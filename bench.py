@@ -1,0 +1,78 @@
+"""Benchmark: flagship decoder training throughput on the real chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Metric: Llama-style decoder train step tokens/sec/chip (BASELINE.md
+north-star "GPT/Llama tokens/sec/chip"). The reference publishes no number
+(BASELINE.md), so vs_baseline compares against a conservative published-class
+A100 figure for a same-size model when available; absent that it reports 1.0.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.parallel.engine import CompiledTrainStep
+
+    paddle.seed(0)
+    on_tpu = jax.default_backend() != "cpu"
+    # single-chip sized decoder (~110M params) in bf16 when on TPU
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=768,
+                          intermediate_size=2048, num_hidden_layers=12,
+                          num_attention_heads=12,
+                          max_position_embeddings=2048, use_parallel=False,
+                          dtype="bfloat16")
+        batch, seq = 8, 1024
+    else:  # CPU smoke config
+        cfg = LlamaConfig.tiny(use_parallel=False)
+        batch, seq = 2, 64
+
+    model = LlamaForCausalLM(cfg)
+    if on_tpu:
+        model.to(dtype="bfloat16")
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+
+    def loss_fn(logits, labels):
+        return F.cross_entropy(
+            logits.reshape([-1, cfg.vocab_size]), labels.reshape([-1]))
+
+    step = CompiledTrainStep(model, loss_fn, opt)
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
+    labels = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
+
+    # warmup / compile
+    for _ in range(2):
+        loss = step(ids, labels)
+    jax.block_until_ready(loss._value)
+
+    iters = 20 if on_tpu else 3
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step(ids, labels)
+    jax.block_until_ready(loss._value)
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * iters / dt
+    print(json.dumps({
+        "metric": "llama_decoder_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": 1.0,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,318 @@
+"""paddle_tpu — a TPU-native deep-learning framework.
+
+Brand-new framework with the capabilities of the reference PaddlePaddle
+snapshot (/root/reference), re-designed for TPU: jax/XLA is the compute and
+compilation substrate, SPMD mesh sharding replaces NCCL process groups, and
+Pallas kernels cover the hot custom ops. The public API mirrors `paddle.*`
+so reference users can switch with minimal changes.
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+import os as _os
+
+import jax as _jax
+
+# float32 matmuls mean float32 (reference CUDA semantics); bfloat16 inputs
+# still hit the MXU natively. Override via FLAGS_matmul_precision.
+_jax.config.update(
+    "jax_default_matmul_precision",
+    _os.environ.get("FLAGS_matmul_precision", "highest"),
+)
+
+from .core.tensor import Parameter, Tensor  # noqa: F401
+from .core.dtype import (  # noqa: F401
+    get_default_dtype,
+    set_default_dtype,
+)
+from .core.place import (  # noqa: F401
+    CPUPlace,
+    CUDAPlace,
+    TPUPlace,
+    device_count,
+    get_device,
+    is_compiled_with_cuda,
+    is_compiled_with_tpu,
+    set_device,
+)
+from .core.dispatch import enable_grad, no_grad  # noqa: F401
+from .core.autograd import grad  # noqa: F401
+from .framework.random import get_rng_state, seed, set_rng_state  # noqa: F401
+
+# dtype name constants (paddle.float32 etc.)
+bool = "bool"  # noqa: A001
+uint8 = "uint8"
+int8 = "int8"
+int16 = "int16"
+int32 = "int32"
+int64 = "int64"
+float16 = "float16"
+bfloat16 = "bfloat16"
+float32 = "float32"
+float64 = "float64"
+complex64 = "complex64"
+complex128 = "complex128"
+
+from .ops.creation import (  # noqa: F401
+    arange,
+    assign,
+    bernoulli,
+    clone,
+    diag,
+    diagflat,
+    empty,
+    empty_like,
+    eye,
+    full,
+    full_like,
+    linspace,
+    logspace,
+    meshgrid,
+    multinomial,
+    normal,
+    numel,
+    ones,
+    ones_like,
+    rand,
+    randint,
+    randn,
+    randperm,
+    standard_normal,
+    to_tensor,
+    tril,
+    triu,
+    uniform,
+    zeros,
+    zeros_like,
+)
+from .ops.math import (  # noqa: F401
+    abs,
+    acos,
+    acosh,
+    add,
+    addmm,
+    asin,
+    asinh,
+    atan,
+    atan2,
+    atanh,
+    bmm,
+    cast,
+    ceil,
+    clip,
+    conj,
+    cos,
+    cosh,
+    cross,
+    cumprod,
+    cumsum,
+    deg2rad,
+    diagonal,
+    digamma,
+    divide,
+    dot,
+    erf,
+    erfinv,
+    exp,
+    expm1,
+    floor,
+    floor_divide,
+    fmax,
+    fmin,
+    frac,
+    heaviside,
+    hypot,
+    increment,
+    inner,
+    isfinite,
+    isinf,
+    isnan,
+    kron,
+    lerp,
+    lgamma,
+    log,
+    log1p,
+    log2,
+    log10,
+    logaddexp,
+    logit,
+    matmul,
+    maximum,
+    minimum,
+    mm,
+    mod,
+    multiply,
+    mv,
+    nan_to_num,
+    neg,
+    outer,
+    pow,
+    rad2deg,
+    real,
+    reciprocal,
+    remainder,
+    round,
+    rsqrt,
+    scale,
+    sign,
+    sin,
+    sinh,
+    sqrt,
+    square,
+    stanh,
+    subtract,
+    tan,
+    tanh,
+    trace,
+    trunc,
+)
+from .ops.math import sigmoid as _sigmoid_op  # noqa: F401
+from .ops.reduction import (  # noqa: F401
+    all,
+    amax,
+    amin,
+    any,
+    argmax,
+    argmin,
+    count_nonzero,
+    logsumexp,
+    max,
+    mean,
+    median,
+    min,
+    nanmean,
+    nansum,
+    prod,
+    quantile,
+    std,
+    sum,
+    var,
+)
+from .ops.manipulation import (  # noqa: F401
+    as_strided,
+    broadcast_tensors,
+    broadcast_to,
+    bucketize,
+    chunk,
+    concat,
+    diff,
+    expand,
+    expand_as,
+    flatten,
+    flip,
+    gather,
+    gather_nd,
+    index_add,
+    index_put,
+    index_select,
+    masked_fill,
+    masked_select,
+    moveaxis,
+    nonzero,
+    one_hot,
+    pad,
+    put_along_axis,
+    repeat_interleave,
+    reshape,
+    roll,
+    rot90,
+    scatter,
+    scatter_nd,
+    scatter_nd_add,
+    searchsorted,
+    slice_ as slice,  # noqa: A001
+    sort,
+    split,
+    squeeze,
+    stack,
+    strided_slice,
+    swapaxes,
+    t,
+    take_along_axis,
+    tile,
+    topk,
+    transpose,
+    unbind,
+    unfold,
+    unique,
+    unsqueeze,
+    where,
+)
+from .ops.manipulation import argsort, kthvalue  # noqa: F401
+from .ops.comparison import (  # noqa: F401
+    allclose,
+    bitwise_and,
+    bitwise_not,
+    bitwise_or,
+    bitwise_xor,
+    equal,
+    equal_all,
+    greater_equal,
+    greater_than,
+    is_empty,
+    isclose,
+    less_equal,
+    less_than,
+    logical_and,
+    logical_not,
+    logical_or,
+    logical_xor,
+    not_equal,
+)
+from .ops import linalg  # noqa: F401
+from .ops.linalg import (  # noqa: F401
+    bincount,
+    cholesky,
+    corrcoef,
+    cov,
+    einsum,
+    histogram,
+    multi_dot,
+    tensordot,
+)
+
+from . import amp  # noqa: F401
+from . import autograd  # noqa: F401
+from . import distributed  # noqa: F401
+from . import framework  # noqa: F401
+from . import io  # noqa: F401
+from . import jit  # noqa: F401
+from . import metric  # noqa: F401
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import static  # noqa: F401
+from . import utils  # noqa: F401
+from . import vision  # noqa: F401
+
+from .framework.io import load, save  # noqa: F401
+from .nn.layer import set_grad_enabled  # noqa: F401
+
+
+def is_grad_enabled():
+    from .core.dispatch import tape_enabled
+
+    return tape_enabled()
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def create_parameter(shape, dtype=None, default_initializer=None):
+    from .nn import initializer as I
+
+    init = default_initializer or I.XavierNormal()
+    return init.create(shape, dtype)
+
+
+def get_flags(name=None):
+    from .core import flags
+
+    return flags.get_flags(name)
+
+
+def set_flags(d):
+    from .core import flags
+
+    return flags.set_flags(d)

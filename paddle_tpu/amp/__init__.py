@@ -1,0 +1,205 @@
+"""Automatic mixed precision.
+
+Parity: reference python/paddle/amp/{auto_cast.py,grad_scaler.py}
+(O1 white/black-list casting, O2 pure low-precision; GradScaler with
+found_inf). TPU-native stance: bfloat16 is the native MXU type and needs NO
+loss scaling — GradScaler degenerates to a pass-through for bf16 and keeps
+full dynamic-scaling semantics for float16.
+"""
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+
+import jax.numpy as jnp
+
+from ..core import dtype as _dtype
+from ..core.tensor import Tensor
+
+_state = threading.local()
+
+# O1 lists (reference python/paddle/amp/fp16_lists.py): ops that are safe in
+# low precision vs ops that must stay fp32.
+WHITE_LIST = {
+    "matmul", "mm", "bmm", "mv", "conv1d", "conv2d", "conv3d", "linear",
+    "einsum", "addmm",
+}
+BLACK_LIST = {
+    "exp", "log", "log2", "log10", "log1p", "softmax", "log_softmax",
+    "cross_entropy", "nll_loss", "mean", "sum", "norm", "layer_norm",
+    "rms_norm", "batch_norm_train", "batch_norm_infer", "cumsum",
+    "logsumexp",
+}
+
+
+def amp_state():
+    return getattr(_state, "amp", None)
+
+
+@contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16"):
+    prev = amp_state()
+    if enable:
+        white = set(WHITE_LIST)
+        black = set(BLACK_LIST)
+        if custom_white_list:
+            white |= set(custom_white_list)
+            black -= set(custom_white_list)
+        if custom_black_list:
+            black |= set(custom_black_list)
+            white -= set(custom_black_list)
+        _state.amp = {
+            "level": level,
+            "dtype": _dtype.canonical_name(dtype),
+            "white": white,
+            "black": black,
+        }
+    else:
+        _state.amp = None
+    try:
+        yield
+    finally:
+        _state.amp = prev
+
+
+amp_guard = auto_cast
+
+
+def maybe_cast_inputs(op_name, leaves):
+    """Called by the dispatcher: cast Tensor leaves per AMP rules."""
+    st = amp_state()
+    if st is None:
+        return leaves
+    dt = _dtype.to_jax(st["dtype"])
+    level = st["level"]
+    cast_down = (op_name in st["white"]) or (
+        level == "O2" and op_name not in st["black"])
+    cast_up = op_name in st["black"]
+    out = []
+    for l in leaves:
+        if isinstance(l, Tensor) and jnp.issubdtype(
+                jnp.result_type(l._value), jnp.floating):
+            v = l._value
+            if cast_down and v.dtype != dt:
+                out.append(_casted_view(l, dt))
+                continue
+            if cast_up and v.dtype in (jnp.bfloat16, jnp.float16):
+                out.append(_casted_view(l, jnp.float32))
+                continue
+        out.append(l)
+    return out
+
+
+def _casted_view(t, dt):
+    from ..ops.math import cast
+
+    return cast(t, dtype=_dtype.canonical_name(dt))
+
+
+def decorate(models=None, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """O2 decoration: cast model params to the AMP dtype (reference
+    amp.decorate). Optimizer moments are float32 already (master weights)."""
+    def _one(m):
+        m.to(dtype=dtype)
+        return m
+
+    if models is None:
+        return None
+    single_model = not isinstance(models, (list, tuple))
+    ms = [models] if single_model else list(models)
+    ms = [_one(m) for m in ms]
+    out_m = ms[0] if single_model else ms
+    if optimizers is None:
+        return out_m
+    return out_m, optimizers
+
+
+class GradScaler:
+    """Dynamic loss scaling (reference python/paddle/amp/grad_scaler.py:149).
+
+    For bfloat16 (TPU default) scaling is unnecessary — enable=True with
+    bf16 behaves as identity, matching TPU practice."""
+
+    def __init__(self, enable=True, init_loss_scaling=2.0**15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=2, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def is_enable(self):
+        return self._enable
+
+    def scale(self, var):
+        if not self._enable:
+            return var
+        return var * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        params = optimizer._get_params()
+        found = False
+        inv = 1.0 / self._scale
+        for p in params:
+            if p.grad is None:
+                continue
+            g = p.grad._value * inv
+            finite = bool(jnp.all(jnp.isfinite(g)))
+            found = found or not finite
+            p.grad._value = g
+        self._found_inf = found
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self.update()
+
+    def minimize(self, optimizer, scaled_loss):
+        self.step(optimizer)
+
+    def update(self):
+        if not self._dynamic:
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+
+    def state_dict(self):
+        return {
+            "scale": self._scale,
+            "good_steps": self._good_steps,
+            "bad_steps": self._bad_steps,
+        }
+
+    def load_state_dict(self, sd):
+        self._scale = sd["scale"]
+        self._good_steps = sd["good_steps"]
+        self._bad_steps = sd["bad_steps"]
+
+    def get_loss_scaling(self):
+        from ..ops.creation import to_tensor
+
+        return to_tensor(self._scale)

@@ -1,0 +1,89 @@
+"""paddle.autograd namespace (reference python/paddle/autograd/)."""
+from __future__ import annotations
+
+from ..core.autograd import backward as _backward_impl
+from ..core.autograd import grad  # noqa: F401
+from ..core.dispatch import no_grad, enable_grad  # noqa: F401
+from ..core.tensor import Tensor
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    from ..core import autograd as eng
+
+    tensors = tensors if isinstance(tensors, (list, tuple)) else [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    import jax.numpy as jnp
+
+    seeds = []
+    for t, g in zip(tensors, grad_tensors):
+        if g is None:
+            seeds.append(jnp.ones(t._value.shape, t._value.dtype))
+        else:
+            seeds.append(g._value if isinstance(g, Tensor) else jnp.asarray(g))
+    eng.run_backward(list(tensors), seeds, retain_graph=retain_graph)
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = []
+        self.attrs = {}
+
+    def save_for_backward(self, *tensors):
+        self._saved = list(tensors)
+
+    def saved_tensor(self):
+        return list(self._saved)
+
+
+class PyLayer:
+    """User-defined differentiable op (reference python/paddle/autograd/py_layer.py).
+
+    Subclass with static `forward(ctx, *args)` and `backward(ctx, *grads)`.
+    The backward is registered as a GradNode whose vjp calls the user code.
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from ..core import autograd as eng
+        from ..core.dispatch import tape_enabled
+
+        ctx = PyLayerContext()
+        in_tensors = [a for a in args if isinstance(a, Tensor)]
+        need_grad = tape_enabled() and any(
+            not t.stop_gradient for t in in_tensors
+        )
+        with no_grad():
+            outs = cls.forward(ctx, *args, **kwargs)
+        single = not isinstance(outs, (tuple, list))
+        outs_t = [outs] if single else list(outs)
+        if need_grad:
+            out_vals = [o._value for o in outs_t]
+
+            def vjp_fn(cots):
+                with no_grad():
+                    gs = cls.backward(ctx, *[
+                        Tensor(c) for c in cots
+                    ])
+                gs = [gs] if isinstance(gs, Tensor) else list(gs)
+                out = []
+                gi = iter(gs)
+                for t in in_tensors:
+                    g = next(gi, None)
+                    out.append(None if g is None else g._value)
+                return out
+
+            node = eng.GradNode(
+                cls.__name__, vjp_fn, in_tensors, out_vals
+            )
+            wrapped = eng.attach_node(out_vals, node)
+            return wrapped[0] if single else list(wrapped)
+        return outs

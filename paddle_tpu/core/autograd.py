@@ -1,0 +1,241 @@
+"""Eager autograd engine.
+
+TPU-native analog of the reference's eager backward engine
+(/root/reference/paddle/fluid/eager/backward.cc:105 RunBackward,
+grad_node_info.h:50 Edge / :168 GradNodeBase):
+
+- GradNode holds the jax.vjp closure produced at forward time — the analytic
+  linearization XLA derived — instead of a generated C++ grad functor.
+- RunBackward is the same queue-driven reverse topological walk with
+  dependency counting and cotangent accumulation (GradTensorHolder analog).
+- Saved "TensorWrappers" are the vjp residuals (device arrays), owned by the
+  closure; freeing the graph drops them.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .tensor import Tensor
+from . import dtype as _dtype
+
+
+class GradNode:
+    __slots__ = (
+        "name",
+        "vjp_fn",
+        "inputs",
+        "out_avals",
+        "freed",
+    )
+
+    def __init__(self, name, vjp_fn, input_tensors, out_vals):
+        self.name = name
+        self.vjp_fn = vjp_fn
+        self.inputs = list(input_tensors)
+        self.out_avals = [
+            jax.ShapeDtypeStruct(jnp.shape(v), jnp.result_type(v))
+            for v in out_vals
+        ]
+        self.freed = False
+
+    def __repr__(self):
+        return "GradNode(%s)" % self.name
+
+
+def _is_float_dtype(dt):
+    return jnp.issubdtype(dt, jnp.floating) or jnp.issubdtype(dt, jnp.complexfloating)
+
+
+def attach_node(out_vals, node):
+    """Wrap op outputs as Tensors carrying the grad node (float outputs only)."""
+    outs = []
+    for i, v in enumerate(out_vals):
+        t = Tensor(v, stop_gradient=True)
+        if _is_float_dtype(node.out_avals[i].dtype):
+            t.stop_gradient = False
+            t._grad_node = node
+            t._out_index = i
+        outs.append(t)
+    return tuple(outs)
+
+
+def _zero_cotangent(aval):
+    if _is_float_dtype(aval.dtype):
+        return jnp.zeros(aval.shape, aval.dtype)
+    # non-differentiable output: jax expects a float0 cotangent
+    return np.zeros(aval.shape, dtype=jax.dtypes.float0)
+
+
+def _accum(a, b):
+    return b if a is None else a + b
+
+
+def run_backward(
+    roots,
+    root_grads,
+    retain_graph=False,
+    capture=None,
+    accumulate_grad=True,
+):
+    """Reverse walk from `roots` (Tensors) seeded with `root_grads` (arrays).
+
+    capture: optional dict id(tensor) -> None; filled with accumulated grad
+    arrays for those tensors (used by paddle_tpu.grad()).
+    Returns nothing; leaf Tensors get .grad accumulated when accumulate_grad.
+    """
+    pending = {}  # node -> list[cotangent or None] per output index
+    deps = {}  # node -> count of incoming edges from reachable consumers
+
+    def route(t, g):
+        """Deliver cotangent g to tensor t."""
+        if g is None or (hasattr(g, "dtype") and g.dtype == jax.dtypes.float0):
+            return
+        if capture is not None and id(t) in capture:
+            capture[id(t)] = _accum(capture[id(t)], g)
+        if t.stop_gradient:
+            return
+        node = t._grad_node
+        if node is None:
+            if accumulate_grad:
+                if t.grad is None:
+                    t.grad = Tensor(g, stop_gradient=True)
+                else:
+                    t.grad._value = t.grad._value + g
+            return
+        lst = pending[node]
+        lst[t._out_index] = _accum(lst[t._out_index], g)
+
+    # --- discover reachable subgraph, count dependencies -------------------
+    root_nodes = []
+    stack = []
+    for t in roots:
+        if t._grad_node is not None:
+            root_nodes.append(t._grad_node)
+            stack.append(t._grad_node)
+    visited = set()
+    while stack:
+        node = stack.pop()
+        if id(node) in visited:
+            continue
+        visited.add(id(node))
+        if node.freed:
+            raise RuntimeError(
+                "Trying to backward through a graph that has already been "
+                "freed (node %s). Use retain_graph=True." % node.name
+            )
+        pending.setdefault(node, [None] * len(node.out_avals))
+        deps.setdefault(node, 0)
+        for t in node.inputs:
+            if t.stop_gradient:
+                continue
+            p = t._grad_node
+            if p is not None:
+                deps[p] = deps.get(p, 0) + 1
+                if id(p) not in visited:
+                    pending.setdefault(p, [None] * len(p.out_avals))
+                    stack.append(p)
+
+    # --- seed root cotangents ---------------------------------------------
+    for t, g in zip(roots, root_grads):
+        route(t, g)
+
+    queue = [n for n in pending if deps.get(n, 0) == 0]
+    processed = []
+    while queue:
+        node = queue.pop()
+        processed.append(node)
+        cots = [
+            c if c is not None else _zero_cotangent(av)
+            for c, av in zip(pending[node], node.out_avals)
+        ]
+        in_grads = node.vjp_fn(tuple(cots))
+        for t, g in zip(node.inputs, in_grads):
+            route(t, g)
+            if not t.stop_gradient and t._grad_node is not None:
+                p = t._grad_node
+                deps[p] -= 1
+                if deps[p] == 0:
+                    queue.append(p)
+
+    if not retain_graph:
+        for node in pending:
+            node.vjp_fn = None
+            node.inputs = []
+            node.freed = True
+
+
+def backward(tensor, grad=None, retain_graph=False):
+    """Tensor.backward implementation (eager_method.cc analog)."""
+    if grad is None:
+        if tensor.size != 1:
+            raise RuntimeError(
+                "backward() on a non-scalar Tensor requires an explicit "
+                "gradient (shape %s)" % (tensor.shape,)
+            )
+        grad = jnp.ones(tensor._value.shape, tensor._value.dtype)
+    else:
+        grad = grad._value if isinstance(grad, Tensor) else jnp.asarray(grad)
+    run_backward([tensor], [grad], retain_graph=retain_graph)
+
+
+def grad(
+    outputs,
+    inputs,
+    grad_outputs=None,
+    retain_graph=None,
+    create_graph=False,
+    only_inputs=True,
+    allow_unused=False,
+):
+    """paddle.grad analog (reference eager GeneralGrad, backward.cc:390).
+
+    create_graph (double backward) is served by the functional transform
+    path (paddle_tpu.incubate.autograd) rather than the eager tape.
+    """
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True: use paddle_tpu.incubate.autograd functional "
+            "transforms (jax.grad composition) for higher-order gradients"
+        )
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outputs)
+    else:
+        grad_outputs = (
+            grad_outputs
+            if isinstance(grad_outputs, (list, tuple))
+            else [grad_outputs]
+        )
+    seeds = []
+    for o, g in zip(outputs, grad_outputs):
+        if g is None:
+            seeds.append(jnp.ones(o._value.shape, o._value.dtype))
+        else:
+            seeds.append(g._value if isinstance(g, Tensor) else jnp.asarray(g))
+    capture = {id(t): None for t in inputs}
+    if retain_graph is None:
+        retain_graph = False
+    run_backward(
+        outputs,
+        seeds,
+        retain_graph=retain_graph,
+        capture=capture,
+        accumulate_grad=False,
+    )
+    results = []
+    for t in inputs:
+        g = capture[id(t)]
+        if g is None:
+            if not allow_unused:
+                raise RuntimeError(
+                    "One of the differentiated Tensors appears to not have "
+                    "been used in the graph (allow_unused=False)"
+                )
+            results.append(None)
+        else:
+            results.append(Tensor(g, stop_gradient=True))
+    return results

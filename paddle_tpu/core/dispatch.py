@@ -1,0 +1,154 @@
+"""Eager op dispatch.
+
+TPU-native replacement for the reference's PHI kernel dispatch stack
+(/root/reference/paddle/phi/core/kernel_factory.h:299 SelectKernelOrThrowError,
+/root/reference/paddle/fluid/eager/ generated *_ad_func):
+
+- There is no per-backend kernel registry: every op body is a pure JAX
+  function; XLA's backend-specific lowering *is* the kernel selection.
+- Autograd capture replaces generated GradNodes: when the tape is live and an
+  input requires grad, the op is linearized with jax.vjp at call time and a
+  GradNode holding the (analytic) vjp closure is recorded. This mirrors the
+  eager engine design (grad_node_info.h:168) with XLA doing the math.
+- Under `paddle_tpu.jit.to_static` tracing, Tensor values are JAX tracers and
+  the very same op bodies stage into one XLA program — the dygraph/static
+  unification the reference needed two engines for.
+"""
+from __future__ import annotations
+
+import functools
+import threading
+
+import jax
+import jax.numpy as jnp
+
+from . import autograd as _autograd
+from .tensor import Tensor, wrap_output
+
+_state = threading.local()
+
+
+def tape_enabled() -> bool:
+    return getattr(_state, "grad_enabled", True)
+
+
+def _set_tape(flag: bool):
+    _state.grad_enabled = flag
+
+
+class no_grad:
+    """Context manager / decorator disabling autograd capture.
+
+    Analog of paddle.no_grad (reference python/paddle/fluid/dygraph/base.py).
+    """
+
+    def __enter__(self):
+        self._prev = tape_enabled()
+        _set_tape(False)
+        return self
+
+    def __exit__(self, *exc):
+        _set_tape(self._prev)
+        return False
+
+    def __call__(self, fn):
+        @functools.wraps(fn)
+        def inner(*args, **kwargs):
+            with no_grad():
+                return fn(*args, **kwargs)
+
+        return inner
+
+
+class enable_grad:
+    def __enter__(self):
+        self._prev = tape_enabled()
+        _set_tape(True)
+        return self
+
+    def __exit__(self, *exc):
+        _set_tape(self._prev)
+        return False
+
+
+# Global registry: op name -> raw (pure-JAX) implementation. The analog of the
+# reference's OpInfoMap; used by OpTest and the profiler, and lets the static
+# capture layer look ops up by name.
+OPS = {}
+
+
+def _unwrap(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+def _contains_tensor(leaves):
+    for l in leaves:
+        if isinstance(l, Tensor):
+            return True
+    return False
+
+
+def primitive(fn=None, *, name=None, nondiff=False):
+    """Register a pure-JAX function as an eager op.
+
+    The wrapped function receives raw jax arrays wherever the caller passed
+    Tensors (including inside one level of list/tuple args), plus static
+    attrs, and returns one array or a tuple of arrays.
+    """
+
+    def deco(raw_fn):
+        op_name = name or raw_fn.__name__
+        OPS[op_name] = raw_fn
+
+        @functools.wraps(raw_fn)
+        def wrapper(*args, **kwargs):
+            leaves, treedef = jax.tree_util.tree_flatten(
+                (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor)
+            )
+            if op_name != "cast":
+                from ..amp import amp_state, maybe_cast_inputs
+
+                if amp_state() is not None:
+                    leaves = maybe_cast_inputs(op_name, leaves)
+            t_idx = [i for i, l in enumerate(leaves) if isinstance(l, Tensor)]
+            need_grad = (
+                not nondiff
+                and tape_enabled()
+                and any(not leaves[i].stop_gradient for i in t_idx)
+            )
+            if not need_grad:
+                plain = [
+                    l._value if isinstance(l, Tensor) else l for l in leaves
+                ]
+                a2, k2 = jax.tree_util.tree_unflatten(treedef, plain)
+                out = raw_fn(*a2, **k2)
+                return wrap_output(out, stop_gradient=True)
+
+            in_tensors = [leaves[i] for i in t_idx]
+            vals = [t._value for t in in_tensors]
+            is_multi = [False]
+
+            def pure(*vs):
+                ls = list(leaves)
+                for i, v in zip(t_idx, vs):
+                    ls[i] = v
+                a2, k2 = jax.tree_util.tree_unflatten(treedef, ls)
+                out = raw_fn(*a2, **k2)
+                if isinstance(out, (tuple, list)):
+                    is_multi[0] = True
+                    return tuple(out)
+                return (out,)
+
+            out_vals, vjp_fn = jax.vjp(pure, *vals)
+            node = _autograd.GradNode(op_name, vjp_fn, in_tensors, out_vals)
+            outs = _autograd.attach_node(out_vals, node)
+            return outs if is_multi[0] else outs[0]
+
+        # stash for introspection
+        wrapper.op_name = op_name
+        wrapper.raw_fn = raw_fn
+        return wrapper
+
+    if fn is not None:
+        return deco(fn)
+    return deco

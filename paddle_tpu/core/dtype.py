@@ -1,0 +1,99 @@
+"""Data types for paddle_tpu.
+
+TPU-native analog of the reference's dtype enums
+(/root/reference/paddle/phi/common/data_type.h). Instead of a closed C++ enum
+we map framework dtype names onto JAX/numpy dtypes — XLA is the single source
+of truth for what a dtype means on device. bfloat16 is first-class (the TPU
+MXU native compute type); float64 is supported but discouraged on TPU.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical dtype table: name -> jnp dtype
+_DTYPE_TABLE = {
+    "bool": jnp.bool_,
+    "uint8": jnp.uint8,
+    "int8": jnp.int8,
+    "int16": jnp.int16,
+    "int32": jnp.int32,
+    "int64": jnp.int64,
+    "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16,
+    "float32": jnp.float32,
+    "float64": jnp.float64,
+    "complex64": jnp.complex64,
+    "complex128": jnp.complex128,
+}
+
+_ALIASES = {
+    "float": "float32",
+    "double": "float64",
+    "half": "float16",
+    "int": "int32",
+    "long": "int64",
+    "bf16": "bfloat16",
+    "fp16": "float16",
+    "fp32": "float32",
+    "fp64": "float64",
+}
+
+FLOATING_DTYPES = ("float16", "bfloat16", "float32", "float64")
+INTEGER_DTYPES = ("uint8", "int8", "int16", "int32", "int64")
+COMPLEX_DTYPES = ("complex64", "complex128")
+
+_default_dtype = "float32"
+
+
+def set_default_dtype(d):
+    """paddle.set_default_dtype analog (reference python/paddle/framework/framework.py)."""
+    global _default_dtype
+    name = canonical_name(d)
+    if name not in FLOATING_DTYPES:
+        raise TypeError(
+            "set_default_dtype only supports floating dtypes, got %s" % name
+        )
+    _default_dtype = name
+
+
+def get_default_dtype():
+    return _default_dtype
+
+
+def canonical_name(dtype) -> str:
+    """Normalize any dtype spec (str/np/jnp dtype) to the canonical name."""
+    if dtype is None:
+        return _default_dtype
+    if isinstance(dtype, str):
+        name = _ALIASES.get(dtype, dtype)
+        if name in _DTYPE_TABLE:
+            return name
+        raise TypeError("Unknown dtype %r" % (dtype,))
+    # numpy / jax dtype objects
+    try:
+        name = np.dtype(dtype).name
+    except TypeError:
+        name = getattr(dtype, "name", None) or str(dtype)
+    name = _ALIASES.get(name, name)
+    if name in _DTYPE_TABLE:
+        return name
+    raise TypeError("Unknown dtype %r" % (dtype,))
+
+
+def to_jax(dtype):
+    """Resolve a dtype spec to the jnp dtype object."""
+    return _DTYPE_TABLE[canonical_name(dtype)]
+
+
+def is_floating(dtype) -> bool:
+    return canonical_name(dtype) in FLOATING_DTYPES
+
+
+def is_integer(dtype) -> bool:
+    name = canonical_name(dtype)
+    return name in INTEGER_DTYPES or name == "bool"
+
+
+def is_complex(dtype) -> bool:
+    return canonical_name(dtype) in COMPLEX_DTYPES

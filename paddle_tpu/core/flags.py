@@ -1,0 +1,73 @@
+"""Runtime flag system.
+
+Analog of the reference's exported gflags
+(/root/reference/paddle/phi/core/flags.cc, python paddle.set_flags at
+python/paddle/fluid/framework.py:7630). Flags are plain process-global values,
+bootstrapped from FLAGS_* environment variables at import, settable from
+Python. TPU-relevant flags map onto XLA/JAX controls where one exists.
+"""
+from __future__ import annotations
+
+import os
+
+_DEFAULTS = {
+    # numerics / debugging
+    "FLAGS_check_nan_inf": False,
+    "FLAGS_check_nan_inf_level": 0,
+    "FLAGS_benchmark": False,
+    # eager engine
+    "FLAGS_retain_grad_for_all_tensor": False,
+    # compile / cache behavior (XLA analogs of allocator & executor flags)
+    "FLAGS_jit_cache_size": 4096,
+    "FLAGS_use_bf16_matmul": True,  # prefer bfloat16 MXU matmuls under amp
+    "FLAGS_eager_delete_tensor_gb": 0.0,  # accepted, no-op under XLA GC
+    "FLAGS_allocator_strategy": "xla",  # buffer assignment is XLA's
+    "FLAGS_fraction_of_gpu_memory_to_use": 1.0,  # accepted for compat
+    # distributed
+    "FLAGS_distributed_barrier_timeout_s": 600,
+    # logging
+    "FLAGS_v": 0,
+}
+
+_flags = {}
+
+
+def _coerce(default, raw):
+    if isinstance(default, bool):
+        return raw.lower() in ("1", "true", "yes", "on")
+    if isinstance(default, int):
+        return int(raw)
+    if isinstance(default, float):
+        return float(raw)
+    return raw
+
+
+def _bootstrap():
+    for k, v in _DEFAULTS.items():
+        raw = os.environ.get(k)
+        _flags[k] = _coerce(v, raw) if raw is not None else v
+
+
+_bootstrap()
+
+
+def get_flags(name=None):
+    if name is None:
+        return dict(_flags)
+    if isinstance(name, (list, tuple)):
+        return {n: _flags[n] for n in name}
+    return {name: _flags[name]}
+
+
+def set_flags(d):
+    for k, v in d.items():
+        if k not in _flags:
+            _flags[k] = v
+        else:
+            _flags[k] = _coerce(_DEFAULTS.get(k, v), str(v)) if isinstance(
+                _DEFAULTS.get(k), (bool, int, float)
+            ) and isinstance(v, str) else v
+
+
+def flag(name, default=None):
+    return _flags.get(name, default)

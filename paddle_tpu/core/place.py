@@ -1,0 +1,116 @@
+"""Device places.
+
+Analog of the reference's Place hierarchy
+(/root/reference/paddle/phi/common/place.h). On TPU the set collapses to
+{TPUPlace, CPUPlace}; a place resolves to a concrete jax.Device. Device
+discovery goes through PJRT (jax.devices) rather than a dynloaded driver.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+
+
+class Place:
+    device_type = "unknown"
+
+    def __init__(self, device_id: int = 0):
+        self.device_id = int(device_id)
+
+    def __repr__(self):
+        return "Place(%s:%d)" % (self.device_type, self.device_id)
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Place)
+            and self.device_type == other.device_type
+            and self.device_id == other.device_id
+        )
+
+    def __hash__(self):
+        return hash((self.device_type, self.device_id))
+
+    def jax_device(self) -> jax.Device:
+        devs = _devices_by_type(self.device_type)
+        if not devs:
+            raise RuntimeError(
+                "No %s devices visible to PJRT" % self.device_type
+            )
+        return devs[self.device_id % len(devs)]
+
+
+class TPUPlace(Place):
+    device_type = "tpu"
+
+
+class CPUPlace(Place):
+    device_type = "cpu"
+
+    def __init__(self):
+        super().__init__(0)
+
+
+class CUDAPlace(Place):
+    """Accepted for API compatibility; resolves to the accelerator backend."""
+
+    device_type = "tpu"
+
+
+@functools.lru_cache(maxsize=None)
+def _devices_by_type(device_type: str):
+    if device_type == "cpu":
+        try:
+            return tuple(jax.devices("cpu"))
+        except RuntimeError:
+            return tuple(jax.devices())
+    # "tpu" means "the accelerator backend" — whatever PJRT says is default.
+    devs = tuple(d for d in jax.devices() if d.platform != "cpu")
+    return devs or tuple(jax.devices())
+
+
+def is_compiled_with_cuda():  # API-compat shim: this framework targets TPU
+    return False
+
+
+def is_compiled_with_tpu():
+    return True
+
+
+def device_count() -> int:
+    return len(jax.devices())
+
+
+_current_place = None
+
+
+def set_device(device):
+    """paddle.set_device analog (reference python/paddle/device/__init__.py)."""
+    global _current_place
+    if isinstance(device, Place):
+        _current_place = device
+        return _current_place
+    name = str(device)
+    if ":" in name:
+        kind, _, idx = name.partition(":")
+        idx = int(idx)
+    else:
+        kind, idx = name, 0
+    kind = {"gpu": "tpu", "cuda": "tpu", "xpu": "tpu", "npu": "tpu"}.get(kind, kind)
+    _current_place = CPUPlace() if kind == "cpu" else TPUPlace(idx)
+    return _current_place
+
+
+def get_device():
+    p = _get_current_place()
+    return "%s:%d" % (p.device_type, p.device_id)
+
+
+def _get_current_place() -> Place:
+    global _current_place
+    if _current_place is None:
+        devs = jax.devices()
+        _current_place = (
+            CPUPlace() if devs[0].platform == "cpu" else TPUPlace(0)
+        )
+    return _current_place

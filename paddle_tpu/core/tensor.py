@@ -1,0 +1,273 @@
+"""The eager Tensor.
+
+TPU-native analog of the reference's eager Tensor
+(/root/reference/paddle/fluid/pybind/eager.cc, python/paddle/fluid/dygraph/
+varbase_patch_methods.py): a thin handle over a device buffer plus autograd
+metadata. Here the buffer is a jax.Array (PJRT-managed, async dispatch built
+in), so there is no separate DeviceContext/stream plumbing — XLA/PJRT owns
+scheduling. Methods are monkey-patched from the ops library at import time,
+mirroring the reference's patching approach.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import dtype as _dtype
+from . import place as _place
+
+
+class Tensor:
+    __slots__ = (
+        "_value",
+        "stop_gradient",
+        "grad",
+        "name",
+        "persistable",
+        "_grad_node",
+        "_out_index",
+        "_sharding_spec",
+        "__weakref__",
+        "__dict__",
+    )
+
+    def __init__(self, value, stop_gradient=True, name=None):
+        if isinstance(value, Tensor):
+            value = value._value
+        self._value = value
+        self.stop_gradient = stop_gradient
+        self.grad = None
+        self.name = name
+        self.persistable = False
+        self._grad_node = None
+        self._out_index = 0
+        self._sharding_spec = None
+
+    # -- metadata ----------------------------------------------------------
+    @property
+    def shape(self):
+        return list(jnp.shape(self._value))
+
+    @property
+    def ndim(self):
+        return len(jnp.shape(self._value))
+
+    dim = ndim
+
+    @property
+    def size(self):
+        return int(np.prod(jnp.shape(self._value), dtype=np.int64))
+
+    @property
+    def dtype(self):
+        return _dtype.canonical_name(jnp.result_type(self._value))
+
+    @property
+    def place(self):
+        devs = getattr(self._value, "devices", None)
+        if callable(devs):
+            try:
+                d = next(iter(self._value.devices()))
+                if d.platform == "cpu":
+                    return _place.CPUPlace()
+                return _place.TPUPlace(d.id)
+            except Exception:
+                pass
+        return _place._get_current_place()
+
+    @property
+    def is_leaf(self):
+        return self._grad_node is None
+
+    @property
+    def T(self):
+        from ..ops import manipulation
+
+        return manipulation.transpose(
+            self, list(range(self.ndim))[::-1]
+        )
+
+    # -- conversion --------------------------------------------------------
+    def numpy(self):
+        return np.asarray(self._value)
+
+    def item(self, *args):
+        if args:
+            return self.numpy().item(*args)
+        return self.numpy().item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def __array__(self, dtype=None):
+        a = self.numpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def __float__(self):
+        return float(self.item())
+
+    def __int__(self):
+        return int(self.item())
+
+    def __bool__(self):
+        if self.size != 1:
+            raise ValueError(
+                "The truth value of a Tensor with more than one element is "
+                "ambiguous; use .any() or .all()"
+            )
+        return bool(self.item())
+
+    def __len__(self):
+        s = self.shape
+        if not s:
+            raise TypeError("len() of a 0-D Tensor")
+        return s[0]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __hash__(self):
+        return id(self)
+
+    def __repr__(self):
+        grad_info = "" if self.stop_gradient else ", stop_gradient=False"
+        return "Tensor(shape=%s, dtype=%s%s,\n       %s)" % (
+            self.shape,
+            self.dtype,
+            grad_info,
+            np.array2string(self.numpy(), prefix="       "),
+        )
+
+    # -- autograd ----------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph=False):
+        from . import autograd
+
+        autograd.backward(self, grad_tensor, retain_graph)
+
+    def clear_grad(self):
+        self.grad = None
+
+    clear_gradient = clear_grad
+
+    def detach(self):
+        t = Tensor(self._value, stop_gradient=True, name=self.name)
+        return t
+
+    def detach_(self):
+        self._grad_node = None
+        self.stop_gradient = True
+        return self
+
+    def register_hook(self, hook):
+        # Gradient hooks land with the EagerReducer analog; store for later.
+        if not hasattr(self, "_hooks"):
+            self._hooks = []
+        self._hooks.append(hook)
+        return hook
+
+    # -- device movement ---------------------------------------------------
+    def to(self, *args, **kwargs):
+        dtype = kwargs.pop("dtype", None)
+        device = kwargs.pop("device", None)
+        for a in args:
+            if isinstance(a, str) and (
+                a in ("cpu", "tpu", "gpu") or ":" in a
+            ):
+                device = a
+            else:
+                dtype = a
+        out = self
+        if dtype is not None:
+            out = out.astype(dtype)
+        if device is not None:
+            kind = device.split(":")[0]
+            kind = {"gpu": "tpu", "cuda": "tpu"}.get(kind, kind)
+            pl = (
+                _place.CPUPlace()
+                if kind == "cpu"
+                else _place.TPUPlace(int(device.split(":")[1]) if ":" in device else 0)
+            )
+            val = jax.device_put(out._value, pl.jax_device())
+            t = Tensor(val, stop_gradient=out.stop_gradient, name=out.name)
+            t._grad_node = out._grad_node
+            t._out_index = out._out_index
+            out = t
+        return out
+
+    def cpu(self):
+        return self.to("cpu")
+
+    def cuda(self, device_id=0):
+        return self.to("tpu:%d" % device_id)
+
+    def pin_memory(self):
+        return self
+
+    # -- mutation (functionalized in-place) --------------------------------
+    def set_value(self, value):
+        """Overwrite the buffer (reference Tensor::copy_ / set_value)."""
+        if isinstance(value, Tensor):
+            value = value._value
+        value = jnp.asarray(value, dtype=jnp.result_type(self._value))
+        if tuple(jnp.shape(value)) != tuple(jnp.shape(self._value)):
+            raise ValueError(
+                "set_value shape mismatch: %s vs %s"
+                % (jnp.shape(value), jnp.shape(self._value))
+            )
+        self._value = value
+        return self
+
+    def copy_(self, other):
+        return self.set_value(other)
+
+    def fill_(self, v):
+        self._value = jnp.full_like(self._value, v)
+        return self
+
+    def zero_(self):
+        self._value = jnp.zeros_like(self._value)
+        return self
+
+    def _bump(self, new_value):
+        """Rebind the buffer for in-place arithmetic ops.
+
+        The reference tracks inplace versions on TensorWrapper
+        (paddle/fluid/eager/tensor_wrapper.h); we functionalize instead:
+        in-place math on a tensor that is part of a live autograd graph is
+        rejected, matching the reference's version-check error.
+        """
+        if self._grad_node is not None:
+            raise RuntimeError(
+                "in-place operation on a non-leaf Tensor recorded by "
+                "autograd is not allowed"
+            )
+        self._value = new_value
+        return self
+
+
+def wrap_output(out, stop_gradient=True):
+    if isinstance(out, (tuple, list)):
+        return tuple(Tensor(v, stop_gradient=stop_gradient) for v in out)
+    return Tensor(out, stop_gradient=stop_gradient)
+
+
+class Parameter(Tensor):
+    """Trainable tensor (reference python/paddle/fluid/framework.py Parameter)."""
+
+    def __init__(self, value, name=None, trainable=True):
+        super().__init__(value, stop_gradient=not trainable, name=name)
+        self.persistable = True
+
+    @property
+    def trainable(self):
+        return not self.stop_gradient
+
+    @trainable.setter
+    def trainable(self, v):
+        self.stop_gradient = not v
+
+    def __repr__(self):
+        return "Parameter(%s):\n%s" % (self.name, super().__repr__())

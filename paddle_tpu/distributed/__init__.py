@@ -1,0 +1,43 @@
+"""paddle.distributed namespace."""
+from . import collective, env, fleet, mesh, topology  # noqa: F401
+from .collective import (  # noqa: F401
+    Group,
+    ReduceOp,
+    all_gather,
+    all_reduce,
+    alltoall,
+    barrier,
+    broadcast,
+    get_group,
+    new_group,
+    recv,
+    reduce,
+    reduce_scatter,
+    scatter,
+    send,
+)
+from .env import (  # noqa: F401
+    ParallelEnv,
+    get_rank,
+    get_world_size,
+    init_parallel_env,
+    is_initialized,
+)
+from .topology import CommunicateTopology, HybridCommunicateGroup  # noqa: F401
+
+
+def spawn(func, args=(), nprocs=-1, **kwargs):
+    """reference paddle.distributed.spawn. Single-controller SPMD does not
+    fork per device — run func once; multi-host launch uses
+    `python -m paddle_tpu.distributed.launch`."""
+    return func(*args)
+
+
+def ParallelMode():
+    class _M:
+        DATA_PARALLEL = 0
+        TENSOR_PARALLEL = 1
+        PIPELINE_PARALLEL = 2
+        SHARDING_PARALLEL = 3
+
+    return _M

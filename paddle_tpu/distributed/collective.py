@@ -1,0 +1,347 @@
+"""Collective communication API.
+
+Parity: reference python/paddle/distributed/communication/ (all_reduce,
+all_gather, reduce_scatter, alltoall, broadcast, send/recv, Group) and the
+C++ ProcessGroup (distributed/collective/process_group.h:53).
+
+TPU-native design ("ProcessGroupICI", SURVEY §5): a Group is a mesh axis.
+Each collective has two execution modes:
+
+1. **Traced** (inside shard_map/pjit): the functions detect tracers and emit
+   the XLA collective (lax.psum / all_gather / ppermute / all_to_all) on the
+   group's axis name — collectives fuse into the surrounding step program and
+   overlap with compute via XLA latency-hiding scheduling (the role of the
+   reference's separate comm streams + WaitCompute/WaitComm events).
+
+2. **Eager**: a cached one-op compiled module (jit of shard_map) applied to a
+   global array sharded over the group axis; dim `shard_axis` (default 0) of
+   the tensor is the per-rank dimension. This mirrors eager ProcessGroup
+   semantics where each rank holds one shard.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from ..core.tensor import Tensor
+from . import mesh as _mesh
+
+_REDUCE_OPS = {"sum": jax.lax.psum, "max": jax.lax.pmax, "min": jax.lax.pmin}
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    """A communication group = one mesh axis (or the full mesh)."""
+
+    def __init__(self, axis="dp", mesh=None, ranks=None, id=0):
+        self.axis = axis
+        self._mesh = mesh
+        self.id = id
+        self.ranks = ranks
+
+    @property
+    def mesh(self):
+        return self._mesh or _mesh.get_mesh()
+
+    @property
+    def nranks(self):
+        return _mesh.axis_size(self.axis, self.mesh)
+
+    world_size = nranks
+
+    @property
+    def rank(self):
+        # process-level rank within group; for SPMD single-process it is 0
+        return 0
+
+    def get_group_rank(self, rank):
+        return rank
+
+    def __repr__(self):
+        return "Group(axis=%s, nranks=%d)" % (self.axis, self.nranks)
+
+
+_default_group = None
+_groups = {}
+
+
+def _get_default_group():
+    global _default_group
+    if _default_group is None:
+        mesh = _mesh.get_mesh()
+        _default_group = Group(axis=mesh.axis_names[0], mesh=mesh)
+    return _default_group
+
+
+def new_group(ranks=None, backend=None, axis=None, timeout=None):
+    """reference communication/group.py new_group. TPU mapping: groups are
+    mesh axes; `axis` selects one. ranks-based ad-hoc groups map onto the
+    default axis (the SPMD partitioner needs axes, not rank lists)."""
+    g = Group(axis=axis or _mesh.get_mesh().axis_names[0])
+    _groups[g.id] = g
+    return g
+
+
+def get_group(gid=0):
+    return _groups.get(gid, _get_default_group())
+
+
+def _is_tracer(v):
+    return isinstance(v, jax.core.Tracer)
+
+
+def _axis_in_scope(axis):
+    """True if `axis` is a bound axis name (we're inside shard_map/pmap)."""
+    try:
+        jax.lax.axis_index(axis)
+        return True
+    except Exception:
+        return False
+
+
+def _unwrap(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+def _wrap_like(x, v):
+    return Tensor(v) if isinstance(x, Tensor) else v
+
+
+@functools.lru_cache(maxsize=256)
+def _compiled_collective(kind, axis, shape, dtype, extra=()):
+    """Cached one-op XLA module over the mesh (the ProcessGroupICI analog of
+    the reference's cached NCCL launch per ring)."""
+    mesh = _mesh.get_mesh()
+    spec = P(axis)
+
+    if kind == "all_reduce_sum":
+        f = lambda v: jax.lax.psum(v, axis)
+        in_spec, out_spec = spec, P()
+    elif kind == "all_reduce_max":
+        f = lambda v: jax.lax.pmax(v, axis)
+        in_spec, out_spec = spec, P()
+    elif kind == "all_reduce_min":
+        f = lambda v: jax.lax.pmin(v, axis)
+        in_spec, out_spec = spec, P()
+    elif kind == "all_gather":
+        f = lambda v: jax.lax.all_gather(v, axis, tiled=True)
+        in_spec, out_spec = spec, P()
+    elif kind == "reduce_scatter":
+        f = lambda v: jax.lax.psum_scatter(v, axis, tiled=True)
+        in_spec, out_spec = spec, spec
+    elif kind == "all_to_all":
+        f = lambda v: jax.lax.all_to_all(v, axis, split_axis=1,
+                                         concat_axis=0, tiled=True)
+        in_spec, out_spec = spec, spec
+    else:
+        raise ValueError(kind)
+    fn = shard_map(f, mesh=mesh, in_specs=(in_spec,), out_specs=out_spec,
+                   check_rep=False)
+    return jax.jit(fn)
+
+
+def _eager_shard(x, axis):
+    mesh = _mesh.get_mesh()
+    return jax.device_put(x, NamedSharding(mesh, P(axis)))
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    g = group or _get_default_group()
+    v = _unwrap(tensor)
+    if _is_tracer(v):
+        if op == ReduceOp.SUM:
+            out = jax.lax.psum(v, g.axis)
+        elif op == ReduceOp.MAX:
+            out = jax.lax.pmax(v, g.axis)
+        elif op == ReduceOp.MIN:
+            out = jax.lax.pmin(v, g.axis)
+        elif op == ReduceOp.AVG:
+            out = jax.lax.pmean(v, g.axis)
+        else:
+            raise ValueError(op)
+        return _wrap_like(tensor, out)
+    if g.nranks == 1:
+        return tensor
+    kind = {"sum": "all_reduce_sum", "max": "all_reduce_max",
+            "min": "all_reduce_min"}[op if op != ReduceOp.AVG else "sum"]
+    fn = _compiled_collective(kind, g.axis, tuple(v.shape), str(v.dtype))
+    out = fn(_eager_shard(v, g.axis))
+    if op == ReduceOp.AVG:
+        out = out / g.nranks
+    if isinstance(tensor, Tensor):
+        tensor._value = out
+        return tensor
+    return out
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
+    g = group or _get_default_group()
+    v = _unwrap(tensor)
+    if _is_tracer(v):
+        out = jax.lax.all_gather(v, g.axis)
+        # traced mode returns stacked [nranks, ...]
+        return _wrap_like(tensor, out)
+    if g.nranks == 1:
+        if tensor_list is not None:
+            tensor_list.append(
+                tensor if isinstance(tensor, Tensor) else Tensor(v))
+            return tensor_list
+        return tensor
+    fn = _compiled_collective("all_gather", g.axis, tuple(v.shape),
+                              str(v.dtype))
+    out = fn(_eager_shard(v, g.axis))
+    if tensor_list is not None:
+        parts = jnp.split(out, g.nranks, axis=0)
+        tensor_list.extend(Tensor(p) for p in parts)
+        return tensor_list
+    return Tensor(out)
+
+
+def reduce_scatter(tensor, tensor_or_tensor_list=None, op=ReduceOp.SUM,
+                   group=None, sync_op=True):
+    g = group or _get_default_group()
+    src = tensor_or_tensor_list if tensor_or_tensor_list is not None else tensor
+    if isinstance(src, (list, tuple)):
+        v = jnp.concatenate([_unwrap(t) for t in src], axis=0)
+    else:
+        v = _unwrap(src)
+    if _is_tracer(v):
+        return _wrap_like(tensor, jax.lax.psum_scatter(v, g.axis, tiled=True))
+    if g.nranks == 1:
+        if isinstance(tensor, Tensor):
+            tensor._value = v
+            return tensor
+        return Tensor(v)
+    fn = _compiled_collective("reduce_scatter", g.axis, tuple(v.shape),
+                              str(v.dtype))
+    out = fn(_eager_shard(v, g.axis))
+    if isinstance(tensor, Tensor):
+        tensor._value = out
+        return tensor
+    return Tensor(out)
+
+
+def alltoall(in_tensor_or_list, out_tensor_or_list=None, group=None,
+             sync_op=True):
+    g = group or _get_default_group()
+    if isinstance(in_tensor_or_list, (list, tuple)):
+        v = jnp.concatenate([_unwrap(t) for t in in_tensor_or_list], axis=0)
+        as_list = True
+    else:
+        v = _unwrap(in_tensor_or_list)
+        as_list = False
+    if _is_tracer(v):
+        n = g.nranks
+        r = v.reshape((n, v.shape[0] // n) + v.shape[1:])
+        out = jax.lax.all_to_all(r, g.axis, split_axis=0, concat_axis=0,
+                                 tiled=False)
+        out = out.reshape(v.shape)
+        return _wrap_like(in_tensor_or_list, out)
+    if g.nranks == 1:
+        out = v
+    else:
+        fn = _compiled_collective("all_to_all", g.axis, tuple(v.shape),
+                                  str(v.dtype))
+        out = fn(_eager_shard(v, g.axis))
+    if as_list and out_tensor_or_list is not None:
+        parts = jnp.split(out, g.nranks, axis=0)
+        out_tensor_or_list.extend(Tensor(p) for p in parts)
+        return out_tensor_or_list
+    return Tensor(out)
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    g = group or _get_default_group()
+    v = _unwrap(tensor)
+    if _is_tracer(v):
+        # broadcast within an SPMD program: select src's shard and replicate
+        idx = jax.lax.axis_index(g.axis)
+        out = jax.lax.psum(jnp.where(idx == src, v, jnp.zeros_like(v)), g.axis)
+        return _wrap_like(tensor, out)
+    # SPMD single process: arrays are already globally addressed; replicating
+    # is a device_put with a replicated sharding.
+    if isinstance(tensor, Tensor):
+        tensor._value = _mesh.replicate(v)
+        return tensor
+    return _mesh.replicate(v)
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    # On the mesh an all-reduce + owner view is the natural lowering; the
+    # reference's rooted reduce saves no ICI time on TPU tori.
+    return all_reduce(tensor, op=op, group=group)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    g = group or _get_default_group()
+    if tensor_list is not None:
+        full = jnp.concatenate([_unwrap(t) for t in tensor_list], axis=0)
+        n = g.nranks
+        part = jnp.split(full, n, axis=0)[0]
+        if isinstance(tensor, Tensor):
+            tensor._value = part
+            return tensor
+        return Tensor(part)
+    return tensor
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    raise RuntimeError(
+        "eager point-to-point send/recv has no SPMD analog: use "
+        "paddle_tpu.parallel p2p helpers (ppermute) inside a compiled "
+        "step, as the pipeline runtime does")
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    raise RuntimeError(
+        "eager point-to-point send/recv has no SPMD analog: use "
+        "paddle_tpu.parallel p2p helpers (ppermute) inside a compiled step")
+
+
+def barrier(group=None):
+    # All outstanding XLA work on all local devices must finish.
+    for d in jax.devices():
+        pass
+    jax.block_until_ready(
+        jax.device_put(jnp.zeros(()), jax.devices()[0]))
+
+
+def get_rank(group=None):
+    from . import env
+
+    return env.get_rank(group)
+
+
+def get_world_size(group=None):
+    from . import env
+
+    return env.get_world_size(group)
+
+
+def is_available():
+    return True
+
+
+# traced-mode helpers used by parallel layers --------------------------------
+
+def psum(v, axis):
+    return jax.lax.psum(v, axis)
+
+
+def ppermute(v, axis, perm):
+    return jax.lax.ppermute(v, axis, perm)
+
+
+def axis_index(axis):
+    return jax.lax.axis_index(axis)

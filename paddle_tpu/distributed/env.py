@@ -1,0 +1,82 @@
+"""Distributed environment discovery.
+
+Parity: reference RoleMaker env parsing (fleet/base/role_maker.py —
+PADDLE_TRAINER_ID / PADDLE_TRAINER_ENDPOINTS) and init_parallel_env's TCP
+store (python/paddle/distributed/parallel.py:108). TPU-native: multi-host
+bootstrap is jax.distributed.initialize (coordinator address + process id),
+after which every XLA collective rides ICI/DCN — there are no per-ring NCCL
+ids to broadcast. Within one process, "ranks" are mesh positions, not
+processes: world size = total device count.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+_initialized = False
+
+
+def init_parallel_env(strategy=None):
+    """paddle.distributed.init_parallel_env analog."""
+    global _initialized
+    if _initialized:
+        return
+    coord = os.environ.get("PADDLE_MASTER") or os.environ.get(
+        "COORDINATOR_ADDRESS")
+    nnodes = int(os.environ.get("PADDLE_NNODES", "1"))
+    if coord and nnodes > 1:
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=nnodes,
+            process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")),
+        )
+    _initialized = True
+
+
+def is_initialized():
+    return _initialized
+
+
+def get_rank(group=None):
+    """Process index (host rank). Device-level rank lives on the mesh."""
+    if group is not None:
+        return group.rank
+    return jax.process_index()
+
+
+def get_world_size(group=None):
+    if group is not None:
+        return group.nranks
+    # device-level world size: each device is a "rank" in SPMD terms
+    return jax.device_count()
+
+
+def get_process_count():
+    return jax.process_count()
+
+
+class ParallelEnv:
+    """reference python/paddle/fluid/dygraph/parallel.py ParallelEnv."""
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def device_id(self):
+        return 0
+
+    @property
+    def current_endpoint(self):
+        eps = os.environ.get("PADDLE_CURRENT_ENDPOINT", "127.0.0.1:6170")
+        return eps
+
+    @property
+    def trainer_endpoints(self):
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        return eps.split(",") if eps else ["127.0.0.1:6170"]

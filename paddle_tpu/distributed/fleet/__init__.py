@@ -1,0 +1,29 @@
+"""paddle.distributed.fleet facade (reference fleet/fleet.py:101)."""
+from __future__ import annotations
+
+from .base import (  # noqa: F401
+    DistributedStrategy,
+    PaddleCloudRoleMaker,
+    Role,
+    UserDefinedRoleMaker,
+)
+from .fleet import Fleet, fleet  # noqa: F401
+
+init = fleet.init
+is_first_worker = fleet.is_first_worker
+worker_index = fleet.worker_index
+worker_num = fleet.worker_num
+is_worker = fleet.is_worker
+is_server = fleet.is_server
+distributed_optimizer = fleet.distributed_optimizer
+distributed_model = fleet.distributed_model
+
+
+def get_hybrid_communicate_group():
+    return fleet._hcg
+
+
+def set_log_level(level):
+    import logging
+
+    logging.getLogger("paddle_tpu.distributed").setLevel(level)

@@ -1,0 +1,130 @@
+"""Fleet base objects: DistributedStrategy & RoleMakers.
+
+Parity: reference fleet/base/distributed_strategy.py (212-field proto wrapper)
+and fleet/base/role_maker.py. The strategy keeps the reference's field names
+(amp, recompute, sharding, pipeline, hybrid_configs, ...) as plain python —
+they select mesh degrees and compiled-step options instead of graph-rewrite
+passes.
+"""
+from __future__ import annotations
+
+import os
+
+
+class Role:
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+    COORDINATOR = 5
+
+
+class DistributedStrategy:
+    def __init__(self):
+        # collective strategies (subset of distributed_strategy.proto:307
+        # that is meaningful on TPU; accepted-but-no-op knobs are kept so
+        # reference configs load unchanged)
+        self.amp = False
+        self.amp_configs = {
+            "init_loss_scaling": 32768.0,
+            "use_pure_fp16": False,
+            "use_fp16_guard": False,
+            "custom_white_list": [],
+            "custom_black_list": [],
+        }
+        self.recompute = False
+        self.recompute_configs = {"checkpoints": []}
+        self.pipeline = False
+        self.pipeline_configs = {
+            "accumulate_steps": 1,
+            "micro_batch_size": 1,
+            "schedule_mode": "1F1B",
+        }
+        self.tensor_parallel = False
+        self.tensor_parallel_configs = {"tensor_parallel_degree": 1}
+        self.sharding = False
+        self.sharding_configs = {
+            "sharding_degree": 1,
+            "stage": 1,
+            "offload": False,
+        }
+        self.hybrid_configs = {
+            "dp_degree": 1,
+            "mp_degree": 1,
+            "pp_degree": 1,
+            "sharding_degree": 1,
+            "sep_degree": 1,
+        }
+        self.gradient_merge = False
+        self.gradient_merge_configs = {"k_steps": 1, "avg": True}
+        self.lamb = False
+        self.lars = False
+        self.dgc = False
+        self.localsgd = False
+        self.fp16_allreduce = False
+        self.find_unused_parameters = False
+        self.gradient_scale_configs = {"scale_strategy": "avg"}
+        self.a_sync = False
+        self.a_sync_configs = {}
+        self.auto = False
+        self.semi_auto = False
+        self.heter_ccl_mode = False
+        self.without_graph_optimization = True
+        self.fuse_all_reduce_ops = True  # XLA fuses; accepted for compat
+        self.fuse_grad_size_in_MB = 32
+        self.nccl_comm_num = 1
+
+    def __repr__(self):
+        keys = ["amp", "recompute", "pipeline", "tensor_parallel", "sharding",
+                "hybrid_configs"]
+        return "DistributedStrategy(%s)" % ", ".join(
+            "%s=%r" % (k, getattr(self, k)) for k in keys)
+
+
+class RoleMakerBase:
+    def __init__(self, is_collective=True, **kwargs):
+        self._is_collective = is_collective
+
+    def _worker_index(self):
+        return int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+
+    def _worker_num(self):
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        return len(eps.split(",")) if eps else 1
+
+    def _is_first_worker(self):
+        return self._worker_index() == 0
+
+    def _is_worker(self):
+        return os.environ.get("TRAINING_ROLE", "TRAINER") in (
+            "TRAINER", "WORKER")
+
+    def _is_server(self):
+        return os.environ.get("TRAINING_ROLE", "") == "PSERVER"
+
+    def _get_trainer_endpoints(self):
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        return eps.split(",") if eps else ["127.0.0.1:6170"]
+
+    def _get_pserver_endpoints(self):
+        eps = os.environ.get("PADDLE_PSERVER_ENDPOINTS", "")
+        return eps.split(",") if eps else []
+
+
+class PaddleCloudRoleMaker(RoleMakerBase):
+    pass
+
+
+class UserDefinedRoleMaker(RoleMakerBase):
+    def __init__(self, is_collective=True, current_id=0, role=Role.WORKER,
+                 worker_num=1, server_endpoints=None, **kwargs):
+        super().__init__(is_collective)
+        self._current_id = current_id
+        self._role = role
+        self._num = worker_num
+
+    def _worker_index(self):
+        return self._current_id
+
+    def _worker_num(self):
+        return self._num

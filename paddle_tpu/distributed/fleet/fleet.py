@@ -1,0 +1,114 @@
+"""The Fleet singleton (reference fleet/fleet.py:101).
+
+fleet.init builds the hybrid mesh from strategy.hybrid_configs;
+distributed_model wraps the user Layer by topology (TensorParallel /
+PipelineParallel / ShardingParallel / DataParallel — reference
+fleet/model.py:30); distributed_optimizer wraps the optimizer with
+hybrid-parallel grad sync + clip (reference
+hybrid_parallel_optimizer.py:186).
+"""
+from __future__ import annotations
+
+from .. import env as _env
+from ..topology import HybridCommunicateGroup
+from .base import DistributedStrategy, RoleMakerBase
+
+
+class Fleet:
+    def __init__(self):
+        self._role_maker = None
+        self._strategy = None
+        self._hcg = None
+        self._is_collective = True
+
+    def init(self, role_maker=None, is_collective=True, strategy=None,
+             log_level="INFO"):
+        self._role_maker = role_maker or RoleMakerBase(is_collective)
+        self._strategy = strategy or DistributedStrategy()
+        self._is_collective = is_collective
+        _env.init_parallel_env()
+        hc = self._strategy.hybrid_configs
+        self._hcg = HybridCommunicateGroup(
+            dp_degree=hc.get("dp_degree", 1),
+            mp_degree=hc.get("mp_degree", 1),
+            pp_degree=hc.get("pp_degree", 1),
+            sharding_degree=hc.get("sharding_degree", 1),
+            sep_degree=hc.get("sep_degree", 1),
+        )
+        return self
+
+    # -- info --------------------------------------------------------------
+    def is_first_worker(self):
+        return _env.get_rank() == 0
+
+    def worker_index(self):
+        return _env.get_rank()
+
+    def worker_num(self):
+        return _env.get_process_count()
+
+    def is_worker(self):
+        return True
+
+    def is_server(self):
+        return False
+
+    def barrier_worker(self):
+        from .. import collective
+
+        collective.barrier()
+
+    @property
+    def worker_endpoints(self, to_string=False):
+        return self._role_maker._get_trainer_endpoints()
+
+    def get_hybrid_communicate_group(self):
+        return self._hcg
+
+    # -- wrapping ----------------------------------------------------------
+    def distributed_model(self, model):
+        from ...parallel.data_parallel import DataParallel
+        from ...parallel.pipeline_parallel import PipelineParallel
+        from ...parallel.sharding_parallel import ShardingParallel
+        from ...parallel.tensor_parallel import TensorParallel
+
+        hcg = self._hcg
+        if hcg is None:
+            raise RuntimeError("call fleet.init() first")
+        if hcg.get_pipe_parallel_world_size() > 1:
+            return PipelineParallel(model, hcg, self._strategy)
+        if hcg.get_model_parallel_world_size() > 1:
+            return TensorParallel(model, hcg, self._strategy)
+        if hcg.get_sharding_parallel_world_size() > 1:
+            return ShardingParallel(model, hcg, self._strategy)
+        return DataParallel(model, hcg=hcg, strategy=self._strategy)
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        from ...parallel.hybrid_optimizer import HybridParallelOptimizer
+
+        if strategy is not None:
+            self._strategy = strategy
+        return HybridParallelOptimizer(optimizer, self._hcg, self._strategy)
+
+    # PS-mode entry points (host-resident parameter server, csrc/ps)
+    def init_server(self, *args, **kwargs):
+        from ..ps.runtime import TheOnePSRuntime
+
+        self._ps_runtime = TheOnePSRuntime(self._strategy)
+        self._ps_runtime.init_server()
+
+    def run_server(self):
+        self._ps_runtime.run_server()
+
+    def init_worker(self):
+        from ..ps.runtime import TheOnePSRuntime
+
+        self._ps_runtime = TheOnePSRuntime(self._strategy)
+        self._ps_runtime.init_worker()
+
+    def stop_worker(self):
+        if hasattr(self, "_ps_runtime"):
+            self._ps_runtime.stop()
+
+
+fleet = Fleet()

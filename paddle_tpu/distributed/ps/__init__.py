@@ -1,0 +1,2 @@
+"""Parameter-server path (reference paddle/fluid/distributed/ps/)."""
+from . import runtime  # noqa: F401

@@ -1,0 +1,112 @@
+"""The-one-PS runtime, TPU-host edition.
+
+Parity: reference TheOnePSRuntime (python/paddle/distributed/ps/
+the_one_ps.py:1031) over brpc MemorySparseTable
+(paddle/fluid/distributed/ps/table/). TPU analog (SURVEY §7.9): sparse
+embedding tables live on the TPU-VM *hosts* (CPU hash maps, C++ backend in
+csrc/ps when built), dense compute on chips; pull/push are host RPCs over
+DCN. This python runtime implements the in-process ("PsLocalClient",
+reference ps_local_client.h) mode used by single-host tests; the wire
+protocol server arrives with csrc/ps.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+
+class SparseTable:
+    """In-memory sparse table (reference MemorySparseTable): id -> embedding
+    row, created on first pull (CTR accessor's create-on-miss)."""
+
+    def __init__(self, dim, init_std=0.01, optimizer="sgd", lr=0.01):
+        self.dim = dim
+        self.rows = {}
+        self.init_std = init_std
+        self.lr = lr
+        self._lock = threading.Lock()
+
+    def pull(self, ids):
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        out = np.empty((ids.size, self.dim), np.float32)
+        with self._lock:
+            for i, k in enumerate(ids):
+                k = int(k)
+                row = self.rows.get(k)
+                if row is None:
+                    row = np.random.normal(
+                        0.0, self.init_std, self.dim).astype(np.float32)
+                    self.rows[k] = row
+                out[i] = row
+        return out
+
+    def push(self, ids, grads):
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        grads = np.asarray(grads, np.float32).reshape(ids.size, self.dim)
+        with self._lock:
+            for k, g in zip(ids, grads):
+                k = int(k)
+                row = self.rows.get(k)
+                if row is not None:
+                    row -= self.lr * g
+
+    def size(self):
+        return len(self.rows)
+
+
+class DenseTable:
+    def __init__(self, shape, lr=0.01):
+        self.value = np.zeros(shape, np.float32)
+        self.lr = lr
+
+    def pull(self):
+        return self.value.copy()
+
+    def push(self, grad):
+        self.value -= self.lr * np.asarray(grad, np.float32)
+
+
+class TheOnePSRuntime:
+    def __init__(self, strategy=None):
+        self._strategy = strategy
+        self._tables = {}
+        self._server_started = False
+
+    # table management
+    def create_sparse_table(self, name, dim, **kwargs):
+        self._tables[name] = SparseTable(dim, **kwargs)
+        return self._tables[name]
+
+    def create_dense_table(self, name, shape, **kwargs):
+        self._tables[name] = DenseTable(shape, **kwargs)
+        return self._tables[name]
+
+    def get_table(self, name):
+        return self._tables[name]
+
+    # lifecycle
+    def init_server(self, *args, **kwargs):
+        self._server_started = True
+
+    def run_server(self):
+        pass
+
+    def init_worker(self):
+        pass
+
+    def stop(self):
+        self._server_started = False
+
+    # client ops (PsLocalClient semantics)
+    def pull_sparse(self, name, ids):
+        return self._tables[name].pull(ids)
+
+    def push_sparse(self, name, ids, grads):
+        return self._tables[name].push(ids, grads)
+
+    def pull_dense(self, name):
+        return self._tables[name].pull()
+
+    def push_dense(self, name, grad):
+        return self._tables[name].push(grad)

@@ -1,0 +1,2 @@
+from . import random  # noqa: F401
+from .random import get_rng_state, seed, set_rng_state  # noqa: F401

@@ -1,0 +1,73 @@
+"""paddle.save / paddle.load.
+
+Parity: reference python/paddle/framework/io.py:637,879 (pickle protocol with
+tensor chunks). We serialize numpy arrays via pickle; nested state dicts,
+optimizer states, and plain python objects round-trip. Sharded / distributed
+checkpointing lives in paddle_tpu.distributed.checkpoint (orbax-backed).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+def _to_serializable(obj):
+    if isinstance(obj, Tensor):
+        return _TensorPayload(np.asarray(obj._value), str(obj.dtype))
+    if isinstance(obj, dict):
+        return {k: _to_serializable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = [_to_serializable(v) for v in obj]
+        return t if isinstance(obj, list) else tuple(t)
+    return obj
+
+
+def _from_serializable(obj, return_numpy=False):
+    if isinstance(obj, _TensorPayload):
+        if return_numpy:
+            return obj.array
+        import jax.numpy as jnp
+
+        from ..core import dtype as _dt
+
+        return Tensor(jnp.asarray(obj.array, _dt.to_jax(obj.dtype)))
+    if isinstance(obj, dict):
+        return {k: _from_serializable(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = [_from_serializable(v, return_numpy) for v in obj]
+        return t if isinstance(obj, list) else tuple(t)
+    return obj
+
+
+class _TensorPayload:
+    def __init__(self, array, dtype):
+        # bfloat16 has no numpy dtype guarantee: store as uint16 view
+        self.dtype = dtype
+        if dtype == "bfloat16":
+            self.array = array.view(np.uint16) if array.dtype != np.uint16 \
+                else array
+        else:
+            self.array = array
+
+    @property
+    def _array(self):
+        return self.array
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    payload = _to_serializable(obj)
+    with open(path, "wb") as f:
+        pickle.dump(payload, f, protocol=protocol)
+
+
+def load(path, return_numpy=False, **configs):
+    with open(path, "rb") as f:
+        payload = pickle.load(f)
+    return _from_serializable(payload, return_numpy)

@@ -1,0 +1,46 @@
+"""Random state.
+
+Analog of the reference's per-device Generator
+(/root/reference/paddle/phi/core/generator.h, python/paddle/framework/random.py)
+rebuilt on JAX's splittable counter-based PRNG: a process-global root key is
+split per draw. Under `to_static` tracing the split happens at trace time, so
+a compiled step re-uses its traced keys; compiled training loops should thread
+keys explicitly (the nn layers accept a `seed` attr for that) — same caveat as
+the reference's cudnn dropout state caching.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+_lock = threading.Lock()
+_root_key = jax.random.key(0)
+_counter = 0
+
+
+def seed(s: int):
+    """paddle.seed analog."""
+    global _root_key, _counter
+    with _lock:
+        _root_key = jax.random.key(int(s))
+        _counter = 0
+    return s
+
+
+def next_key():
+    """Return a fresh PRNG key (thread-safe)."""
+    global _counter
+    with _lock:
+        _counter += 1
+        c = _counter
+    return jax.random.fold_in(_root_key, c)
+
+
+def get_rng_state():
+    return (_root_key, _counter)
+
+
+def set_rng_state(state):
+    global _root_key, _counter
+    _root_key, _counter = state

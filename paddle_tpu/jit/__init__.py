@@ -1,0 +1,183 @@
+"""paddle.jit — dynamic-to-static.
+
+The reference rewrites Python ASTs into ProgramDesc
+(python/paddle/jit/dy2static, ProgramTranslator at
+program_translator.py:1160). TPU-native design: our eager ops already *are*
+jax-traceable expressions, so to_static is jax.jit tracing of the user's
+forward with parameters lifted to arguments — one XLA module per input
+signature, cached, donation-friendly. This collapses the reference's AST
+transformer + ProgramDesc + executor pipeline into a trace-and-compile step
+while keeping the same user API (@to_static, jit.save/load, input_spec).
+"""
+from __future__ import annotations
+
+import functools
+import os
+import pickle
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as _dtype
+from ..core.dispatch import no_grad
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+
+
+class InputSpec:
+    """Static shape/dtype spec (reference python/paddle/static/input.py)."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = list(shape)
+        self.dtype = _dtype.canonical_name(dtype)
+        self.name = name
+
+    def __repr__(self):
+        return "InputSpec(shape=%s, dtype=%s)" % (self.shape, self.dtype)
+
+
+class StaticFunction:
+    """Compiled wrapper around a Layer method or function."""
+
+    def __init__(self, fn, layer=None, input_spec=None):
+        self._fn = fn
+        self._layer = layer
+        self._input_spec = input_spec
+        self._cache = {}
+        functools.update_wrapper(self, fn)
+
+    def _key(self, args):
+        parts = []
+        for a in args:
+            if isinstance(a, Tensor):
+                parts.append(("T", tuple(a.shape), a.dtype))
+            else:
+                parts.append(("S", repr(a)))
+        return tuple(parts)
+
+    def _compile(self, args):
+        layer = self._layer
+        if layer is not None:
+            names, _ = layer.functional_state()
+
+            def pure(state_vals, *in_vals):
+                wrapped = [Tensor(v) for v in in_vals]
+                with layer.bind_state(names, state_vals):
+                    with no_grad():
+                        out = self._fn(*wrapped)
+                return jax.tree_util.tree_map(
+                    lambda t: t._value if isinstance(t, Tensor) else t, out,
+                    is_leaf=lambda x: isinstance(x, Tensor))
+
+            return jax.jit(pure)
+
+        def pure(*in_vals):
+            wrapped = [Tensor(v) for v in in_vals]
+            with no_grad():
+                out = self._fn(*wrapped)
+            return jax.tree_util.tree_map(
+                lambda t: t._value if isinstance(t, Tensor) else t, out,
+                is_leaf=lambda x: isinstance(x, Tensor))
+
+        return jax.jit(pure)
+
+    def __call__(self, *args, **kwargs):
+        tensor_args = [a for a in args if isinstance(a, Tensor)]
+        if len(tensor_args) != len(args):
+            # non-tensor args: fall back to eager for simplicity
+            return self._fn(*args, **kwargs)
+        key = self._key(args)
+        if key not in self._cache:
+            self._cache[key] = self._compile(args)
+        compiled = self._cache[key]
+        in_vals = [a._value for a in args]
+        if self._layer is not None:
+            _, state_vals = self._layer.functional_state()
+            out = compiled(state_vals, *in_vals)
+        else:
+            out = compiled(*in_vals)
+        return jax.tree_util.tree_map(Tensor, out)
+
+    @property
+    def concrete_program(self):
+        return self._cache
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None):
+    """@paddle.jit.to_static analog (reference python/paddle/jit/api.py:222)."""
+
+    def deco(fn):
+        if isinstance(fn, Layer):
+            layer = fn
+            layer.forward = StaticFunction(
+                layer.forward.__func__.__get__(layer)
+                if hasattr(layer.forward, "__func__") else layer.forward,
+                layer=layer, input_spec=input_spec)
+            return layer
+        return StaticFunction(fn, input_spec=input_spec)
+
+    if function is not None:
+        return deco(function)
+    return deco
+
+
+def save(layer, path, input_spec=None, **config):
+    """jit.save: serialize params + a callable spec. The compiled artifact
+    (StableHLO) is regenerated at load — XLA executables are
+    hardware-keyed, mirroring how the reference regenerates engine plans."""
+    import numpy as np
+
+    state = {}
+    if isinstance(layer, Layer):
+        for name, t in layer.state_dict().items():
+            state[name] = np.asarray(t._value)
+    meta = {
+        "class": type(layer).__name__,
+        "input_spec": [
+            {"shape": s.shape, "dtype": s.dtype} for s in (input_spec or [])
+        ],
+    }
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path + ".pdiparams", "wb") as f:
+        pickle.dump(state, f, protocol=4)
+    with open(path + ".pdmodel", "wb") as f:
+        pickle.dump(meta, f, protocol=4)
+
+
+class TranslatedLayer(Layer):
+    """Loaded inference layer (reference python/paddle/jit/translated_layer.py).
+    Holds the state dict; `forward` must be re-bound by the loading model, or
+    used through paddle_tpu.static predictors."""
+
+    def __init__(self, state, meta):
+        super().__init__()
+        self._loaded_state = state
+        self._meta = meta
+
+    def state_dict(self, *a, **k):
+        return self._loaded_state
+
+    def forward(self, *args):
+        raise RuntimeError(
+            "TranslatedLayer from jit.load holds weights only; bind it to a "
+            "model class or use paddle_tpu.static.Predictor")
+
+
+def load(path):
+    with open(path + ".pdiparams", "rb") as f:
+        state = pickle.load(f)
+    meta = {}
+    if os.path.exists(path + ".pdmodel"):
+        with open(path + ".pdmodel", "rb") as f:
+            meta = pickle.load(f)
+    return TranslatedLayer(state, meta)
+
+
+def not_to_static(fn):
+    fn._not_to_static = True
+    return fn
+
+
+def ignore_module(modules):
+    return None

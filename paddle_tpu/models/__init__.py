@@ -1,0 +1,5 @@
+"""Flagship model families (the reference ecosystem's ERNIE/GPT configs live
+in PaddleNLP; the framework repo carries the layers. We ship the model zoo
+in-tree so the distributed configs are testable)."""
+from .gpt import GPTModel  # noqa: F401
+from .llama import LlamaConfig, LlamaForCausalLM, LlamaModel  # noqa: F401

@@ -1,0 +1,80 @@
+"""GPT-style decoder (ERNIE/GPT configs; reference ecosystem models built on
+paddle.nn.TransformerDecoder). LayerNorm + learned positions + GELU MLP."""
+from __future__ import annotations
+
+from .. import ops
+from ..nn import functional as F
+from ..nn.layer import Layer
+from ..nn.layers.common import Dropout, Embedding, Linear
+from ..nn.layers.container import LayerList
+from ..nn.layers.norm import LayerNorm
+from ..parallel.mp_layers import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+
+
+class GPTBlock(Layer):
+    def __init__(self, hidden, heads, ffn, dropout=0.0, use_parallel=False):
+        super().__init__()
+        self.ln1 = LayerNorm(hidden)
+        self.ln2 = LayerNorm(hidden)
+        self.heads = heads
+        self.head_dim = hidden // heads
+        if use_parallel:
+            self.qkv = ColumnParallelLinear(hidden, 3 * hidden,
+                                            gather_output=False)
+            self.proj = RowParallelLinear(hidden, hidden,
+                                          input_is_parallel=True)
+            self.fc1 = ColumnParallelLinear(hidden, ffn, gather_output=False)
+            self.fc2 = RowParallelLinear(ffn, hidden, input_is_parallel=True)
+        else:
+            self.qkv = Linear(hidden, 3 * hidden)
+            self.proj = Linear(hidden, hidden)
+            self.fc1 = Linear(hidden, ffn)
+            self.fc2 = Linear(ffn, hidden)
+        self.drop = Dropout(dropout)
+
+    def forward(self, x):
+        b, s, hdim = x.shape
+        h = self.ln1(x)
+        qkv = self.qkv(h).reshape([b, s, 3, self.heads, self.head_dim])
+        q, k, v = ops.manipulation.unbind(qkv, axis=2)
+        attn = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        attn = attn.reshape([b, s, hdim])
+        x = x + self.drop(self.proj(attn))
+        h = self.ln2(x)
+        x = x + self.drop(self.fc2(F.gelu(self.fc1(h))))
+        return x
+
+
+class GPTModel(Layer):
+    def __init__(self, vocab_size=50304, hidden_size=768, num_layers=12,
+                 num_heads=12, ffn_size=None, max_seq_len=1024, dropout=0.0,
+                 use_parallel=False):
+        super().__init__()
+        ffn_size = ffn_size or 4 * hidden_size
+        Emb = VocabParallelEmbedding if use_parallel else Embedding
+        self.wte = Emb(vocab_size, hidden_size)
+        self.wpe = Embedding(max_seq_len, hidden_size)
+        self.blocks = LayerList([
+            GPTBlock(hidden_size, num_heads, ffn_size, dropout, use_parallel)
+            for _ in range(num_layers)])
+        self.ln_f = LayerNorm(hidden_size)
+        self.vocab_size = vocab_size
+
+    def forward(self, input_ids, labels=None):
+        import paddle_tpu as P
+
+        b, s = input_ids.shape
+        pos = P.arange(s, dtype="int64").unsqueeze(0)
+        x = self.wte(input_ids) + self.wpe(pos)
+        for blk in self.blocks:
+            x = blk(x)
+        x = self.ln_f(x)
+        logits = P.matmul(x, self.wte.weight, transpose_y=True)
+        if labels is not None:
+            return F.cross_entropy(
+                logits.reshape([-1, self.vocab_size]), labels.reshape([-1]))
+        return logits

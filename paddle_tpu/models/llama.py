@@ -1,0 +1,262 @@
+"""Llama-family decoder — the flagship distributed config (BASELINE.md:
+GPT/Llama-7B TP+PP hybrid, tokens/sec/chip).
+
+TPU-native design decisions:
+- weights bf16, RMSNorm/softmax statistics fp32 (MXU-native mixed precision)
+- attention via F.scaled_dot_product_attention → Pallas flash kernel on TPU
+- TP via Column/RowParallelLinear sharding specs ('mp' axis): q/k/v/gate/up
+  column-split, o/down row-split — the Megatron layout the reference builds
+  from c_split/c_concat ops (fleet/layers/mpu/mp_layers.py)
+- sequence axis carries a 'sep' sharding constraint for long-context
+  (ring attention in paddle_tpu/kernels/ring_attention.py)
+- the decode cache is functional (returned, not mutated) so the generation
+  loop jits into one XLA while-loop
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from .. import ops
+from ..core.dispatch import primitive
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer import Layer
+from ..nn.layers.common import Embedding
+from ..nn.layers.container import LayerList
+from ..nn.layers.norm import RMSNorm
+from ..parallel.mp_layers import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+    mark_sharding,
+)
+
+
+class LlamaConfig:
+    def __init__(self, vocab_size=32000, hidden_size=4096,
+                 intermediate_size=11008, num_hidden_layers=32,
+                 num_attention_heads=32, num_key_value_heads=None,
+                 max_position_embeddings=4096, rms_norm_eps=1e-6,
+                 rope_theta=10000.0, tie_word_embeddings=False,
+                 use_parallel=True, dtype="float32"):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.intermediate_size = intermediate_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.num_key_value_heads = num_key_value_heads or num_attention_heads
+        self.max_position_embeddings = max_position_embeddings
+        self.rms_norm_eps = rms_norm_eps
+        self.rope_theta = rope_theta
+        self.tie_word_embeddings = tie_word_embeddings
+        self.use_parallel = use_parallel
+        self.dtype = dtype
+
+    @classmethod
+    def tiny(cls, **kw):
+        d = dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+                 num_hidden_layers=2, num_attention_heads=4,
+                 max_position_embeddings=128)
+        d.update(kw)
+        return cls(**d)
+
+    @classmethod
+    def llama_7b(cls, **kw):
+        return cls(**kw)
+
+
+@primitive
+def rope_apply(q, k, theta, position_offset=0):
+    """Rotary position embedding, fused on q and k.
+    q,k: [B, S, H, D]."""
+    q = jnp.asarray(q)
+    k = jnp.asarray(k)
+    d = q.shape[-1]
+    seq = q.shape[1]
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    pos = jnp.arange(seq, dtype=jnp.float32) + position_offset
+    freqs = jnp.outer(pos, inv_freq)  # [S, D/2]
+    cos = jnp.cos(freqs)[None, :, None, :]
+    sin = jnp.sin(freqs)[None, :, None, :]
+
+    def rot(x):
+        xf = x.astype(jnp.float32)
+        x1, x2 = xf[..., ::2], xf[..., 1::2]
+        o1 = x1 * cos - x2 * sin
+        o2 = x2 * cos + x1 * sin
+        out = jnp.stack([o1, o2], axis=-1).reshape(x.shape)
+        return out.astype(x.dtype)
+
+    return rot(q), rot(k)
+
+
+class LlamaAttention(Layer):
+    def __init__(self, config):
+        super().__init__()
+        c = config
+        self.num_heads = c.num_attention_heads
+        self.num_kv_heads = c.num_key_value_heads
+        self.head_dim = c.hidden_size // c.num_attention_heads
+        self.rope_theta = c.rope_theta
+        Lin = ColumnParallelLinear if c.use_parallel else None
+        if c.use_parallel:
+            self.q_proj = ColumnParallelLinear(
+                c.hidden_size, self.num_heads * self.head_dim,
+                has_bias=False, gather_output=False)
+            self.k_proj = ColumnParallelLinear(
+                c.hidden_size, self.num_kv_heads * self.head_dim,
+                has_bias=False, gather_output=False)
+            self.v_proj = ColumnParallelLinear(
+                c.hidden_size, self.num_kv_heads * self.head_dim,
+                has_bias=False, gather_output=False)
+            self.o_proj = RowParallelLinear(
+                self.num_heads * self.head_dim, c.hidden_size,
+                has_bias=False, input_is_parallel=True)
+        else:
+            from ..nn.layers.common import Linear
+
+            self.q_proj = Linear(c.hidden_size,
+                                 self.num_heads * self.head_dim,
+                                 bias_attr=False)
+            self.k_proj = Linear(c.hidden_size,
+                                 self.num_kv_heads * self.head_dim,
+                                 bias_attr=False)
+            self.v_proj = Linear(c.hidden_size,
+                                 self.num_kv_heads * self.head_dim,
+                                 bias_attr=False)
+            self.o_proj = Linear(self.num_heads * self.head_dim,
+                                 c.hidden_size, bias_attr=False)
+
+    def forward(self, x, cache=None, position_offset=0):
+        b, s, _ = x.shape
+        q = self.q_proj(x).reshape([b, s, self.num_heads, self.head_dim])
+        k = self.k_proj(x).reshape([b, s, self.num_kv_heads, self.head_dim])
+        v = self.v_proj(x).reshape([b, s, self.num_kv_heads, self.head_dim])
+        q, k = rope_apply(q, k, theta=self.rope_theta,
+                          position_offset=position_offset)
+        if cache is not None:
+            pk, pv = cache
+            k = ops.manipulation.concat([pk, k], axis=1)
+            v = ops.manipulation.concat([pv, v], axis=1)
+            cache = (k, v)
+        if self.num_kv_heads != self.num_heads:
+            rep = self.num_heads // self.num_kv_heads
+            k = ops.manipulation.repeat_interleave(k, rep, axis=2)
+            v = ops.manipulation.repeat_interleave(v, rep, axis=2)
+        out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        out = out.reshape([b, s, self.num_heads * self.head_dim])
+        out = self.o_proj(out)
+        if cache is not None:
+            return out, cache
+        return out
+
+
+class LlamaMLP(Layer):
+    def __init__(self, config):
+        super().__init__()
+        c = config
+        if c.use_parallel:
+            self.gate_proj = ColumnParallelLinear(
+                c.hidden_size, c.intermediate_size, has_bias=False,
+                gather_output=False)
+            self.up_proj = ColumnParallelLinear(
+                c.hidden_size, c.intermediate_size, has_bias=False,
+                gather_output=False)
+            self.down_proj = RowParallelLinear(
+                c.intermediate_size, c.hidden_size, has_bias=False,
+                input_is_parallel=True)
+        else:
+            from ..nn.layers.common import Linear
+
+            self.gate_proj = Linear(c.hidden_size, c.intermediate_size,
+                                    bias_attr=False)
+            self.up_proj = Linear(c.hidden_size, c.intermediate_size,
+                                  bias_attr=False)
+            self.down_proj = Linear(c.intermediate_size, c.hidden_size,
+                                    bias_attr=False)
+
+    def forward(self, x):
+        return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
+
+
+class LlamaDecoderLayer(Layer):
+    def __init__(self, config):
+        super().__init__()
+        self.input_layernorm = RMSNorm(config.hidden_size,
+                                       config.rms_norm_eps)
+        self.self_attn = LlamaAttention(config)
+        self.post_attention_layernorm = RMSNorm(config.hidden_size,
+                                                config.rms_norm_eps)
+        self.mlp = LlamaMLP(config)
+
+    def forward(self, x, cache=None, position_offset=0):
+        h = self.input_layernorm(x)
+        if cache is not None:
+            attn, cache = self.self_attn(h, cache, position_offset)
+        else:
+            attn = self.self_attn(h)
+        x = x + attn
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        if cache is not None:
+            return x, cache
+        return x
+
+
+class LlamaModel(Layer):
+    def __init__(self, config):
+        super().__init__()
+        self.config = config
+        Emb = VocabParallelEmbedding if config.use_parallel else Embedding
+        self.embed_tokens = Emb(config.vocab_size, config.hidden_size)
+        self.layers = LayerList(
+            [LlamaDecoderLayer(config)
+             for _ in range(config.num_hidden_layers)])
+        self.norm = RMSNorm(config.hidden_size, config.rms_norm_eps)
+
+    def forward(self, input_ids, caches=None, position_offset=0):
+        x = self.embed_tokens(input_ids)
+        # dp on batch, sep on sequence when those axes exist
+        new_caches = []
+        for i, layer in enumerate(self.layers):
+            if caches is not None:
+                x, c = layer(x, caches[i], position_offset)
+                new_caches.append(c)
+            else:
+                x = layer(x)
+        x = self.norm(x)
+        if caches is not None:
+            return x, new_caches
+        return x
+
+
+class LlamaForCausalLM(Layer):
+    def __init__(self, config):
+        super().__init__()
+        self.config = config
+        self.llama = LlamaModel(config)
+        if config.use_parallel:
+            self.lm_head = ColumnParallelLinear(
+                config.hidden_size, config.vocab_size, has_bias=False)
+        else:
+            from ..nn.layers.common import Linear
+
+            self.lm_head = Linear(config.hidden_size, config.vocab_size,
+                                  bias_attr=False)
+
+    def forward(self, input_ids, labels=None):
+        h = self.llama(input_ids)
+        logits = self.lm_head(h)
+        if labels is not None:
+            loss = F.cross_entropy(
+                logits.reshape([-1, self.config.vocab_size]),
+                labels.reshape([-1]))
+            return loss
+        return logits
+
+    def generate_step(self, input_ids, caches, position_offset):
+        """Single decode step with functional cache."""
+        h, caches = self.llama(input_ids, caches, position_offset)
+        logits = self.lm_head(h)
+        return logits, caches

@@ -1,0 +1,116 @@
+"""paddle.nn.functional namespace."""
+from .activation import (  # noqa: F401
+    celu,
+    elu,
+    gelu,
+    glu,
+    gumbel_softmax,
+    hardshrink,
+    hardsigmoid,
+    hardswish,
+    hardtanh,
+    leaky_relu,
+    log_softmax,
+    maxout,
+    mish,
+    prelu,
+    relu,
+    relu6,
+    rrelu,
+    selu,
+    sigmoid,
+    silu,
+    softmax,
+    softplus,
+    softshrink,
+    softsign,
+    swish,
+    tanh,
+    tanhshrink,
+    thresholded_relu,
+)
+from .attention import (  # noqa: F401
+    scaled_dot_product_attention,
+    sparse_attention,
+)
+from .common import (  # noqa: F401
+    alpha_dropout,
+    bilinear,
+    cosine_similarity,
+    dropout,
+    dropout2d,
+    dropout3d,
+    embedding,
+    interpolate,
+    label_smooth,
+    linear,
+    normalize,
+    pixel_shuffle,
+    pixel_unshuffle,
+    upsample,
+)
+from .conv import (  # noqa: F401
+    conv1d,
+    conv1d_transpose,
+    conv2d,
+    conv2d_transpose,
+    conv3d,
+    conv3d_transpose,
+)
+from .loss import (  # noqa: F401
+    binary_cross_entropy,
+    binary_cross_entropy_with_logits,
+    cosine_embedding_loss,
+    cross_entropy,
+    ctc_loss_dense,
+    hinge_embedding_loss,
+    kl_div,
+    l1_loss,
+    log_loss,
+    margin_ranking_loss,
+    mse_loss,
+    nll_loss,
+    smooth_l1_loss,
+    softmax_with_cross_entropy,
+    square_error_cost,
+    triplet_margin_loss,
+)
+from .norm import (  # noqa: F401
+    batch_norm_infer,
+    batch_norm_train,
+    group_norm,
+    instance_norm,
+    layer_norm,
+    local_response_norm,
+    rms_norm,
+)
+from .pooling import (  # noqa: F401
+    adaptive_avg_pool1d,
+    adaptive_avg_pool2d,
+    adaptive_max_pool1d,
+    adaptive_max_pool2d,
+    avg_pool1d,
+    avg_pool2d,
+    avg_pool3d,
+    max_pool1d,
+    max_pool2d,
+    max_pool3d,
+)
+
+from ...ops.manipulation import one_hot, pad  # noqa: F401
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5, data_format="NCHW"):
+    """Stateful batch_norm facade; layers use the split train/infer kernels."""
+    if not training:
+        return batch_norm_infer(x, running_mean, running_var, weight, bias,
+                                epsilon=epsilon, data_format=data_format)
+    out, mean, var = batch_norm_train(x, weight, bias, epsilon=epsilon,
+                                      data_format=data_format)
+    # update running stats in-place on the provided tensors
+    running_mean.set_value(
+        running_mean._value * momentum + mean._value * (1.0 - momentum))
+    running_var.set_value(
+        running_var._value * momentum + var._value * (1.0 - momentum))
+    return out

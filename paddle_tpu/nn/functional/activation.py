@@ -1,0 +1,198 @@
+"""Activation functionals (reference python/paddle/nn/functional/activation.py,
+phi/kernels/activation_kernel). Pure jnp — XLA fuses these into neighbors."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import primitive
+
+_A = jnp.asarray
+
+
+@primitive
+def relu(x):
+    return jax.nn.relu(_A(x))
+
+
+@primitive
+def relu6(x):
+    return jnp.clip(_A(x), 0.0, 6.0)
+
+
+@primitive
+def gelu(x, approximate=False):
+    return jax.nn.gelu(_A(x), approximate=approximate)
+
+
+@primitive
+def sigmoid(x):
+    return jax.nn.sigmoid(_A(x))
+
+
+@primitive
+def tanh(x):
+    return jnp.tanh(_A(x))
+
+
+@primitive
+def silu(x):
+    return jax.nn.silu(_A(x))
+
+
+def swish(x):
+    return silu(x)
+
+
+@primitive
+def mish(x):
+    x = _A(x)
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+@primitive
+def elu(x, alpha=1.0):
+    return jax.nn.elu(_A(x), alpha=alpha)
+
+
+@primitive
+def selu(
+    x,
+    scale=1.0507009873554804934193349852946,
+    alpha=1.6732632423543772848170429916717,
+):
+    x = _A(x)
+    return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+@primitive
+def celu(x, alpha=1.0):
+    return jax.nn.celu(_A(x), alpha=alpha)
+
+
+@primitive
+def leaky_relu(x, negative_slope=0.01):
+    return jax.nn.leaky_relu(_A(x), negative_slope=negative_slope)
+
+
+@primitive
+def prelu(x, weight, data_format="NCHW"):
+    x, w = _A(x), _A(weight)
+    if w.size > 1 and x.ndim > 1:
+        shape = [1] * x.ndim
+        ch_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+        shape[ch_axis] = w.size
+        w = w.reshape(shape)
+    return jnp.where(x > 0, x, w * x)
+
+
+@primitive
+def rrelu(x, lower=0.125, upper=0.3333333333333333, training=False):
+    x = _A(x)
+    slope = (lower + upper) / 2.0
+    return jnp.where(x >= 0, x, slope * x)
+
+
+@primitive
+def hardtanh(x, min=-1.0, max=1.0):
+    return jnp.clip(_A(x), min, max)
+
+
+@primitive
+def hardsigmoid(x, slope=0.1666667, offset=0.5):
+    return jnp.clip(_A(x) * slope + offset, 0.0, 1.0)
+
+
+@primitive
+def hardswish(x):
+    x = _A(x)
+    return x * jnp.clip(x + 3.0, 0.0, 6.0) / 6.0
+
+
+@primitive
+def hardshrink(x, threshold=0.5):
+    x = _A(x)
+    return jnp.where(jnp.abs(x) > threshold, x, 0.0)
+
+
+@primitive
+def softshrink(x, threshold=0.5):
+    x = _A(x)
+    return jnp.where(
+        x > threshold, x - threshold, jnp.where(x < -threshold, x + threshold, 0.0)
+    )
+
+
+@primitive
+def tanhshrink(x):
+    x = _A(x)
+    return x - jnp.tanh(x)
+
+
+@primitive
+def softplus(x, beta=1.0, threshold=20.0):
+    x = _A(x)
+    return jnp.where(
+        x * beta > threshold, x, jax.nn.softplus(x * beta) / beta
+    )
+
+
+@primitive
+def softsign(x):
+    return jax.nn.soft_sign(_A(x))
+
+
+@primitive
+def softmax(x, axis=-1, dtype=None):
+    from ...core import dtype as _dt
+
+    x = _A(x)
+    if dtype is not None:
+        x = x.astype(_dt.to_jax(dtype))
+    return jax.nn.softmax(x, axis=int(axis))
+
+
+@primitive
+def log_softmax(x, axis=-1, dtype=None):
+    from ...core import dtype as _dt
+
+    x = _A(x)
+    if dtype is not None:
+        x = x.astype(_dt.to_jax(dtype))
+    return jax.nn.log_softmax(x, axis=int(axis))
+
+
+@primitive
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1):
+    from ...framework import random as _random
+
+    x = _A(x)
+    g = jax.random.gumbel(_random.next_key(), x.shape, x.dtype)
+    y = jax.nn.softmax((x + g) / temperature, axis=axis)
+    if hard:
+        idx = jnp.argmax(y, axis=axis)
+        oh = jax.nn.one_hot(idx, y.shape[axis], axis=axis, dtype=y.dtype)
+        y = oh + y - jax.lax.stop_gradient(y)  # straight-through estimator
+    return y
+
+
+@primitive
+def maxout(x, groups, axis=1):
+    x = _A(x)
+    axis = axis % x.ndim
+    c = x.shape[axis]
+    shape = list(x.shape)
+    shape[axis] = c // groups
+    shape.insert(axis + 1, groups)
+    return jnp.max(x.reshape(shape), axis=axis + 1)
+
+
+@primitive
+def glu(x, axis=-1):
+    return jax.nn.glu(_A(x), axis=axis)
+
+
+@primitive
+def thresholded_relu(x, threshold=1.0):
+    x = _A(x)
+    return jnp.where(x > threshold, x, 0.0)

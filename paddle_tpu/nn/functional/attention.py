@@ -1,0 +1,70 @@
+"""Attention functionals.
+
+The reference ships fused CUDA attention (operators/fused/fused_attention_op)
+and sparse attention; here the TPU path is a Pallas flash-attention kernel
+(paddle_tpu/kernels/flash_attention.py) with a pure-XLA fallback that still
+fuses well. Long-context ring attention lives in paddle_tpu/parallel.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import primitive
+
+_A = jnp.asarray
+
+
+def _sdpa_reference(q, k, v, mask=None, dropout_p=0.0, causal=False, scale=None):
+    # q,k,v: [B, N, H, D] (paddle convention: batch, seq, heads, head_dim)
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    logits = jnp.einsum("bnhd,bmhd->bhnm", qf, kf) * scale
+    if causal:
+        n, m = logits.shape[-2], logits.shape[-1]
+        cm = jnp.tril(jnp.ones((n, m), bool), k=m - n)
+        logits = jnp.where(cm, logits, -1e30)
+    if mask is not None:
+        mask = _A(mask)
+        if mask.dtype == jnp.bool_:
+            logits = jnp.where(mask, logits, -1e30)
+        else:
+            logits = logits + mask.astype(logits.dtype)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhnm,bmhd->bnhd", probs.astype(v.dtype), v)
+    return out
+
+
+@primitive
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False, scale=None,
+                                 training=True):
+    q, k, v = _A(query), _A(key), _A(value)
+    use_flash = (
+        jax.default_backend() == "tpu"
+        and attn_mask is None
+        and dropout_p == 0.0
+        and q.shape[-1] % 128 == 0
+        and q.shape[1] % 128 == 0
+        and k.shape[1] % 128 == 0
+    )
+    if use_flash:
+        try:
+            from ...kernels.flash_attention import flash_attention as _fa
+
+            return _fa(q, k, v, causal=is_causal, scale=scale)
+        except Exception:
+            pass
+    return _sdpa_reference(q, k, v, mask=attn_mask, dropout_p=dropout_p,
+                           causal=is_causal, scale=scale)
+
+
+@primitive
+def sparse_attention(query, key, value, sparse_csr_offset=None,
+                     sparse_csr_columns=None, attn_mask=None):
+    # Block-sparse attention degenerates to dense + mask on TPU; the Pallas
+    # ragged kernel (kernels/) covers the serving path.
+    q, k, v = _A(query), _A(key), _A(value)
+    return _sdpa_reference(q, k, v, mask=attn_mask)

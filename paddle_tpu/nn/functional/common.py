@@ -1,0 +1,233 @@
+"""Common functionals: linear, embedding, dropout, normalize, interpolate...
+(reference python/paddle/nn/functional/{common,input,vision}.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import primitive
+from ...core.tensor import Tensor
+from ...framework import random as _random
+
+_A = jnp.asarray
+
+
+@primitive
+def linear(x, weight, bias=None):
+    # paddle stores weight as [in_features, out_features]
+    out = jnp.matmul(_A(x), _A(weight))
+    if bias is not None:
+        out = out + _A(bias)
+    return out
+
+
+@primitive
+def embedding(x, weight, padding_idx=None, sparse=False):
+    # gathers rows of weight; on TPU this lowers to a dynamic-gather that XLA
+    # vectorizes — the analog of phi/kernels/embedding_kernel (lookup_table_v2)
+    x = _A(x).astype(jnp.int32)
+    w = _A(weight)
+    out = jnp.take(w, x, axis=0)
+    if padding_idx is not None:
+        if padding_idx < 0:
+            padding_idx = w.shape[0] + padding_idx
+        mask = (x == padding_idx)[..., None]
+        out = jnp.where(mask, jnp.zeros_like(out), out)
+    return out
+
+
+@primitive
+def dropout(x, p=0.5, training=True, mode="upscale_in_train", seed=None):
+    x = _A(x)
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return x * (1.0 - p)
+        return x
+    key = jax.random.key(seed) if seed is not None else _random.next_key()
+    keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
+    if mode == "upscale_in_train":
+        return jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype)
+    return jnp.where(keep, x, 0.0).astype(x.dtype)
+
+
+@primitive
+def dropout2d(x, p=0.5, training=True, data_format="NCHW"):
+    x = _A(x)
+    if not training or p == 0.0:
+        return x
+    shape = list(x.shape)
+    if data_format == "NCHW":
+        shape[2] = shape[3] = 1
+    else:
+        shape[1] = shape[2] = 1
+    keep = jax.random.bernoulli(_random.next_key(), 1.0 - p, tuple(shape))
+    return jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW"):
+    return _dropout3d(x, p=p, training=training, data_format=data_format)
+
+
+@primitive(name="dropout3d")
+def _dropout3d(x, p=0.5, training=True, data_format="NCDHW"):
+    x = _A(x)
+    if not training or p == 0.0:
+        return x
+    shape = list(x.shape)
+    if data_format == "NCDHW":
+        shape[2] = shape[3] = shape[4] = 1
+    else:
+        shape[1] = shape[2] = shape[3] = 1
+    keep = jax.random.bernoulli(_random.next_key(), 1.0 - p, tuple(shape))
+    return jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype)
+
+
+@primitive
+def alpha_dropout(x, p=0.5, training=True):
+    x = _A(x)
+    if not training or p == 0.0:
+        return x
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    keep = jax.random.bernoulli(_random.next_key(), 1.0 - p, x.shape)
+    a = (1.0 / ((1.0 - p) * (1.0 + p * alpha_p**2)) ** 0.5)
+    b = -a * alpha_p * p
+    return (a * jnp.where(keep, x, alpha_p) + b).astype(x.dtype)
+
+
+@primitive
+def normalize(x, p=2.0, axis=1, epsilon=1e-12):
+    x = _A(x)
+    norm = jnp.sum(jnp.abs(x) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+    return x / jnp.maximum(norm, epsilon)
+
+
+@primitive
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    x1, x2 = _A(x1), _A(x2)
+    dot = jnp.sum(x1 * x2, axis=axis)
+    n1 = jnp.linalg.norm(x1, axis=axis)
+    n2 = jnp.linalg.norm(x2, axis=axis)
+    return dot / jnp.maximum(n1 * n2, eps)
+
+
+@primitive
+def label_smooth(label, prior_dist=None, epsilon=0.1):
+    label = _A(label)
+    k = label.shape[-1]
+    if prior_dist is not None:
+        return (1.0 - epsilon) * label + epsilon * _A(prior_dist)
+    return (1.0 - epsilon) * label + epsilon / k
+
+
+@primitive
+def interpolate(
+    x,
+    size=None,
+    scale_factor=None,
+    mode="nearest",
+    align_corners=False,
+    data_format="NCHW",
+):
+    """Image resize (reference phi/kernels/interpolate_kernel). Uses
+    jax.image.resize; align_corners handled for (bi)linear."""
+    x = _A(x)
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    spatial_ndim = x.ndim - 2
+    if channel_last:
+        spatial = x.shape[1:-1]
+    else:
+        spatial = x.shape[2:]
+    if size is None:
+        if isinstance(scale_factor, (int, float)):
+            scale_factor = [scale_factor] * spatial_ndim
+        size = [int(s * f) for s, f in zip(spatial, scale_factor)]
+    size = [int(s) for s in (size if isinstance(size, (list, tuple)) else [size])]
+    if channel_last:
+        out_shape = (x.shape[0], *size, x.shape[-1])
+    else:
+        out_shape = (x.shape[0], x.shape[1], *size)
+    method = {
+        "nearest": "nearest",
+        "bilinear": "linear",
+        "linear": "linear",
+        "trilinear": "linear",
+        "bicubic": "cubic",
+        "area": "linear",
+    }[mode]
+    if align_corners and method != "nearest":
+        # jax.image.resize implements half-pixel centers; emulate
+        # align_corners with an explicit coordinate map via lax.gather-free
+        # linear interpolation.
+        return _resize_align_corners(x, out_shape, channel_last)
+    return jax.image.resize(x, out_shape, method=method).astype(x.dtype)
+
+
+def _resize_align_corners(x, out_shape, channel_last):
+    sp_slice = slice(1, -1) if channel_last else slice(2, None)
+    in_sp = x.shape[sp_slice]
+    out_sp = out_shape[sp_slice]
+    out = x
+    for i, (ins, outs) in enumerate(zip(in_sp, out_sp)):
+        axis = (1 + i) if channel_last else (2 + i)
+        if ins == outs:
+            continue
+        if outs == 1 or ins == 1:
+            idx = jnp.zeros((outs,), jnp.int32)
+            out = jnp.take(out, idx, axis=axis)
+            continue
+        pos = jnp.arange(outs) * (ins - 1) / (outs - 1)
+        lo = jnp.floor(pos).astype(jnp.int32)
+        hi = jnp.minimum(lo + 1, ins - 1)
+        w = (pos - lo).astype(out.dtype)
+        shape = [1] * out.ndim
+        shape[axis] = outs
+        w = w.reshape(shape)
+        out = jnp.take(out, lo, axis=axis) * (1 - w) + jnp.take(out, hi, axis=axis) * w
+    return out
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+             data_format="NCHW"):
+    return interpolate(x, size=size, scale_factor=scale_factor, mode=mode,
+                       align_corners=align_corners, data_format=data_format)
+
+
+@primitive
+def pixel_shuffle(x, upscale_factor, data_format="NCHW"):
+    x = _A(x)
+    r = int(upscale_factor)
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        x = x.reshape(n, c // (r * r), r, r, h, w)
+        x = jnp.transpose(x, (0, 1, 4, 2, 5, 3))
+        return x.reshape(n, c // (r * r), h * r, w * r)
+    n, h, w, c = x.shape
+    x = x.reshape(n, h, w, r, r, c // (r * r))
+    x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
+    return x.reshape(n, h * r, w * r, c // (r * r))
+
+
+@primitive
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW"):
+    x = _A(x)
+    r = int(downscale_factor)
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        x = x.reshape(n, c, h // r, r, w // r, r)
+        x = jnp.transpose(x, (0, 1, 3, 5, 2, 4))
+        return x.reshape(n, c * r * r, h // r, w // r)
+    n, h, w, c = x.shape
+    x = x.reshape(n, h // r, r, w // r, r, c)
+    x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
+    return x.reshape(n, h // r, w // r, c * r * r)
+
+
+@primitive
+def bilinear(x1, x2, weight, bias=None):
+    # out[b, o] = x1[b, i] W[o, i, j] x2[b, j]  (reference bilinear_tensor_product)
+    out = jnp.einsum("bi,oij,bj->bo", _A(x1), _A(weight), _A(x2))
+    if bias is not None:
+        out = out + _A(bias)
+    return out

@@ -1,0 +1,149 @@
+"""Convolutions (reference python/paddle/nn/functional/conv.py,
+phi/kernels/gpu/conv_kernel.cu → cudnn). On TPU, XLA lowers
+lax.conv_general_dilated straight onto the MXU; NCHW in, weights OIHW —
+XLA's layout assignment picks the fast internal layout, so no cudnn-style
+algo search is needed."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import primitive
+
+_A = jnp.asarray
+
+
+def _norm_tuple(v, n):
+    if isinstance(v, (int, float)):
+        return (int(v),) * n
+    v = tuple(int(i) for i in v)
+    if len(v) == 1:
+        return v * n
+    return v
+
+
+def _norm_padding(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()  # SAME / VALID
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * n:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(n)]
+    return [tuple(p) for p in padding]
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, n, channel_last):
+    x, w = _A(x), _A(weight)
+    stride = _norm_tuple(stride, n)
+    dilation = _norm_tuple(dilation, n)
+    padding = _norm_padding(padding, n)
+    sp = "DHW"[-n:] if n > 1 else "W"
+    if channel_last:
+        lhs_spec = "N" + sp + "C"
+    else:
+        lhs_spec = "NC" + sp
+    dn = jax.lax.conv_dimension_numbers(
+        x.shape, w.shape, (lhs_spec, "OI" + sp, lhs_spec)
+    )
+    out = jax.lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=stride,
+        padding=padding,
+        rhs_dilation=dilation,
+        dimension_numbers=dn,
+        feature_group_count=groups,
+    )
+    if bias is not None:
+        b = _A(bias)
+        shape = [1] * out.ndim
+        shape[1 if not channel_last else -1] = b.size
+        out = out + b.reshape(shape)
+    return out
+
+
+@primitive
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL"):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1,
+                 channel_last=data_format == "NLC")
+
+
+@primitive
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW"):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2,
+                 channel_last=data_format == "NHWC")
+
+
+@primitive
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW"):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3,
+                 channel_last=data_format == "NDHWC")
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                    groups, n, channel_last):
+    x, w = _A(x), _A(weight)
+    stride = _norm_tuple(stride, n)
+    dilation = _norm_tuple(dilation, n)
+    output_padding = _norm_tuple(output_padding, n)
+    sp = "DHW"[-n:] if n > 1 else "W"
+    lhs_spec = ("N" + sp + "C") if channel_last else ("NC" + sp)
+    # paddle conv_transpose weight layout: [in_channels, out_channels//groups, *k]
+    dn = jax.lax.conv_dimension_numbers(
+        x.shape, w.shape, (lhs_spec, "IO" + sp, lhs_spec)
+    )
+    if isinstance(padding, str):
+        pad_cfg = padding.upper()
+    else:
+        pads = _norm_padding(padding, n)
+        # transposed conv: effective padding = k_eff - 1 - pad
+        ksp = w.shape[2:]
+        pad_cfg = []
+        for i in range(n):
+            k_eff = (ksp[i] - 1) * dilation[i] + 1
+            lo = k_eff - 1 - pads[i][0]
+            hi = k_eff - 1 - pads[i][1] + output_padding[i]
+            pad_cfg.append((lo, hi))
+    out = jax.lax.conv_general_dilated(
+        x,
+        jnp.flip(w, axis=tuple(range(2, 2 + n))),
+        window_strides=(1,) * n,
+        padding=pad_cfg,
+        lhs_dilation=stride,
+        rhs_dilation=dilation,
+        dimension_numbers=dn,
+        feature_group_count=groups,
+    )
+    if bias is not None:
+        b = _A(bias)
+        shape = [1] * out.ndim
+        shape[1 if not channel_last else -1] = b.size
+        out = out + b.reshape(shape)
+    return out
+
+
+@primitive
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1, data_format="NCL"):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 1, data_format == "NLC")
+
+
+@primitive
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1, data_format="NCHW"):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 2, data_format == "NHWC")
+
+
+@primitive
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1, data_format="NCDHW"):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 3, data_format == "NDHWC")

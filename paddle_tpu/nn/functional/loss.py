@@ -1,0 +1,257 @@
+"""Loss functionals (reference python/paddle/nn/functional/loss.py,
+phi/kernels/*cross_entropy*). Softmax+CE fused in one expression so XLA emits
+a single stable fused kernel — the analog of the reference's fused
+softmax_with_cross_entropy op."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import primitive
+
+_A = jnp.asarray
+
+
+def _reduce(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+@primitive
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0):
+    x = _A(input)
+    lbl = _A(label)
+    logp = jax.nn.log_softmax(x, axis=axis) if use_softmax else jnp.log(
+        jnp.maximum(x, 1e-30))
+    n_cls = x.shape[axis]
+    if soft_label:
+        soft = _A(lbl).astype(logp.dtype)
+        loss = -jnp.sum(soft * logp, axis=axis)
+        valid = jnp.ones_like(loss, dtype=bool)
+    else:
+        li = lbl.astype(jnp.int32)
+        if li.ndim == x.ndim and li.shape[axis] == 1:
+            li = jnp.squeeze(li, axis=axis)
+        if label_smoothing > 0.0:
+            oh = jax.nn.one_hot(li, n_cls, axis=axis, dtype=logp.dtype)
+            soft = oh * (1.0 - label_smoothing) + label_smoothing / n_cls
+            loss = -jnp.sum(soft * logp, axis=axis)
+        else:
+            picked = jnp.take_along_axis(
+                logp, jnp.expand_dims(jnp.clip(li, 0, n_cls - 1), axis), axis=axis
+            )
+            loss = -jnp.squeeze(picked, axis=axis)
+        valid = li != ignore_index
+        loss = jnp.where(valid, loss, 0.0)
+        if weight is not None:
+            w = jnp.take(_A(weight), jnp.clip(li, 0, n_cls - 1))
+            loss = loss * jnp.where(valid, w, 0.0)
+            if reduction == "mean":
+                denom = jnp.sum(jnp.where(valid, w, 0.0))
+                return jnp.sum(loss) / jnp.maximum(denom, 1e-12)
+    if reduction == "mean" and not soft_label:
+        denom = jnp.sum(valid.astype(loss.dtype))
+        return jnp.sum(loss) / jnp.maximum(denom, 1.0)
+    return _reduce(loss, reduction)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, axis=-1,
+                               ignore_index=-100, return_softmax=False):
+    loss = cross_entropy(logits, label, soft_label=soft_label, axis=axis,
+                         ignore_index=ignore_index, reduction="none")
+    from .activation import softmax as _softmax
+
+    loss = loss.unsqueeze(axis) if hasattr(loss, "unsqueeze") else loss
+    if return_softmax:
+        return loss, _softmax(logits, axis=axis)
+    return loss
+
+
+@primitive
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean"):
+    logp = _A(input)
+    li = _A(label).astype(jnp.int32)
+    n_cls = logp.shape[-1] if logp.ndim == 1 else logp.shape[1]
+    if logp.ndim > 2:
+        # [N,C,d1..] -> [N,d1..,C]
+        logp = jnp.moveaxis(logp, 1, -1)
+    picked = jnp.take_along_axis(
+        logp, jnp.expand_dims(jnp.clip(li, 0, n_cls - 1), -1), axis=-1
+    )
+    loss = -jnp.squeeze(picked, -1)
+    valid = li != ignore_index
+    loss = jnp.where(valid, loss, 0.0)
+    if weight is not None:
+        w = jnp.take(_A(weight), jnp.clip(li, 0, n_cls - 1))
+        loss = loss * jnp.where(valid, w, 0.0)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(jnp.where(valid, w, 0.0)), 1e-12)
+    if reduction == "mean":
+        return jnp.sum(loss) / jnp.maximum(jnp.sum(valid.astype(loss.dtype)), 1.0)
+    return _reduce(loss, reduction)
+
+
+@primitive
+def mse_loss(input, label, reduction="mean"):
+    return _reduce(jnp.square(_A(input) - _A(label)), reduction)
+
+
+@primitive
+def l1_loss(input, label, reduction="mean"):
+    return _reduce(jnp.abs(_A(input) - _A(label)), reduction)
+
+
+@primitive
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0):
+    d = _A(input) - _A(label)
+    ad = jnp.abs(d)
+    loss = jnp.where(ad < delta, 0.5 * d * d / delta, ad - 0.5 * delta)
+    return _reduce(loss, reduction)
+
+
+@primitive
+def binary_cross_entropy(input, label, weight=None, reduction="mean"):
+    p = jnp.clip(_A(input), 1e-12, 1.0 - 1e-12)
+    y = _A(label)
+    loss = -(y * jnp.log(p) + (1.0 - y) * jnp.log(1.0 - p))
+    if weight is not None:
+        loss = loss * _A(weight)
+    return _reduce(loss, reduction)
+
+
+@primitive
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None):
+    x = _A(logit)
+    y = _A(label)
+    # numerically stable: max(x,0) - x*y + log(1+exp(-|x|))
+    loss = jnp.maximum(x, 0) - x * y + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    if pos_weight is not None:
+        pw = _A(pos_weight)
+        log_sig = jax.nn.log_sigmoid(x)
+        log_sig_neg = jax.nn.log_sigmoid(-x)
+        loss = -(pw * y * log_sig + (1.0 - y) * log_sig_neg)
+    if weight is not None:
+        loss = loss * _A(weight)
+    return _reduce(loss, reduction)
+
+
+@primitive
+def kl_div(input, label, reduction="mean"):
+    logp = _A(input)
+    y = _A(label)
+    loss = y * (jnp.log(jnp.maximum(y, 1e-30)) - logp)
+    if reduction == "batchmean":
+        return jnp.sum(loss) / logp.shape[0]
+    return _reduce(loss, reduction)
+
+
+@primitive
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean"):
+    x = _A(input)
+    y = _A(label)
+    loss = jnp.where(y == 1.0, x, jnp.maximum(0.0, margin - x))
+    return _reduce(loss, reduction)
+
+
+@primitive
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean"):
+    loss = jnp.maximum(0.0, -_A(label) * (_A(input) - _A(other)) + margin)
+    return _reduce(loss, reduction)
+
+
+@primitive
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean"):
+    x1, x2 = _A(input1), _A(input2)
+    y = _A(label)
+    cos = jnp.sum(x1 * x2, -1) / jnp.maximum(
+        jnp.linalg.norm(x1, axis=-1) * jnp.linalg.norm(x2, axis=-1), 1e-12)
+    loss = jnp.where(y == 1, 1.0 - cos, jnp.maximum(0.0, cos - margin))
+    return _reduce(loss, reduction)
+
+
+@primitive
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean"):
+    a, pos, neg = _A(input), _A(positive), _A(negative)
+
+    def dist(u, v):
+        return jnp.sum(jnp.abs(u - v + epsilon) ** p, axis=-1) ** (1.0 / p)
+
+    d_ap = dist(a, pos)
+    d_an = dist(a, neg)
+    if swap:
+        d_pn = dist(pos, neg)
+        d_an = jnp.minimum(d_an, d_pn)
+    loss = jnp.maximum(0.0, d_ap - d_an + margin)
+    return _reduce(loss, reduction)
+
+
+@primitive
+def log_loss(input, label, epsilon=1e-4):
+    p = _A(input)
+    y = _A(label)
+    return -y * jnp.log(p + epsilon) - (1.0 - y) * jnp.log(1.0 - p + epsilon)
+
+
+@primitive
+def square_error_cost(input, label):
+    return jnp.square(_A(input) - _A(label))
+
+
+@primitive
+def ctc_loss_dense(log_probs, labels, input_lengths, label_lengths, blank=0,
+                   reduction="mean"):
+    """CTC via the standard alpha recursion in log space using lax.scan
+    (reference warpctc op); log_probs [T,N,C], labels [N,S]."""
+    lp = _A(log_probs)
+    lbl = _A(labels).astype(jnp.int32)
+    T, N, C = lp.shape
+    S = lbl.shape[1]
+    # extended label seq: blank, l1, blank, l2, ... blank  (len 2S+1)
+    ext = jnp.full((N, 2 * S + 1), blank, dtype=jnp.int32)
+    ext = ext.at[:, 1::2].set(lbl)
+    ext_len = 2 * _A(label_lengths).astype(jnp.int32) + 1
+    neg_inf = jnp.asarray(-1e30, lp.dtype)
+
+    init = jnp.full((N, 2 * S + 1), neg_inf)
+    init = init.at[:, 0].set(lp[0, jnp.arange(N), blank])
+    init = init.at[:, 1].set(
+        jnp.where(S > 0, lp[0, jnp.arange(N), ext[:, 1]], neg_inf))
+
+    same_as_prev2 = jnp.concatenate(
+        [jnp.ones((N, 2), bool), ext[:, 2:] == ext[:, :-2]], axis=1)
+
+    def step(alpha, lp_t):
+        a0 = alpha
+        a1 = jnp.concatenate([jnp.full((N, 1), neg_inf), alpha[:, :-1]], 1)
+        a2 = jnp.concatenate([jnp.full((N, 2), neg_inf), alpha[:, :-2]], 1)
+        a2 = jnp.where(same_as_prev2, neg_inf, a2)
+        m = jnp.maximum(jnp.maximum(a0, a1), a2)
+        sum_ = jnp.where(
+            m <= neg_inf / 2, neg_inf,
+            m + jnp.log(jnp.exp(a0 - m) + jnp.exp(a1 - m) + jnp.exp(a2 - m)))
+        emit = jnp.take_along_axis(lp_t, ext, axis=1)
+        new_alpha = sum_ + emit
+        return new_alpha, new_alpha
+
+    _, alphas_rest = jax.lax.scan(step, init, lp[1:])
+    # alphas[t, n, s] for t = 0..T-1; each sample reads its own final frame
+    alphas = jnp.concatenate([init[None], alphas_rest], axis=0)
+    t_last = jnp.clip(_A(input_lengths).astype(jnp.int32) - 1, 0, T - 1)
+    alpha = alphas[t_last, jnp.arange(N)]  # [N, 2S+1]
+    idx_last = ext_len - 1
+    ll_blank = jnp.take_along_axis(alpha, idx_last[:, None], 1)[:, 0]
+    ll_label = jnp.take_along_axis(
+        alpha, jnp.maximum(idx_last - 1, 0)[:, None], 1)[:, 0]
+    m = jnp.maximum(ll_blank, ll_label)
+    ll = m + jnp.log(jnp.exp(ll_blank - m) + jnp.exp(ll_label - m))
+    loss = -ll
+    if reduction == "mean":
+        return jnp.mean(loss / jnp.maximum(_A(label_lengths), 1))
+    return _reduce(loss, reduction)

@@ -1,0 +1,143 @@
+"""Normalization functionals (reference python/paddle/nn/functional/norm.py,
+phi/kernels/{batch_norm,layer_norm,group_norm}_kernel). Stateless math here;
+running-stat bookkeeping lives in the Layer classes."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.dispatch import primitive
+
+_A = jnp.asarray
+
+
+@primitive
+def batch_norm_infer(x, running_mean, running_var, weight=None, bias=None,
+                     epsilon=1e-5, data_format="NCHW"):
+    x = _A(x)
+    ch_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    shape = [1] * x.ndim
+    shape[ch_axis] = -1
+    mean = _A(running_mean).reshape(shape)
+    var = _A(running_var).reshape(shape)
+    out = (x - mean) / jnp.sqrt(var + epsilon)
+    if weight is not None:
+        out = out * _A(weight).reshape(shape)
+    if bias is not None:
+        out = out + _A(bias).reshape(shape)
+    return out
+
+
+@primitive
+def batch_norm_train(x, weight=None, bias=None, epsilon=1e-5,
+                     data_format="NCHW"):
+    """Returns (out, batch_mean, batch_var). Caller updates running stats."""
+    x = _A(x)
+    ch_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+    mean = jnp.mean(x, axis=axes)
+    var = jnp.var(x, axis=axes)
+    shape = [1] * x.ndim
+    shape[ch_axis] = -1
+    out = (x - mean.reshape(shape)) / jnp.sqrt(var.reshape(shape) + epsilon)
+    if weight is not None:
+        out = out * _A(weight).reshape(shape)
+    if bias is not None:
+        out = out + _A(bias).reshape(shape)
+    return out, mean, var
+
+
+@primitive
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5):
+    x = _A(x)
+    if isinstance(normalized_shape, int):
+        normalized_shape = (normalized_shape,)
+    n = len(tuple(normalized_shape))
+    axes = tuple(range(x.ndim - n, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    out = (x - mean) / jnp.sqrt(var + epsilon)
+    if weight is not None:
+        out = out * _A(weight)
+    if bias is not None:
+        out = out + _A(bias)
+    return out
+
+
+@primitive
+def rms_norm(x, weight=None, epsilon=1e-6):
+    """RMSNorm — not in the reference snapshot but required by the Llama
+    family; computed in float32 for bf16 inputs (TPU numerics practice)."""
+    x = _A(x)
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    ms = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    out = xf * jnp.reciprocal(jnp.sqrt(ms + epsilon))
+    out = out.astype(dtype)
+    if weight is not None:
+        out = out * _A(weight)
+    return out
+
+
+@primitive
+def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-5,
+               data_format="NCHW"):
+    x = _A(x)
+    channel_last = not data_format.startswith("NC")
+    if channel_last:
+        x_ = jnp.moveaxis(x, -1, 1)
+    else:
+        x_ = x
+    n, c = x_.shape[:2]
+    g = int(num_groups)
+    xg = x_.reshape(n, g, c // g, *x_.shape[2:])
+    axes = tuple(range(2, xg.ndim))
+    mean = jnp.mean(xg, axis=axes, keepdims=True)
+    var = jnp.var(xg, axis=axes, keepdims=True)
+    out = ((xg - mean) / jnp.sqrt(var + epsilon)).reshape(x_.shape)
+    shape = [1, c] + [1] * (x_.ndim - 2)
+    if weight is not None:
+        out = out * _A(weight).reshape(shape)
+    if bias is not None:
+        out = out + _A(bias).reshape(shape)
+    if channel_last:
+        out = jnp.moveaxis(out, 1, -1)
+    return out
+
+
+@primitive
+def instance_norm(x, weight=None, bias=None, epsilon=1e-5, data_format="NCHW"):
+    x = _A(x)
+    ch_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    axes = tuple(i for i in range(2, x.ndim)) if ch_axis == 1 else tuple(
+        range(1, x.ndim - 1))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    out = (x - mean) / jnp.sqrt(var + epsilon)
+    if weight is not None:
+        shape = [1] * x.ndim
+        shape[ch_axis] = -1
+        out = out * _A(weight).reshape(shape)
+    if bias is not None:
+        shape = [1] * x.ndim
+        shape[ch_axis] = -1
+        out = out + _A(bias).reshape(shape)
+    return out
+
+
+@primitive
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW"):
+    import jax
+
+    x = _A(x)
+    ch_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    sq = jnp.square(x)
+    half = size // 2
+    pad = [(0, 0)] * x.ndim
+    pad[ch_axis] = (half, size - half - 1)
+    sq = jnp.pad(sq, pad)
+    dims = [1] * x.ndim
+    dims[ch_axis] = size
+    s = jax.lax.reduce_window(sq, 0.0, jax.lax.add, tuple(dims), (1,) * x.ndim,
+                              "VALID")
+    return x / jnp.power(k + alpha * s, beta)

@@ -1,0 +1,191 @@
+"""Pooling (reference python/paddle/nn/functional/pooling.py,
+phi/kernels/pool_kernel). lax.reduce_window lowers to the TPU vector unit."""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import primitive
+
+_A = jnp.asarray
+
+
+def _norm(v, n):
+    if isinstance(v, (int, float)):
+        return (int(v),) * n
+    v = tuple(int(i) for i in v)
+    return v * n if len(v) == 1 else v
+
+
+def _pads(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n:
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * n:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(n)]
+    return [tuple(p) for p in padding]
+
+
+def _pool(x, kernel, stride, padding, n, reducer, init, channel_last):
+    x = _A(x)
+    kernel = _norm(kernel, n)
+    stride = _norm(stride if stride is not None else kernel, n)
+    if channel_last:
+        dims = (1,) + kernel + (1,)
+        strides = (1,) + stride + (1,)
+    else:
+        dims = (1, 1) + kernel
+        strides = (1, 1) + stride
+    pads = _pads(padding, n)
+    if isinstance(pads, str):
+        pad_cfg = pads
+    else:
+        pad_cfg = ([(0, 0), (0, 0)] + pads) if not channel_last else (
+            [(0, 0)] + pads + [(0, 0)])
+    return jax.lax.reduce_window(x, init, reducer, dims, strides, pad_cfg)
+
+
+@primitive
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCHW"):
+    out = _pool(_A(x), kernel_size, stride, padding, 2, jax.lax.max,
+                -jnp.inf if jnp.issubdtype(_A(x).dtype, jnp.floating) else jnp.iinfo(_A(x).dtype).min,
+                data_format == "NHWC")
+    return out.astype(_A(x).dtype)
+
+
+@primitive
+def max_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCL"):
+    x = _A(x)
+    return _pool(x, kernel_size, stride, padding, 1, jax.lax.max, -jnp.inf,
+                 data_format == "NLC").astype(x.dtype)
+
+
+@primitive
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCDHW"):
+    x = _A(x)
+    return _pool(x, kernel_size, stride, padding, 3, jax.lax.max, -jnp.inf,
+                 data_format == "NDHWC").astype(x.dtype)
+
+
+def _avg_pool(x, kernel_size, stride, padding, n, exclusive, channel_last):
+    x = _A(x)
+    s = _pool(x, kernel_size, stride, padding, n, jax.lax.add, 0.0, channel_last)
+    if exclusive:
+        ones = jnp.ones_like(x)
+        cnt = _pool(ones, kernel_size, stride, padding, n, jax.lax.add, 0.0,
+                    channel_last)
+        return (s / cnt).astype(x.dtype)
+    k = _norm(kernel_size, n)
+    return (s / float(np.prod(k))).astype(x.dtype)
+
+
+@primitive
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL"):
+    return _avg_pool(x, kernel_size, stride, padding, 1, exclusive,
+                     data_format == "NLC")
+
+
+@primitive
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW"):
+    return _avg_pool(x, kernel_size, stride, padding, 2, exclusive,
+                     data_format == "NHWC")
+
+
+@primitive
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW"):
+    return _avg_pool(x, kernel_size, stride, padding, 3, exclusive,
+                     data_format == "NDHWC")
+
+
+def _adaptive_sizes(in_size, out_size):
+    # adaptive pooling = variable windows; when divisible use uniform windows
+    return in_size % out_size == 0
+
+
+@primitive
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW"):
+    x = _A(x)
+    out_hw = _norm(output_size, 2)
+    channel_last = data_format == "NHWC"
+    h, w = (x.shape[1], x.shape[2]) if channel_last else (x.shape[2], x.shape[3])
+    if h % out_hw[0] == 0 and w % out_hw[1] == 0:
+        kh, kw = h // out_hw[0], w // out_hw[1]
+        return _avg_pool(x, (kh, kw), (kh, kw), 0, 2, False, channel_last)
+    # general case: mean over per-output-bin slices via resize-style gather
+    return _adaptive_pool_general(x, out_hw, channel_last, "avg")
+
+
+@primitive
+def adaptive_max_pool2d(x, output_size, return_mask=False, data_format="NCHW"):
+    x = _A(x)
+    out_hw = _norm(output_size, 2)
+    channel_last = data_format == "NHWC"
+    h, w = (x.shape[1], x.shape[2]) if channel_last else (x.shape[2], x.shape[3])
+    if h % out_hw[0] == 0 and w % out_hw[1] == 0:
+        kh, kw = h // out_hw[0], w // out_hw[1]
+        return _pool(x, (kh, kw), (kh, kw), 0, 2, jax.lax.max, -jnp.inf,
+                     channel_last).astype(x.dtype)
+    return _adaptive_pool_general(x, out_hw, channel_last, "max")
+
+
+def _adaptive_pool_general(x, out_hw, channel_last, mode):
+    h_ax, w_ax = (1, 2) if channel_last else (2, 3)
+    h, w = x.shape[h_ax], x.shape[w_ax]
+
+    def bins(in_size, out_size, axis):
+        starts = (np.arange(out_size) * in_size) // out_size
+        ends = ((np.arange(out_size) + 1) * in_size + out_size - 1) // out_size
+        return starts, ends
+
+    hs, he = bins(h, out_hw[0], h_ax)
+    ws, we = bins(w, out_hw[1], w_ax)
+    rows = []
+    for i in range(out_hw[0]):
+        cols = []
+        for j in range(out_hw[1]):
+            sl = [slice(None)] * x.ndim
+            sl[h_ax] = slice(int(hs[i]), int(he[i]))
+            sl[w_ax] = slice(int(ws[j]), int(we[j]))
+            patch = x[tuple(sl)]
+            red = jnp.mean if mode == "avg" else jnp.max
+            cols.append(red(patch, axis=(h_ax, w_ax), keepdims=True))
+        rows.append(jnp.concatenate(cols, axis=w_ax))
+    return jnp.concatenate(rows, axis=h_ax)
+
+
+@primitive
+def adaptive_avg_pool1d(x, output_size):
+    x = _A(x)
+    out = _norm(output_size, 1)[0]
+    l = x.shape[2]
+    if l % out == 0:
+        k = l // out
+        return _avg_pool(x, (k,), (k,), 0, 1, False, False)
+    x4 = x[:, :, None, :]
+    o = _adaptive_pool_general(x4, (1, out), False, "avg")
+    return o[:, :, 0, :]
+
+
+@primitive
+def adaptive_max_pool1d(x, output_size, return_mask=False):
+    x = _A(x)
+    out = _norm(output_size, 1)[0]
+    l = x.shape[2]
+    if l % out == 0:
+        k = l // out
+        return _pool(x, (k,), (k,), 0, 1, jax.lax.max, -jnp.inf, False).astype(x.dtype)
+    x4 = x[:, :, None, :]
+    o = _adaptive_pool_general(x4, (1, out), False, "max")
+    return o[:, :, 0, :]

@@ -1,0 +1,190 @@
+"""Weight initializers (reference python/paddle/nn/initializer/)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as _dtype
+from ..core.tensor import Parameter
+from ..framework import random as _random
+
+
+class Initializer:
+    def create(self, shape, dtype=None, name=None):
+        dt = _dtype.to_jax(dtype or _dtype.get_default_dtype())
+        v = self._generate(tuple(int(s) for s in shape), dt)
+        p = Parameter(v, name=name)
+        return p
+
+    def _generate(self, shape, dt):
+        raise NotImplementedError
+
+    def __call__(self, param):
+        """Re-initialize an existing Parameter in place."""
+        v = self._generate(tuple(param.shape), param._value.dtype)
+        param._value = v
+        return param
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def _generate(self, shape, dt):
+        return jnp.full(shape, self.value, dt)
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def _generate(self, shape, dt):
+        k = _random.next_key()
+        return jax.random.normal(k, shape, dt) * self.std + self.mean
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def _generate(self, shape, dt):
+        k = _random.next_key()
+        return (
+            jax.random.truncated_normal(k, -2.0, 2.0, shape, dt) * self.std
+            + self.mean
+        )
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0):
+        self.low, self.high = low, high
+
+    def _generate(self, shape, dt):
+        k = _random.next_key()
+        return jax.random.uniform(k, shape, dt, self.low, self.high)
+
+
+def _fans(shape):
+    if len(shape) < 1:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    receptive = int(np.prod(shape[2:]))
+    return shape[1] * receptive, shape[0] * receptive
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def _generate(self, shape, dt):
+        fi, fo = _fans(shape)
+        fi = self.fan_in or fi
+        fo = self.fan_out or fo
+        std = self.gain * math.sqrt(2.0 / (fi + fo))
+        k = _random.next_key()
+        return jax.random.normal(k, shape, dt) * std
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def _generate(self, shape, dt):
+        fi, fo = _fans(shape)
+        fi = self.fan_in or fi
+        fo = self.fan_out or fo
+        limit = self.gain * math.sqrt(6.0 / (fi + fo))
+        k = _random.next_key()
+        return jax.random.uniform(k, shape, dt, -limit, limit)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def _generate(self, shape, dt):
+        fi, _ = _fans(shape)
+        fi = self.fan_in or fi
+        gain = math.sqrt(2.0 / (1 + self.negative_slope**2)) if (
+            self.nonlinearity in ("relu", "leaky_relu")) else 1.0
+        std = gain / math.sqrt(fi)
+        k = _random.next_key()
+        return jax.random.normal(k, shape, dt) * std
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def _generate(self, shape, dt):
+        fi, _ = _fans(shape)
+        fi = self.fan_in or fi
+        gain = math.sqrt(2.0 / (1 + self.negative_slope**2)) if (
+            self.nonlinearity in ("relu", "leaky_relu")) else 1.0
+        limit = gain * math.sqrt(3.0 / fi)
+        k = _random.next_key()
+        return jax.random.uniform(k, shape, dt, -limit, limit)
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain=1.0):
+        self.gain = gain
+
+    def _generate(self, shape, dt):
+        k = _random.next_key()
+        return jax.nn.initializers.orthogonal(self.gain)(k, shape, dt)
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        self.value = value
+
+    def _generate(self, shape, dt):
+        from ..core.tensor import Tensor
+
+        v = self.value
+        if isinstance(v, Tensor):
+            v = v._value
+        return jnp.asarray(np.asarray(v), dt).reshape(shape)
+
+
+class Dirac(Initializer):
+    def __init__(self, groups=1):
+        self.groups = groups
+
+    def _generate(self, shape, dt):
+        out = np.zeros(shape, dtype=np.float32)
+        oc, ic = shape[0], shape[1]
+        per = oc // self.groups
+        for i in range(oc):
+            centers = tuple(s // 2 for s in shape[2:])
+            out[(i, i % ic) + centers] = 1.0
+        return jnp.asarray(out, dt)
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    global _GLOBAL_WEIGHT_INIT, _GLOBAL_BIAS_INIT
+    _GLOBAL_WEIGHT_INIT = weight_init
+    _GLOBAL_BIAS_INIT = bias_init
+
+
+_GLOBAL_WEIGHT_INIT = None
+_GLOBAL_BIAS_INIT = None
+
+
+def default_weight_init():
+    return _GLOBAL_WEIGHT_INIT or XavierNormal()
+
+
+def default_bias_init():
+    return _GLOBAL_BIAS_INIT or Constant(0.0)

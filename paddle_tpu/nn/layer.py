@@ -1,0 +1,336 @@
+"""nn.Layer — the module base class.
+
+Parity with the reference's `paddle.nn.Layer`
+(/root/reference/python/paddle/fluid/dygraph/layers.py:107): parameters,
+sublayers, buffers, hooks, state_dict, train/eval, to(). TPU-native addition:
+`functional_state` + `functional_call`, the bridge that lets a stateful Layer
+be traced as a pure function of its parameters for jax.jit/pjit compilation
+(used by paddle_tpu.jit.to_static and the distributed engine).
+"""
+from __future__ import annotations
+
+import collections
+from contextlib import contextmanager
+
+import jax.numpy as jnp
+
+from ..core import dtype as _dtype
+from ..core.tensor import Parameter, Tensor
+from . import initializer as I
+
+
+def set_grad_enabled(mode):
+    from ..core import dispatch
+
+    class _Ctx:
+        def __enter__(self):
+            self._prev = dispatch.tape_enabled()
+            dispatch._set_tape(bool(mode))
+
+        def __exit__(self, *a):
+            dispatch._set_tape(self._prev)
+            return False
+
+    return _Ctx()
+
+
+class Layer:
+    def __init__(self, name_scope=None, dtype="float32"):
+        self._parameters = collections.OrderedDict()
+        self._sub_layers = collections.OrderedDict()
+        self._buffers = collections.OrderedDict()
+        self._non_persistable_buffer_names = set()
+        self._forward_pre_hooks = collections.OrderedDict()
+        self._forward_post_hooks = collections.OrderedDict()
+        self.training = True
+        self._dtype = dtype
+        self._name_scope = name_scope or self.__class__.__name__.lower()
+
+    # -- construction ------------------------------------------------------
+    def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,
+                         default_initializer=None):
+        init = default_initializer
+        attr_name = None
+        trainable = True
+        if attr is not None and attr is not False:
+            # ParamAttr-style dict or object
+            init = getattr(attr, "initializer", None) or (
+                attr.get("initializer") if isinstance(attr, dict) else None
+            ) or init
+            attr_name = getattr(attr, "name", None) or (
+                attr.get("name") if isinstance(attr, dict) else None)
+            tr = getattr(attr, "trainable", None) if not isinstance(attr, dict) \
+                else attr.get("trainable")
+            if tr is not None:
+                trainable = tr
+        if init is None:
+            init = I.default_bias_init() if is_bias else I.default_weight_init()
+        p = init.create(shape, dtype or self._dtype, name=attr_name)
+        p.trainable = trainable
+        return p
+
+    def add_parameter(self, name, parameter):
+        self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name, sublayer):
+        self._sub_layers[name] = sublayer
+        return sublayer
+
+    def register_buffer(self, name, tensor, persistable=True):
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(name)
+        return tensor
+
+    def __setattr__(self, name, value):
+        if isinstance(value, Parameter):
+            self.__dict__.setdefault("_parameters", collections.OrderedDict())
+            self._parameters[name] = value
+            self.__dict__.pop(name, None)
+        elif isinstance(value, Layer):
+            self.__dict__.setdefault("_sub_layers", collections.OrderedDict())
+            self._sub_layers[name] = value
+            self.__dict__.pop(name, None)
+        else:
+            params = self.__dict__.get("_parameters")
+            if params is not None and name in params:
+                if isinstance(value, Tensor):
+                    params[name] = value
+                    return
+                del params[name]
+            subs = self.__dict__.get("_sub_layers")
+            if subs is not None and name in subs:
+                del subs[name]
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        d = self.__dict__
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            s = d.get(store)
+            if s is not None and name in s:
+                return s[name]
+        raise AttributeError(
+            "%r object has no attribute %r" % (type(self).__name__, name)
+        )
+
+    def __delattr__(self, name):
+        for store in (self._parameters, self._sub_layers, self._buffers):
+            if name in store:
+                del store[name]
+                return
+        object.__delattr__(self, name)
+
+    # -- traversal ---------------------------------------------------------
+    def named_sublayers(self, prefix="", include_self=False, layers_set=None):
+        layers_set = layers_set if layers_set is not None else set()
+        if include_self and id(self) not in layers_set:
+            layers_set.add(id(self))
+            yield prefix, self
+        for name, sub in self._sub_layers.items():
+            if sub is None:
+                continue
+            p = prefix + ("." if prefix else "") + name
+            yield from sub.named_sublayers(
+                prefix=p, include_self=True, layers_set=layers_set
+            )
+
+    def sublayers(self, include_self=False):
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def children(self):
+        return list(self._sub_layers.values())
+
+    def named_children(self):
+        return list(self._sub_layers.items())
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        seen = set()
+        for lname, layer in self.named_sublayers(prefix=prefix, include_self=True):
+            for pname, p in layer._parameters.items():
+                if p is None or id(p) in seen:
+                    continue
+                seen.add(id(p))
+                yield (lname + ("." if lname else "") + pname, p)
+            if not include_sublayers:
+                break
+
+    def parameters(self, include_sublayers=True):
+        return [p for _, p in self.named_parameters(
+            include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix=""):
+        for lname, layer in self.named_sublayers(prefix=prefix, include_self=True):
+            for bname, b in layer._buffers.items():
+                if b is None:
+                    continue
+                yield (lname + ("." if lname else "") + bname, b)
+
+    def buffers(self):
+        return [b for _, b in self.named_buffers()]
+
+    def apply(self, fn):
+        for layer in self.sublayers(include_self=True):
+            fn(layer)
+        return self
+
+    # -- mode --------------------------------------------------------------
+    def train(self):
+        for layer in self.sublayers(include_self=True):
+            layer.training = True
+        return self
+
+    def eval(self):
+        for layer in self.sublayers(include_self=True):
+            layer.training = False
+        return self
+
+    # -- hooks -------------------------------------------------------------
+    def register_forward_pre_hook(self, hook):
+        handle = _HookHandle(self._forward_pre_hooks, len(self._forward_pre_hooks))
+        self._forward_pre_hooks[handle.id] = hook
+        return handle
+
+    def register_forward_post_hook(self, hook):
+        handle = _HookHandle(self._forward_post_hooks, len(self._forward_post_hooks))
+        self._forward_post_hooks[handle.id] = hook
+        return handle
+
+    # -- call --------------------------------------------------------------
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            result = hook(self, inputs)
+            if result is not None:
+                inputs = result if isinstance(result, tuple) else (result,)
+        out = self.forward(*inputs, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            result = hook(self, inputs, out)
+            if result is not None:
+                out = result
+        return out
+
+    # -- state -------------------------------------------------------------
+    def state_dict(self, destination=None, include_sublayers=True,
+                   structured_name_prefix=""):
+        dest = destination if destination is not None else collections.OrderedDict()
+        for name, p in self.named_parameters(prefix=structured_name_prefix):
+            dest[name] = p
+        for name, b in self.named_buffers(prefix=structured_name_prefix):
+            short = name.rsplit(".", 1)[-1]
+            if short in self._non_persistable_buffer_names:
+                continue
+            dest[name] = b
+        return dest
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        own = self.state_dict()
+        missing, unexpected = [], []
+        for name, t in own.items():
+            if name in state_dict:
+                v = state_dict[name]
+                arr = v._value if isinstance(v, Tensor) else jnp.asarray(v)
+                t.set_value(arr.astype(t._value.dtype).reshape(t._value.shape))
+            else:
+                missing.append(name)
+        for name in state_dict:
+            if name not in own:
+                unexpected.append(name)
+        return missing, unexpected
+
+    load_dict = set_state_dict
+
+    def to(self, device=None, dtype=None, blocking=None):
+        if dtype is not None:
+            dt = _dtype.to_jax(dtype)
+            for p in self.parameters():
+                if jnp.issubdtype(p._value.dtype, jnp.floating):
+                    p._value = p._value.astype(dt)
+            for b in self.buffers():
+                if jnp.issubdtype(b._value.dtype, jnp.floating):
+                    b._value = b._value.astype(dt)
+        if device is not None:
+            import jax as _jax
+
+            from ..core import place as _place
+
+            kind = str(device).split(":")[0]
+            pl = (_place.CPUPlace() if kind == "cpu" else _place.TPUPlace(0))
+            for t in list(self.parameters()) + list(self.buffers()):
+                t._value = _jax.device_put(t._value, pl.jax_device())
+        return self
+
+    def astype(self, dtype):
+        return self.to(dtype=dtype)
+
+    def float(self):
+        return self.to(dtype="float32")
+
+    def bfloat16(self):
+        return self.to(dtype="bfloat16")
+
+    # -- functional bridge (TPU-native) ------------------------------------
+    def functional_state(self):
+        """Return (names, values) of all params+buffers as raw arrays."""
+        names, values = [], []
+        for n, p in self.named_parameters():
+            names.append(n)
+            values.append(p._value)
+        for n, b in self.named_buffers():
+            names.append(n)
+            values.append(b._value)
+        return names, values
+
+    def raw_state_tensors(self):
+        tensors = {}
+        for n, p in self.named_parameters():
+            tensors[n] = p
+        for n, b in self.named_buffers():
+            tensors[n] = b
+        return tensors
+
+    @contextmanager
+    def bind_state(self, names, values):
+        """Temporarily swap the given raw arrays into the layer's tensors —
+        lets jax trace self.forward as a pure function of (values, inputs)."""
+        tensors = self.raw_state_tensors()
+        saved = {}
+        try:
+            for n, v in zip(names, values):
+                t = tensors[n]
+                saved[n] = t._value
+                t._value = v
+            yield self
+        finally:
+            for n, old in saved.items():
+                tensors[n]._value = old
+
+    def functional_call(self, state_values, *inputs, state_names=None,
+                        **kwargs):
+        names = state_names or self.functional_state()[0]
+        with self.bind_state(names, state_values):
+            out = self(*inputs, **kwargs)
+        return out
+
+    def __repr__(self):
+        lines = [self.__class__.__name__ + "("]
+        for name, sub in self._sub_layers.items():
+            sub_repr = repr(sub).replace("\n", "\n  ")
+            lines.append("  (%s): %s" % (name, sub_repr))
+        lines.append(")")
+        return "\n".join(lines) if len(lines) > 2 else (
+            self.__class__.__name__ + "()")
+
+
+class _HookHandle:
+    _next_id = [0]
+
+    def __init__(self, store, _):
+        self.store = store
+        self.id = _HookHandle._next_id[0]
+        _HookHandle._next_id[0] += 1
+
+    def remove(self):
+        self.store.pop(self.id, None)

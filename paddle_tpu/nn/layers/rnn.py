@@ -1,0 +1,347 @@
+"""RNN layers (reference python/paddle/nn/layer/rnn.py, phi rnn_kernel/cudnn).
+
+TPU-native design: the time loop is jax.lax.scan — one compiled fused loop
+instead of cudnn's monolithic RNN kernel; multi-layer and bidirectional wrap
+the scan. Weight layout follows the reference (ih/hh per gate blocks)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import primitive
+from ...core.tensor import Tensor
+from .. import initializer as I
+from ..layer import Layer
+
+_A = jnp.asarray
+
+
+# ---- functional cells (pure) ---------------------------------------------
+
+def _lstm_step(x, h, c, w_ih, w_hh, b_ih, b_hh):
+    gates = x @ w_ih.T + h @ w_hh.T
+    if b_ih is not None:
+        gates = gates + b_ih + b_hh
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    g = jnp.tanh(g)
+    c2 = f * c + i * g
+    h2 = o * jnp.tanh(c2)
+    return h2, c2
+
+
+def _gru_step(x, h, w_ih, w_hh, b_ih, b_hh):
+    gi = x @ w_ih.T + (b_ih if b_ih is not None else 0.0)
+    gh = h @ w_hh.T + (b_hh if b_hh is not None else 0.0)
+    ir, iz, ic = jnp.split(gi, 3, axis=-1)
+    hr, hz, hc = jnp.split(gh, 3, axis=-1)
+    r = jax.nn.sigmoid(ir + hr)
+    z = jax.nn.sigmoid(iz + hz)
+    n = jnp.tanh(ic + r * hc)
+    return (1.0 - z) * n + z * h
+
+
+def _simple_step(x, h, w_ih, w_hh, b_ih, b_hh, activation):
+    out = x @ w_ih.T + h @ w_hh.T
+    if b_ih is not None:
+        out = out + b_ih + b_hh
+    return jnp.tanh(out) if activation == "tanh" else jax.nn.relu(out)
+
+
+# ---- cell layers ---------------------------------------------------------
+
+class RNNCellBase(Layer):
+    def _make_weights(self, input_size, hidden_size, gates):
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            [gates * hidden_size, input_size], default_initializer=u)
+        self.weight_hh = self.create_parameter(
+            [gates * hidden_size, hidden_size], default_initializer=u)
+        self.bias_ih = self.create_parameter(
+            [gates * hidden_size], is_bias=True, default_initializer=u)
+        self.bias_hh = self.create_parameter(
+            [gates * hidden_size], is_bias=True, default_initializer=u)
+
+    def get_initial_states(self, batch_ref, shape=None, dtype=None):
+        import paddle_tpu as P
+
+        b = batch_ref.shape[0]
+        return P.zeros([b, self.hidden_size],
+                       dtype or "float32")
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self._make_weights(input_size, hidden_size, 4)
+
+    def forward(self, inputs, states=None):
+        import paddle_tpu as P
+
+        if states is None:
+            h = self.get_initial_states(inputs)
+            c = self.get_initial_states(inputs)
+        else:
+            h, c = states
+        h2, c2 = _lstm_cell_op(inputs, h, c, self.weight_ih, self.weight_hh,
+                               self.bias_ih, self.bias_hh)
+        return h2, (h2, c2)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self._make_weights(input_size, hidden_size, 3)
+
+    def forward(self, inputs, states=None):
+        h = states if states is not None else self.get_initial_states(inputs)
+        h2 = _gru_cell_op(inputs, h, self.weight_ih, self.weight_hh,
+                          self.bias_ih, self.bias_hh)
+        return h2, h2
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        self._make_weights(input_size, hidden_size, 1)
+
+    def forward(self, inputs, states=None):
+        h = states if states is not None else self.get_initial_states(inputs)
+        h2 = _simple_cell_op(inputs, h, self.weight_ih, self.weight_hh,
+                             self.bias_ih, self.bias_hh,
+                             activation=self.activation)
+        return h2, h2
+
+
+@primitive(name="lstm_cell")
+def _lstm_cell_op(x, h, c, w_ih, w_hh, b_ih, b_hh):
+    return _lstm_step(_A(x), _A(h), _A(c), _A(w_ih), _A(w_hh), _A(b_ih), _A(b_hh))
+
+
+@primitive(name="gru_cell")
+def _gru_cell_op(x, h, w_ih, w_hh, b_ih, b_hh):
+    return _gru_step(_A(x), _A(h), _A(w_ih), _A(w_hh), _A(b_ih), _A(b_hh))
+
+
+@primitive(name="simple_rnn_cell")
+def _simple_cell_op(x, h, w_ih, w_hh, b_ih, b_hh, activation="tanh"):
+    return _simple_step(_A(x), _A(h), _A(w_ih), _A(w_hh), _A(b_ih), _A(b_hh),
+                        activation)
+
+
+# ---- scan-based multi-layer RNNs -----------------------------------------
+
+@primitive(name="rnn_scan")
+def _rnn_scan(x, h0, c0, weights, mode, num_layers, direction, time_major,
+              activation="tanh"):
+    """weights: flat list [w_ih, w_hh, b_ih, b_hh] x (num_layers*num_dir)."""
+    x = _A(x)
+    if not time_major:
+        x = jnp.swapaxes(x, 0, 1)  # [T,B,I]
+    num_dir = 2 if direction == "bidirect" else 1
+    h0 = _A(h0)
+    c0 = _A(c0) if c0 is not None else None
+
+    def run_dir(seq, w_ih, w_hh, b_ih, b_hh, h_init, c_init, reverse):
+        if reverse:
+            seq = jnp.flip(seq, 0)
+
+        if mode == "LSTM":
+            def step(carry, xt):
+                h, c = carry
+                h2, c2 = _lstm_step(xt, h, c, w_ih, w_hh, b_ih, b_hh)
+                return (h2, c2), h2
+
+            (hT, cT), ys = jax.lax.scan(step, (h_init, c_init), seq)
+        elif mode == "GRU":
+            def step(h, xt):
+                h2 = _gru_step(xt, h, w_ih, w_hh, b_ih, b_hh)
+                return h2, h2
+
+            hT, ys = jax.lax.scan(step, h_init, seq)
+            cT = None
+        else:
+            def step(h, xt):
+                h2 = _simple_step(xt, h, w_ih, w_hh, b_ih, b_hh, activation)
+                return h2, h2
+
+            hT, ys = jax.lax.scan(step, h_init, seq)
+            cT = None
+        if reverse:
+            ys = jnp.flip(ys, 0)
+        return ys, hT, cT
+
+    layer_in = x
+    h_finals, c_finals = [], []
+    for layer in range(num_layers):
+        outs = []
+        for d in range(num_dir):
+            idx = layer * num_dir + d
+            w_ih, w_hh, b_ih, b_hh = [_A(w) for w in weights[4 * idx:4 * idx + 4]]
+            hi = h0[idx]
+            ci = c0[idx] if c0 is not None else None
+            ys, hT, cT = run_dir(layer_in, w_ih, w_hh, b_ih, b_hh, hi, ci,
+                                 reverse=(d == 1))
+            outs.append(ys)
+            h_finals.append(hT)
+            if cT is not None:
+                c_finals.append(cT)
+        layer_in = outs[0] if num_dir == 1 else jnp.concatenate(outs, -1)
+    out = layer_in
+    if not time_major:
+        out = jnp.swapaxes(out, 0, 1)
+    hN = jnp.stack(h_finals, 0)
+    if mode == "LSTM":
+        return out, hN, jnp.stack(c_finals, 0)
+    return out, hN
+
+
+class _RNNBase(Layer):
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh"):
+        super().__init__()
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.direction = direction
+        self.time_major = time_major
+        self.activation = activation
+        num_dir = 2 if direction in ("bidirect", "bidirectional") else 1
+        self.num_directions = num_dir
+        gates = {"LSTM": 4, "GRU": 3, "RNN": 1}[mode]
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self._weight_names = []
+        for layer in range(num_layers):
+            for d in range(num_dir):
+                in_size = input_size if layer == 0 else hidden_size * num_dir
+                sfx = "_reverse" if d == 1 else ""
+                names = [
+                    "weight_ih_l%d%s" % (layer, sfx),
+                    "weight_hh_l%d%s" % (layer, sfx),
+                    "bias_ih_l%d%s" % (layer, sfx),
+                    "bias_hh_l%d%s" % (layer, sfx),
+                ]
+                shapes = [
+                    [gates * hidden_size, in_size],
+                    [gates * hidden_size, hidden_size],
+                    [gates * hidden_size],
+                    [gates * hidden_size],
+                ]
+                for n, s in zip(names, shapes):
+                    self.add_parameter(n, u.create(s))
+                self._weight_names.extend(names)
+
+    def _weights(self):
+        return [self._parameters[n] for n in self._weight_names]
+
+    def forward(self, inputs, initial_states=None):
+        import paddle_tpu as P
+
+        b_axis = 1 if self.time_major else 0
+        batch = inputs.shape[b_axis]
+        n = self.num_layers * self.num_directions
+        if self.mode == "LSTM":
+            if initial_states is None:
+                h0 = P.zeros([n, batch, self.hidden_size], inputs.dtype)
+                c0 = P.zeros([n, batch, self.hidden_size], inputs.dtype)
+            else:
+                h0, c0 = initial_states
+            out, hN, cN = _rnn_scan(
+                inputs, h0, c0, self._weights(), mode=self.mode,
+                num_layers=self.num_layers,
+                direction="bidirect" if self.num_directions == 2 else "forward",
+                time_major=self.time_major, activation=self.activation)
+            return out, (hN, cN)
+        h0 = initial_states if initial_states is not None else P.zeros(
+            [n, batch, self.hidden_size], inputs.dtype)
+        out, hN = _rnn_scan(
+            inputs, h0, None, self._weights(), mode=self.mode,
+            num_layers=self.num_layers,
+            direction="bidirect" if self.num_directions == 2 else "forward",
+            time_major=self.time_major, activation=self.activation)
+        return out, hN
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kw):
+        super().__init__("LSTM", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kw):
+        super().__init__("GRU", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout)
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kw):
+        super().__init__("RNN", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, activation)
+
+
+class RNN(Layer):
+    """Wrapper running a cell over time (reference paddle.nn.RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, **kwargs):
+        import paddle_tpu as P
+
+        t_axis = 0 if self.time_major else 1
+        steps = inputs.shape[t_axis]
+        idx = range(steps - 1, -1, -1) if self.is_reverse else range(steps)
+        states = initial_states
+        outs = []
+        for t in idx:
+            xt = inputs[:, t] if t_axis == 1 else inputs[t]
+            out, states = self.cell(xt, states)
+            outs.append(out)
+        if self.is_reverse:
+            outs = outs[::-1]
+        out = P.stack(outs, axis=t_axis)
+        return out, states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, **kwargs):
+        import paddle_tpu as P
+
+        s_fw, s_bw = (initial_states if initial_states is not None
+                      else (None, None))
+        o_fw, s_fw = self.rnn_fw(inputs, s_fw)
+        o_bw, s_bw = self.rnn_bw(inputs, s_bw)
+        return P.concat([o_fw, o_bw], axis=-1), (s_fw, s_bw)

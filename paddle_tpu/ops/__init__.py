@@ -1,0 +1,245 @@
+"""Ops library + Tensor method patching.
+
+The reference patches Tensor methods from Python
+(python/paddle/fluid/dygraph/varbase_patch_methods.py) and generated pybind
+math dunders (paddle/fluid/pybind/eager_math_op_patch.cc); we do the same in
+one place here.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import primitive
+from ..core.tensor import Tensor
+
+from . import (  # noqa: F401
+    comparison,
+    creation,
+    linalg,
+    manipulation,
+    math,
+    reduction,
+)
+
+_A = jnp.asarray
+
+
+def _norm_index(idx):
+    """Convert an indexing object possibly containing Tensors to raw form."""
+    if isinstance(idx, Tensor):
+        return idx
+    if isinstance(idx, tuple):
+        return tuple(_norm_index(i) for i in idx)
+    if isinstance(idx, list):
+        return jnp.asarray(idx)
+    return idx
+
+
+@primitive(name="getitem")
+def _getitem(x, idx):
+    def conv(i):
+        if isinstance(i, tuple):
+            return tuple(conv(j) for j in i)
+        if hasattr(i, "dtype") and hasattr(i, "shape") and not isinstance(i, slice):
+            a = _A(i)
+            return a
+        return i
+
+    return _A(x)[conv(idx)]
+
+
+def _tensor_getitem(self, idx):
+    idx = _norm_index(idx)
+    # boolean-mask indexing has a data-dependent shape → host fallback
+    if isinstance(idx, Tensor) and idx.dtype == "bool":
+        return manipulation.masked_select(self, idx)
+    return _getitem(self, idx)
+
+
+def _tensor_setitem(self, idx, value):
+    idx = _norm_index(idx)
+
+    def conv(i):
+        if isinstance(i, tuple):
+            return tuple(conv(j) for j in i)
+        if isinstance(i, Tensor):
+            return i._value
+        return i
+
+    v = value._value if isinstance(value, Tensor) else value
+    self._bump(self._value.at[conv(idx)].set(v))
+
+
+def _swap(fn):
+    return lambda self, other: fn(other, self)
+
+
+_METHODS = {
+    # dunders
+    "__add__": math.add,
+    "__radd__": _swap(math.add),
+    "__sub__": math.subtract,
+    "__rsub__": _swap(math.subtract),
+    "__mul__": math.multiply,
+    "__rmul__": _swap(math.multiply),
+    "__truediv__": math.divide,
+    "__rtruediv__": _swap(math.divide),
+    "__floordiv__": math.floor_divide,
+    "__rfloordiv__": _swap(math.floor_divide),
+    "__mod__": math.remainder,
+    "__rmod__": _swap(math.remainder),
+    "__pow__": math.pow_,
+    "__rpow__": _swap(math.pow_),
+    "__matmul__": math.matmul,
+    "__rmatmul__": _swap(math.matmul),
+    "__neg__": math.neg,
+    "__abs__": math.abs,
+    "__invert__": comparison.logical_not,
+    "__eq__": comparison.equal,
+    "__ne__": comparison.not_equal,
+    "__lt__": comparison.less_than,
+    "__le__": comparison.less_equal,
+    "__gt__": comparison.greater_than,
+    "__ge__": comparison.greater_equal,
+    "__getitem__": _tensor_getitem,
+    "__setitem__": _tensor_setitem,
+    # named methods
+    "add": math.add,
+    "subtract": math.subtract,
+    "multiply": math.multiply,
+    "divide": math.divide,
+    "matmul": math.matmul,
+    "mm": math.mm,
+    "bmm": math.bmm,
+    "dot": math.dot,
+    "pow": math.pow_,
+    "abs": math.abs,
+    "exp": math.exp,
+    "log": math.log,
+    "sqrt": math.sqrt,
+    "rsqrt": math.rsqrt,
+    "square": math.square,
+    "sin": math.sin,
+    "cos": math.cos,
+    "tanh": math.tanh,
+    "sigmoid": math.sigmoid,
+    "floor": math.floor,
+    "ceil": math.ceil,
+    "round": math.round_,
+    "sign": math.sign,
+    "reciprocal": math.reciprocal,
+    "clip": math.clip,
+    "scale": math.scale,
+    "cast": math.cast,
+    "astype": math.cast,
+    "erf": math.erf,
+    "lerp": math.lerp,
+    "cumsum": math.cumsum,
+    "cumprod": math.cumprod,
+    "isnan": math.isnan,
+    "isinf": math.isinf,
+    "isfinite": math.isfinite,
+    "trace": math.trace,
+    "maximum": math.maximum,
+    "minimum": math.minimum,
+    # reductions
+    "sum": reduction.sum,
+    "mean": reduction.mean,
+    "prod": reduction.prod,
+    "max": reduction.max,
+    "min": reduction.min,
+    "amax": reduction.amax,
+    "amin": reduction.amin,
+    "std": reduction.std,
+    "var": reduction.var,
+    "all": reduction.all,
+    "any": reduction.any,
+    "argmax": reduction.argmax,
+    "argmin": reduction.argmin,
+    "logsumexp": reduction.logsumexp,
+    "median": reduction.median,
+    # manipulation
+    "reshape": manipulation.reshape,
+    "transpose": manipulation.transpose,
+    "squeeze": manipulation.squeeze,
+    "unsqueeze": manipulation.unsqueeze,
+    "flatten": manipulation.flatten,
+    "tile": manipulation.tile,
+    "expand": manipulation.expand,
+    "expand_as": manipulation.expand_as,
+    "broadcast_to": manipulation.broadcast_to,
+    "flip": manipulation.flip,
+    "roll": manipulation.roll,
+    "gather": manipulation.gather,
+    "gather_nd": manipulation.gather_nd,
+    "index_select": manipulation.index_select,
+    "masked_select": manipulation.masked_select,
+    "masked_fill": manipulation.masked_fill,
+    "scatter": manipulation.scatter,
+    "scatter_nd_add": manipulation.scatter_nd_add,
+    "take_along_axis": manipulation.take_along_axis,
+    "put_along_axis": manipulation.put_along_axis,
+    "sort": manipulation.sort,
+    "argsort": manipulation.argsort,
+    "topk": manipulation.topk,
+    "split": manipulation.split,
+    "chunk": manipulation.chunk,
+    "unbind": manipulation.unbind,
+    "nonzero": manipulation.nonzero,
+    "unique": manipulation.unique,
+    "where": manipulation.where,
+    "concat": None,  # not a method
+    # comparison
+    "equal": comparison.equal,
+    "not_equal": comparison.not_equal,
+    "greater_than": comparison.greater_than,
+    "greater_equal": comparison.greater_equal,
+    "less_than": comparison.less_than,
+    "less_equal": comparison.less_equal,
+    "logical_and": comparison.logical_and,
+    "logical_or": comparison.logical_or,
+    "logical_not": comparison.logical_not,
+    "logical_xor": comparison.logical_xor,
+    "isclose": comparison.isclose,
+    "allclose": comparison.allclose,
+    "equal_all": comparison.equal_all,
+    "bitwise_and": comparison.bitwise_and,
+    "bitwise_or": comparison.bitwise_or,
+    "bitwise_xor": comparison.bitwise_xor,
+    "bitwise_not": comparison.bitwise_not,
+    # linalg
+    "norm": linalg.norm,
+    "cholesky": linalg.cholesky,
+    "inverse": linalg.inv,
+    "clone": creation.clone,
+    "numel": lambda self: self.size,
+    "tril": creation.tril,
+    "triu": creation.triu,
+    "diagonal": math.diagonal,
+}
+
+
+def _make_inplace(name, fn):
+    def inplace(self, *args, **kwargs):
+        out = fn(self, *args, **kwargs)
+        return self._bump(out._value)
+
+    inplace.__name__ = name
+    return inplace
+
+
+def patch_tensor_methods():
+    for name, fn in _METHODS.items():
+        if fn is None:
+            continue
+        setattr(Tensor, name, fn)
+    # in-place variants (paddle's trailing-underscore API)
+    for base in (
+        "add", "subtract", "multiply", "divide", "clip", "scale", "exp",
+        "sqrt", "rsqrt", "reciprocal", "round", "floor", "ceil", "tanh",
+        "sigmoid", "reshape", "squeeze", "unsqueeze", "flatten", "cast",
+    ):
+        setattr(Tensor, base + "_", _make_inplace(base + "_", _METHODS[base]))
+
+
+patch_tensor_methods()

@@ -1,0 +1,70 @@
+"""Comparison & logical ops (reference python/paddle/tensor/logic.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import primitive
+from ..core.tensor import Tensor
+
+_A = jnp.asarray
+
+
+def _cmp(name, fn):
+    @primitive(name=name, nondiff=True)
+    def op(x, y):
+        return fn(_A(x), _A(y))
+
+    return op
+
+
+equal = _cmp("equal", jnp.equal)
+not_equal = _cmp("not_equal", jnp.not_equal)
+greater_than = _cmp("greater_than", jnp.greater)
+greater_equal = _cmp("greater_equal", jnp.greater_equal)
+less_than = _cmp("less_than", jnp.less)
+less_equal = _cmp("less_equal", jnp.less_equal)
+logical_and = _cmp("logical_and", jnp.logical_and)
+logical_or = _cmp("logical_or", jnp.logical_or)
+logical_xor = _cmp("logical_xor", jnp.logical_xor)
+bitwise_and = _cmp("bitwise_and", jnp.bitwise_and)
+bitwise_or = _cmp("bitwise_or", jnp.bitwise_or)
+bitwise_xor = _cmp("bitwise_xor", jnp.bitwise_xor)
+
+
+@primitive(nondiff=True)
+def logical_not(x):
+    return jnp.logical_not(_A(x))
+
+
+@primitive(nondiff=True)
+def bitwise_not(x):
+    return jnp.bitwise_not(_A(x))
+
+
+@primitive(nondiff=True)
+def isclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False):
+    return jnp.isclose(_A(x), _A(y), rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def allclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False):
+    from .reduction import all_
+
+    return all_(isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan))
+
+
+def equal_all(x, y):
+    xv = x._value if isinstance(x, Tensor) else _A(x)
+    yv = y._value if isinstance(y, Tensor) else _A(y)
+    if jnp.shape(xv) != jnp.shape(yv):
+        return Tensor(jnp.asarray(False))
+    return Tensor(jnp.array_equal(xv, yv))
+
+
+@primitive(nondiff=True)
+def is_empty(x):
+    return jnp.asarray(_A(x).size == 0)
+
+
+@primitive(nondiff=True)
+def in1d(x, test):
+    return jnp.isin(_A(x), _A(test))

@@ -1,0 +1,265 @@
+"""Tensor creation ops.
+
+Parity targets: reference python/paddle/tensor/creation.py and
+python/paddle/tensor/random.py. Creation is host-side trivial under XLA; the
+random family uses JAX's counter-based PRNG (framework/random.py) instead of
+per-device curand generators.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as _dtype
+from ..core.tensor import Tensor
+from ..framework import random as _random
+
+
+def _resolve(dtype, default=None):
+    if dtype is None and default is not None:
+        return _dtype.to_jax(default)
+    return _dtype.to_jax(dtype)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    if isinstance(data, Tensor):
+        v = data._value
+        if dtype is not None:
+            v = v.astype(_dtype.to_jax(dtype))
+        return Tensor(v, stop_gradient=stop_gradient)
+    if isinstance(data, (bool, int, float, complex)) or (
+        isinstance(data, (list, tuple))
+    ) or isinstance(data, np.ndarray):
+        arr = np.asarray(data)
+        if dtype is None and arr.dtype == np.float64:
+            arr = arr.astype(np.float32)  # paddle default is float32
+        if dtype is None and arr.dtype == np.int64 and arr.size:
+            pass  # paddle keeps int64 for python ints
+        v = jnp.asarray(arr, dtype=None if dtype is None else _dtype.to_jax(dtype))
+        return Tensor(v, stop_gradient=stop_gradient)
+    # jax arrays / tracers
+    v = jnp.asarray(data, dtype=None if dtype is None else _dtype.to_jax(dtype))
+    return Tensor(v, stop_gradient=stop_gradient)
+
+
+def _shape_list(shape):
+    if isinstance(shape, Tensor):
+        return [int(s) for s in shape.numpy()]
+    if isinstance(shape, (int, np.integer)):
+        return [int(shape)]
+    return [int(s) if not isinstance(s, Tensor) else int(s.item()) for s in shape]
+
+
+def zeros(shape, dtype=None):
+    return Tensor(jnp.zeros(_shape_list(shape), _resolve(dtype, _dtype.get_default_dtype())))
+
+
+def ones(shape, dtype=None):
+    return Tensor(jnp.ones(_shape_list(shape), _resolve(dtype, _dtype.get_default_dtype())))
+
+
+def full(shape, fill_value, dtype=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    return Tensor(
+        jnp.full(_shape_list(shape), fill_value, _resolve(dtype, _dtype.get_default_dtype()))
+    )
+
+
+def empty(shape, dtype=None):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None):
+    v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jnp.zeros_like(v, dtype=None if dtype is None else _dtype.to_jax(dtype)))
+
+
+def ones_like(x, dtype=None):
+    v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jnp.ones_like(v, dtype=None if dtype is None else _dtype.to_jax(dtype)))
+
+
+def full_like(x, fill_value, dtype=None):
+    v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jnp.full_like(v, fill_value, dtype=None if dtype is None else _dtype.to_jax(dtype)))
+
+
+def empty_like(x, dtype=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None):
+    def _v(x):
+        return x.item() if isinstance(x, Tensor) else x
+    start, end, step = _v(start), _v(end), _v(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        dtype = (
+            "int64"
+            if all(isinstance(v, (int, np.integer)) for v in (start, end, step))
+            else _dtype.get_default_dtype()
+        )
+    return Tensor(jnp.arange(start, end, step, dtype=_dtype.to_jax(dtype)))
+
+
+def linspace(start, stop, num, dtype=None):
+    def _v(x):
+        return x.item() if isinstance(x, Tensor) else x
+    return Tensor(
+        jnp.linspace(_v(start), _v(stop), int(_v(num)), dtype=_resolve(dtype, _dtype.get_default_dtype()))
+    )
+
+
+def logspace(start, stop, num, base=10.0, dtype=None):
+    return Tensor(
+        jnp.logspace(start, stop, int(num), base=base, dtype=_resolve(dtype, _dtype.get_default_dtype()))
+    )
+
+
+def eye(num_rows, num_columns=None, dtype=None):
+    return Tensor(
+        jnp.eye(int(num_rows), None if num_columns is None else int(num_columns),
+                dtype=_resolve(dtype, _dtype.get_default_dtype()))
+    )
+
+
+def diag(x, offset=0, padding_value=0):
+    v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    if v.ndim == 1 and padding_value != 0:
+        d = jnp.diag(v, k=offset)
+        mask = jnp.diag(jnp.ones_like(v, dtype=bool), k=offset)
+        d = jnp.where(mask, d, padding_value)
+        return Tensor(d)
+    return Tensor(jnp.diag(v, k=offset))
+
+
+def diagflat(x, offset=0):
+    v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jnp.diagflat(v, k=offset))
+
+
+def tril(x, diagonal=0):
+    from ..core.dispatch import primitive
+    return _tril(x, diagonal=diagonal)
+
+
+def triu(x, diagonal=0):
+    return _triu(x, diagonal=diagonal)
+
+
+def meshgrid(*args):
+    vs = [a._value if isinstance(a, Tensor) else jnp.asarray(a) for a in args]
+    if len(vs) == 1 and isinstance(vs[0], (list, tuple)):
+        vs = list(vs[0])
+    return [Tensor(v) for v in jnp.meshgrid(*vs, indexing="ij")]
+
+
+def assign(x, output=None):
+    v = x._value if isinstance(x, Tensor) else jnp.asarray(np.asarray(x))
+    if output is not None:
+        output.set_value(v)
+        return output
+    return Tensor(v)
+
+
+def clone(x):
+    from ..core.dispatch import primitive
+    return _clone(x)
+
+
+def numel(x):
+    return Tensor(jnp.asarray(x.size, jnp.int64))
+
+
+# ---- random family -------------------------------------------------------
+
+def rand(shape, dtype=None):
+    return uniform(shape, dtype=dtype, min=0.0, max=1.0)
+
+
+def randn(shape, dtype=None):
+    k = _random.next_key()
+    return Tensor(
+        jax.random.normal(k, _shape_list(shape), _resolve(dtype, _dtype.get_default_dtype()))
+    )
+
+
+def standard_normal(shape, dtype=None):
+    return randn(shape, dtype)
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if shape is None:
+        shape = []
+    k = _random.next_key()
+    v = jax.random.normal(k, _shape_list(shape), _dtype.to_jax(_dtype.get_default_dtype()))
+    return Tensor(v * std + mean)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0):
+    k = _random.next_key() if not seed else jax.random.key(seed)
+    return Tensor(
+        jax.random.uniform(
+            k,
+            _shape_list(shape),
+            _resolve(dtype, _dtype.get_default_dtype()),
+            minval=min,
+            maxval=max,
+        )
+    )
+
+
+def randint(low=0, high=None, shape=(1,), dtype=None):
+    if high is None:
+        low, high = 0, low
+    k = _random.next_key()
+    return Tensor(
+        jax.random.randint(k, _shape_list(shape), low, high, dtype=_resolve(dtype, "int64"))
+    )
+
+
+def randperm(n, dtype=None):
+    k = _random.next_key()
+    return Tensor(jax.random.permutation(k, int(n)).astype(_resolve(dtype, "int64")))
+
+
+def multinomial(x, num_samples=1, replacement=False):
+    v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    k = _random.next_key()
+    logits = jnp.log(jnp.maximum(v, 1e-30))
+    if replacement:
+        out = jax.random.categorical(k, logits, axis=-1, shape=(
+            (*v.shape[:-1], num_samples) if v.ndim > 1 else (num_samples,)))
+    else:
+        g = jax.random.gumbel(k, v.shape, logits.dtype) + logits
+        out = jnp.argsort(-g, axis=-1)[..., :num_samples]
+    return Tensor(out.astype(jnp.int64))
+
+
+def bernoulli(x):
+    v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    k = _random.next_key()
+    return Tensor(jax.random.bernoulli(k, v).astype(v.dtype))
+
+
+# primitives defined late to avoid import cycle
+from ..core.dispatch import primitive  # noqa: E402
+
+
+@primitive(name="tril")
+def _tril(x, diagonal=0):
+    return jnp.tril(jnp.asarray(x), k=diagonal)
+
+
+@primitive(name="triu")
+def _triu(x, diagonal=0):
+    return jnp.triu(jnp.asarray(x), k=diagonal)
+
+
+@primitive(name="clone")
+def _clone(x):
+    return jnp.asarray(x) + 0

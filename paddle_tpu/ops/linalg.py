@@ -1,0 +1,191 @@
+"""Linear algebra ops (reference python/paddle/tensor/linalg.py,
+phi/kernels/*{cholesky,qr,svd,eig,...}*). Dense decompositions lower to
+XLA's native linalg custom-calls on TPU."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import primitive
+
+_A = jnp.asarray
+
+
+@primitive
+def norm(x, p="fro", axis=None, keepdim=False):
+    x = _A(x)
+    if p == "fro" or p is None:
+        if axis is None:
+            return jnp.sqrt(jnp.sum(jnp.square(x)))
+        return jnp.linalg.norm(x, ord=None, axis=_tup(axis), keepdims=keepdim)
+    if p == "nuc":
+        return jnp.linalg.norm(x, ord="nuc", axis=_tup(axis), keepdims=keepdim)
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    p = float(p) if not isinstance(p, str) else p
+    if p == float("inf"):
+        return jnp.max(jnp.abs(x), axis=_tup(axis), keepdims=keepdim)
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(x), axis=_tup(axis), keepdims=keepdim)
+    if p == 0:
+        return jnp.sum((x != 0).astype(x.dtype), axis=_tup(axis), keepdims=keepdim)
+    return jnp.sum(jnp.abs(x) ** p, axis=_tup(axis), keepdims=keepdim) ** (1.0 / p)
+
+
+def _tup(axis):
+    if axis is None:
+        return None
+    return tuple(axis) if isinstance(axis, (list, tuple)) else int(axis)
+
+
+@primitive
+def cholesky(x, upper=False):
+    L = jnp.linalg.cholesky(_A(x))
+    return jnp.swapaxes(L, -1, -2) if upper else L
+
+
+@primitive
+def qr(x, mode="reduced"):
+    q, r = jnp.linalg.qr(_A(x), mode=mode)
+    return q, r
+
+
+@primitive
+def svd(x, full_matrices=False):
+    return tuple(jnp.linalg.svd(_A(x), full_matrices=full_matrices))
+
+
+@primitive
+def inv(x):
+    return jnp.linalg.inv(_A(x))
+
+
+@primitive
+def pinv(x, rcond=1e-15, hermitian=False):
+    return jnp.linalg.pinv(_A(x), rtol=rcond, hermitian=hermitian)
+
+
+@primitive
+def det(x):
+    return jnp.linalg.det(_A(x))
+
+
+@primitive
+def slogdet(x):
+    s, ld = jnp.linalg.slogdet(_A(x))
+    return s, ld
+
+
+@primitive
+def solve(x, y):
+    return jnp.linalg.solve(_A(x), _A(y))
+
+
+@primitive
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False):
+    x = _A(x)
+    if transpose:
+        x = jnp.swapaxes(x, -1, -2)
+        upper = not upper
+    return jax.scipy.linalg.solve_triangular(
+        x, _A(y), lower=not upper, unit_diagonal=unitriangular
+    )
+
+
+@primitive
+def cholesky_solve(x, y, upper=False):
+    y_ = _A(y)
+    b = _A(x)
+    L = y_ if not upper else jnp.swapaxes(y_, -1, -2)
+    z = jax.scipy.linalg.solve_triangular(L, b, lower=True)
+    return jax.scipy.linalg.solve_triangular(jnp.swapaxes(L, -1, -2), z, lower=False)
+
+
+@primitive
+def matrix_power(x, n):
+    return jnp.linalg.matrix_power(_A(x), int(n))
+
+
+@primitive(nondiff=True)
+def matrix_rank(x, tol=None, hermitian=False):
+    return jnp.linalg.matrix_rank(_A(x), rtol=tol).astype(jnp.int64)
+
+
+@primitive
+def eigh(x, UPLO="L"):
+    w, v = jnp.linalg.eigh(_A(x), UPLO=UPLO)
+    return w, v
+
+
+def eig(x):
+    """General (non-symmetric) eig: CPU-only in XLA — host fallback, like the
+    reference's CPU-only eig kernel (phi/kernels/cpu/eig_kernel.cc)."""
+    import numpy as np
+
+    from ..core.tensor import Tensor
+
+    xv = np.asarray(x.numpy() if isinstance(x, Tensor) else x)
+    w, v = np.linalg.eig(xv)
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
+
+
+@primitive
+def eigvalsh(x, UPLO="L"):
+    return jnp.linalg.eigvalsh(_A(x), UPLO=UPLO)
+
+
+@primitive
+def lstsq(x, y, rcond=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(_A(x), _A(y), rcond=rcond)
+    return sol, res, rank, sv
+
+
+@primitive
+def multi_dot(xs):
+    return jnp.linalg.multi_dot([_A(x) for x in xs])
+
+
+@primitive
+def histogram(x, bins=100, min=0, max=0):
+    x = _A(x).reshape(-1)
+    if min == 0 and max == 0:
+        lo, hi = jnp.min(x), jnp.max(x)
+    else:
+        lo, hi = min, max
+    hist, _ = jnp.histogram(x, bins=bins, range=(lo, hi))
+    return hist
+
+
+@primitive(nondiff=True)
+def bincount(x, weights=None, minlength=0):
+    x = _A(x).astype(jnp.int32)
+    length = max(int(minlength), int(jax.device_get(jnp.max(x))) + 1 if x.size else int(minlength))
+    return jnp.bincount(x, weights=None if weights is None else _A(weights), length=length)
+
+
+@primitive
+def corrcoef(x, rowvar=True):
+    return jnp.corrcoef(_A(x), rowvar=rowvar)
+
+
+@primitive
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None):
+    return jnp.cov(_A(x), rowvar=rowvar, ddof=1 if ddof else 0,
+                   fweights=fweights, aweights=aweights)
+
+
+@primitive
+def tensordot(x, y, axes=2):
+    return jnp.tensordot(_A(x), _A(y), axes=axes)
+
+
+def einsum(equation, *operands):
+    from ..core.dispatch import primitive as _p
+
+    return _einsum(list(operands), equation=equation)
+
+
+@primitive(name="einsum")
+def _einsum(operands, equation):
+    return jnp.einsum(equation, *[_A(o) for o in operands])

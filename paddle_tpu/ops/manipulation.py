@@ -1,0 +1,451 @@
+"""Shape / layout manipulation ops.
+
+Parity: reference python/paddle/tensor/manipulation.py + phi kernels
+(concat, split, gather, scatter, transpose, ...). All static-shape; ops whose
+output shape is data-dependent in the reference (nonzero, masked_select,
+unique) here follow XLA conventions: they either take a static `size` hint or
+run un-jitted on host — documented per-op.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import primitive
+from ..core.tensor import Tensor
+
+_A = jnp.asarray
+
+
+def _shape_of(x):
+    return jnp.shape(x)
+
+
+@primitive
+def reshape(x, shape):
+    x = _A(x)
+    shape = [int(s) for s in shape]
+    return jnp.reshape(x, shape)
+
+
+@primitive
+def transpose(x, perm):
+    return jnp.transpose(_A(x), axes=[int(p) for p in perm])
+
+
+def t(x):
+    nd = x.ndim if isinstance(x, Tensor) else jnp.ndim(x)
+    if nd < 2:
+        return x if isinstance(x, Tensor) else Tensor(_A(x))
+    return transpose(x, list(range(nd))[::-1])
+
+
+@primitive
+def concat(xs, axis=0):
+    return jnp.concatenate([_A(x) for x in xs], axis=int(axis))
+
+
+@primitive
+def stack(xs, axis=0):
+    return jnp.stack([_A(x) for x in xs], axis=int(axis))
+
+
+@primitive
+def _split_impl(x, sections, axis):
+    x = _A(x)
+    if isinstance(sections, int):
+        return tuple(jnp.split(x, sections, axis=axis))
+    # sections is a list of sizes; -1 means "the rest"
+    sizes = list(sections)
+    total = x.shape[axis]
+    if -1 in sizes:
+        known = sum(s for s in sizes if s != -1)
+        sizes[sizes.index(-1)] = total - known
+    offsets = np.cumsum(sizes)[:-1].tolist()
+    return tuple(jnp.split(x, offsets, axis=axis))
+
+
+def split(x, num_or_sections, axis=0):
+    out = _split_impl(x, sections=num_or_sections, axis=int(axis))
+    return list(out) if isinstance(out, tuple) else [out]
+
+
+def chunk(x, chunks, axis=0):
+    return split(x, chunks, axis)
+
+
+def unbind(x, axis=0):
+    n = x.shape[axis] if isinstance(x, Tensor) else jnp.shape(x)[axis]
+    parts = split(x, n, axis)
+    return [squeeze(p, axis=axis) for p in parts]
+
+
+@primitive
+def squeeze(x, axis=None):
+    x = _A(x)
+    if axis is None:
+        return jnp.squeeze(x)
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    axes = tuple(a % x.ndim for a in axes if x.shape[a % x.ndim] == 1)
+    return jnp.squeeze(x, axis=axes) if axes else x
+
+
+@primitive
+def unsqueeze(x, axis):
+    x = _A(x)
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    out = x
+    for a in sorted(int(a) if a >= 0 else int(a) + out.ndim + 1 for a in axes):
+        out = jnp.expand_dims(out, a)
+    return out
+
+
+@primitive
+def flatten(x, start_axis=0, stop_axis=-1):
+    x = _A(x)
+    nd = x.ndim
+    if nd == 0:
+        return x.reshape(1)
+    s, e = start_axis % nd, stop_axis % nd
+    new_shape = x.shape[:s] + (-1,) + x.shape[e + 1:]
+    return x.reshape(new_shape)
+
+
+@primitive
+def tile(x, repeat_times):
+    return jnp.tile(_A(x), tuple(int(r) for r in repeat_times))
+
+
+@primitive
+def expand(x, shape):
+    x = _A(x)
+    shape = list(shape)
+    # paddle allows -1 meaning "keep this dim"
+    xs = (1,) * (len(shape) - x.ndim) + x.shape
+    shape = [xs[i] if s == -1 else int(s) for i, s in enumerate(shape)]
+    return jnp.broadcast_to(x, shape)
+
+
+def expand_as(x, y):
+    return expand(x, y.shape if isinstance(y, Tensor) else jnp.shape(y))
+
+
+def broadcast_to(x, shape):
+    return expand(x, shape)
+
+
+def broadcast_tensors(inputs):
+    shapes = [tuple(i.shape) for i in inputs]
+    out_shape = np.broadcast_shapes(*shapes)
+    return [expand(i, list(out_shape)) for i in inputs]
+
+
+@primitive
+def flip(x, axis):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    return jnp.flip(_A(x), axis=tuple(int(a) for a in axes))
+
+
+@primitive
+def roll(x, shifts, axis=None):
+    return jnp.roll(_A(x), shifts, axis=axis)
+
+
+@primitive
+def rot90(x, k=1, axes=(0, 1)):
+    return jnp.rot90(_A(x), k=k, axes=tuple(axes))
+
+
+@primitive
+def gather(x, index, axis=0):
+    return jnp.take(_A(x), _A(index).astype(jnp.int32), axis=int(axis))
+
+
+@primitive
+def index_select(x, index, axis=0):
+    return jnp.take(_A(x), _A(index).astype(jnp.int32), axis=int(axis))
+
+
+@primitive
+def gather_nd(x, index):
+    x, index = _A(x), _A(index).astype(jnp.int32)
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x[idx]
+
+
+@primitive
+def take_along_axis(x, indices, axis):
+    return jnp.take_along_axis(_A(x), _A(indices).astype(jnp.int32), axis=int(axis))
+
+
+@primitive
+def put_along_axis(x, indices, values, axis, reduce="assign"):
+    x = _A(x)
+    indices = _A(indices).astype(jnp.int32)
+    values = jnp.broadcast_to(_A(values), indices.shape).astype(x.dtype)
+    dnums = [jnp.arange(s) for s in indices.shape]
+    grids = jnp.meshgrid(*dnums, indexing="ij")
+    idx = tuple(
+        indices if d == axis % x.ndim else g for d, g in enumerate(grids)
+    )
+    if reduce == "assign":
+        return x.at[idx].set(values)
+    if reduce in ("add", "sum"):
+        return x.at[idx].add(values)
+    if reduce in ("mul", "multiply"):
+        return x.at[idx].multiply(values)
+    raise ValueError("unsupported reduce %r" % reduce)
+
+
+@primitive
+def scatter(x, index, updates, overwrite=True):
+    x = _A(x)
+    index = _A(index).astype(jnp.int32).reshape(-1)
+    updates = _A(updates)
+    if overwrite:
+        return x.at[index].set(updates)
+    # paddle overwrite=False: zero the rows then accumulate
+    zeroed = x.at[index].set(jnp.zeros_like(updates))
+    return zeroed.at[index].add(updates)
+
+
+@primitive
+def scatter_nd_add(x, index, updates):
+    x = _A(x)
+    index = _A(index).astype(jnp.int32)
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x.at[idx].add(_A(updates))
+
+
+def scatter_nd(index, updates, shape):
+    from .creation import zeros
+
+    base = zeros(shape, dtype=updates.dtype)
+    return scatter_nd_add(base, index, updates)
+
+
+@primitive
+def where(condition, x=None, y=None):
+    return jnp.where(_A(condition), _A(x), _A(y))
+
+
+@primitive
+def masked_fill(x, mask, value):
+    return jnp.where(_A(mask), value, _A(x))
+
+
+def masked_select(x, mask):
+    """Data-dependent output shape: executes on host (un-jitted), like the
+    reference's masked_select (phi/kernels/masked_select_kernel.h)."""
+    xv = np.asarray(x.numpy() if isinstance(x, Tensor) else x)
+    mv = np.asarray(mask.numpy() if isinstance(mask, Tensor) else mask)
+    return Tensor(jnp.asarray(xv[mv.astype(bool)]))
+
+
+def nonzero(x, as_tuple=False):
+    """Data-dependent output shape: host fallback (reference where_index)."""
+    xv = np.asarray(x.numpy() if isinstance(x, Tensor) else x)
+    nz = np.nonzero(xv)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(n.astype(np.int64))) for n in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1).astype(np.int64)))
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None):
+    xv = np.asarray(x.numpy() if isinstance(x, Tensor) else x)
+    res = np.unique(
+        xv,
+        return_index=return_index,
+        return_inverse=return_inverse,
+        return_counts=return_counts,
+        axis=axis,
+    )
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    return tuple(Tensor(jnp.asarray(r)) for r in res)
+
+
+@primitive
+def sort(x, axis=-1, descending=False):
+    out = jnp.sort(_A(x), axis=int(axis))
+    if descending:
+        out = jnp.flip(out, axis=int(axis))
+    return out
+
+
+@primitive(nondiff=True)
+def argsort(x, axis=-1, descending=False):
+    x = _A(x)
+    out = jnp.argsort(x, axis=int(axis))
+    if descending:
+        out = jnp.flip(out, axis=int(axis))
+    return out.astype(jnp.int64)
+
+
+@primitive
+def topk(x, k, axis=-1, largest=True, sorted=True):
+    x = _A(x)
+    axis = int(axis) % x.ndim
+    xm = jnp.moveaxis(x, axis, -1)
+    if largest:
+        vals, idx = jax.lax.top_k(xm, int(k))
+    else:
+        vals, idx = jax.lax.top_k(-xm, int(k))
+        vals = -vals
+    return (
+        jnp.moveaxis(vals, -1, axis),
+        jnp.moveaxis(idx.astype(jnp.int64), -1, axis),
+    )
+
+
+def kthvalue(x, k, axis=-1, keepdim=False):
+    vals = sort(x, axis=axis)
+    idx = argsort(x, axis=axis)
+    from . import manipulation as m
+
+    sel_v = m.slice_(vals, axes=[axis], starts=[k - 1], ends=[k])
+    sel_i = m.slice_(idx, axes=[axis], starts=[k - 1], ends=[k])
+    if not keepdim:
+        sel_v = squeeze(sel_v, axis=axis)
+        sel_i = squeeze(sel_i, axis=axis)
+    return sel_v, sel_i
+
+
+@primitive(name="slice")
+def slice_(x, axes, starts, ends):
+    x = _A(x)
+    idx = [slice(None)] * x.ndim
+    for a, s, e in zip(axes, starts, ends):
+        idx[a] = slice(int(s), int(e))
+    return x[tuple(idx)]
+
+
+@primitive
+def strided_slice(x, axes, starts, ends, strides):
+    x = _A(x)
+    idx = [slice(None)] * x.ndim
+    for a, s, e, st in zip(axes, starts, ends, strides):
+        idx[a] = slice(int(s), int(e), int(st))
+    return x[tuple(idx)]
+
+
+@primitive
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW"):
+    x = _A(x)
+    pad = [int(p) for p in pad]
+    if len(pad) == 2 * x.ndim:
+        widths = [(pad[2 * i], pad[2 * i + 1]) for i in range(x.ndim)]
+    else:
+        # paddle convention: pad applies to the *last* len(pad)//2 spatial dims
+        # (reversed pairs), e.g. NCHW with pad=[l,r,t,b]
+        n_spatial = len(pad) // 2
+        widths = [(0, 0)] * (x.ndim - n_spatial)
+        pairs = [(pad[2 * i], pad[2 * i + 1]) for i in range(n_spatial)]
+        widths += list(reversed(pairs)) if data_format in ("NCHW", "NCL", "NCDHW") else list(reversed(pairs))
+    jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge",
+             "circular": "wrap"}[mode]
+    if jmode == "constant":
+        return jnp.pad(x, widths, mode=jmode, constant_values=value)
+    return jnp.pad(x, widths, mode=jmode)
+
+
+@primitive
+def repeat_interleave(x, repeats, axis=None):
+    x = _A(x)
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    r = repeats if isinstance(repeats, int) else _A(repeats)
+    total = None
+    if not isinstance(repeats, int):
+        total = int(np.sum(np.asarray(repeats)))
+    return jnp.repeat(x, r, axis=int(axis), total_repeat_length=total)
+
+
+@primitive
+def moveaxis(x, source, destination):
+    return jnp.moveaxis(_A(x), source, destination)
+
+
+@primitive
+def swapaxes(x, axis0, axis1):
+    return jnp.swapaxes(_A(x), int(axis0), int(axis1))
+
+
+@primitive(nondiff=True)
+def searchsorted(sorted_sequence, values, out_int32=False, right=False):
+    out = jnp.searchsorted(
+        _A(sorted_sequence), _A(values), side="right" if right else "left"
+    )
+    return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+
+@primitive(nondiff=True)
+def bucketize(x, sorted_sequence, out_int32=False, right=False):
+    out = jnp.searchsorted(
+        _A(sorted_sequence), _A(x), side="right" if right else "left"
+    )
+    return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+
+@primitive(nondiff=True)
+def one_hot(x, num_classes):
+    return jax.nn.one_hot(_A(x).astype(jnp.int32), int(num_classes), dtype=jnp.float32)
+
+
+@primitive
+def index_add(x, index, axis, value):
+    x = _A(x)
+    index = _A(index).astype(jnp.int32)
+    value = _A(value)
+    x_m = jnp.moveaxis(x, axis, 0)
+    v_m = jnp.moveaxis(value, axis, 0)
+    out = x_m.at[index].add(v_m)
+    return jnp.moveaxis(out, 0, axis)
+
+
+@primitive
+def index_put(x, indices, value, accumulate=False):
+    x = _A(x)
+    idx = tuple(_A(i) for i in indices)
+    if accumulate:
+        return x.at[idx].add(_A(value))
+    return x.at[idx].set(jnp.broadcast_to(_A(value), x[idx].shape).astype(x.dtype))
+
+
+@primitive
+def as_strided(x, shape, stride, offset=0):
+    x = _A(x).reshape(-1)
+    idx = jnp.arange(int(np.prod(shape))).reshape(shape)
+    flat = offset
+    coords = jnp.unravel_index(idx.reshape(-1), shape)
+    lin = offset + sum(c * s for c, s in zip(coords, stride))
+    return x[lin].reshape(shape)
+
+
+@primitive
+def diff(x, n=1, axis=-1):
+    return jnp.diff(_A(x), n=n, axis=axis)
+
+
+@primitive
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1):
+    """im2col (reference phi/kernels/unfold_kernel). x: [N,C,H,W]."""
+    x = _A(x)
+    ks = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) else [kernel_sizes] * 2
+    st = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    pd = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 2
+    dl = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+    if len(pd) == 2:
+        pd = [pd[0], pd[0], pd[1], pd[1]]
+    N, C, H, W = x.shape
+    x = jnp.pad(x, ((0, 0), (0, 0), (pd[0], pd[1]), (pd[2], pd[3])))
+    patches = jax.lax.conv_general_dilated_patches(
+        x, filter_shape=tuple(ks), window_strides=tuple(st),
+        padding="VALID", rhs_dilation=tuple(dl),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+    )
+    n, ckk, oh, ow = patches.shape
+    return patches.reshape(n, ckk, oh * ow)

@@ -1,0 +1,279 @@
+"""Elementwise & general math ops.
+
+Parity targets: reference python/paddle/tensor/math.py and the PHI kernels in
+/root/reference/paddle/phi/kernels/ (elementwise_*, activation, scale, ...).
+Every op is a pure jnp/lax expression — XLA fuses chains of these into single
+HBM-bandwidth-bound kernels, which is the TPU answer to the reference's
+hand-fused CUDA elementwise kernels (kernels/funcs/elementwise_base.h).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import primitive
+from ..core.tensor import Tensor
+
+_A = jnp.asarray
+
+
+def _binop(name, fn):
+    @primitive(name=name)
+    def op(x, y):
+        return fn(_A(x), _A(y))
+
+    return op
+
+
+add = _binop("add", jnp.add)
+subtract = _binop("subtract", jnp.subtract)
+multiply = _binop("multiply", jnp.multiply)
+divide = _binop("divide", jnp.true_divide)
+floor_divide = _binop("floor_divide", jnp.floor_divide)
+remainder = _binop("remainder", jnp.remainder)
+mod = remainder
+floor_mod = remainder
+maximum = _binop("maximum", jnp.maximum)
+minimum = _binop("minimum", jnp.minimum)
+fmax = _binop("fmax", jnp.fmax)
+fmin = _binop("fmin", jnp.fmin)
+pow_ = _binop("pow", jnp.power)
+atan2 = _binop("atan2", jnp.arctan2)
+heaviside = _binop("heaviside", jnp.heaviside)
+nextafter = _binop("nextafter", jnp.nextafter)
+hypot = _binop("hypot", jnp.hypot)
+copysign = _binop("copysign", jnp.copysign)
+gcd = _binop("gcd", jnp.gcd)
+lcm = _binop("lcm", jnp.lcm)
+logaddexp = _binop("logaddexp", jnp.logaddexp)
+
+
+def pow(x, y):  # noqa: A001 — paddle.pow
+    return pow_(x, y)
+
+
+def _unop(name, fn):
+    @primitive(name=name)
+    def op(x):
+        return fn(_A(x))
+
+    return op
+
+
+abs = _unop("abs", jnp.abs)  # noqa: A001
+neg = _unop("neg", jnp.negative)
+exp = _unop("exp", jnp.exp)
+expm1 = _unop("expm1", jnp.expm1)
+log = _unop("log", jnp.log)
+log2 = _unop("log2", jnp.log2)
+log10 = _unop("log10", jnp.log10)
+log1p = _unop("log1p", jnp.log1p)
+sqrt = _unop("sqrt", jnp.sqrt)
+rsqrt = _unop("rsqrt", lambda x: jax.lax.rsqrt(x))
+square = _unop("square", jnp.square)
+sin = _unop("sin", jnp.sin)
+cos = _unop("cos", jnp.cos)
+tan = _unop("tan", jnp.tan)
+asin = _unop("asin", jnp.arcsin)
+acos = _unop("acos", jnp.arccos)
+atan = _unop("atan", jnp.arctan)
+sinh = _unop("sinh", jnp.sinh)
+cosh = _unop("cosh", jnp.cosh)
+tanh = _unop("tanh", jnp.tanh)
+asinh = _unop("asinh", jnp.arcsinh)
+acosh = _unop("acosh", jnp.arccosh)
+atanh = _unop("atanh", jnp.arctanh)
+floor = _unop("floor", jnp.floor)
+ceil = _unop("ceil", jnp.ceil)
+round_ = _unop("round", jnp.round)
+trunc = _unop("trunc", jnp.trunc)
+frac = _unop("frac", lambda x: x - jnp.trunc(x))
+sign = _unop("sign", jnp.sign)
+reciprocal = _unop("reciprocal", jnp.reciprocal)
+erf = _unop("erf", jax.scipy.special.erf)
+erfinv = _unop("erfinv", jax.scipy.special.erfinv)
+lgamma = _unop("lgamma", jax.scipy.special.gammaln)
+digamma = _unop("digamma", jax.scipy.special.digamma)
+i0 = _unop("i0", jnp.i0)
+sigmoid = _unop("sigmoid", jax.nn.sigmoid)
+rad2deg = _unop("rad2deg", jnp.rad2deg)
+deg2rad = _unop("deg2rad", jnp.deg2rad)
+angle = _unop("angle", jnp.angle)
+conj = _unop("conj", jnp.conj)
+real = _unop("real", jnp.real)
+imag = _unop("imag", jnp.imag)
+
+
+def round(x):  # noqa: A001
+    return round_(x)
+
+
+@primitive
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None):
+    x = _A(x)
+    out = x * scale + bias if bias_after_scale else (x + bias) * scale
+    return out
+
+
+@primitive
+def clip(x, min=None, max=None):
+    return jnp.clip(_A(x), min, max)
+
+
+@primitive
+def lerp(x, y, weight):
+    x, y = _A(x), _A(y)
+    return x + _A(weight) * (y - x)
+
+
+@primitive
+def stanh(x, scale_a=0.67, scale_b=1.7159):
+    return scale_b * jnp.tanh(scale_a * _A(x))
+
+
+@primitive
+def logit(x, eps=None):
+    x = _A(x)
+    if eps is not None:
+        x = jnp.clip(x, eps, 1.0 - eps)
+    return jnp.log(x / (1.0 - x))
+
+
+@primitive
+def multiply_add(x, y, z):
+    return _A(x) * _A(y) + _A(z)
+
+
+@primitive
+def addmm(input, x, y, beta=1.0, alpha=1.0):
+    return beta * _A(input) + alpha * jnp.matmul(_A(x), _A(y))
+
+
+@primitive
+def matmul(x, y, transpose_x=False, transpose_y=False):
+    x, y = _A(x), _A(y)
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    return jnp.matmul(x, y)
+
+
+@primitive
+def dot(x, y):
+    x, y = _A(x), _A(y)
+    return jnp.sum(x * y, axis=-1)
+
+
+@primitive
+def mm(x, y):
+    return jnp.matmul(_A(x), _A(y))
+
+
+@primitive
+def bmm(x, y):
+    return jnp.matmul(_A(x), _A(y))
+
+
+@primitive
+def mv(x, vec):
+    return jnp.matmul(_A(x), _A(vec))
+
+
+@primitive
+def inner(x, y):
+    return jnp.inner(_A(x), _A(y))
+
+
+@primitive
+def outer(x, y):
+    return jnp.outer(_A(x), _A(y))
+
+
+@primitive
+def kron(x, y):
+    return jnp.kron(_A(x), _A(y))
+
+
+@primitive
+def cross(x, y, axis=9):
+    ax = axis if axis != 9 else (next(
+        (i for i, s in enumerate(jnp.shape(_A(x))) if s == 3), -1))
+    return jnp.cross(_A(x), _A(y), axis=ax)
+
+
+@primitive
+def trace(x, offset=0, axis1=0, axis2=1):
+    return jnp.trace(_A(x), offset=offset, axis1=axis1, axis2=axis2)
+
+
+@primitive
+def diagonal(x, offset=0, axis1=0, axis2=1):
+    return jnp.diagonal(_A(x), offset=offset, axis1=axis1, axis2=axis2)
+
+
+@primitive
+def cumsum(x, axis=None, dtype=None):
+    x = _A(x)
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return jnp.cumsum(x, axis=axis)
+
+
+@primitive
+def cumprod(x, dim=None, dtype=None):
+    x = _A(x)
+    if dim is None:
+        x = x.reshape(-1)
+        dim = 0
+    return jnp.cumprod(x, axis=dim)
+
+
+@primitive
+def cummax_values(x, axis=-1):
+    return jax.lax.cummax(_A(x), axis=axis)
+
+
+@primitive
+def cummin_values(x, axis=-1):
+    return jax.lax.cummin(_A(x), axis=axis)
+
+
+@primitive
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None):
+    return jnp.nan_to_num(_A(x), nan=nan, posinf=posinf, neginf=neginf)
+
+
+# non-differentiable predicates
+@primitive(nondiff=True)
+def isnan(x):
+    return jnp.isnan(_A(x))
+
+
+@primitive(nondiff=True)
+def isinf(x):
+    return jnp.isinf(_A(x))
+
+
+@primitive(nondiff=True)
+def isfinite(x):
+    return jnp.isfinite(_A(x))
+
+
+@primitive
+def increment(x, value=1.0):
+    return _A(x) + value
+
+
+@primitive
+def cast(x, dtype):
+    from ..core import dtype as _dt
+
+    return _A(x).astype(_dt.to_jax(dtype))
+
+
+def astype(x, dtype):
+    return cast(x, dtype=dtype)

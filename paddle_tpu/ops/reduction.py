@@ -1,0 +1,126 @@
+"""Reduction ops.
+
+Parity: reference python/paddle/tensor/math.py (sum/mean/...) and
+phi/kernels/reduce_*. XLA lowers these to MXU/VPU-friendly tree reductions;
+the reference's KernelPrimitive reduce machinery is unnecessary.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import primitive
+
+_A = jnp.asarray
+
+
+def _norm_axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def _reduce(name, fn, nondiff=False):
+    @primitive(name=name, nondiff=nondiff)
+    def op(x, axis=None, keepdim=False):
+        return fn(_A(x), axis=_norm_axis(axis), keepdims=keepdim)
+
+    return op
+
+
+sum_ = _reduce("sum", jnp.sum)
+mean = _reduce("mean", jnp.mean)
+prod = _reduce("prod", jnp.prod)
+max_ = _reduce("max", jnp.max)
+min_ = _reduce("min", jnp.min)
+amax = _reduce("amax", jnp.max)
+amin = _reduce("amin", jnp.min)
+nansum = _reduce("nansum", jnp.nansum)
+nanmean = _reduce("nanmean", jnp.nanmean)
+all_ = _reduce("all", jnp.all, nondiff=True)
+any_ = _reduce("any", jnp.any, nondiff=True)
+
+
+def sum(x, axis=None, keepdim=False, dtype=None):  # noqa: A001
+    out = sum_(x, axis=axis, keepdim=keepdim)
+    if dtype is not None:
+        from .math import cast
+
+        out = cast(out, dtype=dtype)
+    return out
+
+
+def max(x, axis=None, keepdim=False):  # noqa: A001
+    return max_(x, axis=axis, keepdim=keepdim)
+
+
+def min(x, axis=None, keepdim=False):  # noqa: A001
+    return min_(x, axis=axis, keepdim=keepdim)
+
+
+def all(x, axis=None, keepdim=False):  # noqa: A001
+    return all_(x, axis=axis, keepdim=keepdim)
+
+
+def any(x, axis=None, keepdim=False):  # noqa: A001
+    return any_(x, axis=axis, keepdim=keepdim)
+
+
+@primitive
+def std(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.std(_A(x), axis=_norm_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+@primitive
+def var(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.var(_A(x), axis=_norm_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+@primitive
+def logsumexp(x, axis=None, keepdim=False):
+    return jax.scipy.special.logsumexp(_A(x), axis=_norm_axis(axis), keepdims=keepdim)
+
+
+@primitive
+def median(x, axis=None, keepdim=False):
+    return jnp.median(_A(x), axis=_norm_axis(axis), keepdims=keepdim)
+
+
+@primitive
+def quantile(x, q, axis=None, keepdim=False):
+    return jnp.quantile(_A(x), jnp.asarray(q), axis=_norm_axis(axis), keepdims=keepdim)
+
+
+@primitive(nondiff=True)
+def argmax(x, axis=None, keepdim=False, dtype="int64"):
+    from ..core import dtype as _dt
+
+    x = _A(x)
+    if axis is None:
+        out = jnp.argmax(x.reshape(-1), axis=0)
+        if keepdim:
+            out = out.reshape((1,) * x.ndim)
+        return out.astype(_dt.to_jax(dtype))
+    out = jnp.argmax(x, axis=int(axis), keepdims=keepdim)
+    return out.astype(_dt.to_jax(dtype))
+
+
+@primitive(nondiff=True)
+def argmin(x, axis=None, keepdim=False, dtype="int64"):
+    from ..core import dtype as _dt
+
+    x = _A(x)
+    if axis is None:
+        out = jnp.argmin(x.reshape(-1), axis=0)
+        if keepdim:
+            out = out.reshape((1,) * x.ndim)
+        return out.astype(_dt.to_jax(dtype))
+    out = jnp.argmin(x, axis=int(axis), keepdims=keepdim)
+    return out.astype(_dt.to_jax(dtype))
+
+
+@primitive(nondiff=True)
+def count_nonzero(x, axis=None, keepdim=False):
+    return jnp.count_nonzero(_A(x), axis=_norm_axis(axis), keepdims=keepdim).astype(jnp.int64)

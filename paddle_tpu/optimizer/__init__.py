@@ -1,0 +1,19 @@
+"""paddle.optimizer namespace."""
+from . import lr  # noqa: F401
+from .clip import (  # noqa: F401
+    ClipGradByGlobalNorm,
+    ClipGradByNorm,
+    ClipGradByValue,
+)
+from .optimizer import L1Decay, L2Decay, Optimizer  # noqa: F401
+from .optimizers import (  # noqa: F401
+    SGD,
+    Adadelta,
+    Adagrad,
+    Adam,
+    Adamax,
+    AdamW,
+    Lamb,
+    Momentum,
+    RMSProp,
+)

@@ -1,0 +1,64 @@
+"""Gradient clipping (reference python/paddle/fluid/clip.py:
+ClipGradByValue/Norm/GlobalNorm). Under hybrid parallel the global norm
+is reduced across mesh axes by the distributed optimizer
+(reference hybrid_parallel_optimizer.py:_dygraph_clip)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = max
+        self.min = -max if min is None else min
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            gv = g._value if isinstance(g, Tensor) else g
+            out.append((p, Tensor(jnp.clip(gv, self.min, self.max))))
+        return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = clip_norm
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            gv = g._value if isinstance(g, Tensor) else g
+            n = jnp.linalg.norm(gv.astype(jnp.float32))
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(n, 1e-12), 1.0)
+            out.append((p, Tensor((gv * scale).astype(gv.dtype))))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group"):
+        self.clip_norm = clip_norm
+
+    def global_norm(self, grads):
+        sq = sum(
+            jnp.sum(jnp.square((g._value if isinstance(g, Tensor) else g)
+                               .astype(jnp.float32)))
+            for g in grads
+        )
+        return jnp.sqrt(sq)
+
+    def __call__(self, params_grads):
+        gn = self.global_norm([g for _, g in params_grads])
+        scale = jnp.minimum(self.clip_norm / jnp.maximum(gn, 1e-12), 1.0)
+        out = []
+        for p, g in params_grads:
+            gv = g._value if isinstance(g, Tensor) else g
+            out.append((p, Tensor((gv.astype(jnp.float32) * scale)
+                                  .astype(gv.dtype))))
+        return out

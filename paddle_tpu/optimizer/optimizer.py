@@ -1,0 +1,225 @@
+"""Optimizer base.
+
+Parity: reference python/paddle/optimizer/optimizer.py (`_create_accumulators`
+/ `_append_optimize_op` structure) — but each rule is a *pure* update function
+`_update(p, g, state, lr) -> (new_p, new_state)`, so the same rule runs
+eagerly per-tensor AND inside a jitted/pjit'd training step (the functional
+bridge used by jit.to_static and distributed training).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import no_grad
+from ..core.tensor import Tensor
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        from .lr import LRScheduler
+
+        self._lr_scheduler = None
+        if isinstance(learning_rate, LRScheduler):
+            self._lr_scheduler = learning_rate
+            self._base_lr = learning_rate()
+        else:
+            self._base_lr = float(learning_rate)
+        if parameters is not None:
+            self._parameter_list = list(parameters)
+        else:
+            self._parameter_list = None
+        self._weight_decay = weight_decay
+        self._grad_clip = grad_clip
+        self._accumulators = {}  # (slot, id(param)) -> jax array
+        self._global_step = 0
+
+    # -- public API --------------------------------------------------------
+    def get_lr(self):
+        if self._lr_scheduler is not None:
+            return float(self._lr_scheduler())
+        return self._base_lr
+
+    def set_lr(self, value):
+        if self._lr_scheduler is not None:
+            raise RuntimeError(
+                "set_lr is not allowed when learning rate is an LRScheduler")
+        self._base_lr = float(value)
+
+    @no_grad()
+    def step(self):
+        params = self._get_params()
+        grads = [p.grad for p in params]
+        pg = [(p, g) for p, g in zip(params, grads) if g is not None]
+        if self._grad_clip is not None:
+            clipped = self._grad_clip([(p, g) for p, g in pg])
+            pg = clipped
+        lr = self.get_lr()
+        self._global_step += 1
+        for p, g in pg:
+            self._apply_one(p, g._value if isinstance(g, Tensor) else g, lr)
+
+    minimize_step = step
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, None
+
+    @no_grad()
+    def clear_grad(self, set_to_zero=False):
+        for p in self._get_params():
+            p.grad = None
+
+    clear_gradients = clear_grad
+
+    # -- state -------------------------------------------------------------
+    def _stable_pid(self, pid):
+        """Map a live id(param) to a process-stable key: the parameter's
+        index in the parameter list (falls back to the raw id)."""
+        if self._parameter_list is not None:
+            for i, p in enumerate(self._parameter_list):
+                if id(p) == pid:
+                    return str(i)
+        return str(pid)
+
+    def state_dict(self):
+        sd = {}
+        for (slot, pid), v in self._accumulators.items():
+            sd["%s/%s" % (slot, self._stable_pid(pid))] = Tensor(v)
+        sd["global_step"] = self._global_step
+        if self._lr_scheduler is not None:
+            sd["LR_Scheduler"] = self._lr_scheduler.state_dict()
+        return sd
+
+    def set_state_dict(self, sd):
+        for k, v in sd.items():
+            if k == "global_step":
+                self._global_step = int(v)
+            elif k == "LR_Scheduler":
+                self._lr_scheduler.set_state_dict(v)
+            elif "/" in k:
+                slot, key = k.rsplit("/", 1)
+                pid = None
+                if (self._parameter_list is not None and key.isdigit()
+                        and int(key) < len(self._parameter_list)):
+                    pid = id(self._parameter_list[int(key)])
+                if pid is None:
+                    continue
+                self._accumulators[(slot, pid)] = (
+                    v._value if isinstance(v, Tensor) else jnp.asarray(v))
+
+    # -- machinery ---------------------------------------------------------
+    def _get_params(self):
+        if self._parameter_list is None:
+            raise ValueError(
+                "Optimizer created without a parameters list; pass "
+                "parameters=model.parameters()")
+        return [p for p in self._parameter_list if not p.stop_gradient]
+
+    def _slots(self):
+        """Accumulator slot names, e.g. ('moment1','moment2')."""
+        return ()
+
+    def _init_slot(self, slot, param):
+        return jnp.zeros_like(param._value)
+
+    def _get_state(self, param):
+        vals = []
+        for slot in self._slots():
+            key = (slot, id(param))
+            if key not in self._accumulators:
+                self._accumulators[key] = self._init_slot(slot, param)
+            vals.append(self._accumulators[key])
+        return vals
+
+    def _set_state(self, param, vals):
+        for slot, v in zip(self._slots(), vals):
+            self._accumulators[(slot, id(param))] = v
+
+    def _apply_one(self, param, grad_val, lr):
+        state = self._get_state(param)
+        wd = self._decay_for(param)
+        new_p, new_state = self._jit_update()(
+            param._value, jnp.asarray(grad_val, param._value.dtype),
+            tuple(state), jnp.asarray(lr, jnp.float32),
+            jnp.asarray(self._global_step, jnp.int32), float(wd))
+        param._value = new_p
+        self._set_state(param, list(new_state))
+
+    def _decay_for(self, param):
+        return self._weight_decay_value()
+
+    def _make_update(self):
+        """Return the pure update rule fn(p, g, state, lr, step, wd) with
+        instance hyperparameters closed over. Default: the class's static
+        rule. Both the eager per-tensor path and functional_apply use THIS,
+        so eager and compiled training share one set of math."""
+        return self.__class__._update
+
+    def _jit_update(self):
+        # wd (arg 5) is static: the rules branch on "is decay enabled";
+        # cached per-instance so hyperparameters are never shared across
+        # sibling optimizers
+        cache = getattr(self, "_jit_cache_inst", None)
+        if cache is None:
+            cache = jax.jit(self._make_update(), static_argnums=(5,))
+            self._jit_cache_inst = cache
+        return cache
+
+    @staticmethod
+    def _update(p, g, state, lr, step, wd):
+        raise NotImplementedError
+
+    # functional bridge for compiled training steps ------------------------
+    def functional_init(self, params_dict):
+        """Return optimizer state pytree for the given {name: array} params."""
+        return {
+            name: [self._init_slot(slot, Tensor(v)) for slot in self._slots()]
+            for name, v in params_dict.items()
+        }
+
+    def functional_apply(self, params_dict, grads_dict, opt_state, lr=None,
+                         step=0):
+        """Pure update over {name: array} pytrees (for jit/pjit steps)."""
+        lr = jnp.asarray(self.get_lr() if lr is None else lr, jnp.float32)
+        update = self._make_update()
+        new_params, new_state = {}, {}
+        for name, p in params_dict.items():
+            g = grads_dict.get(name)
+            if g is None:
+                new_params[name] = p
+                new_state[name] = opt_state[name]
+                continue
+            np_, ns = update(
+                p, g, tuple(opt_state[name]), lr,
+                jnp.asarray(step, jnp.int32), self._decay_for_name(name))
+            new_params[name] = np_
+            new_state[name] = list(ns)
+        return new_params, new_state
+
+    def _decay_for_name(self, name):
+        """Per-parameter decay by structured name (compiled path hook)."""
+        return self._weight_decay_value()
+
+    def _weight_decay_value(self):
+        wd = self._weight_decay
+        if wd is None:
+            return 0.0
+        if isinstance(wd, (int, float)):
+            return float(wd)
+        return float(getattr(wd, "_coeff", 0.0))
+
+
+class L2Decay:
+    """paddle.regularizer.L2Decay analog."""
+
+    def __init__(self, coeff=0.0):
+        self._coeff = coeff
+
+
+class L1Decay:
+    def __init__(self, coeff=0.0):
+        self._coeff = coeff

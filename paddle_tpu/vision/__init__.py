@@ -1,0 +1,2 @@
+"""paddle.vision (reference python/paddle/vision/)."""
+from . import datasets, models, ops, transforms  # noqa: F401

@@ -1,0 +1,135 @@
+"""Vision datasets (reference python/paddle/vision/datasets/).
+
+Zero-egress environment: loaders read local files when present
+(MNIST idx / CIFAR pickle formats identical to the reference's), and every
+dataset offers `synthetic=True` generating deterministic fake data with the
+right shapes — the pattern the reference tests use for CI without data."""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+
+import numpy as np
+
+from ..io import Dataset
+
+
+class MNIST(Dataset):
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend=None,
+                 synthetic=None, size=1024):
+        self.transform = transform
+        self.mode = mode
+        if synthetic is None:
+            synthetic = image_path is None or not os.path.exists(image_path)
+        if synthetic:
+            rng = np.random.RandomState(0 if mode == "train" else 1)
+            self.images = (rng.rand(size, 28, 28) * 255).astype(np.uint8)
+            self.labels = rng.randint(0, 10, size).astype(np.int64)
+        else:
+            self.images = self._read_images(image_path)
+            self.labels = self._read_labels(label_path)
+
+    @staticmethod
+    def _read_images(path):
+        opener = gzip.open if path.endswith(".gz") else open
+        with opener(path, "rb") as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            data = np.frombuffer(f.read(), dtype=np.uint8)
+            return data.reshape(n, rows, cols)
+
+    @staticmethod
+    def _read_labels(path):
+        opener = gzip.open if path.endswith(".gz") else open
+        with opener(path, "rb") as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            return np.frombuffer(f.read(), dtype=np.uint8).astype(np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = (img.astype(np.float32) / 255.0)[None]
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class Cifar10(Dataset):
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None, synthetic=None, size=1024):
+        self.transform = transform
+        if synthetic is None:
+            synthetic = data_file is None or not os.path.exists(data_file)
+        if synthetic:
+            rng = np.random.RandomState(0 if mode == "train" else 1)
+            self.images = (rng.rand(size, 3, 32, 32) * 255).astype(np.uint8)
+            self.labels = rng.randint(0, self._num_classes(), size).astype(
+                np.int64)
+        else:
+            with open(data_file, "rb") as f:
+                d = pickle.load(f, encoding="bytes")
+            self.images = np.asarray(d[b"data"]).reshape(-1, 3, 32, 32)
+            key = b"labels" if b"labels" in d else b"fine_labels"
+            self.labels = np.asarray(d[key], np.int64)
+
+    @staticmethod
+    def _num_classes():
+        return 10
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = img.astype(np.float32) / 255.0
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar100(Cifar10):
+    @staticmethod
+    def _num_classes():
+        return 100
+
+
+class ImageFolder(Dataset):
+    def __init__(self, root, loader=None, transform=None):
+        self.root = root
+        self.transform = transform
+        self.samples = []
+        if os.path.isdir(root):
+            classes = sorted(
+                d for d in os.listdir(root)
+                if os.path.isdir(os.path.join(root, d)))
+            for ci, c in enumerate(classes):
+                cdir = os.path.join(root, c)
+                for fn in sorted(os.listdir(cdir)):
+                    self.samples.append((os.path.join(cdir, fn), ci))
+
+    def __getitem__(self, idx):
+        path, label = self.samples[idx]
+        arr = np.load(path) if path.endswith(".npy") else \
+            self._load_image(path)
+        if self.transform:
+            arr = self.transform(arr)
+        return arr, label
+
+    @staticmethod
+    def _load_image(path):
+        raise RuntimeError(
+            "image decoding requires PIL; store .npy arrays or pass a "
+            "custom loader")
+
+    def __len__(self):
+        return len(self.samples)

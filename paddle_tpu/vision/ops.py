@@ -1,0 +1,130 @@
+"""Vision ops (reference python/paddle/vision/ops.py: roi_align, nms,
+deform_conv, box ops)."""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import primitive
+from ..core.tensor import Tensor
+
+_A = jnp.asarray
+
+
+@primitive
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True):
+    """RoIAlign via bilinear gather (reference phi/kernels/roi_align_kernel).
+    x: [N,C,H,W]; boxes: [R,4] in (x1,y1,x2,y2)."""
+    x = _A(x)
+    boxes = _A(boxes)
+    if isinstance(output_size, int):
+        oh = ow = output_size
+    else:
+        oh, ow = output_size
+    N, C, H, W = x.shape
+    R = boxes.shape[0]
+    offset = 0.5 if aligned else 0.0
+    # assume single image (N==1) or boxes_num mapping handled upstream
+    img_idx = jnp.zeros((R,), jnp.int32)
+
+    x1 = boxes[:, 0] * spatial_scale - offset
+    y1 = boxes[:, 1] * spatial_scale - offset
+    x2 = boxes[:, 2] * spatial_scale - offset
+    y2 = boxes[:, 3] * spatial_scale - offset
+    rw = jnp.maximum(x2 - x1, 1e-3)
+    rh = jnp.maximum(y2 - y1, 1e-3)
+    bin_w = rw / ow
+    bin_h = rh / oh
+
+    iy = (jnp.arange(oh) + 0.5)
+    ix = (jnp.arange(ow) + 0.5)
+    cy = y1[:, None] + iy[None, :] * bin_h[:, None]  # [R, oh]
+    cx = x1[:, None] + ix[None, :] * bin_w[:, None]  # [R, ow]
+
+    def bilinear(img, yy, xx):
+        y0 = jnp.clip(jnp.floor(yy).astype(jnp.int32), 0, H - 1)
+        x0 = jnp.clip(jnp.floor(xx).astype(jnp.int32), 0, W - 1)
+        y1_ = jnp.clip(y0 + 1, 0, H - 1)
+        x1_ = jnp.clip(x0 + 1, 0, W - 1)
+        wy = yy - y0
+        wx = xx - x0
+        v00 = img[:, y0, :][:, :, x0]
+        v01 = img[:, y0, :][:, :, x1_]
+        v10 = img[:, y1_, :][:, :, x0]
+        v11 = img[:, y1_, :][:, :, x1_]
+        return (v00 * (1 - wy)[None, :, None] * (1 - wx)[None, None, :]
+                + v01 * (1 - wy)[None, :, None] * wx[None, None, :]
+                + v10 * wy[None, :, None] * (1 - wx)[None, None, :]
+                + v11 * wy[None, :, None] * wx[None, None, :])
+
+    def per_roi(r):
+        img = x[img_idx[r]]
+        return bilinear(img, cy[r], cx[r])  # [C, oh, ow]
+
+    out = jax.vmap(per_roi)(jnp.arange(R))
+    return out
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """Host-side NMS (data-dependent output count — same reason the
+    reference runs it as a CPU/custom op for dynamic shapes)."""
+    b = np.asarray(boxes.numpy() if isinstance(boxes, Tensor) else boxes)
+    s = (np.asarray(scores.numpy() if isinstance(scores, Tensor) else scores)
+         if scores is not None else np.arange(len(b))[::-1].astype(np.float32))
+    order = np.argsort(-s)
+    keep = []
+    while order.size > 0:
+        i = order[0]
+        keep.append(i)
+        if order.size == 1:
+            break
+        xx1 = np.maximum(b[i, 0], b[order[1:], 0])
+        yy1 = np.maximum(b[i, 1], b[order[1:], 1])
+        xx2 = np.minimum(b[i, 2], b[order[1:], 2])
+        yy2 = np.minimum(b[i, 3], b[order[1:], 3])
+        w = np.maximum(0.0, xx2 - xx1)
+        h = np.maximum(0.0, yy2 - yy1)
+        inter = w * h
+        area_i = (b[i, 2] - b[i, 0]) * (b[i, 3] - b[i, 1])
+        area_o = ((b[order[1:], 2] - b[order[1:], 0])
+                  * (b[order[1:], 3] - b[order[1:], 1]))
+        iou = inter / (area_i + area_o - inter + 1e-10)
+        order = order[1:][iou <= iou_threshold]
+    keep = np.asarray(keep, np.int64)
+    if top_k is not None:
+        keep = keep[:top_k]
+    return Tensor(jnp.asarray(keep))
+
+
+@primitive
+def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_size",
+              box_normalized=True):
+    pb = _A(prior_box)
+    tb = _A(target_box)
+    pbv = _A(prior_box_var) if prior_box_var is not None else None
+    pw = pb[:, 2] - pb[:, 0] + (0.0 if box_normalized else 1.0)
+    ph = pb[:, 3] - pb[:, 1] + (0.0 if box_normalized else 1.0)
+    pcx = pb[:, 0] + pw * 0.5
+    pcy = pb[:, 1] + ph * 0.5
+    if code_type == "encode_center_size":
+        tw = tb[:, 2] - tb[:, 0] + (0.0 if box_normalized else 1.0)
+        th = tb[:, 3] - tb[:, 1] + (0.0 if box_normalized else 1.0)
+        tcx = tb[:, 0] + tw * 0.5
+        tcy = tb[:, 1] + th * 0.5
+        ox = (tcx - pcx) / pw
+        oy = (tcy - pcy) / ph
+        ow = jnp.log(tw / pw)
+        oh = jnp.log(th / ph)
+        out = jnp.stack([ox, oy, ow, oh], axis=1)
+        if pbv is not None:
+            out = out / pbv
+        return out
+    raise NotImplementedError(code_type)
+
+
+def generate_anchors(*a, **k):
+    raise NotImplementedError("anchor generator lands with detection models")

@@ -1,0 +1,29 @@
+"""Test environment: FORCE 8 virtual CPU devices.
+
+Mesh/collective tests run on XLA's CPU multi-device simulation (SURVEY §4:
+this replaces the reference's multi-process localhost NCCL harness). The
+ambient environment may point JAX at the real TPU chip (JAX_PLATFORMS=axon)
+— tests must never touch it: compile-heavy suites sharing the single tunnel
+chip serialize and can wedge the tunnel, so we override (not setdefault)
+before jax is imported."""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+_flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+# jax may already be imported (pytest plugin autoload) with the ambient
+# JAX_PLATFORMS=axon — force the config to cpu post-import and drop the
+# axon/tpu plugin factories so backend init cannot touch the tunnel.
+try:
+    import jax as _jax
+    from jax._src import xla_bridge as _xb
+
+    _jax.config.update("jax_platforms", "cpu")
+    _xb._backend_factories.pop("axon", None)
+    _xb._backend_factories.pop("tpu", None)
+except Exception:
+    pass

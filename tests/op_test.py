@@ -1,0 +1,134 @@
+"""OpTest harness.
+
+Replica of the reference's declarative op test base
+(/root/reference/python/paddle/fluid/tests/unittests/eager_op_test.py:314):
+check_output runs the op through the eager path AND the jit-compiled path
+and compares against a numpy oracle; check_grad compares the autograd
+gradient against central finite differences. Two paths here (eager, jit)
+replace the reference's three (legacy dygraph / eager / static) since this
+framework has one unified op body.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+
+
+def _to_np(x):
+    if isinstance(x, Tensor):
+        return np.asarray(x.numpy(), dtype=np.float64) \
+            if np.issubdtype(np.asarray(x.numpy()).dtype, np.floating) \
+            else np.asarray(x.numpy())
+    return np.asarray(x)
+
+
+def check_output(op_fn, inputs, attrs=None, oracle=None, expected=None,
+                 rtol=1e-5, atol=1e-6, check_jit=True):
+    """inputs: dict name -> np array (or list of arrays). oracle: numpy fn
+    taking the same signature. expected: precomputed output(s)."""
+    attrs = attrs or {}
+    tensors = {
+        k: ([paddle.to_tensor(vi) for vi in v] if isinstance(v, list)
+            else paddle.to_tensor(v))
+        for k, v in inputs.items()
+    }
+    out = op_fn(**tensors, **attrs)
+    if expected is None:
+        expected = oracle(**inputs, **attrs)
+    outs = out if isinstance(out, (tuple, list)) else [out]
+    exps = expected if isinstance(expected, (tuple, list)) else [expected]
+    for o, e in zip(outs, exps):
+        np.testing.assert_allclose(
+            _to_np(o), np.asarray(e), rtol=rtol, atol=atol,
+            err_msg="eager output mismatch for %s" % getattr(
+                op_fn, "op_name", op_fn))
+    if check_jit:
+        # run the same op under jax.jit tracing (static path)
+        keys = list(inputs.keys())
+
+        def pure(*vals):
+            ts = {}
+            for k, v in zip(keys, vals):
+                ts[k] = ([Tensor(vi) for vi in v] if isinstance(v, (list, tuple))
+                         else Tensor(v))
+            with paddle.no_grad():
+                r = op_fn(**ts, **attrs)
+            if isinstance(r, (tuple, list)):
+                return tuple(t._value for t in r)
+            return r._value
+
+        vals = [([np.asarray(vi) for vi in v] if isinstance(v, list)
+                 else np.asarray(v)) for v in inputs.values()]
+        jout = jax.jit(pure)(*vals)
+        jouts = jout if isinstance(jout, (tuple, list)) else [jout]
+        for o, e in zip(jouts, exps):
+            np.testing.assert_allclose(
+                np.asarray(o, dtype=np.asarray(e).dtype
+                           if np.issubdtype(np.asarray(e).dtype, np.floating)
+                           else None),
+                np.asarray(e), rtol=rtol, atol=atol,
+                err_msg="jit output mismatch")
+
+
+def check_grad(op_fn, inputs, attrs=None, grad_vars=None, delta=1e-3,
+               rtol=1e-2, atol=1e-3, output_index=0, reduce_fn=None):
+    """Numeric gradient check (reference eager_op_test.py:2055 get_numeric_
+    gradient). grad_vars: which input names to check (default: all float)."""
+    attrs = attrs or {}
+    grad_vars = grad_vars or [
+        k for k, v in inputs.items()
+        if not isinstance(v, list) and np.issubdtype(
+            np.asarray(v).dtype, np.floating)
+    ]
+
+    def run_loss(np_inputs):
+        tensors = {}
+        for k, v in np_inputs.items():
+            if isinstance(v, list):
+                tensors[k] = [paddle.to_tensor(vi) for vi in v]
+            else:
+                tensors[k] = paddle.to_tensor(
+                    np.asarray(v), stop_gradient=(k not in grad_vars))
+        out = op_fn(**tensors, **attrs)
+        if isinstance(out, (tuple, list)):
+            out = out[output_index]
+        if reduce_fn is not None:
+            out = reduce_fn(out)
+        else:
+            out = out.sum() if out.size > 1 else out
+        return out, tensors
+
+    # analytic gradients
+    loss, tensors = run_loss(inputs)
+    loss.backward()
+    analytic = {k: np.asarray(tensors[k].grad.numpy(), np.float64)
+                for k in grad_vars}
+
+    # numeric gradients (central difference)
+    for k in grad_vars:
+        base = np.asarray(inputs[k], np.float64)
+        num = np.zeros_like(base)
+        flat = base.reshape(-1)
+        numf = num.reshape(-1)
+        for i in range(flat.size):
+            for sign, store in ((1, 0), (-1, 1)):
+                pert = flat.copy()
+                pert[i] += sign * delta
+                mod = dict(inputs)
+                mod[k] = pert.reshape(base.shape).astype(
+                    np.asarray(inputs[k]).dtype)
+                with paddle.no_grad():
+                    l2, _ = run_loss(mod)
+                if sign == 1:
+                    lp = float(l2)
+                else:
+                    lm = float(l2)
+            numf[i] = (lp - lm) / (2 * delta)
+        np.testing.assert_allclose(
+            analytic[k], num, rtol=rtol, atol=atol,
+            err_msg="gradient mismatch for input %r of %s" % (
+                k, getattr(op_fn, "op_name", op_fn)))

@@ -1,0 +1,132 @@
+"""Autograd engine tests (reference test_imperative_basic.py,
+test_eager_* backward semantics)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _f32(*shape):
+    return np.random.RandomState(3).uniform(0.5, 1.5, shape).astype(np.float32)
+
+
+class TestBackward:
+    def test_scalar_backward(self):
+        x = paddle.to_tensor(_f32(3, 4), stop_gradient=False)
+        y = (x * x).sum()
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), 2 * x.numpy(), rtol=1e-5)
+
+    def test_chain(self):
+        x = paddle.to_tensor(_f32(3), stop_gradient=False)
+        y = paddle.exp(paddle.log(x) * 2.0).sum()  # = sum(x^2)
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), 2 * x.numpy(), rtol=1e-4)
+
+    def test_grad_accumulation(self):
+        x = paddle.to_tensor(_f32(3), stop_gradient=False)
+        (x * 2).sum().backward()
+        (x * 3).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), np.full(3, 5.0), rtol=1e-5)
+
+    def test_shared_input_fanout(self):
+        x = paddle.to_tensor(_f32(3), stop_gradient=False)
+        y = x * x + x * x  # x used twice in two ops
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), 4 * x.numpy(), rtol=1e-5)
+
+    def test_stop_gradient(self):
+        x = paddle.to_tensor(_f32(3), stop_gradient=False)
+        y = paddle.to_tensor(_f32(3), stop_gradient=True)
+        (x * y).sum().backward()
+        assert y.grad is None
+        np.testing.assert_allclose(x.grad.numpy(), y.numpy(), rtol=1e-5)
+
+    def test_detach(self):
+        x = paddle.to_tensor(_f32(3), stop_gradient=False)
+        d = (x * 2).detach()
+        assert d.stop_gradient
+        z = (x * d).sum()
+        z.backward()
+        np.testing.assert_allclose(x.grad.numpy(), d.numpy(), rtol=1e-5)
+
+    def test_non_scalar_backward_needs_grad(self):
+        x = paddle.to_tensor(_f32(3), stop_gradient=False)
+        y = x * 2
+        with pytest.raises(RuntimeError):
+            y.backward()
+        y.backward(paddle.ones_like(y))
+        np.testing.assert_allclose(x.grad.numpy(), np.full(3, 2.0), rtol=1e-5)
+
+    def test_retain_graph(self):
+        x = paddle.to_tensor(_f32(3), stop_gradient=False)
+        y = (x * x).sum()
+        y.backward(retain_graph=True)
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), 4 * x.numpy(), rtol=1e-5)
+
+    def test_freed_graph_raises(self):
+        x = paddle.to_tensor(_f32(3), stop_gradient=False)
+        y = (x * x).sum()
+        y.backward()
+        with pytest.raises(RuntimeError):
+            y.backward()
+
+    def test_multi_output_op(self):
+        x = paddle.to_tensor(_f32(4, 6), stop_gradient=False)
+        parts = paddle.split(x, 2, axis=1)
+        (parts[0].sum() * 2 + parts[1].sum()).backward()
+        exp = np.concatenate([np.full((4, 3), 2.0), np.full((4, 3), 1.0)], 1)
+        np.testing.assert_allclose(x.grad.numpy(), exp, rtol=1e-5)
+
+
+class TestGradAPI:
+    def test_grad_basic(self):
+        x = paddle.to_tensor(_f32(3), stop_gradient=False)
+        y = (x * x).sum()
+        (gx,) = paddle.grad(y, x)
+        np.testing.assert_allclose(gx.numpy(), 2 * x.numpy(), rtol=1e-5)
+        assert x.grad is None  # paddle.grad must not pollute .grad
+
+    def test_grad_intermediate(self):
+        x = paddle.to_tensor(_f32(3), stop_gradient=False)
+        h = x * 2
+        y = (h * h).sum()
+        (gh,) = paddle.grad(y, h, retain_graph=True)
+        np.testing.assert_allclose(gh.numpy(), 2 * h.numpy(), rtol=1e-5)
+
+    def test_grad_unused(self):
+        x = paddle.to_tensor(_f32(3), stop_gradient=False)
+        z = paddle.to_tensor(_f32(3), stop_gradient=False)
+        y = (x * x).sum()
+        with pytest.raises(RuntimeError):
+            paddle.grad(y, [x, z], retain_graph=True)
+        gx, gz = paddle.grad(y, [x, z], allow_unused=True)
+        assert gz is None
+
+    def test_no_grad_context(self):
+        x = paddle.to_tensor(_f32(3), stop_gradient=False)
+        with paddle.no_grad():
+            y = x * x
+        assert y.stop_gradient
+
+
+class TestPyLayer:
+    def test_pylayer(self):
+        from paddle_tpu.autograd import PyLayer
+
+        class Square(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * x
+
+            @staticmethod
+            def backward(ctx, grad):
+                (x,) = ctx.saved_tensor()
+                return grad * x * 2
+
+        x = paddle.to_tensor(_f32(3), stop_gradient=False)
+        y = Square.apply(x)
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), 2 * x.numpy(), rtol=1e-5)

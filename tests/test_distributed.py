@@ -1,0 +1,202 @@
+"""Distributed tests on the 8-device virtual CPU mesh.
+
+Replaces the reference's multi-process localhost NCCL harness
+(test_collective_api_base.py:96): collectives are checked against numpy on
+real 8-way sharded arrays — stronger than the reference's 2-rank checks."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import mesh as pmesh
+
+pytestmark = pytest.mark.skipif(
+    jax.device_count() < 8, reason="needs 8 virtual devices")
+
+
+@pytest.fixture(autouse=True)
+def reset_mesh():
+    pmesh.set_mesh(None)
+    yield
+    pmesh.set_mesh(None)
+
+
+class TestMesh:
+    def test_default_mesh(self):
+        m = pmesh.get_mesh()
+        assert m.devices.size == 8
+
+    def test_hybrid_mesh(self):
+        m = pmesh.build_hybrid_mesh(dp=2, mp=2, pp=2)
+        assert m.shape["dp"] == 2 and m.shape["mp"] == 2
+        assert m.shape["pp"] == 2
+
+    def test_topology(self):
+        topo = dist.CommunicateTopology(["data", "pipe", "sharding", "model"],
+                                        [2, 2, 1, 2])
+        assert topo.world_size() == 8
+        assert topo.get_rank(data=1, pipe=0, sharding=0, model=1) == 5
+        groups = topo.get_comm_list("model")
+        assert len(groups) == 4 and all(len(g) == 2 for g in groups)
+
+
+class TestEagerCollectives:
+    def test_all_reduce_sum(self):
+        g = dist.new_group(axis="dp")
+        x = np.arange(16, dtype=np.float32).reshape(8, 2)
+        t = paddle.to_tensor(x.copy())
+        dist.all_reduce(t, group=g)
+        # each of the 8 shards is one row; sum replicated
+        ref = x.sum(axis=0, keepdims=True)
+        np.testing.assert_allclose(np.asarray(t._value)[0], ref[0])
+
+    def test_all_gather(self):
+        g = dist.new_group(axis="dp")
+        x = np.arange(8, dtype=np.float32).reshape(8, 1)
+        out = []
+        dist.all_gather(out, paddle.to_tensor(x), group=g)
+        assert len(out) == 8
+        np.testing.assert_allclose(out[3].numpy(), [[3.0]])
+
+    def test_reduce_scatter(self):
+        g = dist.new_group(axis="dp")
+        # each of the 8 ranks contributes an (8,4) block; rank r keeps the
+        # cross-rank sum of row r → global (8,4) of 8s
+        x = np.ones((64, 4), np.float32)
+        t = paddle.to_tensor(x)
+        out = dist.reduce_scatter(t, group=g)
+        assert tuple(np.asarray(out._value).shape) == (8, 4)
+        assert np.allclose(np.asarray(out._value), 8.0)
+
+
+class TestTracedCollectives:
+    def test_psum_inside_shard_map(self):
+        from jax.experimental.shard_map import shard_map
+
+        mesh = pmesh.build_hybrid_mesh(dp=8)
+        g = dist.Group("dp", mesh)
+
+        def f(x):
+            t = paddle.Tensor(x)
+            out = dist.all_reduce(t, group=g)
+            return out._value
+
+        xs = np.arange(8, dtype=np.float32).reshape(8, 1)
+        fn = shard_map(f, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"))
+        out = jax.jit(fn)(xs)
+        np.testing.assert_allclose(np.asarray(out), np.full((8, 1), 28.0))
+
+
+class TestDataParallelSPMD:
+    def test_dp_training_step_matches_single_device(self):
+        """Golden-loss comparison (reference TestDistBase.check_with_place):
+        a pjit'd dp=8 step must produce the same loss/params as single-device."""
+        from paddle_tpu import nn, optimizer
+        from paddle_tpu.parallel.engine import CompiledTrainStep
+
+        def build():
+            paddle.seed(7)
+            m = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 2))
+            o = optimizer.SGD(learning_rate=0.1, parameters=m.parameters())
+            return m, o
+
+        rng = np.random.RandomState(0)
+        x = rng.rand(16, 4).astype(np.float32)
+        y = rng.randint(0, 2, 16)
+
+        import paddle_tpu.nn.functional as F
+
+        loss_fn = lambda out, lbl: F.cross_entropy(out, lbl)
+
+        # single-device eager reference
+        m1, o1 = build()
+        out = m1(paddle.to_tensor(x))
+        loss = loss_fn(out, paddle.to_tensor(y))
+        loss.backward()
+        o1.step()
+        ref_loss = float(loss)
+        ref_w = m1.state_dict()["0.weight"].numpy()
+
+        # dp=8 compiled step
+        pmesh.build_hybrid_mesh(dp=8)
+        m2, o2 = build()
+        step = CompiledTrainStep(m2, loss_fn, o2)
+        loss2 = step(paddle.to_tensor(x), paddle.to_tensor(y))
+        np.testing.assert_allclose(float(loss2), ref_loss, rtol=1e-4)
+        w2 = m2.state_dict()["0.weight"].numpy()
+        np.testing.assert_allclose(w2, ref_w, rtol=1e-4, atol=1e-5)
+
+
+class TestTensorParallelSPMD:
+    def test_mp_layers_match_plain_linear(self):
+        from paddle_tpu.parallel import (ColumnParallelLinear,
+                                         RowParallelLinear)
+
+        pmesh.build_hybrid_mesh(dp=2, mp=4)
+        paddle.seed(3)
+        col = ColumnParallelLinear(8, 16, gather_output=False)
+        row = RowParallelLinear(16, 8, input_is_parallel=True)
+        x = paddle.to_tensor(np.random.RandomState(1).rand(4, 8)
+                             .astype(np.float32))
+        # eager correctness (mp math identical to dense math)
+        out = row(col(x))
+        ref = (x.numpy() @ col.weight.numpy() + col.bias.numpy()) \
+            @ row.weight.numpy() + row.bias.numpy()
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+    def test_mp_compiled_step(self):
+        from paddle_tpu import nn, optimizer
+        from paddle_tpu.parallel import (ColumnParallelLinear,
+                                         RowParallelLinear)
+        from paddle_tpu.parallel.engine import CompiledTrainStep
+        import paddle_tpu.nn.functional as F
+
+        pmesh.build_hybrid_mesh(dp=2, mp=4)
+        paddle.seed(11)
+
+        class MLP(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.up = ColumnParallelLinear(8, 32, gather_output=False)
+                self.down = RowParallelLinear(32, 4, input_is_parallel=True)
+
+            def forward(self, x):
+                return self.down(F.gelu(self.up(x)))
+
+        m = MLP()
+        o = optimizer.Adam(learning_rate=1e-2, parameters=m.parameters())
+        step = CompiledTrainStep(m, lambda o_, y: F.cross_entropy(o_, y), o)
+        rng = np.random.RandomState(2)
+        x = rng.rand(8, 8).astype(np.float32)
+        y = rng.randint(0, 4, 8)
+        l0 = float(step(paddle.to_tensor(x), paddle.to_tensor(y)))
+        for _ in range(5):
+            l1 = float(step(paddle.to_tensor(x), paddle.to_tensor(y)))
+        assert l1 < l0
+
+
+class TestFleet:
+    def test_fleet_init_and_wrap(self):
+        from paddle_tpu import nn, optimizer
+        from paddle_tpu.distributed import fleet
+
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 4, "mp_degree": 2,
+                                   "pp_degree": 1, "sharding_degree": 1}
+        fleet.init(is_collective=True, strategy=strategy)
+        hcg = fleet.get_hybrid_communicate_group()
+        assert hcg.get_data_parallel_world_size() == 4
+        assert hcg.get_model_parallel_world_size() == 2
+        model = nn.Linear(4, 4)
+        dm = fleet.distributed_model(model)
+        out = dm(paddle.ones([2, 4]))
+        assert out.shape == [2, 4]
+        opt = fleet.distributed_optimizer(
+            optimizer.SGD(0.1, parameters=model.parameters()))
+        loss = dm(paddle.ones([2, 4])).sum()
+        loss.backward()
+        opt.step()

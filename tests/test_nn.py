@@ -1,0 +1,379 @@
+"""nn.Layer + layers tests (reference test_layers.py, test_linear.py,
+test_conv2d_op.py, test_batch_norm_op.py, test_transformer_api.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+import paddle_tpu.nn.functional as F
+
+RNG = np.random.RandomState(5)
+
+
+def _f32(*shape):
+    return RNG.uniform(-1, 1, shape).astype(np.float32)
+
+
+class TestLayerBase:
+    def test_parameters_and_state_dict(self):
+        m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        params = m.parameters()
+        assert len(params) == 4  # 2 weights + 2 biases
+        sd = m.state_dict()
+        assert set(sd.keys()) == {"0.weight", "0.bias", "2.weight", "2.bias"}
+
+    def test_set_state_dict(self):
+        m1 = nn.Linear(4, 3)
+        m2 = nn.Linear(4, 3)
+        m2.set_state_dict(m1.state_dict())
+        np.testing.assert_allclose(m1.weight.numpy(), m2.weight.numpy())
+
+    def test_train_eval_mode(self):
+        m = nn.Dropout(0.5)
+        x = paddle.ones([100, 100])
+        m.eval()
+        np.testing.assert_allclose(m(x).numpy(), x.numpy())
+        m.train()
+        out = m(x)
+        assert (out.numpy() == 0).any()
+
+    def test_hooks(self):
+        m = nn.Linear(3, 3)
+        calls = []
+        h = m.register_forward_post_hook(
+            lambda layer, inp, out: calls.append(1))
+        m(paddle.ones([2, 3]))
+        assert calls == [1]
+        h.remove()
+        m(paddle.ones([2, 3]))
+        assert calls == [1]
+
+    def test_to_dtype(self):
+        m = nn.Linear(3, 3)
+        m.to(dtype="bfloat16")
+        assert m.weight.dtype == "bfloat16"
+
+    def test_named_sublayers(self):
+        m = nn.Sequential(nn.Linear(2, 2), nn.Sequential(nn.ReLU()))
+        names = [n for n, _ in m.named_sublayers()]
+        assert "0" in names and "1.0" in names
+
+
+class TestCommonLayers:
+    def test_linear(self):
+        m = nn.Linear(4, 3)
+        x = _f32(2, 4)
+        out = m(paddle.to_tensor(x))
+        ref = x @ m.weight.numpy() + m.bias.numpy()
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-5)
+
+    def test_embedding(self):
+        m = nn.Embedding(10, 4, padding_idx=0)
+        idx = paddle.to_tensor(np.array([[1, 0, 3]]))
+        out = m(idx)
+        assert out.shape == [1, 3, 4]
+        np.testing.assert_allclose(out.numpy()[0, 1], np.zeros(4))
+
+    def test_conv2d_matches_reference(self):
+        import torch
+        import torch.nn.functional as TF
+
+        x = _f32(2, 3, 8, 8)
+        w = _f32(5, 3, 3, 3)
+        b = _f32(5)
+        out = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w),
+                       paddle.to_tensor(b), stride=2, padding=1)
+        ref = TF.conv2d(torch.tensor(x), torch.tensor(w), torch.tensor(b),
+                        stride=2, padding=1).numpy()
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-4)
+
+    def test_conv2d_grouped(self):
+        import torch
+        import torch.nn.functional as TF
+
+        x = _f32(1, 4, 6, 6)
+        w = _f32(8, 2, 3, 3)
+        out = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w), groups=2)
+        ref = TF.conv2d(torch.tensor(x), torch.tensor(w), groups=2).numpy()
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-4)
+
+    def test_conv2d_transpose(self):
+        import torch
+        import torch.nn.functional as TF
+
+        x = _f32(1, 4, 5, 5)
+        w = _f32(4, 3, 3, 3)  # [in, out, kh, kw]
+        out = F.conv2d_transpose(paddle.to_tensor(x), paddle.to_tensor(w),
+                                 stride=2, padding=1)
+        ref = TF.conv_transpose2d(torch.tensor(x), torch.tensor(w), stride=2,
+                                  padding=1).numpy()
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-4)
+
+    def test_maxpool_avgpool(self):
+        import torch
+        import torch.nn.functional as TF
+
+        x = _f32(2, 3, 8, 8)
+        out = F.max_pool2d(paddle.to_tensor(x), 2, 2)
+        ref = TF.max_pool2d(torch.tensor(x), 2, 2).numpy()
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+        out = F.avg_pool2d(paddle.to_tensor(x), 2, 2)
+        ref = TF.avg_pool2d(torch.tensor(x), 2, 2).numpy()
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+
+    def test_adaptive_avg_pool(self):
+        x = _f32(2, 3, 8, 8)
+        out = F.adaptive_avg_pool2d(paddle.to_tensor(x), 1)
+        np.testing.assert_allclose(
+            out.numpy()[..., 0, 0], x.mean(axis=(2, 3)), rtol=1e-5)
+
+
+class TestNorm:
+    def test_batch_norm_train_infer(self):
+        m = nn.BatchNorm2D(3, momentum=0.9)
+        x = _f32(4, 3, 5, 5)
+        m.train()
+        out = m(paddle.to_tensor(x))
+        mean = x.mean(axis=(0, 2, 3))
+        var = x.var(axis=(0, 2, 3))
+        ref = (x - mean[None, :, None, None]) / np.sqrt(
+            var[None, :, None, None] + 1e-5)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-3, atol=1e-4)
+        # running stats updated
+        np.testing.assert_allclose(m._mean.numpy(), 0.1 * mean, rtol=1e-3,
+                                   atol=1e-5)
+        m.eval()
+        out2 = m(paddle.to_tensor(x))
+        assert out2.shape == list(x.shape)
+
+    def test_layer_norm(self):
+        import torch
+
+        m = nn.LayerNorm(6)
+        x = _f32(4, 6)
+        out = m(paddle.to_tensor(x))
+        ref = torch.nn.functional.layer_norm(
+            torch.tensor(x), (6,)).numpy()
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+    def test_group_norm(self):
+        import torch
+
+        x = _f32(2, 6, 4, 4)
+        out = F.group_norm(paddle.to_tensor(x), 3)
+        ref = torch.nn.functional.group_norm(torch.tensor(x), 3).numpy()
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-4)
+
+    def test_rms_norm(self):
+        x = _f32(2, 8)
+        out = F.rms_norm(paddle.to_tensor(x))
+        ref = x / np.sqrt((x**2).mean(-1, keepdims=True) + 1e-6)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-4)
+
+
+class TestLoss:
+    def test_cross_entropy(self):
+        import torch
+
+        logits = _f32(8, 5)
+        labels = RNG.randint(0, 5, 8)
+        out = F.cross_entropy(paddle.to_tensor(logits),
+                              paddle.to_tensor(labels))
+        ref = torch.nn.functional.cross_entropy(
+            torch.tensor(logits), torch.tensor(labels)).numpy()
+        np.testing.assert_allclose(float(out), float(ref), rtol=1e-5)
+
+    def test_cross_entropy_ignore_index(self):
+        import torch
+
+        logits = _f32(8, 5)
+        labels = RNG.randint(0, 5, 8)
+        labels[:3] = -100
+        out = F.cross_entropy(paddle.to_tensor(logits),
+                              paddle.to_tensor(labels), ignore_index=-100)
+        ref = torch.nn.functional.cross_entropy(
+            torch.tensor(logits), torch.tensor(labels),
+            ignore_index=-100).numpy()
+        np.testing.assert_allclose(float(out), float(ref), rtol=1e-5)
+
+    def test_soft_label(self):
+        logits = _f32(4, 5)
+        soft = np.abs(_f32(4, 5))
+        soft = soft / soft.sum(-1, keepdims=True)
+        out = F.cross_entropy(paddle.to_tensor(logits),
+                              paddle.to_tensor(soft), soft_label=True)
+        logp = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+        ref = -(soft * logp).sum(-1).mean()
+        np.testing.assert_allclose(float(out), ref, rtol=1e-4)
+
+    def test_mse_bce(self):
+        import torch
+
+        x, y = _f32(4, 3), _f32(4, 3)
+        np.testing.assert_allclose(
+            float(F.mse_loss(paddle.to_tensor(x), paddle.to_tensor(y))),
+            float(torch.nn.functional.mse_loss(torch.tensor(x),
+                                               torch.tensor(y))),
+            rtol=1e-5)
+        logit = _f32(4, 3)
+        lbl = (RNG.rand(4, 3) > 0.5).astype(np.float32)
+        np.testing.assert_allclose(
+            float(F.binary_cross_entropy_with_logits(
+                paddle.to_tensor(logit), paddle.to_tensor(lbl))),
+            float(torch.nn.functional.binary_cross_entropy_with_logits(
+                torch.tensor(logit), torch.tensor(lbl))),
+            rtol=1e-5)
+
+
+class TestActivations:
+    @pytest.mark.parametrize("ours,torch_name", [
+        (F.relu, "relu"), (F.gelu, "gelu"), (F.silu, "silu"),
+        (F.elu, "elu"), (F.selu, "selu"), (F.softplus, "softplus"),
+        (F.leaky_relu, "leaky_relu"), (F.mish, "mish"),
+        (F.hardswish, "hardswish"), (F.tanhshrink, "tanhshrink"),
+    ])
+    def test_vs_torch(self, ours, torch_name):
+        import torch
+
+        x = _f32(3, 4) * 3
+        out = ours(paddle.to_tensor(x))
+        ref = getattr(torch.nn.functional, torch_name)(
+            torch.tensor(x)).numpy()
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+    def test_softmax(self):
+        import torch
+
+        x = _f32(3, 4)
+        np.testing.assert_allclose(
+            F.softmax(paddle.to_tensor(x), axis=-1).numpy(),
+            torch.softmax(torch.tensor(x), -1).numpy(), rtol=1e-5, atol=1e-6)
+
+
+class TestAttentionTransformer:
+    def test_sdpa_matches_reference(self):
+        import torch
+
+        b, n, h, d = 2, 6, 2, 4
+        q, k, v = _f32(b, n, h, d), _f32(b, n, h, d), _f32(b, n, h, d)
+        out = F.scaled_dot_product_attention(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+            is_causal=True)
+        # torch sdpa uses [b, h, n, d]
+        tq = torch.tensor(q).permute(0, 2, 1, 3)
+        tk = torch.tensor(k).permute(0, 2, 1, 3)
+        tv = torch.tensor(v).permute(0, 2, 1, 3)
+        ref = torch.nn.functional.scaled_dot_product_attention(
+            tq, tk, tv, is_causal=True).permute(0, 2, 1, 3).numpy()
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-3, atol=1e-4)
+
+    def test_multihead_attention(self):
+        m = nn.MultiHeadAttention(8, 2)
+        x = paddle.to_tensor(_f32(2, 5, 8))
+        out = m(x)
+        assert out.shape == [2, 5, 8]
+
+    def test_mha_cache_incremental(self):
+        m = nn.MultiHeadAttention(8, 2)
+        m.eval()
+        x = paddle.to_tensor(_f32(1, 4, 8))
+        causal = paddle.to_tensor(np.tril(np.ones((4, 4), bool)))
+        full = m(x, attn_mask=causal)
+        cache = m.gen_cache(x[:, :0])
+        outs = []
+        for t in range(4):
+            o, cache = m(x[:, t:t + 1], x[:, t:t + 1], x[:, t:t + 1],
+                         None, cache)
+            outs.append(o)
+        inc = paddle.concat(outs, axis=1)
+        np.testing.assert_allclose(full.numpy(), inc.numpy(), rtol=1e-3,
+                                   atol=1e-4)
+
+    def test_transformer_encoder(self):
+        layer = nn.TransformerEncoderLayer(16, 2, 32, dropout=0.0)
+        enc = nn.TransformerEncoder(layer, 2)
+        x = paddle.to_tensor(_f32(2, 6, 16))
+        out = enc(x)
+        assert out.shape == [2, 6, 16]
+
+    def test_full_transformer(self):
+        m = nn.Transformer(d_model=16, nhead=2, num_encoder_layers=1,
+                           num_decoder_layers=1, dim_feedforward=32,
+                           dropout=0.0)
+        src = paddle.to_tensor(_f32(2, 5, 16))
+        tgt = paddle.to_tensor(_f32(2, 3, 16))
+        out = m(src, tgt)
+        assert out.shape == [2, 3, 16]
+
+
+class TestRNN:
+    def test_lstm_shapes_and_grad(self):
+        m = nn.LSTM(4, 8, num_layers=2)
+        x = paddle.to_tensor(_f32(2, 5, 4), stop_gradient=False)
+        out, (h, c) = m(x)
+        assert out.shape == [2, 5, 8]
+        assert h.shape == [2, 2, 8] and c.shape == [2, 2, 8]
+        out.sum().backward()
+        assert x.grad is not None
+
+    def test_lstm_vs_torch(self):
+        import torch
+
+        m = nn.LSTM(3, 4)
+        tm = torch.nn.LSTM(3, 4, batch_first=True)
+        # copy weights ours -> torch
+        sd = {k: torch.tensor(v.numpy()) for k, v in m.state_dict().items()}
+        tm.weight_ih_l0.data = sd["weight_ih_l0"]
+        tm.weight_hh_l0.data = sd["weight_hh_l0"]
+        tm.bias_ih_l0.data = sd["bias_ih_l0"]
+        tm.bias_hh_l0.data = sd["bias_hh_l0"]
+        x = _f32(2, 6, 3)
+        out, (h, c) = m(paddle.to_tensor(x))
+        tout, (th, tc) = tm(torch.tensor(x))
+        np.testing.assert_allclose(out.numpy(), tout.detach().numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_gru_bidirectional(self):
+        m = nn.GRU(4, 6, direction="bidirect")
+        x = paddle.to_tensor(_f32(3, 5, 4))
+        out, h = m(x)
+        assert out.shape == [3, 5, 12]
+        assert h.shape == [2, 3, 6]
+
+
+class TestCTC:
+    def test_ctc_matches_torch(self):
+        import torch
+
+        T, N, C, S = 12, 3, 5, 4
+        rng = np.random.RandomState(0)
+        logits = rng.rand(T, N, C).astype(np.float32)
+        log_probs = torch.log_softmax(torch.tensor(logits), -1)
+        labels = rng.randint(1, C, (N, S)).astype(np.int64)
+        in_lens = np.array([12, 9, 7], np.int64)
+        lbl_lens = np.array([4, 3, 2], np.int64)
+        ref = torch.nn.functional.ctc_loss(
+            log_probs, torch.tensor(labels), torch.tensor(in_lens),
+            torch.tensor(lbl_lens), blank=0, reduction="none").numpy()
+        ours = F.ctc_loss_dense(
+            paddle.to_tensor(log_probs.numpy()), paddle.to_tensor(labels),
+            paddle.to_tensor(in_lens), paddle.to_tensor(lbl_lens),
+            blank=0, reduction="none")
+        np.testing.assert_allclose(ours.numpy(), ref, rtol=1e-3, atol=1e-4)
+
+    def test_pixel_shuffle_roundtrip(self):
+        x = _f32(2, 8, 4, 4)
+        up = F.pixel_shuffle(paddle.to_tensor(x), 2)
+        down = F.pixel_unshuffle(up, 2)
+        np.testing.assert_allclose(down.numpy(), x, rtol=1e-6)
+        # NHWC layout
+        xh = _f32(2, 4, 4, 8)
+        uph = F.pixel_shuffle(paddle.to_tensor(xh), 2, data_format="NHWC")
+        downh = F.pixel_unshuffle(uph, 2, data_format="NHWC")
+        np.testing.assert_allclose(downh.numpy(), xh, rtol=1e-6)
+
+    def test_embedding_negative_padding_idx(self):
+        emb = nn.Embedding(10, 4, padding_idx=-1)
+        out = emb(paddle.to_tensor(np.array([9, 1])))
+        np.testing.assert_allclose(out.numpy()[0], np.zeros(4))
+        assert not np.allclose(out.numpy()[1], 0)

@@ -1,0 +1,193 @@
+"""Op tests: manipulation/comparison (reference test_reshape_op.py,
+test_concat_op.py, test_gather_op.py, ...)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import check_grad, check_output
+
+RNG = np.random.RandomState(11)
+
+
+def _f32(*shape):
+    return RNG.uniform(-1, 1, shape).astype(np.float32)
+
+
+class TestShape:
+    def test_reshape(self):
+        x = _f32(2, 6)
+        check_output(lambda x: paddle.reshape(x, [3, 4]), {"x": x},
+                     expected=x.reshape(3, 4))
+        check_output(lambda x: paddle.reshape(x, [-1, 2]), {"x": x},
+                     expected=x.reshape(-1, 2))
+
+    def test_transpose(self):
+        x = _f32(2, 3, 4)
+        check_output(lambda x: paddle.transpose(x, [2, 0, 1]), {"x": x},
+                     expected=np.transpose(x, (2, 0, 1)))
+
+    def test_squeeze_unsqueeze(self):
+        x = _f32(3, 1, 4)
+        check_output(lambda x: paddle.squeeze(x, axis=1), {"x": x},
+                     expected=np.squeeze(x, 1))
+        check_output(lambda x: paddle.unsqueeze(x, axis=[0, 2]), {"x": x},
+                     expected=x[None][:, :, None])
+
+    def test_flatten(self):
+        x = _f32(2, 3, 4)
+        check_output(lambda x: paddle.flatten(x, 1), {"x": x},
+                     expected=x.reshape(2, 12))
+
+    def test_tile_expand(self):
+        x = _f32(1, 3)
+        check_output(lambda x: paddle.tile(x, [2, 2]), {"x": x},
+                     expected=np.tile(x, (2, 2)))
+        check_output(lambda x: paddle.expand(x, [4, 3]), {"x": x},
+                     expected=np.broadcast_to(x, (4, 3)))
+
+    def test_reshape_grad(self):
+        check_grad(lambda x: paddle.reshape(x, [6]), {"x": _f32(2, 3)})
+
+
+class TestJoinSplit:
+    def test_concat(self):
+        xs = [_f32(2, 3), _f32(2, 3), _f32(2, 3)]
+        check_output(lambda xs: paddle.concat(xs, axis=1), {"xs": xs},
+                     expected=np.concatenate(xs, 1))
+
+    def test_stack(self):
+        xs = [_f32(2, 3), _f32(2, 3)]
+        check_output(lambda xs: paddle.stack(xs, axis=0), {"xs": xs},
+                     expected=np.stack(xs, 0))
+
+    def test_split(self):
+        x = _f32(6, 4)
+        outs = paddle.split(paddle.to_tensor(x), 3, axis=0)
+        assert len(outs) == 3
+        np.testing.assert_allclose(outs[1].numpy(), x[2:4])
+        outs = paddle.split(paddle.to_tensor(x), [1, 2, -1], axis=0)
+        assert [o.shape[0] for o in outs] == [1, 2, 3]
+
+    def test_concat_grad(self):
+        xs = [_f32(2, 2), _f32(2, 2)]
+        check_grad(lambda xs: paddle.concat(xs, axis=0), {"xs": xs},
+                   grad_vars=[])  # list inputs: output check only
+
+
+class TestGatherScatter:
+    def test_gather(self):
+        x = _f32(5, 3)
+        idx = np.array([0, 2, 4])
+        check_output(lambda: paddle.gather(paddle.to_tensor(x),
+                                           paddle.to_tensor(idx), axis=0),
+                     {}, expected=x[idx])
+
+    def test_gather_nd(self):
+        x = _f32(3, 4, 5)
+        idx = np.array([[0, 1], [2, 3]])
+        check_output(lambda: paddle.gather_nd(paddle.to_tensor(x),
+                                              paddle.to_tensor(idx)),
+                     {}, expected=x[idx[:, 0], idx[:, 1]])
+
+    def test_scatter(self):
+        x = np.zeros((4, 3), np.float32)
+        idx = np.array([1, 3])
+        upd = _f32(2, 3)
+        exp = x.copy()
+        exp[idx] = upd
+        check_output(lambda: paddle.scatter(paddle.to_tensor(x),
+                                            paddle.to_tensor(idx),
+                                            paddle.to_tensor(upd)),
+                     {}, expected=exp)
+
+    def test_where(self):
+        c = RNG.rand(3, 4) > 0.5
+        x, y = _f32(3, 4), _f32(3, 4)
+        check_output(lambda: paddle.where(paddle.to_tensor(c),
+                                          paddle.to_tensor(x),
+                                          paddle.to_tensor(y)),
+                     {}, expected=np.where(c, x, y))
+
+    def test_take_along_axis(self):
+        x = _f32(3, 4)
+        idx = RNG.randint(0, 4, (3, 2))
+        check_output(lambda: paddle.take_along_axis(
+            paddle.to_tensor(x), paddle.to_tensor(idx), 1),
+            {}, expected=np.take_along_axis(x, idx, 1))
+
+
+class TestSortTopk:
+    def test_sort_argsort(self):
+        x = _f32(3, 5)
+        check_output(lambda x: paddle.sort(x, axis=1), {"x": x},
+                     expected=np.sort(x, 1))
+        out = paddle.argsort(paddle.to_tensor(x), axis=1)
+        np.testing.assert_array_equal(out.numpy(), np.argsort(x, 1))
+
+    def test_topk(self):
+        x = _f32(3, 5)
+        vals, idx = paddle.topk(paddle.to_tensor(x), k=2, axis=1)
+        ref = np.sort(x, 1)[:, ::-1][:, :2]
+        np.testing.assert_allclose(vals.numpy(), ref, rtol=1e-6)
+
+    def test_flip_roll(self):
+        x = _f32(3, 4)
+        check_output(lambda x: paddle.flip(x, axis=[0]), {"x": x},
+                     expected=x[::-1])
+        check_output(lambda x: paddle.roll(x, 1, axis=0), {"x": x},
+                     expected=np.roll(x, 1, 0))
+
+
+class TestComparison:
+    def test_cmp(self):
+        x, y = _f32(3, 4), _f32(3, 4)
+        for op, ref in [(paddle.equal, np.equal),
+                        (paddle.greater_than, np.greater),
+                        (paddle.less_equal, np.less_equal)]:
+            out = op(paddle.to_tensor(x), paddle.to_tensor(y))
+            np.testing.assert_array_equal(out.numpy(), ref(x, y))
+
+    def test_dunder_cmp(self):
+        x = paddle.to_tensor([1.0, 2.0, 3.0])
+        y = paddle.to_tensor([2.0, 2.0, 2.0])
+        np.testing.assert_array_equal((x < y).numpy(), [True, False, False])
+        np.testing.assert_array_equal((x == y).numpy(), [False, True, False])
+
+    def test_allclose_equal_all(self):
+        x = _f32(3, 3)
+        assert bool(paddle.allclose(paddle.to_tensor(x),
+                                    paddle.to_tensor(x.copy())))
+        assert bool(paddle.equal_all(paddle.to_tensor(x),
+                                     paddle.to_tensor(x.copy())))
+
+    def test_masked_select_nonzero(self):
+        x = _f32(3, 4)
+        m = x > 0
+        out = paddle.masked_select(paddle.to_tensor(x), paddle.to_tensor(m))
+        np.testing.assert_allclose(out.numpy(), x[m])
+        nz = paddle.nonzero(paddle.to_tensor(m))
+        np.testing.assert_array_equal(nz.numpy(), np.stack(np.nonzero(m), 1))
+
+
+class TestIndexing:
+    def test_getitem(self):
+        x = _f32(4, 5, 6)
+        t = paddle.to_tensor(x)
+        np.testing.assert_allclose(t[1].numpy(), x[1])
+        np.testing.assert_allclose(t[1:3, 2].numpy(), x[1:3, 2])
+        np.testing.assert_allclose(t[..., -1].numpy(), x[..., -1])
+
+    def test_getitem_grad(self):
+        x = _f32(4, 5)
+        t = paddle.to_tensor(x, stop_gradient=False)
+        y = t[1:3].sum()
+        y.backward()
+        exp = np.zeros_like(x)
+        exp[1:3] = 1.0
+        np.testing.assert_allclose(t.grad.numpy(), exp)
+
+    def test_setitem(self):
+        x = _f32(4, 5)
+        t = paddle.to_tensor(x)
+        t[0] = 7.0
+        assert np.allclose(t.numpy()[0], 7.0)

@@ -1,0 +1,164 @@
+"""Op tests for math/reduction ops — numpy oracle + numeric grad check
+(pattern of reference unittests test_elementwise_*_op.py, test_matmul_v2_op.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import check_grad, check_output
+
+RNG = np.random.RandomState(7)
+
+
+def _f32(*shape):
+    return RNG.uniform(0.1, 1.0, shape).astype(np.float32)
+
+
+class TestElementwise:
+    @pytest.mark.parametrize("op,ref", [
+        (paddle.add, np.add),
+        (paddle.subtract, np.subtract),
+        (paddle.multiply, np.multiply),
+        (paddle.divide, np.divide),
+        (paddle.maximum, np.maximum),
+        (paddle.minimum, np.minimum),
+    ])
+    def test_binary(self, op, ref):
+        x, y = _f32(3, 4), _f32(3, 4)
+        check_output(lambda x, y: op(x, y), {"x": x, "y": y},
+                     expected=ref(x, y))
+
+    def test_broadcast(self):
+        x, y = _f32(3, 4), _f32(4)
+        check_output(paddle.add, {"x": x, "y": y}, expected=x + y)
+
+    def test_add_grad(self):
+        check_grad(paddle.add, {"x": _f32(3, 4), "y": _f32(3, 4)})
+
+    def test_multiply_grad(self):
+        check_grad(paddle.multiply, {"x": _f32(3, 4), "y": _f32(3, 4)})
+
+    def test_divide_grad(self):
+        check_grad(paddle.divide, {"x": _f32(3, 4), "y": _f32(3, 4)})
+
+
+class TestUnary:
+    @pytest.mark.parametrize("op,ref", [
+        (paddle.exp, np.exp),
+        (paddle.log, np.log),
+        (paddle.sqrt, np.sqrt),
+        (paddle.abs, np.abs),
+        (paddle.sin, np.sin),
+        (paddle.cos, np.cos),
+        (paddle.tanh, np.tanh),
+        (paddle.floor, np.floor),
+        (paddle.ceil, np.ceil),
+        (paddle.square, np.square),
+    ])
+    def test_unary(self, op, ref):
+        x = _f32(3, 4)
+        # XLA lowers transcendentals to fast polynomial approximations
+        # (~1e-5 rel err) — tolerance reflects that, like the reference's
+        # per-op OpTest tolerances for approximate kernels
+        check_output(lambda x: op(x), {"x": x}, expected=ref(x),
+                     rtol=2e-4, atol=1e-5)
+
+    @pytest.mark.parametrize("op", [paddle.exp, paddle.log, paddle.sqrt,
+                                    paddle.tanh, paddle.square])
+    def test_unary_grad(self, op):
+        check_grad(lambda x: op(x), {"x": _f32(3, 4)})
+
+    def test_sigmoid(self):
+        x = _f32(4, 5)
+        check_output(lambda x: paddle.nn.functional.sigmoid(x), {"x": x},
+                     expected=1 / (1 + np.exp(-x)))
+
+
+class TestMatmul:
+    def test_matmul(self):
+        x, y = _f32(3, 4), _f32(4, 5)
+        check_output(paddle.matmul, {"x": x, "y": y}, expected=x @ y,
+                     rtol=1e-4, atol=1e-4)
+
+    def test_matmul_transpose(self):
+        x, y = _f32(4, 3), _f32(5, 4)
+        check_output(paddle.matmul, {"x": x, "y": y},
+                     attrs={"transpose_x": True, "transpose_y": True},
+                     expected=x.T @ y.T, rtol=1e-4, atol=1e-4)
+
+    def test_batched(self):
+        x, y = _f32(2, 3, 4), _f32(2, 4, 5)
+        check_output(paddle.matmul, {"x": x, "y": y}, expected=x @ y,
+                     rtol=1e-4, atol=1e-4)
+
+    def test_matmul_grad(self):
+        check_grad(paddle.matmul, {"x": _f32(3, 4), "y": _f32(4, 3)},
+                   rtol=3e-2, atol=3e-3)
+
+
+class TestReduce:
+    @pytest.mark.parametrize("op,ref", [
+        (paddle.sum, np.sum),
+        (paddle.mean, np.mean),
+        (paddle.max, np.max),
+        (paddle.min, np.min),
+        (paddle.prod, np.prod),
+    ])
+    def test_full_reduce(self, op, ref):
+        x = _f32(3, 4)
+        check_output(lambda x: op(x), {"x": x}, expected=ref(x), rtol=1e-4)
+
+    @pytest.mark.parametrize("axis,keepdim", [(0, False), (1, True),
+                                              ([0, 1], False)])
+    def test_sum_axis(self, axis, keepdim):
+        x = _f32(3, 4)
+        check_output(lambda x: paddle.sum(x, axis=axis, keepdim=keepdim),
+                     {"x": x},
+                     expected=np.sum(x, axis=tuple(axis) if isinstance(
+                         axis, list) else axis, keepdims=keepdim))
+
+    def test_mean_grad(self):
+        check_grad(lambda x: paddle.mean(x), {"x": _f32(3, 4)})
+
+    def test_argmax(self):
+        x = _f32(3, 4)
+        out = paddle.argmax(paddle.to_tensor(x), axis=1)
+        np.testing.assert_array_equal(out.numpy(), np.argmax(x, 1))
+        # TPU-native deviation: 64-bit ints demote to int32 (XLA x64-off
+        # semantics); index dtypes are int32 on device
+        assert out.dtype in ("int32", "int64")
+
+    def test_std_var(self):
+        x = _f32(5, 6)
+        check_output(lambda x: paddle.std(x), {"x": x},
+                     expected=np.std(x, ddof=1), rtol=1e-4)
+        check_output(lambda x: paddle.var(x), {"x": x},
+                     expected=np.var(x, ddof=1), rtol=1e-4)
+
+    def test_logsumexp(self):
+        x = _f32(3, 4)
+        from scipy.special import logsumexp as ref_lse
+
+        check_output(lambda x: paddle.logsumexp(x, axis=1), {"x": x},
+                     expected=ref_lse(x, axis=1), rtol=1e-5)
+
+
+class TestScaleClip:
+    def test_scale(self):
+        x = _f32(3, 4)
+        check_output(lambda x: paddle.scale(x, scale=2.0, bias=1.0),
+                     {"x": x}, expected=x * 2 + 1)
+
+    def test_clip(self):
+        x = RNG.uniform(-2, 2, (3, 4)).astype(np.float32)
+        check_output(lambda x: paddle.clip(x, min=-0.5, max=0.5), {"x": x},
+                     expected=np.clip(x, -0.5, 0.5))
+
+    def test_pow(self):
+        x = _f32(3, 4)
+        check_output(lambda x: paddle.pow(x, 3.0), {"x": x},
+                     expected=x**3.0, rtol=1e-4)
+
+    def test_cumsum(self):
+        x = _f32(3, 4)
+        check_output(lambda x: paddle.cumsum(x, axis=1), {"x": x},
+                     expected=np.cumsum(x, 1))

@@ -1,0 +1,266 @@
+"""Optimizer tests (reference test_sgd_op.py, test_adam_op.py,
+test_adamw_op.py, test_momentum_op.py + lr scheduler tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+
+RNG = np.random.RandomState(9)
+
+
+def _param(shape, val=None):
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.tensor import Parameter
+
+    v = val if val is not None else RNG.rand(*shape).astype(np.float32)
+    return Parameter(jnp.asarray(v))
+
+
+def _set_grad(p, g):
+    p.grad = paddle.to_tensor(g.astype(np.float32))
+
+
+class TestSGD:
+    def test_sgd_step(self):
+        w0 = RNG.rand(3, 4).astype(np.float32)
+        g = RNG.rand(3, 4).astype(np.float32)
+        p = _param((3, 4), w0)
+        opt = optimizer.SGD(learning_rate=0.1, parameters=[p])
+        _set_grad(p, g)
+        opt.step()
+        np.testing.assert_allclose(p.numpy(), w0 - 0.1 * g, rtol=1e-6)
+
+    def test_weight_decay(self):
+        w0 = RNG.rand(3).astype(np.float32)
+        g = RNG.rand(3).astype(np.float32)
+        p = _param((3,), w0)
+        opt = optimizer.SGD(learning_rate=0.1, parameters=[p],
+                            weight_decay=0.01)
+        _set_grad(p, g)
+        opt.step()
+        np.testing.assert_allclose(p.numpy(), w0 - 0.1 * (g + 0.01 * w0),
+                                   rtol=1e-5)
+
+
+class TestMomentum:
+    def test_two_steps(self):
+        w0 = RNG.rand(4).astype(np.float32)
+        g = RNG.rand(4).astype(np.float32)
+        p = _param((4,), w0)
+        opt = optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                 parameters=[p])
+        _set_grad(p, g)
+        opt.step()
+        _set_grad(p, g)
+        opt.step()
+        v1 = g
+        w1 = w0 - 0.1 * v1
+        v2 = 0.9 * v1 + g
+        w2 = w1 - 0.1 * v2
+        np.testing.assert_allclose(p.numpy(), w2, rtol=1e-5)
+
+
+class TestAdam:
+    def test_adam_reference(self):
+        w0 = RNG.rand(5).astype(np.float32)
+        g = RNG.rand(5).astype(np.float32)
+        p = _param((5,), w0)
+        opt = optimizer.Adam(learning_rate=0.01, parameters=[p])
+        _set_grad(p, g)
+        opt.step()
+        m = 0.1 * g
+        v = 0.001 * g * g
+        mh = m / (1 - 0.9)
+        vh = v / (1 - 0.999)
+        ref = w0 - 0.01 * mh / (np.sqrt(vh) + 1e-8)
+        np.testing.assert_allclose(p.numpy(), ref, rtol=1e-5)
+
+    def test_adamw_decoupled(self):
+        w0 = RNG.rand(5).astype(np.float32)
+        g = np.zeros(5, np.float32)
+        p = _param((5,), w0)
+        opt = optimizer.AdamW(learning_rate=0.1, parameters=[p],
+                              weight_decay=0.1)
+        _set_grad(p, g)
+        opt.step()
+        # zero grad → only decoupled decay applies
+        np.testing.assert_allclose(p.numpy(), w0 * (1 - 0.1 * 0.1), rtol=1e-5)
+
+    def test_bf16_param_fp32_moments(self):
+        p = _param((4,), RNG.rand(4).astype(np.float32))
+        p._value = p._value.astype("bfloat16")
+        opt = optimizer.Adam(learning_rate=0.01, parameters=[p])
+        _set_grad(p, RNG.rand(4))
+        opt.step()
+        assert p.dtype == "bfloat16"
+        (slot,) = [v for (s, _), v in opt._accumulators.items()
+                   if s == "moment1"]
+        assert str(slot.dtype) == "float32"
+
+
+class TestTraining:
+    def test_model_converges(self):
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(4, 16), nn.Tanh(), nn.Linear(16, 1))
+        opt = optimizer.Adam(learning_rate=0.05,
+                             parameters=model.parameters())
+        x = paddle.to_tensor(RNG.rand(64, 4).astype(np.float32))
+        w_true = np.array([[1.0], [-2.0], [0.5], [3.0]], np.float32)
+        y = paddle.to_tensor(x.numpy() @ w_true)
+        first = None
+        for i in range(60):
+            pred = model(x)
+            loss = ((pred - y) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            if first is None:
+                first = float(loss)
+        assert float(loss) < first * 0.05
+
+    def test_grad_clip_global_norm(self):
+        p = _param((4,), np.zeros(4, np.float32))
+        opt = optimizer.SGD(
+            learning_rate=1.0, parameters=[p],
+            grad_clip=optimizer.ClipGradByGlobalNorm(1.0))
+        _set_grad(p, np.full(4, 10.0))
+        opt.step()
+        # grad norm 20 → clipped to norm 1
+        np.testing.assert_allclose(np.linalg.norm(p.numpy()), 1.0, rtol=1e-4)
+
+
+class TestLRSchedulers:
+    def test_step_decay(self):
+        sch = optimizer.lr.StepDecay(0.1, step_size=2, gamma=0.5)
+        lrs = []
+        for _ in range(5):
+            lrs.append(round(sch(), 5))
+            sch.step()
+        assert lrs == [0.1, 0.1, 0.05, 0.05, 0.025]
+
+    def test_cosine(self):
+        sch = optimizer.lr.CosineAnnealingDecay(1.0, T_max=10)
+        assert abs(sch() - 1.0) < 1e-6
+        for _ in range(10):
+            sch.step()
+        assert sch() < 1e-6
+
+    def test_warmup(self):
+        sch = optimizer.lr.LinearWarmup(0.1, warmup_steps=5, start_lr=0.0,
+                                        end_lr=0.1)
+        first = sch()
+        for _ in range(5):
+            sch.step()
+        assert first < 0.1 and abs(sch() - 0.1) < 1e-6
+
+    def test_optimizer_uses_scheduler(self):
+        p = _param((2,), np.zeros(2, np.float32))
+        sch = optimizer.lr.StepDecay(1.0, step_size=1, gamma=0.1)
+        opt = optimizer.SGD(learning_rate=sch, parameters=[p])
+        _set_grad(p, np.ones(2))
+        opt.step()
+        np.testing.assert_allclose(p.numpy(), [-1.0, -1.0], rtol=1e-6)
+        sch.step()
+        _set_grad(p, np.ones(2))
+        opt.step()
+        np.testing.assert_allclose(p.numpy(), [-1.1, -1.1], rtol=1e-5)
+
+    def test_noam(self):
+        sch = optimizer.lr.NoamDecay(d_model=512, warmup_steps=10)
+        vals = []
+        for _ in range(20):
+            vals.append(sch())
+            sch.step()
+        peak = int(np.argmax(vals))
+        assert 8 <= peak <= 11
+
+
+class TestStateDict:
+    def test_optimizer_state_roundtrip(self):
+        p = _param((3,), RNG.rand(3).astype(np.float32))
+        opt = optimizer.Adam(learning_rate=0.01, parameters=[p])
+        _set_grad(p, RNG.rand(3))
+        opt.step()
+        sd = opt.state_dict()
+        opt2 = optimizer.Adam(learning_rate=0.01, parameters=[p])
+        opt2.set_state_dict(sd)
+        assert opt2._global_step == 1
+
+
+class TestReviewRegressions:
+    """Regressions for the round-1 code-review findings."""
+
+    def test_l2decay_object(self):
+        from paddle_tpu.optimizer.optimizer import L2Decay
+
+        p = _param((3,), np.ones(3, np.float32))
+        opt = optimizer.SGD(learning_rate=0.1, parameters=[p],
+                            weight_decay=L2Decay(0.5))
+        _set_grad(p, np.zeros(3))
+        opt.step()
+        np.testing.assert_allclose(p.numpy(), 1 - 0.1 * 0.5, rtol=1e-5)
+
+    def test_state_roundtrip_to_fresh_optimizer(self):
+        w = RNG.rand(3).astype(np.float32)
+        g = RNG.rand(3).astype(np.float32)
+        p1 = _param((3,), w)
+        opt1 = optimizer.Adam(learning_rate=0.01, parameters=[p1])
+        _set_grad(p1, g)
+        opt1.step()
+        sd = opt1.state_dict()
+        # fresh process simulation: new param objects, same order
+        p2 = _param((3,), np.asarray(p1.numpy()))
+        opt2 = optimizer.Adam(learning_rate=0.01, parameters=[p2])
+        opt2.set_state_dict(sd)
+        _set_grad(p1, g)
+        opt1.step()
+        _set_grad(p2, g)
+        opt2.step()
+        np.testing.assert_allclose(p1.numpy(), p2.numpy(), rtol=1e-6)
+
+    def test_adamw_apply_decay_param_fun(self):
+        w = RNG.rand(3).astype(np.float32)
+        p = _param((3,), w)
+        p.name = "layer.bias"
+        opt = optimizer.AdamW(
+            learning_rate=0.1, parameters=[p], weight_decay=0.5,
+            apply_decay_param_fun=lambda n: "bias" not in n)
+        _set_grad(p, np.zeros(3))
+        opt.step()
+        # excluded from decay and zero grad → param unchanged
+        np.testing.assert_allclose(p.numpy(), w, rtol=1e-6)
+
+    def test_momentum_instances_independent(self):
+        w = np.ones(2, np.float32)
+        p1, p2 = _param((2,), w), _param((2,), w)
+        o1 = optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                parameters=[p1])
+        o2 = optimizer.Momentum(learning_rate=0.1, momentum=0.0,
+                                parameters=[p2])
+        for o, p in ((o1, p1), (o2, p2)):
+            _set_grad(p, np.ones(2))
+            o.step()
+            _set_grad(p, np.ones(2))
+            o.step()
+        # mu=0.9: w - .1(1) - .1(1.9); mu=0: w - .1 - .1
+        np.testing.assert_allclose(p1.numpy(), 1 - 0.1 - 0.19, rtol=1e-5)
+        np.testing.assert_allclose(p2.numpy(), 1 - 0.2, rtol=1e-5)
+
+    def test_rmsprop_centered_momentum_compiled_path(self):
+        # functional_apply must honor rho/momentum/centered
+        import jax.numpy as jnp
+
+        p = _param((3,), np.ones(3, np.float32))
+        opt = optimizer.RMSProp(learning_rate=0.1, rho=0.9, momentum=0.5,
+                                centered=True, parameters=[p])
+        state = opt.functional_init({"w": p._value})
+        g = np.full(3, 2.0, np.float32)
+        newp, news = opt.functional_apply(
+            {"w": p._value}, {"w": jnp.asarray(g)}, state, step=1)
+        ms = 0.1 * 4.0
+        mg = 0.1 * 2.0
+        denom = np.sqrt(ms - mg**2 + 1e-6)
+        mom = 0.1 * 2.0 / denom
+        np.testing.assert_allclose(np.asarray(newp["w"]), 1 - mom, rtol=1e-4)
