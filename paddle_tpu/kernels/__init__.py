@@ -1,0 +1,7 @@
+"""Hand-written TPU kernels (Pallas).
+
+The reference's fused CUDA ops (operators/fused/fused_attention_op.cu,
+fused_multi_transformer, fmha) map here: only the ops XLA cannot fuse well
+get kernels — flash attention, ring attention (long context over ICI), and
+MoE dispatch helpers. Everything else rides XLA fusion.
+"""

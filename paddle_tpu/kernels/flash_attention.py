@@ -1,0 +1,159 @@
+"""Flash attention (Pallas, TPU).
+
+Replaces the reference's fused attention CUDA ops
+(/root/reference/paddle/fluid/operators/fused/fused_attention_op.cu and the
+fmha wrappers): blocked online-softmax attention that never materializes the
+[N, N] score matrix in HBM. Forward is a Pallas kernel tiled for the MXU
+(block 128, fp32 accumulators); backward is the standard recompute-form
+attention VJP expressed in XLA (fused well; a Pallas backward is a later
+optimization). Layout follows the framework convention [B, N, H, D].
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+NEG_INF = -1e30
+
+
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_k,
+               kv_len):
+    """One (batch*head, q_block) program: stream kv blocks with online
+    softmax. Refs: q [1, bq, d]; k/v [1, kv_len, d]; o [1, bq, d]."""
+    _, bq, d = q_ref.shape
+    q_idx = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale
+
+    m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+
+    num_kv = kv_len // block_k
+
+    def body(kv_i, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(kv_i * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(kv_i * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [bq, block_k]
+        if causal:
+            q_pos = q_idx * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0)
+            k_pos = kv_i * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + jnp.sum(p, axis=1, keepdims=True)
+        acc_new = alpha * acc + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    if causal:
+        # only kv blocks at or before this q block contribute
+        upper = jnp.minimum(num_kv, (q_idx + 1) * bq // block_k + 1)
+    else:
+        upper = num_kv
+    m, l, acc = jax.lax.fori_loop(0, upper, body, (m0, l0, acc0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_fwd_bhnd(q, k, v, scale, causal, block_q, block_k, interpret):
+    """q,k,v: [BH, N, D] (heads folded into batch)."""
+    bh, n, d = q.shape
+    kv_len = k.shape[1]
+    grid = (bh, n // block_q)
+    kernel = functools.partial(
+        _fa_kernel, scale=scale, causal=causal, block_k=block_k,
+        kv_len=kv_len)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, kv_len, d), lambda b, i: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, kv_len, d), lambda b, i: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((bh, n, d), q.dtype),
+        interpret=interpret,
+    )(q, k, v)
+
+
+def _reference_attention(q, k, v, scale, causal):
+    """[BH, N, D] fp32-statistics attention — the VJP recompute form."""
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    logits = jnp.einsum("bnd,bmd->bnm", qf, kf) * scale
+    if causal:
+        n, m = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((n, m), bool), k=m - n)
+        logits = jnp.where(mask, logits, NEG_INF)
+    p = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bnm,bmd->bnd", p.astype(v.dtype), v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_core(q, k, v, scale, causal, block_q, block_k, interpret):
+    return _flash_fwd_bhnd(q, k, v, scale, causal, block_q, block_k,
+                           interpret)
+
+
+def _flash_core_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+    out = _flash_fwd_bhnd(q, k, v, scale, causal, block_q, block_k,
+                          interpret)
+    return out, (q, k, v)
+
+
+def _flash_core_bwd(scale, causal, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    # recompute-form VJP: XLA fuses the rebuilt softmax with the grads
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _reference_attention(q_, k_, v_, scale, causal),
+        q, k, v)
+    return vjp(g)
+
+
+_flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
+
+
+def flash_attention(q, k, v, causal=False, scale=None,
+                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
+                    interpret=None):
+    """q,k,v: [B, N, H, D] jax arrays. Returns [B, N, H, D]."""
+    b, n, h, d = q.shape
+    kv_n = k.shape[1]
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    block_q = min(block_q, n)
+    block_k = min(block_k, kv_n)
+    if n % block_q or kv_n % block_k:
+        return jnp.swapaxes(
+            _reference_attention(
+                jnp.swapaxes(q, 1, 2).reshape(b * h, n, d),
+                jnp.swapaxes(k, 1, 2).reshape(b * h, kv_n, d),
+                jnp.swapaxes(v, 1, 2).reshape(b * h, kv_n, d),
+                scale, causal).reshape(b, h, n, d), 1, 2)
+
+    def fold(x):
+        return jnp.swapaxes(x, 1, 2).reshape(b * h, x.shape[1], d)
+
+    out = _flash_core(fold(q), fold(k), fold(v), scale, causal, block_q,
+                      block_k, interpret)
+    return jnp.swapaxes(out.reshape(b, h, n, d), 1, 2)

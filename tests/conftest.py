@@ -16,14 +16,13 @@ if "host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8").strip()
 
 # jax may already be imported (pytest plugin autoload) with the ambient
-# JAX_PLATFORMS=axon — force the config to cpu post-import and drop the
-# axon/tpu plugin factories so backend init cannot touch the tunnel.
+# JAX_PLATFORMS=axon — force the config to cpu post-import so backends()
+# only initializes the CPU client and never dials the TPU tunnel. (Do NOT
+# pop the axon/tpu backend factories: 'tpu' must stay a known platform or
+# pallas fails to import.)
 try:
     import jax as _jax
-    from jax._src import xla_bridge as _xb
 
     _jax.config.update("jax_platforms", "cpu")
-    _xb._backend_factories.pop("axon", None)
-    _xb._backend_factories.pop("tpu", None)
 except Exception:
     pass
