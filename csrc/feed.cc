@@ -1,0 +1,211 @@
+// High-throughput data feed — native analog of the reference's DataFeed /
+// InMemoryDataFeed (/root/reference/paddle/fluid/framework/data_feed.h:1083,
+// :1325): multi-threaded file readers pushing length-prefixed binary records
+// through a bounded channel with an optional shuffle buffer. The TPU input
+// pipeline consumes records on the host and batches them into pinned numpy
+// buffers for device_put.
+//
+// Record file format ("ptrec"): [u64 magic][u32 len][bytes]...  (len==0 EOF ok)
+//
+// C ABI:
+//   pt_feed_create(queue_cap, shuffle_buf, seed) -> handle
+//   pt_feed_add_file(h, path)
+//   pt_feed_start(h, num_threads)
+//   pt_feed_next(h, buf, cap) -> len | 0 (end of data) | -2 (cap too small)
+//   pt_feed_destroy(h)
+//   pt_feed_write_open(path) / pt_feed_write_record(f, buf, len) /
+//   pt_feed_write_close(f)   (writer used by tests + dataset converters)
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <condition_variable>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x70747265635f3031ULL;  // "ptrec_01"
+
+struct Feed {
+  std::vector<std::string> files;
+  size_t queue_cap;
+  size_t shuffle_buf;
+  uint64_t seed;
+
+  std::mutex mu;
+  std::condition_variable cv_push, cv_pop;
+  std::deque<std::string> queue;
+  std::vector<std::string> shuffle_pool;
+  std::mt19937_64 rng;
+  size_t next_file = 0;
+  int live_readers = 0;
+  bool started = false;
+  bool stopping = false;
+  std::vector<std::thread> readers;
+};
+
+std::mutex g_mu;
+std::map<int, Feed*> g_feeds;
+int g_next = 1;
+
+Feed* GetFeed(int h) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  auto it = g_feeds.find(h);
+  return it == g_feeds.end() ? nullptr : it->second;
+}
+
+void PushRecord(Feed* f, std::string rec) {
+  std::unique_lock<std::mutex> lk(f->mu);
+  if (f->shuffle_buf > 0) {
+    f->shuffle_pool.push_back(std::move(rec));
+    if (f->shuffle_pool.size() < f->shuffle_buf) return;
+    size_t i = f->rng() % f->shuffle_pool.size();
+    std::swap(f->shuffle_pool[i], f->shuffle_pool.back());
+    rec = std::move(f->shuffle_pool.back());
+    f->shuffle_pool.pop_back();
+  }
+  f->cv_push.wait(lk, [&] { return f->stopping || f->queue.size() < f->queue_cap; });
+  if (f->stopping) return;
+  f->queue.push_back(std::move(rec));
+  f->cv_pop.notify_one();
+}
+
+void ReaderLoop(Feed* f) {
+  for (;;) {
+    std::string path;
+    {
+      std::lock_guard<std::mutex> lk(f->mu);
+      if (f->stopping || f->next_file >= f->files.size()) break;
+      path = f->files[f->next_file++];
+    }
+    FILE* fp = fopen(path.c_str(), "rb");
+    if (fp == nullptr) continue;
+    uint64_t magic = 0;
+    if (fread(&magic, 8, 1, fp) != 1 || magic != kMagic) {
+      fclose(fp);
+      continue;
+    }
+    for (;;) {
+      uint32_t len;
+      if (fread(&len, 4, 1, fp) != 1 || len == 0 || len > (256u << 20)) break;
+      std::string rec(len, '\0');
+      if (fread(&rec[0], 1, len, fp) != len) break;
+      PushRecord(f, std::move(rec));
+      {
+        std::lock_guard<std::mutex> lk(f->mu);
+        if (f->stopping) break;
+      }
+    }
+    fclose(fp);
+  }
+  // last reader drains the shuffle pool
+  std::unique_lock<std::mutex> lk(f->mu);
+  if (--f->live_readers == 0) {
+    while (!f->shuffle_pool.empty() && !f->stopping) {
+      size_t i = f->rng() % f->shuffle_pool.size();
+      std::swap(f->shuffle_pool[i], f->shuffle_pool.back());
+      std::string rec = std::move(f->shuffle_pool.back());
+      f->shuffle_pool.pop_back();
+      f->cv_push.wait(lk, [&] {
+        return f->stopping || f->queue.size() < f->queue_cap;
+      });
+      if (f->stopping) break;
+      f->queue.push_back(std::move(rec));
+      f->cv_pop.notify_one();
+    }
+  }
+  f->cv_pop.notify_all();
+}
+
+}  // namespace
+
+extern "C" {
+
+int pt_feed_create(int queue_cap, int shuffle_buf, uint64_t seed) {
+  auto* f = new Feed();
+  f->queue_cap = queue_cap > 0 ? queue_cap : 1024;
+  f->shuffle_buf = shuffle_buf > 0 ? shuffle_buf : 0;
+  f->seed = seed;
+  f->rng.seed(seed);
+  std::lock_guard<std::mutex> lk(g_mu);
+  int h = g_next++;
+  g_feeds[h] = f;
+  return h;
+}
+
+int pt_feed_add_file(int h, const char* path) {
+  Feed* f = GetFeed(h);
+  if (f == nullptr || f->started) return -1;
+  f->files.emplace_back(path);
+  return 0;
+}
+
+int pt_feed_start(int h, int num_threads) {
+  Feed* f = GetFeed(h);
+  if (f == nullptr || f->started) return -1;
+  f->started = true;
+  int n = num_threads > 0 ? num_threads : 1;
+  f->live_readers = n;
+  for (int i = 0; i < n; ++i) f->readers.emplace_back(ReaderLoop, f);
+  return 0;
+}
+
+int pt_feed_next(int h, void* buf, int cap) {
+  Feed* f = GetFeed(h);
+  if (f == nullptr) return -1;
+  std::unique_lock<std::mutex> lk(f->mu);
+  f->cv_pop.wait(lk, [&] {
+    return f->stopping || !f->queue.empty() || f->live_readers == 0;
+  });
+  if (f->queue.empty()) return 0;  // end of data
+  const std::string& rec = f->queue.front();
+  if (static_cast<int>(rec.size()) > cap) return -2;
+  memcpy(buf, rec.data(), rec.size());
+  int len = static_cast<int>(rec.size());
+  f->queue.pop_front();
+  f->cv_push.notify_one();
+  return len;
+}
+
+void pt_feed_destroy(int h) {
+  Feed* f = nullptr;
+  {
+    std::lock_guard<std::mutex> lk(g_mu);
+    auto it = g_feeds.find(h);
+    if (it == g_feeds.end()) return;
+    f = it->second;
+    g_feeds.erase(it);
+  }
+  {
+    std::lock_guard<std::mutex> lk(f->mu);
+    f->stopping = true;
+  }
+  f->cv_push.notify_all();
+  f->cv_pop.notify_all();
+  for (auto& t : f->readers)
+    if (t.joinable()) t.join();
+  delete f;
+}
+
+void* pt_feed_write_open(const char* path) {
+  FILE* fp = fopen(path, "wb");
+  if (fp == nullptr) return nullptr;
+  fwrite(&kMagic, 8, 1, fp);
+  return fp;
+}
+
+int pt_feed_write_record(void* fp, const void* buf, int len) {
+  uint32_t l = static_cast<uint32_t>(len);
+  if (fwrite(&l, 4, 1, static_cast<FILE*>(fp)) != 1) return -1;
+  if (fwrite(buf, 1, l, static_cast<FILE*>(fp)) != l) return -1;
+  return 0;
+}
+
+void pt_feed_write_close(void* fp) { fclose(static_cast<FILE*>(fp)); }
+
+}  // extern "C"

@@ -1,0 +1,84 @@
+// Global runtime counters — native analog of the reference's monitor
+// (/root/reference/paddle/fluid/platform/monitor.cc STAT_ADD / StatRegistry)
+// and memory stats (paddle/fluid/memory/stats.cc): named atomic counters
+// with peak tracking, readable from Python for observability.
+//
+// Also hosts the nan/inf scanner used by FLAGS_check_nan_inf on host-side
+// buffers (reference framework/details/nan_inf_utils_detail.cc) — on TPU the
+// in-graph guard handles device tensors; this covers host numpy fast-paths.
+#include <atomic>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+
+namespace {
+
+struct Stat {
+  std::atomic<int64_t> value{0};
+  std::atomic<int64_t> peak{0};
+};
+
+std::mutex g_mu;
+std::map<std::string, Stat*> g_stats;
+
+Stat* GetStat(const char* name) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  auto it = g_stats.find(name);
+  if (it != g_stats.end()) return it->second;
+  Stat* s = new Stat();
+  g_stats[name] = s;
+  return s;
+}
+
+}  // namespace
+
+extern "C" {
+
+void pt_stat_add(const char* name, int64_t delta) {
+  Stat* s = GetStat(name);
+  int64_t nv = s->value.fetch_add(delta) + delta;
+  int64_t peak = s->peak.load();
+  while (nv > peak && !s->peak.compare_exchange_weak(peak, nv)) {
+  }
+}
+
+int64_t pt_stat_get(const char* name) { return GetStat(name)->value.load(); }
+
+int64_t pt_stat_peak(const char* name) { return GetStat(name)->peak.load(); }
+
+void pt_stat_reset(const char* name) {
+  Stat* s = GetStat(name);
+  s->value.store(0);
+  s->peak.store(0);
+}
+
+// Write "name=value;name=value;..." into buf; returns bytes written.
+int pt_stat_dump(char* buf, int cap) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  int off = 0;
+  for (const auto& kv : g_stats) {
+    int n = snprintf(buf + off, cap - off, "%s=%lld;", kv.first.c_str(),
+                     (long long)kv.second->value.load());
+    if (n < 0 || off + n >= cap) break;
+    off += n;
+  }
+  return off;
+}
+
+// Fast host-side nan/inf scan over float32 data. Returns: 0 clean,
+// 1 has nan, 2 has inf, 3 both.
+int pt_check_nan_inf_f32(const float* data, int64_t n) {
+  int flags = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    float v = data[i];
+    if (std::isnan(v)) flags |= 1;
+    else if (std::isinf(v)) flags |= 2;
+    if (flags == 3) break;
+  }
+  return flags;
+}
+
+}  // extern "C"

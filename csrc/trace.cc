@@ -1,0 +1,173 @@
+// Host event tracer — native analog of the reference's host_event_recorder
+// (/root/reference/paddle/fluid/platform/profiler/host_event_recorder.h and
+// host_tracer.cc): thread-local ring of begin/end events with nanosecond
+// timestamps, merged on dump into a chrome-trace JSON file. The device side
+// is XLA/Xprof's job on TPU; this covers the host half (op dispatch, data
+// loading, step loop) exactly like the reference's HostTraceLevel recorder.
+//
+// C ABI (loaded via ctypes from paddle_tpu/core/native.py):
+//   pt_trace_enable(level) / pt_trace_disable()
+//   pt_trace_push(name, level) / pt_trace_pop()
+//   pt_trace_instant(name, level)
+//   pt_trace_counter(name, value)
+//   pt_trace_dump(path) -> 0 ok
+//   pt_trace_clear()
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+inline int64_t NowNs() {
+  return std::chrono::duration_cast<std::chrono::nanoseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+struct Event {
+  std::string name;
+  int64_t ts_ns;
+  int64_t dur_ns;  // -1 => instant, -2 => counter
+  int64_t value;   // counter value
+  uint64_t tid;
+};
+
+struct ThreadBuf {
+  std::vector<Event> events;
+  std::vector<size_t> open;  // stack of indices into events
+  uint64_t tid;
+};
+
+std::mutex g_mu;
+std::vector<ThreadBuf*> g_bufs;          // all thread buffers, never freed
+std::atomic<int> g_level{0};             // 0 = disabled
+std::atomic<uint64_t> g_next_tid{1};
+
+ThreadBuf* LocalBuf() {
+  thread_local ThreadBuf* buf = nullptr;
+  if (buf == nullptr) {
+    buf = new ThreadBuf();
+    buf->tid = g_next_tid.fetch_add(1);
+    std::lock_guard<std::mutex> lk(g_mu);
+    g_bufs.push_back(buf);
+  }
+  return buf;
+}
+
+void JsonEscape(const std::string& s, std::string* out) {
+  for (char c : s) {
+    switch (c) {
+      case '"': *out += "\\\""; break;
+      case '\\': *out += "\\\\"; break;
+      case '\n': *out += "\\n"; break;
+      case '\t': *out += "\\t"; break;
+      default:
+        if (static_cast<unsigned char>(c) < 0x20) {
+          char hex[8];
+          snprintf(hex, sizeof(hex), "\\u%04x", c);
+          *out += hex;
+        } else {
+          *out += c;
+        }
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void pt_trace_enable(int level) { g_level.store(level > 0 ? level : 1); }
+void pt_trace_disable() { g_level.store(0); }
+int pt_trace_level() { return g_level.load(); }
+
+void pt_trace_push(const char* name, int level) {
+  if (g_level.load() < level) return;
+  ThreadBuf* b = LocalBuf();
+  b->open.push_back(b->events.size());
+  b->events.push_back({name ? name : "?", NowNs(), 0, 0, b->tid});
+}
+
+void pt_trace_pop() {
+  if (g_level.load() <= 0) return;
+  ThreadBuf* b = LocalBuf();
+  if (b->open.empty()) return;
+  size_t i = b->open.back();
+  b->open.pop_back();
+  b->events[i].dur_ns = NowNs() - b->events[i].ts_ns;
+}
+
+void pt_trace_instant(const char* name, int level) {
+  if (g_level.load() < level) return;
+  ThreadBuf* b = LocalBuf();
+  b->events.push_back({name ? name : "?", NowNs(), -1, 0, b->tid});
+}
+
+void pt_trace_counter(const char* name, int64_t value) {
+  if (g_level.load() <= 0) return;
+  ThreadBuf* b = LocalBuf();
+  b->events.push_back({name ? name : "?", NowNs(), -2, value, b->tid});
+}
+
+void pt_trace_clear() {
+  std::lock_guard<std::mutex> lk(g_mu);
+  for (ThreadBuf* b : g_bufs) {
+    b->events.clear();
+    b->open.clear();
+  }
+}
+
+int64_t pt_trace_event_count() {
+  std::lock_guard<std::mutex> lk(g_mu);
+  int64_t n = 0;
+  for (ThreadBuf* b : g_bufs) n += static_cast<int64_t>(b->events.size());
+  return n;
+}
+
+// Dump all events as chrome-trace JSON (catapult "traceEvents" format, same
+// target format as the reference's chrometracing_logger.cc).
+int pt_trace_dump(const char* path) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  FILE* f = fopen(path, "w");
+  if (f == nullptr) return -1;
+  fputs("{\"traceEvents\":[\n", f);
+  bool first = true;
+  for (ThreadBuf* b : g_bufs) {
+    for (const Event& e : b->events) {
+      std::string name;
+      JsonEscape(e.name, &name);
+      double ts_us = e.ts_ns / 1000.0;
+      if (!first) fputs(",\n", f);
+      first = false;
+      if (e.dur_ns == -1) {
+        fprintf(f,
+                "{\"name\":\"%s\",\"ph\":\"i\",\"ts\":%.3f,\"pid\":0,"
+                "\"tid\":%llu,\"s\":\"t\"}",
+                name.c_str(), ts_us, (unsigned long long)e.tid);
+      } else if (e.dur_ns == -2) {
+        fprintf(f,
+                "{\"name\":\"%s\",\"ph\":\"C\",\"ts\":%.3f,\"pid\":0,"
+                "\"tid\":%llu,\"args\":{\"value\":%lld}}",
+                name.c_str(), ts_us, (unsigned long long)e.tid,
+                (long long)e.value);
+      } else {
+        fprintf(f,
+                "{\"name\":\"%s\",\"ph\":\"X\",\"ts\":%.3f,\"dur\":%.3f,"
+                "\"pid\":0,\"tid\":%llu}",
+                name.c_str(), ts_us, e.dur_ns / 1000.0,
+                (unsigned long long)e.tid);
+      }
+    }
+  }
+  fputs("\n]}\n", f);
+  fclose(f);
+  return 0;
+}
+
+}  // extern "C"

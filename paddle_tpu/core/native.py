@@ -1,0 +1,161 @@
+"""Loader for the native C++ runtime core (csrc/ -> libpaddle_tpu_core.so).
+
+The reference framework's runtime services are native C++ (profiler host
+event recorder paddle/fluid/platform/profiler/, TCP comm bootstrap
+platform/gen_comm_id_helper.cc, DataFeed framework/data_feed.h, monitor
+platform/monitor.cc). This module loads our C++ equivalents via ctypes,
+building the shared library on first use (g++ is always present in the
+toolchain; there is no pybind11 in this environment — ctypes is the
+binding layer, mirroring the reference's pybind role at
+paddle/fluid/pybind/pybind.cc).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_LIB = None
+_LOCK = threading.Lock()
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_LIB_PATH = os.path.join(_REPO_ROOT, "paddle_tpu", "lib",
+                         "libpaddle_tpu_core.so")
+_CSRC = os.path.join(_REPO_ROOT, "csrc")
+
+
+def _build():
+    srcs = [os.path.join(_CSRC, f)
+            for f in ("trace.cc", "store.cc", "feed.cc", "stats.cc")]
+    os.makedirs(os.path.dirname(_LIB_PATH), exist_ok=True)
+    cmd = ["g++", "-O2", "-std=c++17", "-fPIC", "-Wall", "-pthread",
+           "-shared", "-o", _LIB_PATH] + srcs
+    subprocess.run(cmd, check=True, capture_output=True)
+
+
+def _needs_rebuild():
+    if not os.path.exists(_LIB_PATH):
+        return True
+    lib_mtime = os.path.getmtime(_LIB_PATH)
+    try:
+        return any(
+            os.path.getmtime(os.path.join(_CSRC, f)) > lib_mtime
+            for f in os.listdir(_CSRC) if f.endswith(".cc"))
+    except OSError:
+        return False
+
+
+def _declare(lib):
+    c = ctypes
+    # trace.cc
+    lib.pt_trace_enable.argtypes = [c.c_int]
+    lib.pt_trace_disable.argtypes = []
+    lib.pt_trace_level.restype = c.c_int
+    lib.pt_trace_push.argtypes = [c.c_char_p, c.c_int]
+    lib.pt_trace_pop.argtypes = []
+    lib.pt_trace_instant.argtypes = [c.c_char_p, c.c_int]
+    lib.pt_trace_counter.argtypes = [c.c_char_p, c.c_int64]
+    lib.pt_trace_dump.argtypes = [c.c_char_p]
+    lib.pt_trace_dump.restype = c.c_int
+    lib.pt_trace_event_count.restype = c.c_int64
+    # store.cc
+    lib.pt_store_server_start.argtypes = [c.c_int]
+    lib.pt_store_server_start.restype = c.c_int
+    lib.pt_store_server_port.argtypes = [c.c_int]
+    lib.pt_store_server_port.restype = c.c_int
+    lib.pt_store_server_stop.argtypes = [c.c_int]
+    lib.pt_store_connect.argtypes = [c.c_char_p, c.c_int, c.c_int]
+    lib.pt_store_connect.restype = c.c_int
+    lib.pt_store_close.argtypes = [c.c_int]
+    lib.pt_store_set.argtypes = [c.c_int, c.c_char_p, c.c_char_p, c.c_int]
+    lib.pt_store_set.restype = c.c_int
+    lib.pt_store_get.argtypes = [c.c_int, c.c_char_p, c.c_void_p, c.c_int,
+                                 c.c_int64]
+    lib.pt_store_get.restype = c.c_int
+    lib.pt_store_add.argtypes = [c.c_int, c.c_char_p, c.c_int64,
+                                 c.POINTER(c.c_int64)]
+    lib.pt_store_add.restype = c.c_int
+    lib.pt_store_delete.argtypes = [c.c_int, c.c_char_p]
+    lib.pt_store_delete.restype = c.c_int
+    # feed.cc
+    lib.pt_feed_create.argtypes = [c.c_int, c.c_int, c.c_uint64]
+    lib.pt_feed_create.restype = c.c_int
+    lib.pt_feed_add_file.argtypes = [c.c_int, c.c_char_p]
+    lib.pt_feed_add_file.restype = c.c_int
+    lib.pt_feed_start.argtypes = [c.c_int, c.c_int]
+    lib.pt_feed_start.restype = c.c_int
+    lib.pt_feed_next.argtypes = [c.c_int, c.c_void_p, c.c_int]
+    lib.pt_feed_next.restype = c.c_int
+    lib.pt_feed_destroy.argtypes = [c.c_int]
+    lib.pt_feed_write_open.argtypes = [c.c_char_p]
+    lib.pt_feed_write_open.restype = c.c_void_p
+    lib.pt_feed_write_record.argtypes = [c.c_void_p, c.c_char_p, c.c_int]
+    lib.pt_feed_write_record.restype = c.c_int
+    lib.pt_feed_write_close.argtypes = [c.c_void_p]
+    # stats.cc
+    lib.pt_stat_add.argtypes = [c.c_char_p, c.c_int64]
+    lib.pt_stat_get.argtypes = [c.c_char_p]
+    lib.pt_stat_get.restype = c.c_int64
+    lib.pt_stat_peak.argtypes = [c.c_char_p]
+    lib.pt_stat_peak.restype = c.c_int64
+    lib.pt_stat_reset.argtypes = [c.c_char_p]
+    lib.pt_stat_dump.argtypes = [c.c_char_p, c.c_int]
+    lib.pt_stat_dump.restype = c.c_int
+    return lib
+
+
+def get_lib():
+    """Load (building if needed) the native core; returns the ctypes CDLL."""
+    global _LIB
+    if _LIB is not None:
+        return _LIB
+    with _LOCK:
+        if _LIB is not None:
+            return _LIB
+        if _needs_rebuild():
+            _build()
+        _LIB = _declare(ctypes.CDLL(_LIB_PATH))
+    return _LIB
+
+
+def available():
+    try:
+        get_lib()
+        return True
+    except Exception:
+        return False
+
+
+# ---- thin pythonic wrappers -------------------------------------------------
+
+class Stats:
+    """Named global counters (reference platform/monitor.cc STAT_ADD)."""
+
+    @staticmethod
+    def add(name, delta=1):
+        get_lib().pt_stat_add(name.encode(), int(delta))
+
+    @staticmethod
+    def get(name):
+        return int(get_lib().pt_stat_get(name.encode()))
+
+    @staticmethod
+    def peak(name):
+        return int(get_lib().pt_stat_peak(name.encode()))
+
+    @staticmethod
+    def reset(name):
+        get_lib().pt_stat_reset(name.encode())
+
+    @staticmethod
+    def dump():
+        buf = ctypes.create_string_buffer(1 << 16)
+        n = get_lib().pt_stat_dump(buf, len(buf))
+        out = {}
+        for part in buf.raw[:n].decode().split(";"):
+            if "=" in part:
+                k, v = part.split("=", 1)
+                out[k] = int(v)
+        return out

@@ -25,7 +25,14 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+try:
+    from jax import shard_map as _shard_map
+
+    def shard_map(f, **kw):  # jax>=0.8 renamed check_rep -> check_vma
+        kw["check_vma"] = kw.pop("check_rep", False)
+        return _shard_map(f, **kw)
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
 
 from ..core.tensor import Tensor
 from . import mesh as _mesh

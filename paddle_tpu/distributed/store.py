@@ -1,0 +1,113 @@
+"""TCPStore — distributed KV rendezvous over the native C++ store.
+
+Parity: the reference bootstraps NCCL comm rings by TCP-broadcasting unique
+ids (paddle/fluid/platform/gen_comm_id_helper.cc:396) and init_parallel_env
+starts a master TCP store (python/paddle/distributed/parallel.py:108). On
+TPU there are no comm ids to exchange — XLA owns the collectives — but the
+multi-host launch/elastic subsystems still need rendezvous: rank
+registration, coordinator discovery, barriers, heartbeats. The wire
+implementation is csrc/store.cc (C++ threads + sockets), loaded via ctypes.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+
+from ..core import native
+
+
+class TCPStore:
+    """KV store client; rank 0 also hosts the server (is_master=True)."""
+
+    def __init__(self, host="127.0.0.1", port=0, is_master=False,
+                 timeout_s=300):
+        self._lib = native.get_lib()
+        self._server = None
+        self.timeout_ms = int(timeout_s * 1000)
+        if is_master:
+            self._server = self._lib.pt_store_server_start(port)
+            if self._server < 0:
+                raise RuntimeError("TCPStore: failed to bind port %d" % port)
+            port = self._lib.pt_store_server_port(self._server)
+        self.host, self.port = host, port
+        self._fd = self._lib.pt_store_connect(
+            host.encode(), port, self.timeout_ms)
+        if self._fd < 0:
+            if self._server is not None:
+                self._lib.pt_store_server_stop(self._server)
+            raise RuntimeError(
+                "TCPStore: cannot connect to %s:%d" % (host, port))
+
+    @property
+    def is_master(self):
+        return self._server is not None
+
+    def set(self, key, value):
+        if isinstance(value, str):
+            value = value.encode()
+        rc = self._lib.pt_store_set(self._fd, key.encode(), value, len(value))
+        if rc != 0:
+            raise RuntimeError("TCPStore.set(%r) failed" % key)
+
+    def get(self, key, timeout_s=None):
+        """Blocking get: waits until the key exists or timeout (then None)."""
+        to = self.timeout_ms if timeout_s is None else int(timeout_s * 1000)
+        cap = 1 << 16
+        while True:
+            buf = ctypes.create_string_buffer(cap)
+            n = self._lib.pt_store_get(self._fd, key.encode(), buf, cap, to)
+            if n == -2:
+                cap *= 16
+                continue
+            if n < 0:
+                return None
+            return buf.raw[:n]
+
+    def add(self, key, delta=1):
+        out = ctypes.c_int64()
+        rc = self._lib.pt_store_add(self._fd, key.encode(), int(delta),
+                                    ctypes.byref(out))
+        if rc != 0:
+            raise RuntimeError("TCPStore.add(%r) failed" % key)
+        return int(out.value)
+
+    def delete(self, key):
+        self._lib.pt_store_delete(self._fd, key.encode())
+
+    def barrier(self, name, world_size, timeout_s=None):
+        """All ranks arrive; releases when world_size ranks have added."""
+        n = self.add("__barrier/%s/count" % name, 1)
+        if n == world_size:
+            self.set("__barrier/%s/go" % name, b"1")
+        got = self.get("__barrier/%s/go" % name, timeout_s)
+        if got is None:
+            raise TimeoutError("barrier %r timed out (%d/%d arrived)"
+                               % (name, n, world_size))
+
+    def close(self):
+        if self._fd is not None and self._fd >= 0:
+            self._lib.pt_store_close(self._fd)
+            self._fd = -1
+        if self._server is not None:
+            self._lib.pt_store_server_stop(self._server)
+            self._server = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def create_store_from_env(world_size=None):
+    """Build the rendezvous store from PADDLE_MASTER / rank env vars."""
+    master = os.environ.get("PADDLE_MASTER", "127.0.0.1:0")
+    host, _, port = master.partition(":")
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    return TCPStore(host or "127.0.0.1", int(port or 0), is_master=(rank == 0))

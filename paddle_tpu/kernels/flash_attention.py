@@ -3,10 +3,17 @@
 Replaces the reference's fused attention CUDA ops
 (/root/reference/paddle/fluid/operators/fused/fused_attention_op.cu and the
 fmha wrappers): blocked online-softmax attention that never materializes the
-[N, N] score matrix in HBM. Forward is a Pallas kernel tiled for the MXU
-(block 128, fp32 accumulators); backward is the standard recompute-form
-attention VJP expressed in XLA (fused well; a Pallas backward is a later
-optimization). Layout follows the framework convention [B, N, H, D].
+[N, N] score matrix in HBM. The forward is a Pallas kernel with a
+(batch*head, q_block, kv_block) grid — K/V are streamed one (block_k, d)
+tile at a time with the running max/denominator/accumulator held in VMEM
+scratch, so context length is bounded by HBM, not VMEM. Backward is the
+standard recompute-form attention VJP expressed in XLA (fused well; a Pallas
+backward is a later optimization). Layout follows the framework convention
+[B, N, H, D].
+
+Causal semantics are start-aligned (query i attends to keys j <= i) in both
+the kernel and the XLA fallback/VJP; causal cross-attention with
+kv_len != q_len uses the same convention everywhere.
 """
 from __future__ import annotations
 
@@ -21,26 +28,28 @@ from jax.experimental.pallas import tpu as pltpu
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
 NEG_INF = -1e30
+_STAT_LANES = 128  # lane width for the m/l scratch (TPU min tile)
 
 
-def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_k,
-               kv_len):
-    """One (batch*head, q_block) program: stream kv blocks with online
-    softmax. Refs: q [1, bq, d]; k/v [1, kv_len, d]; o [1, bq, d]."""
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+               scale, causal, block_k):
+    """One (bh, q_block, kv_block) program. Refs: q [1, bq, d];
+    k/v [1, block_k, d]; o [1, bq, d]; scratch m/l [bq, 128], acc [bq, d]."""
     _, bq, d = q_ref.shape
     q_idx = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale
+    kv_i = pl.program_id(2)
+    num_kv = pl.num_programs(2)
 
-    m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((bq, 1), jnp.float32)
-    acc0 = jnp.zeros((bq, d), jnp.float32)
+    @pl.when(kv_i == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    num_kv = kv_len // block_k
-
-    def body(kv_i, carry):
-        m, l, acc = carry
-        k = k_ref[0, pl.ds(kv_i * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(kv_i * block_k, block_k), :].astype(jnp.float32)
+    def compute():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)  # [bq, block_k]
@@ -50,59 +59,78 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_k,
             k_pos = kv_i * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        m_prev = m_scr[...][:, :1]                      # [bq, 1]
+        l_prev = l_scr[...][:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m - m_new)
-        l_new = alpha * l + jnp.sum(p, axis=1, keepdims=True)
-        acc_new = alpha * acc + jax.lax.dot_general(
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[...] = alpha * acc_scr[...] + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        return m_new, l_new, acc_new
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
 
     if causal:
-        # only kv blocks at or before this q block contribute
-        upper = jnp.minimum(num_kv, (q_idx + 1) * bq // block_k + 1)
+        # skip kv blocks strictly above the diagonal (no query can see them)
+        @pl.when(kv_i * block_k <= q_idx * bq + bq - 1)
+        def _run():
+            compute()
     else:
-        upper = num_kv
-    m, l, acc = jax.lax.fori_loop(0, upper, body, (m0, l0, acc0))
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+        compute()
+
+    @pl.when(kv_i == num_kv - 1)
+    def _finish():
+        l = l_scr[...][:, :1]
+        o_ref[0] = (acc_scr[...] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
 
 
 def _flash_fwd_bhnd(q, k, v, scale, causal, block_q, block_k, interpret):
     """q,k,v: [BH, N, D] (heads folded into batch)."""
     bh, n, d = q.shape
     kv_len = k.shape[1]
-    grid = (bh, n // block_q)
+    grid = (bh, n // block_q, kv_len // block_k)
     kernel = functools.partial(
-        _fa_kernel, scale=scale, causal=causal, block_k=block_k,
-        kv_len=kv_len)
+        _fa_kernel, scale=scale, causal=causal, block_k=block_k)
     return pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, kv_len, d), lambda b, i: (b, 0, 0),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, kv_len, d), lambda b, i: (b, 0, 0),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0),
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((bh, n, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _STAT_LANES), jnp.float32),
+            pltpu.VMEM((block_q, _STAT_LANES), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q, k, v)
 
 
 def _reference_attention(q, k, v, scale, causal):
-    """[BH, N, D] fp32-statistics attention — the VJP recompute form."""
+    """[BH, N, D] fp32-statistics attention — the VJP recompute form.
+
+    Uses the same start-aligned causal mask as the Pallas kernel (query i
+    sees keys j <= i) so forward and backward agree for any kv_len.
+    """
     qf = q.astype(jnp.float32)
     kf = k.astype(jnp.float32)
     logits = jnp.einsum("bnd,bmd->bnm", qf, kf) * scale
     if causal:
         n, m = logits.shape[-2], logits.shape[-1]
-        mask = jnp.tril(jnp.ones((n, m), bool), k=m - n)
-        logits = jnp.where(mask, logits, NEG_INF)
+        q_pos = jax.lax.broadcasted_iota(jnp.int32, (n, m), 0)
+        k_pos = jax.lax.broadcasted_iota(jnp.int32, (n, m), 1)
+        logits = jnp.where(q_pos >= k_pos, logits, NEG_INF)
     p = jax.nn.softmax(logits, axis=-1)
     return jnp.einsum("bnm,bmd->bnd", p.astype(v.dtype), v)
 
@@ -143,7 +171,13 @@ def flash_attention(q, k, v, causal=False, scale=None,
         interpret = jax.default_backend() != "tpu"
     block_q = min(block_q, n)
     block_k = min(block_k, kv_n)
-    if n % block_q or kv_n % block_k:
+    # Kernel path requires Mosaic-tileable blocks: q blocks on the sublane
+    # axis (multiple of 8) and kv blocks on the lane axis of the score tile
+    # (multiple of 128). Anything else takes the XLA fallback, which shares
+    # the kernel's mask semantics.
+    tileable = (n % block_q == 0 and kv_n % block_k == 0
+                and block_q % 8 == 0 and block_k % 128 == 0)
+    if not tileable:
         return jnp.swapaxes(
             _reference_attention(
                 jnp.swapaxes(q, 1, 2).reshape(b * h, n, d),

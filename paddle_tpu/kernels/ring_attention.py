@@ -28,9 +28,10 @@ def _block_attention(q, k, v, scale, mask):
     if mask is not None:
         s = jnp.where(mask, s, NEG_INF)
     m = jnp.max(s, axis=-1, keepdims=True)
-    # guard fully-masked rows
-    m = jnp.maximum(m, NEG_INF)
-    p = jnp.exp(s - m)
+    # fully-masked rows (m == NEG_INF) must contribute p = 0, not exp(0):
+    # without this a block whose rows are all masked (e.g. a kv block
+    # entirely in the causal future) would add garbage to the accumulator.
+    p = jnp.where(m <= NEG_INF, 0.0, jnp.exp(s - m))
     l = jnp.sum(p, axis=-1, keepdims=True)
     o = jnp.einsum("bhnm,bhmd->bhnd", p, v)
     return o, m, l
@@ -90,8 +91,9 @@ def sequence_parallel_attention(q, k, v, mesh=None, causal=False, scale=None,
                                 axis_name="sep"):
     """Convenience wrapper: full arrays in, shard_map over the sequence
     axis, ring attention inside."""
-    from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from ..distributed.collective import shard_map
 
     from ..distributed import mesh as _mesh
 

@@ -1,0 +1,226 @@
+"""paddle_tpu.profiler — unified host + device profiling.
+
+Parity: reference python/paddle/profiler/profiler.py:344 (`Profiler` with
+scheduler windows ProfilerState cycle at :79), RecordEvent annotations
+threaded through executors/ops, chrome-trace export
+(platform/profiler/chrometracing_logger.cc) and summary statistics
+(profiler_statistic.py). TPU-native split: host events go through the C++
+recorder (csrc/trace.cc, the host_event_recorder.h analog); device-side
+tracing is delegated to jax.profiler (Xprof) which captures XLA/TPU
+activity — the CUPTI analog is the TPU runtime's own tracer, reached via
+jax.profiler.start_trace.
+"""
+from __future__ import annotations
+
+import enum
+import os
+import time
+
+from ..core import native
+
+__all__ = [
+    "Profiler", "RecordEvent", "ProfilerState", "ProfilerTarget",
+    "make_scheduler", "export_chrome_tracing", "load_profiler_result",
+]
+
+
+class ProfilerState(enum.Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class ProfilerTarget(enum.Enum):
+    CPU = 0
+    TPU = 1  # reference: GPU
+
+
+def make_scheduler(*, closed, ready, record, repeat=0, skip_first=0):
+    """Step-window scheduler (reference profiler.py:170 make_scheduler)."""
+
+    def sched(step):
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        period = closed + ready + record
+        if repeat and s >= repeat * period:
+            return ProfilerState.CLOSED
+        pos = s % period
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == period - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return sched
+
+
+class RecordEvent:
+    """Scoped host annotation (reference platform/profiler/event_tracing.h
+    RecordEvent; python API python/paddle/profiler/utils.py RecordEvent)."""
+
+    def __init__(self, name, event_type=None, level=1):
+        self.name = name
+        self.level = level
+        self._lib = None
+
+    def begin(self):
+        self._lib = native.get_lib()
+        self._lib.pt_trace_push(self.name.encode(), self.level)
+
+    def end(self):
+        if self._lib is not None:
+            self._lib.pt_trace_pop()
+            self._lib = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+
+
+def _counter(name, value):
+    native.get_lib().pt_trace_counter(name.encode(), int(value))
+
+
+class Profiler:
+    """Collect host (+ optional Xprof device) traces over scheduled steps.
+
+    Usage matches the reference (profiler.py:344):
+        with Profiler(scheduler=(2, 5), on_trace_ready=...) as p:
+            for batch in loader:
+                train_step(batch)
+                p.step()
+    """
+
+    def __init__(self, *, targets=None, scheduler=None, on_trace_ready=None,
+                 timer_only=False, with_xprof=False, trace_dir=None):
+        if scheduler is None:
+            self._sched = lambda step: ProfilerState.RECORD
+        elif isinstance(scheduler, tuple):
+            start, end = scheduler
+            self._sched = make_scheduler(
+                closed=max(start, 0), ready=0, record=end - start, repeat=1)
+        else:
+            self._sched = scheduler
+        self.on_trace_ready = on_trace_ready
+        self.timer_only = timer_only
+        self.with_xprof = with_xprof and not timer_only
+        self.trace_dir = trace_dir or os.path.join(".", "profiler_log")
+        self._step = 0
+        self._state = ProfilerState.CLOSED
+        self._xprof_on = False
+        self._step_times = []
+        self._t0 = None
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        self._apply_state(self._sched(self._step))
+        self._t0 = time.perf_counter()
+        return self
+
+    def stop(self):
+        if self._state in (ProfilerState.RECORD,
+                           ProfilerState.RECORD_AND_RETURN):
+            self._finish_window()
+        self._apply_state(ProfilerState.CLOSED)
+
+    def step(self):
+        now = time.perf_counter()
+        if self._t0 is not None:
+            self._step_times.append(now - self._t0)
+        self._t0 = now
+        prev = self._state
+        self._step += 1
+        new = self._sched(self._step)
+        if prev in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN) \
+                and new in (ProfilerState.CLOSED, ProfilerState.READY):
+            self._finish_window()
+        self._apply_state(new)
+
+    def _apply_state(self, state):
+        if self.timer_only:
+            self._state = state
+            return
+        lib = native.get_lib()
+        recording = state in (ProfilerState.RECORD,
+                              ProfilerState.RECORD_AND_RETURN)
+        was = self._state in (ProfilerState.RECORD,
+                              ProfilerState.RECORD_AND_RETURN)
+        if recording and not was:
+            lib.pt_trace_enable(2)
+            if self.with_xprof and not self._xprof_on:
+                try:
+                    import jax
+                    jax.profiler.start_trace(self.trace_dir)
+                    self._xprof_on = True
+                except Exception:
+                    self._xprof_on = False
+        elif not recording and was:
+            lib.pt_trace_disable()
+        self._state = state
+
+    def _finish_window(self):
+        if self._xprof_on:
+            try:
+                import jax
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._xprof_on = False
+        if self.on_trace_ready is not None:
+            self.on_trace_ready(self)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- results -----------------------------------------------------------
+    def export_chrome_tracing(self, path):
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        rc = native.get_lib().pt_trace_dump(path.encode())
+        if rc != 0:
+            raise IOError("trace dump to %s failed" % path)
+        return path
+
+    def summary(self):
+        """Step-time stats (reference profiler_statistic.py summary)."""
+        ts = self._step_times
+        if not ts:
+            return {"steps": 0}
+        ts_sorted = sorted(ts)
+        n = len(ts_sorted)
+        return {
+            "steps": n,
+            "avg_s": sum(ts) / n,
+            "min_s": ts_sorted[0],
+            "p50_s": ts_sorted[n // 2],
+            "p99_s": ts_sorted[min(n - 1, int(n * 0.99))],
+            "max_s": ts_sorted[-1],
+        }
+
+
+def export_chrome_tracing(dir_name, worker_name=None):
+    """on_trace_ready factory (reference profiler.py export_chrome_tracing)."""
+
+    def handler(prof):
+        os.makedirs(dir_name, exist_ok=True)
+        name = worker_name or "worker"
+        path = os.path.join(dir_name, "%s_%d.json" % (name, prof._step))
+        prof.export_chrome_tracing(path)
+
+    return handler
+
+
+def load_profiler_result(path):
+    import json
+
+    with open(path) as f:
+        return json.load(f)
