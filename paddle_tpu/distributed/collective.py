@@ -258,9 +258,17 @@ def alltoall(in_tensor_or_list, out_tensor_or_list=None, group=None,
     if g.nranks == 1:
         out = v
     else:
-        fn = _compiled_collective("all_to_all", g.axis, tuple(v.shape),
-                                  str(v.dtype))
-        out = fn(_eager_shard(v, g.axis))
+        # Global view of the exchange: rank r's chunk j becomes rank j's
+        # chunk r — a (src, dst) transpose of dim 0. device_put re-shards
+        # the permuted array, which is the actual ICI all-to-all.
+        n = g.nranks
+        if v.shape[0] % (n * n):
+            raise ValueError(
+                "alltoall requires dim0 (%d) divisible by nranks^2 (%d)"
+                % (v.shape[0], n * n))
+        r = v.reshape((n, n, v.shape[0] // (n * n)) + v.shape[1:])
+        out = jnp.swapaxes(r, 0, 1).reshape(v.shape)
+        out = _eager_shard(out, g.axis)
     if as_list and out_tensor_or_list is not None:
         parts = jnp.split(out, g.nranks, axis=0)
         out_tensor_or_list.extend(Tensor(p) for p in parts)

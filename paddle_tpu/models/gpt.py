@@ -16,22 +16,30 @@ from ..parallel.mp_layers import (
 
 
 class GPTBlock(Layer):
-    def __init__(self, hidden, heads, ffn, dropout=0.0, use_parallel=False):
+    def __init__(self, hidden, heads, ffn, dropout=0.0, use_parallel=False,
+                 moe_experts=0, moe_top_k=2):
         super().__init__()
         self.ln1 = LayerNorm(hidden)
         self.ln2 = LayerNorm(hidden)
         self.heads = heads
         self.head_dim = hidden // heads
+        self.is_moe = moe_experts > 0
         if use_parallel:
             self.qkv = ColumnParallelLinear(hidden, 3 * hidden,
                                             gather_output=False)
             self.proj = RowParallelLinear(hidden, hidden,
                                           input_is_parallel=True)
-            self.fc1 = ColumnParallelLinear(hidden, ffn, gather_output=False)
-            self.fc2 = RowParallelLinear(ffn, hidden, input_is_parallel=True)
         else:
             self.qkv = Linear(hidden, 3 * hidden)
             self.proj = Linear(hidden, hidden)
+        if self.is_moe:
+            from ..parallel.moe import MoELayer
+
+            self.moe = MoELayer(hidden, ffn, moe_experts, top_k=moe_top_k)
+        elif use_parallel:
+            self.fc1 = ColumnParallelLinear(hidden, ffn, gather_output=False)
+            self.fc2 = RowParallelLinear(ffn, hidden, input_is_parallel=True)
+        else:
             self.fc1 = Linear(hidden, ffn)
             self.fc2 = Linear(ffn, hidden)
         self.drop = Dropout(dropout)
@@ -45,24 +53,44 @@ class GPTBlock(Layer):
         attn = attn.reshape([b, s, hdim])
         x = x + self.drop(self.proj(attn))
         h = self.ln2(x)
-        x = x + self.drop(self.fc2(F.gelu(self.fc1(h))))
+        if self.is_moe:
+            x = x + self.drop(self.moe(h))
+        else:
+            x = x + self.drop(self.fc2(F.gelu(self.fc1(h))))
         return x
 
 
 class GPTModel(Layer):
     def __init__(self, vocab_size=50304, hidden_size=768, num_layers=12,
                  num_heads=12, ffn_size=None, max_seq_len=1024, dropout=0.0,
-                 use_parallel=False):
+                 use_parallel=False, moe_experts=0, moe_every=2,
+                 moe_top_k=2, moe_aux_coeff=0.01):
+        """moe_experts > 0 turns every `moe_every`-th block into a
+        GShard-style MoE block (expert-parallel over the dp mesh axis)."""
         super().__init__()
         ffn_size = ffn_size or 4 * hidden_size
         Emb = VocabParallelEmbedding if use_parallel else Embedding
         self.wte = Emb(vocab_size, hidden_size)
         self.wpe = Embedding(max_seq_len, hidden_size)
         self.blocks = LayerList([
-            GPTBlock(hidden_size, num_heads, ffn_size, dropout, use_parallel)
-            for _ in range(num_layers)])
+            GPTBlock(hidden_size, num_heads, ffn_size, dropout, use_parallel,
+                     moe_experts=(moe_experts
+                                  if moe_experts and i % moe_every == 1
+                                  else 0),
+                     moe_top_k=moe_top_k)
+            for i in range(num_layers)])
         self.ln_f = LayerNorm(hidden_size)
         self.vocab_size = vocab_size
+        self.moe_aux_coeff = moe_aux_coeff
+
+    def moe_aux_loss(self):
+        """Sum of load-balancing losses from the MoE blocks this forward."""
+        total = None
+        for blk in self.blocks:
+            if getattr(blk, "is_moe", False) and blk.moe.aux_loss is not None:
+                total = (blk.moe.aux_loss if total is None
+                         else total + blk.moe.aux_loss)
+        return total
 
     def forward(self, input_ids, labels=None):
         import paddle_tpu as P
@@ -75,6 +103,10 @@ class GPTModel(Layer):
         x = self.ln_f(x)
         logits = P.matmul(x, self.wte.weight, transpose_y=True)
         if labels is not None:
-            return F.cross_entropy(
+            loss = F.cross_entropy(
                 logits.reshape([-1, self.vocab_size]), labels.reshape([-1]))
+            aux = self.moe_aux_loss()
+            if aux is not None:
+                loss = loss + aux * self.moe_aux_coeff
+            return loss
         return logits

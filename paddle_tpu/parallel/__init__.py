@@ -9,6 +9,7 @@ reference's eager APIs working.
 """
 from . import engine  # noqa: F401
 from .data_parallel import DataParallel  # noqa: F401
+from .moe import MoELayer, global_gather, global_scatter  # noqa: F401
 from .mp_layers import (  # noqa: F401
     ColumnParallelLinear,
     ParallelCrossEntropy,

@@ -1,0 +1,184 @@
+"""MoE / expert-parallel tests.
+
+Oracle: explicit loop-over-experts numpy computation. Mirrors the
+reference's moe tests (unittests for moe_layer / global_scatter)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import mesh as pmesh
+from paddle_tpu.parallel.moe import MoELayer, moe_mlp
+
+RNG = np.random.RandomState(3)
+
+
+def _dense_moe_top1(x, gate_w, w1, b1, w2, b2, act=np.tanh):
+    """No-drop top-1 oracle: each token goes to its argmax expert."""
+    logits = x @ gate_w
+    probs = np.exp(logits - logits.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    idx = probs.argmax(-1)
+    out = np.zeros_like(x)
+    for t in range(x.shape[0]):
+        e = idx[t]
+        h = np.maximum(x[t] @ w1[e] + b1[e], 0)  # relu
+        out[t] = probs[t, e] * (h @ w2[e] + b2[e])
+    return out
+
+
+class TestMoEPrimitive:
+    def test_top1_matches_dense_oracle(self):
+        t, d, h, e = 32, 8, 16, 4
+        x = RNG.randn(t, d).astype(np.float32)
+        gate_w = RNG.randn(d, e).astype(np.float32)
+        w1 = RNG.randn(e, d, h).astype(np.float32) * 0.1
+        b1 = RNG.randn(e, h).astype(np.float32) * 0.1
+        w2 = RNG.randn(e, h, d).astype(np.float32) * 0.1
+        b2 = RNG.randn(e, d).astype(np.float32) * 0.1
+        out, aux = moe_mlp(
+            jnp.asarray(x), jnp.asarray(gate_w), jnp.asarray(w1),
+            jnp.asarray(b1), jnp.asarray(w2), jnp.asarray(b2),
+            top_k=1, capacity=t, ep_axis="dp", activation="relu")
+        ref = _dense_moe_top1(x, gate_w, w1, b1, w2, b2)
+        np.testing.assert_allclose(np.asarray(out._value), ref,
+                                   rtol=1e-4, atol=1e-5)
+        assert float(aux._value) > 0
+
+    def test_top2_combine_weights_renormalized(self):
+        """With capacity >= tokens (no drops) the top-2 combine weights for
+        each token must sum to 1."""
+        t, d, h, e = 16, 8, 8, 4
+        x = jnp.asarray(RNG.randn(t, d).astype(np.float32))
+        gate_w = jnp.asarray(RNG.randn(d, e).astype(np.float32))
+        # identity-ish experts: w1=relu passthrough impossible; instead use
+        # ones-valued v to read combine mass: expert(x) = 1 vector
+        w1 = jnp.zeros((e, d, h), jnp.float32)
+        b1 = jnp.ones((e, h), jnp.float32)
+        w2 = jnp.zeros((e, h, d), jnp.float32)
+        b2 = jnp.ones((e, d), jnp.float32)
+        out, _ = moe_mlp(x, gate_w, w1, b1, w2, b2, top_k=2, capacity=2 * t,
+                         ep_axis="dp", activation="relu")
+        # each expert outputs the all-ones vector, so out = (g1+g2) * ones
+        np.testing.assert_allclose(np.asarray(out._value),
+                                   np.ones((t, d), np.float32),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_capacity_drops_tokens(self):
+        """capacity=1 forces drops: total output mass strictly less than
+        the no-drop case."""
+        t, d, h, e = 32, 8, 8, 2
+        x = jnp.asarray(RNG.randn(t, d).astype(np.float32))
+        gate_w = jnp.asarray(RNG.randn(d, e).astype(np.float32))
+        w1 = jnp.zeros((e, d, h), jnp.float32)
+        b1 = jnp.ones((e, h), jnp.float32)
+        w2 = jnp.zeros((e, h, d), jnp.float32)
+        b2 = jnp.ones((e, d), jnp.float32)
+        full, _ = moe_mlp(x, gate_w, w1, b1, w2, b2, top_k=1, capacity=t,
+                          ep_axis="dp", activation="relu")
+        capped, _ = moe_mlp(x, gate_w, w1, b1, w2, b2, top_k=1, capacity=1,
+                            ep_axis="dp", activation="relu")
+        assert float(jnp.sum(capped._value)) < float(jnp.sum(full._value))
+
+
+class TestMoELayer:
+    def test_forward_backward(self):
+        paddle.seed(0)
+        moe = MoELayer(d_model=16, d_hidden=32, num_experts=4, top_k=2,
+                       gate="gshard")
+        x = paddle.to_tensor(RNG.randn(4, 8, 16).astype(np.float32))
+        x.stop_gradient = False
+        out = moe(x)
+        assert out.shape == [4, 8, 16]
+        assert moe.aux_loss is not None
+        loss = (out * out).sum() + moe.aux_loss * 0.01
+        loss.backward()
+        for n, p in moe.named_parameters():
+            assert p.grad is not None, "no grad for %s" % n
+            assert np.isfinite(p.grad.numpy()).all(), n
+
+    def test_switch_gate_is_top1(self):
+        moe = MoELayer(16, 32, 4, gate="switch")
+        assert moe.top_k == 1
+
+    def test_training_reduces_loss(self):
+        paddle.seed(1)
+        moe = MoELayer(d_model=8, d_hidden=16, num_experts=2, top_k=1,
+                       gate="switch", capacity_factor=2.0)
+        opt = paddle.optimizer.Adam(learning_rate=5e-3,
+                                    parameters=moe.parameters())
+        x = paddle.to_tensor(RNG.randn(16, 8).astype(np.float32))
+        y = paddle.to_tensor(RNG.randn(16, 8).astype(np.float32))
+        losses = []
+        for _ in range(25):
+            out = moe(x)
+            loss = ((out - y) ** 2).mean() + moe.aux_loss * 0.01
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+
+
+class TestMoESharded:
+    def test_expert_parallel_on_mesh(self):
+        """MoE inside a jit over the 8-device mesh: expert dim sharded on
+        dp; results must match the single-device run."""
+        mesh = pmesh.build_hybrid_mesh(dp=8, mp=1)
+        paddle.seed(0)
+        moe = MoELayer(d_model=16, d_hidden=32, num_experts=8, top_k=1,
+                       gate="switch", capacity_factor=8.0, ep_axis="dp")
+        x_np = RNG.randn(32, 16).astype(np.float32)
+        out_eager = moe(paddle.to_tensor(x_np)).numpy()
+
+        names, values = moe.functional_state()
+
+        def fn(vals, xv):
+            out = moe.functional_call(vals, paddle.Tensor(xv),
+                                      state_names=names)
+            return out._value
+
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        with mesh:
+            out_jit = jax.jit(fn)(values, jnp.asarray(x_np))
+        np.testing.assert_allclose(np.asarray(out_jit), out_eager,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_global_scatter_roundtrip(self):
+        from paddle_tpu.distributed import collective
+        from paddle_tpu.parallel.moe import global_gather, global_scatter
+
+        pmesh.build_hybrid_mesh(dp=8, mp=1)
+        x = paddle.to_tensor(
+            np.arange(256, dtype=np.float32).reshape(64, 4))
+        g = collective.Group(axis="dp")
+        y = global_scatter(x, group=g)
+        # the exchange is a (src, dst) chunk transpose, and an involution
+        assert not np.allclose(y.numpy(), x.numpy())
+        z = global_gather(y, group=g)
+        np.testing.assert_allclose(z.numpy(), x.numpy())
+
+
+class TestGPTMoE:
+    def test_gpt_moe_trains(self):
+        from paddle_tpu.models.gpt import GPTModel
+
+        paddle.seed(0)
+        m = GPTModel(vocab_size=128, hidden_size=32, num_layers=2,
+                     num_heads=2, max_seq_len=32, moe_experts=4,
+                     moe_every=2, moe_top_k=1)
+        assert any(getattr(b, "is_moe", False) for b in m.blocks)
+        opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                    parameters=m.parameters())
+        ids = paddle.to_tensor(RNG.randint(0, 128, (2, 16)).astype("int64"))
+        losses = []
+        for _ in range(8):
+            loss = m(ids, labels=ids)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
