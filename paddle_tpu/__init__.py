@@ -276,6 +276,7 @@ from . import amp  # noqa: F401
 from . import autograd  # noqa: F401
 from . import distributed  # noqa: F401
 from . import framework  # noqa: F401
+from . import hapi  # noqa: F401
 from . import io  # noqa: F401
 from . import jit  # noqa: F401
 from . import metric  # noqa: F401
@@ -287,6 +288,7 @@ from . import utils  # noqa: F401
 from . import vision  # noqa: F401
 
 from .framework.io import load, save  # noqa: F401
+from .hapi import Model  # noqa: F401
 from .nn.layer import set_grad_enabled  # noqa: F401
 
 

@@ -1,0 +1,10 @@
+"""paddle_tpu.hapi — high-level Model API (reference python/paddle/hapi)."""
+from . import callbacks  # noqa: F401
+from .callbacks import (  # noqa: F401
+    Callback,
+    EarlyStopping,
+    LRScheduler,
+    ModelCheckpoint,
+    ProgBarLogger,
+)
+from .model import Model  # noqa: F401

@@ -1,0 +1,243 @@
+"""paddle_tpu.Model — the high-level train/eval/predict facade.
+
+Parity: reference python/paddle/hapi/model.py:1004 (`Model`), fit at :1696,
+evaluate/predict/save/load, prepare(optimizer, loss, metrics). The reference
+switches between dygraph and static-graph adapters; here the eager path IS
+the compiled path (ops trace into XLA), so one implementation serves both.
+Distributed data parallelism comes from the engine/mesh instead of
+fleet.distributed_model wrapping.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..metric import Metric
+from .callbacks import config_callbacks
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    if isinstance(x, (list, tuple)):
+        return list(x)
+    return [x]
+
+
+def _to_tensor(x):
+    if isinstance(x, Tensor):
+        return x
+    import paddle_tpu as paddle
+
+    return paddle.to_tensor(np.asarray(x))
+
+
+class Model:
+    """Trainer facade over a Layer (reference hapi/model.py:1004)."""
+
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self.stop_training = False
+
+    # -- setup -------------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        for m in _to_list(metrics):
+            if not isinstance(m, Metric):
+                raise TypeError("metric must be paddle_tpu.metric.Metric")
+        self._metrics = _to_list(metrics)
+        return self
+
+    # -- single-batch ops (reference Model.train_batch/eval_batch) ---------
+    def train_batch(self, inputs, labels=None):
+        self.network.train()
+        inputs = [_to_tensor(x) for x in _to_list(inputs)]
+        labels = [_to_tensor(y) for y in _to_list(labels)]
+        outs = self.network(*inputs)
+        loss = self._compute_loss(outs, labels)
+        loss.backward()
+        self._optimizer.step()
+        self._optimizer.clear_grad()
+        metrics = self._update_metrics(outs, labels)
+        return self._named_outputs(loss, metrics)
+
+    def eval_batch(self, inputs, labels=None):
+        import paddle_tpu as paddle
+
+        self.network.eval()
+        with paddle.no_grad():
+            inputs = [_to_tensor(x) for x in _to_list(inputs)]
+            labels = [_to_tensor(y) for y in _to_list(labels)]
+            outs = self.network(*inputs)
+            loss = self._compute_loss(outs, labels)
+        metrics = self._update_metrics(outs, labels)
+        return self._named_outputs(loss, metrics)
+
+    def predict_batch(self, inputs):
+        import paddle_tpu as paddle
+
+        self.network.eval()
+        with paddle.no_grad():
+            inputs = [_to_tensor(x) for x in _to_list(inputs)]
+            outs = self.network(*inputs)
+        return [o.numpy() for o in _to_list(outs)]
+
+    def _compute_loss(self, outs, labels):
+        outs_l = _to_list(outs)
+        if self._loss is None:
+            # network computed its own loss
+            return outs_l[0]
+        return self._loss(*(outs_l + labels))
+
+    def _update_metrics(self, outs, labels):
+        res = {}
+        outs_l = _to_list(outs)
+        for m in self._metrics:
+            interm = m.compute(*(outs_l + labels))
+            m.update(*_to_list(interm))
+            name = m.name()
+            name = name[0] if isinstance(name, (list, tuple)) else name
+            res[name] = m.accumulate()
+        return res
+
+    def _named_outputs(self, loss, metrics):
+        logs = {"loss": float(loss)}
+        for k, v in metrics.items():
+            logs[k] = v
+        return logs
+
+    # -- loops -------------------------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=1,
+            shuffle=True, callbacks=None, num_workers=0, drop_last=False):
+        train_loader = self._make_loader(train_data, batch_size, shuffle,
+                                         num_workers, drop_last)
+        eval_loader = (self._make_loader(eval_data, batch_size, False,
+                                         num_workers, False)
+                       if eval_data is not None else None)
+        steps = len(train_loader) if hasattr(train_loader, "__len__") else None
+        cbks = config_callbacks(
+            callbacks, model=self, epochs=epochs, steps=steps,
+            verbose=verbose, log_freq=log_freq, save_freq=save_freq,
+            save_dir=save_dir, metrics=[m.name() for m in self._metrics])
+        self.stop_training = False
+        cbks.on_train_begin()
+        history = []
+        for epoch in range(epochs):
+            if self.stop_training:
+                break
+            cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step, batch in enumerate(train_loader):
+                cbks.on_train_batch_begin(step)
+                ins, lbl = self._split_batch(batch)
+                logs = self.train_batch(ins, lbl)
+                cbks.on_train_batch_end(step, logs)
+            cbks.on_epoch_end(epoch, logs)
+            history.append(logs)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_loader, callbacks=cbks, _inner=True)
+        cbks.on_train_end()
+        return history
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=1,
+                 num_workers=0, callbacks=None, _inner=False):
+        loader = self._make_loader(eval_data, batch_size, False, num_workers,
+                                   False)
+        cbks = callbacks if _inner else config_callbacks(
+            callbacks, model=self, verbose=verbose, log_freq=log_freq)
+        for m in self._metrics:
+            m.reset()
+        cbks.on_eval_begin()
+        logs, losses = {}, []
+        for step, batch in enumerate(loader):
+            cbks.on_eval_batch_begin(step)
+            ins, lbl = self._split_batch(batch)
+            logs = self.eval_batch(ins, lbl)
+            losses.append(logs["loss"])
+            cbks.on_eval_batch_end(step, logs)
+        if losses:
+            logs["loss"] = float(np.mean(losses))
+        cbks.on_eval_end(logs)
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, callbacks=None, verbose=0):
+        loader = self._make_loader(test_data, batch_size, False, num_workers,
+                                   False)
+        outputs = []
+        for batch in loader:
+            # a (x, ..., y) batch from a labeled dataset: drop the label,
+            # matching the reference's input-spec-driven slicing
+            ins, _ = self._split_batch(batch)
+            outputs.append(self.predict_batch(ins))
+        if not outputs:
+            return []
+        n_out = len(outputs[0])
+        grouped = [[o[i] for o in outputs] for i in range(n_out)]
+        if stack_outputs:
+            grouped = [np.concatenate(g, axis=0) for g in grouped]
+        return grouped
+
+    def _make_loader(self, data, batch_size, shuffle, num_workers, drop_last):
+        from ..io import DataLoader, Dataset
+
+        if data is None:
+            return []
+        if isinstance(data, DataLoader):
+            return data
+        if isinstance(data, Dataset):
+            return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                              num_workers=num_workers, drop_last=drop_last)
+        return data  # assume iterable of batches
+
+    def _split_batch(self, batch, has_labels=True):
+        if isinstance(batch, (list, tuple)):
+            if has_labels and len(batch) >= 2:
+                return list(batch[:-1]), [batch[-1]]
+            return list(batch), []
+        return [batch], []
+
+    # -- persistence (reference Model.save/load) ---------------------------
+    def save(self, path, training=True):
+        import paddle_tpu as paddle
+
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        paddle.save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            paddle.save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        import paddle_tpu as paddle
+
+        state = paddle.load(path + ".pdparams")
+        self.network.set_state_dict(state)
+        opt_path = path + ".pdopt"
+        if (not reset_optimizer and self._optimizer is not None
+                and os.path.exists(opt_path)):
+            self._optimizer.set_state_dict(paddle.load(opt_path))
+
+    # -- introspection -----------------------------------------------------
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        lines, total = [], 0
+        for name, p in self.network.named_parameters():
+            n = int(np.prod(p.shape)) if p.shape else 1
+            total += n
+            lines.append("%-40s %-20s %d" % (name, p.shape, n))
+        out = "\n".join(lines) + "\nTotal params: %d" % total
+        print(out)
+        return {"total_params": total}

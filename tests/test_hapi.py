@@ -1,0 +1,124 @@
+"""hapi Model API tests (reference python/paddle/tests/test_model.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.hapi import EarlyStopping, Model
+from paddle_tpu.io import Dataset
+from paddle_tpu.metric import Accuracy
+
+RNG = np.random.RandomState(7)
+
+
+class ToyDataset(Dataset):
+    """Linearly separable 2-class problem."""
+
+    def __init__(self, n=128):
+        self.x = RNG.randn(n, 8).astype("float32")
+        w = RNG.randn(8)
+        self.y = (self.x @ w > 0).astype("int64")
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+def _make_model():
+    net = nn.Sequential(
+        nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 2))
+    m = Model(net)
+    m.prepare(
+        optimizer=paddle.optimizer.Adam(learning_rate=1e-2,
+                                        parameters=net.parameters()),
+        loss=nn.CrossEntropyLoss(),
+        metrics=Accuracy())
+    return m
+
+
+class TestModelFit:
+    def test_fit_reduces_loss_and_tracks_acc(self):
+        m = _make_model()
+        ds = ToyDataset(128)
+        history = m.fit(ds, batch_size=32, epochs=4, verbose=0)
+        assert len(history) == 4
+        assert history[-1]["loss"] < history[0]["loss"]
+        assert history[-1]["acc"] > 0.7
+
+    def test_evaluate_and_predict(self):
+        m = _make_model()
+        ds = ToyDataset(64)
+        m.fit(ds, batch_size=16, epochs=3, verbose=0)
+        logs = m.evaluate(ds, batch_size=16, verbose=0)
+        assert "loss" in logs and "acc" in logs
+        preds = m.predict(ds, batch_size=16, stack_outputs=True)
+        assert preds[0].shape == (64, 2)
+
+    def test_fit_with_eval_data(self):
+        m = _make_model()
+        history = m.fit(ToyDataset(64), eval_data=ToyDataset(32),
+                        batch_size=16, epochs=2, verbose=0)
+        assert len(history) == 2
+
+    def test_save_load_roundtrip(self, tmp_path):
+        m = _make_model()
+        ds = ToyDataset(64)
+        m.fit(ds, batch_size=16, epochs=2, verbose=0)
+        path = str(tmp_path / "ckpt" / "model")
+        m.save(path)
+        assert os.path.exists(path + ".pdparams")
+        assert os.path.exists(path + ".pdopt")
+        m2 = _make_model()
+        m2.load(path)
+        w1 = m.network.state_dict()
+        w2 = m2.network.state_dict()
+        for k in w1:
+            np.testing.assert_allclose(w1[k].numpy(), w2[k].numpy())
+
+    def test_early_stopping(self):
+        m = _make_model()
+        es = EarlyStopping(monitor="loss", patience=0, mode="min")
+        # eval on every epoch; loss on a fixed eval set will plateau fast
+        # with a large lr; patience=0 means stop on first non-improvement
+        m.fit(ToyDataset(32), eval_data=ToyDataset(16), batch_size=16,
+              epochs=50, verbose=0, callbacks=[es])
+        assert m.stop_training  # stopped before 50 epochs
+
+    def test_checkpoint_callback(self, tmp_path):
+        m = _make_model()
+        m.fit(ToyDataset(32), batch_size=16, epochs=2, verbose=0,
+              save_dir=str(tmp_path / "ck"))
+        assert os.path.exists(str(tmp_path / "ck" / "final.pdparams"))
+
+    def test_summary(self, capsys):
+        m = _make_model()
+        info = m.summary()
+        assert info["total_params"] == 8 * 32 + 32 + 32 * 2 + 2
+
+    def test_network_computes_own_loss(self):
+        """Model with loss=None: the network's output IS the loss."""
+
+        class SelfLoss(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.lin = nn.Linear(4, 1)
+
+            def forward(self, x, y):
+                return ((self.lin(x) - y) ** 2).mean()
+
+        net = SelfLoss()
+        m = Model(net)
+        m.prepare(optimizer=paddle.optimizer.SGD(
+            learning_rate=0.05, parameters=net.parameters()))
+        x = RNG.randn(64, 4).astype("float32")
+        y = (x.sum(1, keepdims=True) * 0.3).astype("float32")
+        batches = [((x[i:i + 16], y[i:i + 16]),) for i in range(0, 64, 16)]
+        # network takes two inputs and returns loss; no separate labels
+        l0 = m.train_batch([x[:16], y[:16]])["loss"]
+        for _ in range(20):
+            logs = m.train_batch([x[:16], y[:16]])
+        assert logs["loss"] < l0
