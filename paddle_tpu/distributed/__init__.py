@@ -1,5 +1,6 @@
 """paddle.distributed namespace."""
-from . import collective, env, fleet, mesh, topology  # noqa: F401
+from . import auto_parallel, collective, env, fleet, mesh, topology  # noqa: F401
+from .auto_parallel import ProcessMesh, shard_op, shard_tensor  # noqa: F401
 from .collective import (  # noqa: F401
     Group,
     ReduceOp,

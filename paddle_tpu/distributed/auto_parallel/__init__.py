@@ -1,0 +1,5 @@
+"""paddle_tpu.distributed.auto_parallel — semi-auto SPMD
+(reference python/paddle/distributed/auto_parallel/)."""
+from .engine import Engine  # noqa: F401
+from .interface import get_sharding, shard_op, shard_tensor  # noqa: F401
+from .process_mesh import ProcessMesh, auto_process_mesh  # noqa: F401

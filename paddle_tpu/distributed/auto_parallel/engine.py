@@ -1,0 +1,125 @@
+"""auto_parallel Engine — fit/evaluate/predict over a ProcessMesh.
+
+Parity: reference python/paddle/distributed/auto_parallel/engine.py:58
+(`Engine(model, loss, optimizer, metrics, strategy)`, fit at :811,
+evaluate/predict, dataloader splitting). The reference Engine plans
+(Planner), partitions (Partitioner) and reshards the serialized program;
+here the plan IS the mesh + parameter specs and the compiled step is one
+GSPMD-partitioned XLA module (parallel.engine.CompiledTrainStep).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...core.tensor import Tensor
+from ...parallel.engine import CompiledTrainStep
+from .. import mesh as _gmesh
+from .process_mesh import ProcessMesh, auto_process_mesh
+
+
+class Engine:
+    def __init__(self, model=None, loss=None, optimizer=None, metrics=None,
+                 strategy=None, process_mesh=None):
+        self.model = model
+        self.loss = loss
+        self.optimizer = optimizer
+        self.metrics = metrics if isinstance(metrics, (list, tuple)) else (
+            [metrics] if metrics is not None else [])
+        self.strategy = strategy
+        self.process_mesh = process_mesh
+        self._step = None
+        self._history = []
+
+    def _ensure_mesh(self):
+        if self.process_mesh is None:
+            mp = 1
+            if self.strategy is not None:
+                mp = getattr(self.strategy, "tensor_parallel_configs", {}) \
+                    .get("tensor_parallel_degree", 1) \
+                    if getattr(self.strategy, "tensor_parallel", False) else 1
+            self.process_mesh = auto_process_mesh(mp=mp)
+        _gmesh.set_mesh(self.process_mesh.get_mesh())
+        return self.process_mesh
+
+    def prepare(self, zero_stage=0):
+        self._ensure_mesh()
+        if self.optimizer is not None and self.loss is not None:
+            zs = zero_stage
+            if self.strategy is not None and getattr(
+                    self.strategy, "sharding", False):
+                zs = self.strategy.sharding_configs.get("stage", zero_stage)
+            self._step = CompiledTrainStep(
+                self.model, self._loss_adapter, self.optimizer,
+                mesh=self.process_mesh.get_mesh(), zero_stage=zs)
+        return self
+
+    def _loss_adapter(self, out, labels):
+        return self.loss(out, labels)
+
+    def fit(self, train_data, epochs=1, batch_size=None, steps_per_epoch=None,
+            log_freq=10, verbose=0):
+        if self._step is None:
+            self.prepare()
+        history = []
+        for epoch in range(epochs):
+            losses = []
+            for i, batch in enumerate(self._iter_batches(train_data,
+                                                         batch_size)):
+                if steps_per_epoch and i >= steps_per_epoch:
+                    break
+                *ins, lbl = batch
+                loss = self._step(*ins, lbl)
+                losses.append(float(loss))
+                if verbose and i % log_freq == 0:
+                    print("epoch %d step %d loss %.4f"
+                          % (epoch, i, losses[-1]))
+            history.append({"loss": float(np.mean(losses))
+                            if losses else None})
+        self._history = history
+        return history
+
+    def evaluate(self, eval_data, batch_size=None):
+        import paddle_tpu as paddle
+
+        self._ensure_mesh()
+        self.model.eval()
+        losses = []
+        with paddle.no_grad():
+            for batch in self._iter_batches(eval_data, batch_size):
+                *ins, lbl = [self._wrap(b) for b in batch]
+                out = self.model(*ins)
+                losses.append(float(self.loss(out, lbl)))
+        self.model.train()
+        return {"loss": float(np.mean(losses)) if losses else None}
+
+    def predict(self, test_data, batch_size=None):
+        import paddle_tpu as paddle
+
+        self._ensure_mesh()
+        self.model.eval()
+        outs = []
+        with paddle.no_grad():
+            for batch in self._iter_batches(test_data, batch_size):
+                ins = [self._wrap(b) for b in batch]
+                outs.append(self.model(*ins).numpy())
+        self.model.train()
+        return outs
+
+    def _wrap(self, b):
+        return b if isinstance(b, Tensor) else Tensor(np.asarray(b))
+
+    def _iter_batches(self, data, batch_size):
+        from ...io import DataLoader, Dataset
+
+        if isinstance(data, DataLoader):
+            yield from data
+        elif isinstance(data, Dataset):
+            loader = DataLoader(data, batch_size=batch_size or 1,
+                                shuffle=False)
+            yield from loader
+        else:
+            yield from data
+
+    @property
+    def history(self):
+        return self._history

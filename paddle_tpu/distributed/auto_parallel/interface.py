@@ -1,0 +1,84 @@
+"""shard_tensor / shard_op — the semi-auto SPMD annotation API.
+
+Parity: reference python/paddle/distributed/auto_parallel/interface.py:28
+(`shard_tensor(x, process_mesh, shard_spec)`) and `shard_op`. The
+reference stores DistAttr on the program and runs its own Completer
+(completion.py) to propagate placements, then a Partitioner+Resharder to
+slice the program and insert comm ops. On TPU the entire pipeline is
+GSPMD: annotations become NamedShardings / sharding constraints and the
+XLA partitioner does completion, partitioning and resharding in one pass.
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...core.tensor import Tensor
+from .process_mesh import ProcessMesh
+
+
+def _to_partition_spec(shard_spec):
+    if shard_spec is None:
+        return P()
+    return P(*[s if s is not None else None for s in shard_spec])
+
+
+def shard_tensor(x, process_mesh, shard_spec):
+    """Place x on the mesh with dims sharded per shard_spec (a list with
+    one mesh-dim name or None per tensor dim). Returns x (annotated and
+    re-placed); parameters keep the spec so compiled steps preserve it."""
+    if not isinstance(process_mesh, ProcessMesh):
+        raise TypeError("process_mesh must be a ProcessMesh")
+    spec = _to_partition_spec(shard_spec)
+    mesh = process_mesh.get_mesh()
+    if isinstance(x, Tensor):
+        x._value = jax.device_put(x._value, NamedSharding(mesh, spec))
+        x._sharding_spec = spec
+        return x
+    return jax.device_put(x, NamedSharding(mesh, spec))
+
+
+def shard_op(op_fn, process_mesh=None, in_shard_specs=None,
+             out_shard_specs=None):
+    """Wrap a callable so its outputs carry sharding constraints
+    (reference interface.py shard_op). Inside jit this pins the GSPMD
+    placement; outside it re-places the eager result."""
+
+    def wrapped(*args, **kwargs):
+        out = op_fn(*args, **kwargs)
+        if out_shard_specs is None or process_mesh is None:
+            return out
+        mesh = process_mesh.get_mesh()
+
+        def constrain(t, spec):
+            ps = _to_partition_spec(spec)
+            if isinstance(t, Tensor):
+                try:
+                    t._value = jax.lax.with_sharding_constraint(
+                        t._value, NamedSharding(mesh, ps))
+                except Exception:
+                    t._value = jax.device_put(
+                        t._value, NamedSharding(mesh, ps))
+                return t
+            try:
+                return jax.lax.with_sharding_constraint(
+                    t, NamedSharding(mesh, ps))
+            except Exception:
+                return jax.device_put(t, NamedSharding(mesh, ps))
+
+        if isinstance(out, (tuple, list)):
+            return type(out)(
+                constrain(t, s) for t, s in zip(out, out_shard_specs))
+        return constrain(out, out_shard_specs[0]
+                         if isinstance(out_shard_specs[0], (list, tuple))
+                         or out_shard_specs[0] is None
+                         else out_shard_specs)
+
+    return wrapped
+
+
+def get_sharding(x):
+    """Inspect the placement of a tensor (debugging aid; the reference
+    exposes DistAttr via dist_tensor.dist_attr)."""
+    v = x._value if isinstance(x, Tensor) else x
+    return getattr(v, "sharding", None)

@@ -1,0 +1,104 @@
+"""ProcessMesh — the auto-parallel device topology object.
+
+Parity: reference python/paddle/distributed/auto_parallel/process_mesh.py
+(`ProcessMesh` with `shape`, `process_ids`, `dim_names`). TPU-native: a
+ProcessMesh is a thin, picklable description that lowers to a
+jax.sharding.Mesh; "processes" are XLA devices (SPMD ranks), and nested
+sub-meshes are mesh slices.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+from .. import mesh as _gmesh
+
+
+class ProcessMesh:
+    def __init__(self, mesh, dim_names=None, process_ids=None):
+        arr = np.asarray(mesh)
+        if arr.ndim == 0:
+            arr = arr.reshape(1)
+        self._shape = list(arr.shape)
+        self._process_ids = arr.flatten().tolist()
+        if dim_names is None:
+            dim_names = ["d%d" % i for i in range(arr.ndim)]
+        if len(dim_names) != arr.ndim:
+            raise ValueError("dim_names %r does not match mesh ndim %d"
+                             % (dim_names, arr.ndim))
+        self._dim_names = list(dim_names)
+        self._jax_mesh = None
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def process_ids(self):
+        return list(self._process_ids)
+
+    # reference alias
+    processes = process_ids
+
+    @property
+    def dim_names(self):
+        return list(self._dim_names)
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    def get_dim_size(self, dim_name):
+        return self._shape[self._dim_names.index(dim_name)]
+
+    def get_mesh(self):
+        """Lower to a jax.sharding.Mesh over the actual devices."""
+        if self._jax_mesh is None:
+            devices = {d.id: d for d in jax.devices()}
+            try:
+                devs = np.array([devices[i] for i in self._process_ids])
+            except KeyError as e:
+                raise ValueError(
+                    "process id %s is not an available device (have %d)"
+                    % (e, len(devices)))
+            self._jax_mesh = Mesh(devs.reshape(self._shape),
+                                  tuple(self._dim_names))
+        return self._jax_mesh
+
+    def __enter__(self):
+        self._prev = _gmesh.get_mesh()
+        _gmesh.set_mesh(self.get_mesh())
+        return self
+
+    def __exit__(self, *exc):
+        _gmesh.set_mesh(self._prev)
+        return False
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh)
+                and self._shape == other._shape
+                and self._process_ids == other._process_ids
+                and self._dim_names == other._dim_names)
+
+    def __repr__(self):
+        return ("ProcessMesh(shape=%s, process_ids=%s, dim_names=%s)"
+                % (self._shape, self._process_ids, self._dim_names))
+
+
+def auto_process_mesh(dp=None, mp=1, pp=1):
+    """Build a ProcessMesh over all devices with the given degrees; dp
+    fills the remainder (a minimal Planner: the reference's tuner searches
+    strategies, we default to data-parallel residue)."""
+    n = jax.device_count()
+    if dp is None:
+        dp = n // (mp * pp)
+    if dp * mp * pp != n:
+        raise ValueError("dp*mp*pp=%d != device count %d" % (dp * mp * pp, n))
+    ids = np.arange(n).reshape([d for d in (pp, dp, mp)])
+    names = ["pp", "dp", "mp"]
+    keep = [i for i, d in enumerate((pp, dp, mp)) if d > 1 or names[i] in
+            ("dp", "mp")]
+    ids = ids.reshape([(pp, dp, mp)[i] for i in keep])
+    return ProcessMesh(ids, [names[i] for i in keep])

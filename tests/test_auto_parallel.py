@@ -1,0 +1,97 @@
+"""auto_parallel tests: ProcessMesh, shard_tensor/shard_op, Engine.
+
+Reference analog: unittests/auto_parallel/ (engine/api tests)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed import ProcessMesh, shard_op, shard_tensor
+from paddle_tpu.distributed.auto_parallel import (
+    Engine,
+    auto_process_mesh,
+    get_sharding,
+)
+
+RNG = np.random.RandomState(11)
+
+
+class TestProcessMesh:
+    def test_construct(self):
+        pm = ProcessMesh([[0, 1, 2, 3], [4, 5, 6, 7]],
+                         dim_names=["x", "y"])
+        assert pm.shape == [2, 4]
+        assert pm.ndim == 2
+        assert pm.get_dim_size("y") == 4
+        assert pm.process_ids == list(range(8))
+        m = pm.get_mesh()
+        assert m.shape == {"x": 2, "y": 4}
+
+    def test_equality(self):
+        a = ProcessMesh([0, 1], dim_names=["dp"])
+        b = ProcessMesh([0, 1], dim_names=["dp"])
+        c = ProcessMesh([0, 1], dim_names=["mp"])
+        assert a == b and a != c
+
+    def test_auto_process_mesh(self):
+        pm = auto_process_mesh(mp=4)
+        assert pm.get_dim_size("mp") == 4
+        assert pm.get_dim_size("dp") == 2
+
+    def test_bad_process_ids(self):
+        pm = ProcessMesh([100, 101], dim_names=["dp"])
+        with pytest.raises(ValueError):
+            pm.get_mesh()
+
+
+class TestShardTensor:
+    def test_shard_tensor_places(self):
+        pm = ProcessMesh([[0, 1, 2, 3], [4, 5, 6, 7]],
+                         dim_names=["dp", "mp"])
+        x = paddle.to_tensor(RNG.randn(8, 16).astype("float32"))
+        shard_tensor(x, pm, ["dp", None])
+        sh = get_sharding(x)
+        assert sh is not None
+        assert "dp" in str(sh.spec)
+        # value preserved
+        assert x.shape == [8, 16]
+
+    def test_shard_tensor_sets_param_spec(self):
+        pm = ProcessMesh(list(range(8)), dim_names=["mp"])
+        lin = nn.Linear(16, 32)
+        shard_tensor(lin.weight, pm, [None, "mp"])
+        assert lin.weight._sharding_spec is not None
+
+    def test_shard_op_constrains_output(self):
+        pm = ProcessMesh(list(range(8)), dim_names=["dp"])
+        f = shard_op(lambda a, b: paddle.matmul(a, b), pm,
+                     out_shard_specs=[["dp", None]])
+        a = paddle.to_tensor(RNG.randn(8, 4).astype("float32"))
+        b = paddle.to_tensor(RNG.randn(4, 4).astype("float32"))
+        out = f(a, b)
+        np.testing.assert_allclose(
+            out.numpy(), a.numpy() @ b.numpy(), rtol=1e-5)
+        assert "dp" in str(get_sharding(out).spec)
+
+
+class TestEngine:
+    def test_fit_evaluate_predict(self):
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 8))
+        opt = paddle.optimizer.Adam(learning_rate=5e-3,
+                                    parameters=net.parameters())
+        eng = Engine(model=net, loss=nn.MSELoss(), optimizer=opt,
+                     process_mesh=ProcessMesh(list(range(8)),
+                                              dim_names=["dp"]))
+        x = RNG.randn(64, 8).astype("float32")
+        y = (x * 0.5).astype("float32")
+        batches = [(x[i:i + 16], y[i:i + 16]) for i in range(0, 64, 16)]
+        hist = eng.fit(batches, epochs=4)
+        assert hist[-1]["loss"] < hist[0]["loss"]
+        ev = eng.evaluate(batches)
+        assert ev["loss"] == pytest.approx(hist[-1]["loss"], rel=1.0)
+        preds = eng.predict([(x[:16],)])
+        assert preds[0].shape == (16, 8)
