@@ -275,6 +275,10 @@ from .ops.linalg import (  # noqa: F401
 from . import amp  # noqa: F401
 from . import autograd  # noqa: F401
 from . import distributed  # noqa: F401
+from . import distribution  # noqa: F401
+from . import fft  # noqa: F401
+from . import signal  # noqa: F401
+from . import sparse  # noqa: F401
 from . import framework  # noqa: F401
 from . import hapi  # noqa: F401
 from . import io  # noqa: F401

@@ -1,0 +1,486 @@
+"""paddle_tpu.distribution — probability distributions.
+
+Parity: reference python/paddle/distribution/ (Distribution base
+distribution.py:42, Normal, Uniform, Categorical, Beta, Dirichlet,
+Multinomial, kl_divergence/register_kl kl.py:33). Math is jnp/jax.random;
+sampling threads the framework's global RNG (framework/random.py).
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..framework import random as _random
+
+__all__ = [
+    "Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
+    "Beta", "Dirichlet", "Exponential", "Gamma", "Laplace", "LogNormal",
+    "Multinomial", "Gumbel", "kl_divergence", "register_kl",
+]
+
+
+def _v(x):
+    if isinstance(x, Tensor):
+        return x._value
+    return jnp.asarray(x, jnp.float32)
+
+
+def _key():
+    return _random.next_key()
+
+
+class Distribution:
+    """Base class (reference distribution/distribution.py:42)."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return Tensor(jnp.exp(_v(self.log_prob(value))))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+    def _extend(self, shape):
+        return tuple(shape) + self._batch_shape + self._event_shape
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _v(loc)
+        self.scale = _v(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.loc, self._batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(self.scale ** 2, self._batch_shape))
+
+    @property
+    def stddev(self):
+        return Tensor(jnp.broadcast_to(self.scale, self._batch_shape))
+
+    def sample(self, shape=()):
+        eps = jax.random.normal(_key(), self._extend(shape))
+        return Tensor(self.loc + self.scale * eps)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _v(value)
+        var = self.scale ** 2
+        return Tensor(-((v - self.loc) ** 2) / (2 * var)
+                      - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        return Tensor(jnp.broadcast_to(
+            0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale),
+            self._batch_shape))
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _v(loc)
+        self.scale = _v(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.exp(self.loc + self.scale ** 2 / 2))
+
+    @property
+    def variance(self):
+        s2 = self.scale ** 2
+        return Tensor((jnp.exp(s2) - 1) * jnp.exp(2 * self.loc + s2))
+
+    def sample(self, shape=()):
+        eps = jax.random.normal(_key(), self._extend(shape))
+        return Tensor(jnp.exp(self.loc + self.scale * eps))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _v(value)
+        logv = jnp.log(v)
+        var = self.scale ** 2
+        return Tensor(-((logv - self.loc) ** 2) / (2 * var) - logv
+                      - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _v(low)
+        self.high = _v(high)
+        super().__init__(jnp.broadcast_shapes(self.low.shape,
+                                              self.high.shape))
+
+    @property
+    def mean(self):
+        return Tensor((self.low + self.high) / 2)
+
+    @property
+    def variance(self):
+        return Tensor((self.high - self.low) ** 2 / 12)
+
+    def sample(self, shape=()):
+        u = jax.random.uniform(_key(), self._extend(shape))
+        return Tensor(self.low + (self.high - self.low) * u)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _v(value)
+        inside = (v >= self.low) & (v < self.high)
+        lp = -jnp.log(self.high - self.low)
+        return Tensor(jnp.where(inside, lp, -jnp.inf))
+
+    def entropy(self):
+        return Tensor(jnp.log(self.high - self.low))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs=None, logits=None, name=None):
+        if (probs is None) == (logits is None):
+            raise ValueError("pass exactly one of probs/logits")
+        if probs is not None:
+            self.probs = _v(probs)
+            self.logits = jnp.log(self.probs) - jnp.log1p(-self.probs)
+        else:
+            self.logits = _v(logits)
+            self.probs = jax.nn.sigmoid(self.logits)
+        super().__init__(self.probs.shape)
+
+    @property
+    def mean(self):
+        return Tensor(self.probs)
+
+    @property
+    def variance(self):
+        return Tensor(self.probs * (1 - self.probs))
+
+    def sample(self, shape=()):
+        u = jax.random.uniform(_key(), self._extend(shape))
+        return Tensor((u < self.probs).astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _v(value)
+        return Tensor(v * jnp.log(jnp.clip(self.probs, 1e-12))
+                      + (1 - v) * jnp.log(jnp.clip(1 - self.probs, 1e-12)))
+
+    def entropy(self):
+        p = jnp.clip(self.probs, 1e-12, 1 - 1e-12)
+        return Tensor(-(p * jnp.log(p) + (1 - p) * jnp.log(1 - p)))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None, name=None):
+        if logits is not None and probs is None:
+            self.logits = _v(logits)
+            self.probs = jax.nn.softmax(self.logits, -1)
+        elif probs is not None:
+            self.probs = _v(probs)
+            self.probs = self.probs / jnp.sum(self.probs, -1, keepdims=True)
+            self.logits = jnp.log(jnp.clip(self.probs, 1e-12))
+        else:
+            raise ValueError("pass logits or probs")
+        super().__init__(self.probs.shape[:-1])
+
+    def sample(self, shape=()):
+        out = jax.random.categorical(
+            _key(), self.logits, shape=tuple(shape) + self._batch_shape)
+        return Tensor(out)
+
+    def log_prob(self, value):
+        v = _v(value).astype(jnp.int32)
+        logp = jax.nn.log_softmax(self.logits, -1)
+        return Tensor(jnp.take_along_axis(
+            logp, v[..., None], axis=-1)[..., 0])
+
+    def probs_of(self, value):
+        return Tensor(jnp.exp(_v(self.log_prob(value))))
+
+    def entropy(self):
+        logp = jax.nn.log_softmax(self.logits, -1)
+        return Tensor(-jnp.sum(jnp.exp(logp) * logp, -1))
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _v(alpha)
+        self.beta = _v(beta)
+        super().__init__(jnp.broadcast_shapes(self.alpha.shape,
+                                              self.beta.shape))
+
+    @property
+    def mean(self):
+        return Tensor(self.alpha / (self.alpha + self.beta))
+
+    @property
+    def variance(self):
+        s = self.alpha + self.beta
+        return Tensor(self.alpha * self.beta / (s * s * (s + 1)))
+
+    def sample(self, shape=()):
+        return Tensor(jax.random.beta(
+            _key(), self.alpha, self.beta, self._extend(shape)))
+
+    def log_prob(self, value):
+        v = _v(value)
+        lbeta = (jax.scipy.special.gammaln(self.alpha)
+                 + jax.scipy.special.gammaln(self.beta)
+                 - jax.scipy.special.gammaln(self.alpha + self.beta))
+        return Tensor((self.alpha - 1) * jnp.log(v)
+                      + (self.beta - 1) * jnp.log1p(-v) - lbeta)
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.concentration = _v(concentration)
+        super().__init__(self.concentration.shape[:-1],
+                         self.concentration.shape[-1:])
+
+    @property
+    def mean(self):
+        return Tensor(self.concentration
+                      / jnp.sum(self.concentration, -1, keepdims=True))
+
+    def sample(self, shape=()):
+        return Tensor(jax.random.dirichlet(
+            _key(), self.concentration,
+            tuple(shape) + self._batch_shape))
+
+    def log_prob(self, value):
+        v = _v(value)
+        a = self.concentration
+        lnorm = (jnp.sum(jax.scipy.special.gammaln(a), -1)
+                 - jax.scipy.special.gammaln(jnp.sum(a, -1)))
+        return Tensor(jnp.sum((a - 1) * jnp.log(v), -1) - lnorm)
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _v(rate)
+        super().__init__(self.rate.shape)
+
+    @property
+    def mean(self):
+        return Tensor(1.0 / self.rate)
+
+    @property
+    def variance(self):
+        return Tensor(1.0 / self.rate ** 2)
+
+    def sample(self, shape=()):
+        u = jax.random.exponential(_key(), self._extend(shape))
+        return Tensor(u / self.rate)
+
+    def log_prob(self, value):
+        v = _v(value)
+        return Tensor(jnp.log(self.rate) - self.rate * v)
+
+    def entropy(self):
+        return Tensor(1.0 - jnp.log(self.rate))
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _v(concentration)
+        self.rate = _v(rate)
+        super().__init__(jnp.broadcast_shapes(self.concentration.shape,
+                                              self.rate.shape))
+
+    @property
+    def mean(self):
+        return Tensor(self.concentration / self.rate)
+
+    @property
+    def variance(self):
+        return Tensor(self.concentration / self.rate ** 2)
+
+    def sample(self, shape=()):
+        g = jax.random.gamma(_key(), self.concentration,
+                             self._extend(shape))
+        return Tensor(g / self.rate)
+
+    def log_prob(self, value):
+        v = _v(value)
+        a, b = self.concentration, self.rate
+        return Tensor(a * jnp.log(b) + (a - 1) * jnp.log(v) - b * v
+                      - jax.scipy.special.gammaln(a))
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _v(loc)
+        self.scale = _v(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.loc, self._batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(2 * self.scale ** 2,
+                                       self._batch_shape))
+
+    def sample(self, shape=()):
+        eps = jax.random.laplace(_key(), self._extend(shape))
+        return Tensor(self.loc + self.scale * eps)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _v(value)
+        return Tensor(-jnp.abs(v - self.loc) / self.scale
+                      - jnp.log(2 * self.scale))
+
+    def entropy(self):
+        return Tensor(1 + jnp.log(2 * self.scale)
+                      + jnp.zeros(self._batch_shape))
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _v(loc)
+        self.scale = _v(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(self.loc + self.scale * np.euler_gamma)
+
+    def sample(self, shape=()):
+        g = jax.random.gumbel(_key(), self._extend(shape))
+        return Tensor(self.loc + self.scale * g)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        z = (_v(value) - self.loc) / self.scale
+        return Tensor(-(z + jnp.exp(-z)) - jnp.log(self.scale))
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs = _v(probs)
+        self.probs = self.probs / jnp.sum(self.probs, -1, keepdims=True)
+        super().__init__(self.probs.shape[:-1], self.probs.shape[-1:])
+
+    @property
+    def mean(self):
+        return Tensor(self.total_count * self.probs)
+
+    def sample(self, shape=()):
+        n_cat = self.probs.shape[-1]
+        logits = jnp.log(jnp.clip(self.probs, 1e-12))
+        draws = jax.random.categorical(
+            _key(), logits,
+            shape=(self.total_count,) + tuple(shape) + self._batch_shape)
+        counts = jax.nn.one_hot(draws, n_cat).sum(0)
+        return Tensor(counts)
+
+    def log_prob(self, value):
+        v = _v(value)
+        logp = jnp.log(jnp.clip(self.probs, 1e-12))
+        coef = (jax.scipy.special.gammaln(
+            jnp.asarray(self.total_count + 1.0))
+            - jnp.sum(jax.scipy.special.gammaln(v + 1.0), -1))
+        return Tensor(coef + jnp.sum(v * logp, -1))
+
+
+# -- KL divergence registry (reference distribution/kl.py:33) ---------------
+
+_KL_REGISTRY = {}
+
+
+def register_kl(p_cls, q_cls):
+    def deco(fn):
+        _KL_REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+
+    return deco
+
+
+def kl_divergence(p, q):
+    for (pc, qc), fn in _KL_REGISTRY.items():
+        if isinstance(p, pc) and isinstance(q, qc):
+            return fn(p, q)
+    raise NotImplementedError(
+        "no KL registered for (%s, %s)"
+        % (type(p).__name__, type(q).__name__))
+
+
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p, q):
+    var_ratio = (p.scale / q.scale) ** 2
+    t1 = ((p.loc - q.loc) / q.scale) ** 2
+    return Tensor(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform_uniform(p, q):
+    return Tensor(jnp.log((q.high - q.low) / (p.high - p.low)))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_cat_cat(p, q):
+    lp = jax.nn.log_softmax(p.logits, -1)
+    lq = jax.nn.log_softmax(q.logits, -1)
+    return Tensor(jnp.sum(jnp.exp(lp) * (lp - lq), -1))
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bern_bern(p, q):
+    pp = jnp.clip(p.probs, 1e-12, 1 - 1e-12)
+    qq = jnp.clip(q.probs, 1e-12, 1 - 1e-12)
+    return Tensor(pp * (jnp.log(pp) - jnp.log(qq))
+                  + (1 - pp) * (jnp.log1p(-pp) - jnp.log1p(-qq)))
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exp_exp(p, q):
+    r = q.rate / p.rate
+    return Tensor(jnp.log(p.rate) - jnp.log(q.rate) + r - 1)

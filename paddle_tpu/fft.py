@@ -1,0 +1,101 @@
+"""paddle_tpu.fft — spectral ops.
+
+Parity: reference python/paddle/fft.py (fft/ifft/rfft/irfft families,
+helpers fftfreq/fftshift) backed by phi kernels
+(/root/reference/paddle/phi/kernels/cpu/fft.cc, gpu cuFFT via
+funcs/cufft_util.h). TPU-native: jnp.fft lowers to XLA FftOp which runs on
+the TPU's vector unit; autograd comes from the primitive registry.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.dispatch import primitive
+
+_A = jnp.asarray
+
+
+def _norm(norm):
+    # paddle uses "backward"|"forward"|"ortho" like numpy
+    return norm or "backward"
+
+
+def _fft1(name, fn):
+    @primitive(name=name)
+    def op(x, n=None, axis=-1, norm=None):
+        return fn(_A(x), n=n, axis=axis, norm=_norm(norm))
+
+    return op
+
+
+def _fft2d(name, fn):
+    @primitive(name=name)
+    def op(x, s=None, axes=(-2, -1), norm=None):
+        return fn(_A(x), s=s, axes=axes, norm=_norm(norm))
+
+    return op
+
+
+fft = _fft1("fft", jnp.fft.fft)
+ifft = _fft1("ifft", jnp.fft.ifft)
+rfft = _fft1("rfft", jnp.fft.rfft)
+irfft = _fft1("irfft", jnp.fft.irfft)
+hfft = _fft1("hfft", jnp.fft.hfft)
+ihfft = _fft1("ihfft", jnp.fft.ihfft)
+
+fft2 = _fft2d("fft2", jnp.fft.fft2)
+ifft2 = _fft2d("ifft2", jnp.fft.ifft2)
+rfft2 = _fft2d("rfft2", jnp.fft.rfft2)
+irfft2 = _fft2d("irfft2", jnp.fft.irfft2)
+
+
+@primitive
+def fftn(x, s=None, axes=None, norm=None):
+    return jnp.fft.fftn(_A(x), s=s, axes=axes, norm=_norm(norm))
+
+
+@primitive
+def ifftn(x, s=None, axes=None, norm=None):
+    return jnp.fft.ifftn(_A(x), s=s, axes=axes, norm=_norm(norm))
+
+
+@primitive
+def rfftn(x, s=None, axes=None, norm=None):
+    return jnp.fft.rfftn(_A(x), s=s, axes=axes, norm=_norm(norm))
+
+
+@primitive
+def irfftn(x, s=None, axes=None, norm=None):
+    return jnp.fft.irfftn(_A(x), s=s, axes=axes, norm=_norm(norm))
+
+
+@primitive
+def fftshift(x, axes=None):
+    return jnp.fft.fftshift(_A(x), axes=axes)
+
+
+@primitive
+def ifftshift(x, axes=None):
+    return jnp.fft.ifftshift(_A(x), axes=axes)
+
+
+def fftfreq(n, d=1.0, dtype=None):
+    from .core.tensor import Tensor
+
+    out = jnp.fft.fftfreq(n, d)
+    if dtype is not None:
+        from .core import dtype as _dt
+
+        out = out.astype(_dt.to_jax(dtype))
+    return Tensor(out)
+
+
+def rfftfreq(n, d=1.0, dtype=None):
+    from .core.tensor import Tensor
+
+    out = jnp.fft.rfftfreq(n, d)
+    if dtype is not None:
+        from .core import dtype as _dt
+
+        out = out.astype(_dt.to_jax(dtype))
+    return Tensor(out)

@@ -216,6 +216,10 @@ _METHODS = {
     "tril": creation.tril,
     "triu": creation.triu,
     "diagonal": math.diagonal,
+    "conj": math.conj,
+    "real": math.real,
+    "imag": math.imag,
+    "angle": math.angle,
 }
 
 

@@ -1,0 +1,132 @@
+"""paddle_tpu.signal — STFT/ISTFT.
+
+Parity: reference python/paddle/signal.py (stft :161, istft :324) backed by
+frame/overlap_add ops (phi kernels frame_kernel, overlap_add_kernel).
+TPU-native: framing is a gather-free as_strided-style reshape + rfft; XLA
+maps the batched FFTs onto the VPU.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.dispatch import primitive
+
+_A = jnp.asarray
+
+
+def _frame(x, frame_length, hop_length):
+    """[..., T] -> [..., n_frames, frame_length]"""
+    t = x.shape[-1]
+    n_frames = 1 + (t - frame_length) // hop_length
+    idx = (jnp.arange(n_frames)[:, None] * hop_length
+           + jnp.arange(frame_length)[None, :])
+    return x[..., idx]
+
+
+@primitive
+def frame(x, frame_length, hop_length, axis=-1):
+    x = _A(x)
+    if axis not in (-1, x.ndim - 1):
+        x = jnp.moveaxis(x, axis, -1)
+    out = _frame(x, frame_length, hop_length)
+    if axis not in (-1, x.ndim - 1):
+        out = jnp.moveaxis(out, -2, axis)
+    return out
+
+
+@primitive
+def overlap_add(x, hop_length, axis=-1):
+    """[..., n_frames, frame_length] -> [..., T] (reference overlap_add)."""
+    x = _A(x)
+    *batch, n_frames, frame_length = x.shape
+    t = (n_frames - 1) * hop_length + frame_length
+    out = jnp.zeros(tuple(batch) + (t,), x.dtype)
+    idx = (jnp.arange(n_frames)[:, None] * hop_length
+           + jnp.arange(frame_length)[None, :])
+    return out.at[..., idx.reshape(-1)].add(
+        x.reshape(tuple(batch) + (-1,)))
+
+
+@primitive
+def stft_op(x, window, n_fft, hop_length, center, pad_mode, onesided):
+    x = _A(x)
+    if center:
+        pad = [(0, 0)] * (x.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+        x = jnp.pad(x, pad, mode=pad_mode)
+    frames = _frame(x, n_fft, hop_length)          # [..., n_frames, n_fft]
+    if window is not None:
+        frames = frames * _A(window)
+    fftfn = jnp.fft.rfft if onesided else jnp.fft.fft
+    spec = fftfn(frames, axis=-1)                  # [..., n_frames, bins]
+    return jnp.swapaxes(spec, -1, -2)              # [..., bins, n_frames]
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    """reference signal.py:161 stft. x: [..., T] real or complex Tensor."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is not None and win_length != n_fft:
+        # center-pad the window to n_fft, as the reference does
+        import numpy as np
+
+        w = window.numpy() if hasattr(window, "numpy") else np.asarray(window)
+        lpad = (n_fft - win_length) // 2
+        w = np.pad(w, (lpad, n_fft - win_length - lpad))
+        window = w
+    out = stft_op(x, window, n_fft=n_fft, hop_length=hop_length,
+                  center=center, pad_mode=pad_mode, onesided=onesided)
+    if normalized:
+        import math
+
+        out = out / math.sqrt(n_fft)
+    return out
+
+
+@primitive
+def istft_op(spec, window, n_fft, hop_length, center, onesided, length):
+    spec = _A(spec)
+    frames_f = jnp.swapaxes(spec, -1, -2)          # [..., n_frames, bins]
+    ifftfn = jnp.fft.irfft if onesided else jnp.fft.ifft
+    frames = ifftfn(frames_f, n=n_fft, axis=-1)
+    if not onesided:
+        frames = frames.real
+    if window is not None:
+        w = _A(window)
+        frames = frames * w
+        wsq = jnp.broadcast_to(w * w, frames.shape)
+    else:
+        wsq = jnp.ones_like(frames)
+    *batch, n_frames, _ = frames.shape
+    t = (n_frames - 1) * hop_length + n_fft
+    idx = (jnp.arange(n_frames)[:, None] * hop_length
+           + jnp.arange(n_fft)[None, :]).reshape(-1)
+    num = jnp.zeros(tuple(batch) + (t,), frames.dtype).at[..., idx].add(
+        frames.reshape(tuple(batch) + (-1,)))
+    den = jnp.zeros(tuple(batch) + (t,), frames.dtype).at[..., idx].add(
+        wsq.reshape(tuple(batch) + (-1,)))
+    out = num / jnp.maximum(den, 1e-10)
+    if center:
+        out = out[..., n_fft // 2:]
+        if length is not None:
+            out = out[..., :length]
+        else:
+            out = out[..., :t - n_fft]
+    elif length is not None:
+        out = out[..., :length]
+    return out
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """reference signal.py:324 istft (overlap-add with window-square
+    normalization)."""
+    hop_length = hop_length or n_fft // 4
+    if normalized:
+        import math
+
+        x = x * math.sqrt(n_fft)
+    return istft_op(x, window, n_fft=n_fft, hop_length=hop_length,
+                    center=center, onesided=onesided, length=length)

@@ -1,0 +1,280 @@
+"""paddle_tpu.sparse — COO/CSR sparse tensors.
+
+Parity: reference python/paddle/sparse/ (creation.py sparse_coo_tensor /
+sparse_csr_tensor, unary/binary/matmul ops) over the phi sparse kernel set
+(/root/reference/paddle/phi/kernels/sparse/). TPU-native: backed by
+jax.experimental.sparse BCOO/BCSR — XLA lowers sparse ops to
+gather/scatter/segment-sum programs; on TPU truly sparse compute rarely
+beats dense MXU matmuls, so (as with the reference's sparse-to-dense
+fallbacks) matmul densifies below a size threshold.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ..core.tensor import Tensor
+
+__all__ = [
+    "SparseCooTensor", "SparseCsrTensor", "sparse_coo_tensor",
+    "sparse_csr_tensor", "is_sparse_coo", "is_sparse_csr",
+    "add", "subtract", "multiply", "matmul", "masked_matmul",
+    "relu", "tanh", "sqrt", "sin", "pow", "neg", "abs", "coalesce",
+]
+
+
+def _v(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+class SparseCooTensor:
+    """COO sparse tensor (reference phi::SparseCooTensor)."""
+
+    def __init__(self, bcoo):
+        self._bcoo = bcoo
+
+    # -- metadata ----------------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._bcoo.shape)
+
+    @property
+    def dtype(self):
+        from ..core import dtype as _dt
+
+        return _dt.canonical_name(self._bcoo.dtype)
+
+    @property
+    def nnz(self):
+        return int(self._bcoo.nse)
+
+    def indices(self):
+        return Tensor(jnp.swapaxes(self._bcoo.indices, 0, 1))  # [ndim, nnz]
+
+    def values(self):
+        return Tensor(self._bcoo.data)
+
+    def is_sparse_coo(self):
+        return True
+
+    def is_sparse_csr(self):
+        return False
+
+    # -- conversion --------------------------------------------------------
+    def to_dense(self):
+        return Tensor(self._bcoo.todense())
+
+    def to_sparse_csr(self):
+        if len(self._bcoo.shape) != 2:
+            raise ValueError("CSR requires 2-D")
+        return SparseCsrTensor(jsparse.BCSR.from_bcoo(self._bcoo))
+
+    def coalesce(self):
+        return SparseCooTensor(self._bcoo.sum_duplicates())
+
+    def numpy(self):
+        return np.asarray(self._bcoo.todense())
+
+    def __repr__(self):
+        return ("SparseCooTensor(shape=%s, nnz=%d, dtype=%s)"
+                % (self.shape, self.nnz, self.dtype))
+
+    # -- arithmetic --------------------------------------------------------
+    def __add__(self, other):
+        return add(self, other)
+
+    def __sub__(self, other):
+        return subtract(self, other)
+
+    def __mul__(self, other):
+        return multiply(self, other)
+
+    def __neg__(self):
+        return neg(self)
+
+    def __matmul__(self, other):
+        return matmul(self, other)
+
+
+class SparseCsrTensor:
+    """CSR sparse tensor (reference phi::SparseCsrTensor)."""
+
+    def __init__(self, bcsr):
+        self._bcsr = bcsr
+
+    @property
+    def shape(self):
+        return list(self._bcsr.shape)
+
+    @property
+    def dtype(self):
+        from ..core import dtype as _dt
+
+        return _dt.canonical_name(self._bcsr.dtype)
+
+    @property
+    def nnz(self):
+        return int(self._bcsr.nse)
+
+    def crows(self):
+        return Tensor(self._bcsr.indptr)
+
+    def cols(self):
+        return Tensor(self._bcsr.indices)
+
+    def values(self):
+        return Tensor(self._bcsr.data)
+
+    def is_sparse_coo(self):
+        return False
+
+    def is_sparse_csr(self):
+        return True
+
+    def to_dense(self):
+        return Tensor(self._bcsr.todense())
+
+    def to_sparse_coo(self, sparse_dim=None):
+        return SparseCooTensor(self._bcsr.to_bcoo())
+
+    def numpy(self):
+        return np.asarray(self._bcsr.todense())
+
+    def __repr__(self):
+        return ("SparseCsrTensor(shape=%s, nnz=%d, dtype=%s)"
+                % (self.shape, self.nnz, self.dtype))
+
+    def __matmul__(self, other):
+        return matmul(self, other)
+
+
+# -- creation ---------------------------------------------------------------
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      place=None, stop_gradient=True):
+    """reference sparse/creation.py sparse_coo_tensor: indices [ndim, nnz]."""
+    idx = np.asarray(indices if not isinstance(indices, Tensor)
+                     else indices.numpy())
+    vals = _v(values)
+    if dtype is not None:
+        from ..core import dtype as _dt
+
+        vals = vals.astype(_dt.to_jax(dtype))
+    if shape is None:
+        shape = tuple(int(m) + 1 for m in idx.max(axis=1))
+    bcoo = jsparse.BCOO((vals, jnp.asarray(idx.T)), shape=tuple(shape))
+    return SparseCooTensor(bcoo)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      place=None, stop_gradient=True):
+    vals = _v(values)
+    if dtype is not None:
+        from ..core import dtype as _dt
+
+        vals = vals.astype(_dt.to_jax(dtype))
+    bcsr = jsparse.BCSR(
+        (vals, jnp.asarray(_v(cols), jnp.int32),
+         jnp.asarray(_v(crows), jnp.int32)),
+        shape=tuple(shape))
+    return SparseCsrTensor(bcsr)
+
+
+def is_sparse_coo(x):
+    return isinstance(x, SparseCooTensor)
+
+
+def is_sparse_csr(x):
+    return isinstance(x, SparseCsrTensor)
+
+
+def _as_bcoo(x):
+    if isinstance(x, SparseCooTensor):
+        return x._bcoo
+    if isinstance(x, SparseCsrTensor):
+        return x._bcsr.to_bcoo()
+    raise TypeError("expected a sparse tensor, got %s" % type(x))
+
+
+def _rewrap(x, bcoo):
+    if isinstance(x, SparseCsrTensor):
+        return SparseCsrTensor(jsparse.BCSR.from_bcoo(bcoo.sum_duplicates()))
+    return SparseCooTensor(bcoo)
+
+
+# -- elementwise (same-pattern binary, unary on values) ---------------------
+
+def add(x, y):
+    if isinstance(y, (Tensor, jnp.ndarray, np.ndarray)):
+        return Tensor(_as_bcoo(x).todense() + _v(y))
+    out = (_as_bcoo(x) + _as_bcoo(y)).sum_duplicates()
+    return _rewrap(x, out)
+
+
+def subtract(x, y):
+    if isinstance(y, (Tensor, jnp.ndarray, np.ndarray)):
+        return Tensor(_as_bcoo(x).todense() - _v(y))
+    out = (_as_bcoo(x) + (-1.0) * _as_bcoo(y)).sum_duplicates()
+    return _rewrap(x, out)
+
+
+def multiply(x, y):
+    if isinstance(y, (int, float)):
+        b = _as_bcoo(x)
+        return _rewrap(x, jsparse.BCOO((b.data * y, b.indices),
+                                       shape=b.shape))
+    # elementwise with dense: scale stored values by gathered dense entries
+    b = _as_bcoo(x).sum_duplicates()
+    dv = _v(y)
+    gathered = dv[tuple(b.indices[:, i] for i in range(b.indices.shape[1]))]
+    return _rewrap(x, jsparse.BCOO((b.data * gathered, b.indices),
+                                   shape=b.shape))
+
+
+def _unary(fn):
+    def op(x):
+        b = _as_bcoo(x)
+        return _rewrap(x, jsparse.BCOO((fn(b.data), b.indices),
+                                       shape=b.shape))
+
+    return op
+
+
+relu = _unary(lambda d: jnp.maximum(d, 0))
+tanh = _unary(jnp.tanh)
+sqrt = _unary(jnp.sqrt)
+sin = _unary(jnp.sin)
+neg = _unary(jnp.negative)
+abs = _unary(jnp.abs)  # noqa: A001
+
+
+def pow(x, factor):  # noqa: A001
+    return _unary(lambda d: jnp.power(d, factor))(x)
+
+
+def coalesce(x):
+    return SparseCooTensor(_as_bcoo(x).sum_duplicates())
+
+
+# -- matmul -----------------------------------------------------------------
+
+def matmul(x, y):
+    """sparse @ dense -> dense (reference sparse/matmul.py)."""
+    if isinstance(x, (SparseCooTensor, SparseCsrTensor)):
+        out = _as_bcoo(x) @ _v(y)
+        return Tensor(out)
+    out = _v(x) @ _as_bcoo(y)
+    return Tensor(out)
+
+
+def masked_matmul(x, y, mask):
+    """dense @ dense sampled at mask's sparsity (reference
+    sparse/matmul.py masked_matmul — SDDMM)."""
+    b = _as_bcoo(mask).sum_duplicates()
+    xv, yv = _v(x), _v(y)
+    rows = b.indices[:, 0]
+    cols = b.indices[:, 1]
+    vals = jnp.einsum("nk,nk->n", xv[rows, :], yv[:, cols].T)
+    return SparseCooTensor(jsparse.BCOO((vals, b.indices), shape=b.shape))

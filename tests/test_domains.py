@@ -1,0 +1,202 @@
+"""Tests for sparse / distribution / fft / signal domains.
+
+Oracles: numpy/scipy-free closed forms. Reference analogs:
+unittests/test_sparse_*.py, test_distribution_*.py, fft tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import distribution as D
+from paddle_tpu import fft, signal, sparse
+
+RNG = np.random.RandomState(5)
+
+
+class TestSparse:
+    def _coo(self):
+        dense = np.zeros((4, 5), np.float32)
+        dense[0, 1] = 2.0
+        dense[2, 3] = -1.5
+        dense[3, 0] = 4.0
+        idx = np.array(np.nonzero(dense))
+        vals = dense[tuple(idx)]
+        return sparse.sparse_coo_tensor(idx, vals, dense.shape), dense
+
+    def test_coo_roundtrip(self):
+        st, dense = self._coo()
+        assert st.nnz == 3
+        np.testing.assert_allclose(st.to_dense().numpy(), dense)
+        assert st.is_sparse_coo()
+
+    def test_csr_roundtrip(self):
+        st, dense = self._coo()
+        csr = st.to_sparse_csr()
+        assert csr.is_sparse_csr()
+        np.testing.assert_allclose(csr.to_dense().numpy(), dense)
+        back = csr.to_sparse_coo()
+        np.testing.assert_allclose(back.to_dense().numpy(), dense)
+
+    def test_csr_direct_construction(self):
+        # [[0,2,0],[3,0,4]]
+        csr = sparse.sparse_csr_tensor(
+            [0, 1, 3], [1, 0, 2], [2.0, 3.0, 4.0], (2, 3))
+        expect = np.array([[0, 2, 0], [3, 0, 4]], np.float32)
+        np.testing.assert_allclose(csr.to_dense().numpy(), expect)
+
+    def test_elementwise(self):
+        st, dense = self._coo()
+        np.testing.assert_allclose((st + st).to_dense().numpy(), 2 * dense)
+        np.testing.assert_allclose((st - st).to_dense().numpy(), 0 * dense)
+        np.testing.assert_allclose(
+            sparse.relu(st).to_dense().numpy(), np.maximum(dense, 0))
+        np.testing.assert_allclose(
+            sparse.neg(st).to_dense().numpy(), -dense)
+
+    def test_matmul(self):
+        st, dense = self._coo()
+        y = RNG.randn(5, 3).astype(np.float32)
+        out = sparse.matmul(st, y)
+        np.testing.assert_allclose(out.numpy(), dense @ y, rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_masked_matmul(self):
+        st, dense = self._coo()
+        a = RNG.randn(4, 6).astype(np.float32)
+        b = RNG.randn(6, 5).astype(np.float32)
+        out = sparse.masked_matmul(a, b, st)
+        full = a @ b
+        expect = np.where(dense != 0, full, 0)
+        np.testing.assert_allclose(out.to_dense().numpy(), expect,
+                                   rtol=1e-5, atol=1e-5)
+
+
+class TestDistribution:
+    def test_normal_moments_and_logprob(self):
+        paddle.seed(0)
+        d = D.Normal(1.0, 2.0)
+        s = d.sample([20000])
+        assert abs(float(s.numpy().mean()) - 1.0) < 0.1
+        assert abs(float(s.numpy().std()) - 2.0) < 0.1
+        lp = d.log_prob(paddle.to_tensor(1.0))
+        expect = -np.log(2.0) - 0.5 * np.log(2 * np.pi)
+        np.testing.assert_allclose(float(lp), expect, rtol=1e-5)
+
+    def test_kl_normal(self):
+        p = D.Normal(0.0, 1.0)
+        q = D.Normal(1.0, 2.0)
+        kl = float(D.kl_divergence(p, q))
+        expect = np.log(2.0) + (1 + 1) / (2 * 4) - 0.5
+        np.testing.assert_allclose(kl, expect, rtol=1e-5)
+
+    def test_uniform(self):
+        paddle.seed(0)
+        d = D.Uniform(2.0, 6.0)
+        s = d.sample([10000]).numpy()
+        assert s.min() >= 2.0 and s.max() < 6.0
+        np.testing.assert_allclose(float(d.entropy()), np.log(4.0),
+                                   rtol=1e-6)
+        assert np.isneginf(float(d.log_prob(paddle.to_tensor(7.0))))
+
+    def test_categorical(self):
+        paddle.seed(0)
+        d = D.Categorical(probs=np.array([0.1, 0.2, 0.7], np.float32))
+        s = d.sample([20000]).numpy()
+        freq = np.bincount(s, minlength=3) / len(s)
+        np.testing.assert_allclose(freq, [0.1, 0.2, 0.7], atol=0.02)
+        lp = float(d.log_prob(paddle.to_tensor(2)))
+        np.testing.assert_allclose(lp, np.log(0.7), rtol=1e-4)
+
+    def test_bernoulli_beta_dirichlet(self):
+        paddle.seed(0)
+        b = D.Bernoulli(probs=0.3)
+        assert abs(float(b.sample([20000]).numpy().mean()) - 0.3) < 0.02
+        be = D.Beta(2.0, 3.0)
+        np.testing.assert_allclose(float(be.mean), 0.4, rtol=1e-6)
+        s = be.sample([20000]).numpy()
+        assert abs(s.mean() - 0.4) < 0.02
+        dr = D.Dirichlet(np.array([1.0, 2.0, 3.0], np.float32))
+        np.testing.assert_allclose(dr.mean.numpy(),
+                                   [1 / 6, 2 / 6, 3 / 6], rtol=1e-5)
+
+    def test_gamma_laplace_exponential(self):
+        paddle.seed(0)
+        g = D.Gamma(3.0, 2.0)
+        np.testing.assert_allclose(float(g.mean), 1.5, rtol=1e-6)
+        assert abs(float(g.sample([20000]).numpy().mean()) - 1.5) < 0.05
+        la = D.Laplace(0.0, 1.0)
+        lp = float(la.log_prob(paddle.to_tensor(0.0)))
+        np.testing.assert_allclose(lp, -np.log(2.0), rtol=1e-5)
+        e = D.Exponential(2.0)
+        np.testing.assert_allclose(float(e.mean), 0.5, rtol=1e-6)
+
+    def test_multinomial(self):
+        paddle.seed(0)
+        m = D.Multinomial(10, np.array([0.5, 0.5], np.float32))
+        s = m.sample().numpy()
+        assert s.sum() == 10
+
+
+class TestFFT:
+    def test_fft_matches_numpy(self):
+        x = RNG.randn(16).astype(np.float32)
+        out = fft.fft(paddle.to_tensor(x))
+        np.testing.assert_allclose(out.numpy(), np.fft.fft(x), rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_rfft_irfft_roundtrip(self):
+        x = RNG.randn(32).astype(np.float32)
+        spec = fft.rfft(paddle.to_tensor(x))
+        back = fft.irfft(spec, n=32)
+        np.testing.assert_allclose(back.numpy(), x, rtol=1e-4, atol=1e-5)
+
+    def test_fft2_and_shift(self):
+        x = RNG.randn(8, 8).astype(np.float32)
+        out = fft.fft2(paddle.to_tensor(x))
+        np.testing.assert_allclose(out.numpy(), np.fft.fft2(x), rtol=1e-3,
+                                   atol=1e-4)
+        sh = fft.fftshift(paddle.to_tensor(x))
+        np.testing.assert_allclose(sh.numpy(), np.fft.fftshift(x))
+
+    def test_fftfreq(self):
+        np.testing.assert_allclose(fft.fftfreq(8, 0.5).numpy(),
+                                   np.fft.fftfreq(8, 0.5))
+
+    def test_fft_grad_flows(self):
+        x = paddle.to_tensor(RNG.randn(16).astype(np.float32))
+        x.stop_gradient = False
+        spec = fft.rfft(x)
+        # |X|^2 energy; real-valued loss of a complex intermediate
+        energy = (spec * spec.conj()).real().sum()
+        energy.backward()
+        assert x.grad is not None
+        assert np.isfinite(x.grad.numpy()).all()
+
+
+class TestSignal:
+    def test_stft_shape_and_content(self):
+        n_fft, hop = 64, 16
+        x = np.sin(2 * np.pi * 8 * np.arange(256) / 64).astype(np.float32)
+        spec = signal.stft(paddle.to_tensor(x), n_fft=n_fft,
+                           hop_length=hop, center=False)
+        n_frames = 1 + (256 - n_fft) // hop
+        assert list(spec.shape) == [n_fft // 2 + 1, n_frames]
+        mag = np.abs(spec.numpy())
+        # the sine at bin 8 dominates every frame
+        assert (mag.argmax(axis=0) == 8).all()
+
+    def test_stft_istft_roundtrip(self):
+        x = RNG.randn(400).astype(np.float32)
+        w = np.hanning(100).astype(np.float32)
+        spec = signal.stft(paddle.to_tensor(x), n_fft=100, hop_length=25,
+                           window=paddle.to_tensor(w), center=True)
+        back = signal.istft(spec, n_fft=100, hop_length=25,
+                            window=paddle.to_tensor(w), center=True,
+                            length=400)
+        np.testing.assert_allclose(back.numpy(), x, rtol=1e-3, atol=1e-3)
+
+    def test_frame_overlap_add_inverse(self):
+        x = RNG.randn(128).astype(np.float32)
+        frames = signal.frame(paddle.to_tensor(x), 32, 32)  # no overlap
+        assert frames.shape == [4, 32]
+        back = signal.overlap_add(frames, 32)
+        np.testing.assert_allclose(back.numpy(), x, rtol=1e-6)
