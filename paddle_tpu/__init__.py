@@ -287,6 +287,7 @@ from . import metric  # noqa: F401
 from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import profiler  # noqa: F401
+from . import quantization  # noqa: F401
 from . import static  # noqa: F401
 from . import utils  # noqa: F401
 from . import vision  # noqa: F401

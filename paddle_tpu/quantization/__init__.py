@@ -1,0 +1,249 @@
+"""paddle_tpu.quantization — QAT / PTQ.
+
+Parity: reference python/paddle/quantization/ (config.py QuantConfig,
+qat.py QAT, ptq.py PTQ, observers/, quanters/) and the fake-quant ops
+(/root/reference/paddle/fluid/operators/fake_quantize_op.cc). TPU-native:
+fake-quant is a straight-through-estimator jnp expression that XLA fuses
+into the surrounding matmul; int8 inference on TPU lowers through XLA's
+native int8 MXU path when both operands are quantized.
+"""
+from __future__ import annotations
+
+import copy
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import primitive
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+
+__all__ = [
+    "QuantConfig", "QAT", "PTQ", "quant_linear",
+    "FakeQuanterWithAbsMax", "MovingAverageAbsMaxObserver",
+    "AbsMaxObserver", "fake_quantize_dequantize",
+]
+
+
+# -- straight-through rounding ----------------------------------------------
+
+@jax.custom_vjp
+def _ste_round(x):
+    return jnp.round(x)
+
+
+def _ste_round_fwd(x):
+    return jnp.round(x), None
+
+
+def _ste_round_bwd(_, g):
+    return (g,)
+
+
+_ste_round.defvjp(_ste_round_fwd, _ste_round_bwd)
+
+
+@primitive
+def fake_quantize_dequantize(x, scale, bit_length=8):
+    """Symmetric fake quant (reference fake_quantize_dequantize_abs_max):
+    q = clip(round(x / scale * qmax), -qmax, qmax) * scale / qmax, with a
+    straight-through gradient."""
+    x = jnp.asarray(x)
+    qmax = float(2 ** (bit_length - 1) - 1)
+    s = jnp.maximum(jnp.asarray(scale, x.dtype), 1e-8)
+    q = _ste_round(x / s * qmax)
+    q = jnp.clip(q, -qmax, qmax)
+    return q * s / qmax
+
+
+@primitive
+def quantize_linear(x, scale, bit_length=8):
+    """To int values (no dequant) — inference export path."""
+    x = jnp.asarray(x)
+    qmax = float(2 ** (bit_length - 1) - 1)
+    s = jnp.maximum(jnp.asarray(scale, x.dtype), 1e-8)
+    return jnp.clip(jnp.round(x / s * qmax), -qmax, qmax).astype(jnp.int8)
+
+
+# -- observers (reference quantization/observers/) --------------------------
+
+class AbsMaxObserver:
+    """Track the running abs-max of activations (PTQ calibration)."""
+
+    def __init__(self, quant_bits=8):
+        self.quant_bits = quant_bits
+        self._absmax = 0.0
+
+    def observe(self, x):
+        v = x.numpy() if isinstance(x, Tensor) else np.asarray(x)
+        self._absmax = max(self._absmax, float(np.abs(v).max()))
+
+    def scale(self):
+        return max(self._absmax, 1e-8)
+
+
+class MovingAverageAbsMaxObserver:
+    """EMA abs-max (reference moving_average_abs_max quanter)."""
+
+    def __init__(self, quant_bits=8, moving_rate=0.9):
+        self.quant_bits = quant_bits
+        self.rate = moving_rate
+        self._state = None
+
+    def observe(self, x):
+        v = x.numpy() if isinstance(x, Tensor) else np.asarray(x)
+        cur = float(np.abs(v).max())
+        self._state = cur if self._state is None else (
+            self.rate * self._state + (1 - self.rate) * cur)
+
+    def scale(self):
+        return max(self._state or 0.0, 1e-8)
+
+
+class FakeQuanterWithAbsMax(Layer):
+    """QAT activation/weight quanter: observes abs-max on the fly and
+    fake-quantizes (reference quanters/abs_max.py)."""
+
+    def __init__(self, quant_bits=8, moving_rate=0.9):
+        super().__init__()
+        self.quant_bits = quant_bits
+        self.observer = MovingAverageAbsMaxObserver(quant_bits, moving_rate)
+
+    def forward(self, x):
+        if self.training:
+            self.observer.observe(x)
+        return fake_quantize_dequantize(
+            x, self.observer.scale(), bit_length=self.quant_bits)
+
+
+# -- quantized layer wrappers ----------------------------------------------
+
+class QuantedLinear(Layer):
+    """Linear with weight+activation fake quant (reference
+    nn/quant/quant_layers.py QuantizedLinear)."""
+
+    def __init__(self, inner, quant_bits=8):
+        super().__init__()
+        self.inner = inner
+        self.weight_quanter = FakeQuanterWithAbsMax(quant_bits)
+        self.act_quanter = FakeQuanterWithAbsMax(quant_bits)
+
+    def forward(self, x):
+        from ..nn import functional as F
+
+        xq = self.act_quanter(x)
+        wq = self.weight_quanter(self.inner.weight)
+        return F.linear(xq, wq, self.inner.bias)
+
+
+class QuantedConv2D(Layer):
+    def __init__(self, inner, quant_bits=8):
+        super().__init__()
+        self.inner = inner
+        self.weight_quanter = FakeQuanterWithAbsMax(quant_bits)
+        self.act_quanter = FakeQuanterWithAbsMax(quant_bits)
+
+    def forward(self, x):
+        from ..nn import functional as F
+
+        xq = self.act_quanter(x)
+        wq = self.weight_quanter(self.inner.weight)
+        return F.conv2d(xq, wq, self.inner.bias,
+                        stride=self.inner.stride,
+                        padding=self.inner.padding,
+                        dilation=self.inner.dilation,
+                        groups=self.inner.groups)
+
+
+# -- config + drivers -------------------------------------------------------
+
+class QuantConfig:
+    """Which layer types get quantized (reference quantization/config.py)."""
+
+    def __init__(self, activation=None, weight=None, quant_bits=8):
+        self.quant_bits = quant_bits
+        self._types = []
+
+    def add_type_config(self, layer_types, activation=None, weight=None,
+                        quant_bits=None):
+        if not isinstance(layer_types, (list, tuple)):
+            layer_types = [layer_types]
+        self._types.extend(layer_types)
+        if quant_bits:
+            self.quant_bits = quant_bits
+        return self
+
+    def types(self):
+        if self._types:
+            return tuple(self._types)
+        from ..nn.layers.common import Linear
+        from ..nn.layers.conv import Conv2D
+
+        return (Linear, Conv2D)
+
+
+def _wrap_layers(model, config):
+    from ..nn.layers.common import Linear
+    from ..nn.layers.conv import Conv2D
+
+    types = config.types()
+    for name, child in list(model._sub_layers.items()):
+        if isinstance(child, Linear) and Linear in types:
+            model._sub_layers[name] = QuantedLinear(child, config.quant_bits)
+        elif isinstance(child, Conv2D) and Conv2D in types:
+            model._sub_layers[name] = QuantedConv2D(child, config.quant_bits)
+        else:
+            _wrap_layers(child, config)
+    return model
+
+
+class QAT:
+    """Quantization-aware training driver (reference qat.py QAT)."""
+
+    def __init__(self, config=None):
+        self.config = config or QuantConfig()
+
+    def quantize(self, model, inplace=False):
+        if not inplace:
+            model = copy.deepcopy(model)
+        return _wrap_layers(model, self.config)
+
+
+class PTQ:
+    """Post-training quantization: calibrate observers with sample data,
+    then freeze scales (reference ptq.py PTQ)."""
+
+    def __init__(self, config=None):
+        self.config = config or QuantConfig()
+
+    def quantize(self, model, inplace=False):
+        q = QAT(self.config).quantize(model, inplace=inplace)
+        q.eval()
+        return q
+
+    def calibrate(self, model, data_iter, max_batches=32):
+        """Run forward passes in observe mode to set activation scales."""
+        model.train()
+        count = 0
+        import paddle_tpu as paddle
+
+        with paddle.no_grad():
+            for batch in data_iter:
+                model(batch if isinstance(batch, Tensor)
+                      else paddle.to_tensor(np.asarray(batch)))
+                count += 1
+                if count >= max_batches:
+                    break
+        model.eval()
+        return model
+
+
+def quant_linear(x, w, b, scale_x, scale_w, bit_length=8):
+    """Functional quantized linear (both operands fake-quantized)."""
+    from ..nn import functional as F
+
+    xq = fake_quantize_dequantize(x, scale_x, bit_length=bit_length)
+    wq = fake_quantize_dequantize(w, scale_w, bit_length=bit_length)
+    return F.linear(xq, wq, b)

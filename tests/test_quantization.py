@@ -1,0 +1,109 @@
+"""Quantization tests (reference test_quant_aware / ptq unittests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.quantization import (
+    PTQ,
+    QAT,
+    AbsMaxObserver,
+    FakeQuanterWithAbsMax,
+    MovingAverageAbsMaxObserver,
+    QuantConfig,
+    QuantedLinear,
+    fake_quantize_dequantize,
+)
+
+RNG = np.random.RandomState(13)
+
+
+class TestFakeQuant:
+    def test_quantize_dequantize_error_bounded(self):
+        x = RNG.randn(64).astype(np.float32)
+        scale = float(np.abs(x).max())
+        out = fake_quantize_dequantize(paddle.to_tensor(x), scale,
+                                       bit_length=8)
+        err = np.abs(out.numpy() - x).max()
+        assert err <= scale / 127 + 1e-6
+
+    def test_values_are_on_grid(self):
+        x = RNG.randn(64).astype(np.float32)
+        scale = float(np.abs(x).max())
+        out = fake_quantize_dequantize(paddle.to_tensor(x), scale).numpy()
+        grid = out / (scale / 127)
+        np.testing.assert_allclose(grid, np.round(grid), atol=1e-4)
+
+    def test_straight_through_gradient(self):
+        x = paddle.to_tensor(RNG.randn(32).astype(np.float32))
+        x.stop_gradient = False
+        out = fake_quantize_dequantize(x, 3.0, bit_length=8)
+        out.sum().backward()
+        # STE: gradient is ~1 everywhere in range
+        np.testing.assert_allclose(x.grad.numpy(), np.ones(32), rtol=1e-5)
+
+
+class TestObservers:
+    def test_absmax(self):
+        ob = AbsMaxObserver()
+        ob.observe(np.array([1.0, -3.0]))
+        ob.observe(np.array([2.0]))
+        assert ob.scale() == 3.0
+
+    def test_ema(self):
+        ob = MovingAverageAbsMaxObserver(moving_rate=0.5)
+        ob.observe(np.array([4.0]))
+        ob.observe(np.array([2.0]))
+        assert ob.scale() == pytest.approx(3.0)
+
+
+class TestQATPTQ:
+    def _net(self):
+        paddle.seed(0)
+        return nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+
+    def test_qat_wraps_linears(self):
+        net = self._net()
+        q = QAT(QuantConfig()).quantize(net)
+        wrapped = [m for m in q.sublayers() if isinstance(m, QuantedLinear)]
+        assert len(wrapped) == 2
+        # original untouched (not inplace)
+        assert not any(isinstance(m, QuantedLinear) for m in net.sublayers())
+
+    def test_qat_model_trains(self):
+        net = self._net()
+        q = QAT().quantize(net, inplace=True)
+        opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                    parameters=q.parameters())
+        x = paddle.to_tensor(RNG.randn(32, 8).astype("float32"))
+        y = paddle.to_tensor(RNG.randn(32, 4).astype("float32"))
+        losses = []
+        for _ in range(15):
+            loss = ((q(x) - y) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+
+    def test_qat_output_close_to_float(self):
+        net = self._net()
+        x = paddle.to_tensor(RNG.randn(16, 8).astype("float32"))
+        ref = net(x).numpy()
+        q = QAT().quantize(net)
+        q.train()
+        out = q(x).numpy()  # first pass observes then quantizes
+        out = q(x).numpy()
+        # int8 fake quant keeps outputs close
+        assert np.abs(out - ref).max() < 0.15 * np.abs(ref).max() + 0.05
+
+    def test_ptq_calibrate(self):
+        net = self._net()
+        ptq = PTQ()
+        q = ptq.quantize(net)
+        data = [RNG.randn(8, 8).astype("float32") for _ in range(4)]
+        ptq.calibrate(q, data)
+        assert not q.training
+        x = paddle.to_tensor(RNG.randn(4, 8).astype("float32"))
+        out = q(x)
+        assert out.shape == [4, 4]
