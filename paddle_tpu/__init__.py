@@ -282,6 +282,7 @@ from . import sparse  # noqa: F401
 from . import framework  # noqa: F401
 from . import hapi  # noqa: F401
 from . import io  # noqa: F401
+from . import inference  # noqa: F401
 from . import jit  # noqa: F401
 from . import metric  # noqa: F401
 from . import nn  # noqa: F401

@@ -76,6 +76,30 @@ class enable_grad:
 # capture layer look ops up by name.
 OPS = {}
 
+# Static-graph recorder hook. When paddle_tpu.static is building a Program
+# (program_guard + enable_static), it installs a callable here; every
+# top-level op execution is then appended to the active Program's tape —
+# the TPU-native ProgramDesc (reference framework.proto:242) is a replayable
+# op tape rather than a protobuf, replayed under jax.jit by the Executor.
+_static_recorder = None
+
+
+def set_static_recorder(fn):
+    global _static_recorder
+    _static_recorder = fn
+
+
+def _in_primitive() -> bool:
+    return getattr(_state, "prim_depth", 0) > 0
+
+
+def _enter_primitive():
+    _state.prim_depth = getattr(_state, "prim_depth", 0) + 1
+
+
+def _exit_primitive():
+    _state.prim_depth -= 1
+
 
 def _unwrap(x):
     return x._value if isinstance(x, Tensor) else x
@@ -116,13 +140,24 @@ def primitive(fn=None, *, name=None, nondiff=False):
                 and tape_enabled()
                 and any(not leaves[i].stop_gradient for i in t_idx)
             )
+            record = _static_recorder is not None and not _in_primitive()
             if not need_grad:
                 plain = [
                     l._value if isinstance(l, Tensor) else l for l in leaves
                 ]
                 a2, k2 = jax.tree_util.tree_unflatten(treedef, plain)
-                out = raw_fn(*a2, **k2)
-                return wrap_output(out, stop_gradient=True)
+                _enter_primitive()
+                try:
+                    out = raw_fn(*a2, **k2)
+                finally:
+                    _exit_primitive()
+                multi = isinstance(out, (tuple, list))
+                wrapped = wrap_output(out, stop_gradient=True)
+                if record:
+                    outs = wrapped if multi else (wrapped,)
+                    _static_recorder(op_name, raw_fn, leaves, treedef,
+                                     outs, multi)
+                return wrapped
 
             in_tensors = [leaves[i] for i in t_idx]
             vals = [t._value for t in in_tensors]
@@ -139,9 +174,16 @@ def primitive(fn=None, *, name=None, nondiff=False):
                     return tuple(out)
                 return (out,)
 
-            out_vals, vjp_fn = jax.vjp(pure, *vals)
+            _enter_primitive()
+            try:
+                out_vals, vjp_fn = jax.vjp(pure, *vals)
+            finally:
+                _exit_primitive()
             node = _autograd.GradNode(op_name, vjp_fn, in_tensors, out_vals)
             outs = _autograd.attach_node(out_vals, node)
+            if record:
+                _static_recorder(op_name, raw_fn, leaves, treedef,
+                                 tuple(outs), is_multi[0])
             return outs if is_multi[0] else outs[0]
 
         # stash for introspection

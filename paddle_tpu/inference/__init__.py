@@ -1,0 +1,138 @@
+"""paddle_tpu.inference — the deployment API.
+
+Reference: AnalysisPredictor + AnalysisConfig
+(/root/reference/paddle/fluid/inference/api/analysis_predictor.cc,
+ paddle_inference_api.h). The reference runs a 99k-LoC pass pipeline (IR
+fusions, TensorRT subgraphs, memory planning) over a loaded ProgramDesc.
+TPU-native: the saved artifact already IS a whole-program StableHLO module
+(static.save_inference_model / jit.save), so the "analysis" stage collapses
+into XLA compilation — fusion, layout, and memory planning are the
+compiler's. The Config/Predictor/Tensor-handle API surface is preserved so
+reference deployment code ports directly.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = [
+    "Config", "create_predictor", "Predictor", "PlaceType",
+]
+
+
+class PlaceType:
+    CPU = "cpu"
+    GPU = "gpu"
+    TPU = "tpu"
+    XPU = "xpu"
+
+
+class Config:
+    """AnalysisConfig analog. Accepts a path prefix (``prefix`` →
+    ``prefix.pdmodel`` + ``prefix.pdmeta``/``.pdiparams``) or explicit
+    model/params files."""
+
+    def __init__(self, prog_file=None, params_file=None):
+        if prog_file is not None and prog_file.endswith(".pdmodel"):
+            prog_file = prog_file[: -len(".pdmodel")]
+        self._prefix = prog_file
+        self._params_file = params_file
+        self._device = None
+        self._memory_pool_mb = None
+        self._ir_optim = True
+
+    # device selection: XLA picks the default backend; these record intent
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._device = PlaceType.GPU
+        self._memory_pool_mb = memory_pool_init_size_mb
+
+    def enable_tpu(self):
+        self._device = PlaceType.TPU
+
+    def disable_gpu(self):
+        self._device = PlaceType.CPU
+
+    def set_cpu_math_library_num_threads(self, n):
+        self._cpu_threads = n
+
+    def switch_ir_optim(self, flag=True):
+        self._ir_optim = flag  # XLA always optimizes; recorded for compat
+
+    def enable_memory_optim(self, flag=True):
+        pass  # XLA buffer assignment
+
+    def model_dir(self):
+        return self._prefix
+
+    def prog_file(self):
+        return (self._prefix or "") + ".pdmodel"
+
+    def params_file(self):
+        return self._params_file or ""
+
+
+class _TensorHandle:
+    """Zero-copy-style IO handle (reference ZeroCopyTensor,
+    inference/api/details/zero_copy_tensor.cc)."""
+
+    def __init__(self, name):
+        self.name = name
+        self._value = None
+
+    def reshape(self, shape):
+        pass  # shape comes from the bound array
+
+    def copy_from_cpu(self, arr):
+        self._value = np.ascontiguousarray(arr)
+
+    def copy_to_cpu(self):
+        return np.asarray(self._value)
+
+    def shape(self):
+        return list(np.asarray(self._value).shape)
+
+
+class Predictor:
+    def __init__(self, config):
+        from ..static import load_inference_model
+
+        self._config = config
+        prog, feeds, fetches = load_inference_model(config._prefix)
+        self._prog = prog
+        self._inputs = {n: _TensorHandle(n) for n in feeds}
+        self._outputs = {n: _TensorHandle(n) for n in fetches}
+        self._feed_names = feeds
+        self._fetch_names = fetches
+
+    def get_input_names(self):
+        return list(self._feed_names)
+
+    def get_output_names(self):
+        return list(self._fetch_names)
+
+    def get_input_handle(self, name):
+        return self._inputs[name]
+
+    def get_output_handle(self, name):
+        return self._outputs[name]
+
+    def run(self, inputs=None):
+        """Run the compiled module. With ``inputs`` (list of arrays in
+        input-name order) returns the outputs directly; otherwise uses the
+        bound IO handles."""
+        if inputs is not None:
+            for n, a in zip(self._feed_names, inputs):
+                self._inputs[n].copy_from_cpu(np.asarray(a))
+        feed_vals = [self._inputs[n]._value for n in self._feed_names]
+        outs = self._prog.run(*feed_vals)
+        for n, o in zip(self._fetch_names, outs):
+            self._outputs[n]._value = np.asarray(o)
+        if inputs is not None:
+            return [np.asarray(o) for o in outs]
+        return True
+
+    def clear_intermediate_tensor(self):
+        pass
+
+
+def create_predictor(config):
+    return Predictor(config)

@@ -36,6 +36,54 @@ class InputSpec:
         return "InputSpec(shape=%s, dtype=%s)" % (self.shape, self.dtype)
 
 
+def export_with_dynamic_dims(pure_fn, specs, leading_args=()):
+    """Serialize ``pure_fn(*leading_args_placeholder, *inputs)`` to portable
+    StableHLO bytes (jax.export), with -1/None dims exported as symbolic
+    dimensions when the traced graph supports them, else concretized to 1.
+
+    ``specs``: [(shape, jax_dtype)] for the trailing (user input) args.
+    ``leading_args``: concrete arrays/pytrees prepended verbatim (e.g. model
+    state), exported with their own concrete shapes."""
+    from jax import export as jex
+
+    lead = [jax.tree_util.tree_map(
+        lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype), a)
+        for a in leading_args]
+
+    def concrete_args():
+        return [jax.ShapeDtypeStruct(
+            tuple(1 if d in (-1, None) else d for d in shape), jdt)
+            for shape, jdt in specs]
+
+    in_args, any_sym = [], False
+    for shape, jdt in specs:
+        dims, syms = [], 0
+        for i, d in enumerate(shape):
+            if d in (-1, None):
+                syms += 1
+                dims.append("b%d" % i)
+            else:
+                dims.append(str(d))
+        if syms:
+            try:
+                in_args.append(jax.ShapeDtypeStruct(
+                    jex.symbolic_shape(",".join(dims)), jdt))
+                any_sym = True
+                continue
+            except Exception:
+                pass
+        in_args.append(jax.ShapeDtypeStruct(
+            tuple(1 if d in (-1, None) else d for d in shape), jdt))
+    try:
+        return jex.export(jax.jit(pure_fn))(*lead, *in_args).serialize()
+    except Exception:
+        if not any_sym:
+            raise
+        # symbolic dims unsupported by some op in the graph → concrete
+        return jex.export(jax.jit(pure_fn))(*lead,
+                                            *concrete_args()).serialize()
+
+
 class StaticFunction:
     """Compiled wrapper around a Layer method or function."""
 
@@ -123,9 +171,14 @@ def to_static(function=None, input_spec=None, build_strategy=None,
 
 
 def save(layer, path, input_spec=None, **config):
-    """jit.save: serialize params + a callable spec. The compiled artifact
-    (StableHLO) is regenerated at load — XLA executables are
-    hardware-keyed, mirroring how the reference regenerates engine plans."""
+    """jit.save: serialize params + the traced program as portable StableHLO
+    (jax.export) — the TPU-native saved-inference format (reference:
+    ProgramDesc `.pdmodel` + `.pdiparams`, python/paddle/jit/api.py jit.save).
+
+    With input_spec, the forward is exported with the state as leading
+    arguments, so jit.load returns a runnable TranslatedLayer on any
+    backend; without it, weights-only (the load must re-bind a model
+    class)."""
     import numpy as np
 
     state = {}
@@ -138,40 +191,79 @@ def save(layer, path, input_spec=None, **config):
             {"shape": s.shape, "dtype": s.dtype} for s in (input_spec or [])
         ],
     }
+    blob = None
+    if input_spec and isinstance(layer, Layer):
+        names, values = layer.functional_state()
+        meta["state_names"] = list(names)
+
+        def pure(state_vals, *in_vals):
+            wrapped = [Tensor(v) for v in in_vals]
+            with layer.bind_state(names, list(state_vals)):
+                with no_grad():
+                    out = layer(*wrapped)
+            return jax.tree_util.tree_map(
+                lambda t: t._value if isinstance(t, Tensor) else t, out,
+                is_leaf=lambda x: isinstance(x, Tensor))
+
+        blob = export_with_dynamic_dims(
+            pure,
+            [(s.shape, _dtype.to_jax(s.dtype)) for s in input_spec],
+            leading_args=(list(values),))
+        meta["format"] = "stablehlo.jax_export.v1"
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     with open(path + ".pdiparams", "wb") as f:
         pickle.dump(state, f, protocol=4)
     with open(path + ".pdmodel", "wb") as f:
-        pickle.dump(meta, f, protocol=4)
+        pickle.dump({"meta": meta, "stablehlo": blob}, f, protocol=4)
 
 
 class TranslatedLayer(Layer):
     """Loaded inference layer (reference python/paddle/jit/translated_layer.py).
-    Holds the state dict; `forward` must be re-bound by the loading model, or
-    used through paddle_tpu.static predictors."""
+    If the artifact carries a StableHLO program, forward runs it directly;
+    otherwise it holds weights only and must be re-bound to a model class."""
 
-    def __init__(self, state, meta):
+    def __init__(self, state, meta, exported=None):
         super().__init__()
         self._loaded_state = state
         self._meta = meta
+        self._exported = exported
+        self._call = jax.jit(exported.call) if exported is not None else None
+        if exported is not None:
+            names = meta.get("state_names") or sorted(state.keys())
+            self._state_vals = [jnp.asarray(state[n]) for n in names]
 
     def state_dict(self, *a, **k):
         return self._loaded_state
 
     def forward(self, *args):
-        raise RuntimeError(
-            "TranslatedLayer from jit.load holds weights only; bind it to a "
-            "model class or use paddle_tpu.static.Predictor")
+        if self._call is None:
+            raise RuntimeError(
+                "this jit.save artifact holds weights only (no input_spec "
+                "at save time); bind it to a model class or re-save with "
+                "input_spec")
+        in_vals = [a._value if isinstance(a, Tensor) else jnp.asarray(a)
+                   for a in args]
+        out = self._call(self._state_vals, *in_vals)
+        return jax.tree_util.tree_map(Tensor, out)
 
 
 def load(path):
     with open(path + ".pdiparams", "rb") as f:
         state = pickle.load(f)
-    meta = {}
+    meta, exported = {}, None
     if os.path.exists(path + ".pdmodel"):
         with open(path + ".pdmodel", "rb") as f:
-            meta = pickle.load(f)
-    return TranslatedLayer(state, meta)
+            payload = pickle.load(f)
+        if isinstance(payload, dict) and "meta" in payload:
+            meta = payload["meta"]
+            blob = payload.get("stablehlo")
+            if blob:
+                from jax import export as jex
+
+                exported = jex.deserialize(blob)
+        else:
+            meta = payload
+    return TranslatedLayer(state, meta, exported)
 
 
 def not_to_static(fn):

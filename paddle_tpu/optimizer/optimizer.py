@@ -64,6 +64,13 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
+        from ..static import _register_minimize
+
+        if _register_minimize(loss, self):
+            # static-graph recording: training compiles into the program's
+            # replayed XLA module (reference: minimize appends backward +
+            # optimizer ops to the ProgramDesc)
+            return None, None
         loss.backward()
         self.step()
         return None, None

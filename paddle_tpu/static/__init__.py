@@ -1,11 +1,25 @@
-"""paddle.static — whole-graph capture & execution.
+"""paddle.static — whole-graph capture & execution, TPU-native.
 
-The reference's static graph is ProgramDesc + Executor/InterpreterCore
-(framework.proto:242, new_executor/). TPU-native: a Program is a traced jax
-function (captured via the same eager ops running under jax.jit tracing);
-the Executor compiles it to ONE XLA module per feed signature — what the
-reference's paddle2cinn bridge aspired to. The guard-style API
-(program_guard, data, Executor.run(feed, fetch_list)) is preserved.
+Reference design: ProgramDesc protobuf + Executor/InterpreterCore
+(/root/reference/paddle/fluid/framework/framework.proto:242,
+ new_executor/standalone_executor.h:32, python/paddle/fluid/executor.py:921).
+
+TPU-native design: a Program is an **op tape** — while `program_guard` is
+active, every eager op executed through the dispatch layer is appended to the
+tape (dispatch.set_static_recorder). `Executor.run(feed, fetch_list)` replays
+the tape as a pure function of the feed values and captured parameters under
+`jax.jit`, producing ONE XLA module per feed signature — what the reference's
+InterpreterCore + paddle2cinn pipeline approximates with per-op dispatch and
+subgraph compilation, done structurally here. `Optimizer.minimize(loss)`
+inside a program records a training spec; the Executor then compiles
+forward+backward+update into a single donated XLA module (the analog of the
+reference's append_backward + optimizer-op insertion, with XLA autodiff
+replacing per-op GradOpMakers).
+
+Known deviations (documented, TPU-semantics): RNG ops replay the captured
+key (seed once per program build); BatchNorm running-stat mutation is not a
+tape op and therefore does not update across replays (use dygraph or
+hapi.Model for stat-accumulating training).
 """
 from __future__ import annotations
 
@@ -13,10 +27,11 @@ import threading
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
+from ..core import dispatch as _dispatch
 from ..core import dtype as _dtype
-from ..core.dispatch import no_grad
-from ..core.tensor import Tensor
+from ..core.tensor import Parameter, Tensor
 from ..jit import InputSpec  # noqa: F401
 
 _state = threading.local()
@@ -28,10 +43,12 @@ def _enabled():
 
 def enable_static():
     _state.static_mode = True
+    _install_recorder()
 
 
 def disable_static():
     _state.static_mode = False
+    _dispatch.set_static_recorder(None)
 
 
 def in_dynamic_mode():
@@ -39,8 +56,8 @@ def in_dynamic_mode():
 
 
 class Variable(Tensor):
-    """Placeholder variable in a Program (reference VarDesc). Holds spec
-    only; values are bound at Executor.run via feed."""
+    """Feed placeholder in a Program (reference VarDesc). Holds a zero value
+    of the spec'd shape at build time; bound to real feeds at Executor.run."""
 
     def __init__(self, name, shape, dtype):
         super().__init__(jnp.zeros([1 if s in (-1, None) else s
@@ -51,27 +68,96 @@ class Variable(Tensor):
         self.is_data = True
 
 
+class _OpRecord:
+    __slots__ = ("op_name", "raw_fn", "leaves", "treedef", "outs", "multi")
+
+    def __init__(self, op_name, raw_fn, leaves, treedef, outs, multi):
+        self.op_name = op_name
+        self.raw_fn = raw_fn
+        self.leaves = leaves      # mixed list; Tensor refs read live at replay
+        self.treedef = treedef
+        self.outs = outs          # tuple[Tensor]
+        self.multi = multi
+
+
 class Program:
-    """Captured computation (reference ProgramDesc). Records feed vars,
-    fetch construction function, and the python builder executed under
-    program_guard."""
+    """Captured computation (reference ProgramDesc)."""
 
     def __init__(self):
         self.feed_vars = {}
-        self.ops = []  # (fn, args, kwargs, out) trace, for introspection
-        self._builders = []
+        self.tape = []            # list[_OpRecord]
+        self.version = 0
+        self._train_spec = None   # (loss Tensor, Optimizer)
+        self._grad_map = {}       # id(param) -> grad placeholder Tensor
+        self._opt_state = None
+        self._run_cache = {}
+        self._analyze_cache = None  # (version, params, frozen)
 
+    # -- introspection (reference Program API) ---------------------------
     def global_block(self):
         return self
 
+    @property
+    def ops(self):
+        return self.tape
+
     def clone(self, for_test=False):
-        return self
+        p = Program.__new__(Program)
+        p.__dict__.update(self.__dict__)
+        p.tape = list(self.tape)
+        p.feed_vars = dict(self.feed_vars)
+        p._grad_map = dict(self._grad_map)
+        p._run_cache = {}
+        p._analyze_cache = None
+        if for_test:
+            p._train_spec = None
+        return p
 
     def var(self, name):
         return self.feed_vars.get(name)
 
     def list_vars(self):
         return list(self.feed_vars.values())
+
+    def _bump(self):
+        self.version += 1
+        self._run_cache.clear()
+
+    # -- tape analysis ---------------------------------------------------
+    def _analyze(self):
+        cached = self._analyze_cache
+        if cached is not None and cached[0] == self.version:
+            return cached[1], cached[2]
+        params, frozen = self._analyze_impl()
+        self._analyze_cache = (self.version, params, frozen)
+        return params, frozen
+
+    def _analyze_impl(self):
+        produced = set()
+        for rec in self.tape:
+            for t in rec.outs:
+                produced.add(id(t))
+        feed_ids = {id(v) for v in self.feed_vars.values()}
+        captured, seen = [], set()
+        for rec in self.tape:
+            for l in rec.leaves:
+                if isinstance(l, Tensor) and id(l) not in produced \
+                        and id(l) not in feed_ids and id(l) not in seen:
+                    seen.add(id(l))
+                    captured.append(l)
+        ts = self._train_spec
+        opt_params = None
+        if ts is not None and ts[1] is not None:
+            try:
+                opt_params = {id(p) for p in ts[1]._get_params()}
+            except ValueError:
+                pass  # static-graph optimizers may omit the parameter list
+        params = [t for t in captured
+                  if isinstance(t, Parameter) or not t.stop_gradient]
+        if opt_params is not None:
+            params = [t for t in params if id(t) in opt_params] or params
+        frozen = [t for t in captured if not any(t is p for p in params)]
+        return params, frozen
 
 
 _default_main = Program()
@@ -86,6 +172,24 @@ def default_startup_program():
     return getattr(_state, "startup_program", _default_startup)
 
 
+def _recording_program():
+    if not _enabled():
+        return None
+    return getattr(_state, "main_program", None) or _default_main
+
+
+def _record(op_name, raw_fn, leaves, treedef, outs, multi):
+    prog = _recording_program()
+    if prog is None:
+        return
+    prog.tape.append(_OpRecord(op_name, raw_fn, leaves, treedef, outs, multi))
+    prog._bump()
+
+
+def _install_recorder():
+    _dispatch.set_static_recorder(_record)
+
+
 class program_guard:
     def __init__(self, main_program, startup_program=None):
         self.main = main_program
@@ -96,6 +200,8 @@ class program_guard:
                       getattr(_state, "startup_program", None))
         _state.main_program = self.main
         _state.startup_program = self.startup or _default_startup
+        if _enabled():
+            _install_recorder()
         return self
 
     def __exit__(self, *a):
@@ -109,42 +215,241 @@ def data(name, shape, dtype="float32", lod_level=0):
     return v
 
 
+def _register_minimize(loss, optimizer):
+    """Called by Optimizer.minimize under static recording: record the
+    training spec instead of running eager backward (reference: optimizer
+    ops appended to the ProgramDesc by minimize)."""
+    prog = _recording_program()
+    if prog is None:
+        return False
+    prog._train_spec = (loss, optimizer)
+    prog._bump()
+    return True
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None):
+    """Record gradient computation; returns [(param, grad_placeholder)].
+
+    Reference python/paddle/fluid/backward.py:1729. Gradients are computed by
+    XLA autodiff over the replayed tape at Executor.run; the placeholders
+    returned here are fetchable."""
+    prog = _recording_program() or default_main_program()
+    params, _ = prog._analyze()
+    if parameter_list:
+        params = list(parameter_list)
+    out = []
+    for p in params:
+        g = Tensor(jnp.zeros_like(p._value))
+        g.name = (getattr(p, "name", None) or "param") + "@GRAD"
+        prog._grad_map[id(p)] = g
+        out.append((p, g))
+    if prog._train_spec is None:
+        prog._train_spec = (loss, None)
+    prog._bump()
+    return out
+
+
+class _ReplayContext:
+    """Snapshot/restore of every Tensor the tape touches, so replaying under
+    a jax trace (mutating ._value to tracers) leaves build-time state
+    intact."""
+
+    def __init__(self, program, extra=()):
+        tensors = {}
+        for rec in program.tape:
+            for l in rec.leaves:
+                if isinstance(l, Tensor):
+                    tensors[id(l)] = l
+            for t in rec.outs:
+                tensors[id(t)] = t
+        for v in program.feed_vars.values():
+            tensors[id(v)] = v
+        for t in extra:
+            tensors[id(t)] = t
+        for g in program._grad_map.values():
+            tensors[id(g)] = g
+        self.tensors = list(tensors.values())
+
+    def __enter__(self):
+        self._saved = [t._value for t in self.tensors]
+        return self
+
+    def __exit__(self, *a):
+        for t, v in zip(self.tensors, self._saved):
+            t._value = v
+        return False
+
+
+def _run_tape(program):
+    _dispatch._enter_primitive()
+    try:
+        for rec in program.tape:
+            plain = [l._value if isinstance(l, Tensor) else l
+                     for l in rec.leaves]
+            a2, k2 = jax.tree_util.tree_unflatten(rec.treedef, plain)
+            out = rec.raw_fn(*a2, **k2)
+            outs = out if isinstance(out, (tuple, list)) else (out,)
+            for t, v in zip(rec.outs, outs):
+                t._value = v
+    finally:
+        _dispatch._exit_primitive()
+
+
+def _fetch_tensor(program, f):
+    if isinstance(f, Tensor):
+        return f
+    t = program.var(str(f))
+    if t is None:
+        raise KeyError("fetch target %r not found in program" % (f,))
+    return t
+
+
 class Executor:
-    """reference python/paddle/fluid/executor.py:921. run() re-executes the
-    program builder with fed values, jit-compiling per feed signature."""
+    """reference python/paddle/fluid/executor.py:921 + StandaloneExecutor.
+
+    run() replays the program tape under jax.jit — one compiled XLA module
+    per (program version, feed signature, fetch set); training programs
+    compile forward+grad+update into one donated module."""
 
     def __init__(self, place=None):
         self.place = place
-        self._cache = {}
 
     def run(self, program=None, feed=None, fetch_list=None,
             return_numpy=True):
-        program = program or default_main_program()
+        if isinstance(program, InferenceProgram):
+            feed = feed or {}
+            outs = program.run(*[feed[n] for n in program.feed_names])
+            return [np.asarray(o) for o in outs] if return_numpy \
+                else [Tensor(o) for o in outs]
+        program = program if isinstance(program, Program) else (
+            getattr(program, "program", None) or default_main_program())
         feed = feed or {}
-        fetch_list = fetch_list or []
-        # bind feeds into the program's feed vars
-        for name, value in feed.items():
-            var = program.feed_vars.get(name)
-            if var is not None:
-                import numpy as np
+        fetch_list = list(fetch_list or [])
+        if not program.tape and not program.feed_vars:
+            return []  # startup program: params initialize eagerly
+        missing = sorted(program.feed_vars.keys() - feed.keys())
+        unknown = sorted(feed.keys() - program.feed_vars.keys())
+        if missing:
+            raise ValueError(
+                "Executor.run: program feed vars %s were not fed "
+                "(got feeds %s)" % (missing, sorted(feed.keys())))
+        if unknown:
+            raise ValueError(
+                "Executor.run: feed keys %s match no program feed var "
+                "(program has %s)" % (
+                    unknown, sorted(program.feed_vars.keys())))
+        feed_names = sorted(program.feed_vars.keys())
+        feed_tensors = [program.feed_vars[n] for n in feed_names]
+        feed_vals = [jnp.asarray(np.asarray(feed[n])) for n in feed_names]
+        fetch_tensors = [_fetch_tensor(program, f) for f in fetch_list]
+        params, frozen = program._analyze()
 
-                arr = np.asarray(value)
-                var._value = jnp.asarray(arr)
-        outs = []
-        for f in fetch_list:
-            t = f if isinstance(f, Tensor) else program.var(str(f))
-            if isinstance(t, _DeferredFetch):
-                t = t.evaluate()
-            outs.append(t.numpy() if return_numpy else t)
-        return outs
+        key = (program.version, tuple(feed_names),
+               tuple((v.shape, str(v.dtype)) for v in feed_vals),
+               tuple(id(t) for t in fetch_tensors))
+        entry = program._run_cache.get(key)
+        if entry is None:
+            entry = self._compile(program, feed_tensors, fetch_tensors,
+                                  params, frozen)
+            program._run_cache[key] = entry
+        outs = entry(program, feed_vals, params, frozen)
+        if return_numpy:
+            return [np.asarray(o) for o in outs]
+        return [Tensor(o) for o in outs]
 
+    # -----------------------------------------------------------------
+    def _compile(self, program, feed_tensors, fetch_tensors, params, frozen):
+        train = program._train_spec is not None
+        grad_ids = list(program._grad_map.keys())
 
-class _DeferredFetch:
-    def __init__(self, fn):
-        self.fn = fn
+        if not train:
+            def pure(feed_vals, param_vals, frozen_vals):
+                with _ReplayContext(program, params + frozen):
+                    for t, v in zip(feed_tensors, feed_vals):
+                        t._value = v
+                    for t, v in zip(params, param_vals):
+                        t._value = v
+                    for t, v in zip(frozen, frozen_vals):
+                        t._value = v
+                    _run_tape(program)
+                    return [t._value for t in fetch_tensors]
 
-    def evaluate(self):
-        return self.fn()
+            jitted = jax.jit(pure)
+
+            def runner(prog, feed_vals, params, frozen):
+                return jitted(feed_vals, [p._value for p in params],
+                              [f._value for f in frozen])
+
+            return runner
+
+        loss_t, opt = program._train_spec
+        has_update = opt is not None
+
+        def pure(feed_vals, param_vals, frozen_vals, opt_state, lr, step):
+            def loss_of(pvals):
+                with _ReplayContext(program, params + frozen):
+                    for t, v in zip(feed_tensors, feed_vals):
+                        t._value = v
+                    for t, v in zip(params, pvals):
+                        t._value = v
+                    for t, v in zip(frozen, frozen_vals):
+                        t._value = v
+                    _run_tape(program)
+                    loss_val = loss_t._value
+                    aux = [t._value for t in fetch_tensors]
+                return jnp.sum(loss_val), aux
+
+            (loss_v, fetches), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(param_vals)
+            # grad placeholders fetched by id
+            grad_of = {pid: g for pid, g in zip(
+                [id(p) for p in params], grads)}
+            out_fetches = []
+            for t, fv in zip(fetch_tensors, fetches):
+                hit = None
+                for pid, gt in program._grad_map.items():
+                    if gt is t:
+                        hit = grad_of.get(pid)
+                        break
+                out_fetches.append(fv if hit is None else hit)
+            if not has_update:
+                return out_fetches, param_vals, opt_state
+            names = [str(i) for i in range(len(params))]
+            pdict = dict(zip(names, param_vals))
+            gdict = dict(zip(names, grads))
+            sdict = dict(zip(names, opt_state))
+            new_p, new_s = opt.functional_apply(pdict, gdict, sdict,
+                                                lr=lr, step=step)
+            return (out_fetches, [new_p[n] for n in names],
+                    [new_s[n] for n in names])
+
+        jitted = jax.jit(pure, donate_argnums=(1, 3))
+
+        def runner(prog, feed_vals, params, frozen):
+            if prog._opt_state is None:
+                if has_update:
+                    prog._opt_state = [
+                        [opt._init_slot(s, p) for s in opt._slots()]
+                        for p in params]
+                else:
+                    prog._opt_state = [[] for _ in params]
+            lr = jnp.asarray(opt.get_lr() if has_update else 0.0,
+                             jnp.float32)
+            # eager Optimizer.step increments the global step before the
+            # update (Adam bias correction needs step >= 1)
+            step = jnp.asarray(
+                opt._global_step + 1 if has_update else 1, jnp.int32)
+            outs, new_p, new_s = jitted(
+                feed_vals, [p._value for p in params],
+                [f._value for f in frozen], prog._opt_state, lr, step)
+            for p, v in zip(params, new_p):
+                p._value = v
+            prog._opt_state = new_s
+            if has_update:
+                opt._global_step += 1  # LR schedulers are stepped by user
+            return outs
+
+        return runner
 
 
 class CompiledProgram:
@@ -165,28 +470,6 @@ class ExecutionStrategy:
         self.num_iteration_per_drop_scope = 10
 
 
-def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
-                         program=None):
-    from ..jit import save as jit_save
-
-    class _Holder:
-        pass
-
-    # persist fetch tensors' current params via the program's structure
-    from ..framework.io import save as fsave
-
-    fsave({"feed": [v.name for v in feed_vars],
-           "fetch": [getattr(v, "name", str(i))
-                     for i, v in enumerate(fetch_vars)]},
-          path_prefix + ".pdmodel.meta")
-
-
-def load_inference_model(path_prefix, executor):
-    raise NotImplementedError(
-        "static inference model loading lands with the predictor "
-        "(paddle_tpu.inference)")
-
-
 def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
     from ..core.autograd import grad as _grad
 
@@ -194,16 +477,241 @@ def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
                  allow_unused=True)
 
 
+# ---------------------------------------------------------------------------
+# Inference model serialization: StableHLO via jax.export — the portable
+# program format (the analog of the reference's saved ProgramDesc+params,
+# static/io.py save_inference_model).
+# ---------------------------------------------------------------------------
+
+def _export_program(program, feed_tensors, fetch_tensors):
+    from ..jit import export_with_dynamic_dims
+
+    params, frozen = program._analyze()
+    const_p = [p._value for p in params]
+    const_f = [f._value for f in frozen]
+
+    def pure(*feed_vals):
+        with _ReplayContext(program, params + frozen):
+            for t, v in zip(feed_tensors, feed_vals):
+                t._value = v
+            for t, v in zip(params, const_p):
+                t._value = v
+            for t, v in zip(frozen, const_f):
+                t._value = v
+            _run_tape(program)
+            return [t._value for t in fetch_tensors]
+
+    specs = [(getattr(v, "spec_shape", list(v.shape)), v._value.dtype)
+             for v in feed_tensors]
+    return export_with_dynamic_dims(pure, specs)
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
+                         program=None, **kwargs):
+    """Freeze params into a serialized StableHLO module + meta."""
+    import os
+    import pickle
+
+    program = program or default_main_program()
+    feed_vars = list(feed_vars)
+    fetch_vars = [_fetch_tensor(program, f) for f in fetch_vars]
+    blob = _export_program(program, feed_vars, fetch_vars)
+    meta = {
+        "feed": [v.name for v in feed_vars],
+        "fetch": [getattr(v, "name", "fetch%d" % i)
+                  for i, v in enumerate(fetch_vars)],
+        "format": "stablehlo.jax_export.v1",
+    }
+    os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        f.write(blob)
+    with open(path_prefix + ".pdmeta", "wb") as f:
+        pickle.dump(meta, f, protocol=4)
+
+
+class InferenceProgram:
+    """Loaded frozen program: a deserialized StableHLO executable."""
+
+    def __init__(self, exported, meta):
+        self._exported = exported
+        self.meta = meta
+        self._call = jax.jit(exported.call)
+
+    @property
+    def feed_names(self):
+        return list(self.meta["feed"])
+
+    @property
+    def fetch_names(self):
+        return list(self.meta["fetch"])
+
+    def run(self, *feed_vals):
+        return self._call(*[jnp.asarray(np.asarray(v)) for v in feed_vals])
+
+
+def load_inference_model(path_prefix, executor=None):
+    import pickle
+
+    from jax import export as jex
+
+    with open(path_prefix + ".pdmodel", "rb") as f:
+        blob = f.read()
+    with open(path_prefix + ".pdmeta", "rb") as f:
+        meta = pickle.load(f)
+    prog = InferenceProgram(jex.deserialize(blob), meta)
+    return prog, prog.feed_names, prog.fetch_names
+
+
+# ---------------------------------------------------------------------------
+# paddle.static.nn — graph builders + control flow over the shared eager ops.
+# Control flow lowers to lax.cond / lax.while_loop (reference: block-attr
+# ops in controlflow/, framework.proto attr type BLOCK).
+# ---------------------------------------------------------------------------
+from ..core.dispatch import primitive as _primitive  # noqa: E402
+from ..core.dispatch import no_grad as _no_grad  # noqa: E402
+
+
+def _wrap_all(vals):
+    return [Tensor(v) for v in vals]
+
+
+def _unwrap_all(out):
+    if isinstance(out, Tensor):
+        return [out._value], True
+    seq = list(out) if isinstance(out, (tuple, list)) else [out]
+    return [o._value if isinstance(o, Tensor) else jnp.asarray(o)
+            for o in seq], False
+
+
+@_primitive(name="while_loop", nondiff=True)
+def _while_raw(loop_vars, cond=None, body=None):
+    def c(vs):
+        with _no_grad():
+            r = cond(*_wrap_all(vs))
+        return r._value.reshape(()) if isinstance(r, Tensor) else r
+
+    def b(vs):
+        with _no_grad():
+            out = body(*_wrap_all(vs))
+        flat, _ = _unwrap_all(out)
+        return tuple(flat)
+
+    return tuple(jax.lax.while_loop(c, b, tuple(loop_vars)))
+
+
+@_primitive(name="cond")
+def _cond_raw(operands, pred=None, true_fn=None, false_fn=None):
+    def t(ops):
+        with _no_grad():
+            out = true_fn(*_wrap_all(ops)) if ops else true_fn()
+        flat, _ = _unwrap_all(out)
+        return tuple(flat)
+
+    def f(ops):
+        with _no_grad():
+            out = false_fn(*_wrap_all(ops)) if ops else false_fn()
+        flat, _ = _unwrap_all(out)
+        return tuple(flat)
+
+    p = pred.reshape(()) if hasattr(pred, "reshape") else pred
+    return jax.lax.cond(p, t, f, tuple(operands))
+
+
+def while_loop(cond, body, loop_vars, is_test=False, name=None):
+    """reference paddle.static.nn.while_loop (controlflow/while_op).
+    loop_vars must be explicit (same contract as the reference)."""
+    out = _while_raw(list(loop_vars), cond=cond, body=body)
+    return list(out) if isinstance(out, tuple) else [out]
+
+
+def cond(pred, true_fn=None, false_fn=None, operands=None, name=None):
+    """reference paddle.static.nn.cond (controlflow/conditional_block_op).
+    Branches that close over tensors should take them via `operands`."""
+    if isinstance(pred, Tensor):
+        # under static recording the pred may depend on feeds at replay
+        # time, so record the lax.cond op with the live Tensor; eagerly, a
+        # concrete pred picks the branch in Python.
+        if _recording_program() is None and not _is_traced(pred._value):
+            pred = bool(np.asarray(pred._value))
+    if isinstance(pred, bool):
+        out = true_fn(*(operands or [])) if pred else \
+            false_fn(*(operands or []))
+        return out
+    out = _cond_raw(list(operands or []), pred=pred,
+                    true_fn=true_fn, false_fn=false_fn)
+    if isinstance(out, tuple) and len(out) == 1:
+        return out[0]
+    return out
+
+
+def _is_traced(v):
+    return isinstance(v, jax.core.Tracer)
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    idx = int(np.asarray(branch_index._value)) if isinstance(
+        branch_index, Tensor) and not _is_traced(branch_index._value) \
+        else branch_index
+    fns = dict(branch_fns) if isinstance(branch_fns, (list, tuple)) and \
+        branch_fns and isinstance(branch_fns[0], (list, tuple)) else branch_fns
+    if isinstance(fns, dict) and isinstance(idx, int):
+        fn = fns.get(idx, default)
+        if fn is None:
+            # reference semantics: no default → the max-key branch
+            fn = fns[max(fns.keys())]
+        return fn()
+    raise NotImplementedError("traced switch_case requires int branch index")
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    for pred, fn in pred_fn_pairs:
+        p = bool(np.asarray(pred._value)) if isinstance(pred, Tensor) \
+            else bool(pred)
+        if p:
+            return fn()
+    if default is not None:
+        return default()
+    raise ValueError("no branch taken and no default in static.nn.case")
+
+
 class nn:
     """paddle.static.nn subset: functional builders over the shared ops."""
+
+    cond = staticmethod(cond)
+    while_loop = staticmethod(while_loop)
+    switch_case = staticmethod(switch_case)
+    case = staticmethod(case)
 
     @staticmethod
     def fc(x, size, num_flatten_dims=1, activation=None, name=None):
         import paddle_tpu as P
 
-        flat = P.reshape(x, [x.shape[0], -1]) if num_flatten_dims == 1 else x
-        w = P.create_parameter([flat.shape[-1], size])
-        out = P.matmul(flat, w)
+        flat = P.flatten(x, start_axis=num_flatten_dims) \
+            if len(x.shape) > num_flatten_dims + 1 else x
+        in_dim = 1
+        for s in x.shape[num_flatten_dims:]:
+            in_dim *= s
+        w = P.create_parameter([in_dim, size])
+        b = P.create_parameter([size])
+        out = P.add(P.matmul(flat, w), b)
         if activation:
             out = getattr(P.nn.functional, activation)(out)
+        return out
+
+    @staticmethod
+    def embedding(input, size, param_attr=None, dtype="float32", name=None):
+        import paddle_tpu as P
+
+        w = P.create_parameter(list(size), dtype=dtype)
+        return P.nn.functional.embedding(input, w)
+
+    @staticmethod
+    def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5, **kwargs):
+        import paddle_tpu as P
+
+        bn = P.nn.BatchNorm2D(input.shape[1], momentum=momentum,
+                              epsilon=epsilon)
+        out = bn(input)
+        if act:
+            out = getattr(P.nn.functional, act)(out)
         return out

@@ -1,0 +1,199 @@
+"""Static graph: Program capture/replay, compiled training, control flow,
+inference save/load, predictor.
+
+Reference test model: python/paddle/fluid/tests/unittests/ static-graph
+tests (e.g. test_executor_and_use_program_cache, test_cond, test_while_loop,
+test_inference_model_io).
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+
+
+@pytest.fixture(autouse=True)
+def _static_mode():
+    static.enable_static()
+    yield
+    static.disable_static()
+
+
+def _build_mlp():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [-1, 4], "float32")
+        y = static.data("y", [-1, 1], "float32")
+        h = static.nn.fc(x, 8, activation="relu")
+        pred = static.nn.fc(h, 1)
+        loss = paddle.mean(paddle.square(pred - y))
+    return main, startup, x, y, pred, loss
+
+
+def _xy(n=16, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, 4).astype("float32")
+    W = rng.randn(4, 1).astype("float32")
+    return X, X @ W
+
+
+class TestExecutor:
+    def test_forward_replay_matches_feed(self):
+        main, startup, x, y, pred, loss = _build_mlp()
+        exe = static.Executor()
+        exe.run(startup)
+        X, Y = _xy()
+        out1 = exe.run(main, feed={"x": X, "y": Y}, fetch_list=[pred])
+        out2 = exe.run(main, feed={"x": X * 2, "y": Y}, fetch_list=[pred])
+        assert out1[0].shape == (16, 1)
+        assert not np.allclose(out1[0], out2[0])
+
+    def test_dynamic_batch(self):
+        main, startup, x, y, pred, loss = _build_mlp()
+        exe = static.Executor()
+        X, Y = _xy(16)
+        o16 = exe.run(main, feed={"x": X, "y": Y}, fetch_list=[pred])
+        X4, Y4 = _xy(4)
+        o4 = exe.run(main, feed={"x": X4, "y": Y4}, fetch_list=[pred])
+        assert o16[0].shape == (16, 1) and o4[0].shape == (4, 1)
+
+    def test_minimize_trains(self):
+        paddle.seed(0)
+        main, startup, x, y, pred, loss = _build_mlp()
+        with static.program_guard(main, startup):
+            opt = paddle.optimizer.SGD(learning_rate=0.1)
+            opt.minimize(loss)
+        exe = static.Executor()
+        exe.run(startup)
+        X, Y = _xy()
+        losses = [float(exe.run(main, feed={"x": X, "y": Y},
+                                fetch_list=[loss])[0])
+                  for _ in range(25)]
+        assert losses[-1] < losses[0] * 0.2, losses
+
+    def test_adam_minimize(self):
+        paddle.seed(0)
+        main, startup, x, y, pred, loss = _build_mlp()
+        with static.program_guard(main, startup):
+            opt = paddle.optimizer.Adam(learning_rate=0.05)
+            opt.minimize(loss)
+        exe = static.Executor()
+        X, Y = _xy()
+        losses = [float(exe.run(main, feed={"x": X, "y": Y},
+                                fetch_list=[loss])[0])
+                  for _ in range(30)]
+        assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+    def test_append_backward_grads_fetchable(self):
+        main, startup, x, y, pred, loss = _build_mlp()
+        with static.program_guard(main, startup):
+            pgs = static.append_backward(loss)
+        exe = static.Executor()
+        X, Y = _xy()
+        g = exe.run(main, feed={"x": X, "y": Y},
+                    fetch_list=[loss, pgs[0][1]])
+        assert g[1].shape == tuple(pgs[0][0].shape)
+        assert np.abs(g[1]).sum() > 0
+
+
+class TestControlFlow:
+    def test_cond_feed_dependent(self):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [2, 2], "float32")
+            flag = static.data("flag", [1], "bool")
+            out = static.nn.cond(
+                paddle.all(flag),
+                lambda t: t * 2, lambda t: t - 1, operands=[x])
+        exe = static.Executor()
+        X = np.ones((2, 2), np.float32)
+        t = exe.run(main, feed={"x": X, "flag": np.array([True])},
+                    fetch_list=[out])
+        f = exe.run(main, feed={"x": X, "flag": np.array([False])},
+                    fetch_list=[out])
+        np.testing.assert_allclose(t[0], X * 2)
+        np.testing.assert_allclose(f[0], X - 1)
+
+    def test_while_loop(self):
+        main = static.Program()
+        with static.program_guard(main):
+            n = static.data("n", [1], "int32")
+            i = paddle.zeros([1], "int32")
+            s = paddle.zeros([1], "int32")
+            i2, s2, _ = static.nn.while_loop(
+                lambda i, s, n: paddle.all(i < n),
+                lambda i, s, n: [i + 1, s + i, n],
+                [i, s, n])
+        exe = static.Executor()
+        out = exe.run(main, feed={"n": np.array([5], np.int32)},
+                      fetch_list=[s2])
+        assert int(out[0][0]) == 10  # 0+1+2+3+4
+
+    def test_cond_eager_concrete(self):
+        static.disable_static()
+        r = static.nn.cond(paddle.to_tensor(True),
+                           lambda: paddle.ones([2]),
+                           lambda: paddle.zeros([2]))
+        np.testing.assert_allclose(r.numpy(), np.ones(2))
+        static.enable_static()
+
+
+class TestInference:
+    def test_save_load_inference_model(self, tmp_path):
+        main, startup, x, y, pred, loss = _build_mlp()
+        exe = static.Executor()
+        X, Y = _xy()
+        ref = exe.run(main, feed={"x": X, "y": Y}, fetch_list=[pred])
+        prefix = os.path.join(str(tmp_path), "model")
+        static.save_inference_model(prefix, [x], [pred], exe, program=main)
+        prog, feeds, fetches = static.load_inference_model(prefix)
+        assert feeds == ["x"]
+        out = exe.run(prog, feed={"x": X})
+        np.testing.assert_allclose(out[0], ref[0], rtol=1e-5, atol=1e-5)
+
+    def test_predictor(self, tmp_path):
+        main, startup, x, y, pred, loss = _build_mlp()
+        exe = static.Executor()
+        X, Y = _xy()
+        ref = exe.run(main, feed={"x": X, "y": Y}, fetch_list=[pred])
+        prefix = os.path.join(str(tmp_path), "model")
+        static.save_inference_model(prefix, [x], [pred], exe, program=main)
+
+        config = paddle.inference.Config(prefix)
+        predictor = paddle.inference.create_predictor(config)
+        assert predictor.get_input_names() == ["x"]
+        h = predictor.get_input_handle("x")
+        h.copy_from_cpu(X)
+        predictor.run()
+        out = predictor.get_output_handle(
+            predictor.get_output_names()[0]).copy_to_cpu()
+        np.testing.assert_allclose(out, ref[0], rtol=1e-5, atol=1e-5)
+
+
+class TestJitSaveLoad:
+    def test_jit_save_load_runnable(self, tmp_path):
+        static.disable_static()
+        paddle.seed(0)
+
+        class Net(paddle.nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc1 = paddle.nn.Linear(4, 8)
+                self.fc2 = paddle.nn.Linear(8, 2)
+
+            def forward(self, x):
+                return self.fc2(paddle.nn.functional.relu(self.fc1(x)))
+
+        net = Net()
+        net.eval()
+        X = np.random.RandomState(0).randn(3, 4).astype("float32")
+        ref = net(paddle.to_tensor(X)).numpy()
+        path = os.path.join(str(tmp_path), "net")
+        paddle.jit.save(net, path,
+                        input_spec=[paddle.static.InputSpec([-1, 4])])
+        loaded = paddle.jit.load(path)
+        out = loaded(paddle.to_tensor(X)).numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+        static.enable_static()
