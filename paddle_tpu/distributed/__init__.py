@@ -1,5 +1,15 @@
 """paddle.distributed namespace."""
-from . import auto_parallel, collective, env, fleet, mesh, topology  # noqa: F401
+from . import (  # noqa: F401
+    auto_parallel,
+    collective,
+    elastic,
+    env,
+    fleet,
+    launch,
+    mesh,
+    rpc,
+    topology,
+)
 from .auto_parallel import ProcessMesh, shard_op, shard_tensor  # noqa: F401
 from .collective import (  # noqa: F401
     Group,
@@ -27,11 +37,48 @@ from .env import (  # noqa: F401
 from .topology import CommunicateTopology, HybridCommunicateGroup  # noqa: F401
 
 
-def spawn(func, args=(), nprocs=-1, **kwargs):
-    """reference paddle.distributed.spawn. Single-controller SPMD does not
-    fork per device — run func once; multi-host launch uses
-    `python -m paddle_tpu.distributed.launch`."""
-    return func(*args)
+def spawn(func, args=(), nprocs=-1, join=True, **kwargs):
+    """reference paddle.distributed.spawn (distributed/spawn.py): fork
+    nprocs worker processes on this node, each with rank env set, and run
+    `func(*args)` in each. On real TPU the single-controller SPMD model
+    owns all local chips from one process, so nprocs defaults to 1 there;
+    multi-proc spawn is the CPU-simulation/test path (children are forced
+    onto the CPU platform so they never contend for the chip tunnel)."""
+    import multiprocessing as mp
+
+    if nprocs in (-1, None):
+        nprocs = 1
+    if nprocs < 1:
+        raise ValueError("spawn: nprocs must be >= 1, got %r" % nprocs)
+    if nprocs == 1:
+        func(*args)
+        return None
+    ctx = mp.get_context("spawn")
+    procs = []
+    for rank in range(nprocs):
+        p = ctx.Process(target=_spawn_worker,
+                        args=(func, args, rank, nprocs))
+        p.start()
+        procs.append(p)
+    if not join:
+        return procs
+    for p in procs:
+        p.join()
+    bad = [(i, p.exitcode) for i, p in enumerate(procs) if p.exitcode != 0]
+    if bad:
+        raise RuntimeError("spawn: worker(s) failed: %s" % bad)
+    return None
+
+
+def _spawn_worker(func, args, rank, nprocs):
+    # spawn children inherit the parent environment; only rank vars differ
+    import os
+
+    os.environ["PADDLE_TRAINER_ID"] = str(rank)
+    os.environ["PADDLE_LOCAL_RANK"] = str(rank)
+    os.environ["PADDLE_TRAINERS_NUM"] = str(nprocs)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    func(*args)
 
 
 def ParallelMode():

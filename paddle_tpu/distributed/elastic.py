@@ -1,0 +1,115 @@
+"""Elastic training manager — fault tolerance via store-backed membership.
+
+Parity: reference ElasticManager (fleet/elastic/manager.py:126): etcd node
+registry with TTL heartbeats, membership watch, endpoint rebuild, restart
+via exit codes 101/102; fault tolerance levels from
+PADDLE_ELASTIC_FAULT_TOLERANC_LEVEL. TPU-native: the registry is our C++
+TCPStore (csrc/store.cc) instead of etcd — each node writes
+<job>/beat/<rank> = monotonic timestamp on a heartbeat thread; a watcher
+declares a node dead when its beat is older than `ttl`.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from .store import TCPStore
+
+ELASTIC_EXIT_RESTART = 101
+ELASTIC_AUTO_PARALLEL_EXIT = 102
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class ElasticManager:
+    """Node membership + heartbeat over the rendezvous store."""
+
+    def __init__(self, store: TCPStore = None, job_id=None, rank=None,
+                 np=None, heartbeat_interval=1.0, ttl=None):
+        self.job_id = job_id or os.environ.get("PADDLE_JOB_ID", "default")
+        self.rank = int(os.environ.get("PADDLE_NODE_RANK", 0)
+                        if rank is None else rank)
+        self.np = int(os.environ.get("PADDLE_NNODES", 1) if np is None
+                      else np)
+        self.ftl = int(os.environ.get(
+            "PADDLE_ELASTIC_FAULT_TOLERANC_LEVEL", 0))
+        self.interval = float(heartbeat_interval)
+        self.ttl = float(ttl if ttl is not None else 3 * self.interval)
+        self.store = store
+        self.enable = self.store is not None and (
+            self.np > 1 or self.ftl > 0)
+        self._stop = threading.Event()
+        self._thread = None
+        # Watcher-local liveness state: clocks are NOT comparable across
+        # hosts, so each node publishes an incrementing beat COUNTER and
+        # the watcher times counter advancement on its own clock.
+        self._last_seen = {}  # rank -> (counter, local_time_when_advanced)
+
+    # -- registry -------------------------------------------------------
+    def _beat_key(self, rank):
+        return "%s/beat/%d" % (self.job_id, rank)
+
+    def register(self):
+        if not self.enable:
+            return
+        self.store.add(self._beat_key(self.rank), 1)
+        self._thread = threading.Thread(target=self._heartbeat_loop,
+                                        daemon=True)
+        self._thread.start()
+
+    def _heartbeat_loop(self):
+        while not self._stop.wait(self.interval):
+            try:
+                self.store.add(self._beat_key(self.rank), 1)
+            except Exception:
+                return
+
+    def alive_nodes(self):
+        """Ranks whose beat counter advanced within the last ttl seconds
+        (as measured on THIS watcher's clock). register() starts every
+        live rank at count>=1 and exit() deletes the counter, so count<=0
+        means dead or never registered."""
+        now = time.monotonic()
+        alive = []
+        for r in range(self.np):
+            count = self.store.add(self._beat_key(r), 0)  # read counter
+            if count <= 0:
+                self._last_seen.pop(r, None)
+                continue
+            prev = self._last_seen.get(r)
+            if prev is None or count > prev[0]:
+                self._last_seen[r] = (count, now)
+                alive.append(r)
+            elif now - prev[1] <= self.ttl:
+                alive.append(r)
+        return alive
+
+    def watch(self):
+        """One membership check (reference manager.py watch loop body)."""
+        if not self.enable:
+            return ElasticStatus.COMPLETED
+        alive = self.alive_nodes()
+        if len(alive) == self.np:
+            return ElasticStatus.HOLD
+        if len(alive) < self.np:
+            # a node died: with fault tolerance, shrink/restart; else error
+            return (ElasticStatus.RESTART if self.ftl > 0
+                    else ElasticStatus.ERROR)
+        return ElasticStatus.HOLD
+
+    def exit(self, completed=True):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+        if self.enable:
+            try:
+                self.store.delete(self._beat_key(self.rank))
+            except Exception:
+                pass

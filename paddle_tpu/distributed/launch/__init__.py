@@ -1,0 +1,17 @@
+"""paddle.distributed.launch — multi-process / multi-host job launcher.
+
+Parity: reference `python -m paddle.distributed.launch`
+(python/paddle/distributed/launch/): a Controller spawns per-rank worker
+processes (Pod of Containers) with rank env vars, rendezvous runs through a
+master (HTTPMaster single-node / ETCDMaster multi-node,
+launch/controllers/master.py:65,177), logs are teed per rank, and failures
+tear the pod down.
+
+TPU-native deviations (by design, documented):
+- One worker process per HOST, not per device — JAX is single-controller
+  SPMD; all local chips belong to one process. `--nproc_per_node` exists
+  for CPU simulation/testing (each proc gets a virtual-device slice).
+- Rendezvous uses our native C++ TCPStore (csrc/store.cc) instead of
+  etcd/HTTP: node registration, barriers and heartbeats are store keys.
+"""
+from .controller import Controller, LaunchConfig, launch  # noqa: F401

@@ -1,0 +1,3 @@
+from .controller import launch
+
+launch()

@@ -1,0 +1,193 @@
+"""paddle.distributed.rpc — remote procedure calls between workers.
+
+Parity: reference python/paddle/distributed/rpc/ (init_rpc, rpc_sync,
+rpc_async, shutdown, get_worker_info) backed by a C++ TCP rpc agent + master
+store (paddle/fluid/distributed/rpc/). Here the worker registry rides the
+native C++ TCPStore (csrc/store.cc); the data plane is length-prefixed
+pickled frames over per-call TCP sockets (host-side control traffic only —
+tensor traffic between chips rides XLA collectives, never RPC).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
+
+from .store import TCPStore
+
+_agent = None
+
+
+class WorkerInfo:
+    def __init__(self, name, rank, ip, port):
+        self.name, self.rank, self.ip, self.port = name, rank, ip, port
+
+    def __repr__(self):
+        return ("WorkerInfo(name=%s, rank=%d, ip=%s, port=%d)"
+                % (self.name, self.rank, self.ip, self.port))
+
+
+def _send_frame(sock, obj):
+    data = pickle.dumps(obj)
+    sock.sendall(struct.pack("<Q", len(data)) + data)
+
+
+def _recv_frame(sock):
+    hdr = b""
+    while len(hdr) < 8:
+        chunk = sock.recv(8 - len(hdr))
+        if not chunk:
+            raise ConnectionError("rpc peer closed")
+        hdr += chunk
+    (n,) = struct.unpack("<Q", hdr)
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("rpc peer closed")
+        buf += chunk
+    return pickle.loads(bytes(buf))
+
+
+class _RpcAgent:
+    def __init__(self, name, rank, world_size, store):
+        self.name, self.rank, self.world_size = name, rank, world_size
+        self.store = store
+        self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._server.bind(("0.0.0.0", 0))
+        self._server.listen(64)
+        self.port = self._server.getsockname()[1]
+        self.ip = os.environ.get("POD_IP", "127.0.0.1")
+        self._pool = ThreadPoolExecutor(max_workers=8)
+        self._accepting = True
+        self._accept_thread = threading.Thread(target=self._serve,
+                                               daemon=True)
+        self._accept_thread.start()
+        # registry + all-gather of worker infos
+        store.set("rpc/worker/%d" % rank,
+                  "%s|%s|%d" % (name, self.ip, self.port))
+        store.barrier("rpc/init", world_size)
+        self.workers = {}
+        for r in range(world_size):
+            wname, ip, port = store.get(
+                "rpc/worker/%d" % r).decode().split("|")
+            info = WorkerInfo(wname, r, ip, int(port))
+            self.workers[wname] = info
+            self.workers[r] = info
+
+    def _serve(self):
+        while self._accepting:
+            try:
+                conn, _ = self._server.accept()
+            except OSError:
+                return
+            self._pool.submit(self._handle, conn)
+
+    def _handle(self, conn):
+        try:
+            fn, args, kwargs = _recv_frame(conn)
+            try:
+                result = (True, fn(*args, **kwargs))
+            except Exception as e:  # ship the exception back to the caller
+                result = (False, e)
+            try:
+                _send_frame(conn, result)
+            except (TypeError, AttributeError, pickle.PicklingError):
+                # unpicklable return/exception: ship a diagnostic instead
+                # of silently dropping the connection
+                _send_frame(conn, (False, RuntimeError(
+                    "rpc: result not picklable: %r" % (result[1],))))
+        except Exception:
+            pass
+        finally:
+            conn.close()
+
+    def call(self, to, fn, args, kwargs, timeout):
+        info = self.workers[to]
+        with socket.create_connection((info.ip, info.port),
+                                      timeout=timeout) as s:
+            _send_frame(s, (fn, args or (), kwargs or {}))
+            ok, payload = _recv_frame(s)
+        if not ok:
+            raise payload
+        return payload
+
+    def shutdown(self):
+        self.store.barrier("rpc/shutdown", self.world_size)
+        self._accepting = False
+        try:
+            self._server.close()
+        except OSError:
+            pass
+        self._pool.shutdown(wait=False)
+
+
+def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
+    """Start the rpc agent (reference distributed/rpc/rpc.py init_rpc)."""
+    global _agent
+    if _agent is not None:
+        raise RuntimeError("rpc already initialized")
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", 0)
+               if rank is None else rank)
+    world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", 1)
+                     if world_size is None else world_size)
+    master = master_endpoint or os.environ.get("PADDLE_MASTER",
+                                               "127.0.0.1:0")
+    host, _, port = master.partition(":")
+    store = TCPStore(host, int(port or 0), is_master=(rank == 0))
+    _agent = _RpcAgent(name, rank, world_size, store)
+    return _agent
+
+
+def rpc_sync(to, fn, args=None, kwargs=None, timeout=120):
+    if _agent is None:
+        raise RuntimeError("call init_rpc first")
+    return _agent.call(to, fn, args, kwargs, timeout)
+
+
+def rpc_async(to, fn, args=None, kwargs=None, timeout=120):
+    if _agent is None:
+        raise RuntimeError("call init_rpc first")
+    fut = Future()
+
+    def _run():
+        try:
+            fut.set_result(_agent.call(to, fn, args, kwargs, timeout))
+        except Exception as e:
+            fut.set_exception(e)
+
+    threading.Thread(target=_run, daemon=True).start()
+    return fut
+
+
+def get_worker_info(name=None):
+    if _agent is None:
+        raise RuntimeError("call init_rpc first")
+    if name is None:
+        return _agent.workers[_agent.rank]
+    return _agent.workers[name]
+
+
+def get_all_worker_infos():
+    if _agent is None:
+        raise RuntimeError("call init_rpc first")
+    return sorted({w for w in _agent.workers.values()
+                   if isinstance(w, WorkerInfo)},
+                  key=lambda w: w.rank)
+
+
+def get_current_worker_info():
+    return get_worker_info()
+
+
+def shutdown():
+    global _agent
+    if _agent is not None:
+        _agent.shutdown()
+        store = _agent.store
+        _agent = None
+        store.close()

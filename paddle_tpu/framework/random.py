@@ -15,8 +15,19 @@ import threading
 import jax
 
 _lock = threading.Lock()
-_root_key = jax.random.key(0)
+# Lazy: materializing a key initializes the JAX backend; `import paddle_tpu`
+# must stay device-free (the launcher parent and CLI tools never touch a chip).
+_root_key = None
 _counter = 0
+
+
+def _key():
+    global _root_key
+    if _root_key is None:
+        with _lock:
+            if _root_key is None:
+                _root_key = jax.random.key(0)
+    return _root_key
 
 
 def seed(s: int):
@@ -31,14 +42,15 @@ def seed(s: int):
 def next_key():
     """Return a fresh PRNG key (thread-safe)."""
     global _counter
+    root = _key()
     with _lock:
         _counter += 1
         c = _counter
-    return jax.random.fold_in(_root_key, c)
+    return jax.random.fold_in(root, c)
 
 
 def get_rng_state():
-    return (_root_key, _counter)
+    return (_key(), _counter)
 
 
 def set_rng_state(state):
