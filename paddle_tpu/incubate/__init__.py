@@ -1,0 +1,9 @@
+"""paddle.incubate namespace — experimental features.
+
+Parity: reference python/paddle/incubate/ (asp structured sparsity,
+autotune, fused nn ops). Graph/autograd incubations that the reference
+keeps here (primitive autodiff) are core features of this framework —
+everything is already traced functionally — so they need no incubation.
+"""
+from . import asp, autotune, nn  # noqa: F401
+from .autotune import set_config  # noqa: F401

@@ -1,0 +1,247 @@
+"""ASP — automatic structured (n:m) sparsity.
+
+Parity: reference python/paddle/incubate/asp/ (asp.py: decorate :217,
+prune_model :303, ASPHelper :516; utils.py: get_mask_1d/get_mask_2d_greedy/
+get_mask_2d_best/create_mask/check_sparsity/calculate_density). Semantics
+are identical — n nonzeros per m consecutive weights — computed on host
+numpy exactly as the reference does. TPU note: there is no sparse-tensor-
+core speedup to harvest on the MXU; ASP here serves model-compression
+parity, and masks stay applied through optimizer steps via `decorate`.
+"""
+from __future__ import annotations
+
+import itertools
+from enum import Enum
+
+import numpy as np
+
+
+class MaskAlgo(Enum):
+    MASK_1D = "get_mask_1d"
+    MASK_2D_GREEDY = "get_mask_2d_greedy"
+    MASK_2D_BEST = "get_mask_2d_best"
+
+
+class CheckMethod(Enum):
+    CHECK_1D = "check_mask_1d"
+    CHECK_2D = "check_mask_2d"
+
+    @staticmethod
+    def get_checking_method(mask_algo):
+        return (CheckMethod.CHECK_1D if mask_algo == MaskAlgo.MASK_1D
+                else CheckMethod.CHECK_2D)
+
+
+def calculate_density(x):
+    """Fraction of nonzeros (reference utils.py calculate_density)."""
+    x = np.asarray(x)
+    return float(np.count_nonzero(x)) / x.size
+
+
+def _reshape_1d(mat, m):
+    """Pad the last dim to a multiple of m and view as rows of m."""
+    mat = np.asarray(mat)
+    if mat.shape[1] % m == 0:
+        return mat.reshape(-1, m), mat.shape
+    pad = m - mat.shape[1] % m
+    padded = np.concatenate(
+        [mat, np.zeros((mat.shape[0], pad), mat.dtype)], axis=1)
+    return padded.reshape(-1, m), padded.shape
+
+
+def get_mask_1d(mat, n, m):
+    """Keep the n largest-|w| of every m consecutive weights per row."""
+    mat = np.asarray(mat)
+    rows, shape = _reshape_1d(mat, m)
+    mask = np.zeros_like(rows, dtype=mat.dtype)
+    idx = np.argsort(np.abs(rows), axis=1)[:, -n:]
+    np.put_along_axis(mask, idx, 1, axis=1)
+    return mask.reshape(shape)[:mat.shape[0], :mat.shape[1]]
+
+
+def check_mask_1d(mat, n, m):
+    mat = np.asarray(mat)
+    rows, _ = _reshape_1d(mat, m)
+    return bool(np.all(np.count_nonzero(rows, axis=1) <= n))
+
+
+def _valid_2d_patterns(n, m):
+    """All m x m binary matrices with exactly n ones per row AND column."""
+    row_patterns = [p for p in itertools.product((0, 1), repeat=m)
+                    if sum(p) == n]
+    valid = []
+    for combo in itertools.product(row_patterns, repeat=m):
+        arr = np.array(combo)
+        if np.all(arr.sum(axis=0) == n):
+            valid.append(arr)
+    return np.array(valid)
+
+
+_PATTERN_CACHE = {}
+
+
+def get_mask_2d_best(mat, n, m):
+    """Exhaustive search over valid n:m 2D patterns per m x m block,
+    maximizing retained |w| (reference utils.py get_mask_2d_best)."""
+    mat = np.asarray(mat)
+    key = (n, m)
+    if key not in _PATTERN_CACHE:
+        _PATTERN_CACHE[key] = _valid_2d_patterns(n, m)
+    patterns = _PATTERN_CACHE[key]  # [P, m, m]
+    h, w = mat.shape
+    ph, pw = (-h) % m, (-w) % m
+    padded = np.pad(np.abs(mat), ((0, ph), (0, pw)))
+    H, W = padded.shape
+    blocks = padded.reshape(H // m, m, W // m, m).transpose(0, 2, 1, 3)
+    # score every pattern on every block, pick argmax
+    scores = np.einsum("abij,pij->abp", blocks, patterns)
+    best = np.argmax(scores, axis=-1)
+    chosen = patterns[best]  # [H/m, W/m, m, m]
+    mask = chosen.transpose(0, 2, 1, 3).reshape(H, W)[:h, :w]
+    return mask.astype(mat.dtype)
+
+
+def get_mask_2d_greedy(mat, n, m):
+    """Greedy per-block assignment (reference get_mask_2d_greedy): walk
+    block entries by descending |w|, keep while row/col budgets allow."""
+    mat = np.asarray(mat)
+    h, w = mat.shape
+    ph, pw = (-h) % m, (-w) % m
+    padded = np.pad(np.abs(mat), ((0, ph), (0, pw)))
+    H, W = padded.shape
+    mask = np.zeros((H, W), mat.dtype)
+    for bi in range(0, H, m):
+        for bj in range(0, W, m):
+            block = padded[bi:bi + m, bj:bj + m]
+            order = np.dstack(np.unravel_index(
+                np.argsort(-block, axis=None), (m, m)))[0]
+            row_budget = np.full(m, n)
+            col_budget = np.full(m, n)
+            for i, j in order:
+                if row_budget[i] > 0 and col_budget[j] > 0:
+                    mask[bi + i, bj + j] = 1
+                    row_budget[i] -= 1
+                    col_budget[j] -= 1
+    return mask[:h, :w]
+
+
+def check_mask_2d(mat, n, m):
+    mat = np.asarray(mat)
+    h, w = mat.shape
+    ph, pw = (-h) % m, (-w) % m
+    padded = np.pad(np.abs(mat), ((0, ph), (0, pw)))
+    H, W = padded.shape
+    blocks = padded.reshape(H // m, m, W // m, m).transpose(0, 2, 1, 3)
+    nz = blocks != 0
+    return bool(np.all(nz.sum(axis=2) <= n) and np.all(nz.sum(axis=3) <= n))
+
+
+def create_mask(tensor, func_name=MaskAlgo.MASK_1D, n=2, m=4):
+    """Dispatch to a mask algorithm; >2D tensors are masked over their
+    last two dims flattened (reference create_mask reshapes the same way)."""
+    if isinstance(func_name, str):
+        func_name = MaskAlgo(func_name if func_name.startswith("get_")
+                             else "get_" + func_name)
+    t = np.asarray(tensor)
+    shape = t.shape
+    if t.ndim == 1:
+        mat = t.reshape(1, -1)
+    elif t.ndim == 2:
+        mat = t
+    else:
+        mat = t.reshape(-1, shape[-1])
+    fn = globals()[func_name.value]
+    return fn(mat, n, m).reshape(shape)
+
+
+def check_sparsity(tensor, func_name=CheckMethod.CHECK_1D, n=2, m=4):
+    if isinstance(func_name, str):
+        func_name = CheckMethod(func_name)
+    t = np.asarray(tensor)
+    mat = t.reshape(1, -1) if t.ndim == 1 else t.reshape(-1, t.shape[-1])
+    return globals()[func_name.value](mat, n, m)
+
+
+# ---- model-level API --------------------------------------------------------
+
+class ASPHelper:
+    """Per-parameter masks and exclusion list (reference asp.py:516).
+    Masks live ON the parameter object (`param._asp_mask`) so their
+    lifetime is the parameter's — no global registry to leak or to
+    mis-apply via recycled object ids."""
+
+    MASK_APPENDDED_NAME = "asp_mask"
+    _excluded = set()
+
+    @classmethod
+    def set_excluded_layers(cls, param_names):
+        cls._excluded.update(param_names)
+
+    @classmethod
+    def reset_excluded_layers(cls):
+        cls._excluded = set()
+
+    @classmethod
+    def _supported(cls, name, param):
+        if any(ex in name for ex in cls._excluded):
+            return False
+        shape = param.shape
+        # reference supports Linear/Conv weights; needs both dims % 4 == 0
+        return (len(shape) >= 2 and shape[-1] % 4 == 0
+                and int(np.prod(shape[:-1])) % 4 == 0)
+
+    @classmethod
+    def prune_model(cls, model, n=2, m=4, mask_algo=MaskAlgo.MASK_1D,
+                    with_mask=True):
+        masks = {}
+        for name, param in model.named_parameters():
+            if not cls._supported(name, param):
+                continue
+            mask = create_mask(param.numpy(), mask_algo, n, m)
+            param.set_value(param.numpy() * mask)
+            if with_mask:
+                param._asp_mask = mask
+            masks[name] = mask
+        return masks
+
+    @classmethod
+    def apply_masks(cls, parameters):
+        for p in parameters:
+            mask = getattr(p, "_asp_mask", None)
+            if mask is not None:
+                p.set_value(p.numpy() * mask)
+
+
+def set_excluded_layers(param_names, main_program=None):
+    ASPHelper.set_excluded_layers(param_names)
+
+
+def reset_excluded_layers(main_program=None):
+    ASPHelper.reset_excluded_layers()
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    algo = {"mask_1d": MaskAlgo.MASK_1D,
+            "mask_2d_greedy": MaskAlgo.MASK_2D_GREEDY,
+            "mask_2d_best": MaskAlgo.MASK_2D_BEST}[mask_algo]
+    return ASPHelper.prune_model(model, n=n, m=m, mask_algo=algo,
+                                 with_mask=with_mask)
+
+
+class OptimizerWithSparsityGuarantee:
+    """Re-applies ASP masks after every optimizer step (reference
+    asp.py decorate: masks multiplied back post-update)."""
+
+    def __init__(self, optimizer):
+        self._optimizer = optimizer
+
+    def __getattr__(self, item):
+        return getattr(self._optimizer, item)
+
+    def step(self):
+        self._optimizer.step()
+        ASPHelper.apply_masks(self._optimizer._parameter_list or [])
+
+
+def decorate(optimizer):
+    return OptimizerWithSparsityGuarantee(optimizer)

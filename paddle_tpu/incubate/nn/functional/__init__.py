@@ -1,0 +1,162 @@
+"""Fused-op functionals.
+
+Parity: reference python/paddle/incubate/nn/functional/ (fused_transformer.py
+fused_multi_head_attention :464, fused_feedforward, fused_multi_transformer,
+fused_bias_dropout_residual_layer_norm; fused_matmul_bias.py), which call
+monolithic CUDA kernels (operators/fused/fused_attention_op.cu,
+fused_feedforward_op.cu). TPU-native: "fused" means ONE traced region —
+XLA fuses the elementwise chain into the matmuls, and attention uses the
+Pallas flash kernel on TPU — so these are compositions, not custom kernels,
+with identical signatures/semantics to the reference.
+"""
+from __future__ import annotations
+
+import paddle_tpu.nn.functional as F
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False):
+    """reference fused_matmul_bias (cublasLt epilogue fusion)."""
+    import paddle_tpu as paddle
+
+    out = paddle.matmul(x, y, transpose_x=transpose_x,
+                        transpose_y=transpose_y)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False):
+    return fused_matmul_bias(x, weight, bias, transpose_y=transpose_weight)
+
+
+def fused_bias_dropout_residual_layer_norm(
+        x, residual, bias=None, ln_scale=None, ln_bias=None,
+        dropout_rate=0.5, ln_epsilon=1e-5, training=True):
+    """out = layer_norm(residual + dropout(x + bias))."""
+    if bias is not None:
+        x = x + bias
+    x = F.dropout(x, p=dropout_rate, training=training)
+    x = residual + x
+    dim = x.shape[-1]
+    return F.layer_norm(x, [dim], weight=ln_scale, bias=ln_bias,
+                        epsilon=ln_epsilon)
+
+
+def fused_multi_head_attention(
+        x, qkv_weight, linear_weight, pre_layer_norm=False,
+        pre_ln_scale=None, pre_ln_bias=None, ln_scale=None, ln_bias=None,
+        pre_ln_epsilon=1e-5, qkv_bias=None, linear_bias=None,
+        cache_kv=None, attn_mask=None, dropout_rate=0.5,
+        attn_dropout_rate=0.5, ln_epsilon=1e-5, training=True,
+        num_heads=None):
+    """reference incubate/nn/functional/fused_transformer.py:464.
+
+    x: [B, S, E]; qkv_weight: [3, num_heads, head_dim, E] (reference
+    layout); linear_weight: [E, E]. Computes (optionally pre-LN)
+    transformer self-attention with residual + dropout + (post-)LN in one
+    traced region.
+    """
+    import paddle_tpu as paddle
+
+    embed_dim = x.shape[-1]
+    if num_heads is None:
+        num_heads = qkv_weight.shape[1]
+    head_dim = embed_dim // num_heads
+    residual = x
+    if pre_layer_norm:
+        x = F.layer_norm(x, [embed_dim], weight=pre_ln_scale,
+                         bias=pre_ln_bias, epsilon=pre_ln_epsilon)
+    # qkv projection: [B,S,E] x [3*E, E]^T
+    w = paddle.reshape(qkv_weight, [3 * num_heads * head_dim, embed_dim])
+    qkv = paddle.matmul(x, w, transpose_y=True)
+    if qkv_bias is not None:
+        qkv = qkv + paddle.reshape(qkv_bias, [3 * num_heads * head_dim])
+    b, s = x.shape[0], x.shape[1]
+    qkv = paddle.reshape(qkv, [b, s, 3, num_heads, head_dim])
+    q, k, v = paddle.unbind(qkv, axis=2)  # each [B,S,H,D]
+    if cache_kv is not None:
+        pk, pv = cache_kv
+        k = paddle.concat([pk, k], axis=1)
+        v = paddle.concat([pv, v], axis=1)
+    out = F.scaled_dot_product_attention(
+        q, k, v, attn_mask=attn_mask, dropout_p=attn_dropout_rate,
+        training=training)
+    out = paddle.reshape(out, [b, s, embed_dim])
+    out = paddle.matmul(out, linear_weight)
+    if linear_bias is not None:
+        out = out + linear_bias
+    out = F.dropout(out, p=dropout_rate, training=training)
+    out = residual + out
+    if not pre_layer_norm:
+        out = F.layer_norm(out, [embed_dim], weight=ln_scale, bias=ln_bias,
+                           epsilon=ln_epsilon)
+    if cache_kv is not None:
+        return out, (k, v)
+    return out
+
+
+def fused_feedforward(
+        x, linear1_weight, linear2_weight, linear1_bias=None,
+        linear2_bias=None, ln1_scale=None, ln1_bias=None, ln2_scale=None,
+        ln2_bias=None, dropout1_rate=0.5, dropout2_rate=0.5,
+        activation="relu", ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+        pre_layer_norm=False, training=True):
+    """reference fused_feedforward: residual + LN + MLP in one region."""
+    import paddle_tpu as paddle
+
+    embed_dim = x.shape[-1]
+    residual = x
+    if pre_layer_norm:
+        x = F.layer_norm(x, [embed_dim], weight=ln1_scale, bias=ln1_bias,
+                         epsilon=ln1_epsilon)
+    x = paddle.matmul(x, linear1_weight)
+    if linear1_bias is not None:
+        x = x + linear1_bias
+    x = getattr(F, activation)(x)
+    x = F.dropout(x, p=dropout1_rate, training=training)
+    x = paddle.matmul(x, linear2_weight)
+    if linear2_bias is not None:
+        x = x + linear2_bias
+    x = F.dropout(x, p=dropout2_rate, training=training)
+    out = residual + x
+    if not pre_layer_norm:
+        out = F.layer_norm(out, [embed_dim], weight=ln2_scale, bias=ln2_bias,
+                           epsilon=ln2_epsilon)
+    return out
+
+
+def fused_multi_transformer(
+        x, ln_scales, ln_biases, qkv_weights, qkv_biases, linear_weights,
+        linear_biases, ffn_ln_scales, ffn_ln_biases, ffn1_weights,
+        ffn1_biases, ffn2_weights, ffn2_biases, pre_layer_norm=True,
+        epsilon=1e-5, cache_kvs=None, attn_mask=None, dropout_rate=0.0,
+        activation="gelu", training=False):
+    """reference fused_multi_transformer_op: a whole decoder stack in one
+    region (the serving fast path). Layers run sequentially; XLA pipelines
+    and fuses across them."""
+    new_caches = [] if cache_kvs is not None else None
+    for i in range(len(qkv_weights)):
+        cache = cache_kvs[i] if cache_kvs is not None else None
+        out = fused_multi_head_attention(
+            x, qkv_weights[i], linear_weights[i], pre_layer_norm=pre_layer_norm,
+            pre_ln_scale=ln_scales[i], pre_ln_bias=ln_biases[i],
+            ln_scale=ln_scales[i], ln_bias=ln_biases[i],
+            pre_ln_epsilon=epsilon, qkv_bias=qkv_biases[i],
+            linear_bias=linear_biases[i], cache_kv=cache,
+            attn_mask=attn_mask, dropout_rate=dropout_rate,
+            attn_dropout_rate=dropout_rate, ln_epsilon=epsilon,
+            training=training)
+        if cache is not None:
+            out, new_cache = out
+            new_caches.append(new_cache)
+        x = fused_feedforward(
+            out, ffn1_weights[i], ffn2_weights[i],
+            linear1_bias=ffn1_biases[i], linear2_bias=ffn2_biases[i],
+            ln1_scale=ffn_ln_scales[i], ln1_bias=ffn_ln_biases[i],
+            ln2_scale=ffn_ln_scales[i], ln2_bias=ffn_ln_biases[i],
+            dropout1_rate=dropout_rate, dropout2_rate=dropout_rate,
+            activation=activation, ln1_epsilon=epsilon, ln2_epsilon=epsilon,
+            pre_layer_norm=pre_layer_norm, training=training)
+    if cache_kvs is not None:
+        return x, new_caches
+    return x

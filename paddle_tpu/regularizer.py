@@ -1,0 +1,5 @@
+"""paddle.regularizer (reference python/paddle/regularizer.py L1Decay:20,
+L2Decay:82). The decay coefficients are consumed inside the optimizer
+update (optimizer/optimizer.py _weight_decay_value) — under jit the decay
+fuses into the compiled step, so there is no separate regularization op."""
+from .optimizer.optimizer import L1Decay, L2Decay  # noqa: F401

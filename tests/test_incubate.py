@@ -1,0 +1,233 @@
+"""incubate (ASP / fused ops / autotune) + regularizer tests.
+
+Oracle model: reference ASP tests (unittests/asp/test_asp_pruning_*.py
+check n:m sparsity after prune + after optimizer steps) and fused-op tests
+(unittests/test_fused_attention_op.py compares the fused op against the
+unfused composition).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.incubate import asp
+
+
+class TestASPUtils:
+    def test_mask_1d(self):
+        w = np.random.RandomState(0).randn(8, 16).astype("float32")
+        mask = asp.get_mask_1d(w, 2, 4)
+        assert mask.shape == w.shape
+        assert asp.check_mask_1d(w * mask, 2, 4)
+        # exactly half the weights survive
+        assert asp.calculate_density(mask) == 0.5
+        # kept entries are the 2 largest |w| of each group of 4
+        groups = (np.abs(w).reshape(-1, 4), mask.reshape(-1, 4))
+        for g, m in zip(*groups):
+            kept = set(np.nonzero(m)[0])
+            assert kept == set(np.argsort(g)[-2:])
+
+    def test_mask_1d_ragged_width(self):
+        w = np.random.RandomState(1).randn(4, 10).astype("float32")
+        mask = asp.get_mask_1d(w, 2, 4)
+        assert mask.shape == w.shape
+        assert asp.check_mask_1d(w * mask, 2, 4)
+
+    def test_mask_2d_greedy(self):
+        w = np.random.RandomState(2).randn(8, 8).astype("float32")
+        mask = asp.get_mask_2d_greedy(w, 2, 4)
+        assert asp.check_mask_2d(w * mask, 2, 4)
+        assert asp.calculate_density(mask) == 0.5
+
+    def test_mask_2d_best_not_worse_than_greedy(self):
+        w = np.random.RandomState(3).randn(16, 16).astype("float32")
+        best = asp.get_mask_2d_best(w, 2, 4)
+        greedy = asp.get_mask_2d_greedy(w, 2, 4)
+        assert asp.check_mask_2d(w * best, 2, 4)
+        assert (np.abs(w) * best).sum() >= (np.abs(w) * greedy).sum() - 1e-6
+
+    def test_create_mask_3d(self):
+        w = np.random.RandomState(4).randn(3, 8, 8).astype("float32")
+        mask = asp.create_mask(w, "mask_1d", 2, 4)
+        assert mask.shape == w.shape
+
+
+class TestASPModel:
+    def test_prune_and_decorate(self):
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(16, 32), nn.ReLU(),
+                              nn.Linear(32, 8))
+        asp.prune_model(model, n=2, m=4)
+        for name, p in model.named_parameters():
+            if p.ndim == 2:
+                assert asp.check_sparsity(p.numpy(), n=2, m=4), name
+        opt = asp.decorate(paddle.optimizer.SGD(
+            learning_rate=0.1, parameters=model.parameters()))
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(4, 16).astype("float32"))
+        loss = model(x).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        # masks survive the update (the whole point of decorate)
+        for name, p in model.named_parameters():
+            if p.ndim == 2:
+                assert asp.check_sparsity(p.numpy(), n=2, m=4), name
+
+    def test_excluded_layers(self):
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(8, 8), nn.Linear(8, 8))
+        asp.set_excluded_layers(["0.weight"])
+        try:
+            masks = asp.prune_model(model, n=2, m=4)
+            assert not any("0.weight" in k for k in masks)
+            assert any("1.weight" in k for k in masks)
+        finally:
+            asp.reset_excluded_layers()
+
+
+class TestFusedOps:
+    def test_fused_linear_matches_linear(self):
+        from paddle_tpu.incubate.nn import FusedLinear
+
+        paddle.seed(0)
+        fl = FusedLinear(8, 4)
+        x = paddle.randn([2, 8])
+        out = fl(x)
+        ref = paddle.matmul(x, fl.weight) + fl.bias
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-5)
+
+    def test_fused_mha_matches_unfused(self):
+        import paddle_tpu.incubate.nn.functional as IF
+
+        paddle.seed(0)
+        B, S, E, H = 2, 6, 16, 4
+        D = E // H
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(B, S, E).astype("float32"))
+        qkv_w = paddle.to_tensor(
+            (rng.randn(3, H, D, E) * 0.1).astype("float32"))
+        lin_w = paddle.to_tensor((rng.randn(E, E) * 0.1).astype("float32"))
+        out = IF.fused_multi_head_attention(
+            x, qkv_w, lin_w, pre_layer_norm=True, dropout_rate=0.0,
+            attn_dropout_rate=0.0, training=False)
+        assert out.shape == [B, S, E]
+        # unfused oracle
+        xn = F.layer_norm(x, [E])
+        w2 = qkv_w.reshape([3 * E, E])
+        qkv = paddle.matmul(xn, w2, transpose_y=True).reshape([B, S, 3, H, D])
+        q, k, v = paddle.unbind(qkv, axis=2)
+        attn = F.scaled_dot_product_attention(q, k, v, dropout_p=0.0,
+                                              training=False)
+        ref = x + paddle.matmul(attn.reshape([B, S, E]), lin_w)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=2e-4,
+                                   atol=2e-5)
+
+    def test_fused_mha_cache_kv(self):
+        import paddle_tpu.incubate.nn.functional as IF
+
+        paddle.seed(0)
+        B, E, H = 1, 8, 2
+        rng = np.random.RandomState(1)
+        qkv_w = paddle.to_tensor(
+            (rng.randn(3, H, E // H, E) * 0.1).astype("float32"))
+        lin_w = paddle.to_tensor((rng.randn(E, E) * 0.1).astype("float32"))
+        x = paddle.to_tensor(rng.randn(B, 1, E).astype("float32"))
+        pk = paddle.to_tensor(rng.randn(B, 3, H, E // H).astype("float32"))
+        pv = paddle.to_tensor(rng.randn(B, 3, H, E // H).astype("float32"))
+        out, (k, v) = IF.fused_multi_head_attention(
+            x, qkv_w, lin_w, cache_kv=(pk, pv), dropout_rate=0.0,
+            attn_dropout_rate=0.0, training=False)
+        assert out.shape == [B, 1, E]
+        assert k.shape == [B, 4, H, E // H]
+
+    def test_fused_feedforward(self):
+        import paddle_tpu.incubate.nn.functional as IF
+
+        paddle.seed(0)
+        rng = np.random.RandomState(2)
+        x = paddle.to_tensor(rng.randn(2, 4, 8).astype("float32"))
+        w1 = paddle.to_tensor((rng.randn(8, 32) * 0.1).astype("float32"))
+        w2 = paddle.to_tensor((rng.randn(32, 8) * 0.1).astype("float32"))
+        out = IF.fused_feedforward(x, w1, w2, dropout1_rate=0.0,
+                                   dropout2_rate=0.0, training=False)
+        ref = F.layer_norm(x + paddle.matmul(
+            F.relu(paddle.matmul(x, w1)), w2), [8])
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=2e-4,
+                                   atol=2e-5)
+
+    def test_fused_encoder_layer_trains(self):
+        from paddle_tpu.incubate.nn import FusedTransformerEncoderLayer
+
+        paddle.seed(0)
+        layer = FusedTransformerEncoderLayer(16, 4, 32, dropout_rate=0.0)
+        opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                    parameters=layer.parameters())
+        x = paddle.randn([2, 5, 16])
+        losses = []
+        for _ in range(3):
+            loss = (layer(x) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+
+    def test_fused_multi_transformer(self):
+        import paddle_tpu.incubate.nn.functional as IF
+
+        paddle.seed(0)
+        rng = np.random.RandomState(3)
+        E, H, L = 8, 2, 2
+        t = lambda *s: paddle.to_tensor(  # noqa: E731
+            (rng.randn(*s) * 0.1).astype("float32"))
+        x = t(2, 4, E)
+        out = IF.fused_multi_transformer(
+            x,
+            ln_scales=[t(E) + 1.0 for _ in range(L)],
+            ln_biases=[t(E) for _ in range(L)],
+            qkv_weights=[t(3, H, E // H, E) for _ in range(L)],
+            qkv_biases=[t(3, H, E // H) for _ in range(L)],
+            linear_weights=[t(E, E) for _ in range(L)],
+            linear_biases=[t(E) for _ in range(L)],
+            ffn_ln_scales=[t(E) + 1.0 for _ in range(L)],
+            ffn_ln_biases=[t(E) for _ in range(L)],
+            ffn1_weights=[t(E, 4 * E) for _ in range(L)],
+            ffn1_biases=[t(4 * E) for _ in range(L)],
+            ffn2_weights=[t(4 * E, E) for _ in range(L)],
+            ffn2_biases=[t(E) for _ in range(L)])
+        assert out.shape == [2, 4, E]
+        assert np.all(np.isfinite(out.numpy()))
+
+
+class TestAutotuneAndRegularizer:
+    def test_autotune_set_config(self):
+        from paddle_tpu.incubate import autotune
+
+        autotune.set_config({"kernel": {"enable": False}})
+        assert autotune.get_config()["kernel"]["enable"] is False
+        with pytest.raises(TypeError):
+            autotune.set_config(42)
+
+    def test_regularizer_namespace(self):
+        assert paddle.regularizer.L2Decay(1e-4)._coeff == 1e-4
+        assert paddle.regularizer.L1Decay(1e-3)._coeff == 1e-3
+
+    def test_l2decay_changes_update(self):
+        paddle.seed(0)
+        w0 = np.ones((4, 4), dtype="float32")
+        models = []
+        for wd in (None, paddle.regularizer.L2Decay(0.5)):
+            lin = nn.Linear(4, 4)
+            lin.weight.set_value(w0)
+            opt = paddle.optimizer.Momentum(
+                learning_rate=0.1, parameters=lin.parameters(),
+                weight_decay=wd)
+            x = paddle.to_tensor(np.ones((2, 4), dtype="float32"))
+            loss = lin(x).sum()
+            loss.backward()
+            opt.step()
+            models.append(lin.weight.numpy())
+        # decay pulls weights further toward zero
+        assert np.all(np.abs(models[1]) < np.abs(models[0]))
