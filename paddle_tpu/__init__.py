@@ -281,6 +281,7 @@ from . import signal  # noqa: F401
 from . import sparse  # noqa: F401
 from . import framework  # noqa: F401
 from . import hapi  # noqa: F401
+from . import incubate  # noqa: F401
 from . import io  # noqa: F401
 from . import inference  # noqa: F401
 from . import jit  # noqa: F401
@@ -289,9 +290,13 @@ from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import profiler  # noqa: F401
 from . import quantization  # noqa: F401
+from . import regularizer  # noqa: F401
 from . import static  # noqa: F401
 from . import utils  # noqa: F401
 from . import vision  # noqa: F401
+from . import audio  # noqa: F401
+from . import geometric  # noqa: F401
+from . import text  # noqa: F401
 
 from .framework.io import load, save  # noqa: F401
 from .hapi import Model  # noqa: F401

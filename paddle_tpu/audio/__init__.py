@@ -1,0 +1,4 @@
+"""paddle.audio (reference python/paddle/audio/__init__.py: functional,
+features, datasets, backends + top-level load/info/save)."""
+from . import backends, datasets, features, functional  # noqa: F401
+from .backends import info, load, save  # noqa: F401

@@ -1,0 +1,90 @@
+"""paddle.audio.backends (reference python/paddle/audio/backends/
+wave_backend.py: info :37, load :89, save :168 — stdlib `wave`-based WAV
+IO; init_backend.py lists/sets backends). Pure host IO, no device work.
+"""
+from __future__ import annotations
+
+import wave
+
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+class AudioInfo:
+    """reference backends/backend.py AudioInfo."""
+
+    def __init__(self, sample_rate, num_samples, num_channels,
+                 bits_per_sample, encoding):
+        self.sample_rate = sample_rate
+        self.num_samples = num_samples
+        self.num_channels = num_channels
+        self.bits_per_sample = bits_per_sample
+        self.encoding = encoding
+
+
+def list_available_backends():
+    return ["wave_backend"]
+
+
+def get_current_backend():
+    return "wave_backend"
+
+
+def set_backend(backend_name):
+    if backend_name != "wave_backend":
+        raise NotImplementedError(
+            "only the built-in wave_backend is available")
+
+
+def info(filepath):
+    with wave.open(filepath, "rb") as f:
+        return AudioInfo(sample_rate=f.getframerate(),
+                         num_samples=f.getnframes(),
+                         num_channels=f.getnchannels(),
+                         bits_per_sample=f.getsampwidth() * 8,
+                         encoding="PCM_S")
+
+
+def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
+         channels_first=True):
+    """Returns (Tensor [C, T] (or [T, C]), sample_rate)."""
+    with wave.open(filepath, "rb") as f:
+        sr = f.getframerate()
+        nch = f.getnchannels()
+        width = f.getsampwidth()
+        f.setpos(frame_offset)
+        n = f.getnframes() - frame_offset if num_frames < 0 else num_frames
+        raw = f.readframes(n)
+    dtype = {1: np.uint8, 2: np.int16, 4: np.int32}[width]
+    data = np.frombuffer(raw, dtype=dtype).reshape(-1, nch)
+    if width == 1:  # 8-bit WAV is unsigned
+        data = data.astype(np.int16) - 128
+        scale = 128.0
+    else:
+        scale = float(2 ** (8 * width - 1))
+    if normalize:
+        out = data.astype(np.float32) / scale
+    else:
+        out = data
+    if channels_first:
+        out = out.T
+    return paddle.to_tensor(np.ascontiguousarray(out)), sr
+
+
+def save(filepath, src, sample_rate, channels_first=True,
+         encoding="PCM_16", bits_per_sample=16):
+    data = src.numpy() if hasattr(src, "numpy") else np.asarray(src)
+    if channels_first:
+        data = data.T  # -> [T, C]
+    assert bits_per_sample == 16, "wave backend writes PCM_16"
+    if np.issubdtype(data.dtype, np.floating):
+        data = np.clip(data, -1.0, 1.0)
+        data = (data * 32767.0).astype(np.int16)
+    else:
+        data = data.astype(np.int16)
+    with wave.open(filepath, "wb") as f:
+        f.setnchannels(data.shape[1] if data.ndim > 1 else 1)
+        f.setsampwidth(2)
+        f.setframerate(int(sample_rate))
+        f.writeframes(np.ascontiguousarray(data).tobytes())

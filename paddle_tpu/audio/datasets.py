@@ -1,0 +1,124 @@
+"""paddle.audio.datasets (reference python/paddle/audio/datasets/: TESS,
+ESC50 over AudioClassificationDataset in dataset.py). Zero-egress: loaders
+read local WAV trees when present; `synthetic=True` (default when no files)
+yields deterministic sine-wave clips with the right shapes — the same
+pattern paddle_tpu.vision.datasets uses.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..io import Dataset
+from . import features as _features
+
+
+class AudioClassificationDataset(Dataset):
+    """reference audio/datasets/dataset.py AudioClassificationDataset."""
+
+    def __init__(self, files=None, labels=None, feat_type="raw",
+                 sample_rate=16000, duration=1.0, archive=None, **feat_kwargs):
+        self.files = files or []
+        self.labels = labels or []
+        self.feat_type = feat_type
+        self.sample_rate = sample_rate
+        self.num_samples = int(duration * sample_rate)
+        if feat_type == "raw":
+            self.feature_extractor = None
+        elif feat_type == "mfcc":
+            self.feature_extractor = _features.MFCC(
+                sr=sample_rate, **feat_kwargs)
+        elif feat_type == "melspectrogram":
+            self.feature_extractor = _features.MelSpectrogram(
+                sr=sample_rate, **feat_kwargs)
+        elif feat_type == "logmelspectrogram":
+            self.feature_extractor = _features.LogMelSpectrogram(
+                sr=sample_rate, **feat_kwargs)
+        elif feat_type == "spectrogram":
+            self.feature_extractor = _features.Spectrogram(**feat_kwargs)
+        else:
+            raise ValueError("unknown feat_type %r" % feat_type)
+
+    def _load_waveform(self, idx):
+        from . import backends
+
+        path = self.files[idx]
+        wav, _ = backends.load(path, channels_first=False)
+        w = wav.numpy()[:, 0]
+        if len(w) < self.num_samples:
+            w = np.pad(w, (0, self.num_samples - len(w)))
+        return w[:self.num_samples].astype(np.float32)
+
+    def __getitem__(self, idx):
+        import paddle_tpu as paddle
+
+        w = self._load_waveform(idx)
+        if self.feature_extractor is not None:
+            feat = self.feature_extractor(paddle.to_tensor(w))
+            return feat.numpy(), np.int64(self.labels[idx])
+        return w, np.int64(self.labels[idx])
+
+    def __len__(self):
+        return len(self.files)
+
+
+class _SyntheticAudioDataset(AudioClassificationDataset):
+    """Deterministic sine clips, one frequency per class."""
+
+    n_class = 2
+
+    def __init__(self, mode="train", feat_type="raw", data_dir=None,
+                 size=32, **kwargs):
+        super().__init__(files=None, labels=None, feat_type=feat_type,
+                         **kwargs)
+        if data_dir and os.path.isdir(data_dir):
+            for root, _, names in os.walk(data_dir):
+                for name in sorted(names):
+                    if name.endswith(".wav"):
+                        self.files.append(os.path.join(root, name))
+                        self.labels.append(self._label_of(name))
+        if not self.files:
+            self._synthetic = True
+            rng = np.random.RandomState(0 if mode == "train" else 1)
+            self._freqs = rng.randint(100, 1000, size)
+            self.labels = (self._freqs % self.n_class).astype(np.int64)
+            self.files = [None] * size
+        else:
+            self._synthetic = False
+
+    def _label_of(self, name):
+        return 0
+
+    def _load_waveform(self, idx):
+        if not self._synthetic:
+            return super()._load_waveform(idx)
+        t = np.arange(self.num_samples) / self.sample_rate
+        return np.sin(2 * np.pi * self._freqs[idx] * t).astype(np.float32)
+
+
+class TESS(_SyntheticAudioDataset):
+    """Toronto emotional speech set (reference audio/datasets/tess.py).
+    7 emotion classes parsed from filename."""
+
+    n_class = 7
+    labels_list = ["angry", "disgust", "fear", "happy", "neutral",
+                   "ps", "sad"]
+
+    def _label_of(self, name):
+        emotion = name.rsplit("_", 1)[-1].split(".")[0].lower()
+        return (self.labels_list.index(emotion)
+                if emotion in self.labels_list else 0)
+
+
+class ESC50(_SyntheticAudioDataset):
+    """ESC-50 environmental sounds (reference audio/datasets/esc50.py),
+    50 classes from the filename's last dash field."""
+
+    n_class = 50
+
+    def _label_of(self, name):
+        try:
+            return int(name.rsplit("-", 1)[-1].split(".")[0])
+        except ValueError:
+            return 0

@@ -1,0 +1,103 @@
+"""paddle.audio.features (reference python/paddle/audio/features/layers.py:
+Spectrogram :25, MelSpectrogram :107, LogMelSpectrogram :207, MFCC :310).
+Each layer precomputes its window/filterbank once at construction (host
+numpy, like the reference registering buffers) and does per-frame math in
+traced ops, so feature extraction jit-compiles and fuses with the model.
+"""
+from __future__ import annotations
+
+import paddle_tpu as paddle
+from .. import signal
+from ..nn.layer import Layer
+from . import functional as AF
+
+
+class Spectrogram(Layer):
+    def __init__(self, n_fft=512, hop_length=512, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 dtype="float32"):
+        super().__init__()
+        assert power > 0, "power must be positive"
+        self.n_fft = n_fft
+        self.hop_length = hop_length if hop_length is not None else n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        self.fft_window = AF.get_window(window, self.win_length,
+                                        fftbins=True, dtype=dtype)
+
+    def forward(self, x):
+        spec = signal.stft(x, self.n_fft, hop_length=self.hop_length,
+                           win_length=self.win_length,
+                           window=self.fft_window, center=self.center,
+                           pad_mode=self.pad_mode)
+        mag = paddle.abs(spec)
+        if self.power == 1.0:
+            return mag
+        if self.power == 2.0:
+            return mag * mag
+        return mag ** self.power
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=512, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False,
+                 norm="slaney", dtype="float32"):
+        super().__init__()
+        self._spectrogram = Spectrogram(
+            n_fft=n_fft, hop_length=hop_length, win_length=win_length,
+            window=window, power=power, center=center, pad_mode=pad_mode,
+            dtype=dtype)
+        self.n_mels = n_mels
+        self.fbank_matrix = AF.compute_fbank_matrix(
+            sr=sr, n_fft=n_fft, n_mels=n_mels, f_min=f_min, f_max=f_max,
+            htk=htk, norm=norm, dtype=dtype)
+
+    def forward(self, x):
+        spect = self._spectrogram(x)  # [..., freq, time]
+        return paddle.matmul(self.fbank_matrix, spect)
+
+
+class LogMelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=512, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False,
+                 norm="slaney", ref_value=1.0, amin=1e-10, top_db=None,
+                 dtype="float32"):
+        super().__init__()
+        self._melspectrogram = MelSpectrogram(
+            sr=sr, n_fft=n_fft, hop_length=hop_length, win_length=win_length,
+            window=window, power=power, center=center, pad_mode=pad_mode,
+            n_mels=n_mels, f_min=f_min, f_max=f_max, htk=htk, norm=norm,
+            dtype=dtype)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        return AF.power_to_db(self._melspectrogram(x),
+                              ref_value=self.ref_value, amin=self.amin,
+                              top_db=self.top_db)
+
+
+class MFCC(Layer):
+    def __init__(self, sr=22050, n_mfcc=40, n_fft=512, hop_length=512,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", ref_value=1.0, amin=1e-10,
+                 top_db=None, dtype="float32"):
+        super().__init__()
+        assert n_mfcc <= n_mels, "n_mfcc cannot be larger than n_mels"
+        self._log_melspectrogram = LogMelSpectrogram(
+            sr=sr, n_fft=n_fft, hop_length=hop_length, win_length=win_length,
+            window=window, power=power, center=center, pad_mode=pad_mode,
+            n_mels=n_mels, f_min=f_min, f_max=f_max, htk=htk, norm=norm,
+            ref_value=ref_value, amin=amin, top_db=top_db, dtype=dtype)
+        self.dct_matrix = AF.create_dct(n_mfcc=n_mfcc, n_mels=n_mels,
+                                        dtype=dtype)
+
+    def forward(self, x):
+        mel = self._log_melspectrogram(x)  # [..., n_mels, time]
+        return paddle.matmul(self.dct_matrix, mel, transpose_x=True)

@@ -1,0 +1,152 @@
+"""paddle.audio.functional (reference python/paddle/audio/functional/
+functional.py: hz_to_mel :22, mel_to_hz :78, mel_frequencies :123,
+fft_frequencies :163, compute_fbank_matrix :186, power_to_db :259,
+create_dct :303; window.py get_window). Filterbank construction happens on
+host numpy (it runs once per feature layer, exactly like the reference
+precomputing the fbank as a buffer); the per-frame math is jnp so feature
+extraction fuses into the compiled model when jitted.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+import paddle_tpu as paddle
+from ..core.tensor import Tensor
+
+
+def _is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def hz_to_mel(freq, htk=False):
+    """Slaney by default; htk=True uses 2595*log10(1+f/700)."""
+    if htk:
+        if _is_tensor(freq):
+            return 2595.0 * paddle.log10(1.0 + freq / 700.0)
+        return 2595.0 * math.log10(1.0 + freq / 700.0)
+    f_min, f_sp = 0.0, 200.0 / 3
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    if _is_tensor(freq):
+        lin = (freq - f_min) / f_sp
+        log = min_log_mel + paddle.log(
+            paddle.clip(freq, min=1e-10) / min_log_hz) / logstep
+        return paddle.where(freq >= min_log_hz, log, lin)
+    if freq >= min_log_hz:
+        return min_log_mel + math.log(freq / min_log_hz) / logstep
+    return (freq - f_min) / f_sp
+
+
+def mel_to_hz(mel, htk=False):
+    if htk:
+        return 700.0 * (10.0 ** (mel / 2595.0) - 1.0)
+    f_min, f_sp = 0.0, 200.0 / 3
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    if _is_tensor(mel):
+        lin = f_min + f_sp * mel
+        log = min_log_hz * paddle.exp(logstep * (mel - min_log_mel))
+        return paddle.where(mel >= min_log_mel, log, lin)
+    if mel >= min_log_mel:
+        return min_log_hz * math.exp(logstep * (mel - min_log_mel))
+    return f_min + f_sp * mel
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False,
+                    dtype="float32"):
+    low = hz_to_mel(float(f_min), htk)
+    high = hz_to_mel(float(f_max), htk)
+    mels = np.linspace(low, high, n_mels)
+    hz = np.array([mel_to_hz(float(m), htk) for m in mels], dtype=dtype)
+    return paddle.to_tensor(hz)
+
+
+def fft_frequencies(sr, n_fft, dtype="float32"):
+    return paddle.to_tensor(
+        np.linspace(0, sr / 2.0, 1 + n_fft // 2).astype(dtype))
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm="slaney", dtype="float32"):
+    """Triangular mel filterbank [n_mels, 1 + n_fft//2]."""
+    if f_max is None:
+        f_max = sr / 2.0
+    fftfreqs = np.linspace(0, sr / 2.0, 1 + n_fft // 2)
+    mel_f = mel_frequencies(n_mels + 2, f_min, f_max, htk).numpy()
+    fdiff = np.diff(mel_f)
+    ramps = mel_f[:, None] - fftfreqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = np.maximum(0.0, np.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2:n_mels + 2] - mel_f[:n_mels])
+        weights *= enorm[:, None]
+    return paddle.to_tensor(weights.astype(dtype))
+
+
+def power_to_db(spect, ref_value=1.0, amin=1e-10, top_db=80.0):
+    """10*log10(max(spect, amin)/ref), floored at max-top_db."""
+    if ref_value <= 0 or amin <= 0:
+        raise ValueError("ref_value and amin must be positive")
+    x = spect if _is_tensor(spect) else paddle.to_tensor(spect)
+    log_spec = 10.0 * paddle.log10(paddle.clip(x, min=amin))
+    log_spec = log_spec - 10.0 * math.log10(max(amin, ref_value))
+    if top_db is not None:
+        if top_db < 0:
+            raise ValueError("top_db must be non-negative")
+        # tensor max (no host sync) so the op stays jit-traceable
+        log_spec = paddle.maximum(log_spec, log_spec.max() - top_db)
+    return log_spec
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho", dtype="float32"):
+    """DCT-II basis [n_mels, n_mfcc] (reference create_dct :303)."""
+    n = np.arange(n_mels)
+    k = np.arange(n_mfcc)[:, None]
+    dct = np.cos(math.pi / n_mels * (n + 0.5) * k)  # [n_mfcc, n_mels]
+    if norm is None:
+        dct *= 2.0
+    else:
+        assert norm == "ortho"
+        dct[0] *= 1.0 / math.sqrt(2.0)
+        dct *= math.sqrt(2.0 / n_mels)
+    return paddle.to_tensor(dct.T.astype(dtype))
+
+
+def get_window(window, win_length, fftbins=True, dtype="float64"):
+    """reference audio/functional/window.py get_window subset."""
+    if isinstance(window, tuple):
+        name, args = window[0], window[1:]
+    else:
+        name, args = window, ()
+    sym = not fftbins
+    M = win_length + (0 if sym else 1)
+    n = np.arange(M)
+    if name in ("hann", "hanning"):
+        w = 0.5 - 0.5 * np.cos(2 * np.pi * n / (M - 1))
+    elif name == "hamming":
+        w = 0.54 - 0.46 * np.cos(2 * np.pi * n / (M - 1))
+    elif name == "blackman":
+        w = (0.42 - 0.5 * np.cos(2 * np.pi * n / (M - 1))
+             + 0.08 * np.cos(4 * np.pi * n / (M - 1)))
+    elif name in ("rect", "boxcar", "ones"):
+        w = np.ones(M)
+    elif name == "triang":
+        w = 1.0 - np.abs((n - (M - 1) / 2.0) / ((M - 1) / 2.0))
+    elif name == "bartlett":
+        w = np.bartlett(M)
+    elif name == "gaussian":
+        std = args[0] if args else 7.0
+        w = np.exp(-0.5 * ((n - (M - 1) / 2.0) / std) ** 2)
+    elif name == "exponential":
+        tau = args[0] if args else 1.0
+        w = np.exp(-np.abs(n - (M - 1) / 2.0) / tau)
+    else:
+        raise ValueError("unsupported window: %r" % (window,))
+    if not sym:
+        w = w[:-1]
+    return paddle.to_tensor(w.astype(dtype))

@@ -1,0 +1,211 @@
+"""paddle.geometric — graph learning ops.
+
+Parity: reference python/paddle/geometric/ (math.py segment_sum/mean/min/max
+backed by phi segment_pool kernels; message_passing/ send_u_recv :24,
+send_ue_recv, send_uv backed by graph_send_recv CUDA kernels; reindex.py;
+sampling/neighbors.py sample_neighbors). TPU-native: segment reductions are
+jax.ops.segment_* (XLA scatter-reduce, which TPU lowers onto the VPU);
+device ops require an explicit/derivable segment count because XLA needs
+static output shapes — `out_size` plays that role exactly as the reference's
+optional out_size arg does. Host-side graph preprocessing (reindex,
+neighbor sampling) runs in numpy like the reference's CPU kernels.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import primitive
+from ..core.tensor import Tensor
+
+__all__ = [
+    "send_u_recv", "send_ue_recv", "send_uv",
+    "segment_sum", "segment_mean", "segment_min", "segment_max",
+    "reindex_graph", "reindex_heter_graph", "sample_neighbors",
+]
+
+
+def _num_segments(segment_ids, out_size):
+    if out_size is not None:
+        return int(out_size)
+    ids = segment_ids.numpy() if isinstance(segment_ids, Tensor) \
+        else np.asarray(segment_ids)
+    return int(ids.max()) + 1 if ids.size else 0
+
+
+def _reduce(msgs, ids, num_out, reduce_op):
+    """Shared segment reduction. Empty segments yield 0 (the reference's
+    convention) — detected by count, which also works for integer dtypes
+    where the +/-inf sentinel check would not."""
+    ids = jnp.asarray(ids).astype(jnp.int32)
+    if reduce_op == "sum":
+        return jax.ops.segment_sum(msgs, ids, num_out)
+    cnt = jax.ops.segment_sum(jnp.ones(ids.shape, jnp.int32), ids, num_out)
+    cnt = cnt.reshape((-1,) + (1,) * (msgs.ndim - 1))
+    if reduce_op == "mean":
+        s = jax.ops.segment_sum(msgs, ids, num_out)
+        return s / jnp.maximum(cnt, 1).astype(s.dtype)
+    fn = {"min": jax.ops.segment_min, "max": jax.ops.segment_max}[reduce_op]
+    out = fn(msgs, ids, num_out)
+    return jnp.where(cnt > 0, out, jnp.zeros_like(out))
+
+
+def _segment_reduce(kind):
+    @primitive(name="segment_" + kind)
+    def op(data, segment_ids, num_segments):
+        return _reduce(data, segment_ids, num_segments, kind)
+
+    def api(data, segment_ids, name=None, out_size=None):
+        n = _num_segments(segment_ids, out_size)
+        return op(data, segment_ids, n)
+
+    api.__name__ = "segment_" + kind
+    api.__doc__ = ("reference python/paddle/geometric/math.py segment_%s"
+                   % kind)
+    return api
+
+
+segment_sum = _segment_reduce("sum")
+segment_mean = _segment_reduce("mean")
+segment_min = _segment_reduce("min")
+segment_max = _segment_reduce("max")
+
+
+@primitive
+def _gather_scatter(x, src_index, dst_index, num_out, reduce_op):
+    msgs = jnp.take(x, jnp.asarray(src_index).astype(jnp.int32), axis=0)
+    return _reduce(msgs, dst_index, num_out, reduce_op)
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """Gather x[src_index], reduce into dst_index slots (reference
+    message_passing/send_recv.py:24 send_u_recv; out_size=None infers
+    max(dst_index)+1 as the reference does)."""
+    return _gather_scatter(x, src_index, dst_index,
+                           _num_segments(dst_index, out_size), reduce_op)
+
+
+@primitive
+def _gather_scatter_ue(x, e, src_index, dst_index, num_out, message_op,
+                       reduce_op):
+    msgs = jnp.take(x, jnp.asarray(src_index).astype(jnp.int32), axis=0)
+    e = jnp.asarray(e)
+    while e.ndim < msgs.ndim:
+        e = e[..., None]
+    msgs = msgs + e if message_op == "add" else msgs * e
+    return _reduce(msgs, dst_index, num_out, reduce_op)
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """Node+edge message then reduce (reference send_ue_recv)."""
+    return _gather_scatter_ue(x, y, src_index, dst_index,
+                              _num_segments(dst_index, out_size),
+                              message_op, reduce_op)
+
+
+@primitive
+def _send_uv(x, y, src_index, dst_index, message_op):
+    src = jnp.asarray(src_index).astype(jnp.int32)
+    dst = jnp.asarray(dst_index).astype(jnp.int32)
+    xs = jnp.take(x, src, axis=0)
+    yd = jnp.take(y, dst, axis=0)
+    return {"add": xs + yd, "sub": xs - yd, "mul": xs * yd,
+            "div": xs / yd}[message_op]
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Per-edge message from both endpoints (reference send_uv)."""
+    return _send_uv(x, y, src_index, dst_index, message_op)
+
+
+# ---- host-side graph preprocessing (reference CPU kernels) -----------------
+
+def _to_np(x):
+    return x.numpy() if isinstance(x, Tensor) else np.asarray(x)
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """Compress global node ids to local contiguous ids (reference
+    geometric/reindex.py reindex_graph; phi cpu/graph_reindex_kernel).
+
+    Returns (reindex_src, reindex_dst, out_nodes): out_nodes = unique nodes
+    in [x ++ neighbors] with x first, in first-seen order; reindex_src maps
+    each neighbor to its local id; reindex_dst repeats each x-node's local
+    id `count` times.
+    """
+    import paddle_tpu as paddle
+
+    xs, nb, cnt = _to_np(x), _to_np(neighbors), _to_np(count)
+    order = {}
+    for v in xs.tolist():
+        order.setdefault(int(v), len(order))
+    for v in nb.tolist():
+        order.setdefault(int(v), len(order))
+    out_nodes = np.fromiter(order.keys(), dtype=xs.dtype, count=len(order))
+    reindex_src = np.array([order[int(v)] for v in nb.tolist()],
+                           dtype=xs.dtype)
+    reindex_dst = np.repeat(np.arange(len(xs), dtype=xs.dtype), cnt)
+    return (paddle.to_tensor(reindex_src), paddle.to_tensor(reindex_dst),
+            paddle.to_tensor(out_nodes))
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """Heterogeneous variant: neighbors/count are lists per edge type."""
+    import paddle_tpu as paddle
+
+    xs = _to_np(x)
+    nbs = [_to_np(n) for n in neighbors]
+    cnts = [_to_np(c) for c in count]
+    order = {}
+    for v in xs.tolist():
+        order.setdefault(int(v), len(order))
+    for nb in nbs:
+        for v in nb.tolist():
+            order.setdefault(int(v), len(order))
+    out_nodes = np.fromiter(order.keys(), dtype=xs.dtype, count=len(order))
+    reindex_src = np.concatenate(
+        [[order[int(v)] for v in nb.tolist()] for nb in nbs]).astype(xs.dtype)
+    reindex_dst = np.concatenate(
+        [np.repeat(np.arange(len(xs), dtype=xs.dtype), c) for c in cnts])
+    return (paddle.to_tensor(reindex_src), paddle.to_tensor(reindex_dst),
+            paddle.to_tensor(out_nodes))
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
+                     return_eids=False, perm_buffer=None, name=None):
+    """Uniform neighbor sampling over a CSC graph (reference
+    geometric/sampling/neighbors.py; phi cpu/graph_sample_neighbors_kernel).
+
+    Returns (out_neighbors, out_count[, out_eids]).
+    """
+    import paddle_tpu as paddle
+
+    rown, cp, nodes = _to_np(row), _to_np(colptr), _to_np(input_nodes)
+    eid = _to_np(eids) if eids is not None else None
+    rng = np.random.RandomState()
+    outs, counts, out_eids = [], [], []
+    for v in nodes.tolist():
+        beg, end = int(cp[v]), int(cp[v + 1])
+        deg = end - beg
+        if sample_size < 0 or deg <= sample_size:
+            idx = np.arange(beg, end)
+        else:
+            idx = beg + rng.choice(deg, size=sample_size, replace=False)
+        outs.append(rown[idx])
+        counts.append(len(idx))
+        if return_eids and eid is not None:
+            out_eids.append(eid[idx])
+    neighbors = (np.concatenate(outs) if outs
+                 else np.empty((0,), rown.dtype))
+    count = np.asarray(counts, dtype=cp.dtype)
+    if return_eids:
+        e = (np.concatenate(out_eids) if out_eids
+             else np.empty((0,), rown.dtype))
+        return (paddle.to_tensor(neighbors), paddle.to_tensor(count),
+                paddle.to_tensor(e))
+    return paddle.to_tensor(neighbors), paddle.to_tensor(count)

@@ -1,0 +1,20 @@
+"""paddle.text (reference python/paddle/text/__init__.py: ViterbiDecoder /
+viterbi_decode + 7 NLP datasets). Zero-egress: datasets read local files
+when given paths and otherwise generate deterministic synthetic corpora
+with the reference's shapes/dtypes (same pattern as vision/audio).
+"""
+from .viterbi_decode import ViterbiDecoder, viterbi_decode  # noqa: F401
+from .datasets import (  # noqa: F401
+    Conll05st,
+    Imdb,
+    Imikolov,
+    Movielens,
+    UCIHousing,
+    WMT14,
+    WMT16,
+)
+
+__all__ = [
+    "Conll05st", "Imdb", "Imikolov", "Movielens", "UCIHousing",
+    "WMT14", "WMT16", "ViterbiDecoder", "viterbi_decode",
+]

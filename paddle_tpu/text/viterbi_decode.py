@@ -1,0 +1,76 @@
+"""Viterbi decoding (reference python/paddle/text/viterbi_decode.py:25 and
+the phi viterbi_decode kernel). Forward max-sum runs as a vectorized
+host-side DP over [B, T, N] emissions — decode is a post-processing step in
+the reference too (CPU kernel for CRF inference), not a training hot path.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+
+
+def _np(x):
+    return x.numpy() if isinstance(x, Tensor) else np.asarray(x)
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    """Returns (scores [B], paths [B, max_len] int64).
+
+    With include_bos_eos_tag=True the transition matrix's last row/column
+    act as the start tag and its second-to-last row/column as the stop tag
+    (reference semantics).
+    """
+    import paddle_tpu as paddle
+
+    pots = _np(potentials).astype(np.float64)  # [B, T, N]
+    trans = _np(transition_params).astype(np.float64)  # [N, N]
+    lens = _np(lengths).astype(np.int64)  # [B]
+    B, T, N = pots.shape
+    max_len = int(lens.max()) if B else 0
+
+    alpha = pots[:, 0].copy()  # [B, N]
+    if include_bos_eos_tag:
+        alpha += trans[-1][None, :]  # start -> tag
+    history = np.zeros((max(max_len - 1, 0), B, N), np.int64)
+    for t in range(1, max_len):
+        # scores[b, i, j] = alpha[b, i] + trans[i, j]
+        scores = alpha[:, :, None] + trans[None, :, :]
+        best_prev = scores.argmax(axis=1)  # [B, N]
+        new_alpha = scores.max(axis=1) + pots[:, t]
+        active = (t < lens)[:, None]
+        history[t - 1] = np.where(active, best_prev,
+                                  np.arange(N)[None, :])
+        alpha = np.where(active, new_alpha, alpha)
+    final = alpha.copy()
+    if include_bos_eos_tag:
+        final += trans[:, -2][None, :]  # tag -> stop
+    scores = final.max(axis=1)
+    last_tag = final.argmax(axis=1)  # [B]
+
+    paths = np.zeros((B, max_len), np.int64)
+    if max_len:
+        for b in range(B):
+            L = int(lens[b])
+            tag = int(last_tag[b])
+            paths[b, L - 1] = tag
+            for t in range(L - 2, -1, -1):
+                tag = int(history[t, b, tag])
+                paths[b, t] = tag
+    return (paddle.to_tensor(scores.astype(_np(potentials).dtype)),
+            paddle.to_tensor(paths))
+
+
+class ViterbiDecoder(Layer):
+    """reference text/viterbi_decode.py ViterbiDecoder layer."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

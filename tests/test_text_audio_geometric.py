@@ -1,0 +1,298 @@
+"""text / audio / geometric domain tests.
+
+Oracles: numpy (segment ops, brute-force viterbi), librosa-style closed
+forms for mel/DCT (reference unittests/test_audio_functions.py compares
+against librosa; here the oracle is the direct formula).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestSegmentOps:
+    ids = np.array([0, 0, 1, 2, 2, 2], dtype=np.int64)
+    x = np.arange(12, dtype=np.float32).reshape(6, 2)
+
+    def test_segment_sum(self):
+        out = paddle.geometric.segment_sum(
+            paddle.to_tensor(self.x), paddle.to_tensor(self.ids))
+        ref = np.stack([self.x[:2].sum(0), self.x[2:3].sum(0),
+                        self.x[3:].sum(0)])
+        np.testing.assert_allclose(out.numpy(), ref)
+
+    def test_segment_mean_min_max(self):
+        xt, it = paddle.to_tensor(self.x), paddle.to_tensor(self.ids)
+        np.testing.assert_allclose(
+            paddle.geometric.segment_mean(xt, it).numpy(),
+            np.stack([self.x[:2].mean(0), self.x[2:3].mean(0),
+                      self.x[3:].mean(0)]))
+        np.testing.assert_allclose(
+            paddle.geometric.segment_min(xt, it).numpy(),
+            np.stack([self.x[:2].min(0), self.x[2:3].min(0),
+                      self.x[3:].min(0)]))
+        np.testing.assert_allclose(
+            paddle.geometric.segment_max(xt, it).numpy(),
+            np.stack([self.x[:2].max(0), self.x[2:3].max(0),
+                      self.x[3:].max(0)]))
+
+    def test_segment_min_int_empty_segments(self):
+        # empty segments must yield 0, not the iinfo sentinel
+        out = paddle.geometric.segment_min(
+            paddle.to_tensor(np.array([3, 1], dtype=np.int32)),
+            paddle.to_tensor(np.array([0, 0], dtype=np.int64)), out_size=3)
+        assert out.numpy().tolist() == [1, 0, 0]
+        out = paddle.geometric.segment_max(
+            paddle.to_tensor(np.array([3, 1], dtype=np.int32)),
+            paddle.to_tensor(np.array([0, 0], dtype=np.int64)), out_size=3)
+        assert out.numpy().tolist() == [3, 0, 0]
+
+    def test_segment_sum_grad(self):
+        xt = paddle.to_tensor(self.x)
+        xt.stop_gradient = False
+        out = paddle.geometric.segment_sum(xt, paddle.to_tensor(self.ids))
+        out.sum().backward()
+        np.testing.assert_allclose(xt.grad.numpy(), np.ones_like(self.x))
+
+
+class TestMessagePassing:
+    # graph: 0->1, 0->2, 1->2
+    src = np.array([0, 0, 1], dtype=np.int64)
+    dst = np.array([1, 2, 2], dtype=np.int64)
+    x = np.array([[1., 2.], [3., 4.], [5., 6.]], dtype=np.float32)
+
+    def test_send_u_recv_sum(self):
+        out = paddle.geometric.send_u_recv(
+            paddle.to_tensor(self.x), paddle.to_tensor(self.src),
+            paddle.to_tensor(self.dst), reduce_op="sum", out_size=3)
+        ref = np.array([[0., 0.], [1., 2.], [4., 6.]], dtype=np.float32)
+        np.testing.assert_allclose(out.numpy(), ref)
+
+    def test_send_u_recv_mean_infers_size(self):
+        out = paddle.geometric.send_u_recv(
+            paddle.to_tensor(self.x), paddle.to_tensor(self.src),
+            paddle.to_tensor(self.dst), reduce_op="mean")
+        assert out.shape[0] == 3  # max(dst)+1
+        np.testing.assert_allclose(out.numpy()[2], [2., 3.])
+
+    def test_send_ue_recv(self):
+        e = np.array([10., 20., 30.], dtype=np.float32)
+        out = paddle.geometric.send_ue_recv(
+            paddle.to_tensor(self.x), paddle.to_tensor(e),
+            paddle.to_tensor(self.src), paddle.to_tensor(self.dst),
+            message_op="add", reduce_op="sum", out_size=3)
+        # dst2: (x0 + 20) + (x1 + 30) = [1+20+3+30, 2+20+4+30]
+        np.testing.assert_allclose(out.numpy()[2], [54., 56.])
+
+    def test_send_uv(self):
+        out = paddle.geometric.send_uv(
+            paddle.to_tensor(self.x), paddle.to_tensor(self.x),
+            paddle.to_tensor(self.src), paddle.to_tensor(self.dst),
+            message_op="mul")
+        # edge 0: x[0] * x[1]
+        np.testing.assert_allclose(out.numpy()[0], [3., 8.])
+
+    def test_reindex_graph(self):
+        x = paddle.to_tensor(np.array([10, 20], dtype=np.int64))
+        neighbors = paddle.to_tensor(
+            np.array([30, 10, 40, 20], dtype=np.int64))
+        count = paddle.to_tensor(np.array([2, 2], dtype=np.int64))
+        src, dst, nodes = paddle.geometric.reindex_graph(x, neighbors, count)
+        np.testing.assert_array_equal(nodes.numpy(), [10, 20, 30, 40])
+        np.testing.assert_array_equal(src.numpy(), [2, 0, 3, 1])
+        np.testing.assert_array_equal(dst.numpy(), [0, 0, 1, 1])
+
+    def test_sample_neighbors(self):
+        # CSC: node0 neighbors [1,2,3], node1 neighbors [0]
+        row = paddle.to_tensor(np.array([1, 2, 3, 0], dtype=np.int64))
+        colptr = paddle.to_tensor(np.array([0, 3, 4, 4, 4], dtype=np.int64))
+        nodes = paddle.to_tensor(np.array([0, 1], dtype=np.int64))
+        nbr, cnt = paddle.geometric.sample_neighbors(
+            row, colptr, nodes, sample_size=2)
+        assert cnt.numpy().tolist() == [2, 1]
+        assert set(nbr.numpy()[:2]).issubset({1, 2, 3})
+        assert nbr.numpy()[2] == 0
+
+
+class TestAudioFunctional:
+    def test_hz_mel_roundtrip(self):
+        import paddle_tpu.audio.functional as AF
+
+        for htk in (False, True):
+            for f in (60.0, 440.0, 8000.0):
+                mel = AF.hz_to_mel(f, htk)
+                back = AF.mel_to_hz(mel, htk)
+                assert abs(back - f) / f < 1e-6, (f, htk)
+        # tensor path matches scalar path
+        freqs = paddle.to_tensor(np.array([60., 440., 8000.], np.float32))
+        mels = AF.hz_to_mel(freqs, False).numpy()
+        ref = [AF.hz_to_mel(float(f), False) for f in (60., 440., 8000.)]
+        np.testing.assert_allclose(mels, ref, rtol=1e-5)
+
+    def test_fbank_matrix(self):
+        import paddle_tpu.audio.functional as AF
+
+        fb = AF.compute_fbank_matrix(16000, 512, n_mels=40).numpy()
+        assert fb.shape == (40, 257)
+        assert np.all(fb >= 0)
+        assert np.all(fb.sum(axis=1) > 0)  # every filter hits some bin
+
+    def test_power_to_db(self):
+        import paddle_tpu.audio.functional as AF
+
+        x = paddle.to_tensor(np.array([1.0, 10.0, 100.0], np.float32))
+        db = AF.power_to_db(x, top_db=None).numpy()
+        np.testing.assert_allclose(db, [0.0, 10.0, 20.0], atol=1e-4)
+
+    def test_power_to_db_top_db_jits(self):
+        import paddle_tpu.audio.functional as AF
+        from paddle_tpu import jit
+
+        x = paddle.to_tensor(np.array([1.0, 10.0, 1e-6], np.float32))
+        eager = AF.power_to_db(x, top_db=10.0).numpy()
+        fn = jit.to_static(lambda t: AF.power_to_db(t, top_db=10.0))
+        np.testing.assert_allclose(fn(x).numpy(), eager, rtol=1e-5,
+                                   atol=1e-5)
+        np.testing.assert_allclose(eager, [0.0, 10.0, 0.0], atol=1e-4)
+
+    def test_create_dct_ortho(self):
+        import paddle_tpu.audio.functional as AF
+
+        d = AF.create_dct(13, 40).numpy()  # [40, 13]
+        # orthonormal columns
+        np.testing.assert_allclose(d.T @ d, np.eye(13), atol=1e-5)
+
+    def test_get_window(self):
+        import paddle_tpu.audio.functional as AF
+
+        w = AF.get_window("hann", 16).numpy()
+        assert len(w) == 16 and abs(w[0]) < 1e-12
+
+
+class TestAudioFeatures:
+    wav = np.sin(2 * np.pi * 440 * np.arange(4000) / 16000).astype(
+        np.float32)
+
+    def test_spectrogram_peak(self):
+        from paddle_tpu.audio.features import Spectrogram
+
+        sp = Spectrogram(n_fft=512, hop_length=256)
+        out = sp(paddle.to_tensor(self.wav[None, :]))
+        assert out.shape[1] == 257
+        peak_bin = int(out.numpy()[0].mean(axis=1).argmax())
+        expected = round(440 * 512 / 16000)
+        assert abs(peak_bin - expected) <= 1
+
+    def test_mel_and_mfcc_shapes(self):
+        from paddle_tpu.audio.features import (LogMelSpectrogram, MFCC,
+                                               MelSpectrogram)
+
+        x = paddle.to_tensor(self.wav[None, :])
+        mel = MelSpectrogram(sr=16000, n_fft=512, hop_length=256, n_mels=40)
+        out = mel(x)
+        assert out.shape[1] == 40
+        lm = LogMelSpectrogram(sr=16000, n_fft=512, hop_length=256,
+                               n_mels=40)(x)
+        assert lm.shape[1] == 40
+        mf = MFCC(sr=16000, n_mfcc=13, n_fft=512, hop_length=256,
+                  n_mels=40)(x)
+        assert mf.shape[1] == 13
+
+    def test_wav_save_load_roundtrip(self, tmp_path):
+        import paddle_tpu.audio as audio
+
+        path = str(tmp_path / "t.wav")
+        audio.save(path, paddle.to_tensor(self.wav[None, :]), 16000)
+        info = audio.info(path)
+        assert info.sample_rate == 16000
+        assert info.num_samples == len(self.wav)
+        wav2, sr = audio.load(path)
+        assert sr == 16000
+        np.testing.assert_allclose(wav2.numpy()[0], self.wav, atol=1e-3)
+
+    def test_datasets(self):
+        from paddle_tpu.audio.datasets import ESC50, TESS
+
+        ds = TESS(mode="train", feat_type="raw", size=4, sample_rate=8000,
+                  duration=0.25)
+        w, label = ds[0]
+        assert w.shape == (2000,) and 0 <= int(label) < 7
+        ds2 = ESC50(mode="dev", feat_type="mfcc", size=2, sample_rate=8000,
+                    duration=0.25, n_mfcc=13, n_fft=256, hop_length=128,
+                    n_mels=24)
+        feat, label = ds2[0]
+        assert feat.shape[0] == 13
+
+
+class TestViterbi:
+    def _brute_force(self, pots, trans, length, bos_eos):
+        import itertools
+
+        N = pots.shape[-1]
+        best, best_path = -np.inf, None
+        for path in itertools.product(range(N), repeat=length):
+            s = pots[0, path[0]]
+            if bos_eos:
+                s += trans[-1, path[0]]
+            for t in range(1, length):
+                s += trans[path[t - 1], path[t]] + pots[t, path[t]]
+            if bos_eos:
+                s += trans[path[-1], -2]
+            if s > best:
+                best, best_path = s, path
+        return best, list(best_path)
+
+    @pytest.mark.parametrize("bos_eos", [False, True])
+    def test_matches_brute_force(self, bos_eos):
+        rng = np.random.RandomState(0)
+        B, T, N = 3, 5, 4
+        pots = rng.randn(B, T, N).astype(np.float32)
+        trans = rng.randn(N, N).astype(np.float32)
+        lens = np.array([5, 3, 1], dtype=np.int64)
+        scores, paths = paddle.text.viterbi_decode(
+            paddle.to_tensor(pots), paddle.to_tensor(trans),
+            paddle.to_tensor(lens), include_bos_eos_tag=bos_eos)
+        for b in range(B):
+            ref_s, ref_p = self._brute_force(pots[b], trans, int(lens[b]),
+                                             bos_eos)
+            assert abs(float(scores.numpy()[b]) - ref_s) < 1e-4
+            assert paths.numpy()[b, :int(lens[b])].tolist() == ref_p
+
+    def test_decoder_layer(self):
+        rng = np.random.RandomState(1)
+        trans = paddle.to_tensor(rng.randn(3, 3).astype(np.float32))
+        dec = paddle.text.ViterbiDecoder(trans, include_bos_eos_tag=False)
+        pots = paddle.to_tensor(rng.randn(2, 4, 3).astype(np.float32))
+        lens = paddle.to_tensor(np.array([4, 2], dtype=np.int64))
+        scores, paths = dec(pots, lens)
+        assert scores.shape == [2] and paths.shape == [2, 4]
+
+
+class TestTextDatasets:
+    def test_imdb_imikolov(self):
+        ds = paddle.text.Imdb(mode="train", size=8)
+        doc, label = ds[0]
+        assert doc.dtype == np.int64 and int(label) in (0, 1)
+        ng = paddle.text.Imikolov(mode="train", window_size=3, size=4)
+        tup = ng[0]
+        assert len(tup) == 3
+
+    def test_uci_housing(self):
+        tr = paddle.text.UCIHousing(mode="train")
+        te = paddle.text.UCIHousing(mode="test")
+        x, y = tr[0]
+        assert x.shape == (13,) and y.shape == (1,)
+        assert len(tr) > len(te)
+
+    def test_conll_movielens_wmt(self):
+        c = paddle.text.Conll05st(size=2)
+        sample = c[0]
+        assert len(sample) == 9
+        assert all(len(f) == len(sample[0]) for f in sample)
+        m = paddle.text.Movielens(size=32)
+        fields = m[0]
+        assert len(fields) == 8 and fields[-1].dtype == np.float32
+        w = paddle.text.WMT14(size=4)
+        src, trg_in, trg_next = w[0]
+        assert trg_in[0] == 0 and trg_next[-1] == 1
+        assert len(trg_in) == len(trg_next)
