@@ -1,0 +1,174 @@
+// Native instruction interpreter — the TPU build's analog of the
+// reference's new executor (/root/reference/paddle/fluid/framework/
+// new_executor/interpretercore.cc: dependency_builder computes an
+// instruction DAG, ExecuteInstructionList pushes ready instructions into an
+// async workqueue, each completion decrements successor dependency counts).
+//
+// Here an "instruction" is an opaque id whose body is a host callback
+// (Python closure dispatching an XLA op / compiled executable). The C++
+// side owns: the DAG, the ready queue, the worker pool, and completion
+// bookkeeping. Whole-graph jit remains the fast path (one XLA module, no
+// per-op scheduling at all) — this runtime serves the eager replay path
+// and multi-module pipelines, where the reference also uses its
+// interpreter.
+//
+// C ABI (ctypes):
+//   pt_interp_create(n)                       -> handle (>=0)
+//   pt_interp_add_dep(h, before, after)       -> 0
+//   pt_interp_run(h, cb, ctx, num_threads)    -> 0 ok, -1 bad handle,
+//        -2 cycle/unreached, -3 callback error (first error id via
+//        pt_interp_last_error)
+//   pt_interp_last_error(h)                   -> instr id of first failure
+//   pt_interp_executed(h)                     -> #instructions completed
+//   pt_interp_destroy(h)
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <map>
+#include <mutex>
+#include <queue>
+#include <thread>
+#include <vector>
+
+namespace {
+
+typedef int (*InstrFn)(void* ctx, int64_t instr_id);
+
+struct Interp {
+  int n = 0;
+  std::vector<std::vector<int>> succ;
+  std::vector<int> indegree;
+  // run state
+  std::mutex mu;
+  std::condition_variable cv;
+  std::queue<int> ready;
+  std::vector<int> deps;
+  int executed = 0;
+  int inflight = 0;
+  int64_t first_error = -1;
+  bool failed = false;
+};
+
+std::mutex g_mu;
+std::map<int, Interp*> g_interps;
+int g_next = 1;
+
+Interp* find(int h) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  auto it = g_interps.find(h);
+  return it == g_interps.end() ? nullptr : it->second;
+}
+
+void worker(Interp* in, InstrFn cb, void* ctx) {
+  for (;;) {
+    int id;
+    {
+      std::unique_lock<std::mutex> lk(in->mu);
+      in->cv.wait(lk, [&] {
+        return !in->ready.empty() || in->failed ||
+               (in->inflight == 0 && in->ready.empty());
+      });
+      if (in->failed) return;
+      if (in->ready.empty()) return;  // drained: done or unreachable rest
+      id = in->ready.front();
+      in->ready.pop();
+      in->inflight++;
+    }
+    int rc = cb(ctx, id);
+    {
+      std::unique_lock<std::mutex> lk(in->mu);
+      in->inflight--;
+      if (rc != 0) {
+        if (in->first_error < 0) in->first_error = id;
+        in->failed = true;
+        in->cv.notify_all();
+        return;
+      }
+      in->executed++;
+      for (int s : in->succ[id]) {
+        if (--in->deps[s] == 0) in->ready.push(s);
+      }
+      in->cv.notify_all();
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+int pt_interp_create(int n) {
+  if (n < 0) return -1;
+  auto* in = new Interp();
+  in->n = n;
+  in->succ.resize(n);
+  in->indegree.assign(n, 0);
+  std::lock_guard<std::mutex> lk(g_mu);
+  int h = g_next++;
+  g_interps[h] = in;
+  return h;
+}
+
+int pt_interp_add_dep(int h, int before, int after) {
+  Interp* in = find(h);
+  if (!in || before < 0 || after < 0 || before >= in->n || after >= in->n)
+    return -1;
+  in->succ[before].push_back(after);
+  in->indegree[after]++;
+  return 0;
+}
+
+int pt_interp_run(int h, InstrFn cb, void* ctx, int num_threads) {
+  Interp* in = find(h);
+  if (!in) return -1;
+  if (num_threads < 1) num_threads = 1;
+  {
+    std::lock_guard<std::mutex> lk(in->mu);
+    in->deps = in->indegree;
+    in->executed = 0;
+    in->inflight = 0;
+    in->first_error = -1;
+    in->failed = false;
+    while (!in->ready.empty()) in->ready.pop();
+    for (int i = 0; i < in->n; i++)
+      if (in->deps[i] == 0) in->ready.push(i);
+  }
+  if (num_threads == 1) {
+    // inline fast path: no thread handoff per instruction
+    worker(in, cb, ctx);
+  } else {
+    std::vector<std::thread> pool;
+    pool.reserve(num_threads);
+    for (int t = 0; t < num_threads; t++)
+      pool.emplace_back(worker, in, cb, ctx);
+    for (auto& th : pool) th.join();
+  }
+  std::lock_guard<std::mutex> lk(in->mu);
+  if (in->failed) return -3;
+  if (in->executed != in->n) return -2;  // cycle or disconnected deps
+  return 0;
+}
+
+int64_t pt_interp_last_error(int h) {
+  Interp* in = find(h);
+  return in ? in->first_error : -1;
+}
+
+int pt_interp_executed(int h) {
+  Interp* in = find(h);
+  return in ? in->executed : -1;
+}
+
+void pt_interp_destroy(int h) {
+  Interp* in = nullptr;
+  {
+    std::lock_guard<std::mutex> lk(g_mu);
+    auto it = g_interps.find(h);
+    if (it == g_interps.end()) return;
+    in = it->second;
+    g_interps.erase(it);
+  }
+  delete in;
+}
+
+}  // extern "C"

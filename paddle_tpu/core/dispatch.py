@@ -74,7 +74,8 @@ class enable_grad:
 # Global registry: op name -> raw (pure-JAX) implementation. The analog of the
 # reference's OpInfoMap; used by OpTest and the profiler, and lets the static
 # capture layer look ops up by name.
-OPS = {}
+OPS = {}       # op name -> raw pure-JAX kernel body
+WRAPPERS = {}  # op name -> eager dispatch wrapper (autograd-aware)
 
 # Static-graph recorder hook. When paddle_tpu.static is building a Program
 # (program_guard + enable_static), it installs a callable here; every
@@ -186,9 +187,11 @@ def primitive(fn=None, *, name=None, nondiff=False):
                                  tuple(outs), is_multi[0])
             return outs if is_multi[0] else outs[0]
 
-        # stash for introspection
+        # stash for introspection + the generated _C_ops flat namespace
         wrapper.op_name = op_name
         wrapper.raw_fn = raw_fn
+        wrapper.nondiff = nondiff
+        WRAPPERS[op_name] = wrapper
         return wrapper
 
     if fn is not None:

@@ -23,6 +23,8 @@ _DEFAULTS = {
     "FLAGS_eager_delete_tensor_gb": 0.0,  # accepted, no-op under XLA GC
     "FLAGS_allocator_strategy": "xla",  # buffer assignment is XLA's
     "FLAGS_fraction_of_gpu_memory_to_use": 1.0,  # accepted for compat
+    # executor (reference new_executor flags family)
+    "FLAGS_use_native_interpreter": True,
     # distributed
     "FLAGS_distributed_barrier_timeout_s": 600,
     # logging

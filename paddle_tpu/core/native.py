@@ -27,8 +27,9 @@ _CSRC = os.path.join(_REPO_ROOT, "csrc")
 
 
 def _build():
-    srcs = [os.path.join(_CSRC, f)
-            for f in ("trace.cc", "store.cc", "feed.cc", "stats.cc")]
+    # single source of truth: every .cc in csrc/ (mirrors csrc/Makefile)
+    srcs = sorted(os.path.join(_CSRC, f) for f in os.listdir(_CSRC)
+                  if f.endswith(".cc"))
     os.makedirs(os.path.dirname(_LIB_PATH), exist_ok=True)
     cmd = ["g++", "-O2", "-std=c++17", "-fPIC", "-Wall", "-pthread",
            "-shared", "-o", _LIB_PATH] + srcs
@@ -94,6 +95,25 @@ def _declare(lib):
     lib.pt_feed_write_record.argtypes = [c.c_void_p, c.c_char_p, c.c_int]
     lib.pt_feed_write_record.restype = c.c_int
     lib.pt_feed_write_close.argtypes = [c.c_void_p]
+    # interp.cc — guarded so a prebuilt legacy .so (no interp symbols)
+    # degrades to interpreter-unavailable instead of breaking all of native
+    try:
+        lib.pt_interp_create.argtypes = [c.c_int]
+        lib.pt_interp_create.restype = c.c_int
+        lib.pt_interp_add_dep.argtypes = [c.c_int, c.c_int, c.c_int]
+        lib.pt_interp_add_dep.restype = c.c_int
+        INSTR_FN = c.CFUNCTYPE(c.c_int, c.c_void_p, c.c_int64)
+        lib.pt_interp_run.argtypes = [c.c_int, INSTR_FN, c.c_void_p,
+                                      c.c_int]
+        lib.pt_interp_run.restype = c.c_int
+        lib.pt_interp_last_error.argtypes = [c.c_int]
+        lib.pt_interp_last_error.restype = c.c_int64
+        lib.pt_interp_executed.argtypes = [c.c_int]
+        lib.pt_interp_executed.restype = c.c_int
+        lib.pt_interp_destroy.argtypes = [c.c_int]
+        lib._INSTR_FN = INSTR_FN
+    except AttributeError:
+        pass
     # stats.cc
     lib.pt_stat_add.argtypes = [c.c_char_p, c.c_int64]
     lib.pt_stat_get.argtypes = [c.c_char_p]

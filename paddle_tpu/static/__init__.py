@@ -109,6 +109,7 @@ class Program:
         p._grad_map = dict(self._grad_map)
         p._run_cache = {}
         p._analyze_cache = None
+        p.__dict__.pop("_native_interp", None)  # DAG is per-program
         if for_test:
             p._train_spec = None
         return p
@@ -281,16 +282,40 @@ class _ReplayContext:
 
 
 def _run_tape(program):
+    """Un-jitted replay. Prefers the native C++ interpreter (csrc/interp.cc
+    — dependency-counted workqueue, the reference InterpreterCore analog);
+    falls back to sequential Python replay if the native core is
+    unavailable. Toggle with FLAGS_use_native_interpreter."""
+    from ..core import flags as _flags
+
+    use_native = _flags.get_flags().get("FLAGS_use_native_interpreter", True)
+    if use_native and program.tape:
+        try:
+            interp = program._native_interp
+        except AttributeError:
+            interp = None
+        if interp is None or interp._version != program.version:
+            try:
+                from ..core.interpreter import NativeInterpreter
+
+                interp = NativeInterpreter(program)
+                interp._version = program.version
+                program._native_interp = interp
+            except Exception:
+                if _flags.get_flags().get("FLAGS_v", 0) > 0:
+                    import traceback
+
+                    traceback.print_exc()
+                interp = None
+        if interp is not None:
+            interp.run()
+            return
+    from ..core.interpreter import replay_record
+
     _dispatch._enter_primitive()
     try:
         for rec in program.tape:
-            plain = [l._value if isinstance(l, Tensor) else l
-                     for l in rec.leaves]
-            a2, k2 = jax.tree_util.tree_unflatten(rec.treedef, plain)
-            out = rec.raw_fn(*a2, **k2)
-            outs = out if isinstance(out, (tuple, list)) else (out,)
-            for t, v in zip(rec.outs, outs):
-                t._value = v
+            replay_record(rec)
     finally:
         _dispatch._exit_primitive()
 
@@ -315,7 +340,9 @@ class Executor:
         self.place = place
 
     def run(self, program=None, feed=None, fetch_list=None,
-            return_numpy=True):
+            return_numpy=True, use_program_cache=True):
+        # use_program_cache: accepted for reference API parity; programs
+        # are always cached per (version, feed signature) here.
         if isinstance(program, InferenceProgram):
             feed = feed or {}
             outs = program.run(*[feed[n] for n in program.feed_names])
