@@ -1,0 +1,108 @@
+"""DenseNet (reference python/paddle/vision/models/densenet.py)."""
+from __future__ import annotations
+
+import paddle_tpu as paddle
+from ... import nn
+
+_CFG = {
+    121: (64, 32, [6, 12, 24, 16]),
+    161: (96, 48, [6, 12, 36, 24]),
+    169: (64, 32, [6, 12, 32, 32]),
+    201: (64, 32, [6, 12, 48, 32]),
+    264: (64, 32, [6, 12, 64, 48]),
+}
+
+
+class _DenseLayer(nn.Layer):
+    def __init__(self, num_input, growth_rate, bn_size, dropout):
+        super().__init__()
+        self.bn1 = nn.BatchNorm2D(num_input)
+        self.conv1 = nn.Conv2D(num_input, bn_size * growth_rate, 1,
+                               bias_attr=False)
+        self.bn2 = nn.BatchNorm2D(bn_size * growth_rate)
+        self.conv2 = nn.Conv2D(bn_size * growth_rate, growth_rate, 3,
+                               padding=1, bias_attr=False)
+        self.relu = nn.ReLU()
+        self.dropout = nn.Dropout(dropout) if dropout else None
+
+    def forward(self, x):
+        out = self.conv1(self.relu(self.bn1(x)))
+        out = self.conv2(self.relu(self.bn2(out)))
+        if self.dropout is not None:
+            out = self.dropout(out)
+        return paddle.concat([x, out], axis=1)
+
+
+class _Transition(nn.Layer):
+    def __init__(self, num_input, num_output):
+        super().__init__()
+        self.bn = nn.BatchNorm2D(num_input)
+        self.conv = nn.Conv2D(num_input, num_output, 1, bias_attr=False)
+        self.relu = nn.ReLU()
+        self.pool = nn.AvgPool2D(2, stride=2)
+
+    def forward(self, x):
+        return self.pool(self.conv(self.relu(self.bn(x))))
+
+
+class DenseNet(nn.Layer):
+    def __init__(self, layers=121, bn_size=4, dropout=0.0,
+                 num_classes=1000, with_pool=True):
+        super().__init__()
+        assert layers in _CFG, "supported layers: %s" % list(_CFG)
+        num_init, growth_rate, blocks = _CFG[layers]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, num_init, 7, stride=2, padding=3, bias_attr=False),
+            nn.BatchNorm2D(num_init), nn.ReLU(),
+            nn.MaxPool2D(3, stride=2, padding=1),
+        )
+        features = []
+        ch = num_init
+        for i, n in enumerate(blocks):
+            for _ in range(n):
+                features.append(_DenseLayer(ch, growth_rate, bn_size,
+                                            dropout))
+                ch += growth_rate
+            if i != len(blocks) - 1:
+                features.append(_Transition(ch, ch // 2))
+                ch //= 2
+        features += [nn.BatchNorm2D(ch), nn.ReLU()]
+        self.features = nn.Sequential(*features)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.classifier = nn.Linear(ch, num_classes)
+
+    def forward(self, x):
+        x = self.features(self.stem(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(x.flatten(1))
+        return x
+
+
+def _densenet(layers, **kwargs):
+    return DenseNet(layers=layers, **kwargs)
+
+
+def densenet121(pretrained=False, **kwargs):
+    return _densenet(121, **kwargs)
+
+
+def densenet161(pretrained=False, **kwargs):
+    return _densenet(161, **kwargs)
+
+
+def densenet169(pretrained=False, **kwargs):
+    return _densenet(169, **kwargs)
+
+
+def densenet201(pretrained=False, **kwargs):
+    return _densenet(201, **kwargs)
+
+
+def densenet264(pretrained=False, **kwargs):
+    return _densenet(264, **kwargs)

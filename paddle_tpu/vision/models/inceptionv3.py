@@ -1,0 +1,151 @@
+"""Inception v3 (reference python/paddle/vision/models/inceptionv3.py).
+Standard 299x299 topology: stem -> 3x InceptionA -> reduction ->
+4x InceptionB(7x7 factorized) -> reduction -> 2x InceptionC."""
+from __future__ import annotations
+
+import paddle_tpu as paddle
+from ... import nn
+
+
+class ConvBN(nn.Layer):
+    def __init__(self, cin, cout, k, stride=1, padding=0):
+        super().__init__()
+        self.conv = nn.Conv2D(cin, cout, k, stride=stride, padding=padding,
+                              bias_attr=False)
+        self.bn = nn.BatchNorm2D(cout)
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        return self.relu(self.bn(self.conv(x)))
+
+
+class InceptionA(nn.Layer):
+    def __init__(self, cin, pool_features):
+        super().__init__()
+        self.b1 = ConvBN(cin, 64, 1)
+        self.b5 = nn.Sequential(ConvBN(cin, 48, 1),
+                                ConvBN(48, 64, 5, padding=2))
+        self.b3 = nn.Sequential(ConvBN(cin, 64, 1),
+                                ConvBN(64, 96, 3, padding=1),
+                                ConvBN(96, 96, 3, padding=1))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                ConvBN(cin, pool_features, 1))
+
+    def forward(self, x):
+        return paddle.concat([self.b1(x), self.b5(x), self.b3(x),
+                              self.bp(x)], axis=1)
+
+
+class ReductionA(nn.Layer):
+    def __init__(self, cin):
+        super().__init__()
+        self.b3 = ConvBN(cin, 384, 3, stride=2)
+        self.b3d = nn.Sequential(ConvBN(cin, 64, 1),
+                                 ConvBN(64, 96, 3, padding=1),
+                                 ConvBN(96, 96, 3, stride=2))
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return paddle.concat([self.b3(x), self.b3d(x), self.pool(x)],
+                             axis=1)
+
+
+class InceptionB(nn.Layer):
+    """7x7-factorized block."""
+
+    def __init__(self, cin, c7):
+        super().__init__()
+        self.b1 = ConvBN(cin, 192, 1)
+        self.b7 = nn.Sequential(
+            ConvBN(cin, c7, 1),
+            ConvBN(c7, c7, (1, 7), padding=(0, 3)),
+            ConvBN(c7, 192, (7, 1), padding=(3, 0)))
+        self.b7d = nn.Sequential(
+            ConvBN(cin, c7, 1),
+            ConvBN(c7, c7, (7, 1), padding=(3, 0)),
+            ConvBN(c7, c7, (1, 7), padding=(0, 3)),
+            ConvBN(c7, c7, (7, 1), padding=(3, 0)),
+            ConvBN(c7, 192, (1, 7), padding=(0, 3)))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                ConvBN(cin, 192, 1))
+
+    def forward(self, x):
+        return paddle.concat([self.b1(x), self.b7(x), self.b7d(x),
+                              self.bp(x)], axis=1)
+
+
+class ReductionB(nn.Layer):
+    def __init__(self, cin):
+        super().__init__()
+        self.b3 = nn.Sequential(ConvBN(cin, 192, 1),
+                                ConvBN(192, 320, 3, stride=2))
+        self.b7 = nn.Sequential(
+            ConvBN(cin, 192, 1),
+            ConvBN(192, 192, (1, 7), padding=(0, 3)),
+            ConvBN(192, 192, (7, 1), padding=(3, 0)),
+            ConvBN(192, 192, 3, stride=2))
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return paddle.concat([self.b3(x), self.b7(x), self.pool(x)],
+                             axis=1)
+
+
+class InceptionC(nn.Layer):
+    def __init__(self, cin):
+        super().__init__()
+        self.b1 = ConvBN(cin, 320, 1)
+        self.b3_stem = ConvBN(cin, 384, 1)
+        self.b3_a = ConvBN(384, 384, (1, 3), padding=(0, 1))
+        self.b3_b = ConvBN(384, 384, (3, 1), padding=(1, 0))
+        self.b3d_stem = nn.Sequential(ConvBN(cin, 448, 1),
+                                      ConvBN(448, 384, 3, padding=1))
+        self.b3d_a = ConvBN(384, 384, (1, 3), padding=(0, 1))
+        self.b3d_b = ConvBN(384, 384, (3, 1), padding=(1, 0))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                ConvBN(cin, 192, 1))
+
+    def forward(self, x):
+        s = self.b3_stem(x)
+        d = self.b3d_stem(x)
+        return paddle.concat(
+            [self.b1(x), self.b3_a(s), self.b3_b(s),
+             self.b3d_a(d), self.b3d_b(d), self.bp(x)], axis=1)
+
+
+class InceptionV3(nn.Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            ConvBN(3, 32, 3, stride=2), ConvBN(32, 32, 3),
+            ConvBN(32, 64, 3, padding=1), nn.MaxPool2D(3, stride=2),
+            ConvBN(64, 80, 1), ConvBN(80, 192, 3),
+            nn.MaxPool2D(3, stride=2),
+        )
+        self.blocks = nn.Sequential(
+            InceptionA(192, 32), InceptionA(256, 64), InceptionA(288, 64),
+            ReductionA(288),
+            InceptionB(768, 128), InceptionB(768, 160),
+            InceptionB(768, 160), InceptionB(768, 192),
+            ReductionB(768),
+            InceptionC(1280), InceptionC(2048),
+        )
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.drop = nn.Dropout(0.2)
+            self.fc = nn.Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.drop(x.flatten(1)))
+        return x
+
+
+def inception_v3(pretrained=False, **kwargs):
+    return InceptionV3(**kwargs)

@@ -1,0 +1,57 @@
+"""MobileNetV1 (reference python/paddle/vision/models/mobilenetv1.py):
+13 depthwise-separable blocks. Depthwise convs lower to XLA
+feature-group convolutions (VPU-friendly on TPU)."""
+from __future__ import annotations
+
+from ... import nn
+
+
+class _DWSep(nn.Layer):
+    def __init__(self, cin, cout, stride):
+        super().__init__()
+        self.dw = nn.Conv2D(cin, cin, 3, stride=stride, padding=1,
+                            groups=cin, bias_attr=False)
+        self.bn1 = nn.BatchNorm2D(cin)
+        self.pw = nn.Conv2D(cin, cout, 1, bias_attr=False)
+        self.bn2 = nn.BatchNorm2D(cout)
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        x = self.relu(self.bn1(self.dw(x)))
+        return self.relu(self.bn2(self.pw(x)))
+
+
+class MobileNetV1(nn.Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        s = lambda c: max(int(c * scale), 8)  # noqa: E731
+        cfg = [(64, 1), (128, 2), (128, 1), (256, 2), (256, 1), (512, 2),
+               (512, 1), (512, 1), (512, 1), (512, 1), (512, 1),
+               (1024, 2), (1024, 1)]
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, s(32), 3, stride=2, padding=1, bias_attr=False),
+            nn.BatchNorm2D(s(32)), nn.ReLU())
+        blocks = []
+        cin = s(32)
+        for cout, stride in cfg:
+            blocks.append(_DWSep(cin, s(cout), stride))
+            cin = s(cout)
+        self.blocks = nn.Sequential(*blocks)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = nn.Linear(cin, num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(x.flatten(1))
+        return x
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV1(scale=scale, **kwargs)

@@ -1,0 +1,142 @@
+"""MobileNetV3 (reference python/paddle/vision/models/mobilenetv3.py):
+inverted residuals with squeeze-excitation and hardswish."""
+from __future__ import annotations
+
+from ... import nn
+
+# (kernel, exp, out, use_se, act, stride)
+_LARGE = [
+    (3, 16, 16, False, "relu", 1),
+    (3, 64, 24, False, "relu", 2),
+    (3, 72, 24, False, "relu", 1),
+    (5, 72, 40, True, "relu", 2),
+    (5, 120, 40, True, "relu", 1),
+    (5, 120, 40, True, "relu", 1),
+    (3, 240, 80, False, "hardswish", 2),
+    (3, 200, 80, False, "hardswish", 1),
+    (3, 184, 80, False, "hardswish", 1),
+    (3, 184, 80, False, "hardswish", 1),
+    (3, 480, 112, True, "hardswish", 1),
+    (3, 672, 112, True, "hardswish", 1),
+    (5, 672, 160, True, "hardswish", 2),
+    (5, 960, 160, True, "hardswish", 1),
+    (5, 960, 160, True, "hardswish", 1),
+]
+_SMALL = [
+    (3, 16, 16, True, "relu", 2),
+    (3, 72, 24, False, "relu", 2),
+    (3, 88, 24, False, "relu", 1),
+    (5, 96, 40, True, "hardswish", 2),
+    (5, 240, 40, True, "hardswish", 1),
+    (5, 240, 40, True, "hardswish", 1),
+    (5, 120, 48, True, "hardswish", 1),
+    (5, 144, 48, True, "hardswish", 1),
+    (5, 288, 96, True, "hardswish", 2),
+    (5, 576, 96, True, "hardswish", 1),
+    (5, 576, 96, True, "hardswish", 1),
+]
+
+
+def _make_divisible(v, divisor=8):
+    new_v = max(divisor, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+def _act(name):
+    return nn.Hardswish() if name == "hardswish" else nn.ReLU()
+
+
+class _SE(nn.Layer):
+    def __init__(self, ch):
+        super().__init__()
+        mid = _make_divisible(ch // 4)
+        self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        self.fc1 = nn.Conv2D(ch, mid, 1)
+        self.relu = nn.ReLU()
+        self.fc2 = nn.Conv2D(mid, ch, 1)
+        self.hsig = nn.Hardsigmoid()
+
+    def forward(self, x):
+        s = self.hsig(self.fc2(self.relu(self.fc1(self.pool(x)))))
+        return x * s
+
+
+class _InvRes(nn.Layer):
+    def __init__(self, cin, k, exp, cout, use_se, act, stride):
+        super().__init__()
+        self.use_res = stride == 1 and cin == cout
+        seq = []
+        if exp != cin:
+            seq += [nn.Conv2D(cin, exp, 1, bias_attr=False),
+                    nn.BatchNorm2D(exp), _act(act)]
+        seq += [nn.Conv2D(exp, exp, k, stride=stride, padding=k // 2,
+                          groups=exp, bias_attr=False),
+                nn.BatchNorm2D(exp), _act(act)]
+        if use_se:
+            seq.append(_SE(exp))
+        seq += [nn.Conv2D(exp, cout, 1, bias_attr=False),
+                nn.BatchNorm2D(cout)]
+        self.block = nn.Sequential(*seq)
+
+    def forward(self, x):
+        out = self.block(x)
+        return x + out if self.use_res else out
+
+
+class MobileNetV3(nn.Layer):
+    def __init__(self, cfg, last_ch, scale=1.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        sc = lambda c: _make_divisible(c * scale)  # noqa: E731
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, sc(16), 3, stride=2, padding=1, bias_attr=False),
+            nn.BatchNorm2D(sc(16)), nn.Hardswish())
+        blocks = []
+        cin = sc(16)
+        for k, exp, cout, use_se, act, stride in cfg:
+            blocks.append(_InvRes(cin, k, sc(exp), sc(cout), use_se, act,
+                                  stride))
+            cin = sc(cout)
+        last_conv = sc(cfg[-1][1])
+        blocks += [nn.Conv2D(cin, last_conv, 1, bias_attr=False),
+                   nn.BatchNorm2D(last_conv), nn.Hardswish()]
+        self.blocks = nn.Sequential(*blocks)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            # head width scales too (reference mobilenetv3.py:319,394
+            # last_channel = _make_divisible(scale * {1280,1024}))
+            last_channel = sc(last_ch)
+            self.classifier = nn.Sequential(
+                nn.Linear(last_conv, last_channel), nn.Hardswish(),
+                nn.Dropout(0.2), nn.Linear(last_channel, num_classes))
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(x.flatten(1))
+        return x
+
+
+class MobileNetV3Large(MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_LARGE, 1280, scale, num_classes, with_pool)
+
+
+class MobileNetV3Small(MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_SMALL, 1024, scale, num_classes, with_pool)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV3Large(scale=scale, **kwargs)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV3Small(scale=scale, **kwargs)

@@ -150,3 +150,44 @@ def resnet101(pretrained=False, **kwargs):
 
 def resnet152(pretrained=False, **kwargs):
     return _resnet(BottleneckBlock, 152, **kwargs)
+
+
+def _resnext(depth, groups, width, **kwargs):
+    """reference vision/models/resnet.py resnext* factories."""
+    kwargs.setdefault("groups", groups)
+    kwargs.setdefault("width", width)
+    return ResNet(BottleneckBlock, depth, **kwargs)
+
+
+def resnext50_32x4d(pretrained=False, **kwargs):
+    return _resnext(50, 32, 4, **kwargs)
+
+
+def resnext50_64x4d(pretrained=False, **kwargs):
+    return _resnext(50, 64, 4, **kwargs)
+
+
+def resnext101_32x4d(pretrained=False, **kwargs):
+    return _resnext(101, 32, 4, **kwargs)
+
+
+def resnext101_64x4d(pretrained=False, **kwargs):
+    return _resnext(101, 64, 4, **kwargs)
+
+
+def resnext152_32x4d(pretrained=False, **kwargs):
+    return _resnext(152, 32, 4, **kwargs)
+
+
+def resnext152_64x4d(pretrained=False, **kwargs):
+    return _resnext(152, 64, 4, **kwargs)
+
+
+def wide_resnet50_2(pretrained=False, **kwargs):
+    kwargs.setdefault("width", 128)
+    return ResNet(BottleneckBlock, 50, **kwargs)
+
+
+def wide_resnet101_2(pretrained=False, **kwargs):
+    kwargs.setdefault("width", 128)
+    return ResNet(BottleneckBlock, 101, **kwargs)

@@ -1,0 +1,152 @@
+"""ShuffleNetV2 (reference python/paddle/vision/models/shufflenetv2.py).
+channel_shuffle is a reshape/transpose pair — free on TPU, XLA folds it
+into the surrounding convolution layouts."""
+from __future__ import annotations
+
+import paddle_tpu as paddle
+from ... import nn
+
+_STAGE_OUT = {
+    "0.25": [24, 24, 48, 96, 512],
+    "0.33": [24, 32, 64, 128, 512],
+    "0.5": [24, 48, 96, 192, 1024],
+    "1.0": [24, 116, 232, 464, 1024],
+    "1.5": [24, 176, 352, 704, 1024],
+    "2.0": [24, 244, 488, 976, 2048],
+}
+_STAGE_REPEATS = [4, 8, 4]
+
+
+def channel_shuffle(x, groups):
+    b, c, h, w = x.shape
+    x = paddle.reshape(x, [b, groups, c // groups, h, w])
+    x = paddle.transpose(x, [0, 2, 1, 3, 4])
+    return paddle.reshape(x, [b, c, h, w])
+
+
+def _act(name):
+    return nn.Swish() if name == "swish" else nn.ReLU()
+
+
+class InvertedResidual(nn.Layer):
+    def __init__(self, inp, oup, stride, act="relu"):
+        super().__init__()
+        self.stride = stride
+        branch = oup // 2
+        if stride == 1:
+            self.branch2 = nn.Sequential(
+                nn.Conv2D(inp // 2, branch, 1, bias_attr=False),
+                nn.BatchNorm2D(branch), _act(act),
+                nn.Conv2D(branch, branch, 3, stride=1, padding=1,
+                          groups=branch, bias_attr=False),
+                nn.BatchNorm2D(branch),
+                nn.Conv2D(branch, branch, 1, bias_attr=False),
+                nn.BatchNorm2D(branch), _act(act),
+            )
+            self.branch1 = None
+        else:
+            self.branch1 = nn.Sequential(
+                nn.Conv2D(inp, inp, 3, stride=stride, padding=1, groups=inp,
+                          bias_attr=False),
+                nn.BatchNorm2D(inp),
+                nn.Conv2D(inp, branch, 1, bias_attr=False),
+                nn.BatchNorm2D(branch), _act(act),
+            )
+            self.branch2 = nn.Sequential(
+                nn.Conv2D(inp, branch, 1, bias_attr=False),
+                nn.BatchNorm2D(branch), _act(act),
+                nn.Conv2D(branch, branch, 3, stride=stride, padding=1,
+                          groups=branch, bias_attr=False),
+                nn.BatchNorm2D(branch),
+                nn.Conv2D(branch, branch, 1, bias_attr=False),
+                nn.BatchNorm2D(branch), _act(act),
+            )
+
+    def forward(self, x):
+        if self.branch1 is None:
+            c = x.shape[1] // 2
+            x1, x2 = x[:, :c], x[:, c:]
+            out = paddle.concat([x1, self.branch2(x2)], axis=1)
+        else:
+            out = paddle.concat([self.branch1(x), self.branch2(x)], axis=1)
+        return channel_shuffle(out, 2)
+
+
+class ShuffleNetV2(nn.Layer):
+    def __init__(self, scale=1.0, act="relu", num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        key = {0.25: "0.25", 0.33: "0.33", 0.5: "0.5", 1.0: "1.0",
+               1.5: "1.5", 2.0: "2.0"}.get(scale)
+        if key is None:
+            raise ValueError("unsupported scale %r" % scale)
+        out_ch = _STAGE_OUT[key]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.conv1 = nn.Sequential(
+            nn.Conv2D(3, out_ch[0], 3, stride=2, padding=1,
+                      bias_attr=False),
+            nn.BatchNorm2D(out_ch[0]), _act(act),
+        )
+        self.maxpool = nn.MaxPool2D(3, stride=2, padding=1)
+        stages = []
+        inp = out_ch[0]
+        for i, reps in enumerate(_STAGE_REPEATS):
+            oup = out_ch[i + 1]
+            seq = [InvertedResidual(inp, oup, 2, act)]
+            for _ in range(reps - 1):
+                seq.append(InvertedResidual(oup, oup, 1, act))
+            stages.append(nn.Sequential(*seq))
+            inp = oup
+        self.stages = nn.LayerList(stages)
+        self.conv_last = nn.Sequential(
+            nn.Conv2D(inp, out_ch[-1], 1, bias_attr=False),
+            nn.BatchNorm2D(out_ch[-1]), _act(act),
+        )
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = nn.Linear(out_ch[-1], num_classes)
+
+    def forward(self, x):
+        x = self.maxpool(self.conv1(x))
+        for stage in self.stages:
+            x = stage(x)
+        x = self.conv_last(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(x.flatten(1))
+        return x
+
+
+def _shufflenet(scale, act="relu", **kwargs):
+    return ShuffleNetV2(scale=scale, act=act, **kwargs)
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kwargs):
+    return _shufflenet(0.25, **kwargs)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kwargs):
+    return _shufflenet(0.33, **kwargs)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kwargs):
+    return _shufflenet(0.5, **kwargs)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kwargs):
+    return _shufflenet(1.0, **kwargs)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kwargs):
+    return _shufflenet(1.5, **kwargs)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kwargs):
+    return _shufflenet(2.0, **kwargs)
+
+
+def shufflenet_v2_swish(pretrained=False, **kwargs):
+    return _shufflenet(1.0, act="swish", **kwargs)

@@ -1,0 +1,92 @@
+"""Vision model zoo tests (reference python/paddle/tests/test_vision_models.py
+builds each factory and runs a forward pass)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import models
+
+import os
+
+_FULL = os.environ.get("PADDLE_TPU_FULL_ZOO") == "1"
+
+# (factory name, kwargs, input hw) — small num_classes keeps heads cheap
+_FACTORIES = [
+    ("mobilenet_v1", {"scale": 0.25}, 64),
+    ("mobilenet_v2", {"scale": 0.25}, 64),
+    ("mobilenet_v3_small", {"scale": 0.5}, 64),
+    ("mobilenet_v3_large", {"scale": 0.35}, 64),
+    ("shufflenet_v2_x0_25", {}, 64),
+    ("shufflenet_v2_swish", {}, 64),
+    ("resnet18", {}, 64),
+]
+# heavyweight on CPU eager (many unique conv shapes to compile on the
+# 1-vCPU test box / big FC heads / 299px stem); full-zoo CI only
+_SLOW_FACTORIES = [
+    ("alexnet", {}, 224),
+    ("squeezenet1_0", {}, 224),
+    ("squeezenet1_1", {}, 224),
+    ("inception_v3", {}, 299),
+    ("densenet121", {}, 64),
+    ("googlenet", {}, 64),
+    ("resnext50_32x4d", {}, 64),
+    ("wide_resnet50_2", {}, 64),
+]
+if _FULL:
+    _FACTORIES = _FACTORIES + _SLOW_FACTORIES
+
+
+class TestModelZoo:
+    @pytest.mark.parametrize("name,kwargs,hw", _FACTORIES,
+                             ids=[f[0] for f in _FACTORIES])
+    def test_forward_shape(self, name, kwargs, hw):
+        paddle.seed(0)
+        model = getattr(models, name)(num_classes=10, **kwargs)
+        model.eval()
+        x = paddle.randn([2, 3, hw, hw])
+        with paddle.no_grad():
+            out = model(x)
+        if isinstance(out, tuple):  # googlenet returns (out, aux1, aux2)
+            out = out[0]
+        assert out.shape == [2, 10], name
+        assert np.all(np.isfinite(out.numpy()))
+
+    @pytest.mark.skipif(not _FULL, reason="full-zoo CI only (1-vCPU box)")
+    def test_googlenet_aux_heads(self):
+        paddle.seed(0)
+        model = models.googlenet(num_classes=10)
+        model.train()
+        out, aux1, aux2 = model(paddle.randn([1, 3, 64, 64]))
+        assert out.shape == aux1.shape == aux2.shape == [1, 10]
+        # the reference returns the triple in eval mode too
+        model.eval()
+        with paddle.no_grad():
+            outs = model(paddle.randn([1, 3, 64, 64]))
+        assert isinstance(outs, tuple) and len(outs) == 3
+
+    def test_small_model_trains(self):
+        paddle.seed(0)
+        model = models.mobilenet_v1(scale=0.25, num_classes=4)
+        opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                    parameters=model.parameters())
+        x = paddle.randn([4, 3, 32, 32])
+        y = paddle.to_tensor(np.array([0, 1, 2, 3], np.int64))
+        import paddle_tpu.nn.functional as F
+
+        losses = []
+        for _ in range(3):
+            loss = F.cross_entropy(model(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+
+    def test_feature_extractor_mode(self):
+        # num_classes=0 returns pooled features (reference convention)
+        paddle.seed(0)
+        m = models.mobilenet_v2(scale=0.25, num_classes=0)
+        m.eval()
+        with paddle.no_grad():
+            out = m(paddle.randn([1, 3, 64, 64]))
+        assert out.shape[0] == 1 and len(out.shape) == 4
