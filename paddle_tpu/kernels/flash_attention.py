@@ -6,10 +6,12 @@ fmha wrappers): blocked online-softmax attention that never materializes the
 [N, N] score matrix in HBM. The forward is a Pallas kernel with a
 (batch*head, q_block, kv_block) grid — K/V are streamed one (block_k, d)
 tile at a time with the running max/denominator/accumulator held in VMEM
-scratch, so context length is bounded by HBM, not VMEM. Backward is the
-standard recompute-form attention VJP expressed in XLA (fused well; a Pallas
-backward is a later optimization). Layout follows the framework convention
-[B, N, H, D].
+scratch, so context length is bounded by HBM, not VMEM. The backward is
+also Pallas (FlashAttention-2-style): the forward saves the softmax
+log-sum-exp, and two blocked kernels produce dq (q-major grid) and dk/dv
+(kv-major grid) with fp32 VMEM accumulators — O(N) memory end to end; the
+[N, N] score matrix never exists in either direction. Layout follows the
+framework convention [B, N, H, D].
 
 Causal semantics are start-aligned (query i attends to keys j <= i) in both
 the kernel and the XLA fallback/VJP; causal cross-attention with
@@ -34,10 +36,11 @@ NEG_INF = -1e30
 _STAT_LANES = 128  # lane width for the m/l scratch (TPU min tile)
 
 
-def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
-               scale, causal, block_k):
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+               *, scale, causal, block_k):
     """One (bh, q_block, kv_block) program. Refs: q [1, bq, d];
-    k/v [1, block_k, d]; o [1, bq, d]; scratch m/l [bq, 128], acc [bq, d]."""
+    k/v [1, block_k, d]; o [1, bq, d]; lse [1, bq] (softmax log-sum-exp,
+    saved for the Pallas backward); scratch m/l [bq, 128], acc [bq, d]."""
     _, bq, d = q_ref.shape
     q_idx = pl.program_id(1)
     kv_i = pl.program_id(2)
@@ -85,7 +88,9 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
     @pl.when(kv_i == num_kv - 1)
     def _finish():
         l = l_scr[...][:, :1]
+        m = m_scr[...][:, :1]
         o_ref[0] = (acc_scr[...] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+        lse_ref[0, 0] = (m + jnp.log(jnp.maximum(l, 1e-30)))[:, 0]
 
 
 def _flash_fwd_bhnd(q, k, v, scale, causal, block_q, block_k, interpret):
@@ -106,9 +111,19 @@ def _flash_fwd_bhnd(q, k, v, scale, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((bh, n, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, n, d), q.dtype),
+            # lse as [bh, 1, n]: the singleton axis keeps the (1, block_q)
+            # tail of the block equal-to-array-dim / lane-aligned (Mosaic
+            # tiling rule)
+            jax.ShapeDtypeStruct((bh, 1, n), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, _STAT_LANES), jnp.float32),
             pltpu.VMEM((block_q, _STAT_LANES), jnp.float32),
@@ -118,6 +133,188 @@ def _flash_fwd_bhnd(q, k, v, scale, causal, block_q, block_k, interpret):
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q, k, v)
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dq_ref,
+               dq_scr, *, scale, causal, block_k):
+    """dq pass: grid (bh, q_block, kv_block); dq accumulated in VMEM.
+    ds = p * (dout.v^T - delta); dq = scale * ds @ k (FlashAttention-2
+    backward, arXiv:2307.08691 alg. 4 — public algorithm, fresh code)."""
+    _, bq, d = q_ref.shape
+    q_idx = pl.program_id(1)
+    kv_i = pl.program_id(2)
+    num_kv = pl.num_programs(2)
+
+    @pl.when(kv_i == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    def compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, None]                    # [bq, 1]
+        delta = dl_ref[0, 0][:, None]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [bq, bk]
+        if causal:
+            q_pos = q_idx * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0)
+            k_pos = kv_i * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [bq, bk]
+        ds = p * (dp - delta)
+        dq_scr[...] += scale * jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(kv_i * block_k <= q_idx * bq + bq - 1)
+        def _run():
+            compute()
+    else:
+        compute()
+
+    @pl.when(kv_i == num_kv - 1)
+    def _finish():
+        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dk_ref,
+                dv_ref, dk_scr, dv_scr, *, scale, causal, block_q):
+    """dk/dv pass: grid (bh, kv_block, q_block); dk/dv accumulated in VMEM.
+    dv = p^T @ dout; dk = scale * ds^T @ q."""
+    _, bk, d = k_ref.shape
+    kv_i = pl.program_id(1)
+    q_idx = pl.program_id(2)
+    num_q = pl.num_programs(2)
+
+    @pl.when(q_idx == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    def compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        bq = q.shape[0]
+        lse = lse_ref[0, 0][:, None]
+        delta = dl_ref[0, 0][:, None]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [bq, bk]
+        if causal:
+            q_pos = q_idx * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 0)
+            k_pos = kv_i * bk + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)                             # [bq, bk]
+        dv_scr[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [bk, d]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [bq, bk]
+        ds = p * (dp - delta)
+        dk_scr[...] += scale * jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [bk, d]
+
+    if causal:
+        # skip q blocks entirely above the diagonal for this kv block
+        @pl.when(q_idx * q_ref.shape[1] + q_ref.shape[1] - 1
+                 >= kv_i * bk)
+        def _run():
+            compute()
+    else:
+        compute()
+
+    @pl.when(q_idx == num_q - 1)
+    def _finish():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd_bhnd(q, k, v, out, lse, g, scale, causal, block_q, block_k,
+                    interpret):
+    """Pallas backward: returns (dq, dk, dv), all [BH, N, D]."""
+    bh, n, d = q.shape
+    kv_len = k.shape[1]
+    # delta[b, i] = sum_d dout * out — one fused XLA reduction
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)[:, None, :]                  # [bh, 1, n]
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          block_k=block_k),
+        grid=(bh, n // block_q, kv_len // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((bh, n, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q),
+        grid=(bh, kv_len // block_k, n // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, kv_len, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, kv_len, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+    return dq, dk, dv
 
 
 def _reference_attention(q, k, v, scale, causal):
@@ -142,23 +339,22 @@ def _reference_attention(q, k, v, scale, causal):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash_core(q, k, v, scale, causal, block_q, block_k, interpret):
-    return _flash_fwd_bhnd(q, k, v, scale, causal, block_q, block_k,
-                           interpret)
+    out, _ = _flash_fwd_bhnd(q, k, v, scale, causal, block_q, block_k,
+                             interpret)
+    return out
 
 
 def _flash_core_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
-    out = _flash_fwd_bhnd(q, k, v, scale, causal, block_q, block_k,
-                          interpret)
-    return out, (q, k, v)
+    out, lse = _flash_fwd_bhnd(q, k, v, scale, causal, block_q, block_k,
+                               interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_core_bwd(scale, causal, block_q, block_k, interpret, res, g):
-    q, k, v = res
-    # recompute-form VJP: XLA fuses the rebuilt softmax with the grads
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: _reference_attention(q_, k_, v_, scale, causal),
-        q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = res
+    # Pallas blocked backward: O(N) memory, never materializes [N, N]
+    return _flash_bwd_bhnd(q, k, v, out, lse, g, scale, causal, block_q,
+                           block_k, interpret)
 
 
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
@@ -178,10 +374,13 @@ def flash_attention(q, k, v, causal=False, scale=None,
     block_k = min(block_k, kv_n)
     # Kernel path requires Mosaic-tileable blocks: q blocks on the sublane
     # axis (multiple of 8) and kv blocks on the lane axis of the score tile
-    # (multiple of 128). Anything else takes the XLA fallback, which shares
-    # the kernel's mask semantics.
+    # (multiple of 128); block_q additionally lands on the LANE axis of the
+    # saved lse tile (1, 1, block_q), so it must be a multiple of 128 or
+    # the whole sequence. Anything else takes the XLA fallback, which
+    # shares the kernel's mask semantics.
     tileable = (n % block_q == 0 and kv_n % block_k == 0
-                and block_q % 8 == 0 and block_k % 128 == 0)
+                and block_q % 8 == 0 and block_k % 128 == 0
+                and (block_q % 128 == 0 or block_q == n))
     if not tileable:
         return jnp.swapaxes(
             _reference_attention(
