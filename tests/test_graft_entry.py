@@ -1,0 +1,57 @@
+"""Driver-gate tests: call __graft_entry__ exactly the way the driver does.
+
+Round-1 regression (VERDICT #1): dryrun_multichip asserted device_count
+instead of provisioning the virtual mesh itself, so the driver's direct call
+(jax already initialized on the 1-chip platform, no conftest env) failed.
+These tests run it from a fresh subprocess WITHOUT the conftest's
+--xla_force_host_platform_device_count so the function must self-provision.
+"""
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _driver_env():
+    env = dict(os.environ)
+    # strip everything the conftest set up: the driver has none of it
+    env.pop("_PADDLE_TPU_DRYRUN_CHILD", None)
+    flags = env.get("XLA_FLAGS", "")
+    env["XLA_FLAGS"] = " ".join(
+        f for f in flags.split()
+        if "xla_force_host_platform_device_count" not in f)
+    # the driver's process runs on the real chip platform; we can't dial the
+    # tunnel from tests, but the essential property — jax pre-initialized
+    # with ONE device before dryrun_multichip is called — is preserved.
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def test_dryrun_multichip_self_provisions():
+    code = (
+        "import jax\n"
+        "assert jax.device_count() == 1, jax.device_count()\n"
+        "import __graft_entry__ as g\n"
+        "g.dryrun_multichip(8)\n"
+    )
+    proc = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                          env=_driver_env(), capture_output=True,
+                          text=True, timeout=900)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert "OK" in proc.stdout, proc.stdout
+
+
+def test_entry_compiles_single_chip():
+    code = (
+        "import __graft_entry__ as g\n"
+        "import jax\n"
+        "fn, args = g.entry()\n"
+        "out = jax.jit(fn)(*args)\n"
+        "print('shape', out.shape)\n"
+    )
+    proc = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                          env=_driver_env(), capture_output=True,
+                          text=True, timeout=900)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert "shape" in proc.stdout, proc.stdout
