@@ -6,7 +6,8 @@
 // launch/elastic subsystems.
 //
 // Protocol (length-prefixed binary over TCP):
-//   u8 op ('S' set, 'G' get-blocking, 'A' add, 'D' delete, 'L' list-count)
+//   u8 op ('S' set, 'G' get-blocking, 'A' add, 'R' counter-read,
+//          'D' delete, 'L' list-count)
 //   u32 key_len, key bytes
 //   SET: u32 val_len, val bytes            -> reply u8 0
 //   GET: u64 timeout_ms                    -> reply u8 ok, u32 len, bytes
@@ -134,6 +135,18 @@ void ServeClient(StoreServer* s, int fd) {
       s->cv.notify_all();
       uint8_t ok = 0;
       if (!WriteFull(fd, &ok, 1) || !WriteFull(fd, &nv, 8)) break;
+    } else if (op == 'R') {  // counter read: NON-creating (elastic liveness)
+      int64_t nv = 0;
+      uint8_t found = 0;
+      {
+        std::lock_guard<std::mutex> lk(s->mu);
+        auto it = s->counters.find(key);
+        if (it != s->counters.end()) {
+          nv = it->second;
+          found = 1;
+        }
+      }
+      if (!WriteFull(fd, &found, 1) || !WriteFull(fd, &nv, 8)) break;
     } else if (op == 'D') {
       {
         std::lock_guard<std::mutex> lk(s->mu);
@@ -297,6 +310,22 @@ int pt_store_add(int fd, const char* key, int64_t delta, int64_t* out_new) {
   uint8_t ok;
   if (!ReadFull(fd, &ok, 1)) return -1;
   return ReadFull(fd, out_new, 8) ? 0 : -1;
+}
+
+// Non-creating counter read: returns 0 and *out on hit, -2 on miss, -1 io.
+int pt_store_counter_get(int fd, const char* key, int64_t* out) {
+  uint8_t op = 'R';
+  uint32_t klen = static_cast<uint32_t>(strlen(key));
+  if (!WriteFull(fd, &op, 1) || !WriteFull(fd, &klen, 4) ||
+      !WriteFull(fd, key, klen))
+    return -1;
+  uint8_t found;
+  if (!ReadFull(fd, &found, 1)) return -1;
+  int64_t nv;
+  if (!ReadFull(fd, &nv, 8)) return -1;
+  if (!found) return -2;
+  *out = nv;
+  return 0;
 }
 
 int pt_store_delete(int fd, const char* key) {

@@ -70,6 +70,9 @@ class NativeInterpreter:
                 for r in readers.get(key, ()):  # WAR hazard
                     if r != i:
                         add_dep(h, r, i)
+                w = last_writer.get(key)
+                if w is not None and w != i:  # WAW hazard
+                    add_dep(h, w, i)
                 readers[key] = []
                 last_writer[key] = i
 

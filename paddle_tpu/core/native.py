@@ -78,6 +78,9 @@ def _declare(lib):
     lib.pt_store_add.argtypes = [c.c_int, c.c_char_p, c.c_int64,
                                  c.POINTER(c.c_int64)]
     lib.pt_store_add.restype = c.c_int
+    lib.pt_store_counter_get.argtypes = [c.c_int, c.c_char_p,
+                                         c.POINTER(c.c_int64)]
+    lib.pt_store_counter_get.restype = c.c_int
     lib.pt_store_delete.argtypes = [c.c_int, c.c_char_p]
     lib.pt_store_delete.restype = c.c_int
     # feed.cc

@@ -79,7 +79,9 @@ class ElasticManager:
         now = time.monotonic()
         alive = []
         for r in range(self.np):
-            count = self.store.add(self._beat_key(r), 0)  # read counter
+            # non-creating read: never-registered ranks stay absent instead
+            # of materializing zero counters in the store namespace
+            count = self.store.counter_get(self._beat_key(r), default=0)
             if count <= 0:
                 self._last_seen.pop(r, None)
                 continue

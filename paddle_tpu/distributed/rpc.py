@@ -135,8 +135,15 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
                if rank is None else rank)
     world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", 1)
                      if world_size is None else world_size)
-    master = master_endpoint or os.environ.get("PADDLE_MASTER",
-                                               "127.0.0.1:0")
+    master = master_endpoint or os.environ.get("PADDLE_MASTER")
+    if master is None:
+        if world_size > 1:
+            raise ValueError(
+                "init_rpc with world_size=%d needs an explicit "
+                "master_endpoint or PADDLE_MASTER env (the launch "
+                "controller enforces the same: --master required when "
+                "nnodes > 1)" % world_size)
+        master = "127.0.0.1:0"
     host, _, port = master.partition(":")
     store = TCPStore(host, int(port or 0), is_master=(rank == 0))
     _agent = _RpcAgent(name, rank, world_size, store)

@@ -71,6 +71,18 @@ class TCPStore:
             raise RuntimeError("TCPStore.add(%r) failed" % key)
         return int(out.value)
 
+    def counter_get(self, key, default=None):
+        """Non-creating counter read: value, or `default` if the counter
+        was never created (distinguishes 'never registered' from 0)."""
+        out = ctypes.c_int64()
+        rc = self._lib.pt_store_counter_get(self._fd, key.encode(),
+                                            ctypes.byref(out))
+        if rc == -2:
+            return default
+        if rc != 0:
+            raise RuntimeError("TCPStore.counter_get(%r) failed" % key)
+        return int(out.value)
+
     def delete(self, key):
         self._lib.pt_store_delete(self._fd, key.encode())
 

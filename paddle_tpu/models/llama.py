@@ -260,3 +260,15 @@ class LlamaForCausalLM(Layer):
         h, caches = self.llama(input_ids, caches, position_offset)
         logits = self.lm_head(h)
         return logits, caches
+
+    # -- pipeline-parallel protocol (parallel/pipeline_parallel.py) --------
+
+    def pipeline_blocks(self):
+        """The identical decoder blocks the ring pipeline stacks over 'pp'."""
+        return list(self.llama.layers)
+
+    def forward_embed(self, input_ids):
+        return self.llama.embed_tokens(input_ids)
+
+    def forward_head(self, h):
+        return self.lm_head(self.llama.norm(h))

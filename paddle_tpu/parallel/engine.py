@@ -10,14 +10,16 @@ enters as a sharding:
              (riding ICI, overlapped by the latency-hiding scheduler).
 - mp (TP):   mpu layer params sharded over 'mp' (column/row) → XLA inserts
              the identity/allreduce pairs of Megatron TP.
-- sharding:  ZeRO — params+opt state sharded over 'sharding', gathered
-             on use (XLA all-gathers weights, reduce-scatters grads).
+- sharding:  ZeRO (reference group_sharded_stage{2,3}.py semantics):
+               stage 1: optimizer state sharded over 'sharding'
+               stage 2: + gradients reduce-scattered (sharding constraint on
+                        the grads makes XLA emit reduce-scatter, not
+                        all-reduce + slice)
+               stage 3: + parameters sharded, all-gathered on use
 - sep (SP):  sequence dim sharded over 'sep'; ring attention in kernels/.
 - pp:        lax.scan over stage-stacked weights (see pipeline_parallel).
 """
 from __future__ import annotations
-
-import functools
 
 import jax
 import jax.numpy as jnp
@@ -28,22 +30,46 @@ from ..core.tensor import Tensor
 from ..distributed import mesh as _mesh
 
 
+def _normalize_spec(spec, ndim):
+    """PartitionSpec → list of length ndim (entries: axis name | None)."""
+    entries = list(spec) if spec is not None else []
+    entries += [None] * (ndim - len(entries))
+    return entries[:ndim]
+
+
 def param_spec(param, zero_stage=0, mesh=None):
     """Sharding spec for one parameter: explicit layer annotation first
-    (mpu layers), else ZeRO sharding of the largest divisible dim, else
-    replicated."""
+    (mpu layers), else — only at ZeRO stage 3 — sharded over 'sharding'
+    on the largest divisible dim, else replicated."""
     mesh = mesh or _mesh.get_mesh()
     if param._sharding_spec is not None:
         return param._sharding_spec
-    if zero_stage >= 2 and "sharding" in mesh.axis_names:
-        n = mesh.shape["sharding"]
-        shape = tuple(param.shape)
-        for i, s in enumerate(shape):
-            if s % n == 0 and s >= n:
-                spec = [None] * len(shape)
-                spec[i] = "sharding"
-                return P(*spec)
+    if zero_stage >= 3 and "sharding" in mesh.axis_names:
+        return zero_spec(tuple(param.shape), P(), mesh)
     return P()
+
+
+def zero_spec(shape, base_spec, mesh):
+    """Add the 'sharding' axis to base_spec on the largest dim that is
+    divisible by the sharding degree and not already sharded. Used for
+    opt-state slots (stage>=1), grads (stage>=2), params (stage 3)."""
+    n = mesh.shape.get("sharding", 1)
+    if n <= 1:
+        return base_spec
+    entries = _normalize_spec(base_spec, len(shape))
+    flat = [a for e in entries if e is not None
+            for a in (e if isinstance(e, tuple) else (e,))]
+    if "sharding" in flat:
+        return base_spec
+    best = None
+    for i, s in enumerate(shape):
+        if entries[i] is None and s % n == 0 and s >= n:
+            if best is None or s > shape[best]:
+                best = i
+    if best is None:
+        return base_spec
+    entries[best] = "sharding"
+    return P(*entries)
 
 
 class CompiledTrainStep:
@@ -57,49 +83,94 @@ class CompiledTrainStep:
         self.optimizer = optimizer
         self.mesh = mesh or _mesh.get_mesh()
         self.zero_stage = zero_stage
+        self.donate = donate
         self._names, values = model.functional_state()
-        self._param_names = [n for n, _ in model.named_parameters()
-                             if not dict(model.named_parameters())[n].stop_gradient]
+        self._tensors = model.raw_state_tensors()
         trainable = {n: p for n, p in model.named_parameters()
                      if not p.stop_gradient}
         self._trainable_names = list(trainable.keys())
         self._opt_state = optimizer.functional_init(
             {n: p._value for n, p in trainable.items()})
         self._step_count = 0
-        self.batch_spec = batch_spec or P("dp") if (
-            "dp" in self.mesh.axis_names) else P()
+        if batch_spec is not None:
+            self.batch_spec = batch_spec
+        else:
+            # the 'sharding' axis is a data-parallel axis too (reference
+            # topology.py: data-parallel world = dp * sharding) — batch is
+            # split over both, so grads become partial sums that XLA
+            # reduce-scatters (ZeRO-2) over 'sharding'.
+            batch_axes = [a for a in ("dp", "sharding")
+                          if a in self.mesh.axis_names]
+            self.batch_spec = P(tuple(batch_axes)) if batch_axes else P()
         self._shard_params()
         self._compiled = None
 
+    # -- sharding specs ----------------------------------------------------
+
     def _specs(self):
-        tensors = self.model.raw_state_tensors()
-        return {n: param_spec(tensors[n], self.zero_stage, self.mesh)
+        return {n: param_spec(self._tensors[n], self.zero_stage, self.mesh)
                 for n in self._names}
+
+    def _grad_spec(self, name, specs):
+        """Gradient sharding for stage>=2: reduce-scatter over 'sharding'."""
+        base = specs[name]
+        if self.zero_stage >= 2:
+            return zero_spec(tuple(self._tensors[name].shape), base,
+                             self.mesh)
+        return base
+
+    def _opt_slot_spec(self, name, slot_shape, specs):
+        """Opt-state slot sharding: moment-like slots (same rank as the
+        param) follow the ZeRO spec at stage>=1; scalar/other slots stay
+        replicated-compatible with the param spec."""
+        pshape = tuple(self._tensors[name].shape)
+        base = specs[name]
+        if tuple(slot_shape) != pshape:
+            return P()
+        if self.zero_stage >= 1:
+            return zero_spec(pshape, base, self.mesh)
+        return base
+
+    def _opt_specs(self, specs):
+        out = {}
+        for n, slots in self._opt_state.items():
+            out[n] = [self._opt_slot_spec(n, jnp.shape(s), specs)
+                      for s in slots]
+        return out
 
     def _shard_params(self):
         specs = self._specs()
-        tensors = self.model.raw_state_tensors()
+        tensors = self._tensors
         for n in self._names:
             t = tensors[n]
             t._value = jax.device_put(
                 t._value, NamedSharding(self.mesh, specs[n]))
-        # opt state follows its parameter's sharding
+        opt_specs = self._opt_specs(specs)
         for n, slots in self._opt_state.items():
-            spec = specs[n]
             self._opt_state[n] = [
                 jax.device_put(s, NamedSharding(self.mesh, spec))
-                for s in slots]
+                for s, spec in zip(slots, opt_specs[n])]
+
+    # -- compiled step -----------------------------------------------------
 
     def _build(self):
         model, loss_fn, opt = self.model, self.loss_fn, self.optimizer
         names = self._names
         trainable_names = self._trainable_names
         mesh = self.mesh
+        zero_stage = self.zero_stage
         specs = self._specs()
-        state_shardings = {n: NamedSharding(mesh, specs[n]) for n in names}
+        opt_specs = self._opt_specs(specs)
+        grad_shardings = {
+            n: NamedSharding(mesh, self._grad_spec(n, specs))
+            for n in trainable_names}
+        state_shardings = [NamedSharding(mesh, specs[n]) for n in names]
+        opt_shardings = {n: [NamedSharding(mesh, s) for s in slots]
+                         for n, slots in opt_specs.items()}
         batch_sharding = NamedSharding(mesh, self.batch_spec)
+        repl = NamedSharding(mesh, P())
 
-        def step(state_vals, opt_state, step_i, *batch):
+        def step(state_vals, opt_state, step_i, batch):
             state = dict(zip(names, state_vals))
 
             def loss_of(train_vals, batch):
@@ -115,6 +186,10 @@ class CompiledTrainStep:
 
             train_vals = [state[n] for n in trainable_names]
             loss, grads = jax.value_and_grad(loss_of)(train_vals, batch)
+            if zero_stage >= 2:
+                grads = [jax.lax.with_sharding_constraint(
+                    g, grad_shardings[n])
+                    for n, g in zip(trainable_names, grads)]
             gdict = dict(zip(trainable_names, grads))
             pdict = {n: state[n] for n in trainable_names}
             new_p, new_s = opt.functional_apply(pdict, gdict, opt_state,
@@ -124,32 +199,44 @@ class CompiledTrainStep:
                 out_state.append(new_p[n] if n in new_p else state[n])
             return loss, out_state, new_s
 
-        in_shardings = (
-            [state_shardings[n] for n in names],
-            jax.tree_util.tree_map(
-                lambda _: None, self._opt_state),  # propagate from args
-            None,
-        )
         self._compiled = jax.jit(
             step,
-            donate_argnums=(0, 1),
+            in_shardings=(state_shardings, opt_shardings, None,
+                          batch_sharding),
+            out_shardings=(repl, state_shardings, opt_shardings),
+            donate_argnums=(0, 1) if self.donate else (),
         )
+
+    def _prep_batch(self, batch):
+        return tuple(
+            jax.device_put(b._value if isinstance(b, Tensor)
+                           else jnp.asarray(b),
+                           NamedSharding(self.mesh, self.batch_spec))
+            for b in batch)
+
+    def lowered_hlo(self, *batch):
+        """Compiled HLO text of the step for these batch shapes (for tests
+        and profiling: lets callers assert which collectives XLA inserted)."""
+        if self._compiled is None:
+            self._build()
+        vals = self._prep_batch(batch)
+        state_vals = [self._tensors[n]._value for n in self._names]
+        return self._compiled.lower(
+            state_vals, self._opt_state,
+            jnp.asarray(0, jnp.int32), vals).compile().as_text()
 
     @no_grad()
     def __call__(self, *batch):
         """batch = (*inputs, labels) as Tensors or arrays; returns loss."""
         if self._compiled is None:
             self._build()
-        vals = [b._value if isinstance(b, Tensor) else jnp.asarray(b)
-                for b in batch]
-        vals = [jax.device_put(v, NamedSharding(self.mesh, self.batch_spec))
-                for v in vals]
-        tensors = self.model.raw_state_tensors()
+        vals = self._prep_batch(batch)
+        tensors = self._tensors
         state_vals = [tensors[n]._value for n in self._names]
         self._step_count += 1
         loss, new_state, new_opt = self._compiled(
             state_vals, self._opt_state,
-            jnp.asarray(self._step_count, jnp.int32), *vals)
+            jnp.asarray(self._step_count, jnp.int32), vals)
         for n, v in zip(self._names, new_state):
             tensors[n]._value = v
         self._opt_state = new_opt

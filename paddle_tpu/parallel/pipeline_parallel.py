@@ -11,11 +11,12 @@ is not process choreography but program structure. Two modes:
 - eager (this file): GPipe-style microbatch loop — forward all micro-batches
   stage by stage, backward in reverse; correct on any mesh, used for
   correctness tests and small runs.
-- compiled (`scan_pipeline` below): stages stacked into one extra leading
-  dim sharded over 'pp'; lax.scan + ppermute shift micro-batch activations
-  around the ring — the 1F1B steady state emerges from XLA pipelining the
-  collective-permute with the per-stage matmuls. This is the TPU analog of
-  the reference's interceptor runtime and what the Llama configs use.
+- compiled (`ring_pipeline` + `PipelinedTrainStep` below): stage params
+  stacked on a leading dim sharded over 'pp'; per step all stages compute
+  in parallel and the activation buffer rotates (collective-permute over
+  ICI) — the 1F1B steady state as program structure, with interleaved
+  virtual stages via vpp>1. This is the TPU analog of the reference's
+  interceptor runtime and what the Llama configs use.
 """
 from __future__ import annotations
 
@@ -135,28 +136,56 @@ class PipelineParallel(Layer):
     def forward(self, x):
         return self._layers(x)
 
-    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
-        """GPipe accumulation: forward+backward per micro-batch, grads
-        accumulate in .grad, then one optimizer step."""
-        import paddle_tpu as P
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None,
+                    schedule="1f1b"):
+        """Micro-batched accumulation step (reference train_batch →
+        forward_backward_pipeline, pipeline_parallel.py:117).
 
+        schedule='1f1b': warmup of (num_stages-1) forwards, then steady-state
+        alternating forward/backward, then cooldown — the reference's 1F1B
+        order, which bounds live microbatch activations at num_stages instead
+        of n_micro. schedule='gpipe': all forwards, then all backwards.
+        On a single controller both are numerically identical to sequential
+        accumulation; the compiled ring (PipelinedTrainStep) is the
+        performance path — this loop is the eager/debugging analog.
+        """
         inputs, labels = data
         n_micro = self.accumulate_steps
         batch = inputs.shape[0]
         micro = max(batch // n_micro, 1)
+        slices = [(inputs[i:i + micro], labels[i:i + micro])
+                  for i in range(0, batch, micro)]
+        n = len(slices)
         total_loss = None
         optimizer.clear_grad()
-        for i in range(0, batch, micro):
-            x = inputs[i:i + micro]
-            y = labels[i:i + micro]
+
+        def fwd(i):
+            nonlocal total_loss
+            x, y = slices[i]
             out = self._layers(x)
-            loss = self._layers._loss_fn(out, y)
-            loss = loss / n_micro
+            loss = self._layers._loss_fn(out, y) / n
+            total_loss = loss if total_loss is None else total_loss + loss
+            return loss
+
+        def bwd(loss):
             if scaler is not None:
                 scaler.scale(loss).backward()
             else:
                 loss.backward()
-            total_loss = loss if total_loss is None else total_loss + loss
+
+        if schedule == "gpipe":
+            pending = [fwd(i) for i in range(n)]
+            for loss in pending:
+                bwd(loss)
+        else:  # 1f1b
+            warmup = min(self._layers.num_stages - 1, n)
+            pending = [fwd(i) for i in range(warmup)]
+            for i in range(warmup, n):
+                pending.append(fwd(i))
+                bwd(pending.pop(0))
+            while pending:
+                bwd(pending.pop(0))
+
         if scaler is not None:
             scaler.step(optimizer)
         else:
@@ -186,40 +215,343 @@ class PipelineParallel(Layer):
         return self._layers.set_state_dict(sd, **k)
 
 
-def scan_pipeline(stage_fn, stacked_params, x_micro, num_stages, axis="pp"):
-    """Compiled ring pipeline: `stage_fn(params, x) -> x` applied across
-    `num_stages` stages whose params are stacked on dim 0 (sharded over the
-    pp mesh axis inside shard_map). Micro-batches stream through with
-    collective-permute shifts; total steps = n_micro + num_stages - 1.
+def ring_pipeline(stage_fn, stacked_params, micro_x, n_pp, vpp=1,
+                  constrain=None, remat=True):
+    """Compiled circular pipeline (the TPU-native 1F1B).
 
-    Used inside shard_map(..., axis_names={'pp'}): each pp position holds one
-    stage's params; activations rotate via ppermute — the XLA analog of the
-    reference's send_v2/recv_v2 chain (operators/collective/send_v2_op).
+    Parity: reference pipeline_parallel.py:117 (forward_backward_pipeline,
+    1F1B) and :461 (PipelineParallelWithInterleave, virtual stages) + the
+    send_v2/recv_v2 p2p ops. Here the whole schedule is ONE differentiable
+    program: stage params are stacked on a leading dim sharded over 'pp';
+    per step every stage applies its chunk in parallel (vmap over the stage
+    dim) and the activation buffer rotates one position (jnp.roll on the
+    'pp'-sharded dim -> XLA collective-permute over ICI). jax.grad through
+    the scan gives the backward pipeline in reverse ring order; per-stage
+    jax.checkpoint keeps live activations at O(n_pp + n_micro) — the 1F1B
+    memory profile — instead of GPipe's O(n_micro * L).
+
+    stage_fn(chunk_params, x) -> y; chunk_params leaves [layers_per_chunk,…].
+    stacked_params leaves: [n_pp, vpp, layers_per_chunk, ...].
+    micro_x: [n_micro, micro_batch, ...].
+    vpp > 1 = interleaved virtual stages (Megatron layout: chunk c on stage s
+    holds layers (c*n_pp + s)*lpc ...): microbatches go around the ring vpp
+    times, shrinking the bubble fraction from (n_pp-1)/n_micro to
+    (n_pp-1)/(vpp*n_micro); requires n_micro % n_pp == 0.
     """
-    n_micro = x_micro.shape[0]
-    stage_idx = jax.lax.axis_index(axis)
-    perm = [(i, (i + 1) % num_stages) for i in range(num_stages)]
+    n_micro = micro_x.shape[0]
+    if vpp > 1 and n_micro % n_pp != 0:
+        raise ValueError(
+            "interleaved schedule needs n_micro %% n_pp == 0 (got %d, %d)"
+            % (n_micro, n_pp))
+    cycle = vpp * n_pp
+    total = n_micro * vpp + n_pp - 1
+    sfn = jax.checkpoint(stage_fn) if remat else stage_fn
+    _c = constrain if constrain is not None else (lambda a: a)
 
-    buf = jnp.zeros_like(x_micro[0])
-    outputs = jnp.zeros_like(x_micro)
+    def apply_stage(s_idx, t, chunks_s, x):
+        # chunks_s leaves: [vpp, lpc, ...]; pick this stage's current chunk
+        if vpp == 1:
+            params = jax.tree_util.tree_map(lambda p: p[0], chunks_s)
+        else:
+            u = t - s_idx
+            c = jnp.clip(jnp.mod(u, cycle) // n_pp, 0, vpp - 1)
+            params = jax.tree_util.tree_map(
+                lambda p: jax.lax.dynamic_index_in_dim(
+                    p, c, 0, keepdims=False), chunks_s)
+        return sfn(params, x)
+
+    vstage = jax.vmap(apply_stage, in_axes=(0, None, 0, 0))
+    s_ids = jnp.arange(n_pp)
+
+    state = _c(jnp.zeros((n_pp,) + micro_x.shape[1:], micro_x.dtype))
+    outputs = jnp.zeros_like(micro_x)
 
     def step(carry, t):
-        buf, outputs = carry
-        # stage 0 injects micro-batch t (while it exists)
-        inject = jnp.where(t < n_micro, t, n_micro - 1)
-        x_in = jnp.where(stage_idx == 0, x_micro[inject], buf)
-        y = stage_fn(jax.tree_util.tree_map(lambda p: p, stacked_params), x_in)
-        # last stage writes result for micro-batch (t - num_stages + 1)
-        out_t = t - (num_stages - 1)
-        ok = (stage_idx == num_stages - 1) & (out_t >= 0)
-        outputs = jax.lax.cond(
-            ok,
-            lambda o: o.at[jnp.maximum(out_t, 0)].set(y),
-            lambda o: o,
-            outputs)
-        buf = jax.lax.ppermute(y, axis, perm)
-        return (buf, outputs), None
+        state, outputs = carry
+        # inject into stage 0 while fresh microbatches remain
+        if vpp == 1:
+            m_in = jnp.clip(t, 0, n_micro - 1)
+            do_inject = t < n_micro
+        else:
+            q0 = jnp.mod(t, cycle)
+            m_in = jnp.clip((t // cycle) * n_pp + q0, 0, n_micro - 1)
+            do_inject = (q0 < n_pp) & (t < n_micro * vpp)
+        inj = jax.lax.dynamic_index_in_dim(micro_x, m_in, 0, keepdims=False)
+        state = state.at[0].set(jnp.where(do_inject, inj, state[0]))
+        y = _c(vstage(s_ids, t, stacked_params, _c(state)))
+        # extract finished microbatch from the last stage
+        u = t - (n_pp - 1)
+        if vpp == 1:
+            m_out = jnp.clip(u, 0, n_micro - 1)
+            do_out = (u >= 0) & (u < n_micro)
+        else:
+            q = jnp.mod(u, cycle)
+            m_out = jnp.clip((u // cycle) * n_pp + jnp.mod(q, n_pp),
+                             0, n_micro - 1)
+            do_out = (u >= 0) & (q // n_pp == vpp - 1) & (u < n_micro * vpp)
+        cur = jax.lax.dynamic_index_in_dim(outputs, m_out, 0, keepdims=False)
+        outputs = jax.lax.dynamic_update_index_in_dim(
+            outputs, jnp.where(do_out, y[-1], cur), m_out, 0)
+        state = jnp.roll(y, 1, axis=0)  # stage s output -> stage s+1 input
+        return (state, outputs), None
 
-    (buf, outputs), _ = jax.lax.scan(
-        step, (buf, outputs), jnp.arange(n_micro + num_stages - 1))
+    (state, outputs), _ = jax.lax.scan(
+        step, (state, outputs), jnp.arange(total))
     return outputs
+
+
+class PipelinedTrainStep:
+    """jit-compiled pipeline-parallel train step over the current mesh.
+
+    Wires ring_pipeline into a decoder model that exposes the pipeline
+    protocol (pipeline_blocks / forward_embed / forward_head — e.g.
+    LlamaForCausalLM): block params are stacked [n_pp, vpp, lpc, ...] and
+    sharded over 'pp'; embed/head stay outside the ring (replicated or
+    mp-sharded); forward+backward+update is ONE XLA module, composing with
+    dp/mp shardings on the other mesh axes. This replaces the reference's
+    process-choreographed 1F1B (pipeline_parallel.py:117) with program
+    structure.
+    """
+
+    def __init__(self, model, loss_fn, optimizer, n_micro, vpp=1, mesh=None,
+                 donate=True, remat=True):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..distributed import mesh as _mesh
+        from .engine import _normalize_spec
+
+        self.model = model
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self.mesh = mesh or _mesh.get_mesh()
+        self.n_micro = n_micro
+        self.vpp = vpp
+        self.remat = remat
+        self.donate = donate
+        if "pp" not in self.mesh.axis_names:
+            raise ValueError("PipelinedTrainStep needs a 'pp' mesh axis")
+        self.n_pp = self.mesh.shape["pp"]
+
+        blocks = list(model.pipeline_blocks())
+        L = len(blocks)
+        n_chunks = self.n_pp * vpp
+        if L % n_chunks != 0:
+            raise ValueError(
+                "num layers %d not divisible by pp*vpp=%d" % (L, n_chunks))
+        self.lpc = L // n_chunks
+        self.template = blocks[0]
+        self.suffixes = self.template.functional_state()[0]
+        # block buffers / frozen params ride through the pipeline but are
+        # NOT optimized (mirrors _nb_trainable filtering below)
+        self._train_sfx = [
+            n for n, prm in self.template.named_parameters()
+            if not prm.stop_gradient]
+
+        # Megatron interleaved layout: chunk c on stage s holds layers
+        # (c*n_pp + s)*lpc ... +lpc  (reference pp_layers.py:209 interleave)
+        def layer_values(suffix):
+            per = []
+            for s in range(self.n_pp):
+                row = []
+                for c in range(vpp):
+                    lo = (c * self.n_pp + s) * self.lpc
+                    row.append(jnp.stack(
+                        [blocks[lo + j].raw_state_tensors()[suffix]._value
+                         for j in range(self.lpc)]))
+                per.append(jnp.stack(row))
+            return jnp.stack(per)  # [n_pp, vpp, lpc, ...]
+
+        self._blocks = blocks
+        self._stacked = {sfx: layer_values(sfx) for sfx in self.suffixes}
+
+        # non-block params/buffers (embed, final norm, lm head)
+        block_ids = set()
+        for b in blocks:
+            for t in b.raw_state_tensors().values():
+                block_ids.add(id(t))
+        tensors = model.raw_state_tensors()
+        all_names = model.functional_state()[0]
+        self._nb_names = [n for n in all_names
+                          if id(tensors[n]) not in block_ids]
+        self._nb_trainable = [
+            n for n, p in model.named_parameters()
+            if id(p) not in block_ids and not p.stop_gradient]
+
+        # shardings: stacked leaves get ('pp', None, None) + the template
+        # param's own spec (mp for mpu layers); non-block via explicit spec
+        def stacked_spec(sfx):
+            t = self.template.raw_state_tensors()[sfx]
+            base = _normalize_spec(t._sharding_spec, len(t.shape)) \
+                if t._sharding_spec is not None else [None] * len(t.shape)
+            return P("pp", None, None, *base)
+
+        self._stacked_specs = {s: stacked_spec(s) for s in self.suffixes}
+        self._nb_specs = {}
+        for n in self._nb_names:
+            t = tensors[n]
+            self._nb_specs[n] = (t._sharding_spec
+                                 if t._sharding_spec is not None else P())
+        self._ns = lambda spec: NamedSharding(self.mesh, spec)
+        # place
+        for n in self._nb_names:
+            tensors[n]._value = jax.device_put(
+                tensors[n]._value, self._ns(self._nb_specs[n]))
+        for s in self.suffixes:
+            self._stacked[s] = jax.device_put(
+                self._stacked[s], self._ns(self._stacked_specs[s]))
+
+        pdict = {n: tensors[n]._value for n in self._nb_trainable}
+        pdict.update({"pp_blocks." + s: self._stacked[s]
+                      for s in self._train_sfx})
+        self._opt_state = optimizer.functional_init(pdict)
+        for name, slots in self._opt_state.items():
+            spec = (self._stacked_specs[name[len("pp_blocks."):]]
+                    if name.startswith("pp_blocks.")
+                    else self._nb_specs[name])
+            self._opt_state[name] = [
+                jax.device_put(sl, self._ns(spec))
+                if jnp.shape(sl) else sl for sl in slots]
+
+        self._dp = "dp" if "dp" in self.mesh.axis_names else None
+        self.batch_spec = P(self._dp) if self._dp else P()
+        self._step_count = 0
+        self._compiled = None
+
+    # -- forward pieces ----------------------------------------------------
+
+    def _stage_fn(self):
+        template, suffixes = self.template, self.suffixes
+
+        def stage(chunk_params, x):
+            # chunk_params: list of leaves [lpc, ...] aligned with suffixes
+            def body(h, per_layer):
+                out = template.functional_call(per_layer, Tensor(h),
+                                               state_names=suffixes)
+                return (out._value if isinstance(out, Tensor) else out), None
+
+            h, _ = jax.lax.scan(body, x, chunk_params)
+            return h
+
+        return stage
+
+    def _constrain(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh, dp = self.mesh, self._dp
+
+        def c(a):
+            spec = P("pp", dp, *([None] * (a.ndim - 2)))
+            return jax.lax.with_sharding_constraint(
+                a, NamedSharding(mesh, spec))
+
+        return c
+
+    def _build(self):
+        from ..core.dispatch import no_grad
+
+        model, loss_fn, opt = self.model, self.loss_fn, self.optimizer
+        nb_names, nb_trainable = self._nb_names, self._nb_trainable
+        suffixes = self.suffixes
+        n_micro, n_pp, vpp = self.n_micro, self.n_pp, self.vpp
+        stage = self._stage_fn()
+        constrain = self._constrain()
+        remat = self.remat
+
+        train_sfx = self._train_sfx
+
+        def step(nb_vals, stacked_vals, opt_state, step_i, batch):
+            nb_state = dict(zip(nb_names, nb_vals))
+            stacked_state = dict(zip(suffixes, stacked_vals))
+
+            def loss_of(train, batch):
+                nb_train, st_train = train
+                stacked = dict(stacked_state)
+                stacked.update(st_train)
+                full = dict(nb_state)
+                full.update(dict(zip(nb_trainable, nb_train)))
+                ids, labels = batch
+                with model.bind_state(nb_names,
+                                      [full[n] for n in nb_names]):
+                    with no_grad():
+                        x = model.forward_embed(Tensor(ids))
+                        x = x._value if isinstance(x, Tensor) else x
+                        B = x.shape[0]
+                        mb = B // n_micro
+                        micro = x.reshape((n_micro, mb) + x.shape[1:])
+                        out = ring_pipeline(
+                            stage, [stacked[s] for s in suffixes], micro,
+                            n_pp, vpp=vpp, constrain=constrain, remat=remat)
+                        h = out.reshape((B,) + out.shape[2:])
+                        logits = model.forward_head(Tensor(h))
+                    loss = loss_fn(logits, Tensor(labels))
+                return loss._value if isinstance(loss, Tensor) else loss
+
+            train = ([nb_state[n] for n in nb_trainable],
+                     {s: stacked_state[s] for s in train_sfx})
+            loss, grads = jax.value_and_grad(loss_of)(train, batch)
+            g_nb, g_stacked = grads
+            pdict = {n: nb_state[n] for n in nb_trainable}
+            pdict.update({"pp_blocks." + s: train[1][s] for s in train_sfx})
+            gdict = dict(zip(nb_trainable, g_nb))
+            gdict.update({"pp_blocks." + s: g_stacked[s] for s in train_sfx})
+            new_p, new_s = opt.functional_apply(pdict, gdict, opt_state,
+                                                step=step_i)
+            out_nb = [new_p.get(n, nb_state[n]) for n in nb_names]
+            out_stacked = [new_p.get("pp_blocks." + s, stacked_state[s])
+                           for s in suffixes]
+            return loss, out_nb, out_stacked, new_s
+
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        nb_sh = [self._ns(self._nb_specs[n]) for n in nb_names]
+        st_sh = [self._ns(self._stacked_specs[s]) for s in suffixes]
+        opt_sh = {}
+        for name, slots in self._opt_state.items():
+            spec = (self._stacked_specs[name[len("pp_blocks."):]]
+                    if name.startswith("pp_blocks.")
+                    else self._nb_specs[name])
+            opt_sh[name] = [self._ns(spec) if jnp.shape(sl) else
+                            self._ns(P()) for sl in slots]
+        self._compiled = jax.jit(
+            step,
+            in_shardings=(nb_sh, st_sh, opt_sh, None,
+                          self._ns(self.batch_spec)),
+            out_shardings=(self._ns(P()), nb_sh, st_sh, opt_sh),
+            donate_argnums=(0, 1, 2) if self.donate else (),
+        )
+
+    def __call__(self, input_ids, labels):
+        from ..core.dispatch import no_grad
+
+        if self._compiled is None:
+            self._build()
+        with no_grad():
+            batch = tuple(
+                jax.device_put(b._value if isinstance(b, Tensor)
+                               else jnp.asarray(b),
+                               self._ns(self.batch_spec))
+                for b in (input_ids, labels))
+            tensors = self.model.raw_state_tensors()
+            nb_vals = [tensors[n]._value for n in self._nb_names]
+            stacked_vals = [self._stacked[s] for s in self.suffixes]
+            self._step_count += 1
+            loss, new_nb, new_stacked, new_opt = self._compiled(
+                nb_vals, stacked_vals, self._opt_state,
+                jnp.asarray(self._step_count, jnp.int32), batch)
+            for n, v in zip(self._nb_names, new_nb):
+                tensors[n]._value = v
+            self._stacked = dict(zip(self.suffixes, new_stacked))
+            self._opt_state = new_opt
+            return Tensor(loss)
+
+    def sync_to_model(self):
+        """Write the stacked block params back into the per-layer tensors
+        (for state_dict / checkpoint save)."""
+        for sfx in self.suffixes:
+            arr = self._stacked[sfx]
+            for s in range(self.n_pp):
+                for c in range(self.vpp):
+                    lo = (c * self.n_pp + s) * self.lpc
+                    for j in range(self.lpc):
+                        t = self._blocks[lo + j].raw_state_tensors()[sfx]
+                        t._value = arr[s, c, j]

@@ -54,6 +54,8 @@ def viterbi_decode(potentials, transition_params, lengths,
     if max_len:
         for b in range(B):
             L = int(lens[b])
+            if L <= 0:  # zero-length sequence: empty path, no backtrace
+                continue
             tag = int(last_tag[b])
             paths[b, L - 1] = tag
             for t in range(L - 2, -1, -1):

@@ -1,0 +1,214 @@
+"""Compiled pipeline parallelism (VERDICT #2).
+
+Parity: reference fleet/meta_parallel/pipeline_parallel.py:117 (1F1B),
+:461 (interleaved virtual stages). Golden test: the ring pipeline over a
+'pp' mesh axis must produce the SAME loss sequence as the plain compiled
+step at pp=1 — pipelining is program structure, not different math.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.distributed import mesh as pmesh
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.parallel.engine import CompiledTrainStep
+from paddle_tpu.parallel.pipeline_parallel import (
+    PipelinedTrainStep,
+    ring_pipeline,
+)
+
+VOCAB = 128
+N_LAYERS = 4
+
+
+def _cfg(**kw):
+    d = dict(hidden_size=32, num_attention_heads=2, intermediate_size=64,
+             num_hidden_layers=N_LAYERS, vocab_size=VOCAB,
+             use_parallel=False)
+    d.update(kw)
+    return LlamaConfig.tiny(**d)
+
+
+def _data(batch=8, seq=16, seed=0):
+    rng = np.random.RandomState(seed)
+    ids = rng.randint(0, VOCAB, (batch, seq)).astype(np.int32)
+    labels = rng.randint(0, VOCAB, (batch, seq)).astype(np.int32)
+    return ids, labels
+
+
+def _loss_fn(logits, labels):
+    return F.cross_entropy(logits.reshape([-1, VOCAB]),
+                           labels.reshape([-1]))
+
+
+def _golden_losses(n_steps=3):
+    """Reference loss sequence: plain compiled step on a 1-axis mesh."""
+    pmesh.build_hybrid_mesh(dp=8, mp=1)
+    paddle.seed(0)
+    model = LlamaForCausalLM(_cfg())
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+    step = CompiledTrainStep(model, _loss_fn, opt)
+    ids, labels = _data()
+    return [float(step(paddle.to_tensor(ids), paddle.to_tensor(labels)))
+            for _ in range(n_steps)]
+
+
+class TestRingPipelineUnit:
+    """ring_pipeline against a direct sequential apply (no mesh needed)."""
+
+    def _params(self, n_pp, vpp, lpc, dim=8, seed=0):
+        rng = np.random.RandomState(seed)
+        L = n_pp * vpp * lpc
+        ws = rng.randn(L, dim, dim).astype(np.float32) * 0.1
+        # Megatron layout [n_pp, vpp, lpc, ...]
+        arr = np.zeros((n_pp, vpp, lpc, dim, dim), np.float32)
+        for s in range(n_pp):
+            for c in range(vpp):
+                lo = (c * n_pp + s) * lpc
+                arr[s, c] = ws[lo:lo + lpc]
+        return ws, jnp.asarray(arr)
+
+    @pytest.mark.parametrize("n_pp,vpp,lpc,n_micro", [
+        (4, 1, 1, 4), (4, 1, 2, 8), (2, 2, 1, 4), (4, 2, 1, 8),
+        (2, 1, 1, 3),  # n_micro not divisible by n_pp (vpp=1 path)
+    ])
+    def test_matches_sequential(self, n_pp, vpp, lpc, n_micro):
+        dim = 8
+        ws, stacked = self._params(n_pp, vpp, lpc, dim)
+
+        def stage(chunk_params, x):
+            def body(h, ws):
+                return jnp.tanh(h @ ws[0]), None
+            h, _ = jax.lax.scan(body, x, chunk_params)
+            return h
+
+        rng = np.random.RandomState(1)
+        micro = jnp.asarray(rng.randn(n_micro, 2, dim).astype(np.float32))
+        out = ring_pipeline(stage, [stacked], micro, n_pp, vpp=vpp)
+        # sequential oracle
+        ref = micro
+        for i in range(len(ws)):
+            ref = jnp.tanh(ref @ ws[i])
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_differentiable(self):
+        n_pp, vpp, lpc, dim = 2, 2, 1, 8
+        ws, stacked = self._params(n_pp, vpp, lpc, dim)
+
+        def stage(chunk_params, x):
+            def body(h, ws):
+                return jnp.tanh(h @ ws[0]), None
+            h, _ = jax.lax.scan(body, x, chunk_params)
+            return h
+
+        rng = np.random.RandomState(1)
+        micro = jnp.asarray(rng.randn(4, 2, dim).astype(np.float32))
+
+        def loss_pipe(p):
+            return jnp.sum(ring_pipeline(stage, [p], micro, n_pp, vpp=vpp))
+
+        def loss_seq(wflat):
+            h = micro
+            for i in range(wflat.shape[0]):
+                h = jnp.tanh(h @ wflat[i])
+            return jnp.sum(h)
+
+        g_pipe = jax.grad(loss_pipe)(stacked)
+        g_seq = jax.grad(loss_seq)(jnp.asarray(ws))
+        # map layerwise grads into the Megatron layout and compare
+        for s in range(n_pp):
+            for c in range(vpp):
+                lo = (c * n_pp + s) * lpc
+                np.testing.assert_allclose(
+                    np.asarray(g_pipe[s, c]), np.asarray(g_seq[lo:lo + lpc]),
+                    rtol=1e-4, atol=1e-5)
+
+
+class TestPipelinedTrainStep:
+    def test_pp4_matches_pp1_golden_losses(self):
+        golden = _golden_losses()
+        pmesh.build_hybrid_mesh(dp=2, mp=1, pp=4)
+        paddle.seed(0)
+        model = LlamaForCausalLM(_cfg())
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=model.parameters())
+        step = PipelinedTrainStep(model, _loss_fn, opt, n_micro=4)
+        ids, labels = _data()
+        losses = [float(step(paddle.to_tensor(ids),
+                             paddle.to_tensor(labels)))
+                  for _ in range(len(golden))]
+        np.testing.assert_allclose(losses, golden, rtol=5e-4)
+
+    def test_interleaved_pp2_vpp2_matches_golden(self):
+        golden = _golden_losses()
+        pmesh.build_hybrid_mesh(dp=4, mp=1, pp=2)
+        paddle.seed(0)
+        model = LlamaForCausalLM(_cfg())
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=model.parameters())
+        step = PipelinedTrainStep(model, _loss_fn, opt, n_micro=4, vpp=2)
+        ids, labels = _data()
+        losses = [float(step(paddle.to_tensor(ids),
+                             paddle.to_tensor(labels)))
+                  for _ in range(len(golden))]
+        np.testing.assert_allclose(losses, golden, rtol=5e-4)
+
+    def test_pp_with_mp_compiles_and_learns(self):
+        pmesh.build_hybrid_mesh(dp=2, mp=2, pp=2)
+        paddle.seed(0)
+        model = LlamaForCausalLM(_cfg(use_parallel=True))
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=model.parameters())
+        step = PipelinedTrainStep(model, _loss_fn, opt, n_micro=2)
+        ids, labels = _data()
+        first = float(step(paddle.to_tensor(ids), paddle.to_tensor(labels)))
+        for _ in range(5):
+            last = float(step(paddle.to_tensor(ids),
+                              paddle.to_tensor(labels)))
+        assert np.isfinite(first) and last < first
+
+    def test_collective_permute_in_hlo(self):
+        """The ring shift must lower to collective-permute (the ICI p2p of
+        the reference's send_v2/recv_v2), not all-gather of everything."""
+        pmesh.build_hybrid_mesh(dp=2, mp=1, pp=4)
+        paddle.seed(0)
+        model = LlamaForCausalLM(_cfg())
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=model.parameters())
+        step = PipelinedTrainStep(model, _loss_fn, opt, n_micro=4)
+        step._build()
+        ids, labels = _data()
+        batch = tuple(jnp.asarray(v) for v in (ids, labels))
+        tensors = model.raw_state_tensors()
+        nb_vals = [tensors[n]._value for n in step._nb_names]
+        stacked_vals = [step._stacked[s] for s in step.suffixes]
+        hlo = step._compiled.lower(
+            nb_vals, stacked_vals, step._opt_state,
+            jnp.asarray(0, jnp.int32), batch).compile().as_text()
+        assert "collective-permute" in hlo
+
+    def test_sync_to_model_roundtrip(self):
+        pmesh.build_hybrid_mesh(dp=4, mp=1, pp=2)
+        paddle.seed(0)
+        model = LlamaForCausalLM(_cfg())
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=model.parameters())
+        step = PipelinedTrainStep(model, _loss_fn, opt, n_micro=2)
+        ids, labels = _data()
+        step(paddle.to_tensor(ids), paddle.to_tensor(labels))
+        before = np.asarray(
+            model.llama.layers[0].self_attn.q_proj.weight._value).copy()
+        step.sync_to_model()
+        after = np.asarray(
+            model.llama.layers[0].self_attn.q_proj.weight._value)
+        assert not np.allclose(before, after)  # training moved the weights
+        # stacked source equals the written-back layer values
+        np.testing.assert_array_equal(
+            after, np.asarray(step._stacked[
+                "self_attn.q_proj.weight"][0, 0, 0]))

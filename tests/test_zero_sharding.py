@@ -1,0 +1,154 @@
+"""ZeRO stage semantics (VERDICT #3).
+
+Parity: reference fleet/meta_parallel/sharding/group_sharded_stage2.py,
+group_sharded_stage3.py, dygraph_sharding_optimizer.py:29.
+
+  stage 1: optimizer state sharded over 'sharding'; params replicated
+  stage 2: + gradients reduce-scattered (assert on compiled HLO)
+  stage 3: + parameters sharded (assert per-device bytes shrink ~N x)
+
+All stages must produce the same loss (sharding is layout, not math).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.distributed import mesh as pmesh
+from paddle_tpu.parallel.engine import CompiledTrainStep
+
+N_SHARD = 8
+DIM = 64
+
+
+def _shard_bytes(arr):
+    """Bytes held by one device for this jax.Array."""
+    shape = arr.sharding.shard_shape(arr.shape)
+    return int(np.prod(shape)) * arr.dtype.itemsize
+
+
+def _total_bytes(arr):
+    return int(np.prod(arr.shape)) * arr.dtype.itemsize
+
+
+def _build(stage):
+    pmesh.build_hybrid_mesh(dp=1, mp=1, sharding=N_SHARD)
+    paddle.seed(0)
+    model = nn.Sequential(
+        nn.Linear(DIM, 4 * DIM), nn.ReLU(), nn.Linear(4 * DIM, DIM))
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+    step = CompiledTrainStep(
+        model, lambda out, y: F.mse_loss(out, y), opt, zero_stage=stage)
+    return model, opt, step
+
+
+def _batch():
+    rng = np.random.RandomState(0)
+    x = rng.randn(16, DIM).astype(np.float32)
+    y = rng.randn(16, DIM).astype(np.float32)
+    return paddle.to_tensor(x), paddle.to_tensor(y)
+
+
+class TestZeroStages:
+    def test_stage0_everything_replicated(self):
+        model, _, step = _build(0)
+        for p in model.parameters():
+            assert _shard_bytes(p._value) == _total_bytes(p._value)
+        for slots in step._opt_state.values():
+            for s in slots:
+                assert _shard_bytes(s) == _total_bytes(s)
+
+    def test_stage1_opt_state_sharded_params_replicated(self):
+        model, _, step = _build(1)
+        for p in model.parameters():
+            assert _shard_bytes(p._value) == _total_bytes(p._value)
+        saved = 0
+        for n, slots in step._opt_state.items():
+            for s in slots:
+                if s.shape and s.ndim >= 1 and any(
+                        d % N_SHARD == 0 and d >= N_SHARD for d in s.shape):
+                    assert _shard_bytes(s) * N_SHARD == _total_bytes(s), (
+                        n, s.shape, s.sharding)
+                    saved += 1
+        assert saved >= 4  # Adam m+v for both Linear weights at least
+
+    def test_stage2_grads_reduce_scattered(self):
+        """The grad -> sharded-update -> all-gather(params) ZeRO-2 pattern.
+
+        On TPU XLA emits reduce-scatter for the partial->sharded grad hop;
+        the CPU backend lowers the same semantics as all-reduce + slice
+        (no reduce-scatter-creator pass), so assert the portable signature:
+        the update runs sharded and new params are all-gathered back.
+        """
+        _, _, step = _build(2)
+        x, y = _batch()
+        hlo = step.lowered_hlo(x, y)
+        assert "reduce-scatter" in hlo or "all-gather" in hlo, hlo[-2000:]
+
+    def test_stage0_no_param_allgather(self):
+        """Replicated baseline: grads all-reduced, nothing gathered."""
+        _, _, step = _build(0)
+        x, y = _batch()
+        hlo = step.lowered_hlo(x, y)
+        assert "all-gather" not in hlo
+        assert "reduce-scatter" not in hlo
+
+    def test_stage3_params_sharded_nx_memory(self):
+        model, _, step = _build(3)
+        shard_total = sum(_shard_bytes(p._value) for p in model.parameters())
+        full_total = sum(_total_bytes(p._value) for p in model.parameters())
+        # weights shard N x; small biases may stay replicated
+        assert shard_total * 2 <= full_total, (shard_total, full_total)
+        weights = [p for p in model.parameters() if len(p.shape) == 2]
+        for p in weights:
+            assert _shard_bytes(p._value) * N_SHARD == _total_bytes(p._value)
+        for slots in step._opt_state.values():
+            for s in slots:
+                if s.ndim == 2:
+                    assert _shard_bytes(s) * N_SHARD == _total_bytes(s)
+
+    def test_all_stages_same_loss(self):
+        losses = {}
+        for stage in (0, 1, 2, 3):
+            _, _, step = _build(stage)
+            x, y = _batch()
+            losses[stage] = float(step(x, y))
+        base = losses[0]
+        for stage, v in losses.items():
+            assert np.isfinite(v)
+            np.testing.assert_allclose(v, base, rtol=2e-5, err_msg=str(stage))
+
+    def test_loss_decreases_stage3(self):
+        _, _, step = _build(3)
+        x, y = _batch()
+        first = float(step(x, y))
+        for _ in range(10):
+            last = float(step(x, y))
+        assert last < first
+
+    def test_zero_composes_with_mp(self):
+        """Explicit mp annotation wins on its dim; ZeRO shards another."""
+        pmesh.build_hybrid_mesh(dp=1, mp=2, sharding=4)
+        paddle.seed(0)
+        from jax.sharding import PartitionSpec as P
+
+        model = nn.Linear(DIM, DIM)
+        model.weight._sharding_spec = P(None, "mp")
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=model.parameters())
+        step = CompiledTrainStep(
+            model, lambda out, y: F.mse_loss(out, y), opt, zero_stage=2)
+        x, y = _batch()
+        loss = float(step(x, y))
+        assert np.isfinite(loss)
+        spec = model.weight._value.sharding.spec
+        assert tuple(spec) == (None, "mp"), spec
+        # opt-state moments should carry BOTH mp and sharding axes
+        m = step._opt_state["weight"][0]
+        mspec = tuple(m.sharding.spec)
+        assert "sharding" in mspec and "mp" in mspec, mspec
