@@ -26,9 +26,34 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,
                   use_softmax=True, label_smoothing=0.0):
     x = _A(input)
     lbl = _A(label)
+    n_cls = x.shape[axis]
+    if (use_softmax and not soft_label and weight is None
+            and label_smoothing == 0.0):
+        # Hot path (decoder LM loss): loss = logsumexp(x) - x[label] on
+        # fp32-upcast logits. Cheaper than log_softmax both ways: forward
+        # reduces [N, V] to [N] without materializing log-probabilities,
+        # and the VJP is softmax(x) - onehot recomputed from (x, lse)
+        # elementwise rather than saving a second [N, V] residual.
+        # (reference fuses the same pair in
+        # phi/kernels/gpu/cross_entropy_kernel.cu)
+        li = lbl.astype(jnp.int32)
+        if li.ndim == x.ndim and li.shape[axis] == 1:
+            li = jnp.squeeze(li, axis=axis)
+        xf = x.astype(jnp.float32)
+        lse = jax.nn.logsumexp(xf, axis=axis)
+        picked = jnp.take_along_axis(
+            xf, jnp.expand_dims(jnp.clip(li, 0, n_cls - 1), axis), axis=axis)
+        loss = lse - jnp.squeeze(picked, axis=axis)
+        valid = li != ignore_index
+        loss = jnp.where(valid, loss, 0.0)
+        if reduction == "mean":
+            denom = jnp.sum(valid.astype(loss.dtype))
+            return jnp.sum(loss) / jnp.maximum(denom, 1.0)
+        # fp32 statistics, input-dtype result — same contract as the
+        # log_softmax path below (bf16 in -> bf16 per-token loss)
+        return _reduce(loss, reduction).astype(x.dtype)
     logp = jax.nn.log_softmax(x, axis=axis) if use_softmax else jnp.log(
         jnp.maximum(x, 1e-30))
-    n_cls = x.shape[axis]
     if soft_label:
         soft = _A(lbl).astype(logp.dtype)
         loss = -jnp.sum(soft * logp, axis=axis)
