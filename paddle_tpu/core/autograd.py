@@ -29,17 +29,26 @@ class GradNode:
         "inputs",
         "out_avals",
         "freed",
+        "pure_fn",
     )
 
-    def __init__(self, name, vjp_fn, input_tensors, out_vals):
+    def __init__(self, name, vjp_fn, input_tensors, out_vals, pure_fn=None):
         self.name = name
         self.vjp_fn = vjp_fn
         self.inputs = list(input_tensors)
         self.out_avals = [
-            jax.ShapeDtypeStruct(jnp.shape(v), jnp.result_type(v))
+            jax.ShapeDtypeStruct(
+                jnp.shape(v),
+                getattr(v, "dtype", None) if getattr(v, "dtype", None)
+                is not None else jnp.result_type(v))
             for v in out_vals
         ]
         self.freed = False
+        # the op's pure array->arrays body; kept so create_graph backward
+        # can re-linearize the grad computation w.r.t. the PRIMALS (the
+        # reference's double-grad nodes are generated the same way from
+        # the op's grad-of-grad signature, eager_gen.py)
+        self.pure_fn = pure_fn
 
     def __repr__(self):
         return "GradNode(%s)" % self.name
@@ -73,26 +82,67 @@ def _accum(a, b):
     return b if a is None else a + b
 
 
+def _traced_grad_call(node, cot_tensors, float_idx):
+    """Evaluate `node`'s input grads as a RECORDED differentiable op of
+    (primal inputs + output cotangents) — the create_graph path.
+
+    Re-linearizes the op body w.r.t. its primals inside the call so the
+    second-order dependence through vjp residuals is captured (grads of
+    grads w.r.t. x, the gradient-penalty path). Mirrors the reference's
+    generated double-grad nodes (eager_gen.py grad-of-grad signatures).
+    """
+    from . import dispatch as _dispatch
+
+    n_in = len(node.inputs)
+    avals = node.out_avals
+    pure_fn = node.pure_fn
+    fidx = tuple(float_idx)
+
+    def grad_fn(*vs):
+        primals, cotv = vs[:n_in], vs[n_in:]
+        _, vjp2 = jax.vjp(pure_fn, *primals)
+        full = []
+        it = iter(cotv)
+        for i, av in enumerate(avals):
+            full.append(next(it) if i in fidx else _zero_cotangent(av))
+        return vjp2(tuple(full))
+
+    return _dispatch.call_traced(grad_fn, list(node.inputs) + cot_tensors,
+                                 name="grad::" + node.name)
+
+
 def run_backward(
     roots,
     root_grads,
     retain_graph=False,
     capture=None,
     accumulate_grad=True,
+    create_graph=False,
 ):
     """Reverse walk from `roots` (Tensors) seeded with `root_grads` (arrays).
 
     capture: optional dict id(tensor) -> None; filled with accumulated grad
     arrays for those tensors (used by paddle_tpu.grad()).
     Returns nothing; leaf Tensors get .grad accumulated when accumulate_grad.
+
+    create_graph=True runs the walk in Tensor space: cotangents are
+    Tensors, every vjp evaluation and accumulation is itself recorded on
+    the tape, so the returned grads are differentiable (reference
+    GeneralGrad create_graph, backward.cc:390).
     """
     pending = {}  # node -> list[cotangent or None] per output index
     deps = {}  # node -> count of incoming edges from reachable consumers
+
+    def _as_cot(g):
+        if create_graph and not isinstance(g, Tensor):
+            return Tensor(g, stop_gradient=True)
+        return g
 
     def route(t, g):
         """Deliver cotangent g to tensor t."""
         if g is None or (hasattr(g, "dtype") and g.dtype == jax.dtypes.float0):
             return
+        g = _as_cot(g)
         if capture is not None and id(t) in capture:
             capture[id(t)] = _accum(capture[id(t)], g)
         if t.stop_gradient:
@@ -100,10 +150,11 @@ def run_backward(
         node = t._grad_node
         if node is None:
             if accumulate_grad:
+                gv = g._value if isinstance(g, Tensor) else g
                 if t.grad is None:
-                    t.grad = Tensor(g, stop_gradient=True)
+                    t.grad = Tensor(gv, stop_gradient=True)
                 else:
-                    t.grad._value = t.grad._value + g
+                    t.grad._value = t.grad._value + gv
             return
         lst = pending[node]
         lst[t._out_index] = _accum(lst[t._out_index], g)
@@ -147,11 +198,29 @@ def run_backward(
     while queue:
         node = queue.pop()
         processed.append(node)
-        cots = [
-            c if c is not None else _zero_cotangent(av)
-            for c, av in zip(pending[node], node.out_avals)
-        ]
-        in_grads = node.vjp_fn(tuple(cots))
+        if create_graph and node.pure_fn is not None:
+            # differentiable path: record the vjp evaluation as an op of
+            # (primals + cotangents); inputs' own grad nodes chain x-paths
+            float_idx = [i for i, av in enumerate(node.out_avals)
+                         if _is_float_dtype(av.dtype)]
+            cot_tensors = []
+            for i in float_idx:
+                c = pending[node][i]
+                if c is None:
+                    av = node.out_avals[i]
+                    c = Tensor(jnp.zeros(av.shape, av.dtype),
+                               stop_gradient=True)
+                cot_tensors.append(c)
+            in_grads = _traced_grad_call(node, cot_tensors, float_idx)
+            if not isinstance(in_grads, (tuple, list)):
+                in_grads = (in_grads,)
+        else:
+            cots = [
+                (c._value if isinstance(c, Tensor) else c)
+                if c is not None else _zero_cotangent(av)
+                for c, av in zip(pending[node], node.out_avals)
+            ]
+            in_grads = node.vjp_fn(tuple(cots))
         for t, g in zip(node.inputs, in_grads):
             route(t, g)
             if not t.stop_gradient and t._grad_node is not None:
@@ -164,6 +233,7 @@ def run_backward(
         for node in pending:
             node.vjp_fn = None
             node.inputs = []
+            node.pure_fn = None
             node.freed = True
 
 
@@ -192,14 +262,13 @@ def grad(
 ):
     """paddle.grad analog (reference eager GeneralGrad, backward.cc:390).
 
-    create_graph (double backward) is served by the functional transform
-    path (paddle_tpu.incubate.autograd) rather than the eager tape.
+    create_graph=True returns DIFFERENTIABLE grads: the backward walk is
+    itself recorded on the eager tape (each vjp evaluation re-linearized
+    against the op primals, see _traced_grad_call), so a second
+    backward/grad over the result computes true second-order derivatives
+    — the gradient-penalty pattern. Functional higher-order transforms
+    (jvp/Jacobian/Hessian) live in paddle_tpu.incubate.autograd.
     """
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True: use paddle_tpu.incubate.autograd functional "
-            "transforms (jax.grad composition) for higher-order gradients"
-        )
     outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
     inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
     if grad_outputs is None:
@@ -214,17 +283,20 @@ def grad(
     for o, g in zip(outputs, grad_outputs):
         if g is None:
             seeds.append(jnp.ones(o._value.shape, o._value.dtype))
+        elif create_graph and isinstance(g, Tensor):
+            seeds.append(g)  # keep differentiable seeds on the tape
         else:
             seeds.append(g._value if isinstance(g, Tensor) else jnp.asarray(g))
     capture = {id(t): None for t in inputs}
     if retain_graph is None:
-        retain_graph = False
+        retain_graph = bool(create_graph)
     run_backward(
         outputs,
         seeds,
         retain_graph=retain_graph,
         capture=capture,
         accumulate_grad=False,
+        create_graph=create_graph,
     )
     results = []
     for t in inputs:
@@ -236,6 +308,8 @@ def grad(
                     "been used in the graph (allow_unused=False)"
                 )
             results.append(None)
+        elif isinstance(g, Tensor):
+            results.append(g)
         else:
             results.append(Tensor(g, stop_gradient=True))
     return results

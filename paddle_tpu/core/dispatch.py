@@ -113,6 +113,42 @@ def _contains_tensor(leaves):
     return False
 
 
+def _is_float0(v):
+    return getattr(v, "dtype", None) == jax.dtypes.float0
+
+
+def call_traced(fn, tensor_args, name="traced_call"):
+    """Evaluate fn(*arrays) -> tuple(arrays) as a recorded differentiable
+    op over Tensor args — the building block of create_graph backward
+    (autograd._traced_grad_call): the call gets its own GradNode (with
+    pure_fn, so it is itself re-linearizable for third order and up)."""
+    vals = [t._value for t in tensor_args]
+    need = tape_enabled() and any(not t.stop_gradient for t in tensor_args)
+    _enter_primitive()
+    try:
+        if not need:
+            outs = fn(*vals)
+            return tuple(v if _is_float0(v) else Tensor(v, stop_gradient=True)
+                         for v in outs)
+        out_vals, vjp_fn = jax.vjp(fn, *vals)
+    finally:
+        _exit_primitive()
+    node = _autograd.GradNode(name, vjp_fn, tensor_args, out_vals,
+                              pure_fn=fn)
+    outs = []
+    for i, v in enumerate(out_vals):
+        if _is_float0(v):
+            outs.append(v)
+            continue
+        t = Tensor(v, stop_gradient=True)
+        if jnp.issubdtype(node.out_avals[i].dtype, jnp.floating):
+            t.stop_gradient = False
+            t._grad_node = node
+            t._out_index = i
+        outs.append(t)
+    return tuple(outs)
+
+
 def primitive(fn=None, *, name=None, nondiff=False):
     """Register a pure-JAX function as an eager op.
 
@@ -180,7 +216,8 @@ def primitive(fn=None, *, name=None, nondiff=False):
                 out_vals, vjp_fn = jax.vjp(pure, *vals)
             finally:
                 _exit_primitive()
-            node = _autograd.GradNode(op_name, vjp_fn, in_tensors, out_vals)
+            node = _autograd.GradNode(op_name, vjp_fn, in_tensors, out_vals,
+                                      pure_fn=pure)
             outs = _autograd.attach_node(out_vals, node)
             if record:
                 _static_recorder(op_name, raw_fn, leaves, treedef,

@@ -49,13 +49,23 @@ class ReduceOp:
 
 
 class Group:
-    """A communication group = one mesh axis (or the full mesh)."""
+    """A communication group.
 
-    def __init__(self, axis="dp", mesh=None, ranks=None, id=0):
+    Two coexisting identities (SURVEY §5 "ProcessGroupICI"):
+    - a mesh axis (`axis`) for SPMD/traced collectives inside compiled
+      steps — XLA inserts the ICI collective;
+    - optionally a process-level StoreProcessGroup (`pg`) when
+      init_parallel_env brought up a multi-process world — eager
+      collectives then have true per-rank semantics
+      (reference process_group.h:53 ProcessGroup).
+    """
+
+    def __init__(self, axis="dp", mesh=None, ranks=None, id=0, pg=None):
         self.axis = axis
         self._mesh = mesh
         self.id = id
         self.ranks = ranks
+        self.pg = pg
 
     @property
     def mesh(self):
@@ -63,16 +73,33 @@ class Group:
 
     @property
     def nranks(self):
+        if self.pg is not None:
+            return self.pg.world_size
+        if self.ranks and _world_pg() is not None:
+            return len(self.ranks)
         return _mesh.axis_size(self.axis, self.mesh)
 
     world_size = nranks
 
     @property
     def rank(self):
-        # process-level rank within group; for SPMD single-process it is 0
+        """Process rank within the group; -1 if this process is not a
+        member (reference Group semantics). SPMD single-process is rank 0."""
+        if self.pg is not None:
+            return self.pg.rank
+        if self.ranks:
+            from .process_group import world_rank
+
+            return (self.ranks.index(world_rank())
+                    if world_rank() in self.ranks else -1)
         return 0
 
+    def is_member(self):
+        return self.rank >= 0
+
     def get_group_rank(self, rank):
+        if self.ranks:
+            return self.ranks.index(rank) if rank in self.ranks else -1
         return rank
 
     def __repr__(self):
@@ -83,19 +110,43 @@ _default_group = None
 _groups = {}
 
 
+def _world_pg():
+    from .process_group import get_world_group
+
+    return get_world_group()
+
+
 def _get_default_group():
     global _default_group
-    if _default_group is None:
+    pg = _world_pg()
+    if _default_group is None or _default_group.pg is not pg:
         mesh = _mesh.get_mesh()
-        _default_group = Group(axis=mesh.axis_names[0], mesh=mesh)
+        _default_group = Group(axis=mesh.axis_names[0], mesh=mesh, pg=pg)
     return _default_group
 
 
 def new_group(ranks=None, backend=None, axis=None, timeout=None):
     """reference communication/group.py new_group. TPU mapping: groups are
-    mesh axes; `axis` selects one. ranks-based ad-hoc groups map onto the
-    default axis (the SPMD partitioner needs axes, not rank lists)."""
-    g = Group(axis=axis or _mesh.get_mesh().axis_names[0])
+    mesh axes; `axis` selects one. With a multi-process world (store
+    backend), ranks-based groups become true subgroups; single-process
+    SPMD maps them onto the default axis (the partitioner needs axes,
+    not rank lists)."""
+    pg = _world_pg()
+    sub = None
+    gid = len(_groups) + 1
+    if pg is not None and ranks:
+        ranks = sorted(ranks)
+        if pg.rank in ranks:
+            from .process_group import StoreProcessGroup
+
+            # gid in the prefix: two groups over the same rank set must
+            # not share a store key namespace (every member computes the
+            # same gid — groups are created collectively, in order)
+            sub = StoreProcessGroup(
+                pg.store, ranks.index(pg.rank), len(ranks),
+                prefix="pg/g%d/%s" % (gid, "_".join(map(str, ranks))))
+    g = Group(axis=axis or _mesh.get_mesh().axis_names[0], ranks=ranks,
+              id=gid, pg=sub)
     _groups[g.id] = g
     return g
 
@@ -163,6 +214,28 @@ def _eager_shard(x, axis):
     return jax.device_put(x, NamedSharding(mesh, P(axis)))
 
 
+def _pg_of(g):
+    """Process backend for eager mode, or None for single-process SPMD."""
+    pg = g.pg
+    if pg is not None and pg.world_size > 1:
+        return pg
+    return None
+
+
+def _np(v):
+    import numpy as _numpy
+
+    return _numpy.asarray(v)
+
+
+def _store_result(tensor, out):
+    out = jnp.asarray(out)
+    if isinstance(tensor, Tensor):
+        tensor._value = out
+        return tensor
+    return Tensor(out)
+
+
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     g = group or _get_default_group()
     v = _unwrap(tensor)
@@ -178,6 +251,9 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
         else:
             raise ValueError(op)
         return _wrap_like(tensor, out)
+    pg = _pg_of(g)
+    if pg is not None:
+        return _store_result(tensor, pg.allreduce(_np(v), op))
     if g.nranks == 1:
         return tensor
     kind = {"sum": "all_reduce_sum", "max": "all_reduce_max",
@@ -199,6 +275,14 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
         out = jax.lax.all_gather(v, g.axis)
         # traced mode returns stacked [nranks, ...]
         return _wrap_like(tensor, out)
+    pg = _pg_of(g)
+    if pg is not None:
+        parts = pg.allgather(_np(v))
+        if tensor_list is not None:
+            tensor_list.extend(Tensor(jnp.asarray(p)) for p in parts)
+            return tensor_list
+        return Tensor(jnp.concatenate([jnp.asarray(p) for p in parts],
+                                      axis=0))
     if g.nranks == 1:
         if tensor_list is not None:
             tensor_list.append(
@@ -225,6 +309,10 @@ def reduce_scatter(tensor, tensor_or_tensor_list=None, op=ReduceOp.SUM,
         v = _unwrap(src)
     if _is_tracer(v):
         return _wrap_like(tensor, jax.lax.psum_scatter(v, g.axis, tiled=True))
+    pg = _pg_of(g)
+    if pg is not None:
+        # true per-rank semantics: this rank gets its reduced [d0/n] shard
+        return _store_result(tensor, pg.reduce_scatter(_np(v), op))
     if g.nranks == 1:
         if isinstance(tensor, Tensor):
             tensor._value = v
@@ -255,17 +343,24 @@ def alltoall(in_tensor_or_list, out_tensor_or_list=None, group=None,
                                  tiled=False)
         out = out.reshape(v.shape)
         return _wrap_like(in_tensor_or_list, out)
-    if g.nranks == 1:
+    pg = _pg_of(g)
+    if pg is not None:
+        # per-rank semantics (reference alltoall: dim0 % nranks == 0)
+        out = jnp.asarray(pg.alltoall(_np(v)))
+    elif g.nranks == 1:
         out = v
     else:
-        # Global view of the exchange: rank r's chunk j becomes rank j's
-        # chunk r — a (src, dst) transpose of dim 0. device_put re-shards
-        # the permuted array, which is the actual ICI all-to-all.
+        # Single-process global view of the exchange: rank r's chunk j
+        # becomes rank j's chunk r — a (src, dst) transpose of dim 0
+        # (hence the nranks^2 divisibility of the GLOBAL dim; each
+        # per-rank shard only needs nranks). device_put re-shards the
+        # permuted array, which is the actual ICI all-to-all.
         n = g.nranks
         if v.shape[0] % (n * n):
             raise ValueError(
-                "alltoall requires dim0 (%d) divisible by nranks^2 (%d)"
-                % (v.shape[0], n * n))
+                "alltoall (single-process global view) requires dim0 (%d) "
+                "divisible by nranks^2 (%d); per-rank shards need only "
+                "dim0 %% nranks" % (v.shape[0], n * n))
         r = v.reshape((n, n, v.shape[0] // (n * n)) + v.shape[1:])
         out = jnp.swapaxes(r, 0, 1).reshape(v.shape)
         out = _eager_shard(out, g.axis)
@@ -284,6 +379,10 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
         idx = jax.lax.axis_index(g.axis)
         out = jax.lax.psum(jnp.where(idx == src, v, jnp.zeros_like(v)), g.axis)
         return _wrap_like(tensor, out)
+    pg = _pg_of(g)
+    if pg is not None:
+        # rank-aware: every rank receives src's tensor
+        return _store_result(tensor, pg.broadcast(_np(v), src))
     # SPMD single process: arrays are already globally addressed; replicating
     # is a device_put with a replicated sharding.
     if isinstance(tensor, Tensor):
@@ -293,17 +392,39 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
 
 
 def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
-    # On the mesh an all-reduce + owner view is the natural lowering; the
-    # reference's rooted reduce saves no ICI time on TPU tori.
-    return all_reduce(tensor, op=op, group=group)
+    g = group or _get_default_group()
+    pg = _pg_of(g)
+    if pg is not None:
+        # true rooted-reduce semantics: only dst's tensor changes
+        out = pg.reduce(_np(_unwrap(tensor)), dst, op)
+        if pg.rank == dst:
+            return _store_result(tensor, out)
+        return tensor
+    # single-process SPMD: an all-reduce + owner view is the natural
+    # lowering; the rooted form saves no ICI time on TPU tori.
+    return all_reduce(tensor, op=op, group=g)
 
 
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     g = group or _get_default_group()
+    pg = _pg_of(g)
+    if pg is not None:
+        chunks = None
+        if pg.rank == src:
+            import numpy as _numpy
+
+            # src supplies a tensor list, or one tensor split n ways
+            chunks = ([_np(_unwrap(t)) for t in tensor_list]
+                      if tensor_list is not None else
+                      list(_numpy.split(_np(_unwrap(tensor)),
+                                        pg.world_size, axis=0)))
+        return _store_result(tensor, pg.scatter(chunks, src))
     if tensor_list is not None:
+        # single-process SPMD: this process's rank within the group
+        # selects the chunk (rank 0 unless ranks-groups say otherwise)
         full = jnp.concatenate([_unwrap(t) for t in tensor_list], axis=0)
         n = g.nranks
-        part = jnp.split(full, n, axis=0)[0]
+        part = jnp.split(full, n, axis=0)[max(g.rank, 0)]
         if isinstance(tensor, Tensor):
             tensor._value = part
             return tensor
@@ -312,22 +433,40 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
 
 
 def send(tensor, dst=0, group=None, sync_op=True):
+    """P2P send (reference send_v2). Eager p2p needs a process world:
+    inside compiled steps use ppermute (pipeline runtime); between
+    processes it rides the store backend."""
+    g = group or _get_default_group()
+    pg = _pg_of(g)
+    if pg is not None:
+        pg.send(_np(_unwrap(tensor)), dst)
+        return
     raise RuntimeError(
-        "eager point-to-point send/recv has no SPMD analog: use "
+        "eager send/recv within one process has no SPMD analog: use "
         "paddle_tpu.parallel p2p helpers (ppermute) inside a compiled "
-        "step, as the pipeline runtime does")
+        "step, as the pipeline runtime does; between processes call "
+        "init_parallel_env first (PADDLE_TRAINERS_NUM > 1)")
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
+    g = group or _get_default_group()
+    pg = _pg_of(g)
+    if pg is not None:
+        out = pg.recv(src)
+        return _store_result(tensor, out)
     raise RuntimeError(
-        "eager point-to-point send/recv has no SPMD analog: use "
-        "paddle_tpu.parallel p2p helpers (ppermute) inside a compiled step")
+        "eager send/recv within one process has no SPMD analog: use "
+        "paddle_tpu.parallel p2p helpers (ppermute) inside a compiled "
+        "step; between processes call init_parallel_env first "
+        "(PADDLE_TRAINERS_NUM > 1)")
 
 
 def barrier(group=None):
+    g = group or _get_default_group()
+    pg = _pg_of(g)
+    if pg is not None:
+        pg.barrier()
     # All outstanding XLA work on all local devices must finish.
-    for d in jax.devices():
-        pass
     jax.block_until_ready(
         jax.device_put(jnp.zeros(()), jax.devices()[0]))
 

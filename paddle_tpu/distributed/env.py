@@ -18,19 +18,64 @@ _initialized = False
 
 
 def init_parallel_env(strategy=None):
-    """paddle.distributed.init_parallel_env analog."""
+    """paddle.distributed.init_parallel_env analog.
+
+    Multi-process path (PADDLE_TRAINERS_NUM > 1): rendezvous over the
+    native TCP store (csrc/store.cc) exactly like the reference's
+    init_parallel_env master store (parallel.py:108) — rank 0 hosts the
+    server at PADDLE_MASTER, every rank registers and barriers, and the
+    resulting StoreProcessGroup becomes the world group backing the
+    rank-aware eager collectives (collective.py). Multi-host TPU
+    additionally brings up the jax distributed runtime so XLA
+    collectives span hosts over ICI/DCN.
+    """
     global _initialized
     if _initialized:
         return
-    coord = os.environ.get("PADDLE_MASTER") or os.environ.get(
-        "COORDINATOR_ADDRESS")
+    world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    # Multi-host XLA runtime FIRST: jax.distributed.initialize must run
+    # before anything touches a backend (its backends_are_initialized
+    # guard), so no jax.default_backend() probe here — the decision is
+    # env-only. The JAX coordinator gets its own port (store port + 1 when
+    # derived from PADDLE_MASTER) so it never collides with the TCP store.
     nnodes = int(os.environ.get("PADDLE_NNODES", "1"))
-    if coord and nnodes > 1:
-        jax.distributed.initialize(
-            coordinator_address=coord,
-            num_processes=nnodes,
-            process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")),
-        )
+    if nnodes > 1:
+        coord = os.environ.get("COORDINATOR_ADDRESS")
+        if not coord and os.environ.get("PADDLE_MASTER"):
+            host, _, port = os.environ["PADDLE_MASTER"].partition(":")
+            coord = "%s:%d" % (host, int(port or 0) + 1)
+        if coord:
+            try:
+                jax.distributed.initialize(
+                    coordinator_address=coord,
+                    num_processes=nnodes,
+                    process_id=int(os.environ.get("PADDLE_NODE_RANK", "0")),
+                )
+            except RuntimeError as e:
+                # backends already up (interactive use): store-only mode
+                import warnings
+
+                warnings.warn(
+                    "init_parallel_env: jax.distributed.initialize "
+                    "skipped (%s); cross-host XLA collectives unavailable, "
+                    "store-backed collectives still work" % e)
+    if world > 1:
+        if not os.environ.get("PADDLE_MASTER"):
+            raise ValueError(
+                "init_parallel_env: PADDLE_MASTER=host:port is required "
+                "when PADDLE_TRAINERS_NUM > 1 (the launch controller sets "
+                "it; set it manually for hand-rolled multi-process runs)")
+        from . import process_group as _pg
+        from .store import create_store_from_env
+
+        store = create_store_from_env(world)
+        pg = _pg.StoreProcessGroup(store, rank, world)
+        _pg.set_world_group(pg)
+        # every rank announces itself; release when all are present
+        store.set("env/rank/%d" % rank,
+                  os.environ.get("PADDLE_CURRENT_ENDPOINT", str(rank)))
+        pg.barrier("init_parallel_env")
     _initialized = True
 
 
@@ -42,12 +87,22 @@ def get_rank(group=None):
     """Process index (host rank). Device-level rank lives on the mesh."""
     if group is not None:
         return group.rank
+    from .process_group import get_world_group
+
+    pg = get_world_group()
+    if pg is not None:
+        return pg.rank
     return jax.process_index()
 
 
 def get_world_size(group=None):
     if group is not None:
         return group.nranks
+    from .process_group import get_world_group
+
+    pg = get_world_group()
+    if pg is not None:
+        return pg.world_size
     # device-level world size: each device is a "rank" in SPMD terms
     return jax.device_count()
 

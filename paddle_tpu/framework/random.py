@@ -40,7 +40,11 @@ def seed(s: int):
 
 
 def next_key():
-    """Return a fresh PRNG key (thread-safe)."""
+    """Return a fresh PRNG key (thread-safe). Inside an
+    RNGStatesTracker.rng_state(...) context the named state supplies the
+    key (mp-rank-local when the state is local, reference mpu/random.py)."""
+    if _state_stack:
+        return model_parallel_rng_key()
     global _counter
     root = _key()
     with _lock:
@@ -56,3 +60,87 @@ def get_rng_state():
 def set_rng_state(state):
     global _root_key, _counter
     _root_key, _counter = state
+
+
+# -- named RNG states (model-parallel dropout) -------------------------------
+#
+# Reference fleet/layers/mpu/random.py RNGStatesTracker: under tensor
+# parallelism, dropout on mp-SHARDED activations must draw a DIFFERENT
+# mask per mp rank ('local_seed'), while dropout on replicated activations
+# must draw the SAME mask ('global_seed'). Under GSPMD pjit this is
+# automatic (one logical mask, each device materializes its shard), but
+# per-shard programs (shard_map bodies: ring pipeline, expert dispatch)
+# re-run the same code on every rank, so the local state additionally
+# folds in axis_index(axis) — the JAX-native form of the reference's
+# per-rank seed offset.
+
+_tracker_states = {}   # name -> [key, counter, fold_axes]
+_state_stack = []      # active rng_state(...) contexts (innermost last)
+
+
+class RNGStatesTracker:
+    def add(self, name, seed):
+        if name in _tracker_states:
+            raise ValueError("rng state %r already added" % name)
+        axes = ("mp",) if name != "global_seed" else ()
+        _tracker_states[name] = [jax.random.key(int(seed)), 0, axes]
+
+    def reset(self):
+        _tracker_states.clear()
+
+    def get_states_tracker(self):
+        return dict(_tracker_states)
+
+    class _Ctx:
+        def __init__(self, name):
+            self.name = name
+
+        def __enter__(self):
+            if self.name not in _tracker_states:
+                # auto-register from the global seed (reference raises;
+                # we derive deterministically so layers work untracked).
+                # crc32, NOT hash(): Python string hashes are
+                # PYTHONHASHSEED-randomized per process.
+                import zlib
+
+                axes = ("mp",) if self.name != "global_seed" else ()
+                _tracker_states[self.name] = [
+                    jax.random.fold_in(
+                        _key(), zlib.crc32(self.name.encode()) & 0x7FFFFFFF),
+                    0, axes]
+            _state_stack.append(self.name)
+            return self
+
+        def __exit__(self, *exc):
+            _state_stack.pop()
+            return False
+
+    def rng_state(self, name="global_seed"):
+        return self._Ctx(name)
+
+
+_tracker = RNGStatesTracker()
+
+
+def get_rng_state_tracker():
+    return _tracker
+
+
+def model_parallel_rng_key():
+    """Key for the active named state (fold per-draw counter, then the
+    mp rank when the state is rank-local and the axis is bound)."""
+    st = _tracker_states[_state_stack[-1]]
+    st[1] += 1
+    key = jax.random.fold_in(st[0], st[1])
+    for axis in st[2]:
+        try:
+            key = jax.random.fold_in(key, jax.lax.axis_index(axis))
+        except Exception:
+            # axis not bound: GSPMD mode — the global mask is already
+            # per-position, nothing to fold
+            break
+    return key
+
+
+def in_tracked_rng_state():
+    return bool(_state_stack)

@@ -1,0 +1,202 @@
+"""Functional higher-order autodiff — paddle.incubate.autograd parity.
+
+Reference: /root/reference/python/paddle/incubate/autograd/ — primapi.py
+(jvp/vjp/forward_grad/grad), functional.py (Jacobian/Hessian), primx.py:678
+orig2prim / :703 prim2orig (lowering ops to ~30 differentiable primitives
+so transforms compose).
+
+TPU-native design: the lowering-to-primitives machinery is unnecessary —
+every op body here is already a pure JAX function, so jax's functional
+transforms (jax.vjp / jax.jvp / jacrev / jacfwd / hessian) compose
+directly over the SAME op bodies that eager mode dispatches. What remains
+of the reference API is the Tensor-level wrapping and the lazy
+Jacobian/Hessian views.
+
+Eager double-backward (paddle_tpu.grad(create_graph=True)) lives in
+core/autograd.py; this module is the functional mirror.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import no_grad
+from ...core.tensor import Tensor
+
+__all__ = ["vjp", "jvp", "Jacobian", "Hessian", "forward_grad", "grad",
+           "enable_prim", "disable_prim", "prim_enabled"]
+
+
+def _unwrap(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _wrap(v):
+    return Tensor(v, stop_gradient=True)
+
+
+def _as_seq(xs):
+    return list(xs) if isinstance(xs, (list, tuple)) else [xs]
+
+
+def _array_fn(func, n_in):
+    """Lift a Tensor->Tensor(s) function to an array->array(s) function.
+
+    Runs the body under no_grad: inside a jax transform the values are
+    tracers and the transform itself supplies the differentiation; the
+    eager tape must not also record.
+    """
+
+    def fn(*arrs):
+        with no_grad():
+            out = func(*[Tensor(a) for a in arrs[:n_in]])
+        if isinstance(out, (list, tuple)):
+            return tuple(_unwrap(o) for o in out)
+        return _unwrap(out)
+
+    return fn
+
+
+def vjp(func, xs, v=None):
+    """(outputs, input cotangents) — reference primapi vjp semantics:
+    v defaults to ones like the outputs."""
+    xs = _as_seq(xs)
+    fn = _array_fn(func, len(xs))
+    vals = [_unwrap(x) for x in xs]
+    out, pullback = jax.vjp(fn, *vals)
+    if v is None:
+        cot = (jax.tree_util.tree_map(jnp.ones_like, out)
+               if isinstance(out, tuple) else jnp.ones_like(out))
+    else:
+        vv = _as_seq(v)
+        cot = (tuple(_unwrap(c) for c in vv) if isinstance(out, tuple)
+               else _unwrap(vv[0]))
+    grads = pullback(cot)
+    outs = ([_wrap(o) for o in out] if isinstance(out, tuple)
+            else _wrap(out))
+    gs = [_wrap(g) for g in grads]
+    return outs, (gs if len(gs) > 1 else gs[0])
+
+
+def jvp(func, xs, v=None):
+    """(outputs, output tangents) — forward-mode directional derivative."""
+    xs = _as_seq(xs)
+    fn = _array_fn(func, len(xs))
+    vals = [_unwrap(x) for x in xs]
+    if v is None:
+        tangents = [jnp.ones_like(x) for x in vals]
+    else:
+        tangents = [_unwrap(t) for t in _as_seq(v)]
+    out, tang = jax.jvp(fn, tuple(vals), tuple(tangents))
+    outs = ([_wrap(o) for o in out] if isinstance(out, tuple)
+            else _wrap(out))
+    ts = ([_wrap(t) for t in tang] if isinstance(tang, tuple)
+          else _wrap(tang))
+    return outs, ts
+
+
+class Jacobian:
+    """Lazy Jacobian view (reference functional.py Jacobian): J[i, j]
+    d out_i / d in_j, evaluated on first access, row-batched."""
+
+    def __init__(self, func, xs, is_batched=False):
+        xs = _as_seq(xs)
+        self._single_in = len(xs) == 1
+        fn = _array_fn(func, len(xs))
+        vals = [_unwrap(x) for x in xs]
+        self._is_batched = is_batched
+        self._jac = None
+
+        def compute():
+            jac = jax.jacrev(fn, argnums=tuple(range(len(vals))))(*vals)
+            return jac
+
+        self._compute = compute
+        self._vals = vals
+
+    def _materialize(self):
+        if self._jac is None:
+            jac = self._compute()
+            if self._single_in:
+                jac = jac[0] if isinstance(jac, tuple) else jac
+            # flatten to the reference's 2D [out_size, in_size] view
+            # (batched: [B, out, in])
+            self._jac = jac
+        return self._jac
+
+    @property
+    def shape(self):
+        return jnp.shape(self._materialize())
+
+    def __getitem__(self, idx):
+        return _wrap(jnp.asarray(self._materialize())[idx])
+
+    def numpy(self):
+        import numpy as np
+
+        return np.asarray(self._materialize())
+
+
+class Hessian:
+    """Lazy Hessian view: H[i, j] = d^2 f / dx_i dx_j for scalar-output
+    func (reference functional.py Hessian)."""
+
+    def __init__(self, func, xs, is_batched=False):
+        xs = _as_seq(xs)
+        fn = _array_fn(func, len(xs))
+        vals = [_unwrap(x) for x in xs]
+
+        def scalar_fn(*vs):
+            out = fn(*vs)
+            out = out[0] if isinstance(out, tuple) else out
+            return jnp.reshape(out, ())
+
+        self._hess = None
+        self._compute = lambda: jax.hessian(scalar_fn)(*vals)
+
+    def _materialize(self):
+        if self._hess is None:
+            self._hess = self._compute()
+        return self._hess
+
+    @property
+    def shape(self):
+        return jnp.shape(self._materialize())
+
+    def __getitem__(self, idx):
+        return _wrap(jnp.asarray(self._materialize())[idx])
+
+    def numpy(self):
+        import numpy as np
+
+        return np.asarray(self._materialize())
+
+
+def forward_grad(func, xs, v=None):
+    """Forward-mode gradient (reference primapi.forward_grad)."""
+    _, tang = jvp(func, xs, v)
+    return tang
+
+
+def grad(func, xs, v=None):
+    """Reverse-mode gradient of `func` at `xs` (functional form)."""
+    _, gs = vjp(func, xs, v)
+    return gs
+
+
+# The reference gates prim-based autodiff behind enable_prim/disable_prim
+# (primx.py). Here the "primitive" lowering is XLA itself, so these are
+# compatibility no-ops that report enabled.
+_prim_state = {"enabled": True}
+
+
+def enable_prim():
+    _prim_state["enabled"] = True
+
+
+def disable_prim():
+    _prim_state["enabled"] = False
+
+
+def prim_enabled():
+    return _prim_state["enabled"]

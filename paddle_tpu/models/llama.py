@@ -299,6 +299,21 @@ class LlamaForCausalLM(Layer):
         h = self.llama(input_ids)
         logits = self.lm_head(h)
         if labels is not None:
+            if self.config.use_parallel:
+                # vocab stays mp-sharded through the loss (sharded-vocab
+                # c_softmax_with_cross_entropy, mp_layers.py) — no
+                # full-vocab gather under the partitioner
+                from ..parallel.mp_layers import (
+                    parallel_softmax_cross_entropy,
+                )
+
+                flat = labels.reshape([-1])
+                per_tok = parallel_softmax_cross_entropy(
+                    logits.reshape([-1, self.config.vocab_size]), flat)
+                # mean over VALID tokens (same contract as the
+                # F.cross_entropy branch: ignore_index rows excluded)
+                valid = (flat != -100).astype(per_tok.dtype)
+                return per_tok.sum() / valid.sum().clip(min=1.0)
             loss = F.cross_entropy(
                 logits.reshape([-1, self.config.vocab_size]),
                 labels.reshape([-1]))

@@ -113,18 +113,101 @@ class VocabParallelEmbedding(Layer):
         return F.embedding(x, self.weight)
 
 
+def _axis_bound(axis):
+    try:
+        jax.lax.axis_index(axis)
+        return True
+    except Exception:
+        return False
+
+
+import functools as _functools
+
+
+@_functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _pmax_stat(x, axis):
+    """pmax as a pure statistic: zero gradient (pmax has no JAX
+    differentiation rule; the softmax max-subtraction is gradient-free
+    by the log-sum-exp shift identity anyway)."""
+    return jax.lax.pmax(x, axis)
+
+
+def _pmax_stat_fwd(x, axis):
+    return jax.lax.pmax(x, axis), x
+
+
+def _pmax_stat_bwd(axis, x, g):
+    return (jnp.zeros_like(x),)
+
+
+_pmax_stat.defvjp(_pmax_stat_fwd, _pmax_stat_bwd)
+
+
+@primitive
+def parallel_softmax_cross_entropy(logits, label, ignore_index=-100,
+                                   mp_axis="mp"):
+    """c_softmax_with_cross_entropy semantics (reference
+    /root/reference/paddle/fluid/operators/collective/
+    c_softmax_with_cross_entropy_op.cu and mp_layers.py:498): the vocab
+    dim of `logits` is sharded over the mp axis and is NEVER gathered.
+
+    Two execution forms, identical math:
+    - per-shard (inside shard_map, mp axis bound): each rank holds
+      [N, V/n]; global max/sum-exp/picked-logit come from pmax/psum over
+      the axis, with the label's owning rank contributing the picked
+      logit — exactly the reference kernel's 3 collectives.
+    - GSPMD (pjit or eager): the reduction form is expressed with
+      one_hot·x contractions so the partitioner lowers it to local
+      reductions + all-reduce without materializing a gathered [N, V].
+    """
+    x = jnp.asarray(logits)
+    li = jnp.asarray(label).astype(jnp.int32)
+    if li.ndim == x.ndim and li.shape[-1] == 1:
+        li = jnp.squeeze(li, -1)
+    xf = x.astype(jnp.float32)
+    if _axis_bound(mp_axis):
+        n_shard = x.shape[-1]
+        rank = jax.lax.axis_index(mp_axis)
+        offset = rank * n_shard
+        # global max over the sharded vocab dim (statistic only — the
+        # softmax gradient identity makes its cotangent cancel)
+        m = _pmax_stat(jnp.max(xf, axis=-1), mp_axis)  # [N...]
+        e = jnp.exp(xf - m[..., None])
+        s = jax.lax.psum(jnp.sum(e, axis=-1), mp_axis)
+        # picked logit: only the owning shard contributes
+        local = li - offset
+        in_shard = (local >= 0) & (local < n_shard)
+        safe = jnp.clip(local, 0, n_shard - 1)
+        picked_local = jnp.take_along_axis(
+            xf, safe[..., None], axis=-1)[..., 0]
+        picked = jax.lax.psum(
+            jnp.where(in_shard, picked_local, 0.0), mp_axis)
+        loss = jnp.log(jnp.maximum(s, 1e-30)) + m - picked
+    else:
+        n_cls = x.shape[-1]
+        m = jax.lax.stop_gradient(jnp.max(xf, axis=-1, keepdims=True))
+        lse = jnp.log(jnp.sum(jnp.exp(xf - m), axis=-1)) + m[..., 0]
+        # one_hot contraction instead of take_along_axis: partitions as
+        # (local masked reduce + all-reduce) under a vocab sharding
+        oh = jax.nn.one_hot(li, n_cls, dtype=xf.dtype)
+        picked = jnp.sum(oh * xf, axis=-1)
+        loss = lse - picked
+    valid = li != ignore_index
+    return jnp.where(valid, loss, 0.0)
+
+
 class ParallelCrossEntropy(Layer):
     """Cross entropy over mp-sharded logits (reference mp_layers.py:498 —
-    c_softmax_with_cross_entropy). Under pjit the partitioner handles the
-    sharded max/sum reductions; the expression is the stable fused form."""
+    c_softmax_with_cross_entropy): no full-vocab gather in either the
+    per-shard or the GSPMD execution form."""
 
     def __init__(self, mp_group=None, name=None, ignore_index=-100):
         super().__init__()
         self.ignore_index = ignore_index
 
     def forward(self, input, label):
-        return F.cross_entropy(input, label, reduction="none",
-                               ignore_index=self.ignore_index)
+        return parallel_softmax_cross_entropy(
+            input, label, ignore_index=self.ignore_index)
 
 
 class ParallelEmbedding(VocabParallelEmbedding):
@@ -132,16 +215,11 @@ class ParallelEmbedding(VocabParallelEmbedding):
 
 
 def get_rng_state_tracker():
-    """reference mpu/random.py RNGStatesTracker: dropout seeds differ per mp
-    rank. JAX keys are deterministic per position via fold_in(axis_index)."""
+    """reference mpu/random.py RNGStatesTracker. Real implementation in
+    framework/random.py: named rng states; rank-local states fold in
+    axis_index('mp') inside per-shard programs so dropout masks differ
+    across mp ranks; under GSPMD the single logical mask is already
+    per-position."""
+    from ..framework.random import get_rng_state_tracker as _get
 
-    class _Tracker:
-        def rng_state(self, name="global_seed"):
-            import contextlib
-
-            return contextlib.nullcontext()
-
-        def add(self, name, seed):
-            pass
-
-    return _Tracker()
+    return _get()

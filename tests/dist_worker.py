@@ -1,0 +1,162 @@
+"""Multi-process distributed worker (the reference TestDistBase model-file
+pattern, /root/reference/python/paddle/fluid/tests/unittests/test_dist_base.py:807
+runtime_main): the same file is both a spawnable worker and a library.
+
+Run with PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / PADDLE_MASTER set; it
+exercises every rank-aware eager collective against numpy oracles, then
+trains a tiny MLP data-parallel (grad allreduce over the store backend)
+and prints its loss sequence as JSON for the parent to compare with the
+single-process full-batch run.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+
+
+def mlp_losses(rank=None, nranks=1, steps=4, allreduce_fn=None):
+    """Deterministic tiny-MLP SGD training; rank=None = full batch.
+
+    Pure numpy so the oracle is independent of the framework's own ops
+    (the reference compares loss sequences the same way,
+    test_dist_base.py:1709 check_with_place).
+    """
+    rng = np.random.RandomState(7)
+    W1 = rng.randn(8, 16).astype(np.float64) * 0.1
+    W2 = rng.randn(16, 4).astype(np.float64) * 0.1
+    X = rng.randn(8, 8).astype(np.float64)
+    Y = rng.randn(8, 4).astype(np.float64)
+    if rank is not None:
+        shard = X.shape[0] // nranks
+        Xl = X[rank * shard:(rank + 1) * shard]
+        Yl = Y[rank * shard:(rank + 1) * shard]
+    else:
+        Xl, Yl = X, Y
+    losses = []
+    lr = 0.1
+    for _ in range(steps):
+        h = np.maximum(Xl @ W1, 0.0)
+        out = h @ W2
+        diff = out - Yl
+        loss_local = (diff ** 2).mean()
+        gout = 2.0 * diff / diff.size
+        gW2 = h.T @ gout
+        gh = gout @ W2.T
+        gh[h <= 0] = 0.0
+        gW1 = Xl.T @ gh
+        if allreduce_fn is not None:
+            # average gradients and the reported loss across ranks
+            gW1 = allreduce_fn(gW1) / nranks
+            gW2 = allreduce_fn(gW2) / nranks
+            loss = float(allreduce_fn(np.asarray(loss_local))) / nranks
+        else:
+            loss = float(loss_local)
+        W1 -= lr * gW1
+        W2 -= lr * gW2
+        losses.append(loss)
+    return losses
+
+
+def main():
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    nranks = int(os.environ["PADDLE_TRAINERS_NUM"])
+    dist.init_parallel_env()
+    assert dist.get_rank() == rank, (dist.get_rank(), rank)
+    assert dist.get_world_size() == nranks
+
+    t = lambda a: paddle.to_tensor(np.asarray(a))
+    npv = lambda x: np.asarray(x._value)
+
+    # all_reduce
+    x = t(np.full((4, 3), float(rank + 1), np.float32))
+    out = dist.all_reduce(x)
+    expect = sum(range(1, nranks + 1))
+    np.testing.assert_allclose(npv(out), np.full((4, 3), expect), rtol=1e-6)
+
+    # all_reduce in bfloat16 (the training dtype — serialization must
+    # round-trip ml_dtypes, not numpy-native dtypes only)
+    import ml_dtypes
+
+    xb = t(np.full((2, 2), float(rank + 1), np.float32)).astype("bfloat16")
+    out = dist.all_reduce(xb)
+    assert str(out.dtype).endswith("bfloat16"), out.dtype
+    np.testing.assert_allclose(
+        npv(out).astype(np.float32), np.full((2, 2), float(expect)),
+        rtol=1e-2)
+
+    # all_gather
+    got = dist.all_gather(None, t(np.full((2,), float(rank), np.float32)))
+    np.testing.assert_allclose(
+        npv(got), np.repeat(np.arange(nranks, dtype=np.float32), 2))
+
+    # broadcast from the LAST rank (regression: src used to be ignored)
+    b = t(np.full((3,), float(rank * 10 + 5), np.float32))
+    out = dist.broadcast(b, src=nranks - 1)
+    np.testing.assert_allclose(npv(out),
+                               np.full((3,), (nranks - 1) * 10 + 5))
+
+    # scatter from rank 0 of per-rank rows (regression: always chunk 0)
+    full = np.arange(nranks * 2, dtype=np.float32).reshape(nranks, 2)
+    chunks = [t(full[i:i + 1]) for i in range(nranks)] if rank == 0 else None
+    target = t(np.zeros((1, 2), np.float32))
+    out = dist.scatter(target, chunks, src=0)
+    np.testing.assert_allclose(npv(out), full[rank:rank + 1])
+
+    # reduce_scatter returns this rank's reduced shard
+    rs_in = t(np.tile(np.arange(nranks, dtype=np.float32)[:, None],
+                      (1, 2)) + rank)
+    out = dist.reduce_scatter(t(np.zeros((1, 2), np.float32)), rs_in)
+    # row r of the summed input = sum_ranks (r + rank') = n*r + sum(rank')
+    expect = np.full((1, 2), float(nranks * rank + rank_sum(nranks)))
+    np.testing.assert_allclose(npv(out), expect)
+
+    # alltoall: dim0 % nranks (NOT nranks^2)
+    a2a_in = t((np.arange(nranks * 2, dtype=np.float32) + 100 * rank
+                ).reshape(nranks * 2, 1))
+    out = dist.alltoall(a2a_in)
+    # received chunk from src s = s's chunk `rank` = 100*s + [2*rank, 2*rank+1]
+    expect = np.concatenate([
+        100.0 * s + np.arange(2 * rank, 2 * rank + 2, dtype=np.float32)
+        for s in range(nranks)])[:, None]
+    np.testing.assert_allclose(npv(out), expect)
+
+    # send/recv ring: rank r -> (r+1) % n
+    dst = (rank + 1) % nranks
+    src = (rank - 1) % nranks
+    dist.send(t(np.full((2, 2), float(rank), np.float32)), dst=dst)
+    got = dist.recv(t(np.zeros((2, 2), np.float32)), src=src)
+    np.testing.assert_allclose(npv(got), np.full((2, 2), float(src)))
+
+    # barrier
+    dist.barrier()
+
+    # subgroup of the first two ranks
+    if nranks >= 2:
+        g = dist.new_group(ranks=[0, 1])
+        if rank in (0, 1):
+            assert g.rank == rank and g.nranks == 2
+            out = dist.all_reduce(t(np.ones((2,), np.float32)), group=g)
+            np.testing.assert_allclose(npv(out), np.full((2,), 2.0))
+        else:
+            assert g.rank == -1
+
+    # data-parallel golden-loss training over the store backend
+    pg = dist.collective._get_default_group().pg
+    losses = mlp_losses(rank=rank, nranks=nranks, steps=4,
+                        allreduce_fn=pg.allreduce)
+    print("DIST_RESULT " + json.dumps({"rank": rank, "losses": losses}))
+    sys.stdout.flush()
+
+
+def rank_sum(n):
+    return n * (n - 1) // 2
+
+
+if __name__ == "__main__":
+    main()
