@@ -83,11 +83,16 @@ WRAPPERS = {}  # op name -> eager dispatch wrapper (autograd-aware)
 # the TPU-native ProgramDesc (reference framework.proto:242) is a replayable
 # op tape rather than a protobuf, replayed under jax.jit by the Executor.
 _static_recorder = None
+# State-assignment hook (Tensor.set_value with a Tensor source while a
+# Program is recording): the static module registers target/source pairs
+# here so the Executor threads mutated buffers across replays.
+_state_assign_recorder = None
 
 
-def set_static_recorder(fn):
-    global _static_recorder
+def set_static_recorder(fn, state_fn=None):
+    global _static_recorder, _state_assign_recorder
     _static_recorder = fn
+    _state_assign_recorder = state_fn
 
 
 def _in_primitive() -> bool:

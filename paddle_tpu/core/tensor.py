@@ -210,6 +210,17 @@ class Tensor:
     def set_value(self, value):
         """Overwrite the buffer (reference Tensor::copy_ / set_value)."""
         if isinstance(value, Tensor):
+            # static capture: a Tensor-valued assignment is a STATE EDGE
+            # of the program (BatchNorm running stats etc.) — register it
+            # so Executor.run threads the new value across replays. The
+            # build-time mutation is SKIPPED (a static build defines ops,
+            # it does not execute them — reference ProgramDesc semantics),
+            # so the initial state at the first real run stays pristine.
+            from . import dispatch as _dispatch
+
+            if (_dispatch._state_assign_recorder is not None
+                    and _dispatch._state_assign_recorder(self, value)):
+                return self
             value = value._value
         value = jnp.asarray(value, dtype=jnp.result_type(self._value))
         if tuple(jnp.shape(value)) != tuple(jnp.shape(self._value)):

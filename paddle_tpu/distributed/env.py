@@ -50,7 +50,9 @@ def init_parallel_env(strategy=None):
                 jax.distributed.initialize(
                     coordinator_address=coord,
                     num_processes=nnodes,
-                    process_id=int(os.environ.get("PADDLE_NODE_RANK", "0")),
+                    process_id=int(os.environ.get(
+                        "PADDLE_NODE_RANK",
+                        os.environ.get("PADDLE_TRAINER_ID", "0"))),
                 )
             except RuntimeError as e:
                 # backends already up (interactive use): store-only mode

@@ -39,12 +39,29 @@ def seed(s: int):
     return s
 
 
+_replay = threading.local()
+
+
+def set_replay_base(key):
+    """Static-replay RNG base: while set (the Executor sets it around each
+    tape replay, passing a fresh per-run key as a traced argument), every
+    next_key() derives from it — so a compiled program draws NEW
+    randomness each Executor.run instead of replaying the keys captured
+    at trace time."""
+    _replay.key = key
+    _replay.counter = 0
+
+
 def next_key():
     """Return a fresh PRNG key (thread-safe). Inside an
     RNGStatesTracker.rng_state(...) context the named state supplies the
-    key (mp-rank-local when the state is local, reference mpu/random.py)."""
+    key (mp-rank-local when the state is local, reference mpu/random.py);
+    inside a static replay the per-run base key supplies it."""
     if _state_stack:
         return model_parallel_rng_key()
+    if getattr(_replay, "key", None) is not None:
+        _replay.counter += 1
+        return jax.random.fold_in(_replay.key, _replay.counter)
     global _counter
     root = _key()
     with _lock:
@@ -128,10 +145,17 @@ def get_rng_state_tracker():
 
 def model_parallel_rng_key():
     """Key for the active named state (fold per-draw counter, then the
-    mp rank when the state is rank-local and the axis is bound)."""
+    mp rank when the state is rank-local and the axis is bound). When a
+    static replay base is active it is folded in too, so tracked dropout
+    inside a compiled Program still draws fresh masks per Executor.run
+    instead of baking the trace-time key as a constant."""
     st = _tracker_states[_state_stack[-1]]
     st[1] += 1
     key = jax.random.fold_in(st[0], st[1])
+    replay = getattr(_replay, "key", None)
+    if replay is not None:
+        for d in jax.random.key_data(replay).ravel():
+            key = jax.random.fold_in(key, d)
     for axis in st[2]:
         try:
             key = jax.random.fold_in(key, jax.lax.axis_index(axis))

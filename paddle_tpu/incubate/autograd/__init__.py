@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ...core.dispatch import no_grad
 from ...core.tensor import Tensor
@@ -95,33 +96,72 @@ def jvp(func, xs, v=None):
     return outs, ts
 
 
+def _flat_fn(func, vals, is_batched):
+    """Lift func to a function of ONE flat vector (all inputs raveled and
+    concatenated; batched mode keeps dim0 and flattens the rest), the
+    coordinate system of the reference's 2D Jacobian/Hessian views."""
+    if is_batched:
+        b = vals[0].shape[0]
+        sizes = [int(np.prod(v.shape[1:], dtype=np.int64)) if v.ndim > 1
+                 else 1 for v in vals]
+    else:
+        sizes = [int(v.size) for v in vals]
+    offsets = np.cumsum([0] + sizes)
+    n_in = len(vals)
+    fn = _array_fn(func, n_in)
+
+    def unpack(flat):
+        parts = []
+        for i, v in enumerate(vals):
+            seg = flat[..., offsets[i]:offsets[i + 1]]
+            parts.append(seg.reshape(v.shape))
+        return parts
+
+    def flat_in(flat):
+        out = fn(*unpack(flat))
+        if isinstance(out, tuple):
+            out = jnp.concatenate(
+                [o.reshape((o.shape[0], -1)) if is_batched
+                 else o.reshape(-1) for o in out], axis=-1)
+        else:
+            out = (out.reshape((out.shape[0], -1)) if is_batched
+                   else out.reshape(-1))
+        return out
+
+    if is_batched:
+        flat0 = jnp.concatenate(
+            [v.reshape((b, -1)) for v in vals], axis=-1)
+    else:
+        flat0 = jnp.concatenate([v.reshape(-1) for v in vals])
+    return flat_in, flat0
+
+
 class Jacobian:
-    """Lazy Jacobian view (reference functional.py Jacobian): J[i, j]
-    d out_i / d in_j, evaluated on first access, row-batched."""
+    """Lazy Jacobian view (reference functional.py Jacobian): the 2D
+    [out_size, in_size] matrix over ALL inputs flattened-and-concatenated
+    (batched: [B, out_size, in_size]), evaluated on first access."""
 
     def __init__(self, func, xs, is_batched=False):
         xs = _as_seq(xs)
-        self._single_in = len(xs) == 1
-        fn = _array_fn(func, len(xs))
         vals = [_unwrap(x) for x in xs]
         self._is_batched = is_batched
+        flat_in, flat0 = _flat_fn(func, vals, is_batched)
         self._jac = None
 
-        def compute():
-            jac = jax.jacrev(fn, argnums=tuple(range(len(vals))))(*vals)
-            return jac
+        if is_batched:
+            def compute():
+                # per-sample jacobian: vmap over the batch dim
+                return jax.vmap(jax.jacrev(
+                    lambda f1: flat_in(f1[None])[0]))(flat0)
+        else:
+            def compute():
+                return jax.jacrev(flat_in)(flat0)
 
         self._compute = compute
-        self._vals = vals
 
     def _materialize(self):
         if self._jac is None:
-            jac = self._compute()
-            if self._single_in:
-                jac = jac[0] if isinstance(jac, tuple) else jac
-            # flatten to the reference's 2D [out_size, in_size] view
-            # (batched: [B, out, in])
-            self._jac = jac
+            self._jac = self._compute()
         return self._jac
 
     @property
@@ -132,27 +172,30 @@ class Jacobian:
         return _wrap(jnp.asarray(self._materialize())[idx])
 
     def numpy(self):
-        import numpy as np
-
         return np.asarray(self._materialize())
 
 
 class Hessian:
-    """Lazy Hessian view: H[i, j] = d^2 f / dx_i dx_j for scalar-output
-    func (reference functional.py Hessian)."""
+    """Lazy Hessian view (reference functional.py Hessian): the full
+    [in_size, in_size] matrix over ALL inputs flattened-and-concatenated
+    — including cross-input blocks — for scalar-output func."""
 
     def __init__(self, func, xs, is_batched=False):
         xs = _as_seq(xs)
-        fn = _array_fn(func, len(xs))
         vals = [_unwrap(x) for x in xs]
+        flat_in, flat0 = _flat_fn(func, vals, is_batched)
 
-        def scalar_fn(*vs):
-            out = fn(*vs)
-            out = out[0] if isinstance(out, tuple) else out
-            return jnp.reshape(out, ())
+        if is_batched:
+            def scalar_fn(f1):
+                return jnp.reshape(flat_in(f1[None]), ())
 
+            self._compute = lambda: jax.vmap(jax.hessian(scalar_fn))(flat0)
+        else:
+            def scalar_fn(flat):
+                return jnp.reshape(flat_in(flat), ())
+
+            self._compute = lambda: jax.hessian(scalar_fn)(flat0)
         self._hess = None
-        self._compute = lambda: jax.hessian(scalar_fn)(*vals)
 
     def _materialize(self):
         if self._hess is None:
@@ -167,8 +210,6 @@ class Hessian:
         return _wrap(jnp.asarray(self._materialize())[idx])
 
     def numpy(self):
-        import numpy as np
-
         return np.asarray(self._materialize())
 
 

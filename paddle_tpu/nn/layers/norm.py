@@ -43,9 +43,22 @@ class _BatchNormBase(Layer):
             x, self.weight, self.bias, epsilon=self.epsilon,
             data_format=self.data_format)
         m = self.momentum
-        self._mean.set_value(self._mean._value * m + mean._value * (1 - m))
-        self._variance.set_value(
-            self._variance._value * m + var._value * (1 - m))
+        # running-stat update through DISPATCHED ops (not raw arrays): under
+        # static capture these land on the Program tape, and set_value
+        # registers the state assignment so the Executor threads
+        # mean/variance through replays (reference batch_norm op updates
+        # MeanOut/VarianceOut in-graph, phi/kernels/batch_norm_kernel).
+        # no_grad + detach: the update is a statistic, not a grad path.
+        from ...core.dispatch import no_grad
+
+        with no_grad():
+            # `mean`/`var` used directly (NOT detached): no_grad already
+            # keeps grads off, and the tape needs the op-output tensors
+            # so replays recompute the update from the fresh batch stats
+            nm = self._mean * m + mean * (1.0 - m)
+            nv = self._variance * m + var * (1.0 - m)
+        self._mean.set_value(nm)
+        self._variance.set_value(nv)
         return out
 
 

@@ -16,10 +16,13 @@ forward+backward+update into a single donated XLA module (the analog of the
 reference's append_backward + optimizer-op insertion, with XLA autodiff
 replacing per-op GradOpMakers).
 
-Known deviations (documented, TPU-semantics): RNG ops replay the captured
-key (seed once per program build); BatchNorm running-stat mutation is not a
-tape op and therefore does not update across replays (use dygraph or
-hapi.Model for stat-accumulating training).
+State semantics match the reference executor: Tensor.set_value(Tensor)
+during capture registers a STATE EDGE (`_record_state_assign`) — BatchNorm
+running stats and other mutated buffers are threaded out of the compiled
+module and written back after every run (the reference batch_norm op's
+MeanOut/VarianceOut). RNG ops draw from a fresh per-run key passed as a
+traced argument (framework.random.set_replay_base), so dropout masks
+differ across Executor.run calls exactly as in dygraph.
 """
 from __future__ import annotations
 
@@ -92,6 +95,7 @@ class Program:
         self._opt_state = None
         self._run_cache = {}
         self._analyze_cache = None  # (version, params, frozen)
+        self._state_updates = {}  # id(target) -> (target, source Tensor)
 
     # -- introspection (reference Program API) ---------------------------
     def global_block(self):
@@ -107,6 +111,7 @@ class Program:
         p.tape = list(self.tape)
         p.feed_vars = dict(self.feed_vars)
         p._grad_map = dict(self._grad_map)
+        p._state_updates = dict(self._state_updates)
         p._run_cache = {}
         p._analyze_cache = None
         p.__dict__.pop("_native_interp", None)  # DAG is per-program
@@ -187,8 +192,21 @@ def _record(op_name, raw_fn, leaves, treedef, outs, multi):
     prog._bump()
 
 
+def _record_state_assign(target, source):
+    """Tensor.set_value(Tensor) during capture = a state edge: Executor
+    threads `source`'s replayed value back into `target` after each run
+    (BatchNorm running stats; the reference batch_norm op's
+    MeanOut/VarianceOut outputs)."""
+    prog = _recording_program()
+    if prog is None:
+        return False
+    prog._state_updates[id(target)] = (target, source)
+    prog._bump()
+    return True
+
+
 def _install_recorder():
-    _dispatch.set_static_recorder(_record)
+    _dispatch.set_static_recorder(_record, _record_state_assign)
 
 
 class program_guard:
@@ -386,48 +404,72 @@ class Executor:
 
     # -----------------------------------------------------------------
     def _compile(self, program, feed_tensors, fetch_tensors, params, frozen):
+        from ..framework import random as _random
+
         train = program._train_spec is not None
         grad_ids = list(program._grad_map.keys())
+        # state edges (BatchNorm running stats etc.): replayed source
+        # values are threaded out of the jitted module and written back
+        state_list = list(program._state_updates.values())
+        state_targets = [t for t, _ in state_list]
+        state_sources = [s for _, s in state_list]
 
         if not train:
-            def pure(feed_vals, param_vals, frozen_vals):
-                with _ReplayContext(program, params + frozen):
-                    for t, v in zip(feed_tensors, feed_vals):
-                        t._value = v
-                    for t, v in zip(params, param_vals):
-                        t._value = v
-                    for t, v in zip(frozen, frozen_vals):
-                        t._value = v
-                    _run_tape(program)
-                    return [t._value for t in fetch_tensors]
+            def pure(feed_vals, param_vals, frozen_vals, rng_key):
+                _random.set_replay_base(rng_key)
+                try:
+                    with _ReplayContext(program,
+                                        params + frozen + state_targets):
+                        for t, v in zip(feed_tensors, feed_vals):
+                            t._value = v
+                        for t, v in zip(params, param_vals):
+                            t._value = v
+                        for t, v in zip(frozen, frozen_vals):
+                            t._value = v
+                        _run_tape(program)
+                        return ([t._value for t in fetch_tensors],
+                                [s._value for s in state_sources])
+                finally:
+                    _random.set_replay_base(None)
 
             jitted = jax.jit(pure)
 
             def runner(prog, feed_vals, params, frozen):
-                return jitted(feed_vals, [p._value for p in params],
-                              [f._value for f in frozen])
+                outs, new_state = jitted(
+                    feed_vals, [p._value for p in params],
+                    [f._value for f in frozen], _random.next_key())
+                for t, v in zip(state_targets, new_state):
+                    t._value = v
+                return outs
 
             return runner
 
         loss_t, opt = program._train_spec
         has_update = opt is not None
 
-        def pure(feed_vals, param_vals, frozen_vals, opt_state, lr, step):
-            def loss_of(pvals):
-                with _ReplayContext(program, params + frozen):
-                    for t, v in zip(feed_tensors, feed_vals):
-                        t._value = v
-                    for t, v in zip(params, pvals):
-                        t._value = v
-                    for t, v in zip(frozen, frozen_vals):
-                        t._value = v
-                    _run_tape(program)
-                    loss_val = loss_t._value
-                    aux = [t._value for t in fetch_tensors]
-                return jnp.sum(loss_val), aux
+        def pure(feed_vals, param_vals, frozen_vals, opt_state, lr, step,
+                 rng_key):
+            _random.set_replay_base(rng_key)
+            try:
+                def loss_of(pvals):
+                    with _ReplayContext(program,
+                                        params + frozen + state_targets):
+                        for t, v in zip(feed_tensors, feed_vals):
+                            t._value = v
+                        for t, v in zip(params, pvals):
+                            t._value = v
+                        for t, v in zip(frozen, frozen_vals):
+                            t._value = v
+                        _run_tape(program)
+                        loss_val = loss_t._value
+                        aux = ([t._value for t in fetch_tensors],
+                               [s._value for s in state_sources])
+                    return jnp.sum(loss_val), aux
 
-            (loss_v, fetches), grads = jax.value_and_grad(
-                loss_of, has_aux=True)(param_vals)
+                (loss_v, (fetches, state_vals)), grads = jax.value_and_grad(
+                    loss_of, has_aux=True)(param_vals)
+            finally:
+                _random.set_replay_base(None)
             # grad placeholders fetched by id
             grad_of = {pid: g for pid, g in zip(
                 [id(p) for p in params], grads)}
@@ -440,7 +482,7 @@ class Executor:
                         break
                 out_fetches.append(fv if hit is None else hit)
             if not has_update:
-                return out_fetches, param_vals, opt_state
+                return out_fetches, param_vals, opt_state, state_vals
             names = [str(i) for i in range(len(params))]
             pdict = dict(zip(names, param_vals))
             gdict = dict(zip(names, grads))
@@ -448,7 +490,7 @@ class Executor:
             new_p, new_s = opt.functional_apply(pdict, gdict, sdict,
                                                 lr=lr, step=step)
             return (out_fetches, [new_p[n] for n in names],
-                    [new_s[n] for n in names])
+                    [new_s[n] for n in names], state_vals)
 
         jitted = jax.jit(pure, donate_argnums=(1, 3))
 
@@ -466,11 +508,14 @@ class Executor:
             # update (Adam bias correction needs step >= 1)
             step = jnp.asarray(
                 opt._global_step + 1 if has_update else 1, jnp.int32)
-            outs, new_p, new_s = jitted(
+            outs, new_p, new_s, new_state = jitted(
                 feed_vals, [p._value for p in params],
-                [f._value for f in frozen], prog._opt_state, lr, step)
+                [f._value for f in frozen], prog._opt_state, lr, step,
+                _random.next_key())
             for p, v in zip(params, new_p):
                 p._value = v
+            for t, v in zip(state_targets, new_state):
+                t._value = v
             prog._opt_state = new_s
             if has_update:
                 opt._global_step += 1  # LR schedulers are stepped by user

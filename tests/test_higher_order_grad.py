@@ -118,6 +118,27 @@ class TestIncubateAutograd:
         np.testing.assert_allclose(J.numpy(), np.diag([2.0, 4.0]), rtol=1e-6)
         np.testing.assert_allclose(np.asarray(J[0, 0]._value), 2.0, rtol=1e-6)
 
+    def test_jacobian_flattens_to_2d(self):
+        # reference contract: [out_size, in_size] over flattened inputs
+        x = _t(np.arange(6, dtype=np.float32).reshape(2, 3))
+        J = ag.Jacobian(lambda t: (t * 2.0).sum(axis=1), x)
+        assert J.shape == (2, 6)
+        want = np.zeros((2, 6), np.float32)
+        want[0, :3] = 2.0
+        want[1, 3:] = 2.0
+        np.testing.assert_allclose(J.numpy(), want, rtol=1e-6)
+
+    def test_hessian_multi_input_cross_terms(self):
+        # f(x, y) = sum(x*y): full matrix has identity cross blocks
+        x = _t([1.0, 2.0])
+        y = _t([3.0, 4.0])
+        H = ag.Hessian(lambda a, b: (a * b).sum(), [x, y])
+        assert H.shape == (4, 4)
+        want = np.zeros((4, 4), np.float32)
+        want[:2, 2:] = np.eye(2)
+        want[2:, :2] = np.eye(2)
+        np.testing.assert_allclose(H.numpy(), want, rtol=1e-6)
+
     def test_hessian(self):
         x = _t([1.0, 2.0])
         H = ag.Hessian(lambda t: (t ** 2).sum(), x)
