@@ -88,6 +88,13 @@ from .ops.creation import (  # noqa: F401
 )
 from .ops.math import (  # noqa: F401
     abs,
+    clip_by_norm,
+    dist,
+    logcumsumexp,
+    mode,
+    nanmedian,
+    renorm,
+    squared_l2_norm,
     acos,
     acosh,
     add,
@@ -191,6 +198,14 @@ from .ops.reduction import (  # noqa: F401
 )
 from .ops.manipulation import (  # noqa: F401
     as_strided,
+    diag_embed,
+    fill,
+    fill_diagonal,
+    index_sample,
+    multiplex,
+    reverse,
+    unique_consecutive,
+    unstack,
     broadcast_tensors,
     broadcast_to,
     bucketize,

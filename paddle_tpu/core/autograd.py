@@ -30,6 +30,7 @@ class GradNode:
         "out_avals",
         "freed",
         "pure_fn",
+        "out_hooks",
     )
 
     def __init__(self, name, vjp_fn, input_tensors, out_vals, pure_fn=None):
@@ -49,6 +50,9 @@ class GradNode:
         # reference's double-grad nodes are generated the same way from
         # the op's grad-of-grad signature, eager_gen.py)
         self.pure_fn = pure_fn
+        # out_index -> [hook, ...] (Tensor.register_hook on non-leaf
+        # tensors; fired on the ACCUMULATED cotangent when this node pops)
+        self.out_hooks = None
 
     def __repr__(self):
         return "GradNode(%s)" % self.name
@@ -132,10 +136,28 @@ def run_backward(
     """
     pending = {}  # node -> list[cotangent or None] per output index
     deps = {}  # node -> count of incoming edges from reachable consumers
+    leaf_stage = {}  # id(t) -> [t, accumulated g] (hooks fire on totals)
 
     def _as_cot(g):
         if create_graph and not isinstance(g, Tensor):
             return Tensor(g, stop_gradient=True)
+        return g
+
+    def _apply_hooks(hooks, g):
+        """Run user hooks on a complete gradient; a hook may return a
+        replacement (reference eager hook semantics, grad_node_info.h
+        GradientHooks)."""
+        for h in hooks:
+            arg = g if isinstance(g, Tensor) else Tensor(g,
+                                                         stop_gradient=True)
+            r = h(arg)
+            if r is None:
+                continue
+            if isinstance(g, Tensor):
+                g = r if isinstance(r, Tensor) else Tensor(
+                    r, stop_gradient=True)
+            else:
+                g = r._value if isinstance(r, Tensor) else jnp.asarray(r)
         return g
 
     def route(t, g):
@@ -150,11 +172,11 @@ def run_backward(
         node = t._grad_node
         if node is None:
             if accumulate_grad:
-                gv = g._value if isinstance(g, Tensor) else g
-                if t.grad is None:
-                    t.grad = Tensor(gv, stop_gradient=True)
+                ent = leaf_stage.get(id(t))
+                if ent is None:
+                    leaf_stage[id(t)] = [t, g]
                 else:
-                    t.grad._value = t.grad._value + gv
+                    ent[1] = _accum(ent[1], g)
             return
         lst = pending[node]
         lst[t._out_index] = _accum(lst[t._out_index], g)
@@ -198,6 +220,10 @@ def run_backward(
     while queue:
         node = queue.pop()
         processed.append(node)
+        if node.out_hooks:
+            for i, hooks in node.out_hooks.items():
+                if pending[node][i] is not None:
+                    pending[node][i] = _apply_hooks(hooks, pending[node][i])
         if create_graph and node.pure_fn is not None:
             # differentiable path: record the vjp evaluation as an op of
             # (primals + cotangents); inputs' own grad nodes chain x-paths
@@ -228,6 +254,17 @@ def run_backward(
                 deps[p] -= 1
                 if deps[p] == 0:
                     queue.append(p)
+
+    # leaf hooks fire on the fully-accumulated gradient, then .grad updates
+    for t, g in leaf_stage.values():
+        hooks = getattr(t, "_hooks", None)
+        if hooks:
+            g = _apply_hooks(hooks, g)
+        gv = g._value if isinstance(g, Tensor) else g
+        if t.grad is None:
+            t.grad = Tensor(gv, stop_gradient=True)
+        else:
+            t.grad._value = t.grad._value + gv
 
     if not retain_graph:
         for node in pending:

@@ -162,11 +162,35 @@ class Tensor:
         return self
 
     def register_hook(self, hook):
-        # Gradient hooks land with the EagerReducer analog; store for later.
+        """Gradient hook: fires during backward on this tensor's
+        ACCUMULATED gradient; may return a replacement (reference eager
+        GradientHooks, grad_node_info.h). Non-leaf tensors register on
+        their grad node's output slot; leaves fire before .grad updates.
+        Returns a handle with .remove()."""
+        if self._grad_node is not None:
+            node = self._grad_node
+            if node.out_hooks is None:
+                node.out_hooks = {}
+            lst = node.out_hooks.setdefault(self._out_index, [])
+            lst.append(hook)
+
+            class _H:
+                def remove(self, _lst=lst, _h=hook):
+                    if _h in _lst:
+                        _lst.remove(_h)
+
+            return _H()
         if not hasattr(self, "_hooks"):
             self._hooks = []
         self._hooks.append(hook)
-        return hook
+        lst = self._hooks
+
+        class _H:
+            def remove(self, _lst=lst, _h=hook):
+                if _h in _lst:
+                    _lst.remove(_h)
+
+        return _H()
 
     # -- device movement ---------------------------------------------------
     def to(self, *args, **kwargs):

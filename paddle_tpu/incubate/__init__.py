@@ -5,5 +5,5 @@ autotune, fused nn ops). Graph/autograd incubations that the reference
 keeps here (primitive autodiff) are core features of this framework —
 everything is already traced functionally — so they need no incubation.
 """
-from . import asp, autograd, autotune, nn  # noqa: F401
+from . import asp, autograd, autotune, nn, optimizer  # noqa: F401
 from .autotune import set_config  # noqa: F401

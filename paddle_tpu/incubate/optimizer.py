@@ -1,0 +1,98 @@
+"""Incubate optimizers (reference python/paddle/incubate/optimizer/).
+
+ModelAverage rebuilds the reference's average_accumulates op
+(phi/kernels/average_accumulates_kernel.h) as functional python state:
+windowed running sums of parameter values with apply()/restore() swap.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+class ModelAverage:
+    """Running average of parameter values over a trailing window
+    (reference incubate/optimizer/modelaverage.py + the
+    average_accumulates kernel's sum_1/sum_2/sum_3 rotation)."""
+
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000000):
+        self.rate = average_window_rate
+        self.min_w = min_average_window
+        self.max_w = max_average_window
+        self.params = list(parameters or [])
+        self._sum1 = [jnp.zeros_like(p._value) for p in self.params]
+        self._sum2 = [jnp.zeros_like(p._value) for p in self.params]
+        self._sum3 = [jnp.zeros_like(p._value) for p in self.params]
+        self._num_acc = 0
+        self._old_num_acc = 0
+        self._num_updates = 0
+        self._backup = None
+
+    def step(self):
+        """Accumulate current parameter values (the reference op's
+        per-step update: rotate sums when the window is exceeded)."""
+        self._num_updates += 1
+        self._num_acc += 1
+        window = max(self.min_w,
+                     min(self.max_w, int(self._num_updates * self.rate)))
+        for i, p in enumerate(self.params):
+            self._sum1[i] = self._sum1[i] + p._value
+        if self._num_acc >= window:
+            # rotate: sum_3 <- sum_2 <- sum_1, restart the live window
+            for i in range(len(self.params)):
+                self._sum3[i] = self._sum2[i]
+                self._sum2[i] = self._sum1[i]
+                self._sum1[i] = jnp.zeros_like(self._sum1[i])
+            self._old_num_acc = self._num_acc
+            self._num_acc = 0
+
+    def _averaged(self):
+        total_n = self._num_acc + 2 * self._old_num_acc
+        outs = []
+        for i in range(len(self.params)):
+            s = self._sum1[i] + self._sum2[i] + self._sum3[i]
+            outs.append(s / max(total_n, 1))
+        return outs
+
+    @contextlib.contextmanager
+    def apply(self, need_restore=True):
+        """Swap params to their averaged values inside the context."""
+        self._backup = [p._value for p in self.params]
+        if self._num_acc + self._old_num_acc > 0:
+            for p, avg in zip(self.params, self._averaged()):
+                p._value = avg
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self):
+        if self._backup is not None:
+            for p, v in zip(self.params, self._backup):
+                p._value = v
+            self._backup = None
+
+
+def average_accumulates(param, sum1, sum2, sum3, num_acc, old_num_acc,
+                        num_updates, average_window, max_average_window,
+                        min_average_window):
+    """Functional form of the reference average_accumulates op (one
+    param): returns updated (sum1, sum2, sum3, num_acc, old_num_acc)."""
+    num_updates = int(num_updates)
+    num_acc = int(num_acc) + 1
+    window = max(min_average_window,
+                 min(max_average_window, int(num_updates * average_window)))
+    s1 = jnp.asarray(sum1) + jnp.asarray(
+        param._value if isinstance(param, Tensor) else param)
+    s2, s3 = jnp.asarray(sum2), jnp.asarray(sum3)
+    old = int(old_num_acc)
+    if num_acc >= window:
+        s3, s2, s1 = s2, s1, jnp.zeros_like(s1)
+        old = num_acc
+        num_acc = 0
+    return s1, s2, s3, num_acc, old

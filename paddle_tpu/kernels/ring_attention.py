@@ -90,7 +90,9 @@ def ring_attention(q, k, v, axis_name="sep", causal=False, scale=None):
 def sequence_parallel_attention(q, k, v, mesh=None, causal=False, scale=None,
                                 axis_name="sep"):
     """Convenience wrapper: full arrays in, shard_map over the sequence
-    axis, ring attention inside."""
+    axis, ring attention inside. The batch dim keeps its data-parallel
+    sharding (dp and the ZeRO 'sharding' axis both split batch,
+    reference topology.py), so sep composes with dp/ZeRO in one step."""
     from jax.sharding import PartitionSpec as P
 
     from ..distributed.collective import shard_map
@@ -98,7 +100,9 @@ def sequence_parallel_attention(q, k, v, mesh=None, causal=False, scale=None,
     from ..distributed import mesh as _mesh
 
     mesh = mesh or _mesh.get_mesh()
-    spec = P(None, axis_name, None, None)
+    batch_axes = tuple(a for a in ("dp", "sharding")
+                       if a in mesh.axis_names and mesh.shape[a] > 1)
+    spec = P(batch_axes if batch_axes else None, axis_name, None, None)
     fn = shard_map(
         lambda q_, k_, v_: ring_attention(q_, k_, v_, axis_name=axis_name,
                                           causal=causal, scale=scale),

@@ -41,7 +41,8 @@ class LlamaConfig:
                  max_position_embeddings=4096, rms_norm_eps=1e-6,
                  rope_theta=10000.0, tie_word_embeddings=False,
                  use_parallel=True, dtype="float32",
-                 fuse_attention_qkv=False, fuse_mlp=False):
+                 fuse_attention_qkv=False, fuse_mlp=False,
+                 sequence_parallel=False):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.intermediate_size = intermediate_size
@@ -61,6 +62,10 @@ class LlamaConfig:
         # K=N=768 sustains ~34 TF/s, N=2304 nearly doubles that).
         self.fuse_attention_qkv = fuse_attention_qkv
         self.fuse_mlp = fuse_mlp
+        # long-context: shard the sequence axis over 'sep' and run ring
+        # attention (kernels/ring_attention.py) — capability the
+        # reference snapshot lacks (SURVEY §5)
+        self.sequence_parallel = sequence_parallel
 
     @classmethod
     def tiny(cls, **kw):
@@ -115,6 +120,7 @@ class LlamaAttention(Layer):
         self.num_kv_heads = c.num_key_value_heads
         self.head_dim = c.hidden_size // c.num_attention_heads
         self.rope_theta = c.rope_theta
+        self.sequence_parallel = c.sequence_parallel
         self.fuse_qkv = c.fuse_attention_qkv and not c.use_parallel
         if self.fuse_qkv:
             from ..nn.layers.common import Linear
@@ -181,7 +187,12 @@ class LlamaAttention(Layer):
             rep = self.num_heads // self.num_kv_heads
             k = ops.manipulation.repeat_interleave(k, rep, axis=2)
             v = ops.manipulation.repeat_interleave(v, rep, axis=2)
-        out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        if self.sequence_parallel and cache is None:
+            # ring attention over the 'sep' axis (falls back to flash
+            # attention when the mesh has no sep axis)
+            out = F.sequence_parallel_attention(q, k, v, is_causal=True)
+        else:
+            out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
         out = out.reshape([b, s, self.num_heads * self.head_dim])
         out = self.o_proj(out)
         if cache is not None:
@@ -265,9 +276,25 @@ class LlamaModel(Layer):
              for _ in range(config.num_hidden_layers)])
         self.norm = RMSNorm(config.hidden_size, config.rms_norm_eps)
 
+    def _sep_spec(self):
+        """(batch_axes, 'sep', None) when the mesh has a >1 'sep' axis."""
+        if not self.config.sequence_parallel:
+            return None
+        from ..distributed import mesh as _mesh
+
+        mesh = _mesh.get_mesh()
+        if "sep" not in mesh.axis_names or mesh.shape["sep"] <= 1:
+            return None
+        batch = tuple(a for a in ("dp", "sharding")
+                      if a in mesh.axis_names and mesh.shape[a] > 1)
+        return (batch if batch else None, "sep", None)
+
     def forward(self, input_ids, caches=None, position_offset=0):
         x = self.embed_tokens(input_ids)
         # dp on batch, sep on sequence when those axes exist
+        spec = self._sep_spec() if caches is None else None
+        if spec is not None:
+            x = mark_sharding(x, *spec)
         new_caches = []
         for i, layer in enumerate(self.layers):
             if caches is not None:

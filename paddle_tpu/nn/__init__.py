@@ -155,3 +155,5 @@ def ClipGradByValue(max, min=None):
     from ..optimizer.clip import ClipGradByValue as C
 
     return C(max, min)
+
+from . import utils  # noqa: F401

@@ -31,11 +31,17 @@ from .activation import (  # noqa: F401
 )
 from .attention import (  # noqa: F401
     scaled_dot_product_attention,
+    sequence_parallel_attention,
     sparse_attention,
 )
 from .common import (  # noqa: F401
+    affine_grid,
     alpha_dropout,
     bilinear,
+    channel_shuffle,
+    fold,
+    grid_sample,
+    temporal_shift,
     cosine_similarity,
     dropout,
     dropout2d,
@@ -54,11 +60,20 @@ from .conv import (  # noqa: F401
     conv1d_transpose,
     conv2d,
     conv2d_transpose,
+    deformable_conv,
     conv3d,
     conv3d_transpose,
 )
 from .loss import (  # noqa: F401
     binary_cross_entropy,
+    class_center_sample,
+    ctc_loss,
+    hsigmoid_loss,
+    huber_loss,
+    margin_cross_entropy,
+    sigmoid_cross_entropy_with_logits,
+    sigmoid_focal_loss,
+    warpctc,
     binary_cross_entropy_with_logits,
     cosine_embedding_loss,
     cross_entropy,
@@ -95,6 +110,7 @@ from .pooling import (  # noqa: F401
     max_pool1d,
     max_pool2d,
     max_pool3d,
+    max_unpool2d,
 )
 
 from ...ops.manipulation import one_hot, pad  # noqa: F401

@@ -62,6 +62,29 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
 
 
 @primitive
+def sequence_parallel_attention(query, key, value, is_causal=True,
+                                scale=None, axis_name="sep"):
+    """Ring attention over the 'sep' mesh axis (kernels/ring_attention.py
+    — sequence/context parallelism, the capability the reference snapshot
+    lacks, SURVEY §5). Falls back to regular attention when the mesh has
+    no sep axis, so models can enable it unconditionally."""
+    q, k, v = _A(query), _A(key), _A(value)
+    from ...distributed import mesh as _mesh
+
+    mesh = _mesh.get_mesh()
+    if (axis_name not in mesh.axis_names
+            or mesh.shape.get(axis_name, 1) <= 1):
+        return scaled_dot_product_attention.raw_fn(
+            q, k, v, is_causal=is_causal, scale=scale)
+    from ...kernels.ring_attention import (
+        sequence_parallel_attention as _ring,
+    )
+
+    return _ring(q, k, v, mesh=mesh, causal=is_causal, scale=scale,
+                 axis_name=axis_name)
+
+
+@primitive
 def sparse_attention(query, key, value, sparse_csr_offset=None,
                      sparse_csr_columns=None, attn_mask=None):
     # Block-sparse attention degenerates to dense + mask on TPU; the Pallas

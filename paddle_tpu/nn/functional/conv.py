@@ -147,3 +147,89 @@ def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
                      output_padding=0, dilation=1, groups=1, data_format="NCDHW"):
     return _conv_transpose(x, weight, bias, stride, padding, output_padding,
                            dilation, groups, 3, data_format == "NDHWC")
+
+
+@primitive
+def deformable_conv(x, offset, weight, mask=None, bias=None, stride=1,
+                    padding=0, dilation=1, deformable_groups=1, groups=1):
+    """Deformable convolution v1/v2 (reference
+    phi/kernels/deformable_conv_kernel.h; v2 when `mask` given).
+
+    x [N,C,H,W]; offset [N, 2*dg*kh*kw, OH, OW] as (dy, dx) pairs;
+    mask [N, dg*kh*kw, OH, OW]; weight [Cout, C/groups, kh, kw].
+    Implemented as bilinear sampling of one patch tensor followed by a
+    single big einsum — the patch gather feeds the MXU contraction the
+    same way the reference's im2col-with-offsets does."""
+    x = _A(x)
+    offset = _A(offset).astype(jnp.float32)
+    w = _A(weight)
+    N, C, H, W = x.shape
+    Cout, Cg, kh, kw = w.shape
+    st = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    pd = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    dl = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
+    OH, OW = offset.shape[2], offset.shape[3]
+    K = kh * kw
+    dg = deformable_groups
+    off = offset.reshape(N, dg, K, 2, OH, OW)
+
+    # base sampling positions per output pixel and tap (K = kh*kw taps,
+    # row-major over the kernel window)
+    oy = jnp.broadcast_to(
+        jnp.arange(OH, dtype=jnp.float32)[:, None] * st[0] - pd[0],
+        (OH, OW))
+    ox = jnp.broadcast_to(
+        jnp.arange(OW, dtype=jnp.float32)[None, :] * st[1] - pd[1],
+        (OH, OW))
+    ky_flat = jnp.repeat(jnp.arange(kh, dtype=jnp.float32) * dl[0], kw)
+    kx_flat = jnp.tile(jnp.arange(kw, dtype=jnp.float32) * dl[1], kh)
+    base_y = oy[None] + ky_flat[:, None, None]              # [K, OH, OW]
+    base_x = ox[None] + kx_flat[:, None, None]              # [K, OH, OW]
+
+    py = base_y[None, None] + off[:, :, :, 0]               # [N,dg,K,OH,OW]
+    px = base_x[None, None] + off[:, :, :, 1]
+
+    xv = jnp.transpose(x, (0, 2, 3, 1)).astype(jnp.float32)  # [N,H,W,C]
+    nidx = jnp.arange(N)[:, None, None, None, None]
+
+    def sample(iy, ix):
+        valid = (iy >= 0) & (iy < H) & (ix >= 0) & (ix < W)
+        v = xv[nidx, jnp.clip(iy, 0, H - 1), jnp.clip(ix, 0, W - 1)]
+        return jnp.where(valid[..., None], v, 0.0)  # [N,dg,K,OH,OW,C]
+
+    y0 = jnp.floor(py)
+    x0 = jnp.floor(px)
+    wy1 = py - y0
+    wx1 = px - x0
+    wy0, wx0 = 1.0 - wy1, 1.0 - wx1
+    patches = (
+        sample(y0.astype(jnp.int32), x0.astype(jnp.int32))
+        * (wy0 * wx0)[..., None]
+        + sample(y0.astype(jnp.int32), (x0 + 1).astype(jnp.int32))
+        * (wy0 * wx1)[..., None]
+        + sample((y0 + 1).astype(jnp.int32), x0.astype(jnp.int32))
+        * (wy1 * wx0)[..., None]
+        + sample((y0 + 1).astype(jnp.int32), (x0 + 1).astype(jnp.int32))
+        * (wy1 * wx1)[..., None]
+    )  # [N, dg, K, OH, OW, C]
+    if mask is not None:
+        m = _A(mask).astype(jnp.float32).reshape(N, dg, K, OH, OW)
+        patches = patches * m[..., None]
+    # channels belong to their deformable group: split C into dg chunks
+    patches = patches.reshape(N, dg, K, OH, OW, dg, C // dg)
+    didx = jnp.arange(dg)
+    patches = patches[:, didx, :, :, :, didx]  # [dg, N, K, OH, OW, C/dg]
+    patches = jnp.moveaxis(patches, 0, 1)      # [N, dg, K, OH, OW, C/dg]
+    patches = jnp.moveaxis(patches, (1, 5), (4, 5))  # [N,K,OH,OW,dg,C/dg]
+    patches = patches.reshape(N, K, OH, OW, C)
+    wr = w.reshape(Cout, Cg, K).astype(jnp.float32)
+    if groups == 1:
+        out = jnp.einsum("nkhwc,ock->nohw", patches, wr)
+    else:
+        pg = patches.reshape(N, K, OH, OW, groups, C // groups)
+        wg = wr.reshape(groups, Cout // groups, Cg, K)
+        out = jnp.einsum("nkhwgc,gock->ngohw", pg, wg).reshape(
+            N, Cout, OH, OW)
+    if bias is not None:
+        out = out + _A(bias).reshape(1, -1, 1, 1)
+    return out.astype(x.dtype)

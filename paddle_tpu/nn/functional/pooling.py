@@ -54,10 +54,90 @@ def _pool(x, kernel, stride, padding, n, reducer, init, channel_last):
 @primitive
 def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                return_mask=False, data_format="NCHW"):
-    out = _pool(_A(x), kernel_size, stride, padding, 2, jax.lax.max,
-                -jnp.inf if jnp.issubdtype(_A(x).dtype, jnp.floating) else jnp.iinfo(_A(x).dtype).min,
+    xv = _A(x)
+    if return_mask:
+        # max_pool2d_with_index (reference phi/kernels/pool_kernel.h
+        # MaxPoolWithIndex): indices are flattened positions in the
+        # input H*W plane, as max_unpool2d expects.
+        if data_format == "NHWC":
+            xv = jnp.transpose(xv, (0, 3, 1, 2))
+        ks = _norm(kernel_size, 2)
+        st = _norm(stride if stride is not None else kernel_size, 2)
+        pd = _pads(padding, 2)
+        N, C, H, W = xv.shape
+        if isinstance(pd, str):
+            if pd == "SAME":
+                # same split reduce_window uses for SAME padding
+                pd = []
+                for size, k, s in ((H, ks[0], st[0]), (W, ks[1], st[1])):
+                    out_sz = -(-size // s)
+                    total = max((out_sz - 1) * s + k - size, 0)
+                    pd.append((total // 2, total - total // 2))
+            else:  # VALID
+                pd = [(0, 0), (0, 0)]
+        # finite lowest (NOT -inf): the patch extraction lowers to a
+        # convolution with 0/1 filters and -inf * 0 would produce NaN
+        neg = float(jnp.finfo(jnp.float32).min)
+        xp = jnp.pad(xv.astype(jnp.float32),
+                     ((0, 0), (0, 0), pd[0], pd[1]),
+                     constant_values=neg)
+        patches = jax.lax.conv_general_dilated_patches(
+            xp, filter_shape=tuple(ks), window_strides=tuple(st),
+            padding="VALID", dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        n, ckk, oh, ow = patches.shape
+        p = patches.reshape(N, C, ks[0] * ks[1], oh, ow)
+        out = jnp.max(p, axis=2)
+        arg = jnp.argmax(p, axis=2)  # local patch index
+        di = arg // ks[1]
+        dj = arg % ks[1]
+        ohs = jnp.arange(oh)[None, None, :, None]
+        ows = jnp.arange(ow)[None, None, None, :]
+        iy = ohs * st[0] - pd[0][0] + di
+        ix = ows * st[1] - pd[1][0] + dj
+        mask = (iy * W + ix).astype(jnp.int32)
+        out = out.astype(xv.dtype)
+        if data_format == "NHWC":
+            out = jnp.transpose(out, (0, 2, 3, 1))
+            mask = jnp.transpose(mask, (0, 2, 3, 1))
+        return out, mask
+    out = _pool(xv, kernel_size, stride, padding, 2, jax.lax.max,
+                -jnp.inf if jnp.issubdtype(xv.dtype, jnp.floating)
+                else jnp.iinfo(xv.dtype).min,
                 data_format == "NHWC")
-    return out.astype(_A(x).dtype)
+    return out.astype(xv.dtype)
+
+
+@primitive
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None):
+    """Inverse of max_pool2d(return_mask=True) (reference
+    unpool_kernel.h): scatter pooled values back to their argmax
+    positions; everything else zero."""
+    xv = _A(x)
+    idx = _A(indices).astype(jnp.int32)
+    if data_format == "NHWC":
+        xv = jnp.transpose(xv, (0, 3, 1, 2))
+        idx = jnp.transpose(idx, (0, 3, 1, 2))
+    ks = _norm(kernel_size, 2)
+    st = _norm(stride if stride is not None else kernel_size, 2)
+    N, C, oh, ow = xv.shape
+    if output_size is None:
+        pd = _pads(padding, 2)
+        pd = pd if not isinstance(pd, str) else [(0, 0), (0, 0)]
+        H = (oh - 1) * st[0] - pd[0][0] - pd[0][1] + ks[0]
+        W = (ow - 1) * st[1] - pd[1][0] - pd[1][1] + ks[1]
+    else:
+        H, W = [int(s) for s in output_size[-2:]]
+    flat = jnp.zeros((N, C, H * W), xv.dtype)
+    out = flat.at[
+        jnp.arange(N)[:, None, None],
+        jnp.arange(C)[None, :, None],
+        idx.reshape(N, C, -1),
+    ].add(xv.reshape(N, C, -1))
+    out = out.reshape(N, C, H, W)
+    if data_format == "NHWC":
+        out = jnp.transpose(out, (0, 2, 3, 1))
+    return out
 
 
 @primitive

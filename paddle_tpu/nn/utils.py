@@ -1,0 +1,135 @@
+"""paddle.nn.utils — weight reparameterizations.
+
+Parity: reference python/paddle/nn/utils/{spectral_norm_hook.py,
+weight_norm_hook.py} and the spectral_norm / weight_norm PHI kernels
+(phi/kernels/spectral_norm_kernel.h). TPU-native: the reparameterization
+runs as a forward-pre-hook of dispatched ops, so under jit the power
+iteration and normalization fuse into the step program.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.dispatch import no_grad, primitive
+
+_A = jnp.asarray
+
+
+@primitive
+def spectral_norm_weight(weight, u, v, dim=0, power_iters=1, eps=1e-12):
+    """One power-iteration refresh + normalization: returns
+    (w / sigma, new_u, new_v) (reference spectral_norm_kernel.h)."""
+    w = _A(weight)
+    moved = jnp.moveaxis(w, dim, 0)
+    mat = moved.reshape(moved.shape[0], -1).astype(jnp.float32)
+    uu = _A(u).astype(jnp.float32)
+    vv = _A(v).astype(jnp.float32)
+    for _ in range(max(power_iters, 0)):
+        vv = mat.T @ uu
+        vv = vv / jnp.maximum(jnp.linalg.norm(vv), eps)
+        uu = mat @ vv
+        uu = uu / jnp.maximum(jnp.linalg.norm(uu), eps)
+    sigma = uu @ mat @ vv
+    out = (mat / jnp.maximum(sigma, eps)).reshape(moved.shape)
+    return (jnp.moveaxis(out, 0, dim).astype(w.dtype),
+            uu.astype(w.dtype), vv.astype(w.dtype))
+
+
+class _SpectralNormHook:
+    def __init__(self, layer, name, n_power_iterations, eps, dim):
+        self.name = name
+        self.n = n_power_iterations
+        self.eps = eps
+        self.dim = dim
+        w = getattr(layer, name)
+        moved_shape = list(w.shape)
+        h = moved_shape[dim]
+        wsize = 1
+        for i, s in enumerate(moved_shape):
+            if i != dim:
+                wsize *= s
+        import numpy as np
+
+        rng = np.random.RandomState(0)
+        layer._sn_u = Tensor(jnp.asarray(
+            rng.randn(h).astype(np.float32)), stop_gradient=True)
+        layer._sn_v = Tensor(jnp.asarray(
+            rng.randn(wsize).astype(np.float32)), stop_gradient=True)
+        # keep the raw weight under name_orig; `name` becomes derived
+        layer.add_parameter(name + "_orig", w)
+
+    def __call__(self, layer, inputs):
+        w = getattr(layer, self.name + "_orig")
+        out = spectral_norm_weight(w, layer._sn_u, layer._sn_v,
+                                   dim=self.dim, power_iters=self.n,
+                                   eps=self.eps)
+        w_sn, u, v = out
+        with no_grad():
+            layer._sn_u.set_value(u.detach() if hasattr(u, "detach") else u)
+            layer._sn_v.set_value(v.detach() if hasattr(v, "detach") else v)
+        setattr(layer, self.name, w_sn)
+        return None
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    """Apply spectral normalization to `layer.name` (reference
+    nn/utils/spectral_norm_hook.py)."""
+    if dim is None:
+        dim = 0
+    hook = _SpectralNormHook(layer, name, n_power_iterations, eps, dim)
+    # drop the original parameter slot so only weight_orig trains
+    if name in layer._parameters:
+        del layer._parameters[name]
+    layer.register_forward_pre_hook(hook)
+    return layer
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Weight normalization w = g * v / ||v|| (reference
+    nn/utils/weight_norm_hook.py)."""
+    w = getattr(layer, name)
+    wv = _A(w._value)
+    moved = jnp.moveaxis(wv, dim, 0).reshape(wv.shape[dim], -1)
+    g0 = jnp.linalg.norm(moved, axis=1)
+    layer.add_parameter(name + "_g", Tensor(g0, stop_gradient=False))
+    layer.add_parameter(name + "_v", w)
+    if name in layer._parameters:
+        del layer._parameters[name]
+
+    def hook(l, inputs):
+        v = getattr(l, name + "_v")
+        g = getattr(l, name + "_g")
+        vv = _A(v._value) if isinstance(v, Tensor) else _A(v)
+        mv = jnp.moveaxis(_A(vv), dim, 0)
+        flat = mv.reshape(mv.shape[0], -1)
+        normed = flat / jnp.maximum(
+            jnp.linalg.norm(flat, axis=1, keepdims=True), 1e-12)
+
+        @primitive(name="weight_norm_apply")
+        def _apply(vt, gt):
+            mvt = jnp.moveaxis(_A(vt), dim, 0)
+            ft = mvt.reshape(mvt.shape[0], -1)
+            nt = ft / jnp.maximum(
+                jnp.linalg.norm(ft, axis=1, keepdims=True), 1e-12)
+            out = nt * _A(gt)[:, None]
+            return jnp.moveaxis(out.reshape(mvt.shape), 0, dim)
+
+        setattr(l, name, _apply(v, g))
+        return None
+
+    layer.register_forward_pre_hook(hook)
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    """Fold the current derived weight back into a plain parameter."""
+    w = getattr(layer, name)
+    if isinstance(w, Tensor):
+        layer.add_parameter(name, Tensor(w._value, stop_gradient=False))
+    for k in (name + "_g", name + "_v", name + "_orig"):
+        if k in getattr(layer, "_parameters", {}):
+            del layer._parameters[k]
+    layer._forward_pre_hooks.clear()
+    return layer

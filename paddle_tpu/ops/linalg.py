@@ -189,3 +189,47 @@ def einsum(equation, *operands):
 @primitive(name="einsum")
 def _einsum(operands, equation):
     return jnp.einsum(equation, *[_A(o) for o in operands])
+
+
+@primitive(nondiff=True)
+def eigvals(x):
+    """General (possibly complex) eigenvalues (reference
+    eigvals_kernel.h). LAPACK path — eager/CPU like the reference."""
+    import numpy as np
+
+    return jnp.asarray(np.linalg.eigvals(np.asarray(_A(x))))
+
+
+@primitive(nondiff=True)
+def lu(x, pivot=True, get_infos=False):
+    """LU factorization, packed L\\U + 1-based pivots (reference
+    lu_kernel.h)."""
+    import jax.scipy.linalg as jsl
+
+    a = _A(x)
+    lu_mat, piv = jsl.lu_factor(a)
+    piv = piv.astype(jnp.int32) + 1  # paddle pivots are 1-based
+    if get_infos:
+        info = jnp.zeros(a.shape[:-2], jnp.int32)
+        return lu_mat, piv, info
+    return lu_mat, piv
+
+
+@primitive(nondiff=True)
+def lu_unpack(lu_mat, pivots, unpack_ludata=True, unpack_pivots=True):
+    """Unpack lu() results into P, L, U (reference lu_unpack_kernel)."""
+    a = _A(lu_mat)
+    n = a.shape[-2]
+    L = jnp.tril(a, -1) + jnp.eye(n, a.shape[-1], dtype=a.dtype)
+    U = jnp.triu(a)
+    piv = _A(pivots).astype(jnp.int32) - 1
+    perm = jnp.arange(n, dtype=jnp.int32)
+
+    def swap(perm, i):
+        j = piv[i]
+        pi, pj = perm[i], perm[j]
+        return perm.at[i].set(pj).at[j].set(pi), None
+
+    perm, _ = jax.lax.scan(swap, perm, jnp.arange(piv.shape[-1]))
+    P = jnp.eye(n, dtype=a.dtype)[perm].T
+    return P, L, U

@@ -96,6 +96,7 @@ class Program:
         self._run_cache = {}
         self._analyze_cache = None  # (version, params, frozen)
         self._state_updates = {}  # id(target) -> (target, source Tensor)
+        self._tape_out_ids = set()  # ids of tensors produced by the tape
 
     # -- introspection (reference Program API) ---------------------------
     def global_block(self):
@@ -112,6 +113,7 @@ class Program:
         p.feed_vars = dict(self.feed_vars)
         p._grad_map = dict(self._grad_map)
         p._state_updates = dict(self._state_updates)
+        p._tape_out_ids = set(self._tape_out_ids)
         p._run_cache = {}
         p._analyze_cache = None
         p.__dict__.pop("_native_interp", None)  # DAG is per-program
@@ -189,6 +191,8 @@ def _record(op_name, raw_fn, leaves, treedef, outs, multi):
     if prog is None:
         return
     prog.tape.append(_OpRecord(op_name, raw_fn, leaves, treedef, outs, multi))
+    for t in outs:
+        prog._tape_out_ids.add(id(t))
     prog._bump()
 
 
@@ -196,9 +200,13 @@ def _record_state_assign(target, source):
     """Tensor.set_value(Tensor) during capture = a state edge: Executor
     threads `source`'s replayed value back into `target` after each run
     (BatchNorm running stats; the reference batch_norm op's
-    MeanOut/VarianceOut outputs)."""
+    MeanOut/VarianceOut outputs).
+
+    Only assignments whose SOURCE was produced on this program's tape are
+    state edges; unrelated copies (weight loading, layer conversion)
+    execute eagerly as usual (return False)."""
     prog = _recording_program()
-    if prog is None:
+    if prog is None or id(source) not in prog._tape_out_ids:
         return False
     prog._state_updates[id(target)] = (target, source)
     prog._bump()

@@ -2,6 +2,8 @@
 deform_conv, box ops)."""
 from __future__ import annotations
 
+import math
+
 import numpy as np
 
 import jax
@@ -128,3 +130,199 @@ def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_siz
 
 def generate_anchors(*a, **k):
     raise NotImplementedError("anchor generator lands with detection models")
+
+
+@primitive
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0):
+    """Max RoI pooling (reference phi/kernels/roi_pool_kernel.h): for each
+    box, divide the scaled region into output_size bins and take the max
+    per bin. x: [N, C, H, W]; boxes: [R, 4] (x1, y1, x2, y2)."""
+    x = _A(x)
+    boxes = _A(boxes).astype(jnp.float32)
+    if isinstance(output_size, int):
+        ph = pw = output_size
+    else:
+        ph, pw = output_size
+    N, C, H, W = x.shape
+    bn = np.asarray(boxes_num.numpy() if isinstance(boxes_num, Tensor)
+                    else boxes_num).astype(np.int64)
+    batch_of = np.repeat(np.arange(bn.size), bn)  # static per trace
+
+    ys = jnp.arange(H, dtype=jnp.float32)
+    xs = jnp.arange(W, dtype=jnp.float32)
+
+    def one(ri, box):
+        img = x[batch_of[ri]].astype(jnp.float32)     # [C, H, W]
+        x1 = jnp.round(box[0] * spatial_scale)
+        y1 = jnp.round(box[1] * spatial_scale)
+        x2 = jnp.round(box[2] * spatial_scale)
+        y2 = jnp.round(box[3] * spatial_scale)
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+        bh = rh / ph
+        bw = rw / pw
+        out = []
+        for i in range(ph):
+            for j in range(pw):
+                hs = jnp.floor(y1 + i * bh)
+                he = jnp.ceil(y1 + (i + 1) * bh)
+                ws = jnp.floor(x1 + j * bw)
+                we = jnp.ceil(x1 + (j + 1) * bw)
+                my = (ys >= hs) & (ys < jnp.maximum(he, hs + 1))
+                mx = (xs >= ws) & (xs < jnp.maximum(we, ws + 1))
+                m = my[:, None] & mx[None, :]
+                v = jnp.where(m[None], img, -jnp.inf)
+                mv = jnp.max(v, axis=(1, 2))
+                out.append(jnp.where(jnp.isfinite(mv), mv, 0.0))
+        return jnp.stack(out, 1).reshape(C, ph, pw)
+
+    outs = [one(ri, boxes[ri]) for ri in range(boxes.shape[0])]
+    return jnp.stack(outs, 0).astype(x.dtype)
+
+
+@primitive
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False):
+    """SSD prior (anchor) boxes (reference phi/kernels/prior_box_kernel.h).
+    input: feature map [N, C, H, W]; image: [N, C, Him, Wim].
+    Returns (boxes [H, W, P, 4], variances [H, W, P, 4])."""
+    feat = _A(input)
+    img = _A(image)
+    H, W = feat.shape[2], feat.shape[3]
+    IH, IW = img.shape[2], img.shape[3]
+    step_h = steps[1] if steps[1] > 0 else IH / H
+    step_w = steps[0] if steps[0] > 0 else IW / W
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - a) < 1e-6 for a in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+    whs = []
+    for ms in min_sizes:
+        if min_max_aspect_ratios_order:
+            whs.append((ms, ms))
+            if max_sizes:
+                mx = max_sizes[min_sizes.index(ms)]
+                whs.append((math.sqrt(ms * mx), math.sqrt(ms * mx)))
+            for ar in ars:
+                if abs(ar - 1.0) < 1e-6:
+                    continue
+                whs.append((ms * math.sqrt(ar), ms / math.sqrt(ar)))
+        else:
+            for ar in ars:
+                whs.append((ms * math.sqrt(ar), ms / math.sqrt(ar)))
+            if max_sizes:
+                mx = max_sizes[min_sizes.index(ms)]
+                whs.append((math.sqrt(ms * mx), math.sqrt(ms * mx)))
+    P = len(whs)
+    cy = (jnp.arange(H, dtype=jnp.float32) + offset) * step_h
+    cx = (jnp.arange(W, dtype=jnp.float32) + offset) * step_w
+    gy, gx = jnp.meshgrid(cy, cx, indexing="ij")      # [H, W]
+    wh = jnp.asarray(whs, jnp.float32)                # [P, 2]
+    x1 = (gx[..., None] - wh[None, None, :, 0] / 2) / IW
+    y1 = (gy[..., None] - wh[None, None, :, 1] / 2) / IH
+    x2 = (gx[..., None] + wh[None, None, :, 0] / 2) / IW
+    y2 = (gy[..., None] + wh[None, None, :, 1] / 2) / IH
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1)      # [H, W, P, 4]
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variance, jnp.float32),
+                           boxes.shape)
+    return boxes, var
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, rois_num=None):
+    """reference distribute_fpn_proposals_kernel: route each RoI to an
+    FPN level by scale. Host-side (data-dependent splits, like the
+    reference CPU kernel). Returns (multi_rois list, restore_index,
+    rois_num_per_level list)."""
+    rois = np.asarray(fpn_rois.numpy() if isinstance(fpn_rois, Tensor)
+                      else fpn_rois)
+    w = np.maximum(rois[:, 2] - rois[:, 0], 0.0)
+    h = np.maximum(rois[:, 3] - rois[:, 1], 0.0)
+    scale = np.sqrt(w * h)
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    multi, nums, order = [], [], []
+    for l in range(min_level, max_level + 1):
+        idx = np.flatnonzero(lvl == l)
+        order.append(idx)
+        multi.append(Tensor(jnp.asarray(rois[idx])))
+        nums.append(Tensor(jnp.asarray(np.asarray([idx.size], np.int32))))
+    order = np.concatenate(order) if order else np.empty((0,), np.int64)
+    restore = np.empty_like(order)
+    restore[order] = np.arange(order.size)
+    return multi, Tensor(jnp.asarray(restore)), nums
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False):
+    """RPN proposal generation (reference generate_proposals_v2 kernel):
+    decode anchors with deltas, clip, filter small, NMS. Host-side
+    composition of existing pieces (single image [A,1,H,W]-flattened or
+    [N=1] batch)."""
+    s = np.asarray(scores.numpy() if isinstance(scores, Tensor)
+                   else scores).reshape(-1)
+    d = np.asarray(bbox_deltas.numpy() if isinstance(bbox_deltas, Tensor)
+                   else bbox_deltas).reshape(-1, 4)
+    a = np.asarray(anchors.numpy() if isinstance(anchors, Tensor)
+                   else anchors).reshape(-1, 4)
+    v = np.asarray(variances.numpy() if isinstance(variances, Tensor)
+                   else variances).reshape(-1, 4)
+    im = np.asarray(img_size.numpy() if isinstance(img_size, Tensor)
+                    else img_size).reshape(-1)
+    order = np.argsort(-s)[:pre_nms_top_n]
+    s, d, a, v = s[order], d[order], a[order], v[order]
+    aw = a[:, 2] - a[:, 0] + (1.0 if pixel_offset else 0.0)
+    ah = a[:, 3] - a[:, 1] + (1.0 if pixel_offset else 0.0)
+    acx = a[:, 0] + aw / 2
+    acy = a[:, 1] + ah / 2
+    cx = v[:, 0] * d[:, 0] * aw + acx
+    cy = v[:, 1] * d[:, 1] * ah + acy
+    bw = aw * np.exp(np.minimum(v[:, 2] * d[:, 2], 10.0))
+    bh = ah * np.exp(np.minimum(v[:, 3] * d[:, 3], 10.0))
+    off = 1.0 if pixel_offset else 0.0
+    boxes = np.stack([cx - bw / 2, cy - bh / 2,
+                      cx + bw / 2 - off, cy + bh / 2 - off], 1)
+    boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, im[1] - off)
+    boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, im[0] - off)
+    ok = ((boxes[:, 2] - boxes[:, 0] >= min_size)
+          & (boxes[:, 3] - boxes[:, 1] >= min_size))
+    boxes, s = boxes[ok], s[ok]
+    keep = np.asarray(nms(Tensor(jnp.asarray(boxes)), nms_thresh,
+                          scores=Tensor(jnp.asarray(s)),
+                          top_k=post_nms_top_n).numpy())
+    rois = Tensor(jnp.asarray(boxes[keep]))
+    out_scores = Tensor(jnp.asarray(s[keep]))
+    if return_rois_num:
+        return rois, out_scores, Tensor(
+            jnp.asarray(np.asarray([keep.size], np.int32)))
+    return rois, out_scores
+
+
+def decode_jpeg(x, mode="unchanged"):
+    """Host-side JPEG decode (reference decode_jpeg_kernel is the GPU
+    nvjpeg path; TPU input pipelines decode on host). x: 1-D uint8
+    buffer; returns [C, H, W] uint8."""
+    import io as _io
+
+    from PIL import Image
+
+    buf = np.asarray(x.numpy() if isinstance(x, Tensor) else x,
+                     np.uint8).tobytes()
+    img = Image.open(_io.BytesIO(buf))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = np.transpose(arr, (2, 0, 1))
+    return Tensor(jnp.asarray(arr))

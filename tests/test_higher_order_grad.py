@@ -159,3 +159,46 @@ class TestIncubateAutograd:
         assert not ag.prim_enabled()
         ag.enable_prim()
         assert ag.prim_enabled()
+
+
+class TestRegisterHook:
+    """Tensor.register_hook fires during backward on the accumulated
+    gradient (reference eager GradientHooks, grad_node_info.h)."""
+
+    def test_leaf_hook_modifies_grad(self):
+        x = _t([1.0, 2.0])
+        x.stop_gradient = False
+        h = x.register_hook(lambda g: g * 2)
+        (x * 3).sum().backward()
+        np.testing.assert_allclose(np.asarray(x.grad._value), [6.0, 6.0])
+        h.remove()
+        x.grad = None
+        (x * 3).sum().backward()
+        np.testing.assert_allclose(np.asarray(x.grad._value), [3.0, 3.0])
+
+    def test_interior_hook_sees_and_modifies_flow(self):
+        x = _t([1.0, 2.0])
+        x.stop_gradient = False
+        mid = x * 4
+        seen = []
+
+        def spy(g):
+            seen.append(np.asarray(g._value))
+            return g * 10
+
+        mid.register_hook(spy)
+        (mid * 5).sum().backward()
+        assert len(seen) == 1
+        np.testing.assert_allclose(seen[0], [5.0, 5.0])
+        np.testing.assert_allclose(np.asarray(x.grad._value), [200.0, 200.0])
+
+    def test_hook_on_accumulated_fanout(self):
+        # two consumers: hook must see the SUM of both contributions
+        x = _t([1.0])
+        x.stop_gradient = False
+        seen = []
+        x.register_hook(lambda g: seen.append(np.asarray(g._value)))
+        y = (x * 2).sum() + (x * 3).sum()
+        y.backward()
+        assert len(seen) == 1
+        np.testing.assert_allclose(seen[0], [5.0])

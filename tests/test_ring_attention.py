@@ -1,0 +1,137 @@
+"""Ring attention (sequence/context parallelism over the 'sep' axis) —
+numerics vs dense attention, gradients, and Llama integration.
+Capability the reference snapshot lacks (SURVEY §5); kernel in
+paddle_tpu/kernels/ring_attention.py.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import mesh as pmesh
+from paddle_tpu.kernels.ring_attention import (
+    ring_attention,
+    sequence_parallel_attention,
+)
+
+
+def _dense(q, k, v, causal):
+    # [B, N, H, D] fp64 oracle
+    q64 = q.astype(np.float64)
+    k64 = k.astype(np.float64)
+    v64 = v.astype(np.float64)
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    s = np.einsum("bnhd,bmhd->bhnm", q64, k64) * scale
+    if causal:
+        n, m = s.shape[-2], s.shape[-1]
+        mask = np.tril(np.ones((n, m), bool))
+        s = np.where(mask, s, -1e30)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    return np.einsum("bhnm,bmhd->bnhd", p, v64)
+
+
+def _mesh_sep(n=4):
+    return Mesh(np.array(jax.devices()[:n]), ("sep",))
+
+
+class TestRingAttentionNumerics:
+    @pytest.mark.parametrize("causal", [False, True])
+    @pytest.mark.parametrize("shape", [(2, 32, 2, 8), (1, 64, 3, 16)])
+    def test_matches_dense(self, causal, shape):
+        rng = np.random.RandomState(0)
+        b, n, h, d = shape
+        q = rng.randn(b, n, h, d).astype(np.float32)
+        k = rng.randn(b, n, h, d).astype(np.float32)
+        v = rng.randn(b, n, h, d).astype(np.float32)
+        mesh = _mesh_sep(4)
+        with mesh:
+            out = sequence_parallel_attention(
+                jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                mesh=mesh, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), _dense(q, k, v, causal),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_causal_gradient_matches_dense(self):
+        rng = np.random.RandomState(1)
+        b, n, h, d = 1, 32, 2, 8
+        q = rng.randn(b, n, h, d).astype(np.float32)
+        k = rng.randn(b, n, h, d).astype(np.float32)
+        v = rng.randn(b, n, h, d).astype(np.float32)
+        mesh = _mesh_sep(4)
+
+        def ring_loss(q, k, v):
+            with mesh:
+                out = sequence_parallel_attention(q, k, v, mesh=mesh,
+                                                  causal=True)
+            return jnp.sum(out * out)
+
+        def dense_loss(q, k, v):
+            scale = 1.0 / np.sqrt(d)
+            s = jnp.einsum("bnhd,bmhd->bhnm", q, k) * scale
+            mask = jnp.tril(jnp.ones((n, n), bool))
+            s = jnp.where(mask, s, -1e30)
+            p = jax.nn.softmax(s, axis=-1)
+            out = jnp.einsum("bhnm,bmhd->bnhd", p, v)
+            return jnp.sum(out * out)
+
+        gr = jax.grad(ring_loss, argnums=(0, 1, 2))(q, k, v)
+        gd = jax.grad(dense_loss, argnums=(0, 1, 2))(q, k, v)
+        for a, b_ in zip(gr, gd):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       rtol=5e-4, atol=5e-5)
+
+    def test_uneven_heads_and_long_ring(self):
+        # 8-way ring, 8 tokens per device — exercises multiple fully
+        # masked blocks under causality
+        rng = np.random.RandomState(2)
+        b, n, h, d = 2, 64, 1, 4
+        q = rng.randn(b, n, h, d).astype(np.float32)
+        k = rng.randn(b, n, h, d).astype(np.float32)
+        v = rng.randn(b, n, h, d).astype(np.float32)
+        mesh = Mesh(np.array(jax.devices()[:8]), ("sep",))
+        with mesh:
+            out = sequence_parallel_attention(
+                jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                mesh=mesh, causal=True)
+        np.testing.assert_allclose(np.asarray(out), _dense(q, k, v, True),
+                                   rtol=2e-4, atol=2e-5)
+
+
+class TestLlamaSequenceParallel:
+    def test_sep_train_step_matches_dense(self):
+        """Golden parity: the same tiny Llama, same seed and data, trained
+        one step with sep=4 ring attention vs no sep — losses must match."""
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+        from paddle_tpu.parallel.engine import CompiledTrainStep
+
+        losses = {}
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, 128, (2, 32)).astype(np.int32)
+        labels = rng.randint(0, 128, (2, 32)).astype(np.int32)
+        for name, sp in [("dense", False), ("sep", True)]:
+            if sp:
+                pmesh.build_hybrid_mesh(dp=2, sep=4)
+            else:
+                pmesh.build_hybrid_mesh(dp=2,
+                                        devices=jax.devices()[:2])
+            paddle.seed(0)
+            cfg = LlamaConfig.tiny(vocab_size=128, use_parallel=False,
+                                   sequence_parallel=sp)
+            model = LlamaForCausalLM(cfg)
+            opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                       parameters=model.parameters())
+
+            def loss_fn(logits, lab):
+                return F.cross_entropy(
+                    logits.reshape([-1, cfg.vocab_size]), lab.reshape([-1]))
+
+            step = CompiledTrainStep(model, loss_fn, opt)
+            ls = [float(step(paddle.to_tensor(ids),
+                             paddle.to_tensor(labels))) for _ in range(2)]
+            losses[name] = ls
+        np.testing.assert_allclose(losses["sep"], losses["dense"],
+                                   rtol=2e-4)
