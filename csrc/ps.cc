@@ -1,0 +1,640 @@
+// Parameter-server core: sparse/dense tables + optimizer accessors behind
+// a TCP service, with a ctypes client API.
+//
+// Parity: the reference's brpc PS stack —
+//   table hierarchy   /root/reference/paddle/fluid/distributed/ps/table/
+//                     memory_sparse_table.cc (shard map id -> row,
+//                     create-on-miss), memory_dense_table.cc
+//   accessors         ps/table/sparse_sgd_rule.cc (SGD / AdaGrad / Adam
+//                     update rules applied server-side on push)
+//   service           ps/service/brpc_ps_server.cc (pull/push RPCs)
+//   geo mode          ps/service/communicator/ (delta merge)
+// TPU-native design: tables live on TPU-VM hosts (CPU memory); the device
+// only sees dense minibatch rows. The wire protocol is a length-prefixed
+// binary framing over the same socket substrate as store.cc — no brpc.
+//
+// C ABI (ctypes, used by paddle_tpu/distributed/ps/service.py):
+//   pt_ps_server_start(port) -> handle        pt_ps_server_port(h)
+//   pt_ps_server_stop(h)
+//   pt_ps_connect(host, port, timeout_ms) -> fd   pt_ps_close(fd)
+//   pt_ps_create_sparse(fd, tid, dim, opt, lr, init_std, seed)
+//   pt_ps_create_dense(fd, tid, size, opt, lr)
+//   pt_ps_pull_sparse(fd, tid, ids, n, out)       // out: n*dim f32
+//   pt_ps_push_sparse(fd, tid, ids, n, grads, mode) // 0 grad, 1 geo delta
+//   pt_ps_pull_dense(fd, tid, out, size)
+//   pt_ps_push_dense(fd, tid, grad, size, mode)
+//   pt_ps_sparse_size(fd, tid, out_n)
+//   pt_ps_save(fd, tid, path) / pt_ps_load(fd, tid, path)
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <random>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------- tables
+
+enum Opt { OPT_SGD = 0, OPT_ADAGRAD = 1, OPT_ADAM = 2 };
+
+static int slots_for(int opt) {
+  switch (opt) {
+    case OPT_ADAGRAD: return 1;  // accumulated g^2
+    case OPT_ADAM: return 2;     // m, v
+    default: return 0;
+  }
+}
+
+struct SparseTable {
+  int dim = 0;
+  int opt = OPT_SGD;
+  float lr = 0.01f;
+  float init_std = 0.01f;
+  std::mt19937 rng{0};
+  // row layout: [w(dim)][slot0(dim)][slot1(dim)][t(1 if adam)]
+  std::unordered_map<int64_t, std::vector<float>> rows;
+  std::mutex mu;
+
+  size_t row_size() const {
+    return dim * (1 + slots_for(opt)) + (opt == OPT_ADAM ? 1 : 0);
+  }
+
+  std::vector<float>& row(int64_t id) {
+    auto it = rows.find(id);
+    if (it != rows.end()) return it->second;
+    std::vector<float> r(row_size(), 0.0f);
+    std::normal_distribution<float> d(0.0f, init_std);
+    for (int i = 0; i < dim; ++i) r[i] = d(rng);
+    return rows.emplace(id, std::move(r)).first->second;
+  }
+
+  void apply(std::vector<float>& r, const float* g) {
+    float* w = r.data();
+    if (opt == OPT_SGD) {
+      for (int i = 0; i < dim; ++i) w[i] -= lr * g[i];
+    } else if (opt == OPT_ADAGRAD) {
+      float* acc = w + dim;
+      for (int i = 0; i < dim; ++i) {
+        acc[i] += g[i] * g[i];
+        w[i] -= lr * g[i] / (std::sqrt(acc[i]) + 1e-8f);
+      }
+    } else {  // adam
+      float* m = w + dim;
+      float* v = w + 2 * dim;
+      float& t = r[3 * dim];
+      t += 1.0f;
+      const float b1 = 0.9f, b2 = 0.999f;
+      float bc1 = 1.0f - std::pow(b1, t);
+      float bc2 = 1.0f - std::pow(b2, t);
+      for (int i = 0; i < dim; ++i) {
+        m[i] = b1 * m[i] + (1 - b1) * g[i];
+        v[i] = b2 * v[i] + (1 - b2) * g[i] * g[i];
+        w[i] -= lr * (m[i] / bc1) / (std::sqrt(v[i] / bc2) + 1e-8f);
+      }
+    }
+  }
+};
+
+struct DenseTable {
+  int opt = OPT_SGD;
+  float lr = 0.01f;
+  std::vector<float> w, s0, s1;
+  float t = 0.0f;
+  std::mutex mu;
+
+  void init(size_t n) {
+    w.assign(n, 0.0f);
+    if (slots_for(opt) > 0) s0.assign(n, 0.0f);
+    if (slots_for(opt) > 1) s1.assign(n, 0.0f);
+  }
+
+  void apply(const float* g) {
+    size_t n = w.size();
+    if (opt == OPT_SGD) {
+      for (size_t i = 0; i < n; ++i) w[i] -= lr * g[i];
+    } else if (opt == OPT_ADAGRAD) {
+      for (size_t i = 0; i < n; ++i) {
+        s0[i] += g[i] * g[i];
+        w[i] -= lr * g[i] / (std::sqrt(s0[i]) + 1e-8f);
+      }
+    } else {
+      t += 1.0f;
+      const float b1 = 0.9f, b2 = 0.999f;
+      float bc1 = 1.0f - std::pow(b1, t);
+      float bc2 = 1.0f - std::pow(b2, t);
+      for (size_t i = 0; i < n; ++i) {
+        s0[i] = b1 * s0[i] + (1 - b1) * g[i];
+        s1[i] = b2 * s1[i] + (1 - b2) * g[i] * g[i];
+        w[i] -= lr * (s0[i] / bc1) / (std::sqrt(s1[i] / bc2) + 1e-8f);
+      }
+    }
+  }
+};
+
+// ------------------------------------------------------------- protocol
+
+enum PsOp : uint8_t {
+  PS_CREATE_SPARSE = 1,
+  PS_CREATE_DENSE = 2,
+  PS_PULL_SPARSE = 3,
+  PS_PUSH_SPARSE = 4,
+  PS_PULL_DENSE = 5,
+  PS_PUSH_DENSE = 6,
+  PS_SPARSE_SIZE = 7,
+  PS_SAVE = 8,
+  PS_LOAD = 9,
+};
+
+static bool read_full(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= r;
+  }
+  return true;
+}
+
+static bool write_full(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= r;
+  }
+  return true;
+}
+
+struct PsServer {
+  int listen_fd = -1;
+  int port = 0;
+  std::atomic<bool> stop{false};
+  std::thread accept_thread;
+  std::vector<std::thread> workers;
+  std::vector<int> conns;  // live client fds, shut down on stop
+  std::mutex conns_mu;
+  std::map<int, SparseTable> sparse;
+  std::map<int, DenseTable> dense;
+  std::mutex tables_mu;
+
+  SparseTable* sparse_tab(int tid) {
+    std::lock_guard<std::mutex> l(tables_mu);
+    auto it = sparse.find(tid);
+    return it == sparse.end() ? nullptr : &it->second;
+  }
+  DenseTable* dense_tab(int tid) {
+    std::lock_guard<std::mutex> l(tables_mu);
+    auto it = dense.find(tid);
+    return it == dense.end() ? nullptr : &it->second;
+  }
+
+  void serve(int cfd) {
+    int one = 1;
+    setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    for (;;) {
+      uint8_t op;
+      uint32_t tid, n;
+      if (!read_full(cfd, &op, 1) || !read_full(cfd, &tid, 4) ||
+          !read_full(cfd, &n, 4))
+        break;
+      int32_t status = 0;
+      switch (op) {
+        case PS_CREATE_SPARSE: {
+          float params[3];
+          uint32_t meta[3];  // dim, opt, seed
+          if (!read_full(cfd, meta, sizeof(meta)) ||
+              !read_full(cfd, params, sizeof(params)))
+            return;
+          SparseTable* t;
+          {
+            std::lock_guard<std::mutex> l(tables_mu);
+            t = &sparse[tid];
+          }
+          // re-create = reset: rows sized for an old layout must never
+          // be indexed with a new one (accessor slots live past dim)
+          std::lock_guard<std::mutex> lt(t->mu);
+          t->rows.clear();
+          t->dim = meta[0];
+          t->opt = meta[1];
+          t->rng.seed(meta[2]);
+          t->lr = params[0];
+          t->init_std = params[1];
+          write_full(cfd, &status, 4);
+          break;
+        }
+        case PS_CREATE_DENSE: {
+          uint32_t meta[1];
+          float params[1];
+          uint64_t size;
+          if (!read_full(cfd, &size, 8) ||
+              !read_full(cfd, meta, sizeof(meta)) ||
+              !read_full(cfd, params, sizeof(params)))
+            return;
+          std::lock_guard<std::mutex> l(tables_mu);
+          DenseTable& t = dense[tid];
+          t.opt = meta[0];
+          t.lr = params[0];
+          t.init(size);
+          write_full(cfd, &status, 4);
+          break;
+        }
+        case PS_PULL_SPARSE: {
+          std::vector<int64_t> ids(n);
+          if (!read_full(cfd, ids.data(), n * 8)) return;
+          SparseTable* t = sparse_tab(tid);
+          if (!t) {
+            status = -1;
+            write_full(cfd, &status, 4);
+            break;
+          }
+          std::vector<float> out(size_t(n) * t->dim);
+          {
+            std::lock_guard<std::mutex> l(t->mu);
+            for (uint32_t i = 0; i < n; ++i) {
+              auto& r = t->row(ids[i]);
+              std::memcpy(out.data() + size_t(i) * t->dim, r.data(),
+                          t->dim * 4);
+            }
+          }
+          write_full(cfd, &status, 4);
+          write_full(cfd, out.data(), out.size() * 4);
+          break;
+        }
+        case PS_PUSH_SPARSE: {
+          uint8_t mode;
+          if (!read_full(cfd, &mode, 1)) return;
+          SparseTable* t = sparse_tab(tid);
+          if (!t) {
+            // cannot size the grad payload without the table's dim —
+            // report and drop the connection (create_table must precede)
+            status = -1;
+            write_full(cfd, &status, 4);
+            ::close(cfd);
+            return;
+          }
+          std::vector<int64_t> ids(n);
+          std::vector<float> g(size_t(n) * t->dim);
+          if (!read_full(cfd, ids.data(), n * 8) ||
+              !read_full(cfd, g.data(), g.size() * 4))
+            return;
+          {
+            std::lock_guard<std::mutex> l(t->mu);
+            for (uint32_t i = 0; i < n; ++i) {
+              auto& r = t->row(ids[i]);
+              const float* gi = g.data() + size_t(i) * t->dim;
+              if (mode == 1) {  // geo: merge raw delta into weights
+                for (int d = 0; d < t->dim; ++d) r[d] += gi[d];
+              } else {
+                t->apply(r, gi);
+              }
+            }
+          }
+          write_full(cfd, &status, 4);
+          break;
+        }
+        case PS_PULL_DENSE: {
+          DenseTable* t = dense_tab(tid);
+          if (!t) {
+            status = -1;
+            write_full(cfd, &status, 4);
+            break;
+          }
+          std::lock_guard<std::mutex> l(t->mu);
+          write_full(cfd, &status, 4);
+          uint64_t size = t->w.size();
+          write_full(cfd, &size, 8);
+          write_full(cfd, t->w.data(), t->w.size() * 4);
+          break;
+        }
+        case PS_PUSH_DENSE: {
+          uint8_t mode;
+          uint64_t size;
+          if (!read_full(cfd, &mode, 1) || !read_full(cfd, &size, 8))
+            return;
+          std::vector<float> g(size);
+          if (!read_full(cfd, g.data(), size * 4)) return;
+          DenseTable* t = dense_tab(tid);
+          if (!t || t->w.size() != size) {
+            status = -1;
+            write_full(cfd, &status, 4);
+            break;
+          }
+          {
+            std::lock_guard<std::mutex> l(t->mu);
+            if (mode == 1) {
+              for (size_t i = 0; i < size; ++i) t->w[i] += g[i];
+            } else {
+              t->apply(g.data());
+            }
+          }
+          write_full(cfd, &status, 4);
+          break;
+        }
+        case PS_SPARSE_SIZE: {
+          SparseTable* t = sparse_tab(tid);
+          uint64_t sz = 0;
+          if (t) {
+            std::lock_guard<std::mutex> l(t->mu);
+            sz = t->rows.size();
+          } else {
+            status = -1;
+          }
+          write_full(cfd, &status, 4);
+          write_full(cfd, &sz, 8);
+          break;
+        }
+        case PS_SAVE:
+        case PS_LOAD: {
+          std::vector<char> path(n + 1, 0);
+          if (!read_full(cfd, path.data(), n)) return;
+          SparseTable* t = sparse_tab(tid);
+          if (!t) {
+            status = -1;
+          } else if (op == PS_SAVE) {
+            FILE* f = std::fopen(path.data(), "wb");
+            if (!f) {
+              status = -2;
+            } else {
+              std::lock_guard<std::mutex> l(t->mu);
+              uint64_t cnt = t->rows.size();
+              uint32_t dim = t->dim;
+              uint32_t rs = t->row_size();
+              std::fwrite(&cnt, 8, 1, f);
+              std::fwrite(&dim, 4, 1, f);
+              std::fwrite(&rs, 4, 1, f);
+              for (auto& kv : t->rows) {
+                std::fwrite(&kv.first, 8, 1, f);
+                std::fwrite(kv.second.data(), 4, kv.second.size(), f);
+              }
+              std::fclose(f);
+            }
+          } else {
+            FILE* f = std::fopen(path.data(), "rb");
+            if (!f) {
+              status = -2;
+            } else {
+              uint64_t cnt;
+              uint32_t dim, rs;
+              if (std::fread(&cnt, 8, 1, f) == 1 &&
+                  std::fread(&dim, 4, 1, f) == 1 &&
+                  std::fread(&rs, 4, 1, f) == 1) {
+                std::lock_guard<std::mutex> l(t->mu);
+                if (dim != static_cast<uint32_t>(t->dim) ||
+                    rs != t->row_size()) {
+                  status = -3;  // layout mismatch (dim/optimizer differ)
+                } else {
+                  for (uint64_t i = 0; i < cnt; ++i) {
+                    int64_t id;
+                    std::vector<float> r(rs);
+                    if (std::fread(&id, 8, 1, f) != 1 ||
+                        std::fread(r.data(), 4, rs, f) != rs)
+                      break;
+                    t->rows[id] = std::move(r);
+                  }
+                }
+              }
+              std::fclose(f);
+            }
+          }
+          write_full(cfd, &status, 4);
+          break;
+        }
+        default:
+          ::close(cfd);
+          return;
+      }
+    }
+    ::close(cfd);
+  }
+};
+
+std::mutex g_ps_mu;
+std::map<int, PsServer*> g_ps_servers;
+int g_next_ps = 1;
+
+}  // namespace
+
+extern "C" {
+
+int pt_ps_server_start(int port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(port);
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      ::listen(fd, 64) != 0) {
+    ::close(fd);
+    return -1;
+  }
+  socklen_t len = sizeof(addr);
+  getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &len);
+
+  auto* srv = new PsServer();
+  srv->listen_fd = fd;
+  srv->port = ntohs(addr.sin_port);
+  srv->accept_thread = std::thread([srv] {
+    while (!srv->stop.load()) {
+      int cfd = ::accept(srv->listen_fd, nullptr, nullptr);
+      if (cfd < 0) break;
+      {
+        std::lock_guard<std::mutex> l(srv->conns_mu);
+        srv->conns.push_back(cfd);
+      }
+      srv->workers.emplace_back([srv, cfd] { srv->serve(cfd); });
+    }
+  });
+  std::lock_guard<std::mutex> l(g_ps_mu);
+  int h = g_next_ps++;
+  g_ps_servers[h] = srv;
+  return h;
+}
+
+int pt_ps_server_port(int h) {
+  std::lock_guard<std::mutex> l(g_ps_mu);
+  auto it = g_ps_servers.find(h);
+  return it == g_ps_servers.end() ? -1 : it->second->port;
+}
+
+void pt_ps_server_stop(int h) {
+  PsServer* srv = nullptr;
+  {
+    std::lock_guard<std::mutex> l(g_ps_mu);
+    auto it = g_ps_servers.find(h);
+    if (it == g_ps_servers.end()) return;
+    srv = it->second;
+    g_ps_servers.erase(it);
+  }
+  srv->stop.store(true);
+  ::shutdown(srv->listen_fd, SHUT_RDWR);
+  ::close(srv->listen_fd);
+  {
+    // unblock connection handlers still parked in recv()
+    std::lock_guard<std::mutex> l(srv->conns_mu);
+    for (int cfd : srv->conns) ::shutdown(cfd, SHUT_RDWR);
+  }
+  if (srv->accept_thread.joinable()) srv->accept_thread.join();
+  for (auto& w : srv->workers)
+    if (w.joinable()) w.join();
+  delete srv;
+}
+
+int pt_ps_connect(const char* host, int port, int timeout_ms) {
+  addrinfo hints{}, *res = nullptr;
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  char portstr[16];
+  snprintf(portstr, sizeof(portstr), "%d", port);
+  if (getaddrinfo(host, portstr, &hints, &res) != 0 || res == nullptr)
+    return -1;
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms);
+  int fd = -1;
+  while (std::chrono::steady_clock::now() < deadline) {
+    fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) break;
+    if (::connect(fd, res->ai_addr, res->ai_addrlen) == 0) {
+      int one = 1;
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      freeaddrinfo(res);
+      return fd;
+    }
+    ::close(fd);
+    fd = -1;
+    std::this_thread::sleep_for(std::chrono::milliseconds(50));
+  }
+  freeaddrinfo(res);
+  return fd;
+}
+
+void pt_ps_close(int fd) {
+  if (fd >= 0) ::close(fd);
+}
+
+static int ps_req_header(int fd, uint8_t op, uint32_t tid, uint32_t n) {
+  if (!write_full(fd, &op, 1) || !write_full(fd, &tid, 4) ||
+      !write_full(fd, &n, 4))
+    return -1;
+  return 0;
+}
+
+static int ps_read_status(int fd) {
+  int32_t status;
+  if (!read_full(fd, &status, 4)) return -1;
+  return status;
+}
+
+int pt_ps_create_sparse(int fd, int tid, int dim, int opt, float lr,
+                        float init_std, unsigned seed) {
+  if (ps_req_header(fd, PS_CREATE_SPARSE, tid, 0) != 0) return -1;
+  uint32_t meta[3] = {static_cast<uint32_t>(dim),
+                      static_cast<uint32_t>(opt), seed};
+  float params[3] = {lr, init_std, 0.0f};
+  if (!write_full(fd, meta, sizeof(meta)) ||
+      !write_full(fd, params, sizeof(params)))
+    return -1;
+  return ps_read_status(fd);
+}
+
+int pt_ps_create_dense(int fd, int tid, long size, int opt, float lr) {
+  if (ps_req_header(fd, PS_CREATE_DENSE, tid, 0) != 0) return -1;
+  uint64_t sz = size;
+  uint32_t meta[1] = {static_cast<uint32_t>(opt)};
+  float params[1] = {lr};
+  if (!write_full(fd, &sz, 8) || !write_full(fd, meta, sizeof(meta)) ||
+      !write_full(fd, params, sizeof(params)))
+    return -1;
+  return ps_read_status(fd);
+}
+
+int pt_ps_pull_sparse(int fd, int tid, const long long* ids, int n, int dim,
+                      float* out) {
+  if (ps_req_header(fd, PS_PULL_SPARSE, tid, n) != 0) return -1;
+  if (!write_full(fd, ids, size_t(n) * 8)) return -1;
+  int status = ps_read_status(fd);
+  if (status != 0) return status;
+  if (!read_full(fd, out, size_t(n) * dim * 4)) return -1;
+  return 0;
+}
+
+int pt_ps_push_sparse(int fd, int tid, const long long* ids, int n, int dim,
+                      const float* grads, int mode) {
+  if (ps_req_header(fd, PS_PUSH_SPARSE, tid, n) != 0) return -1;
+  uint8_t m = static_cast<uint8_t>(mode);
+  if (!write_full(fd, &m, 1) || !write_full(fd, ids, size_t(n) * 8) ||
+      !write_full(fd, grads, size_t(n) * dim * 4))
+    return -1;
+  return ps_read_status(fd);
+}
+
+int pt_ps_pull_dense(int fd, int tid, float* out, long size) {
+  if (ps_req_header(fd, PS_PULL_DENSE, tid, 0) != 0) return -1;
+  int status = ps_read_status(fd);
+  if (status != 0) return status;
+  uint64_t sz;
+  if (!read_full(fd, &sz, 8)) return -1;
+  if (static_cast<long>(sz) != size) {
+    // drain the payload so the connection framing stays intact
+    std::vector<char> sink(sz * 4);
+    read_full(fd, sink.data(), sink.size());
+    return -2;
+  }
+  if (!read_full(fd, out, sz * 4)) return -1;
+  return 0;
+}
+
+int pt_ps_push_dense(int fd, int tid, const float* grad, long size,
+                     int mode) {
+  if (ps_req_header(fd, PS_PUSH_DENSE, tid, 0) != 0) return -1;
+  uint8_t m = static_cast<uint8_t>(mode);
+  uint64_t sz = size;
+  if (!write_full(fd, &m, 1) || !write_full(fd, &sz, 8) ||
+      !write_full(fd, grad, size_t(size) * 4))
+    return -1;
+  return ps_read_status(fd);
+}
+
+int pt_ps_sparse_size(int fd, int tid, long long* out) {
+  if (ps_req_header(fd, PS_SPARSE_SIZE, tid, 0) != 0) return -1;
+  int status = ps_read_status(fd);
+  uint64_t sz = 0;
+  if (!read_full(fd, &sz, 8)) return -1;
+  *out = static_cast<long long>(sz);
+  return status;
+}
+
+int pt_ps_save(int fd, int tid, const char* path) {
+  uint32_t n = std::strlen(path);
+  if (ps_req_header(fd, PS_SAVE, tid, n) != 0) return -1;
+  if (!write_full(fd, path, n)) return -1;
+  return ps_read_status(fd);
+}
+
+int pt_ps_load(int fd, int tid, const char* path) {
+  uint32_t n = std::strlen(path);
+  if (ps_req_header(fd, PS_LOAD, tid, n) != 0) return -1;
+  if (!write_full(fd, path, n)) return -1;
+  return ps_read_status(fd);
+}
+
+}  // extern "C"

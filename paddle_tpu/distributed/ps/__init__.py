@@ -1,2 +1,4 @@
 """Parameter-server path (reference paddle/fluid/distributed/ps/)."""
-from . import runtime  # noqa: F401
+from . import runtime, service  # noqa: F401
+from .runtime import TheOnePSRuntime  # noqa: F401
+from .service import GeoWorkerCache, PsClient, PsServer  # noqa: F401

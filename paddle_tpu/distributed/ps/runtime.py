@@ -3,11 +3,11 @@
 Parity: reference TheOnePSRuntime (python/paddle/distributed/ps/
 the_one_ps.py:1031) over brpc MemorySparseTable
 (paddle/fluid/distributed/ps/table/). TPU analog (SURVEY §7.9): sparse
-embedding tables live on the TPU-VM *hosts* (CPU hash maps, C++ backend in
-csrc/ps when built), dense compute on chips; pull/push are host RPCs over
-DCN. This python runtime implements the in-process ("PsLocalClient",
-reference ps_local_client.h) mode used by single-host tests; the wire
-protocol server arrives with csrc/ps.
+embedding tables live on the TPU-VM *hosts*, dense compute on chips;
+pull/push are host RPCs over DCN. The network backend is the native C++
+PS core (csrc/ps.cc — tables, SGD/AdaGrad/Adam accessor rules, TCP
+service) via ps/service.py; the in-process tables below are the
+PsLocalClient (reference ps_local_client.h) single-process mode.
 """
 from __future__ import annotations
 
@@ -16,16 +16,59 @@ import threading
 import numpy as np
 
 
+class _Accessor:
+    """Optimizer rules shared by the local tables — the same math the
+    C++ accessors apply server-side (csrc/ps.cc, reference
+    ps/table/sparse_sgd_rule.cc)."""
+
+    def __init__(self, optimizer, lr):
+        if optimizer not in ("sgd", "adagrad", "adam"):
+            raise ValueError("unknown PS optimizer %r" % optimizer)
+        self.optimizer = optimizer
+        self.lr = lr
+
+    def slots(self, shape):
+        if self.optimizer == "adagrad":
+            return [np.zeros(shape, np.float32)]
+        if self.optimizer == "adam":
+            return [np.zeros(shape, np.float32),
+                    np.zeros(shape, np.float32), np.zeros((), np.float32)]
+        return []
+
+    def apply(self, w, g, slots):
+        if self.optimizer == "sgd":
+            w -= self.lr * g
+        elif self.optimizer == "adagrad":
+            slots[0] += g * g
+            w -= self.lr * g / (np.sqrt(slots[0]) + 1e-8)
+        else:
+            m, v, t = slots
+            t += 1.0
+            b1, b2 = 0.9, 0.999
+            m[...] = b1 * m + (1 - b1) * g
+            v[...] = b2 * v + (1 - b2) * g * g
+            bc1 = 1.0 - b1 ** float(t)
+            bc2 = 1.0 - b2 ** float(t)
+            w -= self.lr * (m / bc1) / (np.sqrt(v / bc2) + 1e-8)
+
+
 class SparseTable:
     """In-memory sparse table (reference MemorySparseTable): id -> embedding
     row, created on first pull (CTR accessor's create-on-miss)."""
 
-    def __init__(self, dim, init_std=0.01, optimizer="sgd", lr=0.01):
+    def __init__(self, dim, init_std=0.01, optimizer="sgd", lr=0.01,
+                 seed=0):
         self.dim = dim
         self.rows = {}
+        self._slots = {}
         self.init_std = init_std
-        self.lr = lr
+        self._acc = _Accessor(optimizer, lr)
+        self._rng = np.random.RandomState(seed)
         self._lock = threading.Lock()
+
+    @property
+    def lr(self):
+        return self._acc.lr
 
     def pull(self, ids):
         ids = np.asarray(ids, np.int64).reshape(-1)
@@ -35,9 +78,10 @@ class SparseTable:
                 k = int(k)
                 row = self.rows.get(k)
                 if row is None:
-                    row = np.random.normal(
+                    row = self._rng.normal(
                         0.0, self.init_std, self.dim).astype(np.float32)
                     self.rows[k] = row
+                    self._slots[k] = self._acc.slots(self.dim)
                 out[i] = row
         return out
 
@@ -49,64 +93,174 @@ class SparseTable:
                 k = int(k)
                 row = self.rows.get(k)
                 if row is not None:
-                    row -= self.lr * g
+                    self._acc.apply(row, g, self._slots[k])
 
     def size(self):
         return len(self.rows)
 
 
 class DenseTable:
-    def __init__(self, shape, lr=0.01):
+    def __init__(self, shape, optimizer="sgd", lr=0.01):
         self.value = np.zeros(shape, np.float32)
-        self.lr = lr
+        self._acc = _Accessor(optimizer, lr)
+        self._slots = self._acc.slots(self.value.shape)
+
+    @property
+    def lr(self):
+        return self._acc.lr
 
     def pull(self):
         return self.value.copy()
 
     def push(self, grad):
-        self.value -= self.lr * np.asarray(grad, np.float32)
+        self._acc.apply(self.value, np.asarray(grad, np.float32),
+                        self._slots)
 
 
 class TheOnePSRuntime:
+    """reference TheOnePSRuntime (the_one_ps.py:1031).
+
+    Two transports behind one API:
+    - local (default): in-process tables — the reference's PsLocalClient
+      (ps_local_client.h) single-process test mode.
+    - network: when PADDLE_PSERVER=host:port is set (or endpoint= passed
+      to init_worker), every table op is an RPC to the native C++ PS
+      service (csrc/ps.cc; accessors run server-side) — the brpc
+      server/client analog.
+    """
+
     def __init__(self, strategy=None):
         self._strategy = strategy
         self._tables = {}
+        self._server = None
+        self._client = None
+        self._table_ids = {}
         self._server_started = False
 
+    @property
+    def is_remote(self):
+        return self._client is not None
+
+    def _check_mode(self, name):
+        """A table is bound to the transport it was created under; mixing
+        modes is a config error, not a silent behavior change."""
+        entry = self._tables[name]
+        is_tuple = isinstance(entry, tuple)
+        if is_tuple and self._client is None:
+            raise RuntimeError(
+                "PS table %r was created in NETWORK mode but the client "
+                "is gone (stop() called?); re-create after init_worker"
+                % name)
+        if not is_tuple and self._client is not None:
+            raise RuntimeError(
+                "PS table %r was created in LOCAL mode before "
+                "init_worker(); create tables after init_worker so they "
+                "live on the server" % name)
+        return entry
+
     # table management
-    def create_sparse_table(self, name, dim, **kwargs):
-        self._tables[name] = SparseTable(dim, **kwargs)
+    def create_sparse_table(self, name, dim, optimizer="sgd", lr=0.01,
+                            init_std=0.01, **kwargs):
+        if self._client is not None:
+            tid = self._table_ids.setdefault(name, len(self._table_ids))
+            self._client.create_sparse_table(
+                tid, dim, optimizer=optimizer, lr=lr, init_std=init_std,
+                seed=kwargs.get("seed", 0))
+            self._tables[name] = ("sparse", tid, dim)
+            return self._tables[name]
+        self._tables[name] = SparseTable(
+            dim, lr=lr, init_std=init_std, optimizer=optimizer,
+            seed=kwargs.get("seed", 0))
         return self._tables[name]
 
-    def create_dense_table(self, name, shape, **kwargs):
-        self._tables[name] = DenseTable(shape, **kwargs)
+    def create_dense_table(self, name, shape, optimizer="sgd", lr=0.01,
+                           **kwargs):
+        if self._client is not None:
+            tid = self._table_ids.setdefault(name, len(self._table_ids))
+            size = int(np.prod(shape))
+            self._client.create_dense_table(tid, size, optimizer=optimizer,
+                                            lr=lr)
+            self._tables[name] = ("dense", tid, tuple(shape))
+            return self._tables[name]
+        self._tables[name] = DenseTable(shape, optimizer=optimizer, lr=lr)
         return self._tables[name]
 
     def get_table(self, name):
         return self._tables[name]
 
-    # lifecycle
-    def init_server(self, *args, **kwargs):
+    # lifecycle (reference fleet.init_server/run_server/init_worker)
+    def init_server(self, port=0, **kwargs):
+        from .service import PsServer
+
+        self._server = PsServer(port=port)
         self._server_started = True
+        return self._server.port
 
     def run_server(self):
-        pass
+        # the native server threads are already accepting; block-free
+        return self._server
 
-    def init_worker(self):
-        pass
+    def init_worker(self, endpoint=None):
+        import os
+
+        from .service import PsClient
+
+        ep = endpoint or os.environ.get("PADDLE_PSERVER")
+        if not ep and self._server is not None:
+            ep = "127.0.0.1:%d" % self._server.port
+        if ep:
+            host, _, port = ep.partition(":")
+            self._client = PsClient(host or "127.0.0.1", int(port))
 
     def stop(self):
+        if self._client is not None:
+            self._client.close()
+            self._client = None
+        if self._server is not None:
+            self._server.stop()
+            self._server = None
         self._server_started = False
 
-    # client ops (PsLocalClient semantics)
+    # client ops
     def pull_sparse(self, name, ids):
-        return self._tables[name].pull(ids)
+        entry = self._check_mode(name)
+        if self._client is not None:
+            _, tid, dim = entry
+            return self._client.pull_sparse(tid, ids, dim)
+        return entry.pull(ids)
 
-    def push_sparse(self, name, ids, grads):
-        return self._tables[name].push(ids, grads)
+    def push_sparse(self, name, ids, grads, geo=False):
+        entry = self._check_mode(name)
+        if self._client is not None:
+            _, tid, dim = entry
+            return self._client.push_sparse(tid, ids, grads, dim, geo=geo)
+        return entry.push(ids, grads)
 
     def pull_dense(self, name):
-        return self._tables[name].pull()
+        entry = self._check_mode(name)
+        if self._client is not None:
+            _, tid, shape = entry
+            return self._client.pull_dense(
+                tid, int(np.prod(shape))).reshape(shape)
+        return entry.pull()
 
-    def push_dense(self, name, grad):
-        return self._tables[name].push(grad)
+    def push_dense(self, name, grad, geo=False):
+        entry = self._check_mode(name)
+        if self._client is not None:
+            _, tid, shape = entry
+            return self._client.push_dense(tid, grad, geo=geo)
+        return entry.push(grad)
+
+    def save(self, name, path):
+        entry = self._check_mode(name)
+        if self._client is not None:
+            _, tid, _ = entry
+            return self._client.save(tid, path)
+        raise NotImplementedError("save requires the network PS backend")
+
+    def load(self, name, path):
+        entry = self._check_mode(name)
+        if self._client is not None:
+            _, tid, _ = entry
+            return self._client.load(tid, path)
+        raise NotImplementedError("load requires the network PS backend")

@@ -1,0 +1,243 @@
+"""Parameter-server service: Python client/server facade over the native
+C++ PS core (csrc/ps.cc).
+
+Parity: reference BrpcPsServer/BrpcPsClient
+(/root/reference/paddle/fluid/distributed/ps/service/brpc_ps_server.cc,
+brpc_ps_client.cc) and the async Communicator
+(ps/service/communicator/communicator.cc). Tables and optimizer
+accessors (SGD/AdaGrad/Adam rules, ps/table/sparse_sgd_rule.cc) execute
+server-side in C++; this module only frames requests.
+
+Modes (reference DistributedStrategy a_sync / a_sync_k_step semantics):
+- sync/async: workers push raw gradients; the server applies the
+  accessor rule immediately (async because pushes are not barriered).
+- geo: workers train a LOCAL cache and periodically push weight DELTAS
+  which the server merges additively (geo-SGD).
+"""
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from ...core import native
+
+OPTIMIZERS = {"sgd": 0, "adagrad": 1, "adam": 2}
+
+
+def _lib():
+    lib = native.get_lib()
+    if not getattr(lib, "_ps_proto_ready", False):
+        c = ctypes
+        lib.pt_ps_server_start.restype = c.c_int
+        lib.pt_ps_server_start.argtypes = [c.c_int]
+        lib.pt_ps_server_port.restype = c.c_int
+        lib.pt_ps_server_port.argtypes = [c.c_int]
+        lib.pt_ps_server_stop.argtypes = [c.c_int]
+        lib.pt_ps_connect.restype = c.c_int
+        lib.pt_ps_connect.argtypes = [c.c_char_p, c.c_int, c.c_int]
+        lib.pt_ps_close.argtypes = [c.c_int]
+        lib.pt_ps_create_sparse.restype = c.c_int
+        lib.pt_ps_create_sparse.argtypes = [
+            c.c_int, c.c_int, c.c_int, c.c_int, c.c_float, c.c_float,
+            c.c_uint]
+        lib.pt_ps_create_dense.restype = c.c_int
+        lib.pt_ps_create_dense.argtypes = [
+            c.c_int, c.c_int, c.c_long, c.c_int, c.c_float]
+        lib.pt_ps_pull_sparse.restype = c.c_int
+        lib.pt_ps_pull_sparse.argtypes = [
+            c.c_int, c.c_int, c.c_void_p, c.c_int, c.c_int, c.c_void_p]
+        lib.pt_ps_push_sparse.restype = c.c_int
+        lib.pt_ps_push_sparse.argtypes = [
+            c.c_int, c.c_int, c.c_void_p, c.c_int, c.c_int, c.c_void_p,
+            c.c_int]
+        lib.pt_ps_pull_dense.restype = c.c_int
+        lib.pt_ps_pull_dense.argtypes = [
+            c.c_int, c.c_int, c.c_void_p, c.c_long]
+        lib.pt_ps_push_dense.restype = c.c_int
+        lib.pt_ps_push_dense.argtypes = [
+            c.c_int, c.c_int, c.c_void_p, c.c_long, c.c_int]
+        lib.pt_ps_sparse_size.restype = c.c_int
+        lib.pt_ps_sparse_size.argtypes = [
+            c.c_int, c.c_int, c.POINTER(c.c_longlong)]
+        lib.pt_ps_save.restype = c.c_int
+        lib.pt_ps_save.argtypes = [c.c_int, c.c_int, c.c_char_p]
+        lib.pt_ps_load.restype = c.c_int
+        lib.pt_ps_load.argtypes = [c.c_int, c.c_int, c.c_char_p]
+        lib._ps_proto_ready = True
+    return lib
+
+
+class PsServer:
+    """Hosts tables in the native core; one instance per server process
+    (reference BrpcPsServer)."""
+
+    def __init__(self, port=0):
+        self._lib = _lib()
+        self._h = self._lib.pt_ps_server_start(port)
+        if self._h < 0:
+            raise RuntimeError("PsServer: failed to bind port %d" % port)
+        self.port = self._lib.pt_ps_server_port(self._h)
+
+    def stop(self):
+        if self._h is not None:
+            self._lib.pt_ps_server_stop(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.stop()
+        except Exception:
+            pass
+
+
+class PsClient:
+    """Per-worker connection (reference BrpcPsClient). NOT thread-safe —
+    one client per worker thread, like the reference's per-channel
+    stubs."""
+
+    def __init__(self, host="127.0.0.1", port=0, timeout_s=30):
+        self._lib = _lib()
+        self._fd = self._lib.pt_ps_connect(
+            host.encode(), port, int(timeout_s * 1000))
+        if self._fd < 0:
+            raise RuntimeError("PsClient: cannot connect %s:%d"
+                               % (host, port))
+        self._dims = {}
+
+    def close(self):
+        if self._fd is not None and self._fd >= 0:
+            self._lib.pt_ps_close(self._fd)
+            self._fd = -1
+
+    # -- table management --------------------------------------------------
+
+    def create_sparse_table(self, table_id, dim, optimizer="sgd", lr=0.01,
+                            init_std=0.01, seed=0):
+        rc = self._lib.pt_ps_create_sparse(
+            self._fd, table_id, dim, OPTIMIZERS[optimizer], lr, init_std,
+            seed)
+        if rc != 0:
+            raise RuntimeError("create_sparse_table failed rc=%d" % rc)
+        self._dims[table_id] = dim
+
+    def create_dense_table(self, table_id, size, optimizer="sgd", lr=0.01):
+        rc = self._lib.pt_ps_create_dense(
+            self._fd, table_id, int(size), OPTIMIZERS[optimizer], lr)
+        if rc != 0:
+            raise RuntimeError("create_dense_table failed rc=%d" % rc)
+
+    # -- sparse ------------------------------------------------------------
+
+    def pull_sparse(self, table_id, ids, dim=None):
+        ids = np.ascontiguousarray(np.asarray(ids, np.int64).reshape(-1))
+        dim = dim or self._dims[table_id]
+        out = np.empty((ids.size, dim), np.float32)
+        rc = self._lib.pt_ps_pull_sparse(
+            self._fd, table_id, ids.ctypes.data, ids.size, dim,
+            out.ctypes.data)
+        if rc != 0:
+            raise RuntimeError("pull_sparse failed rc=%d" % rc)
+        return out
+
+    def push_sparse(self, table_id, ids, grads, dim=None, geo=False):
+        ids = np.ascontiguousarray(np.asarray(ids, np.int64).reshape(-1))
+        dim = dim or self._dims[table_id]
+        grads = np.ascontiguousarray(
+            np.asarray(grads, np.float32).reshape(ids.size, dim))
+        rc = self._lib.pt_ps_push_sparse(
+            self._fd, table_id, ids.ctypes.data, ids.size, dim,
+            grads.ctypes.data, 1 if geo else 0)
+        if rc != 0:
+            raise RuntimeError("push_sparse failed rc=%d" % rc)
+
+    # -- dense -------------------------------------------------------------
+
+    def pull_dense(self, table_id, size):
+        out = np.empty(int(size), np.float32)
+        rc = self._lib.pt_ps_pull_dense(self._fd, table_id,
+                                        out.ctypes.data, int(size))
+        if rc != 0:
+            raise RuntimeError("pull_dense failed rc=%d" % rc)
+        return out
+
+    def push_dense(self, table_id, grad, geo=False):
+        grad = np.ascontiguousarray(np.asarray(grad, np.float32).reshape(-1))
+        rc = self._lib.pt_ps_push_dense(
+            self._fd, table_id, grad.ctypes.data, grad.size,
+            1 if geo else 0)
+        if rc != 0:
+            raise RuntimeError("push_dense failed rc=%d" % rc)
+
+    # -- misc --------------------------------------------------------------
+
+    def sparse_size(self, table_id):
+        out = ctypes.c_longlong()
+        rc = self._lib.pt_ps_sparse_size(self._fd, table_id,
+                                         ctypes.byref(out))
+        if rc != 0:
+            raise RuntimeError("sparse_size failed rc=%d" % rc)
+        return int(out.value)
+
+    def save(self, table_id, path):
+        rc = self._lib.pt_ps_save(self._fd, table_id, path.encode())
+        if rc != 0:
+            raise RuntimeError("save failed rc=%d" % rc)
+
+    def load(self, table_id, path):
+        rc = self._lib.pt_ps_load(self._fd, table_id, path.encode())
+        if rc != 0:
+            raise RuntimeError("load failed rc=%d" % rc)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class GeoWorkerCache:
+    """Geo-async local cache (reference communicator/communicator.cc
+    GeoCommunicator): train against local rows, periodically push the
+    accumulated weight delta and refresh from the server."""
+
+    def __init__(self, client, table_id, dim, push_every=8):
+        self.client = client
+        self.table_id = table_id
+        self.dim = dim
+        self.push_every = push_every
+        self._base = {}   # id -> row value at last sync
+        self._local = {}  # id -> current local row value
+        self._steps = 0
+
+    def pull(self, ids):
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        missing = [int(i) for i in ids if int(i) not in self._local]
+        if missing:
+            rows = self.client.pull_sparse(self.table_id, missing, self.dim)
+            for k, r in zip(missing, rows):
+                self._base[k] = r.copy()
+                self._local[k] = r.copy()
+        return np.stack([self._local[int(i)] for i in ids])
+
+    def apply_local(self, ids, grads, lr):
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        grads = np.asarray(grads, np.float32).reshape(ids.size, self.dim)
+        for k, g in zip(ids, grads):
+            self._local[int(k)] -= lr * g
+        self._steps += 1
+        if self._steps % self.push_every == 0:
+            self.sync()
+
+    def sync(self):
+        if not self._local:
+            return
+        ids = np.fromiter(self._local.keys(), np.int64)
+        delta = np.stack([self._local[int(k)] - self._base[int(k)]
+                          for k in ids])
+        self.client.push_sparse(self.table_id, ids, delta, self.dim,
+                                geo=True)
+        rows = self.client.pull_sparse(self.table_id, ids, self.dim)
+        for k, r in zip(ids, rows):
+            self._base[int(k)] = r.copy()
+            self._local[int(k)] = r.copy()
