@@ -256,53 +256,61 @@ struct PsServer {
           break;
         }
         case PS_PULL_SPARSE: {
+          // client declares its dim so payload sizing never depends on
+          // server state that can change concurrently (re-create race)
+          uint32_t dim;
           std::vector<int64_t> ids(n);
-          if (!read_full(cfd, ids.data(), n * 8)) return;
+          if (!read_full(cfd, &dim, 4) ||
+              !read_full(cfd, ids.data(), n * 8))
+            return;
           SparseTable* t = sparse_tab(tid);
-          if (!t) {
-            status = -1;
-            write_full(cfd, &status, 4);
-            break;
-          }
-          std::vector<float> out(size_t(n) * t->dim);
+          std::vector<float> out(size_t(n) * dim);
           {
-            std::lock_guard<std::mutex> l(t->mu);
-            for (uint32_t i = 0; i < n; ++i) {
-              auto& r = t->row(ids[i]);
-              std::memcpy(out.data() + size_t(i) * t->dim, r.data(),
-                          t->dim * 4);
+            if (!t) {
+              status = -1;
+            } else {
+              std::lock_guard<std::mutex> l(t->mu);
+              if (static_cast<uint32_t>(t->dim) != dim) {
+                status = -4;  // dim mismatch
+              } else {
+                for (uint32_t i = 0; i < n; ++i) {
+                  auto& r = t->row(ids[i]);
+                  std::memcpy(out.data() + size_t(i) * dim, r.data(),
+                              dim * 4);
+                }
+              }
             }
           }
           write_full(cfd, &status, 4);
-          write_full(cfd, out.data(), out.size() * 4);
+          if (status == 0) write_full(cfd, out.data(), out.size() * 4);
           break;
         }
         case PS_PUSH_SPARSE: {
           uint8_t mode;
-          if (!read_full(cfd, &mode, 1)) return;
-          SparseTable* t = sparse_tab(tid);
-          if (!t) {
-            // cannot size the grad payload without the table's dim —
-            // report and drop the connection (create_table must precede)
-            status = -1;
-            write_full(cfd, &status, 4);
-            ::close(cfd);
+          uint32_t dim;
+          if (!read_full(cfd, &mode, 1) || !read_full(cfd, &dim, 4))
             return;
-          }
           std::vector<int64_t> ids(n);
-          std::vector<float> g(size_t(n) * t->dim);
+          std::vector<float> g(size_t(n) * dim);
           if (!read_full(cfd, ids.data(), n * 8) ||
               !read_full(cfd, g.data(), g.size() * 4))
             return;
-          {
+          SparseTable* t = sparse_tab(tid);
+          if (!t) {
+            status = -1;
+          } else {
             std::lock_guard<std::mutex> l(t->mu);
-            for (uint32_t i = 0; i < n; ++i) {
-              auto& r = t->row(ids[i]);
-              const float* gi = g.data() + size_t(i) * t->dim;
-              if (mode == 1) {  // geo: merge raw delta into weights
-                for (int d = 0; d < t->dim; ++d) r[d] += gi[d];
-              } else {
-                t->apply(r, gi);
+            if (static_cast<uint32_t>(t->dim) != dim) {
+              status = -4;
+            } else {
+              for (uint32_t i = 0; i < n; ++i) {
+                auto& r = t->row(ids[i]);
+                const float* gi = g.data() + size_t(i) * dim;
+                if (mode == 1) {  // geo: merge raw delta into weights
+                  for (int d = 0; d < t->dim; ++d) r[d] += gi[d];
+                } else {
+                  t->apply(r, gi);
+                }
               }
             }
           }
@@ -417,8 +425,21 @@ struct PsServer {
           break;
         }
         default:
-          ::close(cfd);
+          drop_conn(cfd);
           return;
+      }
+    }
+    drop_conn(cfd);
+  }
+
+  void drop_conn(int cfd) {
+    {
+      std::lock_guard<std::mutex> l(conns_mu);
+      for (auto it = conns.begin(); it != conns.end(); ++it) {
+        if (*it == cfd) {
+          conns.erase(it);
+          break;
+        }
       }
     }
     ::close(cfd);
@@ -570,7 +591,9 @@ int pt_ps_create_dense(int fd, int tid, long size, int opt, float lr) {
 int pt_ps_pull_sparse(int fd, int tid, const long long* ids, int n, int dim,
                       float* out) {
   if (ps_req_header(fd, PS_PULL_SPARSE, tid, n) != 0) return -1;
-  if (!write_full(fd, ids, size_t(n) * 8)) return -1;
+  uint32_t d = static_cast<uint32_t>(dim);
+  if (!write_full(fd, &d, 4) || !write_full(fd, ids, size_t(n) * 8))
+    return -1;
   int status = ps_read_status(fd);
   if (status != 0) return status;
   if (!read_full(fd, out, size_t(n) * dim * 4)) return -1;
@@ -581,7 +604,9 @@ int pt_ps_push_sparse(int fd, int tid, const long long* ids, int n, int dim,
                       const float* grads, int mode) {
   if (ps_req_header(fd, PS_PUSH_SPARSE, tid, n) != 0) return -1;
   uint8_t m = static_cast<uint8_t>(mode);
-  if (!write_full(fd, &m, 1) || !write_full(fd, ids, size_t(n) * 8) ||
+  uint32_t d = static_cast<uint32_t>(dim);
+  if (!write_full(fd, &m, 1) || !write_full(fd, &d, 4) ||
+      !write_full(fd, ids, size_t(n) * 8) ||
       !write_full(fd, grads, size_t(n) * dim * 4))
     return -1;
   return ps_read_status(fd);

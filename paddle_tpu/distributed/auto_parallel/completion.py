@@ -1,0 +1,106 @@
+"""Completer — sharding-spec propagation over a captured Program.
+
+Parity: reference auto_parallel/completion.py (Completer walks the
+ProgramDesc propagating DistAttr op by op). On TPU, GSPMD does the
+authoritative propagation inside XLA; this Completer reproduces it at
+the Python level over the op tape so the reference's workflow
+(annotate a few tensors -> complete -> inspect/partition/estimate cost)
+works without compiling: rule-based forward propagation keyed on op
+name, defaulting to replication exactly like GSPMD's conservative
+fallback.
+"""
+from __future__ import annotations
+
+from jax.sharding import PartitionSpec as P
+
+from ...core.tensor import Tensor
+
+_ELEMENTWISE = {
+    "add", "subtract", "multiply", "divide", "maximum", "minimum", "pow",
+    "relu", "gelu", "silu", "tanh", "sigmoid", "exp", "log", "sqrt",
+    "scale", "clip", "cast", "dropout", "where", "erf", "square", "neg",
+    "abs", "rsqrt", "softmax", "log_softmax",
+}
+
+_NORMS = {"layer_norm", "rms_norm", "batch_norm_train", "batch_norm_infer"}
+
+
+def _spec_of(t, annotated):
+    if id(t) in annotated:
+        return annotated[id(t)]
+    s = getattr(t, "_sharding_spec", None)
+    return s if s is not None else None
+
+
+def _entries(spec, ndim):
+    e = list(spec) if spec is not None else []
+    e += [None] * (ndim - len(e))
+    return e[:ndim]
+
+
+class Completer:
+    """complete_forward_annotation(program) -> {id(tensor): PartitionSpec}
+    (reference completion.py Completer.complete_forward_annotation)."""
+
+    def __init__(self, dist_context=None):
+        self._dist_context = dist_context
+
+    def complete_forward_annotation(self, program):
+        specs = {}
+        # seeds: every tensor already carrying a spec (shard_tensor /
+        # mpu layer parameters)
+        for rec in program.tape:
+            for l in rec.leaves:
+                if isinstance(l, Tensor) and \
+                        getattr(l, "_sharding_spec", None) is not None:
+                    specs[id(l)] = l._sharding_spec
+        for rec in program.tape:
+            out_spec = self._infer(rec, specs)
+            for t in rec.outs:
+                if id(t) not in specs and out_spec is not None:
+                    specs[id(t)] = out_spec
+        # fill the rest with replication (GSPMD fallback)
+        for rec in program.tape:
+            for t in rec.outs:
+                specs.setdefault(id(t), P())
+        return specs
+
+    # -- rules -------------------------------------------------------------
+
+    def _infer(self, rec, specs):
+        op = rec.op_name
+        tin = [l for l in rec.leaves if isinstance(l, Tensor)]
+        in_specs = [_spec_of(t, specs) for t in tin]
+        if op in _ELEMENTWISE or op in _NORMS:
+            # keep the first sharded operand's layout
+            for t, s in zip(tin, in_specs):
+                if s is not None and tuple(_entries(s, t.ndim)) != ():
+                    return s
+            return next((s for s in in_specs if s is not None), None)
+        if op in ("matmul", "mm", "bmm", "linear"):
+            if len(tin) < 2:
+                return None
+            x, w = tin[0], tin[1]
+            xs = _entries(_spec_of(x, specs) or P(), x.ndim)
+            ws = _entries(_spec_of(w, specs) or P(), w.ndim)
+            # out rank = x rank (linear keeps batch dims, swaps feature)
+            out = xs[:-1] + [ws[-1] if w.ndim >= 1 else None]
+            # contracted-dim sharding implies a psum; output loses it
+            return P(*out)
+        if op in ("reshape", "flatten", "transpose"):
+            # shape/layout change: replication is always a valid
+            # completion (GSPMD re-derives the real one during jit)
+            return None
+        if op in ("sum", "mean", "max", "min", "reduce_sum", "reduce_mean"):
+            t = tin[0] if tin else None
+            if t is None:
+                return None
+            return P()  # reduced output: conservatively replicated
+        if op == "embedding":
+            # out: ids dims + hidden; vocab-sharded table implies psum
+            if len(tin) >= 2:
+                ids, tab = tin[0], tin[1]
+                ts = _entries(_spec_of(tab, specs) or P(), tab.ndim)
+                return P(*([None] * ids.ndim + [ts[-1]]))
+            return None
+        return None

@@ -1,0 +1,116 @@
+"""Partitioner + Resharder.
+
+Parity: reference auto_parallel/partitioner.py (slice the serial program
+into a per-rank distributed program) and reshard.py (insert comm ops for
+placement transitions). TPU-native: partitioning IS placement — applying
+NamedShardings to the program's tensors makes XLA emit the per-device
+program; resharding is a device_put whose implied collective this module
+names (for the cost model and for parity introspection).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...core.tensor import Tensor
+from .completion import Completer, _entries
+
+
+def local_shape(shape, spec, mesh):
+    """Per-device shard shape under `spec` (reference dist tensor
+    local_shape)."""
+    entries = _entries(spec or P(), len(shape))
+    out = []
+    for dim, e in zip(shape, entries):
+        if e is None:
+            out.append(int(dim))
+            continue
+        axes = e if isinstance(e, tuple) else (e,)
+        deg = int(np.prod([mesh.shape[a] for a in axes]))
+        out.append(int(dim) // deg)
+    return tuple(out)
+
+
+class Partitioner:
+    """partition(program) -> report; places every annotated tensor with
+    its NamedSharding (reference partitioner.py partitions serial_main
+    into dist_main per rank)."""
+
+    def __init__(self, mesh=None, dist_context=None, rank_id=0):
+        from .. import mesh as _mesh
+
+        self.mesh = mesh or _mesh.get_mesh()
+        self.rank_id = rank_id
+
+    def partition(self, program, complete=True):
+        specs = (Completer().complete_forward_annotation(program)
+                 if complete else {})
+        report = {}
+        params, frozen = program._analyze()
+        for t in list(params) + list(frozen):
+            spec = getattr(t, "_sharding_spec", None) or specs.get(id(t))
+            if spec is None:
+                spec = P()
+            t._value = jax.device_put(
+                t._value, NamedSharding(self.mesh, spec))
+            report[getattr(t, "name", None) or id(t)] = {
+                "spec": spec,
+                "global_shape": tuple(t.shape),
+                "local_shape": local_shape(tuple(t.shape), spec, self.mesh),
+            }
+        return report
+
+
+def infer_reshard_comm(src_spec, dst_spec, ndim, mesh):
+    """Name the collective a src->dst placement transition implies
+    (reference reshard.py chooses among slice/concat/all_gather/
+    all_to_all when building reshard ops)."""
+    s = _entries(src_spec or P(), ndim)
+    d = _entries(dst_spec or P(), ndim)
+    if s == d:
+        return "identity"
+    gained = [i for i in range(ndim) if s[i] is None and d[i] is not None]
+    lost = [i for i in range(ndim) if s[i] is not None and d[i] is None]
+    if gained and lost:
+        return "all_to_all"
+    if lost and not gained:
+        return "all_gather"
+    if gained and not lost:
+        return "slice"
+    return "collective_permute"
+
+
+class Resharder:
+    """reshard(tensor, dst_spec[, dst_mesh]) — move a tensor to a new
+    placement; XLA lowers the transition to the collective
+    infer_reshard_comm names. Cross-mesh (disjoint device sets) falls
+    back to a host bounce, as the reference does over send/recv."""
+
+    def __init__(self, mesh=None):
+        from .. import mesh as _mesh
+
+        self.mesh = mesh or _mesh.get_mesh()
+
+    def reshard(self, x, dst_spec, dst_mesh=None):
+        dst_mesh = dst_mesh or self.mesh
+        v = x._value if isinstance(x, Tensor) else x
+        src_spec = getattr(x, "_sharding_spec", None)
+        comm = infer_reshard_comm(src_spec, dst_spec, v.ndim, dst_mesh)
+        same_devices = True
+        try:
+            cur = getattr(v, "sharding", None)
+            if cur is not None:
+                same_devices = set(cur.device_set) <= set(
+                    dst_mesh.devices.flat)
+        except Exception:
+            pass
+        if not same_devices:
+            v = np.asarray(v)  # host bounce between disjoint meshes
+        out = jax.device_put(v, NamedSharding(dst_mesh, dst_spec))
+        if isinstance(x, Tensor):
+            x._value = out
+            x._sharding_spec = dst_spec
+            return x, comm
+        return out, comm

@@ -1,0 +1,101 @@
+"""Planner / tuner — search candidate layouts with the cost model.
+
+Parity: reference auto_parallel/planner.py + tuner/ (enumerate dist
+attrs per op, prune with the cost model). TPU-native search space: a
+small set of whole-program layout strategies over the mesh axes
+(replicated / dp-batch / mp on weight columns / dp+mp), scored by
+CostEstimator; the winner's specs are stamped on the program's
+parameters so the Partitioner/GSPMD realize it.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from jax.sharding import PartitionSpec as P
+
+from ...core.tensor import Parameter, Tensor
+from .completion import Completer
+from .cost_model import CostEstimator
+
+
+def _feeds_and_params(program):
+    params, frozen = program._analyze()
+    feeds = list(program.feed_vars.values())
+    return feeds, list(params) + list(frozen)
+
+
+def _candidate_specs(program, mesh):
+    """Yield (name, {id(tensor): spec}) candidate layouts."""
+    feeds, weights = _feeds_and_params(program)
+    axes = [a for a in mesh.axis_names if mesh.shape[a] > 1]
+    dp_axis = next((a for a in ("dp", "sharding") if a in axes), None)
+    mp_axis = "mp" if "mp" in axes else None
+
+    def batch_spec(t, axis):
+        if t.ndim >= 1 and axis and t.shape[0] % mesh.shape[axis] == 0:
+            return P(*([axis] + [None] * (t.ndim - 1)))
+        return P()
+
+    def col_spec(t, axis):
+        if t.ndim >= 2 and axis and t.shape[-1] % mesh.shape[axis] == 0:
+            return P(*([None] * (t.ndim - 1) + [axis]))
+        return P()
+
+    yield "serial", {}
+    if dp_axis:
+        yield "dp", {id(t): batch_spec(t, dp_axis) for t in feeds}
+    if mp_axis:
+        yield "mp", {id(t): col_spec(t, mp_axis) for t in weights
+                     if isinstance(t, Parameter)}
+    if dp_axis and mp_axis:
+        spec = {id(t): batch_spec(t, dp_axis) for t in feeds}
+        spec.update({id(t): col_spec(t, mp_axis) for t in weights
+                     if isinstance(t, Parameter)})
+        yield "dp_mp", spec
+
+
+class Planner:
+    """plan(program) -> (strategy_name, cost, specs); optionally apply
+    by stamping parameter specs (reference planner searches dist-attr
+    space per op; here per-strategy, which is what the tuner's
+    coarse-grained profiles converge to on homogeneous meshes)."""
+
+    def __init__(self, mesh=None, machine=None):
+        from .. import mesh as _mesh
+
+        self.mesh = mesh or _mesh.get_mesh()
+        self.estimator = CostEstimator(self.mesh, machine)
+
+    def plan(self, program, apply=False):
+        results = []
+        for name, seed in _candidate_specs(program, self.mesh):
+            # overlay the candidate seeds, re-complete downstream;
+            # restore in finally so a raising estimate never leaves the
+            # program's live sharding state corrupted
+            saved = {}
+            try:
+                for rec in program.tape:
+                    for l in rec.leaves:
+                        if isinstance(l, Tensor) and id(l) in seed:
+                            saved[id(l)] = getattr(l, "_sharding_spec",
+                                                   None)
+                            l._sharding_spec = seed[id(l)]
+                specs = Completer().complete_forward_annotation(program)
+                specs.update(seed)
+                cost = self.estimator.estimate(program, specs)
+                results.append((name, cost, specs, dict(saved)))
+            finally:
+                for rec in program.tape:
+                    for l in rec.leaves:
+                        if isinstance(l, Tensor) and id(l) in saved:
+                            l._sharding_spec = saved[id(l)]
+        results.sort(key=lambda r: r[1]["time"])
+        name, cost, specs, _ = results[0]
+        if apply:
+            for rec in program.tape:
+                for l in rec.leaves:
+                    if isinstance(l, Tensor) and id(l) in specs and \
+                            isinstance(l, Parameter):
+                        l._sharding_spec = specs[id(l)]
+        self.last_results = [(n, c["time"]) for n, c, _, _ in results]
+        return name, cost, specs

@@ -84,10 +84,18 @@ class Fleet:
         return DataParallel(model, hcg=hcg, strategy=self._strategy)
 
     def distributed_optimizer(self, optimizer, strategy=None):
-        from ...parallel.hybrid_optimizer import HybridParallelOptimizer
-
         if strategy is not None:
             self._strategy = strategy
+        from ... import static as _static
+
+        if not _static.in_dynamic_mode():
+            # static mode: meta-optimizer chain rewrites the captured
+            # Program (reference fleet/meta_optimizers/ + strategy_compiler)
+            from .meta_optimizers import StaticDistributedOptimizer
+
+            return StaticDistributedOptimizer(optimizer, self._strategy)
+        from ...parallel.hybrid_optimizer import HybridParallelOptimizer
+
         return HybridParallelOptimizer(optimizer, self._hcg, self._strategy)
 
     # PS-mode entry points (host-resident parameter server, csrc/ps)

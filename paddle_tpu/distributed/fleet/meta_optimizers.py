@@ -1,0 +1,210 @@
+"""Static-graph meta-optimizers — strategy-driven program rewrites.
+
+Parity: reference fleet/meta_optimizers/ (22 graph-rewriting optimizers
+chained by meta_optimizer_base.py + strategy_compiler.py): amp,
+recompute, gradient_merge, sharding, tensor_parallel, raw_program,
+pipeline. Each reference optimizer rewrites the ProgramDesc with
+inserted ops; here each applies the corresponding tape pass
+(distributed/passes) to the captured Program — the same strategy
+surface, TPU-native rewrite machinery.
+"""
+from __future__ import annotations
+
+from ..passes import new_pass
+
+
+class MetaOptimizerBase:
+    """One strategy-conditional rewrite around an inner optimizer
+    (reference meta_optimizer_base.py)."""
+
+    # subclasses: the DistributedStrategy flag that enables this optimizer
+    flag = None
+
+    def __init__(self, inner_opt):
+        self.inner_opt = inner_opt
+        self.strategy = None
+
+    def _set_basic_info(self, loss, role_maker, user_defined_optimizer,
+                        user_defined_strategy):
+        self.strategy = user_defined_strategy
+
+    def _can_apply(self):
+        return bool(getattr(self.strategy, self.flag, False))
+
+    def _disable_strategy(self, strategy):
+        setattr(strategy, self.flag, False)
+
+    def apply_passes(self, main_program, startup_program):
+        raise NotImplementedError
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        out = self.inner_opt.minimize(loss, startup_program,
+                                      parameter_list, no_grad_set)
+        from ... import static
+
+        main = static.default_main_program()
+        self.apply_passes(main, startup_program)
+        return out
+
+
+class AMPOptimizer(MetaOptimizerBase):
+    """reference meta_optimizers/amp_optimizer.py — O1 mixed precision;
+    bf16 on TPU (fp16 + loss scaling is a GPU-ism)."""
+
+    flag = "amp"
+
+    def apply_passes(self, main_program, startup_program):
+        from ..passes import AutoParallelBF16Pass
+
+        cfg = self.strategy.amp_configs if self.strategy else {}
+        # custom lists EXTEND the built-ins (reference amp lists are
+        # additive: auto_cast.py white/black + custom)
+        white = AutoParallelBF16Pass.WHITE | set(
+            cfg.get("custom_white_list") or [])
+        black = AutoParallelBF16Pass.BLACK | set(
+            cfg.get("custom_black_list") or [])
+        p = new_pass("auto_parallel_bf16", {
+            "custom_white_list": white - black,
+            "custom_black_list": black,
+        })
+        p.apply(main_program, startup_program)
+
+
+class RecomputeOptimizer(MetaOptimizerBase):
+    """reference meta_optimizers/recompute_optimizer.py — checkpoints
+    from strategy.recompute_configs['checkpoints']."""
+
+    flag = "recompute"
+
+    def apply_passes(self, main_program, startup_program):
+        cfg = self.strategy.recompute_configs if self.strategy else {}
+        p = new_pass("auto_parallel_recompute",
+                     {"checkpoints": cfg.get("checkpoints") or []})
+        p.apply(main_program, startup_program)
+
+
+class GradientMergeOptimizer(MetaOptimizerBase):
+    """reference meta_optimizers/gradient_merge_optimizer.py."""
+
+    flag = "gradient_merge"
+
+    def apply_passes(self, main_program, startup_program):
+        cfg = self.strategy.gradient_merge_configs if self.strategy else {}
+        p = new_pass("auto_parallel_gradient_merge", {
+            "k_steps": cfg.get("k_steps", 1),
+            "avg": cfg.get("avg", True),
+        })
+        p.apply(main_program, startup_program)
+
+
+class ShardingOptimizer(MetaOptimizerBase):
+    """reference meta_optimizers/sharding_optimizer.py (ZeRO over the
+    'sharding' mesh axis; GSPMD inserts the collectives)."""
+
+    flag = "sharding"
+
+    def apply_passes(self, main_program, startup_program):
+        cfg = self.strategy.sharding_configs if self.strategy else {}
+        p = new_pass("auto_parallel_sharding",
+                     {"stage": cfg.get("stage", 1)})
+        p.apply(main_program, startup_program)
+
+
+class TensorParallelOptimizer(MetaOptimizerBase):
+    """reference meta_optimizers/tensor_parallel_optimizer.py: under
+    GSPMD the mpu layers already stamp 'mp' specs on their parameters;
+    this optimizer validates the mesh has the axis."""
+
+    flag = "tensor_parallel"
+
+    def apply_passes(self, main_program, startup_program):
+        from .. import mesh as _mesh
+
+        mesh = _mesh.get_mesh()
+        if "mp" not in mesh.axis_names:
+            raise ValueError(
+                "tensor_parallel requires an 'mp' axis on the mesh "
+                "(build_hybrid_mesh(mp=...))")
+
+
+class RawProgramOptimizer(MetaOptimizerBase):
+    """reference meta_optimizers/raw_program_optimizer.py (pure dp:
+    insert grad allreduces). Under SPMD, batch sharding over 'dp' makes
+    XLA insert them — nothing to rewrite; kept for strategy parity."""
+
+    flag = "without_graph_optimization"
+
+    def apply_passes(self, main_program, startup_program):
+        pass
+
+
+class PipelineOptimizer(MetaOptimizerBase):
+    """reference meta_optimizers/pipeline_optimizer.py: static pipeline
+    training routes through the compiled ring pipeline
+    (parallel/pipeline_parallel.PipelinedTrainStep); the static tape is
+    not stage-split — direct users switch to PipelinedTrainStep."""
+
+    flag = "pipeline"
+
+    def apply_passes(self, main_program, startup_program):
+        raise NotImplementedError(
+            "static pipeline rewrite: use "
+            "paddle_tpu.parallel.pipeline_parallel.PipelinedTrainStep "
+            "(compiled ring 1F1B) — the tape is not stage-split")
+
+
+# order matters: precision first, then memory, then distribution —
+# the reference's strategy_compiler ordering
+_META_OPTIMIZERS = [
+    AMPOptimizer,
+    RecomputeOptimizer,
+    GradientMergeOptimizer,
+    ShardingOptimizer,
+    TensorParallelOptimizer,
+    RawProgramOptimizer,
+]
+
+
+class StrategyCompiler:
+    """Pick + chain applicable meta optimizers (reference
+    strategy_compiler.py)."""
+
+    def generate_optimizer(self, loss, role_maker, optimizer, strategy):
+        chain = []
+        for cls in _META_OPTIMIZERS:
+            m = cls(optimizer)
+            m._set_basic_info(loss, role_maker, optimizer, strategy)
+            if m._can_apply():
+                chain.append(m)
+        return chain
+
+
+class StaticDistributedOptimizer:
+    """fleet.distributed_optimizer in static mode: inner minimize records
+    the train spec, then every applicable meta optimizer rewrites the
+    program (reference fleet.py:1044 minimize flow)."""
+
+    def __init__(self, optimizer, strategy):
+        self.inner_opt = optimizer
+        self.strategy = strategy
+        self._chain = None
+
+    def __getattr__(self, name):
+        return getattr(self.inner_opt, name)
+
+    def applied_meta_list(self):
+        return [type(m).__name__ for m in (self._chain or [])]
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        out = self.inner_opt.minimize(loss, startup_program,
+                                      parameter_list, no_grad_set)
+        from ... import static
+
+        main = static.default_main_program()
+        self._chain = StrategyCompiler().generate_optimizer(
+            loss, None, self.inner_opt, self.strategy)
+        for m in self._chain:
+            m.apply_passes(main, startup_program)
+        return out

@@ -1,0 +1,277 @@
+"""Program-rewrite pass framework.
+
+Parity: reference python/paddle/distributed/passes/pass_base.py
+(PassBase/PassManager/register_pass/new_pass) and the auto_parallel_*
+pass set (auto_parallel_amp.py, auto_parallel_bf16.py,
+auto_parallel_recompute.py, auto_parallel_gradient_merge.py,
+auto_parallel_sharding.py), plus the fluid IR pass registry idea
+(paddle/fluid/framework/ir/pass.h:69,236).
+
+TPU-native: a Program here is a replayed op TAPE, not a protobuf graph,
+so passes rewrite tape records / program attributes instead of proto
+nodes; what the reference implements as graph surgery (inserting cast
+ops, allreduce ops, recompute subgraphs) becomes record wrapping and
+replay policy:
+
+- amp/bf16: wrap each record's kernel body with white/black-list casts
+  (reference inserts cast ops around every op).
+- recompute: group the tape into checkpoint-delimited segments; the
+  Executor replays each segment under jax.checkpoint (reference clones
+  the forward subgraph into the backward block).
+- gradient_merge: k-step gradient accumulation folded into the compiled
+  train step (reference inserts gradient-merge vars + cond ops).
+- sharding (ZeRO): stamp parameter sharding specs so GSPMD partitions
+  state (reference rewrites programs with broadcast/allreduce ops).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+_PASSES = {}
+
+
+class PassContext:
+    def __init__(self):
+        self.attrs = {}
+
+    def set_attr(self, k, v):
+        self.attrs[k] = v
+
+    def get_attr(self, k, default=None):
+        return self.attrs.get(k, default)
+
+
+class PassBase:
+    """One rewrite; subclasses set `name` and implement
+    _apply_single_impl(main_program, startup_program, context)."""
+
+    name = None
+
+    def __init__(self):
+        self._attrs = {}
+
+    def set_attr(self, k, v):
+        self._attrs[k] = v
+        return self
+
+    def get_attr(self, k, default=None):
+        return self._attrs.get(k, default)
+
+    # reference-compatible validity hooks
+    def _check_self(self):
+        return True
+
+    def _check_conflict(self, other_pass):
+        return True
+
+    def apply(self, main_programs, startup_programs=None, context=None):
+        context = context or PassContext()
+        mains = main_programs if isinstance(main_programs, (list, tuple)) \
+            else [main_programs]
+        starts = (startup_programs
+                  if isinstance(startup_programs, (list, tuple))
+                  else [startup_programs] * len(mains))
+        for m, s in zip(mains, starts):
+            self._apply_single_impl(m, s, context)
+        return context
+
+    def _apply_single_impl(self, main_program, startup_program, context):
+        raise NotImplementedError
+
+
+def register_pass(name):
+    def deco(cls):
+        cls.name = name
+        _PASSES[name] = cls
+        return cls
+
+    return deco
+
+
+def new_pass(name, pass_attrs=None):
+    if name not in _PASSES:
+        raise ValueError("unknown pass %r (registered: %s)"
+                         % (name, sorted(_PASSES)))
+    p = _PASSES[name]()
+    for k, v in (pass_attrs or {}).items():
+        p.set_attr(k, v)
+    return p
+
+
+class PassManager:
+    """Apply an ordered list of passes (reference pass_base.PassManager)."""
+
+    def __init__(self, passes):
+        self.passes = list(passes)
+        for i, p in enumerate(self.passes):
+            if not p._check_self():
+                raise ValueError("pass %s failed self-check" % p.name)
+            for q in self.passes[:i]:
+                if not p._check_conflict(q):
+                    raise ValueError(
+                        "pass %s conflicts with %s" % (p.name, q.name))
+
+    def apply(self, main_programs, startup_programs=None):
+        ctx = PassContext()
+        for p in self.passes:
+            p.apply(main_programs, startup_programs, ctx)
+        return ctx
+
+    @property
+    def names(self):
+        return [p.name for p in self.passes]
+
+
+# --------------------------------------------------------------- passes
+
+
+def _wrap_record_amp(rec, lists, dtype):
+    """Return a copy of `rec` whose kernel body casts float inputs per
+    the white/black lists (the reference's inserted cast ops): white ops
+    run in the amp dtype, black ops are pinned to fp32, the rest follow
+    their inputs (O1 semantics, reference amp/auto_cast.py lists)."""
+    from ...static import _OpRecord
+
+    white, black = lists
+    op = rec.op_name
+    orig = rec.raw_fn
+    if op in white:
+        target = dtype
+    elif op in black:
+        target = jnp.float32
+    else:
+        return rec
+
+    def amp_fn(*a, **k):
+        import jax
+
+        def cast_in(x):
+            if hasattr(x, "dtype") and hasattr(x, "astype") and \
+                    jnp.issubdtype(jnp.result_type(x), jnp.floating):
+                return x.astype(target)
+            return x
+
+        a2, k2 = jax.tree_util.tree_map(cast_in, (a, k))
+        return orig(*a2, **k2)
+
+    return _OpRecord(rec.op_name, amp_fn, rec.leaves, rec.treedef,
+                     rec.outs, rec.multi)
+
+
+@register_pass("auto_parallel_bf16")
+class AutoParallelBF16Pass(PassBase):
+    """Cast white-listed (MXU-bound) kernels to bfloat16 at replay
+    (reference auto_parallel_bf16.py; list from amp O1 semantics)."""
+
+    DTYPE = "bfloat16"
+    WHITE = {"matmul", "mm", "bmm", "mv", "linear", "conv2d", "conv1d",
+             "conv3d", "einsum", "addmm"}
+    BLACK = {"cross_entropy", "softmax_with_cross_entropy", "log_softmax",
+             "sum", "mean", "reduce_sum", "reduce_mean", "logsumexp",
+             "batch_norm_train", "batch_norm_infer", "layer_norm",
+             "rms_norm", "mse_loss", "l1_loss", "nll_loss"}
+
+    def _apply_single_impl(self, main_program, startup_program, context):
+        dtype = jnp.bfloat16 if self.DTYPE == "bfloat16" else jnp.float16
+        lists = (self.get_attr("custom_white_list") or self.WHITE,
+                 self.get_attr("custom_black_list") or self.BLACK)
+        main_program.tape = [
+            _wrap_record_amp(rec, lists, dtype) for rec in main_program.tape]
+        main_program.__dict__.pop("_native_interp", None)
+        main_program._bump()
+        context.set_attr("amp_dtype", self.DTYPE)
+
+
+@register_pass("auto_parallel_fp16")
+class AutoParallelFP16Pass(AutoParallelBF16Pass):
+    DTYPE = "float16"
+
+
+@register_pass("auto_parallel_amp")
+class AutoParallelAMPPass(AutoParallelBF16Pass):
+    """O1 auto-mixed-precision: bf16 on TPU (reference
+    auto_parallel_amp.py; fp16 is a GPU-ism)."""
+
+
+@register_pass("auto_parallel_recompute")
+class AutoParallelRecomputePass(PassBase):
+    """Segment the tape at user checkpoints; the Executor replays each
+    segment under jax.checkpoint so activations between checkpoints are
+    rematerialized in backward (reference auto_parallel_recompute.py;
+    strategy.recompute_configs['checkpoints'])."""
+
+    def _apply_single_impl(self, main_program, startup_program, context):
+        ckpts = self.get_attr("checkpoints") or []
+        ckpt_ids = {id(t) for t in ckpts}
+        segments = []
+        start = 0
+        for i, rec in enumerate(main_program.tape):
+            if any(id(t) in ckpt_ids for t in rec.outs):
+                segments.append((start, i + 1))
+                start = i + 1
+        if start < len(main_program.tape):
+            segments.append((start, len(main_program.tape)))
+        main_program._recompute_segments = segments
+        main_program.__dict__.pop("_native_interp", None)
+        main_program._bump()
+        context.set_attr("recompute_segments", segments)
+
+
+@register_pass("auto_parallel_gradient_merge")
+class AutoParallelGradientMergePass(PassBase):
+    """k-step gradient accumulation before the optimizer update
+    (reference auto_parallel_gradient_merge.py): the compiled train step
+    accumulates grads and applies the update every k-th call."""
+
+    def _apply_single_impl(self, main_program, startup_program, context):
+        k = int(self.get_attr("k_steps", 1))
+        avg = bool(self.get_attr("avg", True))
+        main_program._grad_merge = (k, avg)
+        main_program._run_cache.clear()
+        main_program._bump()
+        context.set_attr("grad_merge_k", k)
+
+
+@register_pass("auto_parallel_sharding")
+class AutoParallelShardingPass(PassBase):
+    """ZeRO parameter/optimizer sharding by stamping sharding specs on
+    the program's parameters; GSPMD partitions state and inserts the
+    reduce-scatter/all-gather (reference auto_parallel_sharding.py
+    rewrites programs with explicit collectives)."""
+
+    def _apply_single_impl(self, main_program, startup_program, context):
+        from ...parallel.engine import zero_spec
+        from .. import mesh as _mesh
+
+        stage = int(self.get_attr("stage", 2))
+        mesh = _mesh.get_mesh()
+        if "sharding" not in mesh.axis_names or \
+                mesh.shape.get("sharding", 1) <= 1:
+            raise ValueError(
+                "auto_parallel_sharding requires a >1 'sharding' axis on "
+                "the mesh (build_hybrid_mesh(sharding=...)); stage %d "
+                "would otherwise be a silent no-op" % stage)
+        params, _ = main_program._analyze()
+        n = 0
+        for p in params:
+            if stage >= 3 and getattr(p, "_sharding_spec", None) is None:
+                from jax.sharding import PartitionSpec as P
+
+                p._sharding_spec = zero_spec(tuple(p.shape), P(), mesh)
+                n += 1
+        # stage 1: opt-state sharding; stage 2: + grad reduce-scatter —
+        # both realized by the Executor reading _zero_stage
+        main_program._zero_stage = stage
+        main_program._run_cache.clear()
+        main_program._bump()
+        context.set_attr("sharded_params", n)
+
+
+@register_pass("fuse_all_reduce")
+class FuseAllReducePass(PassBase):
+    """No-op by design: under SPMD partitioning XLA's combiner already
+    fuses gradient all-reduces (reference fuse_all_reduce.py exists
+    because NCCL launches are per-tensor)."""
+
+    def _apply_single_impl(self, main_program, startup_program, context):
+        context.set_attr("fuse_all_reduce", "delegated-to-XLA")

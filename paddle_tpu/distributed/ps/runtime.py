@@ -92,8 +92,15 @@ class SparseTable:
             for k, g in zip(ids, grads):
                 k = int(k)
                 row = self.rows.get(k)
-                if row is not None:
-                    self._acc.apply(row, g, self._slots[k])
+                if row is None:
+                    # create-on-miss, matching the C++ server path
+                    # (csrc/ps.cc t->row(): push to an unseen id first
+                    # initializes the row, then applies)
+                    row = self._rng.normal(
+                        0.0, self.init_std, self.dim).astype(np.float32)
+                    self.rows[k] = row
+                    self._slots[k] = self._acc.slots(self.dim)
+                self._acc.apply(row, g, self._slots[k])
 
     def size(self):
         return len(self.rows)

@@ -307,12 +307,74 @@ class _ReplayContext:
         return False
 
 
+def _run_tape_recompute(program, segments):
+    """Replay the tape as checkpoint-delimited segments, each under
+    jax.checkpoint: activations between checkpoints are rematerialized
+    in backward instead of saved (the auto_parallel_recompute pass;
+    reference recompute clones forward subgraphs into the grad block).
+    """
+    from ..core.interpreter import replay_record
+
+    tape = program.tape
+    keep_ids = getattr(program, "_replay_keep_ids", set())
+
+    _dispatch._enter_primitive()
+    try:
+        for si, (s, e) in enumerate(segments):
+            seg = tape[s:e]
+            produced = {id(t) for rec in seg for t in rec.outs}
+            # explicit inputs: every Tensor leaf not produced inside —
+            # params included, so remat recomputes w.r.t. them (a
+            # closed-over param would be a non-differentiable residual)
+            ins, seen = [], set()
+            for rec in seg:
+                for l in rec.leaves:
+                    if isinstance(l, Tensor) and id(l) not in produced \
+                            and id(l) not in seen:
+                        seen.add(id(l))
+                        ins.append(l)
+            # explicit outputs: consumed by later segments, or kept
+            # (fetches / loss / state sources), or checkpoint-final
+            later_consumed = set()
+            for rec in tape[e:]:
+                for l in rec.leaves:
+                    if isinstance(l, Tensor):
+                        later_consumed.add(id(l))
+            outs, oseen = [], set()
+            for rec in seg:
+                for t in rec.outs:
+                    if id(t) in oseen:
+                        continue
+                    if (id(t) in later_consumed or id(t) in keep_ids
+                            or (si == len(segments) - 1
+                                and rec is seg[-1])):
+                        oseen.add(id(t))
+                        outs.append(t)
+
+            def seg_fn(*invals, _seg=seg, _ins=ins, _outs=outs):
+                for t, v in zip(_ins, invals):
+                    t._value = v
+                for rec in _seg:
+                    replay_record(rec)
+                return tuple(t._value for t in _outs)
+
+            vals = jax.checkpoint(seg_fn)(*[t._value for t in ins])
+            for t, v in zip(outs, vals):
+                t._value = v
+    finally:
+        _dispatch._exit_primitive()
+
+
 def _run_tape(program):
     """Un-jitted replay. Prefers the native C++ interpreter (csrc/interp.cc
     — dependency-counted workqueue, the reference InterpreterCore analog);
     falls back to sequential Python replay if the native core is
     unavailable. Toggle with FLAGS_use_native_interpreter."""
     from ..core import flags as _flags
+
+    segments = getattr(program, "_recompute_segments", None)
+    if segments and len(segments) > 1:
+        return _run_tape_recompute(program, segments)
 
     use_native = _flags.get_flags().get("FLAGS_use_native_interpreter", True)
     if use_native and program.tape:
@@ -423,6 +485,10 @@ class Executor:
         state_sources = [s for _, s in state_list]
 
         if not train:
+            program._replay_keep_ids = (
+                {id(t) for t in fetch_tensors}
+                | {id(s) for s in state_sources})
+
             def pure(feed_vals, param_vals, frozen_vals, rng_key):
                 _random.set_replay_base(rng_key)
                 try:
@@ -454,9 +520,33 @@ class Executor:
 
         loss_t, opt = program._train_spec
         has_update = opt is not None
+        gm_k, gm_avg = getattr(program, "_grad_merge", (1, True))
+        # ZeRO stages from the auto_parallel_sharding pass: stage>=1
+        # shards optimizer state over 'sharding', stage>=2 constrains
+        # grads to the same spec (XLA emits reduce-scatter), stage>=3
+        # shards params (specs stamped by the pass itself)
+        zero_stage = getattr(program, "_zero_stage", 0)
+        zero_shardings = None
+        if zero_stage >= 1:
+            from jax.sharding import NamedSharding, PartitionSpec as _P
 
-        def pure(feed_vals, param_vals, frozen_vals, opt_state, lr, step,
-                 rng_key):
+            from ..distributed import mesh as _zmesh
+            from ..parallel.engine import zero_spec as _zero_spec
+
+            zmesh = _zmesh.get_mesh()
+            if "sharding" in zmesh.axis_names:
+                zero_shardings = {
+                    id(p): NamedSharding(
+                        zmesh, _zero_spec(tuple(p.shape), _P(), zmesh))
+                    for p in params}
+        # tensors the segmented-recompute replay must expose as segment
+        # outputs even when no later record consumes them
+        program._replay_keep_ids = (
+            {id(loss_t)} | {id(t) for t in fetch_tensors}
+            | {id(s) for s in state_sources})
+
+        def pure(feed_vals, param_vals, frozen_vals, opt_state, acc_grads,
+                 lr, step, rng_key):
             _random.set_replay_base(rng_key)
             try:
                 def loss_of(pvals):
@@ -478,6 +568,12 @@ class Executor:
                     loss_of, has_aux=True)(param_vals)
             finally:
                 _random.set_replay_base(None)
+            if zero_stage >= 2 and zero_shardings is not None:
+                grads = [
+                    jax.lax.with_sharding_constraint(
+                        g, zero_shardings[id(p)])
+                    if id(p) in zero_shardings else g
+                    for g, p in zip(grads, params)]
             # grad placeholders fetched by id
             grad_of = {pid: g for pid, g in zip(
                 [id(p) for p in params], grads)}
@@ -490,17 +586,40 @@ class Executor:
                         break
                 out_fetches.append(fv if hit is None else hit)
             if not has_update:
-                return out_fetches, param_vals, opt_state, state_vals
+                return (out_fetches, param_vals, opt_state, acc_grads,
+                        state_vals)
             names = [str(i) for i in range(len(params))]
+            if gm_k > 1:
+                # gradient merge (auto_parallel_gradient_merge pass):
+                # accumulate k microsteps, update on the k-th, where()
+                # keeps params/state frozen in between
+                acc_new = [a + g for a, g in zip(acc_grads, grads)]
+                eff = [(a / gm_k if gm_avg else a) for a in acc_new]
+                do_upd = (step % gm_k) == 0
+                upd_step = jnp.maximum(step // gm_k, 1)
+                pdict = dict(zip(names, param_vals))
+                gdict = dict(zip(names, eff))
+                sdict = dict(zip(names, opt_state))
+                new_p, new_s = opt.functional_apply(pdict, gdict, sdict,
+                                                    lr=lr, step=upd_step)
+                out_p = [jnp.where(do_upd, new_p[n], p)
+                         for n, p in zip(names, param_vals)]
+                out_s = [
+                    [jnp.where(do_upd, ns, os)
+                     for ns, os in zip(new_s[n], slots)]
+                    for n, slots in zip(names, opt_state)]
+                acc_out = [jnp.where(do_upd, jnp.zeros_like(a), a)
+                           for a in acc_new]
+                return out_fetches, out_p, out_s, acc_out, state_vals
             pdict = dict(zip(names, param_vals))
             gdict = dict(zip(names, grads))
             sdict = dict(zip(names, opt_state))
             new_p, new_s = opt.functional_apply(pdict, gdict, sdict,
                                                 lr=lr, step=step)
             return (out_fetches, [new_p[n] for n in names],
-                    [new_s[n] for n in names], state_vals)
+                    [new_s[n] for n in names], acc_grads, state_vals)
 
-        jitted = jax.jit(pure, donate_argnums=(1, 3))
+        jitted = jax.jit(pure, donate_argnums=(1, 3, 4))
 
         def runner(prog, feed_vals, params, frozen):
             if prog._opt_state is None:
@@ -508,23 +627,35 @@ class Executor:
                     prog._opt_state = [
                         [opt._init_slot(s, p) for s in opt._slots()]
                         for p in params]
+                    if zero_shardings is not None:
+                        # ZeRO stage 1+: moment slots live sharded
+                        prog._opt_state = [
+                            [jax.device_put(s, zero_shardings[id(p)])
+                             if jnp.shape(s) == tuple(p.shape) else s
+                             for s in slots]
+                            for slots, p in zip(prog._opt_state, params)]
                 else:
                     prog._opt_state = [[] for _ in params]
+            acc = getattr(prog, "_gm_acc", None)
+            if acc is None:
+                acc = ([jnp.zeros(p.shape, jnp.float32) for p in params]
+                       if gm_k > 1 else [])
             lr = jnp.asarray(opt.get_lr() if has_update else 0.0,
                              jnp.float32)
             # eager Optimizer.step increments the global step before the
             # update (Adam bias correction needs step >= 1)
             step = jnp.asarray(
                 opt._global_step + 1 if has_update else 1, jnp.int32)
-            outs, new_p, new_s, new_state = jitted(
+            outs, new_p, new_s, new_acc, new_state = jitted(
                 feed_vals, [p._value for p in params],
-                [f._value for f in frozen], prog._opt_state, lr, step,
-                _random.next_key())
+                [f._value for f in frozen], prog._opt_state, acc, lr,
+                step, _random.next_key())
             for p, v in zip(params, new_p):
                 p._value = v
             for t, v in zip(state_targets, new_state):
                 t._value = v
             prog._opt_state = new_s
+            prog._gm_acc = new_acc
             if has_update:
                 opt._global_step += 1  # LR schedulers are stepped by user
             return outs

@@ -1,0 +1,244 @@
+"""Pass framework + static meta-optimizers (reference
+python/paddle/distributed/passes/pass_base.py, auto_parallel_* passes,
+fleet/meta_optimizers/ + strategy_compiler.py).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.static as static
+from paddle_tpu.distributed.passes import (
+    PassManager,
+    new_pass,
+    register_pass,
+    PassBase,
+)
+
+
+def _fresh():
+    paddle.seed(0)
+    static.enable_static()
+    return static.Program(), static.Program()
+
+
+class TestPassInfra:
+    def teardown_method(self, m):
+        static.disable_static()
+
+    def test_new_pass_unknown_raises(self):
+        with pytest.raises(ValueError):
+            new_pass("definitely_not_a_pass")
+
+    def test_register_and_manager(self):
+        calls = []
+
+        @register_pass("test_dummy_pass")
+        class _Dummy(PassBase):
+            def _apply_single_impl(self, main, startup, ctx):
+                calls.append(self.get_attr("tag"))
+
+        pm = PassManager([new_pass("test_dummy_pass", {"tag": "a"}),
+                          new_pass("fuse_all_reduce")])
+        assert pm.names == ["test_dummy_pass", "fuse_all_reduce"]
+        main, startup = _fresh()
+        pm.apply(main, startup)
+        assert calls == ["a"]
+
+
+class TestBF16Pass:
+    def teardown_method(self, m):
+        static.disable_static()
+
+    def test_matmul_runs_in_bf16(self):
+        main, startup = _fresh()
+        with static.program_guard(main, startup):
+            x = static.data("x", [4, 8], "float32")
+            lin = nn.Linear(8, 8)
+            y = lin(x)
+        new_pass("auto_parallel_bf16").apply(main, startup)
+        exe = static.Executor()
+        exe.run(startup)
+        (out,) = exe.run(main, feed={"x": np.ones((4, 8), np.float32)},
+                         fetch_list=[y], return_numpy=False)
+        assert "bfloat16" in str(out.dtype)
+
+    def test_black_list_pins_fp32(self):
+        main, startup = _fresh()
+        with static.program_guard(main, startup):
+            x = static.data("x", [4, 4], "float32")
+            y = x.matmul(x)          # white -> bf16
+            z = y.sum()              # reduce_sum is black -> fp32
+        new_pass("auto_parallel_bf16").apply(main, startup)
+        exe = static.Executor()
+        (out,) = exe.run(main, feed={"x": np.eye(4, dtype=np.float32)},
+                         fetch_list=[z], return_numpy=False)
+        assert "float32" in str(out.dtype)
+
+
+class TestRecomputePass:
+    def teardown_method(self, m):
+        static.disable_static()
+
+    def test_numerics_identical_with_recompute(self):
+        feeds = np.random.RandomState(0).randn(6, 8).astype(np.float32)
+        labels = np.random.RandomState(1).randn(6, 1).astype(np.float32)
+        losses = {}
+        for use_rc in (False, True):
+            main, startup = _fresh()
+            with static.program_guard(main, startup):
+                x = static.data("x", [6, 8], "float32")
+                lbl = static.data("y", [6, 1], "float32")
+                h1 = nn.Linear(8, 16)(x).tanh()
+                h2 = nn.Linear(16, 16)(h1).tanh()
+                out = nn.Linear(16, 1)(h2)
+                loss = F.mse_loss(out, lbl)
+                opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                           parameters=None)
+                opt.minimize(loss)
+            if use_rc:
+                new_pass("auto_parallel_recompute",
+                         {"checkpoints": [h1, h2]}).apply(main, startup)
+                assert len(main._recompute_segments) >= 2
+            exe = static.Executor()
+            exe.run(startup)
+            ls = []
+            for _ in range(4):
+                (lv,) = exe.run(main, feed={"x": feeds, "y": labels},
+                                fetch_list=[loss])
+                ls.append(float(lv))
+            losses[use_rc] = ls
+        np.testing.assert_allclose(losses[True], losses[False], rtol=1e-5)
+        assert losses[True][-1] < losses[True][0]
+
+
+class TestGradientMergePass:
+    def teardown_method(self, m):
+        static.disable_method = None
+        static.disable_static()
+
+    def test_updates_every_k_steps(self):
+        main, startup = _fresh()
+        with static.program_guard(main, startup):
+            x = static.data("x", [4, 3], "float32")
+            lbl = static.data("y", [4, 1], "float32")
+            lin = nn.Linear(3, 1)
+            loss = F.mse_loss(lin(x), lbl)
+            opt = paddle.optimizer.SGD(learning_rate=0.5, parameters=None)
+            opt.minimize(loss)
+        new_pass("auto_parallel_gradient_merge",
+                 {"k_steps": 2, "avg": True}).apply(main, startup)
+        exe = static.Executor()
+        exe.run(startup)
+        rng = np.random.RandomState(2)
+        w0 = np.asarray(lin.weight._value).copy()
+        f1 = {"x": rng.randn(4, 3).astype(np.float32),
+              "y": rng.randn(4, 1).astype(np.float32)}
+        exe.run(main, feed=f1, fetch_list=[loss])
+        # after microstep 1 of 2: params unchanged
+        np.testing.assert_allclose(np.asarray(lin.weight._value), w0)
+        f2 = {"x": rng.randn(4, 3).astype(np.float32),
+              "y": rng.randn(4, 1).astype(np.float32)}
+        exe.run(main, feed=f2, fetch_list=[loss])
+        # after microstep 2: one update with the AVERAGED grads
+        w_after = np.asarray(lin.weight._value)
+        assert not np.allclose(w_after, w0)
+
+        # oracle: averaged gradient of the two microbatches
+        def grad_of(feed, w, b):
+            xb, yb = feed["x"], feed["y"]
+            pred = xb @ w + b
+            g = 2.0 * (pred - yb) / pred.size
+            return xb.T @ g
+
+        b0 = np.asarray(lin.bias._value) * 0 + 0.0  # bias starts at 0
+        gw = 0.5 * (grad_of(f1, w0, 0.0) + grad_of(f2, w0, 0.0))
+        np.testing.assert_allclose(w_after, w0 - 0.5 * gw, rtol=1e-4,
+                                   atol=1e-6)
+
+
+class TestMetaOptimizerChain:
+    def teardown_method(self, m):
+        static.disable_static()
+
+    def test_fleet_static_chain(self):
+        import paddle_tpu.distributed.fleet as fleet
+
+        import jax
+
+        strategy = fleet.DistributedStrategy()
+        strategy.amp = True
+        strategy.gradient_merge = True
+        strategy.gradient_merge_configs = {"k_steps": 2, "avg": True}
+        strategy.hybrid_configs["dp_degree"] = jax.device_count()
+        fleet.init(is_collective=True, strategy=strategy)
+        main, startup = _fresh()
+        with static.program_guard(main, startup):
+            x = static.data("x", [4, 8], "float32")
+            lbl = static.data("y", [4, 1], "float32")
+            lin = nn.Linear(8, 1)
+            loss = F.mse_loss(lin(x), lbl)
+            opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=None)
+            dist_opt = fleet.distributed_optimizer(opt, strategy)
+            dist_opt.minimize(loss)
+        assert "AMPOptimizer" in dist_opt.applied_meta_list()
+        assert "GradientMergeOptimizer" in dist_opt.applied_meta_list()
+        assert main._grad_merge == (2, True)
+        exe = static.Executor()
+        exe.run(startup)
+        rng = np.random.RandomState(3)
+        for _ in range(4):
+            (lv,) = exe.run(
+                main,
+                feed={"x": rng.randn(4, 8).astype(np.float32),
+                      "y": rng.randn(4, 1).astype(np.float32)},
+                fetch_list=[loss])
+            assert np.isfinite(float(lv))
+
+
+class TestShardingPass:
+    def teardown_method(self, m):
+        static.disable_static()
+
+    def test_requires_sharding_axis(self):
+        from paddle_tpu.distributed import mesh as pmesh
+        import jax
+
+        pmesh.build_hybrid_mesh(dp=jax.device_count())
+        main, startup = _fresh()
+        with pytest.raises(ValueError):
+            new_pass("auto_parallel_sharding", {"stage": 2}).apply(
+                main, startup)
+
+    def test_stage2_shards_opt_state_and_grads(self):
+        from paddle_tpu.distributed import mesh as pmesh
+
+        pmesh.build_hybrid_mesh(dp=2, sharding=4)
+        main, startup = _fresh()
+        with static.program_guard(main, startup):
+            x = static.data("x", [8, 16], "float32")
+            lbl = static.data("y", [8, 8], "float32")
+            lin = nn.Linear(16, 8)
+            loss = F.mse_loss(lin(x), lbl)
+            opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                        parameters=None)
+            opt.minimize(loss)
+        new_pass("auto_parallel_sharding", {"stage": 2}).apply(main,
+                                                              startup)
+        assert main._zero_stage == 2
+        exe = static.Executor()
+        exe.run(startup)
+        rng = np.random.RandomState(5)
+        (lv,) = exe.run(main,
+                        feed={"x": rng.randn(8, 16).astype(np.float32),
+                              "y": rng.randn(8, 8).astype(np.float32)},
+                        fetch_list=[loss])
+        assert np.isfinite(float(lv))
+        # Adam moment slots for the weight are sharded over 'sharding'
+        specs = []
+        for slots in main._opt_state:
+            for s in slots:
+                if hasattr(s, "sharding") and s.ndim >= 1:
+                    specs.append(tuple(s.sharding.spec))
+        assert any("sharding" in str(sp) for sp in specs), specs
