@@ -206,6 +206,14 @@ struct PsServer {
   }
 
   void serve(int cfd) {
+    // every exit path (incl. mid-request read failures) must close the
+    // fd AND remove it from conns, or stop() later shuts down a reused
+    // descriptor belonging to something else
+    serve_impl(cfd);
+    drop_conn(cfd);
+  }
+
+  void serve_impl(int cfd) {
     int one = 1;
     setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
     for (;;) {
@@ -425,11 +433,9 @@ struct PsServer {
           break;
         }
         default:
-          drop_conn(cfd);
           return;
       }
     }
-    drop_conn(cfd);
   }
 
   void drop_conn(int cfd) {

@@ -72,9 +72,11 @@ class Completer:
         tin = [l for l in rec.leaves if isinstance(l, Tensor)]
         in_specs = [_spec_of(t, specs) for t in tin]
         if op in _ELEMENTWISE or op in _NORMS:
-            # keep the first sharded operand's layout
+            # keep the first operand with an actually-sharded layout; a
+            # replicated annotation must not shadow a sharded sibling
             for t, s in zip(tin, in_specs):
-                if s is not None and tuple(_entries(s, t.ndim)) != ():
+                if s is not None and any(
+                        e is not None for e in _entries(s, t.ndim)):
                     return s
             return next((s for s in in_specs if s is not None), None)
         if op in ("matmul", "mm", "bmm", "linear"):

@@ -135,10 +135,12 @@ def _wrap_record_amp(rec, lists, dtype):
     white, black = lists
     op = rec.op_name
     orig = rec.raw_fn
-    if op in white:
-        target = dtype
-    elif op in black:
+    # black wins over white: an op the user blacklists must never run
+    # in the amp dtype (reference auto_cast list precedence)
+    if op in black:
         target = jnp.float32
+    elif op in white:
+        target = dtype
     else:
         return rec
 
@@ -173,8 +175,12 @@ class AutoParallelBF16Pass(PassBase):
 
     def _apply_single_impl(self, main_program, startup_program, context):
         dtype = jnp.bfloat16 if self.DTYPE == "bfloat16" else jnp.float16
-        lists = (self.get_attr("custom_white_list") or self.WHITE,
-                 self.get_attr("custom_black_list") or self.BLACK)
+        # `is None` (not falsy): an explicitly EMPTY custom list means
+        # "nothing", not "use the built-ins"
+        w = self.get_attr("custom_white_list")
+        b = self.get_attr("custom_black_list")
+        lists = (self.WHITE if w is None else set(w),
+                 self.BLACK if b is None else set(b))
         main_program.tape = [
             _wrap_record_amp(rec, lists, dtype) for rec in main_program.tape]
         main_program.__dict__.pop("_native_interp", None)

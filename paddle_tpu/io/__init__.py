@@ -11,8 +11,10 @@ DataFeed for PS-style ingestion lives in csrc/datafeed.
 from __future__ import annotations
 
 import itertools
+import os
 import queue
 import threading
+import time
 
 import numpy as np
 
@@ -266,6 +268,10 @@ class DataLoader:
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
+        self.use_shared_memory = use_shared_memory
+        self.worker_init_fn = worker_init_fn
+        self.timeout = timeout
+        self.persistent_workers = persistent_workers
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_sampler = None
@@ -301,7 +307,21 @@ class DataLoader:
         if self.num_workers <= 0:
             yield from self._batches()
             return
-        # threaded prefetch pipeline
+        mp_iter = None
+        if getattr(self, "use_shared_memory", True) is not False and \
+                not self._iterable_mode:
+            try:
+                # only CONSTRUCTION failures (no mp/shm on this host)
+                # select the fallback; mid-epoch errors must propagate,
+                # never silently restart the epoch on another path
+                mp_iter = _MPIterator(self)
+            except (ImportError, OSError):
+                mp_iter = None
+        if mp_iter is not None:
+            yield from mp_iter
+            return
+        # threaded prefetch pipeline (also the IterableDataset path: the
+        # stream owns its state, so it stays in-process)
         q = queue.Queue(maxsize=self.num_workers * self.prefetch_factor)
         stop = object()
 
@@ -321,5 +341,246 @@ class DataLoader:
             yield item
 
 
+# -- multiprocess workers ----------------------------------------------------
+#
+# Parity: reference python/paddle/fluid/dataloader/worker.py (worker
+# processes fed index batches over queues) and
+# paddle/fluid/imperative/data_loader.cc (shared-memory result transport:
+# the array PAYLOAD crosses processes through a SharedMemory segment;
+# only (name, dtype, shape) goes through the pickled queue).
+
+class WorkerInfo:
+    def __init__(self, id, num_workers, dataset):
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+
+
+_worker_info = None
+
+
 def get_worker_info():
-    return None
+    """Inside a worker process: (id, num_workers, dataset); None in the
+    main process (reference dataloader/worker.py get_worker_info)."""
+    return _worker_info
+
+
+def _shm_pack(batch):
+    """numpy leaves -> (treedef-ish nested struct with shm descriptors)."""
+    from multiprocessing import shared_memory
+
+    blocks = []
+
+    def pack(x):
+        if isinstance(x, np.ndarray) and x.nbytes > 0:
+            shm = shared_memory.SharedMemory(create=True, size=x.nbytes)
+            dst = np.ndarray(x.shape, x.dtype, buffer=shm.buf)
+            dst[...] = x
+            blocks.append(shm)
+            # ownership transfers to the CONSUMER (parent unlinks in
+            # _shm_unpack); without unregistering, the worker's
+            # resource_tracker unlinks the segment when the worker
+            # exits, racing the parent's attach
+            try:
+                from multiprocessing import resource_tracker
+
+                resource_tracker.unregister(shm._name, "shared_memory")
+            except Exception:
+                pass
+            return ("__shm__", shm.name, x.dtype.str, x.shape)
+        return x
+
+    def walk(obj):
+        if isinstance(obj, (list, tuple)):
+            return type(obj)(walk(o) for o in obj)
+        if isinstance(obj, dict):
+            return {k: walk(v) for k, v in obj.items()}
+        return pack(obj)
+
+    out = walk(batch)
+    for shm in blocks:
+        shm.close()  # worker's mapping; the segment lives until unlink
+    return out
+
+
+def _shm_unpack(obj):
+    from multiprocessing import shared_memory
+
+    if isinstance(obj, tuple) and len(obj) == 4 and obj[0] == "__shm__":
+        _, name, dtype, shape = obj
+        shm = shared_memory.SharedMemory(name=name)
+        arr = np.ndarray(shape, np.dtype(dtype), buffer=shm.buf).copy()
+        shm.close()
+        shm.unlink()
+        return arr
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_shm_unpack(o) for o in obj)
+    if isinstance(obj, dict):
+        return {k: _shm_unpack(v) for k, v in obj.items()}
+    return obj
+
+
+def _default_collate_numpy(batch):
+    """default_collate_fn staged as numpy — workers must not touch the
+    jax runtime of the forked parent; the parent wraps to Tensors."""
+    sample = batch[0]
+    if isinstance(sample, (tuple, list)):
+        return [_default_collate_numpy([b[i] for b in batch])
+                for i in range(len(sample))]
+    if isinstance(sample, dict):
+        return {k: _default_collate_numpy([b[k] for b in batch])
+                for k in sample}
+    if isinstance(sample, Tensor):
+        return np.stack([np.asarray(b._value) for b in batch])
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, (int, float, np.integer, np.floating)):
+        return np.asarray(batch)
+    return batch
+
+
+def _tree_to_tensor(obj):
+    if isinstance(obj, np.ndarray):
+        return _to_tensor(obj)
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_tree_to_tensor(o) for o in obj)
+    if isinstance(obj, dict):
+        return {k: _tree_to_tensor(v) for k, v in obj.items()}
+    return obj
+
+
+def _worker_loop(dataset, collate_fn, index_q, result_q, wid, nworkers,
+                 use_shm, init_fn):
+    global _worker_info
+    _worker_info = WorkerInfo(wid, nworkers, dataset)
+    if init_fn is not None:
+        init_fn(wid)
+    while True:
+        item = index_q.get()
+        if item is None:
+            break
+        bidx, indices = item
+        try:
+            batch = collate_fn([dataset[i] for i in indices])
+            payload = _shm_pack(batch) if use_shm else batch
+            result_q.put((bidx, payload, None))
+        except Exception as e:  # surface worker errors in the parent
+            result_q.put((bidx, None, "%s: %s" % (type(e).__name__, e)))
+
+
+class _MPIterator:
+    """Ordered multiprocess iteration (reference
+    _DataLoaderIterMultiProcess): index batches fan out round-robin,
+    results reassemble in order."""
+
+    def __init__(self, loader):
+        import multiprocessing as mp
+
+        self.loader = loader
+        ctx = mp.get_context("fork" if hasattr(os, "fork") else "spawn")
+        n = loader.num_workers
+        self._index_qs = [ctx.Queue() for _ in range(n)]
+        self._result_q = ctx.Queue()
+        use_shm = getattr(loader, "use_shared_memory", True)
+        # workers stage numpy; the parent wraps to Tensors (forked
+        # children must never touch the parent's jax runtime)
+        self._numpy_mode = loader.collate_fn is default_collate_fn
+        worker_collate = (_default_collate_numpy if self._numpy_mode
+                          else loader.collate_fn)
+        self._procs = [
+            ctx.Process(
+                target=_worker_loop,
+                args=(loader.dataset, worker_collate,
+                      self._index_qs[w], self._result_q, w, n, use_shm,
+                      getattr(loader, "worker_init_fn", None)),
+                daemon=True)
+            for w in range(n)]
+        for p in self._procs:
+            p.start()
+
+    def _recv(self, user_timeout):
+        """One result with liveness checks: a dead worker must raise,
+        not hang the parent forever."""
+        deadline = (time.monotonic() + user_timeout) if user_timeout \
+            else None
+        while True:
+            try:
+                return self._result_q.get(timeout=1.0)
+            except queue.Empty:
+                dead = [p for p in self._procs
+                        if not p.is_alive() and p.exitcode not in (0, None)]
+                if dead:
+                    raise RuntimeError(
+                        "DataLoader worker(s) died unexpectedly "
+                        "(exitcodes %s)" % [p.exitcode for p in dead])
+                if deadline is not None and time.monotonic() > deadline:
+                    raise RuntimeError(
+                        "DataLoader timed out after %.1fs waiting for a "
+                        "batch (timeout=%s)" % (user_timeout, user_timeout))
+
+    def __iter__(self):
+        loader = self.loader
+        n = loader.num_workers
+        user_timeout = getattr(loader, "timeout", 0) or None
+        # bounded prefetch: at most num_workers * prefetch_factor index
+        # batches outstanding (the reference's queue-capacity contract)
+        limit = max(n * getattr(loader, "prefetch_factor", 2), n)
+        try:
+            batches = list(enumerate(loader.batch_sampler))
+            sent = 0
+            done_sent = False
+
+            def dispatch():
+                nonlocal sent, done_sent
+                while sent < len(batches) and \
+                        (sent - self._received) < limit:
+                    bidx, idx_batch = batches[sent]
+                    self._index_qs[bidx % n].put((bidx, list(idx_batch)))
+                    sent += 1
+                if sent == len(batches) and not done_sent:
+                    for q in self._index_qs:
+                        q.put(None)
+                    done_sent = True
+
+            self._received = 0
+            pending = {}
+            want = 0
+            dispatch()
+            while want < len(batches):
+                if want in pending:
+                    payload = pending.pop(want)
+                else:
+                    bidx, payload, err = self._recv(user_timeout)
+                    self._received += 1
+                    dispatch()
+                    if err is not None:
+                        raise RuntimeError(
+                            "DataLoader worker failed: %s" % err)
+                    payload = _shm_unpack(payload)
+                    if self._numpy_mode:
+                        payload = _tree_to_tensor(payload)
+                    if bidx != want:
+                        pending[bidx] = payload
+                        continue
+                yield payload
+                want += 1
+        finally:
+            self._shutdown()
+
+    def _shutdown(self):
+        for p in self._procs:
+            if p.is_alive():
+                p.terminate()
+        for p in self._procs:
+            p.join(timeout=5)
+        # drain undelivered results so their SharedMemory segments are
+        # unlinked instead of leaking in /dev/shm past process exit
+        while True:
+            try:
+                _, payload, _err = self._result_q.get_nowait()
+            except Exception:
+                break
+            try:
+                _shm_unpack(payload)
+            except Exception:
+                pass

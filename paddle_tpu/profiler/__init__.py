@@ -66,12 +66,29 @@ class RecordEvent:
         self.name = name
         self.level = level
         self._lib = None
+        self._xprof = None
 
     def begin(self):
         self._lib = native.get_lib()
         self._lib.pt_trace_push(self.name.encode(), self.level)
+        # bridge into the device timeline: the same span shows up in the
+        # Xprof trace (reference merges host RecordEvents with CUPTI
+        # events into one EventNode tree, chrometracing_logger.cc)
+        try:
+            import jax
+
+            self._xprof = jax.profiler.TraceAnnotation(self.name)
+            self._xprof.__enter__()
+        except Exception:
+            self._xprof = None
 
     def end(self):
+        if self._xprof is not None:
+            try:
+                self._xprof.__exit__(None, None, None)
+            except Exception:
+                pass
+            self._xprof = None
         if self._lib is not None:
             self._lib.pt_trace_pop()
             self._lib = None
@@ -188,6 +205,42 @@ class Profiler:
         rc = native.get_lib().pt_trace_dump(path.encode())
         if rc != 0:
             raise IOError("trace dump to %s failed" % path)
+        return path
+
+    def export_merged_chrome_tracing(self, path):
+        """ONE chrome trace containing both timelines: the native host
+        tracer's events (csrc/trace.cc) and the device/XLA events from
+        the Xprof capture (jax writes tensorboard-plugin
+        *.trace.json.gz files in trace_dir) — the unified EventNode view
+        the reference builds in chrometracing_logger.cc from host +
+        CUPTI streams."""
+        import glob
+        import gzip
+        import json
+
+        host_path = path + ".host.json"
+        self.export_chrome_tracing(host_path)
+        with open(host_path) as f:
+            merged = json.load(f)
+        events = merged.get("traceEvents", merged if isinstance(
+            merged, list) else [])
+        if isinstance(merged, list):
+            merged = {"traceEvents": events}
+        device_files = sorted(glob.glob(os.path.join(
+            self.trace_dir, "plugins", "profile", "*", "*.trace.json.gz")))
+        for df in device_files[-1:]:
+            with gzip.open(df, "rt") as f:
+                dev = json.load(f)
+            for ev in dev.get("traceEvents", []):
+                # keep device pids distinct from host pids
+                if isinstance(ev, dict) and "pid" in ev:
+                    ev = dict(ev)
+                    ev["pid"] = "xla/%s" % ev["pid"]
+                events.append(ev)
+        merged["traceEvents"] = events
+        with open(path, "w") as f:
+            json.dump(merged, f)
+        os.remove(host_path)
         return path
 
     def summary(self):

@@ -59,3 +59,5 @@ class deprecated:
 
     def __call__(self, fn):
         return fn
+
+from . import cpp_extension  # noqa: F401

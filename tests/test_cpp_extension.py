@@ -1,0 +1,78 @@
+"""Custom C++ op loading (reference framework/custom_operator.cc +
+python/paddle/utils/cpp_extension/): JIT-build a user .so, register its
+kernels as framework primitives, run them eagerly and under jit, and
+check the custom gradient.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.utils.cpp_extension import load
+
+SRC = r"""
+#include <cstdint>
+extern "C" {
+// y = x^3
+void cube(const float* x, float* y, int64_t n) {
+  for (int64_t i = 0; i < n; ++i) y[i] = x[i] * x[i] * x[i];
+}
+// custom vjp: gx = 3*x^2 * gy
+void cube_grad(const float* x, const float* gy, float* gx, int64_t n) {
+  for (int64_t i = 0; i < n; ++i) gx[i] = 3.0f * x[i] * x[i] * gy[i];
+}
+// binary: z = x*y + 1
+void muladd1(const float* x, const float* y, float* z, int64_t n) {
+  for (int64_t i = 0; i < n; ++i) z[i] = x[i] * y[i] + 1.0f;
+}
+}
+"""
+
+
+@pytest.fixture(scope="module")
+def ext(tmp_path_factory):
+    d = tmp_path_factory.mktemp("ext")
+    src = d / "my_ops.cc"
+    src.write_text(SRC)
+    return load("my_ops", [str(src)], build_directory=str(d))
+
+
+class TestCppExtension:
+    def test_unary_forward(self, ext):
+        cube = ext.get_op("cube")
+        x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+        out = cube(x)
+        np.testing.assert_allclose(np.asarray(out._value), [1.0, 8.0, 27.0])
+
+    def test_custom_grad(self, ext):
+        cube = ext.get_op("cube")
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        x.stop_gradient = False
+        cube(x).sum().backward()
+        np.testing.assert_allclose(np.asarray(x.grad._value), [3.0, 12.0])
+
+    def test_binary(self, ext):
+        mad = ext.get_op("muladd1", arity=2)
+        x = paddle.to_tensor(np.full((4,), 2.0, np.float32))
+        y = paddle.to_tensor(np.full((4,), 5.0, np.float32))
+        np.testing.assert_allclose(np.asarray(mad(x, y)._value), 11.0)
+
+    def test_under_jit(self, ext):
+        import jax
+        import jax.numpy as jnp
+
+        cube = ext.get_op("cube")
+
+        @jax.jit
+        def f(v):
+            from paddle_tpu.core.tensor import Tensor
+
+            return cube(Tensor(v))._value * 2.0
+
+        out = f(jnp.asarray(np.array([2.0], np.float32)))
+        np.testing.assert_allclose(np.asarray(out), [16.0])
+
+    def test_missing_symbol_raises(self, ext):
+        with pytest.raises(ValueError):
+            ext.get_op("nope")
