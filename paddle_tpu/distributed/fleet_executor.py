@@ -1,0 +1,260 @@
+"""FleetExecutor — actor-model runtime (TaskNode / Carrier /
+Interceptor) + DistModel.
+
+Parity: reference paddle/fluid/distributed/fleet_executor/
+(fleet_executor.cc, carrier.cc, interceptor.h, compute_interceptor.h:25,
+source/sink/amplifier interceptors, brpc MessageBus,
+interceptor_message.proto; DistModel for distributed inference).
+
+TPU-native shape: the actor graph stays — it is the host-side
+orchestration for static pipeline/dist-inference — but the message bus
+is in-process queues between interceptor threads (single-controller
+SPMD replaces cross-rank brpc; a multi-host deployment would ride the
+StoreProcessGroup p2p channel). Compute payloads are arbitrary
+callables, normally compiled XLA steps.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+
+
+class InterceptorMessage:
+    """reference interceptor_message.proto (DATA_IS_READY / DATA_IS_USELESS
+    control plane + payload)."""
+
+    DATA_IS_READY = "DATA_IS_READY"
+    DATA_IS_USELESS = "DATA_IS_USELESS"
+    STOP = "STOP"
+
+    def __init__(self, src_id, dst_id, msg_type, payload=None, scope_idx=0):
+        self.src_id = src_id
+        self.dst_id = dst_id
+        self.msg_type = msg_type
+        self.payload = payload
+        self.scope_idx = scope_idx
+
+
+class TaskNode:
+    """One pipeline task (reference task_node.h): a role, upstream /
+    downstream edges with buffer sizes, a payload fn, max_run_times."""
+
+    def __init__(self, rank=0, node_type="Compute", task_id=0,
+                 max_run_times=1, payload=None):
+        self.rank = rank
+        self.node_type = node_type
+        self.task_id = task_id
+        self.max_run_times = max_run_times
+        self.payload = payload
+        self.upstream = {}    # task_id -> buffer size
+        self.downstream = {}  # task_id -> buffer size
+
+    def add_upstream_task(self, task_id, buffer_size=2):
+        self.upstream[task_id] = buffer_size
+
+    def add_downstream_task(self, task_id, buffer_size=2):
+        self.downstream[task_id] = buffer_size
+
+
+class Interceptor(threading.Thread):
+    """Message-driven actor (reference interceptor.h); one thread per
+    node, mailbox per interceptor — the Carrier is the bus."""
+
+    def __init__(self, node, carrier):
+        super().__init__(daemon=True)
+        self.node = node
+        self.carrier = carrier
+        self.mailbox = queue.Queue()
+        self._stopped = False
+
+    def send(self, dst_id, msg_type, payload=None, scope_idx=0):
+        self.carrier.route(InterceptorMessage(
+            self.node.task_id, dst_id, msg_type, payload, scope_idx))
+
+    def run(self):
+        while not self._stopped:
+            msg = self.mailbox.get()
+            if msg.msg_type == InterceptorMessage.STOP:
+                return
+            self.handle(msg)
+
+    def handle(self, msg):
+        raise NotImplementedError
+
+
+class SourceInterceptor(Interceptor):
+    """reference source_interceptor.cc: emits microbatch tokens under a
+    CREDIT bound — at most buffer_size microbatches in flight; each
+    downstream DATA_IS_USELESS ack returns credit. This is what the
+    reference's ready/useless protocol exists for: the pipeline's
+    memory bound."""
+
+    def __init__(self, node, carrier):
+        super().__init__(node, carrier)
+        self._next = 0
+        self._inflight = 0
+        self._acks = {}
+        self._credit = min(node.downstream.values() or [2])
+
+    def _pump(self):
+        while (self._next < self.node.max_run_times
+               and self._inflight < self._credit):
+            i = self._next
+            payload = self.node.payload(i) if self.node.payload else i
+            self._next += 1
+            self._inflight += 1
+            for dst in self.node.downstream:
+                self.send(dst, InterceptorMessage.DATA_IS_READY, payload, i)
+
+    def run(self):
+        self._pump()
+        super().run()
+
+    def handle(self, msg):
+        if msg.msg_type != InterceptorMessage.DATA_IS_USELESS:
+            return
+        self._acks[msg.scope_idx] = self._acks.get(msg.scope_idx, 0) + 1
+        if self._acks[msg.scope_idx] >= len(self.node.downstream):
+            del self._acks[msg.scope_idx]
+            self._inflight -= 1
+            self._pump()
+
+
+class ComputeInterceptor(Interceptor):
+    """reference compute_interceptor.h:25: waits for every upstream's
+    DATA_IS_READY for a scope, runs the payload, forwards downstream,
+    acks upstream with DATA_IS_USELESS."""
+
+    def __init__(self, node, carrier):
+        super().__init__(node, carrier)
+        self._ready = {}  # scope_idx -> {src_id: payload}
+
+    def handle(self, msg):
+        if msg.msg_type != InterceptorMessage.DATA_IS_READY:
+            return
+        slot = self._ready.setdefault(msg.scope_idx, {})
+        slot[msg.src_id] = msg.payload
+        if len(slot) < len(self.node.upstream):
+            return
+        # payload args bind in add_upstream_task DECLARATION order (dict
+        # insertion order), not task-id order
+        inputs = [slot[s] for s in self.node.upstream]
+        del self._ready[msg.scope_idx]
+        out = (self.node.payload(*inputs) if self.node.payload
+               else (inputs[0] if len(inputs) == 1 else inputs))
+        for src in self.node.upstream:
+            self.send(src, InterceptorMessage.DATA_IS_USELESS,
+                      scope_idx=msg.scope_idx)
+        for dst in self.node.downstream:
+            self.send(dst, InterceptorMessage.DATA_IS_READY, out,
+                      msg.scope_idx)
+
+
+class SinkInterceptor(Interceptor):
+    """reference sink_interceptor.cc: collects final outputs; signals
+    completion after max_run_times microbatches."""
+
+    def __init__(self, node, carrier):
+        super().__init__(node, carrier)
+        self.results = {}
+
+    def handle(self, msg):
+        if msg.msg_type != InterceptorMessage.DATA_IS_READY:
+            return
+        self.results[msg.scope_idx] = msg.payload
+        for src in self.node.upstream:
+            self.send(src, InterceptorMessage.DATA_IS_USELESS,
+                      scope_idx=msg.scope_idx)
+        if len(self.results) >= self.node.max_run_times:
+            self.carrier.done.set()
+
+
+_INTERCEPTORS = {
+    "Source": SourceInterceptor,
+    "Compute": ComputeInterceptor,
+    "Sink": SinkInterceptor,
+}
+
+
+class Carrier:
+    """Hosts this rank's interceptors + routes messages (reference
+    carrier.cc; the in-process queue dict plays the brpc MessageBus)."""
+
+    def __init__(self, nodes):
+        self.done = threading.Event()
+        self.interceptors = {
+            n.task_id: _INTERCEPTORS[n.node_type](n, self) for n in nodes}
+
+    def route(self, msg):
+        dst = self.interceptors.get(msg.dst_id)
+        if dst is not None:
+            dst.mailbox.put(msg)
+
+    def start(self):
+        for it in self.interceptors.values():
+            it.start()
+        return self
+
+    def wait(self, timeout=None):
+        ok = self.done.wait(timeout)
+        for it in self.interceptors.values():
+            it._stopped = True
+            it.mailbox.put(InterceptorMessage(
+                -1, it.node.task_id, InterceptorMessage.STOP))
+        return ok
+
+    def results(self):
+        for it in self.interceptors.values():
+            if isinstance(it, SinkInterceptor):
+                return [it.results[k] for k in sorted(it.results)]
+        return []
+
+
+class FleetExecutor:
+    """reference fleet_executor.cc: build the task graph for a rank,
+    host it on a Carrier, run n microbatches."""
+
+    def __init__(self, nodes=None):
+        self.nodes = list(nodes or [])
+
+    def run(self, timeout=60):
+        carrier = Carrier(self.nodes).start()
+        if not carrier.wait(timeout):
+            raise TimeoutError("FleetExecutor pipeline did not finish")
+        return carrier.results()
+
+    @classmethod
+    def from_stages(cls, stage_fns, num_micro_batches, source_fn=None):
+        """Linear pipeline sugar: source -> stage_0 -> ... -> sink."""
+        nodes = [TaskNode(node_type="Source", task_id=0,
+                          max_run_times=num_micro_batches,
+                          payload=source_fn)]
+        for i, fn in enumerate(stage_fns):
+            nodes.append(TaskNode(node_type="Compute", task_id=i + 1,
+                                  max_run_times=num_micro_batches,
+                                  payload=fn))
+        nodes.append(TaskNode(node_type="Sink",
+                              task_id=len(stage_fns) + 1,
+                              max_run_times=num_micro_batches))
+        for a, b in zip(nodes, nodes[1:]):
+            a.add_downstream_task(b.task_id)
+            b.add_upstream_task(a.task_id)
+        return cls(nodes)
+
+
+class DistModel:
+    """Distributed inference facade (reference fleet_executor/dist_model.cc):
+    loads a saved inference model and serves run() — sharded execution
+    comes from the saved program's GSPMD annotations."""
+
+    def __init__(self, config):
+        from ..inference import Predictor
+
+        self.config = config
+        self._predictor = Predictor(config)
+
+    def init(self):
+        return True
+
+    def run(self, inputs):
+        return self._predictor.run(inputs)

@@ -1,2 +1,9 @@
 from . import random  # noqa: F401
 from .random import get_rng_state, seed, set_rng_state  # noqa: F401
+from . import dataset, trainer  # noqa: F401
+from .dataset import InMemoryDataset, QueueDataset  # noqa: F401
+from .trainer import (  # noqa: F401
+    DistMultiTrainer,
+    MultiTrainer,
+    TrainerFactory,
+)

@@ -1,0 +1,119 @@
+"""Fleet datasets — high-throughput file-backed ingestion for
+train_from_dataset.
+
+Parity: reference python/paddle/distributed/fleet/dataset/dataset.py
+(InMemoryDataset: load_into_memory/local_shuffle/global_shuffle;
+QueueDataset: streaming) over the C++ DataFeed
+(framework/data_feed.h:1083,1325). Here both ride the native record
+feed (csrc/feed.cc: multi-threaded file readers + shuffle buffer +
+bounded queue) through io/datafeed.DataFeed.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..io.datafeed import DataFeed, RecordWriter
+
+
+class DatasetBase:
+    def __init__(self):
+        self._filelist = []
+        self._batch_size = 1
+        self._thread_num = 2
+        self._use_vars = []
+        self._shuffle_buffer = 0
+
+    def init(self, batch_size=1, thread_num=2, use_var=None, **kwargs):
+        self._batch_size = batch_size
+        self._thread_num = thread_num
+        if use_var is not None:
+            self.set_use_var(use_var)
+
+    def set_filelist(self, filelist):
+        self._filelist = list(filelist)
+
+    def set_batch_size(self, batch_size):
+        self._batch_size = batch_size
+
+    def set_thread(self, thread_num):
+        self._thread_num = thread_num
+
+    def set_use_var(self, var_list):
+        """Feed slot order: one dataset column per variable (reference
+        dataset.set_use_var binding slots to program vars)."""
+        self._use_vars = [getattr(v, "name", v) for v in var_list]
+
+    def _feed(self):
+        return DataFeed(self._filelist, num_threads=self._thread_num,
+                        shuffle_buffer=self._shuffle_buffer)
+
+    def batches(self):
+        """Yield feed dicts {var_name: np.ndarray} of batch_size rows."""
+        feed = self._feed()
+        try:
+            for cols in feed.batched(self._batch_size, drop_last=False):
+                if isinstance(cols, dict):
+                    yield cols
+                    continue
+                cols = cols if isinstance(cols, (list, tuple)) else [cols]
+                names = self._use_vars or [
+                    "slot_%d" % i for i in range(len(cols))]
+                yield dict(zip(names, cols))
+        finally:
+            feed.close()
+
+
+class QueueDataset(DatasetBase):
+    """Streaming dataset (reference QueueDataset): records flow straight
+    from the reader threads' bounded queue."""
+
+
+class InMemoryDataset(DatasetBase):
+    """reference InMemoryDataset: load once, shuffle in memory, iterate
+    many epochs."""
+
+    def __init__(self):
+        super().__init__()
+        self._records = None
+
+    def load_into_memory(self):
+        feed = self._feed()
+        try:
+            self._records = list(feed)
+        finally:
+            feed.close()
+
+    def local_shuffle(self, seed=None):
+        if self._records is None:
+            raise RuntimeError("call load_into_memory() first")
+        rng = np.random.RandomState(seed)
+        rng.shuffle(self._records)
+
+    def global_shuffle(self, fleet=None, thread_num=None, seed=None):
+        # single-controller SPMD: the global view IS the local view
+        self.local_shuffle(seed)
+
+    def get_memory_data_size(self):
+        return 0 if self._records is None else len(self._records)
+
+    def release_memory(self):
+        self._records = None
+
+    def batches(self):
+        if self._records is None:
+            yield from super().batches()
+            return
+        from ..io.datafeed import _stack
+
+        bs = self._batch_size
+        for i in range(0, len(self._records), bs):
+            chunk = self._records[i:i + bs]
+            cols = _stack(chunk)
+            cols = cols if isinstance(cols, (list, tuple)) else [cols]
+            names = self._use_vars or [
+                "slot_%d" % j for j in range(len(cols))]
+            yield dict(zip(names, cols))
+
+
+__all__ = ["DatasetBase", "InMemoryDataset", "QueueDataset",
+           "RecordWriter"]

@@ -1,0 +1,171 @@
+"""Trainer / DeviceWorker hierarchy — the PS-style train-loop drivers.
+
+Parity: reference paddle/fluid/framework/trainer.h:59 (TrainerBase),
+:105 (MultiTrainer), :142 (DistMultiTrainer) and device_worker.h:164
+(DeviceWorker), :265 (HogwildWorker), :300 (DownpourWorker); entry point
+Executor::RunFromDataset (executor.cc:163) -> python
+Executor.train_from_dataset.
+
+TPU-native shape: worker threads drive the INPUT pipeline in parallel
+(decode/shuffle/batch on host CPUs — where thread parallelism actually
+pays) while program execution funnels through the one compiled XLA
+step; device execution is serialized by the runtime anyway, so the
+reference's thread-per-device op loop degenerates to overlap of host
+ingestion with device steps. DownpourWorker's sparse pull/push becomes
+pull_sparse/push_sparse against TheOnePSRuntime around each step.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+
+import numpy as np
+
+
+class DeviceWorker:
+    """Per-thread batch driver (reference device_worker.h:164)."""
+
+    def __init__(self, trainer, wid):
+        self.trainer = trainer
+        self.wid = wid
+
+    def train_batch(self, batch):
+        raise NotImplementedError
+
+
+class HogwildWorker(DeviceWorker):
+    """Lock-free-style async worker (reference device_worker.h:265
+    HogwildWorker): every worker steps the shared program; the XLA step
+    itself is the critical section."""
+
+    def train_batch(self, batch):
+        return self.trainer._run_batch(batch)
+
+
+class DownpourWorker(HogwildWorker):
+    """PS worker (reference device_worker.h:300): pull sparse rows
+    before the step, push grads after."""
+
+    def train_batch(self, batch):
+        t = self.trainer
+        pulled = {}
+        if t.ps_runtime is not None:
+            for slot, table in t.sparse_tables.items():
+                ids = np.asarray(batch[slot]).reshape(-1)
+                pulled[slot] = (ids, t.ps_runtime.pull_sparse(table, ids))
+        out = t._run_batch(batch, pulled=pulled)
+        if t.ps_runtime is not None and t.push_grads_fn is not None:
+            for slot, (ids, rows) in pulled.items():
+                grads = t.push_grads_fn(slot, ids, rows, batch, out)
+                if grads is not None:
+                    t.ps_runtime.push_sparse(t.sparse_tables[slot], ids,
+                                             grads)
+        return out
+
+
+class TrainerBase:
+    """reference trainer.h:59. run() pulls batches from the dataset's
+    feed and fans them over worker threads."""
+
+    worker_cls = HogwildWorker
+
+    def __init__(self, num_workers=2):
+        self.num_workers = max(1, num_workers)
+        self._run_lock = threading.Lock()
+        self.losses = []
+        self._program = None
+        self._exe = None
+        self._fetch = None
+        self.ps_runtime = None
+        self.sparse_tables = {}
+        self.push_grads_fn = None
+
+    def initialize(self, program=None, executor=None, fetch_list=None,
+                   run_fn=None):
+        self._program = program
+        self._exe = executor
+        self._fetch = fetch_list or []
+        self._run_fn = run_fn
+
+    def _run_batch(self, batch, pulled=None):
+        if self._run_fn is not None:
+            return self._run_fn(batch)
+        with self._run_lock:
+            outs = self._exe.run(self._program, feed=batch,
+                                 fetch_list=self._fetch)
+        if outs:
+            self.losses.append(float(np.asarray(outs[0]).reshape(-1)[0]))
+        return outs
+
+    def run(self, batch_iter):
+        q = queue.Queue(maxsize=self.num_workers * 2)
+        stop = object()
+        errors = []
+        abort = threading.Event()
+
+        def worker_loop(wid):
+            w = self.worker_cls(self, wid)
+            while True:
+                item = q.get()
+                if item is stop:
+                    q.put(stop)
+                    return
+                if abort.is_set():
+                    continue  # drain so the producer never blocks
+                try:
+                    w.train_batch(item)
+                except Exception as e:  # propagate to the caller
+                    errors.append(e)
+                    abort.set()
+
+        threads = [threading.Thread(target=worker_loop, args=(i,),
+                                    daemon=True)
+                   for i in range(self.num_workers)]
+        for t in threads:
+            t.start()
+        try:
+            for batch in batch_iter:
+                if abort.is_set():
+                    break
+                while True:
+                    try:
+                        q.put(batch, timeout=0.5)
+                        break
+                    except queue.Full:
+                        if abort.is_set():
+                            break
+        finally:
+            q.put(stop)
+            for t in threads:
+                t.join()
+        if errors:
+            raise errors[0]
+        return self
+
+
+class MultiTrainer(TrainerBase):
+    """reference trainer.h:105 (async CPU PS / plain multi-thread)."""
+
+
+class DistMultiTrainer(TrainerBase):
+    """reference trainer.h:142 — downpour PS training."""
+
+    worker_cls = DownpourWorker
+
+    def set_ps(self, ps_runtime, sparse_tables, push_grads_fn=None):
+        self.ps_runtime = ps_runtime
+        self.sparse_tables = dict(sparse_tables)
+        self.push_grads_fn = push_grads_fn
+        return self
+
+
+class TrainerFactory:
+    """reference trainer_factory.cc."""
+
+    _TRAINERS = {
+        "MultiTrainer": MultiTrainer,
+        "DistMultiTrainer": DistMultiTrainer,
+    }
+
+    def create_trainer(self, name="MultiTrainer", **kwargs):
+        return self._TRAINERS[name](**kwargs)

@@ -417,6 +417,14 @@ def _fetch_tensor(program, f):
     return t
 
 
+def _as_program(program):
+    """Normalize run()/dataset entry points' program argument: a Program,
+    a CompiledProgram wrapper, or None (-> default main)."""
+    if isinstance(program, Program):
+        return program
+    return getattr(program, "program", None) or default_main_program()
+
+
 class Executor:
     """reference python/paddle/fluid/executor.py:921 + StandaloneExecutor.
 
@@ -436,8 +444,7 @@ class Executor:
             outs = program.run(*[feed[n] for n in program.feed_names])
             return [np.asarray(o) for o in outs] if return_numpy \
                 else [Tensor(o) for o in outs]
-        program = program if isinstance(program, Program) else (
-            getattr(program, "program", None) or default_main_program())
+        program = _as_program(program)
         feed = feed or {}
         fetch_list = list(fetch_list or [])
         if not program.tape and not program.feed_vars:
@@ -471,6 +478,36 @@ class Executor:
         if return_numpy:
             return [np.asarray(o) for o in outs]
         return [Tensor(o) for o in outs]
+
+    def train_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        """PS-style dataset training loop (reference
+        Executor::RunFromDataset, executor.cc:163: TrainerFactory +
+        worker threads over a DataFeed'd Dataset). The trainer class
+        comes from program._fleet_opt (reference trainer_desc from the
+        fleet optimizer): {"trainer": "DistMultiTrainer", "ps_runtime":
+        ..., "sparse_tables": {...}, "push_grads_fn": ...} selects the
+        Downpour pull/push workers."""
+        from ..framework.trainer import TrainerFactory
+
+        prog = _as_program(program)
+        fleet_opt = getattr(prog, "_fleet_opt", None) or {}
+        name = fleet_opt.get("trainer", "MultiTrainer")
+        trainer = TrainerFactory().create_trainer(
+            name, num_workers=thread or getattr(dataset, "_thread_num", 2))
+        trainer.initialize(program=prog, executor=self,
+                           fetch_list=fetch_list)
+        if name == "DistMultiTrainer" and "ps_runtime" in fleet_opt:
+            trainer.set_ps(fleet_opt["ps_runtime"],
+                           fleet_opt.get("sparse_tables", {}),
+                           fleet_opt.get("push_grads_fn"))
+        trainer.run(dataset.batches())
+        return trainer
+
+    def infer_from_dataset(self, program=None, dataset=None, **kwargs):
+        infer = _as_program(program).clone(for_test=True)
+        return self.train_from_dataset(infer, dataset, **kwargs)
 
     # -----------------------------------------------------------------
     def _compile(self, program, feed_tensors, fetch_tensors, params, frozen):
