@@ -287,6 +287,27 @@ from .ops.linalg import (  # noqa: F401
     tensordot,
 )
 
+from .core.enforce import (  # noqa: F401
+    EnforceNotMet,
+    InvalidArgumentError,
+    NotFoundError,
+    OutOfRangeError,
+    UnimplementedError,
+    enforce,
+)
+from .core.selected_rows import SelectedRows  # noqa: F401
+from .core.tensor_array import (  # noqa: F401
+    Scope,
+    TensorArray,
+    array_length,
+    array_read,
+    array_write,
+    create_array,
+    global_scope,
+    scope_guard,
+    tensor_array_to_tensor,
+)
+
 from . import amp  # noqa: F401
 from . import autograd  # noqa: F401
 from . import distributed  # noqa: F401

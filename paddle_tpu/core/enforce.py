@@ -1,0 +1,94 @@
+"""Typed error taxonomy + enforce helpers.
+
+Parity: reference PADDLE_ENFORCE macro family (phi/core/enforce.h) and
+the error-code taxonomy (paddle/utils/error.h / platform/errors.h:
+InvalidArgument, NotFound, OutOfRange, AlreadyExists, PermissionDenied,
+ResourceExhausted, PreconditionNotMet, Unimplemented, Unavailable,
+Fatal, ExecutionTimeout) plus the external-error summary formatting.
+Python-native: typed exception classes with the reference's error-
+summary layout so messages are grep-compatible across frameworks.
+"""
+from __future__ import annotations
+
+
+class EnforceNotMet(RuntimeError):
+    """Base (reference enforce.h EnforceNotMet)."""
+
+    code = "LEGACY"
+
+    def __init__(self, msg, hint=None):
+        self.raw_message = msg
+        self.hint = hint
+        super().__init__(self._format(msg, hint))
+
+    @classmethod
+    def _format(cls, msg, hint):
+        out = "\n----------------------\nError Message Summary:\n" \
+              "----------------------\n%sError: %s" % (
+                  cls.__name__.replace("Error", ""), msg)
+        if hint:
+            out += "\n  [Hint: %s]" % hint
+        return out
+
+
+class InvalidArgumentError(EnforceNotMet):
+    code = "INVALID_ARGUMENT"
+
+
+class NotFoundError(EnforceNotMet):
+    code = "NOT_FOUND"
+
+
+class OutOfRangeError(EnforceNotMet):
+    code = "OUT_OF_RANGE"
+
+
+class AlreadyExistsError(EnforceNotMet):
+    code = "ALREADY_EXISTS"
+
+
+class ResourceExhaustedError(EnforceNotMet):
+    code = "RESOURCE_EXHAUSTED"
+
+
+class PreconditionNotMetError(EnforceNotMet):
+    code = "PRECONDITION_NOT_MET"
+
+
+class PermissionDeniedError(EnforceNotMet):
+    code = "PERMISSION_DENIED"
+
+
+class ExecutionTimeoutError(EnforceNotMet):
+    code = "EXECUTION_TIMEOUT"
+
+
+class UnimplementedError(EnforceNotMet):
+    code = "UNIMPLEMENTED"
+
+
+class UnavailableError(EnforceNotMet):
+    code = "UNAVAILABLE"
+
+
+class FatalError(EnforceNotMet):
+    code = "FATAL"
+
+
+def enforce(cond, msg, error_cls=InvalidArgumentError, hint=None):
+    """PADDLE_ENFORCE analog: raise a typed error when cond is false."""
+    if not cond:
+        raise error_cls(msg, hint)
+    return True
+
+
+def enforce_eq(a, b, msg=None, error_cls=InvalidArgumentError):
+    if a != b:
+        raise error_cls(msg or "expected %r == %r" % (a, b))
+    return True
+
+
+def enforce_not_none(v, msg, error_cls=NotFoundError):
+    if v is None:
+        raise error_cls(msg)
+    return v

@@ -1,0 +1,107 @@
+"""SelectedRows — the sparse-gradient representation.
+
+Parity: reference phi/core/selected_rows.h (rows + value tensor +
+height) and its kernel family (merge_selected_rows,
+sgd_dense_param_sparse_grad, adam_dense_param_sparse_grad,
+clip_by_norm_sr). On TPU dense compute paths, XLA scatter-add makes
+dense gradients of embeddings efficient, so SelectedRows is NOT the
+default grad type; it exists for the PS/recommender path where touched
+rows are a tiny fraction of a huge table and for API parity.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+class SelectedRows:
+    """rows: int64 [n] global row ids (duplicates allowed until merge);
+    value: [n, ...dim] row payloads; height: the dense dim-0 extent."""
+
+    def __init__(self, rows, value, height):
+        self.rows = jnp.asarray(rows, jnp.int32)
+        self.value = jnp.asarray(value)
+        self.height = int(height)
+        if self.rows.shape[0] != self.value.shape[0]:
+            raise ValueError(
+                "SelectedRows: %d rows vs %d value rows"
+                % (self.rows.shape[0], self.value.shape[0]))
+
+    @property
+    def shape(self):
+        return (self.height,) + tuple(self.value.shape[1:])
+
+    def to_dense(self):
+        """scatter-add into the dense [height, ...] tensor (reference
+        SelectedRows::Get / sparse->dense copy)."""
+        dense = jnp.zeros(self.shape, self.value.dtype)
+        return dense.at[self.rows].add(self.value)
+
+    def merge(self):
+        """Sum duplicate rows (reference merge_selected_rows kernel —
+        required before optimizer application)."""
+        uniq, inv = np.unique(np.asarray(self.rows), return_inverse=True)
+        merged = jnp.zeros((uniq.size,) + tuple(self.value.shape[1:]),
+                           self.value.dtype)
+        merged = merged.at[jnp.asarray(inv)].add(self.value)
+        return SelectedRows(uniq, merged, self.height)
+
+    def clip_by_norm(self, max_norm):
+        """reference clip_by_norm_sr: clip the GLOBAL norm of the sparse
+        gradient, scaling only the stored rows."""
+        m = self.merge()
+        norm = jnp.sqrt(jnp.sum(m.value.astype(jnp.float32) ** 2))
+        scale = jnp.where(norm > max_norm,
+                          max_norm / jnp.maximum(norm, 1e-12), 1.0)
+        return SelectedRows(m.rows, m.value * scale, self.height)
+
+    def __repr__(self):
+        return "SelectedRows(height=%d, nnz_rows=%d, dim=%s)" % (
+            self.height, int(self.rows.shape[0]),
+            tuple(self.value.shape[1:]))
+
+
+def embedding_sparse_grad(ids, grad_out, vocab_size):
+    """Build the SelectedRows gradient of an embedding lookup (reference
+    embedding_sparse_grad kernel): rows = flattened ids, values =
+    matching grad slices."""
+    ids = jnp.asarray(ids).reshape(-1)
+    g = jnp.asarray(grad_out)
+    dim = g.shape[-1]
+    return SelectedRows(ids, g.reshape(-1, dim), vocab_size)
+
+
+# -- sparse optimizer rules (reference *_dense_param_sparse_grad kernels)
+
+def sgd_sparse(param, grad_sr, lr):
+    """Update only the touched rows: param[rows] -= lr * grad."""
+    m = grad_sr.merge()
+    return param.at[m.rows].add(-lr * m.value.astype(param.dtype))
+
+
+def adam_sparse(param, grad_sr, m_state, v_state, step, lr, beta1=0.9,
+                beta2=0.999, eps=1e-8):
+    """Row-sparse Adam (reference adam_dense_param_sparse_grad): moments
+    update only on touched rows; bias correction uses the global step.
+    Returns (new_param, new_m, new_v)."""
+    sr = grad_sr.merge()
+    rows = sr.rows
+    g = sr.value.astype(jnp.float32)
+    m_rows = m_state[rows] * beta1 + (1 - beta1) * g
+    v_rows = v_state[rows] * beta2 + (1 - beta2) * g * g
+    bc1 = 1.0 - beta1 ** step
+    bc2 = 1.0 - beta2 ** step
+    upd = lr * (m_rows / bc1) / (jnp.sqrt(v_rows / bc2) + eps)
+    return (param.at[rows].add(-upd.astype(param.dtype)),
+            m_state.at[rows].set(m_rows),
+            v_state.at[rows].set(v_rows))
+
+
+def merge_selected_rows(sr):
+    return sr.merge()
+
+
+def get_tensor_from_selected_rows(sr):
+    return sr.to_dense()

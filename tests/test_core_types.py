@@ -1,0 +1,107 @@
+"""Core tensor types + scope + errors: SelectedRows (sparse grads +
+sparse optimizer rules), TensorArray/array ops, hierarchical Scope,
+typed enforce errors (reference phi/core/selected_rows.h,
+tensor_array.h, framework/scope.h, enforce.h).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.selected_rows import (
+    SelectedRows,
+    adam_sparse,
+    embedding_sparse_grad,
+    sgd_sparse,
+)
+
+import jax.numpy as jnp
+
+
+class TestSelectedRows:
+    def test_to_dense_and_merge(self):
+        sr = SelectedRows([1, 3, 1], np.array([[1., 1.], [2., 2.],
+                                               [3., 3.]], np.float32), 5)
+        dense = np.asarray(sr.to_dense())
+        np.testing.assert_allclose(dense[1], [4., 4.])
+        np.testing.assert_allclose(dense[3], [2., 2.])
+        np.testing.assert_allclose(dense[0], 0.0)
+        m = sr.merge()
+        assert m.rows.shape[0] == 2
+
+    def test_embedding_sparse_grad_matches_dense(self):
+        ids = np.array([[0, 2], [2, 1]], np.int64)
+        gout = np.random.RandomState(0).randn(2, 2, 4).astype(np.float32)
+        sr = embedding_sparse_grad(ids, gout, vocab_size=6)
+        dense = np.zeros((6, 4), np.float32)
+        for b in range(2):
+            for s in range(2):
+                dense[ids[b, s]] += gout[b, s]
+        np.testing.assert_allclose(np.asarray(sr.to_dense()), dense,
+                                   rtol=1e-6)
+
+    def test_sgd_sparse_touches_only_rows(self):
+        p = jnp.ones((6, 3), jnp.float32)
+        sr = SelectedRows([2, 4], np.ones((2, 3), np.float32), 6)
+        out = np.asarray(sgd_sparse(p, sr, lr=0.5))
+        np.testing.assert_allclose(out[2], 0.5)
+        np.testing.assert_allclose(out[4], 0.5)
+        np.testing.assert_allclose(out[0], 1.0)
+
+    def test_adam_sparse_matches_dense_adam_on_rows(self):
+        rng = np.random.RandomState(1)
+        p = jnp.asarray(rng.randn(4, 2).astype(np.float32))
+        g = rng.randn(1, 2).astype(np.float32)
+        sr = SelectedRows([1], g, 4)
+        m = jnp.zeros((4, 2)); v = jnp.zeros((4, 2))
+        newp, m2, v2 = adam_sparse(p, sr, m, v, step=1, lr=0.01)
+        # first adam step: delta == -lr * sign(g)
+        np.testing.assert_allclose(np.asarray(newp[1] - p[1]),
+                                   -0.01 * np.sign(g[0]), rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(newp[0]), np.asarray(p[0]))
+
+    def test_clip_by_norm(self):
+        sr = SelectedRows([0, 1], np.full((2, 2), 3.0, np.float32), 4)
+        clipped = sr.clip_by_norm(1.0)
+        total = np.linalg.norm(np.asarray(clipped.value))
+        np.testing.assert_allclose(total, 1.0, rtol=1e-5)
+
+
+class TestTensorArray:
+    def test_array_ops_roundtrip(self):
+        arr = paddle.create_array()
+        for i in range(3):
+            paddle.array_write(paddle.to_tensor(
+                np.full((2,), float(i), np.float32)), i, arr)
+        assert paddle.array_length(arr) == 3
+        np.testing.assert_allclose(
+            np.asarray(paddle.array_read(arr, 1)._value), 1.0)
+        stacked, n = paddle.tensor_array_to_tensor(arr)
+        assert n == 3 and tuple(stacked.shape) == (3, 2)
+        back = paddle.TensorArray.unstack(stacked)
+        np.testing.assert_allclose(np.asarray(back[2]._value), 2.0)
+
+
+class TestScope:
+    def test_hierarchy_and_guard(self):
+        s = paddle.Scope()
+        s.var("w").set(paddle.to_tensor(np.ones(2, np.float32)))
+        kid = s.new_scope()
+        assert kid.find_var("w") is not None          # parent lookup
+        kid.var("local").set(1)
+        assert s.find_var("local") is None            # no child leak
+        with paddle.scope_guard(s) as sc:
+            assert paddle.global_scope() is s
+        assert paddle.global_scope() is not s
+
+
+class TestEnforce:
+    def test_typed_errors(self):
+        with pytest.raises(paddle.InvalidArgumentError) as e:
+            paddle.enforce(False, "bad dim", hint="check shapes")
+        assert "Error Message Summary" in str(e.value)
+        assert "bad dim" in str(e.value)
+        assert "check shapes" in str(e.value)
+        with pytest.raises(paddle.NotFoundError):
+            from paddle_tpu.core.enforce import enforce_not_none
+
+            enforce_not_none(None, "missing var")
