@@ -29,7 +29,11 @@ from .core.dtype import (  # noqa: F401
 from .core.place import (  # noqa: F401
     CPUPlace,
     CUDAPlace,
+    CustomPlace,
     TPUPlace,
+    get_all_custom_device_type,
+    is_compiled_with_custom_device,
+    register_custom_device,
     device_count,
     get_device,
     is_compiled_with_cuda,

@@ -64,6 +64,9 @@ def _devices_by_type(device_type: str):
             return tuple(jax.devices("cpu"))
         except RuntimeError:
             return tuple(jax.devices())
+    if device_type.startswith("custom:"):
+        # a registered PJRT plugin's OWN devices — never another backend
+        return tuple(jax.devices(device_type.split(":", 1)[1]))
     # "tpu" means "the accelerator backend" — whatever PJRT says is default.
     devs = tuple(d for d in jax.devices() if d.platform != "cpu")
     return devs or tuple(jax.devices())
@@ -114,3 +117,58 @@ def _get_current_place() -> Place:
             CPUPlace() if devs[0].platform == "cpu" else TPUPlace(0)
         )
     return _current_place
+
+
+# -- custom-device plugin ABI ------------------------------------------------
+#
+# Parity: reference DeviceInterface plugin runtime
+# (phi/backends/custom/custom_device.cc, device_base.h:31 — ~50 virtuals
+# for memory/stream/event/CCL, registered from a dlopen'd vendor .so).
+# TPU-native: PJRT *is* the device plugin ABI — a vendor ships a PJRT
+# plugin .so and jax loads it; memory/streams/events/collectives all come
+# through the PJRT C API, so the reference's hand-rolled virtual table is
+# the part XLA already standardized.
+
+_custom_devices = {}
+
+
+class CustomPlace(Place):
+    """reference phi::CustomPlace (plugin device placement)."""
+
+    def __init__(self, device_type, device_id=0):
+        super().__init__(device_id)
+        self.device_type = "custom:%s" % device_type
+        self.custom_type = device_type
+
+
+def register_custom_device(device_type, pjrt_plugin_path, options=None):
+    """Register a PJRT plugin .so as a custom device backend (reference
+    DeviceManager::Register + LoadCustomRuntimeLib,
+    phi/backends/custom/custom_device.cc:1040).
+
+    Must run BEFORE any jax backend initialization — PJRT plugin
+    discovery is frozen at first use (the reference dlopens vendor libs
+    at framework init for the same reason)."""
+    from jax._src import xla_bridge
+
+    if xla_bridge.backends_are_initialized():
+        raise RuntimeError(
+            "register_custom_device(%r) called after the JAX runtime "
+            "initialized; plugin discovery is frozen at first backend "
+            "use. Register custom devices before any op/mesh/device "
+            "call (e.g. right after import)." % device_type)
+    xla_bridge.register_plugin(device_type,
+                               library_path=pjrt_plugin_path,
+                               options=options or {})
+    _custom_devices[device_type] = pjrt_plugin_path
+    _devices_by_type.cache_clear()
+    return CustomPlace(device_type, 0)
+
+
+def get_all_custom_device_type():
+    """reference paddle.device.get_all_custom_device_type."""
+    return sorted(_custom_devices)
+
+
+def is_compiled_with_custom_device(device_type):
+    return device_type in _custom_devices

@@ -3,6 +3,7 @@ from . import (  # noqa: F401
     auto_parallel,
     collective,
     passes,
+    checkpoint,
     fleet_executor,
     elastic,
     env,
