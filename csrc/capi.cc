@@ -1,0 +1,229 @@
+// C inference API — embed the predictor behind a plain C ABI.
+//
+// Parity: reference paddle/fluid/inference/capi_exp/ (pd_inference_api.h:
+// PD_PredictorCreate / PD_PredictorRun / PD_TensorCopyFromCpuFloat ...)
+// and goapi/ which binds the same C surface.
+//
+// TPU-native design: the compute path is a saved StableHLO module
+// executed by the XLA runtime via the Python predictor
+// (paddle_tpu.inference.Predictor). A C/C++/Go application links this
+// library (libpaddle_tpu_capi.so) and the implementation EMBEDS the
+// CPython interpreter to drive that predictor — the pragmatic native
+// bridge when the runtime itself lives behind PJRT. The C surface is
+// reference-shaped: config -> predictor -> named float tensors -> run.
+//
+// Build: make -C csrc capi    (links libpython; separate from the core
+// runtime .so, which stays interpreter-free).
+#include <Python.h>
+
+#include "pt_capi.h"  // keep impl signatures checked against the ABI
+
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+std::mutex g_mu;
+bool g_inited = false;
+
+struct PtPredictor {
+  PyObject* predictor = nullptr;            // paddle_tpu Predictor
+  std::vector<std::string> input_names;
+  std::vector<std::string> output_names;
+  std::map<std::string, std::vector<float>> inputs;
+  std::map<std::string, std::vector<int64_t>> input_shapes;
+  std::map<std::string, std::vector<float>> outputs;
+  std::map<std::string, std::vector<int64_t>> output_shapes;
+};
+
+void ensure_python() {
+  if (!g_inited) {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      // release the GIL the init left us holding, or every other
+      // thread's PyGILState_Ensure deadlocks behind this one
+      PyEval_SaveThread();
+    }
+    g_inited = true;
+  }
+}
+
+// run `expr` with {"p": predictor, ...locals}; the bindings go into
+// GLOBALS (lambda bodies resolve free names via globals, not the eval's
+// locals mapping)
+PyObject* py_eval(const char* code, PyObject* locals) {
+  PyDict_SetItemString(locals, "__builtins__", PyEval_GetBuiltins());
+  PyObject* out = PyRun_String(code, Py_eval_input, locals, locals);
+  return out;
+}
+
+std::vector<std::string> pylist_to_strings(PyObject* lst) {
+  std::vector<std::string> out;
+  for (Py_ssize_t i = 0; i < PyList_Size(lst); ++i) {
+    PyObject* it = PyList_GetItem(lst, i);
+    const char* s = it ? PyUnicode_AsUTF8(it) : nullptr;
+    if (s == nullptr) PyErr_Clear();
+    out.push_back(s ? s : "");
+  }
+  return out;
+}
+
+}  // namespace
+
+extern "C" {
+
+// returns a predictor handle or nullptr (error printed to stderr)
+void* pt_predictor_create(const char* model_prefix) {
+  std::lock_guard<std::mutex> l(g_mu);
+  ensure_python();
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PtPredictor* p = new PtPredictor();
+  PyObject* locals = PyDict_New();
+  PyObject* prefix = PyUnicode_FromString(model_prefix);
+  PyDict_SetItemString(locals, "prefix", prefix);
+  Py_DECREF(prefix);
+  const char* mk =
+      "(lambda inf: inf.create_predictor(inf.Config(prefix)))"
+      "(__import__('paddle_tpu.inference', fromlist=['inference']))";
+  p->predictor = py_eval(mk, locals);
+  if (p->predictor == nullptr) {
+    PyErr_Print();
+    Py_DECREF(locals);
+    PyGILState_Release(gil);
+    delete p;
+    return nullptr;
+  }
+  PyDict_SetItemString(locals, "p", p->predictor);
+  PyObject* ins = py_eval("p.get_input_names()", locals);
+  if (ins == nullptr) PyErr_Print();
+  PyObject* outs = py_eval("p.get_output_names()", locals);
+  if (outs == nullptr) PyErr_Print();
+  if (ins) p->input_names = pylist_to_strings(ins);
+  if (outs) p->output_names = pylist_to_strings(outs);
+  Py_XDECREF(ins);
+  Py_XDECREF(outs);
+  Py_DECREF(locals);
+  PyGILState_Release(gil);
+  return p;
+}
+
+int pt_predictor_num_inputs(void* h) {
+  return static_cast<PtPredictor*>(h)->input_names.size();
+}
+
+int pt_predictor_num_outputs(void* h) {
+  return static_cast<PtPredictor*>(h)->output_names.size();
+}
+
+const char* pt_predictor_input_name(void* h, int i) {
+  return static_cast<PtPredictor*>(h)->input_names[i].c_str();
+}
+
+const char* pt_predictor_output_name(void* h, int i) {
+  return static_cast<PtPredictor*>(h)->output_names[i].c_str();
+}
+
+// PD_TensorCopyFromCpuFloat analog
+void pt_tensor_copy_from_cpu_float(void* h, const char* name,
+                                   const float* data, const int64_t* shape,
+                                   int ndim) {
+  auto* p = static_cast<PtPredictor*>(h);
+  int64_t n = 1;
+  std::vector<int64_t> shp(shape, shape + ndim);
+  for (int64_t d : shp) n *= d;
+  p->inputs[name].assign(data, data + n);
+  p->input_shapes[name] = shp;
+}
+
+int pt_predictor_run(void* h) {
+  auto* p = static_cast<PtPredictor*>(h);
+  std::lock_guard<std::mutex> l(g_mu);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* locals = PyDict_New();
+  PyDict_SetItemString(locals, "p", p->predictor);
+  // stage inputs as (bytes, shape) tuples -> numpy in python
+  PyObject* feed = PyDict_New();
+  for (auto& name : p->input_names) {
+    auto& buf = p->inputs[name];
+    auto& shp = p->input_shapes[name];
+    PyObject* bytes = PyBytes_FromStringAndSize(
+        reinterpret_cast<const char*>(buf.data()),
+        static_cast<Py_ssize_t>(buf.size() * sizeof(float)));
+    PyObject* shape = PyList_New(shp.size());
+    for (size_t i = 0; i < shp.size(); ++i)
+      PyList_SetItem(shape, i, PyLong_FromLongLong(shp[i]));
+    PyObject* pair = PyTuple_Pack(2, bytes, shape);
+    PyDict_SetItemString(feed, name.c_str(), pair);
+    Py_DECREF(bytes);
+    Py_DECREF(shape);
+    Py_DECREF(pair);
+  }
+  PyDict_SetItemString(locals, "feed", feed);
+  Py_DECREF(feed);
+  const char* run =
+      "(lambda np, p, feed: [np.ascontiguousarray(o, np.float32)"
+      " for o in p.run([np.frombuffer(b, np.float32).reshape(s)"
+      "  for b, s in (feed[n] for n in p.get_input_names())])])"
+      "(__import__('numpy'), p, feed)";
+  PyObject* outs = py_eval(run, locals);
+  int rc = 0;
+  if (outs == nullptr) {
+    PyErr_Print();
+    rc = -1;
+  } else {
+    for (Py_ssize_t i = 0; i < PyList_Size(outs); ++i) {
+      PyObject* arr = PyList_GetItem(outs, i);
+      PyObject* tob = PyObject_CallMethod(arr, "tobytes", nullptr);
+      PyObject* shp = PyObject_GetAttrString(arr, "shape");
+      const char* name = p->output_names[i].c_str();
+      char* raw;
+      Py_ssize_t nbytes;
+      PyBytes_AsStringAndSize(tob, &raw, &nbytes);
+      auto& dst = p->outputs[name];
+      dst.assign(reinterpret_cast<float*>(raw),
+                 reinterpret_cast<float*>(raw + nbytes));
+      auto& ds = p->output_shapes[name];
+      ds.clear();
+      for (Py_ssize_t d = 0; d < PyTuple_Size(shp); ++d)
+        ds.push_back(PyLong_AsLongLong(PyTuple_GetItem(shp, d)));
+      Py_DECREF(tob);
+      Py_DECREF(shp);
+    }
+    Py_DECREF(outs);
+  }
+  Py_DECREF(locals);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int pt_tensor_ndim(void* h, const char* name) {
+  auto* p = static_cast<PtPredictor*>(h);
+  return p->output_shapes[name].size();
+}
+
+void pt_tensor_shape(void* h, const char* name, int64_t* out) {
+  auto* p = static_cast<PtPredictor*>(h);
+  auto& s = p->output_shapes[name];
+  std::copy(s.begin(), s.end(), out);
+}
+
+void pt_tensor_copy_to_cpu_float(void* h, const char* name, float* out) {
+  auto* p = static_cast<PtPredictor*>(h);
+  auto& s = p->outputs[name];
+  std::memcpy(out, s.data(), s.size() * sizeof(float));
+}
+
+void pt_predictor_destroy(void* h) {
+  auto* p = static_cast<PtPredictor*>(h);
+  std::lock_guard<std::mutex> l(g_mu);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  Py_XDECREF(p->predictor);
+  PyGILState_Release(gil);
+  delete p;
+}
+
+}  // extern "C"

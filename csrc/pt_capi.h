@@ -1,0 +1,38 @@
+/* C inference API (reference paddle/fluid/inference/capi_exp/
+ * pd_inference_api.h surface, TPU-native implementation in capi.cc).
+ *
+ * Usage:
+ *   void* p = pt_predictor_create("/path/to/saved/model_prefix");
+ *   pt_tensor_copy_from_cpu_float(p, name, data, shape, ndim);
+ *   pt_predictor_run(p);
+ *   pt_tensor_copy_to_cpu_float(p, out_name, out_buf);
+ *   pt_predictor_destroy(p);
+ */
+#ifndef PADDLE_TPU_CAPI_H_
+#define PADDLE_TPU_CAPI_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+void* pt_predictor_create(const char* model_prefix);
+int pt_predictor_num_inputs(void* h);
+int pt_predictor_num_outputs(void* h);
+const char* pt_predictor_input_name(void* h, int i);
+const char* pt_predictor_output_name(void* h, int i);
+void pt_tensor_copy_from_cpu_float(void* h, const char* name,
+                                   const float* data, const int64_t* shape,
+                                   int ndim);
+int pt_predictor_run(void* h);
+int pt_tensor_ndim(void* h, const char* name);
+void pt_tensor_shape(void* h, const char* name, int64_t* out);
+void pt_tensor_copy_to_cpu_float(void* h, const char* name, float* out);
+void pt_predictor_destroy(void* h);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif  /* PADDLE_TPU_CAPI_H_ */

@@ -28,8 +28,10 @@ _CSRC = os.path.join(_REPO_ROOT, "csrc")
 
 def _build():
     # single source of truth: every .cc in csrc/ (mirrors csrc/Makefile)
+    # EXCEPT capi.cc — the C inference API embeds CPython and builds as
+    # its own .so via `make -C csrc capi`
     srcs = sorted(os.path.join(_CSRC, f) for f in os.listdir(_CSRC)
-                  if f.endswith(".cc"))
+                  if f.endswith(".cc") and f != "capi.cc")
     os.makedirs(os.path.dirname(_LIB_PATH), exist_ok=True)
     cmd = ["g++", "-O2", "-std=c++17", "-fPIC", "-Wall", "-pthread",
            "-shared", "-o", _LIB_PATH] + srcs
@@ -43,7 +45,8 @@ def _needs_rebuild():
     try:
         return any(
             os.path.getmtime(os.path.join(_CSRC, f)) > lib_mtime
-            for f in os.listdir(_CSRC) if f.endswith(".cc"))
+            for f in os.listdir(_CSRC)
+            if f.endswith(".cc") and f != "capi.cc")
     except OSError:
         return False
 

@@ -97,6 +97,11 @@ class Predictor:
 
         self._config = config
         prog, feeds, fetches = load_inference_model(config._prefix)
+        # anonymous saved vars get stable synthesized names (the C API
+        # and handle lookups need real strings)
+        feeds = [n if n else "feed_%d" % i for i, n in enumerate(feeds)]
+        fetches = [n if n else "fetch_%d" % i
+                   for i, n in enumerate(fetches)]
         self._prog = prog
         self._inputs = {n: _TensorHandle(n) for n in feeds}
         self._outputs = {n: _TensorHandle(n) for n in fetches}
