@@ -9,12 +9,32 @@ A100 figure for a same-size model when available; absent that it reports 1.0.
 from __future__ import annotations
 
 import json
+import os
+import signal
 import time
 
 import numpy as np
 
 
+def _watchdog(seconds=1500):
+    """Hard exit if the TPU tunnel wedges mid-bench: a hung bench is
+    worse for the driver than a failed one. No output is fabricated —
+    we exit non-zero with a diagnostic on stderr."""
+
+    def fire(signum, frame):
+        import sys
+
+        sys.stderr.write(
+            "bench.py watchdog: no result after %ds (TPU tunnel "
+            "unresponsive?); aborting\n" % seconds)
+        os._exit(3)
+
+    signal.signal(signal.SIGALRM, fire)
+    signal.alarm(seconds)
+
+
 def main():
+    _watchdog()
     import jax
 
     import paddle_tpu as paddle

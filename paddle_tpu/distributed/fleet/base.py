@@ -73,6 +73,33 @@ class DistributedStrategy:
         self.fuse_all_reduce_ops = True  # XLA fuses; accepted for compat
         self.fuse_grad_size_in_MB = 32
         self.nccl_comm_num = 1
+        # remaining distributed_strategy.proto knobs accepted so
+        # reference configs load unchanged; each is either subsumed by
+        # the compiled-SPMD design or routed by the meta-optimizers
+        self.sync_nccl_allreduce = True       # XLA schedules collectives
+        self.sync_batch_norm = False          # SyncBatchNorm layer covers
+        self.cudnn_exhaustive_search = False  # no cudnn; XLA autotunes
+        self.cudnn_batchnorm_spatial_persistent = False
+        self.conv_workspace_size_limit = 512
+        self.adaptive_localsgd = False
+        self.localsgd_configs = {"k_steps": 1, "begin_step": 1}
+        self.dgc_configs = {"rampup_begin_step": 0}
+        self.lars_configs = {"lars_coeff": 0.001, "lars_weight_decay": 5e-4}
+        self.lamb_configs = {"lamb_weight_decay": 0.01}
+        self.asp = False                      # incubate.asp covers
+        self.qat = False                      # paddle_tpu.quantization
+        self.qat_configs = {}
+        self.heter_pipeline_opt = None
+        self.gradient_merge_avg = True
+        self.last_comm_group_size_MB = 1
+        self.calc_comm_same_stream = True     # one XLA program anyway
+        self.use_hierarchical_allreduce = False  # ICI torus needs none
+        self.hierarchical_allreduce_inter_nranks = 1
+        self.elastic = False
+        self.auto_search = False
+        self.fuse_grad_merge = True
+        self.is_fl_ps_mode = False
+        self.with_coordinator = False
 
     def __repr__(self):
         keys = ["amp", "recompute", "pipeline", "tensor_parallel", "sharding",
