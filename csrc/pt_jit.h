@@ -1,0 +1,107 @@
+// C++ jit::Layer — load and run a jit.save'd model from C++.
+//
+// Parity: reference paddle/fluid/jit/ (layer.h jit::Layer, engine/ — the
+// TorchScript-like C++ loader for jit.save artifacts; function_utils).
+// Header-only RAII wrapper over the C inference ABI (pt_capi.h /
+// libpaddle_tpu_capi.so): Layer::Load(prefix) -> layer.Forward(inputs).
+#ifndef PADDLE_TPU_JIT_H_
+#define PADDLE_TPU_JIT_H_
+
+#include <cstdint>
+#include <stdexcept>
+#include <string>
+#include <utility>
+#include <vector>
+
+#include "pt_capi.h"
+
+namespace paddle_tpu {
+namespace jit {
+
+struct Tensor {
+  std::vector<int64_t> shape;
+  std::vector<float> data;
+};
+
+class Layer {
+ public:
+  static Layer Load(const std::string& model_prefix) {
+    void* h = pt_predictor_create(model_prefix.c_str());
+    if (h == nullptr) {
+      throw std::runtime_error("jit::Layer: failed to load " +
+                               model_prefix);
+    }
+    return Layer(h);
+  }
+
+  Layer(Layer&& o) noexcept : h_(o.h_) { o.h_ = nullptr; }
+  Layer& operator=(Layer&& o) noexcept {
+    std::swap(h_, o.h_);
+    return *this;
+  }
+  Layer(const Layer&) = delete;
+  Layer& operator=(const Layer&) = delete;
+
+  ~Layer() {
+    if (h_ != nullptr) pt_predictor_destroy(h_);
+  }
+
+  std::vector<std::string> InputNames() const {
+    std::vector<std::string> out;
+    for (int i = 0; i < pt_predictor_num_inputs(h_); ++i)
+      out.push_back(pt_predictor_input_name(h_, i));
+    return out;
+  }
+
+  std::vector<std::string> OutputNames() const {
+    std::vector<std::string> out;
+    for (int i = 0; i < pt_predictor_num_outputs(h_); ++i)
+      out.push_back(pt_predictor_output_name(h_, i));
+    return out;
+  }
+
+  // inputs in InputNames() order (reference jit::Layer::forward)
+  std::vector<Tensor> Forward(const std::vector<Tensor>& inputs) {
+    auto in_names = InputNames();
+    if (inputs.size() != in_names.size()) {
+      throw std::invalid_argument("jit::Layer: expected " +
+                                  std::to_string(in_names.size()) +
+                                  " inputs");
+    }
+    for (size_t i = 0; i < inputs.size(); ++i) {
+      pt_tensor_copy_from_cpu_float(
+          h_, in_names[i].c_str(), inputs[i].data.data(),
+          inputs[i].shape.data(),
+          static_cast<int>(inputs[i].shape.size()));
+    }
+    if (pt_predictor_run(h_) != 0) {
+      throw std::runtime_error("jit::Layer: run failed");
+    }
+    std::vector<Tensor> outs;
+    for (const auto& name : OutputNames()) {
+      Tensor t;
+      int nd = pt_tensor_ndim(h_, name.c_str());
+      t.shape.resize(nd);
+      pt_tensor_shape(h_, name.c_str(), t.shape.data());
+      int64_t total = 1;
+      for (int64_t d : t.shape) total *= d;
+      t.data.resize(total);
+      pt_tensor_copy_to_cpu_float(h_, name.c_str(), t.data.data());
+      outs.push_back(std::move(t));
+    }
+    return outs;
+  }
+
+ private:
+  explicit Layer(void* h) : h_(h) {}
+  void* h_ = nullptr;
+};
+
+inline Layer Load(const std::string& model_prefix) {
+  return Layer::Load(model_prefix);
+}
+
+}  // namespace jit
+}  // namespace paddle_tpu
+
+#endif  // PADDLE_TPU_JIT_H_

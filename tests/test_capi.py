@@ -101,3 +101,64 @@ class TestCAPI:
                        np.float32).reshape(want.shape)
         np.testing.assert_allclose(got, np.asarray(want), rtol=1e-5,
                                    atol=1e-6)
+
+
+@pytest.mark.skipif(not os.path.exists(
+    os.path.join(REPO, "paddle_tpu", "lib", "libpaddle_tpu_capi.so")),
+    reason="capi lib not built")
+class TestCppJitLayer:
+    CPP = r"""
+#include <cstdio>
+#include "pt_jit.h"
+int main(int argc, char** argv) {
+  auto layer = paddle_tpu::jit::Load(argv[1]);
+  paddle_tpu::jit::Tensor in;
+  in.shape = {2, 4};
+  for (int i = 0; i < 8; ++i) in.data.push_back((float)i);
+  auto outs = layer.Forward({in});
+  for (float v : outs[0].data) printf("%.6f\n", v);
+  return 0;
+}
+"""
+
+    def test_cpp_layer_matches_python(self, tmp_path):
+        import paddle_tpu.inference as inf
+
+        paddle.seed(0)
+        static.enable_static()
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [2, 4], "float32")
+            y = nn.Linear(4, 3)(x).tanh()
+        exe = static.Executor()
+        exe.run(startup)
+        prefix = str(tmp_path / "m")
+        static.save_inference_model(prefix, [x], [y], exe, program=main)
+        static.disable_static()
+        pred = inf.create_predictor(inf.Config(prefix))
+        xin = np.arange(8, dtype=np.float32).reshape(2, 4)
+        (want,) = pred.run([xin])
+
+        src = tmp_path / "drv.cc"
+        src.write_text(self.CPP)
+        exe_path = str(tmp_path / "drv")
+        libdir = os.path.join(REPO, "paddle_tpu", "lib")
+        r = subprocess.run(
+            ["g++", "-std=c++17", "-o", exe_path, str(src),
+             "-I", os.path.join(REPO, "csrc"),
+             "-L", libdir, "-lpaddle_tpu_capi",
+             "-Wl,-rpath," + libdir],
+            capture_output=True, text=True)
+        assert r.returncode == 0, r.stderr
+        env = dict(os.environ)
+        env.update({"PYTHONPATH": REPO + os.pathsep
+                    + env.get("PYTHONPATH", ""),
+                    "JAX_PLATFORMS": "cpu"})
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        out = subprocess.run([exe_path, prefix], env=env,
+                             capture_output=True, text=True, timeout=240)
+        assert out.returncode == 0, (out.stdout[-500:], out.stderr[-1500:])
+        got = np.array([float(l) for l in out.stdout.split()],
+                       np.float32).reshape(np.asarray(want).shape)
+        np.testing.assert_allclose(got, np.asarray(want), rtol=1e-5,
+                                   atol=1e-6)
