@@ -36,6 +36,17 @@ def spectral_norm_weight(weight, u, v, dim=0, power_iters=1, eps=1e-12):
             uu.astype(w.dtype), vv.astype(w.dtype))
 
 
+@primitive
+def weight_norm_apply(v, g, dim=0):
+    """w = g * v / ||v|| per dim-slice (reference weight_norm op)."""
+    mvt = jnp.moveaxis(_A(v), dim, 0)
+    ft = mvt.reshape(mvt.shape[0], -1)
+    nt = ft / jnp.maximum(
+        jnp.linalg.norm(ft, axis=1, keepdims=True), 1e-12)
+    out = nt * _A(g)[:, None]
+    return jnp.moveaxis(out.reshape(mvt.shape), 0, dim)
+
+
 class _SpectralNormHook:
     def __init__(self, layer, name, n_power_iterations, eps, dim):
         self.name = name
@@ -101,22 +112,7 @@ def weight_norm(layer, name="weight", dim=0):
     def hook(l, inputs):
         v = getattr(l, name + "_v")
         g = getattr(l, name + "_g")
-        vv = _A(v._value) if isinstance(v, Tensor) else _A(v)
-        mv = jnp.moveaxis(_A(vv), dim, 0)
-        flat = mv.reshape(mv.shape[0], -1)
-        normed = flat / jnp.maximum(
-            jnp.linalg.norm(flat, axis=1, keepdims=True), 1e-12)
-
-        @primitive(name="weight_norm_apply")
-        def _apply(vt, gt):
-            mvt = jnp.moveaxis(_A(vt), dim, 0)
-            ft = mvt.reshape(mvt.shape[0], -1)
-            nt = ft / jnp.maximum(
-                jnp.linalg.norm(ft, axis=1, keepdims=True), 1e-12)
-            out = nt * _A(gt)[:, None]
-            return jnp.moveaxis(out.reshape(mvt.shape), 0, dim)
-
-        setattr(l, name, _apply(v, g))
+        setattr(l, name, weight_norm_apply(v, g, dim=dim))
         return None
 
     layer.register_forward_pre_hook(hook)
