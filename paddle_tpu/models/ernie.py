@@ -1,0 +1,187 @@
+"""ERNIE-style bidirectional transformer encoder (BASELINE.md north star
+"ERNIE-3.0-base tokens/sec/chip").
+
+Reference shape: the ERNIE family in the Paddle ecosystem is a
+BERT-style encoder (token+position+segment embeddings, post-LN
+transformer blocks, pooler, MLM + NSP/SOP heads) built on
+paddle.nn.TransformerEncoder (reference python/paddle/nn/layer/
+transformer.py). TPU-native: bidirectional attention through the same
+flash kernel (causal=False), mpu-sharded projections under 'mp', batch
+over 'dp' — the whole pretraining step compiles to one XLA module via
+CompiledTrainStep.
+"""
+from __future__ import annotations
+
+from ..nn import functional as F
+from ..nn.layer import Layer
+from ..nn.layers.common import Dropout, Embedding, Linear
+from ..nn.layers.container import LayerList
+from ..nn.layers.norm import LayerNorm
+from ..parallel.mp_layers import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+
+
+class ErnieConfig:
+    def __init__(self, vocab_size=40000, hidden_size=768,
+                 num_hidden_layers=12, num_attention_heads=12,
+                 intermediate_size=3072, max_position_embeddings=512,
+                 type_vocab_size=4, hidden_dropout_prob=0.1,
+                 use_parallel=False, dtype="float32"):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.intermediate_size = intermediate_size
+        self.max_position_embeddings = max_position_embeddings
+        self.type_vocab_size = type_vocab_size
+        self.hidden_dropout_prob = hidden_dropout_prob
+        self.use_parallel = use_parallel
+        self.dtype = dtype
+
+    @classmethod
+    def tiny(cls, **kw):
+        d = dict(vocab_size=128, hidden_size=32, num_hidden_layers=2,
+                 num_attention_heads=4, intermediate_size=64,
+                 max_position_embeddings=64, type_vocab_size=2,
+                 hidden_dropout_prob=0.0)
+        d.update(kw)
+        return cls(**d)
+
+    @classmethod
+    def base(cls, **kw):  # ERNIE-3.0-base geometry
+        return cls(**kw)
+
+
+class ErnieSelfAttention(Layer):
+    def __init__(self, c):
+        super().__init__()
+        self.heads = c.num_attention_heads
+        self.head_dim = c.hidden_size // c.num_attention_heads
+        Lin = (lambda i, o: ColumnParallelLinear(i, o, gather_output=False)
+               ) if c.use_parallel else Linear
+        self.q_proj = Lin(c.hidden_size, c.hidden_size)
+        self.k_proj = Lin(c.hidden_size, c.hidden_size)
+        self.v_proj = Lin(c.hidden_size, c.hidden_size)
+        if c.use_parallel:
+            self.out_proj = RowParallelLinear(
+                c.hidden_size, c.hidden_size, input_is_parallel=True)
+        else:
+            self.out_proj = Linear(c.hidden_size, c.hidden_size)
+
+    def forward(self, x, attn_mask=None):
+        b, s, h = x.shape
+        q = self.q_proj(x).reshape([b, s, self.heads, self.head_dim])
+        k = self.k_proj(x).reshape([b, s, self.heads, self.head_dim])
+        v = self.v_proj(x).reshape([b, s, self.heads, self.head_dim])
+        # bidirectional: flash kernel with causal=False
+        out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
+                                             is_causal=False)
+        out = out.reshape([b, s, h])
+        return self.out_proj(out)
+
+
+class ErnieLayer(Layer):
+    """Post-LN block (BERT/ERNIE convention, unlike Llama's pre-LN)."""
+
+    def __init__(self, c):
+        super().__init__()
+        self.attn = ErnieSelfAttention(c)
+        self.ln1 = LayerNorm(c.hidden_size)
+        self.ln2 = LayerNorm(c.hidden_size)
+        if c.use_parallel:
+            self.fc1 = ColumnParallelLinear(
+                c.hidden_size, c.intermediate_size, gather_output=False)
+            self.fc2 = RowParallelLinear(
+                c.intermediate_size, c.hidden_size,
+                input_is_parallel=True)
+        else:
+            self.fc1 = Linear(c.hidden_size, c.intermediate_size)
+            self.fc2 = Linear(c.intermediate_size, c.hidden_size)
+        self.dropout = Dropout(c.hidden_dropout_prob)
+
+    def forward(self, x, attn_mask=None):
+        x = self.ln1(x + self.dropout(self.attn(x, attn_mask)))
+        x = self.ln2(x + self.dropout(self.fc2(F.gelu(self.fc1(x)))))
+        return x
+
+
+class ErnieModel(Layer):
+    def __init__(self, config):
+        super().__init__()
+        c = config
+        self.config = c
+        Emb = VocabParallelEmbedding if c.use_parallel else Embedding
+        self.word_embeddings = Emb(c.vocab_size, c.hidden_size)
+        self.position_embeddings = Embedding(c.max_position_embeddings,
+                                             c.hidden_size)
+        self.token_type_embeddings = Embedding(c.type_vocab_size,
+                                               c.hidden_size)
+        self.embed_ln = LayerNorm(c.hidden_size)
+        self.embed_dropout = Dropout(c.hidden_dropout_prob)
+        self.layers = LayerList(
+            [ErnieLayer(c) for _ in range(c.num_hidden_layers)])
+        self.pooler = Linear(c.hidden_size, c.hidden_size)
+
+    def forward(self, input_ids, token_type_ids=None, attn_mask=None):
+        from .. import arange
+
+        b, s = input_ids.shape
+        pos = arange(0, s, dtype="int32").unsqueeze(0)
+        h = self.word_embeddings(input_ids) + self.position_embeddings(pos)
+        if token_type_ids is not None:
+            h = h + self.token_type_embeddings(token_type_ids)
+        h = self.embed_dropout(self.embed_ln(h))
+        for layer in self.layers:
+            h = layer(h, attn_mask)
+        pooled = F.tanh(self.pooler(h[:, 0]))
+        return h, pooled
+
+
+class ErnieForPretraining(Layer):
+    """MLM + sentence-order heads (ERNIE pretraining objective)."""
+
+    def __init__(self, config):
+        super().__init__()
+        c = config
+        self.config = c
+        self.ernie = ErnieModel(c)
+        self.mlm_transform = Linear(c.hidden_size, c.hidden_size)
+        self.mlm_ln = LayerNorm(c.hidden_size)
+        if c.use_parallel:
+            self.mlm_head = ColumnParallelLinear(
+                c.hidden_size, c.vocab_size)
+        else:
+            self.mlm_head = Linear(c.hidden_size, c.vocab_size)
+        self.sop_head = Linear(c.hidden_size, 2)
+
+    def forward(self, input_ids, token_type_ids=None, masked_labels=None,
+                sop_labels=None):
+        h, pooled = self.ernie(input_ids, token_type_ids)
+        mlm = self.mlm_head(self.mlm_ln(F.gelu(self.mlm_transform(h))))
+        sop = self.sop_head(pooled)
+        if masked_labels is None:
+            return mlm, sop
+        loss = F.cross_entropy(
+            mlm.reshape([-1, self.config.vocab_size]),
+            masked_labels.reshape([-1]), ignore_index=-100)
+        if sop_labels is not None:
+            loss = loss + F.cross_entropy(sop, sop_labels)
+        return loss
+
+
+class ErnieForSequenceClassification(Layer):
+    def __init__(self, config, num_classes=2):
+        super().__init__()
+        self.ernie = ErnieModel(config)
+        self.classifier = Linear(config.hidden_size, num_classes)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None, labels=None):
+        _, pooled = self.ernie(input_ids, token_type_ids)
+        logits = self.classifier(self.dropout(pooled))
+        if labels is not None:
+            return F.cross_entropy(logits, labels)
+        return logits

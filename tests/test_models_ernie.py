@@ -1,0 +1,86 @@
+"""ERNIE encoder family (BASELINE north star ERNIE-3.0-base): forward
+shapes, MLM+SOP pretraining loss drops, mp-parallel compiled step.
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.distributed import mesh as pmesh
+from paddle_tpu.models.ernie import (
+    ErnieConfig,
+    ErnieForPretraining,
+    ErnieForSequenceClassification,
+    ErnieModel,
+)
+from paddle_tpu.parallel.engine import CompiledTrainStep
+
+
+def _data(cfg, b=4, s=16, seed=0):
+    rng = np.random.RandomState(seed)
+    ids = rng.randint(0, cfg.vocab_size, (b, s)).astype(np.int32)
+    tt = rng.randint(0, cfg.type_vocab_size, (b, s)).astype(np.int32)
+    return ids, tt, rng
+
+
+class TestErnie:
+    def test_forward_shapes(self):
+        paddle.seed(0)
+        cfg = ErnieConfig.tiny()
+        m = ErnieModel(cfg)
+        ids, tt, _ = _data(cfg)
+        h, pooled = m(paddle.to_tensor(ids), paddle.to_tensor(tt))
+        assert tuple(h.shape) == (4, 16, cfg.hidden_size)
+        assert tuple(pooled.shape) == (4, cfg.hidden_size)
+
+    def test_pretraining_loss_drops(self):
+        paddle.seed(0)
+        cfg = ErnieConfig.tiny()
+        m = ErnieForPretraining(cfg)
+        opt = paddle.optimizer.AdamW(learning_rate=2e-3,
+                                     parameters=m.parameters())
+        ids, tt, rng = _data(cfg)
+        masked = ids.copy().astype(np.int64)
+        masked[:, ::2] = -100  # only odd positions scored
+        sop = rng.randint(0, 2, (4,)).astype(np.int64)
+        losses = []
+        for _ in range(8):
+            loss = m(paddle.to_tensor(ids), paddle.to_tensor(tt),
+                     paddle.to_tensor(masked), paddle.to_tensor(sop))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] - 0.5, losses
+
+    def test_sequence_classification(self):
+        paddle.seed(0)
+        cfg = ErnieConfig.tiny()
+        m = ErnieForSequenceClassification(cfg, num_classes=3)
+        ids, tt, rng = _data(cfg)
+        logits = m(paddle.to_tensor(ids), paddle.to_tensor(tt))
+        assert tuple(logits.shape) == (4, 3)
+
+    def test_mp_compiled_step(self):
+        pmesh.build_hybrid_mesh(dp=2, mp=4)
+        paddle.seed(0)
+        cfg = ErnieConfig.tiny(use_parallel=True)
+        m = ErnieForPretraining(cfg)
+
+        def loss_fn(out, masked):
+            mlm, sop = out
+            return F.cross_entropy(
+                mlm.reshape([-1, cfg.vocab_size]), masked.reshape([-1]),
+                ignore_index=-100)
+
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=m.parameters())
+        ids, tt, rng = _data(cfg)
+        masked = ids.astype(np.int64)
+
+        step = CompiledTrainStep(m, loss_fn, opt)
+        loss = step(paddle.to_tensor(ids), paddle.to_tensor(tt),
+                    paddle.to_tensor(masked))
+        assert np.isfinite(float(loss))
+        # mp sharding is real: q_proj weight carries the 'mp' spec
+        spec = m.ernie.layers[0].attn.q_proj.weight._sharding_spec
+        assert spec is not None and "mp" in str(spec)
