@@ -36,11 +36,18 @@ NEG_INF = -1e30
 _STAT_LANES = 128  # lane width for the m/l scratch (TPU min tile)
 
 
-def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-               *, scale, causal, block_k):
+def _fa_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, block_k,
+               segmented):
     """One (bh, q_block, kv_block) program. Refs: q [1, bq, d];
-    k/v [1, block_k, d]; o [1, bq, d]; lse [1, bq] (softmax log-sum-exp,
-    saved for the Pallas backward); scratch m/l [bq, 128], acc [bq, d]."""
+    k/v [1, block_k, d]; optional segment-id refs sq [1, bq], sk
+    [1, block_k] (ragged/packed sequences: tokens attend only within
+    their segment — the serving varlen path); o [1, bq, d]; lse [1, bq]
+    (softmax log-sum-exp, saved for the Pallas backward); scratch m/l
+    [bq, 128], acc [bq, d]."""
+    if segmented:
+        sq_ref, sk_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
     _, bq, d = q_ref.shape
     q_idx = pl.program_id(1)
     kv_i = pl.program_id(2)
@@ -65,6 +72,9 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
             k_pos = kv_i * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if segmented:
+            s = jnp.where(
+                sq_ref[0][:, None] == sk_ref[0][None, :], s, NEG_INF)
         m_prev = m_scr[...][:, :1]                      # [bq, 1]
         l_prev = l_scr[...][:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
@@ -93,24 +103,38 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         lse_ref[0, 0] = (m + jnp.log(jnp.maximum(l, 1e-30)))[:, 0]
 
 
-def _flash_fwd_bhnd(q, k, v, scale, causal, block_q, block_k, interpret):
-    """q,k,v: [BH, N, D] (heads folded into batch)."""
+def _flash_fwd_bhnd(q, k, v, scale, causal, block_q, block_k, interpret,
+                    segs=None):
+    """q,k,v: [BH, N, D] (heads folded into batch); segs: optional
+    [BH, N] int32 segment ids (ragged/packed attention)."""
     bh, n, d = q.shape
     kv_len = k.shape[1]
     grid = (bh, n // block_q, kv_len // block_k)
+    segmented = segs is not None
     kernel = functools.partial(
-        _fa_kernel, scale=scale, causal=causal, block_k=block_k)
+        _fa_kernel, scale=scale, causal=causal, block_k=block_k,
+        segmented=segmented)
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+    args = [q, k, v]
+    if segmented:
+        in_specs += [
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k), lambda b, i, j: (b, j),
+                         memory_space=pltpu.VMEM),
+        ]
+        args += [segs, segs]
     return pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0),
-                         memory_space=pltpu.VMEM),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM),
@@ -132,14 +156,18 @@ def _flash_fwd_bhnd(q, k, v, scale, causal, block_q, block_k, interpret):
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(q, k, v)
+    )(*args)
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dq_ref,
-               dq_scr, *, scale, causal, block_k):
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, *rest,
+               scale, causal, block_k, segmented):
     """dq pass: grid (bh, q_block, kv_block); dq accumulated in VMEM.
     ds = p * (dout.v^T - delta); dq = scale * ds @ k (FlashAttention-2
     backward, arXiv:2307.08691 alg. 4 — public algorithm, fresh code)."""
+    if segmented:
+        sq_ref, sk_ref, dq_ref, dq_scr = rest
+    else:
+        dq_ref, dq_scr = rest
     _, bq, d = q_ref.shape
     q_idx = pl.program_id(1)
     kv_i = pl.program_id(2)
@@ -165,6 +193,9 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dq_ref,
             k_pos = kv_i * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if segmented:
+            s = jnp.where(
+                sq_ref[0][:, None] == sk_ref[0][None, :], s, NEG_INF)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
@@ -186,10 +217,14 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dq_ref,
         dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dk_ref,
-                dv_ref, dk_scr, dv_scr, *, scale, causal, block_q):
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, *rest,
+                scale, causal, block_q, segmented):
     """dk/dv pass: grid (bh, kv_block, q_block); dk/dv accumulated in VMEM.
     dv = p^T @ dout; dk = scale * ds^T @ q."""
+    if segmented:
+        sq_ref, sk_ref, dk_ref, dv_ref, dk_scr, dv_scr = rest
+    else:
+        dk_ref, dv_ref, dk_scr, dv_scr = rest
     _, bk, d = k_ref.shape
     kv_i = pl.program_id(1)
     q_idx = pl.program_id(2)
@@ -217,6 +252,9 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dk_ref,
             k_pos = kv_i * bk + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, bk), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if segmented:
+            s = jnp.where(
+                sq_ref[0][:, None] == sk_ref[0][None, :], s, NEG_INF)
         p = jnp.exp(s - lse)                             # [bq, bk]
         dv_scr[...] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
@@ -245,31 +283,42 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dk_ref,
 
 
 def _flash_bwd_bhnd(q, k, v, out, lse, g, scale, causal, block_q, block_k,
-                    interpret):
+                    interpret, segs=None):
     """Pallas backward: returns (dq, dk, dv), all [BH, N, D]."""
     bh, n, d = q.shape
     kv_len = k.shape[1]
+    segmented = segs is not None
     # delta[b, i] = sum_d dout * out — one fused XLA reduction
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1)[:, None, :]                  # [bh, 1, n]
+    dq_in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i),
+                     memory_space=pltpu.VMEM),
+    ]
+    dq_args = [q, k, v, g, lse, delta]
+    if segmented:
+        dq_in_specs += [
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k), lambda b, i, j: (b, j),
+                         memory_space=pltpu.VMEM),
+        ]
+        dq_args += [segs, segs]
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
-                          block_k=block_k),
+                          block_k=block_k, segmented=segmented),
         grid=(bh, n // block_q, kv_len // block_k),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i),
-                         memory_space=pltpu.VMEM),
-        ],
+        in_specs=dq_in_specs,
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((bh, n, d), q.dtype),
@@ -277,25 +326,35 @@ def _flash_bwd_bhnd(q, k, v, out, lse, g, scale, causal, block_q, block_k,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(q, k, v, g, lse, delta)
+    )(*dq_args)
+    dkv_in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i),
+                     memory_space=pltpu.VMEM),
+    ]
+    dkv_args = [q, k, v, g, lse, delta]
+    if segmented:
+        dkv_in_specs += [
+            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k), lambda b, j, i: (b, j),
+                         memory_space=pltpu.VMEM),
+        ]
+        dkv_args += [segs, segs]
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
-                          block_q=block_q),
+                          block_q=block_q, segmented=segmented),
         grid=(bh, kv_len // block_k, n // block_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i),
-                         memory_space=pltpu.VMEM),
-        ],
+        in_specs=dkv_in_specs,
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0),
                          memory_space=pltpu.VMEM),
@@ -313,15 +372,15 @@ def _flash_bwd_bhnd(q, k, v, out, lse, g, scale, causal, block_q, block_k,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(q, k, v, g, lse, delta)
+    )(*dkv_args)
     return dq, dk, dv
 
 
-def _reference_attention(q, k, v, scale, causal):
+def _reference_attention(q, k, v, scale, causal, segs=None):
     """[BH, N, D] fp32-statistics attention — the VJP recompute form.
 
-    Uses the same start-aligned causal mask as the Pallas kernel (query i
-    sees keys j <= i) so forward and backward agree for any kv_len.
+    Uses the same start-aligned causal mask (and segment mask) as the
+    Pallas kernel so forward and backward agree for any kv_len.
     """
     # bf16 operands + fp32 accumulation: the MXU-native contraction. An
     # fp32 upcast before the dot would halve MXU throughput for the same
@@ -333,28 +392,36 @@ def _reference_attention(q, k, v, scale, causal):
         q_pos = jax.lax.broadcasted_iota(jnp.int32, (n, m), 0)
         k_pos = jax.lax.broadcasted_iota(jnp.int32, (n, m), 1)
         logits = jnp.where(q_pos >= k_pos, logits, NEG_INF)
+    if segs is not None:
+        logits = jnp.where(segs[:, :, None] == segs[:, None, :], logits,
+                           NEG_INF)
     p = jax.nn.softmax(logits, axis=-1)
     return jnp.einsum("bnm,bmd->bnd", p.astype(v.dtype), v)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_core(q, k, v, scale, causal, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash_core(q, k, v, segs, scale, causal, block_q, block_k,
+                interpret):
     out, _ = _flash_fwd_bhnd(q, k, v, scale, causal, block_q, block_k,
-                             interpret)
+                             interpret, segs=segs)
     return out
 
 
-def _flash_core_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+def _flash_core_fwd(q, k, v, segs, scale, causal, block_q, block_k,
+                    interpret):
     out, lse = _flash_fwd_bhnd(q, k, v, scale, causal, block_q, block_k,
-                               interpret)
-    return out, (q, k, v, out, lse)
+                               interpret, segs=segs)
+    return out, (q, k, v, segs, out, lse)
 
 
 def _flash_core_bwd(scale, causal, block_q, block_k, interpret, res, g):
-    q, k, v, out, lse = res
+    q, k, v, segs, out, lse = res
     # Pallas blocked backward: O(N) memory, never materializes [N, N]
-    return _flash_bwd_bhnd(q, k, v, out, lse, g, scale, causal, block_q,
-                           block_k, interpret)
+    dq, dk, dv = _flash_bwd_bhnd(q, k, v, out, lse, g, scale, causal,
+                                 block_q, block_k, interpret, segs=segs)
+    dsegs = (None if segs is None
+             else jnp.zeros(segs.shape, jax.dtypes.float0))
+    return dq, dk, dv, dsegs
 
 
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
@@ -362,8 +429,12 @@ _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 
 def flash_attention(q, k, v, causal=False, scale=None,
                     block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
-                    interpret=None):
-    """q,k,v: [B, N, H, D] jax arrays. Returns [B, N, H, D]."""
+                    interpret=None, segment_ids=None):
+    """q,k,v: [B, N, H, D] jax arrays. Returns [B, N, H, D].
+
+    segment_ids: optional [B, N] int32 — ragged/packed attention
+    (serving varlen batching): tokens attend only within their segment,
+    composable with `causal` (packed causal LM)."""
     b, n, h, d = q.shape
     kv_n = k.shape[1]
     if scale is None:
@@ -381,17 +452,25 @@ def flash_attention(q, k, v, causal=False, scale=None,
     tileable = (n % block_q == 0 and kv_n % block_k == 0
                 and block_q % 8 == 0 and block_k % 128 == 0
                 and (block_q % 128 == 0 or block_q == n))
+    segs = None
+    if segment_ids is not None:
+        if n != kv_n:
+            raise ValueError(
+                "segment_ids requires q_len == kv_len (packed batches)")
+        segs = jnp.broadcast_to(
+            jnp.asarray(segment_ids, jnp.int32)[:, None, :],
+            (b, h, kv_n)).reshape(b * h, kv_n)
     if not tileable:
         return jnp.swapaxes(
             _reference_attention(
                 jnp.swapaxes(q, 1, 2).reshape(b * h, n, d),
                 jnp.swapaxes(k, 1, 2).reshape(b * h, kv_n, d),
                 jnp.swapaxes(v, 1, 2).reshape(b * h, kv_n, d),
-                scale, causal).reshape(b, h, n, d), 1, 2)
+                scale, causal, segs=segs).reshape(b, h, n, d), 1, 2)
 
     def fold(x):
         return jnp.swapaxes(x, 1, 2).reshape(b * h, x.shape[1], d)
 
-    out = _flash_core(fold(q), fold(k), fold(v), scale, causal, block_q,
-                      block_k, interpret)
+    out = _flash_core(fold(q), fold(k), fold(v), segs, scale, causal,
+                      block_q, block_k, interpret)
     return jnp.swapaxes(out.reshape(b, h, n, d), 1, 2)

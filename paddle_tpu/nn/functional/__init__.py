@@ -33,6 +33,7 @@ from .attention import (  # noqa: F401
     scaled_dot_product_attention,
     sequence_parallel_attention,
     sparse_attention,
+    variable_length_attention,
 )
 from .common import (  # noqa: F401
     affine_grid,

@@ -87,7 +87,42 @@ def sequence_parallel_attention(query, key, value, is_causal=True,
 @primitive
 def sparse_attention(query, key, value, sparse_csr_offset=None,
                      sparse_csr_columns=None, attn_mask=None):
-    # Block-sparse attention degenerates to dense + mask on TPU; the Pallas
-    # ragged kernel (kernels/) covers the serving path.
+    # Block-sparse attention degenerates to dense + mask on TPU; packed
+    # variable-length serving goes through variable_length_attention
+    # (segment-masked flash kernel).
     q, k, v = _A(query), _A(key), _A(value)
     return _sdpa_reference(q, k, v, mask=attn_mask)
+
+
+@primitive
+def variable_length_attention(query, key, value, seq_lens=None,
+                              segment_ids=None, is_causal=True,
+                              scale=None):
+    """Ragged/packed attention (reference varlen fused attention,
+    flash_attn_unpadded / variable_length_memory_efficient_attention):
+    multiple sequences packed along one axis; tokens attend only within
+    their own sequence. Provide per-batch `seq_lens` (list of lengths
+    summing to N, converted to segment ids) or `segment_ids` [B, N]."""
+    q, k, v = _A(query), _A(key), _A(value)
+    if segment_ids is None:
+        if seq_lens is None:
+            raise ValueError("need seq_lens or segment_ids")
+        import numpy as _np
+
+        lens = _np.asarray(seq_lens)
+        if lens.ndim == 1:
+            lens = lens[None]
+        total = q.shape[1]
+        segs = _np.zeros((lens.shape[0], total), _np.int32)
+        for bi in range(lens.shape[0]):
+            off = 0
+            for si, L in enumerate(lens[bi]):
+                segs[bi, off:off + int(L)] = si
+                off += int(L)
+            # tail padding (if any) gets its own segment id
+            segs[bi, off:] = lens.shape[1]
+        segment_ids = jnp.asarray(segs)
+    from ...kernels.flash_attention import flash_attention as _fa
+
+    return _fa(q, k, v, causal=is_causal, scale=scale,
+               segment_ids=_A(segment_ids))

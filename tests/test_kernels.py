@@ -174,7 +174,7 @@ class TestFlashPallasBackward:
         sc = 1.0 / np.sqrt(d)
         # 4x4 blocks of 64 -> real multi-iteration accumulation paths
         out, vjp = jax.vjp(
-            lambda a, b_, c: _flash_core(a, b_, c, sc, causal, 64, 128,
+            lambda a, b_, c: _flash_core(a, b_, c, None, sc, causal, 64, 128,
                                          True), q, k, v)
         ref_out, ref_vjp = jax.vjp(
             lambda a, b_, c: _reference_attention(a, b_, c, sc, causal),
@@ -197,7 +197,7 @@ class TestFlashPallasBackward:
         g = jax.random.normal(ks[3], (bh, n, d), jnp.float32)
         sc = 1.0 / np.sqrt(d)
         _, vjp = jax.vjp(
-            lambda a, b_, c: _flash_core(a, b_, c, sc, True, 64, 128, True),
+            lambda a, b_, c: _flash_core(a, b_, c, None, sc, True, 64, 128, True),
             q, k, v)
         _, ref_vjp = jax.vjp(
             lambda a, b_, c: _reference_attention(a, b_, c, sc, True),
