@@ -36,6 +36,20 @@ NEG_INF = -1e30
 _STAT_LANES = 128  # lane width for the m/l scratch (TPU min tile)
 
 
+def _dot(a, b, dims, batch=((), ())):
+    """fp32-accumulating dot. bf16 operands go to the MXU at native
+    precision (DEFAULT — exact for bf16 inputs, 2x the fp32-upcast
+    throughput); fp32 operands inherit the framework's global matmul
+    precision (FLAGS_matmul_precision, default 'highest'), preserving the
+    documented fp32 guarantee for fp32 callers."""
+    prec = (jax.lax.Precision.DEFAULT
+            if a.dtype == jnp.bfloat16 and b.dtype == jnp.bfloat16
+            else None)
+    return jax.lax.dot_general(a, b, (dims, batch),
+                               preferred_element_type=jnp.float32,
+                               precision=prec)
+
+
 def _fa_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, block_k,
                segmented):
     """One (bh, q_block, kv_block) program. Refs: q [1, bq, d];
@@ -60,12 +74,13 @@ def _fa_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, block_k,
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
     def compute():
-        q = q_ref[0].astype(jnp.float32) * scale
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)  # [bq, block_k]
+        # bf16 operands straight into the MXU (fp32 accumulate): an fp32
+        # upcast before the dot halves MXU throughput for statistics we
+        # keep in fp32 anyway. Scale is applied to the fp32 product.
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        s = _dot(q, k, ((1,), (1,))) * scale  # [bq, block_k]
         if causal:
             q_pos = q_idx * bq + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 0)
@@ -81,9 +96,7 @@ def _fa_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, block_k,
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m_prev - m_new)
         l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
-        acc_scr[...] = alpha * acc_scr[...] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        acc_scr[...] = alpha * acc_scr[...] + _dot(p.astype(v.dtype), v, ((1,), (0,)))
         m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
 
@@ -178,15 +191,13 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, *rest,
         dq_scr[...] = jnp.zeros_like(dq_scr)
 
     def compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0, 0][:, None]                    # [bq, 1]
         delta = dl_ref[0, 0][:, None]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale  # [bq, bk]
+        s = _dot(q, k, ((1,), (1,))) * scale  # [bq, bk]
         if causal:
             q_pos = q_idx * bq + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 0)
@@ -197,13 +208,9 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, *rest,
             s = jnp.where(
                 sq_ref[0][:, None] == sk_ref[0][None, :], s, NEG_INF)
         p = jnp.exp(s - lse)
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)          # [bq, bk]
-        ds = p * (dp - delta)
-        dq_scr[...] += scale * jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        dp = _dot(do, v, ((1,), (1,)))          # [bq, bk]
+        ds = (p * (dp - delta)).astype(k.dtype)
+        dq_scr[...] += scale * _dot(ds, k, ((1,), (0,)))
 
     if causal:
         @pl.when(kv_i * block_k <= q_idx * bq + bq - 1)
@@ -236,16 +243,14 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, *rest,
         dv_scr[...] = jnp.zeros_like(dv_scr)
 
     def compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         bq = q.shape[0]
         lse = lse_ref[0, 0][:, None]
         delta = dl_ref[0, 0][:, None]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale  # [bq, bk]
+        s = _dot(q, k, ((1,), (1,))) * scale  # [bq, bk]
         if causal:
             q_pos = q_idx * bq + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, bk), 0)
@@ -256,16 +261,10 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, *rest,
             s = jnp.where(
                 sq_ref[0][:, None] == sk_ref[0][None, :], s, NEG_INF)
         p = jnp.exp(s - lse)                             # [bq, bk]
-        dv_scr[...] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)          # [bk, d]
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)          # [bq, bk]
-        ds = p * (dp - delta)
-        dk_scr[...] += scale * jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)          # [bk, d]
+        dv_scr[...] += _dot(p.astype(do.dtype), do, ((0,), (0,)))          # [bk, d]
+        dp = _dot(do, v, ((1,), (1,)))          # [bq, bk]
+        ds = (p * (dp - delta)).astype(q.dtype)
+        dk_scr[...] += scale * _dot(ds, q, ((0,), (0,)))          # [bk, d]
 
     if causal:
         # skip q blocks entirely above the diagonal for this kv block
@@ -382,11 +381,10 @@ def _reference_attention(q, k, v, scale, causal, segs=None):
     Uses the same start-aligned causal mask (and segment mask) as the
     Pallas kernel so forward and backward agree for any kv_len.
     """
-    # bf16 operands + fp32 accumulation: the MXU-native contraction. An
-    # fp32 upcast before the dot would halve MXU throughput for the same
-    # statistics precision.
-    logits = jnp.einsum("bnd,bmd->bnm", q, k,
-                        preferred_element_type=jnp.float32) * scale
+    # bf16 operands + fp32 accumulation: the MXU-native contraction (same
+    # dtype-gated policy as the kernel's _dot — fp32 callers keep the
+    # global matmul-precision guarantee).
+    logits = _dot(q, k, ((2,), (2,)), batch=((0,), (0,))) * scale
     if causal:
         n, m = logits.shape[-2], logits.shape[-1]
         q_pos = jax.lax.broadcasted_iota(jnp.int32, (n, m), 0)

@@ -1,0 +1,50 @@
+"""On-chip numerics check: Pallas kernel vs XLA reference path (not shipped)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.kernels import flash_attention as fa
+
+B, N, H, D = 2, 1024, 2, 128
+rng = np.random.RandomState(0)
+q = jnp.asarray(rng.randn(B, N, H, D), jnp.bfloat16)
+k = jnp.asarray(rng.randn(B, N, H, D), jnp.bfloat16)
+v = jnp.asarray(rng.randn(B, N, H, D), jnp.bfloat16)
+
+
+def loss_kernel(q, k, v):
+    o = fa.flash_attention(q, k, v, causal=True)
+    return (o.astype(jnp.float32) ** 2).mean()
+
+
+def loss_ref(q, k, v):
+    b, n, h, d = q.shape
+
+    def fold(x):
+        return jnp.swapaxes(x, 1, 2).reshape(b * h, x.shape[1], d)
+
+    o = fa._reference_attention(fold(q), fold(k), fold(v),
+                                1.0 / np.sqrt(d), True)
+    o = jnp.swapaxes(o.reshape(b, h, n, d), 1, 2)
+    return (o.astype(jnp.float32) ** 2).mean()
+
+
+for name, f in [("kernel", loss_kernel), ("ref", loss_ref)]:
+    l, g = jax.jit(jax.value_and_grad(f, argnums=(0, 1, 2)))(q, k, v)
+    print(name, float(l),
+          [float(jnp.abs(x.astype(jnp.float32)).mean()) for x in g])
+
+lk, gk = jax.jit(jax.value_and_grad(loss_kernel, argnums=(0, 1, 2)))(q, k, v)
+lr, gr = jax.jit(jax.value_and_grad(loss_ref, argnums=(0, 1, 2)))(q, k, v)
+print("loss rel err", abs(float(lk) - float(lr)) / abs(float(lr)))
+for a, b_, nm in zip(gk, gr, "qkv"):
+    a = np.asarray(a, np.float32)
+    b_ = np.asarray(b_, np.float32)
+    denom = np.abs(b_).mean() + 1e-8
+    print("d%s: mean abs diff %.3e (rel %.3e)" %
+          (nm, np.abs(a - b_).mean(), np.abs(a - b_).mean() / denom))

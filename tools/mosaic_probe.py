@@ -1,0 +1,70 @@
+"""Probe which bf16 dot forms this Mosaic build compiles (not shipped)."""
+import functools
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+import numpy as np
+
+BQ, BK, D = 512, 512, 128
+
+
+def probe(name, kernel, shapes, out_shape):
+    try:
+        f = pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct(out_shape, jnp.float32),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)
+                      for _ in shapes],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        )
+        args = [jnp.ones(s, jnp.bfloat16) for s in shapes]
+        r = jax.jit(f)(*args)
+        np.asarray(r).ravel()[0]
+        print("OK  ", name)
+    except Exception as e:
+        msg = str(e).split("\n")[0][:150]
+        print("FAIL", name, "--", msg)
+
+
+def k_nt(a_ref, b_ref, o_ref):
+    # a [BQ, D] @ b [BK, D]^T : contracting (1,1) — "transposed rhs"
+    o_ref[...] = jax.lax.dot_general(
+        a_ref[...], b_ref[...], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
+def k_nn(a_ref, b_ref, o_ref):
+    # a [BQ, D] @ b [D, BK] : contracting (1,0) — plain matmul
+    o_ref[...] = jax.lax.dot_general(
+        a_ref[...], b_ref[...], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
+def k_tn(a_ref, b_ref, o_ref):
+    # a [BQ, D]^T... contracting (0,0): [D, BQ]x[BQ... -> a^T @ b
+    o_ref[...] = jax.lax.dot_general(
+        a_ref[...], b_ref[...], (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
+def k_mixed(a_ref, b_ref, o_ref):
+    # bf16 x fp32-from-exp: p (computed fp32, cast bf16) @ v bf16
+    s = jax.lax.dot_general(
+        a_ref[...], b_ref[...], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    p = jnp.exp(s - 1.0).astype(jnp.bfloat16)
+    o_ref[...] = jax.lax.dot_general(
+        p, b_ref[...], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
+probe("nt bf16 (1,1)", k_nt, [(BQ, D), (BK, D)], (BQ, BK))
+probe("nn bf16 (1,0)", k_nn, [(BQ, D), (D, BK)], (BQ, BK))
+probe("tn bf16 (0,0)", k_tn, [(D, BQ), (D, BK)], (BQ, BK))
+probe("nt+cast+nn chained", k_mixed, [(BQ, D), (BK, D)], (BQ, D))

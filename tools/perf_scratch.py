@@ -1,0 +1,90 @@
+"""Scratch on-chip microbench for flash-attention variants (not shipped).
+
+One dispatch runs `iters` iterations via lax.scan on-device, so tunnel
+RPC overhead is amortized away.
+"""
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.kernels.flash_attention import flash_attention
+
+B, N, H, D = 8, 1024, 6, 128
+ITERS = 20
+
+
+def timeit(body, args, iters=ITERS, reps=3):
+    """body: carry -> carry (device arrays). Times iters iterations
+    inside one jitted scan; returns ms/iteration (min over reps)."""
+
+    @jax.jit
+    def run(c):
+        def step(c, _):
+            return body(c), ()
+        c, _ = jax.lax.scan(step, c, None, length=iters)
+        # scalar readback only — pulling full arrays through the tunnel
+        # costs ~100ms and swamps the measurement
+        return sum(jnp.sum(l.astype(jnp.float32))
+                   for l in jax.tree_util.tree_leaves(c))
+
+    s = run(args)  # compile+run
+    _ = float(s)
+    best = 1e9
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        s = run(args)
+        _ = float(s)
+        best = min(best, time.perf_counter() - t0)
+    return best / iters * 1000
+
+
+def main():
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(B, N, H, D), jnp.bfloat16)
+    k = jnp.asarray(rng.randn(B, N, H, D), jnp.bfloat16)
+    v = jnp.asarray(rng.randn(B, N, H, D), jnp.bfloat16)
+
+    def fwd(c):
+        q, k, v = c
+        o = flash_attention(q, k, v, causal=True)
+        # feed output back in so scan iterations are serialized
+        return (o, k, v)
+
+    def fwdbwd(c):
+        q, k, v = c
+
+        def f(q, k, v):
+            return (flash_attention(q, k, v, causal=True)
+                    .astype(jnp.float32).sum())
+
+        _, (dq, dk, dv) = jax.value_and_grad(f, argnums=(0, 1, 2))(q, k, v)
+        return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype))
+
+    def tiny(c):
+        return c + 1.0
+
+    print("overhead    %.3f ms" %
+          timeit(tiny, jnp.zeros((8, 128), jnp.float32), iters=100))
+    print("fa fwd      %.3f ms" % timeit(fwd, (q, k, v), iters=100))
+    print("fa fwd+bwd  %.3f ms" % timeit(fwdbwd, (q, k, v), iters=100))
+
+    for sz in (4096, 8192):
+        a = jnp.asarray(rng.randn(sz, sz), jnp.bfloat16)
+
+        def mm(a):
+            return a @ a
+
+        t = timeit(mm, a, iters=100)
+        print("mm %d^3   %.3f ms  -> %.1f TF/s" %
+              (sz, t, 2 * sz**3 / (t / 1e3) / 1e12))
+
+
+if __name__ == "__main__":
+    main()
