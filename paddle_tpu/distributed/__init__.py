@@ -11,19 +11,27 @@ from . import (  # noqa: F401
     launch,
     mesh,
     rpc,
+    stream,
     topology,
 )
 from .auto_parallel import ProcessMesh, shard_op, shard_tensor  # noqa: F401
 from .collective import (  # noqa: F401
     Group,
+    P2POp,
     ReduceOp,
     all_gather,
     all_reduce,
     alltoall,
     barrier,
+    batch_isend_irecv,
     broadcast,
     get_group,
+    irecv,
+    isend,
     new_group,
+    partial_allgather,
+    partial_recv,
+    partial_send,
     recv,
     reduce,
     reduce_scatter,

@@ -461,6 +461,143 @@ def recv(tensor, src=0, group=None, sync_op=True):
         "(PADDLE_TRAINERS_NUM > 1)")
 
 
+class Task:
+    """Completion handle returned by async-flavored collectives (reference
+    ProcessGroup::Task, distributed/collective/process_group.h:53). The
+    store backend completes operations synchronously, so the handle is a
+    finished-state record with the result attached; `wait()` exists for
+    API compatibility with code written against NCCL's async tasks."""
+
+    def __init__(self, result=None):
+        self._result = result
+
+    def wait(self, timeout=None):
+        return True
+
+    def is_completed(self):
+        return True
+
+    def result(self):
+        return self._result
+
+
+def isend(tensor, dst=0, group=None):
+    """Async-flavored send (reference communication/send.py isend).
+    The store backend's send is a non-blocking put, so the task is
+    complete on return."""
+    send(tensor, dst=dst, group=group, sync_op=False)
+    return Task()
+
+
+def irecv(tensor, src=0, group=None):
+    """Async-flavored recv (reference communication/recv.py irecv): blocks
+    until the matching send's payload lands, writes it into `tensor`, and
+    returns a completed Task."""
+    out = recv(tensor, src=src, group=group, sync_op=False)
+    return Task(out)
+
+
+class P2POp:
+    """One point-to-point operation for batch_isend_irecv (reference
+    communication/batch_isend_irecv.py:26 P2POp): op is `isend` or
+    `irecv`, tensor the buffer, peer the remote rank."""
+
+    def __init__(self, op, tensor, peer, group=None):
+        if op not in (isend, irecv):
+            raise RuntimeError(
+                "The op for p2p_op_list must be paddle.distributed.isend "
+                "or paddle.distributed.irecv")
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def batch_isend_irecv(p2p_op_list):
+    """Execute a batch of p2p ops (reference batch_isend_irecv.py:84).
+
+    All sends are issued before any recv: the reference brackets the batch
+    in a NCCL group so member ops can't deadlock on issue order; with the
+    store backend, sends are non-blocking puts, so issuing them first
+    gives the same guarantee for any self-consistent batch (e.g. the ring
+    exchange where every rank both sends and recvs)."""
+    if not p2p_op_list:
+        raise RuntimeError("p2p_op_list must not be empty")
+    if not all(isinstance(p, P2POp) for p in p2p_op_list):
+        raise RuntimeError("p2p_op_list must contain only P2POp instances")
+    tasks = [None] * len(p2p_op_list)
+    order = ([i for i, p in enumerate(p2p_op_list) if p.op is isend]
+             + [i for i, p in enumerate(p2p_op_list) if p.op is irecv])
+    for i in order:
+        p = p2p_op_list[i]
+        tasks[i] = p.op(p.tensor, p.peer, group=p.group)
+    return tasks
+
+
+def _flat_chunk_bounds(numel, nranks, rank_id):
+    if numel % nranks:
+        raise ValueError(
+            "partial collective: tensor numel (%d) must be divisible by "
+            "nranks (%d)" % (numel, nranks))
+    chunk = numel // nranks
+    return chunk * rank_id, chunk * (rank_id + 1)
+
+
+def partial_send(tensor, dst=0, nranks=1, rank_id=0, group=None):
+    """Send flat elements [rank_id*numel/nranks, (rank_id+1)*numel/nranks)
+    of `tensor` (reference partial_send_op: the PP p2p slice primitive)."""
+    v = _np(_unwrap(tensor))
+    lo, hi = _flat_chunk_bounds(v.size, nranks, rank_id)
+    g = group or _get_default_group()
+    pg = _pg_of(g)
+    if pg is None:
+        raise RuntimeError(
+            "partial_send needs a multi-process world (init_parallel_env)")
+    pg.send(v.reshape(-1)[lo:hi], dst)
+
+
+def partial_recv(tensor, src=0, nranks=1, rank_id=0, group=None):
+    """Receive into the flat [rank_id] chunk of `tensor`, leaving the other
+    chunks untouched (reference partial_recv_op)."""
+    v = _np(_unwrap(tensor)).copy()
+    lo, hi = _flat_chunk_bounds(v.size, nranks, rank_id)
+    g = group or _get_default_group()
+    pg = _pg_of(g)
+    if pg is None:
+        raise RuntimeError(
+            "partial_recv needs a multi-process world (init_parallel_env)")
+    flat = v.reshape(-1)
+    flat[lo:hi] = pg.recv(src).reshape(-1)
+    return _store_result(tensor, flat.reshape(v.shape))
+
+
+def partial_allgather(tensor, nranks=1, rank_id=0, group=None):
+    """Each rank contributes its flat [rank_id] chunk; every rank gets the
+    full tensor with chunk r filled by rank r (reference
+    partial_allgather_op, used to reassemble partial_send/recv'd
+    activations). In-place on `tensor`."""
+    v = _np(_unwrap(tensor))
+    lo, hi = _flat_chunk_bounds(v.size, nranks, rank_id)
+    g = group or _get_default_group()
+    pg = _pg_of(g)
+    if pg is None:
+        raise RuntimeError(
+            "partial_allgather needs a multi-process world "
+            "(init_parallel_env)")
+    if nranks != pg.world_size:
+        # world_size chunks of numel/nranks elements only reassemble into
+        # tensor.shape when the two agree (reference partial_allgather_op
+        # asserts nranks == ring size the same way)
+        raise ValueError(
+            "partial_allgather: nranks (%d) must equal the group world "
+            "size (%d)" % (nranks, pg.world_size))
+    parts = pg.allgather(v.reshape(-1)[lo:hi])
+    import numpy as _numpy
+
+    flat = _numpy.concatenate([_numpy.asarray(p).reshape(-1) for p in parts])
+    return _store_result(tensor, flat.reshape(v.shape))
+
+
 def barrier(group=None):
     g = group or _get_default_group()
     pg = _pg_of(g)

@@ -1,0 +1,76 @@
+"""paddle.distributed.communication.stream variants.
+
+Parity: reference python/paddle/distributed/communication/stream/ — the
+`use_calc_stream=True` forms that run a collective on the compute stream
+to avoid an event sync with a separate comm stream.
+
+TPU mapping: PJRT owns stream scheduling, and collectives traced into a
+compiled step are ordered/overlapped by XLA's latency-hiding scheduler;
+there is no user-visible comm-vs-calc stream split to pick between. The
+stream.* functions therefore share one implementation with the plain
+collectives; `use_calc_stream` is accepted and recorded only (it cannot
+change scheduling under PJRT — documented deviation, SURVEY §7 design
+stance on comm streams).
+"""
+from __future__ import annotations
+
+from . import collective as _c
+
+
+def _run(fn, *args, sync_op=True, use_calc_stream=False, **kw):
+    out = fn(*args, **kw)
+    return out if sync_op else _c.Task(out)
+
+
+def all_reduce(tensor, op=_c.ReduceOp.SUM, group=None, sync_op=True,
+               use_calc_stream=False):
+    return _run(_c.all_reduce, tensor, op=op, group=group, sync_op=sync_op,
+                use_calc_stream=use_calc_stream)
+
+
+def all_gather(tensor_or_tensor_list, tensor, group=None, sync_op=True,
+               use_calc_stream=False):
+    return _run(_c.all_gather, tensor_or_tensor_list, tensor, group=group,
+                sync_op=sync_op, use_calc_stream=use_calc_stream)
+
+
+def alltoall(out_tensor_or_tensor_list, in_tensor_or_tensor_list, group=None,
+             sync_op=True, use_calc_stream=False):
+    # stream.alltoall takes (out, in); the plain API takes (in, out)
+    return _run(_c.alltoall, in_tensor_or_tensor_list,
+                out_tensor_or_tensor_list, group=group, sync_op=sync_op,
+                use_calc_stream=use_calc_stream)
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True, use_calc_stream=False):
+    return _run(_c.broadcast, tensor, src=src, group=group, sync_op=sync_op,
+                use_calc_stream=use_calc_stream)
+
+
+def reduce(tensor, dst=0, op=_c.ReduceOp.SUM, group=None, sync_op=True,
+           use_calc_stream=False):
+    return _run(_c.reduce, tensor, dst=dst, op=op, group=group,
+                sync_op=sync_op, use_calc_stream=use_calc_stream)
+
+
+def reduce_scatter(tensor, tensor_or_tensor_list=None, op=_c.ReduceOp.SUM,
+                   group=None, sync_op=True, use_calc_stream=False):
+    return _run(_c.reduce_scatter, tensor, tensor_or_tensor_list, op=op,
+                group=group, sync_op=sync_op, use_calc_stream=use_calc_stream)
+
+
+def scatter(tensor, tensor_or_tensor_list=None, src=0, group=None,
+            sync_op=True, use_calc_stream=False):
+    return _run(_c.scatter, tensor, tensor_list=tensor_or_tensor_list,
+                src=src, group=group, sync_op=sync_op,
+                use_calc_stream=use_calc_stream)
+
+
+def send(tensor, dst=0, group=None, sync_op=True, use_calc_stream=False):
+    return _run(_c.send, tensor, dst=dst, group=group, sync_op=sync_op,
+                use_calc_stream=use_calc_stream)
+
+
+def recv(tensor, src=0, group=None, sync_op=True, use_calc_stream=False):
+    return _run(_c.recv, tensor, src=src, group=group, sync_op=sync_op,
+                use_calc_stream=use_calc_stream)

@@ -133,6 +133,50 @@ def main():
     got = dist.recv(t(np.zeros((2, 2), np.float32)), src=src)
     np.testing.assert_allclose(npv(got), np.full((2, 2), float(src)))
 
+    # batch_isend_irecv ring exchange (reference batch_isend_irecv.py
+    # example: every rank sends to the next and receives from the previous
+    # in ONE batch — deadlock-free regardless of issue order)
+    send_t = t(np.arange(2, dtype=np.float32) + rank)
+    recv_t = t(np.zeros((2,), np.float32))
+    tasks = dist.batch_isend_irecv([
+        dist.P2POp(dist.isend, send_t, dst),
+        dist.P2POp(dist.irecv, recv_t, src),
+    ])
+    for task in tasks:
+        task.wait()
+    np.testing.assert_allclose(npv(recv_t),
+                               np.arange(2, dtype=np.float32) + src)
+
+    # partial_send/partial_recv: ship only this rank's flat chunk of a
+    # stage activation, then partial_allgather reassembles the rest
+    # (reference partial_send_op/partial_recv_op/partial_allgather_op)
+    act = np.arange(nranks * 3, dtype=np.float32) + 1000.0 * rank
+    dist.partial_send(t(act), dst=dst, nranks=nranks, rank_id=rank)
+    hole = t(np.zeros(nranks * 3, np.float32))
+    got = dist.partial_recv(hole, src=src, nranks=nranks, rank_id=src)
+    chunk = 3
+    expect = np.zeros(nranks * 3, np.float32)
+    expect[src * chunk:(src + 1) * chunk] = (
+        np.arange(nranks * 3, dtype=np.float32)
+        + 1000.0 * src)[src * chunk:(src + 1) * chunk]
+    np.testing.assert_allclose(npv(got), expect)
+
+    # partial_allgather: every rank contributes its own chunk of `act`
+    pa = t(act.copy())
+    out = dist.partial_allgather(pa, nranks=nranks, rank_id=rank)
+    expect = np.concatenate([
+        (np.arange(nranks * 3, dtype=np.float32)
+         + 1000.0 * r)[r * chunk:(r + 1) * chunk]
+        for r in range(nranks)])
+    np.testing.assert_allclose(npv(out), expect)
+
+    # stream.* variants share eager semantics; sync_op=False returns a task
+    sx = t(np.full((2,), float(rank + 1), np.float32))
+    task = dist.stream.all_reduce(sx, sync_op=False, use_calc_stream=True)
+    task.wait()
+    np.testing.assert_allclose(
+        npv(sx), np.full((2,), float(sum(range(1, nranks + 1)))))
+
     # barrier
     dist.barrier()
 
