@@ -8,6 +8,8 @@ from .base import (  # noqa: F401
     UserDefinedRoleMaker,
 )
 from .fleet import Fleet, fleet  # noqa: F401
+from . import utils  # noqa: F401
+from .recompute import recompute  # noqa: F401
 
 init = fleet.init
 is_first_worker = fleet.is_first_worker

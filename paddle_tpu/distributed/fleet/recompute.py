@@ -1,0 +1,100 @@
+"""Activation recompute (gradient checkpointing) for the eager engine.
+
+Parity: reference python/paddle/distributed/fleet/recompute/recompute.py
+(RecomputeFunction): forward runs under no_grad (activations inside
+`function` are dropped), backward re-runs the function with grad enabled
+and differentiates the fresh subgraph.
+
+TPU mapping: inside a CompiledTrainStep / static Program the same policy
+is `jax.checkpoint` on the segment (static/__init__.py RecomputeContext,
+pipeline_parallel.py remat) — XLA rematerializes at schedule time. This
+module is the *eager* path for hand-written train loops.
+"""
+from __future__ import annotations
+
+from ...core.dispatch import enable_grad, no_grad
+from ...core.tensor import Tensor
+from ...framework import random as _random
+
+
+def recompute(function, *args, **kwargs):
+    """Checkpointed call: `function(*args)` whose internal activations are
+    recomputed during backward instead of stored.
+
+    kwargs: preserve_rng_state (default True) re-seeds the framework RNG
+    for the backward re-run so dropout masks match the forward.
+    """
+    preserve_rng = kwargs.pop("preserve_rng_state", True)
+    from ...core import autograd as eng
+    from ...core.dispatch import tape_enabled
+
+    kw_keys = sorted(kwargs)
+    in_tensors = ([a for a in args if isinstance(a, Tensor)]
+                  + [kwargs[k] for k in kw_keys
+                     if isinstance(kwargs[k], Tensor)])
+    # grads may flow to the explicit tensor args OR to trainable params
+    # captured inside `function` (the usual Layer case) — either one makes
+    # the checkpoint node necessary
+    fn_params = (function.parameters()
+                 if hasattr(function, "parameters") else [])
+    need_grad = tape_enabled() and (
+        any(not t.stop_gradient for t in in_tensors)
+        or any(not p.stop_gradient for p in fn_params))
+    rng_state = _random.get_rng_state() if preserve_rng else None
+
+    with no_grad():
+        outs = function(*args, **kwargs)
+    if not need_grad:
+        return outs
+    single = not isinstance(outs, (tuple, list))
+    was_tuple = isinstance(outs, tuple)
+    outs_t = [outs] if single else list(outs)
+    out_vals = [o._value for o in outs_t]
+    diff_idx = [i for i, t in enumerate(in_tensors) if not t.stop_gradient]
+
+    def vjp_fn(cots):
+        if preserve_rng:
+            saved = _random.get_rng_state()
+            _random.set_rng_state(rng_state)
+        try:
+            # re-run on detached leaves so the fresh subgraph's backward
+            # stops at this checkpoint's inputs (tensor kwargs included —
+            # an un-detached kwarg would let the nested backward walk into
+            # and free the pre-checkpoint graph)
+            leaves = []
+
+            def _leaf(t):
+                d = t.detach()
+                d.stop_gradient = t.stop_gradient
+                leaves.append(d)
+                return d
+
+            rerun_args = [(_leaf(a) if isinstance(a, Tensor) else a)
+                          for a in args]
+            rerun_kw = dict(kwargs)
+            for k in kw_keys:
+                if isinstance(kwargs[k], Tensor):
+                    rerun_kw[k] = _leaf(kwargs[k])
+            with enable_grad():
+                outs2 = function(*rerun_args, **rerun_kw)
+            outs2_t = ([outs2] if not isinstance(outs2, (tuple, list))
+                       else list(outs2))
+            seeds = [c for c in cots]
+            eng.run_backward(list(outs2_t), seeds)
+            grads = []
+            for i, d in enumerate(leaves):
+                if i in diff_idx and d.grad is not None:
+                    g = d.grad
+                    grads.append(g._value if isinstance(g, Tensor) else g)
+                else:
+                    grads.append(None)
+            return grads
+        finally:
+            if preserve_rng:
+                _random.set_rng_state(saved)
+
+    node = eng.GradNode("recompute", vjp_fn, in_tensors, out_vals)
+    wrapped = eng.attach_node(out_vals, node)
+    if single:
+        return wrapped[0]
+    return tuple(wrapped) if was_tuple else list(wrapped)

@@ -1,0 +1,17 @@
+"""fleet.utils namespace (reference fleet/utils/__init__.py)."""
+from __future__ import annotations
+
+from . import fs, http_server, hybrid_parallel_util  # noqa: F401
+from .fs import HDFSClient, LocalFS  # noqa: F401
+from .hybrid_parallel_util import (  # noqa: F401
+    broadcast_dp_parameters,
+    broadcast_input_data,
+    broadcast_mp_parameters,
+    broadcast_sharding_parameters,
+    fused_allreduce_gradients,
+    fused_allreduce_gradients_with_group,
+    sharding_reduce_gradients,
+)
+
+
+from ..recompute import recompute  # noqa: F401,E402

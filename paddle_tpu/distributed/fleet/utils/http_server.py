@@ -1,0 +1,108 @@
+"""HTTP key-value server for barrier-free bootstrap exchange.
+
+Parity: reference fleet/utils/http_server.py (KVHandler/KVHTTPServer/
+KVServer) — a scope/key store over GET/PUT/DELETE, used by gloo-style
+init to exchange endpoints before any collective backend exists. The TPU
+stack normally bootstraps over the native TCP store (csrc/store.cc), but
+the HTTP form survives plain proxies and is what reference launch-compat
+scripts expect.
+"""
+from __future__ import annotations
+
+import http.server
+import threading
+
+
+class KVHandler(http.server.BaseHTTPRequestHandler):
+    """GET /scope/key -> value bytes; PUT /scope/key <- body;
+    DELETE /scope/key (reference http_server.py:40)."""
+
+    def _split(self):
+        parts = self.path.strip("/").split("/")
+        if len(parts) < 2:
+            return None, None
+        return "/".join(parts[:-1]), parts[-1]
+
+    def do_GET(self):
+        scope, key = self._split()
+        with self.server.kv_lock:
+            value = self.server.kv.get(scope, {}).get(key)
+        if value is None:
+            self.send_status_code(404)
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(value)))
+        self.end_headers()
+        self.wfile.write(value)
+
+    def do_PUT(self):
+        scope, key = self._split()
+        if scope is None:
+            self.send_status_code(400)
+            return
+        length = int(self.headers.get("Content-Length", 0))
+        value = self.rfile.read(length)
+        with self.server.kv_lock:
+            self.server.kv.setdefault(scope, {})[key] = value
+        self.send_status_code(200)
+
+    def do_DELETE(self):
+        scope, key = self._split()
+        with self.server.kv_lock:
+            if scope in self.server.kv and key in self.server.kv[scope]:
+                del self.server.kv[scope][key]
+                self.server.delete_kv.setdefault(scope, []).append(key)
+        self.send_status_code(200)
+
+    def log_message(self, format, *args):
+        pass  # quiet; the reference logs to http.log
+
+    def send_status_code(self, code):
+        self.send_response(code)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+
+class KVHTTPServer(http.server.ThreadingHTTPServer):
+    """reference http_server.py:128."""
+
+    def __init__(self, port, handler):
+        super().__init__(("", port), handler)
+        self.kv_lock = threading.Lock()
+        self.kv = {}
+        self.delete_kv = {}
+
+    def get_deleted_size(self, key):
+        with self.kv_lock:
+            return len(self.delete_kv.get(key, []))
+
+
+class KVServer:
+    """Threaded server facade (reference http_server.py:151): `size` maps
+    scope -> expected delete count; `should_stop()` turns true once every
+    scope saw its deletes (all workers checked in and released)."""
+
+    def __init__(self, port, size=None):
+        self.http_server = KVHTTPServer(port, KVHandler)
+        self.listen_thread = None
+        self.size = dict(size or {})
+
+    @property
+    def port(self):
+        return self.http_server.server_address[1]
+
+    def start(self):
+        self.listen_thread = threading.Thread(
+            target=self.http_server.serve_forever, daemon=True)
+        self.listen_thread.start()
+
+    def stop(self):
+        self.http_server.shutdown()
+        self.listen_thread.join()
+        self.http_server.server_close()
+
+    def should_stop(self):
+        for key, size in self.size.items():
+            if self.http_server.get_deleted_size(key) < size:
+                return False
+        return True

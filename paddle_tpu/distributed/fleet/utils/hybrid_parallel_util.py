@@ -1,0 +1,121 @@
+"""Hybrid-parallel gradient/parameter sync helpers.
+
+Parity: reference fleet/utils/hybrid_parallel_util.py. TPU mapping: inside
+a CompiledTrainStep, XLA inserts (and overlaps) the dp grad all-reduces
+from shardings, so these helpers matter for the *eager* fallback path —
+custom train loops that call loss.backward() themselves. Bucketing
+(`bucket_size`) is unnecessary under one compiled module per collective;
+the argument is accepted for API compatibility.
+"""
+from __future__ import annotations
+
+from ....core.tensor import Tensor
+
+
+def _dist_mod():
+    # lazy: fleet.utils is imported while paddle_tpu.distributed is still
+    # initializing (fleet is one of its submodules)
+    from ... import collective as _c
+    from ... import env as _env
+
+    class _D:
+        all_reduce = staticmethod(_c.all_reduce)
+        broadcast = staticmethod(_c.broadcast)
+        get_world_size = staticmethod(_env.get_world_size)
+
+    return _D
+
+
+def _params_with_grad(parameter_list):
+    return [p for p in parameter_list
+            if getattr(p, "grad", None) is not None and not p.stop_gradient]
+
+
+def fused_allreduce_gradients_with_group(parameter_list, group, scale=None,
+                                         bucket_size=None):
+    """All-reduce every parameter's grad over `group`, then scale
+    (reference hybrid_parallel_util.py:194).
+
+    No-op without a multi-process world: in single-process SPMD the
+    compiled step's dp sharding already sums grads (XLA-inserted
+    all-reduce), so an eager pass here would double-count."""
+    from ...process_group import get_world_group
+
+    if group is None and get_world_group() is None:
+        return
+    n = group.nranks if group is not None else _dist_mod().get_world_size()
+    if n <= 1:
+        return
+    for p in _params_with_grad(parameter_list):
+        # leaf accumulation always stores .grad as a Tensor
+        out = _dist_mod().all_reduce(p.grad, group=group)
+        v = out._value if isinstance(out, Tensor) else out
+        p.grad._value = v / scale if scale is not None else v
+
+
+def fused_allreduce_gradients(parameter_list, hcg):
+    """dp-group grad all-reduce + average (reference :206)."""
+    from ...process_group import get_world_group
+
+    group = hcg.get_data_parallel_group() if hcg is not None else None
+    if group is None and get_world_group() is None:
+        return
+    n = group.nranks if group is not None else _dist_mod().get_world_size()
+    if n <= 1:
+        return
+    fused_allreduce_gradients_with_group(parameter_list, group, scale=n)
+
+
+def sharding_reduce_gradients(parameter_list, hcg):
+    """ZeRO eager path: reduce grads over the sharding group; each rank
+    keeps the average (reference :212 — reduce-to-owner; with XLA the
+    all-reduce form costs the same on a torus and keeps grads addressable
+    for the owner-shard update)."""
+    group = hcg.get_sharding_parallel_group()
+    if group.nranks <= 1:
+        return
+    fused_allreduce_gradients_with_group(parameter_list, group,
+                                         scale=group.nranks)
+
+
+def _broadcast_params(model, group, src_rank):
+    if group is None or group.nranks <= 1:
+        return
+    for _, p in model.named_parameters():
+        _dist_mod().broadcast(p, src=src_rank, group=group)
+
+
+def broadcast_mp_parameters(model, hcg):
+    """reference :178 — align tp ranks' non-sharded params at init."""
+    _broadcast_params(model, hcg.get_model_parallel_group(),
+                      hcg.get_model_parallel_group_src_rank())
+
+
+def broadcast_dp_parameters(model, hcg):
+    """reference :186 — align dp replicas at init."""
+    _broadcast_params(model, hcg.get_data_parallel_group(),
+                      hcg.get_data_parallel_group_src_rank())
+
+
+def broadcast_sharding_parameters(model, hcg):
+    """reference :229 — align sharding-group replicas at init."""
+    group = hcg.get_sharding_parallel_group()
+    src = group.ranks[0] if group.ranks else 0
+    _broadcast_params(model, group, src)
+
+
+def broadcast_input_data(hcg, *inputs, **kwargs):
+    """Broadcast step inputs from the mp-group src rank (reference :139):
+    tp ranks must see identical data or activations diverge."""
+    group = hcg.get_model_parallel_group()
+    if group is None or group.nranks <= 1:
+        return inputs if not kwargs else (inputs, kwargs)
+    src = hcg.get_model_parallel_group_src_rank()
+    out = tuple(_dist_mod().broadcast(x, src=src, group=group)
+                if isinstance(x, Tensor) else x for x in inputs)
+    kw = {k: (_dist_mod().broadcast(v, src=src, group=group)
+              if isinstance(v, Tensor) else v)
+          for k, v in kwargs.items()}
+    if kwargs:
+        return out, kw
+    return out

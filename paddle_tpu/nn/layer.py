@@ -159,6 +159,12 @@ class Layer:
         return [p for _, p in self.named_parameters(
             include_sublayers=include_sublayers)]
 
+    def clear_gradients(self):
+        """Zero out all parameters' grads (reference Layer.clear_gradients,
+        fluid/dygraph/layers.py)."""
+        for p in self.parameters():
+            p.clear_grad()
+
     def named_buffers(self, prefix=""):
         for lname, layer in self.named_sublayers(prefix=prefix, include_self=True):
             for bname, b in layer._buffers.items():

@@ -1,0 +1,223 @@
+"""fleet.utils: fs clients, http KV server, recompute, hybrid helpers.
+
+Parity model: reference fleet/utils/{fs.py,http_server.py},
+fleet/recompute/recompute.py and their unittests
+(test_fs_interface / test_hdfs*, test_dygraph_recompute).
+"""
+import os
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet.utils import HDFSClient, LocalFS
+from paddle_tpu.distributed.fleet.utils.fs import (
+    FSFileExistsError,
+    FSFileNotExistsError,
+)
+from paddle_tpu.distributed.fleet.utils.http_server import KVServer
+
+
+class TestLocalFS:
+    def test_roundtrip(self, tmp_path):
+        fs = LocalFS()
+        root = str(tmp_path / "fsroot")
+        fs.mkdirs(root)
+        assert fs.is_dir(root) and fs.is_exist(root)
+        f = os.path.join(root, "a.txt")
+        fs.touch(f)
+        assert fs.is_file(f)
+        with open(f, "w") as fh:
+            fh.write("hello")
+        assert fs.cat(f) == "hello"
+        fs.mkdirs(os.path.join(root, "sub"))
+        dirs, files = fs.ls_dir(root)
+        assert dirs == ["sub"] and files == ["a.txt"]
+        assert fs.list_dirs(root) == ["sub"]
+        fs.mv(f, os.path.join(root, "b.txt"))
+        assert not fs.is_exist(f)
+        with pytest.raises(FSFileNotExistsError):
+            fs.mv(f, os.path.join(root, "c.txt"))
+        fs.touch(os.path.join(root, "c.txt"))
+        with pytest.raises(FSFileExistsError):
+            fs.mv(os.path.join(root, "b.txt"), os.path.join(root, "c.txt"))
+        fs.delete(root)
+        assert not fs.is_exist(root)
+        assert not fs.need_upload_download()
+
+
+class TestHDFSClient:
+    """Command construction against a fake runner (no hadoop install)."""
+
+    def _client(self, responses):
+        calls = []
+
+        def runner(cmd):
+            calls.append(cmd)
+            for pat, resp in responses.items():
+                if pat in cmd:
+                    return resp
+            return 0, ""
+
+        c = HDFSClient("/opt/hadoop",
+                       configs={"fs.default.name": "hdfs://ns",
+                                "hadoop.job.ugi": "u,p"},
+                       runner=runner, sleep_inter=1)
+        return c, calls
+
+    def test_base_cmd_carries_configs(self):
+        c, calls = self._client({})
+        c.mkdirs("/remote/dir")
+        cmd = calls[0]
+        assert cmd[0] == "/opt/hadoop/bin/hadoop" and cmd[1] == "fs"
+        assert "-Dfs.default.name=hdfs://ns" in cmd
+        assert "-Dhadoop.job.ugi=u,p" in cmd
+        assert cmd[-3:] == ["-mkdir", "-p", "/remote/dir"]
+
+    def test_ls_dir_parses_dirs_and_files(self):
+        listing = ("Found 2 items\n"
+                   "drwxr-xr-x   - u g          0 2026-01-01 00:00 /r/sub\n"
+                   "-rw-r--r--   3 u g       1024 2026-01-01 00:00 /r/f.txt\n")
+        c, _ = self._client({"-ls": (0, listing)})
+        dirs, files = c.ls_dir("/r")
+        assert dirs == ["sub"] and files == ["f.txt"]
+        assert c.list_dirs("/r") == ["sub"]
+
+    def test_is_exist_retries_once_only(self):
+        c, calls = self._client({"-test": (1, "")})
+        assert not c.is_exist("/nope")
+        assert len(calls) == 1  # -test non-zero means "no", not "retry"
+
+    def test_mv_semantics(self):
+        c, calls = self._client({"-test": (1, "")})
+        with pytest.raises(FSFileNotExistsError):
+            c.mv("/src", "/dst")
+        assert c.need_upload_download()
+
+
+class TestKVServer:
+    def test_put_get_delete_and_should_stop(self):
+        srv = KVServer(0, size={"barrier": 2})
+        srv.start()
+        try:
+            base = "http://127.0.0.1:%d" % srv.port
+            req = urllib.request.Request(
+                base + "/barrier/rank0", data=b"ep0", method="PUT")
+            assert urllib.request.urlopen(req).status == 200
+            req = urllib.request.Request(
+                base + "/barrier/rank1", data=b"ep1", method="PUT")
+            urllib.request.urlopen(req)
+            got = urllib.request.urlopen(base + "/barrier/rank0").read()
+            assert got == b"ep0"
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(base + "/barrier/missing")
+            assert not srv.should_stop()
+            for r in ("rank0", "rank1"):
+                req = urllib.request.Request(
+                    base + "/barrier/" + r, method="DELETE")
+                urllib.request.urlopen(req)
+            assert srv.should_stop()
+        finally:
+            srv.stop()
+
+
+class TestRecompute:
+    """Grads with recompute must equal grads without (reference
+    test_dygraph_recompute.py equivalence check)."""
+
+    def _make(self):
+        paddle.seed(11)
+        return nn.Sequential(
+            nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 8), nn.ReLU(),
+            nn.Linear(8, 4))
+
+    def test_grad_equivalence(self):
+        m = self._make()
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(4, 8).astype(np.float32))
+        x.stop_gradient = False
+
+        out = m(x)
+        loss = (out * out).mean()
+        loss.backward()
+        ref = {n: np.asarray(p.grad._value)
+               for n, p in m.named_parameters()}
+        ref_x = np.asarray(x.grad._value)
+
+        m.clear_gradients()
+        x2 = paddle.to_tensor(np.asarray(x._value))
+        x2.stop_gradient = False
+        out = fleet.recompute(m, x2)
+        loss = (out * out).mean()
+        loss.backward()
+        for n, p in m.named_parameters():
+            np.testing.assert_allclose(np.asarray(p.grad._value), ref[n],
+                                       rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(x2.grad._value), ref_x,
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_preserves_dropout_mask(self):
+        paddle.seed(3)
+        m = nn.Sequential(nn.Linear(8, 32), nn.Dropout(0.5),
+                          nn.Linear(32, 2))
+        m.train()
+        x = paddle.to_tensor(
+            np.random.RandomState(1).randn(16, 8).astype(np.float32))
+        out = fleet.recompute(m, x)
+        loss = (out * out).mean()
+        loss.backward()  # would mismatch shapes/masks if rng not preserved
+        for _, p in m.named_parameters():
+            assert p.grad is not None
+
+    def test_tensor_kwargs_checkpointed(self):
+        """Tensor kwargs must be detached in the re-run and receive grads
+        (regression: kwargs used to bypass the checkpoint boundary)."""
+        lin = nn.Linear(8, 8)
+        x = paddle.to_tensor(
+            np.random.RandomState(2).randn(4, 8).astype(np.float32))
+        x.stop_gradient = False
+
+        def f(a, bias=None):
+            return F.relu(lin(a)) + bias
+
+        y = lin(x)  # non-leaf feeding in via kwarg
+        out = fleet.recompute(f, y, bias=y)
+        loss = (out * out).mean()
+        loss.backward()
+        assert x.grad is not None
+        assert lin.weight.grad is not None
+
+    def test_tuple_output_preserved(self):
+        m = self._make()
+        x = paddle.to_tensor(np.zeros((2, 8), np.float32))
+        x.stop_gradient = False
+
+        def f(a):
+            o = m(a)
+            return (o, o.mean())
+
+        out = fleet.recompute(f, x)
+        assert isinstance(out, tuple) and len(out) == 2
+
+    def test_no_grad_passthrough(self):
+        m = self._make()
+        x = paddle.to_tensor(np.zeros((2, 8), np.float32))
+        with paddle.no_grad():
+            out = fleet.recompute(m, x)
+        assert out.shape == [2, 4]
+
+
+class TestHybridParallelUtil:
+    def test_fused_allreduce_gradients_single_process_noop(self):
+        m = nn.Linear(4, 4)
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        loss = m(x).mean()
+        loss.backward()
+        g0 = np.asarray(m.weight.grad._value)
+        fleet.utils.fused_allreduce_gradients(
+            [p for _, p in m.named_parameters()], None)
+        np.testing.assert_allclose(np.asarray(m.weight.grad._value), g0)
