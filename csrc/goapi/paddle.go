@@ -1,0 +1,161 @@
+// Package paddle — Go inference API over the paddle_tpu C ABI.
+//
+// Parity: reference paddle/fluid/inference/goapi/ (Config/Predictor/
+// Tensor over capi_exp). The TPU C ABI (csrc/pt_capi.h, implemented by
+// libpaddle_tpu_capi.so) is prefix-based: a saved-inference-model prefix
+// loads a frozen StableHLO module, and IO rides named float tensors.
+//
+// Build: go build with CGO_CFLAGS=-I<repo>/csrc and
+// CGO_LDFLAGS="-L<repo>/csrc -lpaddle_tpu_capi" (see README.md).
+package paddle
+
+/*
+#cgo LDFLAGS: -lpaddle_tpu_capi
+#include <stdlib.h>
+#include "pt_capi.h"
+*/
+import "C"
+
+import (
+	"errors"
+	"runtime"
+	"unsafe"
+)
+
+// Config mirrors the reference goapi Config: it records the model path
+// (device selection is owned by PJRT on the TPU stack).
+type Config struct {
+	modelPrefix string
+}
+
+func NewConfig() *Config { return &Config{} }
+
+// SetModel takes the saved prefix (reference takes model+params files;
+// the TPU artifact is `<prefix>.pdmodel` + `<prefix>.pdmeta`).
+func (c *Config) SetModel(modelPrefix string, _ ...string) {
+	c.modelPrefix = modelPrefix
+}
+
+func (c *Config) ModelPrefix() string { return c.modelPrefix }
+
+// Predictor wraps pt_predictor_*.
+type Predictor struct {
+	h unsafe.Pointer
+}
+
+func NewPredictor(config *Config) (*Predictor, error) {
+	cs := C.CString(config.modelPrefix)
+	defer C.free(unsafe.Pointer(cs))
+	h := C.pt_predictor_create(cs)
+	if h == nil {
+		return nil, errors.New("pt_predictor_create failed for " +
+			config.modelPrefix)
+	}
+	p := &Predictor{h: h}
+	runtime.SetFinalizer(p, func(p *Predictor) {
+		C.pt_predictor_destroy(p.h)
+	})
+	return p, nil
+}
+
+func (p *Predictor) GetInputNum() int {
+	n := int(C.pt_predictor_num_inputs(p.h))
+	runtime.KeepAlive(p)
+	return n
+}
+
+func (p *Predictor) GetOutputNum() int {
+	n := int(C.pt_predictor_num_outputs(p.h))
+	runtime.KeepAlive(p)
+	return n
+}
+
+func (p *Predictor) GetInputNames() []string {
+	n := p.GetInputNum()
+	names := make([]string, n)
+	for i := 0; i < n; i++ {
+		names[i] = C.GoString(C.pt_predictor_input_name(p.h, C.int(i)))
+	}
+	runtime.KeepAlive(p)
+	return names
+}
+
+func (p *Predictor) GetOutputNames() []string {
+	n := p.GetOutputNum()
+	names := make([]string, n)
+	for i := 0; i < n; i++ {
+		names[i] = C.GoString(C.pt_predictor_output_name(p.h, C.int(i)))
+	}
+	runtime.KeepAlive(p)
+	return names
+}
+
+func (p *Predictor) GetInputHandle(name string) *Tensor {
+	return &Tensor{pred: p, name: name}
+}
+
+func (p *Predictor) GetOutputHandle(name string) *Tensor {
+	return &Tensor{pred: p, name: name}
+}
+
+// Run executes the compiled module over the bound inputs.
+func (p *Predictor) Run() error {
+	rc := C.pt_predictor_run(p.h)
+	runtime.KeepAlive(p)
+	if rc != 0 {
+		return errors.New("pt_predictor_run failed")
+	}
+	return nil
+}
+
+// Tensor is a named IO handle (reference goapi Tensor over
+// PD_TensorCopyFromCpuFloat etc.).
+type Tensor struct {
+	pred *Predictor
+	name string
+}
+
+func (t *Tensor) Name() string { return t.name }
+
+// Reshape is a no-op: the TPU C ABI takes the shape with the data
+// (kept for reference-API source compatibility).
+func (t *Tensor) Reshape(shape []int32) {}
+
+func (t *Tensor) Shape() []int32 {
+	cn := C.CString(t.name)
+	defer C.free(unsafe.Pointer(cn))
+	nd := int(C.pt_tensor_ndim(t.pred.h, cn))
+	if nd <= 0 {
+		runtime.KeepAlive(t.pred)
+		return nil
+	}
+	buf := make([]C.int64_t, nd)
+	C.pt_tensor_shape(t.pred.h, cn, &buf[0])
+	runtime.KeepAlive(t.pred)
+	out := make([]int32, nd)
+	for i, v := range buf {
+		out[i] = int32(v)
+	}
+	return out
+}
+
+func (t *Tensor) CopyFromCpu(data []float32, shape []int32) {
+	cn := C.CString(t.name)
+	defer C.free(unsafe.Pointer(cn))
+	cshape := make([]C.int64_t, len(shape))
+	for i, d := range shape {
+		cshape[i] = C.int64_t(d)
+	}
+	C.pt_tensor_copy_from_cpu_float(t.pred.h, cn,
+		(*C.float)(unsafe.Pointer(&data[0])), &cshape[0],
+		C.int(len(shape)))
+	runtime.KeepAlive(t.pred)
+}
+
+func (t *Tensor) CopyToCpu(data []float32) {
+	cn := C.CString(t.name)
+	defer C.free(unsafe.Pointer(cn))
+	C.pt_tensor_copy_to_cpu_float(t.pred.h, cn,
+		(*C.float)(unsafe.Pointer(&data[0])))
+	runtime.KeepAlive(t.pred)
+}

@@ -300,6 +300,13 @@ from .core.enforce import (  # noqa: F401
     enforce,
 )
 from .core.selected_rows import SelectedRows  # noqa: F401
+from .core.string_tensor import (  # noqa: F401
+    StringTensor,
+    strings_copy,
+    strings_empty,
+    strings_lower,
+    strings_upper,
+)
 from .core.tensor_array import (  # noqa: F401
     Scope,
     TensorArray,

@@ -1,0 +1,129 @@
+"""StringTensor — n-dimensional tensor of variable-length byte strings.
+
+Parity: reference phi/core/string_tensor.h (pstring payload + DDim meta)
+and the strings kernel family (phi/kernels/strings/: strings_empty,
+strings_copy, strings_lower, strings_upper with the utf-8 aware
+case-conversion tables in unicode.h / case_utils.h).
+
+TPU mapping: strings never reach the accelerator — the reference keeps
+StringTensor host-side too (CPU-only kernel registrations). Here it wraps
+a numpy object array of `bytes`, which keeps arbitrary binary payloads
+(the reference's pstring is not nul-terminated either) and slots into the
+host-side data pipeline ahead of tokenization.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def _as_bytes(x):
+    if isinstance(x, bytes):
+        return x
+    if isinstance(x, str):
+        return x.encode("utf-8")
+    raise TypeError("StringTensor holds str/bytes, got %r" % type(x))
+
+
+class StringTensor:
+    """reference phi/core/string_tensor.h:31."""
+
+    def __init__(self, data, shape=None):
+        if isinstance(data, StringTensor):
+            arr = data._data.copy()
+        else:
+            arr = np.asarray(data, dtype=object)
+            flat = [_as_bytes(v) for v in arr.ravel().tolist()]
+            arr = np.asarray(flat, dtype=object).reshape(arr.shape)
+        if shape is not None:
+            arr = arr.reshape(shape)
+        self._data = arr
+
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    def numel(self):
+        return int(self._data.size)
+
+    @property
+    def dtype(self):
+        return "pstring"
+
+    def numpy(self):
+        return self._data
+
+    def tolist(self, encoding="utf-8"):
+        """Decoded nested python lists (utf-8 by default)."""
+        def dec(x):
+            return x.decode(encoding) if encoding else x
+
+        return np.vectorize(dec, otypes=[object])(self._data).tolist() \
+            if self._data.size else self._data.tolist()
+
+    def __getitem__(self, idx):
+        out = self._data[idx]
+        if isinstance(out, bytes):
+            return out
+        return StringTensor(out)
+
+    def __eq__(self, other):
+        if not isinstance(other, StringTensor):
+            return NotImplemented
+        return bool(np.array_equal(self._data, other._data))
+
+    def __hash__(self):
+        # value hash consistent with __eq__ (string tensors are small,
+        # host-side metadata — hashing the payload is fine)
+        return hash((tuple(self._data.shape),
+                     tuple(self._data.ravel().tolist())))
+
+    def __repr__(self):
+        return "StringTensor(shape=%s, %r)" % (self.shape,
+                                               self._data.tolist())
+
+
+def _elementwise(st, fn):
+    flat = [fn(v) for v in st._data.ravel().tolist()]
+    out = np.asarray(flat, dtype=object).reshape(st._data.shape)
+    return StringTensor(out)
+
+
+def strings_empty(shape):
+    """reference strings_empty_kernel: tensor of empty pstrings."""
+    n = int(np.prod(shape)) if shape else 1
+    arr = np.asarray([b""] * n, dtype=object).reshape(shape)
+    return StringTensor(arr)
+
+
+def strings_copy(src):
+    """reference strings_copy_kernel: deep copy."""
+    return StringTensor(src)
+
+
+def _convert(data, use_utf8_encoding, str_fn):
+    """Reference strings_lower_upper_kernel semantics:
+    use_utf8_encoding=False -> ASCII-only case conversion;
+    True -> full utf-8 (unicode) conversion (unicode.h tables)."""
+    if use_utf8_encoding:
+        return str_fn(data.decode("utf-8", errors="surrogateescape")) \
+            .encode("utf-8", errors="surrogateescape")
+    out = bytearray(data)
+    lower = str_fn("A") == "a"
+    for i, c in enumerate(out):
+        if lower and 0x41 <= c <= 0x5A:
+            out[i] = c + 0x20
+        elif not lower and 0x61 <= c <= 0x7A:
+            out[i] = c - 0x20
+    return bytes(out)
+
+
+def strings_lower(st, use_utf8_encoding=False):
+    """reference strings_lower_upper_kernel.h StringLowerKernel."""
+    return _elementwise(
+        st, lambda b: _convert(b, use_utf8_encoding, str.lower))
+
+
+def strings_upper(st, use_utf8_encoding=False):
+    """reference strings_lower_upper_kernel.h StringUpperKernel."""
+    return _elementwise(
+        st, lambda b: _convert(b, use_utf8_encoding, str.upper))

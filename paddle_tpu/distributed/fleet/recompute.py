@@ -49,7 +49,11 @@ def recompute(function, *args, **kwargs):
     single = not isinstance(outs, (tuple, list))
     was_tuple = isinstance(outs, tuple)
     outs_t = [outs] if single else list(outs)
-    out_vals = [o._value for o in outs_t]
+    # only Tensor outputs join the grad graph; scalars/None/etc. pass
+    # through verbatim (reference RecomputeFunction filters the same way)
+    tensor_idx = [i for i, o in enumerate(outs_t)
+                  if isinstance(o, Tensor)]
+    out_vals = [outs_t[i]._value for i in tensor_idx]
     diff_idx = [i for i, t in enumerate(in_tensors) if not t.stop_gradient]
 
     def vjp_fn(cots):
@@ -79,8 +83,9 @@ def recompute(function, *args, **kwargs):
                 outs2 = function(*rerun_args, **rerun_kw)
             outs2_t = ([outs2] if not isinstance(outs2, (tuple, list))
                        else list(outs2))
+            roots = [outs2_t[i] for i in tensor_idx]
             seeds = [c for c in cots]
-            eng.run_backward(list(outs2_t), seeds)
+            eng.run_backward(roots, seeds)
             grads = []
             for i, d in enumerate(leaves):
                 if i in diff_idx and d.grad is not None:
@@ -95,6 +100,9 @@ def recompute(function, *args, **kwargs):
 
     node = eng.GradNode("recompute", vjp_fn, in_tensors, out_vals)
     wrapped = eng.attach_node(out_vals, node)
+    result = list(outs_t)
+    for i, w in zip(tensor_idx, wrapped):
+        result[i] = w
     if single:
-        return wrapped[0]
-    return tuple(wrapped) if was_tuple else list(wrapped)
+        return result[0]
+    return tuple(result) if was_tuple else result

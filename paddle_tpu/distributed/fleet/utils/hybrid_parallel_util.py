@@ -46,9 +46,10 @@ def fused_allreduce_gradients_with_group(parameter_list, group, scale=None,
     n = group.nranks if group is not None else _dist_mod().get_world_size()
     if n <= 1:
         return
+    d = _dist_mod()
     for p in _params_with_grad(parameter_list):
         # leaf accumulation always stores .grad as a Tensor
-        out = _dist_mod().all_reduce(p.grad, group=group)
+        out = d.all_reduce(p.grad, group=group)
         v = out._value if isinstance(out, Tensor) else out
         p.grad._value = v / scale if scale is not None else v
 
@@ -81,8 +82,9 @@ def sharding_reduce_gradients(parameter_list, hcg):
 def _broadcast_params(model, group, src_rank):
     if group is None or group.nranks <= 1:
         return
+    d = _dist_mod()
     for _, p in model.named_parameters():
-        _dist_mod().broadcast(p, src=src_rank, group=group)
+        d.broadcast(p, src=src_rank, group=group)
 
 
 def broadcast_mp_parameters(model, hcg):
@@ -111,9 +113,10 @@ def broadcast_input_data(hcg, *inputs, **kwargs):
     if group is None or group.nranks <= 1:
         return inputs if not kwargs else (inputs, kwargs)
     src = hcg.get_model_parallel_group_src_rank()
-    out = tuple(_dist_mod().broadcast(x, src=src, group=group)
+    d = _dist_mod()
+    out = tuple(d.broadcast(x, src=src, group=group)
                 if isinstance(x, Tensor) else x for x in inputs)
-    kw = {k: (_dist_mod().broadcast(v, src=src, group=group)
+    kw = {k: (d.broadcast(v, src=src, group=group)
               if isinstance(v, Tensor) else v)
           for k, v in kwargs.items()}
     if kwargs:

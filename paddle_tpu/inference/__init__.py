@@ -16,6 +16,7 @@ import numpy as np
 
 __all__ = [
     "Config", "create_predictor", "Predictor", "PlaceType",
+    "PrecisionType", "convert_to_mixed_precision",
 ]
 
 
@@ -24,6 +25,117 @@ class PlaceType:
     GPU = "gpu"
     TPU = "tpu"
     XPU = "xpu"
+
+
+class PrecisionType:
+    """reference paddle_infer.PrecisionType (paddle_inference_api.h)."""
+
+    Float32 = "float32"
+    Half = "float16"
+    Bfloat16 = "bfloat16"
+    Int8 = "int8"
+
+
+def convert_to_mixed_precision(model_file, params_file, mixed_model_file,
+                               mixed_params_file,
+                               mixed_precision=PrecisionType.Bfloat16,
+                               backend=PlaceType.TPU, keep_io_types=True,
+                               black_list=None):
+    """Convert a saved fp32 jit.save artifact to mixed precision
+    (reference inference/wrapper.py:64 convert_to_mixed_precision →
+    convert_to_mixed_precision.cc pass).
+
+    TPU mapping: the jit.save format keeps params (.pdiparams) separate
+    from the program, whose call signature is (state, *inputs). The
+    converter casts float32 params to `mixed_precision` (black_list =
+    param names kept fp32 — norm scales etc.) and re-exports the program
+    with a cast-at-entry wrapper, halving the artifact and serve-time
+    weight HBM; XLA folds the upcasts into first use. Op-level compute
+    dtype is fixed at export time — for bf16 MXU compute, export under
+    `amp.decorate(level='O2')` + jit.save (documented deviation: the
+    reference rewrites op dtypes post-hoc in the ProgramDesc, which a
+    serialized StableHLO module doesn't permit).
+
+    model_file/params_file accept either the full filename
+    (`prefix.pdmodel`) or the prefix, like Config.
+    """
+    import os
+    import pickle
+
+    import jax
+    import jax.numpy as jnp
+    import ml_dtypes  # noqa: F401  (numpy bf16 support)
+
+    if mixed_precision == PrecisionType.Int8:
+        raise ValueError(
+            "int8 conversion is the quantization pipeline "
+            "(paddle_tpu.quantization PTQ), not a dtype cast")
+    black_list = set(black_list or ())
+    target = jnp.dtype(mixed_precision)
+
+    def _prefix(p, suffix):
+        return p[: -len(suffix)] if p.endswith(suffix) else p
+
+    src = _prefix(model_file, ".pdmodel")
+    src_params = (_prefix(params_file, ".pdiparams")
+                  if params_file else src)
+    dst = _prefix(mixed_model_file, ".pdmodel")
+    with open(src_params + ".pdiparams", "rb") as f:
+        state = pickle.load(f)
+    with open(src + ".pdmodel", "rb") as f:
+        payload = pickle.load(f)
+    if not (isinstance(payload, dict) and "meta" in payload):
+        raise ValueError(
+            "convert_to_mixed_precision needs the jit.save artifact "
+            "format; static.save_inference_model freezes params into the "
+            "compiled module — re-export that model under "
+            "amp.decorate(level='O2') instead")
+    meta = dict(payload["meta"])
+    blob = payload.get("stablehlo")
+
+    orig_dtypes = {}
+    mixed_state = {}
+    for name, v in state.items():
+        arr = np.asarray(v)
+        orig_dtypes[name] = str(arr.dtype)
+        if arr.dtype == np.float32 and name not in black_list:
+            arr = arr.astype(target)
+        mixed_state[name] = arr
+
+    if blob:
+        from jax import export as jex
+
+        from ..jit import export_with_dynamic_dims
+        from ..core import dtype as _dtype
+
+        exported = jex.deserialize(blob)
+        names = meta.get("state_names") or sorted(state.keys())
+        cast_back = [jnp.dtype(orig_dtypes[n]) for n in names]
+
+        def mixed_call(state_vals, *in_vals):
+            full = [v.astype(d) if v.dtype != d else v
+                    for v, d in zip(state_vals, cast_back)]
+            out = exported.call(full, *in_vals)
+            if not keep_io_types:
+                out = jax.tree_util.tree_map(
+                    lambda o: o.astype(target)
+                    if o.dtype == jnp.float32 else o, out)
+            return out
+
+        specs = [(tuple(s["shape"]), _dtype.to_jax(s["dtype"]))
+                 for s in meta.get("input_spec", [])]
+        lead = [jnp.asarray(mixed_state[n]) for n in names]
+        meta["mixed_precision"] = mixed_precision
+        blob = export_with_dynamic_dims(mixed_call, specs,
+                                        leading_args=(lead,))
+
+    os.makedirs(os.path.dirname(dst) or ".", exist_ok=True)
+    params_dst = _prefix(mixed_params_file, ".pdiparams")
+    os.makedirs(os.path.dirname(params_dst) or ".", exist_ok=True)
+    with open(params_dst + ".pdiparams", "wb") as f:
+        pickle.dump(mixed_state, f, protocol=4)
+    with open(dst + ".pdmodel", "wb") as f:
+        pickle.dump({"meta": meta, "stablehlo": blob}, f, protocol=4)
 
 
 class Config:

@@ -105,3 +105,40 @@ class TestEnforce:
             from paddle_tpu.core.enforce import enforce_not_none
 
             enforce_not_none(None, "missing var")
+
+
+class TestStringTensor:
+    """reference phi/core/string_tensor.h + kernels/strings/ (empty/copy/
+    lower/upper with ascii and utf-8 modes)."""
+
+    def test_construct_and_meta(self):
+        st = paddle.StringTensor([["Hello", "World"], ["a", "b"]])
+        assert st.shape == [2, 2]
+        assert st.numel() == 4
+        assert st.dtype == "pstring"
+        assert st[0, 0] == b"Hello"
+        assert st.tolist() == [["Hello", "World"], ["a", "b"]]
+
+    def test_empty_and_copy(self):
+        st = paddle.strings_empty((3,))
+        assert st.tolist() == ["", "", ""]
+        src = paddle.StringTensor(["x"])
+        cp = paddle.strings_copy(src)
+        assert cp == src and cp is not src
+
+    def test_lower_upper_ascii(self):
+        st = paddle.StringTensor(["MiXeD 123!", "ABC"])
+        assert paddle.strings_lower(st).tolist() == ["mixed 123!", "abc"]
+        assert paddle.strings_upper(st).tolist() == ["MIXED 123!", "ABC"]
+
+    def test_ascii_mode_leaves_non_ascii_bytes(self):
+        st = paddle.StringTensor(["Ä"])  # utf-8 bytes 0xC3 0x84
+        low = paddle.strings_lower(st, use_utf8_encoding=False)
+        assert low[0] == "Ä".encode()  # untouched without utf8 mode
+
+    def test_lower_upper_utf8(self):
+        st = paddle.StringTensor(["ÄÖÜ straße"])
+        low = paddle.strings_lower(st, use_utf8_encoding=True)
+        assert low.tolist() == ["äöü straße"]
+        up = paddle.strings_upper(st, use_utf8_encoding=True)
+        assert up.tolist() == ["ÄÖÜ STRASSE"]

@@ -203,6 +203,24 @@ class TestRecompute:
         out = fleet.recompute(f, x)
         assert isinstance(out, tuple) and len(out) == 2
 
+    def test_non_tensor_outputs_pass_through(self):
+        """Scalars/None mixed into the output tuple survive; only Tensor
+        outputs join the grad graph (reference RecomputeFunction filter)."""
+        m = self._make()
+        x = paddle.to_tensor(
+            np.random.RandomState(5).randn(2, 8).astype(np.float32))
+        x.stop_gradient = False
+
+        def f(a):
+            o = m(a)
+            return o, int(a.shape[0]), None
+
+        out, n, none = fleet.recompute(f, x)
+        assert n == 2 and none is None
+        (out * out).mean().backward()
+        assert x.grad is not None
+        assert m[0].weight.grad is not None
+
     def test_no_grad_passthrough(self):
         m = self._make()
         x = paddle.to_tensor(np.zeros((2, 8), np.float32))

@@ -1,0 +1,119 @@
+"""Inference deployment API: Config/Predictor + convert_to_mixed_precision.
+
+Parity model: reference inference/api/analysis_predictor.cc tests and
+fluid/tests/unittests/ir/test_convert_to_mixed_precision.py.
+"""
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.inference import (
+    Config,
+    PrecisionType,
+    convert_to_mixed_precision,
+    create_predictor,
+)
+
+
+def _save_model(tmp_path, with_ln=True):
+    paddle.seed(0)
+    layers = [nn.Linear(8, 16), nn.ReLU()]
+    if with_ln:
+        layers.append(nn.LayerNorm(16))
+    layers.append(nn.Linear(16, 4))
+    m = nn.Sequential(*layers)
+    prefix = str(tmp_path / "fp32" / "m")
+    paddle.jit.save(
+        m, prefix,
+        input_spec=[paddle.static.InputSpec([None, 8], "float32")])
+    return m, prefix
+
+
+class TestConvertToMixedPrecision:
+    def test_bf16_roundtrip_with_blacklist(self, tmp_path):
+        import ml_dtypes
+
+        m, prefix = _save_model(tmp_path)
+        mixed = str(tmp_path / "mixed" / "m")
+        convert_to_mixed_precision(
+            prefix + ".pdmodel", prefix + ".pdiparams",
+            mixed + ".pdmodel", mixed + ".pdiparams",
+            PrecisionType.Bfloat16,
+            black_list={"2.weight", "2.bias"})
+
+        st = pickle.load(open(mixed + ".pdiparams", "rb"))
+        assert st["0.weight"].dtype == ml_dtypes.bfloat16
+        assert st["2.weight"].dtype == np.float32  # black_list kept fp32
+
+        x = np.random.RandomState(0).randn(4, 8).astype(np.float32)
+        ref = np.asarray(m(paddle.to_tensor(x))._value)
+        out = paddle.jit.load(mixed)(paddle.to_tensor(x))
+        assert str(out.dtype).endswith("float32")  # keep_io_types default
+        np.testing.assert_allclose(np.asarray(out._value), ref,
+                                   rtol=2e-2, atol=2e-2)
+
+    def test_params_file_path_honored(self, tmp_path):
+        """params_file may live at a different path than the model file."""
+        import shutil
+
+        m, prefix = _save_model(tmp_path, with_ln=False)
+        alt = str(tmp_path / "elsewhere" / "weights")
+        os.makedirs(os.path.dirname(alt))
+        shutil.move(prefix + ".pdiparams", alt + ".pdiparams")
+        mixed = str(tmp_path / "mixedalt" / "m")
+        convert_to_mixed_precision(
+            prefix + ".pdmodel", alt + ".pdiparams",
+            mixed + ".pdmodel", mixed + ".pdiparams",
+            PrecisionType.Bfloat16)
+        assert os.path.exists(mixed + ".pdiparams")
+
+    def test_fp16_and_io_types(self, tmp_path):
+        m, prefix = _save_model(tmp_path, with_ln=False)
+        mixed = str(tmp_path / "mixed16" / "m")
+        convert_to_mixed_precision(
+            prefix + ".pdmodel", prefix + ".pdiparams",
+            mixed + ".pdmodel", mixed + ".pdiparams",
+            PrecisionType.Half, keep_io_types=False)
+        x = np.random.RandomState(1).randn(2, 8).astype(np.float32)
+        out = paddle.jit.load(mixed)(paddle.to_tensor(x))
+        assert str(out.dtype).endswith("float16")  # io converted too
+
+    def test_int8_rejected(self, tmp_path):
+        _, prefix = _save_model(tmp_path, with_ln=False)
+        with pytest.raises(ValueError, match="quantization"):
+            convert_to_mixed_precision(
+                prefix + ".pdmodel", prefix + ".pdiparams",
+                prefix + "q.pdmodel", prefix + "q.pdiparams",
+                PrecisionType.Int8)
+
+
+class TestPredictor:
+    def test_config_predictor_roundtrip(self, tmp_path):
+        from paddle_tpu import static
+
+        paddle.seed(1)
+        static.enable_static()
+        try:
+            prefix = str(tmp_path / "pred" / "m")
+            main, startup = static.Program(), static.Program()
+            with static.program_guard(main, startup):
+                inp = static.data("x", [-1, 4], "float32")
+                out = static.nn.fc(inp, 3)
+            exe = static.Executor()
+            exe.run(startup)
+            ref = exe.run(main, feed={"x": np.ones((2, 4), np.float32)},
+                          fetch_list=[out])[0]
+            static.save_inference_model(prefix, [inp], [out], exe,
+                                        program=main)
+        finally:
+            static.disable_static()
+        cfg = Config(prefix + ".pdmodel")
+        cfg.enable_tpu()
+        pred = create_predictor(cfg)
+        assert pred.get_input_names()
+        outs = pred.run([np.ones((2, 4), np.float32)])
+        np.testing.assert_allclose(outs[0], ref, rtol=1e-5, atol=1e-6)
