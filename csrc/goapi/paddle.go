@@ -140,6 +140,9 @@ func (t *Tensor) Shape() []int32 {
 }
 
 func (t *Tensor) CopyFromCpu(data []float32, shape []int32) {
+	if len(data) == 0 || len(shape) == 0 {
+		return // zero-size tensor / scalar shape: nothing to bind
+	}
 	cn := C.CString(t.name)
 	defer C.free(unsafe.Pointer(cn))
 	cshape := make([]C.int64_t, len(shape))
@@ -153,6 +156,9 @@ func (t *Tensor) CopyFromCpu(data []float32, shape []int32) {
 }
 
 func (t *Tensor) CopyToCpu(data []float32) {
+	if len(data) == 0 {
+		return
+	}
 	cn := C.CString(t.name)
 	defer C.free(unsafe.Pointer(cn))
 	C.pt_tensor_copy_to_cpu_float(t.pred.h, cn,

@@ -92,6 +92,11 @@ def convert_to_mixed_precision(model_file, params_file, mixed_model_file,
             "amp.decorate(level='O2') instead")
     meta = dict(payload["meta"])
     blob = payload.get("stablehlo")
+    if not blob:
+        raise ValueError(
+            "this artifact holds weights only (jit.save without "
+            "input_spec) — a converted copy could never serve; re-save "
+            "with input_spec so the program is exported too")
 
     orig_dtypes = {}
     mixed_state = {}

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 from jax.experimental import sparse as jsparse
 
@@ -89,6 +90,9 @@ class SparseCooTensor:
         return subtract(self, other)
 
     def __mul__(self, other):
+        return multiply(self, other)
+
+    def __rmul__(self, other):
         return multiply(self, other)
 
     def __neg__(self):
@@ -278,3 +282,39 @@ def masked_matmul(x, y, mask):
     cols = b.indices[:, 1]
     vals = jnp.einsum("nk,nk->n", xv[rows, :], yv[:, cols].T)
     return SparseCooTensor(jsparse.BCOO((vals, b.indices), shape=b.shape))
+
+
+def mv(x, vec):
+    """sparse matrix @ dense vector -> dense vector (reference
+    sparse/binary.py:166 mv)."""
+    return Tensor(_as_bcoo(x) @ _v(vec))
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0):
+    """beta * input + alpha * (x @ y) (reference sparse/multiary.py:22
+    addmm; x sparse, input/y dense -> dense)."""
+    return Tensor(beta * _v(input) + alpha * (_as_bcoo(x) @ _v(y)))
+
+
+def softmax(x, axis=-1):
+    """Sparse softmax over stored values, rows as the softmax groups
+    (reference sparse/nn/functional/activation.py:61: only axis=-1 on
+    2D/3D CSR is supported there; same restriction here). Zero entries
+    stay zero — the softmax runs over the *stored* pattern only."""
+    if axis != -1:
+        raise ValueError("sparse softmax supports axis=-1 only "
+                         "(reference restriction)")
+    b = _as_bcoo(x).sum_duplicates()
+    if len(b.shape) != 2:
+        raise ValueError("sparse softmax: 2D tensors only")
+    rows = b.indices[:, 0]
+    n_rows = b.shape[0]
+    # segment softmax over row groups
+    row_max = jax.ops.segment_max(b.data, rows, num_segments=n_rows)
+    shifted = jnp.exp(b.data - row_max[rows])
+    denom = jax.ops.segment_sum(shifted, rows, num_segments=n_rows)
+    vals = shifted / denom[rows]
+    return _rewrap(x, jsparse.BCOO((vals, b.indices), shape=b.shape))
+
+
+from . import nn  # noqa: F401,E402

@@ -1,0 +1,322 @@
+"""paddle.sparse.nn — layers over sparse COO tensors.
+
+Parity: reference python/paddle/sparse/nn/ (ReLU/ReLU6/LeakyReLU/Softmax/
+BatchNorm/SyncBatchNorm/Conv3D/SubmConv3D/MaxPool3D over the
+phi/kernels/sparse/ conv/pool/batch_norm kernels).
+
+TPU mapping: the reference builds a gather-GEMM-scatter "rulebook" per
+conv call (CPU hash tables / GPU kernels) because dense 3D conv is
+wasteful on its backends at point-cloud densities. XLA has no sparse
+conv; the MXU path here is densify → conv_general_dilated → re-sparsify,
+which at TPU conv throughput beats host rulebook construction for the
+moderate voxel grids that fit HBM, and keeps the whole op inside one
+compiled module. Active-site semantics match the reference: conv3d
+produces every output site its receptive field can reach; subm_conv3d
+keeps exactly the input's active sites.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+from ..nn import initializer as I
+from . import (
+    SparseCooTensor,
+    _as_bcoo,
+    _rewrap,
+    _unary,
+    softmax as _softmax_fn,
+)
+
+__all__ = [
+    "ReLU", "ReLU6", "LeakyReLU", "Softmax", "BatchNorm", "SyncBatchNorm",
+    "Conv3D", "SubmConv3D", "MaxPool3D",
+    "functional",
+]
+
+
+# -- functional -------------------------------------------------------------
+
+def _to_dense(x):
+    return _as_bcoo(x).sum_duplicates().todense()
+
+
+def _dense_to_coo(dense, keep_mask):
+    """Sparsify `dense` keeping entries where keep_mask (bool, same shape
+    up to the channel dim broadcast) is true. Host-side index build —
+    sparse layers are eager-mode, like the reference's rulebook path."""
+    mask = np.asarray(keep_mask)
+    idx = np.argwhere(mask)
+    vals = jnp.asarray(np.asarray(dense)[tuple(idx.T)])
+    return SparseCooTensor(
+        jsparse.BCOO((vals, jnp.asarray(idx)), shape=tuple(dense.shape)))
+
+
+def _site_mask(x):
+    """Bool mask of active (stored) sites, collapsed over the channel dim:
+    x is [N, D, H, W, C] COO with per-site channel vectors stored dense in
+    values when sparse_dim=4, or fully sparse; handle both by densifying
+    presence."""
+    b = _as_bcoo(x).sum_duplicates()
+    nd = b.indices.shape[1]
+    idx = np.asarray(b.indices)
+    shape = b.shape[:4]
+    mask = np.zeros(shape, bool)
+    mask[tuple(idx[:, :4].T)] = True
+    return mask
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+           groups=1, data_format="NDHWC"):
+    """Sparse 3D conv (reference sparse/nn/functional/conv.py:118).
+    x: SparseCooTensor [N, D, H, W, C]; weight: dense [kD, kH, kW, Cin,
+    Cout] (reference layout)."""
+    return _conv3d_impl(x, weight, bias, stride, padding, dilation, groups,
+                        subm=False)
+
+
+def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NDHWC"):
+    """Submanifold conv: output active sites == input active sites
+    (reference sparse/nn/functional/conv.py:224)."""
+    return _conv3d_impl(x, weight, bias, stride, padding, dilation, groups,
+                        subm=True)
+
+
+def _triple(v):
+    return (v, v, v) if isinstance(v, int) else tuple(v)
+
+
+def _conv3d_impl(x, weight, bias, stride, padding, dilation, groups, subm):
+    w = weight._value if isinstance(weight, Tensor) else jnp.asarray(weight)
+    dense = _to_dense(x)  # [N, D, H, W, C]
+    stride, padding, dilation = (_triple(stride), _triple(padding),
+                                 _triple(dilation))
+    if subm:
+        if stride != (1, 1, 1):
+            raise ValueError(
+                "subm_conv3d requires stride 1 (reference check)")
+        # submanifold semantics: output spatial dims == input dims, so the
+        # pad is implicitly SAME ((k-1)*dilation/2 each side); the
+        # reference's indice-key path has the same invariant
+        ks = w.shape[:3]
+        if any((k - 1) % 2 for k in ks):
+            raise ValueError("subm_conv3d requires odd kernel sizes")
+        padding = tuple((k - 1) * d // 2 for k, d in zip(ks, dilation))
+    out = jax.lax.conv_general_dilated(
+        dense.astype(w.dtype), w,
+        window_strides=stride,
+        padding=[(p, p) for p in padding],
+        rhs_dilation=dilation,
+        dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),
+        feature_group_count=groups)
+    if bias is not None:
+        bv = bias._value if isinstance(bias, Tensor) else jnp.asarray(bias)
+        out = out + bv
+    in_mask = _site_mask(x)
+    if subm:
+        out_mask = in_mask
+    else:
+        # a site is active if any input site lands in its receptive field:
+        # convolve the presence indicator with an all-ones kernel
+        ones_k = jnp.ones(w.shape[:3] + (1, 1), jnp.float32)
+        presence = jax.lax.conv_general_dilated(
+            jnp.asarray(in_mask, jnp.float32)[..., None], ones_k,
+            window_strides=stride,
+            padding=[(p, p) for p in padding],
+            rhs_dilation=dilation,
+            dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))[..., 0]
+        out_mask = np.asarray(presence) > 0
+    # expand site mask over channels
+    cmask = np.broadcast_to(np.asarray(out_mask)[..., None],
+                            np.asarray(out).shape)
+    return _dense_to_coo(out, cmask)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0,
+               data_format="NDHWC"):
+    """Sparse max pool over active sites (reference
+    sparse/nn/functional/pooling.py:22): inactive sites do not
+    contribute, and a window with no active site stays inactive."""
+    ks = _triple(kernel_size)
+    stride = _triple(stride if stride is not None else kernel_size)
+    padding = _triple(padding)
+    dense = _to_dense(x)
+    in_mask = _site_mask(x)
+    neg = jnp.asarray(np.where(
+        np.broadcast_to(in_mask[..., None], np.asarray(dense).shape),
+        np.asarray(dense), -np.inf))
+    out = jax.lax.reduce_window(
+        neg, -jnp.inf, jax.lax.max,
+        window_dimensions=(1,) + ks + (1,),
+        window_strides=(1,) + stride + (1,),
+        padding=((0, 0),) + tuple((p, p) for p in padding) + ((0, 0),))
+    arr = np.asarray(out)
+    out_mask = np.isfinite(arr).any(axis=-1)
+    arr = np.where(np.isfinite(arr), arr, 0.0)
+    cmask = np.broadcast_to(out_mask[..., None], arr.shape)
+    return _dense_to_coo(jnp.asarray(arr), cmask)
+
+
+class functional:  # namespace mirror of reference sparse.nn.functional
+    conv3d = staticmethod(conv3d)
+    subm_conv3d = staticmethod(subm_conv3d)
+    max_pool3d = staticmethod(max_pool3d)
+
+    @staticmethod
+    def relu(x):
+        return _unary(lambda d: jnp.maximum(d, 0))(x)
+
+    @staticmethod
+    def softmax(x, axis=-1):
+        return _softmax_fn(x, axis=axis)
+
+
+# -- layers -----------------------------------------------------------------
+
+class ReLU(Layer):
+    """reference sparse/nn/layer/activation.py ReLU."""
+
+    def forward(self, x):
+        return _unary(lambda d: jnp.maximum(d, 0))(x)
+
+
+class ReLU6(Layer):
+    def forward(self, x):
+        return _unary(lambda d: jnp.clip(d, 0, 6))(x)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01):
+        super().__init__()
+        self._slope = negative_slope
+
+    def forward(self, x):
+        s = self._slope
+        return _unary(lambda d: jnp.where(d >= 0, d, s * d))(x)
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1):
+        super().__init__()
+        self._axis = axis
+
+    def forward(self, x):
+        return _softmax_fn(x, axis=self._axis)
+
+
+class BatchNorm(Layer):
+    """Sparse batch norm (reference sparse/nn/layer/norm.py BatchNorm):
+    statistics over the stored (active) values per channel — inactive
+    sites are excluded, unlike a dense BN over the voxel grid."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 data_format="NDHWC"):
+        super().__init__()
+        self.num_features = num_features
+        self._momentum = momentum
+        self._eps = epsilon
+        self.weight = self.create_parameter(
+            [num_features], default_initializer=I.Constant(1.0))
+        self.bias = self.create_parameter(
+            [num_features], default_initializer=I.Constant(0.0),
+            is_bias=True)
+        # running stats as registered buffers so state_dict carries them
+        # (same convention as the dense BatchNorm, nn/layers/norm.py)
+        self.register_buffer("_mean", Tensor(jnp.zeros([num_features])))
+        self.register_buffer("_var", Tensor(jnp.ones([num_features])))
+
+    def forward(self, x):
+        b = _as_bcoo(x).sum_duplicates()
+        C = self.num_features
+        if b.data.ndim >= 2:
+            # sparse over sites, dense per-site channel vectors [nnz, C]
+            vals = b.data
+            if self.training:
+                mean = vals.mean(axis=tuple(range(vals.ndim - 1)))
+                var = vals.var(axis=tuple(range(vals.ndim - 1)))
+                self._update_stats(mean, var)
+            else:
+                mean, var = self._mean._value, self._var._value
+            out = ((vals - mean) / jnp.sqrt(var + self._eps)
+                   * self.weight._value + self.bias._value)
+        else:
+            # fully sparse: channel id is the last index column
+            ch = b.indices[:, -1]
+            vals = b.data
+            if self.training:
+                cnt = jnp.maximum(
+                    jax.ops.segment_sum(jnp.ones_like(vals), ch,
+                                        num_segments=C), 1.0)
+                mean = jax.ops.segment_sum(vals, ch, num_segments=C) / cnt
+                var = jax.ops.segment_sum(
+                    (vals - mean[ch]) ** 2, ch, num_segments=C) / cnt
+                self._update_stats(mean, var)
+            else:
+                mean, var = self._mean._value, self._var._value
+            out = ((vals - mean[ch]) / jnp.sqrt(var[ch] + self._eps)
+                   * self.weight._value[ch] + self.bias._value[ch])
+        return _rewrap(x, jsparse.BCOO((out, b.indices), shape=b.shape))
+
+    def _update_stats(self, mean, var):
+        m = self._momentum
+        self._mean._value = m * self._mean._value + (1 - m) * mean
+        self._var._value = m * self._var._value + (1 - m) * var
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-replica BN: under SPMD the batch axis is sharded and XLA's
+    psum makes the statistics global when traced in a compiled step; the
+    eager single-process form equals BatchNorm (reference
+    sparse/nn/layer/norm.py SyncBatchNorm)."""
+
+
+class _ConvBase(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, bias_attr=None,
+                 data_format="NDHWC"):
+        super().__init__()
+        ks = _triple(kernel_size)
+        fan_in = in_channels * ks[0] * ks[1] * ks[2]
+        bound = 1.0 / np.sqrt(fan_in)
+        self.weight = self.create_parameter(
+            list(ks) + [in_channels // groups, out_channels],
+            default_initializer=I.Uniform(-bound, bound))
+        self.bias = (self.create_parameter(
+            [out_channels], default_initializer=I.Uniform(-bound, bound),
+            is_bias=True) if bias_attr is not False else None)
+        self._stride, self._padding = stride, padding
+        self._dilation, self._groups = dilation, groups
+
+
+class Conv3D(_ConvBase):
+    """reference sparse/nn/layer/conv.py Conv3D."""
+
+    def forward(self, x):
+        return conv3d(x, self.weight, self.bias, self._stride,
+                      self._padding, self._dilation, self._groups)
+
+
+class SubmConv3D(_ConvBase):
+    """reference sparse/nn/layer/conv.py SubmConv3D."""
+
+    def forward(self, x):
+        return subm_conv3d(x, self.weight, self.bias, self._stride,
+                           self._padding, self._dilation, self._groups)
+
+
+class MaxPool3D(Layer):
+    """reference sparse/nn/layer/pooling.py MaxPool3D."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NDHWC"):
+        super().__init__()
+        self._ks, self._stride, self._padding = kernel_size, stride, padding
+
+    def forward(self, x):
+        return max_pool3d(x, self._ks, self._stride, self._padding)

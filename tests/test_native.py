@@ -336,6 +336,10 @@ class TestOpsCodegen:
         for line in open(path):
             if line.startswith("- op : "):
                 names.add(line.split(":", 1)[1].strip())
-        missing = set(WRAPPERS) - names
+        # custom_* ops register at .so-load time (utils/cpp_extension) —
+        # runtime-loaded user ops are not part of the shipped yaml, same
+        # as the reference's custom-operator path vs ops.yaml
+        missing = {n for n in set(WRAPPERS) - names
+                   if not n.startswith("custom_")}
         assert not missing, ("ops.yaml stale; re-run tools/gen_ops.py: %s"
                              % sorted(missing)[:10])

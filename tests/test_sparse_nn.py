@@ -1,0 +1,175 @@
+"""paddle.sparse additions: mv/addmm/softmax + sparse.nn layers.
+
+Oracles: dense numpy computations. Reference analogs:
+unittests/test_sparse_{mv,addmm,softmax,conv,pooling,norm,activation}_op.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse
+
+RNG = np.random.RandomState(9)
+
+
+def _coo_from_dense(dense):
+    idx = np.array(np.nonzero(dense))
+    vals = dense[tuple(idx)]
+    return sparse.sparse_coo_tensor(idx, vals, dense.shape)
+
+
+def _rand_sparse(shape, density=0.3, seed=0):
+    rng = np.random.RandomState(seed)
+    dense = rng.randn(*shape).astype(np.float32)
+    dense[rng.rand(*shape) >= density] = 0.0
+    return _coo_from_dense(dense), dense
+
+
+class TestSparseOps:
+    def test_mv(self):
+        st, dense = _rand_sparse((5, 7))
+        v = RNG.randn(7).astype(np.float32)
+        out = sparse.mv(st, v)
+        np.testing.assert_allclose(np.asarray(out._value), dense @ v,
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_addmm(self):
+        st, dense = _rand_sparse((4, 6), seed=1)
+        y = RNG.randn(6, 3).astype(np.float32)
+        inp = RNG.randn(4, 3).astype(np.float32)
+        out = sparse.addmm(inp, st, y, beta=0.5, alpha=2.0)
+        np.testing.assert_allclose(np.asarray(out._value),
+                                   0.5 * inp + 2.0 * (dense @ y),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_softmax_over_stored_pattern(self):
+        st, dense = _rand_sparse((6, 8), seed=2)
+        out = sparse.softmax(st)
+        got = out.to_dense().numpy()
+        expect = np.zeros_like(dense)
+        for r in range(dense.shape[0]):
+            nz = dense[r] != 0
+            if nz.any():
+                e = np.exp(dense[r][nz] - dense[r][nz].max())
+                expect[r][nz] = e / e.sum()
+        np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-6)
+
+    def test_softmax_axis_restriction(self):
+        st, _ = _rand_sparse((3, 3))
+        with pytest.raises(ValueError):
+            sparse.softmax(st, axis=0)
+
+
+def _voxels(shape=(1, 4, 4, 4, 2), n_active=5, seed=3):
+    """Random sparse NDHWC voxel grid."""
+    rng = np.random.RandomState(seed)
+    dense = np.zeros(shape, np.float32)
+    sites = set()
+    while len(sites) < n_active:
+        sites.add(tuple(rng.randint(0, s) for s in shape[:4]))
+    for s in sites:
+        dense[s] = rng.randn(shape[4])
+    return _coo_from_dense(dense), dense, sites
+
+
+class TestSparseConv:
+    def test_subm_conv3d_keeps_active_sites(self):
+        st, dense, sites = _voxels()
+        conv = sparse.nn.SubmConv3D(2, 3, kernel_size=3, padding=1,
+                                    bias_attr=False)
+        out = conv(st)
+        w = np.asarray(conv.weight._value)
+        # dense oracle
+        import jax
+
+        ref = np.asarray(jax.lax.conv_general_dilated(
+            dense, w, (1, 1, 1), [(1, 1)] * 3,
+            dimension_numbers=("NDHWC", "DHWIO", "NDHWC")))
+        got = out.to_dense().numpy()
+        # only the input's active sites survive
+        for s in sites:
+            np.testing.assert_allclose(got[s], ref[s], rtol=1e-4, atol=1e-4)
+        inactive = np.ones((1, 4, 4, 4), bool)
+        for s in sites:
+            inactive[s] = False
+        assert np.all(got[inactive] == 0)
+
+    def test_subm_conv3d_default_padding_keeps_shape(self):
+        """Submanifold conv pads implicitly SAME: out dims == in dims even
+        with the default padding=0 (regression: broadcast crash)."""
+        st, dense, sites = _voxels()
+        out = sparse.nn.SubmConv3D(2, 3, kernel_size=3)(st)
+        assert out.shape == [1, 4, 4, 4, 3]
+
+    def test_conv3d_expands_sites(self):
+        st, dense, sites = _voxels(n_active=2, seed=4)
+        conv = sparse.nn.Conv3D(2, 2, kernel_size=3, padding=1)
+        out = conv(st)
+        got = out.to_dense().numpy()
+        # every site reachable from an active input is populated with the
+        # biased conv value; sites with empty receptive fields are exactly 0
+        assert out.nnz > len(sites) * 2
+
+    def test_max_pool3d(self):
+        st, dense, sites = _voxels(shape=(1, 4, 4, 4, 1), n_active=6,
+                                   seed=5)
+        out = sparse.nn.MaxPool3D(kernel_size=2, stride=2)(st)
+        got = out.to_dense().numpy()
+        # oracle: max over active sites per 2x2x2 window
+        mask = (dense != 0).any(axis=-1)
+        for d in range(2):
+            for h in range(2):
+                for w in range(2):
+                    win = dense[0, 2 * d:2 * d + 2, 2 * h:2 * h + 2,
+                                2 * w:2 * w + 2, 0]
+                    wmask = mask[0, 2 * d:2 * d + 2, 2 * h:2 * h + 2,
+                                 2 * w:2 * w + 2]
+                    if wmask.any():
+                        assert got[0, d, h, w, 0] == pytest.approx(
+                            win[wmask].max(), rel=1e-5)
+                    else:
+                        assert got[0, d, h, w, 0] == 0
+
+
+class TestSparseNNLayers:
+    def test_activations(self):
+        st, dense = _rand_sparse((4, 4), seed=6)
+        relu = sparse.nn.ReLU()(st).to_dense().numpy()
+        np.testing.assert_allclose(relu, np.maximum(dense, 0))
+        lrelu = sparse.nn.LeakyReLU(0.1)(st).to_dense().numpy()
+        expect = np.where(dense >= 0, dense, 0.1 * dense)
+        expect[dense == 0] = 0
+        np.testing.assert_allclose(lrelu, expect, rtol=1e-6)
+        r6 = sparse.nn.ReLU6()(3 * st).to_dense().numpy()
+        assert r6.max() <= 6.0
+
+    def test_batch_norm_fully_sparse(self):
+        st, dense = _rand_sparse((16, 4), seed=7)
+        bn = sparse.nn.BatchNorm(4)
+        bn.train()
+        out = bn(st).to_dense().numpy()
+        # per-channel stats over stored values only
+        for c in range(4):
+            nz = dense[:, c] != 0
+            if nz.sum() > 1:
+                v = dense[nz, c]
+                expect = (v - v.mean()) / np.sqrt(v.var() + 1e-5)
+                np.testing.assert_allclose(out[nz, c], expect, rtol=1e-4,
+                                           atol=1e-4)
+
+    def test_batch_norm_stats_in_state_dict(self):
+        """Running stats are registered buffers: they survive
+        state_dict save/load (regression: stats were plain attributes)."""
+        st, _ = _rand_sparse((16, 4), seed=8)
+        bn = sparse.nn.BatchNorm(4)
+        bn.train()
+        bn(st)
+        sd = bn.state_dict()
+        assert "_mean" in sd and "_var" in sd
+        bn2 = sparse.nn.BatchNorm(4)
+        bn2.set_state_dict(sd)
+        np.testing.assert_allclose(np.asarray(bn2._mean._value),
+                                   np.asarray(bn._mean._value))
+
+    def test_sync_batch_norm_alias(self):
+        assert issubclass(sparse.nn.SyncBatchNorm, sparse.nn.BatchNorm)
