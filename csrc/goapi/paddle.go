@@ -140,8 +140,11 @@ func (t *Tensor) Shape() []int32 {
 }
 
 func (t *Tensor) CopyFromCpu(data []float32, shape []int32) {
-	if len(data) == 0 || len(shape) == 0 {
-		return // zero-size tensor / scalar shape: nothing to bind
+	if len(data) == 0 {
+		return // genuinely zero-element tensor: nothing to bind
+	}
+	if len(shape) == 0 {
+		shape = []int32{1} // rank-0 scalar: bind as [1]
 	}
 	cn := C.CString(t.name)
 	defer C.free(unsafe.Pointer(cn))
@@ -157,7 +160,7 @@ func (t *Tensor) CopyFromCpu(data []float32, shape []int32) {
 
 func (t *Tensor) CopyToCpu(data []float32) {
 	if len(data) == 0 {
-		return
+		return // zero-element output buffer: nothing to read back
 	}
 	cn := C.CString(t.name)
 	defer C.free(unsafe.Pointer(cn))

@@ -1,8 +1,9 @@
 """fleet.utils namespace (reference fleet/utils/__init__.py)."""
 from __future__ import annotations
 
-from . import fs, http_server, hybrid_parallel_util  # noqa: F401
+from . import fs, http_server, hybrid_parallel_util, ps_util  # noqa: F401
 from .fs import HDFSClient, LocalFS  # noqa: F401
+from .ps_util import DistributedInfer  # noqa: F401
 from .hybrid_parallel_util import (  # noqa: F401
     broadcast_dp_parameters,
     broadcast_input_data,

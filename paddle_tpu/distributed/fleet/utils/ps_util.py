@@ -1,0 +1,124 @@
+"""Distributed-inference utility for the parameter-server path.
+
+Parity: reference fleet/utils/ps_util.py DistributedInfer — at infer
+time on a PS deployment, embedding tables live on the servers, so the
+local program's `embedding` lookups must become distributed pulls
+(the reference rewrites `lookup_table` ops into
+`distributed_lookup_table` against the varname→table map).
+
+TPU mapping: the model is an eager Layer tree (one compiled module per
+batch shape); instead of a ProgramDesc rewrite, `get_dist_infer_program`
+swaps every `nn.Embedding` whose name maps to a sparse table with a
+pull-backed embedding that fetches just the touched rows from the PS
+(dense compute stays on-device). Same lifecycle as the reference:
+construct → `init_distributed_infer_env` → `get_dist_infer_program`.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ....core.tensor import Tensor
+from ....nn.layer import Layer
+
+
+class _PSEmbedding(Layer):
+    """Embedding whose rows are pulled from a PS sparse table per batch
+    (reference pscore distributed_lookup_table op)."""
+
+    def __init__(self, table, num_embeddings, embedding_dim,
+                 padding_idx=None):
+        super().__init__()
+        self._table = table
+        self._num = num_embeddings
+        self._dim = embedding_dim
+        self._padding_idx = padding_idx
+
+    def forward(self, ids):
+        idv = np.asarray(ids._value if isinstance(ids, Tensor) else ids)
+        flat = idv.reshape(-1).astype(np.int64)
+        uniq, inv = np.unique(flat, return_inverse=True)
+        rows = np.asarray(self._table.pull(uniq.tolist()))
+        out = rows[inv].reshape(idv.shape + (self._dim,))
+        if self._padding_idx is not None:
+            # pad rows embed to zero; the lazily-initialized PS row for
+            # the pad id must never leak (SparseTable.pull materializes
+            # missing rows with init noise)
+            out = np.where((idv == self._padding_idx)[..., None],
+                           0.0, out)
+        return Tensor(jnp.asarray(out, jnp.float32))
+
+
+class DistributedInfer:
+    """reference ps_util.py:24.
+
+    Args (TPU form): `model` — the Layer to convert; the reference's
+    main_program/startup_program are accepted positionally for ported
+    code but unused (the eager tree plays both roles).
+    """
+
+    def __init__(self, main_program=None, startup_program=None,
+                 model=None):
+        self._model = model if model is not None else main_program
+        if not isinstance(self._model, Layer):
+            raise TypeError(
+                "DistributedInfer on the TPU stack converts a Layer tree; "
+                "pass model=<Layer> (static ProgramDesc rewriting does "
+                "not apply to compiled StableHLO programs)")
+        self._runtime = None
+        self._table_map = {}
+        self._converted = None
+
+    def init_distributed_infer_env(self, exe=None, loss=None,
+                                   role_maker=None, dirname=None,
+                                   runtime=None):
+        """Bind the PS runtime and (optionally) load dense params from
+        `dirname` (reference :45 loads persistables + inits the PS
+        world)."""
+        from ...ps.runtime import TheOnePSRuntime
+
+        self._runtime = runtime if runtime is not None else TheOnePSRuntime()
+        self._table_map = self._get_sparse_table_map()
+        if dirname is not None:
+            from ....framework.io import load
+            state = load(dirname)
+            self._model.set_state_dict(state)
+
+    def _get_sparse_table_map(self):
+        """name → table for every Embedding sublayer with a matching PS
+        sparse table (reference :75 builds varname2tables)."""
+        from ....nn.layers.common import Embedding
+
+        out = {}
+        for name, sub in self._model.named_sublayers():
+            if isinstance(sub, Embedding):
+                table = None
+                try:
+                    table = self._runtime.get_table(name)
+                except Exception:
+                    pass
+                if table is not None:
+                    out[name] = table
+        return out
+
+    def get_dist_infer_program(self):
+        """Return the model with PS-backed embeddings swapped in
+        (reference :115 returns the rewritten program)."""
+        if self._converted is not None:
+            return self._converted
+        from ....nn.layers.common import Embedding
+
+        for name, table in self._table_map.items():
+            parts = name.split(".")
+            parent = self._model
+            for p in parts[:-1]:
+                parent = getattr(parent, p)
+            old = getattr(parent, parts[-1])
+            assert isinstance(old, Embedding)
+            setattr(parent, parts[-1],
+                    _PSEmbedding(table, old.num_embeddings,
+                                 old.embedding_dim,
+                                 padding_idx=old.padding_idx))
+        self._converted = self._model
+        return self._model

@@ -104,6 +104,7 @@ class RNGStatesTracker:
 
     def reset(self):
         _tracker_states.clear()
+        _process_mp_rank.clear()
 
     def get_states_tracker(self):
         return dict(_tracker_states)
@@ -135,6 +136,15 @@ class RNGStatesTracker:
     def rng_state(self, name="global_seed"):
         return self._Ctx(name)
 
+    def set_mp_rank(self, rank):
+        """Record the process-level mp rank for eager multi-process mode
+        (reference mpu/random.py model_parallel_rng_tracker_name seeding):
+        folded into every rank-local draw when no 'mp' mesh axis is
+        bound."""
+        _process_mp_rank.clear()
+        if rank:
+            _process_mp_rank.append(int(rank))
+
 
 _tracker = RNGStatesTracker()
 
@@ -160,10 +170,17 @@ def model_parallel_rng_key():
         try:
             key = jax.random.fold_in(key, jax.lax.axis_index(axis))
         except Exception:
-            # axis not bound: GSPMD mode — the global mask is already
-            # per-position, nothing to fold
+            # axis not bound. Multi-process eager: fold the process-level
+            # mp rank (set by TensorParallel via set_mp_rank) so ranks
+            # draw distinct masks. Single-process GSPMD: the global mask
+            # is already per-position, nothing to fold.
+            if axis == "mp" and _process_mp_rank:
+                key = jax.random.fold_in(key, _process_mp_rank[0])
             break
     return key
+
+
+_process_mp_rank = []  # [rank] when set (eager multi-process mode)
 
 
 def in_tracked_rng_state():

@@ -107,32 +107,32 @@ def convert_to_mixed_precision(model_file, params_file, mixed_model_file,
             arr = arr.astype(target)
         mixed_state[name] = arr
 
-    if blob:
-        from jax import export as jex
+    # blob is guaranteed non-empty by the weights-only guard above
+    from jax import export as jex
 
-        from ..jit import export_with_dynamic_dims
-        from ..core import dtype as _dtype
+    from ..jit import export_with_dynamic_dims
+    from ..core import dtype as _dtype
 
-        exported = jex.deserialize(blob)
-        names = meta.get("state_names") or sorted(state.keys())
-        cast_back = [jnp.dtype(orig_dtypes[n]) for n in names]
+    exported = jex.deserialize(blob)
+    names = meta.get("state_names") or sorted(state.keys())
+    cast_back = [jnp.dtype(orig_dtypes[n]) for n in names]
 
-        def mixed_call(state_vals, *in_vals):
-            full = [v.astype(d) if v.dtype != d else v
-                    for v, d in zip(state_vals, cast_back)]
-            out = exported.call(full, *in_vals)
-            if not keep_io_types:
-                out = jax.tree_util.tree_map(
-                    lambda o: o.astype(target)
-                    if o.dtype == jnp.float32 else o, out)
-            return out
+    def mixed_call(state_vals, *in_vals):
+        full = [v.astype(d) if v.dtype != d else v
+                for v, d in zip(state_vals, cast_back)]
+        out = exported.call(full, *in_vals)
+        if not keep_io_types:
+            out = jax.tree_util.tree_map(
+                lambda o: o.astype(target)
+                if o.dtype == jnp.float32 else o, out)
+        return out
 
-        specs = [(tuple(s["shape"]), _dtype.to_jax(s["dtype"]))
-                 for s in meta.get("input_spec", [])]
-        lead = [jnp.asarray(mixed_state[n]) for n in names]
-        meta["mixed_precision"] = mixed_precision
-        blob = export_with_dynamic_dims(mixed_call, specs,
-                                        leading_args=(lead,))
+    specs = [(tuple(s["shape"]), _dtype.to_jax(s["dtype"]))
+             for s in meta.get("input_spec", [])]
+    lead = [jnp.asarray(mixed_state[n]) for n in names]
+    meta["mixed_precision"] = mixed_precision
+    blob = export_with_dynamic_dims(mixed_call, specs,
+                                    leading_args=(lead,))
 
     os.makedirs(os.path.dirname(dst) or ".", exist_ok=True)
     params_dst = _prefix(mixed_params_file, ".pdiparams")

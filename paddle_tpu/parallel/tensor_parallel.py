@@ -1,10 +1,25 @@
-"""TensorParallel wrapper (reference fleet/meta_parallel/tensor_parallel.py:27:
-broadcast params/inputs within the mp group). Single-controller SPMD already
-has one global copy of every param, so the broadcasts are structurally
-guaranteed; the wrapper's job is to carry the hcg and keep the API."""
+"""TensorParallel wrapper (reference fleet/meta_parallel/tensor_parallel.py:27).
+
+The reference wrapper does two jobs at construction/step time:
+1. broadcast non-sharded params within the mp group (ranks must agree
+   bit-for-bit or TP activations diverge);
+2. broadcast step inputs from the mp-group src rank.
+
+Single-controller SPMD already has one global copy of every param, so
+both are structurally guaranteed there; in a multi-process world
+(init_parallel_env) the wrapper performs the real broadcasts over the
+store-backed groups, and also seeds the mp-rank RNG tracker so dropout
+masks differ across mp ranks (reference mpu/random.py).
+"""
 from __future__ import annotations
 
 from ..nn.layer import Layer
+
+
+def _world_pg():
+    from ..distributed.process_group import get_world_group
+
+    return get_world_group()
 
 
 class TensorParallel(Layer):
@@ -12,8 +27,39 @@ class TensorParallel(Layer):
         super().__init__()
         self._layers = layers
         self._hcg = hcg
+        if hcg is not None and _world_pg() is not None:
+            from ..distributed.fleet.utils.hybrid_parallel_util import (
+                broadcast_mp_parameters,
+            )
+
+            if hcg.get_model_parallel_world_size() > 1:
+                broadcast_mp_parameters(layers, hcg)
+        if hcg is not None and hcg.get_model_parallel_world_size() > 1:
+            # distinct dropout streams per mp rank (reference
+            # meta_parallel/tensor_parallel.py + mpu/random.py). The rank
+            # must be the PROCESS-level one: hcg.get_model_parallel_rank()
+            # is 0 under single-controller SPMD (topology.py), so in a
+            # multi-process world ask the mp Group, which derives the true
+            # rank from the store-backed process group.
+            from ..framework.random import get_rng_state_tracker
+
+            mp_group = hcg.get_model_parallel_group()
+            rank = mp_group.rank if _world_pg() is not None \
+                else hcg.get_model_parallel_rank()
+            get_rng_state_tracker().set_mp_rank(max(rank, 0))
 
     def forward(self, *inputs, **kwargs):
+        if self._hcg is not None and _world_pg() is not None \
+                and self._hcg.get_model_parallel_world_size() > 1:
+            from ..distributed.fleet.utils.hybrid_parallel_util import (
+                broadcast_input_data,
+            )
+
+            res = broadcast_input_data(self._hcg, *inputs, **kwargs)
+            if kwargs:
+                inputs, kwargs = res
+            else:
+                inputs = res
         return self._layers(*inputs, **kwargs)
 
     def parameters(self, include_sublayers=True):

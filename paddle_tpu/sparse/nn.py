@@ -42,10 +42,6 @@ __all__ = [
 
 # -- functional -------------------------------------------------------------
 
-def _to_dense(x):
-    return _as_bcoo(x).sum_duplicates().todense()
-
-
 def _dense_to_coo(dense, keep_mask):
     """Sparsify `dense` keeping entries where keep_mask (bool, same shape
     up to the channel dim broadcast) is true. Host-side index build —
@@ -57,13 +53,11 @@ def _dense_to_coo(dense, keep_mask):
         jsparse.BCOO((vals, jnp.asarray(idx)), shape=tuple(dense.shape)))
 
 
-def _site_mask(x):
-    """Bool mask of active (stored) sites, collapsed over the channel dim:
-    x is [N, D, H, W, C] COO with per-site channel vectors stored dense in
-    values when sparse_dim=4, or fully sparse; handle both by densifying
-    presence."""
-    b = _as_bcoo(x).sum_duplicates()
-    nd = b.indices.shape[1]
+def _site_mask(b):
+    """Bool mask of active (stored) sites from a deduplicated BCOO,
+    collapsed over the channel dim: [N, D, H, W, C] COO with per-site
+    channel vectors stored dense in values when sparse_dim=4, or fully
+    sparse; handle both by densifying presence."""
     idx = np.asarray(b.indices)
     shape = b.shape[:4]
     mask = np.zeros(shape, bool)
@@ -94,7 +88,8 @@ def _triple(v):
 
 def _conv3d_impl(x, weight, bias, stride, padding, dilation, groups, subm):
     w = weight._value if isinstance(weight, Tensor) else jnp.asarray(weight)
-    dense = _to_dense(x)  # [N, D, H, W, C]
+    b = _as_bcoo(x).sum_duplicates()  # dedup once: dense + mask share it
+    dense = b.todense()  # [N, D, H, W, C]
     stride, padding, dilation = (_triple(stride), _triple(padding),
                                  _triple(dilation))
     if subm:
@@ -118,7 +113,7 @@ def _conv3d_impl(x, weight, bias, stride, padding, dilation, groups, subm):
     if bias is not None:
         bv = bias._value if isinstance(bias, Tensor) else jnp.asarray(bias)
         out = out + bv
-    in_mask = _site_mask(x)
+    in_mask = _site_mask(b)
     if subm:
         out_mask = in_mask
     else:
@@ -146,8 +141,9 @@ def max_pool3d(x, kernel_size, stride=None, padding=0,
     ks = _triple(kernel_size)
     stride = _triple(stride if stride is not None else kernel_size)
     padding = _triple(padding)
-    dense = _to_dense(x)
-    in_mask = _site_mask(x)
+    b = _as_bcoo(x).sum_duplicates()
+    dense = b.todense()
+    in_mask = _site_mask(b)
     neg = jnp.asarray(np.where(
         np.broadcast_to(in_mask[..., None], np.asarray(dense).shape),
         np.asarray(dense), -np.inf))

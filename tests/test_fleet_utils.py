@@ -239,3 +239,79 @@ class TestHybridParallelUtil:
         fleet.utils.fused_allreduce_gradients(
             [p for _, p in m.named_parameters()], None)
         np.testing.assert_allclose(np.asarray(m.weight.grad._value), g0)
+
+
+class TestDistributedInfer:
+    """reference ps_util.py DistributedInfer: embedding lookups become PS
+    pulls in the infer program (pscore distributed_lookup_table)."""
+
+    def test_embedding_swapped_to_ps_pull(self):
+        from paddle_tpu.distributed.fleet.utils import DistributedInfer
+        from paddle_tpu.distributed.ps.runtime import TheOnePSRuntime
+
+        paddle.seed(21)
+
+        class WideModel(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.emb = nn.Embedding(50, 8)
+                self.fc = nn.Linear(8, 1)
+
+            def forward(self, ids):
+                return self.fc(self.emb(ids).mean(axis=1))
+
+        m = WideModel()
+        rt = TheOnePSRuntime()
+        table = rt.create_sparse_table("emb", 8, optimizer="sgd", lr=0.1)
+        # seed the table with the trained rows so pulls match local
+        ids = [3, 7, 11]
+        w = np.asarray(m.emb.weight._value)
+        for i in ids:
+            got = np.asarray(table.pull([i]))  # materialize row
+            table.push([i], (got - w[i:i + 1]) / 0.1)  # sgd: w -= lr*g
+
+        di = DistributedInfer(model=m)
+        di.init_distributed_infer_env(runtime=rt)
+        infer = di.get_dist_infer_program()
+        from paddle_tpu.distributed.fleet.utils.ps_util import _PSEmbedding
+
+        assert isinstance(infer.emb, _PSEmbedding)
+        x = paddle.to_tensor(np.asarray([[3, 7, 11]], np.int64))
+        out = infer(x)
+        # oracle: same fc over the table rows
+        rows = np.stack([np.asarray(table.pull([i]))[0] for i in ids])
+        ref = rows.mean(axis=0) @ np.asarray(m.fc.weight._value) \
+            + np.asarray(m.fc.bias._value)
+        np.testing.assert_allclose(np.asarray(out._value)[0], ref,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_requires_layer(self):
+        from paddle_tpu.distributed.fleet.utils import DistributedInfer
+
+        with pytest.raises(TypeError):
+            DistributedInfer(main_program=object())
+
+    def test_padding_idx_rows_stay_zero(self):
+        """Pad tokens must embed to zero even though SparseTable.pull
+        lazily initializes missing rows with noise (regression)."""
+        from paddle_tpu.distributed.fleet.utils import DistributedInfer
+        from paddle_tpu.distributed.ps.runtime import TheOnePSRuntime
+
+        class M(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.emb = nn.Embedding(10, 4, padding_idx=0)
+
+            def forward(self, ids):
+                return self.emb(ids)
+
+        m = M()
+        rt = TheOnePSRuntime()
+        rt.create_sparse_table("emb", 4, init_std=1.0)
+        di = DistributedInfer(model=m)
+        di.init_distributed_infer_env(runtime=rt)
+        infer = di.get_dist_infer_program()
+        out = infer(paddle.to_tensor(np.asarray([[0, 3, 0]], np.int64)))
+        ov = np.asarray(out._value)
+        assert np.all(ov[0, 0] == 0) and np.all(ov[0, 2] == 0)
+        assert np.any(ov[0, 1] != 0)

@@ -168,3 +168,30 @@ class TestRngTracker:
         with pytest.raises(ValueError):
             tracker.add("s", 2)
         tracker.reset()
+
+    def test_process_level_mp_rank_folds_into_local_draws(self):
+        """Eager multi-process mode (no bound 'mp' axis): set_mp_rank must
+        differentiate rank-local dropout masks while leaving global_seed
+        draws shared (reference mpu/random.py per-rank seeding)."""
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu.core.tensor import Tensor
+
+        tracker = get_rng_state_tracker()
+        tracker.reset()
+        paddle.seed(77)
+        x = paddle.to_tensor(np.ones((64,), np.float32))
+
+        def mask(state, rank):
+            tracker.reset()  # fresh draw counters per simulated rank
+            tracker.set_mp_rank(rank)
+            paddle.seed(77)  # identical base state per simulated rank
+            with tracker.rng_state(state):
+                out = F.dropout(x, p=0.5, training=True)
+            tracker.set_mp_rank(0)
+            return np.asarray(out._value) != 0
+
+        m0, m1 = mask("local_seed", 0), mask("local_seed", 1)
+        assert (m0 != m1).any()
+        g0, g1 = mask("global_seed", 0), mask("global_seed", 1)
+        assert (g0 == g1).all()
+        tracker.reset()
