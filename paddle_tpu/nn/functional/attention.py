@@ -23,8 +23,13 @@ def _sdpa_reference(q, k, v, mask=None, dropout_p=0.0, causal=False, scale=None)
     kf = k.astype(jnp.float32)
     logits = jnp.einsum("bnhd,bmhd->bhnm", qf, kf) * scale
     if causal:
+        # start-aligned (query i attends keys j <= i) — the ONE causal
+        # convention across this fallback, the Pallas kernels, and ring
+        # attention (kernels/flash_attention.py docstring). Cached decode
+        # must pass an explicit end-aligned mask instead of is_causal
+        # (models/llama.py does).
         n, m = logits.shape[-2], logits.shape[-1]
-        cm = jnp.tril(jnp.ones((n, m), bool), k=m - n)
+        cm = jnp.tril(jnp.ones((n, m), bool))
         logits = jnp.where(cm, logits, -1e30)
     if mask is not None:
         mask = _A(mask)
