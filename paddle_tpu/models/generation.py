@@ -1,0 +1,167 @@
+"""Shared autoregressive generation machinery.
+
+Reference analog: PaddleNLP GenerationMixin (greedy/sampling over growing
+DenseTensor caches, top_k_top_p sampling ops). TPU-first shape instead:
+
+- `DecodeCache`: static-size per-layer KV buffer (pytree NamedTuple) —
+  written with dynamic_update_slice at the position head, ONE compiled
+  shape for the whole generation (growing caches would recompile every
+  step under XLA).
+- `GenerationMixin.generate`: jitted prefill over the prompt (flash
+  kernel eligible), then the entire decode loop as a single XLA
+  while-loop with eos early-exit.
+
+A model opts in by providing:
+  generate_step(input_ids, caches, position_offset) -> (logits, caches)
+  init_decode_caches(batch, total_len) -> list[DecodeCache]
+  functional_state() / bind_state(...)  (nn.Layer already has these)
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+
+class DecodeCache(NamedTuple):
+    """[B, L_max, H_kv, D] static KV buffers for one layer."""
+
+    k: "object"
+    v: "object"
+
+
+def cache_update(cache, k, v, position_offset):
+    """Write s new K/V rows into the static buffers at position_offset;
+    returns (new_cache, k_full, v_full) with k/v as full-buffer Tensors."""
+    import jax
+
+    from ..core.tensor import Tensor
+
+    def _upd(buf, new):
+        nv = new._value if hasattr(new, "_value") else jnp.asarray(new)
+        return jax.lax.dynamic_update_slice(
+            buf, nv.astype(buf.dtype), (0, position_offset, 0, 0))
+
+    kb = _upd(cache.k, k)
+    vb = _upd(cache.v, v)
+    return DecodeCache(kb, vb), Tensor(kb), Tensor(vb)
+
+
+def decode_mask(position_offset, s, kv_len):
+    """Valid-region causal mask for cached decode, or the string "causal"
+    when it reduces to plain start-aligned causality (static prefill at
+    offset 0 — lets the flash kernel stay eligible)."""
+    if isinstance(position_offset, int) and position_offset == 0:
+        return "causal"
+    kv_pos = jnp.arange(kv_len)
+    q_pos = position_offset + jnp.arange(s)
+    return kv_pos[None, :] <= q_pos[:, None]  # [s, kv]
+
+
+def masked_decode_attention(q, k, v, mask):
+    """Dispatch on decode_mask()'s result."""
+    from ..nn import functional as F
+
+    if isinstance(mask, str):  # "causal"
+        return F.scaled_dot_product_attention(q, k, v, is_causal=True)
+    return F.scaled_dot_product_attention(
+        q, k, v, attn_mask=mask[None, None], is_causal=False)
+
+
+class GenerationMixin:
+    def max_decode_len(self):
+        """Maximum total sequence length (prompt + generated), or None
+        when unbounded. Models override."""
+        return None
+
+    def generate(self, input_ids, max_new_tokens=32, do_sample=False,
+                 top_k=0, top_p=1.0, temperature=1.0, eos_token_id=None,
+                 seed=0):
+        """Autoregressive generation, compiled end to end. Returns the
+        generated ids [B, max_new_tokens] (prompt excluded); positions
+        after a sequence's eos are padded with eos."""
+        import jax
+
+        from ..core.dispatch import no_grad
+        from ..core.tensor import Tensor
+
+        ids = input_ids._value if isinstance(input_ids, Tensor) \
+            else jnp.asarray(input_ids)
+        ids = ids.astype(jnp.int32)
+        b, prompt_len = ids.shape
+        total = prompt_len + max_new_tokens
+        limit = self.max_decode_len()
+        if limit is not None and total > limit:
+            # out-of-range positions would clamp in XLA's gather (learned
+            # position tables) or extrapolate silently (rope) — refuse
+            raise ValueError(
+                "generate: prompt_len (%d) + max_new_tokens (%d) exceeds "
+                "the model's maximum sequence length (%d)"
+                % (prompt_len, max_new_tokens, limit))
+        names, values = self.functional_state()
+
+        def sample(logits, key):
+            logits = logits.astype(jnp.float32) / max(temperature, 1e-6)
+            if not do_sample:
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            if top_k:
+                kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+                logits = jnp.where(logits < kth, -jnp.inf, logits)
+            if top_p < 1.0:
+                sorted_l = jnp.sort(logits, axis=-1)[:, ::-1]
+                probs = jax.nn.softmax(sorted_l, axis=-1)
+                cum = jnp.cumsum(probs, axis=-1)
+                # smallest prefix with mass >= top_p stays
+                cutoff_idx = jnp.sum(cum < top_p, axis=-1)
+                cutoff = jnp.take_along_axis(
+                    sorted_l, cutoff_idx[:, None], axis=-1)
+                logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+            return jax.random.categorical(key, logits, axis=-1) \
+                .astype(jnp.int32)
+
+        def run(state_vals, ids, key):
+            caches = self.init_decode_caches(b, total)
+
+            def step_logits(token_ids, caches, offset):
+                with self.bind_state(names, list(state_vals)):
+                    with no_grad():
+                        logits, caches = self.generate_step(
+                            Tensor(token_ids), caches, offset)
+                lv = logits._value if isinstance(logits, Tensor) else logits
+                return lv[:, -1, :], caches
+
+            # prefill the whole prompt in one pass
+            last, caches = step_logits(ids, caches, 0)
+            key, sub = jax.random.split(key)
+            tok = sample(last, sub)
+            fill = eos_token_id if eos_token_id is not None else 0
+            out0 = jnp.full((b, max_new_tokens), fill, jnp.int32) \
+                .at[:, 0].set(tok)
+            done0 = (tok == eos_token_id) if eos_token_id is not None \
+                else jnp.zeros((b,), bool)
+
+            def cond(carry):
+                i, tok, caches, out, done, key = carry
+                return jnp.logical_and(i < max_new_tokens,
+                                       jnp.logical_not(jnp.all(done)))
+
+            def body(carry):
+                i, tok, caches, out, done, key = carry
+                last, caches = step_logits(tok[:, None], caches,
+                                           prompt_len + i - 1)
+                key, sub = jax.random.split(key)
+                nxt = sample(last, sub)
+                if eos_token_id is not None:
+                    nxt = jnp.where(done, eos_token_id, nxt)
+                    done = jnp.logical_or(done, nxt == eos_token_id)
+                out = out.at[:, i].set(nxt)
+                return (i + 1, nxt, caches, out, done, key)
+
+            # decode loop: one XLA while_loop (early exit on all-eos)
+            _, _, _, out, _, _ = jax.lax.while_loop(
+                cond, body, (1, tok, caches, out0, done0, key))
+            return out
+
+        with no_grad():
+            out = jax.jit(run)(list(values), ids, jax.random.key(seed))
+        return Tensor(out)

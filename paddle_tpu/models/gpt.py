@@ -2,8 +2,17 @@
 paddle.nn.TransformerDecoder). LayerNorm + learned positions + GELU MLP."""
 from __future__ import annotations
 
+import jax.numpy as jnp
+
 from .. import ops
 from ..nn import functional as F
+from .generation import (
+    DecodeCache,
+    GenerationMixin,
+    cache_update,
+    decode_mask as _decode_mask,
+    masked_decode_attention,
+)
 from ..nn.layer import Layer
 from ..nn.layers.common import Dropout, Embedding, Linear
 from ..nn.layers.container import LayerList
@@ -44,12 +53,21 @@ class GPTBlock(Layer):
             self.fc2 = Linear(ffn, hidden)
         self.drop = Dropout(dropout)
 
-    def forward(self, x):
+    def forward(self, x, cache=None, position_offset=0):
         b, s, hdim = x.shape
         h = self.ln1(x)
         qkv = self.qkv(h).reshape([b, s, 3, self.heads, self.head_dim])
         q, k, v = ops.manipulation.unbind(qkv, axis=2)
-        attn = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        if isinstance(cache, DecodeCache):
+            cache, k, v = cache_update(cache, k, v, position_offset)
+            attn = masked_decode_attention(
+                q, k, v, _decode_mask(position_offset, s, k.shape[1]))
+        elif cache is not None:
+            raise TypeError(
+                "GPTBlock decode takes DecodeCache buffers "
+                "(init_decode_caches); got %r" % type(cache).__name__)
+        else:
+            attn = F.scaled_dot_product_attention(q, k, v, is_causal=True)
         attn = attn.reshape([b, s, hdim])
         x = x + self.drop(self.proj(attn))
         h = self.ln2(x)
@@ -57,10 +75,12 @@ class GPTBlock(Layer):
             x = x + self.drop(self.moe(h))
         else:
             x = x + self.drop(self.fc2(F.gelu(self.fc1(h))))
+        if cache is not None:
+            return x, cache
         return x
 
 
-class GPTModel(Layer):
+class GPTModel(GenerationMixin, Layer):
     def __init__(self, vocab_size=50304, hidden_size=768, num_layers=12,
                  num_heads=12, ffn_size=None, max_seq_len=1024, dropout=0.0,
                  use_parallel=False, moe_experts=0, moe_every=2,
@@ -92,16 +112,24 @@ class GPTModel(Layer):
                          else total + blk.moe.aux_loss)
         return total
 
-    def forward(self, input_ids, labels=None):
+    def forward(self, input_ids, labels=None, caches=None,
+                position_offset=0):
         import paddle_tpu as P
 
         b, s = input_ids.shape
-        pos = P.arange(s, dtype="int64").unsqueeze(0)
+        pos = P.arange(s, dtype="int64").unsqueeze(0) + position_offset
         x = self.wte(input_ids) + self.wpe(pos)
-        for blk in self.blocks:
-            x = blk(x)
+        new_caches = []
+        for i, blk in enumerate(self.blocks):
+            if caches is not None:
+                x, c = blk(x, caches[i], position_offset)
+                new_caches.append(c)
+            else:
+                x = blk(x)
         x = self.ln_f(x)
         logits = P.matmul(x, self.wte.weight, transpose_y=True)
+        if caches is not None:
+            return logits, new_caches
         if labels is not None:
             loss = F.cross_entropy(
                 logits.reshape([-1, self.vocab_size]), labels.reshape([-1]))
@@ -110,3 +138,20 @@ class GPTModel(Layer):
                 loss = loss + aux * self.moe_aux_coeff
             return loss
         return logits
+
+    def generate_step(self, input_ids, caches, position_offset):
+        """Single decode step with functional cache (GenerationMixin)."""
+        return self.forward(input_ids, caches=caches,
+                            position_offset=position_offset)
+
+    def max_decode_len(self):
+        return self.wpe.num_embeddings
+
+    def init_decode_caches(self, batch, total_len):
+        head_dim = self.blocks[0].head_dim
+        heads = self.blocks[0].heads
+        dt = self.wte.weight._value.dtype  # cache in the model's dtype
+        return [DecodeCache(
+            jnp.zeros((batch, total_len, heads, head_dim), dt),
+            jnp.zeros((batch, total_len, heads, head_dim), dt))
+            for _ in range(len(self.blocks))]

@@ -15,7 +15,6 @@ TPU-native design decisions:
 from __future__ import annotations
 
 import math
-from typing import NamedTuple
 
 import jax.numpy as jnp
 
@@ -27,23 +26,19 @@ from ..nn.layer import Layer
 from ..nn.layers.common import Embedding
 from ..nn.layers.container import LayerList
 from ..nn.layers.norm import RMSNorm
+from .generation import (
+    DecodeCache,
+    GenerationMixin,
+    cache_update,
+    decode_mask as _decode_mask,
+    masked_decode_attention,
+)
 from ..parallel.mp_layers import (
     ColumnParallelLinear,
     RowParallelLinear,
     VocabParallelEmbedding,
     mark_sharding,
 )
-
-
-class DecodeCache(NamedTuple):
-    """Static-size per-layer KV buffer for compiled generation: [B, L_max,
-    H_kv, D] each. A NamedTuple so it is a pytree — the whole decode loop
-    jits into one XLA while-loop with the caches as carried state (the
-    reference grows DenseTensor caches per step; on TPU that would
-    recompile every step)."""
-
-    k: "object"
-    v: "object"
 
 
 class LlamaConfig:
@@ -190,48 +185,20 @@ class LlamaAttention(Layer):
                 [b, s, self.num_kv_heads, self.head_dim])
         q, k = rope_apply(q, k, theta=self.rope_theta,
                           position_offset=position_offset)
-        decode_mask = None
+        mask = None
         if isinstance(cache, DecodeCache):
-            # static-buffer decode path: write the s new K/V rows at
-            # position_offset (dynamic_update_slice — ONE compiled shape
-            # for the whole generation, no concat-regrow recompiles)
-            import jax
-
-            def _upd(buf, new):
-                nv = new._value if hasattr(new, "_value") else jnp.asarray(new)
-                return jax.lax.dynamic_update_slice(
-                    buf, nv.astype(buf.dtype), (0, position_offset, 0, 0))
-
-            kb = _upd(cache.k, k)
-            vb = _upd(cache.v, v)
-            cache = DecodeCache(kb, vb)
-            from ..core.tensor import Tensor as _T
-
-            k, v = _T(kb), _T(vb)
-            if isinstance(position_offset, int) and position_offset == 0:
-                # prefill: queries sit at buffer positions 0..s-1, so the
-                # end-aligned valid-region mask IS start-aligned causal —
-                # express it as is_causal so the Pallas flash kernel stays
-                # eligible for the one heavy attention call in generate()
-                decode_mask = "causal"
-            else:
-                # decode: query i (global pos P+i) sees buffer slots
-                # j <= P+i; slots past the write head are excluded by the
-                # same comparison (never attended)
-                kv_pos = jnp.arange(k.shape[1])
-                q_pos = position_offset + jnp.arange(s)
-                decode_mask = kv_pos[None, :] <= q_pos[:, None]  # [s, kv]
+            # static-buffer decode path (generation.py): ONE compiled
+            # shape for the whole generation, no concat-regrow recompiles
+            cache, k, v = cache_update(cache, k, v, position_offset)
+            mask = _decode_mask(position_offset, s, k.shape[1])
         elif cache is not None:
             pk, pv = cache
             k = ops.manipulation.concat([pk, k], axis=1)
             v = ops.manipulation.concat([pv, v], axis=1)
             cache = (k, v)
             # end-aligned: the s new queries sit at the END of the kv
-            # window (scaled_dot_product_attention's is_causal is
-            # start-aligned, which would be wrong here)
-            kv_pos = jnp.arange(k.shape[1])
-            q_pos = k.shape[1] - s + jnp.arange(s)
-            decode_mask = kv_pos[None, :] <= q_pos[:, None]
+            # window (one shared masking convention — generation.py)
+            mask = _decode_mask(k.shape[1] - s, s, k.shape[1])
         if self.num_kv_heads != self.num_heads:
             rep = self.num_heads // self.num_kv_heads
             k = ops.manipulation.repeat_interleave(k, rep, axis=2)
@@ -240,14 +207,8 @@ class LlamaAttention(Layer):
             # ring attention over the 'sep' axis (falls back to flash
             # attention when the mesh has no sep axis)
             out = F.sequence_parallel_attention(q, k, v, is_causal=True)
-        elif decode_mask is not None:
-            if isinstance(decode_mask, str):  # "causal" (prefill)
-                out = F.scaled_dot_product_attention(q, k, v,
-                                                     is_causal=True)
-            else:
-                out = F.scaled_dot_product_attention(
-                    q, k, v, attn_mask=decode_mask[None, None],
-                    is_causal=False)
+        elif mask is not None:
+            out = masked_decode_attention(q, k, v, mask)
         else:
             out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
         out = out.reshape([b, s, self.num_heads * self.head_dim])
@@ -365,7 +326,7 @@ class LlamaModel(Layer):
         return x
 
 
-class LlamaForCausalLM(Layer):
+class LlamaForCausalLM(GenerationMixin, Layer):
     def __init__(self, config):
         super().__init__()
         self.config = config
@@ -410,106 +371,18 @@ class LlamaForCausalLM(Layer):
         logits = self.lm_head(h)
         return logits, caches
 
-    def generate(self, input_ids, max_new_tokens=32, do_sample=False,
-                 top_k=0, top_p=1.0, temperature=1.0, eos_token_id=None,
-                 seed=0):
-        """Autoregressive generation, compiled end to end.
+    def max_decode_len(self):
+        return self.config.max_position_embeddings
 
-        Reference analog: PaddleNLP GenerationMixin over the growing
-        DenseTensor cache + sampling ops (top_k_top_p_sampling). TPU-first
-        shape: static DecodeCache buffers sized prompt+max_new_tokens, a
-        jitted prefill, and ONE jitted lax.while_loop for the whole decode
-        — no per-step dispatch, no shape-driven recompiles. Early exit
-        when every sequence has emitted eos_token_id.
-
-        Returns the generated ids [B, max_new_tokens] (prompt excluded);
-        positions after a sequence's eos are padded with eos.
-        """
-        import jax
-
-        from ..core.dispatch import no_grad
-        from ..core.tensor import Tensor
-
-        ids = input_ids._value if isinstance(input_ids, Tensor) \
-            else jnp.asarray(input_ids)
-        ids = ids.astype(jnp.int32)
-        b, prompt_len = ids.shape
-        total = prompt_len + max_new_tokens
+    def init_decode_caches(self, batch, total_len):
         cfg = self.config
         n_kv = cfg.num_key_value_heads
         head_dim = cfg.hidden_size // cfg.num_attention_heads
         kv_dtype = jnp.dtype(cfg.dtype)
-        names, values = self.functional_state()
-
-        def sample(logits, key):
-            logits = logits.astype(jnp.float32) / max(temperature, 1e-6)
-            if not do_sample:
-                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            if top_k:
-                kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
-                logits = jnp.where(logits < kth, -jnp.inf, logits)
-            if top_p < 1.0:
-                sorted_l = jnp.sort(logits, axis=-1)[:, ::-1]
-                probs = jax.nn.softmax(sorted_l, axis=-1)
-                cum = jnp.cumsum(probs, axis=-1)
-                # smallest prefix with mass >= top_p stays
-                cutoff_idx = jnp.sum(cum < top_p, axis=-1)
-                cutoff = jnp.take_along_axis(
-                    sorted_l, cutoff_idx[:, None], axis=-1)
-                logits = jnp.where(logits < cutoff, -jnp.inf, logits)
-            return jax.random.categorical(key, logits, axis=-1) \
-                .astype(jnp.int32)
-
-        def run(state_vals, ids, key):
-            caches = [DecodeCache(
-                jnp.zeros((b, total, n_kv, head_dim), kv_dtype),
-                jnp.zeros((b, total, n_kv, head_dim), kv_dtype))
-                for _ in range(cfg.num_hidden_layers)]
-
-            def step_logits(token_ids, caches, offset):
-                with self.bind_state(names, list(state_vals)):
-                    with no_grad():
-                        logits, caches = self.generate_step(
-                            Tensor(token_ids), caches, offset)
-                lv = logits._value if isinstance(logits, Tensor) else logits
-                return lv[:, -1, :], caches
-
-            # prefill the whole prompt in one pass
-            last, caches = step_logits(ids, caches, 0)
-            key, sub = jax.random.split(key)
-            tok = sample(last, sub)
-            fill = eos_token_id if eos_token_id is not None else 0
-            out0 = jnp.full((b, max_new_tokens), fill, jnp.int32) \
-                .at[:, 0].set(tok)
-            done0 = (tok == eos_token_id) if eos_token_id is not None \
-                else jnp.zeros((b,), bool)
-
-            def cond(carry):
-                i, tok, caches, out, done, key = carry
-                return jnp.logical_and(i < max_new_tokens,
-                                       jnp.logical_not(jnp.all(done)))
-
-            def body(carry):
-                i, tok, caches, out, done, key = carry
-                last, caches = step_logits(tok[:, None], caches,
-                                           prompt_len + i - 1)
-                key, sub = jax.random.split(key)
-                nxt = sample(last, sub)
-                if eos_token_id is not None:
-                    nxt = jnp.where(done, eos_token_id, nxt)
-                    done = jnp.logical_or(done, nxt == eos_token_id)
-                out = out.at[:, i].set(nxt)
-                return (i + 1, nxt, caches, out, done, key)
-
-            # decode loop: one XLA while_loop (early exit on all-eos)
-            _, _, _, out, _, _ = jax.lax.while_loop(
-                cond, body, (1, tok, caches, out0, done0, key))
-            return out
-
-        with no_grad():
-            out = jax.jit(run)(list(values), ids,
-                               jax.random.key(seed))
-        return Tensor(out)
+        return [DecodeCache(
+            jnp.zeros((batch, total_len, n_kv, head_dim), kv_dtype),
+            jnp.zeros((batch, total_len, n_kv, head_dim), kv_dtype))
+            for _ in range(cfg.num_hidden_layers)]
 
     # -- pipeline-parallel protocol (parallel/pipeline_parallel.py) --------
 
