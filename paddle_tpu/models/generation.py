@@ -162,6 +162,25 @@ class GenerationMixin:
                 cond, body, (1, tok, caches, out0, done0, key))
             return out
 
-        with no_grad():
-            out = jax.jit(run)(list(values), ids, jax.random.key(seed))
+        # one compiled program per (shape, sampling-config) signature —
+        # repeat serving calls hit the cache instead of re-tracing
+        cache_key = (b, prompt_len, max_new_tokens, do_sample, top_k,
+                     top_p, temperature, eos_token_id)
+        jit_cache = self.__dict__.setdefault("_generate_jit_cache", {})
+        compiled = jit_cache.get(cache_key)
+        if compiled is None:
+            compiled = jax.jit(run)
+            jit_cache[cache_key] = compiled
+
+        # inference semantics: dropout must be off inside the compiled
+        # loop (Layer.training defaults True; a traced train-mode dropout
+        # would corrupt logits with one frozen mask per trace)
+        was_training = self.training
+        self.eval()
+        try:
+            with no_grad():
+                out = compiled(list(values), ids, jax.random.key(seed))
+        finally:
+            if was_training:
+                self.train()
         return Tensor(out)

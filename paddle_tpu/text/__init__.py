@@ -4,6 +4,12 @@ when given paths and otherwise generate deterministic synthetic corpora
 with the reference's shapes/dtypes (same pattern as vision/audio).
 """
 from .viterbi_decode import ViterbiDecoder, viterbi_decode  # noqa: F401
+from .tokenizer import (  # noqa: F401
+    BasicTokenizer,
+    BertTokenizer,
+    FasterTokenizer,
+    WordPieceTokenizer,
+)
 from .datasets import (  # noqa: F401
     Conll05st,
     Imdb,

@@ -1,0 +1,245 @@
+"""In-graph-style BERT tokenization over StringTensor.
+
+Parity: reference faster_tokenizer op
+(paddle/fluid/operators/string/faster_tokenizer_op.h: BasicTokenizer,
+WordPieceTokenizer, BertTokenizer, FasterTokenizerKernel) — text to
+(input_ids, token_type_ids) without a Python preprocessing dependency.
+
+TPU mapping: tokenization is host work in both stacks (the reference
+kernel is CPU-only); the output lands directly as device int32 tensors,
+padded/truncated to a static max_seq_len so downstream jit sees ONE
+shape. Standard public BERT wordpiece algorithm, fresh implementation.
+"""
+from __future__ import annotations
+
+import unicodedata
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core.string_tensor import StringTensor
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+
+
+def _is_whitespace(ch):
+    return ch in " \t\n\r" or unicodedata.category(ch) == "Zs"
+
+
+def _is_control(ch):
+    if ch in ("\t", "\n", "\r"):
+        return False
+    return unicodedata.category(ch).startswith("C")
+
+
+def _is_punctuation(ch):
+    cp = ord(ch)
+    # ASCII ranges the reference treats as punctuation even when unicode
+    # says otherwise (e.g. '$', '`')
+    if (33 <= cp <= 47 or 58 <= cp <= 64 or 91 <= cp <= 96
+            or 123 <= cp <= 126):
+        return True
+    return unicodedata.category(ch).startswith("P")
+
+
+def _is_cjk(ch):
+    cp = ord(ch)
+    return (0x4E00 <= cp <= 0x9FFF or 0x3400 <= cp <= 0x4DBF
+            or 0x20000 <= cp <= 0x2A6DF or 0x2A700 <= cp <= 0x2B73F
+            or 0x2B740 <= cp <= 0x2B81F or 0x2B820 <= cp <= 0x2CEAF
+            or 0xF900 <= cp <= 0xFAFF or 0x2F800 <= cp <= 0x2FA1F)
+
+
+class BasicTokenizer:
+    """Whitespace/punctuation/CJK splitting + optional lowercase/accent
+    strip (reference faster_tokenizer_op.h:45)."""
+
+    def __init__(self, do_lower_case=True):
+        self.do_lower_case = do_lower_case
+
+    def tokenize(self, text):
+        # clean: drop control chars, normalize whitespace
+        out = []
+        for ch in text:
+            if ord(ch) == 0 or ord(ch) == 0xFFFD or _is_control(ch):
+                continue
+            out.append(" " if _is_whitespace(ch) else ch)
+        text = "".join(out)
+        # pad CJK chars so each is its own token
+        text = "".join(" %s " % ch if _is_cjk(ch) else ch for ch in text)
+        tokens = []
+        for tok in text.split():
+            if self.do_lower_case:
+                tok = tok.lower()
+                tok = "".join(c for c in unicodedata.normalize("NFD", tok)
+                              if unicodedata.category(c) != "Mn")
+            # split on punctuation
+            cur = []
+            for ch in tok:
+                if _is_punctuation(ch):
+                    if cur:
+                        tokens.append("".join(cur))
+                        cur = []
+                    tokens.append(ch)
+                else:
+                    cur.append(ch)
+            if cur:
+                tokens.append("".join(cur))
+        return tokens
+
+
+class WordPieceTokenizer:
+    """Greedy longest-match-first subword split (reference
+    faster_tokenizer_op.h:56)."""
+
+    def __init__(self, vocab, unk_token="[UNK]",
+                 max_input_chars_per_word=100):
+        self.vocab = vocab
+        self.unk_token = unk_token
+        self.max_input_chars_per_word = max_input_chars_per_word
+
+    def tokenize(self, word):
+        if len(word) > self.max_input_chars_per_word:
+            return [self.unk_token]
+        pieces = []
+        start = 0
+        while start < len(word):
+            end = len(word)
+            cur = None
+            while start < end:
+                sub = word[start:end]
+                if start > 0:
+                    sub = "##" + sub
+                if sub in self.vocab:
+                    cur = sub
+                    break
+                end -= 1
+            if cur is None:
+                return [self.unk_token]
+            pieces.append(cur)
+            start = end
+        return pieces
+
+
+class BertTokenizer:
+    """Full BERT encode pipeline (reference faster_tokenizer_op.h:70)."""
+
+    def __init__(self, vocab, do_lower_case=True, unk_token="[UNK]",
+                 pad_token="[PAD]", cls_token="[CLS]", mask_token="[MASK]",
+                 sep_token="[SEP]"):
+        self.vocab = dict(vocab)
+        self.inv_vocab = {v: k for k, v in self.vocab.items()}
+        self.do_lower_case = do_lower_case
+        self.unk_token, self.pad_token = unk_token, pad_token
+        self.cls_token, self.sep_token = cls_token, sep_token
+        self.mask_token = mask_token
+        self.basic = BasicTokenizer(do_lower_case)
+        self.wordpiece = WordPieceTokenizer(self.vocab, unk_token)
+        self.unk_token_id = self.vocab[unk_token]
+        self.pad_token_id = self.vocab[pad_token]
+        self.cls_token_id = self.vocab[cls_token]
+        self.sep_token_id = self.vocab[sep_token]
+
+    def tokenize(self, text):
+        toks = []
+        for word in self.basic.tokenize(text):
+            toks.extend(self.wordpiece.tokenize(word))
+        return toks
+
+    def convert_tokens_to_ids(self, tokens):
+        return [self.vocab.get(t, self.unk_token_id) for t in tokens]
+
+    def convert_ids_to_tokens(self, ids):
+        return [self.inv_vocab.get(int(i), self.unk_token) for i in ids]
+
+    def num_special_tokens_to_add(self, pair=False):
+        return 3 if pair else 2
+
+    def build_inputs_with_special_tokens(self, ids0, ids1=None):
+        out = [self.cls_token_id] + list(ids0) + [self.sep_token_id]
+        if ids1:
+            out += list(ids1) + [self.sep_token_id]
+        return out
+
+    def create_token_type_ids_from_sequences(self, ids0, ids1=None):
+        tt = [0] * (len(ids0) + 2)
+        if ids1:
+            tt += [1] * (len(ids1) + 1)
+        return tt
+
+    def truncate_sequence(self, ids, pair_ids=None, num_tokens_to_remove=0):
+        """Longest-first truncation (reference TruncateSequence)."""
+        for _ in range(num_tokens_to_remove):
+            if pair_ids and len(pair_ids) >= len(ids):
+                pair_ids.pop()
+            elif ids:
+                ids.pop()
+        return ids, pair_ids
+
+    def encode(self, text, text_pair=None, max_seq_len=0,
+               pad_to_max_seq_len=False):
+        """-> {"input_ids": [...], "token_type_ids": [...]}
+        (reference Encode, faster_tokenizer_op.h:96)."""
+        ids = self.convert_tokens_to_ids(self.tokenize(text))
+        pair_ids = (self.convert_tokens_to_ids(self.tokenize(text_pair))
+                    if text_pair else None)
+        n_special = self.num_special_tokens_to_add(pair=bool(pair_ids))
+        if max_seq_len:
+            total = len(ids) + (len(pair_ids) if pair_ids else 0) + n_special
+            if total > max_seq_len:
+                ids, pair_ids = self.truncate_sequence(
+                    ids, pair_ids, total - max_seq_len)
+        input_ids = self.build_inputs_with_special_tokens(ids, pair_ids)
+        token_type_ids = self.create_token_type_ids_from_sequences(
+            ids, pair_ids)
+        if max_seq_len and pad_to_max_seq_len:
+            pad = max_seq_len - len(input_ids)
+            input_ids += [self.pad_token_id] * pad
+            token_type_ids += [0] * pad
+        return {"input_ids": input_ids, "token_type_ids": token_type_ids}
+
+    def batch_encode(self, texts, text_pairs=None, max_seq_len=0,
+                     pad_to_max_seq_len=False):
+        pairs = text_pairs if text_pairs is not None else [None] * len(texts)
+        return [self.encode(t, p, max_seq_len, pad_to_max_seq_len)
+                for t, p in zip(texts, pairs)]
+
+
+class FasterTokenizer(Layer):
+    """Layer form (reference FasterTokenizerKernel + the to_static path in
+    test_faster_tokenizer_op.py): StringTensor/str batch in →
+    (input_ids, token_type_ids) int32 device tensors, padded to the batch
+    max (or a fixed max_seq_len so jit sees one shape)."""
+
+    def __init__(self, vocab, do_lower_case=True, is_split_into_words=False,
+                 max_seq_len=0, pad_to_max_seq_len=False):
+        super().__init__()
+        self.tokenizer = BertTokenizer(vocab, do_lower_case=do_lower_case)
+        self.max_seq_len = max_seq_len
+        self.pad_to_max_seq_len = pad_to_max_seq_len
+
+    def forward(self, text, text_pair=None):
+        def to_list(t):
+            if t is None:
+                return None
+            if isinstance(t, StringTensor):
+                return [v if isinstance(v, str) else v.decode("utf-8")
+                        for v in np.asarray(t.numpy()).ravel().tolist()]
+            if isinstance(t, str):
+                return [t]
+            return list(t)
+
+        texts = to_list(text)
+        pairs = to_list(text_pair)
+        enc = self.tokenizer.batch_encode(
+            texts, pairs, self.max_seq_len, self.pad_to_max_seq_len)
+        width = max(len(e["input_ids"]) for e in enc)
+        pad_id = self.tokenizer.pad_token_id
+        ids = np.full((len(enc), width), pad_id, np.int32)
+        tt = np.zeros((len(enc), width), np.int32)
+        for i, e in enumerate(enc):
+            n = len(e["input_ids"])
+            ids[i, :n] = e["input_ids"]
+            tt[i, :n] = e["token_type_ids"]
+        return Tensor(jnp.asarray(ids)), Tensor(jnp.asarray(tt))

@@ -154,3 +154,39 @@ class TestGPTGenerate:
         with pytest.raises(TypeError, match="DecodeCache"):
             m.generate_step(paddle.to_tensor(np.zeros((1, 2), np.int32)),
                             bad, 0)
+
+    def test_dropout_model_generates_clean_greedy(self):
+        """generate() must run in eval mode: a train-mode dropout traced
+        into the decode loop would corrupt logits (regression)."""
+        from paddle_tpu.models.gpt import GPTModel
+
+        paddle.seed(8)
+        m = GPTModel(vocab_size=32, hidden_size=16, num_layers=1,
+                     num_heads=2, max_seq_len=32, dropout=0.5)
+        m.train()  # serving code often forgets eval(); generate handles it
+        prompt = np.asarray([[3, 1, 4]], np.int32)
+        got = np.asarray(m.generate(paddle.to_tensor(prompt),
+                                    max_new_tokens=3)._value)
+        assert m.training  # restored
+        m.eval()
+        seq = prompt.copy()
+        for t in range(3):
+            logits = m(paddle.to_tensor(seq))
+            nxt = np.argmax(np.asarray(logits._value)[:, -1, :], axis=-1)
+            np.testing.assert_array_equal(got[:, t], nxt.astype(np.int32))
+            seq = np.concatenate([seq, nxt[:, None].astype(np.int32)],
+                                 axis=1)
+
+    def test_generate_jit_cache_reused(self):
+        from paddle_tpu.models.gpt import GPTModel
+
+        paddle.seed(9)
+        m = GPTModel(vocab_size=32, hidden_size=16, num_layers=1,
+                     num_heads=2, max_seq_len=32)
+        p1 = paddle.to_tensor(np.asarray([[1, 2]], np.int32))
+        m.generate(p1, max_new_tokens=2)
+        assert len(m._generate_jit_cache) == 1
+        m.generate(p1, max_new_tokens=2)  # same signature -> cache hit
+        assert len(m._generate_jit_cache) == 1
+        m.generate(p1, max_new_tokens=3)  # new signature
+        assert len(m._generate_jit_cache) == 2

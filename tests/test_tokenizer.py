@@ -1,0 +1,96 @@
+"""BERT tokenization over StringTensor (reference faster_tokenizer_op.h
+BasicTokenizer/WordPieceTokenizer/BertTokenizer + FasterTokenizerKernel;
+oracle expectations follow the public BERT wordpiece algorithm and the
+reference unittest test_faster_tokenizer_op.py's contract)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.text import (
+    BasicTokenizer,
+    BertTokenizer,
+    FasterTokenizer,
+    WordPieceTokenizer,
+)
+
+VOCAB = {}
+for w in ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]", "the", "quick",
+          "brown", "fox", "jump", "##ed", "##s", "over", "lazy", "dog",
+          "un", "##want", "run", "##ning", "!", ",", "你", "好"]:
+    VOCAB.setdefault(w, len(VOCAB))
+
+
+class TestBasicTokenizer:
+    def test_lower_punct_and_cjk(self):
+        bt = BasicTokenizer(do_lower_case=True)
+        assert bt.tokenize("The QUICK, fox!") == \
+            ["the", "quick", ",", "fox", "!"]
+        assert bt.tokenize("你好") == ["你", "好"]
+        assert bt.tokenize("  spaced\tout\n") == ["spaced", "out"]
+
+    def test_accent_strip(self):
+        assert BasicTokenizer(True).tokenize("café") == ["cafe"]
+
+    def test_no_lower(self):
+        assert BasicTokenizer(False).tokenize("The Fox") == ["The", "Fox"]
+
+
+class TestWordPiece:
+    def test_greedy_longest_match(self):
+        wp = WordPieceTokenizer(VOCAB)
+        assert wp.tokenize("jumped") == ["jump", "##ed"]
+        assert wp.tokenize("running") == ["run", "##ning"]
+        # the canonical BERT example: un + ##want + ##ed
+        assert wp.tokenize("unwanted") == ["un", "##want", "##ed"]
+        assert wp.tokenize("unwant") == ["un", "##want"]
+
+    def test_unknown_and_long(self):
+        wp = WordPieceTokenizer(VOCAB, max_input_chars_per_word=5)
+        assert wp.tokenize("zzzzzz") == ["[UNK]"]
+        assert wp.tokenize("zzz") == ["[UNK]"]
+
+
+class TestBertTokenizer:
+    def test_encode_single(self):
+        t = BertTokenizer(VOCAB)
+        enc = t.encode("The quick fox jumped!")
+        toks = t.convert_ids_to_tokens(enc["input_ids"])
+        assert toks == ["[CLS]", "the", "quick", "fox", "jump", "##ed",
+                        "!", "[SEP]"]
+        assert enc["token_type_ids"] == [0] * 8
+
+    def test_encode_pair_and_types(self):
+        t = BertTokenizer(VOCAB)
+        enc = t.encode("the fox", text_pair="lazy dog")
+        toks = t.convert_ids_to_tokens(enc["input_ids"])
+        assert toks == ["[CLS]", "the", "fox", "[SEP]", "lazy", "dog",
+                        "[SEP]"]
+        assert enc["token_type_ids"] == [0, 0, 0, 0, 1, 1, 1]
+
+    def test_truncate_and_pad(self):
+        t = BertTokenizer(VOCAB)
+        enc = t.encode("the quick brown fox jumped over the lazy dog",
+                       max_seq_len=6, pad_to_max_seq_len=True)
+        assert len(enc["input_ids"]) == 6
+        assert enc["input_ids"][0] == t.cls_token_id
+        assert enc["input_ids"][-1] == t.sep_token_id
+        enc = t.encode("the fox", max_seq_len=8, pad_to_max_seq_len=True)
+        assert len(enc["input_ids"]) == 8
+        assert enc["input_ids"][-1] == t.pad_token_id
+
+
+class TestFasterTokenizerLayer:
+    def test_string_tensor_batch(self):
+        layer = FasterTokenizer(VOCAB)
+        st = paddle.StringTensor(["the quick fox", "lazy dog jumped"])
+        ids, tt = layer(st)
+        assert ids.shape[0] == 2 and ids.shape == tt.shape
+        t = layer.tokenizer
+        row0 = t.convert_ids_to_tokens(
+            [i for i in np.asarray(ids._value)[0] if i != t.pad_token_id])
+        assert row0 == ["[CLS]", "the", "quick", "fox", "[SEP]"]
+
+    def test_static_shape_mode(self):
+        layer = FasterTokenizer(VOCAB, max_seq_len=10,
+                                pad_to_max_seq_len=True)
+        ids, tt = layer(["the fox", "dog"])
+        assert list(ids.shape) == [2, 10]
