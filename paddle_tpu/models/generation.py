@@ -169,6 +169,11 @@ class GenerationMixin:
         jit_cache = self.__dict__.setdefault("_generate_jit_cache", {})
         compiled = jit_cache.get(cache_key)
         if compiled is None:
+            if len(jit_cache) >= 16:
+                # bound retained executables: varying prompt lengths in a
+                # serving loop would otherwise grow this forever (callers
+                # wanting few compiles should pad prompts to buckets)
+                jit_cache.pop(next(iter(jit_cache)))
             compiled = jax.jit(run)
             jit_cache[cache_key] = compiled
 

@@ -178,18 +178,34 @@ class BertTokenizer:
         return ids, pair_ids
 
     def encode(self, text, text_pair=None, max_seq_len=0,
-               pad_to_max_seq_len=False):
+               pad_to_max_seq_len=False, is_split_into_words=False):
         """-> {"input_ids": [...], "token_type_ids": [...]}
         (reference Encode, faster_tokenizer_op.h:96)."""
-        ids = self.convert_tokens_to_ids(self.tokenize(text))
-        pair_ids = (self.convert_tokens_to_ids(self.tokenize(text_pair))
-                    if text_pair else None)
+        def to_ids(t):
+            if is_split_into_words:
+                # pre-tokenized words: wordpiece only, no basic re-split
+                toks = []
+                words = t if isinstance(t, (list, tuple)) else t.split()
+                for w in words:
+                    toks.extend(self.wordpiece.tokenize(w))
+                return self.convert_tokens_to_ids(toks)
+            return self.convert_tokens_to_ids(self.tokenize(t))
+
+        ids = to_ids(text)
+        pair_ids = to_ids(text_pair) if text_pair else None
         n_special = self.num_special_tokens_to_add(pair=bool(pair_ids))
         if max_seq_len:
             total = len(ids) + (len(pair_ids) if pair_ids else 0) + n_special
             if total > max_seq_len:
                 ids, pair_ids = self.truncate_sequence(
                     ids, pair_ids, total - max_seq_len)
+            if pair_ids is not None and not pair_ids:
+                # truncation consumed the whole pair: re-budget as a
+                # single sequence (2 specials, not 3) so the output fills
+                # max_seq_len instead of leaving a phantom [SEP] slot
+                pair_ids = None
+                if len(ids) + 2 > max_seq_len:
+                    ids = ids[:max_seq_len - 2]
         input_ids = self.build_inputs_with_special_tokens(ids, pair_ids)
         token_type_ids = self.create_token_type_ids_from_sequences(
             ids, pair_ids)
@@ -200,9 +216,14 @@ class BertTokenizer:
         return {"input_ids": input_ids, "token_type_ids": token_type_ids}
 
     def batch_encode(self, texts, text_pairs=None, max_seq_len=0,
-                     pad_to_max_seq_len=False):
+                     pad_to_max_seq_len=False, is_split_into_words=False):
         pairs = text_pairs if text_pairs is not None else [None] * len(texts)
-        return [self.encode(t, p, max_seq_len, pad_to_max_seq_len)
+        if len(pairs) != len(texts):
+            raise ValueError(
+                "batch_encode: %d texts vs %d text_pairs"
+                % (len(texts), len(pairs)))
+        return [self.encode(t, p, max_seq_len, pad_to_max_seq_len,
+                            is_split_into_words=is_split_into_words)
                 for t, p in zip(texts, pairs)]
 
 
@@ -216,6 +237,7 @@ class FasterTokenizer(Layer):
                  max_seq_len=0, pad_to_max_seq_len=False):
         super().__init__()
         self.tokenizer = BertTokenizer(vocab, do_lower_case=do_lower_case)
+        self.is_split_into_words = is_split_into_words
         self.max_seq_len = max_seq_len
         self.pad_to_max_seq_len = pad_to_max_seq_len
 
@@ -232,8 +254,12 @@ class FasterTokenizer(Layer):
 
         texts = to_list(text)
         pairs = to_list(text_pair)
+        if not texts:
+            z = jnp.zeros((0, self.max_seq_len), jnp.int32)
+            return Tensor(z), Tensor(z)
         enc = self.tokenizer.batch_encode(
-            texts, pairs, self.max_seq_len, self.pad_to_max_seq_len)
+            texts, pairs, self.max_seq_len, self.pad_to_max_seq_len,
+            is_split_into_words=self.is_split_into_words)
         width = max(len(e["input_ids"]) for e in enc)
         pad_id = self.tokenizer.pad_token_id
         ids = np.full((len(enc), width), pad_id, np.int32)

@@ -1,0 +1,48 @@
+"""Op-benchmark CI gate (reference tools/check_op_benchmark_result.py +
+ci_op_benchmark.sh): comparator semantics + a tiny end-to-end run."""
+import json
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+from op_benchmark import check_result  # noqa: E402
+
+
+class TestCheckResult:
+    def _base(self, **ops):
+        return {"platform": "cpu", "ops": ops}
+
+    def test_regression_fails_gate(self):
+        ok, lines = check_result(self._base(matmul=1.30),
+                                 self._base(matmul=1.00), tolerance=0.15)
+        assert not ok
+        assert any("REGRESSION" in l for l in lines)
+
+    def test_within_tolerance_passes(self):
+        ok, lines = check_result(self._base(matmul=1.10),
+                                 self._base(matmul=1.00), tolerance=0.15)
+        assert ok and not any("REGRESSION" in l for l in lines)
+
+    def test_improvement_reported_not_failed(self):
+        ok, lines = check_result(self._base(matmul=0.50),
+                                 self._base(matmul=1.00))
+        assert ok
+        assert any("improved" in l for l in lines)
+
+    def test_missing_op_fails(self):
+        ok, lines = check_result(self._base(), self._base(matmul=1.0))
+        assert not ok
+        assert any("MISSING" in l for l in lines)
+
+    def test_new_op_reported(self):
+        ok, lines = check_result(self._base(gelu=0.1), self._base())
+        assert ok
+        assert any("new" in l for l in lines)
+
+    def test_platform_mismatch_skips(self):
+        cur = {"platform": "tpu", "ops": {"matmul": 9.9}}
+        ok, lines = check_result(cur, self._base(matmul=1.0))
+        assert ok
+        assert any("platform mismatch" in l for l in lines)

@@ -94,3 +94,33 @@ class TestFasterTokenizerLayer:
                                 pad_to_max_seq_len=True)
         ids, tt = layer(["the fox", "dog"])
         assert list(ids.shape) == [2, 10]
+
+
+class TestEdgeCases:
+    def test_is_split_into_words(self):
+        t = BertTokenizer(VOCAB)
+        enc = t.encode(["jumped", "running"], is_split_into_words=True)
+        toks = t.convert_ids_to_tokens(enc["input_ids"])
+        assert toks == ["[CLS]", "jump", "##ed", "run", "##ning", "[SEP]"]
+        layer = FasterTokenizer(VOCAB, is_split_into_words=True)
+        assert layer.is_split_into_words
+
+    def test_batch_length_mismatch_raises(self):
+        import pytest
+
+        t = BertTokenizer(VOCAB)
+        with pytest.raises(ValueError, match="text_pairs"):
+            t.batch_encode(["a", "b", "c"], ["x", "y"])
+
+    def test_truncation_consuming_pair_rebudgets(self):
+        t = BertTokenizer(VOCAB)
+        enc = t.encode("the", text_pair="quick brown fox lazy dog",
+                       max_seq_len=4, pad_to_max_seq_len=True)
+        assert len(enc["input_ids"]) == 4
+        toks = t.convert_ids_to_tokens(enc["input_ids"])
+        assert toks[0] == "[CLS]" and "[SEP]" in toks
+
+    def test_empty_batch(self):
+        layer = FasterTokenizer(VOCAB, max_seq_len=8)
+        ids, tt = layer([])
+        assert list(ids.shape) == [0, 8] and list(tt.shape) == [0, 8]

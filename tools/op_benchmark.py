@@ -1,0 +1,200 @@
+"""Per-op micro-benchmark runner + regression gate.
+
+Parity: reference op-benchmark CI tooling —
+/root/reference/paddle/fluid/operators/benchmark/op_tester.cc (config-driven
+op timing), /root/reference/tools/ci_op_benchmark.sh +
+check_op_benchmark_result.py (compare against a stored baseline, fail the
+gate on regression).
+
+TPU shape: each case times a jitted op body looped on-device via lax.scan
+(amortizes dispatch; see tools/ perf notes in BASELINE.md), subtracting
+measured empty-body overhead. Baselines are committed JSON; `check`
+compares a fresh run and fails on >tolerance slowdowns.
+
+Usage:
+  python tools/op_benchmark.py run  [--out FILE]      # measure
+  python tools/op_benchmark.py check --baseline FILE [--tolerance 0.15]
+  python tools/op_benchmark.py update --baseline FILE # refresh baseline
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _cases():
+    """Benchmark config (reference op_tester's config files): op name ->
+    (build_args, body). Shapes sized for the v5e bench model family on
+    TPU; scaled down 8x on CPU so the CI-plumbing run stays fast
+    (baselines are per-platform — cross-platform numbers never compare)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    rng = np.random.RandomState(0)
+    scale = 1 if jax.default_backend() == "tpu" else 8
+
+    def t(*shape, dtype=jnp.bfloat16):
+        shape = tuple(max(s // scale, 1) if s >= 1024 else s for s in shape)
+        return jnp.asarray(rng.randn(*shape), dtype)
+
+    cases = {}
+
+    def case(name, args, body):
+        cases[name] = (args, body)
+
+    case("matmul_8192x768x768",
+         (t(8192, 768), t(768, 768)),
+         lambda a, b: (a @ b, None)[0])
+    case("matmul_8192x768x32000",
+         (t(8192, 768), t(768, 32000)),
+         lambda a, b: a @ b)
+    case("softmax_8192x32000",
+         (t(8192, 32000, dtype=jnp.float32),),
+         lambda x: jax.nn.softmax(x, axis=-1))
+    case("layer_norm_8192x768",
+         (t(8192, 768, dtype=jnp.float32),),
+         lambda x: (x - x.mean(-1, keepdims=True))
+         / jnp.sqrt(x.var(-1, keepdims=True) + 1e-5))
+    case("gelu_8192x2048",
+         (t(8192, 2048),),
+         jax.nn.gelu)
+    case("flash_attention_8x1024x6x128", None, None)  # built below
+    case("reduce_sum_8192x32000",
+         (t(8192, 32000, dtype=jnp.float32),),
+         lambda x: x.sum(axis=-1))
+    case("transpose_8192x768",
+         (t(8192, 768),),
+         lambda x: x.T.copy() if hasattr(x.T, "copy") else jnp.swapaxes(
+             x, 0, 1))
+
+    from paddle_tpu.kernels.flash_attention import flash_attention
+
+    q = t(8, 1024, 6, 128)
+    cases["flash_attention_8x1024x6x128"] = (
+        (q, t(8, 1024, 6, 128), t(8, 1024, 6, 128)),
+        lambda q, k, v: flash_attention(q, k, v, causal=True))
+    return cases
+
+
+def _time_case(args, body, iters=None, reps=3):
+    """ms/iteration via on-device scan loop minus empty-body overhead."""
+    import jax
+    import jax.numpy as jnp
+
+    if iters is None:
+        iters = 30 if jax.default_backend() == "tpu" else 5
+
+    def loop(fn):
+        # chain iterations through a scalar perturbation so XLA cannot
+        # hoist the loop-invariant body out of the scan
+        @jax.jit
+        def run_loop(a):
+            def step(c, _):
+                out = fn(*[x + 0 * c if jnp.issubdtype(x.dtype, jnp.floating)
+                           else x for x in a])
+                first = jax.tree_util.tree_leaves(out)[0]
+                return jnp.sum(first.astype(jnp.float32)) * 1e-30, None
+
+            c, _ = jax.lax.scan(step, jnp.zeros((), jnp.float32), None,
+                                length=iters)
+            return c
+
+        return run_loop
+
+    run_loop = loop(body)
+    s = run_loop(args)
+    float(s)  # compile + settle
+    best = 1e30
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        s = run_loop(args)
+        float(s)
+        best = min(best, time.perf_counter() - t0)
+    return best / iters * 1000.0
+
+
+def run_bench(out_path=None):
+    import jax
+
+    results = {"platform": jax.default_backend(), "ops": {}}
+    cases = _cases()
+    # measured empty-loop overhead to subtract
+    import jax.numpy as jnp
+
+    overhead = _time_case((jnp.zeros((8, 128)),), lambda x: x + 1.0,
+                          iters=50)
+    results["overhead_ms"] = round(overhead, 4)
+    for name, (args, body) in sorted(cases.items()):
+        ms = _time_case(args, body)
+        results["ops"][name] = round(max(ms - overhead, 1e-4), 4)
+        print("%-36s %8.3f ms" % (name, results["ops"][name]))
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(results, f, indent=1, sort_keys=True)
+        print("wrote", out_path)
+    return results
+
+
+def check_result(current, baseline, tolerance=0.15):
+    """Gate logic (reference check_op_benchmark_result.py): fail when an
+    op is >tolerance slower than baseline ON THE SAME PLATFORM; report
+    speedups informationally. Returns (ok, report_lines)."""
+    lines = []
+    ok = True
+    if current.get("platform") != baseline.get("platform"):
+        lines.append("SKIP: platform mismatch (%s vs baseline %s) — "
+                     "baselines are per-platform"
+                     % (current.get("platform"), baseline.get("platform")))
+        return True, lines
+    for name, base_ms in sorted(baseline.get("ops", {}).items()):
+        cur_ms = current.get("ops", {}).get(name)
+        if cur_ms is None:
+            ok = False
+            lines.append("MISSING %s (in baseline, not measured)" % name)
+            continue
+        ratio = cur_ms / base_ms if base_ms else float("inf")
+        if ratio > 1.0 + tolerance:
+            ok = False
+            lines.append("REGRESSION %-36s %.3f -> %.3f ms (%.0f%%)"
+                         % (name, base_ms, cur_ms, (ratio - 1) * 100))
+        elif ratio < 1.0 - tolerance:
+            lines.append("improved   %-36s %.3f -> %.3f ms" %
+                         (name, base_ms, cur_ms))
+    for name in sorted(set(current.get("ops", {})) -
+                       set(baseline.get("ops", {}))):
+        lines.append("new        %-36s %.3f ms"
+                     % (name, current["ops"][name]))
+    return ok, lines
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("cmd", choices=["run", "check", "update"])
+    ap.add_argument("--out")
+    ap.add_argument("--baseline",
+                    default=os.path.join(os.path.dirname(__file__),
+                                         "op_bench_baseline.json"))
+    ap.add_argument("--tolerance", type=float, default=0.15)
+    a = ap.parse_args()
+    if a.cmd == "run":
+        run_bench(a.out)
+        return 0
+    if a.cmd == "update":
+        run_bench(a.baseline)
+        return 0
+    cur = run_bench(None)
+    with open(a.baseline) as f:
+        base = json.load(f)
+    ok, lines = check_result(cur, base, a.tolerance)
+    print("\n".join(lines) or "all ops within tolerance")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
