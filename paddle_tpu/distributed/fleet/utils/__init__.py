@@ -1,7 +1,16 @@
 """fleet.utils namespace (reference fleet/utils/__init__.py)."""
 from __future__ import annotations
 
-from . import fs, http_server, hybrid_parallel_util, ps_util  # noqa: F401
+from . import (  # noqa: F401
+    fs,
+    http_server,
+    hybrid_parallel_inference,
+    hybrid_parallel_util,
+    ps_util,
+)
+from .hybrid_parallel_inference import (  # noqa: F401
+    HybridParallelInferenceHelper,
+)
 from .fs import HDFSClient, LocalFS  # noqa: F401
 from .ps_util import DistributedInfer  # noqa: F401
 from .hybrid_parallel_util import (  # noqa: F401

@@ -1,0 +1,98 @@
+"""Hybrid-parallel inference helper.
+
+Parity: reference fleet/utils/hybrid_parallel_inference.py
+(HybridParallelInferenceHelper) — runs inference/generation with the
+model split mp x pp. The reference rewrites a static ProgramDesc:
+device_guard annotations become program sections, send_v2/recv_v2 are
+inserted between pipeline stages, and a while-op drives generation.
+
+TPU mapping: the XLA partitioner does the splitting. The helper builds
+the inference mesh, places every parameter by its mpu sharding spec
+(ColumnParallel/RowParallel annotations), and the compiled
+forward/generate then runs with partitioner-inserted collectives — the
+generation while-op is the lax.while_loop already inside
+GenerationMixin.generate. `gen_infer_program` is therefore a placement
+step, not a program rewrite (documented deviation).
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from ....nn.layer import Layer
+from ... import mesh as _mesh
+
+
+class HybridParallelInferenceHelper:
+    """reference hybrid_parallel_inference.py:25.
+
+    Args (TPU form): num_mp/num_pp select the mesh axes;
+    startup_program/main_program are accepted for ported code and may be
+    a Layer (the eager tree plays the program's role); micro_batch_size/
+    beam_size/init_comm/role_maker are accepted for API compatibility
+    (micro-batching and beam layout are compiled shapes here).
+    """
+
+    def __init__(self, startup_program=None, main_program=None, num_mp=1,
+                 num_pp=1, micro_batch_size=1, beam_size=1, init_comm=True,
+                 role_maker=None, model=None):
+        self.num_mp = int(num_mp)
+        self.num_pp = int(num_pp)
+        self.micro_batch_size = micro_batch_size
+        self.beam_size = beam_size
+        self._model = model
+        for cand in (main_program, startup_program):
+            if self._model is None and isinstance(cand, Layer):
+                self._model = cand
+        if init_comm:
+            # keep ALL devices in the (global) mesh: leftover capacity
+            # becomes a dp axis (batch replication for inference), so
+            # later get_mesh() users don't silently shrink to a subset;
+            # dp/mp axes exist even at degree 1, making mp-annotated
+            # params degenerate to replication on single-device runs
+            n = len(jax.devices())
+            stages = self.num_mp * self.num_pp
+            dp = max(n // stages, 1)
+            self.mesh = _mesh.build_hybrid_mesh(
+                dp=dp, mp=self.num_mp, pp=self.num_pp,
+                devices=jax.devices()[:dp * stages])
+        else:
+            self.mesh = _mesh.get_mesh()
+        if self._model is not None:
+            self.shard_params(self._model)
+
+    def shard_params(self, model):
+        """Place every parameter by its mpu annotation over the inference
+        mesh (the reference's program-section split, done as GSPMD
+        placement). Unannotated params replicate."""
+        names = set(self.mesh.axis_names)
+
+        def keep(e):
+            # drop axes the mesh doesn't carry (init_comm=False with a
+            # caller-provided mesh): absent axis == replicated
+            if e is None:
+                return None
+            if isinstance(e, tuple):
+                kept = tuple(a for a in e if a in names)
+                return kept if kept else None
+            return e if e in names else None
+
+        for _, p in model.named_parameters():
+            spec = p._sharding_spec if p._sharding_spec is not None else P()
+            spec = P(*(keep(e) for e in tuple(spec)))
+            p._value = _mesh.shard(p._value, spec, self.mesh)
+        for b in getattr(model, "buffers", lambda: [])():
+            if hasattr(b, "_value"):
+                b._value = _mesh.replicate(b._value, self.mesh)
+        return model
+
+    def gen_infer_program(self, sync_in_while_lastpp2firstpp_var_names=None,
+                          sync_in_while_var_names=None,
+                          debug=False):
+        """reference :539 — returns the ready-to-run model: splitting and
+        stage p2p are the partitioner's job under one compiled module."""
+        if self._model is None:
+            raise ValueError(
+                "HybridParallelInferenceHelper needs a model "
+                "(model=<Layer>, or pass the Layer as main_program)")
+        return self._model
