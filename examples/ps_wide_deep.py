@@ -1,0 +1,60 @@
+"""Parameter-server training: wide&deep with PS-held sparse embeddings.
+
+The recommender path (reference the-one-PS): a server process owns the
+sparse embedding table + dense slots; workers pull touched rows, compute
+the dense part on-device, and push gradients back (async SGD). This demo
+runs server and worker in one process against the in-process runtime;
+tests/test_ps.py runs the same flow over real TCP worker processes.
+
+    python examples/ps_wide_deep.py
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.distributed.ps.runtime import TheOnePSRuntime
+
+
+def main(steps=20, n_slots=8, vocab=1000, dim=8):
+    paddle.seed(0)
+    rt = TheOnePSRuntime()
+    table = rt.create_sparse_table("emb", dim, optimizer="adagrad", lr=0.05)
+    deep = nn.Sequential(nn.Linear(n_slots * dim, 32), nn.ReLU(),
+                         nn.Linear(32, 1))
+    wide = nn.Linear(n_slots, 1)
+    opt = paddle.optimizer.Adam(
+        learning_rate=1e-3,
+        parameters=deep.parameters() + wide.parameters())
+
+    rng = np.random.RandomState(0)
+    for i in range(steps):
+        ids = rng.randint(0, vocab, (32, n_slots))
+        y = (ids.sum(axis=1, keepdims=True) % 2).astype(np.float32)
+
+        uniq, inv = np.unique(ids.reshape(-1), return_inverse=True)
+        rows = np.asarray(table.pull(uniq.tolist()))      # PS pull
+        emb = rows[inv].reshape(32, n_slots * dim)
+
+        emb_t = paddle.to_tensor(emb.astype(np.float32))
+        emb_t.stop_gradient = False
+        wide_in = paddle.to_tensor((ids % 2).astype(np.float32))
+        logit = deep(emb_t) + wide(wide_in)
+        loss = F.binary_cross_entropy_with_logits(logit,
+                                                  paddle.to_tensor(y))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+
+        grad = np.asarray(emb_t.grad._value).reshape(-1, dim)  # PS push
+        gsum = np.zeros((len(uniq), dim), np.float32)
+        np.add.at(gsum, inv, grad)
+        table.push(uniq.tolist(), gsum)
+        if i % 5 == 0:
+            print("step %d loss %.4f table rows %d"
+                  % (i, float(loss), table.size()))
+    return float(loss)
+
+
+if __name__ == "__main__":
+    main()

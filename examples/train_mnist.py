@@ -1,0 +1,62 @@
+"""Dygraph training end to end: LeNet on (synthetic) MNIST.
+
+The reference's hello-world config (SURVEY.md §7 step 3 minimum slice):
+Dataset -> DataLoader -> Layer -> loss -> backward -> Adam -> lr schedule
+-> save/load. Runs on CPU or a TPU chip unchanged.
+
+    python examples/train_mnist.py
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+class LeNet(nn.Layer):
+    def __init__(self, num_classes=10):
+        super().__init__()
+        self.features = nn.Sequential(
+            nn.Conv2D(1, 6, 5, padding=2), nn.ReLU(), nn.MaxPool2D(2),
+            nn.Conv2D(6, 16, 5), nn.ReLU(), nn.MaxPool2D(2))
+        self.fc = nn.Sequential(
+            nn.Linear(16 * 5 * 5, 120), nn.ReLU(),
+            nn.Linear(120, 84), nn.ReLU(), nn.Linear(84, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        return self.fc(paddle.flatten(x, 1))
+
+
+def main(epochs=1, steps_per_epoch=30, batch_size=64):
+    paddle.seed(0)
+    model = LeNet()
+    sched = paddle.optimizer.lr.CosineAnnealingDecay(
+        learning_rate=1e-3, T_max=epochs * steps_per_epoch)
+    opt = paddle.optimizer.Adam(learning_rate=sched,
+                                parameters=model.parameters())
+    rng = np.random.RandomState(0)
+    for epoch in range(epochs):
+        for step in range(steps_per_epoch):
+            # synthetic batch (swap for paddle.vision.datasets.MNIST +
+            # paddle.io.DataLoader with a real data directory)
+            x = paddle.to_tensor(
+                rng.randn(batch_size, 1, 28, 28).astype("float32"))
+            y = paddle.to_tensor(
+                rng.randint(0, 10, (batch_size,)).astype("int64"))
+            loss = F.cross_entropy(model(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            sched.step()
+            if step % 10 == 0:
+                print("epoch %d step %d loss %.4f lr %.2e"
+                      % (epoch, step, float(loss), sched.get_lr()))
+    paddle.save(model.state_dict(), "/tmp/lenet.pdparams")
+    model.set_state_dict(paddle.load("/tmp/lenet.pdparams"))
+    print("saved + reloaded OK")
+    return float(loss)
+
+
+if __name__ == "__main__":
+    main()

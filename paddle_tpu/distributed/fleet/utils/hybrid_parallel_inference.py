@@ -52,10 +52,14 @@ class HybridParallelInferenceHelper:
             # params degenerate to replication on single-device runs
             n = len(jax.devices())
             stages = self.num_mp * self.num_pp
-            dp = max(n // stages, 1)
+            if stages > n or n % stages:
+                raise ValueError(
+                    "num_mp*num_pp (%d) must divide the device count (%d) "
+                    "— a mesh tiles devices exactly; leftover devices "
+                    "cannot be silently dropped from the global mesh"
+                    % (stages, n))
             self.mesh = _mesh.build_hybrid_mesh(
-                dp=dp, mp=self.num_mp, pp=self.num_pp,
-                devices=jax.devices()[:dp * stages])
+                dp=n // stages, mp=self.num_mp, pp=self.num_pp)
         else:
             self.mesh = _mesh.get_mesh()
         if self._model is not None:
@@ -81,7 +85,7 @@ class HybridParallelInferenceHelper:
             spec = p._sharding_spec if p._sharding_spec is not None else P()
             spec = P(*(keep(e) for e in tuple(spec)))
             p._value = _mesh.shard(p._value, spec, self.mesh)
-        for b in getattr(model, "buffers", lambda: [])():
+        for b in model.buffers():
             if hasattr(b, "_value"):
                 b._value = _mesh.replicate(b._value, self.mesh)
         return model

@@ -1,0 +1,36 @@
+"""Every example script must stay runnable (the reference keeps demo
+configs under CI too). Run in-process with reduced step counts."""
+import importlib.util
+import os
+
+import numpy as np
+
+EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "examples")
+
+
+def _load(name):
+    spec = importlib.util.spec_from_file_location(
+        "example_" + name, os.path.join(EXAMPLES, name + ".py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestExamples:
+    def test_train_mnist(self, capsys):
+        loss = _load("train_mnist").main(epochs=1, steps_per_epoch=6,
+                                         batch_size=8)
+        assert np.isfinite(loss)
+
+    def test_train_llama_hybrid(self):
+        loss = _load("train_llama_hybrid").main(steps=3)
+        assert np.isfinite(loss)
+
+    def test_generate_text(self, capsys):
+        _load("generate_text").main()
+        out = capsys.readouterr().out
+        assert "generated tokens:" in out
+
+    def test_ps_wide_deep(self):
+        loss = _load("ps_wide_deep").main(steps=6)
+        assert np.isfinite(loss)

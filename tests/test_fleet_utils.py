@@ -372,9 +372,18 @@ class TestHybridParallelInference:
         m = LlamaForCausalLM(cfg)
         h = HybridParallelInferenceHelper(num_mp=1, model=m)
         assert "mp" in h.mesh.axis_names  # axis exists at degree 1
+        # ambient mesh WITHOUT an mp axis: the keep() drop path must
+        # degrade mp annotations to replication, not crash
+        import jax
+        from jax.sharding import Mesh
+
+        from paddle_tpu.distributed import mesh as pmesh
+
+        pmesh.set_mesh(Mesh(np.array(jax.devices()), ("dp",)))
         m2 = LlamaForCausalLM(cfg)
         h2 = HybridParallelInferenceHelper(num_mp=4, init_comm=False,
                                            model=m2)  # ambient mesh
+        assert "mp" not in h2.mesh.axis_names
         out = h2.gen_infer_program()(
             paddle.to_tensor(np.zeros((1, 4), np.int32)))
         assert out.shape[-1] == 32
