@@ -28,7 +28,8 @@ class LeNet(nn.Layer):
         return self.fc(paddle.flatten(x, 1))
 
 
-def main(epochs=1, steps_per_epoch=30, batch_size=64):
+def main(epochs=1, steps_per_epoch=30, batch_size=64,
+         ckpt_path="/tmp/lenet.pdparams"):
     paddle.seed(0)
     model = LeNet()
     sched = paddle.optimizer.lr.CosineAnnealingDecay(
@@ -52,8 +53,8 @@ def main(epochs=1, steps_per_epoch=30, batch_size=64):
             if step % 10 == 0:
                 print("epoch %d step %d loss %.4f lr %.2e"
                       % (epoch, step, float(loss), sched.get_lr()))
-    paddle.save(model.state_dict(), "/tmp/lenet.pdparams")
-    model.set_state_dict(paddle.load("/tmp/lenet.pdparams"))
+    paddle.save(model.state_dict(), ckpt_path)
+    model.set_state_dict(paddle.load(ckpt_path))
     print("saved + reloaded OK")
     return float(loss)
 

@@ -299,6 +299,7 @@ from .core.enforce import (  # noqa: F401
     UnimplementedError,
     enforce,
 )
+from .core.scalar import IntArray, Scalar  # noqa: F401
 from .core.selected_rows import SelectedRows  # noqa: F401
 from .core.string_tensor import (  # noqa: F401
     StringTensor,

@@ -1,0 +1,117 @@
+"""Scalar / IntArray — the attribute-normalization types.
+
+Parity: reference phi/common/{scalar.h,int_array.h}. There they bridge
+"attr may be a constant OR a runtime tensor" across the C++ API: a
+Scalar holds one typed value, an IntArray a small int list (shapes,
+axes, strides), either literal or backed by a DenseTensor.
+
+TPU mapping: ops take python numbers/lists or Tensors directly and jax
+tracing handles the tensor-valued case, so these exist as the explicit
+normalization point for code ported from the reference C++ API — they
+accept every form the reference does (python scalar, numpy, Tensor,
+0-d/1-d arrays) and expose the same accessors.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def _unwrap(v):
+    if hasattr(v, "_value"):
+        return np.asarray(v._value)
+    return np.asarray(v)
+
+
+class Scalar:
+    """One typed scalar (reference phi/common/scalar.h Scalar)."""
+
+    def __init__(self, value):
+        if isinstance(value, Scalar):
+            self._v = value._v
+            return
+        if isinstance(value, (bool, int, float, complex)):
+            self._v = value
+            return
+        arr = _unwrap(value)
+        if arr.size != 1:
+            raise ValueError(
+                "Scalar takes exactly one element, got shape %s"
+                % (arr.shape,))
+        self._v = arr.reshape(()).item()
+
+    def to_bool(self):
+        return bool(self._v)
+
+    def to_int(self):
+        return int(self._v)
+
+    def to_float(self):
+        return float(self._v)
+
+    def to_complex(self):
+        return complex(self._v)
+
+    @property
+    def dtype(self):
+        return type(self._v).__name__
+
+    def __eq__(self, other):
+        o = other._v if isinstance(other, Scalar) else other
+        return self._v == o
+
+    def __hash__(self):
+        return hash(self._v)
+
+    def __repr__(self):
+        return "Scalar(%r)" % (self._v,)
+
+
+class IntArray:
+    """Small int vector for shapes/axes/indices (reference
+    phi/common/int_array.h IntArray)."""
+
+    def __init__(self, value=(), size=None):
+        if isinstance(value, IntArray):
+            self._v = list(value._v)
+        elif size is not None and isinstance(
+                value, (int, float, np.integer, np.floating)):
+            # IntArray(n, size) — fill constructor (reference int_array.h)
+            self._v = [int(value)] * int(size)
+        else:
+            arr = _unwrap(value)
+            if arr.ndim > 1:
+                raise ValueError(
+                    "IntArray takes a 0/1-d int sequence, got shape %s"
+                    % (arr.shape,))
+            self._v = [int(x) for x in np.atleast_1d(arr)]
+
+    def get_data(self):
+        return list(self._v)
+
+    to_list = get_data
+
+    def size(self):
+        return len(self._v)
+
+    def __len__(self):
+        return len(self._v)
+
+    def __getitem__(self, i):
+        return self._v[i]
+
+    def __iter__(self):
+        return iter(self._v)
+
+    def __eq__(self, other):
+        if isinstance(other, IntArray):
+            return self._v == other._v
+        try:
+            return self._v == list(other)
+        except TypeError:
+            return NotImplemented
+
+    def __hash__(self):
+        return hash(tuple(self._v))
+
+    def __repr__(self):
+        return "IntArray(%r)" % (self._v,)

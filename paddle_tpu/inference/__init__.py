@@ -16,7 +16,7 @@ import numpy as np
 
 __all__ = [
     "Config", "create_predictor", "Predictor", "PlaceType",
-    "PrecisionType", "convert_to_mixed_precision",
+    "PredictorPool", "PrecisionType", "convert_to_mixed_precision",
 ]
 
 
@@ -255,6 +255,49 @@ class Predictor:
     def clear_intermediate_tensor(self):
         pass
 
+    def _clone(self):
+        """Share the loaded program; fresh IO handles (reference
+        AnalysisPredictor::Clone — the pool building block)."""
+        dup = Predictor.__new__(Predictor)
+        dup._config = self._config
+        dup._prog = self._prog
+        dup._feed_names = list(self._feed_names)
+        dup._fetch_names = list(self._fetch_names)
+        dup._inputs = {n: _TensorHandle(n) for n in dup._feed_names}
+        dup._outputs = {n: _TensorHandle(n) for n in dup._fetch_names}
+        return dup
+
 
 def create_predictor(config):
     return Predictor(config)
+
+
+class PredictorPool:
+    """Fixed pool of predictors over one loaded model (reference
+    paddle_infer::services::PredictorPool, inference/api/
+    paddle_inference_api.h): serving threads each retrieve their own
+    predictor so bound IO handles never race. The compiled XLA executable
+    is shared process-wide (jit cache); each pool member only carries its
+    own IO-handle set, so size N costs N handle sets, not N compilations."""
+
+    def __init__(self, config, size=1):
+        if size < 1:
+            raise ValueError("PredictorPool size must be >= 1")
+        first = Predictor(config)
+        self._preds = [first]
+        for _ in range(size - 1):
+            # reference Clone(): share the loaded program (one disk read,
+            # one compiled executable), fresh IO handle set per member
+            self._preds.append(first._clone())
+
+    def retrieve(self, idx):
+        """Predictor #idx (reference Retrive(idx) spelling is Retrieve
+        here; bounds-checked, no negative wrap-around)."""
+        if not 0 <= idx < len(self._preds):
+            raise IndexError(
+                "PredictorPool.retrieve(%d): pool size is %d"
+                % (idx, len(self._preds)))
+        return self._preds[idx]
+
+    def __len__(self):
+        return len(self._preds)

@@ -142,3 +142,41 @@ class TestStringTensor:
         assert low.tolist() == ["äöü straße"]
         up = paddle.strings_upper(st, use_utf8_encoding=True)
         assert up.tolist() == ["ÄÖÜ STRASSE"]
+
+
+class TestScalarIntArray:
+    """reference phi/common/{scalar.h,int_array.h} — the attr
+    normalization types at the C++ API boundary."""
+
+    def test_scalar_accessors(self):
+        s = paddle.Scalar(3.5)
+        assert s.to_float() == 3.5
+        assert s.to_int() == 3
+        assert s.to_bool() is True
+        assert paddle.Scalar(True).dtype == "bool"
+        assert paddle.Scalar(0 + 2j).to_complex() == 2j
+
+    def test_scalar_from_tensor_and_errors(self):
+        import numpy as np
+
+        assert paddle.Scalar(
+            paddle.to_tensor(np.asarray([7]))).to_int() == 7
+        with pytest.raises(ValueError):
+            paddle.Scalar(np.zeros(3))
+        assert paddle.Scalar(2) == 2
+        assert paddle.Scalar(2) == paddle.Scalar(2.0)
+
+    def test_int_array_forms(self):
+        import numpy as np
+
+        ia = paddle.IntArray([1, 2, 3])
+        assert ia.get_data() == [1, 2, 3]
+        assert len(ia) == 3 and ia[1] == 2 and list(ia) == [1, 2, 3]
+        assert paddle.IntArray(7, size=2) == [7, 7]  # fill constructor
+        assert paddle.IntArray(
+            paddle.to_tensor(np.asarray([4, 5]))).to_list() == [4, 5]
+        assert paddle.IntArray(paddle.IntArray([9])) == [9]
+        assert paddle.IntArray(7.0, size=3) == [7, 7, 7]  # float fill
+        assert paddle.IntArray([1, 2]) != 3  # no TypeError on non-iterable
+        with pytest.raises(ValueError):
+            paddle.IntArray(np.zeros((2, 2)))

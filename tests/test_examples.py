@@ -17,9 +17,10 @@ def _load(name):
 
 
 class TestExamples:
-    def test_train_mnist(self, capsys):
-        loss = _load("train_mnist").main(epochs=1, steps_per_epoch=6,
-                                         batch_size=8)
+    def test_train_mnist(self, capsys, tmp_path):
+        loss = _load("train_mnist").main(
+            epochs=1, steps_per_epoch=6, batch_size=8,
+            ckpt_path=str(tmp_path / "lenet.pdparams"))
         assert np.isfinite(loss)
 
     def test_train_llama_hybrid(self):

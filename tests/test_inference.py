@@ -117,3 +117,46 @@ class TestPredictor:
         assert pred.get_input_names()
         outs = pred.run([np.ones((2, 4), np.float32)])
         np.testing.assert_allclose(outs[0], ref, rtol=1e-5, atol=1e-6)
+
+
+class TestPredictorPool:
+    def test_pool_members_have_isolated_handles(self, tmp_path):
+        from paddle_tpu import static
+        from paddle_tpu.inference import PredictorPool
+
+        paddle.seed(2)
+        static.enable_static()
+        try:
+            prefix = str(tmp_path / "pool" / "m")
+            main, startup = static.Program(), static.Program()
+            with static.program_guard(main, startup):
+                inp = static.data("x", [-1, 4], "float32")
+                out = static.nn.fc(inp, 2)
+            exe = static.Executor()
+            exe.run(startup)
+            static.save_inference_model(prefix, [inp], [out], exe,
+                                        program=main)
+        finally:
+            static.disable_static()
+        pool = PredictorPool(Config(prefix + ".pdmodel"), size=3)
+        assert len(pool) == 3
+        a, b = pool.retrieve(0), pool.retrieve(1)
+        assert a is not b
+        xa = np.ones((2, 4), np.float32)
+        xb = np.full((2, 4), 2.0, np.float32)
+        oa = a.run([xa])[0]
+        ob = b.run([xb])[0]
+        assert not np.allclose(oa, ob)  # different inputs, different outs
+        # a's bound handles were not disturbed by b's run
+        name = a.get_input_names()[0]
+        np.testing.assert_allclose(a.get_input_handle(name).copy_to_cpu(),
+                                   xa)
+        # a third member computes the same function
+        np.testing.assert_allclose(pool.retrieve(2).run([xa])[0], oa,
+                                   rtol=1e-6)
+        with pytest.raises(IndexError):
+            pool.retrieve(3)
+        with pytest.raises(IndexError):
+            pool.retrieve(-1)  # no silent wrap-around
+        # members share one loaded program (reference Clone())
+        assert pool.retrieve(1)._prog is pool.retrieve(0)._prog
