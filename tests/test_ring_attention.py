@@ -219,3 +219,47 @@ class TestSegmentAttention:
 
         g = np.asarray(jax.grad(loss)(jnp.asarray(v)))
         np.testing.assert_allclose(g[:, 100:], 0.0, atol=1e-6)
+
+
+class TestLongContext:
+    """Long-context headline: a sequence FAR past single-shard attention
+    memory comfort, run as sep=8 ring attention over the virtual mesh and
+    checked against the dense oracle (SURVEY §5: capability the reference
+    snapshot lacks)."""
+
+    def test_8k_sequence_matches_dense(self):
+        rng = np.random.RandomState(4)
+        b, n, h, d = 1, 8192, 1, 8
+        q = rng.randn(b, n, h, d).astype(np.float32)
+        k = rng.randn(b, n, h, d).astype(np.float32)
+        v = rng.randn(b, n, h, d).astype(np.float32)
+        mesh = _mesh_sep(8)
+        with mesh:
+            out = sequence_parallel_attention(
+                jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                mesh=mesh, causal=True)
+        # spot-check rows across the full length against the dense oracle
+        # (full dense at 8k x 8k stays feasible on CPU at h=1, d=8)
+        ref = _dense(q, k, v, True)
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=3e-4,
+                                   atol=3e-5)
+
+    def test_long_context_grad_flows(self):
+        rng = np.random.RandomState(5)
+        b, n, h, d = 1, 4096, 1, 8
+        q = rng.randn(b, n, h, d).astype(np.float32)
+        k = rng.randn(b, n, h, d).astype(np.float32)
+        v = rng.randn(b, n, h, d).astype(np.float32)
+        mesh = _mesh_sep(8)
+
+        def loss(q_, k_, v_):
+            with mesh:
+                o = sequence_parallel_attention(q_, k_, v_, mesh=mesh,
+                                                causal=True)
+            return jnp.sum(o ** 2)
+
+        g = jax.grad(loss, argnums=(0, 1, 2))(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+        for a in g:
+            arr = np.asarray(a)
+            assert np.isfinite(arr).all() and np.abs(arr).max() > 0
