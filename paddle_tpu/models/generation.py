@@ -74,15 +74,11 @@ class GenerationMixin:
         when unbounded. Models override."""
         return None
 
-    def generate(self, input_ids, max_new_tokens=32, do_sample=False,
-                 top_k=0, top_p=1.0, temperature=1.0, eos_token_id=None,
-                 seed=0):
-        """Autoregressive generation, compiled end to end. Returns the
-        generated ids [B, max_new_tokens] (prompt excluded); positions
-        after a sequence's eos are padded with eos."""
-        import jax
-
-        from ..core.dispatch import no_grad
+    def _coerce_prompt(self, input_ids, max_new_tokens):
+        """-> (ids int32 [b, prompt_len], b, prompt_len, total); validates
+        against max_decode_len (out-of-range positions would clamp in
+        XLA's gather for learned position tables, or extrapolate silently
+        for rope)."""
         from ..core.tensor import Tensor
 
         ids = input_ids._value if isinstance(input_ids, Tensor) \
@@ -92,12 +88,75 @@ class GenerationMixin:
         total = prompt_len + max_new_tokens
         limit = self.max_decode_len()
         if limit is not None and total > limit:
-            # out-of-range positions would clamp in XLA's gather (learned
-            # position tables) or extrapolate silently (rope) — refuse
             raise ValueError(
                 "generate: prompt_len (%d) + max_new_tokens (%d) exceeds "
                 "the model's maximum sequence length (%d)"
                 % (prompt_len, max_new_tokens, limit))
+        return ids, b, prompt_len, total
+
+    def _jit_cached(self, cache_key, build):
+        """Per-signature compiled-callable cache, bounded at 16 retained
+        executables (varying prompt lengths in a serving loop would
+        otherwise grow it forever)."""
+        import jax
+
+        jit_cache = self.__dict__.setdefault("_generate_jit_cache", {})
+        compiled = jit_cache.get(cache_key)
+        if compiled is None:
+            if len(jit_cache) >= 16:
+                jit_cache.pop(next(iter(jit_cache)))
+            compiled = jax.jit(build())
+            jit_cache[cache_key] = compiled
+        return compiled
+
+    def _run_eval(self, compiled, *args):
+        """Invoke a compiled generation program in inference semantics:
+        dropout off inside the traced loop (Layer.training defaults True;
+        a traced train-mode dropout would corrupt logits with one frozen
+        mask per trace), training flag restored after."""
+        from ..core.dispatch import no_grad
+        from ..core.tensor import Tensor
+
+        was_training = self.training
+        self.eval()
+        try:
+            with no_grad():
+                out = compiled(*args)
+        finally:
+            if was_training:
+                self.train()
+        return Tensor(out)
+
+    def generate(self, input_ids, max_new_tokens=32, do_sample=False,
+                 top_k=0, top_p=1.0, temperature=1.0, eos_token_id=None,
+                 seed=0, num_beams=1, length_penalty=0.0):
+        """Autoregressive generation, compiled end to end. Returns the
+        generated ids [B, max_new_tokens] (prompt excluded); positions
+        after a sequence's eos are padded with eos.
+
+        num_beams > 1 switches to beam search (reference PaddleNLP
+        decode_strategy='beam_search'): beams live as an expanded batch
+        inside the same compiled while-loop; each step takes the top
+        num_beams continuations over (beams x vocab) cumulative
+        log-probs, with finished beams frozen on eos. length_penalty is
+        the GNMT exponent alpha (score / len^alpha) applied at the final
+        beam selection."""
+        import jax
+
+        from ..core.dispatch import no_grad
+        from ..core.tensor import Tensor
+
+        if num_beams > 1:
+            if do_sample:
+                raise ValueError(
+                    "beam search is deterministic; do_sample=True "
+                    "conflicts with num_beams > 1")
+            return self._beam_search(input_ids, max_new_tokens, num_beams,
+                                     eos_token_id, length_penalty,
+                                     temperature)
+
+        ids, b, prompt_len, total = self._coerce_prompt(
+            input_ids, max_new_tokens)
         names, values = self.functional_state()
 
         def sample(logits, key):
@@ -162,30 +221,112 @@ class GenerationMixin:
                 cond, body, (1, tok, caches, out0, done0, key))
             return out
 
-        # one compiled program per (shape, sampling-config) signature —
-        # repeat serving calls hit the cache instead of re-tracing
-        cache_key = (b, prompt_len, max_new_tokens, do_sample, top_k,
-                     top_p, temperature, eos_token_id)
-        jit_cache = self.__dict__.setdefault("_generate_jit_cache", {})
-        compiled = jit_cache.get(cache_key)
-        if compiled is None:
-            if len(jit_cache) >= 16:
-                # bound retained executables: varying prompt lengths in a
-                # serving loop would otherwise grow this forever (callers
-                # wanting few compiles should pad prompts to buckets)
-                jit_cache.pop(next(iter(jit_cache)))
-            compiled = jax.jit(run)
-            jit_cache[cache_key] = compiled
+        compiled = self._jit_cached(
+            (b, prompt_len, max_new_tokens, do_sample, top_k, top_p,
+             temperature, eos_token_id), lambda: run)
+        return self._run_eval(compiled, list(values), ids,
+                              jax.random.key(seed))
 
-        # inference semantics: dropout must be off inside the compiled
-        # loop (Layer.training defaults True; a traced train-mode dropout
-        # would corrupt logits with one frozen mask per trace)
-        was_training = self.training
-        self.eval()
-        try:
-            with no_grad():
-                out = compiled(list(values), ids, jax.random.key(seed))
-        finally:
-            if was_training:
-                self.train()
-        return Tensor(out)
+    def _beam_search(self, input_ids, max_new_tokens, num_beams,
+                     eos_token_id, length_penalty, temperature):
+        import jax
+
+        from ..core.dispatch import no_grad
+        from ..core.tensor import Tensor
+
+        ids, b, prompt_len, total = self._coerce_prompt(
+            input_ids, max_new_tokens)
+        names, values = self.functional_state()
+        K = num_beams
+        NEG = jnp.float32(-1e9)
+
+        def run(state_vals, ids):
+            def step_logits(token_ids, caches, offset):
+                with self.bind_state(names, list(state_vals)):
+                    with no_grad():
+                        logits, caches = self.generate_step(
+                            Tensor(token_ids), caches, offset)
+                lv = logits._value if isinstance(logits, Tensor) else logits
+                return lv[:, -1, :].astype(jnp.float32), caches
+
+            # prefill ONCE at batch b (beams are byte-identical over the
+            # prompt), then fan the caches/logits out to b*K beam rows
+            caches = self.init_decode_caches(b, total)
+            last, caches = step_logits(ids, caches, 0)
+            caches = jax.tree_util.tree_map(
+                lambda x: jnp.repeat(x, K, axis=0), caches)
+            last = jnp.repeat(last, K, axis=0)           # [b*K, V]
+            logp = jax.nn.log_softmax(last / max(temperature, 1e-6), -1)
+            vocab = logp.shape[-1]
+            # first step: all beams of a batch row are identical — mask
+            # beams 1..K-1 so the top-K picks K DISTINCT first tokens
+            beam_mask = jnp.where(
+                jnp.arange(b * K) % K == 0, 0.0, NEG)[:, None]
+            scores0 = (logp + beam_mask).reshape(b, K * vocab)
+            top_s, top_i = jax.lax.top_k(scores0, K)     # [b, K]
+            tok0 = (top_i % vocab).astype(jnp.int32)
+            out0 = jnp.full((b, K, max_new_tokens),
+                            eos_token_id if eos_token_id is not None else 0,
+                            jnp.int32).at[:, :, 0].set(tok0)
+            done0 = ((tok0 == eos_token_id) if eos_token_id is not None
+                     else jnp.zeros((b, K), bool))
+            # NOTE: beams share the prefill cache rows (identical prompt),
+            # so no cache reorder is needed at the first step
+            carry0 = (jnp.asarray(1), tok0, caches, out0, top_s, done0)
+
+            def cond(c):
+                i, tok, caches, out, scores, done = c
+                return jnp.logical_and(i < max_new_tokens,
+                                       jnp.logical_not(jnp.all(done)))
+
+            def body(c):
+                i, tok, caches, out, scores, done = c
+                last, caches = step_logits(
+                    tok.reshape(b * K, 1), caches, prompt_len + i - 1)
+                logp = jax.nn.log_softmax(
+                    last / max(temperature, 1e-6), -1)   # [b*K, V]
+                logp = logp.reshape(b, K, vocab)
+                if eos_token_id is not None:
+                    # finished beams: only eos continues, at zero cost
+                    frozen = jnp.full((vocab,), NEG).at[eos_token_id].set(0.0)
+                    logp = jnp.where(done[:, :, None], frozen[None, None, :],
+                                     logp)
+                cand = (scores[:, :, None] + logp).reshape(b, K * vocab)
+                scores, idx = jax.lax.top_k(cand, K)     # [b, K]
+                src_beam = idx // vocab                  # [b, K]
+                nxt = (idx % vocab).astype(jnp.int32)
+                # reorder carried state to the winning source beams
+                flat_src = (jnp.arange(b)[:, None] * K + src_beam) \
+                    .reshape(-1)                         # [b*K]
+                caches = jax.tree_util.tree_map(
+                    lambda x: x[flat_src], caches)
+                out = jnp.take_along_axis(
+                    out, src_beam[:, :, None], axis=1)
+                done = jnp.take_along_axis(done, src_beam, axis=1)
+                if eos_token_id is not None:
+                    done = jnp.logical_or(done, nxt == eos_token_id)
+                out = out.at[:, :, i].set(nxt)
+                return (i + 1, nxt, caches, out, scores, done)
+
+            i, _, _, out, scores, done = jax.lax.while_loop(
+                cond, body, carry0)
+            # GNMT length normalization at final selection
+            if length_penalty:
+                lengths = jnp.where(
+                    done,
+                    jnp.argmax(
+                        out == (eos_token_id
+                                if eos_token_id is not None else -1),
+                        axis=-1) + 1,
+                    i).astype(jnp.float32).clip(min=1.0)
+                norm = scores / (lengths ** length_penalty)
+            else:
+                norm = scores
+            best = jnp.argmax(norm, axis=1)              # [b]
+            return jnp.take_along_axis(
+                out, best[:, None, None], axis=1)[:, 0]
+
+        compiled = self._jit_cached(
+            ("beam", b, prompt_len, max_new_tokens, K, eos_token_id,
+             length_penalty, temperature), lambda: run)
+        return self._run_eval(compiled, list(values), ids)

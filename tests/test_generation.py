@@ -190,3 +190,82 @@ class TestGPTGenerate:
         assert len(m._generate_jit_cache) == 1
         m.generate(p1, max_new_tokens=3)  # new signature
         assert len(m._generate_jit_cache) == 2
+
+
+class TestBeamSearch:
+    def _model(self):
+        paddle.seed(13)
+        cfg = LlamaConfig(vocab_size=32, hidden_size=32,
+                          intermediate_size=64, num_hidden_layers=2,
+                          num_attention_heads=4,
+                          max_position_embeddings=64, use_parallel=False)
+        return LlamaForCausalLM(cfg), cfg
+
+    def test_single_step_beam_equals_greedy(self):
+        """With max_new_tokens=1 the best beam IS the argmax token for
+        any K — an exact invariant, not a seed accident."""
+        m, cfg = self._model()
+        prompt = paddle.to_tensor(
+            np.random.RandomState(4).randint(0, 32, (2, 4)).astype(np.int32))
+        greedy = np.asarray(m.generate(prompt, max_new_tokens=1)._value)
+        for k in (2, 4):
+            beam = np.asarray(m.generate(prompt, max_new_tokens=1,
+                                         num_beams=k)._value)
+            np.testing.assert_array_equal(beam, greedy)
+
+    def test_beam_matches_exhaustive_oracle(self):
+        """K >= vocab makes beam search EXACT over a 2-token horizon
+        (every step-1 prefix survives): the returned pair must be the
+        brute-force argmax over all vocab^2 continuations."""
+        paddle.seed(17)
+        cfg = LlamaConfig(vocab_size=8, hidden_size=16,
+                          intermediate_size=32, num_hidden_layers=1,
+                          num_attention_heads=2,
+                          max_position_embeddings=32, use_parallel=False)
+        m = LlamaForCausalLM(cfg)
+        prompt = np.asarray([[3, 5]], np.int32)
+
+        def logp_of(seq):
+            logits = np.asarray(m(paddle.to_tensor(seq))._value)[0, -1]
+            e = logits - logits.max()
+            return e - np.log(np.exp(e).sum())
+
+        best_score, best_pair = -np.inf, None
+        lp1 = logp_of(prompt)
+        for t1 in range(8):
+            s1 = np.concatenate([prompt, [[t1]]], axis=1).astype(np.int32)
+            lp2 = logp_of(s1)
+            for t2 in range(8):
+                sc = lp1[t1] + lp2[t2]
+                if sc > best_score:
+                    best_score, best_pair = sc, (t1, t2)
+
+        out = np.asarray(m.generate(paddle.to_tensor(prompt),
+                                    max_new_tokens=2, num_beams=8)._value)
+        assert tuple(out[0]) == best_pair
+
+    def test_beam_deterministic(self):
+        m, cfg = self._model()
+        prompt = paddle.to_tensor(np.asarray([[7, 3]], np.int32))
+        a = np.asarray(m.generate(prompt, max_new_tokens=4,
+                                  num_beams=4)._value)
+        b = np.asarray(m.generate(prompt, max_new_tokens=4,
+                                  num_beams=4)._value)
+        np.testing.assert_array_equal(a, b)
+        assert (a >= 0).all() and (a < cfg.vocab_size).all()
+
+    def test_beam_eos_freezes(self):
+        m, cfg = self._model()
+        prompt = paddle.to_tensor(np.asarray([[1, 2, 3]], np.int32))
+        first = int(np.asarray(m.generate(prompt, max_new_tokens=1,
+                                          num_beams=3)._value)[0, 0])
+        out = np.asarray(m.generate(prompt, max_new_tokens=5, num_beams=3,
+                                    eos_token_id=first)._value)[0]
+        assert out[0] == first
+        np.testing.assert_array_equal(out, np.full(5, first))
+
+    def test_sample_conflict_raises(self):
+        m, cfg = self._model()
+        with pytest.raises(ValueError, match="beam"):
+            m.generate(paddle.to_tensor(np.zeros((1, 2), np.int32)),
+                       num_beams=2, do_sample=True)
