@@ -299,6 +299,8 @@ from .core.enforce import (  # noqa: F401
     UnimplementedError,
     enforce,
 )
+from . import hub  # noqa: F401
+from .batch import batch  # noqa: F401
 from .core.scalar import IntArray, Scalar  # noqa: F401
 from .core.selected_rows import SelectedRows  # noqa: F401
 from .core.string_tensor import (  # noqa: F401

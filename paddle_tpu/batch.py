@@ -1,0 +1,28 @@
+"""paddle.batch — batched-reader combinator.
+
+Parity: reference python/paddle/batch.py (legacy reader-decorator API:
+wrap a sample generator into a mini-batch generator). Kept for code
+ported from reader-style pipelines; new code uses paddle.io.DataLoader.
+"""
+from __future__ import annotations
+
+__all__ = ["batch"]
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Turn a sample reader into a batched reader (reference batch.py)."""
+    if batch_size <= 0:
+        raise ValueError(
+            "batch_size should be a positive integer, got %r" % batch_size)
+
+    def batch_reader():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batch_reader

@@ -8,3 +8,4 @@ from .callbacks import (  # noqa: F401
     ProgBarLogger,
 )
 from .model import Model  # noqa: F401
+from . import hub  # noqa: F401

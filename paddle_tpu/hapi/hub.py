@@ -1,0 +1,84 @@
+"""Model hub: load entrypoints from a hubconf.py repo.
+
+Parity: reference python/paddle/hapi/hub.py (list/help/load over a
+`hubconf.py` exposing callables; `dependencies` checked before load).
+The TPU build supports the `local` source (a directory); `github`/
+`gitee` sources require network egress this environment lacks and raise
+a clear error instead of half-downloading.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+__all__ = ["list", "help", "load"]
+
+_HUBCONF = "hubconf.py"
+
+
+def _import_hubconf(repo_dir):
+    path = os.path.join(repo_dir, _HUBCONF)
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            "no %s found in %s" % (_HUBCONF, repo_dir))
+    spec = importlib.util.spec_from_file_location("paddle_tpu_hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.path.insert(0, repo_dir)
+    try:
+        spec.loader.exec_module(mod)
+    finally:
+        sys.path.remove(repo_dir)
+    deps = getattr(mod, "dependencies", [])
+    missing = [d for d in deps if importlib.util.find_spec(d) is None]
+    if missing:
+        raise RuntimeError(
+            "hubconf dependencies not installed: %s" % ", ".join(missing))
+    return mod
+
+
+def _resolve(repo_dir, source):
+    if source not in ("local", "github", "gitee"):
+        raise ValueError(
+            "unknown source %r (expected 'local', 'github' or 'gitee')"
+            % (source,))
+    if source != "local":
+        raise RuntimeError(
+            "source=%r needs network egress; clone the repo and use "
+            "source='local' with its directory" % (source,))
+    return repo_dir
+
+
+def _entries(mod):
+    return sorted(
+        name for name, f in vars(mod).items()
+        if callable(f) and not name.startswith("_"))
+
+
+def list(repo_dir, source="local", force_reload=False):  # noqa: A001
+    """Entrypoint names exposed by the repo's hubconf
+    (reference hub.py:175)."""
+    mod = _import_hubconf(_resolve(repo_dir, source))
+    return _entries(mod)
+
+
+def help(repo_dir, model, source="local", force_reload=False):  # noqa: A001
+    """Entrypoint docstring (reference hub.py:223)."""
+    mod = _import_hubconf(_resolve(repo_dir, source))
+    entry = getattr(mod, model, None)
+    if entry is None or not callable(entry):
+        raise RuntimeError(
+            "no callable %r in hubconf (have: %s)"
+            % (model, ", ".join(_entries(mod))))
+    return entry.__doc__
+
+
+def load(repo_dir, model, source="local", force_reload=False, **kwargs):
+    """Build the model by calling its entrypoint (reference hub.py:268)."""
+    mod = _import_hubconf(_resolve(repo_dir, source))
+    entry = getattr(mod, model, None)
+    if entry is None or not callable(entry):
+        raise RuntimeError(
+            "no callable %r in hubconf (have: %s)"
+            % (model, ", ".join(_entries(mod))))
+    return entry(**kwargs)

@@ -1,0 +1,3 @@
+"""paddle.hub namespace (reference python/paddle/hub.py: re-exports the
+hapi.hub entrypoint API)."""
+from .hapi.hub import help, list, load  # noqa: F401,A004

@@ -109,6 +109,23 @@ class GenerationMixin:
             jit_cache[cache_key] = compiled
         return compiled
 
+    def _make_step_logits(self, names, state_vals, as_f32=False):
+        """One decode step shared by every strategy: bind functional
+        state, run generate_step, return last-token logits + caches."""
+        from ..core.dispatch import no_grad
+        from ..core.tensor import Tensor
+
+        def step_logits(token_ids, caches, offset):
+            with self.bind_state(names, list(state_vals)):
+                with no_grad():
+                    logits, caches = self.generate_step(
+                        Tensor(token_ids), caches, offset)
+            lv = logits._value if isinstance(logits, Tensor) else logits
+            lv = lv[:, -1, :]
+            return (lv.astype(jnp.float32) if as_f32 else lv), caches
+
+        return step_logits
+
     def _run_eval(self, compiled, *args):
         """Invoke a compiled generation program in inference semantics:
         dropout off inside the traced loop (Layer.training defaults True;
@@ -180,14 +197,7 @@ class GenerationMixin:
 
         def run(state_vals, ids, key):
             caches = self.init_decode_caches(b, total)
-
-            def step_logits(token_ids, caches, offset):
-                with self.bind_state(names, list(state_vals)):
-                    with no_grad():
-                        logits, caches = self.generate_step(
-                            Tensor(token_ids), caches, offset)
-                lv = logits._value if isinstance(logits, Tensor) else logits
-                return lv[:, -1, :], caches
+            step_logits = self._make_step_logits(names, state_vals)
 
             # prefill the whole prompt in one pass
             last, caches = step_logits(ids, caches, 0)
@@ -241,13 +251,8 @@ class GenerationMixin:
         NEG = jnp.float32(-1e9)
 
         def run(state_vals, ids):
-            def step_logits(token_ids, caches, offset):
-                with self.bind_state(names, list(state_vals)):
-                    with no_grad():
-                        logits, caches = self.generate_step(
-                            Tensor(token_ids), caches, offset)
-                lv = logits._value if isinstance(logits, Tensor) else logits
-                return lv[:, -1, :].astype(jnp.float32), caches
+            step_logits = self._make_step_logits(names, state_vals,
+                                                 as_f32=True)
 
             # prefill ONCE at batch b (beams are byte-identical over the
             # prompt), then fan the caches/logits out to b*K beam rows

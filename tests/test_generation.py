@@ -269,3 +269,22 @@ class TestBeamSearch:
         with pytest.raises(ValueError, match="beam"):
             m.generate(paddle.to_tensor(np.zeros((1, 2), np.int32)),
                        num_beams=2, do_sample=True)
+
+    def test_length_penalty_branch(self):
+        """GNMT normalization path: alpha > 0 favors longer finished
+        beams; the branch must at minimum trace, run, and stay within
+        vocab (regression: path had zero coverage)."""
+        m, cfg = self._model()
+        prompt = paddle.to_tensor(np.asarray([[5, 9]], np.int32))
+        for alpha in (0.6, -0.5):
+            out = np.asarray(m.generate(prompt, max_new_tokens=4,
+                                        num_beams=3, length_penalty=alpha,
+                                        eos_token_id=0)._value)
+            assert out.shape == (1, 4)
+            assert (out >= 0).all() and (out < cfg.vocab_size).all()
+        # alpha=0 must equal the unnormalized selection exactly
+        a = np.asarray(m.generate(prompt, max_new_tokens=4,
+                                  num_beams=3)._value)
+        b = np.asarray(m.generate(prompt, max_new_tokens=4, num_beams=3,
+                                  length_penalty=0.0)._value)
+        np.testing.assert_array_equal(a, b)

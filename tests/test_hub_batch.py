@@ -1,0 +1,55 @@
+"""paddle.hub (local hubconf repos) + paddle.batch reader combinator
+(reference hapi/hub.py + batch.py and their unittests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestHub:
+    def _repo(self, tmp_path):
+        (tmp_path / "hubconf.py").write_text(
+            "dependencies = ['numpy']\n"
+            "def tiny_mlp(hidden=4):\n"
+            "    '''A tiny MLP entrypoint.'''\n"
+            "    import paddle_tpu.nn as nn\n"
+            "    return nn.Linear(2, hidden)\n"
+            "def _private():\n"
+            "    pass\n")
+        return str(tmp_path)
+
+    def test_list_help_load(self, tmp_path):
+        repo = self._repo(tmp_path)
+        assert paddle.hub.list(repo, source="local") == ["tiny_mlp"]
+        assert "tiny MLP" in paddle.hub.help(repo, "tiny_mlp",
+                                             source="local")
+        m = paddle.hub.load(repo, "tiny_mlp", source="local", hidden=6)
+        assert m.weight.shape == [2, 6]
+
+    def test_missing_entry_and_remote_source(self, tmp_path):
+        repo = self._repo(tmp_path)
+        with pytest.raises(RuntimeError, match="no callable"):
+            paddle.hub.load(repo, "nope", source="local")
+        with pytest.raises(RuntimeError, match="egress"):
+            paddle.hub.list("user/repo", source="github")
+
+    def test_missing_dependency(self, tmp_path):
+        (tmp_path / "hubconf.py").write_text(
+            "dependencies = ['definitely_not_installed_xyz']\n"
+            "def f():\n    pass\n")
+        with pytest.raises(RuntimeError, match="dependencies"):
+            paddle.hub.list(str(tmp_path), source="local")
+
+
+class TestBatch:
+    def test_batching_and_drop_last(self):
+        def reader():
+            for i in range(7):
+                yield i
+
+        got = [b for b in paddle.batch(reader, 3)()]
+        assert got == [[0, 1, 2], [3, 4, 5], [6]]
+        got = [b for b in paddle.batch(reader, 3, drop_last=True)()]
+        assert got == [[0, 1, 2], [3, 4, 5]]
+        with pytest.raises(ValueError):
+            paddle.batch(reader, 0)
