@@ -350,6 +350,7 @@ from . import text  # noqa: F401
 
 from .framework.io import load, save  # noqa: F401
 from .hapi import Model  # noqa: F401
+from .hapi.dynamic_flops import flops  # noqa: F401
 from .nn.layer import set_grad_enabled  # noqa: F401
 
 

@@ -9,3 +9,4 @@ from .callbacks import (  # noqa: F401
 )
 from .model import Model  # noqa: F401
 from . import hub  # noqa: F401
+from .dynamic_flops import flops  # noqa: F401

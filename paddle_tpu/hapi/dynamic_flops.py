@@ -1,0 +1,163 @@
+"""paddle.flops — dynamic FLOPs counter for Layer networks.
+
+Parity: reference python/paddle/hapi/dynamic_flops.py (forward-hook
+walk over leaf layers; per-type count rules from utils/flops.py) —
+`paddle.flops(net, [1, 3, 224, 224], print_detail=True)`.
+
+Convention matches the reference: one multiply-add counts as ONE flop
+(so a Linear is in*out, not 2*in*out), bias adds out_features, and
+parameter-free activations count their element count.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+
+__all__ = ["flops"]
+
+
+def _numel(t):
+    v = t._value if isinstance(t, Tensor) else t
+    return int(np.prod(v.shape))
+
+
+def _shape(t):
+    v = t._value if isinstance(t, Tensor) else t
+    return tuple(v.shape)
+
+
+def _count_conv(layer, inputs, output):
+    # kernel_ops from the INPUT channel count (reference count_convNd):
+    # correct for both conv ([out, in/g, *k]) and transpose-conv
+    # ([in, out/g, *k]) weight layouts
+    out_numel = _numel(output)
+    in_ch = _shape(inputs[0])[1]
+    k_spatial = _numel(layer.weight) // (
+        layer.weight.shape[0] * layer.weight.shape[1])
+    groups = getattr(layer, "_groups", None) or getattr(layer, "groups", 1)
+    kernel_ops = (in_ch // groups) * k_spatial
+    total = out_numel * kernel_ops
+    if getattr(layer, "bias", None) is not None:
+        total += out_numel
+    return total
+
+
+def _count_linear(layer, inputs, output):
+    out_numel = _numel(output)
+    total = out_numel * layer.weight.shape[0]  # in_features per output
+    if getattr(layer, "bias", None) is not None:
+        total += out_numel
+    return total
+
+
+def _count_norm(layer, inputs, output):
+    # normalize (sub, div) + affine (mul, add) per element ≈ 2x numel
+    return 2 * _numel(inputs[0])
+
+
+def _count_act(layer, inputs, output):
+    return _numel(inputs[0])
+
+
+def _count_pool(layer, inputs, output):
+    return _numel(output)
+
+
+def _count_embedding(layer, inputs, output):
+    return 0  # a gather; the reference counts embeddings as 0 flops
+
+
+def _default_rules():
+    from ..nn.layers import common, conv, norm, pooling
+
+    rules = {}
+    for cls_name, fn in [
+        ("Conv1D", _count_conv), ("Conv2D", _count_conv),
+        ("Conv3D", _count_conv), ("Conv2DTranspose", _count_conv),
+        ("Conv1DTranspose", _count_conv), ("Conv3DTranspose", _count_conv),
+    ]:
+        cls = getattr(conv, cls_name, None)
+        if cls is not None:
+            rules[cls] = fn
+    rules[common.Linear] = _count_linear
+    rules[common.Embedding] = _count_embedding
+    for mod, names, fn in [
+        (norm, ("BatchNorm1D", "BatchNorm2D", "BatchNorm3D", "BatchNorm",
+                "LayerNorm", "GroupNorm", "InstanceNorm1D",
+                "InstanceNorm2D", "InstanceNorm3D", "RMSNorm"),
+         _count_norm),
+        (pooling, ("MaxPool1D", "MaxPool2D", "MaxPool3D", "AvgPool1D",
+                   "AvgPool2D", "AvgPool3D", "AdaptiveAvgPool1D",
+                   "AdaptiveAvgPool2D", "AdaptiveAvgPool3D",
+                   "AdaptiveMaxPool2D"),
+         _count_pool),
+    ]:
+        for cname in names:
+            cls = getattr(mod, cname, None)
+            if cls is not None:
+                rules[cls] = fn
+    from ..nn.layers import activation
+
+    for cname in ("ReLU", "ReLU6", "GELU", "Sigmoid", "Tanh", "Softmax",
+                  "SiLU", "LeakyReLU", "Hardswish", "Hardsigmoid", "PReLU",
+                  "ELU", "Swish", "Mish"):
+        cls = getattr(activation, cname, None)
+        if cls is not None:
+            rules[cls] = _count_act
+    return rules
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Total FLOPs of `net` on a zeros input of `input_size` (reference
+    hapi/dynamic_flops.py:28). custom_ops: {LayerClass: fn(layer,
+    inputs, output) -> int} overrides/extends the built-in rules."""
+    if not isinstance(net, Layer):
+        raise TypeError(
+            "paddle.flops counts nn.Layer networks; for a static Program "
+            "export it via a Layer first (got %r)" % type(net).__name__)
+    rules = _default_rules()
+    rules.update(custom_ops or {})
+    rows = []
+    total = [0]
+    handles = []
+
+    def make_hook(layer, rule):
+        def hook(lyr, inputs, output):
+            n = int(rule(lyr, inputs, output))
+            params = sum(_numel(p) for p in lyr.parameters(
+                include_sublayers=False))
+            rows.append((type(lyr).__name__, _shape(inputs[0]),
+                         _shape(output) if isinstance(output, Tensor)
+                         else None, params, n))
+            total[0] += n
+        return hook
+
+    for _, sub in net.named_sublayers(include_self=True):
+        rule = rules.get(type(sub))
+        if rule is not None:
+            handles.append(sub.register_forward_post_hook(
+                make_hook(sub, rule)))
+    import paddle_tpu as paddle
+
+    was_training = net.training
+    net.eval()
+    try:
+        x = paddle.zeros(list(input_size), dtype="float32")
+        net(x)
+    finally:
+        if was_training:
+            net.train()
+        for h in handles:
+            if hasattr(h, "remove"):
+                h.remove()
+    if print_detail:
+        print("%-20s %-22s %-22s %12s %14s"
+              % ("Layer", "Input Shape", "Output Shape", "Params",
+                 "FLOPs"))
+        for name, ishape, oshape, params, n in rows:
+            print("%-20s %-22s %-22s %12d %14d"
+                  % (name, ishape, oshape, params, n))
+        print("Total FLOPs: %d" % total[0])
+    return total[0]

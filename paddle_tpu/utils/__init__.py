@@ -1,6 +1,37 @@
 """paddle.utils (reference python/paddle/utils/)."""
 from __future__ import annotations
 
+import functools
+import warnings
+
+from . import dlpack, unique_name  # noqa: F401
+from .install_check import run_check  # noqa: F401
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    """Decorator marking an API deprecated (reference utils/deprecated.py):
+    warns once per call site with the replacement hint."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            msg = "API %r is deprecated" % fn.__name__
+            if since:
+                msg += " since %s" % since
+            if update_to:
+                msg += ", use %r instead" % update_to
+            if reason:
+                msg += " (%s)" % reason
+            # default filters hide DeprecationWarning outside __main__;
+            # the reference deprecated.py force-enables it the same way
+            warnings.simplefilter("always", DeprecationWarning)
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco
+
 
 def try_import(name):
     import importlib
@@ -10,15 +41,6 @@ def try_import(name):
     except ImportError as e:
         raise ImportError(
             "Optional dependency %r is not installed" % name) from e
-
-
-def unique_name(prefix="tmp"):
-    global _UNIQUE_COUNTER
-    _UNIQUE_COUNTER += 1
-    return "%s_%d" % (prefix, _UNIQUE_COUNTER)
-
-
-_UNIQUE_COUNTER = 0
 
 
 def flatten(nest):
@@ -40,24 +62,5 @@ def pack_sequence_as(structure, flat):
         structure, is_leaf=lambda x: isinstance(x, Tensor))
     return jax.tree_util.tree_unflatten(treedef, flat)
 
-
-def run_check():
-    """paddle.utils.run_check analog: verifies device visibility + a matmul."""
-    import jax
-    import jax.numpy as jnp
-
-    devs = jax.devices()
-    x = jnp.ones((128, 128))
-    y = (x @ x).block_until_ready()
-    print("paddle_tpu is installed successfully! devices:", devs)
-    return True
-
-
-class deprecated:
-    def __init__(self, since=None, update_to=None, reason=None):
-        self.update_to = update_to
-
-    def __call__(self, fn):
-        return fn
 
 from . import cpp_extension  # noqa: F401

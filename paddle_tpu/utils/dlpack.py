@@ -1,0 +1,31 @@
+"""paddle.utils.dlpack — zero-copy tensor exchange.
+
+Parity: reference python/paddle/utils/dlpack.py (to_dlpack/from_dlpack
+over the DLPack capsule protocol). jax arrays implement the standard
+`__dlpack__` protocol, so interchange with torch/numpy/cupy works
+without a copy where device semantics allow.
+"""
+from __future__ import annotations
+
+__all__ = ["to_dlpack", "from_dlpack"]
+
+
+def to_dlpack(x):
+    """Tensor -> DLPack-protocol object (reference dlpack.py:27 returns a
+    legacy capsule; modern consumers — torch.from_dlpack, np.from_dlpack,
+    jax — take protocol objects carrying __dlpack__/__dlpack_device__,
+    which the underlying array already is, and a bare capsule cannot
+    provide __dlpack_device__)."""
+    from ..core.tensor import Tensor
+
+    return x._value if isinstance(x, Tensor) else x
+
+
+def from_dlpack(dlpack):
+    """DLPack capsule (or any __dlpack__-protocol object, e.g. a torch
+    tensor) -> Tensor (reference dlpack.py:64)."""
+    import jax.dlpack
+
+    from ..core.tensor import Tensor
+
+    return Tensor(jax.dlpack.from_dlpack(dlpack))

@@ -1,0 +1,41 @@
+"""paddle.utils.run_check — install sanity check.
+
+Parity: reference python/paddle/utils/install_check.py run_check():
+verify the framework computes on this machine's devices — a tiny layer
+fwd+bwd on one device, then a sharded run over every local device (the
+reference tries fleet data-parallel the same way).
+"""
+from __future__ import annotations
+
+__all__ = ["run_check"]
+
+
+def run_check():
+    import jax
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+
+    print("Running verify PaddlePaddle(TPU) program ...")
+    paddle.seed(0)
+    m = nn.Linear(4, 2)
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    loss = F.square_error_cost(
+        m(x), paddle.to_tensor(np.zeros((2, 2), np.float32))).mean()
+    loss.backward()
+    assert m.weight.grad is not None
+    n = len(jax.devices())
+    if n > 1:
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        mesh = Mesh(np.array(jax.devices()), ("dp",))
+        xb = jax.device_put(
+            np.ones((n * 2, 4), np.float32), NamedSharding(mesh, P("dp")))
+        out = jax.jit(lambda a: (a @ np.ones((4, 2), np.float32)).sum())(xb)
+        assert np.isfinite(float(out))
+        print("PaddlePaddle(TPU) works well on %d devices." % n)
+    else:
+        print("PaddlePaddle(TPU) works well on 1 device.")
+    print("PaddlePaddle(TPU) is installed successfully!")

@@ -1,0 +1,51 @@
+"""paddle.utils.unique_name — namespaced unique-name generation.
+
+Parity: reference python/paddle/utils/unique_name.py (generate/guard/
+switch over a per-key counter map; guard() scopes a fresh generator so
+two programs built under separate guards get identical names).
+"""
+from __future__ import annotations
+
+import contextlib
+
+__all__ = ["generate", "guard", "switch"]
+
+
+class UniqueNameGenerator:
+    def __init__(self, prefix=""):
+        self.ids = {}
+        self.prefix = prefix
+
+    def __call__(self, key):
+        n = self.ids.get(key, 0)
+        self.ids[key] = n + 1
+        return "%s%s_%d" % (self.prefix, key, n)
+
+
+_generator = UniqueNameGenerator()
+
+
+def generate(key):
+    """Unique name for `key`: key_0, key_1, ... (reference generate)."""
+    return _generator(key)
+
+
+def switch(new_generator=None):
+    """Swap the active generator, returning the old one."""
+    global _generator
+    old = _generator
+    _generator = new_generator or UniqueNameGenerator()
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    """Scope a fresh generator (reference guard): names inside restart
+    from _0; a string argument becomes the prefix."""
+    if isinstance(new_generator, str):
+        new_generator = UniqueNameGenerator(new_generator)
+    old = switch(new_generator)
+    try:
+        yield
+    finally:
+        switch(old)

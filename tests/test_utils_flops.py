@@ -1,0 +1,102 @@
+"""paddle.utils additions (unique_name/dlpack/deprecated/run_check) +
+paddle.flops (reference utils/ + hapi/dynamic_flops.py unittests)."""
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.utils import unique_name
+
+
+class TestUniqueName:
+    def test_generate_and_guard(self):
+        with unique_name.guard():
+            assert unique_name.generate("fc") == "fc_0"
+            assert unique_name.generate("fc") == "fc_1"
+            assert unique_name.generate("conv") == "conv_0"
+            with unique_name.guard():
+                assert unique_name.generate("fc") == "fc_0"  # fresh scope
+            assert unique_name.generate("fc") == "fc_2"  # restored
+        with unique_name.guard("pre_"):
+            assert unique_name.generate("fc") == "pre_fc_0"
+
+
+class TestDlpack:
+    def test_roundtrip(self):
+        x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+        cap = paddle.utils.dlpack.to_dlpack(x)
+        y = paddle.utils.dlpack.from_dlpack(cap)
+        np.testing.assert_array_equal(np.asarray(y._value),
+                                      np.asarray(x._value))
+
+    def test_from_torch(self):
+        torch = pytest.importorskip("torch")
+        t = torch.arange(4, dtype=torch.float32).reshape(2, 2)
+        y = paddle.utils.dlpack.from_dlpack(t)
+        np.testing.assert_array_equal(np.asarray(y._value),
+                                      t.numpy())
+
+
+class TestDeprecated:
+    def test_warns_with_hint(self):
+        @paddle.utils.deprecated(update_to="paddle.new_api", since="2.0")
+        def old_api(v):
+            return v + 1
+
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            assert old_api(1) == 2
+        assert any("paddle.new_api" in str(x.message) for x in w)
+
+
+class TestRunCheck:
+    def test_run_check(self, capsys):
+        paddle.utils.run_check()
+        out = capsys.readouterr().out
+        assert "installed successfully" in out
+
+
+class TestFlops:
+    def test_linear_conv_counts(self):
+        m = nn.Sequential(nn.Conv2D(3, 8, 3, padding=1), nn.ReLU(),
+                          nn.MaxPool2D(2), nn.Flatten(),
+                          nn.Linear(8 * 4 * 4, 10))
+        n = paddle.flops(m, [1, 3, 8, 8])
+        # conv: 8*8*8 outs * (3*3*3) kernel + bias 8*8*8
+        conv = 8 * 8 * 8 * 27 + 8 * 8 * 8
+        relu = 8 * 8 * 8
+        pool = 8 * 4 * 4
+        lin = 10 * (8 * 4 * 4) + 10
+        assert n == conv + relu + pool + lin
+
+    def test_custom_ops_and_detail(self, capsys):
+        m = nn.Sequential(nn.Linear(4, 4))
+        n = paddle.flops(m, [1, 4],
+                         custom_ops={nn.Linear: lambda l, i, o: 1234},
+                         print_detail=True)
+        assert n == 1234
+        assert "Total FLOPs" in capsys.readouterr().out
+
+    def test_rejects_non_layer(self):
+        with pytest.raises(TypeError):
+            paddle.flops(object(), [1, 4])
+
+    def test_transpose_conv_counts_input_channels(self):
+        """Transpose convs store weight as [in, out/g, *k] — kernel ops
+        must come from INPUT channels (regression: 5x undercount)."""
+        m = nn.Sequential(nn.Conv2DTranspose(16, 3, 3, bias_attr=False))
+        n = paddle.flops(m, [1, 16, 4, 4])
+        out_hw = 6 * 6  # 4 + k - 1 with stride 1, no padding
+        assert n == (3 * out_hw) * (16 * 3 * 3)
+
+    def test_bare_leaf_layer_counted(self):
+        n = paddle.flops(nn.Linear(4, 2), [1, 4])
+        assert n == 2 * 4 + 2  # include_self: the net itself is the leaf
+
+    def test_run_check_exercises_backward(self, capsys):
+        # the real install check runs fwd+bwd + multi-device matmul
+        paddle.utils.run_check()
+        out = capsys.readouterr().out
+        assert "works well on" in out
