@@ -299,6 +299,8 @@ from .core.enforce import (  # noqa: F401
     UnimplementedError,
     enforce,
 )
+from . import callbacks  # noqa: F401
+from . import device  # noqa: F401
 from . import hub  # noqa: F401
 from .batch import batch  # noqa: F401
 from .core.scalar import IntArray, Scalar  # noqa: F401

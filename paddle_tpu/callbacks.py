@@ -1,0 +1,9 @@
+"""paddle.callbacks namespace (reference python/paddle/callbacks.py:
+re-exports the hapi training callbacks)."""
+from .hapi.callbacks import (  # noqa: F401
+    Callback,
+    EarlyStopping,
+    LRScheduler,
+    ModelCheckpoint,
+    ProgBarLogger,
+)

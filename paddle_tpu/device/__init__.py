@@ -1,0 +1,64 @@
+"""paddle.device namespace.
+
+Parity: reference python/paddle/device/__init__.py (set_device/
+get_device/place queries + per-vendor is_compiled_with_*). TPU mapping:
+PJRT owns contexts and streams; the `cuda` submodule exposes the
+reference's stream/event API as documented no-ops so ported code runs
+(synchronization is XLA's async-dispatch + block_until_ready).
+"""
+from __future__ import annotations
+
+from ..core.place import (  # noqa: F401
+    CPUPlace,
+    CUDAPlace,
+    CustomPlace,
+    TPUPlace,
+    device_count,
+    get_all_custom_device_type,
+    get_device,
+    is_compiled_with_cuda,
+    is_compiled_with_custom_device,
+    is_compiled_with_tpu,
+    set_device,
+)
+from . import cuda  # noqa: F401
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_ipu():
+    return False
+
+
+def is_compiled_with_npu():
+    return False
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def is_compiled_with_cinn():
+    # XLA plays CINN's role (SURVEY layer 13); report False for the
+    # literal CINN bridge the reference means
+    return False
+
+
+def is_compiled_with_mkldnn():
+    return False
+
+
+def get_cudnn_version():
+    return None  # no cuDNN on this stack
+
+
+def get_available_device():
+    import jax
+
+    return ["%s:%d" % (d.platform, d.id) for d in jax.devices()]
+
+
+def get_available_custom_device():
+    return get_all_custom_device_type()

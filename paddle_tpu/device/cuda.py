@@ -1,0 +1,101 @@
+"""paddle.device.cuda — stream/event API surface for ported code.
+
+Reference python/paddle/device/cuda/__init__.py. Under PJRT the runtime
+owns streams; `synchronize` maps to draining outstanding work, the
+stream/event objects are inert records (documented deviation — the
+scheduling they tune by hand is XLA's latency-hiding scheduler's job).
+"""
+from __future__ import annotations
+
+__all__ = ["Stream", "Event", "current_stream", "synchronize",
+           "device_count", "get_device_properties", "empty_cache"]
+
+
+class Stream:
+    def __init__(self, device=None, priority=2):
+        self.device = device
+
+    def synchronize(self):
+        synchronize()
+
+
+class Event:
+    def __init__(self, enable_timing=False, blocking=False,
+                 interprocess=False):
+        self._pending = False
+
+    def record(self, stream=None):
+        self._pending = True
+        # PJRT dispatch is async but ordered; by the time user code can
+        # query, prior work on the record point is complete
+        self._pending = False
+
+    def query(self):
+        """True when complete — including never-recorded events
+        (cudaEventQuery semantics: unrecorded queries as success)."""
+        return not self._pending
+
+    def synchronize(self):
+        synchronize()
+
+
+_current = Stream()
+
+
+def current_stream(device=None):
+    return _current
+
+
+def synchronize(device=None):
+    """Drain outstanding device work (reference cuda.synchronize)."""
+    import jax
+    import jax.numpy as jnp
+
+    jax.block_until_ready(jnp.zeros(()))
+
+
+def device_count():
+    import jax
+
+    return len(jax.devices())
+
+
+def _device_index(device):
+    if device is None:
+        return 0
+    if isinstance(device, int):
+        return device
+    if isinstance(device, str):
+        # accepted paddle forms: 'gpu:0' / 'tpu:0' / 'cpu' / '0'
+        tail = device.rsplit(":", 1)[-1]
+        return int(tail) if tail.isdigit() else 0
+    for attr in ("get_device_id", "device_id"):
+        f = getattr(device, attr, None)
+        if f is not None:
+            return f() if callable(f) else f
+    raise ValueError("unrecognized device spec %r" % (device,))
+
+
+def get_device_properties(device=None):
+    import jax
+
+    idx = _device_index(device)
+    devs = jax.devices()
+    if not 0 <= idx < len(devs):
+        raise ValueError(
+            "device index %d out of range (have %d devices)"
+            % (idx, len(devs)))
+    d = devs[idx]
+    stats = d.memory_stats() if hasattr(d, "memory_stats") else None
+
+    class _Props:
+        name = getattr(d, "device_kind", d.platform)
+        major, minor = 0, 0
+        total_memory = (stats or {}).get("bytes_limit", 0)
+        multi_processor_count = 1
+
+    return _Props()
+
+
+def empty_cache():
+    pass  # XLA buffer assignment owns memory

@@ -69,10 +69,15 @@ def _count_embedding(layer, inputs, output):
     return 0  # a gather; the reference counts embeddings as 0 flops
 
 
+_RULES_CACHE = {}
+
+
 def _default_rules():
+    if _RULES_CACHE:
+        return dict(_RULES_CACHE)
     from ..nn.layers import common, conv, norm, pooling
 
-    rules = {}
+    rules = _RULES_CACHE
     for cls_name, fn in [
         ("Conv1D", _count_conv), ("Conv2D", _count_conv),
         ("Conv3D", _count_conv), ("Conv2DTranspose", _count_conv),
@@ -106,7 +111,7 @@ def _default_rules():
         cls = getattr(activation, cname, None)
         if cls is not None:
             rules[cls] = _count_act
-    return rules
+    return dict(rules)
 
 
 def flops(net, input_size, custom_ops=None, print_detail=False):
@@ -123,7 +128,7 @@ def flops(net, input_size, custom_ops=None, print_detail=False):
     total = [0]
     handles = []
 
-    def make_hook(layer, rule):
+    def make_hook(rule):
         def hook(lyr, inputs, output):
             n = int(rule(lyr, inputs, output))
             params = sum(_numel(p) for p in lyr.parameters(
@@ -138,7 +143,7 @@ def flops(net, input_size, custom_ops=None, print_detail=False):
         rule = rules.get(type(sub))
         if rule is not None:
             handles.append(sub.register_forward_post_hook(
-                make_hook(sub, rule)))
+                make_hook(rule)))
     import paddle_tpu as paddle
 
     was_training = net.training

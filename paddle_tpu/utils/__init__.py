@@ -8,6 +8,9 @@ from . import dlpack, unique_name  # noqa: F401
 from .install_check import run_check  # noqa: F401
 
 
+_deprecated_seen = set()
+
+
 def deprecated(update_to="", since="", reason="", level=0):
     """Decorator marking an API deprecated (reference utils/deprecated.py):
     warns once per call site with the replacement hint."""
@@ -15,17 +18,25 @@ def deprecated(update_to="", since="", reason="", level=0):
     def deco(fn):
         @functools.wraps(fn)
         def wrapper(*args, **kwargs):
-            msg = "API %r is deprecated" % fn.__name__
-            if since:
-                msg += " since %s" % since
-            if update_to:
-                msg += ", use %r instead" % update_to
-            if reason:
-                msg += " (%s)" % reason
-            # default filters hide DeprecationWarning outside __main__;
-            # the reference deprecated.py force-enables it the same way
-            warnings.simplefilter("always", DeprecationWarning)
-            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            import sys
+
+            frame = sys._getframe(1)
+            key = (fn, frame.f_code.co_filename, frame.f_lineno)
+            if key not in _deprecated_seen:
+                _deprecated_seen.add(key)
+                msg = "API %r is deprecated" % fn.__name__
+                if since:
+                    msg += " since %s" % since
+                if update_to:
+                    msg += ", use %r instead" % update_to
+                if reason:
+                    msg += " (%s)" % reason
+                # visible even outside __main__, WITHOUT permanently
+                # mutating the process-global filter list (the reference
+                # simplefilter('always') leaks past user ignores)
+                with warnings.catch_warnings():
+                    warnings.simplefilter("always", DeprecationWarning)
+                    warnings.warn(msg, DeprecationWarning, stacklevel=2)
             return fn(*args, **kwargs)
 
         return wrapper

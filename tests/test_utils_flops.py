@@ -100,3 +100,34 @@ class TestFlops:
         paddle.utils.run_check()
         out = capsys.readouterr().out
         assert "works well on" in out
+
+
+class TestNamespaces:
+    """paddle.callbacks + paddle.device (reference python/paddle/
+    callbacks.py re-exports and device/ namespace)."""
+
+    def test_callbacks_namespace(self):
+        assert paddle.callbacks.EarlyStopping is \
+            paddle.hapi.callbacks.EarlyStopping
+        for name in ("Callback", "ProgBarLogger", "ModelCheckpoint",
+                     "LRScheduler"):
+            assert hasattr(paddle.callbacks, name)
+
+    def test_device_namespace(self):
+        dev = paddle.device.get_device()
+        assert isinstance(dev, str)
+        assert paddle.device.cuda.device_count() >= 1
+        e = paddle.device.cuda.Event()
+        assert e.query()  # unrecorded event queries complete (CUDA sem.)
+        e.record()
+        assert e.query()
+        paddle.device.cuda.synchronize()
+        props = paddle.device.cuda.get_device_properties()
+        assert props.name
+        # string/paddle-style device specs accepted; bad index is clear
+        assert paddle.device.cuda.get_device_properties("gpu:0").name
+        with pytest.raises(ValueError, match="out of range"):
+            paddle.device.cuda.get_device_properties(99)
+        assert not paddle.device.is_compiled_with_xpu()
+        assert paddle.device.get_cudnn_version() is None
+        assert len(paddle.device.get_available_device()) >= 1
