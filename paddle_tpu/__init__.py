@@ -300,8 +300,15 @@ from .core.enforce import (  # noqa: F401
     enforce,
 )
 from . import callbacks  # noqa: F401
+from . import cost_model  # noqa: F401
+from . import dataset  # noqa: F401
 from . import device  # noqa: F401
 from . import hub  # noqa: F401
+from . import onnx  # noqa: F401
+from . import reader  # noqa: F401
+from . import sysconfig  # noqa: F401
+from . import tensor  # noqa: F401
+from . import version  # noqa: F401
 from .batch import batch  # noqa: F401
 from .core.scalar import IntArray, Scalar  # noqa: F401
 from .core.selected_rows import SelectedRows  # noqa: F401

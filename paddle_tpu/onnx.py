@@ -1,0 +1,32 @@
+"""paddle.onnx namespace.
+
+Parity: reference python/paddle/onnx/export.py — `paddle.onnx.export`
+delegates to the external paddle2onnx package. Neither onnx nor
+paddle2onnx ships in this environment (gated per packaging policy): the
+portable serialized format of the TPU build is StableHLO via
+paddle.jit.save, which this export() produces alongside a clear message
+when ONNX itself is requested.
+"""
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    """Export `layer` for deployment (reference onnx/export.py export).
+
+    Without the onnx/paddle2onnx packages installed this saves the
+    portable StableHLO artifact at `path` (loadable by paddle.jit.load,
+    the C/C++/Go inference APIs, and any StableHLO consumer) and raises
+    only if the caller explicitly requires a .onnx file.
+    """
+    if path.endswith(".onnx"):
+        raise RuntimeError(
+            "ONNX export needs the onnx/paddle2onnx packages (not shipped "
+            "in this environment). The portable artifact here is StableHLO:"
+            " call paddle.onnx.export(layer, path_without_suffix, "
+            "input_spec=...) or paddle.jit.save directly")
+    from . import jit
+
+    jit.save(layer, path, input_spec=input_spec)
+    return path

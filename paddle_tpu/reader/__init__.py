@@ -1,0 +1,168 @@
+"""paddle.reader — legacy reader-decorator pipeline combinators.
+
+Parity: reference python/paddle/reader/decorator.py (cache, map_readers,
+shuffle, chain, compose, buffered, firstn, xmap_readers). Pure-python
+sample pipelines kept for ported code; new code uses paddle.io.
+"""
+from __future__ import annotations
+
+import itertools
+import random as _random
+import threading
+import queue as _queue
+
+__all__ = ["cache", "map_readers", "shuffle", "chain", "compose",
+           "buffered", "firstn", "xmap_readers"]
+
+
+def cache(reader):
+    """Materialize once, replay from memory (reference decorator.py:45)."""
+    all_data = []
+    filled = []
+
+    def cached():
+        if not filled:
+            all_data.extend(reader())
+            filled.append(True)
+        return iter(all_data)
+
+    return cached
+
+
+def map_readers(func, *readers):
+    """Zip readers, map func over the tuples (reference :85)."""
+
+    def reader():
+        rs = [r() for r in readers]
+        for vals in zip(*rs):
+            yield func(*vals)
+
+    return reader
+
+
+def shuffle(reader, buf_size):
+    """Buffered shuffle (reference :127)."""
+
+    def data_reader():
+        buf = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            _random.shuffle(buf)
+            yield from buf
+
+    return data_reader
+
+
+def chain(*readers):
+    """Concatenate readers (reference :176)."""
+
+    def reader():
+        return itertools.chain(*[r() for r in readers])
+
+    return reader
+
+
+def compose(*readers, **kwargs):
+    """Parallel composition: yield tuples drawing one sample from each
+    (reference compose; check_alignment=True raises on ragged ends)."""
+    check_alignment = kwargs.pop("check_alignment", True)
+
+    def make_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    _missing = object()  # private sentinel: readers may yield None
+
+    def reader():
+        rs = [r() for r in readers]
+        if check_alignment:
+            for items in itertools.zip_longest(*rs, fillvalue=_missing):
+                if any(i is _missing for i in items):
+                    raise RuntimeError(
+                        "readers have different lengths (set "
+                        "check_alignment=False to truncate)")
+                yield sum((make_tuple(i) for i in items), ())
+        else:
+            for items in zip(*rs):
+                yield sum((make_tuple(i) for i in items), ())
+
+    return reader
+
+
+def buffered(reader, size):
+    """Prefetch into a bounded queue on a worker thread (reference
+    buffered)."""
+
+    class _End:
+        pass
+
+    def data_reader():
+        q = _queue.Queue(maxsize=size)
+        stop = threading.Event()
+
+        def fill():
+            for d in reader():
+                while not stop.is_set():
+                    try:
+                        q.put(d, timeout=0.1)
+                        break
+                    except _queue.Full:
+                        continue
+                if stop.is_set():
+                    return
+            q.put(_End)
+
+        t = threading.Thread(target=fill, daemon=True)
+        t.start()
+        try:
+            while True:
+                e = q.get()
+                if e is _End:
+                    break
+                yield e
+        finally:
+            # consumer abandoned early (e.g. firstn): release the fill
+            # thread instead of leaving it blocked on a full queue
+            stop.set()
+
+    return data_reader
+
+
+def firstn(reader, n):
+    """First n samples (reference firstn)."""
+
+    def data_reader():
+        return itertools.islice(reader(), n)
+
+    return data_reader
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size,
+                 order=False):
+    """Thread-pool map over a reader (reference xmap_readers). `order`
+    preserves input order."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    def data_reader():
+        import collections
+
+        with ThreadPoolExecutor(max_workers=process_num) as pool:
+            if order:
+                yield from pool.map(mapper, reader())
+                return
+            # unordered: keep at most buffer_size samples in flight so
+            # huge/infinite readers neither hang nor buffer unboundedly
+            window = collections.deque()
+            it = reader()
+            for d in it:
+                window.append(pool.submit(mapper, d))
+                if len(window) >= max(buffer_size, 1):
+                    yield window.popleft().result()
+            while window:
+                yield window.popleft().result()
+
+    return data_reader

@@ -1,0 +1,20 @@
+"""paddle.sysconfig (reference python/paddle/sysconfig.py):
+include/lib dirs for building extensions against the framework."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+_ROOT = os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include():
+    """C headers directory (pt_capi.h / pt_jit.h live in csrc/)."""
+    cand = os.path.join(os.path.dirname(_ROOT), "csrc")
+    return cand if os.path.isdir(cand) else _ROOT
+
+
+def get_lib():
+    """Shared-library directory (libpaddle_tpu_capi.so etc.)."""
+    return os.path.join(_ROOT, "lib")

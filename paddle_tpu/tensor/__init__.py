@@ -1,0 +1,23 @@
+"""paddle.tensor namespace (reference python/paddle/tensor/): the op
+modules grouped by kind. The TPU build defines ops in paddle_tpu.ops.*;
+this namespace re-exports them under the reference's module names so
+`paddle.tensor.creation.to_tensor`-style imports port unchanged."""
+from ..ops import creation, linalg, manipulation, math, reduction  # noqa: F401
+from ..ops import comparison as logic  # noqa: F401
+from ..ops.creation import to_tensor  # noqa: F401
+from ..ops.linalg import einsum  # noqa: F401
+from ..ops.manipulation import (  # noqa: F401
+    argsort,
+    searchsorted,
+    sort,
+    topk,
+    where,
+)
+from ..ops.reduction import argmax, argmin, mean, median, std, var  # noqa: F401
+
+from . import attribute  # noqa: F401
+
+# reference module aliases
+search = manipulation
+stat = reduction
+random = creation

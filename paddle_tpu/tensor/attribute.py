@@ -1,0 +1,42 @@
+"""paddle.tensor.attribute (reference python/paddle/tensor/attribute.py):
+tensor property queries."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["rank", "shape", "is_complex", "is_floating_point",
+           "is_integer", "real", "imag"]
+
+
+def _v(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def rank(input):
+    return Tensor(jnp.asarray(_v(input).ndim))
+
+
+def shape(input):
+    return list(_v(input).shape)
+
+
+def is_complex(x):
+    return jnp.issubdtype(_v(x).dtype, jnp.complexfloating)
+
+
+def is_floating_point(x):
+    return jnp.issubdtype(_v(x).dtype, jnp.floating)
+
+
+def is_integer(x):
+    return jnp.issubdtype(_v(x).dtype, jnp.integer)
+
+
+def real(x):
+    return Tensor(jnp.real(_v(x)))
+
+
+def imag(x):
+    return Tensor(jnp.imag(_v(x)))

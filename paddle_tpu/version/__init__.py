@@ -1,0 +1,35 @@
+"""paddle.version (reference generated python/paddle/version.py)."""
+from __future__ import annotations
+
+full_version = "0.1.0"
+major = "0"
+minor = "1"
+patch = "0"
+rc = "0"
+istaged = False
+commit = "tpu-native"
+with_mkl = "OFF"
+cuda_version = "False"
+cudnn_version = "False"
+xpu_version = "False"
+
+
+def show():
+    """Print the version breakdown (reference version.py show())."""
+    print("full_version:", full_version)
+    print("major:", major)
+    print("minor:", minor)
+    print("patch:", patch)
+    print("commit:", commit)
+
+
+def cuda():
+    return False
+
+
+def cudnn():
+    return False
+
+
+def tpu():
+    return True

@@ -1,0 +1,162 @@
+"""Top-level namespace parity: tensor/version/sysconfig/reader/dataset/
+cost_model/onnx (reference python/paddle/ top-level modules)."""
+import itertools
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestTensorNamespace:
+    def test_module_groups(self):
+        assert paddle.tensor.creation.to_tensor is paddle.to_tensor
+        assert paddle.tensor.math.matmul is paddle.matmul
+        assert paddle.tensor.manipulation.concat is paddle.concat
+        assert paddle.tensor.linalg.einsum is paddle.linalg.einsum
+        assert hasattr(paddle.tensor.logic, "equal")
+        assert paddle.tensor.search is paddle.tensor.manipulation
+
+
+class TestVersionSysconfig:
+    def test_version(self, capsys):
+        assert paddle.version.full_version == paddle.__version__
+        paddle.version.show()
+        assert "full_version" in capsys.readouterr().out
+        assert paddle.version.tpu() and not paddle.version.cuda()
+
+    def test_sysconfig_paths(self):
+        inc = paddle.sysconfig.get_include()
+        assert os.path.isdir(inc)
+        assert os.path.exists(os.path.join(inc, "pt_capi.h"))
+        assert paddle.sysconfig.get_lib().endswith("lib")
+
+
+class TestReader:
+    def _r(self, n=10):
+        def reader():
+            yield from range(n)
+
+        return reader
+
+    def test_shuffle_preserves_multiset(self):
+        out = list(paddle.reader.shuffle(self._r(), 4)())
+        assert sorted(out) == list(range(10))
+
+    def test_chain_compose_firstn(self):
+        c = paddle.reader.chain(self._r(3), self._r(2))
+        assert list(c()) == [0, 1, 2, 0, 1]
+        comp = paddle.reader.compose(self._r(3), self._r(3))
+        assert list(comp()) == [(0, 0), (1, 1), (2, 2)]
+        with pytest.raises(RuntimeError):
+            list(paddle.reader.compose(self._r(3), self._r(2))())
+        assert list(paddle.reader.firstn(self._r(), 4)()) == [0, 1, 2, 3]
+
+    def test_map_buffered_cache_xmap(self):
+        m = paddle.reader.map_readers(lambda a, b: a + b,
+                                      self._r(4), self._r(4))
+        assert list(m()) == [0, 2, 4, 6]
+        assert sorted(paddle.reader.buffered(self._r(5), 2)()) == \
+            list(range(5))
+        cached = paddle.reader.cache(self._r(3))
+        assert list(cached()) == list(cached()) == [0, 1, 2]
+        x = paddle.reader.xmap_readers(lambda v: v * 2, self._r(4), 2, 4,
+                                       order=True)
+        assert list(x()) == [0, 2, 4, 6]
+
+
+class TestDataset:
+    def test_uci_housing_schema(self):
+        feats, y = next(paddle.dataset.uci_housing.train()())
+        assert feats.shape == (13,) and y.shape == (1,)
+        assert len(paddle.dataset.uci_housing.feature_names) == 13
+        train_n = sum(1 for _ in paddle.dataset.uci_housing.train()())
+        test_n = sum(1 for _ in paddle.dataset.uci_housing.test()())
+        assert (train_n, test_n) == (404, 102)
+
+
+class TestCostModel:
+    def test_profile_measure_runs(self):
+        from paddle_tpu import static
+
+        static.enable_static()
+        try:
+            main, startup = static.Program(), static.Program()
+            with static.program_guard(main, startup):
+                x = static.data("x", [2, 2], "float32")
+                static.nn.fc(x, 2)
+            cm = paddle.cost_model.CostModel()
+            # startup must run with a feed-free program; measure main
+            out = cm.profile_measure(startup_program=startup)
+            assert "time" in out and out["time"] >= 0
+        finally:
+            static.disable_static()
+
+
+class TestOnnx:
+    def test_export_saves_stablehlo(self, tmp_path):
+        import paddle_tpu.nn as nn
+
+        m = nn.Linear(4, 2)
+        prefix = str(tmp_path / "m")
+        paddle.onnx.export(
+            m, prefix,
+            input_spec=[paddle.static.InputSpec([None, 4], "float32")])
+        assert os.path.exists(prefix + ".pdmodel")
+        loaded = paddle.jit.load(prefix)
+        out = loaded(paddle.to_tensor(np.ones((2, 4), np.float32)))
+        assert out.shape == [2, 2]
+
+    def test_explicit_onnx_suffix_raises(self, tmp_path):
+        import paddle_tpu.nn as nn
+
+        with pytest.raises(RuntimeError, match="StableHLO"):
+            paddle.onnx.export(nn.Linear(2, 2),
+                               str(tmp_path / "m.onnx"))
+
+
+class TestReaderEdgeCases:
+    def test_compose_allows_none_samples(self):
+        def r_vals():
+            yield from [1, 2]
+
+        def r_opt():
+            yield from [None, 3]
+
+        out = list(paddle.reader.compose(r_vals, r_opt)())
+        assert out == [(1, None), (2, 3)]
+
+    def test_buffered_abandoned_consumer_releases_thread(self):
+        import threading
+
+        before = threading.active_count()
+
+        def big():
+            yield from range(10000)
+
+        for _ in range(5):
+            list(paddle.reader.firstn(
+                paddle.reader.buffered(big, 2), 1)())
+        import time
+
+        time.sleep(0.3)  # fill threads observe stop + exit
+        assert threading.active_count() <= before + 1
+
+    def test_xmap_unordered_bounded_window(self):
+        out = sorted(paddle.reader.xmap_readers(
+            lambda v: v * 2, lambda: iter(range(50)), 2, 4,
+            order=False)())
+        assert out == [v * 2 for v in range(50)]
+
+
+class TestTensorAttribute:
+    def test_attribute_module(self):
+        x = paddle.to_tensor(np.asarray([[1.0, 2.0]], np.float32))
+        assert int(paddle.tensor.attribute.rank(x)._value) == 2
+        assert paddle.tensor.attribute.shape(x) == [1, 2]
+        assert paddle.tensor.attribute.is_floating_point(x)
+        assert not paddle.tensor.attribute.is_complex(x)
+        c = paddle.to_tensor(np.asarray([1 + 2j], np.complex64))
+        np.testing.assert_allclose(
+            np.asarray(paddle.tensor.attribute.imag(c)._value), [2.0])
