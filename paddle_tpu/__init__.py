@@ -300,6 +300,7 @@ from .core.enforce import (  # noqa: F401
     enforce,
 )
 from . import callbacks  # noqa: F401
+from . import fluid  # noqa: F401
 from . import cost_model  # noqa: F401
 from . import dataset  # noqa: F401
 from . import device  # noqa: F401

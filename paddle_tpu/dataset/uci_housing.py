@@ -16,8 +16,19 @@ feature_names = [
 _N_TRAIN, _N_TEST = 404, 102
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=2)
+def _load_cached(path):
+    return _load_impl(path)
+
+
 def _load():
-    path = os.environ.get("PADDLE_DATASET_HOME")
+    return _load_cached(os.environ.get("PADDLE_DATASET_HOME"))
+
+
+def _load_impl(path):
     if path:
         f = os.path.join(path, "housing.data")
         if os.path.exists(f):

@@ -1,0 +1,53 @@
+"""paddle.fluid — legacy-API compatibility shim.
+
+The reference keeps its pre-2.0 surface alive under python/paddle/fluid
+(~366k LoC) because a decade of user code imports it. The TPU build maps
+the most-used fluid entry points onto their modern equivalents so ported
+scripts run; anything genuinely fluid-only (LoDTensor mutation,
+ParallelExecutor strategies, per-op program surgery) raises with a
+pointer to the modern API rather than half-working.
+
+Covered (the symbols real-world fluid scripts actually touch):
+  Program / Executor / program_guard / default_{main,startup}_program /
+  scope_guard / global_scope — paddle_tpu.static
+  CPUPlace / CUDAPlace — paddle_tpu.core.place
+  dygraph.guard / dygraph.to_variable / dygraph.Layer — eager mode
+  layers.fc / layers.data / layers.cross_entropy / layers.mean /
+  layers.fill_constant / layers.concat ... — static.nn + ops
+  io.DataLoader — paddle_tpu.io
+  core (enforce types, Scope) — paddle_tpu.core
+"""
+from __future__ import annotations
+
+from .. import static as _static
+from ..core.place import CPUPlace, CUDAPlace, TPUPlace  # noqa: F401
+from ..core.tensor import Tensor  # noqa: F401
+from ..framework.io import load, save  # noqa: F401
+from ..static import (  # noqa: F401
+    Executor,
+    Program,
+    default_main_program,
+    default_startup_program,
+    program_guard,
+)
+from ..core.tensor_array import global_scope, scope_guard  # noqa: F401
+from .. import io  # noqa: F401
+from . import core, dygraph, layers  # noqa: F401
+
+
+def enable_dygraph(place=None):
+    _static.disable_static()
+
+
+def disable_dygraph():
+    _static.enable_static()
+
+
+def in_dygraph_mode():
+    from ..static import in_dynamic_mode
+
+    return in_dynamic_mode()
+
+
+def is_compiled_with_cuda():
+    return False

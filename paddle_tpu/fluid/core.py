@@ -1,0 +1,19 @@
+"""paddle.fluid.core compat: the symbols user code reads off the old
+pybind module (places, error types, Scope)."""
+from ..core.enforce import (  # noqa: F401
+    EnforceNotMet,
+    InvalidArgumentError,
+    NotFoundError,
+    OutOfRangeError,
+    UnimplementedError,
+)
+from ..core.place import CPUPlace, CUDAPlace, TPUPlace  # noqa: F401
+from ..core.tensor_array import Scope  # noqa: F401
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def get_cuda_device_count():
+    return 0

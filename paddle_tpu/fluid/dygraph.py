@@ -1,0 +1,29 @@
+"""paddle.fluid.dygraph compat: guard/to_variable/Layer and the grad
+helpers old imperative scripts use."""
+from __future__ import annotations
+
+import contextlib
+
+from ..core.dispatch import no_grad  # noqa: F401
+from ..nn.layer import Layer  # noqa: F401
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    """fluid.dygraph.guard(): eager mode scope (the default here)."""
+    from .. import static as _static
+
+    was_static = not _static.in_dynamic_mode()
+    _static.disable_static()
+    try:
+        yield
+    finally:
+        if was_static:
+            _static.enable_static()
+
+
+def to_variable(value, name=None, zero_copy=None, dtype=None):
+    from ..ops.creation import to_tensor
+
+    t = to_tensor(value, dtype=dtype)
+    return t

@@ -1,0 +1,82 @@
+"""paddle.fluid.layers compat: the op spellings fluid-era scripts call.
+
+Each maps onto the modern op/layer; fluid-only semantics that cannot be
+preserved raise with the modern replacement named.
+"""
+from __future__ import annotations
+
+from .. import ops
+from ..nn import functional as F
+from ..static import data as _data
+from ..static import nn as _snn
+
+# direct re-exports with matching semantics
+concat = ops.manipulation.concat
+reshape = ops.manipulation.reshape
+transpose = ops.manipulation.transpose
+reduce_sum = ops.reduction.sum
+reduce_mean = ops.reduction.mean
+mean = ops.reduction.mean
+elementwise_add = ops.math.add
+elementwise_sub = ops.math.subtract
+elementwise_mul = ops.math.multiply
+elementwise_div = ops.math.divide
+matmul = ops.math.matmul
+mul = ops.math.matmul
+sqrt = ops.math.sqrt
+square = ops.math.square
+relu = F.relu
+sigmoid = F.sigmoid
+softmax = F.softmax
+tanh = ops.math.tanh
+cast = ops.math.cast
+fc = _snn.fc
+
+
+def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True):
+    """fluid.layers.data prepended a batch dim by default; modern
+    static.data does not — replicate the old behavior."""
+    if append_batch_size:
+        shape = [-1] + list(shape)
+    return _data(name, shape, dtype)
+
+
+def fill_constant(shape, dtype, value, force_cpu=False, out=None):
+    if out is not None:
+        raise ValueError(
+            "fill_constant(out=...) mutates in place, which functional "
+            "tensors do not support; assign the return value instead "
+            "(modern: paddle.full)")
+    from ..ops.creation import full
+
+    return full(shape, value, dtype=dtype)
+
+
+def cross_entropy(input, label, soft_label=False, ignore_index=-100):
+    """fluid.layers.cross_entropy took PROBABILITIES (post-softmax) and
+    an int label of shape [N, 1]; the modern F.cross_entropy takes
+    logits — this preserves the fluid contract."""
+    import paddle_tpu as paddle
+
+    if soft_label:
+        return -(label * paddle.log(input)).sum(axis=-1, keepdim=True)
+    # rank decides whether the trailing [*, 1] index dim is present
+    idx = label if label.ndim == input.ndim else label.unsqueeze(-1)
+    safe = paddle.where(idx == ignore_index, paddle.zeros_like(idx), idx)
+    picked = ops.manipulation.take_along_axis(input, safe, axis=-1)
+    loss = -paddle.log(picked)
+    # fluid semantics: ignore_index rows contribute zero loss
+    return paddle.where(idx == ignore_index, paddle.zeros_like(loss), loss)
+
+
+def accuracy(input, label, k=1):
+    from ..metric import accuracy as _acc
+
+    return _acc(input, label, k=k)
+
+
+def __getattr__(name):
+    raise AttributeError(
+        "fluid.layers.%s has no compat mapping; use the modern "
+        "paddle_tpu API (ops/F/static.nn) — see the fluid shim docstring"
+        % name)

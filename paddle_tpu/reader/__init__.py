@@ -104,17 +104,24 @@ def buffered(reader, size):
         q = _queue.Queue(maxsize=size)
         stop = threading.Event()
 
+        def put_or_stop(item):
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except _queue.Full:
+                    continue
+            return False
+
         def fill():
-            for d in reader():
-                while not stop.is_set():
-                    try:
-                        q.put(d, timeout=0.1)
-                        break
-                    except _queue.Full:
-                        continue
-                if stop.is_set():
-                    return
-            q.put(_End)
+            try:
+                for d in reader():
+                    if not put_or_stop(d):
+                        return
+            except BaseException as e:  # surface in the consumer
+                put_or_stop(e)
+                return
+            put_or_stop(_End)
 
         t = threading.Thread(target=fill, daemon=True)
         t.start()
@@ -123,6 +130,8 @@ def buffered(reader, size):
                 e = q.get()
                 if e is _End:
                     break
+                if isinstance(e, BaseException):
+                    raise e
                 yield e
         finally:
             # consumer abandoned early (e.g. firstn): release the fill
@@ -152,7 +161,15 @@ def xmap_readers(mapper, reader, process_num, buffer_size,
 
         with ThreadPoolExecutor(max_workers=process_num) as pool:
             if order:
-                yield from pool.map(mapper, reader())
+                # bounded FIFO window (pool.map would eagerly drain the
+                # whole reader, ignoring buffer_size)
+                window = collections.deque()
+                for d in reader():
+                    window.append(pool.submit(mapper, d))
+                    if len(window) >= max(buffer_size, 1):
+                        yield window.popleft().result()
+                while window:
+                    yield window.popleft().result()
                 return
             # unordered: keep at most buffer_size samples in flight so
             # huge/infinite readers neither hang nor buffer unboundedly

@@ -1,0 +1,50 @@
+"""paddle.fluid compat shim: the legacy entry points ported scripts hit
+(reference keeps python/paddle/fluid alive for the same reason)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import fluid, static
+
+
+class TestDygraphCompat:
+    def test_guard_and_to_variable(self):
+        with fluid.dygraph.guard():
+            v = fluid.dygraph.to_variable(np.ones((2, 3), np.float32))
+            out = fluid.layers.relu(v - 2.0)
+        assert out.shape == [2, 3]
+        assert fluid.in_dygraph_mode()
+
+    def test_layer_alias(self):
+        assert fluid.dygraph.Layer is paddle.nn.Layer
+
+
+class TestStaticCompat:
+    def test_fluid_style_program(self):
+        static.enable_static()
+        try:
+            main, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main, startup):
+                x = fluid.layers.data("x", [4])  # batch dim prepended
+                h = fluid.layers.fc(x, 8, activation="relu")
+                y = fluid.layers.fc(h, 2)
+                loss = fluid.layers.reduce_mean(fluid.layers.square(y))
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            out = exe.run(main, feed={"x": np.ones((3, 4), np.float32)},
+                          fetch_list=[loss])
+            assert np.isfinite(out[0]).all()
+        finally:
+            static.disable_static()
+
+    def test_cross_entropy_takes_probs(self):
+        probs = paddle.to_tensor(
+            np.asarray([[0.25, 0.75]], np.float32))
+        label = paddle.to_tensor(np.asarray([[1]], np.int64))
+        ce = fluid.layers.cross_entropy(probs, label)
+        np.testing.assert_allclose(np.asarray(ce._value),
+                                   [[-np.log(0.75)]], rtol=1e-6)
+
+    def test_unmapped_symbol_raises_with_hint(self):
+        with pytest.raises(AttributeError, match="compat mapping"):
+            fluid.layers.sequence_expand

@@ -160,3 +160,64 @@ class TestTensorAttribute:
         c = paddle.to_tensor(np.asarray([1 + 2j], np.complex64))
         np.testing.assert_allclose(
             np.asarray(paddle.tensor.attribute.imag(c)._value), [2.0])
+
+
+class TestReaderErrorPaths:
+    def test_buffered_propagates_reader_exception(self):
+        def bad():
+            yield 1
+            raise ValueError("boom")
+
+        it = paddle.reader.buffered(bad, 2)()
+        assert next(it) == 1
+        with pytest.raises(ValueError, match="boom"):
+            list(it)
+
+    def test_buffered_exhausted_then_abandoned_no_leak(self):
+        import threading
+        import time
+
+        before = threading.active_count()
+        for _ in range(5):
+            it = paddle.reader.buffered(lambda: iter(range(4)), 1)()
+            next(it)
+            it.close()
+        time.sleep(0.3)
+        assert threading.active_count() <= before + 1
+
+    def test_xmap_ordered_is_lazy(self):
+        def infinite():
+            i = 0
+            while True:
+                yield i
+                i += 1
+
+        it = paddle.reader.xmap_readers(lambda v: v + 1, infinite, 2, 3,
+                                        order=True)()
+        assert [next(it) for _ in range(5)] == [1, 2, 3, 4, 5]
+
+
+class TestFluidLayerEdge:
+    def test_cross_entropy_ignore_index_and_1d_label(self):
+        from paddle_tpu import fluid
+
+        probs = paddle.to_tensor(
+            np.asarray([[0.5, 0.5], [0.25, 0.75]], np.float32))
+        label = paddle.to_tensor(np.asarray([[-100], [1]], np.int64))
+        ce = np.asarray(fluid.layers.cross_entropy(
+            probs, label, ignore_index=-100)._value)
+        assert ce[0, 0] == 0.0
+        np.testing.assert_allclose(ce[1, 0], -np.log(0.75), rtol=1e-6)
+        # 1-D label of length 1 (batch-size-1 inference)
+        one = fluid.layers.cross_entropy(
+            paddle.to_tensor(np.asarray([[0.2, 0.8]], np.float32)),
+            paddle.to_tensor(np.asarray([1], np.int64)))
+        np.testing.assert_allclose(np.asarray(one._value)[0],
+                                   -np.log(0.8), rtol=1e-6)
+
+    def test_fill_constant_out_raises(self):
+        from paddle_tpu import fluid
+
+        with pytest.raises(ValueError, match="in place"):
+            fluid.layers.fill_constant([1], "float32", 0.0,
+                                       out=paddle.zeros([1]))
