@@ -25,7 +25,9 @@ def _load_cached(path):
 
 
 def _load():
-    return _load_cached(os.environ.get("PADDLE_DATASET_HOME"))
+    # copy: readers hand rows to user code that may mutate in place —
+    # the cache must never leak a shared mutable array
+    return _load_cached(os.environ.get("PADDLE_DATASET_HOME")).copy()
 
 
 def _load_impl(path):

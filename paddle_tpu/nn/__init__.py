@@ -157,3 +157,5 @@ def ClipGradByValue(max, min=None):
     return C(max, min)
 
 from . import utils  # noqa: F401
+from .layers.common import Fold, Unflatten  # noqa: F401,E402
+from .decode import BeamSearchDecoder, Decoder, dynamic_decode  # noqa: F401,E402

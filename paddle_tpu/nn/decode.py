@@ -1,0 +1,144 @@
+"""Seq2seq decoding: BeamSearchDecoder + dynamic_decode.
+
+Parity: reference python/paddle/nn/decode.py (Decoder base,
+BeamSearchDecoder over an RNN cell, dynamic_decode driver). The
+compiled-LM serving path is models/generation.py; this is the classic
+cell-level API seq2seq models port against. Host-stepped eager loop
+(the reference's dynamic_decode builds a while-op the same shape).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["Decoder", "BeamSearchDecoder", "dynamic_decode"]
+
+_NEG = -1e9
+
+
+def _v(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+class Decoder:
+    """Interface for dynamic_decode (reference decode.py:43)."""
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states, **kwargs):
+        raise NotImplementedError
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        return outputs, final_states
+
+
+class BeamSearchDecoder(Decoder):
+    """Beam search over a cell (reference decode.py:154). States and
+    inputs are tiled to [batch*beam, ...]; each step scores
+    log_softmax(output_fn(cell_out)) + beam score, selects top beam_size
+    over beam*vocab, and freezes finished beams on end_token."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        """[batch, ...] -> [batch*beam, ...] (reference :236): for
+        tensors used inside cell.call, e.g. attention memory."""
+        v = _v(x)
+        return Tensor(jnp.repeat(v, beam_size, axis=0))
+
+    def _merge(self, x):
+        v = _v(x)
+        return v.reshape((-1,) + v.shape[2:])
+
+    def _split(self, v):
+        return v.reshape((-1, self.beam_size) + v.shape[1:])
+
+    def initialize(self, inits):
+        states = [Tensor(self._merge(jnp.repeat(
+            _v(s)[:, None], self.beam_size, axis=1)))
+            for s in (inits if isinstance(inits, (list, tuple))
+                      else [inits])]
+        batch = _v(states[0]).shape[0] // self.beam_size
+        ids = jnp.full((batch * self.beam_size,), self.start_token,
+                       jnp.int32)
+        inputs = Tensor(ids) if self.embedding_fn is None \
+            else self.embedding_fn(Tensor(ids))
+        # beam 0 carries the whole probability mass initially so the
+        # first top-k picks beam_size DISTINCT tokens
+        scores = jnp.where(jnp.arange(self.beam_size)[None, :] == 0,
+                           0.0, _NEG) * jnp.ones((batch, 1))
+        finished = jnp.zeros((batch, self.beam_size), bool)
+        return inputs, states, (scores, finished)
+
+    def step(self, time, inputs, states, beam_state, **kwargs):
+        scores, finished = beam_state
+        batch = scores.shape[0]
+        K = self.beam_size
+        cell_out, new_states = self.cell(inputs, states[0]
+                                         if len(states) == 1 else states)
+        if self.output_fn is not None:
+            cell_out = self.output_fn(cell_out)
+        logits = _v(cell_out).astype(jnp.float32)
+        vocab = logits.shape[-1]
+        logp = jax.nn.log_softmax(logits, axis=-1).reshape(batch, K, vocab)
+        # finished beams: only end_token continues, free of charge
+        frozen = jnp.full((vocab,), _NEG).at[self.end_token].set(0.0)
+        logp = jnp.where(finished[:, :, None], frozen[None, None, :], logp)
+        cand = (scores[:, :, None] + logp).reshape(batch, K * vocab)
+        new_scores, idx = jax.lax.top_k(cand, K)
+        parent = idx // vocab                            # [batch, K]
+        token = (idx % vocab).astype(jnp.int32)
+        rows = jnp.repeat(jnp.arange(batch), K)          # [batch*K]
+        cols = parent.reshape(-1)
+        new_states_list = new_states if isinstance(new_states,
+                                                   (list, tuple)) \
+            else [new_states]
+        # reorder each state to its winning source beam, back to the
+        # merged [batch*K, ...] layout the cell consumes
+        gathered = [Tensor(self._split(_v(s))[rows, cols])
+                    for s in new_states_list]
+        finished = jnp.take_along_axis(finished, parent, axis=1)
+        finished = jnp.logical_or(finished, token == self.end_token)
+        flat_tok = token.reshape(-1)
+        inputs = Tensor(flat_tok) if self.embedding_fn is None \
+            else self.embedding_fn(Tensor(flat_tok))
+        return (token, parent), inputs, gathered, (new_scores, finished)
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None, **kwargs):
+    """Drive `decoder` until every beam finishes or max_step_num
+    (reference decode.py:985). Returns (predicted_ids [batch,
+    time, beam] best-first, final_states)."""
+    if max_step_num is None:
+        max_step_num = 100
+    inputs, states, beam_state = decoder.initialize(inits)
+    tokens, parents = [], []
+    for t in range(int(max_step_num)):
+        (token, parent), inputs, states, beam_state = decoder.step(
+            t, inputs, states, beam_state, **kwargs)
+        tokens.append(np.asarray(token))
+        parents.append(np.asarray(parent))
+        if bool(np.asarray(beam_state[1]).all()):
+            break
+    # backtrace through parent pointers (beams reorder every step)
+    T = len(tokens)
+    batch, K = tokens[0].shape
+    ids = np.zeros((batch, T, K), np.int32)
+    cur = np.tile(np.arange(K), (batch, 1))
+    for t in range(T - 1, -1, -1):
+        ids[:, t, :] = np.take_along_axis(tokens[t], cur, axis=1)
+        cur = np.take_along_axis(parents[t], cur, axis=1)
+    return Tensor(jnp.asarray(ids)), states

@@ -37,6 +37,9 @@ from .attention import (  # noqa: F401
 )
 from .common import (  # noqa: F401
     affine_grid,
+    sequence_mask,
+    unfold,
+    zeropad2d,
     alpha_dropout,
     bilinear,
     channel_shuffle,
@@ -66,6 +69,9 @@ from .conv import (  # noqa: F401
     conv3d_transpose,
 )
 from .loss import (  # noqa: F401
+    multi_label_soft_margin_loss,
+    npair_loss,
+    soft_margin_loss,
     binary_cross_entropy,
     class_center_sample,
     ctc_loss,

@@ -381,3 +381,33 @@ def channel_shuffle(x, groups, data_format="NCHW"):
     if data_format == "NHWC":
         out = jnp.transpose(out, (0, 2, 3, 1))
     return out
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col over [N, C, H, W] -> [N, C*kh*kw, L] (reference
+    nn/functional/common.py:38 unfold / phi unfold_kernel)."""
+    from ...ops.manipulation import unfold as _unfold_op
+
+    return _unfold_op(x, kernel_sizes, strides, paddings, dilations)
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    """Zero-pad the spatial dims; padding = [left, right, top, bottom]
+    (reference nn/functional/common.py zeropad2d)."""
+    from ...ops.manipulation import pad as _pad
+
+    return _pad(x, padding, mode="constant", value=0.0,
+                data_format=data_format)
+
+
+@primitive(nondiff=True)
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """mask[..., j] = j < x[...] (reference functional/extension.py:162
+    sequence_mask over LoD-free length tensors)."""
+    v = _A(x)
+    if maxlen is None:
+        maxlen = int(v.max())  # concrete lengths only in this case
+    pos = jnp.arange(maxlen)
+    mask = pos[None, :] < v.reshape(v.shape + (1,))
+    mask = mask.reshape(v.shape + (maxlen,))
+    return mask.astype(dtype)

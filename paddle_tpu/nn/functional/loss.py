@@ -438,3 +438,45 @@ def class_center_sample(label, num_classes, num_samples, group=None):
     remap = -np.ones(num_classes, np.int64)
     remap[sampled] = np.arange(sampled.size)
     return jnp.asarray(remap[li]), jnp.asarray(sampled)
+
+
+@primitive
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    """log(1 + exp(-label * input)), label in {-1, 1} (reference
+    nn/functional/loss.py:3770)."""
+    iv = _A(input)
+    lv = _A(label).astype(iv.dtype)
+    loss = jnp.logaddexp(0.0, -lv * iv)  # stable log(1+exp(z))
+    return _reduce(loss, reduction)
+
+
+@primitive
+def multi_label_soft_margin_loss(input, label, weight=None,
+                                 reduction="mean", name=None):
+    """Per-class sigmoid BCE averaged over classes (reference
+    nn/functional/loss.py:3043)."""
+    iv = _A(input)
+    lv = _A(label).astype(iv.dtype)
+    loss = -(lv * jax.nn.log_sigmoid(iv)
+             + (1.0 - lv) * jax.nn.log_sigmoid(-iv))
+    if weight is not None:
+        loss = loss * _A(weight)
+    loss = loss.mean(axis=-1)
+    return _reduce(loss, reduction)
+
+
+@primitive
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    """N-pair metric loss (reference nn/functional/loss.py:314): L2 on
+    the embeddings + softmax CE over the anchor@positive^T similarity
+    with same-label soft targets."""
+    a, p = _A(anchor), _A(positive)
+    lab = _A(labels).reshape(-1)
+    batch = a.shape[0]
+    l2loss = l2_reg * (jnp.sum(a * a) + jnp.sum(p * p)) / batch * 0.25
+    sim = a @ p.T                                      # [N, N]
+    same = (lab[:, None] == lab[None, :]).astype(a.dtype)
+    target = same / same.sum(axis=1, keepdims=True)
+    logp = jax.nn.log_softmax(sim, axis=1)
+    ce = -(target * logp).sum(axis=1).mean()
+    return l2loss + ce

@@ -205,3 +205,33 @@ class Unfold(Layer):
     def forward(self, x):
         return ops.manipulation.unfold(x, self.kernel_sizes, self.strides,
                                        self.paddings, self.dilations)
+
+class Fold(Layer):
+    """col2im layer (reference nn/layer/common.py:1612 Fold)."""
+
+    def __init__(self, output_sizes, kernel_sizes, strides=1, paddings=0,
+                 dilations=1, name=None):
+        super().__init__()
+        self._out = output_sizes
+        self._args = (kernel_sizes, strides, paddings, dilations)
+
+    def forward(self, x):
+        return F.fold(x, self._out, *self._args)
+
+
+class Unflatten(Layer):
+    """Inverse of flatten on one axis (reference nn/layer/common.py
+    Unflatten)."""
+
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self._axis = axis
+        self._shape = list(shape)
+
+    def forward(self, x):
+        from ... import ops
+
+        s = list(x.shape)
+        ax = self._axis if self._axis >= 0 else self._axis + len(s)
+        new = s[:ax] + self._shape + s[ax + 1:]
+        return ops.manipulation.reshape(x, new)

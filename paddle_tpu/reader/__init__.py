@@ -100,6 +100,10 @@ def buffered(reader, size):
     class _End:
         pass
 
+    class _Err:
+        def __init__(self, e):
+            self.e = e
+
     def data_reader():
         q = _queue.Queue(maxsize=size)
         stop = threading.Event()
@@ -119,7 +123,7 @@ def buffered(reader, size):
                     if not put_or_stop(d):
                         return
             except BaseException as e:  # surface in the consumer
-                put_or_stop(e)
+                put_or_stop(_Err(e))
                 return
             put_or_stop(_End)
 
@@ -130,8 +134,8 @@ def buffered(reader, size):
                 e = q.get()
                 if e is _End:
                     break
-                if isinstance(e, BaseException):
-                    raise e
+                if isinstance(e, _Err):
+                    raise e.e
                 yield e
         finally:
             # consumer abandoned early (e.g. firstn): release the fill
@@ -159,27 +163,29 @@ def xmap_readers(mapper, reader, process_num, buffer_size,
     def data_reader():
         import collections
 
+        from concurrent.futures import FIRST_COMPLETED, wait
+
         with ThreadPoolExecutor(max_workers=process_num) as pool:
-            if order:
-                # bounded FIFO window (pool.map would eagerly drain the
-                # whole reader, ignoring buffer_size)
-                window = collections.deque()
-                for d in reader():
-                    window.append(pool.submit(mapper, d))
-                    if len(window) >= max(buffer_size, 1):
-                        yield window.popleft().result()
-                while window:
-                    yield window.popleft().result()
-                return
-            # unordered: keep at most buffer_size samples in flight so
-            # huge/infinite readers neither hang nor buffer unboundedly
+            # bounded window either way (an eager pool.map would drain
+            # infinite readers); order=False yields as-completed
             window = collections.deque()
-            it = reader()
-            for d in it:
+            for d in reader():
                 window.append(pool.submit(mapper, d))
                 if len(window) >= max(buffer_size, 1):
-                    yield window.popleft().result()
+                    if order:
+                        yield window.popleft().result()
+                    else:
+                        done, _ = wait(window, return_when=FIRST_COMPLETED)
+                        f = next(iter(done))
+                        window.remove(f)
+                        yield f.result()
             while window:
-                yield window.popleft().result()
+                if order:
+                    yield window.popleft().result()
+                else:
+                    done, _ = wait(window, return_when=FIRST_COMPLETED)
+                    f = next(iter(done))
+                    window.remove(f)
+                    yield f.result()
 
     return data_reader

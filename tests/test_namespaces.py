@@ -221,3 +221,24 @@ class TestFluidLayerEdge:
         with pytest.raises(ValueError, match="in place"):
             fluid.layers.fill_constant([1], "float32", 0.0,
                                        out=paddle.zeros([1]))
+
+
+class TestDatasetIsolation:
+    def test_reader_rows_are_private_copies(self):
+        """Regression: the lru-cached array must not leak shared mutable
+        rows — in-place consumer mutation cannot corrupt later epochs."""
+        feats1, _ = next(paddle.dataset.uci_housing.train()())
+        feats1 += 1000.0  # fluid-era scripts mutate rows in place
+        feats2, _ = next(paddle.dataset.uci_housing.train()())
+        assert feats2[0] < 500.0  # untouched by the first epoch's mutation
+
+    def test_xmap_unordered_yields_as_completed(self):
+        import time
+
+        def r():
+            yield from [0.2, 0.0]  # first sample is slow
+
+        out = list(paddle.reader.xmap_readers(
+            lambda v: (time.sleep(v), v)[1], r, 2, 2, order=False)())
+        assert sorted(out) == [0.0, 0.2]
+        assert out[0] == 0.0  # fast sample came out first

@@ -1,0 +1,133 @@
+"""nn additions: unfold/fold layers, Unflatten, sequence_mask/zeropad2d,
+soft-margin family losses, BeamSearchDecoder + dynamic_decode
+(reference nn/layer/common.py, nn/functional/{common,extension,loss}.py,
+nn/decode.py and their unittests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+RNG = np.random.RandomState(3)
+
+
+class TestUnfoldFold:
+    def test_unfold_matches_manual_patches(self):
+        x = paddle.to_tensor(RNG.randn(1, 2, 4, 4).astype(np.float32))
+        u = nn.Unfold(kernel_sizes=2, strides=2)(x)
+        assert u.shape == [1, 2 * 2 * 2, 4]
+        xv = np.asarray(x._value)
+        # first block = x[:, :, 0:2, 0:2] flattened channel-major
+        first = xv[0, :, 0:2, 0:2].reshape(-1)
+        np.testing.assert_allclose(np.asarray(u._value)[0, :, 0], first,
+                                   rtol=1e-6)
+
+    def test_fold_inverts_unfold_on_disjoint_blocks(self):
+        x = paddle.to_tensor(RNG.randn(1, 3, 4, 4).astype(np.float32))
+        u = F.unfold(x, 2, strides=2)
+        back = nn.Fold(output_sizes=[4, 4], kernel_sizes=2, strides=2)(u)
+        np.testing.assert_allclose(np.asarray(back._value),
+                                   np.asarray(x._value), rtol=1e-6)
+
+    def test_unflatten(self):
+        x = paddle.to_tensor(np.zeros((2, 12), np.float32))
+        out = nn.Unflatten(1, [3, 4])(x)
+        assert out.shape == [2, 3, 4]
+        out = nn.Unflatten(-1, [2, 6])(x)
+        assert out.shape == [2, 2, 6]
+
+
+class TestNewLosses:
+    def test_soft_margin_scalar_oracle(self):
+        x = np.asarray([0.5, -2.0], np.float32)
+        y = np.asarray([1.0, -1.0], np.float32)
+        got = float(F.soft_margin_loss(paddle.to_tensor(x),
+                                       paddle.to_tensor(y)))
+        np.testing.assert_allclose(got, np.log1p(np.exp(-y * x)).mean(),
+                                   rtol=1e-6)
+
+    def test_multi_label_soft_margin_oracle(self):
+        x = RNG.randn(4, 3).astype(np.float32)
+        y = (RNG.rand(4, 3) > 0.5).astype(np.float32)
+        got = float(F.multi_label_soft_margin_loss(
+            paddle.to_tensor(x), paddle.to_tensor(y)))
+
+        def sig(v):
+            return 1.0 / (1.0 + np.exp(-v))
+
+        ref = -(y * np.log(sig(x)) + (1 - y) * np.log(sig(-x)))
+        np.testing.assert_allclose(got, ref.mean(axis=-1).mean(),
+                                   rtol=1e-5)
+
+    def test_npair_loss_grads(self):
+        a = paddle.to_tensor(RNG.randn(4, 8).astype(np.float32))
+        a.stop_gradient = False
+        p = paddle.to_tensor(RNG.randn(4, 8).astype(np.float32))
+        lab = paddle.to_tensor(np.asarray([0, 1, 0, 2], np.int64))
+        loss = F.npair_loss(a, p, lab)
+        loss.backward()
+        assert np.isfinite(float(loss))
+        assert a.grad is not None
+
+
+class TestBeamSearchDecoder:
+    def test_decodes_and_scores_order(self):
+        paddle.seed(10)
+        vocab, hidden = 12, 16
+        emb = nn.Embedding(vocab, hidden)
+        cell = nn.GRUCell(hidden, hidden)
+        out_fc = nn.Linear(hidden, vocab)
+        dec = nn.BeamSearchDecoder(cell, start_token=0, end_token=1,
+                                   beam_size=3, embedding_fn=emb,
+                                   output_fn=out_fc)
+        h0 = paddle.to_tensor(RNG.randn(2, hidden).astype(np.float32))
+        ids, final = nn.dynamic_decode(dec, inits=[h0], max_step_num=6)
+        got = np.asarray(ids._value)
+        assert got.shape[0] == 2 and got.shape[2] == 3
+        assert got.shape[1] <= 6
+        assert (got >= 0).all() and (got < vocab).all()
+
+    def test_greedy_equivalence_beam1(self):
+        """beam_size=1 must follow the argmax chain of the cell."""
+        paddle.seed(11)
+        vocab, hidden = 8, 8
+        emb = nn.Embedding(vocab, hidden)
+        cell = nn.GRUCell(hidden, hidden)
+        fc = nn.Linear(hidden, vocab)
+        dec = nn.BeamSearchDecoder(cell, start_token=0, end_token=7,
+                                   beam_size=1, embedding_fn=emb,
+                                   output_fn=fc)
+        h0 = paddle.to_tensor(RNG.randn(1, hidden).astype(np.float32))
+        ids, _ = nn.dynamic_decode(dec, inits=[h0], max_step_num=5)
+        got = np.asarray(ids._value)[0, :, 0]
+
+        # manual greedy rollout
+        h = h0
+        tok = paddle.to_tensor(np.asarray([0], np.int64))
+        want = []
+        for _ in range(len(got)):
+            out, h = cell(emb(tok), h)
+            nxt = int(np.argmax(np.asarray(fc(out)._value)[0]))
+            want.append(nxt)
+            if nxt == 7:
+                break
+            tok = paddle.to_tensor(np.asarray([nxt], np.int64))
+        np.testing.assert_array_equal(got[:len(want)], want)
+
+    def test_tile_beam_merge_with_batch(self):
+        x = paddle.to_tensor(np.arange(4, dtype=np.float32).reshape(2, 2))
+        t = nn.BeamSearchDecoder.tile_beam_merge_with_batch(x, 3)
+        assert t.shape == [6, 2]
+        np.testing.assert_allclose(np.asarray(t._value)[0:3],
+                                   np.tile(np.asarray([[0., 1.]]), (3, 1)))
+
+
+class TestLossStability:
+    def test_soft_margin_large_logits_finite(self):
+        """Regression: log1p(exp(100)) overflowed; logaddexp is exact."""
+        x = paddle.to_tensor(np.asarray([-100.0, 100.0], np.float32))
+        y = paddle.to_tensor(np.asarray([1.0, -1.0], np.float32))
+        got = float(F.soft_margin_loss(x, y))
+        assert np.isfinite(got)
+        np.testing.assert_allclose(got, 100.0, rtol=1e-5)
