@@ -15,7 +15,7 @@ import jax.numpy as jnp
 
 from ..core.tensor import Tensor
 
-__all__ = ["Decoder", "BeamSearchDecoder", "dynamic_decode"]
+__all__ = ["Decoder", "BeamSearchDecoder", "dynamic_decode", "gather_tree"]
 
 _NEG = -1e9
 
@@ -118,6 +118,22 @@ class BeamSearchDecoder(Decoder):
         return (token, parent), inputs, gathered, (new_scores, finished)
 
 
+def gather_tree(ids, parents):
+    """Backtrace beam parent pointers into full sequences (reference
+    phi gather_tree_kernel / paddle.nn.functional.gather_tree):
+    ids/parents [max_time, batch, beam] -> sequences aligned so that
+    position t holds the ancestor token of the final beams."""
+    iv = np.asarray(_v(ids))
+    pv = np.asarray(_v(parents))
+    T, batch, K = iv.shape
+    out = np.zeros_like(iv)
+    cur = np.tile(np.arange(K), (batch, 1))
+    for t in range(T - 1, -1, -1):
+        out[t] = np.take_along_axis(iv[t], cur, axis=1)
+        cur = np.take_along_axis(pv[t], cur, axis=1)
+    return Tensor(jnp.asarray(out))
+
+
 def dynamic_decode(decoder, inits=None, max_step_num=None, **kwargs):
     """Drive `decoder` until every beam finishes or max_step_num
     (reference decode.py:985). Returns (predicted_ids [batch,
@@ -134,11 +150,6 @@ def dynamic_decode(decoder, inits=None, max_step_num=None, **kwargs):
         if bool(np.asarray(beam_state[1]).all()):
             break
     # backtrace through parent pointers (beams reorder every step)
-    T = len(tokens)
-    batch, K = tokens[0].shape
-    ids = np.zeros((batch, T, K), np.int32)
-    cur = np.tile(np.arange(K), (batch, 1))
-    for t in range(T - 1, -1, -1):
-        ids[:, t, :] = np.take_along_axis(tokens[t], cur, axis=1)
-        cur = np.take_along_axis(parents[t], cur, axis=1)
-    return Tensor(jnp.asarray(ids)), states
+    traced = gather_tree(np.stack(tokens), np.stack(parents))
+    ids = jnp.swapaxes(_v(traced), 0, 1)       # [batch, T, K]
+    return Tensor(ids), states

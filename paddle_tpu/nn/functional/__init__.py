@@ -35,6 +35,8 @@ from .attention import (  # noqa: F401
     sparse_attention,
     variable_length_attention,
 )
+from ..decode import gather_tree  # noqa: F401
+from ...ops.manipulation import diag_embed  # noqa: F401
 from .common import (  # noqa: F401
     affine_grid,
     sequence_mask,
@@ -137,3 +139,25 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
     running_var.set_value(
         running_var._value * momentum + var._value * (1.0 - momentum))
     return out
+
+from .activation import log_sigmoid, _inplace  # noqa: E402
+from . import activation as _act  # noqa: E402
+from .pooling import (  # noqa: F401,E402
+    adaptive_avg_pool3d,
+    adaptive_max_pool3d,
+    max_unpool1d,
+    max_unpool3d,
+)
+from .loss import (  # noqa: F401,E402
+    dice_loss,
+    multi_margin_loss,
+    pairwise_distance,
+    rnnt_loss,
+    triplet_margin_with_distance_loss,
+)
+
+relu_ = _inplace(relu)
+elu_ = _inplace(elu)
+tanh_ = _inplace(tanh)
+softmax_ = _inplace(softmax)
+del _inplace, _act

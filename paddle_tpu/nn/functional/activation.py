@@ -196,3 +196,26 @@ def glu(x, axis=-1):
 def thresholded_relu(x, threshold=1.0):
     x = _A(x)
     return jnp.where(x > threshold, x, 0.0)
+
+@primitive
+def log_sigmoid(x, name=None):
+    """reference log_sigmoid (stable -softplus(-x))."""
+    import jax
+
+    return jax.nn.log_sigmoid(jnp.asarray(x))
+
+
+def _inplace(fn):
+    def op(x, *args, **kwargs):
+        out = fn(x, *args, **kwargs)
+        from ...core.tensor import Tensor
+
+        if isinstance(x, Tensor):
+            x._value = out._value if isinstance(out, Tensor) else out
+            return x
+        return out
+
+    op.__name__ = fn.__name__ + "_"
+    op.__doc__ = ("In-place variant of %s (reference *_ ops mutate the "
+                  "input Tensor)." % fn.__name__)
+    return op

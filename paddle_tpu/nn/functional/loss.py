@@ -480,3 +480,148 @@ def npair_loss(anchor, positive, labels, l2_reg=0.002):
     logp = jax.nn.log_softmax(sim, axis=1)
     ce = -(target * logp).sum(axis=1).mean()
     return l2loss + ce
+
+
+@primitive
+def dice_loss(input, label, epsilon=1e-5):
+    """reference nn/functional/loss.py dice_loss: 1 - 2|X∩Y|/(|X|+|Y|)
+    over the last dim's class probabilities vs int labels."""
+    x = _A(input)
+    lbl = _A(label)
+    if lbl.ndim == x.ndim and lbl.shape[-1] == 1:
+        lbl = jnp.squeeze(lbl, -1)
+    onehot = jax.nn.one_hot(lbl.astype(jnp.int32), x.shape[-1],
+                            dtype=x.dtype)
+    reduce_dims = tuple(range(1, x.ndim))
+    inter = jnp.sum(x * onehot, axis=reduce_dims)
+    union = jnp.sum(x, axis=reduce_dims) + jnp.sum(onehot, axis=reduce_dims)
+    return jnp.mean(1.0 - (2.0 * inter + epsilon) / (union + epsilon))
+
+
+@primitive
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    """reference multi_margin_loss: mean_i max(0, margin - x[y] + x[i])^p
+    over i != y."""
+    x = _A(input)
+    y = _A(label).astype(jnp.int32).reshape(-1)
+    n, c = x.shape
+    picked = jnp.take_along_axis(x, y[:, None], axis=1)
+    base = jnp.maximum(0.0, margin - picked + x)
+    if weight is not None:
+        # weight multiplies INSIDE the power (reference loss.py:3746:
+        # clip(w * (margin - x_y + x), 0)^p)
+        base = base * _A(weight)[y][:, None]
+    m = base ** p
+    m = m.at[jnp.arange(n), y].set(0.0)
+    loss = m.sum(axis=1) / c
+    return _reduce(loss, reduction)
+
+
+@primitive
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False,
+                      name=None):
+    """reference pairwise_distance: ||x - y + eps||_p over the last dim."""
+    d = _A(x) - _A(y) + epsilon
+    out = jnp.sum(jnp.abs(d) ** p, axis=-1) ** (1.0 / p)
+    if keepdim:
+        out = out[..., None]
+    return out
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean",
+                                      name=None):
+    """reference triplet_margin_with_distance_loss: user-supplied
+    distance; composite of existing primitives (stays differentiable
+    through whatever `distance_function` does)."""
+    from ...core.tensor import Tensor as _T
+    import paddle_tpu as paddle
+
+    dist = distance_function if distance_function is not None \
+        else (lambda a, b: _T(pairwise_distance.raw_fn(
+            a._value if isinstance(a, _T) else a,
+            b._value if isinstance(b, _T) else b)))
+    d_pos = dist(input, positive)
+    d_neg = dist(input, negative)
+    if swap:
+        d_pn = dist(positive, negative)
+        d_neg = paddle.minimum(d_neg, d_pn)
+    loss = paddle.clip(d_pos - d_neg + margin, min=0.0)
+    if reduction == "mean":
+        return loss.mean()
+    if reduction == "sum":
+        return loss.sum()
+    return loss
+
+
+@primitive
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.0, reduction="mean", name=None):
+    """RNN-T transducer loss (reference rnnt_loss over the warprnnt
+    kernel; public forward-variable recursion, fresh implementation).
+
+    input: [B, Tmax, Umax+1, V] logits; label: [B, Umax] int;
+    alpha(t, u) = logaddexp(alpha(t-1, u) + blank(t-1, u),
+                            alpha(t, u-1) + y(t, u-1)) in log space,
+    loss = -(alpha(T-1, U) + blank(T-1, U)).
+
+    Deviation: FastEmit regularization (nonzero fastemit_lambda) is not
+    implemented — it needs the beta recursion's emission posteriors; a
+    nonzero value raises rather than silently computing plain RNNT (the
+    default here is therefore 0.0, not the reference's 0.001).
+    """
+    if fastemit_lambda:
+        raise NotImplementedError(
+            "rnnt_loss: FastEmit regularization (fastemit_lambda != 0) "
+            "is not implemented; pass fastemit_lambda=0.0")
+    logp = jax.nn.log_softmax(_A(input).astype(jnp.float32), axis=-1)
+    lbl = _A(label).astype(jnp.int32)
+    T_len = _A(input_lengths).astype(jnp.int32)
+    U_len = _A(label_lengths).astype(jnp.int32)
+    B, Tm, Um1, V = logp.shape
+    Um = Um1 - 1
+    NEG = -1e30
+
+    blank_lp = logp[..., blank]                       # [B, T, U+1]
+    y_lp = jnp.take_along_axis(
+        logp[:, :, :Um, :], lbl[:, None, :, None].repeat(Tm, 1),
+        axis=-1)[..., 0]                              # [B, T, U]
+
+    u_idx = jnp.arange(Um1)
+
+    def step(alpha_prev, t):
+        # arrival from below via blank at (t-1, u); t=0 seeds u=0 only
+        base = jnp.where(
+            t == 0,
+            jnp.where(u_idx[None, :] == 0, 0.0, NEG),
+            alpha_prev + blank_lp[:, t - 1, :])
+        y_t = y_lp[:, t, :]                            # [B, Um]
+
+        def chain(carry, u):
+            # within-t recurrence: alpha(t,u) = logaddexp(base(u),
+            # alpha(t,u-1) + y(t,u-1))
+            b_u = base[:, u]
+            val = jnp.where(
+                u == 0, b_u,
+                jnp.logaddexp(b_u, carry + y_t[:, jnp.maximum(u - 1, 0)]))
+            return val, val
+
+        _, cols = jax.lax.scan(chain, jnp.full((B,), NEG), u_idx)
+        new = jnp.swapaxes(cols, 0, 1)                 # [B, U+1]
+        return new, new
+
+    init = jnp.full((B, Um1), NEG)
+    _, alphas = jax.lax.scan(step, init, jnp.arange(Tm))  # [T, B, U+1]
+    alphas = jnp.swapaxes(alphas, 0, 1)               # [B, T, U+1]
+    final = jnp.take_along_axis(
+        jnp.take_along_axis(alphas, (T_len - 1)[:, None, None]
+                            .repeat(Um1, 2), axis=1)[:, 0, :],
+        U_len[:, None], axis=1)[:, 0]
+    final_blank = jnp.take_along_axis(
+        jnp.take_along_axis(blank_lp, (T_len - 1)[:, None, None]
+                            .repeat(Um1, 2), axis=1)[:, 0, :],
+        U_len[:, None], axis=1)[:, 0]
+    nll = -(final + final_blank)
+    return _reduce(nll, reduction)

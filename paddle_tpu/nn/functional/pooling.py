@@ -269,3 +269,116 @@ def adaptive_max_pool1d(x, output_size, return_mask=False):
     x4 = x[:, :, None, :]
     o = _adaptive_pool_general(x4, (1, out), False, "max")
     return o[:, :, 0, :]
+
+
+def _adaptive_pool_nd(x, out_sizes, channel_last, mode, nd):
+    """General N-d adaptive pool: mean/max over per-output-bin slices
+    (bins per reference adaptive_pool semantics: start = floor(i*in/out),
+    end = ceil((i+1)*in/out)). Shares the bin math with the 2d
+    _adaptive_pool_general; assembled via one stack+reshape."""
+    import itertools
+
+    sp_axes = list(range(1, 1 + nd)) if channel_last \
+        else list(range(2, 2 + nd))
+    N, C = x.shape[0], (x.shape[-1] if channel_last else x.shape[1])
+
+    def bins(in_size, out_size):
+        starts = (np.arange(out_size) * in_size) // out_size
+        ends = ((np.arange(out_size) + 1) * in_size
+                + out_size - 1) // out_size
+        return starts, ends
+
+    per_axis = [bins(x.shape[ax], out_sizes[i])
+                for i, ax in enumerate(sp_axes)]
+    vals = []
+    for coords in itertools.product(*[range(s) for s in out_sizes]):
+        sl = [slice(None)] * x.ndim
+        for i, ax in enumerate(sp_axes):
+            st, en = per_axis[i]
+            sl[ax] = slice(int(st[coords[i]]), int(en[coords[i]]))
+        piece = x[tuple(sl)]
+        vals.append(piece.mean(axis=tuple(sp_axes)) if mode == "avg"
+                    else piece.max(axis=tuple(sp_axes)))  # [N, C]
+    out = jnp.stack(vals, axis=-1).reshape((N, C) + tuple(out_sizes))
+    if channel_last:
+        out = jnp.moveaxis(out, 1, -1)
+    return out
+
+
+@primitive
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW"):
+    """reference adaptive_avg_pool3d (pool_kernel.h adaptive path)."""
+    x = _A(x)
+    out = _norm(output_size, 3)
+    return _adaptive_pool_nd(x, list(out), data_format == "NDHWC",
+                             "avg", 3)
+
+
+@primitive
+def adaptive_max_pool3d(x, output_size, return_mask=False,
+                        data_format="NCDHW"):
+    """reference adaptive_max_pool3d; return_mask unsupported for the
+    general 3d path (reference GPU kernel also computes it separately)."""
+    x = _A(x)
+    out = _norm(output_size, 3)
+    if return_mask:
+        raise NotImplementedError(
+            "adaptive_max_pool3d(return_mask=True): indices for the "
+            "variable-window 3d path are not provided; use max_pool3d")
+    return _adaptive_pool_nd(x, list(out), data_format == "NDHWC",
+                             "max", 3)
+
+
+def _max_unpool_nd(x, indices, spatial_out):
+    """Scatter pooled values back by flat spatial index (reference
+    unpool_kernel.h), any spatial rank; channel-first layouts only."""
+    xv = _A(x)
+    idx = _A(indices).astype(jnp.int32)
+    N, C = xv.shape[0], xv.shape[1]
+    total = 1
+    for s in spatial_out:
+        total *= int(s)
+    flat = jnp.zeros((N, C, total), xv.dtype)
+    out = flat.at[
+        jnp.arange(N)[:, None, None],
+        jnp.arange(C)[None, :, None],
+        idx.reshape(N, C, -1),
+    ].add(xv.reshape(N, C, -1))
+    return out.reshape((N, C) + tuple(int(s) for s in spatial_out))
+
+
+@primitive
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None):
+    """reference max_unpool1d: inverse of max_pool1d(return_mask=True)."""
+    if data_format != "NCL":
+        raise ValueError(
+            "max_unpool1d only supports NCL (reference check)")
+    xv = _A(x)
+    ks = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
+    st = ks if stride is None else (
+        stride if isinstance(stride, int) else stride[0])
+    pd = padding if isinstance(padding, int) else padding[0]
+    L = (xv.shape[-1] - 1) * st - 2 * pd + ks if output_size is None \
+        else int(output_size[-1])
+    return _max_unpool_nd(x, indices, [L])
+
+
+@primitive
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None):
+    """reference max_unpool3d: inverse of max_pool3d(return_mask=True)."""
+    if data_format != "NCDHW":
+        raise ValueError(
+            "max_unpool3d only supports NCDHW (reference check)")
+    xv = _A(x)
+    ks = _norm(kernel_size, 3)
+    st = _norm(stride if stride is not None else kernel_size, 3)
+    pd = _norm(padding, 3)
+    if output_size is None:
+        spatial = [
+            (xv.shape[2 + i] - 1) * st[i] - 2 * pd[i] + ks[i]
+            for i in range(3)]
+    else:
+        spatial = [int(s) for s in output_size[-3:]]
+    return _max_unpool_nd(x, indices, spatial)

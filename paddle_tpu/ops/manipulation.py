@@ -341,9 +341,15 @@ def pad(x, pad, mode="constant", value=0.0, data_format="NCHW"):
         # paddle convention: pad applies to the *last* len(pad)//2 spatial dims
         # (reversed pairs), e.g. NCHW with pad=[l,r,t,b]
         n_spatial = len(pad) // 2
-        widths = [(0, 0)] * (x.ndim - n_spatial)
         pairs = [(pad[2 * i], pad[2 * i + 1]) for i in range(n_spatial)]
-        widths += list(reversed(pairs)) if data_format in ("NCHW", "NCL", "NCDHW") else list(reversed(pairs))
+        if data_format in ("NHWC", "NLC", "NDHWC"):
+            # channel-last: the padded dims are the MIDDLE spatial axes,
+            # channels stay untouched
+            widths = ([(0, 0)] * (x.ndim - n_spatial - 1)
+                      + list(reversed(pairs)) + [(0, 0)])
+        else:
+            widths = ([(0, 0)] * (x.ndim - n_spatial)
+                      + list(reversed(pairs)))
     jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge",
              "circular": "wrap"}[mode]
     if jmode == "constant":
