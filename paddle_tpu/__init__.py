@@ -44,6 +44,15 @@ from .core.dispatch import enable_grad, no_grad  # noqa: F401
 from .core.autograd import grad  # noqa: F401
 from .framework.random import get_rng_state, seed, set_rng_state  # noqa: F401
 
+# paddle.dtype: the dtype handle type (reference exposes the pybind
+# VarType; here dtype strings normalize through jnp)
+import jax.numpy as _jnp
+
+
+def dtype(name):  # noqa: A001
+    return str(_jnp.dtype(name))
+
+
 # dtype name constants (paddle.float32 etc.)
 bool = "bool"  # noqa: A001
 uint8 = "uint8"
@@ -279,7 +288,39 @@ from .ops.comparison import (  # noqa: F401
     logical_xor,
     not_equal,
 )
-from .ops import linalg  # noqa: F401
+from .ops import extras, linalg  # noqa: F401
+from .ops.extras import (  # noqa: F401
+    add_n,
+    angle,
+    as_complex,
+    as_real,
+    broadcast_shape,
+    check_shape,
+    complex,
+    disable_signal_handler,
+    floor_mod,
+    frexp,
+    gcd,
+    iinfo,
+    imag,
+    is_complex,
+    is_floating_point,
+    is_integer,
+    lcm,
+    nanquantile,
+    poisson,
+    randint_like,
+    rank,
+    set_printoptions,
+    sgn,
+    shape,
+    shard_index,
+    take,
+    tolist,
+    tril_indices,
+    triu_indices,
+    vsplit,
+)
 from .ops.linalg import (  # noqa: F401
     bincount,
     cholesky,
@@ -359,6 +400,54 @@ from . import geometric  # noqa: F401
 from . import text  # noqa: F401
 
 from .framework.io import load, save  # noqa: F401
+from .parallel.data_parallel import DataParallel  # noqa: F401
+from .nn import ParamAttr  # noqa: F401
+from .static import (  # noqa: F401
+    disable_static,
+    enable_static,
+    in_dynamic_mode,
+)
+from .core.place import CUDAPinnedPlace, NPUPlace  # noqa: F401
+from .ops.extras import _make_inplace, crop  # noqa: F401
+
+reshape_ = _make_inplace("reshape_", reshape)
+squeeze_ = _make_inplace("squeeze_", squeeze)
+unsqueeze_ = _make_inplace("unsqueeze_", unsqueeze)
+tanh_ = _make_inplace("tanh_", tanh)
+scatter_ = _make_inplace("scatter_", scatter)
+
+
+def summary(net, input_size, dtypes=None):
+    """Layer-table summary of a network (reference paddle.summary over
+    hapi; delegates to Model.summary / flops hooks)."""
+    from .hapi.model import Model
+
+    if isinstance(net, Model):
+        return net.summary(input_size, dtypes)
+    return Model(net).summary(input_size, dtypes)
+
+
+class LazyGuard:
+    """reference fluid/lazy_init.py LazyGuard: defers parameter
+    materialization. Param init here is already lazy-cheap (jax arrays
+    materialize on first use), so the guard is a documented no-op scope."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def get_cuda_rng_state():
+    """No CUDA generators on this stack; returns the framework RNG state
+    so save/restore pairs still round-trip (documented deviation)."""
+    return get_rng_state()
+
+
+def set_cuda_rng_state(state):
+    set_rng_state(state)
+
 from .hapi import Model  # noqa: F401
 from .hapi.dynamic_flops import flops  # noqa: F401
 from .nn.layer import set_grad_enabled  # noqa: F401

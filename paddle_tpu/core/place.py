@@ -132,6 +132,25 @@ def _get_current_place() -> Place:
 _custom_devices = {}
 
 
+class CUDAPinnedPlace(Place):
+    """API-compat shim: pinned host memory is a CUDA transfer concept;
+    PJRT host buffers play that role here."""
+
+    device_type = "cpu"
+
+    def __init__(self):
+        super().__init__(0)
+
+
+class NPUPlace(Place):
+    """API-compat shim (reference NPU vendor place; no such backend)."""
+
+    device_type = "npu"
+
+    def __init__(self, device_id=0):
+        super().__init__(device_id)
+
+
 class CustomPlace(Place):
     """reference phi::CustomPlace (plugin device placement)."""
 

@@ -159,3 +159,27 @@ def ClipGradByValue(max, min=None):
 from . import utils  # noqa: F401
 from .layers.common import Fold, Unflatten  # noqa: F401,E402
 from .decode import BeamSearchDecoder, Decoder, dynamic_decode  # noqa: F401,E402
+from .layers.pooling import (  # noqa: F401,E402
+    AdaptiveAvgPool3D,
+    AdaptiveMaxPool3D,
+    MaxUnPool1D,
+    MaxUnPool2D,
+    MaxUnPool3D,
+)
+from .layers.common import (  # noqa: F401,E402
+    ChannelShuffle,
+    PixelUnshuffle,
+    UpsamplingBilinear2D,
+    UpsamplingNearest2D,
+    ZeroPad2D,
+)
+from .layers.activation import RReLU, Softmax2D  # noqa: F401,E402
+from .layers.loss import (  # noqa: F401,E402
+    HSigmoidLoss,
+    MultiLabelSoftMarginLoss,
+    MultiMarginLoss,
+    PairwiseDistance,
+    RNNTLoss,
+    SoftMarginLoss,
+    TripletMarginWithDistanceLoss,
+)

@@ -206,16 +206,6 @@ def log_sigmoid(x, name=None):
 
 
 def _inplace(fn):
-    def op(x, *args, **kwargs):
-        out = fn(x, *args, **kwargs)
-        from ...core.tensor import Tensor
+    from ...ops.extras import _make_inplace
 
-        if isinstance(x, Tensor):
-            x._value = out._value if isinstance(out, Tensor) else out
-            return x
-        return out
-
-    op.__name__ = fn.__name__ + "_"
-    op.__doc__ = ("In-place variant of %s (reference *_ ops mutate the "
-                  "input Tensor)." % fn.__name__)
-    return op
+    return _make_inplace(fn.__name__ + "_", fn)

@@ -310,8 +310,13 @@ def adaptive_avg_pool3d(x, output_size, data_format="NCDHW"):
     """reference adaptive_avg_pool3d (pool_kernel.h adaptive path)."""
     x = _A(x)
     out = _norm(output_size, 3)
-    return _adaptive_pool_nd(x, list(out), data_format == "NDHWC",
-                             "avg", 3)
+    channel_last = data_format == "NDHWC"
+    sp = (x.shape[1:4] if channel_last else x.shape[2:5])
+    if all(sp[i] % out[i] == 0 for i in range(3)):
+        # divisible: one strided reduce-window instead of prod(out) slices
+        ks = tuple(sp[i] // out[i] for i in range(3))
+        return _avg_pool(x, ks, ks, 0, 3, False, channel_last)
+    return _adaptive_pool_nd(x, list(out), channel_last, "avg", 3)
 
 
 @primitive
@@ -325,8 +330,13 @@ def adaptive_max_pool3d(x, output_size, return_mask=False,
         raise NotImplementedError(
             "adaptive_max_pool3d(return_mask=True): indices for the "
             "variable-window 3d path are not provided; use max_pool3d")
-    return _adaptive_pool_nd(x, list(out), data_format == "NDHWC",
-                             "max", 3)
+    channel_last = data_format == "NDHWC"
+    sp = (x.shape[1:4] if channel_last else x.shape[2:5])
+    if all(sp[i] % out[i] == 0 for i in range(3)):
+        ks = tuple(sp[i] // out[i] for i in range(3))
+        return _pool(x, ks, ks, 0, 3, jax.lax.max, -jnp.inf,
+                     channel_last).astype(x.dtype)
+    return _adaptive_pool_nd(x, list(out), channel_last, "max", 3)
 
 
 def _max_unpool_nd(x, indices, spatial_out):

@@ -256,3 +256,23 @@ import jax.numpy as jnp  # noqa: E402
 @primitive(name="log_sigmoid")
 def _log_sigmoid(x):
     return jax.nn.log_sigmoid(jnp.asarray(x))
+
+class RReLU(Layer):
+    """reference nn RReLU: random slope in [lower, upper] when training,
+    their mean in eval."""
+
+    def __init__(self, lower=0.125, upper=1.0 / 3.0, name=None):
+        super().__init__()
+        self._lower, self._upper = lower, upper
+
+    def forward(self, x):
+        return F.rrelu(x, self._lower, self._upper,
+                       training=self.training)
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel dim of NCHW inputs (reference nn
+    Softmax2D)."""
+
+    def forward(self, x):
+        return F.softmax(x, axis=-3)

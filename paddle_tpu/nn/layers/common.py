@@ -235,3 +235,62 @@ class Unflatten(Layer):
         ax = self._axis if self._axis >= 0 else self._axis + len(s)
         new = s[:ax] + self._shape + s[ax + 1:]
         return ops.manipulation.reshape(x, new)
+
+class ChannelShuffle(Layer):
+    """reference nn ChannelShuffle."""
+
+    def __init__(self, groups, data_format="NCHW", name=None):
+        super().__init__()
+        self._args = (groups, data_format)
+
+    def forward(self, x):
+        return F.channel_shuffle(x, *self._args)
+
+
+class PixelUnshuffle(Layer):
+    """reference nn PixelUnshuffle."""
+
+    def __init__(self, downscale_factor, data_format="NCHW", name=None):
+        super().__init__()
+        self._args = (downscale_factor, data_format)
+
+    def forward(self, x):
+        return F.pixel_unshuffle(x, *self._args)
+
+
+class ZeroPad2D(Layer):
+    """reference nn ZeroPad2D."""
+
+    def __init__(self, padding, data_format="NCHW", name=None):
+        super().__init__()
+        self._args = (padding, data_format)
+
+    def forward(self, x):
+        return F.zeropad2d(x, *self._args)
+
+
+class UpsamplingBilinear2D(Layer):
+    """reference nn UpsamplingBilinear2D."""
+
+    def __init__(self, size=None, scale_factor=None,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self._kw = dict(size=size, scale_factor=scale_factor,
+                        mode="bilinear", align_corners=True,
+                        data_format=data_format)
+
+    def forward(self, x):
+        return F.interpolate(x, **self._kw)
+
+
+class UpsamplingNearest2D(Layer):
+    """reference nn UpsamplingNearest2D."""
+
+    def __init__(self, size=None, scale_factor=None,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self._kw = dict(size=size, scale_factor=scale_factor,
+                        mode="nearest", data_format=data_format)
+
+    def forward(self, x):
+        return F.interpolate(x, **self._kw)

@@ -162,3 +162,102 @@ class CTCLoss(Layer):
         return F.ctc_loss_dense(log_probs, labels, input_lengths,
                                 label_lengths, blank=self.blank,
                                 reduction=self.reduction)
+
+class SoftMarginLoss(Layer):
+    """reference nn SoftMarginLoss."""
+
+    def __init__(self, reduction="mean", name=None):
+        super().__init__()
+        self._reduction = reduction
+
+    def forward(self, input, label):
+        return F.soft_margin_loss(input, label, reduction=self._reduction)
+
+
+class MultiLabelSoftMarginLoss(Layer):
+    """reference nn MultiLabelSoftMarginLoss."""
+
+    def __init__(self, weight=None, reduction="mean", name=None):
+        super().__init__()
+        self._weight, self._reduction = weight, reduction
+
+    def forward(self, input, label):
+        return F.multi_label_soft_margin_loss(
+            input, label, weight=self._weight, reduction=self._reduction)
+
+
+class MultiMarginLoss(Layer):
+    """reference nn MultiMarginLoss."""
+
+    def __init__(self, p=1, margin=1.0, weight=None, reduction="mean",
+                 name=None):
+        super().__init__()
+        self._args = (p, margin, weight, reduction)
+
+    def forward(self, input, label):
+        return F.multi_margin_loss(input, label, p=self._args[0],
+                                   margin=self._args[1],
+                                   weight=self._args[2],
+                                   reduction=self._args[3])
+
+
+class PairwiseDistance(Layer):
+    """reference nn PairwiseDistance."""
+
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self._args = (p, epsilon, keepdim)
+
+    def forward(self, x, y):
+        return F.pairwise_distance(x, y, *self._args)
+
+
+class TripletMarginWithDistanceLoss(Layer):
+    """reference nn TripletMarginWithDistanceLoss."""
+
+    def __init__(self, distance_function=None, margin=1.0, swap=False,
+                 reduction="mean", name=None):
+        super().__init__()
+        self._kw = dict(distance_function=distance_function, margin=margin,
+                       swap=swap, reduction=reduction)
+
+    def forward(self, input, positive, negative):
+        return F.triplet_margin_with_distance_loss(
+            input, positive, negative, **self._kw)
+
+
+class RNNTLoss(Layer):
+    """reference nn RNNTLoss."""
+
+    def __init__(self, blank=0, fastemit_lambda=0.0, reduction="mean",
+                 name=None):
+        super().__init__()
+        self._kw = dict(blank=blank, fastemit_lambda=fastemit_lambda,
+                        reduction=reduction)
+
+    def forward(self, input, label, input_lengths, label_lengths):
+        return F.rnnt_loss(input, label, input_lengths, label_lengths,
+                           **self._kw)
+
+
+class HSigmoidLoss(Layer):
+    """reference nn HSigmoidLoss over F.hsigmoid_loss: owns the
+    path-weight table params."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        from .. import initializer as I
+
+        self.num_classes = num_classes
+        self.weight = self.create_parameter(
+            [num_classes - 1, feature_size], attr=weight_attr,
+            default_initializer=None if weight_attr else I.XavierNormal())
+        self.bias = (None if bias_attr is False else self.create_parameter(
+            [num_classes - 1], attr=bias_attr, is_bias=True))
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        return F.hsigmoid_loss(input, label, self.num_classes, self.weight,
+                               bias=self.bias, path_table=path_table,
+                               path_code=path_code)

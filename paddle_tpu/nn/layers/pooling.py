@@ -117,3 +117,64 @@ class AdaptiveMaxPool2D(Layer):
 
     def forward(self, x):
         return F.adaptive_max_pool2d(x, self.output_size)
+
+class AdaptiveAvgPool3D(Layer):
+    """reference nn/layer/pooling.py AdaptiveAvgPool3D."""
+
+    def __init__(self, output_size, data_format="NCDHW", name=None):
+        super().__init__()
+        self._args = (output_size, data_format)
+
+    def forward(self, x):
+        return F.adaptive_avg_pool3d(x, *self._args)
+
+
+class AdaptiveMaxPool3D(Layer):
+    """reference nn/layer/pooling.py AdaptiveMaxPool3D."""
+
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__()
+        self._args = (output_size, return_mask)
+
+    def forward(self, x):
+        return F.adaptive_max_pool3d(x, *self._args)
+
+
+class MaxUnPool1D(Layer):
+    """reference nn/layer/pooling.py MaxUnPool1D."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+        super().__init__()
+        self._args = (kernel_size, stride, padding, data_format,
+                      output_size)
+
+    def forward(self, x, indices):
+        return F.max_unpool1d(x, indices, *self._args)
+
+
+class MaxUnPool2D(Layer):
+    """reference nn/layer/pooling.py MaxUnPool2D."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+        super().__init__()
+        self._args = (kernel_size, stride, padding, data_format,
+                      output_size)
+
+    def forward(self, x, indices):
+        return F.max_unpool2d(x, indices, self._args[0], self._args[1],
+                              self._args[2], self._args[3], self._args[4])
+
+
+class MaxUnPool3D(Layer):
+    """reference nn/layer/pooling.py MaxUnPool3D."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+        super().__init__()
+        self._args = (kernel_size, stride, padding, data_format,
+                      output_size)
+
+    def forward(self, x, indices):
+        return F.max_unpool3d(x, indices, *self._args)

@@ -1,0 +1,296 @@
+"""Top-level tensor-API completions.
+
+Parity: the remaining reference `paddle.*` __all__ names (python/paddle/
+__init__.py) — complex views, integer math, index grids, sharding
+helpers, and the in-place spellings. Each cites its reference module.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import primitive
+from ..core.tensor import Tensor
+
+_A = jnp.asarray
+
+
+def _v(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+@primitive
+def add_n(inputs):
+    """Sum a list of same-shape tensors (reference tensor/math.py add_n)."""
+    vals = [jnp.asarray(i) for i in (inputs if isinstance(
+        inputs, (list, tuple)) else [inputs])]
+    out = vals[0]
+    for v in vals[1:]:
+        out = out + v
+    return out
+
+
+@primitive
+def angle(x):
+    """reference tensor/math.py angle (complex argument; sign for reals)."""
+    return jnp.angle(_A(x))
+
+
+@primitive
+def as_complex(x):
+    """[..., 2] float -> [...] complex (reference tensor/manipulation.py
+    as_complex)."""
+    v = _A(x)
+    return jax.lax.complex(v[..., 0], v[..., 1])
+
+
+@primitive
+def as_real(x):
+    """[...] complex -> [..., 2] float (reference as_real)."""
+    v = _A(x)
+    return jnp.stack([jnp.real(v), jnp.imag(v)], axis=-1)
+
+
+@primitive
+def complex(real, imag):  # noqa: A001
+    """reference tensor/creation.py complex."""
+    return jax.lax.complex(_A(real).astype(jnp.float32),
+                           _A(imag).astype(jnp.float32))
+
+
+@primitive
+def imag(x):
+    return jnp.imag(_A(x))
+
+
+@primitive
+def sgn(x):
+    """Complex-aware sign: x/|x| for complex, sign(x) for real
+    (reference tensor/math.py sgn)."""
+    v = _A(x)
+    if jnp.issubdtype(v.dtype, jnp.complexfloating):
+        mag = jnp.abs(v)
+        return jnp.where(mag == 0, 0, v / jnp.where(mag == 0, 1, mag))
+    return jnp.sign(v)
+
+
+def broadcast_shape(x_shape, y_shape):
+    """reference tensor/manipulation.py broadcast_shape."""
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+@primitive
+def floor_mod(x, y):
+    """Alias of mod (reference exposes both spellings)."""
+    return jnp.mod(_A(x), _A(y))
+
+
+@primitive
+def frexp(x):
+    """Mantissa/exponent decomposition (reference tensor/math.py frexp):
+    x = m * 2**e with 0.5 <= |m| < 1."""
+    m, e = jnp.frexp(_A(x))
+    return m, e.astype(jnp.int32)
+
+
+@primitive
+def gcd(x, y):
+    return jnp.gcd(_A(x), _A(y))
+
+
+@primitive
+def lcm(x, y):
+    return jnp.lcm(_A(x), _A(y))
+
+
+@primitive
+def nanquantile(x, q, axis=None, keepdim=False):
+    """reference tensor/stat.py nanquantile."""
+    return jnp.nanquantile(_A(x).astype(jnp.float32), q, axis=axis,
+                           keepdims=keepdim)
+
+
+@primitive(nondiff=True)
+def poisson(x):
+    """Per-element Poisson draws with rate x (reference tensor/random.py
+    poisson)."""
+    from ..framework import random as _random
+
+    key = _random.next_key()
+    return jax.random.poisson(key, _A(x)).astype(_A(x).dtype)
+
+
+@primitive(nondiff=True)
+def randint_like(x, low=0, high=None, dtype=None):
+    """reference tensor/creation.py randint_like."""
+    from ..framework import random as _random
+
+    v = _A(x)
+    if high is None:
+        low, high = 0, low
+    key = _random.next_key()
+    # reference randint_like: result dtype follows x (float inputs get
+    # float results) unless overridden
+    out_dtype = jnp.dtype(dtype) if dtype is not None else v.dtype
+    return jax.random.randint(key, v.shape, low, high).astype(out_dtype)
+
+
+@primitive
+def take(x, index, mode="raise"):
+    """Flat-index gather (reference tensor/math.py take): mode 'raise'
+    validates eagerly (concrete indices only), 'wrap'/'clip' follow
+    numpy semantics."""
+    v = _A(x).reshape(-1)
+    idx = _A(index).astype(jnp.int32)
+    n = v.shape[0]
+    if mode == "wrap":
+        idx = ((idx % n) + n) % n
+    elif mode == "clip":
+        idx = jnp.clip(idx, 0, n - 1)
+    elif mode == "raise":
+        try:
+            bad = bool(((idx < -n) | (idx >= n)).any())
+        except jax.errors.TracerBoolConversionError:
+            bad = False  # traced: cannot validate; clamp like XLA gather
+        if bad:
+            raise IndexError(
+                "take(mode='raise'): index out of range for %d elements"
+                % n)
+        idx = jnp.where(idx < 0, idx + n, idx)
+    else:
+        raise ValueError("take: unknown mode %r" % (mode,))
+    return v[idx]
+
+
+def tril_indices(row, col=None, offset=0, dtype="int64"):
+    """reference tensor/creation.py tril_indices -> [2, n] tensor."""
+    col = col if col is not None else row
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    col = col if col is not None else row
+    r, c = np.triu_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype))
+
+
+def vsplit(x, num_or_sections):
+    """Split along dim 0 (reference tensor/manipulation.py vsplit);
+    delegates to split, which already resolves -1 ('rest') sections."""
+    from .manipulation import split
+
+    return split(x, num_or_sections, axis=0)
+
+
+@primitive
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    """Relabel class ids to a shard-local range (reference
+    tensor/manipulation.py:577): ids inside shard_id's range become
+    id - shard_id*shard_size, others ignore_value."""
+    if not 0 <= shard_id < nshards:
+        raise ValueError(
+            "shard_id (%d) must be in [0, %d)" % (shard_id, nshards))
+    v = _A(input)
+    shard_size = (index_num + nshards - 1) // nshards
+    lo = shard_id * shard_size
+    hi = lo + shard_size
+    inside = (v >= lo) & (v < hi)
+    return jnp.where(inside, v - lo, ignore_value)
+
+
+def shape(x):
+    """Shape as an int32 tensor (reference tensor/attribute.py shape —
+    the op form, not the python list property)."""
+    return Tensor(jnp.asarray(_v(x).shape, jnp.int32))
+
+
+def rank(x):
+    return Tensor(jnp.asarray(_v(x).ndim))
+
+
+def is_complex(x):
+    return bool(jnp.issubdtype(_v(x).dtype, jnp.complexfloating))
+
+
+def is_floating_point(x):
+    return bool(jnp.issubdtype(_v(x).dtype, jnp.floating))
+
+
+def is_integer(x):
+    return bool(jnp.issubdtype(_v(x).dtype, jnp.integer))
+
+
+def tolist(x):
+    """reference tensor/manipulation.py tolist."""
+    return np.asarray(_v(x)).tolist()
+
+
+def iinfo(dtype):
+    """reference paddle.iinfo over the int dtypes."""
+    return jnp.iinfo(jnp.dtype(dtype))
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """reference paddle.set_printoptions: display knobs for printed
+    tensors (host-side numpy printing here)."""
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)
+
+
+def check_shape(shape):  # noqa: A002
+    """Validate a shape spec (reference fluid/data_feeder.py:185):
+    entries must be ints (or -1 for deferred dims)."""
+    for s in shape:
+        if not isinstance(s, (int, np.integer)):
+            raise TypeError(
+                "shape entries must be integers, got %r" % (s,))
+        if s < -1 or s == 0:
+            raise ValueError(
+                "shape entries must be positive or -1, got %d" % s)
+    return True
+
+
+@primitive
+def crop(x, shape=None, offsets=None, name=None):
+    """Slice a sub-box (reference tensor/creation.py crop / phi
+    crop_kernel): offsets default 0, shape entries -1 mean 'to the
+    end'."""
+    v = _A(x)
+    shp = list(shape) if shape is not None else list(v.shape)
+    offs = list(offsets) if offsets is not None else [0] * v.ndim
+    sizes = [v.shape[i] - offs[i] if shp[i] == -1 else shp[i]
+             for i in range(v.ndim)]
+    return jax.lax.dynamic_slice(v, offs, sizes)
+
+
+def disable_signal_handler():
+    """reference paddle.disable_signal_handler: the TPU runtime installs
+    no custom signal handlers, so this is a documented no-op."""
+
+
+def _make_inplace(fn_name, fn):
+    def op(x, *args, **kwargs):
+        out = fn(x, *args, **kwargs)
+        if isinstance(x, Tensor):
+            x._value = out._value if isinstance(out, Tensor) else _A(out)
+            return x
+        return out
+
+    op.__name__ = fn_name
+    op.__doc__ = ("In-place spelling of %s (reference *_ ops mutate "
+                  "the input Tensor)." % fn_name.rstrip("_"))
+    return op

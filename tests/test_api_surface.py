@@ -1,0 +1,145 @@
+"""API-surface parity gates: every name in the reference's top-level
+paddle __all__ and nn __all__ resolves here (regression gate — the
+analog of the op-coverage gate at the python-API level)."""
+import re
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+REF = "/root/reference/python/paddle"
+
+
+def _ref_all(path):
+    src = open(path).read()
+    return sorted(set(re.findall(r"^\s+'(\w+)',", src, re.M)))
+
+
+class TestSurfaceGates:
+    def test_top_level_all_resolves(self):
+        missing = [n for n in _ref_all(REF + "/__init__.py")
+                   if not hasattr(paddle, n)]
+        assert missing == [], missing
+
+    def test_nn_all_resolves(self):
+        missing = [n for n in _ref_all(REF + "/nn/__init__.py")
+                   if not hasattr(nn, n)]
+        assert missing == [], missing
+
+    def test_nn_functional_all_resolves(self):
+        import paddle_tpu.nn.functional as F
+
+        missing = [n for n in _ref_all(REF + "/nn/functional/__init__.py")
+                   if not hasattr(F, n)]
+        assert missing == [], missing
+
+
+class TestExtrasSemantics:
+    def test_complex_family(self):
+        c = paddle.complex(
+            paddle.to_tensor(np.asarray([3.0], np.float32)),
+            paddle.to_tensor(np.asarray([4.0], np.float32)))
+        assert paddle.is_complex(c)
+        np.testing.assert_allclose(np.asarray(paddle.as_real(c)._value),
+                                   [[3.0, 4.0]])
+        np.testing.assert_allclose(
+            np.asarray(paddle.angle(c)._value), [np.arctan2(4, 3)],
+            rtol=1e-6)
+        s = paddle.sgn(c)
+        np.testing.assert_allclose(np.asarray(paddle.as_real(s)._value),
+                                   [[0.6, 0.8]], rtol=1e-6)
+        back = paddle.as_complex(paddle.as_real(c))
+        np.testing.assert_allclose(np.asarray(paddle.imag(back)._value),
+                                   [4.0])
+
+    def test_integer_math_and_indices(self):
+        g = paddle.gcd(paddle.to_tensor(np.asarray([12], np.int32)),
+                       paddle.to_tensor(np.asarray([18], np.int32)))
+        assert int(np.asarray(g._value)[0]) == 6
+        l = paddle.lcm(paddle.to_tensor(np.asarray([4], np.int32)),
+                       paddle.to_tensor(np.asarray([6], np.int32)))
+        assert int(np.asarray(l._value)[0]) == 12
+        tl = np.asarray(paddle.tril_indices(3)._value)
+        np.testing.assert_array_equal(tl, np.stack(np.tril_indices(3)))
+
+    def test_take_and_shard_index(self):
+        x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+        out = paddle.take(x, paddle.to_tensor(
+            np.asarray([0, 5, -1], np.int64)))
+        np.testing.assert_allclose(np.asarray(out._value), [0.0, 5.0, 5.0])
+        wrapped = paddle.take(x, paddle.to_tensor(
+            np.asarray([7], np.int64)), mode="wrap")
+        np.testing.assert_allclose(np.asarray(wrapped._value), [1.0])
+        s = paddle.shard_index(
+            paddle.to_tensor(np.asarray([3, 9], np.int64)), 10, 2, 0)
+        np.testing.assert_array_equal(np.asarray(s._value), [3, -1])
+
+    def test_inplace_spellings(self):
+        x = paddle.to_tensor(np.asarray([[1.0, 2.0]], np.float32))
+        y = paddle.reshape_(x, [2, 1])
+        assert y is x and x.shape == [2, 1]
+        t = paddle.tanh_(x)
+        assert t is x
+        u = paddle.unsqueeze_(x, 0)
+        assert u is x and x.shape == [1, 2, 1]
+
+    def test_misc(self):
+        assert paddle.broadcast_shape([2, 1, 3], [4, 3]) == [2, 4, 3]
+        assert paddle.iinfo("int32").max == 2**31 - 1
+        with pytest.raises(ValueError):
+            paddle.check_shape([2, 0])
+        paddle.check_shape([-1, 3])
+        v = paddle.vsplit(paddle.to_tensor(
+            np.arange(6, dtype=np.float32).reshape(6, 1)), 3)
+        assert len(v) == 3 and v[0].shape == [2, 1]
+        p = paddle.poisson(paddle.to_tensor(
+            np.full((100,), 4.0, np.float32)))
+        assert 2.0 < float(np.asarray(p._value).mean()) < 6.0
+        r = paddle.randint_like(paddle.to_tensor(
+            np.zeros((10,), np.int32)), 5)
+        assert (np.asarray(r._value) < 5).all()
+        c = paddle.crop(paddle.to_tensor(
+            np.arange(9, dtype=np.float32).reshape(3, 3)),
+            shape=[2, -1], offsets=[1, 0])
+        assert c.shape == [2, 3]
+        m, e = paddle.frexp(paddle.to_tensor(np.asarray([8.0], np.float32)))
+        np.testing.assert_allclose(np.asarray(m._value), [0.5])
+
+
+class TestExtrasFixRegressions:
+    def test_take_raise_mode_raises(self):
+        x = paddle.to_tensor(np.arange(6, dtype=np.float32))
+        with pytest.raises(IndexError):
+            paddle.take(x, paddle.to_tensor(np.asarray([100], np.int64)))
+        with pytest.raises(ValueError):
+            paddle.take(x, paddle.to_tensor(np.asarray([0], np.int64)),
+                        mode="bogus")
+
+    def test_vsplit_rest_section(self):
+        x = paddle.to_tensor(np.arange(8, dtype=np.float32).reshape(8, 1))
+        a, b, c = paddle.vsplit(x, [2, -1, 2])
+        assert a.shape == [2, 1] and b.shape == [4, 1] and c.shape == [2, 1]
+
+    def test_randint_like_preserves_float_dtype(self):
+        r = paddle.randint_like(
+            paddle.to_tensor(np.zeros((4,), np.float32)), 5)
+        assert str(r.dtype).endswith("float32")
+
+    def test_place_shims_instantiate(self):
+        p = paddle.CUDAPinnedPlace()
+        assert p.device_type == "cpu"
+        n = paddle.NPUPlace(0)
+        assert n.device_type == "npu"
+
+    def test_adaptive3d_fast_path_matches_general(self):
+        import paddle_tpu.nn.functional as F
+
+        xv = np.random.RandomState(0).randn(1, 2, 4, 4, 4) \
+            .astype(np.float32)
+        fast = np.asarray(F.adaptive_avg_pool3d(
+            paddle.to_tensor(xv), 2)._value)
+        # numpy oracle: mean over each 2x2x2 block
+        ref = xv.reshape(1, 2, 2, 2, 2, 2, 2, 2).mean(axis=(3, 5, 7))
+        np.testing.assert_allclose(fast, ref, rtol=1e-5)
