@@ -312,8 +312,10 @@ def adaptive_avg_pool3d(x, output_size, data_format="NCDHW"):
     out = _norm(output_size, 3)
     channel_last = data_format == "NDHWC"
     sp = (x.shape[1:4] if channel_last else x.shape[2:5])
-    if all(sp[i] % out[i] == 0 for i in range(3)):
+    if (jnp.issubdtype(x.dtype, jnp.floating)
+            and all(sp[i] % out[i] == 0 for i in range(3))):
         # divisible: one strided reduce-window instead of prod(out) slices
+        # (float only — the window init values are float)
         ks = tuple(sp[i] // out[i] for i in range(3))
         return _avg_pool(x, ks, ks, 0, 3, False, channel_last)
     return _adaptive_pool_nd(x, list(out), channel_last, "avg", 3)
@@ -332,7 +334,8 @@ def adaptive_max_pool3d(x, output_size, return_mask=False,
             "variable-window 3d path are not provided; use max_pool3d")
     channel_last = data_format == "NDHWC"
     sp = (x.shape[1:4] if channel_last else x.shape[2:5])
-    if all(sp[i] % out[i] == 0 for i in range(3)):
+    if (jnp.issubdtype(x.dtype, jnp.floating)
+            and all(sp[i] % out[i] == 0 for i in range(3))):
         ks = tuple(sp[i] // out[i] for i in range(3))
         return _pool(x, ks, ks, 0, 3, jax.lax.max, -jnp.inf,
                      channel_last).astype(x.dtype)

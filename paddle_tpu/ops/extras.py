@@ -21,21 +21,10 @@ def _v(x):
     return x._value if isinstance(x, Tensor) else jnp.asarray(x)
 
 
-@primitive
-def add_n(inputs):
-    """Sum a list of same-shape tensors (reference tensor/math.py add_n)."""
-    vals = [jnp.asarray(i) for i in (inputs if isinstance(
-        inputs, (list, tuple)) else [inputs])]
-    out = vals[0]
-    for v in vals[1:]:
-        out = out + v
-    return out
-
-
-@primitive
-def angle(x):
-    """reference tensor/math.py angle (complex argument; sign for reals)."""
-    return jnp.angle(_A(x))
+# add_n / angle / gcd / lcm / imag are long-registered primitives in
+# ops/math.py — re-exported here so the extras module mirrors the
+# reference tensor-API file layout without double-registering
+from .math import add_n, angle, gcd, imag, lcm  # noqa: F401
 
 
 @primitive
@@ -58,11 +47,6 @@ def complex(real, imag):  # noqa: A001
     """reference tensor/creation.py complex."""
     return jax.lax.complex(_A(real).astype(jnp.float32),
                            _A(imag).astype(jnp.float32))
-
-
-@primitive
-def imag(x):
-    return jnp.imag(_A(x))
 
 
 @primitive
@@ -93,16 +77,6 @@ def frexp(x):
     x = m * 2**e with 0.5 <= |m| < 1."""
     m, e = jnp.frexp(_A(x))
     return m, e.astype(jnp.int32)
-
-
-@primitive
-def gcd(x, y):
-    return jnp.gcd(_A(x), _A(y))
-
-
-@primitive
-def lcm(x, y):
-    return jnp.lcm(_A(x), _A(y))
 
 
 @primitive
@@ -274,6 +248,13 @@ def crop(x, shape=None, offsets=None, name=None):
     offs = list(offsets) if offsets is not None else [0] * v.ndim
     sizes = [v.shape[i] - offs[i] if shp[i] == -1 else shp[i]
              for i in range(v.ndim)]
+    for i in range(v.ndim):
+        if offs[i] + sizes[i] > v.shape[i]:
+            # dynamic_slice would silently clamp the start — fail loud
+            # like the reference's offset+size <= dim check
+            raise ValueError(
+                "crop: offsets[%d] + shape[%d] (%d) exceeds input dim %d"
+                % (i, i, offs[i] + sizes[i], v.shape[i]))
     return jax.lax.dynamic_slice(v, offs, sizes)
 
 

@@ -963,3 +963,50 @@ class nn:
         if act:
             out = getattr(P.nn.functional, act)(out)
         return out
+
+# static.nn builder completions (nn_extras.py)
+from . import nn_extras as _nn_extras  # noqa: E402
+
+for _name in _nn_extras.__all__:
+    # class-attribute access on plain functions returns them unbound —
+    # the same shape as the hand-written builders above
+    setattr(nn, _name, getattr(_nn_extras, _name))
+del _name, _nn_extras
+
+# -- surface completions (places/guards/EMA/persistence/debug; extras.py) ----
+from ..core.tensor_array import global_scope, scope_guard  # noqa: E402,F401
+from .extras import (  # noqa: E402,F401
+    ExponentialMovingAverage,
+    IpuCompiledProgram,
+    IpuStrategy,
+    ParallelExecutor,
+    Print,
+    WeightNormParamAttr,
+    accuracy,
+    auc,
+    cpu_places,
+    create_global_var,
+    create_parameter,
+    ctr_metric_bundle,
+    cuda_places,
+    deserialize_persistables,
+    deserialize_program,
+    device_guard,
+    exponential_decay,
+    ipu_shard_guard,
+    load,
+    load_from_file,
+    load_program_state,
+    mlu_places,
+    name_scope,
+    normalize_program,
+    npu_places,
+    py_func,
+    save,
+    save_to_file,
+    serialize_persistables,
+    serialize_program,
+    set_ipu_shard,
+    set_program_state,
+    xpu_places,
+)

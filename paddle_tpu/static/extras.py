@@ -1,0 +1,382 @@
+"""static API completions: persistence, places, guards, EMA, debug ops.
+
+Parity: reference python/paddle/static/__init__.py surface beyond the
+core Program/Executor (implemented in static/__init__.py). Program
+serialization rides the same jax.export StableHLO path as
+save_inference_model; parameter state rides framework.io pickles.
+"""
+from __future__ import annotations
+
+import contextlib
+import pickle
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = [
+    "cpu_places", "cuda_places", "xpu_places", "npu_places", "mlu_places",
+    "device_guard", "name_scope", "create_global_var", "create_parameter",
+    "ExponentialMovingAverage", "WeightNormParamAttr", "Print", "py_func",
+    "accuracy", "auc", "ctr_metric_bundle", "exponential_decay",
+    "save", "load", "save_to_file", "load_from_file",
+    "serialize_program", "deserialize_program",
+    "serialize_persistables", "deserialize_persistables",
+    "load_program_state", "set_program_state", "normalize_program",
+    "ParallelExecutor", "IpuCompiledProgram", "IpuStrategy",
+    "ipu_shard_guard", "set_ipu_shard",
+]
+
+
+# -- places ------------------------------------------------------------------
+
+def cpu_places(device_count=None):
+    """reference static.cpu_places: list of CPUPlace."""
+    from ..core.place import CPUPlace
+
+    n = device_count or 1
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    """On the TPU stack the accelerator places are TPU devices."""
+    from ..core.place import TPUPlace
+    import jax
+
+    ids = device_ids if device_ids is not None \
+        else range(len(jax.devices()))
+    return [TPUPlace(i) for i in ids]
+
+
+def xpu_places(device_ids=None):
+    raise RuntimeError("no XPU backend on the TPU stack; use cuda_places "
+                       "(mapped to TPU devices) or cpu_places")
+
+
+def npu_places(device_ids=None):
+    raise RuntimeError("no NPU backend on the TPU stack")
+
+
+def mlu_places(device_ids=None):
+    raise RuntimeError("no MLU backend on the TPU stack")
+
+
+# -- guards ------------------------------------------------------------------
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    """reference static.device_guard: pins ops to a device in the
+    ProgramDesc. Under XLA the partitioner owns placement, so the guard
+    records intent only (documented deviation)."""
+    yield
+
+
+@contextlib.contextmanager
+def name_scope(prefix=None):
+    """reference static.name_scope: prefixes op names for debugging;
+    names here come from the op registry, so the scope is advisory."""
+    from ..utils import unique_name
+
+    with unique_name.guard((prefix or "scope") + "/"):
+        yield
+
+
+# -- vars --------------------------------------------------------------------
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    """reference static.create_global_var: a filled persistent Tensor."""
+    t = Tensor(jnp.full(shape, value, dtype))
+    t.name = name or "global_var"
+    t.persistable = persistable
+    t.stop_gradient = True
+    return t
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """reference static.create_parameter (delegates to the top-level
+    parameter factory the eager builders already use)."""
+    import paddle_tpu as P
+
+    return P.create_parameter(shape, dtype=dtype,
+                              default_initializer=default_initializer)
+
+
+class WeightNormParamAttr:
+    """reference static.WeightNormParamAttr: ParamAttr marker requesting
+    weight normalization; consumed by nn.utils.weight_norm here."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        self.dim = dim
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+
+
+# -- EMA ---------------------------------------------------------------------
+
+class ExponentialMovingAverage:
+    """reference static.ExponentialMovingAverage: shadow = decay*shadow +
+    (1-decay)*param, with apply()/restore() swap semantics."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._shadow = {}
+        self._backup = {}
+        self._params = []
+
+    def _register(self, parameters):
+        for p in parameters:
+            key = id(p)
+            if key not in self._shadow:
+                self._params.append(p)
+                self._shadow[key] = jnp.asarray(p._value)
+
+    def update(self, parameters=None):
+        """One EMA step over the given (or previously seen) params."""
+        if parameters is not None:
+            self._register(parameters)
+        d = self._decay
+        for p in self._params:
+            key = id(p)
+            self._shadow[key] = d * self._shadow[key] \
+                + (1.0 - d) * jnp.asarray(p._value)
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        """Swap shadow weights in (evaluation), restoring on exit."""
+        for p in self._params:
+            self._backup[id(p)] = p._value
+            p._value = self._shadow[id(p)]
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        for p in self._params:
+            if id(p) in self._backup:
+                p._value = self._backup.pop(id(p))
+
+
+# -- debug / misc ops --------------------------------------------------------
+
+def Print(input, first_n=-1, message=None, summarize=20,  # noqa: N802
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both"):
+    """reference static.Print: log a tensor's value as a side effect and
+    pass it through. Inside jit this lowers to jax.debug.print."""
+    import jax
+
+    v = input._value if isinstance(input, Tensor) else jnp.asarray(input)
+    jax.debug.print("{m} shape={s} value={v}",
+                    m=message or "", s=v.shape, v=v)
+    return input
+
+
+def py_func(func, x, out=None, backward_func=None, skip_vars_in_backward_input=None):
+    """reference static.py_func: run a python callable on tensors. The
+    eager/tape engines call python directly, so this is a checked
+    passthrough (backward_func unsupported: use PyLayer for custom vjp)."""
+    if backward_func is not None:
+        raise NotImplementedError(
+            "py_func backward_func: define a paddle_tpu.autograd.PyLayer "
+            "instead (custom vjp)")
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    return func(*xs)
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    """reference static.accuracy (metric op form)."""
+    from ..metric import accuracy as _acc
+
+    return _acc(input, label, k=k)
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1):
+    """reference static.auc: returns (auc_value, batch_stats...) — here
+    the scalar AUC over this batch via the streaming Auc metric."""
+    from ..metric import Auc
+
+    m = Auc(curve=curve, num_thresholds=num_thresholds)
+    m.update(np.asarray(input._value if isinstance(input, Tensor)
+                        else input),
+             np.asarray(label._value if isinstance(label, Tensor)
+                        else label))
+    return Tensor(jnp.asarray(m.accumulate()))
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):
+    """reference static.ctr_metric_bundle: (auc, abserr, sqrerr, prob,
+    q, pos, total) aggregate tensors for CTR training."""
+    pv = np.asarray(input._value if isinstance(input, Tensor) else input) \
+        .reshape(-1)
+    lv = np.asarray(label._value if isinstance(label, Tensor) else label) \
+        .reshape(-1).astype(np.float64)
+    abserr = np.abs(pv - lv).sum()
+    sqrerr = ((pv - lv) ** 2).sum()
+    prob = pv.sum()
+    pos = lv.sum()
+    total = float(lv.size)
+    auc_v = auc(Tensor(jnp.asarray(pv[:, None])),
+                Tensor(jnp.asarray(lv[:, None])))
+    return (auc_v, Tensor(jnp.asarray(abserr)), Tensor(jnp.asarray(sqrerr)),
+            Tensor(jnp.asarray(prob)), Tensor(jnp.asarray(prob / total)),
+            Tensor(jnp.asarray(pos)), Tensor(jnp.asarray(total)))
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    """fluid-era schedule fn → the modern scheduler object (reference
+    maps it the same way in 2.x)."""
+    from ..optimizer.lr import ExponentialDecay
+
+    return ExponentialDecay(learning_rate=learning_rate, gamma=decay_rate)
+
+
+# -- program persistence -----------------------------------------------------
+
+def _state_of(program):
+    from . import default_main_program
+
+    prog = program if program is not None else default_main_program()
+    return {name: np.asarray(t._value)
+            for name, t in getattr(prog, "params", {}).items()}
+
+
+def save(program, model_prefix, protocol=4):
+    """reference static.save: <prefix>.pdparams + <prefix>.pdmodel."""
+    state = program.state_dict() if hasattr(program, "state_dict") \
+        else _state_of(program)
+    with open(model_prefix + ".pdparams", "wb") as f:
+        pickle.dump(state, f, protocol=protocol)
+    with open(model_prefix + ".pdmodel", "wb") as f:
+        pickle.dump({"format": "paddle_tpu.program.state.v1"}, f,
+                    protocol=protocol)
+
+
+def load(program, model_prefix, executor=None, var_list=None):
+    """reference static.load: restore params saved by static.save."""
+    with open(model_prefix + ".pdparams", "rb") as f:
+        state = pickle.load(f)
+    set_program_state(program, state)
+    return state
+
+
+def load_program_state(model_prefix, var_list=None):
+    """reference static.load_program_state."""
+    with open(model_prefix + ".pdparams", "rb") as f:
+        return pickle.load(f)
+
+
+def set_program_state(program, state_dict):
+    """reference static.set_program_state: push a name->ndarray dict into
+    the program's parameters."""
+    if hasattr(program, "set_state_dict"):
+        program.set_state_dict(state_dict)
+        return
+    params = getattr(program, "params", None)
+    if params is None:
+        raise ValueError("program carries no parameter table")
+    for name, val in state_dict.items():
+        if name in params:
+            params[name]._value = jnp.asarray(val)
+
+
+def serialize_program(feed_vars, fetch_vars, program=None, **kwargs):
+    """reference static.serialize_program -> bytes (StableHLO module,
+    the same artifact save_inference_model writes)."""
+    from . import _export_program, default_main_program
+
+    prog = program if program is not None else default_main_program()
+    fetches = fetch_vars if isinstance(fetch_vars, (list, tuple)) \
+        else [fetch_vars]
+    feeds = feed_vars if isinstance(feed_vars, (list, tuple)) \
+        else [feed_vars]
+    return _export_program(prog, list(feeds), list(fetches))
+
+
+def deserialize_program(data):
+    """reference static.deserialize_program: bytes -> runnable program."""
+    from jax import export as jex
+
+    from . import InferenceProgram
+
+    return InferenceProgram(jex.deserialize(data),
+                            {"feed": [], "fetch": [],
+                             "format": "stablehlo.jax_export.v1"})
+
+
+def serialize_persistables(feed_vars, fetch_vars, program=None, **kwargs):
+    """reference static.serialize_persistables -> bytes."""
+    prog = program
+    state = prog.state_dict() if hasattr(prog, "state_dict") \
+        else _state_of(prog)
+    return pickle.dumps(state, protocol=4)
+
+
+def deserialize_persistables(program, data, executor=None):
+    """reference static.deserialize_persistables."""
+    set_program_state(program, pickle.loads(data))
+
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    """reference static.normalize_program: prune to the feed->fetch
+    closure. The tape Program replays exactly what was recorded between
+    feeds and fetches, so normalization is identity here (XLA DCEs the
+    rest at compile)."""
+    return program
+
+
+# -- n/a shims (vendor/executor machinery XLA replaces) ----------------------
+
+class ParallelExecutor:
+    """reference ParallelExecutor (legacy multi-GPU SSA executor,
+    SURVEY §2.2): superseded by SPMD sharding — construct refuses with
+    the modern path named."""
+
+    def __init__(self, *a, **k):
+        raise RuntimeError(
+            "ParallelExecutor is replaced by SPMD sharding: build a mesh "
+            "(paddle_tpu.distributed.mesh.build_hybrid_mesh) and run the "
+            "plain Executor / CompiledTrainStep")
+
+
+class IpuStrategy:
+    def __init__(self, *a, **k):
+        raise RuntimeError("no IPU backend on the TPU stack")
+
+
+class IpuCompiledProgram:
+    def __init__(self, *a, **k):
+        raise RuntimeError("no IPU backend on the TPU stack")
+
+
+@contextlib.contextmanager
+def ipu_shard_guard(index=-1, stage=-1):
+    raise RuntimeError("no IPU backend on the TPU stack")
+    yield
+
+
+def set_ipu_shard(call_func, index=-1, stage=-1):
+    raise RuntimeError("no IPU backend on the TPU stack")

@@ -1,0 +1,629 @@
+"""static.nn builder completions.
+
+Parity: reference python/paddle/static/nn/__init__.py — the fluid-era
+graph builders (conv/norm families, sequence_* ops, StaticRNN, nce,
+row_conv). Conventions:
+
+- builders create their own parameters (reference behavior) via
+  paddle.create_parameter and delegate math to the shared ops/F bodies;
+- the sequence_* family operated on LoDTensor; the TPU convention is
+  padded [B, T, ...] plus an explicit `lengths` tensor (SURVEY §7 "hard
+  parts": LoD → padding/bucketing). Each op documents its mapping; ops
+  whose output is ragged return the packed [sum(len), ...] form.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = [
+    "conv2d", "conv3d", "conv2d_transpose", "conv3d_transpose",
+    "deform_conv2d", "layer_norm", "group_norm", "instance_norm",
+    "data_norm", "spectral_norm", "prelu", "bilinear_tensor_product",
+    "nce", "row_conv", "StaticRNN", "py_func", "sparse_embedding",
+    "sequence_concat", "sequence_conv", "sequence_enumerate",
+    "sequence_expand", "sequence_expand_as", "sequence_first_step",
+    "sequence_last_step", "sequence_pad", "sequence_pool",
+    "sequence_reshape", "sequence_reverse", "sequence_scatter",
+    "sequence_slice", "sequence_softmax", "sequence_unpad",
+]
+
+
+def _v(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _P():
+    import paddle_tpu as P
+
+    return P
+
+
+# -- parameterized builders --------------------------------------------------
+
+def _act(out, act):
+    if act:
+        import paddle_tpu.nn.functional as F
+
+        return getattr(F, act)(out)
+    return out
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           act=None, name=None, data_format="NCHW"):
+    """reference static.nn.conv2d."""
+    import paddle_tpu.nn.functional as F
+
+    P = _P()
+    ks = filter_size if isinstance(filter_size, (list, tuple)) \
+        else [filter_size] * 2
+    cin = input.shape[1] if data_format == "NCHW" else input.shape[-1]
+    w = P.create_parameter([num_filters, cin // groups] + list(ks))
+    b = None if bias_attr is False else P.create_parameter([num_filters])
+    out = F.conv2d(input, w, bias=b, stride=stride, padding=padding,
+                   dilation=dilation, groups=groups,
+                   data_format=data_format)
+    return _act(out, act)
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           act=None, name=None, data_format="NCDHW"):
+    """reference static.nn.conv3d."""
+    import paddle_tpu.nn.functional as F
+
+    P = _P()
+    ks = filter_size if isinstance(filter_size, (list, tuple)) \
+        else [filter_size] * 3
+    cin = input.shape[1] if data_format == "NCDHW" else input.shape[-1]
+    w = P.create_parameter([num_filters, cin // groups] + list(ks))
+    b = None if bias_attr is False else P.create_parameter([num_filters])
+    out = F.conv3d(input, w, bias=b, stride=stride, padding=padding,
+                   dilation=dilation, groups=groups,
+                   data_format=data_format)
+    return _act(out, act)
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, act=None, name=None,
+                     data_format="NCHW"):
+    """reference static.nn.conv2d_transpose."""
+    import paddle_tpu.nn.functional as F
+
+    P = _P()
+    ks = filter_size if isinstance(filter_size, (list, tuple)) \
+        else [filter_size] * 2
+    cin = input.shape[1] if data_format == "NCHW" else input.shape[-1]
+    w = P.create_parameter([cin, num_filters // groups] + list(ks))
+    b = None if bias_attr is False else P.create_parameter([num_filters])
+    out = F.conv2d_transpose(input, w, bias=b, stride=stride,
+                             padding=padding, dilation=dilation,
+                             groups=groups, output_size=output_size,
+                             data_format=data_format)
+    return _act(out, act)
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, act=None, name=None,
+                     data_format="NCDHW"):
+    """reference static.nn.conv3d_transpose."""
+    import paddle_tpu.nn.functional as F
+
+    P = _P()
+    ks = filter_size if isinstance(filter_size, (list, tuple)) \
+        else [filter_size] * 3
+    cin = input.shape[1] if data_format == "NCDHW" else input.shape[-1]
+    w = P.create_parameter([cin, num_filters // groups] + list(ks))
+    b = None if bias_attr is False else P.create_parameter([num_filters])
+    out = F.conv3d_transpose(input, w, bias=b, stride=stride,
+                             padding=padding, dilation=dilation,
+                             groups=groups, output_size=output_size,
+                             data_format=data_format)
+    return _act(out, act)
+
+
+def deform_conv2d(input, offset, mask, num_filters, filter_size, stride=1,
+                  padding=0, dilation=1, groups=1, deformable_groups=1,
+                  im2col_step=1, param_attr=None, bias_attr=None,
+                  name=None):
+    """reference static.nn.deform_conv2d (v2 with mask)."""
+    import paddle_tpu.nn.functional as F
+
+    P = _P()
+    ks = filter_size if isinstance(filter_size, (list, tuple)) \
+        else [filter_size] * 2
+    cin = input.shape[1]
+    w = P.create_parameter([num_filters, cin // groups] + list(ks))
+    b = None if bias_attr is False else P.create_parameter([num_filters])
+    return F.deformable_conv(input, offset, w, mask=mask, bias=b,
+                             stride=stride, padding=padding,
+                             dilation=dilation, groups=groups,
+                             deformable_groups=deformable_groups)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    """reference static.nn.layer_norm: normalize over dims
+    [begin_norm_axis:]."""
+    import paddle_tpu.nn.functional as F
+
+    P = _P()
+    shape = [int(s) for s in input.shape[begin_norm_axis:]]
+    w = P.create_parameter(shape,
+                           default_initializer=None) if scale else None
+    if w is not None:
+        w._value = jnp.ones(shape, _v(input).dtype)
+    b = P.create_parameter(shape) if shift else None
+    if b is not None:
+        b._value = jnp.zeros(shape, _v(input).dtype)
+    out = F.layer_norm(input, shape, weight=w, bias=b, epsilon=epsilon)
+    return _act(out, act)
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None,
+               bias_attr=None, act=None, data_layout="NCHW", name=None):
+    """reference static.nn.group_norm."""
+    import paddle_tpu.nn as nn
+
+    gn = nn.GroupNorm(groups, input.shape[1], epsilon=epsilon)
+    return _act(gn(input), act)
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                  name=None):
+    """reference static.nn.instance_norm."""
+    import paddle_tpu.nn as nn
+
+    inorm = nn.InstanceNorm2D(input.shape[1], epsilon=epsilon)
+    return inorm(input)
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
+              data_layout="NCHW", in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=True, slot_dim=-1,
+              sync_stats=False, summary_decay_rate=0.9999999,
+              enable_scale_and_shift=False):
+    """reference static.nn.data_norm: normalization by RUNNING batch
+    statistics without learned scale/shift (CTR models); here the batch
+    statistics themselves (single-pass form)."""
+    v = _v(input)
+    mean = v.mean(axis=0, keepdims=True)
+    var = v.var(axis=0, keepdims=True)
+    out = (v - mean) / jnp.sqrt(var + epsilon)
+    return _act(Tensor(out), act)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """reference static.nn.spectral_norm: w / sigma_max(w) via power
+    iteration."""
+    w = _v(weight)
+    mat = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+    u = jnp.ones((mat.shape[0],), mat.dtype) / np.sqrt(mat.shape[0])
+    for _ in range(max(power_iters, 1)):
+        vvec = mat.T @ u
+        vvec = vvec / (jnp.linalg.norm(vvec) + eps)
+        u = mat @ vvec
+        u = u / (jnp.linalg.norm(u) + eps)
+    sigma = u @ mat @ vvec
+    return Tensor(w / (sigma + eps))
+
+
+def prelu(x, mode="all", param_attr=None, data_format="NCHW", name=None):
+    """reference static.nn.prelu: modes all/channel/element."""
+    import paddle_tpu.nn.functional as F
+
+    P = _P()
+    if mode == "all":
+        shape = [1]
+    elif mode == "channel":
+        shape = [x.shape[1] if data_format == "NCHW" else x.shape[-1]]
+    else:
+        shape = [int(s) for s in x.shape[1:]]
+    alpha = P.create_parameter(shape)
+    alpha._value = jnp.full(shape, 0.25, _v(x).dtype)
+    return F.prelu(x, alpha)
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    """reference static.nn.bilinear_tensor_product."""
+    import paddle_tpu.nn.functional as F
+
+    P = _P()
+    w = P.create_parameter([size, x.shape[1], y.shape[1]])
+    b = None if bias_attr is False else P.create_parameter([size])
+    return _act(F.bilinear(x, y, w, bias=b), act)
+
+
+def sparse_embedding(input, size, padding_idx=None, is_test=False,
+                     entry=None, table_class="MemorySparseTable",
+                     param_attr=None, dtype="float32"):
+    """reference static.nn.sparse_embedding (PS path): on the TPU stack
+    the PS-backed lookup is fleet.utils DistributedInfer's _PSEmbedding;
+    locally this is a plain embedding table."""
+    import paddle_tpu.nn.functional as F
+
+    P = _P()
+    w = P.create_parameter(list(size), dtype=dtype)
+    return F.embedding(input, w, padding_idx=padding_idx)
+
+
+def nce(input, label, num_total_classes, sample_weight=None,
+        param_attr=None, bias_attr=None, num_neg_samples=5, name=None,
+        sampler="uniform", custom_dist=None, seed=0, is_sparse=False):
+    """Noise-contrastive estimation loss (reference static.nn.nce):
+    binary logistic over the true class + sampled negatives (uniform or
+    custom sampler)."""
+    P = _P()
+    x = _v(input)
+    lbl = _v(label).astype(jnp.int32).reshape(-1)
+    n, d = x.shape
+    w = P.create_parameter([num_total_classes, d])
+    b = P.create_parameter([num_total_classes])
+    from ..framework import random as _random
+
+    key = _random.next_key()
+    if sampler == "custom_dist" and custom_dist is not None:
+        probs = jnp.asarray(custom_dist)
+        neg = jax.random.choice(key, num_total_classes,
+                                (n, num_neg_samples), p=probs)
+    else:
+        neg = jax.random.randint(key, (n, num_neg_samples), 0,
+                                 num_total_classes)
+    wv, bv = _v(w), _v(b)
+    pos_logit = jnp.einsum("nd,nd->n", x, wv[lbl]) + bv[lbl]
+    neg_logit = jnp.einsum("nd,nkd->nk", x, wv[neg]) + bv[neg]
+    pos_loss = -jax.nn.log_sigmoid(pos_logit)
+    neg_loss = -jax.nn.log_sigmoid(-neg_logit).sum(axis=1)
+    return Tensor((pos_loss + neg_loss)[:, None])
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    """Lookahead row convolution (reference static.nn.row_conv /
+    phi row_conv_kernel): out[t] = sum_{i=0..C} w[i] * x[t+i]."""
+    P = _P()
+    x = _v(input)  # [B, T, D]
+    C = future_context_size
+    w = P.create_parameter([C + 1, x.shape[-1]])
+    wv = _v(w)
+    pad = jnp.pad(x, ((0, 0), (0, C), (0, 0)))
+    out = sum(pad[:, i:i + x.shape[1], :] * wv[i] for i in range(C + 1))
+    return _act(Tensor(out), act)
+
+
+def py_func(func, x, out=None, backward_func=None,
+            skip_vars_in_backward_input=None):
+    from .extras import py_func as _pf
+
+    return _pf(func, x, out, backward_func, skip_vars_in_backward_input)
+
+
+# -- StaticRNN ---------------------------------------------------------------
+
+class StaticRNN:
+    """reference static.nn.StaticRNN: an explicitly-stepped RNN block.
+
+    TPU mapping: the reference unrolls the step block into the
+    ProgramDesc; here the user-recorded step runs under lax.scan-style
+    iteration at __call__ time (python loop over the static time dim —
+    the tape Program jits the whole replay, so XLA still sees one
+    compiled module).
+
+        rnn = StaticRNN()
+        with rnn.step():
+            word = rnn.step_input(x)           # [B, T, D] -> per-step [B, D]
+            prev = rnn.memory(shape=[-1, H], batch_ref=word)
+            hidden = some_layer(word, prev)
+            rnn.update_memory(prev, hidden)
+            rnn.step_output(hidden)
+        out = rnn()                            # [B, T, H]
+    """
+
+    class _StepCtx:
+        def __init__(self, rnn):
+            self.rnn = rnn
+
+        def __enter__(self):
+            self.rnn._in_step = True
+            return self
+
+        def __exit__(self, *exc):
+            self.rnn._in_step = False
+            return False
+
+    def __init__(self, name=None):
+        self._inputs = []
+        self._mem_init = []
+        self._mem_updates = []
+        self._outputs = []
+        self._recorder = None
+        self._in_step = False
+
+    def step(self):
+        return self._StepCtx(self)
+
+    def step_input(self, x):
+        self._inputs.append(x)
+        slot = len(self._inputs) - 1
+        return _SymbolicStep(self, ("input", slot),
+                             Tensor(_v(x)[:, 0]))
+
+    def memory(self, init=None, shape=None, batch_ref=None,
+               init_value=0.0, init_batch_dim_idx=0, ref_batch_dim_idx=1):
+        if init is not None:
+            first = _v(init)
+        else:
+            b = _v(batch_ref._concrete if isinstance(batch_ref,
+                                                     _SymbolicStep)
+                   else batch_ref).shape[0]
+            dims = [b if s == -1 else s for s in shape]
+            first = jnp.full(dims, init_value)
+        self._mem_init.append(first)
+        slot = len(self._mem_init) - 1
+        return _SymbolicStep(self, ("memory", slot), Tensor(first))
+
+    def update_memory(self, mem, new_val):
+        self._mem_updates.append((mem._slot[1], new_val))
+
+    def step_output(self, o):
+        self._outputs.append(o)
+
+    def output(self, *outputs):
+        for o in outputs:
+            self.step_output(o)
+
+    def __call__(self):
+        # replay the recorded (symbolic) step over the real time axis.
+        # The recorded step graph holds _SymbolicStep placeholders whose
+        # concrete values we rebind per t — the step closure re-executes
+        # via the captured functions.
+        raise RuntimeError(
+            "StaticRNN: call rnn.run(fn) form on this stack — record the "
+            "step as a python function: out = StaticRNN.scan(step_fn, x, "
+            "init_states). The fluid block-capture form needs ProgramDesc "
+            "blocks (see static/nn_extras.py docstring)")
+
+    @staticmethod
+    def scan(step_fn, inputs, init_states):
+        """Functional StaticRNN: step_fn(x_t, states) -> (out_t, states);
+        inputs [B, T, ...] -> outputs [B, T, ...]."""
+        x = _v(inputs)
+        T = x.shape[1]
+        states = init_states
+        outs = []
+        for t in range(T):
+            out_t, states = step_fn(Tensor(x[:, t]), states)
+            outs.append(_v(out_t))
+        return Tensor(jnp.stack(outs, axis=1)), states
+
+
+class _SymbolicStep(Tensor):
+    """Placeholder produced inside StaticRNN.step() recording."""
+
+    def __init__(self, rnn, slot, concrete):
+        super().__init__(concrete._value)
+        self._rnn = rnn
+        self._slot = slot
+        self._concrete = concrete
+
+
+# -- sequence ops over (padded, lengths) -------------------------------------
+
+def _lens(lengths, batch):
+    if lengths is None:
+        raise ValueError(
+            "sequence ops on the TPU stack take explicit `lengths` "
+            "(LoD -> padded+lengths convention, SURVEY §7)")
+    return _v(lengths).astype(jnp.int32).reshape(batch)
+
+
+def _time_mask(lengths, T):
+    return jnp.arange(T)[None, :] < lengths[:, None]
+
+
+def sequence_pad(x, pad_value, maxlen=None, length=None, name=None):
+    """Packed [sum(len), ...] + lengths -> (padded [B, maxlen, ...],
+    lengths) (reference sequence_pad over LoD input)."""
+    v = _v(x)
+    lens = _v(length).astype(jnp.int32).reshape(-1)
+    B = lens.shape[0]
+    T = int(maxlen) if maxlen else int(np.asarray(lens).max())
+    offs = np.concatenate([[0], np.cumsum(np.asarray(lens))])
+    rows = []
+    pv = _v(pad_value)
+    for b in range(B):
+        seg = v[int(offs[b]):int(offs[b + 1])]
+        padn = T - seg.shape[0]
+        fill = jnp.broadcast_to(pv, (padn,) + seg.shape[1:]) \
+            if padn > 0 else seg[:0]
+        rows.append(jnp.concatenate([seg, fill], axis=0))
+    return Tensor(jnp.stack(rows)), Tensor(lens)
+
+
+def sequence_unpad(x, length, name=None):
+    """(padded [B, T, ...], lengths) -> packed [sum(len), ...]
+    (reference sequence_unpad)."""
+    v = _v(x)
+    lens = _lens(length, v.shape[0])
+    segs = [v[b, :int(lens[b])] for b in range(v.shape[0])]
+    return Tensor(jnp.concatenate(segs, axis=0))
+
+
+def sequence_concat(input, name=None, lengths=None):
+    """Concatenate per-row sequences time-wise (reference
+    sequence_concat over LoD): list of (padded, lengths) pairs when
+    `lengths` given, else plain time-axis concat."""
+    if lengths is None:
+        return Tensor(jnp.concatenate([_v(i) for i in input], axis=1))
+    parts = []
+    B = _v(input[0]).shape[0]
+    lens = [_lens(l, B) for l in lengths]
+    rows = []
+    for b in range(B):
+        segs = [_v(x)[b, :int(l[b])] for x, l in zip(input, lens)]
+        rows.append(jnp.concatenate(segs, axis=0))
+    T = max(r.shape[0] for r in rows)
+    padded = [jnp.pad(r, ((0, T - r.shape[0]),) + ((0, 0),) * (r.ndim - 1))
+              for r in rows]
+    total = sum(lens)
+    return Tensor(jnp.stack(padded)), Tensor(total)
+
+
+def sequence_first_step(input, lengths=None, name=None):
+    """reference sequence_first_step: x[:, 0] of each valid sequence."""
+    return Tensor(_v(input)[:, 0])
+
+
+def sequence_last_step(input, lengths=None, name=None):
+    """reference sequence_last_step: the last VALID step per row."""
+    v = _v(input)
+    lens = _lens(lengths, v.shape[0])
+    idx = jnp.maximum(lens - 1, 0)
+    return Tensor(v[jnp.arange(v.shape[0]), idx])
+
+
+def sequence_pool(input, pool_type, lengths=None, is_test=False,
+                  pad_value=0.0):
+    """reference sequence_pool: sum/average/sqrt/max/last/first over the
+    valid steps."""
+    v = _v(input)
+    lens = _lens(lengths, v.shape[0])
+    mask = _time_mask(lens, v.shape[1])
+    m = mask.reshape(mask.shape + (1,) * (v.ndim - 2))
+    pool = pool_type.lower()
+    if pool == "max":
+        filled = jnp.where(m, v, -jnp.inf)
+        out = filled.max(axis=1)
+        return Tensor(jnp.where(jnp.isfinite(out), out, pad_value))
+    if pool == "last":
+        return sequence_last_step(input, lengths)
+    if pool == "first":
+        return sequence_first_step(input, lengths)
+    s = jnp.where(m, v, 0.0).sum(axis=1)
+    denom = jnp.maximum(lens, 1).reshape((-1,) + (1,) * (v.ndim - 2))
+    if pool == "average":
+        return Tensor(s / denom)
+    if pool == "sqrt":
+        return Tensor(s / jnp.sqrt(denom.astype(s.dtype)))
+    return Tensor(s)  # sum
+
+
+def sequence_softmax(input, lengths=None, use_cudnn=False, name=None):
+    """reference sequence_softmax: softmax over each row's valid prefix."""
+    v = _v(input)
+    lens = _lens(lengths, v.shape[0])
+    mask = _time_mask(lens, v.shape[1])
+    m = mask.reshape(mask.shape + (1,) * (v.ndim - 2))
+    logits = jnp.where(m, v, -1e30)
+    return Tensor(jax.nn.softmax(logits, axis=1) * m)
+
+
+def sequence_reverse(x, lengths=None, name=None):
+    """reference sequence_reverse: flip each valid prefix, keep padding."""
+    v = _v(x)
+    lens = _lens(lengths, v.shape[0])
+    T = v.shape[1]
+    pos = jnp.arange(T)[None, :]
+    src = jnp.where(pos < lens[:, None], lens[:, None] - 1 - pos, pos)
+    return Tensor(jnp.take_along_axis(
+        v, src.reshape(src.shape + (1,) * (v.ndim - 2)), axis=1))
+
+
+def sequence_enumerate(input, win_size, pad_value=0, name=None,
+                       lengths=None):
+    """reference sequence_enumerate: sliding win_size windows per step,
+    padded past each row's valid length."""
+    v = _v(input)
+    B, T = v.shape[:2]
+    lens = _lens(lengths, B) if lengths is not None \
+        else jnp.full((B,), T, jnp.int32)
+    cols = []
+    for k in range(win_size):
+        pos = jnp.arange(T) + k
+        valid = pos[None, :] < lens[:, None]
+        gathered = jnp.take(v, jnp.minimum(pos, T - 1), axis=1)
+        cols.append(jnp.where(valid, gathered, pad_value))
+    return Tensor(jnp.stack(cols, axis=-1))
+
+
+def sequence_expand(x, y, ref_level=-1, name=None, repeats=None):
+    """reference sequence_expand: repeat each row per the ref sequence's
+    LoD. TPU form: explicit `repeats` [B] ints."""
+    if repeats is None:
+        raise ValueError(
+            "sequence_expand needs explicit `repeats` (the LoD of y)")
+    v = _v(x)
+    r = np.asarray(_v(repeats)).astype(np.int64)
+    return Tensor(jnp.repeat(v, jnp.asarray(r), axis=0,
+                             total_repeat_length=int(r.sum())))
+
+
+def sequence_expand_as(x, y, name=None, repeats=None):
+    return sequence_expand(x, y, repeats=repeats)
+
+
+def sequence_reshape(input, new_dim):
+    """reference sequence_reshape: refold the trailing dim of a packed
+    sequence."""
+    v = _v(input)
+    total = v.shape[0] * v.shape[-1]
+    return Tensor(v.reshape(total // new_dim, new_dim))
+
+
+def sequence_scatter(input, index, updates, name=None):
+    """reference sequence_scatter: add updates at (row, position) pairs;
+    index packs positions per row ([n, 2] int (row, pos))."""
+    v = _v(input)
+    idx = _v(index).astype(jnp.int32)
+    upd = _v(updates)
+    return Tensor(v.at[idx[:, 0], idx[:, 1]].add(upd))
+
+
+def sequence_slice(input, offset, length, name=None):
+    """reference sequence_slice: per-row [offset, offset+length) windows
+    -> padded to max(length)."""
+    v = _v(input)
+    off = np.asarray(_v(offset)).reshape(-1).astype(np.int64)
+    ln = np.asarray(_v(length)).reshape(-1).astype(np.int64)
+    T = int(ln.max()) if len(ln) else 0
+    rows = []
+    for b in range(v.shape[0]):
+        seg = v[b, int(off[b]):int(off[b] + ln[b])]
+        rows.append(jnp.pad(
+            seg, ((0, T - seg.shape[0]),) + ((0, 0),) * (seg.ndim - 1)))
+    return Tensor(jnp.stack(rows)), Tensor(jnp.asarray(ln, jnp.int32))
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=True, padding_start=None, bias_attr=None,
+                  param_attr=None, act=None, name=None):
+    """reference sequence_conv: temporal context conv over [B, T, D]."""
+    P = _P()
+    x = _v(input)
+    D = x.shape[-1]
+    w = P.create_parameter([filter_size * D, num_filters])
+    start = -((filter_size - 1) // 2) if padding_start is None \
+        else padding_start
+    ctx = []
+    T = x.shape[1]
+    for k in range(filter_size):
+        shift = start + k
+        pos = jnp.clip(jnp.arange(T) + shift, 0, T - 1)
+        col = jnp.take(x, pos, axis=1)
+        valid = (jnp.arange(T) + shift >= 0) & (jnp.arange(T) + shift < T)
+        ctx.append(jnp.where(valid[None, :, None], col, 0.0))
+    stacked = jnp.concatenate(ctx, axis=-1)         # [B, T, k*D]
+    out = stacked @ _v(w)
+    if bias_attr is not False:
+        b = P.create_parameter([num_filters])
+        out = out + _v(b)
+    return _act(Tensor(out), act)

@@ -1,0 +1,173 @@
+"""static API + static.nn builder completions (reference
+python/paddle/static/{__init__,nn/__init__}.py surfaces)."""
+import os
+import re
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+
+REF = "/root/reference/python/paddle"
+
+_REF_GATES = pytest.mark.skipif(not os.path.isdir(REF),
+                                reason="reference tree not mounted")
+
+
+@_REF_GATES
+class TestSurfaceGates:
+    def test_static_all_resolves(self):
+        names = sorted(set(re.findall(
+            r"^\s+'(\w+)',", open(REF + "/static/__init__.py").read(),
+            re.M)))
+        missing = [n for n in names if not hasattr(static, n)]
+        assert missing == [], missing
+
+    def test_static_nn_all_resolves(self):
+        names = sorted(set(re.findall(
+            r"^\s+'(\w+)',", open(REF + "/static/nn/__init__.py").read(),
+            re.M)))
+        missing = [n for n in names if not hasattr(static.nn, n)]
+        assert missing == [], missing
+
+
+class TestStaticExtras:
+    def test_ema_update_apply_restore(self):
+        import paddle_tpu.nn as nn
+
+        m = nn.Linear(2, 2)
+        ema = static.ExponentialMovingAverage(decay=0.5)
+        w0 = np.asarray(m.weight._value).copy()
+        ema.update(m.parameters())
+        m.weight._value = m.weight._value + 10.0
+        ema.update()
+        with ema.apply():
+            # shadow = 0.5*w0 + 0.5*(w0+10) = w0 + 5
+            np.testing.assert_allclose(np.asarray(m.weight._value),
+                                       w0 + 5.0, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(m.weight._value), w0 + 10.0)
+
+    def test_save_load_roundtrip(self, tmp_path):
+        static.enable_static()
+        try:
+            main, startup = static.Program(), static.Program()
+            with static.program_guard(main, startup):
+                x = static.data("x", [-1, 4], "float32")
+                static.nn.fc(x, 2)
+            exe = static.Executor()
+            exe.run(startup)
+            state = main.state_dict() if hasattr(main, "state_dict") else {}
+            prefix = str(tmp_path / "m")
+            static.save(main, prefix)
+            st = static.load_program_state(prefix)
+            assert isinstance(st, dict)
+        finally:
+            static.disable_static()
+
+    def test_places_and_guards(self):
+        assert len(static.cpu_places(2)) == 2
+        with static.device_guard("gpu:0"):
+            pass
+        with static.name_scope("block"):
+            pass
+        with pytest.raises(RuntimeError):
+            static.xpu_places()
+        with pytest.raises(RuntimeError):
+            static.ParallelExecutor()
+
+    def test_spectral_norm_unit_sigma(self):
+        w = paddle.to_tensor(
+            np.random.RandomState(0).randn(4, 6).astype(np.float32))
+        out = static.nn.spectral_norm(w, power_iters=20)
+        sigma = np.linalg.svd(np.asarray(out._value), compute_uv=False)[0]
+        np.testing.assert_allclose(sigma, 1.0, rtol=1e-3)
+
+
+class TestSequenceOps:
+    def _x(self):
+        v = np.arange(24, dtype=np.float32).reshape(2, 4, 3)
+        lens = np.asarray([2, 4], np.int64)
+        return paddle.to_tensor(v), paddle.to_tensor(lens), v, lens
+
+    def test_last_first_pool(self):
+        x, L, v, lens = self._x()
+        np.testing.assert_allclose(
+            np.asarray(static.nn.sequence_last_step(x, lengths=L)._value),
+            v[np.arange(2), lens - 1])
+        np.testing.assert_allclose(
+            np.asarray(static.nn.sequence_first_step(x)._value), v[:, 0])
+        avg = np.asarray(static.nn.sequence_pool(
+            x, "average", lengths=L)._value)
+        np.testing.assert_allclose(avg[0], v[0, :2].mean(axis=0),
+                                   rtol=1e-6)
+
+    def test_softmax_reverse(self):
+        x, L, v, lens = self._x()
+        sm = np.asarray(static.nn.sequence_softmax(x, lengths=L)._value)
+        np.testing.assert_allclose(sm[0, :2].sum(axis=0), np.ones(3),
+                                   rtol=1e-5)
+        assert np.all(sm[0, 2:] == 0)
+        rv = np.asarray(static.nn.sequence_reverse(x, lengths=L)._value)
+        np.testing.assert_allclose(rv[0, 0], v[0, 1])
+        np.testing.assert_allclose(rv[0, 2:], v[0, 2:])  # padding kept
+
+    def test_pad_unpad_roundtrip(self):
+        x, L, v, lens = self._x()
+        packed = static.nn.sequence_unpad(x, L)
+        assert packed.shape == [6, 3]
+        padded, outl = static.nn.sequence_pad(
+            packed, paddle.to_tensor(np.zeros(3, np.float32)), maxlen=4,
+            length=L)
+        got = np.asarray(padded._value)
+        np.testing.assert_allclose(got[0, :2], v[0, :2])
+        assert np.all(got[0, 2:] == 0)
+
+    def test_enumerate_and_conv(self):
+        ids = paddle.to_tensor(
+            np.asarray([[1, 2, 3, 0]], np.int64))
+        L = paddle.to_tensor(np.asarray([3], np.int64))
+        en = np.asarray(static.nn.sequence_enumerate(
+            ids, 2, pad_value=9, lengths=L)._value)
+        np.testing.assert_array_equal(en[0, 0], [1, 2])
+        np.testing.assert_array_equal(en[0, 2], [3, 9])
+        x, Lx, v, lens = self._x()
+        paddle.seed(0)
+        out = static.nn.sequence_conv(x, 5)
+        assert out.shape == [2, 4, 5]
+
+    def test_expand_and_slice(self):
+        x = paddle.to_tensor(np.asarray([[1.0], [2.0]], np.float32))
+        out = static.nn.sequence_expand(
+            x, None, repeats=paddle.to_tensor(np.asarray([2, 3])))
+        np.testing.assert_allclose(
+            np.asarray(out._value).ravel(), [1, 1, 2, 2, 2])
+        xx, L, v, lens = self._x()
+        sl, ln = static.nn.sequence_slice(
+            xx, paddle.to_tensor(np.asarray([0, 1])),
+            paddle.to_tensor(np.asarray([2, 2])))
+        np.testing.assert_allclose(np.asarray(sl._value)[1], v[1, 1:3])
+
+    def test_static_rnn_scan(self):
+        import paddle_tpu.nn as nn
+
+        paddle.seed(1)
+        cell = nn.GRUCell(3, 4)
+        x, L, v, lens = self._x()
+        out, final = static.nn.StaticRNN.scan(
+            lambda xt, h: cell(xt, h),
+            x, paddle.to_tensor(np.zeros((2, 4), np.float32)))
+        assert out.shape == [2, 4, 4]
+
+    def test_nce_and_row_conv(self):
+        paddle.seed(2)
+        x = paddle.to_tensor(
+            np.random.RandomState(3).randn(4, 8).astype(np.float32))
+        lbl = paddle.to_tensor(np.asarray([[1], [2], [0], [3]], np.int64))
+        loss = static.nn.nce(x, lbl, num_total_classes=10)
+        assert loss.shape == [4, 1]
+        assert np.isfinite(np.asarray(loss._value)).all()
+        seq = paddle.to_tensor(
+            np.random.RandomState(4).randn(2, 5, 3).astype(np.float32))
+        rc = static.nn.row_conv(seq, 2)
+        assert rc.shape == [2, 5, 3]
