@@ -30,10 +30,18 @@ class PyLayerContext:
         self.attrs = {}
 
     def save_for_backward(self, *tensors):
-        self._saved = list(tensors)
+        from ..core.autograd import get_saved_tensor_hooks
+
+        # the hooks ACTIVE AT SAVE TIME travel with the saved tensors
+        # (reference semantics: backward may run after the hook scope)
+        pack, self._unpack = get_saved_tensor_hooks()
+        self._saved = [pack(t) if pack is not None else t
+                       for t in tensors]
 
     def saved_tensor(self):
-        return list(self._saved)
+        unpack = getattr(self, "_unpack", None)
+        return [unpack(t) if unpack is not None else t
+                for t in self._saved]
 
 
 class PyLayer:
@@ -87,3 +95,27 @@ class PyLayer:
             wrapped = eng.attach_node(out_vals, node)
             return wrapped[0] if single else list(wrapped)
         return outs
+
+
+class saved_tensors_hooks:
+    """reference autograd.saved_tensors_hooks: intercept tensors saved
+    for backward (pack on save, unpack on use) — the offload/compress
+    hook point. The eager engine saves via vjp closures, so the hooks
+    wrap Tensor residual registration in core.autograd."""
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        from ..core import autograd as eng
+
+        self._prev = eng.get_saved_tensor_hooks()
+        eng.set_saved_tensor_hooks(self.pack_hook, self.unpack_hook)
+        return self
+
+    def __exit__(self, *exc):
+        from ..core import autograd as eng
+
+        eng.set_saved_tensor_hooks(*self._prev)  # nested scopes restore
+        return False

@@ -22,6 +22,23 @@ from .tensor import Tensor
 from . import dtype as _dtype
 
 
+_saved_hooks = [None, None]
+
+
+def set_saved_tensor_hooks(pack, unpack):
+    """Install/clear the saved-tensor pack/unpack pair
+    (autograd.saved_tensors_hooks). Applies to explicitly saved residuals
+    (PyLayer ctx.save_for_backward / recompute); primitive ops' residuals
+    live inside XLA-managed vjp closures, where donation/remat plays the
+    offload role (documented deviation)."""
+    _saved_hooks[0] = pack
+    _saved_hooks[1] = unpack
+
+
+def get_saved_tensor_hooks():
+    return tuple(_saved_hooks)
+
+
 class GradNode:
     __slots__ = (
         "name",

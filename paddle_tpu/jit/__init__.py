@@ -130,6 +130,9 @@ class StaticFunction:
         return jax.jit(pure)
 
     def __call__(self, *args, **kwargs):
+        if not _TO_STATIC_ENABLED:
+            # jit.enable_to_static(False): decorated fns run eagerly
+            return self._fn(*args, **kwargs)
         tensor_args = [a for a in args if isinstance(a, Tensor)]
         if len(tensor_args) != len(args):
             # non-tensor args: fall back to eager for simplicity
@@ -273,3 +276,31 @@ def not_to_static(fn):
 
 def ignore_module(modules):
     return None
+
+
+def enable_to_static(enable_to_static_bool=True):
+    """reference jit.enable_to_static: global switch for @to_static
+    (ProgramTranslator.enable analog)."""
+    global _TO_STATIC_ENABLED
+    _TO_STATIC_ENABLED = bool(enable_to_static_bool)
+
+
+_TO_STATIC_ENABLED = True
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """reference jit.set_code_level: dy2static transformed-code logging.
+    Trace-based capture has no AST rewriting stages to print; the knob is
+    recorded for API compatibility."""
+    import logging
+
+    logging.getLogger("paddle_tpu.jit").setLevel(
+        logging.DEBUG if level else logging.WARNING)
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """reference jit.set_verbosity: dy2static logging verbosity."""
+    import logging
+
+    logging.getLogger("paddle_tpu.jit").setLevel(
+        logging.DEBUG if level else logging.WARNING)

@@ -160,6 +160,27 @@ class StepDecay(LRScheduler):
         return self.base_lr * self.gamma ** (self.last_epoch // self.step_size)
 
 
+class MultiplicativeDecay(LRScheduler):
+    """lr_t = lr_{t-1} * lr_lambda(t) (reference optimizer/lr.py
+    MultiplicativeDecay — multiplicative where LambdaDecay is
+    absolute)."""
+
+    def __init__(self, learning_rate, lr_lambda, last_epoch=-1,
+                 verbose=False):
+        self.lr_lambda = lr_lambda
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        cur = self.base_lr
+        for e in range(1, self.last_epoch + 1):
+            cur = cur * self.lr_lambda(e)
+        return cur
+
+    def state_dict(self):
+        return {k: v for k, v in super().state_dict().items()
+                if k != "lr_lambda"}
+
+
 class LambdaDecay(LRScheduler):
     def __init__(self, learning_rate, lr_lambda, last_epoch=-1, verbose=False):
         self.lr_lambda = lr_lambda

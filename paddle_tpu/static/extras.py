@@ -246,12 +246,22 @@ def exponential_decay(learning_rate, decay_steps, decay_rate,
 
 # -- program persistence -----------------------------------------------------
 
-def _state_of(program):
+def _param_table(program):
+    """name -> Parameter for a tape Program (its captured trainable
+    leaves, Program._analyze)."""
     from . import default_main_program
 
     prog = program if program is not None else default_main_program()
+    if hasattr(prog, "state_dict"):
+        return prog.state_dict()
+    params, _ = prog._analyze()
+    return {getattr(p, "name", None) or "param_%d" % i: p
+            for i, p in enumerate(params)}
+
+
+def _state_of(program):
     return {name: np.asarray(t._value)
-            for name, t in getattr(prog, "params", {}).items()}
+            for name, t in _param_table(program).items()}
 
 
 def save(program, model_prefix, protocol=4):
@@ -281,16 +291,20 @@ def load_program_state(model_prefix, var_list=None):
 
 def set_program_state(program, state_dict):
     """reference static.set_program_state: push a name->ndarray dict into
-    the program's parameters."""
+    the program's parameters (matched by name over the captured
+    trainable leaves)."""
     if hasattr(program, "set_state_dict"):
         program.set_state_dict(state_dict)
         return
-    params = getattr(program, "params", None)
-    if params is None:
-        raise ValueError("program carries no parameter table")
+    params = _param_table(program)
+    missing = [n for n in state_dict if n not in params]
     for name, val in state_dict.items():
         if name in params:
             params[name]._value = jnp.asarray(val)
+    if missing:
+        raise ValueError(
+            "set_program_state: %d entries matched no program parameter "
+            "(e.g. %s)" % (len(missing), missing[:3]))
 
 
 def serialize_program(feed_vars, fetch_vars, program=None, **kwargs):

@@ -310,87 +310,26 @@ def py_func(func, x, out=None, backward_func=None,
 # -- StaticRNN ---------------------------------------------------------------
 
 class StaticRNN:
-    """reference static.nn.StaticRNN: an explicitly-stepped RNN block.
+    """reference static.nn.StaticRNN — an explicitly-stepped RNN block.
 
-    TPU mapping: the reference unrolls the step block into the
-    ProgramDesc; here the user-recorded step runs under lax.scan-style
-    iteration at __call__ time (python loop over the static time dim —
-    the tape Program jits the whole replay, so XLA still sees one
-    compiled module).
+    The fluid form records a step BLOCK into the ProgramDesc
+    (rnn.step_input/memory/update_memory inside `with rnn.step()`); that
+    block-capture machinery is ProgramDesc-specific, so this stack
+    provides the equivalent functional form instead:
 
-        rnn = StaticRNN()
-        with rnn.step():
-            word = rnn.step_input(x)           # [B, T, D] -> per-step [B, D]
-            prev = rnn.memory(shape=[-1, H], batch_ref=word)
-            hidden = some_layer(word, prev)
-            rnn.update_memory(prev, hidden)
-            rnn.step_output(hidden)
-        out = rnn()                            # [B, T, H]
+        out, final_states = StaticRNN.scan(step_fn, x, init_states)
+
+    where step_fn(x_t, states) -> (out_t, new_states) and x is
+    [B, T, ...]. Under the tape Program the whole replay jits into one
+    XLA module, same as the reference's unrolled block. Constructing the
+    fluid block form raises with this guidance.
     """
 
-    class _StepCtx:
-        def __init__(self, rnn):
-            self.rnn = rnn
-
-        def __enter__(self):
-            self.rnn._in_step = True
-            return self
-
-        def __exit__(self, *exc):
-            self.rnn._in_step = False
-            return False
-
     def __init__(self, name=None):
-        self._inputs = []
-        self._mem_init = []
-        self._mem_updates = []
-        self._outputs = []
-        self._recorder = None
-        self._in_step = False
-
-    def step(self):
-        return self._StepCtx(self)
-
-    def step_input(self, x):
-        self._inputs.append(x)
-        slot = len(self._inputs) - 1
-        return _SymbolicStep(self, ("input", slot),
-                             Tensor(_v(x)[:, 0]))
-
-    def memory(self, init=None, shape=None, batch_ref=None,
-               init_value=0.0, init_batch_dim_idx=0, ref_batch_dim_idx=1):
-        if init is not None:
-            first = _v(init)
-        else:
-            b = _v(batch_ref._concrete if isinstance(batch_ref,
-                                                     _SymbolicStep)
-                   else batch_ref).shape[0]
-            dims = [b if s == -1 else s for s in shape]
-            first = jnp.full(dims, init_value)
-        self._mem_init.append(first)
-        slot = len(self._mem_init) - 1
-        return _SymbolicStep(self, ("memory", slot), Tensor(first))
-
-    def update_memory(self, mem, new_val):
-        self._mem_updates.append((mem._slot[1], new_val))
-
-    def step_output(self, o):
-        self._outputs.append(o)
-
-    def output(self, *outputs):
-        for o in outputs:
-            self.step_output(o)
-
-    def __call__(self):
-        # replay the recorded (symbolic) step over the real time axis.
-        # The recorded step graph holds _SymbolicStep placeholders whose
-        # concrete values we rebind per t — the step closure re-executes
-        # via the captured functions.
         raise RuntimeError(
-            "StaticRNN: call rnn.run(fn) form on this stack — record the "
-            "step as a python function: out = StaticRNN.scan(step_fn, x, "
-            "init_states). The fluid block-capture form needs ProgramDesc "
-            "blocks (see static/nn_extras.py docstring)")
+            "StaticRNN block-capture needs ProgramDesc blocks; use the "
+            "functional form: StaticRNN.scan(step_fn, inputs, "
+            "init_states) (see docstring)")
 
     @staticmethod
     def scan(step_fn, inputs, init_states):
@@ -404,16 +343,6 @@ class StaticRNN:
             out_t, states = step_fn(Tensor(x[:, t]), states)
             outs.append(_v(out_t))
         return Tensor(jnp.stack(outs, axis=1)), states
-
-
-class _SymbolicStep(Tensor):
-    """Placeholder produced inside StaticRNN.step() recording."""
-
-    def __init__(self, rnn, slot, concrete):
-        super().__init__(concrete._value)
-        self._rnn = rnn
-        self._slot = slot
-        self._concrete = concrete
 
 
 # -- sequence ops over (padded, lengths) -------------------------------------

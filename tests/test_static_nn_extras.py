@@ -171,3 +171,39 @@ class TestSequenceOps:
             np.random.RandomState(4).randn(2, 5, 3).astype(np.float32))
         rc = static.nn.row_conv(seq, 2)
         assert rc.shape == [2, 5, 3]
+
+
+class TestPersistenceRoundtrip:
+    def test_program_params_roundtrip(self, tmp_path):
+        """Regression: static.save used to pickle an empty dict (the
+        Program param table comes from _analyze, not .params)."""
+        static.enable_static()
+        try:
+            main, startup = static.Program(), static.Program()
+            with static.program_guard(main, startup):
+                x = static.data("x", [-1, 4], "float32")
+                static.nn.fc(x, 2)
+            static.Executor().run(startup)
+            prefix = str(tmp_path / "m")
+            static.save(main, prefix)
+            st = static.load_program_state(prefix)
+            assert len(st) >= 2  # fc weight + bias actually captured
+            # perturb then restore
+            params, _ = main._analyze()
+            import jax.numpy as jnp
+
+            before = np.asarray(params[0]._value).copy()
+            params[0]._value = params[0]._value + 7.0
+            static.load(main, prefix)
+            np.testing.assert_allclose(np.asarray(params[0]._value),
+                                       before)
+            with pytest.raises(ValueError, match="matched no"):
+                static.set_program_state(main, {"nope": before})
+        finally:
+            static.disable_static()
+
+
+class TestStaticRNNRefusal:
+    def test_block_form_refuses_with_guidance(self):
+        with pytest.raises(RuntimeError, match="scan"):
+            static.nn.StaticRNN()
