@@ -484,3 +484,119 @@ def _kl_bern_bern(p, q):
 def _kl_exp_exp(p, q):
     r = q.rate / p.rate
     return Tensor(jnp.log(p.rate) - jnp.log(q.rate) + r - 1)
+
+
+class ExponentialFamily(Distribution):
+    """Base for exponential-family distributions (reference
+    distribution/exponential_family.py): entropy via the Bregman
+    divergence of the log-normalizer (autodiff of _log_normalizer at the
+    natural parameters)."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        raise NotImplementedError
+
+    def entropy(self):
+        import jax
+
+        nat = tuple(jnp.asarray(p) for p in self._natural_parameters)
+
+        def f(ps):
+            return jnp.sum(self._log_normalizer(*ps))
+
+        lg = self._log_normalizer(*nat)
+        gs = jax.grad(f)(nat)
+        result = -self._mean_carrier_measure + lg
+        for np_, g in zip(nat, gs):
+            result = result - np_ * g
+        return result if isinstance(result, Tensor) else Tensor(result)
+
+
+class Independent(Distribution):
+    """Reinterpret trailing batch dims as event dims (reference
+    distribution/independent.py): log_prob sums over them."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        if reinterpreted_batch_rank < 1:
+            raise ValueError(
+                "reinterpreted_batch_rank must be >= 1")
+        self._base = base
+        self._rank = int(reinterpreted_batch_rank)
+
+    @property
+    def mean(self):
+        return self._base.mean
+
+    @property
+    def variance(self):
+        return self._base.variance
+
+    def sample(self, shape=()):
+        return self._base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self._base.rsample(shape)
+
+    def log_prob(self, value):
+        lp = self._base.log_prob(value)
+        v = lp._value if isinstance(lp, Tensor) else jnp.asarray(lp)
+        return Tensor(v.sum(axis=tuple(range(v.ndim - self._rank,
+                                             v.ndim))))
+
+    def prob(self, value):
+        lp = self.log_prob(value)
+        return Tensor(jnp.exp(lp._value))
+
+    def entropy(self):
+        e = self._base.entropy()
+        v = e._value if isinstance(e, Tensor) else jnp.asarray(e)
+        return Tensor(v.sum(axis=tuple(range(v.ndim - self._rank,
+                                             v.ndim))))
+
+
+class TransformedDistribution(Distribution):
+    """Distribution of transform(base_sample) (reference
+    distribution/transformed_distribution.py): log_prob via the inverse
+    map and the log|det J| correction. `transforms` expose
+    forward/inverse/forward_log_det_jacobian (paddle Transform protocol
+    or any object with those callables)."""
+
+    def __init__(self, base, transforms):
+        self._base = base
+        self._transforms = list(transforms)
+
+    def sample(self, shape=()):
+        x = self._base.sample(shape)
+        for t in self._transforms:
+            x = t.forward(x)
+        return x
+
+    def rsample(self, shape=()):
+        x = self._base.rsample(shape) if hasattr(self._base, "rsample") \
+            else self._base.sample(shape)
+        for t in self._transforms:
+            x = t.forward(x)
+        return x
+
+    def log_prob(self, value):
+        y = value
+        ldj = 0.0
+        for t in reversed(self._transforms):
+            x = t.inverse(y)
+            term = t.forward_log_det_jacobian(x)
+            term = term._value if isinstance(term, Tensor) else term
+            ldj = ldj + term
+            y = x
+        base_lp = self._base.log_prob(y)
+        blp = base_lp._value if isinstance(base_lp, Tensor) else base_lp
+        return Tensor(blp - ldj)
+
+    def prob(self, value):
+        return Tensor(jnp.exp(self.log_prob(value)._value))

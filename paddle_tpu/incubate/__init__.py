@@ -7,3 +7,128 @@ everything is already traced functionally — so they need no incubation.
 """
 from . import asp, autograd, autotune, nn, optimizer  # noqa: F401
 from .autotune import set_config  # noqa: F401
+
+# -- reference incubate top-level names (graph aliases + optimizers) --------
+from .optimizer import LookAhead, ModelAverage  # noqa: F401,E402
+from ..geometric import (  # noqa: F401,E402
+    segment_max,
+    segment_mean,
+    segment_min,
+    segment_sum,
+)
+from ..geometric import reindex_graph as graph_reindex  # noqa: F401,E402
+from ..geometric import (  # noqa: F401,E402
+    sample_neighbors as graph_sample_neighbors,
+)
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum",
+                    out_size=None, name=None):
+    """Legacy alias (reference incubate.graph_send_recv -> geometric
+    send_u_recv)."""
+    from ..geometric import send_u_recv
+
+    return send_u_recv(x, src_index, dst_index, reduce_op=pool_type,
+                       out_size=out_size)
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """Multi-hop neighbor sampling (reference incubate.graph_khop_sampler)
+    by iterating the one-hop sampler per hop; returns
+    (edge_src, edge_dst, sample_index, reindex_nodes). Edge ids are not
+    tracked by the TPU sampler, so return_eids=True raises (deviation:
+    the reference threads eids through its CSC kernel)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..core.tensor import Tensor
+    from ..geometric import sample_neighbors
+
+    if return_eids:
+        raise NotImplementedError(
+            "graph_khop_sampler(return_eids=True): edge ids are not "
+            "tracked; gather them host-side from (edge_src, edge_dst)")
+
+    def _np_of(t):
+        return np.asarray(t._value if hasattr(t, "_value") else t)
+
+    cur = input_nodes
+    all_src, all_dst = [], []
+    for k in sample_sizes:
+        out_neigh, out_count = sample_neighbors(row, colptr, cur,
+                                                sample_size=k)
+        nv = _np_of(out_neigh)
+        cv = _np_of(out_count)
+        dst = np.repeat(_np_of(cur).reshape(-1), cv)
+        all_src.append(nv)
+        all_dst.append(dst)
+        cur = Tensor(jnp.asarray(np.unique(nv)))
+
+    src = np.concatenate(all_src) if all_src else np.zeros(0, np.int64)
+    dst = np.concatenate(all_dst) if all_dst else np.zeros(0, np.int64)
+    uniq, inv = np.unique(np.concatenate([dst, src]), return_inverse=True)
+    n_dst = len(dst)
+    # reindex_nodes: the INPUT nodes' positions in the relabeled space
+    in_nodes = _np_of(input_nodes).reshape(-1)
+    reindex = np.searchsorted(uniq, in_nodes)
+    return (Tensor(jnp.asarray(inv[n_dst:])),
+            Tensor(jnp.asarray(inv[:n_dst])),
+            Tensor(jnp.asarray(uniq)),
+            Tensor(jnp.asarray(reindex)))
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """Fused masked softmax (reference incubate.softmax_mask_fuse —
+    fused_softmax_mask CUDA kernel): softmax(x + mask) in one pass; XLA
+    fuses the add into the softmax the same way."""
+    import paddle_tpu.nn.functional as F
+
+    return F.softmax(x + mask, axis=-1)
+
+
+def identity_loss(x, reduction="none"):
+    """reference incubate.identity_loss: marks a tensor as a loss for
+    IPU pipelines; mathematically reduce-or-passthrough."""
+    if reduction in (0, "sum"):
+        return x.sum()
+    if reduction in (1, "mean"):
+        return x.mean()
+    return x
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    """Fused causal-masked softmax (reference
+    incubate.softmax_mask_fuse_upper_triangle): mask = upper triangle
+    (strictly future positions) of the last two dims."""
+    import jax.numpy as jnp
+
+    import paddle_tpu.nn.functional as F
+    from ..core.tensor import Tensor
+
+    v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    n, m = v.shape[-2], v.shape[-1]
+    causal = jnp.tril(jnp.ones((n, m), bool))
+    masked = jnp.where(causal, v, -1e30)
+    return F.softmax(Tensor(masked), axis=-1)
+
+
+def unzip(input, lod, len=None):  # noqa: A002
+    """reference incubate.unzip (pscore): scatter compressed rows back to
+    their LoD slots, zero elsewhere. lod: [B+1] offsets."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..core.tensor import Tensor
+
+    v = input._value if isinstance(input, Tensor) else jnp.asarray(input)
+    offs = np.asarray(lod._value if isinstance(lod, Tensor)
+                      else lod).astype(np.int64).reshape(-1)
+    n_rows = (offs.shape[0] - 1) if len is None else int(len)
+    rows = []
+    for b in range(n_rows):
+        if b + 1 < offs.shape[0] and offs[b + 1] > offs[b]:
+            rows.append(v[int(offs[b])])
+        else:
+            rows.append(jnp.zeros(v.shape[1:], v.dtype))
+    return Tensor(jnp.stack(rows))

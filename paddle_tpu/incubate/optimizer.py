@@ -96,3 +96,81 @@ def average_accumulates(param, sum1, sum2, sum3, num_acc, old_num_acc,
         old = num_acc
         num_acc = 0
     return s1, s2, s3, num_acc, old
+
+
+class LookAhead:
+    """Lookahead optimizer wrapper (reference incubate/optimizer/lookahead.py,
+    arXiv:1907.08610): run the inner optimizer k fast steps, then move
+    slow weights alpha toward the fast ones and reset."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError("alpha must be in [0, 1]")
+        if k < 1:
+            raise ValueError("k must be a positive integer")
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+        self._step_count = 0
+        self._slow = {}
+
+    def _params(self):
+        return self.inner_optimizer._get_params() \
+            if hasattr(self.inner_optimizer, "_get_params") \
+            else self.inner_optimizer._parameters
+
+    def step(self):
+        import jax.numpy as jnp
+
+        params = self._params()
+        if not self._slow:
+            for p in params:
+                self._slow[id(p)] = jnp.asarray(p._value)
+        self.inner_optimizer.step()
+        self._step_count += 1
+        if self._step_count % self.k == 0:
+            a = self.alpha
+            for p in params:
+                slow = self._slow[id(p)] + a * (jnp.asarray(p._value)
+                                                - self._slow[id(p)])
+                self._slow[id(p)] = slow
+                p._value = slow
+
+    def clear_grad(self, *a, **k):
+        return self.inner_optimizer.clear_grad(*a, **k)
+
+    def minimize(self, loss, *a, **k):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def state_dict(self):
+        import numpy as np
+
+        # slow weights keyed by parameter ORDER (ids don't survive a
+        # process restart)
+        slow = [np.asarray(self._slow[id(p)]) if id(p) in self._slow
+                else None for p in self._params()]
+        return {"inner": self.inner_optimizer.state_dict(),
+                "step_count": self._step_count,
+                "slow": slow}
+
+    def set_state_dict(self, sd):
+        import jax.numpy as jnp
+
+        self.inner_optimizer.set_state_dict(sd.get("inner", {}))
+        self._step_count = sd.get("step_count", 0)
+        slow = sd.get("slow")
+        if slow is not None:
+            self._slow = {}
+            for p, s in zip(self._params(), slow):
+                if s is not None:
+                    self._slow[id(p)] = jnp.asarray(s)
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return getattr(self.inner_optimizer, name)

@@ -133,3 +133,110 @@ class ImageFolder(Dataset):
 
     def __len__(self):
         return len(self.samples)
+
+
+class DatasetFolder(Dataset):
+    """Directory-per-class dataset (reference datasets/folder.py
+    DatasetFolder): root/<class_x>/xxx.ext. Default loader reads .npy
+    arrays (no PIL in this environment); pass `loader` for other
+    formats (e.g. vision.ops.read_file + decode_jpeg)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or (lambda p: np.load(p))
+        exts = tuple(extensions) if extensions is not None else (".npy",)
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        if not classes:
+            raise RuntimeError("no class folders under %s" % root)
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for fname in sorted(os.listdir(cdir)):
+                path = os.path.join(cdir, fname)
+                ok = (is_valid_file(path) if is_valid_file is not None
+                      else fname.lower().endswith(exts))
+                if ok:
+                    self.samples.append((path, self.class_to_idx[c]))
+        if not self.samples:
+            raise RuntimeError(
+                "no valid files under %s (extensions=%s)" % (root, exts))
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class Flowers(Dataset):
+    """Oxford-102 flowers (reference datasets/flowers.py). Local files
+    via data_file or deterministic synthetic fallback with the real
+    schema (same convention as Cifar10 above)."""
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=False,
+                 backend=None, synthetic=None, size=256):
+        self.transform = transform
+        if synthetic is None:
+            synthetic = data_file is None or not os.path.exists(data_file)
+        if not synthetic:
+            # npz with 'images' [N,3,H,W] uint8 + 'labels' [N] int
+            # (convert the original .mat offline; scipy isn't bundled)
+            blob = np.load(data_file)
+            self.images = np.asarray(blob["images"])
+            self.labels = np.asarray(blob["labels"]).astype(np.int64)
+        else:
+            rng = np.random.RandomState(0 if mode == "train" else 1)
+            self.images = (rng.rand(size, 3, 64, 64) * 255) \
+                .astype(np.uint8)
+            self.labels = rng.randint(0, 102, (size,)).astype(np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class VOC2012(Dataset):
+    """Pascal VOC 2012 segmentation (reference datasets/voc2012.py):
+    (image, seg-mask) pairs; synthetic fallback keeps the schema."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None, synthetic=None, size=128):
+        self.transform = transform
+        if synthetic is None:
+            synthetic = data_file is None or not os.path.exists(data_file)
+        if not synthetic:
+            # npz with 'images' [N,3,H,W] uint8 + 'masks' [N,H,W] int
+            # (extract the original tar offline)
+            blob = np.load(data_file)
+            self.images = np.asarray(blob["images"])
+            self.masks = np.asarray(blob["masks"]).astype(np.int64)
+        else:
+            rng = np.random.RandomState(0 if mode == "train" else 1)
+            self.images = (rng.rand(size, 3, 64, 64) * 255) \
+                .astype(np.uint8)
+            self.masks = rng.randint(0, 21, (size, 64, 64)) \
+                .astype(np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.masks[idx]
+
+    def __len__(self):
+        return len(self.images)

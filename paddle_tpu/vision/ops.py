@@ -11,6 +11,7 @@ import jax.numpy as jnp
 
 from ..core.dispatch import primitive
 from ..core.tensor import Tensor
+from ..nn.layer import Layer
 
 _A = jnp.asarray
 
@@ -326,3 +327,273 @@ def decode_jpeg(x, mode="unchanged"):
     else:
         arr = np.transpose(arr, (2, 0, 1))
     return Tensor(jnp.asarray(arr))
+
+
+# -- surface completions (reference vision/ops.py remaining names) -----------
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """reference vision/ops.py deform_conv2d (delegates to the shared
+    deformable_conv kernel body)."""
+    import paddle_tpu.nn.functional as F
+
+    return F.deformable_conv(x, offset, weight, mask=mask, bias=bias,
+                             stride=stride, padding=padding,
+                             dilation=dilation, groups=groups,
+                             deformable_groups=deformable_groups)
+
+
+class DeformConv2D(Layer):
+    """reference vision/ops.py DeformConv2D layer."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        ks = kernel_size if isinstance(kernel_size, (list, tuple)) \
+            else [kernel_size] * 2
+        from ..nn import initializer as I
+
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups] + list(ks),
+            attr=weight_attr,
+            default_initializer=None if weight_attr else I.XavierNormal())
+        self.bias = (None if bias_attr is False else self.create_parameter(
+            [out_channels], attr=bias_attr, is_bias=True))
+        self._kw = dict(stride=stride, padding=padding, dilation=dilation,
+                        deformable_groups=deformable_groups, groups=groups)
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, bias=self.bias,
+                             mask=mask, **self._kw)
+
+
+class RoIAlign(Layer):
+    """reference vision/ops.py RoIAlign layer."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._args = (output_size, spatial_scale)
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_align(x, boxes, boxes_num, self._args[0],
+                         spatial_scale=self._args[1])
+
+
+class RoIPool(Layer):
+    """reference vision/ops.py RoIPool layer."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._args = (output_size, spatial_scale)
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self._args[0],
+                        spatial_scale=self._args[1])
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """Position-sensitive RoI pooling (reference psroi_pool_kernel):
+    input channels C = out_c * ph * pw; bin (i, j) of a box averages its
+    OWN channel group — the R-FCN head op."""
+    xv = _A(x)
+    ph, pw = (output_size, output_size) if isinstance(output_size, int) \
+        else output_size
+    C = xv.shape[1]
+    if C % (ph * pw):
+        raise ValueError(
+            "psroi_pool: input channels (%d) must equal out_c * %d"
+            % (C, ph * pw))
+    out_c = C // (ph * pw)
+    bv = _A(boxes) * spatial_scale
+    n_boxes = bv.shape[0]
+    H, W = xv.shape[2], xv.shape[3]
+    outs = []
+    # batch index per box from boxes_num
+    import numpy as _np
+
+    counts = _np.asarray(_A(boxes_num)).astype(int)
+    batch_of = _np.repeat(_np.arange(len(counts)), counts)
+    for b in range(n_boxes):
+        x1, y1, x2, y2 = [float(v) for v in _np.asarray(bv[b])]
+        bh = max(y2 - y1, 0.1) / ph
+        bw = max(x2 - x1, 0.1) / pw
+        img = xv[int(batch_of[b])]
+        bins = []
+        for i in range(ph):
+            row = []
+            for j in range(pw):
+                ys = int(_np.floor(y1 + i * bh))
+                ye = max(int(_np.ceil(y1 + (i + 1) * bh)), ys + 1)
+                xs = int(_np.floor(x1 + j * bw))
+                xe = max(int(_np.ceil(x1 + (j + 1) * bw)), xs + 1)
+                ys, ye = _np.clip([ys, ye], 0, H)
+                xs, xe = _np.clip([xs, xe], 0, W)
+                # channel group for bin (i, j)
+                ch = slice((i * pw + j) * out_c, (i * pw + j + 1) * out_c)
+                patch = img[ch, ys:ye, xs:xe]
+                row.append(patch.mean(axis=(1, 2)) if patch.size
+                           else jnp.zeros((out_c,), xv.dtype))
+            bins.append(jnp.stack(row, axis=-1))
+        outs.append(jnp.stack(bins, axis=-2))
+    return Tensor(jnp.stack(outs))
+
+
+class PSRoIPool(Layer):
+    """reference vision/ops.py PSRoIPool layer."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._args = (output_size, spatial_scale)
+
+    def forward(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self._args[0],
+                          self._args[1])
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold,
+               nms_top_k, keep_top_k, use_gaussian=False, gaussian_sigma=2.0,
+               background_label=0, normalized=True, return_index=False,
+               return_rois_num=True, name=None):
+    """Matrix NMS (reference matrix_nms_kernel / SOLOv2): decay each
+    box's score by its max-IoU overlap with higher-scored boxes of the
+    same class, in one matrix pass instead of sequential suppression."""
+    import numpy as _np
+
+    bv = _np.asarray(_A(bboxes))   # [N, M, 4]
+    sv = _np.asarray(_A(scores))   # [N, C, M]
+    all_out, all_idx, nums = [], [], []
+    for n in range(bv.shape[0]):
+        dets = []
+        idxs = []
+        for c in range(sv.shape[1]):
+            if c == background_label:
+                continue
+            s = sv[n, c]
+            keep = _np.nonzero(s > score_threshold)[0]
+            if keep.size == 0:
+                continue
+            order = keep[_np.argsort(-s[keep])][:nms_top_k]
+            boxes_c = bv[n, order]
+            scores_c = s[order]
+            # pairwise IoU
+            x1 = _np.maximum(boxes_c[:, None, 0], boxes_c[None, :, 0])
+            y1 = _np.maximum(boxes_c[:, None, 1], boxes_c[None, :, 1])
+            x2 = _np.minimum(boxes_c[:, None, 2], boxes_c[None, :, 2])
+            y2 = _np.minimum(boxes_c[:, None, 3], boxes_c[None, :, 3])
+            inter = _np.clip(x2 - x1, 0, None) * _np.clip(y2 - y1, 0, None)
+            area = (boxes_c[:, 2] - boxes_c[:, 0]) \
+                * (boxes_c[:, 3] - boxes_c[:, 1])
+            iou = inter / _np.maximum(area[:, None] + area[None, :] - inter,
+                                      1e-9)
+            iou = _np.triu(iou, k=1)
+            # compensate_i = max overlap of box i with any HIGHER-scored
+            # box (column max of the upper triangle) — SOLOv2 eq. (4)
+            compensate = iou.max(axis=0)
+            if use_gaussian:
+                decay = _np.exp(-(iou ** 2 - compensate[:, None] ** 2)
+                                / gaussian_sigma).min(axis=0)
+            else:
+                decay = ((1 - iou)
+                         / _np.maximum(1 - compensate[:, None], 1e-9)) \
+                    .min(axis=0)
+            new_scores = scores_c * decay
+            sel = new_scores > post_threshold
+            for k in _np.nonzero(sel)[0]:
+                dets.append([c, new_scores[k]] + boxes_c[k].tolist())
+                idxs.append(order[k])
+        dets = _np.asarray(dets, _np.float32).reshape(-1, 6)
+        if keep_top_k > 0 and dets.shape[0] > keep_top_k:
+            top = _np.argsort(-dets[:, 1])[:keep_top_k]
+            dets = dets[top]
+            idxs = [idxs[i] for i in top]
+        all_out.append(dets)
+        all_idx.extend(idxs)
+        nums.append(dets.shape[0])
+    out = Tensor(jnp.asarray(_np.concatenate(all_out, axis=0)
+                             if all_out else _np.zeros((0, 6), _np.float32)))
+    rois_num = Tensor(jnp.asarray(_np.asarray(nums, _np.int32)))
+    if return_index:
+        index = Tensor(jnp.asarray(_np.asarray(all_idx, _np.int32)))
+        return (out, index, rois_num) if return_rois_num else (out, index)
+    return (out, rois_num) if return_rois_num else out
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, name=None, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5):
+    """Decode YOLOv3 head output to boxes+scores (reference
+    yolo_box_kernel): x [N, len(anchors)/2*(5+C), H, W]."""
+    xv = _A(x).astype(jnp.float32)
+    N, _, H, W = xv.shape
+    na = len(anchors) // 2
+    anc = jnp.asarray(anchors, jnp.float32).reshape(na, 2)
+    pred = xv.reshape(N, na, 5 + class_num, H, W)
+    gx = (jnp.arange(W).reshape(1, 1, 1, W))
+    gy = (jnp.arange(H).reshape(1, 1, H, 1))
+    sig = jax.nn.sigmoid
+    bx = (sig(pred[:, :, 0]) * scale_x_y
+          - (scale_x_y - 1) / 2 + gx) / W
+    by = (sig(pred[:, :, 1]) * scale_x_y
+          - (scale_x_y - 1) / 2 + gy) / H
+    bw = jnp.exp(pred[:, :, 2]) * anc[None, :, 0, None, None] \
+        / (W * downsample_ratio)
+    bh = jnp.exp(pred[:, :, 3]) * anc[None, :, 1, None, None] \
+        / (H * downsample_ratio)
+    conf = sig(pred[:, :, 4])
+    probs = sig(pred[:, :, 5:]) * conf[:, :, None]
+    imgs = _A(img_size).astype(jnp.float32)  # [N, 2] (h, w)
+    ih = imgs[:, 0].reshape(N, 1, 1, 1)
+    iw = imgs[:, 1].reshape(N, 1, 1, 1)
+    x1 = (bx - bw / 2) * iw
+    y1 = (by - bh / 2) * ih
+    x2 = (bx + bw / 2) * iw
+    y2 = (by + bh / 2) * ih
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0, iw - 1)
+        y1 = jnp.clip(y1, 0, ih - 1)
+        x2 = jnp.clip(x2, 0, iw - 1)
+        y2 = jnp.clip(y2, 0, ih - 1)
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1).reshape(N, -1, 4)
+    scores = probs.transpose(0, 1, 3, 4, 2).reshape(N, -1, class_num)
+    mask = (conf > conf_thresh).reshape(N, -1, 1)
+    return Tensor(boxes * mask), Tensor(scores * mask)
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """reference yolo_loss: full YOLOv3 target assignment is a training
+    pipeline concern; the TPU stack trains detection heads with the
+    composable losses (sigmoid bce + iou) — refuse with guidance."""
+    raise NotImplementedError(
+        "yolo_loss: compose F.binary_cross_entropy_with_logits over "
+        "yolo_box-decoded outputs (the reference's monolithic kernel "
+        "bundles target assignment; see vision/ops.py yolo_box)")
+
+
+def generate_proposals_v2(scores, bbox_deltas, img_size, anchors,
+                          variances, pre_nms_top_n=6000,
+                          post_nms_top_n=1000, nms_thresh=0.5,
+                          min_size=0.1, eta=1.0, pixel_offset=False,
+                          return_rois_num=False, name=None):
+    """v2 = v1 with pixel_offset semantics (reference
+    generate_proposals_v2_op); delegates to the shared implementation."""
+    return generate_proposals(scores, bbox_deltas, img_size, anchors,
+                              variances, pre_nms_top_n=pre_nms_top_n,
+                              post_nms_top_n=post_nms_top_n,
+                              nms_thresh=nms_thresh, min_size=min_size,
+                              eta=eta, pixel_offset=pixel_offset,
+                              return_rois_num=return_rois_num)
+
+
+def read_file(filename, name=None):
+    """reference vision/ops.py read_file: raw file bytes as a uint8
+    tensor (pair with decode_jpeg)."""
+    with open(filename, "rb") as f:
+        data = f.read()
+    import numpy as _np
+
+    return Tensor(jnp.asarray(_np.frombuffer(data, _np.uint8)))

@@ -141,3 +141,35 @@ def normalize(img, mean, std, data_format="CHW"):
 
 def resize(img, size, interpolation="bilinear"):
     return Resize(size, interpolation)(img)
+
+
+from .transforms_extras import (  # noqa: F401,E402
+    BaseTransform,
+    BrightnessTransform,
+    ColorJitter,
+    ContrastTransform,
+    Grayscale,
+    HueTransform,
+    Pad,
+    RandomAffine,
+    RandomErasing,
+    RandomPerspective,
+    RandomResizedCrop,
+    RandomRotation,
+    SaturationTransform,
+    Transpose,
+    adjust_brightness,
+    adjust_contrast,
+    adjust_hue,
+    adjust_saturation,
+    affine,
+    center_crop,
+    crop,
+    erase,
+    hflip,
+    pad,
+    perspective,
+    rotate,
+    to_grayscale,
+    vflip,
+)

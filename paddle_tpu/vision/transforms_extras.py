@@ -1,0 +1,551 @@
+"""vision.transforms completions: color/geometry ops + their classes.
+
+Parity: reference python/paddle/vision/transforms/{transforms,
+functional}.py. Images are numpy/jnp arrays, HWC by default (the
+reference's numpy backend convention); geometric warps ride
+F.affine_grid + F.grid_sample — the same pair the reference's tensor
+backend uses — so everything stays XLA-traceable.
+"""
+from __future__ import annotations
+
+import numbers
+import random as _pyrandom
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = [
+    "hflip", "vflip", "crop", "center_crop", "pad", "adjust_brightness",
+    "adjust_contrast", "adjust_saturation", "adjust_hue", "to_grayscale",
+    "rotate", "affine", "perspective", "erase",
+    "BaseTransform", "Transpose", "BrightnessTransform",
+    "ContrastTransform", "SaturationTransform", "HueTransform",
+    "ColorJitter", "Grayscale", "Pad", "RandomRotation", "RandomAffine",
+    "RandomPerspective", "RandomErasing", "RandomResizedCrop",
+]
+
+
+def _arr(img):
+    if isinstance(img, Tensor):
+        return np.asarray(img._value)
+    return np.asarray(img)
+
+
+def _wrap(out, like):
+    if isinstance(like, Tensor):
+        return Tensor(jnp.asarray(out))
+    return out
+
+
+def _is_chw(img):
+    a = _arr(img)
+    return a.ndim == 3 and a.shape[0] in (1, 3) and a.shape[2] not in (1, 3)
+
+
+# -- flips / crops / pad -----------------------------------------------------
+
+def hflip(img):
+    """reference functional.hflip (width axis)."""
+    a = _arr(img)
+    return _wrap(a[..., ::-1] if not _is_chw(img) and a.ndim == 2
+                 else (a[:, :, ::-1] if _is_chw(img) else a[:, ::-1]),
+                 img)
+
+
+def vflip(img):
+    a = _arr(img)
+    if _is_chw(img):
+        return _wrap(a[:, ::-1], img)
+    return _wrap(a[::-1], img)
+
+
+def crop(img, top, left, height, width):
+    a = _arr(img)
+    if _is_chw(img):
+        return _wrap(a[:, top:top + height, left:left + width], img)
+    return _wrap(a[top:top + height, left:left + width], img)
+
+
+def center_crop(img, output_size):
+    a = _arr(img)
+    oh, ow = (output_size, output_size) if isinstance(
+        output_size, numbers.Number) else output_size
+    h, w = (a.shape[1], a.shape[2]) if _is_chw(img) else a.shape[:2]
+    top = max((h - oh) // 2, 0)
+    left = max((w - ow) // 2, 0)
+    return crop(img, top, left, oh, ow)
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    """reference functional.pad: int | [lr_tb] | [l, t, r, b]."""
+    if isinstance(padding, numbers.Number):
+        pl = pr = pt = pb = int(padding)
+    elif len(padding) == 2:
+        pl = pr = int(padding[0])
+        pt = pb = int(padding[1])
+    else:
+        pl, pt, pr, pb = [int(p) for p in padding]
+    a = _arr(img)
+    mode = {"constant": "constant", "edge": "edge", "reflect": "reflect",
+            "symmetric": "symmetric"}[padding_mode]
+    if _is_chw(img):
+        widths = [(0, 0), (pt, pb), (pl, pr)]
+    elif a.ndim == 3:
+        widths = [(pt, pb), (pl, pr), (0, 0)]
+    else:
+        widths = [(pt, pb), (pl, pr)]
+    kw = {"constant_values": fill} if mode == "constant" else {}
+    return _wrap(np.pad(a, widths, mode=mode, **kw), img)
+
+
+# -- color -------------------------------------------------------------------
+
+def _chan_axis(img):
+    return 0 if _is_chw(img) else -1
+
+
+def adjust_brightness(img, brightness_factor):
+    """reference functional.adjust_brightness: img * factor."""
+    a = _arr(img).astype(np.float32)
+    hi = 255.0 if _arr(img).dtype == np.uint8 else 1.0
+    out = np.clip(a * brightness_factor, 0, hi)
+    return _wrap(out.astype(_arr(img).dtype), img)
+
+
+def adjust_contrast(img, contrast_factor):
+    """Blend with the mean of the grayscale image."""
+    a = _arr(img).astype(np.float32)
+    hi = 255.0 if _arr(img).dtype == np.uint8 else 1.0
+    mean = _grayscale_np(a, _chan_axis(img)).mean()
+    out = np.clip(mean + contrast_factor * (a - mean), 0, hi)
+    return _wrap(out.astype(_arr(img).dtype), img)
+
+
+def adjust_saturation(img, saturation_factor):
+    """Blend with the grayscale image."""
+    a = _arr(img).astype(np.float32)
+    hi = 255.0 if _arr(img).dtype == np.uint8 else 1.0
+    gray = _grayscale_np(a, _chan_axis(img), keep_channels=True)
+    out = np.clip(gray + saturation_factor * (a - gray), 0, hi)
+    return _wrap(out.astype(_arr(img).dtype), img)
+
+
+def _grayscale_np(a, ch_axis, keep_channels=False):
+    w = np.asarray([0.299, 0.587, 0.114], np.float32)
+    if a.ndim == 2:
+        return a
+    g = np.tensordot(np.moveaxis(a, ch_axis, -1)[..., :3], w, axes=1)
+    if keep_channels:
+        g = np.repeat(np.expand_dims(g, ch_axis), a.shape[ch_axis],
+                      axis=ch_axis)
+    return g
+
+
+def adjust_hue(img, hue_factor):
+    """Shift hue by hue_factor (in [-0.5, 0.5]) via HSV round-trip
+    (reference functional.adjust_hue)."""
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError("hue_factor must be in [-0.5, 0.5]")
+    a = _arr(img)
+    dtype = a.dtype
+    hi = 255.0 if dtype == np.uint8 else 1.0
+    x = np.moveaxis(a.astype(np.float32) / hi, _chan_axis(img), -1)
+    r, g, b = x[..., 0], x[..., 1], x[..., 2]
+    maxc = x.max(-1)
+    minc = x.min(-1)
+    v = maxc
+    d = maxc - minc
+    s = np.where(maxc > 0, d / np.maximum(maxc, 1e-12), 0.0)
+    rc = (maxc - r) / np.maximum(d, 1e-12)
+    gc = (maxc - g) / np.maximum(d, 1e-12)
+    bc = (maxc - b) / np.maximum(d, 1e-12)
+    h = np.where(maxc == r, bc - gc,
+                 np.where(maxc == g, 2.0 + rc - bc, 4.0 + gc - rc))
+    h = np.where(d == 0, 0.0, (h / 6.0) % 1.0)
+    h = (h + hue_factor) % 1.0
+    i = np.floor(h * 6.0)
+    f = h * 6.0 - i
+    p = v * (1.0 - s)
+    q = v * (1.0 - s * f)
+    t = v * (1.0 - s * (1.0 - f))
+    i = i.astype(np.int32) % 6
+    conds = [i == k for k in range(6)]
+    r2 = np.select(conds, [v, q, p, p, t, v])
+    g2 = np.select(conds, [t, v, v, q, p, p])
+    b2 = np.select(conds, [p, p, t, v, v, q])
+    out = np.stack([r2, g2, b2], axis=-1)
+    out = np.moveaxis(out, -1, _chan_axis(img)) * hi
+    return _wrap(np.clip(out, 0, hi).astype(dtype), img)
+
+
+def to_grayscale(img, num_output_channels=1):
+    a = _arr(img).astype(np.float32)
+    ax = _chan_axis(img)
+    g = _grayscale_np(a, ax)
+    g = np.expand_dims(g, ax)
+    if num_output_channels == 3:
+        g = np.repeat(g, 3, axis=ax)
+    return _wrap(g.astype(_arr(img).dtype), img)
+
+
+# -- geometric warps over grid_sample ----------------------------------------
+
+def _warp(img, theta_2x3):
+    """Apply an inverse-mapping affine via F.affine_grid + grid_sample."""
+    import paddle_tpu.nn.functional as F
+
+    a = _arr(img).astype(np.float32)
+    chw = a if _is_chw(img) else np.moveaxis(a, -1, 0)
+    x = Tensor(jnp.asarray(chw[None]))
+    theta = Tensor(jnp.asarray(theta_2x3[None], jnp.float32))
+    grid = F.affine_grid(theta, [1, chw.shape[0], chw.shape[1],
+                                 chw.shape[2]], align_corners=False)
+    out = F.grid_sample(x, grid, align_corners=False)
+    res = np.asarray(out._value)[0]
+    if not _is_chw(img):
+        res = np.moveaxis(res, 0, -1)
+    return _wrap(res.astype(_arr(img).dtype), img)
+
+
+def _affine_theta(angle, translate, scale, shear, h, w):
+    rot = np.deg2rad(angle)
+    sx, sy = np.deg2rad(shear[0]), np.deg2rad(shear[1])
+    # forward affine (center-anchored), normalized coords
+    a = np.cos(rot - sy) / max(np.cos(sy), 1e-9)
+    b = -np.cos(rot - sy) * np.tan(sx) / max(np.cos(sy), 1e-9) \
+        - np.sin(rot)
+    c = np.sin(rot - sy) / max(np.cos(sy), 1e-9)
+    d = -np.sin(rot - sy) * np.tan(sx) / max(np.cos(sy), 1e-9) \
+        + np.cos(rot)
+    m = np.asarray([[a, b, 0.0], [c, d, 0.0]], np.float32) * scale
+    m[0, 2] = translate[0] * 2.0 / w
+    m[1, 2] = translate[1] * 2.0 / h
+    # grid_sample consumes the INVERSE map
+    full = np.eye(3, dtype=np.float32)
+    full[:2] = m
+    inv = np.linalg.inv(full)
+    return inv[:2]
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    """reference functional.rotate (expand/center subset: center-anchored,
+    no canvas expansion — documented deviation; fill is 0)."""
+    a = _arr(img)
+    h, w = (a.shape[1], a.shape[2]) if _is_chw(img) else a.shape[:2]
+    return _warp(img, _affine_theta(-angle, (0, 0), 1.0, (0, 0), h, w))
+
+
+def affine(img, angle, translate, scale, shear, interpolation="nearest",
+           center=None, fill=0):
+    """reference functional.affine."""
+    if isinstance(shear, numbers.Number):
+        shear = (shear, 0.0)
+    a = _arr(img)
+    h, w = (a.shape[1], a.shape[2]) if _is_chw(img) else a.shape[:2]
+    return _warp(img, _affine_theta(-angle, translate, 1.0 / scale, shear,
+                                    h, w))
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest",
+                fill=0):
+    """reference functional.perspective: warp mapping endpoints back to
+    startpoints (least-squares homography, applied via a dense grid)."""
+    import paddle_tpu.nn.functional as F
+
+    a = _arr(img).astype(np.float32)
+    chw = a if _is_chw(img) else np.moveaxis(a, -1, 0)
+    h, w = chw.shape[1], chw.shape[2]
+    # solve homography endpoints -> startpoints (inverse map)
+    src = np.asarray(endpoints, np.float32)
+    dst = np.asarray(startpoints, np.float32)
+    A = []
+    for (x, y), (u, v) in zip(src, dst):
+        A.append([x, y, 1, 0, 0, 0, -u * x, -u * y])
+        A.append([0, 0, 0, x, y, 1, -v * x, -v * y])
+    A = np.asarray(A, np.float32)
+    rhs = dst.reshape(-1)
+    coef, *_ = np.linalg.lstsq(A, rhs, rcond=None)
+    H = np.append(coef, 1.0).reshape(3, 3)
+    ys, xs = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+    ones = np.ones_like(xs)
+    pts = np.stack([xs, ys, ones], axis=-1).astype(np.float32)
+    mapped = pts @ H.T
+    mx = mapped[..., 0] / np.maximum(mapped[..., 2], 1e-9)
+    my = mapped[..., 1] / np.maximum(mapped[..., 2], 1e-9)
+    # normalize to [-1, 1] for grid_sample
+    gx = mx / (w - 1) * 2.0 - 1.0
+    gy = my / (h - 1) * 2.0 - 1.0
+    grid = Tensor(jnp.asarray(
+        np.stack([gx, gy], axis=-1)[None], jnp.float32))
+    out = F.grid_sample(Tensor(jnp.asarray(chw[None])), grid,
+                        align_corners=True)
+    res = np.asarray(out._value)[0]
+    if not _is_chw(img):
+        res = np.moveaxis(res, 0, -1)
+    return _wrap(res.astype(_arr(img).dtype), img)
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    """reference functional.erase: fill box [i:i+h, j:j+w] with v."""
+    a = _arr(img).copy()
+    if _is_chw(img):
+        a[:, i:i + h, j:j + w] = v
+    else:
+        a[i:i + h, j:j + w] = v
+    return _wrap(a, img)
+
+
+# -- transform classes -------------------------------------------------------
+
+class BaseTransform:
+    """reference transforms.BaseTransform: keys-aware callable base."""
+
+    def __init__(self, keys=None):
+        self.keys = keys
+
+    def _apply_image(self, img):
+        raise NotImplementedError
+
+    def __call__(self, inputs):
+        if self.keys is None or not isinstance(inputs, (list, tuple)):
+            return self._apply_image(inputs)
+        out = []
+        for key, data in zip(self.keys, inputs):
+            fn = getattr(self, "_apply_" + key, None)
+            out.append(fn(data) if fn is not None else data)
+        return tuple(out)
+
+
+class Transpose(BaseTransform):
+    """HWC <-> CHW (reference transforms.Transpose)."""
+
+    def __init__(self, order=(2, 0, 1), keys=None):
+        super().__init__(keys)
+        self.order = order
+
+    def _apply_image(self, img):
+        return _wrap(np.transpose(_arr(img), self.order), img)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        f = _pyrandom.uniform(max(0, 1 - self.value), 1 + self.value)
+        return adjust_brightness(img, f)
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        if value < 0:
+            raise ValueError("contrast value must be non-negative")
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        f = _pyrandom.uniform(max(0, 1 - self.value), 1 + self.value)
+        return adjust_contrast(img, f)
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        f = _pyrandom.uniform(max(0, 1 - self.value), 1 + self.value)
+        return adjust_saturation(img, f)
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        if not 0 <= value <= 0.5:
+            raise ValueError("hue value must be in [0, 0.5]")
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        return adjust_hue(img, _pyrandom.uniform(-self.value, self.value))
+
+
+class ColorJitter(BaseTransform):
+    """reference transforms.ColorJitter: random order of the four
+    component jitters."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        super().__init__(keys)
+        self.transforms = [
+            BrightnessTransform(brightness),
+            ContrastTransform(contrast),
+            SaturationTransform(saturation),
+            HueTransform(hue),
+        ]
+
+    def _apply_image(self, img):
+        order = list(range(4))
+        _pyrandom.shuffle(order)
+        for i in order:
+            img = self.transforms[i]._apply_image(img)
+        return img
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        super().__init__(keys)
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        return to_grayscale(img, self.num_output_channels)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant",
+                 keys=None):
+        super().__init__(keys)
+        self._args = (padding, fill, padding_mode)
+
+    def _apply_image(self, img):
+        return pad(img, *self._args)
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        super().__init__(keys)
+        if isinstance(degrees, numbers.Number):
+            degrees = (-abs(degrees), abs(degrees))
+        self.degrees = degrees
+        self._kw = dict(interpolation=interpolation, expand=expand,
+                        center=center, fill=fill)
+
+    def _apply_image(self, img):
+        angle = _pyrandom.uniform(*self.degrees)
+        return rotate(img, angle, **self._kw)
+
+
+class RandomAffine(BaseTransform):
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        super().__init__(keys)
+        if isinstance(degrees, numbers.Number):
+            degrees = (-abs(degrees), abs(degrees))
+        self.degrees = degrees
+        self.translate = translate
+        self.scale = scale
+        self.shear = shear
+
+    def _apply_image(self, img):
+        a = _arr(img)
+        h, w = (a.shape[1], a.shape[2]) if _is_chw(img) else a.shape[:2]
+        angle = _pyrandom.uniform(*self.degrees)
+        tx = ty = 0.0
+        if self.translate is not None:
+            tx = _pyrandom.uniform(-self.translate[0], self.translate[0]) * w
+            ty = _pyrandom.uniform(-self.translate[1], self.translate[1]) * h
+        sc = _pyrandom.uniform(*self.scale) if self.scale else 1.0
+        sh = (_pyrandom.uniform(-self.shear[0], self.shear[0]), 0.0) \
+            if self.shear else (0.0, 0.0)
+        return affine(img, angle, (tx, ty), sc, sh)
+
+
+class RandomPerspective(BaseTransform):
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+
+    def _apply_image(self, img):
+        if _pyrandom.random() >= self.prob:
+            return img
+        a = _arr(img)
+        h, w = (a.shape[1], a.shape[2]) if _is_chw(img) else a.shape[:2]
+        d = self.distortion_scale
+        dx, dy = int(d * w / 2), int(d * h / 2)
+
+        def jit(x, y, sx, sy):
+            return (x + _pyrandom.randint(0, max(dx, 1)) * sx,
+                    y + _pyrandom.randint(0, max(dy, 1)) * sy)
+
+        start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        end = [jit(0, 0, 1, 1), jit(w - 1, 0, -1, 1),
+               jit(w - 1, h - 1, -1, -1), jit(0, h - 1, 1, -1)]
+        return perspective(img, start, end)
+
+
+class RandomErasing(BaseTransform):
+    """reference transforms.RandomErasing (the cutout regularizer)."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+
+    def _apply_image(self, img):
+        if _pyrandom.random() >= self.prob:
+            return img
+        a = _arr(img)
+        h, w = (a.shape[1], a.shape[2]) if _is_chw(img) else a.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = _pyrandom.uniform(*self.scale) * area
+            ar = _pyrandom.uniform(*self.ratio)
+            eh = int(round(np.sqrt(target * ar)))
+            ew = int(round(np.sqrt(target / ar)))
+            if eh < h and ew < w:
+                i = _pyrandom.randint(0, h - eh)
+                j = _pyrandom.randint(0, w - ew)
+                return erase(img, i, j, eh, ew, self.value)
+        return img
+
+
+class RandomResizedCrop(BaseTransform):
+    """reference transforms.RandomResizedCrop: random area/aspect crop
+    then resize."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3. / 4, 4. / 3),
+                 interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, numbers.Number) \
+            else tuple(size)
+        self.scale = scale
+        self.ratio = ratio
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        from .transforms import resize as _resize
+
+        a = _arr(img)
+        h, w = (a.shape[1], a.shape[2]) if _is_chw(img) else a.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = _pyrandom.uniform(*self.scale) * area
+            log_ratio = (np.log(self.ratio[0]), np.log(self.ratio[1]))
+            ar = np.exp(_pyrandom.uniform(*log_ratio))
+            ch = int(round(np.sqrt(target / ar)))
+            cw = int(round(np.sqrt(target * ar)))
+            if 0 < ch <= h and 0 < cw <= w:
+                i = _pyrandom.randint(0, h - ch)
+                j = _pyrandom.randint(0, w - cw)
+                return _resize(crop(img, i, j, ch, cw), self.size,
+                               self.interpolation)
+        return _resize(center_crop(img, min(h, w)), self.size,
+                       self.interpolation)

@@ -1,0 +1,331 @@
+"""vision.transforms/ops/datasets + incubate + distribution surface
+completions (reference vision/transforms, vision/ops.py, incubate/,
+distribution/ remaining names)."""
+import os
+import re
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.incubate as incubate
+from paddle_tpu import distribution as D
+from paddle_tpu import vision
+
+REF = "/root/reference/python/paddle"
+_REF_GATE = pytest.mark.skipif(not os.path.isdir(REF),
+                               reason="reference tree not mounted")
+
+
+def _ref_all(path):
+    src = open(REF + "/" + path).read()
+    return sorted(set(re.findall(r"^\s+'(\w+)',", src, re.M)))
+
+
+@_REF_GATE
+class TestSurfaceGates:
+    @pytest.mark.parametrize("mod,path", [
+        ("transforms", "vision/transforms/__init__.py"),
+        ("datasets", "vision/datasets/__init__.py"),
+        ("models", "vision/models/__init__.py"),
+    ])
+    def test_vision_surfaces(self, mod, path):
+        m = getattr(vision, mod)
+        missing = [n for n in _ref_all(path) if not hasattr(m, n)]
+        assert missing == [], missing
+
+    def test_incubate_and_distribution(self):
+        for mod, path in [(incubate, "incubate/__init__.py"),
+                          (D, "distribution/__init__.py")]:
+            missing = [n for n in _ref_all(path) if not hasattr(mod, n)]
+            assert missing == [], missing
+
+
+class TestTransforms:
+    def _img(self):
+        rng = np.random.RandomState(0)
+        return (rng.rand(8, 8, 3) * 255).astype(np.uint8)
+
+    def test_flips_crop_pad(self):
+        img = self._img()
+        np.testing.assert_array_equal(
+            vision.transforms.hflip(img), img[:, ::-1])
+        np.testing.assert_array_equal(
+            vision.transforms.vflip(img), img[::-1])
+        c = vision.transforms.crop(img, 1, 2, 3, 4)
+        np.testing.assert_array_equal(c, img[1:4, 2:6])
+        cc = vision.transforms.center_crop(img, 4)
+        np.testing.assert_array_equal(cc, img[2:6, 2:6])
+        p = vision.transforms.pad(img, 2)
+        assert p.shape == (12, 12, 3)
+        assert np.all(p[:2] == 0)
+
+    def test_color_ops(self):
+        img = self._img()
+        b = vision.transforms.adjust_brightness(img, 2.0)
+        assert b.dtype == np.uint8 and b.max() <= 255
+        g = vision.transforms.to_grayscale(img)
+        assert g.shape == (8, 8, 1)
+        g3 = vision.transforms.to_grayscale(img, 3)
+        assert g3.shape == (8, 8, 3)
+        # hue shift of 0 is identity (within rounding)
+        h0 = vision.transforms.adjust_hue(img, 0.0)
+        assert np.abs(h0.astype(int) - img.astype(int)).max() <= 1
+        with pytest.raises(ValueError):
+            vision.transforms.adjust_hue(img, 0.9)
+
+    def test_rotate_and_erase(self):
+        img = np.zeros((8, 8, 1), np.float32)
+        img[2, 2, 0] = 1.0
+        r180 = vision.transforms.rotate(img, 180.0)
+        # 180-degree rotation moves (2,2) to (5,5) (center-anchored)
+        assert abs(float(r180[5, 5, 0]) - 1.0) < 0.2
+        e = vision.transforms.erase(self._img(), 1, 1, 3, 3, 0)
+        assert np.all(e[1:4, 1:4] == 0)
+
+    def test_transform_classes_run(self):
+        img = self._img()
+        for t in [vision.transforms.ColorJitter(0.2, 0.2, 0.2, 0.1),
+                  vision.transforms.Grayscale(3),
+                  vision.transforms.Pad(1),
+                  vision.transforms.RandomRotation(10),
+                  vision.transforms.RandomErasing(prob=1.0),
+                  vision.transforms.RandomResizedCrop(6),
+                  vision.transforms.RandomPerspective(prob=1.0),
+                  vision.transforms.Transpose()]:
+            out = t(img)
+            assert out is not None
+
+    def test_compose_chain(self):
+        chain = vision.transforms.Compose([
+            vision.transforms.Pad(1),
+            vision.transforms.RandomResizedCrop(6),
+            vision.transforms.Transpose(),
+        ])
+        out = chain(self._img())
+        assert out.shape == (3, 6, 6)
+
+
+class TestVisionOps:
+    def test_yolo_box_shapes(self):
+        paddle.seed(0)
+        na, C, H, W = 3, 4, 2, 2
+        x = paddle.to_tensor(np.random.RandomState(0).randn(
+            1, na * (5 + C), H, W).astype(np.float32))
+        boxes, scores = vision.ops.yolo_box(
+            x, paddle.to_tensor(np.asarray([[64, 64]], np.int32)),
+            anchors=[10, 13, 16, 30, 33, 23], class_num=C,
+            conf_thresh=0.01, downsample_ratio=32)
+        assert boxes.shape == [1, na * H * W, 4]
+        assert scores.shape == [1, na * H * W, C]
+
+    def test_matrix_nms_decays_overlaps(self):
+        boxes = np.asarray([[[0, 0, 10, 10], [0, 0, 10, 10],
+                             [20, 20, 30, 30]]], np.float32)
+        scores = np.asarray([[[0.9, 0.85, 0.8]]], np.float32)
+        out, rois_num = vision.ops.matrix_nms(
+            paddle.to_tensor(boxes), paddle.to_tensor(scores),
+            score_threshold=0.1, post_threshold=0.0, nms_top_k=10,
+            keep_top_k=10, background_label=-1)
+        ov = np.asarray(out._value)
+        # the exact-duplicate box decays to score 0 and is filtered by
+        # post_threshold; winner + the far box survive
+        assert int(np.asarray(rois_num._value)[0]) == 2
+        top = ov[np.argsort(-ov[:, 1])]
+        np.testing.assert_allclose(top[0, 1], 0.9, rtol=1e-5)
+        np.testing.assert_allclose(top[1, 1], 0.8, rtol=1e-5)
+
+    def test_psroi_pool(self):
+        C_out, ph, pw = 2, 2, 2
+        x = paddle.to_tensor(np.arange(
+            1 * C_out * ph * pw * 4 * 4, dtype=np.float32)
+            .reshape(1, C_out * ph * pw, 4, 4))
+        boxes = paddle.to_tensor(np.asarray([[0, 0, 4, 4]], np.float32))
+        out = vision.ops.psroi_pool(
+            x, boxes, paddle.to_tensor(np.asarray([1], np.int32)),
+            (ph, pw))
+        assert out.shape == [1, C_out, ph, pw]
+
+    def test_deform_layer_and_read_file(self, tmp_path):
+        paddle.seed(1)
+        m = vision.ops.DeformConv2D(2, 3, 3, padding=1)
+        x = paddle.to_tensor(np.random.RandomState(2).randn(
+            1, 2, 4, 4).astype(np.float32))
+        offset = paddle.to_tensor(np.zeros((1, 18, 4, 4), np.float32))
+        out = m(x, offset)
+        assert out.shape == [1, 3, 4, 4]
+        f = tmp_path / "blob.bin"
+        f.write_bytes(b"\x01\x02\x03")
+        r = vision.ops.read_file(str(f))
+        np.testing.assert_array_equal(np.asarray(r._value), [1, 2, 3])
+
+
+class TestDatasets:
+    def test_dataset_folder(self, tmp_path):
+        for cls in ("cat", "dog"):
+            d = tmp_path / cls
+            d.mkdir()
+            for i in range(2):
+                np.save(d / ("img%d.npy" % i),
+                        np.full((2, 2), i, np.float32))
+        ds = vision.datasets.DatasetFolder(str(tmp_path))
+        assert len(ds) == 4
+        img, label = ds[0]
+        assert img.shape == (2, 2) and label == 0
+        assert ds.classes == ["cat", "dog"]
+        flat = vision.datasets.ImageFolder(str(tmp_path))
+        assert len(flat) == 4
+
+    def test_flowers_voc_synthetic(self):
+        fl = vision.datasets.Flowers(mode="train", size=8)
+        img, lbl = fl[0]
+        assert img.shape == (3, 64, 64) and 0 <= lbl < 102
+        voc = vision.datasets.VOC2012(mode="test", size=4)
+        img, mask = voc[1]
+        assert mask.shape == (64, 64)
+
+
+class TestIncubateExtras:
+    def test_lookahead_converges_and_syncs(self):
+        import paddle_tpu.nn as nn
+
+        paddle.seed(3)
+        m = nn.Linear(4, 1)
+        inner = paddle.optimizer.SGD(learning_rate=0.1,
+                                     parameters=m.parameters())
+        opt = incubate.LookAhead(inner, alpha=0.5, k=2)
+        X = np.random.RandomState(4).randn(16, 4).astype(np.float32)
+        Y = X @ np.ones((4, 1), np.float32)
+        first = None
+        for i in range(10):
+            loss = ((m(paddle.to_tensor(X))
+                     - paddle.to_tensor(Y)) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            first = first if first is not None else float(loss)
+        assert float(loss) < first
+
+    def test_segment_alias_and_masked_softmax(self):
+        x = paddle.to_tensor(np.asarray([[1.0, 2.0], [3.0, 4.0],
+                                         [5.0, 6.0]], np.float32))
+        seg = paddle.to_tensor(np.asarray([0, 0, 1], np.int64))
+        s = incubate.segment_sum(x, seg)
+        np.testing.assert_allclose(np.asarray(s._value),
+                                   [[4.0, 6.0], [5.0, 6.0]])
+        logits = paddle.to_tensor(np.zeros((1, 1, 3, 3), np.float32))
+        out = incubate.softmax_mask_fuse_upper_triangle(logits)
+        ov = np.asarray(out._value)[0, 0]
+        np.testing.assert_allclose(ov[0], [1.0, 0.0, 0.0], atol=1e-6)
+        np.testing.assert_allclose(ov[2], [1 / 3] * 3, rtol=1e-5)
+
+    def test_identity_loss_and_unzip(self):
+        x = paddle.to_tensor(np.asarray([1.0, 3.0], np.float32))
+        assert float(incubate.identity_loss(x, "mean")) == 2.0
+        lod = paddle.to_tensor(np.asarray([0, 1, 1, 2], np.int64))
+        data = paddle.to_tensor(np.asarray([[5.0], [7.0]], np.float32))
+        out = np.asarray(incubate.unzip(data, lod)._value)
+        np.testing.assert_allclose(out, [[5.0], [0.0], [7.0]])
+
+
+class TestDistributionExtras:
+    def test_independent_sums_event_dims(self):
+        base = D.Normal(loc=np.zeros((3, 2), np.float32),
+                        scale=np.ones((3, 2), np.float32))
+        ind = D.Independent(base, 1)
+        v = paddle.to_tensor(np.zeros((3, 2), np.float32))
+        lp = np.asarray(ind.log_prob(v)._value)
+        assert lp.shape == (3,)
+        base_lp = np.asarray(base.log_prob(v)._value)
+        np.testing.assert_allclose(lp, base_lp.sum(-1), rtol=1e-6)
+
+    def test_transformed_distribution_affine(self):
+        class Affine:
+            def forward(self, x):
+                return x * 2.0 + 1.0
+
+            def inverse(self, y):
+                return (y - 1.0) / 2.0
+
+            def forward_log_det_jacobian(self, x):
+                import math
+
+                return np.float32(math.log(2.0))
+
+        base = D.Normal(loc=0.0, scale=1.0)
+        td = D.TransformedDistribution(base, [Affine()])
+        y = paddle.to_tensor(np.asarray([1.0], np.float32))
+        lp = float(np.asarray(td.log_prob(y)._value).ravel()[0])
+        # y=1 -> x=0: N(0,1).logpdf(0) - log 2
+        want = -0.5 * np.log(2 * np.pi) - np.log(2.0)
+        np.testing.assert_allclose(lp, want, rtol=1e-5)
+        s = td.sample((4,))
+        assert np.asarray(s._value).shape[0] == 4
+
+
+class TestReviewRegressions:
+    def test_matrix_nms_partial_overlap_decays(self):
+        """Regression: compensate used the wrong axis, so PARTIAL
+        overlaps (iou<1) were not suppressed at all."""
+        boxes = np.asarray([[[0, 0, 10, 10], [0, 1, 10, 11],
+                             [50, 50, 60, 60]]], np.float32)  # iou~0.82
+        scores = np.asarray([[[0.9, 0.8, 0.7]]], np.float32)
+        out, _ = vision.ops.matrix_nms(
+            paddle.to_tensor(boxes), paddle.to_tensor(scores),
+            score_threshold=0.1, post_threshold=0.0, nms_top_k=10,
+            keep_top_k=10, background_label=-1)
+        ov = np.asarray(out._value)
+        by_score = dict()
+        for row in ov:
+            by_score[tuple(row[2:].tolist())] = row[1]
+        overlap_score = by_score[(0.0, 1.0, 10.0, 11.0)]
+        far_score = by_score[(50.0, 50.0, 60.0, 60.0)]
+        assert overlap_score < 0.3          # decayed hard (was 0.8)
+        np.testing.assert_allclose(far_score, 0.7, rtol=1e-5)
+
+    def test_generate_proposals_v2_pixel_offset_changes_result(self):
+        paddle.seed(5)
+        rng = np.random.RandomState(6)
+        scores = paddle.to_tensor(rng.rand(1, 2, 4, 4).astype(np.float32))
+        deltas = paddle.to_tensor(
+            (rng.randn(1, 8, 4, 4) * 0.1).astype(np.float32))
+        img = paddle.to_tensor(np.asarray([[32.0, 32.0]], np.float32))
+        anchors = paddle.to_tensor(
+            rng.rand(4, 4, 2, 4).astype(np.float32) * 16)
+        var = paddle.to_tensor(np.ones((4, 4, 2, 4), np.float32))
+        a = vision.ops.generate_proposals_v2(
+            scores, deltas, img, anchors, var, pixel_offset=False)
+        b = vision.ops.generate_proposals_v2(
+            scores, deltas, img, anchors, var, pixel_offset=True)
+        assert not np.allclose(np.asarray(a[0]._value),
+                               np.asarray(b[0]._value))
+
+    def test_lookahead_state_dict_carries_slow_weights(self):
+        import paddle_tpu.nn as nn
+
+        paddle.seed(7)
+        m = nn.Linear(2, 1)
+        opt = incubate.LookAhead(
+            paddle.optimizer.SGD(learning_rate=0.1,
+                                 parameters=m.parameters()),
+            alpha=0.5, k=5)
+        x = paddle.to_tensor(np.ones((4, 2), np.float32))
+        for _ in range(3):  # mid-cycle
+            loss = (m(x) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        sd = opt.state_dict()
+        assert sd["slow"][0] is not None  # slow anchor persisted
+
+    def test_unzip_len_bounds_output(self):
+        lod = paddle.to_tensor(np.asarray([0, 1, 1], np.int64))
+        data = paddle.to_tensor(np.asarray([[5.0]], np.float32))
+        out = np.asarray(incubate.unzip(data, lod, len=4)._value)
+        assert out.shape == (4, 1)
+        np.testing.assert_allclose(out[:, 0], [5.0, 0.0, 0.0, 0.0])
+
+    def test_khop_sampler_eids_refuses(self):
+        with pytest.raises(NotImplementedError, match="eids"):
+            incubate.graph_khop_sampler(None, None, None, [2],
+                                        return_eids=True)
