@@ -36,6 +36,20 @@ class ProfilerTarget(enum.Enum):
     TPU = 1  # reference: GPU
 
 
+class SummaryView(enum.Enum):
+    """reference profiler.SummaryView: which summary tables to print."""
+
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+    MemoryManipulationView = 7
+    UDFView = 8
+
+
 def make_scheduler(*, closed, ready, record, repeat=0, skip_first=0):
     """Step-window scheduler (reference profiler.py:170 make_scheduler)."""
 
