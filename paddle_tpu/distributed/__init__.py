@@ -13,6 +13,7 @@ from . import (  # noqa: F401
     rpc,
     stream,
     topology,
+    utils,
 )
 from .auto_parallel import ProcessMesh, shard_op, shard_tensor  # noqa: F401
 from .collective import (  # noqa: F401

@@ -1,0 +1,15 @@
+"""paddle.distributed.utils namespace (reference distributed/utils/:
+moe_utils global_scatter/global_gather + log/launch helpers)."""
+from __future__ import annotations
+
+from ...parallel.moe import global_gather, global_scatter  # noqa: F401
+
+
+def get_logger(log_level=None, name="paddle_tpu.distributed"):
+    """reference distributed/utils/log_utils.py get_logger."""
+    import logging
+
+    logger = logging.getLogger(name)
+    if log_level is not None:
+        logger.setLevel(log_level)
+    return logger
