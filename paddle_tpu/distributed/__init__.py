@@ -11,6 +11,7 @@ from . import (  # noqa: F401
     launch,
     mesh,
     rpc,
+    sharding,
     stream,
     topology,
     utils,

@@ -1,0 +1,7 @@
+"""paddle.distributed.sharding namespace (reference
+distributed/sharding/group_sharded.py): the user-facing ZeRO entry
+points, re-exported from the parallel engine implementation."""
+from ..parallel.sharding_parallel import (  # noqa: F401
+    group_sharded_parallel,
+    save_group_sharded_model,
+)
