@@ -20,6 +20,10 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 def _run(cmd, **kw):
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # force CPU: spawned workers must never dial the TPU tunnel (a wedged
+    # tunnel turned these tests flaky)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
     return subprocess.run(cmd, env=env, capture_output=True, text=True,
                           timeout=120, **kw)
 
@@ -186,11 +190,15 @@ class TestRPC:
             "    fut = rpc.rpc_async('worker1', divmod, args=(7, 3))\n"
             "    assert fut.result(timeout=30) == (2, 1)\n"
             "rpc.shutdown()\n" % (REPO, port))
+        env = {**os.environ,
+               "PYTHONPATH": REPO + os.pathsep
+               + os.environ.get("PYTHONPATH", ""),
+               # force CPU: workers must never dial the TPU tunnel (a
+               # wedged tunnel turned this test flaky)
+               "JAX_PLATFORMS": "cpu"}
+        env.pop("PALLAS_AXON_POOL_IPS", None)
         procs = [subprocess.Popen(
-            [sys.executable, str(script), str(r)],
-            env={**os.environ,
-                 "PYTHONPATH": REPO + os.pathsep
-                 + os.environ.get("PYTHONPATH", "")},
+            [sys.executable, str(script), str(r)], env=env,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
             for r in range(2)]
         outs = []
