@@ -5,25 +5,35 @@ Metric: Llama-style decoder train step tokens/sec/chip (BASELINE.md
 north-star "GPT/Llama tokens/sec/chip"). The reference publishes no number
 (BASELINE.md), so vs_baseline compares against a conservative published-class
 A100 figure for a same-size model when available; absent that it reports 1.0.
+
+Resilience (the axon TPU tunnel has wedged mid-round twice): the parent
+process NEVER imports jax. It forks children for (a) a short pre-flight
+probe and (b) the measurement itself, each under its own timeout, with one
+bounded retry. Every good measurement is persisted to BENCH_LAST_GOOD.json;
+if the tunnel is wedged the parent re-emits the last good number (tagged
+"stale": true with its timestamp) instead of erasing the round's result.
 """
 from __future__ import annotations
 
 import json
 import os
 import signal
+import subprocess
+import sys
 import time
 
-import numpy as np
+LAST_GOOD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "BENCH_LAST_GOOD.json")
+PROBE_TIMEOUT = 240       # import jax + tiny compile + host readback
+MEASURE_TIMEOUT = 1200    # full compile (~40s) + 20 timed iters, margin
+RETRY_TIMEOUT = 900
 
 
-def _watchdog(seconds=1500):
-    """Hard exit if the TPU tunnel wedges mid-bench: a hung bench is
-    worse for the driver than a failed one. No output is fabricated —
-    we exit non-zero with a diagnostic on stderr."""
+def _watchdog(seconds):
+    """Hard exit if the TPU tunnel wedges mid-child: a hung child is
+    worse than a failed one. No output is fabricated — exit non-zero."""
 
     def fire(signum, frame):
-        import sys
-
         sys.stderr.write(
             "bench.py watchdog: no result after %ds (TPU tunnel "
             "unresponsive?); aborting\n" % seconds)
@@ -33,8 +43,21 @@ def _watchdog(seconds=1500):
     signal.alarm(seconds)
 
 
-def main():
-    _watchdog()
+def probe_main():
+    """Child: touch the device with a trivial program; print OK."""
+    _watchdog(PROBE_TIMEOUT - 10)
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.add(jnp.float32(1.0), jnp.float32(2.0))
+    assert float(x) == 3.0  # host readback = the only real sync (memory note)
+    print("PROBE_OK", jax.default_backend())
+
+
+def measure_main():
+    """Child: the actual benchmark. Prints ONE JSON line on success."""
+    _watchdog(MEASURE_TIMEOUT - 30)
+    import numpy as np
     import jax
 
     import paddle_tpu as paddle
@@ -96,8 +119,94 @@ def main():
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s",
         "vs_baseline": 1.0,
+        "backend": jax.default_backend(),
     }))
 
 
+def _run_child(mode, timeout):
+    """Run `python bench.py --<mode>` under a hard timeout.
+    Returns (rc, stdout) — rc None on timeout."""
+    try:
+        p = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--" + mode],
+            capture_output=True, text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return None, ""
+    if p.returncode != 0:
+        sys.stderr.write(p.stderr[-2000:] + "\n")
+    return p.returncode, p.stdout
+
+
+def _parse_result(stdout):
+    for line in reversed(stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                d = json.loads(line)
+                if "metric" in d and "value" in d:
+                    return d
+            except ValueError:
+                pass
+    return None
+
+
+def _emit_stale(reason):
+    try:
+        with open(LAST_GOOD) as f:
+            last = json.load(f)
+    except (OSError, ValueError):
+        last = None
+    if isinstance(last, dict) and "metric" in last:
+        last["stale"] = True
+        last["stale_reason"] = reason
+        sys.stderr.write("bench.py: %s — re-emitting last good measurement "
+                         "from %s\n" % (reason, last.get("measured_at")))
+        print(json.dumps(last))
+        return 0
+    sys.stderr.write("bench.py: %s and no persisted last-good result\n"
+                     % reason)
+    return 3
+
+
+def main():
+    # Pre-flight: is the chip reachable at all? A wedged tunnel hangs any
+    # jax import/compile forever; bound it and fall back to last-good.
+    rc, out = _run_child("probe", PROBE_TIMEOUT)
+    if rc != 0 or "PROBE_OK" not in out:
+        sys.exit(_emit_stale("pre-flight probe failed (tunnel wedged?)"))
+    backend = out.split("PROBE_OK", 1)[1].strip().split()[0]
+
+    result = None
+    for timeout in (MEASURE_TIMEOUT, RETRY_TIMEOUT):
+        rc, out = _run_child("measure", timeout)
+        result = _parse_result(out)
+        if rc == 0 and result is not None:
+            break
+        sys.stderr.write("bench.py: measurement attempt failed (rc=%s); "
+                         "retrying\n" % rc)
+        result = None
+    if result is None:
+        sys.exit(_emit_stale("measurement failed after retry"))
+
+    result["measured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    # Persist only real-chip numbers — judged by the MEASUREMENT child's
+    # backend (a wedge between probe and measure can silently drop the
+    # measure child to CPU); a CPU smoke run must never overwrite the
+    # on-chip record. Write-to-temp-and-rename so a kill mid-write can't
+    # leave truncated JSON for the next fallback to trip over.
+    if result.get("backend", backend) != "cpu":
+        tmp = LAST_GOOD + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(result, f)
+            f.write("\n")
+        os.replace(tmp, LAST_GOOD)
+    print(json.dumps(result))
+
+
 if __name__ == "__main__":
-    main()
+    if "--probe" in sys.argv:
+        probe_main()
+    elif "--measure" in sys.argv:
+        measure_main()
+    else:
+        main()

@@ -32,26 +32,39 @@ class ModelAverage:
         self._num_updates = 0
         self._backup = None
 
+    # precision shelf cadence (reference kMaxNumAccumulates,
+    # average_accumulates_kernel_impl.h:45)
+    _MAX_NUM_ACCUMULATES = 16384
+
     def step(self):
         """Accumulate current parameter values (the reference op's
-        per-step update: rotate sums when the window is exceeded)."""
+        per-step update, average_accumulates_kernel_impl.h:113-134:
+        sum1 += param each step; every 16384 updates shelve sum1 into
+        sum2; when the window is exceeded fold sum1+sum2 into sum3 and
+        zero both)."""
         self._num_updates += 1
         self._num_acc += 1
         window = max(self.min_w,
                      min(self.max_w, int(self._num_updates * self.rate)))
         for i, p in enumerate(self.params):
             self._sum1[i] = self._sum1[i] + p._value
-        if self._num_acc >= window:
-            # rotate: sum_3 <- sum_2 <- sum_1, restart the live window
+        if self._num_updates % self._MAX_NUM_ACCUMULATES == 0:
             for i in range(len(self.params)):
-                self._sum3[i] = self._sum2[i]
-                self._sum2[i] = self._sum1[i]
+                self._sum2[i] = self._sum2[i] + self._sum1[i]
                 self._sum1[i] = jnp.zeros_like(self._sum1[i])
+        if self._num_acc >= window:
+            # window too long: discard the old sum3, fold the live sums
+            for i in range(len(self.params)):
+                self._sum3[i] = self._sum1[i] + self._sum2[i]
+                self._sum1[i] = jnp.zeros_like(self._sum1[i])
+                self._sum2[i] = jnp.zeros_like(self._sum2[i])
             self._old_num_acc = self._num_acc
             self._num_acc = 0
 
     def _averaged(self):
-        total_n = self._num_acc + 2 * self._old_num_acc
+        # sum1+sum2 hold num_acc live samples, sum3 holds the previous
+        # closed window of old_num_acc samples
+        total_n = self._num_acc + self._old_num_acc
         outs = []
         for i in range(len(self.params)):
             s = self._sum1[i] + self._sum2[i] + self._sum3[i]
@@ -82,8 +95,10 @@ def average_accumulates(param, sum1, sum2, sum3, num_acc, old_num_acc,
                         num_updates, average_window, max_average_window,
                         min_average_window):
     """Functional form of the reference average_accumulates op (one
-    param): returns updated (sum1, sum2, sum3, num_acc, old_num_acc)."""
-    num_updates = int(num_updates)
+    param). Pass the PRE-increment counters (as the reference op takes
+    in_num_* and outputs out_num_*); returns the updated
+    (sum1, sum2, sum3, num_acc, old_num_acc, num_updates)."""
+    num_updates = int(num_updates) + 1
     num_acc = int(num_acc) + 1
     window = max(min_average_window,
                  min(max_average_window, int(num_updates * average_window)))
@@ -91,11 +106,14 @@ def average_accumulates(param, sum1, sum2, sum3, num_acc, old_num_acc,
         param._value if isinstance(param, Tensor) else param)
     s2, s3 = jnp.asarray(sum2), jnp.asarray(sum3)
     old = int(old_num_acc)
+    if num_updates % ModelAverage._MAX_NUM_ACCUMULATES == 0:
+        s2, s1 = s2 + s1, jnp.zeros_like(s1)
     if num_acc >= window:
-        s3, s2, s1 = s2, s1, jnp.zeros_like(s1)
+        s3 = s1 + s2
+        s1, s2 = jnp.zeros_like(s1), jnp.zeros_like(s2)
         old = num_acc
         num_acc = 0
-    return s1, s2, s3, num_acc, old
+    return s1, s2, s3, num_acc, old, num_updates
 
 
 class LookAhead:

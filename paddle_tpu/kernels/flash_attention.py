@@ -42,9 +42,16 @@ def _dot(a, b, dims, batch=((), ())):
     throughput); fp32 operands inherit the framework's global matmul
     precision (FLAGS_matmul_precision, default 'highest'), preserving the
     documented fp32 guarantee for fp32 callers."""
-    prec = (jax.lax.Precision.DEFAULT
-            if a.dtype == jnp.bfloat16 and b.dtype == jnp.bfloat16
-            else None)
+    # Gate on EITHER operand being bf16: a mixed bf16/fp32 pair under the
+    # global 'highest' precision hits Mosaic's "Bad lhs type" on bf16 dots
+    # inside Pallas kernels, so pin DEFAULT whenever bf16 is involved.
+    if a.dtype == jnp.bfloat16 or b.dtype == jnp.bfloat16:
+        if a.dtype != b.dtype:  # common dtype for the MXU
+            a = a.astype(jnp.bfloat16)
+            b = b.astype(jnp.bfloat16)
+        prec = jax.lax.Precision.DEFAULT
+    else:
+        prec = None
     return jax.lax.dot_general(a, b, (dims, batch),
                                preferred_element_type=jnp.float32,
                                precision=prec)

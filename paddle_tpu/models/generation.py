@@ -63,7 +63,10 @@ def masked_decode_attention(q, k, v, mask):
     from ..nn import functional as F
 
     if isinstance(mask, str):  # "causal"
-        return F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        # prefill at offset 0 against a preallocated cache: start-aligned
+        # is exactly right (uninitialized tail slots are masked)
+        return F.scaled_dot_product_attention(q, k, v, is_causal=True,
+                                              _warn_rect_causal=False)
     return F.scaled_dot_product_attention(
         q, k, v, attn_mask=mask[None, None], is_causal=False)
 

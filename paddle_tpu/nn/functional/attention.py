@@ -45,8 +45,31 @@ def _sdpa_reference(q, k, v, mask=None, dropout_p=0.0, causal=False, scale=None)
 @primitive
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p=0.0, is_causal=False, scale=None,
-                                 training=True):
+                                 training=True, _warn_rect_causal=True):
+    """Scaled dot-product attention over [B, N, H, D] inputs (reference
+    nn/functional/flash_attention.py convention).
+
+    Causal convention: ``is_causal=True`` applies a START-aligned mask —
+    query i attends keys j <= i — uniformly across the XLA fallback, the
+    Pallas flash kernels, and ring attention. This differs from the
+    FA2/PyTorch bottom-right (end-aligned) convention when
+    ``q_len != kv_len``: for cached decode, pass an explicit end-aligned
+    ``attn_mask`` instead of ``is_causal`` (see models/llama.py).
+    A warning is emitted for the ambiguous rectangular-causal case
+    (``_warn_rect_causal=False`` silences it where start-aligned truly is
+    intended, e.g. prefill against a preallocated decode cache).
+    """
     q, k, v = _A(query), _A(key), _A(value)
+    if (is_causal and attn_mask is None and _warn_rect_causal
+            and q.shape[1] != k.shape[1]):
+        import warnings
+
+        warnings.warn(
+            "scaled_dot_product_attention: is_causal=True with "
+            "q_len != kv_len uses START-aligned masking (query i "
+            "attends keys j <= i). For cached decode (bottom-right "
+            "alignment), pass an explicit end-aligned attn_mask.",
+            stacklevel=2)
     use_flash = (
         jax.default_backend() == "tpu"
         and attn_mask is None

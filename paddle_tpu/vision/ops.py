@@ -30,8 +30,25 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
     N, C, H, W = x.shape
     R = boxes.shape[0]
     offset = 0.5 if aligned else 0.0
-    # assume single image (N==1) or boxes_num mapping handled upstream
-    img_idx = jnp.zeros((R,), jnp.int32)
+    # map each ROI to its image via boxes_num, as the reference kernel's
+    # roi_batch_id_list does. jnp.repeat with total_repeat_length stays
+    # trace-safe (boxes_num may be a tracer inside jit/static replay).
+    if boxes_num is not None:
+        bn = _A(boxes_num).astype(jnp.int32)
+        try:  # concrete path: validate the mapping covers every ROI
+            if int(np.asarray(bn).sum()) != R:
+                raise ValueError(
+                    "roi_align: sum(boxes_num)=%d must equal the number "
+                    "of boxes %d" % (int(np.asarray(bn).sum()), R))
+        except jax.errors.TracerArrayConversionError:
+            pass
+        img_idx = jnp.repeat(jnp.arange(bn.shape[0], dtype=jnp.int32), bn,
+                             total_repeat_length=R)
+    else:
+        if N > 1:
+            raise ValueError(
+                "roi_align: boxes_num is required when batch size > 1")
+        img_idx = jnp.zeros((R,), jnp.int32)
 
     x1 = boxes[:, 0] * spatial_scale - offset
     y1 = boxes[:, 1] * spatial_scale - offset

@@ -335,6 +335,18 @@ class TestVisionLongTail:
         want = np.array([[[[5., 7.], [13., 15.]]]], np.float32)
         np.testing.assert_allclose(out, want)
 
+    def test_roi_align_batched_uses_boxes_num(self):
+        # two images with distinct constant values; each ROI must sample
+        # its own image (regression: img_idx was hardcoded to image 0)
+        x = np.stack([np.full((1, 4, 4), 1.0, np.float32),
+                      np.full((1, 4, 4), 9.0, np.float32)])
+        boxes = np.array([[0.0, 0.0, 3.0, 3.0],
+                          [0.0, 0.0, 3.0, 3.0]], np.float32)
+        out = _np(paddle.vision.ops.roi_align(
+            _t(x), _t(boxes), _t(np.array([1, 1], np.int32)), 2))
+        np.testing.assert_allclose(out[0], 1.0, rtol=1e-5)
+        np.testing.assert_allclose(out[1], 9.0, rtol=1e-5)
+
     def test_prior_box_shapes_and_range(self):
         feat = np.zeros((1, 8, 4, 4), np.float32)
         img = np.zeros((1, 3, 32, 32), np.float32)
@@ -416,4 +428,20 @@ class TestReparamAndModelAverage:
             avg = _np(p).copy()
         # after apply-context exit, the live value is restored
         np.testing.assert_allclose(_np(p), 3.0)
-        assert 1.0 <= avg[0] <= 3.0
+        # window rotates at step 2 (sum3=1+2, old=2), step 3 is live:
+        # averaged = (3 + 3) / (1 + 2) = exact mean of all samples
+        np.testing.assert_allclose(avg, 2.0, rtol=1e-6)
+
+    def test_model_average_constant_param_unbiased(self):
+        # A constant parameter must average to exactly itself across
+        # rotations (regression: the old rotation kept two closed
+        # windows but divided by num_acc + 2*old_num_acc, biasing low).
+        from paddle_tpu.incubate.optimizer import ModelAverage
+
+        p = _t(np.full(3, 7.0, np.float32))
+        ma = ModelAverage(0.3, parameters=[p], min_average_window=3,
+                          max_average_window=6)
+        for _ in range(25):  # crosses several window rotations
+            ma.step()
+            with ma.apply():
+                np.testing.assert_allclose(_np(p), 7.0, rtol=1e-6)
