@@ -214,6 +214,7 @@ from .ops.manipulation import (  # noqa: F401
     diag_embed,
     fill,
     fill_diagonal,
+    fill_diagonal_tensor,
     index_sample,
     multiplex,
     reverse,

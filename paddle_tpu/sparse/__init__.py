@@ -21,7 +21,7 @@ from ..core.tensor import Tensor
 __all__ = [
     "SparseCooTensor", "SparseCsrTensor", "sparse_coo_tensor",
     "sparse_csr_tensor", "is_sparse_coo", "is_sparse_csr",
-    "add", "subtract", "multiply", "matmul", "masked_matmul",
+    "add", "subtract", "multiply", "divide", "matmul", "masked_matmul",
     "relu", "tanh", "sqrt", "sin", "pow", "neg", "abs", "coalesce",
 ]
 
@@ -235,6 +235,34 @@ def multiply(x, y):
     gathered = dv[tuple(b.indices[:, i] for i in range(b.indices.shape[1]))]
     return _rewrap(x, jsparse.BCOO((b.data * gathered, b.indices),
                                    shape=b.shape))
+
+
+def divide(x, y):
+    """Elementwise divide (reference sparse divide / divide_scalar
+    kernels, phi/kernels/sparse/elementwise_kernel.h): sparse / scalar
+    and sparse / dense scale the stored values; sparse / sparse requires
+    a matching sparsity pattern and divides stored values pairwise (as
+    the reference's coo-coo kernel does — implicit zeros stay zero)."""
+    if isinstance(y, (int, float)):
+        b = _as_bcoo(x)
+        return _rewrap(x, jsparse.BCOO((b.data / y, b.indices),
+                                       shape=b.shape))
+    if isinstance(y, (Tensor, jnp.ndarray, np.ndarray)):
+        b = _as_bcoo(x).sum_duplicates()
+        dv = _v(y)
+        gathered = dv[tuple(b.indices[:, i]
+                            for i in range(b.indices.shape[1]))]
+        return _rewrap(x, jsparse.BCOO((b.data / gathered, b.indices),
+                                       shape=b.shape))
+    bx = _as_bcoo(x).sum_duplicates()
+    by = _as_bcoo(y).sum_duplicates()
+    if bx.indices.shape != by.indices.shape or not bool(
+            jnp.all(bx.indices == by.indices)):
+        raise ValueError(
+            "sparse.divide(sparse, sparse) requires matching sparsity "
+            "patterns (the implicit-zero positions would divide 0/0)")
+    return _rewrap(x, jsparse.BCOO((bx.data / by.data, bx.indices),
+                                   shape=bx.shape))
 
 
 def _unary(fn):

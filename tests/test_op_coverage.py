@@ -49,3 +49,152 @@ def test_all_reference_kernels_accounted():
         missing.append(name)
     assert not missing, (
         "reference kernels no longer accounted for: %s" % missing[:20])
+
+
+def _tools():
+    import sys
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+    from tools import op_coverage
+
+    return op_coverage
+
+
+def test_every_alias_target_resolves():
+    """An alias can silently rot (VERDICT r2): every REF_TO_OURS target
+    must resolve to a live object under paddle_tpu."""
+    oc = _tools()
+    bad = []
+    for ref_name, (disp, target) in sorted(oc.REF_TO_OURS.items()):
+        if oc.resolve_alias(target) is None:
+            bad.append("%s -> %s" % (ref_name, target))
+    assert not bad, "rotted alias targets: %s" % bad
+
+
+def test_aliased_ops_smoke_execute():
+    """Execute the aliased capabilities with tiny shapes — resolution
+    proves the name exists; this proves the op actually runs."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+
+    t = paddle.to_tensor
+    x = t(np.arange(6, dtype=np.float32).reshape(2, 3) + 1.0)
+    y = t(np.full((2, 3), 2.0, np.float32))
+    img = t(np.random.RandomState(0).rand(1, 2, 6, 6).astype(np.float32))
+    vol = t(np.random.RandomState(1).rand(1, 1, 4, 4, 4).astype(np.float32))
+    n = lambda v: np.asarray(v.numpy() if hasattr(v, "numpy") else v)
+
+    # arithmetic / reduction aliases
+    np.testing.assert_allclose(n(x + y), n(x) + 2.0)
+    np.testing.assert_allclose(n(x - y), n(x) - 2.0)
+    np.testing.assert_allclose(n(x * y), n(x) * 2.0)
+    np.testing.assert_allclose(n(x / y), n(x) / 2.0)
+    np.testing.assert_allclose(n(paddle.add_n([x, y])), n(x) + 2.0)
+    np.testing.assert_allclose(float(paddle.sum(x)), 21.0)
+    np.testing.assert_allclose(float(paddle.mean(x)), 3.5)
+    np.testing.assert_allclose(n(paddle.pow(x, 2.0)), n(x) ** 2)
+    np.testing.assert_allclose(n(paddle.heaviside(x - 3.0, y)),
+                               np.heaviside(n(x) - 3.0, 2.0))
+    np.testing.assert_allclose(n(paddle.neg(x)), -n(x))
+    np.testing.assert_allclose(n(paddle.tril(x)), np.tril(n(x)))
+    assert n(paddle.full_like(x, 5.0)).min() == 5.0
+    # manipulation aliases
+    out = paddle.split(x, 3, axis=1)
+    assert len(out) == 3 and n(out[0]).shape == (2, 1)
+    np.testing.assert_allclose(n(paddle.concat([x, y], axis=0)).shape,
+                               (4, 3))
+    np.testing.assert_allclose(
+        n(paddle.repeat_interleave(x, 2, axis=0)).shape, (4, 3))
+    bt = paddle.broadcast_tensors([t(np.ones((1, 3), np.float32)),
+                                   t(np.ones((2, 1), np.float32))])
+    assert n(bt[0]).shape == (2, 3)
+    fd = paddle.fill_diagonal_tensor(
+        t(np.zeros((3, 3), np.float32)), t(np.ones((3,), np.float32)))
+    np.testing.assert_allclose(n(fd), np.eye(3))
+    assert n(paddle.crop(x, shape=[1, 2], offsets=[0, 1])).shape == (1, 2)
+    a = t(np.zeros((2, 2), np.float32))
+    np.testing.assert_allclose(n(paddle.assign(x[:, :2], a)), n(x)[:, :2])
+    # nn functional aliases
+    assert n(F.dropout(x, p=0.0, training=False)).shape == (2, 3)
+    np.testing.assert_allclose(
+        float(F.binary_cross_entropy(t(np.full((4,), 0.5, np.float32)),
+                                     t(np.ones((4,), np.float32)))),
+        -np.log(0.5), rtol=1e-5)
+    kl = F.kl_div(t(np.log(np.full((2, 2), 0.5, np.float32))),
+                  t(np.full((2, 2), 0.5, np.float32)))
+    assert np.isfinite(float(kl))
+    assert n(F.interpolate(img, size=[3, 3])).shape == (1, 2, 3, 3)
+    emb = F.embedding(t(np.array([[0, 1]], np.int32)),
+                      t(np.eye(4, 3, dtype=np.float32)))
+    assert n(emb).shape == (1, 2, 3)
+    w = t(np.ones((2, 1, 3, 3), np.float32))
+    assert n(F.conv2d(img, w, groups=2)).shape[1] == 2  # depthwise
+    assert n(F.max_pool2d(img, 2)).shape == (1, 2, 3, 3)
+    assert n(F.avg_pool2d(img, 2)).shape == (1, 2, 3, 3)
+    assert n(F.avg_pool3d(vol, 2)).shape == (1, 1, 2, 2, 2)
+    assert n(F.pad(img, [1, 1, 1, 1])).shape == (1, 2, 8, 8)
+    b = F.bilinear(t(np.ones((2, 3), np.float32)),
+                   t(np.ones((2, 4), np.float32)),
+                   t(np.ones((5, 3, 4), np.float32)))
+    assert n(b).shape == (2, 5)
+    bn = F.batch_norm(img, t(np.zeros(2, np.float32)),
+                      t(np.ones(2, np.float32)),
+                      t(np.zeros(2, np.float32)),
+                      t(np.ones(2, np.float32)))
+    assert n(bn).shape == n(img).shape
+    sce = F.softmax_with_cross_entropy(
+        t(np.random.RandomState(2).randn(4, 5).astype(np.float32)),
+        t(np.array([[0], [1], [2], [3]], np.int32)))
+    assert np.isfinite(n(sce)).all()
+    att = F.scaled_dot_product_attention(
+        t(np.ones((1, 4, 2, 8), np.float32)),
+        t(np.ones((1, 4, 2, 8), np.float32)),
+        t(np.ones((1, 4, 2, 8), np.float32)))
+    assert n(att).shape == (1, 4, 2, 8)
+    va = F.variable_length_attention(
+        t(np.ones((1, 4, 2, 8), np.float32)),
+        t(np.ones((1, 4, 2, 8), np.float32)),
+        t(np.ones((1, 4, 2, 8), np.float32)), seq_lens=[2, 2])
+    assert n(va).shape == (1, 4, 2, 8)
+    # linalg / fft / random / geometric aliases
+    np.testing.assert_allclose(float(paddle.linalg.norm(x)),
+                               np.linalg.norm(n(x)), rtol=1e-5)
+    sq = t(np.eye(3, dtype=np.float32) * 2.0)
+    np.testing.assert_allclose(float(paddle.linalg.det(sq)), 8.0, rtol=1e-5)
+    assert int(paddle.linalg.matrix_rank(sq)) == 3
+    f = paddle.fft.fft(t(np.ones(4, np.complex64)))
+    assert n(f).shape == (4,)
+    r = paddle.fft.rfft(t(np.ones(4, np.float32)))
+    np.testing.assert_allclose(n(paddle.fft.irfft(r)), np.ones(4),
+                               atol=1e-5)
+    assert n(paddle.randn([2, 2])).shape == (2, 2)
+    assert n(paddle.uniform([2, 2])).shape == (2, 2)
+    seg = paddle.geometric.segment_sum(
+        t(np.ones((4, 2), np.float32)), t(np.array([0, 0, 1, 1], np.int32)))
+    np.testing.assert_allclose(n(seg), np.full((2, 2), 2.0))
+    # sparse aliases
+    coo = paddle.sparse.sparse_coo_tensor([[0, 1], [0, 1]], [1.0, 2.0],
+                                          (2, 2))
+    csr = coo.to_sparse_csr()
+    np.testing.assert_allclose(n(csr.to_dense()), np.diag([1.0, 2.0]))
+    np.testing.assert_allclose(n(coo.to_dense()), np.diag([1.0, 2.0]))
+    back = csr.to_sparse_coo()
+    np.testing.assert_allclose(n(back.to_dense()), np.diag([1.0, 2.0]))
+    halves = paddle.sparse.divide(coo, 2.0)
+    np.testing.assert_allclose(n(halves.to_dense()), np.diag([0.5, 1.0]))
+    assert n(coo.values()).shape == (2,)
+    assert n(coo.indices()).shape[1] == 2
+    # optimizer / amp / incubate aliases
+    pr = t(np.ones((2,), np.float32))
+    pr.stop_gradient = False
+    sgd = paddle.optimizer.SGD(learning_rate=0.1, parameters=[pr])
+    (pr * pr).sum().backward()
+    sgd.step()
+    assert not np.allclose(n(pr), 1.0)
+    scaler = paddle.amp.GradScaler(enable=False)
+    assert scaler is not None
+    il = paddle.incubate.identity_loss(t(np.array([3.0], np.float32)))
+    assert np.isfinite(float(il))

@@ -133,87 +133,182 @@ NA_BY_DESIGN = {
     "sparse_mask_helper": "sparse masking via dense where()",
 }
 
-# reference-name (or stripped base) -> the name here that covers it
-# (naming differences where the capability exists under another name)
+# reference-name (or stripped base) -> (display, target) where target is a
+# MACHINE-RESOLVABLE dotted path under the paddle_tpu package ("Tensor.x"
+# addresses a Tensor method/operator). tests/test_op_coverage.py resolves
+# every target at gate time, so an alias cannot silently rot.
 REF_TO_OURS = {
-    "add": "elementwise add (+)", "grad_add": "add", "add_n": "add_n",
-    "subtract": "-", "multiply": "*", "divide": "/",
-    "matmul_with_flatten": "matmul",
-    "batch_norm": "batch_norm_train", "sync_batch_norm": "SyncBatchNorm",
-    "fused_bn_add_activation": "batch_norm_train + XLA fusion",
-    "cross_entropy_with_softmax": "softmax_with_cross_entropy",
-    "c_softmax_with_cross_entropy": "parallel_softmax_cross_entropy",
-    "sum": "reduce/sum", "mean": "mean", "mean_all": "mean",
-    "flash_attn": "kernels.flash_attention",
-    "flash_attn_unpadded": "kernels.flash_attention",
-    "fused_attention": "kernels.flash_attention",
-    "memory_efficient_attention": "kernels.flash_attention",
-    "variable_length_memory_efficient_attention": "flash_attention",
-    "fused_multi_head_attention": "scaled_dot_product_attention",
-    "dropout_nd": "dropout", "fused_dropout_add": "dropout + XLA fusion",
-    "c_allreduce": "all_reduce", "mp_allreduce_sum": "all_reduce",
-    "all_reduce": "all_reduce", "reduce": "reduce",
-    "c_allgather": "all_gather", "all_gather": "all_gather",
-    "c_reducescatter": "reduce_scatter", "c_broadcast": "broadcast",
-    "broadcast_tensors": "broadcast_tensors",
-    "all_to_all": "alltoall", "global_scatter": "alltoall (moe)",
-    "global_gather": "alltoall (moe)",
-    "send_v2": "send", "p_send": "send", "partial_send": "send",
-    "recv_v2": "recv", "p_recv": "recv", "partial_recv": "recv",
-    "partial_allgather": "all_gather",
-    "c_identity": "identity sharding annotation",
-    "c_concat": "concat", "c_split": "split",
-    "c_embedding": "VocabParallelEmbedding",
-    "embedding_with_scaled_gradient": "embedding",
-    "embedding_grad_add_to": "embedding", "embedding_sparse": "embedding",
-    "sparse_weight_embedding": "embedding",
-    "bce_loss": "binary_cross_entropy",
-    "kldiv_loss": "kl_div",
-    "bicubic_interp": "interpolate", "bilinear_interp": "interpolate",
-    "nearest_interp": "interpolate", "linear_interp": "interpolate",
-    "trilinear_interp": "interpolate",
-    "bilinear_tensor_product": "F.bilinear",
-    "check_finite_and_unscale": "amp.GradScaler (python, XLA-fused)",
-    "update_loss_scaling": "amp.GradScaler",
-    "depthwise_conv2d": "conv2d(groups=C)",
-    "depthwise_conv2d_transpose": "conv2d_transpose(groups=C)",
-    "elementwise_pow": "pow", "elementwise_heaviside": "heaviside",
-    "fft_c2c": "paddle.fft", "fft_c2r": "paddle.fft",
-    "fft_r2c": "paddle.fft",
-    "frobenius_norm": "linalg.norm",
-    "full_batch_size_like": "full_like",
-    "gaussian": "randn/normal",
-    "truncated_gaussian_random": "nn.initializer.TruncatedNormal",
-    "graph_sample_neighbors": "geometric.sample_neighbors",
-    "matrix_rank_tol": "linalg.matrix_rank",
-    "max_pool2d_with_index": "max_pool2d(return_mask=True)",
-    "max_pool3d_with_index": "max_pool3d",
-    "maxpool": "max_pool2d",
-    "negative": "neg", "p_norm": "norm", "pad3d": "pad",
-    "pool2d": "avg_pool2d/max_pool2d", "pool3d": "avg_pool3d/max_pool3d",
-    "repeat_interleave_with_tensor_index": "repeat_interleave",
-    "rnn": "nn.SimpleRNN/LSTM/GRU (lax.scan)",
-    "segment_pool": "geometric.segment_sum/mean/min/max",
-    "set_value_with_tensor": "Tensor.set_value",
-    "sgd_sparse_param_sparse_grad": "sgd",
-    "split_with_num": "split", "tril_triu": "tril/triu",
-    "uniform_inplace": "uniform", "unpool": "max_unpool2d",
-    "assign_value": "assign",
-    "coo_to_csr": "sparse .to_csr", "csr_to_coo": "sparse .to_coo",
-    "coo_to_dense": "sparse .to_dense", "csr_to_dense": "sparse .to_dense",
-    "dense_to_coo": "sparse.sparse_coo_tensor",
-    "dense_to_csr": "sparse.sparse_csr_tensor",
-    "values_coo": "sparse .values", "values_csr": "sparse .values",
-    "indices_coo": "sparse .indices",
-    "divide_scalar": "sparse divide",
-    "determinant": "linalg.det",
-    "spectral_norm": "nn.utils.spectral_norm",
-    "identity_loss": "identity_loss",
-    "fill_diagonal_tensor": "fill_diagonal_tensor",
-    "decode_jpeg": "vision.ops.decode_jpeg",
-    "crop": "crop",
-    "average_accumulates": "incubate.optimizer.ModelAverage",
+    "add": ("elementwise add (+)", "Tensor.__add__"),
+    "grad_add": ("add", "add"),
+    "add_n": ("add_n", "add_n"),
+    "subtract": ("- operator", "Tensor.__sub__"),
+    "multiply": ("* operator", "Tensor.__mul__"),
+    "divide": ("/ operator", "Tensor.__truediv__"),
+    "matmul_with_flatten": ("matmul", "matmul"),
+    "batch_norm": ("F.batch_norm", "nn.functional.batch_norm"),
+    "sync_batch_norm": ("nn.SyncBatchNorm", "nn.SyncBatchNorm"),
+    "fused_bn_add_activation":
+        ("F.batch_norm + XLA fusion", "nn.functional.batch_norm"),
+    "cross_entropy_with_softmax": ("softmax_with_cross_entropy",
+                                   "nn.functional.softmax_with_cross_entropy"),
+    "c_softmax_with_cross_entropy":
+        ("parallel_softmax_cross_entropy",
+         "parallel.mp_layers.parallel_softmax_cross_entropy"),
+    "sum": ("sum", "sum"),
+    "mean": ("mean", "mean"),
+    "mean_all": ("mean", "mean"),
+    "flash_attn": ("kernels.flash_attention",
+                   "kernels.flash_attention.flash_attention"),
+    "flash_attn_unpadded": ("kernels.flash_attention (segment_ids)",
+                            "kernels.flash_attention.flash_attention"),
+    "fused_attention": ("kernels.flash_attention",
+                        "kernels.flash_attention.flash_attention"),
+    "memory_efficient_attention": ("kernels.flash_attention",
+                                   "kernels.flash_attention.flash_attention"),
+    "variable_length_memory_efficient_attention":
+        ("F.variable_length_attention",
+         "nn.functional.variable_length_attention"),
+    "fused_multi_head_attention":
+        ("F.scaled_dot_product_attention",
+         "nn.functional.scaled_dot_product_attention"),
+    "dropout_nd": ("F.dropout", "nn.functional.dropout"),
+    "fused_dropout_add": ("F.dropout + XLA fusion", "nn.functional.dropout"),
+    "c_allreduce": ("distributed.all_reduce", "distributed.all_reduce"),
+    "mp_allreduce_sum": ("distributed.all_reduce", "distributed.all_reduce"),
+    "all_reduce": ("distributed.all_reduce", "distributed.all_reduce"),
+    "reduce": ("distributed.reduce", "distributed.reduce"),
+    "c_allgather": ("distributed.all_gather", "distributed.all_gather"),
+    "all_gather": ("distributed.all_gather", "distributed.all_gather"),
+    "c_reducescatter": ("distributed.reduce_scatter",
+                        "distributed.reduce_scatter"),
+    "c_broadcast": ("distributed.broadcast", "distributed.broadcast"),
+    "broadcast_tensors": ("broadcast_tensors", "broadcast_tensors"),
+    "all_to_all": ("distributed.alltoall", "distributed.alltoall"),
+    "global_scatter": ("distributed.utils.global_scatter (moe)",
+                       "distributed.utils.global_scatter"),
+    "global_gather": ("distributed.utils.global_gather (moe)",
+                      "distributed.utils.global_gather"),
+    "send_v2": ("distributed.send", "distributed.send"),
+    "p_send": ("distributed.send", "distributed.send"),
+    "partial_send": ("partial_send", "distributed.collective.partial_send"),
+    "recv_v2": ("distributed.recv", "distributed.recv"),
+    "p_recv": ("distributed.recv", "distributed.recv"),
+    "partial_recv": ("partial_recv", "distributed.collective.partial_recv"),
+    "partial_allgather": ("partial_allgather",
+                          "distributed.collective.partial_allgather"),
+    "c_identity": ("mp identity = sharding annotation",
+                   "parallel.mp_layers.mark_sharding"),
+    "c_concat": ("concat", "concat"),
+    "c_split": ("split", "split"),
+    "c_embedding": ("VocabParallelEmbedding",
+                    "parallel.mp_layers.VocabParallelEmbedding"),
+    "embedding_with_scaled_gradient": ("F.embedding",
+                                       "nn.functional.embedding"),
+    "embedding_grad_add_to": ("F.embedding", "nn.functional.embedding"),
+    "embedding_sparse": ("F.embedding", "nn.functional.embedding"),
+    "sparse_weight_embedding": ("F.embedding", "nn.functional.embedding"),
+    "bce_loss": ("F.binary_cross_entropy",
+                 "nn.functional.binary_cross_entropy"),
+    "kldiv_loss": ("F.kl_div", "nn.functional.kl_div"),
+    "bicubic_interp": ("F.interpolate", "nn.functional.interpolate"),
+    "bilinear_interp": ("F.interpolate", "nn.functional.interpolate"),
+    "nearest_interp": ("F.interpolate", "nn.functional.interpolate"),
+    "linear_interp": ("F.interpolate", "nn.functional.interpolate"),
+    "trilinear_interp": ("F.interpolate", "nn.functional.interpolate"),
+    "bilinear_tensor_product": ("F.bilinear", "nn.functional.bilinear"),
+    "check_finite_and_unscale": ("amp.GradScaler (XLA-fused)",
+                                 "amp.GradScaler"),
+    "update_loss_scaling": ("amp.GradScaler", "amp.GradScaler"),
+    "depthwise_conv2d": ("F.conv2d(groups=C)", "nn.functional.conv2d"),
+    "depthwise_conv2d_transpose": ("F.conv2d_transpose(groups=C)",
+                                   "nn.functional.conv2d_transpose"),
+    "elementwise_pow": ("pow", "pow"),
+    "elementwise_heaviside": ("heaviside", "heaviside"),
+    "fft_c2c": ("fft.fft", "fft.fft"),
+    "fft_c2r": ("fft.irfft", "fft.irfft"),
+    "fft_r2c": ("fft.rfft", "fft.rfft"),
+    "frobenius_norm": ("linalg.norm", "linalg.norm"),
+    "full_batch_size_like": ("full_like", "full_like"),
+    "gaussian": ("randn", "randn"),
+    "truncated_gaussian_random": ("nn.initializer.TruncatedNormal",
+                                  "nn.initializer.TruncatedNormal"),
+    "graph_sample_neighbors": ("geometric.sample_neighbors",
+                               "geometric.sample_neighbors"),
+    "matrix_rank_tol": ("linalg.matrix_rank", "linalg.matrix_rank"),
+    "max_pool2d_with_index": ("F.max_pool2d(return_mask=True)",
+                              "nn.functional.max_pool2d"),
+    "max_pool3d_with_index": ("F.max_pool3d", "nn.functional.max_pool3d"),
+    "maxpool": ("F.max_pool2d", "nn.functional.max_pool2d"),
+    "negative": ("neg", "neg"),
+    "p_norm": ("linalg.norm", "linalg.norm"),
+    "pad3d": ("F.pad", "nn.functional.pad"),
+    "pool2d": ("F.avg_pool2d/max_pool2d", "nn.functional.avg_pool2d"),
+    "pool3d": ("F.avg_pool3d/max_pool3d", "nn.functional.avg_pool3d"),
+    "repeat_interleave_with_tensor_index": ("repeat_interleave",
+                                            "repeat_interleave"),
+    "rnn": ("nn.SimpleRNN/LSTM/GRU (lax.scan)", "nn.LSTM"),
+    "segment_pool": ("geometric.segment_sum/mean/min/max",
+                     "geometric.segment_sum"),
+    "set_value_with_tensor": ("Tensor.set_value", "Tensor.set_value"),
+    "sgd_sparse_param_sparse_grad": ("optimizer.SGD", "optimizer.SGD"),
+    "split_with_num": ("split", "split"),
+    "tril_triu": ("tril/triu", "tril"),
+    "uniform_inplace": ("uniform", "uniform"),
+    "unpool": ("F.max_unpool2d", "nn.functional.max_unpool2d"),
+    "assign_value": ("assign", "assign"),
+    "coo_to_csr": ("SparseCooTensor.to_sparse_csr",
+                   "sparse.SparseCooTensor.to_sparse_csr"),
+    "csr_to_coo": ("SparseCsrTensor.to_sparse_coo",
+                   "sparse.SparseCsrTensor.to_sparse_coo"),
+    "coo_to_dense": ("SparseCooTensor.to_dense",
+                     "sparse.SparseCooTensor.to_dense"),
+    "csr_to_dense": ("SparseCsrTensor.to_dense",
+                     "sparse.SparseCsrTensor.to_dense"),
+    "dense_to_coo": ("sparse.sparse_coo_tensor", "sparse.sparse_coo_tensor"),
+    "dense_to_csr": ("sparse.sparse_csr_tensor", "sparse.sparse_csr_tensor"),
+    "values_coo": ("SparseCooTensor.values", "sparse.SparseCooTensor.values"),
+    "values_csr": ("SparseCsrTensor.values", "sparse.SparseCsrTensor.values"),
+    "indices_coo": ("SparseCooTensor.indices",
+                    "sparse.SparseCooTensor.indices"),
+    "divide_scalar": ("sparse.divide", "sparse.divide"),
+    "determinant": ("linalg.det", "linalg.det"),
+    "spectral_norm": ("nn.utils.spectral_norm", "nn.utils.spectral_norm"),
+    "identity_loss": ("incubate.identity_loss", "incubate.identity_loss"),
+    "fill_diagonal_tensor": ("fill_diagonal_tensor", "fill_diagonal_tensor"),
+    "decode_jpeg": ("vision.ops.decode_jpeg", "vision.ops.decode_jpeg"),
+    "crop": ("crop", "crop"),
+    "average_accumulates": ("incubate.optimizer.ModelAverage",
+                            "incubate.optimizer.ModelAverage"),
 }
+
+
+def resolve_alias(target):
+    """Resolve a REF_TO_OURS target ('a.b.C.attr' under paddle_tpu, or
+    'Tensor.method') to a live object; returns None if it no longer
+    exists. Submodules not imported by the package root are imported on
+    demand."""
+    import importlib
+    import types
+
+    if target.startswith("Tensor."):
+        import paddle_tpu
+
+        obj = paddle_tpu.Tensor
+        parts = target.split(".")[1:]
+    else:
+        obj = importlib.import_module("paddle_tpu")
+        parts = target.split(".")
+    for part in parts:
+        nxt = getattr(obj, part, None)
+        if nxt is None and isinstance(obj, types.ModuleType):
+            try:
+                nxt = importlib.import_module(obj.__name__ + "." + part)
+            except ImportError:
+                return None
+        if nxt is None:
+            return None
+        obj = nxt
+    return obj
 
 def reference_kernel_names(ref):
     out = subprocess.run(
@@ -301,8 +396,9 @@ def main():
         if any(c in ours for c in forms):
             covered.append(name)
         elif any(c in alias_cover for c in forms):
-            via_alias.append((name, next(alias_cover[c] for c in forms
-                                         if c in alias_cover)))
+            key = next(c for c in forms if c in alias_cover)
+            disp, target = alias_cover[key]
+            via_alias.append((name, disp, target))
         elif any(c in NA_BY_DESIGN for c in forms):
             na.append((name, next(NA_BY_DESIGN[c] for c in forms
                                   if c in NA_BY_DESIGN)))
@@ -325,8 +421,14 @@ def main():
     lines.append("\n**Accounted: %.1f%%**\n" % pct)
     lines.append("## Missing (%d)\n" % len(missing))
     lines.append(", ".join("`%s`" % m for m in missing) or "(none)")
+    # every alias target must resolve to a live object (rot gate; also
+    # enforced by tests/test_op_coverage.py)
+    unresolved = sorted({t for _, _, t in via_alias
+                         if resolve_alias(t) is None})
     lines.append("\n## Covered via alias (%d)\n" % len(via_alias))
-    lines.append("\n".join("- `%s` -> `%s`" % (a, b) for a, b in via_alias))
+    lines.append("\n".join(
+        "- `%s` -> %s (`paddle_tpu.%s`)" % (a, d, t)
+        for a, d, t in via_alias))
     lines.append("\n## n/a by design (%d)\n" % len(na))
     lines.append("\n".join("- `%s` — %s" % (a, b) for a, b in na))
     report = "\n".join(lines) + "\n"
@@ -335,6 +437,9 @@ def main():
     print("missing=%d covered=%d alias=%d na=%d (accounted %.1f%%)"
           % (len(missing), len(covered), len(via_alias), len(na), pct))
     print("\n".join(missing))
+    if unresolved:
+        print("UNRESOLVED alias targets: %s" % unresolved)
+        sys.exit(1)
 
 
 if __name__ == "__main__":
