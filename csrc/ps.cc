@@ -35,9 +35,11 @@
 #include <atomic>
 #include <chrono>
 #include <cmath>
+#include <condition_variable>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
+#include <list>
 #include <map>
 #include <mutex>
 #include <random>
@@ -70,17 +72,119 @@ struct SparseTable {
   std::unordered_map<int64_t, std::vector<float>> rows;
   std::mutex mu;
 
+  // SSD spill (reference ssd_sparse_table.cc: memory shard backed by a
+  // rocksdb column; here a bounded in-memory map with LRU eviction to a
+  // fixed-row-size disk file + offset index — same pull/push/save
+  // semantics, host-filesystem storage)
+  size_t mem_capacity = 0;  // 0 = pure in-memory table
+  std::string spill_path;
+  FILE* spill_f = nullptr;
+  std::unordered_map<int64_t, long> disk_index;  // id -> file offset
+  std::list<int64_t> lru;                        // front = most recent
+  std::unordered_map<int64_t, std::list<int64_t>::iterator> lru_pos;
+
+  ~SparseTable() {
+    if (spill_f) std::fclose(spill_f);
+  }
+
   size_t row_size() const {
     return dim * (1 + slots_for(opt)) + (opt == OPT_ADAM ? 1 : 0);
   }
 
+  bool spill_enabled() const { return mem_capacity > 0; }
+
+  void reset_spill() {
+    if (spill_f) {
+      std::fclose(spill_f);
+      spill_f = nullptr;
+    }
+    disk_index.clear();
+    lru.clear();
+    lru_pos.clear();
+    if (!spill_path.empty()) std::remove(spill_path.c_str());
+  }
+
+  void touch(int64_t id) {
+    auto it = lru_pos.find(id);
+    if (it != lru_pos.end()) lru.erase(it->second);
+    lru.push_front(id);
+    lru_pos[id] = lru.begin();
+  }
+
+  bool write_disk(int64_t id, const std::vector<float>& r) {
+    if (!spill_f) {
+      spill_f = std::fopen(spill_path.c_str(), "w+b");
+      if (!spill_f) return false;
+    }
+    long off;
+    auto dit = disk_index.find(id);
+    if (dit != disk_index.end()) {
+      off = dit->second;  // fixed row size: overwrite in place
+    } else {
+      std::fseek(spill_f, 0, SEEK_END);
+      off = std::ftell(spill_f);
+      disk_index[id] = off;
+    }
+    std::fseek(spill_f, off, SEEK_SET);
+    return std::fwrite(r.data(), sizeof(float), r.size(), spill_f) ==
+           r.size();
+  }
+
+  bool read_disk(int64_t id, std::vector<float>* out) {
+    auto it = disk_index.find(id);
+    if (it == disk_index.end() || !spill_f) return false;
+    out->resize(row_size());
+    std::fseek(spill_f, it->second, SEEK_SET);
+    return std::fread(out->data(), sizeof(float), out->size(), spill_f) ==
+           out->size();
+  }
+
+  void evict_over_capacity(int64_t protect_id) {
+    // `protect_id` is the row the caller holds a reference to — never
+    // evict it, even if LRU bookkeeping is sparse (e.g. right after
+    // set_spill on a pre-populated table).
+    while (spill_enabled() && rows.size() > mem_capacity && !lru.empty()) {
+      int64_t victim = lru.back();
+      if (victim == protect_id) break;  // oldest is in use: stop
+      lru.pop_back();
+      lru_pos.erase(victim);
+      auto it = rows.find(victim);
+      if (it == rows.end()) continue;
+      if (!write_disk(victim, it->second)) {
+        // disk failure: keep the row in memory rather than lose the
+        // parameter (capacity becomes soft under IO errors)
+        touch(victim);
+        break;
+      }
+      rows.erase(it);
+    }
+  }
+
+  size_t total_rows() {
+    size_t n = rows.size();
+    for (auto& kv : disk_index)
+      if (rows.find(kv.first) == rows.end()) ++n;
+    return n;
+  }
+
   std::vector<float>& row(int64_t id) {
     auto it = rows.find(id);
-    if (it != rows.end()) return it->second;
-    std::vector<float> r(row_size(), 0.0f);
-    std::normal_distribution<float> d(0.0f, init_std);
-    for (int i = 0; i < dim; ++i) r[i] = d(rng);
-    return rows.emplace(id, std::move(r)).first->second;
+    if (it != rows.end()) {
+      if (spill_enabled()) touch(id);
+      return it->second;
+    }
+    std::vector<float> r;
+    if (!spill_enabled() || !read_disk(id, &r)) {
+      r.assign(row_size(), 0.0f);
+      std::normal_distribution<float> d(0.0f, init_std);
+      for (int i = 0; i < dim; ++i) r[i] = d(rng);
+    }
+    auto& ref = rows.emplace(id, std::move(r)).first->second;
+    if (spill_enabled()) {
+      touch(id);
+      evict_over_capacity(id);
+    }
+    return ref;
   }
 
   void apply(std::vector<float>& r, const float* g) {
@@ -109,6 +213,144 @@ struct SparseTable {
     }
   }
 };
+
+// CTR accessor table (reference ps/table/ctr_accessor.cc CtrCommonAccessor
+// + sparse_sgd_rule.cc): per-feature row
+//   [slot, unseen_days, delta_score, show, click,
+//    embed_w, embed_sgd_state..., embedx_w[dim], embedx_sgd_state...]
+// Push value per feature: [slot, show, click, embed_g, embedx_g[dim]].
+// Pull value per feature: [show, click, embed_w, embedx_w[dim]].
+// The embed (1-d "LR" weight) and embedx (dim-d vector) each run a
+// chained SGD rule: 0=naive, 1=adagrad (shared g2sum), 2=adam.
+struct CtrTable {
+  int dim = 8;        // embedx dim
+  int rule = 1;       // 0 naive / 1 adagrad / 2 adam (both chains)
+  float lr = 0.05f;
+  float init_range = 0.01f;
+  float nonclk_coeff = 0.1f;
+  float click_coeff = 1.0f;
+  float decay_rate = 0.98f;       // show/click time decay on shrink
+  float delete_threshold = 0.8f;  // score below -> delete on shrink
+  float delete_after_unseen = 30.0f;
+  float initial_g2sum = 3.0f;
+  float bound = 10.0f;  // weight bounds +-
+  std::mt19937 rng{0};
+  std::unordered_map<int64_t, std::vector<float>> rows;
+  std::mutex mu;
+
+  enum { SLOT = 0, UNSEEN = 1, DELTA = 2, SHOW = 3, CLICK = 4, EMBED_W = 5 };
+
+  int sgd_dim(int d) const {  // extra state per d-dim weight chain
+    switch (rule) {
+      case 1: return 1;           // shared g2sum
+      case 2: return 2 * d + 2;   // m[d], v[d], beta1_pow, beta2_pow
+      default: return 0;
+    }
+  }
+  int embed_sgd_at() const { return EMBED_W + 1; }
+  int embedx_w_at() const { return embed_sgd_at() + sgd_dim(1); }
+  int embedx_sgd_at() const { return embedx_w_at() + dim; }
+  size_t row_size() const { return embedx_sgd_at() + sgd_dim(dim); }
+  size_t push_size() const { return 4 + dim; }  // slot, show, click, g, gx
+  size_t pull_size() const { return 3 + dim; }  // show, click, w, wx
+
+  float score(float show, float click) const {
+    return (show - click) * nonclk_coeff + click * click_coeff;
+  }
+
+  void clip(float* w, int d) const {
+    for (int i = 0; i < d; ++i) {
+      if (w[i] > bound) w[i] = bound;
+      if (w[i] < -bound) w[i] = -bound;
+    }
+  }
+
+  void rule_update(float* w, float* sgd, const float* g, int d,
+                   float scale) {
+    if (scale <= 0.0f) scale = 1.0f;
+    if (rule == 0) {  // naive
+      for (int i = 0; i < d; ++i) w[i] -= lr * g[i];
+    } else if (rule == 1) {  // adagrad, shared g2sum over the chain
+      float& g2sum = sgd[0];
+      double add = 0;
+      for (int i = 0; i < d; ++i) {
+        double sg = g[i] / scale;
+        w[i] -= lr * sg * std::sqrt(initial_g2sum /
+                                    (initial_g2sum + g2sum));
+        add += sg * sg;
+      }
+      g2sum += static_cast<float>(add / d);
+    } else {  // adam
+      float* m = sgd;
+      float* v = sgd + d;
+      float& b1p = sgd[2 * d];
+      float& b2p = sgd[2 * d + 1];
+      const float b1 = 0.9f, b2 = 0.999f;
+      if (b1p == 0.0f) { b1p = 1.0f; b2p = 1.0f; }
+      b1p *= b1;
+      b2p *= b2;
+      for (int i = 0; i < d; ++i) {
+        float sg = g[i] / scale;
+        m[i] = b1 * m[i] + (1 - b1) * sg;
+        v[i] = b2 * v[i] + (1 - b2) * sg * sg;
+        w[i] -= lr * (m[i] / (1 - b1p)) /
+                (std::sqrt(v[i] / (1 - b2p)) + 1e-8f);
+      }
+    }
+    clip(w, d);
+  }
+
+  std::vector<float>& row(int64_t id) {
+    auto it = rows.find(id);
+    if (it != rows.end()) return it->second;
+    std::vector<float> r(row_size(), 0.0f);
+    std::uniform_real_distribution<float> d(-init_range, init_range);
+    r[EMBED_W] = d(rng);
+    for (int i = 0; i < dim; ++i) r[embedx_w_at() + i] = d(rng);
+    return rows.emplace(id, std::move(r)).first->second;
+  }
+
+  void push_one(std::vector<float>& r, const float* pv) {
+    float push_show = pv[1], push_click = pv[2];
+    r[SLOT] = pv[0];
+    r[SHOW] += push_show;
+    r[CLICK] += push_click;
+    r[DELTA] += score(push_show, push_click);
+    r[UNSEEN] = 0;
+    float scale = push_show > 0 ? push_show : 1.0f;
+    rule_update(&r[EMBED_W], &r[embed_sgd_at()], pv + 3, 1, scale);
+    rule_update(&r[embedx_w_at()], &r[embedx_sgd_at()], pv + 4, dim,
+                scale);
+  }
+
+  void pull_one(const std::vector<float>& r, float* out) {
+    out[0] = r[SHOW];
+    out[1] = r[CLICK];
+    out[2] = r[EMBED_W];
+    std::memcpy(out + 3, r.data() + embedx_w_at(), dim * sizeof(float));
+  }
+
+  // daily maintenance (reference CtrCommonAccessor::Shrink): decay
+  // show/click, age unseen_days, delete rows scoring below threshold
+  size_t shrink() {
+    size_t deleted = 0;
+    for (auto it = rows.begin(); it != rows.end();) {
+      auto& r = it->second;
+      r[SHOW] *= decay_rate;
+      r[CLICK] *= decay_rate;
+      r[UNSEEN] += 1.0f;
+      if (score(r[SHOW], r[CLICK]) < delete_threshold ||
+          r[UNSEEN] > delete_after_unseen) {
+        it = rows.erase(it);
+        ++deleted;
+      } else {
+        ++it;
+      }
+    }
+    return deleted;
+  }
+};
+
 
 struct DenseTable {
   int opt = OPT_SGD;
@@ -158,6 +400,12 @@ enum PsOp : uint8_t {
   PS_SPARSE_SIZE = 7,
   PS_SAVE = 8,
   PS_LOAD = 9,
+  PS_CREATE_CTR = 10,
+  PS_PUSH_CTR = 11,
+  PS_PULL_CTR = 12,
+  PS_CTR_SHRINK = 13,
+  PS_SET_SPILL = 14,
+  PS_MEM_ROWS = 15,
 };
 
 static bool read_full(int fd, void* buf, size_t n) {
@@ -192,6 +440,7 @@ struct PsServer {
   std::mutex conns_mu;
   std::map<int, SparseTable> sparse;
   std::map<int, DenseTable> dense;
+  std::map<int, CtrTable> ctr;
   std::mutex tables_mu;
 
   SparseTable* sparse_tab(int tid) {
@@ -203,6 +452,11 @@ struct PsServer {
     std::lock_guard<std::mutex> l(tables_mu);
     auto it = dense.find(tid);
     return it == dense.end() ? nullptr : &it->second;
+  }
+  CtrTable* ctr_tab(int tid) {
+    std::lock_guard<std::mutex> l(tables_mu);
+    auto it = ctr.find(tid);
+    return it == ctr.end() ? nullptr : &it->second;
   }
 
   void serve(int cfd) {
@@ -365,6 +619,23 @@ struct PsServer {
         }
         case PS_SPARSE_SIZE: {
           SparseTable* t = sparse_tab(tid);
+          CtrTable* ct = t ? nullptr : ctr_tab(tid);
+          uint64_t sz = 0;
+          if (t) {
+            std::lock_guard<std::mutex> l(t->mu);
+            sz = t->total_rows();
+          } else if (ct) {
+            std::lock_guard<std::mutex> l(ct->mu);
+            sz = ct->rows.size();
+          } else {
+            status = -1;
+          }
+          write_full(cfd, &status, 4);
+          write_full(cfd, &sz, 8);
+          break;
+        }
+        case PS_MEM_ROWS: {  // in-memory (non-spilled) row count
+          SparseTable* t = sparse_tab(tid);
           uint64_t sz = 0;
           if (t) {
             std::lock_guard<std::mutex> l(t->mu);
@@ -374,6 +645,121 @@ struct PsServer {
           }
           write_full(cfd, &status, 4);
           write_full(cfd, &sz, 8);
+          break;
+        }
+        case PS_SET_SPILL: {
+          // payload: mem_capacity u64 + path (n bytes). capacity >= 1
+          // keeps the in-use row safely out of eviction range.
+          uint64_t cap;
+          if (!read_full(cfd, &cap, 8)) return;
+          std::vector<char> path(n + 1, 0);
+          if (n > 0 && !read_full(cfd, path.data(), n)) return;
+          SparseTable* t = sparse_tab(tid);
+          if (!t || cap < 1) {
+            status = -1;
+          } else {
+            std::lock_guard<std::mutex> l(t->mu);
+            t->reset_spill();
+            t->mem_capacity = cap;
+            t->spill_path = path.data();
+            // pre-existing rows must enter the LRU or they can never be
+            // evicted (and eviction could otherwise reap a later row
+            // that IS tracked while these linger)
+            for (auto& kv : t->rows) t->touch(kv.first);
+            t->evict_over_capacity(-1);
+          }
+          write_full(cfd, &status, 4);
+          break;
+        }
+        case PS_CREATE_CTR: {
+          // meta: dim, rule, seed; params: lr, init_range, nonclk_coeff,
+          // click_coeff, decay_rate, delete_threshold,
+          // delete_after_unseen, initial_g2sum
+          uint32_t meta[3];
+          float params[8];
+          if (!read_full(cfd, meta, sizeof(meta)) ||
+              !read_full(cfd, params, sizeof(params)))
+            return;
+          CtrTable* t;
+          {
+            std::lock_guard<std::mutex> l(tables_mu);
+            t = &ctr[tid];
+          }
+          std::lock_guard<std::mutex> lt(t->mu);
+          t->rows.clear();
+          t->dim = meta[0];
+          t->rule = meta[1];
+          t->rng.seed(meta[2]);
+          t->lr = params[0];
+          t->init_range = params[1];
+          t->nonclk_coeff = params[2];
+          t->click_coeff = params[3];
+          t->decay_rate = params[4];
+          t->delete_threshold = params[5];
+          t->delete_after_unseen = params[6];
+          t->initial_g2sum = params[7];
+          write_full(cfd, &status, 4);
+          break;
+        }
+        case PS_PUSH_CTR: {
+          uint32_t dim;
+          if (!read_full(cfd, &dim, 4)) return;
+          std::vector<int64_t> ids(n);
+          CtrTable* t = ctr_tab(tid);
+          size_t psz = 4 + dim;
+          std::vector<float> pv(size_t(n) * psz);
+          if (!read_full(cfd, ids.data(), n * 8) ||
+              !read_full(cfd, pv.data(), pv.size() * 4))
+            return;
+          if (!t) {
+            status = -1;
+          } else {
+            std::lock_guard<std::mutex> l(t->mu);
+            if (static_cast<uint32_t>(t->dim) != dim) {
+              status = -4;
+            } else {
+              for (uint32_t i = 0; i < n; ++i)
+                t->push_one(t->row(ids[i]), pv.data() + size_t(i) * psz);
+            }
+          }
+          write_full(cfd, &status, 4);
+          break;
+        }
+        case PS_PULL_CTR: {
+          uint32_t dim;
+          std::vector<int64_t> ids(n);
+          if (!read_full(cfd, &dim, 4) ||
+              !read_full(cfd, ids.data(), n * 8))
+            return;
+          CtrTable* t = ctr_tab(tid);
+          size_t osz = 3 + dim;
+          std::vector<float> out(size_t(n) * osz);
+          if (!t) {
+            status = -1;
+          } else {
+            std::lock_guard<std::mutex> l(t->mu);
+            if (static_cast<uint32_t>(t->dim) != dim) {
+              status = -4;
+            } else {
+              for (uint32_t i = 0; i < n; ++i)
+                t->pull_one(t->row(ids[i]), out.data() + size_t(i) * osz);
+            }
+          }
+          write_full(cfd, &status, 4);
+          if (status == 0) write_full(cfd, out.data(), out.size() * 4);
+          break;
+        }
+        case PS_CTR_SHRINK: {
+          CtrTable* t = ctr_tab(tid);
+          uint64_t deleted = 0;
+          if (!t) {
+            status = -1;
+          } else {
+            std::lock_guard<std::mutex> l(t->mu);
+            deleted = t->shrink();
+          }
+          write_full(cfd, &status, 4);
+          write_full(cfd, &deleted, 8);
           break;
         }
         case PS_SAVE:
@@ -389,17 +775,37 @@ struct PsServer {
               status = -2;
             } else {
               std::lock_guard<std::mutex> l(t->mu);
-              uint64_t cnt = t->rows.size();
               uint32_t dim = t->dim;
               uint32_t rs = t->row_size();
+              // placeholder count first; rewritten with the number of
+              // records actually emitted so a failed disk read can't
+              // leave cnt > records (silent truncation on load)
+              uint64_t cnt = 0;
               std::fwrite(&cnt, 8, 1, f);
               std::fwrite(&dim, 4, 1, f);
               std::fwrite(&rs, 4, 1, f);
               for (auto& kv : t->rows) {
                 std::fwrite(&kv.first, 8, 1, f);
                 std::fwrite(kv.second.data(), 4, kv.second.size(), f);
+                ++cnt;
               }
+              // spilled rows not resident in memory
+              std::vector<float> tmp;
+              bool spill_read_err = false;
+              for (auto& kv : t->disk_index) {
+                if (t->rows.find(kv.first) != t->rows.end()) continue;
+                if (!t->read_disk(kv.first, &tmp)) {
+                  spill_read_err = true;
+                  continue;
+                }
+                std::fwrite(&kv.first, 8, 1, f);
+                std::fwrite(tmp.data(), 4, tmp.size(), f);
+                ++cnt;
+              }
+              std::fseek(f, 0, SEEK_SET);
+              std::fwrite(&cnt, 8, 1, f);
               std::fclose(f);
+              if (spill_read_err) status = -5;  // partial save
             }
           } else {
             FILE* f = std::fopen(path.data(), "rb");
@@ -423,6 +829,10 @@ struct PsServer {
                         std::fread(r.data(), 4, rs, f) != rs)
                       break;
                     t->rows[id] = std::move(r);
+                    if (t->spill_enabled()) {
+                      t->touch(id);
+                      t->evict_over_capacity(-1);
+                    }
                   }
                 }
               }
@@ -666,6 +1076,269 @@ int pt_ps_load(int fd, int tid, const char* path) {
   if (ps_req_header(fd, PS_LOAD, tid, n) != 0) return -1;
   if (!write_full(fd, path, n)) return -1;
   return ps_read_status(fd);
+}
+
+int pt_ps_set_spill(int fd, int tid, long long mem_capacity,
+                    const char* path) {
+  uint32_t n = std::strlen(path);
+  if (ps_req_header(fd, PS_SET_SPILL, tid, n) != 0) return -1;
+  uint64_t cap = mem_capacity;
+  if (!write_full(fd, &cap, 8) || !write_full(fd, path, n)) return -1;
+  return ps_read_status(fd);
+}
+
+int pt_ps_mem_rows(int fd, int tid, long long* out) {
+  if (ps_req_header(fd, PS_MEM_ROWS, tid, 0) != 0) return -1;
+  int status = ps_read_status(fd);
+  uint64_t sz = 0;
+  if (!read_full(fd, &sz, 8)) return -1;
+  *out = static_cast<long long>(sz);
+  return status;
+}
+
+int pt_ps_create_ctr(int fd, int tid, int dim, int rule, unsigned seed,
+                     float lr, float init_range, float nonclk_coeff,
+                     float click_coeff, float decay_rate,
+                     float delete_threshold, float delete_after_unseen,
+                     float initial_g2sum) {
+  if (ps_req_header(fd, PS_CREATE_CTR, tid, 0) != 0) return -1;
+  uint32_t meta[3] = {static_cast<uint32_t>(dim),
+                      static_cast<uint32_t>(rule), seed};
+  float params[8] = {lr, init_range, nonclk_coeff, click_coeff, decay_rate,
+                     delete_threshold, delete_after_unseen, initial_g2sum};
+  if (!write_full(fd, meta, sizeof(meta)) ||
+      !write_full(fd, params, sizeof(params)))
+    return -1;
+  return ps_read_status(fd);
+}
+
+int pt_ps_push_ctr(int fd, int tid, const long long* ids, int n, int dim,
+                   const float* push_values) {
+  if (ps_req_header(fd, PS_PUSH_CTR, tid, n) != 0) return -1;
+  uint32_t d = static_cast<uint32_t>(dim);
+  if (!write_full(fd, &d, 4) || !write_full(fd, ids, size_t(n) * 8) ||
+      !write_full(fd, push_values, size_t(n) * (4 + dim) * 4))
+    return -1;
+  return ps_read_status(fd);
+}
+
+int pt_ps_pull_ctr(int fd, int tid, const long long* ids, int n, int dim,
+                   float* out) {
+  if (ps_req_header(fd, PS_PULL_CTR, tid, n) != 0) return -1;
+  uint32_t d = static_cast<uint32_t>(dim);
+  if (!write_full(fd, &d, 4) || !write_full(fd, ids, size_t(n) * 8))
+    return -1;
+  int status = ps_read_status(fd);
+  if (status != 0) return status;
+  if (!read_full(fd, out, size_t(n) * (3 + dim) * 4)) return -1;
+  return 0;
+}
+
+long long pt_ps_ctr_shrink(int fd, int tid) {
+  if (ps_req_header(fd, PS_CTR_SHRINK, tid, 0) != 0) return -1;
+  int status = ps_read_status(fd);
+  uint64_t deleted = 0;
+  if (!read_full(fd, &deleted, 8)) return -1;
+  if (status != 0) return status;
+  return static_cast<long long>(deleted);
+}
+
+}  // extern "C"
+
+// ------------------------------------------------------- communicator
+// Client-side async gradient batching (reference
+// ps/service/communicator/communicator.h AsyncCommunicator: per-table
+// send queues drained by a background thread that MERGES gradients by
+// feature id and pushes batches). Modes: 0 async (server applies the
+// accessor rule), 1 geo (deltas merged additively). Sync training =
+// push + pt_comm_flush() every step.
+
+namespace {
+
+struct Communicator {
+  int fd = -1;
+  int mode = 0;              // push mode forwarded to the server
+  size_t merge_threshold = 8;  // flush after this many pending pushes
+  int flush_interval_ms = 200;
+  std::atomic<bool> stop{false};
+  std::thread flusher;
+  std::mutex mu;
+  std::condition_variable cv;
+  // per (table, dim): id -> accumulated grad
+  struct Pending {
+    int dim = 0;
+    size_t pushes = 0;
+    std::unordered_map<int64_t, std::vector<float>> grads;
+  };
+  std::map<int, Pending> sparse;
+  struct DensePending {
+    std::vector<float> grad;
+    size_t pushes = 0;
+  };
+  std::map<int, DensePending> dense;
+  std::atomic<long long> flushed_batches{0};
+
+  void push_sparse(int tid, const int64_t* ids, int n, int dim,
+                   const float* g) {
+    std::lock_guard<std::mutex> l(mu);
+    Pending& p = sparse[tid];
+    p.dim = dim;
+    for (int i = 0; i < n; ++i) {
+      auto& acc = p.grads[ids[i]];
+      if (acc.empty()) acc.assign(dim, 0.0f);
+      const float* gi = g + size_t(i) * dim;
+      for (int d = 0; d < dim; ++d) acc[d] += gi[d];
+    }
+    p.pushes++;
+    if (p.pushes >= merge_threshold) cv.notify_one();
+  }
+
+  void push_dense(int tid, const float* g, long size) {
+    std::lock_guard<std::mutex> l(mu);
+    DensePending& p = dense[tid];
+    if (p.grad.empty()) p.grad.assign(size, 0.0f);
+    for (long i = 0; i < size; ++i) p.grad[i] += g[i];
+    p.pushes++;
+    if (p.pushes >= merge_threshold) cv.notify_one();
+  }
+
+  std::mutex send_mu;  // serializes wire I/O: flusher thread vs flush()
+
+  int flush_locked_tables() {
+    // snapshot under `mu`, send under `send_mu`: the background flusher
+    // and a user-thread pt_comm_flush() may run concurrently, and
+    // interleaved request frames would corrupt the TCP protocol
+    std::map<int, Pending> s;
+    std::map<int, DensePending> d;
+    {
+      std::lock_guard<std::mutex> l(mu);
+      s.swap(sparse);
+      d.swap(dense);
+    }
+    std::lock_guard<std::mutex> send_lock(send_mu);
+    int rc = 0;
+    for (auto& kv : s) {
+      Pending& p = kv.second;
+      if (p.grads.empty()) continue;
+      std::vector<int64_t> ids;
+      std::vector<float> g;
+      ids.reserve(p.grads.size());
+      g.reserve(p.grads.size() * p.dim);
+      for (auto& e : p.grads) {
+        ids.push_back(e.first);
+        g.insert(g.end(), e.second.begin(), e.second.end());
+      }
+      if (pt_ps_push_sparse(fd, kv.first,
+                            reinterpret_cast<const long long*>(ids.data()),
+                            static_cast<int>(ids.size()), p.dim, g.data(),
+                            mode) != 0)
+        rc = -1;
+      flushed_batches++;
+    }
+    for (auto& kv : d) {
+      if (kv.second.grad.empty()) continue;
+      if (pt_ps_push_dense(fd, kv.first, kv.second.grad.data(),
+                           static_cast<long>(kv.second.grad.size()),
+                           mode) != 0)
+        rc = -1;
+      flushed_batches++;
+    }
+    return rc;
+  }
+
+  void run() {
+    // flush cadence (reference AsyncCommunicator): whichever comes
+    // first — merge_threshold pushes on any table (cv fires early from
+    // push_*) or flush_interval_ms of latency for stragglers.
+    std::unique_lock<std::mutex> l(mu);
+    while (!stop.load()) {
+      cv.wait_for(l, std::chrono::milliseconds(flush_interval_ms));
+      bool ready = false;
+      for (auto& kv : sparse)
+        if (kv.second.pushes > 0) ready = true;
+      for (auto& kv : dense)
+        if (kv.second.pushes > 0) ready = true;
+      if (!ready) continue;
+      l.unlock();
+      flush_locked_tables();
+      l.lock();
+    }
+  }
+};
+
+std::mutex g_comm_mu;
+std::map<int, Communicator*> g_comms;
+int g_next_comm = 1;
+
+}  // namespace
+
+extern "C" {
+
+int pt_comm_create(const char* host, int port, int timeout_ms, int mode,
+                   int merge_threshold, int flush_interval_ms) {
+  int fd = pt_ps_connect(host, port, timeout_ms);
+  if (fd < 0) return -1;
+  auto* c = new Communicator();
+  c->fd = fd;
+  c->mode = mode;
+  c->merge_threshold = merge_threshold > 0 ? merge_threshold : 1;
+  c->flush_interval_ms = flush_interval_ms > 0 ? flush_interval_ms : 200;
+  c->flusher = std::thread([c] { c->run(); });
+  std::lock_guard<std::mutex> l(g_comm_mu);
+  int h = g_next_comm++;
+  g_comms[h] = c;
+  return h;
+}
+
+static Communicator* comm_of(int h) {
+  std::lock_guard<std::mutex> l(g_comm_mu);
+  auto it = g_comms.find(h);
+  return it == g_comms.end() ? nullptr : it->second;
+}
+
+int pt_comm_push_sparse(int h, int tid, const long long* ids, int n,
+                        int dim, const float* grads) {
+  Communicator* c = comm_of(h);
+  if (!c) return -1;
+  c->push_sparse(tid, reinterpret_cast<const int64_t*>(ids), n, dim,
+                 grads);
+  return 0;
+}
+
+int pt_comm_push_dense(int h, int tid, const float* grad, long size) {
+  Communicator* c = comm_of(h);
+  if (!c) return -1;
+  c->push_dense(tid, grad, size);
+  return 0;
+}
+
+int pt_comm_flush(int h) {
+  Communicator* c = comm_of(h);
+  if (!c) return -1;
+  return c->flush_locked_tables();
+}
+
+long long pt_comm_flushed_batches(int h) {
+  Communicator* c = comm_of(h);
+  return c ? c->flushed_batches.load() : -1;
+}
+
+int pt_comm_stop(int h) {
+  Communicator* c = nullptr;
+  {
+    std::lock_guard<std::mutex> l(g_comm_mu);
+    auto it = g_comms.find(h);
+    if (it == g_comms.end()) return -1;
+    c = it->second;
+    g_comms.erase(it);
+  }
+  c->stop.store(true);
+  c->cv.notify_all();
+  if (c->flusher.joinable()) c->flusher.join();
+  int rc = c->flush_locked_tables();
+  pt_ps_close(c->fd);
+  delete c;
+  return rc;
 }
 
 }  // extern "C"

@@ -1,4 +1,9 @@
 """Parameter-server path (reference paddle/fluid/distributed/ps/)."""
 from . import runtime, service  # noqa: F401
 from .runtime import TheOnePSRuntime  # noqa: F401
-from .service import GeoWorkerCache, PsClient, PsServer  # noqa: F401
+from .service import (  # noqa: F401
+    Communicator,
+    GeoWorkerCache,
+    PsClient,
+    PsServer,
+)

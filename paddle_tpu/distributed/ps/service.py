@@ -64,6 +64,40 @@ def _lib():
         lib.pt_ps_save.argtypes = [c.c_int, c.c_int, c.c_char_p]
         lib.pt_ps_load.restype = c.c_int
         lib.pt_ps_load.argtypes = [c.c_int, c.c_int, c.c_char_p]
+        lib.pt_ps_set_spill.restype = c.c_int
+        lib.pt_ps_set_spill.argtypes = [c.c_int, c.c_int, c.c_longlong,
+                                        c.c_char_p]
+        lib.pt_ps_mem_rows.restype = c.c_int
+        lib.pt_ps_mem_rows.argtypes = [c.c_int, c.c_int,
+                                       c.POINTER(c.c_longlong)]
+        lib.pt_ps_create_ctr.restype = c.c_int
+        lib.pt_ps_create_ctr.argtypes = [
+            c.c_int, c.c_int, c.c_int, c.c_int, c.c_uint, c.c_float,
+            c.c_float, c.c_float, c.c_float, c.c_float, c.c_float,
+            c.c_float, c.c_float]
+        lib.pt_ps_push_ctr.restype = c.c_int
+        lib.pt_ps_push_ctr.argtypes = [
+            c.c_int, c.c_int, c.c_void_p, c.c_int, c.c_int, c.c_void_p]
+        lib.pt_ps_pull_ctr.restype = c.c_int
+        lib.pt_ps_pull_ctr.argtypes = [
+            c.c_int, c.c_int, c.c_void_p, c.c_int, c.c_int, c.c_void_p]
+        lib.pt_ps_ctr_shrink.restype = c.c_longlong
+        lib.pt_ps_ctr_shrink.argtypes = [c.c_int, c.c_int]
+        lib.pt_comm_create.restype = c.c_int
+        lib.pt_comm_create.argtypes = [c.c_char_p, c.c_int, c.c_int,
+                                       c.c_int, c.c_int, c.c_int]
+        lib.pt_comm_push_sparse.restype = c.c_int
+        lib.pt_comm_push_sparse.argtypes = [
+            c.c_int, c.c_int, c.c_void_p, c.c_int, c.c_int, c.c_void_p]
+        lib.pt_comm_push_dense.restype = c.c_int
+        lib.pt_comm_push_dense.argtypes = [c.c_int, c.c_int, c.c_void_p,
+                                           c.c_long]
+        lib.pt_comm_flush.restype = c.c_int
+        lib.pt_comm_flush.argtypes = [c.c_int]
+        lib.pt_comm_flushed_batches.restype = c.c_longlong
+        lib.pt_comm_flushed_batches.argtypes = [c.c_int]
+        lib.pt_comm_stop.restype = c.c_int
+        lib.pt_comm_stop.argtypes = [c.c_int]
         lib._ps_proto_ready = True
     return lib
 
@@ -169,6 +203,81 @@ class PsClient:
         if rc != 0:
             raise RuntimeError("push_dense failed rc=%d" % rc)
 
+    # -- SSD spill (reference ssd_sparse_table.cc) -------------------------
+
+    def set_spill(self, table_id, mem_capacity, path):
+        """Bound the table's in-memory rows; LRU overflow spills to a
+        disk file at `path` (server-side)."""
+        rc = self._lib.pt_ps_set_spill(self._fd, table_id,
+                                       int(mem_capacity), path.encode())
+        if rc != 0:
+            raise RuntimeError("set_spill failed rc=%d" % rc)
+
+    def mem_rows(self, table_id):
+        """In-memory (non-spilled) row count."""
+        out = ctypes.c_longlong()
+        rc = self._lib.pt_ps_mem_rows(self._fd, table_id,
+                                      ctypes.byref(out))
+        if rc != 0:
+            raise RuntimeError("mem_rows failed rc=%d" % rc)
+        return int(out.value)
+
+    # -- CTR accessor (reference ctr_accessor.cc) --------------------------
+
+    def create_ctr_table(self, table_id, dim, rule="adagrad", lr=0.05,
+                         init_range=0.01, nonclk_coeff=0.1, click_coeff=1.0,
+                         decay_rate=0.98, delete_threshold=0.8,
+                         delete_after_unseen_days=30.0, initial_g2sum=3.0,
+                         seed=0):
+        """Sparse CTR table: rows carry show/click statistics and a
+        1-d embed + dim-d embedx weight chain, each updated server-side
+        by the chosen SGD rule (naive/adagrad/adam)."""
+        rc = self._lib.pt_ps_create_ctr(
+            self._fd, table_id, dim, OPTIMIZERS[rule], seed, lr,
+            init_range, nonclk_coeff, click_coeff, decay_rate,
+            delete_threshold, delete_after_unseen_days, initial_g2sum)
+        if rc != 0:
+            raise RuntimeError("create_ctr_table failed rc=%d" % rc)
+        self._dims[table_id] = dim
+
+    def push_ctr(self, table_id, ids, shows, clicks, embed_g, embedx_g,
+                 slots=None, dim=None):
+        """Push per-feature [slot, show, click, embed_g, embedx_g[dim]]."""
+        ids = np.ascontiguousarray(np.asarray(ids, np.int64).reshape(-1))
+        dim = dim or self._dims[table_id]
+        n = ids.size
+        pv = np.empty((n, 4 + dim), np.float32)
+        pv[:, 0] = np.asarray(slots if slots is not None
+                              else np.zeros(n), np.float32).reshape(-1)
+        pv[:, 1] = np.asarray(shows, np.float32).reshape(-1)
+        pv[:, 2] = np.asarray(clicks, np.float32).reshape(-1)
+        pv[:, 3] = np.asarray(embed_g, np.float32).reshape(-1)
+        pv[:, 4:] = np.asarray(embedx_g, np.float32).reshape(n, dim)
+        pv = np.ascontiguousarray(pv)
+        rc = self._lib.pt_ps_push_ctr(self._fd, table_id, ids.ctypes.data,
+                                      n, dim, pv.ctypes.data)
+        if rc != 0:
+            raise RuntimeError("push_ctr failed rc=%d" % rc)
+
+    def pull_ctr(self, table_id, ids, dim=None):
+        """-> (shows, clicks, embed_w, embedx_w[n, dim])."""
+        ids = np.ascontiguousarray(np.asarray(ids, np.int64).reshape(-1))
+        dim = dim or self._dims[table_id]
+        out = np.empty((ids.size, 3 + dim), np.float32)
+        rc = self._lib.pt_ps_pull_ctr(self._fd, table_id, ids.ctypes.data,
+                                      ids.size, dim, out.ctypes.data)
+        if rc != 0:
+            raise RuntimeError("pull_ctr failed rc=%d" % rc)
+        return out[:, 0], out[:, 1], out[:, 2], out[:, 3:]
+
+    def ctr_shrink(self, table_id):
+        """Daily maintenance: decay show/click, age unseen_days, delete
+        below-threshold rows. Returns the number deleted."""
+        rc = self._lib.pt_ps_ctr_shrink(self._fd, table_id)
+        if rc < 0:
+            raise RuntimeError("ctr_shrink failed rc=%d" % rc)
+        return int(rc)
+
     # -- misc --------------------------------------------------------------
 
     def sparse_size(self, table_id):
@@ -241,3 +350,63 @@ class GeoWorkerCache:
         for k, r in zip(ids, rows):
             self._base[int(k)] = r.copy()
             self._local[int(k)] = r.copy()
+
+
+class Communicator:
+    """Client-side async gradient batching (reference
+    ps/service/communicator/communicator.h AsyncCommunicator): pushes
+    land in native per-table queues, a background C++ thread merges
+    gradients by feature id and flushes batches to the server every
+    `merge_threshold` pushes or `flush_interval_ms`.
+
+    mode: "async" (server applies the accessor rule on each merged
+    batch) or "geo" (deltas merged additively into the weights).
+    Sync-SGD training = push_* then flush() every step (reference
+    a_sync=False barriers the same way)."""
+
+    def __init__(self, host="127.0.0.1", port=0, mode="async",
+                 merge_threshold=8, flush_interval_ms=200, timeout_s=30):
+        self._lib = _lib()
+        modes = {"async": 0, "geo": 1, "sync": 0}
+        self._h = self._lib.pt_comm_create(
+            host.encode(), port, int(timeout_s * 1000), modes[mode],
+            int(merge_threshold), int(flush_interval_ms))
+        if self._h < 0:
+            raise RuntimeError("Communicator: cannot connect %s:%d"
+                               % (host, port))
+
+    def push_sparse(self, table_id, ids, grads, dim):
+        ids = np.ascontiguousarray(np.asarray(ids, np.int64).reshape(-1))
+        grads = np.ascontiguousarray(
+            np.asarray(grads, np.float32).reshape(ids.size, dim))
+        rc = self._lib.pt_comm_push_sparse(
+            self._h, table_id, ids.ctypes.data, ids.size, dim,
+            grads.ctypes.data)
+        if rc != 0:
+            raise RuntimeError("comm push_sparse failed rc=%d" % rc)
+
+    def push_dense(self, table_id, grad):
+        grad = np.ascontiguousarray(np.asarray(grad, np.float32).reshape(-1))
+        rc = self._lib.pt_comm_push_dense(self._h, table_id,
+                                          grad.ctypes.data, grad.size)
+        if rc != 0:
+            raise RuntimeError("comm push_dense failed rc=%d" % rc)
+
+    def flush(self):
+        rc = self._lib.pt_comm_flush(self._h)
+        if rc != 0:
+            raise RuntimeError("comm flush failed rc=%d" % rc)
+
+    def flushed_batches(self):
+        return int(self._lib.pt_comm_flushed_batches(self._h))
+
+    def stop(self):
+        if self._h is not None and self._h >= 0:
+            self._lib.pt_comm_stop(self._h)
+            self._h = -1
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()

@@ -112,6 +112,228 @@ class TestGeoMode:
             np.testing.assert_allclose(g0.pull([1]), [[-1.0, -2.0]])
 
 
+class TestCtrAccessor:
+    """Reference ctr_accessor.cc semantics: show/click stats, chained
+    SGD rules for embed/embedx, decay + threshold shrink."""
+
+    def test_show_click_accumulate_and_naive_rule(self, server):
+        with PsClient(port=server.port) as cli:
+            cli.create_ctr_table(0, dim=4, rule="sgd", lr=0.5,
+                                 init_range=0.0)
+            gx = np.full((1, 4), 2.0, np.float32)
+            cli.push_ctr(0, [7], shows=[1.0], clicks=[1.0],
+                         embed_g=[3.0], embedx_g=gx)
+            shows, clicks, w, wx = cli.pull_ctr(0, [7])
+            np.testing.assert_allclose(shows, [1.0])
+            np.testing.assert_allclose(clicks, [1.0])
+            # naive rule: w -= lr * g (init 0)
+            np.testing.assert_allclose(w, [-1.5])
+            np.testing.assert_allclose(wx, -0.5 * gx)
+            # second push accumulates stats
+            cli.push_ctr(0, [7], shows=[2.0], clicks=[0.0],
+                         embed_g=[0.0], embedx_g=np.zeros((1, 4)))
+            shows, clicks, _, _ = cli.pull_ctr(0, [7])
+            np.testing.assert_allclose(shows, [3.0])
+            np.testing.assert_allclose(clicks, [1.0])
+
+    def test_adagrad_rule_oracle(self, server):
+        with PsClient(port=server.port) as cli:
+            lr, g2 = 0.1, 3.0
+            cli.create_ctr_table(0, dim=2, rule="adagrad", lr=lr,
+                                 init_range=0.0, initial_g2sum=g2)
+            gx = np.array([[2.0, 4.0]], np.float32)
+            # push_show=1 -> scale 1; first step g2sum starts at 0:
+            # w -= lr * g * sqrt(g2 / (g2 + 0))
+            cli.push_ctr(0, [1], shows=[1.0], clicks=[0.0],
+                         embed_g=[1.0], embedx_g=gx)
+            _, _, w, wx = cli.pull_ctr(0, [1])
+            np.testing.assert_allclose(wx, -lr * gx, rtol=1e-5)
+            np.testing.assert_allclose(w, [-lr], rtol=1e-5)
+            # second step: g2sum = mean(g^2) from step 1
+            cli.push_ctr(0, [1], shows=[1.0], clicks=[0.0],
+                         embed_g=[1.0], embedx_g=gx)
+            g2sum = float((gx ** 2).mean())
+            want = -lr * gx - lr * gx * np.sqrt(g2 / (g2 + g2sum))
+            _, _, _, wx2 = cli.pull_ctr(0, [1])
+            np.testing.assert_allclose(wx2, want, rtol=1e-5)
+
+    def test_show_scale_divides_gradient(self, server):
+        with PsClient(port=server.port) as cli:
+            cli.create_ctr_table(0, dim=2, rule="adagrad", lr=0.1,
+                                 init_range=0.0, initial_g2sum=3.0)
+            # push_show=4 -> grads scaled by 1/4 (reference show_scale)
+            gx = np.array([[4.0, 8.0]], np.float32)
+            cli.push_ctr(0, [2], shows=[4.0], clicks=[0.0],
+                         embed_g=[0.0], embedx_g=gx)
+            _, _, _, wx = cli.pull_ctr(0, [2])
+            np.testing.assert_allclose(wx, -0.1 * gx / 4.0, rtol=1e-5)
+
+    def test_shrink_decay_and_delete(self, server):
+        with PsClient(port=server.port) as cli:
+            cli.create_ctr_table(0, dim=2, rule="sgd", lr=0.1,
+                                 init_range=0.0, nonclk_coeff=0.1,
+                                 click_coeff=1.0, decay_rate=0.5,
+                                 delete_threshold=0.8)
+            z = np.zeros((1, 2), np.float32)
+            # hot row: score after decay = (10-5)*0.5*0.1 + 5*0.5*1 = 2.75
+            cli.push_ctr(0, [1], shows=[10.0], clicks=[5.0],
+                         embed_g=[0.0], embedx_g=z)
+            # cold row: score after decay = 1*0.5*0.1 = 0.05 < 0.8
+            cli.push_ctr(0, [2], shows=[1.0], clicks=[0.0],
+                         embed_g=[0.0], embedx_g=z)
+            assert cli.ctr_shrink(0) == 1
+            assert cli.sparse_size(0) == 1
+            shows, clicks, _, _ = cli.pull_ctr(0, [1])
+            np.testing.assert_allclose(shows, [5.0])   # decayed
+            np.testing.assert_allclose(clicks, [2.5])
+
+    def test_unseen_days_eviction(self, server):
+        with PsClient(port=server.port) as cli:
+            cli.create_ctr_table(0, dim=2, rule="sgd",
+                                 decay_rate=1.0, delete_threshold=0.0,
+                                 delete_after_unseen_days=2.0)
+            cli.push_ctr(0, [1], shows=[100.0], clicks=[100.0],
+                         embed_g=[0.0], embedx_g=np.zeros((1, 2)))
+            assert cli.ctr_shrink(0) == 0  # unseen=1
+            assert cli.ctr_shrink(0) == 0  # unseen=2
+            assert cli.ctr_shrink(0) == 1  # unseen=3 > 2 -> deleted
+            assert cli.sparse_size(0) == 0
+
+
+class TestSsdSpillTable:
+    """Reference ssd_sparse_table.cc: bounded memory + disk overflow."""
+
+    def test_lru_spill_and_readback(self, server, tmp_path):
+        with PsClient(port=server.port) as cli:
+            cli.create_sparse_table(0, 2, optimizer="sgd", lr=1.0,
+                                    init_std=0.0)
+            cli.set_spill(0, mem_capacity=4,
+                          path=str(tmp_path / "spill.bin"))
+            # write 10 distinct rows via pushes (create-on-miss)
+            for i in range(10):
+                cli.push_sparse(0, [i], np.full((1, 2), float(i + 1),
+                                                np.float32))
+            assert cli.sparse_size(0) == 10      # total incl. spilled
+            assert cli.mem_rows(0) <= 4          # memory bounded
+            # spilled rows read back intact (w = -g after lr=1 sgd)
+            for i in range(10):
+                np.testing.assert_allclose(
+                    cli.pull_sparse(0, [i]), [[-(i + 1.0), -(i + 1.0)]])
+            # pulls promoted rows through memory without exceeding cap
+            assert cli.mem_rows(0) <= 4
+
+    def test_set_spill_on_populated_table(self, server, tmp_path):
+        # regression: enabling spill on a table that already holds rows
+        # must enter them into the LRU (else the new row could be its
+        # own eviction victim -> server use-after-free) and enforce the
+        # capacity on the pre-existing rows too
+        with PsClient(port=server.port) as cli:
+            cli.create_sparse_table(0, 2, optimizer="sgd", lr=1.0,
+                                    init_std=0.0)
+            for i in range(8):
+                cli.push_sparse(0, [i], np.full((1, 2), float(i + 1),
+                                                np.float32))
+            cli.set_spill(0, mem_capacity=3,
+                          path=str(tmp_path / "spill.bin"))
+            assert cli.mem_rows(0) <= 3  # pre-existing rows evicted
+            # new row insert right after set_spill (the crash scenario)
+            cli.push_sparse(0, [100], np.full((1, 2), 0.5, np.float32))
+            np.testing.assert_allclose(cli.pull_sparse(0, [100]),
+                                       [[-0.5, -0.5]])
+            assert cli.sparse_size(0) == 9
+            for i in range(8):
+                np.testing.assert_allclose(
+                    cli.pull_sparse(0, [i]), [[-(i + 1.0), -(i + 1.0)]])
+
+    def test_spilled_rows_survive_save_load(self, server, tmp_path):
+        with PsClient(port=server.port) as cli:
+            cli.create_sparse_table(0, 2, optimizer="sgd", lr=1.0,
+                                    init_std=0.0)
+            cli.set_spill(0, mem_capacity=2,
+                          path=str(tmp_path / "spill.bin"))
+            for i in range(6):
+                cli.push_sparse(0, [i], np.full((1, 2), float(i + 1),
+                                                np.float32))
+            cli.save(0, str(tmp_path / "table.bin"))
+            # fresh table (same layout), load -> all 6 rows back
+            cli.create_sparse_table(1, 2, optimizer="sgd", lr=1.0,
+                                    init_std=0.0)
+            cli.load(1, str(tmp_path / "table.bin"))
+            assert cli.sparse_size(1) == 6
+            for i in range(6):
+                np.testing.assert_allclose(
+                    cli.pull_sparse(1, [i]), [[-(i + 1.0), -(i + 1.0)]])
+
+
+class TestCommunicator:
+    """Reference AsyncCommunicator: client-side merge + batched flush."""
+
+    def test_async_merge_by_id(self, server):
+        from paddle_tpu.distributed.ps import Communicator
+
+        with PsClient(port=server.port) as cli:
+            cli.create_sparse_table(0, 2, optimizer="sgd", lr=1.0,
+                                    init_std=0.0)
+            comm = Communicator(port=server.port, mode="async",
+                                merge_threshold=1000,
+                                flush_interval_ms=10_000)
+            try:
+                # same id pushed 3x -> merged client-side into ONE
+                # gradient before the server applies sgd once
+                for _ in range(3):
+                    comm.push_sparse(0, [5], np.ones((1, 2), np.float32),
+                                     dim=2)
+                comm.push_sparse(0, [6], np.full((1, 2), 2.0, np.float32),
+                                 dim=2)
+                comm.flush()
+                np.testing.assert_allclose(cli.pull_sparse(0, [5]),
+                                           [[-3.0, -3.0]])
+                np.testing.assert_allclose(cli.pull_sparse(0, [6]),
+                                           [[-2.0, -2.0]])
+                assert comm.flushed_batches() >= 1
+            finally:
+                comm.stop()
+
+    def test_background_flush_by_threshold(self, server):
+        import time
+
+        from paddle_tpu.distributed.ps import Communicator
+
+        with PsClient(port=server.port) as cli:
+            cli.create_dense_table(1, 4, optimizer="sgd", lr=1.0)
+            comm = Communicator(port=server.port, mode="async",
+                                merge_threshold=2, flush_interval_ms=20)
+            try:
+                comm.push_dense(1, np.ones(4, np.float32))
+                comm.push_dense(1, np.ones(4, np.float32))
+                deadline = time.time() + 5.0
+                while time.time() < deadline:
+                    if np.allclose(cli.pull_dense(1, 4), -2.0):
+                        break
+                    time.sleep(0.05)
+                np.testing.assert_allclose(cli.pull_dense(1, 4), -2.0)
+            finally:
+                comm.stop()
+
+    def test_geo_mode_merges_deltas(self, server):
+        from paddle_tpu.distributed.ps import Communicator
+
+        with PsClient(port=server.port) as cli:
+            cli.create_sparse_table(0, 2, optimizer="sgd", lr=1.0,
+                                    init_std=0.0)
+            comm = Communicator(port=server.port, mode="geo",
+                                merge_threshold=1000,
+                                flush_interval_ms=10_000)
+            try:
+                comm.push_sparse(0, [3], np.array([[0.5, -0.5]]), dim=2)
+                comm.flush()
+                # geo: delta ADDED to weights (no optimizer rule)
+                np.testing.assert_allclose(cli.pull_sparse(0, [3]),
+                                           [[0.5, -0.5]])
+            finally:
+                comm.stop()
+
+
 class TestRuntimeFacade:
     def test_remote_runtime(self):
         rt = TheOnePSRuntime()
