@@ -2,6 +2,8 @@
 (reference python/paddle/nn/functional/{common,input,vision}.py)."""
 from __future__ import annotations
 
+import functools as _functools
+
 import jax
 import jax.numpy as jnp
 
@@ -21,13 +23,60 @@ def linear(x, weight, bias=None):
     return out
 
 
+@_functools.lru_cache(maxsize=None)
+def _lookup_matmul_grad_fn(vocab, wdtype_name):
+    """Embedding lookup whose weight grad is a one-hot contraction over
+    the token dims instead of XLA's take-grad scatter: under GSPMD a
+    scatter-add from a batch-sharded cotangent into an mp/sharding-
+    sharded weight grad triggers "Involuntary full rematerialization"
+    (all-gather + remat); the dot partitions cleanly (partial sums ->
+    reduce-scatter) and rides the MXU. vocab/dtype are static, hence the
+    closure factory (custom_vjp residuals must be JAX types)."""
+    import numpy as np
+
+    @jax.custom_vjp
+    def lk(w, x):
+        return jnp.take(w, x, axis=0)
+
+    def fwd(w, x):
+        return jnp.take(w, x, axis=0), x
+
+    def bwd(x, g):
+        oh = jax.nn.one_hot(x, vocab, dtype=g.dtype)
+        xdims = tuple(range(x.ndim))
+        gw = jax.lax.dot_general(oh, g, ((xdims, xdims), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        return (gw.astype(wdtype_name),
+                np.zeros(x.shape, jax.dtypes.float0))
+
+    lk.defvjp(fwd, bwd)
+    return lk
+
+
 @primitive
 def embedding(x, weight, padding_idx=None, sparse=False):
     # gathers rows of weight; on TPU this lowers to a dynamic-gather that XLA
     # vectorizes — the analog of phi/kernels/embedding_kernel (lookup_table_v2)
     x = _A(x).astype(jnp.int32)
     w = _A(weight)
-    out = jnp.take(w, x, axis=0)
+    # The one-hot grad only pays off when the WEIGHT itself can be
+    # sharded (mp vocab rows / ZeRO grads): gate on an explicitly built
+    # mesh with a >1 mp or sharding axis. Never call get_mesh() here —
+    # it would fabricate a default mesh as a side effect, and dp-only
+    # (batch) sharding partitions the take-grad scatter fine.
+    sharded_weight = False
+    try:
+        from ...distributed import mesh as _mesh_mod
+
+        mesh = _mesh_mod._global_mesh
+        sharded_weight = mesh is not None and any(
+            mesh.shape.get(a, 1) > 1 for a in ("mp", "sharding"))
+    except Exception:
+        pass
+    if sharded_weight:
+        out = _lookup_matmul_grad_fn(w.shape[0], w.dtype.name)(w, x)
+    else:  # single chip / dp-only: take-grad scatter is cheaper
+        out = jnp.take(w, x, axis=0)
     if padding_idx is not None:
         if padding_idx < 0:
             padding_idx = w.shape[0] + padding_idx

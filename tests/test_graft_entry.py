@@ -40,6 +40,10 @@ def test_dryrun_multichip_self_provisions():
                           text=True, timeout=900)
     assert proc.returncode == 0, proc.stderr[-3000:]
     assert "OK" in proc.stdout, proc.stdout
+    # the parent raises on SPMD remat fallbacks; belt-and-braces assert
+    # none leaked to this process's view either (VERDICT r2: the gate
+    # must be warning-clean, not just green)
+    assert "Involuntary full rematerialization" not in proc.stderr
 
 
 def test_entry_compiles_single_chip():
