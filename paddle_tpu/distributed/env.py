@@ -41,6 +41,21 @@ def init_parallel_env(strategy=None):
     # derived from PADDLE_MASTER) so it never collides with the TCP store.
     nnodes = int(os.environ.get("PADDLE_NNODES", "1"))
     if nnodes > 1:
+        if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+            # CPU multi-process collectives need the gloo implementation
+            # (the portable backend — reference uses gloo for exactly
+            # this role, SURVEY §5 comm backends); must be set before
+            # jax.distributed.initialize.
+            try:
+                jax.config.update(
+                    "jax_cpu_collectives_implementation", "gloo")
+            except Exception as e:
+                import warnings
+
+                warnings.warn(
+                    "init_parallel_env: could not enable gloo CPU "
+                    "collectives (%s); cross-process CPU collectives "
+                    "will likely fail" % e)
         coord = os.environ.get("COORDINATOR_ADDRESS")
         if not coord and os.environ.get("PADDLE_MASTER"):
             host, _, port = os.environ["PADDLE_MASTER"].partition(":")
@@ -55,7 +70,13 @@ def init_parallel_env(strategy=None):
                         os.environ.get("PADDLE_TRAINER_ID", "0"))),
                 )
             except RuntimeError as e:
-                # backends already up (interactive use): store-only mode
+                # Only the backends-already-initialized case (interactive
+                # use) may degrade to store-only mode; a bind/connect
+                # failure on an intended multi-host run must NOT be
+                # swallowed — training would silently continue on the
+                # local topology only.
+                if "already" not in str(e).lower():
+                    raise
                 import warnings
 
                 warnings.warn(

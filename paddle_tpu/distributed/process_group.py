@@ -60,6 +60,16 @@ class StoreProcessGroup:
     All collectives are synchronous and must be called in the same order
     on every member rank (MPI matching rules, like the reference's
     ProcessGroup). `ranks=None` means all processes in the world.
+
+    SCOPE (the reference's gloo-backend role, not its NCCL role): tensors
+    move through the TCP store as numpy payloads, so this is the
+    CONTROL-PLANE / test backend — bootstrap barriers, metric reduction,
+    small-object broadcast, and the portable harness for collective
+    semantics tests. The PERFORMANCE path for tensor collectives is the
+    compiled one (XLA collectives over ICI/DCN inside jitted steps, or
+    the one-op compiled modules in collective.py) on the global mesh that
+    init_parallel_env brings up via jax.distributed.initialize — proven
+    across real processes by tests/test_multihost.py.
     """
 
     def __init__(self, store, rank, world_size, prefix="pg/default"):

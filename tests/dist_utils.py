@@ -1,0 +1,39 @@
+"""Shared plumbing for forked multi-process distributed tests
+(the reference TestDistBase harness analog)."""
+from __future__ import annotations
+
+import socket
+
+
+def free_ports(n=1, host="127.0.0.1"):
+    """Reserve n CONSECUTIVE free ports and return the first. Needed when
+    a service derives sibling ports by offset (init_parallel_env puts the
+    JAX coordinator on store-port + 1) — reserving only the base port
+    leaves the sibling open to bind collisions."""
+    for _ in range(64):
+        socks = []
+        try:
+            s0 = socket.socket()
+            s0.bind((host, 0))
+            base = s0.getsockname()[1]
+            socks.append(s0)
+            ok = True
+            for i in range(1, n):
+                s = socket.socket()
+                try:
+                    s.bind((host, base + i))
+                    socks.append(s)
+                except OSError:
+                    s.close()
+                    ok = False
+                    break
+            if ok:
+                return base
+        finally:
+            for s in socks:
+                s.close()
+    raise RuntimeError("could not reserve %d consecutive ports" % n)
+
+
+def free_port(host="127.0.0.1"):
+    return free_ports(1, host)

@@ -1,0 +1,62 @@
+"""Multi-host worker: one OS process hosting 4 virtual CPU devices,
+joining a 2-process global mesh of 8 devices via init_parallel_env
+(reference pattern: unittests/test_dist_base.py worker model files with
+runtime_main — the same file is spawnable worker and library).
+
+Env contract (set by the parent test):
+  PADDLE_NNODES=2  PADDLE_NODE_RANK=<0|1>  PADDLE_MASTER=host:port
+  PADDLE_TRAINERS_NUM=2  PADDLE_TRAINER_ID=<0|1>
+  JAX_PLATFORMS=cpu  XLA_FLAGS=--xla_force_host_platform_device_count=4
+
+Runs N dp train steps of the tiny Llama on the GLOBAL 8-device mesh and
+prints one line per step: LOSS <step> <value>. The parent compares the
+sequence against a single-process 8-device golden run.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+
+def runtime_main(steps=3):
+    import numpy as np
+    import jax
+
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.distributed import mesh as pmesh
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.parallel.engine import CompiledTrainStep
+
+    dist.init_parallel_env()
+    assert jax.device_count() == 8, jax.device_count()
+    if int(os.environ.get("PADDLE_NNODES", "1")) > 1:
+        assert jax.process_count() == 2, jax.process_count()
+        assert jax.local_device_count() == 4
+
+    pmesh.build_hybrid_mesh(dp=8)
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny(use_parallel=False)
+    model = LlamaForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+
+    def loss_fn(logits, labels):
+        return F.cross_entropy(
+            logits.reshape([-1, cfg.vocab_size]), labels.reshape([-1]))
+
+    step = CompiledTrainStep(model, loss_fn, opt)
+    rng = np.random.RandomState(7)  # identical data in every process
+    for i in range(steps):
+        ids = rng.randint(0, cfg.vocab_size, (8, 16)).astype(np.int32)
+        labels = rng.randint(0, cfg.vocab_size, (8, 16)).astype(np.int32)
+        loss = step(paddle.to_tensor(ids), paddle.to_tensor(labels))
+        print("LOSS %d %.6f" % (i, float(loss)), flush=True)
+
+
+if __name__ == "__main__":
+    runtime_main(int(sys.argv[1]) if len(sys.argv) > 1 else 3)
