@@ -111,6 +111,37 @@ def _unwrap(x):
     return x._value if isinstance(x, Tensor) else x
 
 
+def _reraise_op_error(op_name, leaves, e):
+    """Structured-error enrichment at the dispatch boundary (reference
+    enforce.h: every throw site carries the op + inputs). EnforceNotMet
+    gets the op/input payload attached in place; a matching builtin is
+    re-raised as its typed subclass (still caught by `except <builtin>`);
+    anything else propagates untouched."""
+    from . import enforce as _errors
+
+    def _shapes():
+        out = []
+        for l in leaves:
+            v = l._value if isinstance(l, Tensor) else l
+            shp = getattr(v, "shape", None)
+            if shp is not None and not callable(shp):
+                out.append(tuple(shp))
+        return out
+
+    if isinstance(e, _errors.EnforceNotMet):
+        e.with_op(op_name)
+        e.context.setdefault("input_shapes", _shapes())
+        raise e
+    typed = _errors.BUILTIN_TO_TYPED.get(type(e))
+    if typed is None:
+        raise e
+    # KeyError's str() is the repr of the missing key alone — keep the
+    # payload meaningful
+    msg = ("key %r not found" % e.args[0]
+           if isinstance(e, KeyError) and e.args else str(e))
+    raise typed(msg, op=op_name, input_shapes=_shapes()) from e
+
+
 def _contains_tensor(leaves):
     for l in leaves:
         if isinstance(l, Tensor):
@@ -191,6 +222,8 @@ def primitive(fn=None, *, name=None, nondiff=False):
                 _enter_primitive()
                 try:
                     out = raw_fn(*a2, **k2)
+                except Exception as e:
+                    _reraise_op_error(op_name, leaves, e)
                 finally:
                     _exit_primitive()
                 multi = isinstance(out, (tuple, list))
@@ -219,6 +252,8 @@ def primitive(fn=None, *, name=None, nondiff=False):
             _enter_primitive()
             try:
                 out_vals, vjp_fn = jax.vjp(pure, *vals)
+            except Exception as e:
+                _reraise_op_error(op_name, leaves, e)
             finally:
                 _exit_primitive()
             node = _autograd.GradNode(op_name, vjp_fn, in_tensors, out_vals,

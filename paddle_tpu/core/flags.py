@@ -29,6 +29,9 @@ _DEFAULTS = {
     "FLAGS_distributed_barrier_timeout_s": 600,
     # logging
     "FLAGS_v": 0,
+    # structured errors (reference FLAGS_call_stack_level, enforce.h):
+    # 0 = message only, 1 = + structured context, 2 = + chained cause
+    "FLAGS_call_stack_level": 1,
 }
 
 _flags = {}

@@ -87,20 +87,37 @@ def device_count() -> int:
 _current_place = None
 
 
-def set_device(device):
-    """paddle.set_device analog (reference python/paddle/device/__init__.py)."""
-    global _current_place
+def place_for(device, default_idx=0):
+    """Parse a device string into a Place: 'cpu', 'tpu:1', a registered
+    custom device type ('fake_cpu:0'), or 'custom:<type>:<id>'. Vendor
+    aliases map to the accelerator backend."""
     if isinstance(device, Place):
-        _current_place = device
-        return _current_place
+        return device
     name = str(device)
-    if ":" in name:
-        kind, _, idx = name.partition(":")
-        idx = int(idx)
-    else:
-        kind, idx = name, 0
-    kind = {"gpu": "tpu", "cuda": "tpu", "xpu": "tpu", "npu": "tpu"}.get(kind, kind)
-    _current_place = CPUPlace() if kind == "cpu" else TPUPlace(idx)
+    explicit_custom = name.startswith("custom:")
+    if explicit_custom:
+        name = name[len("custom:"):]
+    kind, _, idx = name.partition(":")
+    idx = int(idx) if idx else default_idx
+    if explicit_custom and kind not in _custom_devices:
+        raise ValueError(
+            "place_for: custom device type %r is not registered "
+            "(registered: %s)" % (kind, sorted(_custom_devices) or "none"))
+    kind = {"gpu": "tpu", "cuda": "tpu", "xpu": "tpu",
+            "npu": "tpu"}.get(kind, kind)
+    if kind == "cpu":
+        return CPUPlace()
+    if kind in _custom_devices:
+        return CustomPlace(kind, idx)
+    return TPUPlace(idx)
+
+
+def set_device(device):
+    """paddle.set_device analog (reference python/paddle/device/__init__.py).
+    Accepts 'cpu' / 'tpu[:i]' / vendor aliases / a registered custom
+    device type name (reference paddle.set_device('custom_cpu:0'))."""
+    global _current_place
+    _current_place = place_for(device)
     return _current_place
 
 
@@ -182,6 +199,43 @@ def register_custom_device(device_type, pjrt_plugin_path, options=None):
     _custom_devices[device_type] = pjrt_plugin_path
     _devices_by_type.cache_clear()
     return CustomPlace(device_type, 0)
+
+
+def register_custom_device_factory(device_type, factory, priority=-100):
+    """Register a custom backend from an in-process PJRT client factory.
+
+    This is the TESTING/prototyping path — the analog of the reference's
+    fake plugin device (phi/backends/custom/fake_cpu_device.h:1, used by
+    custom_device_test.cc to prove the plugin runtime without hardware).
+    Real hardware ships a PJRT C-API .so through register_custom_device.
+    Negative priority keeps the plugged backend from stealing the
+    default-platform slot."""
+    from jax._src import xla_bridge
+
+    if xla_bridge.backends_are_initialized():
+        raise RuntimeError(
+            "register_custom_device_factory(%r) called after the JAX "
+            "runtime initialized; register before any op/mesh/device "
+            "call." % device_type)
+    xla_bridge.register_backend_factory(device_type, factory,
+                                        priority=priority)
+    _custom_devices[device_type] = "<factory>"
+    _devices_by_type.cache_clear()
+    return CustomPlace(device_type, 0)
+
+
+def register_fake_cpu_device(device_type="fake_cpu"):
+    """The reference fake_cpu_device analog: registers a host-memory PJRT
+    client under its own platform name so the whole custom-device path
+    (registration -> discovery -> placement -> compiled execution) is
+    testable on any machine."""
+
+    def factory():
+        from jax._src.lib import xla_client
+
+        return xla_client.make_cpu_client()
+
+    return register_custom_device_factory(device_type, factory)
 
 
 def get_all_custom_device_type():

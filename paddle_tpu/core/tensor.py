@@ -207,13 +207,7 @@ class Tensor:
         if dtype is not None:
             out = out.astype(dtype)
         if device is not None:
-            kind = device.split(":")[0]
-            kind = {"gpu": "tpu", "cuda": "tpu"}.get(kind, kind)
-            pl = (
-                _place.CPUPlace()
-                if kind == "cpu"
-                else _place.TPUPlace(int(device.split(":")[1]) if ":" in device else 0)
-            )
+            pl = _place.place_for(device)
             val = jax.device_put(out._value, pl.jax_device())
             t = Tensor(val, stop_gradient=out.stop_gradient, name=out.name)
             t._grad_node = out._grad_node

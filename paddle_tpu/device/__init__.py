@@ -19,6 +19,10 @@ from ..core.place import (  # noqa: F401
     is_compiled_with_cuda,
     is_compiled_with_custom_device,
     is_compiled_with_tpu,
+    place_for,
+    register_custom_device,
+    register_custom_device_factory,
+    register_fake_cpu_device,
     set_device,
 )
 from . import cuda  # noqa: F401

@@ -1,9 +1,14 @@
 """paddle_tpu.distributed.auto_parallel — semi-auto SPMD
 (reference python/paddle/distributed/auto_parallel/)."""
-from .completion import Completer  # noqa: F401
+from .completion import Completer, op_family  # noqa: F401
 from .cost_model import CostEstimator, MachineSpec  # noqa: F401
 from .engine import Engine  # noqa: F401
 from .interface import get_sharding, shard_op, shard_tensor  # noqa: F401
 from .partitioner import Partitioner, Resharder  # noqa: F401
-from .planner import Planner  # noqa: F401
+from .planner import (  # noqa: F401
+    MeshPlanner,
+    Planner,
+    enumerate_mesh_plans,
+    program_stats,
+)
 from .process_mesh import ProcessMesh, auto_process_mesh  # noqa: F401

@@ -21,6 +21,7 @@ import ctypes
 import numpy as np
 
 from ...core import native
+from ...core.enforce import raise_native
 
 OPTIMIZERS = {"sgd": 0, "adagrad": 1, "adam": 2}
 
@@ -152,14 +153,14 @@ class PsClient:
             self._fd, table_id, dim, OPTIMIZERS[optimizer], lr, init_std,
             seed)
         if rc != 0:
-            raise RuntimeError("create_sparse_table failed rc=%d" % rc)
+            raise_native(rc, "create_sparse_table")
         self._dims[table_id] = dim
 
     def create_dense_table(self, table_id, size, optimizer="sgd", lr=0.01):
         rc = self._lib.pt_ps_create_dense(
             self._fd, table_id, int(size), OPTIMIZERS[optimizer], lr)
         if rc != 0:
-            raise RuntimeError("create_dense_table failed rc=%d" % rc)
+            raise_native(rc, "create_dense_table")
 
     # -- sparse ------------------------------------------------------------
 
@@ -171,7 +172,7 @@ class PsClient:
             self._fd, table_id, ids.ctypes.data, ids.size, dim,
             out.ctypes.data)
         if rc != 0:
-            raise RuntimeError("pull_sparse failed rc=%d" % rc)
+            raise_native(rc, "pull_sparse")
         return out
 
     def push_sparse(self, table_id, ids, grads, dim=None, geo=False):
@@ -183,7 +184,7 @@ class PsClient:
             self._fd, table_id, ids.ctypes.data, ids.size, dim,
             grads.ctypes.data, 1 if geo else 0)
         if rc != 0:
-            raise RuntimeError("push_sparse failed rc=%d" % rc)
+            raise_native(rc, "push_sparse")
 
     # -- dense -------------------------------------------------------------
 
@@ -192,7 +193,7 @@ class PsClient:
         rc = self._lib.pt_ps_pull_dense(self._fd, table_id,
                                         out.ctypes.data, int(size))
         if rc != 0:
-            raise RuntimeError("pull_dense failed rc=%d" % rc)
+            raise_native(rc, "pull_dense")
         return out
 
     def push_dense(self, table_id, grad, geo=False):
@@ -201,7 +202,7 @@ class PsClient:
             self._fd, table_id, grad.ctypes.data, grad.size,
             1 if geo else 0)
         if rc != 0:
-            raise RuntimeError("push_dense failed rc=%d" % rc)
+            raise_native(rc, "push_dense")
 
     # -- SSD spill (reference ssd_sparse_table.cc) -------------------------
 
@@ -211,7 +212,7 @@ class PsClient:
         rc = self._lib.pt_ps_set_spill(self._fd, table_id,
                                        int(mem_capacity), path.encode())
         if rc != 0:
-            raise RuntimeError("set_spill failed rc=%d" % rc)
+            raise_native(rc, "set_spill")
 
     def mem_rows(self, table_id):
         """In-memory (non-spilled) row count."""
@@ -219,7 +220,7 @@ class PsClient:
         rc = self._lib.pt_ps_mem_rows(self._fd, table_id,
                                       ctypes.byref(out))
         if rc != 0:
-            raise RuntimeError("mem_rows failed rc=%d" % rc)
+            raise_native(rc, "mem_rows")
         return int(out.value)
 
     # -- CTR accessor (reference ctr_accessor.cc) --------------------------
@@ -237,7 +238,7 @@ class PsClient:
             init_range, nonclk_coeff, click_coeff, decay_rate,
             delete_threshold, delete_after_unseen_days, initial_g2sum)
         if rc != 0:
-            raise RuntimeError("create_ctr_table failed rc=%d" % rc)
+            raise_native(rc, "create_ctr_table")
         self._dims[table_id] = dim
 
     def push_ctr(self, table_id, ids, shows, clicks, embed_g, embedx_g,
@@ -257,7 +258,7 @@ class PsClient:
         rc = self._lib.pt_ps_push_ctr(self._fd, table_id, ids.ctypes.data,
                                       n, dim, pv.ctypes.data)
         if rc != 0:
-            raise RuntimeError("push_ctr failed rc=%d" % rc)
+            raise_native(rc, "push_ctr")
 
     def pull_ctr(self, table_id, ids, dim=None):
         """-> (shows, clicks, embed_w, embedx_w[n, dim])."""
@@ -267,7 +268,7 @@ class PsClient:
         rc = self._lib.pt_ps_pull_ctr(self._fd, table_id, ids.ctypes.data,
                                       ids.size, dim, out.ctypes.data)
         if rc != 0:
-            raise RuntimeError("pull_ctr failed rc=%d" % rc)
+            raise_native(rc, "pull_ctr")
         return out[:, 0], out[:, 1], out[:, 2], out[:, 3:]
 
     def ctr_shrink(self, table_id):
@@ -275,7 +276,7 @@ class PsClient:
         below-threshold rows. Returns the number deleted."""
         rc = self._lib.pt_ps_ctr_shrink(self._fd, table_id)
         if rc < 0:
-            raise RuntimeError("ctr_shrink failed rc=%d" % rc)
+            raise_native(rc, "ctr_shrink")
         return int(rc)
 
     # -- misc --------------------------------------------------------------
@@ -285,18 +286,18 @@ class PsClient:
         rc = self._lib.pt_ps_sparse_size(self._fd, table_id,
                                          ctypes.byref(out))
         if rc != 0:
-            raise RuntimeError("sparse_size failed rc=%d" % rc)
+            raise_native(rc, "sparse_size")
         return int(out.value)
 
     def save(self, table_id, path):
         rc = self._lib.pt_ps_save(self._fd, table_id, path.encode())
         if rc != 0:
-            raise RuntimeError("save failed rc=%d" % rc)
+            raise_native(rc, "save")
 
     def load(self, table_id, path):
         rc = self._lib.pt_ps_load(self._fd, table_id, path.encode())
         if rc != 0:
-            raise RuntimeError("load failed rc=%d" % rc)
+            raise_native(rc, "load")
 
     def __enter__(self):
         return self
@@ -383,19 +384,19 @@ class Communicator:
             self._h, table_id, ids.ctypes.data, ids.size, dim,
             grads.ctypes.data)
         if rc != 0:
-            raise RuntimeError("comm push_sparse failed rc=%d" % rc)
+            raise_native(rc, "comm push_sparse")
 
     def push_dense(self, table_id, grad):
         grad = np.ascontiguousarray(np.asarray(grad, np.float32).reshape(-1))
         rc = self._lib.pt_comm_push_dense(self._h, table_id,
                                           grad.ctypes.data, grad.size)
         if rc != 0:
-            raise RuntimeError("comm push_dense failed rc=%d" % rc)
+            raise_native(rc, "comm push_dense")
 
     def flush(self):
         rc = self._lib.pt_comm_flush(self._h)
         if rc != 0:
-            raise RuntimeError("comm flush failed rc=%d" % rc)
+            raise_native(rc, "comm flush")
 
     def flushed_batches(self):
         return int(self._lib.pt_comm_flushed_batches(self._h))

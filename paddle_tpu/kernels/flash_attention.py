@@ -42,15 +42,17 @@ def _dot(a, b, dims, batch=((), ())):
     throughput); fp32 operands inherit the framework's global matmul
     precision (FLAGS_matmul_precision, default 'highest'), preserving the
     documented fp32 guarantee for fp32 callers."""
-    # Gate on EITHER operand being bf16: a mixed bf16/fp32 pair under the
-    # global 'highest' precision hits Mosaic's "Bad lhs type" on bf16 dots
-    # inside Pallas kernels, so pin DEFAULT whenever bf16 is involved.
-    if a.dtype == jnp.bfloat16 or b.dtype == jnp.bfloat16:
-        if a.dtype != b.dtype:  # common dtype for the MXU
-            a = a.astype(jnp.bfloat16)
-            b = b.astype(jnp.bfloat16)
+    # Both-bf16 pairs pin DEFAULT (native MXU bf16). A MIXED bf16/fp32
+    # pair under the global 'highest' precision would hit Mosaic's "Bad
+    # lhs type" on the bf16 side, so upcast the bf16 operand to fp32 —
+    # never downcast the fp32 one, preserving its documented precision.
+    if a.dtype == jnp.bfloat16 and b.dtype == jnp.bfloat16:
         prec = jax.lax.Precision.DEFAULT
     else:
+        if a.dtype == jnp.bfloat16:
+            a = a.astype(jnp.float32)
+        if b.dtype == jnp.bfloat16:
+            b = b.astype(jnp.float32)
         prec = None
     return jax.lax.dot_general(a, b, (dims, batch),
                                preferred_element_type=jnp.float32,

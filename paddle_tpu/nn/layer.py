@@ -262,8 +262,7 @@ class Layer:
 
             from ..core import place as _place
 
-            kind = str(device).split(":")[0]
-            pl = (_place.CPUPlace() if kind == "cpu" else _place.TPUPlace(0))
+            pl = _place.place_for(device)
             for t in list(self.parameters()) + list(self.buffers()):
                 t._value = _jax.device_put(t._value, pl.jax_device())
         return self

@@ -148,3 +148,137 @@ class TestPlanner:
         name, cost, specs = Planner().plan(main, apply=True)
         if name in ("mp", "dp_mp"):
             assert l1.weight._sharding_spec is not None
+
+
+class TestOpFamilyCoverage:
+    def test_whole_registry_classified(self):
+        """VERDICT r2 #8: the old ~30-name rule table silently replicated
+        everything else. Every op in the live registry must classify into
+        a propagation family; the opaque bucket is capped so a growing
+        registry can't quietly drain into the fallback."""
+        from paddle_tpu.core.dispatch import OPS
+        from paddle_tpu.distributed.auto_parallel import op_family
+
+        fams = {}
+        for name in OPS:
+            fams.setdefault(op_family(name), []).append(name)
+        total = sum(len(v) for v in fams.values())
+        opaque = len(fams.get("opaque", []))
+        # ops with a real propagation rule must dominate the registry
+        assert opaque / total < 0.45, (
+            "opaque fallback covers %d/%d ops — add family rules: %s"
+            % (opaque, total, sorted(fams.get("opaque", []))[:30]))
+        for fam in ("elementwise", "reduction", "shape"):
+            assert len(fams.get(fam, [])) > 10, fam
+        assert len(fams.get("matmul", [])) >= 5
+
+    def test_unknown_op_completion_is_flagged(self):
+        import warnings
+
+        from paddle_tpu.core.dispatch import primitive
+
+        @primitive
+        def _ap_test_weird_op(x):
+            return x * 2.0
+
+        pmesh.build_hybrid_mesh(dp=2, mp=4)
+        paddle.seed(0)
+        static.enable_static()
+        main = static.Program()
+        with static.program_guard(main, static.Program()):
+            x = static.data("x", [8, 4], "float32")
+            y = _ap_test_weird_op(x)
+        static.disable_static()
+        c = Completer()
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            specs = c.complete_forward_annotation(main)
+            assert "_ap_test_weird_op" in c.unknown_ops
+            assert any("no propagation rule" in str(x.message) for x in w)
+        # the llama program, by contrast, must complete with NO unknowns
+        from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+        paddle.seed(0)
+        model = LlamaForCausalLM(LlamaConfig.tiny(use_parallel=False))
+        static.enable_static()
+        main = static.Program()
+        with static.program_guard(main, static.Program()):
+            ids = static.data("ids", [2, 8], "int32")
+            out = model(ids)
+        static.disable_static()
+        c2 = Completer()
+        c2.complete_forward_annotation(main)
+        assert not c2.unknown_ops, sorted(set(c2.unknown_ops))
+
+
+class TestMeshPlanner:
+    def _llama_stats(self):
+        from paddle_tpu.distributed.auto_parallel import program_stats
+        from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+        paddle.seed(0)
+        model = LlamaForCausalLM(LlamaConfig.tiny(use_parallel=False))
+        static.enable_static()
+        main = static.Program()
+        with static.program_guard(main, static.Program()):
+            ids = static.data("ids", [2, 8], "int32")
+            model(ids)
+        static.disable_static()
+        return program_stats(main)
+
+    def test_enumerates_all_factorizations(self):
+        from paddle_tpu.distributed.auto_parallel import (
+            enumerate_mesh_plans,
+        )
+
+        plans = enumerate_mesh_plans(8)
+        assert {"dp": 8, "mp": 1, "pp": 1, "sharding": 1} in plans
+        assert {"dp": 2, "mp": 2, "pp": 2, "sharding": 1} in plans
+        assert all(p["dp"] * p["mp"] * p["pp"] * p["sharding"] == 8
+                   for p in plans)
+
+    def test_compute_bound_model_prefers_data_parallel(self):
+        """Compute-bound regime (big per-step FLOPs, modest params):
+        pp's bubble multiplies real compute and mp pays per-layer
+        activation allreduces, so a pure data-parallel world (dp and/or
+        ZeRO sharding — cost-equivalent) must win."""
+        from paddle_tpu.distributed.auto_parallel import MeshPlanner
+
+        stats = {"flops": 1e15, "param_bytes": int(1e8),
+                 "act_bytes": int(1e8), "n_layers": 12}
+        best, score, ranking = MeshPlanner(hbm_bytes=16e9).plan(stats, 8)
+        assert best["dp"] * best["sharding"] == 8 and best["mp"] == 1 \
+            and best["pp"] == 1, (best, ranking[:3])
+
+    def test_tiny_llama_plan_is_feasible_and_ranked(self):
+        """The real tiny-Llama program plans without error and every
+        candidate in the ranking is a valid 8-device factorization (the
+        family the driver dryrun proves green)."""
+        from paddle_tpu.distributed.auto_parallel import MeshPlanner
+
+        stats = self._llama_stats()
+        best, score, ranking = MeshPlanner(hbm_bytes=16e9).plan(stats, 8)
+        assert best["dp"] * best["mp"] * best["pp"] * best["sharding"] == 8
+        assert score["time"] > 0 and score["mem"] > 0
+
+    def test_memory_pressure_forces_model_splitting(self):
+        """When the optimizer state cannot fit replicated, the planner
+        must pick a plan that divides parameters (mp/pp/sharding) — and
+        raise if NOTHING fits."""
+        from paddle_tpu.distributed.auto_parallel import MeshPlanner
+
+        stats = dict(self._llama_stats())
+        stats["param_bytes"] = int(4e9)  # pretend a 1B-param model
+        best, score, ranking = MeshPlanner(hbm_bytes=8e9).plan(stats, 8)
+        assert best["mp"] * best["pp"] * best["sharding"] > 1, best
+        with pytest.raises(ValueError, match="memory budget"):
+            MeshPlanner(hbm_bytes=1e6).plan(stats, 8)
+
+    def test_ranking_is_sorted_and_feasible(self):
+        from paddle_tpu.distributed.auto_parallel import MeshPlanner
+
+        stats = self._llama_stats()
+        _, _, ranking = MeshPlanner(hbm_bytes=16e9).plan(stats, 8)
+        times = [s["time"] for _, s in ranking]
+        assert times == sorted(times)
+        assert all(s["mem"] <= 16e9 for _, s in ranking)
