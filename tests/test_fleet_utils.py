@@ -387,3 +387,33 @@ class TestHybridParallelInference:
         out = h2.gen_infer_program()(
             paddle.to_tensor(np.zeros((1, 4), np.int32)))
         assert out.shape[-1] == 32
+
+
+class TestStrategyNoopKnobWarnings:
+    def test_enabling_noop_knob_warns(self):
+        import warnings
+
+        from paddle_tpu.distributed.fleet import DistributedStrategy
+
+        s = DistributedStrategy()  # construction itself must stay silent
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            s.dgc = True
+            s.use_hierarchical_allreduce = True
+            msgs = [str(x.message) for x in w]
+        assert sum("NO-OP" in m for m in msgs) == 2, msgs
+        assert any("dgc" in m for m in msgs)
+
+    def test_acting_knobs_do_not_warn(self):
+        import warnings
+
+        from paddle_tpu.distributed.fleet import DistributedStrategy
+
+        s = DistributedStrategy()
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            s.amp = True
+            s.sharding = True
+            s.hybrid_configs = {"dp_degree": 2, "mp_degree": 4}
+            msgs = [str(x.message) for x in w if "NO-OP" in str(x.message)]
+        assert not msgs, msgs
