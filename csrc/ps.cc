@@ -46,6 +46,7 @@
 #include <string>
 #include <thread>
 #include <unordered_map>
+#include <unordered_set>
 #include <vector>
 
 namespace {
@@ -352,6 +353,67 @@ struct CtrTable {
 };
 
 
+// Graph table for GNN training (reference ps/table/common_graph_table.h:
+// server-side graph storage + neighbor sampling so workers pull dense
+// sampled batches). Host-resident by design: the device only ever sees
+// fixed-shape [n, k] neighbor/feature tensors.
+struct GraphTable {
+  int feat_dim = 0;
+  std::mt19937 rng{0};
+  std::unordered_map<int64_t, std::vector<int64_t>> adj;
+  std::unordered_map<int64_t, std::vector<float>> feats;
+  std::vector<int64_t> nodes;  // insertion-ordered for random sampling
+  std::unordered_set<int64_t> node_seen;
+  std::mutex mu;
+
+  void touch_node(int64_t id) {
+    if (node_seen.insert(id).second) nodes.push_back(id);
+  }
+
+  void add_edges(const int64_t* src, const int64_t* dst, uint32_t n) {
+    for (uint32_t i = 0; i < n; ++i) {
+      adj[src[i]].push_back(dst[i]);
+      touch_node(src[i]);
+      touch_node(dst[i]);
+    }
+  }
+
+  // per id: k samples WITHOUT replacement when degree >= k, padded with
+  // -1 beyond the degree. Floyd's algorithm samples k distinct INDICES
+  // into the const adjacency vector — O(k) per id, no O(degree) copy
+  // (hub nodes on power-law graphs would otherwise dominate the lock)
+  void sample_neighbors(const int64_t* ids, uint32_t n, uint32_t k,
+                        int64_t* out) {
+    std::unordered_set<size_t> chosen;
+    for (uint32_t i = 0; i < n; ++i) {
+      int64_t* row = out + size_t(i) * k;
+      auto it = adj.find(ids[i]);
+      if (it == adj.end()) {
+        for (uint32_t j = 0; j < k; ++j) row[j] = -1;
+        continue;
+      }
+      const auto& nb = it->second;
+      if (nb.size() <= k) {
+        for (size_t j = 0; j < nb.size(); ++j) row[j] = nb[j];
+        for (size_t j = nb.size(); j < k; ++j) row[j] = -1;
+        continue;
+      }
+      chosen.clear();
+      uint32_t w = 0;
+      for (size_t j = nb.size() - k; j < nb.size(); ++j) {
+        std::uniform_int_distribution<size_t> d(0, j);
+        size_t pick = d(rng);
+        if (!chosen.insert(pick).second) {
+          chosen.insert(j);
+          pick = j;
+        }
+        row[w++] = nb[pick];
+      }
+    }
+  }
+};
+
+
 struct DenseTable {
   int opt = OPT_SGD;
   float lr = 0.01f;
@@ -406,6 +468,13 @@ enum PsOp : uint8_t {
   PS_CTR_SHRINK = 13,
   PS_SET_SPILL = 14,
   PS_MEM_ROWS = 15,
+  PS_CREATE_GRAPH = 16,
+  PS_GRAPH_ADD_EDGES = 17,
+  PS_GRAPH_SET_FEAT = 18,
+  PS_GRAPH_SAMPLE = 19,
+  PS_GRAPH_RANDOM_NODES = 20,
+  PS_GRAPH_GET_FEAT = 21,
+  PS_GRAPH_DEGREE = 22,
 };
 
 static bool read_full(int fd, void* buf, size_t n) {
@@ -441,6 +510,7 @@ struct PsServer {
   std::map<int, SparseTable> sparse;
   std::map<int, DenseTable> dense;
   std::map<int, CtrTable> ctr;
+  std::map<int, GraphTable> graph;
   std::mutex tables_mu;
 
   SparseTable* sparse_tab(int tid) {
@@ -457,6 +527,11 @@ struct PsServer {
     std::lock_guard<std::mutex> l(tables_mu);
     auto it = ctr.find(tid);
     return it == ctr.end() ? nullptr : &it->second;
+  }
+  GraphTable* graph_tab(int tid) {
+    std::lock_guard<std::mutex> l(tables_mu);
+    auto it = graph.find(tid);
+    return it == graph.end() ? nullptr : &it->second;
   }
 
   void serve(int cfd) {
@@ -747,6 +822,150 @@ struct PsServer {
           }
           write_full(cfd, &status, 4);
           if (status == 0) write_full(cfd, out.data(), out.size() * 4);
+          break;
+        }
+        case PS_CREATE_GRAPH: {
+          uint32_t meta[2];  // feat_dim, seed
+          if (!read_full(cfd, meta, sizeof(meta))) return;
+          GraphTable* t;
+          {
+            std::lock_guard<std::mutex> l(tables_mu);
+            t = &graph[tid];
+          }
+          std::lock_guard<std::mutex> lt(t->mu);
+          t->adj.clear();
+          t->feats.clear();
+          t->nodes.clear();
+          t->node_seen.clear();
+          t->feat_dim = meta[0];
+          t->rng.seed(meta[1]);
+          write_full(cfd, &status, 4);
+          break;
+        }
+        case PS_GRAPH_ADD_EDGES: {
+          std::vector<int64_t> src(n), dst(n);
+          if (!read_full(cfd, src.data(), n * 8) ||
+              !read_full(cfd, dst.data(), n * 8))
+            return;
+          GraphTable* t = graph_tab(tid);
+          if (!t) {
+            status = -1;
+          } else {
+            std::lock_guard<std::mutex> l(t->mu);
+            t->add_edges(src.data(), dst.data(), n);
+          }
+          write_full(cfd, &status, 4);
+          break;
+        }
+        case PS_GRAPH_SET_FEAT: {
+          uint32_t dim;
+          if (!read_full(cfd, &dim, 4)) return;
+          std::vector<int64_t> ids(n);
+          std::vector<float> f(size_t(n) * dim);
+          if (!read_full(cfd, ids.data(), n * 8) ||
+              !read_full(cfd, f.data(), f.size() * 4))
+            return;
+          GraphTable* t = graph_tab(tid);
+          if (!t) {
+            status = -1;
+          } else {
+            std::lock_guard<std::mutex> l(t->mu);
+            if (static_cast<uint32_t>(t->feat_dim) != dim) {
+              status = -4;
+            } else {
+              for (uint32_t i = 0; i < n; ++i) {
+                t->feats[ids[i]].assign(f.begin() + size_t(i) * dim,
+                                        f.begin() + size_t(i + 1) * dim);
+                t->touch_node(ids[i]);
+              }
+            }
+          }
+          write_full(cfd, &status, 4);
+          break;
+        }
+        case PS_GRAPH_SAMPLE: {
+          uint32_t k;
+          if (!read_full(cfd, &k, 4)) return;
+          std::vector<int64_t> ids(n);
+          if (!read_full(cfd, ids.data(), n * 8)) return;
+          GraphTable* t = graph_tab(tid);
+          std::vector<int64_t> out(size_t(n) * k, -1);
+          if (!t) {
+            status = -1;
+          } else {
+            std::lock_guard<std::mutex> l(t->mu);
+            t->sample_neighbors(ids.data(), n, k, out.data());
+          }
+          write_full(cfd, &status, 4);
+          if (status == 0) write_full(cfd, out.data(), out.size() * 8);
+          break;
+        }
+        case PS_GRAPH_RANDOM_NODES: {
+          // n = requested count; sampled uniformly WITH replacement from
+          // the node set (reference random_sample_nodes role)
+          GraphTable* t = graph_tab(tid);
+          std::vector<int64_t> out(n, -1);
+          if (!t) {
+            status = -1;
+          } else {
+            std::lock_guard<std::mutex> l(t->mu);
+            if (t->nodes.empty()) {
+              status = -3;
+            } else {
+              std::uniform_int_distribution<size_t> d(
+                  0, t->nodes.size() - 1);
+              for (uint32_t i = 0; i < n; ++i)
+                out[i] = t->nodes[d(t->rng)];
+            }
+          }
+          write_full(cfd, &status, 4);
+          if (status == 0) write_full(cfd, out.data(), out.size() * 8);
+          break;
+        }
+        case PS_GRAPH_GET_FEAT: {
+          uint32_t dim;
+          std::vector<int64_t> ids(n);
+          if (!read_full(cfd, &dim, 4) ||
+              !read_full(cfd, ids.data(), n * 8))
+            return;
+          GraphTable* t = graph_tab(tid);
+          std::vector<float> out(size_t(n) * dim, 0.0f);
+          if (!t) {
+            status = -1;
+          } else {
+            std::lock_guard<std::mutex> l(t->mu);
+            if (static_cast<uint32_t>(t->feat_dim) != dim) {
+              status = -4;
+            } else {
+              for (uint32_t i = 0; i < n; ++i) {
+                auto it = t->feats.find(ids[i]);
+                if (it != t->feats.end())
+                  std::copy(it->second.begin(), it->second.end(),
+                            out.begin() + size_t(i) * dim);
+              }
+            }
+          }
+          write_full(cfd, &status, 4);
+          if (status == 0) write_full(cfd, out.data(), out.size() * 4);
+          break;
+        }
+        case PS_GRAPH_DEGREE: {
+          std::vector<int64_t> ids(n);
+          if (!read_full(cfd, ids.data(), n * 8)) return;
+          GraphTable* t = graph_tab(tid);
+          std::vector<int64_t> out(n, 0);
+          if (!t) {
+            status = -1;
+          } else {
+            std::lock_guard<std::mutex> l(t->mu);
+            for (uint32_t i = 0; i < n; ++i) {
+              auto it = t->adj.find(ids[i]);
+              out[i] = it == t->adj.end() ? 0
+                                          : int64_t(it->second.size());
+            }
+          }
+          write_full(cfd, &status, 4);
+          if (status == 0) write_full(cfd, out.data(), out.size() * 8);
           break;
         }
         case PS_CTR_SHRINK: {
@@ -1131,6 +1350,75 @@ int pt_ps_pull_ctr(int fd, int tid, const long long* ids, int n, int dim,
   int status = ps_read_status(fd);
   if (status != 0) return status;
   if (!read_full(fd, out, size_t(n) * (3 + dim) * 4)) return -1;
+  return 0;
+}
+
+int pt_ps_create_graph(int fd, int tid, int feat_dim, unsigned seed) {
+  if (ps_req_header(fd, PS_CREATE_GRAPH, tid, 0) != 0) return -1;
+  uint32_t meta[2] = {static_cast<uint32_t>(feat_dim), seed};
+  if (!write_full(fd, meta, sizeof(meta))) return -1;
+  return ps_read_status(fd);
+}
+
+int pt_ps_graph_add_edges(int fd, int tid, const long long* src,
+                          const long long* dst, int n) {
+  if (ps_req_header(fd, PS_GRAPH_ADD_EDGES, tid, n) != 0) return -1;
+  if (!write_full(fd, src, size_t(n) * 8) ||
+      !write_full(fd, dst, size_t(n) * 8))
+    return -1;
+  return ps_read_status(fd);
+}
+
+int pt_ps_graph_set_feat(int fd, int tid, const long long* ids, int n,
+                         int dim, const float* feats) {
+  if (ps_req_header(fd, PS_GRAPH_SET_FEAT, tid, n) != 0) return -1;
+  uint32_t d = static_cast<uint32_t>(dim);
+  if (!write_full(fd, &d, 4) || !write_full(fd, ids, size_t(n) * 8) ||
+      !write_full(fd, feats, size_t(n) * dim * 4))
+    return -1;
+  return ps_read_status(fd);
+}
+
+int pt_ps_graph_sample(int fd, int tid, const long long* ids, int n,
+                       int k, long long* out) {
+  if (ps_req_header(fd, PS_GRAPH_SAMPLE, tid, n) != 0) return -1;
+  uint32_t kk = static_cast<uint32_t>(k);
+  if (!write_full(fd, &kk, 4) || !write_full(fd, ids, size_t(n) * 8))
+    return -1;
+  int status = ps_read_status(fd);
+  if (status != 0) return status;
+  if (!read_full(fd, out, size_t(n) * k * 8)) return -1;
+  return 0;
+}
+
+int pt_ps_graph_random_nodes(int fd, int tid, int count, long long* out) {
+  if (ps_req_header(fd, PS_GRAPH_RANDOM_NODES, tid, count) != 0)
+    return -1;
+  int status = ps_read_status(fd);
+  if (status != 0) return status;
+  if (!read_full(fd, out, size_t(count) * 8)) return -1;
+  return 0;
+}
+
+int pt_ps_graph_get_feat(int fd, int tid, const long long* ids, int n,
+                         int dim, float* out) {
+  if (ps_req_header(fd, PS_GRAPH_GET_FEAT, tid, n) != 0) return -1;
+  uint32_t d = static_cast<uint32_t>(dim);
+  if (!write_full(fd, &d, 4) || !write_full(fd, ids, size_t(n) * 8))
+    return -1;
+  int status = ps_read_status(fd);
+  if (status != 0) return status;
+  if (!read_full(fd, out, size_t(n) * dim * 4)) return -1;
+  return 0;
+}
+
+int pt_ps_graph_degree(int fd, int tid, const long long* ids, int n,
+                       long long* out) {
+  if (ps_req_header(fd, PS_GRAPH_DEGREE, tid, n) != 0) return -1;
+  if (!write_full(fd, ids, size_t(n) * 8)) return -1;
+  int status = ps_read_status(fd);
+  if (status != 0) return status;
+  if (!read_full(fd, out, size_t(n) * 8)) return -1;
   return 0;
 }
 

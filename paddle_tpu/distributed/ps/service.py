@@ -84,6 +84,27 @@ def _lib():
             c.c_int, c.c_int, c.c_void_p, c.c_int, c.c_int, c.c_void_p]
         lib.pt_ps_ctr_shrink.restype = c.c_longlong
         lib.pt_ps_ctr_shrink.argtypes = [c.c_int, c.c_int]
+        lib.pt_ps_create_graph.restype = c.c_int
+        lib.pt_ps_create_graph.argtypes = [c.c_int, c.c_int, c.c_int,
+                                           c.c_uint]
+        lib.pt_ps_graph_add_edges.restype = c.c_int
+        lib.pt_ps_graph_add_edges.argtypes = [
+            c.c_int, c.c_int, c.c_void_p, c.c_void_p, c.c_int]
+        lib.pt_ps_graph_set_feat.restype = c.c_int
+        lib.pt_ps_graph_set_feat.argtypes = [
+            c.c_int, c.c_int, c.c_void_p, c.c_int, c.c_int, c.c_void_p]
+        lib.pt_ps_graph_sample.restype = c.c_int
+        lib.pt_ps_graph_sample.argtypes = [
+            c.c_int, c.c_int, c.c_void_p, c.c_int, c.c_int, c.c_void_p]
+        lib.pt_ps_graph_random_nodes.restype = c.c_int
+        lib.pt_ps_graph_random_nodes.argtypes = [
+            c.c_int, c.c_int, c.c_int, c.c_void_p]
+        lib.pt_ps_graph_get_feat.restype = c.c_int
+        lib.pt_ps_graph_get_feat.argtypes = [
+            c.c_int, c.c_int, c.c_void_p, c.c_int, c.c_int, c.c_void_p]
+        lib.pt_ps_graph_degree.restype = c.c_int
+        lib.pt_ps_graph_degree.argtypes = [
+            c.c_int, c.c_int, c.c_void_p, c.c_int, c.c_void_p]
         lib.pt_comm_create.restype = c.c_int
         lib.pt_comm_create.argtypes = [c.c_char_p, c.c_int, c.c_int,
                                        c.c_int, c.c_int, c.c_int]
@@ -138,7 +159,9 @@ class PsClient:
         if self._fd < 0:
             raise RuntimeError("PsClient: cannot connect %s:%d"
                                % (host, port))
-        self._dims = {}
+        self._dims = {}        # sparse-table dims
+        self._ctr_dims = {}    # ctr tables live in their own server map
+        self._graph_dims = {}  # graph tables likewise
 
     def close(self):
         if self._fd is not None and self._fd >= 0:
@@ -239,13 +262,13 @@ class PsClient:
             delete_threshold, delete_after_unseen_days, initial_g2sum)
         if rc != 0:
             raise_native(rc, "create_ctr_table")
-        self._dims[table_id] = dim
+        self._ctr_dims[table_id] = dim
 
     def push_ctr(self, table_id, ids, shows, clicks, embed_g, embedx_g,
                  slots=None, dim=None):
         """Push per-feature [slot, show, click, embed_g, embedx_g[dim]]."""
         ids = np.ascontiguousarray(np.asarray(ids, np.int64).reshape(-1))
-        dim = dim or self._dims[table_id]
+        dim = dim or self._ctr_dims[table_id]
         n = ids.size
         pv = np.empty((n, 4 + dim), np.float32)
         pv[:, 0] = np.asarray(slots if slots is not None
@@ -263,7 +286,7 @@ class PsClient:
     def pull_ctr(self, table_id, ids, dim=None):
         """-> (shows, clicks, embed_w, embedx_w[n, dim])."""
         ids = np.ascontiguousarray(np.asarray(ids, np.int64).reshape(-1))
-        dim = dim or self._dims[table_id]
+        dim = dim or self._ctr_dims[table_id]
         out = np.empty((ids.size, 3 + dim), np.float32)
         rc = self._lib.pt_ps_pull_ctr(self._fd, table_id, ids.ctypes.data,
                                       ids.size, dim, out.ctypes.data)
@@ -278,6 +301,79 @@ class PsClient:
         if rc < 0:
             raise_native(rc, "ctr_shrink")
         return int(rc)
+
+    # -- graph table (reference ps/table/common_graph_table.h) -------------
+
+    def create_graph_table(self, table_id, feat_dim, seed=0):
+        """Server-side graph for GNN training: adjacency + node features
+        with server-side neighbor sampling — workers pull fixed-shape
+        [n, k] batches (the device never sees ragged structure)."""
+        rc = self._lib.pt_ps_create_graph(self._fd, table_id, feat_dim,
+                                          seed)
+        if rc != 0:
+            raise_native(rc, "create_graph_table")
+        self._graph_dims[table_id] = feat_dim
+
+    def graph_add_edges(self, table_id, src, dst):
+        src = np.ascontiguousarray(np.asarray(src, np.int64).reshape(-1))
+        dst = np.ascontiguousarray(np.asarray(dst, np.int64).reshape(-1))
+        if src.size != dst.size:
+            raise ValueError("src/dst length mismatch")
+        rc = self._lib.pt_ps_graph_add_edges(
+            self._fd, table_id, src.ctypes.data, dst.ctypes.data, src.size)
+        if rc != 0:
+            raise_native(rc, "graph_add_edges")
+
+    def graph_set_node_feat(self, table_id, ids, feats, dim=None):
+        ids = np.ascontiguousarray(np.asarray(ids, np.int64).reshape(-1))
+        dim = dim or self._graph_dims[table_id]
+        feats = np.ascontiguousarray(
+            np.asarray(feats, np.float32).reshape(ids.size, dim))
+        rc = self._lib.pt_ps_graph_set_feat(
+            self._fd, table_id, ids.ctypes.data, ids.size, dim,
+            feats.ctypes.data)
+        if rc != 0:
+            raise_native(rc, "graph_set_node_feat")
+
+    def graph_sample_neighbors(self, table_id, ids, sample_size):
+        """-> int64 [n, sample_size], -1-padded past each node's degree
+        (sampling is without replacement server-side)."""
+        ids = np.ascontiguousarray(np.asarray(ids, np.int64).reshape(-1))
+        out = np.empty((ids.size, sample_size), np.int64)
+        rc = self._lib.pt_ps_graph_sample(
+            self._fd, table_id, ids.ctypes.data, ids.size, sample_size,
+            out.ctypes.data)
+        if rc != 0:
+            raise_native(rc, "graph_sample_neighbors")
+        return out
+
+    def graph_random_nodes(self, table_id, count):
+        out = np.empty(count, np.int64)
+        rc = self._lib.pt_ps_graph_random_nodes(self._fd, table_id, count,
+                                                out.ctypes.data)
+        if rc != 0:
+            raise_native(rc, "graph_random_nodes")
+        return out
+
+    def graph_get_node_feat(self, table_id, ids, dim=None):
+        ids = np.ascontiguousarray(np.asarray(ids, np.int64).reshape(-1))
+        dim = dim or self._graph_dims[table_id]
+        out = np.empty((ids.size, dim), np.float32)
+        rc = self._lib.pt_ps_graph_get_feat(
+            self._fd, table_id, ids.ctypes.data, ids.size, dim,
+            out.ctypes.data)
+        if rc != 0:
+            raise_native(rc, "graph_get_node_feat")
+        return out
+
+    def graph_node_degree(self, table_id, ids):
+        ids = np.ascontiguousarray(np.asarray(ids, np.int64).reshape(-1))
+        out = np.empty(ids.size, np.int64)
+        rc = self._lib.pt_ps_graph_degree(
+            self._fd, table_id, ids.ctypes.data, ids.size, out.ctypes.data)
+        if rc != 0:
+            raise_native(rc, "graph_node_degree")
+        return out
 
     # -- misc --------------------------------------------------------------
 

@@ -334,6 +334,67 @@ class TestCommunicator:
                 comm.stop()
 
 
+class TestGraphTable:
+    """Reference common_graph_table.h: server-side graph + sampling."""
+
+    def _build(self, cli):
+        cli.create_graph_table(0, feat_dim=4, seed=0)
+        # star: 0 -> 1..5; chain: 1 -> 2
+        cli.graph_add_edges(0, [0] * 5 + [1], [1, 2, 3, 4, 5, 2])
+        ids = np.arange(6)
+        cli.graph_set_node_feat(0, ids,
+                                np.eye(6, 4, dtype=np.float32) + 1.0)
+
+    def test_sample_neighbors_within_adjacency(self, server):
+        with PsClient(port=server.port) as cli:
+            self._build(cli)
+            nb = cli.graph_sample_neighbors(0, [0, 1, 5], 3)
+            assert nb.shape == (3, 3)
+            assert set(nb[0]) <= {1, 2, 3, 4, 5}      # sampled from 0's
+            assert len(set(nb[0])) == 3               # w/o replacement
+            assert list(nb[1]) == [2, -1, -1]         # degree 1, padded
+            assert list(nb[2]) == [-1, -1, -1]        # no out-edges
+
+    def test_degree_and_features_roundtrip(self, server):
+        with PsClient(port=server.port) as cli:
+            self._build(cli)
+            np.testing.assert_array_equal(
+                cli.graph_node_degree(0, [0, 1, 5]), [5, 1, 0])
+            f = cli.graph_get_node_feat(0, [2, 0])
+            np.testing.assert_allclose(
+                f, (np.eye(6, 4, dtype=np.float32) + 1.0)[[2, 0]])
+            # unknown node -> zero features (create-on-miss is wrong for
+            # graphs; absence must be visible)
+            np.testing.assert_allclose(
+                cli.graph_get_node_feat(0, [99]), 0.0)
+
+    def test_random_nodes_cover_node_set(self, server):
+        with PsClient(port=server.port) as cli:
+            self._build(cli)
+            ids = cli.graph_random_nodes(0, 64)
+            assert set(ids) <= set(range(6))
+            assert len(set(ids)) > 1  # actually random, not constant
+
+    def test_graphsage_style_aggregation_step(self, server):
+        """e2e: sample -> gather feats -> mean-aggregate on device (the
+        GNN mini-batch pattern the reference serves via pscore ops)."""
+        import jax.numpy as jnp
+
+        with PsClient(port=server.port) as cli:
+            self._build(cli)
+            batch = cli.graph_random_nodes(0, 8)
+            nb = cli.graph_sample_neighbors(0, batch, 4)
+            valid = nb >= 0
+            feats = cli.graph_get_node_feat(
+                0, np.where(valid, nb, 0).reshape(-1)).reshape(8, 4, 4)
+            self_f = cli.graph_get_node_feat(0, batch)
+            mask = jnp.asarray(valid, jnp.float32)[..., None]
+            agg = (jnp.asarray(feats) * mask).sum(1) / jnp.maximum(
+                mask.sum(1), 1.0)
+            h = jnp.concatenate([jnp.asarray(self_f), agg], axis=-1)
+            assert h.shape == (8, 8) and bool(jnp.isfinite(h).all())
+
+
 class TestRuntimeFacade:
     def test_remote_runtime(self):
         rt = TheOnePSRuntime()
