@@ -7,11 +7,13 @@ source/sink/amplifier interceptors, brpc MessageBus,
 interceptor_message.proto; DistModel for distributed inference).
 
 TPU-native shape: the actor graph stays — it is the host-side
-orchestration for static pipeline/dist-inference — but the message bus
-is in-process queues between interceptor threads (single-controller
-SPMD replaces cross-rank brpc; a multi-host deployment would ride the
-StoreProcessGroup p2p channel). Compute payloads are arbitrary
-callables, normally compiled XLA steps.
+orchestration for static pipeline/dist-inference. In-process
+destinations are thread mailboxes; across OS processes the MessageBus
+(ordered per-rank queues on the native TCP store, csrc/store.cc) plays
+the reference's brpc bus: each rank hosts only its TaskNodes, edges may
+point at task ids on other ranks, and sink completion releases every
+rank (tests/fexec_worker.py + test_trainer_fleet_executor.py). Compute
+payloads are arbitrary callables, normally compiled XLA steps.
 """
 from __future__ import annotations
 
@@ -166,7 +168,7 @@ class SinkInterceptor(Interceptor):
             self.send(src, InterceptorMessage.DATA_IS_USELESS,
                       scope_idx=msg.scope_idx)
         if len(self.results) >= self.node.max_run_times:
-            self.carrier.done.set()
+            self.carrier._signal_done()
 
 
 _INTERCEPTORS = {
@@ -176,31 +178,183 @@ _INTERCEPTORS = {
 }
 
 
+class MessageBus:
+    """Cross-rank interceptor transport (reference fleet_executor's brpc
+    MessageBus + interceptor_message.proto serialization): interceptor
+    ids map to ranks via a shared registry on the native TCP store; a
+    message whose destination lives on another rank is pickled onto that
+    rank's ordered store queue, drained by a receiver thread that
+    re-routes into the local Carrier. Payloads must be picklable (the
+    reference constraint is protobuf-serializable — same idea)."""
+
+    def __init__(self, store, rank, prefix="fexec"):
+        self.store = store
+        self.rank = rank
+        self.prefix = prefix
+        self._carrier = None
+        self._recv = None
+        self._stop = threading.Event()
+        # ONE store connection serves sender threads (interceptors) and
+        # the receiver poll loop: every wire interaction must serialize
+        # or the request frames interleave and both sides hang
+        self._lock = threading.Lock()
+        self._rank_cache = {}
+
+    # -- registry ----------------------------------------------------------
+
+    def register_tasks(self, task_ids):
+        with self._lock:
+            for tid in task_ids:
+                self.store.set("%s/rank_of/%d" % (self.prefix, tid),
+                               str(self.rank).encode())
+                self._rank_cache[tid] = self.rank
+
+    def rank_of(self, task_id, timeout_s=30):
+        if task_id in self._rank_cache:
+            return self._rank_cache[task_id]
+        import time as _time
+
+        deadline = _time.monotonic() + timeout_s
+        while _time.monotonic() < deadline:
+            with self._lock:
+                v = self.store.get("%s/rank_of/%d" % (self.prefix,
+                                                      task_id), 0.2)
+            if v is not None:
+                self._rank_cache[task_id] = int(v)
+                return int(v)
+        raise TimeoutError(
+            "MessageBus: task %d never registered" % task_id)
+
+    def done_count(self):
+        with self._lock:
+            return self.store.counter_get("%s/done" % self.prefix) or 0
+
+    def signal_done(self):
+        with self._lock:
+            self.store.add("%s/done" % self.prefix, 1)
+
+    # -- transport ---------------------------------------------------------
+
+    def post(self, msg):
+        import pickle
+
+        dst_rank = self.rank_of(msg.dst_id)
+        data = pickle.dumps((msg.src_id, msg.dst_id, msg.msg_type,
+                             msg.payload, msg.scope_idx))
+        with self._lock:
+            seq = self.store.add("%s/seq/%d" % (self.prefix, dst_rank), 1)
+            self.store.set("%s/q/%d/%d" % (self.prefix, dst_rank, seq),
+                           data)
+
+    def attach(self, carrier):
+        """Start draining this rank's queue into the carrier. A bus is
+        SINGLE-RUN (like the reference bus, whose carrier wave owns it):
+        seq/done counters live in the store under this prefix, so reuse
+        would replay run-1 state — construct a fresh MessageBus (new
+        prefix) per pipeline run."""
+        import pickle
+
+        if self._recv is not None or self._stop.is_set():
+            raise RuntimeError(
+                "MessageBus is single-run: construct a new bus with a "
+                "fresh prefix for another pipeline run")
+        self._carrier = carrier
+
+        def drain():
+            nxt = 1
+            while not self._stop.is_set():
+                key = "%s/q/%d/%d" % (self.prefix, self.rank, nxt)
+                with self._lock:
+                    data = self.store.get(key, timeout_s=0.05)
+                if data is None:
+                    self._stop.wait(0.01)  # let senders take the lock
+                    continue
+                with self._lock:
+                    self.store.delete(key)
+                src, dst, typ, payload, scope = pickle.loads(data)
+                self._carrier.route_local(
+                    InterceptorMessage(src, dst, typ, payload, scope))
+                nxt += 1
+
+        self._recv = threading.Thread(target=drain, daemon=True)
+        self._recv.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._recv is not None:
+            self._recv.join(timeout=5)
+
+
 class Carrier:
     """Hosts this rank's interceptors + routes messages (reference
-    carrier.cc; the in-process queue dict plays the brpc MessageBus)."""
+    carrier.cc). In-process destinations go straight to the mailbox; with
+    a MessageBus attached, remote destinations ride the store queue —
+    the brpc-bus role for multi-process pipelines."""
 
-    def __init__(self, nodes):
+    def __init__(self, nodes, bus=None):
         self.done = threading.Event()
+        self.bus = bus
         self.interceptors = {
             n.task_id: _INTERCEPTORS[n.node_type](n, self) for n in nodes}
+        if bus is not None:
+            bus.register_tasks(list(self.interceptors))
+            bus.attach(self)
 
-    def route(self, msg):
+    def route_local(self, msg):
         dst = self.interceptors.get(msg.dst_id)
         if dst is not None:
             dst.mailbox.put(msg)
+
+    def route(self, msg):
+        if msg.dst_id in self.interceptors:
+            self.route_local(msg)
+        elif self.bus is not None:
+            self.bus.post(msg)
+        # else: unknown destination in single-process mode — drop, as the
+        # reference carrier CHECKs (here the sink timeout surfaces it)
 
     def start(self):
         for it in self.interceptors.values():
             it.start()
         return self
 
+    def _signal_done(self):
+        """Sink completion: release every rank's wait() via the store."""
+        self.done.set()
+        if self.bus is not None:
+            self.bus.signal_done()
+
     def wait(self, timeout=None):
-        ok = self.done.wait(timeout)
-        for it in self.interceptors.values():
-            it._stopped = True
-            it.mailbox.put(InterceptorMessage(
-                -1, it.node.task_id, InterceptorMessage.STOP))
+        import time as _time
+
+        try:
+            if self.bus is None:
+                ok = self.done.wait(timeout)
+            else:  # non-sink ranks learn completion from the store
+                deadline = None if timeout is None else \
+                    _time.monotonic() + timeout
+                ok = False
+                while not ok:
+                    ok = self.done.wait(0.2)
+                    if not ok:
+                        try:
+                            ok = self.bus.done_count() > 0
+                        except Exception:
+                            # master store went away: the owning rank has
+                            # finished and torn it down — the run is over
+                            ok = True
+                    if not ok and deadline is not None and \
+                            _time.monotonic() > deadline:
+                        break
+        finally:
+            # STOP delivery + bus teardown must happen on EVERY exit
+            # path or interceptor threads leak past run()
+            for it in self.interceptors.values():
+                it._stopped = True
+                it.mailbox.put(InterceptorMessage(
+                    -1, it.node.task_id, InterceptorMessage.STOP))
+            if self.bus is not None:
+                self.bus.stop()
         return ok
 
     def results(self):
@@ -212,13 +366,16 @@ class Carrier:
 
 class FleetExecutor:
     """reference fleet_executor.cc: build the task graph for a rank,
-    host it on a Carrier, run n microbatches."""
+    host it on a Carrier, run n microbatches. Pass `bus` (a MessageBus)
+    to span ranks: each process constructs only ITS tasks; edges may
+    reference task ids hosted by other ranks."""
 
-    def __init__(self, nodes=None):
+    def __init__(self, nodes=None, bus=None):
         self.nodes = list(nodes or [])
+        self.bus = bus
 
     def run(self, timeout=60):
-        carrier = Carrier(self.nodes).start()
+        carrier = Carrier(self.nodes, bus=self.bus).start()
         if not carrier.wait(timeout):
             raise TimeoutError("FleetExecutor pipeline did not finish")
         return carrier.results()
