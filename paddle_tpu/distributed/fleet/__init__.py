@@ -10,6 +10,7 @@ from .base import (  # noqa: F401
 from .fleet import Fleet, fleet  # noqa: F401
 from . import utils  # noqa: F401
 from .recompute import recompute  # noqa: F401
+from . import metrics  # noqa: F401
 
 init = fleet.init
 is_first_worker = fleet.is_first_worker

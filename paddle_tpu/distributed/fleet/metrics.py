@@ -1,0 +1,87 @@
+"""Distributed training metrics (reference
+python/paddle/distributed/fleet/metrics/metric.py over the C++
+framework/fleet/metrics.cc): per-trainer partial statistics are summed /
+maxed / minned across the world, then the metric closes over the global
+totals. Reduction rides the world StoreProcessGroup when
+init_parallel_env created one (multi-process), and is the identity for a
+single process — per-device partials inside one process are already
+global under SPMD.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["sum", "max", "min", "auc", "mae", "rmse", "mse", "acc"]
+
+
+def _np(x):
+    from ...core.tensor import Tensor
+
+    if isinstance(x, Tensor):
+        return np.asarray(x.numpy(), np.float64)
+    return np.asarray(x, np.float64)
+
+
+def _world_reduce(arr, op):
+    from ..process_group import get_world_group
+
+    pg = get_world_group()
+    if pg is None or pg.world_size <= 1:
+        return arr
+    return np.asarray(pg.allreduce(arr, op=op), np.float64)
+
+
+def sum(input, scope=None, util=None):  # noqa: A001 (reference name)
+    """Global elementwise sum of a per-trainer statistic."""
+    return _world_reduce(_np(input), "sum")
+
+
+def max(input, scope=None, util=None):  # noqa: A001
+    return _world_reduce(_np(input), "max")
+
+
+def min(input, scope=None, util=None):  # noqa: A001
+    return _world_reduce(_np(input), "min")
+
+
+def auc(stat_pos, stat_neg, scope=None, util=None):
+    """Global AUC from per-trainer threshold-bin counts (the outputs of
+    metric.Auc / static.auc): bins are summed across trainers, then one
+    trapezoid sweep over the global histogram."""
+    pos = _world_reduce(_np(stat_pos).reshape(-1), "sum")
+    neg = _world_reduce(_np(stat_neg).reshape(-1), "sum")
+    # trapezoid sweep from the most-confident bucket down: each bucket
+    # contributes d(FP)=n at TP height between tot_pos and tot_pos+p
+    tot_pos = 0.0
+    tot_neg = 0.0
+    area = 0.0
+    for p, n in zip(pos[::-1], neg[::-1]):
+        area += n * tot_pos + p * n / 2.0
+        tot_pos += p
+        tot_neg += n
+    if tot_pos == 0 or tot_neg == 0:
+        return 0.5
+    return float(area / (tot_pos * tot_neg))
+
+
+def mae(abserr, total_ins_num, scope=None, util=None):
+    """Global mean absolute error from per-trainer (sum|abs err|, n)."""
+    err = float(_world_reduce(_np(abserr).reshape(-1), "sum").sum())
+    n = float(_world_reduce(_np(total_ins_num).reshape(-1), "sum").sum())
+    return err / n if n else 0.0
+
+
+def mse(sqrerr, total_ins_num, scope=None, util=None):
+    err = float(_world_reduce(_np(sqrerr).reshape(-1), "sum").sum())
+    n = float(_world_reduce(_np(total_ins_num).reshape(-1), "sum").sum())
+    return err / n if n else 0.0
+
+
+def rmse(sqrerr, total_ins_num, scope=None, util=None):
+    return float(np.sqrt(mse(sqrerr, total_ins_num)))
+
+
+def acc(correct, total, scope=None, util=None):
+    c = float(_world_reduce(_np(correct).reshape(-1), "sum").sum())
+    t = float(_world_reduce(_np(total).reshape(-1), "sum").sum())
+    return c / t if t else 0.0

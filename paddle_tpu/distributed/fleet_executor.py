@@ -258,6 +258,14 @@ class MessageBus:
             raise RuntimeError(
                 "MessageBus is single-run: construct a new bus with a "
                 "fresh prefix for another pipeline run")
+        with self._lock:
+            stale = self.store.counter_get("%s/done" % self.prefix) or 0
+        if stale > 0:
+            # a previous run's counters live under this prefix: every
+            # rank's wait() would return before any microbatch ran
+            raise RuntimeError(
+                "MessageBus prefix %r carries a finished run's state; "
+                "use a fresh prefix per pipeline run" % self.prefix)
         self._carrier = carrier
 
         def drain():
@@ -339,9 +347,19 @@ class Carrier:
                     if not ok:
                         try:
                             ok = self.bus.done_count() > 0
-                        except Exception:
-                            # master store went away: the owning rank has
-                            # finished and torn it down — the run is over
+                        except (RuntimeError, OSError, ConnectionError):
+                            # master store went away. The normal cause is
+                            # the owning rank finishing and tearing it
+                            # down AFTER the sink's done landed; a crash
+                            # is indistinguishable on this transport, so
+                            # finish best-effort but say so.
+                            import warnings
+
+                            warnings.warn(
+                                "FleetExecutor: store unreachable while "
+                                "waiting for pipeline completion — "
+                                "treating as finished (results may be "
+                                "partial if a peer crashed)")
                             ok = True
                     if not ok and deadline is not None and \
                             _time.monotonic() > deadline:
