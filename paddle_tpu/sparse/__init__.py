@@ -23,6 +23,9 @@ __all__ = [
     "sparse_csr_tensor", "is_sparse_coo", "is_sparse_csr",
     "add", "subtract", "multiply", "divide", "matmul", "masked_matmul",
     "relu", "tanh", "sqrt", "sin", "pow", "neg", "abs", "coalesce",
+    "asin", "asinh", "atan", "atanh", "sinh", "tan", "ceil", "floor",
+    "expm1", "log1p", "square", "sign", "deg2rad", "rad2deg", "relu6",
+    "leaky_relu", "cast", "reshape", "transpose",
 ]
 
 
@@ -280,10 +283,99 @@ sqrt = _unary(jnp.sqrt)
 sin = _unary(jnp.sin)
 neg = _unary(jnp.negative)
 abs = _unary(jnp.abs)  # noqa: A001
+# zero-preserving unary family (reference phi/kernels/sparse/unary_kernel.h
+# — each only touches stored values, implicit zeros stay zero)
+asin = _unary(jnp.arcsin)
+asinh = _unary(jnp.arcsinh)
+atan = _unary(jnp.arctan)
+atanh = _unary(jnp.arctanh)
+sinh = _unary(jnp.sinh)
+tan = _unary(jnp.tan)
+ceil = _unary(jnp.ceil)
+floor = _unary(jnp.floor)
+expm1 = _unary(jnp.expm1)
+log1p = _unary(jnp.log1p)
+square = _unary(jnp.square)
+sign = _unary(jnp.sign)
+deg2rad = _unary(jnp.deg2rad)
+rad2deg = _unary(jnp.rad2deg)
+relu6 = _unary(lambda d: jnp.clip(d, 0, 6))
+
+
+def leaky_relu(x, negative_slope=0.01):
+    return _unary(lambda d: jnp.where(d >= 0, d, negative_slope * d))(x)
 
 
 def pow(x, factor):  # noqa: A001
     return _unary(lambda d: jnp.power(d, factor))(x)
+
+
+def cast(x, index_dtype=None, value_dtype=None):
+    """reference sparse cast kernel: cast stored values and/or indices.
+    Dtype specs resolve through the framework table (core.dtype), same
+    as dense cast."""
+    from ..core import dtype as _dt
+
+    b = _as_bcoo(x)
+    data = b.data if value_dtype is None \
+        else b.data.astype(_dt.to_jax(value_dtype))
+    idx = b.indices if index_dtype is None \
+        else b.indices.astype(_dt.to_jax(index_dtype))
+    return _rewrap(x, jsparse.BCOO((data, idx), shape=b.shape))
+
+
+def reshape(x, shape):
+    """reference sparse reshape kernel: recompute COO indices for the
+    new shape via flat positions (pattern preserved, values untouched)."""
+    b = _as_bcoo(x).sum_duplicates()
+    old = np.array(b.shape)
+    new = list(shape)
+    neg = [i for i, s in enumerate(new) if s == -1]
+    if len(neg) > 1:
+        raise ValueError("reshape: at most one -1 allowed, got %s"
+                         % (tuple(shape),))
+    if neg:
+        known = int(np.prod([s for s in new if s != -1]))
+        if known <= 0:
+            raise ValueError(
+                "reshape: cannot infer -1 alongside zero-size dims in %s"
+                % (tuple(shape),))
+        new[neg[0]] = int(old.prod() // known)
+    if int(np.prod(new)) != int(old.prod()):
+        raise ValueError("reshape: %s -> %s changes element count"
+                         % (tuple(b.shape), tuple(new)))
+    if isinstance(x, SparseCsrTensor) and len(new) != 2:
+        raise ValueError(
+            "reshape: CSR output must be 2-D (got rank %d); convert "
+            "with .to_sparse_coo() first" % len(new))
+    # int32 flat positions: x64 is disabled (TPU-native); fine while
+    # numel < 2^31, which COO index math already assumes
+    strides = jnp.asarray(
+        np.concatenate([np.cumprod(old[::-1])[::-1][1:], [1]]), jnp.int32)
+    flat = (b.indices.astype(jnp.int32) * strides[None, :]).sum(-1)
+    new_strides = np.concatenate(
+        [np.cumprod(np.array(new)[::-1])[::-1][1:], [1]]).astype(np.int32)
+    cols = []
+    rem = flat
+    for s, dim in zip(new_strides, new):
+        cols.append((rem // s).astype(b.indices.dtype))
+        rem = rem % s
+    idx = jnp.stack(cols, -1)
+    # same-format out (a CSR input stays CSR, like cast/transpose)
+    return _rewrap(x, jsparse.BCOO((b.data, idx), shape=tuple(new)))
+
+
+def transpose(x, perm):
+    """reference sparse transpose kernel: permute index columns."""
+    b = _as_bcoo(x).sum_duplicates()
+    if sorted(perm) != list(range(b.ndim)):
+        raise ValueError(
+            "transpose: perm %s is not a permutation of the %d axes"
+            % (tuple(perm), b.ndim))
+    idx = b.indices[:, jnp.asarray(list(perm))]
+    shape = tuple(b.shape[p] for p in perm)
+    out = jsparse.BCOO((b.data, idx), shape=shape).sum_duplicates()
+    return _rewrap(x, out)
 
 
 def coalesce(x):

@@ -173,3 +173,70 @@ class TestSparseNNLayers:
 
     def test_sync_batch_norm_alias(self):
         assert issubclass(sparse.nn.SyncBatchNorm, sparse.nn.BatchNorm)
+
+
+class TestSparseOpBreadth:
+    """Reference phi/kernels/sparse unary/cast/reshape/transpose family."""
+
+    def _coo(self):
+        import paddle_tpu.sparse as sp
+
+        return sp.sparse_coo_tensor([[0, 1, 1], [2, 0, 3]],
+                                    [1.5, -2.0, 4.0], (2, 4))
+
+    def test_unary_family_preserves_pattern(self):
+        import paddle_tpu.sparse as sp
+
+        x = self._coo()
+        dense = np.asarray(x.to_dense().numpy())
+        for name, ref in [("sinh", np.sinh), ("tan", np.tan),
+                          ("expm1", np.expm1), ("square", np.square),
+                          ("sign", np.sign), ("floor", np.floor),
+                          ("ceil", np.ceil), ("atan", np.arctan),
+                          ("asinh", np.arcsinh)]:
+            got = getattr(sp, name)(x).to_dense()
+            want = np.where(dense != 0, ref(dense), 0.0)
+            np.testing.assert_allclose(np.asarray(got.numpy()), want,
+                                       rtol=1e-5, atol=1e-6,
+                                       err_msg=name)
+        np.testing.assert_allclose(
+            np.asarray(sp.relu6(x).to_dense().numpy()),
+            np.clip(dense, 0, 6) * (dense != 0))
+        lk = sp.leaky_relu(x, 0.1).to_dense()
+        np.testing.assert_allclose(
+            np.asarray(lk.numpy()),
+            np.where(dense >= 0, dense, 0.1 * dense) * (dense != 0))
+
+    def test_cast(self):
+        import paddle_tpu.sparse as sp
+
+        y = sp.cast(self._coo(), value_dtype="float64")
+        assert str(y.values().dtype).endswith(
+            ("float64", "float32"))  # x64 may demote; values intact
+        np.testing.assert_allclose(np.asarray(y.to_dense().numpy()),
+                                   np.asarray(
+                                       self._coo().to_dense().numpy()))
+
+    def test_reshape_flat_roundtrip(self):
+        import paddle_tpu.sparse as sp
+
+        x = self._coo()
+        flat = sp.reshape(x, [8])
+        np.testing.assert_allclose(
+            np.asarray(flat.to_dense().numpy()),
+            np.asarray(x.to_dense().numpy()).reshape(8))
+        back = sp.reshape(flat, [-1, 4])
+        np.testing.assert_allclose(
+            np.asarray(back.to_dense().numpy()),
+            np.asarray(x.to_dense().numpy()))
+        with pytest.raises(ValueError):
+            sp.reshape(x, [3, 3])
+
+    def test_transpose(self):
+        import paddle_tpu.sparse as sp
+
+        x = self._coo()
+        t = sp.transpose(x, [1, 0])
+        np.testing.assert_allclose(
+            np.asarray(t.to_dense().numpy()),
+            np.asarray(x.to_dense().numpy()).T)
