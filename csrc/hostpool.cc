@@ -1,0 +1,162 @@
+// Host buffer pool — the pinned-host-memory story of the memory layer
+// (reference paddle/fluid/memory/allocation/: CUDAPinnedAllocator +
+// AllocatorFacade stats, allocator_facade.h:44). TPU-native role: input
+// pipelines assemble batches into page-aligned, long-lived host buffers
+// that PJRT's host-to-device DMA path can use without bounce copies;
+// the pool recycles them across steps so steady-state training does no
+// host allocation at all (the same reason the reference pools pinned
+// pages instead of cudaHostAlloc per batch).
+//
+// Buckets are next-power-of-two sized (min one page); freed buffers park
+// on their bucket's free list. Stats mirror memory/stats.cc roles:
+// bytes_in_use, bytes_pooled, alloc hits/misses, peak_in_use.
+//
+// C ABI (ctypes, paddle_tpu/io/host_pool.py):
+//   pt_hostpool_create(max_pooled_bytes) -> handle
+//   pt_hostpool_alloc(h, nbytes) -> ptr (NULL on failure)
+//   pt_hostpool_free(h, ptr)            (parks or releases)
+//   pt_hostpool_stats(h, long long out[5])
+//   pt_hostpool_trim(h)                 (drop pooled buffers)
+//   pt_hostpool_destroy(h)
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr size_t kPage = 4096;
+
+struct HostPool {
+  size_t max_pooled = 0;  // cap on parked bytes (0 = unbounded)
+  std::mutex mu;
+  // bucket size -> parked pointers
+  std::map<size_t, std::vector<void*>> free_lists;
+  std::unordered_map<void*, size_t> bucket_of;  // live + parked
+  long long in_use = 0;
+  long long pooled = 0;
+  long long peak_in_use = 0;
+  long long hits = 0;
+  long long misses = 0;
+};
+
+std::mutex g_mu;
+std::map<int, HostPool*> g_pools;
+int g_next = 1;
+
+HostPool* get_pool(int h) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  auto it = g_pools.find(h);
+  return it == g_pools.end() ? nullptr : it->second;
+}
+
+size_t bucket_for(size_t n) {
+  size_t b = kPage;
+  while (b < n) b <<= 1;
+  return b;
+}
+
+}  // namespace
+
+extern "C" {
+
+int pt_hostpool_create(long long max_pooled_bytes) {
+  auto* p = new HostPool();
+  p->max_pooled = max_pooled_bytes > 0
+                      ? static_cast<size_t>(max_pooled_bytes)
+                      : 0;
+  std::lock_guard<std::mutex> lk(g_mu);
+  int h = g_next++;
+  g_pools[h] = p;
+  return h;
+}
+
+void* pt_hostpool_alloc(int h, long long nbytes) {
+  HostPool* p = get_pool(h);
+  if (p == nullptr || nbytes <= 0) return nullptr;
+  size_t b = bucket_for(static_cast<size_t>(nbytes));
+  std::lock_guard<std::mutex> lk(p->mu);
+  auto it = p->free_lists.find(b);
+  void* ptr = nullptr;
+  if (it != p->free_lists.end() && !it->second.empty()) {
+    ptr = it->second.back();
+    it->second.pop_back();
+    p->pooled -= static_cast<long long>(b);
+    p->hits++;
+  } else {
+    if (posix_memalign(&ptr, kPage, b) != 0) return nullptr;
+    p->bucket_of[ptr] = b;
+    p->misses++;
+  }
+  p->in_use += static_cast<long long>(b);
+  if (p->in_use > p->peak_in_use) p->peak_in_use = p->in_use;
+  return ptr;
+}
+
+int pt_hostpool_free(int h, void* ptr) {
+  HostPool* p = get_pool(h);
+  if (p == nullptr || ptr == nullptr) return -1;
+  std::lock_guard<std::mutex> lk(p->mu);
+  auto it = p->bucket_of.find(ptr);
+  if (it == p->bucket_of.end()) return -1;  // not ours / double free
+  size_t b = it->second;
+  p->in_use -= static_cast<long long>(b);
+  if (p->max_pooled == 0 ||
+      p->pooled + static_cast<long long>(b) <=
+          static_cast<long long>(p->max_pooled)) {
+    p->free_lists[b].push_back(ptr);
+    p->pooled += static_cast<long long>(b);
+  } else {  // over the parking cap: release to the OS
+    p->bucket_of.erase(it);
+    std::free(ptr);
+  }
+  return 0;
+}
+
+// out: [in_use, pooled, hits, misses, peak_in_use]
+int pt_hostpool_stats(int h, long long* out) {
+  HostPool* p = get_pool(h);
+  if (p == nullptr) return -1;
+  std::lock_guard<std::mutex> lk(p->mu);
+  out[0] = p->in_use;
+  out[1] = p->pooled;
+  out[2] = p->hits;
+  out[3] = p->misses;
+  out[4] = p->peak_in_use;
+  return 0;
+}
+
+int pt_hostpool_trim(int h) {
+  HostPool* p = get_pool(h);
+  if (p == nullptr) return -1;
+  std::lock_guard<std::mutex> lk(p->mu);
+  for (auto& kv : p->free_lists) {
+    for (void* ptr : kv.second) {
+      p->bucket_of.erase(ptr);
+      std::free(ptr);
+    }
+    kv.second.clear();
+  }
+  p->pooled = 0;
+  return 0;
+}
+
+void pt_hostpool_destroy(int h) {
+  HostPool* p = nullptr;
+  {
+    std::lock_guard<std::mutex> lk(g_mu);
+    auto it = g_pools.find(h);
+    if (it == g_pools.end()) return;
+    p = it->second;
+    g_pools.erase(it);
+  }
+  // frees EVERYTHING it ever handed out: callers must not outlive the
+  // pool (numpy views into pool buffers become dangling)
+  for (auto& kv : p->bucket_of) std::free(kv.first);
+  delete p;
+}
+
+}  // extern "C"

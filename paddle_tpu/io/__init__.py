@@ -584,3 +584,5 @@ class _MPIterator:
                 _shm_unpack(payload)
             except Exception:
                 pass
+
+from .host_pool import HostBufferPool  # noqa: F401,E402

@@ -1,0 +1,117 @@
+"""Host buffer pool — pinned-host-memory analog for the input pipeline.
+
+Parity: reference memory/allocation CUDAPinnedAllocator +
+AllocatorFacade stats (allocator_facade.h:44, memory/stats.cc). On TPU,
+PJRT owns device memory entirely (XLA buffer assignment + donation);
+what remains host-side is the batch-assembly buffer churn, which this
+pool removes: page-aligned buffers recycled across steps, so
+steady-state training performs no host allocation for input batches.
+
+Usage:
+    pool = HostBufferPool(max_pooled_bytes=256 << 20)
+    arr = pool.take((batch, seq), np.int32)   # numpy view into a pool
+    ... fill arr, device_put ...
+    pool.give(arr)                            # recycle
+"""
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from ..core import native
+
+
+def _lib():
+    lib = native.get_lib()
+    if not getattr(lib, "_hostpool_ready", False):
+        c = ctypes
+        lib.pt_hostpool_create.restype = c.c_int
+        lib.pt_hostpool_create.argtypes = [c.c_longlong]
+        lib.pt_hostpool_alloc.restype = c.c_void_p
+        lib.pt_hostpool_alloc.argtypes = [c.c_int, c.c_longlong]
+        lib.pt_hostpool_free.restype = c.c_int
+        lib.pt_hostpool_free.argtypes = [c.c_int, c.c_void_p]
+        lib.pt_hostpool_stats.restype = c.c_int
+        lib.pt_hostpool_stats.argtypes = [c.c_int,
+                                          c.POINTER(c.c_longlong)]
+        lib.pt_hostpool_trim.restype = c.c_int
+        lib.pt_hostpool_trim.argtypes = [c.c_int]
+        lib.pt_hostpool_destroy.argtypes = [c.c_int]
+        lib._hostpool_ready = True
+    return lib
+
+
+class HostBufferPool:
+    """Recycling page-aligned host buffers with numpy views."""
+
+    def __init__(self, max_pooled_bytes=0):
+        self._lib = _lib()
+        self._h = self._lib.pt_hostpool_create(int(max_pooled_bytes))
+        self._ptr_of = {}      # id(base buffer) -> raw pointer
+        self._outstanding = {}  # ptr -> generation token
+        self._gen = 0
+
+    def _on_gc(self, ptr, token):
+        """Finalizer: a taken buffer whose array was dropped without
+        give() (exception paths) is reclaimed instead of leaking. The
+        generation token keeps a stale finalizer from freeing the SAME
+        pointer after the pool recycled it to a newer take()."""
+        if self._outstanding.get(ptr) == token and self._h is not None \
+                and self._h >= 0:
+            del self._outstanding[ptr]
+            self._lib.pt_hostpool_free(self._h, ptr)
+
+    def take(self, shape, dtype):
+        """-> writable numpy array backed by a pooled buffer."""
+        import weakref
+
+        dtype = np.dtype(dtype)
+        nbytes = int(np.prod(shape)) * dtype.itemsize
+        ptr = self._lib.pt_hostpool_alloc(self._h, max(nbytes, 1))
+        if not ptr:
+            raise MemoryError("HostBufferPool.alloc(%d) failed" % nbytes)
+        buf = (ctypes.c_char * max(nbytes, 1)).from_address(ptr)
+        arr = np.frombuffer(buf, dtype=dtype,
+                            count=int(np.prod(shape))).reshape(shape)
+        arr.flags.writeable = True
+        self._ptr_of[id(arr.base)] = ptr
+        self._gen += 1
+        self._outstanding[ptr] = self._gen
+        weakref.finalize(buf, self._on_gc, ptr, self._gen)
+        return arr
+
+    def give(self, arr):
+        """Return a `take`n array's buffer to the pool. The array (and
+        any views) must not be used afterwards."""
+        ptr = self._ptr_of.pop(id(arr.base), None)
+        if ptr is None or self._outstanding.pop(ptr, None) is None:
+            raise ValueError("array was not taken from this pool")
+        rc = self._lib.pt_hostpool_free(self._h, ptr)
+        if rc != 0:
+            raise RuntimeError("hostpool free failed rc=%d" % rc)
+
+    def stats(self):
+        out = (ctypes.c_longlong * 5)()
+        rc = self._lib.pt_hostpool_stats(self._h, out)
+        if rc != 0:
+            raise RuntimeError("hostpool stats failed rc=%d" % rc)
+        return {"bytes_in_use": out[0], "bytes_pooled": out[1],
+                "hits": out[2], "misses": out[3],
+                "peak_bytes_in_use": out[4]}
+
+    def trim(self):
+        self._lib.pt_hostpool_trim(self._h)
+
+    def close(self):
+        if self._h is not None and self._h >= 0:
+            # outstanding views become dangling — caller's contract
+            self._lib.pt_hostpool_destroy(self._h)
+            self._h = -1
+            self._ptr_of.clear()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

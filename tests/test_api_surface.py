@@ -21,23 +21,48 @@ def _ref_all(path):
     return sorted(set(re.findall(r"^\s+'(\w+)',", src, re.M)))
 
 
+def _broken(mod, names):
+    """Names that are missing OR resolve to something that cannot be a
+    real API object (the hasattr-only gate let `None`/string/ellipsis
+    placeholders count as 'implemented' — VERDICT r2)."""
+    import types
+
+    out = []
+    for n in names:
+        if not hasattr(mod, n):
+            out.append(n)
+            continue
+        v = getattr(mod, n)
+        ok = (callable(v)                      # functions & classes
+              or isinstance(v, types.ModuleType)
+              or isinstance(v, (int, float, bool, str))  # constants
+              or n in ("dtype", "inf", "nan", "pi", "e", "newaxis"))
+        # strings are legitimate for dtype constants (dtype-as-string is
+        # this framework's design: paddle.float32 == "float32") and
+        # version-ish constants; any other string is a placeholder
+        if isinstance(v, str) and v != n and n not in ("__version__",):
+            ok = False
+        if v is None or v is Ellipsis:
+            ok = False
+        if not ok:
+            out.append("%s (resolves to %r)" % (n, type(v).__name__))
+    return out
+
+
 @_REF_GATE
 class TestSurfaceGates:
     def test_top_level_all_resolves(self):
-        missing = [n for n in _ref_all(REF + "/__init__.py")
-                   if not hasattr(paddle, n)]
+        missing = _broken(paddle, _ref_all(REF + "/__init__.py"))
         assert missing == [], missing
 
     def test_nn_all_resolves(self):
-        missing = [n for n in _ref_all(REF + "/nn/__init__.py")
-                   if not hasattr(nn, n)]
+        missing = _broken(nn, _ref_all(REF + "/nn/__init__.py"))
         assert missing == [], missing
 
     def test_nn_functional_all_resolves(self):
         import paddle_tpu.nn.functional as F
 
-        missing = [n for n in _ref_all(REF + "/nn/functional/__init__.py")
-                   if not hasattr(F, n)]
+        missing = _broken(F, _ref_all(REF + "/nn/functional/__init__.py"))
         assert missing == [], missing
 
 

@@ -175,26 +175,35 @@ class TestOpFamilyCoverage:
     def test_unknown_op_completion_is_flagged(self):
         import warnings
 
-        from paddle_tpu.core.dispatch import primitive
+        from paddle_tpu.core.dispatch import OPS, WRAPPERS, primitive
 
         @primitive
         def _ap_test_weird_op(x):
             return x * 2.0
 
-        pmesh.build_hybrid_mesh(dp=2, mp=4)
-        paddle.seed(0)
-        static.enable_static()
-        main = static.Program()
-        with static.program_guard(main, static.Program()):
-            x = static.data("x", [8, 4], "float32")
-            y = _ap_test_weird_op(x)
-        static.disable_static()
-        c = Completer()
-        with warnings.catch_warnings(record=True) as w:
-            warnings.simplefilter("always")
-            specs = c.complete_forward_annotation(main)
-            assert "_ap_test_weird_op" in c.unknown_ops
-            assert any("no propagation rule" in str(x.message) for x in w)
+        try:
+            pmesh.build_hybrid_mesh(dp=2, mp=4)
+            paddle.seed(0)
+            static.enable_static()
+            main = static.Program()
+            with static.program_guard(main, static.Program()):
+                x = static.data("x", [8, 4], "float32")
+                y = _ap_test_weird_op(x)
+            static.disable_static()
+            c = Completer()
+            with warnings.catch_warnings(record=True) as w:
+                warnings.simplefilter("always")
+                specs = c.complete_forward_annotation(main)
+                assert "_ap_test_weird_op" in c.unknown_ops
+                assert any("no propagation rule" in str(x.message)
+                           for x in w)
+        finally:
+            # scratch op must not leak into the live registry (the
+            # ops.yaml coverage gate in test_native diffs against it),
+            # and static mode must not leak into later tests
+            static.disable_static()
+            OPS.pop("_ap_test_weird_op", None)
+            WRAPPERS.pop("_ap_test_weird_op", None)
         # the llama program, by contrast, must complete with NO unknowns
         from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
 
