@@ -153,10 +153,18 @@ void pt_hostpool_destroy(int h) {
     p = it->second;
     g_pools.erase(it);
   }
-  // frees EVERYTHING it ever handed out: callers must not outlive the
-  // pool (numpy views into pool buffers become dangling)
+  // Release parked buffers; in-use buffers are freed too (the close()
+  // contract forbids outstanding views). The HostPool struct itself is
+  // intentionally NOT deleted: another thread may already hold the
+  // pointer from get_pool() (ctypes releases the GIL, so Python threads
+  // genuinely race destroy against take/give) and deleting here would
+  // be use-after-free on p->mu. One small struct per pool lifetime is
+  // the price of a lock-free fast path.
+  std::lock_guard<std::mutex> lk(p->mu);
   for (auto& kv : p->bucket_of) std::free(kv.first);
-  delete p;
+  p->bucket_of.clear();
+  p->free_lists.clear();
+  p->in_use = p->pooled = 0;
 }
 
 }  // extern "C"

@@ -263,9 +263,23 @@ class DataLoader:
                  shuffle=False, drop_last=False, collate_fn=None,
                  num_workers=0, use_buffer_reader=True, prefetch_factor=2,
                  use_shared_memory=True, timeout=0, worker_init_fn=None,
-                 persistent_workers=False):
+                 persistent_workers=False, pin_memory=False):
         self.dataset = dataset
-        self.collate_fn = collate_fn or default_collate_fn
+        if pin_memory and collate_fn is None and num_workers <= 0:
+            # batch assembly through the recycling host pool: steady-state
+            # epochs do no host allocation for the stacked batch buffers
+            # (the reference's pinned-memory DataLoader role). In-process
+            # collation only: worker processes must never touch the
+            # parent's jax runtime or drag the pool's ctypes handle
+            # across fork/spawn, so num_workers>0 keeps the default
+            # numpy collate (workers assemble, parent converts).
+            from .host_pool import HostBufferPool
+
+            self._pin_pool = HostBufferPool()
+            self.collate_fn = self._pinned_collate
+        else:
+            self._pin_pool = None
+            self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
         self.use_shared_memory = use_shared_memory
@@ -288,6 +302,32 @@ class DataLoader:
         if self._iterable_mode:
             raise TypeError("IterableDataset DataLoader has no len()")
         return len(self.batch_sampler)
+
+    def _pinned_collate(self, batch):
+        sample = batch[0]
+        if isinstance(sample, (tuple, list)):
+            return [self._pinned_collate([b[i] for b in batch])
+                    for i in range(len(sample))]
+        if isinstance(sample, dict):
+            return {k: self._pinned_collate([b[k] for b in batch])
+                    for k in sample}
+        if isinstance(sample, np.ndarray):
+            import jax.numpy as jnp
+
+            shape = (len(batch),) + sample.shape
+            dt = sample.dtype if sample.dtype != np.float64 \
+                else np.dtype(np.float32)
+            buf = self._pin_pool.take(shape, dt)
+            for i, b in enumerate(batch):
+                buf[i] = b
+            # copy=True is load-bearing: on the CPU backend jnp.asarray
+            # zero-copy ALIASES page-aligned numpy memory, and the pool
+            # is about to recycle this buffer. On TPU this copy is the
+            # H2D transfer that happens anyway.
+            out = Tensor(jnp.array(buf, copy=True))
+            self._pin_pool.give(buf)
+            return out
+        return default_collate_fn(batch)
 
     def _batches(self):
         if self._iterable_mode:

@@ -52,11 +52,13 @@ class HostBufferPool:
         self._outstanding = {}  # ptr -> generation token
         self._gen = 0
 
-    def _on_gc(self, ptr, token):
+    def _on_gc(self, ptr, token, base_id):
         """Finalizer: a taken buffer whose array was dropped without
         give() (exception paths) is reclaimed instead of leaking. The
         generation token keeps a stale finalizer from freeing the SAME
         pointer after the pool recycled it to a newer take()."""
+        if self._ptr_of.get(base_id) == ptr:
+            del self._ptr_of[base_id]  # stale id must not mis-resolve
         if self._outstanding.get(ptr) == token and self._h is not None \
                 and self._h >= 0:
             del self._outstanding[ptr]
@@ -78,7 +80,7 @@ class HostBufferPool:
         self._ptr_of[id(arr.base)] = ptr
         self._gen += 1
         self._outstanding[ptr] = self._gen
-        weakref.finalize(buf, self._on_gc, ptr, self._gen)
+        weakref.finalize(buf, self._on_gc, ptr, self._gen, id(arr.base))
         return arr
 
     def give(self, arr):
@@ -115,3 +117,9 @@ class HostBufferPool:
 
     def __exit__(self, *exc):
         self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

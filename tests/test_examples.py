@@ -35,3 +35,8 @@ class TestExamples:
     def test_ps_wide_deep(self):
         loss = _load("ps_wide_deep").main(steps=6)
         assert np.isfinite(loss)
+
+    def test_gnn_graphsage(self, capsys):
+        _load("gnn_graphsage").main()
+        out = capsys.readouterr().out
+        assert "full-graph accuracy" in out
