@@ -22,13 +22,22 @@ from .collective import (  # noqa: F401
     P2POp,
     ReduceOp,
     all_gather,
+    all_gather_object,
     all_reduce,
     alltoall,
+    alltoall_single,
     barrier,
     batch_isend_irecv,
     broadcast,
+    broadcast_object_list,
+    destroy_process_group,
+    get_backend,
     get_group,
+    gloo_barrier,
+    gloo_init_parallel_env,
+    gloo_release,
     irecv,
+    is_available,
     isend,
     new_group,
     partial_allgather,
@@ -38,8 +47,21 @@ from .collective import (  # noqa: F401
     reduce,
     reduce_scatter,
     scatter,
+    scatter_object_list,
     send,
+    wait,
 )
+from . import io  # noqa: F401
+from .entry import (  # noqa: F401
+    CountFilterEntry,
+    ProbabilityEntry,
+    ShowClickEntry,
+)
+from ..framework.dataset import (  # noqa: F401
+    InMemoryDataset,
+    QueueDataset,
+)
+from ..parallel.mp_layers import split  # noqa: F401
 from .env import (  # noqa: F401
     ParallelEnv,
     get_rank,

@@ -636,3 +636,148 @@ def ppermute(v, axis, perm):
 
 def axis_index(axis):
     return jax.lax.axis_index(axis)
+
+
+# -- object collectives + misc compat ----------------------------------------
+# (reference python/paddle/distributed/communication/*_object_list: python
+# objects pickle onto byte tensors and ride the same transport — here the
+# store process group (_world_pg above); a 1-process world is the identity)
+
+def all_gather_object(object_list, obj, group=None):
+    """Gather a picklable object from every rank into object_list."""
+    import pickle
+
+    pg = _pg_of(group or _get_default_group()) or _world_pg()
+    if pg is None or pg.world_size <= 1:
+        object_list.extend([obj])
+        return
+    payload = np.frombuffer(pickle.dumps(obj), np.uint8).copy()
+    parts = pg.allgather(payload)
+    object_list.extend(pickle.loads(p.tobytes()) for p in parts)
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    """In-place: every rank ends with src's objects."""
+    import pickle
+
+    pg = _pg_of(group or _get_default_group()) or _world_pg()
+    if pg is None or pg.world_size <= 1:
+        return
+    if pg.rank == src:  # only the source serializes; others' payload is
+        payload = np.frombuffer(pickle.dumps(list(object_list)),
+                                np.uint8).copy()
+    else:  # ignored by the store broadcast
+        payload = np.empty(0, np.uint8)
+    out = pg.broadcast(payload, src)
+    object_list[:] = pickle.loads(out.tobytes())
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group=None):
+    """Rank r receives in_object_list[r] from src."""
+    import pickle
+
+    pg = _pg_of(group or _get_default_group()) or _world_pg()
+    if pg is None or pg.world_size <= 1:
+        # identical semantics to the multi-rank path: this rank gets
+        # exactly its own element
+        if in_object_list:
+            out_object_list.append(in_object_list[0])
+        return
+    if in_object_list is not None and pg.rank == src and \
+            len(in_object_list) != pg.world_size:
+        raise ValueError(
+            "scatter_object_list: need one object per rank (%d != %d)"
+            % (len(in_object_list), pg.world_size))
+    if pg.rank == src:
+        chunks = [np.frombuffer(pickle.dumps([o]), np.uint8).copy()
+                  for o in (in_object_list or [])]
+    else:
+        chunks = None
+    got = pg.scatter(chunks, src)
+    out_object_list.extend(pickle.loads(np.asarray(got).tobytes()))
+
+
+def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    """Single-tensor all-to-all (reference communication/all_to_all.py
+    alltoall_single): dim0 splits exchange between ranks. Returns the
+    received tensor (out_tensor is also filled when provided)."""
+    g = group or _get_default_group()
+    n = g.nranks if hasattr(g, "nranks") else get_world_size(g)
+    v = _unwrap(in_tensor)
+    for sizes in (in_split_sizes, out_split_sizes):
+        if sizes is not None and len(set(sizes)) > 1:
+            raise NotImplementedError(
+                "alltoall_single: only uniform split sizes are "
+                "supported (the exchange is a fixed dim0 transpose); "
+                "got %s" % (sizes,))
+    pieces = list(jnp.split(v, n, axis=0)) if n > 1 else [v]
+    received = alltoall(
+        [_wrap_like(in_tensor, p) for p in pieces], group=group)
+    if not isinstance(received, (list, tuple)):
+        received = [received]
+    out = jnp.concatenate([_unwrap(t) for t in received], axis=0)
+    if out_tensor is not None and hasattr(out_tensor, "_value"):
+        out_tensor._value = out
+    return _wrap_like(in_tensor, out)
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    """reference communication/wait: fence outstanding work on the
+    tensor (XLA: block_until_ready)."""
+    v = _unwrap(tensor)
+    if not _is_tracer(v):
+        jax.block_until_ready(v)
+    return tensor
+
+
+def get_backend(group=None):
+    """Communication backend name (reference returns NCCL/GLOO; the
+    compiled path here is XLA collectives, the eager multi-process
+    fallback the TCP store)."""
+    pg = _pg_of(group or _get_default_group()) or _world_pg()
+    if pg is not None and pg.world_size > 1:
+        return "STORE"
+    return "XLA"
+
+
+def destroy_process_group(group=None):
+    """Tear down eager process-group state (reference
+    communication/group.py destroy_process_group). group=None destroys
+    the world; a specific group is removed from the registry."""
+    from . import env as _env
+    from .process_group import set_world_group
+
+    if group is None:
+        set_world_group(None)
+        _groups.clear()
+        _env._initialized = False
+    else:
+        _groups.pop(getattr(group, "id", group), None)
+
+
+# gloo_* compat (reference CPU bootstrap trio): the store process group
+# plays gloo's role here
+
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    import os
+
+    # the explicit arguments are authoritative (reference semantics) —
+    # never let stale launcher env override them
+    os.environ["PADDLE_TRAINER_ID"] = str(rank_id)
+    os.environ["PADDLE_TRAINERS_NUM"] = str(rank_num)
+    os.environ["PADDLE_MASTER"] = server_endpoint
+    from . import env as _env
+
+    _env.init_parallel_env()
+
+
+def gloo_barrier():
+    pg = _world_pg()
+    if pg is not None:
+        pg.barrier("gloo_barrier")
+
+
+def gloo_release():
+    destroy_process_group()

@@ -1,0 +1,63 @@
+"""Sparse-table admission entries (reference
+python/paddle/distributed/entry_attr.py): per-embedding policies for
+which feature ids a PS sparse table admits/retains. Consumed by the PS
+path — show/click maps onto the CTR accessor's score threshold
+(csrc/ps.cc CtrTable), count/probability filter admission client-side.
+"""
+from __future__ import annotations
+
+__all__ = ["ProbabilityEntry", "CountFilterEntry", "ShowClickEntry"]
+
+
+class EntryAttr:
+    def __init__(self):
+        self._name = None
+
+    def _to_attr(self):
+        raise NotImplementedError
+
+
+class ProbabilityEntry(EntryAttr):
+    """Admit a new feature id with probability p."""
+
+    def __init__(self, probability):
+        super().__init__()
+        if not isinstance(probability, float) or not 0 < probability < 1:
+            raise ValueError("probability must be a float in (0, 1)")
+        self._name = "probability_entry"
+        self.probability = probability
+
+    def _to_attr(self):
+        return "%s:%s" % (self._name, self.probability)
+
+
+class CountFilterEntry(EntryAttr):
+    """Admit a feature id after it has been seen `count_filter` times."""
+
+    def __init__(self, count_filter):
+        super().__init__()
+        if not isinstance(count_filter, int) or count_filter < 0:
+            raise ValueError(
+                "count_filter must be a non-negative integer")
+        self._name = "count_filter_entry"
+        self.count_filter = count_filter
+
+    def _to_attr(self):
+        return "%s:%d" % (self._name, self.count_filter)
+
+
+class ShowClickEntry(EntryAttr):
+    """Retention scored by show/click statistics (the CTR accessor's
+    show_click_score; csrc/ps.cc CtrTable.shrink)."""
+
+    def __init__(self, show_name, click_name):
+        super().__init__()
+        if not isinstance(show_name, str) or \
+                not isinstance(click_name, str):
+            raise ValueError("show_name/click_name must be variable names")
+        self._name = "show_click_entry"
+        self.show_name = show_name
+        self.click_name = click_name
+
+    def _to_attr(self):
+        return "%s:%s:%s" % (self._name, self.show_name, self.click_name)

@@ -1,0 +1,65 @@
+"""paddle.distributed.io (reference python/paddle/distributed/io.py):
+persistable save/load helpers for distributed programs. The PS-table
+halves live server-side (PsClient.save/load); the dense program state
+rides the framework checkpoint I/O."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["save_persistables", "load_persistables",
+           "is_persistable", "load_inference_model_distributed"]
+
+
+def is_persistable(var):
+    """Parameters and buffers persist; feed placeholders do not."""
+    from ..core.tensor import Parameter
+
+    if isinstance(var, Parameter):
+        return True
+    return bool(getattr(var, "persistable", False))
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    """Save a program's parameters (reference save_persistables; the
+    sparse PS tables are saved by the server via PsClient.save)."""
+    from .. import save as _save
+
+    if main_program is None:
+        from ..static import default_main_program
+
+        main_program = default_main_program()
+    params, frozen = main_program._analyze()
+    state = {p.name or ("param_%d" % i): p
+             for i, p in enumerate(list(params) + list(frozen))}
+    os.makedirs(dirname, exist_ok=True)
+    _save({k: v for k, v in state.items()},
+          os.path.join(dirname, filename or "persistables.pdparams"))
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    from .. import load as _load
+
+    if main_program is None:
+        from ..static import default_main_program
+
+        main_program = default_main_program()
+    state = _load(os.path.join(dirname,
+                               filename or "persistables.pdparams"))
+    params, frozen = main_program._analyze()
+    by_name = {p.name or ("param_%d" % i): p
+               for i, p in enumerate(list(params) + list(frozen))}
+    for k, v in state.items():
+        if k in by_name:
+            import jax.numpy as jnp
+
+            by_name[k]._value = jnp.asarray(
+                v._value if hasattr(v, "_value") else v)
+
+
+def load_inference_model_distributed(dirname, executor, **kwargs):
+    """Load a saved inference model (dense part; reference counterpart
+    additionally wires remote lookup tables, which here live behind
+    DistributedInfer / TheOnePSRuntime)."""
+    from ..static import load_inference_model
+
+    return load_inference_model(dirname, executor, **kwargs)

@@ -223,3 +223,52 @@ def get_rng_state_tracker():
     from ..framework.random import get_rng_state_tracker as _get
 
     return _get()
+
+
+# -- paddle.distributed.split --------------------------------------------
+# (reference python/paddle/distributed/collective.py split: create a
+# model-parallel linear/embedding whose weight is partitioned over the
+# mp ranks and apply it). Layers cache by name so repeated dygraph calls
+# train ONE set of parallel weights, matching the reference's
+# create-once static-graph semantics.
+
+_split_layers = {}
+
+
+def split(x, size, operation="linear", axis=0, num_partitions=1,
+          gather_out=True, weight_attr=None, bias_attr=None, name=None):
+    if name is None:
+        # key unnamed calls by their CALL SITE so two different layers
+        # with identical configs never share weights, while the same
+        # line re-executed every step reuses its one layer (dygraph)
+        import inspect
+
+        frame = inspect.stack()[1]
+        site = "%s:%d" % (frame.filename, frame.lineno)
+        name = "split@%s" % site
+    key = (name, operation, tuple(size), axis, bool(gather_out),
+           num_partitions, bias_attr is not False)
+    layer = _split_layers.get(key)
+    if layer is None:
+        if operation == "linear":
+            if axis == 1:  # split the output features -> column parallel
+                layer = ColumnParallelLinear(
+                    size[0], size[1], weight_attr=weight_attr,
+                    has_bias=bias_attr is not False,
+                    gather_output=gather_out, name=name)
+            elif axis == 0:  # split the reduce dim -> row parallel
+                layer = RowParallelLinear(
+                    size[0], size[1], weight_attr=weight_attr,
+                    has_bias=bias_attr is not False,
+                    input_is_parallel=False, name=name)
+            else:
+                raise ValueError("linear split axis must be 0 or 1")
+        elif operation == "embedding":
+            layer = VocabParallelEmbedding(
+                size[0], size[1], weight_attr=weight_attr, name=name)
+        else:
+            raise ValueError(
+                "split operation must be 'linear' or 'embedding', got %r"
+                % (operation,))
+        _split_layers[key] = layer
+    return layer(x)

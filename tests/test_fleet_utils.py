@@ -417,3 +417,81 @@ class TestStrategyNoopKnobWarnings:
             s.hybrid_configs = {"dp_degree": 2, "mp_degree": 4}
             msgs = [str(x.message) for x in w if "NO-OP" in str(x.message)]
         assert not msgs, msgs
+
+
+class TestDistributedCompatSurface:
+    def test_object_collectives_single_process(self):
+        import paddle_tpu.distributed as dist
+
+        out = []
+        dist.all_gather_object(out, {"a": 1})
+        assert out == [{"a": 1}]
+        lst = [1, 2]
+        dist.broadcast_object_list(lst)   # world==1: unchanged
+        assert lst == [1, 2]
+        got = []
+        # same semantics as the multi-rank path: THIS rank's element only
+        dist.scatter_object_list(got, [["x"], ["y"]])
+        assert got == [["x"]]
+
+    def test_alltoall_single_matches_transpose_semantics(self):
+        import numpy as np
+
+        import paddle_tpu as paddle
+        import paddle_tpu.distributed as dist
+
+        n = dist.get_world_size() or 1
+        x = np.arange(n * n, dtype=np.float32)
+        y = dist.alltoall_single(paddle.to_tensor(x))
+        # rank i's chunk j becomes rank j's chunk i: an n x n block
+        # transpose of dim0 in the single-process global view
+        want = x.reshape(n, n).T.reshape(-1)
+        np.testing.assert_allclose(np.asarray(y.numpy()), want)
+
+    def test_wait_backend_available(self):
+        import numpy as np
+
+        import paddle_tpu as paddle
+        import paddle_tpu.distributed as dist
+
+        t = paddle.to_tensor(np.ones(3, np.float32))
+        assert dist.wait(t) is t
+        assert dist.get_backend() in ("XLA", "STORE")
+        assert dist.is_available() is True
+
+    def test_split_column_parallel_trains_once(self):
+        import numpy as np
+
+        import paddle_tpu as paddle
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed import mesh as pmesh
+
+        pmesh.build_hybrid_mesh(dp=2, mp=4)
+        paddle.seed(0)
+        x = paddle.to_tensor(np.ones((2, 8), np.float32))
+        y1 = dist.split(x, (8, 16), operation="linear", axis=1,
+                        name="t_split")
+        y2 = dist.split(x, (8, 16), operation="linear", axis=1,
+                        name="t_split")
+        assert tuple(y1.shape) == (2, 16)
+        # cached layer: both calls share ONE weight set
+        np.testing.assert_allclose(np.asarray(y1.numpy()),
+                                   np.asarray(y2.numpy()))
+        e = dist.split(paddle.to_tensor(np.array([[1, 2]], np.int32)),
+                       (32, 8), operation="embedding", name="t_emb")
+        assert tuple(e.shape) == (1, 2, 8)
+
+    def test_entries_and_datasets_exposed(self):
+        import paddle_tpu.distributed as dist
+
+        assert dist.CountFilterEntry(3)._to_attr() == \
+            "count_filter_entry:3"
+        assert dist.ShowClickEntry("show", "clk")._to_attr() == \
+            "show_click_entry:show:clk"
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError):
+            dist.ProbabilityEntry(1.5)
+        assert dist.InMemoryDataset is not None
+        assert dist.QueueDataset is not None
+        assert callable(dist.io.save_persistables)
