@@ -707,17 +707,23 @@ def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
     n = g.nranks if hasattr(g, "nranks") else get_world_size(g)
     v = _unwrap(in_tensor)
     for sizes in (in_split_sizes, out_split_sizes):
-        if sizes is not None and len(set(sizes)) > 1:
+        if sizes is None:
+            continue
+        if len(set(sizes)) > 1:
             raise NotImplementedError(
                 "alltoall_single: only uniform split sizes are "
                 "supported (the exchange is a fixed dim0 transpose); "
                 "got %s" % (sizes,))
-    pieces = list(jnp.split(v, n, axis=0)) if n > 1 else [v]
-    received = alltoall(
-        [_wrap_like(in_tensor, p) for p in pieces], group=group)
-    if not isinstance(received, (list, tuple)):
-        received = [received]
-    out = jnp.concatenate([_unwrap(t) for t in received], axis=0)
+        if sizes and n > 0 and sizes[0] * n != v.shape[0]:
+            raise ValueError(
+                "alltoall_single: split sizes %s do not cover dim0 %d "
+                "across %d ranks" % (sizes, v.shape[0], n))
+    # alltoall takes the whole tensor and exchanges uniform dim0 chunks
+    received = alltoall(_wrap_like(in_tensor, v), group=group)
+    if isinstance(received, (list, tuple)):
+        out = jnp.concatenate([_unwrap(t) for t in received], axis=0)
+    else:
+        out = _unwrap(received)
     if out_tensor is not None and hasattr(out_tensor, "_value"):
         out_tensor._value = out
     return _wrap_like(in_tensor, out)
@@ -763,13 +769,21 @@ def destroy_process_group(group=None):
 def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
     import os
 
+    from . import env as _env
+
+    if _env._initialized:
+        import warnings
+
+        warnings.warn(
+            "gloo_init_parallel_env: the parallel env is already "
+            "initialized; the explicit rank/world arguments cannot take "
+            "effect (call it before any init_parallel_env).")
+        return
     # the explicit arguments are authoritative (reference semantics) —
     # never let stale launcher env override them
     os.environ["PADDLE_TRAINER_ID"] = str(rank_id)
     os.environ["PADDLE_TRAINERS_NUM"] = str(rank_num)
     os.environ["PADDLE_MASTER"] = server_endpoint
-    from . import env as _env
-
     _env.init_parallel_env()
 
 

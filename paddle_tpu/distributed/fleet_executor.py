@@ -419,17 +419,48 @@ class FleetExecutor:
 
 class DistModel:
     """Distributed inference facade (reference fleet_executor/dist_model.cc):
-    loads a saved inference model and serves run() — sharded execution
-    comes from the saved program's GSPMD annotations."""
+    loads a saved inference model and serves run(). With a device mesh
+    carrying a >1 'dp' axis, the batch is sharded over it and GSPMD
+    partitions the compiled program across the chips (throughput
+    serving); model-parallel sharding additionally flows from any
+    sharding annotations the saved program carries."""
 
-    def __init__(self, config):
+    def __init__(self, config, mesh=None):
         from ..inference import Predictor
 
         self.config = config
         self._predictor = Predictor(config)
+        if mesh is None:
+            from . import mesh as _mesh
+
+            m = _mesh._global_mesh
+            if m is not None and m.shape.get("dp", 1) > 1:
+                mesh = m
+        self._mesh = mesh
 
     def init(self):
         return True
 
+    def _dp_degree(self):
+        if self._mesh is None:
+            return 1
+        return int(self._mesh.shape.get("dp", 1))
+
     def run(self, inputs):
-        return self._predictor.run(inputs)
+        dp = self._dp_degree()
+        if dp <= 1:
+            return self._predictor.run(inputs)
+        import jax
+        import numpy as np
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        pred = self._predictor
+        vals = []
+        for name, a in zip(pred._feed_names, inputs):
+            arr = np.asarray(a)
+            shardable = arr.ndim >= 1 and arr.shape[0] % dp == 0
+            spec = P("dp") if shardable else P()
+            vals.append(jax.device_put(
+                arr, NamedSharding(self._mesh, spec)))
+        outs = pred._prog.run(*vals)
+        return [np.asarray(o) for o in outs]

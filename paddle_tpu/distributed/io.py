@@ -32,8 +32,8 @@ def save_persistables(executor, dirname, main_program=None, filename=None):
     state = {p.name or ("param_%d" % i): p
              for i, p in enumerate(list(params) + list(frozen))}
     os.makedirs(dirname, exist_ok=True)
-    _save({k: v for k, v in state.items()},
-          os.path.join(dirname, filename or "persistables.pdparams"))
+    _save(state, os.path.join(dirname,
+                              filename or "persistables.pdparams"))
 
 
 def load_persistables(executor, dirname, main_program=None, filename=None):

@@ -237,18 +237,28 @@ _split_layers = {}
 
 def split(x, size, operation="linear", axis=0, num_partitions=1,
           gather_out=True, weight_attr=None, bias_attr=None, name=None):
+    """NOTE on identity: unnamed calls are keyed by their CALL SITE, so
+    the same source line re-executed each step (dygraph) reuses its one
+    layer while different lines get different layers. A LOOP calling
+    split on one line builds distinct logical layers — pass a distinct
+    `name` per iteration there, or the weights would be shared."""
     if name is None:
-        # key unnamed calls by their CALL SITE so two different layers
-        # with identical configs never share weights, while the same
-        # line re-executed every step reuses its one layer (dygraph)
-        import inspect
+        import sys
 
-        frame = inspect.stack()[1]
-        site = "%s:%d" % (frame.filename, frame.lineno)
-        name = "split@%s" % site
+        f = sys._getframe(1)
+        name = "split@%s:%d" % (f.f_code.co_filename, f.f_lineno)
     key = (name, operation, tuple(size), axis, bool(gather_out),
            num_partitions, bias_attr is not False)
-    layer = _split_layers.get(key)
+    cached = _split_layers.get(key)
+    if cached is not None:
+        layer, made_with_attr = cached
+        if made_with_attr is not weight_attr:
+            raise ValueError(
+                "distributed.split: cached layer %r was created with a "
+                "different weight_attr; pass a distinct name per layer"
+                % (name,))
+        return layer(x)
+    layer = None
     if layer is None:
         if operation == "linear":
             if axis == 1:  # split the output features -> column parallel
@@ -270,5 +280,5 @@ def split(x, size, operation="linear", axis=0, num_partitions=1,
             raise ValueError(
                 "split operation must be 'linear' or 'embedding', got %r"
                 % (operation,))
-        _split_layers[key] = layer
+    _split_layers[key] = (layer, weight_attr)
     return layer(x)

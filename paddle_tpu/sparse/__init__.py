@@ -441,12 +441,9 @@ from . import nn  # noqa: F401,E402
 
 
 def is_same_shape(x, y):
-    """reference sparse is_same_shape kernel: dense-shape equality."""
-    sx = tuple(x.shape if not hasattr(x, "_bcoo") else x._bcoo.shape) \
-        if not hasattr(x, "_bcsr") else tuple(x._bcsr.shape)
-    sy = tuple(y.shape if not hasattr(y, "_bcoo") else y._bcoo.shape) \
-        if not hasattr(y, "_bcsr") else tuple(y._bcsr.shape)
-    return sx == sy
+    """reference sparse is_same_shape kernel: dense-shape equality
+    (dense Tensors and both sparse formats all expose .shape)."""
+    return tuple(x.shape) == tuple(y.shape)
 
 
 __all__.append("is_same_shape")
