@@ -29,6 +29,7 @@ class Engine:
         self.process_mesh = process_mesh
         self._step = None
         self._history = []
+        self.last_plan = None
 
     def _ensure_mesh(self):
         if self.process_mesh is None:
@@ -40,6 +41,41 @@ class Engine:
             self.process_mesh = auto_process_mesh(mp=mp)
         _gmesh.set_mesh(self.process_mesh.get_mesh())
         return self.process_mesh
+
+    def plan(self, sample_input, n_devices=None, hbm_bytes=16e9,
+             n_micro=8):
+        """Search dp/mp/pp/sharding degrees for this model (reference
+        Engine's Planner/tuner phase): captures one forward as a
+        Program, aggregates program_stats, and returns MeshPlanner's
+        analytic-cost argmin. `sample_input` is a representative batch
+        (Tensor/array of ids or features)."""
+        import jax
+
+        from ... import static
+        from .planner import MeshPlanner, program_stats
+
+        n_devices = n_devices or jax.device_count()
+        was_static = static.in_static_mode() if hasattr(
+            static, "in_static_mode") else not __import__(
+                "paddle_tpu").in_dynamic_mode()
+        static.enable_static()
+        try:
+            main = static.Program()
+            with static.program_guard(main, static.Program()):
+                arr = sample_input._value if isinstance(
+                    sample_input, Tensor) else np.asarray(sample_input)
+                x = static.data("planner_in", list(arr.shape),
+                                str(arr.dtype))
+                self.model(x)
+            stats = program_stats(main)
+        finally:
+            if not was_static:  # restore, never clobber, the mode
+                static.disable_static()
+        best, score, ranking = MeshPlanner(
+            hbm_bytes=hbm_bytes, n_micro=n_micro).plan(stats, n_devices)
+        self.last_plan = {"best": best, "score": score,
+                          "ranking": ranking[:5], "stats": stats}
+        return best
 
     def prepare(self, zero_stage=0):
         self._ensure_mesh()

@@ -95,3 +95,24 @@ class TestEngine:
         assert ev["loss"] == pytest.approx(hist[-1]["loss"], rel=1.0)
         preds = eng.predict([(x[:16],)])
         assert preds[0].shape == (16, 8)
+
+
+class TestEnginePlan:
+    def test_engine_plans_degrees_for_model(self):
+        """reference Engine's Planner/tuner phase: Engine.plan captures
+        the model, scores factorizations, returns a valid assignment."""
+        import numpy as np
+
+        import paddle_tpu as paddle
+        from paddle_tpu.distributed.auto_parallel import Engine
+        from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+        paddle.seed(0)
+        model = LlamaForCausalLM(LlamaConfig.tiny(use_parallel=False))
+        eng = Engine(model=model)
+        ids = np.zeros((2, 8), np.int32)
+        best = eng.plan(ids, n_devices=8)
+        assert best["dp"] * best["mp"] * best["pp"] * best["sharding"] == 8
+        assert eng.last_plan["score"]["time"] > 0
+        assert len(eng.last_plan["ranking"]) >= 1
+        assert eng.last_plan["stats"]["param_bytes"] > 0
