@@ -55,9 +55,7 @@ class Engine:
         from .planner import MeshPlanner, program_stats
 
         n_devices = n_devices or jax.device_count()
-        was_static = static.in_static_mode() if hasattr(
-            static, "in_static_mode") else not __import__(
-                "paddle_tpu").in_dynamic_mode()
+        was_static = not static.in_dynamic_mode()
         static.enable_static()
         try:
             main = static.Program()

@@ -152,3 +152,66 @@ class TestZeroStages:
         m = step._opt_state["weight"][0]
         mspec = tuple(m.sharding.spec)
         assert "sharding" in mspec and "mp" in mspec, mspec
+
+
+class TestEmbeddingGradPartitioning:
+    def test_no_scatter_on_sharded_embedding_grad(self):
+        """Regression for the GSPMD full-remat warning (VERDICT r2 #3):
+        with a vocab-sharded (mp) embedding under ZeRO, the weight grad
+        must come from the one-hot contraction (dot), never a
+        scatter-add from the batch-sharded cotangent — the scatter is
+        what forced replicate-then-slice resharding."""
+        import numpy as np
+
+        import paddle_tpu as paddle
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu.distributed import mesh as pmesh
+        from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+        from paddle_tpu.parallel.engine import CompiledTrainStep
+
+        pmesh.build_hybrid_mesh(dp=2, mp=2, sharding=2)
+        paddle.seed(0)
+        cfg = LlamaConfig.tiny(hidden_size=64, num_attention_heads=4,
+                               intermediate_size=128, num_hidden_layers=1,
+                               vocab_size=256)
+        model = LlamaForCausalLM(cfg)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=model.parameters())
+
+        def loss_fn(logits, labels):
+            return F.cross_entropy(logits.reshape([-1, cfg.vocab_size]),
+                                   labels.reshape([-1]))
+
+        step = CompiledTrainStep(model, loss_fn, opt, zero_stage=2)
+        rng = np.random.RandomState(0)
+        ids = paddle.to_tensor(
+            rng.randint(0, 256, (8, 16)).astype(np.int32))
+        labels = paddle.to_tensor(
+            rng.randint(0, 256, (8, 16)).astype(np.int32))
+        hlo = step.lowered_hlo(ids, labels)
+        # the embedding weight grad is [vocab, hidden]-shaped (possibly
+        # mp/sharding-partitioned): NO scatter may produce any shard of
+        # it. (Other small scatters — e.g. index updates — are fine.)
+        vocab, hidden = cfg.vocab_size, cfg.hidden_size
+        # vocab dim shards over mp (2), hidden over sharding (2): the
+        # possible embed-grad shard shapes keep vocab//dv >= 128 so none
+        # collide with the [64, 64] attention weights
+        embed_shard_shapes = {
+            "f32[%d,%d]" % (vocab // dv, hidden // dh)
+            for dv in (1, 2) for dh in (1, 2)}
+        offending = [
+            ln.strip()[:160] for ln in hlo.splitlines()
+            if "scatter(" in ln and "reduce-scatter" not in ln
+            and any(s + "{" in ln or s + " " in ln
+                    for s in embed_shard_shapes)]
+        assert not offending, (
+            "embedding grad fell back to scatter-add under a sharded "
+            "mesh — the GSPMD full-remat regression:\n%s"
+            % "\n".join(offending))
+        # and the one-hot contraction path IS present: a dot (or its
+        # fusion) PRODUCING an embed-grad-shaped value
+        producing = [
+            ln for ln in hlo.splitlines()
+            if ("dot(" in ln or "fusion(" in ln)
+            and any("= " + s in ln for s in embed_shard_shapes)]
+        assert producing, "no dot/fusion produces the embed-grad shape"
