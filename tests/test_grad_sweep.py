@@ -200,3 +200,55 @@ class TestReductionManipGrads:
             return F.embedding(paddle.to_tensor(ids), w)
 
         check_grad(fn, {"w": _x(25, 5, 4)})
+
+
+class TestConvPoolInterpGrads:
+    def test_conv2d(self):
+        def fn(x, w):
+            return F.conv2d(x, w, padding=1)
+
+        check_grad(fn, {"x": _x(40, 1, 2, 5, 5),
+                        "w": _x(41, 3, 2, 3, 3)})
+
+    def test_depthwise_conv2d(self):
+        def fn(x, w):
+            return F.conv2d(x, w, groups=2)
+
+        check_grad(fn, {"x": _x(42, 1, 2, 5, 5),
+                        "w": _x(43, 2, 1, 3, 3)})
+
+    def test_conv2d_transpose(self):
+        def fn(x, w):
+            return F.conv2d_transpose(x, w)
+
+        check_grad(fn, {"x": _x(44, 1, 2, 4, 4),
+                        "w": _x(45, 2, 3, 3, 3)})
+
+    def test_avg_pool2d(self):
+        def fn(x):
+            return F.avg_pool2d(x, 2)
+
+        check_grad(fn, {"x": _x(46, 1, 2, 4, 4)})
+
+    def test_max_pool2d(self):
+        # distinct values keep the max subgradient unique (finite
+        # differences are only valid away from argmax ties)
+        x = np.arange(32, dtype=np.float32).reshape(1, 2, 4, 4)
+        x += R(47).rand(1, 2, 4, 4).astype(np.float32) * 0.3
+
+        def fn(x):
+            return F.max_pool2d(x, 2)
+
+        check_grad(fn, {"x": x})
+
+    def test_bilinear_interpolate(self):
+        def fn(x):
+            return F.interpolate(x, size=[6, 6], mode="bilinear")
+
+        check_grad(fn, {"x": _x(48, 1, 2, 3, 3)})
+
+    def test_pad_grad(self):
+        def fn(x):
+            return F.pad(x, [1, 1, 1, 1])
+
+        check_grad(fn, {"x": _x(49, 1, 2, 3, 3)})
