@@ -291,7 +291,9 @@ struct CtrTable {
       b1p *= b1;
       b2p *= b2;
       for (int i = 0; i < d; ++i) {
-        float sg = g[i] / scale;
+        // Reference parity (sparse_sgd_rule.cc): only the adagrad rules
+        // divide the gradient by the show-scale; adam consumes it raw.
+        float sg = g[i];
         m[i] = b1 * m[i] + (1 - b1) * sg;
         v[i] = b2 * v[i] + (1 - b2) * sg * sg;
         w[i] -= lr * (m[i] / (1 - b1p)) /
@@ -1624,8 +1626,18 @@ int pt_comm_stop(int h) {
   c->cv.notify_all();
   if (c->flusher.joinable()) c->flusher.join();
   int rc = c->flush_locked_tables();
-  pt_ps_close(c->fd);
-  delete c;
+  {
+    // Close under send_mu and poison the fd: a racing flush either
+    // finishes its wire I/O before the close (it holds send_mu) or sees
+    // fd=-1 and fails cleanly — never a write into a reused descriptor.
+    std::lock_guard<std::mutex> l(c->send_mu);
+    pt_ps_close(c->fd);
+    c->fd = -1;
+  }
+  // Intentionally NOT deleted (same policy as hostpool.cc): a concurrent
+  // pt_comm_push_*/pt_comm_flush may already hold the raw pointer from
+  // comm_of() — ctypes releases the GIL — and freeing here would be a
+  // use-after-free. The struct is small; leaking it on stop is safe.
   return rc;
 }
 

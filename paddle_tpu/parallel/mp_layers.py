@@ -239,9 +239,14 @@ def split(x, size, operation="linear", axis=0, num_partitions=1,
           gather_out=True, weight_attr=None, bias_attr=None, name=None):
     """NOTE on identity: unnamed calls are keyed by their CALL SITE, so
     the same source line re-executed each step (dygraph) reuses its one
-    layer while different lines get different layers. A LOOP calling
-    split on one line builds distinct logical layers — pass a distinct
-    `name` per iteration there, or the weights would be shared."""
+    layer — including when the surrounding forward() is reached from
+    different outer call sites (train loop vs eval), which MUST share
+    weights. Two ambiguous shapes therefore share weights SILENTLY and
+    need an explicit `name` per logical layer: a LOOP calling split on
+    one line, and a shared HELPER function whose one split line serves
+    several distinct logical layers (no stack heuristic can tell either
+    apart from the legitimate train/eval re-entry above — both change
+    only outer frames)."""
     if name is None:
         import sys
 
@@ -258,27 +263,25 @@ def split(x, size, operation="linear", axis=0, num_partitions=1,
                 "different weight_attr; pass a distinct name per layer"
                 % (name,))
         return layer(x)
-    layer = None
-    if layer is None:
-        if operation == "linear":
-            if axis == 1:  # split the output features -> column parallel
-                layer = ColumnParallelLinear(
-                    size[0], size[1], weight_attr=weight_attr,
-                    has_bias=bias_attr is not False,
-                    gather_output=gather_out, name=name)
-            elif axis == 0:  # split the reduce dim -> row parallel
-                layer = RowParallelLinear(
-                    size[0], size[1], weight_attr=weight_attr,
-                    has_bias=bias_attr is not False,
-                    input_is_parallel=False, name=name)
-            else:
-                raise ValueError("linear split axis must be 0 or 1")
-        elif operation == "embedding":
-            layer = VocabParallelEmbedding(
-                size[0], size[1], weight_attr=weight_attr, name=name)
+    if operation == "linear":
+        if axis == 1:  # split the output features -> column parallel
+            layer = ColumnParallelLinear(
+                size[0], size[1], weight_attr=weight_attr,
+                has_bias=bias_attr is not False,
+                gather_output=gather_out, name=name)
+        elif axis == 0:  # split the reduce dim -> row parallel
+            layer = RowParallelLinear(
+                size[0], size[1], weight_attr=weight_attr,
+                has_bias=bias_attr is not False,
+                input_is_parallel=False, name=name)
         else:
-            raise ValueError(
-                "split operation must be 'linear' or 'embedding', got %r"
-                % (operation,))
+            raise ValueError("linear split axis must be 0 or 1")
+    elif operation == "embedding":
+        layer = VocabParallelEmbedding(
+            size[0], size[1], weight_attr=weight_attr, name=name)
+    else:
+        raise ValueError(
+            "split operation must be 'linear' or 'embedding', got %r"
+            % (operation,))
     _split_layers[key] = (layer, weight_attr)
     return layer(x)

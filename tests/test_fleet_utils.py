@@ -481,6 +481,39 @@ class TestDistributedCompatSurface:
                        (32, 8), operation="embedding", name="t_emb")
         assert tuple(e.shape) == (1, 2, 8)
 
+    def test_split_callsite_identity_semantics(self):
+        """Unnamed split calls are keyed by their CALL SITE: one split
+        line reached from different outer call sites (train loop vs
+        eval calling the same forward) reuses ONE layer — reaching the
+        forward from a new outer line must NOT mint fresh untrained
+        weights. A shared helper serving distinct logical layers is the
+        documented hazard; explicit names disambiguate it."""
+        import paddle_tpu as paddle
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed import mesh as pmesh
+        from paddle_tpu.parallel.mp_layers import _split_layers
+
+        pmesh.build_hybrid_mesh(dp=2, mp=4)
+        x = paddle.to_tensor(np.ones((2, 8), np.float32))
+
+        def forward():
+            return dist.split(x, (8, 16), operation="linear", axis=1)
+
+        before = len(_split_layers)
+        y_train = forward()  # outer site A (the "train loop")
+        y_eval = forward()   # outer site B (the "eval path")
+        assert len(_split_layers) == before + 1  # ONE shared layer
+        np.testing.assert_allclose(np.asarray(y_train.numpy()),
+                                   np.asarray(y_eval.numpy()))
+        # explicit names split a shared helper into distinct layers
+        def helper(nm):
+            return dist.split(x, (8, 16), operation="linear", axis=1,
+                              name=nm)
+
+        helper("logical_a")
+        helper("logical_b")
+        assert len(_split_layers) == before + 3
+
     def test_entries_and_datasets_exposed(self):
         import paddle_tpu.distributed as dist
 

@@ -168,6 +168,24 @@ class TestCtrAccessor:
             _, _, _, wx = cli.pull_ctr(0, [2])
             np.testing.assert_allclose(wx, -0.1 * gx / 4.0, rtol=1e-5)
 
+    def test_adam_rule_ignores_show_scale(self, server):
+        """Reference sparse_sgd_rule.cc parity: only the adagrad rules
+        divide the gradient by show; SparseAdamSGDRule consumes it raw.
+        Adam's m/sqrt(v) is scale-invariant except through eps, so probe
+        with a gradient small enough that eps dominates: raw g=1e-7 gives
+        step ~ lr*g/(g+eps) = 0.909*lr, while a /show=4 version would
+        give lr*(g/4)/((g/4)+eps) = 0.714*lr."""
+        with PsClient(port=server.port) as cli:
+            cli.create_ctr_table(0, dim=2, rule="adam", lr=0.01,
+                                 init_range=0.0)
+            g = np.float32(1e-7)
+            gx = np.full((1, 2), g, np.float32)
+            cli.push_ctr(0, [3], shows=[4.0], clicks=[0.0],
+                         embed_g=[0.0], embedx_g=gx)
+            _, _, _, wx = cli.pull_ctr(0, [3])
+            want = -0.01 * g / (g + 1e-8)
+            np.testing.assert_allclose(wx, np.full((1, 2), want), rtol=1e-3)
+
     def test_shrink_decay_and_delete(self, server):
         with PsClient(port=server.port) as cli:
             cli.create_ctr_table(0, dim=2, rule="sgd", lr=0.1,

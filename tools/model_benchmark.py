@@ -87,6 +87,9 @@ def bench_resnet50(results, iters=None):
     rng = np.random.RandomState(0)
     x = paddle.to_tensor(rng.rand(batch, 3, size, size).astype(
         np.float32) * 2 - 1)
+    if on_tpu:
+        # weights were cast to bf16 above; conv requires matching dtypes
+        x = x.astype("bfloat16")
     y = paddle.to_tensor(rng.randint(0, 1000, (batch,)).astype(np.int32))
     for _ in range(2):
         loss = step(x, y)
