@@ -85,10 +85,17 @@ def export_with_dynamic_dims(pure_fn, specs, leading_args=()):
 
 
 class StaticFunction:
-    """Compiled wrapper around a Layer method or function."""
+    """Compiled wrapper around a Layer method or function. The wrapped
+    function is first run through the dy2static AST converter
+    (dy2static.py) so tensor-dependent Python `if`/`while`/`for` lower
+    to XLA control flow instead of failing at trace time (reference
+    jit/dy2static program_translator.py:1160)."""
 
     def __init__(self, fn, layer=None, input_spec=None):
-        self._fn = fn
+        from .dy2static import convert_control_flow
+
+        self._original_fn = fn
+        self._fn = convert_control_flow(fn)
         self._layer = layer
         self._input_spec = input_spec
         self._cache = {}
@@ -129,13 +136,34 @@ class StaticFunction:
 
         return jax.jit(pure)
 
+    def _needs_grad(self, args, kwargs):
+        """Training pass? The jitted inference trace runs under no_grad
+        and would silently detach autograd — route through the eager
+        tape instead (the reference's @to_static records fwd+bwd into
+        one Program; here eager IS the differentiable engine, and
+        CompiledTrainStep is the whole-graph-compiled training path)."""
+        from ..core.dispatch import tape_enabled
+
+        if not tape_enabled():
+            return False
+        if self._layer is not None:
+            for p in self._layer.parameters():
+                if not p.stop_gradient:
+                    return True
+        return any(isinstance(a, Tensor) and not a.stop_gradient
+                   for a in list(args) + list(kwargs.values()))
+
     def __call__(self, *args, **kwargs):
         if not _TO_STATIC_ENABLED:
             # jit.enable_to_static(False): decorated fns run eagerly
             return self._fn(*args, **kwargs)
+        if self._needs_grad(args, kwargs):
+            return self._fn(*args, **kwargs)
         tensor_args = [a for a in args if isinstance(a, Tensor)]
-        if len(tensor_args) != len(args):
-            # non-tensor args: fall back to eager for simplicity
+        if kwargs or len(tensor_args) != len(args):
+            # kwargs or non-tensor args: the compiled-path cache keys
+            # and call only cover positional tensors — run eagerly
+            # rather than silently tracing with defaults
             return self._fn(*args, **kwargs)
         key = self._key(args)
         if key not in self._cache:

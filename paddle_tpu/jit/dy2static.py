@@ -1,0 +1,1087 @@
+"""dy2static: AST conversion of tensor-dependent Python control flow.
+
+Parity: reference python/paddle/jit/dy2static (ifelse_transformer.py,
+loop_transformer.py, break_continue_transformer.py, return_transformer.py,
+program_translator.py:1160). The reference rewrites the user's AST so
+`if`/`while`/`for` whose predicate is a Tensor become conditional_block /
+while ops in a ProgramDesc. TPU-native design: the same AST rewrite, but
+the rewritten statements call *runtime-dispatching* helpers that
+
+  - run ordinary Python control flow when the value is concrete
+    (plain bools, un-traced Tensors): zero semantic change off-trace;
+  - lower to `jax.lax.cond` / `jax.lax.while_loop` (via static.cond /
+    static.while_loop, the framework's control-flow ops) when the value
+    is a tracer inside `@to_static`'s jax.jit trace.
+
+The transform pipeline per function (one pass, applied lazily at first
+compile, cached):
+
+  1. interruption desugaring — `return`/`break`/`continue` become flag
+     assignments (`_dy2st_ret_flag`, `_dy2st_brk_N`, ...) and every
+     statement after a potentially-interrupting one is wrapped in an
+     `if <no flag set>:` guard (reference break_continue_transformer /
+     return_transformer use the same flag scheme);
+  2. structural conversion — each `if` becomes two local branch
+     functions + `_dy2st.convert_if(...)` over the carried variables
+     (reference ifelse_transformer's true_fn/false_fn extraction); each
+     `while`/`for` becomes cond/body functions + `_dy2st.convert_while`
+     / `_dy2st.convert_for` (reference loop_transformer's
+     loop-variable analysis).
+
+Carried-variable analysis: a name is carried through a construct when it
+is read or written inside it AND is a local of the enclosing function
+(args + anything stored anywhere in the function). Globals/builtins
+(modules, `len`, ...) resolve through the function's globals and are
+never carried. Names possibly unbound before a converted construct are
+bound to the `UNDEF` sentinel first (`x = locals().get('x', UNDEF)`),
+and under tracing UNDEF inputs are replaced by shape-matched zeros once
+the branch/body output structure is known (via jax.eval_shape).
+
+`while/for ... else` converts via the break-flag's complement; `return`
+inside a converted loop body is supported via the ret flag. Known limits
+(each raises a typed UnimplementedError with the manual routing hint,
+reference program_translator's error_data analog): loop-carried
+variables that change shape/dtype across iterations are not expressible
+in XLA; stores to `global`/`nonlocal` names inside converted blocks.
+Closure values are snapshotted at conversion time (later rebinding of a
+closed-over name is invisible); an unbound forward-referenced closure
+falls back to trace-only conversion with a warning.
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import logging
+import textwrap
+
+import jax
+import jax.numpy as jnp
+
+from ..core.enforce import UnimplementedError
+from ..core.tensor import Tensor
+
+logger = logging.getLogger("paddle_tpu.jit")
+
+_HINT = ("rewrite this construct with paddle_tpu.static.cond / "
+         "static.while_loop / lax-compatible code, or move it out of the "
+         "@to_static region")
+
+
+class _Undef:
+    """Sentinel for 'variable not bound yet' in carried tuples. Loud on
+    accidental use: common operations raise a NameError-style message."""
+
+    _err = ("variable used before assignment inside a @to_static-"
+            "converted control-flow construct (it was assigned on only "
+            "some paths)")
+
+    def __repr__(self):
+        return "<dy2static undefined>"
+
+    def __bool__(self):
+        raise UnimplementedError(self._err, hint=_HINT)
+
+    def __getattr__(self, name):
+        if name.startswith("__"):
+            raise AttributeError(name)
+        raise UnimplementedError(self._err, hint=_HINT)
+
+    def __iter__(self):
+        raise UnimplementedError(self._err, hint=_HINT)
+
+
+UNDEF = _Undef()
+
+
+def _is_traced(v):
+    if isinstance(v, Tensor):
+        v = v._value
+    return isinstance(v, jax.core.Tracer)
+
+
+def _unwrap(v):
+    if v is UNDEF:
+        return v
+    return v._value if isinstance(v, Tensor) else v
+
+
+def _to_raw(v, name):
+    """Carried value -> jax-compatible leaf (UNDEF passes through; it is
+    substituted or rejected later with the variable's name)."""
+    if v is UNDEF:
+        return v
+    if isinstance(v, Tensor):
+        return v._value
+    try:
+        return jnp.asarray(v)
+    except (TypeError, ValueError):
+        raise UnimplementedError(
+            "variable %r carried through tensor-dependent control flow "
+            "has non-tensor type %s" % (name, type(v).__name__),
+            hint=_HINT)
+
+
+def _rewrap(raw, template):
+    """Raw jax value -> Tensor unless the pre-construct value was a plain
+    Python scalar/bool AND the raw is concrete (keep Python types stable
+    on the un-traced path; on the traced path everything is Tensor)."""
+    if isinstance(raw, _Undef):
+        return UNDEF
+    return Tensor(raw)
+
+
+def truthy(v):
+    if isinstance(v, Tensor):
+        import numpy as np
+
+        return bool(np.asarray(v._value))
+    return bool(v)
+
+
+def _shape_struct(fn, *arg_structs):
+    return jax.eval_shape(fn, *arg_structs)
+
+
+def _struct_of(vals):
+    return [jax.ShapeDtypeStruct(jnp.shape(v), jnp.result_type(v))
+            for v in vals]
+
+
+def _to_raw_or_none(v, name):
+    """Branch/body output leaf: UNDEF (never assigned on this path)
+    becomes None so jax.eval_shape can type the output pytree."""
+    if v is UNDEF:
+        return None
+    return _to_raw(v, name)
+
+
+def _make_runner(branch, carried, names):
+    """(raw-or-UNDEF full slots) -> tuple(raw-or-None full slots)."""
+
+    def run(*vals):
+        wrapped = [_rewrap(v, o) for v, o in zip(vals, carried)]
+        out = branch(*wrapped)
+        return tuple(_to_raw_or_none(v, n)
+                     for v, n in zip(out, _names(names, out)))
+
+    return run
+
+
+def _partial_probe(run, raw):
+    """Close the UNDEF slots over statically so nested converted
+    constructs can resolve their shapes themselves; probe only the
+    defined slots through eval_shape."""
+    undef_pos = {i for i, v in enumerate(raw) if isinstance(v, _Undef)}
+
+    def probe(*defined):
+        it = iter(defined)
+        vals = [UNDEF if i in undef_pos else next(it)
+                for i in range(len(raw))]
+        return run(*vals)
+
+    defined_vals = [v for v in raw if not isinstance(v, _Undef)]
+    return probe, defined_vals
+
+
+def _included_runner(run, raw, included):
+    """Restrict a full-slot runner to the lax-carried (included) slots;
+    excluded slots stay UNDEF statically."""
+    inc = list(included)
+
+    def r(*inc_vals):
+        it = iter(inc_vals)
+        vals = [next(it) if i in inc else UNDEF
+                for i in range(len(raw))]
+        out = run(*vals)
+        return tuple(out[i] for i in inc)
+
+    return r
+
+
+def convert_if(pred, true_fn, false_fn, carried, names=()):
+    """Runtime dispatch for a converted `if`.
+
+    true_fn/false_fn: (carried...) -> tuple(carried...) over Tensors.
+    Concrete pred -> exactly one branch runs (Python semantics).
+    Traced pred  -> jax.lax.cond over the carried tuple.
+    """
+    if isinstance(pred, Tensor) and not _is_traced(pred):
+        import numpy as np
+
+        pred = bool(np.asarray(pred._value))
+    if isinstance(pred, _Undef):
+        pred.__bool__()
+    if not _is_traced(pred):
+        out = true_fn(*carried) if truthy(pred) else false_fn(*carried)
+        return tuple(out)
+
+    nm = _names(names, carried)
+    raw = [_to_raw(v, n) for v, n in zip(carried, nm)]
+    t_run = _make_runner(true_fn, carried, names)
+    f_run = _make_runner(false_fn, carried, names)
+
+    # classify slots, promoting one-sided UNDEF slots (assigned in only
+    # one branch) to dummy zeros so the pass-through branch mirrors the
+    # assigned branch's structure; the interruption-flag guards generated
+    # by the transformer ensure such a dummy is never *read* on the path
+    # that did not assign it (reference ifelse_transformer fills the same
+    # hole with UndefinedVar)
+    work = list(raw)
+    for _ in range(len(raw) + 1):
+        t_probe, defined = _partial_probe(t_run, work)
+        f_probe, _ = _partial_probe(f_run, work)
+        try:
+            t_struct = _shape_struct(t_probe, *_struct_of(defined))
+            f_struct = _shape_struct(f_probe, *_struct_of(defined))
+        except UnimplementedError:
+            raise
+        except Exception as e:
+            raise UnimplementedError(
+                "cannot trace the branches of a tensor-dependent `if` "
+                "(carried variables: %s): %s" % (nm, e), hint=_HINT)
+        promoted = False
+        for i, v in enumerate(work):
+            if isinstance(v, _Undef):
+                a, b = t_struct[i], f_struct[i]
+                s = a if a is not None else b
+                if s is None:
+                    continue  # assigned on neither path: stays UNDEF
+                work[i] = jnp.zeros(s.shape, s.dtype)
+                # only a one-sided promotion changes the pass-through
+                # branch's output structure and needs a re-probe
+                promoted = promoted or (a is None) != (b is None)
+        if not promoted:
+            break
+
+    included, mism = [], []
+    for i, (n, a, b) in enumerate(zip(nm, t_struct, f_struct)):
+        if a is None and b is None:
+            continue  # never assigned on either path: stays UNDEF
+        if (a.shape, a.dtype) != (b.shape, b.dtype):
+            mism.append("%s (%s%s vs %s%s)" % (n, a.dtype, a.shape,
+                                               b.dtype, b.shape))
+        else:
+            included.append(i)
+    if mism:
+        raise UnimplementedError(
+            "branches of a tensor-dependent `if` produce mismatched "
+            "shapes/dtypes for variable(s) %s — XLA conditionals need "
+            "structurally identical branch outputs" % mism, hint=_HINT)
+
+    inputs = [work[i] for i in included]
+    t_inc = _included_runner(t_run, work, included)
+    f_inc = _included_runner(f_run, work, included)
+    p = pred._value if isinstance(pred, Tensor) else pred
+    out = jax.lax.cond(jnp.reshape(p, ()), t_inc, f_inc, *inputs)
+    full = [UNDEF] * len(raw)
+    for j, i in enumerate(included):
+        full[i] = Tensor(out[j])
+    return tuple(full)
+
+
+def _names(names, seq):
+    if names and len(names) == len(seq):
+        return list(names)
+    return ["var%d" % i for i in range(len(seq))]
+
+
+def _coerce_loop_init(raw, out_structs, names, what):
+    """lax.while_loop needs init == body-output structure exactly.
+    UNDEF inits take the body-output structure; shape changes across
+    iterations are not expressible in XLA and raise by name."""
+    init = []
+    for v, out, name in zip(raw, out_structs, names):
+        if isinstance(v, _Undef):
+            init.append(jnp.zeros(out.shape, out.dtype))
+            continue
+        shape = jnp.shape(v)
+        if shape == tuple(out.shape):
+            init.append(jnp.asarray(v, out.dtype))  # weak->strong etc.
+        else:
+            raise UnimplementedError(
+                "loop-carried variable %r changes shape across "
+                "iterations of a tensor-dependent %s (%s -> %s) — XLA "
+                "loops need static shapes" %
+                (name, what, shape, tuple(out.shape)), hint=_HINT)
+    return init
+
+
+def loop_test(stop_flags, test_thunk):
+    """Loop condition with interruption flags: stop when any flag is
+    set; short-circuits the test in Python mode (matching `while` after
+    `break`)."""
+    flags = [f for f in stop_flags if f is not UNDEF]
+    if not any(_is_traced(f) for f in flags):
+        for f in flags:
+            if truthy(f):
+                return False
+        return test_thunk()
+    t = test_thunk()
+    traw = t._value if isinstance(t, Tensor) else jnp.asarray(t)
+    out = jnp.reshape(traw, ()).astype(jnp.bool_)
+    for f in flags:
+        fraw = f._value if isinstance(f, Tensor) else jnp.asarray(f)
+        out = jnp.logical_and(out, jnp.logical_not(
+            jnp.reshape(fraw, ()).astype(jnp.bool_)))
+    return Tensor(out)
+
+
+def convert_while(cond_fn, body_fn, carried, names=()):
+    """Runtime dispatch for a converted `while`.
+
+    cond_fn: (carried...) -> bool/Tensor;  body_fn: (carried...) ->
+    tuple(carried...). Traced test -> jax.lax.while_loop.
+    """
+    first = cond_fn(*carried)
+    if not _is_traced(first):
+        cur = tuple(carried)
+        if truthy(first):
+            cur = tuple(body_fn(*cur))
+            while truthy(cond_fn(*cur)):
+                cur = tuple(body_fn(*cur))
+        return cur
+
+    nm = _names(names, carried)
+    raw = [_to_raw(v, n) for v, n in zip(carried, nm)]
+    body_run = _make_runner(body_fn, carried, names)
+    probe, defined = _partial_probe(body_run, raw)
+    try:
+        out_struct = _shape_struct(probe, *_struct_of(defined))
+    except UnimplementedError:
+        raise
+    except Exception as e:
+        raise UnimplementedError(
+            "cannot trace the body of a tensor-dependent `while` "
+            "(carried variables: %s): %s" % (nm, e), hint=_HINT)
+    one_sided = [n for n, v, o in zip(nm, raw, out_struct)
+                 if o is None and not isinstance(v, _Undef)]
+    if one_sided:  # defined input, None output cannot happen via
+        # pass-through; guard anyway for diagnostics
+        raise UnimplementedError(
+            "loop-carried variable(s) %s lose their value inside a "
+            "tensor-dependent `while`" % one_sided, hint=_HINT)
+    included = [i for i, o in enumerate(out_struct) if o is not None]
+    inc_nm = [nm[i] for i in included]
+    init = _coerce_loop_init([raw[i] for i in included],
+                             [out_struct[i] for i in included],
+                             inc_nm, "while")
+    body_inc = _included_runner(body_run, raw, included)
+    # second pass from the coerced init: dtype promotion must converge
+    out_struct2 = _shape_struct(lambda *v: body_inc(*v),
+                                *_struct_of(init))
+    init = tuple(_coerce_loop_init(init, out_struct2, inc_nm, "while"))
+
+    def cond_inc(state):
+        it = iter(state)
+        vals = [next(it) if i in set(included) else UNDEF
+                for i in range(len(raw))]
+        wrapped = [_rewrap(v, o) for v, o in zip(vals, carried)]
+        r = cond_fn(*wrapped)
+        rraw = r._value if isinstance(r, Tensor) else jnp.asarray(r)
+        return jnp.reshape(rraw, ()).astype(jnp.bool_)
+
+    out = jax.lax.while_loop(cond_inc, lambda s: body_inc(*s), init)
+    full = [UNDEF] * len(raw)
+    for j, i in enumerate(included):
+        full[i] = Tensor(out[j])
+    return tuple(full)
+
+
+def convert_for(iterable, body_fn, carried, stop_idx=(), names=()):
+    """Runtime dispatch for a converted `for`.
+
+    body_fn: (elem, carried...) -> tuple(carried...).
+    stop_idx: indices in `carried` of interruption flags (break/return)
+    that end the loop.
+    Traced iteration domains (Tensor being traced, or range() with a
+    traced bound) lower to jax.lax.while_loop with a counter; everything
+    else runs a plain Python loop (including concrete Tensors, matching
+    eager iteration).
+    """
+    traced_len = False
+    seq = iterable
+    if isinstance(iterable, Tensor):
+        if _is_traced(iterable):
+            traced_len = True
+    elif isinstance(iterable, range):
+        pass
+    elif _is_traced(iterable):
+        iterable = Tensor(iterable)
+        traced_len = True
+    if isinstance(iterable, _RangeProxy):
+        traced_len = iterable.traced
+        if not traced_len:
+            seq = iterable.concrete()
+
+    if not traced_len:
+        cur = tuple(carried)
+        if isinstance(seq, Tensor):
+            import numpy as np
+
+            arr = np.asarray(seq._value)
+            seq = [Tensor(jnp.asarray(arr[i])) for i in range(arr.shape[0])]
+        for elem in seq:
+            cur = tuple(body_fn(elem, *cur))
+            if any(truthy(cur[i]) for i in stop_idx
+                   if cur[i] is not UNDEF):
+                break
+        return cur
+
+    nm = _names(names, carried)
+    raw = [_to_raw(v, n) for v, n in zip(carried, nm)]
+    if isinstance(iterable, _RangeProxy):
+        start, stop, step = iterable.raw()
+        arr = None
+    else:
+        arr = iterable._value
+        start, stop, step = 0, arr.shape[0], 1
+
+    def elem_at(i):
+        if arr is None:
+            return Tensor(start + i * step)
+        return Tensor(jax.lax.dynamic_index_in_dim(arr, i, 0,
+                                                   keepdims=False))
+
+    def full_run(i, *vals):
+        wrapped = [_rewrap(v, o) for v, o in zip(vals, carried)]
+        out = body_fn(elem_at(i), *wrapped)
+        return tuple(_to_raw_or_none(v, n)
+                     for v, n in zip(out, _names(names, out)))
+
+    i0 = jnp.asarray(0)
+    probe, defined = _partial_probe(lambda *v: full_run(i0, *v), raw)
+    try:
+        out_struct = _shape_struct(probe, *_struct_of(defined))
+    except UnimplementedError:
+        raise
+    except Exception as e:
+        raise UnimplementedError(
+            "cannot trace the body of a tensor-dependent `for` "
+            "(carried variables: %s): %s" % (nm, e), hint=_HINT)
+    included = [i for i, o in enumerate(out_struct) if o is not None]
+    inc_set = set(included)
+    inc_nm = [nm[i] for i in included]
+    init_vals = _coerce_loop_init([raw[i] for i in included],
+                                  [out_struct[i] for i in included],
+                                  inc_nm, "for")
+    # map stop flags into the included-state coordinates; a flag slot is
+    # always assigned (prologue False) hence always included
+    stop_inc = [included.index(k) for k in stop_idx if k in inc_set]
+
+    def body_state(state):
+        i, vals = state[0], state[1:]
+        it = iter(vals)
+        full_vals = [next(it) if k in inc_set else UNDEF
+                     for k in range(len(raw))]
+        out_full = full_run(i, *full_vals)
+        return (i + 1,) + tuple(out_full[k] for k in included)
+
+    def cond_state(state):
+        i, vals = state[0], state[1:]
+        if arr is None:
+            more = jnp.where(jnp.asarray(step) > 0,
+                             i * step + start < stop,
+                             i * step + start > stop)
+        else:
+            more = i < stop
+        ok = jnp.reshape(jnp.asarray(more), ()).astype(jnp.bool_)
+        for k in stop_inc:
+            ok = jnp.logical_and(ok, jnp.logical_not(
+                jnp.reshape(jnp.asarray(vals[k]), ()).astype(jnp.bool_)))
+        return ok
+
+    init = (i0,) + tuple(init_vals)
+    out_struct2 = _shape_struct(body_state, _struct_of(init))
+    init = (init[0],) + tuple(
+        _coerce_loop_init(list(init[1:]), list(out_struct2[1:]),
+                          inc_nm, "for"))
+    out = jax.lax.while_loop(cond_state, body_state, init)
+    full = [UNDEF] * len(raw)
+    for j, k in enumerate(included):
+        full[k] = Tensor(out[1 + j])
+    return tuple(full)
+
+
+class _RangeProxy:
+    """range() whose bounds may be Tensors/tracers (reference
+    convert_len/convert_range). Concrete bounds behave like range."""
+
+    def __init__(self, *args):
+        vals = [a._value if isinstance(a, Tensor) else a for a in args]
+        self.traced = any(isinstance(v, jax.core.Tracer) for v in vals)
+        if len(vals) == 1:
+            self.start, self.stop, self.step = 0, vals[0], 1
+        elif len(vals) == 2:
+            self.start, self.stop, self.step = vals[0], vals[1], 1
+        else:
+            self.start, self.stop, self.step = vals
+
+    def concrete(self):
+        import numpy as np
+
+        return range(int(np.asarray(self.start)),
+                     int(np.asarray(self.stop)),
+                     int(np.asarray(self.step)))
+
+    def raw(self):
+        return (jnp.asarray(self.start), jnp.asarray(self.stop),
+                jnp.asarray(self.step))
+
+    def __iter__(self):
+        if self.traced:
+            raise UnimplementedError(
+                "iterating a range() with a traced tensor bound outside "
+                "a converted `for`", hint=_HINT)
+        return iter(self.concrete())
+
+
+def make_range(*args):
+    """`range` replacement inside converted functions: returns a real
+    range for plain ints (zero behavior change) and a proxy when any
+    bound is a Tensor/tracer."""
+    if any(isinstance(a, Tensor)
+           or isinstance(a, jax.core.Tracer) for a in args):
+        return _RangeProxy(*args)
+    return range(*args)
+
+
+def not_(v):
+    if isinstance(v, _Undef):
+        return True  # unset flag == not interrupted
+    if _is_traced(v):
+        raw = v._value if isinstance(v, Tensor) else v
+        return Tensor(jnp.logical_not(jnp.reshape(raw, ()).astype(
+            jnp.bool_)))
+    return not truthy(v)
+
+
+def no_interrupt(*flags):
+    """True when no interruption flag is set; tensor-aware AND."""
+    out = True
+    for f in flags:
+        nf = not_(f)
+        if isinstance(nf, Tensor):
+            if out is True:
+                out = nf
+            else:
+                oraw = out._value if isinstance(out, Tensor) else \
+                    jnp.asarray(out)
+                out = Tensor(jnp.logical_and(
+                    jnp.reshape(oraw, ()), nf._value))
+        else:
+            if not nf:
+                return False
+    return out
+
+
+def finalize_return(flag, val):
+    del flag
+    if val is UNDEF:
+        return None
+    return val
+
+
+def bind_or_undef(local_map, name):
+    return local_map.get(name, UNDEF)
+
+
+# ---------------------------------------------------------------------------
+# AST transformer (reference dy2static/*_transformer.py pipeline)
+# ---------------------------------------------------------------------------
+
+
+def _collect_fn_locals(fdef):
+    """Names local to `fdef`: parameters + every name stored anywhere in
+    its body, excluding the interiors of nested function/class scopes
+    (whose def/class *name* is still an outer store). `global`/`nonlocal`
+    declarations are returned separately (conversion refuses to carry
+    them)."""
+    locs, nonlocs = set(), set()
+    a = fdef.args
+    for arg in (list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)
+                + ([a.vararg] if a.vararg else [])
+                + ([a.kwarg] if a.kwarg else [])):
+        locs.add(arg.arg)
+
+    def walk(node, top=False):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)) and not top:
+            locs.add(node.name)
+            return
+        if isinstance(node, ast.Lambda) and not top:
+            return
+        if isinstance(node, (ast.Global, ast.Nonlocal)):
+            nonlocs.update(node.names)
+            return
+        if isinstance(node, ast.Name) and isinstance(node.ctx,
+                                                     (ast.Store, ast.Del)):
+            locs.add(node.id)
+        if isinstance(node, ast.ExceptHandler) and node.name:
+            locs.add(node.name)
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            for al in node.names:
+                locs.add((al.asname or al.name).split(".")[0])
+        for ch in ast.iter_child_nodes(node):
+            walk(ch)
+
+    walk(fdef, top=True)
+    return locs - nonlocs, nonlocs
+
+
+def _names_used(nodes):
+    """All Name identifiers loaded or stored in `nodes`, recursing into
+    nested scopes too (conservative superset — extra carried names are
+    harmless)."""
+    loads, stores = set(), set()
+    for node in nodes:
+        for n in ast.walk(node):
+            if isinstance(n, ast.Name):
+                if isinstance(n.ctx, ast.Load):
+                    loads.add(n.id)
+                else:
+                    stores.add(n.id)
+            elif isinstance(n, ast.ExceptHandler) and n.name:
+                stores.add(n.name)
+    return loads, stores
+
+
+def _contains(node_or_list, kinds, stop_at_loops=False):
+    """Does the statement (or list) contain any node of `kinds`,
+    excluding nested function/class scopes — and, for break/continue
+    (stop_at_loops), excluding nested loops they would bind to? The
+    search starts *around* the given node(s): a loop passed in directly
+    counts as a nested loop for its own breaks."""
+    nodes = node_or_list if isinstance(node_or_list, list) \
+        else [node_or_list]
+    root = ast.Module(body=list(nodes), type_ignores=[])
+    found = []
+
+    def walk(n):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef, ast.Lambda)) and n is not root:
+            return
+        if stop_at_loops and isinstance(n, (ast.For, ast.While)):
+            # breaks/continues inside bind to that inner loop; its
+            # orelse block still binds outward
+            for ch in n.orelse:
+                walk(ch)
+            return
+        if isinstance(n, kinds):
+            found.append(n)
+        for ch in ast.iter_child_nodes(n):
+            walk(ch)
+
+    walk(root)
+    return bool(found)
+
+
+class _RangeRewriter(ast.NodeTransformer):
+    """`range(...)` -> `_dy2st.make_range(...)` when `range` is not
+    shadowed by a function local (reference convert_range)."""
+
+    def __init__(self, fn_locals):
+        self.fn_locals = fn_locals
+
+    def visit_Call(self, node):
+        self.generic_visit(node)
+        if (isinstance(node.func, ast.Name) and node.func.id == "range"
+                and isinstance(node.func.ctx, ast.Load)
+                and "range" not in self.fn_locals):
+            node.func = ast.Attribute(
+                value=ast.Name(id="_dy2st", ctx=ast.Load()),
+                attr="make_range", ctx=ast.Load())
+        return node
+
+
+def _name(id_, store=False):
+    return ast.Name(id=id_, ctx=ast.Store() if store else ast.Load())
+
+
+def _attr(obj, attr):
+    return ast.Attribute(value=_name(obj), attr=attr, ctx=ast.Load())
+
+
+def _call(fn_expr, args, keywords=()):
+    return ast.Call(func=fn_expr, args=list(args),
+                    keywords=list(keywords))
+
+
+def _const(v):
+    return ast.Constant(value=v)
+
+
+def _assign(target_name, value_expr):
+    return ast.Assign(targets=[_name(target_name, store=True)],
+                      value=value_expr)
+
+
+def _tuple_expr(names, store=False):
+    return ast.Tuple(elts=[_name(n, store=store) for n in names],
+                     ctx=ast.Store() if store else ast.Load())
+
+
+class _Converter:
+    """Statement-level conversion over a single function body."""
+
+    RET_FLAG = "_dy2st_ret_flag"
+    RET_VAL = "_dy2st_ret_val"
+
+    def __init__(self, fn_locals, nonlocals=()):
+        self.locals = set(fn_locals)
+        self.nonlocals = set(nonlocals)
+        self.n = 0
+        self.ret_active = False
+
+    def _check_nonlocal_stores(self, *stmt_lists):
+        """Stores to global/nonlocal names inside a converted construct
+        would silently bind a throwaway local in the extracted branch
+        function — refuse loudly instead."""
+        if not self.nonlocals:
+            return
+        _, stores = _names_used([s for sl in stmt_lists for s in sl])
+        bad = sorted(stores & self.nonlocals)
+        if bad:
+            raise UnimplementedError(
+                "assignment to global/nonlocal name(s) %s inside a "
+                "control-flow construct converted by @to_static — the "
+                "extracted branch function cannot rebind the outer "
+                "name" % bad, hint=_HINT)
+
+    def fresh(self, stem):
+        self.n += 1
+        return "_dy2st_%s_%d" % (stem, self.n)
+
+    # -- interruption-flag queries ----------------------------------------
+
+    def _flags_set_by(self, st, loop_ctx):
+        flags = []
+        if self.ret_active and _contains(st, (ast.Return,)):
+            flags.append(self.RET_FLAG)
+        if loop_ctx is not None:
+            if _contains(st, (ast.Break,), stop_at_loops=True):
+                flags.append(loop_ctx[0])
+            if loop_ctx[1] and _contains(st, (ast.Continue,),
+                                         stop_at_loops=True):
+                flags.append(loop_ctx[1])
+        return flags
+
+    # -- function entry ----------------------------------------------------
+
+    def convert_function(self, fdef):
+        # the return transform is needed unless every return is a plain
+        # top-level statement (in which case the flag machinery would be
+        # pure overhead): a return nested inside any compound statement
+        # may become conditional once that statement is converted
+        self.ret_active = any(
+            _contains(st, (ast.Return,)) and not isinstance(st, ast.Return)
+            for st in fdef.body)
+        body = self.convert_block(fdef.body, loop_ctx=None)
+        if self.ret_active:
+            prologue = [
+                _assign(self.RET_VAL, _attr("_dy2st", "UNDEF")),
+                _assign(self.RET_FLAG, _const(False)),
+            ]
+            body = prologue + body + [ast.Return(value=_call(
+                _attr("_dy2st", "finalize_return"),
+                [_name(self.RET_FLAG), _name(self.RET_VAL)]))]
+        fdef.body = body
+        fdef.decorator_list = []
+        return fdef
+
+    # -- blocks ------------------------------------------------------------
+
+    def convert_block(self, stmts, loop_ctx):
+        out = []
+        for i, st in enumerate(stmts):
+            new, may_int = self.convert_stmt(st, loop_ctx)
+            out.extend(new)
+            rest = stmts[i + 1:]
+            if may_int and rest:
+                flags = self._flags_set_by(st, loop_ctx)
+                rest_c = self.convert_block(rest, loop_ctx)
+                if flags:
+                    test = _call(_attr("_dy2st", "no_interrupt"),
+                                 [_name(f) for f in flags])
+                    out.extend(self._emit_if(test, rest_c, []))
+                else:
+                    out.extend(rest_c)
+                return out
+        return out
+
+    def convert_stmt(self, st, loop_ctx):
+        if isinstance(st, ast.If):
+            may = bool(self._flags_set_by(st, loop_ctx))
+            body_c = self.convert_block(st.body, loop_ctx)
+            orelse_c = self.convert_block(st.orelse, loop_ctx)
+            return self._emit_if(st.test, body_c, orelse_c), may
+        if isinstance(st, ast.While):
+            return self._emit_while(st, loop_ctx)
+        if isinstance(st, ast.For):
+            return self._emit_for(st, loop_ctx)
+        if isinstance(st, ast.Return):
+            if not self.ret_active:
+                return [st], False
+            val = st.value if st.value is not None else _const(None)
+            return [_assign(self.RET_VAL, val),
+                    _assign(self.RET_FLAG, _const(True))], True
+        if isinstance(st, ast.Break):
+            if loop_ctx is None:
+                return [st], False
+            return [_assign(loop_ctx[0], _const(True))], True
+        if isinstance(st, ast.Continue):
+            if loop_ctx is None or not loop_ctx[1]:
+                return [st], False
+            return [_assign(loop_ctx[1], _const(True))], True
+        if isinstance(st, (ast.Global, ast.Nonlocal)):
+            return [st], False
+        if isinstance(st, ast.With):
+            may = bool(self._flags_set_by(st, loop_ctx))
+            st.body = self.convert_block(st.body, loop_ctx)
+            return [st], may
+        if isinstance(st, ast.Try):
+            may = bool(self._flags_set_by(st, loop_ctx))
+            st.body = self.convert_block(st.body, loop_ctx)
+            for h in st.handlers:
+                h.body = self.convert_block(h.body, loop_ctx)
+            st.orelse = self.convert_block(st.orelse, loop_ctx)
+            st.finalbody = self.convert_block(st.finalbody, loop_ctx)
+            return [st], may
+        return [st], False
+
+    # -- carried-variable plumbing -----------------------------------------
+
+    def _carried(self, *stmt_lists):
+        loads, stores = set(), set()
+        for sl in stmt_lists:
+            ld, stt = _names_used(sl)
+            loads |= ld
+            stores |= stt
+        names = sorted(((loads | stores) & self.locals) | (
+            stores & {n for n in stores if n.startswith("_dy2st_")}))
+        self.locals.update(n for n in stores
+                           if n.startswith("_dy2st_"))
+        return names
+
+    def _binds(self, names):
+        return [ast.Assign(
+            targets=[_name(n, store=True)],
+            value=_call(_attr("_dy2st", "bind_or_undef"),
+                        [_call(_name("locals"), []), _const(n)]))
+            for n in names]
+
+    def _branch_def(self, fname, carried, body_stmts, extra_arg=None):
+        args = ([ast.arg(arg=extra_arg)] if extra_arg else []) + \
+            [ast.arg(arg=n) for n in carried]
+        body = list(body_stmts) + [ast.Return(
+            value=_tuple_expr(carried))]
+        return ast.FunctionDef(
+            name=fname,
+            args=ast.arguments(posonlyargs=[], args=args, vararg=None,
+                               kwonlyargs=[], kw_defaults=[], kwarg=None,
+                               defaults=[]),
+            body=body, decorator_list=[], returns=None)
+
+    def _result_assign(self, carried, call_expr):
+        if not carried:
+            return [_assign(self.fresh("void"), call_expr)]
+        return [ast.Assign(targets=[_tuple_expr(carried, store=True)],
+                           value=call_expr)]
+
+    def _names_kw(self, carried):
+        return ast.keyword(arg="names", value=ast.Tuple(
+            elts=[_const(n) for n in carried], ctx=ast.Load()))
+
+    # -- emitters ----------------------------------------------------------
+
+    def _emit_if(self, test, body_c, orelse_c):
+        self._check_nonlocal_stores(body_c, orelse_c)
+        carried = self._carried(body_c, orelse_c)
+        tname, fname = self.fresh("true"), self.fresh("false")
+        stmts = [self._branch_def(tname, carried, body_c or [ast.Pass()]),
+                 self._branch_def(fname, carried,
+                                  orelse_c or [ast.Pass()])]
+        stmts += self._binds(carried)
+        call = _call(_attr("_dy2st", "convert_if"),
+                     [test, _name(tname), _name(fname),
+                      _tuple_expr(carried)],
+                     [self._names_kw(carried)])
+        return stmts + self._result_assign(carried, call)
+
+    def _emit_while(self, st, loop_ctx):
+        brk = self.fresh("brk") if _contains(
+            st.body, (ast.Break,), stop_at_loops=True) else None
+        cont = self.fresh("cont") if _contains(
+            st.body, (ast.Continue,), stop_at_loops=True) else None
+        inner_ctx = (brk or self.fresh("brk_unused"), cont)
+        body_c = self.convert_block(st.body, inner_ctx)
+        if cont:
+            body_c = [_assign(cont, _const(False))] + body_c
+        stop_flags = [f for f in (brk, self.RET_FLAG
+                                  if self.ret_active and _contains(
+                                      st.body, (ast.Return,)) else None)
+                      if f]
+        pre = [_assign(brk, _const(False))] if brk else []
+        self._check_nonlocal_stores(body_c)
+        carried = self._carried([st.test], body_c)
+        cname, bname = self.fresh("cond"), self.fresh("body")
+        test_lambda = ast.Lambda(
+            args=ast.arguments(posonlyargs=[], args=[], vararg=None,
+                               kwonlyargs=[], kw_defaults=[], kwarg=None,
+                               defaults=[]),
+            body=st.test)
+        cond_body = [ast.Return(value=_call(
+            _attr("_dy2st", "loop_test"),
+            [ast.Tuple(elts=[_name(f) for f in stop_flags],
+                       ctx=ast.Load()), test_lambda]))]
+        cond_def = ast.FunctionDef(
+            name=cname,
+            args=ast.arguments(posonlyargs=[],
+                               args=[ast.arg(arg=n) for n in carried],
+                               vararg=None, kwonlyargs=[],
+                               kw_defaults=[], kwarg=None, defaults=[]),
+            body=cond_body, decorator_list=[], returns=None)
+        body_def = self._branch_def(bname, carried,
+                                    body_c or [ast.Pass()])
+        call = _call(_attr("_dy2st", "convert_while"),
+                     [_name(cname), _name(bname), _tuple_expr(carried)],
+                     [self._names_kw(carried)])
+        stmts = pre + [cond_def, body_def] + self._binds(carried) + \
+            self._result_assign(carried, call)
+        stmts += self._emit_loop_orelse(st, brk, loop_ctx)
+        may = self.ret_active and _contains(st, (ast.Return,))
+        return stmts, may
+
+    def _emit_loop_orelse(self, st, brk, loop_ctx):
+        """`while/for ... else` runs the else block iff the loop was not
+        broken — exactly the break-flag's complement, so it converts to
+        a (possibly tensor-dependent) guarded block."""
+        if not st.orelse:
+            return []
+        orelse_c = self.convert_block(st.orelse, loop_ctx)
+        if brk is None:
+            return orelse_c  # no break in the loop: else always runs
+        test = _call(_attr("_dy2st", "no_interrupt"), [_name(brk)])
+        return self._emit_if(test, orelse_c, [])
+
+    def _emit_for(self, st, loop_ctx):
+        brk = self.fresh("brk") if _contains(
+            st.body, (ast.Break,), stop_at_loops=True) else None
+        cont = self.fresh("cont") if _contains(
+            st.body, (ast.Continue,), stop_at_loops=True) else None
+        inner_ctx = (brk or self.fresh("brk_unused"), cont)
+        body_c = self.convert_block(st.body, inner_ctx)
+        if cont:
+            body_c = [_assign(cont, _const(False))] + body_c
+        pre = [_assign(brk, _const(False))] if brk else []
+        elem = self.fresh("elem")
+        target_assign = ast.Assign(targets=[st.target],
+                                   value=_name(elem))
+        body_full = [target_assign] + body_c
+        self._check_nonlocal_stores(body_full)
+        carried = self._carried([st.target], body_full)
+        bname = self.fresh("body")
+        body_def = self._branch_def(bname, carried, body_full,
+                                    extra_arg=elem)
+        stop_names = [f for f in (
+            brk, self.RET_FLAG if self.ret_active and _contains(
+                st.body, (ast.Return,)) else None) if f]
+        stop_idx = ast.Tuple(
+            elts=[_const(carried.index(f)) for f in stop_names
+                  if f in carried], ctx=ast.Load())
+        call = _call(_attr("_dy2st", "convert_for"),
+                     [st.iter, _name(bname), _tuple_expr(carried)],
+                     [ast.keyword(arg="stop_idx", value=stop_idx),
+                      self._names_kw(carried)])
+        stmts = pre + [body_def] + self._binds(carried) + \
+            self._result_assign(carried, call)
+        stmts += self._emit_loop_orelse(st, brk, loop_ctx)
+        may = self.ret_active and _contains(st, (ast.Return,))
+        return stmts, may
+
+
+def convert_control_flow(fn):
+    """Return `fn` rewritten so tensor-dependent if/while/for lower to
+    XLA control flow (see module docstring). Functions without
+    control-flow statements, or whose source is unavailable, are
+    returned unchanged."""
+    instance = None
+    if inspect.ismethod(fn):
+        instance, fn = fn.__self__, fn.__func__
+    if getattr(fn, "_dy2st_converted", False) or \
+            getattr(fn, "_not_to_static", False):
+        return fn.__get__(instance) if instance is not None else fn
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError, IndentationError):
+        return fn.__get__(instance) if instance is not None else fn
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef,)):
+        return fn.__get__(instance) if instance is not None else fn
+    has_cf = any(isinstance(n, (ast.If, ast.While, ast.For))
+                 for n in ast.walk(fdef))
+    if not has_cf:
+        return fn.__get__(instance) if instance is not None else fn
+
+    fn_locals, nonlocs = _collect_fn_locals(fdef)
+    fdef = _RangeRewriter(fn_locals).visit(fdef)
+    conv = _Converter(fn_locals, nonlocals=nonlocs)
+    freevars = list(fn.__code__.co_freevars)
+    cells = []
+    if fn.__closure__:
+        try:
+            cells = [c.cell_contents for c in fn.__closure__]
+        except ValueError:
+            # an empty cell (forward reference to a sibling defined
+            # later): conversion cannot snapshot the closure safely —
+            # fall back to trace-only rather than crash at decoration
+            logger.warning(
+                "dy2static: %s closes over a not-yet-bound name; "
+                "falling back to trace-only conversion", fn.__name__)
+            return fn.__get__(instance) if instance is not None else fn
+    factory_name = "__dy2st_factory__"
+    try:
+        fdef = conv.convert_function(fdef)
+        # Wrap in a factory so the converted function (a) resolves
+        # free variables through factory parameters (closure) and
+        # (b) keeps the LIVE module dict as its globals — names defined
+        # later in the module (late binding) still resolve.
+        factory = ast.FunctionDef(
+            name=factory_name,
+            args=ast.arguments(
+                posonlyargs=[],
+                args=[ast.arg(arg="_dy2st")]
+                + [ast.arg(arg=n) for n in freevars],
+                vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+                defaults=[]),
+            body=[fdef, ast.Return(value=_name(fdef.name))],
+            decorator_list=[], returns=None)
+        module = ast.Module(body=[factory], type_ignores=[])
+        ast.fix_missing_locations(module)
+        code = compile(module, filename="<dy2static:%s>" % fn.__name__,
+                       mode="exec")
+    except UnimplementedError:
+        raise
+    except Exception as e:  # noqa: BLE001 — conversion must never brick
+        logger.warning("dy2static: conversion of %s failed (%s); "
+                       "falling back to trace-only", fn.__name__, e)
+        return fn.__get__(instance) if instance is not None else fn
+
+    from . import dy2static as _self
+
+    g = fn.__globals__
+    had = factory_name in g
+    prev = g.get(factory_name)
+    exec(code, g)  # noqa: S102 — compiling the user's own source
+    factory_fn = g.pop(factory_name)
+    if had:
+        g[factory_name] = prev
+    new_fn = factory_fn(_self, *cells)
+    new_fn._dy2st_converted = True
+    new_fn.__wrapped__ = fn
+    functools.update_wrapper(new_fn, fn, updated=())
+    new_fn._dy2st_converted = True
+    if instance is not None:
+        return new_fn.__get__(instance)
+    return new_fn

@@ -1,0 +1,581 @@
+"""dy2static AST control-flow conversion (paddle_tpu/jit/dy2static.py).
+
+Ports the reference dygraph_to_static suite's core patterns
+(/root/reference/python/paddle/fluid/tests/unittests/dygraph_to_static/
+test_ifelse.py, test_loop.py, test_break_continue.py, test_return.py):
+each case asserts dygraph (eager) == to_static numerics, the contract the
+reference enforces via ProgramTranslator. Error cases pin the typed
+UnimplementedError with a routing hint for the genuinely unconvertible.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import jit
+from paddle_tpu.core.enforce import UnimplementedError
+
+
+def check_parity(fn, *inputs):
+    """dygraph == to_static on the same inputs (reference
+    test_ifelse.py::TestDygraphIfElse.._run(to_static=bool) pattern)."""
+    static_fn = jit.to_static(fn)
+    outs_s = static_fn(*[paddle.to_tensor(i) for i in inputs])
+    outs_d = fn(*[paddle.to_tensor(i) for i in inputs])
+    flat_s = outs_s if isinstance(outs_s, (tuple, list)) else [outs_s]
+    flat_d = outs_d if isinstance(outs_d, (tuple, list)) else [outs_d]
+    for s, d in zip(flat_s, flat_d):
+        np.testing.assert_allclose(np.asarray(s.numpy()),
+                                   np.asarray(d.numpy()), rtol=1e-5)
+    return outs_s
+
+
+class TestIfElse:
+    """reference test_ifelse.py dyfunc_with_if_else* family."""
+
+    def test_simple_if_else(self):
+        def fn(x):
+            if x.mean() > 0:
+                y = x - 1.0
+            else:
+                y = x + 1.0
+            return y
+
+        check_parity(fn, np.array([1.0, 2.0], np.float32))
+        check_parity(fn, np.array([-1.0, -2.0], np.float32))
+
+    def test_if_without_else(self):
+        def fn(x):
+            y = x * 2.0
+            if x.sum() > 3.0:
+                y = y + 10.0
+            return y
+
+        check_parity(fn, np.array([1.0, 1.0], np.float32))
+        check_parity(fn, np.array([2.0, 3.0], np.float32))
+
+    def test_nested_if(self):
+        """reference test_ifelse.py dyfunc_with_if_else3 (nested)."""
+
+        def fn(x):
+            if x.sum() > 0:
+                if x.mean() > 1.0:
+                    y = x * 3.0
+                else:
+                    y = x * 2.0
+            else:
+                y = -x
+            return y
+
+        for v in ([2.0, 2.0], [0.5, 0.5], [-1.0, -1.0]):
+            check_parity(fn, np.array(v, np.float32))
+
+    def test_if_new_var_in_both_branches(self):
+        """variable first bound inside the if (UNDEF-substitution path)."""
+
+        def fn(x):
+            if x.mean() > 0:
+                out = x * 2.0
+            else:
+                out = x * -3.0
+            return out + 1.0
+
+        check_parity(fn, np.array([1.0], np.float32))
+        check_parity(fn, np.array([-1.0], np.float32))
+
+    def test_elif_chain(self):
+        def fn(x):
+            if x.mean() > 1.0:
+                y = x + 100.0
+            elif x.mean() > 0.0:
+                y = x + 10.0
+            else:
+                y = x + 1.0
+            return y
+
+        for v in (2.0, 0.5, -1.0):
+            check_parity(fn, np.array([v], np.float32))
+
+    def test_python_bool_if_stays_python(self):
+        """non-tensor predicates keep plain-Python semantics (runtime
+        dispatch falls through; reference converts only Tensor preds)."""
+        side = []
+
+        def fn(x, flag=True):
+            if flag:
+                side.append(1)
+                y = x + 1.0
+            else:
+                y = x - 1.0
+            return y
+
+        static_fn = jit.to_static(fn)
+        out = static_fn(paddle.to_tensor(np.array([1.0], np.float32)))
+        np.testing.assert_allclose(np.asarray(out.numpy()), [2.0])
+        assert side  # only the taken branch ran
+
+    def test_early_return_in_if(self):
+        """reference test_return.py test_return_if pattern."""
+
+        def fn(x):
+            if x.mean() > 0:
+                return x - 1.0
+            return x + 1.0
+
+        check_parity(fn, np.array([1.0, 2.0], np.float32))
+        check_parity(fn, np.array([-1.0, -2.0], np.float32))
+
+    def test_return_in_both_branches(self):
+        def fn(x):
+            if x.sum() > 0:
+                return x * 2.0
+            else:
+                return x * 3.0
+
+        check_parity(fn, np.array([1.0], np.float32))
+        check_parity(fn, np.array([-1.0], np.float32))
+
+
+class TestLoops:
+    """reference test_loop.py while_loop_dyfunc / for patterns."""
+
+    def test_while_tensor_cond(self):
+        def fn(x):
+            i = paddle.to_tensor(np.float32(0.0))
+            while i < x.sum():
+                i = i + 1.0
+            return i
+
+        out = check_parity(fn, np.array([2.5, 1.0], np.float32))
+        assert float(out.numpy()) == 4.0
+
+    def test_while_accumulate(self):
+        """reference test_loop.py while_loop_dyfunc_with_body."""
+
+        def fn(x):
+            s = x * 0.0
+            i = paddle.to_tensor(np.float32(0.0))
+            while i < 5.0:
+                s = s + x * i
+                i = i + 1.0
+            return s
+
+        check_parity(fn, np.array([1.0, 2.0], np.float32))
+
+    def test_while_break(self):
+        """reference test_break_continue.py test_break_in_while."""
+
+        def fn(x):
+            i = paddle.to_tensor(np.float32(0.0))
+            while i < 100.0:
+                if i > x.sum():
+                    break
+                i = i + 1.0
+            return i
+
+        out = check_parity(fn, np.array([2.5, 1.0], np.float32))
+        assert float(out.numpy()) == 4.0
+
+    def test_while_continue(self):
+        """reference test_break_continue.py test_continue_in_while:
+        sum of odd i in [0, 10)."""
+
+        def fn(x):
+            i = paddle.to_tensor(np.float32(0.0))
+            s = x * 0.0
+            while i < 10.0:
+                i = i + 1.0
+                if paddle.floor(i / 2.0) * 2.0 == i:
+                    continue
+                s = s + i
+            return s
+
+        check_parity(fn, np.array([0.0], np.float32))
+
+    def test_for_over_tensor(self):
+        """reference test_loop.py for_iter_var (for x in tensor)."""
+
+        def fn(x):
+            s = paddle.to_tensor(np.float32(0.0))
+            for row in x:
+                s = s + row.sum()
+            return s
+
+        out = check_parity(fn,
+                           np.arange(6, dtype=np.float32).reshape(3, 2))
+        assert float(out.numpy()) == 15.0
+
+    def test_for_range_static_bound_unrolls(self):
+        """for i in range(python_int): plain Python iteration (the
+        reference also keeps non-tensor ranges un-converted)."""
+
+        def fn(x):
+            for i in range(3):
+                x = x + float(i)
+            return x
+
+        check_parity(fn, np.array([0.0], np.float32))
+
+    def test_for_break(self):
+        """reference test_break_continue.py test_break_in_for."""
+
+        def fn(x):
+            s = paddle.to_tensor(np.float32(0.0))
+            for row in x:
+                if s > 4.0:
+                    break
+                s = s + row.sum()
+            return s
+
+        check_parity(fn, np.arange(8, dtype=np.float32).reshape(4, 2))
+
+    def test_nested_loop(self):
+        """reference test_loop.py nested while/for."""
+
+        def fn(x):
+            total = paddle.to_tensor(np.float32(0.0))
+            i = paddle.to_tensor(np.float32(0.0))
+            while i < 3.0:
+                for row in x:
+                    total = total + row.sum() * (i + 1.0)
+                i = i + 1.0
+            return total
+
+        check_parity(fn, np.arange(4, dtype=np.float32).reshape(2, 2))
+
+    def test_return_inside_while(self):
+        """reference test_return.py return in loop body."""
+
+        def fn(x):
+            i = paddle.to_tensor(np.float32(0.0))
+            while i < 100.0:
+                if i * i > x.sum():
+                    return i
+                i = i + 1.0
+            return i
+
+        out = check_parity(fn, np.array([5.0, 5.0], np.float32))
+        assert float(out.numpy()) == 4.0  # 4*4 > 10
+
+
+class TestLayerIntegration:
+    def test_layer_forward_with_control_flow(self):
+        """@to_static on a Layer whose forward branches on its input
+        (reference test_ifelse.py NetWithControlFlowIf)."""
+        import paddle_tpu.nn as nn
+
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 4)
+
+            def forward(self, x):
+                h = self.fc(x)
+                if h.mean() > 0:
+                    out = h * 2.0
+                else:
+                    out = h - 1.0
+                return out
+
+        paddle.seed(0)
+        net_d = Net()
+        paddle.seed(0)
+        net_s = jit.to_static(Net())
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        np.testing.assert_allclose(np.asarray(net_s(x).numpy()),
+                                   np.asarray(net_d(x).numpy()),
+                                   rtol=1e-5)
+
+    def test_to_static_layer_trains(self):
+        """Training through a @to_static Layer must flow gradients (the
+        jitted inference trace is no_grad; a training pass routes
+        through the eager tape) — regression: loss was frozen."""
+        import paddle_tpu.nn as nn
+        import paddle_tpu.nn.functional as F
+
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 2)
+
+            def forward(self, x):
+                h = self.fc(x)
+                if h.mean() > 100.0:  # never taken, but converted
+                    h = h * 2.0
+                return h
+
+        paddle.seed(0)
+        net = jit.to_static(Net())
+        opt = paddle.optimizer.SGD(learning_rate=0.5,
+                                   parameters=net.parameters())
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.rand(8, 4).astype(np.float32))
+        y = paddle.to_tensor(rng.randint(0, 2, (8,)).astype(np.int64))
+        losses = []
+        for _ in range(5):
+            loss = F.cross_entropy(net(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0], losses
+
+    def test_enable_to_static_toggle(self):
+        """jit.enable_to_static(False) runs the decorated fn eagerly
+        (reference ProgramTranslator.enable)."""
+
+        @jit.to_static
+        def fn(x):
+            if x.mean() > 0:
+                return x * 2.0
+            return x * 3.0
+
+        x = paddle.to_tensor(np.array([1.0], np.float32))
+        try:
+            jit.enable_to_static(False)
+            out_eager = fn(x)
+        finally:
+            jit.enable_to_static(True)
+        out_static = fn(x)
+        np.testing.assert_allclose(np.asarray(out_eager.numpy()),
+                                   np.asarray(out_static.numpy()))
+
+
+class TestTypedErrors:
+    def test_branch_shape_mismatch_raises_typed(self):
+        @jit.to_static
+        def fn(x):
+            if x.mean() > 0:
+                y = paddle.concat([x, x])
+            else:
+                y = x
+            return y
+
+        with pytest.raises(UnimplementedError) as ei:
+            fn(paddle.to_tensor(np.array([1.0], np.float32)))
+        assert "mismatched" in str(ei.value)
+        assert "static.cond" in str(ei.value) or "static" in str(
+            ei.value.hint if hasattr(ei.value, "hint") else ei.value)
+
+    def test_while_else_converts(self):
+        """while...else now converts (else runs iff not broken)."""
+
+        def fn(x):
+            i = paddle.to_tensor(np.float32(0.0))
+            while i < x.sum():
+                i = i + 1.0
+            else:
+                i = i + 100.0
+            return i
+
+        out = check_parity(fn, np.array([2.0], np.float32))
+        assert float(out.numpy()) == 102.0
+
+    def test_shape_growing_loop_raises_typed(self):
+        def fn(x):
+            i = paddle.to_tensor(np.float32(0.0))
+            y = x
+            while i < x.sum():
+                y = paddle.concat([y, x])
+                i = i + 1.0
+            return y
+
+        with pytest.raises(UnimplementedError) as ei:
+            jit.to_static(fn)(paddle.to_tensor(
+                np.array([2.0], np.float32)))
+        assert "shape" in str(ei.value)
+
+
+class TestConversionMachinery:
+    def test_unconverted_functions_pass_through(self):
+        """no control flow -> original function object semantics."""
+
+        @jit.to_static
+        def fn(x):
+            return x * 2.0
+
+        out = fn(paddle.to_tensor(np.array([3.0], np.float32)))
+        np.testing.assert_allclose(np.asarray(out.numpy()), [6.0])
+
+    def test_grad_flows_through_converted_if(self):
+        """autograd through lax.cond: d/dx picks the taken branch."""
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.jit.dy2static import convert_control_flow
+        from paddle_tpu.core.tensor import Tensor
+
+        def fn(x):
+            if x.sum() > 0:
+                y = x * 2.0
+            else:
+                y = x * 3.0
+            return y
+
+        conv = convert_control_flow(fn)
+
+        def loss(v):
+            return jnp.sum(conv(Tensor(v))._value)
+
+        g_pos = jax.grad(loss)(jnp.array([1.0], jnp.float32))
+        g_neg = jax.grad(loss)(jnp.array([-1.0], jnp.float32))
+        np.testing.assert_allclose(np.asarray(g_pos), [2.0])
+        np.testing.assert_allclose(np.asarray(g_neg), [3.0])
+
+
+class TestReviewRegressions:
+    """Cases pinned after round-4 code review."""
+
+    def test_for_else_runs_unless_broken(self):
+        """for...else converts via the break-flag's complement."""
+
+        def fn(x):
+            s = paddle.to_tensor(np.float32(0.0))
+            for row in x:
+                if s > 100.0:
+                    break
+                s = s + row.sum()
+            else:
+                s = s + 1000.0  # not broken: else runs
+            return s
+
+        out = check_parity(fn,
+                           np.arange(4, dtype=np.float32).reshape(2, 2))
+        assert float(out.numpy()) == 1006.0
+
+        def fn2(x):
+            s = paddle.to_tensor(np.float32(0.0))
+            for row in x:
+                if s > 0.5:
+                    break
+                s = s + row.sum()
+            else:
+                s = s + 1000.0  # broken: else must NOT run
+            return s
+
+        out2 = check_parity(fn2,
+                            np.arange(4, dtype=np.float32).reshape(2, 2))
+        assert float(out2.numpy()) == 1.0
+
+    def test_plain_python_for_else_still_works(self):
+        """regression: for...else with a non-tensor predicate must not
+        raise at decoration time."""
+
+        @jit.to_static
+        def fn(x):
+            for i in [1, 2]:
+                pass
+            else:
+                y = 3.0
+            return x * y
+
+        out = fn(paddle.to_tensor(np.array([2.0], np.float32)))
+        np.testing.assert_allclose(np.asarray(out.numpy()), [6.0])
+
+    def test_global_store_in_converted_if_raises_typed(self):
+        def fn(x):
+            global _dy2st_test_counter
+            if x.mean() > 0:
+                _dy2st_test_counter = 1
+            return x
+
+        with pytest.raises(UnimplementedError) as ei:
+            jit.to_static(fn)(paddle.to_tensor(
+                np.array([1.0], np.float32)))
+        assert "global/nonlocal" in str(ei.value)
+
+    def test_empty_closure_cell_falls_back(self):
+        """forward-referenced sibling: conversion falls back to
+        trace-only instead of crashing at decoration."""
+
+        def outer():
+            @jit.to_static
+            def f(x):
+                if True:
+                    y = helper(x)
+                return y
+
+            def helper(x):
+                return x * 2.0
+
+            return f
+
+        f = outer()
+        out = f(paddle.to_tensor(np.array([3.0], np.float32)))
+        np.testing.assert_allclose(np.asarray(out.numpy()), [6.0])
+
+
+class TestGradientMergeEdge:
+    def test_missing_grad_on_closing_step_not_dropped(self):
+        """A param with no grad on the window-closing micro-step still
+        gets its buffered gradient applied, and the buffer is cleared."""
+        import paddle_tpu.nn as nn
+        from paddle_tpu import optimizer
+        from paddle_tpu.distributed import fleet
+        from paddle_tpu.parallel.hybrid_optimizer import (
+            HybridParallelOptimizer,
+        )
+
+        strategy = fleet.DistributedStrategy()
+        strategy.gradient_merge = True
+        strategy.gradient_merge_configs = {"k_steps": 2, "avg": False}
+        lin = nn.Linear(2, 1, bias_attr=False)
+        w0 = np.asarray(lin.weight.numpy()).copy()
+        opt = HybridParallelOptimizer(
+            optimizer.SGD(learning_rate=1.0,
+                          parameters=lin.parameters()),
+            hcg=None, strategy=strategy)
+        x = paddle.to_tensor(np.array([[1.0, 2.0]], np.float32))
+        # micro-step 1: real grad
+        lin(x).sum().backward()
+        opt.step()
+        opt.clear_grad()
+        # micro-step 2 closes the window with NO grad for the param
+        opt.step()
+        want = w0 - np.array([[1.0, 2.0]], np.float32).reshape(w0.shape)
+        np.testing.assert_allclose(np.asarray(lin.weight.numpy()), want,
+                                   rtol=1e-6)
+        assert not opt._gm_buffers  # buffer cleared, no leak
+
+
+class TestKwargsRouting:
+    def test_kwargs_are_not_dropped(self):
+        """regression: the compiled path ignored **kwargs (traced with
+        defaults, cached wrong) — kwargs now route eagerly."""
+
+        @jit.to_static
+        def fn(x, scale=1.0):
+            return x * scale
+
+        x = paddle.to_tensor(np.array([2.0], np.float32))
+        np.testing.assert_allclose(np.asarray(fn(x).numpy()), [2.0])
+        np.testing.assert_allclose(
+            np.asarray(fn(x, scale=3.0).numpy()), [6.0])
+        # and again with the default: the 3.0 result must not be cached
+        np.testing.assert_allclose(np.asarray(fn(x).numpy()), [2.0])
+
+    def test_late_bound_global_resolves(self, tmp_path):
+        """regression: conversion snapshotted globals at decoration,
+        breaking late binding for names defined after @to_static."""
+        import importlib.util
+
+        src = (
+            "import numpy as np\n"
+            "import paddle_tpu as paddle\n"
+            "from paddle_tpu import jit\n"
+            "\n"
+            "@jit.to_static\n"
+            "def f(x):\n"
+            "    if x.mean() > 0:\n"
+            "        return helper(x)\n"
+            "    return x\n"
+            "\n"
+            "def helper(x):\n"
+            "    return x * 7.0\n"
+        )
+        p = tmp_path / "dy2st_late_mod.py"
+        p.write_text(src)
+        spec = importlib.util.spec_from_file_location(
+            "dy2st_late_mod", str(p))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        out = mod.f(paddle.to_tensor(np.array([1.0], np.float32)))
+        np.testing.assert_allclose(np.asarray(out.numpy()), [7.0])
