@@ -49,7 +49,7 @@ class LlamaConfig:
                  rope_theta=10000.0, tie_word_embeddings=False,
                  use_parallel=True, dtype="float32",
                  fuse_attention_qkv=False, fuse_mlp=False,
-                 sequence_parallel=False):
+                 sequence_parallel=False, recompute=False):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.intermediate_size = intermediate_size
@@ -73,6 +73,11 @@ class LlamaConfig:
         # attention (kernels/ring_attention.py) — capability the
         # reference snapshot lacks (SURVEY §5)
         self.sequence_parallel = sequence_parallel
+        # activation recompute per decoder layer (reference fleet
+        # recompute / --recompute flag): trades ~1/3 extra FLOPs for
+        # O(layers * B*S*H) activation memory — required to train ~1B+
+        # params on one 16GB v5e chip
+        self.recompute = recompute
 
     @classmethod
     def tiny(cls, **kw):
@@ -283,6 +288,30 @@ class LlamaDecoderLayer(Layer):
         return x
 
 
+def _remat_layer(layer, x):
+    """Per-layer activation recompute. Two engines, one policy (same split
+    as static/__init__.py RecomputeContext vs fleet/recompute.py):
+    - compiled path (CompiledTrainStep traces under no_grad + jax.grad):
+      wrap the layer body in jax.checkpoint so XLA rematerializes its
+      activations during the backward schedule;
+    - eager-tape path: route through the autograd engine's recompute().
+    """
+    from ..core.dispatch import tape_enabled
+
+    if tape_enabled():
+        from ..distributed.fleet.recompute import recompute
+
+        return recompute(layer, x)
+    import jax
+
+    from ..core.tensor import Tensor
+
+    def body(xv, _l=layer):
+        return _l(Tensor(xv))._value
+
+    return Tensor(jax.checkpoint(body)(x._value))
+
+
 class LlamaModel(Layer):
     def __init__(self, config):
         super().__init__()
@@ -314,10 +343,13 @@ class LlamaModel(Layer):
         if spec is not None:
             x = mark_sharding(x, *spec)
         new_caches = []
+        use_remat = self.config.recompute and caches is None
         for i, layer in enumerate(self.layers):
             if caches is not None:
                 x, c = layer(x, caches[i], position_offset)
                 new_caches.append(c)
+            elif use_remat:
+                x = _remat_layer(layer, x)
             else:
                 x = layer(x)
         x = self.norm(x)

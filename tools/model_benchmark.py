@@ -282,8 +282,80 @@ def bench_allreduce(results, iters=None):
           {"devices": n, "payload_mib": nbytes >> 20})
 
 
+def bench_llama1b(results, iters=None):
+    """~1B-param decoder train step: the weight-dominated MFU row
+    (BASELINE.md round-4 'where does the other 40% go' characterization).
+    At 953M params the arithmetic intensity is realistic — weights no
+    longer fit alongside all activations, so per-layer recompute is on
+    (LlamaConfig.recompute -> jax.checkpoint), the same recipe a real 1B+
+    run on one 16GB v5e chip needs. MFU convention: model FLOPs
+    (6*N/token + attention 12*L*S*H/token, x1.33 for the remat re-forward
+    NOT counted — MFU counts useful FLOPs only) over the v5e bf16 peak
+    197 TFLOP/s."""
+    import numpy as np
+    import jax
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.distributed import mesh as pmesh
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.parallel.engine import CompiledTrainStep
+
+    on_tpu = jax.default_backend() != "cpu"
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                          intermediate_size=5632, num_hidden_layers=16,
+                          num_attention_heads=16,
+                          max_position_embeddings=2048,
+                          use_parallel=False, dtype="bfloat16",
+                          recompute=True)
+        batch, seq = 8, 1024
+    else:
+        cfg = LlamaConfig.tiny(use_parallel=False, recompute=True)
+        batch, seq = 2, 64
+    iters = iters or (10 if on_tpu else 2)
+    pmesh.build_hybrid_mesh(dp=1, devices=jax.devices()[:1])
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    if on_tpu:
+        model.to(dtype="bfloat16")
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+
+    def loss_fn(logits, labels):
+        return F.cross_entropy(logits.reshape([-1, cfg.vocab_size]),
+                               labels.reshape([-1]))
+
+    step = CompiledTrainStep(model, loss_fn, opt)
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
+    labels = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
+    for _ in range(2):
+        loss = step(ids, labels)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step(ids, labels)
+    final = float(loss)
+    dt = time.perf_counter() - t0
+    assert np.isfinite(final)
+    tok_s = batch * seq * iters / dt
+    flops_per_tok = (6 * n_params
+                     + 12 * cfg.num_hidden_layers * seq * cfg.hidden_size)
+    mfu = tok_s * flops_per_tok / 197e12 if on_tpu else 0.0
+    _emit(results, "llama1b_train_tokens_per_sec_per_chip", tok_s,
+          "tokens/s",
+          {"batch": batch, "seq": seq, "params_m": round(n_params / 1e6),
+           "model_tflops": round(tok_s * flops_per_tok / 1e12, 1),
+           "mfu_vs_197tf_peak": round(mfu, 3), "recompute": True})
+
+
 SUBS = {"resnet50": bench_resnet50, "ernie_dp": bench_ernie_dp,
-        "widedeep": bench_widedeep, "allreduce": bench_allreduce}
+        "widedeep": bench_widedeep, "allreduce": bench_allreduce,
+        "llama1b": bench_llama1b}
 
 
 def main():

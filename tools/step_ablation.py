@@ -1,0 +1,216 @@
+"""Train-step time attribution for the flagship bench config.
+
+Parity motivation: the reference records per-op numbers next to its model
+numbers (/root/reference/tools/ci_op_benchmark.sh:1 + op_tester.cc); this
+tool answers the model-level question those leave open — *where does the
+non-MXU time in a train step go* — by timing the step's components in
+isolation at the exact bench shapes (bench.py 134M config by default,
+--config llama1b for the weight-dominated one).
+
+Each component is a jitted closure timed with the tunnel-safe recipe
+(host scalar readback, never block_until_ready — BASELINE.md). Components
+overlap deliberately (fwd is part of fwd+bwd); the table reports both raw
+ms and the share of the full step, so the residual row ("other: XLA
+fusion glue, layernorms, residual adds, weight update") is the step time
+minus the big named pieces.
+
+Usage: python tools/step_ablation.py [--config 134m|llama1b] [--out FILE]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _watchdog(seconds=1500):
+    def fire(signum, frame):
+        sys.stderr.write("step_ablation watchdog: %ds, aborting\n" % seconds)
+        os._exit(3)
+
+    signal.signal(signal.SIGALRM, fire)
+    signal.alarm(seconds)
+
+
+def _time_ms(fn, sync, iters):
+    """Median-free simple timing: warmup twice, time `iters` calls."""
+    for _ in range(2):
+        out = fn()
+    sync(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn()
+    sync(out)
+    return (time.perf_counter() - t0) * 1000.0 / iters
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", choices=["134m", "llama1b"], default="134m")
+    ap.add_argument("--iters", type=int, default=None)
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+    _watchdog()
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.distributed import mesh as pmesh
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.parallel.engine import CompiledTrainStep
+
+    on_tpu = jax.default_backend() != "cpu"
+    iters = args.iters or (20 if on_tpu else 2)
+    if not on_tpu:
+        cfg = LlamaConfig.tiny(use_parallel=False)
+        batch, seq = 2, 64
+    elif args.config == "134m":
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=768,
+                          intermediate_size=2048, num_hidden_layers=12,
+                          num_attention_heads=6,
+                          max_position_embeddings=2048,
+                          use_parallel=False, dtype="bfloat16")
+        batch, seq = 8, 1024
+    else:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                          intermediate_size=5632, num_hidden_layers=16,
+                          num_attention_heads=16,
+                          max_position_embeddings=2048,
+                          use_parallel=False, dtype="bfloat16",
+                          recompute=True)
+        batch, seq = 8, 1024
+
+    pmesh.build_hybrid_mesh(dp=1, devices=jax.devices()[:1])
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    if on_tpu:
+        model.to(dtype="bfloat16")
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+
+    def loss_fn(logits, labels):
+        return F.cross_entropy(logits.reshape([-1, cfg.vocab_size]),
+                               labels.reshape([-1]))
+
+    step = CompiledTrainStep(model, loss_fn, opt)
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
+    labels = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
+
+    rows = []
+
+    def emit(name, ms, note=""):
+        rec = {"component": name, "ms": round(ms, 2), "note": note}
+        rows.append(rec)
+        print(json.dumps(rec), flush=True)
+        if args.out:
+            # incremental write: a mid-run tunnel wedge (watchdog abort)
+            # must not erase the components already measured
+            with open(args.out, "w") as f:
+                json.dump({"rows": rows, "partial": True}, f, indent=1)
+
+    # 1. full train step (fwd + bwd + AdamW update)
+    full_ms = _time_ms(lambda: step(ids, labels), lambda o: float(o), iters)
+    emit("full_step", full_ms, "fwd+bwd+opt, the bench.py number")
+
+    # Functional forward closed over the *current* params.
+    names, vals = model.functional_state()
+    state = dict(zip(names, [v for v in vals]))
+
+    def fwd_fn(idsv):
+        from paddle_tpu.core.tensor import Tensor
+        from paddle_tpu.core.dispatch import no_grad
+
+        with model.bind_state(list(state), [state[n] for n in state]):
+            with no_grad():
+                out = model(Tensor(idsv))
+        return out._value
+
+    fwd_jit = jax.jit(fwd_fn)
+    fwd_ms = _time_ms(lambda: fwd_jit(ids._value),
+                      lambda o: float(jnp.sum(o[0, 0, :2])), iters)
+    emit("forward_only", fwd_ms, "inference pass; bwd ~= full - fwd - opt")
+
+    # 2. flash attention fwd+bwd at the model's exact attention shape
+    heads = cfg.num_attention_heads
+    hd = cfg.hidden_size // heads
+    q = jnp.asarray(rng.randn(batch, seq, heads, hd), jnp.bfloat16)
+
+    from paddle_tpu.kernels.flash_attention import flash_attention
+
+    def attn_loss(q, k, v):
+        o = flash_attention(q, k, v, causal=True)
+        return jnp.sum(o.astype(jnp.float32))
+
+    attn_grad = jax.jit(jax.grad(attn_loss, argnums=(0, 1, 2)))
+    attn_ms = _time_ms(lambda: attn_grad(q, q, q),
+                       lambda o: float(o[0][0, 0, 0, 0]), iters)
+    emit("attention_fwd_bwd_per_layer", attn_ms,
+         "x%d layers = %.2f ms" % (cfg.num_hidden_layers,
+                                   attn_ms * cfg.num_hidden_layers))
+
+    # 3. CE loss + lm_head matmul fwd+bwd (the vocab-sized tail)
+    h = jnp.asarray(rng.randn(batch, seq, cfg.hidden_size), jnp.bfloat16)
+    w = jnp.asarray(rng.randn(cfg.hidden_size, cfg.vocab_size),
+                    jnp.bfloat16)
+    lbl = jnp.asarray(labels._value)
+
+    def head_loss(h, w):
+        logits = (h @ w).reshape(-1, cfg.vocab_size).astype(jnp.float32)
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, lbl.reshape(-1, 1),
+                                   axis=-1)[:, 0]
+        return jnp.mean(lse - gold)
+
+    head_grad = jax.jit(jax.grad(head_loss, argnums=(0, 1)))
+    head_ms = _time_ms(lambda: head_grad(h, w),
+                       lambda o: float(o[0][0, 0, 0]), iters)
+    emit("lm_head_plus_ce_fwd_bwd", head_ms, "vocab %d" % cfg.vocab_size)
+
+    # 4. optimizer apply only (AdamW elementwise over all params)
+    tr = {n: state[n] for n in step._trainable_names}
+    gr = {n: jnp.ones_like(v) * 1e-6 for n, v in tr.items()}
+
+    def opt_apply(tr, gr, st):
+        newp, news = opt.functional_apply(tr, gr, st, step=1)
+        return newp
+
+    opt_jit = jax.jit(opt_apply)
+    ost = step._opt_state
+    first = step._trainable_names[0]
+    opt_ms = _time_ms(lambda: opt_jit(tr, gr, ost),
+                      lambda o: float(jnp.sum(o[first][:1, :1]).astype(
+                          jnp.float32)), iters)
+    emit("adamw_update_only", opt_ms, "elementwise, HBM-bound")
+
+    attn_total = attn_ms * cfg.num_hidden_layers
+    resid = full_ms - attn_total - head_ms - opt_ms
+    emit("residual_mlp_norms_rope_glue", resid,
+         "full - attention - head/CE - opt: MLP matmuls + RMSNorm + RoPE "
+         "+ residual adds + XLA glue")
+    summary = {"config": args.config, "backend": jax.default_backend(),
+               "batch": batch, "seq": seq, "full_step_ms": round(full_ms, 2),
+               "shares": {r["component"]: round(
+                   (r["ms"] * (cfg.num_hidden_layers
+                               if r["component"].endswith("per_layer")
+                               else 1)) / full_ms, 3)
+                   for r in rows if r["component"] != "full_step"}}
+    print(json.dumps(summary), flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump({"rows": rows, "summary": summary}, f, indent=1)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
