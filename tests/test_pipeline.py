@@ -212,3 +212,81 @@ class TestPipelinedTrainStep:
         np.testing.assert_array_equal(
             after, np.asarray(step._stacked[
                 "self_attn.q_proj.weight"][0, 0, 0]))
+
+
+class TestSegmentLayers:
+    """reference fleet/meta_parallel/parallel_layers/pp_layers.py:57
+    SegmentLayers: uniform vs parameter-weighted vs layer-name cuts."""
+
+    def _stack(self):
+        import paddle_tpu.nn as nn
+
+        # embedding-heavy head: uniform cutting piles the params onto
+        # stage 0
+        return [nn.Embedding(5000, 64),      # 320k params
+                nn.Linear(64, 64),           # ~4k
+                nn.Linear(64, 64),
+                nn.Linear(64, 64),
+                nn.Linear(64, 64),
+                nn.Linear(64, 64),
+                nn.Linear(64, 64),
+                nn.Linear(64, 10)]
+
+    @staticmethod
+    def _max_stage_params(layers, bounds):
+        def count(layer):
+            return sum(int(np.prod(p.shape)) for p in layer.parameters())
+
+        return max(sum(count(l) for l in layers[lo:hi])
+                   for lo, hi in zip(bounds, bounds[1:]))
+
+    def test_parameter_method_beats_uniform_on_unbalanced_stack(self):
+        from paddle_tpu.parallel.pipeline_parallel import SegmentLayers
+
+        layers = self._stack()
+        uni = SegmentLayers(layers, 4, method="uniform").do_segment()
+        par = SegmentLayers(layers, 4, method="parameter").do_segment()
+        assert uni == [0, 2, 4, 6, 8]
+        assert par != uni  # the cut moved
+        # the embedding gets its own (smaller) stage: max-stage params drop
+        assert (self._max_stage_params(layers, par)
+                < self._max_stage_params(layers, uni))
+        # all stages non-empty and ordered
+        assert par[0] == 0 and par[-1] == len(layers)
+        assert all(a < b for a, b in zip(par, par[1:]))
+
+    def test_layer_name_method(self):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.parallel.pipeline_parallel import SegmentLayers
+
+        layers = [nn.Embedding(10, 4),
+                  nn.Linear(4, 4), nn.ReLU(),
+                  nn.Linear(4, 4), nn.ReLU(),
+                  nn.Linear(4, 4), nn.ReLU(),
+                  nn.Linear(4, 4), nn.ReLU()]
+        bounds = SegmentLayers(layers, 4,
+                               method="layer:Linear").do_segment()
+        # each stage starts at a Linear; stage 0 absorbs the embedding
+        assert bounds == [0, 3, 5, 7, 9]
+
+    def test_unknown_method_raises(self):
+        from paddle_tpu.parallel.pipeline_parallel import SegmentLayers
+
+        with pytest.raises(ValueError):
+            SegmentLayers(self._stack(), 4, method="bogus").do_segment()
+
+    def test_too_many_stages_raises(self):
+        from paddle_tpu.parallel.pipeline_parallel import SegmentLayers
+
+        with pytest.raises(ValueError):
+            SegmentLayers(self._stack()[:2], 4).do_segment()
+
+    def test_pipeline_layer_passes_seg_method_through(self):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.parallel.pipeline_parallel import PipelineLayer
+
+        pl = PipelineLayer(self._stack(), num_stages=4,
+                           seg_method="parameter")
+        # stage 0 ends right after the embedding (it dominates weight)
+        assert pl.stage_bounds[1] == 1
+        assert len(pl.stage_bounds) == 5
