@@ -1,11 +1,33 @@
 """HybridParallelOptimizer (reference
 fleet/meta_optimizers/dygraph_optimizer/hybrid_parallel_optimizer.py:186):
 wraps the inner optimizer; syncs dp grads, reduces the global grad-norm clip
-across mesh axes, then steps."""
+across mesh axes, then steps. DistributedStrategy knobs honored on the
+eager path:
+
+- ``gradient_merge`` (reference gradient_merge_optimizer.py + dygraph
+  GradientMergeOptimizer): accumulate grads across k_steps micro-steps in
+  buffers and apply the inner optimizer once per window (avg=True divides
+  by k). The static-graph route applies the auto_parallel_gradient_merge
+  pass instead (fleet/meta_optimizers.py).
+- ``sharding_configs['offload']`` (reference sharding/offload_helper.py):
+  park optimizer accumulators in host memory between steps — HBM holds
+  only params+grads+activations, the ZeRO-offload trade. On step, the
+  accumulators stream back through the update; outputs are re-pinned to
+  host.
+"""
 from __future__ import annotations
 
 from ..core.dispatch import no_grad
-from ..optimizer.clip import ClipGradByGlobalNorm
+from ..optimizer.clip import ClipGradByGlobalNorm  # noqa: F401 (re-export)
+
+
+def _host_device():
+    import jax
+
+    try:
+        return jax.devices("cpu")[0]
+    except RuntimeError:
+        return None
 
 
 class HybridParallelOptimizer:
@@ -13,9 +35,93 @@ class HybridParallelOptimizer:
         self._inner_opt = optimizer
         self._hcg = hcg
         self._strategy = strategy
+        gm = bool(getattr(strategy, "gradient_merge", False))
+        cfg = getattr(strategy, "gradient_merge_configs", None) or {}
+        self._gm_k = int(cfg.get("k_steps", 1)) if gm else 1
+        self._gm_avg = bool(cfg.get("avg", True))
+        self._gm_count = 0
+        self._gm_buffers = {}
+        sh_cfg = getattr(strategy, "sharding_configs", None) or {}
+        self._offload = bool(getattr(strategy, "sharding", False)
+                             and sh_cfg.get("offload", False))
+
+    # -- gradient merge ----------------------------------------------------
+
+    def _merge_grads(self):
+        """Stash this micro-step's grads; True when the window closes.
+        A param may have no grad on any given micro-step (unused branch):
+        its buffer still applies — and is always cleared — when the
+        window closes, never leaking into the next window."""
+        import jax.numpy as jnp
+
+        from ..core.tensor import Tensor
+
+        self._gm_count += 1
+        last = self._gm_count >= self._gm_k
+        for p in self._inner_opt._get_params():
+            buf = self._gm_buffers.pop(id(p), None)
+            g = p.grad._value if p.grad is not None else None
+            if g is None and buf is None:
+                continue
+            acc = g if buf is None else (buf if g is None else buf + g)
+            if last:
+                if self._gm_avg:
+                    acc = acc / jnp.asarray(self._gm_k, acc.dtype)
+                if p.grad is None:
+                    p.grad = Tensor(acc)
+                else:
+                    p.grad._value = acc
+            else:
+                self._gm_buffers[id(p)] = acc
+        if last:
+            self._gm_count = 0
+        return last
+
+    # -- ZeRO offload ------------------------------------------------------
+
+    def _offload_accumulators(self):
+        """Park accumulators on the host, remembering each one's device
+        placement/sharding so onload restores it exactly (a sharded
+        ZeRO state must NOT come back committed to one chip)."""
+        import jax
+
+        host = _host_device()
+        accs = getattr(self._inner_opt, "_accumulators", None)
+        if not accs or host is None:
+            return
+        shardings = getattr(self, "_acc_shardings", None)
+        if shardings is None:
+            shardings = self._acc_shardings = {}
+        for key, v in list(accs.items()):
+            if hasattr(v, "sharding"):
+                shardings[key] = v.sharding
+            accs[key] = jax.device_put(v, host)
+
+    def _onload_accumulators(self):
+        """Bring host-parked state back to its original placement before
+        the jitted update — committed-CPU state mixed with device params
+        would otherwise fail device placement."""
+        import jax
+
+        accs = getattr(self._inner_opt, "_accumulators", None)
+        if not accs:
+            return
+        shardings = getattr(self, "_acc_shardings", {})
+        default = jax.devices()[0]
+        for key, v in list(accs.items()):
+            accs[key] = jax.device_put(v, shardings.get(key, default))
+
+    # -- step --------------------------------------------------------------
 
     @no_grad()
     def step(self):
+        if self._gm_k > 1:
+            if not self._merge_grads():
+                # window still open: drop this micro-step's grads, the
+                # buffer holds the running sum (reference GradientMerge
+                # zeroes the grad var after accumulation)
+                self._inner_opt.clear_grad()
+                return
         # dp grad sync (fused_allreduce_gradients analog); on the compiled
         # path XLA already inserted the reduction, eager path does it here.
         if self._hcg is not None:
@@ -27,7 +133,11 @@ class HybridParallelOptimizer:
                     if p.grad is not None:
                         collective.all_reduce(p.grad, group=dp_group)
                         p.grad._value = p.grad._value / dp_group.nranks
+        if self._offload:
+            self._onload_accumulators()
         self._inner_opt.step()
+        if self._offload:
+            self._offload_accumulators()
 
     def clear_grad(self, *a, **k):
         self._inner_opt.clear_grad(*a, **k)
