@@ -444,6 +444,14 @@ class Executor:
             outs = program.run(*[feed[n] for n in program.feed_names])
             return [np.asarray(o) for o in outs] if return_numpy \
                 else [Tensor(o) for o in outs]
+        from .ref_import import ReferenceInferenceModel
+
+        if isinstance(program, ReferenceInferenceModel):
+            # reference-format import (ref_import.py): same exe.run
+            # contract as the reference's serving flow
+            outs = program.run(feed or {})
+            return [np.asarray(o) for o in outs] if return_numpy \
+                else [Tensor(o) for o in outs]
         program = _as_program(program)
         feed = feed or {}
         fetch_list = list(fetch_list or [])
@@ -802,6 +810,16 @@ def load_inference_model(path_prefix, executor=None):
 
     from jax import export as jex
 
+    from .ref_import import is_reference_format
+
+    if is_reference_format(path_prefix):
+        # a model saved by the REFERENCE framework (ProgramDesc protobuf
+        # + combined params): import it (ref_import.py) so migrating
+        # users can serve existing artifacts without re-export
+        from .ref_import import load_reference_inference_model
+
+        model = load_reference_inference_model(path_prefix)
+        return model, model.feed_names, model.fetch_names
     with open(path_prefix + ".pdmodel", "rb") as f:
         blob = f.read()
     with open(path_prefix + ".pdmeta", "rb") as f:
