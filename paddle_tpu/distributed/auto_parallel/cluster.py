@@ -1,0 +1,74 @@
+"""Cluster descriptor — per-axis interconnect for the planner.
+
+Parity: reference auto_parallel/cluster.py (Device/Link graph parsed
+from a cluster json: bandwidth/latency per link, NVLink vs NIC). The
+TPU topology collapses that graph to one fact per *mesh axis*: which
+interconnect its collectives ride — ICI (the torus links inside a pod
+slice) or DCN (host network between slices) — and that link's
+bandwidth/latency. The planner charges each parallelism degree's
+traffic (dp grad allreduce, mp activation allreduce, pp p2p) at its own
+axis's link, which is what makes plans that put high-traffic axes on
+DCN lose the ranking (the scaling-book rule: tensor-parallel inside the
+slice, data-parallel across slices).
+"""
+from __future__ import annotations
+
+
+class Link:
+    """One interconnect class: bytes/s and per-hop latency."""
+
+    def __init__(self, kind, bandwidth, latency=1e-6):
+        self.kind = kind
+        self.bandwidth = float(bandwidth)
+        self.latency = float(latency)
+
+    def __repr__(self):
+        return "Link(%s, %.1f GB/s)" % (self.kind, self.bandwidth / 1e9)
+
+
+# Defaults ~ v5e: 45 GB/s ICI per link direction; DCN per-host NIC
+# shared across chips is an order of magnitude down.
+ICI = lambda: Link("ici", 45e9, 1e-6)  # noqa: E731
+DCN = lambda: Link("dcn", 6.25e9, 10e-6)  # noqa: E731
+
+
+class ClusterSpec:
+    """{mesh axis -> Link}; unknown axes default to ICI."""
+
+    def __init__(self, axis_links=None, default=None):
+        self.axis_links = dict(axis_links or {})
+        self.default = default or ICI()
+
+    def link(self, axis):
+        return self.axis_links.get(axis, self.default)
+
+    def bw(self, axis):
+        return self.link(axis).bandwidth
+
+    @classmethod
+    def single_slice(cls):
+        """Everything inside one pod slice: all axes on ICI."""
+        return cls()
+
+    @classmethod
+    def multi_slice(cls, dcn_axes=("dp",)):
+        """Data-parallel (or any listed axis) crosses slices over DCN —
+        the standard multi-pod layout (reference cluster json's
+        cross-machine NIC links)."""
+        return cls({a: DCN() for a in dcn_axes})
+
+    @classmethod
+    def from_devices(cls, mesh):
+        """Axes whose neighboring devices live on different processes/
+        hosts ride DCN; single-process axes ride ICI."""
+        links = {}
+        devs = mesh.devices
+        for i, axis in enumerate(mesh.axis_names):
+            if devs.shape[i] <= 1:
+                continue
+            first = devs.take(0, axis=i).flatten()
+            second = devs.take(1, axis=i).flatten()
+            crosses = any(a.process_index != b.process_index
+                          for a, b in zip(first, second))
+            links[axis] = DCN() if crosses else ICI()
+        return cls(links)
