@@ -22,8 +22,10 @@ from ..nn.layer import Layer
 
 __all__ = [
     "QuantConfig", "QAT", "PTQ", "quant_linear",
-    "FakeQuanterWithAbsMax", "MovingAverageAbsMaxObserver",
-    "AbsMaxObserver", "fake_quantize_dequantize",
+    "FakeQuanterWithAbsMax", "FakeQuanterChannelWiseAbsMax",
+    "MovingAverageAbsMaxObserver", "AbsMaxObserver",
+    "ChannelWiseAbsMaxObserver", "HistObserver",
+    "fake_quantize_dequantize",
 ]
 
 
@@ -47,9 +49,12 @@ _ste_round.defvjp(_ste_round_fwd, _ste_round_bwd)
 
 @primitive
 def fake_quantize_dequantize(x, scale, bit_length=8):
-    """Symmetric fake quant (reference fake_quantize_dequantize_abs_max):
-    q = clip(round(x / scale * qmax), -qmax, qmax) * scale / qmax, with a
-    straight-through gradient."""
+    """Symmetric fake quant (reference fake_quantize_dequantize_abs_max
+    and its channel_wise variant): q = clip(round(x / scale * qmax),
+    -qmax, qmax) * scale / qmax, with a straight-through gradient.
+    `scale` may be a scalar (per-tensor) or any array broadcastable
+    against x (per-channel: shape 1 everywhere except the channel
+    axis)."""
     x = jnp.asarray(x)
     qmax = float(2 ** (bit_length - 1) - 1)
     s = jnp.maximum(jnp.asarray(scale, x.dtype), 1e-8)
@@ -102,6 +107,84 @@ class MovingAverageAbsMaxObserver:
         return max(self._state or 0.0, 1e-8)
 
 
+class ChannelWiseAbsMaxObserver:
+    """Per-channel abs-max along `channel_axis` (reference
+    channel_wise_abs_max weight observer in slim imperative/qat.py):
+    each output channel gets its own scale, so one hot channel no
+    longer crushes the resolution of the quiet ones."""
+
+    def __init__(self, quant_bits=8, channel_axis=0):
+        self.quant_bits = quant_bits
+        self.channel_axis = channel_axis
+        self._absmax = None
+
+    def _current(self, x):
+        v = x.numpy() if isinstance(x, Tensor) else np.asarray(x)
+        axes = tuple(i for i in range(v.ndim) if i != self.channel_axis)
+        self._ndim = v.ndim
+        return np.abs(v).max(axis=axes) if axes else np.abs(v)
+
+    def observe(self, x):
+        """Running max — PTQ calibration over a data stream."""
+        cur = self._current(x)
+        self._absmax = cur if self._absmax is None else \
+            np.maximum(self._absmax, cur)
+
+    def observe_current(self, x):
+        """Replace with the live value's per-channel abs-max — the QAT
+        weight path (reference channel_wise_abs_max recomputes the
+        scale from the current weight each forward; a lifetime running
+        max would freeze stale large scales as weights decay)."""
+        self._absmax = self._current(x)
+
+    def scale(self):
+        """Broadcast-shaped scale: 1 everywhere except channel_axis."""
+        if self._absmax is None:
+            return 1e-8
+        shape = [1] * self._ndim
+        shape[self.channel_axis] = self._absmax.shape[0]
+        return np.maximum(self._absmax, 1e-8).reshape(shape)
+
+
+class HistObserver:
+    """Histogram observer with a percentile scale (reference
+    observers/hist.py + PercentObserver): accumulates |x| into a fixed
+    number of bins, doubling the range (and re-binning) when a batch
+    exceeds it; scale() returns the chosen percentile of the observed
+    distribution, cutting outliers that a raw abs-max would keep."""
+
+    def __init__(self, quant_bits=8, bins=2048, percentile=0.9999):
+        self.quant_bits = quant_bits
+        self.bins = max(2, bins - bins % 2)  # range-doubling folds pairs
+        self.percentile = percentile
+        self._hist = None
+        self._upper = None
+
+    def observe(self, x):
+        v = x.numpy() if isinstance(x, Tensor) else np.asarray(x)
+        a = np.abs(v).ravel()
+        mx = float(a.max()) if a.size else 0.0
+        if self._hist is None:
+            self._upper = max(mx, 1e-8)
+            self._hist = np.zeros(self.bins, np.float64)
+        while mx > self._upper:
+            # double the range: fold existing counts into the lower half
+            folded = self._hist.reshape(self.bins // 2, 2).sum(axis=1)
+            self._hist = np.concatenate(
+                [folded, np.zeros(self.bins - self.bins // 2)])
+            self._upper *= 2.0
+        h, _ = np.histogram(a, bins=self.bins, range=(0.0, self._upper))
+        self._hist += h
+
+    def scale(self):
+        if self._hist is None or self._hist.sum() == 0:
+            return 1e-8
+        cdf = np.cumsum(self._hist) / self._hist.sum()
+        idx = int(np.searchsorted(cdf, self.percentile))
+        idx = min(idx, self.bins - 1)
+        return max((idx + 1) / self.bins * self._upper, 1e-8)
+
+
 class FakeQuanterWithAbsMax(Layer):
     """QAT activation/weight quanter: observes abs-max on the fly and
     fake-quantizes (reference quanters/abs_max.py)."""
@@ -118,17 +201,62 @@ class FakeQuanterWithAbsMax(Layer):
             x, self.observer.scale(), bit_length=self.quant_bits)
 
 
+class FakeQuanterChannelWiseAbsMax(Layer):
+    """Per-channel weight quanter (reference quanters'
+    FakeQuanterChannelWiseAbsMax): the reference slim default for
+    weights — channel_wise_abs_max."""
+
+    def __init__(self, quant_bits=8, channel_axis=0):
+        super().__init__()
+        self.quant_bits = quant_bits
+        self.observer = ChannelWiseAbsMaxObserver(quant_bits,
+                                                  channel_axis)
+
+    def forward(self, x):
+        # training: weights change every step, recompute the live scale
+        # (host-side max over a param-sized array). eval: reuse the
+        # frozen scale — no per-inference device->host weight copy.
+        if self.training or self.observer._absmax is None:
+            self.observer.observe_current(x)
+        return fake_quantize_dequantize(
+            x, self.observer.scale(), bit_length=self.quant_bits)
+
+
+def _make_weight_quanter(kind, quant_bits, channel_axis):
+    if kind in ("channel_wise_abs_max", "per_channel"):
+        return FakeQuanterChannelWiseAbsMax(quant_bits, channel_axis)
+    if kind in ("abs_max", "per_tensor"):
+        return FakeQuanterWithAbsMax(quant_bits)
+    raise ValueError("unknown weight_quantize_type %r" % (kind,))
+
+
+def _make_act_quanter(kind, quant_bits):
+    if kind in ("moving_average_abs_max", None):
+        return FakeQuanterWithAbsMax(quant_bits)
+    if kind in ("hist", "percentile"):
+        q = FakeQuanterWithAbsMax(quant_bits)
+        q.observer = HistObserver(quant_bits)
+        return q
+    raise ValueError("unknown activation_quantize_type %r" % (kind,))
+
+
 # -- quantized layer wrappers ----------------------------------------------
 
 class QuantedLinear(Layer):
     """Linear with weight+activation fake quant (reference
-    nn/quant/quant_layers.py QuantizedLinear)."""
+    nn/quant/quant_layers.py QuantizedLinear). Weight scales are
+    per-output-channel by default (Linear weight is [in, out]: channel
+    axis 1), matching the reference slim default."""
 
-    def __init__(self, inner, quant_bits=8):
+    def __init__(self, inner, quant_bits=8,
+                 weight_quantize_type="channel_wise_abs_max",
+                 activation_quantize_type="moving_average_abs_max"):
         super().__init__()
         self.inner = inner
-        self.weight_quanter = FakeQuanterWithAbsMax(quant_bits)
-        self.act_quanter = FakeQuanterWithAbsMax(quant_bits)
+        self.weight_quanter = _make_weight_quanter(
+            weight_quantize_type, quant_bits, channel_axis=1)
+        self.act_quanter = _make_act_quanter(
+            activation_quantize_type, quant_bits)
 
     def forward(self, x):
         from ..nn import functional as F
@@ -139,11 +267,17 @@ class QuantedLinear(Layer):
 
 
 class QuantedConv2D(Layer):
-    def __init__(self, inner, quant_bits=8):
+    """Conv2D weight is [out, in, kh, kw]: per-channel axis 0."""
+
+    def __init__(self, inner, quant_bits=8,
+                 weight_quantize_type="channel_wise_abs_max",
+                 activation_quantize_type="moving_average_abs_max"):
         super().__init__()
         self.inner = inner
-        self.weight_quanter = FakeQuanterWithAbsMax(quant_bits)
-        self.act_quanter = FakeQuanterWithAbsMax(quant_bits)
+        self.weight_quanter = _make_weight_quanter(
+            weight_quantize_type, quant_bits, channel_axis=0)
+        self.act_quanter = _make_act_quanter(
+            activation_quantize_type, quant_bits)
 
     def forward(self, x):
         from ..nn import functional as F
@@ -160,10 +294,16 @@ class QuantedConv2D(Layer):
 # -- config + drivers -------------------------------------------------------
 
 class QuantConfig:
-    """Which layer types get quantized (reference quantization/config.py)."""
+    """Which layer types get quantized and how (reference
+    quantization/config.py + slim imperative qat's
+    weight_quantize_type/activation_quantize_type knobs)."""
 
-    def __init__(self, activation=None, weight=None, quant_bits=8):
+    def __init__(self, activation=None, weight=None, quant_bits=8,
+                 weight_quantize_type="channel_wise_abs_max",
+                 activation_quantize_type="moving_average_abs_max"):
         self.quant_bits = quant_bits
+        self.weight_quantize_type = weight_quantize_type
+        self.activation_quantize_type = activation_quantize_type
         self._types = []
 
     def add_type_config(self, layer_types, activation=None, weight=None,
@@ -189,11 +329,14 @@ def _wrap_layers(model, config):
     from ..nn.layers.conv import Conv2D
 
     types = config.types()
+    kw = dict(quant_bits=config.quant_bits,
+              weight_quantize_type=config.weight_quantize_type,
+              activation_quantize_type=config.activation_quantize_type)
     for name, child in list(model._sub_layers.items()):
         if isinstance(child, Linear) and Linear in types:
-            model._sub_layers[name] = QuantedLinear(child, config.quant_bits)
+            model._sub_layers[name] = QuantedLinear(child, **kw)
         elif isinstance(child, Conv2D) and Conv2D in types:
-            model._sub_layers[name] = QuantedConv2D(child, config.quant_bits)
+            model._sub_layers[name] = QuantedConv2D(child, **kw)
         else:
             _wrap_layers(child, config)
     return model

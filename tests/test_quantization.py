@@ -107,3 +107,144 @@ class TestQATPTQ:
         x = paddle.to_tensor(RNG.randn(4, 8).astype("float32"))
         out = q(x)
         assert out.shape == [4, 4]
+
+
+class TestPerChannel:
+    """VERDICT r3 #6: per-channel weight scales + histogram observer
+    (reference slim imperative qat channel_wise_abs_max default)."""
+
+    def test_channel_observer_scale_shape(self):
+        from paddle_tpu.quantization import ChannelWiseAbsMaxObserver
+
+        obs = ChannelWiseAbsMaxObserver(channel_axis=0)
+        w = np.stack([np.full((3, 3), 0.1, np.float32),
+                      np.full((3, 3), 10.0, np.float32)])
+        obs.observe(w)
+        s = obs.scale()
+        assert s.shape == (2, 1, 1)
+        np.testing.assert_allclose(s[:, 0, 0], [0.1, 10.0])
+
+    def test_per_channel_beats_per_tensor_on_skewed_weights(self):
+        """A weight matrix whose output channels differ by 100x in
+        magnitude: per-tensor quant crushes the quiet channels;
+        per-channel keeps them."""
+        from paddle_tpu.quantization import fake_quantize_dequantize
+
+        rng = np.random.RandomState(0)
+        w = rng.randn(16, 8).astype(np.float32)
+        chan_scale = np.logspace(-2, 0, 8).astype(np.float32)
+        w = w * chan_scale  # channel magnitudes span 0.01..1.0
+
+        # per-tensor
+        pt = np.asarray(fake_quantize_dequantize(
+            paddle.to_tensor(w), float(np.abs(w).max())).numpy())
+        # per-channel over axis 1
+        s = np.abs(w).max(axis=0, keepdims=True)
+        pc = np.asarray(fake_quantize_dequantize(
+            paddle.to_tensor(w), s).numpy())
+        err_pt = np.abs(pt - w).mean()
+        err_pc = np.abs(pc - w).mean()
+        assert err_pc < err_pt / 2.0, (err_pc, err_pt)
+
+    def test_ptq_per_channel_accuracy_beats_per_tensor(self):
+        """End-to-end PTQ on a small conv net with skewed channels:
+        per-channel int8 output stays closer to float."""
+        import paddle_tpu.nn as nn
+        from paddle_tpu.quantization import PTQ, QuantConfig
+
+        rng = np.random.RandomState(1)
+
+        def build():
+            paddle.seed(7)
+            net = nn.Sequential(
+                nn.Conv2D(3, 8, 3, padding=1), nn.ReLU(),
+                nn.Conv2D(8, 8, 3, padding=1), nn.ReLU(),
+                nn.Flatten(), nn.Linear(8 * 8 * 8, 10))
+            # skew conv output channels so per-tensor hurts
+            with paddle.no_grad():
+                w = np.asarray(net[0].weight.numpy())
+                skew = np.logspace(-2, 0, w.shape[0]).astype(np.float32)
+                net[0].weight.set_value(
+                    w * skew.reshape(-1, 1, 1, 1))
+            return net
+
+        x = rng.rand(4, 3, 8, 8).astype(np.float32)
+        xt = paddle.to_tensor(x)
+
+        float_net = build()
+        ref = np.asarray(float_net(xt).numpy())
+
+        outs = {}
+        for kind in ("channel_wise_abs_max", "abs_max"):
+            net = build()
+            q = PTQ(QuantConfig(weight_quantize_type=kind)).quantize(net)
+            PTQ().calibrate(q, [x])
+            outs[kind] = np.asarray(q(xt).numpy())
+        err_pc = np.abs(outs["channel_wise_abs_max"] - ref).mean()
+        err_pt = np.abs(outs["abs_max"] - ref).mean()
+        assert err_pc < err_pt, (err_pc, err_pt)
+
+    def test_hist_observer_percentile_cuts_outliers(self):
+        from paddle_tpu.quantization import HistObserver
+
+        obs = HistObserver(percentile=0.99)
+        data = np.concatenate([np.random.RandomState(0).uniform(
+            0, 1.0, 10000).astype(np.float32), [1000.0]])
+        obs.observe(data)
+        s = obs.scale()
+        assert s < 10.0  # abs-max would be 1000
+        assert s > 0.5
+
+    def test_hist_observer_range_doubling(self):
+        from paddle_tpu.quantization import HistObserver
+
+        obs = HistObserver(percentile=1.0)
+        obs.observe(np.array([0.5], np.float32))
+        obs.observe(np.array([4.0], np.float32))  # forces rebinning x3
+        s = obs.scale()
+        assert 3.9 <= s <= 4.1
+
+    def test_qat_trains_with_per_channel(self):
+        import paddle_tpu.nn as nn
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu.quantization import QAT, QuantConfig
+
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(),
+                            nn.Linear(16, 4))
+        q = QAT(QuantConfig()).quantize(net, inplace=True)
+        opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                    parameters=q.parameters())
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.rand(16, 8).astype(np.float32))
+        y = paddle.to_tensor(rng.randint(0, 4, (16,)).astype(np.int64))
+        losses = []
+        for _ in range(8):
+            loss = F.cross_entropy(q(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0], losses
+
+    def test_weight_scale_tracks_decaying_weights(self):
+        """QAT weight quanter recomputes the scale from the LIVE weight
+        (regression: a lifetime running max froze stale large scales as
+        weights decayed)."""
+        from paddle_tpu.quantization import FakeQuanterChannelWiseAbsMax
+
+        q = FakeQuanterChannelWiseAbsMax(channel_axis=0)
+        q.train()
+        big = paddle.to_tensor(np.full((2, 4), 10.0, np.float32))
+        small = paddle.to_tensor(np.full((2, 4), 0.1, np.float32))
+        q(big)
+        np.testing.assert_allclose(q.observer.scale().ravel(),
+                                   [10.0, 10.0])
+        q(small)
+        np.testing.assert_allclose(q.observer.scale().ravel(),
+                                   [0.1, 0.1])
+        # eval freezes the scale (no re-observation)
+        q.eval()
+        q(big)
+        np.testing.assert_allclose(q.observer.scale().ravel(),
+                                   [0.1, 0.1])
