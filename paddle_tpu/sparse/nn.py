@@ -159,10 +159,90 @@ def max_pool3d(x, kernel_size, stride=None, padding=0,
     return _dense_to_coo(jnp.asarray(arr), cmask)
 
 
+def attention(query, key, value, sparse_mask, key_padding_mask=None,
+              attn_mask=None, name=None):
+    """Sparse fused attention (reference
+    paddle/phi/kernels/sparse/gpu/fused_attention_kernel.cu:1 +
+    python/paddle/sparse/nn/functional/transformer.py attention).
+
+    out = softmax_over_csr_pattern(Q K^T / sqrt(d)) V, where the
+    SparseCsrTensor `sparse_mask` (dense shape [B*H, S, S]) names the
+    score positions that participate; `key_padding_mask` [B, S] and
+    `attn_mask` [S, S] additionally mask positions whose entry is 0
+    (the reference kernel's zero-means-masked convention).
+
+    TPU mapping: a CUDA gather-softmax over CSR rows would serialize on
+    the VPU. Instead the CSR pattern is materialized once as a dense
+    boolean mask (S*S bools/head — cheap next to the S*S f32 scores
+    that already exist) and the whole computation stays one fused XLA
+    region: mask -> where(-inf) -> softmax -> matmul on the MXU. When
+    the pattern is exactly causal lower-triangular, the O(S)-memory
+    Pallas flash kernel is used instead of materializing scores
+    (kernels/flash_attention.py).
+    """
+    from ..core.tensor import Tensor
+
+    q = query._value if isinstance(query, Tensor) else jnp.asarray(query)
+    k = key._value if isinstance(key, Tensor) else jnp.asarray(key)
+    v = value._value if isinstance(value, Tensor) else jnp.asarray(value)
+    b, h, s, d = q.shape
+
+    dense_mask = jnp.asarray(sparse_mask.to_dense()._value != 0) \
+        if hasattr(sparse_mask, "to_dense") else \
+        jnp.asarray(sparse_mask) != 0
+    dense_mask = dense_mask.reshape(b, h, s, s)
+
+    extra_masks = []
+    if key_padding_mask is not None:
+        kp = key_padding_mask._value if isinstance(
+            key_padding_mask, Tensor) else jnp.asarray(key_padding_mask)
+        extra_masks.append((kp != 0).reshape(b, 1, 1, s))
+    if attn_mask is not None:
+        am = attn_mask._value if isinstance(attn_mask, Tensor) \
+            else jnp.asarray(attn_mask)
+        extra_masks.append((am != 0).reshape(1, 1, s, s))
+
+    # causal fast path: pattern == tril and no extra masks -> flash.
+    # Gate the O(S^2) device comparison (and its host sync) behind the
+    # host-side nnz count: only a pattern with exactly B*H*S*(S+1)/2
+    # stored entries can be causal.
+    nnz = getattr(sparse_mask, "nnz", None)
+    plausibly_causal = (nnz is None
+                        or nnz * 1 == b * h * s * (s + 1) // 2
+                        or nnz == s * (s + 1) // 2)  # per-batch nse
+    if not extra_masks and plausibly_causal and \
+            not isinstance(dense_mask, jax.core.Tracer):
+        tril = jnp.tril(jnp.ones((s, s), jnp.bool_))
+        if bool(jnp.all(dense_mask == tril[None, None])):
+            from ..kernels.flash_attention import flash_attention
+
+            # flash kernel layout is [B, S, H, D]
+            o = flash_attention(q.transpose(0, 2, 1, 3),
+                                k.transpose(0, 2, 1, 3),
+                                v.transpose(0, 2, 1, 3), causal=True)
+            return Tensor(o.transpose(0, 2, 1, 3))
+
+    mask = dense_mask
+    for m in extra_masks:
+        mask = jnp.logical_and(mask, m)
+    scores = jnp.einsum("bhsd,bhtd->bhst", q, k) / jnp.sqrt(
+        jnp.asarray(d, q.dtype))
+    neg = jnp.asarray(jnp.finfo(jnp.float32).min, scores.dtype)
+    scores = jnp.where(mask, scores, neg)
+    p = jax.nn.softmax(scores, axis=-1)
+    # rows with no unmasked entry: softmax of all -inf is uniform junk;
+    # the reference leaves them undefined — zero them instead
+    any_row = jnp.any(mask, axis=-1, keepdims=True)
+    p = jnp.where(any_row, p, 0.0)
+    out = jnp.einsum("bhst,bhtd->bhsd", p, v)
+    return Tensor(out)
+
+
 class functional:  # namespace mirror of reference sparse.nn.functional
     conv3d = staticmethod(conv3d)
     subm_conv3d = staticmethod(subm_conv3d)
     max_pool3d = staticmethod(max_pool3d)
+    attention = staticmethod(attention)
 
     @staticmethod
     def relu(x):

@@ -240,3 +240,102 @@ class TestSparseOpBreadth:
         np.testing.assert_allclose(
             np.asarray(t.to_dense().numpy()),
             np.asarray(x.to_dense().numpy()).T)
+
+
+class TestSparseFusedAttention:
+    """reference sparse fused attention
+    (phi/kernels/sparse/gpu/fused_attention_kernel.cu +
+    sparse/nn/functional/transformer.py attention): dense-oracle parity
+    over a CSR pattern, zero-means-masked kp/attn masks, causal flash
+    fast path."""
+
+    def _qkv(self, b=2, h=2, s=8, d=4, seed=0):
+        rng = np.random.RandomState(seed)
+        mk = lambda: paddle.to_tensor(  # noqa: E731
+            rng.randn(b, h, s, d).astype(np.float32))
+        return mk(), mk(), mk()
+
+    def _csr_mask(self, pattern):
+        """bool [BH, S, S] -> SparseCsrTensor with ones at the pattern
+        (reference contract: nnz equal across batches — tests use one
+        pattern broadcast over BH)."""
+        import jax.numpy as jnp
+        from jax.experimental import sparse as jsparse
+
+        from paddle_tpu.sparse import SparseCsrTensor
+
+        bcsr = jsparse.BCSR.fromdense(
+            jnp.asarray(pattern.astype(np.float32)), n_batch=1)
+        return SparseCsrTensor(bcsr)
+
+    def _oracle(self, q, k, v, mask_b, kp=None, am=None):
+        qn, kn, vn = (np.asarray(t.numpy()) for t in (q, k, v))
+        b, h, s, d = qn.shape
+        scores = np.einsum("bhsd,bhtd->bhst", qn, kn) / np.sqrt(d)
+        m = mask_b.reshape(b, h, s, s).copy()
+        if kp is not None:
+            m &= (kp != 0).reshape(b, 1, 1, s)
+        if am is not None:
+            m &= (am != 0).reshape(1, 1, s, s)
+        scores = np.where(m, scores, -1e30)
+        p = np.exp(scores - scores.max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+        p = np.where(m.any(-1, keepdims=True), p, 0.0)
+        return np.einsum("bhst,bhtd->bhsd", p, vn)
+
+    def test_random_pattern_matches_dense_oracle(self):
+        import paddle_tpu.sparse.nn as snn
+
+        b, h, s, d = 2, 2, 8, 4
+        q, k, v = self._qkv(b, h, s, d)
+        rng = np.random.RandomState(3)
+        one = rng.rand(s, s) < 0.4
+        one[:, 0] = True  # no fully-masked rows
+        pattern = np.broadcast_to(one, (b * h, s, s)).copy()
+        mask = self._csr_mask(pattern)
+        out = snn.attention(q, k, v, mask)
+        want = self._oracle(q, k, v, pattern)
+        np.testing.assert_allclose(np.asarray(out.numpy()), want,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_key_padding_and_attn_masks_zero_means_masked(self):
+        import paddle_tpu.sparse.nn as snn
+
+        b, h, s, d = 2, 2, 8, 4
+        q, k, v = self._qkv(b, h, s, d, seed=1)
+        pattern = np.ones((b * h, s, s), bool)
+        kp = np.ones((b, s), np.float32)
+        kp[:, -2:] = 0.0  # last two keys masked
+        am = np.ones((s, s), np.float32)
+        am[0, 1] = 0.0
+        mask = self._csr_mask(pattern)
+        out = snn.attention(q, k, v, mask,
+                            key_padding_mask=paddle.to_tensor(kp),
+                            attn_mask=paddle.to_tensor(am))
+        want = self._oracle(q, k, v, pattern, kp=kp, am=am)
+        np.testing.assert_allclose(np.asarray(out.numpy()), want,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_causal_pattern_takes_flash_path_and_matches(self):
+        import paddle_tpu.sparse.nn as snn
+
+        b, h, s, d = 2, 2, 16, 4
+        q, k, v = self._qkv(b, h, s, d, seed=2)
+        tril = np.tril(np.ones((s, s), bool))
+        pattern = np.broadcast_to(tril, (b * h, s, s)).copy()
+        mask = self._csr_mask(pattern)
+        out = snn.attention(q, k, v, mask)
+        want = self._oracle(q, k, v, pattern)
+        np.testing.assert_allclose(np.asarray(out.numpy()), want,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_fully_masked_row_is_zero(self):
+        import paddle_tpu.sparse.nn as snn
+
+        b, h, s, d = 1, 1, 4, 2
+        q, k, v = self._qkv(b, h, s, d, seed=4)
+        pattern = np.ones((1, s, s), bool)
+        pattern[0, 2, :] = False  # row 2 attends to nothing
+        mask = self._csr_mask(pattern)
+        out = np.asarray(snn.attention(q, k, v, mask).numpy())
+        np.testing.assert_allclose(out[0, 0, 2], np.zeros(d), atol=1e-6)
