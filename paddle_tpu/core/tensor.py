@@ -283,10 +283,23 @@ def wrap_output(out, stop_gradient=True):
     return Tensor(out, stop_gradient=stop_gradient)
 
 
+def _param_name():
+    # lazy import: utils pulls in modules that import core at package
+    # import time; by first Parameter construction the cycle is closed
+    from ..utils.unique_name import generate
+
+    return generate("param")
+
+
 class Parameter(Tensor):
     """Trainable tensor (reference python/paddle/fluid/framework.py Parameter)."""
 
     def __init__(self, value, name=None, trainable=True):
+        if name is None:
+            # reference framework.py auto-names every Parameter via
+            # unique_name.generate; named params are what Scope lookups
+            # key on
+            name = _param_name()
         super().__init__(value, stop_gradient=not trainable, name=name)
         self.persistable = True
 

@@ -96,20 +96,38 @@ def tensor_array_to_tensor(array, axis=0, use_stack=True):
 
 class Variable_:
     """Scope-held slot (reference framework/variable.h): wraps whatever
-    it stores (Tensor / TensorArray / SelectedRows / bytes)."""
+    it stores (Tensor / TensorArray / SelectedRows / bytes). A slot can
+    alternatively *bind* a live framework Tensor (weakly): the base
+    global scope mirrors program state this way, so reading through the
+    scope always sees the current value without pinning dead programs'
+    arrays alive."""
 
     def __init__(self, name):
         self.name = name
         self._holder = None
+        self._tensor_ref = None
 
     def get_tensor(self):
+        if self._tensor_ref is not None:
+            t = self._tensor_ref()
+            return None if t is None else t._value
         return self._holder
 
     def set(self, value):
         self._holder = value
+        self._tensor_ref = None
+        return self
+
+    def bind(self, tensor):
+        import weakref
+
+        self._holder = None
+        self._tensor_ref = weakref.ref(tensor)
         return self
 
     def is_initialized(self):
+        if self._tensor_ref is not None:
+            return self._tensor_ref() is not None
         return self._holder is not None
 
 
@@ -121,6 +139,15 @@ class Scope:
         self._vars = {}
         self._parent = parent
         self._kids = []
+        # per-program executor runtime state (optimizer slots, grad-merge
+        # accumulators, step counter) when this scope is the run target —
+        # reference scopes likewise own the optimizer accumulator
+        # variables. Weakly keyed by the Program object so a dead
+        # program's state is released (and a recycled id can never
+        # resurrect it).
+        import weakref
+
+        self._exec_state = weakref.WeakKeyDictionary()
 
     def var(self, name):
         v = self._vars.get(name)
@@ -136,6 +163,17 @@ class Scope:
         if self._parent is not None:
             return self._parent.find_var(name)
         return None
+
+    def _find_var_with_owner(self, name):
+        """(Variable_, owning Scope) through the ancestor chain, or
+        (None, None) — the Executor needs the owner to tell real storage
+        apart from the base scope's tensor-backed mirror vars."""
+        v = self._vars.get(name)
+        if v is not None:
+            return v, self
+        if self._parent is not None:
+            return self._parent._find_var_with_owner(name)
+        return None, None
 
     def new_scope(self):
         kid = Scope(self)
@@ -154,10 +192,18 @@ class Scope:
 
 
 _global_scope = Scope()
+# the process-default scope: its variables are backed by the program
+# tensors themselves (tensor storage is canonical there); every other
+# scope holds its own copies so Executor runs under it stay isolated
+_BASE_SCOPE = _global_scope
 
 
 def global_scope():
     return _global_scope
+
+
+def is_base_scope(scope):
+    return scope is _BASE_SCOPE
 
 
 def scope_guard(scope):

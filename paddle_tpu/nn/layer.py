@@ -79,6 +79,12 @@ class Layer:
 
     def register_buffer(self, name, tensor, persistable=True):
         self._buffers[name] = tensor
+        if tensor is not None and getattr(tensor, "name", None) is None:
+            # reference names buffers like params (unique_name) — Scope
+            # lookups and state threading key on the name
+            from ..utils.unique_name import generate
+
+            tensor.name = generate(name.lstrip("_") or "buffer")
         if not persistable:
             self._non_persistable_buffer_names.add(name)
         return tensor

@@ -436,9 +436,12 @@ class Executor:
         self.place = place
 
     def run(self, program=None, feed=None, fetch_list=None,
-            return_numpy=True, use_program_cache=True):
+            return_numpy=True, use_program_cache=True, scope=None):
         # use_program_cache: accepted for reference API parity; programs
         # are always cached per (version, feed signature) here.
+        # scope: reference executor.py run(scope=) — program state
+        # (params, BN stats, optimizer slots) lives in the scope, so the
+        # same Program trains independently under different scopes.
         if isinstance(program, InferenceProgram):
             feed = feed or {}
             outs = program.run(*[feed[n] for n in program.feed_names])
@@ -482,10 +485,112 @@ class Executor:
             entry = self._compile(program, feed_tensors, fetch_tensors,
                                   params, frozen)
             program._run_cache[key] = entry
-        outs = entry(program, feed_vals, params, frozen)
+        outs = self._run_in_scope(entry, program, feed_vals, params,
+                                  frozen, scope)
         if return_numpy:
             return [np.asarray(o) for o in outs]
         return [Tensor(o) for o in outs]
+
+    def _run_in_scope(self, entry, program, feed_vals, params, frozen,
+                      scope):
+        """Route program state through the target scope (reference
+        framework/scope.h: the Executor reads/creates variables in the
+        scope it runs against).
+
+        The base global scope is backed by the tensors themselves: runs
+        mutate tensor storage in place and mirror values into scope vars
+        so ``global_scope().find_var(name).get_tensor()`` works. Any
+        other scope holds its own copies: params are seeded from the
+        current tensor values on first use (copy — the train step
+        donates its input buffers), updates land in the scope, and the
+        base tensor values are restored afterwards.
+        """
+        from ..core.tensor_array import global_scope, is_base_scope
+
+        import weakref
+
+        scope = scope if scope is not None else global_scope()
+        state_targets = getattr(entry, "state_targets", [])
+        tracked, seen, keys = [], set(), set()
+        for t in list(params) + list(frozen) + list(state_targets):
+            if id(t) in seen:
+                continue
+            seen.add(id(t))
+            key = getattr(t, "name", None)
+            if not isinstance(key, str) or not key or key in keys:
+                key = "_anon_%d" % id(t)
+            keys.add(key)
+            tracked.append((key, t))
+        if is_base_scope(scope):
+            outs = entry(program, feed_vals, params, frozen)
+            for key, t in tracked:
+                # bind, don't copy: the base scope is a live view over
+                # tensor storage — no dead program's arrays are pinned
+                scope.var(key).bind(t)
+            return outs
+        # per-program executor state (opt slots, grad-merge acc, step)
+        # resolves through the ancestor chain like the vars themselves:
+        # a child-scope run over params owned by the parent must reuse
+        # the parent's optimizer state, not re-initialize fresh moments
+        est_scope = scope
+        while est_scope is not None and program not in est_scope._exec_state:
+            est_scope = est_scope._parent
+        est = (scope if est_scope is None or is_base_scope(est_scope)
+               else est_scope)._exec_state.setdefault(program, {})
+        ts = program._train_spec
+        opt = ts[1] if ts is not None else None
+        saved = [(t, t._value) for _, t in tracked]
+        saved_opt = (program._opt_state, getattr(program, "_gm_acc", None))
+        saved_step = opt._global_step if opt is not None else None
+        swapped = False
+        holders = []
+        try:
+            for key, t in tracked:
+                v, owner = scope._find_var_with_owner(key)
+                stale_anon = (
+                    key.startswith("_anon_") and v is not None
+                    and (getattr(v, "_anon_for", None) is None
+                         or v._anon_for() is not t))
+                if (v is None or not v.is_initialized()
+                        or is_base_scope(owner) or stale_anon):
+                    # seed a local copy. Copy for two reasons: the
+                    # compiled train step donates param buffers (the
+                    # base tensor must survive the run), and a base-
+                    # scope var resolved through the ancestor chain is
+                    # only a live mirror of tensor storage — never real
+                    # per-scope state to update in place. Anonymous keys
+                    # are id-derived, so a var whose original tensor is
+                    # gone (id recycled) is stale and must be reseeded.
+                    v = scope.var(key).set(jnp.copy(t._value))
+                    if key.startswith("_anon_"):
+                        v._anon_for = weakref.ref(t)
+                holders.append(v)
+                t._value = v.get_tensor()
+            program._opt_state = est.get("opt_state")
+            program._gm_acc = est.get("gm_acc")
+            if opt is not None:
+                # per-scope step counter: a fresh scope's Adam bias
+                # correction must start from step 1, matching its fresh
+                # moment slots. (LR scheduler state remains user-stepped
+                # and shared, as in eager mode.)
+                opt._global_step = est.get("global_step", 0)
+            swapped = True
+            outs = entry(program, feed_vals, params, frozen)
+            for (key, t), v in zip(tracked, holders):
+                v.set(t._value)
+                if key.startswith("_anon_"):
+                    v._anon_for = weakref.ref(t)
+        finally:
+            if swapped:
+                est["opt_state"] = program._opt_state
+                est["gm_acc"] = getattr(program, "_gm_acc", None)
+                program._opt_state, program._gm_acc = saved_opt
+                if opt is not None:
+                    est["global_step"] = opt._global_step
+                    opt._global_step = saved_step
+            for t, val in saved:
+                t._value = val
+        return outs
 
     def train_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,
@@ -561,6 +666,7 @@ class Executor:
                     t._value = v
                 return outs
 
+            runner.state_targets = state_targets
             return runner
 
         loss_t, opt = program._train_spec
@@ -705,6 +811,7 @@ class Executor:
                 opt._global_step += 1  # LR schedulers are stepped by user
             return outs
 
+        runner.state_targets = state_targets
         return runner
 
 

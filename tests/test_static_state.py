@@ -137,3 +137,186 @@ class TestStaticFreshRng:
             "dropout mask identical across Executor.run calls")
         # and still roughly half-dropped
         assert 0.25 < (a != 0).mean() < 0.75
+
+
+class TestScopeIsolation:
+    """Executor.run(scope=) / scope_guard: program state lives in the
+    target scope (reference framework/scope.h + fluid/executor.py run
+    scope argument) — the same Program trains independently under
+    different scopes, and the base global scope stays untouched."""
+
+    def teardown_method(self, method):
+        static.disable_static()
+
+    def _build_train(self):
+        main, startup = _fresh_static()
+        with static.program_guard(main, startup):
+            fc = nn.Linear(3, 1)
+            x = static.data("x", [4, 3], "float32")
+            label = static.data("label", [4, 1], "float32")
+            loss = F.mse_loss(fc(x), label)
+            opt = paddle.optimizer.SGD(learning_rate=0.1)
+            opt.minimize(loss)
+        return main, startup, fc, loss
+
+    def _feeds(self, n=3):
+        rng = np.random.RandomState(7)
+        return [{"x": rng.randn(4, 3).astype(np.float32),
+                 "label": rng.randn(4, 1).astype(np.float32)}
+                for _ in range(n)]
+
+    def test_scoped_training_is_isolated_and_reproducible(self):
+        main, startup, fc, loss = self._build_train()
+        exe = static.Executor()
+        exe.run(startup)
+        w0 = np.asarray(fc.weight._value).copy()
+        feeds = self._feeds()
+        s1, s2 = paddle.Scope(), paddle.Scope()
+        l1 = [float(exe.run(main, feed=f, fetch_list=[loss], scope=s1)[0])
+              for f in feeds]
+        # base tensor storage untouched by the scoped runs
+        np.testing.assert_array_equal(np.asarray(fc.weight._value), w0)
+        # a second fresh scope reproduces the same loss sequence
+        l2 = [float(exe.run(main, feed=f, fetch_list=[loss], scope=s2)[0])
+              for f in feeds]
+        np.testing.assert_allclose(l1, l2, rtol=1e-6)
+        # the scope holds the trained weights, different from the seed
+        wv = np.array(s1.find_var(fc.weight.name).get_tensor())
+        assert not np.allclose(wv, w0)
+        # state persists inside the scope: one more step moves on
+        l_more = float(exe.run(main, feed=feeds[0], fetch_list=[loss],
+                               scope=s1)[0])
+        assert abs(l_more - l1[0]) > 1e-9
+        # a base-scope run starts from the original weights
+        l_base = float(exe.run(main, feed=feeds[0], fetch_list=[loss])[0])
+        np.testing.assert_allclose(l_base, l1[0], rtol=1e-6)
+
+    def test_scope_guard_routes_executor_runs(self):
+        main, startup, fc, loss = self._build_train()
+        exe = static.Executor()
+        exe.run(startup)
+        w0 = np.asarray(fc.weight._value).copy()
+        feeds = self._feeds(2)
+        s = paddle.Scope()
+        with paddle.scope_guard(s):
+            for f in feeds:
+                exe.run(main, feed=f, fetch_list=[loss])
+        np.testing.assert_array_equal(np.asarray(fc.weight._value), w0)
+        assert s.find_var(fc.weight.name).is_initialized()
+
+    def test_global_scope_mirrors_param_values(self):
+        main, startup, fc, loss = self._build_train()
+        exe = static.Executor()
+        exe.run(startup)
+        exe.run(main, feed=self._feeds(1)[0], fetch_list=[loss])
+        v = paddle.global_scope().find_var(fc.weight.name)
+        assert v is not None and v.is_initialized()
+        np.testing.assert_array_equal(np.array(v.get_tensor()),
+                                      np.asarray(fc.weight._value))
+
+    def test_bn_stats_follow_the_scope(self):
+        main, startup = _fresh_static()
+        with static.program_guard(main, startup):
+            bn = nn.BatchNorm1D(4)
+            bn.train()
+            x = static.data("x", [8, 4], "float32")
+            y = bn(x)
+        exe = static.Executor()
+        exe.run(startup)
+        mean0 = np.asarray(bn._mean._value).copy()
+        rng = np.random.RandomState(3)
+        s = paddle.Scope()
+        for _ in range(3):
+            exe.run(main, feed={"x": rng.randn(8, 4).astype(np.float32) + 2},
+                    fetch_list=[y], scope=s)
+        # base running stats untouched; scope's copy moved
+        np.testing.assert_array_equal(np.asarray(bn._mean._value), mean0)
+        sv = np.array(s.find_var(bn._mean.name).get_tensor())
+        assert not np.allclose(sv, mean0)
+
+    def test_child_scope_sees_parent_vars(self):
+        s = paddle.Scope()
+        s.var("a").set(np.float32(3.0))
+        kid = s.new_scope()
+        assert kid.find_var("a") is not None
+        assert float(kid.find_var("a").get_tensor()) == 3.0
+        assert s.find_var("missing") is None
+
+    def test_child_of_global_scope_does_not_steal_base_buffers(self):
+        # review regression: a base-scope run mirrors the live param
+        # array into the global scope; a run under new_scope() of it
+        # must seed a COPY (the train step donates its param buffers),
+        # not adopt the mirror var, or the base tensor's buffer dies
+        main, startup, fc, loss = self._build_train()
+        exe = static.Executor()
+        exe.run(startup)
+        feeds = self._feeds(2)
+        l_base = float(exe.run(main, feed=feeds[0], fetch_list=[loss])[0])
+        w_after_base = np.asarray(fc.weight._value).copy()
+        kid = paddle.global_scope().new_scope()
+        exe.run(main, feed=feeds[1], fetch_list=[loss], scope=kid)
+        # base value still alive and unchanged by the scoped run
+        np.testing.assert_array_equal(np.asarray(fc.weight._value),
+                                      w_after_base)
+        # global scope mirror not clobbered with the kid's training
+        gv = np.array(paddle.global_scope().find_var(
+            fc.weight.name).get_tensor())
+        np.testing.assert_array_equal(gv, w_after_base)
+        # and the base program can keep running
+        float(exe.run(main, feed=feeds[0], fetch_list=[loss])[0])
+
+    def test_adam_step_counter_is_per_scope(self):
+        # review regression: Adam bias correction depends on the step
+        # counter; scoped runs must not share it or a second fresh
+        # scope diverges from the first
+        main, startup = _fresh_static()
+        with static.program_guard(main, startup):
+            fc = nn.Linear(3, 1)
+            x = static.data("x", [4, 3], "float32")
+            label = static.data("label", [4, 1], "float32")
+            loss = F.mse_loss(fc(x), label)
+            paddle.optimizer.Adam(learning_rate=0.05).minimize(loss)
+        exe = static.Executor()
+        exe.run(startup)
+        feeds = self._feeds()
+        s1, s2 = paddle.Scope(), paddle.Scope()
+        l1 = [float(exe.run(main, feed=f, fetch_list=[loss], scope=s1)[0])
+              for f in feeds]
+        l2 = [float(exe.run(main, feed=f, fetch_list=[loss], scope=s2)[0])
+              for f in feeds]
+        np.testing.assert_allclose(l1, l2, rtol=1e-6)
+
+    def test_register_buffer_accepts_none(self):
+        layer = nn.Layer()
+        layer.register_buffer("placeholder", None)
+        assert layer._buffers["placeholder"] is None
+
+    def test_child_scope_continues_parent_optimizer_state(self):
+        # review regression: params resolve through the scope ancestor
+        # chain, so the optimizer state must too — a child-scope run
+        # over parent-owned params continues the parent's Adam moments
+        # and step, exactly as if the parent had run the step itself
+        main, startup = _fresh_static()
+        with static.program_guard(main, startup):
+            fc = nn.Linear(3, 1)
+            x = static.data("x", [4, 3], "float32")
+            label = static.data("label", [4, 1], "float32")
+            loss = F.mse_loss(fc(x), label)
+            paddle.optimizer.Adam(learning_rate=0.05).minimize(loss)
+        exe = static.Executor()
+        exe.run(startup)
+        feeds = self._feeds(5)
+        s = paddle.Scope()
+        mixed = []
+        for f in feeds[:3]:
+            mixed.append(float(exe.run(main, feed=f, fetch_list=[loss],
+                                       scope=s)[0]))
+        kid = s.new_scope()
+        mixed.append(float(exe.run(main, feed=feeds[3], fetch_list=[loss],
+                                   scope=kid)[0]))
+        mixed.append(float(exe.run(main, feed=feeds[4], fetch_list=[loss],
+                                   scope=s)[0]))
+        s2 = paddle.Scope()
+        straight = [float(exe.run(main, feed=f, fetch_list=[loss],
+                                  scope=s2)[0]) for f in feeds]
+        np.testing.assert_allclose(mixed, straight, rtol=1e-6)
