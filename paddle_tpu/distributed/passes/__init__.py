@@ -281,3 +281,259 @@ class FuseAllReducePass(PassBase):
 
     def _apply_single_impl(self, main_program, startup_program, context):
         context.set_attr("fuse_all_reduce", "delegated-to-XLA")
+
+
+# ------------------------------------------------- tape graph-opt passes
+# The reference optimizes graphs with ~244 IR pass files
+# (paddle/fluid/framework/ir/); most fusions are structural no-ops here
+# because XLA fuses compiled modules itself. What remains meaningful on
+# an op tape are SEMANTIC rewrites: inference-mode conversion (is_test),
+# pruning to fetch targets, and trace-time constant folding — the
+# analogs of delete_dropout_op_pass, graph pruning
+# (framework/prune.cc), and constant_folding_pass.
+
+
+def _bind_args(rec):
+    """(BoundArguments, signature) for a record's original call, with
+    Tensor objects kept as leaves."""
+    import inspect
+
+    import jax
+
+    a, k = jax.tree_util.tree_unflatten(rec.treedef, rec.leaves)
+    sig = inspect.signature(rec.raw_fn)
+    return sig.bind(*a, **k), sig
+
+
+def _rebuild_record(rec, args, kwargs, raw_fn=None, op_name=None,
+                    outs=None, multi=None):
+    import jax
+
+    from ...static import _OpRecord
+
+    leaves, treedef = jax.tree_util.tree_flatten((tuple(args), kwargs))
+    return _OpRecord(op_name or rec.op_name, raw_fn or rec.raw_fn, leaves,
+                     treedef, rec.outs if outs is None else outs,
+                     rec.multi if multi is None else multi)
+
+
+def _refresh_tape_meta(program):
+    program._tape_out_ids = {
+        id(t) for rec in program.tape for t in rec.outs}
+    program.__dict__.pop("_native_interp", None)
+    # recompute segments are (start, end) TAPE INDICES — any pass that
+    # shrinks the tape invalidates them; replay falls back to the plain
+    # path (re-apply auto_parallel_recompute after structural passes)
+    program.__dict__.pop("_recompute_segments", None)
+    program._analyze_cache = None
+    program._bump()
+
+
+@register_pass("set_is_test")
+class SetIsTestPass(PassBase):
+    """Inference-mode conversion (reference clone(for_test=True) →
+    _inference_optimize: flips is_test on dropout/batch_norm ops;
+    framework.py:_inference_optimize + delete_dropout_op_pass).
+
+    - dropout/dropout2d/dropout3d records are re-bound with
+      training=False (identity / downscale at replay, per mode).
+    - batch_norm_train records become batch_norm_infer over the layer's
+      running-stat buffers, located through the program's registered
+      state updates; the now-dead running-stat update chains and their
+      state edges are removed.
+    """
+
+    _DROPOUT_OPS = {"dropout", "dropout2d", "dropout3d", "alpha_dropout"}
+
+    def _apply_single_impl(self, main_program, startup_program, context):
+        from ...core.dispatch import OPS
+        from ...core.tensor import Tensor
+
+        tape = list(main_program.tape)
+        n_drop = n_bn = 0
+        # pass 1: dropout -> training=False
+        for i, rec in enumerate(tape):
+            if rec.op_name in self._DROPOUT_OPS:
+                try:
+                    ba, _ = _bind_args(rec)
+                except TypeError:
+                    continue
+                ba.arguments["training"] = False
+                tape[i] = _rebuild_record(rec, ba.args, ba.kwargs)
+                n_drop += 1
+        # pass 2: batch_norm_train -> batch_norm_infer
+        state_items = list(main_program._state_updates.items())
+        dead_ids = set()
+        protected = set()  # outs of converted records: never sweep
+        for i, rec in enumerate(tape):
+            if rec.op_name != "batch_norm_train" or len(rec.outs) != 3:
+                continue
+            mean_t, var_t = rec.outs[1], rec.outs[2]
+            # forward-derive the stat-update chains of this record
+            derived_m, derived_v = {id(mean_t)}, {id(var_t)}
+            for r2 in tape[i + 1:]:
+                lids = {id(l) for l in r2.leaves if isinstance(l, Tensor)}
+                oids = {id(t) for t in r2.outs}
+                if lids & derived_m:
+                    derived_m |= oids
+                if lids & derived_v:
+                    derived_v |= oids
+            run_mean = run_var = None
+            for tid, (target, source) in state_items:
+                if id(source) in derived_m:
+                    run_mean = target
+                elif id(source) in derived_v:
+                    run_var = target
+            if run_mean is None or run_var is None:
+                import warnings
+
+                warnings.warn(
+                    "set_is_test: batch_norm_train record has no "
+                    "registered running-stat update; left in train mode")
+                continue
+            ba, _ = _bind_args(rec)
+            args = ba.arguments
+            tape[i] = _rebuild_record(
+                rec,
+                (args["x"], run_mean, run_var, args.get("weight"),
+                 args.get("bias")),
+                {"epsilon": args.get("epsilon", 1e-5),
+                 "data_format": args.get("data_format", "NCHW")},
+                raw_fn=OPS["batch_norm_infer"], op_name="batch_norm_infer",
+                outs=(rec.outs[0],), multi=False)
+            dead_ids |= derived_m | derived_v
+            protected.add(id(rec.outs[0]))
+            n_bn += 1
+        if dead_ids:
+            # drop the converted records' state edges, then the now-dead
+            # stat-update arithmetic: a record on a dead chain (all outs
+            # in the derived sets) survives only if something still
+            # consumes one of its outs or an out remains a state source
+            main_program._state_updates = {
+                tid: (t, s)
+                for tid, (t, s) in main_program._state_updates.items()
+                if id(s) not in dead_ids}
+            live_srcs = {id(s)
+                         for _, s in main_program._state_updates.values()}
+            kept_target_ids = {id(t) for t, _ in
+                               main_program._state_updates.values()}
+            removed_targets = {id(t) for _tid, (t, _s) in state_items
+                               if id(t) not in kept_target_ids}
+            consumed = set()
+            kept = []
+            for rec in reversed(tape):
+                oids = {id(t) for t in rec.outs}
+                on_dead_chain = oids <= dead_ids or any(
+                    isinstance(l, Tensor) and id(l) in removed_targets
+                    for l in rec.leaves)
+                if on_dead_chain and not (oids & consumed) \
+                        and not (oids & live_srcs) \
+                        and not (oids & protected):
+                    # covers both the derived mean/var arithmetic and the
+                    # running_mean*momentum / running_var*momentum side
+                    # (which consumes the removed state TARGET, so its
+                    # outs are not in the derived sets)
+                    continue
+                kept.append(rec)
+                consumed |= {id(l) for l in rec.leaves
+                             if isinstance(l, Tensor)}
+            kept.reverse()
+            tape = kept
+        main_program.tape = tape
+        _refresh_tape_meta(main_program)
+        context.set_attr("is_test_converted", (n_drop, n_bn))
+
+
+@register_pass("dead_code_elimination")
+class DeadCodeEliminationPass(PassBase):
+    """Prune the tape to the records needed for the given `targets`
+    (reference framework/prune.cc: Prune(ProgramDesc, feed/fetch), used
+    by Executor pruning and save_inference_model). State-update sources
+    and the training loss are implicitly live."""
+
+    def _apply_single_impl(self, main_program, startup_program, context):
+        from ...core.tensor import Tensor
+
+        targets = self.get_attr("targets")
+        if targets is None:
+            raise ValueError(
+                "dead_code_elimination requires set_attr('targets', "
+                "[tensors]) — without fetch targets liveness is "
+                "undefined on a tape")
+        needed = {id(t) for t in targets}
+        ts = main_program._train_spec
+        if ts is not None:
+            needed.add(id(ts[0]))
+        needed |= {id(s) for _, s in main_program._state_updates.values()}
+        kept = []
+        for rec in reversed(main_program.tape):
+            if any(id(t) in needed for t in rec.outs):
+                kept.append(rec)
+                needed |= {id(l) for l in rec.leaves
+                           if isinstance(l, Tensor)}
+        kept.reverse()
+        removed = len(main_program.tape) - len(kept)
+        main_program.tape = kept
+        # drop feed placeholders no kept record reads — Executor.run
+        # validates feeds against feed_vars (reference prune.cc removes
+        # unused feed ops the same way)
+        used = {id(l) for rec in kept for l in rec.leaves
+                if isinstance(l, Tensor)} | {id(t) for t in targets}
+        main_program.feed_vars = {
+            name: v for name, v in main_program.feed_vars.items()
+            if id(v) in used}
+        _refresh_tape_meta(main_program)
+        context.set_attr("dce_removed", removed)
+
+
+@register_pass("constant_folding")
+class ConstantFoldingPass(PassBase):
+    """Evaluate records whose inputs are all build-time constants and
+    drop them from the tape; their outputs become captured constants
+    (reference constant_folding_pass,
+    framework/ir/constant_folding_pass.cc). Trainable parameters, feed
+    placeholders, state targets and RNG ops are never folded."""
+
+    _RNG_OPS = {"dropout", "dropout2d", "dropout3d", "alpha_dropout",
+                "uniform", "gaussian", "standard_normal", "randint",
+                "rand", "randn", "randperm", "bernoulli", "multinomial",
+                "poisson", "exponential"}
+
+    def _apply_single_impl(self, main_program, startup_program, context):
+        from ...core.dispatch import no_grad
+        from ...core.interpreter import replay_record
+        from ...core.tensor import Parameter, Tensor
+
+        feed_ids = {id(v) for v in main_program.feed_vars.values()}
+        state_ids = {id(t) for t, _ in
+                     main_program._state_updates.values()}
+        state_ids |= {id(s) for _, s in
+                      main_program._state_updates.values()}
+        produced = {id(t) for rec in main_program.tape for t in rec.outs}
+        folded_out = set()
+        kept = []
+        n = 0
+        for rec in main_program.tape:
+            def const_leaf(lf):
+                if not isinstance(lf, Tensor):
+                    return True
+                if id(lf) in folded_out:
+                    return True
+                return (id(lf) not in produced
+                        and id(lf) not in feed_ids
+                        and id(lf) not in state_ids
+                        and not isinstance(lf, Parameter)
+                        and lf.stop_gradient)
+
+            if rec.op_name not in self._RNG_OPS and \
+                    not any(id(t) in state_ids for t in rec.outs) and \
+                    all(const_leaf(lf) for lf in rec.leaves):
+                with no_grad():
+                    replay_record(rec)  # outs become captured constants
+                folded_out |= {id(t) for t in rec.outs}
+                n += 1
+                continue
+            kept.append(rec)
+        main_program.tape = kept
+        _refresh_tape_meta(main_program)
+        context.set_attr("folded", n)

@@ -119,6 +119,13 @@ class Program:
         p.__dict__.pop("_native_interp", None)  # DAG is per-program
         if for_test:
             p._train_spec = None
+            # reference clone(for_test=True) -> _inference_optimize:
+            # dropout becomes identity, batch_norm switches to running
+            # stats (is_test=1 on the ops). Without this the cloned
+            # program would stay stochastic / keep batch statistics.
+            from ..distributed.passes import new_pass
+
+            new_pass("set_is_test").apply(p)
         return p
 
     def var(self, name):

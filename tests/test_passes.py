@@ -242,3 +242,172 @@ class TestShardingPass:
                 if hasattr(s, "sharding") and s.ndim >= 1:
                     specs.append(tuple(s.sharding.spec))
         assert any("sharding" in str(sp) for sp in specs), specs
+
+
+class TestGraphOptPasses:
+    """set_is_test / dead_code_elimination / constant_folding over the
+    op tape (reference framework.py _inference_optimize, prune.cc,
+    ir/constant_folding_pass.cc)."""
+
+    def teardown_method(self, m):
+        static.disable_static()
+
+    def test_clone_for_test_deactivates_dropout_and_bn(self):
+        main, startup = _fresh()
+        with static.program_guard(main, startup):
+            bn = nn.BatchNorm1D(4)
+            bn.train()
+            drop = nn.Dropout(0.5)
+            x = static.data("x", [8, 4], "float32")
+            y = drop(bn(x))
+        exe = static.Executor()
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        for _ in range(3):
+            exe.run(main, feed={"x": rng.randn(8, 4).astype(np.float32)
+                                * 2 + 1}, fetch_list=[y])
+        t = main.clone(for_test=True)
+        ops = [r.op_name for r in t.tape]
+        assert "batch_norm_train" not in ops and "batch_norm_infer" in ops
+        assert not t._state_updates, "test clone must not update stats"
+        f = rng.randn(8, 4).astype(np.float32)
+        a = exe.run(t, feed={"x": f}, fetch_list=[y])[0]
+        b = exe.run(t, feed={"x": f}, fetch_list=[y])[0]
+        np.testing.assert_array_equal(a, b)  # dropout inactive
+        mean = np.asarray(bn._mean._value)
+        var = np.asarray(bn._variance._value)
+        w = np.asarray(bn.weight._value)
+        bias = np.asarray(bn.bias._value)
+        oracle = (f - mean) / np.sqrt(var + 1e-5) * w + bias
+        np.testing.assert_allclose(a, oracle, rtol=1e-5, atol=1e-6)
+        # the original program still trains: stats keep moving
+        m0 = mean.copy()
+        exe.run(main, feed={"x": rng.randn(8, 4).astype(np.float32) + 3},
+                fetch_list=[y])
+        assert not np.allclose(np.asarray(bn._mean._value), m0)
+
+    def test_dead_code_elimination_prunes_to_targets(self):
+        main, startup = _fresh()
+        with static.program_guard(main, startup):
+            x = static.data("x", [4, 4], "float32")
+            kept = paddle.matmul(x, x)
+            kept2 = F.relu(kept)
+            dead = paddle.matmul(x, x) + 5.0  # never fetched
+            dead2 = F.softmax(dead)  # noqa: F841
+        n0 = len(main.tape)
+        ctx = new_pass("dead_code_elimination",
+                       {"targets": [kept2]}).apply(main)
+        assert ctx.get_attr("dce_removed") >= 2
+        assert len(main.tape) < n0
+        exe = static.Executor()
+        exe.run(startup)
+        f = np.random.RandomState(1).randn(4, 4).astype(np.float32)
+        out = exe.run(main, feed={"x": f}, fetch_list=[kept2])[0]
+        np.testing.assert_allclose(out, np.maximum(f @ f, 0), rtol=1e-5)
+
+    def test_dead_code_elimination_requires_targets(self):
+        main, _ = _fresh()
+        with pytest.raises(ValueError):
+            new_pass("dead_code_elimination").apply(main)
+
+    def test_constant_folding_folds_const_subgraph(self):
+        main, startup = _fresh()
+        with static.program_guard(main, startup):
+            c = paddle.to_tensor(np.eye(4, dtype=np.float32))
+            c.stop_gradient = True
+            c2 = paddle.matmul(c, c) * 3.0  # fully constant subgraph
+            x = static.data("x", [4, 4], "float32")
+            y = paddle.matmul(x, c2)
+        n0 = len(main.tape)
+        ctx = new_pass("constant_folding").apply(main)
+        assert ctx.get_attr("folded") >= 2
+        assert len(main.tape) < n0
+        exe = static.Executor()
+        exe.run(startup)
+        f = np.random.RandomState(2).randn(4, 4).astype(np.float32)
+        out = exe.run(main, feed={"x": f}, fetch_list=[y])[0]
+        np.testing.assert_allclose(out, f @ (np.eye(4) * 3.0), rtol=1e-5)
+
+    def test_constant_folding_skips_params_and_feeds(self):
+        main, startup = _fresh()
+        with static.program_guard(main, startup):
+            fc = nn.Linear(4, 4)
+            x = static.data("x", [4, 4], "float32")
+            y = fc(x)
+            loss = F.mse_loss(y, x)
+            paddle.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        n0 = len(main.tape)
+        new_pass("constant_folding").apply(main)
+        # nothing folds: every record touches a feed or a parameter
+        assert len(main.tape) == n0
+        exe = static.Executor()
+        exe.run(startup)
+        f = np.random.RandomState(3).randn(4, 4).astype(np.float32)
+        l0 = float(exe.run(main, feed={"x": f}, fetch_list=[loss])[0])
+        l1 = float(exe.run(main, feed={"x": f}, fetch_list=[loss])[0])
+        assert l1 < l0  # training still works
+
+    def test_set_is_test_removes_momentum_side_records(self):
+        # review regression: the running_mean*momentum multiplies
+        # consume the (removed) state target, so they sit outside the
+        # derived sets — they must still be swept
+        main, startup = _fresh()
+        with static.program_guard(main, startup):
+            bn = nn.BatchNorm1D(4)
+            bn.train()
+            x = static.data("x", [8, 4], "float32")
+            y = bn(x)
+        t = main.clone(for_test=True)
+        assert [r.op_name for r in t.tape] == ["batch_norm_infer"]
+        exe = static.Executor()
+        exe.run(startup)
+        f = np.random.RandomState(0).randn(8, 4).astype(np.float32)
+        out = exe.run(t, feed={"x": f}, fetch_list=[y])[0]
+        oracle = (f - 0.0) / np.sqrt(1.0 + 1e-5)
+        np.testing.assert_allclose(out, oracle, rtol=1e-5, atol=1e-6)
+
+    def test_set_is_test_keeps_fetchable_bn_output(self):
+        # the converted batch_norm_infer record is the LAST tape record
+        # (its out consumed by nothing) — it must survive the sweep
+        main, startup = _fresh()
+        with static.program_guard(main, startup):
+            bn = nn.BatchNorm1D(3)
+            bn.train()
+            x = static.data("x", [4, 3], "float32")
+            y = bn(x)
+        t = main.clone(for_test=True)
+        assert any(r.op_name == "batch_norm_infer" for r in t.tape)
+        exe = static.Executor()
+        exe.run(startup)
+        f = np.ones((4, 3), np.float32)
+        out = exe.run(t, feed={"x": f}, fetch_list=[y])[0]
+        assert out.shape == (4, 3)
+
+    def test_dce_drops_unused_feed_vars(self):
+        # review regression: pruned programs must not demand feeds no
+        # kept record reads
+        main, startup = _fresh()
+        with static.program_guard(main, startup):
+            x = static.data("x", [4, 4], "float32")
+            z = static.data("z", [4, 4], "float32")
+            y = F.relu(x)
+            dead = paddle.matmul(z, z)  # noqa: F841
+        new_pass("dead_code_elimination", {"targets": [y]}).apply(main)
+        assert "z" not in main.feed_vars
+        exe = static.Executor()
+        exe.run(startup)
+        f = np.random.RandomState(0).randn(4, 4).astype(np.float32)
+        out = exe.run(main, feed={"x": f}, fetch_list=[y])[0]
+        np.testing.assert_allclose(out, np.maximum(f, 0))
+
+    def test_structural_pass_invalidates_recompute_segments(self):
+        main, startup = _fresh()
+        with static.program_guard(main, startup):
+            x = static.data("x", [4, 4], "float32")
+            a = F.relu(x)
+            b = paddle.matmul(a, a)
+            dead = F.softmax(paddle.matmul(x, x))  # noqa: F841
+        new_pass("auto_parallel_recompute", {"checkpoints": [a]}).apply(main)
+        assert getattr(main, "_recompute_segments", None)
+        new_pass("dead_code_elimination", {"targets": [b]}).apply(main)
+        assert getattr(main, "_recompute_segments", None) is None

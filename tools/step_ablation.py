@@ -49,6 +49,28 @@ def _time_ms(fn, sync, iters):
     return (time.perf_counter() - t0) * 1000.0 / iters
 
 
+def _loop_time_ms(body, init, sync, inner, outer):
+    """Per-iteration time of `body` amortized inside ONE jitted
+    fori_loop call. Isolated per-call timing through the axon tunnel
+    carries ~2-4 ms of host->tunnel dispatch per call, which swamps
+    sub-ms components (the first committed 134m ablation measured
+    attention at 4.47 ms/layer isolated vs ~0.75 ms in-step and went
+    negative in the residual). The carry threads a data dependency so
+    XLA cannot hoist the body out of the loop."""
+    import jax
+
+    looped = jax.jit(lambda c: jax.lax.fori_loop(0, inner, body, c))
+    c = looped(init)
+    sync(c)
+    c = looped(init)
+    sync(c)
+    t0 = time.perf_counter()
+    for _ in range(outer):
+        c = looped(c)
+    sync(c)
+    return (time.perf_counter() - t0) * 1000.0 / (outer * inner)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", choices=["134m", "llama1b"], default="134m")
@@ -119,6 +141,19 @@ def main():
             with open(args.out, "w") as f:
                 json.dump({"rows": rows, "partial": True}, f, indent=1)
 
+    inner = 16 if on_tpu else 2
+    outer = max(2, iters // 4)
+
+    # 0. per-call dispatch floor: a trivial jitted op round-trips the
+    # host->device dispatch path; the full step pays this once per call
+    # while the loop-amortized component rows (below) do not
+    tiny = jnp.zeros((8, 128), jnp.float32)
+    disp_jit = jax.jit(lambda x: x + 1.0)
+    disp_ms = _time_ms(lambda: disp_jit(tiny),
+                       lambda o: float(o[0, 0]), max(iters, 20))
+    emit("dispatch_floor_per_call", disp_ms,
+         "host->device dispatch overhead; included once in full_step")
+
     # 1. full train step (fwd + bwd + AdamW update)
     full_ms = _time_ms(lambda: step(ids, labels), lambda o: float(o), iters)
     emit("full_step", full_ms, "fwd+bwd+opt, the bench.py number")
@@ -136,9 +171,17 @@ def main():
                 out = model(Tensor(idsv))
         return out._value
 
-    fwd_jit = jax.jit(fwd_fn)
-    fwd_ms = _time_ms(lambda: fwd_jit(ids._value),
-                      lambda o: float(jnp.sum(o[0, 0, :2])), iters)
+    def fwd_body(i, idsv):
+        out = fwd_fn(idsv)
+        # impossible predicate threads a dependency on the FULL output
+        # into the next iteration (a slice would let XLA narrow the
+        # whole forward) without changing the ids
+        bump = (jnp.sum(out.astype(jnp.float32))
+                > jnp.float32(1e30)).astype(idsv.dtype)
+        return idsv + bump
+
+    fwd_ms = _loop_time_ms(fwd_body, ids._value,
+                           lambda c: float(jnp.sum(c[0, :2])), inner, outer)
     emit("forward_only", fwd_ms, "inference pass; bwd ~= full - fwd - opt")
 
     # 2. flash attention fwd+bwd at the model's exact attention shape
@@ -152,9 +195,17 @@ def main():
         o = flash_attention(q, k, v, causal=True)
         return jnp.sum(o.astype(jnp.float32))
 
-    attn_grad = jax.jit(jax.grad(attn_loss, argnums=(0, 1, 2)))
-    attn_ms = _time_ms(lambda: attn_grad(q, q, q),
-                       lambda o: float(o[0][0, 0, 0, 0]), iters)
+    attn_grad = jax.grad(attn_loss, argnums=(0, 1, 2))
+
+    def attn_body(i, qc):
+        dq, dk, dv = attn_grad(qc, qc, qc)
+        # thread ALL three grads into the carry or XLA dead-code-
+        # eliminates the dk/dv kernel out of the measurement
+        dsum = (dq + dk + dv).astype(qc.dtype)
+        return qc + dsum * jnp.asarray(1e-30, qc.dtype)
+
+    attn_ms = _loop_time_ms(attn_body, q,
+                            lambda c: float(c[0, 0, 0, 0]), inner, outer)
     emit("attention_fwd_bwd_per_layer", attn_ms,
          "x%d layers = %.2f ms" % (cfg.num_hidden_layers,
                                    attn_ms * cfg.num_hidden_layers))
@@ -172,32 +223,44 @@ def main():
                                    axis=-1)[:, 0]
         return jnp.mean(lse - gold)
 
-    head_grad = jax.jit(jax.grad(head_loss, argnums=(0, 1)))
-    head_ms = _time_ms(lambda: head_grad(h, w),
-                       lambda o: float(o[0][0, 0, 0]), iters)
+    head_grad = jax.grad(head_loss, argnums=(0, 1))
+
+    def head_body(i, hc):
+        gh, gw = head_grad(hc, w)
+        # gw (the [hidden, vocab] wgrad matmul) must feed the carry too,
+        # or XLA removes the dominant backward matmul from the timing
+        gw_tap = jnp.sum(gw.astype(jnp.float32)) * jnp.float32(1e-38)
+        return (hc + gh.astype(hc.dtype) * jnp.asarray(1e-30, hc.dtype)
+                + gw_tap.astype(hc.dtype))
+
+    head_ms = _loop_time_ms(head_body, h,
+                            lambda c: float(c[0, 0, 0]), inner, outer)
     emit("lm_head_plus_ce_fwd_bwd", head_ms, "vocab %d" % cfg.vocab_size)
 
     # 4. optimizer apply only (AdamW elementwise over all params)
     tr = {n: state[n] for n in step._trainable_names}
     gr = {n: jnp.ones_like(v) * 1e-6 for n, v in tr.items()}
 
-    def opt_apply(tr, gr, st):
-        newp, news = opt.functional_apply(tr, gr, st, step=1)
-        return newp
-
-    opt_jit = jax.jit(opt_apply)
     ost = step._opt_state
     first = step._trainable_names[0]
-    opt_ms = _time_ms(lambda: opt_jit(tr, gr, ost),
-                      lambda o: float(jnp.sum(o[first][:1, :1]).astype(
-                          jnp.float32)), iters)
+
+    def opt_body(i, carry):
+        trc, stc = carry
+        newp, news = opt.functional_apply(trc, gr, stc, step=1)
+        return newp, news
+
+    opt_ms = _loop_time_ms(
+        opt_body, (tr, ost),
+        lambda c: float(jnp.sum(c[0][first][:1, :1]).astype(jnp.float32)),
+        inner, outer)
     emit("adamw_update_only", opt_ms, "elementwise, HBM-bound")
 
     attn_total = attn_ms * cfg.num_hidden_layers
-    resid = full_ms - attn_total - head_ms - opt_ms
+    resid = full_ms - disp_ms - attn_total - head_ms - opt_ms
     emit("residual_mlp_norms_rope_glue", resid,
-         "full - attention - head/CE - opt: MLP matmuls + RMSNorm + RoPE "
-         "+ residual adds + XLA glue")
+         "full - dispatch - attention - head/CE - opt: MLP matmuls + "
+         "RMSNorm + RoPE + residual adds + XLA glue; in-step fusion can "
+         "make isolated component times differ from their in-step cost")
     summary = {"config": args.config, "backend": jax.default_backend(),
                "batch": batch, "seq": seq, "full_step_ms": round(full_ms, 2),
                "shares": {r["component"]: round(
