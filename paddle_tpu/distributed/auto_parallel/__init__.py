@@ -2,6 +2,12 @@
 (reference python/paddle/distributed/auto_parallel/)."""
 from .completion import Completer, op_family  # noqa: F401
 from .cost_model import CostEstimator, MachineSpec  # noqa: F401
+from .dist_attr import (  # noqa: F401
+    OperatorDistAttr,
+    TensorDistAttr,
+    get_dist_attr,
+    reshard,
+)
 from .engine import Engine  # noqa: F401
 from .interface import get_sharding, shard_op, shard_tensor  # noqa: F401
 from .partitioner import Partitioner, Resharder  # noqa: F401

@@ -1,0 +1,233 @@
+"""TensorDistAttr / OperatorDistAttr — typed distributed attributes.
+
+Parity: reference paddle/fluid/distributed/auto_parallel/dist_attr.cc
+(TensorDistAttr: process_mesh + dims_mapping + batch_dim + dynamic_dims
++ per-field annotated marks + verify(); OperatorDistAttr: per-input/
+output TensorDistAttr + impl_type/impl_idx) and the python wrappers in
+python/paddle/distributed/auto_parallel/dist_attribute.py.
+
+TPU-native: dims_mapping uses the reference encoding (one entry per
+tensor dim; -1 = replicated, i = sharded over mesh dim i) and lowers
+losslessly to a jax PartitionSpec over the ProcessMesh's named axes —
+the GSPMD partitioner consumes the PartitionSpec, so verify() +
+to_partition_spec() is the entire compilation contract. reshard() is
+the Resharder analog (reference auto_parallel/reshard.py inserts
+send/recv + concat/slice programs; here a placement change is one
+device_put — XLA emits the collective-permute / all-to-all).
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...core.tensor import Tensor
+from .process_mesh import ProcessMesh
+
+
+class TensorDistAttr:
+    """Distribution of one tensor over a ProcessMesh."""
+
+    def __init__(self, process_mesh=None, dims_mapping=None, batch_dim=0,
+                 dynamic_dims=None):
+        self.process_mesh = process_mesh
+        self.dims_mapping = list(dims_mapping) if dims_mapping else []
+        self.batch_dim = batch_dim
+        self.dynamic_dims = list(dynamic_dims) if dynamic_dims else []
+        self._annotated = set()
+
+    # -- annotation marks (reference annotated_ map) --------------------
+    def mark_annotated(self, name):
+        if name not in ("process_mesh", "dims_mapping", "batch_dim",
+                        "dynamic_dims"):
+            raise ValueError("unknown DistAttr field %r" % name)
+        self._annotated.add(name)
+
+    def is_annotated(self, name):
+        return name in self._annotated
+
+    # -- validation (reference TensorDistAttr::verify) ------------------
+    def verify(self, tensor=None):
+        mesh = self.process_mesh
+        if mesh is not None and not isinstance(mesh, ProcessMesh):
+            raise TypeError("process_mesh must be a ProcessMesh")
+        ndim_mesh = mesh.ndim if mesh is not None else 0
+        used = set()
+        for d in self.dims_mapping:
+            if not isinstance(d, int) or d < -1 or d >= ndim_mesh:
+                raise ValueError(
+                    "dims_mapping entry %r out of range for mesh ndim %d"
+                    % (d, ndim_mesh))
+            if d != -1:
+                if d in used:
+                    raise ValueError(
+                        "mesh dim %d used by more than one tensor dim "
+                        "(dims_mapping %s)" % (d, self.dims_mapping))
+                used.add(d)
+        if tensor is not None:
+            shape = list(tensor.shape)
+            if self.dims_mapping and len(self.dims_mapping) != len(shape):
+                raise ValueError(
+                    "dims_mapping %s does not match tensor rank %d"
+                    % (self.dims_mapping, len(shape)))
+            for td, md in enumerate(self.dims_mapping):
+                if md == -1:
+                    continue
+                size = mesh.shape[md]
+                if shape[td] % size != 0:
+                    raise ValueError(
+                        "tensor dim %d (size %d) not divisible by mesh "
+                        "dim %d (size %d)" % (td, shape[td], md, size))
+        return True
+
+    # -- GSPMD lowering -------------------------------------------------
+    def to_partition_spec(self):
+        if self.process_mesh is None:
+            return P()
+        names = self.process_mesh.dim_names
+        return P(*[None if d == -1 else names[d]
+                   for d in self.dims_mapping])
+
+    @classmethod
+    def from_shard_spec(cls, process_mesh, shard_spec, tensor=None):
+        """Build from the interface-level spec (mesh-dim NAMES or None
+        per tensor dim, reference shard_tensor contract)."""
+        names = process_mesh.dim_names
+        dims = []
+        for s in (shard_spec or []):
+            if s is None:
+                dims.append(-1)
+            elif s in names:
+                dims.append(names.index(s))
+            else:
+                raise ValueError(
+                    "shard_spec entry %r is not a mesh dim name %s"
+                    % (s, names))
+        attr = cls(process_mesh, dims)
+        attr.verify(tensor)
+        return attr
+
+    # -- serialization (reference to_proto/from_proto) ------------------
+    def to_dict(self):
+        return {
+            "process_mesh": None if self.process_mesh is None else {
+                "shape": self.process_mesh.shape,
+                "process_ids": self.process_mesh.process_ids,
+                "dim_names": self.process_mesh.dim_names,
+            },
+            "dims_mapping": list(self.dims_mapping),
+            "batch_dim": self.batch_dim,
+            "dynamic_dims": list(self.dynamic_dims),
+        }
+
+    @classmethod
+    def from_dict(cls, d):
+        pm = d.get("process_mesh")
+        mesh = None
+        if pm is not None:
+            import numpy as np
+
+            mesh = ProcessMesh(
+                np.asarray(pm["process_ids"]).reshape(pm["shape"]),
+                pm["dim_names"])
+        return cls(mesh, d.get("dims_mapping"), d.get("batch_dim", 0),
+                   d.get("dynamic_dims"))
+
+    def __eq__(self, other):
+        return (isinstance(other, TensorDistAttr)
+                and self.process_mesh == other.process_mesh
+                and self.dims_mapping == other.dims_mapping
+                and self.batch_dim == other.batch_dim)
+
+    def __repr__(self):
+        return ("TensorDistAttr(mesh=%s, dims_mapping=%s)"
+                % (None if self.process_mesh is None
+                   else self.process_mesh.shape, self.dims_mapping))
+
+
+class OperatorDistAttr:
+    """Distribution of one op: per-input/output TensorDistAttr plus the
+    impl selection fields (reference OperatorDistAttr)."""
+
+    def __init__(self, process_mesh=None):
+        self.process_mesh = process_mesh
+        self.inputs_dist_attrs = {}
+        self.outputs_dist_attrs = {}
+        self.impl_type = "default"
+        self.impl_idx = 0
+        self.is_recompute = False
+        self.execution_stream = "auto"
+        self._annotated = set()
+
+    def set_input_dist_attr(self, name, attr):
+        self.inputs_dist_attrs[name] = attr
+
+    def get_input_dist_attr(self, name):
+        return self.inputs_dist_attrs.get(name)
+
+    def set_output_dist_attr(self, name, attr):
+        self.outputs_dist_attrs[name] = attr
+
+    def get_output_dist_attr(self, name):
+        return self.outputs_dist_attrs.get(name)
+
+    def mark_annotated(self, name):
+        self._annotated.add(name)
+
+    def is_annotated(self, name):
+        return name in self._annotated
+
+    def verify(self):
+        for attr in list(self.inputs_dist_attrs.values()) + \
+                list(self.outputs_dist_attrs.values()):
+            if attr.process_mesh is None and self.process_mesh is not None:
+                attr.process_mesh = self.process_mesh
+            attr.verify()
+        return True
+
+    def __repr__(self):
+        return ("OperatorDistAttr(impl=%s/%d, in=%s, out=%s)"
+                % (self.impl_type, self.impl_idx,
+                   {k: v.dims_mapping
+                    for k, v in self.inputs_dist_attrs.items()},
+                   {k: v.dims_mapping
+                    for k, v in self.outputs_dist_attrs.items()}))
+
+
+def get_dist_attr(x):
+    """The TensorDistAttr stamped on a tensor by shard_tensor/reshard
+    (reference dist_tensor.dist_attr)."""
+    return getattr(x, "_dist_attr", None)
+
+
+def reshard(x, process_mesh, shard_spec):
+    """Move a tensor to a (new) placement — the Resharder analog
+    (reference auto_parallel/reshard.py builds send/recv + slice/concat
+    programs between dist_attrs; under GSPMD one re-placement emits the
+    equivalent collective).
+
+    Eager: device_put to the new NamedSharding (XLA moves the shards).
+    Under jit tracing: with_sharding_constraint pins the new placement
+    and the partitioner inserts the collective (all-to-all /
+    collective-permute / all-gather as needed).
+    """
+    attr = TensorDistAttr.from_shard_spec(
+        process_mesh, shard_spec, x if isinstance(x, Tensor) else None)
+    mesh = process_mesh.get_mesh()
+    spec = attr.to_partition_spec()
+    v = x._value if isinstance(x, Tensor) else x
+    if isinstance(v, jax.core.Tracer):
+        out = jax.lax.with_sharding_constraint(v, NamedSharding(mesh, spec))
+        if isinstance(x, Tensor):
+            x._value = out
+            x._sharding_spec = spec
+            x._dist_attr = attr
+            return x
+        return out
+    from .partitioner import Resharder
+
+    placed, _comm = Resharder(mesh).reshard(
+        x if isinstance(x, Tensor) else v, spec, mesh)
+    if isinstance(x, Tensor):
+        x._dist_attr = attr
+        return x
+    return placed

@@ -32,8 +32,14 @@ def shard_tensor(x, process_mesh, shard_spec):
     spec = _to_partition_spec(shard_spec)
     mesh = process_mesh.get_mesh()
     if isinstance(x, Tensor):
+        # validate BEFORE mutating: an invalid spec (bad axis name,
+        # non-divisible dim) must not leave the tensor half-re-placed
+        from .dist_attr import TensorDistAttr
+
+        attr = TensorDistAttr.from_shard_spec(process_mesh, shard_spec, x)
         x._value = jax.device_put(x._value, NamedSharding(mesh, spec))
         x._sharding_spec = spec
+        x._dist_attr = attr  # typed introspection (reference dist_attr.cc)
         return x
     return jax.device_put(x, NamedSharding(mesh, spec))
 

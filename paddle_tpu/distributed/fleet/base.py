@@ -27,15 +27,10 @@ class DistributedStrategy:
     _NOOP_KNOBS = {
         "dgc": "deep gradient compression targets NVLink-poor clusters; "
                "ICI bandwidth makes it moot",
-        "localsgd": "local-SGD periodic sync is subsumed by compiled "
-                    "dp steps; no equivalent pass is applied",
-        "adaptive_localsgd": "see localsgd",
+        "adaptive_localsgd": "fixed-k localsgd is implemented; the "
+                             "loss-variance-adaptive k schedule is not",
         "fp16_allreduce": "grad dtype follows the amp policy; XLA fuses "
                           "any cast into the collective",
-        "lars": "use paddle.optimizer momentum variants directly; the "
-                "strategy flag applies no rewrite",
-        "lamb": "use paddle.optimizer.Lamb directly; the strategy flag "
-                "applies no rewrite",
         "heter_ccl_mode": "no heterogeneous NCCL/Gloo split exists; all "
                           "collectives ride XLA over ICI/DCN",
         "use_hierarchical_allreduce": "the ICI torus needs no "

@@ -75,6 +75,12 @@ class Fleet:
         hcg = self._hcg
         if hcg is None:
             raise RuntimeError("call fleet.init() first")
+        if getattr(self._strategy, "sync_batch_norm", False):
+            # reference sync_batch_norm strategy knob converts every
+            # BatchNorm to SyncBatchNorm (fleet/model.py)
+            from ...nn import SyncBatchNorm
+
+            model = SyncBatchNorm.convert_sync_batchnorm(model)
         if hcg.get_pipe_parallel_world_size() > 1:
             return PipelineParallel(model, hcg, self._strategy)
         if hcg.get_model_parallel_world_size() > 1:
@@ -83,9 +89,46 @@ class Fleet:
             return ShardingParallel(model, hcg, self._strategy)
         return DataParallel(model, hcg=hcg, strategy=self._strategy)
 
+    def _swap_inner_optimizer(self, optimizer):
+        """strategy.lamb / strategy.lars swap the inner optimizer for
+        the large-batch variant, as the reference meta-optimizers do
+        (lamb_optimizer.py: Adam -> Lamb; lars_optimizer.py:
+        Momentum -> LarsMomentum). The swap keeps lr scheduler,
+        parameter list and grad clip."""
+        from ...optimizer import Adam, Lamb, LarsMomentum, Momentum
+
+        s = self._strategy
+        lr = optimizer._lr_scheduler or optimizer._base_lr
+        params = optimizer._parameter_list
+        clip = optimizer._grad_clip
+        # exact-type matches, as the reference meta-optimizers'
+        # _can_apply do: AdamW's decoupled decay and Adamax's inf-norm
+        # update must NOT be silently replaced
+        if getattr(s, "lamb", False) and type(optimizer) is Adam:
+            cfg = getattr(s, "lamb_configs", None) or {}
+            return Lamb(
+                learning_rate=lr,
+                lamb_weight_decay=float(cfg.get("lamb_weight_decay", 0.01)),
+                beta1=optimizer._beta1, beta2=optimizer._beta2,
+                epsilon=optimizer._epsilon,
+                parameters=params, grad_clip=clip)
+        if getattr(s, "lars", False) and \
+                type(optimizer) is Momentum:
+            cfg = getattr(s, "lars_configs", None) or {}
+            return LarsMomentum(
+                learning_rate=lr, momentum=optimizer._momentum,
+                lars_coeff=float(cfg.get("lars_coeff", 0.001)),
+                lars_weight_decay=float(
+                    cfg.get("lars_weight_decay", 0.0005)),
+                parameters=params, grad_clip=clip,
+                exclude_from_weight_decay=list(
+                    cfg.get("exclude_from_weight_decay", [])))
+        return optimizer
+
     def distributed_optimizer(self, optimizer, strategy=None):
         if strategy is not None:
             self._strategy = strategy
+        optimizer = self._swap_inner_optimizer(optimizer)
         from ... import static as _static
 
         if not _static.in_dynamic_mode():

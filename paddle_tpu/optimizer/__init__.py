@@ -14,6 +14,7 @@ from .optimizers import (  # noqa: F401
     Adamax,
     AdamW,
     Lamb,
+    LarsMomentum,
     Momentum,
     RMSProp,
 )

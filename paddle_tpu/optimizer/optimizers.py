@@ -55,6 +55,55 @@ class Momentum(Optimizer):
         return update
 
 
+class LarsMomentum(Optimizer):
+    """LARS: momentum with a layer-adaptive local learning rate
+    (reference python LarsMomentumOptimizer, fluid/optimizer.py, and
+    phi/kernels/*lars_momentum*: local_lr = lr * lars_coeff * ||p|| /
+    (||g|| + lars_weight_decay * ||p|| + eps); v' = mu*v + local_lr *
+    (g + wd*p); p' = p - v'). Used for large-batch vision training;
+    fleet's strategy.lars knob swaps a Momentum inner optimizer to
+    this."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, parameters=None, grad_clip=None,
+                 epsilon=1e-9, exclude_from_weight_decay=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_weight_decay = lars_weight_decay
+        self._epsilon = epsilon
+        # reference excludes e.g. batch-norm params by name substring
+        self._exclude = list(exclude_from_weight_decay or [])
+
+    def _slots(self):
+        return ("velocity",)
+
+    def _init_slot(self, slot, param):
+        return jnp.zeros(param._value.shape, jnp.float32)
+
+    def _decay_for(self, param):
+        name = getattr(param, "name", "") or ""
+        if any(s in name for s in self._exclude):
+            return 0.0
+        return self._lars_weight_decay
+
+    def _make_update(self):
+        mu, coeff, eps = self._momentum, self._lars_coeff, self._epsilon
+
+        def update(p, g, state, lr, step, wd):
+            (v,) = state
+            pf = p.astype(jnp.float32)
+            gf = g.astype(jnp.float32)
+            pn = jnp.sqrt(jnp.sum(pf * pf))
+            gn = jnp.sqrt(jnp.sum(gf * gf))
+            local = lr * coeff * pn / (gn + wd * pn + eps)
+            local = jnp.where((pn > 0) & (gn > 0), local, lr)
+            v2 = mu * v + local * (gf + wd * pf)
+            return (pf - v2).astype(p.dtype), (v2,)
+
+        return update
+
+
 class _AdamBase(Optimizer):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, parameters=None, weight_decay=None,

@@ -34,7 +34,11 @@ class DataParallel(Layer):
     def sync_gradients(self):
         """Fused dp-group grad allreduce (reference
         fused_allreduce_gradients, fleet/utils/hybrid_parallel_util.py)."""
-        if self._group.nranks <= 1:
+        from .hybrid_optimizer import _eager_multiprocess
+
+        if not _eager_multiprocess(self._group):
+            # single-controller SPMD: the compiled step's psum already
+            # reduced grads over the sharded batch — nothing to sync
             return
         for p in self._layers.parameters():
             if p.grad is not None:

@@ -30,6 +30,19 @@ def _host_device():
         return None
 
 
+def _eager_multiprocess(group):
+    """True when the group has a real multi-process backend, i.e. each
+    process holds its OWN gradient value and an eager reduction is
+    meaningful. Under single-controller SPMD (one process, mesh axis
+    possibly >1) the compiled step already produced the globally-reduced
+    gradient — an extra eager allreduce would be wrong (and would try to
+    shard small tensors over the axis)."""
+    if group is None or group.nranks <= 1:
+        return False
+    pg = getattr(group, "pg", None)
+    return pg is not None and getattr(pg, "world_size", 1) > 1
+
+
 class HybridParallelOptimizer:
     def __init__(self, optimizer, hcg=None, strategy=None):
         self._inner_opt = optimizer
@@ -44,6 +57,14 @@ class HybridParallelOptimizer:
         sh_cfg = getattr(strategy, "sharding_configs", None) or {}
         self._offload = bool(getattr(strategy, "sharding", False)
                              and sh_cfg.get("offload", False))
+        # local SGD (reference localsgd_optimizer.py): k local updates
+        # without per-step grad sync, then average params across dp
+        ls_cfg = getattr(strategy, "localsgd_configs", None) or {}
+        self._localsgd = bool(getattr(strategy, "localsgd", False))
+        self._ls_k = max(1, int(ls_cfg.get("k_steps", 1))) \
+            if self._localsgd else 1
+        self._ls_begin = max(1, int(ls_cfg.get("begin_step", 1)))
+        self._ls_count = 0
 
     # -- gradient merge ----------------------------------------------------
 
@@ -124,9 +145,14 @@ class HybridParallelOptimizer:
                 return
         # dp grad sync (fused_allreduce_gradients analog); on the compiled
         # path XLA already inserted the reduction, eager path does it here.
-        if self._hcg is not None:
+        # Under local SGD (past begin_step) the per-step grad sync is
+        # skipped; parameters are averaged every k_steps instead.
+        self._ls_count += 1
+        ls_active = (self._localsgd
+                     and self._ls_count >= self._ls_begin)
+        if self._hcg is not None and not ls_active:
             dp_group = self._hcg.get_data_parallel_group()
-            if dp_group.nranks > 1:
+            if _eager_multiprocess(dp_group):
                 from ..distributed import collective
 
                 for p in self._inner_opt._get_params():
@@ -138,6 +164,18 @@ class HybridParallelOptimizer:
         self._inner_opt.step()
         if self._offload:
             self._offload_accumulators()
+        # window counts from activation, so every local window is
+        # exactly k_steps long regardless of begin_step
+        if ls_active and \
+                (self._ls_count - self._ls_begin + 1) % self._ls_k == 0 \
+                and self._hcg is not None:
+            dp_group = self._hcg.get_data_parallel_group()
+            if _eager_multiprocess(dp_group):
+                from ..distributed import collective
+
+                for p in self._inner_opt._get_params():
+                    collective.all_reduce(p, group=dp_group)
+                    p._value = p._value / dp_group.nranks
 
     def clear_grad(self, *a, **k):
         self._inner_opt.clear_grad(*a, **k)

@@ -291,3 +291,116 @@ class TestMeshPlanner:
         times = [s["time"] for _, s in ranking]
         assert times == sorted(times)
         assert all(s["mem"] <= 16e9 for _, s in ranking)
+
+
+class TestDistAttr:
+    """TensorDistAttr/OperatorDistAttr + reshard (reference
+    paddle/fluid/distributed/auto_parallel/dist_attr.cc and
+    auto_parallel/reshard.py)."""
+
+    def _mesh(self):
+        from paddle_tpu.distributed.auto_parallel import ProcessMesh
+
+        n = jax.device_count()
+        return ProcessMesh(
+            np.arange(n).reshape(2, n // 2), ["dp", "mp"])
+
+    def test_dims_mapping_partition_spec_roundtrip(self):
+        from paddle_tpu.distributed.auto_parallel import TensorDistAttr
+
+        pm = self._mesh()
+        attr = TensorDistAttr(pm, [0, -1, 1])
+        assert attr.verify()
+        assert attr.to_partition_spec() == P("dp", None, "mp")
+        back = TensorDistAttr.from_shard_spec(pm, ["dp", None, "mp"])
+        assert back.dims_mapping == [0, -1, 1]
+        assert back == attr
+
+    def test_verify_rejects_bad_mappings(self):
+        from paddle_tpu.distributed.auto_parallel import TensorDistAttr
+
+        pm = self._mesh()
+        with pytest.raises(ValueError):
+            TensorDistAttr(pm, [0, 0]).verify()  # mesh dim reused
+        with pytest.raises(ValueError):
+            TensorDistAttr(pm, [2]).verify()  # out of range
+        t = paddle.to_tensor(np.zeros((3, 8), np.float32))
+        with pytest.raises(ValueError):
+            # dim 0 (size 3) not divisible by dp degree 2
+            TensorDistAttr(pm, [0, -1]).verify(t)
+
+    def test_serialization_roundtrip(self):
+        from paddle_tpu.distributed.auto_parallel import TensorDistAttr
+
+        pm = self._mesh()
+        attr = TensorDistAttr(pm, [1, -1], batch_dim=0)
+        attr2 = TensorDistAttr.from_dict(attr.to_dict())
+        assert attr2 == attr
+
+    def test_operator_dist_attr(self):
+        from paddle_tpu.distributed.auto_parallel import (
+            OperatorDistAttr,
+            TensorDistAttr,
+        )
+
+        pm = self._mesh()
+        op = OperatorDistAttr(pm)
+        op.set_input_dist_attr("X", TensorDistAttr(None, [0, -1]))
+        op.set_output_dist_attr("Out", TensorDistAttr(pm, [0, 1]))
+        assert op.verify()  # fills missing meshes from the op mesh
+        assert op.get_input_dist_attr("X").process_mesh is pm
+        op.mark_annotated("process_mesh")
+        assert op.is_annotated("process_mesh")
+
+    def test_shard_tensor_stamps_dist_attr(self):
+        from paddle_tpu.distributed.auto_parallel import (
+            get_dist_attr,
+            shard_tensor,
+        )
+
+        pm = self._mesh()
+        t = paddle.to_tensor(np.ones((4, 8), np.float32))
+        shard_tensor(t, pm, ["dp", "mp"])
+        attr = get_dist_attr(t)
+        assert attr is not None and attr.dims_mapping == [0, 1]
+
+    def test_reshard_eager_moves_placement(self):
+        from paddle_tpu.distributed.auto_parallel import (
+            get_dist_attr,
+            reshard,
+            shard_tensor,
+        )
+
+        pm = self._mesh()
+        rng = np.random.RandomState(0)
+        a = rng.randn(8, 8).astype(np.float32)
+        t = paddle.to_tensor(a)
+        shard_tensor(t, pm, ["dp", None])  # row-sharded
+        reshard(t, pm, [None, "mp"])  # -> col-sharded
+        spec = tuple(t._value.sharding.spec)
+        assert spec in ((None, "mp"), (None, ("mp",))), spec
+        np.testing.assert_allclose(np.asarray(t._value), a)  # values kept
+        assert get_dist_attr(t).dims_mapping == [-1, 1]
+
+    def test_reshard_under_jit_emits_collective(self):
+        from paddle_tpu.distributed.auto_parallel import reshard
+
+        pm = self._mesh()
+        mesh = pm.get_mesh()
+        from jax.sharding import NamedSharding
+
+        def fn(v):
+            return reshard(v * 2.0, pm, [None, "mp"])
+
+        a = np.ones((8, 8), np.float32)
+        placed = jax.device_put(a, NamedSharding(mesh, P("dp", None)))
+        jitted = jax.jit(fn)
+        out = jitted(placed)
+        np.testing.assert_allclose(np.asarray(out), a * 2.0)
+        spec = tuple(out.sharding.spec)
+        assert spec in ((None, "mp"), (None, ("mp",))), spec
+        # the compiled module must contain a layout-changing collective
+        hlo = jitted.lower(placed).compile().as_text()
+        assert any(k in hlo for k in
+                   ("all-to-all", "collective-permute", "all-gather")), \
+            "no collective in resharding module"
