@@ -104,6 +104,8 @@ class CompiledTrainStep:
             self.batch_spec = P(tuple(batch_axes)) if batch_axes else P()
         self._shard_params()
         self._compiled = None
+        self._compiled_multi = None
+        self._step_fn = None
 
     # -- sharding specs ----------------------------------------------------
 
@@ -199,6 +201,9 @@ class CompiledTrainStep:
                 out_state.append(new_p[n] if n in new_p else state[n])
             return loss, out_state, new_s
 
+        self._step_fn = step
+        self._shardings = (state_shardings, opt_shardings, batch_sharding,
+                           repl)
         self._compiled = jax.jit(
             step,
             in_shardings=(state_shardings, opt_shardings, None,
@@ -207,11 +212,75 @@ class CompiledTrainStep:
             donate_argnums=(0, 1) if self.donate else (),
         )
 
-    def _prep_batch(self, batch):
+    def _build_multi(self):
+        """K train steps inside ONE compiled module: fori_loop over
+        batches stacked on a leading axis. This is the device-side input
+        pipeline pattern (host stages K batches, the chip loops) — it
+        amortizes per-call host->device dispatch, which through a
+        tunneled/remote device can cost several ms per call."""
+        if self._step_fn is None:
+            self._build()
+        step_fn = self._step_fn
+        (state_shardings, opt_shardings, _batch_sharding, repl) = \
+            self._shardings
+        stacked_sharding = self._batch_sharding(stacked=True)
+
+        def multi(state_vals, opt_state, step0, batches):
+            k = batches[0].shape[0]
+
+            def body(i, carry):
+                sv, ost, _ = carry
+                batch = tuple(b[i] for b in batches)
+                loss, new_sv, new_ost = step_fn(
+                    sv, ost, step0 + i.astype(jnp.int32), batch)
+                return (new_sv, new_ost, loss.astype(jnp.float32))
+
+            init = (state_vals, opt_state, jnp.float32(0))
+            sv, ost, loss = jax.lax.fori_loop(0, k, body, init)
+            return loss, sv, ost
+
+        self._compiled_multi = jax.jit(
+            multi,
+            in_shardings=(state_shardings, opt_shardings, None,
+                          stacked_sharding),
+            out_shardings=(repl, state_shardings, opt_shardings),
+            donate_argnums=(0, 1) if self.donate else (),
+        )
+
+    @no_grad()
+    def run_steps(self, *stacked_batch):
+        """Run K = leading-dim train steps in one device call.
+
+        Each element of `stacked_batch` carries a leading K axis
+        ([K, batch, ...]); step i consumes slice i. Numerically
+        identical to K sequential __call__s (same optimizer step
+        counter sequence); returns the LAST step's loss.
+        """
+        if getattr(self, "_compiled_multi", None) is None:
+            self._build_multi()
+        vals = self._prep_batch(stacked_batch, stacked=True)
+        k = int(vals[0].shape[0])
+        tensors = self._tensors
+        state_vals = [tensors[n]._value for n in self._names]
+        loss, new_state, new_opt = self._compiled_multi(
+            state_vals, self._opt_state,
+            jnp.asarray(self._step_count + 1, jnp.int32), vals)
+        self._step_count += k
+        for n, v in zip(self._names, new_state):
+            tensors[n]._value = v
+        self._opt_state = new_opt
+        return Tensor(loss)
+
+    def _batch_sharding(self, stacked=False):
+        spec = P(*((None,) + tuple(self.batch_spec))) if stacked \
+            else self.batch_spec
+        return NamedSharding(self.mesh, spec)
+
+    def _prep_batch(self, batch, stacked=False):
+        sharding = self._batch_sharding(stacked)
         return tuple(
             jax.device_put(b._value if isinstance(b, Tensor)
-                           else jnp.asarray(b),
-                           NamedSharding(self.mesh, self.batch_spec))
+                           else jnp.asarray(b), sharding)
             for b in batch)
 
     def lowered_hlo(self, *batch):
