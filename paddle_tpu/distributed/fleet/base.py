@@ -27,8 +27,6 @@ class DistributedStrategy:
     _NOOP_KNOBS = {
         "dgc": "deep gradient compression targets NVLink-poor clusters; "
                "ICI bandwidth makes it moot",
-        "adaptive_localsgd": "fixed-k localsgd is implemented; the "
-                             "loss-variance-adaptive k schedule is not",
         "fp16_allreduce": "grad dtype follows the amp policy; XLA fuses "
                           "any cast into the collective",
         "heter_ccl_mode": "no heterogeneous NCCL/Gloo split exists; all "
@@ -117,6 +115,8 @@ class DistributedStrategy:
         self.cudnn_batchnorm_spatial_persistent = False
         self.conv_workspace_size_limit = 512
         self.adaptive_localsgd = False
+        self.adaptive_localsgd_configs = {"init_k_steps": 1,
+                                          "begin_step": 1}
         self.localsgd_configs = {"k_steps": 1, "begin_step": 1}
         self.dgc_configs = {"rampup_begin_step": 0}
         self.lars_configs = {"lars_coeff": 0.001, "lars_weight_decay": 5e-4}

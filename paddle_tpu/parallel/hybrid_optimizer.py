@@ -58,13 +58,30 @@ class HybridParallelOptimizer:
         self._offload = bool(getattr(strategy, "sharding", False)
                              and sh_cfg.get("offload", False))
         # local SGD (reference localsgd_optimizer.py): k local updates
-        # without per-step grad sync, then average params across dp
-        ls_cfg = getattr(strategy, "localsgd_configs", None) or {}
-        self._localsgd = bool(getattr(strategy, "localsgd", False))
-        self._ls_k = max(1, int(ls_cfg.get("k_steps", 1))) \
-            if self._localsgd else 1
+        # without per-step grad sync, then average params across dp.
+        # adaptive variant (AdaptiveLocalSGDOptimizer): k re-derived at
+        # every sync as ceil(sqrt(lr_0*loss/(lr*loss_0) * init_k)),
+        # clipped to [1, 16] — the reference's Adaptive Communication
+        # Strategies schedule.
+        self._ls_adaptive = bool(getattr(strategy, "adaptive_localsgd",
+                                         False))
+        if self._ls_adaptive:
+            ls_cfg = getattr(strategy,
+                             "adaptive_localsgd_configs", None) or {}
+            self._localsgd = True
+            self._ls_k = max(1, int(ls_cfg.get("init_k_steps", 1)))
+        else:
+            ls_cfg = getattr(strategy, "localsgd_configs", None) or {}
+            self._localsgd = bool(getattr(strategy, "localsgd", False))
+            self._ls_k = max(1, int(ls_cfg.get("k_steps", 1))) \
+                if self._localsgd else 1
+        self._ls_init_k = self._ls_k
         self._ls_begin = max(1, int(ls_cfg.get("begin_step", 1)))
         self._ls_count = 0
+        self._ls_next_sync = None
+        self._ls_loss0 = None
+        self._ls_lr0 = None
+        self._last_loss = None
 
     # -- gradient merge ----------------------------------------------------
 
@@ -165,9 +182,11 @@ class HybridParallelOptimizer:
         if self._offload:
             self._offload_accumulators()
         # window counts from activation, so every local window is
-        # exactly k_steps long regardless of begin_step
-        if ls_active and \
-                (self._ls_count - self._ls_begin + 1) % self._ls_k == 0 \
+        # exactly k_steps long regardless of begin_step; an explicit
+        # next-sync pointer lets the adaptive variant vary k per window
+        if ls_active and self._ls_next_sync is None:
+            self._ls_next_sync = self._ls_begin + self._ls_k - 1
+        if ls_active and self._ls_count >= self._ls_next_sync \
                 and self._hcg is not None:
             dp_group = self._hcg.get_data_parallel_group()
             if _eager_multiprocess(dp_group):
@@ -176,6 +195,36 @@ class HybridParallelOptimizer:
                 for p in self._inner_opt._get_params():
                     collective.all_reduce(p, group=dp_group)
                     p._value = p._value / dp_group.nranks
+            if self._ls_adaptive:
+                self._ls_k = self._adaptive_k(dp_group)
+            self._ls_next_sync = self._ls_count + self._ls_k
+
+    def _adaptive_k(self, dp_group):
+        """Next window length from the reference formula
+        ceil(sqrt(lr_0*loss / (lr*loss_0) * init_k)), clipped to 16
+        (localsgd_optimizer.py communicate_avg_loss). Needs the loss —
+        available on the minimize() flow; plain step() keeps current k."""
+        import math
+
+        loss_t = self._last_loss
+        if loss_t is None:
+            return self._ls_k
+        loss = float(loss_t) if not hasattr(loss_t, "_value") \
+            else float(loss_t._value)
+        if _eager_multiprocess(dp_group):
+            from ..core.tensor import Tensor as _T
+            from ..distributed import collective
+
+            t = collective.all_reduce(_T(loss), group=dp_group)
+            loss = float(t._value) / dp_group.nranks
+        lr_t = max(float(self._inner_opt.get_lr()), 1e-12)
+        if self._ls_loss0 is None:
+            self._ls_loss0 = max(loss, 1e-12)
+            self._ls_lr0 = lr_t
+            return self._ls_k
+        ratio = (self._ls_lr0 * loss) / (lr_t * self._ls_loss0)
+        k = math.ceil(math.sqrt(max(ratio, 0.0) * self._ls_init_k))
+        return int(min(16, max(1, k)))
 
     def clear_grad(self, *a, **k):
         self._inner_opt.clear_grad(*a, **k)
@@ -183,6 +232,7 @@ class HybridParallelOptimizer:
     clear_gradients = clear_grad
 
     def minimize(self, loss, *a, **k):
+        self._last_loss = loss  # adaptive localsgd reads it at sync
         loss.backward()
         self.step()
 
