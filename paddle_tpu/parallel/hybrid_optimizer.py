@@ -78,7 +78,8 @@ class HybridParallelOptimizer:
         self._ls_init_k = self._ls_k
         self._ls_begin = max(1, int(ls_cfg.get("begin_step", 1)))
         self._ls_count = 0
-        self._ls_next_sync = None
+        # first window ends k-1 effective steps after activation
+        self._ls_next_sync = self._ls_begin + self._ls_k - 1
         self._ls_loss0 = None
         self._ls_lr0 = None
         self._last_loss = None
@@ -184,8 +185,6 @@ class HybridParallelOptimizer:
         # window counts from activation, so every local window is
         # exactly k_steps long regardless of begin_step; an explicit
         # next-sync pointer lets the adaptive variant vary k per window
-        if ls_active and self._ls_next_sync is None:
-            self._ls_next_sync = self._ls_begin + self._ls_k - 1
         if ls_active and self._ls_count >= self._ls_next_sync \
                 and self._hcg is not None:
             dp_group = self._hcg.get_data_parallel_group()
@@ -207,6 +206,10 @@ class HybridParallelOptimizer:
         import math
 
         loss_t = self._last_loss
+        # consume it: a stale loss from an old minimize() call must not
+        # keep driving the schedule once the user switches to plain
+        # backward();step() loops
+        self._last_loss = None
         if loss_t is None:
             return self._ls_k
         loss = float(loss_t) if not hasattr(loss_t, "_value") \

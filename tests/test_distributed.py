@@ -512,6 +512,74 @@ class TestOptimizerSwapKnobs:
         assert sync_steps == [6, 10], sync_steps
 
 
+    def test_adaptive_localsgd_recomputes_k(self):
+        # reference AdaptiveLocalSGDOptimizer:
+        # k = clip(ceil(sqrt(lr_0*loss/(lr*loss_0) * init_k)), 1, 16).
+        # Deterministic positive-ratio check: a tiny lr keeps the (mse,
+        # always positive) loss ~constant, so the ratio is controlled
+        # purely by the lr change: lr0/lr = 0.5 with init_k=4 gives
+        # k = ceil(sqrt(0.5*4)) = 2.
+        from paddle_tpu import nn, optimizer
+        from paddle_tpu.distributed import fleet
+        from paddle_tpu.parallel.hybrid_optimizer import (
+            HybridParallelOptimizer,
+        )
+
+        class FakePg:
+            world_size = 1  # single process: skip real collectives
+
+        class FakeGroup:
+            nranks = 1
+            pg = FakePg()
+
+        class FakeHcg:
+            def get_data_parallel_group(self):
+                return FakeGroup()
+
+        paddle.seed(0)
+        strategy = fleet.DistributedStrategy()
+        strategy.adaptive_localsgd = True
+        strategy.adaptive_localsgd_configs = {"init_k_steps": 4,
+                                              "begin_step": 1}
+        lin = nn.Linear(2, 1, bias_attr=False)
+        lin.weight.set_value(np.full((2, 1), 0.5, np.float32))
+        opt = HybridParallelOptimizer(
+            optimizer.SGD(learning_rate=1e-4,
+                          parameters=lin.parameters()),
+            hcg=FakeHcg(), strategy=strategy)
+        assert opt._ls_k == 4 and opt._localsgd
+        x = paddle.to_tensor(np.ones((1, 2), np.float32))
+
+        def run_window():
+            for _ in range(opt._ls_k):
+                out = lin(x)
+                loss = ((out - 2.0) * (out - 2.0)).mean()
+                opt.minimize(loss)
+                opt.clear_grad()
+
+        # first window (steps 1..4): sync at 4 records loss_0, lr_0
+        run_window()
+        assert opt._ls_loss0 is not None and opt._ls_loss0 > 0
+        assert opt._ls_k == 4  # first sync only initializes
+        # double the lr: ratio ~ lr0/lr = 0.5 -> k = ceil(sqrt(2)) = 2
+        opt.set_lr(2e-4)
+        run_window()
+        assert opt._ls_k == 2, opt._ls_k
+        # halve below lr0: ratio ~ 2 -> k = ceil(sqrt(8)) = 3
+        opt.set_lr(5e-5)
+        run_window()
+        assert opt._ls_k == 3, opt._ls_k
+        # plain backward();step() loop (no minimize): the stale loss was
+        # consumed, so k holds instead of drifting from old data
+        opt.set_lr(1e-5)
+        for _ in range(opt._ls_k):
+            out = lin(x)
+            (((out - 2.0) * (out - 2.0)).mean()).backward()
+            opt.step()
+            opt.clear_grad()
+        assert opt._ls_k == 3, opt._ls_k
+
+
 class TestRunSteps:
     """CompiledTrainStep.run_steps: K steps in one compiled call over
     stacked batches must be numerically identical to K sequential
@@ -572,61 +640,3 @@ class TestRunSteps:
         l2 = float(step.run_steps(paddle.to_tensor(xs),
                                   paddle.to_tensor(ys)))
         assert np.isfinite(l1) and np.isfinite(l2) and l2 < l1
-
-    def test_adaptive_localsgd_recomputes_k(self):
-        # reference AdaptiveLocalSGDOptimizer:
-        # k = clip(ceil(sqrt(lr_0*loss/(lr*loss_0) * init_k)), 1, 16).
-        # Deterministic positive-ratio check: a tiny lr keeps the (mse,
-        # always positive) loss ~constant, so the ratio is controlled
-        # purely by the lr change: lr0/lr = 0.5 with init_k=4 gives
-        # k = ceil(sqrt(0.5*4)) = 2.
-        from paddle_tpu import nn, optimizer
-        from paddle_tpu.distributed import fleet
-        from paddle_tpu.parallel.hybrid_optimizer import (
-            HybridParallelOptimizer,
-        )
-
-        class FakePg:
-            world_size = 1  # single process: skip real collectives
-
-        class FakeGroup:
-            nranks = 1
-            pg = FakePg()
-
-        class FakeHcg:
-            def get_data_parallel_group(self):
-                return FakeGroup()
-
-        paddle.seed(0)
-        strategy = fleet.DistributedStrategy()
-        strategy.adaptive_localsgd = True
-        strategy.adaptive_localsgd_configs = {"init_k_steps": 4,
-                                              "begin_step": 1}
-        lin = nn.Linear(2, 1, bias_attr=False)
-        lin.weight.set_value(np.full((2, 1), 0.5, np.float32))
-        opt = HybridParallelOptimizer(
-            optimizer.SGD(learning_rate=1e-4,
-                          parameters=lin.parameters()),
-            hcg=FakeHcg(), strategy=strategy)
-        assert opt._ls_k == 4 and opt._localsgd
-        x = paddle.to_tensor(np.ones((1, 2), np.float32))
-
-        def run_window():
-            for _ in range(opt._ls_k):
-                out = lin(x)
-                loss = ((out - 2.0) * (out - 2.0)).mean()
-                opt.minimize(loss)
-                opt.clear_grad()
-
-        # first window (steps 1..4): sync at 4 records loss_0, lr_0
-        run_window()
-        assert opt._ls_loss0 is not None and opt._ls_loss0 > 0
-        assert opt._ls_k == 4  # first sync only initializes
-        # double the lr: ratio ~ lr0/lr = 0.5 -> k = ceil(sqrt(2)) = 2
-        opt.set_lr(2e-4)
-        run_window()
-        assert opt._ls_k == 2, opt._ls_k
-        # halve below lr0: ratio ~ 2 -> k = ceil(sqrt(8)) = 3
-        opt.set_lr(5e-5)
-        run_window()
-        assert opt._ls_k == 3, opt._ls_k
