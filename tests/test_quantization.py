@@ -248,3 +248,175 @@ class TestPerChannel:
         q(big)
         np.testing.assert_allclose(q.observer.scale().ravel(),
                                    [0.1, 0.1])
+
+
+class TestInt8Execution:
+    """True int8 serving path (reference deploys quantized models via
+    int8 kernels — slim save_quantized_model + inference int8; here an
+    s8 x s8 -> s32 dot_general on the MXU)."""
+
+    def test_int8_linear_matches_fake_quant(self):
+        from paddle_tpu import nn
+        from paddle_tpu.quantization import Int8Linear, QuantedLinear
+
+        paddle.seed(0)
+        lin = nn.Linear(16, 8)
+        q = QuantedLinear(lin)
+        q.eval()
+        rng = np.random.RandomState(1)
+        x = paddle.to_tensor(rng.randn(4, 16).astype(np.float32))
+        # calibrate the act observer once
+        q.train()
+        q(x)
+        q.eval()
+        ref = q(x).numpy()
+        obs = q.act_quanter.observer
+        i8 = Int8Linear(lin, act_scale=float(obs.scale()))
+        out = i8(x).numpy()
+        # identical math: exact int32 accumulation vs fp32 sum of
+        # exactly-representable integer products
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+    def test_dynamic_scale_close_to_float(self):
+        from paddle_tpu import nn
+        from paddle_tpu.quantization import Int8Linear
+
+        paddle.seed(1)
+        lin = nn.Linear(32, 4)
+        rng = np.random.RandomState(2)
+        x = paddle.to_tensor(rng.randn(8, 32).astype(np.float32))
+        ref = lin(x).numpy()
+        out = Int8Linear(lin)(x).numpy()
+        err = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-8)
+        assert err < 0.05, err  # 8-bit relative error envelope
+
+    def test_compiled_module_contains_s8_dot(self):
+        import jax
+        from paddle_tpu import nn
+        from paddle_tpu.quantization import Int8Linear
+
+        paddle.seed(2)
+        i8 = Int8Linear(nn.Linear(16, 16))
+
+        def fn(v):
+            return i8(v)._value
+
+        x = np.ones((4, 16), np.float32)
+        hlo = jax.jit(fn).lower(x).compile().as_text()
+        assert "s8" in hlo, "int8 operands absent from compiled module"
+
+    def test_convert_to_int8_model(self):
+        from paddle_tpu import nn
+        from paddle_tpu.quantization import (
+            Int8Linear,
+            PTQ,
+            convert_to_int8,
+        )
+
+        paddle.seed(3)
+        model = nn.Sequential(nn.Linear(12, 24), nn.ReLU(),
+                              nn.Linear(24, 4))
+        rng = np.random.RandomState(3)
+        X = rng.randn(64, 12).astype(np.float32)
+        ref = model(paddle.to_tensor(X)).numpy()
+        ptq = PTQ()
+        q = ptq.quantize(model)
+        ptq.calibrate(q, [X[i:i + 16] for i in range(0, 64, 16)])
+        deploy = convert_to_int8(q)
+        kinds = [type(m).__name__ for m in deploy.sublayers()]
+        assert kinds.count("Int8Linear") == 2, kinds
+        out = deploy(paddle.to_tensor(X)).numpy()
+        # the contract: int8 execution reproduces the fake-quant
+        # simulation it was converted from
+        q.eval()
+        sim = q(paddle.to_tensor(X)).numpy()
+        rel_sim = np.abs(out - sim).max() / (np.abs(sim).max() + 1e-8)
+        assert rel_sim < 0.02, rel_sim
+        # and stays in the 8-bit envelope of the float model
+        rel = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-8)
+        assert rel < 0.2, rel
+        # original model untouched (inplace=False)
+        assert any(isinstance(m, nn.Linear)
+                   for m in model.sublayers())
+
+    def test_uncalibrated_convert_falls_back_to_dynamic(self):
+        # review regression: an unobserved activation observer's 1e-8
+        # placeholder must NOT be frozen as a static scale
+        from paddle_tpu import nn
+        from paddle_tpu.quantization import PTQ, convert_to_int8
+
+        paddle.seed(4)
+        model = nn.Sequential(nn.Linear(8, 8))
+        x = paddle.to_tensor(
+            np.random.RandomState(4).randn(4, 8).astype(np.float32))
+        ref = model(x).numpy()
+        q = PTQ().quantize(model)  # no calibrate()
+        deploy = convert_to_int8(q)
+        out = deploy(x).numpy()
+        rel = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-8)
+        assert rel < 0.1, rel  # dynamic path, not collapsed to ~0
+
+    def test_quant_bits_flow_through_and_validate(self):
+        from paddle_tpu import nn
+        from paddle_tpu.quantization import (
+            Int8Linear,
+            QuantConfig,
+            QAT,
+            convert_to_int8,
+        )
+
+        paddle.seed(5)
+        with pytest.raises(ValueError):
+            Int8Linear(nn.Linear(4, 4), quant_bits=16)
+        cfg = QuantConfig(quant_bits=4)
+        q = QAT(cfg).quantize(nn.Sequential(nn.Linear(4, 4)))
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        q.train()
+        q(x)
+        deploy = convert_to_int8(q)
+        i8 = [m for m in deploy.sublayers()
+              if isinstance(m, Int8Linear)][0]
+        assert i8.quant_bits == 4, i8.quant_bits
+
+    def test_per_tensor_weight_scale_adopted(self):
+        # review regression: abs_max (per-tensor) weight observers store
+        # state in _state; their calibrated scale must be adopted, not
+        # silently replaced with a per-channel recompute
+        from paddle_tpu import nn
+        from paddle_tpu.quantization import Int8Linear, QuantedLinear, \
+            convert_to_int8
+
+        paddle.seed(6)
+        lin = nn.Linear(8, 4)
+        q = QuantedLinear(lin, weight_quantize_type="abs_max")
+        holder = nn.Sequential(q)
+        x = paddle.to_tensor(
+            np.random.RandomState(6).randn(4, 8).astype(np.float32))
+        holder.train()
+        holder(x)
+        holder.eval()
+        sim = holder(x).numpy()
+        deploy = convert_to_int8(holder)
+        i8 = [m for m in deploy.sublayers()
+              if isinstance(m, Int8Linear)][0]
+        assert np.ndim(np.asarray(i8._w_scale)) == 0 or \
+            np.asarray(i8._w_scale).size == 1  # per-tensor adopted
+        out = deploy(x).numpy()
+        rel = np.abs(out - sim).max() / (np.abs(sim).max() + 1e-8)
+        assert rel < 0.02, rel
+        # and the source model was not mutated
+        assert any(isinstance(m, QuantedLinear)
+                   for m in holder.sublayers())
+
+    def test_one_dim_input_keeps_shape(self):
+        from paddle_tpu import nn
+        from paddle_tpu.quantization import Int8Linear
+
+        paddle.seed(7)
+        lin = nn.Linear(6, 3)
+        # per-channel scale in the observers' broadcast shape (1, out)
+        w = np.asarray(lin.weight._value)
+        ws = np.abs(w).max(axis=0, keepdims=True)  # (1, 3)
+        i8 = Int8Linear(lin, w_scale=ws)
+        out = i8(paddle.to_tensor(np.ones(6, np.float32)))
+        assert out.shape == [3], out.shape
