@@ -35,7 +35,11 @@ def _norm_padding(padding, n):
     return [tuple(p) for p in padding]
 
 
-def _conv(x, weight, bias, stride, padding, dilation, groups, n, channel_last):
+def _conv(x, weight, bias, stride, padding, dilation, groups, n,
+          channel_last, preferred_element_type=None):
+    # preferred_element_type: int8 serving convs accumulate in int32
+    # (quantization.Int8Conv2D) — same padding/stride normalization,
+    # different accumulator
     x, w = _A(x), _A(weight)
     stride = _norm_tuple(stride, n)
     dilation = _norm_tuple(dilation, n)
@@ -56,6 +60,7 @@ def _conv(x, weight, bias, stride, padding, dilation, groups, n, channel_last):
         rhs_dilation=dilation,
         dimension_numbers=dn,
         feature_group_count=groups,
+        preferred_element_type=preferred_element_type,
     )
     if bias is not None:
         b = _A(bias)

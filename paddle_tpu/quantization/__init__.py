@@ -26,6 +26,7 @@ __all__ = [
     "MovingAverageAbsMaxObserver", "AbsMaxObserver",
     "ChannelWiseAbsMaxObserver", "HistObserver",
     "fake_quantize_dequantize",
+    "Int8Linear", "Int8Conv2D", "convert_to_int8",
 ]
 
 
@@ -400,7 +401,36 @@ def quant_linear(x, w, b, scale_x, scale_w, bit_length=8):
 # channel at convert time; the integer matmul accumulates exactly in
 # int32 and dequantizes with (act_scale * channel_scale / qmax^2).
 
-class Int8Linear(Layer):
+class _Int8Base(Layer):
+    """Shared int8-execution scaffolding: quant_bits validation, the
+    static/dynamic activation scale policy, and the quantize/dequantize
+    steps — one definition, so the rounding mode and scale floors cannot
+    diverge between the linear and conv layers."""
+
+    def _init_bits(self, quant_bits):
+        if not 2 <= quant_bits <= 8:
+            raise ValueError(
+                "%s executes in int8 storage: quant_bits must be in "
+                "[2, 8], got %d" % (type(self).__name__, quant_bits))
+        self.quant_bits = quant_bits
+        self._qmax = float(2 ** (quant_bits - 1) - 1)
+
+    def _quantize_weight(self, w, w_scale):
+        """int8 weight + broadcast scale for the dequant multiply."""
+        return jnp.clip(jnp.round(w / w_scale * self._qmax),
+                        -self._qmax, self._qmax).astype(jnp.int8)
+
+    def _act_scale_of(self, vf):
+        if self._act_scale is None:
+            return jnp.maximum(jnp.max(jnp.abs(vf)), 1e-8)
+        return jnp.asarray(self._act_scale, jnp.float32)
+
+    def _quantize_act(self, vf, s_x):
+        return jnp.clip(jnp.round(vf / s_x * self._qmax),
+                        -self._qmax, self._qmax).astype(jnp.int8)
+
+
+class Int8Linear(_Int8Base):
     """Linear executing as a true int8 matmul.
 
     Given the same scales, output matches the fake-quant QuantedLinear
@@ -412,13 +442,7 @@ class Int8Linear(Layer):
     def __init__(self, inner, act_scale=None, quant_bits=8,
                  w_scale=None):
         super().__init__()
-        if not 2 <= quant_bits <= 8:
-            raise ValueError(
-                "Int8Linear executes in int8 storage: quant_bits must be "
-                "in [2, 8], got %d" % quant_bits)
-        qmax = float(2 ** (quant_bits - 1) - 1)
-        self.quant_bits = quant_bits
-        self._qmax = qmax
+        self._init_bits(quant_bits)
         w = inner.weight._value.astype(jnp.float32)  # [in, out]
         if w_scale is None:
             w_scale = jnp.maximum(jnp.max(jnp.abs(w), axis=0), 1e-8)
@@ -430,8 +454,8 @@ class Int8Linear(Layer):
                 # a spurious leading dim on 1-D inputs
                 w_scale = w_scale.reshape(-1)
         self._w_scale = w_scale  # [out] or scalar
-        self.register_buffer("weight_int8", Tensor(jnp.clip(
-            jnp.round(w / w_scale * qmax), -qmax, qmax).astype(jnp.int8)))
+        self.register_buffer(
+            "weight_int8", Tensor(self._quantize_weight(w, w_scale)))
         self.bias = inner.bias
         # static (calibrated) activation scale, or None -> dynamic
         # per-call abs-max quantization
@@ -441,12 +465,8 @@ class Int8Linear(Layer):
         v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
         vf = v.astype(jnp.float32)
         qmax = self._qmax
-        if self._act_scale is None:
-            s_x = jnp.maximum(jnp.max(jnp.abs(vf)), 1e-8)
-        else:
-            s_x = jnp.asarray(self._act_scale, jnp.float32)
-        xq = jnp.clip(jnp.round(vf / s_x * qmax),
-                      -qmax, qmax).astype(jnp.int8)
+        s_x = self._act_scale_of(vf)
+        xq = self._quantize_act(vf, s_x)
         acc = jax.lax.dot_general(
             xq, self.weight_int8._value,
             (((xq.ndim - 1,), (0,)), ((), ())),
@@ -458,13 +478,14 @@ class Int8Linear(Layer):
 
 
 def convert_to_int8(model, inplace=False):
-    """Convert a (calibrated) model to true int8 execution: QuantedLinear
-    layers adopt their observed scales; plain Linear layers fall back to
-    dynamic activation quantization (reference
+    """Convert a (calibrated) model to true int8 execution:
+    QuantedLinear/QuantedConv2D layers adopt their observed scales;
+    plain Linear/Conv2D layers fall back to dynamic activation
+    quantization (reference
     ImperativeQuantAware.save_quantized_model freezes observers into an
     int8 inference program the same way, slim/quantization/imperative/
     qat.py)."""
-    from ..nn import Linear
+    from ..nn import Conv2D, Linear
 
     if not inplace:
         model = copy.deepcopy(model)
@@ -485,29 +506,41 @@ def convert_to_int8(model, inplace=False):
             return obs._absmax > 0
         return False
 
+    def scales_of(sub):
+        # adopt the calibrated scales: quanters expose .observer with
+        # .scale() (scalar for activations; per-out-channel for
+        # channel_wise weights, scalar for abs_max weights — all absmax
+        # conventions, same as the Int8 layers')
+        scale = None
+        obs = getattr(sub.act_quanter, "observer", None)
+        if observed(obs):
+            s = obs.scale()
+            if np.isscalar(s) or np.ndim(s) == 0:
+                scale = float(s)
+        w_scale = None
+        wobs = getattr(sub.weight_quanter, "observer", None)
+        if observed(wobs):
+            w_scale = np.asarray(wobs.scale())
+        return scale, w_scale
+
     def convert(layer):
         for name, sub in list(layer._sub_layers.items()):
             if isinstance(sub, QuantedLinear):
-                # adopt the calibrated scales: quanters expose .observer
-                # with .scale() (scalar for activations; per-out-channel
-                # for channel_wise weights, scalar for abs_max weights —
-                # all absmax conventions, same as Int8Linear's)
-                scale = None
-                obs = getattr(sub.act_quanter, "observer", None)
-                if observed(obs):
-                    s = obs.scale()
-                    if np.isscalar(s) or np.ndim(s) == 0:
-                        scale = float(s)
-                w_scale = None
-                wobs = getattr(sub.weight_quanter, "observer", None)
-                if observed(wobs):
-                    w_scale = np.asarray(wobs.scale())
+                scale, w_scale = scales_of(sub)
                 layer._sub_layers[name] = Int8Linear(
+                    sub.inner, act_scale=scale,
+                    quant_bits=sub.weight_quanter.quant_bits,
+                    w_scale=w_scale)
+            elif isinstance(sub, QuantedConv2D):
+                scale, w_scale = scales_of(sub)
+                layer._sub_layers[name] = Int8Conv2D(
                     sub.inner, act_scale=scale,
                     quant_bits=sub.weight_quanter.quant_bits,
                     w_scale=w_scale)
             elif isinstance(sub, Linear):
                 layer._sub_layers[name] = Int8Linear(sub)
+            elif isinstance(sub, Conv2D):
+                layer._sub_layers[name] = Int8Conv2D(sub)
             else:
                 convert(sub)
         return layer
@@ -515,3 +548,50 @@ def convert_to_int8(model, inplace=False):
     m = convert(model)
     m.eval()
     return m
+
+
+class Int8Conv2D(_Int8Base):
+    """Conv2D executing as a true int8 convolution (s8 x s8 -> s32;
+    the reference's onednn/TRT int8 conv kernels, TPU-native on the
+    MXU). Per-output-channel weight scales; static-calibrated or
+    dynamic activation scale."""
+
+    def __init__(self, inner, act_scale=None, quant_bits=8, w_scale=None):
+        super().__init__()
+        self._init_bits(quant_bits)
+        w = inner.weight._value.astype(jnp.float32)  # [out, in, kh, kw]
+        if w_scale is None:
+            w_scale = jnp.maximum(
+                jnp.max(jnp.abs(w), axis=(1, 2, 3)), 1e-8)
+        else:
+            w_scale = jnp.asarray(w_scale, jnp.float32).reshape(-1)
+        self._w_scale = w_scale  # [out]
+        self.register_buffer("weight_int8", Tensor(
+            self._quantize_weight(w, w_scale.reshape(-1, 1, 1, 1))))
+        self.bias = inner.bias
+        self._act_scale = None if act_scale is None else float(act_scale)
+        self._stride = inner.stride
+        self._padding = inner.padding
+        self._dilation = inner.dilation
+        self._groups = inner.groups
+        self._channel_last = inner.data_format == "NHWC"
+
+    def forward(self, x):
+        from ..nn.functional.conv import _conv
+
+        v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+        vf = v.astype(jnp.float32)
+        qmax = self._qmax
+        s_x = self._act_scale_of(vf)
+        xq = self._quantize_act(vf, s_x)
+        acc = _conv(xq, self.weight_int8._value, None, self._stride,
+                    self._padding, self._dilation, self._groups, 2,
+                    channel_last=self._channel_last,
+                    preferred_element_type=jnp.int32)
+        shape = [1] * acc.ndim
+        shape[-1 if self._channel_last else 1] = -1
+        y = acc.astype(jnp.float32) * (
+            s_x * self._w_scale / (qmax * qmax)).reshape(shape)
+        if self.bias is not None:
+            y = y + self.bias._value.astype(jnp.float32).reshape(shape)
+        return Tensor(y.astype(v.dtype), stop_gradient=True)

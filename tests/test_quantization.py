@@ -420,3 +420,46 @@ class TestInt8Execution:
         i8 = Int8Linear(lin, w_scale=ws)
         out = i8(paddle.to_tensor(np.ones(6, np.float32)))
         assert out.shape == [3], out.shape
+
+    def test_int8_conv2d_matches_fake_quant(self):
+        from paddle_tpu import nn
+        from paddle_tpu.quantization import Int8Conv2D, QuantedConv2D
+
+        paddle.seed(8)
+        conv = nn.Conv2D(3, 6, 3, padding=1, stride=2)
+        q = QuantedConv2D(conv)
+        rng = np.random.RandomState(8)
+        x = paddle.to_tensor(rng.randn(2, 3, 8, 8).astype(np.float32))
+        q.train()
+        q(x)
+        q.eval()
+        ref = q(x).numpy()
+        i8 = Int8Conv2D(conv,
+                        act_scale=float(q.act_quanter.observer.scale()))
+        out = i8(x).numpy()
+        assert out.shape == ref.shape
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+    def test_convert_full_conv_model(self):
+        from paddle_tpu import nn
+        from paddle_tpu.quantization import PTQ, convert_to_int8
+
+        paddle.seed(9)
+        model = nn.Sequential(
+            nn.Conv2D(3, 8, 3, padding=1), nn.ReLU(),
+            nn.Conv2D(8, 8, 3, padding=1), nn.ReLU(),
+            nn.Flatten(), nn.Linear(8 * 8 * 8, 10))
+        rng = np.random.RandomState(9)
+        X = rng.rand(4, 3, 8, 8).astype(np.float32)
+        ptq = PTQ()
+        q = ptq.quantize(model)
+        ptq.calibrate(q, [X])
+        deploy = convert_to_int8(q)
+        kinds = [type(m).__name__ for m in deploy.sublayers()]
+        assert kinds.count("Int8Conv2D") == 2, kinds
+        assert kinds.count("Int8Linear") == 1, kinds
+        q.eval()
+        sim = q(paddle.to_tensor(X)).numpy()
+        out = deploy(paddle.to_tensor(X)).numpy()
+        rel = np.abs(out - sim).max() / (np.abs(sim).max() + 1e-8)
+        assert rel < 0.05, rel
