@@ -11,6 +11,18 @@ from ..core.tensor import Tensor
 
 class ClipGradBase:
     def __call__(self, params_grads):
+        """Eager form over [(param, grad Tensor)] pairs."""
+        clipped = self.functional_clip(
+            {i: (g._value if isinstance(g, Tensor) else g)
+             for i, (_p, g) in enumerate(params_grads)})
+        return [(p, Tensor(clipped[i]))
+                for i, (p, _g) in enumerate(params_grads)]
+
+    def functional_clip(self, grads):
+        """Pure form over a {name: array} dict — the compiled train
+        paths (CompiledTrainStep / static Executor / pipeline) clip
+        through this inside jit; the eager __call__ wraps it, so both
+        paths share one definition of the math."""
         raise NotImplementedError
 
 
@@ -19,25 +31,22 @@ class ClipGradByValue(ClipGradBase):
         self.max = max
         self.min = -max if min is None else min
 
-    def __call__(self, params_grads):
-        out = []
-        for p, g in params_grads:
-            gv = g._value if isinstance(g, Tensor) else g
-            out.append((p, Tensor(jnp.clip(gv, self.min, self.max))))
-        return out
+    def functional_clip(self, grads):
+        return {n: jnp.clip(g, self.min, self.max)
+                for n, g in grads.items()}
 
 
 class ClipGradByNorm(ClipGradBase):
     def __init__(self, clip_norm):
         self.clip_norm = clip_norm
 
-    def __call__(self, params_grads):
-        out = []
-        for p, g in params_grads:
-            gv = g._value if isinstance(g, Tensor) else g
-            n = jnp.linalg.norm(gv.astype(jnp.float32))
-            scale = jnp.minimum(self.clip_norm / jnp.maximum(n, 1e-12), 1.0)
-            out.append((p, Tensor((gv * scale).astype(gv.dtype))))
+    def functional_clip(self, grads):
+        out = {}
+        for n, g in grads.items():
+            norm = jnp.linalg.norm(g.astype(jnp.float32))
+            scale = jnp.minimum(
+                self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+            out[n] = (g * scale).astype(g.dtype)
         return out
 
 
@@ -53,12 +62,8 @@ class ClipGradByGlobalNorm(ClipGradBase):
         )
         return jnp.sqrt(sq)
 
-    def __call__(self, params_grads):
-        gn = self.global_norm([g for _, g in params_grads])
+    def functional_clip(self, grads):
+        gn = self.global_norm(list(grads.values()))
         scale = jnp.minimum(self.clip_norm / jnp.maximum(gn, 1e-12), 1.0)
-        out = []
-        for p, g in params_grads:
-            gv = g._value if isinstance(g, Tensor) else g
-            out.append((p, Tensor((gv.astype(jnp.float32) * scale)
-                                  .astype(gv.dtype))))
-        return out
+        return {n: (g.astype(jnp.float32) * scale).astype(g.dtype)
+                for n, g in grads.items()}

@@ -191,6 +191,14 @@ class Optimizer:
     def functional_apply(self, params_dict, grads_dict, opt_state, lr=None,
                          step=0):
         """Pure update over {name: array} pytrees (for jit/pjit steps)."""
+        if self._grad_clip is not None:
+            # compiled-path clipping: without this, a grad_clip handed to
+            # the optimizer silently applied only on the eager step()
+            present = {n: g for n, g in grads_dict.items()
+                       if g is not None}
+            if present:
+                grads_dict = {**grads_dict,
+                              **self._grad_clip.functional_clip(present)}
         lr = jnp.asarray(self.get_lr() if lr is None else lr, jnp.float32)
         update = self._make_update()
         new_params, new_state = {}, {}

@@ -436,7 +436,12 @@ class PipelinedTrainStep:
                 "num layers %d not divisible by pp*vpp=%d" % (L, n_chunks))
         self.lpc = L // n_chunks
         self.template = blocks[0]
-        self.suffixes = self.template.functional_state()[0]
+        _sfx, _vals = self.template.functional_state()
+        self.suffixes = _sfx
+        # template param ranks: lets the stacked-grad clip recover the
+        # per-LAYER view (leading axes are stack dims, trailing axes are
+        # the parameter) so per-parameter clip semantics match eager
+        self._tpl_ndim = {s: jnp.ndim(v) for s, v in zip(_sfx, _vals)}
         # block buffers / frozen params ride through the pipeline but are
         # NOT optimized (mirrors _nb_trainable filtering below)
         self._train_sfx = [
@@ -590,8 +595,15 @@ class PipelinedTrainStep:
             pdict.update({"pp_blocks." + s: train[1][s] for s in train_sfx})
             gdict = dict(zip(nb_trainable, g_nb))
             gdict.update({"pp_blocks." + s: g_stacked[s] for s in train_sfx})
-            new_p, new_s = opt.functional_apply(pdict, gdict, opt_state,
-                                                step=step_i)
+            gdict = self._clip_grads(opt, gdict)
+            clip_save = opt._grad_clip
+            opt._grad_clip = None  # clipped above with per-layer
+            try:                   # semantics; don't re-clip jointly
+                new_p, new_s = opt.functional_apply(pdict, gdict,
+                                                    opt_state,
+                                                    step=step_i)
+            finally:
+                opt._grad_clip = clip_save
             out_nb = [new_p.get(n, nb_state[n]) for n in nb_names]
             out_stacked = [new_p.get("pp_blocks." + s, stacked_state[s])
                            for s in suffixes]
@@ -615,6 +627,39 @@ class PipelinedTrainStep:
             out_shardings=(self._ns(P()), nb_sh, st_sh, opt_sh),
             donate_argnums=(0, 1, 2) if self.donate else (),
         )
+
+    def _clip_grads(self, opt, gdict):
+        """Apply the optimizer's grad_clip with PER-LAYER semantics on
+        the stacked 'pp_blocks.*' entries (leading axes are stack dims):
+        ClipGradByNorm must clip each logical layer parameter to its own
+        norm, exactly as the eager/non-pipeline paths do — clipping the
+        stacked array jointly would shrink every layer by ~sqrt(n_pp)
+        too much. ByValue is elementwise and GlobalNorm reduces over
+        everything, so both are stack-agnostic and delegate as-is."""
+        clip = opt._grad_clip
+        if clip is None:
+            return gdict
+        from ..optimizer.clip import ClipGradByNorm
+
+        if not isinstance(clip, ClipGradByNorm):
+            return {**gdict, **clip.functional_clip(
+                {n: g for n, g in gdict.items() if g is not None})}
+        out = dict(gdict)
+        for n, g in gdict.items():
+            if g is None:
+                continue
+            if n.startswith("pp_blocks."):
+                tpl_nd = self._tpl_ndim[n[len("pp_blocks."):]]
+                axes = tuple(range(g.ndim - tpl_nd, g.ndim))
+                sq = jnp.sum(jnp.square(g.astype(jnp.float32)),
+                             axis=axes, keepdims=True)
+                norm = jnp.sqrt(sq)
+                scale = jnp.minimum(
+                    clip.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+                out[n] = (g * scale).astype(g.dtype)
+            else:
+                out[n] = clip.functional_clip({n: g})[n]
+        return out
 
     def __call__(self, input_ids, labels):
         from ..core.dispatch import no_grad
