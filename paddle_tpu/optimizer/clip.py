@@ -18,11 +18,18 @@ class ClipGradBase:
         return [(p, Tensor(clipped[i]))
                 for i, (p, _g) in enumerate(params_grads)]
 
-    def functional_clip(self, grads):
+    def functional_clip(self, grads, reduce_axes=None):
         """Pure form over a {name: array} dict — the compiled train
         paths (CompiledTrainStep / static Executor / pipeline) clip
         through this inside jit; the eager __call__ wraps it, so both
-        paths share one definition of the math."""
+        paths share one definition of the math.
+
+        reduce_axes: optional {name: axes} for entries that pack many
+        logical parameters into one array (pipeline layer stacks): a
+        per-parameter clip reduces over those trailing axes only, so
+        each logical parameter keeps its own norm. Elementwise and
+        global-norm clips ignore it (stack-agnostic either way).
+        """
         raise NotImplementedError
 
 
@@ -31,7 +38,7 @@ class ClipGradByValue(ClipGradBase):
         self.max = max
         self.min = -max if min is None else min
 
-    def functional_clip(self, grads):
+    def functional_clip(self, grads, reduce_axes=None):
         return {n: jnp.clip(g, self.min, self.max)
                 for n, g in grads.items()}
 
@@ -40,10 +47,13 @@ class ClipGradByNorm(ClipGradBase):
     def __init__(self, clip_norm):
         self.clip_norm = clip_norm
 
-    def functional_clip(self, grads):
+    def functional_clip(self, grads, reduce_axes=None):
         out = {}
         for n, g in grads.items():
-            norm = jnp.linalg.norm(g.astype(jnp.float32))
+            axes = (reduce_axes or {}).get(n)
+            sq = jnp.sum(jnp.square(g.astype(jnp.float32)), axis=axes,
+                         keepdims=axes is not None)
+            norm = jnp.sqrt(sq)
             scale = jnp.minimum(
                 self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
             out[n] = (g * scale).astype(g.dtype)
@@ -62,7 +72,7 @@ class ClipGradByGlobalNorm(ClipGradBase):
         )
         return jnp.sqrt(sq)
 
-    def functional_clip(self, grads):
+    def functional_clip(self, grads, reduce_axes=None):
         gn = self.global_norm(list(grads.values()))
         scale = jnp.minimum(self.clip_norm / jnp.maximum(gn, 1e-12), 1.0)
         return {n: (g.astype(jnp.float32) * scale).astype(g.dtype)

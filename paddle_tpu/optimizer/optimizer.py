@@ -216,8 +216,24 @@ class Optimizer:
         return new_params, new_state
 
     def _decay_for_name(self, name):
-        """Per-parameter decay by structured name (compiled path hook)."""
+        """Per-parameter decay on the compiled path. Compiled steps
+        register their {functional name: Parameter} map via
+        set_functional_params, so subclass _decay_for overrides (AdamW
+        apply_decay_param_fun, Lamb exclude_from_weight_decay_fn, LARS
+        name exclusions) act identically to the eager path; without a
+        registered param the default decay applies."""
+        p = self._registered_param(name)
+        if p is not None:
+            return self._decay_for(p)
         return self._weight_decay_value()
+
+    def _registered_param(self, name):
+        return getattr(self, "_functional_params", {}).get(name)
+
+    def set_functional_params(self, mapping):
+        """Register the compiled step's functional-name -> Parameter
+        mapping so per-parameter hooks (decay exclusions) resolve."""
+        self._functional_params = dict(mapping)
 
     def _weight_decay_value(self):
         wd = self._weight_decay

@@ -172,6 +172,12 @@ class AdamW(_AdamBase):
         return self._weight_decay_value()
 
     def _decay_for_name(self, name):
+        # prefer the registered param so apply_decay_param_fun sees the
+        # same key (param.name) as the eager path; a direct functional
+        # caller without a registry gets the functional name best-effort
+        p = self._registered_param(name)
+        if p is not None:
+            return self._decay_for(p)
         if (self._apply_decay_param_fun is not None
                 and not self._apply_decay_param_fun(name)):
             return 0.0

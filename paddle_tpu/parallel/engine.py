@@ -91,6 +91,9 @@ class CompiledTrainStep:
         self._trainable_names = list(trainable.keys())
         self._opt_state = optimizer.functional_init(
             {n: p._value for n, p in trainable.items()})
+        # per-parameter hooks (decay exclusions) resolve through the
+        # functional names on the compiled path
+        optimizer.set_functional_params(trainable)
         self._step_count = 0
         if batch_spec is not None:
             self.batch_spec = batch_spec
@@ -172,7 +175,7 @@ class CompiledTrainStep:
         batch_sharding = NamedSharding(mesh, self.batch_spec)
         repl = NamedSharding(mesh, P())
 
-        def step(state_vals, opt_state, step_i, batch):
+        def step(state_vals, opt_state, step_i, lr_i, batch):
             state = dict(zip(names, state_vals))
 
             def loss_of(train_vals, batch):
@@ -194,8 +197,10 @@ class CompiledTrainStep:
                     for n, g in zip(trainable_names, grads)]
             gdict = dict(zip(trainable_names, grads))
             pdict = {n: state[n] for n in trainable_names}
+            # lr threaded as an ARGUMENT: an lr captured at trace time
+            # would freeze the scheduler's value into the executable
             new_p, new_s = opt.functional_apply(pdict, gdict, opt_state,
-                                                step=step_i)
+                                                lr=lr_i, step=step_i)
             out_state = []
             for n in names:
                 out_state.append(new_p[n] if n in new_p else state[n])
@@ -206,7 +211,7 @@ class CompiledTrainStep:
                            repl)
         self._compiled = jax.jit(
             step,
-            in_shardings=(state_shardings, opt_shardings, None,
+            in_shardings=(state_shardings, opt_shardings, None, None,
                           batch_sharding),
             out_shardings=(repl, state_shardings, opt_shardings),
             donate_argnums=(0, 1) if self.donate else (),
@@ -225,14 +230,14 @@ class CompiledTrainStep:
             self._shardings
         stacked_sharding = self._batch_sharding(stacked=True)
 
-        def multi(state_vals, opt_state, step0, batches):
+        def multi(state_vals, opt_state, step0, lr_i, batches):
             k = batches[0].shape[0]
 
             def body(i, carry):
                 sv, ost, _ = carry
                 batch = tuple(b[i] for b in batches)
                 loss, new_sv, new_ost = step_fn(
-                    sv, ost, step0 + i.astype(jnp.int32), batch)
+                    sv, ost, step0 + i.astype(jnp.int32), lr_i, batch)
                 return (new_sv, new_ost, loss.astype(jnp.float32))
 
             init = (state_vals, opt_state, jnp.float32(0))
@@ -241,7 +246,7 @@ class CompiledTrainStep:
 
         self._compiled_multi = jax.jit(
             multi,
-            in_shardings=(state_shardings, opt_shardings, None,
+            in_shardings=(state_shardings, opt_shardings, None, None,
                           stacked_sharding),
             out_shardings=(repl, state_shardings, opt_shardings),
             donate_argnums=(0, 1) if self.donate else (),
@@ -264,7 +269,8 @@ class CompiledTrainStep:
         state_vals = [tensors[n]._value for n in self._names]
         loss, new_state, new_opt = self._compiled_multi(
             state_vals, self._opt_state,
-            jnp.asarray(self._step_count + 1, jnp.int32), vals)
+            jnp.asarray(self._step_count + 1, jnp.int32),
+            jnp.asarray(self.optimizer.get_lr(), jnp.float32), vals)
         self._step_count += k
         for n, v in zip(self._names, new_state):
             tensors[n]._value = v
@@ -291,8 +297,8 @@ class CompiledTrainStep:
         vals = self._prep_batch(batch)
         state_vals = [self._tensors[n]._value for n in self._names]
         return self._compiled.lower(
-            state_vals, self._opt_state,
-            jnp.asarray(0, jnp.int32), vals).compile().as_text()
+            state_vals, self._opt_state, jnp.asarray(0, jnp.int32),
+            jnp.asarray(0.0, jnp.float32), vals).compile().as_text()
 
     @no_grad()
     def __call__(self, *batch):
@@ -305,7 +311,8 @@ class CompiledTrainStep:
         self._step_count += 1
         loss, new_state, new_opt = self._compiled(
             state_vals, self._opt_state,
-            jnp.asarray(self._step_count, jnp.int32), vals)
+            jnp.asarray(self._step_count, jnp.int32),
+            jnp.asarray(self.optimizer.get_lr(), jnp.float32), vals)
         for n, v in zip(self._names, new_state):
             tensors[n]._value = v
         self._opt_state = new_opt

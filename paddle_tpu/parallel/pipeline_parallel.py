@@ -505,6 +505,26 @@ class PipelinedTrainStep:
         pdict.update({"pp_blocks." + s: self._stacked[s]
                       for s in self._train_sfx})
         self._opt_state = optimizer.functional_init(pdict)
+        # decay-exclusion hooks resolve per functional name; stacked
+        # block entries have no single Parameter — map them to the
+        # template block's parameter so name-based exclusions (AdamW
+        # apply_decay_param_fun) behave uniformly across the stack
+        fmap = {n: tensors[n] for n in self._nb_trainable}
+        tpl_params = dict(self.template.named_parameters())
+        for s_ in self._train_sfx:
+            if s_ in tpl_params:
+                fmap["pp_blocks." + s_] = tpl_params[s_]
+        optimizer.set_functional_params(fmap)
+        if (getattr(optimizer, "_apply_decay_param_fun", None) is not None
+                or getattr(optimizer, "_exclude_fn", None) is not None):
+            import warnings
+
+            warnings.warn(
+                "PipelinedTrainStep: per-parameter decay exclusions are "
+                "evaluated on the TEMPLATE (first) block's parameters "
+                "and applied uniformly to every pipelined layer in the "
+                "stack; a predicate that distinguishes individual layers "
+                "cannot act layer-wise on the stacked representation.")
         for name, slots in self._opt_state.items():
             spec = (self._stacked_specs[name[len("pp_blocks."):]]
                     if name.startswith("pp_blocks.")
@@ -560,7 +580,8 @@ class PipelinedTrainStep:
 
         train_sfx = self._train_sfx
 
-        def step(nb_vals, stacked_vals, opt_state, step_i, batch):
+        def step(nb_vals, stacked_vals, opt_state, step_i, lr_i,
+                 batch):
             nb_state = dict(zip(nb_names, nb_vals))
             stacked_state = dict(zip(suffixes, stacked_vals))
 
@@ -599,8 +620,10 @@ class PipelinedTrainStep:
             clip_save = opt._grad_clip
             opt._grad_clip = None  # clipped above with per-layer
             try:                   # semantics; don't re-clip jointly
+                # lr as an ARGUMENT: a trace-time lr would freeze the
+                # scheduler's value into the executable
                 new_p, new_s = opt.functional_apply(pdict, gdict,
-                                                    opt_state,
+                                                    opt_state, lr=lr_i,
                                                     step=step_i)
             finally:
                 opt._grad_clip = clip_save
@@ -622,7 +645,7 @@ class PipelinedTrainStep:
                             self._ns(P()) for sl in slots]
         self._compiled = jax.jit(
             step,
-            in_shardings=(nb_sh, st_sh, opt_sh, None,
+            in_shardings=(nb_sh, st_sh, opt_sh, None, None,
                           self._ns(self.batch_spec)),
             out_shardings=(self._ns(P()), nb_sh, st_sh, opt_sh),
             donate_argnums=(0, 1, 2) if self.donate else (),
@@ -639,27 +662,14 @@ class PipelinedTrainStep:
         clip = opt._grad_clip
         if clip is None:
             return gdict
-        from ..optimizer.clip import ClipGradByNorm
-
-        if not isinstance(clip, ClipGradByNorm):
-            return {**gdict, **clip.functional_clip(
-                {n: g for n, g in gdict.items() if g is not None})}
-        out = dict(gdict)
-        for n, g in gdict.items():
-            if g is None:
-                continue
+        present = {n: g for n, g in gdict.items() if g is not None}
+        reduce_axes = {}
+        for n, g in present.items():
             if n.startswith("pp_blocks."):
                 tpl_nd = self._tpl_ndim[n[len("pp_blocks."):]]
-                axes = tuple(range(g.ndim - tpl_nd, g.ndim))
-                sq = jnp.sum(jnp.square(g.astype(jnp.float32)),
-                             axis=axes, keepdims=True)
-                norm = jnp.sqrt(sq)
-                scale = jnp.minimum(
-                    clip.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
-                out[n] = (g * scale).astype(g.dtype)
-            else:
-                out[n] = clip.functional_clip({n: g})[n]
-        return out
+                reduce_axes[n] = tuple(range(g.ndim - tpl_nd, g.ndim))
+        return {**gdict,
+                **clip.functional_clip(present, reduce_axes=reduce_axes)}
 
     def __call__(self, input_ids, labels):
         from ..core.dispatch import no_grad
@@ -678,7 +688,8 @@ class PipelinedTrainStep:
             self._step_count += 1
             loss, new_nb, new_stacked, new_opt = self._compiled(
                 nb_vals, stacked_vals, self._opt_state,
-                jnp.asarray(self._step_count, jnp.int32), batch)
+                jnp.asarray(self._step_count, jnp.int32),
+                jnp.asarray(self.optimizer.get_lr(), jnp.float32), batch)
             for n, v in zip(self._nb_names, new_nb):
                 tensors[n]._value = v
             self._stacked = dict(zip(self.suffixes, new_stacked))

@@ -747,6 +747,9 @@ class Executor:
                 return (out_fetches, param_vals, opt_state, acc_grads,
                         state_vals)
             names = [str(i) for i in range(len(params))]
+            # per-parameter hooks (decay exclusions) resolve through the
+            # synthetic functional names to the real Parameters
+            opt.set_functional_params(dict(zip(names, params)))
             if gm_k > 1:
                 # gradient merge (auto_parallel_gradient_merge pass):
                 # accumulate k microsteps, update on the k-th, where()

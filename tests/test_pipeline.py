@@ -190,7 +190,8 @@ class TestPipelinedTrainStep:
         stacked_vals = [step._stacked[s] for s in step.suffixes]
         hlo = step._compiled.lower(
             nb_vals, stacked_vals, step._opt_state,
-            jnp.asarray(0, jnp.int32), batch).compile().as_text()
+            jnp.asarray(0, jnp.int32), jnp.asarray(0.0, jnp.float32),
+            batch).compile().as_text()
         assert "collective-permute" in hlo
 
     def test_sync_to_model_roundtrip(self):
