@@ -93,6 +93,12 @@ class Optimizer:
         return str(pid)
 
     def state_dict(self):
+        # a compiled step registers a sync hook: the live moments/step
+        # live in its functional state and are mirrored in only when a
+        # checkpoint actually reads them (not on the per-step hot path)
+        sync = getattr(self, "_functional_sync", None)
+        if sync is not None:
+            sync()
         sd = {}
         for (slot, pid), v in self._accumulators.items():
             sd["%s/%s" % (slot, self._stable_pid(pid))] = Tensor(v)

@@ -94,7 +94,21 @@ class CompiledTrainStep:
         # per-parameter hooks (decay exclusions) resolve through the
         # functional names on the compiled path
         optimizer.set_functional_params(trainable)
-        self._step_count = 0
+        self._trainable = trainable
+        # checkpoint continuity (reference optimizer state_dicts carry
+        # accumulators + step): seed slots from the optimizer's eager
+        # accumulators (set_state_dict -> resume), start the step counter
+        # from its global step, and register the lazy sync hook so
+        # optimizer.state_dict() stays truthful
+        slots = optimizer._slots()
+        for n, p in trainable.items():
+            for j, slot in enumerate(slots):
+                key = (slot, id(p))
+                if key in optimizer._accumulators:
+                    self._opt_state[n][j] = jnp.asarray(
+                        optimizer._accumulators[key])
+        self._step_count = int(optimizer._global_step)
+        optimizer._functional_sync = self._sync_opt_state_out
         if batch_spec is not None:
             self.batch_spec = batch_spec
         else:
@@ -276,6 +290,19 @@ class CompiledTrainStep:
             tensors[n]._value = v
         self._opt_state = new_opt
         return Tensor(loss)
+
+    def _sync_opt_state_out(self):
+        """Mirror the functional slots into the optimizer's eager
+        accumulators (no copies — same arrays). Registered as the
+        optimizer's _functional_sync hook: state_dict() pulls it lazily,
+        keeping the per-step host path free of O(params x slots) dict
+        rebuilds."""
+        opt = self.optimizer
+        slots = opt._slots()
+        for n, p in self._trainable.items():
+            for j, slot in enumerate(slots):
+                opt._accumulators[(slot, id(p))] = self._opt_state[n][j]
+        opt._global_step = self._step_count
 
     def _batch_sharding(self, stacked=False):
         spec = P(*((None,) + tuple(self.batch_spec))) if stacked \

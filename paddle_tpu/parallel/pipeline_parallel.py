@@ -516,7 +516,8 @@ class PipelinedTrainStep:
                 fmap["pp_blocks." + s_] = tpl_params[s_]
         optimizer.set_functional_params(fmap)
         if (getattr(optimizer, "_apply_decay_param_fun", None) is not None
-                or getattr(optimizer, "_exclude_fn", None) is not None):
+                or getattr(optimizer, "_exclude_fn", None) is not None
+                or getattr(optimizer, "_exclude", None)):
             import warnings
 
             warnings.warn(
@@ -535,8 +536,78 @@ class PipelinedTrainStep:
 
         self._dp = "dp" if "dp" in self.mesh.axis_names else None
         self.batch_spec = P(self._dp) if self._dp else P()
-        self._step_count = 0
+        # checkpoint continuity, mirroring CompiledTrainStep: seed slots
+        # from accumulators restored via set_state_dict (per-block slots
+        # restack into the Megatron layout), resume the step counter,
+        # and register the lazy state_dict sync hook
+        self._seed_opt_state_from_accumulators(optimizer, tensors)
+        self._step_count = int(optimizer._global_step)
+        optimizer._functional_sync = self._sync_opt_state_out
         self._compiled = None
+
+    # -- optimizer-state checkpoint bridge ---------------------------------
+
+    def _block_param(self, sfx, idx):
+        return self._blocks[idx].raw_state_tensors()[sfx]
+
+    def _stack_layout(self):
+        """(stage, chunk, local) -> flat block index, Megatron layout
+        (same walk as sync_to_model)."""
+        for st in range(self.n_pp):
+            for c in range(self.vpp):
+                for j in range(self.lpc):
+                    yield st, c, j, (c * self.n_pp + st) * self.lpc + j
+
+    def _seed_opt_state_from_accumulators(self, opt, tensors):
+        slots = opt._slots()
+        for n in self._nb_trainable:
+            for j, slot in enumerate(slots):
+                key = (slot, id(tensors[n]))
+                if key in opt._accumulators:
+                    self._opt_state[n][j] = jax.device_put(
+                        jnp.asarray(opt._accumulators[key]),
+                        self._ns(self._nb_specs[n]))
+        for sfx in self._train_sfx:
+            name = "pp_blocks." + sfx
+            for j, slot in enumerate(slots):
+                per_block = {}
+                for st, c, k, idx in self._stack_layout():
+                    key = (slot, id(self._block_param(sfx, idx)))
+                    if key not in opt._accumulators:
+                        break
+                    per_block[(st, c, k)] = opt._accumulators[key]
+                else:
+                    arr = jnp.stack([
+                        jnp.stack([
+                            jnp.stack([jnp.asarray(per_block[(st, c, k)])
+                                       for k in range(self.lpc)])
+                            for c in range(self.vpp)])
+                        for st in range(self.n_pp)])
+                    self._opt_state[name][j] = jax.device_put(
+                        arr, self._ns(self._stacked_specs[sfx]))
+
+    def _sync_opt_state_out(self):
+        """Mirror functional slots into the optimizer's accumulators —
+        stacked entries unstack to the per-block Parameters (the same
+        walk sync_to_model uses for weights). Lazy: runs only when
+        state_dict() reads the optimizer."""
+        opt = self.optimizer
+        tensors = self.model.raw_state_tensors()
+        slots = opt._slots()
+        for n in self._nb_trainable:
+            for j, slot in enumerate(slots):
+                opt._accumulators[(slot, id(tensors[n]))] =                     self._opt_state[n][j]
+        for sfx in self._train_sfx:
+            name = "pp_blocks." + sfx
+            tpl_nd = self._tpl_ndim[sfx]
+            for j, slot in enumerate(slots):
+                arr = self._opt_state[name][j]
+                if jnp.ndim(arr) != tpl_nd + 3:
+                    continue  # non-param-shaped slot: no per-block view
+                for st, c, k, idx in self._stack_layout():
+                    opt._accumulators[
+                        (slot, id(self._block_param(sfx, idx)))] =                         arr[st, c, k]
+        opt._global_step = self._step_count
 
     # -- forward pieces ----------------------------------------------------
 
