@@ -40,3 +40,9 @@ class TestExamples:
         _load("gnn_graphsage").main()
         out = capsys.readouterr().out
         assert "full-graph accuracy" in out
+
+    def test_quantized_serving(self):
+        float_acc, int8_acc = _load("quantized_serving").main(
+            train_steps=40, calib_batches=2)
+        assert float_acc > 0.75, float_acc
+        assert int8_acc >= float_acc - 0.05, (float_acc, int8_acc)
