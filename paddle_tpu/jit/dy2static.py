@@ -54,6 +54,7 @@ import functools
 import inspect
 import logging
 import textwrap
+import warnings
 
 import jax
 import jax.numpy as jnp
@@ -66,6 +67,21 @@ logger = logging.getLogger("paddle_tpu.jit")
 _HINT = ("rewrite this construct with paddle_tpu.static.cond / "
          "static.while_loop / lax-compatible code, or move it out of the "
          "@to_static region")
+
+
+def _warn_trace_only(fn, reason):
+    """Loud, named, consequence-stating warning when a function reverts
+    to trace-only conversion: users must know that tensor-dependent
+    if/while/for in that function will raise a concretization error
+    under jit rather than being converted to lax control flow."""
+    name = "%s.%s" % (getattr(fn, "__module__", "?"),
+                      getattr(fn, "__qualname__", fn.__name__))
+    msg = ("dy2static: %s falls back to TRACE-ONLY conversion because %s. "
+           "Consequence: tensor-dependent control flow (if/while/for on "
+           "traced values) inside %s will fail with a concretization "
+           "error under jit; %s." % (name, reason, name, _HINT))
+    warnings.warn(msg, stacklevel=3)
+    logger.warning(msg)
 
 
 class _Undef:
@@ -1036,9 +1052,8 @@ def convert_control_flow(fn):
             # an empty cell (forward reference to a sibling defined
             # later): conversion cannot snapshot the closure safely —
             # fall back to trace-only rather than crash at decoration
-            logger.warning(
-                "dy2static: %s closes over a not-yet-bound name; "
-                "falling back to trace-only conversion", fn.__name__)
+            _warn_trace_only(fn, "it closes over a not-yet-bound name "
+                             "(forward reference to a sibling defined later)")
             return fn.__get__(instance) if instance is not None else fn
     factory_name = "__dy2st_factory__"
     try:
@@ -1064,8 +1079,7 @@ def convert_control_flow(fn):
     except UnimplementedError:
         raise
     except Exception as e:  # noqa: BLE001 — conversion must never brick
-        logger.warning("dy2static: conversion of %s failed (%s); "
-                       "falling back to trace-only", fn.__name__, e)
+        _warn_trace_only(fn, "AST conversion failed: %s" % (e,))
         return fn.__get__(instance) if instance is not None else fn
 
     from . import dy2static as _self

@@ -123,6 +123,12 @@ class Optimizer:
                     continue
                 self._accumulators[(slot, pid)] = (
                     v._value if isinstance(v, Tensor) else jnp.asarray(v))
+        # a compiled step registers a load hook: push the restored
+        # accumulators back into its functional state, so restoring AFTER
+        # CompiledTrainStep construction still takes effect
+        load = getattr(self, "_functional_load", None)
+        if load is not None:
+            load()
 
     # -- machinery ---------------------------------------------------------
     def _get_params(self):

@@ -109,6 +109,7 @@ class CompiledTrainStep:
                         optimizer._accumulators[key])
         self._step_count = int(optimizer._global_step)
         optimizer._functional_sync = self._sync_opt_state_out
+        optimizer._functional_load = self._load_opt_state_in
         if batch_spec is not None:
             self.batch_spec = batch_spec
         else:
@@ -271,9 +272,14 @@ class CompiledTrainStep:
         """Run K = leading-dim train steps in one device call.
 
         Each element of `stacked_batch` carries a leading K axis
-        ([K, batch, ...]); step i consumes slice i. Numerically
-        identical to K sequential __call__s (same optimizer step
-        counter sequence); returns the LAST step's loss.
+        ([K, batch, ...]); step i consumes slice i. Matches K sequential
+        __call__s in everything EXCEPT the learning rate: lr is sampled
+        ONCE per window (host-side, before dispatch), so an LRScheduler
+        stepped per train step advances per WINDOW here — all K steps in
+        a window share one lr. Pick K small relative to the schedule's
+        time constant, or use __call__ when per-step lr matters. The
+        optimizer step counter still advances per step (bias correction
+        is exact). Returns the LAST step's loss.
         """
         if getattr(self, "_compiled_multi", None) is None:
             self._build_multi()
@@ -293,16 +299,37 @@ class CompiledTrainStep:
 
     def _sync_opt_state_out(self):
         """Mirror the functional slots into the optimizer's eager
-        accumulators (no copies — same arrays). Registered as the
-        optimizer's _functional_sync hook: state_dict() pulls it lazily,
-        keeping the per-step host path free of O(params x slots) dict
-        rebuilds."""
+        accumulators. Registered as the optimizer's _functional_sync
+        hook: state_dict() pulls it lazily, keeping the per-step host
+        path free of O(params x slots) dict rebuilds. COPIES each slot:
+        with donate=True the next compiled step donates the live
+        _opt_state buffers, and a state_dict snapshot must survive that."""
         opt = self.optimizer
         slots = opt._slots()
         for n, p in self._trainable.items():
             for j, slot in enumerate(slots):
-                opt._accumulators[(slot, id(p))] = self._opt_state[n][j]
+                opt._accumulators[(slot, id(p))] = jnp.copy(
+                    self._opt_state[n][j])
         opt._global_step = self._step_count
+
+    def _load_opt_state_in(self):
+        """Reverse bridge: re-seed the compiled step's functional slots
+        from the optimizer's eager accumulators. Registered as the
+        optimizer's _functional_load hook so set_state_dict() called
+        AFTER this CompiledTrainStep was constructed still takes effect
+        on the compiled path (resume-after-compile)."""
+        opt = self.optimizer
+        slots = opt._slots()
+        specs = self._specs()
+        opt_specs = self._opt_specs(specs)
+        for n, p in self._trainable.items():
+            for j, slot in enumerate(slots):
+                key = (slot, id(p))
+                if key in opt._accumulators:
+                    self._opt_state[n][j] = jax.device_put(
+                        jnp.asarray(opt._accumulators[key]),
+                        NamedSharding(self.mesh, opt_specs[n][j]))
+        self._step_count = int(opt._global_step)
 
     def _batch_sharding(self, stacked=False):
         spec = P(*((None,) + tuple(self.batch_spec))) if stacked \

@@ -543,6 +543,7 @@ class PipelinedTrainStep:
         self._seed_opt_state_from_accumulators(optimizer, tensors)
         self._step_count = int(optimizer._global_step)
         optimizer._functional_sync = self._sync_opt_state_out
+        optimizer._functional_load = self._load_opt_state_in
         self._compiled = None
 
     # -- optimizer-state checkpoint bridge ---------------------------------
@@ -586,6 +587,14 @@ class PipelinedTrainStep:
                     self._opt_state[name][j] = jax.device_put(
                         arr, self._ns(self._stacked_specs[sfx]))
 
+    def _load_opt_state_in(self):
+        """Reverse bridge (optimizer _functional_load hook): re-seed the
+        functional slots from accumulators restored by set_state_dict
+        AFTER this step object was built (resume-after-compile)."""
+        self._seed_opt_state_from_accumulators(
+            self.optimizer, self.model.raw_state_tensors())
+        self._step_count = int(self.optimizer._global_step)
+
     def _sync_opt_state_out(self):
         """Mirror functional slots into the optimizer's accumulators —
         stacked entries unstack to the per-block Parameters (the same
@@ -596,14 +605,23 @@ class PipelinedTrainStep:
         slots = opt._slots()
         for n in self._nb_trainable:
             for j, slot in enumerate(slots):
-                opt._accumulators[(slot, id(tensors[n]))] =                     self._opt_state[n][j]
+                opt._accumulators[(slot, id(tensors[n]))] = jnp.copy(
+                    self._opt_state[n][j])
         for sfx in self._train_sfx:
             name = "pp_blocks." + sfx
             tpl_nd = self._tpl_ndim[sfx]
             for j, slot in enumerate(slots):
                 arr = self._opt_state[name][j]
                 if jnp.ndim(arr) != tpl_nd + 3:
-                    continue  # non-param-shaped slot: no per-block view
+                    # a slot that is not per-block-param shaped has no
+                    # per-block view; silently dropping it would make
+                    # checkpoints lie for a future optimizer
+                    raise NotImplementedError(
+                        "pipeline optimizer checkpoint: slot %r for %r "
+                        "has ndim %d (expected template ndim %d + 3 "
+                        "stack dims); per-block unstacking is undefined "
+                        "for this shape" % (slot, name, jnp.ndim(arr),
+                                            tpl_nd))
                 for st, c, k, idx in self._stack_layout():
                     opt._accumulators[
                         (slot, id(self._block_param(sfx, idx)))] =                         arr[st, c, k]

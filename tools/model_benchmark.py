@@ -277,9 +277,15 @@ def bench_allreduce(results, iters=None):
     float(y[0, 0])
     dt = time.perf_counter() - t0
     bus_bytes = 2 * (n - 1) / n * nbytes * iters
+    extras = {"devices": n, "payload_mib": nbytes >> 20}
+    if jax.default_backend() == "cpu":
+        # quarantine: a host-mesh number says nothing about ICI; every
+        # artifact citing this row must carry the label
+        extras["cpu_mesh_sanity"] = True
+        extras["note"] = ("virtual CPU-mesh sanity row only — NOT an ICI "
+                          "measurement; the ICI row needs >1 real chip")
     _emit(results, "allreduce_bus_bandwidth_gb_s",
-          bus_bytes / dt / 1e9, "GB/s",
-          {"devices": n, "payload_mib": nbytes >> 20})
+          bus_bytes / dt / 1e9, "GB/s", extras)
 
 
 def bench_llama1b(results, iters=None):
