@@ -16,10 +16,12 @@ No reference code is used: the protobuf wire format is decoded by a
 interface facts from framework.proto), and each op adapter is an
 original jnp implementation.
 
-Scope (VERDICT r3 missing-#4): the inference op subset covering
-LeNet / ResNet-class vision models + feed-forward nets. Unknown ops
-raise a typed UnimplementedError naming the op so coverage gaps are
-loud, not silent.
+Scope: the inference op subset covering LeNet / ResNet-class vision
+models, feed-forward nets, and transformer encoders (ERNIE/BERT-class:
+lookup_table embeddings, layer_norm, matmul_v2 with transposes, the
+reshape/transpose/stack/slice/concat/split manipulation family, and the
+scale+softmax attention composition). Unknown ops raise a typed
+UnimplementedError naming the op so coverage gaps are loud, not silent.
 """
 from __future__ import annotations
 
@@ -384,10 +386,25 @@ def _run_op(op, env):
         names = op.inputs.get(slot) or []
         return env[names[idx]] if len(names) > idx else None
 
+    def inps(slot):
+        return [env[n] for n in op.inputs.get(slot) or []]
+
     def set_out(slot, val, idx=0):
         names = op.outputs.get(slot) or []
         if len(names) > idx:
             env[names[idx]] = val
+
+    def no_tensor_operands(*slots):
+        """Loud-not-silent contract: shape/index operands supplied as
+        TENSOR inputs (StartsTensorList etc.) mean the attr values are
+        placeholders — using them would be silently wrong."""
+        for slot in slots:
+            if op.inputs.get(slot):
+                raise UnimplementedError(
+                    "reference-model importer: op %r supplies %r as a "
+                    "tensor input; only attribute-form shapes/indices "
+                    "are supported" % (t, slot),
+                    hint="re-export the model with static shapes")
 
     if t in ("feed", "fetch"):
         return
@@ -400,10 +417,14 @@ def _run_op(op, env):
                                        inp("Bias"), inp("Mean"),
                                        inp("Variance"), a))
     elif t in ("matmul_v2", "matmul"):
-        set_out("Out", _matmul_like(
+        out = _matmul_like(
             inp("X"), inp("Y"),
             a.get("trans_x", a.get("transpose_X", False)),
-            a.get("trans_y", a.get("transpose_Y", False))))
+            a.get("trans_y", a.get("transpose_Y", False)))
+        alpha = a.get("alpha", 1.0)
+        if t == "matmul" and alpha not in (None, 1.0):
+            out = out * alpha
+        set_out("Out", out)
     elif t == "mul":
         set_out("Out", _mul(inp("X"), inp("Y"), a))
     elif t.startswith("elementwise_"):
@@ -422,9 +443,14 @@ def _run_op(op, env):
     elif t == "softmax":
         set_out("Out", jax.nn.softmax(inp("X"), axis=a.get("axis", -1)))
     elif t in ("reshape2", "reshape"):
-        shape = a.get("shape") or []
-        set_out("Out", inp("X").reshape(
-            [int(s) for s in shape]))
+        no_tensor_operands("Shape", "ShapeTensor")
+        x = inp("X")
+        # reference reshape semantics: 0 copies the input dim at that
+        # index, -1 is inferred (framework reshape_op contract)
+        shape = [int(s) for s in (a.get("shape") or [])]
+        shape = [x.shape[i] if s == 0 else s
+                 for i, s in enumerate(shape)]
+        set_out("Out", x.reshape(shape))
     elif t in ("flatten_contiguous_range", "flatten2", "flatten"):
         x = inp("X")
         start = a.get("start_axis", a.get("axis", 1)) or 0
@@ -460,6 +486,128 @@ def _run_op(op, env):
         set_out("Out", jnp.argmax(inp("X"), axis=a.get("axis", -1)))
     elif t == "mean":
         set_out("Out", jnp.mean(inp("X")))
+    elif t == "layer_norm":
+        x = inp("X")
+        eps = a.get("epsilon", 1e-5)
+        bna = a.get("begin_norm_axis", 1) or 1
+        red = tuple(range(bna, x.ndim))
+        m = jnp.mean(x, axis=red, keepdims=True)
+        v = jnp.mean(jnp.square(x - m), axis=red, keepdims=True)
+        y = (x - m) / jnp.sqrt(v + eps)
+        norm_shape = x.shape[bna:]
+        scale, bias = inp("Scale"), inp("Bias")
+        if scale is not None:
+            y = y * scale.reshape(norm_shape)
+        if bias is not None:
+            y = y + bias.reshape(norm_shape)
+        set_out("Y", y)
+    elif t in ("lookup_table_v2", "lookup_table"):
+        w, ids = inp("W"), inp("Ids")
+        if t == "lookup_table" and ids.ndim >= 2 and ids.shape[-1] == 1:
+            ids = ids[..., 0]  # v1 carries a trailing [.., 1] dim
+        ids = ids.astype(jnp.int32)
+        out = jnp.take(w, ids, axis=0)
+        pad = a.get("padding_idx", -1)
+        if pad is not None and pad != -1:
+            if pad < 0:
+                pad += w.shape[0]
+            out = jnp.where((ids == pad)[..., None],
+                            jnp.zeros((), out.dtype), out)
+        set_out("Out", out)
+    elif t == "stack":
+        set_out("Y", jnp.stack(inps("X"), axis=a.get("axis", 0) or 0))
+    elif t == "concat":
+        no_tensor_operands("AxisTensor")
+        set_out("Out", jnp.concatenate(inps("X"),
+                                       axis=a.get("axis", 0) or 0))
+    elif t == "split":
+        no_tensor_operands("AxisTensor", "SectionsTensorList")
+        x = inp("X")
+        axis = a.get("axis", 0) or 0
+        sections = a.get("sections") or []
+        num = a.get("num", 0) or 0
+        if num:
+            pieces = jnp.split(x, num, axis=axis)
+        else:
+            sections = [int(s) for s in sections]
+            if -1 in sections:
+                known = sum(s for s in sections if s != -1)
+                sections = [x.shape[axis] - known if s == -1 else s
+                            for s in sections]
+            pieces = jnp.split(x, np.cumsum(sections[:-1]).tolist(),
+                               axis=axis)
+        for i, p in enumerate(pieces):
+            set_out("Out", p, idx=i)
+    elif t in ("slice", "strided_slice"):
+        no_tensor_operands("StartsTensor", "EndsTensor", "StridesTensor",
+                           "StartsTensorList", "EndsTensorList",
+                           "StridesTensorList")
+        x = inp("Input")
+        axes = [int(v) for v in (a.get("axes") or [])]
+        starts = [int(v) for v in (a.get("starts") or [])]
+        ends = [int(v) for v in (a.get("ends") or [])]
+        strides = [int(v) for v in (a.get("strides") or [1] * len(axes))]
+        idx = [slice(None)] * x.ndim
+        for ax, st, en, sd in zip(axes, starts, ends, strides):
+            idx[ax] = slice(st, en, sd)
+        y = x[tuple(idx)]
+        decrease = a.get("decrease_axis") or []
+        if decrease:
+            y = jnp.squeeze(y, axis=tuple(int(d) for d in decrease))
+        set_out("Out", y)
+    elif t in ("unsqueeze2", "unsqueeze"):
+        no_tensor_operands("AxesTensor", "AxesTensorList")
+        x = inp("X")
+        # reference kernel inserts axes SEQUENTIALLY in the given order
+        # (each insertion sees the previous one's shape) — not sorted
+        for ax in (int(v) for v in (a.get("axes") or [])):
+            x = jnp.expand_dims(x, ax if ax >= 0 else ax + x.ndim + 1)
+        set_out("Out", x)
+    elif t in ("squeeze2", "squeeze"):
+        axes = [int(v) for v in (a.get("axes") or [])]
+        x = inp("X")
+        if axes:
+            set_out("Out", jnp.squeeze(x, axis=tuple(
+                ax if ax >= 0 else ax + x.ndim for ax in axes)))
+        else:
+            set_out("Out", jnp.squeeze(x))
+    elif t == "cast":
+        set_out("Out", inp("X").astype(
+            _dtype_of(a.get("out_dtype", 5))))
+    elif t == "gather":
+        axis = inp("Axis")
+        axis = int(axis) if axis is not None else a.get("axis", 0) or 0
+        set_out("Out", jnp.take(inp("X"),
+                                inp("Index").astype(jnp.int32),
+                                axis=axis))
+    elif t == "expand_v2":
+        no_tensor_operands("Shape", "expand_shapes_tensor")
+        x = inp("X")
+        shape = [int(s) for s in (a.get("shape") or [])]
+        lead = len(shape) - x.ndim
+        full = [shape[i] if shape[i] != -1
+                else (x.shape[i - lead] if i >= lead else 1)
+                for i in range(len(shape))]
+        set_out("Out", jnp.broadcast_to(x, full))
+    elif t in ("reduce_mean", "reduce_sum", "reduce_max", "reduce_min"):
+        x = inp("X")
+        fns = {"reduce_mean": jnp.mean, "reduce_sum": jnp.sum,
+               "reduce_max": jnp.max, "reduce_min": jnp.min}
+        dims = a.get("dim") or []
+        axis = None if (a.get("reduce_all") or not dims) \
+            else tuple(int(d) for d in dims)
+        set_out("Out", fns[t](x, axis=axis,
+                              keepdims=bool(a.get("keep_dim"))))
+    elif t == "sqrt":
+        set_out("Out", jnp.sqrt(inp("X")))
+    elif t == "square":
+        set_out("Out", jnp.square(inp("X")))
+    elif t == "exp":
+        set_out("Out", jnp.exp(inp("X")))
+    elif t == "log":
+        set_out("Out", jnp.log(inp("X")))
+    elif t in ("silu", "swish"):
+        set_out("Out", jax.nn.silu(inp("X")))
     else:
         raise UnimplementedError(
             "reference-model importer: op %r is not in the supported "

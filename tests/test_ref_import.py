@@ -313,6 +313,332 @@ class TestResNetStyleBlock:
                                    rtol=1e-4, atol=1e-5)
 
 
+class TestTransformerEncoderBlock:
+    """VERDICT r4 #4: a reference-saved ERNIE/BERT-class encoder block —
+    embeddings, layer_norm, multi-head attention via the
+    matmul/reshape/transpose/scale/softmax composition, gelu FFN,
+    residuals, first-token pooling — imports and matches a native jnp
+    oracle."""
+
+    H, HEADS, SEQ, VOCAB = 8, 2, 6, 32
+    HD = H // HEADS
+
+    def _build(self, tmp_path, rng):
+        H, SEQ, VOCAB, HEADS, HD = (self.H, self.SEQ, self.VOCAB,
+                                    self.HEADS, self.HD)
+        p = {
+            "word_emb": rng.randn(VOCAB, H).astype(np.float32) * 0.1,
+            "pos_emb": rng.randn(SEQ, H).astype(np.float32) * 0.1,
+            "ln0_s": (rng.rand(H) + 0.5).astype(np.float32),
+            "ln0_b": rng.randn(H).astype(np.float32) * 0.1,
+            "qkv_w": rng.randn(H, 3 * H).astype(np.float32) * 0.2,
+            "qkv_b": rng.randn(3 * H).astype(np.float32) * 0.1,
+            "out_w": rng.randn(H, H).astype(np.float32) * 0.2,
+            "out_b": rng.randn(H).astype(np.float32) * 0.1,
+            "ln1_s": (rng.rand(H) + 0.5).astype(np.float32),
+            "ln1_b": rng.randn(H).astype(np.float32) * 0.1,
+            "ffn1_w": rng.randn(H, 4 * H).astype(np.float32) * 0.2,
+            "ffn1_b": rng.randn(4 * H).astype(np.float32) * 0.1,
+            "ffn2_w": rng.randn(4 * H, H).astype(np.float32) * 0.2,
+            "ffn2_b": rng.randn(H).astype(np.float32) * 0.1,
+            "ln2_s": (rng.rand(H) + 0.5).astype(np.float32),
+            "ln2_b": rng.randn(H).astype(np.float32) * 0.1,
+        }
+        variables = [var_desc("feed"), var_desc("fetch"),
+                     var_desc("ids", [-1, SEQ], dtype=3),
+                     var_desc("pos", [-1, SEQ], dtype=3)]
+        variables += [var_desc(n, list(v.shape), persistable=True)
+                      for n, v in p.items()]
+        for n in ("we", "pe", "emb", "ln0", "qkv", "qkvb", "q", "k", "v",
+                  "qr", "kr", "vr", "qt", "kt", "vt", "qs", "att", "attp",
+                  "attd", "ctx", "ctxt", "ctxr", "proj", "projb", "res1",
+                  "ln1", "ff1", "ff1b", "ff1g", "ff2", "ff2b", "res2",
+                  "ln2", "pooled", "pooledt"):
+            variables.append(var_desc(n))
+
+        def mm(x, y, out, **kw):
+            attrs = [attr("trans_x", b=kw.get("tx", False)),
+                     attr("trans_y", b=kw.get("ty", False))]
+            return op_desc("matmul_v2", {"X": [x], "Y": [y]},
+                           {"Out": [out]}, attrs)
+
+        def add(x, y, out):
+            return op_desc("elementwise_add", {"X": [x], "Y": [y]},
+                           {"Out": [out]}, [attr("axis", i=-1)])
+
+        def ln(x, s, b, out):
+            return op_desc("layer_norm",
+                           {"X": [x], "Scale": [s], "Bias": [b]},
+                           {"Y": [out]},
+                           [attr("epsilon", f=1e-5),
+                            attr("begin_norm_axis", i=2)])
+
+        ops = [
+            op_desc("feed", {"X": ["feed"]}, {"Out": ["ids"]},
+                    [attr("col", i=0)]),
+            op_desc("feed", {"X": ["feed"]}, {"Out": ["pos"]},
+                    [attr("col", i=1)]),
+            op_desc("lookup_table_v2", {"W": ["word_emb"], "Ids": ["ids"]},
+                    {"Out": ["we"]}, [attr("padding_idx", i=-1)]),
+            op_desc("lookup_table_v2", {"W": ["pos_emb"], "Ids": ["pos"]},
+                    {"Out": ["pe"]}, [attr("padding_idx", i=-1)]),
+            add("we", "pe", "emb"),
+            ln("emb", "ln0_s", "ln0_b", "ln0"),
+            # attention: fused qkv, split, [B,S,h,hd] transpose dance
+            mm("ln0", "qkv_w", "qkv"),
+            add("qkv", "qkv_b", "qkvb"),
+            op_desc("split", {"X": ["qkvb"]},
+                    {"Out": ["q", "k", "v"]},
+                    [attr("axis", i=2), attr("num", i=3)]),
+        ]
+        for src, dst in (("q", "qr"), ("k", "kr"), ("v", "vr")):
+            ops.append(op_desc(
+                "reshape2", {"X": [src]}, {"Out": [dst]},
+                [attr("shape", ints=[0, 0, HEADS, HD])]))
+        for src, dst in (("qr", "qt"), ("kr", "kt"), ("vr", "vt")):
+            ops.append(op_desc(
+                "transpose2", {"X": [src]}, {"Out": [dst]},
+                [attr("axis", ints=[0, 2, 1, 3])]))
+        ops += [
+            op_desc("scale", {"X": ["qt"]}, {"Out": ["qs"]},
+                    [attr("scale", f=1.0 / np.sqrt(HD)),
+                     attr("bias", f=0.0)]),
+            mm("qs", "kt", "att", ty=True),
+            op_desc("softmax", {"X": ["att"]}, {"Out": ["attp"]},
+                    [attr("axis", i=-1)]),
+            op_desc("dropout", {"X": ["attp"]}, {"Out": ["attd"]},
+                    [attr("dropout_prob", f=0.1),
+                     attr("dropout_implementation",
+                          s="upscale_in_train")]),
+            mm("attd", "vt", "ctx"),
+            op_desc("transpose2", {"X": ["ctx"]}, {"Out": ["ctxt"]},
+                    [attr("axis", ints=[0, 2, 1, 3])]),
+            op_desc("reshape2", {"X": ["ctxt"]}, {"Out": ["ctxr"]},
+                    [attr("shape", ints=[0, 0, H])]),
+            mm("ctxr", "out_w", "proj"),
+            add("proj", "out_b", "projb"),
+            add("projb", "ln0", "res1"),
+            ln("res1", "ln1_s", "ln1_b", "ln1"),
+            # FFN
+            mm("ln1", "ffn1_w", "ff1"),
+            add("ff1", "ffn1_b", "ff1b"),
+            op_desc("gelu", {"X": ["ff1b"]}, {"Out": ["ff1g"]},
+                    [attr("approximate", b=False)]),
+            mm("ff1g", "ffn2_w", "ff2"),
+            add("ff2", "ffn2_b", "ff2b"),
+            add("ff2b", "ln1", "res2"),
+            ln("res2", "ln2_s", "ln2_b", "ln2"),
+            # pooler: first token + tanh
+            op_desc("slice", {"Input": ["ln2"]}, {"Out": ["pooled"]},
+                    [attr("axes", ints=[1]), attr("starts", ints=[0]),
+                     attr("ends", ints=[1]),
+                     attr("decrease_axis", ints=[1])]),
+            op_desc("tanh", {"X": ["pooled"]}, {"Out": ["pooledt"]}),
+            op_desc("fetch", {"X": ["ln2"]}, {"Out": ["fetch"]},
+                    [attr("col", i=0)]),
+            op_desc("fetch", {"X": ["pooledt"]}, {"Out": ["fetch"]},
+                    [attr("col", i=1)]),
+        ]
+        prefix = save_fixture(tmp_path, "encoder", variables, ops, p)
+        return prefix, p
+
+    def _oracle(self, p, ids, pos):
+        import jax
+        import jax.numpy as jnp
+
+        def ln(x, s, b):
+            m = x.mean(-1, keepdims=True)
+            v = ((x - m) ** 2).mean(-1, keepdims=True)
+            return (x - m) / jnp.sqrt(v + 1e-5) * s + b
+
+        B, S, H, HEADS, HD = (ids.shape[0], self.SEQ, self.H,
+                              self.HEADS, self.HD)
+        emb = p["word_emb"][ids] + p["pos_emb"][pos]
+        h0 = ln(jnp.asarray(emb), p["ln0_s"], p["ln0_b"])
+        qkv = h0 @ p["qkv_w"] + p["qkv_b"]
+        q, k, v = jnp.split(qkv, 3, axis=2)
+        q = q.reshape(B, S, HEADS, HD).transpose(0, 2, 1, 3) / np.sqrt(HD)
+        k = k.reshape(B, S, HEADS, HD).transpose(0, 2, 1, 3)
+        v = v.reshape(B, S, HEADS, HD).transpose(0, 2, 1, 3)
+        att = jax.nn.softmax(q @ k.transpose(0, 1, 3, 2), axis=-1)
+        ctx = (att @ v).transpose(0, 2, 1, 3).reshape(B, S, H)
+        res1 = ctx @ p["out_w"] + p["out_b"] + h0
+        h1 = ln(res1, p["ln1_s"], p["ln1_b"])
+        ff = jax.nn.gelu(h1 @ p["ffn1_w"] + p["ffn1_b"], approximate=False)
+        res2 = ff @ p["ffn2_w"] + p["ffn2_b"] + h1
+        h2 = ln(res2, p["ln2_s"], p["ln2_b"])
+        return h2, jnp.tanh(h2[:, 0])
+
+    def test_encoder_block_matches_native(self, tmp_path):
+        rng = np.random.RandomState(7)
+        prefix, p = self._build(tmp_path, rng)
+        model = load_reference_inference_model(prefix)
+        assert model.feed_names == ["ids", "pos"]
+
+        B = 2
+        ids = rng.randint(0, self.VOCAB, (B, self.SEQ)).astype(np.int64)
+        pos = np.broadcast_to(np.arange(self.SEQ, dtype=np.int64),
+                              (B, self.SEQ)).copy()
+        got_h, got_pooled = model(ids, pos)
+        want_h, want_pooled = self._oracle(p, ids, pos)
+        np.testing.assert_allclose(np.asarray(got_h), np.asarray(want_h),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(got_pooled),
+                                   np.asarray(want_pooled),
+                                   rtol=1e-4, atol=1e-5)
+
+
+class TestTransformerOpAdapters:
+    def _run1(self, tmp_path, name, op, variables_extra, feeds, params=None):
+        variables = [var_desc("feed"), var_desc("fetch")] + variables_extra
+        ops = ([op_desc("feed", {"X": ["feed"]}, {"Out": [f]},
+                        [attr("col", i=i)])
+                for i, f in enumerate(feeds)]
+               + [op] +
+               [op_desc("fetch", {"X": ["out"]}, {"Out": ["fetch"]},
+                        [attr("col", i=0)])])
+        prefix = save_fixture(tmp_path, name, variables, ops, params or {})
+        return load_reference_inference_model(prefix)
+
+    def test_lookup_table_v1_and_padding(self, tmp_path):
+        w = np.arange(12, dtype=np.float32).reshape(4, 3)
+        model = self._run1(
+            tmp_path, "emb",
+            op_desc("lookup_table", {"W": ["w"], "Ids": ["ids"]},
+                    {"Out": ["out"]}, [attr("padding_idx", i=1)]),
+            [var_desc("ids", [-1, 2, 1], dtype=3),
+             var_desc("w", [4, 3], persistable=True),
+             var_desc("out")],
+            ["ids"], {"w": w})
+        ids = np.array([[[0], [1]], [[2], [3]]], np.int64)
+        (out,) = model(ids)
+        assert out.shape == (2, 2, 3)  # trailing [..,1] squeezed
+        np.testing.assert_allclose(np.asarray(out[0, 1]), 0.0)  # padded
+        np.testing.assert_allclose(np.asarray(out[1, 0]), w[2])
+
+    def test_stack_concat(self, tmp_path):
+        model = self._run1(
+            tmp_path, "stk",
+            op_desc("stack", {"X": ["a", "b"]}, {"Y": ["out"]},
+                    [attr("axis", i=1)]),
+            [var_desc("a", [-1, 3]), var_desc("b", [-1, 3]),
+             var_desc("out")],
+            ["a", "b"])
+        a = np.ones((2, 3), np.float32)
+        b = np.full((2, 3), 2.0, np.float32)
+        (out,) = model(a, b)
+        assert out.shape == (2, 2, 3)
+        np.testing.assert_allclose(np.asarray(out[:, 1]), b)
+
+        model = self._run1(
+            tmp_path, "cat",
+            op_desc("concat", {"X": ["a", "b"]}, {"Out": ["out"]},
+                    [attr("axis", i=-1)]),
+            [var_desc("a", [-1, 3]), var_desc("b", [-1, 3]),
+             var_desc("out")],
+            ["a", "b"])
+        (out,) = model(a, b)
+        assert out.shape == (2, 6)
+
+    def test_split_sections_with_inferred(self, tmp_path):
+        model = self._run1(
+            tmp_path, "spl",
+            op_desc("split", {"X": ["a"]}, {"Out": ["s0", "out"]},
+                    [attr("axis", i=1),
+                     attr("sections", ints=[2, -1])]),
+            [var_desc("a", [-1, 5]), var_desc("s0"), var_desc("out")],
+            ["a"])
+        a = np.arange(10, dtype=np.float32).reshape(2, 5)
+        (out,) = model(a)
+        np.testing.assert_allclose(np.asarray(out), a[:, 2:])
+
+    def test_unsqueeze_sequential_order(self, tmp_path):
+        """Non-ascending axes insert SEQUENTIALLY (reference kernel
+        semantics): axes=[2,0] on (3,4) -> (1,3,4,1), not (1,3,1,4)."""
+        model = self._run1(
+            tmp_path, "unsq",
+            op_desc("unsqueeze2", {"X": ["a"]}, {"Out": ["out"]},
+                    [attr("axes", ints=[2, 0])]),
+            [var_desc("a", [3, 4]), var_desc("out")],
+            ["a"])
+        (out,) = model(np.zeros((3, 4), np.float32))
+        assert out.shape == (1, 3, 4, 1)
+
+    def test_cast_gather_expand(self, tmp_path):
+        model = self._run1(
+            tmp_path, "cst",
+            op_desc("cast", {"X": ["a"]}, {"Out": ["out"]},
+                    [attr("in_dtype", i=5), attr("out_dtype", i=2)]),
+            [var_desc("a", [-1, 2]), var_desc("out", dtype=2)],
+            ["a"])
+        (out,) = model(np.array([[1.7, -2.2]], np.float32))
+        assert np.asarray(out).dtype == np.int32
+
+        model = self._run1(
+            tmp_path, "gth",
+            op_desc("gather", {"X": ["a"], "Index": ["i"]},
+                    {"Out": ["out"]}, [attr("axis", i=0)]),
+            [var_desc("a", [-1, 2]), var_desc("i", [-1], dtype=3),
+             var_desc("out")],
+            ["a", "i"])
+        a = np.arange(8, dtype=np.float32).reshape(4, 2)
+        (out,) = model(a, np.array([2, 0], np.int64))
+        np.testing.assert_allclose(np.asarray(out), a[[2, 0]])
+
+        # expand_v2: leading broadcast dim + -1 keeps the source dim
+        model = self._run1(
+            tmp_path, "exp",
+            op_desc("expand_v2", {"X": ["a"]}, {"Out": ["out"]},
+                    [attr("shape", ints=[3, -1, 4])]),
+            [var_desc("a", [2, 1]), var_desc("out")],
+            ["a"])
+        (out,) = model(np.array([[5.0], [7.0]], np.float32))
+        assert out.shape == (3, 2, 4)
+        np.testing.assert_allclose(np.asarray(out[1, :, 2]), [5.0, 7.0])
+
+    def test_tensor_shape_operands_raise(self, tmp_path):
+        """Dynamic StartsTensorList-style operands must fail loudly, not
+        silently slice with placeholder attrs."""
+        model = self._run1(
+            tmp_path, "dynslice",
+            op_desc("slice", {"Input": ["a"],
+                              "StartsTensorList": ["st"]},
+                    {"Out": ["out"]},
+                    [attr("axes", ints=[1]), attr("starts", ints=[0]),
+                     attr("ends", ints=[1])]),
+            [var_desc("a", [-1, 4]), var_desc("st", [1], dtype=2),
+             var_desc("out")],
+            ["a", "st"])
+        with pytest.raises(UnimplementedError) as ei:
+            model(np.zeros((2, 4), np.float32),
+                  np.array([1], np.int32))
+        assert "StartsTensorList" in str(ei.value)
+
+    def test_reduce_and_activations(self, tmp_path):
+        model = self._run1(
+            tmp_path, "red",
+            op_desc("reduce_mean", {"X": ["a"]}, {"Out": ["out"]},
+                    [attr("dim", ints=[1]), attr("keep_dim", b=False)]),
+            [var_desc("a", [-1, 4]), var_desc("out")],
+            ["a"])
+        a = np.arange(8, dtype=np.float32).reshape(2, 4)
+        (out,) = model(a)
+        np.testing.assert_allclose(np.asarray(out), a.mean(1), rtol=1e-6)
+
+        for name, fn in (("sqrt", np.sqrt), ("square", np.square),
+                         ("exp", np.exp), ("log", np.log),
+                         ("silu", lambda x: x / (1 + np.exp(-x)))):
+            model = self._run1(
+                tmp_path, "act_" + name,
+                op_desc(name, {"X": ["a"]}, {"Out": ["out"]}),
+                [var_desc("a", [-1, 3]), var_desc("out")],
+                ["a"])
+            x = np.array([[0.5, 1.0, 2.0]], np.float32)
+            (out,) = model(x)
+            np.testing.assert_allclose(np.asarray(out), fn(x),
+                                       rtol=1e-5, atol=1e-6)
+
+
 class TestImporterErrors:
     def test_unknown_op_raises_typed(self, tmp_path):
         variables = [var_desc("feed"), var_desc("fetch"),
