@@ -111,6 +111,16 @@ class Tensor:
     def __int__(self):
         return int(self.item())
 
+    def __index__(self):
+        # lets a concrete 0-d integer Tensor drive range()/indexing in
+        # eager mode, matching the reference Tensor's __index__; the
+        # operator.index contract is lossless-integers-only
+        if not _dtype.is_integer(self.dtype):
+            raise TypeError(
+                "only integer Tensors can be used as an index, got %s"
+                % self.dtype)
+        return int(self.item())
+
     def __bool__(self):
         if self.size != 1:
             raise ValueError(

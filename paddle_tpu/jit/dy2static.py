@@ -38,11 +38,23 @@ and under tracing UNDEF inputs are replaced by shape-matched zeros once
 the branch/body output structure is known (via jax.eval_shape).
 
 `while/for ... else` converts via the break-flag's complement; `return`
-inside a converted loop body is supported via the ret flag. Known limits
-(each raises a typed UnimplementedError with the manual routing hint,
-reference program_translator's error_data analog): loop-carried
-variables that change shape/dtype across iterations are not expressible
-in XLA; stores to `global`/`nonlocal` names inside converted blocks.
+inside a converted loop body is supported via the ret flag.
+
+Container-carried variables (the reference's list->tensor_array analog,
+convert_operators.py:738): carried lists/tuples/dicts are pytree-
+flattened into per-leaf lax slots and written back into the ORIGINAL
+container objects afterwards (aliases held outside the construct keep
+eager semantics). Structure-preserving mutation (index/key assignment)
+lowers to lax control flow; structure-CHANGING mutation (append/pop
+under a traced bound or condition) has no static-shape equivalent on
+XLA and raises a typed error naming the variable.
+
+Known limits (each raises a typed UnimplementedError with the manual
+routing hint, reference program_translator's error_data analog):
+loop-carried variables that change shape/dtype across iterations are
+not expressible in XLA; container structure changes under traced
+control flow (above); two carried names aliasing one container object;
+stores to `global`/`nonlocal` names inside converted blocks.
 Closure values are snapshotted at conversion time (later rebinding of a
 closed-over name is invisible); an unbound forward-referenced closure
 falls back to trace-only conversion with a warning.
@@ -233,6 +245,12 @@ def convert_if(pred, true_fn, false_fn, carried, names=()):
         return tuple(out)
 
     nm = _names(names, carried)
+    if any(_is_container(v) for v in carried):
+        flat, fnm, spec = _flatten_slots(carried, nm)
+        out = convert_if(pred, _structured_fn(true_fn, spec, nm, "if"),
+                         _structured_fn(false_fn, spec, nm, "if"),
+                         flat, names=fnm)
+        return _restore_slots(out, spec, carried)
     raw = [_to_raw(v, n) for v, n in zip(carried, nm)]
     t_run = _make_runner(true_fn, carried, names)
     f_run = _make_runner(false_fn, carried, names)
@@ -302,6 +320,185 @@ def _names(names, seq):
     return ["var%d" % i for i in range(len(seq))]
 
 
+# -- container-carried variables (reference list->tensor_array analog) ------
+#
+# The reference converts list mutation inside converted control flow to
+# LoDTensorArray ops (convert_operators.py convert_pop / tensor_array
+# machinery) — a *dynamically sized* runtime structure. XLA has no
+# dynamic sizes, so the TPU-native treatment is pytree flattening: a
+# carried list/tuple/dict is expanded into its leaves (each leaf a
+# normal lax-carried slot) and rebuilt afterwards. Structure-PRESERVING
+# mutation (index/key assignment, same-length rebuilds) lowers to
+# lax.cond/while_loop like any other carried value; structure-CHANGING
+# mutation (append/pop under a traced bound) is not expressible and
+# raises a typed error naming the variable.
+
+
+def _is_container(v):
+    return isinstance(v, (list, tuple, dict))
+
+
+def _container_leaf(x):
+    return isinstance(x, (Tensor, _Undef))
+
+
+def _check_container_aliasing(carried, names):
+    """Two carried names bound to the same (or a shared nested) container
+    OBJECT would silently diverge once flattened into independent leaf
+    slots — eager mutation through one alias is visible through the
+    other, lax reconstruction is not. Fail loudly instead."""
+    seen = {}
+    for v, n in zip(carried, names):
+        if not _is_container(v):
+            continue
+        stack = [v]
+        while stack:
+            node = stack.pop()
+            prev = seen.get(id(node))
+            if prev is not None:
+                # ANY revisit — across names, within one container, or
+                # a reference cycle — means flattening would split one
+                # object into independent slots and silently diverge
+                raise UnimplementedError(
+                    "variable(s) %s carry the same (or a shared nested) "
+                    "container object more than once through tensor-"
+                    "dependent control flow — shared/cyclic containers "
+                    "cannot keep eager aliasing semantics once lowered "
+                    "to XLA; mutate through a single reference"
+                    % sorted({prev, n}), hint=_HINT)
+            seen[id(node)] = n
+            vals = node.values() if isinstance(node, dict) else node
+            stack.extend(x for x in vals if _is_container(x))
+
+
+def _flatten_slots(carried, names):
+    """Expand container slots into per-leaf slots.
+
+    Returns (flat_vals, flat_names, spec); spec is one (treedef|None,
+    leaf_count) per original slot — None marks a non-container slot
+    passed through unchanged."""
+    _check_container_aliasing(carried, names)
+    flat_vals, flat_names, spec = [], [], []
+    for v, n in zip(carried, names):
+        if _is_container(v):
+            try:
+                leaves, treedef = jax.tree_util.tree_flatten(
+                    v, is_leaf=_container_leaf)
+            except (TypeError, ValueError) as e:
+                raise UnimplementedError(
+                    "cannot carry container variable %r through "
+                    "tensor-dependent control flow: %s" % (n, e),
+                    hint=_HINT)
+            flat_vals.extend(leaves)
+            flat_names.extend("%s[%d]" % (n, i)
+                              for i in range(len(leaves)))
+            spec.append((treedef, len(leaves)))
+        else:
+            flat_vals.append(v)
+            flat_names.append(n)
+            spec.append((None, 1))
+    return flat_vals, flat_names, spec
+
+
+def _unflatten_slots(flat, spec):
+    out, it = [], iter(flat)
+    for treedef, k in spec:
+        leaves = [next(it) for _ in range(k)]
+        if treedef is None:
+            out.append(leaves[0])
+        else:
+            out.append(jax.tree_util.tree_unflatten(treedef, leaves))
+    return out
+
+
+def _inplace_update(orig, new):
+    """Write `new`'s values into the ORIGINAL container object so
+    aliases of it held outside the converted construct observe the
+    mutation (eager aliasing semantics). Tuples are immutable in eager
+    too, so rebuilding them cannot diverge from eager."""
+    if isinstance(orig, list) and isinstance(new, list):
+        for i in range(len(orig)):
+            orig[i] = _inplace_update(orig[i], new[i]) \
+                if _is_container(orig[i]) else new[i]
+        return orig
+    if isinstance(orig, dict) and isinstance(new, dict):
+        for k in orig:
+            orig[k] = _inplace_update(orig[k], new[k]) \
+                if _is_container(orig[k]) else new[k]
+        return orig
+    if isinstance(orig, tuple) and isinstance(new, tuple):
+        vals = tuple(_inplace_update(o, n) if _is_container(o) else n
+                     for o, n in zip(orig, new))
+        cls = type(new)  # tree_unflatten preserved namedtuple types
+        if cls is tuple:
+            return vals
+        if hasattr(cls, "_fields"):
+            return cls(*vals)
+        return cls(vals)
+    return new
+
+
+def _restore_slots(out_flat, spec, carried):
+    """Final construct-output rebuild: container slots update their
+    original objects in place (alias-preserving); scalar slots pass
+    through."""
+    rebuilt = _unflatten_slots(out_flat, spec)
+    return tuple(
+        _inplace_update(orig, new)
+        if (td is not None and _is_container(orig)) else new
+        for (td, _k), orig, new in zip(spec, carried, rebuilt))
+
+
+def _reflatten_out(out_slots, spec, names, what):
+    """Flatten one construct-output slot list back to leaf slots,
+    enforcing per-variable structure stability (the XLA analog of the
+    reference's tensor-array contract)."""
+    flat = []
+    for v, (treedef, k), n in zip(out_slots, spec, names):
+        if treedef is None:
+            if _is_container(v):
+                raise UnimplementedError(
+                    "variable %r becomes a %s inside a tensor-dependent "
+                    "%s but was not a container before it — XLA control "
+                    "flow needs a fixed structure; initialize %r as a "
+                    "container of the final shape before the %s"
+                    % (n, type(v).__name__, what, n, what), hint=_HINT)
+            flat.append(v)
+            continue
+        if isinstance(v, _Undef):
+            flat.extend([UNDEF] * k)
+            continue
+        if not _is_container(v):
+            raise UnimplementedError(
+                "container variable %r is rebound to %s inside a "
+                "tensor-dependent %s — XLA control flow needs a fixed "
+                "structure" % (n, type(v).__name__, what), hint=_HINT)
+        leaves, td2 = jax.tree_util.tree_flatten(v, is_leaf=_container_leaf)
+        if td2 != treedef:
+            raise UnimplementedError(
+                "container variable %r changes structure inside a "
+                "tensor-dependent %s (%s -> %s). list.append/pop (or "
+                "adding/removing keys) under a traced condition or "
+                "bound has no static-shape equivalent on XLA; use a "
+                "fixed-length container, append under concrete bounds, "
+                "or build the values and paddle.stack them afterwards"
+                % (n, what, treedef, td2), hint=_HINT)
+        flat.extend(leaves)
+    return tuple(flat)
+
+
+def _structured_fn(fn, spec, names, what, extra_args=0):
+    """Adapt an original-slot branch/body fn to flat leaf slots."""
+
+    def wrapped(*flat):
+        extras = flat[:extra_args]
+        args = _unflatten_slots(flat[extra_args:], spec)
+        out = fn(*extras, *args)
+        return _reflatten_out(out, spec, names, what)
+
+    return wrapped
+
+
 def _coerce_loop_init(raw, out_structs, names, what):
     """lax.while_loop needs init == body-output structure exactly.
     UNDEF inits take the body-output structure; shape changes across
@@ -359,6 +556,16 @@ def convert_while(cond_fn, body_fn, carried, names=()):
         return cur
 
     nm = _names(names, carried)
+    if any(_is_container(v) for v in carried):
+        flat, fnm, spec = _flatten_slots(carried, nm)
+
+        def cond_flat(*flat_vals):
+            return cond_fn(*_unflatten_slots(flat_vals, spec))
+
+        out = convert_while(
+            cond_flat, _structured_fn(body_fn, spec, nm, "while"),
+            flat, names=fnm)
+        return _restore_slots(out, spec, carried)
     raw = [_to_raw(v, n) for v, n in zip(carried, nm)]
     body_run = _make_runner(body_fn, carried, names)
     probe, defined = _partial_probe(body_run, raw)
@@ -445,6 +652,17 @@ def convert_for(iterable, body_fn, carried, stop_idx=(), names=()):
         return cur
 
     nm = _names(names, carried)
+    if any(_is_container(v) for v in carried):
+        flat, fnm, spec = _flatten_slots(carried, nm)
+        offs, pos = [], 0
+        for _, k in spec:
+            offs.append(pos)
+            pos += k
+        out = convert_for(
+            iterable, _structured_fn(body_fn, spec, nm, "for",
+                                     extra_args=1),
+            flat, stop_idx=tuple(offs[i] for i in stop_idx), names=fnm)
+        return _restore_slots(out, spec, carried)
     raw = [_to_raw(v, n) for v, n in zip(carried, nm)]
     if isinstance(iterable, _RangeProxy):
         start, stop, step = iterable.raw()

@@ -579,3 +579,245 @@ class TestKwargsRouting:
         spec.loader.exec_module(mod)
         out = mod.f(paddle.to_tensor(np.array([1.0], np.float32)))
         np.testing.assert_allclose(np.asarray(out.numpy()), [7.0])
+
+
+class TestListCarriedVariables:
+    """reference test_list.py patterns — the reference converts list
+    mutation in converted control flow to LoDTensorArray ops
+    (convert_operators.py:738 convert_pop); the TPU-native analog is
+    pytree flattening of container-carried variables with structure
+    stability enforced by typed errors."""
+
+    def test_list_append_concrete_loop_then_stack(self):
+        """reference test_list_append_in_for_loop: concrete bound."""
+
+        def fn(x):
+            xs = []
+            for i in range(4):
+                xs.append(x * float(i))
+            return paddle.stack(xs).sum(axis=0)
+
+        check_parity(fn, np.array([1.0, 2.0], np.float32))
+
+    def test_list_element_update_in_traced_if(self):
+        """Structure-preserving list mutation lowers to lax.cond."""
+
+        def fn(x):
+            xs = [x, x * 2.0]
+            if x.sum() > 0:
+                xs[0] = xs[0] + 10.0
+            else:
+                xs[1] = xs[1] - 10.0
+            return xs[0] + xs[1]
+
+        check_parity(fn, np.array([1.0, 2.0], np.float32))
+        check_parity(fn, np.array([-1.0, -2.0], np.float32))
+
+    def test_dict_carried_through_traced_if(self):
+        def fn(x):
+            d = {"a": x, "b": x * 3.0}
+            if x.mean() > 0:
+                d["a"] = d["a"] * 2.0
+            else:
+                d["b"] = d["b"] + 1.0
+            return d["a"] - d["b"]
+
+        check_parity(fn, np.array([2.0], np.float32))
+        check_parity(fn, np.array([-2.0], np.float32))
+
+    def test_fixed_list_updated_in_traced_while(self):
+        """reference test_list_in_while_loop variant with fixed length:
+        carried list slots update through lax.while_loop."""
+
+        def fn(x, n):
+            xs = [x, x * 0.0]
+            i = paddle.to_tensor(0)
+            while i < n:
+                xs[1] = xs[1] + xs[0]
+                i = i + 1
+            return xs[1]
+
+        check_parity(fn, np.array([1.0, 2.0], np.float32),
+                     np.array(5, np.int32))
+
+    def test_nested_list_in_traced_for(self):
+        def fn(x, n):
+            xs = [[x, x + 1.0], [x * 2.0]]
+            for _ in range(n):
+                xs[0][0] = xs[0][0] + xs[1][0]
+            return xs[0][0] + xs[0][1]
+
+        check_parity(fn, np.array([1.0], np.float32),
+                     np.array(3, np.int32))
+
+    def test_list_pop_concrete_flow(self):
+        """reference test_list pop pattern under concrete control."""
+
+        def fn(x):
+            xs = [x, x * 2.0, x * 3.0]
+            y = xs.pop(1)
+            for i in range(2):
+                xs.append(y + float(i))
+            return paddle.concat(xs)
+
+        check_parity(fn, np.array([1.0, 2.0], np.float32))
+
+    def test_append_under_traced_while_raises_named(self):
+        """Dynamic-length append (reference tensor_array case) has no
+        XLA equivalent: typed error NAMES the list variable."""
+
+        def fn(x, n):
+            zs = [x]
+            i = paddle.to_tensor(0)
+            while i < n:
+                zs.append(x * 2.0)
+                i = i + 1
+            return zs[0]
+
+        static_fn = jit.to_static(fn)
+        with pytest.raises(UnimplementedError) as ei:
+            static_fn(paddle.to_tensor(np.array([1.0], np.float32)),
+                      paddle.to_tensor(np.array(3, np.int32)))
+        msg = str(ei.value)
+        assert "zs" in msg and "structure" in msg
+
+    def test_append_in_traced_if_raises_named(self):
+        def fn(x):
+            ws = [x]
+            if x.sum() > 0:
+                ws.append(x * 2.0)
+            return ws[0]
+
+        static_fn = jit.to_static(fn)
+        with pytest.raises(UnimplementedError) as ei:
+            static_fn(paddle.to_tensor(np.array([1.0], np.float32)))
+        assert "ws" in str(ei.value)
+
+    def test_container_rebound_to_scalar_raises_named(self):
+        def fn(x):
+            cs = [x, x]
+            if x.sum() > 0:
+                cs = x * 1.0
+            return cs
+
+        static_fn = jit.to_static(fn)
+        with pytest.raises(UnimplementedError) as ei:
+            static_fn(paddle.to_tensor(np.array([1.0], np.float32)))
+        assert "cs" in str(ei.value)
+
+    def test_aliased_containers_inside_construct_raise_named(self):
+        """Two carried names aliasing one list are rebuilt as separate
+        objects inside the lax branch — in-branch mutation through one
+        would be invisible through the other; must fail loudly."""
+
+        def fn(x):
+            xs = [x]
+            ys = xs
+            if x.sum() > 0:
+                xs[0] = xs[0] + 10.0
+                z = ys[0] * 1.0
+            else:
+                z = x
+            return z
+
+        static_fn = jit.to_static(fn)
+        with pytest.raises(UnimplementedError) as ei:
+            static_fn(paddle.to_tensor(np.array([1.0], np.float32)))
+        msg = str(ei.value)
+        assert "xs" in msg and "ys" in msg
+
+    def test_alias_read_outside_construct_keeps_eager_semantics(self):
+        """An alias held OUTSIDE the construct observes the mutation:
+        the construct output is written back into the original list
+        object in place (eager aliasing semantics)."""
+
+        def fn(x):
+            xs = [x]
+            ys = xs
+            if x.sum() > 0:
+                xs[0] = xs[0] + 10.0
+            return ys[0]
+
+        check_parity(fn, np.array([1.0], np.float32))
+        check_parity(fn, np.array([-1.0], np.float32))
+
+    def test_unsortable_dict_keys_raise_named(self):
+        def fn(x):
+            d = {1: x, "a": x * 2.0}
+            if x.sum() > 0:
+                d[1] = d[1] + 1.0
+            return d[1]
+
+        static_fn = jit.to_static(fn)
+        with pytest.raises(UnimplementedError) as ei:
+            static_fn(paddle.to_tensor(np.array([1.0], np.float32)))
+        assert "d" in str(ei.value)
+
+    def test_float_tensor_index_raises(self):
+        t = paddle.to_tensor(np.float32(1.7))
+        with pytest.raises(TypeError):
+            range(t)
+        assert range(paddle.to_tensor(np.int32(3))).stop == 3
+
+    def test_shared_subtree_under_one_name_raises(self):
+        """One carried name holding the same object at two positions
+        would silently diverge after flattening — must raise."""
+
+        def fn(x):
+            inner = [x]
+            xs = [inner, inner]
+            if x.sum() > 0:
+                xs[0][0] = xs[0][0] + 10.0
+            return xs[1][0]
+
+        static_fn = jit.to_static(fn)
+        with pytest.raises(UnimplementedError) as ei:
+            static_fn(paddle.to_tensor(np.array([1.0], np.float32)))
+        assert "xs" in str(ei.value)
+
+    def test_cyclic_container_raises_not_hangs(self):
+        def fn(x):
+            xs = [x]
+            xs.append(xs)
+            if x.sum() > 0:
+                xs[0] = xs[0] + 1.0
+            return xs[0]
+
+        static_fn = jit.to_static(fn)
+        with pytest.raises(UnimplementedError):
+            static_fn(paddle.to_tensor(np.array([1.0], np.float32)))
+
+    def test_namedtuple_carried_keeps_type(self):
+        import collections
+
+        Point = collections.namedtuple("Point", ["a", "b"])
+
+        def fn(x):
+            p = Point(x, x * 2.0)
+            if x.sum() > 0:
+                p = Point(p.a + 1.0, p.b)
+            else:
+                p = Point(p.a - 1.0, p.b)
+            return p.a + p.b
+
+        check_parity(fn, np.array([1.0], np.float32))
+        check_parity(fn, np.array([-1.0], np.float32))
+
+    def test_list_grad_flows_through_traced_if(self):
+        """Autograd composes with container-carried lax.cond."""
+
+        def fn(x):
+            xs = [x, x * 2.0]
+            if x.sum() > 0:
+                xs[0] = xs[0] * 3.0
+            else:
+                xs[0] = xs[0] * 5.0
+            return (xs[0] + xs[1]).sum()
+
+        static_fn = jit.to_static(fn)
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        x.stop_gradient = False
+        y = static_fn(x)
+        y.backward()
+        np.testing.assert_allclose(np.asarray(x.grad.numpy()),
+                                   [5.0, 5.0], rtol=1e-6)
