@@ -97,12 +97,18 @@ class GenerationMixin:
                 % (prompt_len, max_new_tokens, limit))
         return ids, b, prompt_len, total
 
-    def _jit_cached(self, cache_key, build):
+    def _jit_cached(self, cache_key, build, state_names=()):
         """Per-signature compiled-callable cache, bounded at 16 retained
         executables (varying prompt lengths in a serving loop would
-        otherwise grow it forever)."""
+        otherwise grow it forever). The functional-state NAMES are part
+        of the key: a compiled program binds state positionally against
+        the name list it was traced with, so any module-tree mutation
+        (e.g. quantization.convert_to_int8 swapping Linear->Int8Linear,
+        possibly on a deep copy that inherited this cache) must miss the
+        cache instead of mis-binding the new value list."""
         import jax
 
+        cache_key = cache_key + (tuple(state_names),)
         jit_cache = self.__dict__.setdefault("_generate_jit_cache", {})
         compiled = jit_cache.get(cache_key)
         if compiled is None:
@@ -236,7 +242,8 @@ class GenerationMixin:
 
         compiled = self._jit_cached(
             (b, prompt_len, max_new_tokens, do_sample, top_k, top_p,
-             temperature, eos_token_id), lambda: run)
+             temperature, eos_token_id), lambda: run,
+            state_names=names)
         return self._run_eval(compiled, list(values), ids,
                               jax.random.key(seed))
 
@@ -336,5 +343,6 @@ class GenerationMixin:
 
         compiled = self._jit_cached(
             ("beam", b, prompt_len, max_new_tokens, K, eos_token_id,
-             length_penalty, temperature), lambda: run)
+             length_penalty, temperature), lambda: run,
+            state_names=names)
         return self._run_eval(compiled, list(values), ids)

@@ -410,7 +410,7 @@ class PipelinedTrainStep:
     """
 
     def __init__(self, model, loss_fn, optimizer, n_micro, vpp=1, mesh=None,
-                 donate=True, remat=True):
+                 donate=True, remat=True, zero_stage=0):
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         from ..distributed import mesh as _mesh
@@ -424,6 +424,11 @@ class PipelinedTrainStep:
         self.vpp = vpp
         self.remat = remat
         self.donate = donate
+        # ZeRO composed with PP+TP+DP (the reference GroupSharded +
+        # PipelineLayer hybrid; Megatron-LM "distributed optimizer"):
+        # stage 1 shards optimizer slots over the 'sharding' mesh axis,
+        # stage 2 additionally reduce-scatters gradients onto it
+        self.zero_stage = zero_stage
         if "pp" not in self.mesh.axis_names:
             raise ValueError("PipelinedTrainStep needs a 'pp' mesh axis")
         self.n_pp = self.mesh.shape["pp"]
@@ -527,15 +532,18 @@ class PipelinedTrainStep:
                 "stack; a predicate that distinguishes individual layers "
                 "cannot act layer-wise on the stacked representation.")
         for name, slots in self._opt_state.items():
-            spec = (self._stacked_specs[name[len("pp_blocks."):]]
-                    if name.startswith("pp_blocks.")
-                    else self._nb_specs[name])
             self._opt_state[name] = [
-                jax.device_put(sl, self._ns(spec))
+                jax.device_put(sl, self._ns(self._slot_spec(
+                    name, jnp.shape(sl))))
                 if jnp.shape(sl) else sl for sl in slots]
 
-        self._dp = "dp" if "dp" in self.mesh.axis_names else None
-        self.batch_spec = P(self._dp) if self._dp else P()
+        # batch (and at stage>=2 the grads) also split over 'sharding':
+        # the reference data-parallel world = dp * sharding degree
+        batch_axes = tuple(a for a in ("dp", "sharding")
+                           if a in self.mesh.axis_names
+                           and self.mesh.shape[a] > 1)
+        self._dp = batch_axes if batch_axes else None
+        self.batch_spec = P(batch_axes) if batch_axes else P()
         # checkpoint continuity, mirroring CompiledTrainStep: seed slots
         # from accumulators restored via set_state_dict (per-block slots
         # restack into the Megatron layout), resume the step counter,
@@ -545,6 +553,38 @@ class PipelinedTrainStep:
         optimizer._functional_sync = self._sync_opt_state_out
         optimizer._functional_load = self._load_opt_state_in
         self._compiled = None
+
+    # -- ZeRO slot/grad sharding -------------------------------------------
+
+    def _param_shape(self, name):
+        if name.startswith("pp_blocks."):
+            return tuple(jnp.shape(self._stacked[name[len("pp_blocks."):]]))
+        # cached walk: raw_state_tensors() recurses the whole module
+        # tree and _slot_spec calls here per slot per name
+        tensors = self.__dict__.get("_model_tensors")
+        if tensors is None:
+            tensors = self._model_tensors = self.model.raw_state_tensors()
+        return tuple(tensors[name].shape)
+
+    def _slot_spec(self, name, slot_shape):
+        """Optimizer-slot (and, at stage>=2, gradient) sharding: param-
+        shaped slots take the param's spec plus — at zero_stage>=1 — the
+        'sharding' axis on the largest divisible free dim (engine
+        zero_spec); non-param-shaped slots stay replicated."""
+        from jax.sharding import PartitionSpec as P
+
+        from .engine import zero_spec
+
+        if name.startswith("pp_blocks."):
+            base = self._stacked_specs[name[len("pp_blocks."):]]
+        else:
+            base = self._nb_specs[name]
+        pshape = self._param_shape(name)
+        if tuple(slot_shape) != pshape:
+            return P()
+        if self.zero_stage >= 1:
+            return zero_spec(pshape, base, self.mesh)
+        return base
 
     # -- optimizer-state checkpoint bridge ---------------------------------
 
@@ -565,9 +605,9 @@ class PipelinedTrainStep:
             for j, slot in enumerate(slots):
                 key = (slot, id(tensors[n]))
                 if key in opt._accumulators:
+                    arr = jnp.asarray(opt._accumulators[key])
                     self._opt_state[n][j] = jax.device_put(
-                        jnp.asarray(opt._accumulators[key]),
-                        self._ns(self._nb_specs[n]))
+                        arr, self._ns(self._slot_spec(n, jnp.shape(arr))))
         for sfx in self._train_sfx:
             name = "pp_blocks." + sfx
             for j, slot in enumerate(slots):
@@ -585,7 +625,8 @@ class PipelinedTrainStep:
                             for c in range(self.vpp)])
                         for st in range(self.n_pp)])
                     self._opt_state[name][j] = jax.device_put(
-                        arr, self._ns(self._stacked_specs[sfx]))
+                        arr, self._ns(self._slot_spec(name,
+                                                      jnp.shape(arr))))
 
     def _load_opt_state_in(self):
         """Reverse bridge (optimizer _functional_load hook): re-seed the
@@ -668,6 +709,12 @@ class PipelinedTrainStep:
         remat = self.remat
 
         train_sfx = self._train_sfx
+        grad_sh = None
+        if self.zero_stage >= 2:
+            grad_sh = {
+                n: self._ns(self._slot_spec(n, self._param_shape(n)))
+                for n in (list(self._nb_trainable)
+                          + ["pp_blocks." + s for s in train_sfx])}
 
         def step(nb_vals, stacked_vals, opt_state, step_i, lr_i,
                  batch):
@@ -705,6 +752,13 @@ class PipelinedTrainStep:
             pdict.update({"pp_blocks." + s: train[1][s] for s in train_sfx})
             gdict = dict(zip(nb_trainable, g_nb))
             gdict.update({"pp_blocks." + s: g_stacked[s] for s in train_sfx})
+            if grad_sh is not None:
+                # ZeRO-2: constraining the raw grads to the 'sharding'
+                # axis makes XLA emit reduce-scatter (not all-reduce)
+                # for the data-parallel grad combine
+                gdict = {n: jax.lax.with_sharding_constraint(g, grad_sh[n])
+                         if g is not None else g
+                         for n, g in gdict.items()}
             gdict = self._clip_grads(opt, gdict)
             clip_save = opt._grad_clip
             opt._grad_clip = None  # clipped above with per-layer
@@ -727,11 +781,9 @@ class PipelinedTrainStep:
         st_sh = [self._ns(self._stacked_specs[s]) for s in suffixes]
         opt_sh = {}
         for name, slots in self._opt_state.items():
-            spec = (self._stacked_specs[name[len("pp_blocks."):]]
-                    if name.startswith("pp_blocks.")
-                    else self._nb_specs[name])
-            opt_sh[name] = [self._ns(spec) if jnp.shape(sl) else
-                            self._ns(P()) for sl in slots]
+            opt_sh[name] = [
+                self._ns(self._slot_spec(name, jnp.shape(sl)))
+                if jnp.shape(sl) else self._ns(P()) for sl in slots]
         self._compiled = jax.jit(
             step,
             in_shardings=(nb_sh, st_sh, opt_sh, None, None,

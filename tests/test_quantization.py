@@ -463,3 +463,34 @@ class TestInt8Execution:
         out = deploy(paddle.to_tensor(X)).numpy()
         rel = np.abs(out - sim).max() / (np.abs(sim).max() + 1e-8)
         assert rel < 0.05, rel
+
+
+class TestConvertAfterGenerate:
+    def test_int8_generate_after_float_generate(self):
+        """convert_to_int8 on a model that has already generated must not
+        reuse the float model's compiled-generate cache: the module tree
+        changed (Linear -> Int8Linear), so positional state binding
+        against the old name list would mis-bind (review-found: the
+        deep-copied cache produced a reshape crash; the cache key now
+        carries the functional-state names)."""
+        import numpy as np
+
+        import paddle_tpu as paddle
+        from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+        from paddle_tpu.quantization import PTQ, convert_to_int8
+
+        cfg = LlamaConfig.tiny(use_parallel=False)
+        paddle.seed(0)
+        m = LlamaForCausalLM(cfg)
+        m.eval()
+        rng = np.random.RandomState(0)
+        ids = paddle.to_tensor(
+            rng.randint(0, cfg.vocab_size, (2, 12)).astype(np.int32))
+        g_float = m.generate(ids, max_new_tokens=4)
+        q = PTQ().quantize(m, inplace=False)
+        q(ids)
+        m8 = convert_to_int8(q)
+        m8.eval()
+        g_int8 = m8.generate(ids, max_new_tokens=4)
+        assert np.asarray(g_int8.numpy()).shape == (2, 4)
+        assert np.asarray(g_float.numpy()).shape == (2, 4)

@@ -90,3 +90,59 @@ class TestModelZoo:
         with paddle.no_grad():
             out = m(paddle.randn([1, 3, 64, 64]))
         assert out.shape[0] == 1 and len(out.shape) == 4
+
+
+class TestResNetDataFormat:
+    """data_format parity (reference vision/models/resnet.py exposes
+    NCHW/NHWC on the same models): NHWC is the TPU-native conv layout;
+    the two layouts must be numerically identical."""
+
+    def test_nhwc_matches_nchw(self):
+        import numpy as np
+
+        import paddle_tpu as paddle
+        from paddle_tpu.vision.models import resnet18
+
+        paddle.seed(7)
+        m_nchw = resnet18(num_classes=10)
+        paddle.seed(7)
+        m_nhwc = resnet18(num_classes=10, data_format="NHWC")
+        # weights initialize identically (OIHW both ways)
+        sd = m_nchw.state_dict()
+        m_nhwc.set_state_dict(sd)
+        rng = np.random.RandomState(0)
+        x = rng.rand(2, 3, 32, 32).astype(np.float32)
+        m_nchw.eval()
+        m_nhwc.eval()
+        out_c = m_nchw(paddle.to_tensor(x))
+        out_l = m_nhwc(paddle.to_tensor(
+            np.transpose(x, (0, 2, 3, 1)).copy()))
+        np.testing.assert_allclose(np.asarray(out_c.numpy()),
+                                   np.asarray(out_l.numpy()),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_nhwc_trains(self):
+        import numpy as np
+
+        import jax
+
+        import paddle_tpu as paddle
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu.distributed import mesh as pmesh
+        from paddle_tpu.parallel.engine import CompiledTrainStep
+        from paddle_tpu.vision.models import resnet18
+
+        pmesh.build_hybrid_mesh(dp=1, devices=jax.devices()[:1])
+        paddle.seed(0)
+        m = resnet18(num_classes=10, data_format="NHWC")
+        opt = paddle.optimizer.Momentum(learning_rate=0.01, momentum=0.9,
+                                        parameters=m.parameters())
+        step = CompiledTrainStep(
+            m, lambda lg, lb: F.cross_entropy(lg, lb), opt)
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.rand(4, 16, 16, 3).astype(np.float32))
+        y = paddle.to_tensor(rng.randint(0, 10, (4,)).astype(np.int32))
+        first = float(step(x, y))
+        for _ in range(4):
+            last = float(step(x, y))
+        assert np.isfinite(last) and last < first

@@ -73,36 +73,49 @@ def bench_resnet50(results, iters=None):
     iters = iters or (20 if on_tpu else 2)
     # per-chip number: pin a 1-device mesh regardless of host topology
     pmesh.build_hybrid_mesh(dp=1, devices=jax.devices()[:1])
-    paddle.seed(0)
-    model = resnet50(num_classes=1000)
-    if on_tpu:
-        model.to(dtype="bfloat16")
-    opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
-                                    parameters=model.parameters())
 
-    def loss_fn(logits, labels):
-        return F.cross_entropy(logits, labels)
+    def measure(layout):
+        paddle.seed(0)
+        model = resnet50(num_classes=1000, data_format=layout)
+        if on_tpu:
+            model.to(dtype="bfloat16")
+        opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                        parameters=model.parameters())
 
-    step = CompiledTrainStep(model, loss_fn, opt)
-    rng = np.random.RandomState(0)
-    x = paddle.to_tensor(rng.rand(batch, 3, size, size).astype(
-        np.float32) * 2 - 1)
-    if on_tpu:
-        # weights were cast to bf16 above; conv requires matching dtypes
-        x = x.astype("bfloat16")
-    y = paddle.to_tensor(rng.randint(0, 1000, (batch,)).astype(np.int32))
-    for _ in range(2):
-        loss = step(x, y)
-    float(loss)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        loss = step(x, y)
-    final = float(loss)
-    dt = time.perf_counter() - t0
-    assert np.isfinite(final)
+        def loss_fn(logits, labels):
+            return F.cross_entropy(logits, labels)
+
+        step = CompiledTrainStep(model, loss_fn, opt)
+        rng = np.random.RandomState(0)
+        shape = ((batch, 3, size, size) if layout == "NCHW"
+                 else (batch, size, size, 3))
+        x = paddle.to_tensor(rng.rand(*shape).astype(np.float32) * 2 - 1)
+        if on_tpu:
+            # weights were cast to bf16 above; conv needs matching dtypes
+            x = x.astype("bfloat16")
+        y = paddle.to_tensor(rng.randint(0, 1000, (batch,)).astype(
+            np.int32))
+        for _ in range(2):
+            loss = step(x, y)
+        float(loss)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            loss = step(x, y)
+        final = float(loss)
+        dt = time.perf_counter() - t0
+        assert np.isfinite(final)
+        return batch * iters / dt
+
+    # NHWC is the TPU-native conv layout (channels ride the 128-lane
+    # dim); NCHW is measured alongside so the layout win stays an
+    # honest, attributed number instead of a silent methodology change
+    per_layout = {fmt: measure(fmt) for fmt in ("NHWC", "NCHW")}
+    best = max(per_layout, key=per_layout.get)
     _emit(results, "resnet50_train_images_per_sec_per_chip",
-          batch * iters / dt, "images/s",
-          {"batch": batch, "image_size": size})
+          per_layout[best], "images/s",
+          {"batch": batch, "image_size": size, "layout": best,
+           "per_layout_images_per_sec":
+               {k: round(v, 1) for k, v in per_layout.items()}})
 
 
 def bench_ernie_dp(results, iters=None):
@@ -121,7 +134,9 @@ def bench_ernie_dp(results, iters=None):
 
     on_tpu = jax.default_backend() != "cpu"
     if on_tpu:
-        cfg = ErnieConfig.base()
+        # fuse_qkv: one [768, 2304] projection — the measured MXU
+        # narrow-matmul lever from the llama work (BASELINE.md)
+        cfg = ErnieConfig.base(fuse_qkv=True)
         batch, seq = 16, 512
     else:
         cfg = ErnieConfig.tiny()
@@ -161,7 +176,11 @@ def bench_ernie_dp(results, iters=None):
     assert np.isfinite(final)
     _emit(results, "ernie_base_dp_tokens_per_sec_per_chip",
           batch * seq * iters / dt, "tokens/s",
-          {"batch": batch, "seq": seq})
+          {"batch": batch, "seq": seq,
+           # config provenance: BASELINE.md 69,508 was measured with
+           # fuse_qkv=False — a jump from the fusion must be attributed,
+           # not read as a silent win
+           "fuse_qkv": bool(getattr(cfg, "fuse_qkv", False))})
 
 
 def bench_widedeep(results, iters=None):
@@ -359,9 +378,90 @@ def bench_llama1b(results, iters=None):
            "mfu_vs_197tf_peak": round(mfu, 3), "recompute": True})
 
 
+def bench_llama_int8(results, iters=None):
+    """Serving throughput bf16 vs int8 (VERDICT r4 #7: the int8 path
+    landed with zero perf evidence). Measures prefill (one forward over
+    the prompt) and decode (generate loop) tokens/s on the bench-family
+    llama, then converts Linear layers to s8 x s8 -> s32 MXU matmuls
+    (quantization.convert_to_int8) and re-measures — the reference's
+    analysis_predictor int8 serving intent, TPU-native."""
+    import numpy as np
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import mesh as pmesh
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.quantization import PTQ, convert_to_int8
+
+    on_tpu = jax.default_backend() != "cpu"
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=768,
+                          intermediate_size=2048, num_hidden_layers=12,
+                          num_attention_heads=6,
+                          max_position_embeddings=2048,
+                          use_parallel=False, dtype="bfloat16")
+        batch, prompt, new = 8, 512, 128
+    else:
+        cfg = LlamaConfig.tiny(use_parallel=False)
+        batch, prompt, new = 2, 16, 8
+    iters = iters or (5 if on_tpu else 2)
+    pmesh.build_hybrid_mesh(dp=1, devices=jax.devices()[:1])
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    if on_tpu:
+        model.to(dtype="bfloat16")
+    model.eval()
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (batch, prompt)).astype(np.int32))
+
+    def measure(m, tag):
+        # prefill: one full forward over the prompt
+        out = m(ids)
+        logits = out[0] if isinstance(out, tuple) else out
+        float(logits.numpy()[0, 0, 0])
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = m(ids)
+            logits = out[0] if isinstance(out, tuple) else out
+        float(logits.numpy()[0, 0, 0])
+        prefill = batch * prompt * iters / (time.perf_counter() - t0)
+        # decode: compiled generate loop
+        g = m.generate(ids, max_new_tokens=new)
+        int(np.asarray(g.numpy())[0, 0])
+        t0 = time.perf_counter()
+        for _ in range(max(1, iters // 2)):
+            g = m.generate(ids, max_new_tokens=new)
+        int(np.asarray(g.numpy())[0, 0])
+        decode = (batch * new * max(1, iters // 2)
+                  / (time.perf_counter() - t0))
+        return {"prefill_tokens_per_sec": round(prefill, 1),
+                "decode_tokens_per_sec": round(decode, 1)}
+
+    bf16 = measure(model, "bf16")
+    # PTQ calibrate on a couple of prompt batches, then freeze to s8
+    ptq = PTQ()
+    qmodel = ptq.quantize(model, inplace=False)
+    for _ in range(2):
+        qmodel(ids)
+    int8 = convert_to_int8(qmodel)
+    int8.eval()
+    q = measure(int8, "int8")
+    _emit(results, "llama_serving_decode_tokens_per_sec_int8",
+          q["decode_tokens_per_sec"], "tokens/s",
+          {"batch": batch, "prompt": prompt, "new_tokens": new,
+           "bf16": bf16, "int8": q,
+           "int8_speedup_decode": round(
+               q["decode_tokens_per_sec"]
+               / max(bf16["decode_tokens_per_sec"], 1e-9), 3),
+           "int8_speedup_prefill": round(
+               q["prefill_tokens_per_sec"]
+               / max(bf16["prefill_tokens_per_sec"], 1e-9), 3)})
+
+
 SUBS = {"resnet50": bench_resnet50, "ernie_dp": bench_ernie_dp,
         "widedeep": bench_widedeep, "allreduce": bench_allreduce,
-        "llama1b": bench_llama1b}
+        "llama1b": bench_llama1b, "llama_int8": bench_llama_int8}
 
 
 def main():
