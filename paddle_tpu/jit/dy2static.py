@@ -411,6 +411,26 @@ def _unflatten_slots(flat, spec):
     return out
 
 
+def _copy_container(v):
+    """Structural copy (containers rebuilt, leaves shared) — the rollback
+    snapshot for retrying an aborted Python-mode loop as a lax loop."""
+    if isinstance(v, list):
+        return [_copy_container(x) if _is_container(x) else x for x in v]
+    if isinstance(v, dict):
+        return {k: _copy_container(x) if _is_container(x) else x
+                for k, x in v.items()}
+    if isinstance(v, tuple):
+        vals = tuple(_copy_container(x) if _is_container(x) else x
+                     for x in v)
+        cls = type(v)
+        if cls is tuple:
+            return vals
+        if hasattr(cls, "_fields"):
+            return cls(*vals)
+        return cls(vals)
+    return v
+
+
 def _inplace_update(orig, new):
     """Write `new`'s values into the ORIGINAL container object so
     aliases of it held outside the converted construct observe the
@@ -611,7 +631,8 @@ def convert_while(cond_fn, body_fn, carried, names=()):
     return tuple(full)
 
 
-def convert_for(iterable, body_fn, carried, stop_idx=(), names=()):
+def convert_for(iterable, body_fn, carried, stop_idx=(), names=(),
+                _force_traced=False):
     """Runtime dispatch for a converted `for`.
 
     body_fn: (elem, carried...) -> tuple(carried...).
@@ -620,9 +641,11 @@ def convert_for(iterable, body_fn, carried, stop_idx=(), names=()):
     Traced iteration domains (Tensor being traced, or range() with a
     traced bound) lower to jax.lax.while_loop with a counter; everything
     else runs a plain Python loop (including concrete Tensors, matching
-    eager iteration).
+    eager iteration). _force_traced: internal — the traced-flag retry
+    re-enters with the SAME concrete iteration domain but must take the
+    lax lowering, not the Python loop again.
     """
-    traced_len = False
+    traced_len = _force_traced
     seq = iterable
     if isinstance(iterable, Tensor):
         if _is_traced(iterable):
@@ -633,23 +656,57 @@ def convert_for(iterable, body_fn, carried, stop_idx=(), names=()):
         iterable = Tensor(iterable)
         traced_len = True
     if isinstance(iterable, _RangeProxy):
-        traced_len = iterable.traced
+        traced_len = iterable.traced or _force_traced
         if not traced_len:
             seq = iterable.concrete()
 
     if not traced_len:
+        # Python iteration first: concrete loop indices keep working
+        # (list indexing by i, float(i), appends). Only when a
+        # break/return FLAG turns out to be traced (flag concretization
+        # error at the stop check) does the loop re-enter as a lax
+        # lowering — the reference loop_transformer's for->while
+        # conversion for tensor-dependent breaks. Container slots are
+        # snapshotted so the aborted Python iterations' in-place
+        # mutations can be rolled back before the traced re-run.
+        snapshot = [_copy_container(v) if _is_container(v) else None
+                    for v in carried]
         cur = tuple(carried)
+        seq_list = seq
         if isinstance(seq, Tensor):
             import numpy as np
 
-            arr = np.asarray(seq._value)
-            seq = [Tensor(jnp.asarray(arr[i])) for i in range(arr.shape[0])]
-        for elem in seq:
-            cur = tuple(body_fn(elem, *cur))
-            if any(truthy(cur[i]) for i in stop_idx
-                   if cur[i] is not UNDEF):
-                break
-        return cur
+            arr2 = np.asarray(seq._value)
+            seq_list = [Tensor(jnp.asarray(arr2[i]))
+                        for i in range(arr2.shape[0])]
+        try:
+            for elem in seq_list:
+                cur = tuple(body_fn(elem, *cur))
+                if any(truthy(cur[i]) for i in stop_idx
+                       if cur[i] is not UNDEF):
+                    break
+            return cur
+        except (jax.errors.ConcretizationTypeError,
+                jax.errors.TracerArrayConversionError,
+                jax.errors.TracerBoolConversionError):
+            if isinstance(iterable, _RangeProxy):
+                retry = iterable
+            elif isinstance(seq, range):
+                retry = _RangeProxy(seq.start, seq.stop, seq.step)
+            elif isinstance(iterable, Tensor):
+                retry = iterable
+            else:
+                raise UnimplementedError(
+                    "break/continue/return inside this `for` depends "
+                    "on traced values, but the iterable (%s) cannot be "
+                    "lowered to a traced loop — iterate a range() or a "
+                    "Tensor instead" % type(seq).__name__, hint=_HINT)
+            for v, snap in zip(carried, snapshot):
+                if snap is not None:
+                    _inplace_update(v, snap)
+            return convert_for(retry, body_fn, carried,
+                               stop_idx=stop_idx, names=names,
+                               _force_traced=True)
 
     nm = _names(names, carried)
     if any(_is_container(v) for v in carried):

@@ -821,3 +821,46 @@ class TestListCarriedVariables:
         y.backward()
         np.testing.assert_allclose(np.asarray(x.grad.numpy()),
                                    [5.0, 5.0], rtol=1e-6)
+
+
+class TestTracedBreakInConcreteFor:
+    """reference loop_transformer converts a concrete-bound `for` whose
+    break depends on traced values into a while op; the TPU analog
+    lowers the whole loop to lax.while_loop."""
+
+    def test_traced_break_parity(self):
+        def fn(x):
+            acc = x * 0.0
+            for i in range(6):
+                if (x.sum() + i) > 7.0:
+                    break
+                acc = acc + x
+            return acc
+
+        check_parity(fn, np.array([1.0, 2.0], np.float32))   # breaks @ i=5
+        check_parity(fn, np.array([4.0, 4.0], np.float32))   # breaks @ i=0
+        check_parity(fn, np.array([-9.0, 0.0], np.float32))  # never breaks
+
+    def test_traced_return_in_concrete_for(self):
+        def fn(x):
+            for i in range(5):
+                if x.sum() > i:
+                    return x * i
+            return x - 1.0
+
+        check_parity(fn, np.array([0.6, 0.6], np.float32))
+        check_parity(fn, np.array([-1.0, 0.0], np.float32))
+
+    def test_list_iterable_with_traced_break_raises_typed(self):
+        def fn(x):
+            acc = x
+            for v in [1.0, 2.0, 3.0]:
+                if (acc.sum() + v) > 2.0:
+                    break
+                acc = acc + v
+            return acc
+
+        static_fn = jit.to_static(fn)
+        with pytest.raises(UnimplementedError) as ei:
+            static_fn(paddle.to_tensor(np.array([0.1], np.float32)))
+        assert "iterable" in str(ei.value)

@@ -310,9 +310,11 @@ def _time_case(args, body, iters=None, reps=3):
         # chain iterations through the scalar carry so XLA cannot hoist
         # the loop-invariant body out of the scan: additive zero for
         # floats, xor with the (zero-valued but data-dependent) carry
-        # truncation for ints
+        # truncation for ints. The zero must be cast to x.dtype FIRST:
+        # `x + 0*c` with an f32 carry silently promotes bf16 inputs to
+        # f32 and the row times the wrong kernel (review-found).
         if jnp.issubdtype(x.dtype, jnp.floating):
-            return x + 0 * c
+            return x + (0 * c).astype(x.dtype)
         if jnp.issubdtype(x.dtype, jnp.integer):
             return x ^ c.astype(x.dtype)
         return x

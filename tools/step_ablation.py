@@ -132,7 +132,8 @@ def _forward_only(emit, model, ids_val, inner, outer, note):
     return ms
 
 
-def _opt_update_only(emit, step, opt, name="adamw_update_only"):
+def _opt_update_only(emit, step, opt, inner, outer,
+                     name="adamw_update_only"):
     import jax.numpy as jnp
 
     tr = {n: step._tensors[n]._value for n in step._trainable_names}
@@ -149,7 +150,7 @@ def _opt_update_only(emit, step, opt, name="adamw_update_only"):
         opt_body, (tr, ost),
         lambda c: float(jnp.sum(
             c[0][first].reshape(-1)[:1].astype(jnp.float32))),
-        16, 2)
+        inner, outer)
     emit(name, ms, "elementwise, HBM-bound")
     return ms
 
@@ -274,7 +275,7 @@ def run_llama(args):
     emit("lm_head_plus_ce_fwd_bwd", head_ms, "vocab %d" % cfg.vocab_size)
 
     # 4. optimizer apply only (AdamW elementwise over all params)
-    opt_ms = _opt_update_only(emit, step, opt)
+    opt_ms = _opt_update_only(emit, step, opt, inner, outer)
 
     attn_total = attn_ms * cfg.num_hidden_layers
     resid = full_ms - disp_ms - attn_total - head_ms - opt_ms
@@ -355,7 +356,8 @@ def run_resnet50(args):
     outer = max(2, iters // 4)
     fwd_ms = _forward_only(emit, model, x._value, inner, outer,
                            "conv tower + head, inference pass")
-    opt_ms = _opt_update_only(emit, step, opt, "momentum_update_only")
+    opt_ms = _opt_update_only(emit, step, opt, inner, outer,
+                              "momentum_update_only")
     emit("residual_bwd_and_glue",
          full_ms - disp_ms - fwd_ms - opt_ms,
          "conv/BN backward + XLA glue (fwd is measured separately)")
@@ -497,7 +499,7 @@ def run_ernie(args):
          "2 x [b,s,h] bernoulli; x%d layers = %.2f ms (llama pays 0)"
          % (cfg.num_hidden_layers, drop_ms * cfg.num_hidden_layers))
 
-    opt_ms = _opt_update_only(emit, step, opt)
+    opt_ms = _opt_update_only(emit, step, opt, inner, outer)
     attn_total = attn_ms * cfg.num_hidden_layers
     drop_total = drop_ms * cfg.num_hidden_layers
     emit("residual_ffn_ln_embed_glue",

@@ -1,0 +1,59 @@
+#!/bin/bash
+# On-chip measurement battery for a live tunnel window (round-5 VERDICT
+# items 1, 2, 7): runs every pending measurement in priority order, each
+# under its own timeout so one wedge cannot burn the window. Outputs are
+# committed artifacts under tools/ + BENCH_LAST_GOOD via bench.py.
+#
+#   bash tools/tunnel_battery.sh [logdir]
+#
+# Priority: the flagship bench first (the driver-visible number), then
+# the model rows, the op baseline, the ablations, serving int8, 7B
+# microbench.
+set -u
+cd "$(dirname "$0")/.."
+LOG=${1:-/tmp/battery_$(date -u +%H%M)}
+mkdir -p "$LOG"
+stamp() { date -u +%H:%M:%S; }
+
+run() {  # run <name> <timeout> <cmd...>
+  local name=$1 t=$2; shift 2
+  echo "[$(stamp)] START $name" | tee -a "$LOG/battery.log"
+  timeout "$t" "$@" > "$LOG/$name.out" 2>&1
+  local rc=$?
+  echo "[$(stamp)] DONE $name rc=$rc" | tee -a "$LOG/battery.log"
+  tail -2 "$LOG/$name.out" | tee -a "$LOG/battery.log"
+  return $rc
+}
+
+# 0. pre-flight: bail fast if the tunnel is actually wedged
+run probe 240 python bench.py --probe || { echo "tunnel wedged; abort"; exit 3; }
+
+# 1. flagship number (single-step for vs_baseline + run_steps headline)
+run bench 1500 python bench.py
+
+# 2. north-star model rows (resnet both layouts, ernie fused, widedeep,
+#    llama1b MFU row)
+run model_resnet 1200 python tools/model_benchmark.py resnet50
+run model_ernie 900 python tools/model_benchmark.py ernie_dp
+run model_llama1b 1200 python tools/model_benchmark.py llama1b
+run model_widedeep 600 python tools/model_benchmark.py widedeep
+
+# 3. op baseline refresh: 44 rows (the reference-style CI gate)
+run op_update 1800 python tools/op_benchmark.py update
+
+# 4. step ablations (fixed grad threading; resnet layout tax; ernie
+#    dropout/attention attribution)
+run ablate_134m 1200 python tools/step_ablation.py --config 134m \
+    --out tools/step_ablation_134m.json
+run ablate_resnet 1500 python tools/step_ablation.py --config resnet50 \
+    --out tools/step_ablation_resnet50.json
+run ablate_ernie 1200 python tools/step_ablation.py --config ernie \
+    --out tools/step_ablation_ernie.json
+
+# 5. int8 serving row
+run model_int8 1200 python tools/model_benchmark.py llama_int8
+
+# 6. 7B-shape layer microbench (refines the pod projection)
+run llama7b_micro 900 python tools/llama7b_plan.py --microbench
+
+echo "[$(stamp)] battery complete; logs in $LOG" | tee -a "$LOG/battery.log"
