@@ -91,7 +91,18 @@ def measure_main():
         return F.cross_entropy(
             logits.reshape([-1, cfg.vocab_size]), labels.reshape([-1]))
 
-    step = CompiledTrainStep(model, loss_fn, opt)
+    # FLAGS_fused_lm_head_ce=1 (env) routes the loss tail through the
+    # streaming Pallas lm_head+CE kernel — the labels then go to the
+    # model, which computes the identical loss (tests pin parity).
+    # Measurement variants are tagged in the output row.
+    from paddle_tpu.core import flags as _flg
+
+    fused_ce = _flg.get_flags("FLAGS_fused_lm_head_ce")[
+        "FLAGS_fused_lm_head_ce"]
+    if fused_ce:
+        step = CompiledTrainStep(model, None, opt, labels_to_model=True)
+    else:
+        step = CompiledTrainStep(model, loss_fn, opt)
     rng = np.random.RandomState(0)
 
     # Device-loop measurement (CompiledTrainStep.run_steps): K distinct
@@ -166,6 +177,7 @@ def measure_main():
         "single_step_tokens_per_sec": round(single_tps, 1),
         "backend": jax.default_backend(),
         "steps_per_call": 1 if single else k,
+        "fused_lm_head_ce": bool(fused_ce),
     }))
 
 

@@ -20,6 +20,17 @@ _DEFAULTS = {
     # compile / cache behavior (XLA analogs of allocator & executor flags)
     "FLAGS_jit_cache_size": 4096,
     "FLAGS_use_bf16_matmul": True,  # prefer bfloat16 MXU matmuls under amp
+    # minimum head_dim routed to the Pallas flash-attention kernel.
+    # The kernel is numerically exact down to 64 (interpret-mode parity
+    # tests), but this Mosaic build has only been measured at 128; set
+    # to 64 (e.g. for ERNIE's 12x64 heads) once an on-chip window
+    # validates the compile — tools/tunnel_battery.sh probes it.
+    "FLAGS_flash_min_head_dim": 128,
+    # route the decoder loss tail through the streaming Pallas
+    # lm_head+CE kernel (kernels/fused_ce.py) on compiled training
+    # steps. Interpret-mode exact; default off until an on-chip window
+    # validates the Mosaic compile + timing (tunnel battery probes it).
+    "FLAGS_fused_lm_head_ce": False,
     "FLAGS_eager_delete_tensor_gb": 0.0,  # accepted, no-op under XLA GC
     "FLAGS_allocator_strategy": "xla",  # buffer assignment is XLA's
     "FLAGS_fraction_of_gpu_memory_to_use": 1.0,  # accepted for compat

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import math
 
+import jax
 import jax.numpy as jnp
 
 from .. import ops
@@ -374,6 +375,41 @@ class LlamaForCausalLM(GenerationMixin, Layer):
 
     def forward(self, input_ids, labels=None):
         h = self.llama(input_ids)
+        if labels is not None and not self.config.use_parallel:
+            from ..core import flags as _flg
+            from ..core.tensor import Tensor
+
+            B, S, H = h.shape
+            T = B * S
+            hv_raw = h._value if isinstance(h, Tensor) else h
+            from ..kernels.fused_ce import (
+                DEFAULT_BLOCK_T,
+                DEFAULT_IGNORE_INDEX,
+            )
+
+            if (_flg.get_flags("FLAGS_fused_lm_head_ce")
+                    ["FLAGS_fused_lm_head_ce"]
+                    and T % DEFAULT_BLOCK_T == 0
+                    and isinstance(hv_raw, jax.core.Tracer)):
+                # traced (compiled-step) path only: the custom_vjp
+                # carries grads through jax.grad; the EAGER tape does
+                # not see through it, so eager training falls through
+                # to the regular logits path
+                # tile-resident loss tail: lm_head matmul + logsumexp
+                # + gold pick in one streaming Pallas kernel — the
+                # [tokens, vocab] logits never reach HBM
+                # (kernels/fused_ce.py; prototype, flag-gated)
+                from ..kernels.fused_ce import fused_lm_head_ce
+
+                lv = labels._value if isinstance(labels, Tensor) \
+                    else jnp.asarray(labels)
+                per_tok = fused_lm_head_ce(
+                    hv_raw.reshape(T, H), self.lm_head.weight._value,
+                    lv.reshape(T), DEFAULT_IGNORE_INDEX, DEFAULT_BLOCK_T)
+                valid = (lv.reshape(T)
+                         != DEFAULT_IGNORE_INDEX).astype(per_tok.dtype)
+                return Tensor(per_tok.sum()
+                              / valid.sum().clip(min=1.0))
         logits = self.lm_head(h)
         if labels is not None:
             if self.config.use_parallel:

@@ -70,11 +70,19 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
             "attends keys j <= i). For cached decode (bottom-right "
             "alignment), pass an explicit end-aligned attn_mask.",
             stacklevel=2)
+    from ...core import flags as _flags
+
+    min_d = _flags.get_flags("FLAGS_flash_min_head_dim")[
+        "FLAGS_flash_min_head_dim"]
     use_flash = (
         jax.default_backend() == "tpu"
         and attn_mask is None
         and dropout_p == 0.0
-        and q.shape[-1] % 128 == 0
+        # validated head_dims only: 128-multiples (measured) and exactly
+        # 64 (kernel-exact, flag-gated pending on-chip Mosaic check) —
+        # NOT every 64-multiple (192/320 are untested lane layouts)
+        and (q.shape[-1] % 128 == 0 or q.shape[-1] == 64)
+        and q.shape[-1] >= min_d
         and q.shape[1] % 128 == 0
         and k.shape[1] % 128 == 0
     )

@@ -77,10 +77,16 @@ class CompiledTrainStep:
     loss_fn + Optimizer over the current mesh."""
 
     def __init__(self, model, loss_fn, optimizer, mesh=None, zero_stage=0,
-                 donate=True, batch_spec=None):
+                 donate=True, batch_spec=None, labels_to_model=False):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
+        # labels_to_model: the model's forward computes the loss itself
+        # (model(*inputs, labels) -> scalar) — the path that lets a
+        # model fuse its loss tail (e.g. FLAGS_fused_lm_head_ce streams
+        # lm_head+CE in one Pallas kernel, kernels/fused_ce.py).
+        # loss_fn may be None in this mode.
+        self.labels_to_model = labels_to_model
         self.mesh = mesh or _mesh.get_mesh()
         self.zero_stage = zero_stage
         self.donate = donate
@@ -175,6 +181,7 @@ class CompiledTrainStep:
 
     def _build(self):
         model, loss_fn, opt = self.model, self.loss_fn, self.optimizer
+        labels_to_model = self.labels_to_model
         names = self._names
         trainable_names = self._trainable_names
         mesh = self.mesh
@@ -199,9 +206,16 @@ class CompiledTrainStep:
                 wrapped = [Tensor(b) for b in batch]
                 with model.bind_state(names, [full[n] for n in names]):
                     with no_grad():
-                        out = model(*wrapped[:-1]) if len(wrapped) > 1 \
-                            else model(wrapped[0])
-                    loss = loss_fn(out, wrapped[-1])
+                        if labels_to_model:
+                            out = model(*wrapped)
+                        else:
+                            out = model(*wrapped[:-1]) \
+                                if len(wrapped) > 1 else model(wrapped[0])
+                    if labels_to_model:
+                        loss = out if loss_fn is None \
+                            else loss_fn(out, wrapped[-1])
+                    else:
+                        loss = loss_fn(out, wrapped[-1])
                 return loss._value if isinstance(loss, Tensor) else loss
 
             train_vals = [state[n] for n in trainable_names]

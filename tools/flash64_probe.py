@@ -1,0 +1,85 @@
+"""On-chip probe: does this Mosaic build compile the flash kernel at
+head_dim 64 (ERNIE/BERT heads)? The kernel is interpret-mode exact at 64
+(tests/test_kernels.py); if this probe passes in a tunnel window, flip
+FLAGS_flash_min_head_dim to 64 for the ERNIE configs (the ablation's
+attention row then routes through the MXU kernel instead of the XLA
+fallback).
+
+Prints one JSON line {"flash_d64_compiles": bool, ...}.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    if jax.default_backend() == "cpu":
+        print(json.dumps({"flash_d64_compiles": None,
+                          "skipped": "needs the TPU chip"}))
+        return 0
+    from paddle_tpu.kernels.flash_attention import flash_attention
+
+    rng = np.random.RandomState(0)
+    b, n, h, d = 8, 512, 12, 64  # the ERNIE-base attention shape
+    q = jnp.asarray(rng.randn(b, n, h, d), jnp.bfloat16)
+    k = jnp.asarray(rng.randn(b, n, h, d), jnp.bfloat16)
+    v = jnp.asarray(rng.randn(b, n, h, d), jnp.bfloat16)
+    row = {"shape": [b, n, h, d]}
+    try:
+        grad = jax.jit(jax.grad(
+            lambda q, k, v: jnp.sum(flash_attention(
+                q, k, v, causal=False, interpret=False
+            ).astype(jnp.float32)), argnums=(0, 1, 2)))
+        g = grad(q, k, v)
+        float(jnp.asarray(g[0]).astype(jnp.float32).sum())
+        # time kernel vs XLA fallback at the same shape
+        def t(fn):
+            r = fn(q, k, v)
+            float(jnp.asarray(r[0]).astype(jnp.float32).sum())
+            t0 = time.perf_counter()
+            for _ in range(10):
+                r = fn(q, k, v)
+            float(jnp.asarray(r[0]).astype(jnp.float32).sum())
+            return (time.perf_counter() - t0) / 10 * 1e3
+
+        from paddle_tpu.kernels.flash_attention import (
+            _reference_attention,
+        )
+
+        def fallback(q, k, v):
+            def fold(x):
+                return jnp.swapaxes(x, 1, 2).reshape(b * h, n, d)
+
+            return (jax.grad(lambda q_, k_, v_: jnp.sum(
+                _reference_attention(fold(q_), fold(k_), fold(v_),
+                                     1.0 / 8.0, False)
+                .astype(jnp.float32)), argnums=(0, 1, 2))(q, k, v))
+
+        fb = jax.jit(fallback)
+        row.update({"flash_d64_compiles": True,
+                    "kernel_ms": round(t(lambda *a: grad(*a)), 3),
+                    "xla_fallback_ms": round(t(lambda *a: fb(*a)), 3)})
+    except Exception as e:  # noqa: BLE001 — the probe's entire job
+        row.update({"flash_d64_compiles": False,
+                    "error": "%s: %s" % (type(e).__name__, str(e)[:300])})
+    row["measured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    print(json.dumps(row), flush=True)
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "flash64_probe.json")
+    with open(out, "w") as f:
+        json.dump(row, f, indent=1)
+        f.write("\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -50,6 +50,21 @@ run ablate_resnet 1500 python tools/step_ablation.py --config resnet50 \
 run ablate_ernie 1200 python tools/step_ablation.py --config ernie \
     --out tools/step_ablation_ernie.json
 
+# 4b. flash kernel at head_dim 64 (ERNIE heads): compile probe + timing
+#     vs the XLA fallback; if it compiles, re-run the ernie ablation
+#     with the kernel routed in for an attributed comparison
+run flash64 600 python tools/flash64_probe.py
+if grep -q '"flash_d64_compiles": true' "$LOG/flash64.out" 2>/dev/null; then
+  run ablate_ernie_flash64 1200 env FLAGS_flash_min_head_dim=64 \
+      python tools/step_ablation.py --config ernie \
+      --out tools/step_ablation_ernie_flash64.json
+fi
+
+# 4c. fused lm_head+CE kernel (measure child only — must not touch
+#     BENCH_LAST_GOOD; parity is test-pinned, this is the timing)
+run bench_fused_ce 1500 env FLAGS_fused_lm_head_ce=1 \
+    python bench.py --measure
+
 # 5. int8 serving row
 run model_int8 1200 python tools/model_benchmark.py llama_int8
 
