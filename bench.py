@@ -67,6 +67,11 @@ def measure_main():
 
     paddle.seed(0)
     on_tpu = jax.default_backend() != "cpu"
+    # BENCH_FUSE=1: fused qkv ([768, 2304]) + fused gate/up ([768, 4096])
+    # projections — the measured narrow-matmul MXU lever; numerics
+    # identical, param structure differs, so it is a tagged VARIANT,
+    # never a silent change to the headline config.
+    fuse = os.environ.get("BENCH_FUSE") == "1"
     # single-chip sized decoder (~110M params) in bf16 when on TPU
     if on_tpu:
         # head_dim 128 (768/6) engages the Pallas flash kernel; 12 heads of
@@ -75,10 +80,12 @@ def measure_main():
                           intermediate_size=2048, num_hidden_layers=12,
                           num_attention_heads=6,
                           max_position_embeddings=2048, use_parallel=False,
-                          dtype="bfloat16")
+                          dtype="bfloat16", fuse_attention_qkv=fuse,
+                          fuse_mlp=fuse)
         batch, seq = 8, 1024
     else:  # CPU smoke config
-        cfg = LlamaConfig.tiny(use_parallel=False)
+        cfg = LlamaConfig.tiny(use_parallel=False, fuse_attention_qkv=fuse,
+                               fuse_mlp=fuse)
         batch, seq = 2, 64
 
     model = LlamaForCausalLM(cfg)
@@ -97,8 +104,11 @@ def measure_main():
     # Measurement variants are tagged in the output row.
     from paddle_tpu.core import flags as _flg
 
-    fused_ce = _flg.get_flags("FLAGS_fused_lm_head_ce")[
-        "FLAGS_fused_lm_head_ce"]
+    from paddle_tpu.kernels.fused_ce import DEFAULT_BLOCK_T
+
+    fused_ce = (_flg.get_flags("FLAGS_fused_lm_head_ce")
+                ["FLAGS_fused_lm_head_ce"]
+                and (batch * seq) % DEFAULT_BLOCK_T == 0)
     if fused_ce:
         step = CompiledTrainStep(model, None, opt, labels_to_model=True)
     else:
@@ -178,6 +188,7 @@ def measure_main():
         "backend": jax.default_backend(),
         "steps_per_call": 1 if single else k,
         "fused_lm_head_ce": bool(fused_ce),
+        "fused_projections": fuse,
     }))
 
 

@@ -231,8 +231,9 @@ def _fused_fwd_impl(h, w, labels, ignore_index, block_t, block_v,
     V = w.shape[1]
     if T % block_t:
         raise ValueError(
-            "fused_lm_head_ce: tokens %d must divide block_t %d "
-            "(vocab is padded to the block internally)" % (T, block_t))
+            "fused_lm_head_ce: block_t %d must divide the token count "
+            "%d (vocab is padded to the block internally)"
+            % (block_t, T))
     labels = jnp.asarray(labels, jnp.int32)
     valid = labels != ignore_index
     # ignored rows pick column 0's logit; masked to 0 below either way

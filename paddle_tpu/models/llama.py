@@ -373,43 +373,45 @@ class LlamaForCausalLM(GenerationMixin, Layer):
             self.lm_head = Linear(config.hidden_size, config.vocab_size,
                                   bias_attr=False)
 
+    def _maybe_fused_ce(self, h, labels):
+        """Scalar mean-CE loss via the streaming lm_head+CE kernel
+        (kernels/fused_ce.py) when FLAGS_fused_lm_head_ce is on, the
+        token count tiles, and we are on a TRACED (compiled-step) path
+        — the custom_vjp carries grads through jax.grad but the eager
+        tape cannot see through it. h must already be final-normed.
+        Returns None when the fused path does not apply."""
+        from ..core import flags as _flg
+        from ..core.tensor import Tensor
+        from ..kernels.fused_ce import (
+            DEFAULT_BLOCK_T,
+            DEFAULT_IGNORE_INDEX,
+            fused_lm_head_ce,
+        )
+
+        if (self.config.use_parallel
+                or not _flg.get_flags("FLAGS_fused_lm_head_ce")
+                ["FLAGS_fused_lm_head_ce"]):
+            return None
+        hv = h._value if isinstance(h, Tensor) else h
+        B, S, H = hv.shape
+        T = B * S
+        if T % DEFAULT_BLOCK_T or not isinstance(hv, jax.core.Tracer):
+            return None
+        lv = labels._value if isinstance(labels, Tensor) \
+            else jnp.asarray(labels)
+        per_tok = fused_lm_head_ce(
+            hv.reshape(T, H), self.lm_head.weight._value,
+            lv.reshape(T), DEFAULT_IGNORE_INDEX, DEFAULT_BLOCK_T)
+        valid = (lv.reshape(T)
+                 != DEFAULT_IGNORE_INDEX).astype(per_tok.dtype)
+        return Tensor(per_tok.sum() / valid.sum().clip(min=1.0))
+
     def forward(self, input_ids, labels=None):
         h = self.llama(input_ids)
-        if labels is not None and not self.config.use_parallel:
-            from ..core import flags as _flg
-            from ..core.tensor import Tensor
-
-            B, S, H = h.shape
-            T = B * S
-            hv_raw = h._value if isinstance(h, Tensor) else h
-            from ..kernels.fused_ce import (
-                DEFAULT_BLOCK_T,
-                DEFAULT_IGNORE_INDEX,
-            )
-
-            if (_flg.get_flags("FLAGS_fused_lm_head_ce")
-                    ["FLAGS_fused_lm_head_ce"]
-                    and T % DEFAULT_BLOCK_T == 0
-                    and isinstance(hv_raw, jax.core.Tracer)):
-                # traced (compiled-step) path only: the custom_vjp
-                # carries grads through jax.grad; the EAGER tape does
-                # not see through it, so eager training falls through
-                # to the regular logits path
-                # tile-resident loss tail: lm_head matmul + logsumexp
-                # + gold pick in one streaming Pallas kernel — the
-                # [tokens, vocab] logits never reach HBM
-                # (kernels/fused_ce.py; prototype, flag-gated)
-                from ..kernels.fused_ce import fused_lm_head_ce
-
-                lv = labels._value if isinstance(labels, Tensor) \
-                    else jnp.asarray(labels)
-                per_tok = fused_lm_head_ce(
-                    hv_raw.reshape(T, H), self.lm_head.weight._value,
-                    lv.reshape(T), DEFAULT_IGNORE_INDEX, DEFAULT_BLOCK_T)
-                valid = (lv.reshape(T)
-                         != DEFAULT_IGNORE_INDEX).astype(per_tok.dtype)
-                return Tensor(per_tok.sum()
-                              / valid.sum().clip(min=1.0))
+        if labels is not None:
+            fused = self._maybe_fused_ce(h, labels)
+            if fused is not None:
+                return fused
         logits = self.lm_head(h)
         if labels is not None:
             if self.config.use_parallel:
@@ -463,3 +465,12 @@ class LlamaForCausalLM(GenerationMixin, Layer):
 
     def forward_head(self, h):
         return self.lm_head(self.llama.norm(h))
+
+    def forward_head_loss(self, h, labels):
+        """Fused pipeline loss tail (mean CE over non-ignored tokens —
+        forward(labels=...)'s contract). Returns None so the caller
+        falls back to forward_head + its loss_fn when the kernel path
+        does not apply. Consulted only under PipelinedTrainStep's
+        EXPLICIT fused_loss_tail=True opt-in: it replaces the step's
+        loss_fn, which is only valid for the plain-CE objective."""
+        return self._maybe_fused_ce(self.llama.norm(h), labels)

@@ -410,7 +410,8 @@ class PipelinedTrainStep:
     """
 
     def __init__(self, model, loss_fn, optimizer, n_micro, vpp=1, mesh=None,
-                 donate=True, remat=True, zero_stage=0):
+                 donate=True, remat=True, zero_stage=0,
+                 fused_loss_tail=False):
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         from ..distributed import mesh as _mesh
@@ -429,6 +430,16 @@ class PipelinedTrainStep:
         # stage 1 shards optimizer slots over the 'sharding' mesh axis,
         # stage 2 additionally reduce-scatters gradients onto it
         self.zero_stage = zero_stage
+        # EXPLICIT opt-in: route the loss through the model's
+        # forward_head_loss (e.g. llama's fused lm_head+CE kernel) —
+        # this REPLACES loss_fn, so it is never keyed on a global flag
+        # alone (a non-plain-CE loss_fn would silently change
+        # objective otherwise)
+        self.fused_loss_tail = fused_loss_tail
+        if fused_loss_tail and not hasattr(model, "forward_head_loss"):
+            raise ValueError(
+                "fused_loss_tail=True but the model does not define "
+                "forward_head_loss")
         if "pp" not in self.mesh.axis_names:
             raise ValueError("PipelinedTrainStep needs a 'pp' mesh axis")
         self.n_pp = self.mesh.shape["pp"]
@@ -709,6 +720,7 @@ class PipelinedTrainStep:
         remat = self.remat
 
         train_sfx = self._train_sfx
+        fused_tail = self.fused_loss_tail
         grad_sh = None
         if self.zero_stage >= 2:
             grad_sh = {
@@ -740,8 +752,13 @@ class PipelinedTrainStep:
                             stage, [stacked[s] for s in suffixes], micro,
                             n_pp, vpp=vpp, constrain=constrain, remat=remat)
                         h = out.reshape((B,) + out.shape[2:])
-                        logits = model.forward_head(Tensor(h))
-                    loss = loss_fn(logits, Tensor(labels))
+                        loss = None
+                        if fused_tail:
+                            loss = model.forward_head_loss(
+                                Tensor(h), Tensor(labels))
+                        if loss is None:
+                            logits = model.forward_head(Tensor(h))
+                            loss = loss_fn(logits, Tensor(labels))
                 return loss._value if isinstance(loss, Tensor) else loss
 
             train = ([nb_state[n] for n in nb_trainable],

@@ -405,3 +405,39 @@ class TestPipelineZero:
         assert ("reduce-scatter" in hlo
                 or plan_mod._allreduce_feeds_dynamic_slice(hlo))
         assert "collective-permute" in hlo
+
+
+class TestPipelineFusedCETail:
+    def test_flag_parity_pp2(self):
+        """forward_head_loss under FLAGS_fused_lm_head_ce streams the
+        loss tail through the fused kernel inside the pipelined step;
+        losses must match the regular forward_head + loss_fn path."""
+        from paddle_tpu.core import flags as fl
+
+        cfg = dict(vocab_size=64, hidden_size=16, intermediate_size=32,
+                   num_hidden_layers=4, num_attention_heads=2,
+                   max_position_embeddings=64, use_parallel=False)
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, 64, (8, 32)).astype(np.int32)  # T=256
+
+        def loss_fn(logits, lbl):
+            return F.cross_entropy(logits.reshape([-1, 64]),
+                                   lbl.reshape([-1]))
+
+        def run(fused):
+            fl.set_flags({"FLAGS_fused_lm_head_ce": fused})
+            try:
+                pmesh.build_hybrid_mesh(dp=4, mp=1, pp=2)
+                paddle.seed(21)
+                m = LlamaForCausalLM(LlamaConfig(**cfg))
+                o = paddle.optimizer.Adam(learning_rate=1e-3,
+                                          parameters=m.parameters())
+                step = PipelinedTrainStep(m, loss_fn, o, n_micro=4,
+                                          fused_loss_tail=fused)
+                return [float(step(paddle.to_tensor(ids),
+                                   paddle.to_tensor(ids)))
+                        for _ in range(3)]
+            finally:
+                fl.set_flags({"FLAGS_fused_lm_head_ce": False})
+
+        np.testing.assert_allclose(run(True), run(False), rtol=2e-4)

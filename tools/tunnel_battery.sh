@@ -65,6 +65,11 @@ fi
 run bench_fused_ce 1500 env FLAGS_fused_lm_head_ce=1 \
     python bench.py --measure
 
+# 4d. fused qkv+mlp projections variant (tagged, measure child only)
+run bench_fused_proj 1500 env BENCH_FUSE=1 python bench.py --measure
+run bench_all_fused 1500 env BENCH_FUSE=1 FLAGS_fused_lm_head_ce=1 \
+    python bench.py --measure
+
 # 5. int8 serving row
 run model_int8 1200 python tools/model_benchmark.py llama_int8
 
