@@ -548,11 +548,25 @@ class PipelinedTrainStep:
                     name, jnp.shape(sl))))
                 if jnp.shape(sl) else sl for sl in slots]
 
-        # batch (and at stage>=2 the grads) also split over 'sharding':
-        # the reference data-parallel world = dp * sharding degree
-        batch_axes = tuple(a for a in ("dp", "sharding")
-                           if a in self.mesh.axis_names
-                           and self.mesh.shape[a] > 1)
+        # The reference data-parallel world = dp * sharding degree, and
+        # batch ALWAYS splits over it — except one scoped workaround:
+        # at stage 0/1 WITH a real dp axis, batch stays on dp only.
+        # Sharding is then purely an optimizer-state partitioning axis
+        # and the ring carry avoids a known XLA partitioner reshard
+        # inefficiency (spmd_partitioner involuntary-remat on mixed
+        # (dp,sharding) batch groupings, b/433785288). Stage>=2 accepts
+        # that cost for the reduce-scatter win; a mesh with ONLY a
+        # sharding axis keeps the batch split over it — replicated
+        # compute would be a far worse regression than the reshard.
+        def _deg(a):
+            return (self.mesh.shape[a]
+                    if a in self.mesh.axis_names else 1)
+
+        if self.zero_stage >= 2 or _deg("dp") <= 1:
+            wanted = ("dp", "sharding")
+        else:
+            wanted = ("dp",)
+        batch_axes = tuple(a for a in wanted if _deg(a) > 1)
         self._dp = batch_axes if batch_axes else None
         self.batch_spec = P(batch_axes) if batch_axes else P()
         # checkpoint continuity, mirroring CompiledTrainStep: seed slots
