@@ -10,6 +10,7 @@ the reference's cudnn dropout state caching.
 """
 from __future__ import annotations
 
+import contextlib
 import threading
 
 import jax
@@ -50,6 +51,23 @@ def set_replay_base(key):
     at trace time."""
     _replay.key = key
     _replay.counter = 0
+
+
+@contextlib.contextmanager
+def replay_base(key):
+    """Scoped set_replay_base: saves/restores the previous base AND its
+    counter, exception-safe. The compiled train steps wrap their traced
+    model call in this with a per-step folded key (fresh dropout masks
+    every step; a leaked traced key would poison every later eager
+    draw)."""
+    prev_k = getattr(_replay, "key", None)
+    prev_c = getattr(_replay, "counter", 0)
+    set_replay_base(key)
+    try:
+        yield
+    finally:
+        _replay.key = prev_k
+        _replay.counter = prev_c
 
 
 def next_key():

@@ -197,25 +197,39 @@ class CompiledTrainStep:
         batch_sharding = NamedSharding(mesh, self.batch_spec)
         repl = NamedSharding(mesh, P())
 
-        def step(state_vals, opt_state, step_i, lr_i, batch):
+        def step(state_vals, opt_state, step_i, lr_i, rng_key,
+                 batch):
             state = dict(zip(names, state_vals))
 
             def loss_of(train_vals, batch):
+                from ..framework import random as _random
+
                 full = dict(state)
                 full.update(dict(zip(trainable_names, train_vals)))
                 wrapped = [Tensor(b) for b in batch]
-                with model.bind_state(names, [full[n] for n in names]):
-                    with no_grad():
+                # thread per-step randomness: without a replay base,
+                # next_key() splits the global root AT TRACE TIME and
+                # every compiled step replays the same dropout masks
+                # (the frozen-mask caveat in framework/random.py).
+                # rng_key is an ARGUMENT (like lr): paddle.seed after
+                # compilation must steer the masks; folding the traced
+                # step counter gives fresh masks each step
+                with _random.replay_base(
+                        jax.random.fold_in(rng_key, step_i)):
+                    with model.bind_state(names,
+                                          [full[n] for n in names]):
+                        with no_grad():
+                            if labels_to_model:
+                                out = model(*wrapped)
+                            else:
+                                out = model(*wrapped[:-1]) \
+                                    if len(wrapped) > 1 \
+                                    else model(wrapped[0])
                         if labels_to_model:
-                            out = model(*wrapped)
+                            loss = out if loss_fn is None \
+                                else loss_fn(out, wrapped[-1])
                         else:
-                            out = model(*wrapped[:-1]) \
-                                if len(wrapped) > 1 else model(wrapped[0])
-                    if labels_to_model:
-                        loss = out if loss_fn is None \
-                            else loss_fn(out, wrapped[-1])
-                    else:
-                        loss = loss_fn(out, wrapped[-1])
+                            loss = loss_fn(out, wrapped[-1])
                 return loss._value if isinstance(loss, Tensor) else loss
 
             train_vals = [state[n] for n in trainable_names]
@@ -241,7 +255,7 @@ class CompiledTrainStep:
         self._compiled = jax.jit(
             step,
             in_shardings=(state_shardings, opt_shardings, None, None,
-                          batch_sharding),
+                          None, batch_sharding),
             out_shardings=(repl, state_shardings, opt_shardings),
             donate_argnums=(0, 1) if self.donate else (),
         )
@@ -259,14 +273,15 @@ class CompiledTrainStep:
             self._shardings
         stacked_sharding = self._batch_sharding(stacked=True)
 
-        def multi(state_vals, opt_state, step0, lr_i, batches):
+        def multi(state_vals, opt_state, step0, lr_i, rng_key, batches):
             k = batches[0].shape[0]
 
             def body(i, carry):
                 sv, ost, _ = carry
                 batch = tuple(b[i] for b in batches)
                 loss, new_sv, new_ost = step_fn(
-                    sv, ost, step0 + i.astype(jnp.int32), lr_i, batch)
+                    sv, ost, step0 + i.astype(jnp.int32), lr_i, rng_key,
+                    batch)
                 return (new_sv, new_ost, loss.astype(jnp.float32))
 
             init = (state_vals, opt_state, jnp.float32(0))
@@ -276,7 +291,7 @@ class CompiledTrainStep:
         self._compiled_multi = jax.jit(
             multi,
             in_shardings=(state_shardings, opt_shardings, None, None,
-                          stacked_sharding),
+                          None, stacked_sharding),
             out_shardings=(repl, state_shardings, opt_shardings),
             donate_argnums=(0, 1) if self.donate else (),
         )
@@ -301,10 +316,13 @@ class CompiledTrainStep:
         k = int(vals[0].shape[0])
         tensors = self._tensors
         state_vals = [tensors[n]._value for n in self._names]
+        from ..framework import random as _random
+
         loss, new_state, new_opt = self._compiled_multi(
             state_vals, self._opt_state,
             jnp.asarray(self._step_count + 1, jnp.int32),
-            jnp.asarray(self.optimizer.get_lr(), jnp.float32), vals)
+            jnp.asarray(self.optimizer.get_lr(), jnp.float32),
+            _random._key(), vals)
         self._step_count += k
         for n, v in zip(self._names, new_state):
             tensors[n]._value = v
@@ -364,9 +382,12 @@ class CompiledTrainStep:
             self._build()
         vals = self._prep_batch(batch)
         state_vals = [self._tensors[n]._value for n in self._names]
+        from ..framework import random as _random
+
         return self._compiled.lower(
             state_vals, self._opt_state, jnp.asarray(0, jnp.int32),
-            jnp.asarray(0.0, jnp.float32), vals).compile().as_text()
+            jnp.asarray(0.0, jnp.float32), _random._key(),
+            vals).compile().as_text()
 
     @no_grad()
     def __call__(self, *batch):
@@ -376,11 +397,14 @@ class CompiledTrainStep:
         vals = self._prep_batch(batch)
         tensors = self._tensors
         state_vals = [tensors[n]._value for n in self._names]
+        from ..framework import random as _random
+
         self._step_count += 1
         loss, new_state, new_opt = self._compiled(
             state_vals, self._opt_state,
             jnp.asarray(self._step_count, jnp.int32),
-            jnp.asarray(self.optimizer.get_lr(), jnp.float32), vals)
+            jnp.asarray(self.optimizer.get_lr(), jnp.float32),
+            _random._key(), vals)
         for n, v in zip(self._names, new_state):
             tensors[n]._value = v
         self._opt_state = new_opt

@@ -742,20 +742,27 @@ class PipelinedTrainStep:
                 for n in (list(self._nb_trainable)
                           + ["pp_blocks." + s for s in train_sfx])}
 
-        def step(nb_vals, stacked_vals, opt_state, step_i, lr_i,
+        def step(nb_vals, stacked_vals, opt_state, step_i, lr_i, rng_key,
                  batch):
             nb_state = dict(zip(nb_names, nb_vals))
             stacked_state = dict(zip(suffixes, stacked_vals))
 
             def loss_of(train, batch):
+                from ..framework import random as _random
+
                 nb_train, st_train = train
                 stacked = dict(stacked_state)
                 stacked.update(st_train)
                 full = dict(nb_state)
                 full.update(dict(zip(nb_trainable, nb_train)))
                 ids, labels = batch
-                with model.bind_state(nb_names,
-                                      [full[n] for n in nb_names]):
+                # per-step RNG threading (same frozen-dropout-mask fix
+                # as CompiledTrainStep; rng_key is a traced ARGUMENT so
+                # paddle.seed after compilation still steers masks)
+                with _random.replay_base(
+                        jax.random.fold_in(rng_key, step_i)), \
+                        model.bind_state(nb_names,
+                                         [full[n] for n in nb_names]):
                     with no_grad():
                         x = model.forward_embed(Tensor(ids))
                         x = x._value if isinstance(x, Tensor) else x
@@ -817,7 +824,7 @@ class PipelinedTrainStep:
                 if jnp.shape(sl) else self._ns(P()) for sl in slots]
         self._compiled = jax.jit(
             step,
-            in_shardings=(nb_sh, st_sh, opt_sh, None, None,
+            in_shardings=(nb_sh, st_sh, opt_sh, None, None, None,
                           self._ns(self.batch_spec)),
             out_shardings=(self._ns(P()), nb_sh, st_sh, opt_sh),
             donate_argnums=(0, 1, 2) if self.donate else (),
@@ -858,10 +865,13 @@ class PipelinedTrainStep:
             nb_vals = [tensors[n]._value for n in self._nb_names]
             stacked_vals = [self._stacked[s] for s in self.suffixes]
             self._step_count += 1
+            from ..framework import random as _random
+
             loss, new_nb, new_stacked, new_opt = self._compiled(
                 nb_vals, stacked_vals, self._opt_state,
                 jnp.asarray(self._step_count, jnp.int32),
-                jnp.asarray(self.optimizer.get_lr(), jnp.float32), batch)
+                jnp.asarray(self.optimizer.get_lr(), jnp.float32),
+                _random._key(), batch)
             for n, v in zip(self._nb_names, new_nb):
                 tensors[n]._value = v
             self._stacked = dict(zip(self.suffixes, new_stacked))

@@ -193,7 +193,7 @@ class TestPipelinedTrainStep:
         hlo = step._compiled.lower(
             nb_vals, stacked_vals, step._opt_state,
             jnp.asarray(0, jnp.int32), jnp.asarray(0.0, jnp.float32),
-            batch).compile().as_text()
+            jax.random.key(0), batch).compile().as_text()
         assert "collective-permute" in hlo
 
     def test_sync_to_model_roundtrip(self):
@@ -390,7 +390,7 @@ class TestPipelineZero:
         hlo = step._compiled.lower(
             nb_vals, stacked_vals, step._opt_state,
             jnp.asarray(0, jnp.int32), jnp.asarray(0.0, jnp.float32),
-            batch).compile().as_text()
+            jax.random.key(0), batch).compile().as_text()
         # tight check: a bare "dynamic-slice in hlo" is vacuous (the
         # 1F1B micro-batch indexing emits them unconditionally); reuse
         # the plan tool's consumes-an-all-reduce matcher

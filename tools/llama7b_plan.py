@@ -223,7 +223,8 @@ def config_a(model, cfg, batch, seq):
     lowered = step._compiled.lower(
         state_structs, step._opt_state,
         jax.ShapeDtypeStruct((), jnp.int32),
-        jax.ShapeDtypeStruct((), jnp.float32), batch_structs)
+        jax.ShapeDtypeStruct((), jnp.float32), jax.random.key(0),
+        batch_structs)
     print("A lowered: %.1fs" % (time.time() - t0), flush=True)
     t0 = time.time()
     compiled = lowered.compile()
@@ -271,7 +272,8 @@ def config_b(model, cfg, batch, seq, n_micro):
     lowered = step._compiled.lower(
         nb_structs, st_structs, step._opt_state,
         jax.ShapeDtypeStruct((), jnp.int32),
-        jax.ShapeDtypeStruct((), jnp.float32), batch_structs)
+        jax.ShapeDtypeStruct((), jnp.float32), jax.random.key(0),
+        batch_structs)
     print("B lowered: %.1fs" % (time.time() - t0), flush=True)
     t0 = time.time()
     compiled = lowered.compile()
