@@ -31,6 +31,13 @@ _DEFAULTS = {
     # steps. Interpret-mode exact; default off until an on-chip window
     # validates the Mosaic compile + timing (tunnel battery probes it).
     "FLAGS_fused_lm_head_ce": False,
+    # dropout mask PRNG implementation: 'threefry' (default, the global
+    # splittable PRNG) or 'rbg' (the TPU hardware RNG instruction —
+    # much cheaper per bit for the big per-layer masks; statistical
+    # quality is ample for dropout, and the mask stream stays
+    # deterministic per key). Opt-in because it changes the mask
+    # sequence for a given seed.
+    "FLAGS_dropout_rng_impl": "threefry",
     "FLAGS_eager_delete_tensor_gb": 0.0,  # accepted, no-op under XLA GC
     "FLAGS_allocator_strategy": "xla",  # buffer assignment is XLA's
     "FLAGS_fraction_of_gpu_memory_to_use": 1.0,  # accepted for compat

@@ -85,6 +85,27 @@ def embedding(x, weight, padding_idx=None, sparse=False):
     return out
 
 
+def _mask_key(key):
+    """Dropout-mask key under FLAGS_dropout_rng_impl: 'rbg' re-wraps the
+    key for the TPU hardware RNG (far cheaper per bit than threefry for
+    the big per-layer masks; dropout needs statistical, not crypto,
+    quality). Applied by every dropout variant. Unknown values raise
+    (a typo'd flag silently measuring threefry would waste an on-chip
+    ablation window)."""
+    from ...core import flags as _flg
+
+    impl = _flg.flag("FLAGS_dropout_rng_impl")
+    if impl == "threefry":
+        return key
+    if impl != "rbg":
+        raise ValueError(
+            "FLAGS_dropout_rng_impl must be 'threefry' or 'rbg', got %r"
+            % (impl,))
+    d = jax.random.key_data(key).astype(jnp.uint32).reshape(-1)
+    return jax.random.wrap_key_data(
+        jnp.concatenate([d, d])[:4], impl="rbg")
+
+
 @primitive
 def dropout(x, p=0.5, training=True, mode="upscale_in_train", seed=None):
     x = _A(x)
@@ -93,7 +114,7 @@ def dropout(x, p=0.5, training=True, mode="upscale_in_train", seed=None):
             return x * (1.0 - p)
         return x
     key = jax.random.key(seed) if seed is not None else _random.next_key()
-    keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
+    keep = jax.random.bernoulli(_mask_key(key), 1.0 - p, x.shape)
     if mode == "upscale_in_train":
         return jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype)
     return jnp.where(keep, x, 0.0).astype(x.dtype)
@@ -109,7 +130,8 @@ def dropout2d(x, p=0.5, training=True, data_format="NCHW"):
         shape[2] = shape[3] = 1
     else:
         shape[1] = shape[2] = 1
-    keep = jax.random.bernoulli(_random.next_key(), 1.0 - p, tuple(shape))
+    keep = jax.random.bernoulli(_mask_key(_random.next_key()), 1.0 - p,
+                                tuple(shape))
     return jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype)
 
 
@@ -127,7 +149,8 @@ def _dropout3d(x, p=0.5, training=True, data_format="NCDHW"):
         shape[2] = shape[3] = shape[4] = 1
     else:
         shape[1] = shape[2] = shape[3] = 1
-    keep = jax.random.bernoulli(_random.next_key(), 1.0 - p, tuple(shape))
+    keep = jax.random.bernoulli(_mask_key(_random.next_key()), 1.0 - p,
+                                tuple(shape))
     return jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype)
 
 

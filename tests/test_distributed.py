@@ -675,3 +675,28 @@ class TestCompiledStepRngThreading:
         np.testing.assert_allclose(a, b, rtol=1e-6)
         c = self._losses(8)
         assert not np.allclose(a, c), "seed must steer the masks"
+
+
+class TestDropoutRngImpl:
+    def test_rbg_masks_valid_and_deterministic(self):
+        """FLAGS_dropout_rng_impl=rbg routes mask generation through the
+        hardware RNG: right keep statistics, deterministic per seed,
+        different stream from threefry (opt-in for that reason)."""
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu.core import flags as fl
+
+        x = paddle.to_tensor(np.ones((64, 256), np.float32))
+
+        def masks(impl, seed):
+            fl.set_flags({"FLAGS_dropout_rng_impl": impl})
+            try:
+                paddle.seed(seed)
+                return np.asarray(F.dropout(x, p=0.5).numpy())
+            finally:
+                fl.set_flags({"FLAGS_dropout_rng_impl": "threefry"})
+
+        a = masks("rbg", 5)
+        keep = (a != 0).mean()
+        assert 0.42 < keep < 0.58, keep
+        np.testing.assert_array_equal(a, masks("rbg", 5))
+        assert not np.array_equal(a, masks("threefry", 5))

@@ -60,6 +60,12 @@ if grep -q '"flash_d64_compiles": true' "$LOG/flash64.out" 2>/dev/null; then
       --out tools/step_ablation_ernie_flash64.json
 fi
 
+# 4b2. dropout masks via the TPU hardware RNG (now that compiled steps
+#      draw REAL per-step masks, the RNG tax is live — attribute it)
+run ablate_ernie_rbg 1200 env FLAGS_dropout_rng_impl=rbg \
+    python tools/step_ablation.py --config ernie \
+    --out tools/step_ablation_ernie_rbg.json
+
 # 4c. fused lm_head+CE kernel (measure child only — must not touch
 #     BENCH_LAST_GOOD; parity is test-pinned, this is the timing)
 run bench_fused_ce 1500 env FLAGS_fused_lm_head_ce=1 \
