@@ -12,6 +12,8 @@ from __future__ import annotations
 
 import ctypes
 import os
+import threading
+import time
 
 from ..core import native
 
@@ -22,6 +24,12 @@ class TCPStore:
     def __init__(self, host="127.0.0.1", port=0, is_master=False,
                  timeout_s=300):
         self._lib = native.get_lib()
+        # The wire protocol is strict request/response over ONE socket:
+        # concurrent callers (e.g. elastic heartbeat threads sharing a
+        # store with the watcher) interleave frames mid-request and the
+        # peer thread blocks forever in recv on a response that never
+        # comes. Serialize every op on this fd.
+        self._mu = threading.Lock()
         self._server = None
         self.timeout_ms = int(timeout_s * 1000)
         if is_master:
@@ -45,28 +53,43 @@ class TCPStore:
     def set(self, key, value):
         if isinstance(value, str):
             value = value.encode()
-        rc = self._lib.pt_store_set(self._fd, key.encode(), value, len(value))
+        with self._mu:
+            rc = self._lib.pt_store_set(self._fd, key.encode(), value,
+                                        len(value))
         if rc != 0:
             raise RuntimeError("TCPStore.set(%r) failed" % key)
+
+    # waiting in get() is a short-poll loop, not one long server-side
+    # wait: the fd lock must not be held for the full timeout or threads
+    # sharing this store (elastic heartbeats during a barrier) starve
+    # past their TTL
+    _POLL_MS = 50
 
     def get(self, key, timeout_s=None):
         """Blocking get: waits until the key exists or timeout (then None)."""
         to = self.timeout_ms if timeout_s is None else int(timeout_s * 1000)
+        deadline = time.monotonic() + to / 1000.0
         cap = 1 << 16
-        while True:
+        first = True
+        while first or time.monotonic() < deadline:
+            first = False
+            left = max(int((deadline - time.monotonic()) * 1000), 0)
             buf = ctypes.create_string_buffer(cap)
-            n = self._lib.pt_store_get(self._fd, key.encode(), buf, cap, to)
+            with self._mu:
+                n = self._lib.pt_store_get(self._fd, key.encode(), buf, cap,
+                                           min(self._POLL_MS, left))
             if n == -2:
                 cap *= 16
                 continue
-            if n < 0:
-                return None
-            return buf.raw[:n]
+            if n >= 0:
+                return buf.raw[:n]
+        return None
 
     def add(self, key, delta=1):
         out = ctypes.c_int64()
-        rc = self._lib.pt_store_add(self._fd, key.encode(), int(delta),
-                                    ctypes.byref(out))
+        with self._mu:
+            rc = self._lib.pt_store_add(self._fd, key.encode(), int(delta),
+                                        ctypes.byref(out))
         if rc != 0:
             raise RuntimeError("TCPStore.add(%r) failed" % key)
         return int(out.value)
@@ -75,8 +98,9 @@ class TCPStore:
         """Non-creating counter read: value, or `default` if the counter
         was never created (distinguishes 'never registered' from 0)."""
         out = ctypes.c_int64()
-        rc = self._lib.pt_store_counter_get(self._fd, key.encode(),
-                                            ctypes.byref(out))
+        with self._mu:
+            rc = self._lib.pt_store_counter_get(self._fd, key.encode(),
+                                                ctypes.byref(out))
         if rc == -2:
             return default
         if rc != 0:
@@ -84,7 +108,8 @@ class TCPStore:
         return int(out.value)
 
     def delete(self, key):
-        self._lib.pt_store_delete(self._fd, key.encode())
+        with self._mu:
+            self._lib.pt_store_delete(self._fd, key.encode())
 
     def barrier(self, name, world_size, timeout_s=None):
         """All ranks arrive; releases when world_size ranks have added."""
@@ -97,9 +122,10 @@ class TCPStore:
                                % (name, n, world_size))
 
     def close(self):
-        if self._fd is not None and self._fd >= 0:
-            self._lib.pt_store_close(self._fd)
-            self._fd = -1
+        with self._mu:
+            if self._fd is not None and self._fd >= 0:
+                self._lib.pt_store_close(self._fd)
+                self._fd = -1
         if self._server is not None:
             self._lib.pt_store_server_stop(self._server)
             self._server = None

@@ -27,6 +27,11 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax renamed TPUCompilerParams -> CompilerParams across 0.4/0.5; accept
+# either so the kernels run on both
+CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or getattr(pltpu, "TPUCompilerParams")
+
 # measured on v5e (8x1024x6x128 causal): 512/512 is ~31% faster than
 # 128/128 — bigger tiles amortize the softmax-rescale epilogue between
 # MXU dots. min()-clamped to the sequence length at call time.
@@ -175,7 +180,7 @@ def _flash_fwd_bhnd(q, k, v, scale, causal, block_q, block_k, interpret,
             pltpu.VMEM((block_q, _STAT_LANES), jnp.float32),
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(*args)
@@ -331,7 +336,7 @@ def _flash_bwd_bhnd(q, k, v, out, lse, g, scale, causal, block_q, block_k,
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((bh, n, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(*dq_args)
@@ -377,7 +382,7 @@ def _flash_bwd_bhnd(q, k, v, out, lse, g, scale, causal, block_q, block_k,
             pltpu.VMEM((block_k, d), jnp.float32),
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(*dkv_args)

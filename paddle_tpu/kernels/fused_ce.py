@@ -260,3 +260,31 @@ def _fused_ce_bwd(ignore_index, block_t, block_v, interpret, res, g):
 
 
 fused_lm_head_ce.defvjp(_fused_ce_fwd, _fused_ce_bwd)
+
+
+def fused_ce_applies(hv, use_parallel):
+    """Engagement gate shared by the model wirings (llama lm_head,
+    ernie mlm_head): FLAGS_fused_lm_head_ce on, single-device layout,
+    token count tiles DEFAULT_BLOCK_T, and a TRACED (compiled-step)
+    value — the custom_vjp carries grads through jax.grad but the
+    eager tape cannot see through it."""
+    from ..core import flags as _flg
+
+    if (use_parallel
+            or not _flg.get_flags("FLAGS_fused_lm_head_ce")
+            ["FLAGS_fused_lm_head_ce"]):
+        return False
+    B, S, H = hv.shape
+    return (B * S) % DEFAULT_BLOCK_T == 0 \
+        and isinstance(hv, jax.core.Tracer)
+
+
+def fused_mean_ce(h2d, w, labels_flat):
+    """Mean CE over non-ignored tokens via the streaming kernel — the
+    loss tail every model wiring shares (any head bias must already be
+    folded into ``w`` by the caller)."""
+    per_tok = fused_lm_head_ce(h2d, w, labels_flat.astype(jnp.int32),
+                               DEFAULT_IGNORE_INDEX, DEFAULT_BLOCK_T)
+    valid = (labels_flat
+             != DEFAULT_IGNORE_INDEX).astype(per_tok.dtype)
+    return per_tok.sum() / valid.sum().clip(min=1.0)

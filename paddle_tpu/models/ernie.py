@@ -172,16 +172,62 @@ class ErnieForPretraining(Layer):
             self.mlm_head = Linear(c.hidden_size, c.vocab_size)
         self.sop_head = Linear(c.hidden_size, 2)
 
+    def _maybe_fused_mlm_ce(self, h_mlm, masked_labels):
+        """Mean MLM CE over valid tokens via the streaming lm_head+CE
+        kernel (kernels/fused_ce.py) — the [tokens, 40000] logits never
+        hit HBM in either direction. Same flag discipline as llama's
+        _maybe_fused_ce: FLAGS_fused_lm_head_ce on, single-device
+        layout, token count tiles, TRACED (compiled-step) path only.
+        Unlike llama's lm_head, mlm_head carries a bias: it is folded
+        exactly by augmenting h with a ones column and w with the bias
+        row — padded a full 128 lanes so the kernel's H axis stays
+        TPU-tile aligned. Returns None when the path does not apply."""
+        import jax.numpy as jnp
+
+        from ..core.tensor import Tensor
+        from ..kernels.fused_ce import fused_ce_applies, fused_mean_ce
+
+        hv = h_mlm._value if isinstance(h_mlm, Tensor) else h_mlm
+        if not fused_ce_applies(hv, self.config.use_parallel):
+            return None
+        B, S, H = hv.shape
+        T = B * S
+        lv = masked_labels._value if isinstance(masked_labels, Tensor) \
+            else jnp.asarray(masked_labels)
+        hf = hv.reshape(T, H)
+        w = self.mlm_head.weight._value
+        bias = self.mlm_head.bias._value
+        pad = 128
+        h_aug = jnp.concatenate(
+            [hf, jnp.zeros((T, pad), hf.dtype).at[:, 0].set(1.0)], axis=1)
+        w_aug = jnp.concatenate(
+            [w, jnp.zeros((pad, w.shape[1]), w.dtype)
+             .at[0].set(bias.astype(w.dtype))], axis=0)
+        return Tensor(fused_mean_ce(h_aug, w_aug, lv.reshape(T)))
+
+    def forward_head_loss(self, h, masked_labels):
+        """Fused MLM loss tail over final hidden states (mean CE over
+        non-ignored tokens — forward(masked_labels=...)'s contract for
+        the MLM term). Returns None so callers fall back to the
+        materialized mlm_head + cross_entropy path when the kernel does
+        not apply (VERDICT round-5 #2: same protocol as llama's
+        forward_head_loss)."""
+        return self._maybe_fused_mlm_ce(
+            self.mlm_ln(F.gelu(self.mlm_transform(h))), masked_labels)
+
     def forward(self, input_ids, token_type_ids=None, masked_labels=None,
                 sop_labels=None):
         h, pooled = self.ernie(input_ids, token_type_ids)
-        mlm = self.mlm_head(self.mlm_ln(F.gelu(self.mlm_transform(h))))
+        h_mlm = self.mlm_ln(F.gelu(self.mlm_transform(h)))
         sop = self.sop_head(pooled)
         if masked_labels is None:
-            return mlm, sop
-        loss = F.cross_entropy(
-            mlm.reshape([-1, self.config.vocab_size]),
-            masked_labels.reshape([-1]), ignore_index=-100)
+            return self.mlm_head(h_mlm), sop
+        loss = self._maybe_fused_mlm_ce(h_mlm, masked_labels)
+        if loss is None:
+            mlm = self.mlm_head(h_mlm)
+            loss = F.cross_entropy(
+                mlm.reshape([-1, self.config.vocab_size]),
+                masked_labels.reshape([-1]), ignore_index=-100)
         if sop_labels is not None:
             loss = loss + F.cross_entropy(sop, sop_labels)
         return loss

@@ -58,7 +58,12 @@ class GPTBlock(Layer):
         h = self.ln1(x)
         qkv = self.qkv(h).reshape([b, s, 3, self.heads, self.head_dim])
         q, k, v = ops.manipulation.unbind(qkv, axis=2)
-        if isinstance(cache, DecodeCache):
+        if cache is not None and hasattr(cache, "update_and_attend"):
+            # external-cache hook: the serving engine's paged-KV view
+            # writes K/V into its pool and runs ragged paged attention
+            # (serving/kv_cache.py)
+            attn, cache = cache.update_and_attend(q, k, v)
+        elif isinstance(cache, DecodeCache):
             cache, k, v = cache_update(cache, k, v, position_offset)
             attn = masked_decode_attention(
                 q, k, v, _decode_mask(position_offset, s, k.shape[1]))
@@ -117,7 +122,13 @@ class GPTModel(GenerationMixin, Layer):
         import paddle_tpu as P
 
         b, s = input_ids.shape
-        pos = P.arange(s, dtype="int64").unsqueeze(0) + position_offset
+        off = position_offset
+        offv = off._value if hasattr(off, "_value") else off
+        if getattr(offv, "ndim", 0):
+            # per-row offsets (serving continuous batching): [B] -> [B, 1]
+            # so the learned position lookup broadcasts to [B, S]
+            off = P.Tensor(jnp.asarray(offv)[:, None].astype(jnp.int64))
+        pos = P.arange(s, dtype="int64").unsqueeze(0) + off
         x = self.wte(input_ids) + self.wpe(pos)
         new_caches = []
         for i, blk in enumerate(self.blocks):
@@ -146,6 +157,13 @@ class GPTModel(GenerationMixin, Layer):
 
     def max_decode_len(self):
         return self.wpe.num_embeddings
+
+    def paged_cache_spec(self):
+        """KV geometry for the serving engine's paged cache."""
+        return {"num_layers": len(self.blocks),
+                "num_kv_heads": self.blocks[0].heads,
+                "head_dim": self.blocks[0].head_dim,
+                "dtype": str(self.wte.weight._value.dtype)}
 
     def init_decode_caches(self, batch, total_len):
         head_dim = self.blocks[0].head_dim

@@ -103,26 +103,46 @@ def rope_apply(q, k, theta, position_offset=0):
     3x faster fwd+bwd at the bench shape (8x1024x6x128) for identical
     positional geometry (the pairing of dims is a convention, not
     semantics; attention scores are invariant to which pairing is used
-    as long as q and k share it)."""
+    as long as q and k share it).
+
+    position_offset may be a scalar (one offset for the whole batch —
+    training/generate) or a [B] int vector (per-row offsets — the
+    serving engine's continuous-batching decode, where every slot sits
+    at its own sequence position)."""
     q = jnp.asarray(q)
     k = jnp.asarray(k)
     d = q.shape[-1]
     seq = q.shape[1]
     inv_freq = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
-    pos = jnp.arange(seq, dtype=jnp.float32) + position_offset
+    off = (position_offset if isinstance(position_offset, (int, float))
+           else jnp.asarray(position_offset))
+    if getattr(off, "ndim", 0):
+        # per-row offsets: pos [B, S] -> freqs [B, S, D/2], cos/sin
+        # [B, S, 1, D] (elementwise identical to the scalar path per row)
+        pos = (off.astype(jnp.float32)[:, None]
+               + jnp.arange(seq, dtype=jnp.float32)[None, :])
+        freqs = pos[..., None] * inv_freq
+        cos = jnp.concatenate([jnp.cos(freqs), jnp.cos(freqs)],
+                              axis=-1)[:, :, None, :]
+        sin = jnp.concatenate([jnp.sin(freqs), jnp.sin(freqs)],
+                              axis=-1)[:, :, None, :]
+        return _rope_rot(q, cos, sin), _rope_rot(k, cos, sin)
+    pos = jnp.arange(seq, dtype=jnp.float32) + off
     freqs = jnp.outer(pos, inv_freq)  # [S, D/2]
     cos = jnp.concatenate([jnp.cos(freqs), jnp.cos(freqs)],
                           axis=-1)[None, :, None, :]
     sin = jnp.concatenate([jnp.sin(freqs), jnp.sin(freqs)],
                           axis=-1)[None, :, None, :]
 
-    def rot(x):
-        xf = x.astype(jnp.float32)
-        x1, x2 = xf[..., :d // 2], xf[..., d // 2:]
-        rotated = jnp.concatenate([-x2, x1], axis=-1)
-        return (xf * cos + rotated * sin).astype(x.dtype)
+    return _rope_rot(q, cos, sin), _rope_rot(k, cos, sin)
 
-    return rot(q), rot(k)
+
+def _rope_rot(x, cos, sin):
+    d = x.shape[-1]
+    xf = x.astype(jnp.float32)
+    x1, x2 = xf[..., :d // 2], xf[..., d // 2:]
+    rotated = jnp.concatenate([-x2, x1], axis=-1)
+    return (xf * cos + rotated * sin).astype(x.dtype)
 
 
 class LlamaAttention(Layer):
@@ -191,6 +211,15 @@ class LlamaAttention(Layer):
                 [b, s, self.num_kv_heads, self.head_dim])
         q, k = rope_apply(q, k, theta=self.rope_theta,
                           position_offset=position_offset)
+        if cache is not None and hasattr(cache, "update_and_attend"):
+            # external-cache hook (serving): the ENGINE owns a paged KV
+            # cache; the per-layer view writes this step's K/V into its
+            # pool pages and runs ragged paged attention (GQA repeat
+            # happens inside the view/kernel — the pool never stores
+            # repeated heads). serving/kv_cache.py.
+            ctx, cache = cache.update_and_attend(q, k, v)
+            out = ctx.reshape([b, s, self.num_heads * self.head_dim])
+            return self.o_proj(out), cache
         mask = None
         if isinstance(cache, DecodeCache):
             # static-buffer decode path (generation.py): ONE compiled
@@ -380,31 +409,18 @@ class LlamaForCausalLM(GenerationMixin, Layer):
         — the custom_vjp carries grads through jax.grad but the eager
         tape cannot see through it. h must already be final-normed.
         Returns None when the fused path does not apply."""
-        from ..core import flags as _flg
         from ..core.tensor import Tensor
-        from ..kernels.fused_ce import (
-            DEFAULT_BLOCK_T,
-            DEFAULT_IGNORE_INDEX,
-            fused_lm_head_ce,
-        )
+        from ..kernels.fused_ce import fused_ce_applies, fused_mean_ce
 
-        if (self.config.use_parallel
-                or not _flg.get_flags("FLAGS_fused_lm_head_ce")
-                ["FLAGS_fused_lm_head_ce"]):
-            return None
         hv = h._value if isinstance(h, Tensor) else h
-        B, S, H = hv.shape
-        T = B * S
-        if T % DEFAULT_BLOCK_T or not isinstance(hv, jax.core.Tracer):
+        if not fused_ce_applies(hv, self.config.use_parallel):
             return None
+        B, S, H = hv.shape
         lv = labels._value if isinstance(labels, Tensor) \
             else jnp.asarray(labels)
-        per_tok = fused_lm_head_ce(
-            hv.reshape(T, H), self.lm_head.weight._value,
-            lv.reshape(T), DEFAULT_IGNORE_INDEX, DEFAULT_BLOCK_T)
-        valid = (lv.reshape(T)
-                 != DEFAULT_IGNORE_INDEX).astype(per_tok.dtype)
-        return Tensor(per_tok.sum() / valid.sum().clip(min=1.0))
+        return Tensor(fused_mean_ce(hv.reshape(B * S, H),
+                                    self.lm_head.weight._value,
+                                    lv.reshape(B * S)))
 
     def forward(self, input_ids, labels=None):
         h = self.llama(input_ids)
@@ -443,6 +459,15 @@ class LlamaForCausalLM(GenerationMixin, Layer):
 
     def max_decode_len(self):
         return self.config.max_position_embeddings
+
+    def paged_cache_spec(self):
+        """KV geometry for the serving engine's paged cache (the engine
+        owns the cache — serving/engine.py)."""
+        cfg = self.config
+        return {"num_layers": cfg.num_hidden_layers,
+                "num_kv_heads": cfg.num_key_value_heads,
+                "head_dim": cfg.hidden_size // cfg.num_attention_heads,
+                "dtype": cfg.dtype}
 
     def init_decode_caches(self, batch, total_len):
         cfg = self.config

@@ -1,0 +1,31 @@
+"""Continuous-batching LLM serving engine (paged KV cache).
+
+The training side of this framework compiles ONE XLA program per
+(model, config) and streams batches through it; this package gives
+inference the same shape discipline under serving traffic:
+
+- ``kv_cache``: a block-paged KV cache — a fixed pool of
+  ``[num_blocks, block_size, kv_heads, head_dim]`` pages per layer,
+  per-request block tables, and a host-side allocator with an explicit
+  out-of-blocks signal (the vLLM/Ragged-Paged-Attention memory model,
+  PAPERS.md arxiv 2604.15464).
+- ``kernels.paged_attention``: a Pallas ragged paged-attention decode
+  kernel (one query token per slot, K/V gathered through the block
+  table) with a jnp fallback that is exact against
+  ``masked_decode_attention``.
+- ``scheduler`` / ``engine``: request lifecycle (queued → prefill →
+  decoding → finished/preempted), FCFS admission control, slot reuse on
+  EOS, preemption-with-requeue on pool exhaustion — all driven by ONE
+  jitted decode step over a fixed ``max_slots`` batch, so XLA compiles
+  the decode exactly once per (model, engine config).
+- ``metrics``: per-request TTFT/TPOT/queue-time and engine-level
+  throughput/occupancy counters as plain dicts, plus chrome-trace spans
+  through the csrc/trace.cc host recorder.
+
+Reference analog: the AnalysisPredictor serving stack
+(/root/reference/paddle/fluid/inference/api/analysis_predictor.cc) —
+rebuilt TPU-first around paged blocks + a shape-stable compiled step.
+"""
+from .engine import Engine  # noqa: F401
+from .kv_cache import BlockAllocator, PagedKVCache  # noqa: F401
+from .scheduler import Request, RequestState, Scheduler  # noqa: F401

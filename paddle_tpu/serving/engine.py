@@ -1,0 +1,261 @@
+"""Continuous-batching serving engine: ONE compiled decode step.
+
+Shape discipline (the whole point, and what the reference
+AnalysisPredictor stack cannot do): the decode step is a single jitted
+function over a FIXED ``max_slots`` batch —
+
+    decode(state, pools, tokens[S], block_tables[S, MB], seq_lens[S])
+        -> (next_tokens[S], pools)
+
+Requests arriving, finishing, and getting preempted never change a
+shape, so XLA compiles the decode EXACTLY ONCE per (model, engine
+config); ``Engine.stats()["decode_compiles"]`` is asserted in-test.
+Prefill is jitted per power-of-two length bucket (right-padded; pad
+rows are causally invisible to real rows and their K/V lands in the
+trash page), so a serving lifetime compiles O(log max_len) prefills.
+
+The engine OWNS the cache: models expose a per-layer external-cache
+attention hook (a cache object with ``update_and_attend``,
+serving/kv_cache.py views) and a ``paged_cache_spec()`` describing
+their KV geometry — the model never allocates or stores KV state.
+
+Greedy decoding (argmax, matching GenerationMixin.generate's
+``do_sample=False`` semantics token-for-token) — the parity contract
+tests/test_serving.py pins. Driving loop is host-side: one device
+round-trip per decode step for the sampled tokens, which is what the
+lifecycle (EOS, admission, preemption) needs to see anyway.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .kv_cache import PagedDecodeView, PagedKVCache, PagedPrefillView
+from .metrics import EngineMetrics, now, span
+from .scheduler import Request, RequestState, Scheduler
+
+
+class Engine:
+    def __init__(self, model, max_slots=4, num_blocks=64, block_size=16,
+                 max_model_len=None):
+        self.model = model
+        spec = model.paged_cache_spec()
+        limit = model.max_decode_len()
+        if max_model_len is None:
+            max_model_len = limit
+        if max_model_len is None:
+            raise ValueError("max_model_len required for an unbounded "
+                             "model")
+        if limit is not None:
+            max_model_len = min(max_model_len, limit)
+        self.max_slots = max_slots
+        self.block_size = block_size
+        self.max_model_len = max_model_len
+        mb = -(-max_model_len // block_size)
+        self.cache = PagedKVCache(
+            num_layers=spec["num_layers"], num_blocks=num_blocks,
+            block_size=block_size, num_kv_heads=spec["num_kv_heads"],
+            head_dim=spec["head_dim"], max_slots=max_slots,
+            max_blocks_per_slot=mb, dtype=spec.get("dtype", "float32"))
+        self.scheduler = Scheduler(max_slots, self.cache)
+        self.metrics = EngineMetrics(max_slots)
+        self.requests = {}
+        self._names, values = model.functional_state()
+        self._state_vals = list(values)
+        # slot_tokens[s]: last generated token, not yet written to KV —
+        # the next decode step's input for that slot
+        self._slot_tokens = np.zeros((max_slots,), np.int32)
+        self._decode = jax.jit(self._decode_fn)
+        self._prefill = jax.jit(self._prefill_fn)
+
+    # -- public API -------------------------------------------------------
+
+    def add_request(self, prompt, max_new_tokens=32, eos_token_id=None):
+        """Queue a request; returns its id. Validates that the request
+        can EVER run alone (admission control proper is per-step)."""
+        prompt = list(map(int, prompt))
+        if not prompt:
+            raise ValueError("empty prompt")
+        total = len(prompt) + max_new_tokens
+        if total > self.max_model_len:
+            raise ValueError(
+                "prompt (%d) + max_new_tokens (%d) exceeds max_model_len"
+                " (%d)" % (len(prompt), max_new_tokens,
+                           self.max_model_len))
+        if (self.cache.pages_needed(total)
+                > self.cache.allocator.usable_blocks):
+            raise ValueError(
+                "request needs %d pages but the pool only has %d usable "
+                "blocks — it could never be scheduled"
+                % (self.cache.pages_needed(total),
+                   self.cache.allocator.usable_blocks))
+        req = Request(prompt, max_new_tokens, eos_token_id)
+        self.requests[req.id] = req
+        self.metrics.requests_in += 1
+        if max_new_tokens == 0:     # zero-length generation: trivially done
+            req.finish()
+            self.metrics.requests_finished += 1
+            return req.id
+        self.scheduler.add(req)
+        return req.id
+
+    def has_work(self):
+        return self.scheduler.has_work()
+
+    def step(self):
+        """One engine iteration: admit+prefill, grow pages (preempting
+        on exhaustion), one batched decode step. Returns has_work()."""
+        self._admit_and_prefill()
+        self._grow_or_preempt()
+        active = self.scheduler.active()
+        if active:
+            self._decode_once(active)
+        return self.has_work()
+
+    def run(self):
+        """Drain all queued work; returns {request_id: generated tokens}."""
+        while self.step():
+            pass
+        return {rid: list(r.generated) for rid, r in self.requests.items()}
+
+    def output(self, rid):
+        return list(self.requests[rid].generated)
+
+    def request_metrics(self, rid):
+        return self.requests[rid].metrics.to_dict()
+
+    def stats(self):
+        return self.metrics.to_dict()
+
+    # -- lifecycle --------------------------------------------------------
+
+    def _admit_and_prefill(self):
+        while True:
+            admitted = self.scheduler.admit_next()
+            if admitted is None:
+                return
+            slot, req = admitted
+            self._prefill_request(slot, req)
+
+    def _prefill_request(self, slot, req):
+        tokens = req.resume_tokens
+        L = len(tokens)
+        P = self._bucket(L)
+        ids = np.zeros((1, P), np.int32)
+        ids[0, :L] = tokens
+        with span("serving.prefill"):
+            tok, new_pools = self._run_eval(
+                self._prefill, self._state_vals, self.cache.pools,
+                jnp.asarray(ids),
+                jnp.asarray(self.cache.block_tables[slot]),
+                jnp.asarray(L, jnp.int32))
+        self.cache.pools = new_pools
+        self.cache.seq_lens[slot] = L
+        self.metrics.prefill_runs += 1
+        req.state = RequestState.DECODING
+        if req.metrics.first_token_t is None:
+            req.metrics.first_token_t = now()
+        self._accept_token(req, int(tok))
+
+    def _grow_or_preempt(self):
+        """Every decoding slot writes one K/V row this step at position
+        seq_len — make sure its page exists, preempting the most recent
+        other request on exhaustion (recompute-requeue)."""
+        for slot, req in list(self.scheduler.active()):
+            if self.scheduler.slots[slot] is not req:
+                continue            # became a victim earlier in the loop
+            while not self.cache.ensure_capacity(
+                    slot, int(self.cache.seq_lens[slot]) + 1):
+                victim = self.scheduler.preempt_victim(slot)
+                if victim is None:
+                    raise RuntimeError(
+                        "KV pool exhausted by a single request — "
+                        "add_request validation should have caught this")
+                self.metrics.preemptions += 1
+
+    def _decode_once(self, active):
+        bt = jnp.asarray(self.cache.block_tables)
+        lens = jnp.asarray(self.cache.seq_lens)
+        toks = jnp.asarray(self._slot_tokens)
+        with span("serving.decode_step"):
+            next_toks, new_pools = self._run_eval(
+                self._decode, self._state_vals, self.cache.pools, toks,
+                bt, lens)
+        self.cache.pools = new_pools
+        out = np.asarray(next_toks)
+        self.metrics.on_decode_step(len(active))
+        for slot, req in active:
+            # the input token's K/V row landed at position seq_len
+            self.cache.seq_lens[slot] += 1
+            self._accept_token(req, int(out[slot]))
+
+    def _accept_token(self, req, tok):
+        req.generated.append(tok)
+        self._slot_tokens[req.slot] = tok
+        self.metrics.output_tokens += 1
+        done = (req.remaining <= 0
+                or (req.eos_token_id is not None
+                    and tok == req.eos_token_id))
+        if done:
+            self.scheduler.release(req)
+            req.finish()
+            self.metrics.requests_finished += 1
+
+    # -- compiled steps ---------------------------------------------------
+
+    def _bucket(self, n):
+        """Prefill length bucket: next power of two (>= 8), capped at
+        max_model_len rounded up to a multiple of 8 AND at the block
+        table's position capacity — a pad length past ``MB * bs`` would
+        make the prefill scatter's clamped gather write pad K/V over
+        the request's last real page."""
+        p = 8
+        while p < n:
+            p *= 2
+        cap = min(-(-self.max_model_len // 8) * 8,
+                  self.cache.max_blocks_per_slot * self.block_size)
+        return min(p, max(cap, n))
+
+    def _run_eval(self, fn, *args):
+        was_training = self.model.training
+        self.model.eval()
+        try:
+            return fn(*args)
+        finally:
+            if was_training:
+                self.model.train()
+
+    def _prefill_fn(self, state_vals, pools, ids, table_row, true_len):
+        from ..core.dispatch import no_grad
+        from ..core.tensor import Tensor
+
+        self.metrics.prefill_compiles += 1      # trace-time counter
+        with self.model.bind_state(self._names, list(state_vals)):
+            with no_grad():
+                views = [PagedPrefillView(p, table_row, self.block_size)
+                         for p in pools]
+                logits, views = self.model.generate_step(
+                    Tensor(ids), views, 0)
+        lv = logits._value if isinstance(logits, Tensor) else logits
+        last = lv[0, true_len - 1].astype(jnp.float32)
+        tok = jnp.argmax(last, axis=-1).astype(jnp.int32)
+        return tok, [v.pool for v in views]
+
+    def _decode_fn(self, state_vals, pools, tokens, block_tables,
+                   seq_lens):
+        from ..core.dispatch import no_grad
+        from ..core.tensor import Tensor
+
+        self.metrics.decode_compiles += 1       # trace-time counter
+        with self.model.bind_state(self._names, list(state_vals)):
+            with no_grad():
+                views = [PagedDecodeView(p, block_tables, seq_lens,
+                                         self.block_size)
+                         for p in pools]
+                logits, views = self.model.generate_step(
+                    Tensor(tokens[:, None]), views, seq_lens)
+        lv = logits._value if isinstance(logits, Tensor) else logits
+        nxt = jnp.argmax(lv[:, -1, :].astype(jnp.float32),
+                         axis=-1).astype(jnp.int32)
+        return nxt, [v.pool for v in views]

@@ -1,0 +1,5 @@
+from .paged_attention import (  # noqa: F401
+    paged_attention,
+    paged_attention_kernel,
+    paged_attention_reference,
+)

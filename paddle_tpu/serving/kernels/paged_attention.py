@@ -1,0 +1,189 @@
+"""Ragged paged-attention decode kernel (Pallas, TPU).
+
+Serving decode is one query token per slot attending over that slot's
+whole history, which lives scattered across fixed-size pool pages
+(serving/kv_cache.py). The dense alternative — gather every slot's
+pages into a contiguous [slots, max_len, heads, head_dim] context —
+moves the entire KV history through HBM every step; at serving batch
+sizes that gather IS the decode step. This kernel instead walks the
+block table: grid (slot, page), the page id for (slot, j) read from the
+scalar-prefetched block table by the BlockSpec index map, so each K/V
+page is DMA'd from the pool exactly once and the running online-softmax
+statistics stay in VMEM (same recurrence as kernels/flash_attention.py).
+
+Layout contract (shared with serving/kv_cache.py):
+  q            [S, H, D]        one query token per slot
+  k/v pools    [NB, bs, Hkv, D] page pools (page 0 is the trash page)
+  block_tables [S, MB] int32    page ids per slot, trash-padded
+  seq_lens     [S]     int32    valid history length per slot (0 = idle)
+
+GQA (H > Hkv) is folded inside the kernel: q reshapes to
+[Hkv, H/Hkv, D] and both dots batch over the kv-head axis, so the pool
+never stores repeated heads.
+
+Status: exact in interpret mode against masked_decode_attention
+(tests/test_serving.py::TestPagedAttentionKernel); on-chip Mosaic
+compile + timing pending a tunnel window (tools/tunnel_battery.sh
+serving row). The jnp fallback below is the CPU/engine default and is
+bit-compatible with the dense decode path generation.py uses.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ...kernels.flash_attention import CompilerParams
+
+NEG_INF = -1e30
+_STAT_LANES = 128
+
+
+def _pa_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+               m_scr, l_scr, acc_scr, *, block_size, rep, scale):
+    """One (slot, page) program. q [1, H, D]; k/v [1, bs, Hkv, D]
+    (the page the index map picked via the block table); scratch
+    m/l [H, 128], acc [H, D] — persisted across the page axis."""
+    s_i = pl.program_id(0)
+    j = pl.program_id(1)
+    num_j = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    length = len_ref[s_i]
+
+    # pages at or past the slot's length hold no valid tokens: skip the
+    # DMA'd block entirely (ragged early-out; idle slots skip all pages)
+    @pl.when(j * block_size < length)
+    def _compute():
+        q = q_ref[0]                                  # [H, D]
+        k = k_ref[0]                                  # [bs, Hkv, D]
+        v = v_ref[0]
+        h, d = q.shape
+        hkv = k.shape[1]
+        qg = q.reshape(hkv, rep, d).astype(jnp.float32)
+        kg = jnp.swapaxes(k, 0, 1).astype(jnp.float32)     # [Hkv, bs, D]
+        s_blk = jax.lax.dot_general(
+            qg, kg, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32) * scale    # [Hkv, rep, bs]
+        s_blk = s_blk.reshape(h, block_size)
+        pos = j * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, (h, block_size), 1)
+        s_blk = jnp.where(pos < length, s_blk, NEG_INF)
+        m_prev = m_scr[...][:, :1]
+        l_prev = l_scr[...][:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s_blk, axis=1, keepdims=True))
+        p = jnp.exp(s_blk - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        vg = jnp.swapaxes(v, 0, 1).astype(jnp.float32)     # [Hkv, bs, D]
+        upd = jax.lax.dot_general(
+            p.reshape(hkv, rep, block_size), vg,
+            (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)            # [Hkv, rep, D]
+        acc_scr[...] = alpha * acc_scr[...] + upd.reshape(h, d)
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(j == num_j - 1)
+    def _emit():
+        l = l_scr[...][:, :1]
+        o_ref[0] = (acc_scr[...] / jnp.maximum(l, 1e-30)).astype(
+            o_ref.dtype)
+
+
+def paged_attention_kernel(q, k_pool, v_pool, block_tables, seq_lens,
+                           scale=None, interpret=None):
+    """Pallas path. q [S, H, D] -> [S, H, D]; idle slots (len 0) emit 0."""
+    s, h, d = q.shape
+    nb, block_size, hkv, _ = k_pool.shape
+    mb = block_tables.shape[1]
+    if h % hkv:
+        raise ValueError("paged_attention: %d heads not a multiple of "
+                         "%d kv heads" % (h, hkv))
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(s, mb),
+        in_specs=[
+            pl.BlockSpec((1, h, d), lambda si, j, bt, ln: (si, 0, 0)),
+            pl.BlockSpec((1, block_size, hkv, d),
+                         lambda si, j, bt, ln: (bt[si, j], 0, 0, 0)),
+            pl.BlockSpec((1, block_size, hkv, d),
+                         lambda si, j, bt, ln: (bt[si, j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, h, d), lambda si, j, bt, ln: (si, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((h, _STAT_LANES), jnp.float32),
+            pltpu.VMEM((h, _STAT_LANES), jnp.float32),
+            pltpu.VMEM((h, d), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_pa_kernel, block_size=block_size,
+                          rep=h // hkv, scale=scale),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((s, h, d), q.dtype),
+        compiler_params=CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(jnp.asarray(block_tables, jnp.int32),
+      jnp.asarray(seq_lens, jnp.int32), q, k_pool, v_pool)
+
+
+def paged_attention_reference(q, k_pool, v_pool, block_tables, seq_lens,
+                              scale=None):
+    """jnp fallback: gather pages into a dense context, then the same
+    fp32-statistics attention as nn.functional's _sdpa_reference — kept
+    operation-for-operation compatible with the dense decode path so the
+    serving engine's greedy tokens match GenerationMixin.generate."""
+    s, h, d = q.shape
+    nb, block_size, hkv, _ = k_pool.shape
+    mb = block_tables.shape[1]
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    bt = jnp.asarray(block_tables, jnp.int32)
+    lens = jnp.asarray(seq_lens, jnp.int32)
+    k = k_pool[bt].reshape(s, mb * block_size, hkv, d)
+    v = v_pool[bt].reshape(s, mb * block_size, hkv, d)
+    if h != hkv:
+        rep = h // hkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    logits = jnp.einsum("shd,smhd->shm", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    valid = (jnp.arange(mb * block_size)[None, None, :]
+             < lens[:, None, None])
+    logits = jnp.where(valid, logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    # idle slots (len 0) have an all-masked row -> uniform softmax over
+    # trash; their output is ignored host-side but must stay finite
+    out = jnp.einsum("shm,smhd->shd", probs.astype(v.dtype), v)
+    return out
+
+
+def paged_attention(q, k_pool, v_pool, block_tables, seq_lens,
+                    scale=None, interpret=None):
+    """Dispatch: the Pallas kernel on TPU when the page geometry is
+    Mosaic-tileable, the jnp gather fallback otherwise (CPU engine path,
+    and the form the parity test pins against masked_decode_attention)."""
+    s, h, d = q.shape
+    block_size = k_pool.shape[1]
+    tileable = (d % 128 == 0 and block_size % 8 == 0 and h % 8 == 0)
+    if jax.default_backend() == "tpu" and tileable:
+        return paged_attention_kernel(q, k_pool, v_pool, block_tables,
+                                      seq_lens, scale=scale,
+                                      interpret=interpret)
+    return paged_attention_reference(q, k_pool, v_pool, block_tables,
+                                     seq_lens, scale=scale)

@@ -1,0 +1,204 @@
+"""Block-paged KV cache: fixed page pools + per-request block tables.
+
+Memory model (Ragged Paged Attention / vLLM, PAPERS.md arxiv
+2604.15464): each layer owns a fixed pool of
+``[num_blocks, block_size, kv_heads, head_dim]`` pages; a request holds
+an ordered list of page ids (its block table row) covering positions
+``0..seq_len-1`` via ``page = table[pos // block_size]``,
+``offset = pos % block_size``. Pages are allocated on demand and
+returned to the free list when the request finishes or is preempted —
+KV memory scales with TOKENS IN FLIGHT, not with
+``max_slots * max_model_len`` the way generation.py's dense
+``DecodeCache`` does.
+
+Page 0 is reserved as the TRASH page: block-table rows are 0-padded, so
+writes for pad positions (right-padded prefill, idle decode slots) land
+in trash instead of corrupting live pages, and every write stays a
+single unconditional scatter — no masking inside the compiled step.
+
+The ``PagedPrefillView`` / ``PagedDecodeView`` classes are the
+per-layer external-cache attention hook: model attention layers that
+see a cache object with ``update_and_attend`` hand it (q, k, v) and get
+the attention context back (models/llama.py, models/gpt.py). The
+ENGINE owns the pools, tables and lengths; the model never holds cache
+state. Views are created inside the jitted step from traced pool
+arrays and return updated views — functional, like DecodeCache.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+TRASH_BLOCK = 0
+
+
+class KVBlockPool(NamedTuple):
+    """One layer's page pools: k/v [num_blocks, block_size, Hkv, D]."""
+
+    k: "object"
+    v: "object"
+
+
+class BlockAllocator:
+    """Host-side free-list over page ids 1..num_blocks-1 (0 is trash).
+
+    ``alloc`` returns None — the explicit out-of-blocks signal — instead
+    of raising: the scheduler turns it into preempt-and-requeue."""
+
+    def __init__(self, num_blocks):
+        if num_blocks < 2:
+            raise ValueError("need >= 2 blocks (page 0 is the trash page)")
+        self.num_blocks = num_blocks
+        # LIFO keeps recently-freed (cache-warm) pages in circulation
+        self._free = list(range(num_blocks - 1, 0, -1))
+
+    @property
+    def free_blocks(self):
+        return len(self._free)
+
+    @property
+    def usable_blocks(self):
+        return self.num_blocks - 1
+
+    def alloc(self, n=1):
+        """n page ids, or None when fewer than n pages are free."""
+        if n > len(self._free):
+            return None
+        return [self._free.pop() for _ in range(n)]
+
+    def free(self, ids):
+        for i in ids:
+            if not 0 < i < self.num_blocks or i in self._free:
+                raise ValueError("bad free of page %r" % (i,))
+            self._free.append(i)
+
+
+class PagedKVCache:
+    """Pools for every layer + the host-side table/length bookkeeping."""
+
+    def __init__(self, num_layers, num_blocks, block_size, num_kv_heads,
+                 head_dim, max_slots, max_blocks_per_slot,
+                 dtype="float32"):
+        dt = jnp.dtype(dtype)
+        self.block_size = block_size
+        self.max_slots = max_slots
+        self.max_blocks_per_slot = max_blocks_per_slot
+        self.pools = [
+            KVBlockPool(
+                jnp.zeros((num_blocks, block_size, num_kv_heads,
+                           head_dim), dt),
+                jnp.zeros((num_blocks, block_size, num_kv_heads,
+                           head_dim), dt))
+            for _ in range(num_layers)]
+        self.allocator = BlockAllocator(num_blocks)
+        self.block_tables = np.zeros((max_slots, max_blocks_per_slot),
+                                     np.int32)
+        self.seq_lens = np.zeros((max_slots,), np.int32)
+        self._slot_pages = [[] for _ in range(max_slots)]
+
+    def pages_needed(self, num_tokens):
+        return -(-num_tokens // self.block_size)  # ceil
+
+    def slot_page_count(self, slot):
+        return len(self._slot_pages[slot])
+
+    def ensure_capacity(self, slot, num_tokens):
+        """Allocate pages so positions 0..num_tokens-1 are covered.
+        Returns True, or False on pool exhaustion (nothing allocated —
+        all-or-nothing, so a failed admission leaves no partial state)."""
+        need = self.pages_needed(num_tokens) - len(self._slot_pages[slot])
+        if need <= 0:
+            return True
+        if num_tokens > self.max_blocks_per_slot * self.block_size:
+            raise ValueError(
+                "%d tokens exceed the per-slot capacity %d"
+                % (num_tokens, self.max_blocks_per_slot * self.block_size))
+        pages = self.allocator.alloc(need)
+        if pages is None:
+            return False
+        start = len(self._slot_pages[slot])
+        self._slot_pages[slot].extend(pages)
+        self.block_tables[slot, start:start + need] = pages
+        return True
+
+    def release_slot(self, slot):
+        """Free the slot's pages back to the pool (finish/preempt)."""
+        if self._slot_pages[slot]:
+            self.allocator.free(self._slot_pages[slot])
+        self._slot_pages[slot] = []
+        self.block_tables[slot, :] = TRASH_BLOCK
+        self.seq_lens[slot] = 0
+
+
+def _raw(x):
+    return x._value if hasattr(x, "_value") else jnp.asarray(x)
+
+
+class PagedPrefillView:
+    """One layer's hook for single-request prefill ([1, P] right-padded
+    prompt): writes every position's K/V through the (trash-padded)
+    block-table row in one scatter, then runs dense causal attention —
+    rows past the true length attend only forward of real tokens, so
+    real rows are exactly the unpadded computation."""
+
+    def __init__(self, pool, table_row, block_size):
+        self.pool = pool
+        self.table_row = table_row            # [MB] int32, trash-padded
+        self.block_size = block_size
+
+    def update_and_attend(self, q, k, v):
+        from ..nn import functional as F
+
+        qv, kv, vv = _raw(q), _raw(k), _raw(v)
+        p = kv.shape[1]
+        pos = jnp.arange(p)
+        pages = self.table_row[pos // self.block_size]
+        offs = pos % self.block_size
+        new_pool = KVBlockPool(
+            self.pool.k.at[pages, offs].set(kv[0].astype(self.pool.k.dtype)),
+            self.pool.v.at[pages, offs].set(vv[0].astype(self.pool.v.dtype)))
+        heads, kv_heads = qv.shape[2], kv.shape[2]
+        if heads != kv_heads:
+            rep = heads // kv_heads
+            kv = jnp.repeat(kv, rep, axis=2)
+            vv = jnp.repeat(vv, rep, axis=2)
+        out = F.scaled_dot_product_attention(qv, kv, vv, is_causal=True,
+                                             _warn_rect_causal=False)
+        return out, PagedPrefillView(new_pool, self.table_row,
+                                     self.block_size)
+
+
+class PagedDecodeView:
+    """One layer's hook for the batched decode step ([S, 1] tokens, one
+    per slot): scatters each slot's new K/V into page
+    ``table[slot, len // bs]`` at offset ``len % bs`` (idle slots write
+    trash), then attends over the paged history including the new token
+    (effective length ``len + 1``) via the ragged paged-attention
+    kernel/fallback."""
+
+    def __init__(self, pool, block_tables, seq_lens, block_size):
+        self.pool = pool
+        self.block_tables = block_tables      # [S, MB] int32
+        self.seq_lens = seq_lens              # [S] int32
+        self.block_size = block_size
+
+    def update_and_attend(self, q, k, v):
+        from ..core.tensor import Tensor
+        from .kernels.paged_attention import paged_attention
+
+        qv, kv, vv = _raw(q), _raw(k), _raw(v)
+        s = qv.shape[0]
+        lens = self.seq_lens
+        pages = self.block_tables[jnp.arange(s), lens // self.block_size]
+        offs = lens % self.block_size
+        new_pool = KVBlockPool(
+            self.pool.k.at[pages, offs].set(
+                kv[:, 0].astype(self.pool.k.dtype)),
+            self.pool.v.at[pages, offs].set(
+                vv[:, 0].astype(self.pool.v.dtype)))
+        out = paged_attention(qv[:, 0], new_pool.k, new_pool.v,
+                              self.block_tables, lens + 1)
+        return Tensor(out[:, None]), PagedDecodeView(
+            new_pool, self.block_tables, lens, self.block_size)

@@ -1,0 +1,141 @@
+"""Serving metrics: per-request latency breakdown + engine counters.
+
+Schema (all plain dicts, json-ready — tools/serving_benchmark.py dumps
+them verbatim):
+
+per-request (``RequestMetrics.to_dict()``):
+  queue_time_s     arrival -> first admission
+  ttft_s           arrival -> first token out of prefill
+  tpot_s           mean inter-token time after the first token
+  e2e_s            arrival -> finished
+  prompt_tokens / output_tokens / preemptions
+
+engine (``EngineMetrics.to_dict()``):
+  requests_in / requests_finished / preemptions
+  prefill_runs / decode_steps / output_tokens
+  decode_compiles / prefill_compiles   (jit trace counts — the
+      compile-once contract tests assert decode_compiles == 1)
+  throughput_tok_s                     output tokens / wall time
+  slot_occupancy                       mean active-slots / max_slots
+      over decode steps (the 占用 utilization counter)
+
+Chrome-trace spans: ``span("serving.decode_step")`` bridges into the
+native host recorder (csrc/trace.cc via profiler.RecordEvent, which
+also annotates the Xprof device timeline), so engine phases line up
+with kernel activity in the merged trace. Guarded: a build without the
+native lib degrades to a no-op, never breaks serving.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+
+
+def now():
+    return time.monotonic()
+
+
+@contextlib.contextmanager
+def span(name, level=1):
+    """Scoped chrome-trace span through csrc/trace.cc; no-op without
+    the native lib."""
+    ev = None
+    try:
+        from ..profiler import RecordEvent
+
+        ev = RecordEvent(name, level=level)
+        ev.begin()
+    except Exception:
+        ev = None
+    try:
+        yield
+    finally:
+        if ev is not None:
+            try:
+                ev.end()
+            except Exception:
+                pass
+
+
+def counter(name, value):
+    """Named counter sample on the native trace timeline (no-op
+    without the lib)."""
+    try:
+        from ..core import native
+
+        native.get_lib().pt_trace_counter(name.encode(), int(value))
+    except Exception:
+        pass
+
+
+class RequestMetrics:
+    def __init__(self, arrival_t):
+        self.arrival_t = arrival_t
+        self.first_admit_t = None
+        self.first_token_t = None
+        self.finish_t = None
+        self.prompt_tokens = 0
+        self.output_tokens = 0
+        self.preemptions = 0
+
+    def on_admit(self, t):
+        if self.first_admit_t is None:
+            self.first_admit_t = t
+
+    def to_dict(self):
+        ttft = (None if self.first_token_t is None
+                else self.first_token_t - self.arrival_t)
+        tpot = None
+        if (self.finish_t is not None and self.first_token_t is not None
+                and self.output_tokens > 1):
+            tpot = ((self.finish_t - self.first_token_t)
+                    / (self.output_tokens - 1))
+        return {
+            "queue_time_s": (None if self.first_admit_t is None
+                             else self.first_admit_t - self.arrival_t),
+            "ttft_s": ttft,
+            "tpot_s": tpot,
+            "e2e_s": (None if self.finish_t is None
+                      else self.finish_t - self.arrival_t),
+            "prompt_tokens": self.prompt_tokens,
+            "output_tokens": self.output_tokens,
+            "preemptions": self.preemptions,
+        }
+
+
+class EngineMetrics:
+    def __init__(self, max_slots):
+        self.max_slots = max_slots
+        self.start_t = now()
+        self.requests_in = 0
+        self.requests_finished = 0
+        self.preemptions = 0
+        self.prefill_runs = 0
+        self.decode_steps = 0
+        self.output_tokens = 0
+        self.decode_compiles = 0
+        self.prefill_compiles = 0
+        self._occupancy_sum = 0
+
+    def on_decode_step(self, active_slots):
+        self.decode_steps += 1
+        self._occupancy_sum += active_slots
+        counter("serving.active_slots", active_slots)
+
+    def to_dict(self):
+        wall = max(now() - self.start_t, 1e-9)
+        occ = (self._occupancy_sum / (self.decode_steps * self.max_slots)
+               if self.decode_steps else 0.0)
+        return {
+            "requests_in": self.requests_in,
+            "requests_finished": self.requests_finished,
+            "preemptions": self.preemptions,
+            "prefill_runs": self.prefill_runs,
+            "decode_steps": self.decode_steps,
+            "output_tokens": self.output_tokens,
+            "decode_compiles": self.decode_compiles,
+            "prefill_compiles": self.prefill_compiles,
+            "wall_s": wall,
+            "throughput_tok_s": self.output_tokens / wall,
+            "slot_occupancy": occ,
+        }

@@ -1,0 +1,141 @@
+"""Request lifecycle + FCFS continuous-batching scheduler.
+
+Lifecycle: QUEUED -> PREFILL -> DECODING -> FINISHED, with
+DECODING -> PREEMPTED when the page pool exhausts (the victim waits at
+the queue front in PREEMPTED state until re-admission re-prefills it).
+
+Policies (vLLM-style, kept deliberately simple and deterministic):
+
+- Admission is strict FCFS with no head-of-line bypass: the queue head
+  is admitted only when a slot is free AND the pool has pages for its
+  whole (resume) prompt; nothing behind it jumps ahead. Deterministic
+  order is what lets tests pin bit-identical outputs.
+- Preemption victim = the most recently admitted OTHER running request
+  (last-in, first-preempted). The victim's pages are freed, and it is
+  requeued at the FRONT of the queue by recompute: its resume prompt is
+  ``prompt + generated so far``, so greedy decoding continues
+  bit-identically after re-prefill.
+- A finished/preempted slot is immediately reusable (slot reuse on
+  EOS) — the next admission claims the lowest free slot index.
+"""
+from __future__ import annotations
+
+import itertools
+from collections import deque
+from enum import Enum
+
+from .metrics import RequestMetrics, now
+
+
+class RequestState(Enum):
+    QUEUED = "queued"
+    PREFILL = "prefill"
+    DECODING = "decoding"
+    PREEMPTED = "preempted"
+    FINISHED = "finished"
+
+
+class Request:
+    _ids = itertools.count()
+
+    def __init__(self, prompt, max_new_tokens, eos_token_id=None):
+        self.id = next(Request._ids)
+        self.prompt = [int(t) for t in prompt]
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_token_id = eos_token_id
+        self.state = RequestState.QUEUED
+        self.generated = []
+        self.slot = None
+        self.admit_seq = None      # monotone admission stamp (victim pick)
+        self.metrics = RequestMetrics(now())
+        self.metrics.prompt_tokens = len(self.prompt)
+
+    @property
+    def resume_tokens(self):
+        """Context to (re-)prefill: prompt plus everything generated —
+        recompute-on-resume keeps greedy output bit-identical."""
+        return self.prompt + self.generated
+
+    @property
+    def remaining(self):
+        return self.max_new_tokens - len(self.generated)
+
+    def finish(self):
+        self.state = RequestState.FINISHED
+        self.metrics.finish_t = now()
+        self.metrics.output_tokens = len(self.generated)
+
+
+class Scheduler:
+    def __init__(self, max_slots, cache):
+        self.max_slots = max_slots
+        self.cache = cache
+        self.queue = deque()
+        self.slots = [None] * max_slots    # slot -> Request or None
+        self._admit_counter = itertools.count()
+
+    # -- queue ------------------------------------------------------------
+
+    def add(self, req):
+        self.queue.append(req)
+
+    def requeue_front(self, req):
+        self.queue.appendleft(req)
+
+    def has_work(self):
+        return bool(self.queue) or any(
+            r is not None for r in self.slots)
+
+    def active(self):
+        """(slot, req) for slots currently decoding, slot order."""
+        return [(i, r) for i, r in enumerate(self.slots)
+                if r is not None and r.state is RequestState.DECODING]
+
+    # -- admission --------------------------------------------------------
+
+    def admit_next(self):
+        """Admit the queue head if a slot is free and the pool can hold
+        its whole resume prompt. Returns (slot, req) or None. Strict
+        FCFS: a blocked head blocks everything behind it."""
+        if not self.queue:
+            return None
+        free = [i for i, r in enumerate(self.slots) if r is None]
+        if not free:
+            return None
+        req = self.queue[0]
+        slot = free[0]
+        need = self.cache.pages_needed(len(req.resume_tokens))
+        if need > self.cache.allocator.free_blocks:
+            return None
+        self.queue.popleft()
+        if not self.cache.ensure_capacity(slot, len(req.resume_tokens)):
+            raise AssertionError("admission raced the allocator")
+        self.slots[slot] = req
+        req.slot = slot
+        req.state = RequestState.PREFILL
+        req.admit_seq = next(self._admit_counter)
+        req.metrics.on_admit(now())
+        return slot, req
+
+    # -- slot release / preemption ---------------------------------------
+
+    def release(self, req):
+        """Free the request's slot + pages (finish or preempt)."""
+        slot = req.slot
+        self.cache.release_slot(slot)
+        self.slots[slot] = None
+        req.slot = None
+
+    def preempt_victim(self, exclude_slot):
+        """Pick and preempt the most recently admitted running request
+        other than ``exclude_slot``; requeues it at the front. Returns
+        the victim or None when there is no other running request."""
+        candidates = [r for i, r in self.active() if i != exclude_slot]
+        if not candidates:
+            return None
+        victim = max(candidates, key=lambda r: r.admit_seq)
+        self.release(victim)
+        victim.state = RequestState.PREEMPTED
+        victim.metrics.preemptions += 1
+        self.requeue_front(victim)
+        return victim

@@ -41,6 +41,13 @@ class TestExamples:
         out = capsys.readouterr().out
         assert "full-graph accuracy" in out
 
+    def test_continuous_batching(self, capsys):
+        stats = _load("continuous_batching").main()
+        assert stats["requests_finished"] == 4
+        assert stats["decode_compiles"] == 1
+        out = capsys.readouterr().out
+        assert "decode compiles: 1" in out
+
     def test_quantized_serving(self):
         float_acc, int8_acc = _load("quantized_serving").main(
             train_steps=40, calib_batches=2)

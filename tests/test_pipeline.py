@@ -47,8 +47,15 @@ def _loss_fn(logits, labels):
                            labels.reshape([-1]))
 
 
+_GOLDEN_CACHE = {}
+
+
 def _golden_losses(n_steps=3):
-    """Reference loss sequence: plain compiled step on a 1-axis mesh."""
+    """Reference loss sequence: plain compiled step on a 1-axis mesh.
+    Deterministic (seeded, CPU), so cached — the batch-axis fork matrix
+    would otherwise recompile this baseline per parametrized case."""
+    if n_steps in _GOLDEN_CACHE:
+        return _GOLDEN_CACHE[n_steps]
     pmesh.build_hybrid_mesh(dp=8, mp=1)
     paddle.seed(0)
     model = LlamaForCausalLM(_cfg())
@@ -56,8 +63,10 @@ def _golden_losses(n_steps=3):
                                  parameters=model.parameters())
     step = CompiledTrainStep(model, _loss_fn, opt)
     ids, labels = _data()
-    return [float(step(paddle.to_tensor(ids), paddle.to_tensor(labels)))
-            for _ in range(n_steps)]
+    losses = [float(step(paddle.to_tensor(ids), paddle.to_tensor(labels)))
+              for _ in range(n_steps)]
+    _GOLDEN_CACHE[n_steps] = losses
+    return losses
 
 
 class TestRingPipelineUnit:
@@ -329,13 +338,17 @@ class TestPipelineGradClip:
 
     def test_by_norm_matches_pp1_golden(self):
         # a clip small enough that it BINDS (otherwise the test is
-        # vacuous: unclipped grads would match too)
+        # vacuous: unclipped grads would match too). AdamW's sqrt(v)
+        # normalization makes a uniformly-scaled grad invisible for the
+        # first steps — divergence builds from the step-to-step
+        # VARIATION of the clip coefficient, so the binding check needs
+        # the longer horizon (rel diff ~1e-4 by step 5, ~2e-6 at 3).
         clip_cls = paddle.nn.ClipGradByNorm
-        golden = self._golden_clipped(clip_cls(0.01))
-        loose = self._golden_clipped(clip_cls(1e6))
-        assert not np.allclose(golden, loose, rtol=1e-5), \
+        golden = self._golden_clipped(clip_cls(0.01), n_steps=5)
+        loose = self._golden_clipped(clip_cls(1e6), n_steps=5)
+        assert not np.allclose(golden, loose, rtol=2e-5), \
             "clip did not bind; test shapes need smaller clip_norm"
-        pipe = self._pipe_losses(clip_cls(0.01))
+        pipe = self._pipe_losses(clip_cls(0.01), n_steps=5)
         np.testing.assert_allclose(pipe, golden, rtol=5e-4)
 
     def test_global_norm_matches_pp1_golden(self):
@@ -405,6 +418,47 @@ class TestPipelineZero:
         assert ("reduce-scatter" in hlo
                 or plan_mod._allreduce_feeds_dynamic_slice(hlo))
         assert "collective-permute" in hlo
+
+
+class TestBatchAxisFork:
+    """VERDICT round-5 #4: parity-pin the batch-axis fork.
+
+    PipelinedTrainStep splits the global batch over ("dp", "sharding")
+    when zero_stage>=2 OR the mesh has no real dp axis, but over ("dp",)
+    alone at stage<2 with real dp (the involuntary-remat workaround).
+    Same seed + same global batch through every cell of
+    zero_stage∈{1,2} × {real dp axis, dp=1} must reproduce the UNFORKED
+    pp=1 golden loss sequence — the fork is program structure, not
+    different math; a dp-only branch that mis-normalized the grad
+    combine diverges from step 2 on."""
+
+    @pytest.mark.parametrize("mesh_kw,zero", [
+        ({"dp": 2, "sharding": 2}, 1),   # real dp, fork -> ("dp",)
+        ({"dp": 2, "sharding": 2}, 2),   # real dp, ("dp", "sharding")
+        ({"dp": 1, "sharding": 4}, 1),   # no dp axis -> sharding carries
+        ({"dp": 1, "sharding": 4}, 2),   # the batch in both stages
+    ])
+    def test_fork_cells_match_unforked_golden(self, mesh_kw, zero):
+        golden = _golden_losses()
+        pmesh.build_hybrid_mesh(mp=1, pp=2, **mesh_kw)
+        paddle.seed(0)
+        model = LlamaForCausalLM(_cfg())
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=model.parameters())
+        step = PipelinedTrainStep(model, _loss_fn, opt, n_micro=4,
+                                  zero_stage=zero)
+        expect_axes = (("dp",) if zero < 2 and mesh_kw["dp"] > 1
+                       else ("dp", "sharding"))
+        got_axes = tuple(a for a in ("dp", "sharding")
+                         if a in str(step.batch_spec))
+        assert got_axes == tuple(
+            a for a in expect_axes if mesh_kw.get(a, 1) > 1), \
+            (step.batch_spec, mesh_kw, zero)
+        ids, labels = _data()
+        losses = [float(step(paddle.to_tensor(ids),
+                             paddle.to_tensor(labels)))
+                  for _ in range(len(golden))]
+        np.testing.assert_allclose(losses, golden, rtol=5e-4)
 
 
 class TestPipelineFusedCETail:

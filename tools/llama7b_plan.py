@@ -144,18 +144,61 @@ def _allreduce_feeds_dynamic_slice(text):
                          r"([^)\n]*)\)", text):
         if consumes(m.group(2)):
             return True
+    # Newer XLA CPU pipelines wrap partitioned bodies in call/fusion
+    # ops (to_apply=/calls=%computation): a call consuming an
+    # all-reduce result whose called computation TRANSITIVELY contains
+    # a dynamic-slice is the same unfused reduce-scatter, one boundary
+    # down.
+    comps, cur, body = {}, None, []
+    for line in text.splitlines():
+        if cur is None:
+            ms = re.match(r"\s*(?:ENTRY\s+)?(%[\w.-]+)\s*\([^\n]*\{\s*$",
+                          line)
+            if ms:
+                cur, body = ms.group(1), []
+        elif line.strip() == "}":
+            comps[cur], cur = "\n".join(body), None
+        else:
+            body.append(line)
+    refs = {n: set(re.findall(r"(?:to_apply|calls)=(%[\w.-]+)", b))
+            for n, b in comps.items()}
+
+    def has_ds(n, seen):
+        if n in seen or n not in comps:
+            return False
+        seen.add(n)
+        return ("dynamic-slice(" in comps[n]
+                or any(has_ds(r, seen) for r in refs[n]))
+
+    for m in re.finditer(r"= [^\n=]*\b(?:call|fusion)\(([^)\n]*)\)"
+                         r"[^\n]*?(?:to_apply|calls)=(%[\w.-]+)", text):
+        if consumes(m.group(1)) and has_ds(m.group(2), set()):
+            return True
     return False
 
 
 def _mem_row(compiled):
     ma = compiled.memory_analysis()
-    return {
+    row = {
         "argument_bytes_per_device": int(ma.argument_size_in_bytes),
         "output_bytes_per_device": int(ma.output_size_in_bytes),
         "temp_bytes_per_device": int(ma.temp_size_in_bytes),
-        "peak_bytes_per_device": int(ma.peak_memory_in_bytes),
         "alias_bytes_per_device": int(ma.alias_size_in_bytes),
     }
+    peak = getattr(ma, "peak_memory_in_bytes", None)
+    if peak is None:
+        # jaxlib builds without the buffer-assignment peak stat: bound
+        # it by args + temps + outputs net of donation aliasing. This
+        # OVERestimates (liveness overlap is ignored), so hbm_fit stays
+        # conservative; flagged so readers don't mistake it for the
+        # scheduler's real high-water mark.
+        peak = (row["argument_bytes_per_device"]
+                + row["temp_bytes_per_device"]
+                + row["output_bytes_per_device"]
+                - row["alias_bytes_per_device"])
+        row["peak_is_upper_bound_estimate"] = True
+    row["peak_bytes_per_device"] = int(peak)
+    return row
 
 
 def _model_and_sizes(cfg_kw, dtype="bfloat16"):
@@ -389,6 +432,9 @@ def main():
     report["generated_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                            time.gmtime())
     out = OUT if not quick else OUT.replace(".json", "_quick.json")
+    for a in sys.argv:  # --out=PATH: redirect (the live-gate test uses
+        if a.startswith("--out="):  # a tmpdir, keeping the tree clean)
+            out = a[len("--out="):]
     with open(out, "w") as f:
         json.dump(report, f, indent=1)
         f.write("\n")

@@ -1,0 +1,196 @@
+"""Serving-engine benchmark: continuous batching under Poisson traffic.
+
+Synthetic open-loop workload (the serving analog of bench.py's training
+headline): requests arrive by a seeded Poisson process with random
+prompt/output lengths and stream through ``serving.Engine`` —
+continuous batching, paged KV blocks, preemption under pool pressure.
+Reports engine throughput (tok/s), TTFT/TPOT p50/p99, queue time,
+preemption count and the compile-once counters to a JSON artifact.
+
+Backend note (same discipline as tools/model_benchmark.py): runs on
+whatever backend jax resolves — the real chip via the tunnel for
+recorded numbers, CPU for plumbing checks. CPU numbers are throughput
+of the jnp fallback kernel and are never recorded as baselines; the
+tunnel_battery.sh serving row is the on-chip measurement.
+
+Usage:
+  python tools/serving_benchmark.py                  # tiny CPU smoke
+  python tools/serving_benchmark.py --preset llama1b # on-chip row
+  python tools/serving_benchmark.py --requests 64 --rate 8 \
+      --out tools/serving_bench.json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+PRESETS = {
+    # geometry-only: weights are random (throughput, not quality)
+    "tiny": dict(hidden_size=64, intermediate_size=128,
+                 num_hidden_layers=2, num_attention_heads=4,
+                 vocab_size=256, max_position_embeddings=256),
+    "llama1b": dict(hidden_size=2048, intermediate_size=5504,
+                    num_hidden_layers=22, num_attention_heads=16,
+                    vocab_size=32000, max_position_embeddings=2048),
+}
+
+
+def _watchdog(seconds):
+    def fire(signum, frame):
+        sys.stderr.write("serving_benchmark watchdog: %ds, aborting\n"
+                         % seconds)
+        os._exit(3)
+
+    signal.signal(signal.SIGALRM, fire)
+    signal.alarm(seconds)
+
+
+def _pct(values, q):
+    import numpy as np
+
+    return float(np.percentile(np.asarray(values, dtype=float), q)) \
+        if values else None
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--preset", choices=sorted(PRESETS), default="tiny")
+    ap.add_argument("--requests", type=int, default=20)
+    ap.add_argument("--rate", type=float, default=10.0,
+                    help="Poisson arrival rate, requests/second")
+    ap.add_argument("--prompt-len", type=int, nargs=2, default=(4, 24),
+                    metavar=("LO", "HI"))
+    ap.add_argument("--max-new", type=int, nargs=2, default=(4, 16),
+                    metavar=("LO", "HI"))
+    ap.add_argument("--max-slots", type=int, default=4)
+    ap.add_argument("--num-blocks", type=int, default=128)
+    ap.add_argument("--block-size", type=int, default=16)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--watchdog", type=int, default=1100)
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "serving_bench.json"))
+    args = ap.parse_args()
+    _watchdog(args.watchdog)
+
+    import numpy as np
+
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu import serving
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    paddle.seed(args.seed)
+    cfg = LlamaConfig(use_parallel=False, **PRESETS[args.preset])
+    model = LlamaForCausalLM(cfg)
+
+    rng = np.random.RandomState(args.seed)
+    arrivals = np.cumsum(rng.exponential(1.0 / args.rate, args.requests))
+    prompts = [rng.randint(0, cfg.vocab_size,
+                           (int(rng.randint(args.prompt_len[0],
+                                            args.prompt_len[1] + 1)),)
+                           ).tolist()
+               for _ in range(args.requests)]
+    max_new = [int(rng.randint(args.max_new[0], args.max_new[1] + 1))
+               for _ in range(args.requests)]
+
+    eng = serving.Engine(model, max_slots=args.max_slots,
+                         num_blocks=args.num_blocks,
+                         block_size=args.block_size)
+
+    # warmup: compile THE decode step plus every prefill bucket the
+    # workload can hit, outside the measured window (compile time is
+    # reported separately); one warmup request per bucket. Buckets go up
+    # to prompt_hi + max_new_hi - 1, not prompt_hi: a preempted request
+    # resumes with prompt + generated-so-far, and its re-prefill must
+    # not pay an in-window compile either.
+    t0 = time.perf_counter()
+    resume_hi = args.prompt_len[1] + args.max_new[1] - 1
+    buckets = sorted({eng._bucket(n) for n in
+                      range(args.prompt_len[0], resume_hi + 1)})
+    n_warm = len(buckets)
+    for b in buckets:
+        warm_len = min(b, resume_hi, eng.max_model_len - 2)
+        eng.add_request([1] * warm_len, max_new_tokens=2)
+    eng.run()
+    warmup_s = time.perf_counter() - t0
+    base = eng.stats()     # counters up to here are warmup, not workload
+
+    ids = []
+    start = time.perf_counter()
+    nxt = 0
+    while nxt < args.requests or eng.has_work():
+        now = time.perf_counter() - start
+        while nxt < args.requests and arrivals[nxt] <= now:
+            ids.append(eng.add_request(prompts[nxt],
+                                       max_new_tokens=max_new[nxt]))
+            nxt += 1
+        if eng.has_work():
+            eng.step()
+        elif nxt < args.requests:
+            time.sleep(min(arrivals[nxt] - now, 0.05))
+    wall = time.perf_counter() - start
+
+    stats = eng.stats()
+    # engine counters aggregate over the whole lifetime — subtract the
+    # warmup snapshot so the artifact reports the measured window only
+    meas_steps = stats["decode_steps"] - base["decode_steps"]
+    occ_sum = (stats["slot_occupancy"] * stats["decode_steps"]
+               - base["slot_occupancy"] * base["decode_steps"])
+    meas_occupancy = occ_sum / meas_steps if meas_steps else 0.0
+    per_req = [eng.request_metrics(r) for r in ids]
+    ttft = [m["ttft_s"] for m in per_req if m["ttft_s"] is not None]
+    tpot = [m["tpot_s"] for m in per_req if m["tpot_s"] is not None]
+    queue = [m["queue_time_s"] for m in per_req
+             if m["queue_time_s"] is not None]
+    out_tokens = sum(m["output_tokens"] for m in per_req)
+
+    report = {
+        "metric": "serving_throughput_tok_s",
+        "value": round(out_tokens / max(wall, 1e-9), 1),
+        "unit": "tok/s",
+        "backend": jax.default_backend(),
+        "preset": args.preset,
+        "workload": {
+            "requests": args.requests, "poisson_rate": args.rate,
+            "prompt_len": list(args.prompt_len),
+            "max_new": list(args.max_new), "seed": args.seed,
+            "max_slots": args.max_slots, "num_blocks": args.num_blocks,
+            "block_size": args.block_size,
+        },
+        "wall_s": round(wall, 3),
+        "warmup_compile_s": round(warmup_s, 3),
+        "output_tokens": out_tokens,
+        "ttft_s": {"p50": _pct(ttft, 50), "p99": _pct(ttft, 99)},
+        "tpot_s": {"p50": _pct(tpot, 50), "p99": _pct(tpot, 99)},
+        "queue_time_s": {"p50": _pct(queue, 50), "p99": _pct(queue, 99)},
+        "preemptions": stats["preemptions"] - base["preemptions"],
+        "decode_steps": meas_steps,
+        "decode_compiles": stats["decode_compiles"],
+        "prefill_compiles": stats["prefill_compiles"],
+        "slot_occupancy": round(meas_occupancy, 4),
+        "requests_finished": stats["requests_finished"] - n_warm,
+        "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    print(json.dumps(report), flush=True)
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=1)
+        f.write("\n")
+    print("wrote", args.out, flush=True)
+    # contract check: the whole staggered workload must have reused ONE
+    # compiled decode step (the engine's core shape-stability claim)
+    if stats["decode_compiles"] != 1:
+        sys.stderr.write("FAIL: decode compiled %d times (expected 1)\n"
+                         % stats["decode_compiles"])
+        return 4
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

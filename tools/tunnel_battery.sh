@@ -7,8 +7,8 @@
 #   bash tools/tunnel_battery.sh [logdir]
 #
 # Priority: the flagship bench first (the driver-visible number), then
-# the model rows, the op baseline, the ablations, serving int8, 7B
-# microbench.
+# the model rows, the op baseline, the ablations, serving int8, the
+# continuous-batching serving row, 7B microbench.
 set -u
 cd "$(dirname "$0")/.."
 LOG=${1:-/tmp/battery_$(date -u +%H%M)}
@@ -78,6 +78,13 @@ run bench_all_fused 1500 env BENCH_FUSE=1 FLAGS_fused_lm_head_ce=1 \
 
 # 5. int8 serving row
 run model_int8 1200 python tools/model_benchmark.py llama_int8
+
+# 5b. continuous-batching serving row: paged KV + ragged paged-attention
+#     decode under Poisson arrivals (tok/s, TTFT/TPOT p50/p99,
+#     preemptions -> committed JSON artifact)
+run serving 1200 python tools/serving_benchmark.py --preset llama1b \
+    --requests 64 --rate 8 --max-slots 8 --num-blocks 512 \
+    --out tools/serving_bench.json
 
 # 6. 7B-shape layer microbench (refines the pod projection)
 run llama7b_micro 900 python tools/llama7b_plan.py --microbench
