@@ -35,9 +35,27 @@ except ImportError:  # older jax
     from jax.experimental.shard_map import shard_map
 
 from ..core.tensor import Tensor
+from ..monitor import flight_recorder as _flight
 from . import mesh as _mesh
 
 _REDUCE_OPS = {"sum": jax.lax.psum, "max": jax.lax.pmax, "min": jax.lax.pmin}
+
+
+def _rec_api(op, g, v, reduce_op=None, strict_shape=False):
+    """Flight-record an API-level eager collective with its axis/group
+    identity (the pg layer records transport ops; the depth guard keeps
+    only this outermost record). The group tag is the pg PREFIX — the
+    same identity the timeout diagnoser scopes its stream comparison
+    by, and unique per group even over one rank set."""
+    pg = getattr(g, "pg", None)
+    return _flight.get_flight_recorder().record(
+        op, reduce_op=reduce_op,
+        shape=tuple(getattr(v, "shape", ()) or ()),
+        dtype=str(getattr(v, "dtype", None)),
+        axis=getattr(g, "axis", None),
+        group=(pg.prefix if pg is not None
+               else getattr(g, "id", None)),
+        strict_shape=strict_shape)
 
 
 class ReduceOp:
@@ -253,7 +271,9 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
         return _wrap_like(tensor, out)
     pg = _pg_of(g)
     if pg is not None:
-        return _store_result(tensor, pg.allreduce(_np(v), op))
+        with _rec_api("all_reduce", g, v, reduce_op=op,
+                      strict_shape=True):
+            return _store_result(tensor, pg.allreduce(_np(v), op))
     if g.nranks == 1:
         return tensor
     kind = {"sum": "all_reduce_sum", "max": "all_reduce_max",
@@ -277,7 +297,8 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
         return _wrap_like(tensor, out)
     pg = _pg_of(g)
     if pg is not None:
-        parts = pg.allgather(_np(v))
+        with _rec_api("all_gather", g, v):
+            parts = pg.allgather(_np(v))
         if tensor_list is not None:
             tensor_list.extend(Tensor(jnp.asarray(p)) for p in parts)
             return tensor_list
@@ -312,7 +333,9 @@ def reduce_scatter(tensor, tensor_or_tensor_list=None, op=ReduceOp.SUM,
     pg = _pg_of(g)
     if pg is not None:
         # true per-rank semantics: this rank gets its reduced [d0/n] shard
-        return _store_result(tensor, pg.reduce_scatter(_np(v), op))
+        with _rec_api("reduce_scatter", g, v, reduce_op=op,
+                      strict_shape=True):
+            return _store_result(tensor, pg.reduce_scatter(_np(v), op))
     if g.nranks == 1:
         if isinstance(tensor, Tensor):
             tensor._value = v
@@ -346,7 +369,8 @@ def alltoall(in_tensor_or_list, out_tensor_or_list=None, group=None,
     pg = _pg_of(g)
     if pg is not None:
         # per-rank semantics (reference alltoall: dim0 % nranks == 0)
-        out = jnp.asarray(pg.alltoall(_np(v)))
+        with _rec_api("all_to_all", g, v, strict_shape=True):
+            out = jnp.asarray(pg.alltoall(_np(v)))
     elif g.nranks == 1:
         out = v
     else:
@@ -382,7 +406,8 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
     pg = _pg_of(g)
     if pg is not None:
         # rank-aware: every rank receives src's tensor
-        return _store_result(tensor, pg.broadcast(_np(v), src))
+        with _rec_api("broadcast", g, v):
+            return _store_result(tensor, pg.broadcast(_np(v), src))
     # SPMD single process: arrays are already globally addressed; replicating
     # is a device_put with a replicated sharding.
     if isinstance(tensor, Tensor):

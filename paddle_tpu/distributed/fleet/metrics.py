@@ -11,7 +11,21 @@ from __future__ import annotations
 
 import numpy as np
 
+from ...monitor import gauge as _mgauge
+
 __all__ = ["sum", "max", "min", "auc", "mae", "rmse", "mse", "acc"]
+
+# globally-reduced evaluation metrics mirror onto the shared registry
+# (monitor/), labeled by metric name — same export path as serving and
+# train-step telemetry
+_FLEET_METRIC = _mgauge("fleet_metric",
+                        "world-reduced fleet evaluation metrics",
+                        labelnames=("name",))
+
+
+def _mirror(name, value):
+    _FLEET_METRIC.labels(name=name).set(float(value))
+    return value
 
 
 def _np(x):
@@ -60,28 +74,28 @@ def auc(stat_pos, stat_neg, scope=None, util=None):
         tot_pos += p
         tot_neg += n
     if tot_pos == 0 or tot_neg == 0:
-        return 0.5
-    return float(area / (tot_pos * tot_neg))
+        return _mirror("auc", 0.5)
+    return _mirror("auc", float(area / (tot_pos * tot_neg)))
 
 
 def mae(abserr, total_ins_num, scope=None, util=None):
     """Global mean absolute error from per-trainer (sum|abs err|, n)."""
     err = float(_world_reduce(_np(abserr).reshape(-1), "sum").sum())
     n = float(_world_reduce(_np(total_ins_num).reshape(-1), "sum").sum())
-    return err / n if n else 0.0
+    return _mirror("mae", err / n if n else 0.0)
 
 
 def mse(sqrerr, total_ins_num, scope=None, util=None):
     err = float(_world_reduce(_np(sqrerr).reshape(-1), "sum").sum())
     n = float(_world_reduce(_np(total_ins_num).reshape(-1), "sum").sum())
-    return err / n if n else 0.0
+    return _mirror("mse", err / n if n else 0.0)
 
 
 def rmse(sqrerr, total_ins_num, scope=None, util=None):
-    return float(np.sqrt(mse(sqrerr, total_ins_num)))
+    return _mirror("rmse", float(np.sqrt(mse(sqrerr, total_ins_num))))
 
 
 def acc(correct, total, scope=None, util=None):
     c = float(_world_reduce(_np(correct).reshape(-1), "sum").sum())
     t = float(_world_reduce(_np(total).reshape(-1), "sum").sum())
-    return c / t if t else 0.0
+    return _mirror("acc", c / t if t else 0.0)

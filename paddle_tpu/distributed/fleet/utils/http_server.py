@@ -24,6 +24,21 @@ class KVHandler(http.server.BaseHTTPRequestHandler):
         return "/".join(parts[:-1]), parts[-1]
 
     def do_GET(self):
+        # pluggable GET routes (monitor/exporter.py registers /metrics
+        # and /metrics.json here — one server stack for KV + telemetry)
+        route = self.server.get_routes.get(self.path.strip("/"))
+        if route is not None:
+            try:
+                code, ctype, body = route()
+            except Exception:
+                self.send_status_code(500)
+                return
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
         scope, key = self._split()
         with self.server.kv_lock:
             value = self.server.kv.get(scope, {}).get(key)
@@ -71,6 +86,7 @@ class KVHTTPServer(http.server.ThreadingHTTPServer):
         self.kv_lock = threading.Lock()
         self.kv = {}
         self.delete_kv = {}
+        self.get_routes = {}  # path (no leading /) -> () -> (code, ctype, bytes)
 
     def get_deleted_size(self, key):
         with self.kv_lock:

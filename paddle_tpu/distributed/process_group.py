@@ -30,6 +30,8 @@ import struct
 
 import numpy as np
 
+from ..monitor import flight_recorder as _fr
+
 _DONE = "/~done"
 
 
@@ -79,6 +81,7 @@ class StoreProcessGroup:
         self.prefix = prefix
         self._seq = 0
         self._p2p_seq = {}  # (src, dst) -> count, matched on both ends
+        self._recorder = _fr.get_flight_recorder()
 
     # -- plumbing ----------------------------------------------------------
 
@@ -89,10 +92,31 @@ class StoreProcessGroup:
     def _put(self, key, arr):
         self.store.set(key, _encode(arr))
 
-    def _get(self, key, timeout_s=None):
+    def _rec(self, op, arr=None, reduce_op=None, strict_shape=False):
+        """Flight-record one collective (outermost call only — allreduce
+        lowers to allgather and must not double-record)."""
+        a = None if arr is None else np.asarray(arr)
+        return self._recorder.record(
+            op, reduce_op=reduce_op,
+            shape=None if a is None else a.shape,
+            dtype=None if a is None else a.dtype.name,
+            group=self.prefix, strict_shape=strict_shape)
+
+    def _get(self, key, timeout_s=None, postmortem=True):
         data = self.store.get(key, timeout_s)
         if data is None:
-            raise TimeoutError("collective wait timed out on %r" % key)
+            if not postmortem:
+                raise TimeoutError(
+                    "collective wait timed out on %r" % key)
+            # hang/desync postmortem: dump + gather ring buffers through
+            # the store (alive — it's the PEER's payload that never
+            # arrived), name the first diverging rank/seq, persist JSON
+            report = _fr.on_collective_timeout(
+                self.store, self.rank, self.world_size, waited_key=key,
+                recorder=self._recorder, group=self.prefix)
+            raise TimeoutError(
+                "collective wait timed out on %r — %s"
+                % (key, _fr.summarize(report)))
         return _decode(data)
 
     def _cleanup(self, base, keys):
@@ -106,14 +130,20 @@ class StoreProcessGroup:
 
     def allgather(self, arr):
         """local [d0, ...] -> list of world_size arrays (rank order)."""
-        base = self._op("ag")
-        keys = ["%s/%d" % (base, r) for r in range(self.world_size)]
-        self._put(keys[self.rank], arr)
-        out = [self._get(k) for k in keys]
-        self._cleanup(base, keys)
-        return out
+        with self._rec("all_gather", arr):
+            base = self._op("ag")
+            keys = ["%s/%d" % (base, r) for r in range(self.world_size)]
+            self._put(keys[self.rank], arr)
+            out = [self._get(k) for k in keys]
+            self._cleanup(base, keys)
+            return out
 
     def allreduce(self, arr, op="sum"):
+        with self._rec("all_reduce", arr, reduce_op=op,
+                       strict_shape=True):
+            return self._allreduce(arr, op)
+
+    def _allreduce(self, arr, op):
         parts = self.allgather(np.asarray(arr))
         acc = np.stack(parts, axis=0)
         if op == "sum":
@@ -129,17 +159,21 @@ class StoreProcessGroup:
         raise ValueError(op)
 
     def broadcast(self, arr, src):
-        base = self._op("bc")
-        key = "%s/%d" % (base, src)
-        if self.rank == src:
-            self._put(key, arr)
-        out = self._get(key)
-        self._cleanup(base, [key])
-        return out
+        # not strict_shape: only src's payload matters (object broadcast
+        # passes an empty placeholder on non-src ranks)
+        with self._rec("broadcast", arr):
+            base = self._op("bc")
+            key = "%s/%d" % (base, src)
+            if self.rank == src:
+                self._put(key, arr)
+            out = self._get(key)
+            self._cleanup(base, [key])
+            return out
 
     def reduce(self, arr, dst, op="sum"):
-        out = self.allreduce(arr, op)
-        return out if self.rank == dst else np.asarray(arr)
+        with self._rec("reduce", arr, reduce_op=op, strict_shape=True):
+            out = self._allreduce(arr, op)
+            return out if self.rank == dst else np.asarray(arr)
 
     def reduce_scatter(self, arr, op="sum"):
         """local [world*d, ...] -> this rank's reduced [d, ...] shard."""
@@ -148,23 +182,26 @@ class StoreProcessGroup:
             raise ValueError(
                 "reduce_scatter: dim0 (%d) %% world_size (%d) != 0"
                 % (arr.shape[0], self.world_size))
-        red = self.allreduce(arr, op)
-        return np.split(red, self.world_size, axis=0)[self.rank]
+        with self._rec("reduce_scatter", arr, reduce_op=op,
+                       strict_shape=True):
+            red = self._allreduce(arr, op)
+            return np.split(red, self.world_size, axis=0)[self.rank]
 
     def scatter(self, chunks, src):
         """src provides world_size chunks; returns this rank's chunk."""
-        base = self._op("sc")
-        keys = ["%s/%d" % (base, r) for r in range(self.world_size)]
-        if self.rank == src:
-            if len(chunks) != self.world_size:
-                raise ValueError(
-                    "scatter: need %d chunks, got %d"
-                    % (self.world_size, len(chunks)))
-            for k, c in zip(keys, chunks):
-                self._put(k, c)
-        out = self._get(keys[self.rank])
-        self._cleanup(base, keys)
-        return out
+        with self._rec("scatter"):
+            base = self._op("sc")
+            keys = ["%s/%d" % (base, r) for r in range(self.world_size)]
+            if self.rank == src:
+                if len(chunks) != self.world_size:
+                    raise ValueError(
+                        "scatter: need %d chunks, got %d"
+                        % (self.world_size, len(chunks)))
+                for k, c in zip(keys, chunks):
+                    self._put(k, c)
+            out = self._get(keys[self.rank])
+            self._cleanup(base, keys)
+            return out
 
     def alltoall(self, arr):
         """local [world*d, ...]: chunk j goes to rank j; returns the
@@ -175,22 +212,23 @@ class StoreProcessGroup:
             raise ValueError(
                 "alltoall: dim0 (%d) %% world_size (%d) != 0"
                 % (arr.shape[0], self.world_size))
-        base = self._op("a2a")
-        chunks = np.split(arr, self.world_size, axis=0)
-        keys = []
-        for dst, c in enumerate(chunks):
-            k = "%s/%d.%d" % (base, self.rank, dst)
-            self._put(k, c)
-        recv = []
-        for src in range(self.world_size):
-            k = "%s/%d.%d" % (base, src, self.rank)
-            keys.append(k)
-            recv.append(self._get(k))
-        all_keys = ["%s/%d.%d" % (base, s, d)
-                    for s in range(self.world_size)
-                    for d in range(self.world_size)]
-        self._cleanup(base, all_keys)
-        return np.concatenate(recv, axis=0)
+        with self._rec("all_to_all", arr, strict_shape=True):
+            base = self._op("a2a")
+            chunks = np.split(arr, self.world_size, axis=0)
+            keys = []
+            for dst, c in enumerate(chunks):
+                k = "%s/%d.%d" % (base, self.rank, dst)
+                self._put(k, c)
+            recv = []
+            for src in range(self.world_size):
+                k = "%s/%d.%d" % (base, src, self.rank)
+                keys.append(k)
+                recv.append(self._get(k))
+            all_keys = ["%s/%d.%d" % (base, s, d)
+                        for s in range(self.world_size)
+                        for d in range(self.world_size)]
+            self._cleanup(base, all_keys)
+            return np.concatenate(recv, axis=0)
 
     def send(self, arr, dst):
         """P2P send; matches the dst's recv with the same (src,dst) order
@@ -204,14 +242,27 @@ class StoreProcessGroup:
         n = self._p2p_seq.get((src, self.rank), 0)
         self._p2p_seq[(src, self.rank)] = n + 1
         key = "%s/p2p/%d.%d/%d" % (self.prefix, src, self.rank, n)
-        out = self._get(key, timeout_s)
+        # no desync postmortem on p2p: only the (src, dst) pair is
+        # involved — a world-wide ring-buffer diff of a stalled send
+        # would falsely name every uninvolved rank as diverging
+        out = self._get(key, timeout_s, postmortem=False)
         self.store.delete(key)
         return out
 
     def barrier(self, name=None):
         self._seq += 1
         tag = name or ("%s/bar.%d" % (self.prefix, self._seq))
-        self.store.barrier(tag, self.world_size)
+        with self._rec("barrier"):
+            try:
+                self.store.barrier(tag, self.world_size)
+            except TimeoutError:
+                report = _fr.on_collective_timeout(
+                    self.store, self.rank, self.world_size,
+                    waited_key=tag, recorder=self._recorder,
+                    group=self.prefix)
+                raise TimeoutError(
+                    "barrier %r timed out — %s"
+                    % (tag, _fr.summarize(report)))
 
 
 _world_group = None

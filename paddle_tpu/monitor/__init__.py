@@ -1,0 +1,59 @@
+"""paddle_tpu.monitor — unified telemetry for the whole stack.
+
+Three pillars (one registry, one postmortem path, one timeline):
+
+1. **Metric registry** (monitor/registry.py): Counter/Gauge/Histogram
+   with labels; near-zero overhead when disabled; JSON snapshot +
+   Prometheus text exporters served over the fleet KV HTTP server
+   (monitor/exporter.py); optional bridge mirroring samples onto the
+   native chrome-trace counter timeline. serving/metrics.py and the
+   compiled train step (parallel/engine.py) publish here.
+
+2. **Collective flight recorder** (monitor/flight_recorder.py): a
+   per-rank ring buffer of every eager collective, gathered through the
+   TCPStore on timeout and diffed to name the first diverging
+   rank/sequence — wired into distributed/process_group.py.
+
+3. **Multi-rank trace merge** (monitor/trace_merge.py +
+   tools/trace_merge.py): store-based clock-offset estimation and
+   rank-prefixed chrome-trace aggregation into one aligned timeline.
+"""
+from __future__ import annotations
+
+from .registry import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    counter,
+    disable,
+    enable,
+    gauge,
+    get_registry,
+    histogram,
+    is_enabled,
+)
+from .exporter import (  # noqa: F401
+    MetricsServer,
+    snapshot,
+    start_metrics_server,
+    stop_metrics_server,
+    write_snapshot,
+)
+from .flight_recorder import (  # noqa: F401
+    FlightRecorder,
+    diagnose,
+    get_flight_recorder,
+)
+from . import flight_recorder  # noqa: F401
+from . import trace_merge  # noqa: F401
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "Registry",
+    "counter", "gauge", "histogram", "get_registry",
+    "enable", "disable", "is_enabled",
+    "MetricsServer", "snapshot", "write_snapshot",
+    "start_metrics_server", "stop_metrics_server",
+    "FlightRecorder", "get_flight_recorder", "diagnose",
+    "flight_recorder", "trace_merge",
+]

@@ -1,0 +1,352 @@
+"""Collective flight recorder: per-rank ring buffer + desync diagnoser.
+
+The NCCL-flight-recorder analog for the store-backed collective
+transport (distributed/process_group.py): every eager collective a rank
+issues is recorded as ``(seq, op, reduce_op, shape, dtype, axis,
+t_start, t_end)`` in a fixed-capacity ring. When a collective times out
+— the classic symptom of a desynchronized call stream (T3 / rank skew /
+one rank wedged in a different op) — the timing-out rank:
+
+1. dumps its own ring buffer into the TCPStore (the store is alive; it
+   is the *peer's contribution* that never arrived),
+2. waits a short grace window for the other ranks' dumps (they time out
+   on their own stuck op around the same time),
+3. diagnoses the gathered call streams: the first sequence position
+   where per-rank op signatures diverge, and which rank(s) diverge from
+   the majority — ranks that posted no dump are reported missing,
+4. writes a postmortem JSON report (``PT_MONITOR_DUMP_DIR``, default
+   cwd) and re-raises the timeout with the diagnosis attached.
+
+Everything here is stdlib-only (no jax, no numpy) so worker processes
+can run it without touching an accelerator backend.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+
+class FlightRecorder:
+    """Fixed-capacity ring buffer of collective call records."""
+
+    def __init__(self, capacity=None):
+        if capacity is None:
+            capacity = int(os.environ.get("PT_FR_CAPACITY", "512"))
+        self.capacity = max(int(capacity), 1)
+        self.enabled = os.environ.get("PT_FR", "1").lower() \
+            not in ("0", "false", "off")
+        self._lock = threading.Lock()
+        self._buf = []
+        self._seq = 0
+        self._gseqs = {}    # group -> per-group sequence counter
+        self._depth = threading.local()
+
+    # -- recording ---------------------------------------------------------
+
+    def record(self, op, reduce_op=None, shape=None, dtype=None,
+               axis=None, group=None, strict_shape=False):
+        """Context manager recording one collective. Nested collectives
+        (allreduce lowers to allgather on the store transport) record
+        only the OUTERMOST call — that is the stream that must match
+        across ranks. ``strict_shape=True`` marks ops whose local shape
+        must agree across ranks (allreduce, reduce_scatter, alltoall) so
+        the diagnoser can flag shape skew; ops with legitimately
+        rank-varying payloads (object allgather/broadcast, scatter)
+        leave it False and match on the op stream only."""
+        return _Record(self, op, reduce_op, shape, dtype, axis, group,
+                       strict_shape)
+
+    def _begin(self, op, reduce_op, shape, dtype, axis, group,
+               strict_shape):
+        with self._lock:
+            seq = self._seq
+            self._seq += 1
+            # per-group sequence: subgroup collectives advance the
+            # global seq only on member ranks, so cross-rank alignment
+            # must happen within one group's stream (gseq), never on
+            # the global counter
+            gseq = self._gseqs.get(group, 0)
+            self._gseqs[group] = gseq + 1
+            entry = {
+                "seq": seq,
+                "gseq": gseq,
+                "op": op,
+                "reduce_op": reduce_op,
+                "shape": list(shape) if shape is not None else None,
+                "dtype": str(dtype) if dtype is not None else None,
+                "axis": axis,
+                "group": group,
+                "strict_shape": bool(strict_shape),
+                "t_start": time.time(),
+                "t_end": None,
+            }
+            self._buf.append(entry)
+            if len(self._buf) > self.capacity:
+                del self._buf[:len(self._buf) - self.capacity]
+        return entry
+
+    def _end(self, entry):
+        entry["t_end"] = time.time()
+
+    # -- inspection --------------------------------------------------------
+
+    def entries(self):
+        with self._lock:
+            return [dict(e) for e in self._buf]
+
+    def clear(self):
+        with self._lock:
+            self._buf = []
+            self._seq = 0
+            self._gseqs = {}
+
+    def dump(self, rank=None, world_size=None):
+        return {
+            "rank": rank,
+            "world_size": world_size,
+            "capacity": self.capacity,
+            "next_seq": self._seq,
+            "entries": self.entries(),
+        }
+
+
+class _Record:
+    __slots__ = ("_fr", "_args", "_entry", "_outer")
+
+    def __init__(self, fr, *args):
+        self._fr = fr
+        self._args = args
+        self._entry = None
+
+    def __enter__(self):
+        fr = self._fr
+        d = fr._depth
+        depth = getattr(d, "n", 0)
+        d.n = depth + 1
+        self._outer = depth == 0
+        if fr.enabled and self._outer:
+            self._entry = fr._begin(*self._args)
+        return self._entry
+
+    def __exit__(self, *exc):
+        self._fr._depth.n -= 1
+        if self._entry is not None:
+            self._fr._end(self._entry)
+
+
+_recorder = None
+_rec_lock = threading.Lock()
+
+
+def get_flight_recorder():
+    global _recorder
+    if _recorder is None:
+        with _rec_lock:
+            if _recorder is None:
+                _recorder = FlightRecorder()
+    return _recorder
+
+
+# -- desync diagnosis --------------------------------------------------------
+
+def signature(entry):
+    """The part of a record that must match across ranks at each seq.
+    Shape/dtype participate only for strict_shape ops — object
+    collectives carry legitimately rank-varying payload sizes."""
+    if entry is None:
+        return None
+    sig = (entry.get("op"), entry.get("reduce_op"), entry.get("axis"),
+           entry.get("group"))
+    if entry.get("strict_shape"):
+        sig += (tuple(entry.get("shape") or ()), entry.get("dtype"))
+    return sig
+
+
+def diagnose(buffers, world_size=None, group=None):
+    """Find the first call-stream divergence across per-rank buffers.
+
+    ``buffers``: {rank: [entry, ...]} — ranks that produced no dump may
+    simply be absent. When ``group`` is given (the process group whose
+    collective timed out), comparison is scoped to that group's stream
+    and aligned on the per-group sequence (``gseq``): subgroup
+    collectives advance the GLOBAL counter only on member ranks, so
+    global-seq alignment would shift streams and blame healthy ranks.
+    Returns a report dict:
+
+      status            "desync" | "consistent"
+      first_divergence_seq   (g)seq number of the first mismatching call
+      diverging_ranks   ranks whose signature differs from the majority
+                        (or whose stream already ended)
+      missing_ranks     ranks (0..world_size-1) with no dump at all
+      expected / observed    majority signature vs per-rank signatures
+    """
+    buffers = {int(r): list(b) for r, b in buffers.items()}
+    missing = []
+    if world_size:
+        missing = [r for r in range(world_size) if r not in buffers]
+    report = {"status": "consistent", "world_size": world_size,
+              "group": group,
+              "ranks_reporting": sorted(buffers), "missing_ranks": missing,
+              "first_divergence_seq": None, "diverging_ranks": [],
+              "expected": None, "observed": None}
+    if not buffers:
+        report["status"] = "no-data"
+        return report
+    # align by (per-group) SEQUENCE NUMBER, not list position: rings of
+    # different ranks may have wrapped at different times. A seq below a
+    # rank's oldest retained entry was evicted — unknown, never evidence
+    # of desync; a seq past a rank's newest entry means its call stream
+    # ENDED there — that is the divergence signal.
+    if group is not None:
+        by_seq = {r: {e.get("gseq", e["seq"]): e for e in b
+                      if e.get("group") == group}
+                  for r, b in buffers.items()}
+    else:
+        by_seq = {r: {e["seq"]: e for e in b} for r, b in buffers.items()}
+    bounds = {r: ((min(d), max(d)) if d else None)
+              for r, d in by_seq.items()}
+    all_seqs = sorted({s for d in by_seq.values() for s in d})
+    for s in all_seqs:
+        sigs = {}
+        for r, d in by_seq.items():
+            if bounds[r] is not None and s < bounds[r][0]:
+                continue            # evicted from this rank's ring
+            sigs[r] = signature(d.get(s))
+        distinct = set(sigs.values())
+        if len(distinct) <= 1:
+            continue
+        # majority signature = the stream most ranks agree on
+        counts = {}
+        for v in sigs.values():
+            if v is not None:
+                counts[v] = counts.get(v, 0) + 1
+        expected = max(counts, key=counts.get)
+        diverging = sorted(r for r, v in sigs.items() if v != expected)
+        report.update({
+            "status": "desync",
+            "first_divergence_seq": s,
+            "diverging_ranks": diverging,
+            "expected": list(expected),
+            "observed": {str(r): (list(v) if v is not None else None)
+                         for r, v in sigs.items()},
+        })
+        return report
+    # identical streams from every reporting rank: a missing rank (never
+    # dumped — wedged outside collectives or dead) is the suspect
+    if missing:
+        report["status"] = "desync"
+        report["diverging_ranks"] = missing
+        report["first_divergence_seq"] = (all_seqs[-1] if all_seqs
+                                          else None)
+    return report
+
+
+# -- hang-time store exchange ------------------------------------------------
+
+_FR_PREFIX = "__fr"
+
+
+def dump_to_store(store, rank, world_size, recorder=None, prefix=None):
+    """Publish this rank's ring buffer for postmortem gathering. The
+    dump is stamped with its wall-clock time: keys are fixed per rank
+    (ranks cannot coordinate a per-incident nonce while desynced), so
+    freshness is what separates THIS incident's dump from a previous
+    incident's leftover on the same store."""
+    rec = recorder or get_flight_recorder()
+    key = "%s/rank%d" % (prefix or _FR_PREFIX, rank)
+    payload = rec.dump(rank, world_size)
+    payload["dumped_at"] = time.time()
+    store.set(key, json.dumps(payload).encode())
+    return key
+
+
+def gather_from_store(store, world_size, grace_s=5.0, prefix=None,
+                      fresh_within_s=None):
+    """Collect whatever per-rank dumps appear within the grace window.
+
+    Barrier-free by design: a wedged rank never dumps, and the gather
+    must not hang on it — absence is itself the diagnostic signal.
+    Dumps older than ``fresh_within_s`` (a previous incident on the
+    same store) are ignored; a rank timing out NOW overwrites its key,
+    so polling continues until a fresh dump lands or the grace window
+    closes."""
+    prefix = prefix or _FR_PREFIX
+    if fresh_within_s is None:
+        fresh_within_s = max(10 * grace_s, 60.0)
+    deadline = time.monotonic() + grace_s
+    buffers = {}
+    pending = set(range(world_size))
+    while pending and time.monotonic() < deadline:
+        for r in sorted(pending):
+            left = deadline - time.monotonic()
+            data = store.get("%s/rank%d" % (prefix, r),
+                             timeout_s=max(min(left, 0.25), 0.05))
+            if data is not None:
+                try:
+                    payload = json.loads(data.decode())
+                    dumped_at = payload.get("dumped_at")
+                    if dumped_at is not None and \
+                            time.time() - dumped_at > fresh_within_s:
+                        continue    # stale: a previous incident's dump
+                    buffers[r] = payload["entries"]
+                except Exception:
+                    buffers[r] = []
+                pending.discard(r)
+    return buffers
+
+
+def on_collective_timeout(store, rank, world_size, waited_key=None,
+                          recorder=None, grace_s=None, dump_dir=None,
+                          group=None):
+    """Full hang/desync postmortem: dump own buffer, gather peers,
+    diagnose, persist the report. ``group`` (the timing-out process
+    group's prefix) scopes both the dump-key namespace — a subgroup
+    uses group-LOCAL rank numbering that must not collide with the
+    world group's keys — and the stream comparison. Never raises —
+    this runs inside an exception path and must not mask the original
+    TimeoutError."""
+    try:
+        rec = recorder or get_flight_recorder()
+        if not rec.enabled:
+            return None
+        if grace_s is None:
+            grace_s = float(os.environ.get("PT_FR_GRACE_S", "5"))
+        prefix = _FR_PREFIX if group is None \
+            else "%s/%s" % (_FR_PREFIX, group)
+        dump_to_store(store, rank, world_size, rec, prefix=prefix)
+        buffers = gather_from_store(store, world_size, grace_s,
+                                    prefix=prefix)
+        report = diagnose(buffers, world_size, group=group)
+        report["detected_by_rank"] = rank
+        report["waited_key"] = waited_key
+        report["buffers"] = buffers
+        d = dump_dir or os.environ.get("PT_MONITOR_DUMP_DIR") or "."
+        try:
+            os.makedirs(d, exist_ok=True)
+            path = os.path.join(d, "flight_recorder_rank%d.json" % rank)
+            with open(path, "w") as f:
+                json.dump(report, f, indent=1)
+                f.write("\n")
+            report["report_path"] = path
+        except OSError:
+            pass
+        return report
+    except Exception:
+        return None
+
+
+def summarize(report):
+    """One-line human summary for exception messages."""
+    if not report:
+        return "flight recorder unavailable"
+    if report.get("status") == "desync":
+        return ("collective desync: first divergence at seq %s, "
+                "diverging rank(s) %s (report: %s)"
+                % (report.get("first_divergence_seq"),
+                   report.get("diverging_ranks"),
+                   report.get("report_path", "not written")))
+    return ("no call-stream divergence detected across %s reporting "
+            "rank(s); likely a straggler or network stall"
+            % len(report.get("ranks_reporting", [])))

@@ -1,0 +1,534 @@
+"""Metric registry: Counter/Gauge/Histogram primitives with labels.
+
+The framework-wide aggregation point (reference platform/monitor.cc
+StatRegistry generalized to labeled series): every subsystem —
+serving/metrics.py, the compiled train step (parallel/engine.py),
+fleet/metrics.py reductions — registers its samples here, and the one
+registry exports them as a JSON snapshot or Prometheus exposition text
+(monitor/exporter.py serves both over HTTP).
+
+Design constraints:
+
+- **Near-zero overhead when disabled.** Every mutator
+  (``inc``/``set``/``observe``) checks a module-level enabled flag
+  before touching locks, dicts, or the native lib — the disabled fast
+  path is one attribute load + branch, asserted native-call-free by
+  tests/test_monitor.py.
+- **No hard native dependency.** The optional chrome-trace bridge
+  mirrors Counter/Gauge samples onto the native counter timeline
+  (csrc/trace.cc ``pt_trace_counter``) so registry series line up with
+  RecordEvent spans in merged traces; a build without the lib degrades
+  to pure-python silently.
+- **Idempotent construction.** ``counter()/gauge()/histogram()``
+  return the already-registered metric when called twice with the same
+  name (engines and train steps are constructed repeatedly in tests) —
+  mismatched kind or labelnames is a real error.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+
+class _State:
+    __slots__ = ("enabled", "trace_bridge", "_trace_fn")
+
+    def __init__(self):
+        self.enabled = os.environ.get("PT_MONITOR", "1").lower() \
+            not in ("0", "false", "off")
+        self.trace_bridge = os.environ.get(
+            "PT_MONITOR_TRACE", "0").lower() in ("1", "true", "on")
+        self._trace_fn = None
+
+
+_state = _State()
+
+
+def enable(trace_bridge=None):
+    """Turn metric collection on (process-wide). ``trace_bridge=True``
+    additionally mirrors Counter/Gauge samples onto the native
+    chrome-trace counter timeline."""
+    _state.enabled = True
+    if trace_bridge is not None:
+        _state.trace_bridge = bool(trace_bridge)
+        if not trace_bridge:
+            _state._trace_fn = None
+
+
+def disable():
+    """Turn collection off: every mutator becomes an early return."""
+    _state.enabled = False
+
+
+def is_enabled():
+    return _state.enabled
+
+
+def _trace_counter(name, value):
+    """Best-effort mirror onto the native trace counter timeline. The
+    native API is int64 (csrc/trace.cc pt_trace_counter): FLOAT samples
+    are scaled x1000 under a ``_milli`` suffix so sub-1.0 gauges (AUC,
+    occupancy, sub-second rates) don't flatline at 0. The decision is
+    by sample TYPE, not value — a metric that always reports floats
+    stays on one consistently-scaled series even when a sample lands on
+    a whole number (0.8 -> 800, 2.0 -> 2000, never a bare 2)."""
+    fn = _state._trace_fn
+    if fn is None:
+        try:
+            from ..core import native
+
+            lib = native.get_lib()
+            fn = lib.pt_trace_counter
+        except Exception:
+            # no native lib in this build: degrade to pure python and
+            # stop probing (flip the bridge off so the fast path stays
+            # fast)
+            _state.trace_bridge = False
+            return
+        _state._trace_fn = fn
+    if isinstance(value, float):
+        name += "_milli"
+        value = round(value * 1000)
+    try:
+        fn(name.encode(), int(value))
+    except Exception:
+        _state.trace_bridge = False
+
+
+# -- metric primitives -------------------------------------------------------
+
+class _Child:
+    """One labeled series of a metric."""
+
+    __slots__ = ("_metric", "_key")
+
+    def __init__(self, metric, key):
+        self._metric = metric
+        self._key = key
+
+
+class _CounterChild(_Child):
+    def inc(self, amount=1):
+        if not _state.enabled:
+            return
+        self._metric._add(self._key, amount)
+
+    @property
+    def value(self):
+        return self._metric._values.get(self._key, 0)
+
+
+class _GaugeChild(_Child):
+    def set(self, value):
+        if not _state.enabled:
+            return
+        self._metric._set(self._key, value)
+
+    def inc(self, amount=1):
+        if not _state.enabled:
+            return
+        self._metric._add(self._key, amount)
+
+    def dec(self, amount=1):
+        self.inc(-amount)
+
+    @property
+    def value(self):
+        return self._metric._values.get(self._key, 0)
+
+
+class _HistogramChild(_Child):
+    def observe(self, value):
+        if not _state.enabled:
+            return
+        self._metric._observe(self._key, value)
+
+    def time(self):
+        """Context manager observing the elapsed seconds of the block."""
+        return _Timer(self)
+
+
+class _Timer:
+    __slots__ = ("_child", "_t0")
+
+    def __init__(self, child):
+        self._child = child
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self._child.observe(time.perf_counter() - self._t0)
+
+
+class _DetachedSink:
+    """Write target for children whose series was remove()d: absorbs
+    samples without re-creating registry state."""
+
+    _values = {}
+
+    def _add(self, key, amount):
+        pass
+
+    def _set(self, key, value):
+        pass
+
+    def _observe(self, key, value):
+        pass
+
+
+_DETACHED = _DetachedSink()
+
+
+class Metric:
+    kind = "untyped"
+
+    def __init__(self, name, help="", labelnames=(), registry=None):
+        if not name or not name.replace("_", "a").replace(":", "a").isalnum():
+            raise ValueError("invalid metric name %r" % name)
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._lock = threading.Lock()
+        self._children = {}
+        reg = registry if registry is not None else get_registry()
+        if reg.register(self) is not self:
+            # a matched duplicate would silently orphan this instance
+            # (its samples never reach the exporters) — force sharing
+            # through the idempotent constructors instead
+            raise ValueError(
+                "metric %r is already registered; use "
+                "monitor.counter/gauge/histogram() to share it"
+                % name)
+
+    def labels(self, *values, **kw):
+        """Bind label values; returns the per-series child."""
+        if kw:
+            if values:
+                raise ValueError("pass label values positionally OR by name")
+            unknown = set(kw) - set(self.labelnames)
+            missing = set(self.labelnames) - set(kw)
+            if unknown or missing:
+                raise ValueError(
+                    "%s expects labels %s; unknown %s, missing %s"
+                    % (self.name, self.labelnames,
+                       sorted(unknown), sorted(missing)))
+            values = tuple(kw[n] for n in self.labelnames)
+        else:
+            values = tuple(str(v) for v in values)
+        if len(values) != len(self.labelnames):
+            raise ValueError(
+                "%s expects labels %s, got %r"
+                % (self.name, self.labelnames, values))
+        values = tuple(str(v) for v in values)
+        child = self._children.get(values)
+        if child is None:
+            with self._lock:
+                child = self._children.setdefault(
+                    values, self._child_cls(self, values))
+        return child
+
+    def remove(self, *values, **kw):
+        """Drop one labeled series (child binding and recorded data) —
+        the hook that keeps per-instance label dimensions (e.g.
+        ``engine=<id>``) from growing without bound. A still-live child
+        bound to the removed series is DETACHED: its writes become
+        no-ops rather than silently resurrecting the series outside the
+        registry's pruning view."""
+        if kw:
+            values = tuple(kw[n] for n in self.labelnames)
+        values = tuple(str(v) for v in values)
+        with self._lock:
+            child = self._children.pop(values, None)
+            if child is not None:
+                child._metric = _DETACHED
+            for attr in ("_values", "_series"):
+                store = getattr(self, attr, None)
+                if store is not None:
+                    store.pop(values, None)
+
+    def _default_child(self):
+        return self.labels(*(() if not self.labelnames else
+                             ("",) * len(self.labelnames)))
+
+    def _series_name(self, key):
+        return _series(self.name, self.labelnames, key)
+
+
+class Counter(Metric):
+    """Monotone counter. ``inc`` on the metric itself operates on the
+    unlabeled series (only valid without labelnames)."""
+
+    kind = "counter"
+    _child_cls = _CounterChild
+
+    def __init__(self, name, help="", labelnames=(), registry=None):
+        super().__init__(name, help, labelnames, registry)
+        self._values = {}
+
+    def _add(self, key, amount):
+        if amount < 0:
+            raise ValueError("counters only go up (inc(%r))" % (amount,))
+        with self._lock:
+            v = self._values.get(key, 0) + amount
+            self._values[key] = v
+        if _state.trace_bridge:
+            _trace_counter(self._series_name(key), v)
+
+    def inc(self, amount=1):
+        if not _state.enabled:
+            return
+        if self.labelnames:
+            raise ValueError("%s has labels; use .labels(...)" % self.name)
+        self._add((), amount)
+
+    @property
+    def value(self):
+        return self._values.get((), 0)
+
+    def collect(self):
+        with self._lock:
+            return [(key, v) for key, v in sorted(self._values.items())]
+
+
+class Gauge(Counter):
+    """Last-write-wins instantaneous value (can go down)."""
+
+    kind = "gauge"
+    _child_cls = _GaugeChild
+
+    def _add(self, key, amount):
+        with self._lock:
+            v = self._values.get(key, 0) + amount
+            self._values[key] = v
+        if _state.trace_bridge:
+            _trace_counter(self._series_name(key), v)
+
+    def _set(self, key, value):
+        with self._lock:
+            self._values[key] = value
+        if _state.trace_bridge:
+            _trace_counter(self._series_name(key), value)
+
+    def set(self, value):
+        if not _state.enabled:
+            return
+        if self.labelnames:
+            raise ValueError("%s has labels; use .labels(...)" % self.name)
+        self._set((), value)
+
+    def dec(self, amount=1):
+        self.inc(-amount)
+
+
+# default buckets: request-latency shaped (prometheus client defaults)
+DEFAULT_BUCKETS = (.001, .0025, .005, .01, .025, .05, .1, .25, .5,
+                   1.0, 2.5, 5.0, 10.0)
+
+
+class Histogram(Metric):
+    kind = "histogram"
+    _child_cls = _HistogramChild
+
+    def __init__(self, name, help="", labelnames=(), buckets=None,
+                 registry=None):
+        self.buckets = tuple(sorted(buckets or DEFAULT_BUCKETS))
+        super().__init__(name, help, labelnames, registry)
+        self._series = {}  # key -> [bucket_counts..., sum, count]
+
+    def _observe(self, key, value):
+        with self._lock:
+            s = self._series.get(key)
+            if s is None:
+                s = self._series[key] = \
+                    [0] * len(self.buckets) + [0.0, 0]
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    s[i] += 1
+            s[-2] += value
+            s[-1] += 1
+
+    def observe(self, value):
+        if not _state.enabled:
+            return
+        if self.labelnames:
+            raise ValueError("%s has labels; use .labels(...)" % self.name)
+        self._observe((), value)
+
+    def time(self):
+        return _Timer(self._default_child() if self.labelnames
+                      else _HistogramChild(self, ()))
+
+    def collect(self):
+        with self._lock:
+            out = []
+            for key, s in sorted(self._series.items()):
+                out.append((key, {
+                    "buckets": dict(zip(self.buckets, s[:-2])),
+                    "sum": s[-2], "count": s[-1],
+                }))
+            return out
+
+
+# -- registry ----------------------------------------------------------------
+
+class Registry:
+    def __init__(self):
+        self._metrics = {}
+        self._lock = threading.Lock()
+
+    def register(self, metric):
+        with self._lock:
+            have = self._metrics.get(metric.name)
+            if have is not None and have is not metric:
+                if (have.kind, have.labelnames) != (metric.kind,
+                                                    metric.labelnames):
+                    raise ValueError(
+                        "metric %r already registered as %s%s"
+                        % (metric.name, have.kind, have.labelnames))
+                return have
+            self._metrics[metric.name] = metric
+            return metric
+
+    def get(self, name):
+        return self._metrics.get(name)
+
+    def unregister(self, name):
+        with self._lock:
+            self._metrics.pop(name, None)
+
+    def clear(self):
+        with self._lock:
+            self._metrics.clear()
+
+    def metrics(self):
+        with self._lock:
+            return [self._metrics[n] for n in sorted(self._metrics)]
+
+    # -- exporters ---------------------------------------------------------
+
+    def snapshot(self):
+        """JSON-ready dict: {name: {kind, help, series: [...]}}."""
+        out = {}
+        for m in self.metrics():
+            series = []
+            if m.kind in ("counter", "gauge"):
+                for key, v in m.collect():
+                    series.append({
+                        "labels": dict(zip(m.labelnames, key)),
+                        "value": v,
+                    })
+            else:
+                for key, h in m.collect():
+                    series.append({
+                        "labels": dict(zip(m.labelnames, key)),
+                        "sum": h["sum"], "count": h["count"],
+                        "buckets": {str(b): c
+                                    for b, c in h["buckets"].items()},
+                    })
+            out[m.name] = {"kind": m.kind, "help": m.help,
+                           "series": series}
+        return out
+
+    def prometheus_text(self):
+        """Prometheus text exposition format (version 0.0.4)."""
+        lines = []
+        for m in self.metrics():
+            if m.help:
+                lines.append("# HELP %s %s"
+                             % (m.name, m.help.replace("\n", " ")))
+            lines.append("# TYPE %s %s" % (m.name, m.kind))
+            if m.kind in ("counter", "gauge"):
+                for key, v in m.collect():
+                    lines.append("%s %s"
+                                 % (_series(m.name, m.labelnames, key),
+                                    _fmt(v)))
+            else:
+                for key, h in m.collect():
+                    bnames = list(m.labelnames) + ["le"]
+                    for b, c in h["buckets"].items():
+                        lines.append("%s %d" % (_series(
+                            m.name + "_bucket", bnames,
+                            list(key) + [_fmt(b)]), c))
+                    lines.append("%s %d" % (_series(
+                        m.name + "_bucket", bnames,
+                        list(key) + ["+Inf"]), h["count"]))
+                    lines.append("%s %s"
+                                 % (_series(m.name + "_sum", m.labelnames,
+                                            key), _fmt(h["sum"])))
+                    lines.append("%s %d"
+                                 % (_series(m.name + "_count",
+                                            m.labelnames, key),
+                                    h["count"]))
+        return "\n".join(lines) + "\n"
+
+
+def _fmt(v):
+    if isinstance(v, float):
+        if v == int(v) and abs(v) < 1e15:
+            return "%g" % v
+        return repr(v)
+    return str(v)
+
+
+def _series(name, labelnames, key):
+    if not labelnames:
+        return name
+    lbl = ",".join('%s="%s"' % (n, str(v).replace('"', '\\"'))
+                   for n, v in zip(labelnames, key))
+    return "%s{%s}" % (name, lbl)
+
+
+_default_registry = Registry()
+
+
+def get_registry():
+    return _default_registry
+
+
+# -- idempotent constructors (the module-level metric idiom) -----------------
+
+def _check_match(have, cls, name, labelnames):
+    if (have.kind, have.labelnames) != (cls.kind, tuple(labelnames)):
+        raise ValueError(
+            "metric %r already registered as %s%s"
+            % (name, have.kind, have.labelnames))
+    return have
+
+
+def _get_or_create(cls, name, help, labelnames, **kw):
+    have = _default_registry.get(name)
+    if have is not None:
+        return _check_match(have, cls, name, labelnames)
+    try:
+        return cls(name, help=help, labelnames=labelnames, **kw)
+    except ValueError:
+        # lost a registration race: fall back to the winner if it
+        # matches, else surface the mismatch
+        have = _default_registry.get(name)
+        if have is None:
+            raise
+        return _check_match(have, cls, name, labelnames)
+
+
+def counter(name, help="", labelnames=()):
+    return _get_or_create(Counter, name, help, labelnames)
+
+
+def gauge(name, help="", labelnames=()):
+    return _get_or_create(Gauge, name, help, labelnames)
+
+
+def histogram(name, help="", labelnames=(), buckets=None):
+    h = _get_or_create(Histogram, name, help, labelnames,
+                       buckets=buckets)
+    want = tuple(sorted(buckets or DEFAULT_BUCKETS))
+    if h.buckets != want:
+        # observations would silently land in the wrong boundaries —
+        # bucket disagreement is as real a conflict as a kind mismatch
+        raise ValueError(
+            "histogram %r already registered with buckets %s (asked "
+            "for %s)" % (name, h.buckets, want))
+    return h

@@ -1,0 +1,174 @@
+"""Multi-rank chrome-trace aggregation: clock sync + aligned merge.
+
+Per-rank profiler output (profiler.export_chrome_tracing /
+export_merged_chrome_tracing) is one timeline per process with its own
+host clock. To read comm/compute overlap across ranks — the exact
+observability gap T3 names for fused distributed training — the per-rank
+traces must land on ONE time base:
+
+1. **Clock offset estimation** (``estimate_clock_offset``): an NTP-style
+   ping exchange over the TCPStore. Rank 0 is the reference clock; each
+   other rank sends its send-time, rank 0 echoes its own clock, and the
+   requester takes the minimum-RTT sample's midpoint offset — accurate
+   to ~RTT/2, far below the collective timescales being diagnosed.
+   The exchange runs on ``time.monotonic()`` — the SAME timebase
+   csrc/trace.cc stamps events with (steady_clock) — so the offset is
+   directly the shift that aligns trace ``ts`` values; wall-clock skew
+   would miss the per-host monotonic epoch (boot-time) delta entirely.
+   ``write_clock_file`` persists the offset next to the trace so merging
+   is an offline operation.
+
+2. **Merge** (``merge_trace_files``): every rank's ``traceEvents`` are
+   shifted by its offset (chrome ``ts`` is in microseconds) and its pids
+   prefixed ``rank{r}/`` so process/thread tracks stay distinct in one
+   Perfetto view. Metadata (``ph == "M"``) events ride along so track
+   names survive.
+
+The CLI wrapper is tools/trace_merge.py.
+"""
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import re
+import time
+
+CLOCK_FILE = "clock_rank%d.json"
+_CLK_PREFIX = "__clk"
+
+
+def estimate_clock_offset(store, rank, world_size, pings=8, prefix=None,
+                          timeout_s=30):
+    """Offset (seconds) such that t_rank0 ~= t_local + offset, in the
+    MONOTONIC timebase the native tracer stamps events with — on
+    distinct hosts this absorbs the boot-epoch delta wall clocks can't
+    see, which is exactly the shift the merged trace needs.
+
+    Collective over the store: EVERY rank must call this (rank 0 serves
+    the echo side). Rank 0's offset is 0.0 by definition.
+    """
+    prefix = prefix or _CLK_PREFIX
+    if rank == 0:
+        for r in range(1, world_size):
+            for i in range(pings):
+                req_key = "%s/%d/req/%d" % (prefix, r, i)
+                data = store.get(req_key, timeout_s=timeout_s)
+                if data is None:
+                    raise TimeoutError(
+                        "clock sync: no ping %d from rank %d" % (i, r))
+                store.set("%s/%d/rsp/%d" % (prefix, r, i),
+                          repr(time.monotonic()).encode())
+                # consume the request so a later sync round on the same
+                # store starts from a clean exchange
+                store.delete(req_key)
+        return 0.0
+    best_rtt, best_off = None, 0.0
+    for i in range(pings):
+        rsp_key = "%s/%d/rsp/%d" % (prefix, rank, i)
+        t0 = time.monotonic()
+        store.set("%s/%d/req/%d" % (prefix, rank, i),
+                  repr(t0).encode())
+        data = store.get(rsp_key, timeout_s=timeout_s)
+        t2 = time.monotonic()
+        if data is None:
+            raise TimeoutError("clock sync: rank 0 did not echo ping %d"
+                               % i)
+        # delete the response immediately: a second sync round reusing
+        # these key names must never read THIS round's echo (a stale
+        # rsp reads as a near-zero RTT and wins min-RTT selection with
+        # a garbage offset)
+        store.delete(rsp_key)
+        t1 = float(data.decode())
+        rtt = t2 - t0
+        if best_rtt is None or rtt < best_rtt:
+            best_rtt, best_off = rtt, t1 - (t0 + t2) / 2.0
+    return best_off
+
+
+def write_clock_file(dir_name, rank, offset_s, rtt_s=None):
+    os.makedirs(dir_name, exist_ok=True)
+    path = os.path.join(dir_name, CLOCK_FILE % rank)
+    with open(path, "w") as f:
+        json.dump({"rank": rank, "offset_s": offset_s,
+                   "rtt_s": rtt_s,
+                   "written_at": time.strftime(
+                       "%Y-%m-%dT%H:%M:%SZ", time.gmtime())}, f)
+        f.write("\n")
+    return path
+
+
+def load_clock_offsets(dir_name):
+    """{rank: offset_s} from clock_rank*.json files in a directory."""
+    offsets = {}
+    for path in glob.glob(os.path.join(dir_name, "clock_rank*.json")):
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+            offsets[int(rec["rank"])] = float(rec["offset_s"])
+        except (OSError, ValueError, KeyError):
+            continue
+    return offsets
+
+
+def _load_events(path):
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt") as f:
+        data = json.load(f)
+    if isinstance(data, list):
+        return data
+    return data.get("traceEvents", [])
+
+
+def rank_of_path(path):
+    """Infer the rank from a filename like trace_rank3.json / worker_3.json
+    (last integer before the extension wins)."""
+    stem = os.path.basename(path)
+    stem = re.sub(r"\.(json|gz)$", "", re.sub(r"\.gz$", "", stem))
+    nums = re.findall(r"(\d+)", stem)
+    return int(nums[-1]) if nums else None
+
+
+def merge_rank_events(rank_events, offsets=None):
+    """{rank: [event, ...]} -> one aligned event list.
+
+    ``ts`` shifts by the rank's clock offset (us); pids become
+    ``rank{r}/{pid}``."""
+    offsets = offsets or {}
+    merged = []
+    for rank in sorted(rank_events):
+        shift_us = offsets.get(rank, 0.0) * 1e6
+        for ev in rank_events[rank]:
+            if not isinstance(ev, dict):
+                continue
+            ev = dict(ev)
+            if isinstance(ev.get("ts"), (int, float)):
+                ev["ts"] = ev["ts"] + shift_us
+            if "pid" in ev:
+                ev["pid"] = "rank%d/%s" % (rank, ev["pid"])
+            else:
+                ev["pid"] = "rank%d" % rank
+            merged.append(ev)
+    return merged
+
+
+def merge_trace_files(paths_by_rank, out_path, offsets=None):
+    """Merge per-rank chrome traces into one aligned timeline file.
+
+    ``paths_by_rank``: {rank: path} (.json or .json.gz).
+    Returns the merged event count."""
+    rank_events = {r: _load_events(p) for r, p in paths_by_rank.items()}
+    merged = merge_rank_events(rank_events, offsets)
+    d = os.path.dirname(os.path.abspath(out_path))
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(out_path, "w") as f:
+        json.dump({"traceEvents": merged,
+                   "displayTimeUnit": "ms",
+                   "metadata": {
+                       "merged_ranks": sorted(rank_events),
+                       "clock_offsets_s": {str(r): v for r, v in
+                                           (offsets or {}).items()},
+                   }}, f)
+    return len(merged)

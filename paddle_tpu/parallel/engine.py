@@ -21,13 +21,74 @@ enters as a sharding:
 """
 from __future__ import annotations
 
+import time
+
 import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from .. import monitor as _monitor
 from ..core.dispatch import no_grad
 from ..core.tensor import Tensor
 from ..distributed import mesh as _mesh
+
+# training telemetry on the same registry as serving (monitor/):
+# step time, token throughput, trace counts, device memory — the
+# north-star numbers bench.py reads, live on /metrics.
+_STEP_TIME = _monitor.histogram(
+    "train_step_seconds",
+    "host wall time of one compiled train-step call (dispatch + any "
+    "host-side blocking)",
+    buckets=(.001, .0025, .005, .01, .025, .05, .1, .25, .5, 1.0, 2.5,
+             5.0, 10.0, 30.0, 60.0))
+_STEPS = _monitor.counter("train_steps_total", "optimizer steps taken")
+_TRAIN_TOKENS = _monitor.counter(
+    "train_tokens_total",
+    "batch elements consumed (batch x seq for >=2-d inputs)")
+_TOK_RATE = _monitor.gauge("train_tokens_per_s",
+                           "tokens/s of the last step window")
+_TRAIN_COMPILES = _monitor.counter(
+    "train_compiles_total", "XLA traces of the train step",
+    labelnames=("kind",))
+_DEV_MEM = _monitor.gauge(
+    "device_memory_bytes", "device allocator stats (first local device)",
+    labelnames=("stat",))
+
+
+def _batch_tokens(vals, stacked=False):
+    """Token-count approximation for throughput telemetry: product of
+    the leading (K,) batch and sequence dims of the first input."""
+    b = vals[0]
+    dims = b.shape[:3] if stacked else b.shape[:2]
+    n = 1
+    for d in dims:
+        n *= int(d)
+    return n
+
+
+def _record_step(vals, steps, dt, stacked=False):
+    if not _monitor.is_enabled():
+        return
+    _STEP_TIME.observe(dt)
+    _STEPS.inc(steps)
+    tokens = _batch_tokens(vals, stacked)
+    _TRAIN_TOKENS.inc(tokens)
+    if dt > 0:
+        _TOK_RATE.set(tokens / dt)
+    try:
+        # device-memory probe only in single-process worlds: under a
+        # multi-process gloo/CPU runtime a per-step device query races
+        # the in-flight collective transport and aborts the process
+        # (gloo preamble mismatch) — and cross-process memory telemetry
+        # belongs to each process's own registry anyway
+        if jax.process_count() == 1:
+            stats = jax.local_devices()[0].memory_stats() or {}
+            for key in ("bytes_in_use", "peak_bytes_in_use",
+                        "bytes_limit"):
+                if key in stats:
+                    _DEV_MEM.labels(stat=key).set(stats[key])
+    except Exception:
+        pass
 
 
 def _normalize_spec(spec, ndim):
@@ -199,6 +260,7 @@ class CompiledTrainStep:
 
         def step(state_vals, opt_state, step_i, lr_i, rng_key,
                  batch):
+            _TRAIN_COMPILES.labels(kind="step").inc()  # trace-time
             state = dict(zip(names, state_vals))
 
             def loss_of(train_vals, batch):
@@ -274,6 +336,7 @@ class CompiledTrainStep:
         stacked_sharding = self._batch_sharding(stacked=True)
 
         def multi(state_vals, opt_state, step0, lr_i, rng_key, batches):
+            _TRAIN_COMPILES.labels(kind="multi").inc()  # trace-time
             k = batches[0].shape[0]
 
             def body(i, carry):
@@ -318,11 +381,13 @@ class CompiledTrainStep:
         state_vals = [tensors[n]._value for n in self._names]
         from ..framework import random as _random
 
+        t0 = time.perf_counter()
         loss, new_state, new_opt = self._compiled_multi(
             state_vals, self._opt_state,
             jnp.asarray(self._step_count + 1, jnp.int32),
             jnp.asarray(self.optimizer.get_lr(), jnp.float32),
             _random._key(), vals)
+        _record_step(vals, k, time.perf_counter() - t0, stacked=True)
         self._step_count += k
         for n, v in zip(self._names, new_state):
             tensors[n]._value = v
@@ -400,11 +465,13 @@ class CompiledTrainStep:
         from ..framework import random as _random
 
         self._step_count += 1
+        t0 = time.perf_counter()
         loss, new_state, new_opt = self._compiled(
             state_vals, self._opt_state,
             jnp.asarray(self._step_count, jnp.int32),
             jnp.asarray(self.optimizer.get_lr(), jnp.float32),
             _random._key(), vals)
+        _record_step(vals, 1, time.perf_counter() - t0)
         for n, v in zip(self._names, new_state):
             tensors[n]._value = v
         self._opt_state = new_opt

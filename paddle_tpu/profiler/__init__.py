@@ -242,14 +242,19 @@ class Profiler:
             merged = {"traceEvents": events}
         device_files = sorted(glob.glob(os.path.join(
             self.trace_dir, "plugins", "profile", "*", "*.trace.json.gz")))
-        for df in device_files[-1:]:
+        for i, df in enumerate(device_files):
+            # ALL capture files merge in (a scheduler with repeat>1
+            # produces one Xprof capture per record window); each file
+            # gets its own pid namespace so windows don't overdraw each
+            # other on one track
+            tag = "xla%d" % i if len(device_files) > 1 else "xla"
             with gzip.open(df, "rt") as f:
                 dev = json.load(f)
             for ev in dev.get("traceEvents", []):
                 # keep device pids distinct from host pids
                 if isinstance(ev, dict) and "pid" in ev:
                     ev = dict(ev)
-                    ev["pid"] = "xla/%s" % ev["pid"]
+                    ev["pid"] = "%s/%s" % (tag, ev["pid"])
                 events.append(ev)
         merged["traceEvents"] = events
         with open(path, "w") as f:

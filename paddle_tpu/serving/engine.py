@@ -92,10 +92,10 @@ class Engine:
                    self.cache.allocator.usable_blocks))
         req = Request(prompt, max_new_tokens, eos_token_id)
         self.requests[req.id] = req
-        self.metrics.requests_in += 1
+        self.metrics.on_request_in()
         if max_new_tokens == 0:     # zero-length generation: trivially done
             req.finish()
-            self.metrics.requests_finished += 1
+            self.metrics.on_request_finished()
             return req.id
         self.scheduler.add(req)
         return req.id
@@ -136,6 +136,7 @@ class Engine:
             if admitted is None:
                 return
             slot, req = admitted
+            self.metrics.on_admission()
             self._prefill_request(slot, req)
 
     def _prefill_request(self, slot, req):
@@ -152,10 +153,9 @@ class Engine:
                 jnp.asarray(L, jnp.int32))
         self.cache.pools = new_pools
         self.cache.seq_lens[slot] = L
-        self.metrics.prefill_runs += 1
+        self.metrics.on_prefill_run()
         req.state = RequestState.DECODING
-        if req.metrics.first_token_t is None:
-            req.metrics.first_token_t = now()
+        req.metrics.on_first_token(now())
         self._accept_token(req, int(tok))
 
     def _grow_or_preempt(self):
@@ -172,7 +172,7 @@ class Engine:
                     raise RuntimeError(
                         "KV pool exhausted by a single request — "
                         "add_request validation should have caught this")
-                self.metrics.preemptions += 1
+                self.metrics.on_preemption()
 
     def _decode_once(self, active):
         bt = jnp.asarray(self.cache.block_tables)
@@ -193,14 +193,14 @@ class Engine:
     def _accept_token(self, req, tok):
         req.generated.append(tok)
         self._slot_tokens[req.slot] = tok
-        self.metrics.output_tokens += 1
+        self.metrics.on_output_token()
         done = (req.remaining <= 0
                 or (req.eos_token_id is not None
                     and tok == req.eos_token_id))
         if done:
             self.scheduler.release(req)
             req.finish()
-            self.metrics.requests_finished += 1
+            self.metrics.on_request_finished()
 
     # -- compiled steps ---------------------------------------------------
 
@@ -230,7 +230,7 @@ class Engine:
         from ..core.dispatch import no_grad
         from ..core.tensor import Tensor
 
-        self.metrics.prefill_compiles += 1      # trace-time counter
+        self.metrics.on_prefill_compile()       # trace-time counter
         with self.model.bind_state(self._names, list(state_vals)):
             with no_grad():
                 views = [PagedPrefillView(p, table_row, self.block_size)
@@ -247,7 +247,7 @@ class Engine:
         from ..core.dispatch import no_grad
         from ..core.tensor import Tensor
 
-        self.metrics.decode_compiles += 1       # trace-time counter
+        self.metrics.on_decode_compile()        # trace-time counter
         with self.model.bind_state(self._names, list(state_vals)):
             with no_grad():
                 views = [PagedDecodeView(p, block_tables, seq_lens,

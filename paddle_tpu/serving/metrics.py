@@ -15,9 +15,17 @@ engine (``EngineMetrics.to_dict()``):
   prefill_runs / decode_steps / output_tokens
   decode_compiles / prefill_compiles   (jit trace counts — the
       compile-once contract tests assert decode_compiles == 1)
-  throughput_tok_s                     output tokens / wall time
+  throughput_tok_s                     output tokens / wall time since
+      FIRST ADMISSION (not engine construction — an engine created
+      before traffic arrives must not understate throughput)
   slot_occupancy                       mean active-slots / max_slots
       over decode steps (the 占用 utilization counter)
+
+Every sample also flows through the framework-wide registry
+(paddle_tpu.monitor): counters/gauges under ``serving_*`` plus
+TTFT/TPOT/queue/e2e histograms, so serving shows up on the same
+/metrics endpoint and JSON snapshots as training telemetry. The dict
+API above stays — it is the benchmark-artifact schema.
 
 Chrome-trace spans: ``span("serving.decode_step")`` bridges into the
 native host recorder (csrc/trace.cc via profiler.RecordEvent, which
@@ -28,7 +36,58 @@ native lib degrades to a no-op, never breaks serving.
 from __future__ import annotations
 
 import contextlib
+import itertools
 import time
+
+from ..monitor import counter as _mcounter
+from ..monitor import gauge as _mgauge
+from ..monitor import histogram as _mhistogram
+
+# shared-registry series (idempotent: re-imports / engine re-creation
+# reuse the registered metric). Counters and histograms are cumulative
+# across every engine in the process; instantaneous gauges
+# (active slots, throughput) are labeled per engine instance —
+# per-engine windows come from EngineMetrics.to_dict().
+_REQUESTS = _mcounter(
+    "serving_requests_total", "request lifecycle events",
+    labelnames=("event",))
+_PREFILLS = _mcounter("serving_prefill_runs_total",
+                      "prefill executions (admissions + resumes)")
+_DECODE_STEPS = _mcounter("serving_decode_steps_total",
+                          "batched decode steps")
+_TOKENS = _mcounter("serving_output_tokens_total", "tokens generated")
+_COMPILES = _mcounter("serving_compiles_total",
+                      "XLA traces of serving step functions",
+                      labelnames=("fn",))
+_ACTIVE = _mgauge("serving_active_slots",
+                  "decoding slots in the current step",
+                  labelnames=("engine",))
+_THROUGHPUT = _mgauge("serving_throughput_tok_s",
+                      "engine-lifetime output tokens/s",
+                      labelnames=("engine",))
+_ENGINE_IDS = itertools.count()
+# engine-labeled gauge series are pruned to this many newest engines —
+# a process that constructs engines repeatedly (test suites, rolling
+# reloads) must not grow the registry without bound
+_MAX_ENGINE_SERIES = 32
+
+
+def _prune_engine_series():
+    for g in (_ACTIVE, _THROUGHPUT):
+        keys = sorted(g._children, key=lambda k: int(k[0]))
+        for k in keys[:-_MAX_ENGINE_SERIES]:
+            g.remove(*k)
+_LAT_BUCKETS = (.0025, .005, .01, .025, .05, .1, .25, .5, 1.0, 2.5,
+                5.0, 10.0, 30.0)
+_TTFT = _mhistogram("serving_ttft_seconds", "arrival -> first token",
+                    buckets=_LAT_BUCKETS)
+_TPOT = _mhistogram("serving_tpot_seconds",
+                    "mean inter-token time per request",
+                    buckets=_LAT_BUCKETS)
+_QUEUE = _mhistogram("serving_queue_time_seconds",
+                     "arrival -> first admission", buckets=_LAT_BUCKETS)
+_E2E = _mhistogram("serving_e2e_seconds", "arrival -> finished",
+                   buckets=_LAT_BUCKETS)
 
 
 def now():
@@ -59,7 +118,12 @@ def span(name, level=1):
 
 def counter(name, value):
     """Named counter sample on the native trace timeline (no-op
-    without the lib)."""
+    without the lib, and skipped entirely when the monitor is disabled
+    — the disabled fast path must not touch native code)."""
+    from ..monitor.registry import is_enabled
+
+    if not is_enabled():
+        return
     try:
         from ..core import native
 
@@ -81,6 +145,20 @@ class RequestMetrics:
     def on_admit(self, t):
         if self.first_admit_t is None:
             self.first_admit_t = t
+            _QUEUE.observe(t - self.arrival_t)
+
+    def on_first_token(self, t):
+        if self.first_token_t is None:
+            self.first_token_t = t
+            _TTFT.observe(t - self.arrival_t)
+
+    def on_finish(self, t, output_tokens):
+        self.finish_t = t
+        self.output_tokens = output_tokens
+        _E2E.observe(t - self.arrival_t)
+        if self.first_token_t is not None and output_tokens > 1:
+            _TPOT.observe((t - self.first_token_t)
+                          / (output_tokens - 1))
 
     def to_dict(self):
         ttft = (None if self.first_token_t is None
@@ -106,7 +184,16 @@ class RequestMetrics:
 class EngineMetrics:
     def __init__(self, max_slots):
         self.max_slots = max_slots
-        self.start_t = now()
+        # instantaneous gauges are per engine instance: two engines in
+        # one process must not overwrite each other's last-write-wins
+        # series (bind the children once — no per-step dict lookups)
+        eid = str(next(_ENGINE_IDS))
+        self._active_gauge = _ACTIVE.labels(engine=eid)
+        self._throughput_gauge = _THROUGHPUT.labels(engine=eid)
+        _prune_engine_series()
+        # wall clock starts at FIRST ADMISSION, not construction: an
+        # engine built ahead of traffic must not understate throughput
+        self.start_t = None
         self.requests_in = 0
         self.requests_finished = 0
         self.preemptions = 0
@@ -117,15 +204,59 @@ class EngineMetrics:
         self.prefill_compiles = 0
         self._occupancy_sum = 0
 
+    # -- engine hooks (mirror every sample into the shared registry) ---
+
+    def on_request_in(self):
+        self.requests_in += 1
+        _REQUESTS.labels(event="in").inc()
+
+    def on_request_finished(self):
+        self.requests_finished += 1
+        _REQUESTS.labels(event="finished").inc()
+
+    def on_preemption(self):
+        self.preemptions += 1
+        _REQUESTS.labels(event="preempted").inc()
+
+    def on_admission(self):
+        if self.start_t is None:
+            self.start_t = now()
+
+    def on_prefill_run(self):
+        self.prefill_runs += 1
+        _PREFILLS.inc()
+
+    def on_output_token(self):
+        self.output_tokens += 1
+        _TOKENS.inc()
+
+    def on_decode_compile(self):
+        self.decode_compiles += 1
+        _COMPILES.labels(fn="decode").inc()
+
+    def on_prefill_compile(self):
+        self.prefill_compiles += 1
+        _COMPILES.labels(fn="prefill").inc()
+
     def on_decode_step(self, active_slots):
         self.decode_steps += 1
         self._occupancy_sum += active_slots
+        _DECODE_STEPS.inc()
+        self._active_gauge.set(active_slots)
+        # the throughput gauge updates on the WRITE path (here, once per
+        # step) so /metrics scrapes are live — not only when something
+        # happens to call to_dict()
+        if self.start_t is not None:
+            self._throughput_gauge.set(self.output_tokens
+                                       / max(now() - self.start_t, 1e-9))
         counter("serving.active_slots", active_slots)
 
     def to_dict(self):
-        wall = max(now() - self.start_t, 1e-9)
+        wall = (max(now() - self.start_t, 1e-9)
+                if self.start_t is not None else 0.0)
         occ = (self._occupancy_sum / (self.decode_steps * self.max_slots)
                if self.decode_steps else 0.0)
+        throughput = self.output_tokens / wall if wall else 0.0
         return {
             "requests_in": self.requests_in,
             "requests_finished": self.requests_finished,
@@ -136,6 +267,6 @@ class EngineMetrics:
             "decode_compiles": self.decode_compiles,
             "prefill_compiles": self.prefill_compiles,
             "wall_s": wall,
-            "throughput_tok_s": self.output_tokens / wall,
+            "throughput_tok_s": throughput,
             "slot_occupancy": occ,
         }

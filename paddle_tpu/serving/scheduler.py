@@ -62,8 +62,7 @@ class Request:
 
     def finish(self):
         self.state = RequestState.FINISHED
-        self.metrics.finish_t = now()
-        self.metrics.output_tokens = len(self.generated)
+        self.metrics.on_finish(now(), len(self.generated))
 
 
 class Scheduler:

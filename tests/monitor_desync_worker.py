@@ -1,0 +1,80 @@
+"""Worker for the 8-process collective-desync acceptance test.
+
+Every rank runs the same two warm collectives; then every rank EXCEPT
+``DESYNC_RANK`` issues a third allreduce while the desync rank skips it
+(wedged in other work — here, a barrier it reaches early). The healthy
+ranks hang waiting for the skipper's contribution, time out, and the
+flight recorder (monitor/flight_recorder.py) gathers ring buffers
+through the still-alive TCPStore and writes a postmortem naming the
+diverging rank and sequence number. Catching the enriched TimeoutError
+is this worker's SUCCESS path — exit 0 means the desync was detected.
+
+Spawned by tests/test_monitor.py with PADDLE_TRAINER_ID /
+PADDLE_TRAINERS_NUM / PADDLE_MASTER / PT_MONITOR_DUMP_DIR set.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+
+def main():
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    world = int(os.environ["PADDLE_TRAINERS_NUM"])
+    host, _, port = os.environ["PADDLE_MASTER"].partition(":")
+    desync_rank = int(os.environ.get("DESYNC_RANK", "3"))
+    op_timeout_s = float(os.environ.get("DESYNC_OP_TIMEOUT_S", "5"))
+
+    import numpy as np
+
+    from paddle_tpu.distributed.process_group import StoreProcessGroup
+    from paddle_tpu.distributed.store import TCPStore
+
+    # long timeout for bootstrap (8 ranks importing jax concurrently
+    # stagger by several seconds), short timeout for the collectives so
+    # the forced hang is detected quickly
+    store = TCPStore(host or "127.0.0.1", int(port),
+                     is_master=(rank == 0), timeout_s=180)
+    store.barrier("boot", world, timeout_s=180)
+    store.timeout_ms = int(op_timeout_s * 1000)
+    pg = StoreProcessGroup(store, rank, world)
+
+    # seq 0 / seq 1: everyone in lockstep
+    out = pg.allreduce(np.full((4,), float(rank), np.float32))
+    assert float(out[0]) == sum(range(world)), out
+    pg.allreduce(np.ones((8,), np.float32))
+
+    try:
+        if rank == desync_rank:
+            # the skipped collective: this rank never joins the third
+            # allreduce — it runs ahead to a barrier nobody else reaches
+            pg.barrier("after_work")
+        else:
+            pg.allreduce(np.ones((16,), np.float32))
+        print("DESYNC_NOT_DETECTED rank=%d" % rank, flush=True)
+        return 1
+    except TimeoutError as e:
+        msg = str(e)
+        print("DESYNC_CAUGHT rank=%d %s" % (rank, msg.splitlines()[0]),
+              flush=True)
+        # the enriched timeout must carry the diagnosis
+        if rank != desync_rank and "desync" not in msg:
+            print("NO_DIAGNOSIS_IN_MESSAGE rank=%d" % rank, flush=True)
+            return 2
+        return 0
+    finally:
+        if rank == 0:
+            # rank 0 hosts the store server: linger so the other ranks
+            # can finish gathering ring buffers through it
+            import time
+
+            time.sleep(float(os.environ.get(
+                "DESYNC_RANK0_LINGER_S", "8")))
+        try:
+            store.close()
+        except Exception:
+            pass
+
+
+if __name__ == "__main__":
+    sys.exit(main())

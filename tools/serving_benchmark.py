@@ -58,6 +58,12 @@ def _pct(values, q):
         if values else None
 
 
+def _pcts(values):
+    """Aggregate percentile row (p50/p90/p99) for the JSON artifact."""
+    return {"p50": _pct(values, 50), "p90": _pct(values, 90),
+            "p99": _pct(values, 99)}
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--preset", choices=sorted(PRESETS), default="tiny")
@@ -75,6 +81,9 @@ def main():
     ap.add_argument("--watchdog", type=int, default=1100)
     ap.add_argument("--out", default=os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "serving_bench.json"))
+    ap.add_argument("--monitor-out", default=None,
+                    help="also dump the monitor registry snapshot (with "
+                         "written_at metadata) to this JSON path")
     args = ap.parse_args()
     _watchdog(args.watchdog)
 
@@ -144,7 +153,7 @@ def main():
     occ_sum = (stats["slot_occupancy"] * stats["decode_steps"]
                - base["slot_occupancy"] * base["decode_steps"])
     meas_occupancy = occ_sum / meas_steps if meas_steps else 0.0
-    per_req = [eng.request_metrics(r) for r in ids]
+    per_req = [dict(eng.request_metrics(r), request_id=r) for r in ids]
     ttft = [m["ttft_s"] for m in per_req if m["ttft_s"] is not None]
     tpot = [m["tpot_s"] for m in per_req if m["tpot_s"] is not None]
     queue = [m["queue_time_s"] for m in per_req
@@ -167,9 +176,9 @@ def main():
         "wall_s": round(wall, 3),
         "warmup_compile_s": round(warmup_s, 3),
         "output_tokens": out_tokens,
-        "ttft_s": {"p50": _pct(ttft, 50), "p99": _pct(ttft, 99)},
-        "tpot_s": {"p50": _pct(tpot, 50), "p99": _pct(tpot, 99)},
-        "queue_time_s": {"p50": _pct(queue, 50), "p99": _pct(queue, 99)},
+        "ttft_s": _pcts(ttft),
+        "tpot_s": _pcts(tpot),
+        "queue_time_s": _pcts(queue),
         "preemptions": stats["preemptions"] - base["preemptions"],
         "decode_steps": meas_steps,
         "decode_compiles": stats["decode_compiles"],
@@ -177,12 +186,26 @@ def main():
         "slot_occupancy": round(meas_occupancy, 4),
         "requests_finished": stats["requests_finished"] - n_warm,
         "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        # raw per-request rows ride along with the aggregates so
+        # distribution questions don't need a re-run
+        "requests_detail": per_req,
     }
-    print(json.dumps(report), flush=True)
+    print(json.dumps({k: v for k, v in report.items()
+                      if k != "requests_detail"}), flush=True)
     with open(args.out, "w") as f:
         json.dump(report, f, indent=1)
         f.write("\n")
     print("wrote", args.out, flush=True)
+    if args.monitor_out:
+        from paddle_tpu import monitor
+
+        monitor.write_snapshot(args.monitor_out, meta={
+            "tool": "serving_benchmark", "preset": args.preset,
+            "backend": jax.default_backend(),
+            "measured_at": report["measured_at"],
+            "serving_throughput_tok_s": report["value"],
+        })
+        print("wrote", args.monitor_out, flush=True)
     # contract check: the whole staggered workload must have reused ONE
     # compiled decode step (the engine's core shape-stability claim)
     if stats["decode_compiles"] != 1:

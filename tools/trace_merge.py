@@ -1,0 +1,106 @@
+"""Merge per-rank chrome traces into ONE clock-aligned timeline.
+
+Front-end for paddle_tpu.monitor.trace_merge: collect the per-rank
+trace files a multi-process run produced (profiler.export_chrome_tracing
+per rank, usually named ``*rank{r}*.json`` or ``worker_{r}.json``),
+apply the per-rank clock offsets estimated at run time
+(``clock_rank{r}.json``, written by
+monitor.trace_merge.estimate_clock_offset + write_clock_file), and emit
+a single merged trace with rank-prefixed pids — open it in
+Perfetto/chrome://tracing to read cross-rank comm/compute overlap.
+
+Usage:
+  python tools/trace_merge.py --dir traces/ --out merged.json
+  python tools/trace_merge.py --out merged.json r0.json r1.json ...
+      (rank inferred from the last integer in each filename)
+  python tools/trace_merge.py --out m.json 0=a.json 1=b.json.gz
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), ".."))
+
+from paddle_tpu.monitor import trace_merge as tm  # noqa: E402
+
+
+def collect_inputs(args):
+    paths_by_rank = {}
+    offsets = {}
+    skipped = []
+    if args.dir:
+        pats = ("*.trace.json", "*.json", "*.json.gz")
+        seen = set()
+        for pat in pats:
+            for path in sorted(glob.glob(os.path.join(args.dir, pat))):
+                base = os.path.basename(path)
+                if base.startswith("clock_rank") or path in seen \
+                        or os.path.abspath(path) == \
+                        os.path.abspath(args.out):
+                    continue
+                seen.add(path)
+                rank = tm.rank_of_path(path)
+                if rank is None:
+                    skipped.append((path, "no rank in filename"))
+                    continue
+                if rank in paths_by_rank:
+                    skipped.append((path, "rank %d already provided by "
+                                    "%s" % (rank, paths_by_rank[rank])))
+                    continue
+                paths_by_rank[rank] = path
+        offsets = tm.load_clock_offsets(args.dir)
+    # a silently dropped file means the merged timeline is missing a
+    # whole rank — always say what was excluded and why
+    for path, why in skipped:
+        print("trace_merge: SKIPPING %s (%s) — pass RANK=path "
+              "explicitly to include it" % (path, why),
+              file=sys.stderr)
+    for spec in args.traces:
+        if "=" in spec:
+            r, _, path = spec.partition("=")
+            rank = int(r)
+        else:
+            path = spec
+            rank = tm.rank_of_path(spec)
+            if rank is None:
+                rank = len(paths_by_rank)
+        paths_by_rank[rank] = path
+        d = os.path.dirname(os.path.abspath(path))
+        for rk, off in tm.load_clock_offsets(d).items():
+            offsets.setdefault(rk, off)
+    return paths_by_rank, offsets
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="merge per-rank chrome traces into one aligned "
+                    "timeline")
+    ap.add_argument("traces", nargs="*",
+                    help="trace files, optionally RANK=path")
+    ap.add_argument("--dir", help="directory holding per-rank traces "
+                                  "(+ clock_rank*.json offsets)")
+    ap.add_argument("--out", required=True, help="merged trace path")
+    ap.add_argument("--no-offsets", action="store_true",
+                    help="skip clock alignment (raw per-rank clocks)")
+    args = ap.parse_args(argv)
+
+    paths_by_rank, offsets = collect_inputs(args)
+    if not paths_by_rank:
+        ap.error("no input traces found")
+    if args.no_offsets:
+        offsets = {}
+    n = tm.merge_trace_files(paths_by_rank, args.out, offsets)
+    print("merged %d events from %d rank(s) -> %s"
+          % (n, len(paths_by_rank), args.out))
+    for r in sorted(paths_by_rank):
+        print("  rank %d: %s (offset %+.0f us)"
+              % (r, paths_by_rank[r], offsets.get(r, 0.0) * 1e6))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

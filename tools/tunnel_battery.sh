@@ -80,11 +80,15 @@ run bench_all_fused 1500 env BENCH_FUSE=1 FLAGS_fused_lm_head_ce=1 \
 run model_int8 1200 python tools/model_benchmark.py llama_int8
 
 # 5b. continuous-batching serving row: paged KV + ragged paged-attention
-#     decode under Poisson arrivals (tok/s, TTFT/TPOT p50/p99,
-#     preemptions -> committed JSON artifact)
+#     decode under Poisson arrivals (tok/s, TTFT/TPOT p50/p90/p99,
+#     preemptions -> committed JSON artifact). Also emits the monitor
+#     registry snapshot with written_at metadata — the staleness witness
+#     for this battery run (VERDICT r5: BENCH_r05 went stale silently;
+#     a snapshot artifact dated by the run itself makes that detectable)
 run serving 1200 python tools/serving_benchmark.py --preset llama1b \
     --requests 64 --rate 8 --max-slots 8 --num-blocks 512 \
-    --out tools/serving_bench.json
+    --out tools/serving_bench.json \
+    --monitor-out tools/monitor_snapshot.json
 
 # 6. 7B-shape layer microbench (refines the pod projection)
 run llama7b_micro 900 python tools/llama7b_plan.py --microbench
