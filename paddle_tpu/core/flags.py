@@ -30,6 +30,11 @@ _DEFAULTS = {
     # lm_head+CE kernel (kernels/fused_ce.py) on compiled training
     # steps. Interpret-mode exact; default off until an on-chip window
     # validates the Mosaic compile + timing (tunnel battery probes it).
+    # COMPILED-STEP ONLY: the eager tape structurally cannot fuse (it
+    # cannot differentiate through the kernel's custom_vjp) and takes
+    # the unfused materialized-logits path with a loud one-time warning
+    # — an eager-vs-compiled A/B under this flag compares different
+    # loss tails and must not be read as a kernel speedup/slowdown.
     "FLAGS_fused_lm_head_ce": False,
     # dropout mask PRNG implementation: 'threefry' (default, the global
     # splittable PRNG) or 'rbg' (the TPU hardware RNG instruction —

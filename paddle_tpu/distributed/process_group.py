@@ -31,8 +31,45 @@ import struct
 import numpy as np
 
 from ..monitor import flight_recorder as _fr
+from ..monitor import watchdog as _wd
 
 _DONE = "/~done"
+
+# watchdog heartbeat bracketing every collective (outermost call only,
+# mirroring the flight recorder): while a rank waits on a peer the
+# watchdog sees "in collective <op> gseq=N for Xs", and the cross-rank
+# postmortem can tell a rank wedged inside a collective from one that
+# never reached it ("between steps")
+_HB_COLL = _wd.heartbeat("collectives")
+
+
+class _CollectiveSpan:
+    """Compound context: flight-recorder entry + watchdog busy bracket
+    carrying the entry's seq/gseq so stall reports name the in-flight
+    collective position."""
+
+    __slots__ = ("_rec_cm", "_op", "_pg", "_busy")
+
+    def __init__(self, rec_cm, op, pg):
+        self._rec_cm = rec_cm
+        self._op = op
+        self._pg = pg
+
+    def __enter__(self):
+        entry = self._rec_cm.__enter__()
+        info = {"op": self._op, "group": self._pg.prefix,
+                "rank": self._pg.rank,
+                "world_size": self._pg.world_size}
+        if entry is not None:
+            info["seq"] = entry["seq"]
+            info["gseq"] = entry["gseq"]
+        self._busy = _HB_COLL.busy("collective.%s" % self._op, **info)
+        self._busy.__enter__()
+        return entry
+
+    def __exit__(self, *exc):
+        self._busy.__exit__(*exc)
+        return self._rec_cm.__exit__(*exc)
 
 
 def _encode(arr):
@@ -94,13 +131,16 @@ class StoreProcessGroup:
 
     def _rec(self, op, arr=None, reduce_op=None, strict_shape=False):
         """Flight-record one collective (outermost call only — allreduce
-        lowers to allgather and must not double-record)."""
+        lowers to allgather and must not double-record) AND bracket it
+        with the watchdog heartbeat so a stalled wait is attributable to
+        this op/seq."""
         a = None if arr is None else np.asarray(arr)
-        return self._recorder.record(
+        rec_cm = self._recorder.record(
             op, reduce_op=reduce_op,
             shape=None if a is None else a.shape,
             dtype=None if a is None else a.dtype.name,
             group=self.prefix, strict_shape=strict_shape)
+        return _CollectiveSpan(rec_cm, op, self)
 
     def _get(self, key, timeout_s=None, postmortem=True):
         data = self.store.get(key, timeout_s)
@@ -244,8 +284,12 @@ class StoreProcessGroup:
         key = "%s/p2p/%d.%d/%d" % (self.prefix, src, self.rank, n)
         # no desync postmortem on p2p: only the (src, dst) pair is
         # involved — a world-wide ring-buffer diff of a stalled send
-        # would falsely name every uninvolved rank as diverging
-        out = self._get(key, timeout_s, postmortem=False)
+        # would falsely name every uninvolved rank as diverging. The
+        # watchdog bracket (no gseq) still makes a stalled recv visible
+        # on /healthz without entering the collective-stream diagnosis.
+        with _HB_COLL.busy("p2p.recv", src=src, dst=self.rank,
+                           group=self.prefix):
+            out = self._get(key, timeout_s, postmortem=False)
         self.store.delete(key)
         return out
 

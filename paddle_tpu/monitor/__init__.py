@@ -17,6 +17,15 @@ Three pillars (one registry, one postmortem path, one timeline):
 3. **Multi-rank trace merge** (monitor/trace_merge.py +
    tools/trace_merge.py): store-based clock-offset estimation and
    rank-prefixed chrome-trace aggregation into one aligned timeline.
+
+4. **Progress watchdog** (monitor/watchdog.py): heartbeat registry fed
+   by the compiled train step, the serving engine loop, and store
+   collectives; a daemon thread (``start_watchdog()`` / ``PT_WATCHDOG``)
+   turns a stalled heartbeat into a cross-rank diagnostic bundle
+   (all-thread stacks + flight ring + metric snapshot + heartbeat ages)
+   naming the stalled or dead rank, and serves /healthz + /debugz/*
+   live on the fleet KV HTTP server. Flight recorder = TIMEOUT-
+   triggered; watchdog = PROGRESS-triggered.
 """
 from __future__ import annotations
 
@@ -45,8 +54,18 @@ from .flight_recorder import (  # noqa: F401
     diagnose,
     get_flight_recorder,
 )
+from .watchdog import (  # noqa: F401
+    Heartbeat,
+    build_bundle,
+    diagnose_bundles,
+    heartbeat,
+    is_watchdog_running,
+    start_watchdog,
+    stop_watchdog,
+)
 from . import flight_recorder  # noqa: F401
 from . import trace_merge  # noqa: F401
+from . import watchdog  # noqa: F401
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "Registry",
@@ -55,5 +74,7 @@ __all__ = [
     "MetricsServer", "snapshot", "write_snapshot",
     "start_metrics_server", "stop_metrics_server",
     "FlightRecorder", "get_flight_recorder", "diagnose",
-    "flight_recorder", "trace_merge",
+    "Heartbeat", "heartbeat", "start_watchdog", "stop_watchdog",
+    "is_watchdog_running", "build_bundle", "diagnose_bundles",
+    "flight_recorder", "trace_merge", "watchdog",
 ]

@@ -3,10 +3,20 @@
 The HTTP side rides the existing fleet KV server
 (distributed/fleet/utils/http_server.py) rather than growing a second
 server stack: ``KVHTTPServer`` gained a ``get_routes`` hook, and
-``MetricsServer`` registers two routes on it —
+``MetricsServer`` registers the telemetry routes on it —
 
     GET /metrics        Prometheus text exposition (scrape target)
     GET /metrics.json   JSON snapshot (tools, dashboards, bench artifacts)
+    GET /healthz        ok|stalled verdict + heartbeat ages (503 when
+                        stalled — load-balancer/probe friendly)
+    GET /debugz/stacks  live all-thread Python stack dump
+    GET /debugz/flight  this rank's collective flight-recorder ring
+    GET /debugz/bundle  full on-demand diagnostic bundle (stacks +
+                        flight ring + metrics + heartbeat ages)
+
+The /healthz and /debugz routes are served live from monitor/watchdog.py
+whether or not the watchdog thread is running (the verdict just reads
+"watchdog: disabled" when it is not).
 
 Snapshot artifacts (``write_snapshot``) carry metadata —
 ``written_at``/``pid``/caller-supplied context — so bench staleness is
@@ -19,6 +29,7 @@ import json
 import os
 import time
 
+from . import watchdog as _watchdog
 from .registry import get_registry
 
 
@@ -61,8 +72,13 @@ class MetricsServer:
 
         self._registry = registry or get_registry()
         self._kv = KVServer(port)
-        self._kv.http_server.get_routes["metrics"] = self._prometheus
-        self._kv.http_server.get_routes["metrics.json"] = self._json
+        routes = self._kv.http_server.get_routes
+        routes["metrics"] = self._prometheus
+        routes["metrics.json"] = self._json
+        routes["healthz"] = _watchdog.http_healthz
+        routes["debugz/stacks"] = _watchdog.http_stacks
+        routes["debugz/flight"] = _watchdog.http_flight
+        routes["debugz/bundle"] = _watchdog.http_bundle
 
     @property
     def port(self):

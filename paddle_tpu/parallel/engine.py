@@ -53,6 +53,10 @@ _TRAIN_COMPILES = _monitor.counter(
 _DEV_MEM = _monitor.gauge(
     "device_memory_bytes", "device allocator stats (first local device)",
     labelnames=("stat",))
+# watchdog heartbeat: each compiled call runs inside a busy bracket so
+# a hung dispatch (wedged tunnel, XLA deadlock) is a detectable stall
+# while the idle time BETWEEN steps never is (monitor/watchdog.py)
+_HB_TRAIN = _monitor.heartbeat("train_step")
 
 
 def _batch_tokens(vals, stacked=False):
@@ -382,11 +386,13 @@ class CompiledTrainStep:
         from ..framework import random as _random
 
         t0 = time.perf_counter()
-        loss, new_state, new_opt = self._compiled_multi(
-            state_vals, self._opt_state,
-            jnp.asarray(self._step_count + 1, jnp.int32),
-            jnp.asarray(self.optimizer.get_lr(), jnp.float32),
-            _random._key(), vals)
+        with _HB_TRAIN.busy("train.run_steps", steps=k,
+                            step0=self._step_count + 1):
+            loss, new_state, new_opt = self._compiled_multi(
+                state_vals, self._opt_state,
+                jnp.asarray(self._step_count + 1, jnp.int32),
+                jnp.asarray(self.optimizer.get_lr(), jnp.float32),
+                _random._key(), vals)
         _record_step(vals, k, time.perf_counter() - t0, stacked=True)
         self._step_count += k
         for n, v in zip(self._names, new_state):
@@ -466,11 +472,12 @@ class CompiledTrainStep:
 
         self._step_count += 1
         t0 = time.perf_counter()
-        loss, new_state, new_opt = self._compiled(
-            state_vals, self._opt_state,
-            jnp.asarray(self._step_count, jnp.int32),
-            jnp.asarray(self.optimizer.get_lr(), jnp.float32),
-            _random._key(), vals)
+        with _HB_TRAIN.busy("train.step", step=self._step_count):
+            loss, new_state, new_opt = self._compiled(
+                state_vals, self._opt_state,
+                jnp.asarray(self._step_count, jnp.int32),
+                jnp.asarray(self.optimizer.get_lr(), jnp.float32),
+                _random._key(), vals)
         _record_step(vals, 1, time.perf_counter() - t0)
         for n, v in zip(self._names, new_state):
             tensors[n]._value = v

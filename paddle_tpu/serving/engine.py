@@ -31,9 +31,16 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import monitor as _monitor
 from .kv_cache import PagedDecodeView, PagedKVCache, PagedPrefillView
 from .metrics import EngineMetrics, now, span
 from .scheduler import Request, RequestState, Scheduler
+
+# watchdog heartbeat (monitor/watchdog.py): every engine iteration runs
+# inside a busy bracket, so a scheduler deadlock or a hung decode
+# dispatch is a detectable stall; an engine with no queued work is idle,
+# never stalled
+_HB_SERVE = _monitor.heartbeat("serving_engine")
 
 
 class Engine:
@@ -106,17 +113,19 @@ class Engine:
     def step(self):
         """One engine iteration: admit+prefill, grow pages (preempting
         on exhaustion), one batched decode step. Returns has_work()."""
-        self._admit_and_prefill()
-        self._grow_or_preempt()
-        active = self.scheduler.active()
-        if active:
-            self._decode_once(active)
+        with _HB_SERVE.busy("serving.step"):
+            self._admit_and_prefill()
+            self._grow_or_preempt()
+            active = self.scheduler.active()
+            if active:
+                self._decode_once(active)
         return self.has_work()
 
     def run(self):
         """Drain all queued work; returns {request_id: generated tokens}."""
-        while self.step():
-            pass
+        with _HB_SERVE.busy("serving.run"):
+            while self.step():
+                pass
         return {rid: list(r.generated) for rid, r in self.requests.items()}
 
     def output(self, rid):

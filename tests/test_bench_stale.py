@@ -1,0 +1,63 @@
+"""bench.py stale re-emit provenance: a multi-round photocopy chain
+(BENCH_r05 was round 4's number re-emitted) must be visible from the
+artifact alone via ``stale_generations`` + ``stale_since``."""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import bench  # noqa: E402
+
+
+def _write_good(path, **extra):
+    rec = {"metric": "llama_decoder_train_tokens_per_sec_per_chip",
+           "value": 12345.6, "unit": "tokens/s",
+           "measured_at": "2026-08-01T00:00:00Z", "backend": "tpu"}
+    rec.update(extra)
+    with open(path, "w") as f:
+        json.dump(rec, f)
+    return rec
+
+
+class TestStaleChain:
+    def test_generations_accumulate_across_reemits(self, tmp_path,
+                                                   monkeypatch, capsys):
+        last = tmp_path / "BENCH_LAST_GOOD.json"
+        _write_good(str(last))
+        monkeypatch.setattr(bench, "LAST_GOOD", str(last))
+
+        rc = bench._emit_stale("tunnel wedged (test)")
+        assert rc == 0
+        out1 = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert out1["stale"] is True
+        assert out1["stale_generations"] == 1
+        assert out1["stale_since"] == "2026-08-01T00:00:00Z"
+        assert out1["value"] == 12345.6
+
+        # the chain survives a process restart: the incremented counter
+        # was persisted back into LAST_GOOD
+        rc = bench._emit_stale("still wedged (test)")
+        assert rc == 0
+        out2 = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert out2["stale_generations"] == 2
+        assert out2["stale_since"] == "2026-08-01T00:00:00Z"
+        assert out2["stale_reason"] == "still wedged (test)"
+        persisted = json.loads(last.read_text())
+        assert persisted["stale_generations"] == 2
+
+    def test_fresh_record_has_no_stale_markers(self, tmp_path,
+                                               monkeypatch, capsys):
+        """A record that was never re-emitted carries none of the
+        photocopy keys — their PRESENCE is the staleness signal."""
+        last = tmp_path / "BENCH_LAST_GOOD.json"
+        rec = _write_good(str(last))
+        assert "stale" not in rec and "stale_generations" not in rec
+
+    def test_no_last_good_is_a_hard_failure(self, tmp_path, monkeypatch):
+        monkeypatch.setattr(bench, "LAST_GOOD",
+                            str(tmp_path / "missing.json"))
+        assert bench._emit_stale("nothing persisted (test)") == 3

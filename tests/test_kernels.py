@@ -367,3 +367,63 @@ class TestFusedCE:
                 fl.set_flags({"FLAGS_fused_lm_head_ce": False})
 
         np.testing.assert_allclose(run(True), run(False), rtol=2e-4)
+
+    def test_eager_with_flag_warns_loudly_once(self, monkeypatch):
+        """A flag-enabled EAGER forward structurally cannot fuse (the
+        eager tape never sees the custom_vjp): the gate must warn — once
+        per process — so eager-vs-compiled A/Bs under the flag aren't
+        silently comparing different loss tails."""
+        import warnings
+
+        from paddle_tpu.core import flags as fl
+        from paddle_tpu.kernels import fused_ce
+
+        monkeypatch.setattr(fused_ce, "_eager_unfused_warned", False)
+        hv = jnp.zeros((2, 128, 8), jnp.float32)   # concrete = eager
+        fl.set_flags({"FLAGS_fused_lm_head_ce": True})
+        try:
+            with pytest.warns(UserWarning, match="EAGER"):
+                assert fused_ce.fused_ce_applies(hv, False) is False
+            # once-latch: the second eager call stays quiet
+            with warnings.catch_warnings():
+                warnings.simplefilter("error")
+                assert fused_ce.fused_ce_applies(hv, False) is False
+        finally:
+            fl.set_flags({"FLAGS_fused_lm_head_ce": False})
+
+    def test_flag_off_or_traced_no_warning(self, monkeypatch):
+        import warnings
+
+        from paddle_tpu.core import flags as fl
+        from paddle_tpu.kernels import fused_ce
+
+        monkeypatch.setattr(fused_ce, "_eager_unfused_warned", False)
+        hv = jnp.zeros((2, 128, 8), jnp.float32)
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            # flag off: eager fallback is the EXPECTED path, no warning
+            assert fused_ce.fused_ce_applies(hv, False) is False
+        fl.set_flags({"FLAGS_fused_lm_head_ce": True})
+        try:
+            with warnings.catch_warnings():
+                warnings.simplefilter("error")
+                # non-tiling token count: compiled would not fuse
+                # either, so warning "use a compiled step" would be
+                # false advice — and it must not burn the once-latch
+                bad = jnp.zeros((3, 11, 8), jnp.float32)
+                assert fused_ce.fused_ce_applies(bad, False) is False
+            assert fused_ce._eager_unfused_warned is False
+        finally:
+            fl.set_flags({"FLAGS_fused_lm_head_ce": False})
+        fl.set_flags({"FLAGS_fused_lm_head_ce": True})
+        try:
+            with warnings.catch_warnings():
+                warnings.simplefilter("error")
+                # traced value: the fused path applies, nothing to warn
+                out = []
+                jax.make_jaxpr(
+                    lambda x: out.append(
+                        fused_ce.fused_ce_applies(x, False)) or x)(hv)
+                assert out == [True]
+        finally:
+            fl.set_flags({"FLAGS_fused_lm_head_ce": False})

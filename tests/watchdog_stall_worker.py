@@ -1,0 +1,93 @@
+"""Worker for the multi-process forced-stall watchdog acceptance test.
+
+Every rank starts the watchdog (tight thresholds), runs two lockstep
+allreduces, then rank ``STALL_RANK`` falls asleep BETWEEN steps while
+the others enter a third allreduce and block waiting for its
+contribution. Their heartbeats stop advancing inside the collective
+busy bracket, the watchdogs fire, publish a bundle request through the
+TCPStore, gather every rank's bundle (the sleeper's daemon thread
+answers while its main thread sleeps — that is how the postmortem gets
+the guilty stack), and write ``watchdog_postmortem_rank{r}.json``
+naming the stalled rank. The sleeper then wakes, joins the collective,
+and every rank exits 0 — the stall episode leaves diagnostics, not
+corpses.
+
+Spawned by tests/test_watchdog.py with PADDLE_TRAINER_ID /
+PADDLE_TRAINERS_NUM / PADDLE_MASTER / PT_MONITOR_DUMP_DIR set.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+
+def main():
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    world = int(os.environ["PADDLE_TRAINERS_NUM"])
+    host, _, port = os.environ["PADDLE_MASTER"].partition(":")
+    stall_rank = int(os.environ.get("STALL_RANK", "2"))
+    sleep_s = float(os.environ.get("STALL_SLEEP_S", "12"))
+
+    import numpy as np
+
+    from paddle_tpu import monitor
+    from paddle_tpu.distributed.process_group import (
+        StoreProcessGroup,
+        set_world_group,
+    )
+    from paddle_tpu.distributed.store import TCPStore
+
+    # generous store timeout: the healthy ranks must keep waiting in the
+    # collective well past the watchdog's stall threshold — the WATCHDOG
+    # is what diagnoses this hang, not a collective TimeoutError
+    store = TCPStore(host or "127.0.0.1", int(port),
+                     is_master=(rank == 0), timeout_s=180)
+    store.barrier("boot", world, timeout_s=180)
+    pg = StoreProcessGroup(store, rank, world)
+    set_world_group(pg)
+
+    monitor.start_watchdog(
+        stall_threshold_s=float(os.environ.get("WD_STALL_S", "1.5")),
+        poll_interval_s=0.3,
+        grace_s=float(os.environ.get("WD_GRACE_S", "4")))
+
+    # gseq 0 / gseq 1: everyone in lockstep
+    out = pg.allreduce(np.full((4,), float(rank), np.float32))
+    assert float(out[0]) == sum(range(world)), out
+    pg.allreduce(np.ones((8,), np.float32))
+
+    if rank == stall_rank:
+        # the forced stall: asleep BETWEEN steps while the others wait
+        # in the collective. The watchdog daemon thread stays alive and
+        # answers the peers' bundle request with this rank's stack.
+        time.sleep(sleep_s)
+    out = pg.allreduce(np.ones((16,), np.float32))
+    assert float(out[0]) == world, out
+
+    # the postmortem is written by the detecting (healthy) ranks during
+    # the stall window; give a final settle tick then report
+    deadline = time.time() + 10
+    ppath = os.path.join(os.environ["PT_MONITOR_DUMP_DIR"],
+                         "watchdog_postmortem_rank%d.json" % rank)
+    if rank != stall_rank:
+        while time.time() < deadline and not os.path.exists(ppath):
+            time.sleep(0.2)
+        if not os.path.exists(ppath):
+            print("NO_POSTMORTEM rank=%d" % rank, flush=True)
+            return 1
+    print("STALL_RUN_OK rank=%d" % rank, flush=True)
+    if rank == 0:
+        # rank 0 hosts the store server: linger so slower ranks finish
+        # their final store traffic through it
+        time.sleep(float(os.environ.get("STALL_RANK0_LINGER_S", "6")))
+    monitor.stop_watchdog()
+    try:
+        store.close()
+    except Exception:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

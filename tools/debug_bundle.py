@@ -1,0 +1,122 @@
+"""Fetch/merge watchdog diagnostic bundles across ranks.
+
+Two sources, one merged artifact:
+
+  # live: GET /debugz/bundle from each rank's fleet KV HTTP server
+  python tools/debug_bundle.py fetch --endpoint host:port \
+      [--endpoint host:port ...] --out merged.json
+
+  # postmortem: merge the watchdog_bundle_rank*.json files a stalled
+  # run left in its PT_MONITOR_DUMP_DIR
+  python tools/debug_bundle.py merge --dir DUMP_DIR --out merged.json
+
+The merged artifact is ``{bundles: {rank: bundle}, diagnosis: ...}``
+where the diagnosis is monitor.watchdog.diagnose_bundles — the same
+stalled/dead-rank naming the in-run cross-rank postmortem performs, so
+an operator pulling bundles by hand and the watchdog's own gather agree
+on the verdict.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from paddle_tpu.monitor.watchdog import (  # noqa: E402
+    diagnose_bundles,
+    summarize_postmortem,
+)
+
+
+def fetch_endpoint(endpoint, timeout_s=10.0):
+    """GET /debugz/bundle from one rank's server; returns the bundle."""
+    url = endpoint if "://" in endpoint else "http://" + endpoint
+    with urllib.request.urlopen(url.rstrip("/") + "/debugz/bundle",
+                                timeout=timeout_s) as r:
+        return json.loads(r.read().decode())
+
+
+def load_dir(dump_dir):
+    """{rank: bundle} from watchdog_bundle_rank*.json files."""
+    bundles = {}
+    for path in sorted(glob.glob(os.path.join(
+            dump_dir, "watchdog_bundle_rank*.json"))):
+        m = re.search(r"rank(\d+)\.json$", path)
+        if not m:
+            continue
+        try:
+            with open(path) as f:
+                bundles[int(m.group(1))] = json.load(f)
+        except (OSError, ValueError) as e:
+            print("skipping %s: %s" % (path, e), file=sys.stderr)
+    return bundles
+
+
+def merge(bundles, world_size=None):
+    if world_size is None:
+        sizes = [b.get("world_size") for b in bundles.values()
+                 if b.get("world_size")]
+        world_size = max(sizes) if sizes else (
+            max(bundles) + 1 if bundles else 0)
+    diagnosis = diagnose_bundles(bundles, world_size)
+    return {
+        "kind": "watchdog_bundle_merged",
+        "merged_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "world_size": world_size,
+        "ranks": sorted(bundles),
+        "diagnosis": diagnosis,
+        "bundles": {str(r): b for r, b in sorted(bundles.items())},
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    f = sub.add_parser("fetch", help="GET /debugz/bundle from live ranks")
+    f.add_argument("--endpoint", action="append", required=True,
+                   help="host:port of a rank's fleet KV/metrics server "
+                        "(repeatable)")
+    f.add_argument("--timeout", type=float, default=10.0)
+    f.add_argument("--out", required=True)
+    f.add_argument("--world-size", type=int)
+    m = sub.add_parser("merge", help="merge on-disk bundle files")
+    m.add_argument("--dir", required=True,
+                   help="PT_MONITOR_DUMP_DIR of the stalled run")
+    m.add_argument("--out", required=True)
+    m.add_argument("--world-size", type=int)
+    a = ap.parse_args(argv)
+
+    if a.cmd == "fetch":
+        bundles = {}
+        for ep in a.endpoint:
+            try:
+                b = fetch_endpoint(ep, a.timeout)
+            except Exception as e:
+                print("endpoint %s unreachable: %s" % (ep, e),
+                      file=sys.stderr)
+                continue
+            bundles[int(b.get("rank", len(bundles)))] = b
+    else:
+        bundles = load_dir(a.dir)
+    if not bundles:
+        print("no bundles found", file=sys.stderr)
+        return 2
+    out = merge(bundles, a.world_size)
+    with open(a.out, "w") as f:
+        json.dump(out, f, indent=1, default=str)
+        f.write("\n")
+    print("merged %d bundle(s) -> %s" % (len(bundles), a.out))
+    print(summarize_postmortem(out["diagnosis"]))
+    return 0 if out["diagnosis"].get("status") in ("ok",
+                                                   "inconclusive") else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -15,6 +15,14 @@ Usage:
   python tools/op_benchmark.py run  [--out FILE]      # measure
   python tools/op_benchmark.py check --baseline FILE [--tolerance 0.15]
   python tools/op_benchmark.py update --baseline FILE # refresh baseline
+
+Both `check` and `update` print a COVERAGE summary (how many of the
+measured cases the baseline actually guards) and list every UNGUARDED
+row — a case with no baseline entry passes the gate vacuously, which is
+how the committed TPU baseline quietly guarded only 8 of 44 cases.
+`--strict-coverage` turns any unguarded row into a nonzero exit (the
+tunnel battery's update row runs with it, so a partial refresh can
+never masquerade as a full one).
 """
 from __future__ import annotations
 
@@ -357,7 +365,15 @@ def run_bench(out_path=None):
                           iters=50)
     results["overhead_ms"] = round(overhead, 4)
     for name, (args, body) in sorted(cases.items()):
-        ms = _time_case(args, body)
+        try:
+            ms = _time_case(args, body)
+        except Exception as e:
+            # a crashed case must not kill the whole sweep — it shows
+            # up as an UNGUARDED/MISSING row in the coverage report
+            # instead of silently erasing every case after it
+            results.setdefault("failed", {})[name] = repr(e)[:300]
+            print("%-36s FAILED: %r" % (name, e))
+            continue
         results["ops"][name] = round(max(ms - overhead, 1e-4), 4)
         print("%-36s %8.3f ms" % (name, results["ops"][name]))
     if out_path:
@@ -399,7 +415,33 @@ def check_result(current, baseline, tolerance=0.15):
     return ok, lines
 
 
-def main():
+def coverage_report(current_names, baseline, strict=False):
+    """The anti-vacuous-pass report: which measured cases the baseline
+    actually guards. Platform-independent (it compares NAMES — a
+    platform-mismatched check skips the timing gate but must still
+    scream about rows nobody guards anywhere). Returns
+    (ok, unguarded_names, report_lines); ok is False only under
+    ``strict`` with a non-empty unguarded list."""
+    current_names = set(current_names)
+    base_names = set(baseline.get("ops", {}))
+    guarded = sorted(current_names & base_names)
+    unguarded = sorted(current_names - base_names)
+    lines = ["COVERAGE baseline guards %d of %d measured cases"
+             % (len(guarded), len(current_names))]
+    for name in unguarded:
+        lines.append("UNGUARDED  %-36s (no baseline entry — the gate "
+                     "passes vacuously)" % name)
+    if unguarded:
+        lines.append("%d unguarded row(s)%s"
+                     % (len(unguarded),
+                        " — FAILING (--strict-coverage)" if strict
+                        else "; run `update` in an on-chip window or "
+                             "pass --strict-coverage to enforce"))
+    ok = not (strict and unguarded)
+    return ok, unguarded, lines
+
+
+def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("cmd", choices=["run", "check", "update"])
     ap.add_argument("--out")
@@ -407,19 +449,49 @@ def main():
                     default=os.path.join(os.path.dirname(__file__),
                                          "op_bench_baseline.json"))
     ap.add_argument("--tolerance", type=float, default=0.15)
-    a = ap.parse_args()
+    ap.add_argument("--strict-coverage", action="store_true",
+                    help="exit nonzero when any measured case has no "
+                         "baseline entry (unguarded rows pass the "
+                         "regression gate vacuously)")
+    a = ap.parse_args(argv)
     if a.cmd == "run":
-        run_bench(a.out)
+        cur = run_bench(a.out)
+        # the sweep survives a crashed case (partial artifact beats
+        # none), but the exit code stays loud about it
+        if cur.get("failed"):
+            print("%d case(s) FAILED: %s"
+                  % (len(cur["failed"]), sorted(cur["failed"])))
+            return 1
         return 0
     if a.cmd == "update":
-        run_bench(a.baseline)
+        # measure FIRST, gate, then write: a mid-sweep crash (strict or
+        # not — pre-resilient-sweep behavior was crash-before-write)
+        # must not replace the committed baseline with a narrowed one
+        # that every later non-strict check would pass vacuously
+        cur = run_bench(None)
+        all_names = set(cur.get("ops", {})) | set(cur.get("failed", {}))
+        cov_ok, _, cov_lines = coverage_report(
+            all_names, cur, strict=a.strict_coverage)
+        print("\n".join(cov_lines))
+        if cur.get("failed") or not cov_ok:
+            print("baseline NOT written (%s): %s"
+                  % ("case(s) crashed" if cur.get("failed")
+                     else "coverage gate failed", a.baseline))
+            return 1
+        with open(a.baseline, "w") as f:
+            json.dump(cur, f, indent=1, sort_keys=True)
+        print("wrote", a.baseline)
         return 0
     cur = run_bench(None)
     with open(a.baseline) as f:
         base = json.load(f)
     ok, lines = check_result(cur, base, a.tolerance)
     print("\n".join(lines) or "all ops within tolerance")
-    return 0 if ok else 1
+    cov_ok, _, cov_lines = coverage_report(
+        set(cur.get("ops", {})) | set(cur.get("failed", {})), base,
+        strict=a.strict_coverage)
+    print("\n".join(cov_lines))
+    return 0 if (ok and cov_ok) else 1
 
 
 if __name__ == "__main__":

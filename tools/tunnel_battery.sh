@@ -28,8 +28,19 @@ run() {  # run <name> <timeout> <cmd...>
 # 0. pre-flight: bail fast if the tunnel is actually wedged
 run probe 240 python bench.py --probe || { echo "tunnel wedged; abort"; exit 3; }
 
+# Watchdog harness for the long train/serving rows: if a row hangs
+# inside its timeout window, the in-process watchdog
+# (paddle_tpu/monitor/watchdog.py) dumps a diagnostic bundle
+# (all-thread stacks + flight ring + metrics) into $LOG and keeps a
+# last-tick /healthz artifact there — a wedge leaves a diagnosis, not a
+# bare `timeout` rc=124. Threshold 300s clears the worst-case compile.
+wd() {  # wd <row-name> -> env-var prefix for a watchdog-monitored row
+  echo "PT_WATCHDOG=1 PT_WATCHDOG_STALL_S=300 PT_MONITOR_DUMP_DIR=$LOG \
+PT_WATCHDOG_HEALTHZ_OUT=$LOG/$1_healthz.json"
+}
+
 # 1. flagship number (single-step for vs_baseline + run_steps headline)
-run bench 1500 python bench.py
+run bench 1500 env $(wd bench) python bench.py
 
 # 2. north-star model rows (resnet both layouts, ernie fused, widedeep,
 #    llama1b MFU row)
@@ -38,8 +49,11 @@ run model_ernie 900 python tools/model_benchmark.py ernie_dp
 run model_llama1b 1200 python tools/model_benchmark.py llama1b
 run model_widedeep 600 python tools/model_benchmark.py widedeep
 
-# 3. op baseline refresh: 44 rows (the reference-style CI gate)
-run op_update 1800 python tools/op_benchmark.py update
+# 3. op baseline refresh: 44 rows (the reference-style CI gate).
+#    --strict-coverage: a case that crashed mid-sweep leaves an
+#    unguarded row and fails the battery row instead of silently
+#    committing a baseline that guards only what happened to finish
+run op_update 1800 python tools/op_benchmark.py update --strict-coverage
 
 # 4. step ablations (fixed grad threading; resnet layout tax; ernie
 #    dropout/attention attribution)
@@ -85,7 +99,10 @@ run model_int8 1200 python tools/model_benchmark.py llama_int8
 #     registry snapshot with written_at metadata — the staleness witness
 #     for this battery run (VERDICT r5: BENCH_r05 went stale silently;
 #     a snapshot artifact dated by the run itself makes that detectable)
-run serving 1200 python tools/serving_benchmark.py --preset llama1b \
+#     Runs under the watchdog: a serving-loop hang archives a bundle +
+#     /healthz in $LOG instead of burning the window silently.
+run serving 1200 env $(wd serving) \
+    python tools/serving_benchmark.py --preset llama1b \
     --requests 64 --rate 8 --max-slots 8 --num-blocks 512 \
     --out tools/serving_bench.json \
     --monitor-out tools/monitor_snapshot.json
