@@ -25,6 +25,7 @@ import time
 LAST_GOOD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          "BENCH_LAST_GOOD.json")
 PROBE_TIMEOUT = 240       # import jax + tiny compile + host readback
+PROBE_RETRY_BACKOFF_S = 15  # short breather before the second probe
 MEASURE_TIMEOUT = 1200    # full compile (~40s) + 20 timed iters, margin
 RETRY_TIMEOUT = 900
 
@@ -261,13 +262,32 @@ def _emit_stale(reason):
     return 3
 
 
+def _preflight_probe():
+    """Probe the chip, retrying ONCE after a short backoff before
+    declaring the tunnel wedged. A single failed probe used to give up
+    immediately, and transient tunnel hiccups (a reconnect racing the
+    probe child's first compile) turned into multi-round photocopy
+    chains — BENCH_r03..r05 all re-emitted the 2026-07-31 measurement
+    because of one bad probe each. Returns the backend name or None."""
+    for attempt in (1, 2):
+        rc, out = _run_child("probe", PROBE_TIMEOUT)
+        if rc == 0 and "PROBE_OK" in out:
+            return out.split("PROBE_OK", 1)[1].strip().split()[0]
+        if attempt == 1:
+            sys.stderr.write(
+                "bench.py: pre-flight probe failed (rc=%s); retrying "
+                "once in %ds\n" % (rc, PROBE_RETRY_BACKOFF_S))
+            time.sleep(PROBE_RETRY_BACKOFF_S)
+    return None
+
+
 def main():
     # Pre-flight: is the chip reachable at all? A wedged tunnel hangs any
     # jax import/compile forever; bound it and fall back to last-good.
-    rc, out = _run_child("probe", PROBE_TIMEOUT)
-    if rc != 0 or "PROBE_OK" not in out:
-        sys.exit(_emit_stale("pre-flight probe failed (tunnel wedged?)"))
-    backend = out.split("PROBE_OK", 1)[1].strip().split()[0]
+    backend = _preflight_probe()
+    if backend is None:
+        sys.exit(_emit_stale(
+            "pre-flight probe failed twice (tunnel wedged?)"))
 
     result = None
     for timeout in (MEASURE_TIMEOUT, RETRY_TIMEOUT):

@@ -50,6 +50,24 @@ _DEFAULTS = {
     "FLAGS_use_native_interpreter": True,
     # distributed
     "FLAGS_distributed_barrier_timeout_s": 600,
+    # quantized gradient communication (distributed/compress.py,
+    # EQuARX-style block-scaled int8). Off = both collective paths are
+    # bit-identical to the uncompressed build (test-pinned): the
+    # compiled train step keeps its implicit fp32 psum/reduce-scatter
+    # and the eager store wire format is unchanged. On = the compiled
+    # step reduces grads via a bucketed two-phase quantized all-reduce
+    # (error-feedback residuals carried in the step state) and float
+    # eager all_reduce/reduce_scatter/all_gather payloads >= 1024
+    # elements ship as int8+block-scales (~4x fewer wire bytes).
+    "FLAGS_quantized_grad_sync": False,
+    # stochastic rounding for the quantized sync (unbiased, stateless
+    # alternative to error feedback; higher variance per step)
+    "FLAGS_quantized_grad_sync_stochastic": False,
+    # fused-communication bucket size threshold, MiB of fp32 grad
+    # payload: small params coalesce until a bucket crosses this, so
+    # the compiled step issues few large reductions XLA can overlap
+    # with backward compute instead of many tiny ones
+    "FLAGS_grad_sync_bucket_mb": 4.0,
     # logging
     "FLAGS_v": 0,
     # structured errors (reference FLAGS_call_stack_level, enforce.h):

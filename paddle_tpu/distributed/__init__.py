@@ -2,6 +2,7 @@
 from . import (  # noqa: F401
     auto_parallel,
     collective,
+    compress,
     passes,
     checkpoint,
     fleet_executor,

@@ -22,6 +22,8 @@ from __future__ import annotations
 
 import functools
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
@@ -297,8 +299,12 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
         return _wrap_like(tensor, out)
     pg = _pg_of(g)
     if pg is not None:
+        # strict: tensor all_gather requires shape/dtype agreement —
+        # validate BEFORE the wire exchange and name the mismatched
+        # rank (object collectives go through pg.allgather directly
+        # with legitimately rank-varying payloads)
         with _rec_api("all_gather", g, v):
-            parts = pg.allgather(_np(v))
+            parts = pg.allgather(_np(v), strict=True)
         if tensor_list is not None:
             tensor_list.extend(Tensor(jnp.asarray(p)) for p in parts)
             return tensor_list
@@ -616,7 +622,10 @@ def partial_allgather(tensor, nranks=1, rank_id=0, group=None):
         raise ValueError(
             "partial_allgather: nranks (%d) must equal the group world "
             "size (%d)" % (nranks, pg.world_size))
-    parts = pg.allgather(v.reshape(-1)[lo:hi])
+    # never compressed: these are pipeline-stage ACTIVATIONS — forward
+    # math must stay exact regardless of the grad-sync flag (the int8
+    # wire format is a gradient-communication trade, not a model change)
+    parts = pg.allgather(v.reshape(-1)[lo:hi], compressed=False)
     import numpy as _numpy
 
     flat = _numpy.concatenate([_numpy.asarray(p).reshape(-1) for p in parts])

@@ -1,0 +1,416 @@
+"""Quantized, bucketed gradient communication.
+
+The bandwidth layer of the two collective paths (EQuARX, arxiv
+2506.17615: block-scaled quantized all-reduce recovers ~4x wire bytes
+with negligible quality loss; T3, arxiv 2401.16677: what remains is
+hidden by overlapping it with compute):
+
+1. **Compiled path** (``parallel/engine.py``): when
+   ``FLAGS_quantized_grad_sync`` is on, the train step's implicit fp32
+   grad psum / ZeRO-2 reduce-scatter is replaced by an explicit
+   two-phase quantized all-reduce inside a ``shard_map`` over the batch
+   axes — quantize local partial grads (block-scaled int8, per-param
+   error-feedback residuals carried in the step's donated opt-state) →
+   all-to-all payload+scales → dequantize-sum → requantize → all-gather
+   → dequantize. Small params are coalesced into fused buckets
+   (``FLAGS_grad_sync_bucket_mb``) so the step issues FEW LARGE
+   reductions XLA's latency-hiding scheduler can overlap with backward
+   compute instead of many tiny ones it cannot.
+
+2. **Eager store path** (``distributed/process_group.py``): the same
+   flag switches the wire format of float all_reduce / reduce_scatter /
+   all_gather payloads to block-scaled int8 (+fp32 scales), so
+   multi-host eager sync pays ~4x fewer bytes over TCP. Reduction
+   happens in fp32 AFTER dequantizing every rank's (lossy)
+   contribution, so sums never accumulate int8 overflow.
+
+Both paths publish to the monitor registry:
+``comm_bytes_total{path,compressed}`` (actual wire bytes on the eager
+path, analytic ring-collective bytes per compiled step via
+``grad_sync_bytes_per_step{compressed}``), ``grad_sync_seconds{path}``
+and ``grad_sync_buckets``; eager flight-recorder entries carry the
+encoded payload size (``wire_bytes``) so a compression win is visible
+from a postmortem ring dump alone.
+
+Why error feedback: int8 round-to-nearest silently drops any gradient
+component below half an ulp of its block scale — systematically, every
+step. The residual ``e' = (g + e) - deq(quant(g + e))`` re-injects the
+dropped mass next step, which is what pins the loss trajectory to the
+fp32 baseline (tests/test_compress.py pins 50 steps). Stochastic
+rounding (``FLAGS_quantized_grad_sync_stochastic``) is the stateless
+alternative: unbiased but higher variance.
+"""
+from __future__ import annotations
+
+import json
+import struct
+
+import numpy as np
+
+from .. import monitor as _monitor
+from ..core import flags as _flags
+
+# wire payloads below this many elements ship uncompressed even with
+# the flag on: scalars/metric reductions stay exact, and the
+# scale+header overhead would eat the win anyway
+MIN_COMPRESS_NUMEL = 1024
+
+DEFAULT_BLOCK = 256
+
+# -- monitor wiring ----------------------------------------------------------
+
+COMM_BYTES = _monitor.counter(
+    "comm_bytes_total",
+    "bytes moved by gradient/collective communication; eager = actual "
+    "encoded wire payloads through the TCP store, compiled = analytic "
+    "ring-collective bytes per step x steps",
+    labelnames=("path", "compressed"))
+GRAD_SYNC_SECONDS = _monitor.histogram(
+    "grad_sync_seconds",
+    "wall time of one gradient synchronization (eager bucketed sync / "
+    "comm_benchmark op)",
+    labelnames=("path",),
+    buckets=(.0005, .001, .0025, .005, .01, .025, .05, .1, .25, .5,
+             1.0, 2.5, 5.0, 10.0))
+GRAD_SYNC_BUCKETS = _monitor.gauge(
+    "grad_sync_buckets",
+    "fused communication buckets the current grad-sync plan issues per "
+    "step")
+GRAD_SYNC_BYTES_STEP = _monitor.gauge(
+    "grad_sync_bytes_per_step",
+    "analytic per-rank wire bytes of one compiled-step gradient sync "
+    "(ring reduce-scatter + all-gather equivalent)",
+    labelnames=("compressed",))
+
+
+def record_comm_bytes(path, compressed, nbytes):
+    if not _monitor.is_enabled():
+        return
+    COMM_BYTES.labels(path=path,
+                      compressed="true" if compressed else "false") \
+        .inc(int(nbytes))
+
+
+# -- flags -------------------------------------------------------------------
+
+def quantized_sync_enabled():
+    return bool(_flags.flag("FLAGS_quantized_grad_sync", False))
+
+
+def stochastic_rounding_enabled():
+    return bool(_flags.flag("FLAGS_quantized_grad_sync_stochastic", False))
+
+
+def bucket_bytes():
+    mb = float(_flags.flag("FLAGS_grad_sync_bucket_mb", 4))
+    return max(int(mb * (1 << 20)), 1)
+
+
+def _is_float_dtype(dt):
+    # numpy-native floats have kind 'f'; ml_dtypes (bfloat16, fp8) are
+    # custom void-kind dtypes whose NAME still spells float
+    dt = np.dtype(dt)
+    return dt.kind == "f" or "float" in dt.name
+
+
+def should_compress(arr):
+    """Wire-compression eligibility for one eager payload."""
+    return (quantized_sync_enabled()
+            and _is_float_dtype(arr.dtype)
+            and arr.size >= MIN_COMPRESS_NUMEL)
+
+
+# -- numpy quantize twins (eager wire path; no jax) --------------------------
+
+def quantize_np(flat, block=DEFAULT_BLOCK):
+    """Flat float array -> (q int8 [numel], scales f32 [nblocks]).
+
+    Non-finite handling mirrors kernels/quant.py: a block containing
+    inf/nan gets scale NaN and dequantizes to NaN everywhere — an
+    overflowing gradient stays detectable through the compressed wire
+    instead of being silently zeroed or clipped finite."""
+    flat = np.asarray(flat, dtype=np.float32).reshape(-1)
+    numel = flat.size
+    nblk = max(-(-numel // block), 1)
+    pad = nblk * block - numel
+    xb = np.pad(flat, (0, pad)).reshape(nblk, block)
+    with np.errstate(invalid="ignore", over="ignore"):
+        amax = np.abs(xb).max(axis=1)
+        finite = np.isfinite(amax)
+        scales = np.where(finite & (amax > 0), amax / 127.0,
+                          np.where(finite, 1.0, np.nan)) \
+            .astype(np.float32)
+        q = np.clip(np.rint(np.nan_to_num(
+            xb / scales[:, None], nan=0.0, posinf=0.0, neginf=0.0)),
+            -127, 127).astype(np.int8)
+    return q.reshape(-1)[:numel], scales
+
+
+def dequantize_np(q, scales, block=DEFAULT_BLOCK):
+    """Inverse of quantize_np -> flat float32 [numel]."""
+    q = np.asarray(q, dtype=np.int8).reshape(-1)
+    numel = q.size
+    nblk = scales.size
+    pad = nblk * block - numel
+    qb = np.pad(q, (0, pad)).reshape(nblk, block).astype(np.float32)
+    return (qb * scales[:, None].astype(np.float32)) \
+        .reshape(-1)[:numel]
+
+
+# -- wire codec (the store transport's payload format) -----------------------
+#
+# Uncompressed frames are byte-identical to the pre-compression format
+# (test-pinned): >I header-length, JSON {"d","s"}, raw buffer. The
+# compressed frame adds a "q" key to the header and ships fp32 block
+# scales followed by the int8 payload.
+
+def wire_encode(arr, compressed=False, block=DEFAULT_BLOCK):
+    arr = np.ascontiguousarray(arr)
+    if not compressed:
+        head = json.dumps({"d": arr.dtype.name,
+                           "s": list(arr.shape)}).encode()
+        return struct.pack(">I", len(head)) + head + arr.tobytes()
+    q, scales = quantize_np(arr.astype(np.float32).reshape(-1), block)
+    head = json.dumps({"d": arr.dtype.name, "s": list(arr.shape),
+                       "q": {"v": 1, "b": block}}).encode()
+    return (struct.pack(">I", len(head)) + head
+            + scales.tobytes() + q.tobytes())
+
+
+def _named_dtype(name):
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def wire_decode(data):
+    """-> (array, meta dict). meta carries 'q' for compressed frames."""
+    (n,) = struct.unpack(">I", data[:4])
+    meta = json.loads(data[4:4 + n].decode())
+    dt = _named_dtype(meta["d"])
+    body = data[4 + n:]
+    qinfo = meta.get("q")
+    if not qinfo:
+        arr = np.frombuffer(body, dtype=dt).reshape(meta["s"]).copy()
+        return arr, meta
+    block = int(qinfo["b"])
+    numel = int(np.prod(meta["s"])) if meta["s"] else 1
+    nblk = max(-(-numel // block), 1)
+    scales = np.frombuffer(body[:nblk * 4], dtype=np.float32)
+    q = np.frombuffer(body[nblk * 4:nblk * 4 + numel], dtype=np.int8)
+    flat = dequantize_np(q, scales, block)
+    return flat.astype(dt).reshape(meta["s"]), meta
+
+
+def wire_is_compressed(data):
+    """Cheap header probe (byte accounting without a full decode)."""
+    try:
+        (n,) = struct.unpack(">I", data[:4])
+        return "q" in json.loads(data[4:4 + n].decode())
+    except Exception:
+        return False
+
+
+# -- bucket planning ---------------------------------------------------------
+
+def plan_buckets(sized_items, threshold_bytes=None):
+    """Greedy size-threshold coalescing: ``sized_items`` is a list of
+    (key, nbytes); returns a list of buckets (lists of keys) where each
+    bucket's total payload stays under the threshold unless a single
+    item alone exceeds it. Order is preserved — gradients become
+    available roughly in reverse-forward order, and keeping neighbors
+    together is what lets the compiled step's reductions overlap the
+    rest of backward (T3's locality argument, reference EagerReducer
+    bucketing, imperative/reducer.cc)."""
+    threshold = bucket_bytes() if threshold_bytes is None \
+        else int(threshold_bytes)
+    buckets, cur, cur_bytes = [], [], 0
+    for key, nbytes in sized_items:
+        if cur and cur_bytes + nbytes > threshold:
+            buckets.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(key)
+        cur_bytes += nbytes
+    if cur:
+        buckets.append(cur)
+    return buckets
+
+
+def ring_allreduce_bytes(numel, nranks, compressed,
+                         block=DEFAULT_BLOCK):
+    """Analytic per-rank wire bytes of one all-reduce of ``numel``
+    elements: ring reduce-scatter + all-gather, fp32 payloads
+    uncompressed vs int8+fp32-block-scales both phases compressed."""
+    if nranks <= 1:
+        return 0
+    frac = 2.0 * (nranks - 1) / nranks
+    if not compressed:
+        return int(frac * numel * 4)
+    return int(frac * (numel * 1 + (numel / block) * 4))
+
+
+# -- traced two-phase quantized all-reduce (compiled path) -------------------
+
+def quantized_mean_allreduce(v, axes, nranks, block=DEFAULT_BLOCK,
+                             stochastic=False, key=None, mean=True):
+    """Inside a ``shard_map`` manual over ``axes``: mean-reduce the flat
+    f32 vector ``v`` (each rank holds its own partial version) with
+    int8 payloads on the wire.
+
+    Two phases (the EQuARX schedule): all-to-all of quantized per-rank
+    chunks + scales, dequantize-sum into this rank's owned chunk,
+    requantize, all-gather chunks + scales, dequantize. Wire bytes per
+    rank ~ 2(n-1)/n * numel * (1 + 4/block) vs 2(n-1)/n * 4*numel for
+    the fp32 ring — a ~3.9x reduction at block=256.
+
+    Returns ``(mean_reduced [numel], local_error [numel])`` where
+    ``local_error = v - deq(quant(v))`` is this rank's phase-1
+    quantization error — the error-feedback residual the caller carries
+    to the next step. (Phase-2 requantization error is not fed back;
+    it is already averaged over ranks and EQuARX measures it
+    negligible.)
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..kernels import quant as _q
+
+    numel = v.shape[0]
+    chunk = max(-(-numel // (nranks * block)), 1) * block
+    total = chunk * nranks
+    vp = jnp.pad(v.astype(jnp.float32), (0, total - numel))
+    rows = vp.reshape(nranks, chunk)
+    k1 = k2 = None
+    if stochastic:
+        if key is None:
+            raise ValueError("stochastic rounding needs an rng key")
+        k1, k2 = jax.random.split(key)
+    q, s = _q.quantize_int8_block(rows, block, stochastic, k1)
+    err = v - _q.dequantize_int8_block(q, s, jnp.float32, block) \
+        .reshape(-1)[:numel]
+    # phase 1: rank r collects every peer's chunk r (payload + scales)
+    qr = jax.lax.all_to_all(q, axes, split_axis=0, concat_axis=0)
+    sr = jax.lax.all_to_all(s, axes, split_axis=0, concat_axis=0)
+    red = _q.dequantize_int8_block(qr, sr, jnp.float32, block) \
+        .sum(axis=0)
+    if mean:
+        red = red / nranks
+    # phase 2: requantize the reduced chunk, gather all chunks back
+    q2, s2 = _q.quantize_int8_block(red[None], block, stochastic, k2)
+    qg = jax.lax.all_gather(q2[0], axes, tiled=False)
+    sg = jax.lax.all_gather(s2[0], axes, tiled=False)
+    out = _q.dequantize_int8_block(qg, sg, jnp.float32, block) \
+        .reshape(-1)[:numel]
+    return out, err
+
+
+def reduce_grads_traced(grads, residuals, axes, nranks, buckets,
+                        block=DEFAULT_BLOCK, stochastic=False,
+                        key=None, mean=True):
+    """Bucketed quantized mean-all-reduce of a gradient list (traced,
+    inside shard_map over ``axes``).
+
+    ``grads``/``residuals`` are parallel lists (residuals f32, same
+    shapes); ``buckets`` is a plan over indices from plan_buckets.
+    Returns (new_grads in original dtypes, new_residuals f32).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    new_grads = [None] * len(grads)
+    new_res = [None] * len(grads)
+    for bi, bucket in enumerate(buckets):
+        flat = jnp.concatenate(
+            [grads[i].reshape(-1).astype(jnp.float32) for i in bucket])
+        res = jnp.concatenate(
+            [residuals[i].reshape(-1) for i in bucket])
+        k = jax.random.fold_in(key, bi) if stochastic else None
+        out, err = quantized_mean_allreduce(
+            flat + res, axes, nranks, block, stochastic, k, mean=mean)
+        # an overflowing step propagates NaN through the reduced grad
+        # (scale-NaN blocks, see quantize) so the loss scaler sees it —
+        # but the residual must not carry the poison into the NEXT step
+        err = jnp.where(jnp.isfinite(err), err, 0.0)
+        off = 0
+        for i in bucket:
+            g = grads[i]
+            n = g.size
+            new_grads[i] = out[off:off + n].reshape(g.shape) \
+                .astype(g.dtype)
+            new_res[i] = err[off:off + n].reshape(g.shape)
+            off += n
+    return new_grads, new_res
+
+
+# -- eager bucketed gradient sync (DataParallel path) ------------------------
+
+def sync_gradients_compressed(params, group, residuals=None,
+                              threshold_bytes=None,
+                              block=DEFAULT_BLOCK):
+    """Fused-bucket compressed grad all-reduce over a real multi-rank
+    eager group (the flag-on replacement for DataParallel's per-param
+    fp32 loop): grads are coalesced into flat fp32 buckets
+    (size-threshold plan), each bucket rides ONE compressed store
+    all-reduce, and the averaged result is scattered back into
+    ``p.grad``. ``residuals`` (dict keyed by id(param) -> f32 flat
+    error) enables error feedback across calls; pass the same dict
+    every step."""
+    import time
+
+    pg = group.pg
+    live = [p for p in params if p.grad is not None]
+    if not live:
+        return
+    t0 = time.perf_counter()
+    sized = [(i, int(np.prod(live[i].grad.shape) or 1) * 4)
+             for i in range(len(live))]
+    buckets = plan_buckets(sized, threshold_bytes)
+    if _monitor.is_enabled():
+        GRAD_SYNC_BUCKETS.set(len(buckets))
+    for bucket in buckets:
+        flats = []
+        for i in bucket:
+            g = np.asarray(live[i].grad._value, dtype=np.float32) \
+                .reshape(-1)
+            if residuals is not None:
+                e = residuals.get(id(live[i]))
+                if e is not None:
+                    g = g + e
+            flats.append(g)
+        flat = np.concatenate(flats) if len(flats) > 1 else flats[0]
+        # encode ONCE: the frame is both this rank's wire payload and
+        # the source of the residual (no second quantize pass); the
+        # decoded value is threaded to allreduce as the own-frame
+        # contribution (no second dequantize pass either)
+        frame = wire_encode(flat, compressed=True, block=block)
+        deq = None
+        if residuals is not None:
+            deq, _ = wire_decode(frame)
+            err = flat - deq.reshape(-1)
+            # a non-finite (overflow) step propagates NaN to the
+            # reduced grad, but must not poison the residual carried
+            # into the next step
+            err = np.where(np.isfinite(err), err, 0.0)
+            off = 0
+            for j, i in enumerate(bucket):
+                n = flats[j].size
+                residuals[id(live[i])] = err[off:off + n]
+                off += n
+        out = pg.allreduce(flat, "sum", compressed=True,
+                           _frame=frame, _own=deq) / group.nranks
+        import jax.numpy as jnp
+
+        off = 0
+        for i in bucket:
+            g = live[i].grad
+            n = np.asarray(g._value).size
+            g._value = jnp.asarray(
+                out[off:off + n].reshape(np.asarray(g._value).shape),
+                dtype=g._value.dtype)
+            off += n
+    if _monitor.is_enabled():
+        GRAD_SYNC_SECONDS.labels(path="eager").observe(
+            time.perf_counter() - t0)

@@ -24,14 +24,13 @@ path) — data-plane collectives on TPU always go through XLA.
 """
 from __future__ import annotations
 
-import json
 import os
-import struct
 
 import numpy as np
 
 from ..monitor import flight_recorder as _fr
 from ..monitor import watchdog as _wd
+from . import compress as _compress
 
 _DONE = "/~done"
 
@@ -72,25 +71,17 @@ class _CollectiveSpan:
         return self._rec_cm.__exit__(*exc)
 
 
-def _encode(arr):
-    """dtype-tagged raw-bytes serialization. np.save round-trips
-    ml_dtypes (bfloat16 — the default training dtype) as opaque V2
-    voids, so we ship our own header + buffer."""
-    arr = np.ascontiguousarray(arr)
-    head = json.dumps({"d": arr.dtype.name, "s": list(arr.shape)}).encode()
-    return struct.pack(">I", len(head)) + head + arr.tobytes()
+def _encode(arr, compressed=False):
+    """dtype-tagged raw-bytes serialization (compress.wire_encode).
+    np.save round-trips ml_dtypes (bfloat16 — the default training
+    dtype) as opaque V2 voids, so we ship our own header + buffer.
+    ``compressed=True`` switches float payloads to the block-scaled
+    int8 wire format (~4x fewer bytes); the uncompressed frame is
+    byte-identical to the pre-compression format (test-pinned)."""
+    return _compress.wire_encode(np.ascontiguousarray(arr),
+                                 compressed=compressed)
 
 
-def _decode(data):
-    (n,) = struct.unpack(">I", data[:4])
-    meta = json.loads(data[4:4 + n].decode())
-    try:
-        dt = np.dtype(meta["d"])
-    except TypeError:
-        import ml_dtypes
-
-        dt = np.dtype(getattr(ml_dtypes, meta["d"]))
-    return np.frombuffer(data[4 + n:], dtype=dt).reshape(meta["s"]).copy()
 
 
 class StoreProcessGroup:
@@ -126,8 +117,17 @@ class StoreProcessGroup:
         self._seq += 1
         return "%s/%s.%d" % (self.prefix, name, self._seq)
 
-    def _put(self, key, arr):
-        self.store.set(key, _encode(arr))
+    def _put(self, key, arr, compressed=False):
+        data = _encode(arr, compressed=compressed)
+        self._account(data, compressed)
+        self.store.set(key, data)
+
+    def _account(self, data, compressed):
+        """Wire-byte telemetry for one frame: the comm_bytes registry
+        counter plus the open flight-recorder entry (so postmortem ring
+        dumps carry actual — including compressed — payload sizes)."""
+        _compress.record_comm_bytes("eager", compressed, len(data))
+        self._recorder.note_bytes(len(data))
 
     def _rec(self, op, arr=None, reduce_op=None, strict_shape=False):
         """Flight-record one collective (outermost call only — allreduce
@@ -142,22 +142,29 @@ class StoreProcessGroup:
             group=self.prefix, strict_shape=strict_shape)
         return _CollectiveSpan(rec_cm, op, self)
 
-    def _get(self, key, timeout_s=None, postmortem=True):
+    def _wait(self, key, timeout_s=None, postmortem=True):
+        """Raw blocking store read with the hang/desync postmortem: on
+        timeout, dump + gather ring buffers through the store (alive —
+        it's the PEER's payload that never arrived), name the first
+        diverging rank/seq, persist JSON, re-raise with the diagnosis."""
         data = self.store.get(key, timeout_s)
         if data is None:
             if not postmortem:
                 raise TimeoutError(
                     "collective wait timed out on %r" % key)
-            # hang/desync postmortem: dump + gather ring buffers through
-            # the store (alive — it's the PEER's payload that never
-            # arrived), name the first diverging rank/seq, persist JSON
             report = _fr.on_collective_timeout(
                 self.store, self.rank, self.world_size, waited_key=key,
                 recorder=self._recorder, group=self.prefix)
             raise TimeoutError(
                 "collective wait timed out on %r — %s"
                 % (key, _fr.summarize(report)))
-        return _decode(data)
+        return data
+
+    def _get(self, key, timeout_s=None, postmortem=True):
+        data = self._wait(key, timeout_s, postmortem)
+        arr, meta = _compress.wire_decode(data)
+        self._account(data, "q" in meta)
+        return arr
 
     def _cleanup(self, base, keys):
         """Last rank to finish reading deletes the op's keys."""
@@ -166,37 +173,116 @@ class StoreProcessGroup:
                 self.store.delete(k)
             self.store.delete(base + _DONE)
 
+    @staticmethod
+    def _check_agreement(parts, op):
+        """Cross-rank shape/dtype validation for collectives whose
+        payloads must agree (tensor all_gather, reduce_scatter, the
+        allgather inside allreduce). The wire frames are
+        self-describing, so the check runs on the decoded parts —
+        zero extra store round-trips (a pre-exchange meta handshake
+        was reviewed and rejected: it doubled blocking store ops on
+        every eager collective, flag on or off) — and a mismatch
+        raises a clear error NAMING THE RANK before any stack()/
+        reassembly produces a cryptic shape error."""
+        ref = (parts[0].shape, parts[0].dtype)
+        for r, p in enumerate(parts):
+            if (p.shape, p.dtype) != ref:
+                raise ValueError(
+                    "%s: rank %d payload shape %s dtype %s disagrees "
+                    "with rank 0 shape %s dtype %s — every member rank "
+                    "must pass an identically-shaped tensor to this "
+                    "collective"
+                    % (op, r, tuple(p.shape), p.dtype.name,
+                       tuple(ref[0]), ref[1].name))
+
     # -- collectives (per-rank semantics) ----------------------------------
 
-    def allgather(self, arr):
-        """local [d0, ...] -> list of world_size arrays (rank order)."""
+    def allgather(self, arr, compressed=None, strict=False,
+                  _frame=None, _own=None):
+        """local [d0, ...] -> list of world_size arrays (rank order).
+
+        ``strict=True`` (tensor all_gather, and the lowering target of
+        allreduce) validates cross-rank shape/dtype agreement before
+        the wire exchange; the default stays permissive because object
+        collectives legitimately ship rank-varying payload sizes.
+        ``compressed=None`` resolves from FLAGS_quantized_grad_sync
+        (float payloads >= 1024 elements ride the int8 wire format)."""
+        arr = np.asarray(arr)
+        if compressed is None:
+            compressed = _compress.should_compress(arr)
         with self._rec("all_gather", arr):
             base = self._op("ag")
             keys = ["%s/%d" % (base, r) for r in range(self.world_size)]
-            self._put(keys[self.rank], arr)
-            out = [self._get(k) for k in keys]
+            data = _frame if _frame is not None \
+                else _encode(arr, compressed=compressed)
+            self._account(data, compressed)
+            self.store.set(keys[self.rank], data)
+            out = []
+            for r, k in enumerate(keys):
+                if r == self.rank:
+                    # own frame: decode the bytes we just posted (for
+                    # compressed frames decode(encode(x)) != x, and
+                    # every rank must see IDENTICAL values) — no store
+                    # read, no wire-byte accounting for a local copy.
+                    # _own (callers that already decoded the frame for
+                    # error feedback) skips even the local decode.
+                    if _own is None:
+                        _own, _ = _compress.wire_decode(data)
+                    out.append(np.asarray(_own))
+                else:
+                    out.append(self._get(k))
+            # cleanup before the strict check: every rank has read all
+            # frames by now, and an error must not leave the done
+            # counter short (keys would outlive the op)
             self._cleanup(base, keys)
+            if strict:
+                self._check_agreement(out, "all_gather")
             return out
 
-    def allreduce(self, arr, op="sum"):
+    def allreduce(self, arr, op="sum", compressed=None, _frame=None,
+                  _own=None):
         with self._rec("all_reduce", arr, reduce_op=op,
                        strict_shape=True):
-            return self._allreduce(arr, op)
+            return self._allreduce(arr, op, compressed=compressed,
+                                   _frame=_frame, _own=_own)
 
-    def _allreduce(self, arr, op):
-        parts = self.allgather(np.asarray(arr))
+    def _allreduce(self, arr, op, compressed=None, _frame=None,
+                   _own=None):
+        # each rank's contribution is (lossily) compressed on the wire;
+        # the reduction itself runs in full precision AFTER decode, so
+        # sums never accumulate int8 overflow. Compression is a
+        # sum/avg-only trade: per-rank rounding error averages out (and
+        # the grad-sync callers carry EF residuals), but a lossy max/
+        # min/prod would just be systematically wrong — those ops stay
+        # exact even with the flag on.
+        if compressed is None and op not in ("sum", "avg"):
+            compressed = False
+        parts = self.allgather(np.asarray(arr), compressed=compressed,
+                               strict=True, _frame=_frame, _own=_own)
         acc = np.stack(parts, axis=0)
+        # accumulate narrow floats (bf16/f16) in fp32 and cast back:
+        # summing world_size bf16 contributions in bf16 adds rounding
+        # error that grows with world size (max/min need no upcast —
+        # they do not accumulate)
+        out_dtype = acc.dtype
+        upcast = (op in ("sum", "avg", "prod")
+                  and _compress._is_float_dtype(out_dtype)
+                  and out_dtype.itemsize < 4)
+        if upcast:
+            acc = acc.astype(np.float32)
         if op == "sum":
-            return acc.sum(axis=0)
-        if op == "max":
-            return acc.max(axis=0)
-        if op == "min":
-            return acc.min(axis=0)
-        if op == "prod":
-            return acc.prod(axis=0)
-        if op == "avg":
-            return acc.mean(axis=0)
-        raise ValueError(op)
+            red = acc.sum(axis=0)
+        elif op == "max":
+            red = acc.max(axis=0)
+        elif op == "min":
+            red = acc.min(axis=0)
+        elif op == "prod":
+            red = acc.prod(axis=0)
+        elif op == "avg":
+            red = acc.mean(axis=0)
+        else:
+            raise ValueError(op)
+        return red.astype(out_dtype) if upcast else red
 
     def broadcast(self, arr, src):
         # not strict_shape: only src's payload matters (object broadcast
@@ -215,7 +301,7 @@ class StoreProcessGroup:
             out = self._allreduce(arr, op)
             return out if self.rank == dst else np.asarray(arr)
 
-    def reduce_scatter(self, arr, op="sum"):
+    def reduce_scatter(self, arr, op="sum", compressed=None):
         """local [world*d, ...] -> this rank's reduced [d, ...] shard."""
         arr = np.asarray(arr)
         if arr.shape[0] % self.world_size:
@@ -224,7 +310,9 @@ class StoreProcessGroup:
                 % (arr.shape[0], self.world_size))
         with self._rec("reduce_scatter", arr, reduce_op=op,
                        strict_shape=True):
-            red = self._allreduce(arr, op)
+            # agreement is validated by the allgather lowering
+            # (strict=True) before any payload moves
+            red = self._allreduce(arr, op, compressed=compressed)
             return np.split(red, self.world_size, axis=0)[self.rank]
 
     def scatter(self, chunks, src):

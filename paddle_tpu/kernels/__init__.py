@@ -1,7 +1,10 @@
-"""Hand-written TPU kernels (Pallas).
+"""Hand-written TPU kernels (Pallas) + fusion-critical jnp ops.
 
 The reference's fused CUDA ops (operators/fused/fused_attention_op.cu,
 fused_multi_transformer, fmha) map here: only the ops XLA cannot fuse well
 get kernels — flash attention, ring attention (long context over ICI), and
-MoE dispatch helpers. Everything else rides XLA fusion.
+MoE dispatch helpers. Everything else rides XLA fusion — including
+quant.py's block-scaled int8 quantize/dequantize (gradient compression),
+which deliberately stays jnp so it fuses INTO the compiled step's
+collective schedule instead of pinning a custom-call boundary.
 """

@@ -1,0 +1,87 @@
+"""Block-scaled int8 quantize/dequantize ops.
+
+The gradient-compression primitives behind
+``paddle_tpu.distributed.compress`` (EQuARX, arxiv 2506.17615:
+block-scaled quantized all-reduce): a flat float array is split into
+fixed-size blocks, each block carries one fp32 scale (max-abs / 127),
+and values are rounded — deterministically or stochastically — into
+int8. Block scaling bounds the quantization error by the LOCAL dynamic
+range, which is what makes int8 survivable for gradients whose
+magnitude spans orders of magnitude across a parameter.
+
+These are deliberately **jnp ops, not Pallas kernels** (the
+kernels/__init__ rule: only what XLA cannot fuse well gets a kernel).
+Quantize/dequantize are memory-bound elementwise+reduce chains that XLA
+fuses into one pass over the data — and on the compiled grad-sync path
+they must additionally fuse INTO the surrounding collective schedule,
+which a custom-call kernel would pin down instead. op_benchmark carries
+``quantize_int8_block`` / ``dequantize_int8_block`` rows so the
+fused-by-XLA assumption stays measured.
+
+Shapes: the canonical layout is ``(rows, cols)`` with ``cols`` a
+multiple of ``block``; callers flatten/pad (compress.py owns padding
+policy). Scales come out as ``(rows, cols // block)`` float32.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# int8 symmetric range: +-127 (never -128, so negation round-trips)
+QMAX = 127.0
+DEFAULT_BLOCK = 256
+
+
+def block_scales(x, block=DEFAULT_BLOCK):
+    """Per-block fp32 scales for a ``(rows, cols)`` float array:
+    ``max|block| / 127`` with a zero-block floor so all-zero blocks
+    dequantize to exact zeros instead of NaNs.
+
+    A block containing ANY non-finite value gets scale NaN: int8 cannot
+    carry inf/nan, so the poison is moved into the scale and the whole
+    block dequantizes to NaN on every rank — an overflowing gradient
+    stays DETECTABLE (amp loss scalers skip the step) instead of being
+    silently zeroed (nan input) or clipped finite (inf input)."""
+    rows, cols = x.shape
+    if cols % block:
+        raise ValueError(
+            "block_scales: cols (%d) %% block (%d) != 0" % (cols, block))
+    xb = x.reshape(rows, cols // block, block).astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xb), axis=-1)
+    finite = jnp.isfinite(amax)
+    return jnp.where(finite & (amax > 0), amax / QMAX,
+                     jnp.where(finite, 1.0, jnp.nan))
+
+
+def quantize_int8_block(x, block=DEFAULT_BLOCK, stochastic=False,
+                        key=None):
+    """Quantize ``(rows, cols)`` float -> ``(q int8 (rows, cols),
+    scales f32 (rows, cols//block))``.
+
+    ``stochastic=True`` rounds with uniform dither (floor(v + u),
+    u ~ U[0,1)) so the rounding is unbiased: E[deq(quant(x))] == x.
+    Deterministic rounding is round-to-nearest — lower variance, but a
+    constant sub-half-ulp gradient would never move without the error
+    feedback carried by compress.py.
+    """
+    rows, cols = x.shape
+    scales = block_scales(x, block)
+    s = jnp.repeat(scales, block, axis=-1)
+    v = x.astype(jnp.float32) / s
+    if stochastic:
+        if key is None:
+            raise ValueError("stochastic rounding needs an rng key")
+        u = jax.random.uniform(key, v.shape, dtype=jnp.float32)
+        q = jnp.floor(v + u)
+    else:
+        q = jnp.round(v)
+    q = jnp.clip(q, -QMAX, QMAX).astype(jnp.int8)
+    return q, scales
+
+
+def dequantize_int8_block(q, scales, dtype=jnp.float32,
+                          block=DEFAULT_BLOCK):
+    """Inverse of quantize_int8_block: ``q (rows, cols)`` int8 +
+    ``scales (rows, cols//block)`` -> float ``(rows, cols)``."""
+    s = jnp.repeat(scales.astype(jnp.float32), block, axis=-1)
+    return (q.astype(jnp.float32) * s).astype(dtype)

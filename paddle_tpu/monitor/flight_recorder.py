@@ -90,6 +90,19 @@ class FlightRecorder:
     def _end(self, entry):
         entry["t_end"] = time.time()
 
+    def note_bytes(self, nbytes):
+        """Attribute wire payload bytes to the currently-open outermost
+        record on this thread (the store transport calls this from its
+        put/get plumbing): entries accumulate a ``wire_bytes`` field so
+        a postmortem ring dump shows the ACTUAL encoded payload sizes —
+        including the compressed sizes when the quantized wire format
+        (distributed/compress.py) is active. Never part of the
+        cross-rank signature (payload framing may legitimately differ
+        by rank)."""
+        entry = getattr(self._depth, "entry", None)
+        if entry is not None:
+            entry["wire_bytes"] = entry.get("wire_bytes", 0) + int(nbytes)
+
     # -- inspection --------------------------------------------------------
 
     def entries(self):
@@ -128,11 +141,14 @@ class _Record:
         self._outer = depth == 0
         if fr.enabled and self._outer:
             self._entry = fr._begin(*self._args)
+            d.entry = self._entry  # note_bytes target for nested I/O
         return self._entry
 
     def __exit__(self, *exc):
-        self._fr._depth.n -= 1
+        d = self._fr._depth
+        d.n -= 1
         if self._entry is not None:
+            d.entry = None
             self._fr._end(self._entry)
 
 

@@ -26,6 +26,10 @@ class DataParallel(Layer):
             hcg.get_data_parallel_group() if hcg is not None
             else collective.Group("dp"))
         self.find_unused_parameters = find_unused_parameters
+        # error-feedback residuals for the quantized eager sync path
+        # (one flat f32 array per param, keyed by id; persists across
+        # steps so dropped sub-ulp gradient mass re-enters next step)
+        self._ef_residuals = {}
 
     def forward(self, *inputs, **kwargs):
         return self._layers(*inputs, **kwargs)
@@ -33,12 +37,25 @@ class DataParallel(Layer):
     @no_grad()
     def sync_gradients(self):
         """Fused dp-group grad allreduce (reference
-        fused_allreduce_gradients, fleet/utils/hybrid_parallel_util.py)."""
+        fused_allreduce_gradients, fleet/utils/hybrid_parallel_util.py).
+
+        With ``FLAGS_quantized_grad_sync`` on, grads coalesce into
+        size-threshold buckets (``FLAGS_grad_sync_bucket_mb``) and each
+        bucket rides ONE compressed store all-reduce — ~4x fewer wire
+        bytes and far fewer round-trips than the per-param fp32 loop,
+        with per-param error feedback preserving convergence
+        (distributed/compress.py)."""
+        from ..distributed import compress as _compress
         from .hybrid_optimizer import _eager_multiprocess
 
         if not _eager_multiprocess(self._group):
             # single-controller SPMD: the compiled step's psum already
             # reduced grads over the sharded batch — nothing to sync
+            return
+        if _compress.quantized_sync_enabled():
+            _compress.sync_gradients_compressed(
+                list(self._layers.parameters()), self._group,
+                residuals=self._ef_residuals)
             return
         for p in self._layers.parameters():
             if p.grad is not None:

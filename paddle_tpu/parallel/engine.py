@@ -18,10 +18,21 @@ enters as a sharding:
                stage 3: + parameters sharded, all-gathered on use
 - sep (SP):  sequence dim sharded over 'sep'; ring attention in kernels/.
 - pp:        lax.scan over stage-stacked weights (see pipeline_parallel).
+
+Gradient communication (FLAGS_quantized_grad_sync): by default the grad
+all-reduce / ZeRO-2 reduce-scatter is IMPLICIT — XLA inserts it because
+the batch is sharded and params replicated. With the flag on (pure
+data-parallel/ZeRO<=2 meshes), forward+backward instead run inside a
+shard_map manual over the batch axes and the reduction is an explicit
+bucketed block-scaled-int8 all-reduce with per-param error-feedback
+residuals (distributed/compress.py) — ~4x fewer gradient wire bytes,
+loss trajectory pinned to fp32 by tests/test_compress.py.
 """
 from __future__ import annotations
 
 import time
+
+import warnings
 
 import jax
 import jax.numpy as jnp
@@ -30,7 +41,11 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from .. import monitor as _monitor
 from ..core.dispatch import no_grad
 from ..core.tensor import Tensor
+from ..distributed import compress as _compress
 from ..distributed import mesh as _mesh
+# the version-portable shard_map shim (check_rep -> check_vma on newer
+# jax) lives in ONE place: distributed/collective.py
+from ..distributed.collective import shard_map as _shard_map
 
 # training telemetry on the same registry as serving (monitor/):
 # step time, token throughput, trace counts, device memory — the
@@ -142,7 +157,8 @@ class CompiledTrainStep:
     loss_fn + Optimizer over the current mesh."""
 
     def __init__(self, model, loss_fn, optimizer, mesh=None, zero_stage=0,
-                 donate=True, batch_spec=None, labels_to_model=False):
+                 donate=True, batch_spec=None, labels_to_model=False,
+                 loss_reduction="mean"):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -155,6 +171,18 @@ class CompiledTrainStep:
         self.mesh = mesh or _mesh.get_mesh()
         self.zero_stage = zero_stage
         self.donate = donate
+        # how loss_fn reduces over the batch ("mean" | "sum"). Only the
+        # quantized grad-sync path needs to know: it combines PER-RANK
+        # losses/grads of per-shard batches, and mean-of-means equals
+        # the global mean while sum-of-sums needs psum — declaring it
+        # wrong would silently rescale gradients by 1/nranks. The exact
+        # (flag-off) path is reduction-agnostic (GSPMD computes the
+        # global loss directly).
+        if loss_reduction not in ("mean", "sum"):
+            raise ValueError(
+                "loss_reduction must be 'mean' or 'sum', got %r"
+                % (loss_reduction,))
+        self.loss_reduction = loss_reduction
         self._names, values = model.functional_state()
         self._tensors = model.raw_state_tensors()
         trainable = {n: p for n, p in model.named_parameters()
@@ -195,6 +223,11 @@ class CompiledTrainStep:
         self._compiled = None
         self._compiled_multi = None
         self._step_fn = None
+        # quantized grad sync (distributed/compress.py): resolved at
+        # first build from FLAGS_quantized_grad_sync; None = the exact
+        # fp32 path (bit-identical to the flag-less build, test-pinned)
+        self._qsync = None
+        self._ef_state = {}
 
     # -- sharding specs ----------------------------------------------------
 
@@ -242,6 +275,102 @@ class CompiledTrainStep:
                 jax.device_put(s, NamedSharding(self.mesh, spec))
                 for s, spec in zip(slots, opt_specs[n])]
 
+    # -- quantized grad sync ----------------------------------------------
+
+    def _batch_axes(self):
+        """Mesh axes the batch dim is split over (the grad-reduce axes)."""
+        entries = list(self.batch_spec)
+        if not entries or entries[0] is None:
+            return ()
+        first = entries[0]
+        axes = tuple(first) if isinstance(first, tuple) else (first,)
+        if any(e is not None for e in entries[1:]):
+            return None  # batch sharded beyond dim0: unsupported
+        return axes
+
+    def _resolve_qsync(self):
+        """Decide whether this build replaces the implicit fp32 grad
+        psum with the bucketed quantized all-reduce. Returns
+        (axes, nranks, buckets) or None; unsupported configurations
+        warn once and fall back to the exact path — the flag must never
+        silently change math it cannot faithfully compress."""
+        if not _compress.quantized_sync_enabled():
+            return None
+
+        def bail(why):
+            warnings.warn(
+                "FLAGS_quantized_grad_sync requested but unsupported "
+                "for this step (%s); using the exact fp32 grad sync"
+                % why)
+            return None
+
+        axes = self._batch_axes()
+        if axes is None or not axes:
+            return bail("batch is not sharded over leading mesh axes")
+        nranks = 1
+        for a in axes:
+            nranks *= self.mesh.shape.get(a, 1)
+        if nranks <= 1:
+            return None  # nothing to reduce; exact path, no warning
+        other = [a for a in self.mesh.axis_names if a not in axes
+                 and self.mesh.shape[a] > 1]
+        if other:
+            return bail("non-batch mesh axes %s have size > 1 (params "
+                        "are not replicated over the manual axes)"
+                        % other)
+        if self.zero_stage >= 3:
+            return bail("ZeRO stage 3 shards parameters")
+        for n in self._names:
+            spec = getattr(self._tensors[n], "_sharding_spec", None)
+            if spec is None:
+                continue
+            # annotations binding only size-1 axes (an mp-annotated
+            # model on a pure data-parallel mesh) are effectively
+            # replicated — only a REAL sharding blocks the manual path
+            used = [a for e in spec if e is not None
+                    for a in (e if isinstance(e, tuple) else (e,))]
+            if any(self.mesh.shape.get(a, 1) > 1 for a in used):
+                return bail(
+                    "parameter %r is sharded over %s (params must be "
+                    "replicated over the manual batch axes)" % (n, used))
+
+        def numel(n):
+            size = 1
+            for d in self._tensors[n].shape:
+                size *= int(d)
+            return size
+
+        # buckets hold INDICES into trainable_names (the grad list order)
+        sized = [(i, numel(n) * 4)
+                 for i, n in enumerate(self._trainable_names)]
+        buckets = _compress.plan_buckets(sized)
+        block = _compress.DEFAULT_BLOCK
+        fp32 = sum(_compress.ring_allreduce_bytes(b // 4, nranks, False)
+                   for _, b in sized)
+        q8 = sum(_compress.ring_allreduce_bytes(b // 4, nranks, True,
+                                                block)
+                 for _, b in sized)
+        if _monitor.is_enabled():
+            _compress.GRAD_SYNC_BUCKETS.set(len(buckets))
+            _compress.GRAD_SYNC_BYTES_STEP.labels(
+                compressed="false").set(fp32)
+            _compress.GRAD_SYNC_BYTES_STEP.labels(
+                compressed="true").set(q8)
+        return (axes, nranks, buckets)
+
+    def _init_ef_state(self, axes, nranks):
+        """Per-param error-feedback residuals: one f32 copy of each
+        trainable param PER RANK, carried in the step's donated state
+        next to the optimizer slots and threaded through every compiled
+        call. Sharded over the batch axes so each device holds exactly
+        its own rank's residual."""
+        sharding = NamedSharding(self.mesh, P(axes))
+        return {
+            n: jax.device_put(
+                jnp.zeros((nranks,) + tuple(self._tensors[n].shape),
+                          jnp.float32), sharding)
+            for n in self._trainable_names}
+
     # -- compiled step -----------------------------------------------------
 
     def _build(self):
@@ -261,45 +390,111 @@ class CompiledTrainStep:
                          for n, slots in opt_specs.items()}
         batch_sharding = NamedSharding(mesh, self.batch_spec)
         repl = NamedSharding(mesh, P())
+        qsync = self._resolve_qsync()
+        self._qsync = qsync
+        if qsync is not None and not self._ef_state:
+            self._ef_state = self._init_ef_state(qsync[0], qsync[1])
+        ef_shardings = (
+            {n: NamedSharding(mesh, P(qsync[0]))
+             for n in self._trainable_names}
+            if qsync is not None else None)
+        stochastic = _compress.stochastic_rounding_enabled()
 
-        def step(state_vals, opt_state, step_i, lr_i, rng_key,
+        def loss_value(train_vals, state_vals, batch, rng_key, step_i,
+                       rank_salt=None):
+            """Pure loss of one (global or per-rank-local) batch: the
+            SAME function backs the exact path (value_and_grad under
+            GSPMD, XLA inserts the grad psum) and the quantized path
+            (value_and_grad per rank inside shard_map, grads stay
+            partial until OUR collective reduces them)."""
+            from ..framework import random as _random
+
+            full = dict(zip(names, state_vals))
+            full.update(dict(zip(trainable_names, train_vals)))
+            wrapped = [Tensor(b) for b in batch]
+            # thread per-step randomness: without a replay base,
+            # next_key() splits the global root AT TRACE TIME and
+            # every compiled step replays the same dropout masks
+            # (the frozen-mask caveat in framework/random.py).
+            # rng_key is an ARGUMENT (like lr): paddle.seed after
+            # compilation must steer the masks; folding the traced
+            # step counter gives fresh masks each step
+            key = jax.random.fold_in(rng_key, step_i)
+            if rank_salt is not None:
+                # manual-SPMD dropout: each rank draws its shard's
+                # masks from a rank-salted key (under GSPMD one global
+                # mask is sharded instead; the streams differ, which is
+                # part of the documented flag-on approximation)
+                key = jax.random.fold_in(key, rank_salt)
+            with _random.replay_base(key):
+                with model.bind_state(names,
+                                      [full[n] for n in names]):
+                    with no_grad():
+                        if labels_to_model:
+                            out = model(*wrapped)
+                        else:
+                            out = model(*wrapped[:-1]) \
+                                if len(wrapped) > 1 \
+                                else model(wrapped[0])
+                    if labels_to_model:
+                        loss = out if loss_fn is None \
+                            else loss_fn(out, wrapped[-1])
+                    else:
+                        loss = loss_fn(out, wrapped[-1])
+            return loss._value if isinstance(loss, Tensor) else loss
+
+        def quantized_grads(state_vals, ef_state, step_i, rng_key,
+                            batch):
+            """Forward+backward inside a shard_map manual over the
+            batch axes: grads come out as PARTIAL per-rank sums and the
+            explicit bucketed quantized all-reduce (compress.py) is the
+            only cross-rank traffic — int8 payloads + block scales on
+            the wire instead of the implicit fp32 psum."""
+            axes, nranks, buckets = qsync
+            # mean loss: global mean == mean of per-shard means (equal
+            # shards) and grads combine by pmean; sum loss: psum both
+            sum_loss = self.loss_reduction == "sum"
+
+            def body(state_vals_m, ef_m, step_m, rng_m, batch_m):
+                train_m = dict(zip(names, state_vals_m))
+                train_vals_m = [train_m[n] for n in trainable_names]
+                salt = jax.lax.axis_index(axes)
+                loss_l, grads_l = jax.value_and_grad(loss_value)(
+                    train_vals_m, state_vals_m, batch_m, rng_m, step_m,
+                    salt)
+                loss = (jax.lax.psum(loss_l, axes) if sum_loss
+                        else jax.lax.pmean(loss_l, axes))
+                ef_l = [ef_m[n][0] for n in trainable_names]
+                key = None
+                if stochastic:
+                    key = jax.random.fold_in(
+                        jax.random.fold_in(rng_m, step_m), salt)
+                new_grads, new_ef = _compress.reduce_grads_traced(
+                    grads_l, ef_l, axes, nranks, buckets,
+                    stochastic=stochastic, key=key, mean=not sum_loss)
+                ef_out = {n: e[None] for n, e in
+                          zip(trainable_names, new_ef)}
+                return loss, new_grads, ef_out
+
+            fn = _shard_map(
+                body, mesh=mesh,
+                in_specs=(P(), P(qsync[0]), P(), P(), self.batch_spec),
+                out_specs=(P(), P(), P(qsync[0])),
+                check_rep=False)
+            return fn(state_vals, ef_state, step_i, rng_key, batch)
+
+        def step(state_vals, opt_state, ef_state, step_i, lr_i, rng_key,
                  batch):
             _TRAIN_COMPILES.labels(kind="step").inc()  # trace-time
             state = dict(zip(names, state_vals))
-
-            def loss_of(train_vals, batch):
-                from ..framework import random as _random
-
-                full = dict(state)
-                full.update(dict(zip(trainable_names, train_vals)))
-                wrapped = [Tensor(b) for b in batch]
-                # thread per-step randomness: without a replay base,
-                # next_key() splits the global root AT TRACE TIME and
-                # every compiled step replays the same dropout masks
-                # (the frozen-mask caveat in framework/random.py).
-                # rng_key is an ARGUMENT (like lr): paddle.seed after
-                # compilation must steer the masks; folding the traced
-                # step counter gives fresh masks each step
-                with _random.replay_base(
-                        jax.random.fold_in(rng_key, step_i)):
-                    with model.bind_state(names,
-                                          [full[n] for n in names]):
-                        with no_grad():
-                            if labels_to_model:
-                                out = model(*wrapped)
-                            else:
-                                out = model(*wrapped[:-1]) \
-                                    if len(wrapped) > 1 \
-                                    else model(wrapped[0])
-                        if labels_to_model:
-                            loss = out if loss_fn is None \
-                                else loss_fn(out, wrapped[-1])
-                        else:
-                            loss = loss_fn(out, wrapped[-1])
-                return loss._value if isinstance(loss, Tensor) else loss
-
             train_vals = [state[n] for n in trainable_names]
-            loss, grads = jax.value_and_grad(loss_of)(train_vals, batch)
+            if qsync is None:
+                loss, grads = jax.value_and_grad(loss_value)(
+                    train_vals, state_vals, batch, rng_key, step_i)
+                new_ef = ef_state
+            else:
+                loss, grads, new_ef = quantized_grads(
+                    state_vals, ef_state, step_i, rng_key, batch)
             if zero_stage >= 2:
                 grads = [jax.lax.with_sharding_constraint(
                     g, grad_shardings[n])
@@ -313,17 +508,18 @@ class CompiledTrainStep:
             out_state = []
             for n in names:
                 out_state.append(new_p[n] if n in new_p else state[n])
-            return loss, out_state, new_s
+            return loss, out_state, new_s, new_ef
 
         self._step_fn = step
         self._shardings = (state_shardings, opt_shardings, batch_sharding,
-                           repl)
+                           repl, ef_shardings)
         self._compiled = jax.jit(
             step,
-            in_shardings=(state_shardings, opt_shardings, None, None,
-                          None, batch_sharding),
-            out_shardings=(repl, state_shardings, opt_shardings),
-            donate_argnums=(0, 1) if self.donate else (),
+            in_shardings=(state_shardings, opt_shardings, ef_shardings,
+                          None, None, None, batch_sharding),
+            out_shardings=(repl, state_shardings, opt_shardings,
+                           ef_shardings),
+            donate_argnums=(0, 1, 2) if self.donate else (),
         )
 
     def _build_multi(self):
@@ -335,32 +531,35 @@ class CompiledTrainStep:
         if self._step_fn is None:
             self._build()
         step_fn = self._step_fn
-        (state_shardings, opt_shardings, _batch_sharding, repl) = \
-            self._shardings
+        (state_shardings, opt_shardings, _batch_sharding, repl,
+         ef_shardings) = self._shardings
         stacked_sharding = self._batch_sharding(stacked=True)
 
-        def multi(state_vals, opt_state, step0, lr_i, rng_key, batches):
+        def multi(state_vals, opt_state, ef_state, step0, lr_i, rng_key,
+                  batches):
             _TRAIN_COMPILES.labels(kind="multi").inc()  # trace-time
             k = batches[0].shape[0]
 
             def body(i, carry):
-                sv, ost, _ = carry
+                sv, ost, ef, _ = carry
                 batch = tuple(b[i] for b in batches)
-                loss, new_sv, new_ost = step_fn(
-                    sv, ost, step0 + i.astype(jnp.int32), lr_i, rng_key,
-                    batch)
-                return (new_sv, new_ost, loss.astype(jnp.float32))
+                loss, new_sv, new_ost, new_ef = step_fn(
+                    sv, ost, ef, step0 + i.astype(jnp.int32), lr_i,
+                    rng_key, batch)
+                return (new_sv, new_ost, new_ef,
+                        loss.astype(jnp.float32))
 
-            init = (state_vals, opt_state, jnp.float32(0))
-            sv, ost, loss = jax.lax.fori_loop(0, k, body, init)
-            return loss, sv, ost
+            init = (state_vals, opt_state, ef_state, jnp.float32(0))
+            sv, ost, ef, loss = jax.lax.fori_loop(0, k, body, init)
+            return loss, sv, ost, ef
 
         self._compiled_multi = jax.jit(
             multi,
-            in_shardings=(state_shardings, opt_shardings, None, None,
-                          None, stacked_sharding),
-            out_shardings=(repl, state_shardings, opt_shardings),
-            donate_argnums=(0, 1) if self.donate else (),
+            in_shardings=(state_shardings, opt_shardings, ef_shardings,
+                          None, None, None, stacked_sharding),
+            out_shardings=(repl, state_shardings, opt_shardings,
+                           ef_shardings),
+            donate_argnums=(0, 1, 2) if self.donate else (),
         )
 
     @no_grad()
@@ -388,8 +587,8 @@ class CompiledTrainStep:
         t0 = time.perf_counter()
         with _HB_TRAIN.busy("train.run_steps", steps=k,
                             step0=self._step_count + 1):
-            loss, new_state, new_opt = self._compiled_multi(
-                state_vals, self._opt_state,
+            loss, new_state, new_opt, new_ef = self._compiled_multi(
+                state_vals, self._opt_state, self._ef_state,
                 jnp.asarray(self._step_count + 1, jnp.int32),
                 jnp.asarray(self.optimizer.get_lr(), jnp.float32),
                 _random._key(), vals)
@@ -398,6 +597,7 @@ class CompiledTrainStep:
         for n, v in zip(self._names, new_state):
             tensors[n]._value = v
         self._opt_state = new_opt
+        self._ef_state = new_ef
         return Tensor(loss)
 
     def _sync_opt_state_out(self):
@@ -456,7 +656,8 @@ class CompiledTrainStep:
         from ..framework import random as _random
 
         return self._compiled.lower(
-            state_vals, self._opt_state, jnp.asarray(0, jnp.int32),
+            state_vals, self._opt_state, self._ef_state,
+            jnp.asarray(0, jnp.int32),
             jnp.asarray(0.0, jnp.float32), _random._key(),
             vals).compile().as_text()
 
@@ -473,8 +674,8 @@ class CompiledTrainStep:
         self._step_count += 1
         t0 = time.perf_counter()
         with _HB_TRAIN.busy("train.step", step=self._step_count):
-            loss, new_state, new_opt = self._compiled(
-                state_vals, self._opt_state,
+            loss, new_state, new_opt, new_ef = self._compiled(
+                state_vals, self._opt_state, self._ef_state,
                 jnp.asarray(self._step_count, jnp.int32),
                 jnp.asarray(self.optimizer.get_lr(), jnp.float32),
                 _random._key(), vals)
@@ -482,6 +683,7 @@ class CompiledTrainStep:
         for n, v in zip(self._names, new_state):
             tensors[n]._value = v
         self._opt_state = new_opt
+        self._ef_state = new_ef
         return Tensor(loss)
 
 

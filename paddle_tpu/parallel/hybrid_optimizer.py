@@ -54,6 +54,10 @@ class HybridParallelOptimizer:
         self._gm_avg = bool(cfg.get("avg", True))
         self._gm_count = 0
         self._gm_buffers = {}
+        # error-feedback residuals for the quantized eager grad sync
+        # (per-param flat f32, persists across steps — see
+        # distributed/compress.py)
+        self._ef_residuals = {}
         sh_cfg = getattr(strategy, "sharding_configs", None) or {}
         self._offload = bool(getattr(strategy, "sharding", False)
                              and sh_cfg.get("offload", False))
@@ -171,12 +175,23 @@ class HybridParallelOptimizer:
         if self._hcg is not None and not ls_active:
             dp_group = self._hcg.get_data_parallel_group()
             if _eager_multiprocess(dp_group):
-                from ..distributed import collective
+                from ..distributed import collective, compress
 
-                for p in self._inner_opt._get_params():
-                    if p.grad is not None:
-                        collective.all_reduce(p.grad, group=dp_group)
-                        p.grad._value = p.grad._value / dp_group.nranks
+                if compress.quantized_sync_enabled():
+                    # same bucketed compressed sync as DataParallel —
+                    # with the per-param error-feedback residuals that
+                    # make lossy grad reduction convergence-safe (a
+                    # bare compressed all_reduce would drop sub-ulp
+                    # gradient mass systematically, no residual)
+                    compress.sync_gradients_compressed(
+                        self._inner_opt._get_params(), dp_group,
+                        residuals=self._ef_residuals)
+                else:
+                    for p in self._inner_opt._get_params():
+                        if p.grad is not None:
+                            collective.all_reduce(p.grad, group=dp_group)
+                            p.grad._value = \
+                                p.grad._value / dp_group.nranks
         if self._offload:
             self._onload_accumulators()
         self._inner_opt.step()

@@ -1,0 +1,255 @@
+"""4-process dp=2 x sharding=2 worker for the quantized-communication
+parity suite (tests/test_compress.py).
+
+Phases (every rank runs all of them; rank 0 prints COMPRESS_RESULT):
+
+1. **DataParallel sync, fp32 vs int8**: the same seeded MLP trains
+   twice over the world group — flag off (per-param fp32 all_reduce)
+   and flag on (bucketed compressed sync with error feedback). Records
+   both loss trajectories, the ``comm_bytes_total{path=eager}``
+   counter deltas per format (the >=3x acceptance assertion), the
+   flight-recorder all_reduce count per sync (bucketing pin: buckets,
+   not params), and that recorder entries carry ``wire_bytes``.
+
+2. **ZeRO-2-style numpy training over subgroups**: grads
+   reduce-scattered over the 'sharding' subgroup, chunk-allreduced over
+   the 'dp' subgroup, params all-gathered back — fp32 vs compressed
+   wire, loss sequences recorded for the tolerance check.
+
+3. **Mismatch validation**: rank 1 passes a wrong-shaped tensor to the
+   strict all_gather; every rank must get the clear error NAMING rank 1
+   (validated on the self-describing frames before reassembly) instead
+   of a cryptic stack() failure or hang.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+
+N_STEPS = 10
+
+
+def _snapshot_comm_bytes():
+    from paddle_tpu.distributed import compress
+
+    return {
+        "false": compress.COMM_BYTES.labels(
+            path="eager", compressed="false").value,
+        "true": compress.COMM_BYTES.labels(
+            path="eager", compressed="true").value,
+    }
+
+
+def _train_dp(paddle, dist, flag_on, seed=3):
+    """One DataParallel training run over the world group; returns
+    (losses, comm-bytes-delta dict, allreduce records per sync)."""
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu import monitor
+    from paddle_tpu.core import flags as fl
+
+    rank = dist.get_rank()
+    nranks = dist.get_world_size()
+    paddle.seed(seed)
+    model = nn.Sequential(nn.Linear(64, 256), nn.Tanh(),
+                          nn.Linear(256, 8))
+    # the default Group("dp") has no process backend; the world group
+    # carries the store pg that makes the eager sync real
+    dp = paddle.DataParallel(
+        model, group=dist.collective._get_default_group())
+    opt = paddle.optimizer.SGD(learning_rate=0.05,
+                               parameters=model.parameters())
+    rng = np.random.RandomState(0)
+    x = rng.rand(32, 64).astype(np.float32)
+    y = rng.randint(0, 8, 32)
+    shard = 32 // nranks
+    xl = x[rank * shard:(rank + 1) * shard]
+    yl = y[rank * shard:(rank + 1) * shard]
+
+    fl.set_flags({"FLAGS_quantized_grad_sync": flag_on,
+                  # small threshold so the 4 params coalesce into
+                  # exactly 2 buckets: [W1] (64KiB) and [b1, W2, b2]
+                  "FLAGS_grad_sync_bucket_mb": 0.0625})
+    rec = monitor.get_flight_recorder()
+    b0 = _snapshot_comm_bytes()
+    losses = []
+    sync_allreduces = None
+    try:
+        for step in range(N_STEPS):
+            out = dp(paddle.to_tensor(xl))
+            loss = F.cross_entropy(out, paddle.to_tensor(yl))
+            loss.backward()
+            n_rec0 = len(rec.entries())
+            dp.sync_gradients()
+            if sync_allreduces is None:
+                entries = rec.entries()[n_rec0:]
+                sync_allreduces = [e for e in entries
+                                   if e["op"] == "all_reduce"]
+            # the loss each rank reports is its LOCAL shard loss; make
+            # it the global mean like the compiled step would
+            gl = dist.collective._get_default_group().pg.allreduce(
+                np.asarray(float(loss)), "avg")
+            losses.append(float(gl))
+            opt.step()
+            opt.clear_grad()
+    finally:
+        fl.set_flags({"FLAGS_quantized_grad_sync": False})
+    b1 = _snapshot_comm_bytes()
+    delta = {k: b1[k] - b0[k] for k in b1}
+    return losses, delta, sync_allreduces
+
+
+def _train_zero2(dist, compressed, dp_group, sh_group, seed=11):
+    """Numpy ZeRO-2-flavor training: batch split over all 4 ranks
+    (dp x sharding is the data-parallel world), grads reduce-scattered
+    over the sharding subgroup, each rank's owned chunk all-reduced
+    over the dp subgroup, updated shards all-gathered back."""
+    pg_sh = sh_group.pg
+    pg_dp = dp_group.pg
+    world = dist.get_world_size()
+    rank = dist.get_rank()
+    rng = np.random.RandomState(seed)
+    W1 = (rng.randn(64, 64) * 0.1).astype(np.float32)
+    W2 = (rng.randn(64, 8) * 0.1).astype(np.float32)
+    X = rng.randn(32, 64).astype(np.float32)
+    Y = rng.randn(32, 8).astype(np.float32)
+    shard = 32 // world
+    Xl = X[rank * shard:(rank + 1) * shard]
+    Yl = Y[rank * shard:(rank + 1) * shard]
+    nsh = pg_sh.world_size
+    lr = 0.05
+    losses = []
+    residual = None
+    for _ in range(N_STEPS):
+        h = np.tanh(Xl @ W1)
+        out = h @ W2
+        diff = out - Yl
+        loss_local = float((diff ** 2).mean())
+        gout = 2.0 * diff / diff.size
+        gW2 = h.T @ gout
+        gh = gout @ W2.T
+        gW1 = Xl.T @ (gh * (1.0 - h * h))
+        flat = np.concatenate([gW1.reshape(-1), gW2.reshape(-1)]) \
+            .astype(np.float32)
+        pad = (-flat.size) % nsh
+        flat = np.pad(flat, (0, pad))
+        if compressed and residual is not None:
+            flat = flat + residual
+        if compressed:
+            from paddle_tpu.distributed import compress
+
+            q, s = compress.quantize_np(flat)
+            residual = flat - compress.dequantize_np(q, s)
+        # sharding-group reduce-scatter of the flat grad, then the
+        # owned chunk rides the dp-group all-reduce: every rank ends
+        # holding the WORLD-summed chunk it owns
+        chunk = pg_sh.reduce_scatter(
+            flat.reshape(nsh, -1), "sum", compressed=compressed)
+        chunk = pg_dp.allreduce(chunk, "sum", compressed=compressed)
+        chunk = chunk.reshape(-1) / world
+        # update owned shard, gather shards back (param sync stays
+        # fp32: compressing it is ZeRO-3 territory, not grad sync)
+        upd = chunk * lr
+        parts = pg_sh.allgather(upd, compressed=False)
+        full = np.concatenate([p.reshape(-1) for p in parts])
+        delta = full[:W1.size + W2.size]
+        W1 -= delta[:W1.size].reshape(W1.shape)
+        W2 -= delta[W1.size:].reshape(W2.shape)
+        loss = float(pg_dp.allreduce(np.asarray(loss_local), "avg"))
+        loss = float(pg_sh.allreduce(np.asarray(loss), "avg"))
+        losses.append(loss)
+    return losses
+
+
+def main():
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    dist.init_parallel_env()
+    assert dist.get_world_size() == 4
+
+    result = {"rank": rank}
+
+    # phase 1: DataParallel fp32 vs compressed
+    fp_losses, fp_bytes, fp_recs = _train_dp(paddle, dist, False)
+    q_losses, q_bytes, q_recs = _train_dp(paddle, dist, True)
+    result.update({
+        "fp32_losses": fp_losses,
+        "q8_losses": q_losses,
+        "fp32_bytes": fp_bytes,
+        "q8_bytes": q_bytes,
+        "fp32_allreduces_per_sync": len(fp_recs),
+        "q8_allreduces_per_sync": len(q_recs),
+        "q8_wire_bytes_recorded": all(
+            e.get("wire_bytes", 0) > 0 for e in q_recs),
+    })
+
+    # phase 2: ZeRO-2 subgroup training. dp groups pair ranks with the
+    # same sharding index; sharding groups pair ranks on the same dp
+    # index (rank = dp_idx * 2 + sh_idx)
+    dp_groups = [[0, 2], [1, 3]]
+    sh_groups = [[0, 1], [2, 3]]
+    my_dp = my_sh = None
+    for ranks in dp_groups:
+        g = dist.new_group(ranks=ranks)
+        if rank in ranks:
+            my_dp = g
+    for ranks in sh_groups:
+        g = dist.new_group(ranks=ranks)
+        if rank in ranks:
+            my_sh = g
+    z_fp = _train_zero2(dist, False, my_dp, my_sh)
+    z_q8 = _train_zero2(dist, True, my_dp, my_sh)
+    result["zero2_fp32_losses"] = z_fp
+    result["zero2_q8_losses"] = z_q8
+
+    # phase 2b: object collectives ride the same store transport with
+    # legitimately rank-varying payloads — the strict validation and
+    # the compressed wire format must both leave them alone
+    # (regression: np was not imported at collective.py module level,
+    # so every multi-rank *_object call died with NameError)
+    objs = []
+    dist.all_gather_object(objs, {"rank": rank, "blob": "x" * (rank + 1)})
+    assert [o["rank"] for o in objs] == [0, 1, 2, 3], objs
+    carried = [{"seed": 42}] if rank == 2 else [None]
+    dist.broadcast_object_list(carried, src=2)
+    assert carried == [{"seed": 42}], carried
+    result["object_collectives_ok"] = True
+
+    # phase 2c: non-sum reductions stay EXACT even with the flag on
+    # (review-found: per-rank rounding error neither averages out nor
+    # re-enters via residuals for max/min/prod)
+    from paddle_tpu.core import flags as fl
+
+    fl.set_flags({"FLAGS_quantized_grad_sync": True})
+    try:
+        pg = dist.collective._get_default_group().pg
+        vals = (np.linspace(0, 1, 4096).astype(np.float32)
+                + 0.001 * rank)
+        got = pg.allreduce(vals, "max")
+        expect = np.linspace(0, 1, 4096).astype(np.float32) + 0.003
+        result["max_exact"] = bool(np.array_equal(got, expect))
+    finally:
+        fl.set_flags({"FLAGS_quantized_grad_sync": False})
+
+    # phase 3: strict all_gather shape-mismatch validation — rank 1
+    # ships a deviant shape; EVERY rank must see the error naming it
+    t = paddle.to_tensor(
+        np.zeros((3, 2) if rank == 1 else (4, 2), np.float32))
+    try:
+        dist.all_gather(None, t)
+        result["mismatch_error"] = None
+    except ValueError as e:
+        result["mismatch_error"] = str(e)
+    dist.barrier()
+
+    print("COMPRESS_RESULT " + json.dumps(result))
+    sys.stdout.flush()
+
+
+if __name__ == "__main__":
+    main()

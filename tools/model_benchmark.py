@@ -280,13 +280,17 @@ def bench_allreduce(results, iters=None):
         jnp.ones((n, elems // n), jnp.float32),
         NamedSharding(mesh, P("x", None)))
 
+    # the version-portable shim (jax.shard_map only exists on newer
+    # jax; 0.4.x ships it under experimental) lives in collective.py
+    from paddle_tpu.distributed.collective import shard_map
+
     @jax.jit
     def ar(x):
         def body(x):
             return jax.lax.psum(x, "x")
 
-        return jax.shard_map(body, mesh=mesh, in_specs=P("x", None),
-                             out_specs=P("x", None))(x)
+        return shard_map(body, mesh=mesh, in_specs=(P("x", None),),
+                         out_specs=P("x", None), check_rep=False)(x)
 
     y = ar(x)
     float(y[0, 0])

@@ -278,6 +278,20 @@ def _cases():
          lambda g: g * (1.0 / jnp.maximum(
              1.0, jnp.sqrt(jnp.sum(g * g)) / 1.0)))
 
+    # -- gradient compression (distributed/compress.py quantized sync:
+    #    the per-step host-side tax of the ~4x wire saving; sized like
+    #    the optimizer rows — one full grad pass) --
+    from paddle_tpu.kernels.quant import (dequantize_int8_block,
+                                          quantize_int8_block)
+
+    qrows = max(n25m // 4096, 1)
+    qx = jnp.asarray(rng.randn(qrows, 4096), jnp.float32)
+    case("quantize_int8_block_25M", (qx,),
+         lambda x: quantize_int8_block(x))
+    qq, qs = quantize_int8_block(qx)
+    case("dequantize_int8_block_25M", (qq, qs),
+         lambda q, sc: dequantize_int8_block(q, sc))
+
     # -- manipulation family --
     case("transpose_0213_8x12x512x64",
          (s(8, 12, 512, 64),),

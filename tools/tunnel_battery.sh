@@ -55,6 +55,14 @@ run model_widedeep 600 python tools/model_benchmark.py widedeep
 #    committing a baseline that guards only what happened to finish
 run op_update 1800 python tools/op_benchmark.py update --strict-coverage
 
+# 3b. eager collective wire benchmark: fp32 vs block-scaled int8
+#     through the TCP store transport (the multi-host eager sync path;
+#     distributed/compress.py). Wire bytes come from the comm_bytes
+#     registry counters — the same series the acceptance tests assert.
+run comm 600 python tools/comm_benchmark.py \
+    --sizes 262144 1048576 4194304 --iters 5 \
+    --out tools/comm_bench.json
+
 # 4. step ablations (fixed grad threading; resnet layout tax; ernie
 #    dropout/attention attribution)
 run ablate_134m 1200 python tools/step_ablation.py --config 134m \
