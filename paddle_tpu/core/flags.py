@@ -68,6 +68,27 @@ _DEFAULTS = {
     # the compiled step issues few large reductions XLA can overlap
     # with backward compute instead of many tiny ones
     "FLAGS_grad_sync_bucket_mb": 4.0,
+    # metric time-series ring (monitor/timeseries.py): every registry
+    # Counter/Gauge sample also appends (ts, value) to a bounded
+    # per-series ring — the substrate for /debugz/timeseries, watchdog
+    # bundle tails, and the perf sentinels. Off = the registry hot path
+    # is unchanged (the hook slot stays None; test-pinned).
+    "FLAGS_monitor_timeseries": False,
+    # MFU/goodput attribution (monitor/perf.py): compiled train steps
+    # publish mfu / model_flops / hbm_peak_bytes / per-step phase split
+    # (compute vs comm vs host), the serving engine publishes per-token
+    # goodput + KV-page occupancy. Costs one extra AOT lower+compile of
+    # the step (for XLA cost/memory analysis) and one loss-scalar host
+    # readback per step — opt-in for measurement runs, off on the
+    # training hot path by default.
+    "FLAGS_perf_attribution": False,
+    # regression sentinels (monitor/perf.py) over the time-series ring:
+    # NaN/inf loss, loss spike vs EWMA, throughput regression vs a
+    # rolling baseline, grad-norm explosion. Each firing increments
+    # perf_anomalies_total{kind}, drops a structured event into the
+    # flight-recorder ring, and flips the /healthz degraded flag.
+    # Enabling sentinels enables the time-series ring (they read it).
+    "FLAGS_perf_sentinels": False,
     # logging
     "FLAGS_v": 0,
     # structured errors (reference FLAGS_call_stack_level, enforce.h):

@@ -18,7 +18,20 @@ Three pillars (one registry, one postmortem path, one timeline):
    tools/trace_merge.py): store-based clock-offset estimation and
    rank-prefixed chrome-trace aggregation into one aligned timeline.
 
-4. **Progress watchdog** (monitor/watchdog.py): heartbeat registry fed
+4. **Perf attribution + sentinels** (monitor/perf.py +
+   monitor/timeseries.py): MFU / model-FLOPs / HBM-peak accounting for
+   compiled train steps (XLA cost/memory analysis over measured wall
+   clock, phase-split compute|comm|host), per-token goodput + KV-page
+   occupancy for the serving engine; a bounded (ts, value) ring behind
+   every Counter/Gauge sample; pluggable regression sentinels (NaN
+   loss, loss spike, throughput cliff, grad-norm explosion) that
+   increment ``perf_anomalies_total{kind}``, drop events into the
+   flight-recorder ring, and flip the /healthz degraded flag. All
+   default-off (``FLAGS_perf_attribution`` / ``FLAGS_monitor_timeseries``
+   / ``FLAGS_perf_sentinels``); served at /debugz/perf +
+   /debugz/timeseries; rendered by tools/perf_report.py.
+
+5. **Progress watchdog** (monitor/watchdog.py): heartbeat registry fed
    by the compiled train step, the serving engine loop, and store
    collectives; a daemon thread (``start_watchdog()`` / ``PT_WATCHDOG``)
    turns a stalled heartbeat into a cross-rank diagnostic bundle
@@ -64,6 +77,8 @@ from .watchdog import (  # noqa: F401
     stop_watchdog,
 )
 from . import flight_recorder  # noqa: F401
+from . import perf  # noqa: F401
+from . import timeseries  # noqa: F401
 from . import trace_merge  # noqa: F401
 from . import watchdog  # noqa: F401
 
@@ -76,5 +91,5 @@ __all__ = [
     "FlightRecorder", "get_flight_recorder", "diagnose",
     "Heartbeat", "heartbeat", "start_watchdog", "stop_watchdog",
     "is_watchdog_running", "build_bundle", "diagnose_bundles",
-    "flight_recorder", "trace_merge", "watchdog",
+    "flight_recorder", "perf", "timeseries", "trace_merge", "watchdog",
 ]

@@ -13,6 +13,10 @@ server stack: ``KVHTTPServer`` gained a ``get_routes`` hook, and
     GET /debugz/flight  this rank's collective flight-recorder ring
     GET /debugz/bundle  full on-demand diagnostic bundle (stacks +
                         flight ring + metrics + heartbeat ages)
+    GET /debugz/perf    MFU/goodput attribution + anomaly state
+                        (monitor/perf.py payload)
+    GET /debugz/timeseries  the metric time-series rings
+                        (monitor/timeseries.py payload)
 
 The /healthz and /debugz routes are served live from monitor/watchdog.py
 whether or not the watchdog thread is running (the verdict just reads
@@ -29,6 +33,8 @@ import json
 import os
 import time
 
+from . import perf as _perf
+from . import timeseries as _timeseries
 from . import watchdog as _watchdog
 from .registry import get_registry
 
@@ -79,6 +85,8 @@ class MetricsServer:
         routes["debugz/stacks"] = _watchdog.http_stacks
         routes["debugz/flight"] = _watchdog.http_flight
         routes["debugz/bundle"] = _watchdog.http_bundle
+        routes["debugz/perf"] = self._perf
+        routes["debugz/timeseries"] = self._timeseries
 
     @property
     def port(self):
@@ -96,7 +104,20 @@ class MetricsServer:
         return 200, "text/plain; version=0.0.4; charset=utf-8", body
 
     def _json(self):
-        body = json.dumps(snapshot(self._registry), default=str).encode()
+        # json_safe: a NaN gauge (the sentinel's input) must not turn
+        # the scrape into an unparseable bare-NaN body mid-incident
+        body = json.dumps(_watchdog.json_safe(snapshot(self._registry)),
+                          default=str).encode()
+        return 200, "application/json", body
+
+    def _perf(self):
+        body = json.dumps(_watchdog.json_safe(_perf.perf_payload()),
+                          default=str).encode()
+        return 200, "application/json", body
+
+    def _timeseries(self):
+        body = json.dumps(_watchdog.json_safe(_timeseries.payload()),
+                          default=str).encode()
         return 200, "application/json", body
 
 

@@ -90,6 +90,32 @@ class FlightRecorder:
     def _end(self, entry):
         entry["t_end"] = time.time()
 
+    def note_event(self, kind, **data):
+        """Append a structured NON-collective event to the ring (perf
+        sentinels drop anomaly events here so a postmortem ring dump
+        interleaves "loss went NaN at t" with the collective stream).
+        Events do NOT advance ``seq``/``gseq`` and carry
+        ``group="__events"`` — they are invisible to the cross-rank
+        stream diagnosis, which compares collective call streams only
+        (an anomaly firing on one rank must never read as a desync)."""
+        if not self.enabled:
+            return None
+        entry = {
+            "event": kind,
+            "seq": None,
+            "gseq": None,
+            "op": "event:%s" % kind,
+            "group": "__events",
+            "t_start": time.time(),
+            "t_end": None,
+            "data": dict(data),
+        }
+        with self._lock:
+            self._buf.append(entry)
+            if len(self._buf) > self.capacity:
+                del self._buf[:len(self._buf) - self.capacity]
+        return entry
+
     def note_bytes(self, nbytes):
         """Attribute wire payload bytes to the currently-open outermost
         record on this thread (the store transport calls this from its
@@ -198,7 +224,12 @@ def diagnose(buffers, world_size=None, group=None):
       missing_ranks     ranks (0..world_size-1) with no dump at all
       expected / observed    majority signature vs per-rank signatures
     """
-    buffers = {int(r): list(b) for r, b in buffers.items()}
+    # event entries (note_event: perf anomalies etc.) carry no sequence
+    # number and are rank-local by nature — drop them before alignment
+    # so a one-rank anomaly can never masquerade as a stream divergence
+    buffers = {int(r): [e for e in b
+                        if not e.get("event") and e.get("seq") is not None]
+               for r, b in buffers.items()}
     missing = []
     if world_size:
         missing = [r for r in range(world_size) if r not in buffers]

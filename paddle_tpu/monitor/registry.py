@@ -32,7 +32,7 @@ import time
 
 
 class _State:
-    __slots__ = ("enabled", "trace_bridge", "_trace_fn")
+    __slots__ = ("enabled", "trace_bridge", "_trace_fn", "ts_hook")
 
     def __init__(self):
         self.enabled = os.environ.get("PT_MONITOR", "1").lower() \
@@ -40,6 +40,11 @@ class _State:
         self.trace_bridge = os.environ.get(
             "PT_MONITOR_TRACE", "0").lower() in ("1", "true", "on")
         self._trace_fn = None
+        # time-series ring hook (monitor/timeseries.py installs it):
+        # None = the ring is off and mutators pay exactly one extra
+        # attribute-load + branch — the same disabled-path discipline
+        # as trace_bridge, pinned by tests/test_perf.py
+        self.ts_hook = None
 
 
 _state = _State()
@@ -276,6 +281,8 @@ class Counter(Metric):
             self._values[key] = v
         if _state.trace_bridge:
             _trace_counter(self._series_name(key), v)
+        if _state.ts_hook is not None:
+            _state.ts_hook(self, key, v)
 
     def inc(self, amount=1):
         if not _state.enabled:
@@ -305,12 +312,16 @@ class Gauge(Counter):
             self._values[key] = v
         if _state.trace_bridge:
             _trace_counter(self._series_name(key), v)
+        if _state.ts_hook is not None:
+            _state.ts_hook(self, key, v)
 
     def _set(self, key, value):
         with self._lock:
             self._values[key] = value
         if _state.trace_bridge:
             _trace_counter(self._series_name(key), value)
+        if _state.ts_hook is not None:
+            _state.ts_hook(self, key, value)
 
     def set(self, value):
         if not _state.enabled:
@@ -349,6 +360,11 @@ class Histogram(Metric):
                     s[i] += 1
             s[-2] += value
             s[-1] += 1
+        if _state.ts_hook is not None:
+            # histograms ring the RAW observation (not the cumulative
+            # sum): train_step_seconds' ring is the per-step trace a
+            # hang postmortem wants
+            _state.ts_hook(self, key, value)
 
     def observe(self, value):
         if not _state.enabled:
@@ -467,6 +483,15 @@ class Registry:
 
 def _fmt(v):
     if isinstance(v, float):
+        # non-finite samples are legal (a NaN loss gauge IS the perf
+        # sentinel's input) — exposition-format spellings, never a
+        # crashed /metrics scrape mid-incident
+        if v != v:
+            return "NaN"
+        if v == float("inf"):
+            return "+Inf"
+        if v == float("-inf"):
+            return "-Inf"
         if v == int(v) and abs(v) < 1e15:
             return "%g" % v
         return repr(v)

@@ -1,0 +1,220 @@
+"""Metric time-series ring: bounded (ts, value) history per series.
+
+The registry (monitor/registry.py) keeps only the CURRENT value of each
+Counter/Gauge series — enough for a scrape target, useless for "when
+did throughput start sliding" questions asked mid-incident. This module
+adds the missing time dimension: when enabled, every Counter/Gauge
+sample (and Histogram observation) also appends ``(ts, value)`` to a
+bounded per-series ring, giving three consumers a shared substrate:
+
+1. **/debugz/timeseries** (monitor/exporter.py): the live rings as
+   JSON, filterable by prefix — an incident responder's first stop.
+2. **Watchdog bundle tails** (monitor/watchdog.py): diagnostic bundles
+   embed the last-K points of the step-time/throughput/comm series, so
+   a hang postmortem shows the deceleration leading INTO the stall,
+   not just the frozen instant.
+3. **Perf sentinels** (monitor/perf.py): regression detectors subscribe
+   to ring appends (``add_listener``) and watch for NaN losses, loss
+   spikes, throughput cliffs, grad-norm explosions.
+
+Discipline (the registry's own): default OFF via
+``FLAGS_monitor_timeseries`` (bootstrapped from the environment like
+every FLAGS_*), and while off the registry hot path is UNCHANGED — the
+hook slot in the registry state stays ``None``, so mutators pay the one
+pre-existing attribute-load + branch and nothing else; no threads, no
+native calls, nothing allocated (test-pinned by tests/test_perf.py).
+Everything here is stdlib-only so worker processes can run it without
+touching an accelerator backend.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from . import registry as _registry
+
+
+def _flag(name, default=False):
+    """FLAGS_* lookup without a hard core-package import at module load
+    (monitor stays stdlib-importable for bare worker processes)."""
+    try:
+        from ..core.flags import flag
+
+        return bool(flag(name, default))
+    except Exception:
+        raw = os.environ.get(name)
+        if raw is None:
+            return default
+        return raw.lower() in ("1", "true", "yes", "on")
+
+
+DEFAULT_CAPACITY = 256
+
+
+class Ring:
+    """Fixed-capacity list of (ts, value) points for one series."""
+
+    __slots__ = ("capacity", "_points")
+
+    def __init__(self, capacity):
+        self.capacity = max(int(capacity), 1)
+        self._points = []
+
+    def append(self, ts, value):
+        self._points.append((ts, value))
+        if len(self._points) > self.capacity:
+            del self._points[:len(self._points) - self.capacity]
+
+    def tail(self, k=None):
+        if k is None:
+            return list(self._points)
+        return list(self._points[-int(k):])
+
+    def values(self, k=None):
+        return [v for _, v in self.tail(k)]
+
+    def __len__(self):
+        return len(self._points)
+
+
+class _TSState:
+    __slots__ = ("enabled", "capacity", "rings", "lock", "listeners")
+
+    def __init__(self):
+        self.enabled = False
+        self.capacity = int(os.environ.get("PT_TIMESERIES_CAPACITY",
+                                           str(DEFAULT_CAPACITY)))
+        self.rings = {}         # series name -> Ring
+        self.lock = threading.Lock()
+        self.listeners = []     # fn(name, ts, value) — perf sentinels
+
+
+_state = _TSState()
+
+
+def _hook(metric, key, value):
+    """The registry-side mutator hook (installed only while enabled):
+    resolve the prometheus-style series name and record the sample.
+    Runs inline on the metric hot path — keep it allocation-light."""
+    record(metric._series_name(key), value)
+
+
+def record(name, value, ts=None):
+    """Append one point to ``name``'s ring (creating it on first use)
+    and fan out to listeners. Safe to call directly for series that
+    don't ride the registry (tests feed synthetic traces this way)."""
+    if not _state.enabled:
+        return
+    if ts is None:
+        ts = time.time()
+    try:
+        value = float(value)
+    except (TypeError, ValueError):
+        return
+    with _state.lock:
+        ring = _state.rings.get(name)
+        if ring is None:
+            ring = _state.rings[name] = Ring(_state.capacity)
+        ring.append(ts, value)
+    # listeners run OUTSIDE the lock: a sentinel that reads other rings
+    # (throughput vs step time) must not deadlock against a concurrent
+    # recorder; the rings' point lists are only ever appended to
+    for fn in list(_state.listeners):
+        try:
+            fn(name, ts, value)
+        except Exception:
+            pass
+
+
+def enable(capacity=None):
+    """Turn ring recording on (process-wide) and install the registry
+    hook. Idempotent; ``capacity`` only affects rings created later."""
+    if capacity is not None:
+        _state.capacity = max(int(capacity), 1)
+    _state.enabled = True
+    _registry._state.ts_hook = _hook
+    return _state
+
+
+def disable():
+    """Stop recording: the registry hook slot returns to ``None`` so
+    the mutator fast path is exactly the disabled-from-boot one.
+    Recorded rings are kept (snapshot-able post-incident); ``clear()``
+    drops them."""
+    _state.enabled = False
+    _registry._state.ts_hook = None
+
+
+def is_enabled():
+    return _state.enabled
+
+
+def clear():
+    with _state.lock:
+        _state.rings = {}
+
+
+def add_listener(fn):
+    """Subscribe ``fn(name, ts, value)`` to every ring append."""
+    if fn not in _state.listeners:
+        _state.listeners.append(fn)
+
+
+def remove_listener(fn):
+    try:
+        _state.listeners.remove(fn)
+    except ValueError:
+        pass
+
+
+def get_ring(name):
+    with _state.lock:
+        return _state.rings.get(name)
+
+
+def snapshot(match=None, k=None):
+    """{series: {capacity, points: [[ts, value], ...]}} — ``match``
+    filters by substring/prefix; ``k`` bounds each series' tail."""
+    with _state.lock:
+        items = list(_state.rings.items())
+    out = {}
+    for name, ring in items:
+        if match and match not in name:
+            continue
+        out[name] = {"capacity": ring.capacity,
+                     "points": [[ts, v] for ts, v in ring.tail(k)]}
+    return out
+
+
+def tail(prefixes=(), k=32):
+    """Last-K points of every series matching one of ``prefixes`` —
+    the watchdog-bundle embedding (a hang postmortem wants the step
+    time / throughput / comm deceleration, not every ring)."""
+    if not _state.enabled and not _state.rings:
+        return {}
+    with _state.lock:
+        items = list(_state.rings.items())
+    out = {}
+    for name, ring in items:
+        if prefixes and not any(name.startswith(p) for p in prefixes):
+            continue
+        out[name] = [[ts, v] for ts, v in ring.tail(k)]
+    return out
+
+
+def payload():
+    """The /debugz/timeseries JSON body."""
+    return {
+        "enabled": _state.enabled,
+        "capacity": _state.capacity,
+        "series_count": len(_state.rings),
+        "series": snapshot(),
+    }
+
+
+# env/FLAGS bootstrap (the registry's PT_MONITOR discipline): a process
+# started with FLAGS_monitor_timeseries=1 (or sentinels, which read the
+# ring) records from the first sample without any code change.
+if _flag("FLAGS_monitor_timeseries") or _flag("FLAGS_perf_sentinels"):
+    enable()

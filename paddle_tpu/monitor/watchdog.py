@@ -299,6 +299,25 @@ def build_bundle(reason="debugz", stalls=None):
         flight = get_flight_recorder().dump(rank, world)
     except Exception:
         flight = {}
+    # time-series tail (monitor/timeseries.py, ring enabled): the
+    # deceleration leading INTO the stall — step time, throughput, and
+    # comm series — not just the frozen instant
+    try:
+        from . import timeseries as _timeseries
+
+        ts_tail = _timeseries.tail(
+            prefixes=("train_step_seconds", "train_tokens_per_s",
+                      "train_loss", "comm_", "grad_sync_",
+                      "serving_throughput", "serving_goodput"),
+            k=int(os.environ.get("PT_WATCHDOG_TS_TAIL", "32")))
+    except Exception:
+        ts_tail = {}
+    try:
+        from . import perf as _perf
+
+        anomalies = _perf.anomaly_summary()
+    except Exception:
+        anomalies = {}
     return {
         "kind": "watchdog_bundle",
         "version": 1,
@@ -319,6 +338,8 @@ def build_bundle(reason="debugz", stalls=None):
         "stacks": thread_stacks(),
         "flight_recorder": flight,
         "metrics": metrics,
+        "timeseries_tail": ts_tail,
+        "perf_anomalies": anomalies,
     }
 
 
@@ -818,8 +839,22 @@ def healthz_payload():
     now = time.time()       # reported wall stamp; ages are monotonic
     stalls = _find_stalls() if _state.enabled else []
     _, rank, world = _world()
+    # perf-sentinel degradation (monitor/perf.py): a NaN loss or
+    # throughput cliff marks the endpoint degraded — orthogonal to the
+    # stalled verdict (a degraded run is alive and probe-200, but a
+    # deploy gate can read the flag)
+    try:
+        from . import perf as _perf
+
+        degraded = _perf.is_degraded()
+        anomalies = _perf.anomaly_summary() if degraded else None
+    except Exception:
+        degraded, anomalies = False, None
     return {
-        "status": "stalled" if stalls else "ok",
+        "status": "stalled" if stalls
+        else ("degraded" if degraded else "ok"),
+        "degraded": degraded,
+        "perf_anomalies": anomalies,
         "watchdog": "enabled" if _state.enabled else "disabled",
         "stall_threshold_s": _state.stall_threshold_s,
         "rank": rank,
@@ -836,9 +871,30 @@ def healthz_payload():
     }
 
 
+def json_safe(obj):
+    """Recursively replace non-finite floats with their string
+    spellings. HTTP debug payloads carry NaN on purpose (a NaN loss IS
+    the incident), but Python's json emits bare ``NaN`` tokens that
+    strict parsers (jq, JSON.parse) reject — and an incident-response
+    endpoint must stay parseable exactly mid-incident."""
+    if isinstance(obj, float):
+        if obj != obj:
+            return "NaN"
+        if obj == float("inf"):
+            return "Infinity"
+        if obj == float("-inf"):
+            return "-Infinity"
+        return obj
+    if isinstance(obj, dict):
+        return {k: json_safe(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [json_safe(v) for v in obj]
+    return obj
+
+
 def _json_route(payload, code=200):
     return code, "application/json", \
-        json.dumps(payload, default=str).encode()
+        json.dumps(json_safe(payload), default=str).encode()
 
 
 def http_healthz():

@@ -72,6 +72,10 @@ _DEV_MEM = _monitor.gauge(
 # a hung dispatch (wedged tunnel, XLA deadlock) is a detectable stall
 # while the idle time BETWEEN steps never is (monitor/watchdog.py)
 _HB_TRAIN = _monitor.heartbeat("train_step")
+# MFU/phase attribution (monitor/perf.py, FLAGS_perf_attribution):
+# opt-in because it costs one AOT lower+compile of the step (for the
+# XLA cost/memory analysis) and one loss-scalar host readback per step
+_perf = _monitor.perf
 
 
 def _batch_tokens(vals, stacked=False):
@@ -228,6 +232,9 @@ class CompiledTrainStep:
         # fp32 path (bit-identical to the flag-less build, test-pinned)
         self._qsync = None
         self._ef_state = {}
+        # per-instance perf attribution (monitor/perf.py), created on
+        # first step only while FLAGS_perf_attribution is on
+        self._perf_attr = None
 
     # -- sharding specs ----------------------------------------------------
 
@@ -592,7 +599,9 @@ class CompiledTrainStep:
                 jnp.asarray(self._step_count + 1, jnp.int32),
                 jnp.asarray(self.optimizer.get_lr(), jnp.float32),
                 _random._key(), vals)
-        _record_step(vals, k, time.perf_counter() - t0, stacked=True)
+        t1 = time.perf_counter()
+        _record_step(vals, k, t1 - t0, stacked=True)
+        self._note_perf(vals, k, t1 - t0, loss, t0, t1, stacked=True)
         self._step_count += k
         for n, v in zip(self._names, new_state):
             tensors[n]._value = v
@@ -661,6 +670,45 @@ class CompiledTrainStep:
             jnp.asarray(0.0, jnp.float32), _random._key(),
             vals).compile().as_text()
 
+    def perf_analysis(self, *batch):
+        """XLA cost/memory analysis of the SINGLE-step executable for
+        these batch shapes: {flops_per_step, hbm_peak_bytes, ...} via
+        monitor/perf.py. AOT lower+compile — one extra compilation, so
+        this is only reached under FLAGS_perf_attribution or from bench
+        tooling, never on the default hot path."""
+        if self._compiled is None:
+            self._build()
+        vals = self._prep_batch(batch)
+        state_vals = [self._tensors[n]._value for n in self._names]
+        from ..framework import random as _random
+
+        compiled = self._compiled.lower(
+            state_vals, self._opt_state, self._ef_state,
+            jnp.asarray(0, jnp.int32),
+            jnp.asarray(0.0, jnp.float32), _random._key(),
+            vals).compile()
+        return _perf.executable_analysis(compiled, steps=1)
+
+    def _note_perf(self, vals, steps, dt, loss, t0, t1, stacked=False):
+        """Feed one engine call into the MFU/phase attribution. The
+        analysis always lowers the SINGLE-step executable (per-step
+        FLOPs of a fori_loop body cannot be recovered from the
+        multi-step module's cost analysis): run_steps passes slice 0 of
+        its stacked batch as the representative shapes."""
+        if not (_monitor.is_enabled() and _perf.attribution_enabled()):
+            return
+        try:
+            if self._perf_attr is None:
+                single = tuple(v[0] for v in vals) if stacked else vals
+                self._perf_attr = _perf.TrainStepPerf(
+                    "train",
+                    analysis_fn=lambda b=single: self.perf_analysis(*b))
+            self._perf_attr.on_step(
+                dt, steps=steps, tokens=_batch_tokens(vals, stacked),
+                loss=loss, t_start=t0, t_end=t1)
+        except Exception:
+            pass
+
     @no_grad()
     def __call__(self, *batch):
         """batch = (*inputs, labels) as Tensors or arrays; returns loss."""
@@ -679,7 +727,9 @@ class CompiledTrainStep:
                 jnp.asarray(self._step_count, jnp.int32),
                 jnp.asarray(self.optimizer.get_lr(), jnp.float32),
                 _random._key(), vals)
-        _record_step(vals, 1, time.perf_counter() - t0)
+        t1 = time.perf_counter()
+        _record_step(vals, 1, t1 - t0)
+        self._note_perf(vals, 1, t1 - t0, loss, t0, t1)
         for n, v in zip(self._names, new_state):
             tensors[n]._value = v
         self._opt_state = new_opt

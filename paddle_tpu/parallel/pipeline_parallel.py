@@ -20,9 +20,12 @@ is not process choreography but program structure. Two modes:
 """
 from __future__ import annotations
 
+import time
+
 import jax
 import jax.numpy as jnp
 
+from .. import monitor as _monitor
 from ..core.tensor import Tensor
 from ..nn.layer import Layer
 from ..nn.layers.container import LayerList
@@ -578,6 +581,9 @@ class PipelinedTrainStep:
         optimizer._functional_sync = self._sync_opt_state_out
         optimizer._functional_load = self._load_opt_state_in
         self._compiled = None
+        # MFU/phase attribution (monitor/perf.py), opt-in via
+        # FLAGS_perf_attribution — same discipline as CompiledTrainStep
+        self._perf_attr = None
 
     # -- ZeRO slot/grad sharding -------------------------------------------
 
@@ -867,16 +873,59 @@ class PipelinedTrainStep:
             self._step_count += 1
             from ..framework import random as _random
 
+            t0 = time.perf_counter()
             loss, new_nb, new_stacked, new_opt = self._compiled(
                 nb_vals, stacked_vals, self._opt_state,
                 jnp.asarray(self._step_count, jnp.int32),
                 jnp.asarray(self.optimizer.get_lr(), jnp.float32),
                 _random._key(), batch)
+            t1 = time.perf_counter()
             for n, v in zip(self._nb_names, new_nb):
                 tensors[n]._value = v
             self._stacked = dict(zip(self.suffixes, new_stacked))
             self._opt_state = new_opt
+            self._note_perf(batch, t1 - t0, loss, t0, t1)
             return Tensor(loss)
+
+    def perf_analysis(self, input_ids, labels):
+        """XLA cost/memory analysis of the pipelined step executable
+        (monitor/perf.py; AOT lower+compile, perf-flag / bench only)."""
+        from ..framework import random as _random
+        from ..monitor import perf as _perf
+
+        if self._compiled is None:
+            self._build()
+        batch = tuple(
+            jax.device_put(b._value if isinstance(b, Tensor)
+                           else jnp.asarray(b),
+                           self._ns(self.batch_spec))
+            for b in (input_ids, labels))
+        tensors = self.model.raw_state_tensors()
+        nb_vals = [tensors[n]._value for n in self._nb_names]
+        stacked_vals = [self._stacked[s] for s in self.suffixes]
+        compiled = self._compiled.lower(
+            nb_vals, stacked_vals, self._opt_state,
+            jnp.asarray(0, jnp.int32), jnp.asarray(0.0, jnp.float32),
+            _random._key(), batch).compile()
+        return _perf.executable_analysis(compiled, steps=1)
+
+    def _note_perf(self, batch, dt, loss, t0, t1):
+        from ..monitor import perf as _perf
+
+        if not (_monitor.is_enabled() and _perf.attribution_enabled()):
+            return
+        try:
+            if self._perf_attr is None:
+                self._perf_attr = _perf.TrainStepPerf(
+                    "train_pp",
+                    analysis_fn=lambda b=batch: self.perf_analysis(*b))
+            tokens = 1
+            for d in batch[0].shape[:2]:
+                tokens *= int(d)
+            self._perf_attr.on_step(dt, steps=1, tokens=tokens,
+                                    loss=loss, t_start=t0, t_end=t1)
+        except Exception:
+            pass
 
     def sync_to_model(self):
         """Write the stacked block params back into the per-layer tensors

@@ -116,6 +116,16 @@ class Engine:
         with _HB_SERVE.busy("serving.step"):
             self._admit_and_prefill()
             self._grow_or_preempt()
+            # perf attribution (FLAGS_perf_attribution): KV-page
+            # occupancy + goodput per engine iteration, sampled at the
+            # step's high-water point (pages grown, nothing released
+            # yet) — pure host arithmetic, but still flag-gated so the
+            # default serving hot path does no new work
+            if _monitor.is_enabled() \
+                    and _monitor.perf.attribution_enabled():
+                alloc = self.cache.allocator
+                self.metrics.on_kv_occupancy(
+                    1.0 - alloc.free_blocks / max(alloc.usable_blocks, 1))
             active = self.scheduler.active()
             if active:
                 self._decode_once(active)
@@ -209,7 +219,7 @@ class Engine:
         if done:
             self.scheduler.release(req)
             req.finish()
-            self.metrics.on_request_finished()
+            self.metrics.on_request_finished(len(req.generated))
 
     # -- compiled steps ---------------------------------------------------
 

@@ -65,6 +65,16 @@ _ACTIVE = _mgauge("serving_active_slots",
 _THROUGHPUT = _mgauge("serving_throughput_tok_s",
                       "engine-lifetime output tokens/s",
                       labelnames=("engine",))
+# perf attribution (monitor/perf.py, FLAGS_perf_attribution): goodput
+# counts only FINISHED requests' tokens — work discarded by
+# preempt-by-recompute is throughput but not goodput, so the gap
+# between these two gauges IS the preemption tax
+_GOODPUT = _mgauge("serving_goodput_tokens_per_s",
+                   "finished-request output tokens/s (recomputed/"
+                   "discarded work excluded)", labelnames=("engine",))
+_KV_OCC = _mgauge("serving_kv_page_occupancy",
+                  "fraction of usable KV pages held by live requests",
+                  labelnames=("engine",))
 _ENGINE_IDS = itertools.count()
 # engine-labeled gauge series are pruned to this many newest engines —
 # a process that constructs engines repeatedly (test suites, rolling
@@ -73,7 +83,7 @@ _MAX_ENGINE_SERIES = 32
 
 
 def _prune_engine_series():
-    for g in (_ACTIVE, _THROUGHPUT):
+    for g in (_ACTIVE, _THROUGHPUT, _GOODPUT, _KV_OCC):
         keys = sorted(g._children, key=lambda k: int(k[0]))
         for k in keys[:-_MAX_ENGINE_SERIES]:
             g.remove(*k)
@@ -190,6 +200,8 @@ class EngineMetrics:
         eid = str(next(_ENGINE_IDS))
         self._active_gauge = _ACTIVE.labels(engine=eid)
         self._throughput_gauge = _THROUGHPUT.labels(engine=eid)
+        self._goodput_gauge = _GOODPUT.labels(engine=eid)
+        self._kv_occ_gauge = _KV_OCC.labels(engine=eid)
         _prune_engine_series()
         # wall clock starts at FIRST ADMISSION, not construction: an
         # engine built ahead of traffic must not understate throughput
@@ -200,9 +212,11 @@ class EngineMetrics:
         self.prefill_runs = 0
         self.decode_steps = 0
         self.output_tokens = 0
+        self.finished_output_tokens = 0
         self.decode_compiles = 0
         self.prefill_compiles = 0
         self._occupancy_sum = 0
+        self._kv_occupancy = 0.0
 
     # -- engine hooks (mirror every sample into the shared registry) ---
 
@@ -210,9 +224,12 @@ class EngineMetrics:
         self.requests_in += 1
         _REQUESTS.labels(event="in").inc()
 
-    def on_request_finished(self):
+    def on_request_finished(self, output_tokens=0):
         self.requests_finished += 1
+        self.finished_output_tokens += int(output_tokens)
         _REQUESTS.labels(event="finished").inc()
+        if self.start_t is not None:
+            self._note_perf_job()
 
     def on_preemption(self):
         self.preemptions += 1
@@ -251,6 +268,43 @@ class EngineMetrics:
                                        / max(now() - self.start_t, 1e-9))
         counter("serving.active_slots", active_slots)
 
+    def on_kv_occupancy(self, occupancy):
+        """Engine-reported KV-page occupancy (used pages / usable) —
+        updated per step under FLAGS_perf_attribution, and mirrored
+        into the /debugz/perf payload with the goodput numbers."""
+        self._kv_occupancy = occupancy
+        self._kv_occ_gauge.set(occupancy)
+        self._note_perf_job()
+
+    def _note_perf_job(self):
+        """Goodput gauge + /debugz/perf mirror, uniformly flag-gated:
+        with attribution off this is an early return — no gauge series
+        appears, the payload stays empty (test-pinned), and a scraper
+        can read the flag state from the series' presence."""
+        try:
+            from ..monitor import perf as _perf
+
+            if not _perf.attribution_enabled():
+                return
+            wall = (max(now() - self.start_t, 1e-9)
+                    if self.start_t is not None else 0.0)
+            if wall:
+                self._goodput_gauge.set(
+                    self.finished_output_tokens / wall)
+            _perf.note_job(
+                "serving",
+                goodput_tokens_per_s=(self.finished_output_tokens / wall
+                                      if wall else 0.0),
+                throughput_tokens_per_s=(self.output_tokens / wall
+                                         if wall else 0.0),
+                kv_page_occupancy=self._kv_occupancy,
+                output_tokens=self.output_tokens,
+                finished_output_tokens=self.finished_output_tokens,
+                preemptions=self.preemptions,
+                decode_steps=self.decode_steps)
+        except Exception:
+            pass
+
     def to_dict(self):
         wall = (max(now() - self.start_t, 1e-9)
                 if self.start_t is not None else 0.0)
@@ -264,9 +318,13 @@ class EngineMetrics:
             "prefill_runs": self.prefill_runs,
             "decode_steps": self.decode_steps,
             "output_tokens": self.output_tokens,
+            "finished_output_tokens": self.finished_output_tokens,
             "decode_compiles": self.decode_compiles,
             "prefill_compiles": self.prefill_compiles,
             "wall_s": wall,
             "throughput_tok_s": throughput,
+            "goodput_tok_s": (self.finished_output_tokens / wall
+                              if wall else 0.0),
             "slot_occupancy": occ,
+            "kv_page_occupancy": self._kv_occupancy,
         }

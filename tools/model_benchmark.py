@@ -41,6 +41,22 @@ def _watchdog(seconds=1200):
     signal.alarm(seconds)
 
 
+def _perf_fields(step, batch_args, units_per_step, units_per_s):
+    """Hardware-normalized row fields (monitor/perf.py): mfu +
+    hbm_peak_bytes from the compiled executable's cost/memory analysis
+    over the measured rate. ``units`` are whatever the row counts
+    (tokens, images, examples) — mfu only needs rate / per-step. Never
+    fails the row."""
+    try:
+        from paddle_tpu.monitor import perf as _perf
+
+        return _perf.bench_fields(
+            step.perf_analysis(*batch_args),
+            tokens_per_s=units_per_s, tokens_per_step=units_per_step)
+    except Exception as e:
+        return {"perf_fields_error": repr(e)[:200]}
+
+
 def _emit(results, metric, value, unit, extra=None):
     import jax
 
@@ -104,18 +120,21 @@ def bench_resnet50(results, iters=None):
         final = float(loss)
         dt = time.perf_counter() - t0
         assert np.isfinite(final)
-        return batch * iters / dt
+        ips = batch * iters / dt
+        return ips, _perf_fields(step, (x, y), batch, ips)
 
     # NHWC is the TPU-native conv layout (channels ride the 128-lane
     # dim); NCHW is measured alongside so the layout win stays an
     # honest, attributed number instead of a silent methodology change
-    per_layout = {fmt: measure(fmt) for fmt in ("NHWC", "NCHW")}
+    measured = {fmt: measure(fmt) for fmt in ("NHWC", "NCHW")}
+    per_layout = {fmt: v[0] for fmt, v in measured.items()}
     best = max(per_layout, key=per_layout.get)
     _emit(results, "resnet50_train_images_per_sec_per_chip",
           per_layout[best], "images/s",
-          {"batch": batch, "image_size": size, "layout": best,
-           "per_layout_images_per_sec":
-               {k: round(v, 1) for k, v in per_layout.items()}})
+          dict({"batch": batch, "image_size": size, "layout": best,
+                "per_layout_images_per_sec":
+                    {k: round(v, 1) for k, v in per_layout.items()}},
+               **measured[best][1]))
 
 
 def bench_ernie_dp(results, iters=None):
@@ -174,13 +193,15 @@ def bench_ernie_dp(results, iters=None):
     final = float(loss)
     dt = time.perf_counter() - t0
     assert np.isfinite(final)
+    tok_s = batch * seq * iters / dt
     _emit(results, "ernie_base_dp_tokens_per_sec_per_chip",
-          batch * seq * iters / dt, "tokens/s",
-          {"batch": batch, "seq": seq,
-           # config provenance: BASELINE.md 69,508 was measured with
-           # fuse_qkv=False — a jump from the fusion must be attributed,
-           # not read as a silent win
-           "fuse_qkv": bool(getattr(cfg, "fuse_qkv", False))})
+          tok_s, "tokens/s",
+          dict({"batch": batch, "seq": seq,
+                # config provenance: BASELINE.md 69,508 was measured
+                # with fuse_qkv=False — a jump from the fusion must be
+                # attributed, not read as a silent win
+                "fuse_qkv": bool(getattr(cfg, "fuse_qkv", False))},
+               **_perf_fields(step, (ids, labels), batch * seq, tok_s)))
 
 
 def bench_widedeep(results, iters=None):
@@ -375,11 +396,18 @@ def bench_llama1b(results, iters=None):
     flops_per_tok = (6 * n_params
                      + 12 * cfg.num_hidden_layers * seq * cfg.hidden_size)
     mfu = tok_s * flops_per_tok / 197e12 if on_tpu else 0.0
+    # both MFU conventions side by side: the analytic 6N/token formula
+    # (useful FLOPs only — remat re-forward NOT counted) and the
+    # executable's cost_analysis (counts the recompute; upper bound on
+    # work, so its mfu reads HIGHER under remat). The gap between them
+    # IS the remat tax.
     _emit(results, "llama1b_train_tokens_per_sec_per_chip", tok_s,
           "tokens/s",
-          {"batch": batch, "seq": seq, "params_m": round(n_params / 1e6),
-           "model_tflops": round(tok_s * flops_per_tok / 1e12, 1),
-           "mfu_vs_197tf_peak": round(mfu, 3), "recompute": True})
+          dict({"batch": batch, "seq": seq,
+                "params_m": round(n_params / 1e6),
+                "model_tflops": round(tok_s * flops_per_tok / 1e12, 1),
+                "mfu_vs_197tf_peak": round(mfu, 3), "recompute": True},
+               **_perf_fields(step, (ids, labels), batch * seq, tok_s)))
 
 
 def bench_llama_int8(results, iters=None):

@@ -42,6 +42,15 @@ PT_WATCHDOG_HEALTHZ_OUT=$LOG/$1_healthz.json"
 # 1. flagship number (single-step for vs_baseline + run_steps headline)
 run bench 1500 env $(wd bench) python bench.py
 
+# 1b. perf report: MFU / phase split / HBM peak of the bench-family
+#     step under full attribution (FLAGS_perf_attribution + the
+#     time-series ring + sentinels), diffed against the bench artifact
+#     bench.py just refreshed — the first tunnel window after the perf
+#     round captures an on-chip MFU baseline automatically
+#     (tools/perf_report.json is the committed artifact).
+run perf_report 900 python tools/perf_report.py --steps 10 --json \
+    --out tools/perf_report.json --baseline BENCH_LAST_GOOD.json
+
 # 2. north-star model rows (resnet both layouts, ernie fused, widedeep,
 #    llama1b MFU row)
 run model_resnet 1200 python tools/model_benchmark.py resnet50
