@@ -82,6 +82,16 @@ _DEFAULTS = {
     # readback per step — opt-in for measurement runs, off on the
     # training hot path by default.
     "FLAGS_perf_attribution": False,
+    # span journal (monitor/trace.py): per-request serving timelines
+    # (queue/prefill/decode/preempted phase spans + token-milestone
+    # events), per-step train spans with flight-recorder-linked comm
+    # child spans, and TTFT/TPOT histogram bucket exemplars resolving
+    # to trace ids. Off = emitters early-return and the registry
+    # exemplar hook slot stays None (zero journal allocations, zero
+    # threads, zero native calls on the hot path — test-pinned).
+    # Served at /debugz/trace + /debugz/trace/{id}; merged into the
+    # chrome timeline by tools/trace_merge.py --requests.
+    "FLAGS_monitor_trace": False,
     # regression sentinels (monitor/perf.py) over the time-series ring:
     # NaN/inf loss, loss spike vs EWMA, throughput regression vs a
     # rolling baseline, grad-norm explosion. Each firing increments

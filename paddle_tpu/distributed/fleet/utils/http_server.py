@@ -26,7 +26,22 @@ class KVHandler(http.server.BaseHTTPRequestHandler):
     def do_GET(self):
         # pluggable GET routes (monitor/exporter.py registers /metrics
         # and /metrics.json here — one server stack for KV + telemetry)
-        route = self.server.get_routes.get(self.path.strip("/"))
+        path = self.path.strip("/")
+        route = self.server.get_routes.get(path)
+        if route is None:
+            # parametric routes (/debugz/trace/{id}): longest registered
+            # prefix wins; the handler receives the path remainder.
+            # Checked before the KV fallback so a trace id can never be
+            # misread as a scope/key lookup.
+            best = None
+            for prefix in self.server.get_prefix_routes:
+                if path.startswith(prefix + "/") and \
+                        (best is None or len(prefix) > len(best)):
+                    best = prefix
+            if best is not None:
+                fn = self.server.get_prefix_routes[best]
+                rest = path[len(best) + 1:]
+                route = lambda: fn(rest)  # noqa: E731
         if route is not None:
             try:
                 code, ctype, body = route()
@@ -87,6 +102,9 @@ class KVHTTPServer(http.server.ThreadingHTTPServer):
         self.kv = {}
         self.delete_kv = {}
         self.get_routes = {}  # path (no leading /) -> () -> (code, ctype, bytes)
+        # prefix -> (rest: str) -> (code, ctype, bytes) — parametric
+        # GET routes (monitor/exporter.py: /debugz/trace/{id})
+        self.get_prefix_routes = {}
 
     def get_deleted_size(self, key):
         with self.kv_lock:

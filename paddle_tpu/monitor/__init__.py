@@ -31,7 +31,16 @@ Three pillars (one registry, one postmortem path, one timeline):
    / ``FLAGS_perf_sentinels``); served at /debugz/perf +
    /debugz/timeseries; rendered by tools/perf_report.py.
 
-5. **Progress watchdog** (monitor/watchdog.py): heartbeat registry fed
+5. **Span journal** (monitor/trace.py, ``FLAGS_monitor_trace``):
+   per-request serving timelines (contiguous queue/prefill/decode/
+   preempted phase spans + token-milestone events carrying KV-page and
+   slot occupancy), per-step train spans with flight-recorder-linked
+   comm child spans, and OpenMetrics-style histogram bucket exemplars
+   (bucket → trace id) through a registry hook slot. Served at
+   /debugz/trace + /debugz/trace/{id}; merged into the chrome-trace
+   timeline by tools/trace_merge.py --requests.
+
+6. **Progress watchdog** (monitor/watchdog.py): heartbeat registry fed
    by the compiled train step, the serving engine loop, and store
    collectives; a daemon thread (``start_watchdog()`` / ``PT_WATCHDOG``)
    turns a stalled heartbeat into a cross-rank diagnostic bundle
@@ -79,6 +88,7 @@ from .watchdog import (  # noqa: F401
 from . import flight_recorder  # noqa: F401
 from . import perf  # noqa: F401
 from . import timeseries  # noqa: F401
+from . import trace  # noqa: F401
 from . import trace_merge  # noqa: F401
 from . import watchdog  # noqa: F401
 
@@ -91,5 +101,6 @@ __all__ = [
     "FlightRecorder", "get_flight_recorder", "diagnose",
     "Heartbeat", "heartbeat", "start_watchdog", "stop_watchdog",
     "is_watchdog_running", "build_bundle", "diagnose_bundles",
-    "flight_recorder", "perf", "timeseries", "trace_merge", "watchdog",
+    "flight_recorder", "perf", "timeseries", "trace", "trace_merge",
+    "watchdog",
 ]

@@ -17,6 +17,10 @@ server stack: ``KVHTTPServer`` gained a ``get_routes`` hook, and
                         (monitor/perf.py payload)
     GET /debugz/timeseries  the metric time-series rings
                         (monitor/timeseries.py payload)
+    GET /debugz/trace   span-journal summary + histogram exemplars
+                        (monitor/trace.py payload)
+    GET /debugz/trace/{id}  one trace's full span timeline (404 for an
+                        unknown or evicted trace id)
 
 The /healthz and /debugz routes are served live from monitor/watchdog.py
 whether or not the watchdog thread is running (the verdict just reads
@@ -35,6 +39,7 @@ import time
 
 from . import perf as _perf
 from . import timeseries as _timeseries
+from . import trace as _trace
 from . import watchdog as _watchdog
 from .registry import get_registry
 
@@ -87,6 +92,9 @@ class MetricsServer:
         routes["debugz/bundle"] = _watchdog.http_bundle
         routes["debugz/perf"] = self._perf
         routes["debugz/timeseries"] = self._timeseries
+        routes["debugz/trace"] = self._trace
+        self._kv.http_server.get_prefix_routes["debugz/trace"] = \
+            self._trace_by_id
 
     @property
     def port(self):
@@ -118,6 +126,20 @@ class MetricsServer:
     def _timeseries(self):
         body = json.dumps(_watchdog.json_safe(_timeseries.payload()),
                           default=str).encode()
+        return 200, "application/json", body
+
+    def _trace(self):
+        body = json.dumps(_watchdog.json_safe(_trace.payload()),
+                          default=str).encode()
+        return 200, "application/json", body
+
+    def _trace_by_id(self, trace_id):
+        p = _trace.trace_payload(trace_id)
+        if p is None:
+            return (404, "application/json",
+                    json.dumps({"error": "unknown trace",
+                                "trace_id": trace_id}).encode())
+        body = json.dumps(_watchdog.json_safe(p), default=str).encode()
         return 200, "application/json", body
 
 

@@ -32,7 +32,8 @@ import time
 
 
 class _State:
-    __slots__ = ("enabled", "trace_bridge", "_trace_fn", "ts_hook")
+    __slots__ = ("enabled", "trace_bridge", "_trace_fn", "ts_hook",
+                 "ex_hook")
 
     def __init__(self):
         self.enabled = os.environ.get("PT_MONITOR", "1").lower() \
@@ -45,6 +46,10 @@ class _State:
         # attribute-load + branch — the same disabled-path discipline
         # as trace_bridge, pinned by tests/test_perf.py
         self.ts_hook = None
+        # histogram exemplar hook (monitor/trace.py installs it):
+        # None = the span journal is off and observes pay one extra
+        # attribute-load + branch, pinned by tests/test_trace.py
+        self.ex_hook = None
 
 
 _state = _State()
@@ -365,6 +370,8 @@ class Histogram(Metric):
             # sum): train_step_seconds' ring is the per-step trace a
             # hang postmortem wants
             _state.ts_hook(self, key, value)
+        if _state.ex_hook is not None:
+            _state.ex_hook(self, key, value)
 
     def observe(self, value):
         if not _state.enabled:

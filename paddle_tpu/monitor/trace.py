@@ -1,0 +1,608 @@
+"""Span journal: per-request & per-step trace timelines + exemplars.
+
+The monitor stack can say *that* a step ran (registry), *that* a rank
+hung (watchdog/flight recorder), and *whether* the step was efficient
+(perf) — but not tell the story of any single request or step: a p99
+TTFT outlier is an anonymous histogram bucket with no way back to the
+request it was, where its time went (queue vs prefill vs
+preemption-recompute vs decode), or which collective it sat behind.
+This module is that missing journey layer:
+
+1. **Journal** — a bounded, lock-cheap store of *traces* (one per
+   request / per train job), each a list of *spans* (``span_id``,
+   ``parent_id``, ``kind``, wall ``t_start``/``t_end``, attrs) carrying
+   typed *events* (``(ts, name, attrs)``). The serving engine gives
+   every request a trace at arrival and drives contiguous *phase*
+   spans (``queue → prefill → decode → preempted → prefill(resume) →
+   decode``) whose durations sum to the request's e2e latency; the
+   compiled train steps record per-step spans whose child *comm* spans
+   replay the flight-recorder brackets (seq/gseq-linked, so a trace
+   and a desync postmortem name the same collective).
+
+2. **Exemplars** — an OpenMetrics-style bucket→trace-id map: while a
+   trace context is set (``exemplar_context``), every Histogram
+   observation also records ``{bucket: (trace_id, value, ts)}`` through
+   a registry hook slot (``_state.ex_hook``), so the TTFT histogram's
+   p99 bucket resolves to the exact request's span timeline.
+
+3. **Export** — ``/debugz/trace`` (journal summary + exemplars) and
+   ``/debugz/trace/{id}`` (one trace's full timeline) on the fleet KV
+   HTTP server; ``write_journal`` persists the journal with a
+   wall↔monotonic clock anchor so ``tools/trace_merge.py --requests``
+   can merge request spans into the rank-prefixed chrome-trace
+   timeline one Perfetto view reads end-to-end.
+
+Discipline (the PR-2/5 contract, test-pinned by tests/test_trace.py):
+default OFF via ``FLAGS_monitor_trace``; while off the hot paths are
+native-call-free and thread-free — emitters early-return on one
+attribute load + branch, the registry exemplar hook slot stays
+``None``, and nothing is allocated into the journal. Stdlib-only so
+worker processes can import it without an accelerator backend.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from . import registry as _registry
+from .timeseries import _flag
+
+DEFAULT_CAPACITY = 256          # retained traces
+DEFAULT_SPANS_PER_TRACE = 512   # per-trace span ring (train jobs loop)
+_EVENTS_PER_SPAN = 256
+
+
+class _TraceState:
+    __slots__ = ("enabled", "capacity", "span_cap", "lock", "traces",
+                 "open_spans", "next_trace", "next_span", "exemplars",
+                 "jobs")
+
+    def __init__(self):
+        self.enabled = False
+        self.capacity = int(os.environ.get("PT_TRACE_CAPACITY",
+                                           str(DEFAULT_CAPACITY)))
+        self.span_cap = int(os.environ.get("PT_TRACE_SPANS_PER_TRACE",
+                                           str(DEFAULT_SPANS_PER_TRACE)))
+        self.lock = threading.Lock()
+        self.traces = {}        # trace_id -> trace dict (insertion order)
+        self.open_spans = {}    # span_id -> span dict (unfinished)
+        self.next_trace = 0
+        self.next_span = 0
+        # {series_name: {bucket_label: {trace_id, value, ts}}}
+        self.exemplars = {}
+        # train-step recorder state: job -> {trace_id, fr_seq watermark}
+        self.jobs = {}
+
+
+_state = _TraceState()
+_tls = threading.local()
+
+
+def now():
+    """The journal's timebase: wall clock (``time.time()``) — the same
+    base the flight recorder stamps entries with, so comm child spans
+    replayed from its ring land on the step span without conversion."""
+    return time.time()
+
+
+# -- lifecycle ---------------------------------------------------------------
+
+def enable(capacity=None, span_cap=None):
+    """Turn the journal on (process-wide) and install the registry
+    exemplar hook. Idempotent; capacities only affect future records."""
+    if capacity is not None:
+        _state.capacity = max(int(capacity), 1)
+    if span_cap is not None:
+        _state.span_cap = max(int(span_cap), 8)
+    _state.enabled = True
+    _registry._state.ex_hook = _ex_hook
+    return _state
+
+
+def disable():
+    """Stop recording: the exemplar hook slot returns to ``None`` so
+    the Histogram hot path is exactly the disabled-from-boot one.
+    Recorded traces are kept (inspectable post-incident); ``clear()``
+    drops them."""
+    _state.enabled = False
+    _registry._state.ex_hook = None
+
+
+def is_enabled():
+    return _state.enabled
+
+
+def clear():
+    with _state.lock:
+        _state.traces = {}
+        _state.open_spans = {}
+        _state.exemplars = {}
+        _state.jobs = {}
+
+
+# -- journal writes ----------------------------------------------------------
+
+def _evict_locked():
+    """Drop oldest traces past capacity — finished ones first, but
+    bounded beats complete: an all-open journal still evicts."""
+    while len(_state.traces) > _state.capacity:
+        victim = None
+        for tid, tr in _state.traces.items():
+            if tr["open"] == 0:
+                victim = tid
+                break
+        if victim is None:
+            victim = next(iter(_state.traces))
+        tr = _state.traces.pop(victim)
+        for s in tr["spans"]:
+            _state.open_spans.pop(s["span_id"], None)
+
+
+def new_trace(name, t=None, **attrs):
+    """Create a trace; returns its id (None while disabled — every
+    later call taking a trace/span id no-ops on None, so a mid-run
+    flag flip never half-traces a request)."""
+    if not _state.enabled:
+        return None
+    if t is None:
+        t = now()
+    with _state.lock:
+        tid = "%x.%x" % (os.getpid(), _state.next_trace)
+        _state.next_trace += 1
+        _state.traces[tid] = {
+            "trace_id": tid,
+            "name": name,
+            "attrs": dict(attrs),
+            "t_start": t,
+            "spans": [],
+            "open": 0,
+        }
+        _evict_locked()
+    return tid
+
+
+def start_span(name, trace_id, parent_id=None, kind="span", t=None,
+               **attrs):
+    """Open a span under ``trace_id``; returns its span id (None when
+    disabled, the trace id is None, or the trace was evicted)."""
+    if not _state.enabled or trace_id is None:
+        return None
+    if t is None:
+        t = now()
+    with _state.lock:
+        tr = _state.traces.get(trace_id)
+        if tr is None:
+            return None
+        sid = _state.next_span
+        _state.next_span += 1
+        span = {
+            "span_id": sid,
+            "trace_id": trace_id,
+            "parent_id": parent_id,
+            "name": name,
+            "kind": kind,
+            "t_start": t,
+            "t_end": None,
+            "attrs": dict(attrs),
+            "events": [],
+        }
+        if len(tr["spans"]) >= _state.span_cap:
+            # per-trace span ring (a long-lived train trace must stay
+            # bounded): drop the oldest FINISHED span; when everything
+            # is somehow open, drop the oldest anyway
+            drop = next((i for i, s in enumerate(tr["spans"])
+                         if s["t_end"] is not None), 0)
+            dead = tr["spans"].pop(drop)
+            if dead["t_end"] is None:
+                tr["open"] -= 1
+                _state.open_spans.pop(dead["span_id"], None)
+        tr["spans"].append(span)
+        tr["open"] += 1
+        _state.open_spans[sid] = span
+    return sid
+
+
+def end_span(span_id, t=None, **attrs):
+    if span_id is None:
+        return
+    if t is None:
+        t = now()
+    with _state.lock:
+        span = _state.open_spans.pop(span_id, None)
+        if span is None:
+            return
+        span["t_end"] = t
+        if attrs:
+            span["attrs"].update(attrs)
+        tr = _state.traces.get(span["trace_id"])
+        if tr is not None:
+            tr["open"] -= 1
+
+
+def add_event(span_id, name, t=None, **attrs):
+    """Typed event on an OPEN span (bounded per span)."""
+    if span_id is None or not _state.enabled:
+        return
+    if t is None:
+        t = now()
+    with _state.lock:
+        span = _state.open_spans.get(span_id)
+        if span is None or len(span["events"]) >= _EVENTS_PER_SPAN:
+            return
+        span["events"].append({"ts": t, "name": name,
+                               "attrs": dict(attrs)})
+
+
+class _NoopSpan:
+    """Shared disabled-path context manager: zero allocations."""
+
+    __slots__ = ()
+    span_id = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOOP = _NoopSpan()
+
+
+class _SpanCtx:
+    __slots__ = ("span_id", "_pushed")
+
+    def __init__(self, name, trace_id, parent_id, kind, attrs):
+        self.span_id = start_span(name, trace_id, parent_id=parent_id,
+                                  kind=kind, **attrs)
+        self._pushed = False
+
+    def __enter__(self):
+        if self.span_id is not None:
+            stack = getattr(_tls, "stack", None)
+            if stack is None:
+                stack = _tls.stack = []
+            stack.append(self.span_id)
+            self._pushed = True
+        return self
+
+    def __exit__(self, *exc):
+        if self._pushed:
+            _tls.stack.pop()
+        end_span(self.span_id)
+        return False
+
+
+def span(name, trace_id=None, parent_id=None, kind="span", **attrs):
+    """Scoped span context manager. ``trace_id`` defaults to the
+    thread's current exemplar/trace context; the parent defaults to the
+    innermost enclosing ``span()`` on this thread."""
+    if not _state.enabled:
+        return _NOOP
+    if trace_id is None:
+        trace_id = current_trace_id()
+    if trace_id is None:
+        return _NOOP
+    if parent_id is None:
+        stack = getattr(_tls, "stack", None)
+        if stack:
+            parent_id = stack[-1]
+    return _SpanCtx(name, trace_id, parent_id, kind, attrs)
+
+
+# -- trace context + exemplars -----------------------------------------------
+
+def current_trace_id():
+    ctx = getattr(_tls, "trace", None)
+    return ctx[-1] if ctx else None
+
+
+class _ExemplarCtx:
+    __slots__ = ("_tid",)
+
+    def __init__(self, tid):
+        self._tid = tid
+
+    def __enter__(self):
+        ctx = getattr(_tls, "trace", None)
+        if ctx is None:
+            ctx = _tls.trace = []
+        ctx.append(self._tid)
+        return self
+
+    def __exit__(self, *exc):
+        _tls.trace.pop()
+        return False
+
+
+def exemplar_context(trace_id):
+    """Bind ``trace_id`` as the thread's current trace: Histogram
+    observations inside the block record bucket exemplars pointing at
+    it (and ``span()`` resolves it as the default trace). ``None`` or
+    journal-off returns the shared no-op manager — zero allocations on
+    the disabled path."""
+    if trace_id is None or not _state.enabled:
+        return _NOOP
+    return _ExemplarCtx(trace_id)
+
+
+def _bucket_label(buckets, value):
+    for b in buckets:
+        if value <= b:
+            return str(b)
+    return "+Inf"
+
+
+def _ex_hook(metric, key, value):
+    """The registry-side Histogram hook (installed only while enabled):
+    record a bucket exemplar for the thread's current trace. Runs
+    inline on the observe path — one tls read when no context is set."""
+    tid = current_trace_id()
+    if tid is None:
+        return
+    series = metric._series_name(key)
+    label = _bucket_label(metric.buckets, value)
+    with _state.lock:
+        _state.exemplars.setdefault(series, {})[label] = {
+            "trace_id": tid, "value": value, "ts": time.time()}
+
+
+def exemplars(series=None):
+    """{series: {bucket: {trace_id, value, ts}}} (or one series')."""
+    with _state.lock:
+        if series is not None:
+            return {b: dict(e)
+                    for b, e in _state.exemplars.get(series, {}).items()}
+        return {s: {b: dict(e) for b, e in bs.items()}
+                for s, bs in _state.exemplars.items()}
+
+
+# -- train-step recorder -----------------------------------------------------
+
+def record_train_step(job, step, dt, steps=1, tokens=0, t_end=None):
+    """One compiled-engine call as a step span on the long-lived
+    ``job`` trace, with child comm spans replayed from the
+    flight-recorder entries recorded during the step (matched by
+    SEQUENCE watermark, never timestamps — the PR-5 discipline), each
+    carrying the ring's seq/gseq/group/wire_bytes so the trace and a
+    desync postmortem name the same collective."""
+    if not _state.enabled:
+        return None
+    from .flight_recorder import get_flight_recorder
+
+    fr = get_flight_recorder()
+    if t_end is None:
+        t_end = now()
+    t_start = t_end - max(dt, 0.0)
+    st = _state.jobs.get(job)
+    if st is None or st["trace_id"] not in _state.traces:
+        tid = new_trace(job, kind="train")
+        st = _state.jobs[job] = {"trace_id": tid, "fr_seq": None}
+    tid = st["trace_id"]
+    sid = start_span("%s.step" % job, tid, kind="step", t=t_start,
+                     step=int(step), steps=int(steps),
+                     tokens=int(tokens))
+    mark, st["fr_seq"] = st["fr_seq"], fr._seq
+    if sid is not None:
+        for e in fr.entries():
+            seq = e.get("seq")
+            if seq is None or e.get("t_end") is None:
+                continue
+            if mark is not None:
+                if seq < mark:
+                    continue
+            elif e["t_start"] < t_start:
+                # first call for this job has no seq watermark yet:
+                # fall back to the step's own wall window (ring stamps
+                # and t_start share the time.time() clock) so a
+                # one-shot run_steps workload still gets its comm
+                # children instead of silently dropping them
+                continue
+            attrs = {"seq": seq, "gseq": e.get("gseq"),
+                     "group": e.get("group"), "op": e.get("op"),
+                     "reduce_op": e.get("reduce_op")}
+            if e.get("wire_bytes"):
+                attrs["wire_bytes"] = e["wire_bytes"]
+            csid = start_span(e.get("op") or "collective", tid,
+                              parent_id=sid, kind="comm",
+                              t=e["t_start"], **attrs)
+            end_span(csid, t=e["t_end"])
+    end_span(sid, t=t_end)
+    return sid
+
+
+# -- queries -----------------------------------------------------------------
+
+def get_trace(trace_id):
+    """Deep-ish copy of one trace ({trace_id, name, attrs, spans}) or
+    None."""
+    with _state.lock:
+        tr = _state.traces.get(trace_id)
+        if tr is None:
+            return None
+        return {
+            "trace_id": tr["trace_id"],
+            "name": tr["name"],
+            "attrs": dict(tr["attrs"]),
+            "t_start": tr["t_start"],
+            "open_spans": tr["open"],
+            "spans": [dict(s, attrs=dict(s["attrs"]),
+                           events=[dict(ev) for ev in s["events"]])
+                      for s in tr["spans"]],
+        }
+
+
+def active_spans(min_age_s=None):
+    """Unfinished spans with ages — the watchdog-bundle embedding:
+    "rank 3 stalled while request r17 was mid-preemption-recompute".
+    ``min_age_s`` keeps only spans at least that old (a stall report
+    wants the long-stuck ones, not this instant's in-flight step)."""
+    t = now()
+    out = []
+    # attr copies happen INSIDE the lock: end_span mutates the span's
+    # attrs dict concurrently, and dict() over a resizing dict raises
+    with _state.lock:
+        for s in _state.open_spans.values():
+            age = t - s["t_start"]
+            if min_age_s is not None and age < min_age_s:
+                continue
+            out.append({
+                "trace_id": s["trace_id"],
+                "span_id": s["span_id"],
+                "parent_id": s["parent_id"],
+                "name": s["name"],
+                "kind": s["kind"],
+                "age_s": round(age, 3),
+                "attrs": dict(s["attrs"]),
+            })
+    return sorted(out, key=lambda s: -s["age_s"])
+
+
+def phase_breakdown(trace_id):
+    """{phase: seconds} summed over the trace's ``kind="phase"`` spans
+    (open phases accrue to now) — the per-request queue / prefill /
+    decode / preempted attribution; ``None`` for an unknown trace."""
+    tr = get_trace(trace_id)
+    if tr is None:
+        return None
+    t = now()
+    out = {}
+    for s in tr["spans"]:
+        if s["kind"] != "phase":
+            continue
+        dur = (s["t_end"] if s["t_end"] is not None else t) - s["t_start"]
+        out[s["name"]] = out.get(s["name"], 0.0) + max(dur, 0.0)
+    return out
+
+
+def traces_summary():
+    out = []
+    # summarized INSIDE the lock (the active_spans discipline): span
+    # lists and open counts mutate under concurrent writers
+    with _state.lock:
+        for tr in _state.traces.values():
+            ends = [s["t_end"] for s in tr["spans"]
+                    if s["t_end"] is not None]
+            out.append({
+                "trace_id": tr["trace_id"],
+                "name": tr["name"],
+                "attrs": dict(tr["attrs"]),
+                "t_start": tr["t_start"],
+                "t_end": max(ends) if ends and not tr["open"] else None,
+                "spans": len(tr["spans"]),
+                "open_spans": tr["open"],
+            })
+    return out
+
+
+def payload():
+    """The /debugz/trace JSON body."""
+    return {
+        "enabled": _state.enabled,
+        "capacity": _state.capacity,
+        "trace_count": len(_state.traces),
+        "traces": traces_summary(),
+        "exemplars": exemplars(),
+    }
+
+
+def trace_payload(trace_id):
+    """The /debugz/trace/{id} JSON body, or None for an unknown id."""
+    return get_trace(trace_id)
+
+
+# -- journal artifact + chrome export ----------------------------------------
+
+def dump():
+    """JSON-ready journal snapshot. Carries a wall↔monotonic clock
+    anchor: journal timestamps are wall-clock, the native chrome tracer
+    stamps monotonic — the anchor is the same-process shift that puts
+    request spans onto the native trace's timebase when merging."""
+    with _state.lock:
+        traces = {tid: {
+            "trace_id": tr["trace_id"], "name": tr["name"],
+            "attrs": dict(tr["attrs"]), "t_start": tr["t_start"],
+            "open_spans": tr["open"],
+            "spans": [dict(s, attrs=dict(s["attrs"]),
+                           events=[dict(ev) for ev in s["events"]])
+                      for s in tr["spans"]],
+        } for tid, tr in _state.traces.items()}
+    return {
+        "kind": "trace_journal",
+        "version": 1,
+        "pid": os.getpid(),
+        "written_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "clock_anchor": {"wall": time.time(),
+                         "monotonic": time.monotonic()},
+        "exemplars": exemplars(),
+        "traces": traces,
+    }
+
+
+def write_journal(path):
+    import json
+
+    journal = dump()
+    d = os.path.dirname(os.path.abspath(path))
+    if d:
+        os.makedirs(d, exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(journal, f, indent=1, default=str)
+        f.write("\n")
+    os.replace(tmp, path)
+    return journal
+
+
+def chrome_events_from_journal(journal, clock="wall"):
+    """Journal dict -> chrome traceEvents: one pid per trace NAME, one
+    tid per trace id (each request is its own track), spans as "X"
+    complete events, typed events as "i" instants, parentage preserved
+    in ``args``. ``clock="monotonic"`` shifts by the journal's clock
+    anchor onto the native tracer's (steady-clock) timebase — the
+    right choice when merging with same-process chrome traces."""
+    shift = 0.0
+    if clock == "monotonic":
+        anchor = journal.get("clock_anchor") or {}
+        if "wall" in anchor and "monotonic" in anchor:
+            shift = anchor["monotonic"] - anchor["wall"]
+    evs = []
+    end = journal.get("clock_anchor", {}).get("wall", time.time())
+    for tid, tr in sorted((journal.get("traces") or {}).items()):
+        pid = tr.get("name") or "trace"
+        evs.append({"ph": "M", "name": "thread_name", "pid": pid,
+                    "tid": tid,
+                    "args": {"name": "%s %s" % (pid, tid)}})
+        for s in tr.get("spans") or ():
+            t0 = s["t_start"] + shift
+            t1 = (s["t_end"] if s["t_end"] is not None else end) + shift
+            args = dict(s.get("attrs") or {})
+            args.update({"trace_id": tid, "span_id": s["span_id"],
+                         "parent_id": s.get("parent_id"),
+                         "kind": s.get("kind")})
+            if s["t_end"] is None:
+                args["open"] = True
+            evs.append({"ph": "X", "name": s["name"],
+                        "cat": s.get("kind") or "span", "pid": pid,
+                        "tid": tid, "ts": t0 * 1e6,
+                        "dur": max(t1 - t0, 0.0) * 1e6, "args": args})
+            for ev in s.get("events") or ():
+                evs.append({"ph": "i", "s": "t", "name": ev["name"],
+                            "cat": "event", "pid": pid, "tid": tid,
+                            "ts": (ev["ts"] + shift) * 1e6,
+                            "args": dict(ev.get("attrs") or {},
+                                         span_id=s["span_id"],
+                                         trace_id=tid)})
+    return evs
+
+
+def to_chrome_events(clock="wall"):
+    """Chrome events of the LIVE journal."""
+    return chrome_events_from_journal(dump(), clock=clock)
+
+
+# env/FLAGS bootstrap (the timeseries discipline): a process started
+# with FLAGS_monitor_trace=1 journals from the first request/step.
+if _flag("FLAGS_monitor_trace"):
+    enable()

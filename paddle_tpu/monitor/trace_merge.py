@@ -153,13 +153,19 @@ def merge_rank_events(rank_events, offsets=None):
     return merged
 
 
-def merge_trace_files(paths_by_rank, out_path, offsets=None):
+def merge_trace_files(paths_by_rank, out_path, offsets=None,
+                      extra_events=None):
     """Merge per-rank chrome traces into one aligned timeline file.
 
     ``paths_by_rank``: {rank: path} (.json or .json.gz).
+    ``extra_events``: already-converted chrome events appended as-is —
+    the span-journal request/step spans (``journal_events``) ride into
+    the same Perfetto view as the rank-prefixed profiler tracks.
     Returns the merged event count."""
     rank_events = {r: _load_events(p) for r, p in paths_by_rank.items()}
     merged = merge_rank_events(rank_events, offsets)
+    if extra_events:
+        merged.extend(extra_events)
     d = os.path.dirname(os.path.abspath(out_path))
     if d:
         os.makedirs(d, exist_ok=True)
@@ -168,7 +174,34 @@ def merge_trace_files(paths_by_rank, out_path, offsets=None):
                    "displayTimeUnit": "ms",
                    "metadata": {
                        "merged_ranks": sorted(rank_events),
+                       "extra_events": len(extra_events or ()),
                        "clock_offsets_s": {str(r): v for r, v in
                                            (offsets or {}).items()},
                    }}, f)
     return len(merged)
+
+
+# -- span-journal merge (monitor/trace.py artifacts) -------------------------
+
+def load_journal(path):
+    """Read a ``trace.write_journal`` artifact (.json or .json.gz)."""
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt") as f:
+        journal = json.load(f)
+    if journal.get("kind") != "trace_journal":
+        raise ValueError(
+            "%s is not a trace journal (kind=%r) — expected the "
+            "monitor.trace.write_journal format"
+            % (path, journal.get("kind")))
+    return journal
+
+
+def journal_events(journal, clock="monotonic"):
+    """Journal -> chrome events. ``clock="monotonic"`` shifts span
+    timestamps (wall clock) by the journal's own wall<->monotonic
+    anchor onto the native tracer's steady-clock timebase — the right
+    default when merging with chrome traces from the same process;
+    ``clock="wall"`` keeps raw wall stamps (journal-only merges)."""
+    from . import trace as _trace
+
+    return _trace.chrome_events_from_journal(journal, clock=clock)

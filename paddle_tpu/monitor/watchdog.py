@@ -318,6 +318,15 @@ def build_bundle(reason="debugz", stalls=None):
         anomalies = _perf.anomaly_summary()
     except Exception:
         anomalies = {}
+    # active (unfinished) spans (monitor/trace.py, journal enabled):
+    # "rank 3 stalled while request r17 was mid-preemption-recompute
+    # at gseq=N" — the journey context next to the frozen stacks
+    try:
+        from . import trace as _trace
+
+        spans = _trace.active_spans()
+    except Exception:
+        spans = []
     return {
         "kind": "watchdog_bundle",
         "version": 1,
@@ -340,6 +349,7 @@ def build_bundle(reason="debugz", stalls=None):
         "metrics": metrics,
         "timeseries_tail": ts_tail,
         "perf_anomalies": anomalies,
+        "active_spans": spans,
     }
 
 

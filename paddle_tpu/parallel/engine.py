@@ -602,6 +602,13 @@ class CompiledTrainStep:
         t1 = time.perf_counter()
         _record_step(vals, k, t1 - t0, stacked=True)
         self._note_perf(vals, k, t1 - t0, loss, t0, t1, stacked=True)
+        # span journal (monitor/trace.py, FLAGS_monitor_trace): one
+        # step span per engine call, child comm spans replayed from the
+        # flight-recorder brackets — off = one attribute load + branch
+        if _monitor.trace.is_enabled():
+            _monitor.trace.record_train_step(
+                "train", self._step_count + k, t1 - t0, steps=k,
+                tokens=_batch_tokens(vals, stacked=True))
         self._step_count += k
         for n, v in zip(self._names, new_state):
             tensors[n]._value = v
@@ -730,6 +737,10 @@ class CompiledTrainStep:
         t1 = time.perf_counter()
         _record_step(vals, 1, t1 - t0)
         self._note_perf(vals, 1, t1 - t0, loss, t0, t1)
+        if _monitor.trace.is_enabled():
+            _monitor.trace.record_train_step(
+                "train", self._step_count, t1 - t0,
+                tokens=_batch_tokens(vals))
         for n, v in zip(self._names, new_state):
             tensors[n]._value = v
         self._opt_state = new_opt

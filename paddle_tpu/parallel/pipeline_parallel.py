@@ -885,6 +885,14 @@ class PipelinedTrainStep:
             self._stacked = dict(zip(self.suffixes, new_stacked))
             self._opt_state = new_opt
             self._note_perf(batch, t1 - t0, loss, t0, t1)
+            # span journal (monitor/trace.py): per-step span + comm
+            # child spans, same discipline as CompiledTrainStep
+            if _monitor.trace.is_enabled():
+                from .engine import _batch_tokens
+
+                _monitor.trace.record_train_step(
+                    "train_pp", self._step_count, t1 - t0,
+                    tokens=_batch_tokens(batch))
             return Tensor(loss)
 
     def perf_analysis(self, input_ids, labels):

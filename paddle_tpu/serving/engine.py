@@ -90,20 +90,27 @@ class Engine:
                 "prompt (%d) + max_new_tokens (%d) exceeds max_model_len"
                 " (%d)" % (len(prompt), max_new_tokens,
                            self.max_model_len))
-        if (self.cache.pages_needed(total)
-                > self.cache.allocator.usable_blocks):
+        pages_needed = self.cache.pages_needed(total)
+        if pages_needed > self.cache.allocator.usable_blocks:
             raise ValueError(
                 "request needs %d pages but the pool only has %d usable "
                 "blocks — it could never be scheduled"
-                % (self.cache.pages_needed(total),
-                   self.cache.allocator.usable_blocks))
+                % (pages_needed, self.cache.allocator.usable_blocks))
         req = Request(prompt, max_new_tokens, eos_token_id)
         self.requests[req.id] = req
+        # span journal (FLAGS_monitor_trace): trace id assigned here —
+        # the admission point — so the queue phase covers every second
+        # the engine owned the request
+        req.trace_begin()
         self.metrics.on_request_in()
         if max_new_tokens == 0:     # zero-length generation: trivially done
             req.finish()
             self.metrics.on_request_finished()
+            req.trace_finish("finished")
             return req.id
+        if req.trace_id is not None:
+            req.trace_phase("queue")
+            req.trace_event("admitted", kv_pages_needed=pages_needed)
         self.scheduler.add(req)
         return req.id
 
@@ -144,6 +151,19 @@ class Engine:
     def request_metrics(self, rid):
         return self.requests[rid].metrics.to_dict()
 
+    def request_trace(self, rid):
+        """(trace_id, {phase: seconds}) of a request's span timeline —
+        (None, None) while the journal (FLAGS_monitor_trace) is off OR
+        when the bounded journal already evicted this request's trace
+        (callers never have to distinguish the two absences)."""
+        tid = self.requests[rid].trace_id
+        if tid is None:
+            return None, None
+        phases = _monitor.trace.phase_breakdown(tid)
+        if phases is None:      # evicted from the bounded journal
+            return None, None
+        return tid, phases
+
     def stats(self):
         return self.metrics.to_dict()
 
@@ -162,6 +182,8 @@ class Engine:
         tokens = req.resume_tokens
         L = len(tokens)
         P = self._bucket(L)
+        req.trace_phase("prefill", slot=slot, tokens=L, bucket=P,
+                        resume=req.metrics.preemptions > 0)
         ids = np.zeros((1, P), np.int32)
         ids[0, :L] = tokens
         with span("serving.prefill"):
@@ -175,6 +197,10 @@ class Engine:
         self.metrics.on_prefill_run()
         req.state = RequestState.DECODING
         req.metrics.on_first_token(now())
+        # decode phase opens BEFORE the first token is accepted: a
+        # max_new_tokens=1 request finishes inside _accept_token and
+        # its trace_finish must close the decode span, not prefill
+        req.trace_phase("decode", slot=slot)
         self._accept_token(req, int(tok))
 
     def _grow_or_preempt(self):
@@ -216,10 +242,23 @@ class Engine:
         done = (req.remaining <= 0
                 or (req.eos_token_id is not None
                     and tok == req.eos_token_id))
+        if req.trace_id is not None:
+            # token MILESTONES, not every token (bounded journal): the
+            # first, every 8th, and the last, each stamped with the KV
+            # and batch-slot occupancy the step saw
+            n = len(req.generated)
+            if n == 1 or done or n % 8 == 0:
+                alloc = self.cache.allocator
+                req.trace_event(
+                    "token", n=n,
+                    kv_pages_used=(alloc.usable_blocks
+                                   - alloc.free_blocks),
+                    slots_active=self.scheduler.slots_active())
         if done:
             self.scheduler.release(req)
             req.finish()
             self.metrics.on_request_finished(len(req.generated))
+            req.trace_finish("finished")
 
     # -- compiled steps ---------------------------------------------------
 

@@ -42,6 +42,7 @@ import time
 from ..monitor import counter as _mcounter
 from ..monitor import gauge as _mgauge
 from ..monitor import histogram as _mhistogram
+from ..monitor import trace as _mtrace
 
 # shared-registry series (idempotent: re-imports / engine re-creation
 # reuse the registered metric). Counters and histograms are cumulative
@@ -151,24 +152,34 @@ class RequestMetrics:
         self.prompt_tokens = 0
         self.output_tokens = 0
         self.preemptions = 0
+        # span-journal trace id (monitor/trace.py): set by the engine
+        # at admission when FLAGS_monitor_trace is on; observations
+        # below then record bucket EXEMPLARS so a p99 outlier in any
+        # latency histogram resolves back to this request's timeline.
+        # None while the journal is off — the observes below pay one
+        # attribute check and nothing else (test-pinned).
+        self.trace_id = None
 
     def on_admit(self, t):
         if self.first_admit_t is None:
             self.first_admit_t = t
-            _QUEUE.observe(t - self.arrival_t)
+            with _mtrace.exemplar_context(self.trace_id):
+                _QUEUE.observe(t - self.arrival_t)
 
     def on_first_token(self, t):
         if self.first_token_t is None:
             self.first_token_t = t
-            _TTFT.observe(t - self.arrival_t)
+            with _mtrace.exemplar_context(self.trace_id):
+                _TTFT.observe(t - self.arrival_t)
 
     def on_finish(self, t, output_tokens):
         self.finish_t = t
         self.output_tokens = output_tokens
-        _E2E.observe(t - self.arrival_t)
-        if self.first_token_t is not None and output_tokens > 1:
-            _TPOT.observe((t - self.first_token_t)
-                          / (output_tokens - 1))
+        with _mtrace.exemplar_context(self.trace_id):
+            _E2E.observe(t - self.arrival_t)
+            if self.first_token_t is not None and output_tokens > 1:
+                _TPOT.observe((t - self.first_token_t)
+                              / (output_tokens - 1))
 
     def to_dict(self):
         ttft = (None if self.first_token_t is None

@@ -24,6 +24,7 @@ import itertools
 from collections import deque
 from enum import Enum
 
+from ..monitor import trace as _trace
 from .metrics import RequestMetrics, now
 
 
@@ -49,6 +50,13 @@ class Request:
         self.admit_seq = None      # monotone admission stamp (victim pick)
         self.metrics = RequestMetrics(now())
         self.metrics.prompt_tokens = len(self.prompt)
+        # span journal (monitor/trace.py, FLAGS_monitor_trace): the
+        # request's trace id, assigned at admission to the engine; None
+        # while the journal is off, and every trace_* helper below
+        # no-ops on None — a mid-run flag flip never half-traces
+        self.trace_id = None
+        self._span_root = None
+        self._span_phase = None
 
     @property
     def resume_tokens(self):
@@ -63,6 +71,54 @@ class Request:
     def finish(self):
         self.state = RequestState.FINISHED
         self.metrics.on_finish(now(), len(self.generated))
+
+    # -- span timeline (monitor/trace.py) ---------------------------------
+    #
+    # One root "request" span per request; lifecycle phases (queue ->
+    # prefill -> decode -> preempted -> prefill(resume) -> ...) are
+    # CONTIGUOUS child phase spans — each transition ends the previous
+    # phase and starts the next at ONE timestamp, so the phase
+    # durations sum to the request's e2e latency (the acceptance
+    # contract tests/test_trace.py pins at +-5%).
+
+    def trace_begin(self):
+        if not _trace.is_enabled():
+            return
+        self.trace_id = _trace.new_trace(
+            "request", request_id=self.id,
+            prompt_tokens=len(self.prompt),
+            max_new_tokens=self.max_new_tokens)
+        self._span_root = _trace.start_span(
+            "request", self.trace_id, kind="request", request_id=self.id)
+        self.metrics.trace_id = self.trace_id
+
+    def trace_phase(self, phase, **attrs):
+        if self.trace_id is None:
+            return
+        t = _trace.now()
+        if self._span_phase is not None:
+            _trace.end_span(self._span_phase, t=t)
+        self._span_phase = _trace.start_span(
+            phase, self.trace_id, parent_id=self._span_root,
+            kind="phase", t=t, **attrs)
+
+    def trace_event(self, name, **attrs):
+        if self.trace_id is None:
+            return
+        _trace.add_event(self._span_phase
+                         if self._span_phase is not None
+                         else self._span_root, name, **attrs)
+
+    def trace_finish(self, status="finished", **attrs):
+        if self.trace_id is None:
+            return
+        t = _trace.now()
+        if self._span_phase is not None:
+            _trace.end_span(self._span_phase, t=t)
+            self._span_phase = None
+        _trace.end_span(self._span_root, t=t, status=status,
+                        output_tokens=len(self.generated),
+                        preemptions=self.metrics.preemptions, **attrs)
 
 
 class Scheduler:
@@ -90,6 +146,11 @@ class Scheduler:
         return [(i, r) for i, r in enumerate(self.slots)
                 if r is not None and r.state is RequestState.DECODING]
 
+    def slots_active(self):
+        """Occupied slot count (any state) — the batch-slot occupancy
+        the trace events stamp."""
+        return sum(1 for r in self.slots if r is not None)
+
     # -- admission --------------------------------------------------------
 
     def admit_next(self):
@@ -114,6 +175,12 @@ class Scheduler:
         req.state = RequestState.PREFILL
         req.admit_seq = next(self._admit_counter)
         req.metrics.on_admit(now())
+        if req.trace_id is not None:    # attrs cost nothing when off
+            req.trace_event(
+                "scheduled", slot=slot, kv_pages=need,
+                kv_free_blocks=self.cache.allocator.free_blocks,
+                slots_active=self.slots_active(),
+                resume=req.metrics.preemptions > 0)
         return slot, req
 
     # -- slot release / preemption ---------------------------------------
@@ -133,8 +200,16 @@ class Scheduler:
         if not candidates:
             return None
         victim = max(candidates, key=lambda r: r.admit_seq)
+        seq_len = (int(self.cache.seq_lens[victim.slot])
+                   if victim.trace_id is not None else 0)
         self.release(victim)
         victim.state = RequestState.PREEMPTED
         victim.metrics.preemptions += 1
         self.requeue_front(victim)
+        if victim.trace_id is not None:
+            victim.trace_phase(
+                "preempted", seq_len=seq_len,
+                kv_pages_freed=self.cache.pages_needed(seq_len),
+                kv_free_blocks=self.cache.allocator.free_blocks,
+                slots_active=self.slots_active())
         return victim

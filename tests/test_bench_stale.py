@@ -61,3 +61,69 @@ class TestStaleChain:
         monkeypatch.setattr(bench, "LAST_GOOD",
                             str(tmp_path / "missing.json"))
         assert bench._emit_stale("nothing persisted (test)") == 3
+
+
+# ---------------------------------------------------------------------------
+# tools/perf_report.py --baseline must not diff against a photocopy
+# ---------------------------------------------------------------------------
+
+def _perf_report():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "perf_report_under_test",
+        os.path.join(REPO, "tools", "perf_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestPerfReportStaleBaseline:
+    """`perf_report.py --baseline` consumes the same BENCH_*.json
+    artifacts bench.py stamps — a stale re-emit (BENCH_r04/r05 are
+    photocopies of the 2026-07-31 probe) must be refused with its
+    provenance named, never diffed as if it were a live number."""
+
+    PAYLOAD = {"smoke": {"mfu": 0.4, "hbm_peak_bytes": 123},
+               "jobs": {}}
+
+    def _diff(self, baseline_path):
+        import io
+
+        out = io.StringIO()
+        _perf_report().diff_baseline(self.PAYLOAD, str(baseline_path),
+                                     out)
+        return out.getvalue()
+
+    def test_stale_markers_refuse_the_diff(self, tmp_path):
+        p = tmp_path / "BENCH_stale.json"
+        _write_good(str(p), mfu=0.39, stale=True,
+                    stale_reason="tunnel wedged (test)",
+                    stale_since="2026-07-31T01:04:37Z",
+                    stale_generations=2)
+        text = self._diff(p)
+        assert "STALE re-emit" in text and "refusing to diff" in text
+        assert "2026-07-31T01:04:37Z" in text
+        assert "stale_generations   2" in text
+        # no numeric comparison against the photocopy
+        assert "->" not in text
+
+    def test_driver_wrapper_parsed_record_detected(self, tmp_path):
+        """BENCH_r*.json wraps the record under "parsed" (next to the
+        raw child tail) — the stale markers must be found there too,
+        the exact BENCH_r04/r05 shape."""
+        p = tmp_path / "BENCH_r99.json"
+        with open(p, "w") as f:
+            json.dump({"n": 99, "rc": 0, "parsed": {
+                "metric": "m", "value": 1.0, "mfu": 0.39,
+                "measured_at": "2026-07-31T01:04:37Z",
+                "stale": True, "stale_reason": "probe failed"}}, f)
+        text = self._diff(p)
+        assert "STALE re-emit" in text
+
+    def test_fresh_baseline_still_diffs(self, tmp_path):
+        p = tmp_path / "BENCH_fresh.json"
+        _write_good(str(p), mfu=0.38)
+        text = self._diff(p)
+        assert "STALE" not in text
+        assert "mfu" in text and "->" in text

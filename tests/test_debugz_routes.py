@@ -1,0 +1,177 @@
+"""/debugz route matrix: every registered route answers under every
+monitor-flag disposition — all on AND all off — with pinned status
+codes; off means "absent or empty", never a crash.
+
+The fleet PR will route on these endpoints (drain-and-reschedule reads
+/healthz, the router reads /debugz/perf), so the whole surface gets one
+smoke matrix here instead of per-feature spot checks: `healthz`,
+`metrics`, `metrics.json`, `stacks`, `flight`, `bundle`, `perf`,
+`timeseries`, `trace` (+ the parametric `trace/{id}`).
+"""
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import monitor
+from paddle_tpu.monitor import perf
+from paddle_tpu.monitor import registry as mreg
+from paddle_tpu.monitor import timeseries as ts
+from paddle_tpu.monitor import trace
+from paddle_tpu.monitor import watchdog as wd
+
+# route -> (pinned status, body kind). These are the CONTRACT: a probe
+# or router hardcodes them, so a refactor that changes one must show up
+# here, not in production.
+ROUTES = {
+    "healthz": (200, "json"),
+    "metrics": (200, "text"),
+    "metrics.json": (200, "json"),
+    "debugz/stacks": (200, "json"),
+    "debugz/flight": (200, "json"),
+    "debugz/bundle": (200, "json"),
+    "debugz/perf": (200, "json"),
+    "debugz/timeseries": (200, "json"),
+    "debugz/trace": (200, "json"),
+}
+
+ALL_FLAGS = ("FLAGS_monitor_timeseries", "FLAGS_perf_attribution",
+             "FLAGS_perf_sentinels", "FLAGS_monitor_trace")
+
+
+@pytest.fixture()
+def server():
+    srv = monitor.MetricsServer(port=0).start()
+    yield "http://127.0.0.1:%d" % srv.port
+    srv.stop()
+
+
+def _reset_monitor_state():
+    paddle.set_flags({f: False for f in ALL_FLAGS})
+    perf.disable_sentinels()
+    perf.reset()
+    ts.disable()
+    ts.clear()
+    trace.disable()
+    trace.clear()
+    wd.stop_watchdog()
+    mreg.enable(trace_bridge=False)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    # reset BEFORE as well as after: the all-off matrix pins "watchdog
+    # disabled / hooks None", which an earlier suite's leftovers (a
+    # running watchdog, an enabled ring) would falsify
+    _reset_monitor_state()
+    yield
+    _reset_monitor_state()
+
+
+def _get(base, route):
+    try:
+        with urllib.request.urlopen("%s/%s" % (base, route),
+                                    timeout=10) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def _check_matrix(base):
+    for route, (want_code, kind) in sorted(ROUTES.items()):
+        code, body = _get(base, route)
+        assert code == want_code, (route, code)
+        if kind == "json":
+            # every JSON route stays STRICT-parseable (no bare NaN)
+            decoded = json.loads(
+                body.decode(),
+                parse_constant=lambda c: pytest.fail(
+                    "%s emitted bare %s" % (route, c)))
+            assert isinstance(decoded, dict)
+        else:
+            body.decode()
+
+
+class TestRouteMatrixAllOff:
+    def test_every_route_answers_with_flags_off(self, server):
+        """All monitor feature flags at their defaults (off): every
+        route still answers its pinned status — the payloads just say
+        disabled/empty."""
+        flags = paddle.get_flags(list(ALL_FLAGS))
+        assert not any(flags.values())
+        _check_matrix(server)
+        # off == empty, pinned per subsystem:
+        _, body = _get(server, "debugz/trace")
+        p = json.loads(body.decode())
+        assert p["enabled"] is False
+        assert p["trace_count"] == 0 and p["exemplars"] == {}
+        _, body = _get(server, "debugz/timeseries")
+        assert json.loads(body.decode())["enabled"] is False
+        _, body = _get(server, "debugz/perf")
+        p = json.loads(body.decode())
+        assert p["enabled"] == {"attribution": False,
+                                "timeseries": False,
+                                "sentinels": False}
+        _, body = _get(server, "healthz")
+        p = json.loads(body.decode())
+        assert p["status"] == "ok" and p["watchdog"] == "disabled"
+        # ...and the registry hot-path hook slots stayed None
+        assert mreg._state.ts_hook is None
+        assert mreg._state.ex_hook is None
+
+    def test_unknown_trace_id_404_not_crash(self, server):
+        code, body = _get(server, "debugz/trace/no-such-trace")
+        assert code == 404
+        assert json.loads(body.decode())["error"] == "unknown trace"
+
+    def test_unregistered_route_is_kv_404(self, server):
+        code, _ = _get(server, "debugz/nope")
+        assert code == 404
+
+
+class TestRouteMatrixAllOn:
+    def test_every_route_answers_with_flags_on(self, server):
+        """Everything enabled at once (ring + sentinels + journal +
+        watchdog thread) with live traffic: same pinned statuses, and
+        the payloads carry the traffic."""
+        paddle.set_flags({f: True for f in ALL_FLAGS})
+        ts.enable()
+        perf.enable_sentinels()
+        trace.enable()
+        wd.start_watchdog(stall_threshold_s=3600)
+        monitor.gauge("t_routes_gauge").set(1.5)
+        h = monitor.histogram("t_routes_seconds", buckets=(1.0,))
+        tid = trace.new_trace("request", request_id=1)
+        sid = trace.start_span("request", tid, kind="request")
+        with trace.exemplar_context(tid):
+            h.observe(0.5)
+        trace.end_span(sid)
+        perf.note_job("t_routes_job", tokens_per_s=10.0)
+
+        _check_matrix(server)
+        _, body = _get(server, "debugz/trace")
+        p = json.loads(body.decode())
+        assert p["enabled"] is True and p["trace_count"] >= 1
+        assert p["exemplars"]["t_routes_seconds"]["1.0"]["trace_id"] \
+            == tid
+        code, body = _get(server, "debugz/trace/%s" % tid)
+        assert code == 200
+        p = json.loads(body.decode())
+        assert p["trace_id"] == tid
+        assert p["spans"][0]["name"] == "request"
+        _, body = _get(server, "debugz/timeseries")
+        p = json.loads(body.decode())
+        assert p["enabled"] is True and "t_routes_gauge" in p["series"]
+        _, body = _get(server, "debugz/perf")
+        p = json.loads(body.decode())
+        assert "t_routes_job" in p["jobs"]
+        _, body = _get(server, "healthz")
+        p = json.loads(body.decode())
+        assert p["watchdog"] == "enabled" and p["status"] in (
+            "ok", "degraded")
+        _, body = _get(server, "metrics")
+        assert "t_routes_gauge 1.5" in body.decode()

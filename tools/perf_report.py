@@ -205,6 +205,30 @@ def diff_baseline(payload, baseline_path, out=sys.stdout):
     if isinstance(base, list):    # model_benchmark --out artifacts
         base = next((r for r in base if "mfu" in r), base[0] if base
                     else {})
+    if isinstance(base, dict) and isinstance(base.get("parsed"), dict):
+        # BENCH_r*.json driver wrapper: the measurement record rides
+        # under "parsed" (next to the raw child tail)
+        base = base["parsed"]
+    if isinstance(base, dict) and (
+            base.get("stale") or base.get("stale_generations")
+            or base.get("stale_since")):
+        # a photocopy re-emit (bench.py stale markers, ROADMAP:
+        # BENCH_r04/r05 re-emitted the 2026-07-31 probe) is NOT a live
+        # baseline — refuse the numeric diff instead of comparing
+        # against a number that was never re-measured
+        w("== baseline %s is a STALE re-emit — refusing to diff ==\n"
+          % os.path.basename(baseline_path))
+        w("  stale_reason        %s\n"
+          % base.get("stale_reason", "unrecorded"))
+        w("  stale_since         %s  (when the number was actually "
+          "measured)\n"
+          % base.get("stale_since", base.get("measured_at")))
+        if base.get("stale_generations"):
+            w("  stale_generations   %s  (consecutive photocopy "
+            "re-emits)\n" % base["stale_generations"])
+        w("  re-baseline on the next live tunnel window before "
+          "trusting any delta against this artifact\n")
+        return
     row = payload.get("smoke") or {}
     train = (payload.get("jobs") or {}).get("train") or {}
     cur_mfu = row.get("mfu", train.get("mfu"))

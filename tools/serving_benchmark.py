@@ -84,6 +84,12 @@ def main():
     ap.add_argument("--monitor-out", default=None,
                     help="also dump the monitor registry snapshot (with "
                          "written_at metadata) to this JSON path")
+    ap.add_argument("--no-trace", action="store_true",
+                    help="skip the span journal (requests_detail rows "
+                         "then carry no trace_id/phases_s breakdown)")
+    ap.add_argument("--trace-out", default=None,
+                    help="also write the span journal here "
+                         "(tools/trace_merge.py --requests input)")
     args = ap.parse_args()
     _watchdog(args.watchdog)
 
@@ -94,6 +100,14 @@ def main():
     import paddle_tpu as paddle
     from paddle_tpu import serving
     from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.monitor import trace as mtrace
+
+    # span journal on by default for the benchmark (a measurement
+    # tool): per-request phase attribution makes the preemption tax
+    # visible per-request, not only in the aggregate counters. Capacity
+    # sized to the workload so early requests never get evicted.
+    if not args.no_trace:
+        mtrace.enable(capacity=max(2 * args.requests + 64, 256))
 
     paddle.seed(args.seed)
     cfg = LlamaConfig(use_parallel=False, **PRESETS[args.preset])
@@ -153,7 +167,19 @@ def main():
     occ_sum = (stats["slot_occupancy"] * stats["decode_steps"]
                - base["slot_occupancy"] * base["decode_steps"])
     meas_occupancy = occ_sum / meas_steps if meas_steps else 0.0
-    per_req = [dict(eng.request_metrics(r), request_id=r) for r in ids]
+    per_req = []
+    for r in ids:
+        row = dict(eng.request_metrics(r), request_id=r)
+        # trace id + per-request phase breakdown (queue / prefill /
+        # decode / preempted seconds): the preemption tax attributable
+        # per-request — a preempted request shows the recompute in its
+        # own prefill/preempted phases, not only in the aggregate
+        tid, phases = eng.request_trace(r)
+        if tid is not None:
+            row["trace_id"] = tid
+            row["phases_s"] = {k: round(v, 6)
+                               for k, v in sorted(phases.items())}
+        per_req.append(row)
     ttft = [m["ttft_s"] for m in per_req if m["ttft_s"] is not None]
     tpot = [m["tpot_s"] for m in per_req if m["tpot_s"] is not None]
     queue = [m["queue_time_s"] for m in per_req
@@ -206,6 +232,9 @@ def main():
             "serving_throughput_tok_s": report["value"],
         })
         print("wrote", args.monitor_out, flush=True)
+    if args.trace_out and not args.no_trace:
+        mtrace.write_journal(args.trace_out)
+        print("wrote", args.trace_out, flush=True)
     # contract check: the whole staggered workload must have reused ONE
     # compiled decode step (the engine's core shape-stability claim)
     if stats["decode_compiles"] != 1:

@@ -9,11 +9,20 @@ monitor.trace_merge.estimate_clock_offset + write_clock_file), and emit
 a single merged trace with rank-prefixed pids — open it in
 Perfetto/chrome://tracing to read cross-rank comm/compute overlap.
 
+``--requests`` additionally merges span-journal artifacts
+(``monitor.trace.write_journal``: per-request serving timelines and
+per-step train spans) into the same view — each journal's wall-clock
+spans are shifted by its own wall<->monotonic anchor onto the native
+tracer's timebase, so one Perfetto file shows a request's journey
+across engine steps.
+
 Usage:
   python tools/trace_merge.py --dir traces/ --out merged.json
   python tools/trace_merge.py --out merged.json r0.json r1.json ...
       (rank inferred from the last integer in each filename)
   python tools/trace_merge.py --out m.json 0=a.json 1=b.json.gz
+  python tools/trace_merge.py --out m.json --requests journal.json \
+      [--requests-clock wall] [rank traces...]
 """
 from __future__ import annotations
 
@@ -86,16 +95,36 @@ def main(argv=None):
     ap.add_argument("--out", required=True, help="merged trace path")
     ap.add_argument("--no-offsets", action="store_true",
                     help="skip clock alignment (raw per-rank clocks)")
+    ap.add_argument("--requests", action="append", default=[],
+                    metavar="JOURNAL",
+                    help="span-journal JSON (monitor.trace."
+                         "write_journal) whose request/step spans "
+                         "merge into the timeline; repeatable")
+    ap.add_argument("--requests-clock", choices=("monotonic", "wall"),
+                    default="monotonic",
+                    help="timebase for journal spans: 'monotonic' "
+                         "(default; aligns with same-process native "
+                         "traces via the journal's clock anchor) or "
+                         "'wall' (journal-only merges)")
     args = ap.parse_args(argv)
 
     paths_by_rank, offsets = collect_inputs(args)
-    if not paths_by_rank:
+    extra = []
+    for jp in args.requests:
+        journal = tm.load_journal(jp)
+        evs = tm.journal_events(journal, clock=args.requests_clock)
+        print("requests: %s -> %d span/event(s) from %d trace(s)"
+              % (jp, len(evs), len(journal.get("traces") or ())))
+        extra.extend(evs)
+    if not paths_by_rank and not extra:
         ap.error("no input traces found")
     if args.no_offsets:
         offsets = {}
-    n = tm.merge_trace_files(paths_by_rank, args.out, offsets)
-    print("merged %d events from %d rank(s) -> %s"
-          % (n, len(paths_by_rank), args.out))
+    n = tm.merge_trace_files(paths_by_rank, args.out, offsets,
+                             extra_events=extra)
+    print("merged %d events (%d from %d rank(s), %d from journals) "
+          "-> %s" % (n, n - len(extra), len(paths_by_rank),
+                     len(extra), args.out))
     for r in sorted(paths_by_rank):
         print("  rank %d: %s (offset %+.0f us)"
               % (r, paths_by_rank[r], offsets.get(r, 0.0) * 1e6))
