@@ -52,6 +52,11 @@ struct StoreServer {
   std::condition_variable cv;
   std::map<std::string, std::string> kv;
   std::map<std::string, int64_t> counters;
+  // live client fds (guarded by mu): server_stop shuts them down so
+  // workers blocked in recv wake and join — shutdown must never
+  // require client cooperation (a still-connected idle client used to
+  // deadlock pt_store_server_stop in pthread_join forever)
+  std::vector<int> client_fds;
 };
 
 std::mutex g_servers_mu;
@@ -159,6 +164,18 @@ void ServeClient(StoreServer* s, int fd) {
       break;
     }
   }
+  {
+    // deregister BEFORE close, under the same mutex server_stop scans:
+    // a stop must never shutdown() an fd number the OS already
+    // recycled to someone else after this close
+    std::lock_guard<std::mutex> lk(s->mu);
+    for (auto it = s->client_fds.begin(); it != s->client_fds.end(); ++it) {
+      if (*it == fd) {
+        s->client_fds.erase(it);
+        break;
+      }
+    }
+  }
   close(fd);
 }
 
@@ -188,6 +205,10 @@ int pt_store_server_start(int port) {
       if (cfd < 0) {
         if (s->stop.load()) break;
         continue;
+      }
+      {
+        std::lock_guard<std::mutex> lk(s->mu);
+        s->client_fds.push_back(cfd);
       }
       s->workers.emplace_back(ServeClient, s, cfd);
     }
@@ -228,6 +249,14 @@ void pt_store_server_stop(int handle) {
   shutdown(s->listen_fd, SHUT_RDWR);
   close(s->listen_fd);
   if (s->accept_thread.joinable()) s->accept_thread.join();
+  {
+    // wake workers blocked in recv on idle-but-connected clients:
+    // without this, join below waited for every client to disconnect
+    // first (observed deadlock: master.close() with a live peer hung
+    // the process in pthread_join)
+    std::lock_guard<std::mutex> lk(s->mu);
+    for (int fd : s->client_fds) shutdown(fd, SHUT_RDWR);
+  }
   for (auto& t : s->workers)
     if (t.joinable()) t.join();
   delete s;

@@ -99,6 +99,15 @@ _DEFAULTS = {
     # flight-recorder ring, and flips the /healthz degraded flag.
     # Enabling sentinels enables the time-series ring (they read it).
     "FLAGS_perf_sentinels": False,
+    # deterministic fault injection (paddle_tpu/resilience/faultinject).
+    # Off = every injection site (store ops, eager collectives, serving
+    # engine step, compiled train step) is one attribute load + branch:
+    # no RNG, no locks, no threads, no native calls (test-pinned, the
+    # PR-2/5/6 discipline). On = the seeded schedule in
+    # PT_FAULT_SCHEDULE (site:kind[=arg][@when]; PT_FAULT_SEED) fires
+    # reproducible faults so every detect->recover->resume path runs in
+    # CI; firings count into faults_injected_total{site,kind}.
+    "FLAGS_fault_inject": False,
     # logging
     "FLAGS_v": 0,
     # structured errors (reference FLAGS_call_stack_level, enforce.h):

@@ -125,19 +125,32 @@ def load_state_dict(path, mesh=None, shardings=None, replicate=False):
     return out
 
 
+def split_model_state(model, optimizer):
+    """({'model.'/'opt.'-keyed arrays}, extras) for one checkpoint:
+    THE one place that decides which optimizer entries are arrays vs
+    extras (global_step, LR_Scheduler dicts). Shared by save_model and
+    the resilience snapshot capture — two copies of this predicate
+    would drift and load back differently depending on which wrote the
+    checkpoint. Array test is ``_value`` (Tensor) or ``dtype`` (numpy
+    AND jax arrays — the compiled path's functional slots sync back as
+    jax arrays)."""
+    state = {"model.%s" % k: v for k, v in model.state_dict().items()}
+    extras = {}
+    if optimizer is not None and hasattr(optimizer, "state_dict"):
+        for k, v in (optimizer.state_dict() or {}).items():
+            if hasattr(v, "_value") or hasattr(v, "dtype"):
+                state["opt.%s" % k] = v
+            else:
+                extras["opt.%s" % k] = v
+    return state, extras
+
+
 def save_model(model, optimizer, path, mesh=None):
     """Model + optimizer state in one checkpoint dir. Non-array
     optimizer entries (global_step, LR_Scheduler) travel as extras —
     dropping them would silently reset Adam bias correction and the LR
     schedule on resume."""
-    state = {"model.%s" % k: v for k, v in model.state_dict().items()}
-    extras = {}
-    if optimizer is not None and hasattr(optimizer, "state_dict"):
-        for k, v in (optimizer.state_dict() or {}).items():
-            if hasattr(v, "_value") or isinstance(v, np.ndarray):
-                state["opt.%s" % k] = v
-            else:
-                extras["opt.%s" % k] = v
+    state, extras = split_model_state(model, optimizer)
     return save_state_dict(state, path, mesh, extras=extras)
 
 

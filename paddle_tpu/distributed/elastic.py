@@ -11,6 +11,7 @@ declares a node dead when its beat is older than `ttl`.
 from __future__ import annotations
 
 import os
+import sys
 import threading
 import time
 
@@ -51,6 +52,15 @@ class ElasticManager:
         # hosts, so each node publishes an incrementing beat COUNTER and
         # the watcher times counter advancement on its own clock.
         self._last_seen = {}  # rank -> (counter, local_time_when_advanced)
+        # current membership, as ORIGINAL rank ids: recovery shrinks it
+        # via set_members() so watch() compares against the survivors,
+        # not the dead world (rank ids never renumber — beat keys and
+        # snapshot dirs stay stable across generations)
+        self.members = list(range(self.np))
+        # dead set of the most recent watch()/dead_nodes() — the "WHO
+        # died" answer the RESTART verdict alone doesn't carry
+        self.last_dead = []
+        self._logged_dead = None
 
     # -- registry -------------------------------------------------------
     def _beat_key(self, rank):
@@ -71,14 +81,22 @@ class ElasticManager:
             except Exception:
                 return
 
+    def set_members(self, members):
+        """Shrink/replace the watched membership (recovery generations:
+        survivors agree on the member set and watch only each other)."""
+        self.members = sorted(int(m) for m in members)
+        self.np = len(self.members)
+        self.last_dead = []
+        self._logged_dead = None
+
     def alive_nodes(self):
-        """Ranks whose beat counter advanced within the last ttl seconds
-        (as measured on THIS watcher's clock). register() starts every
-        live rank at count>=1 and exit() deletes the counter, so count<=0
-        means dead or never registered."""
+        """Member ranks whose beat counter advanced within the last ttl
+        seconds (as measured on THIS watcher's clock). register() starts
+        every live rank at count>=1 and exit() deletes the counter, so
+        count<=0 means dead or never registered."""
         now = time.monotonic()
         alive = []
-        for r in range(self.np):
+        for r in self.members:
             # non-creating read: never-registered ranks stay absent instead
             # of materializing zero counters in the store namespace
             count = self.store.counter_get(self._beat_key(r), default=0)
@@ -93,11 +111,28 @@ class ElasticManager:
                 alive.append(r)
         return alive
 
+    def dead_nodes(self):
+        """Member ranks currently NOT alive — the 'who died' set. A
+        rank whose heartbeat merely stopped ages out after ttl on this
+        watcher's clock; an exit()ed rank (counter deleted) drops out
+        immediately."""
+        return sorted(set(self.members) - set(self.alive_nodes()))
+
     def watch(self):
-        """One membership check (reference manager.py watch loop body)."""
+        """One membership check (reference manager.py watch loop body).
+        Records WHO died in ``self.last_dead`` (and logs the set once
+        per change) — the RESTART/ERROR verdict alone names no rank,
+        and recovery needs the dead set to rebuild membership."""
         if not self.enable:
             return ElasticStatus.COMPLETED
         alive = self.alive_nodes()
+        dead = sorted(set(self.members) - set(alive))
+        self.last_dead = dead
+        if dead and dead != self._logged_dead:
+            self._logged_dead = dead
+            sys.stderr.write(
+                "paddle_tpu.distributed.elastic: job %r dead ranks %s "
+                "(alive %s)\n" % (self.job_id, dead, alive))
         if len(alive) == self.np:
             return ElasticStatus.HOLD
         if len(alive) < self.np:

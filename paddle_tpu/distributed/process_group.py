@@ -30,6 +30,7 @@ import numpy as np
 
 from ..monitor import flight_recorder as _fr
 from ..monitor import watchdog as _wd
+from ..resilience import faultinject as _fi
 from . import compress as _compress
 
 _DONE = "/~done"
@@ -134,6 +135,13 @@ class StoreProcessGroup:
         lowers to allgather and must not double-record) AND bracket it
         with the watchdog heartbeat so a stalled wait is attributable to
         this op/seq."""
+        # fault-injection site per collective (resilience/faultinject):
+        # an injected error here models a rank failing AT the collective
+        # boundary — its peers see the missing frame and the flight
+        # recorder's timeout postmortem, exactly like an organic death.
+        # is_enabled() guard: the disabled hot path allocates nothing
+        if _fi.is_enabled():
+            _fi.fire("pg.%s" % op, group=self.prefix, rank=self.rank)
         a = None if arr is None else np.asarray(arr)
         rec_cm = self._recorder.record(
             op, reduce_op=reduce_op,

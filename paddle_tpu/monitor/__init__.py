@@ -82,8 +82,10 @@ from .watchdog import (  # noqa: F401
     diagnose_bundles,
     heartbeat,
     is_watchdog_running,
+    register_stall_action,
     start_watchdog,
     stop_watchdog,
+    unregister_stall_action,
 )
 from . import flight_recorder  # noqa: F401
 from . import perf  # noqa: F401
@@ -101,6 +103,7 @@ __all__ = [
     "FlightRecorder", "get_flight_recorder", "diagnose",
     "Heartbeat", "heartbeat", "start_watchdog", "stop_watchdog",
     "is_watchdog_running", "build_bundle", "diagnose_bundles",
+    "register_stall_action", "unregister_stall_action",
     "flight_recorder", "perf", "timeseries", "trace", "trace_merge",
     "watchdog",
 ]

@@ -21,6 +21,9 @@ server stack: ``KVHTTPServer`` gained a ``get_routes`` hook, and
                         (monitor/trace.py payload)
     GET /debugz/trace/{id}  one trace's full span timeline (404 for an
                         unknown or evicted trace id)
+    GET /debugz/resilience  fault-injection state + recovery/shed
+                        counters + watchdog escalation mode
+                        (paddle_tpu/resilience payload)
 
 The /healthz and /debugz routes are served live from monitor/watchdog.py
 whether or not the watchdog thread is running (the verdict just reads
@@ -93,6 +96,7 @@ class MetricsServer:
         routes["debugz/perf"] = self._perf
         routes["debugz/timeseries"] = self._timeseries
         routes["debugz/trace"] = self._trace
+        routes["debugz/resilience"] = self._resilience
         self._kv.http_server.get_prefix_routes["debugz/trace"] = \
             self._trace_by_id
 
@@ -130,6 +134,15 @@ class MetricsServer:
 
     def _trace(self):
         body = json.dumps(_watchdog.json_safe(_trace.payload()),
+                          default=str).encode()
+        return 200, "application/json", body
+
+    def _resilience(self):
+        # lazy: paddle_tpu.resilience imports back into monitor — the
+        # route resolves at request time, never at module import
+        from ..resilience import payload as _resilience_payload
+
+        body = json.dumps(_watchdog.json_safe(_resilience_payload()),
                           default=str).encode()
         return 200, "application/json", body
 

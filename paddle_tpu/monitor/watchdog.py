@@ -72,7 +72,7 @@ class _WDState:
     __slots__ = ("enabled", "autostart", "thread", "stop_event",
                  "stall_threshold_s", "poll_interval_s", "grace_s",
                  "lease_s", "fired", "last_request_answered",
-                 "healthz_out", "dump_dir")
+                 "healthz_out", "dump_dir", "action")
 
     def __init__(self):
         self.enabled = False
@@ -88,9 +88,22 @@ class _WDState:
         self.last_request_answered = None   # nonce of the last answered req
         self.healthz_out = None
         self.dump_dir = None
+        # escalation mode (PT_WATCHDOG_ACTION): "bundle" (default) =
+        # diagnose only; "recover" = additionally invoke the registered
+        # stall actions (resilience layer hooks) so a stalled bracket
+        # can TRIGGER recovery instead of only writing a postmortem
+        self.action = os.environ.get("PT_WATCHDOG_ACTION", "bundle")
 
 
 _state = _WDState()
+# stall-action hooks (escalation targets): called from the daemon
+# thread on a FRESH stall episode when PT_WATCHDOG_ACTION=recover.
+# The resilience layer registers here (ResilientTrainLoop requests a
+# snapshot-resume, a serving wrapper can request drain); hooks must be
+# quick + non-blocking (set a flag the owning loop consumes) and must
+# never raise — a recovery hook that wedges the watchdog would be the
+# failure it exists to fix.
+_stall_actions = []
 _hb_lock = threading.Lock()
 # RLock: the restart path (explicit config while running) stops the old
 # thread from inside start_watchdog. Guards against two threads racing
@@ -706,7 +719,37 @@ def _on_stall(stalls):
         _STALLS_TOTAL.inc()
     except Exception:
         pass
+    if _state.action == "recover" and _stall_actions:
+        for fn in list(_stall_actions):
+            try:
+                fn(stalls, report)
+            except Exception as e:
+                sys.stderr.write(
+                    "paddle_tpu.monitor.watchdog: stall action %r "
+                    "failed: %r\n" % (fn, e))
     return report
+
+
+def register_stall_action(fn):
+    """Register an escalation hook ``fn(stalls, report)`` invoked on a
+    fresh stall episode when ``PT_WATCHDOG_ACTION=recover``. Returns
+    ``fn`` (decorator-friendly)."""
+    if fn not in _stall_actions:
+        _stall_actions.append(fn)
+    return fn
+
+
+def unregister_stall_action(fn):
+    try:
+        _stall_actions.remove(fn)
+    except ValueError:
+        pass
+
+
+def stall_action():
+    """Current escalation mode ("bundle" | "recover") and hook count —
+    surfaced at /debugz/resilience."""
+    return {"mode": _state.action, "hooks": len(_stall_actions)}
 
 
 def _write_healthz_artifact():
@@ -809,6 +852,21 @@ def _start_watchdog_locked(stall_threshold_s, poll_interval_s, grace_s,
     _state.lease_s = float(os.environ.get(
         "PT_WATCHDOG_LEASE_S", str(_default_lease_s())))
     _state.healthz_out = os.environ.get("PT_WATCHDOG_HEALTHZ_OUT")
+    # like every PT_WATCHDOG_* sibling, the escalation mode re-reads
+    # the env at start: setting PT_WATCHDOG_ACTION after import (the
+    # common "configure then start" order) must take effect — and an
+    # unset env resets to the default rather than keeping a stale mode.
+    # Unknown values are called out loudly and degrade to diagnose-only:
+    # a typo ('recovery') silently disabling the escalation the operator
+    # armed would be discovered only after the outage.
+    action = os.environ.get("PT_WATCHDOG_ACTION", "bundle")
+    if action not in ("bundle", "recover"):
+        sys.stderr.write(
+            "paddle_tpu.monitor.watchdog: unknown PT_WATCHDOG_ACTION=%r "
+            "(expected 'bundle' or 'recover'); using 'bundle'\n"
+            % action)
+        action = "bundle"
+    _state.action = action
     _state.fired = {}
     _state.enabled = True
     _state.stop_event = threading.Event()

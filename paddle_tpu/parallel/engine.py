@@ -39,6 +39,7 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import monitor as _monitor
+from ..resilience import faultinject as _fi
 from ..core.dispatch import no_grad
 from ..core.tensor import Tensor
 from ..distributed import compress as _compress
@@ -583,6 +584,12 @@ class CompiledTrainStep:
         optimizer step counter still advances per step (bias correction
         is exact). Returns the LAST step's loss.
         """
+        # fault-injection site (resilience/faultinject): fires BEFORE
+        # the window dispatches — an injected error models a rank dying
+        # / wedging at a step boundary, the failure ResilientTrainLoop
+        # recovers from. One branch (and zero allocations) when disabled.
+        if _fi.is_enabled():
+            _fi.fire("train.run_steps", step0=self._step_count + 1)
         if getattr(self, "_compiled_multi", None) is None:
             self._build_multi()
         vals = self._prep_batch(stacked_batch, stacked=True)
@@ -719,6 +726,8 @@ class CompiledTrainStep:
     @no_grad()
     def __call__(self, *batch):
         """batch = (*inputs, labels) as Tensors or arrays; returns loss."""
+        if _fi.is_enabled():
+            _fi.fire("train.step", step=self._step_count + 1)
         if self._compiled is None:
             self._build()
         vals = self._prep_batch(batch)

@@ -1,0 +1,96 @@
+"""paddle_tpu.resilience — deterministic fault injection and
+detect→recover→resume across store, training, and serving.
+
+Division of labor with the monitor stack: monitor *names* a failure
+(flight recorder → diverging rank, watchdog → stalled bracket, trace →
+wedged request); this package *acts* on one:
+
+1. **Fault injection** (resilience/faultinject.py,
+   ``FLAGS_fault_inject`` / ``PT_FAULT_SCHEDULE``): seeded,
+   schedule-driven faults at named sites threaded through the TCPStore
+   ops, the eager collectives, the serving engine, and the compiled
+   train step — every recovery path below is exercised reproducibly.
+
+2. **Store hardening** (distributed/store.py): op retry with
+   exponential backoff + jitter, automatic reconnect on a dead fd,
+   errors naming op/key/peer/attempts, and a reusable round-based
+   barrier (restart generations reuse names safely).
+
+3. **ResilientTrainLoop** (resilience/train.py): periodic async
+   snapshots off the critical path (distributed/checkpoint format +
+   a background writer thread), consuming ``ElasticManager.watch()``
+   verdicts to detect a dead rank, rebuild membership over the store,
+   and resume from the last complete snapshot with a pinned loss
+   trajectory. Registers as a watchdog escalation target
+   (``PT_WATCHDOG_ACTION=recover``).
+
+4. **Serving graceful degradation** (serving/engine.py): per-request
+   queue-TTL deadlines (``expired`` terminal status), bounded
+   admission queue with load shedding, a preemption-count cap,
+   poison-request quarantine (a step exception fails the one request,
+   not the engine), and ``Engine.drain()`` — the fleet
+   drain-and-reschedule building block.
+
+Metrics (the one registry): ``faults_injected_total{site,kind}``,
+``recoveries_total{kind}``, ``snapshot_seconds``,
+``serving_requests_shed_total{reason}``, ``store_reconnects_total``,
+``store_op_retries_total{op}``. Served live at
+``GET /debugz/resilience``.
+
+Import discipline: this ``__init__`` (and faultinject) stays
+stdlib-only so the store/worker processes can import the injection
+sites without an accelerator backend; ``ResilientTrainLoop`` (which
+needs jax via the checkpoint layer) loads lazily on first attribute
+access.
+"""
+from __future__ import annotations
+
+from . import faultinject  # noqa: F401  (stdlib-only, always safe)
+from .faultinject import InjectedFault  # noqa: F401
+
+__all__ = ["faultinject", "InjectedFault", "ResilientTrainLoop",
+           "payload"]
+
+
+def __getattr__(name):
+    # lazy: resilience.train imports the checkpoint layer (jax) — the
+    # stdlib-only importers (store.py, bare workers) must not pay it
+    if name == "ResilientTrainLoop":
+        from .train import ResilientTrainLoop
+
+        return ResilientTrainLoop
+    if name == "train":
+        from . import train
+
+        return train
+    raise AttributeError(name)
+
+
+def payload():
+    """JSON-ready /debugz/resilience payload: injection state plus the
+    resilience counters already in the registry snapshot."""
+    from ..monitor import registry as _mreg
+
+    reg = _mreg.get_registry()
+    counters = {}
+    for mname in ("faults_injected_total", "recoveries_total",
+                  "snapshots_total", "snapshot_errors_total",
+                  "serving_requests_shed_total",
+                  "store_reconnects_total", "store_op_retries_total"):
+        m = reg.get(mname)
+        if m is None:
+            continue
+        counters[mname] = [
+            {"labels": dict(zip(m.labelnames, key)), "value": v}
+            for key, v in m.collect()]
+    out = {
+        "fault_injection": faultinject.state(),
+        "counters": counters,
+    }
+    try:
+        from ..monitor import watchdog as _wd
+
+        out["watchdog_action"] = _wd.stall_action()
+    except Exception:
+        pass
+    return out

@@ -1,0 +1,290 @@
+"""Deterministic fault injection: seeded, schedule-driven chaos.
+
+The observability stack (PRs 2/3/5/6) can *name* every production
+failure — a diverging rank, a stalled bracket, a wedged request — but
+the recovery paths that must *act* on one (store reconnect, elastic
+membership rebuild, serving quarantine/shed, snapshot resume) are
+exactly the code that never runs in a clean CI pass. This module makes
+every failure path below reproducible: named **injection sites**
+threaded into the store ops, the eager collectives, the serving engine
+step, and the compiled train step fire faults on a deterministic,
+seeded schedule, so a chaos test replays the same incident every run.
+
+Sites (the contract between this module and the instrumented code):
+
+    store.set / store.get / store.add / store.delete   TCPStore ops
+    pg.<op>            StoreProcessGroup collectives (pg.all_reduce, …)
+    serving.step       top of Engine.step (engine-level transient)
+    serving.prefill    per-request prefill (poison-request path)
+    serving.decode     batched decode dispatch (quarantine path)
+    train.step         CompiledTrainStep.__call__
+    train.run_steps    CompiledTrainStep.run_steps
+    snapshot.save      ResilientTrainLoop snapshot write
+
+Fault kinds:
+
+    error      raise InjectedFault at the site
+    delay      sleep ``arg`` seconds (default 0.05), then proceed
+    drop       site-cooperative: the op is silently skipped (a set
+               that never lands, a get that times out) — returned to
+               the caller as the string "drop"
+    broken_fd  site-cooperative (store ops): the client fd is closed
+               under the caller's lock before the op, exercising the
+               reconnect path — returned as "broken_fd"
+
+Schedule grammar (``PT_FAULT_SCHEDULE`` / ``enable(schedule)``),
+semicolon-separated rules::
+
+    site:kind[=arg][@when]
+
+    when := N        fire on the Nth hit of the site (1-based), once
+          | N..      every hit from the Nth on
+          | N..M     hits N through M inclusive
+          | pFLOAT   probability per hit (seeded — deterministic)
+          | %N       every Nth hit
+    (no @when = every hit)
+
+    PT_FAULT_SCHEDULE="store.set:error@3;serving.prefill:error@p0.2"
+    PT_FAULT_SCHEDULE="store.get:broken_fd@2;pg.all_reduce:delay=0.2@%4"
+
+Discipline (the PR-2/5/6 contract, test-pinned): default OFF via
+``FLAGS_fault_inject``; while off every ``fire()`` is one attribute
+load + branch — no RNG, no locks, no threads, no native calls, no
+allocations. Sites are also compiled out of artifacts: the disabled
+path never constructs rule state. Stdlib-only so worker processes can
+import it without an accelerator backend.
+"""
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+
+from ..monitor import registry as _registry
+from ..monitor.timeseries import _flag
+
+_FAULTS = _registry.counter(
+    "faults_injected_total",
+    "faults fired by the injection framework (resilience/faultinject)",
+    labelnames=("site", "kind"))
+
+_KINDS = ("error", "delay", "drop", "broken_fd")
+
+
+class InjectedFault(RuntimeError):
+    """A deliberately injected failure (never a real bug). Recovery
+    code may match on this type; production code must treat it exactly
+    like the organic failure it models."""
+
+    def __init__(self, site, rule):
+        super().__init__(
+            "injected fault at site %r (rule %s)" % (site, rule))
+        self.site = site
+        self.rule = rule
+
+
+class Rule:
+    """One schedule entry: fire ``kind`` at ``site`` when the site's
+    hit index (1-based, counted per rule) matches ``when``."""
+
+    __slots__ = ("site", "kind", "arg", "when", "hits", "fired",
+                 "mismatched")
+
+    def __init__(self, site, kind, arg=None, when=None):
+        if kind not in _KINDS:
+            raise ValueError(
+                "unknown fault kind %r (one of %s)" % (kind, _KINDS))
+        self.site = site
+        self.kind = kind
+        self.arg = arg
+        self.when = when            # None | (lo, hi) | ("p", prob) | ("%", n)
+        self.hits = 0
+        self.fired = 0
+        # rule matched a site that cannot apply its kind (e.g. "drop"
+        # at a collective): counted here, NEVER into the fired/metric
+        # totals — a schedule that injects nothing must not report
+        # that it did
+        self.mismatched = 0
+
+    def _matches(self, rng):
+        n = self.hits
+        w = self.when
+        if w is None:
+            return True
+        if w[0] == "p":
+            return rng.random() < w[1]
+        if w[0] == "%":
+            return n % w[1] == 0
+        lo, hi = w
+        return lo <= n <= (hi if hi is not None else n)
+
+    def __str__(self):
+        arg = "=%s" % self.arg if self.arg is not None else ""
+        if self.when is None:
+            when = ""
+        elif self.when[0] == "p":
+            when = "@p%g" % self.when[1]
+        elif self.when[0] == "%":
+            when = "@%%%d" % self.when[1]
+        else:
+            lo, hi = self.when
+            when = "@%d" % lo if hi == lo else (
+                "@%d.." % lo if hi is None else "@%d..%d" % (lo, hi))
+        return "%s:%s%s%s" % (self.site, self.kind, arg, when)
+
+
+def parse_schedule(spec):
+    """Schedule string -> [Rule]; raises ValueError on a bad rule (a
+    silently-ignored typo'd schedule would be a chaos test that tests
+    nothing)."""
+    rules = []
+    for part in str(spec).split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            site, _, rest = part.partition(":")
+            if not site or not rest:
+                raise ValueError("need site:kind")
+            when = None
+            if "@" in rest:
+                rest, _, w = rest.partition("@")
+                if w.startswith("p"):
+                    when = ("p", float(w[1:]))
+                elif w.startswith("%"):
+                    n = int(w[1:])
+                    if n < 1:       # %0 would div-by-zero at FIRE time,
+                        raise ValueError(  # deep inside a production op
+                            "every-Nth trigger needs N >= 1")
+                    when = ("%", n)
+                elif ".." in w:
+                    lo, _, hi = w.partition("..")
+                    when = (int(lo), int(hi) if hi else None)
+                else:
+                    when = (int(w), int(w))
+            arg = None
+            if "=" in rest:
+                rest, _, a = rest.partition("=")
+                arg = float(a)
+            rules.append(Rule(site, rest, arg, when))
+        except ValueError as e:
+            raise ValueError(
+                "bad fault rule %r: %s (grammar: site:kind[=arg][@when])"
+                % (part, e))
+    return rules
+
+
+class _State:
+    __slots__ = ("enabled", "rules", "seed", "rng", "lock", "site_hits")
+
+    def __init__(self):
+        self.enabled = False
+        self.rules = []
+        self.seed = 0
+        self.rng = None
+        self.lock = threading.Lock()
+        self.site_hits = {}
+
+
+_state = _State()
+
+
+def enable(schedule=None, seed=None):
+    """Arm the framework (process-wide). ``schedule`` is a spec string
+    or a list of Rules; defaults to ``PT_FAULT_SCHEDULE``. ``seed``
+    fixes the probabilistic rules' RNG (default ``PT_FAULT_SEED`` or
+    0) — same seed + same schedule + same call sequence = same faults."""
+    if schedule is None:
+        schedule = os.environ.get("PT_FAULT_SCHEDULE", "")
+    rules = (list(schedule) if isinstance(schedule, (list, tuple))
+             else parse_schedule(schedule))
+    if seed is None:
+        seed = int(os.environ.get("PT_FAULT_SEED", "0"))
+    with _state.lock:
+        _state.rules = rules
+        _state.seed = int(seed)
+        _state.rng = random.Random(int(seed))
+        _state.site_hits = {}
+        _state.enabled = True
+    return rules
+
+
+def disable():
+    """Disarm: every ``fire()`` returns to the one-branch fast path.
+    Rule hit/fired counts are kept for post-run inspection."""
+    _state.enabled = False
+
+
+def is_enabled():
+    return _state.enabled
+
+
+def fire(site, _supports=(), **ctx):
+    """Injection site hook. Returns None (no fault, or a fault the
+    framework handled itself: delay) or an action string the CALLER
+    must apply ("drop", "broken_fd"). Raises InjectedFault for kind
+    "error".
+
+    ``_supports`` declares which cooperative kinds THIS site can
+    apply; a rule whose kind the site cannot honor counts as
+    ``mismatched`` (visible in ``state()``), never as injected — the
+    metrics must not claim chaos that never happened.
+
+    The disabled path is one attribute load + branch; hot call sites
+    additionally guard with ``is_enabled()`` so they build no ctx
+    dict/strings while off (the zero-allocation contract)."""
+    if not _state.enabled:
+        return None
+    return _fire(site, _supports, ctx)
+
+
+def _fire(site, supports, ctx):
+    action = None
+    with _state.lock:
+        _state.site_hits[site] = _state.site_hits.get(site, 0) + 1
+        for rule in _state.rules:
+            if rule.site != site:
+                continue
+            rule.hits += 1
+            if not rule._matches(_state.rng):
+                continue
+            if rule.kind in ("drop", "broken_fd") \
+                    and rule.kind not in supports:
+                rule.mismatched += 1
+                continue
+            rule.fired += 1
+            action = rule
+            break
+    if action is None:
+        return None
+    rule = action
+    _FAULTS.labels(site=site, kind=rule.kind).inc()
+    if rule.kind == "delay":
+        time.sleep(rule.arg if rule.arg is not None else 0.05)
+        return None
+    if rule.kind == "error":
+        raise InjectedFault(site, str(rule))
+    return rule.kind         # "drop" | "broken_fd": caller cooperates
+
+
+def state():
+    """JSON-ready snapshot for /debugz/resilience: schedule, per-site
+    hit counts, per-rule fired counts."""
+    with _state.lock:
+        return {
+            "enabled": _state.enabled,
+            "seed": _state.seed,
+            "rules": [{"rule": str(r), "site": r.site, "kind": r.kind,
+                       "hits": r.hits, "fired": r.fired,
+                       "mismatched": r.mismatched}
+                      for r in _state.rules],
+            "site_hits": dict(_state.site_hits),
+        }
+
+
+# FLAGS_fault_inject bootstraps the framework at import like the other
+# monitor flags: a worker process started with the flag + schedule env
+# injects from its first store op.
+if _flag("FLAGS_fault_inject"):
+    enable()

@@ -1,0 +1,537 @@
+"""ResilientTrainLoop: periodic async snapshots + detect→recover→resume.
+
+The repo could *name* a dead rank (ElasticManager verdicts, watchdog
+postmortems) but nothing consumed the verdict: a killed rank or a
+broken store socket ended the job. This loop closes the cycle around a
+``CompiledTrainStep``:
+
+1. **Snapshot** — every ``snapshot_every`` steps the full training
+   state (params, optimizer slots, step counter, RNG key+counter) is
+   captured to host and written OFF the critical path by one background
+   writer thread, in the ``distributed/checkpoint`` format
+   (``index.json`` + ``.npy``), into ``snap_<step>`` dirs finalized by
+   an atomic rename — a kill mid-write can never leave a half snapshot
+   that resume would trust. Retention keeps the newest ``keep``.
+
+2. **Detect** — after every step the loop consumes
+   ``ElasticManager.watch()`` (the so-far-unconsumed RESTART/ERROR
+   verdicts): membership shrank → ``elastic.last_dead`` names who. A
+   step exception (an injected store fault, a collective timeout
+   because a peer died) routes through the same funnel: if the elastic
+   verdict confirms a death within ``2*ttl`` it is a ``rank_death``,
+   otherwise a ``step_error``.
+
+3. **Recover** — ``rank_death``: survivors settle one TTL, the lowest
+   alive rank (leader) publishes the new member set + resume step under
+   a generation-suffixed store key, everyone barriers on the
+   generation-suffixed name (safe to reuse names across generations —
+   the round-based store barrier), ``elastic.set_members`` shrinks the
+   watch set, and the ``on_generation`` callback lets the caller
+   rebuild rank-aware state (a StoreProcessGroup over the survivors).
+   ``step_error``/``watchdog``: restore only.
+
+4. **Resume** — reload the chosen snapshot (params + opt slots through
+   the optimizer's functional-load bridge, step counter, RNG state) and
+   continue from its step. With a deterministic ``batch_fn(step)`` the
+   post-recovery loss trajectory is bit-identical to an uninterrupted
+   run from that snapshot (test-pinned).
+
+Caveats (documented, not silent): snapshots store the global logical
+arrays without partition specs (reload re-shards via the step's jit
+in_shardings — exact for replicated-param configs, which is every
+config this loop targets); quantized-grad-sync error-feedback residuals
+are not snapshotted (flag-off default; a resume under the flag restarts
+EF from zero, within its documented approximation).
+
+Watchdog escalation: ``enable_watchdog_escalation()`` registers this
+loop as a stall action — under ``PT_WATCHDOG_ACTION=recover`` a stalled
+bracket requests a snapshot restore instead of only writing a
+postmortem (the hook only sets a flag; the loop acts at the next step
+boundary, never from the daemon thread).
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import threading
+import time
+
+import numpy as np
+
+from ..monitor import registry as _mreg
+from . import faultinject as _fi
+
+RECOVERIES = _mreg.counter(
+    "recoveries_total",
+    "resilience recovery episodes completed, by trigger kind",
+    labelnames=("kind",))
+SNAPSHOTS = _mreg.counter(
+    "snapshots_total", "training snapshots completed (atomic rename)")
+SNAPSHOT_ERRORS = _mreg.counter(
+    "snapshot_errors_total",
+    "snapshot writes that FAILED (full disk, bad dir) — a flat "
+    "snapshots_total with this climbing means recovery has nothing to "
+    "resume from")
+SNAPSHOT_SECONDS = _mreg.histogram(
+    "snapshot_seconds",
+    "wall seconds of the OFF-critical-path snapshot write (capture to "
+    "host is separate and synchronous)",
+    buckets=(.005, .01, .025, .05, .1, .25, .5, 1.0, 2.5, 5.0, 10.0,
+             30.0, 60.0))
+SNAPSHOT_CAPTURE_SECONDS = _mreg.histogram(
+    "snapshot_capture_seconds",
+    "wall seconds the TRAIN LOOP pays per snapshot (device->host "
+    "capture; the critical-path cost of resilience)",
+    buckets=(.001, .0025, .005, .01, .025, .05, .1, .25, .5, 1.0, 2.5,
+             5.0))
+
+_SNAP_PREFIX = "snap_"
+_TMP_PREFIX = ".tmp-snap_"
+
+
+def _snap_name(step):
+    return "%s%08d" % (_SNAP_PREFIX, step)
+
+
+def list_snapshots(snapshot_dir):
+    """Complete snapshots (finalized dirs with an index) -> sorted
+    step list. Tmp dirs from a killed writer are invisible here."""
+    steps = []
+    try:
+        names = os.listdir(snapshot_dir)
+    except OSError:
+        return []
+    for n in names:
+        if not n.startswith(_SNAP_PREFIX):
+            continue
+        if not os.path.exists(os.path.join(snapshot_dir, n,
+                                           "index.json")):
+            continue
+        try:
+            steps.append(int(n[len(_SNAP_PREFIX):]))
+        except ValueError:
+            continue
+    return sorted(steps)
+
+
+class _SnapshotWriter:
+    """One background thread serializing snapshot writes: tmp dir →
+    save_state_dict → atomic rename → retention prune. At most one
+    pending write; a snapshot requested while one is in flight is
+    skipped (the next cadence tick catches up) — the train loop never
+    blocks on disk."""
+
+    def __init__(self, snapshot_dir, keep):
+        self.snapshot_dir = snapshot_dir
+        self.keep = max(1, int(keep))
+        self._busy = threading.Event()
+        self._work = None
+        self._cv = threading.Condition()
+        self._stop = False
+        self._thread = None
+        self.skipped = 0
+        self.errors = []
+
+    def _ensure_thread(self):
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._run, name="pt-snapshot-writer", daemon=True)
+            self._thread.start()
+
+    def submit(self, step, state, extras):
+        with self._cv:
+            if self._work is not None or self._busy.is_set():
+                self.skipped += 1
+                return False
+            self._work = (step, state, extras)
+            self._ensure_thread()
+            self._cv.notify()
+        return True
+
+    def flush(self, timeout_s=60):
+        """Wait for the in-flight/pending write to land."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._cv:
+                if self._work is None and not self._busy.is_set():
+                    return True
+            time.sleep(0.01)
+        return False
+
+    def stop(self):
+        with self._cv:
+            self._stop = True
+            self._cv.notify()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def _run(self):
+        while True:
+            with self._cv:
+                while self._work is None and not self._stop:
+                    self._cv.wait(0.25)
+                if self._stop and self._work is None:
+                    return
+                step, state, extras = self._work
+                self._work = None
+                self._busy.set()
+            try:
+                self._write(step, state, extras)
+            except Exception as e:
+                # a silently-swallowed write failure would surface
+                # hours later as "no complete snapshot to resume from"
+                # — make it loud NOW, on both stderr and the registry
+                self.errors.append((step, repr(e)))
+                SNAPSHOT_ERRORS.inc()
+                sys.stderr.write(
+                    "paddle_tpu.resilience: snapshot write for step %d "
+                    "FAILED under %r: %r\n"
+                    % (step, self.snapshot_dir, e))
+            finally:
+                self._busy.clear()
+
+    def _write(self, step, state, extras):
+        from ..distributed import checkpoint as _ckpt
+
+        t0 = time.perf_counter()
+        tmp = os.path.join(self.snapshot_dir, _TMP_PREFIX + "%08d" % step)
+        final = os.path.join(self.snapshot_dir, _snap_name(step))
+        shutil.rmtree(tmp, ignore_errors=True)
+        # mesh=None would consult the global mesh from this thread;
+        # the index's mesh_axes field is informational only for these
+        # replicated host arrays, so the capture thread's mesh rides in
+        _ckpt.save_state_dict(state, tmp, mesh=extras.pop("__mesh__"),
+                              extras=extras)
+        if os.path.isdir(final):
+            shutil.rmtree(final, ignore_errors=True)
+        os.replace(tmp, final)
+        SNAPSHOTS.inc()
+        SNAPSHOT_SECONDS.observe(time.perf_counter() - t0)
+        self._prune()
+
+    def _prune(self):
+        steps = list_snapshots(self.snapshot_dir)
+        for s in steps[:-self.keep]:
+            shutil.rmtree(os.path.join(self.snapshot_dir, _snap_name(s)),
+                          ignore_errors=True)
+
+
+class ResilientTrainLoop:
+    """Detect→recover→resume driver around one CompiledTrainStep.
+
+    train_step   the compiled step (owns model/optimizer/step counter)
+    batch_fn     deterministic data source: batch_fn(step_index) ->
+                 (*inputs, labels) for 1-based global step index —
+                 resume replays exactly the batches the lost steps saw
+    snapshot_dir snapshots land here as ``snap_<step>`` dirs
+    elastic      optional ElasticManager: membership detect + rebuild
+    snapshot_every  cadence in steps (0 = only explicit snapshot())
+    keep         snapshot retention
+    post_step    optional hook(step_index, loss_float) -> loss_float,
+                 e.g. a cross-rank loss all-reduce; its exceptions run
+                 the same recovery funnel as step exceptions
+    on_generation  hook(generation, members, info) after a membership
+                 rebuild — rebuild rank-aware state here
+    max_recoveries  hard cap; exceeding it re-raises (no retry storm)
+    """
+
+    def __init__(self, train_step, batch_fn, snapshot_dir, elastic=None,
+                 snapshot_every=0, keep=2, post_step=None,
+                 on_generation=None, max_recoveries=8,
+                 store_timeout_s=60, steps_per_call=1):
+        self.steps_per_call = int(steps_per_call)
+        self.train_step = train_step
+        self.model = train_step.model
+        self.optimizer = train_step.optimizer
+        self.batch_fn = batch_fn
+        self.snapshot_dir = snapshot_dir
+        self.elastic = elastic
+        self.snapshot_every = int(snapshot_every)
+        self.post_step = post_step
+        self.on_generation = on_generation
+        self.max_recoveries = int(max_recoveries)
+        self.store_timeout_s = float(store_timeout_s)
+        self.generation = 0
+        self._last_watch = 0.0
+        self.recoveries = 0
+        self.recovery_log = []      # [(kind, resumed_step)]
+        self._recover_requested = None
+        self._writer = _SnapshotWriter(snapshot_dir, keep)
+        os.makedirs(snapshot_dir, exist_ok=True)
+
+    # -- snapshots --------------------------------------------------------
+
+    def _capture(self):
+        """Device→host capture of the full resume state. Runs on the
+        train loop thread (the only thread that may read live training
+        state); the disk write happens on the writer thread."""
+        import jax
+
+        from ..distributed import checkpoint as _ckpt
+        from ..framework import random as _random
+
+        t0 = time.perf_counter()
+        # the array-vs-extras split is checkpoint.py's ONE predicate;
+        # here we additionally materialize arrays to host numpy so the
+        # background writer never touches live device state
+        state, extras = _ckpt.split_model_state(self.model,
+                                                self.optimizer)
+        state = {k: np.asarray(v._value if hasattr(v, "_value") else v)
+                 for k, v in state.items()}
+        extras["step"] = int(self.train_step._step_count)
+        extras["__mesh__"] = self.train_step.mesh
+        key, counter = _random.get_rng_state()
+        state["__rng__.key_data"] = np.asarray(jax.random.key_data(key))
+        extras["__rng__.counter"] = int(counter)
+        SNAPSHOT_CAPTURE_SECONDS.observe(time.perf_counter() - t0)
+        return state, extras
+
+    def snapshot(self):
+        """Capture now + hand the write to the background thread.
+        Returns the snapshot step, or None when skipped (writer busy or
+        an injected snapshot fault)."""
+        try:
+            _fi.fire("snapshot.save", step=self.train_step._step_count)
+        except _fi.InjectedFault:
+            return None         # a failed snapshot never fails training
+        step = int(self.train_step._step_count)
+        state, extras = self._capture()
+        return step if self._writer.submit(step, state, extras) else None
+
+    def flush_snapshots(self, timeout_s=60):
+        return self._writer.flush(timeout_s)
+
+    def latest_snapshot_step(self):
+        steps = list_snapshots(self.snapshot_dir)
+        return steps[-1] if steps else None
+
+    def restore(self, step=None):
+        """Reload snapshot ``step`` (default: latest complete): params,
+        optimizer slots (through the functional-load bridge, which also
+        restores the compiled step counter), and the RNG key+counter.
+        Returns the restored step."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..distributed import checkpoint as _ckpt
+        from ..framework import random as _random
+
+        self.flush_snapshots()
+        if step is None:
+            step = self.latest_snapshot_step()
+        if step is None:
+            raise RuntimeError(
+                "no complete snapshot under %r to resume from"
+                % self.snapshot_dir)
+        path = os.path.join(self.snapshot_dir, _snap_name(step))
+        _ckpt.load_model(self.model, self.optimizer, path,
+                         mesh=self.train_step.mesh)
+        with open(os.path.join(path, "index.json")) as f:
+            index = json.load(f)
+        extras = index.get("extras", {})
+        meta = index["arrays"].get("__rng__.key_data")
+        if meta is not None:
+            arr = np.load(os.path.join(path, meta["file"]))
+            _random.set_rng_state((
+                jax.random.wrap_key_data(jnp.asarray(arr)),
+                int(extras.get("__rng__.counter", 0))))
+        # set_state_dict drove the optimizer's functional-load hook;
+        # pin the loop-visible counter to the snapshot regardless
+        self.train_step._step_count = int(extras.get("step", step))
+        return step
+
+    # -- watchdog escalation ----------------------------------------------
+
+    def enable_watchdog_escalation(self):
+        """Register as a watchdog stall action: under
+        ``PT_WATCHDOG_ACTION=recover`` a stall requests a restore at
+        the next step boundary (the hook never mutates training state
+        from the daemon thread)."""
+        from ..monitor import watchdog as _wd
+
+        def _action(stalls, report):
+            self._recover_requested = "watchdog"
+
+        self._wd_action = _action
+        _wd.register_stall_action(_action)
+        return _action
+
+    # -- detect / recover -------------------------------------------------
+
+    def _verdict_bad(self, throttled=False):
+        """One membership check. ``throttled=True`` (the per-step call)
+        rate-limits to one check per heartbeat interval: watch() costs
+        a store round-trip per member, detection latency is bounded by
+        the TTL (seconds) anyway, and ms-scale steps must not pay
+        world_size blocking RPCs each."""
+        from ..distributed.elastic import ElasticStatus
+
+        if self.elastic is None or not self.elastic.enable:
+            return False
+        if throttled:
+            now = time.monotonic()
+            if now - self._last_watch < self.elastic.interval:
+                return False
+            self._last_watch = now
+        return self.elastic.watch() in (ElasticStatus.RESTART,
+                                        ElasticStatus.ERROR)
+
+    def _classify_failure(self):
+        """A step raised: was it a peer death? Poll the elastic verdict
+        for up to 2*ttl (a dead peer's beat must age out before the
+        watcher can see it) — confirmed death recovers as rank_death
+        (membership rebuild), anything else as step_error (restore
+        only)."""
+        if self.elastic is None or not self.elastic.enable:
+            return "step_error"
+        deadline = time.monotonic() + 2.0 * self.elastic.ttl
+        while time.monotonic() < deadline:
+            if self._verdict_bad():
+                return "rank_death"
+            time.sleep(self.elastic.interval)
+        return "step_error"
+
+    def _rebuild_membership(self):
+        """Survivors agree on generation g's member set: settle one
+        TTL (every watcher must see the same dead set); the FIRST
+        survivor to claim the generation's leader counter (an atomic
+        store add — two survivors with momentarily different alive
+        views can never both lead) publishes the member set + the
+        newest COMMON snapshot step; everyone barriers on the
+        generation-suffixed name. Rank ids never renumber. A live rank
+        the leader's view missed (heartbeat lagged past ttl) finds
+        itself outside the published membership and fails CLEANLY
+        instead of half-joining a generation that will not wait for
+        it."""
+        el = self.elastic
+        time.sleep(el.ttl)
+        alive = el.alive_nodes()
+        dead = sorted(set(el.members) - set(alive))
+        self.generation += 1
+        gen = self.generation
+        base = "%s/resilience/gen%d" % (el.job_id, gen)
+        # resume step must be COMMON: each survivor publishes its FULL
+        # complete-snapshot list (retention pruning + skipped writes
+        # make per-rank sets diverge — a min over LATESTS could name a
+        # step some rank already pruned); the leader intersects and
+        # takes the newest step every survivor still holds.
+        self.flush_snapshots()
+        el.store.set("%s/snap/%d" % (base, el.rank),
+                     json.dumps(list_snapshots(self.snapshot_dir)))
+        if el.store.add(base + "/leader", 1) == 1:
+            common = None
+            for r in alive:
+                data = el.store.get("%s/snap/%d" % (base, r),
+                                    timeout_s=self.store_timeout_s)
+                steps = set() if data is None \
+                    else set(json.loads(data.decode()))
+                common = steps if common is None else (common & steps)
+            info = {"members": alive, "dead": dead,
+                    "resume_step": max(common) if common else -1,
+                    "generation": gen}
+            el.store.set(base + "/members", json.dumps(info))
+        data = el.store.get(base + "/members",
+                            timeout_s=self.store_timeout_s)
+        if data is None:
+            raise RuntimeError(
+                "membership rebuild gen %d: leader never published %r"
+                % (gen, base + "/members"))
+        info = json.loads(data.decode())
+        if el.rank not in info["members"]:
+            raise RuntimeError(
+                "membership rebuild gen %d: this rank (%d) is not in "
+                "the published membership %s — the leader's liveness "
+                "view aged it out; failing cleanly instead of joining "
+                "a generation that will not wait for it"
+                % (gen, el.rank, info["members"]))
+        el.set_members(info["members"])
+        el.store.barrier(base + "/barrier", len(info["members"]),
+                         timeout_s=self.store_timeout_s)
+        if int(info.get("resume_step", -1)) < 0:
+            raise RuntimeError(
+                "membership rebuild gen %d: survivors %s share no "
+                "complete snapshot — a coherent common resume point "
+                "does not exist (every rank fails identically here "
+                "rather than restoring diverged local states)"
+                % (gen, info["members"]))
+        if self.on_generation is not None:
+            self.on_generation(gen, list(info["members"]), info)
+        return info
+
+    def _recover(self, kind, error=None):
+        self.recoveries += 1
+        if self.recoveries > self.max_recoveries:
+            raise RuntimeError(
+                "resilience: %d recoveries exceeded max_recoveries=%d "
+                "(last trigger %s: %r)"
+                % (self.recoveries, self.max_recoveries, kind, error))
+        resume_step = None
+        if kind == "rank_death":
+            info = self._rebuild_membership()
+            resume_step = info.get("resume_step")
+        restored = self.restore(resume_step)
+        RECOVERIES.labels(kind=kind).inc()
+        self.recovery_log.append((kind, restored))
+        self._recover_requested = None
+        return restored
+
+    # -- the loop ---------------------------------------------------------
+
+    def run(self, total_steps):
+        """Train to ``total_steps`` global steps, surviving failures.
+        Returns the loss trajectory as {step_index: loss} — recovered
+        (replayed) steps overwrite their first attempt, so the dict is
+        the FINAL trajectory a clean run would pin."""
+        losses = {}
+        if self.snapshot_every and self.latest_snapshot_step() is None:
+            self.snapshot()     # step-0 snapshot: a pre-first-step
+            self.flush_snapshots()  # death must have somewhere to resume
+        while int(self.train_step._step_count) < total_steps:
+            if self._recover_requested:
+                self._recover(self._recover_requested)
+                continue
+            step_i = int(self.train_step._step_count) + 1
+            try:
+                # steps_per_call > 1: batch_fn returns a stacked
+                # [K, ...] window and the whole window runs as ONE
+                # device call (run_steps); losses are then pinned per
+                # WINDOW at its last step
+                if self.steps_per_call > 1:
+                    loss = self.train_step.run_steps(
+                        *self.batch_fn(step_i))
+                else:
+                    loss = self.train_step(*self.batch_fn(step_i))
+                val = float(np.asarray(
+                    loss._value if hasattr(loss, "_value") else loss))
+                if self.post_step is not None:
+                    val = self.post_step(step_i, val)
+            except Exception as e:
+                self._recover(self._classify_failure(), error=e)
+                continue
+            end = int(self.train_step._step_count)
+            losses[end] = val
+            if self.snapshot_every \
+                    and end % self.snapshot_every == 0:
+                self.snapshot()
+            if self._verdict_bad(throttled=True):
+                self._recover("rank_death")
+        # a cadence snapshot is SKIPPED when the writer is mid-write
+        # (the loop never blocks on disk) — but the END-of-run snapshot
+        # must land: it is what a follow-up run resumes from
+        self.flush_snapshots()
+        end = int(self.train_step._step_count)
+        if self.snapshot_every and end % self.snapshot_every == 0 \
+                and self.latest_snapshot_step() != end:
+            self.snapshot()
+            self.flush_snapshots()
+        return losses
+
+    def close(self):
+        self._writer.stop()
+        if getattr(self, "_wd_action", None) is not None:
+            from ..monitor import watchdog as _wd
+
+            _wd.unregister_stall_action(self._wd_action)
+            self._wd_action = None

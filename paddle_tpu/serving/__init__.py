@@ -21,11 +21,23 @@ inference the same shape discipline under serving traffic:
 - ``metrics``: per-request TTFT/TPOT/queue-time and engine-level
   throughput/occupancy counters as plain dicts, plus chrome-trace spans
   through the csrc/trace.cc host recorder.
+- graceful degradation (resilience layer, all knobs default-off):
+  per-request queue-TTL deadlines (terminal ``expired`` status),
+  bounded admission queue (``QueueFullError`` load shedding), a
+  preemption-count cap (livelock breaker), poison-request quarantine
+  (a step exception fails the one request, not the engine), and
+  ``Engine.drain()`` — finish in-flight work while rejecting
+  admissions (``DrainingError``), the fleet building block.
 
 Reference analog: the AnalysisPredictor serving stack
 (/root/reference/paddle/fluid/inference/api/analysis_predictor.cc) —
 rebuilt TPU-first around paged blocks + a shape-stable compiled step.
 """
-from .engine import Engine  # noqa: F401
+from .engine import (  # noqa: F401
+    AdmissionError,
+    DrainingError,
+    Engine,
+    QueueFullError,
+)
 from .kv_cache import BlockAllocator, PagedKVCache  # noqa: F401
 from .scheduler import Request, RequestState, Scheduler  # noqa: F401

@@ -32,9 +32,31 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import monitor as _monitor
+from ..resilience import faultinject as _fi
 from .kv_cache import PagedDecodeView, PagedKVCache, PagedPrefillView
 from .metrics import EngineMetrics, now, span
 from .scheduler import Request, RequestState, Scheduler
+
+
+class AdmissionError(RuntimeError):
+    """Request rejected AT admission (load shed) — never enqueued, no
+    id assigned; the caller retries elsewhere or backs off."""
+
+    reason = "admission"
+
+
+class QueueFullError(AdmissionError):
+    """Bounded admission queue is full (``max_queue``)."""
+
+    reason = "queue_full"
+
+
+class DrainingError(AdmissionError):
+    """Engine is draining (``Engine.drain()``): in-flight work
+    completes, new admissions are rejected — the fleet layer's
+    drain-and-reschedule building block."""
+
+    reason = "draining"
 
 # watchdog heartbeat (monitor/watchdog.py): every engine iteration runs
 # inside a busy bracket, so a scheduler deadlock or a hung decode
@@ -45,7 +67,24 @@ _HB_SERVE = _monitor.heartbeat("serving_engine")
 
 class Engine:
     def __init__(self, model, max_slots=4, num_blocks=64, block_size=16,
-                 max_model_len=None):
+                 max_model_len=None, max_queue=None,
+                 default_deadline_s=None, max_preemptions=None):
+        """Resilience knobs (all default-off — the engine behaves
+        exactly as before unless asked):
+
+        max_queue           bounded admission queue: add_request raises
+                            QueueFullError (and counts a queue_full
+                            shed) once this many requests wait
+        default_deadline_s  queue-TTL for requests that don't pass
+                            their own deadline_s: still WAITING past it
+                            -> terminal EXPIRED status (never kills a
+                            decoding request)
+        max_preemptions     a request preempted this many times becomes
+                            non-preemptible (runs to completion) — the
+                            preempt-recompute livelock breaker; when NO
+                            eligible victim remains, the grower is shed
+                            (reason preempt_cap) instead of deadlocking
+        """
         self.model = model
         spec = model.paged_cache_spec()
         limit = model.max_decode_len()
@@ -68,6 +107,15 @@ class Engine:
         self.scheduler = Scheduler(max_slots, self.cache)
         self.metrics = EngineMetrics(max_slots)
         self.requests = {}
+        self.max_queue = max_queue
+        self.default_deadline_s = default_deadline_s
+        self.max_preemptions = max_preemptions
+        self._draining = False
+        # poison quarantine: request ids that were active in a FAILED
+        # batched decode — re-admitted ONE AT A TIME so the next decode
+        # failure is attributable to a single request (bisect-by-
+        # serialization); empties as its members reach terminal states
+        self._quarantine = set()
         self._names, values = model.functional_state()
         self._state_vals = list(values)
         # slot_tokens[s]: last generated token, not yet written to KV —
@@ -78,9 +126,22 @@ class Engine:
 
     # -- public API -------------------------------------------------------
 
-    def add_request(self, prompt, max_new_tokens=32, eos_token_id=None):
+    def add_request(self, prompt, max_new_tokens=32, eos_token_id=None,
+                    deadline_s=None):
         """Queue a request; returns its id. Validates that the request
-        can EVER run alone (admission control proper is per-step)."""
+        can EVER run alone (admission control proper is per-step).
+        Raises DrainingError / QueueFullError when load-shedding (the
+        request is never enqueued and gets no id)."""
+        if self._draining:
+            self.metrics.on_request_shed("draining")
+            raise DrainingError(
+                "engine is draining: new admissions rejected")
+        if self.max_queue is not None \
+                and len(self.scheduler.queue) >= self.max_queue:
+            self.metrics.on_request_shed("queue_full")
+            raise QueueFullError(
+                "admission queue full (%d waiting, max_queue=%d)"
+                % (len(self.scheduler.queue), self.max_queue))
         prompt = list(map(int, prompt))
         if not prompt:
             raise ValueError("empty prompt")
@@ -96,7 +157,10 @@ class Engine:
                 "request needs %d pages but the pool only has %d usable "
                 "blocks — it could never be scheduled"
                 % (pages_needed, self.cache.allocator.usable_blocks))
-        req = Request(prompt, max_new_tokens, eos_token_id)
+        if deadline_s is None:
+            deadline_s = self.default_deadline_s
+        req = Request(prompt, max_new_tokens, eos_token_id,
+                      deadline_s=deadline_s)
         self.requests[req.id] = req
         # span journal (FLAGS_monitor_trace): trace id assigned here —
         # the admission point — so the queue phase covers every second
@@ -121,6 +185,16 @@ class Engine:
         """One engine iteration: admit+prefill, grow pages (preempting
         on exhaustion), one batched decode step. Returns has_work()."""
         with _HB_SERVE.busy("serving.step"):
+            try:
+                # engine-level injection site: a fault here models a
+                # transient failure BETWEEN requests (scheduler glitch,
+                # control-plane hiccup) — nothing owned it, no request
+                # is harmed, the iteration is simply retried
+                if _fi.is_enabled():
+                    _fi.fire("serving.step")
+            except _fi.InjectedFault:
+                return self.has_work()
+            self._expire_waiting()
             self._admit_and_prefill()
             self._grow_or_preempt()
             # perf attribution (FLAGS_perf_attribution): KV-page
@@ -145,6 +219,21 @@ class Engine:
                 pass
         return {rid: list(r.generated) for rid, r in self.requests.items()}
 
+    @property
+    def draining(self):
+        return self._draining
+
+    def drain(self):
+        """Stop admitting, finish everything already accepted (active
+        slots AND the queue), return the outputs. The fleet layer's
+        drain-and-reschedule primitive: after drain() returns, the
+        engine holds no work and every accepted request reached a
+        terminal state; new add_request calls keep raising
+        DrainingError. Waiting requests still honor their deadlines —
+        a drain under overload sheds what it cannot serve in time."""
+        self._draining = True
+        return self.run()
+
     def output(self, rid):
         return list(self.requests[rid].generated)
 
@@ -167,18 +256,60 @@ class Engine:
     def stats(self):
         return self.metrics.to_dict()
 
+    def request_status(self, rid):
+        """Terminal-status view of one request: state + machine-readable
+        reason (finished | expired | shed | failed | a live state)."""
+        r = self.requests[rid]
+        return {
+            "id": rid,
+            "state": r.state.value,
+            "reason": r.status_reason,
+            "output_tokens": len(r.generated),
+            "preemptions": r.metrics.preemptions,
+            "error": repr(r.error) if r.error is not None else None,
+        }
+
     # -- lifecycle --------------------------------------------------------
+
+    def _expire_waiting(self):
+        """Queue-TTL pass: waiting requests past their deadline get the
+        EXPIRED terminal status (shed reason ``expired``) before any
+        admission work is spent on them."""
+        for req in self.scheduler.expire_waiting():
+            req.close(RequestState.EXPIRED, "deadline")
+            self._quarantine.discard(req.id)
+            self.metrics.on_request_shed("expired")
 
     def _admit_and_prefill(self):
         while True:
+            if self._quarantine and self.scheduler.slots_active() > 0:
+                # poison bisect in progress: serialize admissions so a
+                # failing decode names a single request
+                return
             admitted = self.scheduler.admit_next()
             if admitted is None:
                 return
             slot, req = admitted
             self.metrics.on_admission()
-            self._prefill_request(slot, req)
+            try:
+                self._prefill_request(slot, req)
+            except Exception as e:  # poison quarantine: the request's
+                self._fail_request(req, e)  # OWN step failed, not the engine
+
+    def _fail_request(self, req, exc):
+        """Poison quarantine: one request's step raised — fail IT with
+        a terminal status and keep serving everyone else."""
+        if req.slot is not None:
+            self.scheduler.release(req)
+        req.close(RequestState.FAILED, "poison", error=exc)
+        self._quarantine.discard(req.id)
+        self.metrics.on_request_shed("poison")
 
     def _prefill_request(self, slot, req):
+        # per-request injection site: the poison-request model — an
+        # error here is attributable to THIS request and fails only it
+        if _fi.is_enabled():
+            _fi.fire("serving.prefill", request=req.id, slot=slot)
         tokens = req.resume_tokens
         L = len(tokens)
         P = self._bucket(L)
@@ -212,21 +343,43 @@ class Engine:
                 continue            # became a victim earlier in the loop
             while not self.cache.ensure_capacity(
                     slot, int(self.cache.seq_lens[slot]) + 1):
-                victim = self.scheduler.preempt_victim(slot)
+                victim = self.scheduler.preempt_victim(
+                    slot, self.max_preemptions)
                 if victim is None:
+                    others = [r for i, r in self.scheduler.active()
+                              if i != slot]
+                    if others:
+                        # every other running request is at the
+                        # preemption cap (non-preemptible by design):
+                        # shed THIS grower rather than livelock or
+                        # deadlock the pool
+                        self.scheduler.release(req)
+                        req.close(RequestState.SHED, "preempt_cap")
+                        self._quarantine.discard(req.id)
+                        self.metrics.on_request_shed("preempt_cap")
+                        break
                     raise RuntimeError(
                         "KV pool exhausted by a single request — "
                         "add_request validation should have caught this")
                 self.metrics.on_preemption()
 
     def _decode_once(self, active):
-        bt = jnp.asarray(self.cache.block_tables)
-        lens = jnp.asarray(self.cache.seq_lens)
-        toks = jnp.asarray(self._slot_tokens)
-        with span("serving.decode_step"):
-            next_toks, new_pools = self._run_eval(
-                self._decode, self._state_vals, self.cache.pools, toks,
-                bt, lens)
+        try:
+            # batched injection site: a decode failure is NOT
+            # attributable to one request — the quarantine below
+            # serializes the batch until it is
+            if _fi.is_enabled():
+                _fi.fire("serving.decode", batch=len(active))
+            bt = jnp.asarray(self.cache.block_tables)
+            lens = jnp.asarray(self.cache.seq_lens)
+            toks = jnp.asarray(self._slot_tokens)
+            with span("serving.decode_step"):
+                next_toks, new_pools = self._run_eval(
+                    self._decode, self._state_vals, self.cache.pools,
+                    toks, bt, lens)
+        except Exception as e:  # poison quarantine (see _on_decode_failure)
+            self._on_decode_failure(active, e)
+            return
         self.cache.pools = new_pools
         out = np.asarray(next_toks)
         self.metrics.on_decode_step(len(active))
@@ -234,6 +387,42 @@ class Engine:
             # the input token's K/V row landed at position seq_len
             self.cache.seq_lens[slot] += 1
             self._accept_token(req, int(out[slot]))
+
+    def _on_decode_failure(self, active, exc):
+        """A batched decode raised. With ONE active request the poison
+        is named — fail it, keep the engine. With several, requeue them
+        all (preempt-by-recompute keeps their output bit-identical) and
+        enter serial quarantine: one request per batch until the set
+        clears, so the next failure IS attributable. The engine never
+        dies for one request's exception.
+
+        Cost, by design: every quarantined request runs solo to
+        completion, so one transient batched failure serializes its
+        batch's remaining decode. Early exoneration (drop from
+        quarantine after one clean solo step, then re-batch) was
+        considered and rejected: a re-batched exonerated request
+        decoding next to a still-quarantined poison makes the next
+        failure unattributable again — with a deterministic poison that
+        ping-pongs forever. Strict FCFS also means nothing behind the
+        quarantined head could use the freed batch slots anyway."""
+        if len(active) == 1:
+            _, req = active[0]
+            self._fail_request(req, exc)
+            return
+        for slot, req in reversed(list(active)):
+            if self.scheduler.slots[slot] is not req:
+                continue
+            seq_len = int(self.cache.seq_lens[slot])
+            self.scheduler.release(req)
+            req.state = RequestState.PREEMPTED
+            req.metrics.preemptions += 1
+            self.scheduler.requeue_front(req)
+            self._quarantine.add(req.id)
+            self.metrics.on_preemption()
+            if req.trace_id is not None:
+                req.trace_phase(
+                    "preempted", seq_len=seq_len, quarantine=True,
+                    slots_active=self.scheduler.slots_active())
 
     def _accept_token(self, req, tok):
         req.generated.append(tok)
@@ -257,6 +446,7 @@ class Engine:
         if done:
             self.scheduler.release(req)
             req.finish()
+            self._quarantine.discard(req.id)   # survived serial decode
             self.metrics.on_request_finished(len(req.generated))
             req.trace_finish("finished")
 

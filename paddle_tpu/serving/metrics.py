@@ -52,6 +52,15 @@ from ..monitor import trace as _mtrace
 _REQUESTS = _mcounter(
     "serving_requests_total", "request lifecycle events",
     labelnames=("event",))
+# graceful-degradation accounting (resilience layer): every request
+# that terminates WITHOUT full service, by reason — queue_full /
+# draining (load shed at admission), expired (queue-TTL deadline),
+# preempt_cap (no eligible victim under the preemption cap), poison
+# (its own step raised). The SLO reads shed rate next to goodput.
+_SHED = _mcounter(
+    "serving_requests_shed_total",
+    "requests terminated without full service, by reason",
+    labelnames=("reason",))
 _PREFILLS = _mcounter("serving_prefill_runs_total",
                       "prefill executions (admissions + resumes)")
 _DECODE_STEPS = _mcounter("serving_decode_steps_total",
@@ -219,6 +228,8 @@ class EngineMetrics:
         self.start_t = None
         self.requests_in = 0
         self.requests_finished = 0
+        self.requests_shed = 0
+        self.shed_by_reason = {}
         self.preemptions = 0
         self.prefill_runs = 0
         self.decode_steps = 0
@@ -241,6 +252,15 @@ class EngineMetrics:
         _REQUESTS.labels(event="finished").inc()
         if self.start_t is not None:
             self._note_perf_job()
+
+    def on_request_shed(self, reason):
+        """One request terminated without full service (expired /
+        queue_full / draining / preempt_cap / poison)."""
+        self.requests_shed += 1
+        self.shed_by_reason[reason] = \
+            self.shed_by_reason.get(reason, 0) + 1
+        _SHED.labels(reason=reason).inc()
+        _REQUESTS.labels(event="shed").inc()
 
     def on_preemption(self):
         self.preemptions += 1
@@ -325,6 +345,8 @@ class EngineMetrics:
         return {
             "requests_in": self.requests_in,
             "requests_finished": self.requests_finished,
+            "requests_shed": self.requests_shed,
+            "shed_by_reason": dict(self.shed_by_reason),
             "preemptions": self.preemptions,
             "prefill_runs": self.prefill_runs,
             "decode_steps": self.decode_steps,

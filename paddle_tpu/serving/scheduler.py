@@ -34,12 +34,18 @@ class RequestState(Enum):
     DECODING = "decoding"
     PREEMPTED = "preempted"
     FINISHED = "finished"
+    # terminal degraded outcomes (resilience layer): the request ended
+    # WITHOUT full service, each with a machine-readable status_reason
+    EXPIRED = "expired"      # queue-TTL deadline passed while waiting
+    SHED = "shed"            # load-shed (queue bound / preemption cap)
+    FAILED = "failed"        # poison: its own step raised; engine lives
 
 
 class Request:
     _ids = itertools.count()
 
-    def __init__(self, prompt, max_new_tokens, eos_token_id=None):
+    def __init__(self, prompt, max_new_tokens, eos_token_id=None,
+                 deadline_s=None):
         self.id = next(Request._ids)
         self.prompt = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
@@ -50,6 +56,13 @@ class Request:
         self.admit_seq = None      # monotone admission stamp (victim pick)
         self.metrics = RequestMetrics(now())
         self.metrics.prompt_tokens = len(self.prompt)
+        # queue-TTL deadline (monotonic absolute): a request still
+        # WAITING (queued or preempted-requeued) past it is shed with
+        # the EXPIRED terminal status; once decoding it runs to finish
+        self.deadline_t = (None if deadline_s is None
+                           else self.metrics.arrival_t + float(deadline_s))
+        self.status_reason = None  # terminal detail for EXPIRED/SHED/FAILED
+        self.error = None          # the exception of a FAILED request
         # span journal (monitor/trace.py, FLAGS_monitor_trace): the
         # request's trace id, assigned at admission to the engine; None
         # while the journal is off, and every trace_* helper below
@@ -71,6 +84,24 @@ class Request:
     def finish(self):
         self.state = RequestState.FINISHED
         self.metrics.on_finish(now(), len(self.generated))
+
+    def close(self, state, reason, error=None):
+        """Terminal close for the degraded outcomes (EXPIRED / SHED /
+        FAILED): stamps the finish time for wall accounting WITHOUT
+        observing the latency histograms — a shed request's lifetime is
+        not a service latency, and mixing them would poison the p99s
+        the SLO reads."""
+        self.state = state
+        self.status_reason = reason
+        self.error = error
+        self.metrics.finish_t = now()
+        self.metrics.output_tokens = len(self.generated)
+        self.trace_finish(state.value, reason=reason)
+
+    @property
+    def terminal(self):
+        return self.state in (RequestState.FINISHED, RequestState.EXPIRED,
+                              RequestState.SHED, RequestState.FAILED)
 
     # -- span timeline (monitor/trace.py) ---------------------------------
     #
@@ -137,6 +168,21 @@ class Scheduler:
     def requeue_front(self, req):
         self.queue.appendleft(req)
 
+    def expire_waiting(self, t=None):
+        """Remove waiting requests (QUEUED or PREEMPTED — both hold no
+        slot) whose queue-TTL deadline passed; returns them, oldest
+        first, for the engine to close as EXPIRED. Decoding requests
+        are never expired: their pages are live and finishing is
+        strictly cheaper than recomputing a replacement."""
+        t = now() if t is None else t
+        expired = [r for r in self.queue
+                   if r.deadline_t is not None and t >= r.deadline_t]
+        if expired:
+            dead = set(id(r) for r in expired)
+            self.queue = deque(r for r in self.queue
+                               if id(r) not in dead)
+        return expired
+
     def has_work(self):
         return bool(self.queue) or any(
             r is not None for r in self.slots)
@@ -192,11 +238,18 @@ class Scheduler:
         self.slots[slot] = None
         req.slot = None
 
-    def preempt_victim(self, exclude_slot):
+    def preempt_victim(self, exclude_slot, max_preemptions=None):
         """Pick and preempt the most recently admitted running request
         other than ``exclude_slot``; requeues it at the front. Returns
-        the victim or None when there is no other running request."""
-        candidates = [r for i, r in self.active() if i != exclude_slot]
+        the victim or None when there is no ELIGIBLE other running
+        request. With ``max_preemptions`` set, a request that already
+        paid the cap is no longer a candidate — it runs to completion,
+        which is what breaks the preempt-recompute livelock (two
+        requests evicting each other forever make no progress; a capped
+        request cannot be evicted, so it finishes and frees pages)."""
+        candidates = [r for i, r in self.active() if i != exclude_slot
+                      and (max_preemptions is None
+                           or r.metrics.preemptions < max_preemptions)]
         if not candidates:
             return None
         victim = max(candidates, key=lambda r: r.admit_seq)

@@ -37,6 +37,7 @@ ROUTES = {
     "debugz/perf": (200, "json"),
     "debugz/timeseries": (200, "json"),
     "debugz/trace": (200, "json"),
+    "debugz/resilience": (200, "json"),
 }
 
 ALL_FLAGS = ("FLAGS_monitor_timeseries", "FLAGS_perf_attribution",
@@ -51,6 +52,10 @@ def server():
 
 
 def _reset_monitor_state():
+    from paddle_tpu.resilience import faultinject as _fi
+
+    _fi.disable()
+    _fi._state.rules = []
     paddle.set_flags({f: False for f in ALL_FLAGS})
     perf.disable_sentinels()
     perf.reset()
@@ -119,6 +124,9 @@ class TestRouteMatrixAllOff:
         _, body = _get(server, "healthz")
         p = json.loads(body.decode())
         assert p["status"] == "ok" and p["watchdog"] == "disabled"
+        _, body = _get(server, "debugz/resilience")
+        p = json.loads(body.decode())
+        assert p["fault_injection"]["enabled"] is False
         # ...and the registry hot-path hook slots stayed None
         assert mreg._state.ts_hook is None
         assert mreg._state.ex_hook is None

@@ -18,6 +18,10 @@ Usage:
   python tools/serving_benchmark.py --preset llama1b # on-chip row
   python tools/serving_benchmark.py --requests 64 --rate 8 \
       --out tools/serving_bench.json
+  # resilience row: injected faults + queue bounds + deadlines —
+  # reports shed/expired/failed counts and goodput under chaos
+  python tools/serving_benchmark.py --fault-rate 0.1 --max-queue 16 \
+      --deadline-s 10
 """
 from __future__ import annotations
 
@@ -84,6 +88,22 @@ def main():
     ap.add_argument("--monitor-out", default=None,
                     help="also dump the monitor registry snapshot (with "
                          "written_at metadata) to this JSON path")
+    ap.add_argument("--fault-rate", type=float, default=0.0,
+                    help="resilience chaos knob: probability of an "
+                         "injected per-request prefill error (the "
+                         "poison-request path); 0 = injection off")
+    ap.add_argument("--fault-schedule", default=None,
+                    help="raw fault schedule (resilience/faultinject "
+                         "grammar, overrides --fault-rate), e.g. "
+                         "'serving.prefill:error@p0.1;"
+                         "serving.decode:delay=0.01@%%8'")
+    ap.add_argument("--fault-seed", type=int, default=0)
+    ap.add_argument("--deadline-s", type=float, default=None,
+                    help="per-request queue-TTL: still waiting past "
+                         "this -> terminal 'expired' status")
+    ap.add_argument("--max-queue", type=int, default=None,
+                    help="bounded admission queue: arrivals beyond it "
+                         "are load-shed (counted, not enqueued)")
     ap.add_argument("--no-trace", action="store_true",
                     help="skip the span journal (requests_detail rows "
                          "then carry no trace_id/phases_s breakdown)")
@@ -123,6 +143,10 @@ def main():
     max_new = [int(rng.randint(args.max_new[0], args.max_new[1] + 1))
                for _ in range(args.requests)]
 
+    # resilience knobs are applied AFTER warmup (below): the compile
+    # warmup enqueues one request per prefill bucket, and a deadline or
+    # queue bound there would expire/reject buckets — pushing their
+    # compiles into the measured window
     eng = serving.Engine(model, max_slots=args.max_slots,
                          num_blocks=args.num_blocks,
                          block_size=args.block_size)
@@ -144,21 +168,46 @@ def main():
     eng.run()
     warmup_s = time.perf_counter() - t0
     base = eng.stats()     # counters up to here are warmup, not workload
+    eng.max_queue = args.max_queue
+    eng.default_deadline_s = args.deadline_s
+
+    # chaos: arm the injection framework AFTER warmup so the compile
+    # window stays clean and every injected fault lands in the
+    # measured workload (resilience/faultinject — seeded, so the same
+    # arguments replay the same faults)
+    fault_schedule = args.fault_schedule
+    if fault_schedule is None and args.fault_rate > 0:
+        fault_schedule = ("serving.prefill:error@p%g" % args.fault_rate)
+    if fault_schedule:
+        from paddle_tpu.resilience import faultinject as fi
+
+        fi.enable(fault_schedule, seed=args.fault_seed)
 
     ids = []
+    rejected = {}          # admission-shed reason -> count (no id)
     start = time.perf_counter()
     nxt = 0
     while nxt < args.requests or eng.has_work():
         now = time.perf_counter() - start
         while nxt < args.requests and arrivals[nxt] <= now:
-            ids.append(eng.add_request(prompts[nxt],
-                                       max_new_tokens=max_new[nxt]))
+            try:
+                ids.append(eng.add_request(
+                    prompts[nxt], max_new_tokens=max_new[nxt]))
+            except serving.AdmissionError as e:
+                rejected[e.reason] = rejected.get(e.reason, 0) + 1
             nxt += 1
         if eng.has_work():
             eng.step()
         elif nxt < args.requests:
             time.sleep(min(arrivals[nxt] - now, 0.05))
     wall = time.perf_counter() - start
+    if fault_schedule:
+        from paddle_tpu.resilience import faultinject as fi
+
+        fault_state = fi.state()
+        fi.disable()
+    else:
+        fault_state = None
 
     stats = eng.stats()
     # engine counters aggregate over the whole lifetime — subtract the
@@ -170,6 +219,10 @@ def main():
     per_req = []
     for r in ids:
         row = dict(eng.request_metrics(r), request_id=r)
+        status = eng.request_status(r)
+        row["status"] = status["state"]
+        if status["reason"] is not None:
+            row["status_reason"] = status["reason"]
         # trace id + per-request phase breakdown (queue / prefill /
         # decode / preempted seconds): the preemption tax attributable
         # per-request — a preempted request shows the recompute in its
@@ -211,6 +264,19 @@ def main():
         "prefill_compiles": stats["prefill_compiles"],
         "slot_occupancy": round(meas_occupancy, 4),
         "requests_finished": stats["requests_finished"] - n_warm,
+        # resilience accounting: goodput (finished-request tokens only)
+        # next to shed/expired/failed counts — under a fault schedule
+        # the SLO question is "how much service survived the chaos"
+        "goodput_tok_s": round(
+            sum(m["output_tokens"] for m in per_req
+                if m["status"] == "finished") / max(wall, 1e-9), 1),
+        "requests_shed_total": stats["requests_shed"],
+        "shed_by_reason": stats["shed_by_reason"],
+        "rejected_at_admission": rejected,
+        "fault_schedule": fault_schedule,
+        "faults_injected": (
+            None if fault_state is None else
+            {r["rule"]: r["fired"] for r in fault_state["rules"]}),
         "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         # raw per-request rows ride along with the aggregates so
         # distribution questions don't need a re-run

@@ -124,6 +124,18 @@ run serving 1200 env $(wd serving) \
     --out tools/serving_bench.json \
     --monitor-out tools/monitor_snapshot.json
 
+# 5c. resilience serving row (ISSUE 7): the same engine under an
+#     injected fault schedule + queue bound + deadlines — reports
+#     goodput next to shed/expired/poison counts, proving graceful
+#     degradation on-chip (requests fail individually, the engine and
+#     its compile-once decode survive). Watchdog on like every long
+#     row; the seeded schedule makes the chaos replayable.
+run serving_resilience 1200 env $(wd serving_resilience) \
+    python tools/serving_benchmark.py --preset llama1b \
+    --requests 48 --rate 8 --max-slots 8 --num-blocks 512 \
+    --fault-rate 0.1 --max-queue 32 --deadline-s 30 \
+    --out tools/serving_resilience_bench.json
+
 # 6. 7B-shape layer microbench (refines the pod projection)
 run llama7b_micro 900 python tools/llama7b_plan.py --microbench
 
