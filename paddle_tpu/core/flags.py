@@ -99,6 +99,19 @@ _DEFAULTS = {
     # flight-recorder ring, and flips the /healthz degraded flag.
     # Enabling sentinels enables the time-series ring (they read it).
     "FLAGS_perf_sentinels": False,
+    # fleet telemetry plane (monitor/fleet.py): each rank announces its
+    # metrics endpoint in the TCPStore and a collector (rank
+    # PT_FLEET_COLLECTOR_RANK, default 0, or a standalone process)
+    # scrapes /metrics.json + /debugz/perf + /healthz from every rank,
+    # fuses them into rank-labeled fleet series (counter sums, gauge
+    # min/max/p50 spreads) served at /debugz/fleet* + /metrics/fleet,
+    # flags stragglers (persistently slower than the fleet-median step
+    # time -> fleet_straggler_total{rank}) BEFORE anything times out,
+    # and pulls a fleet-wide capture (bundles + journal tails from all
+    # ranks) when any rank's sentinel fires. Off = announce/identity
+    # hooks are one flag branch: no server, no collector thread, no
+    # store traffic (test-pinned, the PR-2/5/6 discipline).
+    "FLAGS_monitor_fleet": False,
     # deterministic fault injection (paddle_tpu/resilience/faultinject).
     # Off = every injection site (store ops, eager collectives, serving
     # engine step, compiled train step) is one attribute load + branch:

@@ -99,6 +99,23 @@ def init_parallel_env(strategy=None):
         store.set("env/rank/%d" % rank,
                   os.environ.get("PADDLE_CURRENT_ENDPOINT", str(rank)))
         pg.barrier("init_parallel_env")
+        # fleet telemetry plane (monitor/fleet.py): under
+        # FLAGS_monitor_fleet every rank announces its metrics endpoint
+        # in the store and the collector rank starts the scrape loop;
+        # one flag branch when off (no server, no store traffic).
+        # Telemetry must never take down training bring-up: a failed
+        # server bind or endpoint write warns and the job proceeds
+        # unobserved rather than dead.
+        try:
+            from ..monitor import fleet as _fleet
+
+            _fleet.maybe_announce_and_collect(pg)
+        except Exception as e:
+            import warnings
+
+            warnings.warn(
+                "init_parallel_env: fleet telemetry announce failed "
+                "(%r); continuing without the fleet plane" % e)
     _initialized = True
 
 

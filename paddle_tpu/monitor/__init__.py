@@ -40,7 +40,18 @@ Three pillars (one registry, one postmortem path, one timeline):
    /debugz/trace + /debugz/trace/{id}; merged into the chrome-trace
    timeline by tools/trace_merge.py --requests.
 
-6. **Progress watchdog** (monitor/watchdog.py): heartbeat registry fed
+6. **Fleet telemetry plane** (monitor/fleet.py, ``FLAGS_monitor_fleet``):
+   store-registered per-rank endpoints, a collector fusing every rank's
+   /metrics.json + /debugz/perf + /healthz into rank-labeled fleet
+   series (counter sums, gauge min/max/p50 spreads) served at
+   /debugz/fleet, /debugz/fleet/ranks and federation-style
+   /metrics/fleet; cross-rank straggler detection
+   (``fleet_straggler_total{rank}``) that names the slow rank BEFORE a
+   timeout; and anomaly-triggered fleet captures pulling bundles +
+   journal tails from all ranks into one ``fleet_capture_<ts>/``
+   artifact. Rendered live by tools/fleet_top.py.
+
+7. **Progress watchdog** (monitor/watchdog.py): heartbeat registry fed
    by the compiled train step, the serving engine loop, and store
    collectives; a daemon thread (``start_watchdog()`` / ``PT_WATCHDOG``)
    turns a stalled heartbeat into a cross-rank diagnostic bundle
@@ -87,6 +98,7 @@ from .watchdog import (  # noqa: F401
     stop_watchdog,
     unregister_stall_action,
 )
+from . import fleet  # noqa: F401
 from . import flight_recorder  # noqa: F401
 from . import perf  # noqa: F401
 from . import timeseries  # noqa: F401
@@ -104,6 +116,6 @@ __all__ = [
     "Heartbeat", "heartbeat", "start_watchdog", "stop_watchdog",
     "is_watchdog_running", "build_bundle", "diagnose_bundles",
     "register_stall_action", "unregister_stall_action",
-    "flight_recorder", "perf", "timeseries", "trace", "trace_merge",
-    "watchdog",
+    "fleet", "flight_recorder", "perf", "timeseries", "trace",
+    "trace_merge", "watchdog",
 ]

@@ -19,8 +19,19 @@ server stack: ``KVHTTPServer`` gained a ``get_routes`` hook, and
                         (monitor/timeseries.py payload)
     GET /debugz/trace   span-journal summary + histogram exemplars
                         (monitor/trace.py payload)
+    GET /debugz/trace/journal  the full journal artifact (the
+                        write_journal format — what a fleet capture
+                        pulls so tools/trace_merge.py can merge it)
     GET /debugz/trace/{id}  one trace's full span timeline (404 for an
                         unknown or evicted trace id)
+    GET /debugz/fleet   fleet summary: collector state, straggler
+                        verdict, fused cross-rank aggregates
+                        (monitor/fleet.py payload)
+    GET /debugz/fleet/ranks  the per-rank fleet table (step, tokens/s,
+                        MFU, heartbeat age, straggler flag — what
+                        tools/fleet_top.py renders)
+    GET /metrics/fleet  Prometheus federation-style exposition of the
+                        fused fleet series (rank-labeled + aggregates)
     GET /debugz/resilience  fault-injection state + recovery/shed
                         counters + watchdog escalation mode
                         (paddle_tpu/resilience payload)
@@ -40,6 +51,7 @@ import json
 import os
 import time
 
+from . import fleet as _fleet
 from . import perf as _perf
 from . import timeseries as _timeseries
 from . import trace as _trace
@@ -96,7 +108,13 @@ class MetricsServer:
         routes["debugz/perf"] = self._perf
         routes["debugz/timeseries"] = self._timeseries
         routes["debugz/trace"] = self._trace
+        # exact routes win over the debugz/trace prefix dispatch, so
+        # "journal" can never be misread as a trace id
+        routes["debugz/trace/journal"] = self._trace_journal
         routes["debugz/resilience"] = self._resilience
+        routes["debugz/fleet"] = self._fleet
+        routes["debugz/fleet/ranks"] = self._fleet_ranks
+        routes["metrics/fleet"] = self._fleet_prometheus
         self._kv.http_server.get_prefix_routes["debugz/trace"] = \
             self._trace_by_id
 
@@ -136,6 +154,25 @@ class MetricsServer:
         body = json.dumps(_watchdog.json_safe(_trace.payload()),
                           default=str).encode()
         return 200, "application/json", body
+
+    def _trace_journal(self):
+        body = json.dumps(_watchdog.json_safe(_trace.dump()),
+                          default=str).encode()
+        return 200, "application/json", body
+
+    def _fleet(self):
+        body = json.dumps(_watchdog.json_safe(_fleet.fleet_payload()),
+                          default=str).encode()
+        return 200, "application/json", body
+
+    def _fleet_ranks(self):
+        body = json.dumps(_watchdog.json_safe(_fleet.ranks_payload()),
+                          default=str).encode()
+        return 200, "application/json", body
+
+    def _fleet_prometheus(self):
+        body = _fleet.prometheus_fleet_text().encode()
+        return 200, "text/plain; version=0.0.4; charset=utf-8", body
 
     def _resilience(self):
         # lazy: paddle_tpu.resilience imports back into monitor — the

@@ -205,3 +205,58 @@ def journal_events(journal, clock="monotonic"):
     from . import trace as _trace
 
     return _trace.chrome_events_from_journal(journal, clock=clock)
+
+
+# -- fleet-capture merge (monitor/fleet.py artifacts) -------------------------
+
+def load_fleet_capture(dir_name):
+    """(manifest, {rank: journal dict}) from a ``fleet_capture_<ts>/``
+    directory (monitor/fleet.py FleetCollector.capture). Per-rank
+    journals that failed to pull (the capture writes an error stub in
+    their place) are skipped — absence of a rank's journal is visible
+    in the returned dict, never a crash."""
+    with open(os.path.join(dir_name, "manifest.json")) as f:
+        manifest = json.load(f)
+    if manifest.get("kind") != "fleet_capture":
+        raise ValueError(
+            "%s is not a fleet capture (kind=%r) — expected the "
+            "monitor.fleet FleetCollector.capture format"
+            % (dir_name, manifest.get("kind")))
+    journals = {}
+    for path in sorted(glob.glob(os.path.join(dir_name,
+                                              "journal_rank*.json"))):
+        rank = rank_of_path(path)
+        if rank is None:
+            continue
+        try:
+            journals[rank] = load_journal(path)
+        except (ValueError, OSError):
+            continue
+    return manifest, journals
+
+
+def capture_events(dir_name, clock="wall"):
+    """(manifest, chrome events) for every rank journal in a fleet
+    capture: pids are rank-prefixed (``rank{r}/...``) and — the fleet
+    analog of the clock files — each rank's WALL timestamps shift by
+    the manifest's collector-estimated clock offset onto the
+    collector's clock, so cross-host spans line up in one Perfetto
+    view. ``clock`` defaults to "wall": per-process monotonic anchors
+    are boot-relative and meaningless across hosts."""
+    manifest, journals = load_fleet_capture(dir_name)
+    offsets = {}
+    for r, v in (manifest.get("clock_offsets_s") or {}).items():
+        if isinstance(v, (int, float)):
+            offsets[int(r)] = float(v)
+    evs = []
+    for rank in sorted(journals):
+        # offset = rank_clock - collector_clock, so subtracting it
+        # lands the rank's wall stamps on the collector's clock
+        shift_us = -offsets.get(rank, 0.0) * 1e6
+        for ev in journal_events(journals[rank], clock=clock):
+            ev = dict(ev)
+            if isinstance(ev.get("ts"), (int, float)):
+                ev["ts"] += shift_us
+            ev["pid"] = "rank%d/%s" % (rank, ev.get("pid", "trace"))
+            evs.append(ev)
+    return manifest, evs

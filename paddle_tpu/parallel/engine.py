@@ -236,6 +236,10 @@ class CompiledTrainStep:
         # per-instance perf attribution (monitor/perf.py), created on
         # first step only while FLAGS_perf_attribution is on
         self._perf_attr = None
+        # fleet identity beacon (monitor/fleet.py): under
+        # FLAGS_monitor_fleet the scraped train series resolve to this
+        # rank/host/job; one flag branch when off
+        _monitor.fleet.note_identity("train")
 
     # -- sharding specs ----------------------------------------------------
 

@@ -106,6 +106,10 @@ class Engine:
             max_blocks_per_slot=mb, dtype=spec.get("dtype", "float32"))
         self.scheduler = Scheduler(max_slots, self.cache)
         self.metrics = EngineMetrics(max_slots)
+        # fleet identity beacon (monitor/fleet.py): under
+        # FLAGS_monitor_fleet the scraped serving series resolve to
+        # this rank/host/job; one flag branch when off
+        _monitor.fleet.note_identity("serving")
         self.requests = {}
         self.max_queue = max_queue
         self.default_deadline_s = default_deadline_s

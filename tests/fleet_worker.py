@@ -1,0 +1,165 @@
+"""Worker for the multi-process fleet-telemetry acceptance test.
+
+Every rank announces its metrics endpoint in the TCPStore
+(monitor/fleet.py ``announce``), publishes train-shaped telemetry
+(``train_step_seconds`` / ``train_steps_total`` / ``train_loss``) from
+a synthetic step loop, and journals per-step spans. Rank 0 runs the
+fleet collector. The scripted incidents:
+
+- rank ``STRAGGLER_RANK`` runs every step ``SLOW_S`` instead of
+  ``FAST_S`` — persistently slower than the fleet median, so the
+  collector must flag it (``fleet_straggler_total{rank}``, named in
+  ``/debugz/fleet``) while every rank is still stepping: no timeout,
+  no stall, no watchdog involved;
+- rank ``NAN_RANK`` publishes a NaN loss from step ``NAN_STEP`` — its
+  local perf sentinel fires, its /healthz turns degraded, and the
+  collector pulls a ``fleet_capture_<ts>/`` with bundles + journal
+  tails from every rank.
+
+Rank 0 prints the machine-checkable evidence lines the parent test
+pins: STRAGGLER_FLAGGED (with the steps watermark at flag time),
+FLEET_VERDICT (the /debugz/fleet payload fetched over real HTTP),
+STRAGGLER_TOTAL, CAPTURES, FINAL_STEPS. Every rank prints FLEET_OK and
+exits 0 — the incidents leave telemetry, not corpses.
+
+Spawned by tests/test_monitor_fleet.py with PADDLE_TRAINER_ID /
+PADDLE_TRAINERS_NUM / PADDLE_MASTER / PT_MONITOR_DUMP_DIR and the
+FLAGS_* env (monitor_fleet, perf_sentinels, monitor_timeseries,
+monitor_trace) set.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+
+def main():
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    world = int(os.environ["PADDLE_TRAINERS_NUM"])
+    host, _, port = os.environ["PADDLE_MASTER"].partition(":")
+    straggler_rank = int(os.environ.get("STRAGGLER_RANK", "2"))
+    nan_rank = int(os.environ.get("NAN_RANK", "1"))
+    nan_step = int(os.environ.get("NAN_STEP", "30"))
+    steps = int(os.environ.get("STEPS", "45"))
+    fast_s = float(os.environ.get("FAST_S", "0.08"))
+    slow_s = float(os.environ.get("SLOW_S", "0.32"))
+
+    from paddle_tpu import monitor
+    from paddle_tpu.monitor import fleet, perf, trace
+    from paddle_tpu.distributed.process_group import (
+        StoreProcessGroup,
+        set_world_group,
+    )
+    from paddle_tpu.distributed.store import TCPStore
+
+    assert fleet.is_enabled(), "FLAGS_monitor_fleet must be on"
+    store = TCPStore(host or "127.0.0.1", int(port),
+                     is_master=(rank == 0), timeout_s=180)
+    store.barrier("boot", world, timeout_s=180)
+    pg = StoreProcessGroup(store, rank, world)
+    set_world_group(pg)
+
+    url = fleet.announce(store, rank, world, job="train")
+    assert url, "announce() returned no url with the flag on"
+    print("ANNOUNCED rank=%d url=%s" % (rank, url), flush=True)
+
+    collector = None
+    if rank == 0:
+        collector = fleet.start_collector(
+            store=store, world_size=world, rank=0,
+            interval_s=0.25, straggler_factor=2.0,
+            straggler_persist=2, capture_cooldown_s=1.5,
+            http_timeout_s=10.0,
+            capture_dir=os.environ["PT_MONITOR_DUMP_DIR"])
+    # every rank waits until all endpoints are announced so the first
+    # collector rounds see the whole fleet
+    store.barrier("announced", world, timeout_s=180)
+
+    reg = monitor.get_registry()
+    step_hist = reg.get("train_step_seconds")
+    steps_total = reg.get("train_steps_total")
+    tok_rate = reg.get("train_tokens_per_s")
+    loss_gauge = reg.get("train_loss")
+    assert None not in (step_hist, steps_total, tok_rate, loss_gauge)
+
+    sleep_s = slow_s if rank == straggler_rank else fast_s
+    straggler_flag_step = None
+    for i in range(steps):
+        t0 = time.perf_counter()
+        time.sleep(sleep_s)
+        dt = time.perf_counter() - t0
+        step_hist.observe(dt)
+        steps_total.inc()
+        tok_rate.set(128.0 / dt)
+        loss = 2.0 - 0.01 * i
+        if rank == nan_rank and i >= nan_step:
+            loss = float("nan")
+        loss_gauge.labels(job="train").set(loss)
+        trace.record_train_step("train", i, dt, steps=1, tokens=128)
+        if rank == 0 and straggler_flag_step is None \
+                and collector._stragglers:
+            straggler_flag_step = i
+            # the run is demonstrably alive at flag time: record the
+            # fleet's progress watermark, later pinned < FINAL_STEPS
+            watermark = max(
+                (st.get("steps_total") or 0)
+                for st in collector._ranks.values())
+            print("STRAGGLER_FLAGGED step=%d ranks=%s watermark=%d"
+                  % (i, sorted(collector._stragglers), int(watermark)),
+                  flush=True)
+            # the verdict over real HTTP — what an operator (or the
+            # ROADMAP item-2 router) would read
+            with urllib.request.urlopen(url + "/debugz/fleet",
+                                        timeout=10) as r:
+                print("FLEET_VERDICT %s" % r.read().decode(),
+                      flush=True)
+
+    if rank == nan_rank:
+        assert perf.is_degraded(), \
+            "NaN loss did not trip the local sentinel"
+
+    if rank == 0:
+        # settle: the collector needs a round or two to see the NaN
+        # rank's degradation and pull the capture
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            caps = list(collector._captures)
+            if any(c["reason"] == "anomaly" for c in caps) \
+                    and collector._stragglers:
+                break
+            time.sleep(0.25)
+        total = 0
+        m = reg.get("fleet_straggler_total")
+        for key, v in m.collect():
+            if key == (str(straggler_rank),):
+                total = v
+        print("STRAGGLER_TOTAL rank=%d value=%d"
+              % (straggler_rank, int(total)), flush=True)
+        print("CAPTURES %s" % json.dumps(
+            [{"dir": c["dir"], "reason": c["reason"],
+              "ranks": c["ranks"]} for c in collector._captures]),
+            flush=True)
+        final = max((st.get("steps_total") or 0)
+                    for st in collector._ranks.values())
+        print("FINAL_STEPS %d" % int(final), flush=True)
+        with urllib.request.urlopen(url + "/metrics/fleet",
+                                    timeout=10) as r:
+            text = r.read().decode()
+        assert 'train_steps_total{rank="0"}' in text, text[:400]
+        print("FEDERATION_OK", flush=True)
+
+    store.barrier("done", world, timeout_s=180)
+    if collector is not None:
+        fleet.stop_collector()
+    print("FLEET_OK rank=%d" % rank, flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

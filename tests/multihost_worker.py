@@ -53,8 +53,13 @@ def runtime_main(steps=3):
     rng = np.random.RandomState(7)  # identical data in every process
     for i in range(steps):
         ids = rng.randint(0, cfg.vocab_size, (8, 16)).astype(np.int32)
-        labels = rng.randint(0, cfg.vocab_size, (8, 16)).astype(np.int32)
-        loss = step(paddle.to_tensor(ids), paddle.to_tensor(labels))
+        # LEARNABLE target (labels == inputs, the copy task): with
+        # random labels the loss just random-walks around ln(vocab) and
+        # the parent's "training progresses" assertion was a coin flip
+        # (the PR-7-noted flake); on the copy task the tiny model's
+        # loss drops monotonically within a handful of steps on every
+        # jax build, so progress is a deterministic signal again
+        loss = step(paddle.to_tensor(ids), paddle.to_tensor(ids))
         print("LOSS %d %.6f" % (i, float(loss)), flush=True)
 
 

@@ -11,6 +11,7 @@ smoke matrix here instead of per-feature spot checks: `healthz`,
 from __future__ import annotations
 
 import json
+import time
 import urllib.error
 import urllib.request
 
@@ -18,6 +19,7 @@ import pytest
 
 import paddle_tpu as paddle
 from paddle_tpu import monitor
+from paddle_tpu.monitor import fleet
 from paddle_tpu.monitor import perf
 from paddle_tpu.monitor import registry as mreg
 from paddle_tpu.monitor import timeseries as ts
@@ -37,11 +39,16 @@ ROUTES = {
     "debugz/perf": (200, "json"),
     "debugz/timeseries": (200, "json"),
     "debugz/trace": (200, "json"),
+    "debugz/trace/journal": (200, "json"),
     "debugz/resilience": (200, "json"),
+    "debugz/fleet": (200, "json"),
+    "debugz/fleet/ranks": (200, "json"),
+    "metrics/fleet": (200, "text"),
 }
 
 ALL_FLAGS = ("FLAGS_monitor_timeseries", "FLAGS_perf_attribution",
-             "FLAGS_perf_sentinels", "FLAGS_monitor_trace")
+             "FLAGS_perf_sentinels", "FLAGS_monitor_trace",
+             "FLAGS_monitor_fleet")
 
 
 @pytest.fixture()
@@ -64,6 +71,7 @@ def _reset_monitor_state():
     trace.disable()
     trace.clear()
     wd.stop_watchdog()
+    fleet.stop_collector()
     mreg.enable(trace_bridge=False)
 
 
@@ -127,6 +135,18 @@ class TestRouteMatrixAllOff:
         _, body = _get(server, "debugz/resilience")
         p = json.loads(body.decode())
         assert p["fault_injection"]["enabled"] is False
+        _, body = _get(server, "debugz/fleet")
+        p = json.loads(body.decode())
+        assert p["enabled"] is False and p["collector"] is None
+        _, body = _get(server, "debugz/fleet/ranks")
+        p = json.loads(body.decode())
+        assert p["enabled"] is False and p["ranks"] == []
+        _, body = _get(server, "metrics/fleet")
+        assert "not running" in body.decode()
+        # ...no collector thread exists with the flag off...
+        import threading
+        assert not [t for t in threading.enumerate()
+                    if t.name == fleet._THREAD_NAME]
         # ...and the registry hot-path hook slots stayed None
         assert mreg._state.ts_hook is None
         assert mreg._state.ex_hook is None
@@ -151,6 +171,7 @@ class TestRouteMatrixAllOn:
         perf.enable_sentinels()
         trace.enable()
         wd.start_watchdog(stall_threshold_s=3600)
+        fleet.start_collector(endpoints={0: server}, interval_s=0.1)
         monitor.gauge("t_routes_gauge").set(1.5)
         h = monitor.histogram("t_routes_seconds", buckets=(1.0,))
         tid = trace.new_trace("request", request_id=1)
@@ -183,3 +204,22 @@ class TestRouteMatrixAllOn:
             "ok", "degraded")
         _, body = _get(server, "metrics")
         assert "t_routes_gauge 1.5" in body.decode()
+        # fleet routes carry the collector's fused self-scrape
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if fleet.get_collector()._scrapes >= 1:
+                break
+            time.sleep(0.05)
+        _, body = _get(server, "debugz/fleet")
+        p = json.loads(body.decode())
+        assert p["enabled"] is True
+        assert p["collector"]["running"] is True
+        _, body = _get(server, "debugz/fleet/ranks")
+        p = json.loads(body.decode())
+        assert [r["rank"] for r in p["ranks"]] == [0]
+        assert p["ranks"][0]["ok"] is True
+        _, body = _get(server, "metrics/fleet")
+        assert 'rank="0"' in body.decode()
+        _, body = _get(server, "debugz/trace/journal")
+        p = json.loads(body.decode())
+        assert p["kind"] == "trace_journal" and tid in p["traces"]

@@ -49,7 +49,11 @@ class TestExamples:
         assert "decode compiles: 1" in out
 
     def test_quantized_serving(self):
+        # 120 steps: the float model reaches ~0.84 deterministically on
+        # this jax build (40 steps plateaued at 0.645 after an optimizer
+        # numerics drift) — comfortably above the 0.75 gate while the
+        # int8-parity assertion below stays the actual subject
         float_acc, int8_acc = _load("quantized_serving").main(
-            train_steps=40, calib_batches=2)
+            train_steps=120, calib_batches=2)
         assert float_acc > 0.75, float_acc
         assert int8_acc >= float_acc - 0.05, (float_acc, int8_acc)

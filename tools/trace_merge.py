@@ -16,6 +16,12 @@ spans are shifted by its own wall<->monotonic anchor onto the native
 tracer's timebase, so one Perfetto file shows a request's journey
 across engine steps.
 
+``--capture`` accepts a collector ``fleet_capture_<ts>/`` directory
+(monitor/fleet.py anomaly-triggered fleet capture): every rank's
+journal tail merges with rank-prefixed pids, wall clocks aligned on
+the collector's clock via the manifest's per-rank offsets — one
+command renders the merged fleet chrome-trace from a capture.
+
 Usage:
   python tools/trace_merge.py --dir traces/ --out merged.json
   python tools/trace_merge.py --out merged.json r0.json r1.json ...
@@ -23,6 +29,7 @@ Usage:
   python tools/trace_merge.py --out m.json 0=a.json 1=b.json.gz
   python tools/trace_merge.py --out m.json --requests journal.json \
       [--requests-clock wall] [rank traces...]
+  python tools/trace_merge.py --out m.json --capture fleet_capture_<ts>/
 """
 from __future__ import annotations
 
@@ -106,6 +113,12 @@ def main(argv=None):
                          "(default; aligns with same-process native "
                          "traces via the journal's clock anchor) or "
                          "'wall' (journal-only merges)")
+    ap.add_argument("--capture", action="append", default=[],
+                    metavar="DIR",
+                    help="fleet_capture_<ts>/ directory (monitor/"
+                         "fleet.py collector capture) whose per-rank "
+                         "journal tails merge rank-prefixed and "
+                         "clock-aligned; repeatable")
     args = ap.parse_args(argv)
 
     paths_by_rank, offsets = collect_inputs(args)
@@ -115,6 +128,12 @@ def main(argv=None):
         evs = tm.journal_events(journal, clock=args.requests_clock)
         print("requests: %s -> %d span/event(s) from %d trace(s)"
               % (jp, len(evs), len(journal.get("traces") or ())))
+        extra.extend(evs)
+    for cap in args.capture:
+        manifest, evs = tm.capture_events(cap)
+        print("capture: %s (%s) -> %d span/event(s) from rank(s) %s"
+              % (cap, manifest.get("reason"), len(evs),
+                 manifest.get("ranks")))
         extra.extend(evs)
     if not paths_by_rank and not extra:
         ap.error("no input traces found")

@@ -136,6 +136,18 @@ run serving_resilience 1200 env $(wd serving_resilience) \
     --fault-rate 0.1 --max-queue 32 --deadline-s 30 \
     --out tools/serving_resilience_bench.json
 
+# 5d. fleet telemetry row (ISSUE 8): the existing 2-process multihost
+#     train entry under FLAGS_monitor_fleet — every rank announces its
+#     metrics endpoint in the TCPStore, a STANDALONE collector scrapes
+#     /metrics.json + /debugz/perf + /healthz from both ranks, fuses
+#     them (counter sums, gauge spreads), and commits the per-rank
+#     table + aggregates as tools/fleet_snapshot.json. A failed run
+#     re-emits the previous artifact marked stale (bench.py's
+#     discipline) and exits 3 — the battery row goes red instead of
+#     photocopying a fleet table.
+run fleet 900 python tools/fleet_battery.py --steps 40 \
+    --out tools/fleet_snapshot.json
+
 # 6. 7B-shape layer microbench (refines the pod projection)
 run llama7b_micro 900 python tools/llama7b_plan.py --microbench
 
