@@ -112,6 +112,28 @@ _DEFAULTS = {
     # hooks are one flag branch: no server, no collector thread, no
     # store traffic (test-pinned, the PR-2/5/6 discipline).
     "FLAGS_monitor_fleet": False,
+    # radix prefix cache over the serving engine's paged KV pool
+    # (serving/prefix_cache.py): requests sharing a prompt prefix
+    # (system prompts, few-shot headers) map their block-table head to
+    # SHARED pages via a radix tree keyed on block_size token chunks;
+    # admission charges only the uncached suffix, release decrefs
+    # instead of freeing (finished/preempted prefixes stay warm), and
+    # an LRU walk reclaims unreferenced cached pages under pressure
+    # BEFORE any running request is preempted. Off = the allocator
+    # behaves exactly as before (exclusive pages, release frees) and
+    # engine outputs are bit-identical to the pre-cache build
+    # (test-pinned). Latched at Engine construction.
+    "FLAGS_serving_prefix_cache": False,
+    # chunked prefill (serving/engine.py): long prompts prefill in
+    # fixed-size chunks interleaved into the ONE compiled mixed step as
+    # extra ragged rows next to the decode rows, so a long prefill no
+    # longer stalls the whole decode batch's TPOT and the engine
+    # compiles exactly one step function (decode_compiles == 1,
+    # test-pinned; the trash-page scatter discipline makes padded rows
+    # safe). Off = the split decode/prefill paths are unchanged.
+    # Latched at Engine construction; chunk size is the Engine's
+    # prefill_chunk argument.
+    "FLAGS_serving_chunked_prefill": False,
     # deterministic fault injection (paddle_tpu/resilience/faultinject).
     # Off = every injection site (store ops, eager collectives, serving
     # engine step, compiled train step) is one attribute load + branch:

@@ -40,4 +40,5 @@ from .engine import (  # noqa: F401
     QueueFullError,
 )
 from .kv_cache import BlockAllocator, PagedKVCache  # noqa: F401
+from .prefix_cache import RadixPrefixCache  # noqa: F401
 from .scheduler import Request, RequestState, Scheduler  # noqa: F401

@@ -14,6 +14,18 @@ Prefill is jitted per power-of-two length bucket (right-padded; pad
 rows are causally invisible to real rows and their K/V lands in the
 trash page), so a serving lifetime compiles O(log max_len) prefills.
 
+Serving tier 2 (default-off flags, latched at construction):
+``FLAGS_serving_prefix_cache`` adopts shared refcounted pages for
+cached prompt prefixes and prefills only the uncached suffix (the
+per-bucket prefill becomes the hist-parameterized suffix prefill);
+``FLAGS_serving_chunked_prefill`` replaces the split decode/prefill
+pair with ONE mixed ragged step over [max_slots, prefill_chunk] rows —
+decode rows are q_len==1 chunks — so long prompts stream through the
+decode batch one chunk per step instead of stalling it, and the
+compile-once contract holds as ``decode_compiles == 1`` for the mixed
+step. Both off: every compiled function, shape and output below is
+bit-identical to the tier-1 engine (test-pinned).
+
 The engine OWNS the cache: models expose a per-layer external-cache
 attention hook (a cache object with ``update_and_attend``,
 serving/kv_cache.py views) and a ``paged_cache_spec()`` describing
@@ -33,7 +45,12 @@ import numpy as np
 
 from .. import monitor as _monitor
 from ..resilience import faultinject as _fi
-from .kv_cache import PagedDecodeView, PagedKVCache, PagedPrefillView
+from .kv_cache import (
+    PagedDecodeView,
+    PagedKVCache,
+    PagedMixedView,
+    PagedPrefillView,
+)
 from .metrics import EngineMetrics, now, span
 from .scheduler import Request, RequestState, Scheduler
 
@@ -68,7 +85,8 @@ _HB_SERVE = _monitor.heartbeat("serving_engine")
 class Engine:
     def __init__(self, model, max_slots=4, num_blocks=64, block_size=16,
                  max_model_len=None, max_queue=None,
-                 default_deadline_s=None, max_preemptions=None):
+                 default_deadline_s=None, max_preemptions=None,
+                 prefill_chunk=16):
         """Resilience knobs (all default-off — the engine behaves
         exactly as before unless asked):
 
@@ -84,7 +102,25 @@ class Engine:
                             preempt-recompute livelock breaker; when NO
                             eligible victim remains, the grower is shed
                             (reason preempt_cap) instead of deadlocking
+
+        Serving tier-2 flags, LATCHED HERE at construction (a mid-life
+        flag flip never changes a live engine's compiled step set):
+
+        FLAGS_serving_prefix_cache   radix prefix cache over the page
+                            pool (serving/prefix_cache.py): shared
+                            prompt heads map to shared refcounted
+                            pages, admission charges only the uncached
+                            suffix, release keeps prefixes warm, LRU
+                            reclaim runs before any preemption
+        FLAGS_serving_chunked_prefill  prompts prefill in
+                            ``prefill_chunk``-token chunks interleaved
+                            into the ONE compiled mixed step as ragged
+                            rows next to the decode rows — a long
+                            prefill no longer stalls the decode batch,
+                            and ``decode_compiles`` stays exactly 1
         """
+        from ..core import flags as _flags
+
         self.model = model
         spec = model.paged_cache_spec()
         limit = model.max_decode_len()
@@ -104,7 +140,18 @@ class Engine:
             block_size=block_size, num_kv_heads=spec["num_kv_heads"],
             head_dim=spec["head_dim"], max_slots=max_slots,
             max_blocks_per_slot=mb, dtype=spec.get("dtype", "float32"))
-        self.scheduler = Scheduler(max_slots, self.cache)
+        self.prefix_cache = None
+        if _flags.flag("FLAGS_serving_prefix_cache"):
+            from .prefix_cache import RadixPrefixCache
+
+            self.prefix_cache = RadixPrefixCache(self.cache)
+        self.chunked_prefill = bool(
+            _flags.flag("FLAGS_serving_chunked_prefill"))
+        self.prefill_chunk = int(prefill_chunk)
+        if self.prefill_chunk < 1:
+            raise ValueError("prefill_chunk must be >= 1")
+        self.scheduler = Scheduler(max_slots, self.cache,
+                                   self.prefix_cache)
         self.metrics = EngineMetrics(max_slots)
         # fleet identity beacon (monitor/fleet.py): under
         # FLAGS_monitor_fleet the scraped serving series resolve to
@@ -125,8 +172,20 @@ class Engine:
         # slot_tokens[s]: last generated token, not yet written to KV —
         # the next decode step's input for that slot
         self._slot_tokens = np.zeros((max_slots,), np.int32)
-        self._decode = jax.jit(self._decode_fn)
-        self._prefill = jax.jit(self._prefill_fn)
+        if self.chunked_prefill:
+            # ONE mixed ragged step serves decode rows AND prefill
+            # chunks (a decode row is the q_len==1 case); the split
+            # decode/prefill functions are never traced
+            self._mixed = jax.jit(self._mixed_fn)
+        else:
+            self._decode = jax.jit(self._decode_fn)
+            if self.prefix_cache is not None:
+                # cache-aware prefill: runs only the uncached suffix
+                # over the adopted pool history (hist == 0 on a miss),
+                # jitted per suffix-length bucket like _prefill was
+                self._suffix_prefill = jax.jit(self._suffix_prefill_fn)
+            else:
+                self._prefill = jax.jit(self._prefill_fn)
 
     # -- public API -------------------------------------------------------
 
@@ -211,9 +270,17 @@ class Engine:
                 alloc = self.cache.allocator
                 self.metrics.on_kv_occupancy(
                     1.0 - alloc.free_blocks / max(alloc.usable_blocks, 1))
-            active = self.scheduler.active()
-            if active:
-                self._decode_once(active)
+            if self.chunked_prefill:
+                rows = self.scheduler.occupied()
+                if rows:
+                    self._mixed_once(rows)
+            else:
+                active = self.scheduler.active()
+                if active:
+                    self._decode_once(active)
+            if self.prefix_cache is not None:
+                self.metrics.on_prefix_stats(self.prefix_cache.stats(),
+                                             self.cache.cow_clones)
         return self.has_work()
 
     def run(self):
@@ -295,6 +362,27 @@ class Engine:
                 return
             slot, req = admitted
             self.metrics.on_admission()
+            if self.chunked_prefill:
+                # no synchronous prefill: the request sits in PREFILL
+                # state and its prompt streams through the mixed step
+                # in prefill_chunk-token rows next to everyone else's
+                # decode rows (resumable: prefill_pos is the cursor).
+                # The per-request serving.prefill injection site fires
+                # HERE — admission is the last moment a prefill fault
+                # is attributable to this one request
+                try:
+                    if _fi.is_enabled():
+                        _fi.fire("serving.prefill", request=req.id,
+                                 slot=slot)
+                except Exception as e:
+                    self._fail_request(req, e)
+                    continue
+                self.metrics.on_prefill_run()
+                req.trace_phase(
+                    "prefill", slot=slot, tokens=len(req.resume_tokens),
+                    cached=req.cached_tokens, chunked=True,
+                    resume=req.metrics.preemptions > 0)
+                continue
             try:
                 self._prefill_request(slot, req)
             except Exception as e:  # poison quarantine: the request's
@@ -316,20 +404,51 @@ class Engine:
             _fi.fire("serving.prefill", request=req.id, slot=slot)
         tokens = req.resume_tokens
         L = len(tokens)
-        P = self._bucket(L)
-        req.trace_phase("prefill", slot=slot, tokens=L, bucket=P,
-                        resume=req.metrics.preemptions > 0)
-        ids = np.zeros((1, P), np.int32)
-        ids[0, :L] = tokens
-        with span("serving.prefill"):
-            tok, new_pools = self._run_eval(
-                self._prefill, self._state_vals, self.cache.pools,
-                jnp.asarray(ids),
-                jnp.asarray(self.cache.block_tables[slot]),
-                jnp.asarray(L, jnp.int32))
+        if self.prefix_cache is not None:
+            # cache-aware path: only the uncached suffix runs through
+            # the model (hist == 0 on a miss — same function, so a miss
+            # and a hit share the per-bucket compile). A partially-
+            # matched page is split copy-on-write first; admission
+            # charged the clone page, so this cannot fail here.
+            hist = req.cached_tokens
+            if not self.cache.make_writable(slot, hist, L):
+                raise AssertionError("COW clone raced the allocator")
+            suffix = tokens[hist:]
+            Ls = len(suffix)
+            P = self._bucket(Ls)
+            req.trace_phase("prefill", slot=slot, tokens=L, bucket=P,
+                            cached=hist,
+                            resume=req.metrics.preemptions > 0)
+            ids = np.zeros((1, P), np.int32)
+            ids[0, :Ls] = suffix
+            with span("serving.prefill"):
+                tok, new_pools = self._run_eval(
+                    self._suffix_prefill, self._state_vals,
+                    self.cache.pools, jnp.asarray(ids),
+                    jnp.asarray(self.cache.block_tables[slot]),
+                    jnp.asarray(hist, jnp.int32),
+                    jnp.asarray(Ls, jnp.int32))
+        else:
+            P = self._bucket(L)
+            req.trace_phase("prefill", slot=slot, tokens=L, bucket=P,
+                            resume=req.metrics.preemptions > 0)
+            ids = np.zeros((1, P), np.int32)
+            ids[0, :L] = tokens
+            with span("serving.prefill"):
+                tok, new_pools = self._run_eval(
+                    self._prefill, self._state_vals, self.cache.pools,
+                    jnp.asarray(ids),
+                    jnp.asarray(self.cache.block_tables[slot]),
+                    jnp.asarray(L, jnp.int32))
         self.cache.pools = new_pools
         self.cache.seq_lens[slot] = L
         self.metrics.on_prefill_run()
+        if self.prefix_cache is not None:
+            # publish the freshly-computed prompt pages immediately —
+            # the next queued request sharing this prompt head admits
+            # against them, not against a finished-request race
+            self.prefix_cache.insert(tokens, self.cache.slot_pages(slot),
+                                     L)
         req.state = RequestState.DECODING
         req.metrics.on_first_token(now())
         # decode phase opens BEFORE the first token is accepted: a
@@ -339,18 +458,50 @@ class Engine:
         self._accept_token(req, int(tok))
 
     def _grow_or_preempt(self):
-        """Every decoding slot writes one K/V row this step at position
-        seq_len — make sure its page exists, preempting the most recent
-        other request on exhaustion (recompute-requeue)."""
-        for slot, req in list(self.scheduler.active()):
+        """Every live row writes K/V this step — decode rows one
+        position at seq_len, prefill-chunk rows their next chunk — make
+        sure the pages exist AND are exclusively owned (copy-on-write
+        splits a partially-shared prefix page before the first write).
+        On pool exhaustion the ESCALATION ORDER is: (1) LRU-reclaim
+        pages held only by the prefix cache — dropping cold cached
+        state costs nothing already-computed in flight; (2) preempt the
+        most recent other request (recompute-requeue) — now the LAST
+        resort, not the first; (3) shed the grower when every victim is
+        preemption-capped."""
+        rows = (self.scheduler.occupied() if self.chunked_prefill
+                else self.scheduler.active())
+        for slot, req in list(rows):
             if self.scheduler.slots[slot] is not req:
                 continue            # became a victim earlier in the loop
-            while not self.cache.ensure_capacity(
-                    slot, int(self.cache.seq_lens[slot]) + 1):
+            while True:
+                start = int(self.cache.seq_lens[slot])
+                if req.state is RequestState.PREFILL:
+                    end = start + min(
+                        self.prefill_chunk,
+                        len(req.resume_tokens) - req.prefill_pos)
+                else:
+                    end = start + 1
+                ok = self.cache.ensure_capacity(slot, end)
+                if ok and self.prefix_cache is not None:
+                    ok = self.cache.make_writable(slot, start, end)
+                if ok:
+                    break
+                if self.prefix_cache is not None:
+                    # reclaim the WHOLE shortfall in one heap walk
+                    # (+1 covers a possible COW clone page); calling
+                    # reclaim(1) per loop turn would pay a full tree
+                    # walk per page under sustained pressure
+                    shortfall = max(
+                        self.cache.pages_needed(end)
+                        - self.cache.slot_page_count(slot) + 1
+                        - self.cache.allocator.free_blocks, 1)
+                    if self.prefix_cache.reclaim(shortfall):
+                        continue
                 victim = self.scheduler.preempt_victim(
-                    slot, self.max_preemptions)
+                    slot, self.max_preemptions,
+                    include_prefill=self.chunked_prefill)
                 if victim is None:
-                    others = [r for i, r in self.scheduler.active()
+                    others = [r for i, r in self.scheduler.occupied()
                               if i != slot]
                     if others:
                         # every other running request is at the
@@ -390,6 +541,65 @@ class Engine:
         for slot, req in active:
             # the input token's K/V row landed at position seq_len
             self.cache.seq_lens[slot] += 1
+            self._accept_token(req, int(out[slot]))
+
+    def _mixed_once(self, rows):
+        """ONE mixed ragged step (chunked prefill): decode rows feed
+        their pending token (q_len 1), PREFILL rows feed their next
+        prompt chunk (q_len up to prefill_chunk) — all through the ONE
+        compiled step, so a long prefill costs the decode batch one
+        chunk of latency per step instead of a full-prompt stall."""
+        C = self.prefill_chunk
+        tokens = np.zeros((self.max_slots, C), np.int32)
+        q_lens = np.zeros((self.max_slots,), np.int32)
+        chunk_rows = 0
+        for slot, req in rows:
+            if req.state is RequestState.PREFILL:
+                toks = req.resume_tokens
+                n = min(C, len(toks) - req.prefill_pos)
+                tokens[slot, :n] = toks[req.prefill_pos:
+                                        req.prefill_pos + n]
+                q_lens[slot] = n
+                chunk_rows += 1
+            else:
+                tokens[slot, 0] = self._slot_tokens[slot]
+                q_lens[slot] = 1
+        try:
+            # same batched injection site as the split decode step: a
+            # failure is not attributable to one request until the
+            # quarantine serializes the batch
+            if _fi.is_enabled():
+                _fi.fire("serving.decode", batch=len(rows))
+            bt = jnp.asarray(self.cache.block_tables)
+            lens = jnp.asarray(self.cache.seq_lens)
+            with span("serving.mixed_step"):
+                next_toks, new_pools = self._run_eval(
+                    self._mixed, self._state_vals, self.cache.pools,
+                    jnp.asarray(tokens), bt, lens, jnp.asarray(q_lens))
+        except Exception as e:
+            self._on_decode_failure(rows, e)
+            return
+        self.cache.pools = new_pools
+        out = np.asarray(next_toks)
+        self.metrics.on_decode_step(len(rows))
+        for _ in range(chunk_rows):
+            self.metrics.on_prefill_chunk()
+        for slot, req in rows:
+            n = int(q_lens[slot])
+            self.cache.seq_lens[slot] += n
+            if req.state is RequestState.PREFILL:
+                req.prefill_pos += n
+                if req.prefill_pos < len(req.resume_tokens):
+                    continue        # mid-prompt: sampled token discarded
+                # final chunk: its last position's logits are the first
+                # generated token — the request becomes a decode row
+                if self.prefix_cache is not None:
+                    self.prefix_cache.insert(
+                        req.resume_tokens, self.cache.slot_pages(slot),
+                        int(self.cache.seq_lens[slot]))
+                req.state = RequestState.DECODING
+                req.metrics.on_first_token(now())
+                req.trace_phase("decode", slot=slot)
             self._accept_token(req, int(out[slot]))
 
     def _on_decode_failure(self, active, exc):
@@ -510,4 +720,57 @@ class Engine:
         lv = logits._value if isinstance(logits, Tensor) else logits
         nxt = jnp.argmax(lv[:, -1, :].astype(jnp.float32),
                          axis=-1).astype(jnp.int32)
+        return nxt, [v.pool for v in views]
+
+    def _suffix_prefill_fn(self, state_vals, pools, ids, table_row,
+                           hist, true_len):
+        """Cache-aware prefill: ids [1, P] (right-padded uncached
+        suffix) runs at absolute positions hist..hist+true_len-1 over
+        the slot's adopted pool history — the mixed ragged view with
+        S == 1. ``hist`` and ``true_len`` are traced, so a hit and a
+        miss (hist == 0) share the per-bucket compile."""
+        from ..core.dispatch import no_grad
+        from ..core.tensor import Tensor
+
+        self.metrics.on_prefill_compile()       # trace-time counter
+        hist_v = jnp.reshape(hist, (1,)).astype(jnp.int32)
+        qlen_v = jnp.reshape(true_len, (1,)).astype(jnp.int32)
+        with self.model.bind_state(self._names, list(state_vals)):
+            with no_grad():
+                views = [PagedMixedView(p, table_row[None, :], hist_v,
+                                        qlen_v, self.block_size)
+                         for p in pools]
+                logits, views = self.model.generate_step(
+                    Tensor(ids), views, hist_v)
+        lv = logits._value if isinstance(logits, Tensor) else logits
+        last = lv[0, true_len - 1].astype(jnp.float32)
+        tok = jnp.argmax(last, axis=-1).astype(jnp.int32)
+        return tok, [v.pool for v in views]
+
+    def _mixed_fn(self, state_vals, pools, tokens, block_tables,
+                  seq_lens, q_lens):
+        """THE compiled step under chunked prefill: [S, C] ragged rows
+        (decode rows q_len 1, prefill chunks up to C, idle rows 0) over
+        fixed shapes — requests arriving, chunking, finishing and
+        preempting never change a shape, so this traces EXACTLY once
+        (it counts into decode_compiles; the compile-once contract
+        holds with the flag on)."""
+        from ..core.dispatch import no_grad
+        from ..core.tensor import Tensor
+
+        self.metrics.on_decode_compile()        # trace-time counter
+        with self.model.bind_state(self._names, list(state_vals)):
+            with no_grad():
+                views = [PagedMixedView(p, block_tables, seq_lens,
+                                        q_lens, self.block_size)
+                         for p in pools]
+                logits, views = self.model.generate_step(
+                    Tensor(tokens), views, seq_lens)
+        lv = logits._value if isinstance(logits, Tensor) else logits
+        # each row's next token comes from its LAST VALID position's
+        # logits (q_len-1; idle rows clamp to 0 and are ignored host-side)
+        last = jnp.take_along_axis(
+            lv.astype(jnp.float32),
+            jnp.maximum(q_lens - 1, 0)[:, None, None], axis=1)[:, 0]
+        nxt = jnp.argmax(last, axis=-1).astype(jnp.int32)
         return nxt, [v.pool for v in views]

@@ -21,6 +21,17 @@ GQA (H > Hkv) is folded inside the kernel: q reshapes to
 [Hkv, H/Hkv, D] and both dots batch over the kv-head axis, so the pool
 never stores repeated heads.
 
+MIXED MODE (serving tier 2, FLAGS_serving_chunked_prefill /
+FLAGS_serving_prefix_cache): ``mixed_paged_attention`` generalizes the
+decode kernel to ragged [S, C] rows — row s holds q_lens[s] new tokens
+at absolute positions hist_lens[s]..hist_lens[s]+q_lens[s]-1, and the
+causal rule becomes ``key position <= hist + chunk index``. A decode
+row is the q_len == 1 case, a prefill chunk is 1 < q_len <= C, and the
+prefix-cache suffix prefill is S == 1 with hist = cached tokens; the
+compiled mixed step batches all of them in one call, which is exactly
+the mixed prefill/decode batch the Ragged Paged Attention paper's
+kernel is built for.
+
 Status: exact in interpret mode against masked_decode_attention
 (tests/test_serving.py::TestPagedAttentionKernel); on-chip Mosaic
 compile + timing pending a tunnel window (tools/tunnel_battery.sh
@@ -171,6 +182,175 @@ def paged_attention_reference(q, k_pool, v_pool, block_tables, seq_lens,
     # trash; their output is ignored host-side but must stay finite
     out = jnp.einsum("shm,smhd->shd", probs.astype(v.dtype), v)
     return out
+
+
+def _mixed_kernel(bt_ref, hist_ref, qlen_ref, q_ref, k_ref, v_ref, o_ref,
+                  m_scr, l_scr, acc_scr, *, block_size, rep, chunk, scale):
+    """One (slot, page) program of the MIXED ragged step. q [1, C, H, D]
+    (row s's chunk: q_len valid new tokens at absolute positions
+    hist..hist+q_len-1); k/v [1, bs, Hkv, D] (the page the index map
+    picked via the block table). The ragged causal rule is
+    ``key position <= hist + ci`` per chunk row ci — a decode row is the
+    C == q_len == 1 degenerate case. Stats flatten the (H, C) query rows
+    to H*C online-softmax rows; scratch m/l [H*C, 128], acc [H*C, D]."""
+    s_i = pl.program_id(0)
+    j = pl.program_id(1)
+    num_j = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    hist = hist_ref[s_i]
+    q_len = qlen_ref[s_i]
+
+    # pages at or past hist + q_len hold nothing this row can see: skip
+    # the DMA'd block (ragged early-out; idle rows q_len=0 skip every
+    # page and emit exact zeros, same as the decode kernel)
+    @pl.when(j * block_size < hist + q_len)
+    def _compute():
+        q = q_ref[0]                                  # [C, H, D]
+        k = k_ref[0]                                  # [bs, Hkv, D]
+        v = v_ref[0]
+        c, h, d = q.shape
+        hkv = k.shape[1]
+        # group for GQA: [C, H, D] -> [H, C, D] -> [Hkv, rep*C, D]
+        qg = jnp.swapaxes(q, 0, 1).reshape(
+            hkv, rep * c, d).astype(jnp.float32)
+        kg = jnp.swapaxes(k, 0, 1).astype(jnp.float32)     # [Hkv, bs, D]
+        s_blk = jax.lax.dot_general(
+            qg, kg, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32) * scale    # [Hkv, rep*C, bs]
+        s_blk = s_blk.reshape(h, c, block_size)
+        kpos = j * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, (h, c, block_size), 2)
+        qpos = hist + jax.lax.broadcasted_iota(
+            jnp.int32, (h, c, block_size), 1)
+        s_blk = jnp.where(kpos <= qpos, s_blk, NEG_INF)
+        s_blk = s_blk.reshape(h * c, block_size)
+        m_prev = m_scr[...][:, :1]
+        l_prev = l_scr[...][:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s_blk, axis=1, keepdims=True))
+        p = jnp.exp(s_blk - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        vg = jnp.swapaxes(v, 0, 1).astype(jnp.float32)     # [Hkv, bs, D]
+        upd = jax.lax.dot_general(
+            p.reshape(hkv, rep * c, block_size), vg,
+            (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)            # [Hkv, rep*C, D]
+        acc_scr[...] = alpha * acc_scr[...] + upd.reshape(h * c, d)
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(j == num_j - 1)
+    def _emit():
+        l = l_scr[...][:, :1]
+        h = o_ref.shape[2]
+        o = (acc_scr[...] / jnp.maximum(l, 1e-30)).reshape(
+            h, chunk, o_ref.shape[3])
+        o_ref[0] = jnp.swapaxes(o, 0, 1).astype(o_ref.dtype)
+
+
+def mixed_paged_attention_kernel(q, k_pool, v_pool, block_tables,
+                                 hist_lens, q_lens, scale=None,
+                                 interpret=None):
+    """Pallas path for the mixed step. q [S, C, H, D] -> [S, C, H, D];
+    rows past q_len and idle rows emit unspecified-but-finite values the
+    host ignores."""
+    s, c, h, d = q.shape
+    nb, block_size, hkv, _ = k_pool.shape
+    mb = block_tables.shape[1]
+    if h % hkv:
+        raise ValueError("mixed_paged_attention: %d heads not a multiple"
+                         " of %d kv heads" % (h, hkv))
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(s, mb),
+        in_specs=[
+            pl.BlockSpec((1, c, h, d),
+                         lambda si, j, bt, hl, ql: (si, 0, 0, 0)),
+            pl.BlockSpec((1, block_size, hkv, d),
+                         lambda si, j, bt, hl, ql: (bt[si, j], 0, 0, 0)),
+            pl.BlockSpec((1, block_size, hkv, d),
+                         lambda si, j, bt, hl, ql: (bt[si, j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, c, h, d), lambda si, j, bt, hl, ql: (si, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((h * c, _STAT_LANES), jnp.float32),
+            pltpu.VMEM((h * c, _STAT_LANES), jnp.float32),
+            pltpu.VMEM((h * c, d), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_mixed_kernel, block_size=block_size,
+                          rep=h // hkv, chunk=c, scale=scale),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((s, c, h, d), q.dtype),
+        compiler_params=CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(jnp.asarray(block_tables, jnp.int32),
+      jnp.asarray(hist_lens, jnp.int32),
+      jnp.asarray(q_lens, jnp.int32), q, k_pool, v_pool)
+
+
+def mixed_paged_attention_reference(q, k_pool, v_pool, block_tables,
+                                    hist_lens, q_lens, scale=None):
+    """jnp fallback for the mixed ragged step (chunked prefill + prefix-
+    cache suffix prefill + decode rows in ONE call): gather each row's
+    pages into a dense context — which already contains the chunk's own
+    freshly-scattered K/V — and apply the ragged causal mask
+    ``key position <= hist + ci``. Same fp32-statistics discipline as
+    paged_attention_reference (einsum -> NEG_INF mask -> softmax), so
+    greedy outputs stay consistent with the dense paths."""
+    s, c, h, d = q.shape
+    nb, block_size, hkv, _ = k_pool.shape
+    mb = block_tables.shape[1]
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    bt = jnp.asarray(block_tables, jnp.int32)
+    hist = jnp.asarray(hist_lens, jnp.int32)
+    k = k_pool[bt].reshape(s, mb * block_size, hkv, d)
+    v = v_pool[bt].reshape(s, mb * block_size, hkv, d)
+    if h != hkv:
+        rep = h // hkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    logits = jnp.einsum("schd,smhd->shcm", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    qpos = hist[:, None] + jnp.arange(c)[None, :]          # [S, C]
+    valid = (jnp.arange(mb * block_size)[None, None, :]
+             <= qpos[:, :, None])                          # [S, C, M]
+    logits = jnp.where(valid[:, None], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    # pad/idle rows see at least key position 0 (trash) -> finite
+    out = jnp.einsum("shcm,smhd->schd", probs.astype(v.dtype), v)
+    return out
+
+
+def mixed_paged_attention(q, k_pool, v_pool, block_tables, hist_lens,
+                          q_lens, scale=None, interpret=None):
+    """Dispatch for the mixed ragged step: the Pallas kernel on TPU when
+    the geometry is Mosaic-tileable, the jnp gather fallback otherwise
+    (CPU engine path and the parity-test oracle form)."""
+    s, c, h, d = q.shape
+    block_size = k_pool.shape[1]
+    tileable = (d % 128 == 0 and block_size % 8 == 0
+                and (h * c) % 8 == 0)
+    if jax.default_backend() == "tpu" and tileable:
+        return mixed_paged_attention_kernel(
+            q, k_pool, v_pool, block_tables, hist_lens, q_lens,
+            scale=scale, interpret=interpret)
+    return mixed_paged_attention_reference(
+        q, k_pool, v_pool, block_tables, hist_lens, q_lens, scale=scale)
 
 
 def paged_attention(q, k_pool, v_pool, block_tables, seq_lens,

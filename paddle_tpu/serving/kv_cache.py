@@ -16,13 +16,26 @@ writes for pad positions (right-padded prefill, idle decode slots) land
 in trash instead of corrupting live pages, and every write stays a
 single unconditional scatter — no masking inside the compiled step.
 
-The ``PagedPrefillView`` / ``PagedDecodeView`` classes are the
-per-layer external-cache attention hook: model attention layers that
-see a cache object with ``update_and_attend`` hand it (q, k, v) and get
-the attention context back (models/llama.py, models/gpt.py). The
-ENGINE owns the pools, tables and lengths; the model never holds cache
-state. Views are created inside the jitted step from traced pool
-arrays and return updated views — functional, like DecodeCache.
+Ownership is REFCOUNTED (serving tier 2): pages leave ``alloc`` at
+refcount 1; the radix prefix cache (serving/prefix_cache.py) increfs
+pages shared between its tree and the requests mapping their
+block-table head onto a cached prompt prefix; ``release_slot`` decrefs
+instead of freeing, and a write into a still-shared page goes through
+the ``make_writable`` copy-on-write guard. With
+FLAGS_serving_prefix_cache off nothing ever increfs and the allocator
+behaves exactly as the original exclusive-owner free list.
+
+The ``PagedPrefillView`` / ``PagedDecodeView`` / ``PagedMixedView``
+classes are the per-layer external-cache attention hook: model
+attention layers that see a cache object with ``update_and_attend``
+hand it (q, k, v) and get the attention context back (models/llama.py,
+models/gpt.py). The ENGINE owns the pools, tables and lengths; the
+model never holds cache state. Views are created inside the jitted
+step from traced pool arrays and return updated views — functional,
+like DecodeCache. ``PagedMixedView`` is the ragged superset the other
+two are special cases of: [S, C] rows of q_len new tokens each at
+positions hist..hist+q_len-1, serving chunked prefill, prefix-cache
+suffix prefill, and decode rows through one code path.
 """
 from __future__ import annotations
 
@@ -45,7 +58,17 @@ class BlockAllocator:
     """Host-side free-list over page ids 1..num_blocks-1 (0 is trash).
 
     ``alloc`` returns None — the explicit out-of-blocks signal — instead
-    of raising: the scheduler turns it into preempt-and-requeue."""
+    of raising: the scheduler turns it into preempt-and-requeue.
+
+    Ownership model: every allocated page carries a REFCOUNT. ``alloc``
+    hands out pages at refcount 1 (the exclusive-owner fast path —
+    without a prefix cache nothing ever increfs, and behavior is
+    exactly the pre-refcount allocator). The prefix cache increfs pages
+    it shares between a radix-tree node and the requests mapping their
+    block-table head onto it; ``free``/``decref`` only return a page to
+    the free list when the last reference drops. A page is free XOR
+    refcounted — the double-free check is an O(1) set probe, not the
+    O(n) list scan that made page-heavy teardown quadratic."""
 
     def __init__(self, num_blocks):
         if num_blocks < 2:
@@ -53,6 +76,8 @@ class BlockAllocator:
         self.num_blocks = num_blocks
         # LIFO keeps recently-freed (cache-warm) pages in circulation
         self._free = list(range(num_blocks - 1, 0, -1))
+        self._free_set = set(self._free)
+        self._refs = {}                 # page id -> refcount (> 0)
 
     @property
     def free_blocks(self):
@@ -63,16 +88,41 @@ class BlockAllocator:
         return self.num_blocks - 1
 
     def alloc(self, n=1):
-        """n page ids, or None when fewer than n pages are free."""
+        """n page ids at refcount 1, or None when fewer than n are free."""
         if n > len(self._free):
             return None
-        return [self._free.pop() for _ in range(n)]
+        pages = [self._free.pop() for _ in range(n)]
+        for p in pages:
+            self._free_set.discard(p)
+            self._refs[p] = 1
+        return pages
+
+    def refcount(self, i):
+        return self._refs.get(i, 0)
+
+    def incref(self, i):
+        """Add a reference to an allocated page (prefix-cache sharing)."""
+        if i not in self._refs:
+            raise ValueError("incref of unallocated page %r" % (i,))
+        self._refs[i] += 1
+
+    def decref(self, i):
+        """Drop one reference; the page returns to the free list when
+        the LAST reference drops. Returns True when the page was freed."""
+        if (not 0 < i < self.num_blocks or i in self._free_set
+                or i not in self._refs):
+            raise ValueError("bad free of page %r" % (i,))
+        self._refs[i] -= 1
+        if self._refs[i] == 0:
+            del self._refs[i]
+            self._free.append(i)
+            self._free_set.add(i)
+            return True
+        return False
 
     def free(self, ids):
         for i in ids:
-            if not 0 < i < self.num_blocks or i in self._free:
-                raise ValueError("bad free of page %r" % (i,))
-            self._free.append(i)
+            self.decref(i)
 
 
 class PagedKVCache:
@@ -97,12 +147,18 @@ class PagedKVCache:
                                      np.int32)
         self.seq_lens = np.zeros((max_slots,), np.int32)
         self._slot_pages = [[] for _ in range(max_slots)]
+        self.cow_clones = 0             # copy-on-write page splits
 
     def pages_needed(self, num_tokens):
         return -(-num_tokens // self.block_size)  # ceil
 
     def slot_page_count(self, slot):
         return len(self._slot_pages[slot])
+
+    def slot_pages(self, slot):
+        """The slot's page ids in position order (prefix-cache insert
+        reads them; treat as read-only)."""
+        return self._slot_pages[slot]
 
     def ensure_capacity(self, slot, num_tokens):
         """Allocate pages so positions 0..num_tokens-1 are covered.
@@ -123,8 +179,64 @@ class PagedKVCache:
         self.block_tables[slot, start:start + need] = pages
         return True
 
+    def adopt_prefix(self, slot, pages, matched_tokens):
+        """Map an (empty) slot's block-table head onto SHARED prefix
+        pages from the radix cache: each page gains a reference for
+        this slot, ``seq_lens`` starts at the matched token count, and
+        the request only prefills the uncached suffix. The caller has
+        already verified free-block capacity for that suffix."""
+        assert not self._slot_pages[slot], "adopt into a non-empty slot"
+        for p in pages:
+            self.allocator.incref(p)
+        self._slot_pages[slot] = list(pages)
+        self.block_tables[slot, :len(pages)] = pages
+        self.seq_lens[slot] = matched_tokens
+
+    def make_writable(self, slot, start, end):
+        """Copy-on-write guard: every page covering positions
+        ``[start, end)`` the slot is about to WRITE must be exclusively
+        owned. A shared page (a partially-matched prefix page, refcount
+        > 1) is cloned — pool K/V copied for every layer, block table
+        repointed, old reference dropped — so the write never corrupts
+        the other holders' history. Returns False when the pool cannot
+        supply a clone page (caller reclaims/preempts and retries) —
+        already-cloned pages stay valid, so the retry is incremental."""
+        if end <= start:
+            return True
+        ok = True
+        src, dst = [], []
+        for idx in range(start // self.block_size,
+                         -(-end // self.block_size)):
+            page = self._slot_pages[slot][idx]
+            if self.allocator.refcount(page) <= 1:
+                continue
+            new = self.allocator.alloc(1)
+            if new is None:
+                ok = False          # partial progress kept (see above)
+                break
+            new = new[0]
+            src.append(page)
+            dst.append(new)
+            self.allocator.decref(page)
+            self._slot_pages[slot][idx] = new
+            self.block_tables[slot, idx] = new
+            self.cow_clones += 1
+        if src:
+            # ONE batched gather-scatter per pool for the whole call —
+            # a functional .at[].set copies the entire pool buffer, so
+            # per-page updates would pay that copy once per clone
+            s = jnp.asarray(src, jnp.int32)
+            d = jnp.asarray(dst, jnp.int32)
+            self.pools = [
+                KVBlockPool(p.k.at[d].set(p.k[s]), p.v.at[d].set(p.v[s]))
+                for p in self.pools]
+        return ok
+
     def release_slot(self, slot):
-        """Free the slot's pages back to the pool (finish/preempt)."""
+        """Release the slot's page references (finish/preempt). A page
+        the prefix cache still references survives — release DECREFS
+        instead of freeing, so a finished request's prefix stays warm
+        for the next request that shares it."""
         if self._slot_pages[slot]:
             self.allocator.free(self._slot_pages[slot])
         self._slot_pages[slot] = []
@@ -202,3 +314,53 @@ class PagedDecodeView:
                               self.block_tables, lens + 1)
         return Tensor(out[:, None]), PagedDecodeView(
             new_pool, self.block_tables, lens, self.block_size)
+
+
+class PagedMixedView:
+    """One layer's hook for the MIXED ragged step ([S, C] tokens): row
+    ``s`` holds ``q_lens[s]`` valid new tokens at absolute positions
+    ``hist_lens[s] .. hist_lens[s] + q_lens[s] - 1`` (0 = idle row). A
+    decode row is the ``q_len == 1`` special case; a prefill chunk is
+    ``1 < q_len <= C``; the prefix-cache suffix prefill is the ``S == 1``
+    case with ``hist = cached tokens``. Every valid position's K/V
+    scatters through the slot's block-table row; PAD positions
+    (``j >= q_len``) route to the trash page — the same unconditional-
+    scatter discipline as the prefill/decode views, so no masking is
+    needed inside the compiled step. Attention runs over the POOL
+    (history plus the chunk's own freshly-written K/V) with the ragged
+    causal rule ``key position <= hist + j``."""
+
+    def __init__(self, pool, block_tables, hist_lens, q_lens, block_size):
+        self.pool = pool
+        self.block_tables = block_tables      # [S, MB] int32
+        self.hist_lens = hist_lens            # [S] int32 (pool history)
+        self.q_lens = q_lens                  # [S] int32 (new tokens)
+        self.block_size = block_size
+
+    def update_and_attend(self, q, k, v):
+        from ..core.tensor import Tensor
+        from .kernels.paged_attention import mixed_paged_attention
+
+        qv, kv, vv = _raw(q), _raw(k), _raw(v)
+        s, c = qv.shape[0], qv.shape[1]
+        mb = self.block_tables.shape[1]
+        pos = self.hist_lens[:, None] + jnp.arange(c)[None, :]  # [S, C]
+        valid = jnp.arange(c)[None, :] < self.q_lens[:, None]
+        # pad positions may index past the table (clamped gather) but
+        # their write is rerouted to the trash page anyway
+        page_idx = jnp.clip(pos // self.block_size, 0, mb - 1)
+        pages = jnp.where(
+            valid, jnp.take_along_axis(self.block_tables, page_idx,
+                                       axis=1), TRASH_BLOCK)
+        offs = jnp.where(valid, pos % self.block_size, 0)
+        new_pool = KVBlockPool(
+            self.pool.k.at[pages, offs].set(
+                kv.astype(self.pool.k.dtype)),
+            self.pool.v.at[pages, offs].set(
+                vv.astype(self.pool.v.dtype)))
+        out = mixed_paged_attention(qv, new_pool.k, new_pool.v,
+                                    self.block_tables, self.hist_lens,
+                                    self.q_lens)
+        return Tensor(out), PagedMixedView(
+            new_pool, self.block_tables, self.hist_lens, self.q_lens,
+            self.block_size)

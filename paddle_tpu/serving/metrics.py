@@ -63,6 +63,29 @@ _SHED = _mcounter(
     labelnames=("reason",))
 _PREFILLS = _mcounter("serving_prefill_runs_total",
                       "prefill executions (admissions + resumes)")
+# radix prefix cache (FLAGS_serving_prefix_cache) + chunked prefill
+# (FLAGS_serving_chunked_prefill) accounting: hit/lookup token counters
+# give the cache hit RATE, eviction/insert/COW counters describe pool
+# churn, chunk counter sizes the mixed step's prefill interleave. All
+# zero (and series-free until first touch) with the flags off.
+_PREFIX_HIT = _mcounter("serving_prefix_cache_hit_tokens_total",
+                        "prompt tokens served from the radix prefix "
+                        "cache instead of prefill compute")
+_PREFIX_LOOKUP = _mcounter("serving_prefix_cache_lookup_tokens_total",
+                           "prompt tokens looked up in the prefix cache "
+                           "at admission")
+_PREFIX_EVICT = _mcounter("serving_prefix_cache_evictions_total",
+                          "cached pages reclaimed by the LRU walk")
+_PREFIX_INSERT = _mcounter("serving_prefix_cache_insert_pages_total",
+                           "full pages registered in the radix tree")
+_COW_CLONES = _mcounter("serving_kv_cow_clones_total",
+                        "copy-on-write page splits (shared prefix page "
+                        "cloned before a divergent write)")
+_PREFIX_PAGES = _mgauge("serving_prefix_cache_pages",
+                        "pages currently held by the radix tree",
+                        labelnames=("engine",))
+_CHUNKS = _mcounter("serving_prefill_chunks_total",
+                    "prefill chunks interleaved into the mixed step")
 _DECODE_STEPS = _mcounter("serving_decode_steps_total",
                           "batched decode steps")
 _TOKENS = _mcounter("serving_output_tokens_total", "tokens generated")
@@ -93,7 +116,7 @@ _MAX_ENGINE_SERIES = 32
 
 
 def _prune_engine_series():
-    for g in (_ACTIVE, _THROUGHPUT, _GOODPUT, _KV_OCC):
+    for g in (_ACTIVE, _THROUGHPUT, _GOODPUT, _KV_OCC, _PREFIX_PAGES):
         keys = sorted(g._children, key=lambda k: int(k[0]))
         for k in keys[:-_MAX_ENGINE_SERIES]:
             g.remove(*k)
@@ -168,6 +191,26 @@ class RequestMetrics:
         # None while the journal is off — the observes below pay one
         # attribute check and nothing else (test-pinned).
         self.trace_id = None
+        # prefix-cache accounting (FLAGS_serving_prefix_cache): tokens
+        # of this request's prompt looked up / served from the radix
+        # cache, summed across admissions (a preempted request's resume
+        # looks up again — and usually re-hits its own inserted pages)
+        self.prefix_lookup_tokens = 0
+        self.prefix_cached_tokens = 0
+        # cached tokens at the FIRST admission only: the hit/miss
+        # CLASSIFICATION bit. The cumulative count above also absorbs
+        # resume re-matches (a preempted miss re-hits its own inserted
+        # pages), which must not reclassify a miss-TTFT as a hit.
+        self.prefix_cached_tokens_first = None
+
+    def on_prefix_lookup(self, lookup_tokens, hit_tokens):
+        if self.prefix_cached_tokens_first is None:
+            self.prefix_cached_tokens_first = int(hit_tokens)
+        self.prefix_lookup_tokens += int(lookup_tokens)
+        self.prefix_cached_tokens += int(hit_tokens)
+        _PREFIX_LOOKUP.inc(int(lookup_tokens))
+        if hit_tokens:
+            _PREFIX_HIT.inc(int(hit_tokens))
 
     def on_admit(self, t):
         if self.first_admit_t is None:
@@ -208,6 +251,9 @@ class RequestMetrics:
             "prompt_tokens": self.prompt_tokens,
             "output_tokens": self.output_tokens,
             "preemptions": self.preemptions,
+            "prefix_cached_tokens": self.prefix_cached_tokens,
+            "prefix_cached_tokens_first": (
+                self.prefix_cached_tokens_first or 0),
         }
 
 
@@ -222,6 +268,10 @@ class EngineMetrics:
         self._throughput_gauge = _THROUGHPUT.labels(engine=eid)
         self._goodput_gauge = _GOODPUT.labels(engine=eid)
         self._kv_occ_gauge = _KV_OCC.labels(engine=eid)
+        # bound lazily on the first prefix-cache sample: with the flags
+        # off no serving_prefix_cache_pages series exists at all
+        self._eid = eid
+        self._prefix_pages_gauge = None
         _prune_engine_series()
         # wall clock starts at FIRST ADMISSION, not construction: an
         # engine built ahead of traffic must not understate throughput
@@ -239,6 +289,15 @@ class EngineMetrics:
         self.prefill_compiles = 0
         self._occupancy_sum = 0
         self._kv_occupancy = 0.0
+        # prefix cache / chunked prefill (FLAGS_serving_*; all stay 0
+        # with the flags off)
+        self.prefix_hit_tokens = 0
+        self.prefix_lookup_tokens = 0
+        self.prefix_evictions = 0
+        self.prefix_insert_pages = 0
+        self.prefix_cached_pages = 0
+        self.cow_clones = 0
+        self.prefill_chunks = 0
 
     # -- engine hooks (mirror every sample into the shared registry) ---
 
@@ -273,6 +332,36 @@ class EngineMetrics:
     def on_prefill_run(self):
         self.prefill_runs += 1
         _PREFILLS.inc()
+
+    def on_prefill_chunk(self):
+        self.prefill_chunks += 1
+        _CHUNKS.inc()
+
+    def on_prefix_stats(self, pc_stats, cow_clones):
+        """Engine-pushed snapshot of the radix cache counters (called
+        once per engine step with the cache on; the registry series get
+        the DELTAS so counters stay monotone across engines)."""
+        if self._prefix_pages_gauge is None:
+            self._prefix_pages_gauge = _PREFIX_PAGES.labels(
+                engine=self._eid)
+        # hit/lookup token counters are incremented per-request in
+        # on_prefix_lookup — here only the engine-dict mirrors update
+        d = pc_stats["evicted_pages"] - self.prefix_evictions
+        if d:
+            _PREFIX_EVICT.inc(d)
+        d = pc_stats["inserted_pages"] - self.prefix_insert_pages
+        if d:
+            _PREFIX_INSERT.inc(d)
+        d = cow_clones - self.cow_clones
+        if d:
+            _COW_CLONES.inc(d)
+        self.prefix_hit_tokens = pc_stats["hit_tokens"]
+        self.prefix_lookup_tokens = pc_stats["lookup_tokens"]
+        self.prefix_evictions = pc_stats["evicted_pages"]
+        self.prefix_insert_pages = pc_stats["inserted_pages"]
+        self.prefix_cached_pages = pc_stats["cached_pages"]
+        self.cow_clones = cow_clones
+        self._prefix_pages_gauge.set(pc_stats["cached_pages"])
 
     def on_output_token(self):
         self.output_tokens += 1
@@ -332,7 +421,10 @@ class EngineMetrics:
                 output_tokens=self.output_tokens,
                 finished_output_tokens=self.finished_output_tokens,
                 preemptions=self.preemptions,
-                decode_steps=self.decode_steps)
+                decode_steps=self.decode_steps,
+                prefix_hit_tokens=self.prefix_hit_tokens,
+                prefix_cached_pages=self.prefix_cached_pages,
+                prefill_chunks=self.prefill_chunks)
         except Exception:
             pass
 
@@ -360,4 +452,11 @@ class EngineMetrics:
                               if wall else 0.0),
             "slot_occupancy": occ,
             "kv_page_occupancy": self._kv_occupancy,
+            "prefix_hit_tokens": self.prefix_hit_tokens,
+            "prefix_lookup_tokens": self.prefix_lookup_tokens,
+            "prefix_evictions": self.prefix_evictions,
+            "prefix_insert_pages": self.prefix_insert_pages,
+            "prefix_cached_pages": self.prefix_cached_pages,
+            "cow_clones": self.cow_clones,
+            "prefill_chunks": self.prefill_chunks,
         }

@@ -17,6 +17,18 @@ Policies (vLLM-style, kept deliberately simple and deterministic):
   bit-identically after re-prefill.
 - A finished/preempted slot is immediately reusable (slot reuse on
   EOS) — the next admission claims the lowest free slot index.
+
+Serving tier 2 (both default-off, latched at Engine construction):
+with FLAGS_serving_prefix_cache the admission check charges only the
+UNCACHED SUFFIX of the resume prompt (matched prefix pages are adopted
+shared/refcounted from the radix tree, and an LRU reclaim of cold
+cached pages runs before admission gives up); release inserts the
+slot's full pages into the tree before decref'ing, so preempt-by-
+recompute resumes mostly from cache. With
+FLAGS_serving_chunked_prefill, PREFILL is a RESUMABLE state — the
+request holds its slot across steps while ``prefill_pos`` walks its
+prompt in chunks through the mixed step — and mid-prefill rows are
+preemption candidates like decode rows.
 """
 from __future__ import annotations
 
@@ -70,6 +82,15 @@ class Request:
         self.trace_id = None
         self._span_root = None
         self._span_phase = None
+        # prefix-cache / chunked-prefill state (FLAGS_serving_*; both 0
+        # and unused on the default paths):
+        # cached_tokens — tokens of THIS admission's resume prompt that
+        # came out of the radix cache (the prefill starts there);
+        # prefill_pos — resumable chunked-prefill cursor: tokens of
+        # resume_tokens already run through the mixed step. Both reset
+        # at every (re-)admission.
+        self.cached_tokens = 0
+        self.prefill_pos = 0
 
     @property
     def resume_tokens(self):
@@ -153,9 +174,12 @@ class Request:
 
 
 class Scheduler:
-    def __init__(self, max_slots, cache):
+    def __init__(self, max_slots, cache, prefix_cache=None):
         self.max_slots = max_slots
         self.cache = cache
+        # radix prefix cache (FLAGS_serving_prefix_cache; None = the
+        # pre-cache admission path, bit-identical)
+        self.prefix_cache = prefix_cache
         self.queue = deque()
         self.slots = [None] * max_slots    # slot -> Request or None
         self._admit_counter = itertools.count()
@@ -192,6 +216,14 @@ class Scheduler:
         return [(i, r) for i, r in enumerate(self.slots)
                 if r is not None and r.state is RequestState.DECODING]
 
+    def occupied(self):
+        """(slot, req) for every slot holding live work — DECODING rows
+        plus mid-prefill chunk rows (chunked prefill keeps PREFILL
+        state across steps); the mixed ragged step batches them all."""
+        return [(i, r) for i, r in enumerate(self.slots)
+                if r is not None and r.state in (RequestState.PREFILL,
+                                                 RequestState.DECODING)]
+
     def slots_active(self):
         """Occupied slot count (any state) — the batch-slot occupancy
         the trace events stamp."""
@@ -201,8 +233,14 @@ class Scheduler:
 
     def admit_next(self):
         """Admit the queue head if a slot is free and the pool can hold
-        its whole resume prompt. Returns (slot, req) or None. Strict
-        FCFS: a blocked head blocks everything behind it."""
+        its resume prompt's UNCACHED SUFFIX (with the prefix cache off,
+        that is the whole prompt — the pre-cache check, bit-identical).
+        Returns (slot, req) or None. Strict FCFS: a blocked head blocks
+        everything behind it. With the prefix cache on, the head's
+        prefix is matched against the radix tree first: matched pages
+        are adopted (shared, refcounted) instead of allocated, and when
+        even the suffix doesn't fit, an LRU reclaim of unreferenced
+        cached pages runs BEFORE giving up."""
         if not self.queue:
             return None
         free = [i for i, r in enumerate(self.slots) if r is None]
@@ -210,12 +248,39 @@ class Scheduler:
             return None
         req = self.queue[0]
         slot = free[0]
-        need = self.cache.pages_needed(len(req.resume_tokens))
+        tokens = req.resume_tokens
+        matched_pages, matched = [], 0
+        if self.prefix_cache is not None:
+            matched_pages, matched = self.prefix_cache.match(
+                tokens, limit=len(tokens) - 1)
+        need = self.cache.pages_needed(len(tokens)) - len(matched_pages)
+        if matched % self.cache.block_size:
+            # a partially-matched page will be copy-on-write cloned at
+            # first write — charge the clone page now so the prefill
+            # can never fail mid-admission (all-or-nothing stays true)
+            need += 1
+        # adopt BEFORE any reclaim: the slot's reference (refcount 2)
+        # protects the just-matched pages from the LRU walk — otherwise
+        # an eviction pass triggered by THIS admission could free the
+        # very prefix it matched
+        if matched_pages:
+            self.cache.adopt_prefix(slot, matched_pages, matched)
         if need > self.cache.allocator.free_blocks:
-            return None
+            if self.prefix_cache is not None:
+                self.prefix_cache.reclaim(
+                    need - self.cache.allocator.free_blocks)
+            if need > self.cache.allocator.free_blocks:
+                if matched_pages:   # undo: all-or-nothing admission
+                    self.cache.release_slot(slot)
+                return None
         self.queue.popleft()
-        if not self.cache.ensure_capacity(slot, len(req.resume_tokens)):
+        if not self.cache.ensure_capacity(slot, len(tokens)):
             raise AssertionError("admission raced the allocator")
+        req.cached_tokens = matched
+        req.prefill_pos = matched
+        if self.prefix_cache is not None:
+            self.prefix_cache.note_lookup(len(tokens), matched)
+            req.metrics.on_prefix_lookup(len(tokens), matched)
         self.slots[slot] = req
         req.slot = slot
         req.state = RequestState.PREFILL
@@ -224,6 +289,7 @@ class Scheduler:
         if req.trace_id is not None:    # attrs cost nothing when off
             req.trace_event(
                 "scheduled", slot=slot, kv_pages=need,
+                kv_cached_tokens=matched,
                 kv_free_blocks=self.cache.allocator.free_blocks,
                 slots_active=self.slots_active(),
                 resume=req.metrics.preemptions > 0)
@@ -232,13 +298,24 @@ class Scheduler:
     # -- slot release / preemption ---------------------------------------
 
     def release(self, req):
-        """Free the request's slot + pages (finish or preempt)."""
+        """Release the request's slot + page references (finish or
+        preempt). With the prefix cache on, the slot's FULL pages are
+        inserted into the radix tree FIRST — release then decrefs, so
+        the computed prefix (prompt and generated history both) stays
+        warm: a preempted victim's resume re-matches its own pages and
+        recomputes almost nothing, and the next request sharing the
+        prompt head skips it entirely."""
         slot = req.slot
+        if self.prefix_cache is not None:
+            self.prefix_cache.insert(req.resume_tokens,
+                                     self.cache.slot_pages(slot),
+                                     int(self.cache.seq_lens[slot]))
         self.cache.release_slot(slot)
         self.slots[slot] = None
         req.slot = None
 
-    def preempt_victim(self, exclude_slot, max_preemptions=None):
+    def preempt_victim(self, exclude_slot, max_preemptions=None,
+                       include_prefill=False):
         """Pick and preempt the most recently admitted running request
         other than ``exclude_slot``; requeues it at the front. Returns
         the victim or None when there is no ELIGIBLE other running
@@ -246,8 +323,13 @@ class Scheduler:
         paid the cap is no longer a candidate — it runs to completion,
         which is what breaks the preempt-recompute livelock (two
         requests evicting each other forever make no progress; a capped
-        request cannot be evicted, so it finishes and frees pages)."""
-        candidates = [r for i, r in self.active() if i != exclude_slot
+        request cannot be evicted, so it finishes and frees pages).
+        ``include_prefill`` widens the candidate set to mid-prefill
+        chunk rows (chunked prefill holds PREFILL slots across steps;
+        on the default path prefill is synchronous and the wider set is
+        identical to active())."""
+        pool = self.occupied() if include_prefill else self.active()
+        candidates = [r for i, r in pool if i != exclude_slot
                       and (max_preemptions is None
                            or r.metrics.preemptions < max_preemptions)]
         if not candidates:

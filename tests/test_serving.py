@@ -316,7 +316,9 @@ class TestServingEdgeCases:
         eng.run()
         rm = eng.request_metrics(rid)
         assert set(rm) == {"queue_time_s", "ttft_s", "tpot_s", "e2e_s",
-                           "prompt_tokens", "output_tokens", "preemptions"}
+                           "prompt_tokens", "output_tokens", "preemptions",
+                           "prefix_cached_tokens",
+                           "prefix_cached_tokens_first"}
         assert rm["prompt_tokens"] == 3 and rm["output_tokens"] == 4
         for k in ("queue_time_s", "ttft_s", "tpot_s", "e2e_s"):
             assert rm[k] is not None and rm[k] >= 0

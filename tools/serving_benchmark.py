@@ -104,6 +104,22 @@ def main():
     ap.add_argument("--max-queue", type=int, default=None,
                     help="bounded admission queue: arrivals beyond it "
                          "are load-shed (counted, not enqueued)")
+    ap.add_argument("--prefix-cache", action="store_true",
+                    help="enable FLAGS_serving_prefix_cache (radix "
+                         "prefix cache over the paged KV pool)")
+    ap.add_argument("--chunked-prefill", action="store_true",
+                    help="enable FLAGS_serving_chunked_prefill (prompts "
+                         "stream through the ONE mixed step in chunks)")
+    ap.add_argument("--prefill-chunk", type=int, default=16,
+                    help="chunk size for --chunked-prefill")
+    ap.add_argument("--shared-prefix-tokens", type=int, default=0,
+                    help="system-prompt traffic shape: every request's "
+                         "prompt starts with one of --prefix-groups "
+                         "shared prefixes of this many tokens (0 = "
+                         "fully random prompts)")
+    ap.add_argument("--prefix-groups", type=int, default=4,
+                    help="number of distinct shared prefixes for "
+                         "--shared-prefix-tokens")
     ap.add_argument("--no-trace", action="store_true",
                     help="skip the span journal (requests_detail rows "
                          "then carry no trace_id/phases_s breakdown)")
@@ -135,13 +151,37 @@ def main():
 
     rng = np.random.RandomState(args.seed)
     arrivals = np.cumsum(rng.exponential(1.0 / args.rate, args.requests))
-    prompts = [rng.randint(0, cfg.vocab_size,
-                           (int(rng.randint(args.prompt_len[0],
-                                            args.prompt_len[1] + 1)),)
-                           ).tolist()
-               for _ in range(args.requests)]
+    # shared-prefix traffic shape (--shared-prefix-tokens): the
+    # millions-of-users workload — every request opens with one of G
+    # shared system-prompt/few-shot headers, then a random tail of the
+    # configured prompt length. The PREFIX CACHE should collapse
+    # hit-request TTFT to roughly the tail's prefill cost.
+    if args.shared_prefix_tokens > 0:
+        prefixes = [rng.randint(0, cfg.vocab_size,
+                                (args.shared_prefix_tokens,)).tolist()
+                    for _ in range(args.prefix_groups)]
+        group_of = [int(rng.randint(args.prefix_groups))
+                    for _ in range(args.requests)]
+        prompts = [prefixes[group_of[i]]
+                   + rng.randint(0, cfg.vocab_size,
+                                 (int(rng.randint(args.prompt_len[0],
+                                                  args.prompt_len[1] + 1)),)
+                                 ).tolist()
+                   for i in range(args.requests)]
+    else:
+        prompts = [rng.randint(0, cfg.vocab_size,
+                               (int(rng.randint(args.prompt_len[0],
+                                                args.prompt_len[1] + 1)),)
+                               ).tolist()
+                   for _ in range(args.requests)]
     max_new = [int(rng.randint(args.max_new[0], args.max_new[1] + 1))
                for _ in range(args.requests)]
+
+    from paddle_tpu.core import flags as ptflags
+
+    ptflags.set_flags({
+        "FLAGS_serving_prefix_cache": bool(args.prefix_cache),
+        "FLAGS_serving_chunked_prefill": bool(args.chunked_prefill)})
 
     # resilience knobs are applied AFTER warmup (below): the compile
     # warmup enqueues one request per prefill bucket, and a deadline or
@@ -149,23 +189,48 @@ def main():
     # compiles into the measured window
     eng = serving.Engine(model, max_slots=args.max_slots,
                          num_blocks=args.num_blocks,
-                         block_size=args.block_size)
+                         block_size=args.block_size,
+                         prefill_chunk=args.prefill_chunk)
 
     # warmup: compile THE decode step plus every prefill bucket the
     # workload can hit, outside the measured window (compile time is
     # reported separately); one warmup request per bucket. Buckets go up
     # to prompt_hi + max_new_hi - 1, not prompt_hi: a preempted request
     # resumes with prompt + generated-so-far, and its re-prefill must
-    # not pay an in-window compile either.
+    # not pay an in-window compile either. Chunked prefill has NO
+    # per-bucket prefills — one warm request traces the one mixed step.
+    # With the prefix cache on, suffix prefills can be SHORTER than any
+    # full prompt, so the bucket sweep starts at length 1.
     t0 = time.perf_counter()
-    resume_hi = args.prompt_len[1] + args.max_new[1] - 1
-    buckets = sorted({eng._bucket(n) for n in
-                      range(args.prompt_len[0], resume_hi + 1)})
-    n_warm = len(buckets)
-    for b in buckets:
-        warm_len = min(b, resume_hi, eng.max_model_len - 2)
-        eng.add_request([1] * warm_len, max_new_tokens=2)
+    prompt_hi = (args.prompt_len[1] + args.shared_prefix_tokens)
+    resume_hi = prompt_hi + args.max_new[1] - 1
+    if args.chunked_prefill:
+        n_warm = 1
+        eng.add_request([1] * min(resume_hi, eng.max_model_len - 2),
+                        max_new_tokens=2)
+    else:
+        lo = 1 if args.prefix_cache else args.prompt_len[0]
+        buckets = sorted({eng._bucket(n) for n in
+                          range(lo, resume_hi + 1)})
+        n_warm = len(buckets)
+        for b in buckets:
+            warm_len = min(b, resume_hi, eng.max_model_len - 2)
+            eng.add_request([1] * warm_len, max_new_tokens=2)
+            if eng.prefix_cache is not None:
+                # each warm request must be a FULL MISS: letting warm
+                # request N hit request N-1's cached pages would shrink
+                # its suffix into a lower bucket and leave the top
+                # buckets uncompiled — an in-window jit later
+                eng.run()
+                eng.prefix_cache.clear()
     eng.run()
+    if eng.prefix_cache is not None:
+        # warmup prompts must not seed the measured workload's cache;
+        # push the post-clear counters into the engine mirror so the
+        # warmup snapshot below absorbs the clear's evictions
+        eng.prefix_cache.clear()
+        eng.metrics.on_prefix_stats(eng.prefix_cache.stats(),
+                                    eng.cache.cow_clones)
     warmup_s = time.perf_counter() - t0
     base = eng.stats()     # counters up to here are warmup, not workload
     eng.max_queue = args.max_queue
@@ -238,6 +303,17 @@ def main():
     queue = [m["queue_time_s"] for m in per_req
              if m["queue_time_s"] is not None]
     out_tokens = sum(m["output_tokens"] for m in per_req)
+    # TTFT split by prefix-cache outcome at the FIRST admission (TTFT
+    # is set by the first token, so only that admission's match can
+    # explain it — a preempted miss that re-hits its own pages on
+    # resume stays a miss). The acceptance headline is p50 hit-TTFT
+    # collapsing vs miss-TTFT on the shared-prefix shape.
+    ttft_hit = [m["ttft_s"] for m in per_req
+                if m["ttft_s"] is not None
+                and m["prefix_cached_tokens_first"] > 0]
+    ttft_miss = [m["ttft_s"] for m in per_req
+                 if m["ttft_s"] is not None
+                 and m["prefix_cached_tokens_first"] == 0]
 
     report = {
         "metric": "serving_throughput_tok_s",
@@ -251,11 +327,29 @@ def main():
             "max_new": list(args.max_new), "seed": args.seed,
             "max_slots": args.max_slots, "num_blocks": args.num_blocks,
             "block_size": args.block_size,
+            "shared_prefix_tokens": args.shared_prefix_tokens,
+            "prefix_groups": (args.prefix_groups
+                              if args.shared_prefix_tokens else 0),
+            "prefix_cache": bool(args.prefix_cache),
+            "chunked_prefill": bool(args.chunked_prefill),
+            "prefill_chunk": (args.prefill_chunk
+                              if args.chunked_prefill else None),
         },
         "wall_s": round(wall, 3),
         "warmup_compile_s": round(warmup_s, 3),
         "output_tokens": out_tokens,
         "ttft_s": _pcts(ttft),
+        "ttft_hit_s": _pcts(ttft_hit),
+        "ttft_miss_s": _pcts(ttft_miss),
+        "prefix_cache_hits": len(ttft_hit),
+        "prefix_cache_hit_tokens_total": (stats["prefix_hit_tokens"]
+                                          - base["prefix_hit_tokens"]),
+        "prefix_cache_lookup_tokens_total": (
+            stats["prefix_lookup_tokens"] - base["prefix_lookup_tokens"]),
+        "prefix_cache_evictions": (stats["prefix_evictions"]
+                                   - base["prefix_evictions"]),
+        "cow_clones": stats["cow_clones"] - base["cow_clones"],
+        "prefill_chunks": stats["prefill_chunks"] - base["prefill_chunks"],
         "tpot_s": _pcts(tpot),
         "queue_time_s": _pcts(queue),
         "preemptions": stats["preemptions"] - base["preemptions"],

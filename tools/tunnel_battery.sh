@@ -124,6 +124,26 @@ run serving 1200 env $(wd serving) \
     --out tools/serving_bench.json \
     --monitor-out tools/monitor_snapshot.json
 
+# 5b2. serving tier-2 row (ISSUE 9): the SAME Poisson engine under the
+#     system-prompt traffic shape (4 groups x 128 shared prefix tokens)
+#     with the radix prefix cache + chunked prefill on — the artifact
+#     reports TTFT split by cache hit/miss (acceptance: p50 hit-TTFT
+#     <= 0.3x miss-TTFT), prefix_cache_hit_tokens_total, eviction/COW
+#     counts and the chunk interleave, and still pins
+#     decode_compiles == 1 (the mixed step is THE one compiled step).
+#     Compare goodput-vs-throughput gap against the 5b row on the same
+#     trace shape: the preemption tax should shrink (reclaim-before-
+#     preempt). NOTE (re-baseline): BENCH_r04/r05 are stale photocopies
+#     — run the bench + perf_report rows above in the same window so
+#     these serving numbers diff against a LIVE baseline, not a rotted
+#     one.
+run serving_prefix 1200 env $(wd serving_prefix) \
+    python tools/serving_benchmark.py --preset llama1b \
+    --requests 64 --rate 8 --max-slots 8 --num-blocks 512 \
+    --prefix-cache --chunked-prefill \
+    --shared-prefix-tokens 128 --prefix-groups 4 \
+    --out tools/serving_prefix_bench.json
+
 # 5c. resilience serving row (ISSUE 7): the same engine under an
 #     injected fault schedule + queue bound + deadlines — reports
 #     goodput next to shed/expired/poison counts, proving graceful
