@@ -1,0 +1,46 @@
+"""ptlint — project-specific static analysis for paddle_tpu.
+
+Nine PRs of growth rest on hand-enforced invariants: default-off
+``FLAGS_*`` with test-pinned disabled paths, the compile-once
+decode/train step, the monotonic-clock rule, lock-guarded daemon
+threads, and the single labeled metric registry. Reviewer memory does
+not scale to ROADMAP items 2-4 churning hundreds of files, so this
+package makes the invariants *mechanical*: ~6 AST passes over the
+whole tree, each encoding one discipline the repo already documents
+(README "Static analysis" has the catalog):
+
+    flag          every FLAGS_* declared, dispositioned in BASELINE.md,
+                  test-referenced, and never re-read per hot-path step
+    trace         functions reachable from jax.jit/shard_map call sites
+                  stay host-pure (no clocks, host RNG, print, sync)
+    clock         time.time() never feeds duration/deadline arithmetic
+                  (time.monotonic() does); wall clock is identity-only
+    thread        spawned threads are daemon=True with a reachable stop
+                  path; state they mutate is lock-guarded
+    metric        registry metric names are literal, family-prefixed,
+                  label-consistent, and documented
+    silent-except broad ``except Exception: pass`` is forbidden —
+                  diagnostic threads must not eat their own failures
+
+Suppression is per-site (``# ptlint: <rule>-ok — reason``) and
+grandfathering is explicit (the checked-in baseline file named by
+``[tool.ptlint]`` in pyproject.toml). ``tools/ptlint.py`` is the CLI;
+tests/test_ptlint.py holds the tier-1 tree-is-clean gate.
+
+The reference stack ships exactly this kind of correctness tooling
+(nan/inf checkers, FLAGS_call_stack_level enforcement in enforce.h);
+the whole-program-compilation story only holds if traced functions
+stay host-pure — a property a static pass proves where a flaky test
+can only sample.
+"""
+from __future__ import annotations
+
+from .base import (  # noqa: F401
+    Baseline,
+    Finding,
+    Project,
+    load_config,
+    render_json,
+    render_text,
+)
+from .runner import RULES, run  # noqa: F401

@@ -1,0 +1,153 @@
+"""Shared AST helpers for the ptlint passes (stdlib-only).
+
+The passes trade soundness for precision deliberately: resolution is
+name-based and module-local, because a lint that chases every dynamic
+dispatch drowns the five real disciplines in noise. Pragmas and the
+baseline handle the residue.
+"""
+from __future__ import annotations
+
+import ast
+
+
+def dotted(node):
+    """'a.b.c' for a Name/Attribute chain, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call):
+    """Dotted name of a Call's callee, else None."""
+    return dotted(call.func)
+
+
+class FuncIndex:
+    """Module-local function/method index.
+
+    defs        {simple_name: [FunctionDef, ...]} — every def in the
+                module, INCLUDING nested ones (a traced step_fn defined
+                inside __init__ is the common jit target here)
+    qualname    {id(node): 'Class.method' / 'outer.<locals>.inner'}
+    parent      {id(node): enclosing FunctionDef/ClassDef/Module}
+    """
+
+    def __init__(self, tree):
+        self.defs = {}
+        self.qualname = {}
+        self.parent = {}
+        self.methods = {}       # {class_name: {method_name: node}}
+        self._walk(tree, (), None)
+
+    def _walk(self, node, stack, parent):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qn = ".".join(s for s, _ in stack + ((child.name, "f"),))
+                self.qualname[id(child)] = qn
+                self.parent[id(child)] = parent
+                self.defs.setdefault(child.name, []).append(child)
+                if stack and stack[-1][1] == "c":
+                    self.methods.setdefault(
+                        stack[-1][0], {})[child.name] = child
+                self._walk(child, stack + ((child.name, "f"),), child)
+            elif isinstance(child, ast.ClassDef):
+                self._walk(child, stack + ((child.name, "c"),), child)
+            else:
+                self._walk(child, stack, parent)
+
+    def enclosing_class(self, node):
+        """Class name owning a method node, via its qualname."""
+        qn = self.qualname.get(id(node), "")
+        if "." in qn:
+            head = qn.rsplit(".", 1)[0]
+            if head in self.methods and node.name in self.methods[head]:
+                return head
+        return None
+
+
+def local_scopes(tree):
+    """Yield (scope_node, qualname) for the module and every def —
+    each is one taint-analysis scope (module body excludes nested def
+    bodies; each def excludes ITS nested defs in turn)."""
+    idx = FuncIndex(tree)
+    yield tree, "<module>"
+    for defs in idx.defs.values():
+        for d in defs:
+            yield d, idx.qualname.get(id(d), d.name)
+
+
+def scope_statements(scope):
+    """The statements belonging directly to a scope (nested function
+    and class bodies are excluded — they are their own scopes)."""
+    out = []
+
+    def visit(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef, ast.Lambda)):
+                continue
+            out.append(child)
+            visit(child)
+
+    visit(scope)
+    return out
+
+
+def import_aliases(tree):
+    """{local_name: canonical dotted target} for imports, flattening
+    relative imports onto their leaf names.
+
+    ``from ..monitor import counter as _mcounter`` ->
+        {'_mcounter': 'monitor.counter'}
+    ``from . import registry as _registry`` ->
+        {'_registry': 'registry'}
+    ``import threading`` -> {'threading': 'threading'}
+    """
+    out = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.asname:
+                    out[a.asname] = a.name
+                else:
+                    # `import a.b` binds only `a` — mapping it to
+                    # "a.b" would mangle every `a.x` call ("jax.jit"
+                    # -> "jax.numpy.jit") and hide jit roots
+                    top = a.name.split(".")[0]
+                    out[top] = top
+        elif isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            leaf = mod.rsplit(".", 1)[-1] if mod else ""
+            for a in node.names:
+                target = ("%s.%s" % (leaf, a.name)) if leaf else a.name
+                out[a.asname or a.name] = target
+    return out
+
+
+def resolve_call(call, aliases):
+    """Canonical dotted callee using the module's import aliases:
+    '_mcounter(...)' -> 'monitor.counter'; '_registry.counter(...)' ->
+    'registry.counter'; unknown heads pass through unchanged."""
+    name = call_name(call)
+    if name is None:
+        return None
+    head, _, rest = name.partition(".")
+    head = aliases.get(head, head)
+    return "%s.%s" % (head, rest) if rest else head
+
+
+def const_str(node):
+    return node.value if (isinstance(node, ast.Constant)
+                          and isinstance(node.value, str)) else None
+
+
+def keyword(call, name):
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None

@@ -1,0 +1,386 @@
+"""ptlint infrastructure: findings, pragmas, project tree, config, baseline.
+
+Design constraints shared by every pass:
+
+- **Stable identity.** A finding's baseline key is ``(rule, path,
+  symbol)`` where ``symbol`` is content-derived (qualname + detail),
+  never a line number — grandfathered findings must survive unrelated
+  edits above them, and a moved-but-unfixed violation must NOT mint a
+  fresh finding the gate then rejects.
+- **Explicit suppression.** ``# ptlint: <rule>-ok`` on the offending
+  line (or in the contiguous comment block directly above it)
+  suppresses exactly that rule at exactly that site; suppressions
+  should carry a one-line reason after an em-dash or parenthesis.
+  There is no file-level or wildcard opt-out — a discipline you can
+  silently opt a whole file out of is not a discipline.
+- **Stdlib only.** The linter runs in bare CI workers and inside the
+  tier-1 pytest gate; it must import without jax/numpy.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+
+# pragma grammar: "# ptlint: clock-ok", "# ptlint: clock-ok, thread-ok",
+# optionally followed by free-text justification. Rule tokens must end
+# in "-ok"; anything after the last recognized token is the reason.
+_PRAGMA_RE = re.compile(r"#\s*ptlint:\s*(?P<rules>[a-z][a-z0-9-]*-ok"
+                        r"(?:\s*,\s*[a-z][a-z0-9-]*-ok)*)")
+_RULE_TOKEN_RE = re.compile(r"([a-z][a-z0-9-]*)-ok")
+
+
+class Finding:
+    """One rule violation at one site.
+
+    symbol       content-stable id for baseline matching (no line nos)
+    grandfathered  True once matched against a baseline entry
+    """
+
+    __slots__ = ("rule", "path", "line", "symbol", "message",
+                 "grandfathered")
+
+    def __init__(self, rule, path, line, symbol, message):
+        self.rule = rule
+        self.path = path
+        self.line = int(line)
+        self.symbol = symbol
+        self.message = message
+        self.grandfathered = False
+
+    @property
+    def key(self):
+        return (self.rule, self.path, self.symbol)
+
+    def to_dict(self):
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "symbol": self.symbol, "message": self.message,
+                "grandfathered": self.grandfathered}
+
+    def __repr__(self):
+        return "Finding(%s %s:%d %s)" % (self.rule, self.path,
+                                         self.line, self.symbol)
+
+
+class SourceFile:
+    """One parsed python file: text, AST, and per-line pragma map."""
+
+    def __init__(self, root, relpath):
+        self.relpath = relpath
+        self.abspath = os.path.join(root, relpath)
+        with open(self.abspath, encoding="utf-8") as f:
+            self.text = f.read()
+        self.lines = self.text.splitlines()
+        self._tree = None
+        self._parse_error = None
+        self.pragmas = self._scan_pragmas()
+
+    @property
+    def tree(self):
+        if self._tree is None and self._parse_error is None:
+            try:
+                self._tree = ast.parse(self.text,
+                                       filename=self.relpath)
+            except SyntaxError as e:
+                self._parse_error = e
+        return self._tree
+
+    def _scan_pragmas(self):
+        out = {}
+        for i, line in enumerate(self.lines, 1):
+            if "ptlint" not in line:
+                continue
+            m = _PRAGMA_RE.search(line)
+            if m is None:
+                continue
+            out[i] = set(_RULE_TOKEN_RE.findall(m.group("rules")))
+        return out
+
+    def suppressed(self, rule, lines):
+        """True when any of ``lines`` — or the contiguous comment
+        block directly above the first of them — carries a
+        ``<rule>-ok`` pragma. The comment-block walk is what lets a
+        pragma share a multi-line justification comment."""
+        lines = sorted(set(int(x) for x in lines if x))
+        candidates = set(lines)
+        if lines:
+            ln = lines[0] - 1
+            while ln >= 1 and \
+                    self.lines[ln - 1].lstrip().startswith("#"):
+                candidates.add(ln)
+                ln -= 1
+        for ln in candidates:
+            if rule in self.pragmas.get(ln, ()):
+                return True
+        return False
+
+
+_DEFAULT_EXCLUDE = ("__pycache__", ".git", "build", "dist")
+
+
+class Project:
+    """The lint target: a root dir + the python files under the given
+    relative paths (minus excludes). Passes read ``files`` and the
+    config dict; nothing else, so tests can point a pass at a tmp tree
+    of seeded-violation fixtures."""
+
+    def __init__(self, root, paths=("paddle_tpu", "tools"),
+                 exclude=(), config=None):
+        self.root = os.path.abspath(root)
+        self.paths = tuple(paths)
+        self.exclude = tuple(exclude) or ()
+        self.config = config or {}
+        self._files = None
+
+    def _excluded(self, rel):
+        parts = rel.split(os.sep)
+        for pat in _DEFAULT_EXCLUDE + self.exclude:
+            if pat in parts or rel == pat or rel.startswith(pat + os.sep):
+                return True
+        return False
+
+    @property
+    def files(self):
+        if self._files is None:
+            out = []
+            for base in self.paths:
+                top = os.path.join(self.root, base)
+                if os.path.isfile(top) and top.endswith(".py"):
+                    out.append(SourceFile(self.root, base))
+                    continue
+                for dirpath, dirnames, filenames in os.walk(top):
+                    dirnames[:] = sorted(
+                        d for d in dirnames
+                        if not self._excluded(os.path.relpath(
+                            os.path.join(dirpath, d), self.root)))
+                    for fn in sorted(filenames):
+                        if not fn.endswith(".py"):
+                            continue
+                        rel = os.path.relpath(
+                            os.path.join(dirpath, fn), self.root)
+                        if not self._excluded(rel):
+                            out.append(SourceFile(self.root, rel))
+            self._files = out
+        return self._files
+
+    def file(self, relpath):
+        """Load one file by repo-relative path (outside ``paths`` is
+        fine: the flag pass reads BASELINE.md's sibling flags file even
+        when only ``tools`` is being linted)."""
+        p = os.path.join(self.root, relpath)
+        if not os.path.exists(p):
+            return None
+        return SourceFile(self.root, relpath)
+
+    def read(self, relpath):
+        p = os.path.join(self.root, relpath)
+        if not os.path.exists(p):
+            return None
+        with open(p, encoding="utf-8") as f:
+            return f.read()
+
+
+# -- config ([tool.ptlint] in pyproject.toml) --------------------------------
+#
+# Python 3.10 has no tomllib, so this reads the narrow TOML subset the
+# block actually uses: [tool.ptlint] / [tool.ptlint.<pass>] tables with
+# string, bool, int, and single-line string-array values. Anything
+# fancier belongs in code, not config.
+
+_SECTION_RE = re.compile(r"^\[(?P<name>[^\]]+)\]\s*$")
+_KV_RE = re.compile(r"^(?P<key>[A-Za-z0-9_-]+)\s*=\s*(?P<val>.+?)\s*$")
+
+
+def _toml_value(raw):
+    raw = raw.strip()
+    if raw.startswith("[") and raw.endswith("]"):
+        inner = raw[1:-1].strip()
+        if not inner:
+            return []
+        return [_toml_value(v) for v in re.findall(
+            r'"(?:[^"\\]|\\.)*"|[^,\s][^,]*', inner)]
+    if raw.startswith('"') and raw.endswith('"'):
+        return raw[1:-1].replace('\\"', '"')
+    if raw in ("true", "false"):
+        return raw == "true"
+    try:
+        return int(raw)
+    except ValueError:
+        return raw
+
+
+def _brackets_balanced(text):
+    """True once every ``[`` outside a double-quoted string has its
+    ``]`` — the multi-line-array continuation test."""
+    depth = 0
+    in_str = False
+    prev = ""
+    for c in text:
+        if c == '"' and prev != "\\":
+            in_str = not in_str
+        elif not in_str:
+            if c == "[":
+                depth += 1
+            elif c == "]":
+                depth -= 1
+        prev = c
+    return depth <= 0 and not in_str
+
+
+def _strip_toml_comment(line):
+    """Drop a trailing # comment, respecting double-quoted strings."""
+    out = []
+    in_str = False
+    i = 0
+    while i < len(line):
+        c = line[i]
+        if c == '"' and (i == 0 or line[i - 1] != "\\"):
+            in_str = not in_str
+        elif c == "#" and not in_str:
+            break
+        out.append(c)
+        i += 1
+    return "".join(out).rstrip()
+
+
+def load_config(root, pyproject="pyproject.toml"):
+    """The [tool.ptlint] tables as nested dicts: top-level keys plus
+    one sub-dict per ``[tool.ptlint.<pass>]`` section. Missing file or
+    missing section -> {} (every consumer has defaults)."""
+    path = os.path.join(root, pyproject)
+    if not os.path.exists(path):
+        return {}
+    out = {}
+    section = None
+    pending = None    # (key, accumulated text) of an open [... array
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = _strip_toml_comment(line)
+            if not line.strip():
+                continue
+            if pending is not None:
+                # continuation of a multi-line array value
+                key, acc = pending
+                acc += " " + line.strip()
+                if _brackets_balanced(acc):
+                    section[key] = _toml_value(acc)
+                    pending = None
+                else:
+                    pending = (key, acc)
+                continue
+            m = _SECTION_RE.match(line.strip())
+            if m:
+                name = m.group("name").strip()
+                if name == "tool.ptlint":
+                    section = out
+                elif name.startswith("tool.ptlint."):
+                    sub = name[len("tool.ptlint."):]
+                    section = out.setdefault(sub, {})
+                else:
+                    section = None
+                continue
+            if section is None:
+                continue
+            kv = _KV_RE.match(line.strip())
+            if kv:
+                val = kv.group("val").strip()
+                if val.startswith("[") and not _brackets_balanced(val):
+                    pending = (kv.group("key"), val)
+                else:
+                    section[kv.group("key")] = _toml_value(val)
+    return out
+
+
+# -- baseline ----------------------------------------------------------------
+
+class Baseline:
+    """Checked-in grandfather list. Matching is by ``(rule, path,
+    symbol)`` — content-stable, line-free. ``apply`` marks matched
+    findings grandfathered and returns the STALE entries (baseline rows
+    whose finding no longer exists): stale rows fail the gate too, so
+    the file can only shrink as debt is paid, never silently rot."""
+
+    def __init__(self, entries=()):
+        self.entries = [dict(e) for e in entries]
+
+    @classmethod
+    def load(cls, path):
+        if not path or not os.path.exists(path):
+            return cls()
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+        return cls(data.get("findings", []))
+
+    @classmethod
+    def from_findings(cls, findings):
+        return cls([{"rule": f.rule, "path": f.path,
+                     "symbol": f.symbol, "note": f.message}
+                    for f in findings])
+
+    def write(self, path):
+        data = {
+            "kind": "ptlint_baseline",
+            "version": 1,
+            "comment": "grandfathered ptlint findings; every entry is "
+                       "named debt — pay it down, never append to "
+                       "dodge the gate (use a pragma with a reason "
+                       "for a deliberate exception)",
+            "findings": sorted(
+                self.entries,
+                key=lambda e: (e["rule"], e["path"], e["symbol"])),
+        }
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(data, f, indent=1)
+            f.write("\n")
+
+    def apply(self, findings):
+        keys = {(e["rule"], e["path"], e["symbol"])
+                for e in self.entries}
+        seen = set()
+        for f in findings:
+            if f.key in keys:
+                f.grandfathered = True
+                seen.add(f.key)
+        return [e for e in self.entries
+                if (e["rule"], e["path"], e["symbol"]) not in seen]
+
+
+# -- reporting ---------------------------------------------------------------
+
+def render_text(findings, stale=(), counts=None):
+    lines = []
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule)):
+        mark = " [grandfathered]" if f.grandfathered else ""
+        lines.append("%s:%d: %s: %s%s"
+                     % (f.path, f.line, f.rule, f.message, mark))
+    for e in stale:
+        lines.append("BASELINE-STALE: %s %s %s — finding no longer "
+                     "exists; remove the entry"
+                     % (e["rule"], e["path"], e["symbol"]))
+    fresh = [f for f in findings if not f.grandfathered]
+    lines.append("ptlint: %d finding(s) (%d grandfathered, %d fresh), "
+                 "%d stale baseline entr%s"
+                 % (len(findings), len(findings) - len(fresh),
+                    len(fresh), len(stale),
+                    "y" if len(stale) == 1 else "ies"))
+    if counts:
+        lines.append("per-rule: " + ", ".join(
+            "%s=%d" % (r, n) for r, n in sorted(counts.items())))
+    return "\n".join(lines)
+
+
+def render_json(findings, stale=(), counts=None, meta=None):
+    out = {
+        "kind": "ptlint_report",
+        "version": 1,
+        "findings": [f.to_dict() for f in sorted(
+            findings, key=lambda f: (f.path, f.line, f.rule))],
+        "stale_baseline": list(stale),
+        "fresh": sum(1 for f in findings if not f.grandfathered),
+        "grandfathered": sum(1 for f in findings if f.grandfathered),
+        "per_rule": dict(counts or {}),
+    }
+    if meta:
+        out["meta"] = dict(meta)
+    return out

@@ -1,0 +1,170 @@
+"""clock pass: time.time() must never feed duration/deadline arithmetic.
+
+The repo's rule since PR 3 ("no cross-host or NTP-step clock hazards"):
+wall clock is for IDENTITY — provenance stamps, beacons, the NTP-style
+offset probes — and ``time.monotonic()`` is for anything the code
+subtracts or orders (durations, deadlines, backoff, freshness). A wall
+clock that steps under NTP mid-run turns `now - started` negative and
+fires (or masks) every timeout downstream.
+
+Mechanics (per-scope taint): a variable assigned from ``time.time()``
+(optionally +/- a constant, i.e. a deadline) is tainted; a finding is
+any ``-`` with a tainted operand or any ``<``/``<=``/``>``/``>=``
+comparison touching one, plus the same uses of a ``time.time()`` call
+inline. Equality compares are deliberately exempt — stamp equality is
+the watchdog's skew-immune liveness idiom.
+
+A ``# ptlint: clock-ok`` pragma on the ASSIGNMENT (or the offending
+op) blesses a deliberate wall-clock site — the NTP probe keeps its
+wall stamps by un-tainting them at the source, so downstream midpoint
+math stays clean without a pragma per expression.
+"""
+from __future__ import annotations
+
+import ast
+
+from .astutil import dotted, import_aliases, local_scopes, \
+    resolve_call, scope_statements
+from .base import Finding
+
+RULE = "clock"
+
+_ORDERED_CMP = (ast.Lt, ast.LtE, ast.Gt, ast.GtE)
+
+
+def _wall_calls(node, aliases):
+    """time.time() Call nodes anywhere under ``node``."""
+    out = []
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call) and \
+                resolve_call(n, aliases) == "time.time":
+            out.append(n)
+    return out
+
+
+def _taint_keys(target):
+    """Dotted keys an assignment target binds: ``t0`` -> {"t0"},
+    ``self.x`` -> {"self.x"}, ``a, b`` -> {"a", "b"}. Keys are FULL
+    dotted paths — tainting the bare base name ("self") would poison
+    every later attribute compare in the scope."""
+    keys = set()
+    if isinstance(target, ast.Name):
+        keys.add(target.id)
+    elif isinstance(target, ast.Attribute):
+        d = dotted(target)
+        if d:
+            keys.add(d)
+    elif isinstance(target, (ast.Tuple, ast.List)):
+        for elt in target.elts:
+            keys |= _taint_keys(elt)
+    elif isinstance(target, ast.Starred):
+        keys |= _taint_keys(target.value)
+    # Subscript targets (d[k] = wall) taint nothing: keying the whole
+    # container would be the same base-name poisoning
+    return keys
+
+
+def _names(node):
+    """Loadable dotted paths under ``node`` — what taint matches on."""
+    out = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+            out.add(n.id)
+        elif isinstance(n, ast.Attribute) and \
+                isinstance(n.ctx, ast.Load):
+            d = dotted(n)
+            if d:
+                out.add(d)
+    return out
+
+
+def _is_wall_expr(node, aliases, tainted):
+    return bool(_wall_calls(node, aliases)) or \
+        bool(_names(node) & tainted)
+
+
+def run_pass(project):
+    findings = []
+    for sf in project.files:
+        tree = sf.tree
+        if tree is None:
+            continue
+        aliases = import_aliases(tree)
+        if "time" not in aliases.values() and \
+                "time.time" not in aliases.values():
+            continue
+        for scope, qual in local_scopes(tree):
+            findings.extend(_scan_scope(sf, scope, qual, aliases))
+    return findings
+
+
+def _scan_scope(sf, scope, qual, aliases):
+    stmts = scope_statements(scope)
+    tainted = set()
+    out = []
+    n = 0
+    reported = set()    # node ids: the flattened statement list nests
+    for st in stmts:
+        # taint propagation first-pass per statement: assignment from a
+        # wall expr taints the targets (unless the line is pragma'd —
+        # that is how a deliberate wall site is blessed at its source)
+        if isinstance(st, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            value = st.value
+            if value is not None and \
+                    _is_wall_expr(value, aliases, tainted):
+                targets = [st.target] if not isinstance(
+                    st, ast.Assign) else st.targets
+                keys = set()
+                for t in targets:
+                    keys |= _taint_keys(t)
+                if not sf.suppressed(RULE, [st.lineno]):
+                    tainted |= keys
+                else:
+                    # pragma'd source: also clear any previous taint on
+                    # these names so the blessing actually sticks
+                    tainted -= keys
+            elif value is not None and not isinstance(st, ast.AugAssign):
+                # reassignment from a non-wall value launders the name
+                # (t0 = time.monotonic() after t0 = time.time()); aug-
+                # assign keeps taint — the new value folds in the old
+                targets = [st.target] if not isinstance(
+                    st, ast.Assign) else st.targets
+                for t in targets:
+                    tainted -= _taint_keys(t)
+        for node in ast.walk(st):
+            if id(node) in reported:
+                continue
+            hit = None
+            if isinstance(node, ast.BinOp) and \
+                    isinstance(node.op, ast.Sub):
+                if _is_wall_expr(node.left, aliases, tainted) or \
+                        _is_wall_expr(node.right, aliases, tainted):
+                    hit = "subtraction"
+            elif isinstance(node, ast.Compare) and any(
+                    isinstance(op, _ORDERED_CMP) for op in node.ops):
+                operands = [node.left] + list(node.comparators)
+                if any(_is_wall_expr(o, aliases, tainted)
+                       for o in operands):
+                    hit = "ordered comparison"
+            if hit is None:
+                continue
+            reported.add(id(node))
+            if isinstance(node, ast.Compare):
+                # one finding per expression: the deadline compare and
+                # the subtraction inside it are the same violation
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.BinOp) and \
+                            isinstance(sub.op, ast.Sub):
+                        reported.add(id(sub))
+            line = getattr(node, "lineno", st.lineno)
+            if sf.suppressed(RULE, [line]):
+                continue
+            n += 1
+            out.append(Finding(
+                RULE, sf.relpath, line,
+                "%s:wall-%s#%d" % (qual, hit.split()[0], n),
+                "wall-clock value flows into %s (duration/deadline "
+                "math must use time.monotonic(); wall clock is "
+                "identity-only — pragma the assignment if this site "
+                "is a deliberate wall-clock probe)" % hit))
+    return out

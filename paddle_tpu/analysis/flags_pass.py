@@ -1,0 +1,179 @@
+"""flag pass: every FLAGS_* is dispositioned, tested, and hot-path-latched.
+
+Three sub-checks, all driven by ``core/flags.py``'s ``_DEFAULTS`` dict
+(the single source of truth for the flag surface):
+
+1. **disposition** — every flag has a row in BASELINE.md's
+   flag-disposition table (``| `FLAGS_x` | ... |``). The table is the
+   repo's contract for WHY a flag is default-off and what measurement
+   flips it; a flag without a row is an untracked fork of behavior.
+2. **test reference** — every flag appears in at least one file under
+   ``tests/``: a flag nothing exercises is a flag whose disabled path
+   silently rots (the repo's test-pinned-disabled-path discipline).
+3. **hot-path latch** — configured hot-path methods (``Engine.step``,
+   ``CompiledTrainStep.__call__``/``run_steps``) must not RE-READ
+   flags per step: flags are latched at construction (the PR-9
+   convention) so a mid-run ``set_flags`` can never shear a compiled
+   step against its own state.
+
+Config (``[tool.ptlint.flag]``): ``flags_file``, ``baseline_md``,
+``tests_dir``, ``hot_paths`` (list of ``path::Class.method``).
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from .astutil import scope_statements
+from .base import Finding
+
+RULE = "flag"
+
+_DEFAULTS_CFG = {
+    "flags_file": "paddle_tpu/core/flags.py",
+    "baseline_md": "BASELINE.md",
+    "tests_dir": "tests",
+    "hot_paths": [
+        "paddle_tpu/serving/engine.py::Engine.step",
+        "paddle_tpu/parallel/engine.py::CompiledTrainStep.__call__",
+        "paddle_tpu/parallel/engine.py::CompiledTrainStep.run_steps",
+    ],
+}
+
+_ROW_RE = re.compile(r"^\|\s*`(FLAGS_\w+)", re.M)
+_READER_NAMES = {"flag", "_flag", "get_flags"}
+
+
+def _cfg(project, key):
+    return project.config.get("flag", {}).get(key, _DEFAULTS_CFG[key])
+
+
+def declared_flags(sf):
+    """{flag_name: lineno} from the _DEFAULTS dict literal."""
+    out = {}
+    if sf is None or sf.tree is None:
+        return out
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "_DEFAULTS"
+                for t in node.targets) and \
+                isinstance(node.value, ast.Dict):
+            for k in node.value.keys:
+                if isinstance(k, ast.Constant) and \
+                        isinstance(k.value, str):
+                    out[k.value] = k.lineno
+    return out
+
+
+def _tests_text(project, tests_dir):
+    chunks = []
+    top = os.path.join(project.root, tests_dir)
+    for dirpath, _dirnames, filenames in os.walk(top):
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                with open(os.path.join(dirpath, fn),
+                          encoding="utf-8") as f:
+                    chunks.append(f.read())
+    return "\n".join(chunks)
+
+
+def _is_flag_read(call):
+    """A runtime flag read: flag("FLAGS_x") / flags.flag(...) /
+    get_flags(...) in any aliasing."""
+    fn = call.func
+    name = fn.attr if isinstance(fn, ast.Attribute) else (
+        fn.id if isinstance(fn, ast.Name) else None)
+    if name not in _READER_NAMES:
+        return None
+    if name == "get_flags":
+        return "get_flags"
+    if call.args and isinstance(call.args[0], ast.Constant) and \
+            isinstance(call.args[0].value, str) and \
+            call.args[0].value.startswith("FLAGS_"):
+        return call.args[0].value
+    return None
+
+
+def _hot_path_findings(project):
+    out = []
+    for spec in _cfg(project, "hot_paths"):
+        path, _, target = spec.partition("::")
+        cls, _, meth = target.partition(".")
+        sf = project.file(path)
+        matched = False
+        for node in ast.walk(sf.tree) if (
+                sf is not None and sf.tree is not None) else ():
+            if not (isinstance(node, ast.ClassDef) and node.name == cls):
+                continue
+            for item in node.body:
+                if not (isinstance(item, ast.FunctionDef)
+                        and item.name == meth):
+                    continue
+                matched = True
+                seen = set()    # the flattened list nests: dedupe
+                n_reads = {}    # per-flag site counter: the symbol
+                # must be unique per site or one baseline entry
+                # grandfathers every future re-read of that flag
+                for st in scope_statements(item):
+                    for n in ast.walk(st):
+                        if not isinstance(n, ast.Call) or \
+                                id(n) in seen:
+                            continue
+                        seen.add(id(n))
+                        read = _is_flag_read(n)
+                        if read is None:
+                            continue
+                        if sf.suppressed(RULE, [n.lineno]):
+                            continue
+                        k = n_reads[read] = n_reads.get(read, 0) + 1
+                        out.append(Finding(
+                            RULE, path, n.lineno,
+                            "%s:%s#%d" % (target, read, k),
+                            "flag read %r inside hot-path %s.%s — "
+                            "latch it at construction (PR-9 "
+                            "convention); per-step re-reads let a "
+                            "mid-run set_flags shear the compiled "
+                            "step against its own state"
+                            % (read, cls, meth)))
+        if not matched:
+            # a spec that resolves to nothing is a gate that silently
+            # turned itself off — the rename that orphaned it must
+            # update [tool.ptlint.flag] hot_paths too
+            out.append(Finding(
+                RULE, path, 1, "hot-path-spec:%s" % spec,
+                "hot_paths spec %r matches no file/class/method — the "
+                "construction-latch gate is OFF for it; fix the spec "
+                "in [tool.ptlint.flag] (or the pass defaults) to "
+                "follow the rename" % spec))
+    return out
+
+
+def run_pass(project):
+    findings = []
+    flags_file = _cfg(project, "flags_file")
+    sf = project.file(flags_file)
+    flags = declared_flags(sf)
+    base_text = project.read(_cfg(project, "baseline_md")) or ""
+    rows = set(_ROW_RE.findall(base_text))
+    tests = _tests_text(project, _cfg(project, "tests_dir"))
+    for name, line in sorted(flags.items()):
+        if sf is not None and sf.suppressed(RULE, [line]):
+            continue
+        if name not in rows:
+            findings.append(Finding(
+                RULE, flags_file, line, "%s:disposition" % name,
+                "%s has no disposition row in %s — the flag table is "
+                "machine-checked contract: add a `| `%s` | ... |` row "
+                "stating default, why, and what measurement flips it"
+                % (name, _cfg(project, "baseline_md"), name)))
+        # word-boundary match: a bare substring test would let
+        # FLAGS_foo ride on FLAGS_foo_level's references
+        if not re.search(r"\b%s\b" % re.escape(name), tests):
+            findings.append(Finding(
+                RULE, flags_file, line, "%s:test" % name,
+                "%s is referenced by no file under %s/ — a flag "
+                "nothing exercises is a flag whose disabled path "
+                "silently rots" % (name, _cfg(project, "tests_dir"))))
+    findings.extend(_hot_path_findings(project))
+    return findings

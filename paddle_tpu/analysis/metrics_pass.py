@@ -1,0 +1,159 @@
+"""metric pass: one registry, literal family-prefixed documented names.
+
+The monitor registry is the repo's single telemetry aggregation point;
+its value decays one sloppy registration at a time. Four sub-checks on
+every ``counter()``/``gauge()``/``histogram()`` registration (resolved
+through the module's imports — ``_mcounter``, ``_registry.counter``,
+``_mreg.gauge`` all count; unrelated local helpers named ``counter``
+don't):
+
+1. **literal** — the metric name must be a string literal: a computed
+   name defeats grep, docs, dashboards, and THIS pass.
+2. **family** — the name matches one of the established family
+   prefixes (``serving_ | train_ | fleet_ | perf_ | comm_ | store_ |
+   faults_ | watchdog_``) or a config-allowed legacy name
+   (``[tool.ptlint.metric] allow``; trailing ``*`` = prefix) — new
+   subsystems extend the config deliberately, not by drift.
+3. **labels** — the same name must carry the same kind + labelnames at
+   every registration site (the runtime registry raises at import
+   ORDER's mercy; the pass catches the conflict before any import).
+4. **docs** — the name must appear in README.md or BASELINE.md: an
+   undocumented metric is invisible exactly when someone needs it.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from .astutil import const_str, import_aliases, keyword, resolve_call
+from .base import Finding
+
+RULE = "metric"
+
+_DEFAULT_FAMILIES = ["serving", "train", "fleet", "perf", "comm",
+                     "store", "faults", "watchdog"]
+_KINDS = ("counter", "gauge", "histogram")
+# import heads that denote the shared registry (post alias-flattening)
+_REGISTRY_HEADS = ("monitor", "registry", "paddle_tpu.monitor")
+
+
+def _cfg(project, key, default):
+    return project.config.get("metric", {}).get(key, default)
+
+
+def _is_registration(call, aliases):
+    name = resolve_call(call, aliases)
+    if not name:
+        return None
+    head, _, fn = name.rpartition(".")
+    if fn not in _KINDS:
+        return None
+    if head and (head in _REGISTRY_HEADS
+                 or head.endswith(".monitor")
+                 or head.endswith(".registry")):
+        return fn
+    return None
+
+
+def _name_arg(call):
+    if call.args:
+        return call.args[0], const_str(call.args[0])
+    kw = keyword(call, "name")
+    if kw is not None:
+        return kw, const_str(kw)
+    return None, None
+
+
+def _labelnames(call):
+    kw = keyword(call, "labelnames")
+    if kw is None:
+        return ()
+    try:
+        return tuple(ast.literal_eval(kw))
+    except (ValueError, SyntaxError):
+        return ("<dynamic>",)
+
+
+def _allowed(name, families, allow):
+    for fam in families:
+        if name.startswith(fam + "_"):
+            return True
+    for a in allow:
+        if a.endswith("*"):
+            if name.startswith(a[:-1]):
+                return True
+        elif name == a:
+            return True
+    return False
+
+
+def run_pass(project):
+    families = _cfg(project, "families", _DEFAULT_FAMILIES)
+    allow = _cfg(project, "allow", [])
+    docs = _cfg(project, "docs", ["README.md", "BASELINE.md"])
+    doc_text = "\n".join(project.read(d) or "" for d in docs)
+    registry = {}   # name -> (kind, labels, path, line)
+    flagged = set()  # (name, check): family/docs report once per name
+    findings = []
+    for sf in project.files:
+        tree = sf.tree
+        if tree is None:
+            continue
+        aliases = import_aliases(tree)
+        n = 0
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            kind = _is_registration(node, aliases)
+            if kind is None:
+                continue
+            n += 1
+            arg, name = _name_arg(node)
+            if name is None:
+                if not sf.suppressed(RULE, [node.lineno]):
+                    findings.append(Finding(
+                        RULE, sf.relpath, node.lineno,
+                        "registration#%d:literal" % n,
+                        "metric name must be a string literal — a "
+                        "computed name defeats grep, docs, and this "
+                        "pass"))
+                continue
+            suppressed = sf.suppressed(RULE, [node.lineno])
+            if not _allowed(name, families, allow) and not suppressed \
+                    and (name, "family") not in flagged:
+                flagged.add((name, "family"))
+                findings.append(Finding(
+                    RULE, sf.relpath, node.lineno,
+                    "%s:family" % name,
+                    "metric %r is outside the established families "
+                    "(%s) and not config-allowed — extend "
+                    "[tool.ptlint.metric] allow deliberately or "
+                    "rename into a family" % (
+                        name, "|".join("%s_" % f for f in families))))
+            labels = _labelnames(node)
+            prior = registry.get(name)
+            if prior is None:
+                registry[name] = (kind, labels, sf.relpath,
+                                  node.lineno)
+            elif prior[:2] != (kind, labels) and not suppressed:
+                findings.append(Finding(
+                    RULE, sf.relpath, node.lineno,
+                    "%s:labels" % name,
+                    "metric %r re-registered as %s%s but %s:%d "
+                    "registered it as %s%s — kind and label set must "
+                    "agree at every site" % (
+                        name, kind, list(labels), prior[2], prior[3],
+                        prior[0], list(prior[1]))))
+            # word-boundary: a substring test would let `train_steps`
+            # ride `train_steps_total`'s documentation
+            if not re.search(r"\b%s\b" % re.escape(name), doc_text) \
+                    and not suppressed \
+                    and (name, "docs") not in flagged:
+                flagged.add((name, "docs"))
+                findings.append(Finding(
+                    RULE, sf.relpath, node.lineno,
+                    "%s:docs" % name,
+                    "metric %r appears in neither %s — an "
+                    "undocumented metric is invisible exactly when "
+                    "someone needs it" % (name, " nor ".join(docs))))
+    return findings

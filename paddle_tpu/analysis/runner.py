@@ -1,0 +1,59 @@
+"""ptlint runner: pass registry, baseline application, entry point."""
+from __future__ import annotations
+
+from collections import Counter
+
+from . import clocks, flags_pass, metrics_pass, silent_except, \
+    threads, trace_purity
+from .base import Baseline
+
+# rule id -> pass. Order is report order; ids are the pragma grammar
+# (``# ptlint: <rule>-ok``) and the baseline/report vocabulary.
+RULES = {
+    flags_pass.RULE: flags_pass.run_pass,
+    trace_purity.RULE: trace_purity.run_pass,
+    clocks.RULE: clocks.run_pass,
+    threads.RULE: threads.run_pass,
+    metrics_pass.RULE: metrics_pass.run_pass,
+    silent_except.RULE: silent_except.run_pass,
+}
+
+# passes whose findings may be grandfathered in the baseline file;
+# clock, silent-except and metric violations must be FIXED (or
+# pragma'd with a reason) — the baseline refuses to carry them.
+BASELINE_ELIGIBLE = ("flag", "trace", "thread")
+
+
+def run(project, rules=None, baseline=None):
+    """Run the passes over ``project``.
+
+    Returns ``(findings, stale_baseline_entries, per_rule_counts)``.
+    ``baseline`` (a Baseline) marks matched findings grandfathered;
+    entries for non-eligible rules or with no surviving finding come
+    back as stale (both fail the gate)."""
+    findings = []
+    for rule, fn in RULES.items():
+        if rules is not None and rule not in rules:
+            continue
+        findings.extend(fn(project))
+    stale = []
+    if baseline is not None:
+        # Entries for passes that did not run this invocation cannot be
+        # judged stale — a --rules subset must not flag the other
+        # rules' legitimate debt as "paid".
+        ran = set(RULES) if rules is None else set(rules)
+        eligible = Baseline([e for e in baseline.entries
+                             if e.get("rule") in BASELINE_ELIGIBLE
+                             and e.get("rule") in ran])
+        stale = eligible.apply(findings)
+        stale.extend(e for e in baseline.entries
+                     if e.get("rule") in RULES
+                     and e.get("rule") not in BASELINE_ELIGIBLE
+                     and e.get("rule") in ran)
+        # an entry naming a rule no pass owns (typo, removed pass) can
+        # never match a finding — surfacing it on every run is the only
+        # way the "file only shrinks" contract can hold
+        stale.extend(e for e in baseline.entries
+                     if e.get("rule") not in RULES)
+    counts = Counter(f.rule for f in findings)
+    return findings, stale, dict(counts)

@@ -1,0 +1,66 @@
+"""silent-except pass: broad ``except Exception: pass`` is forbidden.
+
+A diagnostic thread that eats its own failures invisibly is the
+watchdog bug the watchdog cannot see: the collector keeps "running"
+while every scrape raises, the heartbeat loop dies without a word, the
+snapshot that recovery depends on silently never lands. The rule:
+
+- an ``except`` clause that catches broadly (bare, ``Exception``, or
+  ``BaseException`` — alone or in a tuple) AND whose body is a single
+  ``pass`` is a finding;
+- narrow catches (``except OSError: pass``) are fine — swallowing a
+  SPECIFIC expected failure is a decision, swallowing everything is
+  the absence of one;
+- fix by narrowing the exception + logging at least once, or keep the
+  swallow deliberately with ``# ptlint: silent-except-ok — reason``.
+"""
+from __future__ import annotations
+
+import ast
+
+from .base import Finding
+
+RULE = "silent-except"
+
+_BROAD = ("Exception", "BaseException")
+
+
+def _is_broad(type_node):
+    if type_node is None:
+        return True
+    if isinstance(type_node, ast.Name):
+        return type_node.id in _BROAD
+    if isinstance(type_node, ast.Attribute):
+        return type_node.attr in _BROAD
+    if isinstance(type_node, ast.Tuple):
+        return any(_is_broad(e) for e in type_node.elts)
+    return False
+
+
+def run_pass(project):
+    findings = []
+    for sf in project.files:
+        tree = sf.tree
+        if tree is None:
+            continue
+        n = 0
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _is_broad(node.type):
+                continue
+            if not (len(node.body) == 1
+                    and isinstance(node.body[0], ast.Pass)):
+                continue
+            n += 1
+            lines = [node.lineno, node.body[0].lineno]
+            if sf.suppressed(RULE, lines):
+                continue
+            findings.append(Finding(
+                RULE, sf.relpath, node.lineno,
+                "silent#%d" % n,
+                "broad except with a bare `pass` body swallows every "
+                "failure invisibly — narrow the exception and log "
+                "once, or pragma with the reason the swallow is "
+                "deliberate"))
+    return findings

@@ -1,0 +1,248 @@
+"""thread pass: daemon threads with stop paths and lock-guarded state.
+
+Every long-lived helper in this repo (watchdog, fleet collector,
+snapshot writer, elastic heartbeat) follows the same shape: a
+``threading.Thread(..., daemon=True)`` whose target loops on a stop
+signal, and whose shared state is touched under a lock. The pass
+mechanizes the three ways that shape decays:
+
+1. **daemon** — a spawned thread without ``daemon=True`` outlives the
+   interpreter's intent: a wedged helper turns process exit into a
+   hang (the exact failure class the watchdog exists to diagnose).
+2. **stop path** — a target that loops ``while True`` with no
+   ``break``/``return`` and no reference to a stop/exit signal (or a
+   blocking ``.wait(...)``) cannot be shut down; tests leak it.
+3. **shared state** — an attribute ASSIGNED in the thread target
+   outside any lock-ish ``with`` block, and also touched by other
+   methods of the same class, is a data race the GIL merely makes
+   rare (dict/list field updates on a shared row are out of scope —
+   the pass polices attribute rebinding, the pattern that tears).
+
+Resolution is module-local: ``target=self._run`` and ``target=fn``
+resolve; dynamic targets don't (pragma them).
+"""
+from __future__ import annotations
+
+import ast
+
+from .astutil import FuncIndex, dotted, import_aliases, keyword, \
+    resolve_call, scope_statements
+from .base import Finding
+
+RULE = "thread"
+
+_LOCKISH = ("lock", "cv", "cond", "mutex")
+
+
+def _is_lockish(expr):
+    name = dotted(expr if not isinstance(expr, ast.Call)
+                  else expr.func) or ""
+    low = name.lower()
+    return any(t in low for t in _LOCKISH)
+
+
+def _under_lock(node, with_stack):
+    return any(_is_lockish(item.context_expr)
+               for w in with_stack for item in w.items)
+
+
+def _walk_attrs(fn, match):
+    """[(attr_name, lineno, locked)] for every node ``match`` selects
+    in ``fn``, tracking enclosing ``with <lock>`` blocks and skipping
+    nested function/class scopes. ``match(node)`` yields the attribute
+    names the node contributes — the single traversal both attr
+    visitors share, so lock-context rules can't silently diverge."""
+    out = []
+
+    def visit(node, with_stack):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef, ast.Lambda,
+                                  ast.ClassDef)):
+                continue
+            stack = with_stack
+            if isinstance(child, ast.With):
+                stack = with_stack + [child]
+            for attr in match(child):
+                out.append((attr, child.lineno,
+                            _under_lock(child, stack)))
+            visit(child, stack)
+
+    visit(fn, [])
+    return out
+
+
+def _attr_stores(fn, only_self=True):
+    """[(attr_name, lineno, locked)] for self.X = ... in ``fn``."""
+    def match(child):
+        if isinstance(child, (ast.Assign, ast.AugAssign)):
+            targets = child.targets if isinstance(
+                child, ast.Assign) else [child.target]
+            for t in targets:
+                if isinstance(t, ast.Attribute) and \
+                        isinstance(t.value, ast.Name) and \
+                        (not only_self or t.value.id == "self"):
+                    yield t.attr
+
+    return _walk_attrs(fn, match)
+
+
+def _attr_touches(fn, attr):
+    """Lines where self.<attr> is loaded or stored in ``fn``, with
+    lock context."""
+    def match(child):
+        if isinstance(child, ast.Attribute) and \
+                child.attr == attr and \
+                isinstance(child.value, ast.Name) and \
+                child.value.id == "self":
+            yield attr
+
+    return [(ln, locked)
+            for _, ln, locked in _walk_attrs(fn, match)]
+
+
+_STOPISH = ("stop", "stopped", "stopping", "shutdown", "exit",
+            "done", "closed", "quit")
+
+
+def _consults_stop(loop):
+    """True if the loop CONSULTS a stop-ish signal — in an if/while
+    test or a called name, places that can gate or raise. A mere
+    assignment (``tasks_done = 1``) is not a stop path."""
+    names = set()
+    for n in ast.walk(loop):
+        if isinstance(n, (ast.While, ast.If)):
+            for x in ast.walk(n.test):
+                if isinstance(x, ast.Attribute):
+                    names.add(x.attr.lower())
+                elif isinstance(x, ast.Name):
+                    names.add(x.id.lower())
+        elif isinstance(n, ast.Call):
+            for x in ast.walk(n.func):
+                if isinstance(x, ast.Attribute):
+                    names.add(x.attr.lower())
+                elif isinstance(x, ast.Name):
+                    names.add(x.id.lower())
+    tokens = set()
+    for s in names:
+        tokens.update(s.split("_"))
+    return bool(tokens & set(_STOPISH))
+
+
+def _has_stop_path(fn):
+    """A loop with an exit: no while-True, or break/return inside it,
+    or a consulted stop/exit-ish signal, or a blocking .wait()."""
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Call) and \
+                isinstance(n.func, ast.Attribute) and \
+                n.func.attr == "wait":
+            return True
+    for n in ast.walk(fn):
+        if isinstance(n, ast.While) and \
+                isinstance(n.test, ast.Constant) and n.test.value:
+            if not any(isinstance(x, (ast.Break, ast.Return))
+                       for x in ast.walk(n)) and not _consults_stop(n):
+                return False
+    return True
+
+
+def _resolve_target(node, index, cls_name):
+    """Thread target expr -> (FunctionDef, is_method) or (None, _)."""
+    if isinstance(node, ast.Name):
+        for d in index.defs.get(node.id, ()):
+            return d, index.enclosing_class(d) is not None
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and \
+            node.value.id == "self" and cls_name:
+        meth = index.methods.get(cls_name, {}).get(node.attr)
+        if meth is not None:
+            return meth, True
+    return None, False
+
+
+def run_pass(project):
+    findings = []
+    for sf in project.files:
+        tree = sf.tree
+        if tree is None:
+            continue
+        aliases = import_aliases(tree)
+        # `import threading` maps to "threading", `from threading
+        # import Thread` to "threading.Thread" — gate on either or the
+        # from-import style skips the whole file.
+        if not any(v == "threading" or v.startswith("threading.")
+                   for v in aliases.values()):
+            continue
+        index = FuncIndex(tree)
+        n = 0
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call) and
+                    resolve_call(node, aliases) in
+                    ("threading.Thread", "Thread")):
+                continue
+            n += 1
+            # which class does this spawn site live in (for self._run)?
+            cls_name = None
+            for cname, methods in index.methods.items():
+                for m in methods.values():
+                    if node.lineno >= m.lineno and \
+                            node.lineno <= (m.end_lineno or m.lineno):
+                        cls_name = cname
+            daemon = keyword(node, "daemon")
+            if not (isinstance(daemon, ast.Constant)
+                    and daemon.value is True):
+                if not sf.suppressed(RULE, [node.lineno]):
+                    findings.append(Finding(
+                        RULE, sf.relpath, node.lineno,
+                        "spawn#%d:daemon" % n,
+                        "threading.Thread without daemon=True — a "
+                        "wedged helper must never turn process exit "
+                        "into a hang"))
+            target, is_method = _resolve_target(
+                keyword(node, "target"), index, cls_name)
+            if target is None:
+                continue
+            if not _has_stop_path(target):
+                if not sf.suppressed(RULE, [node.lineno,
+                                            target.lineno]):
+                    findings.append(Finding(
+                        RULE, sf.relpath, target.lineno,
+                        "%s:stop-path" % target.name,
+                        "thread target %r loops forever with no "
+                        "reachable stop path (no break/return, no "
+                        "stop/shutdown signal, no blocking wait)"
+                        % target.name))
+            if is_method:
+                findings.extend(
+                    _shared_state_findings(sf, index, target))
+    return findings
+
+
+def _shared_state_findings(sf, index, target):
+    out = []
+    cls = index.enclosing_class(target)
+    if cls is None:
+        return out
+    peers = [m for name, m in index.methods.get(cls, {}).items()
+             if m is not target]
+    for attr, line, locked in _attr_stores(target):
+        if locked or attr.startswith("__"):
+            continue
+        # only attrs OTHER methods also touch are shared state; a
+        # thread-private attr is the target's own business
+        shared = [(m, ln, lk) for m in peers
+                  for ln, lk in _attr_touches(m, attr)]
+        if not shared:
+            continue
+        if sf.suppressed(RULE, [line]):
+            continue
+        qual = index.qualname.get(id(target), target.name)
+        out.append(Finding(
+            RULE, sf.relpath, line,
+            "%s:shared:%s" % (qual, attr),
+            "attribute %r is rebound in thread target %s outside a "
+            "lock but also touched by %s — guard both sides with the "
+            "owning lock (or pragma with the reason it is safe)"
+            % (attr, qual,
+               ", ".join(sorted({m.name for m, _, _ in shared})))))
+    return out

@@ -1,0 +1,169 @@
+"""trace pass: functions reachable from jit/shard_map stay host-pure.
+
+The compile-once discipline (and the whole-program-compilation story
+the paper stack rests on) dies quietly when a traced function touches
+host state: a ``time.time()`` or ``print`` executes at TRACE time and
+silently freezes into the graph (or retraces), ``random``/``np.random``
+bakes one host sample into every step, and ``.item()``/``float(x)``
+forces a device sync that serializes the step. A test can only sample
+this; the pass proves it over the tree.
+
+Mechanics: roots are the callables handed to ``jax.jit``/``pjit``/
+``shard_map`` (first positional arg, module-locally resolved by name —
+including defs nested inside the jit-calling function, the repo's
+dominant idiom) plus defs decorated with them. Reachability is a
+module-local, name-resolved BFS over direct calls; ``self.*`` and
+cross-module calls are deliberately out of scope (pragma/baseline
+carry the residue — precision over soundness).
+"""
+from __future__ import annotations
+
+import ast
+
+from .astutil import FuncIndex, import_aliases, resolve_call, \
+    scope_statements
+from .base import Finding
+
+RULE = "trace"
+
+# callables whose first argument becomes traced code
+_JIT_HEADS = {"jax.jit", "jit", "pjit", "jax.pjit", "shard_map",
+              "_shard_map", "shard_map.shard_map",
+              "collective.shard_map", "jax.experimental.pjit.pjit"}
+
+# canonical call names that are host-impure inside a traced function
+_BANNED_EXACT = {
+    "time.time": "host clock read freezes into the trace",
+    "time.monotonic": "host clock read freezes into the trace",
+    "time.perf_counter": "host clock read freezes into the trace",
+    "time.sleep": "host sleep executes at trace time only",
+    "print": "prints at trace time, never per step "
+             "(use jax.debug.print)",
+}
+_BANNED_PREFIX = {
+    "random.": "host RNG bakes one sample into the compiled step "
+               "(use jax.random with a threaded key)",
+    "numpy.random.": "host RNG bakes one sample into the compiled "
+                     "step (use jax.random with a threaded key)",
+}
+_SYNC_METHODS = {"item"}
+
+
+def _jit_roots(tree, aliases, index):
+    """Def nodes handed to jit/shard_map (or so-decorated)."""
+    roots = {}
+
+    def note(node, why):
+        if isinstance(node, ast.Name):
+            for d in index.defs.get(node.id, ()):
+                roots.setdefault(id(d), (d, why))
+        elif isinstance(node, ast.Lambda):
+            roots.setdefault(id(node), (node, why))
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            name = resolve_call(node, aliases)
+            if name in _JIT_HEADS and node.args:
+                note(node.args[0], name)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                if resolve_call(ast.Call(func=target, args=[],
+                                         keywords=[]),
+                                aliases) in _JIT_HEADS:
+                    roots.setdefault(id(node), (node, "decorator"))
+    return list(roots.values())
+
+
+def _reachable(root, index):
+    """BFS over module-locally resolvable direct calls."""
+    seen = {}
+    queue = [(root, None)]
+    while queue:
+        node, via = queue.pop()
+        if id(node) in seen:
+            continue
+        seen[id(node)] = (node, via)
+        body = node.body if not isinstance(node, ast.Lambda) \
+            else [ast.Expr(value=node.body)]
+        for st in body:
+            for n in ast.walk(st):
+                if isinstance(n, ast.Call) and \
+                        isinstance(n.func, ast.Name):
+                    for d in index.defs.get(n.func.id, ()):
+                        queue.append((d, node))
+    return [v for v in seen.values()]
+
+
+def _scan_fn(sf, fn, qual, root_name, aliases):
+    out = []
+    n = 0
+    seen = set()    # the flattened statement list nests: dedupe
+    body = scope_statements(fn) if not isinstance(fn, ast.Lambda) \
+        else [fn.body]
+    for st in body:
+        for node in ast.walk(st):
+            if not isinstance(node, ast.Call) or id(node) in seen:
+                continue
+            seen.add(id(node))
+            name = resolve_call(node, aliases)
+            why = None
+            what = name
+            if name in _BANNED_EXACT:
+                why = _BANNED_EXACT[name]
+            elif name:
+                for pfx, msg in _BANNED_PREFIX.items():
+                    if name.startswith(pfx):
+                        why = msg
+                        break
+            if why is None and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _SYNC_METHODS \
+                    and not node.args:
+                what = ".%s()" % node.func.attr
+                why = "forces a device->host sync inside the " \
+                      "traced step"
+            if why is None and isinstance(node.func, ast.Name) \
+                    and node.func.id == "float" and node.args \
+                    and not isinstance(node.args[0], ast.Constant):
+                what = "float(...)"
+                why = "forces a device->host sync when the argument " \
+                      "is a tracer"
+            if why is None:
+                continue
+            if sf.suppressed(RULE, [node.lineno]):
+                continue
+            n += 1
+            out.append(Finding(
+                RULE, sf.relpath, node.lineno,
+                "%s:%s#%d" % (qual, what, n),
+                "host-impure call %s inside %r (traced: reachable "
+                "from %s): %s" % (what, qual, root_name, why)))
+    return out
+
+
+def run_pass(project):
+    findings = []
+    for sf in project.files:
+        tree = sf.tree
+        if tree is None:
+            continue
+        aliases = import_aliases(tree)
+        index = FuncIndex(tree)
+        roots = _jit_roots(tree, aliases, index)
+        if not roots:
+            continue
+        seen_fn = set()
+        for root, why in roots:
+            for fn, _via in _reachable(root, index):
+                if id(fn) in seen_fn:
+                    continue
+                seen_fn.add(id(fn))
+                qual = index.qualname.get(id(fn),
+                                          getattr(fn, "name",
+                                                  "<lambda>"))
+                root_qual = index.qualname.get(
+                    id(root), getattr(root, "name", "<lambda>"))
+                findings.extend(_scan_fn(sf, fn, qual,
+                                         "%s via %s" % (root_qual, why),
+                                         aliases))
+    return findings

@@ -125,5 +125,7 @@ class NativeInterpreter:
     def __del__(self):
         try:
             self.close()
+        # ptlint: silent-except-ok — __del__ at interpreter-GC time
+        # must never raise (native lib may already be unloaded)
         except Exception:
             pass

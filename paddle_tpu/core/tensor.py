@@ -73,6 +73,8 @@ class Tensor:
                 if d.platform == "cpu":
                     return _place.CPUPlace()
                 return _place.TPUPlace(d.id)
+            # ptlint: silent-except-ok — best-effort device probe on a
+            # possibly-deleted buffer; falls back to the current place
             except Exception:
                 pass
         return _place._get_current_place()

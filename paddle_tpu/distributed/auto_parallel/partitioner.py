@@ -104,6 +104,8 @@ class Resharder:
             if cur is not None:
                 same_devices = set(cur.device_set) <= set(
                     dst_mesh.devices.flat)
+        # ptlint: silent-except-ok — sharding introspection is
+        # best-effort; the fallback is the conservative host bounce
         except Exception:
             pass
         if not same_devices:

@@ -148,5 +148,11 @@ class ElasticManager:
         if self.enable:
             try:
                 self.store.delete(self._beat_key(self.rank))
-            except Exception:
-                pass
+            except Exception as e:
+                from ..monitor.registry import warn_once
+
+                warn_once(
+                    "elastic.beat_cleanup",
+                    "paddle_tpu.distributed.elastic: heartbeat key "
+                    "cleanup failed on exit (peers will age it out): "
+                    "%r" % (e,))

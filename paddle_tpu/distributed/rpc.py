@@ -101,6 +101,8 @@ class _RpcAgent:
                 # of silently dropping the connection
                 _send_frame(conn, (False, RuntimeError(
                     "rpc: result not picklable: %r" % (result[1],))))
+        # ptlint: silent-except-ok — client hung up mid-reply; the
+        # diagnostic frame above was already attempted
         except Exception:
             pass
         finally:

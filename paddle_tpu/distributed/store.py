@@ -335,6 +335,8 @@ class TCPStore:
     def __del__(self):
         try:
             self.close()
+        # ptlint: silent-except-ok — __del__ at store-GC time must
+        # never raise (socket may already be torn down)
         except Exception:
             pass
 

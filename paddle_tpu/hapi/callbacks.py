@@ -90,7 +90,7 @@ class ProgBarLogger(Callback):
     def on_epoch_begin(self, epoch, logs=None):
         self.epoch = epoch
         self.steps = (self.params or {}).get("steps")
-        self._t0 = time.time()
+        self._t0 = time.monotonic()
         if self.verbose:
             print("Epoch %d/%d" % (epoch + 1,
                                    (self.params or {}).get("epochs", 1)))
@@ -110,7 +110,7 @@ class ProgBarLogger(Callback):
                 "%s: %.4f" % (k, float(v)) for k, v in (logs or {}).items()
                 if not hasattr(v, "__len__"))
             print("  epoch %d done in %.1fs - %s"
-                  % (epoch + 1, time.time() - self._t0, items))
+                  % (epoch + 1, time.monotonic() - self._t0, items))
 
     def on_eval_end(self, logs=None):
         if self.verbose:

@@ -51,5 +51,7 @@ def _apply():
         try:  # persistent compilation cache = cross-process kernel reuse
             jax.config.update("jax_compilation_cache_dir",
                               "/tmp/paddle_tpu_xla_cache")
+        # ptlint: silent-except-ok — older jax without the
+        # compilation-cache config key; tuning stays best-effort
         except Exception:
             pass

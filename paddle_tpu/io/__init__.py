@@ -425,6 +425,9 @@ def _shm_pack(batch):
                 from multiprocessing import resource_tracker
 
                 resource_tracker.unregister(shm._name, "shared_memory")
+            # ptlint: silent-except-ok — private resource_tracker API
+            # varies across py versions; worst case is a benign unlink
+            # race warning at worker exit
             except Exception:
                 pass
             return ("__shm__", shm.name, x.dtype.str, x.shape)
@@ -622,6 +625,8 @@ class _MPIterator:
                 break
             try:
                 _shm_unpack(payload)
+            # ptlint: silent-except-ok — draining orphaned shm results
+            # at shutdown; the segment may already be unlinked
             except Exception:
                 pass
 

@@ -108,6 +108,8 @@ class DataFeed:
     def __del__(self):
         try:
             self.close()
+        # ptlint: silent-except-ok — __del__ at feed-GC time must
+        # never raise (native lib may already be unloaded)
         except Exception:
             pass
 

@@ -121,5 +121,7 @@ class HostBufferPool:
     def __del__(self):
         try:
             self.close()
+        # ptlint: silent-except-ok — __del__ at pool-GC time must
+        # never raise (buffers may already be freed)
         except Exception:
             pass

@@ -70,6 +70,8 @@ def export_with_dynamic_dims(pure_fn, specs, leading_args=()):
                     jex.symbolic_shape(",".join(dims)), jdt))
                 any_sym = True
                 continue
+            # ptlint: silent-except-ok — symbolic shapes are
+            # opportunistic; the concrete-dim fallback is right below
             except Exception:
                 pass
         in_args.append(jax.ShapeDtypeStruct(

@@ -57,6 +57,7 @@ processes can load it without an accelerator backend.
 """
 from __future__ import annotations
 
+import http.client
 import json
 import os
 import threading
@@ -199,8 +200,11 @@ def announce(store=None, rank=None, world_size=None, job=None, port=0):
         try:
             _RANK_INFO.labels(job=job or "rank", rank=rank,
                               host=_local_host()).set(os.getpid())
-        except Exception:
-            pass
+        except Exception as e:
+            _registry.warn_once(
+                "fleet.rank_info",
+                "paddle_tpu.monitor.fleet: rank-info gauge failed "
+                "(identity labels missing from fleet view): %r" % (e,))
     return url
 
 
@@ -222,8 +226,12 @@ def note_identity(job):
         rank = pg.rank if pg is not None else 0
         _RANK_INFO.labels(job=job, rank=rank,
                           host=_local_host()).set(os.getpid())
-    except Exception:
-        pass
+    except Exception as e:
+        _registry.warn_once(
+            "fleet.note_identity",
+            "paddle_tpu.monitor.fleet: identity labeling failed "
+            "(fused view loses job attribution for this rank): "
+            "%r" % (e,))
 
 
 def maybe_announce_and_collect(pg):
@@ -243,17 +251,23 @@ def maybe_announce_and_collect(pg):
 # -- scraping ----------------------------------------------------------------
 
 def _http_json(url, timeout_s):
-    """(payload, t0, t1) — wall stamps around the exchange feed the
-    NTP-style offset estimate. Raises on transport errors; HTTP error
-    codes with a JSON body (healthz 503) still parse."""
-    t0 = time.time()
+    """(payload, t0, t1, rtt_s) — the WALL stamps around the exchange
+    feed the NTP-style offset estimate (the one legitimate wall-clock
+    use here: comparing the peer's self-reported unix_time against our
+    own wall midpoint); the round-trip DURATION is measured on the
+    monotonic clock, because an NTP step mid-exchange must not produce
+    a negative or kilometric RTT. Raises on transport errors; HTTP
+    error codes with a JSON body (healthz 503) still parse."""
+    t0 = time.time()    # ptlint: clock-ok — NTP-style offset probe
+    m0 = time.monotonic()
     try:
         with urllib.request.urlopen(url, timeout=timeout_s) as r:
             body = r.read()
     except urllib.error.HTTPError as e:
         body = e.read()
-    t1 = time.time()
-    return json.loads(body.decode()), t0, t1
+    t1 = time.time()    # ptlint: clock-ok — NTP-style offset probe
+    rtt_s = max(time.monotonic() - m0, 0.0)
+    return json.loads(body.decode()), t0, t1, rtt_s
 
 
 def fuse_snapshots(metrics_by_rank):
@@ -400,8 +414,11 @@ class FleetCollector:
         if out:
             try:
                 write_snapshot_artifact(out, collector=self)
-            except Exception:
-                pass
+            except Exception as e:
+                _registry.warn_once(
+                    "fleet.snapshot_artifact",
+                    "paddle_tpu.monitor.fleet: final snapshot "
+                    "artifact write failed (%s): %r" % (out, e))
 
     def is_running(self):
         return self._thread is not None and self._thread.is_alive()
@@ -410,8 +427,14 @@ class FleetCollector:
         while not self._stop.wait(self.interval_s):
             try:
                 self.scrape_once()
-            except Exception:
-                pass
+            except Exception as e:
+                # the collector eating its own scrape failures is the
+                # exact watchdog-blind-spot this repo lints against:
+                # say it once, keep the loop alive
+                _registry.warn_once(
+                    "fleet.scrape_loop",
+                    "paddle_tpu.monitor.fleet: scrape round failed "
+                    "(collector still running): %r" % (e,))
 
     # -- one scrape round --------------------------------------------------
 
@@ -437,34 +460,38 @@ class FleetCollector:
         """One rank's scrape: /metrics.json + /debugz/perf + /healthz,
         with the HTTP exchange doubling as the NTP-style clock probe
         (rank-reported unix_time vs the local request midpoint; the
-        min-RTT sample wins, the PR-2 trace_merge discipline)."""
-        snap, t0, t1 = _http_json(url + "/metrics.json",
-                                  self.http_timeout_s)
-        rtt = max(t1 - t0, 0.0)
+        min-RTT sample wins, the PR-2 trace_merge discipline).
+        ``scraped_at`` is a MONOTONIC stamp: every consumer subtracts
+        it (freshness ages, progress windows) and a wall step must not
+        fake or mask staleness."""
+        snap, t0, t1, rtt = _http_json(url + "/metrics.json",
+                                       self.http_timeout_s)
         offset = None
         if isinstance(snap.get("unix_time"), (int, float)):
             offset = float(snap["unix_time"]) - (t0 + t1) / 2.0
-        perf, _, _ = _http_json(url + "/debugz/perf",
-                                self.http_timeout_s)
-        healthz, _, _ = _http_json(url + "/healthz",
+        perf, _, _, _ = _http_json(url + "/debugz/perf",
                                    self.http_timeout_s)
+        healthz, _, _, _ = _http_json(url + "/healthz",
+                                      self.http_timeout_s)
         # flight-recorder seq watermark (best-effort): the second skew
         # signal next to train_steps_total — which COLLECTIVE stream is
-        # behind, not just which optimizer loop
+        # behind, not just which optimizer loop. Narrow catch: an
+        # unreachable or non-JSON /debugz/flight simply leaves the
+        # watermark None this round.
         flight_seq = None
         try:
-            flight, _, _ = _http_json(url + "/debugz/flight",
-                                      self.http_timeout_s)
+            flight, _, _, _ = _http_json(url + "/debugz/flight",
+                                         self.http_timeout_s)
             if isinstance(flight.get("next_seq"), (int, float)):
                 flight_seq = int(flight["next_seq"])
-        except Exception:
+        except (OSError, ValueError, http.client.HTTPException):
             pass
         return {"metrics": snap.get("metrics") or {},
                 "snapshot_time": snap.get("unix_time"),
                 "perf": perf, "healthz": healthz,
                 "flight_seq": flight_seq,
                 "rtt_s": rtt, "clock_offset_s": offset,
-                "scraped_at": t1}
+                "scraped_at": time.monotonic()}
 
     @staticmethod
     def _metric_value(mets, name, kind="sum"):
@@ -739,8 +766,10 @@ class FleetCollector:
         one capture for the oldest pending trigger, with any later
         ones folded into its detail under ``also`` — distinct
         incidents keep their reason/detail attribution in the
-        manifest. ``reason=None`` = flush-pending only."""
-        now = time.time()
+        manifest. ``reason=None`` = flush-pending only. The cooldown
+        interval is measured on the monotonic clock — an NTP step must
+        neither extend nor collapse it."""
+        now = time.monotonic()
         if reason is not None:
             self._pending_captures.append((reason, detail or {}))
         if not self._pending_captures:
@@ -760,7 +789,12 @@ class FleetCollector:
         self._last_capture_at = now
         try:
             return self.capture(reason, detail)
-        except Exception:
+        except Exception as e:
+            _registry.warn_once(
+                "fleet.capture",
+                "paddle_tpu.monitor.fleet: anomaly capture failed "
+                "(trigger %r consumed, no capture dir written): %r"
+                % (reason, e))
             return None
 
     def capture(self, reason="manual", detail=None):
@@ -785,7 +819,7 @@ class FleetCollector:
             for route, stem in (("debugz/bundle", "bundle"),
                                 ("debugz/trace/journal", "journal")):
                 try:
-                    payload, _, _ = _http_json(
+                    payload, _, _, _ = _http_json(
                         "%s/%s" % (url, route), self.http_timeout_s)
                 except Exception as e:
                     payload = {"error": repr(e), "rank": rank,
@@ -842,8 +876,9 @@ class FleetCollector:
 
     def ranks_table(self):
         """Per-rank table rows (the /debugz/fleet/ranks body and the
-        fleet_top columns), sorted by rank."""
-        now = time.time()
+        fleet_top columns), sorted by rank. Freshness ages subtract
+        monotonic stamps (``scraped_at`` is monotonic)."""
+        now = time.monotonic()
         rows = []
         for r, st in self._rank_items():
             rows.append({k: st.get(k) for k in (

@@ -334,8 +334,12 @@ def gather_from_store(store, world_size, grace_s=5.0, prefix=None,
                 try:
                     payload = json.loads(data.decode())
                     dumped_at = payload.get("dumped_at")
+                    # ptlint: clock-ok — cross-rank freshness has only
+                    # the shared wall clock; the window is coarse
+                    # (seconds) so an NTP step degrades, not breaks it
+                    now_wall = time.time()
                     if dumped_at is not None and \
-                            time.time() - dumped_at > fresh_within_s:
+                            now_wall - dumped_at > fresh_within_s:
                         continue    # stale: a previous incident's dump
                     buffers[r] = payload["entries"]
                 except Exception:

@@ -164,6 +164,8 @@ def executable_analysis(compiled, steps=1):
             ba = float(d.get("bytes accessed", 0.0))
             if ba > 0:
                 out["bytes_accessed_per_step"] = ba / steps
+    # ptlint: silent-except-ok — cost_analysis is a backend-optional
+    # introspection API; absent fields are the documented contract
     except Exception:
         pass
     try:
@@ -184,6 +186,8 @@ def executable_analysis(compiled, steps=1):
             peak = arg + tmp + outb - alias
             out["hbm_peak_is_estimate"] = True
         out["hbm_peak_bytes"] = int(peak)
+    # ptlint: silent-except-ok — memory_analysis is a backend-optional
+    # introspection API; absent fields are the documented contract
     except Exception:
         pass
     return out
@@ -281,6 +285,8 @@ class TrainStepPerf:
                 if nbytes > 0:
                     return (nbytes / self.machine["ici_bw"], int(nbytes),
                             "analytic")
+        # ptlint: silent-except-ok — absent/odd comm metric degrades
+        # the overlap attribution to "none", which is the fallback row
         except Exception:
             pass
         return 0.0, 0, "none"
@@ -558,14 +564,20 @@ def _fire(sentinel, name, ts, value, detail):
             del _state.events[:len(_state.events) - _EVENTS_CAP]
     try:
         _ANOMALIES.labels(kind=kind).inc()
-    except Exception:
-        pass
+    except Exception as e:
+        _registry.warn_once(
+            "perf.anomaly_counter",
+            "paddle_tpu.monitor.perf: anomaly counter increment "
+            "failed (event ring still recorded it): %r" % (e,))
     try:
         get_flight_recorder().note_event(
             "perf_anomaly", anomaly_kind=kind, series=name,
             value=repr(value), detail=detail)
-    except Exception:
-        pass
+    except Exception as e:
+        _registry.warn_once(
+            "perf.anomaly_flight_note",
+            "paddle_tpu.monitor.perf: flight-recorder anomaly note "
+            "failed: %r" % (e,))
 
 
 def _dispatch(name, ts, value):
@@ -578,8 +590,14 @@ def _dispatch(name, ts, value):
                 detail = s.observe(name, ts, value)
                 if detail is not None:
                     _fire(s, name, ts, value, detail)
-        except Exception:
-            pass
+        except Exception as e:
+            # must never raise (inline on the metric hot path), but a
+            # sentinel dying forever deserves one line
+            _registry.warn_once(
+                "perf.sentinel.%s" % type(s).__name__,
+                "paddle_tpu.monitor.perf: sentinel %s raised while "
+                "observing %r (sentinel stays enabled): %r"
+                % (type(s).__name__, name, e))
 
 
 def enable_sentinels(sentinels=None):

@@ -54,6 +54,31 @@ class _State:
 
 _state = _State()
 
+# -- warn-once (the silent-except replacement) -------------------------------
+# Diagnostic threads must not eat their own failures invisibly (ptlint
+# silent-except discipline), but a collector hitting the same transient
+# error every 2s scrape must not flood stderr either: one line per key.
+_warned = set()
+_warned_lock = threading.Lock()
+
+
+def warn_once(key, msg):
+    """Write ``msg`` to stderr the FIRST time ``key`` is seen."""
+    with _warned_lock:
+        if key in _warned:
+            return False
+        _warned.add(key)
+    import sys
+
+    try:
+        sys.stderr.write(msg.rstrip("\n") + "\n")
+    # ptlint: silent-except-ok — warn_once is the sink every never-raise
+    # diagnostic path drains into; a closed/replaced/None stderr (pytest
+    # capsys teardown, interpreter shutdown) must not re-raise there
+    except Exception:
+        pass
+    return True
+
 
 def enable(trace_bridge=None):
     """Turn metric collection on (process-wide). ``trace_bridge=True``

@@ -123,8 +123,13 @@ def record(name, value, ts=None):
     for fn in list(_state.listeners):
         try:
             fn(name, ts, value)
-        except Exception:
-            pass
+        except Exception as e:
+            _registry.warn_once(
+                "timeseries.listener.%s" % getattr(
+                    fn, "__name__", repr(fn)),
+                "paddle_tpu.monitor.timeseries: listener %r raised "
+                "on %r (listener stays attached): %r"
+                % (getattr(fn, "__name__", fn), name, e))
 
 
 def enable(capacity=None):

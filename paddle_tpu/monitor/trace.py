@@ -568,6 +568,8 @@ def chrome_events_from_journal(journal, clock="wall"):
         if "wall" in anchor and "monotonic" in anchor:
             shift = anchor["monotonic"] - anchor["wall"]
     evs = []
+    # ptlint: clock-ok — journal spans are wall-stamped by format (the
+    # clock anchor converts to monotonic); export math mirrors that
     end = journal.get("clock_anchor", {}).get("wall", time.time())
     for tid, tr in sorted((journal.get("traces") or {}).items()):
         pid = tr.get("name") or "trace"

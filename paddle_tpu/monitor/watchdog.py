@@ -444,8 +444,9 @@ def read_lease_stamps(store, world_size):
         if data is not None:
             try:
                 t = float(json.loads(data.decode())["t"])
-            except Exception:
-                pass
+            except (ValueError, KeyError, TypeError,
+                    UnicodeDecodeError):
+                pass    # malformed stamp reads as "no heartbeat"
         stamps[r] = t
     return stamps
 
@@ -477,8 +478,11 @@ def gather_bundles(store, world_size, grace_s=None, expect_nonce=None,
         if on_poll is not None:
             try:
                 on_poll()
-            except Exception:
-                pass
+            except Exception as e:
+                _registry.warn_once(
+                    "watchdog.on_poll",
+                    "paddle_tpu.monitor.watchdog: on_poll callback "
+                    "raised during bundle gather: %r" % (e,))
         for r in sorted(pending):
             left = deadline - time.monotonic()
             data = store.get("%s/bundle/rank%d" % (_WD_PREFIX, r),
@@ -717,8 +721,11 @@ def _on_stall(stalls):
     sys.stderr.write("\n".join(lines) + "\n")
     try:
         _STALLS_TOTAL.inc()
-    except Exception:
-        pass
+    except Exception as e:
+        _registry.warn_once(
+            "watchdog.stalls_counter",
+            "paddle_tpu.monitor.watchdog: stall counter increment "
+            "failed (stall was still reported above): %r" % (e,))
     if _state.action == "recover" and _stall_actions:
         for fn in list(_stall_actions):
             try:
@@ -780,8 +787,12 @@ def _tick():
                 _publish_bundle(pg.store, rank,
                                 build_bundle("request"),
                                 answering=req.get("t"))
-        except Exception:
-            pass
+        except Exception as e:
+            _registry.warn_once(
+                "watchdog.respond",
+                "paddle_tpu.monitor.watchdog: cross-rank bundle "
+                "response failed (postmortem will miss this rank's "
+                "stacks): %r" % (e,))
     _write_healthz_artifact()
     stalls = _find_stalls(now)
     live_keys = set()
@@ -804,8 +815,14 @@ def _run(stop_event, poll_s):
     while not stop_event.wait(poll_s):
         try:
             _tick()
-        except Exception:
-            pass
+        except Exception as e:
+            # the watchdog eating its own tick failures is the exact
+            # blind spot it exists to diagnose: say it once, keep
+            # ticking
+            _registry.warn_once(
+                "watchdog.tick",
+                "paddle_tpu.monitor.watchdog: tick failed (watchdog "
+                "still polling): %r" % (e,))
 
 
 def start_watchdog(stall_threshold_s=None, poll_interval_s=None,

@@ -91,8 +91,14 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
             from ...kernels.flash_attention import flash_attention as _fa
 
             return _fa(q, k, v, causal=is_causal, scale=scale)
-        except Exception:
-            pass
+        except Exception as e:
+            from ...monitor.registry import warn_once
+
+            warn_once(
+                "attention.flash_fallback",
+                "paddle_tpu.nn.functional: flash_attention path "
+                "unavailable, using reference SDPA (slower): "
+                "%r" % (e,))
     return _sdpa_reference(q, k, v, mask=attn_mask, dropout_p=dropout_p,
                            causal=is_causal, scale=scale)
 

@@ -71,6 +71,8 @@ def embedding(x, weight, padding_idx=None, sparse=False):
         mesh = _mesh_mod._global_mesh
         sharded_weight = mesh is not None and any(
             mesh.shape.get(a, 1) > 1 for a in ("mp", "sharding"))
+    # ptlint: silent-except-ok — mesh introspection is best-effort;
+    # the default is the unsharded lookup path
     except Exception:
         pass
     if sharded_weight:

@@ -111,8 +111,13 @@ def _record_step(vals, steps, dt, stacked=False):
                         "bytes_limit"):
                 if key in stats:
                     _DEV_MEM.labels(stat=key).set(stats[key])
-    except Exception:
-        pass
+    except Exception as e:
+        from ..monitor.registry import warn_once
+
+        warn_once(
+            "engine.device_memory",
+            "paddle_tpu.parallel: device memory stats unavailable "
+            "(gauge stays empty): %r" % (e,))
 
 
 def _normalize_spec(spec, ndim):
@@ -724,8 +729,14 @@ class CompiledTrainStep:
             self._perf_attr.on_step(
                 dt, steps=steps, tokens=_batch_tokens(vals, stacked),
                 loss=loss, t_start=t0, t_end=t1)
-        except Exception:
-            pass
+        except Exception as e:
+            from ..monitor.registry import warn_once
+
+            warn_once(
+                "engine.perf_attr",
+                "paddle_tpu.parallel: perf attribution failed (train "
+                "step unaffected, MFU/goodput series stop): "
+                "%r" % (e,))
 
     @no_grad()
     def __call__(self, *batch):

@@ -932,8 +932,14 @@ class PipelinedTrainStep:
                 tokens *= int(d)
             self._perf_attr.on_step(dt, steps=1, tokens=tokens,
                                     loss=loss, t_start=t0, t_end=t1)
-        except Exception:
-            pass
+        except Exception as e:
+            from ..monitor.registry import warn_once
+
+            warn_once(
+                "pipeline.perf_attr",
+                "paddle_tpu.parallel: pipeline perf attribution "
+                "failed (train step unaffected, MFU/goodput series "
+                "stop): %r" % (e,))
 
     def sync_to_model(self):
         """Write the stacked block params back into the per-layer tensors

@@ -100,6 +100,8 @@ class RecordEvent:
         if self._xprof is not None:
             try:
                 self._xprof.__exit__(None, None, None)
+            # ptlint: silent-except-ok — profiler teardown is
+            # best-effort; the trace dir keeps whatever landed
             except Exception:
                 pass
             self._xprof = None
@@ -201,6 +203,8 @@ class Profiler:
             try:
                 import jax
                 jax.profiler.stop_trace()
+            # ptlint: silent-except-ok — stop_trace raises when the
+            # backend already closed the window; teardown best-effort
             except Exception:
                 pass
             self._xprof_on = False

@@ -91,6 +91,6 @@ def payload():
         from ..monitor import watchdog as _wd
 
         out["watchdog_action"] = _wd.stall_action()
-    except Exception:
-        pass
+    except ImportError:
+        pass    # monitor stack absent: state() reports without it
     return out

@@ -155,6 +155,8 @@ def span(name, level=1):
         if ev is not None:
             try:
                 ev.end()
+            # ptlint: silent-except-ok — native trace-event teardown
+            # is best-effort; the span simply ends unclosed
             except Exception:
                 pass
 
@@ -171,8 +173,13 @@ def counter(name, value):
         from ..core import native
 
         native.get_lib().pt_trace_counter(name.encode(), int(value))
-    except Exception:
-        pass
+    except Exception as e:
+        from ..monitor.registry import warn_once
+
+        warn_once(
+            "serving.native_counter",
+            "paddle_tpu.serving.metrics: native trace counter "
+            "unavailable (registry metrics unaffected): %r" % (e,))
 
 
 class RequestMetrics:
@@ -425,8 +432,14 @@ class EngineMetrics:
                 prefix_hit_tokens=self.prefix_hit_tokens,
                 prefix_cached_pages=self.prefix_cached_pages,
                 prefill_chunks=self.prefill_chunks)
-        except Exception:
-            pass
+        except Exception as e:
+            from ..monitor.registry import warn_once
+
+            warn_once(
+                "serving.note_perf_job",
+                "paddle_tpu.serving.metrics: perf-job attribution "
+                "failed (serving unaffected, goodput series stop): "
+                "%r" % (e,))
 
     def to_dict(self):
         wall = (max(now() - self.start_t, 1e-9)

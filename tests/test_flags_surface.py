@@ -1,0 +1,112 @@
+"""Flag-surface coverage: every declared FLAGS_* round-trips.
+
+ptlint's flag pass requires each flag in ``core/flags.py``'s
+``_DEFAULTS`` to be referenced by at least one file under tests/ — a
+flag nothing exercises is a flag whose disabled path silently rots.
+This file is that reference for the reference-compat and
+infrastructure flags no feature suite owns (the feature flags —
+``FLAGS_quantized_grad_sync``, ``FLAGS_monitor_*``, ... — are
+exercised where their features are tested), and it pins the plumbing
+those flags share: declared default, set/get round-trip, and the
+env-var bootstrap coercion rules.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core import flags as _flags_mod
+
+# (name, declared default, an exercise value) for the flags whose only
+# behavior IS the flag plumbing (reference-compat accepts/no-ops) or
+# whose feature cost keeps them out of any default-on suite. Literal
+# names on purpose: this list is what satisfies the flag pass's
+# test-reference check for them.
+SURFACE = [
+    ("FLAGS_check_nan_inf", False, True),
+    ("FLAGS_check_nan_inf_level", 0, 2),
+    ("FLAGS_benchmark", False, True),
+    ("FLAGS_retain_grad_for_all_tensor", False, True),
+    ("FLAGS_jit_cache_size", 4096, 128),
+    ("FLAGS_use_bf16_matmul", True, False),
+    ("FLAGS_eager_delete_tensor_gb", 0.0, 1.5),
+    ("FLAGS_allocator_strategy", "xla", "xla"),
+    ("FLAGS_fraction_of_gpu_memory_to_use", 1.0, 0.5),
+    ("FLAGS_use_native_interpreter", True, False),
+    ("FLAGS_distributed_barrier_timeout_s", 600, 5),
+    ("FLAGS_fault_inject", False, True),
+    ("FLAGS_v", 0, 3),
+]
+
+
+@pytest.fixture
+def restore_flags():
+    saved = paddle.get_flags()
+    yield
+    paddle.set_flags(saved)
+
+
+@pytest.mark.parametrize("name,default,_probe",
+                         SURFACE, ids=[s[0] for s in SURFACE])
+def test_declared_default(name, default, _probe):
+    # the declared default is the contract BASELINE.md's disposition
+    # table documents; env overrides would have been applied at import,
+    # so skip any flag the environment pinned
+    if os.environ.get(name) is not None:
+        pytest.skip("%s set in the environment" % name)
+    assert _flags_mod._DEFAULTS[name] == default
+    assert paddle.get_flags(name)[name] == default
+
+
+@pytest.mark.parametrize("name,default,probe",
+                         SURFACE, ids=[s[0] for s in SURFACE])
+def test_set_get_roundtrip(name, default, probe, restore_flags):
+    paddle.set_flags({name: probe})
+    assert paddle.get_flags(name)[name] == probe
+    # string values coerce per the default's type (env-var parity)
+    if isinstance(default, bool):
+        paddle.set_flags({name: "0"})
+        assert paddle.get_flags(name)[name] is False
+        paddle.set_flags({name: "true"})
+        assert paddle.get_flags(name)[name] is True
+    elif isinstance(default, int):
+        paddle.set_flags({name: "7"})
+        assert paddle.get_flags(name)[name] == 7
+    elif isinstance(default, float):
+        paddle.set_flags({name: "0.25"})
+        assert paddle.get_flags(name)[name] == 0.25
+
+
+def test_env_bootstrap_coercion():
+    """FLAGS_* env vars set the flag at import with type coercion —
+    checked in a subprocess so this process's import state is not
+    disturbed."""
+    env = dict(os.environ)
+    env.update({"FLAGS_check_nan_inf": "1",
+                "FLAGS_jit_cache_size": "77",
+                "FLAGS_eager_delete_tensor_gb": "2.5",
+                "FLAGS_allocator_strategy": "xla"})
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "from paddle_tpu.core import flags as f;"
+         "print(f.get_flags('FLAGS_check_nan_inf')['FLAGS_check_nan_inf'],"
+         " f.get_flags('FLAGS_jit_cache_size')['FLAGS_jit_cache_size'],"
+         " f.get_flags('FLAGS_eager_delete_tensor_gb')"
+         "['FLAGS_eager_delete_tensor_gb'])"],
+        env=env, capture_output=True, text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert out.returncode == 0, out.stderr
+    assert out.stdout.split() == ["True", "77", "2.5"]
+
+
+def test_every_declared_flag_is_gettable():
+    allf = paddle.get_flags()
+    for name in _flags_mod._DEFAULTS:
+        assert name in allf
+
+
+def test_surface_flags_stay_declared():
+    for name, _, _ in SURFACE:
+        assert name in _flags_mod._DEFAULTS

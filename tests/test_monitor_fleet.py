@@ -36,6 +36,7 @@ import pytest
 import paddle_tpu as paddle
 from paddle_tpu import monitor
 from paddle_tpu.monitor import fleet
+from paddle_tpu.monitor import registry
 from paddle_tpu.monitor import trace
 from paddle_tpu.monitor import trace_merge as tm
 
@@ -280,6 +281,48 @@ class TestCollectorLive:
         assert rows[1]["ok"] is False
         assert rows[1]["error"]
         assert rows[1]["consecutive_errors"] == 1
+
+    def test_flight_http_error_leaves_rank_healthy(self, monkeypatch):
+        """A truncated /debugz/flight body (http.client.HTTPException,
+        not OSError) must leave flight_seq None — not mark the whole
+        rank as a scrape error when its other endpoints answered."""
+        import http.client
+
+        real = {"/metrics.json": {"metrics": {}, "unix_time": 1.0},
+                "/debugz/perf": {}, "/healthz": {"ok": True}}
+
+        def fake_http_json(url, timeout):
+            for suffix, payload in real.items():
+                if url.endswith(suffix):
+                    return payload, 0.0, 0.001, 0.001
+            raise http.client.IncompleteRead(b"")
+
+        monkeypatch.setattr(fleet, "_http_json", fake_http_json)
+        c = fleet.FleetCollector(endpoints={0: "http://fake:1"},
+                                 interval_s=0.2, http_timeout_s=0.5)
+        c.scrape_once()
+        rows = {r["rank"]: r for r in c.ranks_table()}
+        assert rows[0]["ok"] is True
+        assert rows[0]["consecutive_errors"] == 0
+
+    def test_capture_failure_warns_not_swallows(self, monkeypatch,
+                                                capsys):
+        """capture() raising (disk full, unwritable dir) must leave a
+        warn-once trail, not silently eat the consumed trigger."""
+        c = fleet.FleetCollector(endpoints={0: "http://fake:1"},
+                                 interval_s=0.2, http_timeout_s=0.5)
+
+        def boom(reason, detail=None):
+            raise OSError("disk full")
+
+        monkeypatch.setattr(c, "capture", boom)
+        # warn_once dedups on a process-global key: an earlier test that
+        # drove a failing capture would consume it — make this hermetic
+        registry._warned.discard("fleet.capture")
+        assert c._maybe_capture(reason="test_anomaly") is None
+        err = capsys.readouterr().err
+        assert "anomaly capture failed" in err
+        assert "test_anomaly" in err
 
     def test_capture_and_trace_merge_capture(self, live_server,
                                              tmp_path):

@@ -1,0 +1,807 @@
+"""ptlint: per-pass seeded-violation fixtures + the tree-is-clean gate.
+
+Two layers:
+
+1. **Fixture tests** — each pass gets a tmp project tree seeded with a
+   known violation and a known-clean twin: the pass must fire on the
+   former (right rule, right site) and stay silent on the latter, a
+   ``# ptlint: <rule>-ok`` pragma must suppress exactly that site, and
+   the baseline must round-trip (grandfather, then go stale when the
+   finding disappears).
+2. **The gate** — the tier-1 contract: running the full suite over the
+   real tree with the checked-in config + baseline yields ZERO fresh
+   findings and zero stale baseline entries. Any new violation anyone
+   introduces fails THIS test, in-process, without needing CI wiring.
+"""
+import json
+import os
+import textwrap
+
+import pytest
+
+from paddle_tpu.analysis import (Baseline, Project, load_config,
+                                 render_json, render_text, run)
+from paddle_tpu.analysis import (clocks, flags_pass, metrics_pass,
+                                 silent_except, threads, trace_purity)
+from paddle_tpu.analysis.runner import BASELINE_ELIGIBLE, RULES
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def make_project(tmp_path, files, config=None, paths=("pkg",)):
+    for rel, text in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(text))
+    return Project(str(tmp_path), paths=paths, config=config or {})
+
+
+def rules_of(findings):
+    return sorted(set(f.rule for f in findings))
+
+
+# -- flag pass ---------------------------------------------------------------
+
+FLAG_CFG = {"flag": {"flags_file": "pkg/flags.py",
+                     "baseline_md": "BASELINE.md",
+                     "tests_dir": "tests",
+                     # hot-path fixtures opt in explicitly: a spec the
+                     # fixture does not materialize is itself a finding
+                     # (the orphaned-spec check)
+                     "hot_paths": []}}
+FLAG_HOT_CFG = {"flag": dict(FLAG_CFG["flag"],
+                             hot_paths=["pkg/engine.py::Engine.step"])}
+
+
+class TestFlagPass:
+    def test_fresh_flag_without_disposition_row_fails(self, tmp_path):
+        """The pin: adding a FLAGS_ entry without a BASELINE row is a
+        finding — the disposition table is machine-checked contract."""
+        project = make_project(tmp_path, {
+            "pkg/flags.py": """
+                _DEFAULTS = {
+                    "FLAGS_old_thing": False,
+                    "FLAGS_totally_new": False,
+                }
+            """,
+            "BASELINE.md": "| `FLAGS_old_thing` | opt-in |\n",
+            "tests/test_x.py": "USES = ['FLAGS_old_thing',"
+                               " 'FLAGS_totally_new']\n",
+        }, config=FLAG_CFG)
+        found = flags_pass.run_pass(project)
+        assert [f.symbol for f in found] == \
+            ["FLAGS_totally_new:disposition"]
+
+    def test_flag_without_test_reference_fails(self, tmp_path):
+        project = make_project(tmp_path, {
+            "pkg/flags.py": '_DEFAULTS = {"FLAGS_untested": 1}\n',
+            "BASELINE.md": "| `FLAGS_untested` | knob |\n",
+            "tests/test_x.py": "pass\n",
+        }, config=FLAG_CFG)
+        found = flags_pass.run_pass(project)
+        assert [f.symbol for f in found] == ["FLAGS_untested:test"]
+
+    def test_prefix_flag_does_not_ride_longer_names_tests(
+            self, tmp_path):
+        """FLAGS_foo must have its OWN test reference — a substring
+        match would let FLAGS_foo_level's references satisfy it."""
+        project = make_project(tmp_path, {
+            "pkg/flags.py": """
+                _DEFAULTS = {
+                    "FLAGS_foo": 0,
+                    "FLAGS_foo_level": 1,
+                }
+            """,
+            "BASELINE.md": "| `FLAGS_foo` | x |\n"
+                           "| `FLAGS_foo_level` | x |\n",
+            "tests/test_x.py": "F = 'FLAGS_foo_level'\n",
+        }, config=FLAG_CFG)
+        found = flags_pass.run_pass(project)
+        assert [f.symbol for f in found] == ["FLAGS_foo:test"]
+
+    def test_hot_path_flag_reread_fails_latched_is_clean(self, tmp_path):
+        project = make_project(tmp_path, {
+            "pkg/flags.py": '_DEFAULTS = {"FLAGS_fast": True}\n',
+            "BASELINE.md": "| `FLAGS_fast` | on |\n",
+            "tests/test_x.py": "F = 'FLAGS_fast'\n",
+            "pkg/engine.py": """
+                from .flags import flag
+
+                class Engine:
+                    def __init__(self):
+                        # construction latch: the blessed convention
+                        self._fast = flag("FLAGS_fast")
+
+                    def step(self):
+                        return flag("FLAGS_fast")
+            """,
+        }, config=FLAG_HOT_CFG)
+        found = flags_pass.run_pass(project)
+        assert len(found) == 1
+        assert found[0].symbol == "Engine.step:FLAGS_fast#1"
+        assert "hot-path" in found[0].message
+
+    def test_hot_path_symbol_unique_per_site(self, tmp_path):
+        """Two re-reads of the same flag are two findings with two
+        symbols: baselining one must not grandfather the other."""
+        project = make_project(tmp_path, {
+            "pkg/flags.py": '_DEFAULTS = {"FLAGS_fast": True}\n',
+            "BASELINE.md": "| `FLAGS_fast` | on |\n",
+            "tests/test_x.py": "F = 'FLAGS_fast'\n",
+            "pkg/engine.py": """
+                from .flags import flag
+
+                class Engine:
+                    def step(self):
+                        a = flag("FLAGS_fast")
+                        b = flag("FLAGS_fast")
+                        return a, b
+            """,
+        }, config=FLAG_HOT_CFG)
+        found = [f for f in flags_pass.run_pass(project)
+                 if "hot-path" in f.message]
+        assert sorted(f.symbol for f in found) == [
+            "Engine.step:FLAGS_fast#1", "Engine.step:FLAGS_fast#2"]
+        baseline = Baseline.from_findings(found[:1])
+        findings, stale, _ = run(project, rules=["flag"],
+                                 baseline=baseline)
+        hot = [f for f in findings if "hot-path" in f.message]
+        assert [f.grandfathered for f in
+                sorted(hot, key=lambda f: f.symbol)] == [True, False]
+        assert not stale
+
+    def test_orphaned_hot_path_spec_is_a_finding(self, tmp_path):
+        """A hot_paths spec that resolves to no file/class/method is a
+        gate that silently turned itself off — a rename must fail the
+        pass until the spec follows."""
+        cfg = {"flag": dict(FLAG_CFG["flag"],
+                            hot_paths=["pkg/engine.py::Engine.step",
+                                       "pkg/gone.py::Gone.run"])}
+        project = make_project(tmp_path, {
+            "pkg/flags.py": '_DEFAULTS = {"FLAGS_fast": True}\n',
+            "BASELINE.md": "| `FLAGS_fast` | on |\n",
+            "tests/test_x.py": "F = 'FLAGS_fast'\n",
+            "pkg/engine.py": """
+                class Engine:
+                    def renamed_step(self):
+                        pass
+            """,
+        }, config=cfg)
+        found = flags_pass.run_pass(project)
+        assert sorted(f.symbol for f in found) == [
+            "hot-path-spec:pkg/engine.py::Engine.step",
+            "hot-path-spec:pkg/gone.py::Gone.run"]
+
+    def test_pragma_suppresses_declaration_findings(self, tmp_path):
+        project = make_project(tmp_path, {
+            "pkg/flags.py": """
+                _DEFAULTS = {
+                    "FLAGS_vendored": 1,  # ptlint: flag-ok — vendored
+                }
+            """,
+            "BASELINE.md": "",
+            "tests/test_x.py": "pass\n",
+        }, config=FLAG_CFG)
+        assert flags_pass.run_pass(project) == []
+
+
+# -- trace-purity pass -------------------------------------------------------
+
+class TestTracePass:
+    def test_impure_traced_fn_fires(self, tmp_path):
+        project = make_project(tmp_path, {
+            "pkg/step.py": """
+                import time
+                import jax
+
+                def helper():
+                    return time.time()
+
+                def step_fn(x):
+                    print("tracing", x)
+                    return x + helper()
+
+                step = jax.jit(step_fn)
+            """})
+        found = trace_purity.run_pass(project)
+        whats = {f.symbol.split(":")[1].split("#")[0] for f in found}
+        assert "print" in whats            # direct impurity
+        assert "time.time" in whats        # via reachable helper()
+        assert len(found) == 2             # and exactly once each
+
+    def test_pure_fn_and_sync_forcing(self, tmp_path):
+        project = make_project(tmp_path, {
+            "pkg/ok.py": """
+                import jax
+
+                @jax.jit
+                def pure(x):
+                    return x * 2
+            """,
+            "pkg/sync.py": """
+                import jax
+
+                def step_fn(x):
+                    y = (x * 2).item()
+                    return float(x) + y
+
+                step = jax.jit(step_fn)
+            """})
+        found = trace_purity.run_pass(project)
+        assert all(f.path == "pkg/sync.py" for f in found)
+        whats = {f.symbol.split(":")[1].split("#")[0] for f in found}
+        assert ".item()" in whats and "float(...)" in whats
+
+    def test_pragma_suppresses(self, tmp_path):
+        project = make_project(tmp_path, {
+            "pkg/step.py": """
+                import jax
+
+                def step_fn(x):
+                    # deliberate: trace-time banner
+                    print("x")  # ptlint: trace-ok — trace-time banner
+                    return x
+
+                step = jax.jit(step_fn)
+            """})
+        assert trace_purity.run_pass(project) == []
+
+    def test_dotted_import_does_not_mangle_jit_root(self, tmp_path):
+        """`import jax.numpy` binds `jax` — aliasing it to "jax.numpy"
+        would resolve jax.jit as "jax.numpy.jit" and skip the root."""
+        project = make_project(tmp_path, {
+            "pkg/step.py": """
+                import time
+                import jax.numpy
+
+                def step_fn(x):
+                    return x * time.time()
+
+                step = jax.jit(step_fn)
+            """})
+        found = trace_purity.run_pass(project)
+        assert [f.symbol.split(":")[1].split("#")[0]
+                for f in found] == ["time.time"]
+
+
+# -- clock pass --------------------------------------------------------------
+
+class TestClockPass:
+    def test_wall_duration_and_deadline_fire(self, tmp_path):
+        project = make_project(tmp_path, {
+            "pkg/bad.py": """
+                import time
+
+                def loop():
+                    t0 = time.time()
+                    work()
+                    dur = time.time() - t0
+                    deadline = time.time() + 5
+                    while time.time() < deadline:
+                        work()
+                    return dur
+            """})
+        found = clocks.run_pass(project)
+        assert rules_of(found) == ["clock"]
+        assert len(found) == 2   # the subtraction + ONE per compare
+        assert all(f.path == "pkg/bad.py" for f in found)
+
+    def test_monotonic_and_equality_are_clean(self, tmp_path):
+        project = make_project(tmp_path, {
+            "pkg/ok.py": """
+                import time
+
+                def loop(stamp):
+                    t0 = time.monotonic()
+                    work()
+                    dur = time.monotonic() - t0
+                    # stamp EQUALITY is the skew-immune liveness idiom
+                    fresh = stamp == time.time()
+                    return dur, fresh
+            """})
+        assert clocks.run_pass(project) == []
+
+    def test_pragma_on_assignment_blesses_downstream(self, tmp_path):
+        project = make_project(tmp_path, {
+            "pkg/probe.py": """
+                import time
+
+                def ntp_probe(peer_time):
+                    t0 = time.time()  # ptlint: clock-ok — NTP probe
+                    t1 = time.time()  # ptlint: clock-ok — NTP probe
+                    return peer_time - (t0 + t1) / 2.0
+            """})
+        assert clocks.run_pass(project) == []
+
+    def test_taint_is_scoped_per_function(self, tmp_path):
+        project = make_project(tmp_path, {
+            "pkg/scoped.py": """
+                import time
+
+                def stamp():
+                    return time.time()
+
+                def other(a, b):
+                    return a - b   # untainted names: clean
+            """})
+        assert clocks.run_pass(project) == []
+
+
+# -- thread pass -------------------------------------------------------------
+
+class TestThreadPass:
+    def test_missing_daemon_and_no_stop_path(self, tmp_path):
+        project = make_project(tmp_path, {
+            "pkg/bad.py": """
+                import threading
+
+                def forever():
+                    while True:
+                        work()
+
+                t = threading.Thread(target=forever)
+                t.start()
+            """})
+        found = threads.run_pass(project)
+        syms = sorted(f.symbol for f in found)
+        assert any(s.endswith(":daemon") for s in syms)
+        assert any(s.endswith(":stop-path") for s in syms)
+
+    def test_daemon_with_stop_event_is_clean(self, tmp_path):
+        project = make_project(tmp_path, {
+            "pkg/ok.py": """
+                import threading
+
+                class Helper:
+                    def __init__(self):
+                        self._stop = threading.Event()
+                        self._thread = threading.Thread(
+                            target=self._run, daemon=True)
+
+                    def _run(self):
+                        while not self._stop.wait(1.0):
+                            work()
+            """})
+        assert threads.run_pass(project) == []
+
+    def test_unlocked_shared_attr_fires_locked_is_clean(self, tmp_path):
+        project = make_project(tmp_path, {
+            "pkg/shared.py": """
+                import threading
+
+                class Bad:
+                    def start(self):
+                        threading.Thread(target=self._run,
+                                         daemon=True).start()
+
+                    def _run(self):
+                        while not self.stopped:
+                            self.latest = work()
+
+                    def read(self):
+                        return self.latest
+
+                class Good:
+                    def start(self):
+                        threading.Thread(target=self._run,
+                                         daemon=True).start()
+
+                    def _run(self):
+                        while not self.stopped:
+                            with self._lock:
+                                self.latest = work()
+
+                    def read(self):
+                        with self._lock:
+                            return self.latest
+            """})
+        found = threads.run_pass(project)
+        assert len(found) == 1
+        assert found[0].symbol == "Bad._run:shared:latest"
+
+    def test_from_import_thread_style_fires(self, tmp_path):
+        """`from threading import Thread` must not skip the file: the
+        alias value is "threading.Thread", not "threading"."""
+        project = make_project(tmp_path, {
+            "pkg/fromimp.py": """
+                from threading import Thread
+
+                def forever():
+                    while True:
+                        work()
+
+                t = Thread(target=forever)
+                t.start()
+            """})
+        found = threads.run_pass(project)
+        syms = sorted(f.symbol for f in found)
+        assert any(s.endswith(":daemon") for s in syms)
+        assert any(s.endswith(":stop-path") for s in syms)
+
+    def test_pragma_suppresses_spawn(self, tmp_path):
+        project = make_project(tmp_path, {
+            "pkg/tool.py": """
+                import threading
+
+                # ptlint: thread-ok — short-lived benchmark worker,
+                # joined three lines down
+                t = threading.Thread(target=print)
+                t.start()
+                t.join()
+            """})
+        assert threads.run_pass(project) == []
+
+
+# -- metric pass -------------------------------------------------------------
+
+MET_CFG = {"metric": {"docs": ["DOCS.md"]}}
+
+
+class TestMetricPass:
+    def test_nonliteral_family_docs_and_label_mismatch(self, tmp_path):
+        project = make_project(tmp_path, {
+            "DOCS.md": "`train_steps_total` is documented\n",
+            "pkg/a.py": """
+                from paddle_tpu import monitor
+
+                NAME = "train_" + "dyn"
+                C1 = monitor.counter(NAME, "computed name")
+                C2 = monitor.counter("rogue_total", "bad family")
+                C3 = monitor.counter("train_steps_total", "ok")
+                C4 = monitor.counter("train_steps_total", "relabeled",
+                                     labelnames=("rank",))
+            """}, config=MET_CFG)
+        found = metrics_pass.run_pass(project)
+        kinds = sorted(f.symbol.rsplit(":", 1)[1] for f in found)
+        # computed name; rogue family + rogue docs; label conflict
+        assert kinds == ["docs", "family", "labels", "literal"]
+
+    def test_documented_family_consistent_is_clean(self, tmp_path):
+        project = make_project(tmp_path, {
+            "DOCS.md": "`serving_requests_total` counts requests\n",
+            "pkg/a.py": """
+                from paddle_tpu import monitor
+
+                C = monitor.counter("serving_requests_total", "reqs",
+                                    labelnames=("event",))
+            """,
+            "pkg/b.py": """
+                from paddle_tpu import monitor
+
+                C = monitor.counter("serving_requests_total", "reqs",
+                                    labelnames=("event",))
+            """}, config=MET_CFG)
+        assert metrics_pass.run_pass(project) == []
+
+    def test_allow_list_and_pragma(self, tmp_path):
+        project = make_project(tmp_path, {
+            "DOCS.md": "`legacy_total` and `mfu` are documented\n",
+            "pkg/a.py": """
+                from paddle_tpu import monitor
+
+                A = monitor.counter("legacy_total", "x")
+                B = monitor.gauge("mfu", "y")
+                C = monitor.counter(  # ptlint: metric-ok — vendored
+                    "weird_name", "z")
+            """}, config={"metric": {"docs": ["DOCS.md"],
+                                     "allow": ["legacy_*", "mfu"]}})
+        assert metrics_pass.run_pass(project) == []
+
+    def test_unrelated_counter_helper_ignored(self, tmp_path):
+        project = make_project(tmp_path, {
+            "DOCS.md": "",
+            "pkg/a.py": """
+                from collections import Counter as counter
+
+                c = counter("not a metric")
+            """}, config=MET_CFG)
+        assert metrics_pass.run_pass(project) == []
+
+
+# -- silent-except pass ------------------------------------------------------
+
+class TestSilentExceptPass:
+    def test_broad_pass_fires_narrow_and_logged_do_not(self, tmp_path):
+        project = make_project(tmp_path, {
+            "pkg/a.py": """
+                def f():
+                    try:
+                        work()
+                    except Exception:
+                        pass
+                    try:
+                        work()
+                    except OSError:
+                        pass          # narrow: a decision, fine
+                    try:
+                        work()
+                    except Exception as e:
+                        log(e)        # broad but loud: fine
+            """})
+        found = silent_except.run_pass(project)
+        assert len(found) == 1
+        assert found[0].line == 5
+
+    def test_bare_and_tuple_broad_fire(self, tmp_path):
+        project = make_project(tmp_path, {
+            "pkg/a.py": """
+                def f():
+                    try:
+                        work()
+                    except:
+                        pass
+                    try:
+                        work()
+                    except (OSError, Exception):
+                        pass
+            """})
+        assert len(silent_except.run_pass(project)) == 2
+
+    def test_pragma_in_comment_block_above(self, tmp_path):
+        project = make_project(tmp_path, {
+            "pkg/a.py": """
+                def f():
+                    try:
+                        work()
+                    # ptlint: silent-except-ok — teardown must not
+                    # raise, and the reason spans two comment lines
+                    except Exception:
+                        pass
+            """})
+        assert silent_except.run_pass(project) == []
+
+
+# -- baseline round-trip -----------------------------------------------------
+
+class TestBaseline:
+    def _project(self, tmp_path, flags="1"):
+        return make_project(tmp_path, {
+            "pkg/flags.py": '_DEFAULTS = {"FLAGS_debt": %s}\n' % flags,
+            "BASELINE.md": "",
+            "tests/test_x.py": "pass\n",
+        }, config=FLAG_CFG)
+
+    def test_grandfather_then_stale(self, tmp_path):
+        project = self._project(tmp_path)
+        findings, stale, _ = run(project, rules=["flag"])
+        assert len(findings) == 2 and not stale
+
+        baseline = Baseline.from_findings(findings)
+        path = tmp_path / "baseline.json"
+        baseline.write(str(path))
+        reloaded = Baseline.load(str(path))
+        assert {tuple(sorted(e.items())) for e in reloaded.entries} == \
+            {tuple(sorted(e.items())) for e in baseline.entries}
+
+        findings, stale, _ = run(project, rules=["flag"],
+                                 baseline=reloaded)
+        assert all(f.grandfathered for f in findings) and not stale
+
+        # pay the disposition debt -> that entry must go STALE (the
+        # baseline only shrinks, never silently rots)
+        (tmp_path / "BASELINE.md").write_text(
+            "| `FLAGS_debt` | paid |\n")
+        project2 = Project(str(tmp_path), paths=("pkg",),
+                           config=FLAG_CFG)
+        findings, stale, _ = run(project2, rules=["flag"],
+                                 baseline=reloaded)
+        assert [f.symbol for f in findings] == ["FLAGS_debt:test"]
+        assert [e["symbol"] for e in stale] == ["FLAGS_debt:disposition"]
+
+    def test_non_eligible_rules_cannot_be_grandfathered(self, tmp_path):
+        project = make_project(tmp_path, {
+            "pkg/a.py": """
+                import time
+
+                def f():
+                    t0 = time.time()
+                    return time.time() - t0
+            """})
+        rogue = Baseline([{"rule": "clock", "path": "pkg/a.py",
+                           "symbol": "f:wall-subtraction#1",
+                           "note": "tried to dodge"}])
+        findings, stale, _ = run(project, rules=["clock"],
+                                 baseline=rogue)
+        # the finding stays FRESH and the entry comes back stale: a
+        # clock violation cannot ride the baseline
+        assert findings and not any(f.grandfathered for f in findings)
+        assert len(stale) == 1
+
+    def test_rules_subset_leaves_other_rules_baseline_alone(
+            self, tmp_path):
+        """`--rules clock` must not report the flag/trace/thread
+        baseline debt as stale — those passes never ran, so their
+        entries have no findings by construction."""
+        project = self._project(tmp_path)
+        findings, _, _ = run(project, rules=["flag"])
+        baseline = Baseline.from_findings(findings)
+        findings, stale, _ = run(project, rules=["clock"],
+                                 baseline=baseline)
+        assert findings == [] and stale == []
+        # the full run still judges them
+        findings, stale, _ = run(project, baseline=baseline)
+        assert all(f.grandfathered for f in findings
+                   if f.rule == "flag") and not stale
+
+    def test_stable_symbol_survives_line_moves(self, tmp_path):
+        project = self._project(tmp_path)
+        findings, _, _ = run(project, rules=["flag"])
+        baseline = Baseline.from_findings(findings)
+        moved = make_project(tmp_path / "moved", {
+            "pkg/flags.py": '\n\n\n# padding\n_DEFAULTS = '
+                            '{"FLAGS_debt": 1}\n',
+            "BASELINE.md": "",
+            "tests/test_x.py": "pass\n",
+        }, config=FLAG_CFG)
+        findings, stale, _ = run(moved, rules=["flag"],
+                                 baseline=baseline)
+        assert all(f.grandfathered for f in findings) and not stale
+
+
+# -- config + reporting ------------------------------------------------------
+
+class TestConfigAndReport:
+    def test_pyproject_subset_parses(self, tmp_path):
+        (tmp_path / "pyproject.toml").write_text(textwrap.dedent("""
+            [tool.other]
+            ignored = true
+
+            [tool.ptlint]
+            paths = ["paddle_tpu", "tools"]   # trailing comment
+            baseline = "tools/b.json"
+
+            [tool.ptlint.metric]
+            allow = ["mfu", "legacy_*"]
+            strict = true
+            max = 10
+        """))
+        cfg = load_config(str(tmp_path))
+        assert cfg["paths"] == ["paddle_tpu", "tools"]
+        assert cfg["baseline"] == "tools/b.json"
+        assert cfg["metric"] == {"allow": ["mfu", "legacy_*"],
+                                 "strict": True, "max": 10}
+
+    def test_multiline_array_parses(self, tmp_path):
+        """The real pyproject wraps the metric allow list across
+        lines; a single-line-only parse left a garbage string whose
+        '*' character allow-listed EVERY metric name."""
+        (tmp_path / "pyproject.toml").write_text(textwrap.dedent("""
+            [tool.ptlint.metric]
+            allow = ["grad_sync_*", "snapshot_*",  # comment
+                     "mfu",
+                     "hbm_peak_bytes"]
+            strict = true
+        """))
+        cfg = load_config(str(tmp_path))
+        assert cfg["metric"]["allow"] == [
+            "grad_sync_*", "snapshot_*", "mfu", "hbm_peak_bytes"]
+        assert cfg["metric"]["strict"] is True
+
+    def test_render_text_and_json(self, tmp_path):
+        project = make_project(tmp_path, {
+            "pkg/a.py": "def f():\n    try:\n        w()\n"
+                        "    except Exception:\n        pass\n"})
+        findings, stale, counts = run(project, rules=["silent-except"])
+        text = render_text(findings, stale, counts)
+        assert "pkg/a.py:4: silent-except:" in text
+        assert "1 fresh" in text
+        blob = render_json(findings, stale, counts, meta={"x": 1})
+        parsed = json.loads(json.dumps(blob))
+        assert parsed["kind"] == "ptlint_report"
+        assert parsed["fresh"] == 1
+        assert parsed["per_rule"] == {"silent-except": 1}
+
+
+# -- the tier-1 gate ---------------------------------------------------------
+
+class TestTreeIsClean:
+    """THE gate: the real tree, the checked-in config + baseline, all
+    passes, zero fresh findings. A violation anywhere in paddle_tpu/
+    or tools/ fails here first."""
+
+    def _run_repo(self):
+        config = load_config(REPO_ROOT)
+        project = Project(REPO_ROOT,
+                          paths=tuple(config.get("paths",
+                                                 ("paddle_tpu",
+                                                  "tools"))),
+                          exclude=tuple(config.get("exclude", ())),
+                          config=config)
+        baseline = Baseline.load(
+            os.path.join(REPO_ROOT, config["baseline"]))
+        return run(project, baseline=baseline), baseline
+
+    def test_tree_is_clean(self):
+        (findings, stale, counts), _ = self._run_repo()
+        fresh = [f for f in findings if not f.grandfathered]
+        assert not fresh, "NEW ptlint findings:\n" + render_text(
+            fresh, counts=counts)
+        assert not stale, ("stale baseline entries (debt paid or "
+                           "moved — prune tools/ptlint_baseline.json):"
+                           "\n%s" % stale)
+
+    def test_every_pass_ran_over_a_real_corpus(self):
+        (_, _, counts), _ = self._run_repo()
+        # counts only lists rules with findings; what we pin instead
+        # is that the scan saw the tree at all
+        config = load_config(REPO_ROOT)
+        project = Project(REPO_ROOT,
+                          paths=tuple(config.get("paths")),
+                          exclude=tuple(config.get("exclude", ())),
+                          config=config)
+        assert len(project.files) > 200
+        assert set(RULES) == {"flag", "trace", "clock", "thread",
+                              "metric", "silent-except"}
+
+    def test_baseline_carries_no_nongrandfatherable_debt(self):
+        _, baseline = self._run_repo()
+        assert all(e["rule"] in BASELINE_ELIGIBLE
+                   for e in baseline.entries), (
+            "clock/metric/silent-except findings must be fixed or "
+            "pragma'd, never baselined")
+        # the acceptance bound: grandfathered debt stays small + named
+        assert len(baseline.entries) <= 10
+        assert all(e.get("note") for e in baseline.entries)
+
+
+class TestCLI:
+    def test_cli_clean_exit_and_report_artifact(self, tmp_path):
+        import subprocess
+        import sys
+
+        out = tmp_path / "report.json"
+        r = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO_ROOT, "tools", "ptlint.py"),
+             "--out", str(out)],
+            capture_output=True, text=True, cwd=REPO_ROOT)
+        assert r.returncode == 0, r.stdout + r.stderr
+        blob = json.loads(out.read_text())
+        assert blob["kind"] == "ptlint_report"
+        assert blob["fresh"] == 0 and not blob["stale_baseline"]
+        assert blob["meta"]["files_scanned"] > 200
+
+    def test_cli_rules_subset_and_unknown_rule(self, tmp_path):
+        import subprocess
+        import sys
+
+        r = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO_ROOT, "tools", "ptlint.py"),
+             "--rules", "clock,silent-except"],
+            capture_output=True, text=True, cwd=REPO_ROOT)
+        assert r.returncode == 0, r.stdout + r.stderr
+        r = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO_ROOT, "tools", "ptlint.py"),
+             "--rules", "nonsense"],
+            capture_output=True, text=True, cwd=REPO_ROOT)
+        assert r.returncode == 2
+
+    def test_cli_write_baseline_rejects_rules_subset(self, tmp_path):
+        import subprocess
+        import sys
+
+        r = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO_ROOT, "tools", "ptlint.py"),
+             "--rules", "flag", "--write-baseline",
+             "--baseline", str(tmp_path / "b.json")],
+            capture_output=True, text=True, cwd=REPO_ROOT)
+        assert r.returncode == 2
+        assert "cannot be combined" in r.stderr
+        assert not (tmp_path / "b.json").exists()
+
+    def test_cli_nonexistent_path_is_usage_error(self, tmp_path):
+        """A typo'd path must exit 2, not scan zero files and report
+        the tree clean."""
+        import subprocess
+        import sys
+
+        r = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO_ROOT, "tools", "ptlint.py"),
+             "no_such_dir"],
+            capture_output=True, text=True, cwd=REPO_ROOT)
+        assert r.returncode == 2
+        assert "not found" in r.stderr

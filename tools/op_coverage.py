@@ -457,6 +457,12 @@ def main():
     lines.append("\n".join(
         "- `%s` — %s (`paddle_tpu.%s`)" % (a, d, t)
         for a, d, t in BEYOND_REFERENCE))
+    lines.append("\n> Note: the old manual \"metrics documented?\" "
+                 "checklist item is superseded by ptlint's "
+                 "metric-registry pass (`python tools/ptlint.py "
+                 "--rules metric`), which machine-checks that every "
+                 "registered metric is literal, family-prefixed, "
+                 "label-consistent, and documented in README/BASELINE.")
     report = "\n".join(lines) + "\n"
     with open(os.path.join(REPO, "OP_COVERAGE.md"), "w") as f:
         f.write(report)

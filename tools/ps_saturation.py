@@ -119,7 +119,8 @@ def scaling(make_client, thread_counts, iters):
                                 dim=EMB)
                 counts[k] += 1
 
-        threads = [threading.Thread(target=worker, args=(k,))
+        threads = [threading.Thread(target=worker, args=(k,),
+                                    daemon=True)
                    for k in range(n)]
         t0 = time.perf_counter()
         for t in threads:

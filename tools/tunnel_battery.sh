@@ -25,6 +25,14 @@ run() {  # run <name> <timeout> <cmd...>
   return $rc
 }
 
+# 0a. static analysis: the invariant linter over the whole tree,
+#     committed as an artifact. Host-only (stdlib, no accelerator) so
+#     it runs before the tunnel probe — a red lint row must be visible
+#     even in a window where the tunnel is wedged. Config comes from
+#     [tool.ptlint] in pyproject.toml; rc!=0 means fresh findings or
+#     stale baseline entries (tools/ptlint_report.json names them).
+run ptlint 120 python tools/ptlint.py --out tools/ptlint_report.json
+
 # 0. pre-flight: bail fast if the tunnel is actually wedged
 run probe 240 python bench.py --probe || { echo "tunnel wedged; abort"; exit 3; }
 
