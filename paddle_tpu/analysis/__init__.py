@@ -5,7 +5,7 @@ Nine PRs of growth rest on hand-enforced invariants: default-off
 decode/train step, the monotonic-clock rule, lock-guarded daemon
 threads, and the single labeled metric registry. Reviewer memory does
 not scale to ROADMAP items 2-4 churning hundreds of files, so this
-package makes the invariants *mechanical*: ~6 AST passes over the
+package makes the invariants *mechanical*: ~7 AST passes over the
 whole tree, each encoding one discipline the repo already documents
 (README "Static analysis" has the catalog):
 
@@ -13,6 +13,9 @@ whole tree, each encoding one discipline the repo already documents
                   test-referenced, and never re-read per hot-path step
     trace         functions reachable from jax.jit/shard_map call sites
                   stay host-pure (no clocks, host RNG, print, sync)
+    compile-discipline
+                  traced bodies never read FLAGS_* / mutable module
+                  globals (values latch at trace time, never retrace)
     clock         time.time() never feeds duration/deadline arithmetic
                   (time.monotonic() does); wall clock is identity-only
     thread        spawned threads are daemon=True with a reachable stop
@@ -25,7 +28,9 @@ whole tree, each encoding one discipline the repo already documents
 Suppression is per-site (``# ptlint: <rule>-ok — reason``) and
 grandfathering is explicit (the checked-in baseline file named by
 ``[tool.ptlint]`` in pyproject.toml). ``tools/ptlint.py`` is the CLI;
-tests/test_ptlint.py holds the tier-1 tree-is-clean gate.
+tests/test_ptlint.py holds the tier-1 tree-is-clean gate. The sibling
+``analysis/graph`` package (tools/pthlo.py) runs the COMPILED-graph
+twin of these source passes over AOT-lowered fixtures.
 
 The reference stack ships exactly this kind of correctness tooling
 (nan/inf checkers, FLAGS_call_stack_level enforcement in enforce.h);

@@ -207,6 +207,12 @@ def _toml_value(raw):
     try:
         return int(raw)
     except ValueError:
+        pass
+    # floats too: [tool.ptlint.graph] thresholds (e.g. bucket sizes in
+    # MiB) are naturally fractional
+    try:
+        return float(raw)
+    except ValueError:
         return raw
 
 

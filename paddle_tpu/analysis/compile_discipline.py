@@ -1,0 +1,247 @@
+"""compile-discipline pass: traced bodies must not read runtime flags
+or mutable module globals.
+
+This is the source-side twin of the graph analyzer's recompile hazard
+(paddle_tpu/analysis/graph): ``jax.jit`` caches on function identity
+and argument shapes, NOT on flag values — a ``flags.flag("FLAGS_x")``
+read inside a traced body silently latches whatever the flag held at
+first trace, and a later ``set_flags`` neither retraces nor errors.
+Same for a module global rebound at runtime (``global X`` + assignment
+somewhere): the trace captures one snapshot forever. Both look like
+working code in every test that sets the flag before building the step.
+
+The repo convention (PR-9, enforced for hot paths by the flag pass) is
+the construction latch: read flags in ``__init__``, close over the
+value. This pass proves the complement over every traced body.
+
+Mechanics mirror the trace pass: roots are callables handed to
+``jax.jit``/``pjit``/``shard_map`` (first positional arg or decorator),
+PLUS ``self.method`` first-args resolved through the call site's
+enclosing class — the serving engine's ``jax.jit(self._mixed_fn)``
+idiom, which the trace pass deliberately skips. Reachability is the
+same module-local name-resolved BFS, extended with same-class
+``self.method()`` calls.
+"""
+from __future__ import annotations
+
+import ast
+
+from .astutil import FuncIndex, import_aliases, resolve_call, \
+    scope_statements
+from .base import Finding
+from .trace_purity import _JIT_HEADS
+
+RULE = "compile-discipline"
+
+
+def _mutable_globals(tree):
+    """Module-level names rebound at runtime: declared ``global X``
+    inside some def AND assigned there. These are exactly the names
+    whose trace-time read is a stale snapshot."""
+    out = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        declared = set()
+        for st in ast.walk(node):
+            if isinstance(st, ast.Global):
+                declared.update(st.names)
+        if not declared:
+            continue
+        for st in ast.walk(node):
+            targets = []
+            if isinstance(st, ast.Assign):
+                targets = st.targets
+            elif isinstance(st, (ast.AugAssign, ast.AnnAssign)):
+                targets = [st.target]
+            for t in targets:
+                if isinstance(t, ast.Name) and t.id in declared:
+                    out.add(t.id)
+    return out
+
+
+def _local_bindings(fn):
+    """Names bound inside ``fn``'s own scope (params, assignments,
+    for-targets, with-as, comprehension-free walk) — a Load of one of
+    these shadows any module global of the same name."""
+    bound = set()
+    args = fn.args
+    for a in (list(args.posonlyargs) + list(args.args)
+              + list(args.kwonlyargs)):
+        bound.add(a.arg)
+    if args.vararg:
+        bound.add(args.vararg.arg)
+    if args.kwarg:
+        bound.add(args.kwarg.arg)
+    if isinstance(fn, ast.Lambda):
+        return bound
+    for st in scope_statements(fn):
+        for node in ast.walk(st):
+            if isinstance(node, ast.Name) and \
+                    isinstance(node.ctx, ast.Store):
+                bound.add(node.id)
+    return bound
+
+
+def _jit_roots(tree, aliases, index):
+    """Defs handed to jit/pjit/shard_map: Name/Lambda first-args and
+    decorators (the trace pass's set) plus ``self.method`` first-args
+    resolved via the enclosing class of the CALL site."""
+    roots = {}
+
+    def note(node, why):
+        if isinstance(node, ast.Name):
+            for d in index.defs.get(node.id, ()):
+                roots.setdefault(id(d), (d, why))
+        elif isinstance(node, ast.Lambda):
+            roots.setdefault(id(node), (node, why))
+
+    # Name/Lambda roots + decorators anywhere in the module
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            name = resolve_call(node, aliases)
+            if name in _JIT_HEADS and node.args:
+                note(node.args[0], name)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                if resolve_call(ast.Call(func=target, args=[],
+                                         keywords=[]),
+                                aliases) in _JIT_HEADS:
+                    roots.setdefault(id(node), (node, "decorator"))
+
+    # self.method roots: jit(self._fn) inside a method of class C ->
+    # C._fn is traced
+    for defs in index.defs.values():
+        for caller in defs:
+            cls = index.enclosing_class(caller)
+            if cls is None:
+                continue
+            for st in scope_statements(caller):
+                for node in ast.walk(st):
+                    if not isinstance(node, ast.Call) or not node.args:
+                        continue
+                    if resolve_call(node, aliases) not in _JIT_HEADS:
+                        continue
+                    a0 = node.args[0]
+                    if isinstance(a0, ast.Attribute) and \
+                            isinstance(a0.value, ast.Name) and \
+                            a0.value.id == "self":
+                        target = index.methods.get(cls, {}).get(a0.attr)
+                        if target is not None:
+                            roots.setdefault(
+                                id(target),
+                                (target, "jit(self.%s)" % a0.attr))
+    return list(roots.values())
+
+
+def _reachable(root, index):
+    """BFS over direct Name calls plus same-class self.method calls."""
+    seen = {}
+    queue = [root]
+    while queue:
+        node = queue.pop()
+        if id(node) in seen:
+            continue
+        seen[id(node)] = node
+        body = [ast.Expr(value=node.body)] \
+            if isinstance(node, ast.Lambda) else node.body
+        cls = None if isinstance(node, ast.Lambda) \
+            else index.enclosing_class(node)
+        for st in body:
+            for n in ast.walk(st):
+                if not isinstance(n, ast.Call):
+                    continue
+                if isinstance(n.func, ast.Name):
+                    for d in index.defs.get(n.func.id, ()):
+                        queue.append(d)
+                elif cls is not None and \
+                        isinstance(n.func, ast.Attribute) and \
+                        isinstance(n.func.value, ast.Name) and \
+                        n.func.value.id == "self":
+                    target = index.methods.get(cls, {}).get(n.func.attr)
+                    if target is not None:
+                        queue.append(target)
+    return list(seen.values())
+
+
+def _scan_fn(sf, fn, qual, root_name, aliases, mutable):
+    out = []
+    n = 0
+    seen = set()    # scope_statements flattening nests: dedupe
+    if isinstance(fn, ast.Lambda):
+        body = [ast.Expr(value=fn.body)]
+    else:
+        body = scope_statements(fn)
+    local = _local_bindings(fn)
+    for st in body:
+        for node in ast.walk(st):
+            why = what = None
+            line = getattr(node, "lineno", None)
+            if isinstance(node, ast.Call) and id(node) not in seen:
+                seen.add(id(node))
+                name = resolve_call(node, aliases) or ""
+                leaf = name.rsplit(".", 1)[-1]
+                if leaf == "flag" and node.args and \
+                        isinstance(node.args[0], ast.Constant) and \
+                        isinstance(node.args[0].value, str) and \
+                        node.args[0].value.startswith("FLAGS_"):
+                    what = "%s(%r)" % (name, node.args[0].value)
+                    why = ("flag read latches its trace-time value " \
+                           "into the compiled step (set_flags after " \
+                           "build never retraces) — latch it at " \
+                           "construction instead")
+                elif leaf in ("set_flags", "get_flags"):
+                    what = "%s(...)" % name
+                    why = ("flag-table access executes at TRACE time " \
+                           "only; the compiled step never sees it " \
+                           "again")
+            elif isinstance(node, ast.Name) and \
+                    isinstance(node.ctx, ast.Load) and \
+                    node.id in mutable and node.id not in local and \
+                    id(node) not in seen:
+                seen.add(id(node))
+                what = node.id
+                why = ("mutable module global (rebound via 'global' "
+                       "elsewhere) — the trace captures one snapshot "
+                       "and never re-reads it")
+            if why is None:
+                continue
+            if sf.suppressed(RULE, [line]):
+                continue
+            n += 1
+            out.append(Finding(
+                RULE, sf.relpath, line,
+                "%s:%s#%d" % (qual, what, n),
+                "%s inside %r (traced: reachable from %s): %s"
+                % (what, qual, root_name, why)))
+    return out
+
+
+def run_pass(project):
+    findings = []
+    for sf in project.files:
+        tree = sf.tree
+        if tree is None:
+            continue
+        aliases = import_aliases(tree)
+        index = FuncIndex(tree)
+        roots = _jit_roots(tree, aliases, index)
+        if not roots:
+            continue
+        mutable = _mutable_globals(tree)
+        seen_fn = set()
+        for root, why in roots:
+            for fn in _reachable(root, index):
+                if id(fn) in seen_fn:
+                    continue
+                seen_fn.add(id(fn))
+                qual = index.qualname.get(id(fn),
+                                          getattr(fn, "name",
+                                                  "<lambda>"))
+                root_qual = index.qualname.get(
+                    id(root), getattr(root, "name", "<lambda>"))
+                findings.extend(_scan_fn(
+                    sf, fn, qual, "%s via %s" % (root_qual, why),
+                    aliases, mutable))
+    return findings

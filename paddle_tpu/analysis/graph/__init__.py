@@ -1,0 +1,49 @@
+"""pthlo — compiled-graph static analysis (the ptlint of lowered HLO).
+
+ptlint (the sibling package) machine-checks SOURCE-level invariants;
+the repo's hardest-won guarantees, though, live in the COMPILED graph:
+``decode_compiles == 1``, one quantized all-reduce chain per bucket,
+donated step state actually aliased, zero host transfers inside the
+hot step. Until now those were pinned ad hoc — PR-4 counted
+all-to-alls in one test's HLO text, PR-9 pinned compile counts
+dynamically — leaving everything else about the lowered artifact
+unchecked. Here the lowered graph becomes the artifact of record
+(the T3 overlap work and the whole-program-compilation thesis in
+PAPERS.md both treat it that way):
+
+- **fixtures.py** registers small, structurally faithful programs
+  (llama/gpt/ernie train steps across the quantized-sync/bucket flag
+  matrix, a pipelined step, the serving engine's ONE step across the
+  prefix x chunked matrix), built through the engines' own
+  ``graph_report()`` hooks — AOT lower + compile, never execute;
+- **hlo.py** parses the StableHLO/HLO texts (stdlib-only, fixture-
+  testable without jax);
+- **donation.py / collectives.py / hostlint.py / sharding.py** are
+  the graph passes: donation/aliasing audit, collective-schedule
+  extraction + self-expectations, host-transfer & f64 lint, and the
+  per-param-class layout report ROADMAP item 5's SpecLayout will
+  diff against;
+- **contract.py** pins the collective schedule to the checked-in
+  ``tools/graph_contract.json`` — drift fails the gate;
+- **runner.py** orchestrates; ``tools/pthlo.py`` is the CLI
+  (``--check`` / ``--write-contract``, text/JSON, exit 0/1/2, config
+  from ``[tool.ptlint.graph]``).
+
+tests/test_pthlo.py holds the tier-1 gate (zero findings, zero
+contract drift over the checked-in fixtures) and the flag-matrix
+compile-signature pins.
+"""
+from __future__ import annotations
+
+from .fixtures import (  # noqa: F401
+    GRAPH_FIXTURES,
+    build_fixture,
+    fingerprint,
+    graph_fixture,
+)
+from .runner import (  # noqa: F401
+    GRAPH_RULES,
+    graph_config,
+    render_graph_text,
+    run_graph,
+)

@@ -1,0 +1,80 @@
+"""Engine-side artifact helpers for graph_report() hooks.
+
+The engines (parallel/engine.py, parallel/pipeline_parallel.py,
+serving/engine.py) each AOT-lower their compiled step and hand the
+analyzer raw texts plus a PER-LEAF argument census. The census is what
+lets the donation audit name a specific buffer: ``Lowered.args_info``
+carries (aval, donated) per input leaf in flat order, and the engine
+knows which span of leaves is carried state vs weights vs per-call
+input. jit's ``keep_unused=False`` may DROP an unused leaf from the
+lowered signature, so the audit aligns census to signature by
+(dims, dtype) subsequence matching — see graph/donation.py.
+
+This module must import without jax (the analyzer's parsers are
+stdlib-only); jax objects only ever arrive as arguments.
+"""
+from __future__ import annotations
+
+# numpy dtype name -> MLIR tensor element spelling (the form
+# parse_main_args reports). PRNG key avals stringify as "key<fry>" and
+# stay as-is — the aligner treats unknown dtypes leniently.
+_NP_TO_MLIR = {
+    "float64": "f64", "float32": "f32", "float16": "f16",
+    "bfloat16": "bf16",
+    "int64": "i64", "int32": "i32", "int16": "i16", "int8": "i8",
+    "uint64": "ui64", "uint32": "ui32", "uint16": "ui16",
+    "uint8": "ui8", "bool": "i1",
+    "float8_e4m3fn": "f8E4M3FN", "float8_e5m2": "f8E5M2",
+}
+
+
+def mlir_dtype(np_name):
+    return _NP_TO_MLIR.get(str(np_name), str(np_name))
+
+
+def arg_leaf_census(args_info_leaves, class_spans):
+    """[{class, dims, dtype, donated}] per input leaf, flat order.
+
+    ``args_info_leaves`` — ``jax.tree_util.tree_leaves(lowered.
+    args_info)`` (ArgInfo objects with ``.aval`` / ``.donated``).
+    ``class_spans`` — [(class_name, leaf_count), ...] covering the flat
+    argument order; counts must sum to the leaf count.
+    """
+    classes = []
+    for cls, n in class_spans:
+        classes.extend([cls] * int(n))
+    if len(classes) != len(args_info_leaves):
+        raise ValueError(
+            "arg class spans cover %d leaves but args_info has %d"
+            % (len(classes), len(args_info_leaves)))
+    out = []
+    for cls, info in zip(classes, args_info_leaves):
+        # jax.stages ArgInfo: .aval on newer versions, ._aval on 0.4.x
+        aval = getattr(info, "aval", None)
+        if aval is None:
+            aval = getattr(info, "_aval", None)
+        out.append({
+            "class": cls,
+            "dims": [int(d) for d in getattr(aval, "shape", ())],
+            "dtype": mlir_dtype(getattr(aval, "dtype", "?")),
+            "donated": bool(info.donated),
+        })
+    return out
+
+
+def param_census(named_values, spec_of=None):
+    """{name: {bytes, dtype, spec}} for a (name -> array) mapping.
+    ``spec_of(name)`` supplies the sharding string (default:
+    'single-device')."""
+    out = {}
+    for n, v in named_values:
+        nbytes = v.dtype.itemsize
+        for d in v.shape:
+            nbytes *= int(d)
+        out[n] = {
+            "bytes": int(nbytes),
+            "dtype": str(v.dtype),
+            "spec": (spec_of(n) if spec_of is not None
+                     else "single-device"),
+        }
+    return out

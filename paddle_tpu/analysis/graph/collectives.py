@@ -1,0 +1,77 @@
+"""Collective-schedule extraction + per-fixture expectations.
+
+PR-4 proved its bucket coalescing by counting all-to-alls in HLO text
+inside one test; this pass makes that the general mechanism: walk the
+compiled HLO for all-reduce / all-gather / all-to-all / reduce-scatter
+/ collective-permute, record per-kind op counts, payload bytes and the
+dependency DEPTH of the schedule (the longest chain of collectives
+that must serialize through dataflow — count minus depth is the
+overlappable slack ROADMAP item 4's T3 work will chase), and check the
+structural expectations the fixture itself declares:
+
+- a quantized-sync fixture knows its bucket count (from
+  ``FLAGS_grad_sync_bucket_mb`` via the step's resolved plan): the
+  two-phase reduce must show EXACTLY 2 all-to-alls and 2 all-gathers
+  per bucket (int8 payload + f32 block scales each) — a flag combo
+  silently adding or fusing a collective is a finding here, before any
+  checked-in contract is consulted;
+- a single-device fixture must show no collectives at all.
+
+Cross-run drift against ``tools/graph_contract.json`` is the contract
+module's job; this pass only extracts and checks self-expectations.
+"""
+from __future__ import annotations
+
+from ..base import Finding
+from . import hlo as H
+
+RULE = "collective-expectation"
+
+
+def run(fixture_name, step_name, step, expected_buckets=None,
+        single_device=False, instrs=None):
+    """(findings, report) for one step artifact. ``instrs`` takes a
+    pre-parsed instruction list (the runner parses each step's HLO
+    once and shares it across passes)."""
+    if instrs is None:
+        instrs = H.parse_instructions(step["hlo"])
+    ops, depth = H.collective_schedule(instrs)
+    counts = {}
+    nbytes = {}
+    for o in ops:
+        counts[o["kind"]] = counts.get(o["kind"], 0) + 1
+        nbytes[o["kind"]] = nbytes.get(o["kind"], 0) + o["bytes"]
+    findings = []
+    site = "%s/%s" % (fixture_name, step_name)
+    if expected_buckets is not None:
+        # two-phase quantized all-reduce: per bucket, one all-to-all +
+        # one all-gather EACH for the int8 payload and its f32 scales
+        want = 2 * expected_buckets
+        for kind in ("all-to-all", "all-gather"):
+            got = counts.get(kind, 0)
+            if got != want:
+                findings.append(Finding(
+                    RULE, site, 0,
+                    "%s:%s:buckets" % (step_name, kind),
+                    "quantized grad sync resolved %d bucket(s) "
+                    "(FLAGS_grad_sync_bucket_mb) so the HLO must "
+                    "carry %d %s ops (payload + scales per bucket), "
+                    "found %d — the compiled schedule no longer "
+                    "matches the bucket plan"
+                    % (expected_buckets, want, kind, got)))
+    if single_device and ops:
+        findings.append(Finding(
+            RULE, site, 0,
+            "%s:unexpected-collectives" % step_name,
+            "single-device fixture lowered %d collective op(s) (%s) — "
+            "a sharding annotation or mesh leak is inserting "
+            "cross-device traffic where none can exist"
+            % (len(ops), ", ".join(sorted(counts)))))
+    report = {
+        "counts": counts,
+        "payload_bytes": nbytes,
+        "total": len(ops),
+        "depth": depth,
+        "overlappable": len(ops) - depth,
+    }
+    return findings, report

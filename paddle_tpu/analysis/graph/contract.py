@@ -1,0 +1,135 @@
+"""Checked-in collective-schedule contract (tools/graph_contract.json).
+
+The ptlint flag pass made BASELINE.md's disposition table a
+machine-checked contract; this is the same move for the compiled
+graph: per fixture, per compiled step, the collective op counts,
+payload bytes and dependency depth are written once
+(``pthlo --write-contract``) and every later run must match. Drift —
+a flag combo silently adding a collective, a bucket plan diverging
+from ``FLAGS_grad_sync_bucket_mb``, an XLA upgrade reshuffling the
+schedule — fails the gate with the exact kind/count named. Refreshing
+the file is deliberate and reviewable, never incidental.
+
+Subset semantics mirror ptlint's ``--rules``: fixtures not selected
+for a run are not judged (their contract rows are neither checked nor
+stale), so a targeted ``--fixtures`` invocation cannot eat the other
+rows' protection.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+from ..base import Finding
+
+RULE = "collective-contract"
+
+KIND = "pthlo_contract"
+
+
+def load(path):
+    if not path or not os.path.exists(path):
+        return None
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    return data if data.get("kind") == KIND else None
+
+
+def from_report(fixtures_report):
+    """Contract rows from a run's per-fixture report sections."""
+    rows = {}
+    for name, fx in sorted(fixtures_report.items()):
+        if fx.get("skipped"):
+            continue
+        steps = {}
+        for sname, srep in sorted((fx.get("steps") or {}).items()):
+            col = srep.get("collectives") or {}
+            steps[sname] = {
+                "collectives": dict(sorted(
+                    (col.get("counts") or {}).items())),
+                "payload_bytes": dict(sorted(
+                    (col.get("payload_bytes") or {}).items())),
+                "depth": col.get("depth", 0),
+            }
+        rows[name] = steps
+    return {
+        "kind": KIND,
+        "version": 1,
+        "comment": "machine-checked collective schedule per graph "
+                   "fixture (tools/pthlo.py). Regenerate ONLY via "
+                   "--write-contract and review the diff: a changed "
+                   "count is a changed wire protocol.",
+        "fixtures": rows,
+    }
+
+
+def write(path, data):
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+def compare(contract, fixtures_report):
+    """Findings for every divergence between the checked-in contract
+    and this run's report, over the fixtures that actually ran."""
+    findings = []
+    rows = (contract or {}).get("fixtures") or {}
+    for name, fx in sorted(fixtures_report.items()):
+        if fx.get("skipped"):
+            continue
+        want_steps = rows.get(name)
+        if want_steps is None:
+            findings.append(Finding(
+                RULE, name, 0, "contract:missing-fixture",
+                "fixture %r has no row in the contract file — run "
+                "`pthlo --write-contract` and review/commit the new "
+                "schedule" % name))
+            continue
+        got_steps = fx.get("steps") or {}
+        for sname in sorted(set(want_steps) | set(got_steps)):
+            want = want_steps.get(sname)
+            srep = got_steps.get(sname)
+            site = "%s/%s" % (name, sname)
+            if want is None:
+                findings.append(Finding(
+                    RULE, site, 0, "contract:new-step:%s" % sname,
+                    "step %r is not in the contract row — the fixture "
+                    "now lowers a program the contract never saw"
+                    % sname))
+                continue
+            if srep is None:
+                findings.append(Finding(
+                    RULE, site, 0, "contract:lost-step:%s" % sname,
+                    "contract names step %r but the fixture no longer "
+                    "lowers it — refresh the contract" % sname))
+                continue
+            col = srep.get("collectives") or {}
+            got_counts = col.get("counts") or {}
+            want_counts = want.get("collectives") or {}
+            for kind in sorted(set(want_counts) | set(got_counts)):
+                g, w = got_counts.get(kind, 0), want_counts.get(kind, 0)
+                if g != w:
+                    findings.append(Finding(
+                        RULE, site, 0,
+                        "contract:%s:%s:count" % (sname, kind),
+                        "%s count drifted: contract %d, lowered %d — "
+                        "a flag combo or dependency change altered "
+                        "the comm schedule" % (kind, w, g)))
+            got_bytes = col.get("payload_bytes") or {}
+            want_bytes = want.get("payload_bytes") or {}
+            for kind in sorted(set(want_bytes) | set(got_bytes)):
+                g, w = got_bytes.get(kind, 0), want_bytes.get(kind, 0)
+                if g != w:
+                    findings.append(Finding(
+                        RULE, site, 0,
+                        "contract:%s:%s:bytes" % (sname, kind),
+                        "%s payload drifted: contract %d bytes, "
+                        "lowered %d bytes" % (kind, w, g)))
+            g, w = col.get("depth", 0), want.get("depth", 0)
+            if g != w:
+                findings.append(Finding(
+                    RULE, site, 0, "contract:%s:depth" % sname,
+                    "collective dependency depth drifted: contract "
+                    "%d, lowered %d — the serialized-vs-overlappable "
+                    "split changed" % (w, g)))
+    return findings

@@ -1,0 +1,130 @@
+"""Donation/aliasing audit over one lowered step.
+
+The HBM story every fixture's ``hbm_peak_bytes`` tells rests on
+donation actually working: a carried-state buffer (params + opt slots
+in a train step, the KV pools in a serving step) that is NOT aliased
+in ``input_output_aliases`` exists TWICE at the step's peak — input
+and output — and the perf numbers silently absorb the doubling. jax
+only *warns* when a donation is unusable, and nobody reads warnings in
+CI; this pass turns the property into a gate.
+
+Two checks per step:
+
+1. every census leaf of class ``state`` must carry a donation marker
+   in the lowered signature (``tf.aliasing_output`` — jax matched it
+   to an output — or ``jax.buffer_donor``), UNLESS jit dropped it as
+   unused (an untransferred buffer costs nothing);
+2. any signature argument at or above ``min_bytes`` whose census class
+   is ``state`` but which lacks the marker is reported with its shape
+   — the finding names the buffer, not just a count.
+
+Alignment: ``keep_unused=False`` may drop census leaves from the
+signature, so census and signature are matched as an ordered
+subsequence on (dims, dtype) — dropped leaves are skipped, unknown
+dtypes (PRNG keys) match leniently.
+"""
+from __future__ import annotations
+
+from ..base import Finding
+from . import hlo as H
+
+RULE = "donation"
+
+# below this, an unaliased buffer is reported but not a finding:
+# scalars and tiny step counters don't move an HBM needle
+DEFAULT_MIN_BYTES = 1 << 16
+
+
+def align(census, sig_args):
+    """Match signature args to census leaves as an ordered subsequence
+    on (dims, dtype). Returns ``[(sig_arg, census_leaf | None)]`` —
+    every signature arg paired with the census leaf it came from (None
+    when alignment failed), plus the list of census leaves the
+    signature dropped."""
+    pairs = []
+    dropped = []
+    ci = 0
+    for arg in sig_args:
+        leaf = None
+        while ci < len(census):
+            cand = census[ci]
+            dims_ok = list(cand["dims"]) == list(arg["dims"])
+            dtype_ok = (cand["dtype"] == arg["dtype"]
+                        or cand["dtype"] not in H.MLIR_DTYPE_BYTES)
+            if dims_ok and dtype_ok:
+                leaf = cand
+                ci += 1
+                break
+            # PRNG keys: key<fry>[] census leaf lowers to ui32[2]
+            if cand["dtype"] not in H.MLIR_DTYPE_BYTES:
+                leaf = cand
+                ci += 1
+                break
+            dropped.append(cand)
+            ci += 1
+        pairs.append((arg, leaf))
+    dropped.extend(census[ci:])
+    return pairs, dropped
+
+
+def run(fixture_name, step_name, step, min_bytes=DEFAULT_MIN_BYTES,
+        hot=True):
+    """(findings, report) for one step artifact."""
+    census = step.get("arg_leaves") or []
+    sig = H.parse_main_args(step["stablehlo"])
+    aliases = H.parse_alias_header(step["hlo"])
+    pairs, dropped = align(census, sig)
+    n_state = sum(1 for c in census if c["class"] == "state")
+    # the COMPILED module's input_output_alias header is authoritative:
+    # tf.aliasing_output records jax's own matching and
+    # jax.buffer_donor only records the donation REQUEST — XLA may
+    # still decline (layout/sharding mismatch), and a declined
+    # donation is exactly the silent HBM doubling this pass exists to
+    # catch. The StableHLO attrs are only a fallback for a dump whose
+    # header the parser could not read (attrs claim aliasing, header
+    # parse came up empty).
+    attr_marked = any(a["aliased"] for a in sig)
+    use_header = bool(aliases) or not attr_marked
+    n_marked = 0
+    unaliased = []
+    for arg, leaf in pairs:
+        if use_header:
+            marked = arg["index"] in aliases
+        else:
+            marked = arg["aliased"] or arg["donor"]
+        if leaf is None or leaf["class"] != "state":
+            continue
+        if marked:
+            n_marked += 1
+        else:
+            unaliased.append({
+                "index": arg["index"],
+                "dims": list(arg["dims"]),
+                "dtype": arg["dtype"],
+                "bytes": arg["bytes"],
+            })
+    findings = []
+    site = "%s/%s" % (fixture_name, step_name)
+    for u in unaliased:
+        if not hot or u["bytes"] < min_bytes:
+            continue
+        findings.append(Finding(
+            RULE, site, 0,
+            "%s:arg%d:%s[%s]" % (step_name, u["index"], u["dtype"],
+                                 "x".join(map(str, u["dims"]))),
+            "carried-state buffer %%arg%d %s[%s] (%d bytes) is not "
+            "aliased in input_output_aliases — it exists twice at the "
+            "step's HBM peak and the hbm_peak_bytes this fixture "
+            "reports silently absorbs the doubling (donate it, or "
+            "reclass it if it is genuinely per-call input)"
+            % (u["index"], u["dtype"],
+               "x".join(map(str, u["dims"])), u["bytes"])))
+    report = {
+        "state_leaves": n_state,
+        "state_aliased": n_marked,
+        "state_unaliased": unaliased,
+        "unaliased_bytes": sum(u["bytes"] for u in unaliased),
+        "dropped_unused_leaves": len(dropped),
+        "hlo_alias_entries": len(aliases),
+    }
+    return findings, report

@@ -1,0 +1,318 @@
+"""Registered graph fixtures: the programs the analyzer lowers.
+
+Each fixture builds a SMALL but structurally faithful engine — tiny
+llama/gpt/ernie ``CompiledTrainStep``s across the quantized-sync /
+bucket flag matrix, a ``PipelinedTrainStep``, and the serving engine's
+ONE step across the prefix-cache x chunked-prefill matrix — and calls
+its ``graph_report()`` hook (AOT lower + compile, never execute). The
+model geometry is deliberately minuscule (hidden 32, 2 layers, vocab
+64): every property the passes check — donation aliasing, collective
+counts per bucket, host transfers, f64 leaks, per-class layouts — is
+SHAPE-structural, identical at 32 or 4096 hidden.
+
+Builders are hermetic: flags and the global mesh are snapshotted and
+restored, so the tier-1 gate can run fixtures in-process next to every
+other test. Fixtures declare the device count they need and are
+skipped (visibly — the runner records why) when the backend has fewer;
+tools/pthlo.py forces 8 virtual CPU devices before importing jax, the
+same harness tests/conftest.py sets up.
+"""
+from __future__ import annotations
+
+import hashlib
+import re
+
+GRAPH_FIXTURES = {}
+
+
+class _Fixture:
+    __slots__ = ("name", "fn", "needs_devices", "hot", "single_device",
+                 "doc")
+
+    def __init__(self, name, fn, needs_devices, hot, single_device):
+        self.name = name
+        self.fn = fn
+        self.needs_devices = needs_devices
+        self.hot = hot
+        self.single_device = single_device
+        self.doc = (fn.__doc__ or "").strip().splitlines()[0] \
+            if fn.__doc__ else ""
+
+
+def graph_fixture(name, needs_devices=1, hot=True, single_device=None):
+    """Register a fixture builder. ``hot`` marks the program as a hot
+    step (host-transfer/f64/donation findings fire); ``single_device``
+    (default: needs_devices == 1) arms the no-collectives check."""
+    def deco(fn):
+        GRAPH_FIXTURES[name] = _Fixture(
+            name, fn, needs_devices, hot,
+            needs_devices == 1 if single_device is None
+            else single_device)
+        return fn
+    return deco
+
+
+_HEX_RE = re.compile(r"0x[0-9a-f]{6,}")
+
+
+def fingerprint(text):
+    """Content hash of a jaxpr/StableHLO text, hex addresses masked (a
+    leaked object repr must not make every build unique)."""
+    return hashlib.sha256(
+        _HEX_RE.sub("0x0", text or "").encode()).hexdigest()[:24]
+
+
+class _Env:
+    """Snapshot/restore of the process-global state builders touch."""
+
+    def __enter__(self):
+        from ...core import flags as fl
+        from ...distributed import mesh as pmesh
+
+        self._flags = fl.get_flags()
+        self._mesh = pmesh._global_mesh
+        return self
+
+    def __exit__(self, *exc):
+        from ...core import flags as fl
+        from ...distributed import mesh as pmesh
+
+        cur = fl.get_flags()
+        fl.set_flags({k: v for k, v in self._flags.items()
+                      if cur.get(k) != v})
+        pmesh.set_mesh(self._mesh)
+        return False
+
+
+def build_fixture(name):
+    """Build one fixture hermetically; returns the artifact dict with
+    fixture metadata merged in. Raises KeyError for unknown names."""
+    fx = GRAPH_FIXTURES[name]
+    import jax
+
+    if jax.device_count() < fx.needs_devices:
+        return {"name": name, "skipped":
+                "needs %d devices, backend has %d"
+                % (fx.needs_devices, jax.device_count())}
+    with _Env():
+        art = fx.fn()
+    art["name"] = name
+    art["hot"] = fx.hot
+    art["single_device"] = fx.single_device
+    for step in art.get("steps", {}).values():
+        step["fingerprint"] = fingerprint(
+            step.get("jaxpr") or step.get("stablehlo"))
+    return art
+
+
+# -- builders ----------------------------------------------------------------
+
+def _tiny_llama(use_parallel=False):
+    import paddle_tpu as paddle
+    from ...models.llama import LlamaConfig, LlamaForCausalLM
+
+    paddle.seed(0)
+    cfg = LlamaConfig(vocab_size=64, hidden_size=32,
+                      intermediate_size=64, num_hidden_layers=2,
+                      num_attention_heads=4,
+                      max_position_embeddings=64,
+                      use_parallel=use_parallel)
+    return LlamaForCausalLM(cfg), cfg
+
+
+def _train_step(model, cfg, **kw):
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from ...parallel.engine import CompiledTrainStep
+
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+
+    def loss_fn(logits, labels):
+        return F.cross_entropy(
+            logits.reshape([-1, cfg.vocab_size]),
+            labels.reshape([-1]))
+
+    return CompiledTrainStep(model, loss_fn, opt, **kw)
+
+
+def _ids(batch, seq, vocab, seed=0):
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    rng = np.random.RandomState(seed)
+    return (paddle.to_tensor(
+                rng.randint(0, vocab, (batch, seq)).astype(np.int32)),
+            paddle.to_tensor(
+                rng.randint(0, vocab, (batch, seq)).astype(np.int32)))
+
+
+@graph_fixture("llama_train", needs_devices=1)
+def _llama_train():
+    """tiny llama CompiledTrainStep, exact path, single device."""
+    import jax
+
+    from ...distributed import mesh as pmesh
+
+    pmesh.build_hybrid_mesh(dp=1, devices=jax.devices()[:1])
+    model, cfg = _tiny_llama()
+    step = _train_step(model, cfg)
+    ids, labels = _ids(2, 16, cfg.vocab_size)
+    return step.graph_report(ids, labels)
+
+
+def _qsync_report(bucket_mb=None):
+    from ...core import flags as fl
+    from ...distributed import mesh as pmesh
+
+    pmesh.build_hybrid_mesh(dp=4, sharding=2)
+    flags = {"FLAGS_quantized_grad_sync": True}
+    if bucket_mb is not None:
+        flags["FLAGS_grad_sync_bucket_mb"] = bucket_mb
+    fl.set_flags(flags)
+    model, cfg = _tiny_llama()
+    step = _train_step(model, cfg)
+    ids, labels = _ids(8, 16, cfg.vocab_size)
+    return step.graph_report(ids, labels)
+
+
+@graph_fixture("llama_train_qsync", needs_devices=8,
+               single_device=False)
+def _llama_train_qsync():
+    """quantized grad sync, default FLAGS_grad_sync_bucket_mb (one
+    bucket at this model size): the two-phase reduce's collective
+    counts are pinned against the resolved bucket plan."""
+    return _qsync_report()
+
+
+@graph_fixture("llama_train_qsync_fine", needs_devices=8,
+               single_device=False)
+def _llama_train_qsync_fine():
+    """quantized grad sync with a sub-byte bucket threshold: one
+    bucket PER PARAMETER — the other end of the bucket matrix, where a
+    count drift means the coalescing plan itself changed."""
+    return _qsync_report(bucket_mb=1e-6)
+
+
+@graph_fixture("gpt_train", needs_devices=1)
+def _gpt_train():
+    """tiny GPT CompiledTrainStep (labels_to_model loss path)."""
+    import jax
+
+    import paddle_tpu as paddle
+    from ...distributed import mesh as pmesh
+    from ...models.gpt import GPTModel
+    from ...parallel.engine import CompiledTrainStep
+
+    pmesh.build_hybrid_mesh(dp=1, devices=jax.devices()[:1])
+    paddle.seed(0)
+    model = GPTModel(vocab_size=64, hidden_size=32, num_layers=2,
+                     num_heads=4, ffn_size=64, max_seq_len=64)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+    step = CompiledTrainStep(model, None, opt, labels_to_model=True)
+    ids, labels = _ids(2, 16, 64)
+    return step.graph_report(ids, labels)
+
+
+@graph_fixture("ernie_train", needs_devices=1)
+def _ernie_train():
+    """tiny ERNIE MLM pretraining step (fused_lm_head_ce-eligible
+    labels_to_model path)."""
+    import jax
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from ...distributed import mesh as pmesh
+    from ...models.ernie import ErnieConfig, ErnieForPretraining
+    from ...parallel.engine import CompiledTrainStep
+
+    pmesh.build_hybrid_mesh(dp=1, devices=jax.devices()[:1])
+    paddle.seed(0)
+    cfg = ErnieConfig.tiny()
+    model = ErnieForPretraining(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+    step = CompiledTrainStep(model, None, opt, labels_to_model=True)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (2, 16)).astype(np.int32)
+    tt = rng.randint(0, cfg.type_vocab_size, (2, 16)).astype(np.int32)
+    masked = ids.astype(np.int64).copy()
+    masked[:, ::2] = -100
+    return step.graph_report(paddle.to_tensor(ids),
+                             paddle.to_tensor(tt),
+                             paddle.to_tensor(masked))
+
+
+@graph_fixture("pipeline_train", needs_devices=2,
+               single_device=False)
+def _pipeline_train():
+    """tiny llama PipelinedTrainStep over pp=2: the ring's
+    collective-permutes are the schedule under contract here."""
+    import jax
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from ...distributed import mesh as pmesh
+    from ...parallel.pipeline_parallel import PipelinedTrainStep
+
+    pmesh.build_hybrid_mesh(dp=1, pp=2, devices=jax.devices()[:2])
+    model, cfg = _tiny_llama()
+
+    def loss_fn(logits, labels):
+        return F.cross_entropy(
+            logits.reshape([-1, cfg.vocab_size]),
+            labels.reshape([-1]))
+
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+    step = PipelinedTrainStep(model, loss_fn, opt, n_micro=2)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (4, 16)).astype(np.int32)
+    labels = rng.randint(0, cfg.vocab_size, (4, 16)).astype(np.int32)
+    return step.graph_report(paddle.to_tensor(ids),
+                             paddle.to_tensor(labels))
+
+
+def _serving_report(prefix_cache, chunked_prefill):
+    import jax
+
+    from ... import serving
+    from ...core import flags as fl
+    from ...distributed import mesh as pmesh
+
+    pmesh.build_hybrid_mesh(dp=1, devices=jax.devices()[:1])
+    fl.set_flags({"FLAGS_serving_prefix_cache": prefix_cache,
+                  "FLAGS_serving_chunked_prefill": chunked_prefill})
+    model, _cfg = _tiny_llama()
+    eng = serving.Engine(model, max_slots=4, num_blocks=32,
+                         block_size=8)
+    return eng.graph_report()
+
+
+@graph_fixture("serving_base", needs_devices=1)
+def _serving_base():
+    """tier-1 serving engine: split decode + bucketed prefill."""
+    return _serving_report(False, False)
+
+
+@graph_fixture("serving_prefix", needs_devices=1)
+def _serving_prefix():
+    """prefix cache on: decode + hist-parameterized suffix prefill."""
+    return _serving_report(True, False)
+
+
+@graph_fixture("serving_chunked", needs_devices=1)
+def _serving_chunked():
+    """chunked prefill on: the ONE mixed ragged step."""
+    return _serving_report(False, True)
+
+
+@graph_fixture("serving_prefix_chunked", needs_devices=1)
+def _serving_prefix_chunked():
+    """both tier-2 flags: still the ONE mixed step — its fingerprint
+    must equal serving_chunked's (the prefix cache changes admission,
+    never the compiled program; the signature test pins this)."""
+    return _serving_report(True, True)

@@ -1,0 +1,61 @@
+"""Host-transfer & dtype lint over one lowered step.
+
+The compiled hot step must stay on-device and in the intended
+precision. Two families of graph-level findings:
+
+- **host transfers**: infeed / outfeed / send / recv instructions, and
+  custom-calls whose target names a host callback (python callbacks,
+  SendToHost/RecvFromHost). A ``jax.debug.print`` or a stray
+  ``io_callback`` left in a step serializes every dispatch through the
+  host — invisible in tests (they pass, slowly), fatal to throughput.
+- **f64 upcasts**: any instruction producing an f64 result. The TPU
+  path is f32/bf16 by design; f64 appears when a python float sneaks
+  into a jnp op with ``float64`` enabled or a numpy default leaks in.
+  s64/u64 INDEX math is deliberately not flagged — the hazard is
+  double-precision FLOPs, not wide integers.
+
+Both only fire on hot-step fixtures; diagnostic/offline programs may
+legitimately talk to the host.
+"""
+from __future__ import annotations
+
+from ..base import Finding
+from . import hlo as H
+
+RULE_HOST = "host-transfer"
+RULE_DTYPE = "dtype"
+
+
+def run(fixture_name, step_name, step, hot=True, instrs=None):
+    """(findings, report) for one step artifact. ``instrs`` takes a
+    pre-parsed instruction list (the runner parses each step's HLO
+    once and shares it across passes)."""
+    if instrs is None:
+        instrs = H.parse_instructions(step["hlo"])
+    host = H.find_host_transfers(instrs)
+    f64 = H.find_f64_ops(instrs)
+    findings = []
+    site = "%s/%s" % (fixture_name, step_name)
+    if hot:
+        for ins, what in host:
+            findings.append(Finding(
+                RULE_HOST, site, ins.line,
+                "%s:%s:%s" % (step_name, ins.op, what),
+                "host transfer %r (%s) inside the hot step — every "
+                "dispatch round-trips the host; move it out of the "
+                "compiled step or behind a debug flag" % (what, ins.op)))
+        for ins in f64:
+            findings.append(Finding(
+                RULE_DTYPE, site, ins.line,
+                "%s:f64:%s" % (step_name, ins.op),
+                "f64 result %s in op %r on the TPU path — "
+                "double-precision compute is ~0 FLOPs/s on MXU "
+                "hardware; find the python float / numpy default that "
+                "upcast this" % (ins.shapes, ins.op)))
+    report = {
+        "host_transfers": [
+            {"op": ins.op, "target": what} for ins, what in host],
+        "f64_ops": [
+            {"op": ins.op, "name": ins.name} for ins in f64],
+    }
+    return findings, report

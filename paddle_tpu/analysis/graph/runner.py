@@ -1,0 +1,196 @@
+"""pthlo runner: build fixtures, run the graph passes, check the
+contract, render the report.
+
+The flow mirrors analysis/runner.py (ptlint) one level up: where
+ptlint's unit is a source file and its passes walk ASTs, pthlo's unit
+is a REGISTERED FIXTURE (a lowered compiled step) and its passes walk
+jaxpr/StableHLO/HLO text. There is no baseline here — a graph finding
+is either fixed or the fixture/threshold is changed in review; the
+only checked-in state is the collective contract, which ``
+--write-contract`` regenerates wholesale.
+"""
+from __future__ import annotations
+
+import os
+
+from ..base import Finding
+from . import collectives, contract as contract_mod, donation, \
+    fixtures as fixtures_mod, hlo as hlo_mod, hostlint, sharding
+
+# pass vocabulary (report order); the fixture rule covers build/skip
+# failures so an analyzer that cannot see a fixture can never report
+# the tree clean
+GRAPH_RULES = ("fixture", donation.RULE, collectives.RULE,
+               contract_mod.RULE, hostlint.RULE_HOST,
+               hostlint.RULE_DTYPE, sharding.RULE)
+
+DEFAULT_CONTRACT = "tools/graph_contract.json"
+
+
+def graph_config(config):
+    """The [tool.ptlint.graph] table with defaults filled in."""
+    g = dict((config or {}).get("graph") or {})
+    g.setdefault("contract", DEFAULT_CONTRACT)
+    g.setdefault("donation_min_bytes", donation.DEFAULT_MIN_BYTES)
+    g.setdefault("large_param_bytes", 1 << 16)
+    g.setdefault("gather_min_bytes", 1 << 14)
+    g.setdefault("fixtures", sorted(fixtures_mod.GRAPH_FIXTURES))
+    return g
+
+
+def run_graph(root, config=None, fixtures=None, check_contract=True):
+    """Build + analyze the selected fixtures.
+
+    Returns ``(report, findings)``. ``fixtures`` (list of names)
+    restricts the run — contract rows for unselected fixtures are then
+    neither checked nor stale, the ptlint --rules semantics."""
+    gcfg = graph_config(config)
+    names = list(fixtures or gcfg["fixtures"])
+    unknown = [n for n in names
+               if n not in fixtures_mod.GRAPH_FIXTURES]
+    if unknown:
+        raise KeyError("unknown graph fixture(s): %s (have: %s)"
+                       % (unknown,
+                          ", ".join(sorted(
+                              fixtures_mod.GRAPH_FIXTURES))))
+    findings = []
+    fx_report = {}
+    for name in names:
+        fx = fixtures_mod.GRAPH_FIXTURES[name]
+        try:
+            art = fixtures_mod.build_fixture(name)
+        except Exception as e:   # a fixture that cannot build is a
+            findings.append(Finding(   # finding, not a crash
+                "fixture", name, 0, "build-error",
+                "fixture failed to build: %r" % (e,)))
+            fx_report[name] = {"skipped": "build error: %r" % (e,)}
+            continue
+        if art.get("skipped"):
+            findings.append(Finding(
+                "fixture", name, 0, "skipped",
+                "fixture skipped (%s) — the analyzer cannot vouch for "
+                "a graph it never lowered" % art["skipped"]))
+            fx_report[name] = {"skipped": art["skipped"]}
+            continue
+        steps_report = {}
+        instrs_by_step = {}
+        for sname, step in sorted(art["steps"].items()):
+            # parse each step's HLO text once; the collective, host
+            # and sharding passes all walk the same instruction list
+            instrs = instrs_by_step[sname] = hlo_mod.parse_instructions(
+                step["hlo"])
+            dfind, drep = donation.run(
+                name, sname, step,
+                min_bytes=gcfg["donation_min_bytes"], hot=fx.hot)
+            cfind, crep = collectives.run(
+                name, sname, step,
+                expected_buckets=art.get("qsync_buckets"),
+                single_device=fx.single_device, instrs=instrs)
+            hfind, hrep = hostlint.run(name, sname, step, hot=fx.hot,
+                                       instrs=instrs)
+            findings.extend(dfind + cfind + hfind)
+            steps_report[sname] = {
+                "fingerprint": step.get("fingerprint"),
+                "donation": drep,
+                "collectives": crep,
+                "host": hrep,
+                "cost": step.get("cost"),
+            }
+        sfind, srep = sharding.run(
+            name, art.get("params") or {}, art["steps"],
+            art.get("mesh_axes"),
+            large_bytes=gcfg["large_param_bytes"],
+            gather_min_bytes=gcfg["gather_min_bytes"],
+            instrs_by_step=instrs_by_step)
+        findings.extend(sfind)
+        fx_report[name] = {
+            "kind": art.get("kind"),
+            "hot": fx.hot,
+            "doc": fx.doc,
+            "qsync_buckets": art.get("qsync_buckets"),
+            "flags": art.get("flags"),
+            "steps": steps_report,
+            "sharding": srep,
+        }
+    contract_status = "unchecked"
+    if check_contract:
+        path = gcfg["contract"]
+        if path and not os.path.isabs(path):
+            path = os.path.join(root, path)
+        data = contract_mod.load(path)
+        if data is None:
+            findings.append(Finding(
+                contract_mod.RULE, gcfg["contract"], 0,
+                "contract:missing-file",
+                "no contract file at %r — run `pthlo "
+                "--write-contract` and commit it" % gcfg["contract"]))
+            contract_status = "missing"
+        else:
+            drift = contract_mod.compare(data, fx_report)
+            # a contract row no REGISTERED fixture owns (deleted or
+            # renamed fixture) can never be checked again — surfacing
+            # it on every run, subset or not, is the only way the
+            # file tracks the registry (ptlint's unknown-rule
+            # baseline logic)
+            for name in sorted((data.get("fixtures") or {})):
+                if name not in fixtures_mod.GRAPH_FIXTURES:
+                    drift.append(Finding(
+                        contract_mod.RULE, name, 0,
+                        "contract:stale-row",
+                        "contract row %r matches no registered "
+                        "fixture — the fixture was deleted or "
+                        "renamed; refresh the contract" % name))
+            findings.extend(drift)
+            contract_status = "drift" if drift else "match"
+    report = {
+        "kind": "pthlo_report",
+        "version": 1,
+        "fixtures": fx_report,
+        "contract": {"path": gcfg["contract"],
+                     "status": contract_status},
+        "findings": [f.to_dict() for f in findings],
+        "per_rule": _counts(findings),
+    }
+    return report, findings
+
+
+def _counts(findings):
+    out = {}
+    for f in findings:
+        out[f.rule] = out.get(f.rule, 0) + 1
+    return out
+
+
+def render_graph_text(report, out=None):
+    lines = []
+    for name, fx in sorted(report["fixtures"].items()):
+        if fx.get("skipped"):
+            lines.append("%-24s SKIPPED: %s" % (name, fx["skipped"]))
+            continue
+        for sname, srep in sorted((fx.get("steps") or {}).items()):
+            col = srep["collectives"]
+            don = srep["donation"]
+            host = srep["host"]
+            cstr = " ".join("%s=%d" % (k, v) for k, v in
+                            sorted(col["counts"].items())) or "none"
+            lines.append(
+                "%-24s %-14s collectives: %s depth=%d  donated %d/%d"
+                "  host=%d f64=%d"
+                % (name, sname, cstr, col["depth"],
+                   don["state_aliased"], don["state_leaves"],
+                   len(host["host_transfers"]), len(host["f64_ops"])))
+        sh = fx.get("sharding") or {}
+        classes = sh.get("classes") or {}
+        if classes:
+            lines.append("%-24s layouts: %s" % ("", "; ".join(
+                "%s[%d]=%s" % (c, v["params"],
+                               "|".join(sorted(v["specs"])))
+                for c, v in sorted(classes.items()))))
+    findings = report.get("findings") or []
+    for f in findings:
+        lines.append("%s: %s: %s" % (f["path"], f["rule"],
+                                     f["message"]))
+    lines.append("pthlo: %d fixture(s), %d finding(s), contract %s"
+                 % (len(report["fixtures"]), len(findings),
+                    report["contract"]["status"]))
+    return "\n".join(lines)

@@ -1,0 +1,104 @@
+"""Sharding report: the layout baseline SpecLayout will diff against.
+
+ROADMAP item 5 plans one canonical named-axis sharding layer; its
+parity pin needs a machine-readable record of what the layouts ARE
+today. This pass produces it from the fixture's param census + lowered
+HLO:
+
+- **per-class layouts**: params classified (embed / attn / mlp / norm /
+  head / other) with the distinct PartitionSpecs and byte totals each
+  class carries — every class must name at least one layout (the
+  acceptance pin for the llama fixture);
+- **large-but-replicated**: params at/above the size threshold whose
+  spec binds no mesh axis while the mesh has a >1 non-batch axis —
+  these are the ZeRO-3/TP candidates item 5 will move first (report
+  rows, not findings: on a pure data-parallel mesh replicated weights
+  are the correct layout);
+- **gather shapes**: the largest gathers in the compiled step — the
+  GSPMD mp embedding-gather full-remat pattern PR-8 had to skip a
+  multichip gate over manifests here first.
+"""
+from __future__ import annotations
+
+import re
+
+from ..base import Finding
+from . import hlo as H
+
+RULE = "sharding"
+
+# parameter-name classification, first match wins
+_CLASS_PATTERNS = (
+    ("embed", re.compile(r"embed|wte|wpe|word_emb|pos_emb|token_type")),
+    ("attn", re.compile(r"attn|attention|q_proj|k_proj|v_proj|o_proj|"
+                        r"qkv")),
+    ("mlp", re.compile(r"mlp|gate_proj|up_proj|down_proj|ffn|fc\d|"
+                       r"linear\d|intermediate|dense")),
+    ("norm", re.compile(r"norm|ln_|_ln|layernorm")),
+    ("head", re.compile(r"lm_head|head|classifier|pooler|predictions")),
+)
+
+
+def classify(name):
+    low = name.lower()
+    for cls, pat in _CLASS_PATTERNS:
+        if pat.search(low):
+            return cls
+    return "other"
+
+
+def _replicated(spec):
+    s = (spec or "").replace(" ", "")
+    return s in ("PartitionSpec()", "P()", "single-device", "None", "")
+
+
+def run(fixture_name, params, steps, mesh_axes,
+        large_bytes=1 << 16, gather_min_bytes=1 << 14,
+        instrs_by_step=None):
+    """(findings, report) over the fixture's param census + steps.
+    ``instrs_by_step`` maps step name → pre-parsed instruction list
+    (the runner parses each step's HLO once and shares it across
+    passes)."""
+    classes = {}
+    large_replicated = []
+    findings = []
+    nonbatch = 0
+    if mesh_axes:
+        nonbatch = max([n for a, n in mesh_axes.items()
+                        if a not in ("dp", "sharding")] or [0])
+    for name, p in sorted(params.items()):
+        cls = classes.setdefault(classify(name),
+                                 {"params": 0, "bytes": 0, "specs": {}})
+        cls["params"] += 1
+        cls["bytes"] += p["bytes"]
+        spec = p.get("spec") or "?"
+        cls["specs"][spec] = cls["specs"].get(spec, 0) + 1
+        if p.get("spec") is None:
+            findings.append(Finding(
+                RULE, fixture_name, 0, "param:%s:no-spec" % name,
+                "param %r reports no sharding spec — the layout "
+                "baseline cannot cover it" % name))
+        if p["bytes"] >= large_bytes and _replicated(spec) \
+                and nonbatch > 1:
+            large_replicated.append(
+                {"param": name, "bytes": p["bytes"], "spec": spec})
+    gathers = []
+    for step_name, step in steps.items():
+        instrs = (instrs_by_step or {}).get(step_name)
+        if instrs is None:
+            instrs = H.parse_instructions(step["hlo"])
+        for ins in H.find_gathers(instrs, min_bytes=gather_min_bytes):
+            gathers.append({
+                "step": step_name,
+                "shapes": [[dt, list(dims)] for dt, dims in ins.shapes],
+                "bytes": ins.bytes,
+            })
+    gathers.sort(key=lambda g: -g["bytes"])
+    report = {
+        "classes": classes,
+        "large_replicated": sorted(large_replicated,
+                                   key=lambda r: -r["bytes"])[:16],
+        "gathers": gathers[:16],
+        "mesh_axes": mesh_axes,
+    }
+    return findings, report

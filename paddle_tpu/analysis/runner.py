@@ -3,8 +3,8 @@ from __future__ import annotations
 
 from collections import Counter
 
-from . import clocks, flags_pass, metrics_pass, silent_except, \
-    threads, trace_purity
+from . import clocks, compile_discipline, flags_pass, metrics_pass, \
+    silent_except, threads, trace_purity
 from .base import Baseline
 
 # rule id -> pass. Order is report order; ids are the pragma grammar
@@ -12,6 +12,7 @@ from .base import Baseline
 RULES = {
     flags_pass.RULE: flags_pass.run_pass,
     trace_purity.RULE: trace_purity.run_pass,
+    compile_discipline.RULE: compile_discipline.run_pass,
     clocks.RULE: clocks.run_pass,
     threads.RULE: threads.run_pass,
     metrics_pass.RULE: metrics_pass.run_pass,
@@ -21,7 +22,7 @@ RULES = {
 # passes whose findings may be grandfathered in the baseline file;
 # clock, silent-except and metric violations must be FIXED (or
 # pragma'd with a reason) — the baseline refuses to carry them.
-BASELINE_ELIGIBLE = ("flag", "trace", "thread")
+BASELINE_ELIGIBLE = ("flag", "trace", "compile-discipline", "thread")
 
 
 def run(project, rules=None, baseline=None):

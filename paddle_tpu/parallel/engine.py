@@ -712,6 +712,58 @@ class CompiledTrainStep:
             vals).compile()
         return _perf.executable_analysis(compiled, steps=1)
 
+    def graph_report(self, *batch):
+        """Lower (never execute) the single-step program for these
+        batch shapes and return the raw graph-analysis artifact the
+        offline analyzer (paddle_tpu/analysis/graph, tools/pthlo.py)
+        consumes: jaxpr + StableHLO + compiled-HLO text, the donated
+        leaf census, per-param shardings, and the XLA cost analysis.
+        AOT lower+compile like perf_analysis — fixture/bench tooling
+        only, never the training hot path."""
+        if self._compiled is None:
+            self._build()
+        vals = self._prep_batch(batch)
+        state_vals = [self._tensors[n]._value for n in self._names]
+        from ..framework import random as _random
+
+        from ..analysis.graph.artifact import arg_leaf_census, \
+            param_census
+
+        args = (state_vals, self._opt_state, self._ef_state,
+                jnp.asarray(0, jnp.int32),
+                jnp.asarray(0.0, jnp.float32), _random._key(), vals)
+        lowered = self._compiled.lower(*args)
+        compiled = lowered.compile()
+        leaves = jax.tree_util.tree_leaves
+        carried = len(leaves((args[0], args[1], args[2])))
+        total = len(leaves(args))
+        # class spans in FLAT ARGUMENT ORDER (the carried pytrees lead
+        # the signature): "state" must alias an output when donated;
+        # "input" is fresh per call and exempt from the donation audit
+        spans = [("state" if self.donate else "input", carried),
+                 ("input", total - carried)]
+        specs = self._specs()
+        return {
+            "kind": "train",
+            "steps": {
+                "step": {
+                    "hlo": compiled.as_text(),
+                    "stablehlo": lowered.as_text(),
+                    "jaxpr": str(jax.make_jaxpr(self._step_fn)(*args)),
+                    "arg_leaves": arg_leaf_census(
+                        leaves(lowered.args_info), spans),
+                    "cost": _perf.executable_analysis(compiled,
+                                                      steps=1),
+                },
+            },
+            "params": param_census(
+                ((n, self._tensors[n]._value) for n in self._names),
+                spec_of=lambda n: str(specs[n])),
+            "mesh_axes": dict(self.mesh.shape),
+            "qsync_buckets": (len(self._qsync[2])
+                              if self._qsync is not None else None),
+        }
+
     def _note_perf(self, vals, steps, dt, loss, t0, t1, stacked=False):
         """Feed one engine call into the MFU/phase attribution. The
         analysis always lowers the SINGLE-step executable (per-step

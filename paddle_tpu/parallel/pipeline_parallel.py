@@ -917,6 +917,65 @@ class PipelinedTrainStep:
             _random._key(), batch).compile()
         return _perf.executable_analysis(compiled, steps=1)
 
+    def graph_report(self, input_ids, labels):
+        """Raw graph-analysis artifact of the pipelined step for the
+        offline analyzer (paddle_tpu/analysis/graph, tools/pthlo.py):
+        jaxpr + StableHLO + compiled-HLO text, donated leaf census,
+        per-param shardings, XLA cost analysis. AOT lower+compile —
+        fixture tooling only, same discipline as perf_analysis."""
+        from ..framework import random as _random
+        from ..monitor import perf as _perf
+
+        if self._compiled is None:
+            self._build()
+        batch = tuple(
+            jax.device_put(b._value if isinstance(b, Tensor)
+                           else jnp.asarray(b),
+                           self._ns(self.batch_spec))
+            for b in (input_ids, labels))
+        tensors = self.model.raw_state_tensors()
+        nb_vals = [tensors[n]._value for n in self._nb_names]
+        stacked_vals = [self._stacked[s] for s in self.suffixes]
+        from ..analysis.graph.artifact import arg_leaf_census, \
+            param_census
+
+        args = (nb_vals, stacked_vals, self._opt_state,
+                jnp.asarray(0, jnp.int32), jnp.asarray(0.0, jnp.float32),
+                _random._key(), batch)
+        lowered = self._compiled.lower(*args)
+        compiled = lowered.compile()
+        leaves = jax.tree_util.tree_leaves
+        carried = len(leaves((args[0], args[1], args[2])))
+        total = len(leaves(args))
+        spans = [("state" if self.donate else "input", carried),
+                 ("input", total - carried)]
+        named = [(n, tensors[n]._value) for n in self._nb_names]
+        named += [("pp_blocks." + s, self._stacked[s])
+                  for s in self.suffixes]
+        spec_strs = {n: str(self._nb_specs[n]) for n in self._nb_names}
+        spec_strs.update({"pp_blocks." + s: str(self._stacked_specs[s])
+                          for s in self.suffixes})
+        return {
+            "kind": "pipeline",
+            "steps": {
+                "step": {
+                    "hlo": compiled.as_text(),
+                    "stablehlo": lowered.as_text(),
+                    "jaxpr": "",    # the jitted fn is rebuilt per
+                                    # _build; the stablehlo text is the
+                                    # fingerprint substrate here
+                    "arg_leaves": arg_leaf_census(
+                        leaves(lowered.args_info), spans),
+                    "cost": _perf.executable_analysis(compiled,
+                                                      steps=1),
+                },
+            },
+            "params": param_census(named,
+                                   spec_of=lambda n: spec_strs[n]),
+            "mesh_axes": dict(self.mesh.shape),
+            "qsync_buckets": None,
+        }
+
     def _note_perf(self, batch, dt, loss, t0, t1):
         from ..monitor import perf as _perf
 

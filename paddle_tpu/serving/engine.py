@@ -172,20 +172,31 @@ class Engine:
         # slot_tokens[s]: last generated token, not yet written to KV —
         # the next decode step's input for that slot
         self._slot_tokens = np.zeros((max_slots,), np.int32)
+        # donate_argnums=(1,): the KV pools are CARRIED state — every
+        # step consumes the previous pools and returns the next, and
+        # the caller rebinds self.cache.pools immediately — so the
+        # buffers must alias in-place (input_output_aliases) instead of
+        # doubling the pool's HBM footprint every step. The pthlo
+        # donation audit (paddle_tpu/analysis/graph) pins this: an
+        # un-donated pool in the hot step is a finding. Weights
+        # (state_vals, arg 0) are deliberately NOT donated — the same
+        # buffers feed every subsequent call.
         if self.chunked_prefill:
             # ONE mixed ragged step serves decode rows AND prefill
             # chunks (a decode row is the q_len==1 case); the split
             # decode/prefill functions are never traced
-            self._mixed = jax.jit(self._mixed_fn)
+            self._mixed = jax.jit(self._mixed_fn, donate_argnums=(1,))
         else:
-            self._decode = jax.jit(self._decode_fn)
+            self._decode = jax.jit(self._decode_fn, donate_argnums=(1,))
             if self.prefix_cache is not None:
                 # cache-aware prefill: runs only the uncached suffix
                 # over the adopted pool history (hist == 0 on a miss),
                 # jitted per suffix-length bucket like _prefill was
-                self._suffix_prefill = jax.jit(self._suffix_prefill_fn)
+                self._suffix_prefill = jax.jit(self._suffix_prefill_fn,
+                                               donate_argnums=(1,))
             else:
-                self._prefill = jax.jit(self._prefill_fn)
+                self._prefill = jax.jit(self._prefill_fn,
+                                        donate_argnums=(1,))
 
     # -- public API -------------------------------------------------------
 
@@ -396,6 +407,53 @@ class Engine:
         req.close(RequestState.FAILED, "poison", error=exc)
         self._quarantine.discard(req.id)
         self.metrics.on_request_shed("poison")
+        self._recover_consumed_pools()
+
+    def _recover_consumed_pools(self):
+        """The donated-pools failure path: the compiled steps donate
+        their input pools (``donate_argnums=(1,)``), so a step that
+        raises AFTER execution started leaves ``cache.pools`` pointing
+        at DELETED buffers — every slot's KV, not just the failing
+        request's, is gone. (A pre-dispatch failure — fault injection,
+        a trace-time error — never consumes anything and this is one
+        cheap liveness check.) Recovery is preempt-by-recompute for
+        every occupied slot over a fresh zeroed pool plane: recompute
+        re-prefills from host-side tokens deterministically, so
+        outputs stay bit-identical and a one-step transient cannot
+        become permanent engine death. The prefix cache is REBUILT,
+        not kept: its pages map into the dead pools, and the
+        keep-warm release path must not re-serve garbage KV — which
+        is also why the requeue below bypasses scheduler.release
+        (its insert would cache those pages)."""
+        if self.cache.pools_alive():
+            return
+        from ..monitor.registry import warn_once
+
+        warn_once(
+            "serving.pools_consumed",
+            "paddle_tpu.serving: a compiled step failed after its "
+            "donated KV pools were consumed; resetting the pool "
+            "plane and requeueing every occupied slot "
+            "(preempt-by-recompute)")
+        # reversed + requeue_front, the _on_decode_failure idiom:
+        # appendleft in reverse slot order keeps the survivors'
+        # re-admission strictly FCFS
+        for slot, req in reversed(list(self.scheduler.occupied())):
+            if self.scheduler.slots[slot] is not req:
+                continue
+            self.cache.release_slot(slot)
+            self.scheduler.slots[slot] = None
+            req.slot = None
+            req.state = RequestState.PREEMPTED
+            req.metrics.preemptions += 1
+            self.scheduler.requeue_front(req)
+            self.metrics.on_preemption()
+        if self.prefix_cache is not None:
+            from .prefix_cache import RadixPrefixCache
+
+            self.prefix_cache = RadixPrefixCache(self.cache)
+            self.scheduler.prefix_cache = self.prefix_cache
+        self.cache.reset_pools()
 
     def _prefill_request(self, slot, req):
         # per-request injection site: the poison-request model — an
@@ -637,6 +695,7 @@ class Engine:
                 req.trace_phase(
                     "preempted", seq_len=seq_len, quarantine=True,
                     slots_active=self.scheduler.slots_active())
+        self._recover_consumed_pools()
 
     def _accept_token(self, req, tok):
         req.generated.append(tok)
@@ -663,6 +722,83 @@ class Engine:
             self._quarantine.discard(req.id)   # survived serial decode
             self.metrics.on_request_finished(len(req.generated))
             req.trace_finish("finished")
+
+    # -- graph analysis ---------------------------------------------------
+
+    def graph_report(self):
+        """AOT-lower (never execute) every compiled step this engine
+        configuration would run — the ONE mixed step under chunked
+        prefill, else decode + the live prefill variant — and return
+        the raw graph-analysis artifact for the offline analyzer
+        (paddle_tpu/analysis/graph, tools/pthlo.py): jaxpr + StableHLO
+        + compiled-HLO text per step, the donated-pool leaf census,
+        and the weight census. Representative shapes are the engine's
+        own fixed shapes (that fixedness IS the compile-once
+        contract). Tracing counts into the compile metrics like any
+        trace; call this on fixture engines, not mid-serve."""
+        import jax.tree_util as jtu
+
+        from ..analysis.graph.artifact import arg_leaf_census, \
+            param_census
+        from ..monitor import perf as _perf
+
+        S = self.max_slots
+        pools = self.cache.pools
+        bt = jnp.asarray(self.cache.block_tables)
+        lens = jnp.asarray(self.cache.seq_lens)
+
+        def artifact(jit_fn, raw_fn, args):
+            lowered = jit_fn.lower(*args)
+            compiled = lowered.compile()
+            # weights feed every call (never donated); pools are
+            # carried state and MUST alias; the rest is per-call input
+            spans = [("weights", len(jtu.tree_leaves(args[0]))),
+                     ("state", len(jtu.tree_leaves(args[1]))),
+                     ("input", len(jtu.tree_leaves(args[2:])))]
+            return {
+                "hlo": compiled.as_text(),
+                "stablehlo": lowered.as_text(),
+                "jaxpr": str(jax.make_jaxpr(raw_fn)(*args)),
+                "arg_leaves": arg_leaf_census(
+                    jtu.tree_leaves(lowered.args_info), spans),
+                "cost": _perf.executable_analysis(compiled, steps=1),
+            }
+
+        steps = {}
+        if self.chunked_prefill:
+            toks = jnp.zeros((S, self.prefill_chunk), jnp.int32)
+            ql = jnp.zeros((S,), jnp.int32)
+            steps["mixed"] = artifact(
+                self._mixed, self._mixed_fn,
+                (self._state_vals, pools, toks, bt, lens, ql))
+        else:
+            toks = jnp.zeros((S,), jnp.int32)
+            steps["decode"] = artifact(
+                self._decode, self._decode_fn,
+                (self._state_vals, pools, toks, bt, lens))
+            P = self._bucket(8)
+            ids = jnp.zeros((1, P), jnp.int32)
+            row = jnp.asarray(self.cache.block_tables[0])
+            if self.prefix_cache is not None:
+                steps["suffix_prefill"] = artifact(
+                    self._suffix_prefill, self._suffix_prefill_fn,
+                    (self._state_vals, pools, ids, row,
+                     jnp.asarray(0, jnp.int32),
+                     jnp.asarray(P, jnp.int32)))
+            else:
+                steps["prefill"] = artifact(
+                    self._prefill, self._prefill_fn,
+                    (self._state_vals, pools, ids, row,
+                     jnp.asarray(P, jnp.int32)))
+        return {
+            "kind": "serving",
+            "params": param_census(zip(self._names, self._state_vals)),
+            "steps": steps,
+            "mesh_axes": None,
+            "qsync_buckets": None,
+            "flags": {"prefix_cache": self.prefix_cache is not None,
+                      "chunked_prefill": self.chunked_prefill},
+        }
 
     # -- compiled steps ---------------------------------------------------
 

@@ -243,6 +243,37 @@ class PagedKVCache:
         self.block_tables[slot, :] = TRASH_BLOCK
         self.seq_lens[slot] = 0
 
+    def pools_alive(self):
+        """False once the pool buffers were CONSUMED by donation: the
+        engine's compiled steps donate their input pools
+        (``donate_argnums``), so a step that raises AFTER execution
+        started leaves these arrays deleted — readable shape/dtype,
+        unreadable data."""
+        try:
+            return not any(p.k.is_deleted() or p.v.is_deleted()
+                           for p in self.pools)
+        except AttributeError:      # non-jax pools (unit fixtures)
+            return True
+
+    def reset_pools(self):
+        """Fresh zeroed pool plane + allocator + per-slot bookkeeping —
+        the donated-pools failure recovery. When a compiled step
+        consumes its input pools (donation) and then fails, every KV
+        byte is gone and every page mapping refers to garbage; the
+        caller requeues the occupied slots first (preempt-by-recompute
+        re-prefills from host-side tokens, so nothing durable lived
+        only in the pools) and then rebuilds the plane here. Shapes
+        and dtypes survive a deleted jax array, so the new pools match
+        the compiled steps' signatures exactly — no retrace."""
+        self.pools = [
+            KVBlockPool(jnp.zeros(p.k.shape, p.k.dtype),
+                        jnp.zeros(p.v.shape, p.v.dtype))
+            for p in self.pools]
+        self.allocator = BlockAllocator(int(self.pools[0].k.shape[0]))
+        self.block_tables[:] = TRASH_BLOCK
+        self.seq_lens[:] = 0
+        self._slot_pages = [[] for _ in range(self.max_slots)]
+
 
 def _raw(x):
     return x._value if hasattr(x, "_value") else jnp.asarray(x)

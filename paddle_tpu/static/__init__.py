@@ -383,6 +383,10 @@ def _run_tape(program):
     if segments and len(segments) > 1:
         return _run_tape_recompute(program, segments)
 
+    # ptlint: compile-discipline-ok — the flag picks HOW the tape is
+    # replayed (native vs python driver) while building the graph; it
+    # is a per-compile host decision, never a value baked into the
+    # compiled program
     use_native = _flags.get_flags().get("FLAGS_use_native_interpreter", True)
     if use_native and program.tape:
         try:
@@ -397,6 +401,9 @@ def _run_tape(program):
                 interp._version = program.version
                 program._native_interp = interp
             except Exception:
+                # ptlint: compile-discipline-ok — verbosity check on the
+                # native-interpreter fallback path; trace-time diagnostic
+                # only, nothing graph-visible depends on it
                 if _flags.get_flags().get("FLAGS_v", 0) > 0:
                     import traceback
 

@@ -1,0 +1,406 @@
+"""pthlo: compiled-graph analysis — parser units, the tier-1 gate,
+and the flag-matrix compile-signature pins.
+
+Three layers:
+
+1. **Parser units** — the HLO/StableHLO text extractors on literal
+   fixtures (tuple-typed all-to-alls, nested-brace alias headers,
+   quoted sharding attrs): jax-free, so a parser regression is named
+   directly instead of surfacing as a weird gate failure.
+2. **The gate** — run_graph over the REAL registered fixtures with the
+   checked-in config + contract: zero findings, zero drift, nothing
+   skipped. This is the tier-1 twin of ptlint's TestTreeIsClean: a
+   donation regression, a stray collective, a host callback or an f64
+   leak in any engine's compiled step fails HERE, in-process.
+3. **Compile signatures** — the serving mixed step and the train step
+   lower to a STABLE fingerprint (jaxpr hash) per flag combo, and the
+   combos that must share a program do: flipping the prefix cache must
+   not re-lower the ONE mixed step, rebuilding the same combo must
+   reproduce the hash bit-for-bit. A silent recompile across the
+   prefix x chunked x quantized matrix is a red test, not a production
+   latency surprise.
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from paddle_tpu.analysis import load_config
+from paddle_tpu.analysis.graph import hlo as H
+from paddle_tpu.analysis.graph import (GRAPH_FIXTURES, build_fixture,
+                                       run_graph)
+from paddle_tpu.analysis.graph import contract as contract_mod
+from paddle_tpu.analysis.graph import donation
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# parser units (no jax required beyond import side effects)
+# ---------------------------------------------------------------------------
+
+_HLO_SNIPPET = textwrap.dedent("""\
+    HloModule jit_step, is_scheduled=true, input_output_alias={ {1}: (0, {}, may-alias), {2, 0}: (3, {}, must-alias) }, entry_computation_layout={()->()}
+
+    %region_1.23 (a: f32[]) -> f32[] {
+      %a = f32[] parameter(0)
+      ROOT %add.1 = f32[] add(f32[] %a, f32[] %a)
+    }
+
+    ENTRY %main.42 (p0: f32[8,4], p1: s8[256]) -> (f32[8,4]) {
+      %p0 = f32[8,4]{1,0} parameter(0)
+      %p1 = s8[256]{0} parameter(1)
+      %q = s8[1,256]{1,0} reshape(s8[256]{0} %p1)
+      %all-to-all.4 = (s8[1,256]{1,0}, s8[1,256]{1,0}) all-to-all(s8[1,256]{1,0} %q, s8[1,256]{1,0} %q), replica_groups={{0,1}}
+      %gte.1 = s8[1,256]{1,0} get-tuple-element((s8[1,256]{1,0}, s8[1,256]{1,0}) %all-to-all.4), index=0
+      %ag.1 = s8[2,256]{1,0} all-gather(s8[1,256]{1,0} %gte.1), channel_id=4, dimensions={0}
+      %conv.9 = f64[8,4]{1,0} convert(f32[8,4]{1,0} %p0)
+      %cc.1 = f32[8,4]{1,0} custom-call(f32[8,4]{1,0} %p0), custom_call_target="xla_ffi_python_cpu_callback"
+      %cc.2 = f32[8,4]{1,0} custom-call(f32[8,4]{1,0} %p0), custom_call_target="lapack_sgetrf"
+      %ar.1 = f32[8,4]{1,0} all-reduce(f32[8,4]{1,0} %p0), to_apply=%region_1.23
+      ROOT %t = (f32[8,4]{1,0}) tuple(f32[8,4]{1,0} %cc.1)
+    }
+""")
+
+
+class TestHloParsers:
+    def test_instructions_and_tuple_types(self):
+        instrs = H.parse_instructions(_HLO_SNIPPET)
+        by_name = {i.name: i for i in instrs}
+        a2a = by_name["all-to-all.4"]
+        assert a2a.op == "all-to-all"
+        # tuple result: 2 x s8[1,256] = 512 bytes
+        assert a2a.bytes == 512
+        assert a2a.computation == "main.42"
+        assert "q" in a2a.operands
+        assert by_name["add.1"].computation == "region_1.23"
+        assert by_name["t"].root
+
+    def test_alias_header_nested_braces(self):
+        aliases = H.parse_alias_header(_HLO_SNIPPET)
+        assert aliases == {0: 1, 3: 2}
+
+    def test_collective_schedule_counts_bytes_depth(self):
+        instrs = H.parse_instructions(_HLO_SNIPPET)
+        ops, depth = H.collective_schedule(instrs)
+        counts = {}
+        for o in ops:
+            counts[o["kind"]] = counts.get(o["kind"], 0) + 1
+        assert counts == {"all-to-all": 1, "all-gather": 1,
+                          "all-reduce": 1}
+        # ag.1 consumes gte.1 <- all-to-all.4: a 2-deep chain; the
+        # all-reduce is independent
+        assert depth == 2
+        a2a = [o for o in ops if o["kind"] == "all-to-all"][0]
+        assert a2a["bytes"] == 512
+
+    def test_f64_and_host_transfer_lint(self):
+        instrs = H.parse_instructions(_HLO_SNIPPET)
+        f64 = H.find_f64_ops(instrs)
+        assert [i.op for i in f64] == ["convert"]
+        host = H.find_host_transfers(instrs)
+        # the python callback is a host transfer; the LAPACK compute
+        # custom-call is not
+        assert [what for _, what in host] == \
+            ["xla_ffi_python_cpu_callback"]
+
+    def test_main_args_aliasing_and_quoted_sharding(self):
+        sh = ('module @jit_f {\n'
+              '  func.func public @main('
+              '%arg0: tensor<128x4xf32> {tf.aliasing_output = 0 : i32},'
+              ' %arg1: tensor<4xi32>,'
+              ' %arg2: tensor<2x2xbf16> {jax.buffer_donor = true,'
+              ' mhlo.sharding = "{devices=[2,1]0,1}"})'
+              ' -> (tensor<128x4xf32>) {\n'
+              '    return %arg0 : tensor<128x4xf32>\n  }\n}\n')
+        args = H.parse_main_args(sh)
+        assert len(args) == 3
+        assert args[0]["aliased"] and not args[0]["donor"]
+        assert args[0]["bytes"] == 128 * 4 * 4
+        assert not args[1]["aliased"]
+        assert args[2]["donor"]
+        assert args[2]["sharding"] == "{devices=[2,1]0,1}"
+        assert args[2]["bytes"] == 2 * 2 * 2
+
+
+class TestDonationAlign:
+    def test_dropped_unused_leaf_realigns(self):
+        """keep_unused=False drops a census leaf from the signature:
+        the audit must still map every signature arg to the right
+        class instead of shifting everything by one."""
+        census = [
+            {"class": "state", "dims": [8, 4], "dtype": "f32",
+             "donated": True},
+            {"class": "input", "dims": [], "dtype": "f32",
+             "donated": False},          # dropped as unused
+            {"class": "input", "dims": [16], "dtype": "i32",
+             "donated": False},
+        ]
+        sig = [
+            {"index": 0, "dims": (8, 4), "dtype": "f32", "bytes": 128,
+             "aliased": True, "donor": False, "sharding": None},
+            {"index": 1, "dims": (16,), "dtype": "i32", "bytes": 64,
+             "aliased": False, "donor": False, "sharding": None},
+        ]
+        pairs, dropped = donation.align(census, sig)
+        assert [p[1]["class"] for p in pairs] == ["state", "input"]
+        assert len(dropped) == 1 and dropped[0]["dims"] == []
+
+    def test_unaliased_state_is_a_finding(self):
+        step = {
+            "arg_leaves": [
+                {"class": "state", "dims": [1024, 1024],
+                 "dtype": "f32", "donated": True}],
+            "stablehlo": ('func.func public @main('
+                          '%arg0: tensor<1024x1024xf32>) -> '
+                          '(tensor<1024x1024xf32>) {'),
+            "hlo": "HloModule jit_x, entry_computation_layout={()->()}",
+        }
+        findings, rep = donation.run("fx", "step", step,
+                                     min_bytes=1 << 16, hot=True)
+        assert len(findings) == 1
+        assert findings[0].rule == "donation"
+        assert "4194304 bytes" in findings[0].message
+        assert rep["state_aliased"] == 0 and rep["state_leaves"] == 1
+
+
+class TestContractDrift:
+    def _report(self):
+        return {"fx": {"steps": {"step": {"collectives": {
+            "counts": {"all-to-all": 2}, "payload_bytes":
+            {"all-to-all": 100}, "depth": 1}}}}}
+
+    def test_match_is_clean(self):
+        report = self._report()
+        data = contract_mod.from_report(report)
+        assert contract_mod.compare(data, report) == []
+
+    def test_count_drift_fails(self):
+        report = self._report()
+        data = contract_mod.from_report(report)
+        report["fx"]["steps"]["step"]["collectives"]["counts"] \
+            ["all-to-all"] = 3
+        drift = contract_mod.compare(data, report)
+        assert any("count drifted" in f.message for f in drift)
+
+    def test_missing_fixture_row_fails(self):
+        report = self._report()
+        drift = contract_mod.compare({"fixtures": {}}, report)
+        assert any(f.symbol == "contract:missing-fixture"
+                   for f in drift)
+
+    def test_subset_run_does_not_judge_unselected_rows(self):
+        report = self._report()
+        data = contract_mod.from_report(report)
+        data["fixtures"]["other_fixture"] = {"step": {
+            "collectives": {"all-reduce": 1}, "payload_bytes": {},
+            "depth": 1}}
+        # other_fixture did not run: its row must not be judged
+        assert contract_mod.compare(data, report) == []
+
+    def test_expectation_findings_survive_write_contract_filter(self):
+        """--write-contract supersedes ONLY cross-run contract drift
+        (contract_mod.RULE). The collectives pass's structural
+        self-expectations carry their own rule, so a schedule leak
+        (here: a single-device fixture lowering collectives) still
+        gates the refresh instead of being legitimized into the fresh
+        contract file."""
+        from paddle_tpu.analysis.graph import collectives
+
+        assert collectives.RULE != contract_mod.RULE
+        findings, _ = collectives.run(
+            "fx", "step", {"hlo": _HLO_SNIPPET}, single_device=True)
+        assert findings
+        assert all(f.rule == collectives.RULE for f in findings)
+        # the pthlo --write-contract filter drops contract_mod.RULE:
+        # every expectation finding must survive it
+        kept = [f for f in findings if f.rule != contract_mod.RULE]
+        assert kept == findings
+
+
+# ---------------------------------------------------------------------------
+# the gate: the real fixtures, the checked-in config + contract
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def gate_run():
+    config = load_config(REPO_ROOT)
+    return run_graph(REPO_ROOT, config=config)
+
+
+class TestGraphGate:
+    """tier-1 contract: zero findings, zero drift, nothing skipped."""
+
+    def test_zero_findings_and_contract_match(self, gate_run):
+        report, findings = gate_run
+        assert not findings, "pthlo findings:\n" + "\n".join(
+            "%s: %s: %s" % (f.path, f.rule, f.message)
+            for f in findings)
+        assert report["contract"]["status"] == "match"
+
+    def test_every_fixture_lowered(self, gate_run):
+        report, _ = gate_run
+        skipped = {n: fx["skipped"]
+                   for n, fx in report["fixtures"].items()
+                   if fx.get("skipped")}
+        assert not skipped, skipped
+        assert set(report["fixtures"]) == set(GRAPH_FIXTURES)
+        # the matrix is real: train exact + qsync both bucket ends,
+        # pipeline, and all four serving combos
+        assert {"llama_train", "llama_train_qsync",
+                "llama_train_qsync_fine", "gpt_train", "ernie_train",
+                "pipeline_train", "serving_base", "serving_prefix",
+                "serving_chunked",
+                "serving_prefix_chunked"} <= set(report["fixtures"])
+
+    def test_quantized_fixture_counts_match_bucket_plan(self, gate_run):
+        """The acceptance pin: all-to-all/all-gather counts == 2x the
+        bucket count FLAGS_grad_sync_bucket_mb resolved to (payload +
+        scales per bucket), at BOTH ends of the bucket matrix."""
+        report, _ = gate_run
+        for name in ("llama_train_qsync", "llama_train_qsync_fine"):
+            fx = report["fixtures"][name]
+            buckets = fx["qsync_buckets"]
+            assert buckets and buckets >= 1
+            counts = fx["steps"]["step"]["collectives"]["counts"]
+            assert counts["all-to-all"] == 2 * buckets, name
+            assert counts["all-gather"] == 2 * buckets, name
+        # and the ends differ: fine buckets = one per trainable param
+        assert report["fixtures"]["llama_train_qsync_fine"] \
+            ["qsync_buckets"] > \
+            report["fixtures"]["llama_train_qsync"]["qsync_buckets"]
+
+    def test_serving_steps_fully_donate_their_pools(self, gate_run):
+        report, _ = gate_run
+        for name in ("serving_base", "serving_prefix",
+                     "serving_chunked", "serving_prefix_chunked"):
+            for sname, srep in report["fixtures"][name]["steps"] \
+                    .items():
+                d = srep["donation"]
+                assert d["state_leaves"] > 0, (name, sname)
+                assert d["state_aliased"] == d["state_leaves"], \
+                    (name, sname, d)
+
+    def test_llama_sharding_report_names_every_class(self, gate_run):
+        """Acceptance: a layout for every param class of the llama
+        fixture."""
+        report, _ = gate_run
+        classes = report["fixtures"]["llama_train"]["sharding"] \
+            ["classes"]
+        for cls in ("embed", "attn", "mlp", "norm", "head"):
+            assert cls in classes, classes.keys()
+            assert classes[cls]["specs"], cls
+            assert classes[cls]["bytes"] > 0, cls
+
+    def test_hot_steps_are_clean_of_host_and_f64(self, gate_run):
+        report, _ = gate_run
+        for name, fx in report["fixtures"].items():
+            for sname, srep in (fx.get("steps") or {}).items():
+                assert srep["host"]["host_transfers"] == [], \
+                    (name, sname)
+                assert srep["host"]["f64_ops"] == [], (name, sname)
+
+    def test_depth_report_shows_overlappable_slack(self, gate_run):
+        """The ROADMAP-4 scoreboard seed: the fine-bucket fixture has
+        many collectives but a shallow dependency chain — the
+        difference is what comm/compute overlap can reclaim."""
+        report, _ = gate_run
+        col = report["fixtures"]["llama_train_qsync_fine"]["steps"] \
+            ["step"]["collectives"]
+        assert col["total"] > 10
+        assert col["depth"] <= 4
+        assert col["overlappable"] == col["total"] - col["depth"]
+
+
+# ---------------------------------------------------------------------------
+# compile signatures: stable fingerprints per flag combo
+# ---------------------------------------------------------------------------
+
+class TestCompileSignature:
+    def test_serving_mixed_step_stable_across_prefix_flag(self):
+        """The ONE mixed step must be the same compiled program with
+        the prefix cache on or off (the cache changes admission, never
+        the graph) AND bit-stable across rebuilds — a silent recompile
+        across the matrix fails here."""
+        a = build_fixture("serving_chunked")
+        b = build_fixture("serving_prefix_chunked")
+        a2 = build_fixture("serving_chunked")
+        fp = a["steps"]["mixed"]["fingerprint"]
+        assert fp == a2["steps"]["mixed"]["fingerprint"]
+        assert fp == b["steps"]["mixed"]["fingerprint"]
+
+    def test_serving_decode_stable_across_prefix_flag(self):
+        a = build_fixture("serving_base")
+        b = build_fixture("serving_prefix")
+        assert a["steps"]["decode"]["fingerprint"] == \
+            b["steps"]["decode"]["fingerprint"]
+
+    def test_train_step_stable_per_combo_and_sensitive_to_qsync(self):
+        base = build_fixture("llama_train")
+        base2 = build_fixture("llama_train")
+        q = build_fixture("llama_train_qsync")
+        q2 = build_fixture("llama_train_qsync")
+        fp_base = base["steps"]["step"]["fingerprint"]
+        fp_q = q["steps"]["step"]["fingerprint"]
+        assert fp_base == base2["steps"]["step"]["fingerprint"]
+        assert fp_q == q2["steps"]["step"]["fingerprint"]
+        # the quantized combo IS a different program — a fingerprint
+        # that cannot tell them apart would pin nothing
+        assert fp_base != fp_q
+
+    def test_bucket_flag_changes_the_program(self):
+        q = build_fixture("llama_train_qsync")
+        fine = build_fixture("llama_train_qsync_fine")
+        assert q["steps"]["step"]["fingerprint"] != \
+            fine["steps"]["step"]["fingerprint"]
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+class TestCli:
+    def test_list_names_every_fixture(self):
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, "tools",
+                                          "pthlo.py"), "--list"],
+            capture_output=True, text=True, timeout=120)
+        assert out.returncode == 0
+        for name in GRAPH_FIXTURES:
+            assert name in out.stdout
+
+    def test_check_subset_artifact_and_exit_code(self, tmp_path):
+        art = tmp_path / "graph_report.json"
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, "tools",
+                                          "pthlo.py"),
+             "--fixtures", "llama_train", "--no-contract",
+             "--out", str(art)],
+            capture_output=True, text=True, timeout=300,
+            cwd=REPO_ROOT)
+        assert out.returncode == 0, out.stdout + out.stderr
+        report = json.loads(art.read_text())
+        assert report["kind"] == "pthlo_report"
+        assert "llama_train" in report["fixtures"]
+        assert report["fixtures"]["llama_train"]["steps"]["step"] \
+            ["donation"]["state_aliased"] > 0
+
+    def test_unknown_fixture_is_usage_error(self):
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, "tools",
+                                          "pthlo.py"),
+             "--fixtures", "nope"],
+            capture_output=True, text=True, timeout=120)
+        assert out.returncode == 2
+
+    def test_write_contract_rejects_fixture_subset(self):
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, "tools",
+                                          "pthlo.py"),
+             "--write-contract", "--fixtures", "llama_train"],
+            capture_output=True, text=True, timeout=120)
+        assert out.returncode == 2
+        assert "whole" in out.stderr

@@ -21,8 +21,9 @@ import pytest
 
 from paddle_tpu.analysis import (Baseline, Project, load_config,
                                  render_json, render_text, run)
-from paddle_tpu.analysis import (clocks, flags_pass, metrics_pass,
-                                 silent_except, threads, trace_purity)
+from paddle_tpu.analysis import (clocks, compile_discipline, flags_pass,
+                                 metrics_pass, silent_except, threads,
+                                 trace_purity)
 from paddle_tpu.analysis.runner import BASELINE_ELIGIBLE, RULES
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -262,6 +263,129 @@ class TestTracePass:
         found = trace_purity.run_pass(project)
         assert [f.symbol.split(":")[1].split("#")[0]
                 for f in found] == ["time.time"]
+
+
+# -- compile-discipline pass -------------------------------------------------
+
+class TestCompileDisciplinePass:
+    def test_flag_read_in_traced_body_fires(self, tmp_path):
+        """The pin: flags.flag("FLAGS_x") inside a jit-reachable body
+        latches at trace time — a finding, even via a helper."""
+        project = make_project(tmp_path, {
+            "pkg/step.py": """
+                import jax
+                from core import flags as _flags
+
+                def helper():
+                    return 2.0 if _flags.flag("FLAGS_fast_path") else 1.0
+
+                def step_fn(x):
+                    return x * helper()
+
+                step = jax.jit(step_fn)
+            """})
+        found = compile_discipline.run_pass(project)
+        assert len(found) == 1
+        assert "FLAGS_fast_path" in found[0].symbol
+        assert found[0].rule == "compile-discipline"
+
+    def test_construction_latch_is_clean(self, tmp_path):
+        """The documented idiom: read the flag in __init__, close over
+        the value — nothing inside the traced body touches the table."""
+        project = make_project(tmp_path, {
+            "pkg/ok.py": """
+                import jax
+                from core import flags as _flags
+
+                class Engine:
+                    def __init__(self):
+                        self.fast = _flags.flag("FLAGS_fast_path")
+                        self._fn = jax.jit(self._step_fn)
+
+                    def _step_fn(self, x):
+                        return x * (2.0 if self.fast else 1.0)
+            """})
+        assert compile_discipline.run_pass(project) == []
+
+    def test_self_method_jit_root_is_traced(self, tmp_path):
+        """jax.jit(self._step_fn) — the serving-engine idiom the trace
+        pass skips — must still be a root for THIS pass."""
+        project = make_project(tmp_path, {
+            "pkg/engine.py": """
+                import jax
+                from core import flags as _flags
+
+                class Engine:
+                    def __init__(self):
+                        self._fn = jax.jit(self._step_fn)
+
+                    def _step_fn(self, x):
+                        if _flags.flag("FLAGS_mode_b"):
+                            return x + 1
+                        return x
+            """})
+        found = compile_discipline.run_pass(project)
+        assert len(found) == 1
+        assert "FLAGS_mode_b" in found[0].symbol
+        assert "Engine._step_fn" in found[0].symbol
+
+    def test_mutable_module_global_read_fires(self, tmp_path):
+        """A module global rebound via ``global`` elsewhere is a stale
+        snapshot inside a trace; a write-once module constant is not."""
+        project = make_project(tmp_path, {
+            "pkg/g.py": """
+                import jax
+
+                _SCALE = 1.0
+                _CONST = 4.0
+
+                def set_scale(v):
+                    global _SCALE
+                    _SCALE = v
+
+                def step_fn(x):
+                    return x * _SCALE + _CONST
+
+                step = jax.jit(step_fn)
+            """})
+        found = compile_discipline.run_pass(project)
+        assert [f.symbol.split(":")[1].split("#")[0] for f in found] \
+            == ["_SCALE"]
+
+    def test_local_shadow_does_not_fire(self, tmp_path):
+        project = make_project(tmp_path, {
+            "pkg/shadow.py": """
+                import jax
+
+                _SCALE = 1.0
+
+                def bump():
+                    global _SCALE
+                    _SCALE += 1
+
+                def step_fn(x, _SCALE):
+                    return x * _SCALE
+
+                step = jax.jit(step_fn)
+            """})
+        assert compile_discipline.run_pass(project) == []
+
+    def test_pragma_suppresses(self, tmp_path):
+        project = make_project(tmp_path, {
+            "pkg/step.py": """
+                import jax
+                from core import flags as _flags
+
+                def step_fn(x):
+                    # deliberate latch: replay driver choice, not
+                    # graph state
+                    # ptlint: compile-discipline-ok — trace-time driver
+                    mode = _flags.flag("FLAGS_driver")
+                    return x if mode else x + 1
+
+                step = jax.jit(step_fn)
+            """})
+        assert compile_discipline.run_pass(project) == []
 
 
 # -- clock pass --------------------------------------------------------------
@@ -677,6 +801,27 @@ class TestConfigAndReport:
             "grad_sync_*", "snapshot_*", "mfu", "hbm_peak_bytes"]
         assert cfg["metric"]["strict"] is True
 
+    def test_graph_table_round_trips(self, tmp_path):
+        """[tool.ptlint.graph] — the pthlo analyzer's config shares the
+        ptlint surface: fixtures list, size threshold (ints AND floats),
+        contract path all survive the subset parser."""
+        (tmp_path / "pyproject.toml").write_text(textwrap.dedent("""
+            [tool.ptlint.graph]
+            contract = "tools/graph_contract.json"
+            donation_min_bytes = 65536
+            bucket_mb = 4.0
+            fixtures = ["llama_train",
+                        "serving_chunked"]   # subset for this run
+        """))
+        cfg = load_config(str(tmp_path))
+        assert cfg["graph"] == {
+            "contract": "tools/graph_contract.json",
+            "donation_min_bytes": 65536,
+            "bucket_mb": 4.0,
+            "fixtures": ["llama_train", "serving_chunked"]}
+        assert isinstance(cfg["graph"]["bucket_mb"], float)
+        assert isinstance(cfg["graph"]["donation_min_bytes"], int)
+
     def test_render_text_and_json(self, tmp_path):
         project = make_project(tmp_path, {
             "pkg/a.py": "def f():\n    try:\n        w()\n"
@@ -730,8 +875,9 @@ class TestTreeIsClean:
                           exclude=tuple(config.get("exclude", ())),
                           config=config)
         assert len(project.files) > 200
-        assert set(RULES) == {"flag", "trace", "clock", "thread",
-                              "metric", "silent-except"}
+        assert set(RULES) == {"flag", "trace", "compile-discipline",
+                              "clock", "thread", "metric",
+                              "silent-except"}
 
     def test_baseline_carries_no_nongrandfatherable_debt(self):
         _, baseline = self._run_repo()

@@ -351,3 +351,103 @@ class TestGPTServing:
         for p, rid in zip(prompts, ids):
             assert outs[rid] == _greedy_ref(m, p, 5)
         assert eng.stats()["decode_compiles"] == 1
+
+
+# ---------------------------------------------------------------------------
+# donated-pools failure recovery
+# ---------------------------------------------------------------------------
+
+class TestDonatedPoolRecovery:
+    """The compiled steps donate their input pools (donate_argnums) —
+    a step that raises AFTER execution started leaves cache.pools
+    pointing at DELETED buffers. The engine must detect that, reset
+    the pool plane, and preempt-by-recompute every occupied slot:
+    outputs stay bit-identical to a clean run and a one-step transient
+    never becomes permanent engine death."""
+
+    def _poison_after_dispatch(self, eng, attr):
+        """Wrap a compiled step so its FIRST call runs the real jit
+        (consuming the donated pools) and then raises — the
+        post-dispatch failure mode fault injection (which fires before
+        the call) cannot produce."""
+        real = getattr(eng, attr)
+        state = {"fired": False}
+
+        def wrapper(*args):
+            out = real(*args)
+            if not state["fired"]:
+                state["fired"] = True
+                raise RuntimeError("post-dispatch transient")
+            return out
+
+        setattr(eng, attr, wrapper)
+        return state
+
+    def test_split_decode_recovers_bit_identical(self, llama):
+        model, _cfg = llama
+        rng = np.random.RandomState(9)
+        prompts = [rng.randint(1, 64, (n,)).tolist() for n in (5, 9, 3)]
+        clean = serving.Engine(model, max_slots=3, num_blocks=64,
+                               block_size=4)
+        ids = [clean.add_request(p, max_new_tokens=6) for p in prompts]
+        want = clean.run()
+        eng = serving.Engine(model, max_slots=3, num_blocks=64,
+                             block_size=4)
+        ids2 = [eng.add_request(p, max_new_tokens=6) for p in prompts]
+        state = self._poison_after_dispatch(eng, "_decode")
+        got = eng.run()
+        assert state["fired"]
+        assert [got[i] for i in ids2] == [want[i] for i in ids]
+        st = eng.stats()
+        assert st["preemptions"] >= 3     # every occupied slot requeued
+        assert st["requests_finished"] == 3
+        assert eng.cache.pools_alive()
+
+    def test_recovery_requeue_preserves_fcfs_order(self, llama):
+        """The recovery requeue uses appendleft in REVERSE slot order
+        (the _on_decode_failure idiom) so the survivors re-admit
+        strictly FCFS — earliest-admitted request back at the queue
+        head, not the tail-end slot."""
+        model, _cfg = llama
+        eng = serving.Engine(model, max_slots=3, num_blocks=64,
+                             block_size=4)
+        rng = np.random.RandomState(11)
+        ids = [eng.add_request(rng.randint(1, 64, (n,)).tolist(),
+                               max_new_tokens=4) for n in (5, 7, 3)]
+        eng.step()                        # admit + prefill all three
+        for p in eng.cache.pools:         # simulate a post-dispatch
+            p.k.delete()                  # failure consuming the
+            p.v.delete()                  # donated pools
+        eng._recover_consumed_pools()
+        assert [r.id for r in eng.scheduler.queue] == ids
+        assert eng.cache.pools_alive()
+
+    def test_mixed_step_with_prefix_cache_recovers(self, llama):
+        model, _cfg = llama
+        paddle.set_flags({"FLAGS_serving_prefix_cache": True,
+                          "FLAGS_serving_chunked_prefill": True})
+        try:
+            rng = np.random.RandomState(10)
+            shared = rng.randint(1, 64, (8,)).tolist()
+            prompts = [shared + rng.randint(1, 64, (n,)).tolist()
+                       for n in (4, 6, 2)]
+            clean = serving.Engine(model, max_slots=3, num_blocks=64,
+                                   block_size=4)
+            ids = [clean.add_request(p, max_new_tokens=6)
+                   for p in prompts]
+            want = clean.run()
+            eng = serving.Engine(model, max_slots=3, num_blocks=64,
+                                 block_size=4)
+            ids2 = [eng.add_request(p, max_new_tokens=6)
+                    for p in prompts]
+            state = self._poison_after_dispatch(eng, "_mixed")
+            got = eng.run()
+            assert state["fired"]
+            assert [got[i] for i in ids2] == [want[i] for i in ids]
+            # the rebuilt prefix cache serves the fresh pools, not the
+            # dead ones: the tree must be consistent with a live pool
+            assert eng.cache.pools_alive()
+            assert eng.stats()["decode_compiles"] == 1
+        finally:
+            paddle.set_flags({"FLAGS_serving_prefix_cache": False,
+                              "FLAGS_serving_chunked_prefill": False})

@@ -194,6 +194,40 @@ def render(payload, out=sys.stdout):
         w("  none\n")
 
 
+def render_graph(graph_path, out=sys.stdout):
+    """Collective-count + donation-audit columns from the pthlo
+    artifact (tools/graph_report.json, the battery's pthlo row): one
+    report answers "is the comm schedule still what we shipped". Reads
+    the artifact only — never re-lowers anything — and renders it with
+    the analysis package's OWN formatter so these columns can never
+    drift from pthlo's output. paddle_tpu/__init__ imports jax but
+    analysis/ is stdlib-only, so a bare worker gets the ptlint.py
+    stub-package trick."""
+    w = out.write
+    try:
+        with open(graph_path) as f:
+            graph = json.load(f)
+    except (OSError, ValueError) as e:
+        w("== graph report %s unreadable: %s ==\n" % (graph_path, e))
+        return
+    if graph.get("kind") != "pthlo_report":
+        w("== %s is not a pthlo report ==\n" % graph_path)
+        return
+    if "paddle_tpu" not in sys.modules:
+        import types
+
+        _pkg = types.ModuleType("paddle_tpu")
+        _pkg.__path__ = [os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "paddle_tpu")]
+        sys.modules["paddle_tpu"] = _pkg
+    from paddle_tpu.analysis.graph.runner import render_graph_text
+
+    w("== graph report (%s) ==\n" % os.path.basename(graph_path))
+    w(render_graph_text(graph))
+    w("\n")
+
+
 def diff_baseline(payload, baseline_path, out=sys.stdout):
     w = out.write
     try:
@@ -265,6 +299,10 @@ def main(argv=None):
     ap.add_argument("--out", help="also write the payload JSON here")
     ap.add_argument("--baseline",
                     help="BENCH_*.json to diff mfu/hbm against")
+    ap.add_argument("--graph", default=None,
+                    help="pthlo artifact for the collective/donation "
+                         "columns (default: tools/graph_report.json "
+                         "when present; 'none' disables)")
     a = ap.parse_args(argv)
     _watchdog()
 
@@ -286,6 +324,14 @@ def main(argv=None):
         render(payload)
     if a.baseline:
         diff_baseline(payload, a.baseline)
+    graph_path = a.graph
+    if graph_path is None:
+        default = os.path.join(os.path.dirname(os.path.abspath(
+            __file__)), "graph_report.json")
+        if os.path.exists(default):
+            graph_path = default
+    if graph_path and graph_path != "none" and not a.json:
+        render_graph(graph_path)
     return 0
 
 

@@ -33,6 +33,16 @@ run() {  # run <name> <timeout> <cmd...>
 #     stale baseline entries (tools/ptlint_report.json names them).
 run ptlint 120 python tools/ptlint.py --out tools/ptlint_report.json
 
+# 0b. compiled-graph analysis: pthlo lowers the registered fixtures
+#     (train/pipeline/serving flag matrix) on 8 virtual CPU devices —
+#     host-only like the ptlint row, it never touches the tunnel chip —
+#     and runs the donation audit, collective-schedule contract check,
+#     host-transfer/f64 lint and sharding report. rc!=0 means findings
+#     or contract drift (tools/graph_report.json names them); the
+#     committed artifact also feeds tools/perf_report.py's
+#     collective/donation columns.
+run pthlo 600 python tools/pthlo.py --check --out tools/graph_report.json
+
 # 0. pre-flight: bail fast if the tunnel is actually wedged
 run probe 240 python bench.py --probe || { echo "tunnel wedged; abort"; exit 3; }
 
