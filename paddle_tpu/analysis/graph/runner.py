@@ -161,6 +161,23 @@ def _counts(findings):
     return out
 
 
+def _fmt_peak(cost):
+    """Memory column: the donation-aware HBM peak from the step's cost
+    row (monitor/perf.py executable_analysis; ``~`` marks the
+    args+temps+outputs−alias upper-bound estimate on jaxlib builds
+    without the buffer-assignment stat)."""
+    peak = (cost or {}).get("hbm_peak_bytes")
+    if not isinstance(peak, (int, float)):
+        return "?"
+    v = float(peak)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(v) < 1024 or unit == "TiB":
+            break
+        v /= 1024.0
+    est = "~" if (cost or {}).get("hbm_peak_is_estimate") else ""
+    return "%s%.1f%s" % (est, v, unit)
+
+
 def render_graph_text(report, out=None):
     lines = []
     for name, fx in sorted(report["fixtures"].items()):
@@ -175,10 +192,11 @@ def render_graph_text(report, out=None):
                             sorted(col["counts"].items())) or "none"
             lines.append(
                 "%-24s %-14s collectives: %s depth=%d  donated %d/%d"
-                "  host=%d f64=%d"
+                "  host=%d f64=%d  peak=%s"
                 % (name, sname, cstr, col["depth"],
                    don["state_aliased"], don["state_leaves"],
-                   len(host["host_transfers"]), len(host["f64_ops"])))
+                   len(host["host_transfers"]), len(host["f64_ops"]),
+                   _fmt_peak(srep.get("cost"))))
         sh = fx.get("sharding") or {}
         classes = sh.get("classes") or {}
         if classes:

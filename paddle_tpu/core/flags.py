@@ -112,6 +112,18 @@ _DEFAULTS = {
     # hooks are one flag branch: no server, no collector thread, no
     # store traffic (test-pinned, the PR-2/5/6 discipline).
     "FLAGS_monitor_fleet": False,
+    # memory plane (monitor/memory.py): per-component device-memory
+    # ledger (mem_device_bytes{component,job} reconciled against
+    # allocator stats, mem_hbm_headroom_bytes{job} = capacity − static
+    # ledger − compiled transient peak), OOM forensics on the hot
+    # paths (oom_postmortem_rank{r}.json written before the failure
+    # re-raises; deterministic mem.oom injection site), and a leak
+    # sentinel firing perf_anomalies_total{kind="mem_leak"} on
+    # steady-state growth. Engines latch the tracker ONCE at
+    # construction; off = one attribute load + branch on the hot
+    # paths — no threads, no native calls, no registry series, no jax
+    # import (test-pinned, the PR-2/5/6 discipline).
+    "FLAGS_monitor_memory": False,
     # radix prefix cache over the serving engine's paged KV pool
     # (serving/prefix_cache.py): requests sharing a prompt prefix
     # (system prompts, few-shot headers) map their block-table head to

@@ -51,7 +51,18 @@ Three pillars (one registry, one postmortem path, one timeline):
    journal tails from all ranks into one ``fleet_capture_<ts>/``
    artifact. Rendered live by tools/fleet_top.py.
 
-7. **Progress watchdog** (monitor/watchdog.py): heartbeat registry fed
+7. **Memory plane** (monitor/memory.py, ``FLAGS_monitor_memory``):
+   per-component device-memory ledger (``mem_device_bytes{component,
+   job}``) reconciled against allocator stats, explicit
+   static-vs-transient attribution (``mem_hbm_headroom_bytes{job}`` =
+   capacity − static ledger − compiled peak), OOM forensics writing
+   ``oom_postmortem_rank{r}.json`` before the failure re-raises (with
+   a deterministic ``mem.oom`` injection site), and a leak sentinel
+   firing ``perf_anomalies_total{kind="mem_leak"}`` on steady-state
+   growth. Served at /debugz/memory; per-rank memory columns in the
+   fleet table and tools/fleet_top.py.
+
+8. **Progress watchdog** (monitor/watchdog.py): heartbeat registry fed
    by the compiled train step, the serving engine loop, and store
    collectives; a daemon thread (``start_watchdog()`` / ``PT_WATCHDOG``)
    turns a stalled heartbeat into a cross-rank diagnostic bundle
@@ -100,6 +111,7 @@ from .watchdog import (  # noqa: F401
 )
 from . import fleet  # noqa: F401
 from . import flight_recorder  # noqa: F401
+from . import memory  # noqa: F401
 from . import perf  # noqa: F401
 from . import timeseries  # noqa: F401
 from . import trace  # noqa: F401
@@ -116,6 +128,6 @@ __all__ = [
     "Heartbeat", "heartbeat", "start_watchdog", "stop_watchdog",
     "is_watchdog_running", "build_bundle", "diagnose_bundles",
     "register_stall_action", "unregister_stall_action",
-    "fleet", "flight_recorder", "perf", "timeseries", "trace",
-    "trace_merge", "watchdog",
+    "fleet", "flight_recorder", "memory", "perf", "timeseries",
+    "trace", "trace_merge", "watchdog",
 ]

@@ -24,6 +24,10 @@ server stack: ``KVHTTPServer`` gained a ``get_routes`` hook, and
                         pulls so tools/trace_merge.py can merge it)
     GET /debugz/trace/{id}  one trace's full span timeline (404 for an
                         unknown or evicted trace id)
+    GET /debugz/memory  memory-plane breakdown: per-component ledger,
+                        allocator reconciliation, headroom, recent
+                        admission/preempt decisions, OOM postmortems
+                        (monitor/memory.py payload)
     GET /debugz/fleet   fleet summary: collector state, straggler
                         verdict, fused cross-rank aggregates
                         (monitor/fleet.py payload)
@@ -52,6 +56,7 @@ import os
 import time
 
 from . import fleet as _fleet
+from . import memory as _memory
 from . import perf as _perf
 from . import timeseries as _timeseries
 from . import trace as _trace
@@ -111,6 +116,7 @@ class MetricsServer:
         # exact routes win over the debugz/trace prefix dispatch, so
         # "journal" can never be misread as a trace id
         routes["debugz/trace/journal"] = self._trace_journal
+        routes["debugz/memory"] = self._memory
         routes["debugz/resilience"] = self._resilience
         routes["debugz/fleet"] = self._fleet
         routes["debugz/fleet/ranks"] = self._fleet_ranks
@@ -157,6 +163,11 @@ class MetricsServer:
 
     def _trace_journal(self):
         body = json.dumps(_watchdog.json_safe(_trace.dump()),
+                          default=str).encode()
+        return 200, "application/json", body
+
+    def _memory(self):
+        body = json.dumps(_watchdog.json_safe(_memory.memory_payload()),
                           default=str).encode()
         return 200, "application/json", body
 

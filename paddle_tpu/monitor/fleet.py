@@ -16,7 +16,8 @@ need:
 
 2. **Collector** (``FleetCollector``, runnable on any rank or as a
    standalone process holding a store client): scrapes every rank's
-   ``/metrics.json`` + ``/debugz/perf`` + ``/healthz`` on an interval
+   ``/metrics.json`` + ``/debugz/perf`` + ``/healthz`` (plus
+   best-effort ``/debugz/flight`` and ``/debugz/memory``) on an interval
    and fuses them into rank-labeled fleet series — counters SUM across
    ranks, gauges keep per-rank values plus min/max/p50 fleet
    aggregates, histograms sum bucket-wise. Each scrape also estimates
@@ -42,8 +43,9 @@ need:
 4. **Anomaly-triggered fleet capture**: when any rank's perf sentinel
    fires (its ``perf_anomalies_total`` advances / healthz turns
    degraded) or a straggler is flagged, the collector pulls
-   watchdog-style bundles (``/debugz/bundle``) and span-journal tails
-   (``/debugz/trace/journal``) from ALL ranks into one
+   watchdog-style bundles (``/debugz/bundle``), span-journal tails
+   (``/debugz/trace/journal``) and the memory breakdown
+   (``/debugz/memory``) from ALL ranks into one
    ``fleet_capture_<ts>/`` directory (manifest + per-rank artifacts)
    — a loss spike on rank 3 automatically yields fleet-wide evidence.
    ``tools/trace_merge.py --capture`` renders the merged chrome trace
@@ -486,10 +488,21 @@ class FleetCollector:
                 flight_seq = int(flight["next_seq"])
         except (OSError, ValueError, http.client.HTTPException):
             pass
+        # memory plane (best-effort, same narrow-catch contract): a
+        # rank without the route or with FLAGS_monitor_memory off just
+        # has empty memory columns this round
+        memory = None
+        try:
+            mem, _, _, _ = _http_json(url + "/debugz/memory",
+                                      self.http_timeout_s)
+            if isinstance(mem, dict):
+                memory = mem
+        except (OSError, ValueError, http.client.HTTPException):
+            pass
         return {"metrics": snap.get("metrics") or {},
                 "snapshot_time": snap.get("unix_time"),
                 "perf": perf, "healthz": healthz,
-                "flight_seq": flight_seq,
+                "flight_seq": flight_seq, "memory": memory,
                 "rtt_s": rtt, "clock_offset_s": offset,
                 "scraped_at": time.monotonic()}
 
@@ -578,6 +591,22 @@ class FleetCollector:
                 if isinstance(h.get("last_beat_age_s"), (int, float))]
         st["heartbeat_age_s"] = min(ages) if ages else None
         st["collective_seq"] = scraped.get("flight_seq")
+        # memory columns (monitor/memory.py /debugz/memory): live
+        # bytes prefer the allocator witness, fall back to the ledger
+        # total (bare workers never import jax, so the witness may be
+        # absent while the ledger reports); headroom is the tightest
+        # job's
+        mem = scraped.get("memory") or {}
+        rec = mem.get("reconciliation") or {}
+        live = rec.get("live_bytes")
+        if not isinstance(live, (int, float)):
+            live = rec.get("ledger_bytes")
+        st["mem_live_bytes"] = live if isinstance(live, (int, float)) \
+            else None
+        heads = [j.get("headroom_bytes")
+                 for j in (mem.get("jobs") or {}).values()
+                 if isinstance(j.get("headroom_bytes"), (int, float))]
+        st["mem_headroom_bytes"] = min(heads) if heads else None
         # anomaly watermark: total sentinel firings this rank reports
         anomalies = (scraped["perf"] or {}).get("anomalies") or {}
         st["anomalies_total"] = sum(
@@ -817,7 +846,8 @@ class FleetCollector:
         for rank, url in sorted(endpoints.items()):
             ok = True
             for route, stem in (("debugz/bundle", "bundle"),
-                                ("debugz/trace/journal", "journal")):
+                                ("debugz/trace/journal", "journal"),
+                                ("debugz/memory", "memory")):
                 try:
                     payload, _, _, _ = _http_json(
                         "%s/%s" % (url, route), self.http_timeout_s)
@@ -885,7 +915,8 @@ class FleetCollector:
                 "rank", "url", "ok", "error", "consecutive_errors",
                 "steps_total", "steps_behind", "collective_seq",
                 "collective_seq_behind", "step_time_s",
-                "tokens_per_s", "mfu", "hbm_peak_bytes", "comm_share",
+                "tokens_per_s", "mfu", "hbm_peak_bytes",
+                "mem_live_bytes", "mem_headroom_bytes", "comm_share",
                 "serving_goodput_tokens_per_s", "heartbeat_age_s",
                 "healthz", "degraded", "anomalies_total",
                 "anomaly_kinds", "straggler", "slow_hits",

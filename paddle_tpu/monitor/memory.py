@@ -1,0 +1,624 @@
+"""ptmem — the live HBM/memory plane: ledger, OOM forensics, leak watch.
+
+The sixth and final pillar of the monitor division of labor (flight
+recorder = collectives, watchdog = stalls, perf = efficiency, trace =
+journeys, fleet = cross-rank, **memory = bytes**). Until this module
+the repo's only memory observability was a compile-time
+``hbm_peak_bytes`` estimate (monitor/perf.py) and one unlabeled
+allocator gauge (parallel/engine.py) — nothing could say WHOSE bytes
+filled the device when the ROADMAP item-2 router needs per-replica
+load or item-3 trades KV bytes for occupancy. Four pieces:
+
+1. **Per-component device-memory ledger**: engines register named
+   components (model params, optimizer slots, EF residuals, each
+   serving engine's paged KV pools with prefix-cache/COW detail) whose
+   providers report ACTUAL live bytes from array ``nbytes``.
+   ``sample()`` publishes ``mem_device_bytes{component,job}`` gauges
+   (feeding the PR-5 time-series ring), reconciles the ledger total
+   against the allocator witness (device ``memory_stats()`` where the
+   backend has one, the summed ``jax.live_arrays()`` nbytes on the CPU
+   backend — tolerance documented at ``RECONCILE_TOLERANCE``), and
+   derives ``mem_hbm_headroom_bytes{job}`` = device capacity − (static
+   ledger + compiled transient peak) so static-vs-transient
+   attribution is explicit. The transient peak comes from the SAME
+   donation-aware ``executable_analysis`` number perf attribution and
+   ``graph_report()`` publish (``compiled_peak``), never a second
+   hand-rolled estimate.
+
+2. **OOM forensics**: the hot paths (``Engine.step``,
+   ``CompiledTrainStep.__call__``/``run_steps``) catch OOM-shaped
+   failures (XLA RESOURCE_EXHAUSTED, and the deterministic ``mem.oom``
+   fault-injection site so the path is CPU-testable) and call
+   ``write_postmortem`` BEFORE re-raising:
+   ``oom_postmortem_rank{r}.json`` carries the ledger breakdown, the
+   top-K live arrays by bytes (shape/dtype/tag), the caller context
+   (KV occupancy, slots) plus the recent admission/preempt decision
+   ring, and the last-K ``mem_*`` time-series tails. The engine never
+   tries to recover — allocator state after a real OOM is unknowable.
+
+3. **Leak sentinel** (``MemLeakSentinel`` via ``perf.add_sentinel``):
+   steady-state growth of live bytes across a full sample window fires
+   ``perf_anomalies_total{kind="mem_leak"}`` and flips ``/healthz`` to
+   degraded through the existing perf anomaly plumbing. Armed only
+   after warmup; window span is measured on the MONOTONIC clock.
+
+4. **Surfacing**: ``/debugz/memory`` (monitor/exporter.py), per-rank
+   memory columns in the fleet table (monitor/fleet.py scrapes the
+   route; tools/fleet_top.py renders MEM/HEADROOM), fleet captures
+   pull the breakdown from every rank, and watchdog bundles embed the
+   ``mem_*`` ring tails.
+
+Discipline (the PR-2/5/6 contract, test-pinned): default OFF via
+``FLAGS_monitor_memory``. Engines latch ``tracker()`` ONCE at
+construction (the ptlint hot-path-latch convention) — while off the
+hot paths pay one attribute load + branch: no threads, no native
+calls, no registry series, no jax import. Even enabled, the allocator
+witness only consults jax when the HOST PROCESS already imported it
+(``sys.modules`` probe) — a bare collector/worker process scraping the
+route never drags an accelerator backend in. Module import stays
+stdlib-only; jax objects only ever arrive through providers.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+from . import perf as _perf
+from . import registry as _registry
+from . import timeseries as _timeseries
+from .timeseries import _flag
+
+# -- metrics (shared registry; series appear only once sampled) --------------
+
+_MEM_DEV = _registry.gauge(
+    "mem_device_bytes",
+    "live device bytes per registered ledger component (component="
+    "allocator, job=device is the allocator witness the ledger "
+    "reconciles against)", labelnames=("component", "job"))
+_MEM_HEADROOM = _registry.gauge(
+    "mem_hbm_headroom_bytes",
+    "device capacity minus (static ledger + compiled transient peak) "
+    "per job — the number item-3 int8-KV work is scored on",
+    labelnames=("job",))
+_MEM_UNATTRIBUTED = _registry.gauge(
+    "mem_unattributed_bytes",
+    "allocator live bytes the ledger cannot attribute to a registered "
+    "component (reconciliation residue; tolerance in BASELINE.md)",
+    labelnames=("job",))
+_OOM_TOTAL = _registry.counter(
+    "mem_oom_postmortems_total",
+    "OOM postmortems written by the forensics path",
+    labelnames=("job",))
+
+# documented reconciliation tolerance (BASELINE.md round 14): on the
+# CPU backend the witness is jax.live_arrays() — compile caches,
+# donated-buffer turnover and test-suite junk live next to the tracked
+# arrays, so the ledger is expected within this fraction (+ slack) of
+# the witness DELTA across engine construction, not byte-equal
+RECONCILE_TOLERANCE = 0.25
+_DECISIONS_CAP = 64
+_POSTMORTEMS_CAP = 16
+_TOP_K = 12
+
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Resource exhausted",
+                "Out of memory", "out of memory", "Allocation failure")
+
+
+class _MemState:
+    __slots__ = ("lock", "components", "decisions", "postmortems",
+                 "transient", "sentinel")
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.components = {}    # (job, name) -> provider
+        self.decisions = []     # bounded admission/preempt ring
+        self.postmortems = []   # bounded written-postmortem records
+        self.transient = {}     # job -> {"bytes", "source"}
+        self.sentinel = None
+
+
+_state = _MemState()
+
+
+def is_enabled():
+    return _flag("FLAGS_monitor_memory")
+
+
+# -- ledger ------------------------------------------------------------------
+
+def _nbytes(arr):
+    """Bytes of one array-like: ``nbytes`` when the object has it,
+    else shape x dtype itemsize (ShapeDtypeStructs in AOT plans)."""
+    n = getattr(arr, "nbytes", None)
+    if n is not None:
+        return int(n)
+    shape = getattr(arr, "shape", None)
+    dtype = getattr(arr, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    size = 1
+    for d in shape:
+        size *= int(d)
+    return size * int(getattr(dtype, "itemsize", 1) or 1)
+
+
+def _entry(ent):
+    """Normalize one provider entry into {tag, bytes, shape, dtype}.
+    Accepted forms: ``(tag, array_like)``, ``(tag, nbytes_int)``, or a
+    ready-made dict."""
+    if isinstance(ent, dict):
+        return {"tag": str(ent.get("tag")),
+                "bytes": int(ent.get("bytes", 0)),
+                "shape": ent.get("shape"), "dtype": ent.get("dtype")}
+    tag, obj = ent
+    if isinstance(obj, (int, float)):
+        return {"tag": str(tag), "bytes": int(obj), "shape": None,
+                "dtype": None}
+    shape = getattr(obj, "shape", None)
+    return {"tag": str(tag), "bytes": _nbytes(obj),
+            "shape": list(shape) if shape is not None else None,
+            "dtype": str(getattr(obj, "dtype", None))}
+
+
+def register_component(name, provider, job="default"):
+    """Register (or replace) one ledger component. ``provider()``
+    returns an iterable of entries (see ``_entry``) or a dict
+    ``{"entries": [...], "detail": {...}}``. Re-registration replaces
+    the provider — engines re-constructed in tests must not grow the
+    ledger without bound (the serving-metrics pruning discipline)."""
+    with _state.lock:
+        _state.components[(str(job), str(name))] = provider
+    return name
+
+
+def unregister_component(name, job="default"):
+    with _state.lock:
+        _state.components.pop((str(job), str(name)), None)
+
+
+def allocator_stats():
+    """The reconciliation witness. Device ``memory_stats()`` where the
+    backend reports one; on backends that don't (CPU) the summed
+    ``jax.live_arrays()`` nbytes. Consults jax ONLY when the process
+    already imported it (``sys.modules`` probe) — a bare worker
+    scraping /debugz/memory must not drag an accelerator backend in.
+    Never raises."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return {"source": "unavailable", "live_bytes": None,
+                "peak_bytes": None, "limit_bytes": None}
+    try:
+        stats = None
+        if jax.process_count() == 1:
+            # multi-process guard: the per-step device query races the
+            # in-flight collective transport (parallel/engine.py note)
+            stats = jax.local_devices()[0].memory_stats()
+        if stats and "bytes_in_use" in stats:
+            return {"source": "device_memory_stats",
+                    "live_bytes": int(stats["bytes_in_use"]),
+                    "peak_bytes": int(stats.get("peak_bytes_in_use", 0))
+                    or None,
+                    "limit_bytes": int(stats.get("bytes_limit", 0))
+                    or None}
+        live, n = 0, 0
+        for a in jax.live_arrays():
+            live += _nbytes(a)
+            n += 1
+        return {"source": "live_arrays", "live_bytes": int(live),
+                "live_arrays": n, "peak_bytes": None,
+                "limit_bytes": None}
+    except Exception as e:
+        _registry.warn_once(
+            "memory.allocator_stats",
+            "paddle_tpu.monitor.memory: allocator witness unavailable "
+            "(ledger stays unreconciled): %r" % (e,))
+        return {"source": "unavailable", "live_bytes": None,
+                "peak_bytes": None, "limit_bytes": None}
+
+
+def device_capacity_bytes(stats=None):
+    """HBM capacity for the headroom math: ``PT_MEM_CAPACITY_BYTES``
+    override first (tests, CPU smoke), then the allocator's own
+    ``bytes_limit``; None when neither exists (headroom then absent,
+    never fabricated)."""
+    raw = os.environ.get("PT_MEM_CAPACITY_BYTES")
+    if raw:
+        try:
+            return int(float(raw))
+        except ValueError:
+            pass
+    if stats is None:
+        stats = allocator_stats()
+    return stats.get("limit_bytes")
+
+
+def note_transient_peak(job, nbytes, source="caller"):
+    """Record the compiled-step transient peak for ``job`` — the
+    donation-aware ``executable_analysis``/``graph_report()`` number
+    (``compiled_peak``), which the headroom math adds to the static
+    ledger."""
+    with _state.lock:
+        _state.transient[str(job)] = {"bytes": int(nbytes),
+                                      "source": str(source)}
+
+
+def transient_peak(job):
+    """{bytes, source} of the best-known compiled transient peak for
+    ``job``: an explicit ``note_transient_peak`` first, else the
+    ``hbm_peak_bytes{job}`` gauge perf attribution publishes."""
+    with _state.lock:
+        rec = _state.transient.get(str(job))
+    if rec is not None:
+        return dict(rec)
+    g = _registry.get_registry().get("hbm_peak_bytes")
+    if g is not None:
+        for key, v in g.collect():
+            if key == (str(job),) and isinstance(v, (int, float)) \
+                    and v > 0:
+                return {"bytes": int(v), "source": "hbm_peak_bytes"}
+    return None
+
+
+def compiled_peak(compiled):
+    """Donation-aware HBM peak of one compiled executable — THE shared
+    peak number (monitor/perf.py ``executable_analysis``: the real
+    buffer-assignment peak when jaxlib reports one, else args + temps
+    + outputs net of donation aliasing, flagged as an estimate).
+    tools/llama7b_plan.py and the graph_report() cost rows both
+    consume this instead of hand-rolling the fallback. Returns
+    ``(peak_bytes_or_None, is_estimate)``. ``memory_only`` skips the
+    cost_analysis FLOPs walk the peak never needed."""
+    a = _perf.executable_analysis(compiled, memory_only=True)
+    return a.get("hbm_peak_bytes"), bool(a.get("hbm_peak_is_estimate"))
+
+
+def sample():
+    """Walk every registered provider, publish the ``mem_*`` gauges,
+    reconcile against the allocator witness, and return the breakdown
+    dict (the /debugz/memory core). Never raises: a provider dying
+    marks ITS component and the rest of the ledger still reports."""
+    with _state.lock:
+        items = sorted(_state.components.items())
+    components = {}
+    job_totals = {}
+    arrays = []
+    for (job, name), provider in items:
+        try:
+            raw = provider() or ()
+        except Exception as e:
+            _registry.warn_once(
+                "memory.provider.%s.%s" % (job, name),
+                "paddle_tpu.monitor.memory: provider %s/%s raised "
+                "(component reports error, ledger continues): %r"
+                % (job, name, e))
+            components.setdefault(job, {})[name] = {
+                "bytes": 0, "entries": 0, "error": repr(e)}
+            continue
+        detail = None
+        if isinstance(raw, dict):
+            detail = raw.get("detail")
+            raw = raw.get("entries") or ()
+        ents = [_entry(e) for e in raw]
+        total = sum(e["bytes"] for e in ents)
+        comp = {"bytes": total, "entries": len(ents)}
+        if detail:
+            comp["detail"] = dict(detail)
+        components.setdefault(job, {})[name] = comp
+        job_totals[job] = job_totals.get(job, 0) + total
+        for e in ents:
+            arrays.append(dict(e, component=name, job=job))
+        _MEM_DEV.labels(component=name, job=job).set(total)
+    stats = allocator_stats()
+    ledger_total = sum(job_totals.values())
+    unattributed = None
+    if stats["live_bytes"] is not None:
+        _MEM_DEV.labels(component="allocator",
+                        job="device").set(stats["live_bytes"])
+        unattributed = stats["live_bytes"] - ledger_total
+        _MEM_UNATTRIBUTED.labels(job="device").set(unattributed)
+    cap = device_capacity_bytes(stats)
+    jobs = {}
+    for job, total in sorted(job_totals.items()):
+        peak = transient_peak(job)
+        row = {"ledger_bytes": total,
+               "transient_peak_bytes": peak["bytes"] if peak else None,
+               "transient_peak_source": peak["source"] if peak
+               else None,
+               "capacity_bytes": cap, "headroom_bytes": None}
+        if cap is not None:
+            # headroom subtracts the FULL static ledger (every job's
+            # components share the one device), plus THIS job's
+            # transient peak — two jobs on one chip must not each
+            # claim the other's bytes as free
+            row["headroom_bytes"] = int(
+                cap - ledger_total - (peak["bytes"] if peak else 0))
+            _MEM_HEADROOM.labels(job=job).set(row["headroom_bytes"])
+        jobs[job] = row
+    arrays.sort(key=lambda a: -a["bytes"])
+    return {
+        "components": components,
+        "jobs": jobs,
+        "top_arrays": arrays[:_TOP_K],
+        "reconciliation": {
+            "source": stats["source"],
+            "live_bytes": stats["live_bytes"],
+            "ledger_bytes": ledger_total,
+            "unattributed_bytes": unattributed,
+            "tolerance": RECONCILE_TOLERANCE,
+        },
+    }
+
+
+# -- decision ring (OOM-postmortem context) ----------------------------------
+
+def note_decision(job, kind, **info):
+    """Record one scheduler decision (admit / preempt / shed) into the
+    bounded ring the OOM postmortem embeds — "what was the engine
+    doing to the pool right before it died". Monotonic stamp: the
+    postmortem orders and ages these, never a wall clock."""
+    rec = {"job": str(job), "kind": str(kind),
+           "t_mono": time.monotonic()}
+    rec.update(info)
+    with _state.lock:
+        _state.decisions.append(rec)
+        if len(_state.decisions) > _DECISIONS_CAP:
+            del _state.decisions[:len(_state.decisions)
+                                 - _DECISIONS_CAP]
+
+
+def recent_decisions(k=16):
+    with _state.lock:
+        return list(_state.decisions[-int(k):])
+
+
+# -- OOM forensics -----------------------------------------------------------
+
+def looks_like_oom(exc):
+    """OOM classification: XLA RESOURCE_EXHAUSTED shapes, plus the
+    deterministic ``mem.oom`` injection site (CPU-testable stand-in —
+    a real 16 GB exhaustion cannot run in CI)."""
+    try:
+        from ..resilience.faultinject import InjectedFault
+
+        if isinstance(exc, InjectedFault) and exc.site == "mem.oom":
+            return True
+    except Exception as e:
+        _registry.warn_once(
+            "memory.oom_classify",
+            "paddle_tpu.monitor.memory: fault-inject import failed "
+            "during OOM classification (marker match still runs): %r"
+            % (e,))
+    msg = "%s: %s" % (type(exc).__name__, exc)
+    return any(m in msg for m in _OOM_MARKERS)
+
+
+def _rank():
+    try:
+        from ..distributed import process_group as _pg
+
+        pg = _pg.get_world_group()
+        if pg is not None:
+            return int(pg.rank)
+    except Exception as e:
+        _registry.warn_once(
+            "memory.rank",
+            "paddle_tpu.monitor.memory: world-group rank lookup "
+            "failed (postmortem files as rank from env/0): %r" % (e,))
+    try:
+        return int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    except ValueError:
+        return 0
+
+
+def write_postmortem(job, exc, context=None):
+    """Emit ``oom_postmortem_rank{r}.json`` (PT_MONITOR_DUMP_DIR):
+    ledger breakdown + top-K live arrays, caller context (KV occupancy
+    etc.), the recent decision ring, and the last-K ``mem_*`` ring
+    tails. NEVER raises and never recovers — the caller re-raises the
+    original failure; this only makes sure the evidence outlives the
+    process. Returns the written path or None."""
+    try:
+        rank = _rank()
+        try:
+            breakdown = sample()
+        except Exception as e:   # the ledger itself must not mask the OOM
+            breakdown = {"error": repr(e)}
+        post = {
+            "kind": "oom_postmortem",
+            "version": 1,
+            "job": str(job),
+            "rank": rank,
+            "pid": os.getpid(),
+            "created_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                        time.gmtime()),
+            "unix_time": time.time(),
+            "error": repr(exc),
+            "error_type": type(exc).__name__,
+            "injected": type(exc).__name__ == "InjectedFault",
+            "ledger": breakdown,
+            "context": dict(context) if context else {},
+            "decisions": recent_decisions(),
+            "mem_ring_tails": _timeseries.tail(prefixes=("mem_",),
+                                               k=32),
+        }
+        d = os.environ.get("PT_MONITOR_DUMP_DIR") or "."
+        os.makedirs(d, exist_ok=True)
+        path = os.path.join(d, "oom_postmortem_rank%d.json" % rank)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(post, f, indent=1, default=str)
+            f.write("\n")
+        os.replace(tmp, path)
+    except Exception as e:
+        _registry.warn_once(
+            "memory.postmortem",
+            "paddle_tpu.monitor.memory: OOM postmortem write failed "
+            "(original failure re-raises regardless): %r" % (e,))
+        return None
+    _OOM_TOTAL.labels(job=str(job)).inc()
+    with _state.lock:
+        _state.postmortems.append({
+            "path": path, "job": str(job), "rank": rank,
+            "unix_time": post["unix_time"], "error": repr(exc)})
+        if len(_state.postmortems) > _POSTMORTEMS_CAP:
+            del _state.postmortems[:len(_state.postmortems)
+                                   - _POSTMORTEMS_CAP]
+    return path
+
+
+# -- leak sentinel -----------------------------------------------------------
+
+class MemLeakSentinel(_perf.Sentinel):
+    """Steady-state growth of live bytes: a full window of
+    never-decreasing samples whose total growth clears
+    ``min_growth_bytes`` (and spans ``min_window_s`` of MONOTONIC
+    time) fires ``perf_anomalies_total{kind="mem_leak"}`` — which
+    flips /healthz to degraded via the existing perf plumbing. Warmup
+    is the base-class guarantee: a clean warmup can never fire. Any
+    single decreasing sample (a release, a preemption reclaim) resets
+    the verdict — sawtooth occupancy is load, monotone growth is a
+    leak."""
+
+    kind = "mem_leak"
+
+    def __init__(self, series="mem_device_bytes", warmup=8, window=6,
+                 min_growth_bytes=1 << 20, min_window_s=0.0):
+        super().__init__(series, warmup=warmup)
+        self.window = int(window)
+        self.min_growth = int(min_growth_bytes)
+        self.min_window_s = float(min_window_s)
+
+    def check(self, st, value):
+        win = st.get("win") or []
+        if len(win) < self.window:
+            return None
+        vals = [v for _, v in win]
+        if any(b < a for a, b in zip(vals, vals[1:])):
+            return None
+        if value < vals[-1]:
+            return None
+        growth = value - vals[0]
+        if growth < self.min_growth:
+            return None
+        span = time.monotonic() - win[0][0]
+        if span < self.min_window_s:
+            return None
+        return {"growth_bytes": growth, "window": self.window,
+                "window_s": span, "first_bytes": vals[0],
+                "last_bytes": value}
+
+    def update(self, st, value):
+        win = st.setdefault("win", [])
+        # window stamps are our OWN monotonic reads, not the ring's
+        # wall ts — the span math must survive an NTP step mid-window
+        win.append((time.monotonic(), value))
+        if len(win) > self.window:
+            del win[:len(win) - self.window]
+
+
+def _ensure_leak_sentinel():
+    """Install the leak sentinel once (enabling the ring + listener it
+    reads, the ``perf.add_sentinel`` contract)."""
+    with _state.lock:
+        if _state.sentinel is not None:
+            return _state.sentinel
+        s = _state.sentinel = MemLeakSentinel()
+    _perf.add_sentinel(s)
+    return s
+
+
+# -- construction-latch tracker (the engine-facing API) ----------------------
+
+class MemTracker:
+    """One engine's latched handle: decisions, transient peaks and
+    postmortems route through it so the hot path never re-reads the
+    flag (ptlint hot-path-latch discipline)."""
+
+    __slots__ = ("job", "_context_fn")
+
+    def __init__(self, job, context_fn=None):
+        self.job = job
+        self._context_fn = context_fn
+
+    def note_decision(self, kind, **info):
+        note_decision(self.job, kind, **info)
+
+    def note_transient_peak(self, nbytes, source="engine"):
+        note_transient_peak(self.job, nbytes, source)
+
+    def write_postmortem(self, exc):
+        ctx = None
+        if self._context_fn is not None:
+            try:
+                ctx = self._context_fn()
+            except Exception as e:
+                ctx = {"context_error": repr(e)}
+        return write_postmortem(self.job, exc, context=ctx)
+
+
+def tracker(job, components, context_fn=None):
+    """THE construction-latch entry point: when ``FLAGS_monitor_memory``
+    is on, register ``components`` ({name: provider}) under ``job``,
+    arm the leak sentinel, and return a ``MemTracker``; when off,
+    return None — one flag read at construction, and the hot path only
+    ever checks the handle."""
+    if not is_enabled():
+        return None
+    for name, provider in components.items():
+        register_component(name, provider, job=job)
+    _ensure_leak_sentinel()
+    return MemTracker(job, context_fn)
+
+
+# -- payload / reset ---------------------------------------------------------
+
+def memory_payload():
+    """The /debugz/memory JSON body. Off = pinned
+    ``{"enabled": false}`` shape with empty collections (route answers
+    200 either way — "off" is a payload, not an error)."""
+    enabled = is_enabled()
+    out = {"enabled": enabled, "time": time.time(),
+           "components": {}, "jobs": {}, "decisions": [],
+           "postmortems": []}
+    if not enabled:
+        return out
+    out.update(sample())
+    out["decisions"] = recent_decisions()
+    with _state.lock:
+        out["postmortems"] = list(_state.postmortems)
+        s = _state.sentinel
+    out["leak_sentinel"] = None if s is None else {
+        "series": s.series, "warmup": s.warmup, "window": s.window,
+        "min_growth_bytes": s.min_growth,
+        "min_window_s": s.min_window_s}
+    return out
+
+
+def reset():
+    """Test hook: forget components/decisions/postmortems/peaks, drop
+    the published ``mem_*`` series (flags-off after reset is pinned
+    series-free), and detach the leak sentinel."""
+    with _state.lock:
+        _state.components = {}
+        _state.decisions = []
+        _state.postmortems = []
+        _state.transient = {}
+        s, _state.sentinel = _state.sentinel, None
+    if s is not None:
+        try:
+            _perf._state.sentinels.remove(s)
+        except ValueError:
+            pass
+    for g in (_MEM_DEV, _MEM_HEADROOM, _MEM_UNATTRIBUTED, _OOM_TOTAL):
+        for key in list(g._children):
+            g.remove(*key)
+
+
+# env/FLAGS bootstrap (the timeseries/perf discipline): a process
+# started with FLAGS_monitor_memory=1 has the leak sentinel armed from
+# its first sample without any code change.
+if _flag("FLAGS_monitor_memory"):
+    _ensure_leak_sentinel()

@@ -147,27 +147,32 @@ def machine_spec():
 
 # -- executable analysis -----------------------------------------------------
 
-def executable_analysis(compiled, steps=1):
+def executable_analysis(compiled, steps=1, memory_only=False):
     """FLOPs + HBM accounting of one compiled executable (a jax AOT
     ``Compiled`` — passed in, never imported). ``steps`` divides the
-    totals for multi-step modules. Never raises: perf attribution must
+    totals for multi-step modules. ``memory_only`` skips the
+    cost_analysis FLOPs walk for callers (monitor/memory.py
+    ``compiled_peak``) that only need the peak — the peak RULE still
+    lives here and nowhere else. Never raises: perf attribution must
     not take down a training run."""
     out = {"source": "xla_cost_analysis", "steps_per_call": int(steps)}
     steps = max(int(steps), 1)
-    try:
-        ca = compiled.cost_analysis()
-        d = ca[0] if isinstance(ca, (list, tuple)) and ca else ca
-        if d:
-            flops = float(d.get("flops", 0.0))
-            if flops > 0:
-                out["flops_per_step"] = flops / steps
-            ba = float(d.get("bytes accessed", 0.0))
-            if ba > 0:
-                out["bytes_accessed_per_step"] = ba / steps
-    # ptlint: silent-except-ok — cost_analysis is a backend-optional
-    # introspection API; absent fields are the documented contract
-    except Exception:
-        pass
+    if not memory_only:
+        try:
+            ca = compiled.cost_analysis()
+            d = ca[0] if isinstance(ca, (list, tuple)) and ca else ca
+            if d:
+                flops = float(d.get("flops", 0.0))
+                if flops > 0:
+                    out["flops_per_step"] = flops / steps
+                ba = float(d.get("bytes accessed", 0.0))
+                if ba > 0:
+                    out["bytes_accessed_per_step"] = ba / steps
+        # ptlint: silent-except-ok — cost_analysis is a
+        # backend-optional introspection API; absent fields are the
+        # documented contract
+        except Exception:
+            pass
     try:
         ma = compiled.memory_analysis()
         arg = int(ma.argument_size_in_bytes)

@@ -320,7 +320,7 @@ def build_bundle(reason="debugz", stalls=None):
 
         ts_tail = _timeseries.tail(
             prefixes=("train_step_seconds", "train_tokens_per_s",
-                      "train_loss", "comm_", "grad_sync_",
+                      "train_loss", "comm_", "grad_sync_", "mem_",
                       "serving_throughput", "serving_goodput"),
             k=int(os.environ.get("PT_WATCHDOG_TS_TAIL", "32")))
     except Exception:

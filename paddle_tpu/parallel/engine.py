@@ -33,6 +33,7 @@ from __future__ import annotations
 import time
 
 import warnings
+import weakref
 
 import jax
 import jax.numpy as jnp
@@ -67,7 +68,11 @@ _TRAIN_COMPILES = _monitor.counter(
     "train_compiles_total", "XLA traces of the train step",
     labelnames=("kind",))
 _DEV_MEM = _monitor.gauge(
-    "device_memory_bytes", "device allocator stats (first local device)",
+    "device_memory_bytes", "device allocator stats (first local device)"
+    "; DEPRECATED round 14: the memory plane (monitor/memory.py) "
+    "publishes the same witness as mem_device_bytes{component="
+    "\"allocator\",job=\"device\"} — this series emits one more round "
+    "(BASELINE.md deprecation note), then dashboards move",
     labelnames=("stat",))
 # watchdog heartbeat: each compiled call runs inside a busy bracket so
 # a hung dispatch (wedged tunnel, XLA deadlock) is a detectable stall
@@ -245,6 +250,47 @@ class CompiledTrainStep:
         # FLAGS_monitor_fleet the scraped train series resolve to this
         # rank/host/job; one flag branch when off
         _monitor.fleet.note_identity("train")
+        # memory-plane ledger (monitor/memory.py, FLAGS_monitor_memory),
+        # LATCHED HERE: params / optimizer slots / EF residuals report
+        # live nbytes (the donated step state IS these three carried
+        # pytrees). None = flags-off; the step hot path only checks
+        # the handle.
+        self._mem = _monitor.memory.tracker(
+            "train", self._mem_components(),
+            context_fn=lambda: {"step_count": self._step_count})
+
+    def _mem_components(self):
+        """Ledger providers: every carried (donated) buffer class of
+        the compiled step, tagged by functional name so an OOM
+        postmortem's top-arrays table names real parameters. The
+        providers hold the step WEAKLY — the global ledger must never
+        pin a discarded step's params/slots (and their device
+        buffers) alive; a dead step's components just report empty."""
+        wself = weakref.ref(self)
+
+        def model_params():
+            s = wself()
+            if s is None:
+                return ()
+            return [(n, s._tensors[n]._value) for n in s._names]
+
+        def optimizer_slots():
+            s = wself()
+            if s is None:
+                return ()
+            return [("%s/slot%d" % (n, j), sl)
+                    for n, slots in s._opt_state.items()
+                    for j, sl in enumerate(slots)]
+
+        def ef_residuals():
+            s = wself()
+            if s is None:
+                return ()
+            return list(s._ef_state.items())
+
+        return {"model_params": model_params,
+                "optimizer_slots": optimizer_slots,
+                "ef_residuals": ef_residuals}
 
     # -- sharding specs ----------------------------------------------------
 
@@ -599,22 +645,33 @@ class CompiledTrainStep:
         # recovers from. One branch (and zero allocations) when disabled.
         if _fi.is_enabled():
             _fi.fire("train.run_steps", step0=self._step_count + 1)
-        if getattr(self, "_compiled_multi", None) is None:
-            self._build_multi()
-        vals = self._prep_batch(stacked_batch, stacked=True)
-        k = int(vals[0].shape[0])
-        tensors = self._tensors
-        state_vals = [tensors[n]._value for n in self._names]
-        from ..framework import random as _random
+        try:
+            # OOM forensics site (monitor/memory.py): armed only while
+            # the tracker is latched; the postmortem wrapper below
+            # treats the InjectedFault exactly like RESOURCE_EXHAUSTED
+            if self._mem is not None and _fi.is_enabled():
+                _fi.fire("mem.oom", step0=self._step_count + 1)
+            if getattr(self, "_compiled_multi", None) is None:
+                self._build_multi()
+            vals = self._prep_batch(stacked_batch, stacked=True)
+            k = int(vals[0].shape[0])
+            tensors = self._tensors
+            state_vals = [tensors[n]._value for n in self._names]
+            from ..framework import random as _random
 
-        t0 = time.perf_counter()
-        with _HB_TRAIN.busy("train.run_steps", steps=k,
-                            step0=self._step_count + 1):
-            loss, new_state, new_opt, new_ef = self._compiled_multi(
-                state_vals, self._opt_state, self._ef_state,
-                jnp.asarray(self._step_count + 1, jnp.int32),
-                jnp.asarray(self.optimizer.get_lr(), jnp.float32),
-                _random._key(), vals)
+            t0 = time.perf_counter()
+            with _HB_TRAIN.busy("train.run_steps", steps=k,
+                                step0=self._step_count + 1):
+                loss, new_state, new_opt, new_ef = self._compiled_multi(
+                    state_vals, self._opt_state, self._ef_state,
+                    jnp.asarray(self._step_count + 1, jnp.int32),
+                    jnp.asarray(self.optimizer.get_lr(), jnp.float32),
+                    _random._key(), vals)
+        except Exception as e:
+            if self._mem is not None \
+                    and _monitor.memory.looks_like_oom(e):
+                self._mem.write_postmortem(e)
+            raise
         t1 = time.perf_counter()
         _record_step(vals, k, t1 - t0, stacked=True)
         self._note_perf(vals, k, t1 - t0, loss, t0, t1, stacked=True)
@@ -710,7 +767,16 @@ class CompiledTrainStep:
             jnp.asarray(0, jnp.int32),
             jnp.asarray(0.0, jnp.float32), _random._key(),
             vals).compile()
-        return _perf.executable_analysis(compiled, steps=1)
+        analysis = _perf.executable_analysis(compiled, steps=1)
+        # feed the memory ledger's headroom math: this donation-aware
+        # peak is the "compiled transient" half of
+        # mem_hbm_headroom_bytes (monitor/memory.py)
+        if self._mem is not None and "hbm_peak_bytes" in analysis:
+            self._mem.note_transient_peak(
+                analysis["hbm_peak_bytes"],
+                source="estimate" if analysis.get("hbm_peak_is_estimate")
+                else "xla_memory_analysis")
+        return analysis
 
     def graph_report(self, *batch):
         """Lower (never execute) the single-step program for these
@@ -795,21 +861,31 @@ class CompiledTrainStep:
         """batch = (*inputs, labels) as Tensors or arrays; returns loss."""
         if _fi.is_enabled():
             _fi.fire("train.step", step=self._step_count + 1)
-        if self._compiled is None:
-            self._build()
-        vals = self._prep_batch(batch)
-        tensors = self._tensors
-        state_vals = [tensors[n]._value for n in self._names]
-        from ..framework import random as _random
+        try:
+            # OOM forensics site (monitor/memory.py): armed only while
+            # the tracker is latched
+            if self._mem is not None and _fi.is_enabled():
+                _fi.fire("mem.oom", step=self._step_count + 1)
+            if self._compiled is None:
+                self._build()
+            vals = self._prep_batch(batch)
+            tensors = self._tensors
+            state_vals = [tensors[n]._value for n in self._names]
+            from ..framework import random as _random
 
-        self._step_count += 1
-        t0 = time.perf_counter()
-        with _HB_TRAIN.busy("train.step", step=self._step_count):
-            loss, new_state, new_opt, new_ef = self._compiled(
-                state_vals, self._opt_state, self._ef_state,
-                jnp.asarray(self._step_count, jnp.int32),
-                jnp.asarray(self.optimizer.get_lr(), jnp.float32),
-                _random._key(), vals)
+            self._step_count += 1
+            t0 = time.perf_counter()
+            with _HB_TRAIN.busy("train.step", step=self._step_count):
+                loss, new_state, new_opt, new_ef = self._compiled(
+                    state_vals, self._opt_state, self._ef_state,
+                    jnp.asarray(self._step_count, jnp.int32),
+                    jnp.asarray(self.optimizer.get_lr(), jnp.float32),
+                    _random._key(), vals)
+        except Exception as e:
+            if self._mem is not None \
+                    and _monitor.memory.looks_like_oom(e):
+                self._mem.write_postmortem(e)
+            raise
         t1 = time.perf_counter()
         _record_step(vals, 1, t1 - t0)
         self._note_perf(vals, 1, t1 - t0, loss, t0, t1)

@@ -20,6 +20,11 @@ Sites (the contract between this module and the instrumented code):
     train.step         CompiledTrainStep.__call__
     train.run_steps    CompiledTrainStep.run_steps
     snapshot.save      ResilientTrainLoop snapshot write
+    mem.oom            deterministic OOM stand-in on the engine hot
+                       paths (armed only while FLAGS_monitor_memory
+                       latched a tracker; monitor/memory.py treats the
+                       InjectedFault exactly like RESOURCE_EXHAUSTED,
+                       so the postmortem path is CPU-testable)
 
 Fault kinds:
 

@@ -39,6 +39,8 @@ lifecycle (EOS, admission, preemption) needs to see anyway.
 """
 from __future__ import annotations
 
+import weakref
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -153,6 +155,10 @@ class Engine:
         self.scheduler = Scheduler(max_slots, self.cache,
                                    self.prefix_cache)
         self.metrics = EngineMetrics(max_slots)
+        # memory plane (monitor/memory.py, FLAGS_monitor_memory),
+        # LATCHED HERE like the tier-2 flags: the step hot path only
+        # ever checks the handle. None = flags-off, bit-identical.
+        self._mem = None
         # fleet identity beacon (monitor/fleet.py): under
         # FLAGS_monitor_fleet the scraped serving series resolve to
         # this rank/host/job; one flag branch when off
@@ -197,6 +203,65 @@ class Engine:
             else:
                 self._prefill = jax.jit(self._prefill_fn,
                                         donate_argnums=(1,))
+        self._mem = _monitor.memory.tracker(
+            "serving", self._mem_components(),
+            context_fn=self._mem_context)
+
+    def _mem_components(self):
+        """Ledger providers (monitor/memory.py): the paged KV pools
+        (every layer's k/v planes, with prefix-cache/COW page detail)
+        and the resident model weights. Providers read live engine
+        state at sample time, so pool resets and COW churn are always
+        current — and hold the engine WEAKLY, so the global ledger
+        never pins a discarded engine's pools/weights alive (a dead
+        engine's components just report empty)."""
+        wself = weakref.ref(self)
+
+        def kv_pool():
+            s = wself()
+            if s is None:
+                return ()
+            cache = s.cache
+            entries = []
+            for i, pool in enumerate(cache.pools):
+                entries.append(("kv_pool/layer%d/k" % i, pool.k))
+                entries.append(("kv_pool/layer%d/v" % i, pool.v))
+            alloc = cache.allocator
+            detail = {
+                "pages_used": alloc.usable_blocks - alloc.free_blocks,
+                "pages_usable": alloc.usable_blocks,
+                "cow_clones": cache.cow_clones,
+            }
+            if s.prefix_cache is not None:
+                detail["prefix_cached_pages"] = \
+                    s.prefix_cache.stats()["cached_pages"]
+            return {"entries": entries, "detail": detail}
+
+        def model_params():
+            s = wself()
+            if s is None:
+                return ()
+            return list(zip(s._names, s._state_vals))
+
+        return {"kv_pool": kv_pool, "model_params": model_params}
+
+    def _mem_context(self):
+        """OOM-postmortem context: the pool/batch state at the moment
+        of death — occupancy, slot fill, prefix-cache residency."""
+        alloc = self.cache.allocator
+        used = alloc.usable_blocks - alloc.free_blocks
+        ctx = {
+            "kv_page_occupancy": used / max(alloc.usable_blocks, 1),
+            "kv_pages_used": used,
+            "kv_pages_usable": alloc.usable_blocks,
+            "slots_active": self.scheduler.slots_active(),
+            "queue_depth": len(self.scheduler.queue),
+            "cow_clones": self.cache.cow_clones,
+        }
+        if self.prefix_cache is not None:
+            ctx["prefix_cached_pages"] = \
+                self.prefix_cache.stats()["cached_pages"]
+        return ctx
 
     # -- public API -------------------------------------------------------
 
@@ -268,30 +333,47 @@ class Engine:
                     _fi.fire("serving.step")
             except _fi.InjectedFault:
                 return self.has_work()
-            self._expire_waiting()
-            self._admit_and_prefill()
-            self._grow_or_preempt()
-            # perf attribution (FLAGS_perf_attribution): KV-page
-            # occupancy + goodput per engine iteration, sampled at the
-            # step's high-water point (pages grown, nothing released
-            # yet) — pure host arithmetic, but still flag-gated so the
-            # default serving hot path does no new work
-            if _monitor.is_enabled() \
-                    and _monitor.perf.attribution_enabled():
-                alloc = self.cache.allocator
-                self.metrics.on_kv_occupancy(
-                    1.0 - alloc.free_blocks / max(alloc.usable_blocks, 1))
-            if self.chunked_prefill:
-                rows = self.scheduler.occupied()
-                if rows:
-                    self._mixed_once(rows)
-            else:
-                active = self.scheduler.active()
-                if active:
-                    self._decode_once(active)
-            if self.prefix_cache is not None:
-                self.metrics.on_prefix_stats(self.prefix_cache.stats(),
-                                             self.cache.cow_clones)
+            try:
+                # OOM forensics (monitor/memory.py, latched at
+                # construction): mem.oom is the deterministic
+                # RESOURCE_EXHAUSTED stand-in; any OOM-shaped failure
+                # writes oom_postmortem_rank{r}.json and RE-RAISES —
+                # allocator state after a real OOM is unknowable, so
+                # unlike the poison paths there is no recovery here
+                if self._mem is not None and _fi.is_enabled():
+                    _fi.fire("mem.oom")
+                self._expire_waiting()
+                self._admit_and_prefill()
+                self._grow_or_preempt()
+                # perf attribution (FLAGS_perf_attribution): KV-page
+                # occupancy + goodput per engine iteration, sampled at
+                # the step's high-water point (pages grown, nothing
+                # released yet) — pure host arithmetic, but still
+                # flag-gated so the default serving hot path does no
+                # new work
+                if _monitor.is_enabled() \
+                        and _monitor.perf.attribution_enabled():
+                    alloc = self.cache.allocator
+                    self.metrics.on_kv_occupancy(
+                        1.0 - alloc.free_blocks
+                        / max(alloc.usable_blocks, 1))
+                if self.chunked_prefill:
+                    rows = self.scheduler.occupied()
+                    if rows:
+                        self._mixed_once(rows)
+                else:
+                    active = self.scheduler.active()
+                    if active:
+                        self._decode_once(active)
+                if self.prefix_cache is not None:
+                    self.metrics.on_prefix_stats(
+                        self.prefix_cache.stats(),
+                        self.cache.cow_clones)
+            except Exception as e:
+                if self._mem is not None \
+                        and _monitor.memory.looks_like_oom(e):
+                    self._mem.write_postmortem(e)
+                raise
         return self.has_work()
 
     def run(self):
@@ -373,6 +455,10 @@ class Engine:
                 return
             slot, req = admitted
             self.metrics.on_admission()
+            if self._mem is not None:
+                self._mem.note_decision(
+                    "admit", request=req.id, slot=slot,
+                    kv_pages_free=self.cache.allocator.free_blocks)
             if self.chunked_prefill:
                 # no synchronous prefill: the request sits in PREFILL
                 # state and its prompt streams through the mixed step
@@ -570,11 +656,19 @@ class Engine:
                         req.close(RequestState.SHED, "preempt_cap")
                         self._quarantine.discard(req.id)
                         self.metrics.on_request_shed("preempt_cap")
+                        if self._mem is not None:
+                            self._mem.note_decision(
+                                "shed", request=req.id,
+                                reason="preempt_cap")
                         break
                     raise RuntimeError(
                         "KV pool exhausted by a single request — "
                         "add_request validation should have caught this")
                 self.metrics.on_preemption()
+                if self._mem is not None:
+                    self._mem.note_decision(
+                        "preempt", victim=victim.id, grower=req.id,
+                        kv_pages_free=self.cache.allocator.free_blocks)
 
     def _decode_once(self, active):
         try:
@@ -677,6 +771,13 @@ class Engine:
         failure unattributable again — with a deterministic poison that
         ping-pongs forever. Strict FCFS also means nothing behind the
         quarantined head could use the freed batch slots anyway."""
+        # an OOM-shaped decode failure gets its forensics BEFORE the
+        # recovery below mutates the pool state the postmortem must
+        # describe (the quarantine path still runs — a transient OOM
+        # in a batched decode is recoverable the same way any decode
+        # failure is)
+        if self._mem is not None and _monitor.memory.looks_like_oom(exc):
+            self._mem.write_postmortem(exc)
         if len(active) == 1:
             _, req = active[0]
             self._fail_request(req, exc)

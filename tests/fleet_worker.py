@@ -52,6 +52,7 @@ def main():
 
     from paddle_tpu import monitor
     from paddle_tpu.monitor import fleet, perf, trace
+    from paddle_tpu.monitor import memory as ptmem
     from paddle_tpu.distributed.process_group import (
         StoreProcessGroup,
         set_world_group,
@@ -68,6 +69,17 @@ def main():
     url = fleet.announce(store, rank, world, job="train")
     assert url, "announce() returned no url with the flag on"
     print("ANNOUNCED rank=%d url=%s" % (rank, url), flush=True)
+
+    # memory plane (ISSUE 12): a synthetic per-rank ledger so the
+    # collector's /debugz/memory scrape populates the fleet table's
+    # MEM/HEADROOM columns — distinct per rank so the parent test can
+    # pin that each rank's own bytes surfaced (64 MiB + rank MiB)
+    mem_bytes = (64 + rank) << 20
+    if ptmem.is_enabled():
+        tr = ptmem.tracker(
+            "train", {"synthetic": lambda: [("blob", mem_bytes)]})
+        assert tr is not None
+        ptmem.note_transient_peak("train", 8 << 20, source="test")
 
     collector = None
     if rank == 0:
@@ -148,6 +160,17 @@ def main():
         final = max((st.get("steps_total") or 0)
                     for st in collector._ranks.values())
         print("FINAL_STEPS %d" % int(final), flush=True)
+        # per-rank memory columns over real HTTP (ISSUE 12): the
+        # parent test pins every rank's MEM/HEADROOM against its own
+        # synthetic ledger
+        with urllib.request.urlopen(url + "/debugz/fleet/ranks",
+                                    timeout=10) as r:
+            ranks = json.loads(r.read().decode())["ranks"]
+        print("MEM_COLUMNS %s" % json.dumps(
+            [{"rank": row["rank"],
+              "mem_live_bytes": row.get("mem_live_bytes"),
+              "mem_headroom_bytes": row.get("mem_headroom_bytes")}
+             for row in ranks]), flush=True)
         with urllib.request.urlopen(url + "/metrics/fleet",
                                     timeout=10) as r:
             text = r.read().decode()

@@ -20,6 +20,7 @@ import pytest
 import paddle_tpu as paddle
 from paddle_tpu import monitor
 from paddle_tpu.monitor import fleet
+from paddle_tpu.monitor import memory as ptmem
 from paddle_tpu.monitor import perf
 from paddle_tpu.monitor import registry as mreg
 from paddle_tpu.monitor import timeseries as ts
@@ -40,6 +41,7 @@ ROUTES = {
     "debugz/timeseries": (200, "json"),
     "debugz/trace": (200, "json"),
     "debugz/trace/journal": (200, "json"),
+    "debugz/memory": (200, "json"),
     "debugz/resilience": (200, "json"),
     "debugz/fleet": (200, "json"),
     "debugz/fleet/ranks": (200, "json"),
@@ -48,7 +50,7 @@ ROUTES = {
 
 ALL_FLAGS = ("FLAGS_monitor_timeseries", "FLAGS_perf_attribution",
              "FLAGS_perf_sentinels", "FLAGS_monitor_trace",
-             "FLAGS_monitor_fleet")
+             "FLAGS_monitor_fleet", "FLAGS_monitor_memory")
 
 
 @pytest.fixture()
@@ -64,6 +66,7 @@ def _reset_monitor_state():
     _fi.disable()
     _fi._state.rules = []
     paddle.set_flags({f: False for f in ALL_FLAGS})
+    ptmem.reset()
     perf.disable_sentinels()
     perf.reset()
     ts.disable()
@@ -132,6 +135,11 @@ class TestRouteMatrixAllOff:
         _, body = _get(server, "healthz")
         p = json.loads(body.decode())
         assert p["status"] == "ok" and p["watchdog"] == "disabled"
+        _, body = _get(server, "debugz/memory")
+        p = json.loads(body.decode())
+        assert p["enabled"] is False
+        assert p["components"] == {} and p["jobs"] == {}
+        assert p["decisions"] == [] and p["postmortems"] == []
         _, body = _get(server, "debugz/resilience")
         p = json.loads(body.decode())
         assert p["fault_injection"]["enabled"] is False
@@ -180,6 +188,7 @@ class TestRouteMatrixAllOn:
             h.observe(0.5)
         trace.end_span(sid)
         perf.note_job("t_routes_job", tokens_per_s=10.0)
+        ptmem.tracker("t_routes_job", {"c": lambda: [("x", 4096)]})
 
         _check_matrix(server)
         _, body = _get(server, "debugz/trace")
@@ -202,6 +211,11 @@ class TestRouteMatrixAllOn:
         p = json.loads(body.decode())
         assert p["watchdog"] == "enabled" and p["status"] in (
             "ok", "degraded")
+        _, body = _get(server, "debugz/memory")
+        p = json.loads(body.decode())
+        assert p["enabled"] is True
+        assert p["components"]["t_routes_job"]["c"]["bytes"] == 4096
+        assert "reconciliation" in p
         _, body = _get(server, "metrics")
         assert "t_routes_gauge 1.5" in body.decode()
         # fleet routes carry the collector's fused self-scrape

@@ -483,6 +483,8 @@ class TestFleetMultiProc:
                 "FLAGS_perf_sentinels": "1",
                 "FLAGS_monitor_timeseries": "1",
                 "FLAGS_monitor_trace": "1",
+                "FLAGS_monitor_memory": "1",
+                "PT_MEM_CAPACITY_BYTES": str(1 << 30),
                 "STRAGGLER_RANK": str(self.STRAGGLER_RANK),
                 "NAN_RANK": str(self.NAN_RANK),
                 "NAN_STEP": "30",
@@ -562,11 +564,36 @@ class TestFleetMultiProc:
                 journal = json.load(f)
             assert journal.get("kind") == "trace_journal", jpath
             assert journal["traces"], "rank %d journal empty" % r
+            # ISSUE 12: the capture embeds every rank's memory
+            # breakdown, carrying that rank's OWN ledger bytes
+            mpath = os.path.join(d, "memory_rank%d.json" % r)
+            with open(mpath) as f:
+                memory = json.load(f)
+            assert memory.get("enabled") is True, mpath
+            assert memory["components"]["train"]["synthetic"][
+                "bytes"] == (64 + r) << 20, mpath
         with open(os.path.join(d, "manifest.json")) as f:
             manifest = json.load(f)
         assert manifest["detail"]["ranks"] == [self.NAN_RANK]
         # the straggler episode rode into the manifest
         assert str(self.STRAGGLER_RANK) in manifest["stragglers"]
+
+    def test_per_rank_memory_columns_in_fleet_table(self, fleet_run):
+        """ISSUE-12 satellite: /debugz/fleet/ranks (and so
+        tools/fleet_top.py's MEM/HEADROOM columns) carries per-rank
+        memory — each rank's headroom reflects its OWN synthetic
+        ledger (64+rank MiB) + noted transient peak (8 MiB) against
+        PT_MEM_CAPACITY_BYTES (1 GiB)."""
+        _, outs = fleet_run
+        out0 = outs[0][2]
+        rows = json.loads(re.search(r"MEM_COLUMNS (.*)", out0).group(1))
+        assert sorted(r["rank"] for r in rows) == list(
+            range(self.WORLD))
+        for row in rows:
+            r = row["rank"]
+            assert isinstance(row["mem_live_bytes"], (int, float)), row
+            want = (1 << 30) - ((64 + r) << 20) - (8 << 20)
+            assert row["mem_headroom_bytes"] == want, row
 
     def test_capture_dirs_are_unique(self, fleet_run):
         dump_dir, _ = fleet_run

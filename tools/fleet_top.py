@@ -3,7 +3,8 @@
 Scrapes every rank's metrics endpoint (monitor/fleet.py
 FleetCollector, run in-process here — no server-side collector needed)
 and renders the per-rank table: step, step time, tokens/s, MFU, HBM
-peak, comm share, heartbeat age, health verdict, straggler flag.
+peak, live memory + headroom (the /debugz/memory plane, round 14),
+comm share, heartbeat age, health verdict, straggler flag.
 
 Endpoints come from one of:
   --endpoints URL[,URL...]   explicit list (rank = position, or R=URL)
@@ -73,6 +74,8 @@ COLS = (
     ("TOK/S", 9, lambda r: _fmt(r.get("tokens_per_s"), "%.0f")),
     ("MFU", 6, lambda r: _fmt(r.get("mfu"), "%.3f")),
     ("HBM_PEAK", 9, lambda r: _fmt_bytes(r.get("hbm_peak_bytes"))),
+    ("MEM", 9, lambda r: _fmt_bytes(r.get("mem_live_bytes"))),
+    ("HEADROOM", 9, lambda r: _fmt_bytes(r.get("mem_headroom_bytes"))),
     ("COMM%", 6, lambda r: _fmt(
         r.get("comm_share") * 100 if isinstance(
             r.get("comm_share"), (int, float)) else None, "%.1f")),

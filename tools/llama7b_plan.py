@@ -178,6 +178,8 @@ def _allreduce_feeds_dynamic_slice(text):
 
 
 def _mem_row(compiled):
+    from paddle_tpu.monitor import memory as ptmem
+
     ma = compiled.memory_analysis()
     row = {
         "argument_bytes_per_device": int(ma.argument_size_in_bytes),
@@ -185,18 +187,18 @@ def _mem_row(compiled):
         "temp_bytes_per_device": int(ma.temp_size_in_bytes),
         "alias_bytes_per_device": int(ma.alias_size_in_bytes),
     }
-    peak = getattr(ma, "peak_memory_in_bytes", None)
-    if peak is None:
-        # jaxlib builds without the buffer-assignment peak stat: bound
-        # it by args + temps + outputs net of donation aliasing. This
-        # OVERestimates (liveness overlap is ignored), so hbm_fit stays
-        # conservative; flagged so readers don't mistake it for the
-        # scheduler's real high-water mark.
-        peak = (row["argument_bytes_per_device"]
-                + row["temp_bytes_per_device"]
-                + row["output_bytes_per_device"]
-                - row["alias_bytes_per_device"])
+    # ONE peak number repo-wide (monitor/memory.py compiled_peak, the
+    # same donation-aware executable_analysis the ledger/headroom math
+    # and graph_report() cost rows consume): the real buffer-assignment
+    # peak when jaxlib reports one, else args + temps + outputs net of
+    # donation aliasing — an over-estimate (liveness overlap ignored),
+    # flagged so hbm_fit readers don't mistake it for the scheduler's
+    # real high-water mark.
+    peak, is_estimate = ptmem.compiled_peak(compiled)
+    if is_estimate:
         row["peak_is_upper_bound_estimate"] = True
+    if peak is None:    # memory_analysis succeeded above, so this is
+        peak = 0        # unreachable in practice — but never KeyError
     row["peak_bytes_per_device"] = int(peak)
     return row
 

@@ -69,6 +69,16 @@ run bench 1500 env $(wd bench) python bench.py
 run perf_report 900 python tools/perf_report.py --steps 10 --json \
     --out tools/perf_report.json --baseline BENCH_LAST_GOOD.json
 
+# 1c. memory-plane snapshot (ISSUE 12): the per-component ledger +
+#     allocator reconciliation + static-vs-transient headroom of the
+#     SAME bench-family step under FLAGS_monitor_memory, committed as
+#     tools/mem_snapshot.json. Runs inside the same window as the
+#     train rows above so the headroom numbers date against a live
+#     bench baseline; a failed child re-emits the previous artifact
+#     marked stale (bench.py discipline) and the row goes red (rc=3).
+run mem 600 env $(wd mem) python tools/mem_snapshot.py --steps 5 \
+    --out tools/mem_snapshot.json
+
 # 2. north-star model rows (resnet both layouts, ernie fused, widedeep,
 #    llama1b MFU row)
 run model_resnet 1200 python tools/model_benchmark.py resnet50
