@@ -124,6 +124,21 @@ _DEFAULTS = {
     # paths — no threads, no native calls, no registry series, no jax
     # import (test-pinned, the PR-2/5/6 discipline).
     "FLAGS_monitor_memory": False,
+    # continuous profiling plane (monitor/profile.py): an always-on
+    # stdlib host sampling profiler (sys._current_frames() at
+    # PT_PROFILE_HZ, folded stacks with component attribution, served
+    # at /debugz/profile[-/folded]), anomaly-triggered one-shot device
+    # capture windows (jax.profiler start/stop_trace around the next N
+    # hot steps, armed by throughput-cliff/mem_leak sentinels, watchdog
+    # stalls and fleet stragglers; cooldown + PT_PROFILE_MAX_CAPTURES,
+    # defer-not-drop), and measured dispatch/blocked/gap step timers
+    # (profile_*_seconds{job}) that make the analytic
+    # perf_phase_seconds split falsifiable. Off = engines latch
+    # step_hook()=None at construction and the hot paths pay one
+    # attribute load + branch: no daemon threads, no native calls, no
+    # profile_* series, both routes report disabled (test-pinned, the
+    # PR-2/5/6 discipline).
+    "FLAGS_monitor_profile": False,
     # radix prefix cache over the serving engine's paged KV pool
     # (serving/prefix_cache.py): requests sharing a prompt prefix
     # (system prompts, few-shot headers) map their block-table head to

@@ -62,7 +62,22 @@ Three pillars (one registry, one postmortem path, one timeline):
    growth. Served at /debugz/memory; per-rank memory columns in the
    fleet table and tools/fleet_top.py.
 
-8. **Progress watchdog** (monitor/watchdog.py): heartbeat registry fed
+8. **Continuous profiling plane** (monitor/profile.py,
+   ``FLAGS_monitor_profile``): an always-on stdlib host sampling
+   profiler (``sys._current_frames()`` at ``PT_PROFILE_HZ``, folded
+   stacks with scheduler/store-io/device-wait/tokenize component
+   attribution, /debugz/profile + /debugz/profile/folded), one-shot
+   anomaly-triggered device capture windows (``capture_window`` /
+   ``arm_capture`` around the next N hot steps, through the
+   paddle_tpu/profiler Xprof session guard; armed by throughput-cliff
+   and mem_leak sentinels, watchdog stalls, fleet stragglers —
+   cooldown + cap, defer-not-drop), and measured phase timers
+   (``profile_dispatch_seconds`` / ``profile_host_blocked_seconds`` /
+   ``profile_host_gap_seconds``) that make PR-5's analytic phase split
+   falsifiable via tools/perf_report.py. Division of labor: **profile
+   = where the time measurably went**.
+
+9. **Progress watchdog** (monitor/watchdog.py): heartbeat registry fed
    by the compiled train step, the serving engine loop, and store
    collectives; a daemon thread (``start_watchdog()`` / ``PT_WATCHDOG``)
    turns a stalled heartbeat into a cross-rank diagnostic bundle
@@ -113,6 +128,7 @@ from . import fleet  # noqa: F401
 from . import flight_recorder  # noqa: F401
 from . import memory  # noqa: F401
 from . import perf  # noqa: F401
+from . import profile  # noqa: F401
 from . import timeseries  # noqa: F401
 from . import trace  # noqa: F401
 from . import trace_merge  # noqa: F401
@@ -128,6 +144,6 @@ __all__ = [
     "Heartbeat", "heartbeat", "start_watchdog", "stop_watchdog",
     "is_watchdog_running", "build_bundle", "diagnose_bundles",
     "register_stall_action", "unregister_stall_action",
-    "fleet", "flight_recorder", "memory", "perf", "timeseries",
-    "trace", "trace_merge", "watchdog",
+    "fleet", "flight_recorder", "memory", "perf", "profile",
+    "timeseries", "trace", "trace_merge", "watchdog",
 ]

@@ -28,6 +28,12 @@ server stack: ``KVHTTPServer`` gained a ``get_routes`` hook, and
                         allocator reconciliation, headroom, recent
                         admission/preempt decisions, OOM postmortems
                         (monitor/memory.py payload)
+    GET /debugz/profile continuous-profiling summary: sampler stats,
+                        component attribution, top-K folded stacks,
+                        measured dispatch/blocked/gap per job, capture
+                        windows (monitor/profile.py payload)
+    GET /debugz/profile/folded  collapsed-stack text of the host
+                        sampling profiler (flamegraph.pl input)
     GET /debugz/fleet   fleet summary: collector state, straggler
                         verdict, fused cross-rank aggregates
                         (monitor/fleet.py payload)
@@ -58,6 +64,7 @@ import time
 from . import fleet as _fleet
 from . import memory as _memory
 from . import perf as _perf
+from . import profile as _profile
 from . import timeseries as _timeseries
 from . import trace as _trace
 from . import watchdog as _watchdog
@@ -117,6 +124,8 @@ class MetricsServer:
         # "journal" can never be misread as a trace id
         routes["debugz/trace/journal"] = self._trace_journal
         routes["debugz/memory"] = self._memory
+        routes["debugz/profile"] = self._profile
+        routes["debugz/profile/folded"] = self._profile_folded
         routes["debugz/resilience"] = self._resilience
         routes["debugz/fleet"] = self._fleet
         routes["debugz/fleet/ranks"] = self._fleet_ranks
@@ -170,6 +179,16 @@ class MetricsServer:
         body = json.dumps(_watchdog.json_safe(_memory.memory_payload()),
                           default=str).encode()
         return 200, "application/json", body
+
+    def _profile(self):
+        body = json.dumps(
+            _watchdog.json_safe(_profile.profile_payload()),
+            default=str).encode()
+        return 200, "application/json", body
+
+    def _profile_folded(self):
+        return (200, "text/plain; charset=utf-8",
+                _profile.folded_route_text().encode())
 
     def _fleet(self):
         body = json.dumps(_watchdog.json_safe(_fleet.fleet_payload()),

@@ -44,8 +44,9 @@ need:
    fires (its ``perf_anomalies_total`` advances / healthz turns
    degraded) or a straggler is flagged, the collector pulls
    watchdog-style bundles (``/debugz/bundle``), span-journal tails
-   (``/debugz/trace/journal``) and the memory breakdown
-   (``/debugz/memory``) from ALL ranks into one
+   (``/debugz/trace/journal``), the memory breakdown
+   (``/debugz/memory``) and the profiling summary incl. folded host
+   stacks (``/debugz/profile``) from ALL ranks into one
    ``fleet_capture_<ts>/`` directory (manifest + per-rank artifacts)
    — a loss spike on rank 3 automatically yields fleet-wide evidence.
    ``tools/trace_merge.py --capture`` renders the merged chrome trace
@@ -499,10 +500,22 @@ class FleetCollector:
                 memory = mem
         except (OSError, ValueError, http.client.HTTPException):
             pass
+        # profiling plane (best-effort, same contract): sampler summary
+        # + measured dispatch/blocked/gap per job — feeds the HOSTBLK%
+        # column; absent or flags-off ranks just have an empty column
+        profile = None
+        try:
+            prof, _, _, _ = _http_json(url + "/debugz/profile",
+                                       self.http_timeout_s)
+            if isinstance(prof, dict):
+                profile = prof
+        except (OSError, ValueError, http.client.HTTPException):
+            pass
         return {"metrics": snap.get("metrics") or {},
                 "snapshot_time": snap.get("unix_time"),
                 "perf": perf, "healthz": healthz,
                 "flight_seq": flight_seq, "memory": memory,
+                "profile": profile,
                 "rtt_s": rtt, "clock_offset_s": offset,
                 "scraped_at": time.monotonic()}
 
@@ -607,6 +620,29 @@ class FleetCollector:
                  for j in (mem.get("jobs") or {}).values()
                  if isinstance(j.get("headroom_bytes"), (int, float))]
         st["mem_headroom_bytes"] = min(heads) if heads else None
+        # profiling column (monitor/profile.py): host-blocked share of
+        # the LAST measured step window — from the per-step gauges
+        # mirrored into the perf job rows, not the lifetime totals (a
+        # rank that blocked an hour ago but recovered must not wear a
+        # red HOSTBLK% forever). Worst job wins, the memory columns'
+        # convention.
+        shares = []
+        for j in jobs.values():
+            d = j.get("profile_dispatch_seconds")
+            b = j.get("profile_host_blocked_seconds")
+            g = j.get("profile_host_gap_seconds")
+            if all(isinstance(x, (int, float)) for x in (d, b, g)) \
+                    and (d + b + g) > 0:
+                shares.append(b / (d + b + g))
+        st["profile_host_blocked_share"] = max(shares) if shares \
+            else None
+        # the /debugz/profile summary scrape: where the rank's host
+        # time goes by the sampler's attribution (dominant component)
+        prof = scraped.get("profile") or {}
+        comps = prof.get("components") or {}
+        st["profile_top_component"] = max(
+            comps, key=lambda c: comps[c].get("share", 0)) if comps \
+            else None
         # anomaly watermark: total sentinel firings this rank reports
         anomalies = (scraped["perf"] or {}).get("anomalies") or {}
         st["anomalies_total"] = sum(
@@ -702,6 +738,20 @@ class FleetCollector:
         if new_stragglers:
             self._maybe_capture(
                 "straggler", {"ranks": sorted(new_stragglers)})
+            # ptprof (monitor/profile.py): a fresh straggler also arms
+            # a local device-capture window — the per-rank folded
+            # stacks ride the fleet capture's /debugz/profile pulls,
+            # this adds the collector rank's own Xprof window. No-op
+            # while FLAGS_monitor_profile is off.
+            try:
+                from . import profile as _profile
+
+                _profile.on_straggler(sorted(new_stragglers))
+            except Exception as e:
+                _registry.warn_once(
+                    "fleet.profile_arm",
+                    "paddle_tpu.monitor.fleet: profile capture arming "
+                    "failed (straggler was still flagged): %r" % (e,))
         # flush triggers the cooldown deferred: their watermarks have
         # already advanced and will not re-fire on their own
         self._maybe_capture()
@@ -847,7 +897,8 @@ class FleetCollector:
             ok = True
             for route, stem in (("debugz/bundle", "bundle"),
                                 ("debugz/trace/journal", "journal"),
-                                ("debugz/memory", "memory")):
+                                ("debugz/memory", "memory"),
+                                ("debugz/profile", "profile")):
                 try:
                     payload, _, _, _ = _http_json(
                         "%s/%s" % (url, route), self.http_timeout_s)
@@ -916,7 +967,9 @@ class FleetCollector:
                 "steps_total", "steps_behind", "collective_seq",
                 "collective_seq_behind", "step_time_s",
                 "tokens_per_s", "mfu", "hbm_peak_bytes",
-                "mem_live_bytes", "mem_headroom_bytes", "comm_share",
+                "mem_live_bytes", "mem_headroom_bytes",
+                "profile_host_blocked_share", "profile_top_component",
+                "comm_share",
                 "serving_goodput_tokens_per_s", "heartbeat_age_s",
                 "healthz", "degraded", "anomalies_total",
                 "anomaly_kinds", "straggler", "slow_hits",

@@ -583,6 +583,19 @@ def _fire(sentinel, name, ts, value, detail):
             "perf.anomaly_flight_note",
             "paddle_tpu.monitor.perf: flight-recorder anomaly note "
             "failed: %r" % (e,))
+    # ptprof (monitor/profile.py): profile-shaped anomalies
+    # (throughput cliff, mem leak) arm a one-shot device-capture
+    # window around the next hot steps, so the Xprof artifact is of
+    # the ANOMALOUS steps. Lazy import, no-op while the plane is off.
+    try:
+        from . import profile as _profile
+
+        _profile.on_anomaly(kind)
+    except Exception as e:
+        _registry.warn_once(
+            "perf.profile_arm",
+            "paddle_tpu.monitor.perf: profile capture arming failed "
+            "(anomaly was still recorded above): %r" % (e,))
 
 
 def _dispatch(name, ts, value):

@@ -340,6 +340,17 @@ def build_bundle(reason="debugz", stalls=None):
         spans = _trace.active_spans()
     except Exception:
         spans = []
+    # ptprof time-weighted profile (monitor/profile.py, sampler on):
+    # WHERE the time went across the window leading into the stall —
+    # the de-dup against the point-in-time "stacks" section above, so
+    # a postmortem shows the time distribution, not just where threads
+    # sat at one instant. None while FLAGS_monitor_profile is off.
+    try:
+        from . import profile as _profile
+
+        prof = _profile.bundle_payload()
+    except Exception:
+        prof = None
     return {
         "kind": "watchdog_bundle",
         "version": 1,
@@ -363,6 +374,7 @@ def build_bundle(reason="debugz", stalls=None):
         "timeseries_tail": ts_tail,
         "perf_anomalies": anomalies,
         "active_spans": spans,
+        "profile_folded": prof,
     }
 
 
@@ -719,6 +731,19 @@ def _on_stall(stalls):
         except Exception as e:
             lines.append("  cross-rank gather failed: %r" % e)
     sys.stderr.write("\n".join(lines) + "\n")
+    # ptprof escalation (monitor/profile.py): a fresh stall arms a
+    # one-shot device-capture window, so the first steps after the
+    # wedge clears (or recovery restarts the loop) get an Xprof trace
+    # + folded host stacks. No-op while FLAGS_monitor_profile is off.
+    try:
+        from . import profile as _profile
+
+        _profile.on_stall(stalls)
+    except Exception as e:
+        _registry.warn_once(
+            "watchdog.profile_arm",
+            "paddle_tpu.monitor.watchdog: profile capture arming "
+            "failed (stall was still reported above): %r" % (e,))
     try:
         _STALLS_TOTAL.inc()
     except Exception as e:

@@ -258,6 +258,11 @@ class CompiledTrainStep:
         self._mem = _monitor.memory.tracker(
             "train", self._mem_components(),
             context_fn=lambda: {"step_count": self._step_count})
+        # ptprof step hook (monitor/profile.py, FLAGS_monitor_profile),
+        # LATCHED HERE like the memory tracker: measured dispatch/
+        # blocked/gap timers + device-capture-window lifecycle. None =
+        # flags-off; the hot paths only ever check the handle.
+        self._prof = _monitor.profile.step_hook("train")
 
     def _mem_components(self):
         """Ledger providers: every carried (donated) buffer class of
@@ -645,6 +650,7 @@ class CompiledTrainStep:
         # recovers from. One branch (and zero allocations) when disabled.
         if _fi.is_enabled():
             _fi.fire("train.run_steps", step0=self._step_count + 1)
+        prof = self._prof
         try:
             # OOM forensics site (monitor/memory.py): armed only while
             # the tracker is latched; the postmortem wrapper below
@@ -659,6 +665,8 @@ class CompiledTrainStep:
             state_vals = [tensors[n]._value for n in self._names]
             from ..framework import random as _random
 
+            if prof is not None:
+                prof.step_begin()
             t0 = time.perf_counter()
             with _HB_TRAIN.busy("train.run_steps", steps=k,
                                 step0=self._step_count + 1):
@@ -671,8 +679,18 @@ class CompiledTrainStep:
             if self._mem is not None \
                     and _monitor.memory.looks_like_oom(e):
                 self._mem.write_postmortem(e)
+            if prof is not None:
+                # a raising window must not leak the open capture
+                # window (or its live device trace)
+                prof.step_abort()
             raise
         t1 = time.perf_counter()
+        if prof is not None:
+            # measured split: dispatch (call issue -> handles back) vs
+            # host-blocked (explicit block on the window's loss) vs
+            # inter-window host gap — the measured side perf_report
+            # diffs against the analytic perf_phase_seconds
+            prof.step_end(t0, t1, block=loss)
         _record_step(vals, k, t1 - t0, stacked=True)
         self._note_perf(vals, k, t1 - t0, loss, t0, t1, stacked=True)
         # span journal (monitor/trace.py, FLAGS_monitor_trace): one
@@ -861,6 +879,7 @@ class CompiledTrainStep:
         """batch = (*inputs, labels) as Tensors or arrays; returns loss."""
         if _fi.is_enabled():
             _fi.fire("train.step", step=self._step_count + 1)
+        prof = self._prof
         try:
             # OOM forensics site (monitor/memory.py): armed only while
             # the tracker is latched
@@ -874,6 +893,8 @@ class CompiledTrainStep:
             from ..framework import random as _random
 
             self._step_count += 1
+            if prof is not None:
+                prof.step_begin()
             t0 = time.perf_counter()
             with _HB_TRAIN.busy("train.step", step=self._step_count):
                 loss, new_state, new_opt, new_ef = self._compiled(
@@ -885,8 +906,12 @@ class CompiledTrainStep:
             if self._mem is not None \
                     and _monitor.memory.looks_like_oom(e):
                 self._mem.write_postmortem(e)
+            if prof is not None:
+                prof.step_abort()
             raise
         t1 = time.perf_counter()
+        if prof is not None:
+            prof.step_end(t0, t1, block=loss)
         _record_step(vals, 1, t1 - t0)
         self._note_perf(vals, 1, t1 - t0, loss, t0, t1)
         if _monitor.trace.is_enabled():

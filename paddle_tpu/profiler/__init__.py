@@ -14,14 +14,91 @@ from __future__ import annotations
 
 import enum
 import os
+import threading
 import time
 
 from ..core import native
+from ..monitor.registry import warn_once as _warn_once
 
 __all__ = [
     "Profiler", "RecordEvent", "ProfilerState", "ProfilerTarget",
     "make_scheduler", "export_chrome_tracing", "load_profiler_result",
+    "xprof_session_begin", "xprof_session_end", "xprof_session_owner",
 ]
+
+# -- Xprof session guard -----------------------------------------------------
+# jax.profiler allows exactly ONE live trace per process; a second
+# start_trace raises and the first window's artifact is at the mercy of
+# whoever calls stop_trace first. Every device-trace user in this repo
+# (the manual Profiler below, ptprof's anomaly capture windows in
+# monitor/profile.py) goes through this guard so two owners can never
+# double-start or steal each other's stop.
+_xprof_lock = threading.Lock()
+_xprof_owner = None
+
+
+def xprof_session_owner():
+    """Name of the owner currently holding the live Xprof session, or
+    None."""
+    return _xprof_owner
+
+
+def xprof_session_begin(owner, trace_dir):
+    """Claim the process-wide Xprof session and start the device trace
+    into ``trace_dir``. Returns True when THIS call started the trace;
+    False when another owner already holds the session (the caller
+    degrades to host-only — never an exception on the busy path). A
+    ``start_trace`` failure releases the claim and re-raises so the
+    caller can report the real cause."""
+    global _xprof_owner
+    with _xprof_lock:
+        if _xprof_owner is not None:
+            return False
+        _xprof_owner = str(owner)
+    try:
+        import jax
+
+        jax.profiler.start_trace(trace_dir)
+    except BaseException:
+        with _xprof_lock:
+            _xprof_owner = None
+        raise
+    return True
+
+
+def xprof_session_end(owner):
+    """Stop the device trace IF ``owner`` holds the session (a no-op
+    returning False otherwise — an owner can never stop a window it
+    did not start). The historical broad silent-except here is narrowed
+    to the types jax.profiler.stop_trace actually raises (RuntimeError
+    "No profile started" when the backend already closed the window,
+    ValueError from a torn-down profiler state) and routed through
+    warn_once — the PR-10 discipline applied to the one module that
+    predates it."""
+    global _xprof_owner
+    with _xprof_lock:
+        if _xprof_owner != str(owner):
+            return False
+    # ownership is held UNTIL stop_trace returns: releasing first would
+    # let a concurrent begin claim the session and start_trace into the
+    # still-live old trace — the double-start this guard exists to stop
+    try:
+        import jax
+
+        jax.profiler.stop_trace()
+        ok = True
+    except (RuntimeError, ValueError) as e:
+        _warn_once(
+            "profiler.stop_trace",
+            "paddle_tpu.profiler: jax.profiler.stop_trace failed — the "
+            "backend already closed the window; whatever landed in the "
+            "trace dir is kept: %r" % (e,))
+        ok = False
+    finally:
+        with _xprof_lock:
+            if _xprof_owner == str(owner):
+                _xprof_owner = None
+    return ok
 
 
 class ProfilerState(enum.Enum):
@@ -188,25 +265,28 @@ class Profiler:
         if recording and not was:
             lib.pt_trace_enable(2)
             if self.with_xprof and not self._xprof_on:
+                # through the session guard: a ptprof capture window
+                # (monitor/profile.py) holding the session degrades
+                # this window to host-only instead of raising — and
+                # vice versa
                 try:
-                    import jax
-                    jax.profiler.start_trace(self.trace_dir)
-                    self._xprof_on = True
-                except Exception:
+                    self._xprof_on = xprof_session_begin(
+                        "profiler", self.trace_dir)
+                except Exception as e:
                     self._xprof_on = False
+                    _warn_once(
+                        "profiler.start_trace",
+                        "paddle_tpu.profiler: device trace unavailable "
+                        "(host trace still records): %r" % (e,))
         elif not recording and was:
             lib.pt_trace_disable()
         self._state = state
 
     def _finish_window(self):
         if self._xprof_on:
-            try:
-                import jax
-                jax.profiler.stop_trace()
-            # ptlint: silent-except-ok — stop_trace raises when the
-            # backend already closed the window; teardown best-effort
-            except Exception:
-                pass
+            # the guard narrows the except to stop_trace's real raise
+            # types and warns once instead of swallowing
+            xprof_session_end("profiler")
             self._xprof_on = False
         if self.on_trace_ready is not None:
             self.on_trace_ready(self)

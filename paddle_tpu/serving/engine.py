@@ -39,6 +39,7 @@ lifecycle (EOS, admission, preemption) needs to see anyway.
 """
 from __future__ import annotations
 
+import time
 import weakref
 
 import jax
@@ -206,6 +207,12 @@ class Engine:
         self._mem = _monitor.memory.tracker(
             "serving", self._mem_components(),
             context_fn=self._mem_context)
+        # ptprof step hook (monitor/profile.py, FLAGS_monitor_profile),
+        # LATCHED HERE like the tier-2 flags and the memory tracker:
+        # per-iteration dispatch/gap timers, prefill/decode phase
+        # timers, and the device-capture-window lifecycle. None =
+        # flags-off; the step hot path only ever checks the handle.
+        self._prof = _monitor.profile.step_hook("serving")
 
     def _mem_components(self):
         """Ledger providers (monitor/memory.py): the paged KV pools
@@ -333,6 +340,12 @@ class Engine:
                     _fi.fire("serving.step")
             except _fi.InjectedFault:
                 return self.has_work()
+            prof = self._prof
+            if prof is not None:
+                # ptprof: open any queued capture window BEFORE the
+                # iteration dispatches, so the Xprof trace covers it
+                prof.step_begin()
+                _pt0 = time.perf_counter()
             try:
                 # OOM forensics (monitor/memory.py, latched at
                 # construction): mem.oom is the deterministic
@@ -343,7 +356,8 @@ class Engine:
                 if self._mem is not None and _fi.is_enabled():
                     _fi.fire("mem.oom")
                 self._expire_waiting()
-                self._admit_and_prefill()
+                self._timed_phase(prof, "prefill",
+                                  self._admit_and_prefill)
                 self._grow_or_preempt()
                 # perf attribution (FLAGS_perf_attribution): KV-page
                 # occupancy + goodput per engine iteration, sampled at
@@ -360,11 +374,13 @@ class Engine:
                 if self.chunked_prefill:
                     rows = self.scheduler.occupied()
                     if rows:
-                        self._mixed_once(rows)
+                        self._timed_phase(prof, "decode",
+                                          self._mixed_once, rows)
                 else:
                     active = self.scheduler.active()
                     if active:
-                        self._decode_once(active)
+                        self._timed_phase(prof, "decode",
+                                          self._decode_once, active)
                 if self.prefix_cache is not None:
                     self.metrics.on_prefix_stats(
                         self.prefix_cache.stats(),
@@ -373,8 +389,30 @@ class Engine:
                 if self._mem is not None \
                         and _monitor.memory.looks_like_oom(e):
                     self._mem.write_postmortem(e)
+                if prof is not None:
+                    # a raising step must not leak the open capture
+                    # window (or its live device trace); the partial
+                    # artifact lands marked aborted
+                    prof.step_abort()
                 raise
+            if prof is not None:
+                # no block arg: the decode path already synced the
+                # step's outputs to host numpy — the iteration wall IS
+                # the host-exposed time; gap covers the scheduler idle
+                # between iterations
+                prof.step_end(_pt0, time.perf_counter())
         return self.has_work()
+
+    def _timed_phase(self, prof, phase, fn, *args):
+        """Run one step phase, feeding its host wall into the ptprof
+        per-phase timers when the handle is latched (one call site per
+        phase instead of three copies of the stamp dance)."""
+        if prof is None:
+            fn(*args)
+            return
+        t = time.perf_counter()
+        fn(*args)
+        prof.note_phase(phase, time.perf_counter() - t)
 
     def run(self):
         """Drain all queued work; returns {request_id: generated tokens}."""

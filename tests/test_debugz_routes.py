@@ -22,6 +22,7 @@ from paddle_tpu import monitor
 from paddle_tpu.monitor import fleet
 from paddle_tpu.monitor import memory as ptmem
 from paddle_tpu.monitor import perf
+from paddle_tpu.monitor import profile as pprof
 from paddle_tpu.monitor import registry as mreg
 from paddle_tpu.monitor import timeseries as ts
 from paddle_tpu.monitor import trace
@@ -42,6 +43,8 @@ ROUTES = {
     "debugz/trace": (200, "json"),
     "debugz/trace/journal": (200, "json"),
     "debugz/memory": (200, "json"),
+    "debugz/profile": (200, "json"),
+    "debugz/profile/folded": (200, "text"),
     "debugz/resilience": (200, "json"),
     "debugz/fleet": (200, "json"),
     "debugz/fleet/ranks": (200, "json"),
@@ -50,7 +53,8 @@ ROUTES = {
 
 ALL_FLAGS = ("FLAGS_monitor_timeseries", "FLAGS_perf_attribution",
              "FLAGS_perf_sentinels", "FLAGS_monitor_trace",
-             "FLAGS_monitor_fleet", "FLAGS_monitor_memory")
+             "FLAGS_monitor_fleet", "FLAGS_monitor_memory",
+             "FLAGS_monitor_profile")
 
 
 @pytest.fixture()
@@ -67,6 +71,7 @@ def _reset_monitor_state():
     _fi._state.rules = []
     paddle.set_flags({f: False for f in ALL_FLAGS})
     ptmem.reset()
+    pprof.reset()
     perf.disable_sentinels()
     perf.reset()
     ts.disable()
@@ -140,6 +145,17 @@ class TestRouteMatrixAllOff:
         assert p["enabled"] is False
         assert p["components"] == {} and p["jobs"] == {}
         assert p["decisions"] == [] and p["postmortems"] == []
+        _, body = _get(server, "debugz/profile")
+        p = json.loads(body.decode())
+        assert p["enabled"] is False
+        assert p["sampler"] is None and p["jobs"] == {}
+        assert p["captures"] == [] and p["top"] == []
+        _, body = _get(server, "debugz/profile/folded")
+        assert "ptprof disabled" in body.decode()
+        # ...no sampler daemon thread exists with the flag off...
+        import threading as _threading
+        assert not [t for t in _threading.enumerate()
+                    if t.name == pprof._THREAD_NAME]
         _, body = _get(server, "debugz/resilience")
         p = json.loads(body.decode())
         assert p["fault_injection"]["enabled"] is False
@@ -189,6 +205,11 @@ class TestRouteMatrixAllOn:
         trace.end_span(sid)
         perf.note_job("t_routes_job", tokens_per_s=10.0)
         ptmem.tracker("t_routes_job", {"c": lambda: [("x", 4096)]})
+        sp = pprof.step_hook("t_routes_job")
+        assert sp is not None
+        t0 = time.monotonic()
+        sp.step_begin()
+        sp.step_end(t0, t0 + 0.01)
 
         _check_matrix(server)
         _, body = _get(server, "debugz/trace")
@@ -216,6 +237,13 @@ class TestRouteMatrixAllOn:
         assert p["enabled"] is True
         assert p["components"]["t_routes_job"]["c"]["bytes"] == 4096
         assert "reconciliation" in p
+        _, body = _get(server, "debugz/profile")
+        p = json.loads(body.decode())
+        assert p["enabled"] is True
+        assert p["sampler"]["running"] is True
+        assert p["jobs"]["t_routes_job"]["steps"] == 1
+        _, body = _get(server, "debugz/profile/folded")
+        assert "ptprof disabled" not in body.decode()
         _, body = _get(server, "metrics")
         assert "t_routes_gauge 1.5" in body.decode()
         # fleet routes carry the collector's fused self-scrape

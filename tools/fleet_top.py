@@ -4,6 +4,7 @@ Scrapes every rank's metrics endpoint (monitor/fleet.py
 FleetCollector, run in-process here — no server-side collector needed)
 and renders the per-rank table: step, step time, tokens/s, MFU, HBM
 peak, live memory + headroom (the /debugz/memory plane, round 14),
+measured host-blocked share (the /debugz/profile plane, round 15),
 comm share, heartbeat age, health verdict, straggler flag.
 
 Endpoints come from one of:
@@ -76,6 +77,10 @@ COLS = (
     ("HBM_PEAK", 9, lambda r: _fmt_bytes(r.get("hbm_peak_bytes"))),
     ("MEM", 9, lambda r: _fmt_bytes(r.get("mem_live_bytes"))),
     ("HEADROOM", 9, lambda r: _fmt_bytes(r.get("mem_headroom_bytes"))),
+    ("HOSTBLK%", 8, lambda r: _fmt(
+        r.get("profile_host_blocked_share") * 100 if isinstance(
+            r.get("profile_host_blocked_share"), (int, float))
+        else None, "%.1f")),
     ("COMM%", 6, lambda r: _fmt(
         r.get("comm_share") * 100 if isinstance(
             r.get("comm_share"), (int, float)) else None, "%.1f")),

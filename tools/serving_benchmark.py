@@ -120,6 +120,12 @@ def main():
     ap.add_argument("--prefix-groups", type=int, default=4,
                     help="number of distinct shared prefixes for "
                          "--shared-prefix-tokens")
+    ap.add_argument("--profile", action="store_true",
+                    help="FLAGS_monitor_profile: host sampling profiler "
+                         "+ per-iteration dispatch/gap + prefill/decode "
+                         "phase timers; arms a one-shot device-capture "
+                         "window mid-run and reports host_blocked_s per "
+                         "phase in the JSON")
     ap.add_argument("--no-trace", action="store_true",
                     help="skip the span journal (requests_detail rows "
                          "then carry no trace_id/phases_s breakdown)")
@@ -181,7 +187,10 @@ def main():
 
     ptflags.set_flags({
         "FLAGS_serving_prefix_cache": bool(args.prefix_cache),
-        "FLAGS_serving_chunked_prefill": bool(args.chunked_prefill)})
+        "FLAGS_serving_chunked_prefill": bool(args.chunked_prefill),
+        # ptprof latches at Engine construction like the tier-2 flags
+        # — set BEFORE the engine is built
+        "FLAGS_monitor_profile": bool(args.profile)})
 
     # resilience knobs are applied AFTER warmup (below): the compile
     # warmup enqueues one request per prefill bucket, and a deadline or
@@ -233,6 +242,18 @@ def main():
                                     eng.cache.cow_clones)
     warmup_s = time.perf_counter() - t0
     base = eng.stats()     # counters up to here are warmup, not workload
+    prof_base = None
+    if args.profile:
+        # ptprof totals snapshot: the measured window's per-phase host
+        # seconds must exclude the compile warmup above
+        from paddle_tpu.monitor import profile as pprof
+
+        _pt = pprof.job_totals().get("serving") or {}
+        prof_base = {"steps": _pt.get("steps", 0),
+                     "dispatch_s": _pt.get("dispatch_s", 0.0),
+                     "blocked_s": _pt.get("blocked_s", 0.0),
+                     "gap_s": _pt.get("gap_s", 0.0),
+                     "phases": dict(_pt.get("phases", {}))}
     eng.max_queue = args.max_queue
     eng.default_deadline_s = args.deadline_s
 
@@ -252,8 +273,17 @@ def main():
     rejected = {}          # admission-shed reason -> count (no id)
     start = time.perf_counter()
     nxt = 0
+    profile_armed = False
     while nxt < args.requests or eng.has_work():
         now = time.perf_counter() - start
+        if args.profile and not profile_armed \
+                and nxt >= args.requests // 2:
+            # mid-run capture window: the Xprof artifact covers
+            # steady-state steps, not the warmup or the tail drain
+            from paddle_tpu.monitor import profile as pprof
+
+            pprof.arm_capture(steps=8, reason="serving_benchmark")
+            profile_armed = True
         while nxt < args.requests and arrivals[nxt] <= now:
             try:
                 ids.append(eng.add_request(
@@ -376,6 +406,30 @@ def main():
         # distribution questions don't need a re-run
         "requests_detail": per_req,
     }
+    if args.profile:
+        # measured host attribution (monitor/profile.py): per-phase
+        # host seconds over the measured window (warmup subtracted),
+        # the sampler's component shares, and any capture artifacts
+        from paddle_tpu.monitor import profile as pprof
+
+        ppay = pprof.profile_payload()
+        tot = (ppay.get("jobs") or {}).get("serving") or {}
+        pb = prof_base or {}
+        report["profile"] = {
+            "host_blocked_s": {
+                k: round(v - pb.get("phases", {}).get(k, 0.0), 6)
+                for k, v in sorted((tot.get("phases") or {}).items())},
+            "dispatch_s_total": round(
+                tot.get("dispatch_s", 0.0)
+                - pb.get("dispatch_s", 0.0), 6),
+            "gap_s_total": round(
+                tot.get("gap_s", 0.0) - pb.get("gap_s", 0.0), 6),
+            "steps": tot.get("steps", 0) - pb.get("steps", 0),
+            "sampler": ppay.get("sampler"),
+            "components": ppay.get("components"),
+            "captures": [c["dir"] for c in ppay.get("captures") or ()],
+            "pending_captures": ppay.get("pending_captures"),
+        }
     print(json.dumps({k: v for k, v in report.items()
                       if k != "requests_detail"}), flush=True)
     with open(args.out, "w") as f:

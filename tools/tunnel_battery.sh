@@ -79,6 +79,18 @@ run perf_report 900 python tools/perf_report.py --steps 10 --json \
 run mem 600 env $(wd mem) python tools/mem_snapshot.py --steps 5 \
     --out tools/mem_snapshot.json
 
+# 1d. continuous-profiling snapshot (ISSUE 13): host sampler component
+#     attribution + MEASURED dispatch/blocked/gap step timers of the
+#     SAME bench-family step under FLAGS_monitor_profile (+
+#     FLAGS_perf_attribution for the analytic side), committed as
+#     tools/profile_snapshot.json in the SAME window as the train rows
+#     — the first live tunnel window gets measured host-blocked time
+#     next to the re-baselined MFU (the round-13 re-baseline note).
+#     tools/perf_report.py renders the measured-vs-analytic diff from
+#     its own row above. Stale re-emit discipline on failure (rc=3).
+run profile 600 env $(wd profile) python tools/profile_snapshot.py \
+    --steps 5 --out tools/profile_snapshot.json
+
 # 2. north-star model rows (resnet both layouts, ernie fused, widedeep,
 #    llama1b MFU row)
 run model_resnet 1200 python tools/model_benchmark.py resnet50
@@ -146,9 +158,12 @@ run model_int8 1200 python tools/model_benchmark.py llama_int8
 #     a snapshot artifact dated by the run itself makes that detectable)
 #     Runs under the watchdog: a serving-loop hang archives a bundle +
 #     /healthz in $LOG instead of burning the window silently.
+#     --profile (ISSUE 13): the row also carries measured per-phase
+#     host seconds + an anomaly-style mid-run Xprof capture window.
 run serving 1200 env $(wd serving) \
     python tools/serving_benchmark.py --preset llama1b \
     --requests 64 --rate 8 --max-slots 8 --num-blocks 512 \
+    --profile \
     --out tools/serving_bench.json \
     --monitor-out tools/monitor_snapshot.json
 
