@@ -6,12 +6,22 @@
 // launch/elastic subsystems.
 //
 // Protocol (length-prefixed binary over TCP):
-//   u8 op ('S' set, 'G' get-blocking, 'A' add, 'R' counter-read,
-//          'D' delete, 'L' list-count)
+//   u8 op ('S' set, 'G' get-blocking, 'A' add, 'N' add-nonced,
+//          'R' counter-read, 'D' delete, 'L' list-count)
 //   u32 key_len, key bytes
 //   SET: u32 val_len, val bytes            -> reply u8 0
 //   GET: u64 timeout_ms                    -> reply u8 ok, u32 len, bytes
 //   ADD: i64 delta                         -> reply u8 0, i64 new_value
+//   ADN: i64 delta, u64 cid, u64 seq       -> reply u8 0, i64 new_value
+//        idempotent form: the server remembers a bounded ring of each
+//        client's recently applied (seq -> value); a duplicate
+//        (cid, seq) — a client retry after a lost reply — returns the
+//        recorded value WITHOUT re-applying the delta. The python
+//        client guarantees a retried op resends its nonce BEFORE any
+//        other op from the same cid (the op lock spans the whole
+//        attempt loop), so correctness needs only the newest entry;
+//        kNonceRing=64 is defensive margin (16 bytes x 64 per client)
+//        for clients that interleave differently.
 //   DEL:                                   -> reply u8 0
 //
 // C ABI:
@@ -35,6 +45,7 @@
 #include <condition_variable>
 #include <cstdint>
 #include <cstring>
+#include <deque>
 #include <map>
 #include <mutex>
 #include <string>
@@ -52,6 +63,21 @@ struct StoreServer {
   std::condition_variable cv;
   std::map<std::string, std::string> kv;
   std::map<std::string, int64_t> counters;
+  // nonce ledger for idempotent adds: cid -> ring of recent
+  // (seq, result). A retried (cid, seq) after a lost reply must not
+  // double-apply — leader election treats counter values as atomic
+  // claims. The client serializes a retry against every other op on
+  // its connection (op lock spans the attempt loop), so the newest
+  // entry suffices; the ring depth is defensive margin. The ledger is
+  // bounded too: clients churn (elastic restarts mint a fresh cid per
+  // TCPStore instance, forever), so past kMaxNonceClients the
+  // oldest-registered cids are evicted FIFO — a long-lived master
+  // must not grow memory with every client generation. An evicted
+  // cid only matters if that client still has a lost-ack retry in
+  // flight, which needs thousands of NEW clients inside one
+  // retry-backoff window.
+  std::map<uint64_t, std::deque<std::pair<uint64_t, int64_t>>> add_nonces;
+  std::deque<uint64_t> nonce_cid_order;
   // live client fds (guarded by mu): server_stop shuts them down so
   // workers blocked in recv wake and join — shutdown must never
   // require client cooperation (a still-connected idle client used to
@@ -136,6 +162,45 @@ void ServeClient(StoreServer* s, int fd) {
       {
         std::lock_guard<std::mutex> lk(s->mu);
         nv = (s->counters[key] += delta);
+      }
+      s->cv.notify_all();
+      uint8_t ok = 0;
+      if (!WriteFull(fd, &ok, 1) || !WriteFull(fd, &nv, 8)) break;
+    } else if (op == 'N') {  // idempotent add (client retry nonce)
+      int64_t delta;
+      uint64_t cid, seq;
+      if (!ReadFull(fd, &delta, 8) || !ReadFull(fd, &cid, 8) ||
+          !ReadFull(fd, &seq, 8))
+        break;
+      constexpr size_t kNonceRing = 64;
+      constexpr size_t kMaxNonceClients = 4096;
+      int64_t nv = 0;
+      {
+        std::lock_guard<std::mutex> lk(s->mu);
+        bool fresh_cid = s->add_nonces.find(cid) == s->add_nonces.end();
+        auto& ring = s->add_nonces[cid];
+        if (fresh_cid) {
+          s->nonce_cid_order.push_back(cid);
+          while (s->add_nonces.size() > kMaxNonceClients &&
+                 !s->nonce_cid_order.empty()) {
+            uint64_t oldest = s->nonce_cid_order.front();
+            s->nonce_cid_order.pop_front();
+            if (oldest != cid) s->add_nonces.erase(oldest);
+          }
+        }
+        bool dup = false;
+        for (const auto& e : ring) {
+          if (e.first == seq) {
+            nv = e.second;  // duplicate: reply, don't re-apply
+            dup = true;
+            break;
+          }
+        }
+        if (!dup) {
+          nv = (s->counters[key] += delta);
+          ring.emplace_back(seq, nv);
+          if (ring.size() > kNonceRing) ring.pop_front();
+        }
       }
       s->cv.notify_all();
       uint8_t ok = 0;
@@ -335,6 +400,22 @@ int pt_store_add(int fd, const char* key, int64_t delta, int64_t* out_new) {
   uint32_t klen = static_cast<uint32_t>(strlen(key));
   if (!WriteFull(fd, &op, 1) || !WriteFull(fd, &klen, 4) ||
       !WriteFull(fd, key, klen) || !WriteFull(fd, &delta, 8))
+    return -1;
+  uint8_t ok;
+  if (!ReadFull(fd, &ok, 1)) return -1;
+  return ReadFull(fd, out_new, 8) ? 0 : -1;
+}
+
+// Idempotent add: same wire semantics as pt_store_add plus a client
+// nonce (cid, seq). Retrying the SAME nonce after a lost reply gets
+// the originally-applied value instead of a second application.
+int pt_store_add_nonced(int fd, const char* key, int64_t delta,
+                        uint64_t cid, uint64_t seq, int64_t* out_new) {
+  uint8_t op = 'N';
+  uint32_t klen = static_cast<uint32_t>(strlen(key));
+  if (!WriteFull(fd, &op, 1) || !WriteFull(fd, &klen, 4) ||
+      !WriteFull(fd, key, klen) || !WriteFull(fd, &delta, 8) ||
+      !WriteFull(fd, &cid, 8) || !WriteFull(fd, &seq, 8))
     return -1;
   uint8_t ok;
   if (!ReadFull(fd, &ok, 1)) return -1;

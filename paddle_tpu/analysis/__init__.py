@@ -5,7 +5,7 @@ Nine PRs of growth rest on hand-enforced invariants: default-off
 decode/train step, the monotonic-clock rule, lock-guarded daemon
 threads, and the single labeled metric registry. Reviewer memory does
 not scale to ROADMAP items 2-4 churning hundreds of files, so this
-package makes the invariants *mechanical*: ~7 AST passes over the
+package makes the invariants *mechanical*: 8 AST passes over the
 whole tree, each encoding one discipline the repo already documents
 (README "Static analysis" has the catalog):
 
@@ -20,6 +20,10 @@ whole tree, each encoding one discipline the repo already documents
                   (time.monotonic() does); wall clock is identity-only
     thread        spawned threads are daemon=True with a reachable stop
                   path; state they mutate is lock-guarded
+    store         protocol modules take the store as an injected
+                  parameter (no construction inside protocol
+                  functions) and never hold a lock across a blocking
+                  store op
     metric        registry metric names are literal, family-prefixed,
                   label-consistent, and documented
     silent-except broad ``except Exception: pass`` is forbidden —
@@ -30,7 +34,12 @@ grandfathering is explicit (the checked-in baseline file named by
 ``[tool.ptlint]`` in pyproject.toml). ``tools/ptlint.py`` is the CLI;
 tests/test_ptlint.py holds the tier-1 tree-is-clean gate. The sibling
 ``analysis/graph`` package (tools/pthlo.py) runs the COMPILED-graph
-twin of these source passes over AOT-lowered fixtures.
+twin of these source passes over AOT-lowered fixtures, and
+``analysis/proto`` (tools/ptcheck.py) is the PROTOCOL leg: a
+deterministic interleaving explorer driving the real store/election/
+barrier code over a SimStore. This package stays stdlib-only (bare
+workers lint without jax); proto imports the protocol modules and is
+therefore only pulled in by its own CLI/tests, never from here.
 
 The reference stack ships exactly this kind of correctness tooling
 (nan/inf checkers, FLAGS_call_stack_level enforcement in enforce.h);

@@ -1,0 +1,30 @@
+"""ptcheck — deterministic interleaving explorer + protocol checker.
+
+The repo's third analysis leg: ptlint proves **source** invariants,
+pthlo proves **compiled-graph** invariants, ptcheck proves **protocol**
+invariants — the store/election/barrier plane every multi-host feature
+(fleet serving, leader-elected weight hot-swap) is built on. Every
+protocol bug so far (the PR-1 client frame race, the pre-PR-7 count+go
+barrier name-reuse hang, the PR-7 server-stop deadlock) was found the
+expensive way: a flaky multi-process hang. ptcheck turns "hangs once
+per 50 CI runs" into a deterministic, seed-replayable red test.
+
+Model: each rank's protocol step runs as a cooperative task under a
+controlled scheduler (``sched.py``) over a ``SimStore``
+(``simstore.py``) that implements the TCPStore client API as
+in-process shared state — so the *real* protocol code (the round-based
+barrier, ``resilience/protocol.py``'s election + snapshot agreement,
+``ElasticManager``'s TTL membership, the watchdog bundle protocol)
+runs **unmodified**. The explorer (``explore.py``) walks the
+interleaving space: exhaustive bounded DFS with state-hash dedup plus
+a seeded random-walk mode; crash and lost-ack faults are transitions
+like any other. Checked properties live in ``fixtures.py``; findings
+replay from a printed schedule string (``tools/ptcheck.py --replay``).
+"""
+from .explore import (  # noqa: F401
+    ProtoFinding, RunResult, dfs_explore, random_walk, render_proto_json,
+    render_proto_text, replay_schedule, run_fixtures)
+from .fixtures import PROTO_FIXTURES  # noqa: F401
+from .sched import (  # noqa: F401
+    Scheduler, SimCrash, Task, VirtualClock)
+from .simstore import SimClient, SimStore  # noqa: F401

@@ -1,0 +1,410 @@
+"""Cooperative deterministic scheduler for protocol interleaving checks.
+
+Each rank's protocol step runs as a **task**: a real thread that is
+suspended at every store-op boundary by a semaphore handshake, so only
+ONE task ever runs at a time and the scheduler — not the OS — picks
+which rank advances next. Unmodified synchronous protocol code becomes
+schedulable without rewriting it as a state machine: a store op calls
+``op_boundary()`` (yield), the scheduler resumes exactly one task, the
+op applies atomically, and the task runs to its next boundary.
+
+Transitions (the DFS alphabet, stable replay tokens):
+
+    s:<task>   resume <task> through its pending op (or from start)
+    a:<task>   apply <task>'s pending ``add`` but LOSE THE ACK — the
+               client's retry protocol resends it (the idempotence
+               race window; budgeted via ``max_lost_acks``)
+    c:<task>   crash <task> at its current op boundary (the op never
+               applies; the rank goes silent; budgeted via
+               ``max_crashes``, only for tasks marked crashable)
+
+Blocking waits never spin: a task waiting on a key parks in
+``blocked`` state and is made runnable again when some task's op sets
+the key (the server's push-release, modeled) or when the scheduler
+fires its timeout. Time is **virtual**: blocking deadlines live on a
+``VirtualClock`` that only advances when the scheduler decides —
+so a "hangs for 50s once per 50 runs" schedule is a deterministic,
+replayable token string, and wall time never enters the state space.
+
+Hang rule (deterministic, not a choice point): when no task is
+runnable, the state is recorded as a hang event (the deadlock-freedom
+property's raw material) and the earliest pending timeout fires,
+advancing the clock — so a hung protocol unwinds into its contractual
+TimeoutErrors instead of wedging the checker. If every blocked wait is
+timeout-less, that is a hard deadlock: recorded, and the run is killed.
+"""
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import threading
+import time
+
+# captured before any virtual-clock patching: the scheduler's own
+# anti-wedge guard must measure REAL time even while protocol code
+# under test sees the virtual clock
+_REAL_MONOTONIC = time.monotonic
+
+# real seconds a resumed task may run between two boundaries before the
+# checker declares it non-cooperative (a protocol loop that never does
+# a store op cannot be scheduled)
+_COOP_GUARD_S = 30.0
+
+# virtual wall epoch: patched time.time() = epoch + clock.now, so
+# protocol code that stamps wall time sees plausible values
+_WALL_EPOCH = 1_700_000_000.0
+
+
+class SimCrash(BaseException):
+    """Injected rank death. BaseException on purpose: protocol code's
+    ``except Exception`` recovery paths must not be able to swallow a
+    simulated crash — a dead rank does not run its except block."""
+
+
+class ReplayDivergence(Exception):
+    """A replayed schedule token was not enabled at its position —
+    the schedule does not belong to this fixture/build."""
+
+
+class NonCooperativeTask(Exception):
+    """A task ran past the real-time guard without reaching a store-op
+    boundary: the code under test is not schedulable as written."""
+
+
+class VirtualClock:
+    """Deterministic monotonic time; advances only on scheduler
+    decisions (timeout fire, explicit tick, simulated sleep)."""
+
+    def __init__(self, start=0.0):
+        self.now = float(start)
+
+    def advance(self, dt):
+        self.now += max(0.0, float(dt))
+
+    def advance_to(self, t):
+        self.now = max(self.now, float(t))
+
+
+class Task:
+    """One schedulable protocol participant."""
+
+    __slots__ = ("name", "fn", "crashable", "status", "pending",
+                 "blocked_key", "deadline", "wake_reason", "result",
+                 "error", "op_count", "trace_hash", "killed",
+                 "_go", "_back", "_mode", "_thread")
+
+    def __init__(self, name, fn, crashable=False):
+        self.name = name
+        self.fn = fn
+        self.crashable = crashable
+        # ready -> (parked|blocked|wakeable)* -> done|crashed
+        self.status = "ready"
+        self.pending = None         # (op, key) while parked at a boundary
+        self.blocked_key = None     # key while blocked (None = sleeping)
+        self.deadline = None        # virtual deadline while blocked
+        self.wake_reason = None     # "key" | "timeout" after a wake
+        self.result = None
+        self.error = None           # exception the task fn raised
+        self.op_count = 0
+        self.trace_hash = ""        # rolling md5 of (op, key, result)
+        self.killed = False         # engine termination, not explored crash
+        self._go = threading.Semaphore(0)
+        self._back = threading.Semaphore(0)
+        self._mode = "proceed"
+        self._thread = None
+
+    @property
+    def live(self):
+        return self.status not in ("done", "crashed")
+
+    def note(self, op, key, result):
+        self.op_count += 1
+        h = hashlib.md5()
+        h.update(self.trace_hash.encode())
+        h.update(repr((op, key, result)).encode())
+        self.trace_hash = h.hexdigest()
+
+
+class Scheduler:
+    """Owns the tasks, the virtual clock, and the transition system."""
+
+    def __init__(self, clock=None, max_crashes=0, max_lost_acks=0,
+                 patch_time=False):
+        self.clock = clock or VirtualClock()
+        self.tasks = {}
+        self.store = None           # set by the Scenario (fingerprints)
+        self.crash_budget = int(max_crashes)
+        self.lostack_budget = int(max_lost_acks)
+        self.patch_time = bool(patch_time)
+        self.schedule = []          # applied tokens, the replay string
+        self.events = []            # [(kind, payload)] hang/deadlock/budget
+        self.log = []               # fixture-visible, appended in
+        #                             schedule order by task code
+        self.truncated = False
+        self._hangs_seen = set()
+        self._by_thread = {}
+
+    # -- task side (called from task threads) -----------------------------
+
+    def current_task(self):
+        return self._by_thread.get(threading.get_ident())
+
+    def spawn(self, name, fn, crashable=False):
+        if name in self.tasks:
+            raise ValueError("duplicate task %r" % name)
+        self.tasks[name] = Task(name, fn, crashable=crashable)
+        return self.tasks[name]
+
+    def op_boundary(self, op, key=None):
+        """Yield point at the START of a store op. Returns the mode the
+        scheduler chose: "proceed" (apply normally) or "lost_ack"
+        (apply, then lose the reply — add only). "crash" raises."""
+        task = self.current_task()
+        task.pending = (op, key)
+        task.status = "parked"
+        self._yield(task)
+        task.pending = None
+        mode = task._mode
+        task._mode = "proceed"
+        if mode in ("crash", "kill"):
+            task.killed = mode == "kill"
+            raise SimCrash()
+        return mode
+
+    def block_on_key(self, key, deadline):
+        """Park the current task until ``key`` is set (wake reason
+        "key") or its virtual ``deadline`` fires ("timeout")."""
+        task = self.current_task()
+        task.blocked_key = key
+        task.deadline = deadline
+        task.status = "blocked"
+        self._yield(task)
+        task.blocked_key = None
+        task.deadline = None
+        mode = task._mode
+        task._mode = "proceed"
+        if mode in ("crash", "kill"):
+            task.killed = mode == "kill"
+            raise SimCrash()
+        reason = task.wake_reason or "key"
+        task.wake_reason = None
+        return reason
+
+    def sim_sleep(self, seconds):
+        """Virtual sleep: blocked with a deadline and no key — wakes
+        only when the scheduler advances time past it."""
+        self.block_on_key(None, self.clock.now + max(0.0, seconds))
+
+    def tick(self, dt):
+        """Fixture helper: an op boundary that advances the virtual
+        clock when applied — lets the DFS interleave time passing with
+        protocol steps (TTL aging, lease windows)."""
+        self.op_boundary("tick", None)
+        self.clock.advance(dt)
+        self.current_task().note("tick", None, round(self.clock.now, 9))
+
+    def wake_key(self, key):
+        """A store op set ``key``: every task blocked on it becomes
+        runnable (it re-checks the store when next scheduled — the
+        wake models the server's push-release, the re-check models the
+        client seeing the reply)."""
+        for t in self.tasks.values():
+            if t.status == "blocked" and t.blocked_key == key:
+                t.wake_reason = "key"
+                t.status = "wakeable"
+
+    def _yield(self, task):
+        task._back.release()
+        task._go.acquire()
+
+    # -- scheduler side ---------------------------------------------------
+
+    def _task_main(self, task):
+        self._by_thread[threading.get_ident()] = task
+        try:
+            task._go.acquire()
+            if task._mode in ("crash", "kill"):
+                task.killed = task._mode == "kill"
+                raise SimCrash()
+            task.result = task.fn()
+            task.status = "done"
+        except SimCrash:
+            task.status = "crashed"
+        except BaseException as e:  # noqa: BLE001 — recorded, judged
+            task.error = e          # by the fixture verdict
+            task.status = "done"
+        finally:
+            task._back.release()
+
+    def _resume(self, task, mode):
+        task._mode = mode
+        if task._thread is None:
+            task._thread = threading.Thread(
+                target=self._task_main, args=(task,),
+                name="ptcheck-%s" % task.name, daemon=True)
+            task._thread.start()
+        if task.status in ("parked", "wakeable", "ready", "blocked"):
+            task.status = "running"
+        task._go.release()
+        if not task._back.acquire(timeout=_COOP_GUARD_S):
+            raise NonCooperativeTask(
+                "task %r ran %gs without reaching a store-op boundary"
+                % (task.name, _COOP_GUARD_S))
+
+    def enabled(self):
+        """Transition tokens, deterministically ordered (the DFS
+        explores enabled[0] first — plain progress before faults)."""
+        toks = []
+        for name in sorted(self.tasks):
+            if self.tasks[name].status in ("ready", "parked",
+                                           "wakeable"):
+                toks.append("s:" + name)
+        if self.lostack_budget > 0:
+            for name in sorted(self.tasks):
+                t = self.tasks[name]
+                if t.status == "parked" and t.pending \
+                        and t.pending[0] == "add":
+                    toks.append("a:" + name)
+        if self.crash_budget > 0:
+            for name in sorted(self.tasks):
+                t = self.tasks[name]
+                if t.crashable and t.live and t.status != "ready":
+                    toks.append("c:" + name)
+        return toks
+
+    def state_fingerprint(self):
+        """Sound dedup key for deterministic tasks: same store state +
+        same per-task op/result history (+ budgets + clock) ⇒ same
+        continuation. Tuples, not hashes — equality is exact."""
+        tasks = tuple(
+            (t.name, t.status, t.op_count, t.trace_hash,
+             t.blocked_key, t.pending,
+             None if t.deadline is None else round(t.deadline, 9))
+            for _, t in sorted(self.tasks.items()))
+        store_fp = self.store.fingerprint() if self.store is not None \
+            else None
+        return (round(self.clock.now, 9), self.crash_budget,
+                self.lostack_budget, store_fp, tasks)
+
+    def _apply(self, tok):
+        kind, _, name = tok.partition(":")
+        task = self.tasks[name]
+        if kind == "s":
+            self._resume(task, "proceed")
+        elif kind == "a":
+            self.lostack_budget -= 1
+            self._resume(task, "lost_ack")
+        elif kind == "c":
+            self.crash_budget -= 1
+            self._resume(task, "crash")
+        else:
+            raise ReplayDivergence("unknown token %r" % tok)
+
+    def _record_hang(self, blocked):
+        sig = tuple(sorted((t.name, t.blocked_key,
+                            t.pending[0] if t.pending else "wait")
+                           for t in blocked))
+        if sig in self._hangs_seen:
+            return
+        self._hangs_seen.add(sig)
+        self.events.append(("hang", {
+            "blocked": [
+                {"task": t.name, "key": t.blocked_key,
+                 "deadline": t.deadline, "op_count": t.op_count}
+                for t in sorted(blocked, key=lambda t: t.name)],
+            "at_step": len(self.schedule),
+            "clock": round(self.clock.now, 9),
+        }))
+
+    def kill_all(self):
+        for _, t in sorted(self.tasks.items()):
+            if t.live:
+                self._resume(t, "kill")
+
+    def join(self, timeout=2.0):
+        for t in self.tasks.values():
+            if t._thread is not None:
+                t._thread.join(timeout=timeout)
+
+    @contextlib.contextmanager
+    def patched_time(self):
+        """Optionally route ``time.monotonic/time/sleep`` to the
+        virtual clock — ONLY for sim task threads (resolved per call
+        by thread id); every other thread keeps real time. Lets
+        deadline-loop protocol code (watchdog gather) run unmodified
+        with a bounded, deterministic schedule length."""
+        if not self.patch_time:
+            yield
+            return
+        real_mono, real_time = time.monotonic, time.time
+        real_sleep = time.sleep
+
+        def mono():
+            return self.clock.now \
+                if threading.get_ident() in self._by_thread \
+                else real_mono()
+
+        def wall():
+            return _WALL_EPOCH + self.clock.now \
+                if threading.get_ident() in self._by_thread \
+                else real_time()
+
+        def sleep(seconds):
+            if threading.get_ident() in self._by_thread:
+                self.sim_sleep(seconds)
+            else:
+                real_sleep(seconds)
+
+        time.monotonic, time.time, time.sleep = mono, wall, sleep
+        try:
+            yield
+        finally:
+            time.monotonic, time.time = real_mono, real_time
+            time.sleep = real_sleep
+
+    def run(self, chooser, max_steps=400):
+        """Drive the system to completion. ``chooser(tokens, fp)``
+        picks one enabled token (DFS prefix-replay, random walk, or
+        default-first). Returns when every task is done/crashed, the
+        step budget trips, or a hard deadlock was recorded."""
+        steps = 0
+        with self.patched_time():
+            while True:
+                live = [t for _, t in sorted(self.tasks.items())
+                        if t.live]
+                if not live:
+                    break
+                toks = self.enabled()
+                if not any(t.startswith("s:") for t in toks):
+                    blocked = [t for t in live if t.status == "blocked"]
+                    if not blocked:
+                        break       # defensive: nothing live can move
+                    self._record_hang(blocked)
+                    timed = [t for t in blocked
+                             if t.deadline is not None]
+                    if not timed:
+                        self.events.append(("deadlock", {
+                            "blocked": sorted(t.name for t in blocked),
+                            "at_step": len(self.schedule)}))
+                        self.kill_all()
+                        break
+                    first = min(timed,
+                                key=lambda t: (t.deadline, t.name))
+                    self.clock.advance_to(first.deadline)
+                    first.wake_reason = "timeout"
+                    first.status = "wakeable"
+                    continue
+                if steps >= max_steps:
+                    self.events.append(("budget", {"steps": steps}))
+                    self.truncated = True
+                    self.kill_all()
+                    break
+                tok = chooser(list(toks), self.state_fingerprint())
+                if tok not in toks:
+                    raise ReplayDivergence(
+                        "token %r not enabled at step %d (enabled: %s)"
+                        % (tok, len(self.schedule), ",".join(toks)))
+                self.schedule.append(tok)
+                steps += 1
+                self._apply(tok)
+        self.join()

@@ -4,7 +4,7 @@ from __future__ import annotations
 from collections import Counter
 
 from . import clocks, compile_discipline, flags_pass, metrics_pass, \
-    silent_except, threads, trace_purity
+    silent_except, store_discipline, threads, trace_purity
 from .base import Baseline
 
 # rule id -> pass. Order is report order; ids are the pragma grammar
@@ -15,6 +15,7 @@ RULES = {
     compile_discipline.RULE: compile_discipline.run_pass,
     clocks.RULE: clocks.run_pass,
     threads.RULE: threads.run_pass,
+    store_discipline.RULE: store_discipline.run_pass,
     metrics_pass.RULE: metrics_pass.run_pass,
     silent_except.RULE: silent_except.run_pass,
 }
@@ -22,7 +23,8 @@ RULES = {
 # passes whose findings may be grandfathered in the baseline file;
 # clock, silent-except and metric violations must be FIXED (or
 # pragma'd with a reason) — the baseline refuses to carry them.
-BASELINE_ELIGIBLE = ("flag", "trace", "compile-discipline", "thread")
+BASELINE_ELIGIBLE = ("flag", "trace", "compile-discipline", "thread",
+                     "store")
 
 
 def run(project, rules=None, baseline=None):

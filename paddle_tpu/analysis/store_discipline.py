@@ -1,0 +1,163 @@
+"""store pass: protocol code takes the store injected, never holds a
+lock across a blocking store op.
+
+The protocol plane (barrier, election, elastic membership, watchdog
+bundles) is checkable — ptcheck drives the REAL code over a SimStore —
+precisely because every protocol function takes its store as a
+parameter. The two ways that property decays, mechanized:
+
+1. **injection** — a protocol module constructing its own store
+   (``TCPStore(...)`` or ``create_store_from_env()``) inside a
+   protocol function (or at module scope: a global store) hard-wires
+   the transport, making the code untestable under the deterministic
+   scheduler and un-reusable across store generations. Construction
+   belongs in launchers/factories; protocol code receives the object.
+2. **lock-across-blocking-op** — ``with <lock>: ... store.get(...)``
+   (or ``.barrier``/``.wait``) holds a lock across an op that can
+   block for a full timeout window: every peer thread sharing that
+   lock (elastic heartbeats, watchdog daemons) starves past its TTL —
+   the PR-1 frame-race fix's dual, on the caller side. The store's
+   own fd lock is exempt by design (its blocking get is a short-poll
+   loop, never one long server-side wait).
+
+Scope: the ``[tool.ptlint.store]`` ``paths`` list (protocol modules) —
+discipline rules with teeth need a crisp jurisdiction; launchers and
+tools construct stores legitimately. ``factories`` names functions
+allowed to construct. Baseline-eligible; ``# ptlint: store-ok``
+suppresses a deliberate site.
+"""
+from __future__ import annotations
+
+import ast
+import os
+
+from .astutil import FuncIndex, dotted, import_aliases, resolve_call
+from .base import Finding
+from .threads import _is_lockish
+
+RULE = "store"
+
+_DEFAULT_PATHS = (
+    "paddle_tpu/distributed/store.py",
+    "paddle_tpu/distributed/elastic.py",
+    "paddle_tpu/distributed/process_group.py",
+    "paddle_tpu/resilience",
+    "paddle_tpu/monitor/watchdog.py",
+    "paddle_tpu/analysis/proto",
+)
+_DEFAULT_FACTORIES = ("create_store_from_env",)
+
+# constructor heads a protocol module must not call (alias-resolved)
+_CONSTRUCTORS = ("TCPStore", "store.TCPStore",
+                 "create_store_from_env",
+                 "store.create_store_from_env")
+
+# store client ops that can block for a full timeout window
+_BLOCKING = ("get", "barrier", "wait")
+
+
+def _in_scope(relpath, paths):
+    rel = relpath.replace(os.sep, "/")
+    for p in paths:
+        p = p.rstrip("/")
+        if rel == p or rel.startswith(p + "/"):
+            return True
+    return False
+
+
+def _with_body(node):
+    """Nodes executed WHILE the with-block's lock is held: nested
+    function/lambda/class bodies are skipped — a store op inside a
+    deferred callback (`lambda: store.get(k)`) runs later, outside
+    the lock (the threads pass's own scope discipline)."""
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda, ast.ClassDef)):
+            continue
+        yield child
+        yield from _with_body(child)
+
+
+def _enclosing_def(index, lineno):
+    """Innermost FunctionDef containing ``lineno`` (None = module)."""
+    best = None
+    for defs in index.defs.values():
+        for d in defs:
+            if d.lineno <= lineno <= (d.end_lineno or d.lineno):
+                if best is None or d.lineno > best.lineno:
+                    best = d
+    return best
+
+
+def run_pass(project):
+    cfg = project.config.get("store", {})
+    paths = tuple(cfg.get("paths", _DEFAULT_PATHS))
+    factories = set(cfg.get("factories", _DEFAULT_FACTORIES))
+    findings = []
+    for sf in project.files:
+        if not _in_scope(sf.relpath, paths):
+            continue
+        tree = sf.tree
+        if tree is None:
+            continue
+        aliases = import_aliases(tree)
+        index = FuncIndex(tree)
+        n_construct = 0
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call) and
+                    resolve_call(node, aliases) in _CONSTRUCTORS):
+                continue
+            encl = _enclosing_def(index, node.lineno)
+            if encl is not None and encl.name in factories:
+                continue
+            n_construct += 1
+            if sf.suppressed(RULE, [node.lineno]):
+                continue
+            where = (index.qualname.get(id(encl), encl.name)
+                     if encl is not None else "<module>")
+            findings.append(Finding(
+                RULE, sf.relpath, node.lineno,
+                "construct:%s#%d" % (where, n_construct),
+                "protocol code constructs its own store in %s — take "
+                "the store as an injected parameter (construction "
+                "belongs in launchers/factories); hard-wired "
+                "transport cannot run under ptcheck's deterministic "
+                "scheduler" % where))
+        seen_ops = set()    # nested lockish withs report an op ONCE
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.With) and
+                    any(_is_lockish(item.context_expr)
+                        for item in node.items)):
+                continue
+            for sub in _with_body(node):
+                if not (isinstance(sub, ast.Call) and
+                        isinstance(sub.func, ast.Attribute) and
+                        sub.func.attr in _BLOCKING):
+                    continue
+                if id(sub) in seen_ops:
+                    continue
+                seen_ops.add(id(sub))
+                recv = dotted(sub.func.value) or ""
+                if "store" not in recv.lower():
+                    continue
+                if sf.suppressed(RULE, [sub.lineno, node.lineno]):
+                    continue
+                encl = _enclosing_def(index, sub.lineno)
+                where = (index.qualname.get(id(encl), encl.name)
+                         if encl is not None else "<module>")
+                findings.append(Finding(
+                    RULE, sf.relpath, sub.lineno,
+                    "lock:%s:%s.%s" % (where, recv, sub.func.attr),
+                    "%s holds a lock (with %s) across the blocking "
+                    "store op %s.%s — peers sharing the lock starve "
+                    "for the op's full timeout window; move the "
+                    "blocking call outside the critical section"
+                    % (where,
+                       " / ".join(
+                           dotted(item.context_expr
+                                  if not isinstance(item.context_expr,
+                                                    ast.Call)
+                                  else item.context_expr.func) or "?"
+                           for item in node.items),
+                       recv, sub.func.attr)))
+    return findings

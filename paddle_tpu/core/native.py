@@ -81,6 +81,15 @@ def _declare(lib):
     lib.pt_store_add.argtypes = [c.c_int, c.c_char_p, c.c_int64,
                                  c.POINTER(c.c_int64)]
     lib.pt_store_add.restype = c.c_int
+    # nonced (idempotent) add — guarded so a prebuilt legacy .so
+    # degrades to the non-idempotent op instead of breaking native
+    try:
+        lib.pt_store_add_nonced.argtypes = [
+            c.c_int, c.c_char_p, c.c_int64, c.c_uint64, c.c_uint64,
+            c.POINTER(c.c_int64)]
+        lib.pt_store_add_nonced.restype = c.c_int
+    except AttributeError:
+        pass
     lib.pt_store_counter_get.argtypes = [c.c_int, c.c_char_p,
                                          c.POINTER(c.c_int64)]
     lib.pt_store_counter_get.restype = c.c_int

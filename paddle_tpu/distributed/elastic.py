@@ -33,7 +33,8 @@ class ElasticManager:
     """Node membership + heartbeat over the rendezvous store."""
 
     def __init__(self, store: TCPStore = None, job_id=None, rank=None,
-                 np=None, heartbeat_interval=1.0, ttl=None):
+                 np=None, heartbeat_interval=1.0, ttl=None,
+                 clock=None):
         self.job_id = job_id or os.environ.get("PADDLE_JOB_ID", "default")
         self.rank = int(os.environ.get("PADDLE_NODE_RANK", 0)
                         if rank is None else rank)
@@ -48,6 +49,10 @@ class ElasticManager:
             self.np > 1 or self.ftl > 0)
         self._stop = threading.Event()
         self._thread = None
+        # injectable clock (ptcheck drives TTL aging on a virtual
+        # clock); liveness math only ever compares THIS watcher's
+        # clock against itself, so any monotonic source works
+        self._clock = clock if clock is not None else time.monotonic
         # Watcher-local liveness state: clocks are NOT comparable across
         # hosts, so each node publishes an incrementing beat COUNTER and
         # the watcher times counter advancement on its own clock.
@@ -94,7 +99,7 @@ class ElasticManager:
         seconds (as measured on THIS watcher's clock). register() starts
         every live rank at count>=1 and exit() deletes the counter, so
         count<=0 means dead or never registered."""
-        now = time.monotonic()
+        now = self._clock()
         alive = []
         for r in self.members:
             # non-creating read: never-registered ranks stay absent instead

@@ -15,11 +15,17 @@ a bounced master or a dropped connection costs a retry, not the job.
 Errors that survive the retries name op/key/peer/attempts. All ops are
 fault-injection sites (``store.set``/``get``/``add``/``delete``,
 resilience/faultinject.py) so the retry/reconnect paths are exercised
-deterministically in CI. Retry caveat: ``add`` is not idempotent — a
-reply lost AFTER the server applied the delta double-counts on retry;
-the injected broken-fd fault breaks the fd BEFORE the request, so the
-recovery tests stay exact (real mid-reply losses are rare and favor
-liveness over exactly-once here, like the reference's bootstrap).
+deterministically in CI.
+
+Retried mutating ops are IDEMPOTENT: every ``add`` carries a client
+nonce (a per-connection random 64-bit id + a per-op sequence number)
+and the server replays the recorded result for a duplicate nonce
+instead of re-applying the delta — a reply lost AFTER the server
+applied used to double-count on retry, which leader election (first
+``add`` to observe 1 wins) reads as a vanished claim. The injected
+``lost_ack`` fault (applies the op, then forces the retry path)
+exercises exactly that window; ptcheck's idempotence fixtures explore
+it under every interleaving.
 """
 from __future__ import annotations
 
@@ -70,6 +76,15 @@ class TCPStore:
         # seeding keeps a single process's tests deterministic enough
         # while never synchronizing a whole fleet's backoff waves
         self._jitter = random.Random(os.getpid() * 1000003 + id(self) % 997)
+        # idempotence nonce: a random connection id (urandom, NOT the
+        # seeded jitter — uniqueness across every process in the fleet
+        # is the whole point) + a per-op sequence. A retried add
+        # resends the same (cid, seq) and the server replays the
+        # recorded result instead of re-applying the delta.
+        self._nonce_cid = int.from_bytes(os.urandom(8), "little")
+        self._nonce_seq = 0
+        self._add_nonced = getattr(self._lib, "pt_store_add_nonced",
+                                   None)
         if is_master:
             self._server = self._lib.pt_store_server_start(port)
             if self._server < 0:
@@ -118,18 +133,24 @@ class TCPStore:
 
     def _reconnect(self, op, key, attempt):
         """Drop the dead fd and dial again (backoff + jitter first).
-        Returns True when a fresh socket is up."""
+        Returns True when a fresh socket is up. Used by the blocking
+        ``get`` poll loop, which must NOT hold the op lock across its
+        waits (peers sharing the store would starve past their
+        TTL)."""
         self._sleep_backoff(attempt)
         with self._mu:
-            if self._closed:
-                return False
-            if self._fd is not None and self._fd >= 0:
-                self._lib.pt_store_close(self._fd)
-                self._fd = -1
-            self._fd = self._lib.pt_store_connect(
-                self.host.encode(), self.port,
-                min(self.timeout_ms, 5000))
-            ok = self._fd >= 0
+            return self._reconnect_locked(op)
+
+    def _reconnect_locked(self, op):
+        if self._closed:
+            return False
+        if self._fd is not None and self._fd >= 0:
+            self._lib.pt_store_close(self._fd)
+            self._fd = -1
+        self._fd = self._lib.pt_store_connect(
+            self.host.encode(), self.port,
+            min(self.timeout_ms, 5000))
+        ok = self._fd >= 0
         _OP_RETRIES.labels(op=op).inc()
         if ok:
             _RECONNECTS.inc()
@@ -150,8 +171,12 @@ class TCPStore:
 
     # cooperative fault kinds every store op can apply (faultinject):
     # callers off the hot path see one is_enabled() branch and build
-    # no ctx allocations while injection is disabled
+    # no ctx allocations while injection is disabled. The retrying
+    # request/reply ops additionally honor "lost_ack": the request is
+    # SENT (and applied server-side) but the reply is discarded, so
+    # the retry path resends it — the idempotence window.
     _FI_ACTS = ("drop", "broken_fd")
+    _FI_ACTS_RETRY = ("drop", "broken_fd", "lost_ack")
 
     def set(self, key, value):
         if isinstance(value, str):
@@ -218,24 +243,43 @@ class TCPStore:
 
     def _int_op(self, name, key, call):
         """Shared retry/reconnect wrapper for the request/reply ops
-        (set/add/counter_get/delete): injection site, broken-fd
-        cooperation, backoff+reconnect between attempts, and the
-        op/key/peer/attempts give-up error — ONE copy of the
+        (set/add/counter_get/delete): injection site, broken-fd /
+        lost-ack cooperation, backoff+reconnect between attempts, and
+        the op/key/peer/attempts give-up error — ONE copy of the
         protocol. Returns None on an injected drop."""
-        act = _fi.fire("store.%s" % name, _supports=self._FI_ACTS,
+        act = _fi.fire("store.%s" % name,
+                       _supports=self._FI_ACTS_RETRY,
                        key=key) if _fi.is_enabled() else None
         if act == "drop":
             return None
-        for attempt in range(1, self._op_retries + 1):
-            with self._mu:
+        # the op lock is held across the WHOLE attempt loop, not per
+        # attempt: a retried mutating op must resend its nonce before
+        # any other op from this client can interleave — a hot peer
+        # thread (elastic heartbeats at socket speed) would otherwise
+        # push the pending nonce out of the server's bounded dedup
+        # ring during the backoff and the retry would re-apply. Peers
+        # block for the backoff+reconnect window, which costs them
+        # nothing: the shared socket is dead for everyone until the
+        # reconnect lands anyway.
+        with self._mu:
+            for attempt in range(1, self._op_retries + 1):
                 if act == "broken_fd":
                     self._break_fd_locked()
                     act = None
                 rc = call()
-            if rc != -1:
-                return rc
-            if attempt < self._op_retries:
-                self._reconnect(name, key, attempt)
+                if act == "lost_ack":
+                    # the request LANDED (call() above ran) but the
+                    # reply is "lost": force one pass through the
+                    # retry path so the op is resent — the window
+                    # where a non-idempotent add double-applies
+                    # (nonce dedup keeps it exact)
+                    act = None
+                    rc = -1
+                if rc != -1:
+                    return rc
+                if attempt < self._op_retries:
+                    self._sleep_backoff(attempt)
+                    self._reconnect_locked(name)
         raise RuntimeError(
             "TCPStore.%s(key=%r) to %s failed after %d attempts "
             "(socket-level failure; server down or unreachable)"
@@ -243,10 +287,31 @@ class TCPStore:
 
     def add(self, key, delta=1):
         out = ctypes.c_int64()
-        rc = self._int_op(
-            "add", key,
-            lambda: self._lib.pt_store_add(self._fd, key.encode(),
-                                           int(delta), ctypes.byref(out)))
+        # ONE nonce per logical op, allocated before the retry loop:
+        # every resend carries the same (cid, seq), so the server
+        # applies the delta at most once no matter how many replies
+        # are lost. Allocation takes the op lock — threads sharing
+        # this store (elastic heartbeats) must never mint one seq
+        # twice. A legacy .so on THIS host (no nonced symbol) degrades
+        # to the non-idempotent wire form; note both endpoints build
+        # from the same csrc tree — a NEW client against a
+        # still-running LEGACY server is not a supported mix (the old
+        # server drops unknown ops).
+        if self._add_nonced is not None:
+            with self._mu:
+                self._nonce_seq += 1
+                seq = self._nonce_seq
+            rc = self._int_op(
+                "add", key,
+                lambda: self._add_nonced(self._fd, key.encode(),
+                                         int(delta), self._nonce_cid,
+                                         seq, ctypes.byref(out)))
+        else:
+            rc = self._int_op(
+                "add", key,
+                lambda: self._lib.pt_store_add(self._fd, key.encode(),
+                                               int(delta),
+                                               ctypes.byref(out)))
         if rc is None:
             # injected drop: add has no silent no-op form (callers need
             # the counter value) — surface it as the op failure it is
@@ -289,16 +354,25 @@ class TCPStore:
         release (the pre-resilience bug: ``count``+``go`` keys lived
         forever, so arrival world_size+1 could never reach the ==
         trigger while ``go`` was already set). State is two counters
-        per name — nothing to clean up, no delete/arrive race.
+        per (name, world_size) — nothing to clean up, no delete/arrive
+        race. The counter namespace includes ``world_size`` because
+        round arithmetic is only coherent within ONE world size: a
+        SHRUNK restart generation reusing the name (3 ranks arrive,
+        then 2 survivors re-barrier) would otherwise fold the old
+        world's arrivals into the new world's rounds and strand the
+        survivors waiting on rounds that can never fill (a ptcheck
+        interleaving-explorer finding; regression-pinned there and in
+        tests/test_resilience.py).
         """
-        n = self.add("__barrier/%s/count" % name, 1)
+        ns = "__barrier/%s/ws%d" % (name, world_size)
+        n = self.add(ns + "/count", 1)
         round_i = (n - 1) // world_size
         # the go key is PER ROUND (a fresh KV key, not a mutated one):
         # waiters ride the server-side blocking get and are released
         # the instant the last arrival sets it — no poll gap a releaser
         # could win by closing its store first (the pre-round barrier's
         # push-release property, kept)
-        go_key = "__barrier/%s/go/%d" % (name, round_i)
+        go_key = "%s/go/%d" % (ns, round_i)
         if n == (round_i + 1) * world_size:
             self.set(go_key, b"1")
         got = self.get(go_key, timeout_s)
@@ -307,7 +381,7 @@ class TCPStore:
             # the contractual TimeoutError (callers match on it for the
             # flight-recorder postmortem), never a masked RuntimeError
             try:
-                cur = self.counter_get("__barrier/%s/count" % name,
+                cur = self.counter_get(ns + "/count",
                                        default=0)
             except RuntimeError:
                 cur = n

@@ -495,6 +495,7 @@ def gather_bundles(store, world_size, grace_s=None, expect_nonce=None,
                     "watchdog.on_poll",
                     "paddle_tpu.monitor.watchdog: on_poll callback "
                     "raised during bundle gather: %r" % (e,))
+        locked_in = False
         for r in sorted(pending):
             left = deadline - time.monotonic()
             data = store.get("%s/bundle/rank%d" % (_WD_PREFIX, r),
@@ -509,6 +510,16 @@ def gather_bundles(store, world_size, grace_s=None, expect_nonce=None,
             if expect_nonce is None \
                     or b.get("answering") == expect_nonce:
                 pending.discard(r)
+                locked_in = True
+        # pacing: an ABSENT bundle key blocks its get for the poll
+        # window, but a stale leftover bundle (exists, wrong nonce)
+        # returns instantly — without this sleep a round of only-stale
+        # pending ranks busy-spins on the store for the entire grace
+        # window (a ptcheck bundle-fixture finding: the gather loop's
+        # schedule was unbounded whenever a previous incident left its
+        # bundles behind)
+        if pending and not locked_in:
+            time.sleep(0.05)
     return bundles
 
 

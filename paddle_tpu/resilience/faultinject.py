@@ -36,6 +36,11 @@ Fault kinds:
     broken_fd  site-cooperative (store ops): the client fd is closed
                under the caller's lock before the op, exercising the
                reconnect path — returned as "broken_fd"
+    lost_ack   site-cooperative (retrying store ops): the request is
+               SENT and applied server-side, but the reply is
+               discarded so the client's retry path resends the op —
+               the exactly-once window the nonce-idempotent ``add``
+               closes; returned as "lost_ack"
 
 Schedule grammar (``PT_FAULT_SCHEDULE`` / ``enable(schedule)``),
 semicolon-separated rules::
@@ -74,7 +79,7 @@ _FAULTS = _registry.counter(
     "faults fired by the injection framework (resilience/faultinject)",
     labelnames=("site", "kind"))
 
-_KINDS = ("error", "delay", "drop", "broken_fd")
+_KINDS = ("error", "delay", "drop", "broken_fd", "lost_ack")
 
 
 class InjectedFault(RuntimeError):
@@ -254,7 +259,7 @@ def _fire(site, supports, ctx):
             rule.hits += 1
             if not rule._matches(_state.rng):
                 continue
-            if rule.kind in ("drop", "broken_fd") \
+            if rule.kind in ("drop", "broken_fd", "lost_ack") \
                     and rule.kind not in supports:
                 rule.mismatched += 1
                 continue

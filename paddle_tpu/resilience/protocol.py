@@ -1,0 +1,84 @@
+"""The store-level agreement protocols, isolated from the train loop.
+
+``ResilientTrainLoop`` recovery is two layers: HOST-side mechanics
+(settle a TTL, flush snapshots, restore state) and a pure STORE
+protocol (publish, claim, agree, barrier). This module is the store
+layer on its own, taking the store as an injected parameter — which
+is what lets ptcheck (``paddle_tpu/analysis/proto``) drive the REAL
+agreement code under a deterministic scheduler and explore every
+interleaving and crash point, instead of checking a hand-written
+model that drifts from the shipped protocol.
+
+Leader claim: one atomic counter add per generation — the FIRST
+survivor to observe 1 leads; the store's nonce-idempotent add keeps
+that claim exact even when a lost ack forces a client retry (a
+double-applied retry would leave NO rank observing 1 and the
+generation leaderless: the historical ``add`` retry hole, now a
+ptcheck regression fixture).
+"""
+from __future__ import annotations
+
+import json
+
+
+def rebuild_membership(store, base, rank, alive, dead, snapshot_steps,
+                       generation, timeout_s, on_members=None):
+    """Survivors agree on generation ``generation``'s member set and
+    resume step under the key namespace ``base``.
+
+    Protocol (every call sees the same store, injected):
+
+    1. each survivor publishes its FULL complete-snapshot list under
+       ``<base>/snap/<rank>`` (retention pruning + skipped writes make
+       per-rank sets diverge — a min over latests could name a step
+       some rank already pruned);
+    2. the FIRST survivor to claim the generation's leader counter
+       (one atomic store add — two survivors with momentarily
+       different alive views can never both lead) intersects the
+       published lists and publishes members + the newest COMMON
+       snapshot step under ``<base>/members``;
+    3. everyone blocks on the published membership; a rank that finds
+       itself outside it fails CLEANLY instead of half-joining a
+       generation that will not wait for it;
+    4. ``on_members(info)`` runs before the barrier (the caller
+       shrinks its watch set here), then everyone barriers on the
+       generation-scoped name — safe to reuse across generations and
+       across a SHRUNK world: the round-based barrier namespaces its
+       counters per (name, world_size).
+
+    Returns the published ``info`` dict. Raises RuntimeError when the
+    leader never published within ``timeout_s`` (it died between
+    claim and publish) or when this rank is outside the membership.
+    """
+    store.set("%s/snap/%d" % (base, rank),
+              json.dumps(sorted(int(s) for s in snapshot_steps)))
+    if store.add(base + "/leader", 1) == 1:
+        common = None
+        for r in alive:
+            data = store.get("%s/snap/%d" % (base, r),
+                             timeout_s=timeout_s)
+            steps = set() if data is None \
+                else set(json.loads(data.decode()))
+            common = steps if common is None else (common & steps)
+        info = {"members": list(alive), "dead": list(dead),
+                "resume_step": max(common) if common else -1,
+                "generation": generation}
+        store.set(base + "/members", json.dumps(info))
+    data = store.get(base + "/members", timeout_s=timeout_s)
+    if data is None:
+        raise RuntimeError(
+            "membership rebuild gen %d: leader never published %r"
+            % (generation, base + "/members"))
+    info = json.loads(data.decode())
+    if rank not in info["members"]:
+        raise RuntimeError(
+            "membership rebuild gen %d: this rank (%d) is not in "
+            "the published membership %s — the leader's liveness "
+            "view aged it out; failing cleanly instead of joining "
+            "a generation that will not wait for it"
+            % (generation, rank, info["members"]))
+    if on_members is not None:
+        on_members(info)
+    store.barrier(base + "/barrier", len(info["members"]),
+                  timeout_s=timeout_s)
+    return info

@@ -395,16 +395,17 @@ class ResilientTrainLoop:
 
     def _rebuild_membership(self):
         """Survivors agree on generation g's member set: settle one
-        TTL (every watcher must see the same dead set); the FIRST
-        survivor to claim the generation's leader counter (an atomic
-        store add — two survivors with momentarily different alive
-        views can never both lead) publishes the member set + the
-        newest COMMON snapshot step; everyone barriers on the
-        generation-suffixed name. Rank ids never renumber. A live rank
-        the leader's view missed (heartbeat lagged past ttl) finds
-        itself outside the published membership and fails CLEANLY
-        instead of half-joining a generation that will not wait for
-        it."""
+        TTL (every watcher must see the same dead set), then run the
+        STORE protocol — ``protocol.rebuild_membership``, the ptcheck-
+        explored agreement: first-claimant leader election (atomic
+        add), newest-COMMON-snapshot intersection, membership publish,
+        generation-scoped barrier. Rank ids never renumber. A live
+        rank the leader's view missed (heartbeat lagged past ttl)
+        finds itself outside the published membership and fails
+        CLEANLY instead of half-joining a generation that will not
+        wait for it."""
+        from . import protocol as _proto
+
         el = self.elastic
         time.sleep(el.ttl)
         alive = el.alive_nodes()
@@ -412,43 +413,14 @@ class ResilientTrainLoop:
         self.generation += 1
         gen = self.generation
         base = "%s/resilience/gen%d" % (el.job_id, gen)
-        # resume step must be COMMON: each survivor publishes its FULL
-        # complete-snapshot list (retention pruning + skipped writes
-        # make per-rank sets diverge — a min over LATESTS could name a
-        # step some rank already pruned); the leader intersects and
-        # takes the newest step every survivor still holds.
+        # resume step must be COMMON across survivors; the snapshot
+        # list published below is this rank's FULL complete set
         self.flush_snapshots()
-        el.store.set("%s/snap/%d" % (base, el.rank),
-                     json.dumps(list_snapshots(self.snapshot_dir)))
-        if el.store.add(base + "/leader", 1) == 1:
-            common = None
-            for r in alive:
-                data = el.store.get("%s/snap/%d" % (base, r),
-                                    timeout_s=self.store_timeout_s)
-                steps = set() if data is None \
-                    else set(json.loads(data.decode()))
-                common = steps if common is None else (common & steps)
-            info = {"members": alive, "dead": dead,
-                    "resume_step": max(common) if common else -1,
-                    "generation": gen}
-            el.store.set(base + "/members", json.dumps(info))
-        data = el.store.get(base + "/members",
-                            timeout_s=self.store_timeout_s)
-        if data is None:
-            raise RuntimeError(
-                "membership rebuild gen %d: leader never published %r"
-                % (gen, base + "/members"))
-        info = json.loads(data.decode())
-        if el.rank not in info["members"]:
-            raise RuntimeError(
-                "membership rebuild gen %d: this rank (%d) is not in "
-                "the published membership %s — the leader's liveness "
-                "view aged it out; failing cleanly instead of joining "
-                "a generation that will not wait for it"
-                % (gen, el.rank, info["members"]))
-        el.set_members(info["members"])
-        el.store.barrier(base + "/barrier", len(info["members"]),
-                         timeout_s=self.store_timeout_s)
+        info = _proto.rebuild_membership(
+            el.store, base, el.rank, alive, dead,
+            list_snapshots(self.snapshot_dir), gen,
+            self.store_timeout_s,
+            on_members=lambda info: el.set_members(info["members"]))
         if int(info.get("resume_step", -1)) < 0:
             raise RuntimeError(
                 "membership rebuild gen %d: survivors %s share no "

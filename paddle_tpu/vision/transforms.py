@@ -7,6 +7,15 @@ import numpy as np
 from ..core.tensor import Tensor
 
 
+# _looks_chw: THE layout guess, bound at the bottom of this module to
+# transforms_extras._is_chw (one copy of the rule): channels-first
+# only when dim 0 is channel-like AND dim 2 is not — a (3, W, 3)
+# array (e.g. a random crop of height 3 from an HWC image) must read
+# as HWC, or a crop→resize chain silently flips layout on ~6% of crop
+# draws (seed-dependent; regression-pinned in
+# tests/test_vision_incubate_extras.py).
+
+
 class Compose:
     def __init__(self, transforms):
         self.transforms = transforms
@@ -56,7 +65,7 @@ class Resize:
 
         import jax.numpy as jnp
 
-        chw = arr.ndim == 3 and arr.shape[0] in (1, 3)
+        chw = _looks_chw(arr)
         h_ax, w_ax = (1, 2) if chw else (0, 1)
         shape = list(arr.shape)
         shape[h_ax], shape[w_ax] = self.size[0], self.size[1]
@@ -72,7 +81,7 @@ class CenterCrop:
 
     def __call__(self, img):
         arr = np.asarray(img)
-        chw = arr.ndim == 3 and arr.shape[0] in (1, 3)
+        chw = _looks_chw(arr)
         h_ax, w_ax = (1, 2) if chw else (0, 1)
         h, w = arr.shape[h_ax], arr.shape[w_ax]
         th, tw = self.size
@@ -90,7 +99,7 @@ class RandomCrop:
 
     def __call__(self, img):
         arr = np.asarray(img)
-        chw = arr.ndim == 3 and arr.shape[0] in (1, 3)
+        chw = _looks_chw(arr)
         h_ax, w_ax = (1, 2) if chw else (0, 1)
         if self.padding:
             pads = [(0, 0)] * arr.ndim
@@ -114,8 +123,11 @@ class RandomHorizontalFlip:
     def __call__(self, img):
         arr = np.asarray(img)
         if np.random.rand() < self.prob:
-            chw = arr.ndim == 3 and arr.shape[0] in (1, 3)
-            return arr[..., ::-1] if not chw else arr[:, :, ::-1]
+            # horizontal = reverse the WIDTH axis: 1 for 2-D/HWC, 2
+            # for CHW (`arr[..., ::-1]` on a 3-D HWC array reversed
+            # CHANNELS — an RGB->BGR swap with zero flip)
+            chw = _looks_chw(arr)
+            return arr[:, :, ::-1] if chw else arr[:, ::-1]
         return arr
 
 
@@ -126,8 +138,11 @@ class RandomVerticalFlip:
     def __call__(self, img):
         arr = np.asarray(img)
         if np.random.rand() < self.prob:
-            chw = arr.ndim == 3 and arr.shape[0] in (1, 3)
-            return arr[:, ::-1] if not chw else arr[:, ::-1, :]
+            # vertical = reverse the HEIGHT axis: 0 for 2-D/HWC, 1
+            # for CHW (`arr[:, ::-1]` on a 3-D HWC array reversed
+            # WIDTH — a horizontal flip masquerading as vertical)
+            chw = _looks_chw(arr)
+            return arr[:, ::-1, :] if chw else arr[::-1]
         return arr
 
 
@@ -143,6 +158,7 @@ def resize(img, size, interpolation="bilinear"):
     return Resize(size, interpolation)(img)
 
 
+from .transforms_extras import _is_chw as _looks_chw  # noqa: E402
 from .transforms_extras import (  # noqa: F401,E402
     BaseTransform,
     BrightnessTransform,

@@ -1,0 +1,337 @@
+"""ptcheck: scheduler/SimStore units, DFS/replay semantics, the
+tier-1 gate (live fixtures clean + historical bugs found), and the
+seeded random-walk fuzz for the barrier/election protocols.
+
+The gate is the acceptance contract: running the FULL fixture registry
+in-process yields zero findings on the live tree, and the
+expected-finding fixtures (the pre-PR-7 count+go barrier, the
+non-idempotent retried add) are FOUND within their default budgets
+with replayable schedule traces — the proof the zeros mean something.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from paddle_tpu.analysis.proto import (
+    PROTO_FIXTURES, SimStore, dfs_explore, random_walk,
+    replay_schedule, run_fixtures)
+from paddle_tpu.analysis.proto.explore import RunResult, Scenario, \
+    run_once
+from paddle_tpu.analysis.proto.sched import ReplayDivergence, SimCrash
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class _MiniFixture:
+    """Two writers, two adds each — the smallest interesting tree."""
+
+    name = "mini"
+    doc = "test fixture"
+    expect_finding = False
+    max_schedules = 200
+    max_steps = 60
+    wall_s = 10.0
+    walks = 10
+
+    def build(self):
+        scenario = Scenario(SimStore())
+
+        def mk(rank):
+            client = scenario.client("w%d" % rank)
+
+            def fn():
+                for _ in range(2):
+                    scenario.log.append(
+                        (rank, client.add("ctr", 1)))
+
+            return fn
+
+        for rank in range(2):
+            scenario.task("w%d" % rank, mk(rank))
+        return scenario
+
+    def verdict(self, result):
+        return []
+
+
+class TestScheduler:
+    def test_one_task_at_a_time_and_deterministic_replay(self):
+        fixture = _MiniFixture()
+        # each s: resume carries a task THROUGH its pending op to the
+        # next boundary: w0 start; w0 applies add->1; w1 start; w0
+        # applies add->2 (done); w1 applies add->3; w1 applies add->4
+        result, _ = run_once(fixture, ["s:w0", "s:w0", "s:w1",
+                                       "s:w0", "s:w1", "s:w1"])
+        assert result.log == [(0, 1), (0, 2), (1, 3), (1, 4)]
+        again, _ = run_once(fixture, ["s:w0", "s:w0", "s:w1",
+                                      "s:w0", "s:w1", "s:w1"])
+        assert again.log == result.log
+        assert again.store.fingerprint() == result.store.fingerprint()
+
+    def test_crash_transition_is_not_swallowed_by_except(self):
+        """SimCrash is a BaseException: protocol code's ``except
+        Exception`` recovery must not survive a simulated death."""
+        scenario = Scenario(SimStore(), max_crashes=1)
+        client = scenario.client("c")
+        survived = []
+
+        def fn():
+            try:
+                client.add("k", 1)
+                client.add("k", 1)
+            except Exception:       # would hide a real crash
+                survived.append(True)
+
+        scenario.task("c", fn, crashable=True)
+        # start, then crash at the first add boundary
+        scenario.sched.run(lambda toks, fp: (
+            "c:c" if "c:c" in toks else toks[0]), max_steps=20)
+        assert scenario.sched.tasks["c"].status == "crashed"
+        assert survived == []
+        assert scenario.store.counters.get("k", 0) == 0
+
+    def test_blocking_get_woken_by_set(self):
+        scenario = Scenario(SimStore())
+        waiter_client = scenario.client("w")
+        setter_client = scenario.client("s")
+        got = []
+
+        def waiter():
+            got.append(waiter_client.get("key", timeout_s=10.0))
+
+        def setter():
+            setter_client.set("key", b"value")
+
+        scenario.task("waiter", waiter)
+        scenario.task("setter", setter)
+        # run the waiter first so it genuinely blocks, then the setter
+        scenario.sched.run(lambda toks, fp: toks[0], max_steps=20)
+        assert got == [b"value"]
+
+    def test_hang_unwinds_via_timeout_and_records_event(self):
+        scenario = Scenario(SimStore())
+        client = scenario.client("c")
+        got = []
+
+        def fn():
+            got.append(client.get("never", timeout_s=3.0))
+
+        scenario.task("c", fn)
+        scenario.sched.run(lambda toks, fp: toks[0], max_steps=20)
+        assert got == [None]
+        result = RunResult(scenario)
+        assert result.hangs and \
+            result.hangs[0]["blocked"][0]["key"] == "never"
+        # the virtual clock advanced to the deadline — no real waiting
+        assert scenario.sched.clock.now == pytest.approx(3.0)
+
+    def test_replay_divergence_raises(self):
+        fixture = _MiniFixture()
+        with pytest.raises(ReplayDivergence):
+            run_once(fixture, ["s:nope"])
+
+    def test_replay_refuses_unconsumed_trailing_tokens(self):
+        """The replay contract's other half: a schedule whose tail
+        the run never reaches (the code changed under a recorded
+        finding) must DIVERGE, not be judged as a shorter run."""
+        fixture = _MiniFixture()
+        full, _ = run_once(fixture, [])
+        with pytest.raises(ReplayDivergence, match="never reachable"):
+            replay_schedule(fixture,
+                            ",".join(full.schedule + ["s:w0", "c:zz"]))
+        # the exact recorded schedule still replays cleanly
+        result, _ = replay_schedule(fixture,
+                                    ",".join(full.schedule))
+        assert result.log == full.log
+
+
+class TestSimStore:
+    def test_lost_ack_idempotent_vs_legacy(self):
+        """The a:<task> transition: same nonce resent — exact against
+        the nonce-dedup server, double-applied against the legacy
+        one."""
+        for idempotent, expected in ((True, 1), (False, 2)):
+            scenario = Scenario(SimStore(idempotent_add=idempotent),
+                                max_lost_acks=1)
+            client = scenario.client("c")
+            seen = []
+
+            def fn(client=client, seen=seen):
+                seen.append(client.add("k", 1))
+
+            scenario.task("c", fn)
+            scenario.sched.run(lambda toks, fp: (
+                "a:c" if "a:c" in toks else toks[0]), max_steps=20)
+            assert scenario.store.counters["k"] == expected
+            # the client observes the RETRY's value either way
+            assert seen == [expected]
+
+    def test_real_barrier_runs_unbound_over_sim_clients(self):
+        """TCPStore.barrier literally executes over the sim — one
+        generation, three ranks, everyone released."""
+        scenario = Scenario(SimStore())
+        released = []
+
+        def mk(rank):
+            client = scenario.client("r%d" % rank)
+
+            def fn():
+                client.barrier("gate", 3, timeout_s=5.0)
+                released.append(rank)
+
+            return fn
+
+        for rank in range(3):
+            scenario.task("r%d" % rank, mk(rank))
+        scenario.sched.run(lambda toks, fp: toks[0], max_steps=60)
+        assert sorted(released) == [0, 1, 2]
+        assert not RunResult(scenario).errors()
+
+
+class TestDFS:
+    def test_exhausts_the_mini_tree(self):
+        """2 tasks × 2 ops: the interleaving space is tiny; DFS must
+        exhaust it within budget and dedup converging states."""
+        findings, stats = dfs_explore(_MiniFixture())
+        assert findings == []
+        assert stats["exhausted"]
+        # C(4,2)=6 maximal interleavings; with start/finish boundaries
+        # and dedup the run count stays well under the naive 2^6
+        assert 6 <= stats["schedules"] <= 40
+
+    def test_walk_mode_is_seeded_deterministic(self):
+        f1, s1 = random_walk(_MiniFixture(), seed=7, walks=5)
+        f2, s2 = random_walk(_MiniFixture(), seed=7, walks=5)
+        assert f1 == [] and f2 == []
+        assert s1["schedules"] == s2["schedules"] == 5
+
+
+class TestGate:
+    """Tier-1 acceptance: the full registry, in-process."""
+
+    @pytest.fixture(scope="class")
+    def full_run(self):
+        report, findings = run_fixtures(PROTO_FIXTURES)
+        return report, findings
+
+    def test_live_tree_is_clean(self, full_run):
+        report, findings = full_run
+        assert report["clean"], (
+            "ptcheck findings on the live protocol plane:\n%s"
+            % json.dumps([f.to_dict() for f in findings], indent=1))
+        for name, row in report["fixtures"].items():
+            if not row["expect_finding"]:
+                assert row["findings"] == [], name
+                assert row["truncated"] == 0, (
+                    "%s: unbounded schedules (hot spin)" % name)
+
+    def test_every_fixture_ran(self, full_run):
+        report, _ = full_run
+        assert set(report["fixtures"]) == {
+            "barrier", "barrier_legacy", "election", "elastic",
+            "bundle", "idempotence", "add_legacy"}
+        for row in report["fixtures"].values():
+            assert row["schedules"] > 0
+
+    def test_historical_count_go_barrier_is_found(self, full_run):
+        """THE acceptance pin: the pre-PR-7 bug is found within the
+        default budget, as a deadlock/safety finding, with a
+        replayable schedule that reproduces it."""
+        report, _ = full_run
+        row = report["fixtures"]["barrier_legacy"]
+        assert row["found_expected"]
+        assert row["hangs"] > 0, "the hang itself must be observed"
+        finding = row["findings"][0]
+        assert finding["schedule"]
+        result, replayed = replay_schedule(
+            PROTO_FIXTURES["barrier_legacy"], finding["schedule"])
+        assert result.hangs or result.errors()
+        assert any(f.prop == finding["property"] for f in replayed)
+
+    def test_legacy_add_double_apply_is_found(self, full_run):
+        report, _ = full_run
+        row = report["fixtures"]["add_legacy"]
+        assert row["found_expected"]
+        props = {f["property"] for f in row["findings"]}
+        assert "retry-idempotence" in props or "claim-unique" in props
+
+    def test_regression_power_requires_the_historical_property(self):
+        """A fixture whose runs merely TRUNCATE (engine
+        schedule-budget noise) must NOT satisfy the regression-power
+        gate: found_expected demands the declared property ids."""
+        class Truncating(_MiniFixture):
+            name = "truncating"
+            expect_finding = True
+            expected_props = ("some-historical-property",)
+            max_steps = 1       # every run truncates
+
+        report, gate = run_fixtures({"truncating": Truncating()})
+        row = report["fixtures"]["truncating"]
+        assert row["truncated"] > 0
+        assert row["found_expected"] is False
+        assert any(f.prop == "regression-power" for f in gate)
+
+    def test_election_explored_crashes_and_lost_acks(self, full_run):
+        """The election DFS must actually have taken crash and
+        lost-ack transitions — a budget regression that silently
+        stops exploring faults would leave the uniqueness property
+        vacuous."""
+        report, _ = full_run
+        row = report["fixtures"]["election"]
+        assert row["hangs"] > 0  # crashed-leader schedules were seen
+
+
+class TestFuzz:
+    """Satellite: seeded random-walk fuzz for the round-based barrier
+    and leader election. Bounded to a few seconds; a failing seed
+    prints a replay command."""
+
+    @pytest.mark.parametrize("name", ["barrier", "election"])
+    @pytest.mark.parametrize("seed", [0, 20260804])
+    def test_random_walks_stay_clean(self, name, seed):
+        fixture = PROTO_FIXTURES[name]
+        findings, stats = random_walk(fixture, seed=seed, walks=60,
+                                      wall_s=20.0)
+        assert not findings, (
+            "seeded fuzz found a protocol violation — replay with:\n"
+            "  python tools/ptcheck.py --mode walk --seed %d "
+            "--fixtures %s\nor exactly:\n  python tools/ptcheck.py "
+            "--replay '%s'\nfindings: %s"
+            % (seed, name, findings[0].replay,
+               json.dumps([f.to_dict() for f in findings], indent=1)))
+
+
+class TestCLI:
+    def _run(self, *args):
+        return subprocess.run(
+            [sys.executable,
+             os.path.join(REPO_ROOT, "tools", "ptcheck.py")]
+            + list(args),
+            capture_output=True, text=True, cwd=REPO_ROOT,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+
+    def test_list(self):
+        r = self._run("--list")
+        assert r.returncode == 0
+        for name in PROTO_FIXTURES:
+            assert name in r.stdout
+
+    def test_check_clean_and_artifact(self, tmp_path):
+        out = tmp_path / "ptcheck_report.json"
+        r = self._run("--out", str(out))
+        assert r.returncode == 0, r.stdout + r.stderr
+        report = json.loads(out.read_text())
+        assert report["kind"] == "ptcheck_report"
+        assert report["clean"] is True
+        assert report["fixtures"]["barrier_legacy"]["found_expected"]
+
+    def test_unknown_fixture_is_usage_error(self):
+        r = self._run("--fixtures", "nope")
+        assert r.returncode == 2
+
+    def test_replay_of_a_diverging_schedule_is_usage_error(self):
+        r = self._run("--replay", "barrier:s:bogus")
+        assert r.returncode == 2

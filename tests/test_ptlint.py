@@ -22,7 +22,8 @@ import pytest
 from paddle_tpu.analysis import (Baseline, Project, load_config,
                                  render_json, render_text, run)
 from paddle_tpu.analysis import (clocks, compile_discipline, flags_pass,
-                                 metrics_pass, silent_except, threads,
+                                 metrics_pass, silent_except,
+                                 store_discipline, threads,
                                  trace_purity)
 from paddle_tpu.analysis.runner import BASELINE_ELIGIBLE, RULES
 
@@ -622,6 +623,170 @@ class TestMetricPass:
         assert metrics_pass.run_pass(project) == []
 
 
+# -- store pass --------------------------------------------------------------
+
+STORE_CFG = {"store": {"paths": ["pkg"]}}
+
+
+class TestStorePass:
+    def test_construction_in_protocol_function_fires(self, tmp_path):
+        """Protocol code must take the store injected; constructing
+        one inside a protocol function (or at module scope) hard-wires
+        the transport and defeats ptcheck."""
+        project = make_project(tmp_path, {
+            "pkg/proto.py": """
+                from paddle_tpu.distributed.store import TCPStore
+
+                GLOBAL_STORE = TCPStore(is_master=True)
+
+                def elect(rank):
+                    store = TCPStore("127.0.0.1", 1234)
+                    return store.add("leader", 1) == 1
+
+                def injected(store, rank):
+                    return store.add("leader", 1) == 1
+            """}, config=STORE_CFG)
+        found = store_discipline.run_pass(project)
+        syms = sorted(f.symbol for f in found)
+        assert syms == ["construct:<module>#1", "construct:elect#2"]
+
+    def test_factory_function_is_allowed(self, tmp_path):
+        project = make_project(tmp_path, {
+            "pkg/factory.py": """
+                from paddle_tpu.distributed.store import TCPStore
+
+                def create_store_from_env(world_size=None):
+                    return TCPStore(is_master=True)
+            """}, config=STORE_CFG)
+        assert store_discipline.run_pass(project) == []
+
+    def test_out_of_scope_module_is_ignored(self, tmp_path):
+        """Launchers/tools construct stores legitimately: the pass
+        only patrols the configured protocol paths."""
+        project = make_project(tmp_path, {
+            "pkg/launcher.py": """
+                from paddle_tpu.distributed.store import TCPStore
+
+                def main():
+                    return TCPStore(is_master=True)
+            """}, config={"store": {"paths": ["other"]}})
+        assert store_discipline.run_pass(project) == []
+
+    def test_lock_across_blocking_store_op_fires(self, tmp_path):
+        project = make_project(tmp_path, {
+            "pkg/waiters.py": """
+                import threading
+
+                class Bad:
+                    def __init__(self, store):
+                        self._lock = threading.Lock()
+                        self.store = store
+
+                    def wait_members(self):
+                        with self._lock:
+                            return self.store.get("members")
+
+                class Good:
+                    def __init__(self, store):
+                        self._lock = threading.Lock()
+                        self.store = store
+
+                    def wait_members(self):
+                        data = self.store.get("members")
+                        with self._lock:
+                            self.cache = data
+                        return data
+
+                    def quick_op(self):
+                        # non-blocking ops under a lock are fine
+                        with self._lock:
+                            self.store.set("k", b"v")
+            """}, config=STORE_CFG)
+        found = store_discipline.run_pass(project)
+        assert len(found) == 1
+        assert found[0].symbol == "lock:Bad.wait_members:self.store.get"
+
+    def test_deferred_callback_and_nested_locks(self, tmp_path):
+        """A store op inside a lambda/def under the lock runs LATER,
+        outside the lock — clean; an op under two nested lockish
+        withs is ONE finding, not two (baseline keys must not
+        collide)."""
+        project = make_project(tmp_path, {
+            "pkg/deferred.py": """
+                import threading
+
+                class Q:
+                    def defer(self):
+                        with self._lock:
+                            self.cbs.append(
+                                lambda: self.store.get("k"))
+
+                    def nested(self):
+                        with self._lock_a:
+                            with self._lock_b:
+                                return self.store.get("k")
+            """}, config=STORE_CFG)
+        found = store_discipline.run_pass(project)
+        assert len(found) == 1
+        assert found[0].symbol == "lock:Q.nested:self.store.get"
+
+    def test_non_store_receiver_get_is_clean(self, tmp_path):
+        """dict.get / cache.get under a lock are not store ops."""
+        project = make_project(tmp_path, {
+            "pkg/cachey.py": """
+                import threading
+
+                class Cache:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self._d = {}
+
+                    def lookup(self, k):
+                        with self._lock:
+                            return self._d.get(k)
+            """}, config=STORE_CFG)
+        assert store_discipline.run_pass(project) == []
+
+    def test_pragma_suppresses(self, tmp_path):
+        project = make_project(tmp_path, {
+            "pkg/blessed.py": """
+                from paddle_tpu.distributed.store import TCPStore
+
+                def bootstrap():
+                    # ptlint: store-ok — this IS the launcher entry
+                    return TCPStore(is_master=True)
+            """}, config=STORE_CFG)
+        assert store_discipline.run_pass(project) == []
+
+    def test_store_rule_is_baseline_eligible(self, tmp_path):
+        """store findings may be grandfathered (debt), like
+        flag/trace/thread — and go stale when the debt is paid."""
+        assert "store" in BASELINE_ELIGIBLE
+        files = {
+            "pkg/proto.py": """
+                from paddle_tpu.distributed.store import TCPStore
+
+                def elect():
+                    return TCPStore(is_master=True)
+            """}
+        project = make_project(tmp_path, files, config=STORE_CFG)
+        found = store_discipline.run_pass(project)
+        baseline = Baseline.from_findings(found)
+        findings, stale, _ = run(project, rules=["store"],
+                                 baseline=baseline)
+        assert all(f.grandfathered for f in findings)
+        assert stale == []
+        clean = make_project(tmp_path, {
+            "pkg/proto.py": """
+                def elect(store):
+                    return store.add("leader", 1) == 1
+            """}, config=STORE_CFG)
+        findings, stale, _ = run(clean, rules=["store"],
+                                 baseline=baseline)
+        assert findings == []
+        assert len(stale) == 1
+
+
 # -- silent-except pass ------------------------------------------------------
 
 class TestSilentExceptPass:
@@ -876,7 +1041,7 @@ class TestTreeIsClean:
                           config=config)
         assert len(project.files) > 200
         assert set(RULES) == {"flag", "trace", "compile-discipline",
-                              "clock", "thread", "metric",
+                              "clock", "thread", "store", "metric",
                               "silent-except"}
 
     def test_baseline_carries_no_nongrandfatherable_debt(self):

@@ -239,6 +239,48 @@ class TestBarrierReuse:
             for _ in range(4):
                 store.barrier("solo", 1, timeout_s=5)
 
+    def test_shrunk_world_reuses_name(self):
+        """A SHRUNK restart generation reusing the name (3 ranks
+        arrive, then 2 survivors re-barrier) — the ptcheck finding:
+        with ONE shared counter the survivors' arrivals landed as
+        counts 4 and 5 of a ws-2 round series that can never fill, a
+        permanent hang. Counters are namespaced per (name,
+        world_size) now, so the shrunk generation starts fresh."""
+        master = TCPStore(is_master=True)
+        clients = [TCPStore("127.0.0.1", master.port)
+                   for _ in range(2)]
+        try:
+            errs = []
+
+            def arrive(st, ws):
+                try:
+                    st.barrier("shrink", ws, timeout_s=10)
+                except Exception as e:      # pragma: no cover
+                    errs.append(e)
+
+            # generation 1: world of 3 (master + both clients)
+            threads = [threading.Thread(target=arrive,
+                                        args=(c, 3), daemon=True)
+                       for c in clients]
+            for t in threads:
+                t.start()
+            master.barrier("shrink", 3, timeout_s=10)
+            for t in threads:
+                t.join(timeout=15)
+            assert not errs
+            # generation 2: rank 2 "died" — the 2 survivors reuse
+            # the SAME name with the shrunk world
+            t = threading.Thread(target=arrive,
+                                 args=(clients[0], 2), daemon=True)
+            t.start()
+            master.barrier("shrink", 2, timeout_s=10)
+            t.join(timeout=15)
+            assert not t.is_alive() and not errs
+        finally:
+            for c in clients:
+                c.close()
+            master.close()
+
 
 # ---------------------------------------------------------------------------
 # elastic: who died

@@ -105,6 +105,52 @@ class TestTransforms:
         out = chain(self._img())
         assert out.shape == (3, 6, 6)
 
+    def test_compose_chain_layout_stable_across_crop_draws(self):
+        """Regression: Resize guessed CHW from `shape[0] in (1, 3)`
+        alone, so a random crop of HEIGHT 3 — a (3, W, 3) HWC array —
+        was resized channels-first and the chain's output layout
+        flipped on ~6% of global-RNG draws (seed 22 was one). The
+        layout guess now requires dim 2 to be non-channel-like too
+        (transforms_extras._is_chw's rule)."""
+        import random
+
+        chain = vision.transforms.Compose([
+            vision.transforms.Pad(1),
+            vision.transforms.RandomResizedCrop(6),
+            vision.transforms.Transpose(),
+        ])
+        state = random.getstate()
+        try:
+            for seed in (22, 31, 43, 57, 113):    # height-3 crop draws
+                random.seed(seed)
+                assert chain(self._img()).shape == (3, 6, 6), seed
+        finally:
+            random.setstate(state)
+
+    def test_resize_ambiguous_three_row_image_is_hwc(self):
+        """(3, W, 3) reads as HWC: resize scales rows/cols, keeping
+        channels last."""
+        arr = np.arange(3 * 5 * 3, dtype=np.float32).reshape(3, 5, 3)
+        out = vision.transforms.resize(arr, (6, 6))
+        assert out.shape == (6, 6, 3)
+
+    def test_random_flips_flip_the_right_axes(self):
+        """Regression: on HWC input, RandomHorizontalFlip reversed
+        the CHANNEL axis (an RGB->BGR swap with zero flip) and
+        RandomVerticalFlip reversed WIDTH. Horizontal = width axis,
+        vertical = height axis, in every layout."""
+        hwc = np.arange(4 * 5 * 3, dtype=np.float32).reshape(4, 5, 3)
+        chw = np.transpose(hwc, (2, 0, 1)).copy()
+        gray = np.arange(20, dtype=np.float32).reshape(4, 5)
+        h = vision.transforms.RandomHorizontalFlip(prob=1.0)
+        v = vision.transforms.RandomVerticalFlip(prob=1.0)
+        assert np.array_equal(h(hwc), hwc[:, ::-1, :])
+        assert np.array_equal(h(chw), chw[:, :, ::-1])
+        assert np.array_equal(h(gray), gray[:, ::-1])
+        assert np.array_equal(v(hwc), hwc[::-1])
+        assert np.array_equal(v(chw), chw[:, ::-1, :])
+        assert np.array_equal(v(gray), gray[::-1])
+
 
 class TestVisionOps:
     def test_yolo_box_shapes(self):

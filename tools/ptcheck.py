@@ -1,0 +1,173 @@
+#!/usr/bin/env python
+"""ptcheck CLI — deterministic interleaving explorer for the protocol
+plane (store barrier / leader election / elastic membership / watchdog
+bundles).
+
+    python tools/ptcheck.py                  # --check: DFS-explore every
+                                             # registered fixture
+    python tools/ptcheck.py --json           # JSON report on stdout
+    python tools/ptcheck.py --out tools/ptcheck_report.json
+    python tools/ptcheck.py --fixtures barrier,election
+    python tools/ptcheck.py --list           # registered fixtures
+    python tools/ptcheck.py --mode walk --seed 7 --walks 200
+    python tools/ptcheck.py --replay "barrier_legacy:s:r0,s:r1,..."
+
+Exit codes: 0 = clean (live fixtures produced zero findings AND every
+expected-finding regression fixture FOUND its historical bug), 1 =
+findings (or a regression fixture that came back clean — the checker
+lost power), 2 = usage.
+
+Every finding prints a replayable schedule token string: ``--replay
+"<fixture>:<tok,tok,...>"`` re-executes that exact interleaving.
+Random-walk findings additionally carry the seed that derived them.
+Config lives in ``[tool.ptlint.proto]`` in pyproject.toml
+(max_schedules / walks / wall_s caps for CI).
+
+Host-only: the sim store is in-process shared state — no sockets, no
+accelerator, no real time (blocking waits ride a virtual clock).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from paddle_tpu.analysis import load_config  # noqa: E402
+from paddle_tpu.analysis.proto import (  # noqa: E402
+    PROTO_FIXTURES, render_proto_json, render_proto_text,
+    replay_schedule, run_fixtures)
+from paddle_tpu.analysis.proto.sched import ReplayDivergence  # noqa: E402
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="ptcheck", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--root", default=_REPO,
+                    help="repo root (default: the tools/ parent)")
+    ap.add_argument("--check", action="store_true",
+                    help="explore + judge every fixture (the default)")
+    ap.add_argument("--fixtures", default=None,
+                    help="comma-separated subset of registered "
+                         "fixtures")
+    ap.add_argument("--list", action="store_true",
+                    help="list registered fixtures and exit")
+    ap.add_argument("--mode", choices=("dfs", "walk"), default="dfs",
+                    help="dfs = bounded exhaustive exploration with "
+                         "state dedup; walk = seeded random walks "
+                         "(deeper schedules)")
+    ap.add_argument("--seed", type=int, default=0,
+                    help="random-walk seed (walk mode; findings "
+                         "replay from it)")
+    ap.add_argument("--walks", type=int, default=None,
+                    help="random walks per fixture (walk mode)")
+    ap.add_argument("--max-schedules", type=int, default=None,
+                    help="DFS schedule budget override per fixture")
+    ap.add_argument("--wall-s", type=float, default=None,
+                    help="per-fixture wall budget override (seconds)")
+    ap.add_argument("--replay", default=None, metavar="FIX:SCHEDULE",
+                    help="re-run one schedule token string exactly "
+                         "and judge it")
+    ap.add_argument("--json", action="store_true",
+                    help="JSON report on stdout instead of text")
+    ap.add_argument("--out", default=None,
+                    help="also write the JSON report to this path")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        for name in sorted(PROTO_FIXTURES):
+            fixture = PROTO_FIXTURES[name]
+            mark = "expect-finding " if fixture.expect_finding else ""
+            print("%-16s %s%s" % (name, mark, fixture.doc))
+        return 0
+
+    if args.replay:
+        name, _, schedule = args.replay.partition(":")
+        if name not in PROTO_FIXTURES:
+            ap.error("unknown fixture %r (have: %s)"
+                     % (name, ",".join(sorted(PROTO_FIXTURES))))
+        try:
+            result, findings = replay_schedule(PROTO_FIXTURES[name],
+                                               schedule)
+        except ReplayDivergence as e:
+            print("ptcheck: replay diverged: %s" % e)
+            return 2
+        payload = {
+            "kind": "ptcheck_replay", "fixture": name,
+            "schedule": result.schedule_str,
+            "tasks": {t: {"status": row["status"],
+                          "error": repr(row["error"])
+                          if row["error"] else None}
+                      for t, row in sorted(result.tasks.items())},
+            "events": result.events,
+            "log": [repr(ev) for ev in result.log],
+            "findings": [f.to_dict() for f in findings],
+        }
+        if args.json or args.out:
+            if args.out:
+                with open(args.out, "w", encoding="utf-8") as f:
+                    json.dump(payload, f, indent=1, default=str)
+                    f.write("\n")
+            if args.json:
+                json.dump(payload, sys.stdout, indent=1, default=str)
+                sys.stdout.write("\n")
+        else:
+            print("replayed %s (%d transitions)"
+                  % (name, len(result.schedule)))
+            for t, row in sorted(result.tasks.items()):
+                print("  task %-10s %s%s"
+                      % (t, row["status"],
+                         " error=%r" % row["error"]
+                         if row["error"] else ""))
+            for kind, detail in result.events:
+                print("  event %-9s %s" % (kind, json.dumps(
+                    detail, sort_keys=True, default=str)))
+            for f in findings:
+                print("  FINDING %s: %s" % (f.prop, f.message))
+        return 1 if findings else 0
+
+    fixtures = None
+    if args.fixtures:
+        fixtures = [f.strip() for f in args.fixtures.split(",")
+                    if f.strip()]
+        unknown = [f for f in fixtures if f not in PROTO_FIXTURES]
+        if unknown:
+            ap.error("unknown fixture(s) %s (have: %s)"
+                     % (unknown, ",".join(sorted(PROTO_FIXTURES))))
+
+    config = dict(load_config(os.path.abspath(args.root))
+                  .get("proto", {}))
+    if args.max_schedules is not None:
+        config["max_schedules"] = args.max_schedules
+    if args.walks is not None:
+        config["walks"] = args.walks
+    if args.wall_s is not None:
+        config["wall_s"] = args.wall_s
+
+    report, findings = run_fixtures(
+        PROTO_FIXTURES, names=fixtures, mode=args.mode,
+        seed=args.seed, config=config)
+    report = render_proto_json(report, meta={
+        "root": os.path.abspath(args.root),
+        "fixtures": fixtures or sorted(PROTO_FIXTURES),
+        "config": config})
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(report, f, indent=1, sort_keys=True,
+                      default=str)
+            f.write("\n")
+    if args.json:
+        json.dump(report, sys.stdout, indent=1, sort_keys=True,
+                  default=str)
+        sys.stdout.write("\n")
+    else:
+        print(render_proto_text(report))
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

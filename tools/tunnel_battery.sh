@@ -43,6 +43,16 @@ run ptlint 120 python tools/ptlint.py --out tools/ptlint_report.json
 #     collective/donation columns.
 run pthlo 600 python tools/pthlo.py --check --out tools/graph_report.json
 
+# 0c. protocol analysis: ptcheck DFS-explores the store/election/
+#     barrier plane (real protocol code over an in-process SimStore on
+#     a virtual clock) — host-only like the ptlint/pthlo rows, no
+#     accelerator, no sockets, no real waiting. rc!=0 means a live
+#     fixture produced a finding (the JSON carries a replayable
+#     schedule string: `python tools/ptcheck.py --replay ...`) OR an
+#     expected-finding regression fixture came back clean (the checker
+#     lost the power its zeros rely on).
+run ptcheck 300 python tools/ptcheck.py --out tools/ptcheck_report.json
+
 # 0. pre-flight: bail fast if the tunnel is actually wedged
 run probe 240 python bench.py --probe || { echo "tunnel wedged; abort"; exit 3; }
 
