@@ -602,3 +602,214 @@ class BundleFixture(ProtoFixture):
         # absence of blocked states
         out += self._liveness(result, "bundle-liveness", hangs=False)
         return out
+
+
+# -- serving-fleet router membership -----------------------------------------
+
+class _RouterScenarioMixin:
+    """The serving-fleet register/renew/evict/dispatch protocol
+    (serving/fleet/membership.py module functions, unmodified) under
+    crash + lost-ack interleavings, judged by three properties:
+
+    - ``register-exact``: one registration claims exactly one
+      generation — the final generation counter never exceeds the
+      attempted registrations and no client ever observes a phantom
+      generation (the retried-register-without-nonce double-register).
+    - ``dispatch-evicted``: the router never dispatches (or re-routes)
+      a request to a replica after evicting it.
+    - ``request-lost``: at the router's final pump every accepted
+      request is either dispatched to a non-evicted replica or still
+      queued; an assignment left on an evicted replica while live
+      candidates existed is a lost request.
+    """
+
+    ttl = 2.0
+
+    def _replica_task(self, scenario, rank, renews=2):
+        from ...serving.fleet import membership
+
+        client = scenario.client("r%d" % rank)
+        log = scenario.log
+
+        def fn():
+            log.append(("register_attempt", rank))
+            gen = membership.register_replica(
+                client, rank, "sim://r%d" % rank)
+            log.append(("registered", rank, gen))
+            for _ in range(renews):
+                membership.renew_lease(client, rank)
+
+        return fn
+
+    def _router_task(self, scenario, world_size=2, pumps=4, reqs=2):
+        from ...serving.fleet import membership
+
+        client = scenario.client("router")
+        sched = scenario.sched
+        log = scenario.log
+        view = membership.ReplicaView(
+            client, world_size, ttl_s=self.ttl,
+            clock=lambda: sched.clock.now)
+
+        def fn():
+            assigned = {}           # request -> rank
+            queued = ["q%d" % i for i in range(reqs)]
+            evicted = set()
+            candidates = []
+            for _ in range(pumps):
+                alive = set(view.alive())
+                dead = [r for r in range(world_size)
+                        if r not in alive]
+                for r in dead:
+                    # evict only ranks that actually registered: a
+                    # never-seen rank has no lease to revoke and can
+                    # hold no work or affinity entries
+                    if r not in evicted and (client.counter_get(
+                            membership.gen_key(r), default=0) or 0) > 0:
+                        evicted.add(r)
+                        membership.evict_replica(client, r)
+                        log.append(("evict", r))
+                # reroute before dispatch: work assigned to a replica
+                # evicted this pump goes back in the queue
+                for q, r in sorted(assigned.items()):
+                    if r in evicted:
+                        del assigned[q]
+                        queued.append(q)
+                        log.append(("reroute", q, r))
+                candidates = sorted(alive - evicted)
+                still = []
+                for q in queued:
+                    rank, _ = membership.pick_replica(candidates)
+                    if rank is None:
+                        still.append(q)
+                    else:
+                        assigned[q] = rank
+                        log.append(("dispatch", q, rank))
+                queued = still
+            log.append(("final",
+                        tuple(sorted(assigned.items())),
+                        tuple(sorted(queued)),
+                        tuple(sorted(evicted)),
+                        tuple(candidates)))
+
+        return fn
+
+    def _membership_verdict(self, result, world_size=2):
+        from ...serving.fleet import membership
+
+        out = []
+        attempts = {}
+        for ev in result.log:
+            if ev[0] == "register_attempt":
+                attempts[ev[1]] = attempts.get(ev[1], 0) + 1
+        for rank in range(world_size):
+            gk = membership.gen_key(rank)
+            n = attempts.get(rank, 0)
+            final = result.store.counters.get(gk, 0)
+            if final > n:
+                out.append(("register-exact",
+                            "rank %d attempted %d registration(s) but "
+                            "the generation counter reads %d — a "
+                            "retried register burned a generation "
+                            "(double-register)" % (rank, n, final)))
+            for _, seen in result.store.observed_adds(gk):
+                if seen > n:
+                    out.append(("register-exact",
+                                "rank %d observed generation %d from "
+                                "%d attempted registration(s) — the "
+                                "client saw a phantom prior "
+                                "incarnation" % (rank, seen, n)))
+        evicted = set()
+        for ev in result.log:
+            if ev[0] == "evict":
+                evicted.add(ev[1])
+            elif ev[0] == "dispatch" and ev[2] in evicted:
+                # a "reroute" names the rank the work LEFT — only a
+                # dispatch TO an evicted rank violates the discipline
+                out.append(("dispatch-evicted",
+                            "request %r dispatched to rank %d AFTER "
+                            "its eviction" % (ev[1], ev[2])))
+        finals = [ev for ev in result.log if ev[0] == "final"]
+        if finals:
+            _, assigned, queued, evicted_final, candidates = finals[-1]
+            reqs = {q for q, _ in assigned} | set(queued)
+            expected = {"q0", "q1"}
+            missing = expected - reqs
+            if missing:
+                out.append(("request-lost",
+                            "accepted request(s) %s neither assigned "
+                            "nor queued at the final pump"
+                            % sorted(missing)))
+            for q, r in assigned:
+                if r in evicted_final and candidates:
+                    out.append(("request-lost",
+                                "request %r left assigned to evicted "
+                                "rank %d while live candidates %s "
+                                "existed" % (q, r, list(candidates))))
+        out += self._liveness(result, "router-liveness")
+        out += self._clean_failures(result, "router-clean-failure")
+        return out
+
+
+@register
+class RouterMembershipFixture(_RouterScenarioMixin, ProtoFixture):
+    """The SHIPPED fleet membership + dispatch discipline: replicas
+    register/renew over the nonce-idempotent store, a router evicts on
+    the elastic TTL view (the REAL ``ReplicaView`` math on the virtual
+    clock), reroutes before dispatch, and never loses an accepted
+    request — explored against a replica crash, a lost ack, and TTL
+    time passing."""
+
+    name = "router_membership"
+    doc = ("serving-fleet membership/dispatch: register claims exactly "
+           "one generation, no dispatch to an evicted replica, no "
+           "accepted request lost; explored with crash + lost ack + "
+           "TTL ticks")
+    max_schedules = 400
+    max_steps = 300
+
+    def build(self):
+        scenario = Scenario(SimStore(), max_crashes=1, max_lost_acks=1)
+        sched = scenario.sched
+
+        def ticker():
+            for _ in range(2):
+                sched.tick(1.25)
+
+        scenario.task("r0", self._replica_task(scenario, 0),
+                      crashable=True)
+        scenario.task("r1", self._replica_task(scenario, 1),
+                      crashable=True)
+        scenario.task("router", self._router_task(scenario))
+        scenario.task("ticker", ticker)
+        return scenario
+
+    def verdict(self, result):
+        return self._membership_verdict(result)
+
+
+@register
+class RouterRegisterLegacyFixture(_RouterScenarioMixin, ProtoFixture):
+    """HISTORICAL BUG: registration retried over a NON-idempotent add
+    (no request nonce) burns a generation per retry — a lost ack
+    double-registers the replica, so its record claims a phantom prior
+    incarnation and every peer's generation-fencing is off by one. The
+    checker must find the ``register-exact`` violation within budget."""
+
+    name = "router_register_legacy"
+    doc = ("HISTORICAL BUG (non-idempotent retried register): a lost "
+           "ack burns a generation, the record claims a phantom prior "
+           "incarnation — the checker must find it")
+    expect_finding = True
+    expected_props = ("register-exact",)
+    max_schedules = 150
+    max_steps = 80
+
+    def build(self):
+        scenario = Scenario(SimStore(idempotent_add=False),
+                            max_lost_acks=1)
+        scenario.task("r0", self._replica_task(scenario, 0, renews=1))
+        return scenario
+
+    def verdict(self, result):
+        return self._membership_verdict(result, world_size=1)

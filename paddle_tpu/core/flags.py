@@ -161,6 +161,21 @@ _DEFAULTS = {
     # Latched at Engine construction; chunk size is the Engine's
     # prefill_chunk argument.
     "FLAGS_serving_chunked_prefill": False,
+    # serving fleet plane (serving/fleet/): N data-parallel engine
+    # replicas announce themselves in the TCPStore under
+    # __sfleet/replica/{r} (endpoint + generation + capability
+    # snapshot), renew a liveness lease on the elastic TTL machinery,
+    # and a router (serving/fleet/router.py, tools/serving_router.py)
+    # dispatches admitted requests over HTTP: prefix-affinity first
+    # (router-side radix index over block_size token chunks), least
+    # loaded as tie-break, nonce-idempotent bounded retry-with-reroute,
+    # healthz-driven drain-and-reschedule, dead-lease evict +
+    # affinity invalidation. Off = Replica/Router refuse to construct:
+    # no lease/serve/router threads, no __sfleet store traffic, no
+    # router_* series, and the /debugz/router routes report disabled
+    # (test-pinned, the PR-2/5/6 discipline). Latched at Replica/
+    # Router construction.
+    "FLAGS_serving_fleet": False,
     # deterministic fault injection (paddle_tpu/resilience/faultinject).
     # Off = every injection site (store ops, eager collectives, serving
     # engine step, compiled train step) is one attribute load + branch:

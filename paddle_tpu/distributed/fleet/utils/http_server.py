@@ -65,6 +65,29 @@ class KVHandler(http.server.BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(value)
 
+    def do_POST(self):
+        # pluggable POST routes (serving/fleet: the router dispatches
+        # request bodies to replica /sfleet/enqueue here) — the handler
+        # receives the raw body and returns (code, ctype, body). No KV
+        # fallback: an unregistered POST path is a 404, never a write.
+        path = self.path.strip("/")
+        route = self.server.post_routes.get(path)
+        if route is None:
+            self.send_status_code(404)
+            return
+        length = int(self.headers.get("Content-Length", 0))
+        payload = self.rfile.read(length)
+        try:
+            code, ctype, body = route(payload)
+        except Exception:
+            self.send_status_code(500)
+            return
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def do_PUT(self):
         scope, key = self._split()
         if scope is None:
@@ -105,6 +128,9 @@ class KVHTTPServer(http.server.ThreadingHTTPServer):
         # prefix -> (rest: str) -> (code, ctype, bytes) — parametric
         # GET routes (monitor/exporter.py: /debugz/trace/{id})
         self.get_prefix_routes = {}
+        # path -> (body: bytes) -> (code, ctype, bytes) — POST routes
+        # (serving/fleet replica enqueue / router submit)
+        self.post_routes = {}
 
     def get_deleted_size(self, key):
         with self.kv_lock:

@@ -45,6 +45,14 @@ server stack: ``KVHTTPServer`` gained a ``get_routes`` hook, and
     GET /debugz/resilience  fault-injection state + recovery/shed
                         counters + watchdog escalation mode
                         (paddle_tpu/resilience payload)
+    GET /debugz/router  serving-fleet router summary: replica states
+                        (live/draining/evicted), request-outcome
+                        counts, affinity-index stats (served via the
+                        monitor/fleet.py router hook; reports disabled
+                        when FLAGS_serving_fleet is off)
+    GET /debugz/router/replicas  the router's per-replica table (url,
+                        generation, state, load, queue depth, per-
+                        replica dispatch/affinity counts)
 
 The /healthz and /debugz routes are served live from monitor/watchdog.py
 whether or not the watchdog thread is running (the verdict just reads
@@ -130,6 +138,8 @@ class MetricsServer:
         routes["debugz/fleet"] = self._fleet
         routes["debugz/fleet/ranks"] = self._fleet_ranks
         routes["metrics/fleet"] = self._fleet_prometheus
+        routes["debugz/router"] = self._router
+        routes["debugz/router/replicas"] = self._router_replicas
         self._kv.http_server.get_prefix_routes["debugz/trace"] = \
             self._trace_by_id
 
@@ -143,6 +153,20 @@ class MetricsServer:
 
     def stop(self):
         self._kv.stop()
+
+    # -- route registration (serving/fleet rides the same server) ------
+
+    def add_route(self, path, fn):
+        """Register a GET route: ``fn() -> (code, ctype, body)``."""
+        self._kv.http_server.get_routes[path.strip("/")] = fn
+
+    def add_prefix_route(self, prefix, fn):
+        """Register a parametric GET route: ``fn(rest) -> ...``."""
+        self._kv.http_server.get_prefix_routes[prefix.strip("/")] = fn
+
+    def add_post_route(self, path, fn):
+        """Register a POST route: ``fn(body) -> (code, ctype, body)``."""
+        self._kv.http_server.post_routes[path.strip("/")] = fn
 
     def _prometheus(self):
         body = self._registry.prometheus_text().encode()
@@ -203,6 +227,20 @@ class MetricsServer:
     def _fleet_prometheus(self):
         body = _fleet.prometheus_fleet_text().encode()
         return 200, "text/plain; version=0.0.4; charset=utf-8", body
+
+    def _router(self):
+        # serving-fleet router summary: served via monitor/fleet.py's
+        # duck-typed hook slot so the monitor plane never imports the
+        # serving package (flag off / no router = pinned disabled body)
+        body = json.dumps(_watchdog.json_safe(_fleet.router_payload()),
+                          default=str).encode()
+        return 200, "application/json", body
+
+    def _router_replicas(self):
+        body = json.dumps(
+            _watchdog.json_safe(_fleet.router_replicas_payload()),
+            default=str).encode()
+        return 200, "application/json", body
 
     def _resilience(self):
         # lazy: paddle_tpu.resilience imports back into monitor — the

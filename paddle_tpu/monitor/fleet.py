@@ -511,11 +511,23 @@ class FleetCollector:
                 profile = prof
         except (OSError, ValueError, http.client.HTTPException):
             pass
+        # serving-fleet router (best-effort, same contract): a rank
+        # hosting a router reports replica/affinity columns so ONE pane
+        # shows the training fleet and the serving fleet; every other
+        # rank (or a pre-router build) just has empty columns
+        router = None
+        try:
+            rt, _, _, _ = _http_json(url + "/debugz/router",
+                                     self.http_timeout_s)
+            if isinstance(rt, dict) and rt.get("router"):
+                router = rt["router"]
+        except (OSError, ValueError, http.client.HTTPException):
+            pass
         return {"metrics": snap.get("metrics") or {},
                 "snapshot_time": snap.get("unix_time"),
                 "perf": perf, "healthz": healthz,
                 "flight_seq": flight_seq, "memory": memory,
-                "profile": profile,
+                "profile": profile, "router": router,
                 "rtt_s": rtt, "clock_offset_s": offset,
                 "scraped_at": time.monotonic()}
 
@@ -643,6 +655,17 @@ class FleetCollector:
         st["profile_top_component"] = max(
             comps, key=lambda c: comps[c].get("share", 0)) if comps \
             else None
+        # serving-fleet router columns (/debugz/router, best-effort):
+        # live replica count + affinity hit rate for a rank hosting a
+        # router — None everywhere else (the fleet_top REPLICAS /
+        # AFFIN% columns)
+        rt = scraped.get("router") or {}
+        reps = rt.get("replicas") or {}
+        st["router_replicas"] = reps.get("live") \
+            if isinstance(reps.get("live"), int) else None
+        aff = rt.get("affinity") or {}
+        st["router_affinity_hit_rate"] = aff.get("hit_rate") \
+            if isinstance(aff.get("hit_rate"), (int, float)) else None
         # anomaly watermark: total sentinel firings this rank reports
         anomalies = (scraped["perf"] or {}).get("anomalies") or {}
         st["anomalies_total"] = sum(
@@ -973,6 +996,7 @@ class FleetCollector:
                 "serving_goodput_tokens_per_s", "heartbeat_age_s",
                 "healthz", "degraded", "anomalies_total",
                 "anomaly_kinds", "straggler", "slow_hits",
+                "router_replicas", "router_affinity_hit_rate",
                 "clock_offset_s", "rtt_s")})
             rows[-1]["scrape_age_s"] = (
                 round(now - st["scraped_at"], 3)
@@ -1164,6 +1188,56 @@ def prometheus_fleet_text():
                 "(FLAGS_monitor_fleet=%s)\n" % ("on" if is_enabled()
                                                 else "off"))
     return c.prometheus_text()
+
+
+# -- serving-fleet router hook (the /debugz/router routes) -------------------
+#
+# serving/fleet/router.py sets this slot when a Router starts on this
+# process; the monitor plane never imports the serving package (the
+# hook is duck-typed: any object with debug_payload() /
+# replicas_debug_payload()). With FLAGS_serving_fleet off the slot
+# stays None and the routes report the pinned disabled body —
+# no serving import, no store traffic (test-pinned).
+
+_router_hook = None
+
+
+def set_router_hook(router):
+    global _router_hook
+    _router_hook = router
+
+
+def clear_router_hook():
+    global _router_hook
+    _router_hook = None
+
+
+def _sfleet_enabled():
+    return _flag("FLAGS_serving_fleet")
+
+
+def router_payload():
+    """The /debugz/router body."""
+    if not _sfleet_enabled():
+        return {"enabled": False, "router": None}
+    r = _router_hook
+    if r is None:
+        return {"enabled": True, "router": None,
+                "time": time.time()}
+    return {"enabled": True, "router": r.debug_payload(),
+            "time": time.time()}
+
+
+def router_replicas_payload():
+    """The /debugz/router/replicas body."""
+    if not _sfleet_enabled():
+        return {"enabled": False, "replicas": []}
+    r = _router_hook
+    if r is None:
+        return {"enabled": True, "replicas": [],
+                "time": time.time()}
+    return {"enabled": True, "replicas": r.replicas_debug_payload(),
+            "time": time.time()}
 
 
 # -- fleet snapshot artifact (bench.py staleness discipline) ------------------

@@ -1,0 +1,36 @@
+"""Serving fleet: replicated engines + prefix-affinity router.
+
+Three pieces (ISSUE-16):
+
+- ``membership``: the register/renew/evict/drain protocol as pure
+  functions over an injected store (TCPStore in production, SimStore
+  under the ptcheck ``router_membership`` fixture), plus the
+  ``ReplicaView`` liveness watcher (the elastic TTL lease, reused)
+  and the pure ``pick_replica`` dispatch choice.
+- ``Replica``: one engine behind the fleet HTTP protocol —
+  nonce-idempotent enqueue, result polling, load signals, lease
+  heartbeat (``replica.py``).
+- ``Router``: admission -> dispatch with a prefix-affinity radix
+  index, least-loaded tie-break, bounded retry-with-reroute,
+  healthz-driven drain-and-reschedule, dead-lease eviction
+  (``router.py``; hosted by ``tools/serving_router.py``).
+
+Prefill/decode disaggregation is OUT of scope: the capability
+snapshot's ``disaggregation`` field is the seam (membership.py).
+Everything here is gated on ``FLAGS_serving_fleet`` (default off).
+"""
+from __future__ import annotations
+
+from . import membership
+from .membership import ReplicaView, pick_replica
+from .replica import Replica
+from .router import AffinityIndex, Router
+
+__all__ = [
+    "membership",
+    "ReplicaView",
+    "pick_replica",
+    "Replica",
+    "AffinityIndex",
+    "Router",
+]

@@ -1,0 +1,196 @@
+"""Serving-fleet replica membership: pure protocol over an injected store.
+
+The replica register/renew/evict/drain state machine, extracted into
+module functions that take the store as an argument (the
+``resilience/protocol.py`` discipline) so the SAME code runs in
+production (over ``TCPStore``) and under ptcheck (over ``SimStore`` —
+the ``router_membership`` fixture explores crash/lost-ack
+interleavings of exactly these functions). This module is in the
+ptlint ``store`` pass jurisdiction: it never constructs a store and
+never holds a lock across a blocking store op.
+
+Store namespace (all under ``__sfleet``):
+
+- ``__sfleet/gen/{r}``      registration-generation counter. Claimed
+                            with the nonce-idempotent ``add`` so a
+                            RETRIED register (lost ack) never burns a
+                            generation — the historical double-register
+                            bug the ``router_register_legacy`` fixture
+                            must re-find.
+- ``__sfleet/replica/{r}``  JSON record: endpoint URL + generation +
+                            capability snapshot (the ``disaggregation``
+                            field is the explicit seam for streaming KV
+                            pages between prefill/decode replicas — out
+                            of scope for this layer, carried so the
+                            router can route on it later).
+- ``__sfleet/beat/{r}``     liveness lease: an incrementing beat
+                            counter renewed by the replica and aged by
+                            each watcher ON ITS OWN CLOCK (clocks are
+                            not comparable across hosts — the
+                            ElasticManager TTL machinery, reused here
+                            verbatim). ``deregister`` deletes it:
+                            immediate death, no TTL wait.
+- ``__sfleet/drain/{r}``    drain marker (counter > 0 = draining): a
+                            router that observed 503/stall publishes
+                            the verdict so every router stops sending
+                            new work, not just the one that saw it.
+"""
+from __future__ import annotations
+
+import json
+
+from ...distributed.elastic import ElasticManager
+
+PREFIX = "__sfleet"
+
+#: Default capability snapshot. ``disaggregation`` is the seam for
+#: prefill/decode disaggregation (KV pages streamed via the store) —
+#: explicitly out of scope here; a replica that implements it will
+#: announce it and the router can begin routing on the split.
+DEFAULT_CAPABILITIES = {"prefill": True, "decode": True,
+                        "disaggregation": False}
+
+
+def gen_key(rank):
+    return "%s/gen/%d" % (PREFIX, rank)
+
+
+def replica_key(rank):
+    return "%s/replica/%d" % (PREFIX, rank)
+
+
+def beat_key(rank):
+    return "%s/beat/%d" % (PREFIX, rank)
+
+
+def drain_key(rank):
+    return "%s/drain/%d" % (PREFIX, rank)
+
+
+def register_replica(store, rank, url, capabilities=None, meta=None):
+    """Announce one replica; returns its registration generation.
+
+    The generation is claimed via the nonce-idempotent ``add``: a
+    retried register after a lost ack observes the SAME generation, so
+    the record can never claim a phantom prior incarnation. The beat
+    counter starts at >= 1 (``register() starts every live rank at
+    count >= 1`` — the ElasticManager contract ``alive_nodes`` ages)."""
+    generation = store.add(gen_key(rank), 1)
+    record = {"rank": int(rank), "url": url,
+              "generation": int(generation),
+              "capabilities": dict(capabilities
+                                   if capabilities is not None
+                                   else DEFAULT_CAPABILITIES)}
+    if meta:
+        record.update(meta)
+    store.set(replica_key(rank), json.dumps(
+        record, sort_keys=True).encode())
+    store.add(beat_key(rank), 1)
+    return generation
+
+
+def renew_lease(store, rank):
+    """One lease renewal (the replica's heartbeat thread body)."""
+    return store.add(beat_key(rank), 1)
+
+
+def deregister_replica(store, rank):
+    """Graceful exit: deleting the beat counter is immediate death for
+    every watcher (no TTL wait) — the ``ElasticManager.exit`` shape."""
+    store.delete(beat_key(rank))
+
+
+def evict_replica(store, rank):
+    """Router-side eviction of a dead-leased replica: same store effect
+    as a graceful deregister (the beat counter disappears, so every
+    OTHER router's view converges without waiting out its own TTL).
+    The caller also invalidates its affinity entries for the rank."""
+    store.delete(beat_key(rank))
+
+
+def mark_draining(store, rank):
+    """Publish the drain verdict (healthz 503/stalled): counter > 0
+    means every router stops dispatching new work to the rank."""
+    return store.add(drain_key(rank), 1)
+
+
+def clear_draining(store, rank):
+    """Lift the drain marker (a replica re-registering after recovery)."""
+    store.delete(drain_key(rank))
+
+
+def is_draining(store, rank):
+    return (store.counter_get(drain_key(rank), default=0) or 0) > 0
+
+
+def read_replica(store, rank, timeout_s=0.05):
+    """The announced record, or None (never registered / not yet
+    visible). Non-blocking-ish: the short timeout bounds the wait."""
+    raw = store.get(replica_key(rank), timeout_s=timeout_s)
+    if raw is None:
+        return None
+    try:
+        return json.loads(bytes(raw).decode())
+    except (ValueError, UnicodeDecodeError):
+        return None
+
+
+class ReplicaView:
+    """A router's watcher-local liveness view over the beat counters.
+
+    Wraps the ElasticManager TTL machinery (counter-advancement timed
+    on THIS watcher's clock; deleted counter = immediately dead) rather
+    than re-deriving it — the fleet lease is the elastic lease with a
+    different key prefix. The view never registers or beats: a router
+    is not a member."""
+
+    def __init__(self, store, world_size, ttl_s=3.0, clock=None):
+        self._store = store
+        self._manager = ElasticManager(
+            store=store, job_id=PREFIX, rank=0, np=int(world_size),
+            ttl=ttl_s, clock=clock)
+
+    @property
+    def world_size(self):
+        return self._manager.np
+
+    def alive(self):
+        """Ranks whose lease is live (beat advanced within ttl on this
+        watcher's clock)."""
+        return self._manager.alive_nodes()
+
+    def dead(self):
+        """Ranks whose lease lapsed (aged out) or was deleted
+        (deregistered/evicted). Never-registered ranks count as dead."""
+        return self._manager.dead_nodes()
+
+    def draining(self):
+        """Ranks carrying a published drain marker."""
+        return [r for r in self._manager.members
+                if is_draining(self._store, r)]
+
+    def record(self, rank):
+        return read_replica(self._store, rank)
+
+
+def pick_replica(candidates, load=None, affinity=None):
+    """Pure dispatch choice: prefix-affinity first, least-loaded as the
+    tie-break. Returns ``(rank, used_affinity)`` — ``(None, False)``
+    when no candidate is dispatchable.
+
+    ``candidates``: live, non-draining, non-evicted ranks.
+    ``affinity``:   {rank: matched prefix chunks} from the router's
+                    radix index (0 or absent = no shared prefix).
+    ``load``:       {rank: load score} (occupancy + normalized queue
+                    depth from the scraped gauges); lower is better.
+    """
+    ranks = sorted(set(candidates))
+    if not ranks:
+        return None, False
+    affinity = affinity or {}
+    load = load or {}
+    best = max((affinity.get(r, 0) for r in ranks), default=0)
+    used_affinity = best > 0
+    if used_affinity:
+        ranks = [r for r in ranks if affinity.get(r, 0) == best]
+    return min(ranks, key=lambda r: (load.get(r, 0.0), r)), used_affinity

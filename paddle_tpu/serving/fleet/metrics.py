@@ -1,0 +1,42 @@
+"""Router metrics: the ``router_*`` family on the shared registry.
+
+Registered at import (idempotent, the serving/metrics.py idiom) but
+series-free until first touch — with ``FLAGS_serving_fleet`` off no
+router exists, nothing increments, and the registry snapshot carries
+no ``router_*`` series (test-pinned). All four are documented in the
+README metrics catalog (the metric pass's machine-checked contract).
+
+``router_requests_total{outcome}`` outcomes:
+
+  accepted    request admitted by the router (a nonce exists; the
+              never-lose-an-accepted-request property counts these)
+  dispatched  enqueued on a replica (first placement)
+  rerouted    re-dispatched after a failed dispatch / drain / evict
+              (same nonce — the replica-side dedup makes this safe)
+  finished    terminal success observed
+  failed      terminal non-success observed (expired/shed/poisoned on
+              the replica — the router reports, it does not retry a
+              request the replica terminated)
+  unroutable  no dispatchable replica after the bounded retry walk;
+              the request STAYS queued router-side (not lost) and the
+              next pump retries it
+"""
+from __future__ import annotations
+
+from ...monitor import counter as _mcounter
+from ...monitor import histogram as _mhistogram
+
+REQUESTS = _mcounter(
+    "router_requests_total",
+    "router request lifecycle events", labelnames=("outcome",))
+AFFINITY_HITS = _mcounter(
+    "router_affinity_hits_total",
+    "dispatches placed by the prefix-affinity radix index "
+    "(vs pure least-loaded)")
+EVICTIONS = _mcounter(
+    "router_replica_evictions_total",
+    "replicas evicted on a dead lease (affinity entries invalidated)")
+DISPATCH_SECONDS = _mhistogram(
+    "router_dispatch_seconds",
+    "admission -> accepted-by-a-replica latency, including the "
+    "bounded retry-with-reroute walk")

@@ -1,0 +1,613 @@
+"""Fleet router: admission -> dispatch with prefix affinity + drain/evict.
+
+The standalone dispatch process in front of N engine replicas
+(``tools/serving_router.py`` hosts it; the fleet benchmark drives it
+in-process). One router turn (``pump()``):
+
+1. **Membership** — refresh the ``membership.ReplicaView`` (the
+   elastic TTL lease over ``__sfleet/beat/{r}``): a newly-live rank's
+   announced record is adopted; a dead lease EVICTS the replica
+   (``router_replica_evictions_total`` + affinity invalidation +
+   ``membership.evict_replica`` so every other router converges
+   without waiting out its own TTL); a re-registration with a newer
+   generation revives an evicted rank.
+2. **Load + health** — scrape each live replica's ``/sfleet/load``
+   (kv-page occupancy + queue depth, the gauges' values served by the
+   replica) and ``/healthz``; a 503/stalled verdict or repeated scrape
+   failure marks the replica DRAINING: it gets no new work and its
+   queued-but-unstarted requests re-route. Draining is published via
+   ``membership.mark_draining`` so peer routers agree.
+3. **Dispatch** — prefix-affinity first: a router-side radix index
+   over block_size token chunks (the SAME chunking as
+   ``prefix_cache.py``) maps prompt prefixes to the replicas that
+   served them, so shared-prefix requests land where their KV pages
+   are already cached; least-loaded (occupancy + queue depth) breaks
+   ties. A failed dispatch walks the next candidate, bounded by
+   ``max_retries`` — idempotent, because every request carries a
+   router-minted nonce and the replica dedups on it (a retried
+   request is never double-admitted).
+4. **Progress** — poll dispatched requests' ``/sfleet/result/{nonce}``;
+   first observed output token stamps TTFT; terminal states count into
+   ``router_requests_total{finished|failed}``.
+
+Never-lose-an-accepted-request: a request that got a nonce is terminal
+(finished/failed-by-the-replica) or still queued/dispatched somewhere
+— eviction, drain and dispatch failure all re-route, never drop (the
+ptcheck ``router_membership`` fixture explores exactly this against
+crash/lost-ack interleavings of the membership half).
+"""
+from __future__ import annotations
+
+import http.client
+import itertools
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+from ...core import flags as _flags
+from ...monitor import fleet as _mfleet
+from ...monitor.registry import warn_once
+from . import membership
+from .metrics import AFFINITY_HITS, DISPATCH_SECONDS, EVICTIONS, REQUESTS
+
+_ROUTER_THREAD = "pt-sfleet-router"
+
+# terminal replica-side request states (engine RequestState values):
+# the router reports these, it never retries a request the replica
+# terminated on purpose
+_REPLICA_TERMINAL_OK = ("finished",)
+_REPLICA_TERMINAL_BAD = ("expired", "shed", "failed")
+_SCRAPE_ERRORS = (OSError, ValueError, http.client.HTTPException)
+
+
+def _require_flag(what):
+    if not _flags.flag("FLAGS_serving_fleet"):
+        raise RuntimeError(
+            "%s requires FLAGS_serving_fleet=true (the serving-fleet "
+            "plane is default-off; set it BEFORE construction — the "
+            "flag is latched, the PR-9 convention)" % what)
+
+
+def _http_get_json(url, timeout_s):
+    """(status, payload) — HTTP error codes with a JSON body still
+    parse (healthz 503, result 404); transport errors raise."""
+    try:
+        with urllib.request.urlopen(url, timeout=timeout_s) as r:
+            return r.status, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        body = e.read()
+        try:
+            return e.code, json.loads(body.decode())
+        except ValueError:
+            return e.code, {}
+
+
+def _http_post_json(url, payload, timeout_s):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as r:
+            return r.status, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        body = e.read()
+        try:
+            return e.code, json.loads(body.decode())
+        except ValueError:
+            return e.code, {}
+
+
+class AffinityIndex:
+    """Router-side radix index over block_size token chunks.
+
+    Same chunking as the engine's ``prefix_cache.py`` radix tree —
+    full chunks of ``tuple(tokens[i*bs:(i+1)*bs])`` over at most
+    ``len(tokens) - 1`` tokens (the cache never stores a prompt's last
+    token, so matching past it could not hit pages anyway) — but the
+    VALUES are replica ranks, not KV pages: the index remembers which
+    replicas served which prefixes, so a shared-prefix request is
+    dispatched to a replica whose radix cache is already warm.
+    Depth-capped; ``invalidate(rank)`` drops an evicted replica's
+    entries everywhere (its pages are gone with it)."""
+
+    def __init__(self, block_size=16, max_chunks=64):
+        self.block_size = int(block_size)
+        self.max_chunks = int(max_chunks)
+        self._root = {"children": {}, "ranks": set()}
+        self._nodes = 0
+
+    def _chunks(self, tokens):
+        bs = self.block_size
+        usable = max(len(tokens) - 1, 0)
+        n = min(usable // bs, self.max_chunks)
+        return [tuple(tokens[i * bs:(i + 1) * bs]) for i in range(n)]
+
+    def match(self, tokens):
+        """{rank: matched chunk depth} — the deepest node along the
+        prompt's chunk path that each rank appears on."""
+        out = {}
+        node = self._root
+        for depth, chunk in enumerate(self._chunks(tokens), start=1):
+            node = node["children"].get(chunk)
+            if node is None:
+                break
+            for rank in node["ranks"]:
+                out[rank] = depth
+        return out
+
+    def note(self, tokens, rank):
+        """Record that ``rank`` served a request with this prompt."""
+        node = self._root
+        for chunk in self._chunks(tokens):
+            nxt = node["children"].get(chunk)
+            if nxt is None:
+                nxt = node["children"][chunk] = {
+                    "children": {}, "ranks": set()}
+                self._nodes += 1
+            nxt["ranks"].add(rank)
+            node = nxt
+
+    def invalidate(self, rank):
+        """Drop every entry for an evicted replica, pruning emptied
+        subtrees (the dead replica's cached pages died with it)."""
+        def walk(node):
+            for chunk in list(node["children"]):
+                child = node["children"][chunk]
+                child["ranks"].discard(rank)
+                walk(child)
+                if not child["children"] and not child["ranks"]:
+                    del node["children"][chunk]
+                    self._nodes -= 1
+        walk(self._root)
+
+    def stats(self):
+        return {"block_size": self.block_size, "nodes": self._nodes,
+                "max_chunks": self.max_chunks}
+
+
+class Router:
+    """Admission -> dispatch over HTTP to the replica plane.
+
+    Store mode (production): ``store`` + ``world_size`` — membership,
+    records and drain markers ride the injected TCPStore client.
+    Static mode (tests): ``endpoints`` = {rank: url}, no store traffic;
+    drain/evict are driven purely by scrape results."""
+
+    def __init__(self, store=None, world_size=None, endpoints=None,
+                 block_size=16, ttl_s=3.0, http_timeout_s=2.0,
+                 max_retries=3, suspect_after=2, clock=None):
+        _require_flag("Router")
+        if store is None and not endpoints:
+            raise ValueError("Router needs store+world_size or "
+                             "explicit endpoints")
+        self._store = store
+        self._view = (membership.ReplicaView(
+            store, world_size, ttl_s=ttl_s, clock=clock)
+            if store is not None else None)
+        self._clock = clock if clock is not None else time.monotonic
+        self.http_timeout_s = float(http_timeout_s)
+        self.max_retries = int(max_retries)
+        self.suspect_after = int(suspect_after)
+        self.affinity = AffinityIndex(block_size)
+        self._lock = threading.Lock()
+        self._replicas = {}     # rank -> replica entry dict
+        self._requests = {}     # nonce -> request dict
+        self._order = []        # nonces in admission order
+        self._seq = itertools.count()
+        self._salt = os.urandom(4).hex()
+        self._stop = threading.Event()
+        self._thread = None
+        for rank, url in sorted((endpoints or {}).items()):
+            self._replicas[int(rank)] = self._entry(
+                int(rank), url, generation=0, capabilities=dict(
+                    membership.DEFAULT_CAPABILITIES))
+        _mfleet.set_router_hook(self)
+
+    @staticmethod
+    def _entry(rank, url, generation, capabilities):
+        url = (url or "").rstrip("/")
+        return {"rank": rank, "url": url, "generation": generation,
+                "capabilities": capabilities, "state": "live",
+                "occupancy": 0.0, "queue_depth": 0, "active_slots": 0,
+                "decode_compiles": None, "requests_finished": None,
+                "scrape_errors": 0, "dispatches": 0,
+                "affinity_hits": 0, "last_load_at": None}
+
+    # -- membership ------------------------------------------------------
+
+    def refresh_membership(self):
+        if self._view is None:
+            return
+        alive = set(self._view.alive())
+        dead = set(self._view.dead())
+        draining = set(self._view.draining())
+        for rank in sorted(alive):
+            ent = self._replicas.get(rank)
+            if ent is None or ent["state"] == "evicted":
+                rec = self._view.record(rank)
+                if not rec:
+                    continue
+                if ent is not None and \
+                        rec.get("generation", 0) <= ent["generation"]:
+                    continue    # the evicted incarnation, not a rejoin
+                self._replicas[rank] = self._entry(
+                    rank, rec.get("url"),
+                    rec.get("generation", 0),
+                    dict(rec.get("capabilities") or {}))
+            elif rank in draining:
+                ent["state"] = "draining"
+        for rank, ent in sorted(self._replicas.items()):
+            if ent["state"] != "evicted" and rank in dead:
+                self.evict(rank)
+
+    def evict(self, rank):
+        """Dead lease: no dispatch ever again (this incarnation), drop
+        its affinity entries, converge peers via the store."""
+        ent = self._replicas.get(rank)
+        if ent is None or ent["state"] == "evicted":
+            return
+        ent["state"] = "evicted"
+        self.affinity.invalidate(rank)
+        EVICTIONS.inc()
+        if self._store is not None:
+            membership.evict_replica(self._store, rank)
+
+    def drain(self, rank, reason="healthz"):
+        """503/stalled/unreachable: no NEW work; queued-but-unstarted
+        requests re-route on the next pump. Published to the store so
+        peer routers stop dispatching too."""
+        ent = self._replicas.get(rank)
+        if ent is None or ent["state"] in ("draining", "evicted"):
+            return
+        ent["state"] = "draining"
+        ent["drain_reason"] = reason
+        if self._store is not None:
+            membership.mark_draining(self._store, rank)
+
+    # -- load + health scrape --------------------------------------------
+
+    def scrape_loads(self):
+        for rank, ent in sorted(self._replicas.items()):
+            if ent["state"] == "evicted":
+                continue
+            try:
+                _, load = _http_get_json(
+                    ent["url"] + "/sfleet/load", self.http_timeout_s)
+                code, hz = _http_get_json(
+                    ent["url"] + "/healthz", self.http_timeout_s)
+            except _SCRAPE_ERRORS as e:
+                ent["scrape_errors"] += 1
+                warn_once(
+                    "sfleet.router.scrape.%d" % rank,
+                    "paddle_tpu.serving.fleet: load scrape of replica "
+                    "%d (%s) failed (%r) — draining it after %d "
+                    "consecutive failures" % (
+                        rank, ent["url"], e, self.suspect_after))
+                if ent["scrape_errors"] >= self.suspect_after:
+                    self.drain(rank, reason="unreachable")
+                continue
+            ent["scrape_errors"] = 0
+            ent["occupancy"] = float(load.get("occupancy") or 0.0)
+            ent["queue_depth"] = int(load.get("queue_depth") or 0)
+            ent["active_slots"] = int(load.get("active_slots") or 0)
+            ent["decode_compiles"] = load.get("decode_compiles")
+            ent["requests_finished"] = load.get("requests_finished")
+            ent["last_load_at"] = self._clock()
+            if load.get("draining"):
+                self.drain(rank, reason="engine_draining")
+            elif code == 503 or (hz or {}).get("status") == "stalled":
+                self.drain(rank, reason="healthz")
+            elif ent["state"] == "draining":
+                # drain recovery: the replica answers again, healthz is
+                # clean and its engine is not draining — a transient
+                # stall (first-step compile, GC pause, brief partition)
+                # must not permanently halve the fleet
+                ent["state"] = "live"
+                ent.pop("drain_reason", None)
+                if self._store is not None:
+                    membership.clear_draining(self._store, rank)
+
+    @staticmethod
+    def _load_score(ent):
+        # occupancy (0..1) + queue depth, normalized so one queued
+        # request outweighs a full pool only past ~16 waiting — the
+        # scraped-gauges tie-break, not a scheduler
+        return ent["occupancy"] + ent["queue_depth"] / 16.0
+
+    # -- admission + dispatch --------------------------------------------
+
+    def submit(self, prompt, max_new_tokens=32, eos_token_id=None,
+               deadline_s=None):
+        """Admit one request; returns its nonce. The request is never
+        lost after this point: dispatch failure leaves it queued
+        router-side and every pump retries."""
+        nonce = "%s-%06d" % (self._salt, next(self._seq))
+        with self._lock:
+            req = {"nonce": nonce, "prompt": list(prompt),
+                   "max_new_tokens": int(max_new_tokens),
+                   "eos_token_id": eos_token_id,
+                   "deadline_s": deadline_s,
+                   "state": "queued", "rank": None,
+                   "replica_state": None, "reroutes": 0,
+                   "submitted_at": self._clock(),
+                   "first_token_at": None, "finished_at": None,
+                   "output_tokens": 0, "tokens": None,
+                   "affinity": False, "_dispatched_once": False,
+                   "status_reason": None}
+            self._requests[nonce] = req
+            self._order.append(nonce)
+        REQUESTS.labels("accepted").inc()
+        self._try_dispatch(req)
+        return nonce
+
+    def _candidates(self):
+        return [r for r, ent in self._replicas.items()
+                if ent["state"] == "live"]
+
+    def _try_dispatch(self, req):
+        candidates = self._candidates()
+        affinity = self.affinity.match(req["prompt"])
+        attempts = 0
+        while candidates and attempts < self.max_retries:
+            load = {r: self._load_score(self._replicas[r])
+                    for r in candidates}
+            rank, used_affinity = membership.pick_replica(
+                candidates, load=load, affinity=affinity)
+            if rank is None:
+                break
+            attempts += 1
+            ent = self._replicas[rank]
+            try:
+                code, resp = _http_post_json(
+                    ent["url"] + "/sfleet/enqueue",
+                    {"nonce": req["nonce"], "prompt": req["prompt"],
+                     "max_new_tokens": req["max_new_tokens"],
+                     "eos_token_id": req["eos_token_id"],
+                     "deadline_s": req["deadline_s"]},
+                    self.http_timeout_s)
+            except _SCRAPE_ERRORS:
+                # unreachable mid-dispatch: suspect it, walk on — the
+                # nonce makes the retry idempotent even if the replica
+                # DID admit before the connection died
+                self.drain(rank, reason="dispatch_failed")
+                candidates.remove(rank)
+                continue
+            if code == 200:
+                req["rank"] = rank
+                req["state"] = "dispatched"
+                req["replica_state"] = resp.get("state") or "queued"
+                REQUESTS.labels(
+                    "rerouted" if req["_dispatched_once"]
+                    else "dispatched").inc()
+                if req["_dispatched_once"]:
+                    req["reroutes"] += 1
+                req["_dispatched_once"] = True
+                req["affinity"] = used_affinity
+                if used_affinity:
+                    AFFINITY_HITS.inc()
+                    ent["affinity_hits"] += 1
+                ent["dispatches"] += 1
+                ent["queue_depth"] += 1     # optimistic, until rescrape
+                self.affinity.note(req["prompt"], rank)
+                DISPATCH_SECONDS.observe(
+                    max(self._clock() - req["submitted_at"], 0.0))
+                return True
+            # 409 draining / queue_full, or any other refusal: walk on
+            reason = (resp or {}).get("error")
+            if reason == "draining":
+                self.drain(rank, reason="admission_draining")
+            candidates.remove(rank)
+            affinity.pop(rank, None)
+        REQUESTS.labels("unroutable").inc()
+        return False
+
+    # -- progress --------------------------------------------------------
+
+    def _poll_request(self, req):
+        ent = self._replicas.get(req["rank"])
+        if ent is None:
+            return
+        try:
+            code, resp = _http_get_json(
+                "%s/sfleet/result/%s" % (ent["url"], req["nonce"]),
+                self.http_timeout_s)
+        except _SCRAPE_ERRORS:
+            ent["scrape_errors"] += 1
+            if ent["scrape_errors"] >= self.suspect_after:
+                self.drain(req["rank"], reason="unreachable")
+            return
+        if code == 404:
+            # the replica does not know the nonce (restarted with a
+            # new generation): the work is gone, re-route it
+            self._reroute(req)
+            return
+        if code != 200:
+            return
+        req["replica_state"] = resp.get("state")
+        n_out = int(resp.get("output_tokens") or 0)
+        if n_out > 0 and req["first_token_at"] is None:
+            req["first_token_at"] = self._clock()
+        req["output_tokens"] = n_out
+        if resp.get("state") == "shed" and \
+                resp.get("reason") in ("draining", "queue_full"):
+            # the replica shed it at admission (the pre-check raced a
+            # drain): the request never ran — re-route, don't fail it
+            self._reroute(req)
+            return
+        if resp.get("state") in _REPLICA_TERMINAL_OK:
+            req["state"] = "finished"
+            req["tokens"] = resp.get("tokens")
+            req["finished_at"] = self._clock()
+            REQUESTS.labels("finished").inc()
+        elif resp.get("state") in _REPLICA_TERMINAL_BAD:
+            req["state"] = "failed"
+            req["status_reason"] = resp.get("reason")
+            req["finished_at"] = self._clock()
+            REQUESTS.labels("failed").inc()
+
+    def _reroute(self, req):
+        req["state"] = "queued"
+        req["rank"] = None
+        req["replica_state"] = None
+        self._try_dispatch(req)
+
+    def pump(self):
+        """One router turn; returns progress counts."""
+        self.refresh_membership()
+        self.scrape_loads()
+        outstanding = 0
+        for nonce in list(self._order):
+            req = self._requests[nonce]
+            if req["state"] in ("finished", "failed"):
+                continue
+            outstanding += 1
+            if req["state"] == "queued":
+                self._try_dispatch(req)
+                continue
+            ent = self._replicas.get(req["rank"])
+            if ent is None or ent["state"] == "evicted":
+                # the replica died with the work: re-dispatch
+                self._reroute(req)
+            elif ent["state"] == "draining" and \
+                    req["replica_state"] in (None, "queued"):
+                # drain-and-reschedule: queued-but-unstarted work moves
+                # off the draining replica (started work finishes there)
+                self._reroute(req)
+            else:
+                self._poll_request(req)
+        return {"outstanding": outstanding,
+                "total": len(self._requests)}
+
+    def wait_all(self, timeout_s=60.0, poll_interval_s=0.02):
+        """Pump until every admitted request is terminal (benchmark /
+        test driver). Returns True when all settled."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self.pump()["outstanding"] == 0:
+                return True
+            time.sleep(poll_interval_s)
+        return self.pump()["outstanding"] == 0
+
+    def request(self, nonce):
+        return self._requests.get(nonce)
+
+    def requests(self):
+        return [self._requests[n] for n in self._order]
+
+    # -- serve loop (tools/serving_router.py) ----------------------------
+
+    def start(self, interval_s=0.05):
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._serve_loop, args=(float(interval_s),),
+                name=_ROUTER_THREAD, daemon=True)
+            self._thread.start()
+        return self
+
+    def _serve_loop(self, interval_s):
+        while not self._stop.wait(interval_s):
+            try:
+                self.pump()
+            except Exception as e:
+                warn_once("sfleet.router.pump",
+                          "paddle_tpu.serving.fleet: router pump "
+                          "failed (loop continues): %r" % (e,))
+
+    def close(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+        if _mfleet._router_hook is self:
+            _mfleet.clear_router_hook()
+
+    # -- HTTP surface (rides the router process's MetricsServer) ---------
+
+    def install_routes(self, server):
+        """Register the router's own HTTP API on a MetricsServer:
+        POST /sfleet/submit, GET /sfleet/status/{nonce} (the /debugz/
+        router routes are process-wide via the monitor hook)."""
+        server.add_post_route("sfleet/submit", self._http_submit)
+        server.add_prefix_route("sfleet/status", self._http_status)
+
+    def _http_submit(self, body):
+        try:
+            payload = json.loads(body.decode())
+            prompt = payload["prompt"]
+            if not isinstance(prompt, list):
+                raise ValueError("prompt must be a token-id list")
+        except (ValueError, KeyError, UnicodeDecodeError) as e:
+            return (400, "application/json",
+                    json.dumps({"error": repr(e)}).encode())
+        nonce = self.submit(
+            prompt, max_new_tokens=int(payload.get(
+                "max_new_tokens", 32)),
+            eos_token_id=payload.get("eos_token_id"),
+            deadline_s=payload.get("deadline_s"))
+        return (200, "application/json",
+                json.dumps({"nonce": nonce}).encode())
+
+    def _http_status(self, nonce):
+        req = self._requests.get(nonce)
+        if req is None:
+            return (404, "application/json",
+                    json.dumps({"error": "unknown nonce",
+                                "nonce": nonce}).encode())
+        out = {k: req[k] for k in (
+            "nonce", "state", "rank", "replica_state", "reroutes",
+            "output_tokens", "tokens", "affinity", "status_reason")}
+        return (200, "application/json",
+                json.dumps(out, default=str).encode())
+
+    # -- debugz payloads (monitor/fleet.py hook protocol) ----------------
+
+    def debug_payload(self):
+        by_state = {}
+        for ent in self._replicas.values():
+            by_state[ent["state"]] = by_state.get(ent["state"], 0) + 1
+        req_states = {}
+        rerouted = 0
+        for req in self._requests.values():
+            req_states[req["state"]] = \
+                req_states.get(req["state"], 0) + 1
+            rerouted += req["reroutes"]
+        dispatches = sum(e["dispatches"]
+                         for e in self._replicas.values())
+        hits = sum(e["affinity_hits"] for e in self._replicas.values())
+        return {
+            "world_size": (self._view.world_size
+                           if self._view is not None
+                           else len(self._replicas)),
+            "store_backed": self._store is not None,
+            "replicas": {"known": len(self._replicas),
+                         "live": by_state.get("live", 0),
+                         "draining": by_state.get("draining", 0),
+                         "evicted": by_state.get("evicted", 0)},
+            "requests": dict(req_states,
+                             accepted=len(self._requests),
+                             rerouted=rerouted),
+            "affinity": dict(self.affinity.stats(),
+                             hits=hits, dispatches=dispatches,
+                             hit_rate=(hits / dispatches
+                                       if dispatches else None)),
+        }
+
+    def replicas_debug_payload(self):
+        rows = []
+        now = self._clock()
+        for rank, ent in sorted(self._replicas.items()):
+            rows.append({k: ent[k] for k in (
+                "rank", "url", "generation", "state", "occupancy",
+                "queue_depth", "active_slots", "decode_compiles",
+                "requests_finished", "dispatches", "affinity_hits",
+                "scrape_errors")})
+            rows[-1]["capabilities"] = dict(ent["capabilities"])
+            rows[-1]["drain_reason"] = ent.get("drain_reason")
+            rows[-1]["load_age_s"] = (
+                round(now - ent["last_load_at"], 3)
+                if ent["last_load_at"] is not None else None)
+        return rows

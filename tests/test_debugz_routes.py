@@ -49,12 +49,14 @@ ROUTES = {
     "debugz/fleet": (200, "json"),
     "debugz/fleet/ranks": (200, "json"),
     "metrics/fleet": (200, "text"),
+    "debugz/router": (200, "json"),
+    "debugz/router/replicas": (200, "json"),
 }
 
 ALL_FLAGS = ("FLAGS_monitor_timeseries", "FLAGS_perf_attribution",
              "FLAGS_perf_sentinels", "FLAGS_monitor_trace",
              "FLAGS_monitor_fleet", "FLAGS_monitor_memory",
-             "FLAGS_monitor_profile")
+             "FLAGS_monitor_profile", "FLAGS_serving_fleet")
 
 
 @pytest.fixture()
@@ -80,6 +82,14 @@ def _reset_monitor_state():
     trace.clear()
     wd.stop_watchdog()
     fleet.stop_collector()
+    fleet.clear_router_hook()
+    # drop router_* series another suite's fleet traffic may have
+    # minted: the all-off matrix pins the family series-free
+    for m in mreg.get_registry().metrics():
+        if m.name.startswith("router_"):
+            for store in ("_values", "_series"):
+                for key in list(getattr(m, store, ()) or ()):
+                    m.remove(*key)
     mreg.enable(trace_bridge=False)
 
 
@@ -167,10 +177,26 @@ class TestRouteMatrixAllOff:
         assert p["enabled"] is False and p["ranks"] == []
         _, body = _get(server, "metrics/fleet")
         assert "not running" in body.decode()
-        # ...no collector thread exists with the flag off...
+        _, body = _get(server, "debugz/router")
+        p = json.loads(body.decode())
+        assert p == {"enabled": False, "router": None}
+        _, body = _get(server, "debugz/router/replicas")
+        p = json.loads(body.decode())
+        assert p == {"enabled": False, "replicas": []}
+        # ...no collector / serving-fleet threads exist flags-off...
         import threading
         assert not [t for t in threading.enumerate()
-                    if t.name == fleet._THREAD_NAME]
+                    if t.name == fleet._THREAD_NAME
+                    or t.name.startswith("pt-sfleet")]
+        # ...the serving-fleet router hook slot stayed None (the
+        # route serves without ever importing the serving package)
+        assert fleet._router_hook is None
+        # ...and no router_* series materialized (registration is
+        # series-free until a router/replica actually increments)
+        snap = mreg.get_registry().snapshot()
+        for name, fam in snap.items():
+            if name.startswith("router_"):
+                assert fam["series"] == [], name
         # ...and the registry hot-path hook slots stayed None
         assert mreg._state.ts_hook is None
         assert mreg._state.ex_hook is None
@@ -265,3 +291,19 @@ class TestRouteMatrixAllOn:
         _, body = _get(server, "debugz/trace/journal")
         p = json.loads(body.decode())
         assert p["kind"] == "trace_journal" and tid in p["traces"]
+        # serving-fleet routes: flag on + a live (endpoint-mode)
+        # router registered via the monitor hook
+        from paddle_tpu.serving.fleet import Router
+        router = Router(endpoints={0: "http://127.0.0.1:1"})
+        try:
+            _, body = _get(server, "debugz/router")
+            p = json.loads(body.decode())
+            assert p["enabled"] is True
+            assert p["router"]["replicas"]["known"] == 1
+            _, body = _get(server, "debugz/router/replicas")
+            p = json.loads(body.decode())
+            assert p["enabled"] is True
+            assert [r["rank"] for r in p["replicas"]] == [0]
+        finally:
+            router.close()
+        assert fleet._router_hook is None

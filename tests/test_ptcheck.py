@@ -232,7 +232,8 @@ class TestGate:
         report, _ = full_run
         assert set(report["fixtures"]) == {
             "barrier", "barrier_legacy", "election", "elastic",
-            "bundle", "idempotence", "add_legacy"}
+            "bundle", "idempotence", "add_legacy",
+            "router_membership", "router_register_legacy"}
         for row in report["fixtures"].values():
             assert row["schedules"] > 0
 
@@ -257,6 +258,25 @@ class TestGate:
         assert row["found_expected"]
         props = {f["property"] for f in row["findings"]}
         assert "retry-idempotence" in props or "claim-unique" in props
+
+    def test_legacy_router_register_is_found(self, full_run):
+        """The serving-fleet regression pin: a register retried over a
+        non-idempotent add must be FOUND (as the declared
+        register-exact violation) every run."""
+        report, _ = full_run
+        row = report["fixtures"]["router_register_legacy"]
+        assert row["found_expected"]
+        props = {f["property"] for f in row["findings"]}
+        assert "register-exact" in props
+
+    def test_router_membership_is_clean_and_explored(self, full_run):
+        """The live fixture gates at zero findings AND actually
+        explored faulted schedules (a fixture that never exercises its
+        crash/lost-ack budget proves nothing)."""
+        report, _ = full_run
+        row = report["fixtures"]["router_membership"]
+        assert row["findings"] == []
+        assert row["schedules"] > 50
 
     def test_regression_power_requires_the_historical_property(self):
         """A fixture whose runs merely TRUNCATE (engine

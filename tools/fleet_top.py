@@ -5,7 +5,9 @@ FleetCollector, run in-process here — no server-side collector needed)
 and renders the per-rank table: step, step time, tokens/s, MFU, HBM
 peak, live memory + headroom (the /debugz/memory plane, round 14),
 measured host-blocked share (the /debugz/profile plane, round 15),
-comm share, heartbeat age, health verdict, straggler flag.
+comm share, serving-router replica count + affinity hit rate where a
+rank hosts one (the /debugz/router plane, round 17), heartbeat age,
+health verdict, straggler flag.
 
 Endpoints come from one of:
   --endpoints URL[,URL...]   explicit list (rank = position, or R=URL)
@@ -84,6 +86,13 @@ COLS = (
     ("COMM%", 6, lambda r: _fmt(
         r.get("comm_share") * 100 if isinstance(
             r.get("comm_share"), (int, float)) else None, "%.1f")),
+    # serving-fleet router columns (blank unless the rank hosts a
+    # Router — /debugz/router answers with a live hook there only)
+    ("REPLICAS", 8, lambda r: _fmt(r.get("router_replicas"), "%d")),
+    ("AFFIN%", 6, lambda r: _fmt(
+        r.get("router_affinity_hit_rate") * 100 if isinstance(
+            r.get("router_affinity_hit_rate"), (int, float))
+        else None, "%.1f")),
     ("HB_AGE", 7, lambda r: _fmt(r.get("heartbeat_age_s"), "%.1f")),
     ("HEALTH", 9, lambda r: ("UNREACH" if not r.get("ok")
                              else (r.get("healthz") or "-"))),

@@ -22,6 +22,13 @@ Usage:
   # reports shed/expired/failed counts and goodput under chaos
   python tools/serving_benchmark.py --fault-rate 0.1 --max-queue 16 \
       --deadline-s 10
+  # fleet row (ISSUE 16): N forked engine replicas + the in-process
+  # prefix-affinity router; phase A is the no-kill baseline, phase B
+  # SIGKILLs one replica mid-run — zero accepted requests may be
+  # lost, kill-phase p99 TTFT must stay within 2x of baseline, and
+  # every survivor must still report decode_compiles == 1
+  python tools/serving_benchmark.py --fleet 3 --kill-replica-at 4 \
+      --shared-prefix-tokens 32 --out tools/serving_fleet_snapshot.json
 """
 from __future__ import annotations
 
@@ -55,6 +62,16 @@ def _watchdog(seconds):
     signal.alarm(seconds)
 
 
+def _pow2_bucket(n):
+    """Engine._bucket without the engine: next power of two >= 8. The
+    fleet parent pre-warms every bucket its workload can hit on every
+    replica so phase TTFTs never pay an in-window prefill compile."""
+    p = 8
+    while p < n:
+        p *= 2
+    return p
+
+
 def _pct(values, q):
     import numpy as np
 
@@ -66,6 +83,302 @@ def _pcts(values):
     """Aggregate percentile row (p50/p90/p99) for the JSON artifact."""
     return {"p50": _pct(values, 50), "p90": _pct(values, 90),
             "p99": _pct(values, 99)}
+
+
+def _write_fleet_artifact(path, report, stale_reason=None):
+    """bench.py's staleness discipline for the fleet artifact: a run
+    that produced nothing re-emits the previous snapshot marked
+    ``stale: true`` (+ stale_generations/stale_since) instead of
+    silently photocopying — the battery row goes red (rc=3)."""
+    if stale_reason is not None and os.path.exists(path):
+        try:
+            with open(path) as f:
+                last = json.load(f)
+        except (OSError, ValueError):
+            last = None
+        if last and last.get("kind") == "serving_fleet_snapshot":
+            last["stale"] = True
+            last["stale_reason"] = stale_reason
+            last["stale_generations"] = \
+                int(last.get("stale_generations", 0)) + 1
+            last.setdefault("stale_since", last.get("measured_at"))
+            report = last
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(report, f, indent=1, default=str)
+        f.write("\n")
+    os.replace(tmp, path)
+    return report
+
+
+def run_fleet(args):
+    """--fleet N: fork N replica processes (tools/serving_router.py
+    --replica), drive them through the in-process store-backed router,
+    and measure the fleet headline: baseline (phase A) vs kill-one-
+    replica-mid-run (phase B) TTFT, rerouted/lost counts, per-replica
+    affinity hit rate, survivor decode_compiles."""
+    import subprocess
+    import urllib.request
+
+    import numpy as np
+
+    import jax
+
+    from paddle_tpu.core import flags as ptflags
+    from paddle_tpu.distributed.store import TCPStore
+    from paddle_tpu.serving.fleet import Router
+
+    ptflags.set_flags({"FLAGS_serving_fleet": True})
+
+    def post_json(url, payload):
+        req = urllib.request.Request(
+            url, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST")
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return json.loads(r.read().decode())
+
+    def get_json(url):
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return json.loads(r.read().decode())
+
+    launcher = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "serving_router.py")
+    master = TCPStore(is_master=True)
+    procs, announce, router = [], {}, None
+    spt = args.shared_prefix_tokens
+    vocab = PRESETS[args.preset]["vocab_size"]
+    max_pos = PRESETS[args.preset]["max_position_embeddings"]
+    rng = np.random.RandomState(args.seed)
+    prefixes = [rng.randint(0, vocab, (spt,)).tolist()
+                for _ in range(args.prefix_groups)] if spt else None
+
+    def mk_workload():
+        prompts = []
+        for _ in range(args.requests):
+            tail = rng.randint(
+                0, vocab,
+                (int(rng.randint(args.prompt_len[0],
+                                 args.prompt_len[1] + 1)),)).tolist()
+            head = prefixes[int(rng.randint(args.prefix_groups))] \
+                if prefixes else []
+            prompts.append(head + tail)
+        new = [int(rng.randint(args.max_new[0], args.max_new[1] + 1))
+               for _ in range(args.requests)]
+        arrivals = np.cumsum(rng.exponential(1.0 / args.rate,
+                                             args.requests))
+        return prompts, new, arrivals
+
+    def run_phase(name, kill_at=None):
+        prompts, new, arrivals = mk_workload()
+        nonces, killed = [], None
+        start = time.perf_counter()
+        nxt = 0
+        while nxt < len(prompts) or (kill_at is not None
+                                     and killed is None):
+            now = time.perf_counter() - start
+            if kill_at is not None and killed is None \
+                    and now >= kill_at:
+                # the victim is the live replica holding the most
+                # unfinished work — the worst case for the
+                # never-lose-a-request claim
+                holding = {}
+                for rq in router.requests():
+                    if rq["state"] not in ("finished", "failed") \
+                            and rq["rank"] is not None:
+                        holding[rq["rank"]] = \
+                            holding.get(rq["rank"], 0) + 1
+                live = [r["rank"] for r in
+                        router.replicas_debug_payload()
+                        if r["state"] == "live"]
+                killed = max(live,
+                             key=lambda r: (holding.get(r, 0), -r)) \
+                    if live else None
+                if killed is not None:
+                    procs[killed].kill()        # SIGKILL: no goodbye
+            while nxt < len(prompts) and arrivals[nxt] <= now:
+                nonces.append(router.submit(
+                    prompts[nxt], max_new_tokens=new[nxt]))
+                nxt += 1
+            router.pump()
+            time.sleep(0.002)
+        settled = router.wait_all(timeout_s=args.fleet_wait_s)
+        wall = time.perf_counter() - start
+        reqs = [router.request(n) for n in nonces]
+        ttft = [r["first_token_at"] - r["submitted_at"] for r in reqs
+                if r["first_token_at"] is not None]
+        lost = [r["nonce"] for r in reqs if r["state"] != "finished"]
+        return {
+            "phase": name, "requests": len(reqs),
+            "settled": bool(settled), "wall_s": round(wall, 3),
+            "ttft_s": _pcts(ttft),
+            "finished": sum(r["state"] == "finished" for r in reqs),
+            "lost": lost,
+            "rerouted": sum(r["reroutes"] for r in reqs),
+            "affinity_dispatches": sum(bool(r["affinity"])
+                                       for r in reqs),
+            "output_tokens": sum(r["output_tokens"] for r in reqs),
+            "killed_rank": killed,
+        }
+
+    out = args.out
+    try:
+        for r in range(args.fleet):
+            procs.append(subprocess.Popen(
+                [sys.executable, launcher, "--replica",
+                 "--rank", str(r),
+                 "--store", "127.0.0.1:%d" % master.port,
+                 "--preset", args.preset,
+                 "--max-slots", str(args.max_slots),
+                 "--num-blocks", str(args.num_blocks),
+                 "--block-size", str(args.block_size),
+                 "--seed", str(args.seed + r),
+                 "--ttl-s", str(args.fleet_ttl_s),
+                 "--heartbeat-s", "0.2"],
+                stdout=subprocess.PIPE))
+        for r, p in enumerate(procs):
+            # one JSON line after Replica.start(): engine built, lease
+            # registered, protocol served
+            announce[r] = json.loads(p.stdout.readline().decode())
+            print("replica %d up: %s" % (r, announce[r]["url"]),
+                  flush=True)
+
+        # per-replica compile warmup, straight to each replica's
+        # enqueue endpoint (bypassing placement): every prefill bucket
+        # the workload can hit + THE decode step, per replica, so
+        # neither phase pays an in-window compile
+        t0 = time.perf_counter()
+        lo = args.prompt_len[0] + spt
+        hi = args.prompt_len[1] + spt + args.max_new[1] - 1
+        buckets = sorted({_pow2_bucket(n) for n in range(lo, hi + 1)})
+        warm = []
+        for r, info in announce.items():
+            for i, b in enumerate(buckets):
+                nonce = "warm-%d-%d" % (r, i)
+                post_json(info["url"] + "/sfleet/enqueue",
+                          {"nonce": nonce,
+                           "prompt": [1] * min(b, max_pos - 4),
+                           "max_new_tokens": 2})
+                warm.append((info["url"], nonce))
+        pending = list(warm)
+        while pending:
+            url, nonce = pending[0]
+            st = get_json("%s/sfleet/result/%s" % (url, nonce))
+            if st["state"] in ("finished", "failed", "shed",
+                               "expired"):
+                if st["state"] != "finished":
+                    raise RuntimeError("warmup %s on %s: %r"
+                                       % (nonce, url, st))
+                pending.pop(0)
+            else:
+                time.sleep(0.05)
+        warmup_s = time.perf_counter() - t0
+
+        router = Router(store=TCPStore(port=master.port),
+                        world_size=args.fleet,
+                        block_size=args.block_size,
+                        ttl_s=args.fleet_ttl_s)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            router.refresh_membership()
+            router.scrape_loads()
+            if router.debug_payload()["replicas"]["live"] \
+                    == args.fleet:
+                break
+            time.sleep(0.05)
+        else:
+            raise RuntimeError(
+                "only %r of %d replicas came live"
+                % (router.debug_payload()["replicas"], args.fleet))
+
+        baseline = run_phase("baseline")
+        kill = run_phase("kill", kill_at=args.kill_replica_at) \
+            if args.kill_replica_at is not None else None
+
+        dbg = router.debug_payload()
+        rows = router.replicas_debug_payload()
+        killed_ranks = {p["killed_rank"] for p in (baseline, kill)
+                        if p and p["killed_rank"] is not None}
+        survivors = {
+            r["rank"]: r["decode_compiles"] for r in rows
+            if r["state"] != "evicted"
+            and r["rank"] not in killed_ranks}
+        lost = list(baseline["lost"]) + list(kill["lost"] if kill
+                                             else [])
+        ratio = None
+        if kill and baseline["ttft_s"]["p99"] and \
+                kill["ttft_s"]["p99"] is not None:
+            ratio = round(kill["ttft_s"]["p99"]
+                          / baseline["ttft_s"]["p99"], 3)
+        report = {
+            "kind": "serving_fleet_snapshot",
+            "metric": "serving_fleet_kill_ttft_p99_ratio",
+            "value": ratio,
+            "backend": jax.default_backend(),
+            "preset": args.preset,
+            "fleet": args.fleet,
+            "workload": {
+                "requests_per_phase": args.requests,
+                "poisson_rate": args.rate,
+                "prompt_len": list(args.prompt_len),
+                "max_new": list(args.max_new), "seed": args.seed,
+                "shared_prefix_tokens": spt,
+                "prefix_groups": args.prefix_groups if spt else 0,
+                "max_slots": args.max_slots,
+                "num_blocks": args.num_blocks,
+                "block_size": args.block_size,
+                "kill_replica_at_s": args.kill_replica_at,
+                "ttl_s": args.fleet_ttl_s,
+            },
+            "warmup_compile_s": round(warmup_s, 3),
+            "baseline": baseline,
+            "kill": kill,
+            "lost_requests": lost,
+            "ttft_p99_ratio_within_2x": (ratio is not None
+                                         and ratio <= 2.0),
+            "survivor_decode_compiles": survivors,
+            "router": dbg,
+            "replicas": rows,
+            "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                         time.gmtime()),
+        }
+        print(json.dumps({k: v for k, v in report.items()
+                          if k not in ("replicas",)}), flush=True)
+        _write_fleet_artifact(out, report)
+        print("wrote", out, flush=True)
+        if lost:
+            sys.stderr.write("FAIL: %d accepted request(s) lost: %r\n"
+                             % (len(lost), lost))
+            return 5
+        bad = {r: c for r, c in survivors.items() if c != 1}
+        if bad:
+            sys.stderr.write("FAIL: survivor decode_compiles != 1: "
+                             "%r\n" % (bad,))
+            return 4
+        return 0
+    except (RuntimeError, OSError, ValueError,
+            json.JSONDecodeError) as e:
+        sys.stderr.write("serving_benchmark --fleet failed: %r\n"
+                         % (e,))
+        _write_fleet_artifact(
+            out, {"kind": "serving_fleet_snapshot", "ok": False,
+                  "error": repr(e),
+                  "measured_at": time.strftime(
+                      "%Y-%m-%dT%H:%M:%SZ", time.gmtime())},
+            stale_reason=repr(e))
+        return 3
+    finally:
+        if router is not None:
+            router.close()
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        master.close()
 
 
 def main():
@@ -132,8 +445,25 @@ def main():
     ap.add_argument("--trace-out", default=None,
                     help="also write the span journal here "
                          "(tools/trace_merge.py --requests input)")
+    ap.add_argument("--fleet", type=int, default=0,
+                    help="serving-fleet mode: fork this many engine "
+                         "replica processes (tools/serving_router.py "
+                         "--replica) and drive them through the "
+                         "in-process prefix-affinity router instead "
+                         "of one local engine")
+    ap.add_argument("--kill-replica-at", type=float, default=None,
+                    help="fleet mode: SIGKILL one replica this many "
+                         "seconds into the kill phase (phase B); the "
+                         "router's TTL eviction + re-dispatch must "
+                         "lose nothing")
+    ap.add_argument("--fleet-ttl-s", type=float, default=2.0,
+                    help="fleet mode: replica liveness lease TTL")
+    ap.add_argument("--fleet-wait-s", type=float, default=300.0,
+                    help="fleet mode: per-phase drain deadline")
     args = ap.parse_args()
     _watchdog(args.watchdog)
+    if args.fleet > 0:
+        return run_fleet(args)
 
     import numpy as np
 

@@ -209,6 +209,22 @@ run serving_resilience 1200 env $(wd serving_resilience) \
     --fault-rate 0.1 --max-queue 32 --deadline-s 30 \
     --out tools/serving_resilience_bench.json
 
+# 5c2. serving fleet row (ISSUE 16): 3 forked engine replicas + the
+#     in-process prefix-affinity router over the fleet TCPStore, under
+#     the shared-prefix Poisson shape. Phase A is the no-kill baseline;
+#     phase B SIGKILLs the replica holding the most in-flight work
+#     mid-run. Acceptance, enforced by exit codes: zero accepted
+#     requests lost (rc=5), kill p99 TTFT ratio reported (within-2x
+#     flag in the JSON), every survivor still decode_compiles == 1
+#     (rc=4). A failed run re-emits the previous artifact marked stale
+#     (rc=3) — bench.py's discipline.
+run serving_fleet 1500 env $(wd serving_fleet) \
+    python tools/serving_benchmark.py --preset llama1b \
+    --fleet 3 --kill-replica-at 4 \
+    --requests 48 --rate 8 --max-slots 4 --num-blocks 256 \
+    --shared-prefix-tokens 32 --prefix-groups 4 \
+    --out tools/serving_fleet_snapshot.json
+
 # 5d. fleet telemetry row (ISSUE 8): the existing 2-process multihost
 #     train entry under FLAGS_monitor_fleet — every rank announces its
 #     metrics endpoint in the TCPStore, a STANDALONE collector scrapes
