@@ -23,7 +23,11 @@ server stack: ``KVHTTPServer`` gained a ``get_routes`` hook, and
                         write_journal format — what a fleet capture
                         pulls so tools/trace_merge.py can merge it)
     GET /debugz/trace/{id}  one trace's full span timeline (404 for an
-                        unknown or evicted trace id)
+                        unknown or evicted trace id) + a
+                        ``federation`` block: on a serving-fleet
+                        router process the replica-side fragments of
+                        the fleet trace, fetched on demand
+                        (enabled:false otherwise, zero fetches)
     GET /debugz/memory  memory-plane breakdown: per-component ledger,
                         allocator reconciliation, headroom, recent
                         admission/preempt decisions, OOM postmortems
@@ -251,12 +255,23 @@ class MetricsServer:
                           default=str).encode()
         return 200, "application/json", body
 
-    def _trace_by_id(self, trace_id):
+    def _trace_by_id(self, rest):
+        trace_id, _, query = rest.partition("?")
         p = _trace.trace_payload(trace_id)
         if p is None:
             return (404, "application/json",
                     json.dumps({"error": "unknown trace",
                                 "trace_id": trace_id}).encode())
+        # on a router process the trace is fleet-wide: federate the
+        # replica-side fragments on demand (enabled:false — and zero
+        # cross-replica fetches — without FLAGS_serving_fleet + a
+        # running router; the 404-for-unknown contract is unchanged).
+        # ``?local=1`` pins the LOCAL view: the router's own federation
+        # fetches ask for it, so a fragment request can never recurse
+        # into another fan-out (loop-proofs a misconfigured topology
+        # where a router's endpoint resolves back to a router process)
+        if "local=1" not in query.split("&"):
+            p["federation"] = _fleet.router_trace_federation(trace_id)
         body = json.dumps(_watchdog.json_safe(p), default=str).encode()
         return 200, "application/json", body
 

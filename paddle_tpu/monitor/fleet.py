@@ -937,9 +937,25 @@ class FleetCollector:
                 os.replace(tmp, path)
             if ok:
                 got_ranks.append(rank)
+        # the serving-fleet router (when one runs in THIS process —
+        # the tools/serving_router.py shape) journals the dispatch
+        # half of every fleet trace: write its journal locally so the
+        # capture carries router+replica fragments of one incident
+        router_journal = None
+        if _sfleet_enabled() and _router_hook is not None:
+            from . import trace as _trace
+            if _trace.is_enabled():
+                path = os.path.join(d, "journal_router.json")
+                tmp = path + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump(_trace.dump(), f, indent=1, default=str)
+                    f.write("\n")
+                os.replace(tmp, path)
+                router_journal = "journal_router.json"
         manifest = {
             "kind": "fleet_capture",
             "version": 1,
+            "router_journal": router_journal,
             "reason": reason,
             "detail": detail or {},
             "created_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
@@ -1238,6 +1254,20 @@ def router_replicas_payload():
                 "time": time.time()}
     return {"enabled": True, "replicas": r.replicas_debug_payload(),
             "time": time.time()}
+
+
+def router_trace_federation(trace_id):
+    """The ``federation`` block a router process's ``/debugz/trace/
+    {id}`` attaches: the replica-side fragments of one fleet trace,
+    fetched on demand through the hook. ``{"enabled": False}`` — and
+    ZERO cross-replica fetches — whenever FLAGS_serving_fleet is off
+    or no router runs here (test-pinned)."""
+    if not _sfleet_enabled() or _router_hook is None:
+        return {"enabled": False}
+    segments = getattr(_router_hook, "trace_segments", None)
+    if segments is None:
+        return {"enabled": True, "segments": {}}
+    return dict(segments(trace_id), enabled=True)
 
 
 # -- fleet snapshot artifact (bench.py staleness discipline) ------------------

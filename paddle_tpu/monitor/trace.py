@@ -32,6 +32,18 @@ This module is that missing journey layer:
    can merge request spans into the rank-prefixed chrome-trace
    timeline one Perfetto view reads end-to-end.
 
+4. **Cross-process context** — ``format_traceparent`` /
+   ``parse_traceparent`` carry ``(trace_id, parent_span_id)`` over the
+   fleet HTTP protocol (``pt1-<trace_id>-<span_id hex>``); the
+   receiving process ``adopt_trace``s the incoming id and opens its
+   spans with ``remote_parent=`` (parent span ids from another
+   process's id space never mix with local ``parent_id`` counters), so
+   the serving router's dispatch span and the replica engine's phase
+   spans land under ONE fleet-wide trace id and
+   ``monitor/trace_merge.merge_fleet_journals`` can stitch them. Ids
+   are ``<cid>.<counter>`` with a per-process random 64-bit cid — pids
+   collide across hosts and recycle within one; random cids don't.
+
 Discipline (the PR-2/5 contract, test-pinned by tests/test_trace.py):
 default OFF via ``FLAGS_monitor_trace``; while off the hot paths are
 native-call-free and thread-free — emitters early-return on one
@@ -56,10 +68,15 @@ _EVENTS_PER_SPAN = 256
 class _TraceState:
     __slots__ = ("enabled", "capacity", "span_cap", "lock", "traces",
                  "open_spans", "next_trace", "next_span", "exemplars",
-                 "jobs")
+                 "jobs", "cid")
 
     def __init__(self):
         self.enabled = False
+        # per-process random 64-bit collector id (the store-nonce
+        # discipline): trace ids minted off the pid collide across
+        # hosts AND recycle within one, silently fusing unrelated
+        # requests in fleet-merged journals
+        self.cid = "%016x" % int.from_bytes(os.urandom(8), "little")
         self.capacity = int(os.environ.get("PT_TRACE_CAPACITY",
                                            str(DEFAULT_CAPACITY)))
         self.span_cap = int(os.environ.get("PT_TRACE_SPANS_PER_TRACE",
@@ -148,7 +165,7 @@ def new_trace(name, t=None, **attrs):
     if t is None:
         t = now()
     with _state.lock:
-        tid = "%x.%x" % (os.getpid(), _state.next_trace)
+        tid = "%s.%x" % (_state.cid, _state.next_trace)
         _state.next_trace += 1
         _state.traces[tid] = {
             "trace_id": tid,
@@ -162,10 +179,42 @@ def new_trace(name, t=None, **attrs):
     return tid
 
 
+def adopt_trace(trace_id, name, t=None, **attrs):
+    """Register a trace minted by ANOTHER process — the id arrived in a
+    traceparent context over the wire — so local spans land under the
+    same fleet-wide id. Idempotent: re-adopting an id (or adopting one
+    this process minted) just merges attrs; returns the id, or None
+    while disabled so callers keep the new_trace() contract."""
+    if not _state.enabled or trace_id is None:
+        return None
+    if t is None:
+        t = now()
+    with _state.lock:
+        tr = _state.traces.get(trace_id)
+        if tr is not None:
+            if attrs:
+                tr["attrs"].update(attrs)
+            return trace_id
+        _state.traces[trace_id] = {
+            "trace_id": trace_id,
+            "name": name,
+            "attrs": dict(attrs, adopted=True),
+            "t_start": t,
+            "spans": [],
+            "open": 0,
+        }
+        _evict_locked()
+    return trace_id
+
+
 def start_span(name, trace_id, parent_id=None, kind="span", t=None,
-               **attrs):
+               remote_parent=None, **attrs):
     """Open a span under ``trace_id``; returns its span id (None when
-    disabled, the trace id is None, or the trace was evicted)."""
+    disabled, the trace id is None, or the trace was evicted).
+    ``remote_parent`` names a parent span id from ANOTHER process's id
+    space (extracted from a traceparent context) — kept separate from
+    ``parent_id`` because local span ids and remote ones never share a
+    counter; the fleet merge stitches on it."""
     if not _state.enabled or trace_id is None:
         return None
     if t is None:
@@ -187,6 +236,8 @@ def start_span(name, trace_id, parent_id=None, kind="span", t=None,
             "attrs": dict(attrs),
             "events": [],
         }
+        if remote_parent is not None:
+            span["remote_parent"] = remote_parent
         if len(tr["spans"]) >= _state.span_cap:
             # per-trace span ring (a long-lived train trace must stay
             # bounded): drop the oldest FINISHED span; when everything
@@ -289,6 +340,43 @@ def span(name, trace_id=None, parent_id=None, kind="span", **attrs):
         if stack:
             parent_id = stack[-1]
     return _SpanCtx(name, trace_id, parent_id, kind, attrs)
+
+
+# -- cross-process context (traceparent) -------------------------------------
+
+TRACEPARENT_VERSION = "pt1"
+
+
+def format_traceparent(trace_id, span_id=None):
+    """Serialize ``(trace_id, parent_span_id)`` for the wire:
+    ``pt1-<trace_id>-<span_id hex>`` (span id empty when the sender has
+    no journal span open). Returns None for a None trace id so a
+    journal-off sender emits NO context field — the flags-off wire
+    format stays bit-identical."""
+    if trace_id is None:
+        return None
+    if span_id is None:
+        return "%s-%s-" % (TRACEPARENT_VERSION, trace_id)
+    return "%s-%s-%x" % (TRACEPARENT_VERSION, trace_id, span_id)
+
+
+def parse_traceparent(value):
+    """``(trace_id, parent_span_id)`` from a wire value; ``(None,
+    None)`` for absent/foreign-version/malformed input — a bad peer
+    must never break admission, just lose its trace linkage."""
+    if not value or not isinstance(value, str):
+        return (None, None)
+    parts = value.split("-")
+    if len(parts) != 3 or parts[0] != TRACEPARENT_VERSION or \
+            not parts[1]:
+        return (None, None)
+    sid = None
+    if parts[2]:
+        try:
+            sid = int(parts[2], 16)
+        except ValueError:
+            return (None, None)
+    return (parts[1], sid)
 
 
 # -- trace context + exemplars -----------------------------------------------
@@ -532,6 +620,7 @@ def dump():
         "kind": "trace_journal",
         "version": 1,
         "pid": os.getpid(),
+        "cid": _state.cid,
         "written_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "clock_anchor": {"wall": time.time(),
                          "monotonic": time.monotonic()},
@@ -583,6 +672,8 @@ def chrome_events_from_journal(journal, clock="wall"):
             args.update({"trace_id": tid, "span_id": s["span_id"],
                          "parent_id": s.get("parent_id"),
                          "kind": s.get("kind")})
+            if s.get("remote_parent") is not None:
+                args["remote_parent"] = s["remote_parent"]
             if s["t_end"] is None:
                 args["open"] = True
             evs.append({"ph": "X", "name": s["name"],

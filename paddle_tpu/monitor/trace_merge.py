@@ -24,6 +24,13 @@ traces must land on ONE time base:
    Perfetto view. Metadata (``ph == "M"``) events ride along so track
    names survive.
 
+The span-journal half (``merge_fleet_journals`` /
+``write_fleet_timeline``) does the same for the serving fleet: the
+router's journal plus each replica's, aligned by the collector-style
+NTP wall-clock offsets and stitched with chrome flow arrows on the
+traceparent linkage, so one Perfetto view shows a request's dispatch,
+reroute and cross-replica finish under one trace id.
+
 The CLI wrapper is tools/trace_merge.py.
 """
 from __future__ import annotations
@@ -259,4 +266,142 @@ def capture_events(dir_name, clock="wall"):
                 ev["ts"] += shift_us
             ev["pid"] = "rank%d/%s" % (rank, ev.get("pid", "trace"))
             evs.append(ev)
+    # a serving-fleet capture also carries the router's own journal
+    # (the dispatch half of every fleet trace, written collector-local
+    # — its clock IS the reference, no shift)
+    rpath = os.path.join(dir_name, "journal_router.json")
+    if manifest.get("router_journal") and os.path.exists(rpath):
+        try:
+            rj = load_journal(rpath)
+        except (ValueError, OSError):
+            rj = None
+        if rj is not None:
+            for ev in journal_events(rj, clock=clock):
+                ev = dict(ev)
+                ev["pid"] = "router/%s" % ev.get("pid", "trace")
+                evs.append(ev)
     return manifest, evs
+
+
+# -- fleet-trace merge (router + replica journals, ONE trace id) --------------
+
+def merge_fleet_journals(router_journal, replica_journals, offsets=None,
+                         clock="wall"):
+    """Stitch a serving-fleet router journal and its replicas' journals
+    into one clock-aligned chrome event list: router tracks are pid
+    ``router/...``, replica ``rank r`` tracks ``replica{r}/...``, and
+    each replica's WALL timestamps shift by ``offsets[rank]`` (the
+    collector-style NTP estimate: replica clock minus router clock) so
+    attempt 1 on a killed replica, the reroute span naming the reason,
+    and attempt 2 on the survivor read left-to-right under ONE trace
+    id. Chrome flow arrows (``ph "s"/"f"``) connect every router
+    ``dispatch`` span to the replica request span that adopted it —
+    matched on ``(trace_id, remote_parent == dispatch span_id)``, the
+    traceparent linkage, never timestamps."""
+    offsets = offsets or {}
+    evs = []
+    for ev in journal_events(router_journal, clock=clock):
+        ev = dict(ev)
+        ev["pid"] = "router/%s" % ev.get("pid", "trace")
+        evs.append(ev)
+    # (trace_id, span_id) -> router dispatch span, for flow stitches
+    dispatch = {}
+    for tid, tr in (router_journal.get("traces") or {}).items():
+        for s in tr.get("spans") or ():
+            if s.get("kind") == "dispatch":
+                dispatch[(tid, s["span_id"])] = (s, tr.get("name"))
+    for rank in sorted(replica_journals):
+        journal = replica_journals[rank]
+        shift_s = -float(offsets.get(rank, 0.0))
+        for ev in journal_events(journal, clock=clock):
+            ev = dict(ev)
+            if isinstance(ev.get("ts"), (int, float)):
+                ev["ts"] += shift_s * 1e6
+            ev["pid"] = "replica%d/%s" % (rank, ev.get("pid", "trace"))
+            evs.append(ev)
+        for tid, tr in (journal.get("traces") or {}).items():
+            for s in tr.get("spans") or ():
+                rp = s.get("remote_parent")
+                src = dispatch.get((tid, rp)) if rp is not None else None
+                if src is None:
+                    continue
+                span, src_name = src
+                fid = "%s/%x/r%d" % (tid, rp, rank)
+                evs.append({
+                    "ph": "s", "id": fid, "cat": "traceparent",
+                    "name": "dispatch",
+                    "pid": "router/%s" % (src_name or "trace"),
+                    "tid": tid, "ts": span["t_start"] * 1e6})
+                evs.append({
+                    "ph": "f", "bp": "e", "id": fid,
+                    "cat": "traceparent", "name": "dispatch",
+                    "pid": "replica%d/%s" % (rank,
+                                             tr.get("name") or "trace"),
+                    "tid": tid,
+                    "ts": (s["t_start"] + shift_s) * 1e6})
+    return evs
+
+
+def fleet_trace_summary(router_journal):
+    """Per-trace reroute-causality rows from the ROUTER journal alone
+    (it survives replica kills): ordered dispatch attempts with their
+    replica + outcome, and the reroute spans with their reason — the
+    merged-timeline acceptance contract in table form."""
+    out = {}
+    for tid, tr in (router_journal.get("traces") or {}).items():
+        dispatches, reroutes = [], []
+        for s in tr.get("spans") or ():
+            attrs = s.get("attrs") or {}
+            if s.get("kind") == "dispatch":
+                dispatches.append({
+                    "replica": attrs.get("replica"),
+                    "outcome": attrs.get("outcome"),
+                    "attempt": attrs.get("attempt"),
+                    "t_start": s["t_start"]})
+            elif s.get("kind") == "reroute":
+                reroutes.append({"reason": attrs.get("reason"),
+                                 "from_rank": attrs.get("from_rank"),
+                                 "t_start": s["t_start"]})
+        if not dispatches and not reroutes:
+            continue
+        out[tid] = {
+            "name": tr.get("name"),
+            "nonce": (tr.get("attrs") or {}).get("nonce"),
+            "dispatches": sorted(dispatches,
+                                 key=lambda d: d["t_start"]),
+            "reroutes": sorted(reroutes, key=lambda r: r["t_start"]),
+        }
+    return out
+
+
+def write_fleet_timeline(path, router_journal, replica_journals,
+                         offsets=None, meta=None):
+    """Write the merged fleet timeline artifact (``kind:
+    "fleet_trace"``): the aligned chrome events plus the per-trace
+    causality summary, so the artifact answers "which replica was
+    attempt 1 / why did it move / where did it finish" without a
+    Perfetto load. Atomic write; returns the dict written."""
+    evs = merge_fleet_journals(router_journal, replica_journals,
+                               offsets=offsets)
+    doc = {
+        "kind": "fleet_trace",
+        "version": 1,
+        "traceEvents": evs,
+        "displayTimeUnit": "ms",
+        "requests": fleet_trace_summary(router_journal),
+        "metadata": dict(
+            meta or {},
+            router_cid=router_journal.get("cid"),
+            replica_ranks=sorted(replica_journals),
+            clock_offsets_s={str(r): v for r, v in
+                             (offsets or {}).items()}),
+    }
+    d = os.path.dirname(os.path.abspath(path))
+    if d:
+        os.makedirs(d, exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1, default=str)
+        f.write("\n")
+    os.replace(tmp, path)
+    return doc

@@ -273,11 +273,13 @@ class Engine:
     # -- public API -------------------------------------------------------
 
     def add_request(self, prompt, max_new_tokens=32, eos_token_id=None,
-                    deadline_s=None):
+                    deadline_s=None, trace_ctx=None):
         """Queue a request; returns its id. Validates that the request
         can EVER run alone (admission control proper is per-step).
         Raises DrainingError / QueueFullError when load-shedding (the
-        request is never enqueued and gets no id)."""
+        request is never enqueued and gets no id). ``trace_ctx=(trace_id,
+        parent_span_id)`` adopts a caller-minted trace context (the
+        fleet router's traceparent) instead of minting a fresh id."""
         if self._draining:
             self.metrics.on_request_shed("draining")
             raise DrainingError(
@@ -311,7 +313,7 @@ class Engine:
         # span journal (FLAGS_monitor_trace): trace id assigned here —
         # the admission point — so the queue phase covers every second
         # the engine owned the request
-        req.trace_begin()
+        req.trace_begin(trace_ctx)
         self.metrics.on_request_in()
         if max_new_tokens == 0:     # zero-length generation: trivially done
             req.finish()

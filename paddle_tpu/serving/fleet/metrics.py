@@ -3,8 +3,11 @@
 Registered at import (idempotent, the serving/metrics.py idiom) but
 series-free until first touch — with ``FLAGS_serving_fleet`` off no
 router exists, nothing increments, and the registry snapshot carries
-no ``router_*`` series (test-pinned). All four are documented in the
+no ``router_*`` series (test-pinned). All five are documented in the
 README metrics catalog (the metric pass's machine-checked contract).
+The two histograms record trace-id exemplars through the registry
+hook when the router journals (FLAGS_monitor_trace), so a p99 bucket
+resolves to one request's fleet-wide timeline.
 
 ``router_requests_total{outcome}`` outcomes:
 
@@ -40,3 +43,7 @@ DISPATCH_SECONDS = _mhistogram(
     "router_dispatch_seconds",
     "admission -> accepted-by-a-replica latency, including the "
     "bounded retry-with-reroute walk")
+E2E_SECONDS = _mhistogram(
+    "router_e2e_seconds",
+    "router-observed admission -> terminal latency (queue + dispatch "
+    "walk + replica residency, across reroutes)")

@@ -10,6 +10,13 @@ the gauges the router scrapes for load):
 
     POST /sfleet/enqueue        {nonce, prompt, max_new_tokens,
                                  eos_token_id, deadline_s} -> {state}.
+                                 An optional ``traceparent`` field
+                                 (``pt1-<trace_id>-<span id>``) makes
+                                 the engine adopt the router's
+                                 fleet-wide trace context; absent
+                                 (journal off) the payload — and the
+                                 engine's local-mint tracing path —
+                                 is bit-identical to pre-trace.
                                  Nonce-idempotent: a retried dispatch
                                  (router saw a dead connection after
                                  we DID accept) maps to the existing
@@ -19,7 +26,11 @@ the gauges the router scrapes for load):
                                  (draining / queue_full).
     GET  /sfleet/result/{nonce} request progress: state, output token
                                  count, and the generated tokens once
-                                 terminal. 404 for an unknown nonce
+                                 terminal (plus the span summary —
+                                 trace_id + per-phase seconds — when
+                                 the journal is on, so the router
+                                 settles e2e attribution). 404 for an
+                                 unknown nonce
                                  (a restarted replica answers 404 for
                                  pre-restart nonces — the router
                                  re-routes them).
@@ -46,6 +57,7 @@ import json
 import threading
 import time
 
+from ...monitor import trace as _trace
 from ...monitor.exporter import MetricsServer
 from ...monitor.registry import warn_once
 from . import membership
@@ -150,13 +162,20 @@ class Replica:
         with self._mu:
             pending, self._pending = self._pending, []
         for nonce, payload in pending:
+            # cross-process trace context: the router's traceparent
+            # field adopts its fleet-wide trace id here, so the
+            # engine's phase spans land under it with the router's
+            # dispatch span as remote parent. (None, None) — absent
+            # or malformed — keeps the local-mint path.
+            ctx = _trace.parse_traceparent(payload.get("traceparent"))
             try:
                 rid = self.engine.add_request(
                     list(payload["prompt"]),
                     max_new_tokens=int(payload.get(
                         "max_new_tokens", 32)),
                     eos_token_id=payload.get("eos_token_id"),
-                    deadline_s=payload.get("deadline_s"))
+                    deadline_s=payload.get("deadline_s"),
+                    trace_ctx=ctx if ctx[0] is not None else None)
             except ValueError as e:
                 upd = {"state": "failed", "reason": "invalid",
                        "error": repr(e), "tokens": []}
@@ -186,6 +205,17 @@ class Replica:
                    "error": st["error"]}
             if st["state"] in _TERMINAL:
                 upd["tokens"] = self.engine.output(rid)
+                # span summary for the router's e2e attribution —
+                # computed here on the serve thread (handlers never
+                # touch the engine); (None, None) while the journal
+                # is off, and then the result payload carries no
+                # trace keys at all
+                tid, phases = self.engine.request_trace(rid)
+                if tid is not None:
+                    upd["trace_id"] = tid
+                    upd["phases_s"] = {
+                        k: round(v, 6)
+                        for k, v in (phases or {}).items()}
             with self._mu:
                 self._status[nonce].update(upd)
 
@@ -263,6 +293,11 @@ class Replica:
             out = {k: st[k] for k in (
                 "rid", "state", "reason", "output_tokens", "error",
                 "tokens")}
+            # replica span summary (present only when the journal was
+            # on at finish — the journal-off payload is bit-identical)
+            if "trace_id" in st:
+                out["trace_id"] = st["trace_id"]
+                out["phases_s"] = st["phases_s"]
         return 200, "application/json", json.dumps(out).encode()
 
     def _load(self):

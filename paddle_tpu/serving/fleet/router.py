@@ -35,6 +35,20 @@ Never-lose-an-accepted-request: a request that got a nonce is terminal
 — eviction, drain and dispatch failure all re-route, never drop (the
 ptcheck ``router_membership`` fixture explores exactly this against
 crash/lost-ack interleavings of the membership half).
+
+Tracing (FLAGS_monitor_trace, default off — every emitter below
+no-ops on a None trace id): ``submit()`` mints the fleet-wide trace
+and the router journals the dispatch half of the journey —
+``router_queue`` phases, a ``placement`` span per candidate pick
+(affinity depth / chosen replica / load score), a ``dispatch`` span
+per HTTP attempt (nonce, outcome), a ``reroute`` span naming WHY work
+moved (shed / 404 / lease-evicted / drain), and a ``settle`` span at
+terminal accounting. Each enqueue POST carries a traceparent field
+(``pt1-<trace_id>-<dispatch span id>``) so the replica engine's
+phase spans land under the SAME id with the dispatch span as remote
+parent; ``/sfleet/result`` hands the replica's span summary back for
+e2e attribution, and ``trace_segments()`` federates the replica
+fragments for ``/debugz/trace/{id}``.
 """
 from __future__ import annotations
 
@@ -49,9 +63,11 @@ import urllib.request
 
 from ...core import flags as _flags
 from ...monitor import fleet as _mfleet
+from ...monitor import trace as _trace
 from ...monitor.registry import warn_once
 from . import membership
-from .metrics import AFFINITY_HITS, DISPATCH_SECONDS, EVICTIONS, REQUESTS
+from .metrics import (AFFINITY_HITS, DISPATCH_SECONDS, E2E_SECONDS,
+                      EVICTIONS, REQUESTS)
 
 _ROUTER_THREAD = "pt-sfleet-router"
 
@@ -198,6 +214,7 @@ class Router:
         self._order = []        # nonces in admission order
         self._seq = itertools.count()
         self._salt = os.urandom(4).hex()
+        self._trace_index = {}  # trace_id -> nonce (federation lookup)
         self._stop = threading.Event()
         self._thread = None
         for rank, url in sorted((endpoints or {}).items()):
@@ -325,6 +342,14 @@ class Router:
         lost after this point: dispatch failure leaves it queued
         router-side and every pump retries."""
         nonce = "%s-%06d" % (self._salt, next(self._seq))
+        # fleet-wide trace (None while FLAGS_monitor_trace is off —
+        # every span call below no-ops on it): the router owns the
+        # trace id; replicas adopt it via the enqueue traceparent
+        tid = _trace.new_trace("fleet_request", nonce=nonce,
+                               prompt_tokens=len(prompt),
+                               max_new_tokens=int(max_new_tokens))
+        root = _trace.start_span("route", tid, kind="request",
+                                 nonce=nonce)
         with self._lock:
             req = {"nonce": nonce, "prompt": list(prompt),
                    "max_new_tokens": int(max_new_tokens),
@@ -336,9 +361,17 @@ class Router:
                    "first_token_at": None, "finished_at": None,
                    "output_tokens": 0, "tokens": None,
                    "affinity": False, "_dispatched_once": False,
-                   "status_reason": None}
+                   "status_reason": None,
+                   "trace_id": tid, "attempt_ranks": [],
+                   "attempts": [], "reroute_reasons": [],
+                   "replica_trace": None,
+                   "_span_root": root, "_span_queue": None}
             self._requests[nonce] = req
             self._order.append(nonce)
+            if tid is not None:
+                self._trace_index[tid] = nonce
+        req["_span_queue"] = _trace.start_span(
+            "router_queue", tid, parent_id=root, kind="phase")
         REQUESTS.labels("accepted").inc()
         self._try_dispatch(req)
         return nonce
@@ -351,6 +384,8 @@ class Router:
         candidates = self._candidates()
         affinity = self.affinity.match(req["prompt"])
         attempts = 0
+        tid = req.get("trace_id")
+        root = req.get("_span_root")
         while candidates and attempts < self.max_retries:
             load = {r: self._load_score(self._replicas[r])
                     for r in candidates}
@@ -360,22 +395,47 @@ class Router:
                 break
             attempts += 1
             ent = self._replicas[rank]
+            psid = _trace.start_span(
+                "placement", tid, parent_id=root, kind="placement",
+                replica=rank, affinity_depth=affinity.get(rank, 0),
+                load_score=round(load[rank], 4),
+                candidates=len(candidates))
+            _trace.end_span(psid)
+            dsid = _trace.start_span(
+                "dispatch", tid, parent_id=root, kind="dispatch",
+                nonce=req["nonce"], replica=rank,
+                attempt=len(req.get("attempts") or ()) + 1)
+            payload = {"nonce": req["nonce"], "prompt": req["prompt"],
+                       "max_new_tokens": req["max_new_tokens"],
+                       "eos_token_id": req["eos_token_id"],
+                       "deadline_s": req["deadline_s"]}
+            # cross-process context: the dispatch span is the remote
+            # parent of the replica engine's request span. Absent
+            # while the journal is off — the wire stays bit-identical.
+            tp = _trace.format_traceparent(tid, dsid)
+            if tp is not None:
+                payload["traceparent"] = tp
             try:
                 code, resp = _http_post_json(
-                    ent["url"] + "/sfleet/enqueue",
-                    {"nonce": req["nonce"], "prompt": req["prompt"],
-                     "max_new_tokens": req["max_new_tokens"],
-                     "eos_token_id": req["eos_token_id"],
-                     "deadline_s": req["deadline_s"]},
+                    ent["url"] + "/sfleet/enqueue", payload,
                     self.http_timeout_s)
             except _SCRAPE_ERRORS:
                 # unreachable mid-dispatch: suspect it, walk on — the
                 # nonce makes the retry idempotent even if the replica
                 # DID admit before the connection died
+                _trace.end_span(dsid, outcome="unreachable")
+                req["attempts"].append(
+                    {"rank": rank, "outcome": "unreachable"})
                 self.drain(rank, reason="dispatch_failed")
                 candidates.remove(rank)
                 continue
             if code == 200:
+                _trace.end_span(
+                    dsid, outcome="accepted",
+                    deduped=bool(resp.get("deduped")))
+                req["attempts"].append(
+                    {"rank": rank, "outcome": "accepted"})
+                req["attempt_ranks"].append(rank)
                 req["rank"] = rank
                 req["state"] = "dispatched"
                 req["replica_state"] = resp.get("state") or "queued"
@@ -392,15 +452,23 @@ class Router:
                 ent["dispatches"] += 1
                 ent["queue_depth"] += 1     # optimistic, until rescrape
                 self.affinity.note(req["prompt"], rank)
-                DISPATCH_SECONDS.observe(
-                    max(self._clock() - req["submitted_at"], 0.0))
+                if req.get("_span_queue") is not None:
+                    _trace.end_span(req["_span_queue"], replica=rank)
+                    req["_span_queue"] = None
+                with _trace.exemplar_context(tid):
+                    DISPATCH_SECONDS.observe(
+                        max(self._clock() - req["submitted_at"], 0.0))
                 return True
             # 409 draining / queue_full, or any other refusal: walk on
             reason = (resp or {}).get("error")
+            _trace.end_span(dsid, outcome="refused", reason=reason)
+            req["attempts"].append(
+                {"rank": rank, "outcome": "refused", "reason": reason})
             if reason == "draining":
                 self.drain(rank, reason="admission_draining")
             candidates.remove(rank)
             affinity.pop(rank, None)
+        _trace.add_event(root, "unroutable", attempts=attempts)
         REQUESTS.labels("unroutable").inc()
         return False
 
@@ -422,7 +490,7 @@ class Router:
         if code == 404:
             # the replica does not know the nonce (restarted with a
             # new generation): the work is gone, re-route it
-            self._reroute(req)
+            self._reroute(req, "404")
             return
         if code != 200:
             return
@@ -435,23 +503,70 @@ class Router:
                 resp.get("reason") in ("draining", "queue_full"):
             # the replica shed it at admission (the pre-check raced a
             # drain): the request never ran — re-route, don't fail it
-            self._reroute(req)
+            self._reroute(req, "shed")
             return
         if resp.get("state") in _REPLICA_TERMINAL_OK:
             req["state"] = "finished"
             req["tokens"] = resp.get("tokens")
             req["finished_at"] = self._clock()
             REQUESTS.labels("finished").inc()
+            self._settle(req, "finished", resp)
         elif resp.get("state") in _REPLICA_TERMINAL_BAD:
             req["state"] = "failed"
             req["status_reason"] = resp.get("reason")
             req["finished_at"] = self._clock()
             REQUESTS.labels("failed").inc()
+            self._settle(req, "failed", resp)
 
-    def _reroute(self, req):
+    def _settle(self, req, status, resp):
+        """Terminal accounting: e2e histogram (+ trace-id exemplar),
+        the replica's span summary from the result payload, and the
+        settle/root span closes."""
+        e2e = max(req["finished_at"] - req["submitted_at"], 0.0)
+        with _trace.exemplar_context(req.get("trace_id")):
+            E2E_SECONDS.observe(e2e)
+        if resp.get("trace_id") is not None:
+            req["replica_trace"] = {
+                "trace_id": resp.get("trace_id"),
+                "phases_s": resp.get("phases_s")}
+        tid = req.get("trace_id")
+        if tid is None:
+            return
+        if req.get("_span_queue") is not None:
+            _trace.end_span(req["_span_queue"])
+            req["_span_queue"] = None
+        ssid = _trace.start_span(
+            "settle", tid, parent_id=req.get("_span_root"),
+            kind="settle", replica=req["rank"], status=status,
+            reroutes=req["reroutes"],
+            replica_phases_s=(req["replica_trace"] or {}).get(
+                "phases_s"))
+        _trace.end_span(ssid)
+        _trace.end_span(req.get("_span_root"), status=status,
+                        replica=req["rank"],
+                        output_tokens=req["output_tokens"],
+                        reroutes=req["reroutes"],
+                        e2e_s=round(e2e, 6))
+        req["_span_root"] = None
+
+    def _reroute(self, req, reason):
+        """Move the work: the reroute span names WHY (shed / 404 /
+        lease-evicted / drain) — the causality the merged fleet
+        timeline pins."""
+        rsid = _trace.start_span(
+            "reroute", req.get("trace_id"),
+            parent_id=req.get("_span_root"), kind="reroute",
+            reason=reason, from_rank=req["rank"])
+        _trace.end_span(rsid)
+        req["reroute_reasons"].append(reason)
         req["state"] = "queued"
         req["rank"] = None
         req["replica_state"] = None
+        if req.get("trace_id") is not None and \
+                req.get("_span_queue") is None:
+            req["_span_queue"] = _trace.start_span(
+                "router_queue", req["trace_id"],
+                parent_id=req.get("_span_root"), kind="phase")
         self._try_dispatch(req)
 
     def pump(self):
@@ -470,12 +585,12 @@ class Router:
             ent = self._replicas.get(req["rank"])
             if ent is None or ent["state"] == "evicted":
                 # the replica died with the work: re-dispatch
-                self._reroute(req)
+                self._reroute(req, "lease-evicted")
             elif ent["state"] == "draining" and \
                     req["replica_state"] in (None, "queued"):
                 # drain-and-reschedule: queued-but-unstarted work moves
                 # off the draining replica (started work finishes there)
-                self._reroute(req)
+                self._reroute(req, "drain")
             else:
                 self._poll_request(req)
         return {"outstanding": outstanding,
@@ -559,11 +674,47 @@ class Router:
                                 "nonce": nonce}).encode())
         out = {k: req[k] for k in (
             "nonce", "state", "rank", "replica_state", "reroutes",
-            "output_tokens", "tokens", "affinity", "status_reason")}
+            "output_tokens", "tokens", "affinity", "status_reason",
+            "trace_id", "attempt_ranks", "reroute_reasons")}
         return (200, "application/json",
                 json.dumps(out, default=str).encode())
 
     # -- debugz payloads (monitor/fleet.py hook protocol) ----------------
+
+    def trace_segments(self, trace_id):
+        """Federation fetch for ``/debugz/trace/{id}``: pull the
+        replica-side fragments of one fleet trace on demand —
+        ``GET {replica}/debugz/trace/{id}`` from the ranks the request
+        was actually dispatched to (every non-evicted replica when the
+        id is not a router-minted request trace). Best-effort: an
+        unreachable replica contributes an error stub, never an
+        exception (narrow-catch)."""
+        nonce = self._trace_index.get(trace_id)
+        req = self._requests.get(nonce) if nonce is not None else None
+        if req is not None and req.get("attempt_ranks"):
+            ranks = sorted(set(req["attempt_ranks"]))
+        else:
+            ranks = [r for r, e in sorted(self._replicas.items())
+                     if e["state"] != "evicted"]
+        segments = {}
+        for rank in ranks:
+            ent = self._replicas.get(rank)
+            if ent is None or not ent["url"]:
+                continue
+            try:
+                # ?local=1: ask for the replica's LOCAL fragment — a
+                # fragment fetch must never trigger a nested federation
+                code, seg = _http_get_json(
+                    "%s/debugz/trace/%s?local=1" % (ent["url"],
+                                                    trace_id),
+                    self.http_timeout_s)
+            except _SCRAPE_ERRORS as e:
+                segments[str(rank)] = {"error": repr(e)}
+                continue
+            segments[str(rank)] = (
+                seg if code == 200 else dict(
+                    seg or {}, error="http %d" % code))
+        return {"nonce": nonce, "segments": segments}
 
     def debug_payload(self):
         by_state = {}

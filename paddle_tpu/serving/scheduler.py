@@ -133,15 +133,29 @@ class Request:
     # durations sum to the request's e2e latency (the acceptance
     # contract tests/test_trace.py pins at +-5%).
 
-    def trace_begin(self):
+    def trace_begin(self, trace_ctx=None):
+        """``trace_ctx=(trace_id, parent_span_id)`` adopts a context
+        minted by another process (the fleet router's traceparent): the
+        engine's phase spans land under the SAME fleet-wide trace id,
+        the root span naming the sender's dispatch span as its remote
+        parent."""
         if not _trace.is_enabled():
             return
-        self.trace_id = _trace.new_trace(
-            "request", request_id=self.id,
-            prompt_tokens=len(self.prompt),
-            max_new_tokens=self.max_new_tokens)
+        remote_parent = None
+        if trace_ctx is not None and trace_ctx[0] is not None:
+            self.trace_id = _trace.adopt_trace(
+                trace_ctx[0], "request", request_id=self.id,
+                prompt_tokens=len(self.prompt),
+                max_new_tokens=self.max_new_tokens)
+            remote_parent = trace_ctx[1]
+        else:
+            self.trace_id = _trace.new_trace(
+                "request", request_id=self.id,
+                prompt_tokens=len(self.prompt),
+                max_new_tokens=self.max_new_tokens)
         self._span_root = _trace.start_span(
-            "request", self.trace_id, kind="request", request_id=self.id)
+            "request", self.trace_id, kind="request", request_id=self.id,
+            remote_parent=remote_parent)
         self.metrics.trace_id = self.trace_id
 
     def trace_phase(self, phase, **attrs):

@@ -307,3 +307,115 @@ class TestRouteMatrixAllOn:
         finally:
             router.close()
         assert fleet._router_hook is None
+
+
+class TestTraceFederation:
+    """/debugz/trace/{id} ``federation`` matrix (ISSUE 17): pinned per
+    FLAGS_serving_fleet x FLAGS_monitor_trace disposition — off means
+    ``enabled: false`` with ZERO cross-replica fetches and no new
+    threads; on means the replica fragments federate on demand."""
+
+    def _local_trace(self):
+        tid = trace.new_trace("fleet_request", nonce="n-1")
+        sid = trace.start_span("route", tid, kind="request")
+        trace.end_span(sid)
+        return tid
+
+    def test_trace_on_fleet_off_pins_disabled_zero_fetches(
+            self, server):
+        paddle.set_flags({"FLAGS_monitor_trace": True})
+        trace.enable()
+        tid = self._local_trace()
+
+        # a hook whose fetch path fires despite the flag being off is
+        # the contract bug this test exists to catch
+        class _Boom:
+            def trace_segments(self, _tid):
+                pytest.fail("federation fetched with "
+                            "FLAGS_serving_fleet off")
+
+        fleet.set_router_hook(_Boom())
+        try:
+            import threading as _threading
+            threads_before = set(_threading.enumerate())
+            code, body = _get(server, "debugz/trace/%s" % tid)
+            assert code == 200
+            p = json.loads(body.decode())
+            assert p["federation"] == {"enabled": False}
+            # the 404-for-unknown contract is unchanged by federation
+            code, _ = _get(server, "debugz/trace/no-such-trace")
+            assert code == 404
+            assert set(_threading.enumerate()) == threads_before
+        finally:
+            fleet.clear_router_hook()
+
+    def test_fleet_on_trace_off_unknown_ids_404(self, server):
+        paddle.set_flags({"FLAGS_serving_fleet": True})
+        # journal off: no traces exist, so every id 404s — federation
+        # never runs for a trace that cannot resolve locally
+        code, body = _get(server, "debugz/trace/anything")
+        assert code == 404
+        assert json.loads(body.decode())["error"] == "unknown trace"
+
+    def test_both_on_hook_without_segments_pins_empty(self, server):
+        paddle.set_flags({"FLAGS_serving_fleet": True,
+                          "FLAGS_monitor_trace": True})
+        trace.enable()
+        tid = self._local_trace()
+        fleet.set_router_hook(object())     # duck-type: no
+        try:                                # trace_segments attr
+            _, body = _get(server, "debugz/trace/%s" % tid)
+            p = json.loads(body.decode())
+            assert p["federation"] == {"enabled": True, "segments": {}}
+        finally:
+            fleet.clear_router_hook()
+
+    def test_both_on_unreachable_replica_degrades_to_error_stub(
+            self, server):
+        from paddle_tpu.serving.fleet import Router
+
+        paddle.set_flags({"FLAGS_serving_fleet": True,
+                          "FLAGS_monitor_trace": True})
+        trace.enable()
+        tid = self._local_trace()
+        router = Router(endpoints={0: "http://127.0.0.1:1"})
+        try:
+            code, body = _get(server, "debugz/trace/%s" % tid)
+            assert code == 200          # best-effort, never a crash
+            p = json.loads(body.decode())
+            fed = p["federation"]
+            assert fed["enabled"] is True
+            assert "error" in fed["segments"]["0"]
+        finally:
+            router.close()
+
+    def test_both_on_federates_replica_fragments(self, server):
+        """Endpoint-mode router pointing at a second in-process
+        MetricsServer: the federation block carries that 'replica's'
+        fragment, and the fragment is the LOCAL view (?local=1) — a
+        fragment fetch never recurses into another fan-out."""
+        from paddle_tpu.serving.fleet import Router
+
+        paddle.set_flags({"FLAGS_serving_fleet": True,
+                          "FLAGS_monitor_trace": True})
+        trace.enable()
+        tid = self._local_trace()
+        replica_srv = monitor.MetricsServer(port=0).start()
+        router = Router(endpoints={
+            0: "http://127.0.0.1:%d" % replica_srv.port})
+        try:
+            code, body = _get(server, "debugz/trace/%s" % tid)
+            assert code == 200
+            p = json.loads(body.decode())
+            fed = p["federation"]
+            assert fed["enabled"] is True
+            frag = fed["segments"]["0"]
+            assert frag["trace_id"] == tid
+            assert frag["spans"][0]["name"] == "route"
+            # the fragment is local-only: no nested federation block
+            assert "federation" not in frag
+            # a router-submitted id resolves its nonce for attribution
+            assert fed["nonce"] is None     # not router-submitted here
+        finally:
+            router.close()
+            replica_srv.stop()

@@ -386,4 +386,255 @@ class TestFleetEndToEnd:
             rep.stop()
 
 
+# ---------------------------------------------------------------------------
+# distributed tracing (ISSUE 17): cross-process context + reroute causality
+# ---------------------------------------------------------------------------
+
+from paddle_tpu.monitor import trace as mtrace  # noqa: E402
+from paddle_tpu.monitor import trace_merge as tmerge  # noqa: E402
+
+
+@pytest.fixture()
+def trace_flag():
+    paddle.set_flags({"FLAGS_monitor_trace": True})
+    mtrace.enable()
+    yield
+    paddle.set_flags({"FLAGS_monitor_trace": False})
+    mtrace.disable()
+    mtrace.clear()
+
+
+class TestFleetTracing:
+    def test_request_journey_is_one_trace_across_router_and_engine(
+            self, llama, fleet_flag, trace_flag, store_pair):
+        """The tentpole contract: the router mints the trace, the
+        enqueue traceparent carries it, and the replica engine's phase
+        spans land under the SAME id with the dispatch span as remote
+        parent; /sfleet/result hands the span summary back for the
+        settle span's e2e attribution."""
+        model, _ = llama
+        replicas, router = _mk_fleet(model, store_pair, 1)
+        try:
+            rng = np.random.RandomState(3)
+            nonce = router.submit(rng.randint(1, 64, size=8).tolist(),
+                                  max_new_tokens=4)
+            assert router.wait_all(timeout_s=180)
+            req = router.request(nonce)
+            assert req["state"] == "finished"
+            tid = req["trace_id"]
+            assert tid is not None
+            tr = mtrace.get_trace(tid)
+            names = {s["name"] for s in tr["spans"]}
+            # router half AND engine half, one trace id
+            assert {"route", "router_queue", "placement", "dispatch",
+                    "settle"} <= names
+            assert {"request", "prefill", "decode"} <= names
+            dispatch = next(s for s in tr["spans"]
+                            if s["kind"] == "dispatch")
+            assert dispatch["attrs"]["outcome"] == "accepted"
+            assert dispatch["attrs"]["nonce"] == nonce
+            engine_root = next(s for s in tr["spans"]
+                               if s["kind"] == "request"
+                               and s["name"] == "request")
+            assert engine_root["remote_parent"] == dispatch["span_id"]
+            # the result payload's span summary settled e2e attribution
+            assert req["replica_trace"]["trace_id"] == tid
+            assert req["replica_trace"]["phases_s"]["decode"] > 0
+            settle = next(s for s in tr["spans"]
+                          if s["kind"] == "settle")
+            assert settle["attrs"]["status"] == "finished"
+            assert settle["attrs"]["replica_phases_s"]["prefill"] >= 0
+            root = next(s for s in tr["spans"] if s["name"] == "route")
+            assert root["attrs"]["status"] == "finished"
+            assert root["attrs"]["e2e_s"] > 0
+            # dispatch + e2e histograms carry trace-id exemplars
+            assert any(e["trace_id"] == tid for e in
+                       mtrace.exemplars("router_e2e_seconds").values())
+            assert any(
+                e["trace_id"] == tid for e in
+                mtrace.exemplars("router_dispatch_seconds").values())
+            # phase breakdown includes the router queue hop
+            assert "router_queue" in mtrace.phase_breakdown(tid)
+        finally:
+            for rep in replicas:
+                rep.stop()
+            router.close()
+
+    def test_killed_replica_trace_pins_reroute_causality(
+            self, llama, fleet_flag, trace_flag, store_pair):
+        """THE acceptance pin (ISSUE 17): a rerouted request's merged
+        timeline shows attempt 1 on the victim, a reroute span naming
+        the reason, and attempt 2 finishing on the survivor — all
+        under ONE trace id."""
+        model, _ = llama
+        replicas, router = _mk_fleet(model, store_pair, 2)
+        try:
+            rng = np.random.RandomState(4)
+            nonces = [router.submit(
+                rng.randint(1, 64, size=10).tolist(), max_new_tokens=5)
+                for _ in range(6)]
+            victim = next(
+                r["rank"]
+                for n in nonces
+                for r in [router.request(n)]
+                if r["rank"] is not None)
+            moved = [n for n in nonces
+                     if router.request(n)["rank"] == victim]
+            replicas[victim].stop(deregister=True)
+            assert router.wait_all(timeout_s=180)
+            req = router.request(moved[0])
+            assert req["state"] == "finished"
+            assert req["reroutes"] >= 1
+            survivor = req["rank"]
+            assert survivor != victim
+            assert req["attempt_ranks"][0] == victim
+            assert req["attempt_ranks"][-1] == survivor
+            tid = req["trace_id"]
+            tr = mtrace.get_trace(tid)
+            dispatches = [s for s in tr["spans"]
+                          if s["kind"] == "dispatch"]
+            assert dispatches[0]["attrs"]["replica"] == victim
+            assert dispatches[0]["attrs"]["outcome"] == "accepted"
+            assert dispatches[-1]["attrs"]["replica"] == survivor
+            assert dispatches[-1]["attrs"]["outcome"] == "accepted"
+            reroutes = [s for s in tr["spans"]
+                        if s["kind"] == "reroute"]
+            assert reroutes, "reroute span missing from the timeline"
+            assert reroutes[0]["attrs"]["reason"] in (
+                "lease-evicted", "404", "shed", "drain")
+            assert reroutes[0]["attrs"]["from_rank"] == victim
+            assert req["reroute_reasons"][0] == \
+                reroutes[0]["attrs"]["reason"]
+            # causality reads left-to-right: attempt 1, reroute,
+            # attempt 2
+            assert dispatches[0]["t_start"] \
+                <= reroutes[0]["t_start"] <= dispatches[-1]["t_start"]
+            # ...and the merged-artifact summary table pins the same
+            # chain from the router journal alone (a SIGKILLed
+            # victim's own journal dies with it)
+            row = tmerge.fleet_trace_summary(mtrace.dump())[tid]
+            assert [d["replica"] for d in row["dispatches"]
+                    if d["outcome"] == "accepted"] == \
+                req["attempt_ranks"]
+            assert row["reroutes"][0]["reason"] == \
+                reroutes[0]["attrs"]["reason"]
+            # no recompile storm on the survivor, even traced
+            assert replicas[survivor].engine.stats()[
+                "decode_compiles"] == 1
+        finally:
+            for rep in replicas:
+                rep.stop()
+            router.close()
+
+    def test_trace_off_pins_wire_format_and_result_keys(
+            self, llama, fleet_flag, store_pair, monkeypatch):
+        """Flags-off bit-identical pin: journal off means NO
+        traceparent field on the enqueue wire, NO trace keys in the
+        result payload, no trace ids router-side, and an empty
+        journal."""
+        import paddle_tpu.serving.fleet.router as rmod
+
+        assert not paddle.get_flags(
+            ["FLAGS_monitor_trace"])["FLAGS_monitor_trace"]
+        sent = []
+        orig = rmod._http_post_json
+
+        def spy(url, payload, timeout_s):
+            sent.append(payload)
+            return orig(url, payload, timeout_s)
+
+        monkeypatch.setattr(rmod, "_http_post_json", spy)
+        model, _ = llama
+        replicas, router = _mk_fleet(model, store_pair, 1)
+        try:
+            rng = np.random.RandomState(5)
+            nonce = router.submit(rng.randint(1, 64, size=8).tolist(),
+                                  max_new_tokens=3)
+            assert router.wait_all(timeout_s=180)
+            req = router.request(nonce)
+            assert req["state"] == "finished"
+            assert req["trace_id"] is None
+            assert req["replica_trace"] is None
+            assert sent and all("traceparent" not in p for p in sent)
+            with urllib.request.urlopen(
+                    "%s/sfleet/result/%s" % (replicas[0].url, nonce),
+                    timeout=10) as r:
+                st = json.loads(r.read().decode())
+            assert "trace_id" not in st and "phases_s" not in st
+            assert mtrace._state.traces == {}
+            assert mtrace._state.exemplars == {}
+            # status payload still reports the (empty) walk accounting
+            assert req["attempt_ranks"] == [0]
+            assert req["reroute_reasons"] == []
+        finally:
+            for rep in replicas:
+                rep.stop()
+            router.close()
+
+
+@pytest.mark.slow
+class TestFleetBenchmarkTracing:
+    def test_benchmark_kill_run_emits_merged_reroute_timeline(
+            self, tmp_path):
+        """The ISSUE-17 acceptance row, subprocess-for-real: a
+        3-replica --fleet --kill-replica-at run loses nothing, and the
+        merged clock-aligned timeline shows >=1 rerouted request whose
+        chain reads attempt 1 on the victim, a reroute span naming the
+        reason, attempt 2 on a survivor — under ONE trace id."""
+        import os
+        import subprocess
+        import sys
+
+        repo = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        out = str(tmp_path / "snap.json")
+        trace_out = str(tmp_path / "fleet_trace.json")
+        p = subprocess.run(
+            [sys.executable,
+             os.path.join(repo, "tools", "serving_benchmark.py"),
+             "--fleet", "3", "--kill-replica-at", "0.3",
+             "--requests", "16", "--rate", "30",
+             "--max-new", "12", "24", "--preset", "tiny",
+             "--max-slots", "2", "--num-blocks", "64",
+             "--out", out, "--fleet-trace-out", trace_out,
+             "--watchdog", "540"],
+            capture_output=True, text=True, timeout=560,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"), cwd=repo)
+        assert p.returncode == 0, (p.stdout[-2000:], p.stderr[-2000:])
+        report = json.load(open(out))
+        assert report["lost_requests"] == []
+        assert report["trace"]["enabled"] is True
+        doc = json.load(open(trace_out))
+        assert doc["kind"] == "fleet_trace"
+        assert doc["metadata"]["router_cid"]
+        rerouted = {tid: row for tid, row in doc["requests"].items()
+                    if row["reroutes"]}
+        assert rerouted, "kill run produced no rerouted request"
+        killed = report["kill"]["killed_rank"]
+        for tid, row in rerouted.items():
+            accepted = [d for d in row["dispatches"]
+                        if d["outcome"] == "accepted"]
+            assert accepted[0]["replica"] == killed
+            assert accepted[-1]["replica"] != killed
+            assert row["reroutes"][0]["reason"] in (
+                "lease-evicted", "404", "shed", "drain")
+            assert row["reroutes"][0]["from_rank"] == killed
+            assert accepted[0]["t_start"] \
+                <= row["reroutes"][0]["t_start"] \
+                <= accepted[-1]["t_start"]
+        # the requests_detail rows agree with the merged artifact
+        detail = {r["trace_id"]: r
+                  for r in report["kill"]["requests_detail"]}
+        for tid, row in rerouted.items():
+            r = detail[tid]
+            assert r["state"] == "finished"
+            assert r["attempt_ranks"][0] == killed
+            assert r["attempt_ranks"][-1] != killed
+            assert len(r["hops"]["dispatch_attempts"]) >= 2
+        # surviving replicas' journals merged in (the victim's died
+        # with the SIGKILL; its evidence lives in the router spans)
+        ranks = doc["metadata"]["replica_ranks"]
+        assert killed not in ranks and len(ranks) >= 1
+
+
 import urllib.error  # noqa: E402  (used by the 404 pin above)

@@ -545,3 +545,241 @@ class TestChromeRoundTrip:
         p.write_text(json.dumps({"traceEvents": []}))
         with pytest.raises(ValueError):
             tmerge.load_journal(str(p))
+
+
+# ---------------------------------------------------------------------------
+# cross-process context (ISSUE 17): cid ids, traceparent, adoption
+# ---------------------------------------------------------------------------
+
+class TestCrossProcessContext:
+    def test_trace_ids_minted_off_random_cid_not_pid(self):
+        """The collision fix: ids come from a per-process RANDOM 64-bit
+        cid, not the pid — pid-minted ids collide across hosts and
+        recycle within one, silently fusing unrelated requests in
+        fleet-merged journals."""
+        trace.enable()
+        cid = trace._state.cid
+        assert len(cid) == 16
+        int(cid, 16)                       # parses as hex
+        assert cid != "%x" % os.getpid()
+        assert cid != "%016x" % os.getpid()
+        tid = trace.new_trace("request")
+        assert tid.startswith(cid + ".")
+        # two states (= two processes) mint from distinct id spaces
+        assert trace._TraceState().cid != trace._TraceState().cid
+
+    def test_journal_round_trips_cid(self, tmp_path):
+        trace.enable()
+        trace.new_trace("request")
+        path = str(tmp_path / "journal.json")
+        journal = trace.write_journal(path)
+        assert journal["cid"] == trace._state.cid
+        assert tmerge.load_journal(path)["cid"] == trace._state.cid
+
+    def test_traceparent_format_parse_round_trip(self):
+        tp = trace.format_traceparent("deadbeef.5", 11)
+        assert tp == "pt1-deadbeef.5-b"
+        assert trace.parse_traceparent(tp) == ("deadbeef.5", 11)
+        # span-less context: the sender journals but had no span open
+        assert trace.parse_traceparent(
+            trace.format_traceparent("deadbeef.5")) == ("deadbeef.5",
+                                                        None)
+        # journal-off sender emits NO context field
+        assert trace.format_traceparent(None) is None
+        assert trace.format_traceparent(None, 11) is None
+        # malformed/foreign input degrades to no-linkage, never raises
+        for bad in (None, "", 7, "pt1", "pt2-x-1", "pt1--1",
+                    "pt1-x-zz", "pt1-x-1-2"):
+            assert trace.parse_traceparent(bad) == (None, None)
+
+    def test_adopt_trace_registers_foreign_id_and_remote_parent(self):
+        trace.enable()
+        tid = trace.adopt_trace("feedface.3", "request", request_id=1)
+        assert tid == "feedface.3"
+        tr = trace.get_trace(tid)
+        assert tr["attrs"]["adopted"] is True
+        assert tr["attrs"]["request_id"] == 1
+        # re-adoption merges attrs instead of duplicating the trace
+        assert trace.adopt_trace(tid, "request", extra=2) == tid
+        assert trace.get_trace(tid)["attrs"]["extra"] == 2
+        assert len([t for t in trace._state.traces if t == tid]) == 1
+        sid = trace.start_span("request", tid, kind="request",
+                               remote_parent=42)
+        trace.end_span(sid)
+        span = trace.get_trace(tid)["spans"][-1]
+        assert span["remote_parent"] == 42
+        assert span["parent_id"] is None    # separate id spaces
+        # the chrome export carries the linkage for the fleet merge
+        evs = trace.chrome_events_from_journal(trace.dump())
+        x = next(e for e in evs if e.get("ph") == "X"
+                 and e["name"] == "request")
+        assert x["args"]["remote_parent"] == 42
+
+    def test_adopt_trace_disabled_or_none_noops(self):
+        assert trace.adopt_trace("feedface.3", "request") is None
+        trace.enable()
+        assert trace.adopt_trace(None, "request") is None
+        assert trace._state.traces == {}
+
+
+# ---------------------------------------------------------------------------
+# fleet-journal merge (ISSUE 17): router + replica journals, ONE trace
+# ---------------------------------------------------------------------------
+
+_FLEET_TID = "aaaaaaaaaaaaaaaa.0"
+
+
+def _mk_span(sid, name, kind, t0, t1, parent=None, remote_parent=None,
+             **attrs):
+    s = {"span_id": sid, "trace_id": _FLEET_TID, "parent_id": parent,
+         "name": name, "kind": kind, "t_start": t0, "t_end": t1,
+         "attrs": dict(attrs), "events": []}
+    if remote_parent is not None:
+        s["remote_parent"] = remote_parent
+    return s
+
+
+def _mk_journal(cid, traces):
+    return {"kind": "trace_journal", "version": 1, "pid": 1,
+            "cid": cid, "written_at": "t",
+            "clock_anchor": {"wall": 100.0, "monotonic": 50.0},
+            "exemplars": {}, "traces": traces}
+
+
+class TestFleetJournalMerge:
+    """Synthetic router + replica journals reproducing the acceptance
+    shape: attempt 1 dispatched to replica 0 (then killed), a reroute
+    span naming the reason, attempt 2 finishing on replica 1 — all
+    under ONE trace id, stitched on (trace_id, remote_parent)."""
+
+    def _journals(self):
+        router = _mk_journal("bbbbbbbbbbbbbbbb", {_FLEET_TID: {
+            "trace_id": _FLEET_TID, "name": "fleet_request",
+            "attrs": {"nonce": "n-1"}, "t_start": 10.0, "open_spans": 0,
+            "spans": [
+                _mk_span(0, "route", "request", 10.0, 14.0),
+                _mk_span(1, "router_queue", "phase", 10.0, 10.5,
+                         parent=0),
+                _mk_span(2, "dispatch", "dispatch", 10.5, 10.6,
+                         parent=0, nonce="n-1", replica=0,
+                         outcome="accepted", attempt=1),
+                _mk_span(3, "reroute", "reroute", 12.0, 12.0, parent=0,
+                         reason="lease-evicted", from_rank=0),
+                _mk_span(4, "dispatch", "dispatch", 12.1, 12.2,
+                         parent=0, nonce="n-1", replica=1,
+                         outcome="accepted", attempt=2),
+                _mk_span(5, "settle", "settle", 14.0, 14.0, parent=0,
+                         replica=1, status="finished"),
+            ]}})
+        victim = _mk_journal("cccccccccccccccc", {_FLEET_TID: {
+            "trace_id": _FLEET_TID, "name": "request",
+            "attrs": {"adopted": True}, "t_start": 10.5,
+            "open_spans": 1,
+            "spans": [_mk_span(0, "request", "request", 10.5, None,
+                               remote_parent=2)]}})
+        survivor = _mk_journal("dddddddddddddddd", {_FLEET_TID: {
+            "trace_id": _FLEET_TID, "name": "request",
+            "attrs": {"adopted": True}, "t_start": 12.1,
+            "open_spans": 0,
+            "spans": [_mk_span(0, "request", "request", 12.1, 14.0,
+                               remote_parent=4)]}})
+        return router, {0: victim, 1: survivor}
+
+    def test_merge_prefixes_pids_and_stitches_flows(self):
+        router, replicas = self._journals()
+        evs = tmerge.merge_fleet_journals(router, replicas)
+        pids = {e["pid"] for e in evs}
+        assert "router/fleet_request" in pids
+        assert "replica0/request" in pids and "replica1/request" in pids
+        # one flow arrow per adopted replica span, dispatch -> request
+        starts = [e for e in evs if e.get("ph") == "s"]
+        finishes = [e for e in evs if e.get("ph") == "f"]
+        assert len(starts) == 2 and len(finishes) == 2
+        ids = {e["id"] for e in starts}
+        assert ids == {"%s/2/r0" % _FLEET_TID, "%s/4/r1" % _FLEET_TID}
+        assert {e["id"] for e in finishes} == ids
+        # the arrow leaves the router track and lands on the replica's
+        f1 = next(e for e in finishes
+                  if e["id"] == "%s/4/r1" % _FLEET_TID)
+        assert f1["pid"] == "replica1/request"
+        s1 = next(e for e in starts
+                  if e["id"] == "%s/4/r1" % _FLEET_TID)
+        assert s1["pid"] == "router/fleet_request"
+        assert s1["ts"] == pytest.approx(12.1 * 1e6)
+
+    def test_merge_applies_clock_offsets_to_replica_events(self):
+        router, replicas = self._journals()
+        evs = tmerge.merge_fleet_journals(router, replicas,
+                                          offsets={1: 0.5})
+        # replica 1's clock runs 0.5s AHEAD of the router's: its spans
+        # shift LEFT by 0.5s onto the router timebase
+        x1 = next(e for e in evs if e.get("ph") == "X"
+                  and e["pid"] == "replica1/request")
+        assert x1["ts"] == pytest.approx((12.1 - 0.5) * 1e6)
+        f1 = next(e for e in evs if e.get("ph") == "f"
+                  and e["id"] == "%s/4/r1" % _FLEET_TID)
+        assert f1["ts"] == pytest.approx((12.1 - 0.5) * 1e6)
+        # router events never shift (it IS the timebase)
+        xr = next(e for e in evs if e.get("ph") == "X"
+                  and e["name"] == "route")
+        assert xr["ts"] == pytest.approx(10.0 * 1e6)
+
+    def test_fleet_trace_summary_orders_reroute_causality(self):
+        router, _ = self._journals()
+        summary = tmerge.fleet_trace_summary(router)
+        row = summary[_FLEET_TID]
+        assert row["nonce"] == "n-1"
+        assert [d["replica"] for d in row["dispatches"]] == [0, 1]
+        assert [d["outcome"] for d in row["dispatches"]] == \
+            ["accepted", "accepted"]
+        assert [r["reason"] for r in row["reroutes"]] == \
+            ["lease-evicted"]
+        assert row["reroutes"][0]["from_rank"] == 0
+        # attempt 1 precedes the reroute precedes attempt 2
+        assert row["dispatches"][0]["t_start"] \
+            < row["reroutes"][0]["t_start"] \
+            < row["dispatches"][1]["t_start"]
+
+    def test_write_fleet_timeline_artifact(self, tmp_path):
+        router, replicas = self._journals()
+        path = str(tmp_path / "fleet_trace.json")
+        doc = tmerge.write_fleet_timeline(path, router, replicas,
+                                          offsets={1: 0.5},
+                                          meta={"tool": "test"})
+        on_disk = json.load(open(path))
+        assert on_disk["kind"] == "fleet_trace"
+        assert on_disk["requests"][_FLEET_TID]["reroutes"][0]["reason"] \
+            == "lease-evicted"
+        md = on_disk["metadata"]
+        assert md["tool"] == "test"
+        assert md["router_cid"] == "bbbbbbbbbbbbbbbb"
+        assert md["replica_ranks"] == [0, 1]
+        assert md["clock_offsets_s"] == {"1": 0.5}
+        assert len(doc["traceEvents"]) == len(on_disk["traceEvents"])
+
+    def test_trace_merge_cli_fleet_mode(self, tmp_path):
+        router, replicas = self._journals()
+        rp = str(tmp_path / "router.json")
+        json.dump(router, open(rp, "w"))
+        reps = []
+        for r, j in replicas.items():
+            p = str(tmp_path / ("replica%d.json" % r))
+            json.dump(j, open(p, "w"))
+            reps += ["--fleet-replica", "%d=%s" % (r, p)]
+        out = str(tmp_path / "merged.json")
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        try:
+            import trace_merge as cli
+        finally:
+            sys.path.pop(0)
+        rc = cli.main(["--out", out, "--fleet-router", rp,
+                       "--fleet-offset", "1=0.5"] + reps)
+        assert rc == 0
+        merged = json.load(open(out))
+        pids = {e.get("pid") for e in merged["traceEvents"]}
+        assert "router/fleet_request" in pids
+        assert "replica1/request" in pids
+        assert any(e.get("ph") == "s" for e in merged["traceEvents"])
+        # --fleet-replica without --fleet-router is an argparse error
+        with pytest.raises(SystemExit):
+            cli.main(["--out", out] + reps)

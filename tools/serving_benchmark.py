@@ -26,7 +26,13 @@ Usage:
   # prefix-affinity router; phase A is the no-kill baseline, phase B
   # SIGKILLs one replica mid-run — zero accepted requests may be
   # lost, kill-phase p99 TTFT must stay within 2x of baseline, and
-  # every survivor must still report decode_compiles == 1
+  # every survivor must still report decode_compiles == 1. Fleet runs
+  # also trace end-to-end (ISSUE 17): the router journals its
+  # queue/placement/dispatch/reroute spans, each replica adopts the
+  # dispatch traceparent, and the merged clock-aligned timeline lands
+  # in --fleet-trace-out; requests_detail rows carry trace_id plus the
+  # per-hop breakdown (router queue vs dispatch attempts vs replica
+  # phases)
   python tools/serving_benchmark.py --fleet 3 --kill-replica-at 4 \
       --shared-prefix-tokens 32 --out tools/serving_fleet_snapshot.json
 """
@@ -85,9 +91,11 @@ def _pcts(values):
             "p99": _pct(values, 99)}
 
 
-def _write_fleet_artifact(path, report, stale_reason=None):
-    """bench.py's staleness discipline for the fleet artifact: a run
-    that produced nothing re-emits the previous snapshot marked
+def _write_fleet_artifact(path, report, stale_reason=None,
+                          kind="serving_fleet_snapshot"):
+    """bench.py's staleness discipline for the fleet artifacts (the
+    snapshot AND the merged fleet_trace timeline): a run that produced
+    nothing re-emits the previous artifact of the same ``kind`` marked
     ``stale: true`` (+ stale_generations/stale_since) instead of
     silently photocopying — the battery row goes red (rc=3)."""
     if stale_reason is not None and os.path.exists(path):
@@ -96,7 +104,7 @@ def _write_fleet_artifact(path, report, stale_reason=None):
                 last = json.load(f)
         except (OSError, ValueError):
             last = None
-        if last and last.get("kind") == "serving_fleet_snapshot":
+        if last and last.get("kind") == kind:
             last["stale"] = True
             last["stale_reason"] = stale_reason
             last["stale_generations"] = \
@@ -126,9 +134,20 @@ def run_fleet(args):
 
     from paddle_tpu.core import flags as ptflags
     from paddle_tpu.distributed.store import TCPStore
+    from paddle_tpu.monitor import trace as mtrace
+    from paddle_tpu.monitor import trace_merge as tm
     from paddle_tpu.serving.fleet import Router
 
     ptflags.set_flags({"FLAGS_serving_fleet": True})
+    # fleet-wide tracing (on by default, the single-engine benchmark
+    # discipline): the ROUTER journal records the dispatch half here;
+    # each forked replica journals its engine half via the
+    # FLAGS_monitor_trace env bootstrap, and the two merge into
+    # tools/fleet_trace.json after the phases. Capacity covers both
+    # phases plus warmups so early traces never get evicted.
+    trace_cap = max(4 * args.requests + 128, 512)
+    if not args.no_trace:
+        mtrace.enable(capacity=trace_cap)
 
     def post_json(url, payload):
         req = urllib.request.Request(
@@ -141,6 +160,26 @@ def run_fleet(args):
     def get_json(url):
         with urllib.request.urlopen(url, timeout=10) as r:
             return json.loads(r.read().decode())
+
+    def clock_offset(url, pings=5):
+        """Replica wall clock minus local wall clock, NTP-style over
+        /metrics.json (the monitor/fleet.py collector discipline:
+        self-reported unix_time vs the local request midpoint, min-RTT
+        sample wins) — the shift that clock-aligns the merged fleet
+        timeline."""
+        best_rtt, best_off = None, 0.0
+        for _ in range(pings):
+            t0 = time.time()    # ptlint: clock-ok — NTP offset probe
+            m0 = time.monotonic()
+            snap = get_json(url + "/metrics.json")
+            t1 = time.time()    # ptlint: clock-ok — NTP offset probe
+            rtt = time.monotonic() - m0
+            if not isinstance(snap.get("unix_time"), (int, float)):
+                return None
+            if best_rtt is None or rtt < best_rtt:
+                best_rtt = rtt
+                best_off = float(snap["unix_time"]) - (t0 + t1) / 2.0
+        return best_off
 
     launcher = os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "serving_router.py")
@@ -208,6 +247,41 @@ def run_fleet(args):
         ttft = [r["first_token_at"] - r["submitted_at"] for r in reqs
                 if r["first_token_at"] is not None]
         lost = [r["nonce"] for r in reqs if r["state"] != "finished"]
+        # per-request rows with the per-hop breakdown: router queue
+        # (trace phase) vs dispatch attempts (every replica tried,
+        # with outcome — a rerouted request reports BOTH attempts'
+        # replicas) vs replica engine phases (from the result
+        # payload's span summary). trace_id links each row to the
+        # merged fleet timeline.
+        detail = []
+        for r in reqs:
+            row = {
+                "nonce": r["nonce"], "state": r["state"],
+                "rank": r["rank"], "reroutes": r["reroutes"],
+                "reroute_reasons": list(r["reroute_reasons"]),
+                "attempt_ranks": list(r["attempt_ranks"]),
+                "affinity": bool(r["affinity"]),
+                "output_tokens": r["output_tokens"],
+                "ttft_s": (round(r["first_token_at"]
+                                 - r["submitted_at"], 6)
+                           if r["first_token_at"] is not None
+                           else None),
+                "e2e_s": (round(r["finished_at"]
+                                - r["submitted_at"], 6)
+                          if r["finished_at"] is not None else None),
+                "trace_id": r["trace_id"],
+            }
+            if r["trace_id"] is not None:
+                pb = mtrace.phase_breakdown(r["trace_id"]) or {}
+                row["hops"] = {
+                    "router_queue_s": round(
+                        pb.get("router_queue", 0.0), 6),
+                    "dispatch_attempts": [dict(a)
+                                          for a in r["attempts"]],
+                    "replica_phases_s": (r["replica_trace"] or {}
+                                         ).get("phases_s"),
+                }
+            detail.append(row)
         return {
             "phase": name, "requests": len(reqs),
             "settled": bool(settled), "wall_s": round(wall, 3),
@@ -219,6 +293,7 @@ def run_fleet(args):
                                        for r in reqs),
             "output_tokens": sum(r["output_tokens"] for r in reqs),
             "killed_rank": killed,
+            "requests_detail": detail,
         }
 
     out = args.out
@@ -235,7 +310,14 @@ def run_fleet(args):
                  "--seed", str(args.seed + r),
                  "--ttl-s", str(args.fleet_ttl_s),
                  "--heartbeat-s", "0.2"],
-                stdout=subprocess.PIPE))
+                stdout=subprocess.PIPE,
+                # journal in the replica too (the trace.py env
+                # bootstrap): its engine-half spans adopt the router's
+                # traceparent and are pulled via /debugz/trace/journal
+                # after the phases
+                env=(dict(os.environ, FLAGS_monitor_trace="1",
+                          PT_TRACE_CAPACITY=str(trace_cap))
+                     if not args.no_trace else None)))
         for r, p in enumerate(procs):
             # one JSON line after Replica.start(): engine built, lease
             # registered, protocol served
@@ -295,6 +377,47 @@ def run_fleet(args):
         kill = run_phase("kill", kill_at=args.kill_replica_at) \
             if args.kill_replica_at is not None else None
 
+        # merged fleet timeline: the router's journal (dispatch half)
+        # + every SURVIVING replica's journal (engine half, pulled over
+        # /debugz/trace/journal) + NTP-style clock offsets -> ONE
+        # clock-aligned chrome trace with traceparent flow arrows. A
+        # SIGKILLed victim's journal dies with it, but its attempt-1
+        # evidence lives in the router's dispatch/reroute spans, so the
+        # reroute causality chain survives the kill.
+        trace_block = {"enabled": not args.no_trace}
+        if not args.no_trace:
+            replica_journals, offsets_s = {}, {}
+            for r, info in announce.items():
+                if procs[r].poll() is not None:
+                    continue        # dead replica: journal lost
+                try:
+                    replica_journals[r] = get_json(
+                        info["url"] + "/debugz/trace/journal")
+                    off = clock_offset(info["url"])
+                    if off is not None:
+                        offsets_s[r] = off
+                except (OSError, ValueError):
+                    continue        # died mid-pull: same as dead
+            doc = tm.write_fleet_timeline(
+                args.fleet_trace_out, mtrace.dump(), replica_journals,
+                offsets=offsets_s,
+                meta={"tool": "serving_benchmark", "fleet": args.fleet,
+                      "preset": args.preset,
+                      "kill_replica_at_s": args.kill_replica_at,
+                      "measured_at": time.strftime(
+                          "%Y-%m-%dT%H:%M:%SZ", time.gmtime())})
+            reqs_sum = doc.get("requests") or {}
+            trace_block.update({
+                "fleet_trace": args.fleet_trace_out,
+                "router_traces": len(reqs_sum),
+                "replica_journals": sorted(replica_journals),
+                "clock_offsets_s": {r: round(o, 6)
+                                    for r, o in offsets_s.items()},
+                "rerouted_traces": sum(
+                    1 for v in reqs_sum.values() if v["reroutes"]),
+            })
+            print("wrote", args.fleet_trace_out, flush=True)
+
         dbg = router.debug_payload()
         rows = router.replicas_debug_payload()
         killed_ranks = {p["killed_rank"] for p in (baseline, kill)
@@ -337,6 +460,7 @@ def run_fleet(args):
             "ttft_p99_ratio_within_2x": (ratio is not None
                                          and ratio <= 2.0),
             "survivor_decode_compiles": survivors,
+            "trace": trace_block,
             "router": dbg,
             "replicas": rows,
             "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
@@ -366,6 +490,15 @@ def run_fleet(args):
                   "measured_at": time.strftime(
                       "%Y-%m-%dT%H:%M:%SZ", time.gmtime())},
             stale_reason=repr(e))
+        # the merged timeline rides the same staleness discipline: a
+        # failed run re-emits the previous fleet_trace marked stale
+        # rather than leaving a silently outdated artifact behind
+        _write_fleet_artifact(
+            args.fleet_trace_out,
+            {"kind": "fleet_trace", "ok": False, "error": repr(e),
+             "measured_at": time.strftime(
+                 "%Y-%m-%dT%H:%M:%SZ", time.gmtime())},
+            stale_reason=repr(e), kind="fleet_trace")
         return 3
     finally:
         if router is not None:
@@ -458,6 +591,11 @@ def main():
                          "lose nothing")
     ap.add_argument("--fleet-ttl-s", type=float, default=2.0,
                     help="fleet mode: replica liveness lease TTL")
+    ap.add_argument("--fleet-trace-out", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "fleet_trace.json"),
+        help="fleet mode: merged clock-aligned fleet timeline "
+             "(router + surviving-replica journals stitched on "
+             "traceparent; open in Perfetto)")
     ap.add_argument("--fleet-wait-s", type=float, default=300.0,
                     help="fleet mode: per-phase drain deadline")
     args = ap.parse_args()

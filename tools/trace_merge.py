@@ -22,6 +22,15 @@ journal tail merges with rank-prefixed pids, wall clocks aligned on
 the collector's clock via the manifest's per-rank offsets — one
 command renders the merged fleet chrome-trace from a capture.
 
+``--fleet-router`` + ``--fleet-replica`` stitch a serving-fleet run:
+the router's journal and each replica's (``RANK=path``) merge into one
+timeline with ``router/`` / ``replica{r}/`` pids, replica wall clocks
+aligned by ``RANK=offset`` pairs from ``--fleet-offset`` (the
+collector-style NTP estimates; seconds, replica minus router), and
+chrome flow arrows connecting each dispatch span to the replica
+request span that adopted its traceparent — reroute causality in one
+Perfetto view.
+
 Usage:
   python tools/trace_merge.py --dir traces/ --out merged.json
   python tools/trace_merge.py --out merged.json r0.json r1.json ...
@@ -30,6 +39,10 @@ Usage:
   python tools/trace_merge.py --out m.json --requests journal.json \
       [--requests-clock wall] [rank traces...]
   python tools/trace_merge.py --out m.json --capture fleet_capture_<ts>/
+  python tools/trace_merge.py --out fleet.json \
+      --fleet-router router_journal.json \
+      --fleet-replica 0=replica0.json --fleet-replica 1=replica1.json \
+      [--fleet-offset 1=0.0031]
 """
 from __future__ import annotations
 
@@ -119,6 +132,19 @@ def main(argv=None):
                          "fleet.py collector capture) whose per-rank "
                          "journal tails merge rank-prefixed and "
                          "clock-aligned; repeatable")
+    ap.add_argument("--fleet-router", metavar="JOURNAL",
+                    help="serving-fleet ROUTER journal: merge with "
+                         "--fleet-replica journals into router/ + "
+                         "replica{r}/ tracks with traceparent flow "
+                         "arrows")
+    ap.add_argument("--fleet-replica", action="append", default=[],
+                    metavar="RANK=JOURNAL",
+                    help="one replica's journal (requires "
+                         "--fleet-router); repeatable")
+    ap.add_argument("--fleet-offset", action="append", default=[],
+                    metavar="RANK=SECONDS",
+                    help="replica wall-clock offset vs the router "
+                         "(NTP-style estimate); repeatable")
     args = ap.parse_args(argv)
 
     paths_by_rank, offsets = collect_inputs(args)
@@ -134,6 +160,24 @@ def main(argv=None):
         print("capture: %s (%s) -> %d span/event(s) from rank(s) %s"
               % (cap, manifest.get("reason"), len(evs),
                  manifest.get("ranks")))
+        extra.extend(evs)
+    if args.fleet_replica and not args.fleet_router:
+        ap.error("--fleet-replica requires --fleet-router")
+    if args.fleet_router:
+        replicas = {}
+        for spec in args.fleet_replica:
+            r, _, path = spec.partition("=")
+            replicas[int(r)] = tm.load_journal(path)
+        fleet_offsets = {}
+        for spec in args.fleet_offset:
+            r, _, off = spec.partition("=")
+            fleet_offsets[int(r)] = float(off)
+        evs = tm.merge_fleet_journals(
+            tm.load_journal(args.fleet_router), replicas,
+            offsets=fleet_offsets)
+        print("fleet: router %s + %d replica journal(s) -> %d "
+              "span/event(s)" % (args.fleet_router, len(replicas),
+                                 len(evs)))
         extra.extend(evs)
     if not paths_by_rank and not extra:
         ap.error("no input traces found")

@@ -217,13 +217,18 @@ run serving_resilience 1200 env $(wd serving_resilience) \
 #     requests lost (rc=5), kill p99 TTFT ratio reported (within-2x
 #     flag in the JSON), every survivor still decode_compiles == 1
 #     (rc=4). A failed run re-emits the previous artifact marked stale
-#     (rc=3) — bench.py's discipline.
+#     (rc=3) — bench.py's discipline. The row also commits the merged
+#     fleet timeline (ISSUE 17): router + surviving-replica span
+#     journals stitched on traceparent into tools/fleet_trace.json
+#     (clock-aligned chrome trace + per-trace reroute-causality table);
+#     the same stale re-emit discipline covers it on failure.
 run serving_fleet 1500 env $(wd serving_fleet) \
     python tools/serving_benchmark.py --preset llama1b \
     --fleet 3 --kill-replica-at 4 \
     --requests 48 --rate 8 --max-slots 4 --num-blocks 256 \
     --shared-prefix-tokens 32 --prefix-groups 4 \
-    --out tools/serving_fleet_snapshot.json
+    --out tools/serving_fleet_snapshot.json \
+    --fleet-trace-out tools/fleet_trace.json
 
 # 5d. fleet telemetry row (ISSUE 8): the existing 2-process multihost
 #     train entry under FLAGS_monitor_fleet — every rank announces its
