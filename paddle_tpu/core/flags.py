@@ -139,6 +139,25 @@ _DEFAULTS = {
     # profile_* series, both routes report disabled (test-pinned, the
     # PR-2/5/6 discipline).
     "FLAGS_monitor_profile": False,
+    # SLO/error-budget plane + unified incident manager (monitor/slo.py
+    # + monitor/incidents.py): declarative objectives (serving
+    # TTFT/TPOT/e2e latency attainment + availability, training
+    # step-time/goodput floors) judged over the PR-5 timeseries ring —
+    # no new sampling path, the evaluator is a ring listener —
+    # publishing slo_attainment_ratio / slo_error_budget_remaining_
+    # ratio / slo_burn_rate with multi-window multi-burn-rate alerting
+    # (fast+slow pairs on the monotonic clock; page vs ticket severity
+    # from the pair). Every detector (perf sentinels, mem-leak,
+    # watchdog stalls, fleet stragglers, OOM postmortems, router
+    # evictions, burn-rate alerts) reports into ONE bounded incident
+    # table (episode-keyed dedup, open->resolve lifecycle, evidence
+    # links to the artifacts each already writes); /healthz "degraded"
+    # derives from the open set. Off = open/resolve and the ring
+    # listener hook are one flag branch: no threads, no native calls,
+    # no slo_*/incident_* series, /debugz/slo + /debugz/incidents
+    # report disabled, and /healthz is bit-identical to the
+    # pre-incident build (test-pinned, the PR-2/5/6 discipline).
+    "FLAGS_monitor_slo": False,
     # radix prefix cache over the serving engine's paged KV pool
     # (serving/prefix_cache.py): requests sharing a prompt prefix
     # (system prompts, few-shot headers) map their block-table head to

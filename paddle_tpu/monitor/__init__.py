@@ -77,7 +77,24 @@ Three pillars (one registry, one postmortem path, one timeline):
    falsifiable via tools/perf_report.py. Division of labor: **profile
    = where the time measurably went**.
 
-9. **Progress watchdog** (monitor/watchdog.py): heartbeat registry fed
+9. **SLO/error-budget plane + incident manager** (monitor/slo.py +
+   monitor/incidents.py, ``FLAGS_monitor_slo``): declarative
+   objectives (serving TTFT/TPOT/e2e latency attainment +
+   availability; training step-time/goodput floors) judged over the
+   timeseries ring by a plain ring listener — no new sampling path —
+   publishing ``slo_attainment_ratio`` / ``slo_error_budget_
+   remaining_ratio`` / ``slo_burn_rate`` with multi-window
+   multi-burn-rate alerting (fast+slow pairs on the monotonic clock;
+   page vs ticket severity from the pair); and ONE bounded incident
+   table every detector reports into (``incidents.open/resolve`` with
+   episode-keyed dedup, evidence links to the artifacts each detector
+   already writes) that /healthz "degraded" derives from while the
+   plane is on. Served at /debugz/slo + /debugz/incidents +
+   /debugz/fleet/incidents; rendered by tools/slo_report.py.
+   Division of labor: sentinels/watchdog/fleet **detect**, incidents
+   **aggregate**, slo **judges**.
+
+10. **Progress watchdog** (monitor/watchdog.py): heartbeat registry fed
    by the compiled train step, the serving engine loop, and store
    collectives; a daemon thread (``start_watchdog()`` / ``PT_WATCHDOG``)
    turns a stalled heartbeat into a cross-rank diagnostic bundle
@@ -126,9 +143,11 @@ from .watchdog import (  # noqa: F401
 )
 from . import fleet  # noqa: F401
 from . import flight_recorder  # noqa: F401
+from . import incidents  # noqa: F401
 from . import memory  # noqa: F401
 from . import perf  # noqa: F401
 from . import profile  # noqa: F401
+from . import slo  # noqa: F401
 from . import timeseries  # noqa: F401
 from . import trace  # noqa: F401
 from . import trace_merge  # noqa: F401
@@ -144,6 +163,7 @@ __all__ = [
     "Heartbeat", "heartbeat", "start_watchdog", "stop_watchdog",
     "is_watchdog_running", "build_bundle", "diagnose_bundles",
     "register_stall_action", "unregister_stall_action",
-    "fleet", "flight_recorder", "memory", "perf", "profile",
-    "timeseries", "trace", "trace_merge", "watchdog",
+    "fleet", "flight_recorder", "incidents", "memory", "perf",
+    "profile", "slo", "timeseries", "trace", "trace_merge",
+    "watchdog",
 ]

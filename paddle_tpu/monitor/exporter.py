@@ -57,6 +57,19 @@ server stack: ``KVHTTPServer`` gained a ``get_routes`` hook, and
     GET /debugz/router/replicas  the router's per-replica table (url,
                         generation, state, load, queue depth, per-
                         replica dispatch/affinity counts)
+    GET /debugz/slo     SLO/error-budget verdicts: per-objective
+                        attainment, budget remaining, burn rates per
+                        alerting window, active burn alerts
+                        (monitor/slo.py payload; enabled:false while
+                        FLAGS_monitor_slo is off)
+    GET /debugz/incidents  the unified incident table: open + recently
+                        resolved incidents with severity, episode
+                        counts and evidence links
+                        (monitor/incidents.py payload)
+    GET /debugz/fleet/incidents  fleet-wide incident timeline merged
+                        from every scraped rank's table + the
+                        collector's own, clock-offset-aligned and
+                        deduped by incident id (monitor/fleet.py)
 
 The /healthz and /debugz routes are served live from monitor/watchdog.py
 whether or not the watchdog thread is running (the verdict just reads
@@ -74,9 +87,11 @@ import os
 import time
 
 from . import fleet as _fleet
+from . import incidents as _incidents
 from . import memory as _memory
 from . import perf as _perf
 from . import profile as _profile
+from . import slo as _slo
 from . import timeseries as _timeseries
 from . import trace as _trace
 from . import watchdog as _watchdog
@@ -144,6 +159,9 @@ class MetricsServer:
         routes["metrics/fleet"] = self._fleet_prometheus
         routes["debugz/router"] = self._router
         routes["debugz/router/replicas"] = self._router_replicas
+        routes["debugz/slo"] = self._slo
+        routes["debugz/incidents"] = self._incidents
+        routes["debugz/fleet/incidents"] = self._fleet_incidents
         self._kv.http_server.get_prefix_routes["debugz/trace"] = \
             self._trace_by_id
 
@@ -243,6 +261,22 @@ class MetricsServer:
     def _router_replicas(self):
         body = json.dumps(
             _watchdog.json_safe(_fleet.router_replicas_payload()),
+            default=str).encode()
+        return 200, "application/json", body
+
+    def _slo(self):
+        body = json.dumps(_watchdog.json_safe(_slo.payload()),
+                          default=str).encode()
+        return 200, "application/json", body
+
+    def _incidents(self):
+        body = json.dumps(_watchdog.json_safe(_incidents.payload()),
+                          default=str).encode()
+        return 200, "application/json", body
+
+    def _fleet_incidents(self):
+        body = json.dumps(
+            _watchdog.json_safe(_fleet.fleet_incidents_payload()),
             default=str).encode()
         return 200, "application/json", body
 

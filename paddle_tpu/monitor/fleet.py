@@ -523,11 +523,33 @@ class FleetCollector:
                 router = rt["router"]
         except (OSError, ValueError, http.client.HTTPException):
             pass
+        # SLO + incident planes (best-effort, same contract): the
+        # rank's objective verdicts feed the SLO/BUDGET columns and
+        # its incident table feeds the merged /debugz/fleet/incidents
+        # timeline; a flags-off or pre-ptslo rank just has empty
+        # columns this round
+        slo = None
+        try:
+            sl, _, _, _ = _http_json(url + "/debugz/slo",
+                                     self.http_timeout_s)
+            if isinstance(sl, dict) and sl.get("enabled"):
+                slo = sl
+        except (OSError, ValueError, http.client.HTTPException):
+            pass
+        incidents = None
+        try:
+            inc, _, _, _ = _http_json(url + "/debugz/incidents",
+                                      self.http_timeout_s)
+            if isinstance(inc, dict) and inc.get("enabled"):
+                incidents = inc
+        except (OSError, ValueError, http.client.HTTPException):
+            pass
         return {"metrics": snap.get("metrics") or {},
                 "snapshot_time": snap.get("unix_time"),
                 "perf": perf, "healthz": healthz,
                 "flight_seq": flight_seq, "memory": memory,
                 "profile": profile, "router": router,
+                "slo": slo, "incidents": incidents,
                 "rtt_s": rtt, "clock_offset_s": offset,
                 "scraped_at": time.monotonic()}
 
@@ -666,6 +688,27 @@ class FleetCollector:
         aff = rt.get("affinity") or {}
         st["router_affinity_hit_rate"] = aff.get("hit_rate") \
             if isinstance(aff.get("hit_rate"), (int, float)) else None
+        # SLO columns (/debugz/slo, best-effort): the rank's WORST
+        # objective — min attainment and min budget remaining across
+        # its judged objectives (the memory columns' worst-wins
+        # convention); None for flags-off or pre-ptslo ranks
+        slo = scraped.get("slo") or {}
+        atts = [o.get("attainment")
+                for o in (slo.get("objectives") or ())
+                if isinstance(o.get("attainment"), (int, float))]
+        st["slo_attainment_min"] = min(atts) if atts else None
+        buds = [o.get("budget_remaining_ratio")
+                for o in (slo.get("objectives") or ())
+                if isinstance(o.get("budget_remaining_ratio"),
+                              (int, float))]
+        st["slo_budget_min"] = min(buds) if buds else None
+        # incident columns + the raw table (the /debugz/fleet/incidents
+        # merge reads the latest scraped table per rank)
+        incidents = scraped.get("incidents")
+        st["incidents_open"] = (
+            len(incidents.get("open") or ())
+            if isinstance(incidents, dict) else None)
+        st["_incidents"] = incidents
         # anomaly watermark: total sentinel firings this rank reports
         anomalies = (scraped["perf"] or {}).get("anomalies") or {}
         st["anomalies_total"] = sum(
@@ -840,8 +883,24 @@ class FleetCollector:
                     if r in self._stragglers:
                         # recovered: close the episode so a relapse
                         # counts as a fresh straggler_total increment
+                        # — and resolve its incident (the table lives
+                        # on the collector, which detected it; no-op
+                        # branch while the SLO plane is off)
                         self._stragglers.pop(r, None)
                         st["straggler"] = False
+                        try:
+                            from . import incidents as _incidents
+
+                            _incidents.resolve(
+                                "fleet/straggler/rank%d" % r,
+                                reason="step time recovered to fleet "
+                                "pace")
+                        except Exception as e:
+                            _registry.warn_once(
+                                "fleet.incident_resolve",
+                                "paddle_tpu.monitor.fleet: straggler "
+                                "incident resolve failed (episode "
+                                "still closed): %r" % (e,))
                 if st.get("slow_hits", 0) >= self.straggler_persist \
                         and r not in self._stragglers:
                     info = {
@@ -857,6 +916,26 @@ class FleetCollector:
                     st["straggler"] = True
                     newly.add(r)
                     _STRAGGLER_TOTAL.labels(rank=r).inc()
+                    # ptslo: ONE incident per straggler episode,
+                    # naming the guilty rank; the recovery branch
+                    # above resolves it, a relapse opens a fresh one
+                    try:
+                        from . import incidents as _incidents
+
+                        _incidents.open(
+                            "fleet/straggler/rank%d" % r,
+                            severity="ticket", kind="straggler",
+                            source="fleet", rank=r,
+                            summary="rank %d straggling: step %.3fs "
+                            "vs fleet median %.3fs" % (
+                                r, st["step_time_s"], median),
+                            evidence=dict(info))
+                    except Exception as e:
+                        _registry.warn_once(
+                            "fleet.incident_open",
+                            "paddle_tpu.monitor.fleet: straggler "
+                            "incident open failed (episode still "
+                            "flagged): %r" % (e,))
         return newly
 
     # -- anomaly-triggered fleet capture -------------------------------------
@@ -971,6 +1050,11 @@ class FleetCollector:
                 if st.get("clock_offset_s") is not None},
             "stragglers": {str(r): i for r, i in
                            sorted(self._stragglers.items())},
+            # causality: the open incidents known fleet-wide when the
+            # capture fired — the triggering incident's id is in here
+            # (its detector opened it before the watermark advanced).
+            # Empty while FLAGS_monitor_slo is off everywhere.
+            "incidents": self._known_open_incident_ids(),
         }
         tmp = os.path.join(d, "manifest.json.tmp")
         with open(tmp, "w") as f:
@@ -979,11 +1063,48 @@ class FleetCollector:
         os.replace(tmp, os.path.join(d, "manifest.json"))
         rec = {"dir": d, "reason": reason, "detail": detail or {},
                "created_at": manifest["unix_time"],
-               "ranks": got_ranks}
+               "ranks": got_ranks,
+               "incidents": manifest["incidents"]}
         with self._lock:
             self._captures.append(rec)
         _CAPTURES_TOTAL.labels(reason=reason).inc()
+        # back-link: the collector's OWN open incidents (stragglers,
+        # local detectors) get the capture dir as evidence — remote
+        # incidents get the link at merge time via the manifest ids
+        try:
+            from . import incidents as _incidents
+
+            for inc in _incidents.open_incidents():
+                _incidents.add_evidence(inc["key"], capture_dir=d)
+        except Exception as e:
+            _registry.warn_once(
+                "fleet.capture_evidence",
+                "paddle_tpu.monitor.fleet: capture evidence back-link "
+                "failed (capture %s still written): %r" % (d, e))
         return d
+
+    def _known_open_incident_ids(self):
+        """Open incident ids across the collector's own table and the
+        latest scraped table of every rank (deduped — the collector's
+        process may also be a scraped rank)."""
+        ids = []
+        try:
+            from . import incidents as _incidents
+
+            for inc in _incidents.open_incidents():
+                ids.append(inc["id"])
+        except Exception as e:
+            _registry.warn_once(
+                "fleet.incident_ids",
+                "paddle_tpu.monitor.fleet: local incident-id walk "
+                "failed (scraped ids still recorded): %r" % (e,))
+        for _, st in self._rank_items():
+            pay = st.get("_incidents")
+            if isinstance(pay, dict):
+                for inc in pay.get("open") or ():
+                    if inc.get("id"):
+                        ids.append(inc["id"])
+        return sorted(set(ids))
 
     # -- payloads ------------------------------------------------------------
 
@@ -1013,6 +1134,8 @@ class FleetCollector:
                 "healthz", "degraded", "anomalies_total",
                 "anomaly_kinds", "straggler", "slow_hits",
                 "router_replicas", "router_affinity_hit_rate",
+                "slo_attainment_min", "slo_budget_min",
+                "incidents_open",
                 "clock_offset_s", "rtt_s")})
             rows[-1]["scrape_age_s"] = (
                 round(now - st["scraped_at"], 3)
@@ -1194,6 +1317,77 @@ def ranks_payload():
             "stragglers": stragglers,
             "ranks": c.ranks_table(),
             "time": time.time()}
+
+
+def fleet_incidents_payload():
+    """The /debugz/fleet/incidents body: one clock-offset-aligned
+    fleet-wide incident timeline — the collector's own table merged
+    with the latest scraped table of every rank, deduped by incident
+    id (ids embed (rank, pid), so the collector re-seeing its own
+    rank's table, or re-scraping a rank, never duplicates an
+    episode). Peer wall stamps are shifted onto the collector's clock
+    by the per-rank NTP-style offsets (the trace_merge discipline);
+    capture manifests' incident ids back-link each merged incident to
+    its capture dir."""
+    from . import incidents as _incidents
+
+    if not _incidents.is_enabled():
+        return {"enabled": False, "incidents": []}
+    merged = {}
+    local = _incidents.payload()
+    for inc in (local.get("open") or []) + \
+            (local.get("resolved") or []):
+        e = dict(inc)
+        e["evidence"] = dict(e.get("evidence") or {})
+        e["origin"] = "local"
+        e["origin_rank"] = e.get("rank")
+        merged[e["id"]] = e
+    c = _collector
+    ranks_merged = []
+    if c is not None:
+        for r, st in c._rank_items():
+            pay = st.get("_incidents")
+            if not isinstance(pay, dict):
+                continue
+            ranks_merged.append(r)
+            offset = st.get("clock_offset_s") or 0.0
+            for inc in (pay.get("open") or []) + \
+                    (pay.get("resolved") or []):
+                if not inc.get("id"):
+                    continue
+                prev = merged.get(inc["id"])
+                if prev is not None and prev.get("origin") == "local":
+                    continue    # our own table is fresher than a scrape
+                e = dict(inc)
+                e["evidence"] = dict(e.get("evidence") or {})
+                e["origin"] = "rank%d" % r
+                e["origin_rank"] = r
+                # align the peer's wall stamps onto the collector's
+                # clock (display metadata only — never subtracted)
+                for k in ("opened_at", "last_seen", "resolved_at"):
+                    if isinstance(e.get(k), (int, float)):
+                        e[k] = e[k] - offset
+                merged[e["id"]] = e
+        with c._lock:
+            captures = list(c._captures)
+        for cap in captures:
+            for iid in cap.get("incidents") or ():
+                if iid in merged:
+                    merged[iid]["evidence"].setdefault(
+                        "capture_dir", cap["dir"])
+    timeline = sorted(merged.values(),
+                      key=lambda e: (e.get("opened_at") or 0,
+                                     e["id"]))
+    open_n = sum(1 for e in timeline if e.get("state") == "open")
+    return {
+        "enabled": True,
+        "collector": c is not None,
+        "ranks_merged": ranks_merged,
+        "counts": {"total": len(timeline), "open": open_n,
+                   "resolved": len(timeline) - open_n},
+        "incidents": timeline,
+        "time": time.time(),
+    }
 
 
 def prometheus_fleet_text():

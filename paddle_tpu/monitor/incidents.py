@@ -1,0 +1,290 @@
+"""Unified incident manager: one bounded table every detector reports to.
+
+The repo has eight independent anomaly sources — perf sentinels,
+the mem-leak sentinel, watchdog stall episodes, fleet straggler
+episodes, OOM postmortem writers, router lease evictions, poison
+quarantine, sheds — each with its own counter, artifact and healthz
+side-channel. This module is the aggregation layer over all of them:
+a narrow ``open(key, ...)`` / ``resolve(key)`` API with episode-keyed
+dedup (re-fire EXTENDS the open incident, recovery RESOLVES it —
+each detector keeps its own episode latching and reports the edges
+here), severity (``ticket`` < ``page``), an open → resolved lifecycle,
+and causality links to the evidence artifacts the detectors already
+produce (bundle path, postmortem path, capture dir, trace ids).
+
+Division of labor (README "SLO & incidents"): sentinels/watchdog/fleet
+**detect**, this table **aggregates**, monitor/slo.py **judges**
+(objectives + error budgets). /healthz "degraded" derives from the
+open set when the plane is on — one source of truth instead of N
+side-channels (monitor/watchdog.py ``healthz_payload``).
+
+Discipline (the PR-2/5/6/12/13 contract, test-pinned by
+tests/test_slo.py): default OFF via ``FLAGS_monitor_slo``; while off,
+``open()``/``resolve()``/``add_evidence()`` are one enabled-attribute
+load + branch — no registry series, no threads (this module NEVER has
+threads), no native calls, and ``/debugz/incidents`` reports
+``enabled: false``. Incident ids embed ``(rank, pid)`` so a fleet
+merge (monitor/fleet.py ``fleet_incidents_payload``) can dedup by id
+across the collector's own table and every scraped rank table.
+
+Wall-clock stamps (``opened_at``/``last_seen``/``resolved_at``) are
+display/merge metadata only — nothing here subtracts or orders them
+(the fleet merge shifts them by the NTP-style per-rank offsets, the
+trace_merge discipline).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from . import registry as _registry
+from .timeseries import _flag
+
+SEVERITIES = ("ticket", "page")     # ascending
+
+# registry metrics (lazy series: nothing exists until the first
+# open()/resolve() with the plane enabled — the series-free pin)
+_OPENED = _registry.counter(
+    "incident_opened_total",
+    "incidents opened, by reporting detector and severity",
+    labelnames=("source", "severity"))
+_RESOLVED = _registry.counter(
+    "incident_resolved_total",
+    "incidents resolved (episode recovered or acknowledged), by "
+    "reporting detector", labelnames=("source",))
+_OPEN_COUNT = _registry.gauge(
+    "incident_open_count", "currently-open incidents by severity",
+    labelnames=("severity",))
+
+
+def _env_int(name, default):
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+class _State:
+    __slots__ = ("enabled", "lock", "open", "resolved", "seq", "rank")
+
+    def __init__(self):
+        self.enabled = False
+        self.lock = threading.Lock()
+        self.open = {}          # key -> incident dict
+        self.resolved = []      # bounded, oldest first
+        self.seq = 0
+        self.rank = None
+
+
+_state = _State()
+
+
+def _resolved_cap():
+    return max(_env_int("PT_INCIDENTS_CAP", 64), 1)
+
+
+def enable(rank=None):
+    """Turn the incident table on (process-wide). ``rank`` defaults to
+    this process's trainer rank so incident ids name their origin."""
+    if rank is None:
+        rank = _env_int("PADDLE_TRAINER_ID", 0)
+    _state.rank = int(rank)
+    _state.enabled = True
+    return _state
+
+
+def disable():
+    _state.enabled = False
+
+
+def is_enabled():
+    return _state.enabled
+
+
+def clear():
+    """Test hook: drop every incident (open and resolved)."""
+    with _state.lock:
+        self_open = list(_state.open.values())
+        _state.open = {}
+        _state.resolved = []
+        _state.seq = 0
+    for inc in self_open:
+        _sync_open_gauge_severity(inc["severity"])
+
+
+def _sync_open_gauge_severity(severity):
+    n = sum(1 for i in _state.open.values()
+            if i["severity"] == severity)
+    try:
+        _OPEN_COUNT.labels(severity=severity).set(n)
+    except Exception as e:
+        _registry.warn_once(
+            "incidents.open_gauge",
+            "paddle_tpu.monitor.incidents: open-count gauge update "
+            "failed (table state is still authoritative): %r" % (e,))
+
+
+def open(key, severity="ticket", kind=None, source=None, summary=None,
+         evidence=None, rank=None):
+    """Open (or extend) the incident for episode ``key``. Returns the
+    incident id, or None while the plane is disabled.
+
+    Dedup is episode-keyed: a second ``open`` on an already-open key
+    bumps ``count``/``last_seen``, merges ``evidence``, and escalates
+    severity (ticket -> page, never the reverse) instead of creating a
+    duplicate — a detector may re-fire every sample while its episode
+    lasts and the table shows ONE incident."""
+    if not _state.enabled:
+        return None
+    if severity not in SEVERITIES:
+        severity = "ticket"
+    now = time.time()
+    fresh = None
+    with _state.lock:
+        inc = _state.open.get(key)
+        if inc is not None:
+            inc["count"] += 1
+            inc["last_seen"] = now
+            if evidence:
+                inc["evidence"].update(evidence)
+            if summary:
+                inc["summary"] = summary
+            if SEVERITIES.index(severity) > \
+                    SEVERITIES.index(inc["severity"]):
+                inc["severity"] = severity
+                fresh = ("escalated", inc)
+            return inc["id"]
+        _state.seq += 1
+        inc = {
+            "id": "inc-r%d-p%d-%d" % (
+                _state.rank if rank is None else int(rank),
+                os.getpid(), _state.seq),
+            "key": key,
+            "kind": kind or key.split("/", 1)[0],
+            "source": source or "unknown",
+            "severity": severity,
+            "summary": summary or key,
+            "rank": _state.rank if rank is None else int(rank),
+            "state": "open",
+            "opened_at": now,
+            "last_seen": now,
+            "count": 1,
+            "evidence": dict(evidence or {}),
+        }
+        _state.open[key] = inc
+        fresh = ("opened", inc)
+    try:
+        _OPENED.labels(source=inc["source"],
+                       severity=inc["severity"]).inc()
+    except Exception as e:
+        _registry.warn_once(
+            "incidents.opened_counter",
+            "paddle_tpu.monitor.incidents: opened counter increment "
+            "failed (incident %s is still in the table): %r"
+            % (inc["id"], e))
+    if fresh is not None:
+        _sync_open_gauge_severity(inc["severity"])
+    return inc["id"]
+
+
+def resolve(key, reason=None):
+    """Close the open incident for ``key`` (episode recovered). The
+    record moves to the bounded resolved list. Returns True if an open
+    incident was resolved."""
+    if not _state.enabled:
+        return False
+    now = time.time()
+    with _state.lock:
+        inc = _state.open.pop(key, None)
+        if inc is None:
+            return False
+        inc["state"] = "resolved"
+        inc["resolved_at"] = now
+        if reason:
+            inc["resolve_reason"] = reason
+        _state.resolved.append(inc)
+        cap = _resolved_cap()
+        if len(_state.resolved) > cap:
+            del _state.resolved[:len(_state.resolved) - cap]
+    try:
+        _RESOLVED.labels(source=inc["source"]).inc()
+    except Exception as e:
+        _registry.warn_once(
+            "incidents.resolved_counter",
+            "paddle_tpu.monitor.incidents: resolved counter increment "
+            "failed (incident %s is still resolved): %r"
+            % (inc["id"], e))
+    _sync_open_gauge_severity(inc["severity"])
+    return True
+
+
+def resolve_source(source, reason=None):
+    """Resolve every open incident reported by ``source`` (the
+    perf ``clear_anomalies`` acknowledgement path). Returns the count
+    resolved."""
+    if not _state.enabled:
+        return 0
+    with _state.lock:
+        keys = [k for k, i in _state.open.items()
+                if i["source"] == source]
+    return sum(1 for k in keys if resolve(k, reason=reason))
+
+
+def add_evidence(key, **links):
+    """Attach causality links (artifact paths, trace ids) to the open
+    incident for ``key``. Returns True if it was open."""
+    if not _state.enabled:
+        return False
+    with _state.lock:
+        inc = _state.open.get(key)
+        if inc is None:
+            return False
+        inc["evidence"].update(links)
+    return True
+
+
+def get(key):
+    with _state.lock:
+        inc = _state.open.get(key)
+        return dict(inc) if inc else None
+
+
+def open_incidents():
+    """Open incidents, oldest first (insertion order)."""
+    with _state.lock:
+        return [dict(i) for i in _state.open.values()]
+
+
+def is_degraded():
+    """One open incident anywhere = the process is degraded — the
+    single healthz source of truth while the plane is on."""
+    return _state.enabled and bool(_state.open)
+
+
+def payload():
+    """The /debugz/incidents JSON body."""
+    if not _state.enabled:
+        return {"enabled": False, "open": [], "resolved": []}
+    with _state.lock:
+        open_ = [dict(i) for i in _state.open.values()]
+        resolved = [dict(i) for i in _state.resolved]
+    by_sev = {}
+    for i in open_:
+        by_sev[i["severity"]] = by_sev.get(i["severity"], 0) + 1
+    return {
+        "enabled": True,
+        "rank": _state.rank,
+        "open": open_,
+        "resolved": resolved,
+        "counts": {"open": len(open_), "open_by_severity": by_sev,
+                   "resolved": len(resolved)},
+        "time": time.time(),
+    }
+
+
+# env/FLAGS bootstrap (the timeseries/perf discipline): a process
+# started with FLAGS_monitor_slo=1 has the table live from the first
+# detector firing, no code change anywhere.
+if _flag("FLAGS_monitor_slo"):
+    enable()

@@ -466,6 +466,24 @@ def write_postmortem(job, exc, context=None):
         if len(_state.postmortems) > _POSTMORTEMS_CAP:
             del _state.postmortems[:len(_state.postmortems)
                                    - _POSTMORTEMS_CAP]
+    # ptslo (monitor/incidents.py): an OOM is a page-severity incident
+    # with the postmortem as evidence. It never auto-resolves — the
+    # process is about to re-raise; resolution is a human (or fleet
+    # restart) decision. Lazy import, one flag branch while off.
+    try:
+        from . import incidents as _incidents
+
+        _incidents.open(
+            "oom/%s" % (job,), severity="page", kind="oom",
+            source="memory", rank=rank,
+            summary="OOM in job %s on rank %d: %s"
+            % (job, rank, type(exc).__name__),
+            evidence={"postmortem": path, "error": repr(exc)})
+    except Exception as e:
+        _registry.warn_once(
+            "memory.incident_open",
+            "paddle_tpu.monitor.memory: OOM incident open failed "
+            "(postmortem was still written): %r" % (e,))
     return path
 
 
@@ -506,12 +524,19 @@ class MemLeakSentinel(_perf.Sentinel):
         span = time.monotonic() - win[0][0]
         if span < self.min_window_s:
             return None
+        st["leaking"] = True
         return {"growth_bytes": growth, "window": self.window,
                 "window_s": span, "first_bytes": vals[0],
                 "last_bytes": value}
 
     def update(self, st, value):
         win = st.setdefault("win", [])
+        # a decreasing sample is the sawtooth reset that already clears
+        # the verdict — while a leak episode is latched it is also the
+        # recovery edge the incident table resolves on
+        if st.get("leaking") and win and value < win[-1][1]:
+            st["leaking"] = False
+            st["recovered"] = True
         # window stamps are our OWN monotonic reads, not the ring's
         # wall ts — the span math must survive an NTP step mid-window
         win.append((time.monotonic(), value))

@@ -424,6 +424,15 @@ class Sentinel:
     def update(self, st, value):
         pass
 
+    def recovered(self, name):
+        """Consume the recovery edge for ``name``: True exactly once
+        after the sentinel's episode latch clears (subclasses set
+        ``st["recovered"]`` when their condition ends). The incident
+        table resolves on this edge — detection stays in the
+        sentinel, aggregation in monitor/incidents.py."""
+        st = self._per_series.get(name)
+        return bool(st) and bool(st.pop("recovered", False))
+
 
 class NaNLossSentinel(Sentinel):
     """Non-finite loss. Latched: one firing per contiguous non-finite
@@ -439,8 +448,9 @@ class NaNLossSentinel(Sentinel):
         if bad and not st.get("latched"):
             st["latched"] = True
             return {"value": repr(value)}
-        if not bad:
+        if not bad and st.get("latched"):
             st["latched"] = False
+            st["recovered"] = True
         return None
 
 
@@ -465,7 +475,11 @@ class LossSpikeSentinel(Sentinel):
             return None
         thr = mean + self.factor * max(dev, 0.1 * abs(mean), 1e-9)
         if value > thr:
+            st["spiking"] = True
             return {"value": value, "ewma": mean, "threshold": thr}
+        if st.get("spiking"):
+            st["spiking"] = False
+            st["recovered"] = True
         return None
 
     def update(self, st, value):
@@ -501,8 +515,12 @@ class ThroughputRegressionSentinel(Sentinel):
         baseline = sorted(win)[len(win) // 2]    # median
         thr = baseline * (1.0 - self.drop)
         if baseline > 0 and value < thr:
+            st["cliff"] = True
             return {"value": value, "baseline": baseline,
                     "threshold": thr}
+        if st.get("cliff"):
+            st["cliff"] = False
+            st["recovered"] = True
         return None
 
     def update(self, st, value):
@@ -534,8 +552,12 @@ class GradNormSentinel(Sentinel):
         if mean is None or mean <= 0:
             return None
         if value > self.factor * mean:
+            st["exploding"] = True
             return {"value": value, "ewma": mean,
                     "threshold": self.factor * mean}
+        if st.get("exploding"):
+            st["exploding"] = False
+            st["recovered"] = True
         return None
 
     def update(self, st, value):
@@ -596,6 +618,42 @@ def _fire(sentinel, name, ts, value, detail):
             "perf.profile_arm",
             "paddle_tpu.monitor.perf: profile capture arming failed "
             "(anomaly was still recorded above): %r" % (e,))
+    # ptslo (monitor/incidents.py): every firing is also an incident —
+    # episode-keyed on (kind, series) so a persistent condition is ONE
+    # open incident that re-fires extend. Lazy import, one flag branch
+    # while the plane is off.
+    try:
+        from . import incidents as _incidents
+
+        _incidents.open(
+            "perf/%s/%s" % (kind, name),
+            severity=("page" if kind in ("nan_loss",
+                                         "grad_norm_explosion")
+                      else "ticket"),
+            kind=kind, source="perf",
+            summary="%s on %s" % (kind, name),
+            evidence={"series": name, "detail": detail})
+    except Exception as e:
+        _registry.warn_once(
+            "perf.incident_open",
+            "paddle_tpu.monitor.perf: incident open failed (anomaly "
+            "was still recorded above): %r" % (e,))
+
+
+def _recover(sentinel, name):
+    """The episode's recovery edge: resolve the matching incident.
+    Detection (and the latch) stays in the sentinel — this only
+    reports the edge to the table."""
+    try:
+        from . import incidents as _incidents
+
+        _incidents.resolve("perf/%s/%s" % (sentinel.kind, name),
+                           reason="sentinel recovered")
+    except Exception as e:
+        _registry.warn_once(
+            "perf.incident_resolve",
+            "paddle_tpu.monitor.perf: incident resolve failed "
+            "(sentinel state already recovered): %r" % (e,))
 
 
 def _dispatch(name, ts, value):
@@ -608,6 +666,8 @@ def _dispatch(name, ts, value):
                 detail = s.observe(name, ts, value)
                 if detail is not None:
                     _fire(s, name, ts, value, detail)
+                elif s.recovered(name):
+                    _recover(s, name)
         except Exception as e:
             # must never raise (inline on the metric hot path), but a
             # sentinel dying forever deserves one line
@@ -656,6 +716,18 @@ def clear_anomalies():
         _state.degraded_since = None
         _state.events = []
         _state.anomaly_counts = {}
+    # the incident table is the healthz source of truth while the SLO
+    # plane is on — acknowledging here must clear it there too, or the
+    # flag would change what clear_anomalies means (pinned equivalent).
+    try:
+        from . import incidents as _incidents
+
+        _incidents.resolve_source("perf", reason="anomalies cleared")
+    except Exception as e:
+        _registry.warn_once(
+            "perf.incident_clear",
+            "paddle_tpu.monitor.perf: incident clear failed (local "
+            "anomaly state was still reset): %r" % (e,))
 
 
 def anomaly_summary():

@@ -1,0 +1,462 @@
+"""SLO / error-budget plane: declarative objectives judged over the
+existing timeseries ring, with multi-window multi-burn-rate alerting.
+
+The sentinels (monitor/perf.py) answer "did something anomalous just
+happen?"; this module answers the operator question behind ROADMAP
+items 6/7: "are we meeting our latency/availability objectives, and
+how fast are we spending the error budget?". Division of labor:
+sentinels/watchdog/fleet **detect**, monitor/incidents.py
+**aggregates**, this module **judges**.
+
+Design, in the shape the Gemma-serving methodology (PAPERS.md) uses:
+
+* **Objectives are declarative.** An :class:`Objective` names a ring
+  series and a goodness rule: ``latency`` (sample good when value <=
+  threshold — TTFT/TPOT/e2e histogram observations ride the ring raw,
+  the PR-5 contract), ``floor`` (good when value >= threshold —
+  training goodput/step-time floors over gauges), or ``availability``
+  (cumulative counter deltas: good events vs shed/expired events,
+  attainment = 1 - bad fraction). No new sampling path exists: the
+  evaluator is a plain ``timeseries.add_listener`` consumer of the
+  PR-5 fan-out, so anything the ring sees the judge sees.
+
+* **Windows live on the monotonic clock.** Every event is stamped
+  with ``clock()`` (``time.monotonic`` by default, injectable for
+  deterministic tests — the ElasticManager/Router precedent); wall
+  time never enters window math. ``PT_SLO_WINDOW_SCALE`` scales all
+  four windows so tests exercise real multi-window behavior in
+  milliseconds.
+
+* **Multi-window multi-burn-rate alerting** (the SRE playbook): burn
+  rate = (1 - attainment) / (1 - target); an alert opens only when a
+  fast AND slow window pair BOTH exceed the pair's burn threshold
+  (fast window = reactivity, slow window = evidence), and resolves
+  when the fast window recovers. The page pair (60s/600s, burn 10x)
+  and ticket pair (300s/3600s, burn 2x) give severity for free.
+  Alerts are incidents: they open/extend/resolve through
+  monitor/incidents.py like every other detector.
+
+Discipline: default OFF behind ``FLAGS_monitor_slo``; the disabled
+path is one enabled-load + branch, with zero threads (this module
+never starts one — evaluation piggybacks on whatever thread recorded
+the sample), zero native calls, zero registry series. Engines latch
+at construction: enabling the flag mid-run affects only samples
+recorded after ``enable()``. The gauges this module publishes
+(``slo_*``) re-enter ``timeseries.record`` once; ``_observe`` ignores
+them (no objective may target an ``slo_``/``incident_`` series) and a
+reentrancy latch makes that a hard guarantee.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+
+from . import registry as _registry
+from . import timeseries as _timeseries
+from .timeseries import _flag
+
+# burn-rate grades: fast window reacts, slow window confirms, the
+# pair's threshold is the burn multiple BOTH must exceed.  Env scale
+# lets tests shrink hours to milliseconds without forking the math.
+_GRADES = (
+    {"grade": "page", "fast_s": 60.0, "slow_s": 600.0, "burn": 10.0},
+    {"grade": "ticket", "fast_s": 300.0, "slow_s": 3600.0, "burn": 2.0},
+)
+
+_ATTAINMENT = _registry.gauge(
+    "slo_attainment_ratio",
+    "fraction of good events over the budget (ticket-slow) window",
+    labelnames=("objective", "job"))
+_BUDGET = _registry.gauge(
+    "slo_error_budget_remaining_ratio",
+    "error budget remaining over the budget window (1 = untouched, "
+    "0 = exhausted)", labelnames=("objective", "job"))
+_BURN = _registry.gauge(
+    "slo_burn_rate",
+    "error-budget burn multiple per alerting window "
+    "(1.0 = spending exactly the budget)",
+    labelnames=("objective", "window"))
+_ALERTS = _registry.counter(
+    "slo_alerts_total",
+    "multi-window burn-rate alerts fired (transition edges only)",
+    labelnames=("objective", "severity"))
+
+
+def _env_float(name, default):
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return float(default)
+
+
+def _env_int(name, default):
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return int(default)
+
+
+class Objective(object):
+    """One declarative objective over one ring series.
+
+    kind="latency":      good sample  <=> value <= threshold
+    kind="floor":        good sample  <=> value >= threshold
+    kind="availability": ``series`` / ``bad_series`` are CUMULATIVE
+        counters; each observation contributes its positive delta as
+        good/bad events.  The first observation per series seeds the
+        baseline (an evaluator enabled mid-run must not judge
+        history it never watched).
+    """
+
+    __slots__ = ("name", "series", "kind", "threshold", "target",
+                 "job", "bad_series", "events", "samples", "first_t",
+                 "_last", "alerting")
+
+    def __init__(self, name, series, kind="latency", threshold=None,
+                 target=0.99, job="serving", bad_series=()):
+        if kind not in ("latency", "floor", "availability"):
+            raise ValueError("unknown objective kind: %r" % (kind,))
+        if kind != "availability" and threshold is None:
+            raise ValueError("objective %s: kind %s needs a threshold"
+                             % (name, kind))
+        self.name = name
+        self.series = series
+        self.kind = kind
+        self.threshold = threshold
+        self.target = float(target)
+        self.job = job
+        self.bad_series = tuple(bad_series)
+        self.events = deque()       # (t_mono, good, total)
+        self.samples = 0
+        self.first_t = None
+        self._last = {}             # series name -> last cumulative
+        self.alerting = {}          # grade -> bool
+        if self.target >= 1.0:
+            # a zero-width budget makes burn infinite on the first
+            # bad event; clamp just under 1 to keep the math finite
+            self.target = 1.0 - 1e-9
+
+    def _match(self, spec, name):
+        return name == spec or name.startswith(spec + "{")
+
+    def matches(self, name):
+        if self._match(self.series, name):
+            return True
+        return any(self._match(b, name) for b in self.bad_series)
+
+    def ingest(self, name, value, t):
+        """Fold one ring sample into the event window."""
+        if self.first_t is None:
+            self.first_t = t
+        if self.kind == "availability":
+            last = self._last.get(name)
+            self._last[name] = value
+            if last is None:
+                return          # baseline seed, judge deltas only
+            delta = value - last
+            if delta <= 0:
+                return
+            bad = any(self._match(b, name) for b in self.bad_series)
+            good = 0 if bad else delta
+            self.samples += delta
+            self.events.append((t, good, delta))
+        else:
+            if value != value:      # NaN never judges good
+                good = 0
+            elif self.kind == "latency":
+                good = 1 if value <= self.threshold else 0
+            else:                   # floor
+                good = 1 if value >= self.threshold else 0
+            self.samples += 1
+            self.events.append((t, good, 1))
+
+    def prune(self, now, max_window_s):
+        horizon = now - max_window_s
+        ev = self.events
+        while ev and ev[0][0] < horizon:
+            ev.popleft()
+
+    def attainment(self, now, window_s):
+        good = total = 0
+        horizon = now - window_s
+        for t, g, n in self.events:
+            if t >= horizon:
+                good += g
+                total += n
+        if total <= 0:
+            return None
+        return good / float(total)
+
+    def burn_rate(self, now, window_s):
+        att = self.attainment(now, window_s)
+        if att is None:
+            return None
+        return (1.0 - att) / (1.0 - self.target)
+
+
+class _State(object):
+    __slots__ = ("enabled", "lock", "clock", "objectives", "grades",
+                 "min_samples", "in_eval")
+
+    def __init__(self):
+        self.enabled = False
+        self.lock = threading.Lock()
+        self.clock = time.monotonic
+        self.objectives = []
+        self.grades = ()
+        self.min_samples = 20
+        self.in_eval = threading.local()
+
+
+_state = _State()
+
+
+def _scaled_grades():
+    scale = _env_float("PT_SLO_WINDOW_SCALE", 1.0)
+    if scale <= 0:
+        scale = 1.0
+    return tuple(dict(g, fast_s=g["fast_s"] * scale,
+                      slow_s=g["slow_s"] * scale) for g in _GRADES)
+
+
+def default_objectives():
+    """The stock objective set (each threshold/target env-tunable).
+
+    Serving: TTFT/TPOT/e2e latency attainment + availability
+    (1 - shed/expired fraction, over the request-event counters).
+    Training: step-time ceiling and an optional goodput floor
+    (``PT_SLO_GOODPUT_FLOOR`` <= 0 disables it — a floor of zero is
+    vacuously met and would only pad the payload).
+    """
+    target = _env_float("PT_SLO_TARGET", 0.99)
+    objs = [
+        Objective("serving_ttft", "serving_ttft_seconds",
+                  kind="latency",
+                  threshold=_env_float("PT_SLO_TTFT_S", 2.0),
+                  target=target, job="serving"),
+        Objective("serving_tpot", "serving_tpot_seconds",
+                  kind="latency",
+                  threshold=_env_float("PT_SLO_TPOT_S", 0.25),
+                  target=target, job="serving"),
+        Objective("serving_e2e", "serving_e2e_seconds",
+                  kind="latency",
+                  threshold=_env_float("PT_SLO_E2E_S", 30.0),
+                  target=target, job="serving"),
+        Objective("serving_availability",
+                  'serving_requests_total{event="finished"}',
+                  kind="availability",
+                  target=_env_float("PT_SLO_AVAIL_TARGET", 0.999),
+                  job="serving",
+                  bad_series=("serving_requests_shed_total",)),
+        Objective("train_step_time", "train_step_seconds",
+                  kind="latency",
+                  threshold=_env_float("PT_SLO_STEP_S", 1.0),
+                  target=target, job="train"),
+    ]
+    goodput_floor = _env_float("PT_SLO_GOODPUT_FLOOR", 0.0)
+    if goodput_floor > 0:
+        objs.append(Objective(
+            "train_goodput", "train_tokens_per_s", kind="floor",
+            threshold=goodput_floor, target=target, job="train"))
+    return objs
+
+
+def enable(objectives=None, clock=None):
+    """Turn the judge on: ensure the ring is recording, install the
+    listener, and (re)latch windows/objectives from the environment."""
+    from . import incidents as _incidents
+    _state.clock = clock or time.monotonic
+    _state.grades = _scaled_grades()
+    _state.min_samples = max(_env_int("PT_SLO_MIN_SAMPLES", 20), 1)
+    with _state.lock:
+        _state.objectives = list(
+            objectives if objectives is not None
+            else default_objectives())
+    _timeseries.enable()
+    _timeseries.add_listener(_observe)
+    if not _incidents.is_enabled():
+        _incidents.enable()
+    _state.enabled = True
+    return _state
+
+
+def disable():
+    _state.enabled = False
+    _timeseries.remove_listener(_observe)
+
+
+def is_enabled():
+    return _state.enabled
+
+
+def clear():
+    """Test hook: drop windows and alert latches, keep objectives."""
+    with _state.lock:
+        for obj in _state.objectives:
+            obj.events.clear()
+            obj.samples = 0
+            obj.first_t = None
+            obj._last.clear()
+            obj.alerting = {}
+
+
+def add_objective(obj):
+    with _state.lock:
+        _state.objectives.append(obj)
+
+
+def set_objectives(objs):
+    with _state.lock:
+        _state.objectives = list(objs)
+
+
+def _max_window_s():
+    return max((g["slow_s"] for g in _state.grades), default=3600.0)
+
+
+def _observe(name, ts, value):
+    """timeseries listener: fold matching samples, then re-judge the
+    touched objectives.  Must never raise into the recording thread
+    (the fan-out already warn_once-guards us, but cheap checks first)."""
+    if not _state.enabled:
+        return
+    if getattr(_state.in_eval, "active", False):
+        return      # our own slo_* gauge publications re-entering
+    touched = []
+    now = _state.clock()
+    with _state.lock:
+        for obj in _state.objectives:
+            if obj.matches(name):
+                obj.ingest(name, float(value), now)
+                touched.append(obj)
+    if touched:
+        _evaluate(touched, now)
+
+
+def _evaluate(objectives, now):
+    from . import incidents as _incidents
+    _state.in_eval.active = True
+    try:
+        max_w = _max_window_s()
+        budget_w = max_w            # ticket-slow = the budget window
+        for obj in objectives:
+            with _state.lock:
+                obj.prune(now, max_w * 1.25)
+                att = obj.attainment(now, budget_w)
+                burns = {}
+                for g in _state.grades:
+                    burns[g["grade"] + "_fast"] = \
+                        obj.burn_rate(now, g["fast_s"])
+                    burns[g["grade"] + "_slow"] = \
+                        obj.burn_rate(now, g["slow_s"])
+                warm = (obj.samples >= _state.min_samples
+                        and obj.first_t is not None
+                        and (now - obj.first_t)
+                        >= min(g["fast_s"] for g in _state.grades))
+            if att is not None:
+                _ATTAINMENT.labels(objective=obj.name,
+                                   job=obj.job).set(att)
+                budget_used = (1.0 - att) / (1.0 - obj.target)
+                _BUDGET.labels(objective=obj.name, job=obj.job).set(
+                    max(0.0, 1.0 - budget_used))
+            for wname, burn in burns.items():
+                if burn is not None:
+                    _BURN.labels(objective=obj.name,
+                                 window=wname).set(burn)
+            for g in _state.grades:
+                _judge_grade(obj, g, burns, warm, _incidents)
+    finally:
+        _state.in_eval.active = False
+
+
+def _judge_grade(obj, grade, burns, warm, _incidents):
+    """One grade's alert edge: open when BOTH windows burn past the
+    threshold (and warmup passed), extend while burning, resolve when
+    the fast window recovers."""
+    gname = grade["grade"]
+    fast = burns.get(gname + "_fast")
+    slow = burns.get(gname + "_slow")
+    burning = (warm and fast is not None and slow is not None
+               and fast > grade["burn"] and slow > grade["burn"])
+    was = obj.alerting.get(gname, False)
+    key = "slo/%s/%s" % (obj.name, gname)
+    if burning:
+        summary = ("SLO %s burning error budget at %.1fx/%.1fx "
+                   "(threshold %.1fx, %s grade)"
+                   % (obj.name, fast, slow, grade["burn"], gname))
+        evidence = {
+            "objective": obj.name, "job": obj.job,
+            "target": obj.target,
+            "burn_fast": fast, "burn_slow": slow,
+            "windows_s": [grade["fast_s"], grade["slow_s"]],
+            "burn_threshold": grade["burn"],
+        }
+        severity = "page" if gname == "page" else "ticket"
+        _incidents.open(key, severity=severity, kind="slo_burn_rate",
+                        source="slo", summary=summary,
+                        evidence=evidence)
+        if not was:
+            obj.alerting[gname] = True
+            try:
+                _ALERTS.labels(objective=obj.name,
+                               severity=severity).inc()
+            except Exception as e:
+                _registry.warn_once(
+                    "slo.alerts_counter",
+                    "paddle_tpu.monitor.slo: alert counter increment "
+                    "failed (the incident is still open): %r" % (e,))
+    elif was and (fast is None or fast <= grade["burn"]):
+        obj.alerting[gname] = False
+        _incidents.resolve(key, reason="fast-window burn recovered")
+
+
+def payload():
+    """The /debugz/slo JSON body."""
+    if not _state.enabled:
+        return {"enabled": False, "objectives": []}
+    now = _state.clock()
+    budget_w = _max_window_s()
+    out = []
+    with _state.lock:
+        grades = _state.grades
+        for obj in _state.objectives:
+            att = obj.attainment(now, budget_w)
+            burns = {}
+            for g in grades:
+                burns[g["grade"] + "_fast"] = \
+                    obj.burn_rate(now, g["fast_s"])
+                burns[g["grade"] + "_slow"] = \
+                    obj.burn_rate(now, g["slow_s"])
+            budget = None
+            if att is not None:
+                budget = max(0.0, 1.0 - (1.0 - att)
+                             / (1.0 - obj.target))
+            out.append({
+                "objective": obj.name,
+                "job": obj.job,
+                "kind": obj.kind,
+                "series": obj.series,
+                "threshold": obj.threshold,
+                "target": obj.target,
+                "samples": obj.samples,
+                "attainment": att,
+                "budget_remaining_ratio": budget,
+                "burn_rate": burns,
+                "alerting": dict(obj.alerting),
+            })
+    return {
+        "enabled": True,
+        "window_scale": _env_float("PT_SLO_WINDOW_SCALE", 1.0),
+        "grades": [dict(g) for g in _state.grades],
+        "min_samples": _state.min_samples,
+        "objectives": out,
+        "time": time.time(),
+    }
+
+
+# env/FLAGS bootstrap (the timeseries/perf discipline): one flag turns
+# on the ring + listener + incident table for the whole process.
+if _flag("FLAGS_monitor_slo"):
+    enable()

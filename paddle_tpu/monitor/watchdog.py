@@ -742,6 +742,32 @@ def _on_stall(stalls):
         except Exception as e:
             lines.append("  cross-rank gather failed: %r" % e)
     sys.stderr.write("\n".join(lines) + "\n")
+    # ptslo (monitor/incidents.py): each stall episode is ONE open
+    # page-severity incident keyed on (heartbeat, phase) — re-fires of
+    # a persistent stall extend it, the _tick prune loop resolves it —
+    # with the bundle (and multi-rank postmortem) as evidence. Lazy
+    # import, one flag branch while the plane is off.
+    try:
+        from . import incidents as _incidents
+
+        for s in stalls:
+            evidence = {"bundle": path}
+            if report is not None and report.get("report_path"):
+                evidence["postmortem"] = report["report_path"]
+                if report.get("stalled_ranks"):
+                    evidence["stalled_ranks"] = \
+                        report["stalled_ranks"]
+            _incidents.open(
+                "watchdog/stall/%s/%s" % (s["heartbeat"], s["phase"]),
+                severity="page", kind="stall", source="watchdog",
+                summary="stall: %s/%s blocked %.1fs"
+                % (s["heartbeat"], s["phase"], s["age_s"]),
+                evidence=evidence)
+    except Exception as e:
+        _registry.warn_once(
+            "watchdog.incident_open",
+            "paddle_tpu.monitor.watchdog: stall incident open failed "
+            "(stall was still reported above): %r" % (e,))
     # ptprof escalation (monitor/profile.py): a fresh stall arms a
     # one-shot device-capture window, so the first steps after the
     # wedge clears (or recovery restarts the loop) get an Xprof trace
@@ -839,10 +865,24 @@ def _tick():
         if key not in _state.fired:
             _state.fired[key] = now
             fresh.append(s)
-    # prune episodes whose phase ended so a future stall re-fires
+    # prune episodes whose phase ended so a future stall re-fires —
+    # the same edge resolves the episode's incident (monitor/
+    # incidents.py; no-op branch while the SLO plane is off)
     for key in list(_state.fired):
         if key not in live_keys:
             del _state.fired[key]
+            try:
+                from . import incidents as _incidents
+
+                _incidents.resolve(
+                    "watchdog/stall/%s/%s" % (key[0], key[1]),
+                    reason="stalled phase ended")
+            except Exception as e:
+                _registry.warn_once(
+                    "watchdog.incident_resolve",
+                    "paddle_tpu.monitor.watchdog: stall incident "
+                    "resolve failed (episode latch still pruned): %r"
+                    % (e,))
     if fresh:
         _on_stall(stalls)
 
@@ -963,15 +1003,29 @@ def healthz_payload():
     # perf-sentinel degradation (monitor/perf.py): a NaN loss or
     # throughput cliff marks the endpoint degraded — orthogonal to the
     # stalled verdict (a degraded run is alive and probe-200, but a
-    # deploy gate can read the flag)
+    # deploy gate can read the flag). With the SLO plane on, the
+    # incident table is the single source of truth instead: degraded
+    # = any open incident (the sentinels still report through it, so
+    # the verdict is equivalent until something else opens one). Flag
+    # off, the payload is bit-identical to the pre-incident build
+    # (test-pinned).
+    incidents_open = None
     try:
         from . import perf as _perf
 
-        degraded = _perf.is_degraded()
+        try:
+            from . import incidents as _incidents
+        except Exception:
+            _incidents = None
+        if _incidents is not None and _incidents.is_enabled():
+            degraded = _incidents.is_degraded()
+            incidents_open = len(_incidents.open_incidents())
+        else:
+            degraded = _perf.is_degraded()
         anomalies = _perf.anomaly_summary() if degraded else None
     except Exception:
         degraded, anomalies = False, None
-    return {
+    body = {
         "status": "stalled" if stalls
         else ("degraded" if degraded else "ok"),
         "degraded": degraded,
@@ -990,6 +1044,11 @@ def healthz_payload():
                 "active_phases": s["active_phases"],
             } for name, s in heartbeats_snapshot().items()},
     }
+    # key exists only while the incident plane is on — the flag-off
+    # payload stays byte-for-byte what PR-17 served (test-pinned)
+    if incidents_open is not None:
+        body["incidents_open"] = incidents_open
+    return body
 
 
 def json_safe(obj):

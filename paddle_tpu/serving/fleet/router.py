@@ -254,6 +254,22 @@ class Router:
                     rank, rec.get("url"),
                     rec.get("generation", 0),
                     dict(rec.get("capabilities") or {}))
+                if ent is not None:
+                    # a generation-fenced rejoin ends the eviction
+                    # episode (monitor/incidents.py; no-op while off)
+                    try:
+                        from ...monitor import incidents as _incidents
+
+                        _incidents.resolve(
+                            "router/evicted/rank%d" % rank,
+                            reason="replica rejoined (generation %d)"
+                            % rec.get("generation", 0))
+                    except Exception as e:
+                        warn_once(
+                            "sfleet.router.incident_resolve",
+                            "paddle_tpu.serving.fleet: eviction "
+                            "incident resolve failed (replica %d is "
+                            "still re-adopted): %r" % (rank, e))
             elif rank in draining:
                 ent["state"] = "draining"
         for rank, ent in sorted(self._replicas.items()):
@@ -269,6 +285,26 @@ class Router:
         ent["state"] = "evicted"
         self.affinity.invalidate(rank)
         EVICTIONS.inc()
+        # ptslo (monitor/incidents.py): a dead-lease eviction is an
+        # incident naming the rank; a newer-generation rejoin resolves
+        # it (refresh_membership). One flag branch while the plane is
+        # off.
+        try:
+            from ...monitor import incidents as _incidents
+
+            _incidents.open(
+                "router/evicted/rank%d" % rank, severity="page",
+                kind="replica_eviction", source="router", rank=rank,
+                summary="replica rank %d evicted on dead lease"
+                % rank,
+                evidence={"url": ent["url"],
+                          "generation": ent["generation"]})
+        except Exception as e:
+            warn_once(
+                "sfleet.router.incident_open",
+                "paddle_tpu.serving.fleet: eviction incident open "
+                "failed (replica %d is still evicted): %r"
+                % (rank, e))
         if self._store is not None:
             membership.evict_replica(self._store, rank)
 

@@ -14,18 +14,26 @@ fleet collector. The scripted incidents:
 - rank ``NAN_RANK`` publishes a NaN loss from step ``NAN_STEP`` — its
   local perf sentinel fires, its /healthz turns degraded, and the
   collector pulls a ``fleet_capture_<ts>/`` with bundles + journal
-  tails from every rank.
+  tails from every rank;
+- (ISSUE 18, ``STRAGGLER_RECOVER_STEP`` >= 0 + ``FLAGS_monitor_slo``)
+  the straggler recovers mid-run: its steps turn fast again, the
+  collector resolves the ``fleet/straggler/rank{r}`` incident, and
+  every rank keeps publishing fast tail steps until rank 0 has
+  observed the WHOLE lifecycle (flag -> capture -> resolve) in the
+  merged /debugz/fleet/incidents timeline — recovery is only
+  detectable against a live fleet pace.
 
 Rank 0 prints the machine-checkable evidence lines the parent test
 pins: STRAGGLER_FLAGGED (with the steps watermark at flag time),
 FLEET_VERDICT (the /debugz/fleet payload fetched over real HTTP),
-STRAGGLER_TOTAL, CAPTURES, FINAL_STEPS. Every rank prints FLEET_OK and
+STRAGGLER_TOTAL, CAPTURES, FINAL_STEPS, INCIDENTS (the merged fleet
+incident timeline over real HTTP). Every rank prints FLEET_OK and
 exits 0 — the incidents leave telemetry, not corpses.
 
 Spawned by tests/test_monitor_fleet.py with PADDLE_TRAINER_ID /
 PADDLE_TRAINERS_NUM / PADDLE_MASTER / PT_MONITOR_DUMP_DIR and the
 FLAGS_* env (monitor_fleet, perf_sentinels, monitor_timeseries,
-monitor_trace) set.
+monitor_trace, monitor_slo) set.
 """
 from __future__ import annotations
 
@@ -49,6 +57,7 @@ def main():
     steps = int(os.environ.get("STEPS", "45"))
     fast_s = float(os.environ.get("FAST_S", "0.08"))
     slow_s = float(os.environ.get("SLOW_S", "0.32"))
+    recover_step = int(os.environ.get("STRAGGLER_RECOVER_STEP", "-1"))
 
     from paddle_tpu import monitor
     from paddle_tpu.monitor import fleet, perf, trace
@@ -100,9 +109,12 @@ def main():
     loss_gauge = reg.get("train_loss")
     assert None not in (step_hist, steps_total, tok_rate, loss_gauge)
 
-    sleep_s = slow_s if rank == straggler_rank else fast_s
     straggler_flag_step = None
     for i in range(steps):
+        sleep_s = fast_s
+        if rank == straggler_rank and not (
+                0 <= recover_step <= i):
+            sleep_s = slow_s
         t0 = time.perf_counter()
         time.sleep(sleep_s)
         dt = time.perf_counter() - t0
@@ -136,16 +148,55 @@ def main():
         assert perf.is_degraded(), \
             "NaN loss did not trip the local sentinel"
 
+    def _tail_step():
+        # one fast step's worth of live telemetry: the collector can
+        # only judge a recovery against a fleet that is still pacing
+        t0 = time.perf_counter()
+        time.sleep(fast_s)
+        dt = time.perf_counter() - t0
+        step_hist.observe(dt)
+        steps_total.inc()
+        tok_rate.set(128.0 / dt)
+
+    slo_phase = recover_step >= 0
+    if slo_phase:
+        from paddle_tpu.monitor import incidents as ptinc
+        assert ptinc.is_enabled(), \
+            "FLAGS_monitor_slo must enable the incident table"
+
+    if rank != 0 and slo_phase:
+        # keep publishing until rank 0 has the whole lifecycle in hand
+        while store.get("__slo/done", timeout_s=0.05) is None:
+            _tail_step()
+
     if rank == 0:
-        # settle: the collector needs a round or two to see the NaN
-        # rank's degradation and pull the capture
-        deadline = time.monotonic() + 20
+        # settle: the collector needs (a) a round or two to see the
+        # NaN rank's degradation and pull the capture, and (b) in the
+        # ISSUE-18 recovery scenario, enough live rounds to watch the
+        # straggler episode resolve in the merged incident timeline —
+        # rank 0 keeps stepping so its own row stays live too
+        skey = "fleet/straggler/rank%d" % straggler_rank
+        deadline = time.monotonic() + (90 if slo_phase else 20)
         while time.monotonic() < deadline:
             caps = list(collector._captures)
-            if any(c["reason"] == "anomaly" for c in caps) \
-                    and collector._stragglers:
-                break
-            time.sleep(0.25)
+            anomaly_seen = any(c["reason"] == "anomaly" for c in caps)
+            if slo_phase:
+                _tail_step()
+                merged = fleet.fleet_incidents_payload()
+                by_key = {}
+                for inc in merged.get("incidents") or ():
+                    by_key.setdefault(inc["key"], []).append(inc)
+                straggler_resolved = any(
+                    i.get("state") == "resolved"
+                    for i in by_key.get(skey, ()))
+                nan_seen = any(k.startswith("perf/nan_loss")
+                               for k in by_key)
+                if anomaly_seen and straggler_resolved and nan_seen:
+                    break
+            else:
+                if anomaly_seen and collector._stragglers:
+                    break
+                time.sleep(0.25)
         total = 0
         m = reg.get("fleet_straggler_total")
         for key, v in m.collect():
@@ -176,6 +227,14 @@ def main():
             text = r.read().decode()
         assert 'train_steps_total{rank="0"}' in text, text[:400]
         print("FEDERATION_OK", flush=True)
+        if slo_phase:
+            # the merged fleet incident timeline over real HTTP (ISSUE
+            # 18): dedup by id, episode lifecycle, capture causality —
+            # then release the fleet's tail-step loops
+            with urllib.request.urlopen(
+                    url + "/debugz/fleet/incidents", timeout=10) as r:
+                print("INCIDENTS %s" % r.read().decode(), flush=True)
+            store.set("__slo/done", "1")
 
     store.barrier("done", world, timeout_s=180)
     if collector is not None:

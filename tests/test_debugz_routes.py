@@ -20,10 +20,12 @@ import pytest
 import paddle_tpu as paddle
 from paddle_tpu import monitor
 from paddle_tpu.monitor import fleet
+from paddle_tpu.monitor import incidents as ptinc
 from paddle_tpu.monitor import memory as ptmem
 from paddle_tpu.monitor import perf
 from paddle_tpu.monitor import profile as pprof
 from paddle_tpu.monitor import registry as mreg
+from paddle_tpu.monitor import slo as ptslo
 from paddle_tpu.monitor import timeseries as ts
 from paddle_tpu.monitor import trace
 from paddle_tpu.monitor import watchdog as wd
@@ -51,12 +53,16 @@ ROUTES = {
     "metrics/fleet": (200, "text"),
     "debugz/router": (200, "json"),
     "debugz/router/replicas": (200, "json"),
+    "debugz/slo": (200, "json"),
+    "debugz/incidents": (200, "json"),
+    "debugz/fleet/incidents": (200, "json"),
 }
 
 ALL_FLAGS = ("FLAGS_monitor_timeseries", "FLAGS_perf_attribution",
              "FLAGS_perf_sentinels", "FLAGS_monitor_trace",
              "FLAGS_monitor_fleet", "FLAGS_monitor_memory",
-             "FLAGS_monitor_profile", "FLAGS_serving_fleet")
+             "FLAGS_monitor_profile", "FLAGS_serving_fleet",
+             "FLAGS_monitor_slo")
 
 
 @pytest.fixture()
@@ -76,6 +82,10 @@ def _reset_monitor_state():
     pprof.reset()
     perf.disable_sentinels()
     perf.reset()
+    ptslo.disable()
+    ptslo.clear()
+    ptinc.disable()
+    ptinc.clear()
     ts.disable()
     ts.clear()
     trace.disable()
@@ -183,6 +193,24 @@ class TestRouteMatrixAllOff:
         _, body = _get(server, "debugz/router/replicas")
         p = json.loads(body.decode())
         assert p == {"enabled": False, "replicas": []}
+        # SLO/incident plane off: disabled payloads, healthz stays
+        # bit-identical (NO incidents_open key), zero slo_/incident_
+        # series minted
+        _, body = _get(server, "debugz/slo")
+        p = json.loads(body.decode())
+        assert p == {"enabled": False, "objectives": []}
+        _, body = _get(server, "debugz/incidents")
+        p = json.loads(body.decode())
+        assert p == {"enabled": False, "open": [], "resolved": []}
+        _, body = _get(server, "debugz/fleet/incidents")
+        p = json.loads(body.decode())
+        assert p == {"enabled": False, "incidents": []}
+        _, body = _get(server, "healthz")
+        assert "incidents_open" not in json.loads(body.decode())
+        snap = mreg.get_registry().snapshot()
+        for name, fam in snap.items():
+            if name.startswith(("slo_", "incident_")):
+                assert fam["series"] == [], name
         # ...no collector / serving-fleet threads exist flags-off...
         import threading
         assert not [t for t in threading.enumerate()
@@ -219,6 +247,7 @@ class TestRouteMatrixAllOn:
         paddle.set_flags({f: True for f in ALL_FLAGS})
         ts.enable()
         perf.enable_sentinels()
+        ptslo.enable()
         trace.enable()
         wd.start_watchdog(stall_threshold_s=3600)
         fleet.start_collector(endpoints={0: server}, interval_s=0.1)
@@ -291,6 +320,27 @@ class TestRouteMatrixAllOn:
         _, body = _get(server, "debugz/trace/journal")
         p = json.loads(body.decode())
         assert p["kind"] == "trace_journal" and tid in p["traces"]
+        # SLO/incident routes carry the live judge + table
+        inc_id = ptinc.open("t_routes/incident", severity="ticket",
+                            source="test", summary="route matrix")
+        assert inc_id
+        _, body = _get(server, "debugz/slo")
+        p = json.loads(body.decode())
+        assert p["enabled"] is True and p["objectives"]
+        _, body = _get(server, "debugz/incidents")
+        p = json.loads(body.decode())
+        assert p["enabled"] is True
+        assert [i["key"] for i in p["open"]] == ["t_routes/incident"]
+        _, body = _get(server, "debugz/fleet/incidents")
+        p = json.loads(body.decode())
+        assert p["enabled"] is True
+        assert any(i["key"] == "t_routes/incident"
+                   for i in p["incidents"])
+        # an open incident IS the degraded verdict while the plane is on
+        _, body = _get(server, "healthz")
+        p = json.loads(body.decode())
+        assert p["status"] == "degraded" and p["incidents_open"] >= 1
+        ptinc.resolve("t_routes/incident", reason="matrix done")
         # serving-fleet routes: flag on + a live (endpoint-mode)
         # router registered via the monitor hook
         from paddle_tpu.serving.fleet import Router

@@ -98,6 +98,18 @@ COLS = (
                              else (r.get("healthz") or "-"))),
     ("ANOM", 5, lambda r: _fmt(r.get("anomalies_total"), "%d")),
     ("STRAG", 5, lambda r: ("YES" if r.get("straggler") else "")),
+    # SLO/incident columns (blank unless the rank runs
+    # FLAGS_monitor_slo): worst objective's attainment %, worst
+    # error-budget remaining %, open incident count
+    ("SLO%", 6, lambda r: _fmt(
+        r.get("slo_attainment_min") * 100 if isinstance(
+            r.get("slo_attainment_min"), (int, float))
+        else None, "%.1f")),
+    ("BUDGET%", 7, lambda r: _fmt(
+        r.get("slo_budget_min") * 100 if isinstance(
+            r.get("slo_budget_min"), (int, float))
+        else None, "%.1f")),
+    ("INC", 4, lambda r: _fmt(r.get("incidents_open"), "%d")),
 )
 
 

@@ -566,6 +566,11 @@ def main():
     ap.add_argument("--prefix-groups", type=int, default=4,
                     help="number of distinct shared prefixes for "
                          "--shared-prefix-tokens")
+    ap.add_argument("--slo", action="store_true",
+                    help="judge the workload against the serving SLOs "
+                    "(FLAGS_monitor_slo, latched before Engine "
+                    "construction): per-objective attainment + budget "
+                    "burn + burn-rate alerts land in the report")
     ap.add_argument("--profile", action="store_true",
                     help="FLAGS_monitor_profile: host sampling profiler "
                          "+ per-iteration dispatch/gap + prefill/decode "
@@ -658,7 +663,14 @@ def main():
         "FLAGS_serving_chunked_prefill": bool(args.chunked_prefill),
         # ptprof latches at Engine construction like the tier-2 flags
         # — set BEFORE the engine is built
-        "FLAGS_monitor_profile": bool(args.profile)})
+        "FLAGS_monitor_profile": bool(args.profile),
+        # ptslo same discipline: the judge's ring listener must be
+        # installed before the engine publishes its first sample
+        "FLAGS_monitor_slo": bool(args.slo)})
+    if args.slo:
+        from paddle_tpu.monitor import slo as ptslo
+
+        ptslo.enable()
 
     # resilience knobs are applied AFTER warmup (below): the compile
     # warmup enqueues one request per prefill bucket, and a deadline or
@@ -709,6 +721,11 @@ def main():
         eng.metrics.on_prefix_stats(eng.prefix_cache.stats(),
                                     eng.cache.cow_clones)
     warmup_s = time.perf_counter() - t0
+    if args.slo:
+        # warmup requests must not count against the measured
+        # window's objectives (the warmup-vs-workload split every
+        # other counter gets via the `base` snapshot below)
+        ptslo.clear()
     base = eng.stats()     # counters up to here are warmup, not workload
     prof_base = None
     if args.profile:
@@ -874,6 +891,32 @@ def main():
         # distribution questions don't need a re-run
         "requests_detail": per_req,
     }
+    if args.slo:
+        # ptslo verdicts next to the goodput-vs-throughput gap: the
+        # same artifact answers "how fast" AND "did it meet the SLO"
+        from paddle_tpu.monitor import incidents as ptincidents
+
+        spay = ptslo.payload()
+        report["slo"] = {
+            "enabled": spay.get("enabled", False),
+            "window_scale": spay.get("window_scale"),
+            "objectives": [
+                {"objective": o.get("objective"),
+                 "job": o.get("job"),
+                 "threshold": o.get("threshold"),
+                 "target": o.get("target"),
+                 "samples": o.get("samples"),
+                 "attainment": o.get("attainment"),
+                 "budget_remaining_ratio":
+                     o.get("budget_remaining_ratio"),
+                 "burn_rate": o.get("burn_rate"),
+                 "alerting": o.get("alerting")}
+                for o in spay.get("objectives") or ()],
+            "alerts_open": sorted(
+                i["key"] for i in ptincidents.open_incidents()
+                if i.get("source") == "slo"),
+            "incidents_open": len(ptincidents.open_incidents()),
+        }
     if args.profile:
         # measured host attribution (monitor/profile.py): per-phase
         # host seconds over the measured window (warmup subtracted),
