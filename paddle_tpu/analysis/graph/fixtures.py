@@ -276,7 +276,8 @@ def _pipeline_train():
                              paddle.to_tensor(labels))
 
 
-def _serving_report(prefix_cache, chunked_prefill):
+def _serving_report(prefix_cache, chunked_prefill, quant_kv=False,
+                    quant_weights=False):
     import jax
 
     from ... import serving
@@ -285,7 +286,9 @@ def _serving_report(prefix_cache, chunked_prefill):
 
     pmesh.build_hybrid_mesh(dp=1, devices=jax.devices()[:1])
     fl.set_flags({"FLAGS_serving_prefix_cache": prefix_cache,
-                  "FLAGS_serving_chunked_prefill": chunked_prefill})
+                  "FLAGS_serving_chunked_prefill": chunked_prefill,
+                  "FLAGS_serving_quant_kv": quant_kv,
+                  "FLAGS_serving_quant_weights": quant_weights})
     model, _cfg = _tiny_llama()
     eng = serving.Engine(model, max_slots=4, num_blocks=32,
                          block_size=8)
@@ -316,3 +319,20 @@ def _serving_prefix_chunked():
     must equal serving_chunked's (the prefix cache changes admission,
     never the compiled program; the signature test pins this)."""
     return _serving_report(True, True)
+
+
+@graph_fixture("serving_quant_kv", needs_devices=1)
+def _serving_quant_kv():
+    """int8 block-scaled KV pages (FLAGS_serving_quant_kv): split
+    decode + bucketed prefill over int8 pools with fp32 scale planes —
+    the donation audit must show the int8 planes AND their scale planes
+    aliased in-place (they ride the same donated pools pytree)."""
+    return _serving_report(False, False, quant_kv=True)
+
+
+@graph_fixture("serving_quant_prefix_chunked", needs_devices=1)
+def _serving_quant_prefix_chunked():
+    """quant-kv + prefix cache + chunked prefill: the ONE mixed ragged
+    step with write-time quantize scatter and fused-dequant gather —
+    the full tier-2 stack on quantized pages."""
+    return _serving_report(True, True, quant_kv=True)

@@ -180,6 +180,28 @@ _DEFAULTS = {
     # Latched at Engine construction; chunk size is the Engine's
     # prefill_chunk argument.
     "FLAGS_serving_chunked_prefill": False,
+    # int8 block-scaled KV-cache pages (serving/kv_cache.py): the paged
+    # k/v pools are stored as int8 planes with per-(page, position,
+    # head) fp32 scales living alongside them in KVBlockPool, quantized
+    # at page-write time (the views' scatter) and dequantized inside the
+    # paged-attention gather (kernels/quant.py discipline: amax/127,
+    # zero-vector floor, non-finite poison) — ~3.8x pool capacity at
+    # the same HBM byte budget for head_dim 64. COW clones and prefix
+    # adoption carry the scale planes, so refcounted sharing works
+    # unchanged on quantized pages. Off = pools stay fp32, no scale
+    # planes exist, engine outputs are bit-identical to the pre-quant
+    # build (test-pinned). Latched at Engine construction.
+    "FLAGS_serving_quant_kv": False,
+    # weight-only int8 block-scaled decode (serving/engine.py):
+    # attention/MLP projection weights are quantized ONCE at engine
+    # bind (block-scaled along the input axis) and dequantize-fused
+    # into the memory-bound decode-row matmuls; the split prefill step
+    # keeps fp32 weights (compute-bound rows gain nothing). Under
+    # chunked prefill the ONE mixed step binds the quantized weights
+    # for all rows — a prefill chunk rides as a decode-batch row.
+    # Off = every step binds the fp32 state, outputs bit-identical
+    # (test-pinned). Latched at Engine construction.
+    "FLAGS_serving_quant_weights": False,
     # serving fleet plane (serving/fleet/): N data-parallel engine
     # replicas announce themselves in the TCPStore under
     # __sfleet/replica/{r} (endpoint + generation + capability

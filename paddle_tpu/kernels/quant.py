@@ -80,8 +80,78 @@ def quantize_int8_block(x, block=DEFAULT_BLOCK, stochastic=False,
 
 
 def dequantize_int8_block(q, scales, dtype=jnp.float32,
-                          block=DEFAULT_BLOCK):
+                          block=DEFAULT_BLOCK, out_dtype=None):
     """Inverse of quantize_int8_block: ``q (rows, cols)`` int8 +
-    ``scales (rows, cols//block)`` -> float ``(rows, cols)``."""
+    ``scales (rows, cols//block)`` -> float ``(rows, cols)``.
+
+    Axis-aware path (the serving KV-page layout): when ``scales.shape
+    == q.shape[:-1]`` — one scale per trailing vector, e.g. int8 pages
+    ``(..., heads, head_dim)`` with scales ``(..., heads)`` — the scale
+    broadcasts over the last axis directly, no repeat. ``out_dtype``
+    overrides ``dtype`` (kept for call-site clarity inside fused
+    gathers: ``out_dtype=q_like.dtype``)."""
+    dt = dtype if out_dtype is None else out_dtype
+    if scales.shape == q.shape[:-1]:
+        return (q.astype(jnp.float32)
+                * scales.astype(jnp.float32)[..., None]).astype(dt)
     s = jnp.repeat(scales.astype(jnp.float32), block, axis=-1)
+    return (q.astype(jnp.float32) * s).astype(dt)
+
+
+def page_scales(x):
+    """Per-vector fp32 scales over the LAST axis of an N-d float array
+    (the KV-page discipline: one scale per (position, head) head_dim
+    vector). Same floor/poison rules as ``block_scales``: all-zero
+    vectors get scale 1.0 (dequantize to exact zeros), vectors with any
+    non-finite value get scale NaN (poison stays detectable)."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    finite = jnp.isfinite(amax)
+    return jnp.where(finite & (amax > 0), amax / QMAX,
+                     jnp.where(finite, 1.0, jnp.nan))
+
+
+def quantize_int8_page(x):
+    """Quantize an N-d float array along its last axis: ``x (...,
+    vec)`` -> ``(q int8 (..., vec), scales f32 (...))``. Deterministic
+    round-to-nearest — KV pages are read many times, so low variance
+    beats unbiasedness (no error feedback exists for a cache)."""
+    scales = page_scales(x)
+    v = x.astype(jnp.float32) / scales[..., None]
+    q = jnp.clip(jnp.round(v), -QMAX, QMAX).astype(jnp.int8)
+    return q, scales
+
+
+def weight_block(in_features, block=DEFAULT_BLOCK):
+    """Largest power-of-two block <= ``block`` dividing ``in_features``
+    (weight-only decode quant); falls back to one scale per column."""
+    b = block
+    while b >= 8:
+        if in_features % b == 0:
+            return b
+        b //= 2
+    return in_features
+
+
+def quantize_int8_weight(w, block=DEFAULT_BLOCK):
+    """Quantize a 2-D ``(in_features, out_features)`` projection weight
+    block-scaled along the INPUT axis (the reduction axis of ``x @ w``,
+    so dequant fuses into the matmul's operand read): returns ``(q int8
+    (in, out), scales f32 (in//b, out))`` with ``b = weight_block(in,
+    block)``."""
+    i, o = w.shape
+    b = weight_block(i, block)
+    q, scales = quantize_int8_block(
+        w.astype(jnp.float32).T.reshape(o, i), block=b)
+    return (q.reshape(o, i).T.astype(jnp.int8),
+            scales.reshape(o, i // b).T)
+
+
+def dequantize_int8_weight(q, scales, dtype=jnp.float32):
+    """Inverse of quantize_int8_weight: ``q (in, out)`` int8 + ``scales
+    (in//b, out)`` -> float ``(in, out)``. Pure elementwise broadcast —
+    XLA fuses it into the consuming matmul's operand read."""
+    i, o = q.shape
+    b = i // scales.shape[0]
+    s = jnp.broadcast_to(scales.astype(jnp.float32)[:, None, :],
+                         (scales.shape[0], b, o)).reshape(i, o)
     return (q.astype(jnp.float32) * s).astype(dtype)

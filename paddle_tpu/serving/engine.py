@@ -84,6 +84,23 @@ class DrainingError(AdmissionError):
 # never stalled
 _HB_SERVE = _monitor.heartbeat("serving_engine")
 
+# weight-only quantized decode (FLAGS_serving_quant_weights) eligibility:
+# 2-D projection weights of the attention/MLP stacks — the memory-bound
+# decode matmuls. Embeddings, lm_head, norms and biases stay fp32 (the
+# embedding gather and the final projection dominate accuracy, and
+# 1-D params have no reduction axis to block-scale over).
+_QUANT_PROJ_SEGMENTS = frozenset((
+    "q_proj", "k_proj", "v_proj", "o_proj", "qkv_proj",       # llama attn
+    "gate_proj", "up_proj", "down_proj", "gate_up_proj",      # llama mlp
+    "qkv", "proj", "fc1", "fc2",                              # gpt
+))
+
+
+def _quantizable_weight(name, val):
+    parts = name.split(".")
+    return (getattr(val, "ndim", 0) == 2 and parts[-1] == "weight"
+            and any(p in _QUANT_PROJ_SEGMENTS for p in parts[:-1]))
+
 
 class Engine:
     def __init__(self, model, max_slots=4, num_blocks=64, block_size=16,
@@ -121,6 +138,17 @@ class Engine:
                             rows next to the decode rows — a long
                             prefill no longer stalls the decode batch,
                             and ``decode_compiles`` stays exactly 1
+        FLAGS_serving_quant_kv  the paged K/V pools are int8 planes
+                            with per-(page, position, head) fp32 scale
+                            planes riding alongside in KVBlockPool —
+                            quantized at page-write time, dequantized
+                            inside the attention gather; ~4x page
+                            capacity at the same byte budget
+        FLAGS_serving_quant_weights  projection weights quantized int8
+                            block-scaled ONCE here at bind; the decode/
+                            mixed steps bind the dequantize-fused
+                            weights (memory-bound rows), the split
+                            prefill steps keep fp32
         """
         from ..core import flags as _flags
 
@@ -138,11 +166,19 @@ class Engine:
         self.block_size = block_size
         self.max_model_len = max_model_len
         mb = -(-max_model_len // block_size)
+        self.quant_kv = bool(_flags.flag("FLAGS_serving_quant_kv"))
+        self.quant_weights = bool(
+            _flags.flag("FLAGS_serving_quant_weights"))
         self.cache = PagedKVCache(
             num_layers=spec["num_layers"], num_blocks=num_blocks,
             block_size=block_size, num_kv_heads=spec["num_kv_heads"],
             head_dim=spec["head_dim"], max_slots=max_slots,
-            max_blocks_per_slot=mb, dtype=spec.get("dtype", "float32"))
+            max_blocks_per_slot=mb, dtype=spec.get("dtype", "float32"),
+            quantized=self.quant_kv)
+        # int8 bytes one page's k+v planes hold — the dequant-bytes
+        # accounting unit for serving_quant_dequant_bytes_total
+        self._quant_page_bytes = (2 * block_size * spec["num_kv_heads"]
+                                  * spec["head_dim"])
         self.prefix_cache = None
         if _flags.flag("FLAGS_serving_prefix_cache"):
             from .prefix_cache import RadixPrefixCache
@@ -176,6 +212,25 @@ class Engine:
         self._quarantine = set()
         self._names, values = model.functional_state()
         self._state_vals = list(values)
+        # weight-only quantized decode (FLAGS_serving_quant_weights):
+        # projection weights quantized ONCE here; _decode_vals is the
+        # state the decode/mixed steps bind — each quantized leaf is an
+        # (int8 q, f32 scales) pair the step dequantizes in-trace so
+        # XLA fuses the broadcast-multiply into the consuming matmul's
+        # operand read. Prefill steps keep binding _state_vals (fp32):
+        # compute-bound rows gain nothing from a smaller weight read.
+        # Flag off: _decode_vals IS _state_vals — same leaves, same
+        # jaxpr, bit-identical (test-pinned).
+        self._qw_dtypes = {}            # leaf index -> original dtype
+        self._decode_vals = self._state_vals
+        if self.quant_weights:
+            from ..kernels.quant import quantize_int8_weight
+
+            self._decode_vals = list(self._state_vals)
+            for i, (name, val) in enumerate(zip(self._names, values)):
+                if _quantizable_weight(name, val):
+                    self._qw_dtypes[i] = val.dtype
+                    self._decode_vals[i] = quantize_int8_weight(val)
         # slot_tokens[s]: last generated token, not yet written to KV —
         # the next decode step's input for that slot
         self._slot_tokens = np.zeros((max_slots,), np.int32)
@@ -233,6 +288,11 @@ class Engine:
             for i, pool in enumerate(cache.pools):
                 entries.append(("kv_pool/layer%d/k" % i, pool.k))
                 entries.append(("kv_pool/layer%d/v" % i, pool.v))
+                if pool.k_scale is not None:
+                    entries.append(("kv_pool/layer%d/k_scale" % i,
+                                    pool.k_scale))
+                    entries.append(("kv_pool/layer%d/v_scale" % i,
+                                    pool.v_scale))
             alloc = cache.allocator
             detail = {
                 "pages_used": alloc.usable_blocks - alloc.free_blocks,
@@ -248,7 +308,16 @@ class Engine:
             s = wself()
             if s is None:
                 return ()
-            return list(zip(s._names, s._state_vals))
+            entries = list(zip(s._names, s._state_vals))
+            # quantized decode copies (FLAGS_serving_quant_weights) are
+            # resident alongside the fp32 originals (prefill binds
+            # fp32) — the ledger must see both
+            for i in s._qw_dtypes:
+                q, scales = s._decode_vals[i]
+                entries.append(("int8/" + s._names[i], q))
+                entries.append(("int8/" + s._names[i] + ".scales",
+                                scales))
+            return entries
 
         return {"kv_pool": kv_pool, "model_params": model_params}
 
@@ -722,7 +791,7 @@ class Engine:
             toks = jnp.asarray(self._slot_tokens)
             with span("serving.decode_step"):
                 next_toks, new_pools = self._run_eval(
-                    self._decode, self._state_vals, self.cache.pools,
+                    self._decode, self._decode_vals, self.cache.pools,
                     toks, bt, lens)
         except Exception as e:  # poison quarantine (see _on_decode_failure)
             self._on_decode_failure(active, e)
@@ -730,6 +799,7 @@ class Engine:
         self.cache.pools = new_pools
         out = np.asarray(next_toks)
         self.metrics.on_decode_step(len(active))
+        self._note_quant_step()
         for slot, req in active:
             # the input token's K/V row landed at position seq_len
             self.cache.seq_lens[slot] += 1
@@ -766,7 +836,7 @@ class Engine:
             lens = jnp.asarray(self.cache.seq_lens)
             with span("serving.mixed_step"):
                 next_toks, new_pools = self._run_eval(
-                    self._mixed, self._state_vals, self.cache.pools,
+                    self._mixed, self._decode_vals, self.cache.pools,
                     jnp.asarray(tokens), bt, lens, jnp.asarray(q_lens))
         except Exception as e:
             self._on_decode_failure(rows, e)
@@ -774,6 +844,7 @@ class Engine:
         self.cache.pools = new_pools
         out = np.asarray(next_toks)
         self.metrics.on_decode_step(len(rows))
+        self._note_quant_step()
         for _ in range(chunk_rows):
             self.metrics.on_prefill_chunk()
         for slot, req in rows:
@@ -793,6 +864,20 @@ class Engine:
                 req.metrics.on_first_token(now())
                 req.trace_phase("decode", slot=slot)
             self._accept_token(req, int(out[slot]))
+
+    def _note_quant_step(self):
+        """Per-step quant-KV accounting (FLAGS_serving_quant_kv; one
+        attribute check when off): live int8 page count, plus the int8
+        bytes this step's attention gathers dequantized — every live
+        slot's full history pages, k and v planes, every layer."""
+        if not self.quant_kv:
+            return
+        alloc = self.cache.allocator
+        read_pages = sum(-(-int(n) // self.block_size)
+                         for n in self.cache.seq_lens if n)
+        self.metrics.on_quant_step(
+            alloc.usable_blocks - alloc.free_blocks,
+            read_pages * self._quant_page_bytes * len(self.cache.pools))
 
     def _on_decode_failure(self, active, exc):
         """A batched decode raised. With ONE active request the poison
@@ -911,12 +996,12 @@ class Engine:
             ql = jnp.zeros((S,), jnp.int32)
             steps["mixed"] = artifact(
                 self._mixed, self._mixed_fn,
-                (self._state_vals, pools, toks, bt, lens, ql))
+                (self._decode_vals, pools, toks, bt, lens, ql))
         else:
             toks = jnp.zeros((S,), jnp.int32)
             steps["decode"] = artifact(
                 self._decode, self._decode_fn,
-                (self._state_vals, pools, toks, bt, lens))
+                (self._decode_vals, pools, toks, bt, lens))
             P = self._bucket(8)
             ids = jnp.zeros((1, P), jnp.int32)
             row = jnp.asarray(self.cache.block_tables[0])
@@ -938,7 +1023,9 @@ class Engine:
             "mesh_axes": None,
             "qsync_buckets": None,
             "flags": {"prefix_cache": self.prefix_cache is not None,
-                      "chunked_prefill": self.chunked_prefill},
+                      "chunked_prefill": self.chunked_prefill,
+                      "quant_kv": self.quant_kv,
+                      "quant_weights": self.quant_weights},
         }
 
     # -- compiled steps ---------------------------------------------------
@@ -955,6 +1042,23 @@ class Engine:
         cap = min(-(-self.max_model_len // 8) * 8,
                   self.cache.max_blocks_per_slot * self.block_size)
         return min(p, max(cap, n))
+
+    def _dequant_state(self, state_vals):
+        """Rebuild the fp32 weight list from the mixed quantized state
+        (traced — runs INSIDE the decode/mixed steps, so the per-leaf
+        dequant is a broadcast-multiply XLA fuses into the consuming
+        matmul's operand read; the int8 planes are what crosses HBM).
+        No quantized leaves (flag off): the list passes through
+        untouched and the trace is unchanged."""
+        if not self._qw_dtypes:
+            return list(state_vals)
+        from ..kernels.quant import dequantize_int8_weight
+
+        out = list(state_vals)
+        for i, dt in self._qw_dtypes.items():
+            q, scales = out[i]
+            out[i] = dequantize_int8_weight(q, scales, dt)
+        return out
 
     def _run_eval(self, fn, *args):
         was_training = self.model.training
@@ -987,7 +1091,8 @@ class Engine:
         from ..core.tensor import Tensor
 
         self.metrics.on_decode_compile()        # trace-time counter
-        with self.model.bind_state(self._names, list(state_vals)):
+        with self.model.bind_state(self._names,
+                                   self._dequant_state(state_vals)):
             with no_grad():
                 views = [PagedDecodeView(p, block_tables, seq_lens,
                                          self.block_size)
@@ -1036,7 +1141,8 @@ class Engine:
         from ..core.tensor import Tensor
 
         self.metrics.on_decode_compile()        # trace-time counter
-        with self.model.bind_state(self._names, list(state_vals)):
+        with self.model.bind_state(self._names,
+                                   self._dequant_state(state_vals)):
             with no_grad():
                 views = [PagedMixedView(p, block_tables, seq_lens,
                                         q_lens, self.block_size)

@@ -49,16 +49,26 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ...kernels.flash_attention import CompilerParams
+from ...kernels.quant import dequantize_int8_block
 
 NEG_INF = -1e30
 _STAT_LANES = 128
 
 
-def _pa_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
-               m_scr, l_scr, acc_scr, *, block_size, rep, scale):
+def _pa_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, *rest,
+               block_size, rep, scale, quantized=False):
     """One (slot, page) program. q [1, H, D]; k/v [1, bs, Hkv, D]
     (the page the index map picked via the block table); scratch
-    m/l [H, 128], acc [H, D] — persisted across the page axis."""
+    m/l [H, 128], acc [H, D] — persisted across the page axis.
+    ``quantized`` (FLAGS_serving_quant_kv): k/v blocks arrive int8 and
+    two extra scale refs [1, bs, Hkv] ride the same block-table index
+    map; dequant happens here, inside the gather, per the fused-dequant
+    discipline (kernels/quant.py)."""
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        o_ref, m_scr, l_scr, acc_scr = rest
+        ks_ref = vs_ref = None
     s_i = pl.program_id(0)
     j = pl.program_id(1)
     num_j = pl.num_programs(1)
@@ -78,6 +88,9 @@ def _pa_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
         q = q_ref[0]                                  # [H, D]
         k = k_ref[0]                                  # [bs, Hkv, D]
         v = v_ref[0]
+        if quantized:
+            k = dequantize_int8_block(k, ks_ref[0], out_dtype=jnp.float32)
+            v = dequantize_int8_block(v, vs_ref[0], out_dtype=jnp.float32)
         h, d = q.shape
         hkv = k.shape[1]
         qg = q.reshape(hkv, rep, d).astype(jnp.float32)
@@ -112,11 +125,14 @@ def _pa_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
 
 
 def paged_attention_kernel(q, k_pool, v_pool, block_tables, seq_lens,
-                           scale=None, interpret=None):
-    """Pallas path. q [S, H, D] -> [S, H, D]; idle slots (len 0) emit 0."""
+                           scale=None, interpret=None, k_scale=None,
+                           v_scale=None):
+    """Pallas path. q [S, H, D] -> [S, H, D]; idle slots (len 0) emit 0.
+    ``k_scale``/``v_scale`` [NB, bs, Hkv]: int8 pools, fused dequant."""
     s, h, d = q.shape
     nb, block_size, hkv, _ = k_pool.shape
     mb = block_tables.shape[1]
+    quantized = k_scale is not None
     if h % hkv:
         raise ValueError("paged_attention: %d heads not a multiple of "
                          "%d kv heads" % (h, hkv))
@@ -124,16 +140,23 @@ def paged_attention_kernel(q, k_pool, v_pool, block_tables, seq_lens,
         scale = 1.0 / math.sqrt(d)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    page_spec = pl.BlockSpec((1, block_size, hkv, d),
+                             lambda si, j, bt, ln: (bt[si, j], 0, 0, 0))
+    in_specs = [
+        pl.BlockSpec((1, h, d), lambda si, j, bt, ln: (si, 0, 0)),
+        page_spec, page_spec,
+    ]
+    operands = [q, k_pool, v_pool]
+    if quantized:
+        # scale planes ride the SAME block-table index map as the pages
+        scale_spec = pl.BlockSpec((1, block_size, hkv),
+                                  lambda si, j, bt, ln: (bt[si, j], 0, 0))
+        in_specs += [scale_spec, scale_spec]
+        operands += [k_scale, v_scale]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(s, mb),
-        in_specs=[
-            pl.BlockSpec((1, h, d), lambda si, j, bt, ln: (si, 0, 0)),
-            pl.BlockSpec((1, block_size, hkv, d),
-                         lambda si, j, bt, ln: (bt[si, j], 0, 0, 0)),
-            pl.BlockSpec((1, block_size, hkv, d),
-                         lambda si, j, bt, ln: (bt[si, j], 0, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, h, d), lambda si, j, bt, ln: (si, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((h, _STAT_LANES), jnp.float32),
@@ -143,22 +166,25 @@ def paged_attention_kernel(q, k_pool, v_pool, block_tables, seq_lens,
     )
     return pl.pallas_call(
         functools.partial(_pa_kernel, block_size=block_size,
-                          rep=h // hkv, scale=scale),
+                          rep=h // hkv, scale=scale, quantized=quantized),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((s, h, d), q.dtype),
         compiler_params=CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(jnp.asarray(block_tables, jnp.int32),
-      jnp.asarray(seq_lens, jnp.int32), q, k_pool, v_pool)
+      jnp.asarray(seq_lens, jnp.int32), *operands)
 
 
 def paged_attention_reference(q, k_pool, v_pool, block_tables, seq_lens,
-                              scale=None):
+                              scale=None, k_scale=None, v_scale=None):
     """jnp fallback: gather pages into a dense context, then the same
     fp32-statistics attention as nn.functional's _sdpa_reference — kept
     operation-for-operation compatible with the dense decode path so the
-    serving engine's greedy tokens match GenerationMixin.generate."""
+    serving engine's greedy tokens match GenerationMixin.generate.
+    With scale planes the dequant sits right after the gather — XLA
+    fuses the broadcast-multiply into the gather's consumer, so int8
+    pages decompress 'for free' on the way into the einsum."""
     s, h, d = q.shape
     nb, block_size, hkv, _ = k_pool.shape
     mb = block_tables.shape[1]
@@ -168,6 +194,13 @@ def paged_attention_reference(q, k_pool, v_pool, block_tables, seq_lens,
     lens = jnp.asarray(seq_lens, jnp.int32)
     k = k_pool[bt].reshape(s, mb * block_size, hkv, d)
     v = v_pool[bt].reshape(s, mb * block_size, hkv, d)
+    if k_scale is not None:
+        k = dequantize_int8_block(
+            k, k_scale[bt].reshape(s, mb * block_size, hkv),
+            out_dtype=jnp.float32)
+        v = dequantize_int8_block(
+            v, v_scale[bt].reshape(s, mb * block_size, hkv),
+            out_dtype=jnp.float32)
     if h != hkv:
         rep = h // hkv
         k = jnp.repeat(k, rep, axis=2)
@@ -184,15 +217,22 @@ def paged_attention_reference(q, k_pool, v_pool, block_tables, seq_lens,
     return out
 
 
-def _mixed_kernel(bt_ref, hist_ref, qlen_ref, q_ref, k_ref, v_ref, o_ref,
-                  m_scr, l_scr, acc_scr, *, block_size, rep, chunk, scale):
+def _mixed_kernel(bt_ref, hist_ref, qlen_ref, q_ref, k_ref, v_ref, *rest,
+                  block_size, rep, chunk, scale, quantized=False):
     """One (slot, page) program of the MIXED ragged step. q [1, C, H, D]
     (row s's chunk: q_len valid new tokens at absolute positions
     hist..hist+q_len-1); k/v [1, bs, Hkv, D] (the page the index map
     picked via the block table). The ragged causal rule is
     ``key position <= hist + ci`` per chunk row ci — a decode row is the
     C == q_len == 1 degenerate case. Stats flatten the (H, C) query rows
-    to H*C online-softmax rows; scratch m/l [H*C, 128], acc [H*C, D]."""
+    to H*C online-softmax rows; scratch m/l [H*C, 128], acc [H*C, D].
+    ``quantized``: int8 k/v blocks + scale refs [1, bs, Hkv] on the same
+    index map, dequantized here inside the gather (_pa_kernel note)."""
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        o_ref, m_scr, l_scr, acc_scr = rest
+        ks_ref = vs_ref = None
     s_i = pl.program_id(0)
     j = pl.program_id(1)
     num_j = pl.num_programs(1)
@@ -214,6 +254,9 @@ def _mixed_kernel(bt_ref, hist_ref, qlen_ref, q_ref, k_ref, v_ref, o_ref,
         q = q_ref[0]                                  # [C, H, D]
         k = k_ref[0]                                  # [bs, Hkv, D]
         v = v_ref[0]
+        if quantized:
+            k = dequantize_int8_block(k, ks_ref[0], out_dtype=jnp.float32)
+            v = dequantize_int8_block(v, vs_ref[0], out_dtype=jnp.float32)
         c, h, d = q.shape
         hkv = k.shape[1]
         # group for GQA: [C, H, D] -> [H, C, D] -> [Hkv, rep*C, D]
@@ -256,13 +299,16 @@ def _mixed_kernel(bt_ref, hist_ref, qlen_ref, q_ref, k_ref, v_ref, o_ref,
 
 def mixed_paged_attention_kernel(q, k_pool, v_pool, block_tables,
                                  hist_lens, q_lens, scale=None,
-                                 interpret=None):
+                                 interpret=None, k_scale=None,
+                                 v_scale=None):
     """Pallas path for the mixed step. q [S, C, H, D] -> [S, C, H, D];
     rows past q_len and idle rows emit unspecified-but-finite values the
-    host ignores."""
+    host ignores. ``k_scale``/``v_scale`` [NB, bs, Hkv]: int8 pools,
+    fused dequant inside the gather."""
     s, c, h, d = q.shape
     nb, block_size, hkv, _ = k_pool.shape
     mb = block_tables.shape[1]
+    quantized = k_scale is not None
     if h % hkv:
         raise ValueError("mixed_paged_attention: %d heads not a multiple"
                          " of %d kv heads" % (h, hkv))
@@ -270,17 +316,24 @@ def mixed_paged_attention_kernel(q, k_pool, v_pool, block_tables,
         scale = 1.0 / math.sqrt(d)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    page_spec = pl.BlockSpec((1, block_size, hkv, d),
+                             lambda si, j, bt, hl, ql: (bt[si, j], 0, 0, 0))
+    in_specs = [
+        pl.BlockSpec((1, c, h, d),
+                     lambda si, j, bt, hl, ql: (si, 0, 0, 0)),
+        page_spec, page_spec,
+    ]
+    operands = [q, k_pool, v_pool]
+    if quantized:
+        scale_spec = pl.BlockSpec(
+            (1, block_size, hkv),
+            lambda si, j, bt, hl, ql: (bt[si, j], 0, 0))
+        in_specs += [scale_spec, scale_spec]
+        operands += [k_scale, v_scale]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(s, mb),
-        in_specs=[
-            pl.BlockSpec((1, c, h, d),
-                         lambda si, j, bt, hl, ql: (si, 0, 0, 0)),
-            pl.BlockSpec((1, block_size, hkv, d),
-                         lambda si, j, bt, hl, ql: (bt[si, j], 0, 0, 0)),
-            pl.BlockSpec((1, block_size, hkv, d),
-                         lambda si, j, bt, hl, ql: (bt[si, j], 0, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, c, h, d), lambda si, j, bt, hl, ql: (si, 0, 0, 0)),
         scratch_shapes=[
@@ -291,7 +344,8 @@ def mixed_paged_attention_kernel(q, k_pool, v_pool, block_tables,
     )
     return pl.pallas_call(
         functools.partial(_mixed_kernel, block_size=block_size,
-                          rep=h // hkv, chunk=c, scale=scale),
+                          rep=h // hkv, chunk=c, scale=scale,
+                          quantized=quantized),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((s, c, h, d), q.dtype),
         compiler_params=CompilerParams(
@@ -299,11 +353,12 @@ def mixed_paged_attention_kernel(q, k_pool, v_pool, block_tables,
         interpret=interpret,
     )(jnp.asarray(block_tables, jnp.int32),
       jnp.asarray(hist_lens, jnp.int32),
-      jnp.asarray(q_lens, jnp.int32), q, k_pool, v_pool)
+      jnp.asarray(q_lens, jnp.int32), *operands)
 
 
 def mixed_paged_attention_reference(q, k_pool, v_pool, block_tables,
-                                    hist_lens, q_lens, scale=None):
+                                    hist_lens, q_lens, scale=None,
+                                    k_scale=None, v_scale=None):
     """jnp fallback for the mixed ragged step (chunked prefill + prefix-
     cache suffix prefill + decode rows in ONE call): gather each row's
     pages into a dense context — which already contains the chunk's own
@@ -320,6 +375,13 @@ def mixed_paged_attention_reference(q, k_pool, v_pool, block_tables,
     hist = jnp.asarray(hist_lens, jnp.int32)
     k = k_pool[bt].reshape(s, mb * block_size, hkv, d)
     v = v_pool[bt].reshape(s, mb * block_size, hkv, d)
+    if k_scale is not None:
+        k = dequantize_int8_block(
+            k, k_scale[bt].reshape(s, mb * block_size, hkv),
+            out_dtype=jnp.float32)
+        v = dequantize_int8_block(
+            v, v_scale[bt].reshape(s, mb * block_size, hkv),
+            out_dtype=jnp.float32)
     if h != hkv:
         rep = h // hkv
         k = jnp.repeat(k, rep, axis=2)
@@ -337,33 +399,48 @@ def mixed_paged_attention_reference(q, k_pool, v_pool, block_tables,
 
 
 def mixed_paged_attention(q, k_pool, v_pool, block_tables, hist_lens,
-                          q_lens, scale=None, interpret=None):
+                          q_lens, scale=None, interpret=None,
+                          k_scale=None, v_scale=None):
     """Dispatch for the mixed ragged step: the Pallas kernel on TPU when
     the geometry is Mosaic-tileable, the jnp gather fallback otherwise
-    (CPU engine path and the parity-test oracle form)."""
+    (CPU engine path and the parity-test oracle form). Quantized pools
+    additionally need the scale block's lane dim (Hkv) tileable —
+    on-chip Mosaic validation of the int8 path pending a tunnel window,
+    so small-Hkv models take the reference (XLA still fuses the
+    dequant into the gather)."""
     s, c, h, d = q.shape
     block_size = k_pool.shape[1]
+    hkv = k_pool.shape[2]
     tileable = (d % 128 == 0 and block_size % 8 == 0
-                and (h * c) % 8 == 0)
+                and (h * c) % 8 == 0
+                and (k_scale is None or hkv % 128 == 0))
     if jax.default_backend() == "tpu" and tileable:
         return mixed_paged_attention_kernel(
             q, k_pool, v_pool, block_tables, hist_lens, q_lens,
-            scale=scale, interpret=interpret)
+            scale=scale, interpret=interpret, k_scale=k_scale,
+            v_scale=v_scale)
     return mixed_paged_attention_reference(
-        q, k_pool, v_pool, block_tables, hist_lens, q_lens, scale=scale)
+        q, k_pool, v_pool, block_tables, hist_lens, q_lens, scale=scale,
+        k_scale=k_scale, v_scale=v_scale)
 
 
 def paged_attention(q, k_pool, v_pool, block_tables, seq_lens,
-                    scale=None, interpret=None):
+                    scale=None, interpret=None, k_scale=None,
+                    v_scale=None):
     """Dispatch: the Pallas kernel on TPU when the page geometry is
     Mosaic-tileable, the jnp gather fallback otherwise (CPU engine path,
-    and the form the parity test pins against masked_decode_attention)."""
+    and the form the parity test pins against masked_decode_attention).
+    Quantized-pool tileability note: see mixed_paged_attention."""
     s, h, d = q.shape
     block_size = k_pool.shape[1]
-    tileable = (d % 128 == 0 and block_size % 8 == 0 and h % 8 == 0)
+    hkv = k_pool.shape[2]
+    tileable = (d % 128 == 0 and block_size % 8 == 0 and h % 8 == 0
+                and (k_scale is None or hkv % 128 == 0))
     if jax.default_backend() == "tpu" and tileable:
         return paged_attention_kernel(q, k_pool, v_pool, block_tables,
                                       seq_lens, scale=scale,
-                                      interpret=interpret)
+                                      interpret=interpret,
+                                      k_scale=k_scale, v_scale=v_scale)
     return paged_attention_reference(q, k_pool, v_pool, block_tables,
-                                     seq_lens, scale=scale)
+                                     seq_lens, scale=scale,
+                                     k_scale=k_scale, v_scale=v_scale)

@@ -48,10 +48,20 @@ TRASH_BLOCK = 0
 
 
 class KVBlockPool(NamedTuple):
-    """One layer's page pools: k/v [num_blocks, block_size, Hkv, D]."""
+    """One layer's page pools: k/v [num_blocks, block_size, Hkv, D].
+
+    Under FLAGS_serving_quant_kv the k/v planes are int8 and the
+    per-(page, position, head) fp32 scale planes
+    ``k_scale``/``v_scale`` [num_blocks, block_size, Hkv] live
+    alongside them — same page ids, same scatter indices, donated and
+    COW-cloned together. Flags-off they are None, which jax treats as
+    an EMPTY pytree node: the flattened leaves (and therefore every
+    compiled step's jaxpr) are bit-identical to the pre-quant build."""
 
     k: "object"
     v: "object"
+    k_scale: "object" = None
+    v_scale: "object" = None
 
 
 class BlockAllocator:
@@ -130,17 +140,22 @@ class PagedKVCache:
 
     def __init__(self, num_layers, num_blocks, block_size, num_kv_heads,
                  head_dim, max_slots, max_blocks_per_slot,
-                 dtype="float32"):
-        dt = jnp.dtype(dtype)
+                 dtype="float32", quantized=False):
+        dt = jnp.dtype("int8") if quantized else jnp.dtype(dtype)
         self.block_size = block_size
         self.max_slots = max_slots
         self.max_blocks_per_slot = max_blocks_per_slot
+        self.quantized = bool(quantized)
+        page = (num_blocks, block_size, num_kv_heads, head_dim)
+        # zero scales x zero int8 pages dequantize to exact zeros, so
+        # trash/idle reads match the fp32 zero-init pools bit-for-bit
+        scale = ((num_blocks, block_size, num_kv_heads)
+                 if quantized else None)
         self.pools = [
             KVBlockPool(
-                jnp.zeros((num_blocks, block_size, num_kv_heads,
-                           head_dim), dt),
-                jnp.zeros((num_blocks, block_size, num_kv_heads,
-                           head_dim), dt))
+                jnp.zeros(page, dt), jnp.zeros(page, dt),
+                jnp.zeros(scale, jnp.float32) if quantized else None,
+                jnp.zeros(scale, jnp.float32) if quantized else None)
             for _ in range(num_layers)]
         self.allocator = BlockAllocator(num_blocks)
         self.block_tables = np.zeros((max_slots, max_blocks_per_slot),
@@ -227,8 +242,15 @@ class PagedKVCache:
             # per-page updates would pay that copy once per clone
             s = jnp.asarray(src, jnp.int32)
             d = jnp.asarray(dst, jnp.int32)
+            # _replace keeps the scale planes; under quant they are
+            # cloned with the same batched gather-scatter so a COW'd
+            # page carries its scales (shared holders keep theirs)
             self.pools = [
-                KVBlockPool(p.k.at[d].set(p.k[s]), p.v.at[d].set(p.v[s]))
+                p._replace(
+                    k=p.k.at[d].set(p.k[s]), v=p.v.at[d].set(p.v[s]),
+                    **({} if p.k_scale is None else {
+                        "k_scale": p.k_scale.at[d].set(p.k_scale[s]),
+                        "v_scale": p.v_scale.at[d].set(p.v_scale[s])}))
                 for p in self.pools]
         return ok
 
@@ -266,8 +288,8 @@ class PagedKVCache:
         and dtypes survive a deleted jax array, so the new pools match
         the compiled steps' signatures exactly — no retrace."""
         self.pools = [
-            KVBlockPool(jnp.zeros(p.k.shape, p.k.dtype),
-                        jnp.zeros(p.v.shape, p.v.dtype))
+            KVBlockPool(*[None if x is None
+                          else jnp.zeros(x.shape, x.dtype) for x in p])
             for p in self.pools]
         self.allocator = BlockAllocator(int(self.pools[0].k.shape[0]))
         self.block_tables[:] = TRASH_BLOCK
@@ -277,6 +299,28 @@ class PagedKVCache:
 
 def _raw(x):
     return x._value if hasattr(x, "_value") else jnp.asarray(x)
+
+
+def _write_pages(pool, pages, offs, kv, vv):
+    """Scatter fresh K/V into the pool planes at ``(pages, offs)`` —
+    the views' single unconditional write. With int8 pools (scale
+    planes present) each (position, head) head_dim vector is quantized
+    AT WRITE TIME and its scale lands in the scale plane at the same
+    indices, so the trash-page discipline covers scales for free: a pad
+    position's quantized garbage and its scale both land in page 0."""
+    if pool.k_scale is None:
+        return pool._replace(
+            k=pool.k.at[pages, offs].set(kv.astype(pool.k.dtype)),
+            v=pool.v.at[pages, offs].set(vv.astype(pool.v.dtype)))
+    from ..kernels.quant import quantize_int8_page
+
+    kq, ks = quantize_int8_page(kv)
+    vq, vs = quantize_int8_page(vv)
+    return pool._replace(
+        k=pool.k.at[pages, offs].set(kq),
+        v=pool.v.at[pages, offs].set(vq),
+        k_scale=pool.k_scale.at[pages, offs].set(ks),
+        v_scale=pool.v_scale.at[pages, offs].set(vs))
 
 
 class PagedPrefillView:
@@ -299,9 +343,9 @@ class PagedPrefillView:
         pos = jnp.arange(p)
         pages = self.table_row[pos // self.block_size]
         offs = pos % self.block_size
-        new_pool = KVBlockPool(
-            self.pool.k.at[pages, offs].set(kv[0].astype(self.pool.k.dtype)),
-            self.pool.v.at[pages, offs].set(vv[0].astype(self.pool.v.dtype)))
+        new_pool = _write_pages(self.pool, pages, offs, kv[0], vv[0])
+        # prefill attends over the raw fp32 fresh K/V (dense causal),
+        # never the pool — quantization error only enters on pool READS
         heads, kv_heads = qv.shape[2], kv.shape[2]
         if heads != kv_heads:
             rep = heads // kv_heads
@@ -336,13 +380,11 @@ class PagedDecodeView:
         lens = self.seq_lens
         pages = self.block_tables[jnp.arange(s), lens // self.block_size]
         offs = lens % self.block_size
-        new_pool = KVBlockPool(
-            self.pool.k.at[pages, offs].set(
-                kv[:, 0].astype(self.pool.k.dtype)),
-            self.pool.v.at[pages, offs].set(
-                vv[:, 0].astype(self.pool.v.dtype)))
+        new_pool = _write_pages(self.pool, pages, offs, kv[:, 0], vv[:, 0])
         out = paged_attention(qv[:, 0], new_pool.k, new_pool.v,
-                              self.block_tables, lens + 1)
+                              self.block_tables, lens + 1,
+                              k_scale=new_pool.k_scale,
+                              v_scale=new_pool.v_scale)
         return Tensor(out[:, None]), PagedDecodeView(
             new_pool, self.block_tables, lens, self.block_size)
 
@@ -384,14 +426,12 @@ class PagedMixedView:
             valid, jnp.take_along_axis(self.block_tables, page_idx,
                                        axis=1), TRASH_BLOCK)
         offs = jnp.where(valid, pos % self.block_size, 0)
-        new_pool = KVBlockPool(
-            self.pool.k.at[pages, offs].set(
-                kv.astype(self.pool.k.dtype)),
-            self.pool.v.at[pages, offs].set(
-                vv.astype(self.pool.v.dtype)))
+        new_pool = _write_pages(self.pool, pages, offs, kv, vv)
         out = mixed_paged_attention(qv, new_pool.k, new_pool.v,
                                     self.block_tables, self.hist_lens,
-                                    self.q_lens)
+                                    self.q_lens,
+                                    k_scale=new_pool.k_scale,
+                                    v_scale=new_pool.v_scale)
         return Tensor(out), PagedMixedView(
             new_pool, self.block_tables, self.hist_lens, self.q_lens,
             self.block_size)

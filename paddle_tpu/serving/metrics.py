@@ -108,6 +108,16 @@ _GOODPUT = _mgauge("serving_goodput_tokens_per_s",
 _KV_OCC = _mgauge("serving_kv_page_occupancy",
                   "fraction of usable KV pages held by live requests",
                   labelnames=("engine",))
+# KV quantization (FLAGS_serving_quant_kv): gauge bound lazily on the
+# first quant sample — with the flag off no series exists at all, and
+# the counter is registered-but-untouched (series-free), the PR-2/5/6
+# flags-off discipline
+_KV_QUANT_PAGES = _mgauge("serving_kv_quant_pages",
+                          "KV pages held as int8 block-scaled planes",
+                          labelnames=("engine",))
+_QUANT_DEQ_BYTES = _mcounter(
+    "serving_quant_dequant_bytes_total",
+    "int8 KV bytes dequantized inside paged-attention gathers")
 _ENGINE_IDS = itertools.count()
 # engine-labeled gauge series are pruned to this many newest engines —
 # a process that constructs engines repeatedly (test suites, rolling
@@ -279,6 +289,7 @@ class EngineMetrics:
         # off no serving_prefix_cache_pages series exists at all
         self._eid = eid
         self._prefix_pages_gauge = None
+        self._quant_pages_gauge = None
         _prune_engine_series()
         # wall clock starts at FIRST ADMISSION, not construction: an
         # engine built ahead of traffic must not understate throughput
@@ -305,6 +316,9 @@ class EngineMetrics:
         self.prefix_cached_pages = 0
         self.cow_clones = 0
         self.prefill_chunks = 0
+        # KV quantization (FLAGS_serving_quant_kv; 0 with the flag off)
+        self.kv_quant_pages = 0
+        self.quant_dequant_bytes = 0
 
     # -- engine hooks (mirror every sample into the shared registry) ---
 
@@ -369,6 +383,19 @@ class EngineMetrics:
         self.prefix_cached_pages = pc_stats["cached_pages"]
         self.cow_clones = cow_clones
         self._prefix_pages_gauge.set(pc_stats["cached_pages"])
+
+    def on_quant_step(self, pages_used, dequant_bytes):
+        """Engine-pushed quant-KV sample, once per decode/mixed step
+        with FLAGS_serving_quant_kv on: the live int8 page count and
+        the int8 bytes the step's attention gathers dequantized."""
+        if self._quant_pages_gauge is None:
+            self._quant_pages_gauge = _KV_QUANT_PAGES.labels(
+                engine=self._eid)
+        self.kv_quant_pages = pages_used
+        self._quant_pages_gauge.set(pages_used)
+        if dequant_bytes:
+            self.quant_dequant_bytes += int(dequant_bytes)
+            _QUANT_DEQ_BYTES.inc(int(dequant_bytes))
 
     def on_output_token(self):
         self.output_tokens += 1
@@ -472,4 +499,6 @@ class EngineMetrics:
             "prefix_cached_pages": self.prefix_cached_pages,
             "cow_clones": self.cow_clones,
             "prefill_chunks": self.prefill_chunks,
+            "kv_quant_pages": self.kv_quant_pages,
+            "quant_dequant_bytes": self.quant_dequant_bytes,
         }

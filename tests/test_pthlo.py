@@ -249,12 +249,13 @@ class TestGraphGate:
         assert not skipped, skipped
         assert set(report["fixtures"]) == set(GRAPH_FIXTURES)
         # the matrix is real: train exact + qsync both bucket ends,
-        # pipeline, and all four serving combos
+        # pipeline, all four serving combos, and the quant-KV pair
         assert {"llama_train", "llama_train_qsync",
                 "llama_train_qsync_fine", "gpt_train", "ernie_train",
                 "pipeline_train", "serving_base", "serving_prefix",
-                "serving_chunked",
-                "serving_prefix_chunked"} <= set(report["fixtures"])
+                "serving_chunked", "serving_prefix_chunked",
+                "serving_quant_kv",
+                "serving_quant_prefix_chunked"} <= set(report["fixtures"])
 
     def test_quantized_fixture_counts_match_bucket_plan(self, gate_run):
         """The acceptance pin: all-to-all/all-gather counts == 2x the
@@ -274,9 +275,13 @@ class TestGraphGate:
             report["fixtures"]["llama_train_qsync"]["qsync_buckets"]
 
     def test_serving_steps_fully_donate_their_pools(self, gate_run):
+        # the quant fixtures pin that the int8 pools AND their fp32
+        # scale planes alias in-place — scales ride the same donated
+        # pools pytree, so state_aliased == state_leaves covers both
         report, _ = gate_run
         for name in ("serving_base", "serving_prefix",
-                     "serving_chunked", "serving_prefix_chunked"):
+                     "serving_chunked", "serving_prefix_chunked",
+                     "serving_quant_kv", "serving_quant_prefix_chunked"):
             for sname, srep in report["fixtures"][name]["steps"] \
                     .items():
                 d = srep["donation"]

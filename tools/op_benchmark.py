@@ -291,6 +291,18 @@ def _cases():
     qq, qs = quantize_int8_block(qx)
     case("dequantize_int8_block_25M", (qq, qs),
          lambda q, sc: dequantize_int8_block(q, sc))
+    # KV-page shape (serving quant-kv, FLAGS_serving_quant_kv): per-
+    # (position, head) vector scales over head_dim — the write-time
+    # quantize and the fused-gather dequantize the paged-attention
+    # views pay, at a serving-sized pool slab [pages*bs, Hkv, D]
+    from paddle_tpu.kernels.quant import quantize_int8_page
+
+    kvp = s(8192, 8, 128, dtype=jnp.float32)
+    case("quantize_int8_page_kv8M", (kvp,),
+         lambda x: quantize_int8_page(x))
+    kq, ks = quantize_int8_page(kvp)
+    case("dequantize_int8_page_kv8M", (kq, ks),
+         lambda q, sc: dequantize_int8_block(q, sc))
 
     # -- manipulation family --
     case("transpose_0213_8x12x512x64",

@@ -558,6 +558,17 @@ def main():
                          "stream through the ONE mixed step in chunks)")
     ap.add_argument("--prefill-chunk", type=int, default=16,
                     help="chunk size for --chunked-prefill")
+    ap.add_argument("--quant-kv", action="store_true",
+                    help="enable FLAGS_serving_quant_kv (int8 block-"
+                         "scaled KV pages + fp32 scale planes). "
+                         "--num-blocks then names the FP32 pool the "
+                         "byte budget could afford; the quantized run "
+                         "gets the SAME bytes, which buy more pages — "
+                         "the report's kv_capacity_headroom_vs_fp32")
+    ap.add_argument("--quant-weights", action="store_true",
+                    help="enable FLAGS_serving_quant_weights (weight-"
+                         "only int8 block-scaled projection matmuls on "
+                         "decode rows; prefill rows stay fp32)")
     ap.add_argument("--shared-prefix-tokens", type=int, default=0,
                     help="system-prompt traffic shape: every request's "
                          "prompt starts with one of --prefix-groups "
@@ -607,7 +618,25 @@ def main():
     _watchdog(args.watchdog)
     if args.fleet > 0:
         return run_fleet(args)
+    try:
+        return _run_single(args)
+    except Exception as e:
+        # bench.py staleness discipline for the single-engine rows too
+        # (battery serving/serving_prefix/serving_quant): a crashed run
+        # re-emits the previous snapshot marked stale (rc=3) instead of
+        # leaving a silently rotted photocopy behind
+        import traceback
+        traceback.print_exc()
+        _write_fleet_artifact(
+            args.out,
+            {"kind": "serving_bench", "error": repr(e),
+             "measured_at": time.strftime(
+                 "%Y-%m-%dT%H:%M:%SZ", time.gmtime())},
+            stale_reason=repr(e), kind="serving_bench")
+        return 3
 
+
+def _run_single(args):
     import numpy as np
 
     import jax
@@ -661,6 +690,10 @@ def main():
     ptflags.set_flags({
         "FLAGS_serving_prefix_cache": bool(args.prefix_cache),
         "FLAGS_serving_chunked_prefill": bool(args.chunked_prefill),
+        # serving-quant flags latch at Engine construction too — set
+        # BEFORE the engine is built (PR-9 discipline)
+        "FLAGS_serving_quant_kv": bool(args.quant_kv),
+        "FLAGS_serving_quant_weights": bool(args.quant_weights),
         # ptprof latches at Engine construction like the tier-2 flags
         # — set BEFORE the engine is built
         "FLAGS_monitor_profile": bool(args.profile),
@@ -672,12 +705,31 @@ def main():
 
         ptslo.enable()
 
+    # equal-byte-budget sizing (--quant-kv): --num-blocks names the
+    # fp32 pool a fixed HBM budget could afford. The quantized run
+    # keeps the SAME byte budget and converts it into MORE pages —
+    # per-page k+v bytes: fp32 = 2*4*bs*Hkv*D, int8+scales =
+    # 2*(bs*Hkv*D + 4*bs*Hkv). The capacity headroom is the serving
+    # payoff: later preemption onset and lower shed rate at the same
+    # memory, reported as kv_capacity_headroom_vs_fp32 (>= 1.8 for any
+    # realistic head_dim; 4D/(D+4) ~ 3.76x at D=64).
+    kv_heads = cfg.num_key_value_heads or cfg.num_attention_heads
+    head_dim = cfg.hidden_size // cfg.num_attention_heads
+    fp32_page_bytes = 8 * args.block_size * kv_heads * head_dim
+    quant_page_bytes = 2 * args.block_size * kv_heads * (head_dim + 4)
+    num_blocks = args.num_blocks
+    if args.quant_kv:
+        num_blocks = max(args.num_blocks,
+                         args.num_blocks * fp32_page_bytes
+                         // quant_page_bytes)
+    kv_headroom = num_blocks / args.num_blocks
+
     # resilience knobs are applied AFTER warmup (below): the compile
     # warmup enqueues one request per prefill bucket, and a deadline or
     # queue bound there would expire/reject buckets — pushing their
     # compiles into the measured window
     eng = serving.Engine(model, max_slots=args.max_slots,
-                         num_blocks=args.num_blocks,
+                         num_blocks=num_blocks,
                          block_size=args.block_size,
                          prefill_chunk=args.prefill_chunk)
 
@@ -756,6 +808,13 @@ def main():
 
     ids = []
     rejected = {}          # admission-shed reason -> count (no id)
+    # pool-pressure trajectory: peak page occupancy overall and the
+    # occupancy right BEFORE the first preemption/shed event — with
+    # --quant-kv the same byte budget holds more pages, so pressure
+    # (and the preemption tax) arrives later or never
+    peak_occ = 0.0
+    occ_at_first_pressure = None
+    pressure_base = (eng.metrics.preemptions, eng.metrics.requests_shed)
     start = time.perf_counter()
     nxt = 0
     profile_armed = False
@@ -777,7 +836,17 @@ def main():
                 rejected[e.reason] = rejected.get(e.reason, 0) + 1
             nxt += 1
         if eng.has_work():
+            alloc = eng.cache.allocator
+            occ = (1.0 - alloc.free_blocks
+                   / max(alloc.usable_blocks, 1))
+            peak_occ = max(peak_occ, occ)
             eng.step()
+            if occ_at_first_pressure is None and (
+                    (eng.metrics.preemptions,
+                     eng.metrics.requests_shed) != pressure_base):
+                # occupancy going INTO the step that first preempted
+                # or shed — the onset point of pool pressure
+                occ_at_first_pressure = occ
         elif nxt < args.requests:
             time.sleep(min(arrivals[nxt] - now, 0.05))
     wall = time.perf_counter() - start
@@ -831,6 +900,7 @@ def main():
                  and m["prefix_cached_tokens_first"] == 0]
 
     report = {
+        "kind": "serving_bench",
         "metric": "serving_throughput_tok_s",
         "value": round(out_tokens / max(wall, 1e-9), 1),
         "unit": "tok/s",
@@ -849,6 +919,8 @@ def main():
             "chunked_prefill": bool(args.chunked_prefill),
             "prefill_chunk": (args.prefill_chunk
                               if args.chunked_prefill else None),
+            "quant_kv": bool(args.quant_kv),
+            "quant_weights": bool(args.quant_weights),
         },
         "wall_s": round(wall, 3),
         "warmup_compile_s": round(warmup_s, 3),
@@ -867,6 +939,28 @@ def main():
         "prefill_chunks": stats["prefill_chunks"] - base["prefill_chunks"],
         "tpot_s": _pcts(tpot),
         "queue_time_s": _pcts(queue),
+        # serving-quant scoreboard: at the FIXED byte budget named by
+        # --num-blocks, how many pages did the dtype buy, how late did
+        # pool pressure arrive, and how much traffic was shed. The
+        # acceptance headline is kv_capacity_headroom_vs_fp32 >= 1.8
+        # with --quant-kv on.
+        "quant": {
+            "quant_kv": bool(args.quant_kv),
+            "quant_weights": bool(args.quant_weights),
+            "num_blocks_fp32_budget": args.num_blocks,
+            "num_blocks_effective": num_blocks,
+            "kv_page_bytes_fp32": fp32_page_bytes,
+            "kv_page_bytes_quant": quant_page_bytes,
+            "kv_capacity_headroom_vs_fp32": round(kv_headroom, 3),
+            "peak_kv_page_occupancy": round(peak_occ, 4),
+            "occupancy_before_first_pressure": (
+                None if occ_at_first_pressure is None
+                else round(occ_at_first_pressure, 4)),
+            "shed_rate": round(
+                stats["requests_shed"] / max(args.requests, 1), 4),
+            "kv_quant_pages": stats.get("kv_quant_pages", 0),
+            "quant_dequant_bytes": stats.get("quant_dequant_bytes", 0),
+        },
         "preemptions": stats["preemptions"] - base["preemptions"],
         "decode_steps": meas_steps,
         "decode_compiles": stats["decode_compiles"],

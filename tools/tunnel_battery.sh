@@ -210,6 +210,27 @@ run serving_prefix 1200 env $(wd serving_prefix) \
     --shared-prefix-tokens 128 --prefix-groups 4 \
     --out tools/serving_prefix_bench.json
 
+# 5b3. serving quant row (ISSUE 19): the SAME shared-prefix shape as
+#     5b2 with int8 block-scaled KV pages + weight-only int8 decode on
+#     top (prefix cache + chunked prefill stay on — COW clones must
+#     copy scale planes on-chip too). --num-blocks names the fp32 byte
+#     budget; the quantized pool converts the same bytes into ~3.8x
+#     the pages at head_dim=128, and the artifact's quant section
+#     reports kv_capacity_headroom_vs_fp32 (acceptance: >= 1.8),
+#     occupancy at first preemption/shed, and shed rate — compare
+#     against the 5b2 row at the SAME --num-blocks to see pressure
+#     arrive later. Still pins decode_compiles == 1 (rc=4): the
+#     dequant-fused mixed step is THE one compiled step. Exercises the
+#     quantized Mosaic paged-attention path (num_kv_heads*head_dim
+#     tiling permitting) that CPU interpret tests can only approximate.
+run serving_quant 1200 env $(wd serving_quant) \
+    python tools/serving_benchmark.py --preset llama1b \
+    --requests 64 --rate 8 --max-slots 8 --num-blocks 512 \
+    --prefix-cache --chunked-prefill \
+    --shared-prefix-tokens 128 --prefix-groups 4 \
+    --quant-kv --quant-weights \
+    --out tools/serving_quant_bench.json
+
 # 5c. resilience serving row (ISSUE 7): the same engine under an
 #     injected fault schedule + queue bound + deadlines — reports
 #     goodput next to shed/expired/poison counts, proving graceful
