@@ -12,7 +12,7 @@ don't):
 2. **family** — the name matches one of the established family
    prefixes (``serving_ | train_ | fleet_ | perf_ | comm_ | store_ |
    faults_ | watchdog_ | mem_ | profile_ | router_ | slo_ |
-   incident_``) or a config-allowed legacy
+   incident_ | replay_``) or a config-allowed legacy
    name
    (``[tool.ptlint.metric] allow``; trailing ``*`` = prefix) — new
    subsystems extend the config deliberately, not by drift.
@@ -34,7 +34,7 @@ RULE = "metric"
 
 _DEFAULT_FAMILIES = ["serving", "train", "fleet", "perf", "comm",
                      "store", "faults", "watchdog", "mem", "profile",
-                     "router", "slo", "incident"]
+                     "router", "slo", "incident", "replay"]
 _KINDS = ("counter", "gauge", "histogram")
 # import heads that denote the shared registry (post alias-flattening)
 _REGISTRY_HEADS = ("monitor", "registry", "paddle_tpu.monitor")

@@ -217,6 +217,23 @@ _DEFAULTS = {
     # (test-pinned, the PR-2/5/6 discipline). Latched at Replica/
     # Router construction.
     "FLAGS_serving_fleet": False,
+    # deterministic request record/replay journal (serving/replay.py,
+    # tools/ptreplay.py): every admission captures what re-execution
+    # needs — prompt token ids, sampling params, the engine's latched
+    # flag snapshot (prefix x chunked x quant axes), weights
+    # generation, capability snapshot — and every terminal stamps the
+    # outcome digest (output ids + rolling token hash, phase timings,
+    # preempt count, shed/expired reason) into a bounded journal
+    # (PT_REPLAY_CAPACITY, finished-evicted-first). write_journal()
+    # emits the versioned JSONL artifact tools/ptreplay.py re-drives a
+    # REAL engine from and diffs token-for-token (--matrix bisects
+    # which flag axis introduced a divergence; --against diffs two
+    # recordings). Off = the engine's recorder handle stays None: zero
+    # journal allocations, zero threads (this plane NEVER has
+    # threads), zero replay_* series, wire/result payloads
+    # bit-identical (test-pinned, the PR-2/5/6 discipline). Latched at
+    # Engine construction.
+    "FLAGS_serving_replay": False,
     # deterministic fault injection (paddle_tpu/resilience/faultinject).
     # Off = every injection site (store ops, eager collectives, serving
     # engine step, compiled train step) is one attribute load + branch:

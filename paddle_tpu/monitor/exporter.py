@@ -70,6 +70,13 @@ server stack: ``KVHTTPServer`` gained a ``get_routes`` hook, and
                         from every scraped rank's table + the
                         collector's own, clock-offset-aligned and
                         deduped by incident id (monitor/fleet.py)
+    GET /debugz/replay  record/replay journal summary + per-request
+                        outcome digests (prompt/output token counts,
+                        rolling token hash, flag snapshot, trace_id
+                        cross-links) + the router's dispatch-decision
+                        ring (serving/replay.py payload; reports
+                        disabled — without importing the serving
+                        package — while FLAGS_serving_replay is off)
 
 The /healthz and /debugz routes are served live from monitor/watchdog.py
 whether or not the watchdog thread is running (the verdict just reads
@@ -162,6 +169,7 @@ class MetricsServer:
         routes["debugz/slo"] = self._slo
         routes["debugz/incidents"] = self._incidents
         routes["debugz/fleet/incidents"] = self._fleet_incidents
+        routes["debugz/replay"] = self._replay
         self._kv.http_server.get_prefix_routes["debugz/trace"] = \
             self._trace_by_id
 
@@ -278,6 +286,24 @@ class MetricsServer:
         body = json.dumps(
             _watchdog.json_safe(_fleet.fleet_incidents_payload()),
             default=str).encode()
+        return 200, "application/json", body
+
+    def _replay(self):
+        # lazier than the /debugz/resilience route: the serving
+        # package pulls in the accelerator backend, so the monitor
+        # plane must not import it just to say "disabled" — serve the
+        # module only if an engine (or tool) already imported it. The
+        # literal below is pinned bit-identical to
+        # serving/replay.payload()'s disabled body by
+        # tests/test_debugz_routes.py.
+        import sys
+
+        mod = sys.modules.get("paddle_tpu.serving.replay")
+        if mod is None:
+            p = {"enabled": False, "requests": [], "dispatches": 0}
+        else:
+            p = mod.payload()
+        body = json.dumps(_watchdog.json_safe(p), default=str).encode()
         return 200, "application/json", body
 
     def _resilience(self):

@@ -54,6 +54,7 @@ from .kv_cache import (
     PagedMixedView,
     PagedPrefillView,
 )
+from . import replay as _replay
 from .metrics import EngineMetrics, now, span
 from .scheduler import Request, RequestState, Scheduler
 
@@ -268,6 +269,17 @@ class Engine:
         # timers, and the device-capture-window lifecycle. None =
         # flags-off; the step hot path only ever checks the handle.
         self._prof = _monitor.profile.step_hook("serving")
+        # weight-swap generation (ROADMAP item 6): stamped into every
+        # replay journal entry + benchmark requests_detail row so a
+        # post-hot-swap divergence is attributable to the generation
+        # that served it; the swap path will bump it
+        self.weights_generation = 0
+        # record/replay recorder (serving/replay.py,
+        # FLAGS_serving_replay), LATCHED HERE like the tier-2 flags
+        # and the monitor handles: None = flags-off — every capture
+        # site below is one handle-is-None branch, zero journal
+        # allocations, wire/result payloads bit-identical
+        self._replay = _replay.recorder(self)
 
     def _mem_components(self):
         """Ledger providers (monitor/memory.py): the paged KV pools
@@ -383,11 +395,19 @@ class Engine:
         # the admission point — so the queue phase covers every second
         # the engine owned the request
         req.trace_begin(trace_ctx)
+        # replay journal admission capture (FLAGS_serving_replay):
+        # AFTER trace_begin so the entry cross-links the adopted
+        # fleet-wide trace id, not a pre-adoption placeholder
+        rec = self._replay
+        if rec is not None:
+            rec.admit(req, deadline_s=deadline_s)
         self.metrics.on_request_in()
         if max_new_tokens == 0:     # zero-length generation: trivially done
             req.finish()
             self.metrics.on_request_finished()
             req.trace_finish("finished")
+            if rec is not None:
+                rec.terminal(req)
             return req.id
         if req.trace_id is not None:
             req.trace_phase("queue")
@@ -552,6 +572,8 @@ class Engine:
             req.close(RequestState.EXPIRED, "deadline")
             self._quarantine.discard(req.id)
             self.metrics.on_request_shed("expired")
+            if self._replay is not None:
+                self._replay.terminal(req)
 
     def _admit_and_prefill(self):
         while True:
@@ -602,6 +624,8 @@ class Engine:
         req.close(RequestState.FAILED, "poison", error=exc)
         self._quarantine.discard(req.id)
         self.metrics.on_request_shed("poison")
+        if self._replay is not None:
+            self._replay.terminal(req)
         self._recover_consumed_pools()
 
     def _recover_consumed_pools(self):
@@ -765,6 +789,8 @@ class Engine:
                         req.close(RequestState.SHED, "preempt_cap")
                         self._quarantine.discard(req.id)
                         self.metrics.on_request_shed("preempt_cap")
+                        if self._replay is not None:
+                            self._replay.terminal(req)
                         if self._mem is not None:
                             self._mem.note_decision(
                                 "shed", request=req.id,
@@ -948,6 +974,8 @@ class Engine:
             self._quarantine.discard(req.id)   # survived serial decode
             self.metrics.on_request_finished(len(req.generated))
             req.trace_finish("finished")
+            if self._replay is not None:
+                self._replay.terminal(req)
 
     # -- graph analysis ---------------------------------------------------
 

@@ -65,6 +65,7 @@ from ...core import flags as _flags
 from ...monitor import fleet as _mfleet
 from ...monitor import trace as _trace
 from ...monitor.registry import warn_once
+from .. import replay as _replay
 from . import membership
 from .metrics import (AFFINITY_HITS, DISPATCH_SECONDS, E2E_SECONDS,
                       EVICTIONS, REQUESTS)
@@ -460,6 +461,11 @@ class Router:
                 # nonce makes the retry idempotent even if the replica
                 # DID admit before the connection died
                 _trace.end_span(dsid, outcome="unreachable")
+                _replay.note_dispatch(
+                    trace_id=tid, nonce=req["nonce"], rank=rank,
+                    endpoint=ent["url"],
+                    attempt=len(req["attempts"]) + 1,
+                    outcome="unreachable")
                 req["attempts"].append(
                     {"rank": rank, "outcome": "unreachable"})
                 self.drain(rank, reason="dispatch_failed")
@@ -469,6 +475,19 @@ class Router:
                 _trace.end_span(
                     dsid, outcome="accepted",
                     deduped=bool(resp.get("deduped")))
+                # replay journal (FLAGS_serving_replay; one enabled
+                # branch when off): the dispatch decision keyed by the
+                # fleet trace id — the stitch a fleet capture uses to
+                # reassemble per-replica journals into one workload. A
+                # reroute shows up as attempt > 1 under the SAME
+                # nonce; the replica dedups admission on it, so the
+                # serving replica still journals ONE entry
+                _replay.note_dispatch(
+                    trace_id=tid, nonce=req["nonce"], rank=rank,
+                    endpoint=ent["url"],
+                    attempt=len(req["attempts"]) + 1,
+                    outcome="rerouted" if req["_dispatched_once"]
+                    else "accepted")
                 req["attempts"].append(
                     {"rank": rank, "outcome": "accepted"})
                 req["attempt_ranks"].append(rank)
@@ -498,6 +517,10 @@ class Router:
             # 409 draining / queue_full, or any other refusal: walk on
             reason = (resp or {}).get("error")
             _trace.end_span(dsid, outcome="refused", reason=reason)
+            _replay.note_dispatch(
+                trace_id=tid, nonce=req["nonce"], rank=rank,
+                endpoint=ent["url"], attempt=len(req["attempts"]) + 1,
+                outcome="refused", reason=reason)
             req["attempts"].append(
                 {"rank": rank, "outcome": "refused", "reason": reason})
             if reason == "draining":

@@ -1,0 +1,481 @@
+"""Deterministic request record/replay journal (ptreplay, ISSUE 20).
+
+Every serving guarantee this repo ships — quant-kv greedy
+token-identity, prefix/chunked flags-off bit-identity, compile-once —
+is pinned only inside unit tests; a *running* engine keeps no record
+of what it served, so a production divergence (wrong tokens after a
+flag flip, a canary replica drifting from the fleet) is invisible and
+unreproducible. This module is the record half of the answer:
+
+1. **Recorder** — a bounded journal of served requests
+   (``PT_REPLAY_CAPACITY``, finished-evicted-first like the trace
+   store). At admission the engine's latched recorder handle captures
+   everything deterministic re-execution needs: prompt token ids,
+   sampling params (greedy today; the seed slot is where a sampler's
+   RNG key lands), the engine's latched flag snapshot (prefix x
+   chunked x quant axes), weights generation, and the capability
+   snapshot (slots/pages/chunk — the shapes the compiled step was
+   built for). At the terminal it stamps the outcome digest: output
+   token ids + a rolling token hash, per-request phase timings,
+   preempt/resume count, prefix-cache hit tokens, shed/expired
+   reason.
+
+2. **Artifact** — ``write_journal(path)`` emits a versioned JSONL
+   artifact (header line with a wall<->monotonic clock anchor — the
+   PR-6 trace_journal discipline — then one line per request);
+   ``tools/ptreplay.py run`` re-drives a freshly built REAL engine
+   from it and diffs token-for-token, ``--matrix`` bisects which flag
+   axis introduced a divergence. Greedy decode is deterministic per
+   slot (paged attention gathers each request's own pages), so replay
+   order/batching doesn't matter and the one compiled step makes the
+   re-execution cost no recompiles.
+
+3. **Fleet cross-links** — the router journals its dispatch decisions
+   (``note_dispatch``: request -> replica endpoint, reroute nonces)
+   keyed by the same trace ids the engine entries carry, so a fleet
+   capture can reassemble per-replica journals into one replayable
+   workload; ``/debugz/replay`` serves the summary + per-request
+   digests with ``trace_id`` cross-links into the trace plane.
+
+Division of labor (README "Record/replay"): the flight recorder
+replays *collectives*, the trace plane replays *journeys*, this plane
+replays *execution* — it is the proof layer, not a telemetry layer.
+
+Discipline (the PR-2/5/6 contract, test-pinned by
+tests/test_replay.py): default OFF via ``FLAGS_serving_replay``;
+while off the engine's recorder handle is ``None`` (zero journal
+allocations on the hot path), this module NEVER has threads, the
+``replay_*`` series stay unminted, and every payload the engine or
+fleet wire produces is bit-identical to a build without this module.
+Stdlib-only so worker processes can import it without an accelerator
+backend.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from ..core import flags as _coreflags
+from ..monitor import counter as _mcounter
+from ..monitor.registry import warn_once as _warn_once
+
+JOURNAL_VERSION = 1
+DEFAULT_CAPACITY = 256          # retained request entries
+_DISPATCH_CAP = 1024            # router dispatch-decision ring
+
+# the flag axes the recorder snapshots per entry and tools/ptreplay.py
+# --matrix bisects over (one flip per axis vs the recorded baseline)
+FLAG_AXES = (
+    ("prefix", "FLAGS_serving_prefix_cache"),
+    ("chunked", "FLAGS_serving_chunked_prefill"),
+    ("quant_kv", "FLAGS_serving_quant_kv"),
+    ("quant_weights", "FLAGS_serving_quant_weights"),
+)
+
+# registry metrics (lazy series: nothing exists until the first
+# recorded admission with the plane enabled — the series-free pin)
+_RECORDED = _mcounter(
+    "replay_requests_recorded_total",
+    "requests captured into the record/replay journal at admission")
+_EVICTED = _mcounter(
+    "replay_journal_evictions_total",
+    "journal entries evicted past PT_REPLAY_CAPACITY "
+    "(finished-first)")
+_DIVERGED = _mcounter(
+    "replay_divergences_total",
+    "replayed requests whose tokens diverged from the recording, by "
+    "the bisected axis (weights | prefix | chunked | quant_kv | "
+    "quant_weights | unknown)", labelnames=("axis",))
+
+
+def token_hash(tokens):
+    """Rolling FNV-1a-64 over token ids, as a hex digest: the
+    order-sensitive digest two artifacts compare for token identity
+    without shipping full outputs. Incremental by construction —
+    ``token_hash(a + b)`` picks up where ``token_hash(a)`` left off —
+    so a future streaming recorder can fold tokens as they land."""
+    h = 0xcbf29ce484222325
+    for t in tokens:
+        h ^= int(t) & 0xFFFFFFFFFFFFFFFF
+        h = (h * 0x100000001b3) & 0xFFFFFFFFFFFFFFFF
+    return "%016x" % h
+
+
+class _ReplayState:
+    __slots__ = ("enabled", "lock", "capacity", "entries", "order",
+                 "recorded", "evictions", "dispatches", "engines",
+                 "model_meta", "next_engine")
+
+    def __init__(self):
+        self.enabled = False
+        self.lock = threading.Lock()
+        self.capacity = int(os.environ.get("PT_REPLAY_CAPACITY",
+                                           DEFAULT_CAPACITY) or
+                            DEFAULT_CAPACITY)
+        self.entries = {}       # request id -> entry dict (insertion-ordered)
+        self.order = None       # unused; dict preserves admission order
+        self.recorded = 0
+        self.evictions = 0
+        self.dispatches = []    # router dispatch decisions, bounded
+        self.engines = {}       # engine id -> capability snapshot
+        self.model_meta = None  # how to rebuild the model (note_model)
+        self.next_engine = 0
+
+
+_state = _ReplayState()
+
+
+# -- lifecycle ---------------------------------------------------------------
+
+def enable(capacity=None):
+    """Turn the journal on (process-wide). Idempotent; capacity only
+    affects future evictions. No threads are started — recording rides
+    the engine's own call stack."""
+    if capacity is not None:
+        _state.capacity = max(int(capacity), 1)
+    _state.enabled = True
+    return _state
+
+
+def disable():
+    """Stop recording. Recorded entries are kept (inspectable
+    post-incident); ``clear()`` drops them."""
+    _state.enabled = False
+
+
+def is_enabled():
+    return _state.enabled
+
+
+def clear():
+    """Drop everything recorded AND restore the env-default capacity —
+    a test/tool that narrowed the journal via ``enable(capacity=...)``
+    must not leak that bound into the next recording."""
+    with _state.lock:
+        _state.entries = {}
+        _state.recorded = 0
+        _state.evictions = 0
+        _state.dispatches = []
+        _state.engines = {}
+        _state.model_meta = None
+        _state.capacity = int(os.environ.get("PT_REPLAY_CAPACITY",
+                                             DEFAULT_CAPACITY) or
+                              DEFAULT_CAPACITY)
+
+
+def drop_entries():
+    """Forget recorded request entries (and dispatch rows) while
+    keeping engine capability snapshots and model meta. Benchmarks
+    call this after compile warmup so the journal holds the measured
+    workload only — warmup requests are shape-probes, not workload."""
+    with _state.lock:
+        _state.entries = {}
+        _state.recorded = 0
+        _state.evictions = 0
+        _state.dispatches = []
+
+
+# -- recorder ----------------------------------------------------------------
+
+def _evict_locked():
+    """Drop oldest entries past capacity — terminal ones first, but
+    bounded beats complete: an all-open journal still evicts."""
+    while len(_state.entries) > _state.capacity:
+        victim = None
+        for rid, ent in _state.entries.items():
+            if ent["state"] != "open":
+                victim = rid
+                break
+        if victim is None:
+            victim = next(iter(_state.entries))
+        del _state.entries[victim]
+        _state.evictions += 1
+        _EVICTED.inc()
+
+
+class _Recorder:
+    """Per-engine recorder handle, latched by ``Engine.__init__`` when
+    FLAGS_serving_replay is on (``None`` otherwise — the hot-path
+    branch). Holds the engine's capability + flag snapshot computed
+    ONCE so per-request capture is dict assembly, never flag reads."""
+
+    __slots__ = ("engine_id", "flags", "caps", "_engine")
+
+    def __init__(self, engine):
+        import weakref
+
+        self._engine = weakref.ref(engine)
+        with _state.lock:
+            self.engine_id = _state.next_engine
+            _state.next_engine += 1
+        # the latched axes, read back from the ENGINE's own latches
+        # (not the live flag table): the snapshot must name what this
+        # engine actually compiled, surviving any later flag flip
+        self.flags = {
+            "FLAGS_serving_prefix_cache": engine.prefix_cache is not None,
+            "FLAGS_serving_chunked_prefill": bool(engine.chunked_prefill),
+            "FLAGS_serving_quant_kv": bool(engine.quant_kv),
+            "FLAGS_serving_quant_weights": bool(engine.quant_weights),
+        }
+        self.caps = {
+            "max_slots": engine.max_slots,
+            "block_size": engine.block_size,
+            "num_blocks": engine.cache.allocator.num_blocks,
+            "max_model_len": engine.max_model_len,
+            "prefill_chunk": engine.prefill_chunk,
+            "max_queue": engine.max_queue,
+        }
+        with _state.lock:
+            _state.engines[self.engine_id] = {
+                "flags": dict(self.flags), "caps": dict(self.caps)}
+
+    def admit(self, req, deadline_s=None):
+        """Admission capture: everything deterministic re-execution
+        needs, stamped the moment the engine owns the request."""
+        if not _state.enabled:
+            return
+        eng = self._engine()
+        entry = {
+            "id": req.id,
+            "engine": self.engine_id,
+            "trace_id": req.trace_id,
+            "admitted_wall": time.time(),
+            "admitted_mono": time.monotonic(),
+            "prompt": list(req.prompt),
+            "max_new_tokens": req.max_new_tokens,
+            "eos_token_id": req.eos_token_id,
+            "deadline_s": deadline_s,
+            # greedy decode takes no RNG; the seed slot is where a
+            # future sampler records its key so replay can re-seed
+            "sampling": {"mode": "greedy", "rng_seed": None},
+            "flags": self.flags,
+            "weights_generation": (0 if eng is None
+                                   else eng.weights_generation),
+            "state": "open",
+        }
+        with _state.lock:
+            _state.entries[req.id] = entry
+            _state.recorded += 1
+            _evict_locked()
+        _RECORDED.inc()
+
+    def terminal(self, req):
+        """Terminal capture (finished OR expired/shed/failed): the
+        outcome digest replay compares against. A no-op when the entry
+        was already evicted — bounded beats complete."""
+        if not _state.enabled:
+            return
+        m = req.metrics
+        with _state.lock:
+            entry = _state.entries.get(req.id)
+            if entry is None:
+                return
+            entry["state"] = req.state.value
+            entry["reason"] = req.status_reason
+            entry["output"] = list(req.generated)
+            entry["output_token_hash"] = token_hash(req.generated)
+            entry["preemptions"] = m.preemptions
+            entry["prefix_cached_tokens"] = m.prefix_cached_tokens
+            entry["completed_wall"] = time.time()
+            d = m.to_dict()
+            entry["timings_s"] = {
+                "queue": d.get("queue_time_s"),
+                "ttft": d.get("ttft_s"),
+                "tpot": d.get("tpot_s"),
+                "e2e": d.get("e2e_s"),
+            }
+
+
+def recorder(engine):
+    """The Engine's latch point: a live ``_Recorder`` iff
+    FLAGS_serving_replay is on at construction, else ``None`` — the
+    flags-off hot path is one handle-is-None branch per site (the
+    monitor memory/profile handle discipline)."""
+    if not _coreflags.flag("FLAGS_serving_replay"):
+        return None
+    if not _state.enabled:
+        enable()
+    return _Recorder(engine)
+
+
+# -- fleet cross-links -------------------------------------------------------
+
+def note_dispatch(trace_id=None, nonce=None, rank=None, endpoint=None,
+                  attempt=None, outcome=None, reason=None):
+    """Router-side journal of one dispatch decision (request ->
+    replica endpoint, reroute nonces), keyed by the same trace id the
+    replica's engine entry will carry — the stitch a fleet capture
+    reassembles per-replica journals with. Bounded ring; no-op while
+    the plane is off (one attribute load + branch)."""
+    if not _state.enabled:
+        return
+    rec = {"trace_id": trace_id, "nonce": nonce, "rank": rank,
+           "endpoint": endpoint, "attempt": attempt,
+           "outcome": outcome, "reason": reason, "wall": time.time()}
+    with _state.lock:
+        _state.dispatches.append(rec)
+        if len(_state.dispatches) > _DISPATCH_CAP:
+            del _state.dispatches[:len(_state.dispatches)
+                                  - _DISPATCH_CAP]
+
+
+def note_model(meta):
+    """Record how to rebuild the model (config kwargs + init seed +
+    preset name): ``tools/ptreplay.py`` reconstructs the weights from
+    this, so it lands in the journal header. Merges over repeat
+    calls."""
+    if not _state.enabled:
+        return
+    with _state.lock:
+        if _state.model_meta is None:
+            _state.model_meta = {}
+        _state.model_meta.update(meta)
+
+
+def note_divergence(axis, count=1, report=None):
+    """Count a replay divergence against its bisected axis and open a
+    ``replay_divergence`` incident (no-op while FLAGS_monitor_slo is
+    off — the incident plane's own discipline) with the divergence
+    report as evidence."""
+    _DIVERGED.labels(axis=axis).inc(count)
+    try:
+        from ..monitor import incidents as _incidents
+
+        _incidents.open(
+            "replay/divergence/%s" % axis, severity="ticket",
+            kind="replay_divergence", source="replay",
+            summary="%d replayed request(s) diverged from the "
+                    "recording (axis: %s)" % (count, axis),
+            evidence={"report": report} if report else None)
+    except Exception as e:
+        _warn_once("replay.incident",
+                   "paddle_tpu.serving.replay: incident open failed: "
+                   "%r" % (e,))
+
+
+# -- export ------------------------------------------------------------------
+
+def _digest_locked(entry):
+    """One /debugz/replay row: the entry minus its token payloads."""
+    out = {
+        "id": entry["id"],
+        "trace_id": entry["trace_id"],
+        "state": entry["state"],
+        "prompt_tokens": len(entry["prompt"]),
+        "max_new_tokens": entry["max_new_tokens"],
+        "weights_generation": entry["weights_generation"],
+        "flags": {axis: entry["flags"][name]
+                  for axis, name in FLAG_AXES},
+    }
+    if entry["state"] != "open":
+        out["reason"] = entry.get("reason")
+        out["output_tokens"] = len(entry.get("output") or ())
+        out["output_token_hash"] = entry.get("output_token_hash")
+        out["preemptions"] = entry.get("preemptions")
+    return out
+
+
+def payload():
+    """The /debugz/replay JSON body. The disabled body is pinned
+    bit-identical to the literal the exporter serves when this module
+    was never imported (tests/test_debugz_routes.py)."""
+    if not _state.enabled:
+        return {"enabled": False, "requests": [], "dispatches": 0}
+    with _state.lock:
+        rows = [_digest_locked(e) for e in _state.entries.values()]
+        n_disp = len(_state.dispatches)
+        recent = [dict(d) for d in _state.dispatches[-16:]]
+        model = (dict(_state.model_meta)
+                 if _state.model_meta is not None else None)
+    return {
+        "enabled": True,
+        "capacity": _state.capacity,
+        "recorded_total": _state.recorded,
+        "evictions": _state.evictions,
+        "entries": len(rows),
+        "open": sum(1 for r in rows if r["state"] == "open"),
+        "model": model,
+        "requests": rows,
+        "dispatches": n_disp,
+        "dispatches_recent": recent,
+    }
+
+
+def header():
+    """The journal header (JSONL line 1): version + clock anchor (the
+    trace_journal discipline: wall-stamped entries, the anchor is the
+    same-process shift onto the monotonic timebase) + everything
+    needed to rebuild the serving setup."""
+    with _state.lock:
+        engines = {str(eid): {"flags": dict(s["flags"]),
+                              "caps": dict(s["caps"])}
+                   for eid, s in _state.engines.items()}
+        model = (dict(_state.model_meta)
+                 if _state.model_meta is not None else None)
+        n = len(_state.entries)
+        disp = [dict(d) for d in _state.dispatches]
+    return {
+        "kind": "replay_journal",
+        "version": JOURNAL_VERSION,
+        "pid": os.getpid(),
+        "written_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                    time.gmtime()),
+        "clock_anchor": {"wall": time.time(),
+                         "monotonic": time.monotonic()},
+        "model": model,
+        "engines": engines,
+        "requests": n,
+        "recorded_total": _state.recorded,
+        "evictions": _state.evictions,
+        "dispatches": disp,
+    }
+
+
+def write_journal(path):
+    """Persist the journal as versioned JSONL: header line, then one
+    line per request entry in admission order. Atomic (tmp + replace);
+    returns (header, entries)."""
+    import json
+
+    head = header()
+    with _state.lock:
+        entries = [dict(e, flags=dict(e["flags"]))
+                   for e in _state.entries.values()]
+    d = os.path.dirname(os.path.abspath(path))
+    if d:
+        os.makedirs(d, exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(json.dumps(head, default=str) + "\n")
+        for e in entries:
+            f.write(json.dumps(e, default=str) + "\n")
+    os.replace(tmp, path)
+    return head, entries
+
+
+def load_journal(path):
+    """Parse a JSONL journal back into (header, entries); raises
+    ValueError on a kind/version mismatch (a journal from a future
+    schema must fail loudly, not replay garbage)."""
+    import json
+
+    with open(path) as f:
+        lines = [ln for ln in f.read().splitlines() if ln.strip()]
+    if not lines:
+        raise ValueError("empty replay journal: %s" % path)
+    head = json.loads(lines[0])
+    if head.get("kind") != "replay_journal":
+        raise ValueError("not a replay journal (kind=%r): %s"
+                         % (head.get("kind"), path))
+    if head.get("version") != JOURNAL_VERSION:
+        raise ValueError(
+            "replay journal version %r != supported %d: %s"
+            % (head.get("version"), JOURNAL_VERSION, path))
+    return head, [json.loads(ln) for ln in lines[1:]]
+
+
+# env/FLAGS bootstrap (the trace/timeseries discipline): a process
+# started with FLAGS_serving_replay=1 records from the first engine.
+if _coreflags.flag("FLAGS_serving_replay"):
+    enable()

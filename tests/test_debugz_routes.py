@@ -56,13 +56,14 @@ ROUTES = {
     "debugz/slo": (200, "json"),
     "debugz/incidents": (200, "json"),
     "debugz/fleet/incidents": (200, "json"),
+    "debugz/replay": (200, "json"),
 }
 
 ALL_FLAGS = ("FLAGS_monitor_timeseries", "FLAGS_perf_attribution",
              "FLAGS_perf_sentinels", "FLAGS_monitor_trace",
              "FLAGS_monitor_fleet", "FLAGS_monitor_memory",
              "FLAGS_monitor_profile", "FLAGS_serving_fleet",
-             "FLAGS_monitor_slo")
+             "FLAGS_monitor_slo", "FLAGS_serving_replay")
 
 
 @pytest.fixture()
@@ -93,10 +94,18 @@ def _reset_monitor_state():
     wd.stop_watchdog()
     fleet.stop_collector()
     fleet.clear_router_hook()
-    # drop router_* series another suite's fleet traffic may have
-    # minted: the all-off matrix pins the family series-free
+    # replay journal: reset WITHOUT importing it — the monitor plane
+    # must stay importable with no serving (jax-heavy) modules loaded,
+    # which is exactly the contract the /debugz/replay route keeps
+    import sys as _sys
+    _sreplay = _sys.modules.get("paddle_tpu.serving.replay")
+    if _sreplay is not None:
+        _sreplay.disable()
+        _sreplay.clear()
+    # drop router_*/replay_* series another suite's traffic may have
+    # minted: the all-off matrix pins the families series-free
     for m in mreg.get_registry().metrics():
-        if m.name.startswith("router_"):
+        if m.name.startswith(("router_", "replay_")):
             for store in ("_values", "_series"):
                 for key in list(getattr(m, store, ()) or ()):
                     m.remove(*key)
@@ -210,6 +219,18 @@ class TestRouteMatrixAllOff:
         snap = mreg.get_registry().snapshot()
         for name, fam in snap.items():
             if name.startswith(("slo_", "incident_")):
+                assert fam["series"] == [], name
+        # replay journal off: the pinned disabled body — bit-identical
+        # whether or not the serving package happens to be imported
+        # (the route must not import it just to say "disabled") — and
+        # zero replay_ series. The plane is thread-free by
+        # construction on AND off: recording rides the engine's own
+        # call stack (test_replay.py pins the engine-side path).
+        _, body = _get(server, "debugz/replay")
+        p = json.loads(body.decode())
+        assert p == {"enabled": False, "requests": [], "dispatches": 0}
+        for name, fam in mreg.get_registry().snapshot().items():
+            if name.startswith("replay_"):
                 assert fam["series"] == [], name
         # ...no collector / serving-fleet threads exist flags-off...
         import threading
@@ -341,6 +362,14 @@ class TestRouteMatrixAllOn:
         p = json.loads(body.decode())
         assert p["status"] == "degraded" and p["incidents_open"] >= 1
         ptinc.resolve("t_routes/incident", reason="matrix done")
+        # replay journal on: the route serves the live module payload
+        # (capacity/entries/requests), not the pinned disabled stub
+        from paddle_tpu.serving import replay as sreplay
+        sreplay.enable()
+        _, body = _get(server, "debugz/replay")
+        p = json.loads(body.decode())
+        assert p["enabled"] is True
+        assert p["requests"] == [] and p["capacity"] >= 1
         # serving-fleet routes: flag on + a live (endpoint-mode)
         # router registered via the monitor hook
         from paddle_tpu.serving.fleet import Router

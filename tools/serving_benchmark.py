@@ -588,6 +588,19 @@ def main():
                          "phase timers; arms a one-shot device-capture "
                          "window mid-run and reports host_blocked_s per "
                          "phase in the JSON")
+    ap.add_argument("--record-out", default=None,
+                    help="FLAGS_serving_replay: journal every measured "
+                         "request (prompt ids, flag snapshot, weights "
+                         "generation, output token hash) to this JSONL "
+                         "path; tools/ptreplay.py run re-drives it and "
+                         "diffs token-for-token")
+    ap.add_argument("--replay", default=None,
+                    help="replay a --record-out journal instead of "
+                         "generating a workload: delegates to "
+                         "tools/ptreplay.py (rebuilds the recorded "
+                         "model + engine, re-drives every finished "
+                         "request) and writes the divergence report to "
+                         "--out; rc=2 on divergence")
     ap.add_argument("--no-trace", action="store_true",
                     help="skip the span journal (requests_detail rows "
                          "then carry no trace_id/phases_s breakdown)")
@@ -616,6 +629,19 @@ def main():
                     help="fleet mode: per-phase drain deadline")
     args = ap.parse_args()
     _watchdog(args.watchdog)
+    if args.replay:
+        # replay mode IS ptreplay: same entrypoint for record and
+        # replay so CI rows and operators drive both through one tool
+        import importlib.util
+
+        p = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "ptreplay.py")
+        spec = importlib.util.spec_from_file_location("ptreplay", p)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod.run_replay(argparse.Namespace(
+            journal=args.replay, out=args.out, full=False,
+            matrix=False, against=None))
     if args.fleet > 0:
         return run_fleet(args)
     try:
@@ -687,7 +713,16 @@ def _run_single(args):
 
     from paddle_tpu.core import flags as ptflags
 
+    from paddle_tpu.serving import replay as sreplay
+
+    if args.record_out:
+        # journal capacity sized like the trace journal: the measured
+        # workload must never evict its own head
+        sreplay.enable(capacity=max(2 * args.requests + 64, 256))
     ptflags.set_flags({
+        # the record journal latches at Engine construction like every
+        # tier-2 serving flag
+        "FLAGS_serving_replay": bool(args.record_out),
         "FLAGS_serving_prefix_cache": bool(args.prefix_cache),
         "FLAGS_serving_chunked_prefill": bool(args.chunked_prefill),
         # serving-quant flags latch at Engine construction too — set
@@ -773,6 +808,11 @@ def _run_single(args):
         eng.metrics.on_prefix_stats(eng.prefix_cache.stats(),
                                     eng.cache.cow_clones)
     warmup_s = time.perf_counter() - t0
+    if args.record_out:
+        # warmup requests are shape probes, not workload: drop their
+        # journal entries (keeping the engine capability snapshot and
+        # model meta) so replay re-drives the measured window only
+        sreplay.drop_entries()
     if args.slo:
         # warmup requests must not count against the measured
         # window's objectives (the warmup-vs-workload split every
@@ -881,6 +921,12 @@ def _run_single(args):
             row["trace_id"] = tid
             row["phases_s"] = {k: round(v, 6)
                                for k, v in sorted(phases.items())}
+        # the replay-audit columns ride along unconditionally (the
+        # hash is a pure function of the output ids): two bench
+        # artifacts can be diffed for token drift without either run
+        # having recorded a journal
+        row["output_token_hash"] = sreplay.token_hash(eng.output(r))
+        row["weights_generation"] = eng.weights_generation
         per_req.append(row)
     ttft = [m["ttft_s"] for m in per_req if m["ttft_s"] is not None]
     tpot = [m["tpot_s"] for m in per_req if m["tpot_s"] is not None]
@@ -1054,6 +1100,16 @@ def _run_single(args):
     if args.trace_out and not args.no_trace:
         mtrace.write_journal(args.trace_out)
         print("wrote", args.trace_out, flush=True)
+    if args.record_out:
+        # model meta makes the journal self-contained: ptreplay
+        # rebuilds the exact weights from config kwargs + init seed
+        # without ever importing this script
+        sreplay.note_model({"preset": args.preset, "seed": args.seed,
+                            "config": dict(PRESETS[args.preset])})
+        head, jentries = sreplay.write_journal(args.record_out)
+        print("wrote %s (%d journal entries, %d evictions)"
+              % (args.record_out, len(jentries), head["evictions"]),
+              flush=True)
     # contract check: the whole staggered workload must have reused ONE
     # compiled decode step (the engine's core shape-stability claim)
     if stats["decode_compiles"] != 1:

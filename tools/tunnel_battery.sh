@@ -53,6 +53,21 @@ run pthlo 600 python tools/pthlo.py --check --out tools/graph_report.json
 #     lost the power its zeros rely on).
 run ptcheck 300 python tools/ptcheck.py --out tools/ptcheck_report.json
 
+# 0d. record/replay audit (ISSUE 20): ptreplay's self-check — record a
+#     mixed tiny workload (prefix hits + chunked prefill + quant-kv +
+#     forced preempt/resume) under FLAGS_serving_replay, then (a) the
+#     identity replay must land ZERO divergences with
+#     decode_compiles == 1, (b) a deliberately perturbed weight leaf
+#     MUST be detected and the flag matrix must bisect it to the
+#     `weights` axis, not blame a flag (the ptcheck expected-finding
+#     discipline: a replay check that cannot fail a broken run proves
+#     nothing), and (c) the clean matrix must keep the token-identity
+#     axes (prefix, chunked) at zero. Host-only CPU like the 0a-0c
+#     rows — determinism is a software property; the committed
+#     artifact is tools/replay_snapshot.json (stale re-emit rc=3).
+run serving_replay 900 env JAX_PLATFORMS=cpu \
+    python tools/ptreplay.py smoke --out tools/replay_snapshot.json
+
 # 0. pre-flight: bail fast if the tunnel is actually wedged
 run probe 240 python bench.py --probe || { echo "tunnel wedged; abort"; exit 3; }
 
